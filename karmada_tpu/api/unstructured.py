@@ -1,0 +1,103 @@
+"""Unstructured workload objects (templates) stored as plain dict manifests.
+
+The reference detector watches *all* API resources as
+unstructured.Unstructured (pkg/detector/detector.go:112); we mirror that with
+a thin wrapper over a dict manifest that exposes ObjectMeta accessors so it can
+live in the same store as typed objects.
+"""
+from __future__ import annotations
+
+import copy
+from typing import Any, Optional
+
+from .meta import ObjectMeta
+
+
+class Unstructured:
+    """Dict-backed object: {'apiVersion','kind','metadata',...}."""
+
+    def __init__(self, manifest: dict):
+        manifest.setdefault("metadata", {})
+        self._m = manifest
+        md = manifest["metadata"]
+        self.metadata = ObjectMeta(
+            name=md.get("name", ""),
+            namespace=md.get("namespace", ""),
+            uid=md.get("uid", ""),
+            labels=md.setdefault("labels", {}),
+            annotations=md.setdefault("annotations", {}),
+            finalizers=md.setdefault("finalizers", []),
+            resource_version=md.get("resourceVersion", 0),
+            generation=md.get("generation", 0),
+            creation_timestamp=md.get("creationTimestamp", 0.0),
+            deletion_timestamp=md.get("deletionTimestamp"),
+        )
+
+    # Keep the wrapper and the dict view coherent when the store mutates meta.
+    def sync_meta(self) -> None:
+        md = self._m["metadata"]
+        md["name"] = self.metadata.name
+        md["namespace"] = self.metadata.namespace
+        md["uid"] = self.metadata.uid
+        md["labels"] = self.metadata.labels
+        md["annotations"] = self.metadata.annotations
+        md["finalizers"] = self.metadata.finalizers
+        md["resourceVersion"] = self.metadata.resource_version
+        md["generation"] = self.metadata.generation
+        md["creationTimestamp"] = self.metadata.creation_timestamp
+        if self.metadata.deletion_timestamp is not None:
+            md["deletionTimestamp"] = self.metadata.deletion_timestamp
+
+    @property
+    def kind(self) -> str:
+        return self._m.get("kind", "")
+
+    @property
+    def api_version(self) -> str:
+        return self._m.get("apiVersion", "")
+
+    @property
+    def name(self) -> str:
+        return self.metadata.name
+
+    @property
+    def namespace(self) -> str:
+        return self.metadata.namespace
+
+    def to_dict(self) -> dict:
+        self.sync_meta()
+        return copy.deepcopy(self._m)
+
+    def get(self, *path: str, default: Any = None) -> Any:
+        cur: Any = self._m
+        for p in path:
+            if not isinstance(cur, dict) or p not in cur:
+                return default
+            cur = cur[p]
+        return cur
+
+    def set(self, *path_and_value: Any) -> None:
+        *path, value = path_and_value
+        cur = self._m
+        for p in path[:-1]:
+            cur = cur.setdefault(p, {})
+        cur[path[-1]] = value
+
+    @property
+    def spec(self) -> dict:
+        return self._m.setdefault("spec", {})
+
+    @property
+    def status(self) -> dict:
+        return self._m.setdefault("status", {})
+
+    @status.setter
+    def status(self, v: dict) -> None:
+        self._m["status"] = v
+
+    def __deepcopy__(self, memo):
+        self.sync_meta()
+        return Unstructured(copy.deepcopy(self._m, memo))
+
+    def __repr__(self) -> str:
+        return f"Unstructured({self.api_version}/{self.kind} {self.metadata.key()})"

@@ -1,0 +1,251 @@
+"""In-memory versioned object store with a watch bus.
+
+This is the control-plane storage/API layer (SURVEY L1 / D1): the reference
+uses a stock kube-apiserver + etcd with level-triggered informers; we provide
+the same contract — versioned objects, generation bumps on spec change, watch
+events, finalizer-gated deletion — as an in-process store so every controller
+can stay level-triggered and resumable (reference invariant: all state is CRDs,
+device state is a rebuildable cache; SURVEY §5 checkpoint note).
+
+Thread-safety: a single RLock guards all maps; watch delivery is synchronous
+(callbacks run under the caller, outside the lock) feeding controller queues.
+"""
+from __future__ import annotations
+
+import copy
+import threading
+from dataclasses import dataclass
+from typing import Any, Callable, Iterable, Optional
+
+from ..api.meta import ObjectMeta, new_uid, now
+from ..api.unstructured import Unstructured
+
+ADDED = "ADDED"
+MODIFIED = "MODIFIED"
+DELETED = "DELETED"
+
+WatchHandler = Callable[[str, Any], None]  # (event_type, obj)
+
+
+class ConflictError(Exception):
+    pass
+
+
+class NotFoundError(KeyError):
+    pass
+
+
+def gvk_of(obj: Any) -> str:
+    """Store key kind. Typed objects use their dataclass kind; unstructured
+    use apiVersion+kind so e.g. apps/v1/Deployment is distinct."""
+    if isinstance(obj, Unstructured):
+        return f"{obj.api_version}/{obj.kind}"
+    return obj.kind
+
+
+@dataclass
+class _Bucket:
+    objects: dict[str, Any]
+    watchers: list[WatchHandler]
+
+
+class Store:
+    def __init__(self) -> None:
+        self._lock = threading.RLock()
+        self._buckets: dict[str, _Bucket] = {}
+        self._rv = 0
+        self._all_watchers: list[Callable[[str, str, Any], None]] = []
+
+    # -- helpers ----------------------------------------------------------
+
+    def _bucket(self, kind: str) -> _Bucket:
+        b = self._buckets.get(kind)
+        if b is None:
+            b = _Bucket(objects={}, watchers=[])
+            self._buckets[kind] = b
+        return b
+
+    @staticmethod
+    def _key(meta: ObjectMeta) -> str:
+        return meta.key()
+
+    @staticmethod
+    def _name_key(name: str, namespace: str) -> str:
+        return ObjectMeta(name=name, namespace=namespace).key()
+
+    def _next_rv(self) -> int:
+        self._rv += 1
+        return self._rv
+
+    @staticmethod
+    def _spec_view(obj: Any) -> Any:
+        """The part whose change bumps generation (k8s semantics: spec only)."""
+        if isinstance(obj, Unstructured):
+            d = obj.to_dict()
+            d.pop("status", None)
+            d.pop("metadata", None)
+            return d
+        spec = getattr(obj, "spec", None)
+        return spec
+
+    # -- CRUD -------------------------------------------------------------
+
+    def create(self, obj: Any) -> Any:
+        kind = gvk_of(obj)
+        with self._lock:
+            b = self._bucket(kind)
+            key = self._key(obj.metadata)
+            if key in b.objects:
+                raise ConflictError(f"{kind} {key} already exists")
+            stored = copy.deepcopy(obj)
+            m = stored.metadata
+            if not m.uid:
+                m.uid = new_uid(kind.split("/")[-1].lower())
+            m.creation_timestamp = m.creation_timestamp or now()
+            m.resource_version = self._next_rv()
+            m.generation = 1
+            b.objects[key] = stored
+            out = copy.deepcopy(stored)
+        self._notify(kind, ADDED, out)
+        return out
+
+    def get(self, kind: str, name: str, namespace: str = "") -> Any:
+        with self._lock:
+            b = self._buckets.get(kind)
+            key = self._name_key(name, namespace)
+            if b is None or key not in b.objects:
+                raise NotFoundError(f"{kind} {key}")
+            return copy.deepcopy(b.objects[key])
+
+    def try_get(self, kind: str, name: str, namespace: str = "") -> Optional[Any]:
+        try:
+            return self.get(kind, name, namespace)
+        except NotFoundError:
+            return None
+
+    def list(self, kind: str, namespace: str = "") -> list[Any]:
+        with self._lock:
+            b = self._buckets.get(kind)
+            if b is None:
+                return []
+            objs = b.objects.values()
+            if namespace:
+                objs = [o for o in objs if o.metadata.namespace == namespace]
+            return [copy.deepcopy(o) for o in objs]
+
+    def kinds(self) -> list[str]:
+        with self._lock:
+            return list(self._buckets.keys())
+
+    def update(self, obj: Any, *, check_rv: bool = False) -> Any:
+        """Update; bumps generation if the spec view changed. Finalizer-gated
+        deletion: if deletionTimestamp set and no finalizers remain, the
+        object is removed instead."""
+        kind = gvk_of(obj)
+        with self._lock:
+            b = self._bucket(kind)
+            key = self._key(obj.metadata)
+            existing = b.objects.get(key)
+            if existing is None:
+                raise NotFoundError(f"{kind} {key}")
+            if check_rv and obj.metadata.resource_version != existing.metadata.resource_version:
+                raise ConflictError(
+                    f"{kind} {key}: rv {obj.metadata.resource_version} != {existing.metadata.resource_version}"
+                )
+            stored = copy.deepcopy(obj)
+            m = stored.metadata
+            m.uid = existing.metadata.uid
+            m.creation_timestamp = existing.metadata.creation_timestamp
+            m.generation = existing.metadata.generation
+            # deletionTimestamp is immutable once set (k8s semantics): a stale
+            # writer must not resurrect an object already marked for deletion.
+            if existing.metadata.deletion_timestamp is not None:
+                m.deletion_timestamp = existing.metadata.deletion_timestamp
+            if self._differs(self._spec_view(existing), self._spec_view(stored)):
+                m.generation += 1
+            if m.deletion_timestamp is not None and not m.finalizers:
+                del b.objects[key]
+                out = copy.deepcopy(stored)
+                deleted = True
+            else:
+                m.resource_version = self._next_rv()
+                b.objects[key] = stored
+                out = copy.deepcopy(stored)
+                deleted = False
+        self._notify(kind, DELETED if deleted else MODIFIED, out)
+        return out
+
+    def apply(self, obj: Any) -> Any:
+        """create-or-update. The existence check and the inner create/update
+        run under one reentrant-lock hold so concurrent apply() calls cannot
+        race each other into ConflictError/NotFoundError. Watch handlers must
+        stay enqueue-only (they may run with the lock held on this path)."""
+        kind = gvk_of(obj)
+        key = self._key(obj.metadata)
+        with self._lock:
+            exists = key in self._bucket(kind).objects
+            return self.update(obj) if exists else self.create(obj)
+
+    def delete(self, kind: str, name: str, namespace: str = "") -> None:
+        """Marks deletionTimestamp; removes immediately when no finalizers."""
+        with self._lock:
+            b = self._buckets.get(kind)
+            key = self._name_key(name, namespace)
+            if b is None or key not in b.objects:
+                return
+            obj = b.objects[key]
+            if obj.metadata.deletion_timestamp is None:
+                obj.metadata.deletion_timestamp = now()
+            if obj.metadata.finalizers:
+                obj.metadata.resource_version = self._next_rv()
+                out = copy.deepcopy(obj)
+                deleted = False
+            else:
+                del b.objects[key]
+                out = copy.deepcopy(obj)
+                deleted = True
+        self._notify(kind, DELETED if deleted else MODIFIED, out)
+
+    @staticmethod
+    def _differs(a: Any, b: Any) -> bool:
+        if a is None and b is None:
+            return False
+        try:
+            return a != b
+        except Exception:
+            return True
+
+    # -- watch ------------------------------------------------------------
+
+    def watch(self, kind: str, handler: WatchHandler, *, replay: bool = True) -> None:
+        """Subscribe; with replay=True existing objects are delivered as ADDED
+        first (informer 'list+watch' semantics)."""
+        with self._lock:
+            self._bucket(kind).watchers.append(handler)
+            snapshot = [copy.deepcopy(o) for o in self._buckets[kind].objects.values()]
+        if replay:
+            for o in snapshot:
+                handler(ADDED, o)
+
+    def watch_all(self, handler: Callable[[str, str, Any], None], *, replay: bool = True) -> None:
+        """Subscribe to every kind: handler(kind, event, obj). Used by the
+        detector's dynamic-informer sweep (detector.go:112)."""
+        with self._lock:
+            self._all_watchers.append(handler)
+            snapshot = [
+                (kind, copy.deepcopy(o))
+                for kind, b in self._buckets.items()
+                for o in b.objects.values()
+            ]
+        if replay:
+            for kind, o in snapshot:
+                handler(kind, ADDED, o)
+
+    def _notify(self, kind: str, event: str, obj: Any) -> None:
+        with self._lock:
+            watchers = list(self._buckets[kind].watchers)
+            all_watchers = list(self._all_watchers)
+        for w in watchers:
+            w(event, obj)
+        for w in all_watchers:
+            w(kind, event, obj)

@@ -1,0 +1,8 @@
+"""Test env: force an 8-device virtual CPU mesh before jax is imported
+(multi-chip sharding is validated on host devices; real TPU only in bench)."""
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "--xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()

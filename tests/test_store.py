@@ -1,0 +1,122 @@
+from karmada_tpu.api.cluster import Cluster
+from karmada_tpu.api.meta import ObjectMeta
+from karmada_tpu.api.unstructured import Unstructured
+from karmada_tpu.store.store import ADDED, DELETED, MODIFIED, Store
+from karmada_tpu.testing.fixtures import new_cluster, new_deployment
+
+
+def test_create_get_versions():
+    s = Store()
+    c = s.create(new_cluster("m1"))
+    assert c.metadata.uid
+    assert c.metadata.resource_version == 1
+    assert c.metadata.generation == 1
+    got = s.get("Cluster", "m1")
+    assert got.name == "m1"
+
+
+def test_generation_bumps_only_on_spec_change():
+    s = Store()
+    c = s.create(new_cluster("m1"))
+    c.status.kubernetes_version = "v1.30"
+    c = s.update(c)
+    assert c.metadata.generation == 1  # status-only change
+    c.spec.region = "us-east1"
+    c = s.update(c)
+    assert c.metadata.generation == 2
+
+
+def test_watch_replay_and_events():
+    s = Store()
+    s.create(new_cluster("m1"))
+    events = []
+    s.watch("Cluster", lambda ev, o: events.append((ev, o.name)))
+    assert events == [(ADDED, "m1")]
+    s.create(new_cluster("m2"))
+    c = s.get("Cluster", "m1")
+    c.spec.region = "r"
+    s.update(c)
+    s.delete("Cluster", "m2")
+    assert events == [(ADDED, "m1"), (ADDED, "m2"), (MODIFIED, "m1"), (DELETED, "m2")]
+
+
+def test_finalizer_gated_delete():
+    s = Store()
+    c = new_cluster("m1")
+    c.metadata.finalizers = ["karmada.io/cluster-controller"]
+    s.create(c)
+    s.delete("Cluster", "m1")
+    got = s.get("Cluster", "m1")  # still there, marked deleting
+    assert got.metadata.deletion_timestamp is not None
+    got.metadata.finalizers = []
+    s.update(got)
+    assert s.try_get("Cluster", "m1") is None
+
+
+def test_unstructured_kind_key():
+    s = Store()
+    d = new_deployment("default", "nginx", replicas=3)
+    s.create(d)
+    got = s.get("apps/v1/Deployment", "nginx", "default")
+    assert isinstance(got, Unstructured)
+    assert got.get("spec", "replicas") == 3
+
+
+def test_store_isolation_mutation_safe():
+    s = Store()
+    c = new_cluster("m1", labels={"a": "1"})
+    s.create(c)
+    c.metadata.labels["a"] = "HACKED"
+    assert s.get("Cluster", "m1").metadata.labels["a"] == "1"
+
+
+def test_unstructured_roundtrips_meta_through_store():
+    s = Store()
+    d = new_deployment("default", "nginx")
+    d.metadata.finalizers = ["karmada.io/x"]
+    created = s.create(d)
+    assert created.metadata.resource_version == 1
+    assert created.metadata.generation == 1
+    assert created.metadata.finalizers == ["karmada.io/x"]
+    s.delete("apps/v1/Deployment", "nginx", "default")
+    got = s.get("apps/v1/Deployment", "nginx", "default")
+    assert got.metadata.deletion_timestamp is not None  # gated by finalizer
+    got.metadata.finalizers = []
+    s.update(got)
+    assert s.try_get("apps/v1/Deployment", "nginx", "default") is None
+
+
+def test_stale_update_cannot_resurrect_deleting_object():
+    s = Store()
+    c = new_cluster("m1")
+    c.metadata.finalizers = ["f"]
+    s.create(c)
+    stale = s.get("Cluster", "m1")  # controller holds a copy
+    s.delete("Cluster", "m1")
+    stale.status.kubernetes_version = "v1.30"
+    out = s.update(stale)  # status write from stale copy
+    assert out.metadata.deletion_timestamp is not None
+
+
+def test_runtime_retries_then_drops_failing_key():
+    from karmada_tpu.runtime.controller import Controller, DONE, Runtime
+
+    calls = {"n": 0}
+
+    def reconcile(key):
+        calls["n"] += 1
+        if calls["n"] < 3:
+            raise RuntimeError("transient")
+        return DONE
+
+    rt = Runtime()
+    c = rt.register(Controller(name="t", reconcile=reconcile))
+    c.enqueue("k")
+    rt.settle()
+    assert calls["n"] == 3
+    assert "k" not in c.errors
+
+    boom = rt.register(Controller(name="boom", reconcile=lambda k: (_ for _ in ()).throw(RuntimeError("always"))))
+    boom.enqueue("k2")
+    rt.settle()  # must terminate (retry cap) without raising
+    assert isinstance(boom.errors["k2"], RuntimeError)
