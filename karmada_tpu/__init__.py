@@ -1,0 +1,16 @@
+"""karmada-tpu: a TPU-native multi-cluster placement framework.
+
+Host plane: level-triggered reconcilers over a versioned store (the Karmada
+object model). Device plane: the scheduler/estimator/descheduler math as
+batched [bindings, clusters] array programs under JAX/XLA.
+
+int64 is required end-to-end for the division algorithms' integer parity with
+the reference (weight*target products exceed int32; resource quantities are
+int64 in Kubernetes) — enable x64 before any jax arrays are created. All
+device arrays keep explicit dtypes (f32 for floats) so TPU never sees f64.
+"""
+import jax
+
+jax.config.update("jax_enable_x64", True)
+
+__version__ = "0.1.0"

@@ -1,0 +1,268 @@
+"""Per-round binding batch encoding: dirty ResourceBindings → dense arrays.
+
+The reference schedules one binding at a time (scheduler.go:375-443); the TPU
+build gathers all dirty bindings of a round into one [B,...] batch. String
+work (affinity/label selectors, static-weight rule matching) happens here on
+host with per-policy dedup; the device sees only ids, masks and integers.
+
+Strategy codes mirror newAssignState's dispatch (core/assignment.go:89-117):
+  0 NON_WORKLOAD (spec.replicas <= 0 → all candidates, no counts,
+    core/common.go:68-75)
+  1 DUPLICATED
+  2 STATIC_WEIGHT
+  3 DYNAMIC_WEIGHT
+  4 AGGREGATED
+"""
+from __future__ import annotations
+
+import hashlib
+from dataclasses import dataclass
+from typing import Optional, Sequence
+
+import numpy as np
+
+from ..api.policy import (
+    DIVISION_PREFERENCE_AGGREGATED,
+    DIVISION_PREFERENCE_WEIGHTED,
+    Placement,
+    REPLICA_SCHEDULING_DIVIDED,
+    REPLICA_SCHEDULING_DUPLICATED,
+)
+from ..api.work import ResourceBinding
+from ..sched.affinity import AffinityMaskCache, affinity_key
+from .fleet import EFFECT_CODES, FleetArrays, FleetEncoder, to_int_units
+from ..ops.filters import TOL_OP_EQUAL, TOL_OP_EXISTS
+
+NON_WORKLOAD = 0
+DUPLICATED = 1
+STATIC_WEIGHT = 2
+DYNAMIC_WEIGHT = 3
+AGGREGATED = 4
+
+
+def strategy_code(placement: Optional[Placement], replicas: int) -> int:
+    if replicas <= 0:
+        return NON_WORKLOAD
+    if placement is None or placement.replica_scheduling is None:
+        return DUPLICATED
+    rs = placement.replica_scheduling
+    if rs.replica_scheduling_type == REPLICA_SCHEDULING_DUPLICATED:
+        return DUPLICATED
+    if rs.replica_scheduling_type == REPLICA_SCHEDULING_DIVIDED:
+        if rs.replica_division_preference == DIVISION_PREFERENCE_AGGREGATED:
+            return AGGREGATED
+        if rs.replica_division_preference == DIVISION_PREFERENCE_WEIGHTED:
+            if rs.weight_preference is not None and rs.weight_preference.dynamic_weight:
+                return DYNAMIC_WEIGHT
+            return STATIC_WEIGHT
+    return DUPLICATED
+
+
+def uid_seed(uid: str) -> np.uint64:
+    return np.frombuffer(hashlib.blake2b(uid.encode(), digest_size=8).digest(), np.uint64)[0]
+
+
+def _mix64(x: np.ndarray) -> np.ndarray:
+    """splitmix64 finalizer — stateless deterministic tie-break randomness."""
+    with np.errstate(over="ignore"):
+        x = (x ^ (x >> np.uint64(30))) * np.uint64(0xBF58476D1CE4E5B9)
+        x = (x ^ (x >> np.uint64(27))) * np.uint64(0x94D049BB133111EB)
+        return x ^ (x >> np.uint64(31))
+
+
+def tie_matrix(uids: Sequence[str], n_clusters: int) -> np.ndarray:
+    """Deterministic replacement for the crypto-rand tie-break
+    (binding.go:74-79): per-(binding,cluster) pseudo-random i32 derived from
+    the binding UID, independent of batch composition."""
+    seeds = np.array([uid_seed(u) for u in uids], np.uint64)[:, None]
+    idx = np.arange(1, n_clusters + 1, dtype=np.uint64)[None, :]
+    return (_mix64(seeds ^ idx) >> np.uint64(33)).astype(np.int32)
+
+
+@dataclass
+class BindingBatch:
+    keys: list[str]  # namespace/name per row
+    uids: list[str]
+    # core tensors
+    replicas: np.ndarray  # i32[B]
+    request: np.ndarray  # i64[B,R] integer units (cpu milli)
+    unknown_request: np.ndarray  # bool[B] request names outside the resource
+    #   vocabulary ⇒ estimators must report 0 (missing allocatable key → 0,
+    #   general.go:166-169)
+    gvk: np.ndarray  # i32[B]
+    strategy: np.ndarray  # i32[B]
+    fresh: np.ndarray  # bool[B]
+    # tolerations
+    tol_key: np.ndarray  # i32[B,K]
+    tol_value: np.ndarray
+    tol_effect: np.ndarray
+    tol_op: np.ndarray
+    # host-evaluated masks / weights
+    affinity_ok: np.ndarray  # bool[B,C]
+    eviction_ok: np.ndarray  # bool[B,C]
+    static_weight: np.ndarray  # i64[B,C]
+    prev_member: np.ndarray  # bool[B,C]
+    prev_replicas: np.ndarray  # i32[B,C]
+    tie: np.ndarray  # i32[B,C]
+
+    @property
+    def size(self) -> int:
+        return len(self.keys)
+
+
+class BatchEncoder:
+    """Encodes bindings against one fleet encoding. Create a new instance
+    when the fleet changes (affinity masks depend on cluster labels)."""
+
+    def __init__(self, encoder: FleetEncoder, fleet: FleetArrays, clusters, max_tolerations: int = 6):
+        self.encoder = encoder
+        self.fleet = fleet
+        self.clusters = list(clusters)
+        self.max_tolerations = max_tolerations
+        self.affinity_cache = AffinityMaskCache(self.clusters)
+        self._weight_cache: dict[str, np.ndarray] = {}
+        self._cluster_index = {c.name: i for i, c in enumerate(self.clusters)}
+
+    def _static_weights(self, placement: Optional[Placement]) -> np.ndarray:
+        """weight[c] = max over matching rules (division_algorithm.go:40-55);
+        0 where no rule matches. The all-zero → all-ones fallback happens on
+        device against the *candidate* set."""
+        C = len(self.clusters)
+        if (
+            placement is None
+            or placement.replica_scheduling is None
+            or placement.replica_scheduling.weight_preference is None
+            or not placement.replica_scheduling.weight_preference.static_weight_list
+        ):
+            return np.zeros(C, np.int64)
+        rules = placement.replica_scheduling.weight_preference.static_weight_list
+        key = "&".join(f"{affinity_key(r.target_cluster)}#{r.weight}" for r in rules)
+        w = self._weight_cache.get(key)
+        if w is None:
+            w = np.zeros(C, np.int64)
+            for r in rules:
+                m = self.affinity_cache.mask(r.target_cluster)
+                w = np.where(m, np.maximum(w, r.weight), w)
+            self._weight_cache[key] = w
+        return w
+
+    def active_affinity(self, rb: ResourceBinding, term_index: int = -1):
+        """Single affinity, or the term_index-th ordered affinity term
+        (scheduler.go:562-625 failover loop)."""
+        p = rb.spec.placement
+        if p is None:
+            return None
+        if p.cluster_affinities:
+            i = max(term_index, 0)
+            return p.cluster_affinities[i].affinity
+        return p.cluster_affinity
+
+    def encode(
+        self,
+        bindings: Sequence[ResourceBinding],
+        term_indices: Optional[Sequence[int]] = None,
+    ) -> BindingBatch:
+        B = len(bindings)
+        C = len(self.clusters)
+        R = len(self.encoder.resources)
+        # Toleration axis sized to the batch maximum (bucketed) — capping
+        # would wrongly reject bindings whose matching toleration is dropped.
+        widest = max(
+            (
+                len(b.spec.placement.cluster_tolerations)
+                for b in bindings
+                if b.spec.placement is not None
+            ),
+            default=0,
+        )
+        K = self.max_tolerations
+        while K < widest:
+            K *= 2
+
+        keys, uids = [], []
+        replicas = np.zeros(B, np.int32)
+        request = np.zeros((B, R), np.int64)
+        unknown_request = np.zeros(B, bool)
+        gvk = np.zeros(B, np.int32)
+        strategy = np.zeros(B, np.int32)
+        fresh = np.zeros(B, bool)
+        tol_key = np.zeros((B, K), np.int32)
+        tol_value = np.zeros((B, K), np.int32)
+        tol_effect = np.zeros((B, K), np.int32)
+        tol_op = np.zeros((B, K), np.int32)
+        affinity_ok = np.ones((B, C), bool)
+        eviction_ok = np.ones((B, C), bool)
+        static_weight = np.zeros((B, C), np.int64)
+        prev_member = np.zeros((B, C), bool)
+        prev_replicas = np.zeros((B, C), np.int32)
+
+        for b, rb in enumerate(bindings):
+            keys.append(rb.metadata.key())
+            uids.append(rb.metadata.uid or rb.metadata.key())
+            spec = rb.spec
+            replicas[b] = spec.replicas
+            gvk[b] = self.encoder.gvk_id(spec.resource.api_version, spec.resource.kind)
+            strategy[b] = strategy_code(spec.placement, spec.replicas)
+            fresh[b] = _reschedule_required(spec, rb.status)
+            if spec.replica_requirements is not None:
+                known = set(self.encoder.resources)
+                for rname, val in spec.replica_requirements.resource_request.items():
+                    if rname not in known and to_int_units(rname, val) > 0:
+                        unknown_request[b] = True
+                for r, rname in enumerate(self.encoder.resources):
+                    request[b, r] = to_int_units(
+                        rname, spec.replica_requirements.resource_request.get(rname, 0.0)
+                    )
+
+            placement = spec.placement or Placement()
+            for k, tol in enumerate(placement.cluster_tolerations):
+                tol_key[b, k] = self.encoder.strings.id(tol.key)
+                tol_value[b, k] = self.encoder.strings.id(tol.value)
+                tol_effect[b, k] = EFFECT_CODES.get(tol.effect, 0)
+                tol_op[b, k] = TOL_OP_EXISTS if tol.operator == "Exists" else TOL_OP_EQUAL
+
+            term = -1 if term_indices is None else term_indices[b]
+            affinity_ok[b] = self.affinity_cache.mask(self.active_affinity(rb, term))
+            static_weight[b] = self._static_weights(placement)
+
+            for tc in spec.clusters:
+                i = self._cluster_index.get(tc.name)
+                if i is not None:
+                    prev_member[b, i] = True
+                    prev_replicas[b, i] = tc.replicas
+            for task in spec.graceful_eviction_tasks:
+                i = self._cluster_index.get(task.from_cluster)
+                if i is not None:
+                    eviction_ok[b, i] = False
+
+        return BindingBatch(
+            keys=keys,
+            uids=uids,
+            replicas=replicas,
+            request=request,
+            unknown_request=unknown_request,
+            gvk=gvk,
+            strategy=strategy,
+            fresh=fresh,
+            tol_key=tol_key,
+            tol_value=tol_value,
+            tol_effect=tol_effect,
+            tol_op=tol_op,
+            affinity_ok=affinity_ok,
+            eviction_ok=eviction_ok,
+            static_weight=static_weight,
+            prev_member=prev_member,
+            prev_replicas=prev_replicas,
+            tie=tie_matrix(uids, C),
+        )
+
+
+def _reschedule_required(spec, status) -> bool:
+    """util.RescheduleRequired: a WorkloadRebalancer stamped
+    spec.rescheduleTriggeredAt after the last successful schedule
+    (assignment.go:110-115 → Fresh mode)."""
+    if spec.reschedule_triggered_at is None:
+        return False
+    if status.last_scheduled_time is None:
+        return True
+    return spec.reschedule_triggered_at > status.last_scheduled_time

@@ -1,0 +1,114 @@
+"""Scheduler filter plugins as batched boolean masks.
+
+TPU reframing of pkg/scheduler/core/generic_scheduler.go:118-141 (the
+sequential clusters × filter-plugins loop, HOT LOOP 1): all six in-tree
+plugins (plugins/registry.go:30-39) become one fused [B,C] mask computation.
+
+Plugin → mask:
+- APIEnablement  (api_enablement.go:52)       → api_mask
+- TaintToleration (taint_toleration.go:52)    → taint_mask (NoSchedule +
+  NoExecute taints must be tolerated; PreferNoSchedule is score-only and
+  ignored by the filter)
+- ClusterAffinity (cluster_affinity.go:51-80) → affinity mask: cluster-name
+  include/exclude matched on interned ids device-side; label/field selectors
+  are string programs evaluated host-side into `selector_ok` and combined here
+- SpreadConstraint filter (spread_constraint.go:49) → topo fields populated
+- ClusterEviction (cluster_eviction.go:50)    → eviction mask from
+  spec.gracefulEvictionTasks
+- aliveness (scheduler watches only joined+ready clusters)
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+# toleration operator codes
+TOL_OP_NONE = 0
+TOL_OP_EQUAL = 1
+TOL_OP_EXISTS = 2
+
+# effect codes (models/fleet.py EFFECT_CODES)
+EFF_NO_SCHEDULE = 1
+EFF_PREFER_NO_SCHEDULE = 2
+EFF_NO_EXECUTE = 3
+
+
+def taint_toleration_mask(
+    taint_key,  # i32[C,T] (0 = no taint in slot)
+    taint_value,  # i32[C,T]
+    taint_effect,  # i32[C,T]
+    tol_key,  # i32[B,K] (0 = empty key)
+    tol_value,  # i32[B,K]
+    tol_effect,  # i32[B,K] (0 = matches all effects)
+    tol_op,  # i32[B,K]
+):
+    """ok[b,c] ⇔ every NoSchedule/NoExecute taint of c is tolerated by some
+    toleration of b (corev1 toleration semantics via
+    plugins/tainttoleration/taint_toleration.go:52)."""
+    B, K = tol_key.shape
+    C, T = taint_key.shape
+    active = (taint_effect == EFF_NO_SCHEDULE) | (taint_effect == EFF_NO_EXECUTE)  # [C,T]
+    has_tol = tol_op != TOL_OP_NONE  # [B,K]
+
+    ok = jnp.ones((B, C), bool)
+    for t in range(T):  # T is a small static constant; XLA fuses the slices
+        tk = taint_key[:, t]  # [C]
+        tv = taint_value[:, t]
+        te = taint_effect[:, t]
+        # match[b,c,k]
+        key_match = (tol_key[:, None, :] == tk[None, :, None]) | (
+            (tol_key[:, None, :] == 0) & (tol_op[:, None, :] == TOL_OP_EXISTS)
+        )
+        effect_match = (tol_effect[:, None, :] == 0) | (
+            tol_effect[:, None, :] == te[None, :, None]
+        )
+        value_match = (tol_op[:, None, :] == TOL_OP_EXISTS) | (
+            tol_value[:, None, :] == tv[None, :, None]
+        )
+        tolerated = (has_tol[:, None, :] & key_match & effect_match & value_match).any(-1)
+        ok &= ~active[None, :, t] | tolerated
+    return ok
+
+
+def api_enablement_mask(api_ok, gvk):
+    """ok[b,c] ⇔ cluster c advertises binding b's GVK (api_enablement.go:52).
+    api_ok: bool[C,G]; gvk: i32[B]. A GVK id minted after the fleet encoding
+    (gvk >= G) is advertised by no cluster — without the explicit bound check
+    the gather would clamp and alias the last registered GVK's row."""
+    G = api_ok.shape[1]
+    ok = api_ok.T[jnp.clip(gvk, 0, max(G - 1, 0))]  # [B,C]
+    return ok & (gvk < G)[:, None]
+
+
+def cluster_name_affinity_mask(
+    name_id,  # i32[C]
+    include,  # i32[B,A] affinity clusterNames ids (0 = pad)
+    has_include,  # bool[B] clusterNames non-empty
+    exclude,  # i32[B,E] (0 = pad)
+):
+    """ClusterAffinity clusterNames/exclude on interned ids
+    (cluster_affinity.go:51-80); label/field selectors enter via selector_ok."""
+    inc = (include[:, :, None] == name_id[None, None, :]).any(1)  # [B,C]
+    inc = jnp.where(has_include[:, None], inc, True)
+    exc = (exclude[:, :, None] == name_id[None, None, :]).any(1)
+    return inc & ~exc
+
+
+def feasible_mask(
+    alive,  # bool[C]
+    api_mask,  # bool[B,C]
+    taint_mask,  # bool[B,C]
+    name_affinity,  # bool[B,C]
+    selector_ok,  # bool[B,C] host-evaluated label/field selectors
+    eviction_ok,  # bool[B,C] ClusterEviction plugin (cluster not in
+    #               gracefulEvictionTasks, cluster_eviction.go:50)
+):
+    """The fused findClustersThatFit (generic_scheduler.go:118-141)."""
+    return alive[None, :] & api_mask & taint_mask & name_affinity & selector_ok & eviction_ok
+
+
+def locality_score(prev_member):
+    """ClusterLocality score plugin (cluster_locality.go:50): 100 for
+    clusters already in spec.clusters, else 0. Other in-tree score plugins
+    return constant 0, so total score = locality (generic_scheduler.go:166-172
+    sums plugins)."""
+    return jnp.where(prev_member, 100, 0).astype(jnp.int32)

@@ -1,0 +1,236 @@
+"""The batched scheduling core: one jitted [B,C] program per round.
+
+TPU reframing of pkg/scheduler/core/generic_scheduler.go:70-115
+(Schedule = snapshot → findClustersThatFit → prioritizeClusters →
+SelectClusters → AssignReplicas): the per-binding sequential loop becomes a
+single fused device program over all dirty bindings. The fleet snapshot is the
+persistent device encoding (models/fleet.py) instead of an O(N) deep copy per
+attempt (cache/cache.go:62-77).
+
+Spread-constraint selection is layered on in sched/spread.py; without spread
+constraints SelectClusters returns every feasible cluster (common.go:32-39
+with empty constraints).
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from functools import partial
+from typing import Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..api.work import TargetCluster
+from ..models.batch import (
+    AGGREGATED,
+    BatchEncoder,
+    BindingBatch,
+    DUPLICATED,
+    DYNAMIC_WEIGHT,
+    NON_WORKLOAD,
+    STATIC_WEIGHT,
+)
+from ..models.fleet import FleetArrays, FleetEncoder
+from ..ops import assign as assign_ops
+from ..ops import filters as filter_ops
+
+
+@dataclass
+class ScheduleDecision:
+    key: str
+    targets: Optional[list[TargetCluster]] = None
+    error: str = ""  # non-empty ⇒ unschedulable / fit error
+    feasible: list[str] = field(default_factory=list)
+    score: Optional[np.ndarray] = None
+
+    @property
+    def ok(self) -> bool:
+        return not self.error
+
+
+@partial(jax.jit, static_argnames=())
+def _schedule_kernel(
+    # fleet
+    alive,
+    capacity,
+    has_summary,
+    taint_key,
+    taint_value,
+    taint_effect,
+    api_ok,
+    # batch
+    replicas,
+    request,
+    unknown_request,
+    gvk,
+    strategy,
+    fresh,
+    tol_key,
+    tol_value,
+    tol_effect,
+    tol_op,
+    affinity_ok,
+    eviction_ok,
+    static_weight,
+    prev_member,
+    prev_replicas,
+    tie,
+):
+    taint_mask = filter_ops.taint_toleration_mask(
+        taint_key, taint_value, taint_effect, tol_key, tol_value, tol_effect, tol_op
+    )
+    api_mask = filter_ops.api_enablement_mask(api_ok, gvk)
+    feasible = filter_ops.feasible_mask(
+        alive, api_mask, taint_mask, jnp.ones_like(affinity_ok), affinity_ok, eviction_ok
+    )
+    score = filter_ops.locality_score(prev_member)
+
+    # Estimation (GeneralEstimator path; additional estimators min-merge in).
+    # Requests naming resources outside the encoded vocabulary behave like a
+    # missing allocatable key: 0 available everywhere (general.go:166-169).
+    avail = assign_ops.general_estimate(capacity, has_summary, request, replicas)
+    avail = jnp.where(unknown_request[:, None], 0, avail)
+
+    # All strategies computed batched, row-selected by strategy code.
+    dup = assign_ops.duplicated_assign(feasible, replicas)
+    static = assign_ops.static_weight_assign(
+        feasible, static_weight, prev_replicas, tie, replicas
+    )
+    dyn = assign_ops.dynamic_assign(
+        feasible,
+        avail,
+        prev_replicas,
+        tie,
+        replicas,
+        fresh,
+        strategy == AGGREGATED,
+    )
+
+    result = jnp.zeros_like(dup)
+    result = jnp.where((strategy == DUPLICATED)[:, None], dup, result)
+    result = jnp.where((strategy == STATIC_WEIGHT)[:, None], static, result)
+    is_dyn = (strategy == DYNAMIC_WEIGHT) | (strategy == AGGREGATED)
+    result = jnp.where(is_dyn[:, None], dyn.result, result)
+    unschedulable = is_dyn & dyn.unschedulable
+    return feasible, score, result, unschedulable, dyn.available_sum
+
+
+class ArrayScheduler:
+    """Host wrapper: encodes fleet + batches, runs the kernel, decodes
+    TargetClusters. Batch sizes are padded to power-of-two buckets to bound
+    the jit cache (SURVEY §7 dynamic-shapes note)."""
+
+    def __init__(self, clusters: Sequence, encoder: Optional[FleetEncoder] = None):
+        self.encoder = encoder or FleetEncoder()
+        self.set_clusters(clusters)
+
+    def set_clusters(self, clusters: Sequence) -> None:
+        self.clusters = list(clusters)
+        self.fleet: FleetArrays = self.encoder.encode(self.clusters)
+        self.batch_encoder = BatchEncoder(self.encoder, self.fleet, self.clusters)
+
+    @staticmethod
+    def _bucket(n: int) -> int:
+        b = 8
+        while b < n:
+            b *= 2
+        return b
+
+    def _pad(self, batch: BindingBatch) -> BindingBatch:
+        B = batch.size
+        Bp = self._bucket(B)
+        if Bp == B:
+            return batch
+        pad = Bp - B
+
+        def pz(a):
+            width = [(0, pad)] + [(0, 0)] * (a.ndim - 1)
+            return np.pad(a, width)
+
+        return BindingBatch(
+            keys=batch.keys,
+            uids=batch.uids,
+            replicas=pz(batch.replicas),
+            request=pz(batch.request),
+            unknown_request=pz(batch.unknown_request),
+            gvk=pz(batch.gvk),
+            strategy=pz(batch.strategy),
+            fresh=pz(batch.fresh),
+            tol_key=pz(batch.tol_key),
+            tol_value=pz(batch.tol_value),
+            tol_effect=pz(batch.tol_effect),
+            tol_op=pz(batch.tol_op),
+            affinity_ok=pz(batch.affinity_ok),
+            eviction_ok=pz(batch.eviction_ok),
+            static_weight=pz(batch.static_weight),
+            prev_member=pz(batch.prev_member),
+            prev_replicas=pz(batch.prev_replicas),
+            tie=pz(batch.tie),
+        )
+
+    def run_kernel(self, batch: BindingBatch):
+        f = self.fleet
+        return _schedule_kernel(
+            f.alive,
+            f.capacity,
+            f.has_summary,
+            f.taint_key,
+            f.taint_value,
+            f.taint_effect,
+            f.api_ok,
+            batch.replicas,
+            batch.request,
+            batch.unknown_request,
+            batch.gvk,
+            batch.strategy,
+            batch.fresh,
+            batch.tol_key,
+            batch.tol_value,
+            batch.tol_effect,
+            batch.tol_op,
+            batch.affinity_ok,
+            batch.eviction_ok,
+            batch.static_weight,
+            batch.prev_member,
+            batch.prev_replicas,
+            batch.tie,
+        )
+
+    def schedule(self, bindings: Sequence) -> list[ScheduleDecision]:
+        if not bindings:
+            return []
+        raw = self.batch_encoder.encode(bindings)
+        batch = self._pad(raw)
+        feasible, score, result, unsched, avail_sum = jax.tree.map(
+            np.asarray, self.run_kernel(batch)
+        )
+        names = self.fleet.names
+        out: list[ScheduleDecision] = []
+        for b, key in enumerate(raw.keys):
+            feas_idx = np.nonzero(feasible[b])[0]
+            dec = ScheduleDecision(
+                key=key, feasible=[names[i] for i in feas_idx], score=score[b]
+            )
+            if feas_idx.size == 0:
+                # FitError diagnosis (generic_scheduler.go:83-88)
+                dec.error = f"0/{len(names)} clusters are available"
+                out.append(dec)
+                continue
+            if unsched[b]:
+                dec.error = (
+                    f"Clusters available replicas {int(avail_sum[b])} are not "
+                    "enough to schedule."
+                )
+                out.append(dec)
+                continue
+            if raw.strategy[b] == NON_WORKLOAD:
+                dec.targets = [TargetCluster(name=names[i], replicas=0) for i in feas_idx]
+            else:
+                pos = np.nonzero(result[b] > 0)[0]
+                # removeZeroReplicasCluster (common.go:60-66)
+                dec.targets = [
+                    TargetCluster(name=names[i], replicas=int(result[b, i])) for i in pos
+                ]
+            out.append(dec)
+        return out

@@ -1,0 +1,210 @@
+"""Sequential oracle: a direct, obviously-correct transcription of the
+reference scheduler's per-binding semantics, used ONLY in tests to validate
+the batched device path (the "sequential-equivalence mode for parity testing"
+from SURVEY §7). One binding at a time, plain Python ints — mirrors
+pkg/scheduler/core/{generic_scheduler,assignment,division_algorithm}.go and
+pkg/util/helper/binding.go behavior, with the crypto-rand tie-break replaced
+by the same deterministic `tie` values the device uses.
+"""
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+from ..api.cluster import Cluster, cluster_api_enabled, cluster_ready
+from ..api.cluster import EFFECT_NO_EXECUTE, EFFECT_NO_SCHEDULE
+from ..api.policy import Placement
+from ..api.work import ResourceBinding, TargetCluster
+from ..models.batch import (
+    AGGREGATED,
+    DUPLICATED,
+    DYNAMIC_WEIGHT,
+    NON_WORKLOAD,
+    STATIC_WEIGHT,
+    strategy_code,
+    _reschedule_required,
+)
+from .affinity import cluster_matches
+
+MAX_INT32 = 2**31 - 1
+
+
+class Unschedulable(Exception):
+    pass
+
+
+def tolerates_all_taints(placement: Optional[Placement], cluster: Cluster) -> bool:
+    tolerations = placement.cluster_tolerations if placement else []
+    for taint in cluster.spec.taints:
+        if taint.effect not in (EFFECT_NO_SCHEDULE, EFFECT_NO_EXECUTE):
+            continue
+        if not any(t.tolerates(taint) for t in tolerations):
+            return False
+    return True
+
+
+def feasible_clusters(rb: ResourceBinding, clusters: Sequence[Cluster]) -> list[Cluster]:
+    spec = rb.spec
+    placement = spec.placement
+    affinity = None
+    if placement is not None:
+        affinity = placement.cluster_affinity
+        if placement.cluster_affinities:
+            affinity = placement.cluster_affinities[0].affinity
+    evicted = {t.from_cluster for t in spec.graceful_eviction_tasks}
+    out = []
+    for c in clusters:
+        if not cluster_ready(c):
+            continue
+        if not cluster_api_enabled(c, spec.resource.api_version, spec.resource.kind):
+            continue
+        if not cluster_matches(c, affinity):
+            continue
+        if not tolerates_all_taints(placement, c):
+            continue
+        if c.name in evicted:
+            continue
+        out.append(c)
+    return out
+
+
+def general_estimate_one(cluster: Cluster, request: dict[str, float], replicas: int) -> int:
+    from ..models.fleet import to_int_units
+
+    rs = cluster.status.resource_summary
+    if rs is None:
+        return 0
+    positive = {k: v for k, v in request.items() if to_int_units(k, v) > 0}
+    if not positive:
+        return replicas  # MaxInt32 → clamp (core/util.go:94-100)
+    best = MAX_INT32
+    for k, v in positive.items():
+        if k not in rs.allocatable:
+            return 0
+        a = (
+            to_int_units(k, rs.allocatable.get(k, 0.0))
+            - to_int_units(k, rs.allocated.get(k, 0.0))
+            - to_int_units(k, rs.allocating.get(k, 0.0))
+        )
+        if a <= 0:
+            return 0
+        best = min(best, a // to_int_units(k, v))
+    return replicas if best >= MAX_INT32 else best
+
+
+def take_by_weight(
+    entries: list[tuple[str, int, int, int]],  # (name, weight, last, tie)
+    target: int,
+    init: dict[str, int],
+) -> tuple[dict[str, int], int]:
+    """Dispenser.TakeByWeight (binding.go:112-144)."""
+    result = dict(init)
+    total = sum(w for _, w, _, _ in entries)
+    if total == 0:
+        return result, target
+    ordered = sorted(entries, key=lambda e: (-e[1], -e[2], e[3]))
+    remain = target
+    quotas = []
+    for name, w, _, _ in ordered:
+        q = w * target // total
+        quotas.append([name, q])
+        remain -= q
+    for q in quotas:
+        if remain == 0:
+            break
+        q[1] += 1
+        remain -= 1
+    for name, q in quotas:
+        result[name] = result.get(name, 0) + q
+    return result, remain
+
+
+def schedule_one(
+    rb: ResourceBinding,
+    clusters: Sequence[Cluster],
+    tie: dict[str, int],
+) -> list[TargetCluster]:
+    spec = rb.spec
+    candidates = feasible_clusters(rb, clusters)
+    if not candidates:
+        raise Unschedulable(f"0/{len(clusters)} clusters are available")
+    code = strategy_code(spec.placement, spec.replicas)
+
+    if code == NON_WORKLOAD:
+        return [TargetCluster(name=c.name, replicas=0) for c in candidates]
+    if code == DUPLICATED:
+        return [TargetCluster(name=c.name, replicas=spec.replicas) for c in candidates]
+
+    prev = {tc.name: tc.replicas for tc in spec.clusters}
+    if code == STATIC_WEIGHT:
+        weights = []
+        rules = (
+            spec.placement.replica_scheduling.weight_preference.static_weight_list
+            if spec.placement.replica_scheduling.weight_preference
+            else []
+        )
+        for c in candidates:
+            w = 0
+            for r in rules:
+                if cluster_matches(c, r.target_cluster):
+                    w = max(w, r.weight)
+            if w > 0:
+                weights.append((c.name, w, prev.get(c.name, 0), tie[c.name]))
+        if not weights:
+            weights = [(c.name, 1, prev.get(c.name, 0), tie[c.name]) for c in candidates]
+        result, _ = take_by_weight(weights, spec.replicas, {})
+        return [TargetCluster(name=n, replicas=r) for n, r in result.items() if r > 0]
+
+    # dynamic strategies
+    req = spec.replica_requirements.resource_request if spec.replica_requirements else {}
+    avail = {c.name: general_estimate_one(c, req, spec.replicas) for c in candidates}
+    scheduled = [(n, prev[n]) for n in (c.name for c in candidates) if n in prev]
+    assigned = sum(r for _, r in scheduled)
+    fresh = _reschedule_required(spec, rb.status)
+    aggregated = code == AGGREGATED
+
+    if fresh:
+        target = spec.replicas
+        weight_list = [(n, avail[n] + prev.get(n, 0)) for n in avail]
+        init: dict[str, int] = {}
+        last: dict[str, int] = {}
+    elif assigned > spec.replicas:  # scale down
+        target = spec.replicas
+        weight_list = list(scheduled)
+        init, last = {}, {}
+    elif assigned < spec.replicas:  # scale up / first schedule
+        target = spec.replicas - assigned
+        weight_list = [(n, avail[n]) for n in avail]
+        init = dict(scheduled)
+        last = dict(scheduled)
+    else:
+        return [TargetCluster(name=n, replicas=r) for n, r in scheduled if r > 0]
+
+    if sum(w for _, w in weight_list) < target:
+        raise Unschedulable(
+            f"Clusters available replicas {sum(w for _, w in weight_list)} are not enough to schedule."
+        )
+
+    if aggregated:
+        prior = {n for n, r in (init.items() if init else []) if r > 0}
+        order = sorted(
+            weight_list,
+            key=lambda e: (0 if e[0] in prior else 1, -e[1], _index_of(candidates, e[0])),
+        )
+        cum, kept = 0, []
+        for n, w in order:
+            kept.append((n, w))
+            cum += w
+            if cum >= target:
+                break
+        weight_list = kept
+
+    entries = [(n, w, last.get(n, 0), tie[n]) for n, w in weight_list]
+    result, _ = take_by_weight(entries, target, init)
+    return [TargetCluster(name=n, replicas=r) for n, r in result.items() if r > 0]
+
+
+def _index_of(candidates, name):
+    for i, c in enumerate(candidates):
+        if c.name == name:
+            return i
+    return len(candidates)

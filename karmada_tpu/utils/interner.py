@@ -1,0 +1,37 @@
+"""String interning: the bridge between the host object model and device
+arrays. Device code never sees strings — only stable int32 ids. Id 0 is
+reserved for "absent"; ids are assigned in first-seen order so encodings are
+deterministic for a given event sequence.
+"""
+from __future__ import annotations
+
+import threading
+
+
+class Interner:
+    NONE = 0
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._ids: dict[str, int] = {}
+        self._strs: list[str] = [""]
+
+    def id(self, s: str) -> int:
+        if not s:
+            return self.NONE
+        with self._lock:
+            i = self._ids.get(s)
+            if i is None:
+                i = len(self._strs)
+                self._ids[s] = i
+                self._strs.append(s)
+            return i
+
+    def lookup(self, i: int) -> str:
+        return self._strs[i]
+
+    def ids(self, strs) -> list[int]:
+        return [self.id(s) for s in strs]
+
+    def __len__(self) -> int:
+        return len(self._strs)

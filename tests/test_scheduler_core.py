@@ -1,0 +1,239 @@
+"""Array scheduler core: strategy behavior + randomized parity vs the
+sequential oracle (the bit-exactness tests SURVEY §7 demands)."""
+import random
+
+import numpy as np
+import pytest
+
+from karmada_tpu.api.cluster import Taint, EFFECT_NO_EXECUTE, EFFECT_NO_SCHEDULE
+from karmada_tpu.api.meta import CPU, MEMORY, ObjectMeta, new_uid
+from karmada_tpu.api.policy import (
+    ClusterAffinity,
+    ClusterPreferences,
+    DIVISION_PREFERENCE_AGGREGATED,
+    DIVISION_PREFERENCE_WEIGHTED,
+    DYNAMIC_WEIGHT_AVAILABLE_REPLICAS,
+    Placement,
+    REPLICA_SCHEDULING_DIVIDED,
+    ReplicaSchedulingStrategy,
+    Toleration,
+)
+from karmada_tpu.api.work import (
+    BindingSpec,
+    ObjectReference,
+    ReplicaRequirements,
+    ResourceBinding,
+    TargetCluster,
+)
+from karmada_tpu.models.batch import tie_matrix
+from karmada_tpu.sched import oracle
+from karmada_tpu.sched.core import ArrayScheduler
+from karmada_tpu.testing.fixtures import (
+    new_cluster,
+    new_cluster_with_resource,
+    static_weight_placement,
+    synthetic_fleet,
+)
+
+GiB = 1024.0**3
+
+
+def make_binding(name, replicas, placement, *, cpu=0.0, prev=None, ns="default"):
+    rr = ReplicaRequirements(resource_request={CPU: cpu}) if cpu else None
+    return ResourceBinding(
+        metadata=ObjectMeta(namespace=ns, name=name, uid=new_uid("rb")),
+        spec=BindingSpec(
+            resource=ObjectReference(api_version="apps/v1", kind="Deployment", namespace=ns, name=name),
+            replicas=replicas,
+            replica_requirements=rr,
+            placement=placement,
+            clusters=[TargetCluster(name=n, replicas=r) for n, r in (prev or {}).items()],
+        ),
+    )
+
+
+def targets_dict(decision):
+    assert decision.ok, decision.error
+    return {t.name: t.replicas for t in decision.targets}
+
+
+def dyn_placement(aggregated=False, names=None):
+    return Placement(
+        cluster_affinity=ClusterAffinity(cluster_names=list(names or [])),
+        replica_scheduling=ReplicaSchedulingStrategy(
+            replica_scheduling_type=REPLICA_SCHEDULING_DIVIDED,
+            replica_division_preference=(
+                DIVISION_PREFERENCE_AGGREGATED if aggregated else DIVISION_PREFERENCE_WEIGHTED
+            ),
+            weight_preference=None if aggregated else ClusterPreferences(
+                dynamic_weight=DYNAMIC_WEIGHT_AVAILABLE_REPLICAS
+            ),
+        ),
+    )
+
+
+class TestStrategies:
+    def setup_method(self):
+        self.clusters = [
+            new_cluster_with_resource("m1", {CPU: 10.0, MEMORY: 40 * GiB}),
+            new_cluster_with_resource("m2", {CPU: 20.0, MEMORY: 80 * GiB}),
+            new_cluster_with_resource("m3", {CPU: 40.0, MEMORY: 160 * GiB}),
+        ]
+        self.sched = ArrayScheduler(self.clusters)
+
+    def test_duplicated(self):
+        from karmada_tpu.testing.fixtures import duplicated_placement
+
+        rb = make_binding("a", 5, duplicated_placement(["m1", "m3"]))
+        (d,) = self.sched.schedule([rb])
+        assert targets_dict(d) == {"m1": 5, "m3": 5}
+
+    def test_static_weight_reference_examples(self):
+        # assignment.go doc: 9 replicas 1:2 → 3:6 ; 9 replicas 1:3 → 2:7
+        rb1 = make_binding("a", 9, static_weight_placement({"m1": 1, "m2": 2}))
+        rb2 = make_binding("b", 9, static_weight_placement({"m1": 1, "m2": 3}))
+        d1, d2 = self.sched.schedule([rb1, rb2])
+        assert targets_dict(d1) == {"m1": 3, "m2": 6}
+        assert targets_dict(d2) == {"m1": 2, "m2": 7}
+
+    def test_dynamic_weight_proportional(self):
+        # avail = 10/20/40 cpu ⇒ 1cpu request ⇒ weights 10:20:40, 7 replicas
+        rb = make_binding("a", 7, dyn_placement(), cpu=1.0)
+        (d,) = self.sched.schedule([rb])
+        t = targets_dict(d)
+        assert sum(t.values()) == 7
+        assert t["m3"] >= t["m2"] >= t.get("m1", 0)
+
+    def test_aggregated_packs_fewest(self):
+        rb = make_binding("a", 30, dyn_placement(aggregated=True), cpu=1.0)
+        (d,) = self.sched.schedule([rb])
+        # m3 alone covers 30 ⇒ everything packs there
+        assert targets_dict(d) == {"m3": 30}
+
+    def test_unschedulable_when_capacity_short(self):
+        rb = make_binding("a", 1000, dyn_placement(), cpu=1.0)
+        (d,) = self.sched.schedule([rb])
+        assert not d.ok and "not enough" in d.error
+
+    def test_scale_up_steady_keeps_prior(self):
+        rb = make_binding("a", 20, dyn_placement(), cpu=1.0, prev={"m1": 5, "m2": 5})
+        (d,) = self.sched.schedule([rb])
+        t = targets_dict(d)
+        assert t["m1"] >= 5 and t["m2"] >= 5
+        assert sum(t.values()) == 20
+
+    def test_scale_down_proportional(self):
+        rb = make_binding("a", 5, dyn_placement(), cpu=1.0, prev={"m2": 6, "m3": 4})
+        (d,) = self.sched.schedule([rb])
+        t = targets_dict(d)
+        assert sum(t.values()) == 5
+        assert set(t) <= {"m2", "m3"}
+        assert t["m2"] >= t["m3"]
+
+    def test_non_workload_all_candidates_no_counts(self):
+        from karmada_tpu.testing.fixtures import duplicated_placement
+
+        rb = make_binding("a", 0, duplicated_placement([]))
+        (d,) = self.sched.schedule([rb])
+        assert {t.name for t in d.targets} == {"m1", "m2", "m3"}
+        assert all(t.replicas == 0 for t in d.targets)
+
+
+class TestFilters:
+    def test_taints_and_tolerations(self):
+        clusters = [
+            new_cluster("m1", taints=[Taint(key="k", value="v", effect=EFFECT_NO_SCHEDULE)]),
+            new_cluster("m2"),
+            new_cluster("m3", taints=[Taint(key="x", effect=EFFECT_NO_EXECUTE)]),
+        ]
+        sched = ArrayScheduler(clusters)
+        from karmada_tpu.testing.fixtures import duplicated_placement
+
+        p = duplicated_placement([])
+        rb_plain = make_binding("plain", 1, p)
+        p_tol = duplicated_placement([])
+        p_tol.cluster_tolerations = [Toleration(key="k", operator="Equal", value="v")]
+        rb_tol = make_binding("tol", 1, p_tol)
+        d_plain, d_tol = sched.schedule([rb_plain, rb_tol])
+        assert targets_dict(d_plain) == {"m2": 1}
+        assert targets_dict(d_tol) == {"m1": 1, "m2": 1}
+
+    def test_not_ready_and_api_enablement(self):
+        c_down = new_cluster("down", ready=False)
+        c_noapi = new_cluster("noapi", api_enablements=[])
+        c_ok = new_cluster("ok")
+        sched = ArrayScheduler([c_down, c_noapi, c_ok])
+        from karmada_tpu.testing.fixtures import duplicated_placement
+
+        rb = make_binding("a", 2, duplicated_placement([]))
+        (d,) = sched.schedule([rb])
+        assert targets_dict(d) == {"ok": 2}
+
+    def test_eviction_filter(self):
+        from karmada_tpu.api.work import GracefulEvictionTask
+        from karmada_tpu.testing.fixtures import duplicated_placement
+
+        sched = ArrayScheduler([new_cluster("m1"), new_cluster("m2")])
+        rb = make_binding("a", 1, duplicated_placement([]))
+        rb.spec.graceful_eviction_tasks = [GracefulEvictionTask(from_cluster="m1")]
+        (d,) = sched.schedule([rb])
+        assert targets_dict(d) == {"m2": 1}
+
+
+class TestOracleParity:
+    """Randomized equivalence: batched device path == sequential oracle."""
+
+    @pytest.mark.parametrize("seed", [0, 1, 2, 3])
+    def test_parity(self, seed):
+        rng = random.Random(seed)
+        clusters = synthetic_fleet(rng.randrange(20, 60), seed=seed, ready_fraction=0.9)
+        for c in clusters:  # sprinkle taints, incl. wide taint lists (>4)
+            if rng.random() < 0.2:
+                c.spec.taints.append(Taint(key="dedicated", value="infra", effect=EFFECT_NO_SCHEDULE))
+            if rng.random() < 0.05:
+                c.spec.taints.extend(
+                    Taint(key=f"t{i}", value="x", effect=EFFECT_NO_SCHEDULE) for i in range(5)
+                )
+        sched = ArrayScheduler(clusters)
+        names = [c.name for c in clusters]
+
+        bindings = []
+        for i in range(40):
+            kind = rng.choice(["dup", "static", "dyn", "agg"])
+            replicas = rng.randrange(0, 50)
+            prev = {}
+            if rng.random() < 0.4:
+                for n in rng.sample(names, rng.randrange(1, 4)):
+                    prev[n] = rng.randrange(1, 10)
+            subset = rng.sample(names, rng.randrange(2, min(12, len(names))))
+            if kind == "dup":
+                from karmada_tpu.testing.fixtures import duplicated_placement
+
+                p = duplicated_placement(subset if rng.random() < 0.5 else [])
+            elif kind == "static":
+                p = static_weight_placement({n: rng.randrange(1, 5) for n in subset})
+            else:
+                p = dyn_placement(aggregated=(kind == "agg"), names=subset)
+            if rng.random() < 0.3:
+                p.cluster_tolerations = [Toleration(key="dedicated", operator="Exists")]
+            rb = make_binding(f"rb-{i}", replicas, p, cpu=rng.choice([0.5, 1.0, 2.0]))
+            if rng.random() < 0.1:  # GVK no cluster advertises
+                rb.spec.resource.api_version = "example.io/v1"
+                rb.spec.resource.kind = "Widget"
+            if rng.random() < 0.1 and rb.spec.replica_requirements:  # exotic resource
+                rb.spec.replica_requirements.resource_request["nvidia.com/gpu"] = 1.0
+            bindings.append(rb)
+
+        decisions = sched.schedule(bindings)
+        tie = tie_matrix([b.metadata.uid for b in bindings], len(names))
+        for b, (rb, dec) in enumerate(zip(bindings, decisions)):
+            tie_map = {names[i]: int(tie[b, i]) for i in range(len(names))}
+            try:
+                expected = oracle.schedule_one(rb, clusters, tie_map)
+            except oracle.Unschedulable as e:
+                assert not dec.ok, f"{rb.name}: device scheduled but oracle said {e}"
+                continue
+            assert dec.ok, f"{rb.name}: device error {dec.error}, oracle ok"
+            got = {t.name: t.replicas for t in dec.targets}
+            want = {t.name: t.replicas for t in expected}
+            assert got == want, f"{rb.name}: device {got} != oracle {want}"
