@@ -1,0 +1,196 @@
+"""Status plane: member object status → Work → ResourceBinding → template.
+
+Parity with pkg/controllers/status/work_status_controller.go:84-389
+(per-cluster informers on every GVR mentioned by Works, ReflectStatus via the
+interpreter into work.status.manifestStatuses, health interpretation, recreate
+when a member object vanishes) and the rb_status/crb_status controllers +
+helper/workstatus.go (aggregate manifestStatuses → rb.status.aggregatedStatus
+→ interpreter.AggregateStatus back onto the template, FullyApplied condition).
+"""
+from __future__ import annotations
+
+from ..api.meta import Condition, get_condition, set_condition
+from ..api.unstructured import Unstructured
+from ..api.work import (
+    AggregatedStatusItem,
+    CONDITION_FULLY_APPLIED,
+    ManifestStatus,
+    ObjectReference,
+    ResourceBinding,
+    WORK_CONDITION_APPLIED,
+    Work,
+    cluster_of_work_namespace,
+)
+from ..controllers.binding import WORK_BINDING_NAME_LABEL, WORK_BINDING_NAMESPACE_LABEL
+from ..interpreter.interpreter import ResourceInterpreter
+from ..runtime.controller import Controller, DONE, Runtime
+from ..store.store import Store
+from ..utils.names import execution_namespace, work_name
+
+
+class WorkStatusController:
+    """Reflect member-side object status into work.status.manifestStatuses;
+    re-enqueue the execution controller when a member object disappears
+    (work_status_controller.go:389 recreate path)."""
+
+    def __init__(
+        self,
+        store: Store,
+        members: dict,
+        interpreter: ResourceInterpreter,
+        runtime: Runtime,
+        execution_controller=None,
+    ) -> None:
+        self.store = store
+        self.members = members
+        self.interpreter = interpreter
+        self.execution_controller = execution_controller
+        self.controller = runtime.register(
+            Controller(name="work-status", reconcile=self._reconcile)
+        )
+        store.watch("Work", lambda ev, w: self.controller.enqueue(w.metadata.key()))
+
+    def watch_member(self, member) -> None:
+        """Subscribe to one member's object events (fedinformer equivalent)."""
+
+        def handler(kind: str, event: str, obj) -> None:
+            if not isinstance(obj, Unstructured):
+                return
+            wname = work_name(obj.api_version, obj.kind, obj.namespace, obj.name)
+            wns = execution_namespace(member.name)
+            if self.store.try_get("Work", wname, wns) is not None:
+                self.controller.enqueue(f"{wns}/{wname}")
+                if event == "DELETED" and self.execution_controller is not None:
+                    # member object deleted out from under us → reapply
+                    self.execution_controller.enqueue(f"{wns}/{wname}")
+
+        member.store.watch_all(handler, replay=False)
+
+    def _reconcile(self, key: str) -> str:
+        ns, _, name = key.partition("/")
+        work: Work = self.store.try_get("Work", name, ns)
+        if work is None or work.metadata.deletion_timestamp is not None:
+            return DONE
+        member = self.members.get(cluster_of_work_namespace(ns))
+        if member is None:
+            return DONE
+        statuses = []
+        for manifest in work.spec.workload_manifests:
+            md = manifest.get("metadata", {})
+            obj = member.get(
+                manifest.get("apiVersion", ""),
+                manifest.get("kind", ""),
+                md.get("name", ""),
+                md.get("namespace", ""),
+            )
+            if obj is None:
+                continue
+            statuses.append(
+                ManifestStatus(
+                    identifier=ObjectReference(
+                        api_version=manifest.get("apiVersion", ""),
+                        kind=manifest.get("kind", ""),
+                        namespace=md.get("namespace", ""),
+                        name=md.get("name", ""),
+                    ),
+                    status=self.interpreter.reflect_status(obj),
+                    health=self.interpreter.interpret_health(obj),
+                )
+            )
+        if statuses != work.status.manifest_statuses:
+            work.status.manifest_statuses = statuses
+            self.store.update(work)
+        return DONE
+
+
+class BindingStatusController:
+    """Aggregate per-cluster Work statuses onto the ResourceBinding and the
+    template object (rb_status_controller.go + AggregateStatus)."""
+
+    def __init__(
+        self,
+        store: Store,
+        interpreter: ResourceInterpreter,
+        runtime: Runtime,
+    ) -> None:
+        self.store = store
+        self.interpreter = interpreter
+        self.controller = runtime.register(
+            Controller(name="binding-status", reconcile=self._reconcile)
+        )
+        store.watch("Work", self._on_work)
+        store.watch("ResourceBinding", lambda ev, rb: self.controller.enqueue(rb.metadata.key()))
+
+    def _on_work(self, event: str, work: Work) -> None:
+        rb_ns = work.metadata.labels.get(WORK_BINDING_NAMESPACE_LABEL)
+        rb_name = work.metadata.labels.get(WORK_BINDING_NAME_LABEL)
+        if rb_name:
+            self.controller.enqueue(f"{rb_ns}/{rb_name}")
+
+    def _reconcile(self, key: str) -> str:
+        ns, _, name = key.partition("/")
+        rb: ResourceBinding = self.store.try_get("ResourceBinding", name, ns)
+        if rb is None or rb.metadata.deletion_timestamp is not None:
+            return DONE
+
+        works_by_cluster: dict[str, Work] = {}
+        for work in self.store.list("Work"):
+            if (
+                work.metadata.labels.get(WORK_BINDING_NAMESPACE_LABEL) == ns
+                and work.metadata.labels.get(WORK_BINDING_NAME_LABEL) == name
+            ):
+                works_by_cluster[cluster_of_work_namespace(work.namespace)] = work
+
+        items: list[AggregatedStatusItem] = []
+        fully_applied = bool(rb.spec.clusters)
+        for tc in rb.spec.clusters:
+            work = works_by_cluster.get(tc.name)
+            if work is None:
+                fully_applied = False
+                items.append(AggregatedStatusItem(cluster_name=tc.name))
+                continue
+            applied_cond = get_condition(work.status.conditions, WORK_CONDITION_APPLIED)
+            applied = applied_cond is not None and applied_cond.status == "True"
+            if not applied:
+                fully_applied = False
+            status = None
+            health = "Unknown"
+            if work.status.manifest_statuses:
+                status = work.status.manifest_statuses[0].status
+                health = work.status.manifest_statuses[0].health
+            items.append(
+                AggregatedStatusItem(
+                    cluster_name=tc.name,
+                    status=status,
+                    applied=applied,
+                    applied_message="" if applied else (applied_cond.message if applied_cond else ""),
+                    health=health,
+                )
+            )
+
+        changed = items != rb.status.aggregated_status
+        if changed:
+            rb.status.aggregated_status = items
+        cond_changed = set_condition(
+            rb.status.conditions,
+            Condition(
+                type=CONDITION_FULLY_APPLIED,
+                status="True" if fully_applied else "False",
+                reason="FullyAppliedSuccess" if fully_applied else "FullyAppliedFailed",
+            ),
+        )
+        if changed or cond_changed:
+            self.store.update(rb)
+
+        # write aggregated status back onto the template (AggregateStatus op)
+        template = self.store.try_get(
+            f"{rb.spec.resource.api_version}/{rb.spec.resource.kind}",
+            rb.spec.resource.name,
+            rb.spec.resource.namespace,
+        )
+        if template is not None and items:
+            old_status = template.get("status")
+            updated = self.interpreter.aggregate_status(template, items)
+            if updated.get("status") != old_status:
+                self.store.update(updated)
+        return DONE

@@ -1,0 +1,116 @@
+"""Control plane wiring: store + runtime + all controllers in one process.
+
+Equivalent of the reference's component set as started by
+cmd/controller-manager/app/controllermanager.go:217-247 + cmd/scheduler — the
+detector, scheduler, binding/execution/status controllers — against an
+in-memory store and an in-memory member fleet. `settle()` drains every
+reconcile loop to its fixpoint (deterministic tests; a threaded driver can
+call the same loops continuously).
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+from .api.cluster import (
+    APIEnablement,
+    Cluster,
+    ClusterSpec,
+    ClusterStatus,
+    NodeSummary,
+    ResourceSummary,
+    CLUSTER_CONDITION_READY,
+)
+from .api.meta import Condition, ObjectMeta, set_condition
+from .controllers.binding import BindingController
+from .controllers.execution import ExecutionController
+from .controllers.status import BindingStatusController, WorkStatusController
+from .detector.detector import ResourceDetector
+from .interpreter.interpreter import ResourceInterpreter
+from .members.member import InMemoryMember, MemberConfig
+from .runtime.controller import Clock, Runtime
+from .sched.scheduler import SchedulerDaemon
+from .store.store import Store
+
+DEFAULT_API_ENABLEMENTS = [
+    APIEnablement(group_version="apps/v1", resources=["Deployment", "StatefulSet"]),
+    APIEnablement(group_version="v1", resources=["ConfigMap", "Secret", "Service"]),
+    APIEnablement(group_version="batch/v1", resources=["Job"]),
+]
+
+
+class ControlPlane:
+    def __init__(self, clock: Optional[Clock] = None):
+        self.store = Store()
+        self.runtime = Runtime(clock=clock)
+        self.interpreter = ResourceInterpreter()
+        self.members: dict[str, InMemoryMember] = {}
+
+        self.detector = ResourceDetector(self.store, self.interpreter, self.runtime)
+        self.scheduler = SchedulerDaemon(self.store, self.runtime)
+        self.binding_controller = BindingController(self.store, self.interpreter, self.runtime)
+        self.execution_controller = ExecutionController(
+            self.store, self.members, self.interpreter, self.runtime
+        )
+        self.work_status_controller = WorkStatusController(
+            self.store,
+            self.members,
+            self.interpreter,
+            self.runtime,
+            execution_controller=self.execution_controller.controller,
+        )
+        self.binding_status_controller = BindingStatusController(
+            self.store, self.interpreter, self.runtime
+        )
+
+    # -- cluster lifecycle (karmadactl join equivalent) -------------------
+
+    def join_member(self, config: MemberConfig) -> InMemoryMember:
+        """Register a member cluster: create the Cluster object with status
+        collected from the member (the cluster status controller's
+        syncClusterStatus in one step: health, API enablements, resource
+        summary — cluster_status_controller.go:181,544-679)."""
+        member = InMemoryMember(config)
+        self.members[config.name] = member
+        cluster = Cluster(
+            metadata=ObjectMeta(name=config.name, labels=dict(config.labels)),
+            spec=ClusterSpec(
+                sync_mode=config.sync_mode,
+                provider=config.provider,
+                region=config.region,
+                zone=config.zone,
+            ),
+            status=ClusterStatus(
+                kubernetes_version="v1.30.0",
+                api_enablements=list(DEFAULT_API_ENABLEMENTS),
+                node_summary=NodeSummary(total_num=10, ready_num=10),
+                resource_summary=ResourceSummary(
+                    allocatable=dict(config.allocatable),
+                    allocated=dict(config.allocated),
+                ),
+            ),
+        )
+        set_condition(
+            cluster.status.conditions,
+            Condition(type=CLUSTER_CONDITION_READY, status="True", reason="ClusterReady"),
+        )
+        self.store.create(cluster)
+        self.work_status_controller.watch_member(member)
+        return member
+
+    def set_member_ready(self, name: str, ready: bool, reason: str = "") -> None:
+        """Flip the Ready condition (health-probe outcome)."""
+        cluster = self.store.get("Cluster", name)
+        set_condition(
+            cluster.status.conditions,
+            Condition(
+                type=CLUSTER_CONDITION_READY,
+                status="True" if ready else "False",
+                reason=reason or ("ClusterReady" if ready else "ClusterNotReady"),
+            ),
+        )
+        self.store.update(cluster)
+        if name in self.members:
+            self.members[name].set_healthy(ready)
+
+    def settle(self, max_steps: int = 100_000) -> int:
+        return self.runtime.settle(max_steps)

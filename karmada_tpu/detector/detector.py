@@ -1,0 +1,243 @@
+"""Resource detector: templates × policies → ResourceBindings.
+
+Parity with pkg/detector (detector.go:112 Start, :233 Reconcile, :362/:394
+LookForMatchedPolicy, :422/:514 ApplyPolicy, :940/:1011 policy reconcile,
+:1051/:1087 deletion): watches every non-Karmada kind in the store, matches
+templates against PropagationPolicy / ClusterPropagationPolicy resource
+selectors with the reference's precedence (explicit priority, then name-match
+over label-selector specificity, then alphabetical), claims the template with
+the policy's permanent id, and creates/updates the ResourceBinding with
+replicas + requirements extracted through the resource interpreter
+(BuildResourceBinding detector.go:730-805).
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+from ..api.policy import (
+    ClusterPropagationPolicy,
+    PropagationPolicy,
+    ResourceSelector,
+)
+from ..api.unstructured import Unstructured
+from ..api.work import (
+    BindingSpec,
+    ObjectReference,
+    ResourceBinding,
+    RESOURCE_BINDING_PERMANENT_ID_LABEL,
+)
+from ..interpreter.interpreter import ResourceInterpreter
+from ..runtime.controller import Controller, DONE, Runtime
+from ..store.store import DELETED, Store
+from ..utils.names import binding_name
+
+POLICY_ID_LABEL = "propagationpolicy.karmada.io/permanent-id"
+CLUSTER_POLICY_ID_LABEL = "clusterpropagationpolicy.karmada.io/permanent-id"
+POLICY_NAME_ANNOTATION = "policy.karmada.io/name"
+POLICY_NAMESPACE_ANNOTATION = "policy.karmada.io/namespace"
+
+# Kinds that are part of the control plane itself, never propagated
+# (detector.go isSelectorMatches / api exclusions).
+CONTROL_PLANE_KINDS = {
+    "Cluster",
+    "PropagationPolicy",
+    "ClusterPropagationPolicy",
+    "OverridePolicy",
+    "ClusterOverridePolicy",
+    "ResourceBinding",
+    "ClusterResourceBinding",
+    "Work",
+    "WorkloadRebalancer",
+    "FederatedResourceQuota",
+}
+
+
+def selector_matches(sel: ResourceSelector, obj: Unstructured, policy_namespace: str) -> int:
+    """Returns implicit priority: 0 = no match, 1 = kind/label match,
+    2 = exact-name match (pkg/detector implicit priority ordering)."""
+    if sel.api_version != obj.api_version or sel.kind != obj.kind:
+        return 0
+    ns = sel.namespace or policy_namespace
+    if ns and obj.namespace and ns != obj.namespace:
+        return 0
+    if sel.name:
+        return 2 if sel.name == obj.name else 0
+    if sel.label_selector is not None:
+        return 1 if sel.label_selector.matches(obj.metadata.labels) else 0
+    return 1
+
+
+class ResourceDetector:
+    def __init__(
+        self,
+        store: Store,
+        interpreter: ResourceInterpreter,
+        runtime: Runtime,
+    ) -> None:
+        self.store = store
+        self.interpreter = interpreter
+        self.controller = runtime.register(
+            Controller(name="detector", reconcile=self._reconcile)
+        )
+        store.watch_all(self._on_any_event, replay=True)
+        store.watch("PropagationPolicy", self._on_policy_event, replay=False)
+        store.watch("ClusterPropagationPolicy", self._on_policy_event, replay=False)
+
+    # -- event plumbing ---------------------------------------------------
+
+    @staticmethod
+    def _key(obj: Unstructured) -> str:
+        return f"{obj.api_version}|{obj.kind}|{obj.namespace}|{obj.name}"
+
+    def _on_any_event(self, kind: str, event: str, obj) -> None:
+        if not isinstance(obj, Unstructured) or obj.kind in CONTROL_PLANE_KINDS:
+            return
+        self.controller.enqueue(self._key(obj))
+
+    def _on_policy_event(self, event: str, policy) -> None:
+        """Policy add/update/delete → re-sweep every template (the reference
+        re-enqueues via its waiting list; a full sweep is the same fixpoint)."""
+        for kind in self.store.kinds():
+            for obj in self.store.list(kind):
+                if isinstance(obj, Unstructured) and obj.kind not in CONTROL_PLANE_KINDS:
+                    self.controller.enqueue(self._key(obj))
+        if event == DELETED:
+            self._cleanup_policy_bindings(policy)
+
+    # -- reconcile --------------------------------------------------------
+
+    def _reconcile(self, key: str) -> str:
+        api_version, kind, namespace, name = key.split("|")
+        obj = self.store.try_get(f"{api_version}/{kind}", name, namespace)
+        if obj is None or obj.metadata.deletion_timestamp is not None:
+            self._delete_binding_for(kind, namespace, name)
+            return DONE
+        policy = self._look_for_matched_policy(obj)
+        if policy is None:
+            self._delete_binding_for(kind, namespace, name)
+            return DONE
+        self._apply_policy(obj, policy)
+        return DONE
+
+    def _look_for_matched_policy(self, obj: Unstructured):
+        """Namespaced PropagationPolicies win over ClusterPropagationPolicies
+        (detector.go:362 then :394); within a tier: explicit priority desc,
+        implicit selector priority desc, name asc."""
+        best = None
+        for policy in self.store.list("PropagationPolicy"):
+            if obj.namespace and policy.metadata.namespace != obj.namespace:
+                continue
+            m = max(
+                (selector_matches(s, obj, policy.metadata.namespace) for s in policy.spec.resource_selectors),
+                default=0,
+            )
+            if m == 0:
+                continue
+            rank = (policy.spec.priority, m, _neg_name(policy.name))
+            if best is None or rank > best[0]:
+                best = (rank, policy)
+        if best is not None:
+            return best[1]
+        for policy in self.store.list("ClusterPropagationPolicy"):
+            m = max(
+                (selector_matches(s, obj, "") for s in policy.spec.resource_selectors),
+                default=0,
+            )
+            if m == 0:
+                continue
+            rank = (policy.spec.priority, m, _neg_name(policy.name))
+            if best is None or rank > best[0]:
+                best = (rank, policy)
+        return best[1] if best else None
+
+    def _apply_policy(self, obj: Unstructured, policy) -> None:
+        """Claim + BuildResourceBinding (detector.go:422,730-805)."""
+        is_cluster_policy = isinstance(policy, ClusterPropagationPolicy)
+        id_label = CLUSTER_POLICY_ID_LABEL if is_cluster_policy else POLICY_ID_LABEL
+
+        # claim the template
+        fresh = self.store.get(f"{obj.api_version}/{obj.kind}", obj.name, obj.namespace)
+        if fresh.metadata.labels.get(id_label) != policy.metadata.uid:
+            fresh.metadata.labels[id_label] = policy.metadata.uid
+            fresh.metadata.annotations[POLICY_NAME_ANNOTATION] = policy.name
+            self.store.update(fresh)
+            obj = fresh
+
+        replicas, requirements = self.interpreter.get_replicas(obj)
+        rb_name = binding_name(obj.kind, obj.name)
+        existing = self.store.try_get("ResourceBinding", rb_name, obj.namespace)
+        rb = existing or ResourceBinding()
+        rb.metadata.name = rb_name
+        rb.metadata.namespace = obj.namespace
+        rb.metadata.labels[id_label] = policy.metadata.uid
+        if RESOURCE_BINDING_PERMANENT_ID_LABEL not in rb.metadata.labels:
+            rb.metadata.labels[RESOURCE_BINDING_PERMANENT_ID_LABEL] = (
+                rb.metadata.uid or f"{obj.namespace}.{rb_name}"
+            )
+        new_spec = BindingSpec(
+            resource=ObjectReference(
+                api_version=obj.api_version,
+                kind=obj.kind,
+                namespace=obj.namespace,
+                name=obj.name,
+                uid=obj.metadata.uid,
+                # Template spec changes bump this, so the RB spec changes and
+                # the binding controller regenerates Works (the reference
+                # records Resource.ResourceVersion in BuildResourceBinding;
+                # generation is the spec-only equivalent — status writes from
+                # the aggregation loop must not churn RBs).
+                resource_version=obj.metadata.generation,
+            ),
+            replicas=replicas,
+            replica_requirements=requirements,
+            placement=policy.spec.placement,
+            scheduler_name=policy.spec.scheduler_name,
+            propagate_deps=policy.spec.propagate_deps,
+            conflict_resolution=policy.spec.conflict_resolution,
+            failover=policy.spec.failover,
+            clusters=existing.spec.clusters if existing else [],
+            graceful_eviction_tasks=existing.spec.graceful_eviction_tasks if existing else [],
+            reschedule_triggered_at=existing.spec.reschedule_triggered_at if existing else None,
+        )
+        if policy.spec.suspension is not None:
+            from ..api.work import BindingSuspension
+
+            new_spec.suspension = BindingSuspension(
+                dispatching=policy.spec.suspension.dispatching,
+                scheduling=policy.spec.suspension.scheduling,
+            )
+        if existing is None:
+            rb.spec = new_spec
+            created = self.store.create(rb)
+            if created.metadata.labels[RESOURCE_BINDING_PERMANENT_ID_LABEL].startswith(
+                f"{obj.namespace}."
+            ):
+                created.metadata.labels[RESOURCE_BINDING_PERMANENT_ID_LABEL] = created.metadata.uid
+                self.store.update(created)
+        elif existing.spec != new_spec:  # full dataclass comparison
+            rb.spec = new_spec
+            self.store.update(rb)
+
+    # -- deletion ---------------------------------------------------------
+
+    def _delete_binding_for(self, kind: str, namespace: str, name: str) -> None:
+        rb_name = binding_name(kind, name)
+        if self.store.try_get("ResourceBinding", rb_name, namespace) is not None:
+            self.store.delete("ResourceBinding", rb_name, namespace)
+
+    def _cleanup_policy_bindings(self, policy) -> None:
+        id_label = (
+            CLUSTER_POLICY_ID_LABEL
+            if isinstance(policy, ClusterPropagationPolicy)
+            else POLICY_ID_LABEL
+        )
+        for rb in self.store.list("ResourceBinding"):
+            if rb.metadata.labels.get(id_label) == policy.metadata.uid:
+                # another policy may re-claim on the sweep; delete and let the
+                # sweep recreate if so (level-triggered fixpoint)
+                self.store.delete("ResourceBinding", rb.name, rb.namespace)
+
+
+def _neg_name(name: str) -> tuple:
+    """Ascending-name preference inside a descending-rank comparison."""
+    return tuple(-ord(ch) for ch in name)

@@ -1,0 +1,262 @@
+"""Resource interpreter: per-kind understanding of workload objects.
+
+Parity with pkg/resourceinterpreter/interpreter.go:39-68 — operations:
+GetReplicas, ReviseReplica, Retain, AggregateStatus, GetDependencies,
+ReflectStatus, InterpretHealth — with default native interpreters for common
+kinds (default/native/*.go) and a registry for customized interpreters (the
+Lua/webhook tiers of the reference map to plain-Python customizations here;
+declarative configs can be layered on this registry).
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Callable, Optional
+
+from ..api.meta import Resources
+from ..api.unstructured import Unstructured
+from ..api.work import AggregatedStatusItem, NodeClaim, ReplicaRequirements
+
+HEALTHY = "Healthy"
+UNHEALTHY = "Unhealthy"
+UNKNOWN = "Unknown"
+
+
+@dataclass
+class KindInterpreter:
+    """Hooks for one GVK; any hook may be None → fall back to defaults."""
+
+    get_replicas: Optional[Callable[[Unstructured], tuple[int, Optional[ReplicaRequirements]]]] = None
+    revise_replica: Optional[Callable[[Unstructured, int], Unstructured]] = None
+    retain: Optional[Callable[[Unstructured, Unstructured], Unstructured]] = None
+    aggregate_status: Optional[Callable[[Unstructured, list[AggregatedStatusItem]], Unstructured]] = None
+    get_dependencies: Optional[Callable[[Unstructured], list[dict]]] = None
+    reflect_status: Optional[Callable[[Unstructured], Optional[dict]]] = None
+    interpret_health: Optional[Callable[[Unstructured], str]] = None
+
+
+def _pod_template_requirements(pod_spec: dict, namespace: str) -> ReplicaRequirements:
+    request: Resources = {}
+    for container in pod_spec.get("containers", []):
+        for k, v in container.get("resources", {}).get("requests", {}).items():
+            request[k] = request.get(k, 0.0) + _parse_quantity(v)
+    node_claim = None
+    if pod_spec.get("nodeSelector") or pod_spec.get("tolerations"):
+        node_claim = NodeClaim(
+            node_selector=dict(pod_spec.get("nodeSelector", {})),
+            tolerations=list(pod_spec.get("tolerations", [])),
+        )
+    return ReplicaRequirements(
+        node_claim=node_claim,
+        resource_request=request,
+        namespace=namespace,
+        priority_class_name=pod_spec.get("priorityClassName", ""),
+    )
+
+
+def _parse_quantity(v: Any) -> float:
+    """Kubernetes quantity strings → canonical floats (cpu cores / bytes)."""
+    if isinstance(v, (int, float)):
+        return float(v)
+    s = str(v).strip()
+    try:
+        return float(s)
+    except ValueError:
+        pass
+    if s.endswith("m"):
+        return float(s[:-1]) / 1000.0
+    suffixes = {
+        "Ki": 1024.0,
+        "Mi": 1024.0**2,
+        "Gi": 1024.0**3,
+        "Ti": 1024.0**4,
+        "Pi": 1024.0**5,
+        "k": 1e3,
+        "M": 1e6,
+        "G": 1e9,
+        "T": 1e12,
+    }
+    for suf, mult in suffixes.items():
+        if s.endswith(suf):
+            return float(s[: -len(suf)]) * mult
+    raise ValueError(f"unparseable quantity {v!r}")
+
+
+# ---------------------------------------------------------------------------
+# Default native interpreters (default/native/default.go equivalents)
+# ---------------------------------------------------------------------------
+
+
+def _deployment_get_replicas(obj: Unstructured):
+    replicas = int(obj.get("spec", "replicas", default=1) or 0)
+    pod_spec = obj.get("spec", "template", "spec", default={}) or {}
+    return replicas, _pod_template_requirements(pod_spec, obj.namespace)
+
+
+def _deployment_health(obj: Unstructured) -> str:
+    spec_replicas = int(obj.get("spec", "replicas", default=1) or 0)
+    ready = int(obj.get("status", "readyReplicas", default=0) or 0)
+    observed = int(obj.get("status", "observedGeneration", default=0) or 0)
+    if observed >= obj.metadata.generation and ready == spec_replicas:
+        return HEALTHY
+    return UNHEALTHY
+
+
+def _deployment_aggregate(template: Unstructured, items: list[AggregatedStatusItem]):
+    ready = available = updated = total = 0
+    for it in items:
+        st = it.status or {}
+        ready += int(st.get("readyReplicas", 0) or 0)
+        available += int(st.get("availableReplicas", 0) or 0)
+        updated += int(st.get("updatedReplicas", 0) or 0)
+        total += int(st.get("replicas", 0) or 0)
+    template.status = {
+        "replicas": total,
+        "readyReplicas": ready,
+        "availableReplicas": available,
+        "updatedReplicas": updated,
+    }
+    return template
+
+
+def _workload_dependencies(obj: Unstructured) -> list[dict]:
+    """ConfigMaps/Secrets referenced by the pod template (GetDependencies,
+    default/native/dependencies.go behavior)."""
+    pod_spec = obj.get("spec", "template", "spec", default={}) or {}
+    ns = obj.namespace
+    deps: list[dict] = []
+
+    def add(kind: str, name: str) -> None:
+        if name:
+            deps.append({"apiVersion": "v1", "kind": kind, "namespace": ns, "name": name})
+
+    for vol in pod_spec.get("volumes", []):
+        if "configMap" in vol:
+            add("ConfigMap", vol["configMap"].get("name", ""))
+        if "secret" in vol:
+            add("Secret", vol["secret"].get("secretName", ""))
+        if "persistentVolumeClaim" in vol:
+            add("PersistentVolumeClaim", vol["persistentVolumeClaim"].get("claimName", ""))
+    for container in pod_spec.get("containers", []):
+        for env in container.get("env", []):
+            src = env.get("valueFrom", {})
+            if "configMapKeyRef" in src:
+                add("ConfigMap", src["configMapKeyRef"].get("name", ""))
+            if "secretKeyRef" in src:
+                add("Secret", src["secretKeyRef"].get("name", ""))
+        for envfrom in container.get("envFrom", []):
+            if "configMapRef" in envfrom:
+                add("ConfigMap", envfrom["configMapRef"].get("name", ""))
+            if "secretRef" in envfrom:
+                add("Secret", envfrom["secretRef"].get("name", ""))
+    # dedupe preserving order
+    seen, out = set(), []
+    for d in deps:
+        k = (d["kind"], d["namespace"], d["name"])
+        if k not in seen:
+            seen.add(k)
+            out.append(d)
+    return out
+
+
+def _job_get_replicas(obj: Unstructured):
+    parallelism = int(obj.get("spec", "parallelism", default=1) or 0)
+    pod_spec = obj.get("spec", "template", "spec", default={}) or {}
+    return parallelism, _pod_template_requirements(pod_spec, obj.namespace)
+
+
+def _job_health(obj: Unstructured) -> str:
+    for cond in obj.get("status", "conditions", default=[]) or []:
+        if cond.get("type") == "Failed" and cond.get("status") == "True":
+            return UNHEALTHY
+    return HEALTHY
+
+
+class ResourceInterpreter:
+    """Facade (interpreter.go:39-68). Custom interpreters override defaults
+    per GVK; generic fallbacks keep unknown kinds propagatable."""
+
+    def __init__(self) -> None:
+        self._custom: dict[str, KindInterpreter] = {}
+        self._native: dict[str, KindInterpreter] = {
+            "apps/v1/Deployment": KindInterpreter(
+                get_replicas=_deployment_get_replicas,
+                aggregate_status=_deployment_aggregate,
+                interpret_health=_deployment_health,
+                get_dependencies=_workload_dependencies,
+            ),
+            "apps/v1/StatefulSet": KindInterpreter(
+                get_replicas=_deployment_get_replicas,
+                interpret_health=_deployment_health,
+                get_dependencies=_workload_dependencies,
+            ),
+            "batch/v1/Job": KindInterpreter(
+                get_replicas=_job_get_replicas,
+                interpret_health=_job_health,
+                get_dependencies=_workload_dependencies,
+            ),
+        }
+
+    @staticmethod
+    def _gvk(obj: Unstructured) -> str:
+        return f"{obj.api_version}/{obj.kind}"
+
+    def register(self, gvk: str, interpreter: KindInterpreter) -> None:
+        """Customized interpreter tier (ResourceInterpreterCustomization)."""
+        self._custom[gvk] = interpreter
+
+    def _hook(self, obj: Unstructured, name: str):
+        gvk = self._gvk(obj)
+        for tier in (self._custom, self._native):
+            ki = tier.get(gvk)
+            if ki is not None and getattr(ki, name) is not None:
+                return getattr(ki, name)
+        return None
+
+    # -- operations -------------------------------------------------------
+
+    def get_replicas(self, obj: Unstructured) -> tuple[int, Optional[ReplicaRequirements]]:
+        hook = self._hook(obj, "get_replicas")
+        if hook:
+            return hook(obj)
+        return 0, None  # non-workload
+
+    def revise_replica(self, obj: Unstructured, replicas: int) -> Unstructured:
+        hook = self._hook(obj, "revise_replica")
+        if hook:
+            return hook(obj, replicas)
+        if obj.get("spec", "replicas") is not None:
+            obj.set("spec", "replicas", replicas)
+        return obj
+
+    def retain(self, desired: Unstructured, observed: Unstructured) -> Unstructured:
+        hook = self._hook(desired, "retain")
+        if hook:
+            return hook(desired, observed)
+        return desired
+
+    def aggregate_status(
+        self, template: Unstructured, items: list[AggregatedStatusItem]
+    ) -> Unstructured:
+        hook = self._hook(template, "aggregate_status")
+        if hook:
+            return hook(template, items)
+        return template
+
+    def get_dependencies(self, obj: Unstructured) -> list[dict]:
+        hook = self._hook(obj, "get_dependencies")
+        if hook:
+            return hook(obj)
+        return []
+
+    def reflect_status(self, obj: Unstructured) -> Optional[dict]:
+        hook = self._hook(obj, "reflect_status")
+        if hook:
+            return hook(obj)
+        status = obj.get("status")
+        return dict(status) if isinstance(status, dict) else None
+
+    def interpret_health(self, obj: Unstructured) -> str:
+        hook = self._hook(obj, "interpret_health")
+        if hook:
+            return hook(obj)
+        return UNKNOWN

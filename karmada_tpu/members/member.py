@@ -1,0 +1,90 @@
+"""In-memory member clusters: the simulated fleet the execution plane pushes
+to.
+
+Plays the role the kind clusters play in the reference's e2e environment
+(hack/local-up-karmada.sh) and the fake clientsets play in its unit tests: a
+member is a Store plus a tiny "kubelet" that fills workload status when
+manifests are applied, with health/failure injection for failover tests
+(SURVEY §5 fault injection = deleting/cordoning kind clusters)."""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Optional
+
+from ..api.meta import Resources
+from ..api.unstructured import Unstructured
+from ..store.store import Store, gvk_of
+
+
+@dataclass
+class MemberConfig:
+    name: str
+    provider: str = ""
+    region: str = ""
+    zone: str = ""
+    labels: dict[str, str] = field(default_factory=dict)
+    allocatable: Resources = field(default_factory=dict)
+    allocated: Resources = field(default_factory=dict)
+    sync_mode: str = "Push"
+
+
+class InMemoryMember:
+    """One member cluster: apply/delete manifests; workload controllers are
+    simulated synchronously (a Deployment becomes Ready on apply unless the
+    member is unhealthy or a failure is injected)."""
+
+    def __init__(self, config: MemberConfig):
+        self.config = config
+        self.store = Store()
+        self.healthy = True
+        # kinds that never become ready on this member (failure injection)
+        self.failing_kinds: set[str] = set()
+
+    @property
+    def name(self) -> str:
+        return self.config.name
+
+    def apply_manifest(self, manifest: dict) -> Unstructured:
+        obj = Unstructured(manifest)
+        applied = self.store.apply(obj)
+        self._run_controllers(applied)
+        return self.store.get(gvk_of(applied), applied.name, applied.namespace)
+
+    def delete_manifest(self, api_version: str, kind: str, namespace: str, name: str) -> None:
+        self.store.delete(f"{api_version}/{kind}", name, namespace)
+
+    def get(self, api_version: str, kind: str, name: str, namespace: str = "") -> Optional[Unstructured]:
+        return self.store.try_get(f"{api_version}/{kind}", name, namespace)
+
+    def _run_controllers(self, obj: Unstructured) -> None:
+        """Simulated member-side controllers: set status on workloads."""
+        key = f"{obj.api_version}/{obj.kind}"
+        fresh = self.store.get(key, obj.name, obj.namespace)
+        ok = self.healthy and obj.kind not in self.failing_kinds
+        if obj.kind in ("Deployment", "StatefulSet"):
+            replicas = int(fresh.get("spec", "replicas", default=1) or 0)
+            ready = replicas if ok else 0
+            fresh.status = {
+                "observedGeneration": fresh.metadata.generation,
+                "replicas": replicas,
+                "readyReplicas": ready,
+                "availableReplicas": ready,
+                "updatedReplicas": replicas,
+            }
+            self.store.update(fresh)
+        elif obj.kind == "Job":
+            parallelism = int(fresh.get("spec", "parallelism", default=1) or 0)
+            fresh.status = {
+                "active": parallelism if ok else 0,
+                "conditions": [] if ok else [{"type": "Failed", "status": "True"}],
+            }
+            self.store.update(fresh)
+
+    def set_healthy(self, healthy: bool) -> None:
+        """Flip member health and re-run controllers over existing workloads
+        (level-triggered: status converges to the new health)."""
+        self.healthy = healthy
+        for kind in list(self.store.kinds()):
+            for obj in self.store.list(kind):
+                if isinstance(obj, Unstructured):
+                    self._run_controllers(obj)

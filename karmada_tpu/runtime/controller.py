@@ -90,6 +90,52 @@ class Controller:
         return True
 
 
+@dataclass
+class BatchingController(Controller):
+    """Controller that drains its whole queue into ONE reconcile call — the
+    host-side hook that turns per-key events into the scheduler's batched
+    [B,C] device solve. reconcile_batch(keys) returns keys to requeue."""
+
+    reconcile_batch: Optional[Callable[[list[str]], list[str]]] = None
+
+    def step(self) -> bool:
+        keys = []
+        while True:
+            k = self.queue.pop()
+            if k is None:
+                break
+            keys.append(k)
+        if not keys:
+            return False
+        try:
+            requeue = self.reconcile_batch(keys) or []
+        except Exception:
+            # Per-key error isolation: one bad item must not burn the whole
+            # batch's retry budget (the reference retries bindings
+            # individually). Fall back to singleton batches; only the
+            # offender is retried/dropped.
+            for k in keys:
+                try:
+                    solo_requeue = self.reconcile_batch([k]) or []
+                except Exception as e:  # noqa: BLE001
+                    self.errors[k] = e
+                    self.queue.retry(k)
+                    continue
+                if k in solo_requeue:
+                    self.queue.retry(k)
+                else:
+                    self.queue.forget(k)
+                    self.errors.pop(k, None)
+            return True
+        for k in keys:
+            if k in requeue:
+                self.queue.retry(k)
+            else:
+                self.queue.forget(k)
+                self.errors.pop(k, None)
+        return True
+
+
 class Runtime:
     """Holds all controllers; `settle()` drains every queue until quiescent.
 

@@ -1,0 +1,184 @@
+"""Scheduler daemon: the host loop around the batched device solve.
+
+Parity with pkg/scheduler/scheduler.go: watches ResourceBindings + Clusters
+(event_handler.go:46,94-120 filters: schedulerName, scheduling suspension),
+decides WHETHER each binding needs scheduling (doScheduleBinding:375-443 — the
+four triggers: applied-placement changed :401, replicas changed :408,
+reschedule triggered :415, Duplicated refresh :422), then — unlike the
+reference's one-at-a-time loop — drains every dirty binding into ONE
+ArrayScheduler batch (BatchingController), and patches results + conditions
+(patchScheduleResultForResourceBinding:627-651, condition updates :913-961).
+
+Cluster add/update/delete re-encodes the device fleet and re-enqueues all
+bindings (reconcileCluster/enqueueAffectedBindings event_handler.go:313-368);
+idempotent no-op writes make the fixpoint terminate.
+"""
+from __future__ import annotations
+
+import json
+from dataclasses import asdict
+from typing import Optional
+
+from ..api.meta import Condition, set_condition
+from ..api.policy import DEFAULT_SCHEDULER_NAME, REPLICA_SCHEDULING_DUPLICATED
+from ..api.work import (
+    CONDITION_SCHEDULED,
+    POLICY_PLACEMENT_ANNOTATION,
+    REASON_BINDING_SCHEDULED,
+    REASON_SCHEDULE_FAILED,
+    REASON_UNSCHEDULABLE,
+    ResourceBinding,
+)
+from ..runtime.controller import BatchingController, Runtime
+from ..store.store import DELETED, Store
+from .core import ArrayScheduler, ScheduleDecision
+
+
+def placement_json(placement) -> str:
+    if placement is None:
+        return ""
+    return json.dumps(asdict(placement), sort_keys=True, default=str)
+
+
+class SchedulerDaemon:
+    def __init__(
+        self,
+        store: Store,
+        runtime: Runtime,
+        scheduler_name: str = DEFAULT_SCHEDULER_NAME,
+    ) -> None:
+        self.store = store
+        self.clock = runtime.clock
+        self.scheduler_name = scheduler_name
+        self._array: Optional[ArrayScheduler] = None
+        self._fleet_dirty = True
+        self.controller = runtime.register(
+            BatchingController(
+                name="scheduler", reconcile=None, reconcile_batch=self._schedule_batch
+            )
+        )
+        store.watch("ResourceBinding", self._on_binding)
+        store.watch("Cluster", self._on_cluster)
+
+    # -- event handlers (event_handler.go:94-120) -------------------------
+
+    def _on_binding(self, event: str, rb: ResourceBinding) -> None:
+        if event == DELETED:
+            return
+        if rb.spec.scheduler_name and rb.spec.scheduler_name != self.scheduler_name:
+            return
+        if rb.spec.scheduling_suspended():
+            return
+        self.controller.enqueue(rb.metadata.key())
+
+    def _on_cluster(self, event: str, cluster) -> None:
+        self._fleet_dirty = True
+        for rb in self.store.list("ResourceBinding"):
+            self._on_binding("MODIFIED", rb)
+
+    # -- trigger decision (doScheduleBinding:375-443) ---------------------
+
+    def _needs_schedule(self, rb: ResourceBinding) -> bool:
+        applied = rb.metadata.annotations.get(POLICY_PLACEMENT_ANNOTATION, "")
+        current = placement_json(rb.spec.placement)
+        if applied != current:
+            return True  # placement changed (:401) or never scheduled
+        if rb.spec.reschedule_triggered_at is not None and (
+            rb.status.last_scheduled_time is None
+            or rb.spec.reschedule_triggered_at > rb.status.last_scheduled_time
+        ):
+            return True  # reschedule triggered (:415)
+        if rb.spec.replicas > 0:
+            placement = rb.spec.placement
+            if placement is not None and placement.replica_scheduling_type() == REPLICA_SCHEDULING_DUPLICATED:
+                # Duplicated: replicas synced whenever any target drifts (:422);
+                # cluster-set changes also re-run (cluster events enqueue us).
+                return True
+            if rb.spec.assigned_replicas() != rb.spec.replicas:
+                return True  # replicas changed → scale schedule (:408)
+        return False
+
+    # -- the batch solve --------------------------------------------------
+
+    def _ensure_fleet(self) -> ArrayScheduler:
+        if self._array is None or self._fleet_dirty:
+            clusters = self.store.list("Cluster")
+            clusters.sort(key=lambda c: c.name)
+            if self._array is None:
+                self._array = ArrayScheduler(clusters)
+            else:
+                self._array.set_clusters(clusters)
+            self._fleet_dirty = False
+        return self._array
+
+    def _schedule_batch(self, keys: list[str]) -> list[str]:
+        bindings = []
+        for key in keys:
+            ns, _, name = key.partition("/")
+            rb = self.store.try_get("ResourceBinding", name, ns)
+            if rb is None or rb.metadata.deletion_timestamp is not None:
+                continue
+            if rb.spec.scheduling_suspended():
+                continue
+            if self._needs_schedule(rb):
+                bindings.append(rb)
+        if not bindings:
+            return []
+        array = self._ensure_fleet()
+        decisions = array.schedule(bindings)
+        for rb, decision in zip(bindings, decisions):
+            self._patch_result(rb, decision)
+        return []
+
+    def _patch_result(self, rb: ResourceBinding, decision: ScheduleDecision) -> None:
+        fresh = self.store.try_get("ResourceBinding", rb.name, rb.namespace)
+        if fresh is None:
+            return
+        if decision.ok:
+            placement = placement_json(fresh.spec.placement)
+            trigger_active = fresh.spec.reschedule_triggered_at is not None and (
+                fresh.status.last_scheduled_time is None
+                or fresh.spec.reschedule_triggered_at > fresh.status.last_scheduled_time
+            )
+            changed = (
+                _targets_fingerprint(fresh.spec.clusters)
+                != _targets_fingerprint(decision.targets)
+                or fresh.metadata.annotations.get(POLICY_PLACEMENT_ANNOTATION) != placement
+                or trigger_active
+            )
+            fresh.spec.clusters = decision.targets
+            fresh.metadata.annotations[POLICY_PLACEMENT_ANNOTATION] = placement
+            cond_changed = set_condition(
+                fresh.status.conditions,
+                Condition(
+                    type=CONDITION_SCHEDULED,
+                    status="True",
+                    reason=REASON_BINDING_SCHEDULED,
+                    message="Binding has been scheduled successfully.",
+                ),
+            )
+            if not changed and not cond_changed:
+                return  # idempotent no-op: the event fixpoint terminates here
+            fresh.status.scheduler_observed_generation = fresh.metadata.generation
+            fresh.status.last_scheduled_time = self.clock.now()
+        else:
+            reason = (
+                REASON_UNSCHEDULABLE
+                if "not enough" in decision.error or "available" in decision.error
+                else REASON_SCHEDULE_FAILED
+            )
+            if not set_condition(
+                fresh.status.conditions,
+                Condition(
+                    type=CONDITION_SCHEDULED,
+                    status="False",
+                    reason=reason,
+                    message=decision.error,
+                ),
+            ):
+                return
+        self.store.update(fresh)
+
+
+def _targets_fingerprint(targets) -> tuple:
+    return tuple(sorted((t.name, t.replicas) for t in (targets or [])))

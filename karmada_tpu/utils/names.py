@@ -1,0 +1,25 @@
+"""Deterministic object naming (reference: pkg/util/names)."""
+from __future__ import annotations
+
+import hashlib
+
+
+def _short_hash(*parts: str) -> str:
+    return hashlib.blake2b("/".join(parts).encode(), digest_size=4).hexdigest()
+
+
+def binding_name(kind: str, name: str) -> str:
+    """names.GenerateBindingName: '{name}-{kind lowercased}'."""
+    return f"{name}-{kind.lower()}"
+
+
+def work_name(api_version: str, kind: str, namespace: str, name: str) -> str:
+    """Work object name, unique per template INCLUDING the API group
+    (names.GenerateWorkName adds a hash; without apiVersion, same-kind
+    templates from different groups would collide on one Work)."""
+    base = f"{name}-{namespace or 'cluster'}-{kind.lower()}"
+    return f"{base}-{_short_hash(api_version, kind, namespace, name)}"
+
+
+def execution_namespace(cluster: str) -> str:
+    return f"karmada-es-{cluster}"

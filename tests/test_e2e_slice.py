@@ -1,0 +1,207 @@
+"""End-to-end slice: template + policy → detector → scheduler → works →
+member apply → status aggregation back onto the template (BASELINE config 1:
+nginx Deployment over 3 members, Duplicated)."""
+from karmada_tpu.api.meta import CPU, MEMORY
+from karmada_tpu.api.work import CONDITION_FULLY_APPLIED, CONDITION_SCHEDULED
+from karmada_tpu.api.meta import get_condition
+from karmada_tpu.controlplane import ControlPlane
+from karmada_tpu.members.member import MemberConfig
+from karmada_tpu.testing.fixtures import (
+    duplicated_placement,
+    new_deployment,
+    new_policy,
+    selector_for,
+    static_weight_placement,
+)
+
+GiB = 1024.0**3
+
+
+def three_member_plane() -> ControlPlane:
+    cp = ControlPlane()
+    for i in range(1, 4):
+        cp.join_member(
+            MemberConfig(
+                name=f"member{i}",
+                region=f"region-{i % 2}",
+                allocatable={CPU: 100.0, MEMORY: 400 * GiB, "pods": 1000.0},
+            )
+        )
+    return cp
+
+
+def test_nginx_duplicated_end_to_end():
+    cp = three_member_plane()
+    deploy = new_deployment("default", "nginx", replicas=2, cpu=0.1)
+    cp.store.create(deploy)
+    cp.store.create(
+        new_policy("default", "nginx-pp", [selector_for(deploy)], duplicated_placement([]))
+    )
+    cp.settle()
+
+    rb = cp.store.get("ResourceBinding", "nginx-deployment", "default")
+    assert get_condition(rb.status.conditions, CONDITION_SCHEDULED).status == "True"
+    assert {tc.name for tc in rb.spec.clusters} == {"member1", "member2", "member3"}
+    assert all(tc.replicas == 2 for tc in rb.spec.clusters)
+
+    # works exist and members run the workload
+    for m in ("member1", "member2", "member3"):
+        obj = cp.members[m].get("apps/v1", "Deployment", "nginx", "default")
+        assert obj is not None
+        assert obj.get("spec", "replicas") == 2
+        assert obj.get("status", "readyReplicas") == 2
+
+    # status aggregated back to binding and template
+    rb = cp.store.get("ResourceBinding", "nginx-deployment", "default")
+    assert get_condition(rb.status.conditions, CONDITION_FULLY_APPLIED).status == "True"
+    assert all(i.applied and i.health == "Healthy" for i in rb.status.aggregated_status)
+    template = cp.store.get("apps/v1/Deployment", "nginx", "default")
+    assert template.get("status", "readyReplicas") == 6  # 2 × 3 clusters
+
+
+def test_divided_static_weight_revises_member_replicas():
+    cp = three_member_plane()
+    deploy = new_deployment("default", "web", replicas=9, cpu=0.1)
+    cp.store.create(deploy)
+    cp.store.create(
+        new_policy(
+            "default",
+            "web-pp",
+            [selector_for(deploy)],
+            static_weight_placement({"member1": 1, "member2": 2}),
+        )
+    )
+    cp.settle()
+
+    rb = cp.store.get("ResourceBinding", "web-deployment", "default")
+    got = {tc.name: tc.replicas for tc in rb.spec.clusters}
+    assert got == {"member1": 3, "member2": 6}
+    assert cp.members["member1"].get("apps/v1", "Deployment", "web", "default").get("spec", "replicas") == 3
+    assert cp.members["member2"].get("apps/v1", "Deployment", "web", "default").get("spec", "replicas") == 6
+    assert cp.members["member3"].get("apps/v1", "Deployment", "web", "default") is None
+
+
+def test_template_update_propagates():
+    cp = three_member_plane()
+    deploy = new_deployment("default", "nginx", replicas=2)
+    cp.store.create(deploy)
+    cp.store.create(
+        new_policy("default", "pp", [selector_for(deploy)], duplicated_placement(["member1"]))
+    )
+    cp.settle()
+    assert cp.members["member1"].get("apps/v1", "Deployment", "nginx", "default").get("spec", "replicas") == 2
+
+    fresh = cp.store.get("apps/v1/Deployment", "nginx", "default")
+    fresh.set("spec", "replicas", 5)
+    cp.store.update(fresh)
+    cp.settle()
+    assert cp.members["member1"].get("apps/v1", "Deployment", "nginx", "default").get("spec", "replicas") == 5
+
+
+def test_template_delete_cascades():
+    cp = three_member_plane()
+    deploy = new_deployment("default", "nginx", replicas=1)
+    cp.store.create(deploy)
+    cp.store.create(
+        new_policy("default", "pp", [selector_for(deploy)], duplicated_placement([]))
+    )
+    cp.settle()
+    assert cp.members["member1"].get("apps/v1", "Deployment", "nginx", "default") is not None
+
+    cp.store.delete("apps/v1/Deployment", "nginx", "default")
+    cp.settle()
+    assert cp.store.try_get("ResourceBinding", "nginx-deployment", "default") is None
+    assert not cp.store.list("Work")
+    for m in ("member1", "member2", "member3"):
+        assert cp.members[m].get("apps/v1", "Deployment", "nginx", "default") is None
+
+
+def test_cluster_not_ready_scheduling_behavior():
+    """NotReady alone must NOT move already-bound replicas (that's the taint
+    manager / failover family's job — doScheduleBinding has no 'cluster
+    unhealthy' trigger); new bindings must avoid the unready cluster."""
+    cp = three_member_plane()
+    deploy = new_deployment("default", "web", replicas=6, cpu=0.5)
+    cp.store.create(deploy)
+    from tests.test_scheduler_core import dyn_placement
+
+    cp.store.create(new_policy("default", "pp", [selector_for(deploy)], dyn_placement()))
+    cp.settle()
+    rb = cp.store.get("ResourceBinding", "web-deployment", "default")
+    assert rb.spec.assigned_replicas() == 6
+    before = {tc.name: tc.replicas for tc in rb.spec.clusters}
+
+    cp.set_member_ready("member1", False)
+    cp.settle()
+    rb = cp.store.get("ResourceBinding", "web-deployment", "default")
+    assert {tc.name: tc.replicas for tc in rb.spec.clusters} == before  # sticky
+
+    # a NEW workload scheduled after the outage avoids member1
+    deploy2 = new_deployment("default", "web2", replicas=4, cpu=0.5)
+    cp.store.create(deploy2)
+    cp.store.create(new_policy("default", "pp2", [selector_for(deploy2)], dyn_placement()))
+    cp.settle()
+    rb2 = cp.store.get("ResourceBinding", "web2-deployment", "default")
+    assert rb2.spec.assigned_replicas() == 4
+    assert "member1" not in {tc.name for tc in rb2.spec.clusters}
+
+
+def test_policy_delete_removes_binding():
+    cp = three_member_plane()
+    deploy = new_deployment("default", "nginx", replicas=1)
+    cp.store.create(deploy)
+    cp.store.create(
+        new_policy("default", "pp", [selector_for(deploy)], duplicated_placement([]))
+    )
+    cp.settle()
+    assert cp.store.try_get("ResourceBinding", "nginx-deployment", "default") is not None
+    cp.store.delete("PropagationPolicy", "pp", "default")
+    cp.settle()
+    assert cp.store.try_get("ResourceBinding", "nginx-deployment", "default") is None
+
+
+def test_image_update_propagates_and_no_status_in_manifests():
+    cp = three_member_plane()
+    deploy = new_deployment("default", "nginx", replicas=2)
+    cp.store.create(deploy)
+    cp.store.create(
+        new_policy("default", "pp", [selector_for(deploy)], duplicated_placement(["member1"]))
+    )
+    cp.settle()
+
+    fresh = cp.store.get("apps/v1/Deployment", "nginx", "default")
+    containers = fresh.get("spec", "template", "spec", "containers")
+    containers[0]["image"] = "nginx:2.0"
+    cp.store.update(fresh)
+    cp.settle()
+
+    obj = cp.members["member1"].get("apps/v1", "Deployment", "nginx", "default")
+    assert obj.get("spec", "template", "spec", "containers")[0]["image"] == "nginx:2.0"
+    # the aggregated template status must never be pushed to members
+    (work,) = cp.store.list("Work")
+    assert "status" not in work.spec.workload_manifests[0]
+
+
+def test_suspension_dispatching_gates_and_resumes():
+    from karmada_tpu.api.policy import Suspension
+    from karmada_tpu.api.work import WORK_CONDITION_DISPATCHING
+
+    cp = three_member_plane()
+    deploy = new_deployment("default", "nginx", replicas=1)
+    cp.store.create(deploy)
+    pol = new_policy("default", "pp", [selector_for(deploy)], duplicated_placement(["member1"]))
+    pol.spec.suspension = Suspension(dispatching=True)
+    cp.store.create(pol)
+    cp.settle()
+    assert cp.members["member1"].get("apps/v1", "Deployment", "nginx", "default") is None
+    (work,) = cp.store.list("Work")
+    cond = get_condition(work.status.conditions, WORK_CONDITION_DISPATCHING)
+    assert cond.status == "False"
+
+    pol = cp.store.get("PropagationPolicy", "pp", "default")
+    pol.spec.suspension = None
+    cp.store.update(pol)
+    cp.settle()
+    assert cp.members["member1"].get("apps/v1", "Deployment", "nginx", "default") is not None
+    (work,) = cp.store.list("Work")
+    assert get_condition(work.status.conditions, WORK_CONDITION_DISPATCHING).status == "True"
