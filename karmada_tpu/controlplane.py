@@ -24,7 +24,9 @@ from .api.meta import Condition, ObjectMeta, set_condition
 from .controllers.binding import BindingController
 from .controllers.execution import ExecutionController
 from .controllers.status import BindingStatusController, WorkStatusController
+from .descheduler.descheduler import Descheduler
 from .detector.detector import ResourceDetector
+from .estimator.client import EstimatorRegistry, MemberEstimators
 from .interpreter.interpreter import ResourceInterpreter
 from .members.member import InMemoryMember, MemberConfig
 from .runtime.controller import Clock, Runtime
@@ -45,8 +47,19 @@ class ControlPlane:
         self.interpreter = ResourceInterpreter()
         self.members: dict[str, InMemoryMember] = {}
 
+        self.estimator_registry = EstimatorRegistry()
+        member_estimators = MemberEstimators(self.members)
+        self.estimator_registry.register_replica_estimator(
+            "scheduler-estimator", member_estimators
+        )
+        self.estimator_registry.register_unschedulable_estimator(
+            "scheduler-estimator", member_estimators
+        )
+
         self.detector = ResourceDetector(self.store, self.interpreter, self.runtime)
-        self.scheduler = SchedulerDaemon(self.store, self.runtime)
+        self.scheduler = SchedulerDaemon(
+            self.store, self.runtime, estimator_registry=self.estimator_registry
+        )
         self.binding_controller = BindingController(self.store, self.interpreter, self.runtime)
         self.execution_controller = ExecutionController(
             self.store, self.members, self.interpreter, self.runtime
@@ -61,6 +74,9 @@ class ControlPlane:
         self.binding_status_controller = BindingStatusController(
             self.store, self.interpreter, self.runtime
         )
+        self.descheduler = Descheduler(
+            self.store, self.estimator_registry, clock=self.runtime.clock
+        )
 
     # -- cluster lifecycle (karmadactl join equivalent) -------------------
 
@@ -71,6 +87,17 @@ class ControlPlane:
         summary — cluster_status_controller.go:181,544-679)."""
         member = InMemoryMember(config)
         self.members[config.name] = member
+        if member.node_estimator is not None:
+            member.node_estimator.clock = self.runtime.clock
+        if config.nodes and not config.allocatable:
+            # derive the ResourceSummary from node capacity (status collector
+            # NodeSummary/ResourceSummary path, cluster_status_controller.go:544-679)
+            alloc: dict[str, float] = {}
+            for n in config.nodes:
+                for k, v in n.allocatable.items():
+                    alloc[k] = alloc.get(k, 0.0) + v
+            alloc.setdefault("pods", float(sum(n.allowed_pods for n in config.nodes)))
+            config.allocatable = alloc
         cluster = Cluster(
             metadata=ObjectMeta(name=config.name, labels=dict(config.labels)),
             spec=ClusterSpec(
@@ -114,3 +141,9 @@ class ControlPlane:
 
     def settle(self, max_steps: int = 100_000) -> int:
         return self.runtime.settle(max_steps)
+
+    def run_descheduler(self) -> int:
+        """One descheduling sweep + convergence (the 2m timer tick)."""
+        n = self.descheduler.deschedule_once()
+        self.settle()
+        return n

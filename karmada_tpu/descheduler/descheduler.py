@@ -1,0 +1,126 @@
+"""Descheduler: shrink assignments stuck unschedulable so the scheduler can
+re-place the freed replicas elsewhere.
+
+Parity with pkg/descheduler (EST5, descheduler.go:141-240): every
+--descheduling-interval (default 2m) sweep all ResourceBindings with
+Divided+Dynamic placements (core/filter.go:35), find clusters where
+ready < assigned (GetUndesiredClusters, core/helper.go:99-109), ask the
+unschedulable estimators how many replicas cannot ever start (min-merge,
+helper.go:62-96), reduce spec.clusters[i].replicas by that count — never below
+ready (updateScheduleResult:207) — and update the binding. The scheduler then
+sees replicas-changed (scheduler.go:408) and scale-up re-places the freed
+replicas on clusters with headroom.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+from ..api.policy import (
+    DIVISION_PREFERENCE_AGGREGATED,
+    DIVISION_PREFERENCE_WEIGHTED,
+    REPLICA_SCHEDULING_DIVIDED,
+)
+from ..api.work import ResourceBinding, TargetCluster
+from ..runtime.controller import Clock
+from ..store.store import Store
+
+DEFAULT_DESCHEDULING_INTERVAL = 120.0  # seconds (cmd/descheduler/app/options)
+DEFAULT_UNSCHEDULABLE_THRESHOLD = 300.0  # 5m (descheduler options)
+
+
+def eligible(rb: ResourceBinding) -> bool:
+    """FilterBindings (descheduler/core/filter.go:35): Divided + dynamic
+    division only (Aggregated or Weighted with dynamicWeight)."""
+    p = rb.spec.placement
+    if p is None or p.replica_scheduling is None:
+        return False
+    rs = p.replica_scheduling
+    if rs.replica_scheduling_type != REPLICA_SCHEDULING_DIVIDED:
+        return False
+    if rs.replica_division_preference == DIVISION_PREFERENCE_AGGREGATED:
+        return True
+    return (
+        rs.replica_division_preference == DIVISION_PREFERENCE_WEIGHTED
+        and rs.weight_preference is not None
+        and bool(rs.weight_preference.dynamic_weight)
+    )
+
+
+def ready_replicas_by_cluster(rb: ResourceBinding) -> dict[str, int]:
+    """Parsed from aggregatedStatus (core/helper.go:120-142)."""
+    out: dict[str, int] = {}
+    for item in rb.status.aggregated_status:
+        status = item.status or {}
+        out[item.cluster_name] = int(status.get("readyReplicas", 0) or 0)
+    return out
+
+
+class Descheduler:
+    def __init__(
+        self,
+        store: Store,
+        estimator_registry,
+        clock: Optional[Clock] = None,
+        unschedulable_threshold: float = DEFAULT_UNSCHEDULABLE_THRESHOLD,
+        interval: float = DEFAULT_DESCHEDULING_INTERVAL,
+    ) -> None:
+        self.store = store
+        self.registry = estimator_registry
+        self.clock = clock or Clock()
+        self.threshold = unschedulable_threshold
+        self.interval = interval
+        self._last_run: Optional[float] = None
+
+    def tick(self) -> int:
+        """Run one sweep if the interval elapsed; returns bindings updated."""
+        now = self.clock.now()
+        if self._last_run is not None and now - self._last_run < self.interval:
+            return 0
+        self._last_run = now
+        return self.deschedule_once()
+
+    def deschedule_once(self) -> int:
+        updated = 0
+        for rb in self.store.list("ResourceBinding"):
+            if not eligible(rb):
+                continue
+            if self._deschedule_binding(rb):
+                updated += 1
+        return updated
+
+    def _deschedule_binding(self, rb: ResourceBinding) -> bool:
+        ready = ready_replicas_by_cluster(rb)
+        undesired = [
+            tc.name for tc in rb.spec.clusters if ready.get(tc.name, 0) < tc.replicas
+        ]
+        if not undesired:
+            return False
+        workload_key = (
+            f"{rb.spec.resource.kind}/{rb.spec.resource.namespace}/{rb.spec.resource.name}"
+        )
+        unschedulable = dict(
+            zip(
+                undesired,
+                self.registry.min_unschedulable(undesired, workload_key, self.threshold),
+            )
+        )
+        new_clusters = []
+        changed = False
+        for tc in rb.spec.clusters:
+            n = unschedulable.get(tc.name, 0)
+            if n > 0:
+                # shrink by the unschedulable count, floored at ready
+                target = max(tc.replicas - n, ready.get(tc.name, 0))
+                if target != tc.replicas:
+                    changed = True
+                new_clusters.append(TargetCluster(name=tc.name, replicas=target))
+            else:
+                new_clusters.append(tc)
+        if not changed:
+            return False
+        fresh = self.store.try_get("ResourceBinding", rb.name, rb.namespace)
+        if fresh is None:
+            return False
+        fresh.spec.clusters = new_clusters
+        self.store.update(fresh)
+        return True

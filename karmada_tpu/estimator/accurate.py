@@ -1,0 +1,153 @@
+"""Accurate estimator core: the karmada-scheduler-estimator daemon's brain.
+
+Parity with pkg/estimator/server (EST4): per member cluster, a node/pod
+snapshot answers MaxAvailableReplicas = Σ over affinity+toleration-feasible
+nodes of min((allocatable−requested)/request, free pod slots)
+(estimate.go:36-112), and GetUnschedulableReplicas counts replicas pending
+longer than a threshold (server.go:228). The node math runs as a jitted array
+kernel (ops/estimate.py); node-affinity string matching is host-evaluated with
+per-claim dedup.
+"""
+from __future__ import annotations
+
+import time
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+
+from ..api.meta import Resources
+from ..api.work import ReplicaRequirements
+from ..models.nodes import (
+    NodeArrays,
+    NodeEncoder,
+    NodeSpec,
+    node_claim_matches,
+    tolerations_cover_node_taints,
+)
+from ..ops.estimate import cluster_estimate
+
+
+class AccurateEstimator:
+    """One member cluster's estimator. Also serves as the member's pod
+    placement simulator (the test fixture role — SURVEY §4 synthetic fleet)."""
+
+    def __init__(self, nodes: Sequence[NodeSpec], clock=None):
+        self.clock = clock  # injectable (tests advance time deterministically)
+        self.encoder = NodeEncoder()
+        self.specs = list(nodes)
+        self.arrays: NodeArrays = self.encoder.encode(self.specs)
+        # pods placed per workload key: list of (node_idx, count, req_vec)
+        self._pods: dict[str, list[tuple[int, int, np.ndarray]]] = {}
+        self._pending: dict[str, tuple[int, float]] = {}  # key -> (count, since)
+        self._estimate = jax.jit(cluster_estimate)
+
+    # -- estimation (the gRPC answer) -------------------------------------
+
+    def _node_ok(self, requirements: Optional[ReplicaRequirements]) -> np.ndarray:
+        N = self.arrays.n_nodes
+        ok = np.ones(N, bool)
+        claim = requirements.node_claim if requirements else None
+        tolerations = claim.tolerations if claim else []
+        for i, spec in enumerate(self.specs):
+            if not node_claim_matches(claim, spec.labels):
+                ok[i] = False
+            elif not tolerations_cover_node_taints(tolerations, spec.taints):
+                ok[i] = False
+        return ok
+
+    def max_available_replicas(self, requirements: Optional[ReplicaRequirements]) -> int:
+        return self.max_available_replicas_batch([requirements])[0]
+
+    def max_available_replicas_batch(
+        self, requirements_list: Sequence[Optional[ReplicaRequirements]]
+    ) -> list[int]:
+        """All B requests against this cluster's nodes in ONE kernel call —
+        the batched form the scheduler's per-round estimate sweep uses."""
+        if self.arrays.n_nodes == 0:
+            return [0] * len(requirements_list)
+        request = np.stack(
+            [
+                self.encoder.request_vector(r.resource_request if r else {})
+                for r in requirements_list
+            ]
+        )
+        node_ok = np.stack([self._node_ok(r) for r in requirements_list])
+        out = self._estimate(
+            self.arrays.alloc,
+            self.arrays.requested,
+            self.arrays.pod_count,
+            self.arrays.allowed_pods,
+            request,
+            node_ok,
+        )
+        return [int(v) for v in np.asarray(out)]
+
+    def get_unschedulable_replicas(
+        self, workload_key: str, threshold_seconds: float, now: Optional[float] = None
+    ) -> int:
+        """Replicas of the workload pending longer than the threshold
+        (server.go:228: owner-chained pods Pending > threshold)."""
+        pending = self._pending.get(workload_key)
+        if pending is None:
+            return 0
+        count, since = pending
+        if now is None:
+            now = self.clock.now() if self.clock else time.time()
+        return count if now - since >= threshold_seconds else 0
+
+    # -- pod placement simulation (member-side "kubelet/scheduler") -------
+
+    def place(
+        self,
+        workload_key: str,
+        replicas: int,
+        request: Resources,
+        now: Optional[float] = None,
+        claim=None,
+    ) -> int:
+        """Greedy first-fit of `replicas` pods over claim-feasible nodes
+        (taints/selector respected, like the real kube-scheduler would);
+        returns how many fit. The remainder is recorded as pending (feeds
+        GetUnschedulableReplicas); the pending-since timestamp survives
+        re-placement so the unschedulable threshold can actually elapse."""
+        prev_pending = self._pending.get(workload_key)
+        self.unplace(workload_key)
+        req = self.encoder.request_vector(request)
+        tolerations = claim.tolerations if claim else []
+        placed: list[tuple[int, int, np.ndarray]] = []
+        remaining = replicas
+        a = self.arrays
+        for i in range(a.n_nodes):
+            if remaining <= 0:
+                break
+            spec = self.specs[i]
+            if not node_claim_matches(claim, spec.labels):
+                continue
+            if not tolerations_cover_node_taints(tolerations, spec.taints):
+                continue
+            rest = a.alloc[i] - a.requested[i]
+            with np.errstate(divide="ignore"):
+                fits = np.where(req > 0, rest // np.maximum(req, 1), np.iinfo(np.int64).max)
+            fit = int(min(fits.min(), a.allowed_pods[i] - a.pod_count[i]))
+            fit = max(min(fit, remaining), 0)
+            if fit > 0:
+                a.requested[i] += req * fit
+                a.pod_count[i] += fit
+                placed.append((i, fit, req))
+                remaining -= fit
+        self._pods[workload_key] = placed
+        if remaining > 0:
+            if now is None:
+                now = self.clock.now() if self.clock else time.time()
+            since = prev_pending[1] if prev_pending else now
+            self._pending[workload_key] = (remaining, since)
+        else:
+            self._pending.pop(workload_key, None)
+        return replicas - remaining
+
+    def unplace(self, workload_key: str) -> None:
+        for i, count, req in self._pods.pop(workload_key, []):
+            self.arrays.requested[i] -= req * count
+            self.arrays.pod_count[i] -= count
+        self._pending.pop(workload_key, None)

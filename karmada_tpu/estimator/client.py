@@ -1,0 +1,171 @@
+"""Estimator client registry + min-merge.
+
+Parity with pkg/estimator/client (EST1/EST3): a pluggable registry of
+ReplicaEstimator / UnschedulableReplicaEstimator implementations; the
+scheduler takes the MIN across estimators per cluster, with
+UnauthenticReplica = -1 meaning "discard my answer" (interface.go:27-55,
+core/util.go:72-100). The in-process MemberEstimators adapter plays the role
+of the per-cluster gRPC connection cache (accurate.go:34-68); the real gRPC
+client lives in service.py.
+"""
+from __future__ import annotations
+
+from concurrent.futures import ThreadPoolExecutor
+from typing import Optional, Protocol, Sequence
+
+import numpy as np
+
+from ..api.work import ReplicaRequirements, ResourceBinding
+
+UNAUTHENTIC_REPLICA = -1
+
+
+class ReplicaEstimator(Protocol):
+    def max_available_replicas(
+        self,
+        clusters: Sequence[str],
+        requirements: Optional[ReplicaRequirements],
+        replicas: int,
+    ) -> list[int]:
+        """Per-cluster estimate; UNAUTHENTIC_REPLICA to discard."""
+        ...
+
+
+class UnschedulableReplicaEstimator(Protocol):
+    def get_unschedulable_replicas(
+        self, clusters: Sequence[str], workload_key: str, threshold_seconds: float
+    ) -> list[int]:
+        ...
+
+
+class EstimatorRegistry:
+    """replicaEstimators / unschedulableReplicaEstimators registries
+    (interface.go:38-55). The GeneralEstimator equivalent is fused into the
+    device kernel; registered estimators contribute the extra min-merge term."""
+
+    def __init__(self) -> None:
+        self.replica_estimators: dict[str, ReplicaEstimator] = {}
+        self.unschedulable_estimators: dict[str, UnschedulableReplicaEstimator] = {}
+
+    def register_replica_estimator(self, name: str, est: ReplicaEstimator) -> None:
+        self.replica_estimators[name] = est
+
+    def register_unschedulable_estimator(
+        self, name: str, est: UnschedulableReplicaEstimator
+    ) -> None:
+        self.unschedulable_estimators[name] = est
+
+    def batch_estimates(
+        self,
+        bindings: Sequence[ResourceBinding],
+        clusters: Sequence[str],
+    ) -> Optional[np.ndarray]:
+        """extra_avail i32[B,C]: min across registered estimators, -1 where
+        every estimator discarded (the device kernel min-merges this with the
+        GeneralEstimator column)."""
+        if not self.replica_estimators:
+            return None
+        from ..models.batch import AGGREGATED, DYNAMIC_WEIGHT, strategy_code
+
+        B, C = len(bindings), len(clusters)
+        # Only dynamic strategies consume availability; Duplicated/static
+        # rows must not pay B×C estimator calls (core/util.go:63-70 skips
+        # non-workloads; the reference only estimates inside dynamic assign).
+        dyn_rows = [
+            b
+            for b, rb in enumerate(bindings)
+            if strategy_code(rb.spec.placement, rb.spec.replicas)
+            in (DYNAMIC_WEIGHT, AGGREGATED)
+        ]
+        if not dyn_rows:
+            return None
+        merged = np.full((B, C), np.iinfo(np.int32).max, np.int64)
+        authentic = np.zeros((B, C), bool)
+
+        def merge_row(b: int, res) -> None:
+            row = np.asarray(res, np.int64)
+            ok = row != UNAUTHENTIC_REPLICA
+            merged[b] = np.where(ok, np.minimum(merged[b], row), merged[b])
+            authentic[b] |= ok
+
+        reqs = [bindings[b].spec.replica_requirements for b in dyn_rows]
+        for est in self.replica_estimators.values():
+            rows_fn = getattr(est, "max_available_replicas_rows", None)
+            if rows_fn is not None:  # batched path: one kernel per cluster
+                for b, res in zip(dyn_rows, rows_fn(clusters, reqs)):
+                    merge_row(b, res)
+            else:
+                for b in dyn_rows:
+                    merge_row(
+                        b,
+                        est.max_available_replicas(
+                            clusters,
+                            bindings[b].spec.replica_requirements,
+                            bindings[b].spec.replicas,
+                        ),
+                    )
+        return np.where(authentic, merged, UNAUTHENTIC_REPLICA).astype(np.int32)
+
+    def min_unschedulable(
+        self,
+        clusters: Sequence[str],
+        workload_key: str,
+        threshold_seconds: float,
+    ) -> list[int]:
+        """Min across unschedulable estimators (descheduler/core/helper.go:62-96)."""
+        C = len(clusters)
+        merged = [np.iinfo(np.int32).max] * C
+        authentic = [False] * C
+        for est in self.unschedulable_estimators.values():
+            res = est.get_unschedulable_replicas(clusters, workload_key, threshold_seconds)
+            for i, v in enumerate(res):
+                if v != UNAUTHENTIC_REPLICA:
+                    merged[i] = min(merged[i], v)
+                    authentic[i] = True
+        return [m if a else 0 for m, a in zip(merged, authentic)]
+
+
+class MemberEstimators:
+    """In-process adapter: routes estimator calls to each member's
+    AccurateEstimator with concurrent fan-out (accurate.go:139-162's
+    goroutine-per-cluster becomes a thread pool; answers for members without
+    node state are discarded with the -1 sentinel)."""
+
+    def __init__(self, members: dict):
+        self.members = members
+        self._pool = ThreadPoolExecutor(max_workers=16)
+
+    def _estimator_for(self, cluster: str):
+        member = self.members.get(cluster)
+        return getattr(member, "node_estimator", None) if member else None
+
+    def max_available_replicas(self, clusters, requirements, replicas) -> list[int]:
+        def one(cluster: str) -> int:
+            est = self._estimator_for(cluster)
+            if est is None:
+                return UNAUTHENTIC_REPLICA
+            return est.max_available_replicas(requirements)
+
+        return list(self._pool.map(one, clusters))
+
+    def max_available_replicas_rows(self, clusters, requirements_list) -> list[list[int]]:
+        """Batched: all B requirements per cluster in one kernel call; returns
+        [B][C]. Clusters without node state are discarded via the sentinel."""
+
+        def one(cluster: str) -> list[int]:
+            est = self._estimator_for(cluster)
+            if est is None:
+                return [UNAUTHENTIC_REPLICA] * len(requirements_list)
+            return est.max_available_replicas_batch(requirements_list)
+
+        columns = list(self._pool.map(one, clusters))  # [C][B]
+        return [[columns[c][b] for c in range(len(clusters))] for b in range(len(requirements_list))]
+
+    def get_unschedulable_replicas(self, clusters, workload_key, threshold_seconds) -> list[int]:
+        def one(cluster: str) -> int:
+            est = self._estimator_for(cluster)
+            if est is None:
+                return UNAUTHENTIC_REPLICA
+            return est.get_unschedulable_replicas(workload_key, threshold_seconds)
+
+        return list(self._pool.map(one, clusters))

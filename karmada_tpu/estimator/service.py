@@ -1,0 +1,257 @@
+"""gRPC estimator service: the Go-interop seam (SURVEY D2).
+
+Serves the reference's `service Estimator { MaxAvailableReplicas;
+GetUnschedulableReplicas }` contract (service.proto:26-28) on the reference's
+method paths, with wire-compatible messages (proto/estimator.proto), so a
+stock karmada-scheduler can point its --enable-scheduler-estimator at this
+process and get TPU-computed answers. The client side mirrors
+estimator/client/accurate.go: per-cluster channel cache, concurrent fan-out
+with a shared deadline, -1 sentinel on error.
+"""
+from __future__ import annotations
+
+from concurrent import futures
+from concurrent.futures import ThreadPoolExecutor
+from typing import Callable, Optional
+
+import grpc
+
+from ..api.meta import Resources
+from ..api.work import NodeClaim, ReplicaRequirements
+from ..interpreter.interpreter import _parse_quantity
+from .client import UNAUTHENTIC_REPLICA
+from .proto import estimator_pb2 as pb
+
+_SERVICE = "github.com.karmada_io.karmada.pkg.estimator.service.Estimator"
+METHOD_MAX_AVAILABLE = f"/{_SERVICE}/MaxAvailableReplicas"
+METHOD_UNSCHEDULABLE = f"/{_SERVICE}/GetUnschedulableReplicas"
+
+
+def requirements_from_pb(req: pb.ReplicaRequirements) -> ReplicaRequirements:
+    request: Resources = {
+        name: _parse_quantity(q.string) for name, q in req.resourceRequest.items()
+    }
+    claim = None
+    if req.HasField("nodeClaim"):
+        nc = req.nodeClaim
+        affinity = None
+        if nc.HasField("nodeAffinity"):
+            affinity = [
+                {
+                    "matchExpressions": [
+                        {"key": e.key, "operator": e.operator, "values": list(e.values)}
+                        for e in term.matchExpressions
+                    ]
+                }
+                for term in nc.nodeAffinity.nodeSelectorTerms
+            ]
+        claim = NodeClaim(
+            node_selector=dict(nc.nodeSelector),
+            tolerations=[
+                {
+                    "key": t.key,
+                    "operator": t.operator or "Equal",
+                    "value": t.value,
+                    "effect": t.effect,
+                }
+                for t in nc.tolerations
+            ],
+            hard_node_affinity=affinity,
+        )
+    return ReplicaRequirements(
+        node_claim=claim,
+        resource_request=request,
+        namespace=req.namespace,
+        priority_class_name=req.priorityClassName,
+    )
+
+
+def requirements_to_pb(requirements: Optional[ReplicaRequirements]) -> pb.ReplicaRequirements:
+    out = pb.ReplicaRequirements()
+    if requirements is None:
+        return out
+    for name, value in requirements.resource_request.items():
+        out.resourceRequest[name].string = _format_quantity(name, value)
+    out.namespace = requirements.namespace
+    out.priorityClassName = requirements.priority_class_name
+    claim = requirements.node_claim
+    if claim is not None:
+        for k, v in claim.node_selector.items():
+            out.nodeClaim.nodeSelector[k] = v
+        for t in claim.tolerations:
+            tol = out.nodeClaim.tolerations.add()
+            if isinstance(t, dict):
+                tol.key = t.get("key", "")
+                tol.operator = t.get("operator", "Equal")
+                tol.value = t.get("value", "")
+                tol.effect = t.get("effect", "")
+            else:
+                tol.key, tol.operator, tol.value, tol.effect = (
+                    t.key,
+                    t.operator,
+                    t.value,
+                    t.effect,
+                )
+        if claim.hard_node_affinity:
+            for term in claim.hard_node_affinity:
+                pb_term = out.nodeClaim.nodeAffinity.nodeSelectorTerms.add()
+                for e in term.get("matchExpressions", []):
+                    pb_e = pb_term.matchExpressions.add()
+                    pb_e.key = e.get("key", "")
+                    pb_e.operator = e.get("operator", "In")
+                    pb_e.values.extend(e.get("values", []))
+    return out
+
+
+def _format_quantity(resource: str, value: float) -> str:
+    if resource == "cpu":
+        return f"{int(round(value * 1000))}m"
+    if value == int(value):
+        return str(int(value))
+    return str(value)
+
+
+class EstimatorServer:
+    """Serves N member clusters' estimators from one process.
+
+    estimators: cluster name -> AccurateEstimator.
+    workload_key_fn: maps (kind, namespace, name) to the estimator's pending
+    registry key."""
+
+    def __init__(
+        self,
+        estimators: dict,
+        workload_key_fn: Optional[Callable[[str, str, str], str]] = None,
+        port: int = 0,
+        max_workers: int = 16,
+    ):
+        self.estimators = estimators
+        self.workload_key_fn = workload_key_fn or (lambda k, ns, n: f"{k}/{ns}/{n}")
+        self._server = grpc.server(futures.ThreadPoolExecutor(max_workers=max_workers))
+        handlers = {
+            "MaxAvailableReplicas": grpc.unary_unary_rpc_method_handler(
+                self._max_available,
+                request_deserializer=pb.MaxAvailableReplicasRequest.FromString,
+                response_serializer=pb.MaxAvailableReplicasResponse.SerializeToString,
+            ),
+            "GetUnschedulableReplicas": grpc.unary_unary_rpc_method_handler(
+                self._unschedulable,
+                request_deserializer=pb.UnschedulableReplicasRequest.FromString,
+                response_serializer=pb.UnschedulableReplicasResponse.SerializeToString,
+            ),
+        }
+        self._server.add_generic_rpc_handlers(
+            (grpc.method_handlers_generic_handler(_SERVICE, handlers),)
+        )
+        self.port = self._server.add_insecure_port(f"127.0.0.1:{port}")
+
+    def start(self, warm: bool = True) -> int:
+        if warm:
+            # Pre-compile each estimator's kernel so the first RPC doesn't
+            # spend its deadline on XLA compilation (the reference's 3s
+            # default --scheduler-estimator-timeout would trip too).
+            for est in self.estimators.values():
+                est.max_available_replicas(
+                    ReplicaRequirements(resource_request={"cpu": 0.001})
+                )
+        self._server.start()
+        return self.port
+
+    def stop(self, grace: float = 0.5) -> None:
+        self._server.stop(grace)
+
+    # -- handlers ---------------------------------------------------------
+
+    def _max_available(self, request: pb.MaxAvailableReplicasRequest, context):
+        est = self.estimators.get(request.cluster)
+        if est is None:
+            context.abort(grpc.StatusCode.NOT_FOUND, f"unknown cluster {request.cluster}")
+        requirements = requirements_from_pb(request.replicaRequirements)
+        return pb.MaxAvailableReplicasResponse(
+            maxReplicas=est.max_available_replicas(requirements)
+        )
+
+    def _unschedulable(self, request: pb.UnschedulableReplicasRequest, context):
+        est = self.estimators.get(request.cluster)
+        if est is None:
+            context.abort(grpc.StatusCode.NOT_FOUND, f"unknown cluster {request.cluster}")
+        key = self.workload_key_fn(
+            request.resource.kind, request.resource.namespace, request.resource.name
+        )
+        return pb.UnschedulableReplicasResponse(
+            unschedulableReplicas=est.get_unschedulable_replicas(
+                key, float(request.unschedulableThreshold)
+            )
+        )
+
+
+class GrpcSchedulerEstimator:
+    """Client: ReplicaEstimator + UnschedulableReplicaEstimator over gRPC
+    (EST3). One cached channel per cluster service address; concurrent
+    fan-out with shared timeout; errors → -1 sentinel."""
+
+    def __init__(self, address_for: Callable[[str], Optional[str]], timeout: float = 5.0):
+        self.address_for = address_for
+        self.timeout = timeout
+        self._channels: dict[str, grpc.Channel] = {}
+        self._pool = ThreadPoolExecutor(max_workers=16)
+
+    def _channel(self, cluster: str) -> Optional[grpc.Channel]:
+        addr = self.address_for(cluster)
+        if addr is None:
+            return None
+        ch = self._channels.get(addr)
+        if ch is None:
+            ch = grpc.insecure_channel(addr)
+            self._channels[addr] = ch
+        return ch
+
+    def max_available_replicas(self, clusters, requirements, replicas) -> list[int]:
+        req_pb = requirements_to_pb(requirements)
+
+        def one(cluster: str) -> int:
+            ch = self._channel(cluster)
+            if ch is None:
+                return UNAUTHENTIC_REPLICA
+            try:
+                resp = ch.unary_unary(
+                    METHOD_MAX_AVAILABLE,
+                    request_serializer=pb.MaxAvailableReplicasRequest.SerializeToString,
+                    response_deserializer=pb.MaxAvailableReplicasResponse.FromString,
+                )(
+                    pb.MaxAvailableReplicasRequest(
+                        cluster=cluster, replicaRequirements=req_pb
+                    ),
+                    timeout=self.timeout,
+                )
+                return resp.maxReplicas
+            except grpc.RpcError:
+                return UNAUTHENTIC_REPLICA
+
+        return list(self._pool.map(one, clusters))
+
+    def get_unschedulable_replicas(self, clusters, workload_key, threshold_seconds) -> list[int]:
+        kind, ns, name = (workload_key.split("/", 2) + ["", ""])[:3]
+
+        def one(cluster: str) -> int:
+            ch = self._channel(cluster)
+            if ch is None:
+                return UNAUTHENTIC_REPLICA
+            try:
+                resp = ch.unary_unary(
+                    METHOD_UNSCHEDULABLE,
+                    request_serializer=pb.UnschedulableReplicasRequest.SerializeToString,
+                    response_deserializer=pb.UnschedulableReplicasResponse.FromString,
+                )(
+                    pb.UnschedulableReplicasRequest(
+                        cluster=cluster,
+                        resource=pb.ObjectReference(kind=kind, namespace=ns, name=name),
+                        unschedulableThreshold=int(threshold_seconds),
+                    ),
+                    timeout=self.timeout,
+                )
+                return resp.unschedulableReplicas
+            except grpc.RpcError:
+                return UNAUTHENTIC_REPLICA
+
+        return list(self._pool.map(one, clusters))

@@ -26,12 +26,16 @@ class MemberConfig:
     allocatable: Resources = field(default_factory=dict)
     allocated: Resources = field(default_factory=dict)
     sync_mode: str = "Push"
+    # When set, the member simulates node-level pod placement and exposes an
+    # AccurateEstimator (the per-member scheduler-estimator daemon).
+    nodes: Optional[list] = None  # list[NodeSpec]
 
 
 class InMemoryMember:
     """One member cluster: apply/delete manifests; workload controllers are
     simulated synchronously (a Deployment becomes Ready on apply unless the
-    member is unhealthy or a failure is injected)."""
+    member is unhealthy or a failure is injected). With `config.nodes`, ready
+    counts come from greedy pod placement over real node capacity."""
 
     def __init__(self, config: MemberConfig):
         self.config = config
@@ -39,6 +43,11 @@ class InMemoryMember:
         self.healthy = True
         # kinds that never become ready on this member (failure injection)
         self.failing_kinds: set[str] = set()
+        self.node_estimator = None
+        if config.nodes:
+            from ..estimator.accurate import AccurateEstimator
+
+            self.node_estimator = AccurateEstimator(config.nodes)
 
     @property
     def name(self) -> str:
@@ -51,6 +60,10 @@ class InMemoryMember:
         return self.store.get(gvk_of(applied), applied.name, applied.namespace)
 
     def delete_manifest(self, api_version: str, kind: str, namespace: str, name: str) -> None:
+        if self.node_estimator is not None:
+            # kind-qualified key: deleting e.g. a same-named Service must not
+            # free a Deployment's placed pods
+            self.node_estimator.unplace(f"{kind}/{namespace}/{name}")
         self.store.delete(f"{api_version}/{kind}", name, namespace)
 
     def get(self, api_version: str, kind: str, name: str, namespace: str = "") -> Optional[Unstructured]:
@@ -63,7 +76,21 @@ class InMemoryMember:
         ok = self.healthy and obj.kind not in self.failing_kinds
         if obj.kind in ("Deployment", "StatefulSet"):
             replicas = int(fresh.get("spec", "replicas", default=1) or 0)
-            ready = replicas if ok else 0
+            fit = replicas
+            if self.node_estimator is not None:
+                from ..interpreter.interpreter import _pod_template_requirements
+
+                rr = _pod_template_requirements(
+                    fresh.get("spec", "template", "spec", default={}) or {},
+                    fresh.namespace,
+                )
+                fit = self.node_estimator.place(
+                    f"{fresh.kind}/{fresh.namespace}/{fresh.name}",
+                    replicas,
+                    rr.resource_request,
+                    claim=rr.node_claim,
+                )
+            ready = fit if ok else 0
             fresh.status = {
                 "observedGeneration": fresh.metadata.generation,
                 "replicas": replicas,
@@ -71,6 +98,8 @@ class InMemoryMember:
                 "availableReplicas": ready,
                 "updatedReplicas": replicas,
             }
+            if fit < replicas:
+                fresh.status["unavailableReplicas"] = replicas - fit
             self.store.update(fresh)
         elif obj.kind == "Job":
             parallelism = int(fresh.get("spec", "parallelism", default=1) or 0)

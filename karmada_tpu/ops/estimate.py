@@ -1,0 +1,74 @@
+"""Node-level replica estimation kernels.
+
+TPU reframing of the karmada-scheduler-estimator's core math
+(pkg/estimator/server/estimate.go:59-112): answer = Σ over feasible nodes of
+min(min over requested resources floor((allocatable − requested) / request),
+allowed_pods − pod_count), where node feasibility = NodeAffinity +
+toleration match. The reference parallelizes over nodes with goroutines
+(parallelizer.Until, HOT LOOP 3); here the whole fleet's nodes are one array
+and every binding × node pair is computed in a single fused program, reduced
+per cluster with a segment-sum.
+
+The 500-node/10k-pod and 5000-node/100k-pod benchmark fixtures
+(server_test.go:265-312) map to a single [B, N_total] kernel invocation.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+I32_MAX = jnp.int64(2**31 - 1)
+
+
+def node_available_replicas(
+    alloc,  # i64[N,R] node allocatable (integer units)
+    requested,  # i64[N,R] Σ pod requests per node (pods resource excluded)
+    pod_count,  # i32[N] number of pods on the node
+    allowed_pods,  # i64[N] allocatable pod slots
+    request,  # i64[B,R] per-replica request
+    node_ok,  # bool[B,N] affinity + toleration feasibility
+):
+    """per_node[b,n] = nodeMaxAvailableReplica (estimate.go:104-112)."""
+    rest = alloc - requested  # i64[N,R]
+    has_req = request > 0  # [B,R]
+    req = jnp.maximum(request, 1)[:, None, :]  # [B,1,R]
+    per_res = jnp.where(has_req[:, None, :], rest[None, :, :] // req, I32_MAX)
+    per_node = per_res.min(-1)  # [B,N]
+    pods_left = jnp.maximum(allowed_pods - pod_count.astype(jnp.int64), 0)  # [N]
+    per_node = jnp.minimum(per_node, pods_left[None, :])
+    per_node = jnp.clip(per_node, 0, I32_MAX)
+    return jnp.where(node_ok, per_node, 0)
+
+
+def cluster_estimate(
+    alloc, requested, pod_count, allowed_pods, request, node_ok
+):
+    """MaxAvailableReplicas for ONE cluster: i32[B] (estimateReplicas sum)."""
+    per_node = node_available_replicas(
+        alloc, requested, pod_count, allowed_pods, request, node_ok
+    )
+    return jnp.clip(per_node.sum(-1), 0, I32_MAX).astype(jnp.int32)
+
+
+def fleet_estimate(
+    alloc,  # i64[N,R] ALL clusters' nodes flattened
+    requested,
+    pod_count,
+    allowed_pods,
+    cluster_id,  # i32[N] owning cluster index
+    request,  # i64[B,R]
+    node_ok,  # bool[B,N]
+    num_clusters: int,
+):
+    """The whole fleet's node-level estimates in one pass: i32[B,C].
+
+    This is the seam where 'per-member estimator daemon' becomes a
+    device-resident column of the scheduling matrix (SURVEY §5: the capacity
+    matrix refresh)."""
+    per_node = node_available_replicas(
+        alloc, requested, pod_count, allowed_pods, request, node_ok
+    )
+    sums = jax.vmap(
+        lambda row: jax.ops.segment_sum(row, cluster_id, num_segments=num_clusters)
+    )(per_node)
+    return jnp.clip(sums, 0, I32_MAX).astype(jnp.int32)

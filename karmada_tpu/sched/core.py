@@ -76,6 +76,7 @@ def _schedule_kernel(
     prev_member,
     prev_replicas,
     tie,
+    extra_avail,  # i32[B,C] min-merged registered-estimator answers; -1 = none
 ):
     taint_mask = filter_ops.taint_toleration_mask(
         taint_key, taint_value, taint_effect, tol_key, tol_value, tol_effect, tol_op
@@ -91,6 +92,9 @@ def _schedule_kernel(
     # missing allocatable key: 0 available everywhere (general.go:166-169).
     avail = assign_ops.general_estimate(capacity, has_summary, request, replicas)
     avail = jnp.where(unknown_request[:, None], 0, avail)
+    # min-merge with registered estimators (-1 sentinel discarded,
+    # core/util.go:72-92); gRPC/node-level answers tighten the general bound
+    avail = jnp.where(extra_avail >= 0, jnp.minimum(avail, extra_avail), avail)
 
     # All strategies computed batched, row-selected by strategy code.
     dup = assign_ops.duplicated_assign(feasible, replicas)
@@ -169,7 +173,11 @@ class ArrayScheduler:
             tie=pz(batch.tie),
         )
 
-    def run_kernel(self, batch: BindingBatch):
+    def run_kernel(self, batch: BindingBatch, extra_avail=None):
+        if extra_avail is None:
+            extra_avail = np.full(
+                (len(batch.replicas), len(self.fleet.names)), -1, np.int32
+            )
         f = self.fleet
         return _schedule_kernel(
             f.alive,
@@ -195,15 +203,19 @@ class ArrayScheduler:
             batch.prev_member,
             batch.prev_replicas,
             batch.tie,
+            extra_avail,
         )
 
-    def schedule(self, bindings: Sequence) -> list[ScheduleDecision]:
+    def schedule(self, bindings: Sequence, extra_avail=None) -> list[ScheduleDecision]:
         if not bindings:
             return []
         raw = self.batch_encoder.encode(bindings)
         batch = self._pad(raw)
+        if extra_avail is not None and len(extra_avail) < len(batch.replicas):
+            pad = len(batch.replicas) - len(extra_avail)
+            extra_avail = np.pad(extra_avail, [(0, pad), (0, 0)], constant_values=-1)
         feasible, score, result, unsched, avail_sum = jax.tree.map(
-            np.asarray, self.run_kernel(batch)
+            np.asarray, self.run_kernel(batch, extra_avail)
         )
         names = self.fleet.names
         out: list[ScheduleDecision] = []

@@ -46,10 +46,12 @@ class SchedulerDaemon:
         store: Store,
         runtime: Runtime,
         scheduler_name: str = DEFAULT_SCHEDULER_NAME,
+        estimator_registry=None,
     ) -> None:
         self.store = store
         self.clock = runtime.clock
         self.scheduler_name = scheduler_name
+        self.estimator_registry = estimator_registry
         self._array: Optional[ArrayScheduler] = None
         self._fleet_dirty = True
         self.controller = runtime.register(
@@ -125,7 +127,12 @@ class SchedulerDaemon:
         if not bindings:
             return []
         array = self._ensure_fleet()
-        decisions = array.schedule(bindings)
+        extra_avail = None
+        if self.estimator_registry is not None:
+            extra_avail = self.estimator_registry.batch_estimates(
+                bindings, array.fleet.names
+            )
+        decisions = array.schedule(bindings, extra_avail=extra_avail)
         for rb, decision in zip(bindings, decisions):
             self._patch_result(rb, decision)
         return []
