@@ -95,13 +95,10 @@ class Descheduler:
         ]
         if not undesired:
             return False
-        workload_key = (
-            f"{rb.spec.resource.kind}/{rb.spec.resource.namespace}/{rb.spec.resource.name}"
-        )
         unschedulable = dict(
             zip(
                 undesired,
-                self.registry.min_unschedulable(undesired, workload_key, self.threshold),
+                self.registry.min_unschedulable(undesired, rb.spec.resource, self.threshold),
             )
         )
         new_clusters = []

@@ -39,21 +39,30 @@ class AccurateEstimator:
         self.arrays: NodeArrays = self.encoder.encode(self.specs)
         # pods placed per workload key: list of (node_idx, count, req_vec)
         self._pods: dict[str, list[tuple[int, int, np.ndarray]]] = {}
+        self._node_ok_cache: dict[str, np.ndarray] = {}
         self._pending: dict[str, tuple[int, float]] = {}  # key -> (count, since)
         self._estimate = jax.jit(cluster_estimate)
 
     # -- estimation (the gRPC answer) -------------------------------------
 
     def _node_ok(self, requirements: Optional[ReplicaRequirements]) -> np.ndarray:
+        """Claim → node feasibility mask, deduped per distinct claim (most
+        rows in a batch share a claim — typically None); node labels/taints
+        are fixed at construction so the cache never invalidates."""
+        claim = requirements.node_claim if requirements else None
+        key = repr(claim)
+        cached = self._node_ok_cache.get(key)
+        if cached is not None:
+            return cached
         N = self.arrays.n_nodes
         ok = np.ones(N, bool)
-        claim = requirements.node_claim if requirements else None
         tolerations = claim.tolerations if claim else []
         for i, spec in enumerate(self.specs):
             if not node_claim_matches(claim, spec.labels):
                 ok[i] = False
             elif not tolerations_cover_node_taints(tolerations, spec.taints):
                 ok[i] = False
+        self._node_ok_cache[key] = ok
         return ok
 
     def max_available_replicas(self, requirements: Optional[ReplicaRequirements]) -> int:

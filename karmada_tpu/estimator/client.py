@@ -33,8 +33,10 @@ class ReplicaEstimator(Protocol):
 
 class UnschedulableReplicaEstimator(Protocol):
     def get_unschedulable_replicas(
-        self, clusters: Sequence[str], workload_key: str, threshold_seconds: float
+        self, clusters: Sequence[str], resource, threshold_seconds: float
     ) -> list[int]:
+        """resource: api/work.ObjectReference (full GVK+name — the gRPC wire
+        needs apiVersion for a stock Go server to resolve the workload)."""
         ...
 
 
@@ -76,6 +78,13 @@ class EstimatorRegistry:
             for b, rb in enumerate(bindings)
             if strategy_code(rb.spec.placement, rb.spec.replicas)
             in (DYNAMIC_WEIGHT, AGGREGATED)
+            # spread-constrained rows need availability for group scoring
+            # regardless of strategy (group_clusters.go:143-330)
+            or (
+                rb.spec.placement is not None
+                and rb.spec.placement.spread_constraints
+                and rb.spec.replicas > 0
+            )
         ]
         if not dyn_rows:
             return None
@@ -109,7 +118,7 @@ class EstimatorRegistry:
     def min_unschedulable(
         self,
         clusters: Sequence[str],
-        workload_key: str,
+        resource,
         threshold_seconds: float,
     ) -> list[int]:
         """Min across unschedulable estimators (descheduler/core/helper.go:62-96)."""
@@ -117,7 +126,7 @@ class EstimatorRegistry:
         merged = [np.iinfo(np.int32).max] * C
         authentic = [False] * C
         for est in self.unschedulable_estimators.values():
-            res = est.get_unschedulable_replicas(clusters, workload_key, threshold_seconds)
+            res = est.get_unschedulable_replicas(clusters, resource, threshold_seconds)
             for i, v in enumerate(res):
                 if v != UNAUTHENTIC_REPLICA:
                     merged[i] = min(merged[i], v)
@@ -161,11 +170,13 @@ class MemberEstimators:
         columns = list(self._pool.map(one, clusters))  # [C][B]
         return [[columns[c][b] for c in range(len(clusters))] for b in range(len(requirements_list))]
 
-    def get_unschedulable_replicas(self, clusters, workload_key, threshold_seconds) -> list[int]:
+    def get_unschedulable_replicas(self, clusters, resource, threshold_seconds) -> list[int]:
+        key = f"{resource.kind}/{resource.namespace}/{resource.name}"
+
         def one(cluster: str) -> int:
             est = self._estimator_for(cluster)
             if est is None:
                 return UNAUTHENTIC_REPLICA
-            return est.get_unschedulable_replicas(workload_key, threshold_seconds)
+            return est.get_unschedulable_replicas(key, threshold_seconds)
 
         return list(self._pool.map(one, clusters))

@@ -178,10 +178,11 @@ class EstimatorServer:
         key = self.workload_key_fn(
             request.resource.kind, request.resource.namespace, request.resource.name
         )
+        # unschedulableThreshold is a time.Duration on the wire (nanoseconds,
+        # pb/types.go casttype) — a stock Go descheduler sends 5m as 3e11 ns
+        threshold_seconds = float(request.unschedulableThreshold) / 1e9
         return pb.UnschedulableReplicasResponse(
-            unschedulableReplicas=est.get_unschedulable_replicas(
-                key, float(request.unschedulableThreshold)
-            )
+            unschedulableReplicas=est.get_unschedulable_replicas(key, threshold_seconds)
         )
 
 
@@ -230,8 +231,10 @@ class GrpcSchedulerEstimator:
 
         return list(self._pool.map(one, clusters))
 
-    def get_unschedulable_replicas(self, clusters, workload_key, threshold_seconds) -> list[int]:
-        kind, ns, name = (workload_key.split("/", 2) + ["", ""])[:3]
+    def get_unschedulable_replicas(self, clusters, resource, threshold_seconds) -> list[int]:
+        """resource: api/work.ObjectReference — the full reference travels on
+        the wire (a stock Go server resolves the workload via
+        FromAPIVersionAndKind, server.go:255, so apiVersion is mandatory)."""
 
         def one(cluster: str) -> int:
             ch = self._channel(cluster)
@@ -245,8 +248,14 @@ class GrpcSchedulerEstimator:
                 )(
                     pb.UnschedulableReplicasRequest(
                         cluster=cluster,
-                        resource=pb.ObjectReference(kind=kind, namespace=ns, name=name),
-                        unschedulableThreshold=int(threshold_seconds),
+                        resource=pb.ObjectReference(
+                            apiVersion=resource.api_version,
+                            kind=resource.kind,
+                            namespace=resource.namespace,
+                            name=resource.name,
+                        ),
+                        # time.Duration: seconds → nanoseconds on the wire
+                        unschedulableThreshold=int(threshold_seconds * 1e9),
                     ),
                     timeout=self.timeout,
                 )

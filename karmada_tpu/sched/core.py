@@ -117,7 +117,37 @@ def _schedule_kernel(
     is_dyn = (strategy == DYNAMIC_WEIGHT) | (strategy == AGGREGATED)
     result = jnp.where(is_dyn[:, None], dyn.result, result)
     unschedulable = is_dyn & dyn.unschedulable
-    return feasible, score, result, unschedulable, dyn.available_sum
+    return feasible, score, result, unschedulable, dyn.available_sum, avail
+
+
+def _restrict_rows(batch: BindingBatch, rows: list[int], affinity_override: np.ndarray) -> BindingBatch:
+    """Row-subset of a batch with the spread-selection mask folded into the
+    affinity mask (phase-2 candidate restriction)."""
+    idx = np.asarray(rows)
+
+    def take(a):
+        return a[idx]
+
+    return BindingBatch(
+        keys=[batch.keys[b] for b in rows],
+        uids=[batch.uids[b] for b in rows],
+        replicas=take(batch.replicas),
+        request=take(batch.request),
+        unknown_request=take(batch.unknown_request),
+        gvk=take(batch.gvk),
+        strategy=take(batch.strategy),
+        fresh=take(batch.fresh),
+        tol_key=take(batch.tol_key),
+        tol_value=take(batch.tol_value),
+        tol_effect=take(batch.tol_effect),
+        tol_op=take(batch.tol_op),
+        affinity_ok=affinity_override[idx],
+        eviction_ok=take(batch.eviction_ok),
+        static_weight=take(batch.static_weight),
+        prev_member=take(batch.prev_member),
+        prev_replicas=take(batch.prev_replicas),
+        tie=take(batch.tie),
+    )
 
 
 class ArrayScheduler:
@@ -214,9 +244,68 @@ class ArrayScheduler:
         if extra_avail is not None and len(extra_avail) < len(batch.replicas):
             pad = len(batch.replicas) - len(extra_avail)
             extra_avail = np.pad(extra_avail, [(0, pad), (0, 0)], constant_values=-1)
-        feasible, score, result, unsched, avail_sum = jax.tree.map(
-            np.asarray, self.run_kernel(batch, extra_avail)
+        feasible, score, result, unsched, avail_sum, avail = (
+            np.array(x) for x in self.run_kernel(batch, extra_avail)
         )
+
+        # Phase 2: spread-constrained rows restrict candidates via the host
+        # combinatorial selection (SelectClusters, common.go:32-39), then the
+        # assignment kernel re-runs over the restricted feasible set.
+        spread_errors: dict[int, str] = {}
+        spread_rows: list[int] = []
+        for b, rb in enumerate(bindings):
+            placement = rb.spec.placement
+            if placement is not None and placement.spread_constraints and feasible[b].any():
+                spread_rows.append(b)
+        if spread_rows:
+            from . import spread as spread_mod
+
+            sub_affinity = raw.affinity_ok.copy()
+            live_rows = []
+            for b in spread_rows:
+                rb = bindings[b]
+                details = [
+                    spread_mod.ClusterDetail(
+                        name=self.fleet.names[i],
+                        index=int(i),
+                        score=int(score[b, i]),
+                        available=int(avail[b, i]) + int(raw.prev_replicas[b, i]),
+                        region=self.clusters[i].spec.region,
+                        zone=self.clusters[i].spec.zone,
+                        provider=self.clusters[i].spec.provider,
+                    )
+                    for i in np.nonzero(feasible[b])[0]
+                ]
+                try:
+                    selected = spread_mod.select_clusters_by_spread(
+                        details, rb.spec.placement, rb.spec.replicas
+                    )
+                except spread_mod.SpreadError as e:
+                    spread_errors[b] = str(e)
+                    continue
+                mask = np.zeros(len(self.fleet.names), bool)
+                mask[[d.index for d in selected]] = True
+                sub_affinity[b] &= mask
+                live_rows.append(b)
+            if live_rows:
+                sub = _restrict_rows(raw, live_rows, sub_affinity)
+                sub_batch = self._pad(sub)
+                sub_extra = None
+                if extra_avail is not None:
+                    sub_extra = extra_avail[live_rows]
+                    pad = len(sub_batch.replicas) - len(sub_extra)
+                    if pad:
+                        sub_extra = np.pad(sub_extra, [(0, pad), (0, 0)], constant_values=-1)
+                s_feas, s_score, s_result, s_unsched, s_avail_sum, _ = jax.tree.map(
+                    np.asarray, self.run_kernel(sub_batch, sub_extra)
+                )
+                for j, b in enumerate(live_rows):
+                    feasible[b] = s_feas[j]
+                    score[b] = s_score[j]
+                    result[b] = s_result[j]
+                    unsched[b] = s_unsched[j]
+                    avail_sum[b] = s_avail_sum[j]
+
         names = self.fleet.names
         out: list[ScheduleDecision] = []
         for b, key in enumerate(raw.keys):
@@ -224,6 +313,10 @@ class ArrayScheduler:
             dec = ScheduleDecision(
                 key=key, feasible=[names[i] for i in feas_idx], score=score[b]
             )
+            if b in spread_errors:
+                dec.error = spread_errors[b]
+                out.append(dec)
+                continue
             if feas_idx.size == 0:
                 # FitError diagnosis (generic_scheduler.go:83-88)
                 dec.error = f"0/{len(names)} clusters are available"

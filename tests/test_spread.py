@@ -1,0 +1,163 @@
+"""SpreadConstraint selection (BASELINE config 4: multi-dim HA)."""
+import pytest
+
+from karmada_tpu.api.meta import CPU, MEMORY
+from karmada_tpu.api.policy import (
+    ClusterAffinity,
+    Placement,
+    REPLICA_SCHEDULING_DIVIDED,
+    ReplicaSchedulingStrategy,
+    SPREAD_BY_FIELD_CLUSTER,
+    SPREAD_BY_FIELD_REGION,
+    SpreadConstraint,
+)
+from karmada_tpu.sched import spread
+from karmada_tpu.sched.core import ArrayScheduler
+from karmada_tpu.testing.fixtures import new_cluster_with_resource
+from tests.test_scheduler_core import make_binding, targets_dict
+
+GiB = 1024.0**3
+
+
+def detail(name, idx, score, avail, region=""):
+    return spread.ClusterDetail(name=name, index=idx, score=score, available=avail, region=region)
+
+
+class TestSelectByCluster:
+    def test_max_groups_picks_top_scored(self):
+        details = [detail("a", 0, 100, 10), detail("b", 1, 50, 10), detail("c", 2, 0, 10)]
+        c = SpreadConstraint(spread_by_field=SPREAD_BY_FIELD_CLUSTER, min_groups=1, max_groups=2)
+        out = spread._select_by_cluster(c, spread.sort_details(details), spread.INVALID_REPLICAS)
+        assert [d.name for d in out] == ["a", "b"]
+
+    def test_capacity_swap_repair(self):
+        # reference example (select_clusters_by_cluster.go:58-65): scores
+        # 60/50/40, avail 40/30/60, need 2 clusters x 80 replicas → m1+m3
+        details = [detail("m1", 0, 60, 40), detail("m2", 1, 50, 30), detail("m3", 2, 40, 60)]
+        c = SpreadConstraint(spread_by_field=SPREAD_BY_FIELD_CLUSTER, min_groups=2, max_groups=2)
+        out = spread._select_by_cluster(c, spread.sort_details(details), 80)
+        assert {d.name for d in out} == {"m1", "m3"}
+
+    def test_min_groups_violation(self):
+        c = SpreadConstraint(spread_by_field=SPREAD_BY_FIELD_CLUSTER, min_groups=3, max_groups=3)
+        with pytest.raises(spread.SpreadError, match="less than spreadConstraint.MinGroups"):
+            spread._select_by_cluster(c, [detail("a", 0, 0, 5)], 5)
+
+    def test_not_enough_capacity(self):
+        c = SpreadConstraint(spread_by_field=SPREAD_BY_FIELD_CLUSTER, min_groups=1, max_groups=1)
+        with pytest.raises(spread.SpreadError, match="no enough resource"):
+            spread._select_by_cluster(c, [detail("a", 0, 0, 5), detail("b", 1, 0, 4)], 100)
+
+
+class TestGroupScores:
+    def test_duplicated_score_reference_example(self):
+        # group_clusters.go:160-186: replicas=50
+        g1 = [detail(f"m{i}", i, 100, a) for i, a in enumerate([60, 70, 40, 30, 10])]
+        g2 = [detail(f"n{i}", i, 0, a) for i, a in enumerate([60, 60, 60, 60])]
+        assert spread.calc_group_score_duplicated(g1, 50) == 2100
+        assert spread.calc_group_score_duplicated(g2, 50) == 4000
+
+    def test_divided_score_reference_example(self):
+        # group_clusters.go:268-297: replicas=100, group minGroups=2, cluster minGroups=2
+        g1 = [detail(f"m{i}", i, 100, a) for i, a in enumerate([10, 10, 10, 10, 5])]
+        g2 = [detail(f"n{i}", i, 0, a) for i, a in enumerate([40, 30, 10, 10])]
+        assert spread.calc_group_score_divided(g1, 100, 2, 2) == 45100
+        assert spread.calc_group_score_divided(g2, 100, 2, 2) == 50000
+
+
+class TestDfs:
+    def test_feasible_paths_and_subpath_preference(self):
+        groups = [
+            spread._Group(name=f"g{v}", value=v, weight=w)
+            for v, w in [(2, 10), (3, 10), (6, 5), (7, 1)]
+        ]
+        # target=7 clusters, exactly 2 regions
+        out = spread._select_groups(groups, 2, 2, 7)
+        # highest total weight combos covering 7: (2,3)=5 clusters<7 not
+        # feasible; feasible pairs: (2,6)=8,(3,6)=9,(2,7),(3,7),(6,7)
+        # weights: (2,6)=15,(3,6)=15,(2,7)=11,(3,7)=11,(6,7)=6 → tie 15;
+        # value desc: (3,6)=9 > (2,6)=8 → pick {g3,g6}
+        assert {g.name for g in out} == {"g3", "g6"}
+
+
+def region_fleet():
+    clusters = []
+    for r in range(4):
+        for i in range(3):
+            clusters.append(
+                new_cluster_with_resource(
+                    f"r{r}-m{i}",
+                    {CPU: 20.0 * (i + 1), MEMORY: 80 * GiB * (i + 1)},
+                    region=f"region-{r}",
+                    zone=f"region-{r}-z{i}",
+                )
+            )
+    return clusters
+
+
+class TestEndToEndSpread:
+    def test_region_spread_duplicated(self):
+        sched = ArrayScheduler(region_fleet())
+        p = Placement(
+            cluster_affinity=ClusterAffinity(),
+            spread_constraints=[
+                SpreadConstraint(spread_by_field=SPREAD_BY_FIELD_REGION, min_groups=2, max_groups=2),
+                SpreadConstraint(spread_by_field=SPREAD_BY_FIELD_CLUSTER, min_groups=2, max_groups=2),
+            ],
+        )
+        rb = make_binding("ha", 5, p, cpu=1.0)
+        (d,) = sched.schedule([rb])
+        t = targets_dict(d)
+        assert len(t) == 2
+        regions = {n.split("-m")[0] for n in t}
+        assert len(regions) == 2  # spread across two regions
+        assert all(v == 5 for v in t.values())  # duplicated
+
+    def test_region_spread_divided_dynamic(self):
+        sched = ArrayScheduler(region_fleet())
+        p = Placement(
+            cluster_affinity=ClusterAffinity(),
+            spread_constraints=[
+                SpreadConstraint(spread_by_field=SPREAD_BY_FIELD_REGION, min_groups=2, max_groups=3),
+                SpreadConstraint(spread_by_field=SPREAD_BY_FIELD_CLUSTER, min_groups=2, max_groups=4),
+            ],
+            replica_scheduling=ReplicaSchedulingStrategy(
+                replica_scheduling_type=REPLICA_SCHEDULING_DIVIDED,
+                replica_division_preference="Aggregated",
+            ),
+        )
+        rb = make_binding("web", 40, p, cpu=1.0)
+        (d,) = sched.schedule([rb])
+        t = targets_dict(d)
+        assert sum(t.values()) == 40
+        assert len(t) <= 4
+        # Spread constraints restrict the CANDIDATE set (selection spans >=2
+        # regions); Aggregated assignment may then legally pack into fewer
+        # regions — the candidate pool is what must satisfy the constraint.
+        candidate_regions = {n.split("-m")[0] for n in d.feasible}
+        assert len(candidate_regions) >= 2
+        assert all(n in d.feasible for n in t)
+
+    def test_spread_unsatisfiable(self):
+        sched = ArrayScheduler(region_fleet()[:3])  # one region only
+        p = Placement(
+            cluster_affinity=ClusterAffinity(),
+            spread_constraints=[
+                SpreadConstraint(spread_by_field=SPREAD_BY_FIELD_REGION, min_groups=2),
+            ],
+        )
+        rb = make_binding("ha", 2, p, cpu=1.0)
+        (d,) = sched.schedule([rb])
+        assert not d.ok and "feasible region" in d.error
+
+    def test_provider_only_constraint_rejected(self):
+        sched = ArrayScheduler(region_fleet())
+        p = Placement(
+            cluster_affinity=ClusterAffinity(),
+            spread_constraints=[
+                SpreadConstraint(spread_by_field="provider", min_groups=1),
+            ],
+        )
+        rb = make_binding("x", 1, p, cpu=1.0)
+        (d,) = sched.schedule([rb])
+        assert not d.ok and "just support cluster and region" in d.error
