@@ -1,0 +1,164 @@
+"""North-star benchmark (BASELINE.md): schedule 10k ResourceBindings over 5k
+member clusters in one batched device solve, target < 1 s p99 on TPU v5e-1.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+value = p99 latency in seconds of the full schedule round (device solve over
+the encoded batch, results materialized on host). vs_baseline = baseline
+target (1.0 s) / measured — >1.0 means faster than the target envelope.
+
+The reference has no batched path at all (SURVEY §6): its per-binding loop
+pays an O(C) snapshot deep-copy + sequential filter/score per binding
+(cache/cache.go:62-77, generic_scheduler.go:118-172).
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import time
+
+import numpy as np
+
+BASELINE_P99_S = 1.0  # BASELINE.json: 10k x 5k < 1 s p99
+
+
+def build_problem(n_clusters: int, n_bindings: int, seed: int = 0):
+    from karmada_tpu.api.meta import CPU, ObjectMeta, new_uid
+    from karmada_tpu.api.policy import (
+        ClusterAffinity,
+        ClusterPreferences,
+        DIVISION_PREFERENCE_AGGREGATED,
+        DIVISION_PREFERENCE_WEIGHTED,
+        DYNAMIC_WEIGHT_AVAILABLE_REPLICAS,
+        Placement,
+        REPLICA_SCHEDULING_DIVIDED,
+        ReplicaSchedulingStrategy,
+    )
+    from karmada_tpu.api.work import (
+        BindingSpec,
+        ObjectReference,
+        ReplicaRequirements,
+        ResourceBinding,
+        TargetCluster,
+    )
+    from karmada_tpu.sched.core import ArrayScheduler
+    from karmada_tpu.testing.fixtures import (
+        duplicated_placement,
+        static_weight_placement,
+        synthetic_fleet,
+    )
+
+    rng = np.random.default_rng(seed)
+    clusters = synthetic_fleet(n_clusters, seed=seed)
+    names = [c.name for c in clusters]
+
+    # a handful of distinct placements shared across bindings (realistic:
+    # policies are few, bindings are many; affinity masks dedup per policy)
+    dyn_w = Placement(
+        cluster_affinity=ClusterAffinity(cluster_names=[]),
+        replica_scheduling=ReplicaSchedulingStrategy(
+            replica_scheduling_type=REPLICA_SCHEDULING_DIVIDED,
+            replica_division_preference=DIVISION_PREFERENCE_WEIGHTED,
+            weight_preference=ClusterPreferences(
+                dynamic_weight=DYNAMIC_WEIGHT_AVAILABLE_REPLICAS
+            ),
+        ),
+    )
+    dyn_a = Placement(
+        cluster_affinity=ClusterAffinity(cluster_names=[]),
+        replica_scheduling=ReplicaSchedulingStrategy(
+            replica_scheduling_type=REPLICA_SCHEDULING_DIVIDED,
+            replica_division_preference=DIVISION_PREFERENCE_AGGREGATED,
+        ),
+    )
+    placements = [
+        duplicated_placement(names[:16]),
+        static_weight_placement({names[j]: j + 1 for j in range(8)}),
+        dyn_w,
+        dyn_a,
+    ]
+
+    bindings = []
+    for i in range(n_bindings):
+        prev = (
+            [TargetCluster(name=names[int(rng.integers(n_clusters))], replicas=2)]
+            if i % 3 == 0
+            else []
+        )
+        bindings.append(
+            ResourceBinding(
+                metadata=ObjectMeta(namespace="bench", name=f"app-{i}", uid=new_uid("rb")),
+                spec=BindingSpec(
+                    resource=ObjectReference(
+                        api_version="apps/v1", kind="Deployment",
+                        namespace="bench", name=f"app-{i}",
+                    ),
+                    replicas=int(rng.integers(1, 64)),
+                    replica_requirements=ReplicaRequirements(
+                        resource_request={CPU: float(rng.choice([0.1, 0.25, 0.5, 1.0]))}
+                    ),
+                    placement=placements[i % len(placements)],
+                    clusters=prev,
+                ),
+            )
+        )
+
+    sched = ArrayScheduler(clusters)
+    return sched, bindings
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--clusters", type=int, default=5000)
+    ap.add_argument("--bindings", type=int, default=10000)
+    ap.add_argument("--iters", type=int, default=10)
+    ap.add_argument("--verbose", action="store_true")
+    args = ap.parse_args()
+
+    import jax
+
+    t0 = time.perf_counter()
+    sched, bindings = build_problem(args.clusters, args.bindings)
+    t_build = time.perf_counter() - t0
+
+    t0 = time.perf_counter()
+    batch = sched._pad(sched.batch_encoder.encode(bindings))
+    t_encode = time.perf_counter() - t0
+
+    # compile + warm
+    t0 = time.perf_counter()
+    out = sched.run_kernel(batch)
+    jax.block_until_ready(out)
+    t_compile = time.perf_counter() - t0
+
+    lat = []
+    for _ in range(args.iters):
+        t0 = time.perf_counter()
+        out = sched.run_kernel(batch)
+        # materialize the decision tensors on host (the API-patch input)
+        _ = [np.asarray(x) for x in out[:4]]
+        lat.append(time.perf_counter() - t0)
+    lat.sort()
+    p50 = lat[len(lat) // 2]
+    p99 = lat[min(len(lat) - 1, int(np.ceil(0.99 * len(lat))) - 1)]
+
+    if args.verbose:
+        print(
+            f"# build={t_build:.2f}s encode={t_encode:.2f}s compile={t_compile:.2f}s "
+            f"p50={p50 * 1e3:.1f}ms p99={p99 * 1e3:.1f}ms "
+            f"({args.bindings}x{args.clusters}, {len(jax.devices())} dev "
+            f"{jax.devices()[0].device_kind})"
+        )
+    print(
+        json.dumps(
+            {
+                "metric": f"schedule_round_p99_{args.bindings}rb_x_{args.clusters}clusters",
+                "value": round(p99, 6),
+                "unit": "s",
+                "vs_baseline": round(BASELINE_P99_S / p99, 3),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

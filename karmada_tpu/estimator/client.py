@@ -68,6 +68,7 @@ class EstimatorRegistry:
         if not self.replica_estimators:
             return None
         from ..models.batch import AGGREGATED, DYNAMIC_WEIGHT, strategy_code
+        from ..sched.spread import should_ignore_spread_constraint
 
         B, C = len(bindings), len(clusters)
         # Only dynamic strategies consume availability; Duplicated/static
@@ -79,11 +80,13 @@ class EstimatorRegistry:
             if strategy_code(rb.spec.placement, rb.spec.replicas)
             in (DYNAMIC_WEIGHT, AGGREGATED)
             # spread-constrained rows need availability for group scoring
-            # regardless of strategy (group_clusters.go:143-330)
+            # regardless of strategy (group_clusters.go:143-330) — unless the
+            # constraint is statically ignored (select_clusters.go:63-77)
             or (
                 rb.spec.placement is not None
                 and rb.spec.placement.spread_constraints
                 and rb.spec.replicas > 0
+                and not should_ignore_spread_constraint(rb.spec.placement)
             )
         ]
         if not dyn_rows:

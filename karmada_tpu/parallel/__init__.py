@@ -1,0 +1,18 @@
+"""Multi-chip sharding of the scheduling solve over a jax.sharding.Mesh."""
+from .mesh import (
+    AXIS_BINDINGS,
+    AXIS_CLUSTERS,
+    MeshScheduleKernel,
+    build_sharded_kernel,
+    factor_mesh,
+    make_mesh,
+)
+
+__all__ = [
+    "AXIS_BINDINGS",
+    "AXIS_CLUSTERS",
+    "MeshScheduleKernel",
+    "build_sharded_kernel",
+    "factor_mesh",
+    "make_mesh",
+]

@@ -1,0 +1,224 @@
+"""Multi-chip sharding of the batched scheduling solve.
+
+The reference scales by sharding *work items* over goroutines (SURVEY §5
+long-context note: no batched path exists). Here the scheduling problem itself
+is sharded over a 2D `jax.sharding.Mesh`:
+
+  axis "bindings" — data-parallel over the dirty-binding batch rows (the DP
+    axis of this domain: rows are independent end-to-end);
+  axis "clusters" — model-parallel over the fleet columns (the TP-like axis:
+    filter masks, locality score and the GeneralEstimator math
+    [general.go:96-114] are elementwise over (B,C) and run on local cluster
+    shards; the replica-division solve needs full rows — each row is a
+    sort/prefix-sum over ALL clusters, binding.go:112-144 — so the per-cluster
+    partials ride one `all_gather` over ICI before assignment).
+
+This keeps the HBM-resident working set per chip at B/mesh_b × C/mesh_c for
+the quadratic phase, which is what lets 10k bindings × 5k clusters (BASELINE
+north star) exceed a single chip.
+
+Everything here compiles under `jit` on N virtual CPU devices too
+(xla_force_host_platform_device_count) — see __graft_entry__.dryrun_multichip.
+"""
+from __future__ import annotations
+
+from functools import partial
+from typing import Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, PartitionSpec as P
+
+from ..models.batch import AGGREGATED, BindingBatch, DUPLICATED, DYNAMIC_WEIGHT, STATIC_WEIGHT
+from ..models.fleet import FleetArrays
+from ..ops import assign as assign_ops
+from ..ops import filters as filter_ops
+
+AXIS_BINDINGS = "bindings"
+AXIS_CLUSTERS = "clusters"
+
+
+def factor_mesh(n_devices: int) -> tuple[int, int]:
+    """Split n devices into (bindings, clusters) axis sizes, as square as
+    possible with bindings >= clusters (binding rows are the cheaper axis to
+    widen: no collective crosses it)."""
+    best = (n_devices, 1)
+    f = 1
+    while f * f <= n_devices:
+        if n_devices % f == 0:
+            best = (n_devices // f, f)
+        f += 1
+    return best
+
+
+def make_mesh(devices: Optional[Sequence] = None) -> Mesh:
+    devices = list(devices if devices is not None else jax.devices())
+    b, c = factor_mesh(len(devices))
+    return Mesh(np.array(devices).reshape(b, c), (AXIS_BINDINGS, AXIS_CLUSTERS))
+
+
+# in_specs in the exact positional order of sched.core._schedule_kernel
+_FLEET_SPECS = (
+    P(AXIS_CLUSTERS),        # alive
+    P(AXIS_CLUSTERS, None),  # capacity
+    P(AXIS_CLUSTERS),        # has_summary
+    P(AXIS_CLUSTERS, None),  # taint_key
+    P(AXIS_CLUSTERS, None),  # taint_value
+    P(AXIS_CLUSTERS, None),  # taint_effect
+    P(AXIS_CLUSTERS, None),  # api_ok
+)
+_BATCH_SPECS = (
+    P(AXIS_BINDINGS),        # replicas
+    P(AXIS_BINDINGS, None),  # request
+    P(AXIS_BINDINGS),        # unknown_request
+    P(AXIS_BINDINGS),        # gvk
+    P(AXIS_BINDINGS),        # strategy
+    P(AXIS_BINDINGS),        # fresh
+    P(AXIS_BINDINGS, None),  # tol_key
+    P(AXIS_BINDINGS, None),  # tol_value
+    P(AXIS_BINDINGS, None),  # tol_effect
+    P(AXIS_BINDINGS, None),  # tol_op
+    P(AXIS_BINDINGS, AXIS_CLUSTERS),  # affinity_ok
+    P(AXIS_BINDINGS, AXIS_CLUSTERS),  # eviction_ok
+    P(AXIS_BINDINGS, AXIS_CLUSTERS),  # static_weight
+    P(AXIS_BINDINGS, AXIS_CLUSTERS),  # prev_member
+    P(AXIS_BINDINGS, AXIS_CLUSTERS),  # prev_replicas
+    P(AXIS_BINDINGS, AXIS_CLUSTERS),  # tie
+    P(AXIS_BINDINGS, AXIS_CLUSTERS),  # extra_avail
+)
+_OUT_SPECS = (
+    P(AXIS_BINDINGS, None),  # feasible
+    P(AXIS_BINDINGS, None),  # score
+    P(AXIS_BINDINGS, None),  # result
+    P(AXIS_BINDINGS),        # unschedulable
+    P(AXIS_BINDINGS),        # available_sum
+    P(AXIS_BINDINGS, None),  # avail
+)
+
+
+def _sharded_body(
+    alive, capacity, has_summary, taint_key, taint_value, taint_effect, api_ok,
+    replicas, request, unknown_request, gvk, strategy, fresh,
+    tol_key, tol_value, tol_effect, tol_op,
+    affinity_ok, eviction_ok, static_weight, prev_member, prev_replicas, tie,
+    extra_avail,
+):
+    # ---- local phase: elementwise over (B_local, C_local) ----
+    taint_mask = filter_ops.taint_toleration_mask(
+        taint_key, taint_value, taint_effect, tol_key, tol_value, tol_effect, tol_op
+    )
+    api_mask = filter_ops.api_enablement_mask(api_ok, gvk)
+    feasible_l = filter_ops.feasible_mask(
+        alive, api_mask, taint_mask, jnp.ones_like(affinity_ok), affinity_ok, eviction_ok
+    )
+    score_l = filter_ops.locality_score(prev_member)
+    avail_l = assign_ops.general_estimate(capacity, has_summary, request, replicas)
+    avail_l = jnp.where(unknown_request[:, None], 0, avail_l)
+    avail_l = jnp.where(extra_avail >= 0, jnp.minimum(avail_l, extra_avail), avail_l)
+
+    # ---- gather the cluster shards: the division solve is a per-row
+    # sort/cumsum over the FULL fleet (binding.go:112-144). One tiled
+    # all_gather over ICI reconstructs the global rows. ----
+    def gcols(x):
+        return jax.lax.all_gather(x, AXIS_CLUSTERS, axis=1, tiled=True)
+
+    feasible = gcols(feasible_l)
+    score = gcols(score_l)
+    avail = gcols(avail_l)
+    static_w = gcols(static_weight)
+    prev_m = gcols(prev_member)
+    prev_r = gcols(prev_replicas)
+    tie_g = gcols(tie)
+
+    dup = assign_ops.duplicated_assign(feasible, replicas)
+    static = assign_ops.static_weight_assign(feasible, static_w, prev_r, tie_g, replicas)
+    dyn = assign_ops.dynamic_assign(
+        feasible, avail, prev_r, tie_g, replicas, fresh, strategy == AGGREGATED
+    )
+
+    result = jnp.zeros_like(dup)
+    result = jnp.where((strategy == DUPLICATED)[:, None], dup, result)
+    result = jnp.where((strategy == STATIC_WEIGHT)[:, None], static, result)
+    is_dyn = (strategy == DYNAMIC_WEIGHT) | (strategy == AGGREGATED)
+    result = jnp.where(is_dyn[:, None], dyn.result, result)
+    unschedulable = is_dyn & dyn.unschedulable
+    return feasible, score, result, unschedulable, dyn.available_sum, avail
+
+
+def build_sharded_kernel(mesh: Mesh):
+    """jit(shard_map(schedule kernel)) over the given mesh. Same positional
+    signature and outputs as sched.core._schedule_kernel; inputs may be plain
+    numpy arrays (jit shards them per in_specs)."""
+    fn = jax.shard_map(
+        _sharded_body,
+        mesh=mesh,
+        in_specs=_FLEET_SPECS + _BATCH_SPECS,
+        out_specs=_OUT_SPECS,
+        check_vma=False,
+    )
+    return jax.jit(fn)
+
+
+def _pad_axis(a: np.ndarray, axis: int, to: int, fill=0) -> np.ndarray:
+    cur = a.shape[axis]
+    if cur >= to:
+        return a
+    width = [(0, 0)] * a.ndim
+    width[axis] = (0, to - cur)
+    return np.pad(a, width, constant_values=fill)
+
+
+def _round_up(n: int, mult: int) -> int:
+    return ((n + mult - 1) // mult) * mult
+
+
+class MeshScheduleKernel:
+    """Host wrapper: pads fleet/batch axes to mesh-divisible sizes (padded
+    clusters are dead — alive=False ⇒ infeasible; padded bindings are
+    NON_WORKLOAD rows) and trims outputs back."""
+
+    def __init__(self, mesh: Mesh):
+        self.mesh = mesh
+        self.kernel = build_sharded_kernel(mesh)
+        self.mesh_b = mesh.shape[AXIS_BINDINGS]
+        self.mesh_c = mesh.shape[AXIS_CLUSTERS]
+
+    def __call__(self, fleet: FleetArrays, batch: BindingBatch, extra_avail=None):
+        B = len(batch.replicas)
+        C = fleet.alive.shape[0]
+        Bp = _round_up(max(B, self.mesh_b), self.mesh_b)
+        Cp = _round_up(max(C, self.mesh_c), self.mesh_c)
+        if extra_avail is None:
+            extra_avail = np.full((B, C), -1, np.int32)
+
+        def fb(a):  # fleet array: pad cluster axis 0
+            return _pad_axis(a, 0, Cp)
+
+        def bb(a):  # batch array: pad binding axis 0
+            return _pad_axis(a, 0, Bp)
+
+        def bc(a):  # [B,C] matrix: pad both
+            return _pad_axis(_pad_axis(a, 0, Bp), 1, Cp)
+
+        out = self.kernel(
+            fb(fleet.alive), fb(fleet.capacity), fb(fleet.has_summary),
+            fb(fleet.taint_key), fb(fleet.taint_value), fb(fleet.taint_effect),
+            fb(fleet.api_ok),
+            bb(batch.replicas), bb(batch.request), bb(batch.unknown_request),
+            bb(batch.gvk), bb(batch.strategy), bb(batch.fresh),
+            bb(batch.tol_key), bb(batch.tol_value), bb(batch.tol_effect),
+            bb(batch.tol_op),
+            bc(batch.affinity_ok), bc(batch.eviction_ok), bc(batch.static_weight),
+            bc(batch.prev_member), bc(batch.prev_replicas), bc(batch.tie),
+            _pad_axis(_pad_axis(extra_avail, 0, Bp), 1, Cp, fill=-1),
+        )
+        feasible, score, result, unsched, avail_sum, avail = (np.asarray(x) for x in out)
+        return (
+            feasible[:B, :C],
+            score[:B, :C],
+            result[:B, :C],
+            unsched[:B],
+            avail_sum[:B],
+            avail[:B, :C],
+        )

@@ -251,15 +251,22 @@ class ArrayScheduler:
         # Phase 2: spread-constrained rows restrict candidates via the host
         # combinatorial selection (SelectClusters, common.go:32-39), then the
         # assignment kernel re-runs over the restricted feasible set.
+        from . import spread as spread_mod
+
         spread_errors: dict[int, str] = {}
         spread_rows: list[int] = []
         for b, rb in enumerate(bindings):
             placement = rb.spec.placement
-            if placement is not None and placement.spread_constraints and feasible[b].any():
+            if (
+                placement is not None
+                and placement.spread_constraints
+                and feasible[b].any()
+                # statically-ignored constraints select every feasible cluster
+                # (select_clusters.go:63-77) — the restriction re-run is a no-op
+                and not spread_mod.should_ignore_spread_constraint(placement)
+            ):
                 spread_rows.append(b)
         if spread_rows:
-            from . import spread as spread_mod
-
             sub_affinity = raw.affinity_ok.copy()
             live_rows = []
             for b in spread_rows:

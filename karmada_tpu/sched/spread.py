@@ -125,14 +125,13 @@ def select_clusters_by_spread(
     if not constraints or should_ignore_spread_constraint(placement):
         return details
 
-    need_replicas = replicas
-    if should_ignore_available_resource(placement):
-        need_replicas = INVALID_REPLICAS
-
     cmap = _constraint_map(constraints)
     if SPREAD_BY_FIELD_REGION in cmap:
         return _select_by_region(cmap, details, placement, replicas)
     if SPREAD_BY_FIELD_CLUSTER in cmap:
+        need_replicas = (
+            INVALID_REPLICAS if should_ignore_available_resource(placement) else replicas
+        )
         return _select_by_cluster(cmap[SPREAD_BY_FIELD_CLUSTER], details, need_replicas)
     raise SpreadError("just support cluster and region spread constraint")
 
@@ -219,10 +218,7 @@ def _select_by_region(
     if len(regions) < region_constraint.min_groups:
         raise SpreadError("the number of feasible region is less than spreadConstraint.MinGroups")
 
-    duplicated = (
-        placement.replica_scheduling is None
-        or placement.replica_scheduling_type() == REPLICA_SCHEDULING_DUPLICATED
-    )
+    duplicated = should_ignore_available_resource(placement)
     for g in regions.values():
         if duplicated:
             g.weight = calc_group_score_duplicated(g.clusters, replicas)
@@ -253,8 +249,7 @@ def _select_by_region(
         need_cnt = min(need_cnt, cluster_constraint.max_groups)
     rest_cnt = need_cnt - len(selected)
     if rest_cnt > 0:
-        candidates = sorted(candidates, key=lambda d: (-d.score, -d.available, d.name))
-        selected.extend(candidates[:rest_cnt])
+        selected.extend(sort_details(candidates)[:rest_cnt])
     return selected
 
 

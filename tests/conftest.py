@@ -1,8 +1,15 @@
-"""Test env: force an 8-device virtual CPU mesh before jax is imported
-(multi-chip sharding is validated on host devices; real TPU only in bench)."""
+"""Test env: force an 8-device virtual CPU mesh (multi-chip sharding is
+validated on host devices; the real TPU is only used by bench.py).
+
+The ambient image registers the tunnel TPU backend from sitecustomize (jax is
+already imported before this file runs), so env-var-only selection is too
+late; override via jax.config before any backend is initialized instead."""
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
 _flags = os.environ.get("XLA_FLAGS", "")
 if "--xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")

@@ -1,0 +1,136 @@
+"""Mesh-sharded solve parity: the shard_map kernel over an 8-device virtual
+CPU mesh (conftest.py) must be bit-identical to the single-device kernel for
+every strategy, including ragged (non-divisible) B and C."""
+import numpy as np
+import pytest
+
+import jax
+
+from karmada_tpu.api.meta import CPU, MEMORY, ObjectMeta, new_uid
+from karmada_tpu.api.work import (
+    BindingSpec,
+    ObjectReference,
+    ReplicaRequirements,
+    ResourceBinding,
+    TargetCluster,
+)
+from karmada_tpu.api.policy import (
+    ClusterAffinity,
+    ClusterPreferences,
+    DIVISION_PREFERENCE_AGGREGATED,
+    DIVISION_PREFERENCE_WEIGHTED,
+    DYNAMIC_WEIGHT_AVAILABLE_REPLICAS,
+    Placement,
+    REPLICA_SCHEDULING_DIVIDED,
+    ReplicaSchedulingStrategy,
+)
+from karmada_tpu.parallel import MeshScheduleKernel, factor_mesh, make_mesh
+from karmada_tpu.sched.core import ArrayScheduler
+from karmada_tpu.testing.fixtures import (
+    duplicated_placement,
+    static_weight_placement,
+    synthetic_fleet,
+)
+
+GiB = 1024.0**3
+
+
+def make_binding(name, replicas, placement, *, cpu=0.0, prev=None, ns="default"):
+    rr = ReplicaRequirements(resource_request={CPU: cpu}) if cpu else None
+    return ResourceBinding(
+        metadata=ObjectMeta(namespace=ns, name=name, uid=new_uid("rb")),
+        spec=BindingSpec(
+            resource=ObjectReference(
+                api_version="apps/v1", kind="Deployment", namespace=ns, name=name
+            ),
+            replicas=replicas,
+            replica_requirements=rr,
+            placement=placement,
+            clusters=[TargetCluster(name=n, replicas=r) for n, r in (prev or {}).items()],
+        ),
+    )
+
+
+def dyn_placement(aggregated=False, names=None):
+    return Placement(
+        cluster_affinity=ClusterAffinity(cluster_names=list(names or [])),
+        replica_scheduling=ReplicaSchedulingStrategy(
+            replica_scheduling_type=REPLICA_SCHEDULING_DIVIDED,
+            replica_division_preference=(
+                DIVISION_PREFERENCE_AGGREGATED if aggregated else DIVISION_PREFERENCE_WEIGHTED
+            ),
+            weight_preference=None if aggregated else ClusterPreferences(
+                dynamic_weight=DYNAMIC_WEIGHT_AVAILABLE_REPLICAS
+            ),
+        ),
+    )
+
+
+def test_factor_mesh():
+    assert factor_mesh(8) == (4, 2)
+    assert factor_mesh(4) == (2, 2)
+    assert factor_mesh(6) == (3, 2)
+    assert factor_mesh(1) == (1, 1)
+    assert factor_mesh(7) == (7, 1)
+
+
+@pytest.fixture(scope="module")
+def fleet_and_bindings():
+    clusters = synthetic_fleet(13, seed=3)  # deliberately not divisible by 2
+    names = [c.name for c in clusters]
+    bindings = []
+    for i in range(11):  # not divisible by 4
+        kind = i % 4
+        if kind == 0:
+            p = duplicated_placement(names[: 3 + i % 5])
+        elif kind == 1:
+            p = static_weight_placement({names[j]: j + 1 for j in range(1 + i % 6)})
+        elif kind == 2:
+            p = dyn_placement(aggregated=False)
+        else:
+            p = dyn_placement(aggregated=True)
+        prev = {names[i % len(names)]: 2} if i % 3 == 0 else None
+        bindings.append(
+            make_binding(f"app-{i}", 5 + i, p, cpu=0.5 + 0.25 * (i % 3), prev=prev)
+        )
+    return clusters, bindings
+
+
+def test_sharded_kernel_matches_single_device(fleet_and_bindings):
+    clusters, bindings = fleet_and_bindings
+    sched = ArrayScheduler(clusters)
+    raw = sched.batch_encoder.encode(bindings)
+    ref = tuple(np.asarray(x) for x in sched.run_kernel(sched._pad(raw)))
+    B = raw.size
+
+    mesh = make_mesh(jax.devices())
+    assert mesh.devices.size == 8
+    mk = MeshScheduleKernel(mesh)
+    got = mk(sched.fleet, raw)
+
+    for r, g, name in zip(
+        ref, got, ["feasible", "score", "result", "unsched", "avail_sum", "avail"]
+    ):
+        r = r[:B]  # single-device path padded B; mesh wrapper trims
+        np.testing.assert_array_equal(r, g, err_msg=name)
+
+
+def test_sharded_end_to_end_decisions(fleet_and_bindings):
+    """ArrayScheduler decisions recomputed through the mesh kernel agree on
+    final target assignments."""
+    clusters, bindings = fleet_and_bindings
+    sched = ArrayScheduler(clusters)
+    decisions = sched.schedule(bindings)
+
+    mesh = make_mesh(jax.devices())
+    mk = MeshScheduleKernel(mesh)
+    raw = sched.batch_encoder.encode(bindings)
+    _, _, result, unsched, _, _ = mk(sched.fleet, raw)
+
+    for b, dec in enumerate(decisions):
+        assert dec.ok, dec.error
+        got = {
+            sched.fleet.names[i]: int(result[b, i])
+            for i in np.nonzero(result[b] > 0)[0]
+        }
+        assert got == {t.name: t.replicas for t in dec.targets}
