@@ -1,0 +1,62 @@
+"""Apps API: WorkloadRebalancer.
+
+Parity with pkg/apis/apps/v1alpha1/workloadrebalancer_types.go: a list of
+workload references whose bindings should be freshly rescheduled; per-workload
+observed result in status; optional TTL-after-finished cleanup.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Optional
+
+from .meta import ObjectMeta
+
+KIND_WORKLOAD_REBALANCER = "WorkloadRebalancer"
+
+REBALANCE_SUCCESSFUL = "Successful"
+REBALANCE_FAILED = "Failed"
+
+REASON_REFERENCED_BINDING_NOT_FOUND = "ReferencedBindingNotFound"
+
+
+@dataclass
+class RebalancerObjectReference:
+    api_version: str = ""
+    kind: str = ""
+    namespace: str = ""
+    name: str = ""
+
+    def key(self) -> str:
+        return f"{self.api_version}/{self.kind}/{self.namespace}/{self.name}"
+
+
+@dataclass
+class WorkloadRebalancerSpec:
+    workloads: list[RebalancerObjectReference] = field(default_factory=list)
+    ttl_seconds_after_finished: Optional[int] = None
+
+
+@dataclass
+class ObservedWorkload:
+    workload: RebalancerObjectReference = field(default_factory=RebalancerObjectReference)
+    result: str = ""  # "" (pending) | Successful | Failed
+    reason: str = ""
+
+
+@dataclass
+class WorkloadRebalancerStatus:
+    observed_workloads: list[ObservedWorkload] = field(default_factory=list)
+    observed_generation: int = 0
+    finish_time: Optional[float] = None
+
+
+@dataclass
+class WorkloadRebalancer:
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    spec: WorkloadRebalancerSpec = field(default_factory=WorkloadRebalancerSpec)
+    status: WorkloadRebalancerStatus = field(default_factory=WorkloadRebalancerStatus)
+    kind: str = KIND_WORKLOAD_REBALANCER
+
+    @property
+    def name(self) -> str:
+        return self.metadata.name

@@ -1,0 +1,56 @@
+"""Remedy API: cluster-condition-triggered remedy actions.
+
+Parity with pkg/apis/remedy/v1alpha1: a Remedy selects clusters (by names or
+all) and lists decisionMatches on cluster conditions; when a match fires, the
+remedy's actions land in cluster.status.remedyActions
+(pkg/controllers/remediation/remedy_controller.go:51).
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Optional
+
+from .meta import ObjectMeta
+
+KIND_REMEDY = "Remedy"
+
+# ConditionType addressable by decision matches (remedy API)
+SERVICE_DOMAIN_NAME_RESOLUTION_READY = "ServiceDomainNameResolutionReady"
+
+# RemedyAction
+ACTION_TRAFFIC_CONTROL = "TrafficControl"
+
+
+@dataclass
+class ClusterConditionRequirement:
+    condition_type: str = ""
+    operator: str = "Equal"  # Equal | NotEqual
+    condition_status: str = ""  # "True" | "False" | "Unknown"
+
+
+@dataclass
+class DecisionMatch:
+    cluster_condition_match: Optional[ClusterConditionRequirement] = None
+
+
+@dataclass
+class RemedyClusterAffinity:
+    cluster_names: list[str] = field(default_factory=list)
+
+
+@dataclass
+class RemedySpec:
+    cluster_affinity: Optional[RemedyClusterAffinity] = None  # None = all clusters
+    decision_matches: list[DecisionMatch] = field(default_factory=list)  # empty = always
+    actions: list[str] = field(default_factory=list)
+
+
+@dataclass
+class Remedy:
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    spec: RemedySpec = field(default_factory=RemedySpec)
+    kind: str = KIND_REMEDY
+
+    @property
+    def name(self) -> str:
+        return self.metadata.name

@@ -104,7 +104,7 @@ class GracefulEvictionTask:
     producer: str = ""
     grace_period_seconds: Optional[int] = None
     suppress_deletion: Optional[bool] = None
-    creation_timestamp: float = 0.0
+    creation_timestamp: Optional[float] = None  # None = not yet stamped
     purge_mode: str = PURGE_MODE_GRACIOUSLY
     preserved_label_state: dict[str, str] = field(default_factory=dict)
     cluster_before_failover: list[str] = field(default_factory=list)

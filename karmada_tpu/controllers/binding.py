@@ -11,14 +11,16 @@ from __future__ import annotations
 
 from typing import Optional
 
-from ..api.policy import REPLICA_SCHEDULING_DIVIDED
+from ..api.policy import PURGE_MODE_IMMEDIATELY, REPLICA_SCHEDULING_DIVIDED
 from ..api.unstructured import Unstructured
 from ..api.work import (
     RESOURCE_BINDING_PERMANENT_ID_LABEL,
     ResourceBinding,
+    TargetCluster,
     Work,
     WorkSpec,
 )
+from ..features import FeatureGates, STATEFUL_FAILOVER_INJECTION, default_gates
 from ..interpreter.interpreter import ResourceInterpreter
 from ..runtime.controller import Controller, DONE, Runtime
 from ..store.store import Store
@@ -35,10 +37,12 @@ class BindingController:
         interpreter: ResourceInterpreter,
         runtime: Runtime,
         override_manager=None,
+        gates: Optional[FeatureGates] = None,
     ) -> None:
         self.store = store
         self.interpreter = interpreter
         self.override_manager = override_manager
+        self.gates = gates or default_gates
         self.controller = runtime.register(
             Controller(name="binding", reconcile=self._reconcile)
         )
@@ -66,7 +70,15 @@ class BindingController:
         )
         if template is None:
             return
-        targets = rb.spec.clusters
+        # mergeTargetClusters (common.go:193-210): dependency (requiredBy)
+        # clusters receive the workload too, keeping the snapshot's replicas.
+        targets = list(rb.spec.clusters)
+        seen = {tc.name for tc in targets}
+        for snapshot in rb.spec.required_by:
+            for tc in snapshot.clusters:
+                if tc.name not in seen:
+                    seen.add(tc.name)
+                    targets.append(TargetCluster(name=tc.name, replicas=tc.replicas))
         divided = (
             rb.spec.placement is not None
             and rb.spec.placement.replica_scheduling_type() == REPLICA_SCHEDULING_DIVIDED
@@ -80,6 +92,14 @@ class BindingController:
                 manifest_obj = self.interpreter.revise_replica(manifest_obj, tc.replicas)
             if self.override_manager is not None:
                 manifest_obj = self.override_manager.apply_overrides(manifest_obj, tc.name)
+            if self.gates.enabled(STATEFUL_FAILOVER_INJECTION):
+                # gate on the SCHEDULED cluster count, not the merged list —
+                # requiredBy dependency clusters must not defeat the
+                # single-cluster-failover check (common.go:168 uses
+                # bindingSpec.Clusters)
+                manifest_obj = self._inject_preserved_label_state(
+                    rb, tc, manifest_obj, len(rb.spec.clusters)
+                )
             manifest = manifest_obj.to_dict()
             # Strip control-plane bookkeeping AND the template's status — the
             # template carries the multi-cluster aggregated status, which must
@@ -116,7 +136,30 @@ class BindingController:
             elif existing.spec != new_spec:
                 work.spec = new_spec
                 self.store.update(work)
+        # Graceful eviction: Works on evicting clusters (PurgeMode != Immediately)
+        # survive until the eviction task is assessed away
+        # (helper.ObtainBindingSpecExistingClusters).
+        for task in rb.spec.graceful_eviction_tasks:
+            if task.purge_mode != PURGE_MODE_IMMEDIATELY:
+                keep.add(task.from_cluster)
         self._remove_works(rb.namespace, rb.name, keep_clusters=keep)
+
+    def _inject_preserved_label_state(
+        self, rb: ResourceBinding, tc: TargetCluster, manifest_obj: Unstructured, n_targets: int
+    ) -> Unstructured:
+        """injectReservedLabelState (common.go:158-191): single-cluster
+        failover only; uses the LAST eviction task; Immediately purge only;
+        skips clusters the app already ran on before the failover."""
+        if n_targets > 1 or not rb.spec.graceful_eviction_tasks:
+            return manifest_obj
+        task = rb.spec.graceful_eviction_tasks[-1]
+        if task.purge_mode != PURGE_MODE_IMMEDIATELY:
+            return manifest_obj
+        if tc.name in task.cluster_before_failover:
+            return manifest_obj
+        for key, value in task.preserved_label_state.items():
+            manifest_obj.set("metadata", "labels", key, value)
+        return manifest_obj
 
     def _remove_works(self, rb_namespace: str, rb_name: str, keep_clusters: set[str]) -> None:
         """Orphan GC (binding_controller.go:146)."""

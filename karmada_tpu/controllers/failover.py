@@ -1,0 +1,692 @@
+"""Failover family: taint-based eviction, application failover, graceful
+eviction (F1, F2, F3 + the cluster taint-by-condition feed).
+
+Behavior parity:
+- Eviction primitive `graceful_evict_cluster` mirrors
+  ResourceBindingSpec.GracefulEvictCluster
+  (pkg/apis/work/v1alpha2/binding_types_helper.go): move the target out of
+  spec.clusters into spec.gracefulEvictionTasks (dedup by fromCluster,
+  replicas snapshot when >0).
+- TaintManager (pkg/controllers/cluster/taint_manager.go:66-298): clusters
+  with NoExecute taints trigger per-binding checks against the tolerations of
+  the *applied* placement annotation; untolerated ⇒ evict now (Graciously when
+  the GracefulEviction gate is on, else Immediately); tolerated with
+  tolerationSeconds ⇒ evict when the window elapses; tolerated forever ⇒ keep.
+- ApplicationFailoverController
+  (applicationfailover/rb_application_failover_controller.go:61-177): tracks
+  first-unhealthy timestamps per (binding, cluster); evicts after
+  decisionConditions.tolerationSeconds with task options built per
+  common.go buildTaskOptions (PurgeMode dispatch, StatePreservation JSONPath
+  extraction under the StatefulFailoverInjection gate).
+- GracefulEvictionController (gracefuleviction/evictiontask.go:38-114):
+  stamps creationTimestamp, honors suppressDeletion, expires tasks after the
+  grace period (default 10m) or as soon as the *current* schedule result is
+  fully healthy.
+- Cluster taint-by-condition
+  (cluster/cluster_controller.go taintClusterByCondition + the NoExecute
+  eviction taints added after --failover-eviction-timeout when the Failover
+  gate is on).
+"""
+from __future__ import annotations
+
+import json
+from typing import Optional
+
+from ..api.cluster import (
+    Cluster,
+    EFFECT_NO_EXECUTE,
+    EFFECT_NO_SCHEDULE,
+    CLUSTER_CONDITION_READY,
+    TAINT_CLUSTER_NOT_READY,
+    TAINT_CLUSTER_UNREACHABLE,
+    Taint,
+)
+from ..api.meta import get_condition
+from ..api.policy import (
+    ApplicationFailoverBehavior,
+    PURGE_MODE_GRACIOUSLY,
+    PURGE_MODE_IMMEDIATELY,
+    PURGE_MODE_NEVER,
+    Toleration,
+)
+from ..api.work import (
+    GracefulEvictionTask,
+    POLICY_PLACEMENT_ANNOTATION,
+    ResourceBinding,
+)
+from ..features import (
+    FAILOVER,
+    FeatureGates,
+    GRACEFUL_EVICTION,
+    STATEFUL_FAILOVER_INJECTION,
+    default_gates,
+)
+from ..runtime.controller import Clock, Controller, DONE, Runtime
+from ..store.store import DELETED, Store
+
+EVICTION_PRODUCER_TAINT_MANAGER = "TaintManager"
+EVICTION_REASON_TAINT_UNTOLERATED = "TaintUntolerated"
+EVICTION_REASON_APPLICATION_FAILURE = "ApplicationFailure"
+
+HEALTHY = "Healthy"
+UNHEALTHY = "Unhealthy"
+
+DEFAULT_GRACEFUL_EVICTION_TIMEOUT = 600.0  # 10m (graceful eviction controller)
+DEFAULT_FAILOVER_EVICTION_TIMEOUT = 300.0  # 5m (--failover-eviction-timeout)
+
+
+# ---------------------------------------------------------------------------
+# Eviction primitive (binding_types_helper.go GracefulEvictCluster)
+# ---------------------------------------------------------------------------
+
+
+def graceful_evict_cluster(
+    spec,
+    cluster: str,
+    *,
+    purge_mode: str,
+    producer: str,
+    reason: str,
+    message: str = "",
+    grace_period_seconds: Optional[int] = None,
+    suppress_deletion: Optional[bool] = None,
+    preserved_label_state: Optional[dict[str, str]] = None,
+    clusters_before_failover: Optional[list[str]] = None,
+) -> bool:
+    """Returns True if the spec changed."""
+    idx = next((i for i, tc in enumerate(spec.clusters) if tc.name == cluster), None)
+    if idx is None:
+        return False
+    evicted = spec.clusters.pop(idx)
+    if any(t.from_cluster == cluster for t in spec.graceful_eviction_tasks):
+        return True
+    task = GracefulEvictionTask(
+        from_cluster=cluster,
+        purge_mode=purge_mode,
+        reason=reason,
+        message=message,
+        producer=producer,
+        grace_period_seconds=grace_period_seconds,
+        suppress_deletion=suppress_deletion,
+        preserved_label_state=dict(preserved_label_state or {}),
+        cluster_before_failover=list(clusters_before_failover or []),
+    )
+    if evicted.replicas > 0:
+        task.replicas = evicted.replicas
+    spec.graceful_eviction_tasks.append(task)
+    return True
+
+
+# ---------------------------------------------------------------------------
+# Toleration matching (helper.GetMatchingTolerations / GetMinTolerationTime)
+# ---------------------------------------------------------------------------
+
+
+def no_execute_taints(taints: list[Taint]) -> list[Taint]:
+    return [t for t in taints if t.effect == EFFECT_NO_EXECUTE]
+
+
+def matching_tolerations(
+    taints: list[Taint], tolerations: list[Toleration]
+) -> tuple[bool, list[tuple[Taint, Toleration]]]:
+    """For each taint find a matching toleration; (False, []) if any taint is
+    untolerated (helper.GetMatchingTolerations)."""
+    pairs: list[tuple[Taint, Toleration]] = []
+    for taint in taints:
+        match = next((tol for tol in tolerations if tol.tolerates(taint)), None)
+        if match is None:
+            return False, []
+        pairs.append((taint, match))
+    return True, pairs
+
+
+def min_toleration_deadline(
+    pairs: list[tuple[Taint, Toleration]], now: float
+) -> Optional[float]:
+    """Earliest instant any toleration window expires; None = tolerate forever
+    (helper.GetMinTolerationTime: window starts at taint.timeAdded)."""
+    deadline: Optional[float] = None
+    for taint, tol in pairs:
+        if tol.toleration_seconds is None:
+            continue
+        start = taint.time_added if taint.time_added is not None else now
+        d = start + max(tol.toleration_seconds, 0)
+        if deadline is None or d < deadline:
+            deadline = d
+    return deadline
+
+
+def tolerations_from_applied_placement(rb: ResourceBinding) -> list[Toleration]:
+    """The taint manager judges against the placement the scheduler actually
+    applied (annotation), not the live policy (taint_manager.go needEviction →
+    helper.GetAppliedPlacement)."""
+    raw = rb.metadata.annotations.get(POLICY_PLACEMENT_ANNOTATION, "")
+    if not raw:
+        return []
+    data = json.loads(raw)
+    out = []
+    for t in data.get("cluster_tolerations") or []:
+        out.append(
+            Toleration(
+                key=t.get("key", ""),
+                operator=t.get("operator", "Equal"),
+                value=t.get("value", ""),
+                effect=t.get("effect", ""),
+                toleration_seconds=t.get("toleration_seconds"),
+            )
+        )
+    return out
+
+
+# ---------------------------------------------------------------------------
+# TaintManager (F1)
+# ---------------------------------------------------------------------------
+
+
+class TaintManager:
+    """NoExecute taint eviction. Registered only when the Failover feature
+    gate is on (features.go:84-88 wiring in controllermanager.go)."""
+
+    def __init__(
+        self,
+        store: Store,
+        runtime: Runtime,
+        gates: Optional[FeatureGates] = None,
+    ) -> None:
+        self.store = store
+        self.clock = runtime.clock
+        self.gates = gates or default_gates
+        # (binding key, cluster) -> absolute deadline for tolerated-with-window
+        self._pending: dict[tuple[str, str], float] = {}
+        self.controller = runtime.register(
+            Controller(name="taint-manager", reconcile=self._reconcile_cluster)
+        )
+        store.watch("Cluster", self._on_cluster)
+        store.watch("ResourceBinding", self._on_binding)
+
+    def _on_binding(self, event: str, rb: ResourceBinding) -> None:
+        if event == DELETED:
+            key = rb.metadata.key()
+            self._pending = {k: v for k, v in self._pending.items() if k[0] != key}
+
+    def _on_cluster(self, event: str, cluster: Cluster) -> None:
+        if event == DELETED:
+            self._pending = {
+                k: v for k, v in self._pending.items() if k[1] != cluster.name
+            }
+            return
+        self.controller.enqueue(cluster.name)
+
+    def _reconcile_cluster(self, cluster_name: str) -> str:
+        cluster = self.store.try_get("Cluster", cluster_name)
+        if cluster is None:
+            return DONE
+        taints = no_execute_taints(cluster.spec.taints)
+        if not taints:
+            self._pending = {
+                k: v for k, v in self._pending.items() if k[1] != cluster_name
+            }
+            return DONE
+        live_keys = set()
+        for rb in self.store.list("ResourceBinding"):
+            if rb.metadata.deletion_timestamp is not None:
+                continue
+            if cluster_name not in rb.spec.target_cluster_names():
+                continue
+            live_keys.add(rb.metadata.key())
+            self._sync_binding_eviction(rb, cluster, taints)
+        # prune windows for bindings that vanished or stopped targeting us
+        self._pending = {
+            k: v
+            for k, v in self._pending.items()
+            if k[1] != cluster_name or k[0] in live_keys
+        }
+        return DONE
+
+    def _sync_binding_eviction(
+        self, rb: ResourceBinding, cluster: Cluster, taints: list[Taint]
+    ) -> None:
+        key = (rb.metadata.key(), cluster.name)
+        tolerations = tolerations_from_applied_placement(rb)
+        all_tolerated, pairs = matching_tolerations(taints, tolerations)
+        now = self.clock.now()
+        if all_tolerated:
+            deadline = min_toleration_deadline(pairs, now)
+            if deadline is None:
+                self._pending.pop(key, None)  # tolerate forever
+                return
+            if now < deadline:
+                self._pending[key] = deadline
+                return
+        self._pending.pop(key, None)
+        self._evict(rb, cluster.name)
+
+    def _evict(self, rb: ResourceBinding, cluster: str) -> None:
+        fresh = self.store.try_get("ResourceBinding", rb.name, rb.namespace)
+        if fresh is None or cluster not in fresh.spec.target_cluster_names():
+            return
+        purge = (
+            PURGE_MODE_GRACIOUSLY
+            if self.gates.enabled(GRACEFUL_EVICTION)
+            else PURGE_MODE_IMMEDIATELY
+        )
+        if graceful_evict_cluster(
+            fresh.spec,
+            cluster,
+            purge_mode=purge,
+            producer=EVICTION_PRODUCER_TAINT_MANAGER,
+            reason=EVICTION_REASON_TAINT_UNTOLERATED,
+        ):
+            self.store.update(fresh)
+
+    def tick(self) -> int:
+        """Fire toleration windows that elapsed (reference: AddAfter retries)."""
+        now = self.clock.now()
+        due = [k for k, deadline in self._pending.items() if now >= deadline]
+        for binding_key, cluster_name in due:
+            self.controller.enqueue(cluster_name)
+        return len(due)
+
+
+# ---------------------------------------------------------------------------
+# Application failover (F2)
+# ---------------------------------------------------------------------------
+
+
+def parse_json_path(status: Optional[dict], json_path: str) -> Optional[str]:
+    """Minimal kubernetes-jsonpath `{.a.b[0].c}` evaluator over the aggregated
+    status dict (applicationfailover/common.go parseJSONValue). Returns a
+    string (scalars stringified, composites JSON-encoded); None on miss."""
+    path = json_path.strip()
+    if path.startswith("{") and path.endswith("}"):
+        path = path[1:-1]
+    path = path.lstrip(".")
+    cur = status
+    if cur is None:
+        return None
+    for seg in path.split("."):
+        if not seg:
+            continue
+        while "[" in seg:
+            field, _, rest = seg.partition("[")
+            idx_str, _, seg_rest = rest.partition("]")
+            if field:
+                if not isinstance(cur, dict) or field not in cur:
+                    return None
+                cur = cur[field]
+            try:
+                i = int(idx_str)
+            except ValueError:
+                return None
+            if not isinstance(cur, list) or i >= len(cur):
+                return None
+            cur = cur[i]
+            seg = seg_rest.lstrip(".")
+        if seg:
+            if not isinstance(cur, dict) or seg not in cur:
+                return None
+            cur = cur[seg]
+    if isinstance(cur, str):
+        return cur
+    if isinstance(cur, bool):
+        return "true" if cur else "false"
+    return json.dumps(cur)
+
+
+def build_preserved_label_state(
+    behavior: ApplicationFailoverBehavior, status: Optional[dict]
+) -> dict[str, str]:
+    out: dict[str, str] = {}
+    if behavior.state_preservation is None:
+        return out
+    for rule in behavior.state_preservation.rules:
+        value = parse_json_path(status, rule.json_path)
+        if value is None:
+            raise ValueError(f"jsonpath {rule.json_path!r} not found in status")
+        out[rule.alias_label_name] = value
+    return out
+
+
+class ApplicationFailoverController:
+    def __init__(
+        self,
+        store: Store,
+        runtime: Runtime,
+        gates: Optional[FeatureGates] = None,
+    ) -> None:
+        self.store = store
+        self.clock = runtime.clock
+        self.gates = gates or default_gates
+        # binding key -> {cluster: first unhealthy timestamp}
+        self._unhealthy_since: dict[str, dict[str, float]] = {}
+        self.controller = runtime.register(
+            Controller(name="rb-application-failover", reconcile=self._reconcile)
+        )
+        store.watch("ResourceBinding", self._on_binding)
+
+    def _on_binding(self, event: str, rb: ResourceBinding) -> None:
+        if event == DELETED:
+            self._unhealthy_since.pop(rb.metadata.key(), None)
+            return
+        self.controller.enqueue(rb.metadata.key())
+
+    def _behavior(self, rb: ResourceBinding) -> Optional[ApplicationFailoverBehavior]:
+        failover = rb.spec.failover
+        if failover is None:
+            return None
+        return getattr(failover, "application", None)
+
+    def _reconcile(self, key: str) -> str:
+        ns, _, name = key.partition("/")
+        rb = self.store.try_get("ResourceBinding", name, ns)
+        if rb is None or rb.metadata.deletion_timestamp is not None:
+            self._unhealthy_since.pop(key, None)
+            return DONE
+        behavior = self._behavior(rb)
+        if behavior is None or not rb.status.aggregated_status:
+            self._unhealthy_since.pop(key, None)
+            return DONE
+
+        targets = set(rb.spec.target_cluster_names())
+        unhealthy = [
+            item.cluster_name
+            for item in rb.status.aggregated_status
+            if item.cluster_name in targets and item.health == UNHEALTHY
+        ]
+        others = targets - set(unhealthy)
+
+        seen = self._unhealthy_since.setdefault(key, {})
+        now = self.clock.now()
+        toleration = behavior.decision_conditions_toleration_seconds
+        need_evict: list[str] = []
+        for cluster in unhealthy:
+            since = seen.setdefault(cluster, now)
+            if now >= since + toleration:
+                need_evict.append(cluster)
+
+        evicted: list[str] = []
+        if need_evict:
+            evicted = self._evict(rb, behavior, need_evict)
+        # cleanup healthy/EVICTED clusters from the unhealthy map
+        # (deleteIrrelevantClusters) — clusters whose eviction was skipped
+        # (status not collected yet, gate off) keep their window open so the
+        # retry fires immediately rather than restarting the toleration clock
+        for cluster in list(seen):
+            if cluster in others or cluster not in targets or cluster in evicted:
+                seen.pop(cluster)
+        if not seen:
+            self._unhealthy_since.pop(key, None)
+        return DONE
+
+    def _evict(
+        self,
+        rb: ResourceBinding,
+        behavior: ApplicationFailoverBehavior,
+        clusters: list[str],
+    ) -> list[str]:
+        """Returns the clusters actually evicted (skips stay pending)."""
+        fresh = self.store.try_get("ResourceBinding", rb.name, rb.namespace)
+        if fresh is None:
+            return []
+        clusters_before = fresh.spec.target_cluster_names()
+        status_by_cluster = {
+            i.cluster_name: i.status for i in fresh.status.aggregated_status
+        }
+        evicted: list[str] = []
+        changed = False
+        for cluster in clusters:
+            preserved: dict[str, str] = {}
+            before: list[str] = []
+            if (
+                self.gates.enabled(STATEFUL_FAILOVER_INJECTION)
+                and behavior.state_preservation is not None
+                and behavior.state_preservation.rules
+            ):
+                try:
+                    preserved = build_preserved_label_state(
+                        behavior, status_by_cluster.get(cluster)
+                    )
+                except ValueError:
+                    continue  # status not collected yet; retry next event
+                if preserved:
+                    before = clusters_before
+            grace = None
+            suppress = None
+            if behavior.purge_mode == PURGE_MODE_GRACIOUSLY:
+                if not self.gates.enabled(GRACEFUL_EVICTION):
+                    continue  # buildTaskOptions errors in this combination
+                grace = behavior.grace_period_seconds
+            elif behavior.purge_mode == PURGE_MODE_NEVER:
+                suppress = True
+            changed |= graceful_evict_cluster(
+                fresh.spec,
+                cluster,
+                purge_mode=behavior.purge_mode,
+                producer="resource-binding-application-failover-controller",
+                reason=EVICTION_REASON_APPLICATION_FAILURE,
+                grace_period_seconds=grace,
+                suppress_deletion=suppress,
+                preserved_label_state=preserved,
+                clusters_before_failover=before,
+            )
+            evicted.append(cluster)
+        if changed:
+            self.store.update(fresh)
+        return evicted
+
+    def tick(self) -> int:
+        """Re-examine bindings with open toleration windows."""
+        fired = 0
+        for key in list(self._unhealthy_since):
+            self.controller.enqueue(key)
+            fired += 1
+        return fired
+
+
+# ---------------------------------------------------------------------------
+# Graceful eviction (F3)
+# ---------------------------------------------------------------------------
+
+
+class GracefulEvictionController:
+    """Assess spec.gracefulEvictionTasks; drop tasks once the replacement is
+    healthy, the grace period expired, or the user confirmed deletion
+    (evictiontask.go:38-114). Dropping the task is what finally releases the
+    old cluster: the binding controller stops emitting a Work for it."""
+
+    def __init__(
+        self,
+        store: Store,
+        runtime: Runtime,
+        timeout: float = DEFAULT_GRACEFUL_EVICTION_TIMEOUT,
+    ) -> None:
+        self.store = store
+        self.clock = runtime.clock
+        self.timeout = timeout
+        self.controller = runtime.register(
+            Controller(name="rb-graceful-eviction", reconcile=self._reconcile)
+        )
+        store.watch("ResourceBinding", self._on_binding)
+
+    def _on_binding(self, event: str, rb: ResourceBinding) -> None:
+        if event == DELETED:
+            return
+        if rb.spec.graceful_eviction_tasks:
+            self.controller.enqueue(rb.metadata.key())
+
+    def _reconcile(self, key: str) -> str:
+        ns, _, name = key.partition("/")
+        rb = self.store.try_get("ResourceBinding", name, ns)
+        if rb is None or rb.metadata.deletion_timestamp is not None:
+            return DONE
+        if not rb.spec.graceful_eviction_tasks:
+            return DONE
+        scheduled = self._has_scheduled(rb)
+        kept = []
+        changed = False
+        now = self.clock.now()
+        for task in rb.spec.graceful_eviction_tasks:
+            if task.creation_timestamp is None:
+                task.creation_timestamp = now  # stamp new task (must persist)
+                changed = True
+                kept.append(task)
+                continue
+            keep = self._assess(task, rb, scheduled, now)
+            if keep:
+                kept.append(task)
+            else:
+                changed = True
+        if changed:
+            rb.spec.graceful_eviction_tasks = kept
+            self.store.update(rb)
+        return DONE
+
+    def _has_scheduled(self, rb: ResourceBinding) -> bool:
+        """The scheduler has observed the current spec (eviction included):
+        rb_graceful_eviction_controller.go:85. Without this gate the task
+        would be assessed against a stale schedule result."""
+        return rb.status.scheduler_observed_generation == rb.metadata.generation
+
+    def _assess(
+        self, task: GracefulEvictionTask, rb: ResourceBinding, scheduled: bool, now: float
+    ) -> bool:
+        if task.suppress_deletion is not None:
+            # True: hold forever until the user flips it; False: confirmed.
+            return task.suppress_deletion
+        timeout = (
+            task.grace_period_seconds
+            if task.grace_period_seconds is not None
+            else self.timeout
+        )
+        if now > task.creation_timestamp + timeout:
+            return False
+        if scheduled and self._all_targets_healthy(rb):
+            return False
+        return True
+
+    def _all_targets_healthy(self, rb: ResourceBinding) -> bool:
+        status_by_cluster = {
+            i.cluster_name: i for i in rb.status.aggregated_status
+        }
+        for tc in rb.spec.clusters:
+            item = status_by_cluster.get(tc.name)
+            if item is None or item.health != HEALTHY:
+                return False
+        return True
+
+    def tick(self) -> int:
+        fired = 0
+        for rb in self.store.list("ResourceBinding"):
+            if rb.spec.graceful_eviction_tasks:
+                self.controller.enqueue(rb.metadata.key())
+                fired += 1
+        return fired
+
+
+# ---------------------------------------------------------------------------
+# Cluster taint-by-condition (the F1 feed)
+# ---------------------------------------------------------------------------
+
+NOT_READY_TAINT_SCHED = Taint(key=TAINT_CLUSTER_NOT_READY, effect=EFFECT_NO_SCHEDULE)
+UNREACHABLE_TAINT_SCHED = Taint(key=TAINT_CLUSTER_UNREACHABLE, effect=EFFECT_NO_SCHEDULE)
+NOT_READY_TAINT_EXEC = Taint(key=TAINT_CLUSTER_NOT_READY, effect=EFFECT_NO_EXECUTE)
+UNREACHABLE_TAINT_EXEC = Taint(key=TAINT_CLUSTER_UNREACHABLE, effect=EFFECT_NO_EXECUTE)
+
+
+def _set_taints(
+    taints: list[Taint], add: list[Taint], remove: list[Taint], now: float
+) -> tuple[list[Taint], bool]:
+    changed = False
+    out = list(taints)
+    for r in remove:
+        n = len(out)
+        out = [t for t in out if not (t.key == r.key and t.effect == r.effect)]
+        changed |= len(out) != n
+    for a in add:
+        if not any(t.key == a.key and t.effect == a.effect for t in out):
+            out.append(Taint(key=a.key, value=a.value, effect=a.effect, time_added=now))
+            changed = True
+    return out, changed
+
+
+class ClusterTaintController:
+    """Maintains condition-derived taints on Cluster objects.
+
+    Ready=False ⇒ not-ready NoSchedule taint now; Ready=Unknown ⇒ unreachable
+    NoSchedule now (taintClusterByCondition). When the Failover gate is on and
+    the condition persists past --failover-eviction-timeout, the matching
+    NoExecute taint is added (processTaintBaseEviction), which is what the
+    TaintManager evicts on.
+    """
+
+    def __init__(
+        self,
+        store: Store,
+        runtime: Runtime,
+        gates: Optional[FeatureGates] = None,
+        eviction_timeout: float = DEFAULT_FAILOVER_EVICTION_TIMEOUT,
+    ) -> None:
+        self.store = store
+        self.clock = runtime.clock
+        self.gates = gates or default_gates
+        self.eviction_timeout = eviction_timeout
+        # cluster -> (ready status, first time we observed it): the health
+        # monitor's probe bookkeeping (clusterHealthMap in the reference),
+        # kept on the injected clock so tests can advance time
+        self._observed: dict[str, tuple[str, float]] = {}
+        self.controller = runtime.register(
+            Controller(name="cluster-taint", reconcile=self._reconcile)
+        )
+        store.watch("Cluster", self._on_cluster)
+
+    def _on_cluster(self, event: str, cluster: Cluster) -> None:
+        if event == DELETED:
+            return
+        self.controller.enqueue(cluster.name)
+
+    def _reconcile(self, key: str) -> str:
+        cluster = self.store.try_get("Cluster", key)
+        if cluster is None:
+            return DONE
+        now = self.clock.now()
+        ready = get_condition(cluster.status.conditions, CLUSTER_CONDITION_READY)
+        add: list[Taint] = []
+        remove: list[Taint] = []
+        if ready is None or ready.status == "False":
+            add, remove = [NOT_READY_TAINT_SCHED], [UNREACHABLE_TAINT_SCHED]
+            exec_taint, exec_other = NOT_READY_TAINT_EXEC, UNREACHABLE_TAINT_EXEC
+        elif ready.status == "Unknown":
+            add, remove = [UNREACHABLE_TAINT_SCHED], [NOT_READY_TAINT_SCHED]
+            exec_taint, exec_other = UNREACHABLE_TAINT_EXEC, NOT_READY_TAINT_EXEC
+        else:
+            remove = [
+                NOT_READY_TAINT_SCHED,
+                UNREACHABLE_TAINT_SCHED,
+                NOT_READY_TAINT_EXEC,
+                UNREACHABLE_TAINT_EXEC,
+            ]
+            exec_taint = exec_other = None
+
+        status = ready.status if ready is not None else "False"
+        prev = self._observed.get(key)
+        if prev is None or prev[0] != status:
+            self._observed[key] = (status, now)
+        if exec_taint is not None and self.gates.enabled(FAILOVER):
+            remove.append(exec_other)
+            since = self._observed[key][1]
+            if now - since >= self.eviction_timeout:
+                add.append(exec_taint)
+        taints, changed = _set_taints(cluster.spec.taints, add, remove, now)
+        if changed:
+            cluster.spec.taints = taints
+            self.store.update(cluster)
+        return DONE
+
+    def tick(self) -> int:
+        fired = 0
+        for cluster in self.store.list("Cluster"):
+            ready = get_condition(cluster.status.conditions, CLUSTER_CONDITION_READY)
+            if ready is not None and ready.status in ("False", "Unknown"):
+                self.controller.enqueue(cluster.name)
+                fired += 1
+        return fired

@@ -1,0 +1,132 @@
+"""WorkloadRebalancer controller (F4).
+
+Parity with pkg/controllers/workloadrebalancer/workloadrebalancer_controller.go:
+for each workload listed in spec, stamp spec.rescheduleTriggeredAt on its
+ResourceBinding (util.RescheduleRequired) so the scheduler runs a Fresh
+reassignment (assignment.go:110-115); record per-workload results in status
+(spec→status sync rules at :115-154); delete the rebalancer TTLSecondsAfter-
+Finished after the last workload finishes.
+"""
+from __future__ import annotations
+
+import copy
+
+from ..api.apps import (
+    ObservedWorkload,
+    REASON_REFERENCED_BINDING_NOT_FOUND,
+    REBALANCE_FAILED,
+    REBALANCE_SUCCESSFUL,
+    WorkloadRebalancer,
+    WorkloadRebalancerStatus,
+)
+from ..runtime.controller import Controller, DONE, Runtime
+from ..store.store import DELETED, Store
+from ..utils.names import binding_name
+
+
+class WorkloadRebalancerController:
+    def __init__(self, store: Store, runtime: Runtime) -> None:
+        self.store = store
+        self.clock = runtime.clock
+        self.controller = runtime.register(
+            Controller(name="workload-rebalancer", reconcile=self._reconcile)
+        )
+        store.watch("WorkloadRebalancer", self._on_rebalancer)
+
+    def _on_rebalancer(self, event: str, obj: WorkloadRebalancer) -> None:
+        if event == DELETED:
+            return
+        self.controller.enqueue(obj.name)
+
+    def _reconcile(self, key: str) -> str:
+        rebalancer = self.store.try_get("WorkloadRebalancer", key)
+        if rebalancer is None:
+            return DONE
+        # snapshot before mutation: _trigger_reschedules mutates ObservedWorkload
+        # objects shared with rebalancer.status, so compare against a copy
+        old_status = copy.deepcopy(rebalancer.status)
+        new_status = self._sync_spec_to_status(rebalancer)
+        self._trigger_reschedules(new_status)
+        # finish_time carries over before comparing, else every reconcile
+        # looks changed and the status update re-enqueues us forever
+        new_status.finish_time = old_status.finish_time
+        changed = new_status != old_status
+        if changed and new_status.finish_time is None:
+            new_status.finish_time = self.clock.now()
+        if changed:
+            rebalancer.status = new_status
+            self.store.update(rebalancer)
+        if (
+            rebalancer.spec.ttl_seconds_after_finished is not None
+            and rebalancer.status.finish_time is not None
+            and self.clock.now()
+            >= rebalancer.status.finish_time + rebalancer.spec.ttl_seconds_after_finished
+        ):
+            self.store.delete("WorkloadRebalancer", rebalancer.name)
+        return DONE
+
+    def _sync_spec_to_status(
+        self, rebalancer: WorkloadRebalancer
+    ) -> WorkloadRebalancerStatus:
+        """Spec→status merge (:115-154): keep successful entries even if
+        dropped from spec; pending entries removed from spec disappear."""
+        spec_keys = {w.key(): w for w in rebalancer.spec.workloads}
+        observed: list[ObservedWorkload] = []
+        for item in rebalancer.status.observed_workloads:
+            k = item.workload.key()
+            if k in spec_keys:
+                observed.append(item)
+                spec_keys.pop(k)
+            elif item.result == REBALANCE_SUCCESSFUL:
+                observed.append(item)
+        for w in spec_keys.values():
+            observed.append(ObservedWorkload(workload=w))
+        observed.sort(
+            key=lambda o: (
+                o.workload.api_version,
+                o.workload.kind,
+                o.workload.namespace,
+                o.workload.name,
+            )
+        )
+        return WorkloadRebalancerStatus(
+            observed_workloads=observed,
+            observed_generation=rebalancer.metadata.generation,
+        )
+
+    def _trigger_reschedules(self, status: WorkloadRebalancerStatus) -> None:
+        """Stamp rescheduleTriggeredAt on each not-yet-successful workload's
+        binding (failed entries retry on every reconcile, matching the
+        reference's per-item retry)."""
+        for item in status.observed_workloads:
+            if item.result == REBALANCE_SUCCESSFUL:
+                continue
+            w = item.workload
+            rb = self._find_binding(w.namespace, w.name, w.kind)
+            if rb is None:
+                item.result = REBALANCE_FAILED
+                item.reason = REASON_REFERENCED_BINDING_NOT_FOUND
+                continue
+            rb.spec.reschedule_triggered_at = self.clock.now()
+            self.store.update(rb)
+            item.result = REBALANCE_SUCCESSFUL
+            item.reason = ""
+
+    def _find_binding(self, namespace: str, name: str, kind: str):
+        rb_name = binding_name(kind, name)
+        return self.store.try_get("ResourceBinding", rb_name, namespace)
+
+    def tick(self) -> int:
+        """Fire TTL cleanups whose deadline elapsed."""
+        fired = 0
+        now = self.clock.now()
+        for rebalancer in self.store.list("WorkloadRebalancer"):
+            ttl = rebalancer.spec.ttl_seconds_after_finished
+            if (
+                ttl is not None
+                and rebalancer.status.finish_time is not None
+                and now >= rebalancer.status.finish_time + ttl
+            ):
+                self.controller.enqueue(rebalancer.name)
+                fired += 1
+        return fired

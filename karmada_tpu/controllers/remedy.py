@@ -1,0 +1,74 @@
+"""Remedy controller (F5).
+
+Parity with pkg/controllers/remediation/remedy_controller.go:51: on Cluster or
+Remedy change, compute the union of actions from every Remedy whose cluster
+affinity covers the cluster and whose decisionMatches fire against the
+cluster's conditions; write the sorted action list to
+cluster.status.remedyActions.
+"""
+from __future__ import annotations
+
+from ..api.cluster import Cluster
+from ..api.meta import get_condition
+from ..api.remedy import Remedy
+from ..runtime.controller import Controller, DONE, Runtime
+from ..store.store import DELETED, Store
+
+
+def remedy_matches_cluster(remedy: Remedy, cluster: Cluster) -> bool:
+    affinity = remedy.spec.cluster_affinity
+    if affinity is not None and cluster.name not in affinity.cluster_names:
+        return False
+    if not remedy.spec.decision_matches:
+        return True  # empty matches = unconditionally applies
+    for dm in remedy.spec.decision_matches:
+        req = dm.cluster_condition_match
+        if req is None:
+            continue
+        cond = get_condition(cluster.status.conditions, req.condition_type)
+        status = cond.status if cond is not None else ""
+        if req.operator == "Equal" and status == req.condition_status:
+            return True
+        if req.operator == "NotEqual" and status != req.condition_status:
+            return True
+    return False
+
+
+class RemedyController:
+    def __init__(self, store: Store, runtime: Runtime) -> None:
+        self.store = store
+        self.controller = runtime.register(
+            Controller(name="remedy", reconcile=self._reconcile)
+        )
+        store.watch("Cluster", self._on_cluster)
+        store.watch("Remedy", self._on_remedy)
+
+    def _on_cluster(self, event: str, cluster: Cluster) -> None:
+        if event == DELETED:
+            return
+        self.controller.enqueue(cluster.name)
+
+    def _on_remedy(self, event: str, remedy: Remedy) -> None:
+        # a remedy change can affect any cluster it names — or all of them
+        affinity = remedy.spec.cluster_affinity
+        names = (
+            affinity.cluster_names
+            if affinity is not None
+            else [c.name for c in self.store.list("Cluster")]
+        )
+        for name in names:
+            self.controller.enqueue(name)
+
+    def _reconcile(self, key: str) -> str:
+        cluster = self.store.try_get("Cluster", key)
+        if cluster is None:
+            return DONE
+        actions: set[str] = set()
+        for remedy in self.store.list("Remedy"):
+            if remedy_matches_cluster(remedy, cluster):
+                actions.update(remedy.spec.actions)
+        new_actions = sorted(actions)
+        if new_actions != cluster.status.remedy_actions:
+            cluster.status.remedy_actions = new_actions
+            self.store.update(cluster)
+        return DONE

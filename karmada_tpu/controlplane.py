@@ -23,9 +23,18 @@ from .api.cluster import (
 from .api.meta import Condition, ObjectMeta, set_condition
 from .controllers.binding import BindingController
 from .controllers.execution import ExecutionController
+from .controllers.failover import (
+    ApplicationFailoverController,
+    ClusterTaintController,
+    GracefulEvictionController,
+    TaintManager,
+)
+from .controllers.rebalancer import WorkloadRebalancerController
+from .controllers.remedy import RemedyController
 from .controllers.status import BindingStatusController, WorkStatusController
 from .descheduler.descheduler import Descheduler
 from .detector.detector import ResourceDetector
+from .features import FAILOVER, FeatureGates, GRACEFUL_EVICTION
 from .estimator.client import EstimatorRegistry, MemberEstimators
 from .interpreter.interpreter import ResourceInterpreter
 from .members.member import InMemoryMember, MemberConfig
@@ -41,9 +50,10 @@ DEFAULT_API_ENABLEMENTS = [
 
 
 class ControlPlane:
-    def __init__(self, clock: Optional[Clock] = None):
+    def __init__(self, clock: Optional[Clock] = None, gates: Optional[FeatureGates] = None):
         self.store = Store()
         self.runtime = Runtime(clock=clock)
+        self.gates = gates or FeatureGates()
         self.interpreter = ResourceInterpreter()
         self.members: dict[str, InMemoryMember] = {}
 
@@ -60,7 +70,9 @@ class ControlPlane:
         self.scheduler = SchedulerDaemon(
             self.store, self.runtime, estimator_registry=self.estimator_registry
         )
-        self.binding_controller = BindingController(self.store, self.interpreter, self.runtime)
+        self.binding_controller = BindingController(
+            self.store, self.interpreter, self.runtime, gates=self.gates
+        )
         self.execution_controller = ExecutionController(
             self.store, self.members, self.interpreter, self.runtime
         )
@@ -77,6 +89,28 @@ class ControlPlane:
         self.descheduler = Descheduler(
             self.store, self.estimator_registry, clock=self.runtime.clock
         )
+
+        # Failover family (F1-F5). The taint manager and condition-eviction
+        # taints are wired only under the Failover gate (features.go:84-88);
+        # graceful eviction assessment under the GracefulEviction gate.
+        self.cluster_taint_controller = ClusterTaintController(
+            self.store, self.runtime, gates=self.gates
+        )
+        self.taint_manager = (
+            TaintManager(self.store, self.runtime, gates=self.gates)
+            if self.gates.enabled(FAILOVER)
+            else None
+        )
+        self.application_failover_controller = ApplicationFailoverController(
+            self.store, self.runtime, gates=self.gates
+        )
+        self.graceful_eviction_controller = (
+            GracefulEvictionController(self.store, self.runtime)
+            if self.gates.enabled(GRACEFUL_EVICTION)
+            else None
+        )
+        self.rebalancer_controller = WorkloadRebalancerController(self.store, self.runtime)
+        self.remedy_controller = RemedyController(self.store, self.runtime)
 
     # -- cluster lifecycle (karmadactl join equivalent) -------------------
 
@@ -141,6 +175,21 @@ class ControlPlane:
 
     def settle(self, max_steps: int = 100_000) -> int:
         return self.runtime.settle(max_steps)
+
+    def tick(self, seconds: float = 0.0, max_steps: int = 100_000) -> int:
+        """Advance the injected clock and fire every time-gated loop (the
+        reference's RequeueAfter/timer behaviors), then settle to fixpoint."""
+        if seconds:
+            self.runtime.clock.advance(seconds)
+        self.cluster_taint_controller.tick()
+        if self.taint_manager is not None:
+            self.taint_manager.tick()
+        self.application_failover_controller.tick()
+        if self.graceful_eviction_controller is not None:
+            self.graceful_eviction_controller.tick()
+        self.rebalancer_controller.tick()
+        self.descheduler.tick()
+        return self.settle(max_steps)
 
     def run_descheduler(self) -> int:
         """One descheduling sweep + convergence (the 2m timer tick)."""

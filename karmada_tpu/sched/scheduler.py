@@ -124,6 +124,12 @@ class SchedulerDaemon:
                 continue
             if self._needs_schedule(rb):
                 bindings.append(rb)
+            elif rb.status.scheduler_observed_generation != rb.metadata.generation:
+                # no scheduling required: still record that the current spec
+                # was observed (scheduler.go:437-441) — graceful eviction
+                # assessment gates on this
+                rb.status.scheduler_observed_generation = rb.metadata.generation
+                self.store.update(rb)
         if not bindings:
             return []
         array = self._ensure_fleet()
@@ -165,6 +171,9 @@ class SchedulerDaemon:
                 ),
             )
             if not changed and not cond_changed:
+                if fresh.status.scheduler_observed_generation != fresh.metadata.generation:
+                    fresh.status.scheduler_observed_generation = fresh.metadata.generation
+                    self.store.update(fresh)
                 return  # idempotent no-op: the event fixpoint terminates here
             fresh.status.scheduler_observed_generation = fresh.metadata.generation
             fresh.status.last_scheduled_time = self.clock.now()

@@ -1,0 +1,505 @@
+"""Failover family tests (F1-F5): taint-based eviction, application failover,
+graceful eviction assessment, workload rebalancer, remedy.
+
+Mirrors the reference's test approach (taint_manager_test.go,
+rb_application_failover_controller_test.go, evictiontask_test.go,
+workloadrebalancer_controller_test.go): fake clusters + fabricated conditions,
+deterministic clocks instead of wall-time sleeps.
+"""
+from karmada_tpu.api.apps import (
+    REASON_REFERENCED_BINDING_NOT_FOUND,
+    REBALANCE_FAILED,
+    REBALANCE_SUCCESSFUL,
+    RebalancerObjectReference,
+    WorkloadRebalancer,
+    WorkloadRebalancerSpec,
+)
+from karmada_tpu.api.cluster import EFFECT_NO_EXECUTE, TAINT_CLUSTER_NOT_READY, Taint
+from karmada_tpu.api.meta import CPU, MEMORY, ObjectMeta
+from karmada_tpu.api.policy import (
+    ApplicationFailoverBehavior,
+    FailoverBehavior,
+    PURGE_MODE_GRACIOUSLY,
+    PURGE_MODE_IMMEDIATELY,
+    StatePreservation,
+    StatePreservationRule,
+    Toleration,
+)
+from karmada_tpu.api.remedy import (
+    ACTION_TRAFFIC_CONTROL,
+    ClusterConditionRequirement,
+    DecisionMatch,
+    Remedy,
+    RemedySpec,
+)
+from karmada_tpu.controllers.failover import parse_json_path
+from karmada_tpu.controlplane import ControlPlane
+from karmada_tpu.features import (
+    FAILOVER,
+    FeatureGates,
+    STATEFUL_FAILOVER_INJECTION,
+)
+from karmada_tpu.members.member import MemberConfig
+from karmada_tpu.runtime.controller import Clock
+from karmada_tpu.testing.fixtures import (
+    duplicated_placement,
+    new_deployment,
+    new_policy,
+    selector_for,
+    static_weight_placement,
+)
+
+GiB = 1024.0**3
+
+
+def failover_plane(**gate_overrides) -> ControlPlane:
+    gates = FeatureGates({FAILOVER: True, **gate_overrides})
+    cp = ControlPlane(clock=Clock(fixed=1000.0), gates=gates)
+    for i in range(1, 4):
+        cp.join_member(
+            MemberConfig(
+                name=f"member{i}",
+                region=f"region-{i % 2}",
+                allocatable={CPU: 100.0, MEMORY: 400 * GiB, "pods": 1000.0},
+            )
+        )
+    return cp
+
+
+def deploy_nginx(cp: ControlPlane, placement=None, failover=None, replicas=2):
+    deploy = new_deployment("default", "nginx", replicas=replicas, cpu=0.1)
+    cp.store.create(deploy)
+    policy = new_policy(
+        "default", "nginx-pp", [selector_for(deploy)], placement or duplicated_placement([])
+    )
+    if failover is not None:
+        policy.spec.failover = failover
+    cp.store.create(policy)
+    cp.settle()
+    return cp.store.get("ResourceBinding", "nginx-deployment", "default")
+
+
+# ---------------------------------------------------------------------------
+# Taint manager (F1)
+# ---------------------------------------------------------------------------
+
+
+def test_noexecute_taint_evicts_untolerated_binding():
+    """Divided placement: the scheduler has no re-schedule trigger when a
+    taint lands (assigned == desired), so the taint manager drives the
+    eviction — the case the reference controller exists for."""
+    cp = failover_plane()
+    rb = deploy_nginx(
+        cp, placement=static_weight_placement({"member1": 1, "member2": 2}), replicas=9
+    )
+    assert {t.name: t.replicas for t in rb.spec.clusters} == {"member1": 3, "member2": 6}
+
+    # member2 unhealthy ⇒ the eviction task can't be assessed away yet
+    # (replacement not fully healthy) so we can observe it mid-flight
+    cp.members["member2"].set_healthy(False)
+    cp.settle()
+
+    cluster = cp.store.get("Cluster", "member1")
+    cluster.spec.taints.append(
+        Taint(key="disk-pressure", effect=EFFECT_NO_EXECUTE, time_added=cp.runtime.clock.now())
+    )
+    cp.store.update(cluster)
+    cp.settle()
+
+    rb = cp.store.get("ResourceBinding", "nginx-deployment", "default")
+    assert "member1" not in {t.name for t in rb.spec.clusters}
+    # GracefulEviction gate defaults on ⇒ Graciously task recorded
+    tasks = rb.spec.graceful_eviction_tasks
+    assert [t.from_cluster for t in tasks] == ["member1"]
+    assert tasks[0].purge_mode == PURGE_MODE_GRACIOUSLY
+    assert tasks[0].reason == "TaintUntolerated"
+    assert tasks[0].producer == "TaintManager"
+    assert tasks[0].replicas == 3  # replicas snapshot of the evicted target
+    # the old copy keeps running during graceful eviction
+    assert cp.members["member1"].get("apps/v1", "Deployment", "nginx", "default") is not None
+    # the freed replicas were re-dispensed to the remaining weighted cluster
+    assert {t.name: t.replicas for t in rb.spec.clusters} == {"member2": 9}
+
+    # replacement becomes healthy ⇒ task assessed away, old copy removed
+    cp.members["member2"].set_healthy(True)
+    cp.settle()
+    rb = cp.store.get("ResourceBinding", "nginx-deployment", "default")
+    assert not rb.spec.graceful_eviction_tasks
+    assert cp.members["member1"].get("apps/v1", "Deployment", "nginx", "default") is None
+
+
+def test_noexecute_taint_toleration_window():
+    cp = failover_plane()
+    placement = static_weight_placement({"member1": 1, "member2": 2})
+    placement.cluster_tolerations = [
+        Toleration(key="disk-pressure", operator="Exists", effect=EFFECT_NO_EXECUTE,
+                   toleration_seconds=60)
+    ]
+    rb = deploy_nginx(cp, placement=placement, replicas=9)
+
+    cluster = cp.store.get("Cluster", "member1")
+    cluster.spec.taints.append(
+        Taint(key="disk-pressure", effect=EFFECT_NO_EXECUTE, time_added=cp.runtime.clock.now())
+    )
+    cp.store.update(cluster)
+    cp.settle()
+
+    # within the window: still scheduled on member1
+    rb = cp.store.get("ResourceBinding", "nginx-deployment", "default")
+    assert "member1" in {t.name for t in rb.spec.clusters}
+
+    cp.tick(61)
+    rb = cp.store.get("ResourceBinding", "nginx-deployment", "default")
+    assert {t.name: t.replicas for t in rb.spec.clusters} == {"member2": 9}
+
+
+def test_forever_toleration_never_evicts():
+    cp = failover_plane()
+    placement = static_weight_placement({"member1": 1, "member2": 2})
+    placement.cluster_tolerations = [
+        Toleration(key="disk-pressure", operator="Exists", effect=EFFECT_NO_EXECUTE)
+    ]
+    deploy_nginx(cp, placement=placement, replicas=9)
+    cluster = cp.store.get("Cluster", "member1")
+    cluster.spec.taints.append(
+        Taint(key="disk-pressure", effect=EFFECT_NO_EXECUTE, time_added=cp.runtime.clock.now())
+    )
+    cp.store.update(cluster)
+    cp.settle()
+    cp.tick(3600)
+    rb = cp.store.get("ResourceBinding", "nginx-deployment", "default")
+    assert "member1" in {t.name for t in rb.spec.clusters}
+
+
+def test_cluster_condition_taints_and_eviction_flow():
+    """NotReady condition ⇒ NoSchedule taint now, NoExecute after the
+    failover eviction timeout ⇒ taint manager evicts ⇒ scheduler re-places."""
+    cp = failover_plane()
+    deploy_nginx(cp)
+    cp.set_member_ready("member2", False)
+    cp.settle()
+
+    cluster = cp.store.get("Cluster", "member2")
+    taint_effects = {(t.key, t.effect) for t in cluster.spec.taints}
+    assert (TAINT_CLUSTER_NOT_READY, "NoSchedule") in taint_effects
+    assert (TAINT_CLUSTER_NOT_READY, EFFECT_NO_EXECUTE) not in taint_effects
+
+    cp.tick(301)  # past --failover-eviction-timeout (5m)
+    cluster = cp.store.get("Cluster", "member2")
+    taint_effects = {(t.key, t.effect) for t in cluster.spec.taints}
+    assert (TAINT_CLUSTER_NOT_READY, EFFECT_NO_EXECUTE) in taint_effects
+
+    rb = cp.store.get("ResourceBinding", "nginx-deployment", "default")
+    assert "member2" not in {t.name for t in rb.spec.clusters}
+    assert {t.name for t in rb.spec.clusters} == {"member1", "member3"}
+
+
+# ---------------------------------------------------------------------------
+# Application failover (F2)
+# ---------------------------------------------------------------------------
+
+
+def app_failover(toleration=30, purge=PURGE_MODE_GRACIOUSLY, state_rules=None):
+    return FailoverBehavior(
+        application=ApplicationFailoverBehavior(
+            decision_conditions_toleration_seconds=toleration,
+            purge_mode=purge,
+            state_preservation=(
+                StatePreservation(rules=state_rules) if state_rules else None
+            ),
+        )
+    )
+
+
+def test_application_failover_evicts_after_toleration():
+    cp = failover_plane()
+    deploy_nginx(
+        cp,
+        placement=static_weight_placement({"member1": 1, "member3": 1}),
+        failover=app_failover(toleration=30),
+        replicas=4,
+    )
+    # inject failure on member3 only
+    cp.members["member3"].set_healthy(False)
+    cp.settle()
+    rb = cp.store.get("ResourceBinding", "nginx-deployment", "default")
+    unhealthy = [i for i in rb.status.aggregated_status if i.health == "Unhealthy"]
+    assert [i.cluster_name for i in unhealthy] == ["member3"]
+    # toleration window still open
+    assert "member3" in {t.name for t in rb.spec.clusters}
+
+    cp.tick(31)
+    rb = cp.store.get("ResourceBinding", "nginx-deployment", "default")
+    assert "member3" not in {t.name for t in rb.spec.clusters}
+    # freed replicas moved to the healthy weighted cluster
+    assert {t.name: t.replicas for t in rb.spec.clusters} == {"member1": 4}
+
+
+def test_application_failover_recovery_cancels_eviction():
+    cp = failover_plane()
+    deploy_nginx(cp, failover=app_failover(toleration=300))
+    cp.members["member3"].set_healthy(False)
+    cp.settle()
+    cp.tick(100)
+    # recovers inside the window
+    cp.set_member_ready("member3", True)
+    cp.settle()
+    cp.tick(300)
+    rb = cp.store.get("ResourceBinding", "nginx-deployment", "default")
+    assert "member3" in {t.name for t in rb.spec.clusters}
+    assert not rb.spec.graceful_eviction_tasks
+
+
+# ---------------------------------------------------------------------------
+# Graceful eviction (F3)
+# ---------------------------------------------------------------------------
+
+
+def test_graceful_eviction_resolves_when_replacement_healthy():
+    cp = failover_plane()
+    deploy_nginx(cp)
+    cluster = cp.store.get("Cluster", "member1")
+    cluster.spec.taints.append(
+        Taint(key="bad", effect=EFFECT_NO_EXECUTE, time_added=cp.runtime.clock.now())
+    )
+    cp.store.update(cluster)
+    cp.settle()
+
+    # remaining targets are healthy, so the task resolves at the fixpoint
+    rb = cp.store.get("ResourceBinding", "nginx-deployment", "default")
+    assert not rb.spec.graceful_eviction_tasks
+    # and the member1 workload is gone
+    assert cp.members["member1"].get("apps/v1", "Deployment", "nginx", "default") is None
+
+
+def test_graceful_eviction_grace_period_expiry():
+    cp = failover_plane()
+    deploy_nginx(
+        cp, placement=static_weight_placement({"member1": 1, "member2": 2}), replicas=9
+    )
+    # make everything unhealthy so "replacement healthy" can never fire
+    for m in cp.members.values():
+        m.set_healthy(False)
+    cp.settle()
+    cluster = cp.store.get("Cluster", "member1")
+    cluster.spec.taints.append(
+        Taint(key="bad", effect=EFFECT_NO_EXECUTE, time_added=cp.runtime.clock.now())
+    )
+    cp.store.update(cluster)
+    cp.settle()
+    rb = cp.store.get("ResourceBinding", "nginx-deployment", "default")
+    assert [t.from_cluster for t in rb.spec.graceful_eviction_tasks] == ["member1"]
+
+    cp.tick(601)  # default 10m grace period
+    rb = cp.store.get("ResourceBinding", "nginx-deployment", "default")
+    assert not rb.spec.graceful_eviction_tasks
+
+
+def test_suppress_deletion_holds_task():
+    cp = failover_plane()
+    rb = deploy_nginx(cp)
+    rb = cp.store.get("ResourceBinding", "nginx-deployment", "default")
+    from karmada_tpu.controllers.failover import graceful_evict_cluster
+
+    graceful_evict_cluster(
+        rb.spec, "member1",
+        purge_mode="Never", producer="test", reason="test",
+        suppress_deletion=True,
+    )
+    cp.store.update(rb)
+    cp.settle()
+    cp.tick(10_000)
+    rb = cp.store.get("ResourceBinding", "nginx-deployment", "default")
+    assert [t.from_cluster for t in rb.spec.graceful_eviction_tasks] == ["member1"]
+    # user confirms deletion
+    rb.spec.graceful_eviction_tasks[0].suppress_deletion = False
+    cp.store.update(rb)
+    cp.settle()
+    rb = cp.store.get("ResourceBinding", "nginx-deployment", "default")
+    assert not rb.spec.graceful_eviction_tasks
+
+
+# ---------------------------------------------------------------------------
+# State preservation (StatefulFailoverInjection)
+# ---------------------------------------------------------------------------
+
+
+def test_parse_json_path():
+    status = {"a": {"b": [{"c": 5}, {"c": "x"}]}, "ready": True}
+    assert parse_json_path(status, "{.a.b[0].c}") == "5"
+    assert parse_json_path(status, ".a.b[1].c") == "x"
+    assert parse_json_path(status, "{.ready}") == "true"
+    assert parse_json_path(status, "{.missing}") is None
+
+
+def test_stateful_failover_injection():
+    """Single-cluster app (Duplicated + spread maxGroups=1) fails over to a
+    fresh cluster; the preserved status state rides along as labels."""
+    from karmada_tpu.api.policy import SpreadConstraint
+
+    cp = failover_plane(**{STATEFUL_FAILOVER_INJECTION: True})
+    placement = duplicated_placement([])
+    placement.spread_constraints = [
+        SpreadConstraint(spread_by_field="cluster", min_groups=1, max_groups=1)
+    ]
+    rb = deploy_nginx(
+        cp,
+        placement=placement,
+        failover=app_failover(
+            toleration=10,
+            purge=PURGE_MODE_IMMEDIATELY,
+            state_rules=[StatePreservationRule(alias_label_name="failover.io/ready", json_path="{.readyReplicas}")],
+        ),
+    )
+    assert len(rb.spec.clusters) == 1
+    first = rb.spec.clusters[0].name
+
+    # every member unhealthy: the replacement can't turn Healthy, so the
+    # eviction task stays observable after the failover completes
+    for m in cp.members.values():
+        m.set_healthy(False)
+    cp.settle()
+    cp.tick(11)
+
+    rb = cp.store.get("ResourceBinding", "nginx-deployment", "default")
+    new_targets = {t.name for t in rb.spec.clusters}
+    assert first not in new_targets and len(new_targets) == 1
+    task = rb.spec.graceful_eviction_tasks[0]
+    assert task.purge_mode == PURGE_MODE_IMMEDIATELY
+    assert task.preserved_label_state == {"failover.io/ready": "0"}
+    assert first in task.cluster_before_failover
+
+    # the preserved state is injected into the new cluster's workload labels
+    target = next(iter(new_targets))
+    obj = cp.members[target].get("apps/v1", "Deployment", "nginx", "default")
+    assert obj is not None
+    assert obj.get("metadata", "labels", "failover.io/ready") == "0"
+
+
+# ---------------------------------------------------------------------------
+# Workload rebalancer (F4)
+# ---------------------------------------------------------------------------
+
+
+def test_workload_rebalancer_triggers_fresh_reschedule():
+    cp = failover_plane()
+    deploy_nginx(cp)
+    rb0 = cp.store.get("ResourceBinding", "nginx-deployment", "default")
+    assert rb0.spec.reschedule_triggered_at is None
+
+    cp.store.create(
+        WorkloadRebalancer(
+            metadata=ObjectMeta(name="rebalance-1"),
+            spec=WorkloadRebalancerSpec(
+                workloads=[
+                    RebalancerObjectReference(
+                        api_version="apps/v1", kind="Deployment",
+                        namespace="default", name="nginx",
+                    ),
+                    RebalancerObjectReference(
+                        api_version="apps/v1", kind="Deployment",
+                        namespace="default", name="ghost",
+                    ),
+                ]
+            ),
+        )
+    )
+    cp.settle()
+
+    rb = cp.store.get("ResourceBinding", "nginx-deployment", "default")
+    assert rb.spec.reschedule_triggered_at is not None
+
+    rebalancer = cp.store.get("WorkloadRebalancer", "rebalance-1")
+    by_name = {o.workload.name: o for o in rebalancer.status.observed_workloads}
+    assert by_name["nginx"].result == REBALANCE_SUCCESSFUL
+    assert by_name["ghost"].result == REBALANCE_FAILED
+    assert by_name["ghost"].reason == REASON_REFERENCED_BINDING_NOT_FOUND
+    assert rebalancer.status.finish_time is not None
+
+
+def test_workload_rebalancer_retries_failed_workloads():
+    """A workload whose binding appears later flips Failed → Successful on
+    the next reconcile, and the transition is persisted."""
+    cp = failover_plane()
+    cp.store.create(
+        WorkloadRebalancer(
+            metadata=ObjectMeta(name="rebalance-late"),
+            spec=WorkloadRebalancerSpec(
+                workloads=[
+                    RebalancerObjectReference(
+                        api_version="apps/v1", kind="Deployment",
+                        namespace="default", name="nginx",
+                    )
+                ]
+            ),
+        )
+    )
+    cp.settle()
+    rebalancer = cp.store.get("WorkloadRebalancer", "rebalance-late")
+    assert rebalancer.status.observed_workloads[0].result == REBALANCE_FAILED
+
+    deploy_nginx(cp)  # binding exists now
+    cp.rebalancer_controller.controller.enqueue("rebalance-late")
+    cp.settle()
+    rebalancer = cp.store.get("WorkloadRebalancer", "rebalance-late")
+    assert rebalancer.status.observed_workloads[0].result == REBALANCE_SUCCESSFUL
+    rb = cp.store.get("ResourceBinding", "nginx-deployment", "default")
+    assert rb.spec.reschedule_triggered_at is not None
+
+
+def test_workload_rebalancer_ttl_cleanup():
+    cp = failover_plane()
+    deploy_nginx(cp)
+    cp.store.create(
+        WorkloadRebalancer(
+            metadata=ObjectMeta(name="rebalance-ttl"),
+            spec=WorkloadRebalancerSpec(
+                workloads=[
+                    RebalancerObjectReference(
+                        api_version="apps/v1", kind="Deployment",
+                        namespace="default", name="nginx",
+                    )
+                ],
+                ttl_seconds_after_finished=60,
+            ),
+        )
+    )
+    cp.settle()
+    assert cp.store.try_get("WorkloadRebalancer", "rebalance-ttl") is not None
+    cp.tick(61)
+    assert cp.store.try_get("WorkloadRebalancer", "rebalance-ttl") is None
+
+
+# ---------------------------------------------------------------------------
+# Remedy (F5)
+# ---------------------------------------------------------------------------
+
+
+def test_remedy_actions_follow_cluster_conditions():
+    cp = failover_plane()
+    cp.store.create(
+        Remedy(
+            metadata=ObjectMeta(name="traffic-remedy"),
+            spec=RemedySpec(
+                decision_matches=[
+                    DecisionMatch(
+                        cluster_condition_match=ClusterConditionRequirement(
+                            condition_type="Ready", operator="Equal", condition_status="False"
+                        )
+                    )
+                ],
+                actions=[ACTION_TRAFFIC_CONTROL],
+            ),
+        )
+    )
+    cp.settle()
+    assert cp.store.get("Cluster", "member1").status.remedy_actions == []
+
+    cp.set_member_ready("member1", False)
+    cp.settle()
+    assert cp.store.get("Cluster", "member1").status.remedy_actions == [ACTION_TRAFFIC_CONTROL]
+    assert cp.store.get("Cluster", "member2").status.remedy_actions == []
+
+    cp.set_member_ready("member1", True)
+    cp.settle()
+    assert cp.store.get("Cluster", "member1").status.remedy_actions == []
