@@ -47,6 +47,14 @@ class BindingController:
             Controller(name="binding", reconcile=self._reconcile)
         )
         store.watch("ResourceBinding", self._on_binding)
+        if override_manager is not None:
+            # override policy changes re-render every binding's works
+            store.watch("OverridePolicy", self._on_override_policy)
+            store.watch("ClusterOverridePolicy", self._on_override_policy)
+
+    def _on_override_policy(self, event: str, policy) -> None:
+        for rb in self.store.list("ResourceBinding"):
+            self.controller.enqueue(rb.metadata.key())
 
     def _on_binding(self, event: str, rb: ResourceBinding) -> None:
         self.controller.enqueue(rb.metadata.key())

@@ -1,0 +1,188 @@
+"""Dependencies distributor (P3, feature gate PropagateDeps).
+
+Behavior parity with pkg/dependenciesdistributor/dependencies_distributor.go:
+for every *independent* ResourceBinding with propagateDeps, ask the resource
+interpreter for its dependent objects (ConfigMaps/Secrets/PVCs/... referenced
+by the workload, interpreter GetDependencies); for each dependency that exists
+as a template, create an *attached* ResourceBinding (buildAttachedBinding
+:697-731) whose spec.requiredBy snapshots the parent's schedule result — the
+binding controller then materializes the dependency on exactly the parent's
+target clusters (mergeTargetClusters). Attached bindings carry
+`depended-by-*` labels keyed per parent (:686); when the parent's result
+changes, snapshots merge (:586); when a parent goes away or stops depending,
+its snapshot is removed and the attached binding is deleted once orphaned
+(:557-558).
+"""
+from __future__ import annotations
+
+from ..api.work import (
+    BindingSnapshot,
+    BindingSpec,
+    ObjectReference,
+    RESOURCE_BINDING_PERMANENT_ID_LABEL,
+    ResourceBinding,
+)
+from ..features import FeatureGates, PROPAGATE_DEPS, default_gates
+from ..interpreter.interpreter import ResourceInterpreter
+from ..runtime.controller import Controller, DONE, Runtime
+from ..store.store import DELETED, Store
+from ..utils.names import binding_name, _short_hash
+
+DEPENDED_BY_LABEL_PREFIX = "resourcebinding.karmada.io/depended-by-"
+
+
+def depended_by_label(parent_namespace: str, parent_name: str) -> str:
+    return DEPENDED_BY_LABEL_PREFIX + _short_hash(parent_namespace, parent_name)
+
+
+def is_attached_binding(rb: ResourceBinding) -> bool:
+    return any(k.startswith(DEPENDED_BY_LABEL_PREFIX) for k in rb.metadata.labels)
+
+
+class DependenciesDistributor:
+    def __init__(
+        self,
+        store: Store,
+        interpreter: ResourceInterpreter,
+        runtime: Runtime,
+        gates: FeatureGates | None = None,
+    ) -> None:
+        self.store = store
+        self.interpreter = interpreter
+        self.gates = gates or default_gates
+        self.controller = runtime.register(
+            Controller(name="dependencies-distributor", reconcile=self._reconcile)
+        )
+        store.watch("ResourceBinding", self._on_binding)
+
+    def _on_binding(self, event: str, rb: ResourceBinding) -> None:
+        if not self.gates.enabled(PROPAGATE_DEPS):
+            return
+        if is_attached_binding(rb):
+            return
+        if event == DELETED:
+            self._detach_parent(rb)
+            return
+        if rb.spec.propagate_deps:
+            self.controller.enqueue(rb.metadata.key())
+
+    # -- reconcile (dependencies_distributor.go:248,381) -------------------
+
+    def _reconcile(self, key: str) -> str:
+        ns, _, name = key.partition("/")
+        rb = self.store.try_get("ResourceBinding", name, ns)
+        if rb is None or rb.metadata.deletion_timestamp is not None:
+            return DONE
+        if not rb.spec.propagate_deps or is_attached_binding(rb):
+            return DONE
+        template = self.store.try_get(
+            f"{rb.spec.resource.api_version}/{rb.spec.resource.kind}",
+            rb.spec.resource.name,
+            rb.spec.resource.namespace,
+        )
+        if template is None:
+            return DONE
+        deps = self.interpreter.get_dependencies(template)
+        label_key = depended_by_label(rb.namespace, rb.name)
+        permanent_id = rb.metadata.labels.get(RESOURCE_BINDING_PERMANENT_ID_LABEL, "")
+        wanted: set[str] = set()
+        for dep in deps:
+            dep_api = dep.get("apiVersion", "v1")
+            dep_kind = dep.get("kind", "")
+            dep_ns = dep.get("namespace", rb.namespace)
+            dep_name = dep.get("name", "")
+            if not dep_kind or not dep_name:
+                continue
+            if self.store.try_get(f"{dep_api}/{dep_kind}", dep_name, dep_ns) is None:
+                continue  # dependency template not present in the control plane
+            attached_name = binding_name(dep_kind, dep_name)
+            wanted.add(f"{dep_ns}/{attached_name}")
+            self._ensure_attached(
+                rb, label_key, permanent_id, dep_api, dep_kind, dep_ns, dep_name
+            )
+        # drop our snapshot from attached bindings we no longer depend on
+        for attached in self.store.list("ResourceBinding"):
+            if label_key not in attached.metadata.labels:
+                continue
+            if attached.metadata.key() in wanted:
+                continue
+            self._remove_snapshot(attached, rb.namespace, rb.name, label_key)
+        return DONE
+
+    def _ensure_attached(
+        self,
+        parent: ResourceBinding,
+        label_key: str,
+        permanent_id: str,
+        api_version: str,
+        kind: str,
+        namespace: str,
+        name: str,
+    ) -> None:
+        snapshot = BindingSnapshot(
+            resource=ObjectReference(
+                namespace=parent.namespace, name=parent.name
+            ),
+            clusters=list(parent.spec.clusters),
+        )
+        attached_name = binding_name(kind, name)
+        existing = self.store.try_get("ResourceBinding", attached_name, namespace)
+        if existing is None:
+            rb = ResourceBinding()
+            rb.metadata.name = attached_name
+            rb.metadata.namespace = namespace
+            rb.metadata.labels[label_key] = permanent_id
+            rb.spec = BindingSpec(
+                resource=ObjectReference(
+                    api_version=api_version, kind=kind, namespace=namespace, name=name
+                ),
+                required_by=[snapshot],
+                conflict_resolution=parent.spec.conflict_resolution,
+            )
+            created = self.store.create(rb)
+            created.metadata.labels.setdefault(
+                RESOURCE_BINDING_PERMANENT_ID_LABEL, created.metadata.uid
+            )
+            self.store.update(created)
+            return
+        # merge our snapshot (mergeBindingSnapshot :586)
+        changed = existing.metadata.labels.get(label_key) != permanent_id
+        existing.metadata.labels[label_key] = permanent_id
+        for i, snap in enumerate(existing.spec.required_by):
+            if (
+                snap.resource.namespace == parent.namespace
+                and snap.resource.name == parent.name
+            ):
+                if snap.clusters != snapshot.clusters:
+                    existing.spec.required_by[i] = snapshot
+                    changed = True
+                break
+        else:
+            existing.spec.required_by.append(snapshot)
+            changed = True
+        if changed:
+            self.store.update(existing)
+
+    def _remove_snapshot(
+        self, attached: ResourceBinding, parent_ns: str, parent_name: str, label_key: str
+    ) -> None:
+        """deleteBindingFromSnapshot (:557) + orphan deletion."""
+        attached.spec.required_by = [
+            s
+            for s in attached.spec.required_by
+            if not (s.resource.namespace == parent_ns and s.resource.name == parent_name)
+        ]
+        attached.metadata.labels.pop(label_key, None)
+        still_depended = any(
+            k.startswith(DEPENDED_BY_LABEL_PREFIX) for k in attached.metadata.labels
+        )
+        if not still_depended and not attached.spec.required_by:
+            self.store.delete("ResourceBinding", attached.name, attached.namespace)
+        else:
+            self.store.update(attached)
+
+    def _detach_parent(self, rb: ResourceBinding) -> None:
+        label_key = depended_by_label(rb.namespace, rb.name)
+        for attached in self.store.list("ResourceBinding"):
+            if label_key in attached.metadata.labels:
+                self._remove_snapshot(attached, rb.namespace, rb.name, label_key)

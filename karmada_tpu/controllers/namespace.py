@@ -1,0 +1,77 @@
+"""Namespace sync controller (P9).
+
+Behavior parity with pkg/controllers/namespace: every user namespace is
+auto-propagated to every member cluster (a Work per cluster in its execution
+namespace) unless the namespace is reserved (kube-*, karmada-*) or carries the
+skip-auto-propagation label. Cluster joins trigger a full namespace re-sync.
+"""
+from __future__ import annotations
+
+from ..api.unstructured import Unstructured
+from ..api.work import Work, WorkSpec
+from ..runtime.controller import Controller, DONE, Runtime
+from ..store.store import DELETED, Store
+from ..utils.names import execution_namespace, work_name
+
+SKIP_AUTO_PROPAGATION_LABEL = "namespace.karmada.io/skip-auto-propagation"
+NAMESPACE_WORK_LABEL = "namespace.karmada.io/name"
+
+RESERVED_PREFIXES = ("kube-", "karmada-")
+RESERVED_NAMES = {"default", "kube-system", "kube-public", "kube-node-lease"}
+
+
+def should_skip(ns: Unstructured) -> bool:
+    name = ns.name
+    if name in RESERVED_NAMES or any(name.startswith(p) for p in RESERVED_PREFIXES):
+        return True
+    return ns.get("metadata", "labels", SKIP_AUTO_PROPAGATION_LABEL) == "true"
+
+
+class NamespaceSyncController:
+    def __init__(self, store: Store, runtime: Runtime) -> None:
+        self.store = store
+        self.controller = runtime.register(
+            Controller(name="namespace-sync", reconcile=self._reconcile)
+        )
+        store.watch("v1/Namespace", self._on_namespace)
+        store.watch("Cluster", self._on_cluster)
+
+    def _on_namespace(self, event: str, ns: Unstructured) -> None:
+        self.controller.enqueue(ns.name)
+
+    def _on_cluster(self, event: str, cluster) -> None:
+        for ns in self.store.list("v1/Namespace"):
+            self.controller.enqueue(ns.name)
+
+    def _reconcile(self, key: str) -> str:
+        ns = self.store.try_get("v1/Namespace", key)
+        clusters = self.store.list("Cluster")
+        wname = work_name("v1", "Namespace", "", key)
+        if ns is None or ns.metadata.deletion_timestamp is not None or should_skip(ns):
+            for cluster in clusters:
+                wns = execution_namespace(cluster.name)
+                if self.store.try_get("Work", wname, wns) is not None:
+                    self.store.delete("Work", wname, wns)
+            return DONE
+        manifest = ns.to_dict()
+        manifest.pop("status", None)
+        md = manifest.get("metadata", {})
+        for field in ("resourceVersion", "generation", "uid", "creationTimestamp"):
+            md.pop(field, None)
+        for cluster in clusters:
+            if cluster.metadata.deletion_timestamp is not None:
+                continue
+            wns = execution_namespace(cluster.name)
+            existing = self.store.try_get("Work", wname, wns)
+            work = existing or Work()
+            work.metadata.name = wname
+            work.metadata.namespace = wns
+            work.metadata.labels[NAMESPACE_WORK_LABEL] = key
+            new_spec = WorkSpec(workload_manifests=[manifest])
+            if existing is None:
+                work.spec = new_spec
+                self.store.create(work)
+            elif existing.spec != new_spec:
+                work.spec = new_spec
+                self.store.update(work)
+        return DONE

@@ -1,0 +1,320 @@
+"""Override manager (P4): per-target-cluster mutation of propagated manifests.
+
+Behavior parity with pkg/util/overridemanager: ClusterOverridePolicies apply
+first, then namespace-scoped OverridePolicies of the template's namespace
+(overridemanager.go:95-124); within each scope, matching policies sort by
+implicit resource-selector priority then name ascending (:215-229); each
+policy's overrideRules contribute when the rule's targetCluster matches the
+target (util.ClusterMatches). Overrider kinds: image (component-wise
+registry/repository/tag edit, imageoverride.go), command/args (append/remove
+on the named container, commandargsoverride.go), labels/annotations
+(add/replace/remove on metadata maps, labelannotationoverrider.go), and
+plaintext RFC-6902-style JSON patches.
+"""
+from __future__ import annotations
+
+from typing import Any, Optional, Sequence
+
+from ..api.policy import (
+    CommandArgsOverrider,
+    ImageOverrider,
+    LabelAnnotationOverrider,
+    Overriders,
+    PlaintextOverrider,
+)
+from ..api.unstructured import Unstructured
+from ..detector.detector import selector_matches
+from ..sched.affinity import cluster_matches
+from ..store.store import Store
+
+# kinds with a pod template at spec.template.spec (imageoverride.go:42-80)
+POD_TEMPLATE_KINDS = ("Deployment", "ReplicaSet", "DaemonSet", "StatefulSet", "Job")
+
+PRIORITY_MATCH_ALL = 1  # empty selector list = lowest implicit priority
+
+
+# ---------------------------------------------------------------------------
+# Image reference parsing (pkg/util/imageparser)
+# ---------------------------------------------------------------------------
+
+
+class ImageComponents:
+    """registry/repository[:tag|@digest] split. The hostname heuristic is the
+    docker one: the first path segment is a registry only if it contains a dot
+    or colon or equals 'localhost'."""
+
+    def __init__(self, hostname: str, repository: str, tag: str, digest: str):
+        self.hostname = hostname
+        self.repository = repository
+        self.tag = tag
+        self.digest = digest
+
+    @classmethod
+    def parse(cls, image: str) -> "ImageComponents":
+        rest = image
+        digest = tag = ""
+        if "@" in rest:
+            rest, _, digest = rest.partition("@")
+        else:
+            head, _, maybe_tag = rest.rpartition(":")
+            if head and "/" not in maybe_tag:
+                rest, tag = head, maybe_tag
+        hostname = ""
+        first, sep, remainder = rest.partition("/")
+        if sep and ("." in first or ":" in first or first == "localhost"):
+            hostname, rest = first, remainder
+        return cls(hostname, rest, tag, digest)
+
+    def tag_or_digest(self) -> str:
+        return self.tag or self.digest
+
+    def set_tag_or_digest(self, value: str) -> None:
+        if self.digest:
+            self.digest = value
+        else:
+            self.tag = value
+
+    def __str__(self) -> str:
+        full = f"{self.hostname}/{self.repository}" if self.hostname else self.repository
+        if self.tag:
+            return f"{full}:{self.tag}"
+        if self.digest:
+            return f"{full}@{self.digest}"
+        return full
+
+
+def override_image(image: str, o: ImageOverrider) -> str:
+    c = ImageComponents.parse(image)
+    if o.component == "Registry":
+        if o.operator == "add":
+            c.hostname += o.value
+        elif o.operator == "replace":
+            c.hostname = o.value
+        elif o.operator == "remove":
+            c.hostname = ""
+    elif o.component == "Repository":
+        if o.operator == "add":
+            c.repository += o.value
+        elif o.operator == "replace":
+            c.repository = o.value
+        elif o.operator == "remove":
+            c.repository = ""
+    elif o.component == "Tag":
+        if o.operator == "add":
+            c.set_tag_or_digest(c.tag_or_digest() + o.value)
+        elif o.operator == "replace":
+            c.set_tag_or_digest(o.value)
+        elif o.operator == "remove":
+            c.tag = c.digest = ""
+    else:
+        raise ValueError(f"unsupported image component {o.component!r}")
+    return str(c)
+
+
+# ---------------------------------------------------------------------------
+# JSON pointer patch (plaintext overrider)
+# ---------------------------------------------------------------------------
+
+
+def _jp_tokens(path: str) -> list[str]:
+    if not path.startswith("/"):
+        raise ValueError(f"JSON pointer must start with '/': {path!r}")
+    return [t.replace("~1", "/").replace("~0", "~") for t in path[1:].split("/")]
+
+
+def apply_json_patch(doc: dict, op: str, path: str, value: Any = None) -> None:
+    """add/remove/replace on a nested dict/list document (RFC 6902 subset, as
+    the plaintext overrider consumes it). add on a map creates intermediate
+    maps; add on a list index inserts; '-' appends."""
+    tokens = _jp_tokens(path)
+    cur: Any = doc
+    for tok in tokens[:-1]:
+        if isinstance(cur, list):
+            cur = cur[int(tok)]
+        elif isinstance(cur, dict):
+            if tok not in cur:
+                if op == "add":
+                    cur[tok] = {}
+                else:
+                    raise KeyError(path)
+            cur = cur[tok]
+        else:
+            raise KeyError(path)
+    last = tokens[-1]
+    if isinstance(cur, list):
+        if op == "add":
+            if last == "-":
+                cur.append(value)
+            else:
+                cur.insert(int(last), value)
+        elif op == "replace":
+            cur[int(last)] = value
+        elif op == "remove":
+            del cur[int(last)]
+        else:
+            raise ValueError(f"unsupported patch op {op!r}")
+    elif isinstance(cur, dict):
+        if op in ("add", "replace"):
+            cur[last] = value
+        elif op == "remove":
+            cur.pop(last, None)
+        else:
+            raise ValueError(f"unsupported patch op {op!r}")
+    else:
+        raise KeyError(path)
+
+
+# ---------------------------------------------------------------------------
+# Overrider application (applyPolicyOverriders)
+# ---------------------------------------------------------------------------
+
+
+def _pod_spec(manifest: dict, kind: str) -> Optional[dict]:
+    if kind == "Pod":
+        return manifest.get("spec")
+    if kind in POD_TEMPLATE_KINDS:
+        return manifest.get("spec", {}).get("template", {}).get("spec")
+    return None
+
+
+def _apply_image_overriders(manifest: dict, kind: str, overriders: list[ImageOverrider]) -> None:
+    for o in overriders:
+        if o.predicate_path:
+            tokens = _jp_tokens(o.predicate_path)
+            cur: Any = manifest
+            ok = True
+            for tok in tokens:
+                if isinstance(cur, list):
+                    idx = int(tok)
+                    if idx >= len(cur):
+                        ok = False
+                        break
+                    cur = cur[idx]
+                elif isinstance(cur, dict) and tok in cur:
+                    cur = cur[tok]
+                else:
+                    ok = False
+                    break
+            if not ok or not isinstance(cur, str):
+                continue
+            apply_json_patch(manifest, "replace", o.predicate_path, override_image(cur, o))
+            continue
+        spec = _pod_spec(manifest, kind)
+        if spec is None:
+            continue
+        for container in spec.get("containers", []):
+            if "image" in container:
+                container["image"] = override_image(container["image"], o)
+
+
+def _apply_command_args(manifest: dict, kind: str, target: str, overriders: list[CommandArgsOverrider]) -> None:
+    spec = _pod_spec(manifest, kind)
+    if spec is None:
+        return
+    for o in overriders:
+        for container in spec.get("containers", []):
+            if container.get("name") != o.container_name:
+                continue
+            cur = list(container.get(target) or [])
+            if o.operator == "add":
+                cur = cur + list(o.value)
+            elif o.operator == "remove":
+                cur = [v for v in cur if v not in set(o.value)]
+            container[target] = cur
+
+
+def _apply_label_annotation(manifest: dict, field: str, overriders: list[LabelAnnotationOverrider]) -> None:
+    for o in overriders:
+        md = manifest.setdefault("metadata", {})
+        current = md.get(field) or {}
+        if o.operator == "add":
+            current.update(o.value)
+        elif o.operator == "replace":
+            for k, v in o.value.items():
+                if k in current:
+                    current[k] = v
+        elif o.operator == "remove":
+            for k in o.value:
+                current.pop(k, None)
+        md[field] = current
+
+
+def _apply_plaintext(manifest: dict, overriders: list[PlaintextOverrider]) -> None:
+    for o in overriders:
+        apply_json_patch(manifest, o.operator, o.path, o.value)
+
+
+def apply_overriders(manifest: dict, kind: str, overriders: Overriders) -> None:
+    """In-place, in the reference's fixed order (overridemanager.go
+    applyPolicyOverriders): image, command, args, labels, annotations,
+    plaintext last."""
+    _apply_image_overriders(manifest, kind, overriders.image_overrider)
+    _apply_command_args(manifest, kind, "command", overriders.command_overrider)
+    _apply_command_args(manifest, kind, "args", overriders.args_overrider)
+    _apply_label_annotation(manifest, "labels", overriders.labels_overrider)
+    _apply_label_annotation(manifest, "annotations", overriders.annotations_overrider)
+    _apply_plaintext(manifest, overriders.plaintext)
+
+
+# ---------------------------------------------------------------------------
+# OverrideManager
+# ---------------------------------------------------------------------------
+
+
+class OverrideManager:
+    def __init__(self, store: Store):
+        self.store = store
+
+    def _matching_rules(self, policies: Sequence, obj: Unstructured, cluster) -> list[Overriders]:
+        """Resource-selector match + implicit-priority/name sort + per-rule
+        cluster match (getOverridersFromOverridePolicies)."""
+        matching = []
+        for policy in policies:
+            selectors = policy.spec.resource_selectors
+            if not selectors:
+                matching.append((PRIORITY_MATCH_ALL, policy.name, policy))
+                continue
+            prio = max(
+                (selector_matches(s, obj, policy.metadata.namespace) for s in selectors),
+                default=0,
+            )
+            if prio > 0:
+                matching.append((prio, policy.name, policy))
+        matching.sort(key=lambda t: (t[0], t[1]))
+        out: list[Overriders] = []
+        for _, _, policy in matching:
+            for rule in policy.spec.override_rules:
+                if rule.target_cluster is None or cluster_matches(cluster, rule.target_cluster):
+                    out.append(rule.overriders)
+        return out
+
+    def apply_overrides(self, obj: Unstructured, cluster_name: str) -> Unstructured:
+        cluster = self.store.try_get("Cluster", cluster_name)
+        if cluster is None:
+            return obj
+        manifest = obj.to_dict()
+        kind = obj.kind
+        # cluster-scoped first, then namespaced of the template's namespace
+        cops = self._matching_rules(
+            sorted(self.store.list("ClusterOverridePolicy"), key=lambda p: p.name),
+            obj,
+            cluster,
+        )
+        for overriders in cops:
+            apply_overriders(manifest, kind, overriders)
+        if obj.namespace:
+            ops = self._matching_rules(
+                sorted(
+                    (
+                        p
+                        for p in self.store.list("OverridePolicy")
+                        if p.metadata.namespace == obj.namespace
+                    ),
+                    key=lambda p: p.name,
+                ),
+                obj,
+                cluster,
+            )
+            for overriders in ops:
+                apply_overriders(manifest, kind, overriders)
+        return Unstructured(manifest)

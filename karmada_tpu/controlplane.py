@@ -22,7 +22,10 @@ from .api.cluster import (
 )
 from .api.meta import Condition, ObjectMeta, set_condition
 from .controllers.binding import BindingController
+from .controllers.dependencies import DependenciesDistributor
 from .controllers.execution import ExecutionController
+from .controllers.namespace import NamespaceSyncController
+from .controllers.overrides import OverrideManager
 from .controllers.failover import (
     ApplicationFailoverController,
     ClusterTaintController,
@@ -70,9 +73,18 @@ class ControlPlane:
         self.scheduler = SchedulerDaemon(
             self.store, self.runtime, estimator_registry=self.estimator_registry
         )
+        self.override_manager = OverrideManager(self.store)
         self.binding_controller = BindingController(
+            self.store,
+            self.interpreter,
+            self.runtime,
+            override_manager=self.override_manager,
+            gates=self.gates,
+        )
+        self.dependencies_distributor = DependenciesDistributor(
             self.store, self.interpreter, self.runtime, gates=self.gates
         )
+        self.namespace_controller = NamespaceSyncController(self.store, self.runtime)
         self.execution_controller = ExecutionController(
             self.store, self.members, self.interpreter, self.runtime
         )

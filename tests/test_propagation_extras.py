@@ -1,0 +1,326 @@
+"""Override manager (P4), dependencies distributor (P3), namespace sync (P9).
+
+Modeled on the reference's overridemanager_test.go / imageoverride_test.go /
+dependencies_distributor_test.go table tests.
+"""
+from karmada_tpu.api.meta import CPU, MEMORY, LabelSelector, ObjectMeta
+from karmada_tpu.api.policy import (
+    ClusterAffinity,
+    ClusterOverridePolicy,
+    CommandArgsOverrider,
+    ImageOverrider,
+    LabelAnnotationOverrider,
+    OverridePolicy,
+    OverrideSpec,
+    Overriders,
+    PlaintextOverrider,
+    ResourceSelector,
+    RuleWithCluster,
+)
+from karmada_tpu.api.unstructured import Unstructured
+from karmada_tpu.controllers.overrides import ImageComponents, override_image
+from karmada_tpu.controlplane import ControlPlane
+from karmada_tpu.members.member import MemberConfig
+from karmada_tpu.testing.fixtures import (
+    duplicated_placement,
+    new_deployment,
+    new_policy,
+    selector_for,
+)
+
+GiB = 1024.0**3
+
+
+def plane(n=3) -> ControlPlane:
+    cp = ControlPlane()
+    for i in range(1, n + 1):
+        cp.join_member(
+            MemberConfig(
+                name=f"member{i}",
+                region=f"region-{i % 2}",
+                labels={"env": "prod" if i == 1 else "dev"},
+                allocatable={CPU: 100.0, MEMORY: 400 * GiB, "pods": 1000.0},
+            )
+        )
+    return cp
+
+
+# ---------------------------------------------------------------------------
+# Image parsing / component override
+# ---------------------------------------------------------------------------
+
+
+def test_image_components_parse_roundtrip():
+    cases = [
+        "nginx",
+        "nginx:1.19",
+        "library/nginx:1.19",
+        "registry.io/library/nginx:1.19",
+        "localhost:5000/nginx",
+        "registry.io/nginx@sha256:abc123",
+    ]
+    for image in cases:
+        assert str(ImageComponents.parse(image)) == image
+
+
+def test_override_image_components():
+    o = ImageOverrider(component="Registry", operator="replace", value="mirror.io")
+    assert override_image("registry.io/library/nginx:1.19", o) == "mirror.io/library/nginx:1.19"
+    o = ImageOverrider(component="Registry", operator="add", value=":5000")
+    assert override_image("registry.io/nginx", o) == "registry.io:5000/nginx"
+    o = ImageOverrider(component="Registry", operator="remove")
+    assert override_image("registry.io/library/nginx:1.19", o) == "library/nginx:1.19"
+    o = ImageOverrider(component="Tag", operator="replace", value="2.0")
+    assert override_image("nginx:1.19", o) == "nginx:2.0"
+    o = ImageOverrider(component="Repository", operator="replace", value="httpd")
+    assert override_image("registry.io/nginx:1", o) == "registry.io/httpd:1"
+
+
+# ---------------------------------------------------------------------------
+# End-to-end override application per target cluster
+# ---------------------------------------------------------------------------
+
+
+def test_override_policy_rewrites_member_manifest():
+    cp = plane()
+    deploy = new_deployment("default", "web", replicas=3, cpu=0.1)
+    cp.store.create(deploy)
+    cp.store.create(
+        new_policy("default", "web-pp", [selector_for(deploy)], duplicated_placement([]))
+    )
+    # only member1 (env=prod) gets the mirror registry + extra annotation
+    cp.store.create(
+        OverridePolicy(
+            metadata=ObjectMeta(name="prod-override", namespace="default"),
+            spec=OverrideSpec(
+                resource_selectors=[
+                    ResourceSelector(api_version="apps/v1", kind="Deployment")
+                ],
+                override_rules=[
+                    RuleWithCluster(
+                        target_cluster=ClusterAffinity(
+                            label_selector=LabelSelector(match_labels={"env": "prod"})
+                        ),
+                        overriders=Overriders(
+                            image_overrider=[
+                                ImageOverrider(
+                                    component="Registry", operator="replace", value="mirror.io"
+                                )
+                            ],
+                            annotations_overrider=[
+                                LabelAnnotationOverrider(
+                                    operator="add", value={"override.io/applied": "yes"}
+                                )
+                            ],
+                        ),
+                    )
+                ],
+            ),
+        )
+    )
+    cp.settle()
+
+    prod = cp.members["member1"].get("apps/v1", "Deployment", "web", "default")
+    img = prod.get("spec", "template", "spec", "containers")[0]["image"]
+    assert img.startswith("mirror.io/")
+    assert prod.get("metadata", "annotations", "override.io/applied") == "yes"
+
+    dev = cp.members["member2"].get("apps/v1", "Deployment", "web", "default")
+    assert not dev.get("spec", "template", "spec", "containers")[0]["image"].startswith("mirror.io/")
+    assert dev.get("metadata", "annotations", "override.io/applied") is None
+
+
+def test_cluster_override_applies_before_namespaced():
+    """COP then OP (overridemanager.go:95-124): the namespaced policy sees —
+    and can overwrite — the cluster-scoped result."""
+    cp = plane(1)
+    deploy = new_deployment("default", "web", replicas=1, cpu=0.1)
+    cp.store.create(deploy)
+    cp.store.create(
+        new_policy("default", "web-pp", [selector_for(deploy)], duplicated_placement([]))
+    )
+    cp.store.create(
+        ClusterOverridePolicy(
+            metadata=ObjectMeta(name="base"),
+            spec=OverrideSpec(
+                override_rules=[
+                    RuleWithCluster(
+                        overriders=Overriders(
+                            labels_overrider=[
+                                LabelAnnotationOverrider(operator="add", value={"tier": "cop"})
+                            ]
+                        )
+                    )
+                ],
+            ),
+        )
+    )
+    cp.store.create(
+        OverridePolicy(
+            metadata=ObjectMeta(name="specific", namespace="default"),
+            spec=OverrideSpec(
+                override_rules=[
+                    RuleWithCluster(
+                        overriders=Overriders(
+                            labels_overrider=[
+                                LabelAnnotationOverrider(operator="replace", value={"tier": "op"})
+                            ]
+                        )
+                    )
+                ],
+            ),
+        )
+    )
+    cp.settle()
+    obj = cp.members["member1"].get("apps/v1", "Deployment", "web", "default")
+    assert obj.get("metadata", "labels", "tier") == "op"
+
+
+def test_plaintext_and_command_overriders():
+    cp = plane(1)
+    deploy = new_deployment("default", "web", replicas=1, cpu=0.1)
+    # name the container so the command overrider can address it
+    containers = deploy.get("spec", "template", "spec", "containers")
+    containers[0]["name"] = "app"
+    containers[0]["command"] = ["serve"]
+    cp.store.create(deploy)
+    cp.store.create(
+        new_policy("default", "web-pp", [selector_for(deploy)], duplicated_placement([]))
+    )
+    cp.store.create(
+        OverridePolicy(
+            metadata=ObjectMeta(name="tweak", namespace="default"),
+            spec=OverrideSpec(
+                override_rules=[
+                    RuleWithCluster(
+                        overriders=Overriders(
+                            command_overrider=[
+                                CommandArgsOverrider(
+                                    container_name="app", operator="add", value=["--verbose"]
+                                )
+                            ],
+                            plaintext=[
+                                PlaintextOverrider(
+                                    path="/spec/revisionHistoryLimit", operator="add", value=5
+                                )
+                            ],
+                        )
+                    )
+                ],
+            ),
+        )
+    )
+    cp.settle()
+    obj = cp.members["member1"].get("apps/v1", "Deployment", "web", "default")
+    assert obj.get("spec", "template", "spec", "containers")[0]["command"] == ["serve", "--verbose"]
+    assert obj.get("spec", "revisionHistoryLimit") == 5
+
+
+# ---------------------------------------------------------------------------
+# Dependencies distributor
+# ---------------------------------------------------------------------------
+
+
+def _deployment_with_configmap(namespace: str, name: str, cm: str) -> Unstructured:
+    d = new_deployment(namespace, name, replicas=2, cpu=0.1)
+    pod_spec = d.get("spec", "template", "spec")
+    pod_spec["volumes"] = [{"name": "cfg", "configMap": {"name": cm}}]
+    return d
+
+
+def test_dependencies_follow_workload():
+    cp = plane()
+    cm = Unstructured(
+        {
+            "apiVersion": "v1",
+            "kind": "ConfigMap",
+            "metadata": {"name": "web-config", "namespace": "default"},
+            "data": {"k": "v"},
+        }
+    )
+    cp.store.create(cm)
+    deploy = _deployment_with_configmap("default", "web", "web-config")
+    cp.store.create(deploy)
+    policy = new_policy(
+        "default", "web-pp", [selector_for(deploy)], duplicated_placement(["member1", "member2"])
+    )
+    policy.spec.propagate_deps = True
+    cp.store.create(policy)
+    cp.settle()
+
+    # attached binding exists with the parent's schedule result snapshot
+    attached = cp.store.get("ResourceBinding", "web-config-configmap", "default")
+    assert attached.spec.required_by and {
+        t.name for t in attached.spec.required_by[0].clusters
+    } == {"member1", "member2"}
+
+    # the ConfigMap landed on exactly the parent's clusters
+    assert cp.members["member1"].get("v1", "ConfigMap", "web-config", "default") is not None
+    assert cp.members["member2"].get("v1", "ConfigMap", "web-config", "default") is not None
+    assert cp.members["member3"].get("v1", "ConfigMap", "web-config", "default") is None
+
+
+def test_dependency_binding_removed_with_parent():
+    cp = plane()
+    cm = Unstructured(
+        {
+            "apiVersion": "v1",
+            "kind": "ConfigMap",
+            "metadata": {"name": "web-config", "namespace": "default"},
+            "data": {"k": "v"},
+        }
+    )
+    cp.store.create(cm)
+    deploy = _deployment_with_configmap("default", "web", "web-config")
+    cp.store.create(deploy)
+    policy = new_policy(
+        "default", "web-pp", [selector_for(deploy)], duplicated_placement(["member1"])
+    )
+    policy.spec.propagate_deps = True
+    cp.store.create(policy)
+    cp.settle()
+    assert cp.store.try_get("ResourceBinding", "web-config-configmap", "default") is not None
+
+    cp.store.delete("apps/v1/Deployment", "web", "default")
+    cp.settle()
+    assert cp.store.try_get("ResourceBinding", "web-config-configmap", "default") is None
+    assert cp.members["member1"].get("v1", "ConfigMap", "web-config", "default") is None
+
+
+# ---------------------------------------------------------------------------
+# Namespace sync
+# ---------------------------------------------------------------------------
+
+
+def test_namespace_auto_propagation():
+    cp = plane()
+    cp.store.create(
+        Unstructured({"apiVersion": "v1", "kind": "Namespace", "metadata": {"name": "team-a"}})
+    )
+    cp.store.create(
+        Unstructured({"apiVersion": "v1", "kind": "Namespace", "metadata": {"name": "kube-system"}})
+    )
+    cp.store.create(
+        Unstructured(
+            {
+                "apiVersion": "v1",
+                "kind": "Namespace",
+                "metadata": {
+                    "name": "team-b",
+                    "labels": {"namespace.karmada.io/skip-auto-propagation": "true"},
+                },
+            }
+        )
+    )
+    cp.settle()
+    for m in ("member1", "member2", "member3"):
+        assert cp.members[m].get("v1", "Namespace", "team-a") is not None
+        assert cp.members[m].get("v1", "Namespace", "kube-system") is None
+        assert cp.members[m].get("v1", "Namespace", "team-b") is None
+
+    # late-joining cluster catches up
+    cp.join_member(
+        MemberConfig(name="member4", allocatable={CPU: 10.0, MEMORY: 40 * GiB, "pods": 100.0})
+    )
+    cp.settle()
+    assert cp.members["member4"].get("v1", "Namespace", "team-a") is not None
