@@ -37,6 +37,7 @@ from .controllers.remedy import RemedyController
 from .controllers.status import BindingStatusController, WorkStatusController
 from .descheduler.descheduler import Descheduler
 from .detector.detector import ResourceDetector
+from .events import EventRecorder
 from .features import FAILOVER, FeatureGates, GRACEFUL_EVICTION
 from .estimator.client import EstimatorRegistry, MemberEstimators
 from .interpreter.interpreter import ResourceInterpreter
@@ -69,9 +70,14 @@ class ControlPlane:
             "scheduler-estimator", member_estimators
         )
 
+        self.event_recorder = EventRecorder(self.store, clock=self.runtime.clock)
         self.detector = ResourceDetector(self.store, self.interpreter, self.runtime)
         self.scheduler = SchedulerDaemon(
-            self.store, self.runtime, estimator_registry=self.estimator_registry
+            self.store,
+            self.runtime,
+            estimator_registry=self.estimator_registry,
+            gates=self.gates,
+            event_recorder=self.event_recorder,
         )
         self.override_manager = OverrideManager(self.store)
         self.binding_controller = BindingController(

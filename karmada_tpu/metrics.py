@@ -1,0 +1,213 @@
+"""Prometheus-style metrics registry (reference: pkg/scheduler/metrics/metrics.go:61-127,
+pkg/metrics/, pkg/estimator/server/metrics/ — counters + histograms with per-step
+scheduler timing Filter/Score/Select/AssignReplicas :50-57,146-149).
+
+Dependency-free: a process-local registry of counters/gauges/histograms with a
+text exposition (`render()`) matching the Prometheus format closely enough for
+scraping in tests and the CLI `top`-style views.
+"""
+from __future__ import annotations
+
+import bisect
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Optional
+
+_DEFAULT_BUCKETS = (
+    0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0,
+)
+
+
+def _label_key(labels: dict[str, str]) -> tuple:
+    return tuple(sorted(labels.items()))
+
+
+@dataclass
+class Counter:
+    name: str
+    help: str = ""
+    _values: dict[tuple, float] = field(default_factory=dict)
+
+    def inc(self, amount: float = 1.0, **labels: str) -> None:
+        k = _label_key(labels)
+        self._values[k] = self._values.get(k, 0.0) + amount
+
+    def value(self, **labels: str) -> float:
+        return self._values.get(_label_key(labels), 0.0)
+
+    def total(self) -> float:
+        return sum(self._values.values())
+
+
+@dataclass
+class Gauge:
+    name: str
+    help: str = ""
+    _values: dict[tuple, float] = field(default_factory=dict)
+
+    def set(self, v: float, **labels: str) -> None:
+        self._values[_label_key(labels)] = v
+
+    def value(self, **labels: str) -> float:
+        return self._values.get(_label_key(labels), 0.0)
+
+
+@dataclass
+class Histogram:
+    name: str
+    help: str = ""
+    buckets: tuple = _DEFAULT_BUCKETS
+    _counts: dict[tuple, list[int]] = field(default_factory=dict)
+    _sums: dict[tuple, float] = field(default_factory=dict)
+    _totals: dict[tuple, int] = field(default_factory=dict)
+
+    def observe(self, v: float, **labels: str) -> None:
+        k = _label_key(labels)
+        counts = self._counts.setdefault(k, [0] * len(self.buckets))
+        i = bisect.bisect_left(self.buckets, v)
+        if i < len(counts):
+            counts[i] += 1
+        self._sums[k] = self._sums.get(k, 0.0) + v
+        self._totals[k] = self._totals.get(k, 0) + 1
+
+    def count(self, **labels: str) -> int:
+        return self._totals.get(_label_key(labels), 0)
+
+    def sum(self, **labels: str) -> float:
+        return self._sums.get(_label_key(labels), 0.0)
+
+    def quantile(self, q: float, **labels: str) -> float:
+        """Approximate quantile from bucket upper bounds (scrape-side math)."""
+        k = _label_key(labels)
+        counts = self._counts.get(k)
+        total = self._totals.get(k, 0)
+        if not counts or total == 0:
+            return 0.0
+        target = q * total
+        acc = 0
+        for i, c in enumerate(counts):
+            acc += c
+            if acc >= target:
+                return self.buckets[i]
+        return self.buckets[-1]
+
+
+class MetricsRegistry:
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._metrics: dict[str, object] = {}
+
+    def counter(self, name: str, help: str = "") -> Counter:
+        with self._lock:
+            m = self._metrics.get(name)
+            if m is None:
+                m = Counter(name=name, help=help)
+                self._metrics[name] = m
+            return m  # type: ignore[return-value]
+
+    def gauge(self, name: str, help: str = "") -> Gauge:
+        with self._lock:
+            m = self._metrics.get(name)
+            if m is None:
+                m = Gauge(name=name, help=help)
+                self._metrics[name] = m
+            return m  # type: ignore[return-value]
+
+    def histogram(self, name: str, help: str = "", buckets: tuple = _DEFAULT_BUCKETS) -> Histogram:
+        with self._lock:
+            m = self._metrics.get(name)
+            if m is None:
+                m = Histogram(name=name, help=help, buckets=buckets)
+                self._metrics[name] = m
+            return m  # type: ignore[return-value]
+
+    def render(self) -> str:
+        """Prometheus text exposition."""
+        out: list[str] = []
+        with self._lock:
+            metrics = list(self._metrics.values())
+        for m in metrics:
+            if m.help:
+                out.append(f"# HELP {m.name} {m.help}")
+            if isinstance(m, Counter):
+                out.append(f"# TYPE {m.name} counter")
+                for k, v in sorted(m._values.items()):
+                    out.append(f"{m.name}{_fmt_labels(k)} {v}")
+            elif isinstance(m, Gauge):
+                out.append(f"# TYPE {m.name} gauge")
+                for k, v in sorted(m._values.items()):
+                    out.append(f"{m.name}{_fmt_labels(k)} {v}")
+            elif isinstance(m, Histogram):
+                out.append(f"# TYPE {m.name} histogram")
+                for k in sorted(m._totals):
+                    acc = 0
+                    for i, c in enumerate(m._counts[k]):
+                        acc += c
+                        le = ("le", repr(m.buckets[i]))
+                        out.append(f"{m.name}_bucket{_fmt_labels(k + (le,))} {acc}")
+                    inf = ("le", "+Inf")
+                    out.append(f"{m.name}_bucket{_fmt_labels(k + (inf,))} {m._totals[k]}")
+                    out.append(f"{m.name}_sum{_fmt_labels(k)} {m._sums[k]}")
+                    out.append(f"{m.name}_count{_fmt_labels(k)} {m._totals[k]}")
+        return "\n".join(out) + "\n"
+
+
+def _fmt_labels(k: tuple) -> str:
+    if not k:
+        return ""
+    return "{" + ",".join(f'{name}="{val}"' for name, val in k) + "}"
+
+
+# -- the scheduler metric set (metrics.go:61-127) --------------------------
+
+registry = MetricsRegistry()
+
+schedule_attempts = registry.counter(
+    "karmada_scheduler_schedule_attempts_total",
+    "Number of attempts to schedule resourceBinding",
+)
+e2e_scheduling_duration = registry.histogram(
+    "karmada_scheduler_e2e_scheduling_duration_seconds",
+    "E2e scheduling latency in seconds",
+)
+scheduling_algorithm_duration = registry.histogram(
+    "karmada_scheduler_scheduling_algorithm_duration_seconds",
+    "Scheduling algorithm latency in seconds",
+)
+queue_incoming_bindings = registry.counter(
+    "karmada_scheduler_queue_incoming_bindings_total",
+    "Number of bindings added to scheduling queues by event type",
+)
+framework_extension_point_duration = registry.histogram(
+    "karmada_scheduler_framework_extension_point_duration_seconds",
+    "Latency for running all plugins of a specific extension point",
+)
+estimating_request_total = registry.counter(
+    "karmada_estimator_estimating_request_total",
+    "Number of estimating requests handled by the estimator",
+)
+estimating_algorithm_duration = registry.histogram(
+    "karmada_estimator_estimating_algorithm_duration_seconds",
+    "Estimating algorithm latency in seconds",
+)
+descheduler_sweeps = registry.counter(
+    "karmada_descheduler_sweeps_total",
+    "Number of descheduling sweeps",
+)
+
+
+class timed:
+    """Context manager observing wall time into a histogram."""
+
+    def __init__(self, hist: Histogram, **labels: str):
+        self.hist = hist
+        self.labels = labels
+
+    def __enter__(self):
+        self._t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, *exc):
+        self.hist.observe(time.perf_counter() - self._t0, **self.labels)
+        return False

@@ -1,0 +1,140 @@
+"""Priority scheduling queue (SCH3).
+
+Parity with pkg/scheduler/internal/queue/scheduling_queue.go:43-57 under the
+PriorityBasedScheduling feature gate: an activeQ (max-heap by binding priority,
+FIFO among equals), a backoffQ with exponential per-key backoff (1s initial →
+10s max), and an unschedulable pool whose items re-enter activeQ after at most
+5 minutes. The heap mirrors internal/heap/heap.go; priority comes from
+`spec.SchedulePriorityValue()` (event_handler.go:122-137) — here the binding's
+`schedule_priority` (None ⇒ 0).
+
+Implements the same queue interface the controller runtime drains
+(add/pop/retry/forget/len), so it can be dropped into a BatchingController in
+place of the FIFO WorkQueue. Time is injectable (Clock) so backoff windows are
+deterministic in tests.
+"""
+from __future__ import annotations
+
+import heapq
+import itertools
+from typing import Callable, Optional
+
+DEFAULT_BACKOFF_INITIAL = 1.0  # scheduling_queue.go:43-51
+DEFAULT_BACKOFF_MAX = 10.0
+DEFAULT_UNSCHEDULABLE_MAX_STAY = 300.0  # 5 min
+
+
+class PrioritySchedulingQueue:
+    """activeQ + backoffQ + unschedulable pool.
+
+    `priority_fn(key) -> int` resolves a binding key to its current priority at
+    enqueue time (the reference reads spec.SchedulePriorityValue at event time).
+    """
+
+    def __init__(
+        self,
+        clock,
+        priority_fn: Optional[Callable[[str], int]] = None,
+        backoff_initial: float = DEFAULT_BACKOFF_INITIAL,
+        backoff_max: float = DEFAULT_BACKOFF_MAX,
+        unschedulable_max_stay: float = DEFAULT_UNSCHEDULABLE_MAX_STAY,
+        max_retries: int = 16,
+    ):
+        self.clock = clock
+        self.priority_fn = priority_fn or (lambda _key: 0)
+        self.backoff_initial = backoff_initial
+        self.backoff_max = backoff_max
+        self.unschedulable_max_stay = unschedulable_max_stay
+        self.max_retries = max_retries
+
+        self._seq = itertools.count()  # FIFO tie-break among equal priorities
+        self._active: list[tuple[int, int, str]] = []  # (-priority, seq, key)
+        self._in_active: set[str] = set()
+        self._backoff: list[tuple[float, int, str]] = []  # (due, seq, key)
+        self._in_backoff: set[str] = set()
+        self._unschedulable: dict[str, float] = {}  # key -> entered-at
+        self._attempts: dict[str, int] = {}
+
+    # -- queue interface (WorkQueue-compatible) ---------------------------
+
+    def add(self, key: str) -> None:
+        """Add/move to activeQ. An add always wins over backoff/unschedulable
+        (a fresh event means new information — moveToActiveQ semantics)."""
+        self._in_backoff.discard(key)
+        self._unschedulable.pop(key, None)
+        if key in self._in_active:
+            return
+        prio = self.priority_fn(key)
+        heapq.heappush(self._active, (-prio, next(self._seq), key))
+        self._in_active.add(key)
+
+    def pop(self) -> Optional[str]:
+        self._flush()
+        while self._active:
+            _, _, key = heapq.heappop(self._active)
+            if key in self._in_active:
+                self._in_active.discard(key)
+                return key
+        return None
+
+    def retry(self, key: str) -> bool:
+        """Failed attempt → backoffQ with exponential delay."""
+        n = self._attempts.get(key, 0) + 1
+        self._attempts[key] = n
+        if n > self.max_retries:
+            return False
+        delay = min(self.backoff_initial * (2 ** (n - 1)), self.backoff_max)
+        self._push_backoff(key, delay)
+        return True
+
+    def forget(self, key: str) -> None:
+        self._attempts.pop(key, None)
+
+    def __len__(self) -> int:
+        self._flush()
+        return len(self._in_active) + len(self._in_backoff) + len(self._unschedulable)
+
+    # -- scheduler-facing extras ------------------------------------------
+
+    def push_unschedulable(self, key: str) -> None:
+        """Park a binding that found no feasible cluster; it re-enters activeQ
+        after at most `unschedulable_max_stay` (or earlier via add())."""
+        if key in self._in_active or key in self._in_backoff:
+            return
+        self._unschedulable.setdefault(key, self.clock.now())
+
+    def active_len(self) -> int:
+        self._flush()
+        return len(self._in_active)
+
+    # -- internals --------------------------------------------------------
+
+    def _push_backoff(self, key: str, delay: float) -> None:
+        if key in self._in_active or key in self._in_backoff:
+            return
+        heapq.heappush(self._backoff, (self.clock.now() + delay, next(self._seq), key))
+        self._in_backoff.add(key)
+
+    def _flush(self) -> None:
+        """Move due backoff items and expired unschedulable items to activeQ
+        (the reference's flushBackoffQCompleted / flushUnschedulableLeftover)."""
+        now = self.clock.now()
+        while self._backoff and self._backoff[0][0] <= now:
+            _, _, key = heapq.heappop(self._backoff)
+            if key in self._in_backoff:
+                self._in_backoff.discard(key)
+                if key not in self._in_active:
+                    prio = self.priority_fn(key)
+                    heapq.heappush(self._active, (-prio, next(self._seq), key))
+                    self._in_active.add(key)
+        expired = [
+            k
+            for k, entered in self._unschedulable.items()
+            if now - entered >= self.unschedulable_max_stay
+        ]
+        for key in expired:
+            self._unschedulable.pop(key, None)
+            if key not in self._in_active:
+                prio = self.priority_fn(key)
+                heapq.heappush(self._active, (-prio, next(self._seq), key))
+                self._in_active.add(key)

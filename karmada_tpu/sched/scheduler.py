@@ -29,9 +29,18 @@ from ..api.work import (
     REASON_UNSCHEDULABLE,
     ResourceBinding,
 )
+from ..features import FeatureGates, PRIORITY_BASED_SCHEDULING
+from ..metrics import (
+    e2e_scheduling_duration,
+    queue_incoming_bindings,
+    schedule_attempts,
+    scheduling_algorithm_duration,
+    timed,
+)
 from ..runtime.controller import BatchingController, Runtime
 from ..store.store import DELETED, Store
 from .core import ArrayScheduler, ScheduleDecision
+from .queue import PrioritySchedulingQueue
 
 
 def placement_json(placement) -> str:
@@ -47,11 +56,14 @@ class SchedulerDaemon:
         runtime: Runtime,
         scheduler_name: str = DEFAULT_SCHEDULER_NAME,
         estimator_registry=None,
+        gates: Optional[FeatureGates] = None,
+        event_recorder=None,
     ) -> None:
         self.store = store
         self.clock = runtime.clock
         self.scheduler_name = scheduler_name
         self.estimator_registry = estimator_registry
+        self.event_recorder = event_recorder
         self._array: Optional[ArrayScheduler] = None
         self._fleet_dirty = True
         self.controller = runtime.register(
@@ -59,6 +71,12 @@ class SchedulerDaemon:
                 name="scheduler", reconcile=None, reconcile_batch=self._schedule_batch
             )
         )
+        if gates is not None and gates.enabled(PRIORITY_BASED_SCHEDULING):
+            # swap the FIFO for the activeQ/backoffQ/unschedulable-pool queue
+            # (scheduling_queue.go:43-57 under the PriorityBasedScheduling gate)
+            self.controller.queue = PrioritySchedulingQueue(
+                self.clock, priority_fn=self._priority_of
+            )
         store.watch("ResourceBinding", self._on_binding)
         store.watch("Cluster", self._on_cluster)
 
@@ -71,7 +89,15 @@ class SchedulerDaemon:
             return
         if rb.spec.scheduling_suspended():
             return
+        queue_incoming_bindings.inc(event=event)
         self.controller.enqueue(rb.metadata.key())
+
+    def _priority_of(self, key: str) -> int:
+        ns, _, name = key.partition("/")
+        rb = self.store.try_get("ResourceBinding", name, ns)
+        if rb is None or rb.spec.schedule_priority is None:
+            return 0
+        return rb.spec.schedule_priority
 
     def _on_cluster(self, event: str, cluster) -> None:
         self._fleet_dirty = True
@@ -132,15 +158,18 @@ class SchedulerDaemon:
                 self.store.update(rb)
         if not bindings:
             return []
-        array = self._ensure_fleet()
-        extra_avail = None
-        if self.estimator_registry is not None:
-            extra_avail = self.estimator_registry.batch_estimates(
-                bindings, array.fleet.names
-            )
-        decisions = array.schedule(bindings, extra_avail=extra_avail)
-        for rb, decision in zip(bindings, decisions):
-            self._patch_result(rb, decision)
+        with timed(e2e_scheduling_duration):
+            array = self._ensure_fleet()
+            extra_avail = None
+            if self.estimator_registry is not None:
+                extra_avail = self.estimator_registry.batch_estimates(
+                    bindings, array.fleet.names
+                )
+            with timed(scheduling_algorithm_duration):
+                decisions = array.schedule(bindings, extra_avail=extra_avail)
+            for rb, decision in zip(bindings, decisions):
+                schedule_attempts.inc(result="scheduled" if decision.ok else "error")
+                self._patch_result(rb, decision)
         return []
 
     def _patch_result(self, rb: ResourceBinding, decision: ScheduleDecision) -> None:
@@ -183,6 +212,9 @@ class SchedulerDaemon:
                 if "not enough" in decision.error or "available" in decision.error
                 else REASON_SCHEDULE_FAILED
             )
+            if isinstance(self.controller.queue, PrioritySchedulingQueue):
+                # park until new information arrives (≤5 min max stay)
+                self.controller.queue.push_unschedulable(fresh.metadata.key())
             if not set_condition(
                 fresh.status.conditions,
                 Condition(
@@ -194,6 +226,25 @@ class SchedulerDaemon:
             ):
                 return
         self.store.update(fresh)
+        if self.event_recorder is not None:
+            # recorded on the binding (scheduler.go:964-1010); the binding
+            # status controller mirrors template-side visibility
+            from ..events import (
+                REASON_SCHEDULE_BINDING_FAILED,
+                REASON_SCHEDULE_BINDING_SUCCEED,
+                TYPE_NORMAL,
+                TYPE_WARNING,
+            )
+
+            if decision.ok:
+                self.event_recorder.event(
+                    fresh, TYPE_NORMAL, REASON_SCHEDULE_BINDING_SUCCEED,
+                    "Binding has been scheduled successfully.",
+                )
+            else:
+                self.event_recorder.event(
+                    fresh, TYPE_WARNING, REASON_SCHEDULE_BINDING_FAILED, decision.error
+                )
 
 
 def _targets_fingerprint(targets) -> tuple:

@@ -1,0 +1,177 @@
+"""Priority scheduling queue (SCH3), events registry (U6), metrics (§5)."""
+from __future__ import annotations
+
+from karmada_tpu.api.meta import ObjectMeta
+from karmada_tpu.api.work import BindingSpec, ObjectReference, ResourceBinding
+from karmada_tpu.events import (
+    EventRecorder,
+    REASON_SCHEDULE_BINDING_SUCCEED,
+    TYPE_NORMAL,
+)
+from karmada_tpu.features import FeatureGates, PRIORITY_BASED_SCHEDULING
+from karmada_tpu.metrics import MetricsRegistry, schedule_attempts
+from karmada_tpu.runtime.controller import Clock
+from karmada_tpu.sched.queue import PrioritySchedulingQueue
+from karmada_tpu.store.store import Store
+from karmada_tpu.controlplane import ControlPlane
+from karmada_tpu.members.member import MemberConfig
+from karmada_tpu.testing.fixtures import (
+    duplicated_placement,
+    new_deployment,
+    new_policy,
+    selector_for,
+)
+
+
+def _propagate(cp: ControlPlane, name: str = "web"):
+    dep = new_deployment("default", name, replicas=1)
+    cp.store.create(dep)
+    cp.store.create(
+        new_policy("default", f"pp-{name}", [selector_for(dep)], duplicated_placement())
+    )
+
+
+def make_queue(clock=None, priorities=None):
+    clock = clock or Clock(fixed=1000.0)
+    priorities = priorities or {}
+    return clock, PrioritySchedulingQueue(
+        clock, priority_fn=lambda k: priorities.get(k, 0)
+    )
+
+
+class TestPriorityQueue:
+    def test_pop_order_by_priority_then_fifo(self):
+        _, q = make_queue(priorities={"b/high": 10, "b/low": 1})
+        q.add("b/first")
+        q.add("b/high")
+        q.add("b/low")
+        q.add("b/second")
+        assert q.pop() == "b/high"
+        assert q.pop() == "b/low"
+        assert q.pop() == "b/first"  # FIFO among priority 0
+        assert q.pop() == "b/second"
+        assert q.pop() is None
+
+    def test_backoff_exponential_window(self):
+        clock, q = make_queue()
+        q.add("b/x")
+        assert q.pop() == "b/x"
+        assert q.retry("b/x")  # 1s backoff
+        assert q.pop() is None  # not due yet
+        clock.advance(1.0)
+        assert q.pop() == "b/x"
+        assert q.retry("b/x")  # 2s backoff
+        clock.advance(1.0)
+        assert q.pop() is None
+        clock.advance(1.0)
+        assert q.pop() == "b/x"
+        # attempts 5+ cap at 10s (1,2,4,8,10)
+        for _ in range(3):
+            assert q.retry("b/x")
+            clock.advance(10.0)
+            assert q.pop() == "b/x"
+
+    def test_add_overrides_backoff(self):
+        clock, q = make_queue()
+        q.add("b/x")
+        q.pop()
+        q.retry("b/x")
+        q.add("b/x")  # fresh event wins over backoff
+        assert q.pop() == "b/x"
+
+    def test_unschedulable_pool_max_stay(self):
+        clock, q = make_queue()
+        q.push_unschedulable("b/stuck")
+        assert q.pop() is None
+        clock.advance(299.0)
+        assert q.pop() is None
+        clock.advance(1.0)
+        assert q.pop() == "b/stuck"
+
+    def test_unschedulable_reactivated_by_add(self):
+        _, q = make_queue()
+        q.push_unschedulable("b/stuck")
+        q.add("b/stuck")  # new cluster event re-activates immediately
+        assert q.pop() == "b/stuck"
+
+    def test_forget_resets_attempts(self):
+        clock, q = make_queue()
+        q.add("b/x")
+        q.pop()
+        q.retry("b/x")
+        clock.advance(1.0)
+        q.pop()
+        q.forget("b/x")
+        q.add("b/x")
+        q.pop()
+        assert q.retry("b/x")
+        clock.advance(1.0)  # back to initial 1s backoff
+        assert q.pop() == "b/x"
+
+
+class TestEvents:
+    def test_record_and_dedup(self):
+        store = Store()
+        rec = EventRecorder(store, clock=Clock(fixed=1.0))
+        rb = ResourceBinding(
+            metadata=ObjectMeta(name="rb", namespace="default"),
+            spec=BindingSpec(resource=ObjectReference(kind="Deployment", name="d")),
+        )
+        rec.event(rb, TYPE_NORMAL, REASON_SCHEDULE_BINDING_SUCCEED, "ok")
+        rec.event(rb, TYPE_NORMAL, REASON_SCHEDULE_BINDING_SUCCEED, "ok")
+        evs = rec.events_for(rb)
+        assert len(evs) == 1
+        assert evs[0].count == 2
+        rec.event(rb, TYPE_NORMAL, REASON_SCHEDULE_BINDING_SUCCEED, "other msg")
+        assert len(rec.events_for(rb)) == 2
+
+    def test_ring_bound(self):
+        store = Store()
+        rec = EventRecorder(store, clock=Clock(fixed=1.0), max_events=5)
+        for i in range(10):
+            rb = ResourceBinding(
+                metadata=ObjectMeta(name=f"rb{i}", namespace="default"),
+                spec=BindingSpec(resource=ObjectReference(kind="Deployment", name="d")),
+            )
+            rec.event(rb, TYPE_NORMAL, "R", f"m{i}")
+        assert len(store.list("Event")) == 5
+
+
+class TestMetrics:
+    def test_counter_and_histogram(self):
+        reg = MetricsRegistry()
+        c = reg.counter("c_total")
+        c.inc(result="ok")
+        c.inc(result="ok")
+        c.inc(result="err")
+        assert c.value(result="ok") == 2
+        h = reg.histogram("h_seconds")
+        for v in (0.002, 0.02, 0.2, 2.0):
+            h.observe(v)
+        assert h.count() == 4
+        assert h.quantile(0.5) <= 0.025
+        text = reg.render()
+        assert 'c_total{result="ok"} 2' in text
+        assert "h_seconds_count 4" in text
+
+    def test_scheduler_increments_attempts(self):
+        before = schedule_attempts.value(result="scheduled")
+        cp = ControlPlane()
+        cp.join_member(MemberConfig(name="m1", allocatable={"cpu": 10.0}))
+        _propagate(cp)
+        cp.settle()
+        assert schedule_attempts.value(result="scheduled") > before
+
+
+class TestPriorityScheduling:
+    def test_gate_swaps_queue_and_still_schedules(self):
+        gates = FeatureGates({PRIORITY_BASED_SCHEDULING: True})
+        cp = ControlPlane(gates=gates)
+        assert isinstance(cp.scheduler.controller.queue, PrioritySchedulingQueue)
+        cp.join_member(MemberConfig(name="m1", allocatable={"cpu": 10.0}))
+        _propagate(cp)
+        cp.settle()
+        rb = next(iter(cp.store.list("ResourceBinding")))
+        assert rb.spec.clusters and rb.spec.clusters[0].name == "m1"
+        evs = cp.event_recorder.events_for(rb)
+        assert any(e.reason == REASON_SCHEDULE_BINDING_SUCCEED for e in evs)
