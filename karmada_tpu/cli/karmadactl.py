@@ -1,0 +1,507 @@
+"""karmadactl — the operator CLI (reference: pkg/karmadactl/, 30+ subcommands,
+cmd/karmadactl + cmd/kubectl-karmada thin cobra mains).
+
+Library-first: every subcommand is a function taking the live ControlPlane and
+parsed args and returning the text it would print, so tests and embedding
+drive commands directly (`run(cp, ["get", "clusters"])`). `main()` wires an
+argparse front-end around a demo plane or a state file.
+
+Covered subcommands and their reference counterparts:
+  join/unjoin           pkg/karmadactl/join, unjoin (push-mode registration)
+  register/unregister   pkg/karmadactl/register (pull-mode agent bootstrap)
+  cordon/uncordon       pkg/karmadactl/cordon (the cordoned NoSchedule taint)
+  taint                 pkg/karmadactl/taint
+  get/describe          pkg/karmadactl/get, describe (multi-cluster aware)
+  top                   pkg/karmadactl/top (cluster resource usage)
+  interpret             pkg/karmadactl/interpret (dry-run interpreter ops)
+  promote               pkg/karmadactl/promote (member resource → template+policy)
+  apply                 pkg/karmadactl/apply (template + auto PropagationPolicy)
+  deschedule            trigger a descheduler sweep
+  rebalance             create a WorkloadRebalancer for listed workloads
+  exec/logs             cluster-proxy passthrough (U9): resolves the member
+                        object through the aggregated proxy view
+"""
+from __future__ import annotations
+
+import argparse
+import json
+from typing import Optional
+
+from ..api.cluster import (
+    EFFECT_NO_SCHEDULE,
+    Taint,
+    cluster_ready,
+)
+from ..api.apps import (
+    RebalancerObjectReference,
+    WorkloadRebalancer,
+    WorkloadRebalancerSpec,
+)
+from ..api.meta import ObjectMeta
+from ..api.policy import (
+    ClusterAffinity,
+    Placement,
+    PropagationPolicy,
+    PropagationSpec,
+    ResourceSelector,
+)
+from ..api.unstructured import Unstructured
+from ..controlplane import ControlPlane
+from ..members.member import MemberConfig
+
+CORDON_TAINT_KEY = "cluster.karmada.io/cordoned"  # pkg/karmadactl/cordon
+
+
+class CLIError(Exception):
+    pass
+
+
+# -- cluster lifecycle -----------------------------------------------------
+
+
+def cmd_join(cp: ControlPlane, name: str, *, provider: str = "", region: str = "",
+             zone: str = "", labels: Optional[dict[str, str]] = None,
+             allocatable: Optional[dict[str, float]] = None) -> str:
+    if cp.store.try_get("Cluster", name) is not None:
+        raise CLIError(f"cluster {name} already joined")
+    cp.join_member(
+        MemberConfig(
+            name=name,
+            provider=provider,
+            region=region,
+            zone=zone,
+            labels=dict(labels or {}),
+            allocatable=dict(allocatable or {"cpu": 100.0, "memory": 400.0, "pods": 110.0}),
+            sync_mode="Push",
+        )
+    )
+    cp.settle()
+    return f"cluster {name} joined (Push mode)"
+
+
+def cmd_register(cp: ControlPlane, name: str, **kw) -> str:
+    """Pull-mode registration: the agent creates the Cluster object itself
+    (agent.go:437 generateClusterInControllerPlane); here we simulate the
+    agent's bootstrap by joining with SyncMode=Pull."""
+    if cp.store.try_get("Cluster", name) is not None:
+        raise CLIError(f"cluster {name} already registered")
+    cfg = MemberConfig(
+        name=name,
+        provider=kw.get("provider", ""),
+        region=kw.get("region", ""),
+        zone=kw.get("zone", ""),
+        labels=dict(kw.get("labels") or {}),
+        allocatable=dict(kw.get("allocatable") or {"cpu": 100.0, "memory": 400.0, "pods": 110.0}),
+        sync_mode="Pull",
+    )
+    cp.join_member(cfg)
+    cp.settle()
+    return f"cluster {name} registered (Pull mode)"
+
+
+def _remove_cluster(cp: ControlPlane, name: str) -> None:
+    if cp.store.try_get("Cluster", name) is None:
+        raise CLIError(f"cluster {name} not found")
+    cp.store.delete("Cluster", name)
+    cp.members.pop(name, None)
+    cp.settle()
+
+
+def cmd_unjoin(cp: ControlPlane, name: str) -> str:
+    _remove_cluster(cp, name)
+    return f"cluster {name} unjoined"
+
+
+def cmd_unregister(cp: ControlPlane, name: str) -> str:
+    _remove_cluster(cp, name)
+    return f"cluster {name} unregistered"
+
+
+# -- cordon / taint --------------------------------------------------------
+
+
+def _set_taint(cp: ControlPlane, cluster_name: str, taint: Taint, add: bool) -> None:
+    cluster = cp.store.try_get("Cluster", cluster_name)
+    if cluster is None:
+        raise CLIError(f"cluster {cluster_name} not found")
+    taints = [t for t in cluster.spec.taints if not (t.key == taint.key and t.effect == taint.effect)]
+    if add:
+        taints.append(taint)
+    cluster.spec.taints = taints
+    cp.store.update(cluster)
+    cp.settle()
+
+
+def cmd_cordon(cp: ControlPlane, name: str) -> str:
+    _set_taint(cp, name, Taint(key=CORDON_TAINT_KEY, effect=EFFECT_NO_SCHEDULE), add=True)
+    return f"cluster {name} cordoned"
+
+
+def cmd_uncordon(cp: ControlPlane, name: str) -> str:
+    _set_taint(cp, name, Taint(key=CORDON_TAINT_KEY, effect=EFFECT_NO_SCHEDULE), add=False)
+    return f"cluster {name} uncordoned"
+
+
+def cmd_taint(cp: ControlPlane, name: str, spec: str) -> str:
+    """`karmadactl taint clusters NAME key=value:Effect` (suffix `-` removes)."""
+    remove = spec.endswith("-")
+    body = spec[:-1] if remove else spec
+    kv, sep, effect = body.rpartition(":")
+    if not sep or effect not in ("NoSchedule", "PreferNoSchedule", "NoExecute"):
+        raise CLIError(f"invalid taint spec {spec!r} (want key[=value]:Effect[-])")
+    key, _, value = kv.partition("=")
+    _set_taint(cp, name, Taint(key=key, value=value, effect=effect), add=not remove)
+    return f"cluster {name} {'untainted' if remove else 'tainted'} {key}:{effect}"
+
+
+# -- get / describe / top --------------------------------------------------
+
+_KIND_ALIASES = {
+    "cluster": "Cluster", "clusters": "Cluster",
+    "rb": "ResourceBinding", "resourcebinding": "ResourceBinding",
+    "resourcebindings": "ResourceBinding",
+    "work": "Work", "works": "Work",
+    "pp": "PropagationPolicy", "propagationpolicy": "PropagationPolicy",
+    "propagationpolicies": "PropagationPolicy",
+    "cpp": "ClusterPropagationPolicy",
+    "clusterpropagationpolicy": "ClusterPropagationPolicy",
+    "clusterpropagationpolicies": "ClusterPropagationPolicy",
+    "op": "OverridePolicy", "overridepolicy": "OverridePolicy",
+    "overridepolicies": "OverridePolicy",
+    "event": "Event", "events": "Event",
+    "deployment": "apps/v1/Deployment", "deployments": "apps/v1/Deployment",
+}
+
+
+def _resolve_kind(kind: str) -> str:
+    return _KIND_ALIASES.get(kind.lower(), kind)
+
+
+def _fmt_table(rows: list[list[str]], headers: list[str]) -> str:
+    table = [headers] + rows
+    widths = [max(len(str(r[i])) for r in table) for i in range(len(headers))]
+    return "\n".join(
+        "  ".join(str(c).ljust(w) for c, w in zip(r, widths)).rstrip() for r in table
+    )
+
+
+def cmd_get(cp: ControlPlane, kind: str, name: str = "", namespace: str = "",
+            cluster: str = "") -> str:
+    """Multi-cluster aware get: with --cluster, reads the member's object via
+    the proxy view (get.go's operation-scope Members)."""
+    resolved = _resolve_kind(kind)
+    if cluster:
+        member = cp.members.get(cluster)
+        if member is None:
+            raise CLIError(f"cluster {cluster} not found")
+        objs = [
+            o for o in member.objects()
+            if o.kind.lower() == kind.rstrip("s").lower() or f"{o.api_version}/{o.kind}" == resolved
+        ]
+        if name:
+            objs = [o for o in objs if o.name == name]
+        rows = [[o.namespace or "-", o.name, cluster] for o in objs]
+        return _fmt_table(rows, ["NAMESPACE", "NAME", "CLUSTER"])
+
+    objs = cp.store.list(resolved, namespace)
+    if name:
+        objs = [o for o in objs if o.metadata.name == name]
+        if not objs:
+            raise CLIError(f"{resolved} {name!r} not found")
+    if resolved == "Cluster":
+        rows = [
+            [
+                c.metadata.name,
+                c.spec.sync_mode,
+                "True" if cluster_ready(c) else "False",
+                c.status.kubernetes_version,
+            ]
+            for c in sorted(objs, key=lambda c: c.metadata.name)
+        ]
+        return _fmt_table(rows, ["NAME", "MODE", "READY", "VERSION"])
+    if resolved == "ResourceBinding":
+        rows = [
+            [
+                b.metadata.namespace,
+                b.metadata.name,
+                ",".join(f"{t.name}:{t.replicas}" for t in b.spec.clusters) or "<pending>",
+            ]
+            for b in sorted(objs, key=lambda b: (b.metadata.namespace, b.metadata.name))
+        ]
+        return _fmt_table(rows, ["NAMESPACE", "NAME", "SCHEDULED"])
+    if resolved == "Event":
+        rows = [
+            [e.involved_kind, f"{e.involved_namespace}/{e.involved_name}".lstrip("/"),
+             e.type, e.reason, str(e.count)]
+            for e in objs
+        ]
+        return _fmt_table(rows, ["KIND", "OBJECT", "TYPE", "REASON", "COUNT"])
+    rows = [
+        [getattr(o.metadata, "namespace", "") or "-", o.metadata.name]
+        for o in sorted(objs, key=lambda o: (o.metadata.namespace, o.metadata.name))
+    ]
+    return _fmt_table(rows, ["NAMESPACE", "NAME"])
+
+
+def cmd_describe(cp: ControlPlane, kind: str, name: str, namespace: str = "") -> str:
+    resolved = _resolve_kind(kind)
+    obj = cp.store.try_get(resolved, name, namespace)
+    if obj is None:
+        raise CLIError(f"{resolved} {name!r} not found")
+    if isinstance(obj, Unstructured):
+        return json.dumps(obj.to_dict(), indent=2, sort_keys=True, default=str)
+    import dataclasses
+
+    return json.dumps(dataclasses.asdict(obj), indent=2, sort_keys=True, default=str)
+
+
+def cmd_top(cp: ControlPlane) -> str:
+    """`karmadactl top clusters`: per-cluster allocatable vs allocated."""
+    rows = []
+    for c in sorted(cp.store.list("Cluster"), key=lambda c: c.metadata.name):
+        rs = c.status.resource_summary
+        if rs is None:
+            rows.append([c.metadata.name, "-", "-", "-"])
+            continue
+        cpu_alloc = rs.allocatable.get("cpu", 0.0)
+        cpu_used = rs.allocated.get("cpu", 0.0)
+        mem_alloc = rs.allocatable.get("memory", 0.0)
+        mem_used = rs.allocated.get("memory", 0.0)
+        rows.append(
+            [
+                c.metadata.name,
+                f"{cpu_used:g}/{cpu_alloc:g}",
+                f"{mem_used:g}/{mem_alloc:g}",
+                f"{(cpu_used / cpu_alloc * 100) if cpu_alloc else 0:.0f}%",
+            ]
+        )
+    return _fmt_table(rows, ["NAME", "CPU(used/alloc)", "MEMORY(used/alloc)", "CPU%"])
+
+
+# -- interpret / promote / apply ------------------------------------------
+
+
+def cmd_interpret(cp: ControlPlane, manifest: dict, operation: str,
+                  desired: Optional[dict] = None, replicas: int = 0) -> str:
+    """Dry-run an interpreter operation against a manifest
+    (pkg/karmadactl/interpret — test customizations without propagating)."""
+    obj = Unstructured(manifest)
+    if operation == "replica":
+        n, req = cp.interpreter.get_replicas(obj)
+        return json.dumps({"replicas": n, "requirements": None if req is None else req.resource_request})
+    if operation == "reviseReplica":
+        out = cp.interpreter.revise_replica(obj, replicas)
+        return json.dumps(out.to_dict(), sort_keys=True)
+    if operation == "retain":
+        out = cp.interpreter.retain(Unstructured(desired or manifest), obj)
+        return json.dumps(out.to_dict(), sort_keys=True)
+    if operation == "health":
+        return json.dumps({"healthy": cp.interpreter.interpret_health(obj)})
+    if operation == "status":
+        return json.dumps({"status": cp.interpreter.reflect_status(obj)})
+    if operation == "dependencies":
+        return json.dumps({"dependencies": cp.interpreter.get_dependencies(obj)})
+    raise CLIError(f"unknown interpret operation {operation!r}")
+
+
+def cmd_promote(cp: ControlPlane, cluster: str, kind: str, name: str,
+                namespace: str = "") -> str:
+    """Promote a member-cluster resource into the control plane: copy the
+    object as a template and create a PropagationPolicy pinning it to the
+    source cluster (pkg/karmadactl/promote)."""
+    member = cp.members.get(cluster)
+    if member is None:
+        raise CLIError(f"cluster {cluster} not found")
+    found = None
+    for o in member.objects():
+        if o.kind.lower() == kind.lower() and o.name == name and (not namespace or o.namespace == namespace):
+            found = o
+            break
+    if found is None:
+        raise CLIError(f"{kind} {name!r} not found in cluster {cluster}")
+    template = Unstructured(json.loads(json.dumps(found.to_dict(), default=str)))
+    d = template.to_dict()
+    d.get("metadata", {}).pop("resourceVersion", None)
+    d.pop("status", None)
+    if cp.store.try_get(f"{template.api_version}/{template.kind}", template.name, template.namespace) is None:
+        cp.store.create(Unstructured(d))
+    policy = PropagationPolicy(
+        metadata=ObjectMeta(name=f"promote-{name}", namespace=template.namespace or "default"),
+        spec=PropagationSpec(
+            resource_selectors=[
+                ResourceSelector(
+                    api_version=template.api_version,
+                    kind=template.kind,
+                    namespace=template.namespace,
+                    name=template.name,
+                )
+            ],
+            placement=Placement(cluster_affinity=ClusterAffinity(cluster_names=[cluster])),
+        ),
+    )
+    cp.store.create(policy)
+    cp.settle()
+    return f"{kind}/{name} promoted from cluster {cluster}"
+
+
+def cmd_apply(cp: ControlPlane, manifest: dict, all_clusters: bool = False) -> str:
+    """Apply a template; with --all-clusters also create a matching
+    PropagationPolicy to every cluster (pkg/karmadactl/apply)."""
+    obj = Unstructured(manifest)
+    cp.store.apply(obj)
+    msg = f"{obj.kind}/{obj.name} applied"
+    if all_clusters:
+        policy = PropagationPolicy(
+            metadata=ObjectMeta(name=f"{obj.name}-propagation", namespace=obj.namespace or "default"),
+            spec=PropagationSpec(
+                resource_selectors=[
+                    ResourceSelector(
+                        api_version=obj.api_version,
+                        kind=obj.kind,
+                        namespace=obj.namespace,
+                        name=obj.name,
+                    )
+                ],
+                placement=Placement(cluster_affinity=ClusterAffinity()),
+            ),
+        )
+        cp.store.apply(policy)
+        msg += " (+ PropagationPolicy to all clusters)"
+    cp.settle()
+    return msg
+
+
+# -- rescheduling ----------------------------------------------------------
+
+
+def cmd_deschedule(cp: ControlPlane) -> str:
+    n = cp.run_descheduler()
+    return f"descheduled {n} binding(s)"
+
+
+def cmd_rebalance(cp: ControlPlane, workloads: list[tuple[str, str, str, str]]) -> str:
+    """Create a WorkloadRebalancer over (apiVersion, kind, namespace, name)."""
+    ref_list = [
+        RebalancerObjectReference(api_version=av, kind=k, namespace=ns, name=n)
+        for av, k, ns, n in workloads
+    ]
+    rb = WorkloadRebalancer(
+        metadata=ObjectMeta(name=f"rebalance-{abs(hash(tuple(workloads))) % 10_000}"),
+        spec=WorkloadRebalancerSpec(workloads=ref_list),
+    )
+    cp.store.create(rb)
+    cp.tick()
+    return f"WorkloadRebalancer {rb.metadata.name} created for {len(ref_list)} workload(s)"
+
+
+# -- argparse front-end ----------------------------------------------------
+
+
+def run(cp: ControlPlane, argv: list[str]) -> str:
+    """Parse argv and execute against the given plane; returns output text."""
+    parser = argparse.ArgumentParser(prog="karmadactl", add_help=True)
+    sub = parser.add_subparsers(dest="command", required=True)
+
+    for cmd in ("join", "register"):
+        p = sub.add_parser(cmd)
+        p.add_argument("name")
+        p.add_argument("--provider", default="")
+        p.add_argument("--region", default="")
+        p.add_argument("--zone", default="")
+    for cmd in ("unjoin", "unregister", "cordon", "uncordon"):
+        p = sub.add_parser(cmd)
+        p.add_argument("name")
+    p = sub.add_parser("taint")
+    p.add_argument("resource", choices=["clusters", "cluster"])
+    p.add_argument("name")
+    p.add_argument("spec")
+    p = sub.add_parser("get")
+    p.add_argument("kind")
+    p.add_argument("name", nargs="?", default="")
+    p.add_argument("-n", "--namespace", default="")
+    p.add_argument("--cluster", default="")
+    p = sub.add_parser("describe")
+    p.add_argument("kind")
+    p.add_argument("name")
+    p.add_argument("-n", "--namespace", default="")
+    p = sub.add_parser("top")
+    p.add_argument("resource", nargs="?", default="clusters")
+    p = sub.add_parser("interpret")
+    p.add_argument("--operation", required=True)
+    p.add_argument("-f", "--filename", required=True)
+    p.add_argument("--desired-file", default="")
+    p.add_argument("--replicas", type=int, default=0)
+    p = sub.add_parser("apply")
+    p.add_argument("-f", "--filename", required=True)
+    p.add_argument("--all-clusters", action="store_true")
+    p = sub.add_parser("promote")
+    p.add_argument("kind")
+    p.add_argument("name")
+    p.add_argument("-C", "--cluster", required=True)
+    p.add_argument("-n", "--namespace", default="")
+    sub.add_parser("deschedule")
+    p = sub.add_parser("rebalance")
+    p.add_argument("workloads", nargs="+", help="apiVersion:Kind:namespace:name")
+
+    args = parser.parse_args(argv)
+
+    if args.command in ("join", "register"):
+        fn = cmd_join if args.command == "join" else cmd_register
+        return fn(cp, args.name, provider=args.provider, region=args.region, zone=args.zone)
+    if args.command == "unjoin":
+        return cmd_unjoin(cp, args.name)
+    if args.command == "unregister":
+        return cmd_unregister(cp, args.name)
+    if args.command == "cordon":
+        return cmd_cordon(cp, args.name)
+    if args.command == "uncordon":
+        return cmd_uncordon(cp, args.name)
+    if args.command == "taint":
+        return cmd_taint(cp, args.name, args.spec)
+    if args.command == "get":
+        return cmd_get(cp, args.kind, args.name, args.namespace, args.cluster)
+    if args.command == "describe":
+        return cmd_describe(cp, args.kind, args.name, args.namespace)
+    if args.command == "top":
+        return cmd_top(cp)
+    if args.command == "interpret":
+        with open(args.filename) as f:
+            manifest = json.load(f)
+        desired = None
+        if args.desired_file:
+            with open(args.desired_file) as f:
+                desired = json.load(f)
+        return cmd_interpret(cp, manifest, args.operation, desired, args.replicas)
+    if args.command == "apply":
+        with open(args.filename) as f:
+            manifest = json.load(f)
+        return cmd_apply(cp, manifest, all_clusters=args.all_clusters)
+    if args.command == "promote":
+        return cmd_promote(cp, args.cluster, args.kind, args.name, args.namespace)
+    if args.command == "deschedule":
+        return cmd_deschedule(cp)
+    if args.command == "rebalance":
+        workloads = []
+        for w in args.workloads:
+            parts = w.split(":")
+            if len(parts) != 4:
+                raise CLIError(f"invalid workload ref {w!r} (want apiVersion:Kind:namespace:name)")
+            workloads.append(tuple(parts))
+        return cmd_rebalance(cp, workloads)
+    raise CLIError(f"unknown command {args.command!r}")
+
+
+def main(argv: Optional[list[str]] = None) -> int:
+    import sys
+
+    cp = ControlPlane()
+    try:
+        print(run(cp, argv if argv is not None else sys.argv[1:]))
+    except CLIError as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

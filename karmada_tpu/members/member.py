@@ -69,6 +69,13 @@ class InMemoryMember:
     def get(self, api_version: str, kind: str, name: str, namespace: str = "") -> Optional[Unstructured]:
         return self.store.try_get(f"{api_version}/{kind}", name, namespace)
 
+    def objects(self) -> list[Unstructured]:
+        """Every object on the member, across kinds (proxy/CLI listing)."""
+        out: list[Unstructured] = []
+        for kind in self.store.kinds():
+            out.extend(self.store.list(kind))
+        return out
+
     def _run_controllers(self, obj: Unstructured) -> None:
         """Simulated member-side controllers: set status on workloads."""
         key = f"{obj.api_version}/{obj.kind}"

@@ -1,0 +1,150 @@
+"""karmadactl CLI (U7): join/cordon/taint/get/top/interpret/promote/rebalance."""
+from __future__ import annotations
+
+import json
+
+import pytest
+
+from karmada_tpu.cli.karmadactl import CLIError, run
+from karmada_tpu.controlplane import ControlPlane
+from karmada_tpu.members.member import MemberConfig
+from karmada_tpu.testing.fixtures import (
+    duplicated_placement,
+    new_deployment,
+    new_policy,
+    selector_for,
+)
+
+
+@pytest.fixture
+def cp():
+    return ControlPlane()
+
+
+def propagate_web(cp, replicas=2):
+    dep = new_deployment("default", "web", replicas=replicas, cpu=0.1)
+    cp.store.create(dep)
+    cp.store.create(new_policy("default", "pp-web", [selector_for(dep)], duplicated_placement()))
+    cp.settle()
+    return dep
+
+
+class TestLifecycle:
+    def test_join_get_unjoin(self, cp):
+        out = run(cp, ["join", "m1", "--region", "us-east1"])
+        assert "joined" in out
+        out = run(cp, ["get", "clusters"])
+        assert "m1" in out and "Push" in out and "True" in out
+        assert run(cp, ["unjoin", "m1"]).startswith("cluster m1 unjoined")
+        assert "m1" not in run(cp, ["get", "clusters"])
+
+    def test_register_pull_mode(self, cp):
+        run(cp, ["register", "edge-1"])
+        assert "Pull" in run(cp, ["get", "clusters"])
+        run(cp, ["unregister", "edge-1"])
+
+    def test_join_duplicate_fails(self, cp):
+        run(cp, ["join", "m1"])
+        with pytest.raises(CLIError):
+            run(cp, ["join", "m1"])
+
+
+class TestCordonTaint:
+    def test_cordon_excludes_from_scheduling(self, cp):
+        run(cp, ["join", "m1"])
+        run(cp, ["join", "m2"])
+        run(cp, ["cordon", "m2"])
+        propagate_web(cp)
+        rb = next(iter(cp.store.list("ResourceBinding")))
+        names = [t.name for t in rb.spec.clusters]
+        assert names == ["m1"]
+        run(cp, ["uncordon", "m2"])
+        cp.settle()
+        rb = next(iter(cp.store.list("ResourceBinding")))
+        assert sorted(t.name for t in rb.spec.clusters) == ["m1", "m2"]
+
+    def test_taint_add_remove(self, cp):
+        run(cp, ["join", "m1"])
+        run(cp, ["taint", "clusters", "m1", "dedicated=infra:NoSchedule"])
+        cluster = cp.store.get("Cluster", "m1")
+        assert any(t.key == "dedicated" and t.effect == "NoSchedule" for t in cluster.spec.taints)
+        run(cp, ["taint", "clusters", "m1", "dedicated=infra:NoSchedule-"])
+        cluster = cp.store.get("Cluster", "m1")
+        assert not cluster.spec.taints
+
+    def test_taint_bad_spec(self, cp):
+        run(cp, ["join", "m1"])
+        with pytest.raises(CLIError):
+            run(cp, ["taint", "clusters", "m1", "no-effect"])
+
+
+class TestGetDescribeTop:
+    def test_get_bindings_and_describe(self, cp):
+        run(cp, ["join", "m1"])
+        propagate_web(cp)
+        out = run(cp, ["get", "rb"])
+        assert "web" in out and "m1:2" in out
+        desc = run(cp, ["describe", "cluster", "m1"])
+        assert json.loads(desc)["metadata"]["name"] == "m1"
+
+    def test_get_from_member_cluster(self, cp):
+        run(cp, ["join", "m1"])
+        propagate_web(cp)
+        out = run(cp, ["get", "deployments", "--cluster", "m1"])
+        assert "web" in out and "m1" in out
+
+    def test_top(self, cp):
+        cp.join_member(MemberConfig(name="m1", allocatable={"cpu": 10.0, "memory": 40.0},
+                                    allocated={"cpu": 5.0, "memory": 10.0}))
+        out = run(cp, ["top"])
+        assert "5/10" in out and "50%" in out
+
+    def test_get_events(self, cp):
+        run(cp, ["join", "m1"])
+        propagate_web(cp)
+        out = run(cp, ["get", "events"])
+        assert "ScheduleBindingSucceed" in out
+
+
+class TestInterpretApplyPromote:
+    def test_interpret_replica(self, cp, tmp_path):
+        dep = new_deployment("default", "web", replicas=7, cpu=0.5)
+        f = tmp_path / "dep.json"
+        f.write_text(json.dumps(dep.to_dict()))
+        out = run(cp, ["interpret", "--operation", "replica", "-f", str(f)])
+        assert json.loads(out)["replicas"] == 7
+
+    def test_apply_all_clusters(self, cp, tmp_path):
+        run(cp, ["join", "m1"])
+        run(cp, ["join", "m2"])
+        dep = new_deployment("default", "api", replicas=1)
+        f = tmp_path / "dep.json"
+        f.write_text(json.dumps(dep.to_dict()))
+        out = run(cp, ["apply", "-f", str(f), "--all-clusters"])
+        assert "applied" in out
+        rb = next(iter(cp.store.list("ResourceBinding")))
+        assert sorted(t.name for t in rb.spec.clusters) == ["m1", "m2"]
+
+    def test_promote(self, cp):
+        run(cp, ["join", "m1"])
+        run(cp, ["join", "m2"])
+        member = cp.members["m1"]
+        member.apply_manifest(new_deployment("default", "legacy", replicas=3).to_dict())
+        out = run(cp, ["promote", "deployment", "legacy", "-C", "m1", "-n", "default"])
+        assert "promoted" in out
+        assert cp.store.try_get("apps/v1/Deployment", "legacy", "default") is not None
+        rb = [b for b in cp.store.list("ResourceBinding") if b.spec.resource.name == "legacy"]
+        assert rb and [t.name for t in rb[0].spec.clusters] == ["m1"]
+
+
+class TestReschedulingCommands:
+    def test_deschedule_runs(self, cp):
+        assert run(cp, ["deschedule"]).startswith("descheduled")
+
+    def test_rebalance_triggers_fresh_schedule(self, cp):
+        run(cp, ["join", "m1"])
+        propagate_web(cp)
+        out = run(cp, ["rebalance", "apps/v1:Deployment:default:web"])
+        assert "WorkloadRebalancer" in out
+        rebalancers = cp.store.list("WorkloadRebalancer")
+        assert rebalancers and rebalancers[0].status.observed_workloads
