@@ -59,11 +59,15 @@ class CLIError(Exception):
 # -- cluster lifecycle -----------------------------------------------------
 
 
-def cmd_join(cp: ControlPlane, name: str, *, provider: str = "", region: str = "",
-             zone: str = "", labels: Optional[dict[str, str]] = None,
-             allocatable: Optional[dict[str, float]] = None) -> str:
+DEFAULT_ALLOCATABLE = {"cpu": 100.0, "memory": 400.0, "pods": 110.0}
+
+
+def _bootstrap_member(cp: ControlPlane, name: str, sync_mode: str, verb: str,
+                      *, provider: str = "", region: str = "", zone: str = "",
+                      labels: Optional[dict[str, str]] = None,
+                      allocatable: Optional[dict[str, float]] = None) -> str:
     if cp.store.try_get("Cluster", name) is not None:
-        raise CLIError(f"cluster {name} already joined")
+        raise CLIError(f"cluster {name} already {verb}")
     cp.join_member(
         MemberConfig(
             name=name,
@@ -71,32 +75,23 @@ def cmd_join(cp: ControlPlane, name: str, *, provider: str = "", region: str = "
             region=region,
             zone=zone,
             labels=dict(labels or {}),
-            allocatable=dict(allocatable or {"cpu": 100.0, "memory": 400.0, "pods": 110.0}),
-            sync_mode="Push",
+            allocatable=dict(allocatable or DEFAULT_ALLOCATABLE),
+            sync_mode=sync_mode,
         )
     )
     cp.settle()
-    return f"cluster {name} joined (Push mode)"
+    return f"cluster {name} {verb} ({sync_mode} mode)"
+
+
+def cmd_join(cp: ControlPlane, name: str, **kw) -> str:
+    return _bootstrap_member(cp, name, "Push", "joined", **kw)
 
 
 def cmd_register(cp: ControlPlane, name: str, **kw) -> str:
     """Pull-mode registration: the agent creates the Cluster object itself
     (agent.go:437 generateClusterInControllerPlane); here we simulate the
     agent's bootstrap by joining with SyncMode=Pull."""
-    if cp.store.try_get("Cluster", name) is not None:
-        raise CLIError(f"cluster {name} already registered")
-    cfg = MemberConfig(
-        name=name,
-        provider=kw.get("provider", ""),
-        region=kw.get("region", ""),
-        zone=kw.get("zone", ""),
-        labels=dict(kw.get("labels") or {}),
-        allocatable=dict(kw.get("allocatable") or {"cpu": 100.0, "memory": 400.0, "pods": 110.0}),
-        sync_mode="Pull",
-    )
-    cp.join_member(cfg)
-    cp.settle()
-    return f"cluster {name} registered (Pull mode)"
+    return _bootstrap_member(cp, name, "Pull", "registered", **kw)
 
 
 def _remove_cluster(cp: ControlPlane, name: str) -> None:
@@ -194,12 +189,16 @@ def cmd_get(cp: ControlPlane, kind: str, name: str = "", namespace: str = "",
         member = cp.members.get(cluster)
         if member is None:
             raise CLIError(f"cluster {cluster} not found")
+        want = kind.lower()
         objs = [
             o for o in member.objects()
-            if o.kind.lower() == kind.rstrip("s").lower() or f"{o.api_version}/{o.kind}" == resolved
+            if want in (o.kind.lower(), o.kind.lower() + "s")
+            or f"{o.api_version}/{o.kind}" == resolved
         ]
         if name:
             objs = [o for o in objs if o.name == name]
+        if namespace:
+            objs = [o for o in objs if o.namespace == namespace]
         rows = [[o.namespace or "-", o.name, cluster] for o in objs]
         return _fmt_table(rows, ["NAMESPACE", "NAME", "CLUSTER"])
 
@@ -385,8 +384,13 @@ def cmd_rebalance(cp: ControlPlane, workloads: list[tuple[str, str, str, str]]) 
         RebalancerObjectReference(api_version=av, kind=k, namespace=ns, name=n)
         for av, k, ns, n in workloads
     ]
+    # deterministic unique name: first free sequential suffix
+    existing = {r.metadata.name for r in cp.store.list("WorkloadRebalancer")}
+    n = 1
+    while f"rebalance-{n}" in existing:
+        n += 1
     rb = WorkloadRebalancer(
-        metadata=ObjectMeta(name=f"rebalance-{abs(hash(tuple(workloads))) % 10_000}"),
+        metadata=ObjectMeta(name=f"rebalance-{n}"),
         spec=WorkloadRebalancerSpec(workloads=ref_list),
     )
     cp.store.create(rb)
@@ -494,10 +498,13 @@ def run(cp: ControlPlane, argv: list[str]) -> str:
 def main(argv: Optional[list[str]] = None) -> int:
     import sys
 
+    from ..store.store import ConflictError, NotFoundError
+    from ..webhook import AdmissionDenied
+
     cp = ControlPlane()
     try:
         print(run(cp, argv if argv is not None else sys.argv[1:]))
-    except CLIError as e:
+    except (CLIError, AdmissionDenied, ConflictError, NotFoundError) as e:
         print(f"error: {e}", file=sys.stderr)
         return 1
     return 0

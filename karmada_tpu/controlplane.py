@@ -45,6 +45,7 @@ from .members.member import InMemoryMember, MemberConfig
 from .runtime.controller import Clock, Runtime
 from .sched.scheduler import SchedulerDaemon
 from .store.store import Store
+from .webhook import default_admission_chain
 
 DEFAULT_API_ENABLEMENTS = [
     APIEnablement(group_version="apps/v1", resources=["Deployment", "StatefulSet"]),
@@ -58,6 +59,8 @@ class ControlPlane:
         self.store = Store()
         self.runtime = Runtime(clock=clock)
         self.gates = gates or FeatureGates()
+        self.admission = default_admission_chain(self.gates)
+        self.store.set_admission(self.admission.admit)
         self.interpreter = ResourceInterpreter()
         self.members: dict[str, InMemoryMember] = {}
 

@@ -55,6 +55,12 @@ class Store:
         self._buckets: dict[str, _Bucket] = {}
         self._rv = 0
         self._all_watchers: list[Callable[[str, str, Any], None]] = []
+        # admission chain (op, kind, obj, old) -> obj; raises to deny —
+        # the apiserver admission path (reference: pkg/webhook/* handlers)
+        self._admission: Optional[Callable[[str, str, Any, Any], Any]] = None
+
+    def set_admission(self, admit: Callable[[str, str, Any, Any], Any]) -> None:
+        self._admission = admit
 
     # -- helpers ----------------------------------------------------------
 
@@ -77,6 +83,16 @@ class Store:
         self._rv += 1
         return self._rv
 
+    def _peek_deletion_timestamp(self, kind: str, name: str, namespace: str):
+        """Copy-free read of a stored object's deletionTimestamp (hot path:
+        every update consults this for the removal-transition check)."""
+        with self._lock:
+            b = self._buckets.get(kind)
+            if b is None:
+                return None
+            obj = b.objects.get(self._name_key(name, namespace))
+            return None if obj is None else obj.metadata.deletion_timestamp
+
     @staticmethod
     def _spec_view(obj: Any) -> Any:
         """The part whose change bumps generation (k8s semantics: spec only)."""
@@ -92,6 +108,8 @@ class Store:
 
     def create(self, obj: Any) -> Any:
         kind = gvk_of(obj)
+        if self._admission is not None:
+            obj = self._admission("CREATE", kind, obj, None)
         with self._lock:
             b = self._bucket(kind)
             key = self._key(obj.metadata)
@@ -142,6 +160,17 @@ class Store:
         deletion: if deletionTimestamp set and no finalizers remain, the
         object is removed instead."""
         kind = gvk_of(obj)
+        if self._admission is not None:
+            name, ns = obj.metadata.name, obj.metadata.namespace
+            obj = self._admission("UPDATE", kind, obj, lambda: self.try_get(kind, name, ns))
+            # an update that transitions into removal (deletionTimestamp set,
+            # no finalizers left) IS a delete — run DELETE admission so
+            # deletion protection cannot be bypassed via update()
+            if not obj.metadata.finalizers and (
+                obj.metadata.deletion_timestamp is not None
+                or self._peek_deletion_timestamp(kind, name, ns) is not None
+            ):
+                self._admission("DELETE", kind, obj, None)
         with self._lock:
             b = self._bucket(kind)
             key = self._key(obj.metadata)
@@ -188,6 +217,10 @@ class Store:
 
     def delete(self, kind: str, name: str, namespace: str = "") -> None:
         """Marks deletionTimestamp; removes immediately when no finalizers."""
+        if self._admission is not None:
+            target = self.try_get(kind, name, namespace)
+            if target is not None:
+                self._admission("DELETE", kind, target, None)
         with self._lock:
             b = self._buckets.get(kind)
             key = self._name_key(name, namespace)

@@ -1,0 +1,10 @@
+from .admission import AdmissionChain, AdmissionDenied, AdmissionRequest, Webhook
+from .handlers import default_admission_chain
+
+__all__ = [
+    "AdmissionChain",
+    "AdmissionDenied",
+    "AdmissionRequest",
+    "Webhook",
+    "default_admission_chain",
+]

@@ -1,0 +1,185 @@
+"""Admission webhooks (W1): mutating defaults + validation + deletion protection."""
+from __future__ import annotations
+
+import pytest
+
+from karmada_tpu.api.meta import ObjectMeta
+from karmada_tpu.api.policy import (
+    ApplicationFailoverBehavior,
+    FailoverBehavior,
+    ImageOverrider,
+    OverridePolicy,
+    OverrideSpec,
+    Overriders,
+    PlaintextOverrider,
+    PropagationPolicy,
+    PropagationSpec,
+    ResourceSelector,
+    RuleWithCluster,
+    SpreadConstraint,
+)
+from karmada_tpu.api.work import BindingSpec, ObjectReference, ResourceBinding
+from karmada_tpu.controlplane import ControlPlane
+from karmada_tpu.testing.fixtures import (
+    duplicated_placement,
+    new_deployment,
+    new_policy,
+    selector_for,
+)
+from karmada_tpu.webhook import AdmissionDenied
+from karmada_tpu.webhook.handlers import (
+    DELETION_PROTECTION_LABEL,
+    NOT_READY_TAINT_KEY,
+    UNREACHABLE_TAINT_KEY,
+)
+
+
+@pytest.fixture
+def cp():
+    return ControlPlane()
+
+
+def _pp(name="pp", **spec_kw):
+    return PropagationPolicy(
+        metadata=ObjectMeta(name=name, namespace="default"),
+        spec=PropagationSpec(
+            resource_selectors=[ResourceSelector(api_version="apps/v1", kind="Deployment")],
+            **spec_kw,
+        ),
+    )
+
+
+class TestPropagationPolicyWebhook:
+    def test_mutating_defaults_tolerations(self, cp):
+        created = cp.store.create(_pp())
+        keys = {(t.key, t.effect) for t in created.spec.placement.cluster_tolerations}
+        assert (NOT_READY_TAINT_KEY, "NoExecute") in keys
+        assert (UNREACHABLE_TAINT_KEY, "NoExecute") in keys
+        secs = [t.toleration_seconds for t in created.spec.placement.cluster_tolerations]
+        assert all(s == 300 for s in secs)
+
+    def test_permanent_id_label_stable_across_updates(self, cp):
+        created = cp.store.create(_pp())
+        pid = created.metadata.labels["propagationpolicy.karmada.io/permanent-id"]
+        assert pid
+        created.spec.priority = 5
+        updated = cp.store.update(created)
+        assert updated.metadata.labels["propagationpolicy.karmada.io/permanent-id"] == pid
+
+    def test_empty_selectors_denied(self, cp):
+        bad = PropagationPolicy(metadata=ObjectMeta(name="bad", namespace="default"))
+        with pytest.raises(AdmissionDenied, match="resourceSelectors"):
+            cp.store.create(bad)
+
+    def test_spread_constraint_validation(self, cp):
+        pp = _pp()
+        pp.spec.placement.spread_constraints = [
+            SpreadConstraint(spread_by_field="region", min_groups=3, max_groups=2)
+        ]
+        with pytest.raises(AdmissionDenied, match="minGroups"):
+            cp.store.create(pp)
+
+    def test_negative_toleration_seconds_denied(self, cp):
+        pp = _pp(
+            failover=FailoverBehavior(
+                application=ApplicationFailoverBehavior(
+                    decision_conditions_toleration_seconds=-1
+                )
+            )
+        )
+        with pytest.raises(AdmissionDenied, match="tolerationSeconds"):
+            cp.store.create(pp)
+
+
+class TestOverridePolicyWebhook:
+    def test_bad_image_component_denied(self, cp):
+        op = OverridePolicy(
+            metadata=ObjectMeta(name="op", namespace="default"),
+            spec=OverrideSpec(
+                override_rules=[
+                    RuleWithCluster(
+                        overriders=Overriders(
+                            image_overrider=[ImageOverrider(component="Nope", value="x")]
+                        )
+                    )
+                ]
+            ),
+        )
+        with pytest.raises(AdmissionDenied, match="component"):
+            cp.store.create(op)
+
+    def test_bad_plaintext_path_denied(self, cp):
+        op = OverridePolicy(
+            metadata=ObjectMeta(name="op", namespace="default"),
+            spec=OverrideSpec(
+                override_rules=[
+                    RuleWithCluster(
+                        overriders=Overriders(
+                            plaintext=[PlaintextOverrider(path="spec/replicas", operator="replace", value=1)]
+                        )
+                    )
+                ]
+            ),
+        )
+        with pytest.raises(AdmissionDenied, match="JSON pointer"):
+            cp.store.create(op)
+
+    def test_valid_override_accepted(self, cp):
+        op = OverridePolicy(
+            metadata=ObjectMeta(name="op", namespace="default"),
+            spec=OverrideSpec(
+                override_rules=[
+                    RuleWithCluster(
+                        overriders=Overriders(
+                            image_overrider=[ImageOverrider(component="Tag", value="v2")]
+                        )
+                    )
+                ]
+            ),
+        )
+        assert cp.store.create(op) is not None
+
+
+class TestBindingWebhook:
+    def test_rb_gets_permanent_id(self, cp):
+        rb = ResourceBinding(
+            metadata=ObjectMeta(name="rb", namespace="default"),
+            spec=BindingSpec(resource=ObjectReference(kind="Deployment", name="d")),
+        )
+        created = cp.store.create(rb)
+        assert created.metadata.labels.get("resourcebinding.karmada.io/permanent-id")
+
+    def test_rb_without_resource_denied(self, cp):
+        rb = ResourceBinding(metadata=ObjectMeta(name="rb", namespace="default"))
+        with pytest.raises(AdmissionDenied, match="spec.resource"):
+            cp.store.create(rb)
+
+
+class TestDeletionProtection:
+    def test_protected_template_cannot_be_deleted(self, cp):
+        dep = new_deployment("default", "web", replicas=1)
+        dep.metadata.labels[DELETION_PROTECTION_LABEL] = "Always"
+        cp.store.create(dep)
+        with pytest.raises(AdmissionDenied, match="protected"):
+            cp.store.delete("apps/v1/Deployment", "web", "default")
+        # removing the label unblocks deletion
+        obj = cp.store.get("apps/v1/Deployment", "web", "default")
+        obj.metadata.labels.pop(DELETION_PROTECTION_LABEL)
+        cp.store.update(obj)
+        cp.store.delete("apps/v1/Deployment", "web", "default")
+        assert cp.store.try_get("apps/v1/Deployment", "web", "default") is None
+
+
+class TestEndToEndWithAdmission:
+    def test_full_pipeline_still_converges(self, cp):
+        from karmada_tpu.members.member import MemberConfig
+
+        cp.join_member(MemberConfig(name="m1", allocatable={"cpu": 10.0}))
+        dep = new_deployment("default", "web", replicas=2)
+        cp.store.create(dep)
+        cp.store.create(new_policy("default", "pp", [selector_for(dep)], duplicated_placement()))
+        cp.settle()
+        rb = next(iter(cp.store.list("ResourceBinding")))
+        assert [t.name for t in rb.spec.clusters] == ["m1"]
+        works = cp.store.list("Work")
+        assert works
