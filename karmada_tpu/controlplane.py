@@ -38,10 +38,16 @@ from .controllers.status import BindingStatusController, WorkStatusController
 from .descheduler.descheduler import Descheduler
 from .detector.detector import ResourceDetector
 from .events import EventRecorder
-from .features import FAILOVER, FeatureGates, GRACEFUL_EVICTION
+from .features import (
+    CUSTOMIZED_CLUSTER_RESOURCE_MODELING,
+    FAILOVER,
+    FeatureGates,
+    GRACEFUL_EVICTION,
+)
 from .estimator.client import EstimatorRegistry, MemberEstimators
 from .interpreter.interpreter import ResourceInterpreter
 from .members.member import InMemoryMember, MemberConfig
+from .modeling import GradeHistogram, ModelBasedEstimator, default_resource_models
 from .runtime.controller import Clock, Runtime
 from .sched.scheduler import SchedulerDaemon
 from .store.store import Store
@@ -71,6 +77,9 @@ class ControlPlane:
         )
         self.estimator_registry.register_unschedulable_estimator(
             "scheduler-estimator", member_estimators
+        )
+        self.estimator_registry.register_replica_estimator(
+            "general-estimator/models", ModelBasedEstimator(self.store, self.gates)
         )
 
         self.event_recorder = EventRecorder(self.store, clock=self.runtime.clock)
@@ -153,6 +162,15 @@ class ControlPlane:
                     alloc[k] = alloc.get(k, 0.0) + v
             alloc.setdefault("pods", float(sum(n.allowed_pods for n in config.nodes)))
             config.allocatable = alloc
+        # node-histogram resource modeling (EST6): default grades + counts
+        # from node capacity (cluster_status_controller.go:282,671)
+        resource_models = []
+        modelings = []
+        if config.nodes and self.gates.enabled(CUSTOMIZED_CLUSTER_RESOURCE_MODELING):
+            resource_models = default_resource_models()
+            hist = GradeHistogram(resource_models)
+            hist.add_nodes([dict(n.allocatable) for n in config.nodes])
+            modelings = hist.to_allocatable_modelings()
         cluster = Cluster(
             metadata=ObjectMeta(name=config.name, labels=dict(config.labels)),
             spec=ClusterSpec(
@@ -160,6 +178,7 @@ class ControlPlane:
                 provider=config.provider,
                 region=config.region,
                 zone=config.zone,
+                resource_models=resource_models,
             ),
             status=ClusterStatus(
                 kubernetes_version="v1.30.0",
@@ -168,6 +187,7 @@ class ControlPlane:
                 resource_summary=ResourceSummary(
                     allocatable=dict(config.allocatable),
                     allocated=dict(config.allocated),
+                    allocatable_modelings=modelings,
                 ),
             ),
         )

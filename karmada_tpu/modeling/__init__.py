@@ -1,0 +1,17 @@
+from .modeling import (
+    DEFAULT_RESOURCE_MODELS,
+    GradeHistogram,
+    ModelBasedEstimator,
+    default_resource_models,
+    max_replicas_from_models,
+    model_estimates_batch,
+)
+
+__all__ = [
+    "DEFAULT_RESOURCE_MODELS",
+    "GradeHistogram",
+    "ModelBasedEstimator",
+    "default_resource_models",
+    "max_replicas_from_models",
+    "model_estimates_batch",
+]

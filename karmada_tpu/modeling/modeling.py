@@ -1,0 +1,273 @@
+"""Cluster resource modeling (EST6): node histogram over configurable grades.
+
+Reference behavior (pkg/modeling/modeling.go:33-240, defaults
+pkg/apis/cluster/mutation/mutation.go:84-205, estimator path
+pkg/estimator/client/general.go:116-249):
+- every node is classified into a *grade* — per resource, the last grade whose
+  range-min is <= the node's available amount; the node's grade is the MIN over
+  its resources (getIndex, modeling.go:112-121);
+- `Cluster.status.resourceSummary.allocatableModelings[g].count` histograms the
+  fleet's nodes;
+- the model-based MaxAvailableReplicas: find the minimum *compliant* grade
+  (per resource, first grade with min >= request, maxed over resources —
+  general.go:199-233); every node at grade >= that contributes
+  `min_over_resources(floor(grade_min / request))` replicas, floored at 1 for
+  the first suitable grade (general.go:127-154).
+
+Instead of the reference's red-black-tree per grade, the histogram is a dense
+[G] count vector and classification is a vectorized searchsorted over the grade
+boundaries — O(N log G) for N nodes with plain numpy, trivially battachable.
+"""
+from __future__ import annotations
+
+import math
+from typing import Optional
+
+import numpy as np
+
+from ..api.cluster import AllocatableModeling, ResourceModel, ResourceModelRange
+
+GB = 1.0  # memory unit across the framework is GB-as-float
+
+_DEFAULT_CPU_MINS = [0.0, 1.0, 2.0, 4.0, 8.0, 16.0, 32.0, 64.0, 128.0]
+_DEFAULT_MEM_MINS = [0.0, 4.0, 16.0, 32.0, 64.0, 128.0, 256.0, 512.0, 1024.0]
+
+
+def default_resource_models() -> list[ResourceModel]:
+    """The 9 default grades (mutation.go:84-205): cpu 0/1/2/4/8/16/32/64/128,
+    memory 0/4/16/32/64/128/256/512/1024 GB; last grade max = +inf."""
+    models: list[ResourceModel] = []
+    n = len(_DEFAULT_CPU_MINS)
+    for g in range(n):
+        cpu_max = _DEFAULT_CPU_MINS[g + 1] if g + 1 < n else math.inf
+        mem_max = _DEFAULT_MEM_MINS[g + 1] if g + 1 < n else math.inf
+        models.append(
+            ResourceModel(
+                grade=g,
+                ranges=[
+                    ResourceModelRange(name="cpu", min=_DEFAULT_CPU_MINS[g], max=cpu_max),
+                    ResourceModelRange(name="memory", min=_DEFAULT_MEM_MINS[g], max=mem_max),
+                ],
+            )
+        )
+    return models
+
+
+DEFAULT_RESOURCE_MODELS = default_resource_models()
+
+
+def _mins_by_resource(models: list[ResourceModel]) -> dict[str, np.ndarray]:
+    """resource name -> [G] array of grade range-mins (convertToResourceModelsMinMap)."""
+    out: dict[str, list[float]] = {}
+    for m in sorted(models, key=lambda m: m.grade):
+        for r in m.ranges:
+            out.setdefault(r.name, []).append(r.min)
+    return {k: np.asarray(v, dtype=np.float64) for k, v in out.items()}
+
+
+class GradeHistogram:
+    """Histogram of a cluster's nodes over model grades (the modeling.go
+    ResourceSummary, minus the per-grade node trees — counts are enough for
+    the estimator math)."""
+
+    def __init__(self, models: Optional[list[ResourceModel]] = None):
+        self.models = sorted(models or default_resource_models(), key=lambda m: m.grade)
+        self.mins = _mins_by_resource(self.models)
+        self.counts = np.zeros(len(self.models), dtype=np.int64)
+
+    def classify(self, node_resources: dict[str, float]) -> int:
+        """Grade of one node = min over resources of last grade whose min <=
+        value (getIndex/searchLastLessElement, modeling.go:112-140)."""
+        grade = len(self.models) - 1
+        for name, mins in self.mins.items():
+            v = node_resources.get(name, 0.0)
+            # searchsorted(side='right')-1 == last index with mins[i] <= v
+            idx = int(np.searchsorted(mins, v, side="right")) - 1
+            grade = min(grade, max(idx, 0))
+        return grade
+
+    def add_nodes(self, nodes: list[dict[str, float]]) -> None:
+        """Vectorized bulk classification (AddToResourceSummary over a fleet)."""
+        if not nodes:
+            return
+        g = np.full(len(nodes), len(self.models) - 1, dtype=np.int64)
+        for name, mins in self.mins.items():
+            vals = np.asarray([n.get(name, 0.0) for n in nodes], dtype=np.float64)
+            idx = np.searchsorted(mins, vals, side="right") - 1
+            g = np.minimum(g, np.maximum(idx, 0))
+        self.counts += np.bincount(g, minlength=len(self.models))
+
+    def to_allocatable_modelings(self) -> list[AllocatableModeling]:
+        return [
+            AllocatableModeling(grade=m.grade, count=int(c))
+            for m, c in zip(self.models, self.counts)
+        ]
+
+
+def max_replicas_from_models(
+    models: list[ResourceModel],
+    counts: list[int],
+    request: dict[str, float],
+) -> int:
+    """Model-based MaxAvailableReplicas for one cluster
+    (getMaximumReplicasBasedOnResourceModels, general.go:198-233)."""
+    mins = _mins_by_resource(models)
+    G = len(models)
+    min_compliant = 0
+    for name, req in request.items():
+        if req <= 0:
+            continue
+        arr = mins.get(name)
+        if arr is None:
+            # resource model inapplicable for this resource (general.go:208-210)
+            return -1
+        # first grade with min >= request (minimumModelIndex)
+        ge = np.nonzero(arr >= req)[0]
+        if len(ge) == 0:
+            return 0
+        min_compliant = max(min_compliant, int(ge[0]))
+
+    total = 0
+    for g in range(min_compliant, G):
+        c = counts[g] if g < len(counts) else 0
+        if c == 0:
+            continue
+        per_node = math.inf
+        for name, req in request.items():
+            if req <= 0:
+                continue
+            per_node = min(per_node, mins[name][g] // req)
+        if per_node == 0:
+            per_node = 1  # first suitable grade can host one pod (general.go:149-152)
+        total += int(c) * int(per_node)
+    return total
+
+
+def model_estimates_batch(
+    models: list[ResourceModel],
+    counts_matrix: np.ndarray,  # [C, G] per-cluster grade counts
+    requests: np.ndarray,  # [B, R] requests over a fixed resource axis
+    resource_names: list[str],
+) -> np.ndarray:
+    """Batched [B, C] model-based estimates — the whole fleet × all dirty
+    bindings in one shot (the TPU-shaped equivalent of per-cluster loops).
+
+    Uses the same grade math as max_replicas_from_models, vectorized:
+      per_grade[b, g]  = min over resources floor(grade_min[g, r]/req[b, r])
+      suitable[b, g]   = all resources' grade_min >= req  AND  g >= compliant
+      answer[b, c]     = Σ_g suitable[b, g] * counts[c, g] * max(per_grade, 1 if ==0)
+    """
+    mins = _mins_by_resource(models)
+    G = len(models)
+    B = requests.shape[0]
+    grade_min = np.zeros((G, len(resource_names)))
+    have = np.zeros(len(resource_names), dtype=bool)
+    for i, name in enumerate(resource_names):
+        if name in mins:
+            grade_min[:, i] = mins[name]
+            have[i] = True
+
+    req = np.asarray(requests, dtype=np.float64)  # [B, R]
+    active = req > 0  # resources that constrain
+    # a requested resource missing from the model: the model is inapplicable
+    # for that binding (general.go:208-210 errors → summary fallback) — mark
+    # with the -1 sentinel so the min-merge discards these answers
+    inapplicable = (active & ~have[None, :]).any(axis=1)  # [B]
+
+    with np.errstate(divide="ignore", invalid="ignore"):
+        ratio = np.floor(grade_min[None, :, :] / req[:, None, :])  # [B, G, R]
+    ratio = np.where(active[:, None, :], ratio, np.inf)
+    per_grade = ratio.min(axis=2)  # [B, G]
+    per_grade = np.where(np.isinf(per_grade), 0.0, per_grade)
+
+    compliant = grade_min[None, :, :] >= req[:, None, :]  # grade min can host the pod
+    suitable = np.where(active[:, None, :], compliant, True).all(axis=2)  # [B, G]
+    # min-compliant grade: first suitable; all grades >= it contribute
+    first = np.where(suitable.any(axis=1), suitable.argmax(axis=1), G)  # [B]
+    grades = np.arange(G)
+    contributing = grades[None, :] >= first[:, None]  # [B, G]
+    per_node = np.where(contributing, np.maximum(per_grade, 1.0), 0.0)  # [B, G]
+
+    answers = per_node @ counts_matrix.T.astype(np.float64)  # [B, C]
+    answers[inapplicable] = -1.0
+    return answers.astype(np.int64)
+
+
+UNAUTHENTIC_REPLICA = -1  # estimator/client/interface.go:27-30 sentinel
+
+
+class ModelBasedEstimator:
+    """ReplicaEstimator backed by the cluster resource models
+    (general.go:75-86: when CustomizedClusterResourceModeling is enabled and a
+    cluster reports allocatableModelings, the model math bounds its answer;
+    clusters without modelings answer the UnauthenticReplica sentinel so the
+    min-merge discards this column for them — the summary path in the device
+    kernel remains their estimate, mirroring the reference's fallback).
+
+    Batched: clusters sharing a model definition are answered with one
+    [B, C_group] matrix product (model_estimates_batch)."""
+
+    def __init__(self, store, gates=None):
+        self.store = store
+        self.gates = gates
+
+    def _enabled(self) -> bool:
+        from ..features import CUSTOMIZED_CLUSTER_RESOURCE_MODELING
+
+        return self.gates is None or self.gates.enabled(CUSTOMIZED_CLUSTER_RESOURCE_MODELING)
+
+    def max_available_replicas_rows(self, clusters, requirements_list):
+        C = len(clusters)
+        B = len(requirements_list)
+        out = np.full((B, C), UNAUTHENTIC_REPLICA, dtype=np.int64)
+        if not self._enabled():
+            return out.tolist()
+
+        # collect model groups: model-signature -> (models, [cluster col], [counts])
+        groups: dict = {}
+        for c, name in enumerate(clusters):
+            cluster = self.store.try_get("Cluster", name)
+            if cluster is None or not cluster.spec.resource_models:
+                continue
+            modelings = (
+                cluster.status.resource_summary.allocatable_modelings
+                if cluster.status.resource_summary is not None
+                else []
+            )
+            if not modelings:
+                continue
+            sig = tuple(
+                (m.grade, tuple((r.name, r.min, r.max) for r in m.ranges))
+                for m in cluster.spec.resource_models
+            )
+            models, cols, counts = groups.setdefault(sig, (cluster.spec.resource_models, [], []))
+            cols.append(c)
+            by_grade = {am.grade: am.count for am in modelings}
+            counts.append([by_grade.get(m.grade, 0) for m in models])
+
+        if not groups:
+            return out.tolist()
+
+        resource_names = sorted(
+            {k for req in requirements_list if req is not None for k in req.resource_request}
+        )
+        if not resource_names:
+            return out.tolist()
+        requests = np.zeros((B, len(resource_names)))
+        no_request = np.zeros(B, dtype=bool)
+        for b, req in enumerate(requirements_list):
+            if req is None or not req.resource_request:
+                no_request[b] = True
+                continue
+            for i, name in enumerate(resource_names):
+                requests[b, i] = req.resource_request.get(name, 0.0)
+
+        for models, cols, counts in groups.values():
+            answers = model_estimates_batch(
+                models, np.asarray(counts, dtype=np.int64), requests, resource_names
+            )  # [B, len(cols)]
+            for j, c in enumerate(cols):
+                out[:, c] = answers[:, j]
+        # rows with no resource request: no model constraint (general.go:69-71)
+        out[no_request, :] = UNAUTHENTIC_REPLICA
+        return out.tolist()

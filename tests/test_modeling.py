@@ -1,0 +1,129 @@
+"""Cluster resource modeling (EST6): grade histogram + model-based estimates."""
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from karmada_tpu.api.cluster import ResourceModel, ResourceModelRange
+from karmada_tpu.api.work import ReplicaRequirements
+from karmada_tpu.modeling import (
+    GradeHistogram,
+    ModelBasedEstimator,
+    default_resource_models,
+    max_replicas_from_models,
+    model_estimates_batch,
+)
+
+
+def small_models():
+    """3 grades: cpu [0,1) [1,2) [2,inf); memory [0,4) [4,16) [16,inf)."""
+    return [
+        ResourceModel(grade=0, ranges=[
+            ResourceModelRange(name="cpu", min=0, max=1),
+            ResourceModelRange(name="memory", min=0, max=4),
+        ]),
+        ResourceModel(grade=1, ranges=[
+            ResourceModelRange(name="cpu", min=1, max=2),
+            ResourceModelRange(name="memory", min=4, max=16),
+        ]),
+        ResourceModel(grade=2, ranges=[
+            ResourceModelRange(name="cpu", min=2, max=float("inf")),
+            ResourceModelRange(name="memory", min=16, max=float("inf")),
+        ]),
+    ]
+
+
+class TestGradeHistogram:
+    def test_classify_min_over_resources(self):
+        h = GradeHistogram(small_models())
+        # cpu 4 → grade 2, memory 5 → grade 1 ⇒ node grade = min = 1
+        assert h.classify({"cpu": 4.0, "memory": 5.0}) == 1
+        assert h.classify({"cpu": 0.5, "memory": 100.0}) == 0
+        assert h.classify({"cpu": 8.0, "memory": 64.0}) == 2
+
+    def test_add_nodes_histogram(self):
+        h = GradeHistogram(small_models())
+        h.add_nodes([
+            {"cpu": 0.5, "memory": 2.0},   # grade 0
+            {"cpu": 1.5, "memory": 8.0},   # grade 1
+            {"cpu": 4.0, "memory": 32.0},  # grade 2
+            {"cpu": 4.0, "memory": 32.0},  # grade 2
+        ])
+        assert h.counts.tolist() == [1, 1, 2]
+        ams = h.to_allocatable_modelings()
+        assert [(a.grade, a.count) for a in ams] == [(0, 1), (1, 1), (2, 2)]
+
+    def test_default_models_shape(self):
+        models = default_resource_models()
+        assert len(models) == 9
+        assert models[0].ranges[0].min == 0.0
+        assert models[8].ranges[0].min == 128.0
+        assert models[8].ranges[0].max == float("inf")
+
+
+class TestModelEstimate:
+    def test_scalar_math(self):
+        models = small_models()
+        counts = [5, 3, 2]  # 5 tiny, 3 medium, 2 large nodes
+        # request cpu=1: min compliant grade = 1 (grade1 min cpu=1 >= 1)
+        # grade1: floor(min(1/1, 4/0→inf)) = 1 → 3*1; grade2: min(2/1, ...) = 2 → 2*2
+        assert max_replicas_from_models(models, counts, {"cpu": 1.0}) == 3 * 1 + 2 * 2
+        # request cpu=1, memory=8: compliant grade = max(1, 2) = 2
+        # grade2 per node: min(2//1, 16//8) = 2 → 2*2 = 4
+        assert max_replicas_from_models(models, counts, {"cpu": 1.0, "memory": 8.0}) == 4
+        # request bigger than every grade min → 0
+        assert max_replicas_from_models(models, counts, {"cpu": 1000.0}) == 0
+        # unknown resource → -1 (model inapplicable)
+        assert max_replicas_from_models(models, counts, {"gpu": 1.0}) == -1
+
+    def test_first_suitable_grade_counts_one_pod(self):
+        models = small_models()
+        # request cpu=2: compliant grade 2, per-node floor(2/2)=1 → count*1
+        assert max_replicas_from_models(models, [0, 0, 4], {"cpu": 2.0}) == 4
+        # request cpu=1.5: compliant grade 2 (grade1 min 1 < 1.5), floor(2/1.5)=1
+        assert max_replicas_from_models(models, [9, 9, 4], {"cpu": 1.5}) == 4
+
+    def test_batch_matches_scalar(self):
+        models = small_models()
+        counts = np.array([[5, 3, 2], [0, 1, 7], [2, 0, 0]])
+        reqs = [
+            {"cpu": 1.0},
+            {"cpu": 1.0, "memory": 8.0},
+            {"cpu": 0.25, "memory": 1.0},
+            {"memory": 64.0},
+        ]
+        names = ["cpu", "memory"]
+        R = np.zeros((len(reqs), 2))
+        for b, r in enumerate(reqs):
+            for i, n in enumerate(names):
+                R[b, i] = r.get(n, 0.0)
+        got = model_estimates_batch(models, counts, R, names)
+        for b, r in enumerate(reqs):
+            for c in range(counts.shape[0]):
+                assert got[b, c] == max_replicas_from_models(models, counts[c].tolist(), r), (b, c)
+
+
+class TestModelBasedEstimatorIntegration:
+    def test_fleet_modelings_populated_and_estimator_answers(self):
+        from karmada_tpu.controlplane import ControlPlane
+        from karmada_tpu.members.member import MemberConfig
+        from karmada_tpu.models.nodes import NodeSpec
+
+        cp = ControlPlane()
+        nodes = [NodeSpec(name=f"n{i}", allocatable={"cpu": 4.0, "memory": 32.0}) for i in range(3)]
+        cp.join_member(MemberConfig(name="m1", nodes=nodes))
+        cp.join_member(MemberConfig(name="m2", allocatable={"cpu": 10.0}))  # no nodes → no models
+        cluster = cp.store.get("Cluster", "m1")
+        assert cluster.spec.resource_models
+        ams = cluster.status.resource_summary.allocatable_modelings
+        assert sum(a.count for a in ams) == 3
+        # cpu 4, mem 32GB → default grade: cpu grade 3 ([4,8)), mem grade 3 ([32,64)) → 3
+        assert [a.count for a in ams if a.grade == 3] == [3]
+
+        est = ModelBasedEstimator(cp.store)
+        rows = est.max_available_replicas_rows(
+            ["m1", "m2"], [ReplicaRequirements(resource_request={"cpu": 1.0})]
+        )
+        # grade 3 min cpu = 4 → 4 replicas/node × 3 nodes; m2 unauthenticated
+        assert rows[0][0] == 12
+        assert rows[0][1] == -1
