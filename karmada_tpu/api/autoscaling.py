@@ -1,0 +1,110 @@
+"""Autoscaling API (reference: pkg/apis/autoscaling/v1alpha1 — FederatedHPA +
+CronFederatedHPA CRDs consumed by pkg/controllers/{federatedhpa,cronfederatedhpa}).
+
+FederatedHPA scales a workload template across the whole federation on
+aggregated member-cluster pod metrics; CronFederatedHPA scales a FederatedHPA
+(its min/max) or a workload (its replicas) on cron schedules.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Optional
+
+from .meta import ObjectMeta
+
+KIND_FEDERATED_HPA = "FederatedHPA"
+KIND_CRON_FEDERATED_HPA = "CronFederatedHPA"
+
+
+@dataclass
+class ScaleTargetRef:
+    api_version: str = "apps/v1"
+    kind: str = ""
+    name: str = ""
+
+
+@dataclass
+class ResourceMetricSource:
+    """metrics: resource type with target average utilization percentage
+    (autoscaling/v2 ResourceMetricSource as used by FederatedHPA)."""
+
+    name: str = "cpu"
+    target_average_utilization: int = 80  # percent of request
+
+
+@dataclass
+class FederatedHPASpec:
+    scale_target_ref: ScaleTargetRef = field(default_factory=ScaleTargetRef)
+    min_replicas: Optional[int] = 1
+    max_replicas: int = 1
+    metrics: list[ResourceMetricSource] = field(default_factory=list)
+
+
+@dataclass
+class FederatedHPAStatus:
+    current_replicas: int = 0
+    desired_replicas: int = 0
+    current_average_utilization: Optional[int] = None
+    last_scale_time: Optional[float] = None
+
+
+@dataclass
+class FederatedHPA:
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    spec: FederatedHPASpec = field(default_factory=FederatedHPASpec)
+    status: FederatedHPAStatus = field(default_factory=FederatedHPAStatus)
+    kind: str = KIND_FEDERATED_HPA
+
+    @property
+    def name(self) -> str:
+        return self.metadata.name
+
+    @property
+    def namespace(self) -> str:
+        return self.metadata.namespace
+
+
+@dataclass
+class CronFederatedHPARule:
+    name: str = ""
+    schedule: str = ""  # 5-field cron
+    target_replicas: Optional[int] = None  # when scaling a workload
+    target_min_replicas: Optional[int] = None  # when scaling a FederatedHPA
+    target_max_replicas: Optional[int] = None
+    suspend: bool = False
+
+
+@dataclass
+class CronFederatedHPASpec:
+    scale_target_ref: ScaleTargetRef = field(default_factory=ScaleTargetRef)
+    rules: list[CronFederatedHPARule] = field(default_factory=list)
+
+
+@dataclass
+class ExecutionHistory:
+    rule_name: str = ""
+    next_execution_time: Optional[float] = None
+    last_execution_time: Optional[float] = None
+    last_result: str = ""  # Succeed | Failed
+    message: str = ""
+
+
+@dataclass
+class CronFederatedHPAStatus:
+    execution_histories: list[ExecutionHistory] = field(default_factory=list)
+
+
+@dataclass
+class CronFederatedHPA:
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    spec: CronFederatedHPASpec = field(default_factory=CronFederatedHPASpec)
+    status: CronFederatedHPAStatus = field(default_factory=CronFederatedHPAStatus)
+    kind: str = KIND_CRON_FEDERATED_HPA
+
+    @property
+    def name(self) -> str:
+        return self.metadata.name
+
+    @property
+    def namespace(self) -> str:
+        return self.metadata.namespace

@@ -1,0 +1,279 @@
+"""Autoscaling controller family (A1-A3).
+
+Reference:
+- FederatedHPA controller (pkg/controllers/federatedhpa/, 2415 LoC): computes
+  desired replicas for a workload template from member-cluster pod metrics
+  aggregated by the metrics adapter, using the standard HPA algorithm
+  (desired = ceil(current × currentUtilization/targetUtilization), 10%
+  tolerance, min/max clamp), then scales the template.
+- CronFederatedHPA controller (pkg/controllers/cronfederatedhpa/, 730 LoC):
+  cron rules scale either a FederatedHPA's min/max or a workload's replicas;
+  execution history recorded in status.
+- hpaScaleTargetMarker (pkg/controllers/hpascaletargetmarker/, 322 LoC):
+  labels workloads referenced by a FederatedHPA so the retain path knows
+  member-side replicas are autoscaler-owned.
+- deploymentReplicasSyncer (pkg/controllers/deploymentreplicassyncer/, 210
+  LoC): for marked, Divided-scheduled deployments, syncs the members' actual
+  replica sum back into the template spec.
+"""
+from __future__ import annotations
+
+import math
+from typing import Optional
+
+from ..api.autoscaling import CronFederatedHPA, FederatedHPA, KIND_FEDERATED_HPA
+from ..metricsadapter import MetricsAdapter
+from ..runtime.controller import DONE, Controller, Runtime
+from ..store.store import DELETED, Store
+from ..utils.cron import CronParseError, CronSchedule
+
+HPA_TOLERANCE = 0.1  # kube HPA default --horizontal-pod-autoscaler-tolerance
+SCALE_TARGET_MARKER_LABEL = "autoscaling.karmada.io/federated-hpa-enabled"
+
+
+def _template_kinds(store: Store, kind: str) -> list[str]:
+    return [g for g in store.kinds() if g.endswith(f"/{kind}")]
+
+
+def _find_template(store: Store, kind: str, name: str, namespace: str):
+    for gvk in _template_kinds(store, kind):
+        obj = store.try_get(gvk, name, namespace)
+        if obj is not None:
+            return obj
+    return None
+
+
+class FederatedHPAController:
+    """A1: metric-driven scaling of workload templates."""
+
+    def __init__(self, store: Store, adapter: MetricsAdapter, runtime: Runtime,
+                 interpreter=None):
+        self.store = store
+        self.adapter = adapter
+        self.clock = runtime.clock
+        self.interpreter = interpreter
+        self.controller = runtime.register(
+            Controller(name="federatedhpa", reconcile=self._reconcile)
+        )
+        store.watch("FederatedHPA", self._on_hpa)
+
+    def _on_hpa(self, event: str, hpa: FederatedHPA) -> None:
+        if event == DELETED:
+            return
+        self.controller.enqueue(hpa.metadata.key())
+
+    def tick(self) -> None:
+        """The HPA sync period (15s in kube): re-evaluate every FederatedHPA."""
+        for hpa in self.store.list("FederatedHPA"):
+            self.controller.enqueue(hpa.metadata.key())
+
+    def _reconcile(self, key: str) -> str:
+        ns, _, name = key.partition("/")
+        hpa = self.store.try_get("FederatedHPA", name, ns)
+        if hpa is None:
+            return DONE
+        target = hpa.spec.scale_target_ref
+        template = _find_template(self.store, target.kind, target.name, ns)
+        if template is None:
+            return DONE
+        current = int(template.get("spec", "replicas", default=1) or 0)
+
+        desired = self._desired_replicas(hpa, template, current, ns)
+        lo = hpa.spec.min_replicas or 1
+        hi = hpa.spec.max_replicas
+        desired = max(lo, min(desired, hi))
+
+        changed = hpa.status.current_replicas != current or hpa.status.desired_replicas != desired
+        hpa.status.current_replicas = current
+        hpa.status.desired_replicas = desired
+        if desired != current:
+            template.set("spec", "replicas", desired)
+            self.store.update(template)
+            hpa.status.last_scale_time = self.clock.now()
+            changed = True
+        if changed:
+            self.store.update(hpa)
+        return DONE
+
+    def _desired_replicas(self, hpa: FederatedHPA, template, current: int, ns: str) -> int:
+        if current <= 0:
+            return current
+        metrics = self.adapter.collect(hpa.spec.scale_target_ref.kind,
+                                       ns, hpa.spec.scale_target_ref.name)
+        if metrics.ready_pods == 0:
+            return current
+        request: dict[str, float] = {}
+        if self.interpreter is not None:
+            try:
+                _, req = self.interpreter.get_replicas(template)
+                if req is not None:
+                    request = req.resource_request
+            except KeyError:
+                pass
+        desired = current
+        utilization_seen: Optional[int] = None
+        for metric in hpa.spec.metrics:
+            res_request = request.get(metric.name, 0.0)
+            if res_request <= 0:
+                continue
+            avg_usage = metrics.average_usage(metric.name)
+            utilization = avg_usage / res_request * 100.0
+            utilization_seen = int(utilization)
+            ratio = utilization / float(metric.target_average_utilization)
+            if abs(ratio - 1.0) <= HPA_TOLERANCE:
+                continue
+            # scale on ready pods, then take the max across metrics (kube HPA)
+            desired = max(desired if desired != current else 0,
+                          math.ceil(metrics.ready_pods * ratio))
+        hpa.status.current_average_utilization = utilization_seen
+        return desired if desired > 0 else current
+
+
+class CronFederatedHPAController:
+    """A2: cron-scheduled scaling."""
+
+    def __init__(self, store: Store, runtime: Runtime):
+        self.store = store
+        self.clock = runtime.clock
+        self._last_check = self.clock.now()
+
+    def tick(self) -> int:
+        now = self.clock.now()
+        fired = 0
+        for cron in self.store.list("CronFederatedHPA"):
+            changed = False
+            for rule in cron.spec.rules:
+                if rule.suspend:
+                    continue
+                try:
+                    sched = CronSchedule.parse(rule.schedule)
+                except CronParseError as e:
+                    self._record(cron, rule.name, "Failed", str(e), None)
+                    changed = True
+                    continue
+                if sched.fired_between(self._last_check, now):
+                    ok, msg = self._execute(cron, rule)
+                    self._record(cron, rule.name, "Succeed" if ok else "Failed", msg, now)
+                    changed = True
+                    fired += 1
+            if changed:
+                self.store.update(cron)
+        self._last_check = now
+        return fired
+
+    def _execute(self, cron: CronFederatedHPA, rule) -> tuple[bool, str]:
+        target = cron.spec.scale_target_ref
+        ns = cron.metadata.namespace
+        if target.kind == KIND_FEDERATED_HPA:
+            hpa = self.store.try_get("FederatedHPA", target.name, ns)
+            if hpa is None:
+                return False, f"FederatedHPA {target.name} not found"
+            if rule.target_min_replicas is not None:
+                hpa.spec.min_replicas = rule.target_min_replicas
+            if rule.target_max_replicas is not None:
+                hpa.spec.max_replicas = rule.target_max_replicas
+            self.store.update(hpa)
+            return True, "scaled FederatedHPA bounds"
+        template = _find_template(self.store, target.kind, target.name, ns)
+        if template is None:
+            return False, f"{target.kind} {target.name} not found"
+        if rule.target_replicas is not None:
+            template.set("spec", "replicas", rule.target_replicas)
+            self.store.update(template)
+            return True, f"scaled to {rule.target_replicas}"
+        return False, "rule has no workload target"
+
+    def _record(self, cron, rule_name: str, result: str, message: str, ts) -> None:
+        for h in cron.status.execution_histories:
+            if h.rule_name == rule_name:
+                h.last_result = result
+                h.message = message
+                if ts is not None:
+                    h.last_execution_time = ts
+                return
+        from ..api.autoscaling import ExecutionHistory
+
+        cron.status.execution_histories.append(
+            ExecutionHistory(rule_name=rule_name, last_result=result,
+                             message=message, last_execution_time=ts)
+        )
+
+
+class HPAScaleTargetMarker:
+    """A3a: label FederatedHPA targets (hpascaletargetmarker)."""
+
+    def __init__(self, store: Store, runtime: Runtime):
+        self.store = store
+        self.controller = runtime.register(
+            Controller(name="hpascaletargetmarker", reconcile=self._reconcile)
+        )
+        store.watch("FederatedHPA", self._on_hpa)
+
+    def _on_hpa(self, event: str, hpa: FederatedHPA) -> None:
+        target = hpa.spec.scale_target_ref
+        op = "unmark" if event == DELETED else "mark"
+        self.controller.enqueue(
+            f"{op}|{hpa.metadata.namespace}|{target.kind}|{target.name}"
+        )
+
+    def _reconcile(self, key: str) -> str:
+        op, ns, kind, name = key.split("|", 3)
+        template = _find_template(self.store, kind, name, ns)
+        if template is None:
+            return DONE
+        labels = template.metadata.labels
+        if op == "mark":
+            if labels.get(SCALE_TARGET_MARKER_LABEL) != "true":
+                labels[SCALE_TARGET_MARKER_LABEL] = "true"
+                self.store.update(template)
+        else:
+            # only unmark if no other FederatedHPA still targets it
+            for hpa in self.store.list("FederatedHPA", ns):
+                t = hpa.spec.scale_target_ref
+                if t.kind == kind and t.name == name:
+                    return DONE
+            if SCALE_TARGET_MARKER_LABEL in labels:
+                del labels[SCALE_TARGET_MARKER_LABEL]
+                self.store.update(template)
+        return DONE
+
+
+class DeploymentReplicasSyncer:
+    """A3b: for marked, Divided-scheduled deployments, template spec.replicas
+    follows the members' actual total (deploymentreplicassyncer)."""
+
+    def __init__(self, store: Store, members: dict, runtime: Runtime):
+        self.store = store
+        self.members = members
+
+    def sync_once(self) -> int:
+        from ..api.policy import REPLICA_SCHEDULING_DIVIDED
+
+        synced = 0
+        for rb in self.store.list("ResourceBinding"):
+            res = rb.spec.resource
+            if res.kind != "Deployment":
+                continue
+            placement = rb.spec.placement
+            if placement is None or placement.replica_scheduling_type() != REPLICA_SCHEDULING_DIVIDED:
+                continue
+            template = _find_template(self.store, res.kind, res.name, res.namespace)
+            if template is None:
+                continue
+            if template.metadata.labels.get(SCALE_TARGET_MARKER_LABEL) != "true":
+                continue
+            total = 0
+            seen = False
+            for t in rb.spec.clusters:
+                member = self.members.get(t.name)
+                if member is None:
+                    continue
+                obj = member.get(res.api_version, res.kind, res.name, res.namespace)
+                if obj is not None:
+                    total += int(obj.get("status", "replicas", default=0) or 0)
+                    seen = True
+            if seen and total > 0 and int(template.get("spec", "replicas", default=0) or 0) != total:
+                template.set("spec", "replicas", total)
+                self.store.update(template)
+                synced += 1
+        return synced

@@ -21,6 +21,12 @@ from .api.cluster import (
     CLUSTER_CONDITION_READY,
 )
 from .api.meta import Condition, ObjectMeta, set_condition
+from .controllers.autoscaling import (
+    CronFederatedHPAController,
+    DeploymentReplicasSyncer,
+    FederatedHPAController,
+    HPAScaleTargetMarker,
+)
 from .controllers.binding import BindingController
 from .controllers.dependencies import DependenciesDistributor
 from .controllers.execution import ExecutionController
@@ -47,6 +53,7 @@ from .features import (
 from .estimator.client import EstimatorRegistry, MemberEstimators
 from .interpreter.interpreter import ResourceInterpreter
 from .members.member import InMemoryMember, MemberConfig
+from .metricsadapter import MetricsAdapter
 from .modeling import GradeHistogram, ModelBasedEstimator, default_resource_models
 from .runtime.controller import Clock, Runtime
 from .sched.scheduler import SchedulerDaemon
@@ -142,6 +149,19 @@ class ControlPlane:
         self.rebalancer_controller = WorkloadRebalancerController(self.store, self.runtime)
         self.remedy_controller = RemedyController(self.store, self.runtime)
 
+        # Autoscaling family (A1-A4)
+        self.metrics_adapter = MetricsAdapter(self.members)
+        self.federated_hpa_controller = FederatedHPAController(
+            self.store, self.metrics_adapter, self.runtime, interpreter=self.interpreter
+        )
+        self.cron_federated_hpa_controller = CronFederatedHPAController(
+            self.store, self.runtime
+        )
+        self.hpa_scale_target_marker = HPAScaleTargetMarker(self.store, self.runtime)
+        self.deployment_replicas_syncer = DeploymentReplicasSyncer(
+            self.store, self.members, self.runtime
+        )
+
     # -- cluster lifecycle (karmadactl join equivalent) -------------------
 
     def join_member(self, config: MemberConfig) -> InMemoryMember:
@@ -230,6 +250,9 @@ class ControlPlane:
             self.graceful_eviction_controller.tick()
         self.rebalancer_controller.tick()
         self.descheduler.tick()
+        self.federated_hpa_controller.tick()
+        self.cron_federated_hpa_controller.tick()
+        self.deployment_replicas_syncer.sync_once()
         return self.settle(max_steps)
 
     def run_descheduler(self) -> int:

@@ -43,6 +43,9 @@ class InMemoryMember:
         self.healthy = True
         # kinds that never become ready on this member (failure injection)
         self.failing_kinds: set[str] = set()
+        # simulated per-pod resource usage by "kind/ns/name" → {resource: qty}
+        # (what metrics-server would report; feeds the metrics adapter)
+        self.workload_usage: dict[str, dict[str, float]] = {}
         self.node_estimator = None
         if config.nodes:
             from ..estimator.accurate import AccurateEstimator
@@ -68,6 +71,24 @@ class InMemoryMember:
 
     def get(self, api_version: str, kind: str, name: str, namespace: str = "") -> Optional[Unstructured]:
         return self.store.try_get(f"{api_version}/{kind}", name, namespace)
+
+    def set_workload_usage(self, kind: str, namespace: str, name: str,
+                           usage: dict[str, float]) -> None:
+        """Set simulated per-pod usage for a workload (metrics-server feed)."""
+        self.workload_usage[f"{kind}/{namespace}/{name}"] = dict(usage)
+
+    def pod_metrics(self, kind: str, namespace: str, name: str):
+        """(ready_pods, per-pod usage dict or None) for a workload."""
+        obj = None
+        for gvk in self.store.kinds():
+            if gvk.endswith(f"/{kind}"):
+                obj = self.store.try_get(gvk, name, namespace)
+                if obj is not None:
+                    break
+        if obj is None:
+            return 0, None
+        ready = int(obj.get("status", "readyReplicas", default=0) or 0)
+        return ready, self.workload_usage.get(f"{kind}/{namespace}/{name}")
 
     def objects(self) -> list[Unstructured]:
         """Every object on the member, across kinds (proxy/CLI listing)."""

@@ -1,0 +1,3 @@
+from .adapter import MetricsAdapter, WorkloadMetrics
+
+__all__ = ["MetricsAdapter", "WorkloadMetrics"]

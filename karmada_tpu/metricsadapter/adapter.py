@@ -1,0 +1,44 @@
+"""karmada-metrics-adapter (A4, reference: pkg/metricsadapter/ — the
+custom-metrics aggregated API that fans a metric query out to every member
+cluster and merges the answers; consumed by the FederatedHPA controller).
+
+Here the fan-out is over the in-memory members' simulated metrics-server
+feeds; the merged answer is the federation-wide pod metric set."""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+
+@dataclass
+class WorkloadMetrics:
+    """Merged pod metrics for one workload across the federation."""
+
+    ready_pods: int = 0
+    # per-cluster: cluster name -> (pods, per-pod usage dict)
+    by_cluster: dict = field(default_factory=dict)
+    # federation-wide totals per resource
+    total_usage: dict[str, float] = field(default_factory=dict)
+
+    def average_usage(self, resource: str) -> float:
+        if self.ready_pods == 0:
+            return 0.0
+        return self.total_usage.get(resource, 0.0) / self.ready_pods
+
+
+class MetricsAdapter:
+    def __init__(self, members: dict):
+        self.members = members
+
+    def collect(self, kind: str, namespace: str, name: str) -> WorkloadMetrics:
+        """Fan out to every member (the adapter's multi-cluster query path)
+        and merge: total usage = Σ pods × per-pod usage."""
+        out = WorkloadMetrics()
+        for cname, member in self.members.items():
+            pods, usage = member.pod_metrics(kind, namespace, name)
+            if pods <= 0 or usage is None:
+                continue
+            out.ready_pods += pods
+            out.by_cluster[cname] = (pods, dict(usage))
+            for res, v in usage.items():
+                out.total_usage[res] = out.total_usage.get(res, 0.0) + pods * v
+        return out

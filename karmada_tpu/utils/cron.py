@@ -1,0 +1,108 @@
+"""Minimal 5-field cron matcher for CronFederatedHPA schedules
+(reference uses robfig/cron via pkg/controllers/cronfederatedhpa).
+
+Supports: "*", "*/n", "a", "a-b", "a,b,c", "a-b/n" per field; fields are
+minute hour day-of-month month day-of-week (0=Sunday, 7 also Sunday).
+"""
+from __future__ import annotations
+
+import calendar
+import time
+from dataclasses import dataclass
+
+_FIELD_RANGES = [(0, 59), (0, 23), (1, 31), (1, 12), (0, 6)]
+
+
+class CronParseError(ValueError):
+    pass
+
+
+def _parse_field(expr: str, lo: int, hi: int, dow: bool = False) -> set[int]:
+    out: set[int] = set()
+    for part in expr.split(","):
+        step = 1
+        if "/" in part:
+            part, step_s = part.split("/", 1)
+            try:
+                step = int(step_s)
+            except ValueError as e:
+                raise CronParseError(f"bad step in {expr!r}") from e
+            if step <= 0:
+                raise CronParseError(f"bad step in {expr!r}")
+        if part == "*" or part == "":
+            a, b = lo, hi
+        elif "-" in part:
+            a_s, b_s = part.split("-", 1)
+            try:
+                a, b = int(a_s), int(b_s)
+            except ValueError as e:
+                raise CronParseError(f"bad range in {expr!r}") from e
+        else:
+            try:
+                a = b = int(part)
+            except ValueError as e:
+                raise CronParseError(f"bad value in {expr!r}") from e
+        if dow:
+            a, b = a % 7 if a == 7 else a, b % 7 if b == 7 else b
+        if a < lo or b > hi or a > b:
+            raise CronParseError(f"value out of range in {expr!r}")
+        out.update(range(a, b + 1, step))
+    return out
+
+
+@dataclass
+class CronSchedule:
+    minutes: set[int]
+    hours: set[int]
+    days: set[int]
+    months: set[int]
+    weekdays: set[int]
+    dom_star: bool
+    dow_star: bool
+
+    @classmethod
+    def parse(cls, expr: str) -> "CronSchedule":
+        fields = expr.split()
+        if len(fields) != 5:
+            raise CronParseError(f"cron {expr!r}: want 5 fields, got {len(fields)}")
+        sets = []
+        for f, (lo, hi) in zip(fields, _FIELD_RANGES):
+            sets.append(_parse_field(f, lo, hi, dow=(lo, hi) == (0, 6)))
+        return cls(
+            minutes=sets[0], hours=sets[1], days=sets[2], months=sets[3], weekdays=sets[4],
+            dom_star=fields[2] == "*", dow_star=fields[4] == "*",
+        )
+
+    def matches(self, ts: float) -> bool:
+        t = time.gmtime(ts)
+        if t.tm_min not in self.minutes or t.tm_hour not in self.hours or t.tm_mon not in self.months:
+            return False
+        # standard cron: dom and dow are OR'd when both are restricted
+        dow = t.tm_wday  # Monday=0 in struct_time
+        dow_cron = (dow + 1) % 7  # cron Sunday=0
+        dom_ok = t.tm_mday in self.days
+        dow_ok = dow_cron in self.weekdays
+        if self.dom_star and self.dow_star:
+            return True
+        if self.dom_star:
+            return dow_ok
+        if self.dow_star:
+            return dom_ok
+        return dom_ok or dow_ok
+
+    def fired_between(self, start: float, end: float) -> bool:
+        """True if any whole minute in (start, end] matches — the tick-driven
+        equivalent of a timer firing at the matching instant."""
+        if end <= start:
+            return False
+        # scan minute boundaries; tick cadence is minutes-to-hours so the scan
+        # is short; cap to avoid pathological ranges
+        first = (int(start) // 60 + 1) * 60
+        minute = first
+        scanned = 0
+        while minute <= end and scanned < 1_000_000:
+            if self.matches(minute):
+                return True
+            minute += 60
+            scanned += 1
+        return False

@@ -1,0 +1,188 @@
+"""Autoscaling family (A1-A4): FederatedHPA, CronFederatedHPA, marker, syncer."""
+from __future__ import annotations
+
+import pytest
+
+from karmada_tpu.api.autoscaling import (
+    CronFederatedHPA,
+    CronFederatedHPARule,
+    CronFederatedHPASpec,
+    FederatedHPA,
+    FederatedHPASpec,
+    ResourceMetricSource,
+    ScaleTargetRef,
+)
+from karmada_tpu.api.meta import ObjectMeta
+from karmada_tpu.controlplane import ControlPlane
+from karmada_tpu.controllers.autoscaling import SCALE_TARGET_MARKER_LABEL
+from karmada_tpu.members.member import MemberConfig
+from karmada_tpu.runtime.controller import Clock
+from karmada_tpu.testing.fixtures import (
+    duplicated_placement,
+    new_deployment,
+    new_policy,
+    selector_for,
+)
+from karmada_tpu.utils.cron import CronParseError, CronSchedule
+from karmada_tpu.webhook import AdmissionDenied
+
+
+@pytest.fixture
+def cp():
+    # fixed clock at a known UTC minute boundary for cron math
+    plane = ControlPlane(clock=Clock(fixed=1_700_000_000.0))
+    plane.join_member(MemberConfig(name="m1", allocatable={"cpu": 100.0}))
+    plane.join_member(MemberConfig(name="m2", allocatable={"cpu": 100.0}))
+    return plane
+
+
+def deploy_web(cp, replicas=2, cpu=1.0):
+    dep = new_deployment("default", "web", replicas=replicas, cpu=cpu)
+    cp.store.create(dep)
+    cp.store.create(new_policy("default", "pp", [selector_for(dep)], duplicated_placement()))
+    cp.settle()
+    return dep
+
+
+def fhpa(name="hpa", min_r=1, max_r=10, target_util=50):
+    return FederatedHPA(
+        metadata=ObjectMeta(name=name, namespace="default"),
+        spec=FederatedHPASpec(
+            scale_target_ref=ScaleTargetRef(kind="Deployment", name="web"),
+            min_replicas=min_r,
+            max_replicas=max_r,
+            metrics=[ResourceMetricSource(name="cpu", target_average_utilization=target_util)],
+        ),
+    )
+
+
+class TestCron:
+    def test_parse_and_match(self):
+        s = CronSchedule.parse("*/5 * * * *")
+        assert s.matches(1_700_000_100)  # :15 → minute 15? depends; use fired_between
+        assert CronSchedule.parse("0 9 * * 1-5").hours == {9}
+        with pytest.raises(CronParseError):
+            CronSchedule.parse("* * *")
+        with pytest.raises(CronParseError):
+            CronSchedule.parse("61 * * * *")
+
+    def test_fired_between(self):
+        s = CronSchedule.parse("* * * * *")  # every minute
+        assert s.fired_between(1_700_000_000, 1_700_000_061)
+        assert not s.fired_between(1_700_000_000, 1_700_000_010)
+
+
+class TestFederatedHPA:
+    def test_scale_up_on_high_utilization(self, cp):
+        deploy_web(cp, replicas=2, cpu=1.0)
+        cp.store.create(fhpa(target_util=50))
+        # both members run 2 pods each at 0.9 cpu (90% of request)
+        for m in cp.members.values():
+            m.set_workload_usage("Deployment", "default", "web", {"cpu": 0.9})
+        cp.tick()
+        dep = cp.store.get("apps/v1/Deployment", "web", "default")
+        # ready pods = 4, ratio = 90/50 = 1.8 → desired = ceil(4*1.8) = 8
+        assert int(dep.get("spec", "replicas")) == 8
+        hpa = cp.store.get("FederatedHPA", "hpa", "default")
+        assert hpa.status.desired_replicas == 8
+        assert hpa.status.current_average_utilization == 90
+
+    def test_no_scale_within_tolerance(self, cp):
+        deploy_web(cp, replicas=2, cpu=1.0)
+        cp.store.create(fhpa(target_util=50))
+        for m in cp.members.values():
+            m.set_workload_usage("Deployment", "default", "web", {"cpu": 0.52})
+        cp.tick()
+        dep = cp.store.get("apps/v1/Deployment", "web", "default")
+        assert int(dep.get("spec", "replicas")) == 2  # 4% over target < 10% tolerance
+
+    def test_scale_down_clamped_to_min(self, cp):
+        deploy_web(cp, replicas=4, cpu=1.0)
+        cp.store.create(fhpa(min_r=2, target_util=80))
+        for m in cp.members.values():
+            m.set_workload_usage("Deployment", "default", "web", {"cpu": 0.05})
+        cp.tick()
+        dep = cp.store.get("apps/v1/Deployment", "web", "default")
+        assert int(dep.get("spec", "replicas")) == 2
+
+    def test_max_replicas_webhook_validation(self, cp):
+        bad = fhpa(min_r=5, max_r=3)
+        with pytest.raises(AdmissionDenied, match="maxReplicas"):
+            cp.store.create(bad)
+
+    def test_webhook_defaults_min_replicas(self, cp):
+        h = fhpa()
+        h.spec.min_replicas = None
+        created = cp.store.create(h)
+        assert created.spec.min_replicas == 1
+
+
+class TestScaleTargetMarker:
+    def test_mark_and_unmark(self, cp):
+        deploy_web(cp)
+        cp.store.create(fhpa())
+        cp.settle()
+        dep = cp.store.get("apps/v1/Deployment", "web", "default")
+        assert dep.metadata.labels.get(SCALE_TARGET_MARKER_LABEL) == "true"
+        cp.store.delete("FederatedHPA", "hpa", "default")
+        cp.settle()
+        dep = cp.store.get("apps/v1/Deployment", "web", "default")
+        assert SCALE_TARGET_MARKER_LABEL not in dep.metadata.labels
+
+
+class TestCronFederatedHPA:
+    def test_cron_scales_workload(self, cp):
+        deploy_web(cp, replicas=2)
+        cron = CronFederatedHPA(
+            metadata=ObjectMeta(name="cron", namespace="default"),
+            spec=CronFederatedHPASpec(
+                scale_target_ref=ScaleTargetRef(kind="Deployment", name="web"),
+                rules=[CronFederatedHPARule(name="night", schedule="* * * * *",
+                                            target_replicas=6)],
+            ),
+        )
+        cp.store.create(cron)
+        cp.tick(seconds=120)  # two minutes pass → rule fires
+        dep = cp.store.get("apps/v1/Deployment", "web", "default")
+        assert int(dep.get("spec", "replicas")) == 6
+        cron = cp.store.get("CronFederatedHPA", "cron", "default")
+        assert cron.status.execution_histories[0].last_result == "Succeed"
+
+    def test_cron_scales_fhpa_bounds(self, cp):
+        deploy_web(cp)
+        cp.store.create(fhpa())
+        cron = CronFederatedHPA(
+            metadata=ObjectMeta(name="cron", namespace="default"),
+            spec=CronFederatedHPASpec(
+                scale_target_ref=ScaleTargetRef(kind="FederatedHPA", name="hpa"),
+                rules=[CronFederatedHPARule(name="peak", schedule="* * * * *",
+                                            target_min_replicas=4, target_max_replicas=20)],
+            ),
+        )
+        cp.store.create(cron)
+        cp.tick(seconds=90)
+        hpa = cp.store.get("FederatedHPA", "hpa", "default")
+        assert hpa.spec.min_replicas == 4
+        assert hpa.spec.max_replicas == 20
+
+    def test_bad_schedule_rejected_by_webhook(self, cp):
+        cron = CronFederatedHPA(
+            metadata=ObjectMeta(name="cron", namespace="default"),
+            spec=CronFederatedHPASpec(
+                scale_target_ref=ScaleTargetRef(kind="Deployment", name="web"),
+                rules=[CronFederatedHPARule(name="bad", schedule="nope",
+                                            target_replicas=1)],
+            ),
+        )
+        with pytest.raises(AdmissionDenied, match="cron"):
+            cp.store.create(cron)
+
+
+class TestMetricsAdapter:
+    def test_collect_merges_members(self, cp):
+        deploy_web(cp, replicas=3)
+        cp.members["m1"].set_workload_usage("Deployment", "default", "web", {"cpu": 0.5})
+        cp.members["m2"].set_workload_usage("Deployment", "default", "web", {"cpu": 0.7})
+        metrics = cp.metrics_adapter.collect("Deployment", "default", "web")
+        assert metrics.ready_pods == 6  # Duplicated: 3 pods in each member
+        assert metrics.average_usage("cpu") == pytest.approx((3 * 0.5 + 3 * 0.7) / 6)
