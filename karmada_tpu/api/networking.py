@@ -1,0 +1,139 @@
+"""Networking API (reference: pkg/apis/networking/v1alpha1 — MultiClusterService
+and MultiClusterIngress; plus the upstream MCS API ServiceExport/ServiceImport
+consumed by pkg/controllers/mcs/).
+
+MultiClusterService exposes a Service across clusters: provider clusters run
+the backing pods, consumer clusters receive the derived service + imported
+EndpointSlices (pkg/controllers/multiclusterservice/).
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+from .meta import ObjectMeta
+
+KIND_MULTI_CLUSTER_SERVICE = "MultiClusterService"
+KIND_MULTI_CLUSTER_INGRESS = "MultiClusterIngress"
+KIND_SERVICE_EXPORT = "ServiceExport"
+KIND_SERVICE_IMPORT = "ServiceImport"
+
+EXPOSURE_TYPE_CROSS_CLUSTER = "CrossCluster"
+EXPOSURE_TYPE_LOAD_BALANCER = "LoadBalancer"
+
+# label stamped on imported EndpointSlices (reference:
+# discovery.karmada.io labels on collected slices)
+ENDPOINT_SLICE_SOURCE_CLUSTER_LABEL = "endpointslice.karmada.io/source-cluster"
+ENDPOINT_SLICE_SERVICE_LABEL = "kubernetes.io/service-name"
+DERIVED_SERVICE_PREFIX = "derived-"
+
+
+@dataclass
+class ExposurePort:
+    name: str = ""
+    port: int = 0
+    protocol: str = "TCP"
+
+
+@dataclass
+class ExposureRange:
+    cluster_names: list[str] = field(default_factory=list)
+
+
+@dataclass
+class MultiClusterServiceSpec:
+    types: list[str] = field(default_factory=lambda: [EXPOSURE_TYPE_CROSS_CLUSTER])
+    ports: list[ExposurePort] = field(default_factory=list)
+    provider_clusters: list[str] = field(default_factory=list)  # empty = all
+    consumer_clusters: list[str] = field(default_factory=list)  # empty = all
+
+
+@dataclass
+class MultiClusterServiceStatus:
+    conditions: list = field(default_factory=list)
+
+
+@dataclass
+class MultiClusterService:
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    spec: MultiClusterServiceSpec = field(default_factory=MultiClusterServiceSpec)
+    status: MultiClusterServiceStatus = field(default_factory=MultiClusterServiceStatus)
+    kind: str = KIND_MULTI_CLUSTER_SERVICE
+
+    @property
+    def name(self) -> str:
+        return self.metadata.name
+
+    @property
+    def namespace(self) -> str:
+        return self.metadata.namespace
+
+
+@dataclass
+class ServiceExport:
+    """MCS API: marks a Service (same ns/name) for export from the clusters
+    it is propagated to (pkg/controllers/mcs/service_export_controller.go)."""
+
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    kind: str = KIND_SERVICE_EXPORT
+
+    @property
+    def name(self) -> str:
+        return self.metadata.name
+
+    @property
+    def namespace(self) -> str:
+        return self.metadata.namespace
+
+
+@dataclass
+class ServiceImportSpec:
+    type: str = "ClusterSetIP"
+    ports: list[ExposurePort] = field(default_factory=list)
+
+
+@dataclass
+class ServiceImport:
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    spec: ServiceImportSpec = field(default_factory=ServiceImportSpec)
+    kind: str = KIND_SERVICE_IMPORT
+
+    @property
+    def name(self) -> str:
+        return self.metadata.name
+
+    @property
+    def namespace(self) -> str:
+        return self.metadata.namespace
+
+
+@dataclass
+class IngressBackend:
+    service_name: str = ""
+    service_port: int = 80
+
+
+@dataclass
+class IngressRule:
+    host: str = ""
+    path: str = "/"
+    backend: IngressBackend = field(default_factory=IngressBackend)
+
+
+@dataclass
+class MultiClusterIngressSpec:
+    rules: list[IngressRule] = field(default_factory=list)
+
+
+@dataclass
+class MultiClusterIngress:
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    spec: MultiClusterIngressSpec = field(default_factory=MultiClusterIngressSpec)
+    kind: str = KIND_MULTI_CLUSTER_INGRESS
+
+    @property
+    def name(self) -> str:
+        return self.metadata.name
+
+    @property
+    def namespace(self) -> str:
+        return self.metadata.namespace

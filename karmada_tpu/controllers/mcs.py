@@ -1,0 +1,263 @@
+"""Multi-cluster service controllers (N1/N2).
+
+Reference:
+- MultiClusterService controller (pkg/controllers/multiclusterservice/, 1607
+  LoC): for a CrossCluster MCS, propagate the Service to provider+consumer
+  clusters, collect EndpointSlices from providers, dispatch them (relabeled,
+  cluster-disambiguated) to consumers so the service name resolves everywhere.
+- ServiceExport/ServiceImport controllers (pkg/controllers/mcs/, 1043 LoC):
+  ServiceExport collects member EndpointSlices into the control plane;
+  ServiceImport materializes a `derived-<name>` Service + imported slices in
+  consuming clusters.
+
+Collection is level-triggered off the member informers (here: a sweep in
+`tick()`/`collect_once()` over members, mirroring the federated-informer
+resync path).
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+from ..api.networking import (
+    DERIVED_SERVICE_PREFIX,
+    ENDPOINT_SLICE_SERVICE_LABEL,
+    ENDPOINT_SLICE_SOURCE_CLUSTER_LABEL,
+    MultiClusterService,
+)
+from ..api.unstructured import Unstructured
+from ..api.work import Work, WorkSpec
+from ..runtime.controller import DONE, Controller, Runtime
+from ..store.store import DELETED, Store
+from ..utils.names import execution_namespace, work_name
+
+MCS_WORK_LABEL = "multiclusterservice.karmada.io/name"
+EXPORT_WORK_LABEL = "serviceexport.karmada.io/name"
+
+
+def _strip_meta(manifest: dict) -> dict:
+    manifest.pop("status", None)
+    md = manifest.get("metadata", {})
+    for f in ("resourceVersion", "generation", "uid", "creationTimestamp"):
+        md.pop(f, None)
+    return manifest
+
+
+class MultiClusterServiceController:
+    """N1: MCS reconcile — service Works to providers+consumers, slice
+    collection from providers, slice dispatch to consumers."""
+
+    def __init__(self, store: Store, members: dict, runtime: Runtime):
+        self.store = store
+        self.members = members
+        self.controller = runtime.register(
+            Controller(name="multiclusterservice", reconcile=self._reconcile)
+        )
+        store.watch("MultiClusterService", self._on_mcs)
+        store.watch("Cluster", self._on_cluster)
+
+    def _on_mcs(self, event: str, mcs: MultiClusterService) -> None:
+        self.controller.enqueue(mcs.metadata.key())
+
+    def _on_cluster(self, event: str, cluster) -> None:
+        for mcs in self.store.list("MultiClusterService"):
+            self.controller.enqueue(mcs.metadata.key())
+
+    def collect_once(self) -> None:
+        """Informer resync: re-run every MCS (endpoints may have moved)."""
+        for mcs in self.store.list("MultiClusterService"):
+            self.controller.enqueue(mcs.metadata.key())
+
+    def _cluster_names(self) -> list[str]:
+        return sorted(c.metadata.name for c in self.store.list("Cluster"))
+
+    def _reconcile(self, key: str) -> str:
+        ns, _, name = key.partition("/")
+        mcs: Optional[MultiClusterService] = self.store.try_get("MultiClusterService", name, ns)
+        if mcs is None or mcs.metadata.deletion_timestamp is not None:
+            self._gc_works(ns, name)
+            return DONE
+        svc = self.store.try_get("v1/Service", name, ns)
+        if svc is None:
+            return DONE
+        all_clusters = self._cluster_names()
+        providers = [c for c in (mcs.spec.provider_clusters or all_clusters) if c in all_clusters]
+        consumers = [c for c in (mcs.spec.consumer_clusters or all_clusters) if c in all_clusters]
+
+        # 1. the Service itself reaches providers and consumers
+        svc_manifest = _strip_meta(svc.to_dict())
+        for cluster in sorted(set(providers) | set(consumers)):
+            self._ensure_work(
+                cluster,
+                work_name("v1", "Service", ns, name),
+                [svc_manifest],
+                mcs,
+            )
+
+        # 2. collect provider EndpointSlices into the control plane
+        collected = self._collect_slices(ns, name, providers)
+        for slice_obj in collected:
+            self.store.apply(slice_obj)
+
+        # 3. dispatch to consumers: every slice from a *different* cluster
+        for cluster in consumers:
+            imported = [
+                _strip_meta(s.to_dict())
+                for s in collected
+                if s.metadata.labels.get(ENDPOINT_SLICE_SOURCE_CLUSTER_LABEL) != cluster
+            ]
+            if not imported:
+                continue
+            self._ensure_work(
+                cluster,
+                work_name("discovery.k8s.io/v1", "EndpointSlice", ns, name),
+                imported,
+                mcs,
+            )
+        return DONE
+
+    def _collect_slices(self, ns: str, svc_name: str, providers: list[str]) -> list[Unstructured]:
+        out: list[Unstructured] = []
+        for cluster in providers:
+            member = self.members.get(cluster)
+            if member is None:
+                continue
+            for s in member.store.list("discovery.k8s.io/v1/EndpointSlice", ns):
+                if s.metadata.labels.get(ENDPOINT_SLICE_SERVICE_LABEL) != svc_name:
+                    continue
+                d = _strip_meta(s.to_dict())
+                d["metadata"]["name"] = f"{svc_name}-{cluster}"
+                d["metadata"].setdefault("labels", {})[
+                    ENDPOINT_SLICE_SOURCE_CLUSTER_LABEL
+                ] = cluster
+                d["metadata"]["labels"][ENDPOINT_SLICE_SERVICE_LABEL] = svc_name
+                out.append(Unstructured(d))
+        return out
+
+    def _ensure_work(self, cluster: str, wname: str, manifests: list[dict], mcs) -> None:
+        wns = execution_namespace(cluster)
+        existing: Optional[Work] = self.store.try_get("Work", wname, wns)
+        work = existing or Work()
+        work.metadata.name = wname
+        work.metadata.namespace = wns
+        work.metadata.labels[MCS_WORK_LABEL] = f"{mcs.metadata.namespace}.{mcs.metadata.name}"
+        new_spec = WorkSpec(workload_manifests=manifests)
+        if existing is None:
+            work.spec = new_spec
+            self.store.create(work)
+        elif existing.spec != new_spec:
+            work.spec = new_spec
+            self.store.update(work)
+
+    def _gc_works(self, ns: str, name: str) -> None:
+        tag = f"{ns}.{name}"
+        for work in self.store.list("Work"):
+            if work.metadata.labels.get(MCS_WORK_LABEL) == tag:
+                self.store.delete("Work", work.metadata.name, work.metadata.namespace)
+
+
+class ServiceExportController:
+    """N2: collect EndpointSlices of exported Services into the control plane
+    (service_export_controller) and materialize derived services for
+    ServiceImports (service_import_controller)."""
+
+    def __init__(self, store: Store, members: dict, runtime: Runtime):
+        self.store = store
+        self.members = members
+        self.controller = runtime.register(
+            Controller(name="serviceexport", reconcile=self._reconcile)
+        )
+        store.watch("ServiceExport", self._on_export)
+        store.watch("ServiceImport", self._on_import)
+
+    def _on_export(self, event: str, exp) -> None:
+        self.controller.enqueue(f"export|{exp.metadata.key()}")
+
+    def _on_import(self, event: str, imp) -> None:
+        self.controller.enqueue(f"import|{imp.metadata.key()}")
+
+    def collect_once(self) -> None:
+        for exp in self.store.list("ServiceExport"):
+            self._on_export("MODIFIED", exp)
+        for imp in self.store.list("ServiceImport"):
+            self._on_import("MODIFIED", imp)
+
+    def _reconcile(self, key: str) -> str:
+        op, _, okey = key.partition("|")
+        ns, _, name = okey.partition("/")
+        if op == "export":
+            return self._reconcile_export(ns, name)
+        return self._reconcile_import(ns, name)
+
+    def _reconcile_export(self, ns: str, name: str) -> str:
+        exp = self.store.try_get("ServiceExport", name, ns)
+        if exp is None:
+            return DONE
+        # the export applies in every cluster the ServiceExport template was
+        # propagated to; here: every member that has the Service
+        for cluster, member in sorted(self.members.items()):
+            svc = member.get("v1", "Service", name, ns)
+            if svc is None:
+                continue
+            for s in member.store.list("discovery.k8s.io/v1/EndpointSlice", ns):
+                if s.metadata.labels.get(ENDPOINT_SLICE_SERVICE_LABEL) != name:
+                    continue
+                d = _strip_meta(s.to_dict())
+                d["metadata"]["name"] = f"{name}-{cluster}"
+                d["metadata"].setdefault("labels", {})[
+                    ENDPOINT_SLICE_SOURCE_CLUSTER_LABEL
+                ] = cluster
+                self.store.apply(Unstructured(d))
+        return DONE
+
+    def _reconcile_import(self, ns: str, name: str) -> str:
+        imp = self.store.try_get("ServiceImport", name, ns)
+        if imp is None:
+            return DONE
+        # derived service + imported slices dispatched to all clusters that
+        # do NOT export the service themselves
+        derived_name = DERIVED_SERVICE_PREFIX + name
+        slices = [
+            s
+            for s in self.store.list("discovery.k8s.io/v1/EndpointSlice", ns)
+            if s.metadata.labels.get(ENDPOINT_SLICE_SERVICE_LABEL) == name
+        ]
+        if not slices:
+            return DONE
+        derived = {
+            "apiVersion": "v1",
+            "kind": "Service",
+            "metadata": {"name": derived_name, "namespace": ns},
+            "spec": {
+                "ports": [
+                    {"name": p.name, "port": p.port, "protocol": p.protocol}
+                    for p in imp.spec.ports
+                ]
+            },
+        }
+        for cluster in sorted(self.members):
+            exported_here = any(
+                s.metadata.labels.get(ENDPOINT_SLICE_SOURCE_CLUSTER_LABEL) == cluster
+                for s in slices
+            )
+            if exported_here:
+                continue
+            manifests = [dict(derived)]
+            for s in slices:
+                d = _strip_meta(s.to_dict())
+                d["metadata"]["labels"][ENDPOINT_SLICE_SERVICE_LABEL] = derived_name
+                manifests.append(d)
+            wname = work_name("v1", "Service", ns, derived_name)
+            wns = execution_namespace(cluster)
+            existing = self.store.try_get("Work", wname, wns)
+            work = existing or Work()
+            work.metadata.name = wname
+            work.metadata.namespace = wns
+            work.metadata.labels[EXPORT_WORK_LABEL] = f"{ns}.{name}"
+            new_spec = WorkSpec(workload_manifests=manifests)
+            if existing is None:
+                work.spec = new_spec
+                self.store.create(work)
+            elif existing.spec != new_spec:
+                work.spec = new_spec
+                self.store.update(work)
+        return DONE

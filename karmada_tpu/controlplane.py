@@ -30,6 +30,7 @@ from .controllers.autoscaling import (
 from .controllers.binding import BindingController
 from .controllers.dependencies import DependenciesDistributor
 from .controllers.execution import ExecutionController
+from .controllers.mcs import MultiClusterServiceController, ServiceExportController
 from .controllers.namespace import NamespaceSyncController
 from .controllers.overrides import OverrideManager
 from .controllers.failover import (
@@ -49,6 +50,7 @@ from .features import (
     FAILOVER,
     FeatureGates,
     GRACEFUL_EVICTION,
+    MULTI_CLUSTER_SERVICE,
 )
 from .estimator.client import EstimatorRegistry, MemberEstimators
 from .interpreter.interpreter import ResourceInterpreter
@@ -148,6 +150,17 @@ class ControlPlane:
         )
         self.rebalancer_controller = WorkloadRebalancerController(self.store, self.runtime)
         self.remedy_controller = RemedyController(self.store, self.runtime)
+
+        # Networking family (N1/N2): MCS under its alpha gate
+        # (features.go MultiClusterService α off), ServiceExport/Import always
+        self.mcs_controller = (
+            MultiClusterServiceController(self.store, self.members, self.runtime)
+            if self.gates.enabled(MULTI_CLUSTER_SERVICE)
+            else None
+        )
+        self.service_export_controller = ServiceExportController(
+            self.store, self.members, self.runtime
+        )
 
         # Autoscaling family (A1-A4)
         self.metrics_adapter = MetricsAdapter(self.members)
@@ -253,6 +266,9 @@ class ControlPlane:
         self.federated_hpa_controller.tick()
         self.cron_federated_hpa_controller.tick()
         self.deployment_replicas_syncer.sync_once()
+        if self.mcs_controller is not None:
+            self.mcs_controller.collect_once()
+        self.service_export_controller.collect_once()
         return self.settle(max_steps)
 
     def run_descheduler(self) -> int:

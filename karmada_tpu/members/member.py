@@ -136,6 +136,44 @@ class InMemoryMember:
                 "conditions": [] if ok else [{"type": "Failed", "status": "True"}],
             }
             self.store.update(fresh)
+        if obj.kind in ("Service", "Deployment", "StatefulSet"):
+            self._sync_endpoint_slices(obj.namespace)
+
+    def _sync_endpoint_slices(self, namespace: str) -> None:
+        """Member-side endpoint controller: every Service with a selector gets
+        an EndpointSlice with one ready endpoint per ready pod of the
+        workloads it selects (what kube's endpointslice controller maintains;
+        these are what the control plane collects for MCS/ServiceExport)."""
+        for svc in self.store.list("v1/Service", namespace):
+            selector = svc.get("spec", "selector", default=None)
+            if not selector:
+                continue
+            ready_total = 0
+            for kind in ("apps/v1/Deployment", "apps/v1/StatefulSet"):
+                for wl in self.store.list(kind, namespace):
+                    pod_labels = wl.get("spec", "template", "metadata", "labels", default={}) or {}
+                    if all(pod_labels.get(k) == v for k, v in selector.items()):
+                        ready_total += int(wl.get("status", "readyReplicas", default=0) or 0)
+            slice_name = f"{svc.name}-{self.config.name}"
+            manifest = {
+                "apiVersion": "discovery.k8s.io/v1",
+                "kind": "EndpointSlice",
+                "metadata": {
+                    "name": slice_name,
+                    "namespace": namespace,
+                    "labels": {"kubernetes.io/service-name": svc.name},
+                },
+                "addressType": "IPv4",
+                "endpoints": [
+                    {"addresses": [f"10.244.0.{i + 1}"], "conditions": {"ready": True}}
+                    for i in range(ready_total)
+                ],
+                "ports": [
+                    {"name": p.get("name", ""), "port": p.get("port", 0)}
+                    for p in (svc.get("spec", "ports", default=[]) or [])
+                ],
+            }
+            self.store.apply(Unstructured(manifest))
 
     def set_healthy(self, healthy: bool) -> None:
         """Flip member health and re-run controllers over existing workloads

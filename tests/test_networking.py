@@ -1,0 +1,155 @@
+"""Networking family (N1/N2): MCS, ServiceExport/Import, EndpointSlices."""
+from __future__ import annotations
+
+import pytest
+
+from karmada_tpu.api.meta import ObjectMeta
+from karmada_tpu.api.networking import (
+    ENDPOINT_SLICE_SOURCE_CLUSTER_LABEL,
+    ExposurePort,
+    IngressBackend,
+    IngressRule,
+    MultiClusterIngress,
+    MultiClusterIngressSpec,
+    MultiClusterService,
+    MultiClusterServiceSpec,
+    ServiceExport,
+    ServiceImport,
+    ServiceImportSpec,
+)
+from karmada_tpu.api.unstructured import Unstructured
+from karmada_tpu.controlplane import ControlPlane
+from karmada_tpu.features import FeatureGates, MULTI_CLUSTER_SERVICE
+from karmada_tpu.members.member import MemberConfig
+from karmada_tpu.testing.fixtures import (
+    duplicated_placement,
+    new_deployment,
+    new_policy,
+    selector_for,
+)
+from karmada_tpu.webhook import AdmissionDenied
+
+
+def service_manifest(name="web", port=80):
+    return {
+        "apiVersion": "v1",
+        "kind": "Service",
+        "metadata": {"namespace": "default", "name": name},
+        "spec": {
+            "selector": {"app": name},
+            "ports": [{"name": "http", "port": port}],
+        },
+    }
+
+
+@pytest.fixture
+def cp():
+    plane = ControlPlane(gates=FeatureGates({MULTI_CLUSTER_SERVICE: True}))
+    plane.join_member(MemberConfig(name="m1", allocatable={"cpu": 100.0}))
+    plane.join_member(MemberConfig(name="m2", allocatable={"cpu": 100.0}))
+    return plane
+
+
+def deploy_to_m1(cp, name="web", replicas=3):
+    dep = new_deployment("default", name, replicas=replicas)
+    cp.store.create(dep)
+    cp.store.create(
+        new_policy("default", f"pp-{name}", [selector_for(dep)],
+                   duplicated_placement(["m1"]))
+    )
+    cp.settle()
+
+
+class TestMemberEndpointSlices:
+    def test_member_synthesizes_slices(self, cp):
+        deploy_to_m1(cp, replicas=3)
+        cp.members["m1"].apply_manifest(service_manifest())
+        slices = cp.members["m1"].store.list("discovery.k8s.io/v1/EndpointSlice", "default")
+        assert len(slices) == 1
+        assert len(slices[0].get("endpoints")) == 3
+
+    def test_slices_track_workload_status(self, cp):
+        deploy_to_m1(cp, replicas=2)
+        cp.members["m1"].apply_manifest(service_manifest())
+        # scale the deployment in the member (re-apply with more replicas)
+        dep = cp.members["m1"].get("apps/v1", "Deployment", "web", "default")
+        dep.set("spec", "replicas", 5)
+        cp.members["m1"].apply_manifest(dep.to_dict())
+        slices = cp.members["m1"].store.list("discovery.k8s.io/v1/EndpointSlice", "default")
+        assert len(slices[0].get("endpoints")) == 5
+
+
+class TestMultiClusterService:
+    def test_cross_cluster_dispatch(self, cp):
+        deploy_to_m1(cp, replicas=3)
+        # the Service template reaches m1 via MCS itself
+        cp.store.create(Unstructured(service_manifest()))
+        mcs = MultiClusterService(
+            metadata=ObjectMeta(name="web", namespace="default"),
+            spec=MultiClusterServiceSpec(
+                ports=[ExposurePort(name="http", port=80)],
+                provider_clusters=["m1"],
+                consumer_clusters=["m2"],
+            ),
+        )
+        cp.store.create(mcs)
+        cp.tick()
+        cp.tick()  # second sweep: collect slices created after first apply
+        # m2 (consumer) got the service and the imported slice from m1
+        svc_m2 = cp.members["m2"].get("v1", "Service", "web", "default")
+        assert svc_m2 is not None
+        slices_m2 = cp.members["m2"].store.list("discovery.k8s.io/v1/EndpointSlice", "default")
+        imported = [s for s in slices_m2
+                    if s.metadata.labels.get(ENDPOINT_SLICE_SOURCE_CLUSTER_LABEL) == "m1"]
+        assert imported and len(imported[0].get("endpoints")) == 3
+
+    def test_invalid_port_denied(self, cp):
+        mcs = MultiClusterService(
+            metadata=ObjectMeta(name="web", namespace="default"),
+            spec=MultiClusterServiceSpec(ports=[ExposurePort(name="http", port=99999)]),
+        )
+        with pytest.raises(AdmissionDenied, match="port"):
+            cp.store.create(mcs)
+
+
+class TestServiceExportImport:
+    def test_export_collects_slices(self, cp):
+        deploy_to_m1(cp, replicas=2)
+        cp.members["m1"].apply_manifest(service_manifest())
+        cp.store.create(ServiceExport(metadata=ObjectMeta(name="web", namespace="default")))
+        cp.settle()
+        collected = cp.store.list("discovery.k8s.io/v1/EndpointSlice", "default")
+        assert any(
+            s.metadata.labels.get(ENDPOINT_SLICE_SOURCE_CLUSTER_LABEL) == "m1"
+            for s in collected
+        )
+
+    def test_import_creates_derived_service(self, cp):
+        deploy_to_m1(cp, replicas=2)
+        cp.members["m1"].apply_manifest(service_manifest())
+        cp.store.create(ServiceExport(metadata=ObjectMeta(name="web", namespace="default")))
+        cp.settle()
+        cp.store.create(ServiceImport(
+            metadata=ObjectMeta(name="web", namespace="default"),
+            spec=ServiceImportSpec(ports=[ExposurePort(name="http", port=80)]),
+        ))
+        cp.settle()
+        derived = cp.members["m2"].get("v1", "Service", "derived-web", "default")
+        assert derived is not None
+        # m1 exports the service, so it must NOT get the derived copy
+        assert cp.members["m1"].get("v1", "Service", "derived-web", "default") is None
+
+
+class TestMultiClusterIngress:
+    def test_create_and_validate(self, cp):
+        mci = MultiClusterIngress(
+            metadata=ObjectMeta(name="ing", namespace="default"),
+            spec=MultiClusterIngressSpec(rules=[
+                IngressRule(host="web.example.com",
+                            backend=IngressBackend(service_name="web", service_port=80))
+            ]),
+        )
+        assert cp.store.create(mci) is not None
+        empty = MultiClusterIngress(metadata=ObjectMeta(name="bad", namespace="default"))
+        with pytest.raises(AdmissionDenied, match="rules"):
+            cp.store.create(empty)
