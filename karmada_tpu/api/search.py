@@ -1,0 +1,91 @@
+"""Search & quota APIs.
+
+- ResourceRegistry (reference: pkg/apis/search/v1alpha1): which resources to
+  cache from which clusters, with an optional backend store (OpenSearch).
+- FederatedResourceQuota (reference: pkg/apis/policy/v1alpha1/federatedresourcequota_types.go):
+  federation-wide hard limits with per-cluster static assignments.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Optional
+
+from .meta import ObjectMeta
+from .policy import ClusterAffinity
+
+KIND_RESOURCE_REGISTRY = "ResourceRegistry"
+KIND_FEDERATED_RESOURCE_QUOTA = "FederatedResourceQuota"
+
+
+@dataclass
+class SearchResourceSelector:
+    api_version: str = ""
+    kind: str = ""
+
+
+@dataclass
+class BackendStoreConfig:
+    """backendStore.openSearch equivalent; None = in-memory cache only."""
+
+    type: str = "memory"  # memory | opensearch
+    addresses: list[str] = field(default_factory=list)
+
+
+@dataclass
+class ResourceRegistrySpec:
+    target_cluster: ClusterAffinity = field(default_factory=ClusterAffinity)
+    resource_selectors: list[SearchResourceSelector] = field(default_factory=list)
+    backend_store: Optional[BackendStoreConfig] = None
+
+
+@dataclass
+class ResourceRegistry:
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    spec: ResourceRegistrySpec = field(default_factory=ResourceRegistrySpec)
+    kind: str = KIND_RESOURCE_REGISTRY
+
+    @property
+    def name(self) -> str:
+        return self.metadata.name
+
+
+@dataclass
+class StaticClusterAssignment:
+    cluster_name: str = ""
+    hard: dict[str, float] = field(default_factory=dict)
+
+
+@dataclass
+class FederatedResourceQuotaSpec:
+    overall: dict[str, float] = field(default_factory=dict)
+    static_assignments: list[StaticClusterAssignment] = field(default_factory=list)
+
+
+@dataclass
+class ClusterQuotaStatus:
+    cluster_name: str = ""
+    hard: dict[str, float] = field(default_factory=dict)
+    used: dict[str, float] = field(default_factory=dict)
+
+
+@dataclass
+class FederatedResourceQuotaStatus:
+    overall: dict[str, float] = field(default_factory=dict)
+    overall_used: dict[str, float] = field(default_factory=dict)
+    aggregated_status: list[ClusterQuotaStatus] = field(default_factory=list)
+
+
+@dataclass
+class FederatedResourceQuota:
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    spec: FederatedResourceQuotaSpec = field(default_factory=FederatedResourceQuotaSpec)
+    status: FederatedResourceQuotaStatus = field(default_factory=FederatedResourceQuotaStatus)
+    kind: str = KIND_FEDERATED_RESOURCE_QUOTA
+
+    @property
+    def name(self) -> str:
+        return self.metadata.name
+
+    @property
+    def namespace(self) -> str:
+        return self.metadata.namespace

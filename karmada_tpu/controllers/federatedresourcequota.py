@@ -1,0 +1,132 @@
+"""FederatedResourceQuota controllers (Q2, reference:
+pkg/controllers/federatedresourcequota/ — sync controller builds per-cluster
+ResourceQuota Works from staticAssignments; status controller aggregates the
+member quota statuses into status.aggregatedStatus + overallUsed).
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+from ..api.search import ClusterQuotaStatus, FederatedResourceQuota
+from ..api.work import Work, WorkSpec
+from ..runtime.controller import DONE, Controller, Runtime
+from ..store.store import DELETED, Store
+from ..utils.names import execution_namespace, work_name
+
+FRQ_WORK_LABEL = "federatedresourcequota.karmada.io/name"
+
+
+def _quota_manifest(ns: str, name: str, hard: dict[str, float]) -> dict:
+    return {
+        "apiVersion": "v1",
+        "kind": "ResourceQuota",
+        "metadata": {"namespace": ns, "name": name},
+        "spec": {"hard": dict(hard)},
+    }
+
+
+class FederatedResourceQuotaSyncController:
+    """federated_resource_quota_sync_controller: one ResourceQuota Work per
+    static assignment; orphaned Works (assignment removed) are deleted."""
+
+    def __init__(self, store: Store, runtime: Runtime):
+        self.store = store
+        self.controller = runtime.register(
+            Controller(name="federatedresourcequota-sync", reconcile=self._reconcile)
+        )
+        store.watch("FederatedResourceQuota", self._on_quota)
+        store.watch("Cluster", self._on_cluster)
+
+    def _on_quota(self, event: str, frq) -> None:
+        self.controller.enqueue(frq.metadata.key())
+
+    def _on_cluster(self, event: str, cluster) -> None:
+        for frq in self.store.list("FederatedResourceQuota"):
+            self.controller.enqueue(frq.metadata.key())
+
+    def _reconcile(self, key: str) -> str:
+        ns, _, name = key.partition("/")
+        frq: Optional[FederatedResourceQuota] = self.store.try_get(
+            "FederatedResourceQuota", name, ns
+        )
+        tag = f"{ns}.{name}"
+        if frq is None or frq.metadata.deletion_timestamp is not None:
+            for work in self.store.list("Work"):
+                if work.metadata.labels.get(FRQ_WORK_LABEL) == tag:
+                    self.store.delete("Work", work.metadata.name, work.metadata.namespace)
+            return DONE
+        clusters = {c.metadata.name for c in self.store.list("Cluster")}
+        wanted: set[tuple[str, str]] = set()
+        for sa in frq.spec.static_assignments:
+            if sa.cluster_name not in clusters:
+                continue
+            wname = work_name("v1", "ResourceQuota", ns, name)
+            wns = execution_namespace(sa.cluster_name)
+            wanted.add((wns, wname))
+            manifest = _quota_manifest(ns, name, sa.hard)
+            existing = self.store.try_get("Work", wname, wns)
+            work = existing or Work()
+            work.metadata.name = wname
+            work.metadata.namespace = wns
+            work.metadata.labels[FRQ_WORK_LABEL] = tag
+            new_spec = WorkSpec(workload_manifests=[manifest])
+            if existing is None:
+                work.spec = new_spec
+                self.store.create(work)
+            elif existing.spec != new_spec:
+                work.spec = new_spec
+                self.store.update(work)
+        # GC works for removed assignments
+        for work in self.store.list("Work"):
+            if work.metadata.labels.get(FRQ_WORK_LABEL) != tag:
+                continue
+            if (work.metadata.namespace, work.metadata.name) not in wanted:
+                self.store.delete("Work", work.metadata.name, work.metadata.namespace)
+        return DONE
+
+
+class FederatedResourceQuotaStatusController:
+    """federated_resource_quota_status_controller: collect member quota usage
+    → status.aggregatedStatus (sorted by cluster) + overallUsed."""
+
+    def __init__(self, store: Store, members: dict, runtime: Runtime):
+        self.store = store
+        self.members = members
+
+    def collect_once(self) -> int:
+        updated = 0
+        for frq in self.store.list("FederatedResourceQuota"):
+            agg: list[ClusterQuotaStatus] = []
+            overall_used: dict[str, float] = {}
+            for sa in sorted(frq.spec.static_assignments, key=lambda s: s.cluster_name):
+                member = self.members.get(sa.cluster_name)
+                if member is None:
+                    continue
+                quota = member.get("v1", "ResourceQuota", frq.metadata.name, frq.metadata.namespace)
+                if quota is None:
+                    continue
+                used = quota.get("status", "used", default=None)
+                if used is None:
+                    # the member quota controller would fill status.used from
+                    # pod consumption; absent that, usage is the cluster's
+                    # tracked allocation for the namespace (0 in simulation)
+                    used = {}
+                agg.append(
+                    ClusterQuotaStatus(
+                        cluster_name=sa.cluster_name, hard=dict(sa.hard), used=dict(used)
+                    )
+                )
+                for k, v in used.items():
+                    overall_used[k] = overall_used.get(k, 0.0) + v
+            status_changed = (
+                frq.status.aggregated_status != agg
+                or frq.status.overall_used != overall_used
+                or frq.status.overall != frq.spec.overall
+            )
+            if status_changed:
+                frq.status.aggregated_status = agg
+                frq.status.overall_used = overall_used
+                frq.status.overall = dict(frq.spec.overall)
+                self.store.update(frq)
+                updated += 1
+        return updated

@@ -1,0 +1,90 @@
+"""Unified-auth controller (Q3, reference: pkg/controllers/unifiedauth/, 340
+LoC): for every cluster, sync an impersonation ClusterRole + ClusterRoleBinding
+Work so subjects granted `clusters/proxy` access on the control plane can act
+through the aggregated proxy inside members with the same identity.
+"""
+from __future__ import annotations
+
+from ..api.work import Work, WorkSpec
+from ..runtime.controller import DONE, Controller, Runtime
+from ..store.store import DELETED, Store
+from ..utils.names import execution_namespace, work_name
+
+IMPERSONATOR_NAME = "karmada-impersonator"
+UNIFIED_AUTH_WORK_LABEL = "unifiedauth.karmada.io/managed"
+
+
+class UnifiedAuthController:
+    def __init__(self, store: Store, runtime: Runtime):
+        self.store = store
+        # subjects granted cluster-proxy access (the reference derives these
+        # from ClusterRoles referencing clusters/proxy; settable via CLI/API)
+        self.subjects: list[dict] = []
+        self.controller = runtime.register(
+            Controller(name="unifiedauth", reconcile=self._reconcile)
+        )
+        store.watch("Cluster", self._on_cluster)
+
+    def _on_cluster(self, event: str, cluster) -> None:
+        if event == DELETED:
+            return
+        self.controller.enqueue(cluster.metadata.name)
+
+    def grant(self, kind: str, name: str) -> None:
+        """Grant a subject (User/Group/ServiceAccount) proxy access and
+        re-sync every cluster."""
+        subject = {"kind": kind, "name": name}
+        if subject not in self.subjects:
+            self.subjects.append(subject)
+        for cluster in self.store.list("Cluster"):
+            self.controller.enqueue(cluster.metadata.name)
+
+    def _reconcile(self, cluster_name: str) -> str:
+        cluster = self.store.try_get("Cluster", cluster_name)
+        if cluster is None:
+            return DONE
+        wname = work_name("rbac.authorization.k8s.io/v1", "ClusterRole", "", IMPERSONATOR_NAME)
+        wns = execution_namespace(cluster_name)
+        if not self.subjects:
+            # nothing granted: no impersonation config is synced (the
+            # reference skips clusters without an impersonator secret,
+            # unified_auth_controller.go:89)
+            if self.store.try_get("Work", wname, wns) is not None:
+                self.store.delete("Work", wname, wns)
+            return DONE
+        role = {
+            "apiVersion": "rbac.authorization.k8s.io/v1",
+            "kind": "ClusterRole",
+            "metadata": {"name": IMPERSONATOR_NAME},
+            "rules": [
+                {
+                    "apiGroups": [""],
+                    "resources": ["users", "groups", "serviceaccounts"],
+                    "verbs": ["impersonate"],
+                }
+            ],
+        }
+        binding = {
+            "apiVersion": "rbac.authorization.k8s.io/v1",
+            "kind": "ClusterRoleBinding",
+            "metadata": {"name": IMPERSONATOR_NAME},
+            "roleRef": {
+                "apiGroup": "rbac.authorization.k8s.io",
+                "kind": "ClusterRole",
+                "name": IMPERSONATOR_NAME,
+            },
+            "subjects": list(self.subjects),
+        }
+        existing = self.store.try_get("Work", wname, wns)
+        work = existing or Work()
+        work.metadata.name = wname
+        work.metadata.namespace = wns
+        work.metadata.labels[UNIFIED_AUTH_WORK_LABEL] = "true"
+        new_spec = WorkSpec(workload_manifests=[role, binding])
+        if existing is None:
+            work.spec = new_spec
+            self.store.create(work)
+        elif existing.spec != new_spec:
+            work.spec = new_spec
+            self.store.update(work)
+        return DONE

@@ -30,7 +30,12 @@ from .controllers.autoscaling import (
 from .controllers.binding import BindingController
 from .controllers.dependencies import DependenciesDistributor
 from .controllers.execution import ExecutionController
+from .controllers.federatedresourcequota import (
+    FederatedResourceQuotaStatusController,
+    FederatedResourceQuotaSyncController,
+)
 from .controllers.mcs import MultiClusterServiceController, ServiceExportController
+from .controllers.unifiedauth import UnifiedAuthController
 from .controllers.namespace import NamespaceSyncController
 from .controllers.overrides import OverrideManager
 from .controllers.failover import (
@@ -59,6 +64,7 @@ from .metricsadapter import MetricsAdapter
 from .modeling import GradeHistogram, ModelBasedEstimator, default_resource_models
 from .runtime.controller import Clock, Runtime
 from .sched.scheduler import SchedulerDaemon
+from .search import ResourceCache, SearchProxy
 from .store.store import Store
 from .webhook import default_admission_chain
 
@@ -150,6 +156,17 @@ class ControlPlane:
         )
         self.rebalancer_controller = WorkloadRebalancerController(self.store, self.runtime)
         self.remedy_controller = RemedyController(self.store, self.runtime)
+
+        # Query plane (Q1-Q3)
+        self.resource_cache = ResourceCache(self.store, self.members)
+        self.search_proxy = SearchProxy(self.resource_cache)
+        self.frq_sync_controller = FederatedResourceQuotaSyncController(
+            self.store, self.runtime
+        )
+        self.frq_status_controller = FederatedResourceQuotaStatusController(
+            self.store, self.members, self.runtime
+        )
+        self.unified_auth_controller = UnifiedAuthController(self.store, self.runtime)
 
         # Networking family (N1/N2): MCS under its alpha gate
         # (features.go MultiClusterService α off), ServiceExport/Import always
@@ -269,6 +286,8 @@ class ControlPlane:
         if self.mcs_controller is not None:
             self.mcs_controller.collect_once()
         self.service_export_controller.collect_once()
+        self.resource_cache.sweep()
+        self.frq_status_controller.collect_once()
         return self.settle(max_steps)
 
     def run_descheduler(self) -> int:

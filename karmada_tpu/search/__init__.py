@@ -1,0 +1,15 @@
+from .search import (
+    BackendStore,
+    InMemoryBackend,
+    OpenSearchBackend,
+    ResourceCache,
+    SearchProxy,
+)
+
+__all__ = [
+    "BackendStore",
+    "InMemoryBackend",
+    "OpenSearchBackend",
+    "ResourceCache",
+    "SearchProxy",
+]

@@ -1,0 +1,190 @@
+"""karmada-search (Q1, reference: pkg/search/ 9.7k LoC): ResourceRegistry
+cache + aggregated search API + proxy.
+
+- ResourceCache (pkg/search/controller.go): per-ResourceRegistry collection of
+  member objects for the selected (cluster, resource) pairs, kept fresh by a
+  level-triggered sweep (the reference uses per-cluster dynamic informers; the
+  sweep is our resync).
+- search API (pkg/search/apiserver.go): federation-wide list with cluster
+  annotations.
+- proxy (pkg/search/proxy/controller.go:94,277 Connect): route GET/LIST to
+  the cached member objects — the "single pane of glass".
+- backend stores (pkg/search/backendstore): pluggable sinks; the default
+  keeps objects in memory, the OpenSearch one ships documents to a cluster
+  (stubbed offline: it records what it would index).
+"""
+from __future__ import annotations
+
+from typing import Optional, Protocol
+
+from ..api.unstructured import Unstructured
+
+CLUSTER_ANNOTATION = "resource.karmada.io/cached-from-cluster"
+
+
+class BackendStore(Protocol):
+    def index(self, cluster: str, obj: Unstructured) -> None: ...
+    def remove(self, cluster: str, gvk: str, namespace: str, name: str) -> None: ...
+
+
+class InMemoryBackend:
+    """Default backend: dict keyed (cluster, gvk, ns/name)."""
+
+    def __init__(self) -> None:
+        self.docs: dict[tuple, dict] = {}
+
+    def index(self, cluster: str, obj: Unstructured) -> None:
+        key = (cluster, f"{obj.api_version}/{obj.kind}", obj.namespace, obj.name)
+        self.docs[key] = obj.to_dict()
+
+    def remove(self, cluster: str, gvk: str, namespace: str, name: str) -> None:
+        self.docs.pop((cluster, gvk, namespace, name), None)
+
+
+class OpenSearchBackend:
+    """OpenSearch sink (backendstore/opensearch.go). Network egress is not
+    available in this environment, so documents are queued with the bulk
+    requests that would be sent; `flushed` exposes them for inspection."""
+
+    def __init__(self, addresses: list[str]):
+        self.addresses = addresses
+        self.pending: list[dict] = []
+
+    def index(self, cluster: str, obj: Unstructured) -> None:
+        self.pending.append(
+            {
+                "_op": "index",
+                "_index": f"{obj.kind.lower()}s",
+                "_id": f"{cluster}/{obj.namespace}/{obj.name}",
+                "doc": obj.to_dict(),
+            }
+        )
+
+    def remove(self, cluster: str, gvk: str, namespace: str, name: str) -> None:
+        self.pending.append(
+            {"_op": "delete", "_id": f"{cluster}/{namespace}/{name}", "_index": gvk}
+        )
+
+
+class ResourceCache:
+    """The registry-driven member-object cache + aggregated search API."""
+
+    def __init__(self, store, members: dict):
+        self.store = store
+        self.members = members
+        # (cluster, gvk, ns, name) -> Unstructured
+        self._cache: dict[tuple, Unstructured] = {}
+        self._backends: dict[str, BackendStore] = {}
+
+    def backend_for(self, registry) -> BackendStore:
+        name = registry.metadata.name
+        be = self._backends.get(name)
+        if be is None:
+            cfg = registry.spec.backend_store
+            if cfg is not None and cfg.type == "opensearch":
+                be = OpenSearchBackend(cfg.addresses)
+            else:
+                be = InMemoryBackend()
+            self._backends[name] = be
+        return be
+
+    def _selected_clusters(self, registry) -> list[str]:
+        clusters = sorted(c.metadata.name for c in self.store.list("Cluster"))
+        affinity = registry.spec.target_cluster
+        if affinity.cluster_names:
+            clusters = [c for c in clusters if c in affinity.cluster_names]
+        if affinity.exclude:
+            clusters = [c for c in clusters if c not in affinity.exclude]
+        return clusters
+
+    def sweep(self) -> int:
+        """Refresh the cache from every registry's selected members (informer
+        resync). Returns the number of cached objects."""
+        fresh: dict[tuple, Unstructured] = {}
+        for registry in self.store.list("ResourceRegistry"):
+            backend = self.backend_for(registry)
+            wanted = {(s.api_version, s.kind) for s in registry.spec.resource_selectors}
+            for cname in self._selected_clusters(registry):
+                member = self.members.get(cname)
+                if member is None:
+                    continue
+                for obj in member.objects():
+                    if (obj.api_version, obj.kind) not in wanted:
+                        continue
+                    key = (cname, f"{obj.api_version}/{obj.kind}", obj.namespace, obj.name)
+                    copy = Unstructured(obj.to_dict())
+                    copy.metadata.annotations[CLUSTER_ANNOTATION] = cname
+                    copy.sync_meta()
+                    fresh[key] = copy
+                    backend.index(cname, copy)
+        removed = set(self._cache) - set(fresh)
+        for key in removed:
+            cluster, gvk, ns, name = key
+            for be in self._backends.values():
+                be.remove(cluster, gvk, ns, name)
+        self._cache = fresh
+        return len(self._cache)
+
+    # -- aggregated search API -------------------------------------------
+
+    def search(
+        self,
+        api_version: str,
+        kind: str,
+        namespace: str = "",
+        name: str = "",
+        clusters: Optional[list[str]] = None,
+        label_selector: Optional[dict[str, str]] = None,
+    ) -> list[Unstructured]:
+        gvk = f"{api_version}/{kind}"
+        out = []
+        for (cname, g, ns, n), obj in sorted(self._cache.items()):
+            if g != gvk:
+                continue
+            if namespace and ns != namespace:
+                continue
+            if name and n != name:
+                continue
+            if clusters and cname not in clusters:
+                continue
+            if label_selector and any(
+                obj.metadata.labels.get(k) != v for k, v in label_selector.items()
+            ):
+                continue
+            out.append(obj)
+        return out
+
+
+class SearchProxy:
+    """Single-pane proxy (proxy/controller.go Connect): GET/LIST routed to the
+    cache, falling through to the live member for objects not yet cached."""
+
+    def __init__(self, cache: ResourceCache):
+        self.cache = cache
+
+    def get(self, cluster: str, api_version: str, kind: str,
+            name: str, namespace: str = "") -> Optional[Unstructured]:
+        hit = self.cache._cache.get((cluster, f"{api_version}/{kind}", namespace, name))
+        if hit is not None:
+            return hit
+        member = self.cache.members.get(cluster)
+        if member is None:
+            return None
+        return member.get(api_version, kind, name, namespace)
+
+    def list(self, cluster: str, api_version: str, kind: str,
+             namespace: str = "") -> list[Unstructured]:
+        out = [
+            obj
+            for (cname, gvk, ns, _), obj in sorted(self.cache._cache.items())
+            if cname == cluster and gvk == f"{api_version}/{kind}"
+            and (not namespace or ns == namespace)
+        ]
+        if out:
+            return out
+        member = self.cache.members.get(cluster)
+        if member is None:
+            return []
+        return [
+            o for o in member.store.list(f"{api_version}/{kind}", namespace)
+        ]

@@ -1,0 +1,178 @@
+"""Query plane (Q1-Q3): search cache/proxy, FederatedResourceQuota, unifiedauth."""
+from __future__ import annotations
+
+import pytest
+
+from karmada_tpu.api.meta import ObjectMeta
+from karmada_tpu.api.policy import ClusterAffinity
+from karmada_tpu.api.search import (
+    BackendStoreConfig,
+    FederatedResourceQuota,
+    FederatedResourceQuotaSpec,
+    ResourceRegistry,
+    ResourceRegistrySpec,
+    SearchResourceSelector,
+    StaticClusterAssignment,
+)
+from karmada_tpu.controlplane import ControlPlane
+from karmada_tpu.members.member import MemberConfig
+from karmada_tpu.search.search import CLUSTER_ANNOTATION, OpenSearchBackend
+from karmada_tpu.testing.fixtures import (
+    duplicated_placement,
+    new_deployment,
+    new_policy,
+    selector_for,
+)
+from karmada_tpu.webhook import AdmissionDenied
+
+
+@pytest.fixture
+def cp():
+    plane = ControlPlane()
+    plane.join_member(MemberConfig(name="m1", allocatable={"cpu": 100.0}))
+    plane.join_member(MemberConfig(name="m2", allocatable={"cpu": 100.0}))
+    return plane
+
+
+def registry(name="reg", clusters=None, backend=None):
+    return ResourceRegistry(
+        metadata=ObjectMeta(name=name),
+        spec=ResourceRegistrySpec(
+            target_cluster=ClusterAffinity(cluster_names=list(clusters or [])),
+            resource_selectors=[SearchResourceSelector(api_version="apps/v1", kind="Deployment")],
+            backend_store=backend,
+        ),
+    )
+
+
+def propagate(cp, name="web", replicas=2, clusters=None):
+    dep = new_deployment("default", name, replicas=replicas)
+    cp.store.create(dep)
+    cp.store.create(
+        new_policy("default", f"pp-{name}", [selector_for(dep)],
+                   duplicated_placement(clusters or []))
+    )
+    cp.settle()
+
+
+class TestSearchCache:
+    def test_sweep_and_search(self, cp):
+        propagate(cp)
+        cp.store.create(registry())
+        n = cp.resource_cache.sweep()
+        assert n == 2  # web cached from both members
+        hits = cp.resource_cache.search("apps/v1", "Deployment")
+        assert len(hits) == 2
+        assert {h.metadata.annotations[CLUSTER_ANNOTATION] for h in hits} == {"m1", "m2"}
+
+    def test_registry_cluster_scope(self, cp):
+        propagate(cp)
+        cp.store.create(registry(clusters=["m1"]))
+        cp.resource_cache.sweep()
+        hits = cp.resource_cache.search("apps/v1", "Deployment")
+        assert len(hits) == 1
+        assert hits[0].metadata.annotations[CLUSTER_ANNOTATION] == "m1"
+
+    def test_search_filters(self, cp):
+        propagate(cp, name="web")
+        propagate(cp, name="api")
+        cp.store.create(registry())
+        cp.resource_cache.sweep()
+        assert len(cp.resource_cache.search("apps/v1", "Deployment", name="api")) == 2
+        assert len(cp.resource_cache.search("apps/v1", "Deployment", clusters=["m2"])) == 2
+
+    def test_opensearch_backend_queues_documents(self, cp):
+        propagate(cp)
+        cp.store.create(
+            registry(backend=BackendStoreConfig(type="opensearch", addresses=["http://os:9200"]))
+        )
+        cp.resource_cache.sweep()
+        be = cp.resource_cache.backend_for(cp.store.get("ResourceRegistry", "reg"))
+        assert isinstance(be, OpenSearchBackend)
+        assert any(d["_op"] == "index" for d in be.pending)
+
+
+class TestSearchProxy:
+    def test_get_through_cache_and_fallthrough(self, cp):
+        propagate(cp)
+        cp.store.create(registry(clusters=["m1"]))
+        cp.resource_cache.sweep()
+        # cached path
+        hit = cp.search_proxy.get("m1", "apps/v1", "Deployment", "web", "default")
+        assert hit is not None and hit.metadata.annotations.get(CLUSTER_ANNOTATION) == "m1"
+        # m2 not in registry → live member fallthrough
+        live = cp.search_proxy.get("m2", "apps/v1", "Deployment", "web", "default")
+        assert live is not None and CLUSTER_ANNOTATION not in live.metadata.annotations
+
+    def test_list(self, cp):
+        propagate(cp)
+        cp.store.create(registry())
+        cp.resource_cache.sweep()
+        assert len(cp.search_proxy.list("m1", "apps/v1", "Deployment")) == 1
+
+
+class TestFederatedResourceQuota:
+    def frq(self, assignments):
+        return FederatedResourceQuota(
+            metadata=ObjectMeta(name="quota", namespace="default"),
+            spec=FederatedResourceQuotaSpec(
+                overall={"cpu": 20.0, "memory": 40.0},
+                static_assignments=[
+                    StaticClusterAssignment(cluster_name=c, hard=h) for c, h in assignments
+                ],
+            ),
+        )
+
+    def test_sync_creates_quota_works_and_members_get_quota(self, cp):
+        cp.store.create(self.frq([("m1", {"cpu": 12.0}), ("m2", {"cpu": 8.0})]))
+        cp.settle()
+        q1 = cp.members["m1"].get("v1", "ResourceQuota", "quota", "default")
+        assert q1 is not None
+        assert q1.get("spec", "hard")["cpu"] == 12.0
+
+    def test_status_aggregation(self, cp):
+        cp.store.create(self.frq([("m1", {"cpu": 12.0}), ("m2", {"cpu": 8.0})]))
+        cp.settle()
+        # simulate member quota usage
+        q1 = cp.members["m1"].get("v1", "ResourceQuota", "quota", "default")
+        q1.status = {"used": {"cpu": 3.0}}
+        cp.members["m1"].store.update(q1)
+        cp.tick()
+        frq = cp.store.get("FederatedResourceQuota", "quota", "default")
+        assert frq.status.overall_used == {"cpu": 3.0}
+        assert [s.cluster_name for s in frq.status.aggregated_status] == ["m1", "m2"]
+
+    def test_gc_on_assignment_removal(self, cp):
+        cp.store.create(self.frq([("m1", {"cpu": 12.0}), ("m2", {"cpu": 8.0})]))
+        cp.settle()
+        frq = cp.store.get("FederatedResourceQuota", "quota", "default")
+        frq.spec.static_assignments = frq.spec.static_assignments[:1]  # drop m2
+        cp.store.update(frq)
+        cp.settle()
+        works = [w for w in cp.store.list("Work")
+                 if w.metadata.labels.get("federatedresourcequota.karmada.io/name")]
+        assert len(works) == 1
+
+    def test_webhook_rejects_unknown_resource(self, cp):
+        bad = FederatedResourceQuota(
+            metadata=ObjectMeta(name="bad", namespace="default"),
+            spec=FederatedResourceQuotaSpec(
+                overall={"cpu": 10.0},
+                static_assignments=[StaticClusterAssignment(cluster_name="m1", hard={"gpu": 1.0})],
+            ),
+        )
+        with pytest.raises(AdmissionDenied, match="not present"):
+            cp.store.create(bad)
+
+
+class TestUnifiedAuth:
+    def test_impersonation_works_synced(self, cp):
+        cp.unified_auth_controller.grant("User", "alice")
+        cp.settle()
+        role = cp.members["m1"].get("rbac.authorization.k8s.io/v1", "ClusterRole",
+                                    "karmada-impersonator", "")
+        assert role is not None
+        binding = cp.members["m2"].get("rbac.authorization.k8s.io/v1", "ClusterRoleBinding",
+                                       "karmada-impersonator", "")
+        assert binding is not None
+        assert {"kind": "User", "name": "alice"} in binding.get("subjects")
