@@ -1,0 +1,91 @@
+"""Resource-interpreter customization APIs (reference:
+pkg/apis/config/v1alpha1 — ResourceInterpreterCustomization with per-operation
+Lua scripts, and ResourceInterpreterWebhookConfiguration pointing at external
+interpreter endpoints).
+
+The script dialect here is a sandboxed Python-expression subset (the TPU-native
+stand-in for the reference's gopher-lua sandbox, luavm/lua.go); the operation
+names and call contracts mirror interpreter.go:39-68.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Optional
+
+from .meta import ObjectMeta
+
+KIND_RESOURCE_INTERPRETER_CUSTOMIZATION = "ResourceInterpreterCustomization"
+KIND_RESOURCE_INTERPRETER_WEBHOOK_CONFIGURATION = "ResourceInterpreterWebhookConfiguration"
+
+
+@dataclass
+class CustomizationTarget:
+    api_version: str = ""
+    kind: str = ""
+
+
+@dataclass
+class ScriptRule:
+    script: str = ""
+
+
+@dataclass
+class Customizations:
+    """One optional script per interpreter operation (config/v1alpha1
+    CustomizationRules: GetReplicas/ReviseReplica/Retain/AggregateStatus/
+    ReflectStatus/InterpretHealth/GetDependencies)."""
+
+    replica_resource: Optional[ScriptRule] = None       # GetReplicas
+    replica_revision: Optional[ScriptRule] = None       # ReviseReplica
+    retention: Optional[ScriptRule] = None              # Retain
+    status_aggregation: Optional[ScriptRule] = None     # AggregateStatus
+    status_reflection: Optional[ScriptRule] = None      # ReflectStatus
+    health_interpretation: Optional[ScriptRule] = None  # InterpretHealth
+    dependency_interpretation: Optional[ScriptRule] = None  # GetDependencies
+
+
+@dataclass
+class ResourceInterpreterCustomizationSpec:
+    target: CustomizationTarget = field(default_factory=CustomizationTarget)
+    customizations: Customizations = field(default_factory=Customizations)
+
+
+@dataclass
+class ResourceInterpreterCustomization:
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    spec: ResourceInterpreterCustomizationSpec = field(
+        default_factory=ResourceInterpreterCustomizationSpec
+    )
+    kind: str = KIND_RESOURCE_INTERPRETER_CUSTOMIZATION
+
+    @property
+    def name(self) -> str:
+        return self.metadata.name
+
+
+@dataclass
+class InterpreterRule:
+    """Which (apiVersion, kind, operations) a webhook serves."""
+
+    api_versions: list[str] = field(default_factory=list)
+    kinds: list[str] = field(default_factory=list)
+    operations: list[str] = field(default_factory=list)  # e.g. InterpretReplica
+
+
+@dataclass
+class InterpreterWebhook:
+    name: str = ""
+    url: str = ""  # in-process endpoint name in the HookRegistry
+    rules: list[InterpreterRule] = field(default_factory=list)
+    timeout_seconds: int = 10
+
+
+@dataclass
+class ResourceInterpreterWebhookConfiguration:
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    webhooks: list[InterpreterWebhook] = field(default_factory=list)
+    kind: str = KIND_RESOURCE_INTERPRETER_WEBHOOK_CONFIGURATION
+
+    @property
+    def name(self) -> str:
+        return self.metadata.name

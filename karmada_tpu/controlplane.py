@@ -58,6 +58,11 @@ from .features import (
     MULTI_CLUSTER_SERVICE,
 )
 from .estimator.client import EstimatorRegistry, MemberEstimators
+from .interpreter.customized import (
+    DeclarativeInterpreterManager,
+    HookRegistry,
+    WebhookInterpreterManager,
+)
 from .interpreter.interpreter import ResourceInterpreter
 from .members.member import InMemoryMember, MemberConfig
 from .metricsadapter import MetricsAdapter
@@ -83,6 +88,7 @@ class ControlPlane:
         self.admission = default_admission_chain(self.gates)
         self.store.set_admission(self.admission.admit)
         self.interpreter = ResourceInterpreter()
+        self.interpreter.load_thirdparty()  # I3 shipped customizations
         self.members: dict[str, InMemoryMember] = {}
 
         self.estimator_registry = EstimatorRegistry()
@@ -98,6 +104,14 @@ class ControlPlane:
         )
 
         self.event_recorder = EventRecorder(self.store, clock=self.runtime.clock)
+        # customized interpreter tiers (I4 declarative, I5 webhook)
+        self.declarative_interpreter_manager = DeclarativeInterpreterManager(
+            self.store, self.interpreter, self.runtime
+        )
+        self.hook_registry = HookRegistry()
+        self.webhook_interpreter_manager = WebhookInterpreterManager(
+            self.store, self.interpreter, self.runtime, self.hook_registry
+        )
         self.detector = ResourceDetector(self.store, self.interpreter, self.runtime)
         self.scheduler = SchedulerDaemon(
             self.store,

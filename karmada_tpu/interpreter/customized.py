@@ -1,0 +1,287 @@
+"""Customized interpreter tiers (I4/I5) + thirdparty configs (I3).
+
+- DeclarativeInterpreterManager (I4, reference customized/declarative/):
+  watches ResourceInterpreterCustomization objects and (un)registers compiled
+  script interpreters on the facade's customized tier.
+- HookRegistry + WebhookInterpreterManager (I5, reference customized/webhook/ +
+  examples/customresourceinterpreter): ResourceInterpreterWebhookConfiguration
+  routes operations to named in-process endpoints (the stand-in for the HTTPS
+  hook servers).
+- THIRDPARTY_CUSTOMIZATIONS (I3, reference
+  default/thirdparty/resourcecustomizations/): shipped script configs for
+  common CRDs, loaded below the customized tiers.
+"""
+from __future__ import annotations
+
+from typing import Any, Callable, Optional
+
+from ..api.unstructured import Unstructured
+from ..api.work import AggregatedStatusItem, ReplicaRequirements
+from ..runtime.controller import DONE, Controller, Runtime
+from ..store.store import Store
+from .declarative import OPERATION_FUNCTIONS, ScriptError, compile_script
+from .interpreter import HEALTHY, KindInterpreter, UNHEALTHY, UNKNOWN, ResourceInterpreter
+
+
+def _wrap_scripts(fns: dict[str, Callable]) -> KindInterpreter:
+    """Adapt dict-level script functions to the Unstructured-level hooks."""
+    ki = KindInterpreter()
+
+    get_rep = fns.get("replica_resource")
+    if get_rep is not None:
+        def get_replicas(obj: Unstructured):
+            replicas, req = get_rep(obj.to_dict())
+            requirements = None
+            if req:
+                requirements = ReplicaRequirements(
+                    resource_request={k: float(v) for k, v in req.items()},
+                    namespace=obj.namespace,
+                )
+            return int(replicas or 0), requirements
+        ki.get_replicas = get_replicas
+
+    revise = fns.get("replica_revision")
+    if revise is not None:
+        ki.revise_replica = lambda obj, n: Unstructured(revise(obj.to_dict(), n))
+
+    retain = fns.get("retention")
+    if retain is not None:
+        ki.retain = lambda desired, observed: Unstructured(
+            retain(desired.to_dict(), observed.to_dict())
+        )
+
+    agg = fns.get("status_aggregation")
+    if agg is not None:
+        def aggregate(template: Unstructured, items: list[AggregatedStatusItem]):
+            dict_items = [
+                {"clusterName": it.cluster_name, "status": it.status or {}}
+                for it in items
+            ]
+            return Unstructured(agg(template.to_dict(), dict_items))
+        ki.aggregate_status = aggregate
+
+    reflect = fns.get("status_reflection")
+    if reflect is not None:
+        ki.reflect_status = lambda obj: reflect(obj.to_dict())
+
+    health = fns.get("health_interpretation")
+    if health is not None:
+        ki.interpret_health = lambda obj: (
+            HEALTHY if health(obj.to_dict()) else UNHEALTHY
+        )
+
+    deps = fns.get("dependency_interpretation")
+    if deps is not None:
+        ki.get_dependencies = lambda obj: list(deps(obj.to_dict()) or [])
+
+    return ki
+
+
+def compile_customization(spec) -> KindInterpreter:
+    """Compile every script in a ResourceInterpreterCustomizationSpec."""
+    fns: dict[str, Callable] = {}
+    for op in OPERATION_FUNCTIONS:
+        rule = getattr(spec.customizations, op, None)
+        if rule is not None and rule.script:
+            fns[op] = compile_script(rule.script, op)
+    if not fns:
+        raise ScriptError("customization defines no scripts")
+    return _wrap_scripts(fns)
+
+
+class DeclarativeInterpreterManager:
+    """Level-triggered registry sync: customization objects → facade tier."""
+
+    def __init__(self, store: Store, interpreter: ResourceInterpreter, runtime: Runtime):
+        self.store = store
+        self.interpreter = interpreter
+        self.controller = runtime.register(
+            Controller(name="interpreter-customizations", reconcile=self._reconcile)
+        )
+        store.watch("ResourceInterpreterCustomization", self._on_change)
+
+    def _on_change(self, event: str, ric) -> None:
+        self.controller.enqueue("sync")
+
+    def _reconcile(self, _key: str) -> str:
+        """Rebuild the whole customized tier (multiple customizations may
+        target one GVK; name-ascending merge order matches the reference's
+        configmanager sort)."""
+        by_gvk: dict[str, KindInterpreter] = {}
+        for ric in sorted(
+            self.store.list("ResourceInterpreterCustomization"),
+            key=lambda r: r.metadata.name,
+        ):
+            gvk = f"{ric.spec.target.api_version}/{ric.spec.target.kind}"
+            try:
+                ki = compile_customization(ric.spec)
+            except ScriptError:
+                continue  # admission validates scripts; defensive skip here
+            merged = by_gvk.get(gvk)
+            if merged is None:
+                by_gvk[gvk] = ki
+            else:
+                for f in (
+                    "get_replicas", "revise_replica", "retain", "aggregate_status",
+                    "get_dependencies", "reflect_status", "interpret_health",
+                ):
+                    if getattr(ki, f) is not None:
+                        setattr(merged, f, getattr(ki, f))
+        self.interpreter.set_declarative_tier(by_gvk)
+        return DONE
+
+
+class HookRegistry:
+    """Named in-process interpreter endpoints (the webhook servers)."""
+
+    def __init__(self) -> None:
+        self._endpoints: dict[str, Any] = {}
+
+    def register(self, url: str, handler: Any) -> None:
+        """handler: object with optional methods named like the operations
+        (get_replicas(obj dict) -> (n, req), interpret_health(obj) -> bool...)."""
+        self._endpoints[url] = handler
+
+    def get(self, url: str) -> Optional[Any]:
+        return self._endpoints.get(url)
+
+
+class WebhookInterpreterManager:
+    """ResourceInterpreterWebhookConfiguration → facade webhook tier."""
+
+    def __init__(self, store: Store, interpreter: ResourceInterpreter,
+                 runtime: Runtime, hooks: HookRegistry):
+        self.store = store
+        self.interpreter = interpreter
+        self.hooks = hooks
+        self.controller = runtime.register(
+            Controller(name="interpreter-webhooks", reconcile=self._reconcile)
+        )
+        store.watch("ResourceInterpreterWebhookConfiguration", self._on_change)
+
+    def _on_change(self, event: str, cfg) -> None:
+        self.controller.enqueue("sync")
+
+    def _reconcile(self, _key: str) -> str:
+        by_gvk: dict[str, KindInterpreter] = {}
+        for cfg in sorted(
+            self.store.list("ResourceInterpreterWebhookConfiguration"),
+            key=lambda c: c.metadata.name,
+        ):
+            for wh in cfg.webhooks:
+                handler = self.hooks.get(wh.url)
+                if handler is None:
+                    continue
+                for rule in wh.rules:
+                    for av in rule.api_versions:
+                        for kind in rule.kinds:
+                            gvk = f"{av}/{kind}"
+                            ki = by_gvk.setdefault(gvk, KindInterpreter())
+                            self._bind(ki, handler, rule.operations)
+        self.interpreter.set_webhook_tier(by_gvk)
+        return DONE
+
+    @staticmethod
+    def _bind(ki: KindInterpreter, handler, operations: list[str]) -> None:
+        ops = set(operations or ["*"])
+
+        def want(op: str) -> bool:
+            return "*" in ops or op in ops
+
+        if want("InterpretReplica") and hasattr(handler, "get_replicas"):
+            def get_replicas(obj: Unstructured):
+                n, req = handler.get_replicas(obj.to_dict())
+                requirements = (
+                    ReplicaRequirements(resource_request=dict(req), namespace=obj.namespace)
+                    if req else None
+                )
+                return int(n), requirements
+            ki.get_replicas = get_replicas
+        if want("ReviseReplica") and hasattr(handler, "revise_replica"):
+            ki.revise_replica = lambda obj, n: Unstructured(handler.revise_replica(obj.to_dict(), n))
+        if want("Retain") and hasattr(handler, "retain"):
+            ki.retain = lambda d, o: Unstructured(handler.retain(d.to_dict(), o.to_dict()))
+        if want("AggregateStatus") and hasattr(handler, "aggregate_status"):
+            ki.aggregate_status = lambda t, items: Unstructured(
+                handler.aggregate_status(
+                    t.to_dict(),
+                    [{"clusterName": i.cluster_name, "status": i.status or {}} for i in items],
+                )
+            )
+        if want("InterpretStatus") and hasattr(handler, "reflect_status"):
+            ki.reflect_status = lambda obj: handler.reflect_status(obj.to_dict())
+        if want("InterpretHealth") and hasattr(handler, "interpret_health"):
+            ki.interpret_health = lambda obj: (
+                HEALTHY if handler.interpret_health(obj.to_dict()) else UNHEALTHY
+            )
+        if want("InterpretDependency") and hasattr(handler, "get_dependencies"):
+            ki.get_dependencies = lambda obj: list(handler.get_dependencies(obj.to_dict()) or [])
+
+
+# -- I3: shipped thirdparty customizations ---------------------------------
+# (reference: default/thirdparty/resourcecustomizations/ — Lua for common
+# CRDs; the same operations expressed in the script dialect.)
+
+THIRDPARTY_CUSTOMIZATIONS: dict[str, dict[str, str]] = {
+    # Argo Rollouts: replicas like a Deployment, health from status phases
+    "argoproj.io/v1alpha1/Rollout": {
+        "replica_resource": (
+            "def GetReplicas(obj):\n"
+            "    spec = obj.get('spec', {})\n"
+            "    replicas = spec.get('replicas', 1)\n"
+            "    req = {}\n"
+            "    tpl = spec.get('template', {}).get('spec', {})\n"
+            "    for c in tpl.get('containers', []):\n"
+            "        for k, v in c.get('resources', {}).get('requests', {}).items():\n"
+            "            req[k] = req.get(k, 0) + float(v)\n"
+            "    return replicas, req\n"
+        ),
+        "replica_revision": (
+            "def ReviseReplica(obj, replica):\n"
+            "    obj.setdefault('spec', {})['replicas'] = replica\n"
+            "    return obj\n"
+        ),
+        "health_interpretation": (
+            "def InterpretHealth(obj):\n"
+            "    st = obj.get('status', {})\n"
+            "    return st.get('phase') == 'Healthy' or (\n"
+            "        st.get('readyReplicas', 0) >= obj.get('spec', {}).get('replicas', 1))\n"
+        ),
+    },
+    # OpenKruise CloneSet: Deployment-shaped workload CRD
+    "apps.kruise.io/v1alpha1/CloneSet": {
+        "replica_resource": (
+            "def GetReplicas(obj):\n"
+            "    spec = obj.get('spec', {})\n"
+            "    replicas = spec.get('replicas', 1)\n"
+            "    req = {}\n"
+            "    tpl = spec.get('template', {}).get('spec', {})\n"
+            "    for c in tpl.get('containers', []):\n"
+            "        for k, v in c.get('resources', {}).get('requests', {}).items():\n"
+            "            req[k] = req.get(k, 0) + float(v)\n"
+            "    return replicas, req\n"
+        ),
+        "replica_revision": (
+            "def ReviseReplica(obj, replica):\n"
+            "    obj.setdefault('spec', {})['replicas'] = replica\n"
+            "    return obj\n"
+        ),
+        "health_interpretation": (
+            "def InterpretHealth(obj):\n"
+            "    st = obj.get('status', {})\n"
+            "    return st.get('readyReplicas', 0) >= obj.get('spec', {}).get('replicas', 1)\n"
+        ),
+        "status_reflection": (
+            "def ReflectStatus(obj):\n"
+            "    return obj.get('status')\n"
+        ),
+    },
+}
+
+
+def load_thirdparty_tier() -> dict[str, KindInterpreter]:
+    out: dict[str, KindInterpreter] = {}
+    for gvk, scripts in THIRDPARTY_CUSTOMIZATIONS.items():
+        fns = {op: compile_script(src, op) for op, src in scripts.items()}
+        out[gvk] = _wrap_scripts(fns)
+    return out

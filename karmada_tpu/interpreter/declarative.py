@@ -1,0 +1,83 @@
+"""Sandboxed declarative script engine (I4, reference:
+pkg/resourceinterpreter/customized/declarative/luavm/lua.go — a gopher-lua
+sandbox with k8s helpers; here a restricted Python-expression dialect, since
+the operation contracts — not the scripting language — are the API surface).
+
+A script defines ONE function with the operation's canonical name:
+    GetReplicas(obj)                -> (replicas, requirement_dict_or_None)
+    ReviseReplica(obj, replica)     -> obj
+    Retain(desiredObj, observedObj) -> obj
+    AggregateStatus(obj, items)     -> obj   (items: list of {cluster, status})
+    ReflectStatus(obj)              -> dict or None
+    InterpretHealth(obj)            -> bool
+    GetDependencies(obj)            -> list of {apiVersion, kind, namespace, name}
+
+Objects are plain dicts (the Lua side also sees tables). The sandbox rejects
+imports, dunder access, and exec/eval/open at compile time, and runs with a
+minimal builtin set.
+"""
+from __future__ import annotations
+
+import ast
+from typing import Any, Callable
+
+OPERATION_FUNCTIONS = {
+    "replica_resource": "GetReplicas",
+    "replica_revision": "ReviseReplica",
+    "retention": "Retain",
+    "status_aggregation": "AggregateStatus",
+    "status_reflection": "ReflectStatus",
+    "health_interpretation": "InterpretHealth",
+    "dependency_interpretation": "GetDependencies",
+}
+
+_FORBIDDEN_NAMES = {
+    "eval", "exec", "open", "compile", "globals", "locals", "vars",
+    "getattr", "setattr", "delattr", "__import__", "input", "breakpoint",
+}
+
+_SAFE_BUILTINS = {
+    "len": len, "int": int, "float": float, "str": str, "bool": bool,
+    "dict": dict, "list": list, "tuple": tuple, "set": set,
+    "min": min, "max": max, "sum": sum, "abs": abs, "round": round,
+    "sorted": sorted, "reversed": reversed, "range": range,
+    "enumerate": enumerate, "zip": zip, "any": any, "all": all,
+    "isinstance": isinstance, "True": True, "False": False, "None": None,
+}
+
+
+class ScriptError(Exception):
+    pass
+
+
+def _check_ast(tree: ast.AST) -> None:
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.Import, ast.ImportFrom)):
+            raise ScriptError("imports are not allowed in interpreter scripts")
+        if isinstance(node, ast.Attribute) and node.attr.startswith("__"):
+            raise ScriptError("dunder attribute access is not allowed")
+        if isinstance(node, ast.Name) and node.id in _FORBIDDEN_NAMES:
+            raise ScriptError(f"{node.id!r} is not allowed in interpreter scripts")
+        if isinstance(node, (ast.Global, ast.Nonlocal)):
+            raise ScriptError("global/nonlocal are not allowed")
+
+
+def compile_script(script: str, operation: str) -> Callable[..., Any]:
+    """Compile a customization script and return the operation function."""
+    fn_name = OPERATION_FUNCTIONS.get(operation)
+    if fn_name is None:
+        raise ScriptError(f"unknown operation {operation!r}")
+    try:
+        tree = ast.parse(script)
+    except SyntaxError as e:
+        raise ScriptError(f"syntax error in {operation} script: {e}") from e
+    _check_ast(tree)
+    env: dict[str, Any] = {"__builtins__": _SAFE_BUILTINS}
+    try:
+        exec(compile(tree, f"<{operation}>", "exec"), env)  # noqa: S102 - sandboxed above
+    except Exception as e:  # noqa: BLE001
+        raise ScriptError(f"error loading {operation} script: {e}") from e
+    fn = env.get(fn_name)
+    if not callable(fn):
+        raise ScriptError(f"{operation} script must define {fn_name}()")
+    return fn

@@ -176,7 +176,11 @@ class ResourceInterpreter:
     per GVK; generic fallbacks keep unknown kinds propagatable."""
 
     def __init__(self) -> None:
+        # Tier priority (interpreter.go: customized webhook > customized
+        # declarative > thirdparty configs > default native):
+        self._webhook: dict[str, KindInterpreter] = {}
         self._custom: dict[str, KindInterpreter] = {}
+        self._thirdparty: dict[str, KindInterpreter] = {}
         self._native: dict[str, KindInterpreter] = {
             "apps/v1/Deployment": KindInterpreter(
                 get_replicas=_deployment_get_replicas,
@@ -204,9 +208,23 @@ class ResourceInterpreter:
         """Customized interpreter tier (ResourceInterpreterCustomization)."""
         self._custom[gvk] = interpreter
 
+    def set_declarative_tier(self, tier: dict[str, KindInterpreter]) -> None:
+        """Replace the declarative-customization tier wholesale (the manager
+        rebuilds it from the live customization objects)."""
+        self._custom = tier
+
+    def set_webhook_tier(self, tier: dict[str, KindInterpreter]) -> None:
+        self._webhook = tier
+
+    def load_thirdparty(self) -> None:
+        """Load the shipped thirdparty configs (default/thirdparty/)."""
+        from .customized import load_thirdparty_tier
+
+        self._thirdparty = load_thirdparty_tier()
+
     def _hook(self, obj: Unstructured, name: str):
         gvk = self._gvk(obj)
-        for tier in (self._custom, self._native):
+        for tier in (self._webhook, self._custom, self._thirdparty, self._native):
             ki = tier.get(gvk)
             if ki is not None and getattr(ki, name) is not None:
                 return getattr(ki, name)

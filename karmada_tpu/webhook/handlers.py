@@ -236,20 +236,21 @@ def _validate_interpreter_customization(req: AdmissionRequest) -> None:
     ric = req.obj
     if not ric.spec.target.api_version or not ric.spec.target.kind:
         raise AdmissionDenied(req.kind, f"{ric.metadata.name}: target apiVersion/kind must be set")
-    ops = ric.spec.customizations
-    scripts = [
-        getattr(ops, f, None)
-        for f in (
-            "replica_resource",
-            "replica_revision",
-            "retention",
-            "status_aggregation",
-            "status_reflection",
-            "health_interpretation",
-            "dependency_interpretation",
-        )
-    ]
-    if not any(s and s.script for s in scripts if s is not None):
+    from ..interpreter.declarative import OPERATION_FUNCTIONS, ScriptError, compile_script
+
+    any_script = False
+    for op in OPERATION_FUNCTIONS:
+        rule = getattr(ric.spec.customizations, op, None)
+        if rule is None or not rule.script:
+            continue
+        any_script = True
+        try:
+            # scripts must compile in the sandbox (the reference's webhook
+            # runs the Lua compile check at admission time)
+            compile_script(rule.script, op)
+        except ScriptError as e:
+            raise AdmissionDenied(req.kind, f"{ric.metadata.name}: {op}: {e}") from e
+    if not any_script:
         raise AdmissionDenied(req.kind, f"{ric.metadata.name}: at least one customization required")
 
 

@@ -1,0 +1,200 @@
+"""Interpreter customization tiers (I3-I5): declarative scripts, webhooks,
+thirdparty configs, sandbox safety."""
+from __future__ import annotations
+
+import pytest
+
+from karmada_tpu.api.interpreter import (
+    CustomizationTarget,
+    Customizations,
+    InterpreterRule,
+    InterpreterWebhook,
+    ResourceInterpreterCustomization,
+    ResourceInterpreterCustomizationSpec,
+    ResourceInterpreterWebhookConfiguration,
+    ScriptRule,
+)
+from karmada_tpu.api.meta import ObjectMeta
+from karmada_tpu.api.unstructured import Unstructured
+from karmada_tpu.controlplane import ControlPlane
+from karmada_tpu.interpreter.declarative import ScriptError, compile_script
+from karmada_tpu.interpreter.interpreter import HEALTHY, UNHEALTHY
+from karmada_tpu.members.member import MemberConfig
+from karmada_tpu.testing.fixtures import (
+    duplicated_placement,
+    new_policy,
+)
+from karmada_tpu.api.policy import ResourceSelector
+from karmada_tpu.webhook import AdmissionDenied
+
+
+def crd_workload(name="demo", replicas=3):
+    return Unstructured({
+        "apiVersion": "example.io/v1",
+        "kind": "MyWorkload",
+        "metadata": {"namespace": "default", "name": name},
+        "spec": {"replicas": replicas, "podTemplate": {"cpuPerPod": 0.5}},
+    })
+
+
+GET_REPLICAS_SCRIPT = """
+def GetReplicas(obj):
+    spec = obj.get('spec', {})
+    return spec.get('replicas', 1), {'cpu': spec.get('podTemplate', {}).get('cpuPerPod', 0)}
+"""
+
+HEALTH_SCRIPT = """
+def InterpretHealth(obj):
+    return obj.get('status', {}).get('ready', 0) >= obj.get('spec', {}).get('replicas', 1)
+"""
+
+
+class TestSandbox:
+    def test_compile_and_run(self):
+        fn = compile_script(GET_REPLICAS_SCRIPT, "replica_resource")
+        n, req = fn(crd_workload().to_dict())
+        assert n == 3 and req == {"cpu": 0.5}
+
+    @pytest.mark.parametrize("bad", [
+        "import os\ndef GetReplicas(obj):\n    return 1, {}",
+        "def GetReplicas(obj):\n    return eval('1'), {}",
+        "def GetReplicas(obj):\n    return obj.__class__, {}",
+        "def GetReplicas(obj):\n    open('/etc/passwd')\n    return 1, {}",
+        "def WrongName(obj):\n    return 1, {}",
+        "def GetReplicas(obj:\n    return",
+    ])
+    def test_rejects_unsafe_or_broken(self, bad):
+        with pytest.raises(ScriptError):
+            compile_script(bad, "replica_resource")
+
+
+class TestDeclarativeCustomization:
+    def ric(self, name="ric-demo"):
+        return ResourceInterpreterCustomization(
+            metadata=ObjectMeta(name=name),
+            spec=ResourceInterpreterCustomizationSpec(
+                target=CustomizationTarget(api_version="example.io/v1", kind="MyWorkload"),
+                customizations=Customizations(
+                    replica_resource=ScriptRule(script=GET_REPLICAS_SCRIPT),
+                    health_interpretation=ScriptRule(script=HEALTH_SCRIPT),
+                ),
+            ),
+        )
+
+    def test_customization_drives_propagation(self):
+        cp = ControlPlane()
+        cp.join_member(MemberConfig(name="m1", allocatable={"cpu": 100.0}))
+        cp.store.create(self.ric())
+        cp.settle()
+        wl = crd_workload(replicas=4)
+        cp.store.create(wl)
+        cp.store.create(new_policy(
+            "default", "pp",
+            [ResourceSelector(api_version="example.io/v1", kind="MyWorkload",
+                              namespace="default", name="demo")],
+            duplicated_placement(),
+        ))
+        cp.settle()
+        rb = next(iter(cp.store.list("ResourceBinding")))
+        assert rb.spec.replicas == 4
+        assert rb.spec.replica_requirements.resource_request == {"cpu": 0.5}
+
+    def test_health_script(self):
+        cp = ControlPlane()
+        cp.store.create(self.ric())
+        cp.settle()
+        obj = crd_workload(replicas=2)
+        obj.status = {"ready": 2}
+        assert cp.interpreter.interpret_health(obj) == HEALTHY
+        obj.status = {"ready": 1}
+        assert cp.interpreter.interpret_health(obj) == UNHEALTHY
+
+    def test_deleting_customization_unregisters(self):
+        cp = ControlPlane()
+        cp.store.create(self.ric())
+        cp.settle()
+        n, _ = cp.interpreter.get_replicas(crd_workload())
+        assert n == 3
+        cp.store.delete("ResourceInterpreterCustomization", "ric-demo")
+        cp.settle()
+        n, _ = cp.interpreter.get_replicas(crd_workload())
+        assert n == 0  # back to non-workload default
+
+    def test_admission_rejects_bad_script(self):
+        cp = ControlPlane()
+        bad = self.ric("bad")
+        bad.spec.customizations.replica_resource = ScriptRule(script="import os")
+        with pytest.raises(AdmissionDenied, match="replica_resource"):
+            cp.store.create(bad)
+
+
+class TestWebhookInterpreter:
+    class Handler:
+        def get_replicas(self, obj):
+            return obj.get("spec", {}).get("size", 1), {"cpu": 1.0}
+
+        def interpret_health(self, obj):
+            return obj.get("status", {}).get("ok", False)
+
+    def test_webhook_tier_wins(self):
+        cp = ControlPlane()
+        cp.hook_registry.register("hooks://demo", self.Handler())
+        cfg = ResourceInterpreterWebhookConfiguration(
+            metadata=ObjectMeta(name="cfg"),
+            webhooks=[InterpreterWebhook(
+                name="demo.example.io",
+                url="hooks://demo",
+                rules=[InterpreterRule(api_versions=["example.io/v1"], kinds=["MyWorkload"],
+                                       operations=["InterpretReplica", "InterpretHealth"])],
+            )],
+        )
+        cp.store.create(cfg)
+        cp.settle()
+        obj = crd_workload()
+        obj.set("spec", "size", 9)
+        n, req = cp.interpreter.get_replicas(obj)
+        assert n == 9 and req.resource_request == {"cpu": 1.0}
+
+    def test_duplicate_webhook_names_denied(self):
+        cp = ControlPlane()
+        cfg = ResourceInterpreterWebhookConfiguration(
+            metadata=ObjectMeta(name="cfg"),
+            webhooks=[
+                InterpreterWebhook(name="a", url="u1"),
+                InterpreterWebhook(name="a", url="u2"),
+            ],
+        )
+        with pytest.raises(AdmissionDenied, match="duplicate"):
+            cp.store.create(cfg)
+
+
+class TestThirdparty:
+    def test_rollout_interpreted(self):
+        cp = ControlPlane()
+        rollout = Unstructured({
+            "apiVersion": "argoproj.io/v1alpha1",
+            "kind": "Rollout",
+            "metadata": {"namespace": "default", "name": "r"},
+            "spec": {
+                "replicas": 5,
+                "template": {"spec": {"containers": [
+                    {"name": "c", "resources": {"requests": {"cpu": "0.2"}}}
+                ]}},
+            },
+        })
+        n, req = cp.interpreter.get_replicas(rollout)
+        assert n == 5
+        assert req.resource_request["cpu"] == pytest.approx(0.2)
+        rollout.status = {"phase": "Healthy"}
+        assert cp.interpreter.interpret_health(rollout) == HEALTHY
+
+    def test_cloneset_revise(self):
+        cp = ControlPlane()
+        cs = Unstructured({
+            "apiVersion": "apps.kruise.io/v1alpha1",
+            "kind": "CloneSet",
+            "metadata": {"namespace": "default", "name": "c"},
+            "spec": {"replicas": 2},
+        })
+        out = cp.interpreter.revise_replica(cs, 7)
+        assert out.get("spec", "replicas") == 7
