@@ -1,0 +1,3 @@
+from .agent import KarmadaAgent
+
+__all__ = ["KarmadaAgent"]

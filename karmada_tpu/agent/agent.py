@@ -1,0 +1,147 @@
+"""Pull-mode member agent (L7, reference: cmd/agent/app/agent.go:73,135,248-433).
+
+The agent runs member-side and owns, for its own cluster only:
+- cluster registration (generateClusterInControllerPlane, agent.go:437 — done
+  by ControlPlane.join_member for Pull configs, which attaches this agent),
+- the execution controller (apply Works from the cluster namespace),
+- work status reflection for its Works,
+- the cluster Lease heartbeat + resource-summary refresh (the signal the
+  control plane's failure detector watches; cluster_status_controller.go:400).
+
+Push clusters never get an agent; the central execution controller serves
+them. The split is the sync-mode seam of the reference (ClusterSyncMode
+Push/Pull, apis/cluster types.go).
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Optional
+
+from ..api.meta import ObjectMeta
+from ..api.work import Work, cluster_of_work_namespace, work_namespace_for_cluster
+from ..controllers.execution import (
+    EXECUTION_FINALIZER,
+    apply_work_manifests,
+    remove_work_manifests,
+)
+from ..api.meta import Condition, set_condition
+from ..api.work import WORK_CONDITION_APPLIED
+from ..runtime.controller import Controller, DONE, Runtime
+from ..store.store import Store
+
+LEASE_DURATION_SECONDS = 40.0  # cluster lease default (cluster API)
+
+
+@dataclass
+class Lease:
+    """coordination.k8s.io/v1 Lease equivalent for cluster heartbeats."""
+
+    kind: str = "Lease"
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    holder: str = ""
+    renew_time: float = 0.0
+    lease_duration_seconds: float = LEASE_DURATION_SECONDS
+
+    @property
+    def name(self) -> str:
+        return self.metadata.name
+
+    @property
+    def namespace(self) -> str:
+        return self.metadata.namespace
+
+
+class KarmadaAgent:
+    def __init__(self, store: Store, member, interpreter, runtime: Runtime):
+        self.store = store
+        self.member = member
+        self.interpreter = interpreter
+        self.clock = runtime.clock
+        self.namespace = work_namespace_for_cluster(member.name)
+        self.controller = runtime.register(
+            Controller(name=f"agent-{member.name}", reconcile=self._reconcile)
+        )
+        store.watch("Work", self._on_work)
+
+    def _on_work(self, event: str, work: Work) -> None:
+        if work.metadata.namespace == self.namespace:
+            self.controller.enqueue(work.metadata.key())
+
+    def _reconcile(self, key: str) -> str:
+        ns, _, name = key.partition("/")
+        if cluster_of_work_namespace(ns) != self.member.name:
+            return DONE
+        work = self.store.try_get("Work", name, ns)
+        if work is None:
+            return DONE
+        if work.metadata.deletion_timestamp is not None:
+            if not work.spec.preserve_resources_on_deletion:
+                remove_work_manifests(work, self.member)
+            if EXECUTION_FINALIZER in work.metadata.finalizers:
+                work.metadata.finalizers.remove(EXECUTION_FINALIZER)
+                self.store.update(work)
+            return DONE
+        if EXECUTION_FINALIZER not in work.metadata.finalizers:
+            work.metadata.finalizers.append(EXECUTION_FINALIZER)
+            work = self.store.update(work)
+        if work.spec.suspend_dispatching:
+            return DONE
+        errors = apply_work_manifests(work, self.member, self.interpreter)
+        if set_condition(
+            work.status.conditions,
+            Condition(
+                type=WORK_CONDITION_APPLIED,
+                status="False" if errors else "True",
+                reason="AppliedFailed" if errors else "AppliedSuccessful",
+                message="; ".join(errors) if errors else "Manifest has been successfully applied",
+            ),
+        ):
+            self.store.update(work)
+        return DONE
+
+    # -- heartbeat (cluster lease + status refresh) -----------------------
+
+    def heartbeat(self) -> None:
+        """Renew the cluster Lease and refresh the reported ResourceSummary
+        (the agent's clusterStatus controller). Skipped when the member is
+        down — that is exactly the failure the lease detector catches."""
+        if not self.member.healthy:
+            return
+        lease = self.store.try_get("Lease", self.member.name, self.namespace)
+        if lease is None:
+            lease = Lease(
+                metadata=ObjectMeta(name=self.member.name, namespace=self.namespace),
+                holder=self.member.name,
+            )
+            lease.renew_time = self.clock.now()
+            self.store.create(lease)
+        else:
+            lease.renew_time = self.clock.now()
+            self.store.update(lease)
+        cluster = self.store.try_get("Cluster", self.member.name)
+        if cluster is not None and cluster.status.resource_summary is not None:
+            alloc = dict(self.member.config.allocatable)
+            if cluster.status.resource_summary.allocatable != alloc:
+                cluster.status.resource_summary.allocatable = alloc
+                self.store.update(cluster)
+
+
+class LeaseFailureDetector:
+    """Control-plane side: a cluster whose lease expired goes NotReady
+    (cluster_status_controller.go lease monitoring + condition cache)."""
+
+    def __init__(self, store: Store, runtime: Runtime, on_not_ready=None):
+        self.store = store
+        self.clock = runtime.clock
+        self.on_not_ready = on_not_ready  # callback(cluster_name)
+
+    def check(self) -> list[str]:
+        expired = []
+        now = self.clock.now()
+        for lease in self.store.list("Lease"):
+            if now - lease.renew_time > lease.lease_duration_seconds:
+                cluster_name = lease.holder
+                expired.append(cluster_name)
+                if self.on_not_ready is not None:
+                    self.on_not_ready(cluster_name)
+        return expired

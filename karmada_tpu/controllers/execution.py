@@ -25,6 +25,39 @@ from ..store.store import Store
 EXECUTION_FINALIZER = "karmada.io/execution-controller"
 
 
+def apply_work_manifests(work: Work, member, interpreter: ResourceInterpreter) -> list[str]:
+    """Apply every manifest of a Work to the member with interpreter retain
+    (objectwatcher.Create/Update path); returns per-manifest error strings.
+    Shared by the push-mode execution controller and the pull-mode agent."""
+    errors: list[str] = []
+    for manifest in work.spec.workload_manifests:
+        try:
+            desired = Unstructured(dict(manifest))
+            observed = member.get(
+                desired.api_version, desired.kind, desired.name, desired.namespace
+            )
+            if observed is not None:
+                desired = interpreter.retain(desired, observed)
+            member.apply_manifest(desired.to_dict())
+        except Exception as e:  # noqa: BLE001 — reported on the Work
+            errors.append(
+                f"{manifest.get('kind')}/{manifest.get('metadata', {}).get('name')}: {e}"
+            )
+    return errors
+
+
+def remove_work_manifests(work: Work, member) -> None:
+    """Finalizer-driven teardown of a Work's member objects."""
+    for manifest in work.spec.workload_manifests:
+        md = manifest.get("metadata", {})
+        member.delete_manifest(
+            manifest.get("apiVersion", ""),
+            manifest.get("kind", ""),
+            md.get("namespace", ""),
+            md.get("name", ""),
+        )
+
+
 class ExecutionController:
     def __init__(
         self,
@@ -32,10 +65,15 @@ class ExecutionController:
         members: dict,
         interpreter: ResourceInterpreter,
         runtime: Runtime,
+        pull_clusters=None,  # any container supporting `in` (live dict view ok)
     ) -> None:
         self.store = store
         self.members = members
         self.interpreter = interpreter
+        # clusters served by a pull-mode agent: the push controller must not
+        # touch their Works (cmd/agent runs the execution controller
+        # in-member for those, agent.go:248-433)
+        self.pull_clusters = pull_clusters if pull_clusters is not None else frozenset()
         self.controller = runtime.register(
             Controller(name="execution", reconcile=self._reconcile)
         )
@@ -50,20 +88,15 @@ class ExecutionController:
         if work is None:
             return DONE
         cluster = cluster_of_work_namespace(ns)
+        if cluster in self.pull_clusters:
+            return DONE  # the member's agent owns this Work
         member = self.members.get(cluster)
         if work.metadata.deletion_timestamp is not None:
             # Finalizer-driven teardown (execution_controller.go finalizer +
             # PreserveResourcesOnDeletion gate): remove member objects derived
             # from the Work's own manifests — restart-safe, no side cache.
             if member is not None and not work.spec.preserve_resources_on_deletion:
-                for manifest in work.spec.workload_manifests:
-                    md = manifest.get("metadata", {})
-                    member.delete_manifest(
-                        manifest.get("apiVersion", ""),
-                        manifest.get("kind", ""),
-                        md.get("namespace", ""),
-                        md.get("name", ""),
-                    )
+                remove_work_manifests(work, member)
             if EXECUTION_FINALIZER in work.metadata.finalizers:
                 work.metadata.finalizers.remove(EXECUTION_FINALIZER)
                 self.store.update(work)
@@ -98,18 +131,7 @@ class ExecutionController:
         ):
             work = self.store.update(work)
 
-        errors = []
-        for manifest in work.spec.workload_manifests:
-            try:
-                desired = Unstructured(dict(manifest))
-                observed = member.get(
-                    desired.api_version, desired.kind, desired.name, desired.namespace
-                )
-                if observed is not None:
-                    desired = self.interpreter.retain(desired, observed)
-                member.apply_manifest(desired.to_dict())
-            except Exception as e:  # noqa: BLE001 — reported on the Work
-                errors.append(f"{manifest.get('kind')}/{manifest.get('metadata', {}).get('name')}: {e}")
+        errors = apply_work_manifests(work, member, self.interpreter)
 
         changed = set_condition(
             work.status.conditions,

@@ -64,8 +64,11 @@ from .interpreter.customized import (
     WebhookInterpreterManager,
 )
 from .interpreter.interpreter import ResourceInterpreter
+from .agent import KarmadaAgent
+from .agent.agent import LeaseFailureDetector
 from .members.member import InMemoryMember, MemberConfig
 from .metricsadapter import MetricsAdapter
+from .proxy import ClusterProxy
 from .modeling import GradeHistogram, ModelBasedEstimator, default_resource_models
 from .runtime.controller import Clock, Runtime
 from .sched.scheduler import SchedulerDaemon
@@ -132,8 +135,20 @@ class ControlPlane:
             self.store, self.interpreter, self.runtime, gates=self.gates
         )
         self.namespace_controller = NamespaceSyncController(self.store, self.runtime)
+        self.agents: dict[str, KarmadaAgent] = {}
         self.execution_controller = ExecutionController(
-            self.store, self.members, self.interpreter, self.runtime
+            self.store,
+            self.members,
+            self.interpreter,
+            self.runtime,
+            pull_clusters=self.agents.keys(),  # live view: agents join later
+        )
+        self.lease_detector = LeaseFailureDetector(
+            self.store,
+            self.runtime,
+            on_not_ready=lambda name: self.set_member_ready(
+                name, False, reason="ClusterLeaseExpired"
+            ),
         )
         self.work_status_controller = WorkStatusController(
             self.store,
@@ -181,6 +196,9 @@ class ControlPlane:
             self.store, self.members, self.runtime
         )
         self.unified_auth_controller = UnifiedAuthController(self.store, self.runtime)
+        self.cluster_proxy = ClusterProxy(
+            self.store, self.members, unified_auth=self.unified_auth_controller
+        )
 
         # Networking family (N1/N2): MCS under its alpha gate
         # (features.go MultiClusterService α off), ServiceExport/Import always
@@ -261,6 +279,11 @@ class ControlPlane:
         )
         self.store.create(cluster)
         self.work_status_controller.watch_member(member)
+        if config.sync_mode == "Pull":
+            # the member runs its own agent (L7): execution + lease heartbeat
+            agent = KarmadaAgent(self.store, member, self.interpreter, self.runtime)
+            self.agents[config.name] = agent
+            agent.heartbeat()
         return member
 
     def set_member_ready(self, name: str, ready: bool, reason: str = "") -> None:
@@ -300,6 +323,9 @@ class ControlPlane:
         if self.mcs_controller is not None:
             self.mcs_controller.collect_once()
         self.service_export_controller.collect_once()
+        for agent in self.agents.values():
+            agent.heartbeat()
+        self.lease_detector.check()
         self.resource_cache.sweep()
         self.frq_status_controller.collect_once()
         return self.settle(max_steps)

@@ -1,0 +1,96 @@
+"""Aggregated-apiserver cluster proxy (U9, reference: pkg/aggregatedapiserver +
+pkg/registry/cluster — the `cluster/proxy` subresource: kubectl through the
+control plane into a member, with unified-auth impersonation).
+
+`ClusterProxy.request()` is the Connect handler: method + resource path routed
+to the member's API surface under an allowed subject.
+"""
+from __future__ import annotations
+
+from typing import Any, Optional
+
+from .api.unstructured import Unstructured
+
+
+class ProxyError(Exception):
+    pass
+
+
+class ForbiddenError(ProxyError):
+    pass
+
+
+class ClusterProxy:
+    def __init__(self, store, members: dict, unified_auth=None):
+        self.store = store
+        self.members = members
+        self.unified_auth = unified_auth
+
+    def _authorize(self, subject: Optional[dict]) -> None:
+        """With unified auth wired, only granted subjects may proxy
+        (unifiedauth Q3; no auth configured = open, like a kubeconfig admin)."""
+        if self.unified_auth is None or subject is None:
+            return
+        if subject not in self.unified_auth.subjects:
+            raise ForbiddenError(
+                f"subject {subject.get('kind')}/{subject.get('name')} is not "
+                "granted cluster proxy access"
+            )
+
+    def _member(self, cluster: str):
+        member = self.members.get(cluster)
+        if member is None:
+            raise ProxyError(f"cluster {cluster} not found")
+        cluster_obj = self.store.try_get("Cluster", cluster)
+        if cluster_obj is None:
+            raise ProxyError(f"cluster {cluster} not registered")
+        return member
+
+    def request(
+        self,
+        cluster: str,
+        method: str,
+        api_version: str,
+        kind: str,
+        name: str = "",
+        namespace: str = "",
+        body: Optional[dict] = None,
+        subject: Optional[dict] = None,
+    ) -> Any:
+        """The Connect handler (registry/cluster/storage/proxy.go):
+        GET/LIST/POST/PUT/DELETE against one member through the control plane."""
+        self._authorize(subject)
+        member = self._member(cluster)
+        method = method.upper()
+        if method == "GET":
+            if not name:
+                return member.store.list(f"{api_version}/{kind}", namespace)
+            obj = member.get(api_version, kind, name, namespace)
+            if obj is None:
+                raise ProxyError(f"{kind} {namespace}/{name} not found in {cluster}")
+            return obj
+        if method == "LIST":
+            return member.store.list(f"{api_version}/{kind}", namespace)
+        if method in ("POST", "PUT"):
+            if body is None:
+                raise ProxyError(f"{method} requires a body")
+            return member.apply_manifest(dict(body))
+        if method == "DELETE":
+            member.delete_manifest(api_version, kind, namespace, name)
+            return None
+        raise ProxyError(f"unsupported method {method}")
+
+    # kubectl-style conveniences used by karmadactl exec/logs/top
+    def logs(self, cluster: str, namespace: str, pod_or_workload: str,
+             subject: Optional[dict] = None) -> str:
+        self._authorize(subject)
+        member = self._member(cluster)
+        for gvk in ("apps/v1/Deployment", "apps/v1/StatefulSet", "batch/v1/Job"):
+            obj = member.store.try_get(gvk, pod_or_workload, namespace)
+            if obj is not None:
+                ready = obj.get("status", "readyReplicas", default=0)
+                return (
+                    f"[{cluster}/{namespace}/{pod_or_workload}] "
+                    f"ready={ready} generation={obj.metadata.generation}"
+                )
+        raise ProxyError(f"workload {namespace}/{pod_or_workload} not found in {cluster}")

@@ -1,0 +1,107 @@
+"""Pull-mode agent (L7) + cluster proxy (U9) + lease failure detection."""
+from __future__ import annotations
+
+import pytest
+
+from karmada_tpu.controlplane import ControlPlane
+from karmada_tpu.members.member import MemberConfig
+from karmada_tpu.proxy import ForbiddenError, ProxyError
+from karmada_tpu.runtime.controller import Clock
+from karmada_tpu.testing.fixtures import (
+    duplicated_placement,
+    new_deployment,
+    new_policy,
+    selector_for,
+)
+from karmada_tpu.api.cluster import cluster_ready
+
+
+@pytest.fixture
+def cp():
+    plane = ControlPlane(clock=Clock(fixed=1_700_000_000.0))
+    plane.join_member(MemberConfig(name="push-1", allocatable={"cpu": 100.0}))
+    plane.join_member(MemberConfig(name="pull-1", allocatable={"cpu": 100.0},
+                                   sync_mode="Pull"))
+    return plane
+
+
+def propagate(cp, name="web", replicas=2, clusters=None):
+    dep = new_deployment("default", name, replicas=replicas)
+    cp.store.create(dep)
+    cp.store.create(new_policy("default", f"pp-{name}", [selector_for(dep)],
+                               duplicated_placement(clusters or [])))
+    cp.settle()
+
+
+class TestPullAgent:
+    def test_agent_applies_works(self, cp):
+        propagate(cp)
+        # the pull member got the workload via ITS agent, not the push path
+        assert "pull-1" in cp.agents
+        obj = cp.members["pull-1"].get("apps/v1", "Deployment", "web", "default")
+        assert obj is not None
+        assert int(obj.get("status", "readyReplicas")) == 2
+
+    def test_agent_cleanup_on_delete(self, cp):
+        propagate(cp)
+        cp.store.delete("apps/v1/Deployment", "web", "default")
+        cp.settle()
+        assert cp.members["pull-1"].get("apps/v1", "Deployment", "web", "default") is None
+
+    def test_lease_renewed_while_healthy(self, cp):
+        lease_ns = "karmada-es-pull-1"
+        lease0 = cp.store.get("Lease", "pull-1", lease_ns)
+        cp.tick(seconds=100)
+        lease1 = cp.store.get("Lease", "pull-1", lease_ns)
+        assert lease1.renew_time > lease0.renew_time
+        assert cluster_ready(cp.store.get("Cluster", "pull-1"))
+
+    def test_lease_expiry_marks_not_ready(self, cp):
+        cp.members["pull-1"].healthy = False  # agent down: no renewals
+        cp.tick(seconds=100)  # > 40s lease duration
+        cluster = cp.store.get("Cluster", "pull-1")
+        assert not cluster_ready(cluster)
+        # recovery: agent back up → lease renews; ready flips back on probe
+        cp.members["pull-1"].healthy = True
+        cp.set_member_ready("pull-1", True)
+        cp.tick()
+        assert cluster_ready(cp.store.get("Cluster", "pull-1"))
+
+
+class TestClusterProxy:
+    def test_get_and_list(self, cp):
+        propagate(cp)
+        obj = cp.cluster_proxy.request("push-1", "GET", "apps/v1", "Deployment",
+                                       name="web", namespace="default")
+        assert obj.name == "web"
+        objs = cp.cluster_proxy.request("push-1", "LIST", "apps/v1", "Deployment",
+                                        namespace="default")
+        assert len(objs) == 1
+
+    def test_write_through_proxy(self, cp):
+        manifest = new_deployment("default", "direct", replicas=1).to_dict()
+        cp.cluster_proxy.request("push-1", "POST", "apps/v1", "Deployment", body=manifest)
+        assert cp.members["push-1"].get("apps/v1", "Deployment", "direct", "default") is not None
+        cp.cluster_proxy.request("push-1", "DELETE", "apps/v1", "Deployment",
+                                 name="direct", namespace="default")
+        assert cp.members["push-1"].get("apps/v1", "Deployment", "direct", "default") is None
+
+    def test_unknown_cluster(self, cp):
+        with pytest.raises(ProxyError, match="not found"):
+            cp.cluster_proxy.request("nope", "GET", "apps/v1", "Deployment", name="x")
+
+    def test_unified_auth_gate(self, cp):
+        propagate(cp)
+        subject = {"kind": "User", "name": "alice"}
+        with pytest.raises(ForbiddenError):
+            cp.cluster_proxy.request("push-1", "GET", "apps/v1", "Deployment",
+                                     name="web", namespace="default", subject=subject)
+        cp.unified_auth_controller.grant("User", "alice")
+        obj = cp.cluster_proxy.request("push-1", "GET", "apps/v1", "Deployment",
+                                       name="web", namespace="default", subject=subject)
+        assert obj.name == "web"
+
+    def test_logs(self, cp):
+        propagate(cp)
+        out = cp.cluster_proxy.logs("push-1", "default", "web")
+        assert "ready=2" in out
