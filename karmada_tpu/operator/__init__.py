@@ -1,0 +1,17 @@
+from .operator import (
+    KarmadaInstance,
+    KarmadaInstanceSpec,
+    KarmadaOperator,
+    Task,
+    Workflow,
+    WorkflowError,
+)
+
+__all__ = [
+    "KarmadaInstance",
+    "KarmadaInstanceSpec",
+    "KarmadaOperator",
+    "Task",
+    "Workflow",
+    "WorkflowError",
+]

@@ -1,0 +1,116 @@
+"""karmada-operator (U8): workflow engine + instance lifecycle."""
+from __future__ import annotations
+
+import pytest
+
+from karmada_tpu.api.meta import ObjectMeta, get_condition
+from karmada_tpu.members.member import MemberConfig
+from karmada_tpu.operator import (
+    KarmadaInstance,
+    KarmadaInstanceSpec,
+    KarmadaOperator,
+    Task,
+    Workflow,
+    WorkflowError,
+)
+from karmada_tpu.operator.operator import PHASE_FAILED, PHASE_RUNNING
+from karmada_tpu.runtime.controller import Runtime
+from karmada_tpu.store.store import Store
+from karmada_tpu.testing.fixtures import (
+    duplicated_placement,
+    new_deployment,
+    new_policy,
+    selector_for,
+)
+
+
+class TestWorkflowEngine:
+    def test_depth_first_order(self):
+        order = []
+        wf = Workflow([
+            Task(name="a", run=lambda ctx: order.append("a"), tasks=[
+                Task(name="a1", run=lambda ctx: order.append("a1")),
+                Task(name="a2", run=lambda ctx: order.append("a2")),
+            ]),
+            Task(name="b", run=lambda ctx: order.append("b")),
+        ])
+        wf.run({})
+        assert order == ["a", "a1", "a2", "b"]
+        assert wf.executed == ["a", "a/a1", "a/a2", "b"]
+
+    def test_failure_reports_task_path(self):
+        def boom(ctx):
+            raise ValueError("nope")
+
+        wf = Workflow([Task(name="outer", tasks=[Task(name="inner", run=boom)])])
+        with pytest.raises(WorkflowError, match="outer/inner"):
+            wf.run({})
+
+    def test_skip(self):
+        order = []
+        wf = Workflow([
+            Task(name="a", run=lambda ctx: order.append("a"), skip=lambda ctx: True),
+            Task(name="b", run=lambda ctx: order.append("b")),
+        ])
+        wf.run({})
+        assert order == ["b"]
+
+
+class TestOperator:
+    def setup_method(self):
+        self.store = Store()
+        self.runtime = Runtime()
+        self.operator = KarmadaOperator(self.store, self.runtime)
+
+    def test_install_and_use(self):
+        self.store.create(KarmadaInstance(metadata=ObjectMeta(name="prod")))
+        self.runtime.settle()
+        instance = self.store.get("KarmadaInstance", "prod")
+        assert instance.status.phase == PHASE_RUNNING
+        assert get_condition(instance.status.conditions, "Ready").status == "True"
+        assert "karmada-scheduler" in instance.status.installed_components
+
+        # the installed plane is a fully working control plane
+        plane = self.operator.plane("prod")
+        plane.join_member(MemberConfig(name="m1", allocatable={"cpu": 10.0}))
+        dep = new_deployment("default", "web", replicas=1)
+        plane.store.create(dep)
+        plane.store.create(new_policy("default", "pp", [selector_for(dep)],
+                                      duplicated_placement()))
+        plane.settle()
+        assert plane.members["m1"].get("apps/v1", "Deployment", "web", "default") is not None
+
+    def test_feature_gates_forwarded(self):
+        self.store.create(KarmadaInstance(
+            metadata=ObjectMeta(name="gated"),
+            spec=KarmadaInstanceSpec(feature_gates={"PriorityBasedScheduling": True}),
+        ))
+        self.runtime.settle()
+        plane = self.operator.plane("gated")
+        assert plane.gates.enabled("PriorityBasedScheduling")
+
+    def test_invalid_spec_fails_workflow(self):
+        self.store.create(KarmadaInstance(
+            metadata=ObjectMeta(name="bad"),
+            spec=KarmadaInstanceSpec(components=["no-such-component"]),
+        ))
+        self.runtime.settle()
+        instance = self.store.get("KarmadaInstance", "bad")
+        assert instance.status.phase == PHASE_FAILED
+        assert "validate" in get_condition(instance.status.conditions, "Ready").message
+
+    def test_unknown_gate_fails(self):
+        self.store.create(KarmadaInstance(
+            metadata=ObjectMeta(name="badgate"),
+            spec=KarmadaInstanceSpec(feature_gates={"NotAGate": True}),
+        ))
+        self.runtime.settle()
+        assert self.store.get("KarmadaInstance", "badgate").status.phase == PHASE_FAILED
+
+    def test_deinit_on_delete(self):
+        self.store.create(KarmadaInstance(metadata=ObjectMeta(name="tmp")))
+        self.runtime.settle()
+        assert self.operator.plane("tmp") is not None
+        self.store.delete("KarmadaInstance", "tmp")
+        self.runtime.settle()
+        assert self.operator.plane("tmp") is None
