@@ -373,6 +373,45 @@ def cmd_apply(cp: ControlPlane, manifest: dict, all_clusters: bool = False) -> s
 # -- rescheduling ----------------------------------------------------------
 
 
+def cmd_logs(cp: ControlPlane, cluster: str, workload: str, namespace: str = "default") -> str:
+    """`karmadactl logs` — member workload logs through the cluster proxy (U9)."""
+    from ..proxy import ProxyError
+
+    try:
+        return cp.cluster_proxy.logs(cluster, namespace, workload)
+    except ProxyError as e:
+        raise CLIError(str(e)) from e
+
+
+def cmd_exec(cp: ControlPlane, cluster: str, workload: str, command: list[str],
+             namespace: str = "default") -> str:
+    """`karmadactl exec` — the proxy Connect path; in the in-memory fleet the
+    'exec' resolves the target and reports where it would run."""
+    from ..proxy import ProxyError
+
+    try:
+        obj = cp.cluster_proxy.request(
+            cluster, "GET", "apps/v1", "Deployment", name=workload, namespace=namespace
+        )
+    except ProxyError as e:
+        raise CLIError(str(e)) from e
+    return (
+        f"exec {' '.join(command)} -> {cluster}/{namespace}/{obj.name} "
+        f"(ready={obj.get('status', 'readyReplicas', default=0)})"
+    )
+
+
+def cmd_addons(cp: ControlPlane) -> str:
+    """`karmadactl addons list` — which optional components are running."""
+    rows = [
+        ["karmada-descheduler", "enabled"],
+        ["karmada-search", "enabled"],
+        ["karmada-metrics-adapter", "enabled"],
+        ["karmada-scheduler-estimator", "enabled" if cp.estimator_registry.replica_estimators else "disabled"],
+    ]
+    return _fmt_table(rows, ["ADDON", "STATUS"])
+
+
 def cmd_deschedule(cp: ControlPlane) -> str:
     n = cp.run_descheduler()
     return f"descheduled {n} binding(s)"
@@ -446,6 +485,17 @@ def run(cp: ControlPlane, argv: list[str]) -> str:
     sub.add_parser("deschedule")
     p = sub.add_parser("rebalance")
     p.add_argument("workloads", nargs="+", help="apiVersion:Kind:namespace:name")
+    p = sub.add_parser("logs")
+    p.add_argument("workload")
+    p.add_argument("-C", "--cluster", required=True)
+    p.add_argument("-n", "--namespace", default="default")
+    p = sub.add_parser("exec")
+    p.add_argument("workload")
+    p.add_argument("-C", "--cluster", required=True)
+    p.add_argument("-n", "--namespace", default="default")
+    p.add_argument("cmd", nargs="*", default=["sh"])
+    p = sub.add_parser("addons")
+    p.add_argument("action", nargs="?", default="list")
 
     args = parser.parse_args(argv)
 
@@ -482,6 +532,12 @@ def run(cp: ControlPlane, argv: list[str]) -> str:
         return cmd_apply(cp, manifest, all_clusters=args.all_clusters)
     if args.command == "promote":
         return cmd_promote(cp, args.cluster, args.kind, args.name, args.namespace)
+    if args.command == "logs":
+        return cmd_logs(cp, args.cluster, args.workload, args.namespace)
+    if args.command == "exec":
+        return cmd_exec(cp, args.cluster, args.workload, args.cmd, args.namespace)
+    if args.command == "addons":
+        return cmd_addons(cp)
     if args.command == "deschedule":
         return cmd_deschedule(cp)
     if args.command == "rebalance":

@@ -25,6 +25,7 @@ from ..models.nodes import (
     node_claim_matches,
     tolerations_cover_node_taints,
 )
+from ..native import first_fit_place
 from ..ops.estimate import cluster_estimate
 
 
@@ -123,28 +124,19 @@ class AccurateEstimator:
         prev_pending = self._pending.get(workload_key)
         self.unplace(workload_key)
         req = self.encoder.request_vector(request)
-        tolerations = claim.tolerations if claim else []
-        placed: list[tuple[int, int, np.ndarray]] = []
-        remaining = replicas
         a = self.arrays
-        for i in range(a.n_nodes):
-            if remaining <= 0:
-                break
-            spec = self.specs[i]
-            if not node_claim_matches(claim, spec.labels):
-                continue
-            if not tolerations_cover_node_taints(tolerations, spec.taints):
-                continue
-            rest = a.alloc[i] - a.requested[i]
-            with np.errstate(divide="ignore"):
-                fits = np.where(req > 0, rest // np.maximum(req, 1), np.iinfo(np.int64).max)
-            fit = int(min(fits.min(), a.allowed_pods[i] - a.pod_count[i]))
-            fit = max(min(fit, remaining), 0)
-            if fit > 0:
-                a.requested[i] += req * fit
-                a.pod_count[i] += fit
-                placed.append((i, fit, req))
-                remaining -= fit
+        # claim feasibility reuses the deduped node_ok cache; the greedy scan
+        # itself runs in the native kernel (numpy fallback inside)
+        fake_req = ReplicaRequirements(node_claim=claim) if claim else None
+        node_ok = self._node_ok(fake_req)
+        n_placed, fits = first_fit_place(
+            a.alloc, a.requested, a.pod_count, a.allowed_pods,
+            node_ok, req.astype(np.int64), replicas,
+        )
+        placed = [
+            (i, int(fits[i]), req) for i in np.nonzero(fits)[0]
+        ]
+        remaining = replicas - n_placed
         self._pods[workload_key] = placed
         if remaining > 0:
             if now is None:

@@ -37,7 +37,7 @@ class NodeArrays:
     names: list[str]
     alloc: np.ndarray  # i64[N,R]
     requested: np.ndarray  # i64[N,R] (mutable: pod placement updates it)
-    pod_count: np.ndarray  # i32[N]
+    pod_count: np.ndarray  # i64[N]
     allowed_pods: np.ndarray  # i64[N]
     taint_key: np.ndarray  # i32[N,T]
     taint_value: np.ndarray
@@ -77,7 +77,7 @@ class NodeEncoder:
             names=[n.name for n in nodes],
             alloc=alloc,
             requested=np.zeros((N, R), np.int64),
-            pod_count=np.zeros(N, np.int32),
+            pod_count=np.zeros(N, np.int64),
             allowed_pods=allowed,
             taint_key=taint_key,
             taint_value=taint_value,

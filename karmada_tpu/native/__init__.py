@@ -1,0 +1,146 @@
+"""Native (C++) runtime kernels with lazy compilation and Python fallback.
+
+The TPU compute path is JAX/XLA (ops/); these are the *host-side* data-plane
+kernels — the greedy pod-placement loop and the node-level estimate sweep that
+run per member cluster (the reference's estimator server hot loops,
+estimate.go:88-112). Compiled once per environment with g++ into a cached
+shared library; every entry point has a numpy fallback so the framework works
+without a toolchain.
+"""
+from __future__ import annotations
+
+import ctypes
+import hashlib
+import os
+import subprocess
+import tempfile
+import threading
+from typing import Optional
+
+import numpy as np
+
+_SRC = os.path.join(os.path.dirname(__file__), "placement.cpp")
+_lock = threading.Lock()
+_lib: Optional[ctypes.CDLL] = None
+_tried = False
+
+
+def _build() -> Optional[ctypes.CDLL]:
+    with open(_SRC, "rb") as f:
+        digest = hashlib.sha256(f.read()).hexdigest()[:16]
+    cache_dir = os.path.join(tempfile.gettempdir(), "karmada_tpu_native")
+    os.makedirs(cache_dir, exist_ok=True)
+    so_path = os.path.join(cache_dir, f"placement-{digest}.so")
+    if not os.path.exists(so_path):
+        tmp = so_path + f".tmp{os.getpid()}"
+        cmd = ["g++", "-O2", "-shared", "-fPIC", "-o", tmp, _SRC]
+        try:
+            subprocess.run(cmd, check=True, capture_output=True, timeout=120)
+        except (subprocess.SubprocessError, OSError, FileNotFoundError):
+            return None
+        os.replace(tmp, so_path)
+    try:
+        lib = ctypes.CDLL(so_path)
+    except OSError:
+        return None
+    LL = ctypes.c_longlong
+    LLP = ctypes.POINTER(LL)
+    U8P = ctypes.POINTER(ctypes.c_ubyte)
+    lib.first_fit_place.restype = LL
+    lib.first_fit_place.argtypes = [LLP, LLP, LLP, LLP, U8P, LLP, LLP, LL, LL, LL]
+    lib.max_available_replicas.restype = None
+    lib.max_available_replicas.argtypes = [LLP, LLP, LLP, LLP, U8P, LLP, LLP, LL, LL, LL]
+    return lib
+
+
+def get_lib() -> Optional[ctypes.CDLL]:
+    global _lib, _tried
+    if _lib is None and not _tried:
+        with _lock:
+            if _lib is None and not _tried:
+                _lib = _build()
+                _tried = True
+    return _lib
+
+
+def native_available() -> bool:
+    return get_lib() is not None
+
+
+def _ll(a: np.ndarray):
+    return a.ctypes.data_as(ctypes.POINTER(ctypes.c_longlong))
+
+
+def _u8(a: np.ndarray):
+    return a.ctypes.data_as(ctypes.POINTER(ctypes.c_ubyte))
+
+
+def first_fit_place(
+    alloc: np.ndarray,      # i64[N,R]
+    requested: np.ndarray,  # i64[N,R] — mutated
+    pod_count: np.ndarray,  # i64[N]  — mutated
+    allowed: np.ndarray,    # i64[N]
+    node_ok: np.ndarray,    # bool[N]
+    req: np.ndarray,        # i64[R]
+    replicas: int,
+) -> tuple[int, np.ndarray]:
+    """Greedy first-fit; returns (placed, fits[N]). Mutates requested/pod_count."""
+    N, R = alloc.shape
+    fits = np.zeros(N, dtype=np.int64)
+    lib = get_lib()
+    if lib is not None:
+        alloc = np.ascontiguousarray(alloc, dtype=np.int64)
+        req64 = np.ascontiguousarray(req, dtype=np.int64)
+        ok = np.ascontiguousarray(node_ok, dtype=np.uint8)
+        placed = int(
+            lib.first_fit_place(
+                _ll(alloc), _ll(requested), _ll(pod_count), _ll(allowed),
+                _u8(ok), _ll(req64), _ll(fits), N, R, int(replicas),
+            )
+        )
+        return placed, fits
+    # -- fallback: vectorized numpy scan ---------------------------------
+    remaining = int(replicas)
+    for i in range(N):
+        if remaining <= 0 or not node_ok[i]:
+            continue
+        fit = int(allowed[i] - pod_count[i])
+        if fit <= 0:
+            continue
+        rest = alloc[i] - requested[i]
+        with np.errstate(divide="ignore"):
+            by_res = np.where(req > 0, rest // np.maximum(req, 1), np.iinfo(np.int64).max)
+        fit = max(0, min(fit, int(by_res.min()), remaining))
+        if fit > 0:
+            requested[i] += req * fit
+            pod_count[i] += fit
+            fits[i] = fit
+            remaining -= fit
+    return replicas - remaining, fits
+
+
+def max_available_replicas_native(
+    alloc: np.ndarray,      # i64[N,R]
+    requested: np.ndarray,  # i64[N,R]
+    pod_count: np.ndarray,  # i64[N]
+    allowed: np.ndarray,    # i64[N]
+    node_ok: np.ndarray,    # bool[B,N]
+    req: np.ndarray,        # i64[B,R]
+) -> Optional[np.ndarray]:
+    """Batched estimate via the native kernel; None when unavailable (caller
+    uses the jitted XLA kernel instead)."""
+    lib = get_lib()
+    if lib is None:
+        return None
+    B, N = node_ok.shape
+    R = alloc.shape[1]
+    answers = np.zeros(B, dtype=np.int64)
+    alloc = np.ascontiguousarray(alloc, dtype=np.int64)
+    requested = np.ascontiguousarray(requested, dtype=np.int64)
+    ok = np.ascontiguousarray(node_ok, dtype=np.uint8)
+    req = np.ascontiguousarray(req, dtype=np.int64)
+    lib.max_available_replicas(
+        _ll(alloc), _ll(requested), _ll(pod_count), _ll(allowed),
+        _u8(ok), _ll(req), _ll(answers), N, R, B,
+    )
+    return answers

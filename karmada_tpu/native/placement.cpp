@@ -1,0 +1,85 @@
+// Native first-fit pod placement kernel (the member-side "kube-scheduler"
+// loop of the estimator plane — reference behavior: estimate.go's per-node
+// math applied greedily; this is the host-side hot loop when simulating
+// 5k-node members, kept native per SURVEY §2's data-plane note).
+//
+// Contract (all arrays int64, row-major):
+//   alloc     [N*R]  node allocatable per resource
+//   requested [N*R]  already-requested per resource (MUTATED)
+//   pod_count [N]    pods on node (MUTATED)
+//   allowed   [N]    max pods per node
+//   node_ok   [N]    1 = claim-feasible node
+//   req       [R]    per-pod request
+//   fits      [N]    OUT: pods placed on each node this call
+// returns: number of pods placed (<= replicas)
+extern "C" long long first_fit_place(
+    long long* alloc,
+    long long* requested,
+    long long* pod_count,
+    const long long* allowed,
+    const unsigned char* node_ok,
+    const long long* req,
+    long long* fits,
+    long long n_nodes,
+    long long n_resources,
+    long long replicas) {
+  long long remaining = replicas;
+  for (long long i = 0; i < n_nodes; ++i) {
+    fits[i] = 0;
+    if (remaining <= 0 || !node_ok[i]) continue;
+    long long fit = allowed[i] - pod_count[i];
+    if (fit <= 0) continue;
+    const long long* arow = alloc + i * n_resources;
+    long long* rrow = requested + i * n_resources;
+    for (long long r = 0; r < n_resources && fit > 0; ++r) {
+      if (req[r] <= 0) continue;
+      long long rest = arow[r] - rrow[r];
+      long long by_res = rest > 0 ? rest / req[r] : 0;
+      if (by_res < fit) fit = by_res;
+    }
+    if (fit <= 0) continue;
+    if (fit > remaining) fit = remaining;
+    for (long long r = 0; r < n_resources; ++r) rrow[r] += req[r] * fit;
+    pod_count[i] += fit;
+    fits[i] = fit;
+    remaining -= fit;
+  }
+  return replicas - remaining;
+}
+
+// Batched node-level MaxAvailableReplicas (estimate.go:88-112 hot loop 3):
+// for B requests x N nodes, sum over feasible nodes of
+// min(free_pod_slots, min_r floor((alloc-requested)/req)).
+//   answers [B] OUT
+extern "C" void max_available_replicas(
+    const long long* alloc,
+    const long long* requested,
+    const long long* pod_count,
+    const long long* allowed,
+    const unsigned char* node_ok,  // [B*N]
+    const long long* req,          // [B*R]
+    long long* answers,            // [B]
+    long long n_nodes,
+    long long n_resources,
+    long long n_requests) {
+  for (long long b = 0; b < n_requests; ++b) {
+    const long long* breq = req + b * n_resources;
+    const unsigned char* bok = node_ok + b * n_nodes;
+    long long total = 0;
+    for (long long i = 0; i < n_nodes; ++i) {
+      if (!bok[i]) continue;
+      long long fit = allowed[i] - pod_count[i];
+      if (fit <= 0) continue;
+      const long long* arow = alloc + i * n_resources;
+      const long long* rrow = requested + i * n_resources;
+      for (long long r = 0; r < n_resources && fit > 0; ++r) {
+        if (breq[r] <= 0) continue;
+        long long rest = arow[r] - rrow[r];
+        long long by_res = rest > 0 ? rest / breq[r] : 0;
+        if (by_res < fit) fit = by_res;
+      }
+      if (fit > 0) total += fit;
+    }
+    answers[b] = total;
+  }
+}

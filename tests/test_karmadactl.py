@@ -148,3 +148,22 @@ class TestReschedulingCommands:
         assert "WorkloadRebalancer" in out
         rebalancers = cp.store.list("WorkloadRebalancer")
         assert rebalancers and rebalancers[0].status.observed_workloads
+
+
+class TestProxyCommands:
+    def test_logs_and_exec(self, cp):
+        run(cp, ["join", "m1"])
+        propagate_web(cp)
+        out = run(cp, ["logs", "web", "-C", "m1"])
+        assert "ready=2" in out
+        out = run(cp, ["exec", "web", "-C", "m1", "ls"])
+        assert "m1/default/web" in out
+
+    def test_logs_missing_workload(self, cp):
+        run(cp, ["join", "m1"])
+        with pytest.raises(CLIError):
+            run(cp, ["logs", "nope", "-C", "m1"])
+
+    def test_addons(self, cp):
+        out = run(cp, ["addons"])
+        assert "karmada-search" in out and "enabled" in out

@@ -1,0 +1,136 @@
+"""Native C++ kernels: first-fit placement + batched estimate, vs numpy/XLA."""
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from karmada_tpu.api.work import ReplicaRequirements
+from karmada_tpu.estimator.accurate import AccurateEstimator
+from karmada_tpu.models.nodes import NodeSpec
+from karmada_tpu.native import (
+    first_fit_place,
+    get_lib,
+    max_available_replicas_native,
+    native_available,
+)
+
+
+def make_arrays(n_nodes=4, cpu=4000, mem=8_000_000_000, pods=10):
+    alloc = np.zeros((n_nodes, 4), np.int64)
+    alloc[:, 0] = cpu   # milli-cpu
+    alloc[:, 1] = mem
+    requested = np.zeros_like(alloc)
+    pod_count = np.zeros(n_nodes, np.int64)
+    allowed = np.full(n_nodes, pods, np.int64)
+    return alloc, requested, pod_count, allowed
+
+
+class TestNativeBuild:
+    def test_compiles(self):
+        # g++ is part of the baked toolchain; the kernel must build here
+        assert native_available(), "native kernel failed to build with g++"
+
+
+class TestFirstFit:
+    def test_places_across_nodes(self):
+        alloc, requested, pod_count, allowed = make_arrays(n_nodes=3, cpu=2000)
+        req = np.array([1000, 0, 0, 0], np.int64)  # 1 cpu per pod, 2 fit/node
+        ok = np.ones(3, bool)
+        placed, fits = first_fit_place(alloc, requested, pod_count, allowed, ok, req, 5)
+        assert placed == 5
+        assert fits.tolist() == [2, 2, 1]
+        assert pod_count.tolist() == [2, 2, 1]
+        assert requested[0, 0] == 2000
+
+    def test_respects_node_ok_and_pod_slots(self):
+        alloc, requested, pod_count, allowed = make_arrays(n_nodes=3, cpu=100000, pods=1)
+        req = np.array([1000, 0, 0, 0], np.int64)
+        ok = np.array([False, True, True])
+        placed, fits = first_fit_place(alloc, requested, pod_count, allowed, ok, req, 5)
+        assert placed == 2  # one pod slot on each of the two feasible nodes
+        assert fits.tolist() == [0, 1, 1]
+
+    def test_matches_python_fallback(self):
+        rng = np.random.default_rng(42)
+        for _ in range(10):
+            N = int(rng.integers(1, 30))
+            alloc = rng.integers(0, 8000, size=(N, 4)).astype(np.int64)
+            requested = rng.integers(0, 2000, size=(N, 4)).astype(np.int64)
+            pod_count = rng.integers(0, 5, size=N).astype(np.int64)
+            allowed = rng.integers(0, 12, size=N).astype(np.int64)
+            ok = rng.random(N) > 0.3
+            req = rng.integers(0, 1500, size=4).astype(np.int64)
+            replicas = int(rng.integers(1, 40))
+
+            lib = get_lib()
+            r1, p1, f1 = requested.copy(), pod_count.copy(), None
+            placed_native, fits_native = first_fit_place(
+                alloc, r1, p1, allowed, ok, req, replicas
+            )
+            # force the python fallback by monkeypatching get_lib? simpler:
+            # re-run the same semantics in pure python here
+            r2, p2 = requested.copy(), pod_count.copy()
+            remaining = replicas
+            fits_py = np.zeros(N, np.int64)
+            for i in range(N):
+                if remaining <= 0 or not ok[i]:
+                    continue
+                fit = int(allowed[i] - p2[i])
+                if fit <= 0:
+                    continue
+                rest = alloc[i] - r2[i]
+                with np.errstate(divide="ignore"):
+                    by = np.where(req > 0, rest // np.maximum(req, 1), np.iinfo(np.int64).max)
+                by = np.where((req > 0) & (rest <= 0), 0, by)
+                fit = max(0, min(fit, int(by.min()), remaining))
+                if fit > 0:
+                    r2[i] += req * fit
+                    p2[i] += fit
+                    fits_py[i] = fit
+                    remaining -= fit
+            assert fits_native.tolist() == fits_py.tolist()
+            assert placed_native == replicas - remaining
+            assert np.array_equal(r1, r2) and np.array_equal(p1, p2)
+
+
+class TestNativeEstimate:
+    def test_matches_xla_kernel(self):
+        nodes = [
+            NodeSpec(name=f"n{i}", allocatable={"cpu": 4.0, "memory": 16.0})
+            for i in range(8)
+        ]
+        est = AccurateEstimator(nodes)
+        reqs = [
+            ReplicaRequirements(resource_request={"cpu": 1.0}),
+            ReplicaRequirements(resource_request={"cpu": 0.5, "memory": 2.0}),
+            None,
+        ]
+        xla = est.max_available_replicas_batch(reqs)
+        request = np.stack([est.encoder.request_vector(r.resource_request if r else {}) for r in reqs])
+        node_ok = np.stack([est._node_ok(r) for r in reqs])
+        native = max_available_replicas_native(
+            est.arrays.alloc, est.arrays.requested, est.arrays.pod_count,
+            est.arrays.allowed_pods, node_ok, request,
+        )
+        assert native is not None
+        assert native.tolist() == xla
+
+
+class TestEstimatorWithNativePlacement:
+    def test_place_and_unplace_roundtrip(self):
+        nodes = [NodeSpec(name=f"n{i}", allocatable={"cpu": 2.0}, allowed_pods=5)
+                 for i in range(3)]
+        est = AccurateEstimator(nodes)
+        placed = est.place("Deployment/default/web", 4, {"cpu": 1.0})
+        assert placed == 4
+        assert est.arrays.pod_count.sum() == 4
+        est.unplace("Deployment/default/web")
+        assert est.arrays.pod_count.sum() == 0
+        assert est.arrays.requested.sum() == 0
+
+    def test_pending_tracking_survives(self):
+        nodes = [NodeSpec(name="n0", allocatable={"cpu": 1.0}, allowed_pods=10)]
+        est = AccurateEstimator(nodes)
+        placed = est.place("Deployment/default/web", 3, {"cpu": 1.0}, now=100.0)
+        assert placed == 1
+        assert est.get_unschedulable_replicas("Deployment/default/web", 60, now=200.0) == 2
