@@ -81,6 +81,16 @@ def tie_matrix(uids: Sequence[str], n_clusters: int) -> np.ndarray:
 
 @dataclass
 class BindingBatch:
+    """Transfer-compact batch: the [B,C] tensors the solve needs are stored
+    factored — policy-level tables + per-binding indices + sparse previous/
+    eviction entries + a tie seed — and reconstructed ON DEVICE
+    (sched.core._schedule_kernel decompression). Host→device traffic per round
+    is O(B·K + P·C) instead of O(B·C); at 10k×5k that is ~3 MB instead of
+    ~1.3 GB, which is what makes the tunnel-attached TPU viable.
+
+    Dense views (`affinity_ok`, `static_weight`, ...) are materialized lazily
+    for the mesh path and tests."""
+
     keys: list[str]  # namespace/name per row
     uids: list[str]
     # core tensors
@@ -97,17 +107,58 @@ class BindingBatch:
     tol_value: np.ndarray
     tol_effect: np.ndarray
     tol_op: np.ndarray
-    # host-evaluated masks / weights
-    affinity_ok: np.ndarray  # bool[B,C]
-    eviction_ok: np.ndarray  # bool[B,C]
-    static_weight: np.ndarray  # i64[B,C]
-    prev_member: np.ndarray  # bool[B,C]
-    prev_replicas: np.ndarray  # i32[B,C]
-    tie: np.ndarray  # i32[B,C]
+    # factored policy tables (deduped across the batch)
+    aff_masks: np.ndarray  # bool[P,C] unique affinity masks
+    aff_idx: np.ndarray  # i32[B] row → mask row
+    weight_tables: np.ndarray  # i64[W,C] unique static-weight tables (row 0 = zeros)
+    weight_idx: np.ndarray  # i32[B]
+    # sparse previous-placement / eviction entries; column index C = padding
+    prev_idx: np.ndarray  # i32[B,Kp]
+    prev_rep: np.ndarray  # i32[B,Kp]
+    evict_idx: np.ndarray  # i32[B,Ke]
+    # tie-break randomness: per-binding seed, expanded on device
+    seeds: np.ndarray  # u64[B]
+    n_clusters: int = 0
 
     @property
     def size(self) -> int:
         return len(self.keys)
+
+    # -- dense views (mesh path, oracle parity tests) ---------------------
+
+    @property
+    def affinity_ok(self) -> np.ndarray:  # bool[B,C]
+        return self.aff_masks[self.aff_idx]
+
+    @property
+    def static_weight(self) -> np.ndarray:  # i64[B,C]
+        return self.weight_tables[self.weight_idx]
+
+    @property
+    def prev_member(self) -> np.ndarray:  # bool[B,C]
+        out = np.zeros((len(self.replicas), self.n_clusters), bool)
+        rows, cols = np.nonzero(self.prev_idx < self.n_clusters)
+        out[rows, self.prev_idx[rows, cols]] = True
+        return out
+
+    @property
+    def prev_replicas(self) -> np.ndarray:  # i32[B,C]
+        out = np.zeros((len(self.replicas), self.n_clusters), np.int32)
+        rows, cols = np.nonzero(self.prev_idx < self.n_clusters)
+        out[rows, self.prev_idx[rows, cols]] = self.prev_rep[rows, cols]
+        return out
+
+    @property
+    def eviction_ok(self) -> np.ndarray:  # bool[B,C]
+        out = np.ones((len(self.replicas), self.n_clusters), bool)
+        rows, cols = np.nonzero(self.evict_idx < self.n_clusters)
+        out[rows, self.evict_idx[rows, cols]] = False
+        return out
+
+    @property
+    def tie(self) -> np.ndarray:  # i32[B,C]
+        idx = np.arange(1, self.n_clusters + 1, dtype=np.uint64)[None, :]
+        return (_mix64(self.seeds[:, None] ^ idx) >> np.uint64(33)).astype(np.int32)
 
 
 class BatchEncoder:
@@ -190,11 +241,19 @@ class BatchEncoder:
         tol_value = np.zeros((B, K), np.int32)
         tol_effect = np.zeros((B, K), np.int32)
         tol_op = np.zeros((B, K), np.int32)
-        affinity_ok = np.ones((B, C), bool)
-        eviction_ok = np.ones((B, C), bool)
-        static_weight = np.zeros((B, C), np.int64)
-        prev_member = np.zeros((B, C), bool)
-        prev_replicas = np.zeros((B, C), np.int32)
+
+        # factored tables: dedup masks/weights per policy signature (few
+        # distinct policies, many bindings); indices per row
+        aff_rows: list[np.ndarray] = []
+        aff_by_id: dict[int, int] = {}  # id(mask buffer) → table row
+        aff_idx = np.zeros(B, np.int32)
+        weight_rows: list[np.ndarray] = [np.zeros(C, np.int64)]  # row 0 = zeros
+        weight_by_id: dict[int, int] = {}
+        weight_idx = np.zeros(B, np.int32)
+
+        prev_lists: list[list[tuple[int, int]]] = []
+        evict_lists: list[list[int]] = []
+        seeds = np.zeros(B, np.uint64)
 
         for b, rb in enumerate(bindings):
             keys.append(rb.metadata.key())
@@ -204,6 +263,7 @@ class BatchEncoder:
             gvk[b] = self.encoder.gvk_id(spec.resource.api_version, spec.resource.kind)
             strategy[b] = strategy_code(spec.placement, spec.replicas)
             fresh[b] = _reschedule_required(spec, rb.status)
+            seeds[b] = uid_seed(uids[-1])
             if spec.replica_requirements is not None:
                 known = set(self.encoder.resources)
                 for rname, val in spec.replica_requirements.resource_request.items():
@@ -222,18 +282,56 @@ class BatchEncoder:
                 tol_op[b, k] = TOL_OP_EXISTS if tol.operator == "Exists" else TOL_OP_EQUAL
 
             term = -1 if term_indices is None else term_indices[b]
-            affinity_ok[b] = self.affinity_cache.mask(self.active_affinity(rb, term))
-            static_weight[b] = self._static_weights(placement)
+            mask = self.affinity_cache.mask(self.active_affinity(rb, term))
+            row = aff_by_id.get(id(mask))
+            if row is None:
+                row = len(aff_rows)
+                aff_rows.append(mask)
+                aff_by_id[id(mask)] = row
+            aff_idx[b] = row
 
-            for tc in spec.clusters:
-                i = self._cluster_index.get(tc.name)
-                if i is not None:
-                    prev_member[b, i] = True
-                    prev_replicas[b, i] = tc.replicas
-            for task in spec.graceful_eviction_tasks:
-                i = self._cluster_index.get(task.from_cluster)
-                if i is not None:
-                    eviction_ok[b, i] = False
+            w = self._static_weights(placement)
+            if w.any():
+                wrow = weight_by_id.get(id(w))
+                if wrow is None:
+                    wrow = len(weight_rows)
+                    weight_rows.append(w)
+                    weight_by_id[id(w)] = wrow
+                weight_idx[b] = wrow
+
+            prev_lists.append(
+                [
+                    (i, tc.replicas)
+                    for tc in spec.clusters
+                    if (i := self._cluster_index.get(tc.name)) is not None
+                ]
+            )
+            evict_lists.append(
+                [
+                    i
+                    for task in spec.graceful_eviction_tasks
+                    if (i := self._cluster_index.get(task.from_cluster)) is not None
+                ]
+            )
+
+        # sparse axes bucketed to powers of two (jit cache bound)
+        def bucket(n: int, lo: int = 2) -> int:
+            k = lo
+            while k < n:
+                k *= 2
+            return k
+
+        Kp = bucket(max((len(p) for p in prev_lists), default=0))
+        Ke = bucket(max((len(e) for e in evict_lists), default=0), lo=1)
+        prev_idx = np.full((B, Kp), C, np.int32)  # C = drop sentinel
+        prev_rep = np.zeros((B, Kp), np.int32)
+        evict_idx = np.full((B, Ke), C, np.int32)
+        for b in range(B):
+            for k, (i, rep) in enumerate(prev_lists[b]):
+                prev_idx[b, k] = i
+                prev_rep[b, k] = rep
+            for k, i in enumerate(evict_lists[b]):
+                evict_idx[b, k] = i
 
         return BindingBatch(
             keys=keys,
@@ -248,12 +346,15 @@ class BatchEncoder:
             tol_value=tol_value,
             tol_effect=tol_effect,
             tol_op=tol_op,
-            affinity_ok=affinity_ok,
-            eviction_ok=eviction_ok,
-            static_weight=static_weight,
-            prev_member=prev_member,
-            prev_replicas=prev_replicas,
-            tie=tie_matrix(uids, C),
+            aff_masks=np.stack(aff_rows) if aff_rows else np.ones((1, C), bool),
+            aff_idx=aff_idx,
+            weight_tables=np.stack(weight_rows),
+            weight_idx=weight_idx,
+            prev_idx=prev_idx,
+            prev_rep=prev_rep,
+            evict_idx=evict_idx,
+            seeds=seeds,
+            n_clusters=C,
         )
 
 

@@ -35,6 +35,11 @@ from ..models.fleet import FleetArrays, FleetEncoder
 from ..ops import assign as assign_ops
 from ..ops import filters as filter_ops
 
+# compact-output width: covers every row whose target count is <= this
+# (divided rows are bounded by spec.replicas; wider duplicated rows fetch
+# their dense result row as a fallback)
+TOPK_TARGETS = 128
+
 
 @dataclass
 class ScheduleDecision:
@@ -49,8 +54,7 @@ class ScheduleDecision:
         return not self.error
 
 
-@partial(jax.jit, static_argnames=())
-def _schedule_kernel(
+def _schedule_body(
     # fleet
     alive,
     capacity,
@@ -59,7 +63,7 @@ def _schedule_kernel(
     taint_value,
     taint_effect,
     api_ok,
-    # batch
+    # batch (dense)
     replicas,
     request,
     unknown_request,
@@ -120,9 +124,90 @@ def _schedule_kernel(
     return feasible, score, result, unschedulable, dyn.available_sum, avail
 
 
+@partial(jax.jit, static_argnames=())
+def _schedule_kernel(
+    alive, capacity, has_summary, taint_key, taint_value, taint_effect, api_ok,
+    replicas, request, unknown_request, gvk, strategy, fresh,
+    tol_key, tol_value, tol_effect, tol_op,
+    affinity_ok, eviction_ok, static_weight, prev_member, prev_replicas, tie,
+    extra_avail,
+):
+    """Dense-input variant (mesh path / graft entry)."""
+    return _schedule_body(
+        alive, capacity, has_summary, taint_key, taint_value, taint_effect, api_ok,
+        replicas, request, unknown_request, gvk, strategy, fresh,
+        tol_key, tol_value, tol_effect, tol_op,
+        affinity_ok, eviction_ok, static_weight, prev_member, prev_replicas, tie,
+        extra_avail,
+    )
+
+
+def _device_tie(seeds, n_clusters):
+    """splitmix64 tie-break expanded on device — bit-identical to
+    models.batch.tie_matrix (the deterministic stand-in for the reference's
+    crypto-rand tie-break, binding.go:74-79)."""
+    idx = jnp.arange(1, n_clusters + 1, dtype=jnp.uint64)[None, :]
+    x = seeds[:, None] ^ idx
+    x = (x ^ (x >> jnp.uint64(30))) * jnp.uint64(0xBF58476D1CE4E5B9)
+    x = (x ^ (x >> jnp.uint64(27))) * jnp.uint64(0x94D049BB133111EB)
+    x = x ^ (x >> jnp.uint64(31))
+    return (x >> jnp.uint64(33)).astype(jnp.int32)
+
+
+@partial(jax.jit, static_argnames=())
+def _schedule_kernel_compact(
+    # fleet (device-resident)
+    alive, capacity, has_summary, taint_key, taint_value, taint_effect, api_ok,
+    # batch core
+    replicas, request, unknown_request, gvk, strategy, fresh,
+    tol_key, tol_value, tol_effect, tol_op,
+    # factored [B,C] reconstruction inputs (models/batch.py BindingBatch)
+    aff_masks, aff_idx, weight_tables, weight_idx,
+    prev_idx, prev_rep, evict_idx, seeds,
+    extra_avail,  # i32[B,C] or broadcastable [1,1] sentinel
+):
+    """Decompress the factored batch ON DEVICE (gathers + scatters over ICI-
+    free local HBM), then run the solve. Host→device transfer is O(B·K+P·C)."""
+    B = replicas.shape[0]
+    C = alive.shape[0]
+    rows = jnp.arange(B)[:, None]
+    affinity_ok = aff_masks[aff_idx]
+    static_weight = weight_tables[weight_idx]
+    # sparse scatters; padded entries carry index C → dropped
+    prev_member = jnp.zeros((B, C), bool).at[rows, prev_idx].set(True, mode="drop")
+    prev_replicas = (
+        jnp.zeros((B, C), jnp.int32).at[rows, prev_idx].set(prev_rep, mode="drop")
+    )
+    eviction_ok = jnp.ones((B, C), bool).at[rows, evict_idx].set(False, mode="drop")
+    tie = _device_tie(seeds, C)
+    extra = jnp.broadcast_to(extra_avail, (B, C))
+    feasible, score, result, unschedulable, avail_sum, avail = _schedule_body(
+        alive, capacity, has_summary, taint_key, taint_value, taint_effect, api_ok,
+        replicas, request, unknown_request, gvk, strategy, fresh,
+        tol_key, tol_value, tol_effect, tol_op,
+        affinity_ok, eviction_ok, static_weight, prev_member, prev_replicas, tie,
+        extra,
+    )
+    # Compact outputs: the per-binding target list is almost always far
+    # smaller than C (bounded by spec.replicas for divided rows, by the
+    # affinity size for duplicated rows). top-K sparsification turns the
+    # round's device→host transfer from O(B·C) into O(B·K); rows whose
+    # nonzero count exceeds K (rare: Duplicated over a huge candidate set)
+    # fall back to a dense row fetch on host.
+    K = min(C, TOPK_TARGETS)
+    top_val, top_idx = jax.lax.top_k(result, K)
+    nnz = (result > 0).sum(-1).astype(jnp.int32)
+    feas_count = feasible.sum(-1).astype(jnp.int32)
+    return (
+        feasible, score, result, unschedulable, avail_sum, avail,
+        feas_count, nnz, top_idx.astype(jnp.int32), top_val,
+    )
+
+
 def _restrict_rows(batch: BindingBatch, rows: list[int], affinity_override: np.ndarray) -> BindingBatch:
     """Row-subset of a batch with the spread-selection mask folded into the
-    affinity mask (phase-2 candidate restriction)."""
+    affinity mask (phase-2 candidate restriction). The override masks are
+    per-row, so the sub-batch carries them as its own (un-deduped) table."""
     idx = np.asarray(rows)
 
     def take(a):
@@ -141,12 +226,15 @@ def _restrict_rows(batch: BindingBatch, rows: list[int], affinity_override: np.n
         tol_value=take(batch.tol_value),
         tol_effect=take(batch.tol_effect),
         tol_op=take(batch.tol_op),
-        affinity_ok=affinity_override[idx],
-        eviction_ok=take(batch.eviction_ok),
-        static_weight=take(batch.static_weight),
-        prev_member=take(batch.prev_member),
-        prev_replicas=take(batch.prev_replicas),
-        tie=take(batch.tie),
+        aff_masks=affinity_override[idx],
+        aff_idx=np.arange(len(rows), dtype=np.int32),
+        weight_tables=batch.weight_tables,
+        weight_idx=take(batch.weight_idx),
+        prev_idx=take(batch.prev_idx),
+        prev_rep=take(batch.prev_rep),
+        evict_idx=take(batch.evict_idx),
+        seeds=take(batch.seeds),
+        n_clusters=batch.n_clusters,
     )
 
 
@@ -163,6 +251,17 @@ class ArrayScheduler:
         self.clusters = list(clusters)
         self.fleet: FleetArrays = self.encoder.encode(self.clusters)
         self.batch_encoder = BatchEncoder(self.encoder, self.fleet, self.clusters)
+        # fleet tensors live on device across rounds (the persistent snapshot
+        # that replaces the reference's per-attempt deep copy, cache.go:62-77);
+        # re-transferred only on cluster-set change
+        f = self.fleet
+        self._fleet_dev = tuple(
+            jax.device_put(x)
+            for x in (
+                f.alive, f.capacity, f.has_summary,
+                f.taint_key, f.taint_value, f.taint_effect, f.api_ok,
+            )
+        )
 
     @staticmethod
     def _bucket(n: int) -> int:
@@ -178,9 +277,9 @@ class ArrayScheduler:
             return batch
         pad = Bp - B
 
-        def pz(a):
+        def pz(a, fill=0):
             width = [(0, pad)] + [(0, 0)] * (a.ndim - 1)
-            return np.pad(a, width)
+            return np.pad(a, width, constant_values=fill)
 
         return BindingBatch(
             keys=batch.keys,
@@ -195,28 +294,25 @@ class ArrayScheduler:
             tol_value=pz(batch.tol_value),
             tol_effect=pz(batch.tol_effect),
             tol_op=pz(batch.tol_op),
-            affinity_ok=pz(batch.affinity_ok),
-            eviction_ok=pz(batch.eviction_ok),
-            static_weight=pz(batch.static_weight),
-            prev_member=pz(batch.prev_member),
-            prev_replicas=pz(batch.prev_replicas),
-            tie=pz(batch.tie),
+            aff_masks=batch.aff_masks,
+            aff_idx=pz(batch.aff_idx),  # padded rows → mask row 0 (harmless:
+            #   strategy 0/replicas 0 rows are never decoded)
+            weight_tables=batch.weight_tables,
+            weight_idx=pz(batch.weight_idx),
+            prev_idx=pz(batch.prev_idx, fill=batch.n_clusters),
+            prev_rep=pz(batch.prev_rep),
+            evict_idx=pz(batch.evict_idx, fill=batch.n_clusters),
+            seeds=pz(batch.seeds),
+            n_clusters=batch.n_clusters,
         )
+
+    _NO_EXTRA = np.full((1, 1), -1, np.int32)  # broadcast sentinel
 
     def run_kernel(self, batch: BindingBatch, extra_avail=None):
         if extra_avail is None:
-            extra_avail = np.full(
-                (len(batch.replicas), len(self.fleet.names)), -1, np.int32
-            )
-        f = self.fleet
-        return _schedule_kernel(
-            f.alive,
-            f.capacity,
-            f.has_summary,
-            f.taint_key,
-            f.taint_value,
-            f.taint_effect,
-            f.api_ok,
+            extra_avail = self._NO_EXTRA
+        return _schedule_kernel_compact(
+            *self._fleet_dev,
             batch.replicas,
             batch.request,
             batch.unknown_request,
@@ -227,12 +323,14 @@ class ArrayScheduler:
             batch.tol_value,
             batch.tol_effect,
             batch.tol_op,
-            batch.affinity_ok,
-            batch.eviction_ok,
-            batch.static_weight,
-            batch.prev_member,
-            batch.prev_replicas,
-            batch.tie,
+            batch.aff_masks,
+            batch.aff_idx,
+            batch.weight_tables,
+            batch.weight_idx,
+            batch.prev_idx,
+            batch.prev_rep,
+            batch.evict_idx,
+            batch.seeds,
             extra_avail,
         )
 
@@ -244,9 +342,29 @@ class ArrayScheduler:
         if extra_avail is not None and len(extra_avail) < len(batch.replicas):
             pad = len(batch.replicas) - len(extra_avail)
             extra_avail = np.pad(extra_avail, [(0, pad), (0, 0)], constant_values=-1)
-        feasible, score, result, unsched, avail_sum, avail = (
-            np.array(x) for x in self.run_kernel(batch, extra_avail)
+        out = self.run_kernel(batch, extra_avail)
+        dev_feasible, dev_score, dev_result, dev_unsched, dev_avail_sum, dev_avail = out[:6]
+        # one batched device_get for the compact outputs (a single tunnel
+        # round-trip instead of one per array)
+        unsched, avail_sum, feas_count, nnz, top_idx, top_val = jax.device_get(
+            (dev_unsched, dev_avail_sum, out[6], out[7], out[8], out[9])
         )
+        # the spread re-run overwrites per-row entries; device_get buffers are
+        # read-only views
+        unsched = np.array(unsched)
+        avail_sum = np.array(avail_sum)
+        feas_count = np.array(feas_count)
+        # dense tensors are fetched lazily: only the phases that need full
+        # rows (spread selection, non-workload target lists, top-K overflow)
+        dense_cache: dict[str, np.ndarray] = {}
+
+        def dense(name: str) -> np.ndarray:
+            a = dense_cache.get(name)
+            if a is None:
+                a = np.asarray({"feasible": dev_feasible, "score": dev_score,
+                                "result": dev_result, "avail": dev_avail}[name])
+                dense_cache[name] = a
+            return a
 
         # Phase 2: spread-constrained rows restrict candidates via the host
         # combinatorial selection (SelectClusters, common.go:32-39), then the
@@ -260,14 +378,21 @@ class ArrayScheduler:
             if (
                 placement is not None
                 and placement.spread_constraints
-                and feasible[b].any()
+                and feas_count[b] > 0
                 # statically-ignored constraints select every feasible cluster
                 # (select_clusters.go:63-77) — the restriction re-run is a no-op
                 and not spread_mod.should_ignore_spread_constraint(placement)
             ):
                 spread_rows.append(b)
+        # sparse decode state; spread-restricted rows overwrite their entries
+        row_targets: dict[int, list[tuple[int, int]]] = {}
+        row_feasible: dict[int, np.ndarray] = {}
         if spread_rows:
+            feasible = dense("feasible")
+            score = dense("score")
+            avail = dense("avail")
             sub_affinity = raw.affinity_ok.copy()
+            prev_dense = raw.prev_replicas  # dense view materialized once
             live_rows = []
             for b in spread_rows:
                 rb = bindings[b]
@@ -276,7 +401,7 @@ class ArrayScheduler:
                         name=self.fleet.names[i],
                         index=int(i),
                         score=int(score[b, i]),
-                        available=int(avail[b, i]) + int(raw.prev_replicas[b, i]),
+                        available=int(avail[b, i]) + int(prev_dense[b, i]),
                         region=self.clusters[i].spec.region,
                         zone=self.clusters[i].spec.zone,
                         provider=self.clusters[i].spec.provider,
@@ -303,46 +428,78 @@ class ArrayScheduler:
                     pad = len(sub_batch.replicas) - len(sub_extra)
                     if pad:
                         sub_extra = np.pad(sub_extra, [(0, pad), (0, 0)], constant_values=-1)
-                s_feas, s_score, s_result, s_unsched, s_avail_sum, _ = jax.tree.map(
-                    np.asarray, self.run_kernel(sub_batch, sub_extra)
+                s_out = self.run_kernel(sub_batch, sub_extra)
+                s_feas, s_result, s_unsched, s_avail_sum = jax.device_get(
+                    (s_out[0], s_out[2], s_out[3], s_out[4])
                 )
                 for j, b in enumerate(live_rows):
-                    feasible[b] = s_feas[j]
-                    score[b] = s_score[j]
-                    result[b] = s_result[j]
+                    row_feasible[b] = np.nonzero(s_feas[j])[0]
+                    feas_count[b] = int(s_feas[j].sum())
+                    pos = np.nonzero(s_result[j] > 0)[0]
+                    row_targets[b] = [(int(i), int(s_result[j, i])) for i in pos]
                     unsched[b] = s_unsched[j]
                     avail_sum[b] = s_avail_sum[j]
 
         names = self.fleet.names
-        out: list[ScheduleDecision] = []
+        C = len(names)
+        # rows whose target set overflowed the top-K window fetch dense rows
+        overflow = [
+            b for b in range(len(raw.keys))
+            if b not in row_targets and nnz[b] > top_idx.shape[1]
+        ]
+        # NON_WORKLOAD rows need the full feasible set as their target list
+        nonwork = [
+            b for b in range(len(raw.keys))
+            if raw.strategy[b] == NON_WORKLOAD and b not in row_feasible
+            and feas_count[b] > 0
+        ]
+        if overflow:
+            result_dense = dense("result")
+            for b in overflow:
+                pos = np.nonzero(result_dense[b] > 0)[0]
+                row_targets[b] = [(int(i), int(result_dense[b, i])) for i in pos]
+        if nonwork:
+            feasible_dense = dense("feasible")
+            for b in nonwork:
+                row_feasible[b] = np.nonzero(feasible_dense[b])[0]
+
+        out_decisions: list[ScheduleDecision] = []
         for b, key in enumerate(raw.keys):
-            feas_idx = np.nonzero(feasible[b])[0]
-            dec = ScheduleDecision(
-                key=key, feasible=[names[i] for i in feas_idx], score=score[b]
-            )
+            dec = ScheduleDecision(key=key)
+            if b in row_feasible:
+                dec.feasible = [names[i] for i in row_feasible[b]]
             if b in spread_errors:
                 dec.error = spread_errors[b]
-                out.append(dec)
+                out_decisions.append(dec)
                 continue
-            if feas_idx.size == 0:
+            if feas_count[b] == 0:
                 # FitError diagnosis (generic_scheduler.go:83-88)
-                dec.error = f"0/{len(names)} clusters are available"
-                out.append(dec)
+                dec.error = f"0/{C} clusters are available"
+                out_decisions.append(dec)
                 continue
             if unsched[b]:
                 dec.error = (
                     f"Clusters available replicas {int(avail_sum[b])} are not "
                     "enough to schedule."
                 )
-                out.append(dec)
+                out_decisions.append(dec)
                 continue
             if raw.strategy[b] == NON_WORKLOAD:
+                feas_idx = row_feasible.get(b, np.empty(0, np.int64))
                 dec.targets = [TargetCluster(name=names[i], replicas=0) for i in feas_idx]
-            else:
-                pos = np.nonzero(result[b] > 0)[0]
-                # removeZeroReplicasCluster (common.go:60-66)
+            elif b in row_targets:
                 dec.targets = [
-                    TargetCluster(name=names[i], replicas=int(result[b, i])) for i in pos
+                    TargetCluster(name=names[i], replicas=rep)
+                    for i, rep in sorted(row_targets[b])
                 ]
-            out.append(dec)
-        return out
+            else:
+                # compact path: the top-K window holds every nonzero target
+                n = int(nnz[b])
+                pairs = sorted(
+                    (int(top_idx[b, k]), int(top_val[b, k])) for k in range(n)
+                )
+                dec.targets = [
+                    TargetCluster(name=names[i], replicas=rep) for i, rep in pairs
+                ]
+            out_decisions.append(dec)
+        return out_decisions
