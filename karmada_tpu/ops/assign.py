@@ -30,8 +30,16 @@ I32_MAX = jnp.int32(2**31 - 1)
 
 def _rank_by(weight, last, tie):
     """rank[b,c] = position of cluster c in the order (weight desc, last
-    desc, tie asc) within row b. Double-argsort of a lexsort."""
-    order = jnp.lexsort((tie, -last, -weight), axis=-1)  # last key = primary
+    desc, tie asc) within row b. Double-argsort of a lexsort.
+
+    The (last, tie) pair packs into ONE i64 key — both are i32 — so the
+    lexsort runs 2 stable passes instead of 3 (each pass is a full [B,C]
+    sort; at 10k×5k these passes dominate the solve)."""
+    last_tie = (
+        ((jnp.int64(2**31 - 1) - last.astype(jnp.int64)) << jnp.int64(32))
+        | tie.astype(jnp.int64)
+    )
+    order = jnp.lexsort((last_tie, -weight), axis=-1)  # last key = primary
     rank = jnp.argsort(order, axis=-1)
     return rank
 
@@ -126,10 +134,10 @@ def dynamic_assign(
     # dynamicFreshScale still route through the Aggregated branch of
     # dynamicDivideReplicas, only with scheduledClusters nil so no prior
     # preference): prior-first, then weight desc; keep the shortest prefix
-    # whose cumulative capacity covers the target.
+    # whose cumulative capacity covers the target. The cluster-index tie-break
+    # comes free from sort stability (no third key pass needed).
     prior = up[:, None] & (prev_m > 0)
-    c_idx = jnp.broadcast_to(jnp.arange(weight.shape[1], dtype=jnp.int32), weight.shape)
-    trunc_order = jnp.lexsort((c_idx, -weight, -prior.astype(jnp.int32)), axis=-1)
+    trunc_order = jnp.lexsort((-weight, -prior.astype(jnp.int32)), axis=-1)
     w_sorted = jnp.take_along_axis(weight, trunc_order, axis=-1)
     cum = jnp.cumsum(w_sorted, axis=-1)
     keep_sorted = (cum - w_sorted) < tgt[:, None]  # strictly before coverage
@@ -142,6 +150,72 @@ def dynamic_assign(
     last = jnp.where(up[:, None], prev_m, 0).astype(jnp.int32)
     dispensed, _ = take_by_weight(weight, last, tie, tgt.astype(jnp.int32), init)
     result = jnp.where(eq[:, None], prev_m.astype(jnp.int32), dispensed)
+    result = jnp.where(unsched[:, None], 0, result)
+    return DynamicResult(result, unsched, avail_sum.astype(jnp.int32))
+
+
+def combined_assign(
+    feasible,  # bool[B,C]
+    is_static,  # bool[B] strategy == STATIC_WEIGHT
+    is_dyn,  # bool[B] DYNAMIC_WEIGHT | AGGREGATED
+    aggregated,  # bool[B]
+    raw_weight,  # i64[B,C] static weight tables
+    avail,  # i32[B,C]
+    prev,  # i32[B,C]
+    tie,  # i32[B,C]
+    replicas,  # i32[B]
+    fresh,  # bool[B]
+) -> DynamicResult:
+    """Static-weight AND dynamic rows through ONE dispenser pass.
+
+    The two strategies are row-disjoint, so their (weight, last, init, target)
+    inputs row-select into a single take_by_weight — halving the [B,C] sort
+    passes, which dominate the full-scale solve. Semantics are identical to
+    static_weight_assign / dynamic_assign (division_algorithm.go paths)."""
+    # --- static inputs (assignment.go:194-206) ---
+    w_static = jnp.where(feasible, raw_weight, 0).astype(jnp.int64)
+    all_zero = w_static.sum(-1) == 0
+    w_static = jnp.where(all_zero[:, None] & feasible, 1, w_static)
+    last_static = jnp.where(feasible, prev, 0)
+
+    # --- dynamic inputs (assignment.go:208-239) ---
+    avail_m = jnp.where(feasible, avail, 0).astype(jnp.int64)
+    prev_m = jnp.where(feasible, prev, 0).astype(jnp.int64)
+    assigned = prev_m.sum(-1)
+    target_spec = replicas.astype(jnp.int64)
+    down = ~fresh & (assigned > target_spec)
+    up = ~fresh & (assigned < target_spec)
+    eq = ~fresh & (assigned == target_spec)
+    w_dyn = jnp.where(
+        fresh[:, None], avail_m + prev_m, jnp.where(down[:, None], prev_m, avail_m)
+    )
+    init_dyn = jnp.where(up[:, None], prev_m, 0).astype(jnp.int32)
+    tgt_dyn = jnp.where(up, target_spec - assigned, target_spec)
+    avail_sum = w_dyn.sum(-1)
+    unsched = is_dyn & ~eq & (avail_sum < tgt_dyn)
+
+    # Aggregated truncation (see dynamic_assign)
+    prior = up[:, None] & (prev_m > 0)
+    trunc_order = jnp.lexsort((-w_dyn, -prior.astype(jnp.int32)), axis=-1)
+    w_sorted = jnp.take_along_axis(w_dyn, trunc_order, axis=-1)
+    cum = jnp.cumsum(w_sorted, axis=-1)
+    keep_sorted = (cum - w_sorted) < tgt_dyn[:, None]
+    keep = jnp.zeros_like(keep_sorted).at[
+        jnp.arange(w_dyn.shape[0])[:, None], trunc_order
+    ].set(keep_sorted)
+    do_trunc = (aggregated & ~eq)[:, None]
+    w_dyn = jnp.where(do_trunc & ~keep, 0, w_dyn)
+    last_dyn = jnp.where(up[:, None], prev_m, 0).astype(jnp.int32)
+
+    # --- row-select into ONE dispense ---
+    sm = is_static[:, None]
+    weight = jnp.where(sm, w_static, w_dyn)
+    last = jnp.where(sm, last_static, last_dyn)
+    init = jnp.where(sm, 0, init_dyn)
+    tgt = jnp.where(is_static, target_spec, tgt_dyn).astype(jnp.int32)
+    dispensed, _ = take_by_weight(weight, last, tie, tgt, init)
+
+    result = jnp.where((is_dyn & eq)[:, None], prev_m.astype(jnp.int32), dispensed)
     result = jnp.where(unsched[:, None], 0, result)
     return DynamicResult(result, unsched, avail_sum.astype(jnp.int32))
 

@@ -48,6 +48,7 @@ class ScheduleDecision:
     error: str = ""  # non-empty ⇒ unschedulable / fit error
     feasible: list[str] = field(default_factory=list)
     score: Optional[np.ndarray] = None
+    affinity_name: str = ""  # applied ordered-affinity term (scheduler.go:562-625)
 
     @property
     def ok(self) -> bool:
@@ -100,28 +101,21 @@ def _schedule_body(
     # core/util.go:72-92); gRPC/node-level answers tighten the general bound
     avail = jnp.where(extra_avail >= 0, jnp.minimum(avail, extra_avail), avail)
 
-    # All strategies computed batched, row-selected by strategy code.
+    # All strategies batched; static + dynamic rows share one dispenser pass
+    # (they are row-disjoint — combined_assign halves the [B,C] sort work).
     dup = assign_ops.duplicated_assign(feasible, replicas)
-    static = assign_ops.static_weight_assign(
-        feasible, static_weight, prev_replicas, tie, replicas
-    )
-    dyn = assign_ops.dynamic_assign(
-        feasible,
-        avail,
-        prev_replicas,
-        tie,
-        replicas,
-        fresh,
-        strategy == AGGREGATED,
+    is_static = strategy == STATIC_WEIGHT
+    is_dyn = (strategy == DYNAMIC_WEIGHT) | (strategy == AGGREGATED)
+    sd = assign_ops.combined_assign(
+        feasible, is_static, is_dyn, strategy == AGGREGATED,
+        static_weight, avail, prev_replicas, tie, replicas, fresh,
     )
 
     result = jnp.zeros_like(dup)
     result = jnp.where((strategy == DUPLICATED)[:, None], dup, result)
-    result = jnp.where((strategy == STATIC_WEIGHT)[:, None], static, result)
-    is_dyn = (strategy == DYNAMIC_WEIGHT) | (strategy == AGGREGATED)
-    result = jnp.where(is_dyn[:, None], dyn.result, result)
-    unschedulable = is_dyn & dyn.unschedulable
-    return feasible, score, result, unschedulable, dyn.available_sum, avail
+    result = jnp.where((is_static | is_dyn)[:, None], sd.result, result)
+    unschedulable = is_dyn & sd.unschedulable
+    return feasible, score, result, unschedulable, sd.available_sum, avail
 
 
 @partial(jax.jit, static_argnames=())
@@ -265,10 +259,15 @@ class ArrayScheduler:
 
     @staticmethod
     def _bucket(n: int) -> int:
+        """Power-of-two buckets up to 2048, then 2048-multiples: bounds the
+        jit cache while capping pad waste at large B (10k pads to 10240, not
+        16384 — the solve is O(B·C), so pad waste is wall-clock waste)."""
         b = 8
-        while b < n:
+        while b < n and b < 2048:
             b *= 2
-        return b
+        if n <= b:
+            return b
+        return ((n + 2047) // 2048) * 2048
 
     def _pad(self, batch: BindingBatch) -> BindingBatch:
         B = batch.size
@@ -335,9 +334,56 @@ class ArrayScheduler:
         )
 
     def schedule(self, bindings: Sequence, extra_avail=None) -> list[ScheduleDecision]:
+        """Schedule with the ordered-affinity-terms retry loop
+        (scheduleResourceBindingWithClusterAffinities, scheduler.go:562-625):
+        bindings whose placement lists `cluster_affinities` start from the
+        last observed term and fall through to later terms on failure; the
+        applied term's name is recorded on the decision."""
         if not bindings:
             return []
-        raw = self.batch_encoder.encode(bindings)
+
+        def terms_of(rb):
+            p = rb.spec.placement
+            return p.cluster_affinities if p is not None else []
+
+        def initial_term(rb) -> int:
+            terms = terms_of(rb)
+            if not terms:
+                return 0
+            observed = rb.status.scheduler_observed_affinity_name
+            for i, t in enumerate(terms):
+                if t.affinity_name == observed:
+                    return i
+            return 0
+
+        term_idx = [initial_term(rb) for rb in bindings]
+        decisions = self._schedule_once(bindings, extra_avail, term_idx)
+        while True:
+            retry = [
+                b
+                for b, d in enumerate(decisions)
+                if not d.ok and term_idx[b] + 1 < len(terms_of(bindings[b]))
+            ]
+            if not retry:
+                break
+            for b in retry:
+                term_idx[b] += 1
+            sub_extra = None if extra_avail is None else extra_avail[retry]
+            sub_dec = self._schedule_once(
+                [bindings[b] for b in retry], sub_extra, [term_idx[b] for b in retry]
+            )
+            for j, b in enumerate(retry):
+                decisions[b] = sub_dec[j]
+        for b, d in enumerate(decisions):
+            terms = terms_of(bindings[b])
+            if terms and d.ok:
+                d.affinity_name = terms[term_idx[b]].affinity_name
+        return decisions
+
+    def _schedule_once(
+        self, bindings: Sequence, extra_avail=None, term_indices=None
+    ) -> list[ScheduleDecision]:
+        raw = self.batch_encoder.encode(bindings, term_indices=term_indices)
         batch = self._pad(raw)
         if extra_avail is not None and len(extra_avail) < len(batch.replicas):
             pad = len(batch.replicas) - len(extra_avail)

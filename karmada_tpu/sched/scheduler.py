@@ -205,6 +205,7 @@ class SchedulerDaemon:
                     self.store.update(fresh)
                 return  # idempotent no-op: the event fixpoint terminates here
             fresh.status.scheduler_observed_generation = fresh.metadata.generation
+            fresh.status.scheduler_observed_affinity_name = decision.affinity_name
             fresh.status.last_scheduled_time = self.clock.now()
         else:
             reason = (

@@ -1,0 +1,67 @@
+"""Ordered cluster-affinity terms (scheduler.go:562-625 failover loop)."""
+from __future__ import annotations
+
+from karmada_tpu.api.meta import ObjectMeta, new_uid
+from karmada_tpu.api.policy import (
+    ClusterAffinity,
+    ClusterAffinityTerm,
+    Placement,
+)
+from karmada_tpu.api.work import BindingSpec, ObjectReference, ResourceBinding
+from karmada_tpu.sched.core import ArrayScheduler
+from karmada_tpu.testing.fixtures import new_cluster_with_resource
+
+
+def fleet():
+    return [
+        new_cluster_with_resource(f"m{i}", {"cpu": 10.0}) for i in range(1, 4)
+    ]
+
+
+def binding(terms, observed=""):
+    rb = ResourceBinding(
+        metadata=ObjectMeta(namespace="default", name="web", uid=new_uid("rb")),
+        spec=BindingSpec(
+            resource=ObjectReference(api_version="apps/v1", kind="Deployment",
+                                     namespace="default", name="web"),
+            replicas=2,
+            placement=Placement(cluster_affinities=[
+                ClusterAffinityTerm(affinity_name=name,
+                                    affinity=ClusterAffinity(cluster_names=names))
+                for name, names in terms
+            ]),
+        ),
+    )
+    rb.status.scheduler_observed_affinity_name = observed
+    return rb
+
+
+class TestOrderedAffinityTerms:
+    def test_first_term_wins_when_feasible(self):
+        sched = ArrayScheduler(fleet())
+        (d,) = sched.schedule([binding([("primary", ["m1"]), ("backup", ["m2"])])])
+        assert d.ok
+        assert d.affinity_name == "primary"
+        assert [t.name for t in d.targets] == ["m1"]
+
+    def test_falls_through_to_next_term(self):
+        sched = ArrayScheduler(fleet())
+        # first term matches nothing in the fleet
+        (d,) = sched.schedule([binding([("primary", ["gone"]), ("backup", ["m2"])])])
+        assert d.ok
+        assert d.affinity_name == "backup"
+        assert [t.name for t in d.targets] == ["m2"]
+
+    def test_all_terms_fail(self):
+        sched = ArrayScheduler(fleet())
+        (d,) = sched.schedule([binding([("a", ["gone1"]), ("b", ["gone2"])])])
+        assert not d.ok
+
+    def test_resumes_from_observed_term(self):
+        sched = ArrayScheduler(fleet())
+        # observed=backup → starts at backup even though primary is feasible
+        (d,) = sched.schedule(
+            [binding([("primary", ["m1"]), ("backup", ["m2"])], observed="backup")]
+        )
+        assert d.affinity_name == "backup"
+        assert [t.name for t in d.targets] == ["m2"]
