@@ -98,6 +98,15 @@ class BindingController:
             manifest_obj: Unstructured = template.__deepcopy__({})
             if rb.spec.replicas > 0 and divided:
                 manifest_obj = self.interpreter.revise_replica(manifest_obj, tc.replicas)
+                # Job completions split (binding/common.go:301): a divided
+                # Job's .spec.completions scales with its parallelism share
+                if (
+                    manifest_obj.kind == "Job"
+                    and manifest_obj.get("spec", "completions") is not None
+                ):
+                    total = int(manifest_obj.get("spec", "completions") or 0)
+                    share = round(total * tc.replicas / rb.spec.replicas)
+                    manifest_obj.set("spec", "completions", int(share))
             if self.override_manager is not None:
                 manifest_obj = self.override_manager.apply_overrides(manifest_obj, tc.name)
             if self.gates.enabled(STATEFUL_FAILOVER_INJECTION):

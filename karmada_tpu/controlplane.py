@@ -115,7 +115,9 @@ class ControlPlane:
         self.webhook_interpreter_manager = WebhookInterpreterManager(
             self.store, self.interpreter, self.runtime, self.hook_registry
         )
-        self.detector = ResourceDetector(self.store, self.interpreter, self.runtime)
+        self.detector = ResourceDetector(
+            self.store, self.interpreter, self.runtime, gates=self.gates
+        )
         self.scheduler = SchedulerDaemon(
             self.store,
             self.runtime,

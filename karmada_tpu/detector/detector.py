@@ -73,9 +73,11 @@ class ResourceDetector:
         store: Store,
         interpreter: ResourceInterpreter,
         runtime: Runtime,
+        gates=None,
     ) -> None:
         self.store = store
         self.interpreter = interpreter
+        self.gates = gates
         self.controller = runtime.register(
             Controller(name="detector", reconcile=self._reconcile)
         )
@@ -116,8 +118,54 @@ class ResourceDetector:
         if policy is None:
             self._delete_binding_for(kind, namespace, name)
             return DONE
+        policy = self._resolve_claim(obj, policy)
+        if policy is None:
+            self._delete_binding_for(kind, namespace, name)
+            return DONE
         self._apply_policy(obj, policy)
         return DONE
+
+    def _resolve_claim(self, obj: Unstructured, best):
+        """Claim stability + preemption (pkg/detector/preemption.go under the
+        PolicyPreemption α gate): a template already claimed by a still-
+        matching policy keeps it; a different policy takes over only when the
+        gate is on, it declares `preemption: Always`, AND its explicit
+        priority is strictly higher (preemption.go preemption conditions).
+        Without a valid current claim, the best match wins outright."""
+        current = self._claimed_policy(obj)
+        if current is None:
+            return best
+        if current.metadata.uid == best.metadata.uid:
+            return best
+        preemption_on = self.gates is not None and self.gates.enabled("PolicyPreemption")
+        if (
+            preemption_on
+            and best.spec.preemption == "Always"
+            and best.spec.priority > current.spec.priority
+        ):
+            return best
+        # current claim persists if it still matches the template
+        ns = current.metadata.namespace if isinstance(current, PropagationPolicy) else ""
+        still_matches = any(
+            selector_matches(s, obj, ns) for s in current.spec.resource_selectors
+        )
+        return current if still_matches else best
+
+    def _claimed_policy(self, obj: Unstructured):
+        """The policy currently holding the template's claim labels."""
+        name = obj.metadata.annotations.get(POLICY_NAME_ANNOTATION)
+        if not name:
+            return None
+        if obj.metadata.labels.get(POLICY_ID_LABEL):
+            ns = obj.metadata.annotations.get(POLICY_NAMESPACE_ANNOTATION, obj.namespace)
+            pol = self.store.try_get("PropagationPolicy", name, ns)
+            if pol is not None and pol.metadata.uid == obj.metadata.labels[POLICY_ID_LABEL]:
+                return pol
+        if obj.metadata.labels.get(CLUSTER_POLICY_ID_LABEL):
+            pol = self.store.try_get("ClusterPropagationPolicy", name)
+            if pol is not None and pol.metadata.uid == obj.metadata.labels[CLUSTER_POLICY_ID_LABEL]:
+                return pol
+        return None
 
     def _look_for_matched_policy(self, obj: Unstructured):
         """Namespaced PropagationPolicies win over ClusterPropagationPolicies
@@ -155,17 +203,35 @@ class ResourceDetector:
         is_cluster_policy = isinstance(policy, ClusterPropagationPolicy)
         id_label = CLUSTER_POLICY_ID_LABEL if is_cluster_policy else POLICY_ID_LABEL
 
-        # claim the template
+        # claim the template (dropping any previous claim on preemption)
+        other_label = POLICY_ID_LABEL if is_cluster_policy else CLUSTER_POLICY_ID_LABEL
         fresh = self.store.get(f"{obj.api_version}/{obj.kind}", obj.name, obj.namespace)
-        if fresh.metadata.labels.get(id_label) != policy.metadata.uid:
+        if (
+            fresh.metadata.labels.get(id_label) != policy.metadata.uid
+            or other_label in fresh.metadata.labels
+        ):
+            fresh.metadata.labels.pop(other_label, None)
             fresh.metadata.labels[id_label] = policy.metadata.uid
             fresh.metadata.annotations[POLICY_NAME_ANNOTATION] = policy.name
+            if not is_cluster_policy:
+                fresh.metadata.annotations[POLICY_NAMESPACE_ANNOTATION] = (
+                    policy.metadata.namespace
+                )
             self.store.update(fresh)
             obj = fresh
 
         replicas, requirements = self.interpreter.get_replicas(obj)
         rb_name = binding_name(obj.kind, obj.name)
         existing = self.store.try_get("ResourceBinding", rb_name, obj.namespace)
+        if (
+            policy.spec.activation_preference == "Lazy"
+            and existing is not None
+            and existing.spec.resource.resource_version == obj.metadata.generation
+        ):
+            # Lazy activation (propagation_types.go ActivationPreference):
+            # policy updates take effect only on the NEXT template change —
+            # an unchanged template keeps its current binding spec
+            return
         rb = existing or ResourceBinding()
         rb.metadata.name = rb_name
         rb.metadata.namespace = obj.namespace
@@ -191,6 +257,7 @@ class ResourceDetector:
             replicas=replicas,
             replica_requirements=requirements,
             placement=policy.spec.placement,
+            schedule_priority=policy.spec.scheduler_priority,
             scheduler_name=policy.spec.scheduler_name,
             propagate_deps=policy.spec.propagate_deps,
             conflict_resolution=policy.spec.conflict_resolution,

@@ -1,0 +1,161 @@
+"""Detector claim stability, policy preemption (gate), lazy activation,
+schedule priority propagation, Job completions split."""
+from __future__ import annotations
+
+import pytest
+
+from karmada_tpu.api.unstructured import Unstructured
+from karmada_tpu.controlplane import ControlPlane
+from karmada_tpu.features import FeatureGates, POLICY_PREEMPTION
+from karmada_tpu.members.member import MemberConfig
+from karmada_tpu.testing.fixtures import (
+    duplicated_placement,
+    new_deployment,
+    new_policy,
+    selector_for,
+    static_weight_placement,
+)
+
+
+def plane(gates=None):
+    cp = ControlPlane(gates=gates)
+    cp.join_member(MemberConfig(name="m1", allocatable={"cpu": 100.0}))
+    cp.join_member(MemberConfig(name="m2", allocatable={"cpu": 100.0}))
+    return cp
+
+
+class TestClaimStability:
+    def test_claimed_template_keeps_policy_without_gate(self):
+        cp = plane()
+        dep = new_deployment("default", "web", replicas=1)
+        cp.store.create(dep)
+        cp.store.create(new_policy("default", "pp-a", [selector_for(dep)],
+                                   duplicated_placement(["m1"])))
+        cp.settle()
+        rb = next(iter(cp.store.list("ResourceBinding")))
+        assert [t.name for t in rb.spec.clusters] == ["m1"]
+        # a higher-priority policy appears but the gate is off → no preemption
+        high = new_policy("default", "pp-b", [selector_for(dep)],
+                          duplicated_placement(["m2"]))
+        high.spec.priority = 10
+        high.spec.preemption = "Always"
+        cp.store.create(high)
+        cp.settle()
+        rb = next(iter(cp.store.list("ResourceBinding")))
+        assert [t.name for t in rb.spec.clusters] == ["m1"]
+
+    def test_preemption_with_gate(self):
+        cp = plane(gates=FeatureGates({POLICY_PREEMPTION: True}))
+        dep = new_deployment("default", "web", replicas=1)
+        cp.store.create(dep)
+        cp.store.create(new_policy("default", "pp-a", [selector_for(dep)],
+                                   duplicated_placement(["m1"])))
+        cp.settle()
+        high = new_policy("default", "pp-b", [selector_for(dep)],
+                          duplicated_placement(["m2"]))
+        high.spec.priority = 10
+        high.spec.preemption = "Always"
+        cp.store.create(high)
+        cp.settle()
+        rb = next(iter(cp.store.list("ResourceBinding")))
+        assert [t.name for t in rb.spec.clusters] == ["m2"]
+        template = cp.store.get("apps/v1/Deployment", "web", "default")
+        assert template.metadata.annotations["policy.karmada.io/name"] == "pp-b"
+
+    def test_no_preemption_without_always(self):
+        cp = plane(gates=FeatureGates({POLICY_PREEMPTION: True}))
+        dep = new_deployment("default", "web", replicas=1)
+        cp.store.create(dep)
+        cp.store.create(new_policy("default", "pp-a", [selector_for(dep)],
+                                   duplicated_placement(["m1"])))
+        cp.settle()
+        high = new_policy("default", "pp-b", [selector_for(dep)],
+                          duplicated_placement(["m2"]))
+        high.spec.priority = 10  # preemption stays default "Never"
+        cp.store.create(high)
+        cp.settle()
+        rb = next(iter(cp.store.list("ResourceBinding")))
+        assert [t.name for t in rb.spec.clusters] == ["m1"]
+
+    def test_claim_released_when_policy_stops_matching(self):
+        cp = plane()
+        dep = new_deployment("default", "web", replicas=1)
+        cp.store.create(dep)
+        cp.store.create(new_policy("default", "pp-a", [selector_for(dep)],
+                                   duplicated_placement(["m1"])))
+        cp.store.create(new_policy("default", "pp-b", [selector_for(dep)],
+                                   duplicated_placement(["m2"])))
+        cp.settle()
+        rb = next(iter(cp.store.list("ResourceBinding")))
+        assert [t.name for t in rb.spec.clusters] == ["m1"]  # name asc wins
+        cp.store.delete("PropagationPolicy", "pp-a", "default")
+        cp.settle()
+        rb = next(iter(cp.store.list("ResourceBinding")))
+        assert [t.name for t in rb.spec.clusters] == ["m2"]
+
+
+class TestLazyActivation:
+    def test_policy_update_deferred_until_template_change(self):
+        cp = plane()
+        dep = new_deployment("default", "web", replicas=1)
+        cp.store.create(dep)
+        pol = new_policy("default", "pp", [selector_for(dep)],
+                         duplicated_placement(["m1"]))
+        pol.spec.activation_preference = "Lazy"
+        cp.store.create(pol)
+        cp.settle()
+        rb = next(iter(cp.store.list("ResourceBinding")))
+        assert [t.name for t in rb.spec.clusters] == ["m1"]
+        # policy changes target — binding must NOT move yet
+        pol = cp.store.get("PropagationPolicy", "pp", "default")
+        pol.spec.placement = duplicated_placement(["m2"])
+        cp.store.update(pol)
+        cp.settle()
+        rb = next(iter(cp.store.list("ResourceBinding")))
+        assert [t.name for t in rb.spec.clusters] == ["m1"]
+        # template change activates the pending policy
+        dep2 = cp.store.get("apps/v1/Deployment", "web", "default")
+        dep2.set("spec", "replicas", 2)
+        cp.store.update(dep2)
+        cp.settle()
+        rb = next(iter(cp.store.list("ResourceBinding")))
+        assert [t.name for t in rb.spec.clusters] == ["m2"]
+
+
+class TestSchedulePriorityPropagation:
+    def test_priority_copied_to_binding(self):
+        cp = plane()
+        dep = new_deployment("default", "web", replicas=1)
+        cp.store.create(dep)
+        pol = new_policy("default", "pp", [selector_for(dep)], duplicated_placement())
+        pol.spec.scheduler_priority = 7
+        cp.store.create(pol)
+        cp.settle()
+        rb = next(iter(cp.store.list("ResourceBinding")))
+        assert rb.spec.schedule_priority == 7
+
+
+class TestJobCompletionsSplit:
+    def test_divided_job_splits_completions(self):
+        cp = plane()
+        job = Unstructured({
+            "apiVersion": "batch/v1",
+            "kind": "Job",
+            "metadata": {"namespace": "default", "name": "batch"},
+            "spec": {
+                "parallelism": 9,
+                "completions": 9,
+                "template": {"spec": {"containers": [{"name": "c", "image": "busybox"}]}},
+            },
+        })
+        cp.store.create(job)
+        cp.store.create(new_policy(
+            "default", "pp", [selector_for(job)],
+            static_weight_placement({"m1": 1, "m2": 2}),
+        ))
+        cp.settle()
+        j1 = cp.members["m1"].get("batch/v1", "Job", "batch", "default")
+        j2 = cp.members["m2"].get("batch/v1", "Job", "batch", "default")
+        assert j1 is not None and j2 is not None
+        assert int(j1.get("spec", "completions")) + int(j2.get("spec", "completions")) == 9
+        assert int(j2.get("spec", "completions")) == 6  # 2/3 share
