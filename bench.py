@@ -14,11 +14,61 @@ from __future__ import annotations
 
 import argparse
 import json
+import os
+import subprocess
+import sys
 import time
 
 import numpy as np
 
 BASELINE_P99_S = 1.0  # BASELINE.json: 10k x 5k < 1 s p99
+
+
+def _child_env() -> dict:
+    # env-var platform selection hangs under this image's TPU sitecustomize;
+    # children pin platforms via jax.config (--platform) instead
+    return {k: v for k, v in os.environ.items() if k != "JAX_PLATFORMS"}
+
+
+def _metric_name(args) -> str:
+    return f"schedule_round_p99_{args.bindings}rb_x_{args.clusters}clusters"
+
+
+def _tail(r: subprocess.CompletedProcess) -> str:
+    lines = (r.stderr or r.stdout or "").strip().splitlines()
+    # the inner child reports failures as a JSON line on stdout; prefer it
+    for line in reversed((r.stdout or "").strip().splitlines()):
+        if line.startswith("{"):
+            return line[:300]
+    return lines[-1][:200] if lines else ""
+
+
+def probe_tpu(timeout_s: float) -> tuple[bool, str]:
+    """Bounded probe of the default (tunnel TPU) backend in a subprocess.
+
+    Backend init can block indefinitely when the tunnel is down (round-1
+    BENCH/MULTICHIP failures), so never probe in-process: spawn a child that
+    initializes the default backend and report whether it came up in time.
+    JAX_PLATFORMS is stripped from the child env: env-var platform selection
+    hangs under this image's TPU sitecustomize (verified: JAX_PLATFORMS=cpu
+    blocks jax.devices() forever) — platform pinning works only via
+    jax.config, which is what the --platform flag does."""
+    code = "import jax; ds = jax.devices(); print(ds[0].platform, len(ds))"
+    env = _child_env()
+    try:
+        r = subprocess.run(
+            [sys.executable, "-c", code],
+            timeout=timeout_s, capture_output=True, text=True, env=env,
+        )
+    except subprocess.TimeoutExpired:
+        return False, f"tpu backend init exceeded {timeout_s:.0f}s (tunnel down?)"
+    if r.returncode != 0:
+        tail = (r.stderr or r.stdout).strip().splitlines()
+        return False, (tail[-1][:200] if tail else f"probe rc={r.returncode}")
+    out = r.stdout.strip().split()
+    if out and out[0] == "cpu":
+        return False, "default backend is cpu (forced or no TPU registered)"
+    return True, r.stdout.strip()
 
 
 def build_problem(n_clusters: int, n_bindings: int, seed: int = 0):
@@ -106,15 +156,107 @@ def build_problem(n_clusters: int, n_bindings: int, seed: int = 0):
     return sched, bindings
 
 
-def main() -> None:
-    ap = argparse.ArgumentParser()
+def add_args(ap: argparse.ArgumentParser) -> None:
     ap.add_argument("--clusters", type=int, default=5000)
     ap.add_argument("--bindings", type=int, default=10000)
     ap.add_argument("--iters", type=int, default=10)
     ap.add_argument("--verbose", action="store_true")
-    args = ap.parse_args()
+    ap.add_argument("--probe-timeout", type=float, default=90.0,
+                    help="seconds to wait for the TPU backend before CPU fallback")
+    ap.add_argument("--run-timeout", type=float, default=900.0,
+                    help="total seconds for all measured child runs combined "
+                         "(the CPU fallback only gets what the TPU attempt left)")
+    ap.add_argument("--require-tpu", action="store_true",
+                    help="fail (with a JSON error line) instead of falling back to CPU")
+    ap.add_argument("--inner", action="store_true", help=argparse.SUPPRESS)
+    # NOTE: platform must be pinned via jax.config inside the child, not the
+    # JAX_PLATFORMS env var: the image's TPU sitecustomize hangs backend
+    # selection when JAX_PLATFORMS=cpu is set in the environment.
+    ap.add_argument("--platform", default=None, help=argparse.SUPPRESS)
 
+
+def main() -> None:
+    """Supervisor: decide the backend with a bounded probe, then run the
+    measured section in a child process under a hard timeout. The parent
+    never initializes a jax backend in-process, so no tunnel failure mode
+    can hang it (round-1 BENCH hang)."""
+    ap = argparse.ArgumentParser()
+    add_args(ap)
+    args = ap.parse_args()
+    if args.inner:
+        run_bench(args)
+        return
+
+    metric = _metric_name(args)
+    tpu_ok, probe_msg = probe_tpu(args.probe_timeout)
+    deadline = time.perf_counter() + args.run_timeout  # shared budget: the
+    # CPU fallback must still fit if the TPU child burns its slice and hangs
+
+    def run_child(platform: str | None, iters: int) -> subprocess.CompletedProcess | None:
+        argv = [
+            sys.executable, os.path.abspath(__file__), "--inner",
+            "--clusters", str(args.clusters), "--bindings", str(args.bindings),
+            "--iters", str(iters),
+        ] + (["--verbose"] if args.verbose else []) \
+          + (["--platform", platform] if platform else [])
+        budget = deadline - time.perf_counter()
+        if platform is None:
+            budget = min(budget, 0.6 * args.run_timeout)  # keep fallback room
+        if budget <= 1.0:
+            return None  # shared budget exhausted; count as a timeout
+        try:
+            return subprocess.run(
+                argv, timeout=budget, text=True,
+                capture_output=True, env=_child_env(),
+            )
+        except subprocess.TimeoutExpired:
+            return None
+
+    attempts = []
+    if tpu_ok:
+        r = run_child(None, args.iters)
+        if r is not None and r.returncode == 0:
+            sys.stderr.write(r.stderr)
+            sys.stdout.write(r.stdout)
+            return
+        attempts.append(
+            f"tpu run {'timed out' if r is None else f'rc={r.returncode}'}"
+            + ("" if r is None else ": " + _tail(r))
+        )
+    else:
+        attempts.append(f"tpu unavailable: {probe_msg}")
+
+    if args.require_tpu:
+        print(json.dumps({
+            "metric": metric, "value": None, "unit": "s", "vs_baseline": 0.0,
+            "error": "; ".join(attempts),
+        }))
+        sys.exit(1)
+
+    # CPU fallback: ~60 s/round at the north-star shape (round-1 judge run),
+    # so cap iters to fit the driver budget; the metric is backend-labeled.
+    if args.verbose:
+        print(f"# cpu fallback: {'; '.join(attempts)}")
+    r = run_child("cpu", min(args.iters, 3))
+    if r is None or r.returncode != 0:
+        tail = "" if r is None else _tail(r)
+        print(json.dumps({
+            "metric": metric, "value": None, "unit": "s", "vs_baseline": 0.0,
+            "error": "; ".join(attempts + [
+                f"cpu run {'timed out' if r is None else f'rc={r.returncode}'}: {tail}"
+            ]),
+        }))
+        sys.exit(1)
+    sys.stderr.write(r.stderr)
+    sys.stdout.write(r.stdout)
+
+
+def run_bench(args) -> None:
     import jax
+
+    if args.platform:
+        jax.config.update("jax_platforms", args.platform)
+    backend = jax.devices()[0].platform
 
     t0 = time.perf_counter()
     sched, bindings = build_problem(args.clusters, args.bindings)
@@ -155,17 +297,31 @@ def main() -> None:
             f"({args.bindings}x{args.clusters}, {len(jax.devices())} dev "
             f"{jax.devices()[0].device_kind})"
         )
+    metric = _metric_name(args)
+    if backend != "tpu" and "axon" not in backend:
+        metric += f"_{backend}"  # label non-TPU fallbacks so numbers never mix
     print(
         json.dumps(
             {
-                "metric": f"schedule_round_p99_{args.bindings}rb_x_{args.clusters}clusters",
+                "metric": metric,
                 "value": round(p99, 6),
                 "unit": "s",
                 "vs_baseline": round(BASELINE_P99_S / p99, 3),
+                "backend": backend,
+                "iters": args.iters,
             }
         )
     )
 
 
 if __name__ == "__main__":
-    main()
+    try:
+        main()
+    except SystemExit:
+        raise
+    except Exception as e:  # never die with a raw traceback: one JSON line
+        print(json.dumps({
+            "metric": "schedule_round_p99", "value": None, "unit": "s",
+            "vs_baseline": 0.0, "error": f"{type(e).__name__}: {e}"[:300],
+        }))
+        sys.exit(1)

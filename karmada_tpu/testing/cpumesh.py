@@ -1,0 +1,38 @@
+"""Force an n-device virtual CPU mesh, never touching the default backend.
+
+The ambient image registers a tunnel TPU plugin whose backend init can block
+indefinitely when the tunnel is down, so any code path that must work
+offline (tests, multichip dryrun) pins platform selection to cpu BEFORE the
+first backend init and raises the host device count via XLA_FLAGS."""
+from __future__ import annotations
+
+import os
+import re
+
+_COUNT_FLAG = "--xla_force_host_platform_device_count"
+
+
+def force_cpu_mesh(n_devices: int):
+    """Pin jax to the cpu platform with >= n_devices virtual devices.
+
+    Must run before any jax backend is initialized (safe after `import jax`).
+    Returns the cpu device list; raises if the process already initialized
+    jax with fewer host devices than requested."""
+    flags = os.environ.get("XLA_FLAGS", "")
+    m = re.search(rf"{_COUNT_FLAG}=(\d+)", flags)
+    if m is None:
+        flags = (flags + f" {_COUNT_FLAG}={n_devices}").strip()
+    elif int(m.group(1)) < n_devices:
+        flags = flags.replace(m.group(0), f"{_COUNT_FLAG}={n_devices}")
+    os.environ["XLA_FLAGS"] = flags
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    devices = jax.devices("cpu")
+    if len(devices) < n_devices:
+        raise RuntimeError(
+            f"need {n_devices} cpu devices, have {len(devices)}: jax was "
+            f"already initialized before force_cpu_mesh({n_devices}) ran"
+        )
+    return devices

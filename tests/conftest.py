@@ -1,15 +1,5 @@
 """Test env: force an 8-device virtual CPU mesh (multi-chip sharding is
-validated on host devices; the real TPU is only used by bench.py).
+validated on host devices; the real TPU is only used by bench.py)."""
+from karmada_tpu.testing.cpumesh import force_cpu_mesh
 
-The ambient image registers the tunnel TPU backend from sitecustomize (jax is
-already imported before this file runs), so env-var-only selection is too
-late; override via jax.config before any backend is initialized instead."""
-import os
-
-_flags = os.environ.get("XLA_FLAGS", "")
-if "--xla_force_host_platform_device_count" not in _flags:
-    os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
-
-import jax
-
-jax.config.update("jax_platforms", "cpu")
+force_cpu_mesh(8)
