@@ -31,6 +31,10 @@ from ..store.store import Store
 
 LEASE_DURATION_SECONDS = 40.0  # cluster lease default (cluster API)
 
+# Ready-condition reason written when the lease detector marks a cluster
+# NotReady; the recovery path only reverts NotReady states it caused itself
+REASON_LEASE_EXPIRED = "ClusterLeaseExpired"
+
 
 @dataclass
 class Lease:
@@ -127,21 +131,44 @@ class KarmadaAgent:
 
 
 class LeaseFailureDetector:
-    """Control-plane side: a cluster whose lease expired goes NotReady
+    """Control-plane side: a cluster whose lease expired goes NotReady; a
+    cluster whose lease is current again is restored to Ready, matching the
+    reference cluster-status controller's behavior on resumed heartbeats
     (cluster_status_controller.go lease monitoring + condition cache)."""
 
-    def __init__(self, store: Store, runtime: Runtime, on_not_ready=None):
+    def __init__(self, store: Store, runtime: Runtime, on_not_ready=None, on_ready=None):
         self.store = store
         self.clock = runtime.clock
         self.on_not_ready = on_not_ready  # callback(cluster_name)
+        self.on_ready = on_ready  # callback(cluster_name), recovery path
+
+    def _ready_condition(self, cluster_name: str):
+        from ..api.cluster import CLUSTER_CONDITION_READY
+        from ..api.meta import get_condition
+
+        cluster = self.store.try_get("Cluster", cluster_name)
+        if cluster is None:
+            return None
+        return get_condition(cluster.status.conditions, CLUSTER_CONDITION_READY)
 
     def check(self) -> list[str]:
         expired = []
         now = self.clock.now()
         for lease in self.store.list("Lease"):
+            cluster_name = lease.holder
             if now - lease.renew_time > lease.lease_duration_seconds:
-                cluster_name = lease.holder
                 expired.append(cluster_name)
                 if self.on_not_ready is not None:
                     self.on_not_ready(cluster_name)
+            elif self.on_ready is not None:
+                cond = self._ready_condition(cluster_name)
+                # only revert a NotReady this detector set itself: a health
+                # probe or operator action that marked the cluster NotReady
+                # for another reason must not be overridden by a live lease
+                if (
+                    cond is not None
+                    and cond.status != "True"
+                    and cond.reason == REASON_LEASE_EXPIRED
+                ):
+                    self.on_ready(cluster_name)
         return expired

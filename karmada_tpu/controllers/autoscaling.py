@@ -110,7 +110,11 @@ class FederatedHPAController:
                     request = req.resource_request
             except KeyError:
                 pass
-        desired = current
+        # kube HPA algorithm: every metric produces a proposal — the current
+        # replica count when within tolerance (a tolerant metric still vetoes
+        # scaling below what it needs), else ceil(ready * usage/target) — and
+        # the final answer is the max across all metric proposals.
+        proposals: list[int] = []
         utilization_seen: Optional[int] = None
         for metric in hpa.spec.metrics:
             res_request = request.get(metric.name, 0.0)
@@ -121,11 +125,11 @@ class FederatedHPAController:
             utilization_seen = int(utilization)
             ratio = utilization / float(metric.target_average_utilization)
             if abs(ratio - 1.0) <= HPA_TOLERANCE:
-                continue
-            # scale on ready pods, then take the max across metrics (kube HPA)
-            desired = max(desired if desired != current else 0,
-                          math.ceil(metrics.ready_pods * ratio))
+                proposals.append(current)
+            else:
+                proposals.append(math.ceil(metrics.ready_pods * ratio))
         hpa.status.current_average_utilization = utilization_seen
+        desired = max(proposals, default=current)
         return desired if desired > 0 else current
 
 

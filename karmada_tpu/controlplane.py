@@ -65,7 +65,7 @@ from .interpreter.customized import (
 )
 from .interpreter.interpreter import ResourceInterpreter
 from .agent import KarmadaAgent
-from .agent.agent import LeaseFailureDetector
+from .agent.agent import LeaseFailureDetector, REASON_LEASE_EXPIRED
 from .members.member import InMemoryMember, MemberConfig
 from .metricsadapter import MetricsAdapter
 from .proxy import ClusterProxy
@@ -149,7 +149,10 @@ class ControlPlane:
             self.store,
             self.runtime,
             on_not_ready=lambda name: self.set_member_ready(
-                name, False, reason="ClusterLeaseExpired"
+                name, False, reason=REASON_LEASE_EXPIRED
+            ),
+            on_ready=lambda name: self.set_member_ready(
+                name, True, reason="ClusterLeaseRenewed"
             ),
         )
         self.work_status_controller = WorkStatusController(

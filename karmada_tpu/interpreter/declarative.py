@@ -19,6 +19,8 @@ minimal builtin set.
 from __future__ import annotations
 
 import ast
+import functools
+import sys
 from typing import Any, Callable
 
 OPERATION_FUNCTIONS = {
@@ -36,6 +38,23 @@ _FORBIDDEN_NAMES = {
     "getattr", "setattr", "delattr", "__import__", "input", "breakpoint",
 }
 
+# Frame/generator/coroutine/code introspection attributes are NOT dunders, so
+# the dunder check alone does not stop e.g.
+# gen.gi_frame.f_back.f_globals['__builtins__'] escaping to the caller's
+# builtins (round-1 advisor PoC). Deny them by name.
+_FORBIDDEN_ATTRS = {
+    "gi_frame", "gi_code", "gi_yieldfrom",
+    "cr_frame", "cr_code", "cr_await", "cr_origin",
+    "ag_frame", "ag_code", "ag_await",
+    "f_back", "f_globals", "f_builtins", "f_locals", "f_code", "f_trace",
+    "tb_frame", "tb_next",
+    "co_consts", "co_names", "co_code", "co_filename",
+}
+
+# hard cap on traced line events per script call; interpreter scripts are
+# small field transforms — anything past this is a runaway loop
+_MAX_TRACE_EVENTS = 200_000
+
 _SAFE_BUILTINS = {
     "len": len, "int": int, "float": float, "str": str, "bool": bool,
     "dict": dict, "list": list, "tuple": tuple, "set": set,
@@ -43,6 +62,11 @@ _SAFE_BUILTINS = {
     "sorted": sorted, "reversed": reversed, "range": range,
     "enumerate": enumerate, "zip": zip, "any": any, "all": all,
     "isinstance": isinstance, "True": True, "False": False, "None": None,
+    # standard error types so scripts can use try/except; BaseException is
+    # deliberately absent (the execution-limit signal must stay uncatchable)
+    "Exception": Exception, "ValueError": ValueError, "KeyError": KeyError,
+    "TypeError": TypeError, "IndexError": IndexError,
+    "AttributeError": AttributeError, "ZeroDivisionError": ZeroDivisionError,
 }
 
 
@@ -50,16 +74,32 @@ class ScriptError(Exception):
     pass
 
 
+class _ScriptLimitExceeded(BaseException):
+    """Raised by the execution-limit tracer. Deliberately a BaseException so
+    a script's `except Exception:` cannot swallow it (raising inside a trace
+    function unsets tracing, so a caught limit error would leave the rest of
+    the script running unbounded). Bare `except:` and `except BaseException:`
+    are denied at compile time for the same reason."""
+
+
 def _check_ast(tree: ast.AST) -> None:
     for node in ast.walk(tree):
         if isinstance(node, (ast.Import, ast.ImportFrom)):
             raise ScriptError("imports are not allowed in interpreter scripts")
-        if isinstance(node, ast.Attribute) and node.attr.startswith("__"):
-            raise ScriptError("dunder attribute access is not allowed")
+        if isinstance(node, ast.Attribute) and (
+            node.attr.startswith("__") or node.attr in _FORBIDDEN_ATTRS
+        ):
+            raise ScriptError(f"attribute {node.attr!r} is not allowed")
         if isinstance(node, ast.Name) and node.id in _FORBIDDEN_NAMES:
             raise ScriptError(f"{node.id!r} is not allowed in interpreter scripts")
         if isinstance(node, (ast.Global, ast.Nonlocal)):
             raise ScriptError("global/nonlocal are not allowed")
+        if isinstance(node, ast.ExceptHandler):
+            if node.type is None:
+                raise ScriptError("bare except is not allowed (catch Exception)")
+            names = [n.id for n in ast.walk(node.type) if isinstance(n, ast.Name)]
+            if "BaseException" in names:
+                raise ScriptError("catching BaseException is not allowed")
 
 
 def compile_script(script: str, operation: str) -> Callable[..., Any]:
@@ -80,4 +120,33 @@ def compile_script(script: str, operation: str) -> Callable[..., Any]:
     fn = env.get(fn_name)
     if not callable(fn):
         raise ScriptError(f"{operation} script must define {fn_name}()")
-    return fn
+    return _with_execution_limit(fn, operation)
+
+
+def _with_execution_limit(fn: Callable[..., Any], operation: str) -> Callable[..., Any]:
+    """Bound script runtime: scripts can still loop, but a trace-event budget
+    turns an infinite loop into a ScriptError instead of a stuck controller."""
+
+    @functools.wraps(fn)
+    def limited(*args: Any, **kwargs: Any) -> Any:
+        budget = _MAX_TRACE_EVENTS
+
+        def tracer(frame, event, arg):  # noqa: ANN001 - cpython trace protocol
+            nonlocal budget
+            budget -= 1
+            if budget < 0:
+                raise _ScriptLimitExceeded
+            return tracer
+
+        prev = sys.gettrace()
+        sys.settrace(tracer)
+        try:
+            return fn(*args, **kwargs)
+        except _ScriptLimitExceeded:
+            raise ScriptError(
+                f"{operation} script exceeded the execution limit"
+            ) from None
+        finally:
+            sys.settrace(prev)
+
+    return limited

@@ -177,9 +177,13 @@ class ResourceInterpreter:
 
     def __init__(self) -> None:
         # Tier priority (interpreter.go: customized webhook > customized
-        # declarative > thirdparty configs > default native):
+        # declarative > thirdparty configs > default native). Interpreters
+        # registered through the public register() API live in their own tier:
+        # the declarative manager rebuilds _declarative wholesale on every
+        # customization change and must not drop manual registrations.
         self._webhook: dict[str, KindInterpreter] = {}
-        self._custom: dict[str, KindInterpreter] = {}
+        self._registered: dict[str, KindInterpreter] = {}
+        self._declarative: dict[str, KindInterpreter] = {}
         self._thirdparty: dict[str, KindInterpreter] = {}
         self._native: dict[str, KindInterpreter] = {
             "apps/v1/Deployment": KindInterpreter(
@@ -205,13 +209,14 @@ class ResourceInterpreter:
         return f"{obj.api_version}/{obj.kind}"
 
     def register(self, gvk: str, interpreter: KindInterpreter) -> None:
-        """Customized interpreter tier (ResourceInterpreterCustomization)."""
-        self._custom[gvk] = interpreter
+        """Manually-registered customized interpreter (survives declarative
+        reconciles; takes priority over declarative scripts)."""
+        self._registered[gvk] = interpreter
 
     def set_declarative_tier(self, tier: dict[str, KindInterpreter]) -> None:
         """Replace the declarative-customization tier wholesale (the manager
         rebuilds it from the live customization objects)."""
-        self._custom = tier
+        self._declarative = tier
 
     def set_webhook_tier(self, tier: dict[str, KindInterpreter]) -> None:
         self._webhook = tier
@@ -224,7 +229,13 @@ class ResourceInterpreter:
 
     def _hook(self, obj: Unstructured, name: str):
         gvk = self._gvk(obj)
-        for tier in (self._webhook, self._custom, self._thirdparty, self._native):
+        for tier in (
+            self._webhook,
+            self._registered,
+            self._declarative,
+            self._thirdparty,
+            self._native,
+        ):
             ki = tier.get(gvk)
             if ki is not None and getattr(ki, name) is not None:
                 return getattr(ki, name)

@@ -18,6 +18,10 @@ def force_cpu_mesh(n_devices: int):
     Must run before any jax backend is initialized (safe after `import jax`).
     Returns the cpu device list; raises if the process already initialized
     jax with fewer host devices than requested."""
+    # env-var platform selection hangs under this image's TPU sitecustomize
+    # (verified: JAX_PLATFORMS=cpu blocks jax.devices() forever); drop it and
+    # pin via jax.config below, which works
+    os.environ.pop("JAX_PLATFORMS", None)
     flags = os.environ.get("XLA_FLAGS", "")
     m = re.search(rf"{_COUNT_FLAG}=(\d+)", flags)
     if m is None:

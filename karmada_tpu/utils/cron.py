@@ -2,7 +2,12 @@
 (reference uses robfig/cron via pkg/controllers/cronfederatedhpa).
 
 Supports: "*", "*/n", "a", "a-b", "a,b,c", "a-b/n" per field; fields are
-minute hour day-of-month month day-of-week (0=Sunday, 7 also Sunday).
+minute hour day-of-month month day-of-week (0=Sunday, 7 also Sunday; ranges
+ending in 7 wrap, e.g. 5-7 = Fri,Sat,Sun).
+
+Matching is in UTC (deliberate divergence from robfig/cron's local-time
+default: the control plane's clock abstraction is epoch-based and tests need
+timezone-independent determinism).
 """
 from __future__ import annotations
 
@@ -42,8 +47,13 @@ def _parse_field(expr: str, lo: int, hi: int, dow: bool = False) -> set[int]:
                 a = b = int(part)
             except ValueError as e:
                 raise CronParseError(f"bad value in {expr!r}") from e
-        if dow:
-            a, b = a % 7 if a == 7 else a, b % 7 if b == 7 else b
+        if dow and b == 7:
+            # 7 = Sunday alias. A range ending in 7 (e.g. 5-7, Fri-Sun) wraps:
+            # expand over 0..7 then fold 7 onto 0.
+            if a < lo or a > 7:
+                raise CronParseError(f"value out of range in {expr!r}")
+            out.update(v % 7 for v in range(a, 8, step))
+            continue
         if a < lo or b > hi or a > b:
             raise CronParseError(f"value out of range in {expr!r}")
         out.update(range(a, b + 1, step))

@@ -61,9 +61,9 @@ class TestPullAgent:
         cp.tick(seconds=100)  # > 40s lease duration
         cluster = cp.store.get("Cluster", "pull-1")
         assert not cluster_ready(cluster)
-        # recovery: agent back up → lease renews; ready flips back on probe
+        # recovery: agent back up → lease renews → detector restores Ready
+        # automatically (no manual probe), like the reference status controller
         cp.members["pull-1"].healthy = True
-        cp.set_member_ready("pull-1", True)
         cp.tick()
         assert cluster_ready(cp.store.get("Cluster", "pull-1"))
 

@@ -71,6 +71,14 @@ class TestCron:
         assert s.fired_between(1_700_000_000, 1_700_000_061)
         assert not s.fired_between(1_700_000_000, 1_700_000_010)
 
+    def test_dow_seven_is_sunday_and_ranges_wrap(self):
+        assert CronSchedule.parse("0 0 * * 7").weekdays == {0}
+        # 5-7 = Fri,Sat,Sun (the Sunday alias wraps the range)
+        assert CronSchedule.parse("0 0 * * 5-7").weekdays == {5, 6, 0}
+        assert CronSchedule.parse("0 0 * * 0-7/2").weekdays == {0, 2, 4, 6}
+        with pytest.raises(CronParseError):
+            CronSchedule.parse("0 0 * * 8")
+
 
 class TestFederatedHPA:
     def test_scale_up_on_high_utilization(self, cp):
@@ -95,6 +103,53 @@ class TestFederatedHPA:
         cp.tick()
         dep = cp.store.get("apps/v1/Deployment", "web", "default")
         assert int(dep.get("spec", "replicas")) == 2  # 4% over target < 10% tolerance
+
+    def test_tolerant_metric_vetoes_deeper_scale_down(self, cp):
+        # kube HPA: a metric within tolerance proposes currentReplicas, so a
+        # second underutilized metric cannot scale below what it requires
+        deploy_web(cp, replicas=4, cpu=1.0)
+        h = fhpa(min_r=1, target_util=50)
+        h.spec.metrics.append(
+            ResourceMetricSource(name="memory", target_average_utilization=50)
+        )
+        cp.store.create(h)
+        dep = cp.store.get("apps/v1/Deployment", "web", "default")
+        # give the pod template a memory request so both metrics resolve
+        containers = dep.get("spec", "template", "spec", "containers")
+        containers[0]["resources"]["requests"]["memory"] = 1.0
+        cp.store.update(dep)
+        for m in cp.members.values():
+            # cpu at 52% (within 10% tolerance of target 50) → proposes
+            # currentReplicas=4; memory at 5% → ratio 0.1 → ceil(8*0.1)=1
+            m.set_workload_usage("Deployment", "default", "web",
+                                 {"cpu": 0.52, "memory": 0.05})
+        cp.tick()
+        dep = cp.store.get("apps/v1/Deployment", "web", "default")
+        # max(4, 1): the tolerant cpu metric keeps the replica count unchanged
+        assert int(dep.get("spec", "replicas")) == 4
+
+    def test_later_smaller_metric_does_not_override_earlier(self, cp):
+        deploy_web(cp, replicas=4, cpu=1.0)
+        h = fhpa(min_r=1, target_util=50)
+        h.spec.metrics.append(
+            ResourceMetricSource(name="memory", target_average_utilization=50)
+        )
+        cp.store.create(h)
+        dep = cp.store.get("apps/v1/Deployment", "web", "default")
+        containers = dep.get("spec", "template", "spec", "containers")
+        containers[0]["resources"]["requests"]["memory"] = 1.0
+        cp.store.update(dep)
+        for m in cp.members.values():
+            # ready pods = 8 (Duplicated over 2 members). cpu at 25% of
+            # target 50 → ratio 0.5 → proposes ceil(8*0.5)=4, which happens
+            # to equal currentReplicas; memory at 5% → ratio 0.1 → proposes 1
+            m.set_workload_usage("Deployment", "default", "web",
+                                 {"cpu": 0.25, "memory": 0.05})
+        cp.tick()
+        dep = cp.store.get("apps/v1/Deployment", "web", "default")
+        # max across proposals: the earlier proposal (4) must win even though
+        # it equals currentReplicas (the old code zeroed it and 1 won)
+        assert int(dep.get("spec", "replicas")) == 4
 
     def test_scale_down_clamped_to_min(self, cp):
         deploy_web(cp, replicas=4, cpu=1.0)

@@ -67,6 +67,79 @@ class TestSandbox:
         with pytest.raises(ScriptError):
             compile_script(bad, "replica_resource")
 
+    def test_rejects_frame_introspection_escape(self):
+        # round-1 advisor PoC: generator frames reach the caller's builtins
+        # without any dunder — gi_frame/f_back/f_globals must be denied
+        escape = (
+            "def GetReplicas(obj):\n"
+            "    def g():\n"
+            "        yield\n"
+            "    gen = g()\n"
+            "    return gen.gi_frame.f_back.f_globals, {}\n"
+        )
+        with pytest.raises(ScriptError, match="gi_frame|f_back|f_globals"):
+            compile_script(escape, "replica_resource")
+
+    def test_execution_limit_uncatchable_by_script(self):
+        # except Exception must not swallow the limit signal (raising inside
+        # a trace function unsets tracing, so a caught limit would leave the
+        # rest of the script unbounded); bare except / BaseException are
+        # denied at compile time
+        fn = compile_script(
+            "def GetReplicas(obj):\n"
+            "    while True:\n"
+            "        try:\n"
+            "            while True:\n"
+            "                pass\n"
+            "        except Exception:\n"
+            "            pass\n",
+            "replica_resource",
+        )
+        with pytest.raises(ScriptError, match="execution limit"):
+            fn({})
+        for bad in ("except:", "except BaseException:"):
+            with pytest.raises(ScriptError, match="not allowed"):
+                compile_script(
+                    "def GetReplicas(obj):\n"
+                    "    try:\n"
+                    "        pass\n"
+                    f"    {bad}\n"
+                    "        pass\n"
+                    "    return 1, {}",
+                    "replica_resource",
+                )
+
+    def test_infinite_loop_hits_execution_limit(self):
+        fn = compile_script(
+            "def GetReplicas(obj):\n"
+            "    n = 0\n"
+            "    while True:\n"
+            "        n += 1\n"
+            "    return n, {}\n",
+            "replica_resource",
+        )
+        with pytest.raises(ScriptError, match="execution limit"):
+            fn({})
+
+
+class TestTierIsolation:
+    def test_manual_registration_survives_declarative_reconcile(self):
+        from karmada_tpu.interpreter.interpreter import (
+            KindInterpreter,
+            ResourceInterpreter,
+        )
+
+        ri = ResourceInterpreter()
+        ri.register(
+            "example.io/v1/MyWorkload",
+            KindInterpreter(get_replicas=lambda obj: (42, None)),
+        )
+        # the declarative manager rebuilding its tier (on any customization
+        # create/update/delete) must not drop the manual registration
+        ri.set_declarative_tier({})
+        n, _ = ri.get_replicas(crd_workload())
+        assert n == 42
+
 
 class TestDeclarativeCustomization:
     def ric(self, name="ric-demo"):
