@@ -94,6 +94,10 @@ def _check_ast(tree: ast.AST) -> None:
             raise ScriptError(f"{node.id!r} is not allowed in interpreter scripts")
         if isinstance(node, (ast.Global, ast.Nonlocal)):
             raise ScriptError("global/nonlocal are not allowed")
+        if isinstance(node, ast.Try) and node.finalbody:
+            # a finally block runs AFTER the limit tracer raised (tracing is
+            # already unset), so code inside it would be unbounded
+            raise ScriptError("try/finally is not allowed in interpreter scripts")
         if isinstance(node, ast.ExceptHandler):
             if node.type is None:
                 raise ScriptError("bare except is not allowed (catch Exception)")
@@ -113,8 +117,13 @@ def compile_script(script: str, operation: str) -> Callable[..., Any]:
         raise ScriptError(f"syntax error in {operation} script: {e}") from e
     _check_ast(tree)
     env: dict[str, Any] = {"__builtins__": _SAFE_BUILTINS}
+    code = compile(tree, f"<{operation}>", "exec")
     try:
-        exec(compile(tree, f"<{operation}>", "exec"), env)  # noqa: S102 - sandboxed above
+        # module-level statements run under the same execution budget as the
+        # operation calls (a top-level loop must not hang the reconciler)
+        _run_limited(lambda: exec(code, env), operation)  # noqa: S102 - sandboxed above
+    except ScriptError:
+        raise
     except Exception as e:  # noqa: BLE001
         raise ScriptError(f"error loading {operation} script: {e}") from e
     fn = env.get(fn_name)
@@ -123,30 +132,33 @@ def compile_script(script: str, operation: str) -> Callable[..., Any]:
     return _with_execution_limit(fn, operation)
 
 
-def _with_execution_limit(fn: Callable[..., Any], operation: str) -> Callable[..., Any]:
-    """Bound script runtime: scripts can still loop, but a trace-event budget
-    turns an infinite loop into a ScriptError instead of a stuck controller."""
+def _run_limited(thunk: Callable[[], Any], operation: str) -> Any:
+    """Run `thunk` under a trace-event budget: an infinite loop becomes a
+    ScriptError instead of a stuck controller."""
+    budget = _MAX_TRACE_EVENTS
 
+    def tracer(frame, event, arg):  # noqa: ANN001 - cpython trace protocol
+        nonlocal budget
+        budget -= 1
+        if budget < 0:
+            raise _ScriptLimitExceeded
+        return tracer
+
+    prev = sys.gettrace()
+    sys.settrace(tracer)
+    try:
+        return thunk()
+    except _ScriptLimitExceeded:
+        raise ScriptError(
+            f"{operation} script exceeded the execution limit"
+        ) from None
+    finally:
+        sys.settrace(prev)
+
+
+def _with_execution_limit(fn: Callable[..., Any], operation: str) -> Callable[..., Any]:
     @functools.wraps(fn)
     def limited(*args: Any, **kwargs: Any) -> Any:
-        budget = _MAX_TRACE_EVENTS
-
-        def tracer(frame, event, arg):  # noqa: ANN001 - cpython trace protocol
-            nonlocal budget
-            budget -= 1
-            if budget < 0:
-                raise _ScriptLimitExceeded
-            return tracer
-
-        prev = sys.gettrace()
-        sys.settrace(tracer)
-        try:
-            return fn(*args, **kwargs)
-        except _ScriptLimitExceeded:
-            raise ScriptError(
-                f"{operation} script exceeded the execution limit"
-            ) from None
-        finally:
-            sys.settrace(prev)
+        return _run_limited(lambda: fn(*args, **kwargs), operation)
 
     return limited

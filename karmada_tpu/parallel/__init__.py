@@ -3,7 +3,6 @@ from .mesh import (
     AXIS_BINDINGS,
     AXIS_CLUSTERS,
     MeshScheduleKernel,
-    build_sharded_kernel,
     factor_mesh,
     make_mesh,
 )
@@ -12,7 +11,6 @@ __all__ = [
     "AXIS_BINDINGS",
     "AXIS_CLUSTERS",
     "MeshScheduleKernel",
-    "build_sharded_kernel",
     "factor_mesh",
     "make_mesh",
 ]

@@ -13,27 +13,28 @@ is sharded over a 2D `jax.sharding.Mesh`:
     sort/prefix-sum over ALL clusters, binding.go:112-144 — so the per-cluster
     partials ride one `all_gather` over ICI before assignment).
 
-This keeps the HBM-resident working set per chip at B/mesh_b × C/mesh_c for
-the quadratic phase, which is what lets 10k bindings × 5k clusters (BASELINE
-north star) exceed a single chip.
+Transfer discipline matches the single-chip path (sched/core.py): the host
+ships the FACTORED batch — policy tables [P,C]/[W,C] column-sharded, per-row
+indices row-sharded, sparse prev/eviction entries, a tie seed — and each
+device decompresses its (B_local, C_local) tile on device. Host→device per
+round is O(B·K + P·C), never O(B·C); device→host is the compact top-K
+outputs. (Round-1 fed dense host-materialized [B,C] tensors here, which
+recreated exactly the transfer wall the factored encoding removes.)
 
-Everything here compiles under `jit` on N virtual CPU devices too
+Everything compiles under `jit` on N virtual CPU devices too
 (xla_force_host_platform_device_count) — see __graft_entry__.dryrun_multichip.
 """
 from __future__ import annotations
 
-from functools import partial
 from typing import Optional, Sequence
 
 import jax
 import jax.numpy as jnp
 import numpy as np
-from jax.sharding import Mesh, PartitionSpec as P
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from ..models.batch import AGGREGATED, BindingBatch, DUPLICATED, DYNAMIC_WEIGHT, STATIC_WEIGHT
+from ..models.batch import BindingBatch
 from ..models.fleet import FleetArrays
-from ..ops import assign as assign_ops
-from ..ops import filters as filter_ops
 
 AXIS_BINDINGS = "bindings"
 AXIS_CLUSTERS = "clusters"
@@ -58,7 +59,7 @@ def make_mesh(devices: Optional[Sequence] = None) -> Mesh:
     return Mesh(np.array(devices).reshape(b, c), (AXIS_BINDINGS, AXIS_CLUSTERS))
 
 
-# in_specs in the exact positional order of sched.core._schedule_kernel
+# in_specs in the exact positional order of sched.core._schedule_kernel_compact
 _FLEET_SPECS = (
     P(AXIS_CLUSTERS),        # alive
     P(AXIS_CLUSTERS, None),  # capacity
@@ -79,85 +80,92 @@ _BATCH_SPECS = (
     P(AXIS_BINDINGS, None),  # tol_value
     P(AXIS_BINDINGS, None),  # tol_effect
     P(AXIS_BINDINGS, None),  # tol_op
-    P(AXIS_BINDINGS, AXIS_CLUSTERS),  # affinity_ok
-    P(AXIS_BINDINGS, AXIS_CLUSTERS),  # eviction_ok
-    P(AXIS_BINDINGS, AXIS_CLUSTERS),  # static_weight
-    P(AXIS_BINDINGS, AXIS_CLUSTERS),  # prev_member
-    P(AXIS_BINDINGS, AXIS_CLUSTERS),  # prev_replicas
-    P(AXIS_BINDINGS, AXIS_CLUSTERS),  # tie
-    P(AXIS_BINDINGS, AXIS_CLUSTERS),  # extra_avail
+    P(None, AXIS_CLUSTERS),  # aff_masks   [P,C] policy table, column-sharded
+    P(AXIS_BINDINGS),        # aff_idx
+    P(None, AXIS_CLUSTERS),  # weight_tables [W,C]
+    P(AXIS_BINDINGS),        # weight_idx
+    P(AXIS_BINDINGS, None),  # prev_idx (global column ids)
+    P(AXIS_BINDINGS, None),  # prev_rep
+    P(AXIS_BINDINGS, None),  # evict_idx
+    P(AXIS_BINDINGS),        # seeds
 )
 _OUT_SPECS = (
-    P(AXIS_BINDINGS, None),  # feasible
+    P(AXIS_BINDINGS, None),  # feasible (full rows, replicated over clusters axis)
     P(AXIS_BINDINGS, None),  # score
     P(AXIS_BINDINGS, None),  # result
     P(AXIS_BINDINGS),        # unschedulable
     P(AXIS_BINDINGS),        # available_sum
     P(AXIS_BINDINGS, None),  # avail
+    P(AXIS_BINDINGS),        # feas_count
+    P(AXIS_BINDINGS),        # nnz
+    P(AXIS_BINDINGS, None),  # top_idx
+    P(AXIS_BINDINGS, None),  # top_val
 )
 
 
-def _sharded_body(
-    alive, capacity, has_summary, taint_key, taint_value, taint_effect, api_ok,
-    replicas, request, unknown_request, gvk, strategy, fresh,
-    tol_key, tol_value, tol_effect, tol_op,
-    affinity_ok, eviction_ok, static_weight, prev_member, prev_replicas, tie,
-    extra_avail,
-):
-    # ---- local phase: elementwise over (B_local, C_local) ----
-    taint_mask = filter_ops.taint_toleration_mask(
-        taint_key, taint_value, taint_effect, tol_key, tol_value, tol_effect, tol_op
-    )
-    api_mask = filter_ops.api_enablement_mask(api_ok, gvk)
-    feasible_l = filter_ops.feasible_mask(
-        alive, api_mask, taint_mask, jnp.ones_like(affinity_ok), affinity_ok, eviction_ok
-    )
-    score_l = filter_ops.locality_score(prev_member)
-    avail_l = assign_ops.general_estimate(capacity, has_summary, request, replicas)
-    avail_l = jnp.where(unknown_request[:, None], 0, avail_l)
-    avail_l = jnp.where(extra_avail >= 0, jnp.minimum(avail_l, extra_avail), avail_l)
+def _sharded_body(topk: int):
+    def body(
+        alive, capacity, has_summary, taint_key, taint_value, taint_effect, api_ok,
+        replicas, request, unknown_request, gvk, strategy, fresh,
+        tol_key, tol_value, tol_effect, tol_op,
+        aff_masks, aff_idx, weight_tables, weight_idx,
+        prev_idx, prev_rep, evict_idx, seeds,
+        extra_avail,
+    ):
+        # shares the single-chip kernel's phases (sched/core.py): decompress →
+        # filter/estimate on the local tile → all_gather → assignment tail
+        from ..sched.core import (
+            assignment_tail,
+            compact_outputs,
+            decompress_batch,
+            filter_estimate_phase,
+        )
 
-    # ---- gather the cluster shards: the division solve is a per-row
-    # sort/cumsum over the FULL fleet (binding.go:112-144). One tiled
-    # all_gather over ICI reconstructs the global rows. ----
-    def gcols(x):
-        return jax.lax.all_gather(x, AXIS_CLUSTERS, axis=1, tiled=True)
+        C_l = alive.shape[0]
+        c0 = jax.lax.axis_index(AXIS_CLUSTERS).astype(jnp.int32) * C_l
 
-    feasible = gcols(feasible_l)
-    score = gcols(score_l)
-    avail = gcols(avail_l)
-    static_w = gcols(static_weight)
-    prev_m = gcols(prev_member)
-    prev_r = gcols(prev_replicas)
-    tie_g = gcols(tie)
+        affinity_ok, static_weight, prev_member, prev_replicas, eviction_ok, tie = (
+            decompress_batch(
+                aff_masks, aff_idx, weight_tables, weight_idx,
+                prev_idx, prev_rep, evict_idx, seeds, C_l, col_offset=c0,
+            )
+        )
+        feasible_l, score_l, avail_l = filter_estimate_phase(
+            alive, capacity, has_summary, taint_key, taint_value, taint_effect,
+            api_ok,
+            replicas, request, unknown_request, gvk,
+            tol_key, tol_value, tol_effect, tol_op,
+            affinity_ok, eviction_ok, prev_member,
+        )
 
-    dup = assign_ops.duplicated_assign(feasible, replicas)
-    static = assign_ops.static_weight_assign(feasible, static_w, prev_r, tie_g, replicas)
-    dyn = assign_ops.dynamic_assign(
-        feasible, avail, prev_r, tie_g, replicas, fresh, strategy == AGGREGATED
-    )
+        # ---- gather the cluster shards: the division solve is a per-row
+        # sort/cumsum over the FULL fleet (binding.go:112-144). One tiled
+        # all_gather over ICI reconstructs the global rows. ----
+        def gcols(x):
+            return jax.lax.all_gather(x, AXIS_CLUSTERS, axis=1, tiled=True)
 
-    result = jnp.zeros_like(dup)
-    result = jnp.where((strategy == DUPLICATED)[:, None], dup, result)
-    result = jnp.where((strategy == STATIC_WEIGHT)[:, None], static, result)
-    is_dyn = (strategy == DYNAMIC_WEIGHT) | (strategy == AGGREGATED)
-    result = jnp.where(is_dyn[:, None], dyn.result, result)
-    unschedulable = is_dyn & dyn.unschedulable
-    return feasible, score, result, unschedulable, dyn.available_sum, avail
+        feasible = gcols(feasible_l)
+        score = gcols(score_l)
+        avail = gcols(avail_l)
+        static_w = gcols(static_weight)
+        prev_r = gcols(prev_replicas)
+        tie_g = gcols(tie)
 
+        # registered-estimator min-merge (row-sharded dense [B_l, C] or the
+        # replicated [1,1] no-estimator sentinel)
+        extra = jnp.broadcast_to(extra_avail, avail.shape)
+        avail = jnp.where(extra >= 0, jnp.minimum(avail, extra), avail)
 
-def build_sharded_kernel(mesh: Mesh):
-    """jit(shard_map(schedule kernel)) over the given mesh. Same positional
-    signature and outputs as sched.core._schedule_kernel; inputs may be plain
-    numpy arrays (jit shards them per in_specs)."""
-    fn = jax.shard_map(
-        _sharded_body,
-        mesh=mesh,
-        in_specs=_FLEET_SPECS + _BATCH_SPECS,
-        out_specs=_OUT_SPECS,
-        check_vma=False,
-    )
-    return jax.jit(fn)
+        result, unschedulable, avail_sum = assignment_tail(
+            feasible, strategy, static_w, avail, prev_r, tie_g, replicas, fresh
+        )
+        feas_count, nnz, top_idx, top_val = compact_outputs(feasible, result, topk)
+        return (
+            feasible, score, result, unschedulable, avail_sum, avail,
+            feas_count, nnz, top_idx, top_val,
+        )
+
+    return body
 
 
 def _pad_axis(a: np.ndarray, axis: int, to: int, fill=0) -> np.ndarray:
@@ -174,51 +182,103 @@ def _round_up(n: int, mult: int) -> int:
 
 
 class MeshScheduleKernel:
-    """Host wrapper: pads fleet/batch axes to mesh-divisible sizes (padded
-    clusters are dead — alive=False ⇒ infeasible; padded bindings are
-    NON_WORKLOAD rows) and trims outputs back."""
+    """Drop-in replacement for ArrayScheduler.run_kernel over a device mesh.
 
-    def __init__(self, mesh: Mesh):
+    Holds the fleet column-sharded and device-resident across rounds (same
+    persistent-snapshot discipline as the single-chip path); each call ships
+    only the factored batch and returns the compact 10-output tuple of
+    sched.core._schedule_kernel_compact (dense tensors stay on device until
+    the host decode actually fetches them).
+
+    Padded clusters are dead (alive=False ⇒ infeasible); padded binding rows
+    are NON_WORKLOAD rows the decode never reads."""
+
+    def __init__(self, mesh: Mesh, fleet: Optional[FleetArrays] = None):
         self.mesh = mesh
-        self.kernel = build_sharded_kernel(mesh)
         self.mesh_b = mesh.shape[AXIS_BINDINGS]
         self.mesh_c = mesh.shape[AXIS_CLUSTERS]
+        from ..sched.core import TOPK_TARGETS
 
-    def __call__(self, fleet: FleetArrays, batch: BindingBatch, extra_avail=None):
-        B = len(batch.replicas)
+        self._topk = TOPK_TARGETS
+        self._kernels: dict[int, object] = {}
+        self._fleet_dev = None
+        self.n_clusters = 0
+        if fleet is not None:
+            self.set_fleet(fleet)
+
+    def _kernel(self, topk: int, dense_extra: bool):
+        key = (topk, dense_extra)
+        fn = self._kernels.get(key)
+        if fn is None:
+            extra_spec = P(AXIS_BINDINGS, None) if dense_extra else P(None, None)
+            fn = jax.jit(
+                jax.shard_map(
+                    _sharded_body(topk),
+                    mesh=self.mesh,
+                    in_specs=_FLEET_SPECS + _BATCH_SPECS + (extra_spec,),
+                    out_specs=_OUT_SPECS,
+                    check_vma=False,
+                )
+            )
+            self._kernels[key] = fn
+        return fn
+
+    def set_fleet(self, fleet: FleetArrays) -> None:
+        """Pad the cluster axis to a mesh-divisible size and place the fleet
+        sharded on device once (re-placed only on cluster-set change)."""
         C = fleet.alive.shape[0]
+        self.n_clusters = C
+        self.padded_clusters = _round_up(max(C, self.mesh_c), self.mesh_c)
+
+        def fb(a, spec):
+            return jax.device_put(
+                _pad_axis(a, 0, self.padded_clusters),
+                NamedSharding(self.mesh, spec),
+            )
+
+        self._fleet_dev = (
+            fb(fleet.alive, P(AXIS_CLUSTERS)),
+            fb(fleet.capacity, P(AXIS_CLUSTERS, None)),
+            fb(fleet.has_summary, P(AXIS_CLUSTERS)),
+            fb(fleet.taint_key, P(AXIS_CLUSTERS, None)),
+            fb(fleet.taint_value, P(AXIS_CLUSTERS, None)),
+            fb(fleet.taint_effect, P(AXIS_CLUSTERS, None)),
+            fb(fleet.api_ok, P(AXIS_CLUSTERS, None)),
+        )
+
+    _NO_EXTRA = np.full((1, 1), -1, np.int32)
+
+    def __call__(self, batch: BindingBatch, extra_avail=None):
+        if self._fleet_dev is None:
+            raise RuntimeError("set_fleet() before scheduling")
+        B = len(batch.replicas)
         Bp = _round_up(max(B, self.mesh_b), self.mesh_b)
-        Cp = _round_up(max(C, self.mesh_c), self.mesh_c)
-        if extra_avail is None:
-            extra_avail = np.full((B, C), -1, np.int32)
+        Cp = self.padded_clusters
 
-        def fb(a):  # fleet array: pad cluster axis 0
-            return _pad_axis(a, 0, Cp)
-
-        def bb(a):  # batch array: pad binding axis 0
+        def bb(a):  # [B,...] row-sharded arrays: pad binding axis
             return _pad_axis(a, 0, Bp)
 
-        def bc(a):  # [B,C] matrix: pad both
-            return _pad_axis(_pad_axis(a, 0, Bp), 1, Cp)
+        def tbl(a):  # policy tables: pad the cluster axis
+            return _pad_axis(a, 1, Cp)
 
-        out = self.kernel(
-            fb(fleet.alive), fb(fleet.capacity), fb(fleet.has_summary),
-            fb(fleet.taint_key), fb(fleet.taint_value), fb(fleet.taint_effect),
-            fb(fleet.api_ok),
+        if extra_avail is None or extra_avail.shape == (1, 1):
+            extra, dense_extra = self._NO_EXTRA, False
+        else:
+            # registered-estimator answers are per-row: ship them row-sharded
+            extra = _pad_axis(_pad_axis(extra_avail, 0, Bp, fill=-1), 1, Cp, fill=-1)
+            dense_extra = True
+        return self._kernel(min(Cp, self._topk), dense_extra)(
+            *self._fleet_dev,
             bb(batch.replicas), bb(batch.request), bb(batch.unknown_request),
             bb(batch.gvk), bb(batch.strategy), bb(batch.fresh),
             bb(batch.tol_key), bb(batch.tol_value), bb(batch.tol_effect),
             bb(batch.tol_op),
-            bc(batch.affinity_ok), bc(batch.eviction_ok), bc(batch.static_weight),
-            bc(batch.prev_member), bc(batch.prev_replicas), bc(batch.tie),
-            _pad_axis(_pad_axis(extra_avail, 0, Bp), 1, Cp, fill=-1),
-        )
-        feasible, score, result, unsched, avail_sum, avail = (np.asarray(x) for x in out)
-        return (
-            feasible[:B, :C],
-            score[:B, :C],
-            result[:B, :C],
-            unsched[:B],
-            avail_sum[:B],
-            avail[:B, :C],
+            tbl(batch.aff_masks), bb(batch.aff_idx),
+            tbl(batch.weight_tables), bb(batch.weight_idx),
+            # padded rows carry the global drop sentinel, not column 0
+            _pad_axis(batch.prev_idx, 0, Bp, fill=Cp),
+            bb(batch.prev_rep),
+            _pad_axis(batch.evict_idx, 0, Bp, fill=Cp),
+            bb(batch.seeds),
+            extra,
         )

@@ -55,6 +55,62 @@ class ScheduleDecision:
         return not self.error
 
 
+def filter_estimate_phase(
+    alive, capacity, has_summary, taint_key, taint_value, taint_effect, api_ok,
+    replicas, request, unknown_request, gvk,
+    tol_key, tol_value, tol_effect, tol_op,
+    affinity_ok, eviction_ok, prev_member,
+):
+    """Filters + score + GeneralEstimator — elementwise over (B, C), so the
+    mesh path runs it on local (B_l, C_l) tiles before any collective.
+
+    Requests naming resources outside the encoded vocabulary behave like a
+    missing allocatable key: 0 available everywhere (general.go:166-169)."""
+    taint_mask = filter_ops.taint_toleration_mask(
+        taint_key, taint_value, taint_effect, tol_key, tol_value, tol_effect, tol_op
+    )
+    api_mask = filter_ops.api_enablement_mask(api_ok, gvk)
+    feasible = filter_ops.feasible_mask(
+        alive, api_mask, taint_mask, jnp.ones_like(affinity_ok), affinity_ok, eviction_ok
+    )
+    score = filter_ops.locality_score(prev_member)
+    avail = assign_ops.general_estimate(capacity, has_summary, request, replicas)
+    avail = jnp.where(unknown_request[:, None], 0, avail)
+    return feasible, score, avail
+
+
+def assignment_tail(
+    feasible, strategy, static_weight, avail, prev_replicas, tie, replicas, fresh
+):
+    """Strategy dispatch + division over FULL fleet rows (the phase that needs
+    every cluster column: per-row sort/cumsum, binding.go:112-144). Static +
+    dynamic rows share one dispenser pass (row-disjoint — combined_assign
+    halves the [B,C] sort work)."""
+    dup = assign_ops.duplicated_assign(feasible, replicas)
+    is_static = strategy == STATIC_WEIGHT
+    is_dyn = (strategy == DYNAMIC_WEIGHT) | (strategy == AGGREGATED)
+    sd = assign_ops.combined_assign(
+        feasible, is_static, is_dyn, strategy == AGGREGATED,
+        static_weight, avail, prev_replicas, tie, replicas, fresh,
+    )
+    result = jnp.zeros_like(dup)
+    result = jnp.where((strategy == DUPLICATED)[:, None], dup, result)
+    result = jnp.where((is_static | is_dyn)[:, None], sd.result, result)
+    unschedulable = is_dyn & sd.unschedulable
+    return result, unschedulable, sd.available_sum
+
+
+def compact_outputs(feasible, result, topk: int):
+    """Top-K sparsification of the decision tensor: the per-binding target
+    list is almost always far smaller than C, so the round's device→host
+    transfer drops from O(B·C) to O(B·K); rows whose nonzero count exceeds K
+    fall back to a dense row fetch on host."""
+    top_val, top_idx = jax.lax.top_k(result, topk)
+    nnz = (result > 0).sum(-1).astype(jnp.int32)
+    feas_count = feasible.sum(-1).astype(jnp.int32)
+    return feas_count, nnz, top_idx.astype(jnp.int32), top_val
+
+
 def _schedule_body(
     # fleet
     alive,
@@ -83,39 +139,19 @@ def _schedule_body(
     tie,
     extra_avail,  # i32[B,C] min-merged registered-estimator answers; -1 = none
 ):
-    taint_mask = filter_ops.taint_toleration_mask(
-        taint_key, taint_value, taint_effect, tol_key, tol_value, tol_effect, tol_op
+    feasible, score, avail = filter_estimate_phase(
+        alive, capacity, has_summary, taint_key, taint_value, taint_effect, api_ok,
+        replicas, request, unknown_request, gvk,
+        tol_key, tol_value, tol_effect, tol_op,
+        affinity_ok, eviction_ok, prev_member,
     )
-    api_mask = filter_ops.api_enablement_mask(api_ok, gvk)
-    feasible = filter_ops.feasible_mask(
-        alive, api_mask, taint_mask, jnp.ones_like(affinity_ok), affinity_ok, eviction_ok
-    )
-    score = filter_ops.locality_score(prev_member)
-
-    # Estimation (GeneralEstimator path; additional estimators min-merge in).
-    # Requests naming resources outside the encoded vocabulary behave like a
-    # missing allocatable key: 0 available everywhere (general.go:166-169).
-    avail = assign_ops.general_estimate(capacity, has_summary, request, replicas)
-    avail = jnp.where(unknown_request[:, None], 0, avail)
     # min-merge with registered estimators (-1 sentinel discarded,
     # core/util.go:72-92); gRPC/node-level answers tighten the general bound
     avail = jnp.where(extra_avail >= 0, jnp.minimum(avail, extra_avail), avail)
-
-    # All strategies batched; static + dynamic rows share one dispenser pass
-    # (they are row-disjoint — combined_assign halves the [B,C] sort work).
-    dup = assign_ops.duplicated_assign(feasible, replicas)
-    is_static = strategy == STATIC_WEIGHT
-    is_dyn = (strategy == DYNAMIC_WEIGHT) | (strategy == AGGREGATED)
-    sd = assign_ops.combined_assign(
-        feasible, is_static, is_dyn, strategy == AGGREGATED,
-        static_weight, avail, prev_replicas, tie, replicas, fresh,
+    result, unschedulable, avail_sum = assignment_tail(
+        feasible, strategy, static_weight, avail, prev_replicas, tie, replicas, fresh
     )
-
-    result = jnp.zeros_like(dup)
-    result = jnp.where((strategy == DUPLICATED)[:, None], dup, result)
-    result = jnp.where((is_static | is_dyn)[:, None], sd.result, result)
-    unschedulable = is_dyn & sd.unschedulable
-    return feasible, score, result, unschedulable, sd.available_sum, avail
+    return feasible, score, result, unschedulable, avail_sum, avail
 
 
 @partial(jax.jit, static_argnames=())
@@ -136,16 +172,53 @@ def _schedule_kernel(
     )
 
 
-def _device_tie(seeds, n_clusters):
+def _device_tie(seeds, n_clusters, offset=0):
     """splitmix64 tie-break expanded on device — bit-identical to
     models.batch.tie_matrix (the deterministic stand-in for the reference's
-    crypto-rand tie-break, binding.go:74-79)."""
-    idx = jnp.arange(1, n_clusters + 1, dtype=jnp.uint64)[None, :]
+    crypto-rand tie-break, binding.go:74-79). `offset` shifts the cluster
+    index range for column-sharded callers (parallel/mesh.py) so every shard
+    reproduces its slice of the global tie matrix."""
+    idx = (
+        jnp.asarray(offset).astype(jnp.uint64)
+        + jnp.arange(1, n_clusters + 1, dtype=jnp.uint64)
+    )[None, :]
     x = seeds[:, None] ^ idx
     x = (x ^ (x >> jnp.uint64(30))) * jnp.uint64(0xBF58476D1CE4E5B9)
     x = (x ^ (x >> jnp.uint64(27))) * jnp.uint64(0x94D049BB133111EB)
     x = x ^ (x >> jnp.uint64(31))
     return (x >> jnp.uint64(33)).astype(jnp.int32)
+
+
+def decompress_batch(
+    aff_masks, aff_idx, weight_tables, weight_idx,
+    prev_idx, prev_rep, evict_idx, seeds,
+    n_cols: int, col_offset=0,
+):
+    """Reconstruct the [B, n_cols] tile of the factored batch ON DEVICE
+    (gathers + scatters over local HBM — host→device stays O(B·K + P·C)).
+
+    `col_offset` is the global index of this tile's first cluster column:
+    0 on the single-chip path; the shard's offset under the mesh (sparse
+    prev/eviction entries carry GLOBAL column ids and the tie matrix is
+    defined over global indices, so every shard reproduces exactly its slice
+    of the dense tensors)."""
+    B = aff_idx.shape[0]
+    rows = jnp.arange(B)[:, None]
+    affinity_ok = aff_masks[aff_idx]
+    static_weight = weight_tables[weight_idx]
+    # translate global → local column ids; everything out of this tile's
+    # range (including the encoder's drop sentinel) lands on n_cols → dropped
+    p = prev_idx - col_offset
+    p = jnp.where((p >= 0) & (p < n_cols), p, n_cols)
+    prev_member = jnp.zeros((B, n_cols), bool).at[rows, p].set(True, mode="drop")
+    prev_replicas = (
+        jnp.zeros((B, n_cols), jnp.int32).at[rows, p].set(prev_rep, mode="drop")
+    )
+    e = evict_idx - col_offset
+    e = jnp.where((e >= 0) & (e < n_cols), e, n_cols)
+    eviction_ok = jnp.ones((B, n_cols), bool).at[rows, e].set(False, mode="drop")
+    tie = _device_tie(seeds, n_cols, offset=col_offset)
+    return affinity_ok, static_weight, prev_member, prev_replicas, eviction_ok, tie
 
 
 @partial(jax.jit, static_argnames=())
@@ -160,20 +233,15 @@ def _schedule_kernel_compact(
     prev_idx, prev_rep, evict_idx, seeds,
     extra_avail,  # i32[B,C] or broadcastable [1,1] sentinel
 ):
-    """Decompress the factored batch ON DEVICE (gathers + scatters over ICI-
-    free local HBM), then run the solve. Host→device transfer is O(B·K+P·C)."""
+    """Decompress the factored batch on device, then run the solve."""
     B = replicas.shape[0]
     C = alive.shape[0]
-    rows = jnp.arange(B)[:, None]
-    affinity_ok = aff_masks[aff_idx]
-    static_weight = weight_tables[weight_idx]
-    # sparse scatters; padded entries carry index C → dropped
-    prev_member = jnp.zeros((B, C), bool).at[rows, prev_idx].set(True, mode="drop")
-    prev_replicas = (
-        jnp.zeros((B, C), jnp.int32).at[rows, prev_idx].set(prev_rep, mode="drop")
+    affinity_ok, static_weight, prev_member, prev_replicas, eviction_ok, tie = (
+        decompress_batch(
+            aff_masks, aff_idx, weight_tables, weight_idx,
+            prev_idx, prev_rep, evict_idx, seeds, C,
+        )
     )
-    eviction_ok = jnp.ones((B, C), bool).at[rows, evict_idx].set(False, mode="drop")
-    tie = _device_tie(seeds, C)
     extra = jnp.broadcast_to(extra_avail, (B, C))
     feasible, score, result, unschedulable, avail_sum, avail = _schedule_body(
         alive, capacity, has_summary, taint_key, taint_value, taint_effect, api_ok,
@@ -182,19 +250,12 @@ def _schedule_kernel_compact(
         affinity_ok, eviction_ok, static_weight, prev_member, prev_replicas, tie,
         extra,
     )
-    # Compact outputs: the per-binding target list is almost always far
-    # smaller than C (bounded by spec.replicas for divided rows, by the
-    # affinity size for duplicated rows). top-K sparsification turns the
-    # round's device→host transfer from O(B·C) into O(B·K); rows whose
-    # nonzero count exceeds K (rare: Duplicated over a huge candidate set)
-    # fall back to a dense row fetch on host.
-    K = min(C, TOPK_TARGETS)
-    top_val, top_idx = jax.lax.top_k(result, K)
-    nnz = (result > 0).sum(-1).astype(jnp.int32)
-    feas_count = feasible.sum(-1).astype(jnp.int32)
+    feas_count, nnz, top_idx, top_val = compact_outputs(
+        feasible, result, min(C, TOPK_TARGETS)
+    )
     return (
         feasible, score, result, unschedulable, avail_sum, avail,
-        feas_count, nnz, top_idx.astype(jnp.int32), top_val,
+        feas_count, nnz, top_idx, top_val,
     )
 
 
@@ -237,8 +298,17 @@ class ArrayScheduler:
     TargetClusters. Batch sizes are padded to power-of-two buckets to bound
     the jit cache (SURVEY §7 dynamic-shapes note)."""
 
-    def __init__(self, clusters: Sequence, encoder: Optional[FleetEncoder] = None):
+    def __init__(
+        self,
+        clusters: Sequence,
+        encoder: Optional[FleetEncoder] = None,
+        mesh=None,
+    ):
+        """`mesh`: optional jax.sharding.Mesh — the solve runs column/row-
+        sharded over it (parallel/mesh.py) with identical outputs."""
         self.encoder = encoder or FleetEncoder()
+        self.mesh = mesh
+        self._mesh_kernel = None
         self.set_clusters(clusters)
 
     def set_clusters(self, clusters: Sequence) -> None:
@@ -248,6 +318,14 @@ class ArrayScheduler:
         # fleet tensors live on device across rounds (the persistent snapshot
         # that replaces the reference's per-attempt deep copy, cache.go:62-77);
         # re-transferred only on cluster-set change
+        if self.mesh is not None:
+            from ..parallel.mesh import MeshScheduleKernel
+
+            if self._mesh_kernel is None:
+                self._mesh_kernel = MeshScheduleKernel(self.mesh)
+            self._mesh_kernel.set_fleet(self.fleet)
+            self._fleet_dev = None
+            return
         f = self.fleet
         self._fleet_dev = tuple(
             jax.device_put(x)
@@ -308,6 +386,8 @@ class ArrayScheduler:
     _NO_EXTRA = np.full((1, 1), -1, np.int32)  # broadcast sentinel
 
     def run_kernel(self, batch: BindingBatch, extra_avail=None):
+        if self._mesh_kernel is not None:
+            return self._mesh_kernel(batch, extra_avail)
         if extra_avail is None:
             extra_avail = self._NO_EXTRA
         return _schedule_kernel_compact(

@@ -109,6 +109,32 @@ class TestSandbox:
                     "replica_resource",
                 )
 
+    def test_module_level_loop_hits_execution_limit(self):
+        # top-level statements run under the same budget at compile/exec time
+        with pytest.raises(ScriptError, match="execution limit"):
+            compile_script(
+                "n = 0\n"
+                "while True:\n"
+                "    n += 1\n"
+                "def GetReplicas(obj):\n"
+                "    return 1, {}\n",
+                "replica_resource",
+            )
+
+    def test_try_finally_denied(self):
+        # finally runs after the limit tracer fired (tracing unset) and would
+        # be unbounded — denied at compile time
+        with pytest.raises(ScriptError, match="finally"):
+            compile_script(
+                "def GetReplicas(obj):\n"
+                "    try:\n"
+                "        x = 1\n"
+                "    finally:\n"
+                "        x = 2\n"
+                "    return x, {}\n",
+                "replica_resource",
+            )
+
     def test_infinite_loop_hits_execution_limit(self):
         fn = compile_script(
             "def GetReplicas(obj):\n"

@@ -97,40 +97,106 @@ def fleet_and_bindings():
 
 
 def test_sharded_kernel_matches_single_device(fleet_and_bindings):
+    """The mesh kernel consumes the same FACTORED batch as the single-chip
+    compact kernel (host→device O(B·K+P·C)) and must reproduce every output —
+    dense tensors, compact top-K window, counts — bit-identically on the
+    ragged 13-cluster / 11-binding shapes."""
     clusters, bindings = fleet_and_bindings
     sched = ArrayScheduler(clusters)
-    raw = sched.batch_encoder.encode(bindings)
-    ref = tuple(np.asarray(x) for x in sched.run_kernel(sched._pad(raw)))
-    B = raw.size
+    padded = sched._pad(sched.batch_encoder.encode(bindings))
+    ref = tuple(np.asarray(x) for x in sched.run_kernel(padded))
+    B = len(padded.replicas)
+    C = len(sched.fleet.names)
 
     mesh = make_mesh(jax.devices())
     assert mesh.devices.size == 8
-    mk = MeshScheduleKernel(mesh)
-    got = mk(sched.fleet, raw)
+    mk = MeshScheduleKernel(mesh, sched.fleet)
+    got = tuple(np.asarray(x) for x in mk(padded))
 
-    for r, g, name in zip(
-        ref, got, ["feasible", "score", "result", "unsched", "avail_sum", "avail"]
-    ):
-        r = r[:B]  # single-device path padded B; mesh wrapper trims
+    names = [
+        "feasible", "score", "result", "unsched", "avail_sum", "avail",
+        "feas_count", "nnz", "top_idx", "top_val",
+    ]
+    for r, g, name in zip(ref, got, names):
+        g = g[:B]  # mesh pads rows to a mesh-divisible size
+        if g.ndim == 2 and name not in ("top_idx", "top_val"):
+            g = g[:, :C]  # and the cluster axis
+        if name == "top_idx":
+            # equal top-K windows may order ties differently across backends;
+            # compare as (idx, val) sets over the nonzero entries instead
+            continue
+        if name == "top_val":
+            for b in range(B):
+                n = int(ref[7][b])
+                ref_pairs = {
+                    (int(ref[8][b, k]), int(ref[9][b, k])) for k in range(n)
+                }
+                got_pairs = {
+                    (int(got[8][b, k]), int(got[9][b, k])) for k in range(n)
+                }
+                assert ref_pairs == got_pairs, f"top-K window row {b}"
+            continue
         np.testing.assert_array_equal(r, g, err_msg=name)
 
 
 def test_sharded_end_to_end_decisions(fleet_and_bindings):
-    """ArrayScheduler decisions recomputed through the mesh kernel agree on
-    final target assignments."""
+    """Full ArrayScheduler.schedule() through the mesh kernel — including the
+    compact decode and the spread re-run plumbing — must produce identical
+    decisions to the single-device scheduler."""
     clusters, bindings = fleet_and_bindings
     sched = ArrayScheduler(clusters)
     decisions = sched.schedule(bindings)
 
-    mesh = make_mesh(jax.devices())
-    mk = MeshScheduleKernel(mesh)
-    raw = sched.batch_encoder.encode(bindings)
-    _, _, result, unsched, _, _ = mk(sched.fleet, raw)
+    mesh_sched = ArrayScheduler(clusters, mesh=make_mesh(jax.devices()))
+    mesh_decisions = mesh_sched.schedule(bindings)
 
-    for b, dec in enumerate(decisions):
+    assert len(decisions) == len(mesh_decisions)
+    for dec, mdec in zip(decisions, mesh_decisions):
         assert dec.ok, dec.error
-        got = {
-            sched.fleet.names[i]: int(result[b, i])
-            for i in np.nonzero(result[b] > 0)[0]
+        assert mdec.ok, mdec.error
+        assert {t.name: t.replicas for t in dec.targets} == {
+            t.name: t.replicas for t in mdec.targets
         }
-        assert got == {t.name: t.replicas for t in dec.targets}
+
+
+def test_mesh_with_registered_estimator_extra(fleet_and_bindings):
+    """Dense extra_avail (registered-estimator min-merge input) must ride the
+    mesh row-sharded and reproduce the single-device result."""
+    clusters, bindings = fleet_and_bindings
+    sched = ArrayScheduler(clusters)
+    B, C = len(bindings), len(clusters)
+    rng = np.random.default_rng(5)
+    extra = rng.integers(-1, 7, size=(B, C)).astype(np.int32)
+
+    ref = sched.schedule(bindings, extra_avail=extra)
+    mesh_sched = ArrayScheduler(clusters, mesh=make_mesh(jax.devices()))
+    got = mesh_sched.schedule(bindings, extra_avail=extra)
+
+    for dec, mdec in zip(ref, got):
+        assert dec.ok == mdec.ok
+        assert dec.error == mdec.error
+        if dec.ok:
+            assert {t.name: t.replicas for t in dec.targets} == {
+                t.name: t.replicas for t in mdec.targets
+            }
+
+
+def test_mesh_scheduler_spread_and_infeasible(fleet_and_bindings):
+    """Rows that are unschedulable single-device must be unschedulable on the
+    mesh too (error strings included)."""
+    clusters, _ = fleet_and_bindings
+    names = [c.name for c in clusters]
+    bindings = [
+        make_binding("fit", 4, dyn_placement(), cpu=0.5),
+        make_binding("too-big", 10_000_000, dyn_placement(), cpu=16.0),
+        make_binding("nowhere", 2, duplicated_placement(["no-such-cluster"])),
+    ]
+    sched = ArrayScheduler(clusters)
+    mesh_sched = ArrayScheduler(clusters, mesh=make_mesh(jax.devices()))
+    for dec, mdec in zip(sched.schedule(bindings), mesh_sched.schedule(bindings)):
+        assert dec.ok == mdec.ok
+        assert dec.error == mdec.error
+        if dec.ok:
+            assert {t.name: t.replicas for t in dec.targets} == {
+                t.name: t.replicas for t in mdec.targets
+            }
