@@ -94,7 +94,7 @@ def _check_ast(tree: ast.AST) -> None:
             raise ScriptError(f"{node.id!r} is not allowed in interpreter scripts")
         if isinstance(node, (ast.Global, ast.Nonlocal)):
             raise ScriptError("global/nonlocal are not allowed")
-        if isinstance(node, ast.Try) and node.finalbody:
+        if isinstance(node, (ast.Try, ast.TryStar)) and node.finalbody:
             # a finally block runs AFTER the limit tracer raised (tracing is
             # already unset), so code inside it would be unbounded
             raise ScriptError("try/finally is not allowed in interpreter scripts")

@@ -315,6 +315,19 @@ class ArrayScheduler:
         self.clusters = list(clusters)
         self.fleet: FleetArrays = self.encoder.encode(self.clusters)
         self.batch_encoder = BatchEncoder(self.encoder, self.fleet, self.clusters)
+        # spread-selection fast-path encodings (sched/spread.py array API):
+        # cluster-name ascending ranks (sortClusters tie-break) and region ids
+        C = len(self.clusters)
+        self._name_rank = np.empty(C, np.int32)
+        self._name_rank[np.argsort(np.array(self.fleet.names))] = np.arange(C)
+        region_ids: dict[str, int] = {}
+        self._region_id = np.full(C, -1, np.int32)
+        for i, c in enumerate(self.clusters):
+            region = c.spec.region
+            if region:
+                rid = region_ids.setdefault(region, len(region_ids))
+                self._region_id[i] = rid
+        self._region_names = list(region_ids)
         # fleet tensors live on device across rounds (the persistent snapshot
         # that replaces the reference's per-attempt deep copy, cache.go:62-77);
         # re-transferred only on cluster-set change
@@ -522,27 +535,27 @@ class ArrayScheduler:
             live_rows = []
             for b in spread_rows:
                 rb = bindings[b]
-                details = [
-                    spread_mod.ClusterDetail(
-                        name=self.fleet.names[i],
-                        index=int(i),
-                        score=int(score[b, i]),
-                        available=int(avail[b, i]) + int(prev_dense[b, i]),
-                        region=self.clusters[i].spec.region,
-                        zone=self.clusters[i].spec.zone,
-                        provider=self.clusters[i].spec.provider,
-                    )
-                    for i in np.nonzero(feasible[b])[0]
-                ]
+                # array fast path: per-row lexsort + cumsum group scoring over
+                # the kernel's rows — no per-cluster Python objects
+                # (group_clusters.go:88-330 semantics, parity-tested against
+                # the ClusterDetail implementation)
+                feas = np.nonzero(feasible[b])[0]
                 try:
-                    selected = spread_mod.select_clusters_by_spread(
-                        details, rb.spec.placement, rb.spec.replicas
+                    selected_idx = spread_mod.select_by_spread_arrays(
+                        feas,
+                        score[b, feas],
+                        avail[b, feas].astype(np.int64) + prev_dense[b, feas],
+                        self._name_rank[feas],
+                        self._region_id[feas],
+                        self._region_names,
+                        rb.spec.placement,
+                        rb.spec.replicas,
                     )
                 except spread_mod.SpreadError as e:
                     spread_errors[b] = str(e)
                     continue
                 mask = np.zeros(len(self.fleet.names), bool)
-                mask[[d.index for d in selected]] = True
+                mask[selected_idx] = True
                 sub_affinity[b] &= mask
                 live_rows.append(b)
             if live_rows:

@@ -9,15 +9,23 @@ select_clusters_by_region.go:28-119). Only cluster and region constraints
 are implemented — matching the reference, which errors on provider/zone-only
 combinations (select_clusters.go:59).
 
-The inputs (per-cluster score and available replicas) come from the batched
-device kernel; this module is the sequential combinatorial tail that does not
-vectorize (SURVEY §7 hard parts — exact DFS on host; group counts are small).
+Two implementations of the same semantics:
+- the ClusterDetail list functions below are the readable spec (and what the
+  parity tests exercise directly);
+- `select_by_spread_arrays` is the hot path the scheduler core calls: group
+  membership, availability sums and group scores are numpy array ops over the
+  kernel's score/avail rows (one lexsort + cumsums per row) — no per-cluster
+  Python object is ever built, which is what makes 5k spread rows × 5k
+  clusters per round viable. Only the group-combination DFS stays
+  combinatorial (SURVEY §7 hard parts; group counts are small).
 """
 from __future__ import annotations
 
 import math
 from dataclasses import dataclass, field
 from typing import Optional, Sequence
+
+import numpy as np
 
 from ..api.policy import (
     DIVISION_PREFERENCE_WEIGHTED,
@@ -251,6 +259,186 @@ def _select_by_region(
     if rest_cnt > 0:
         selected.extend(sort_details(candidates)[:rest_cnt])
     return selected
+
+
+# -- array fast path (scheduler core) ---------------------------------------
+
+
+@dataclass
+class _ArrayGroup:
+    """Region group over positions into the row's sorted feasible arrays.
+    Duck-types _Group for the shared DFS (value/weight/name)."""
+
+    name: str
+    value: int
+    weight: int
+    positions: np.ndarray = None
+    available: int = 0
+
+
+def select_by_spread_arrays(
+    feas_idx: np.ndarray,  # i64[N] fleet indices of the row's feasible clusters
+    score: np.ndarray,  # i32[N] kernel score row
+    available: np.ndarray,  # i64[N] kernel avail + own previous replicas
+    name_rank: np.ndarray,  # i32[N] cluster-name ascending rank (tie-break)
+    region_id: np.ndarray,  # i32[N] region id, -1 = none
+    region_names: Sequence[str],  # id → region name (group-id tie-break)
+    placement: Placement,
+    replicas: int,
+) -> np.ndarray:
+    """Array equivalent of select_clusters_by_spread: returns the SELECTED
+    fleet indices. Semantics identical to the ClusterDetail path (parity
+    tested); no per-cluster objects are built."""
+    available = available.astype(np.int64)
+    # sortClusters (util.go:43-57): score desc, avail desc, name asc
+    order = np.lexsort((name_rank, -available, -score))
+    feas_idx = feas_idx[order]
+    score = score[order]
+    available = available[order]
+    region_id = region_id[order]
+
+    constraints = placement.spread_constraints
+    if not constraints or should_ignore_spread_constraint(placement):
+        return feas_idx
+
+    cmap = _constraint_map(constraints)
+    if SPREAD_BY_FIELD_REGION in cmap:
+        return _region_arrays(
+            cmap, feas_idx, score, available, region_id, region_names,
+            placement, replicas,
+        )
+    if SPREAD_BY_FIELD_CLUSTER in cmap:
+        need_replicas = (
+            INVALID_REPLICAS if should_ignore_available_resource(placement) else replicas
+        )
+        return _cluster_arrays(
+            cmap[SPREAD_BY_FIELD_CLUSTER], feas_idx, available, need_replicas
+        )
+    raise SpreadError("just support cluster and region spread constraint")
+
+
+def _cluster_arrays(
+    constraint: SpreadConstraint,
+    feas_idx: np.ndarray,  # sorted
+    available: np.ndarray,
+    need_replicas: int,
+) -> np.ndarray:
+    """_select_by_cluster + the availability-swap repair
+    (select_clusters_by_cluster.go:46-99) over arrays."""
+    total = len(feas_idx)
+    if total < constraint.min_groups:
+        raise SpreadError(
+            "the number of feasible clusters is less than spreadConstraint.MinGroups"
+        )
+    need_cnt = constraint.max_groups if constraint.max_groups > 0 else total
+    need_cnt = min(need_cnt, total)
+    if need_replicas == INVALID_REPLICAS:
+        return feas_idx[:need_cnt]
+
+    ret_pos = np.arange(need_cnt)
+    rest_pos = np.arange(need_cnt, total)
+    ret_av = available[:need_cnt].copy()
+    rest_av = available[need_cnt:].copy()
+    update = need_cnt - 1
+    while ret_av.sum() < need_replicas and update >= 0:
+        # reference picks the max-availability rest cluster strictly better
+        # than the one being replaced; argmax's first-max == its choice
+        if rest_av.size:
+            best = int(np.argmax(rest_av))
+            if rest_av[best] > ret_av[update]:
+                ret_pos[update], rest_pos[best] = rest_pos[best], ret_pos[update]
+                ret_av[update], rest_av[best] = rest_av[best], ret_av[update]
+        update -= 1
+    if ret_av.sum() < need_replicas:
+        raise SpreadError(f"no enough resource when selecting {need_cnt} clusters")
+    return feas_idx[ret_pos]
+
+
+def _region_arrays(
+    cmap: dict[str, SpreadConstraint],
+    feas_idx: np.ndarray,  # all sorted by (score desc, avail desc, name asc)
+    score: np.ndarray,
+    available: np.ndarray,
+    region_id: np.ndarray,
+    region_names: Sequence[str],
+    placement: Placement,
+    replicas: int,
+) -> np.ndarray:
+    """_select_by_region over arrays: per-region membership/sums/scores via
+    cumsums on the sorted row; DFS unchanged."""
+    region_constraint = cmap[SPREAD_BY_FIELD_REGION]
+    cluster_constraint = cmap.get(SPREAD_BY_FIELD_CLUSTER, SpreadConstraint(min_groups=0))
+
+    has_region = region_id >= 0
+    rids = region_id[has_region]
+    positions = np.nonzero(has_region)[0]
+    unique_rids = np.unique(rids)
+    if len(unique_rids) < region_constraint.min_groups:
+        raise SpreadError(
+            "the number of feasible region is less than spreadConstraint.MinGroups"
+        )
+
+    duplicated = should_ignore_available_resource(placement)
+    min_groups = max(region_constraint.min_groups, 1)
+    need = max(cluster_constraint.min_groups, min_groups)
+    target = math.ceil(replicas / min_groups)
+
+    groups: list[_ArrayGroup] = []
+    for rid in unique_rids:
+        pos = positions[rids == int(rid)]  # ascending = global sorted order
+        av = available[pos]
+        sc = score[pos].astype(np.int64)
+        n = len(pos)
+        if duplicated:
+            # calcGroupScoreForDuplicate (group_clusters.go:143-215)
+            valid = av >= replicas
+            cnt = int(valid.sum())
+            weight = cnt * WEIGHT_UNIT + int(sc[valid].sum()) // cnt if cnt else 0
+        else:
+            # calcGroupScore divided branch (group_clusters.go:217-330):
+            # prefix accumulation in sorted order with early stop
+            cum_av = np.cumsum(av)
+            cum_sc = np.cumsum(sc)
+            cond = (np.arange(1, n + 1) >= need) & (cum_av >= target)
+            if cond.any():
+                k = int(np.argmax(cond))
+                weight = target * WEIGHT_UNIT + int(cum_sc[k]) // (k + 1)
+            elif int(cum_av[-1]) < target:
+                weight = int(cum_av[-1]) * WEIGHT_UNIT + int(cum_sc[-1]) // n
+            else:
+                weight = target * WEIGHT_UNIT + int(cum_sc[-1]) // n
+        groups.append(
+            _ArrayGroup(
+                name=region_names[int(rid)],
+                value=n,
+                weight=weight,
+                positions=pos,
+                available=int(av.sum()),
+            )
+        )
+
+    chosen = _select_groups(
+        groups,
+        region_constraint.min_groups,
+        region_constraint.max_groups if region_constraint.max_groups > 0 else len(groups),
+        cluster_constraint.min_groups,
+    )
+    if not chosen:
+        raise SpreadError(
+            "the number of clusters is less than the cluster spreadConstraint.MinGroups"
+        )
+
+    # best cluster per selected region, then fill by score — candidate
+    # positions ascending reproduce sort_details order exactly
+    selected = [int(g.positions[0]) for g in chosen]
+    candidates = np.sort(np.concatenate([g.positions[1:] for g in chosen]))
+    need_cnt = len(selected) + len(candidates)
+    if cluster_constraint.max_groups > 0:
+        need_cnt = min(need_cnt, cluster_constraint.max_groups)
+    rest_cnt = need_cnt - len(selected)
+    if rest_cnt > 0:
+        selected.extend(int(p) for p in candidates[:rest_cnt])
+    return feas_idx[selected]
 
 
 def _select_groups(

@@ -161,3 +161,130 @@ class TestEndToEndSpread:
         rb = make_binding("x", 1, p, cpu=1.0)
         (d,) = sched.schedule([rb])
         assert not d.ok and "just support cluster and region" in d.error
+
+
+class TestArrayParity:
+    """select_by_spread_arrays (the scheduler's hot path) must reproduce the
+    ClusterDetail implementation exactly over randomized rows."""
+
+    @staticmethod
+    def random_case(rng, n, with_region):
+        import numpy as np
+
+        names = [f"c{i:03d}" for i in range(n)]
+        perm = rng.permutation(n)  # fleet order != name order
+        names = [names[p] for p in perm]
+        score = rng.choice([0, 100], size=n).astype(np.int32)
+        avail = rng.integers(0, 40, size=n).astype(np.int64)
+        regions = (
+            rng.integers(-1, 4, size=n).astype(np.int32)
+            if with_region
+            else np.full(n, -1, np.int32)
+        )
+        region_names = ["r0", "r1", "r2", "r3"]
+        return names, score, avail, regions, region_names
+
+    @staticmethod
+    def run_both(names, score, avail, regions, region_names, placement, replicas):
+        import numpy as np
+
+        n = len(names)
+        details = [
+            spread.ClusterDetail(
+                name=names[i],
+                index=i,
+                score=int(score[i]),
+                available=int(avail[i]),
+                region=region_names[regions[i]] if regions[i] >= 0 else "",
+            )
+            for i in range(n)
+        ]
+        name_rank = np.empty(n, np.int32)
+        name_rank[np.argsort(np.array(names))] = np.arange(n)
+        feas_idx = np.arange(n)
+
+        ref_err = arr_err = None
+        ref = arr = None
+        try:
+            ref = {d.index for d in spread.select_clusters_by_spread(details, placement, replicas)}
+        except spread.SpreadError as e:
+            ref_err = str(e)
+        try:
+            arr = set(
+                int(i)
+                for i in spread.select_by_spread_arrays(
+                    feas_idx, score, avail, name_rank, regions, region_names,
+                    placement, replicas,
+                )
+            )
+        except spread.SpreadError as e:
+            arr_err = str(e)
+        assert ref_err == arr_err
+        assert ref == arr
+
+    @pytest.mark.parametrize("seed", range(8))
+    def test_cluster_constraint_parity(self, seed):
+        import numpy as np
+
+        rng = np.random.default_rng(seed)
+        names, score, avail, regions, region_names = self.random_case(rng, 17, False)
+        for min_g, max_g, replicas in [(1, 3, 30), (2, 5, 80), (4, 0, 10), (1, 17, 200)]:
+            for divided in (False, True):
+                p = Placement(
+                    cluster_affinity=ClusterAffinity(),
+                    spread_constraints=[
+                        SpreadConstraint(
+                            spread_by_field=SPREAD_BY_FIELD_CLUSTER,
+                            min_groups=min_g, max_groups=max_g,
+                        )
+                    ],
+                    replica_scheduling=(
+                        ReplicaSchedulingStrategy(
+                            replica_scheduling_type=REPLICA_SCHEDULING_DIVIDED,
+                            replica_division_preference="Aggregated",
+                        )
+                        if divided
+                        else None
+                    ),
+                )
+                self.run_both(names, score, avail, regions, region_names, p, replicas)
+
+    @pytest.mark.parametrize("seed", range(8))
+    def test_region_constraint_parity(self, seed):
+        import numpy as np
+
+        rng = np.random.default_rng(100 + seed)
+        names, score, avail, regions, region_names = self.random_case(rng, 23, True)
+        for rmin, rmax, cmin, cmax, replicas in [
+            (1, 2, 0, 0, 20),
+            (2, 3, 2, 6, 50),
+            (2, 0, 1, 0, 100),
+            (3, 4, 3, 10, 9),
+        ]:
+            for divided in (False, True):
+                cons = [
+                    SpreadConstraint(
+                        spread_by_field=SPREAD_BY_FIELD_REGION,
+                        min_groups=rmin, max_groups=rmax,
+                    )
+                ]
+                if cmin or cmax:
+                    cons.append(
+                        SpreadConstraint(
+                            spread_by_field=SPREAD_BY_FIELD_CLUSTER,
+                            min_groups=cmin, max_groups=cmax,
+                        )
+                    )
+                p = Placement(
+                    cluster_affinity=ClusterAffinity(),
+                    spread_constraints=cons,
+                    replica_scheduling=(
+                        ReplicaSchedulingStrategy(
+                            replica_scheduling_type=REPLICA_SCHEDULING_DIVIDED,
+                            replica_division_preference="Aggregated",
+                        )
+                        if divided
+                        else None
+                    ),
+                )
+                self.run_both(names, score, avail, regions, region_names, p, replicas)
