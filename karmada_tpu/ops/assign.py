@@ -23,25 +23,61 @@ from __future__ import annotations
 
 from typing import NamedTuple
 
+import jax
 import jax.numpy as jnp
 
 I32_MAX = jnp.int32(2**31 - 1)
 
 
-def _rank_by(weight, last, tie):
-    """rank[b,c] = position of cluster c in the order (weight desc, last
-    desc, tie asc) within row b. Double-argsort of a lexsort.
-
-    The (last, tie) pair packs into ONE i64 key — both are i32 — so the
-    lexsort runs 2 stable passes instead of 3 (each pass is a full [B,C]
-    sort; at 10k×5k these passes dominate the solve)."""
-    last_tie = (
+def _pack_last_tie(last, tie):
+    """(last desc, tie asc) as ONE ascending i64 key — both inputs are i32."""
+    return (
         ((jnp.int64(2**31 - 1) - last.astype(jnp.int64)) << jnp.int64(32))
         | tie.astype(jnp.int64)
     )
-    order = jnp.lexsort((last_tie, -weight), axis=-1)  # last key = primary
-    rank = jnp.argsort(order, axis=-1)
-    return rank
+
+
+def _neg_key(weight, narrow: bool):
+    """Descending-weight sort key; i32 when the caller proves every weight
+    fits (the ArrayScheduler._batch_flags host bound) — narrower comparators
+    make the [B,C] sort measurably faster on TPU."""
+    return (-weight).astype(jnp.int32) if narrow else -weight
+
+
+def _cutoff_le(key1, key2, iota, k1s, k2s, ios, k):
+    """mask of columns whose (key1, key2, iota) triple sorts at or before the
+    sorted cutoff element at position k-1 — i.e. the first k positions of the
+    total order, selected by ONE elementwise compare instead of a rank.
+
+    Shared by the dispenser bonus and the Aggregated truncation so the two
+    order predicates can never drift apart (binding.go order semantics)."""
+    C = key1.shape[-1]
+    idx = jnp.clip(k - 1, 0, C - 1).astype(jnp.int32)[:, None]
+    c1 = jnp.take_along_axis(k1s, idx, axis=-1)
+    c2 = jnp.take_along_axis(k2s, idx, axis=-1)
+    co = jnp.take_along_axis(ios, idx, axis=-1)
+    le = (key1 < c1) | (
+        (key1 == c1) & ((key2 < c2) | ((key2 == c2) & (iota <= co)))
+    )
+    return le & (k > 0)[:, None]
+
+
+def _first_k_mask(key1, key2, k):
+    """mask[b,c] = True iff c is among the first k[b] columns of row b in
+    ascending (key1, key2, col-index) order — WITHOUT materializing a rank.
+
+    A [B,C] rank needs either an argsort-of-argsort (a second full sort) or a
+    scatter of iota; TPU scatters at this shape measure ~1.9 s (profile_tail),
+    which was most of the round-2 3.1 s p99. Instead: one variadic lax.sort
+    with the column iota as the tie-break key, read the CUTOFF element at
+    position k-1, and compare every column's key triple against it — a pure
+    elementwise pass. The iota key makes the order total, so "triple <=
+    cutoff" selects exactly the first k positions, bit-identical to the
+    stable-sort rank (binding.go:118-144 order semantics)."""
+    B, C = key1.shape
+    iota = jax.lax.broadcasted_iota(jnp.int32, (B, C), 1)
+    k1s, k2s, ios = jax.lax.sort((key1, key2, iota), dimension=-1, num_keys=3)
+    return _cutoff_le(key1, key2, iota, k1s, k2s, ios, k)
 
 
 def take_by_weight(
@@ -50,6 +86,7 @@ def take_by_weight(
     tie,  # i32[B,C] deterministic pseudo-random tie-break
     target,  # i32[B]
     init,  # i32[B,C] dispenser init result (prev clusters on scale-up)
+    narrow: bool = False,  # static: every weight proven < 2**31 by the caller
 ):
     """Vectorized Dispenser.TakeByWeight. Returns (result i32[B,C],
     remain i32[B]); remain == target where sum(weight) == 0 (dispenser no-op,
@@ -60,13 +97,39 @@ def take_by_weight(
     safe_sum = jnp.maximum(sum_w, 1)
     quota = weight * target64[:, None] // safe_sum[:, None]  # i64[B,C]
     rem = target64 - quota.sum(-1)  # i64[B]
-    rank = _rank_by(weight, last, tie)
-    bonus = (rank < rem[:, None]) & (weight > 0)
+    # +1 to the first `rem` clusters in (weight desc, last desc, tie asc)
+    # order; rem < #positive-weight clusters, so every bonus lands on w > 0
+    bonus = _first_k_mask(
+        _neg_key(weight, narrow), _pack_last_tie(last, tie), rem
+    ) & (weight > 0)
     result = (quota + bonus).astype(jnp.int32)
     ok = sum_w > 0
     result = jnp.where(ok[:, None], result, 0)
     remain = jnp.where(ok, 0, target).astype(jnp.int32)
     return init + result, remain
+
+
+def _aggregated_keep(prior, weight, tgt, narrow: bool = False):
+    """Aggregated truncation mask: keep the shortest (prior desc, weight
+    desc, col-index asc) prefix whose cumulative capacity covers tgt.
+
+    One variadic sort co-sorts the weights (no separate gather), the prefix
+    length k comes from a cumsum over the sorted weights, and membership is a
+    cutoff compare (see _first_k_mask) instead of scattering the sorted mask
+    back — the scatter was the round-2 hot spot (~1.9 s of the 3.1 s p99)."""
+    B, C = weight.shape
+    iota = jax.lax.broadcasted_iota(jnp.int32, (B, C), 1)
+    key1 = -prior.astype(jnp.int32)
+    key2 = _neg_key(weight, narrow)
+    ws_in = weight.astype(jnp.int32) if narrow else weight
+    k1s, k2s, ios, ws = jax.lax.sort(
+        (key1, key2, iota, ws_in), dimension=-1, num_keys=3
+    )
+    ws = ws.astype(jnp.int64)  # cumsum over C columns can exceed i32
+    cum = jnp.cumsum(ws, axis=-1)
+    keep_sorted = (cum - ws) < tgt[:, None]  # strictly before coverage
+    k = keep_sorted.sum(-1).astype(jnp.int32)  # prefix length (ws >= 0)
+    return _cutoff_le(key1, key2, iota, k1s, k2s, ios, k)
 
 
 def duplicated_assign(feasible, replicas):
@@ -134,16 +197,9 @@ def dynamic_assign(
     # dynamicFreshScale still route through the Aggregated branch of
     # dynamicDivideReplicas, only with scheduledClusters nil so no prior
     # preference): prior-first, then weight desc; keep the shortest prefix
-    # whose cumulative capacity covers the target. The cluster-index tie-break
-    # comes free from sort stability (no third key pass needed).
+    # whose cumulative capacity covers the target.
     prior = up[:, None] & (prev_m > 0)
-    trunc_order = jnp.lexsort((-weight, -prior.astype(jnp.int32)), axis=-1)
-    w_sorted = jnp.take_along_axis(weight, trunc_order, axis=-1)
-    cum = jnp.cumsum(w_sorted, axis=-1)
-    keep_sorted = (cum - w_sorted) < tgt[:, None]  # strictly before coverage
-    keep = jnp.zeros_like(keep_sorted).at[
-        jnp.arange(weight.shape[0])[:, None], trunc_order
-    ].set(keep_sorted)
+    keep = _aggregated_keep(prior, weight, tgt)
     do_trunc = (aggregated & ~eq)[:, None]
     weight = jnp.where(do_trunc & ~keep, 0, weight)
 
@@ -165,13 +221,19 @@ def combined_assign(
     tie,  # i32[B,C]
     replicas,  # i32[B]
     fresh,  # bool[B]
+    narrow: bool = False,  # static: all weights proven < 2**31 (host bound)
+    has_agg: bool = True,  # static: batch contains Aggregated rows
 ) -> DynamicResult:
     """Static-weight AND dynamic rows through ONE dispenser pass.
 
     The two strategies are row-disjoint, so their (weight, last, init, target)
     inputs row-select into a single take_by_weight — halving the [B,C] sort
     passes, which dominate the full-scale solve. Semantics are identical to
-    static_weight_assign / dynamic_assign (division_algorithm.go paths)."""
+    static_weight_assign / dynamic_assign (division_algorithm.go paths).
+
+    `narrow`/`has_agg` are host-derived static specializations: narrow sort
+    keys, and the truncation sort compiled out entirely for batches with no
+    Aggregated row (the common case for configs 1-2 of BASELINE.md)."""
     # --- static inputs (assignment.go:194-206) ---
     w_static = jnp.where(feasible, raw_weight, 0).astype(jnp.int64)
     all_zero = w_static.sum(-1) == 0
@@ -194,17 +256,12 @@ def combined_assign(
     avail_sum = w_dyn.sum(-1)
     unsched = is_dyn & ~eq & (avail_sum < tgt_dyn)
 
-    # Aggregated truncation (see dynamic_assign)
-    prior = up[:, None] & (prev_m > 0)
-    trunc_order = jnp.lexsort((-w_dyn, -prior.astype(jnp.int32)), axis=-1)
-    w_sorted = jnp.take_along_axis(w_dyn, trunc_order, axis=-1)
-    cum = jnp.cumsum(w_sorted, axis=-1)
-    keep_sorted = (cum - w_sorted) < tgt_dyn[:, None]
-    keep = jnp.zeros_like(keep_sorted).at[
-        jnp.arange(w_dyn.shape[0])[:, None], trunc_order
-    ].set(keep_sorted)
-    do_trunc = (aggregated & ~eq)[:, None]
-    w_dyn = jnp.where(do_trunc & ~keep, 0, w_dyn)
+    if has_agg:
+        # Aggregated truncation (see dynamic_assign)
+        prior = up[:, None] & (prev_m > 0)
+        keep = _aggregated_keep(prior, w_dyn, tgt_dyn, narrow=narrow)
+        do_trunc = (aggregated & ~eq)[:, None]
+        w_dyn = jnp.where(do_trunc & ~keep, 0, w_dyn)
     last_dyn = jnp.where(up[:, None], prev_m, 0).astype(jnp.int32)
 
     # --- row-select into ONE dispense ---
@@ -213,7 +270,7 @@ def combined_assign(
     last = jnp.where(sm, last_static, last_dyn)
     init = jnp.where(sm, 0, init_dyn)
     tgt = jnp.where(is_static, target_spec, tgt_dyn).astype(jnp.int32)
-    dispensed, _ = take_by_weight(weight, last, tie, tgt, init)
+    dispensed, _ = take_by_weight(weight, last, tie, tgt, init, narrow=narrow)
 
     result = jnp.where((is_dyn & eq)[:, None], prev_m.astype(jnp.int32), dispensed)
     result = jnp.where(unsched[:, None], 0, result)

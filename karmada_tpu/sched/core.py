@@ -80,18 +80,21 @@ def filter_estimate_phase(
 
 
 def assignment_tail(
-    feasible, strategy, static_weight, avail, prev_replicas, tie, replicas, fresh
+    feasible, strategy, static_weight, avail, prev_replicas, tie, replicas,
+    fresh, narrow: bool = False, has_agg: bool = True,
 ):
     """Strategy dispatch + division over FULL fleet rows (the phase that needs
     every cluster column: per-row sort/cumsum, binding.go:112-144). Static +
     dynamic rows share one dispenser pass (row-disjoint — combined_assign
-    halves the [B,C] sort work)."""
+    halves the [B,C] sort work). narrow/has_agg are host-derived static
+    specializations (see ArrayScheduler._batch_flags)."""
     dup = assign_ops.duplicated_assign(feasible, replicas)
     is_static = strategy == STATIC_WEIGHT
     is_dyn = (strategy == DYNAMIC_WEIGHT) | (strategy == AGGREGATED)
     sd = assign_ops.combined_assign(
         feasible, is_static, is_dyn, strategy == AGGREGATED,
         static_weight, avail, prev_replicas, tie, replicas, fresh,
+        narrow=narrow, has_agg=has_agg,
     )
     result = jnp.zeros_like(dup)
     result = jnp.where((strategy == DUPLICATED)[:, None], dup, result)
@@ -138,6 +141,8 @@ def _schedule_body(
     prev_replicas,
     tie,
     extra_avail,  # i32[B,C] min-merged registered-estimator answers; -1 = none
+    narrow: bool = False,
+    has_agg: bool = True,
 ):
     feasible, score, avail = filter_estimate_phase(
         alive, capacity, has_summary, taint_key, taint_value, taint_effect, api_ok,
@@ -149,7 +154,8 @@ def _schedule_body(
     # core/util.go:72-92); gRPC/node-level answers tighten the general bound
     avail = jnp.where(extra_avail >= 0, jnp.minimum(avail, extra_avail), avail)
     result, unschedulable, avail_sum = assignment_tail(
-        feasible, strategy, static_weight, avail, prev_replicas, tie, replicas, fresh
+        feasible, strategy, static_weight, avail, prev_replicas, tie, replicas,
+        fresh, narrow=narrow, has_agg=has_agg,
     )
     return feasible, score, result, unschedulable, avail_sum, avail
 
@@ -221,7 +227,7 @@ def decompress_batch(
     return affinity_ok, static_weight, prev_member, prev_replicas, eviction_ok, tie
 
 
-@partial(jax.jit, static_argnames=())
+@partial(jax.jit, static_argnames=("topk", "narrow", "has_agg"))
 def _schedule_kernel_compact(
     # fleet (device-resident)
     alive, capacity, has_summary, taint_key, taint_value, taint_effect, api_ok,
@@ -232,8 +238,17 @@ def _schedule_kernel_compact(
     aff_masks, aff_idx, weight_tables, weight_idx,
     prev_idx, prev_rep, evict_idx, seeds,
     extra_avail,  # i32[B,C] or broadcastable [1,1] sentinel
+    topk: int = TOPK_TARGETS,
+    narrow: bool = False,
+    has_agg: bool = True,
 ):
-    """Decompress the factored batch on device, then run the solve."""
+    """Decompress the factored batch on device, then run the solve.
+
+    topk/narrow/has_agg are host-derived static specializations (bounded jit
+    cache: 5 top-K buckets x 2 x 2): the compact window shrinks to the
+    batch's real target bound, the division sorts use i32 keys when every
+    weight provably fits, and the Aggregated truncation sort is compiled out
+    when no row needs it."""
     B = replicas.shape[0]
     C = alive.shape[0]
     affinity_ok, static_weight, prev_member, prev_replicas, eviction_ok, tie = (
@@ -248,10 +263,10 @@ def _schedule_kernel_compact(
         replicas, request, unknown_request, gvk, strategy, fresh,
         tol_key, tol_value, tol_effect, tol_op,
         affinity_ok, eviction_ok, static_weight, prev_member, prev_replicas, tie,
-        extra,
+        extra, narrow=narrow, has_agg=has_agg,
     )
     feas_count, nnz, top_idx, top_val = compact_outputs(
-        feasible, result, min(C, TOPK_TARGETS)
+        feasible, result, min(C, topk)
     )
     return (
         feasible, score, result, unschedulable, avail_sum, avail,
@@ -328,6 +343,12 @@ class ArrayScheduler:
                 rid = region_ids.setdefault(region, len(region_ids))
                 self._region_id[i] = rid
         self._region_names = list(region_ids)
+        # per-resource capacity ceiling for the narrow-keys bound (host-side
+        # proof that every division weight fits i32 — see _batch_flags)
+        cap = np.asarray(self.fleet.capacity, np.int64)
+        self._max_cap_per_res = (
+            cap.max(axis=0) if cap.size else np.zeros(cap.shape[1], np.int64)
+        )
         # fleet tensors live on device across rounds (the persistent snapshot
         # that replaces the reference's per-attempt deep copy, cache.go:62-77);
         # re-transferred only on cluster-set change
@@ -398,11 +419,53 @@ class ArrayScheduler:
 
     _NO_EXTRA = np.full((1, 1), -1, np.int32)  # broadcast sentinel
 
+    def _batch_flags(self, batch: BindingBatch) -> tuple[int, bool, bool]:
+        """Host-derived static kernel specializations (cheap numpy passes
+        over the factored batch — never over [B,C]):
+
+        - topk: the compact-output window, bucketed to the batch's provable
+          per-row target bound (divided rows emit <= spec.replicas targets;
+          duplicated rows <= their affinity-mask popcount). Smaller window =
+          less top_k work and fewer device->host bytes per round.
+        - narrow: True when every division weight provably fits i32, so the
+          [B,C] sort keys narrow from i64 (GeneralEstimator answers are
+          bounded by max capacity // min positive request per resource;
+          static weights by their table max).
+        - has_agg: False compiles the Aggregated truncation sort out."""
+        max_prev = int(batch.prev_rep.max(initial=0))
+        max_repl = int(batch.replicas.max(initial=0))
+        req = np.asarray(batch.request, np.int64)
+        pos = req > 0
+        bound_est = 0
+        if pos.any():
+            min_req = np.where(pos, req, np.iinfo(np.int64).max).min(axis=0)
+            used = pos.any(axis=0)
+            per_res = np.where(
+                used, self._max_cap_per_res // np.maximum(min_req, 1), 0
+            )
+            bound_est = int(per_res.max(initial=0))
+        max_static = int(batch.weight_tables.max(initial=0))
+        i32max = 2**31 - 1
+        narrow = (
+            max(bound_est, max_repl) + max_prev < i32max and max_static < i32max
+        )
+        has_agg = bool((batch.strategy == AGGREGATED).any())
+        cand = max_repl
+        dup = batch.strategy == DUPLICATED
+        if dup.any():
+            pc = batch.aff_masks.sum(axis=1)
+            cand = max(cand, int(pc[batch.aff_idx[dup]].max(initial=0)))
+        topk = 8
+        while topk < min(cand, TOPK_TARGETS):
+            topk *= 2
+        return min(topk, TOPK_TARGETS), narrow, has_agg
+
     def run_kernel(self, batch: BindingBatch, extra_avail=None):
         if self._mesh_kernel is not None:
             return self._mesh_kernel(batch, extra_avail)
         if extra_avail is None:
             extra_avail = self._NO_EXTRA
+        topk, narrow, has_agg = self._batch_flags(batch)
         return _schedule_kernel_compact(
             *self._fleet_dev,
             batch.replicas,
@@ -424,6 +487,9 @@ class ArrayScheduler:
             batch.evict_idx,
             batch.seeds,
             extra_avail,
+            topk=topk,
+            narrow=narrow,
+            has_agg=has_agg,
         )
 
     def schedule(self, bindings: Sequence, extra_avail=None) -> list[ScheduleDecision]:

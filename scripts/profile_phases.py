@@ -1,0 +1,129 @@
+"""Honest per-phase breakdown of the north-star kernel (scalar-checksum sync;
+block_until_ready does not block on the tunnel backend).
+
+Run:  python scripts/profile_phases.py
+"""
+from __future__ import annotations
+
+import sys
+import time
+
+sys.path.insert(0, ".")
+import karmada_tpu  # noqa: F401
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from bench import build_problem
+
+
+def timeit(fn, label, iters=4):
+    r = fn()
+    _ = np.asarray(r)
+    ts = []
+    for _ in range(iters):
+        t0 = time.perf_counter()
+        _ = np.asarray(fn())
+        ts.append(time.perf_counter() - t0)
+    ts.sort()
+    print(f"{label:34s} {ts[len(ts)//2]*1e3:9.1f} ms", flush=True)
+
+
+def main():
+    dev = jax.devices()[0]
+    print(f"# backend={dev.platform} kind={dev.device_kind}", flush=True)
+
+    sched, bindings = build_problem(5000, 10000)
+    batch = sched._pad(sched.batch_encoder.encode(bindings))
+    B = batch.replicas.shape[0]
+    C = batch.n_clusters
+    print(f"# B={B} C={C}", flush=True)
+
+    from karmada_tpu.sched import core as core_mod
+    from karmada_tpu.ops import assign as assign_ops
+
+    fleet_dev = sched._fleet_dev
+    dec_args = (batch.aff_masks, batch.aff_idx, batch.weight_tables,
+                batch.weight_idx, batch.prev_idx, batch.prev_rep,
+                batch.evict_idx, batch.seeds)
+
+    # put the batch core on device once so phase timings exclude upload
+    core_args = jax.device_put((
+        batch.replicas, batch.request, batch.unknown_request, batch.gvk,
+        batch.strategy, batch.fresh, batch.tol_key, batch.tol_value,
+        batch.tol_effect, batch.tol_op))
+    dec_dev = jax.device_put(dec_args)
+    (replicas, request, unknown_request, gvk, strategy, fresh,
+     tol_key, tol_value, tol_effect, tol_op) = core_args
+    _ = np.asarray(jax.jit(lambda r: r.sum())(replicas))
+
+    timeit(lambda: jax.jit(lambda: jnp.int32(1))(), "noop RTT")
+
+    @jax.jit
+    def full_kernel():
+        out = core_mod._schedule_kernel_compact(
+            *fleet_dev, replicas, request, unknown_request, gvk, strategy,
+            fresh, tol_key, tol_value, tol_effect, tol_op, *dec_dev,
+            jnp.full((1, 1), -1, jnp.int32))
+        return sum(o.sum().astype(jnp.int64) for o in out[3:5]) + out[8].sum()
+
+    timeit(lambda: full_kernel(), "full kernel (checksum only)")
+
+    @jax.jit
+    def decomp():
+        parts = core_mod.decompress_batch(*dec_dev, C)
+        return sum(p.sum().astype(jnp.int64) for p in parts)
+
+    timeit(lambda: decomp(), "  decompress")
+
+    @jax.jit
+    def filt():
+        affinity_ok, static_weight, prev_member, prev_replicas, eviction_ok, tie = (
+            core_mod.decompress_batch(*dec_dev, C))
+        feasible, score, avail = core_mod.filter_estimate_phase(
+            *fleet_dev, replicas, request, unknown_request, gvk,
+            tol_key, tol_value, tol_effect, tol_op,
+            affinity_ok, eviction_ok, prev_member)
+        return (feasible.sum().astype(jnp.int64) + score.sum()
+                + avail.sum().astype(jnp.int64))
+
+    timeit(lambda: filt(), "  decompress+filter+estimate")
+
+    @jax.jit
+    def through_tail():
+        affinity_ok, static_weight, prev_member, prev_replicas, eviction_ok, tie = (
+            core_mod.decompress_batch(*dec_dev, C))
+        feasible, score, avail = core_mod.filter_estimate_phase(
+            *fleet_dev, replicas, request, unknown_request, gvk,
+            tol_key, tol_value, tol_effect, tol_op,
+            affinity_ok, eviction_ok, prev_member)
+        result, unsched, avail_sum = core_mod.assignment_tail(
+            feasible, strategy, static_weight, avail, prev_replicas, tie,
+            replicas, fresh)
+        return result.sum().astype(jnp.int64) + unsched.sum()
+
+    timeit(lambda: through_tail(), "  ... + assignment tail")
+
+    # transfer cost of the compact outputs alone
+    out = core_mod._schedule_kernel_compact(
+        *fleet_dev, replicas, request, unknown_request, gvk, strategy,
+        fresh, tol_key, tol_value, tol_effect, tol_op, *dec_dev,
+        jnp.full((1, 1), -1, jnp.int32))
+    _ = jax.device_get((out[3], out[4], out[6], out[7], out[8], out[9]))
+
+    def get_compact():
+        return jax.device_get((out[3], out[4], out[6], out[7], out[8], out[9]))
+
+    ts = []
+    for _ in range(4):
+        t0 = time.perf_counter()
+        get_compact()
+        ts.append(time.perf_counter() - t0)
+    ts.sort()
+    nbytes = sum(np.asarray(x).nbytes for x in get_compact())
+    print(f"{'device_get compact (' + f'{nbytes/1e6:.1f} MB)':34s} {ts[len(ts)//2]*1e3:9.1f} ms", flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,198 @@
+"""Ablation profiler for the north-star solve (VERDICT r2 item 1: know where
+the 3.1 s goes before optimizing). Times pieces of the 10k x 5k round on the
+default backend:
+
+  - encode + pad (host)
+  - full compact kernel, device-only (block_until_ready)
+  - device_get of the compact outputs (tunnel transfer)
+  - filter/estimate phase alone
+  - assignment tail alone (the sort-heavy part)
+  - individual sort passes at the padded shape
+
+Run:  python scripts/profile_solve.py [--clusters 5000] [--bindings 10000]
+"""
+from __future__ import annotations
+
+import argparse
+import sys
+import time
+
+sys.path.insert(0, ".")
+
+
+def timeit(fn, iters=5, warmup=1):
+    import jax
+
+    for _ in range(warmup):
+        jax.block_until_ready(fn())
+    ts = []
+    for _ in range(iters):
+        t0 = time.perf_counter()
+        jax.block_until_ready(fn())
+        ts.append(time.perf_counter() - t0)
+    ts.sort()
+    return ts[len(ts) // 2]
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--clusters", type=int, default=5000)
+    ap.add_argument("--bindings", type=int, default=10000)
+    ap.add_argument("--iters", type=int, default=5)
+    args = ap.parse_args()
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from bench import build_problem
+
+    dev = jax.devices()[0]
+    print(f"# backend={dev.platform} kind={dev.device_kind}", flush=True)
+
+    t0 = time.perf_counter()
+    sched, bindings = build_problem(args.clusters, args.bindings)
+    print(f"build_problem        {time.perf_counter()-t0:8.3f}s", flush=True)
+
+    t0 = time.perf_counter()
+    raw = sched.batch_encoder.encode(bindings)
+    print(f"encode               {time.perf_counter()-t0:8.3f}s", flush=True)
+    t0 = time.perf_counter()
+    batch = sched._pad(raw)
+    print(f"pad                  {time.perf_counter()-t0:8.3f}s", flush=True)
+
+    B = batch.replicas.shape[0]
+    C = batch.n_clusters
+    print(f"# padded shape B={B} C={C}", flush=True)
+
+    # --- full kernel, device only ---
+    t = timeit(lambda: sched.run_kernel(batch), iters=args.iters)
+    print(f"kernel (device)      {t:8.3f}s", flush=True)
+
+    # --- transfer of compact outputs ---
+    out = sched.run_kernel(batch)
+    jax.block_until_ready(out)
+
+    def get_compact():
+        return jax.device_get((out[3], out[4], out[6], out[7], out[8], out[9]))
+
+    t = timeit(get_compact, iters=args.iters)
+    nbytes = sum(np.asarray(x).nbytes for x in get_compact())
+    print(f"device_get compact   {t:8.3f}s  ({nbytes/1e6:.1f} MB)", flush=True)
+
+    # --- full schedule() end to end (host decode incl.) ---
+    t0 = time.perf_counter()
+    decisions = sched.schedule(bindings)
+    t_sched = time.perf_counter() - t0
+    nok = sum(d.ok for d in decisions)
+    print(f"schedule() e2e       {t_sched:8.3f}s  ({nok}/{len(decisions)} ok)", flush=True)
+
+    # --- phase ablations: jit sub-programs over the same decompressed batch ---
+    from karmada_tpu.sched import core as core_mod
+    from karmada_tpu.ops import assign as assign_ops
+
+    fleet_dev = sched._fleet_dev
+    NO_EXTRA = jnp.full((1, 1), -1, jnp.int32)
+
+    @jax.jit
+    def decompress_only(b_aff_masks, b_aff_idx, b_wt, b_widx, b_pidx, b_prep,
+                        b_evict, b_seeds):
+        return core_mod.decompress_batch(
+            b_aff_masks, b_aff_idx, b_wt, b_widx, b_pidx, b_prep, b_evict,
+            b_seeds, C)
+
+    dec_args = (batch.aff_masks, batch.aff_idx, batch.weight_tables,
+                batch.weight_idx, batch.prev_idx, batch.prev_rep,
+                batch.evict_idx, batch.seeds)
+    t = timeit(lambda: decompress_only(*dec_args), iters=args.iters)
+    print(f"  decompress         {t:8.3f}s", flush=True)
+
+    dec = decompress_only(*dec_args)
+    affinity_ok, static_weight, prev_member, prev_replicas, eviction_ok, tie = (
+        jax.block_until_ready(dec))
+
+    @jax.jit
+    def filter_est(affinity_ok, eviction_ok, prev_member):
+        return core_mod.filter_estimate_phase(
+            *fleet_dev, batch.replicas, batch.request, batch.unknown_request,
+            batch.gvk, batch.tol_key, batch.tol_value, batch.tol_effect,
+            batch.tol_op, affinity_ok, eviction_ok, prev_member)
+
+    t = timeit(lambda: filter_est(affinity_ok, eviction_ok, prev_member),
+               iters=args.iters)
+    print(f"  filter+estimate    {t:8.3f}s", flush=True)
+
+    feasible, score, avail = jax.block_until_ready(
+        filter_est(affinity_ok, eviction_ok, prev_member))
+
+    @jax.jit
+    def tail(feasible, static_weight, avail, prev_replicas, tie):
+        return core_mod.assignment_tail(
+            feasible, batch.strategy, static_weight, avail, prev_replicas,
+            tie, batch.replicas, batch.fresh)
+
+    t = timeit(lambda: tail(feasible, static_weight, avail, prev_replicas, tie),
+               iters=args.iters)
+    print(f"  assignment tail    {t:8.3f}s", flush=True)
+
+    result, _, _ = jax.block_until_ready(
+        tail(feasible, static_weight, avail, prev_replicas, tie))
+
+    @jax.jit
+    def compact(feasible, result):
+        return core_mod.compact_outputs(feasible, result, min(C, core_mod.TOPK_TARGETS))
+
+    t = timeit(lambda: compact(feasible, result), iters=args.iters)
+    print(f"  compact top_k      {t:8.3f}s", flush=True)
+
+    # --- sort micro-benches at [B,C] ---
+    rng = np.random.default_rng(0)
+    w64 = jnp.asarray(rng.integers(0, 1 << 40, (B, C)), jnp.int64)
+    w32 = jnp.asarray(rng.integers(0, 1 << 30, (B, C)), jnp.int32)
+    last = jnp.asarray(rng.integers(0, 100, (B, C)), jnp.int32)
+    tie32 = jnp.asarray(rng.integers(0, 1 << 31 - 1, (B, C)), jnp.int32)
+
+    @jax.jit
+    def rank_current(w, last, tie):
+        return assign_ops._rank_by(w, last, tie)
+
+    t = timeit(lambda: rank_current(w64, last, tie32), iters=args.iters)
+    print(f"  _rank_by (lexsort+argsort, i64) {t:8.3f}s", flush=True)
+
+    @jax.jit
+    def rank_scatter(w, last, tie):
+        last_tie = (
+            ((jnp.int64(2**31 - 1) - last.astype(jnp.int64)) << jnp.int64(32))
+            | tie.astype(jnp.int64))
+        order = jnp.lexsort((last_tie, -w), axis=-1)
+        iota = jnp.broadcast_to(jnp.arange(C, dtype=jnp.int32), (B, C))
+        rank = jnp.zeros((B, C), jnp.int32).at[
+            jnp.arange(B)[:, None], order].set(iota)
+        return rank
+
+    t = timeit(lambda: rank_scatter(w64, last, tie32), iters=args.iters)
+    print(f"  rank scatter-iota (1 sort, i64) {t:8.3f}s", flush=True)
+
+    @jax.jit
+    def one_sort_i64(w):
+        return jnp.sort(w, axis=-1)
+
+    t = timeit(lambda: one_sort_i64(w64), iters=args.iters)
+    print(f"  plain sort i64                  {t:8.3f}s", flush=True)
+
+    t = timeit(lambda: one_sort_i64(w32), iters=args.iters)
+    print(f"  plain sort i32                  {t:8.3f}s", flush=True)
+
+    @jax.jit
+    def topk128(w):
+        return jax.lax.top_k(w, 128)
+
+    t = timeit(lambda: topk128(w32), iters=args.iters)
+    print(f"  top_k 128 i32                   {t:8.3f}s", flush=True)
+
+    t = timeit(lambda: jax.lax.top_k(w64, 128), iters=args.iters)
+    print(f"  top_k 128 i64                   {t:8.3f}s", flush=True)
+
+
+if __name__ == "__main__":
+    main()

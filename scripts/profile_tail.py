@@ -1,0 +1,231 @@
+"""Second-level ablation: where inside the assignment tail do the seconds go?
+
+Tunnel-backend gotchas this harness works around (learned the hard way):
+- jax.block_until_ready does NOT block on the axon remote backend; only
+  device_get synchronizes. Every timing fetches a scalar checksum.
+- host->device transfers ride the tunnel (400 MB for one [B,C] i64); inputs
+  are generated ON DEVICE from seeds inside a jitted setup program.
+
+Run:  python scripts/profile_tail.py
+"""
+from __future__ import annotations
+
+import sys
+import time
+
+sys.path.insert(0, ".")
+import karmada_tpu  # noqa: F401  (enables x64)
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+B, C = 10240, 5000
+
+
+@jax.jit
+def make_inputs(seed):
+    k = jax.random.PRNGKey(seed)
+    ks = jax.random.split(k, 6)
+    w = jax.random.randint(ks[0], (B, C), 0, 1 << 31, jnp.int64)
+    last = jax.random.randint(ks[1], (B, C), 0, 100, jnp.int32)
+    tie = jax.random.randint(ks[2], (B, C), 0, (1 << 31) - 1, jnp.int32)
+    prior = jax.random.bernoulli(ks[3], 0.5, (B, C))
+    tgt = jax.random.randint(ks[4], (B,), 1, 64, jnp.int64)
+    feasible = jax.random.bernoulli(ks[5], 0.5, (B, C))
+    return w, last, tie, prior, tgt, feasible
+
+
+def sync(x):
+    """Force full materialization: fetch a checksum scalar."""
+    return int(np.asarray(jax.jit(lambda v: v)(x)))
+
+
+def timeit(fn, label, iters=4):
+    # warmup (compile + one run)
+    r = fn()
+    _ = np.asarray(r)
+    ts = []
+    for _ in range(iters):
+        t0 = time.perf_counter()
+        r = fn()
+        _ = np.asarray(r)  # scalar fetch = the only real sync point
+        ts.append(time.perf_counter() - t0)
+    ts.sort()
+    print(f"{label:36s} {ts[len(ts)//2]*1e3:9.1f} ms", flush=True)
+    return ts[len(ts) // 2]
+
+
+def main():
+    groups = set(sys.argv[1:]) or {"trunc", "tbw", "ops"}
+    dev = jax.devices()[0]
+    print(f"# backend={dev.platform} kind={dev.device_kind} B={B} C={C}", flush=True)
+
+    w, last, tie, prior, tgt, feasible = make_inputs(0)
+    target = jax.jit(lambda t: t.astype(jnp.int32))(tgt)
+    init = jax.jit(lambda: jnp.zeros((B, C), jnp.int32))()
+    _ = np.asarray(jax.jit(lambda a: a.sum())(w))  # materialize inputs once
+
+    # baseline sync cost (tunnel RTT + dispatch)
+    timeit(lambda: jax.jit(lambda: jnp.int32(1))(), "noop scalar fetch (RTT)")
+
+    rows = jnp.arange(B)[:, None]
+
+    if "trunc" in groups:
+        run_trunc(w, prior, tgt, rows)
+    if "tbw" in groups:
+        run_tbw(w, last, tie, target, init)
+    if "ops" in groups:
+        run_ops(w, last, tie, target, rows)
+
+
+def run_trunc(w, prior, tgt, rows):
+    # --- trunc block as in combined_assign today ---
+    @jax.jit
+    def trunc_today(w, prior, tgt):
+        trunc_order = jnp.lexsort((-w, -prior.astype(jnp.int32)), axis=-1)
+        w_sorted = jnp.take_along_axis(w, trunc_order, axis=-1)
+        cum = jnp.cumsum(w_sorted, axis=-1)
+        keep_sorted = (cum - w_sorted) < tgt[:, None]
+        keep = jnp.zeros_like(keep_sorted).at[rows, trunc_order].set(keep_sorted)
+        return keep.sum()
+
+    timeit(lambda: trunc_today(w, prior, tgt), "trunc block (today)")
+
+    @jax.jit
+    def trunc_sort_only(w, prior):
+        return jnp.lexsort((-w, -prior.astype(jnp.int32)), axis=-1).sum()
+
+    timeit(lambda: trunc_sort_only(w, prior), "  lexsort only")
+
+    # --- threshold trunc: total-order cutoff compare, no scatter ---
+    @jax.jit
+    def trunc_threshold(w, prior, tgt):
+        iota = jax.lax.broadcasted_iota(jnp.int32, (B, C), 1)
+        key1 = -prior.astype(jnp.int32)
+        key2 = -w
+        k1s, k2s, ios, ws = jax.lax.sort(
+            (key1, key2, iota, w), dimension=-1, num_keys=3)
+        cum = jnp.cumsum(ws, axis=-1)
+        keep_sorted = (cum - ws) < tgt[:, None]
+        k = keep_sorted.sum(-1).astype(jnp.int32)
+        idx = jnp.maximum(k - 1, 0)[:, None]
+        c1 = jnp.take_along_axis(k1s, idx, axis=-1)
+        c2 = jnp.take_along_axis(k2s, idx, axis=-1)
+        co = jnp.take_along_axis(ios, idx, axis=-1)
+        lt = (key1 < c1) | ((key1 == c1) & ((key2 < c2) | ((key2 == c2) & (iota <= co))))
+        keep = lt & (k > 0)[:, None]
+        return keep.sum()
+
+    timeit(lambda: trunc_threshold(w, prior, tgt), "trunc (threshold, no scatter)")
+
+    a = int(np.asarray(jax.jit(lambda *x: trunc_today(*x))(w, prior, tgt)))
+    b = int(np.asarray(jax.jit(lambda *x: trunc_threshold(*x))(w, prior, tgt)))
+    print(f"  parity (keep counts): {a == b} ({a} vs {b})", flush=True)
+
+
+def run_tbw(w, last, tie, target, init):
+    # --- take_by_weight as written (lexsort + argsort rank) ---
+    from karmada_tpu.ops import assign as assign_ops
+
+    @jax.jit
+    def tbw_today(w, last, tie, target, init):
+        r, rem = assign_ops.take_by_weight(w, last, tie, target, init)
+        return r.sum() + rem.sum()
+
+    timeit(lambda: tbw_today(w, last, tie, target, init), "take_by_weight (today)")
+
+    # --- threshold bonus variant ---
+    @jax.jit
+    def tbw_threshold(w, last, tie, target, init):
+        w64 = w.astype(jnp.int64)
+        target64 = target.astype(jnp.int64)
+        sum_w = w64.sum(-1)
+        safe_sum = jnp.maximum(sum_w, 1)
+        quota = w64 * target64[:, None] // safe_sum[:, None]
+        rem = target64 - quota.sum(-1)
+        last_tie = (
+            ((jnp.int64(2**31 - 1) - last.astype(jnp.int64)) << jnp.int64(32))
+            | tie.astype(jnp.int64))
+        iota = jax.lax.broadcasted_iota(jnp.int32, (B, C), 1)
+        key1 = -w64
+        k1s, k2s, ios = jax.lax.sort((key1, last_tie, iota), dimension=-1, num_keys=3)
+        idx = jnp.maximum(rem.astype(jnp.int32) - 1, 0)[:, None]
+        c1 = jnp.take_along_axis(k1s, idx, axis=-1)
+        c2 = jnp.take_along_axis(k2s, idx, axis=-1)
+        co = jnp.take_along_axis(ios, idx, axis=-1)
+        lt = (key1 < c1) | ((key1 == c1) & ((last_tie < c2) | ((last_tie == c2) & (iota <= co))))
+        bonus = lt & (rem > 0)[:, None] & (w64 > 0)
+        result = (quota + bonus).astype(jnp.int32)
+        ok = sum_w > 0
+        result = jnp.where(ok[:, None], result, 0)
+        remain = jnp.where(ok, 0, target).astype(jnp.int32)
+        r = init + result
+        return r.sum() + remain.sum()
+
+    timeit(lambda: tbw_threshold(w, last, tie, target, init), "take_by_weight (threshold)")
+
+    a = int(np.asarray(jax.jit(lambda *x: tbw_today(*x))(w, last, tie, target, init)))
+    b = int(np.asarray(jax.jit(lambda *x: tbw_threshold(*x))(w, last, tie, target, init)))
+    print(f"  parity (checksums): {a == b} ({a} vs {b})", flush=True)
+
+
+def run_ops(w, last, tie, target, rows):
+    # --- individual op costs ---
+    @jax.jit
+    def sort_i64(w):
+        return jnp.sort(w, axis=-1)[:, 0].sum()
+
+    timeit(lambda: sort_i64(w), "plain sort i64")
+
+    @jax.jit
+    def sort_variadic3(w, last, tie):
+        lt = ((jnp.int64(2**31 - 1) - last.astype(jnp.int64)) << jnp.int64(32)) | tie.astype(jnp.int64)
+        iota = jax.lax.broadcasted_iota(jnp.int32, (B, C), 1)
+        a, b_, c = jax.lax.sort((-w, lt, iota), dimension=-1, num_keys=3)
+        return a[:, 0].sum() + c[:, 0].sum()
+
+    timeit(lambda: sort_variadic3(w, last, tie), "variadic sort (i64,i64,i32) 3key")
+
+    @jax.jit
+    def argsort_of(w):
+        o = jnp.argsort(w, axis=-1)
+        return jnp.argsort(o, axis=-1)[:, 0].sum()
+
+    timeit(lambda: argsort_of(w), "argsort+argsort i64")
+
+    @jax.jit
+    def scatter_rank(w):
+        o = jnp.argsort(w, axis=-1)
+        iota = jnp.broadcast_to(jnp.arange(C, dtype=jnp.int32), (B, C))
+        r = jnp.zeros((B, C), jnp.int32).at[rows, o].set(iota)
+        return r[:, 0].sum()
+
+    timeit(lambda: scatter_rank(w), "argsort+scatter-rank i64")
+
+    @jax.jit
+    def quota_div(w, target):
+        w64 = w.astype(jnp.int64)
+        t64 = target.astype(jnp.int64)
+        q = w64 * t64[:, None] // jnp.maximum(w64.sum(-1), 1)[:, None]
+        return q.sum()
+
+    timeit(lambda: quota_div(w, target), "quota mul+div i64")
+
+    @jax.jit
+    def cumsum_i64(w):
+        return jnp.cumsum(w, -1)[:, -1].sum()
+
+    timeit(lambda: cumsum_i64(w), "cumsum i64")
+
+    @jax.jit
+    def gather_cols(w):
+        o = (w[:, :1] % C).astype(jnp.int32)
+        full = jnp.take_along_axis(w, jnp.broadcast_to(o, (B, C)), axis=-1)
+        return full.sum()
+
+    timeit(lambda: gather_cols(w), "take_along_axis [B,C]")
+
+
+if __name__ == "__main__":
+    main()
