@@ -99,6 +99,9 @@ def _remove_cluster(cp: ControlPlane, name: str) -> None:
         raise CLIError(f"cluster {name} not found")
     cp.store.delete("Cluster", name)
     cp.members.pop(name, None)
+    # drop the flap-suppression entry with the membership
+    # (cluster_condition_cache.go delete-on-removal)
+    cp.condition_cache.delete(name)
     cp.settle()
 
 

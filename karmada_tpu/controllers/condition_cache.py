@@ -1,0 +1,68 @@
+"""Threshold-adjusted cluster Ready condition (flap suppression).
+
+Parity with pkg/controllers/status/cluster_condition_cache.go:44-98: when the
+observed Ready status flips against the currently-recorded condition, the old
+status is retained until the new observation has held for the configured
+threshold — so a flapping member (unstable network, missed heartbeat) does
+not thrash taint-based eviction and rescheduling. failure_threshold guards
+True→NotTrue flips, success_threshold guards recovery (NotTrue→True).
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Optional
+
+# reference defaults: --cluster-failure-threshold / --cluster-success-threshold
+DEFAULT_FAILURE_THRESHOLD_S = 30.0
+DEFAULT_SUCCESS_THRESHOLD_S = 30.0
+
+
+@dataclass
+class _ClusterData:
+    ready_status: str  # last OBSERVED status
+    threshold_start: float  # when the observed status changed
+
+
+class ClusterConditionCache:
+    def __init__(
+        self,
+        clock,
+        failure_threshold: float = DEFAULT_FAILURE_THRESHOLD_S,
+        success_threshold: float = DEFAULT_SUCCESS_THRESHOLD_S,
+    ):
+        self.clock = clock
+        self.failure_threshold = failure_threshold
+        self.success_threshold = success_threshold
+        self._data: dict[str, _ClusterData] = {}
+
+    def threshold_adjusted_ready(
+        self, cluster: str, current_status: Optional[str], observed_status: str
+    ) -> str:
+        """thresholdAdjustedReadyCondition (cluster_condition_cache.go:44-84):
+        returns the status to RECORD given the stored condition and the fresh
+        observation."""
+        saved = self._data.get(cluster)
+        if saved is None or current_status is None:
+            # the cluster just joined (or re-joined: a registration seed must
+            # RESET any stale entry from a previous membership, else the next
+            # one-shot flap matches the stale status and bypasses the debounce)
+            self._data[cluster] = _ClusterData(observed_status, 0.0)
+            return observed_status
+        now = self.clock.now()
+        if saved.ready_status != observed_status:
+            saved = _ClusterData(observed_status, now)
+            self._data[cluster] = saved
+        threshold = (
+            self.success_threshold
+            if observed_status == "True"
+            else self.failure_threshold
+        )
+        # only True <-> not-True transitions are debounced (Unknown->False
+        # passes straight through, matching the reference)
+        flips = (observed_status == "True") != (current_status == "True")
+        if flips and now < saved.threshold_start + threshold:
+            return current_status  # retain until the flip has held long enough
+        return observed_status
+
+    def delete(self, cluster: str) -> None:
+        self._data.pop(cluster, None)

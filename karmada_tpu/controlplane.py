@@ -67,6 +67,7 @@ from .interpreter.interpreter import ResourceInterpreter
 from .agent import KarmadaAgent
 from .agent.agent import LeaseFailureDetector, REASON_LEASE_EXPIRED
 from .members.member import InMemoryMember, MemberConfig
+from .controllers.condition_cache import ClusterConditionCache
 from .metricsadapter import MetricsAdapter
 from .proxy import ClusterProxy
 from .modeling import GradeHistogram, ModelBasedEstimator, default_resource_models
@@ -84,7 +85,13 @@ DEFAULT_API_ENABLEMENTS = [
 
 
 class ControlPlane:
-    def __init__(self, clock: Optional[Clock] = None, gates: Optional[FeatureGates] = None):
+    def __init__(
+        self,
+        clock: Optional[Clock] = None,
+        gates: Optional[FeatureGates] = None,
+        cluster_failure_threshold: float = 30.0,
+        cluster_success_threshold: float = 30.0,
+    ):
         self.store = Store()
         self.runtime = Runtime(clock=clock)
         self.gates = gates or FeatureGates()
@@ -144,6 +151,11 @@ class ControlPlane:
             self.interpreter,
             self.runtime,
             pull_clusters=self.agents.keys(),  # live view: agents join later
+        )
+        self.condition_cache = ClusterConditionCache(
+            self.runtime.clock,
+            failure_threshold=cluster_failure_threshold,
+            success_threshold=cluster_success_threshold,
         )
         self.lease_detector = LeaseFailureDetector(
             self.store,
@@ -282,6 +294,10 @@ class ControlPlane:
             cluster.status.conditions,
             Condition(type=CLUSTER_CONDITION_READY, status="True", reason="ClusterReady"),
         )
+        # registration IS the first Ready observation: seed the flap-
+        # suppression cache so a later one-shot NotReady probe is retained
+        # until it holds for the failure threshold
+        self.condition_cache.threshold_adjusted_ready(config.name, None, "True")
         self.store.create(cluster)
         self.work_status_controller.watch_member(member)
         if config.sync_mode == "Pull":
@@ -292,13 +308,26 @@ class ControlPlane:
         return member
 
     def set_member_ready(self, name: str, ready: bool, reason: str = "") -> None:
-        """Flip the Ready condition (health-probe outcome)."""
+        """Record a Ready observation through the flap-suppression cache
+        (cluster_condition_cache.go:44-84): the stored condition only flips
+        once the new observation has held for the configured threshold."""
         cluster = self.store.get("Cluster", name)
+        observed = "True" if ready else "False"
+        current = None
+        for c in cluster.status.conditions:
+            if c.type == CLUSTER_CONDITION_READY:
+                current = c.status
+                break
+        effective = self.condition_cache.threshold_adjusted_ready(
+            name, current, observed
+        )
+        if effective != observed:
+            return  # retained: the flip hasn't held long enough
         set_condition(
             cluster.status.conditions,
             Condition(
                 type=CLUSTER_CONDITION_READY,
-                status="True" if ready else "False",
+                status=observed,
                 reason=reason or ("ClusterReady" if ready else "ClusterNotReady"),
             ),
         )

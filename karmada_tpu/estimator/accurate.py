@@ -33,8 +33,12 @@ class AccurateEstimator:
     """One member cluster's estimator. Also serves as the member's pod
     placement simulator (the test fixture role — SURVEY §4 synthetic fleet)."""
 
-    def __init__(self, nodes: Sequence[NodeSpec], clock=None):
+    def __init__(self, nodes: Sequence[NodeSpec], clock=None, framework=None):
         self.clock = clock  # injectable (tests advance time deterministically)
+        # EstimateReplicas plugin framework (estimate.go:78-101): plugin
+        # answers min-merge into the node-level sum; Unschedulable short-
+        # circuits to 0. None = no plugins configured.
+        self.framework = framework
         self.encoder = NodeEncoder()
         self.specs = list(nodes)
         self.arrays: NodeArrays = self.encoder.encode(self.specs)
@@ -91,7 +95,20 @@ class AccurateEstimator:
             request,
             node_ok,
         )
-        return [int(v) for v in np.asarray(out)]
+        res = [int(v) for v in np.asarray(out)]
+        if self.framework is not None:
+            # RunEstimateReplicasPlugins min-merge (estimate.go:78-101):
+            # Unschedulable => 0; Success bounds the node sum; NoOperation
+            # leaves it untouched; plugin errors surface the node answer
+            # (the reference returns an error — our gRPC layer maps that to
+            # the -1 discard sentinel upstream, so keep the node sum here)
+            for i, req in enumerate(requirements_list):
+                replicas, ret = self.framework.run_estimate_replicas_plugins(req)
+                if ret.is_unschedulable:
+                    res[i] = 0
+                elif ret.is_success and replicas < res[i]:
+                    res[i] = replicas
+        return res
 
     def get_unschedulable_replicas(
         self, workload_key: str, threshold_seconds: float, now: Optional[float] = None

@@ -1,0 +1,91 @@
+"""gRPC channel security for the estimator seam (U3).
+
+Parity with pkg/util/grpcconnection/config.go:34-160: ServerConfig /
+ClientConfig carry cert file paths; empty cert config means insecure (the
+reference returns a bare grpc.Server / insecure credentials the same way).
+The D2 seam is the advertised Go-interop boundary, so the knobs mirror the
+reference flags one-to-one:
+
+  server: --grpc-auth-cert-file/--grpc-auth-key-file
+          --grpc-client-ca-file (+ InsecureSkipClientVerify)
+  client: --grpc-client-cert-file/--grpc-client-key-file
+          --grpc-server-ca-file (+ InsecureSkipServerVerify)
+
+grpc-python notes: require_client_auth maps RequireAndVerifyClientCert;
+python's ssl_channel_credentials has no InsecureSkipVerify — skipping server
+verification entirely is not offered by grpc-python, so
+InsecureSkipServerVerify=True without a CA falls back to the system trust
+store (documented divergence; the reference marks that mode test-only).
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Optional
+
+import grpc
+
+
+def _read(path: str) -> bytes:
+    with open(path, "rb") as f:
+        return f.read()
+
+
+@dataclass
+class ServerConfig:
+    """config.go:34-49 ServerConfig."""
+
+    cert_file: str = ""
+    key_file: str = ""
+    client_auth_ca_file: str = ""
+    insecure_skip_client_verify: bool = False
+
+    @property
+    def secure(self) -> bool:
+        return bool(self.cert_file and self.key_file)
+
+    def bind(self, server: grpc.Server, address: str) -> int:
+        """NewServer (config.go:71-103) + port bind: plain when no cert pair
+        is configured; TLS otherwise; mutual TLS when a client CA is given
+        and InsecureSkipClientVerify is off."""
+        if not self.secure:
+            return server.add_insecure_port(address)
+        root = _read(self.client_auth_ca_file) if self.client_auth_ca_file else None
+        creds = grpc.ssl_server_credentials(
+            [(_read(self.key_file), _read(self.cert_file))],
+            root_certificates=root,
+            require_client_auth=bool(root) and not self.insecure_skip_client_verify,
+        )
+        return server.add_secure_port(address, creds)
+
+
+@dataclass
+class ClientConfig:
+    """config.go:51-69 ClientConfig."""
+
+    server_auth_ca_file: str = ""
+    cert_file: str = ""
+    key_file: str = ""
+    insecure_skip_server_verify: bool = False
+
+    @property
+    def secure(self) -> bool:
+        return bool(self.server_auth_ca_file) or self.insecure_skip_server_verify
+
+    def channel(self, address: str) -> grpc.Channel:
+        """DialWithTimeOut's credential selection (config.go:105-136):
+        insecure when neither a server CA nor skip-verify is set; TLS with
+        the CA as root otherwise; mutual TLS when a client cert pair is
+        also configured."""
+        if not self.secure:
+            return grpc.insecure_channel(address)
+        root = _read(self.server_auth_ca_file) if self.server_auth_ca_file else None
+        key = _read(self.key_file) if self.key_file else None
+        chain = _read(self.cert_file) if self.cert_file else None
+        creds = grpc.ssl_channel_credentials(
+            root_certificates=root, private_key=key, certificate_chain=chain
+        )
+        return grpc.secure_channel(address, creds)
+
+
+INSECURE_CLIENT = ClientConfig()
+INSECURE_SERVER = ServerConfig()

@@ -124,9 +124,13 @@ class EstimatorServer:
         workload_key_fn: Optional[Callable[[str, str, str], str]] = None,
         port: int = 0,
         max_workers: int = 16,
+        server_config=None,  # grpcconnection.ServerConfig; None = insecure
     ):
+        from .grpcconnection import INSECURE_SERVER
+
         self.estimators = estimators
         self.workload_key_fn = workload_key_fn or (lambda k, ns, n: f"{k}/{ns}/{n}")
+        self.server_config = server_config or INSECURE_SERVER
         self._server = grpc.server(futures.ThreadPoolExecutor(max_workers=max_workers))
         handlers = {
             "MaxAvailableReplicas": grpc.unary_unary_rpc_method_handler(
@@ -143,7 +147,10 @@ class EstimatorServer:
         self._server.add_generic_rpc_handlers(
             (grpc.method_handlers_generic_handler(_SERVICE, handlers),)
         )
-        self.port = self._server.add_insecure_port(f"127.0.0.1:{port}")
+        # TLS / mTLS per grpcconnection.ServerConfig (config.go:71-103);
+        # the default empty config binds plain like the reference's bare
+        # grpc.NewServer()
+        self.port = self.server_config.bind(self._server, f"127.0.0.1:{port}")
 
     def start(self, warm: bool = True) -> int:
         if warm:
@@ -191,9 +198,17 @@ class GrpcSchedulerEstimator:
     (EST3). One cached channel per cluster service address; concurrent
     fan-out with shared timeout; errors → -1 sentinel."""
 
-    def __init__(self, address_for: Callable[[str], Optional[str]], timeout: float = 5.0):
+    def __init__(
+        self,
+        address_for: Callable[[str], Optional[str]],
+        timeout: float = 5.0,
+        client_config=None,  # grpcconnection.ClientConfig; None = insecure
+    ):
+        from .grpcconnection import INSECURE_CLIENT
+
         self.address_for = address_for
         self.timeout = timeout
+        self.client_config = client_config or INSECURE_CLIENT
         self._channels: dict[str, grpc.Channel] = {}
         self._pool = ThreadPoolExecutor(max_workers=16)
 
@@ -203,7 +218,8 @@ class GrpcSchedulerEstimator:
             return None
         ch = self._channels.get(addr)
         if ch is None:
-            ch = grpc.insecure_channel(addr)
+            # credential selection mirrors DialWithTimeOut (config.go:105-136)
+            ch = self.client_config.channel(addr)
             self._channels[addr] = ch
         return ch
 

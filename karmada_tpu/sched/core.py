@@ -41,18 +41,78 @@ from ..ops import filters as filter_ops
 TOPK_TARGETS = 128
 
 
-@dataclass
 class ScheduleDecision:
-    key: str
-    targets: Optional[list[TargetCluster]] = None
-    error: str = ""  # non-empty ⇒ unschedulable / fit error
-    feasible: list[str] = field(default_factory=list)
-    score: Optional[np.ndarray] = None
-    affinity_name: str = ""  # applied ordered-affinity term (scheduler.go:562-625)
+    """Outcome for one binding.
+
+    Target/feasible lists materialize LAZILY from array-backed sources (SoA
+    decode): a region-HA selection can span hundreds of clusters per row, and
+    building those TargetCluster objects eagerly for 5k rows costs seconds of
+    host time before anything consumes them. Consumers see plain lists via
+    the `targets`/`feasible` properties; assigning a list works too."""
+
+    __slots__ = ("key", "error", "affinity_name", "score",
+                 "_targets", "_targets_src", "_feasible", "_feasible_src")
+
+    def __init__(self, key: str, targets=None, error: str = "",
+                 feasible=None, score=None, affinity_name: str = ""):
+        self.key = key
+        self.error = error  # non-empty ⇒ unschedulable / fit error
+        self.affinity_name = affinity_name  # applied ordered-affinity term
+        self.score = score
+        self._targets = targets
+        self._targets_src = None
+        self._feasible = feasible
+        self._feasible_src = None
 
     @property
     def ok(self) -> bool:
         return not self.error
+
+    @property
+    def targets(self) -> Optional[list[TargetCluster]]:
+        if self._targets is None and self._targets_src is not None:
+            src = self._targets_src
+            if src[0] == "pairs":  # pre-sorted (cluster idx, replicas) arrays
+                _, names, idxs, reps = src
+                self._targets = [
+                    TargetCluster(name=names[int(i)], replicas=int(r))
+                    for i, r in zip(idxs, reps)
+                ]
+            else:  # ("mask", names, packed_bits, n_cols, replicas_per_cluster)
+                from . import spread_batch
+
+                _, names, packed, n_cols, reps = src
+                self._targets = [
+                    TargetCluster(name=names[int(i)], replicas=int(reps))
+                    for i in spread_batch.unpack_row(packed, n_cols)
+                ]
+        return self._targets
+
+    @targets.setter
+    def targets(self, v) -> None:
+        self._targets = v
+        self._targets_src = None
+
+    @property
+    def feasible(self) -> list[str]:
+        if self._feasible is None and self._feasible_src is not None:
+            src = self._feasible_src
+            if src[0] == "mask":
+                from . import spread_batch
+
+                _, names, packed, n_cols = src
+                self._feasible = [
+                    names[int(i)] for i in spread_batch.unpack_row(packed, n_cols)
+                ]
+            else:  # ("idx", names, idx_array)
+                _, names, idxs = src
+                self._feasible = [names[int(i)] for i in idxs]
+        return self._feasible if self._feasible is not None else []
+
+    @feasible.setter
+    def feasible(self, v) -> None:
+        self._feasible = v
+        self._feasible_src = None
 
 
 def filter_estimate_phase(
@@ -274,6 +334,84 @@ def _schedule_kernel_compact(
     )
 
 
+@jax.jit
+def _filter_kernel_compact(
+    # fleet (device-resident)
+    alive, capacity, has_summary, taint_key, taint_value, taint_effect, api_ok,
+    # batch core
+    replicas, request, unknown_request, gvk,
+    tol_key, tol_value, tol_effect, tol_op,
+    # factored reconstruction inputs (static weights skipped: spread-batched
+    # rows are never static-weighted, select_clusters.go:63-77)
+    aff_masks, aff_idx, prev_idx, prev_rep, evict_idx, seeds,
+    extra_avail,
+):
+    """Filter + estimate ONLY — the phase-1 program for batches where every
+    row rides the batched spread path (their assignment re-runs over the
+    selected set anyway, so the full kernel's division work would be thrown
+    away). Returns device-resident (feasible, score, avail, prev_replicas,
+    tie) for the spread kernels to consume without a host round-trip."""
+    B = replicas.shape[0]
+    C = alive.shape[0]
+    rows = jnp.arange(B)[:, None]
+    affinity_ok = aff_masks[aff_idx]
+    p = jnp.where((prev_idx >= 0) & (prev_idx < C), prev_idx, C)
+    prev_member = jnp.zeros((B, C), bool).at[rows, p].set(True, mode="drop")
+    prev_replicas = (
+        jnp.zeros((B, C), jnp.int32).at[rows, p].set(prev_rep, mode="drop")
+    )
+    e = jnp.where((evict_idx >= 0) & (evict_idx < C), evict_idx, C)
+    eviction_ok = jnp.ones((B, C), bool).at[rows, e].set(False, mode="drop")
+    tie = _device_tie(seeds, C)
+    feasible, score, avail = filter_estimate_phase(
+        alive, capacity, has_summary, taint_key, taint_value, taint_effect,
+        api_ok, replicas, request, unknown_request, gvk,
+        tol_key, tol_value, tol_effect, tol_op,
+        affinity_ok, eviction_ok, prev_member,
+    )
+    extra = jnp.broadcast_to(extra_avail, (B, C))
+    avail = jnp.where(extra >= 0, jnp.minimum(avail, extra), avail)
+    return feasible, score, avail, prev_replicas, tie
+
+
+@jax.jit
+def _gather_rows_kernel(a, idx):
+    return a[idx]
+
+
+def _pad_rows_idx(rows: Sequence[int], bucket_fn) -> tuple[np.ndarray, int]:
+    """Pad a row-index list to a jit-cache-friendly bucket (pads repeat the
+    first row; callers slice the result back to len(rows))."""
+    n = len(rows)
+    b = bucket_fn(n)
+    idx = np.empty(b, np.int32)
+    idx[:n] = rows
+    idx[n:] = rows[0] if n else 0
+    return idx, n
+
+
+def fetch_rows(dev_array, rows: Sequence[int], bucket_fn) -> np.ndarray:
+    """Fetch a row subset of a device tensor: device-side gather + compact
+    transfer, never the full [B,C] fetch (200 MB at the flagship shape)."""
+    idx, n = _pad_rows_idx(rows, bucket_fn)
+    out = _gather_rows_kernel(dev_array, idx)
+    return np.asarray(jax.device_get(out))[:n]
+
+
+@partial(jax.jit, static_argnames=("n_cols",))
+def _row_context_kernel(prev_idx, prev_rep, seeds, n_cols: int):
+    """(prev_replicas, tie) dense rows for a row subset — the spread kernels
+    need them and the full schedule kernel keeps them internal."""
+    B = prev_idx.shape[0]
+    rows = jnp.arange(B)[:, None]
+    p = jnp.where((prev_idx >= 0) & (prev_idx < n_cols), prev_idx, n_cols)
+    prev_replicas = (
+        jnp.zeros((B, n_cols), jnp.int32).at[rows, p].set(prev_rep, mode="drop")
+    )
+    tie = _device_tie(seeds, n_cols)
+    return prev_replicas, tie
+
+
 def _restrict_rows(batch: BindingBatch, rows: list[int], affinity_override: np.ndarray) -> BindingBatch:
     """Row-subset of a batch with the spread-selection mask folded into the
     affinity mask (phase-2 candidate restriction). The override masks are
@@ -343,6 +481,11 @@ class ArrayScheduler:
                 rid = region_ids.setdefault(region, len(region_ids))
                 self._region_id[i] = rid
         self._region_names = list(region_ids)
+        from . import spread_batch
+
+        self._spread_layout = spread_batch.RegionLayout(
+            self._region_id, self._region_names, self._name_rank
+        )
         # per-resource capacity ceiling for the narrow-keys bound (host-side
         # proof that every division weight fits i32 — see _batch_flags)
         cap = np.asarray(self.fleet.capacity, np.int64)
@@ -539,78 +682,232 @@ class ArrayScheduler:
                 d.affinity_name = terms[term_idx[b]].affinity_name
         return decisions
 
+    def _classify_spread(self, bindings) -> tuple[list[int], dict, list[int]]:
+        """Split spread-constrained rows into the batched device path and the
+        per-row exact fallback (cluster-only constraints, cluster MaxGroups
+        caps, huge region counts, or divided rows wider than the compact
+        window). Placement-only — runs before any kernel."""
+        from . import spread as spread_mod
+        from . import spread_batch
+
+        batched, cfg_of, fallback = [], {}, []
+        layout = self._spread_layout
+        for b, rb in enumerate(bindings):
+            placement = rb.spec.placement
+            if placement is None or not placement.spread_constraints:
+                continue
+            if spread_mod.should_ignore_spread_constraint(placement):
+                continue
+            cfg = spread_batch.config_of(placement)
+            if (
+                cfg is not None
+                and 0 < layout.n_regions <= spread_batch.MAX_REGIONS
+                and (cfg.duplicated or rb.spec.replicas <= TOPK_TARGETS)
+            ):
+                batched.append(b)
+                cfg_of[b] = cfg
+            else:
+                fallback.append(b)
+        return batched, cfg_of, fallback
+
     def _schedule_once(
         self, bindings: Sequence, extra_avail=None, term_indices=None
     ) -> list[ScheduleDecision]:
+        from . import spread as spread_mod
+        from . import spread_batch
+
         raw = self.batch_encoder.encode(bindings, term_indices=term_indices)
         batch = self._pad(raw)
         if extra_avail is not None and len(extra_avail) < len(batch.replicas):
             pad = len(batch.replicas) - len(extra_avail)
             extra_avail = np.pad(extra_avail, [(0, pad), (0, 0)], constant_values=-1)
-        out = self.run_kernel(batch, extra_avail)
-        dev_feasible, dev_score, dev_result, dev_unsched, dev_avail_sum, dev_avail = out[:6]
-        # one batched device_get for the compact outputs (a single tunnel
-        # round-trip instead of one per array)
-        unsched, avail_sum, feas_count, nnz, top_idx, top_val = jax.device_get(
-            (dev_unsched, dev_avail_sum, out[6], out[7], out[8], out[9])
+        n_real = len(raw.keys)
+        names = self.fleet.names
+        C = len(names)
+
+        batched_rows, batched_cfg, fallback_rows = self._classify_spread(bindings)
+        # every row rides the batched spread path ⇒ phase 1 skips the
+        # division tail entirely (it would be recomputed over the selection)
+        all_batched = (
+            len(batched_rows) == n_real
+            and n_real > 0
+            and not fallback_rows
+            and self._mesh_kernel is None
         )
-        # the spread re-run overwrites per-row entries; device_get buffers are
-        # read-only views
-        unsched = np.array(unsched)
-        avail_sum = np.array(avail_sum)
-        feas_count = np.array(feas_count)
-        # dense tensors are fetched lazily: only the phases that need full
-        # rows (spread selection, non-workload target lists, top-K overflow)
-        dense_cache: dict[str, np.ndarray] = {}
 
-        def dense(name: str) -> np.ndarray:
-            a = dense_cache.get(name)
-            if a is None:
-                a = np.asarray({"feasible": dev_feasible, "score": dev_score,
-                                "result": dev_result, "avail": dev_avail}[name])
-                dense_cache[name] = a
-            return a
+        # sparse decode state, overlaid on the main kernel outputs
+        row_err: dict[int, str] = {}
+        row_target_src: dict[int, tuple] = {}
+        row_feas_src: dict[int, tuple] = {}
 
-        # Phase 2: spread-constrained rows restrict candidates via the host
-        # combinatorial selection (SelectClusters, common.go:32-39), then the
-        # assignment kernel re-runs over the restricted feasible set.
-        from . import spread as spread_mod
+        if all_batched:
+            dev_feasible, dev_score, dev_avail, dev_prev, dev_tie = (
+                _filter_kernel_compact(
+                    *self._fleet_dev,
+                    batch.replicas, batch.request, batch.unknown_request,
+                    batch.gvk, batch.tol_key, batch.tol_value, batch.tol_effect,
+                    batch.tol_op, batch.aff_masks, batch.aff_idx,
+                    batch.prev_idx, batch.prev_rep, batch.evict_idx, batch.seeds,
+                    self._NO_EXTRA if extra_avail is None else extra_avail,
+                )
+            )
+            unsched = np.zeros(n_real, bool)
+            avail_sum = np.zeros(n_real, np.int64)
+            feas_count = np.zeros(n_real, np.int64)  # filled from group kernel
+            nnz = top_idx = top_val = None
+        else:
+            out = self.run_kernel(batch, extra_avail)
+            dev_feasible, dev_score, dev_result, dev_avail = (
+                out[0], out[1], out[2], out[5],
+            )
+            dev_prev = dev_tie = None
+            unsched, avail_sum, feas_count, nnz, top_idx, top_val = jax.device_get(
+                (out[3], out[4], out[6], out[7], out[8], out[9])
+            )
+            unsched = np.array(unsched)[:n_real]
+            avail_sum = np.array(avail_sum)[:n_real]
+            feas_count = np.array(feas_count)[:n_real]
 
-        spread_errors: dict[int, str] = {}
-        spread_rows: list[int] = []
-        for b, rb in enumerate(bindings):
-            placement = rb.spec.placement
-            if (
-                placement is not None
-                and placement.spread_constraints
-                and feas_count[b] > 0
-                # statically-ignored constraints select every feasible cluster
-                # (select_clusters.go:63-77) — the restriction re-run is a no-op
-                and not spread_mod.should_ignore_spread_constraint(placement)
-            ):
-                spread_rows.append(b)
-        # sparse decode state; spread-restricted rows overwrite their entries
-        row_targets: dict[int, list[tuple[int, int]]] = {}
-        row_feasible: dict[int, np.ndarray] = {}
-        if spread_rows:
-            feasible = dense("feasible")
-            score = dense("score")
-            avail = dense("avail")
+        # ---- batched spread path: device group scoring → vectorized host
+        # combination search → packed selection masks + divided re-dispense
+        if batched_rows:
+            layout = self._spread_layout
+            idx_pad, nb = _pad_rows_idx(batched_rows, self._bucket)
+            g_feas = _gather_rows_kernel(dev_feasible, idx_pad)
+            g_score = _gather_rows_kernel(dev_score, idx_pad)
+            g_avail = _gather_rows_kernel(dev_avail, idx_pad)
+            if dev_prev is not None and nb == len(batch.replicas):
+                g_prev, g_tie = dev_prev, dev_tie
+            elif dev_prev is not None:
+                g_prev = _gather_rows_kernel(dev_prev, idx_pad)
+                g_tie = _gather_rows_kernel(dev_tie, idx_pad)
+            else:
+                g_prev, g_tie = _row_context_kernel(
+                    batch.prev_idx[idx_pad], batch.prev_rep[idx_pad],
+                    batch.seeds[idx_pad], n_cols=C,
+                )
+
+            S = len(idx_pad)
+            need = np.ones(S, np.int64)
+            target = np.ones(S, np.int64)
+            reps = np.zeros(S, np.int64)
+            dupf = np.zeros(S, bool)
+            for j, b in enumerate(batched_rows):
+                cfg = batched_cfg[b]
+                mg = max(cfg.rmin, 1)
+                need[j] = cfg.need
+                target[j] = -(-bindings[b].spec.replicas // mg)
+                reps[j] = bindings[b].spec.replicas
+                dupf[j] = cfg.duplicated
+            W, V, A, fc_dev = spread_batch.group_score_kernel(
+                g_feas, g_score, g_avail, g_prev,
+                reps, need, target, dupf, layout=layout,
+            )
+            W, V, fc = jax.device_get((W, V, fc_dev))
+            W = np.asarray(W)[:nb]
+            V = np.asarray(V)[:nb]
+            fc = np.asarray(fc)[:nb]
+            for j, b in enumerate(batched_rows):
+                feas_count[b] = fc[j]
+
+            from collections import defaultdict
+
+            j_by_cfg: dict = defaultdict(list)
+            for j, b in enumerate(batched_rows):
+                if fc[j] > 0:  # 0-feasible rows take the FitError branch
+                    j_by_cfg[batched_cfg[b]].append(j)
+            chosen = np.zeros((S, layout.n_regions), bool)
+            for cfg, js in j_by_cfg.items():
+                res = spread_batch.select_regions_batch(W[js], V[js], cfg, layout)
+                chosen[js] = res.chosen
+                for local, msg in res.errors.items():
+                    row_err[batched_rows[js[local]]] = msg
+                for local in res.fallback:
+                    fallback_rows.append(batched_rows[js[local]])
+            fallback_set = set(fallback_rows)
+
+            ok_js = [
+                j for j, b in enumerate(batched_rows)
+                if fc[j] > 0 and b not in row_err and b not in fallback_set
+            ]
+            if ok_js:
+                packed = np.asarray(jax.device_get(
+                    spread_batch.packed_selection_kernel(
+                        g_feas, chosen, layout=layout
+                    )
+                ))
+                div_js = []
+                for j in ok_js:
+                    b = batched_rows[j]
+                    row_feas_src[b] = ("mask", names, packed[j], C)
+                    strat = int(raw.strategy[b])
+                    if strat == NON_WORKLOAD:
+                        row_target_src[b] = ("mask", names, packed[j], C, 0)
+                    elif strat == DUPLICATED:
+                        row_target_src[b] = (
+                            "mask", names, packed[j], C,
+                            int(bindings[b].spec.replicas),
+                        )
+                    else:
+                        div_js.append(j)
+                if div_js:
+                    d_idx, nd = _pad_rows_idx(div_js, self._bucket)
+                    d_rows = [batched_rows[j] for j in div_js]
+                    d_feas = _gather_rows_kernel(g_feas, d_idx)
+                    d_avail = _gather_rows_kernel(g_avail, d_idx)
+                    d_prev = _gather_rows_kernel(g_prev, d_idx)
+                    d_tie = _gather_rows_kernel(g_tie, d_idx)
+                    d_chosen = chosen[d_idx]
+                    d_brows = np.asarray(
+                        [batched_rows[j] for j in d_idx], np.int64
+                    )
+                    d_strategy = raw.strategy[d_brows]
+                    d_replicas = raw.replicas[d_brows]
+                    d_fresh = raw.fresh[d_brows]
+                    topk_d, narrow_d, _ = self._batch_flags(batch)
+                    has_agg_d = bool((d_strategy == AGGREGATED).any())
+                    un2, as2, fc2, nnz2, ti2, tv2 = jax.device_get(
+                        spread_batch.spread_tail_kernel(
+                            d_feas, d_avail, d_prev, d_tie, d_chosen,
+                            d_strategy, d_replicas, d_fresh,
+                            layout=layout, topk=topk_d,
+                            narrow=narrow_d, has_agg=has_agg_d,
+                        )
+                    )
+                    ordd = np.argsort(
+                        np.where(tv2 > 0, ti2, np.int32(1 << 30)), axis=1,
+                        kind="stable",
+                    )
+                    ti2s = np.take_along_axis(ti2, ordd, 1)
+                    tv2s = np.take_along_axis(tv2, ordd, 1)
+                    for k, b in enumerate(d_rows):
+                        unsched[b] = bool(un2[k])
+                        avail_sum[b] = int(as2[k])
+                        feas_count[b] = int(fc2[k])
+                        n = int(nnz2[k])
+                        row_target_src[b] = ("pairs", names, ti2s[k, :n], tv2s[k, :n])
+
+        # ---- fallback spread path: the per-row exact selection + restricted
+        # re-run (sched/spread.py stays the semantic spec)
+        if fallback_rows:
+            fallback_rows = sorted(set(fallback_rows))
+            f_feas = fetch_rows(dev_feasible, fallback_rows, self._bucket)
+            f_score = fetch_rows(dev_score, fallback_rows, self._bucket)
+            f_avail = fetch_rows(dev_avail, fallback_rows, self._bucket)
             sub_affinity = raw.affinity_ok.copy()
-            prev_dense = raw.prev_replicas  # dense view materialized once
             live_rows = []
-            for b in spread_rows:
+            for k, b in enumerate(fallback_rows):
+                if not f_feas[k].any():
+                    continue  # FitError branch
                 rb = bindings[b]
-                # array fast path: per-row lexsort + cumsum group scoring over
-                # the kernel's rows — no per-cluster Python objects
-                # (group_clusters.go:88-330 semantics, parity-tested against
-                # the ClusterDetail implementation)
-                feas = np.nonzero(feasible[b])[0]
+                prev_row = np.zeros(C + 1, np.int32)
+                prev_row[raw.prev_idx[b]] = raw.prev_rep[b]
+                feas = np.nonzero(f_feas[k])[0]
                 try:
                     selected_idx = spread_mod.select_by_spread_arrays(
                         feas,
-                        score[b, feas],
-                        avail[b, feas].astype(np.int64) + prev_dense[b, feas],
+                        f_score[k, feas],
+                        f_avail[k, feas].astype(np.int64) + prev_row[feas],
                         self._name_rank[feas],
                         self._region_id[feas],
                         self._region_names,
@@ -618,9 +915,9 @@ class ArrayScheduler:
                         rb.spec.replicas,
                     )
                 except spread_mod.SpreadError as e:
-                    spread_errors[b] = str(e)
+                    row_err[b] = str(e)
                     continue
-                mask = np.zeros(len(self.fleet.names), bool)
+                mask = np.zeros(C, bool)
                 mask[selected_idx] = True
                 sub_affinity[b] &= mask
                 live_rows.append(b)
@@ -632,79 +929,81 @@ class ArrayScheduler:
                     sub_extra = extra_avail[live_rows]
                     pad = len(sub_batch.replicas) - len(sub_extra)
                     if pad:
-                        sub_extra = np.pad(sub_extra, [(0, pad), (0, 0)], constant_values=-1)
+                        sub_extra = np.pad(
+                            sub_extra, [(0, pad), (0, 0)], constant_values=-1
+                        )
                 s_out = self.run_kernel(sub_batch, sub_extra)
                 s_feas, s_result, s_unsched, s_avail_sum = jax.device_get(
                     (s_out[0], s_out[2], s_out[3], s_out[4])
                 )
                 for j, b in enumerate(live_rows):
-                    row_feasible[b] = np.nonzero(s_feas[j])[0]
-                    feas_count[b] = int(s_feas[j].sum())
+                    fidx = np.nonzero(s_feas[j])[0]
+                    row_feas_src[b] = ("idx", names, fidx)
+                    feas_count[b] = len(fidx)
                     pos = np.nonzero(s_result[j] > 0)[0]
-                    row_targets[b] = [(int(i), int(s_result[j, i])) for i in pos]
-                    unsched[b] = s_unsched[j]
-                    avail_sum[b] = s_avail_sum[j]
+                    row_target_src[b] = (
+                        "pairs", names, pos, s_result[j, pos].astype(np.int64)
+                    )
+                    unsched[b] = bool(s_unsched[j])
+                    avail_sum[b] = int(s_avail_sum[j])
 
-        names = self.fleet.names
-        C = len(names)
-        # rows whose target set overflowed the top-K window fetch dense rows
-        overflow = [
-            b for b in range(len(raw.keys))
-            if b not in row_targets and nnz[b] > top_idx.shape[1]
-        ]
-        # NON_WORKLOAD rows need the full feasible set as their target list
+        # ---- main-path decode sources (vectorized; no per-row Python sort)
+        if top_idx is not None:
+            Kw = top_idx.shape[1]
+            order = np.argsort(
+                np.where(top_val > 0, top_idx, np.int32(1 << 30)), axis=1,
+                kind="stable",
+            )
+            ti_sorted = np.take_along_axis(top_idx, order, 1)
+            tv_sorted = np.take_along_axis(top_val, order, 1)
+            overflow = [
+                b for b in range(n_real)
+                if b not in row_target_src and nnz[b] > Kw
+                and raw.strategy[b] != NON_WORKLOAD
+            ]
+            if overflow:
+                o_res = fetch_rows(dev_result, overflow, self._bucket)
+                for k, b in enumerate(overflow):
+                    pos = np.nonzero(o_res[k] > 0)[0]
+                    row_target_src[b] = (
+                        "pairs", names, pos, o_res[k, pos].astype(np.int64)
+                    )
         nonwork = [
-            b for b in range(len(raw.keys))
-            if raw.strategy[b] == NON_WORKLOAD and b not in row_feasible
+            b for b in range(n_real)
+            if raw.strategy[b] == NON_WORKLOAD and b not in row_feas_src
             and feas_count[b] > 0
         ]
-        if overflow:
-            result_dense = dense("result")
-            for b in overflow:
-                pos = np.nonzero(result_dense[b] > 0)[0]
-                row_targets[b] = [(int(i), int(result_dense[b, i])) for i in pos]
         if nonwork:
-            feasible_dense = dense("feasible")
-            for b in nonwork:
-                row_feasible[b] = np.nonzero(feasible_dense[b])[0]
+            nw_feas = fetch_rows(dev_feasible, nonwork, self._bucket)
+            for k, b in enumerate(nonwork):
+                fidx = np.nonzero(nw_feas[k])[0]
+                row_feas_src[b] = ("idx", names, fidx)
+                row_target_src[b] = (
+                    "pairs", names, fidx, np.zeros(len(fidx), np.int64)
+                )
 
+        # ---- build decisions ----
         out_decisions: list[ScheduleDecision] = []
         for b, key in enumerate(raw.keys):
             dec = ScheduleDecision(key=key)
-            if b in row_feasible:
-                dec.feasible = [names[i] for i in row_feasible[b]]
-            if b in spread_errors:
-                dec.error = spread_errors[b]
-                out_decisions.append(dec)
-                continue
-            if feas_count[b] == 0:
+            if b in row_feas_src:
+                dec._feasible_src = row_feas_src[b]
+            if b in row_err:
+                dec.error = row_err[b]
+            elif feas_count[b] == 0:
                 # FitError diagnosis (generic_scheduler.go:83-88)
                 dec.error = f"0/{C} clusters are available"
-                out_decisions.append(dec)
-                continue
-            if unsched[b]:
+            elif unsched[b]:
                 dec.error = (
                     f"Clusters available replicas {int(avail_sum[b])} are not "
                     "enough to schedule."
                 )
-                out_decisions.append(dec)
-                continue
-            if raw.strategy[b] == NON_WORKLOAD:
-                feas_idx = row_feasible.get(b, np.empty(0, np.int64))
-                dec.targets = [TargetCluster(name=names[i], replicas=0) for i in feas_idx]
-            elif b in row_targets:
-                dec.targets = [
-                    TargetCluster(name=names[i], replicas=rep)
-                    for i, rep in sorted(row_targets[b])
-                ]
+            elif b in row_target_src:
+                dec._targets_src = row_target_src[b]
             else:
-                # compact path: the top-K window holds every nonzero target
                 n = int(nnz[b])
-                pairs = sorted(
-                    (int(top_idx[b, k]), int(top_val[b, k])) for k in range(n)
+                dec._targets_src = (
+                    "pairs", names, ti_sorted[b, :n], tv_sorted[b, :n]
                 )
-                dec.targets = [
-                    TargetCluster(name=names[i], replicas=rep) for i, rep in pairs
-                ]
             out_decisions.append(dec)
         return out_decisions

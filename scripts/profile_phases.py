@@ -15,7 +15,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from bench import build_problem
+from bench import build_flagship
 
 
 def timeit(fn, label, iters=4):
@@ -34,7 +34,7 @@ def main():
     dev = jax.devices()[0]
     print(f"# backend={dev.platform} kind={dev.device_kind}", flush=True)
 
-    sched, bindings = build_problem(5000, 10000)
+    sched, bindings, _ = build_flagship(n_clusters=5000, n_bindings=10000)
     batch = sched._pad(sched.batch_encoder.encode(bindings))
     B = batch.replicas.shape[0]
     C = batch.n_clusters

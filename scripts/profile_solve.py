@@ -45,13 +45,13 @@ def main():
     import jax.numpy as jnp
     import numpy as np
 
-    from bench import build_problem
+    from bench import build_flagship
 
     dev = jax.devices()[0]
     print(f"# backend={dev.platform} kind={dev.device_kind}", flush=True)
 
     t0 = time.perf_counter()
-    sched, bindings = build_problem(args.clusters, args.bindings)
+    sched, bindings, _ = build_flagship(n_clusters=args.clusters, n_bindings=args.bindings)
     print(f"build_problem        {time.perf_counter()-t0:8.3f}s", flush=True)
 
     t0 = time.perf_counter()

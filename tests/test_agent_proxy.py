@@ -59,12 +59,21 @@ class TestPullAgent:
     def test_lease_expiry_marks_not_ready(self, cp):
         cp.members["pull-1"].healthy = False  # agent down: no renewals
         cp.tick(seconds=100)  # > 40s lease duration
+        # first NotReady observation is retained (condition debounce); the
+        # detector re-observes the expired lease on the next pass
+        assert cluster_ready(cp.store.get("Cluster", "pull-1"))
+        cp.tick(seconds=31)
         cluster = cp.store.get("Cluster", "pull-1")
         assert not cluster_ready(cluster)
         # recovery: agent back up → lease renews → detector restores Ready
-        # automatically (no manual probe), like the reference status controller
+        # automatically (no manual probe), like the reference status
+        # controller — debounced by the success threshold
+        # (cluster_condition_cache.go:44-84), so Ready only flips back once
+        # renewals have held for 30s
         cp.members["pull-1"].healthy = True
         cp.tick()
+        assert not cluster_ready(cp.store.get("Cluster", "pull-1"))  # retained
+        cp.tick(seconds=31)
         assert cluster_ready(cp.store.get("Cluster", "pull-1"))
 
 

@@ -131,6 +131,8 @@ def test_cluster_not_ready_scheduling_behavior():
     assert rb.spec.assigned_replicas() == 6
     before = {tc.name: tc.replicas for tc in rb.spec.clusters}
 
+    cp.set_member_ready("member1", False)  # debounced: sustain it
+    cp.tick(seconds=31)
     cp.set_member_ready("member1", False)
     cp.settle()
     rb = cp.store.get("ResourceBinding", "web-deployment", "default")

@@ -202,3 +202,153 @@ class TestDescheduler:
         assert sum(after.values()) == 10
         assert after.get("a", 0) <= 2
         assert after["b"] >= 8
+
+
+class TestEstimatorPluginFramework:
+    """EST4 plugin seam: RunEstimateReplicasPlugins + ResourceQuota plugin
+    (ref framework/interface.go:31-41, plugins/resourcequota/resourcequota.go)."""
+
+    def _gates(self, on=True):
+        from karmada_tpu.features import RESOURCE_QUOTA_ESTIMATE, FeatureGates
+
+        g = FeatureGates()
+        g.set(RESOURCE_QUOTA_ESTIMATE, on)
+        return g
+
+    def _quota(self, scopes=None, selector=None, hard=None, used=None):
+        from karmada_tpu.estimator import plugins as P
+
+        return P.ResourceQuota(
+            name="rq", namespace="demo",
+            scopes=scopes or [],
+            scope_selector=selector or [],
+            hard=hard or {}, used=used or {},
+        )
+
+    def _req(self, cpu=1.0, priority=""):
+        from karmada_tpu.api.meta import CPU
+        from karmada_tpu.api.work import ReplicaRequirements
+
+        return ReplicaRequirements(
+            resource_request={CPU: cpu}, namespace="demo",
+            priority_class_name=priority,
+        )
+
+    def test_priority_class_exists_scope(self):
+        from karmada_tpu.estimator import plugins as P
+
+        rq = self._quota(scopes=[P.SCOPE_PRIORITY_CLASS],
+                         hard={"requests.cpu": 10.0}, used={"requests.cpu": 4.0})
+        pl = P.ResourceQuotaEstimatorPlugin(lambda ns: [rq], gates=self._gates())
+        # no priority class on the pod -> Exists scope does not match -> noop
+        replicas, ret = pl.estimate(self._req(cpu=1.0))
+        assert ret.is_noop and replicas == P.MAX_INT32
+        # with a priority class: free 6 cpu / 1 cpu = 6
+        replicas, ret = pl.estimate(self._req(cpu=1.0, priority="high"))
+        assert ret.is_success and replicas == 6
+
+    def test_priority_class_in_selector(self):
+        from karmada_tpu.estimator import plugins as P
+
+        sel = [P.ScopedSelectorRequirement(
+            scope_name=P.SCOPE_PRIORITY_CLASS, operator=P.SCOPE_OP_IN,
+            values=["gold"],
+        )]
+        rq = self._quota(selector=sel, hard={"cpu": 4.0}, used={"cpu": 0.0})
+        pl = P.ResourceQuotaEstimatorPlugin(lambda ns: [rq], gates=self._gates())
+        r1, ret1 = pl.estimate(self._req(cpu=2.0, priority="gold"))
+        assert ret1.is_success and r1 == 2
+        r2, ret2 = pl.estimate(self._req(cpu=2.0, priority="silver"))
+        assert ret2.is_noop and r2 == P.MAX_INT32
+
+    def test_limits_rows_skipped_and_requests_merged(self):
+        from karmada_tpu.estimator import plugins as P
+
+        rq = self._quota(
+            scopes=[P.SCOPE_PRIORITY_CLASS],
+            hard={"limits.cpu": 1.0, "requests.cpu": 8.0},
+            used={"limits.cpu": 1.0, "requests.cpu": 0.0},
+        )
+        pl = P.ResourceQuotaEstimatorPlugin(lambda ns: [rq], gates=self._gates())
+        # limits.cpu (free 0) must NOT constrain; requests.cpu merges to cpu
+        replicas, ret = pl.estimate(self._req(cpu=1.0, priority="x"))
+        assert ret.is_success and replicas == 8
+
+    def test_uncovered_resource_does_not_bind(self):
+        from karmada_tpu.api.meta import MEMORY
+        from karmada_tpu.estimator import plugins as P
+
+        rq = self._quota(scopes=[P.SCOPE_PRIORITY_CLASS],
+                         hard={"requests.cpu": 2.0}, used={"requests.cpu": 0.0})
+        pl = P.ResourceQuotaEstimatorPlugin(lambda ns: [rq], gates=self._gates())
+        req = self._req(cpu=1.0, priority="x")
+        req.resource_request[MEMORY] = 64 * 1024.0**3  # quota has no memory row
+        replicas, ret = pl.estimate(req)
+        assert ret.is_success and replicas == 2
+
+    def test_unscoped_quota_never_constrains(self):
+        from karmada_tpu.estimator import plugins as P
+
+        rq = self._quota(hard={"cpu": 1.0}, used={"cpu": 0.0})
+        pl = P.ResourceQuotaEstimatorPlugin(lambda ns: [rq], gates=self._gates())
+        replicas, ret = pl.estimate(self._req(cpu=10.0, priority="x"))
+        assert ret.is_noop and replicas == P.MAX_INT32
+
+    def test_gate_disabled_noop(self):
+        from karmada_tpu.estimator import plugins as P
+
+        rq = self._quota(scopes=[P.SCOPE_PRIORITY_CLASS],
+                         hard={"cpu": 1.0}, used={"cpu": 0.0})
+        pl = P.ResourceQuotaEstimatorPlugin(
+            lambda ns: [rq], gates=self._gates(on=False))
+        replicas, ret = pl.estimate(self._req(cpu=10.0, priority="x"))
+        assert ret.is_noop and replicas == P.MAX_INT32
+
+    def test_zero_replica_is_unschedulable(self):
+        from karmada_tpu.estimator import plugins as P
+
+        rq = self._quota(scopes=[P.SCOPE_PRIORITY_CLASS],
+                         hard={"cpu": 1.0}, used={"cpu": 1.0})
+        pl = P.ResourceQuotaEstimatorPlugin(lambda ns: [rq], gates=self._gates())
+        replicas, ret = pl.estimate(self._req(cpu=1.0, priority="x"))
+        assert ret.is_unschedulable and replicas == 0
+
+    def test_merge_precedence(self):
+        from karmada_tpu.estimator import plugins as P
+
+        assert P.merge_results({}).is_noop
+        r = P.merge_results({"a": P.Result(P.SUCCESS), "b": P.Result(P.NO_OPERATION)})
+        assert r.is_success
+        r = P.merge_results({"a": P.Result(P.UNSCHEDULABLE), "b": P.Result(P.SUCCESS)})
+        assert r.is_unschedulable
+        r = P.merge_results(
+            {"a": P.Result(P.UNSCHEDULABLE), "b": P.Result(P.ERROR, err="boom")})
+        assert r.code == P.ERROR
+        r = P.merge_results({"a": P.Result(P.NO_OPERATION)})
+        assert r.is_noop
+
+    def test_framework_min_merges_into_node_estimate(self):
+        from karmada_tpu.api.meta import CPU, MEMORY, PODS
+        from karmada_tpu.estimator import plugins as P
+        from karmada_tpu.estimator.accurate import AccurateEstimator
+        from karmada_tpu.models.nodes import NodeSpec
+
+        GiB = 1024.0**3
+        nodes = [NodeSpec(name="n0", allocatable={CPU: 16.0, MEMORY: 64 * GiB, PODS: 110.0})]
+        rq = P.ResourceQuota(
+            name="rq", namespace="demo", scopes=[P.SCOPE_PRIORITY_CLASS],
+            hard={"requests.cpu": 3.0}, used={"requests.cpu": 0.0},
+        )
+        fw = P.EstimatorFramework([
+            P.ResourceQuotaEstimatorPlugin(lambda ns: [rq], gates=self._gates())
+        ])
+        est = AccurateEstimator(nodes, framework=fw)
+        req = self._req(cpu=1.0, priority="gold")
+        # node answer is 16; quota caps at 3
+        assert est.max_available_replicas(req) == 3
+        # without a priority class the quota scope doesn't match: node answer
+        req2 = self._req(cpu=1.0)
+        assert est.max_available_replicas(req2) == 16
+        # exhausted quota: Unschedulable short-circuits to 0
+        rq.used = {"requests.cpu": 3.0}
+        assert est.max_available_replicas(req) == 0

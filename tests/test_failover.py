@@ -176,6 +176,9 @@ def test_cluster_condition_taints_and_eviction_flow():
     failover eviction timeout ⇒ taint manager evicts ⇒ scheduler re-places."""
     cp = failover_plane()
     deploy_nginx(cp)
+    # sustained NotReady: observed past the condition debounce threshold
+    cp.set_member_ready("member2", False)
+    cp.tick(seconds=31)
     cp.set_member_ready("member2", False)
     cp.settle()
 
@@ -496,10 +499,62 @@ def test_remedy_actions_follow_cluster_conditions():
     assert cp.store.get("Cluster", "member1").status.remedy_actions == []
 
     cp.set_member_ready("member1", False)
+    cp.tick(seconds=31)
+    cp.set_member_ready("member1", False)
     cp.settle()
     assert cp.store.get("Cluster", "member1").status.remedy_actions == [ACTION_TRAFFIC_CONTROL]
     assert cp.store.get("Cluster", "member2").status.remedy_actions == []
 
+    # recovery is debounced (cluster_condition_cache.go:44-84): a single
+    # fresh True observation is retained until it has held success-threshold
+    cp.set_member_ready("member1", True)
+    cp.settle()
+    assert cp.store.get("Cluster", "member1").status.remedy_actions == [ACTION_TRAFFIC_CONTROL]
+    cp.tick(seconds=31)
     cp.set_member_ready("member1", True)
     cp.settle()
     assert cp.store.get("Cluster", "member1").status.remedy_actions == []
+
+
+def _ready_status(cluster):
+    from karmada_tpu.api.cluster import CLUSTER_CONDITION_READY
+
+    for c in cluster.status.conditions:
+        if c.type == CLUSTER_CONDITION_READY:
+            return c.status
+    return None
+
+
+def test_ready_condition_flap_suppression():
+    """A lease/probe flap INSIDE the failure threshold must not flip the
+    recorded Ready condition or fire any eviction
+    (ref cluster_condition_cache.go:44-84)."""
+    cp = failover_plane()
+    deploy_nginx(cp)
+
+    # seed the cache with a steady True observation (the status controller
+    # observes every cycle in the reference)
+    cp.set_member_ready("member1", True)
+    assert _ready_status(cp.store.get("Cluster", "member1")) == "True"
+
+    # flap: NotReady observed, then Ready again 5s later (inside threshold)
+    cp.set_member_ready("member1", False)
+    cp.settle()
+    cluster = cp.store.get("Cluster", "member1")
+    assert _ready_status(cluster) == "True"  # retained, never flipped
+    assert not cluster.spec.taints  # no not-ready taint -> no eviction path
+    cp.tick(seconds=5)
+    cp.set_member_ready("member1", True)
+    cp.settle()
+    cluster = cp.store.get("Cluster", "member1")
+    assert _ready_status(cluster) == "True"
+    assert not cluster.spec.taints
+    for rb in cp.store.list("ResourceBinding"):
+        assert not rb.spec.graceful_eviction_tasks
+
+    # a SUSTAINED failure (observed again after the threshold) does flip
+    cp.set_member_ready("member1", False)
+    cp.tick(seconds=31)
+    cp.set_member_ready("member1", False)
+    cp.settle()
+    assert _ready_status(cp.store.get("Cluster", "member1")) == "False"

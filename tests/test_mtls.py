@@ -1,0 +1,154 @@
+"""mTLS on the estimator gRPC seam (U3 — ref pkg/util/grpcconnection/config.go).
+
+Loopback round-trips of both RPCs over mutual TLS, plus rejection of
+uncertified clients when client auth is required."""
+import datetime
+
+import pytest
+
+from karmada_tpu.api.meta import CPU, MEMORY, PODS
+from karmada_tpu.api.work import ObjectReference, ReplicaRequirements
+from karmada_tpu.estimator.accurate import AccurateEstimator
+from karmada_tpu.estimator.grpcconnection import ClientConfig, ServerConfig
+from karmada_tpu.estimator.service import EstimatorServer, GrpcSchedulerEstimator
+from karmada_tpu.models.nodes import NodeSpec
+
+GiB = 1024.0**3
+
+
+def _make_cert(tmp_path, name, issuer_key=None, issuer_cert=None, is_ca=False):
+    """Self-signed CA or CA-signed leaf with localhost/127.0.0.1 SANs."""
+    from cryptography import x509
+    from cryptography.hazmat.primitives import hashes, serialization
+    from cryptography.hazmat.primitives.asymmetric import rsa
+    from cryptography.x509.oid import NameOID
+    import ipaddress
+
+    key = rsa.generate_private_key(public_exponent=65537, key_size=2048)
+    subject = x509.Name([x509.NameAttribute(NameOID.COMMON_NAME, name)])
+    now = datetime.datetime(2026, 1, 1)
+    builder = (
+        x509.CertificateBuilder()
+        .subject_name(subject)
+        .issuer_name(issuer_cert.subject if issuer_cert is not None else subject)
+        .public_key(key.public_key())
+        .serial_number(x509.random_serial_number())
+        .not_valid_before(now)
+        .not_valid_after(now + datetime.timedelta(days=3650))
+        .add_extension(
+            x509.BasicConstraints(ca=is_ca, path_length=None), critical=True
+        )
+        .add_extension(
+            x509.SubjectAlternativeName([
+                x509.DNSName("localhost"),
+                x509.IPAddress(ipaddress.ip_address("127.0.0.1")),
+            ]),
+            critical=False,
+        )
+    )
+    cert = builder.sign(issuer_key if issuer_key is not None else key, hashes.SHA256())
+    key_path = tmp_path / f"{name}.key"
+    cert_path = tmp_path / f"{name}.crt"
+    key_path.write_bytes(
+        key.private_bytes(
+            serialization.Encoding.PEM,
+            serialization.PrivateFormat.TraditionalOpenSSL,
+            serialization.NoEncryption(),
+        )
+    )
+    cert_path.write_bytes(cert.public_bytes(serialization.Encoding.PEM))
+    return key, cert, str(key_path), str(cert_path)
+
+
+@pytest.fixture(scope="module")
+def pki(tmp_path_factory):
+    tmp = tmp_path_factory.mktemp("pki")
+    ca_key, ca_cert, _, ca_path = _make_cert(tmp, "test-ca", is_ca=True)
+    _, _, skey, scrt = _make_cert(tmp, "server", issuer_key=ca_key, issuer_cert=ca_cert)
+    _, _, ckey, ccrt = _make_cert(tmp, "client", issuer_key=ca_key, issuer_cert=ca_cert)
+    return {"ca": ca_path, "server": (scrt, skey), "client": (ccrt, ckey)}
+
+
+def _server(pki, require_client=True):
+    est = AccurateEstimator(
+        [NodeSpec(name="n0", allocatable={CPU: 8.0, MEMORY: 32 * GiB, PODS: 110.0})]
+    )
+    est._pending["Deployment/demo/web"] = (3, 0.0)  # pending since t=0
+    scrt, skey = pki["server"]
+    cfg = ServerConfig(
+        cert_file=scrt, key_file=skey,
+        client_auth_ca_file=pki["ca"],
+        insecure_skip_client_verify=not require_client,
+    )
+    srv = EstimatorServer({"m1": est}, server_config=cfg)
+    port = srv.start(warm=False)
+    return srv, port
+
+
+class TestMutualTLS:
+    def test_mtls_round_trip_both_rpcs(self, pki):
+        srv, port = _server(pki)
+        try:
+            ccrt, ckey = pki["client"]
+            client = GrpcSchedulerEstimator(
+                address_for=lambda c: f"localhost:{port}",
+                timeout=5.0,
+                client_config=ClientConfig(
+                    server_auth_ca_file=pki["ca"],
+                    cert_file=ccrt, key_file=ckey,
+                ),
+            )
+            req = ReplicaRequirements(resource_request={CPU: 1.0})
+            (max_avail,) = client.max_available_replicas(["m1"], req, 10)
+            assert max_avail == 8
+            resource = ObjectReference(
+                api_version="apps/v1", kind="Deployment",
+                namespace="demo", name="web",
+            )
+            (unsched,) = client.get_unschedulable_replicas(["m1"], resource, 0.0)
+            assert unsched == 3
+        finally:
+            srv.stop()
+
+    def test_client_without_cert_rejected(self, pki):
+        srv, port = _server(pki, require_client=True)
+        try:
+            client = GrpcSchedulerEstimator(
+                address_for=lambda c: f"localhost:{port}",
+                timeout=2.0,
+                client_config=ClientConfig(server_auth_ca_file=pki["ca"]),
+            )
+            req = ReplicaRequirements(resource_request={CPU: 1.0})
+            # handshake fails -> the -1 discard sentinel (EST1 semantics)
+            (ans,) = client.max_available_replicas(["m1"], req, 10)
+            assert ans == -1
+        finally:
+            srv.stop()
+
+    def test_skip_client_verify_allows_bare_tls(self, pki):
+        srv, port = _server(pki, require_client=False)
+        try:
+            client = GrpcSchedulerEstimator(
+                address_for=lambda c: f"localhost:{port}",
+                timeout=5.0,
+                client_config=ClientConfig(server_auth_ca_file=pki["ca"]),
+            )
+            req = ReplicaRequirements(resource_request={CPU: 1.0})
+            (ans,) = client.max_available_replicas(["m1"], req, 10)
+            assert ans == 8
+        finally:
+            srv.stop()
+
+    def test_insecure_default_still_works(self):
+        est = AccurateEstimator(
+            [NodeSpec(name="n0", allocatable={CPU: 4.0, MEMORY: 16 * GiB, PODS: 110.0})]
+        )
+        srv = EstimatorServer({"m1": est})
+        port = srv.start(warm=False)
+        try:
+            client = GrpcSchedulerEstimator(address_for=lambda c: f"127.0.0.1:{port}")
+            req = ReplicaRequirements(resource_request={CPU: 1.0})
+            (ans,) = client.max_available_replicas(["m1"], req, 10)
+            assert ans == 4
+        finally:
+            srv.stop()

@@ -1,4 +1,6 @@
 """SpreadConstraint selection (BASELINE config 4: multi-dim HA)."""
+import random
+
 import pytest
 
 from karmada_tpu.api.meta import CPU, MEMORY
@@ -13,7 +15,11 @@ from karmada_tpu.api.policy import (
 )
 from karmada_tpu.sched import spread
 from karmada_tpu.sched.core import ArrayScheduler
-from karmada_tpu.testing.fixtures import new_cluster_with_resource
+from karmada_tpu.api.policy import (
+    ClusterPreferences,
+    DYNAMIC_WEIGHT_AVAILABLE_REPLICAS,
+)
+from karmada_tpu.testing.fixtures import new_cluster_with_resource, synthetic_fleet
 from tests.test_scheduler_core import make_binding, targets_dict
 
 GiB = 1024.0**3
@@ -288,3 +294,126 @@ class TestArrayParity:
                     ),
                 )
                 self.run_both(names, score, avail, regions, region_names, p, replicas)
+
+
+class TestBatchedSpreadParity:
+    """The batched device path (sched/spread_batch.py) must produce the same
+    decisions as the per-row exact path for every eligible placement shape;
+    ineligible shapes (cluster caps, ties) must route to the fallback."""
+
+    def _random_problem(self, seed, n_clusters=40, n_bindings=30):
+        rng = random.Random(seed)
+        clusters = synthetic_fleet(n_clusters, seed=seed, ready_fraction=0.95)
+        bindings = []
+        for i in range(n_bindings):
+            rmin = rng.randrange(1, 4)
+            rmax = rng.choice([0, rmin, rmin + 1, rmin + 2])
+            cons = [SpreadConstraint(
+                spread_by_field=SPREAD_BY_FIELD_REGION,
+                min_groups=rmin, max_groups=rmax,
+            )]
+            if rng.random() < 0.6:
+                cons.append(SpreadConstraint(
+                    spread_by_field=SPREAD_BY_FIELD_CLUSTER,
+                    min_groups=rng.randrange(0, 6), max_groups=0,
+                ))
+            kind = rng.choice(["dup", "dyn", "agg"])
+            if kind == "dup":
+                p = Placement(cluster_affinity=ClusterAffinity(), spread_constraints=cons)
+            else:
+                p = Placement(
+                    cluster_affinity=ClusterAffinity(),
+                    spread_constraints=cons,
+                    replica_scheduling=ReplicaSchedulingStrategy(
+                        replica_scheduling_type=REPLICA_SCHEDULING_DIVIDED,
+                        replica_division_preference=(
+                            "Aggregated" if kind == "agg" else "Weighted"
+                        ),
+                        weight_preference=None if kind == "agg" else ClusterPreferences(
+                            dynamic_weight=DYNAMIC_WEIGHT_AVAILABLE_REPLICAS
+                        ),
+                    ),
+                )
+            prev = {}
+            names = [c.name for c in clusters]
+            if rng.random() < 0.3:
+                for n in rng.sample(names, rng.randrange(1, 3)):
+                    prev[n] = rng.randrange(1, 5)
+            bindings.append(
+                make_binding(f"sp-{i}", rng.randrange(1, 80), p,
+                             cpu=rng.choice([0.5, 1.0, 2.0]), prev=prev)
+            )
+        return clusters, bindings
+
+    @pytest.mark.parametrize("seed", [0, 1, 2, 3, 4])
+    def test_batched_vs_exact(self, seed, monkeypatch):
+        clusters, bindings = self._random_problem(seed)
+
+        sched = ArrayScheduler(clusters)
+        got = sched.schedule(bindings)
+
+        # force EVERY row through the per-row exact path
+        from karmada_tpu.sched import spread_batch
+
+        monkeypatch.setattr(spread_batch, "config_of", lambda p: None)
+        sched2 = ArrayScheduler(clusters)
+        want = sched2.schedule(bindings)
+
+        for rb, g, w in zip(bindings, got, want):
+            assert g.ok == w.ok, f"{rb.name}: ok {g.ok} vs {w.ok} ({g.error!r} vs {w.error!r})"
+            if not g.ok:
+                assert g.error == w.error, rb.name
+                continue
+            gt = {t.name: t.replicas for t in g.targets}
+            wt = {t.name: t.replicas for t in w.targets}
+            assert gt == wt, f"{rb.name}: batched {gt} != exact {wt}"
+            assert sorted(g.feasible) == sorted(w.feasible), rb.name
+
+    def test_cluster_cap_routes_to_fallback(self):
+        clusters = synthetic_fleet(20, seed=9)
+        sched = ArrayScheduler(clusters)
+        p = Placement(
+            cluster_affinity=ClusterAffinity(),
+            spread_constraints=[
+                SpreadConstraint(spread_by_field=SPREAD_BY_FIELD_REGION,
+                                 min_groups=2, max_groups=0),
+                SpreadConstraint(spread_by_field=SPREAD_BY_FIELD_CLUSTER,
+                                 min_groups=2, max_groups=3),
+            ],
+        )
+        rb = make_binding("capped", 4, p, cpu=0.5)
+        batched, _, fallback = sched._classify_spread([rb])
+        assert batched == [] and fallback == [0]
+        (d,) = sched.schedule([rb])
+        assert d.ok and len(d.targets) <= 3
+
+
+def test_region_max_below_min_clamped_like_dfs():
+    """max_groups < min_groups: the DFS clamps max up to min
+    (select_groups.go:102-107) — the batched path must match, not error."""
+    clusters = synthetic_fleet(30, seed=3)
+    p = Placement(
+        cluster_affinity=ClusterAffinity(),
+        spread_constraints=[
+            SpreadConstraint(spread_by_field=SPREAD_BY_FIELD_REGION,
+                             min_groups=3, max_groups=2),
+        ],
+    )
+    rb = make_binding("clamp", 4, p, cpu=0.5)
+    sched = ArrayScheduler(clusters)
+    (got,) = sched.schedule([rb])
+
+    from karmada_tpu.sched import spread_batch
+    import pytest as _pytest
+
+    monkey = _pytest.MonkeyPatch()
+    monkey.setattr(spread_batch, "config_of", lambda pl: None)
+    try:
+        sched2 = ArrayScheduler(clusters)
+        (want,) = sched2.schedule([rb])
+    finally:
+        monkey.undo()
+    assert got.ok == want.ok, (got.error, want.error)
+    if got.ok:
+        assert {t.name: t.replicas for t in got.targets} == {
+            t.name: t.replicas for t in want.targets}
