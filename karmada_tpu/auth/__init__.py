@@ -1,0 +1,19 @@
+from .pki import (
+    AGENT_ORGANIZATION,
+    BootstrapToken,
+    BootstrapTokens,
+    CertificateAuthority,
+    InvalidToken,
+    IssuedCertificate,
+    SIGNER_NAME,
+)
+
+__all__ = [
+    "AGENT_ORGANIZATION",
+    "BootstrapToken",
+    "BootstrapTokens",
+    "CertificateAuthority",
+    "InvalidToken",
+    "IssuedCertificate",
+    "SIGNER_NAME",
+]

@@ -1,0 +1,194 @@
+"""In-process PKI for the register/agent bootstrap flow.
+
+Parity surface:
+- bootstrap tokens in the kubeadm "<id>.<secret>" format with TTL and
+  CA-cert-hash pinning (ref pkg/karmadactl/register/register.go:70-74,
+  304-308: token required, CACertHashes verified unless explicitly skipped;
+  pkg/karmadactl/cmdinit + util/bootstraptoken issue them);
+- CSR signing for the pull-mode agent identity
+  ("system:node:<cluster>"-style subject, ref register.go generates a
+  karmada-agent cert with O=system:nodes);
+- certificate rotation bookkeeping for the agent cert
+  (ref pkg/controllers/certificate/cert_rotation_controller.go:56-82 —
+  rotate when remaining/total lifetime <= threshold).
+
+EC P-256 keys keep issuance sub-millisecond; certificates are real x509
+(cryptography lib) so hashes/expiries behave like production artifacts. The
+clock is injectable: token TTL and cert rotation are tested deterministically.
+"""
+from __future__ import annotations
+
+import datetime
+import hashlib
+import secrets
+import string
+from dataclasses import dataclass
+from typing import Callable, Optional
+
+_EPOCH = datetime.datetime(1970, 1, 1)
+
+AGENT_ORGANIZATION = "system:nodes"
+SIGNER_NAME = "kubernetes.io/kube-apiserver-client-kubelet"  # cert_rotation_controller.go:57
+
+
+def _now_dt(now_s: float) -> datetime.datetime:
+    return _EPOCH + datetime.timedelta(seconds=now_s)
+
+
+@dataclass
+class IssuedCertificate:
+    cert_pem: bytes
+    key_pem: bytes
+    common_name: str
+    not_before: float  # seconds (injectable-clock domain)
+    not_after: float
+
+    def remaining_ratio(self, now_s: float) -> float:
+        total = self.not_after - self.not_before
+        if total <= 0:
+            return 0.0
+        return max(self.not_after - now_s, 0.0) / total
+
+
+class CertificateAuthority:
+    """The control plane's cluster CA (cmdinit generates one; agents trust
+    it via the discovery token CA hash)."""
+
+    def __init__(self, common_name: str = "karmada-ca",
+                 clock: Optional[Callable[[], float]] = None):
+        from cryptography import x509
+        from cryptography.hazmat.primitives import hashes, serialization
+        from cryptography.hazmat.primitives.asymmetric import ec
+        from cryptography.x509.oid import NameOID
+
+        self._clock = clock or (lambda: 0.0)
+        self._key = ec.generate_private_key(ec.SECP256R1())
+        now = _now_dt(self._clock())
+        name = x509.Name([x509.NameAttribute(NameOID.COMMON_NAME, common_name)])
+        self._cert = (
+            x509.CertificateBuilder()
+            .subject_name(name)
+            .issuer_name(name)
+            .public_key(self._key.public_key())
+            .serial_number(x509.random_serial_number())
+            .not_valid_before(now)
+            .not_valid_after(now + datetime.timedelta(days=3650))
+            .add_extension(x509.BasicConstraints(ca=True, path_length=None), critical=True)
+            .sign(self._key, hashes.SHA256())
+        )
+        self.ca_pem = self._cert.public_bytes(serialization.Encoding.PEM)
+
+    def cert_hash(self) -> str:
+        """kubeadm-style discovery hash: sha256 over the CA's SPKI DER
+        ("sha256:<hex>") — what --discovery-token-ca-cert-hash pins."""
+        from cryptography.hazmat.primitives import serialization
+
+        spki = self._cert.public_key().public_bytes(
+            serialization.Encoding.DER,
+            serialization.PublicFormat.SubjectPublicKeyInfo,
+        )
+        return "sha256:" + hashlib.sha256(spki).hexdigest()
+
+    def sign(
+        self,
+        common_name: str,
+        organizations: tuple[str, ...] = (),
+        ttl_seconds: float = 365 * 86400.0,
+    ) -> IssuedCertificate:
+        from cryptography import x509
+        from cryptography.hazmat.primitives import hashes, serialization
+        from cryptography.hazmat.primitives.asymmetric import ec
+        from cryptography.x509.oid import NameOID
+
+        key = ec.generate_private_key(ec.SECP256R1())
+        now_s = self._clock()
+        now = _now_dt(now_s)
+        attrs = [x509.NameAttribute(NameOID.COMMON_NAME, common_name)]
+        attrs.extend(
+            x509.NameAttribute(NameOID.ORGANIZATION_NAME, o) for o in organizations
+        )
+        cert = (
+            x509.CertificateBuilder()
+            .subject_name(x509.Name(attrs))
+            .issuer_name(self._cert.subject)
+            .public_key(key.public_key())
+            .serial_number(x509.random_serial_number())
+            .not_valid_before(now)
+            .not_valid_after(now + datetime.timedelta(seconds=ttl_seconds))
+            .sign(self._key, hashes.SHA256())
+        )
+        return IssuedCertificate(
+            cert_pem=cert.public_bytes(serialization.Encoding.PEM),
+            key_pem=key.private_bytes(
+                serialization.Encoding.PEM,
+                serialization.PrivateFormat.PKCS8,
+                serialization.NoEncryption(),
+            ),
+            common_name=common_name,
+            not_before=now_s,
+            not_after=now_s + ttl_seconds,
+        )
+
+
+class InvalidToken(Exception):
+    pass
+
+
+_TOKEN_CHARS = string.ascii_lowercase + string.digits
+
+
+def _rand(n: int) -> str:
+    return "".join(secrets.choice(_TOKEN_CHARS) for _ in range(n))
+
+
+@dataclass
+class BootstrapToken:
+    token_id: str  # 6 chars, public
+    secret: str  # 16 chars
+    expires_at: float
+    description: str = ""
+
+    @property
+    def token(self) -> str:
+        return f"{self.token_id}.{self.secret}"
+
+
+class BootstrapTokens:
+    """kubeadm-format bootstrap tokens with TTL (util/bootstraptoken)."""
+
+    DEFAULT_TTL_S = 24 * 3600.0  # cmdinit default: 24h
+
+    def __init__(self, clock: Optional[Callable[[], float]] = None):
+        self._clock = clock or (lambda: 0.0)
+        self._tokens: dict[str, BootstrapToken] = {}
+
+    def create(self, ttl_seconds: float = DEFAULT_TTL_S,
+               description: str = "") -> BootstrapToken:
+        t = BootstrapToken(
+            token_id=_rand(6),
+            secret=_rand(16),
+            expires_at=self._clock() + ttl_seconds,
+            description=description,
+        )
+        self._tokens[t.token_id] = t
+        return t
+
+    def list(self) -> list[BootstrapToken]:
+        now = self._clock()
+        return [t for t in self._tokens.values() if t.expires_at > now]
+
+    def delete(self, token_id: str) -> bool:
+        return self._tokens.pop(token_id, None) is not None
+
+    def validate(self, token: str) -> BootstrapToken:
+        """Raises InvalidToken on malformed/unknown/expired tokens
+        (register.go:304: token is required and must validate)."""
+        tid, sep, secret = token.partition(".")
+        if not sep or len(tid) != 6 or len(secret) != 16:
+            raise InvalidToken("token must be of the form <6 chars>.<16 chars>")
+        t = self._tokens.get(tid)
+        if t is None or not secrets.compare_digest(t.secret, secret):
+            raise InvalidToken("unknown or mismatched bootstrap token")
+        if t.expires_at <= self._clock():
+            raise InvalidToken("bootstrap token expired")
+        return t

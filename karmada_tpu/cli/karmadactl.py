@@ -87,11 +87,126 @@ def cmd_join(cp: ControlPlane, name: str, **kw) -> str:
     return _bootstrap_member(cp, name, "Push", "joined", **kw)
 
 
-def cmd_register(cp: ControlPlane, name: str, **kw) -> str:
-    """Pull-mode registration: the agent creates the Cluster object itself
-    (agent.go:437 generateClusterInControllerPlane); here we simulate the
-    agent's bootstrap by joining with SyncMode=Pull."""
+def cmd_register(cp: ControlPlane, name: str, *, token: str = "",
+                 ca_cert_hash: str = "", skip_ca_verification: bool = False,
+                 **kw) -> str:
+    """Pull-mode registration with the token/CSR bootstrap handshake
+    (pkg/karmadactl/register/register.go:70-74,304-308):
+
+      1. the bootstrap token must validate against the control plane's
+         token store (token is required);
+      2. discovery pins the cluster CA via --discovery-token-ca-cert-hash
+         unless --discovery-token-unsafe-skip-ca-verification;
+      3. the agent identity cert is CSR-signed by the cluster CA
+         (CN system:node:<name>, O system:nodes) at join.
+    """
+    from ..auth import InvalidToken
+
+    if not token:
+        raise CLIError("token is required")
+    try:
+        cp.bootstrap_tokens.validate(token)
+    except InvalidToken as e:
+        raise CLIError(f"invalid bootstrap token: {e}") from None
+    if not skip_ca_verification:
+        if not ca_cert_hash:
+            raise CLIError(
+                "need to verify CACertHashes, or set "
+                "--discovery-token-unsafe-skip-ca-verification=true"
+            )
+        if ca_cert_hash != cp.pki.cert_hash():
+            raise CLIError("CA cert hash does not match the cluster CA")
     return _bootstrap_member(cp, name, "Pull", "registered", **kw)
+
+
+def cmd_token(cp: ControlPlane, action: str, token_id: str = "",
+              print_register_command: bool = False) -> str:
+    """karmadactl token create/list/delete (util/bootstraptoken)."""
+    if action == "create":
+        t = cp.bootstrap_tokens.create()
+        if print_register_command:
+            return (
+                f"karmadactl register <endpoint> --token {t.token} "
+                f"--discovery-token-ca-cert-hash {cp.pki.cert_hash()}"
+            )
+        return t.token
+    if action == "list":
+        lines = [
+            f"{t.token_id}\texpires={t.expires_at:.0f}\t{t.description}"
+            for t in cp.bootstrap_tokens.list()
+        ]
+        return "\n".join(lines) if lines else "no bootstrap tokens"
+    if action == "delete":
+        if not cp.bootstrap_tokens.delete(token_id.partition(".")[0]):
+            raise CLIError(f"token {token_id!r} not found")
+        return f"token {token_id} deleted"
+    raise CLIError(f"unknown token action {action!r}")
+
+
+class Management:
+    """The target of karmadactl init/deinit: a management store running the
+    operator (the reference installs the control plane into a host cluster;
+    here the operator's workflow engine materializes live ControlPlanes,
+    ref pkg/karmadactl/cmdinit + operator/pkg/tasks/{init,deinit})."""
+
+    def __init__(self, clock=None):
+        from ..operator.operator import KarmadaOperator
+        from ..runtime.controller import Runtime
+        from ..store.store import Store
+
+        self.runtime = Runtime(clock=clock)
+        self.store = Store()
+        self.operator = KarmadaOperator(self.store, self.runtime)
+
+    def plane(self, name: str) -> Optional[ControlPlane]:
+        return self.operator.plane(name)
+
+
+def cmd_init(mgmt: Management, name: str = "karmada",
+             components: Optional[list[str]] = None,
+             feature_gates: Optional[dict[str, bool]] = None) -> str:
+    """karmadactl init: run the install workflow and leave a live plane
+    behind (cmdinit's phases: validate → control plane → components)."""
+    from ..api.meta import ObjectMeta
+    from ..operator.operator import (
+        DEFAULT_COMPONENTS,
+        KarmadaInstance,
+        KarmadaInstanceSpec,
+    )
+
+    if mgmt.plane(name) is not None:
+        raise CLIError(f"control plane {name} already installed")
+    inst = KarmadaInstance(
+        metadata=ObjectMeta(name=name),
+        spec=KarmadaInstanceSpec(
+            components=list(components or DEFAULT_COMPONENTS),
+            feature_gates=dict(feature_gates or {}),
+        ),
+    )
+    mgmt.store.create(inst)
+    mgmt.runtime.settle()
+    plane = mgmt.plane(name)
+    if plane is None:
+        inst = mgmt.store.get("KarmadaInstance", name)
+        raise CLIError(f"init failed (phase {inst.status.phase})")
+    token = plane.bootstrap_tokens.create(description="init bootstrap")
+    return (
+        f"control plane {name} installed\n"
+        f"register command:\n"
+        f"  karmadactl register <endpoint> --token {token.token} "
+        f"--discovery-token-ca-cert-hash {plane.pki.cert_hash()}"
+    )
+
+
+def cmd_deinit(mgmt: Management, name: str = "karmada") -> str:
+    """karmadactl deinit: tear the installed plane down."""
+    if mgmt.store.try_get("KarmadaInstance", name) is None:
+        raise CLIError(f"control plane {name} not found")
+    mgmt.store.delete("KarmadaInstance", name)
+    mgmt.runtime.settle()
+    if mgmt.plane(name) is not None:
+        raise CLIError(f"deinit failed: plane {name} still running")
+    return f"control plane {name} removed"
 
 
 def _remove_cluster(cp: ControlPlane, name: str) -> None:
@@ -454,6 +569,16 @@ def run(cp: ControlPlane, argv: list[str]) -> str:
         p.add_argument("--provider", default="")
         p.add_argument("--region", default="")
         p.add_argument("--zone", default="")
+        if cmd == "register":
+            p.add_argument("--token", default="")
+            p.add_argument("--discovery-token-ca-cert-hash", dest="ca_cert_hash",
+                           default="")
+            p.add_argument("--discovery-token-unsafe-skip-ca-verification",
+                           dest="skip_ca_verification", action="store_true")
+    p = sub.add_parser("token")
+    p.add_argument("action", choices=["create", "list", "delete"])
+    p.add_argument("token_id", nargs="?", default="")
+    p.add_argument("--print-register-command", action="store_true")
     for cmd in ("unjoin", "unregister", "cordon", "uncordon"):
         p = sub.add_parser(cmd)
         p.add_argument("name")
@@ -502,9 +627,18 @@ def run(cp: ControlPlane, argv: list[str]) -> str:
 
     args = parser.parse_args(argv)
 
-    if args.command in ("join", "register"):
-        fn = cmd_join if args.command == "join" else cmd_register
-        return fn(cp, args.name, provider=args.provider, region=args.region, zone=args.zone)
+    if args.command == "join":
+        return cmd_join(cp, args.name, provider=args.provider,
+                        region=args.region, zone=args.zone)
+    if args.command == "register":
+        return cmd_register(
+            cp, args.name, token=args.token, ca_cert_hash=args.ca_cert_hash,
+            skip_ca_verification=args.skip_ca_verification,
+            provider=args.provider, region=args.region, zone=args.zone,
+        )
+    if args.command == "token":
+        return cmd_token(cp, args.action, args.token_id,
+                         print_register_command=args.print_register_command)
     if args.command == "unjoin":
         return cmd_unjoin(cp, args.name)
     if args.command == "unregister":

@@ -1,0 +1,45 @@
+"""Agent certificate rotation (ref pkg/controllers/certificate/
+cert_rotation_controller.go:54-298).
+
+The pull-mode agent's client certificate is re-issued when its remaining
+lifetime ratio drops to the rotation threshold (reference default 0.1,
+checked every CertRotationCheckingInterval). The control plane signs the
+new cert with the cluster CA under the kubelet-client signer name — our CSR
+round-trip is the `signer` callable (ControlPlane.sign_agent_cert)."""
+from __future__ import annotations
+
+from typing import Callable
+
+from ..auth import IssuedCertificate
+
+DEFAULT_ROTATION_THRESHOLD = 0.1  # cert_rotation_controller.go:82
+
+
+class CertRotationController:
+    def __init__(
+        self,
+        agents: dict,  # cluster name -> KarmadaAgent (live view)
+        signer: Callable[[str], IssuedCertificate],
+        clock,
+        threshold: float = DEFAULT_ROTATION_THRESHOLD,
+    ):
+        self.agents = agents
+        self.signer = signer
+        self.clock = clock
+        self.threshold = threshold
+        self.rotations = 0
+
+    def tick(self) -> int:
+        """Check every pull agent's cert; rotate the expiring ones. Returns
+        how many were rotated this pass."""
+        now = self.clock.now()
+        rotated = 0
+        for name, agent in self.agents.items():
+            cert = getattr(agent, "cert", None)
+            if cert is None:
+                continue
+            if cert.remaining_ratio(now) <= self.threshold:
+                agent.cert = self.signer(name)
+                rotated += 1
+                self.rotations += 1
+        return rotated

@@ -67,6 +67,13 @@ from .interpreter.interpreter import ResourceInterpreter
 from .agent import KarmadaAgent
 from .agent.agent import LeaseFailureDetector, REASON_LEASE_EXPIRED
 from .members.member import InMemoryMember, MemberConfig
+from .auth import (
+    AGENT_ORGANIZATION,
+    BootstrapTokens,
+    CertificateAuthority,
+    IssuedCertificate,
+)
+from .controllers.certificate import CertRotationController
 from .controllers.condition_cache import ClusterConditionCache
 from .metricsadapter import MetricsAdapter
 from .proxy import ClusterProxy
@@ -151,6 +158,15 @@ class ControlPlane:
             self.interpreter,
             self.runtime,
             pull_clusters=self.agents.keys(),  # live view: agents join later
+        )
+        # cluster CA + bootstrap tokens (cmdinit generates these; the
+        # register token/CSR handshake and agent cert rotation consume them)
+        self.pki = CertificateAuthority(clock=lambda: self.runtime.clock.now())
+        self.bootstrap_tokens = BootstrapTokens(
+            clock=lambda: self.runtime.clock.now()
+        )
+        self.cert_rotation_controller = CertRotationController(
+            self.agents, self.sign_agent_cert, self.runtime.clock
         )
         self.condition_cache = ClusterConditionCache(
             self.runtime.clock,
@@ -303,9 +319,19 @@ class ControlPlane:
         if config.sync_mode == "Pull":
             # the member runs its own agent (L7): execution + lease heartbeat
             agent = KarmadaAgent(self.store, member, self.interpreter, self.runtime)
+            # the agent identity cert the register CSR flow would have issued
+            agent.cert = self.sign_agent_cert(config.name)
             self.agents[config.name] = agent
             agent.heartbeat()
         return member
+
+    def sign_agent_cert(self, cluster: str, ttl_seconds: float = 365 * 86400.0) -> IssuedCertificate:
+        """Sign the karmada-agent client identity for a pull cluster
+        (register.go's CSR: CN system:node:<name>, O system:nodes)."""
+        return self.pki.sign(
+            f"system:node:{cluster}", organizations=(AGENT_ORGANIZATION,),
+            ttl_seconds=ttl_seconds,
+        )
 
     def set_member_ready(self, name: str, ready: bool, reason: str = "") -> None:
         """Record a Ready observation through the flap-suppression cache
@@ -344,6 +370,7 @@ class ControlPlane:
         if seconds:
             self.runtime.clock.advance(seconds)
         self.cluster_taint_controller.tick()
+        self.cert_rotation_controller.tick()
         if self.taint_manager is not None:
             self.taint_manager.tick()
         self.application_failover_controller.tick()

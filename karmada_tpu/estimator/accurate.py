@@ -13,7 +13,6 @@ from __future__ import annotations
 import time
 from typing import Optional, Sequence
 
-import jax
 import numpy as np
 
 from ..api.meta import Resources
@@ -26,7 +25,23 @@ from ..models.nodes import (
     tolerations_cover_node_taints,
 )
 from ..native import first_fit_place
-from ..ops.estimate import cluster_estimate
+
+_I32_MAX = np.int64(2**31 - 1)
+
+
+def _np_cluster_estimate(alloc, requested, pod_count, allowed_pods, request, node_ok):
+    """numpy twin of ops/estimate.cluster_estimate — bit-identical integer
+    math (estimate.go:59-112), kept host-side for member-local calls."""
+    rest = alloc - requested  # i64[N,R]
+    has_req = request > 0  # [B,R]
+    req = np.maximum(request, 1)[:, None, :]  # [B,1,R]
+    per_res = np.where(has_req[:, None, :], rest[None, :, :] // req, _I32_MAX)
+    per_node = per_res.min(-1)  # [B,N]
+    pods_left = np.maximum(allowed_pods - pod_count.astype(np.int64), 0)
+    per_node = np.minimum(per_node, pods_left[None, :])
+    per_node = np.clip(per_node, 0, _I32_MAX)
+    per_node = np.where(node_ok, per_node, 0)
+    return np.clip(per_node.sum(-1), 0, _I32_MAX).astype(np.int32)
 
 
 class AccurateEstimator:
@@ -46,7 +61,9 @@ class AccurateEstimator:
         self._pods: dict[str, list[tuple[int, int, np.ndarray]]] = {}
         self._node_ok_cache: dict[str, np.ndarray] = {}
         self._pending: dict[str, tuple[int, float]] = {}  # key -> (count, since)
-        self._estimate = jax.jit(cluster_estimate)
+        # bumped on every node-state mutation (pod placement); lets fleet-
+        # level caches (client.MemberEstimators) know when to re-snapshot
+        self.version = 0
 
     # -- estimation (the gRPC answer) -------------------------------------
 
@@ -87,7 +104,14 @@ class AccurateEstimator:
             ]
         )
         node_ok = np.stack([self._node_ok(r) for r in requirements_list])
-        out = self._estimate(
+        # Member-side compute runs in plain numpy ON PURPOSE: the estimator
+        # daemon lives on the member cluster's CPUs in the reference
+        # deployment, and these [B, N, R] slabs are tiny — routing each call
+        # through jax would ship them to the control plane's accelerator
+        # (per-call dispatch + tunnel RTT dominated BASELINE config 3 by
+        # ~8x). The device-resident form of this math is the scheduler-side
+        # capacity matrix (ops/estimate.fleet_estimate + general_estimate).
+        out = _np_cluster_estimate(
             self.arrays.alloc,
             self.arrays.requested,
             self.arrays.pod_count,
@@ -95,7 +119,7 @@ class AccurateEstimator:
             request,
             node_ok,
         )
-        res = [int(v) for v in np.asarray(out)]
+        res = [int(v) for v in out]
         if self.framework is not None:
             # RunEstimateReplicasPlugins min-merge (estimate.go:78-101):
             # Unschedulable => 0; Success bounds the node sum; NoOperation
@@ -150,6 +174,7 @@ class AccurateEstimator:
             a.alloc, a.requested, a.pod_count, a.allowed_pods,
             node_ok, req.astype(np.int64), replicas,
         )
+        self.version += 1
         placed = [
             (i, int(fits[i]), req) for i in np.nonzero(fits)[0]
         ]
@@ -165,7 +190,10 @@ class AccurateEstimator:
         return replicas - remaining
 
     def unplace(self, workload_key: str) -> None:
-        for i, count, req in self._pods.pop(workload_key, []):
+        removed = self._pods.pop(workload_key, [])
+        for i, count, req in removed:
             self.arrays.requested[i] -= req * count
             self.arrays.pod_count[i] -= count
+        if removed:
+            self.version += 1
         self._pending.pop(workload_key, None)

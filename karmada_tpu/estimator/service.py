@@ -170,13 +170,25 @@ class EstimatorServer:
     # -- handlers ---------------------------------------------------------
 
     def _max_available(self, request: pb.MaxAvailableReplicasRequest, context):
-        est = self.estimators.get(request.cluster)
-        if est is None:
-            context.abort(grpc.StatusCode.NOT_FOUND, f"unknown cluster {request.cluster}")
-        requirements = requirements_from_pb(request.replicaRequirements)
-        return pb.MaxAvailableReplicasResponse(
-            maxReplicas=est.max_available_replicas(requirements)
-        )
+        from ..tracing import Trace
+
+        trace = Trace("Estimating", {"cluster": request.cluster})
+        try:
+            est = self.estimators.get(request.cluster)
+            if est is None:
+                context.abort(
+                    grpc.StatusCode.NOT_FOUND, f"unknown cluster {request.cluster}"
+                )
+            requirements = requirements_from_pb(request.replicaRequirements)
+            trace.step("Snapshotting estimator cache and node infos done")
+            resp = pb.MaxAvailableReplicasResponse(
+                maxReplicas=est.max_available_replicas(requirements)
+            )
+            trace.step("Computing estimation done")
+            return resp
+        finally:
+            # slow-estimate span logging (ref estimate.go:37-38: > 100 ms)
+            trace.log_if_long()
 
     def _unschedulable(self, request: pb.UnschedulableReplicasRequest, context):
         est = self.estimators.get(request.cluster)

@@ -10,6 +10,7 @@ the event payload).
 """
 from __future__ import annotations
 
+import threading
 import time
 from collections import OrderedDict
 from dataclasses import dataclass, field
@@ -24,36 +25,47 @@ class WorkQueue:
     """Dedup'ing FIFO with retry backoff bookkeeping (reference:
     workqueue.RateLimitingInterface; backoff envelope 1s→10s per
     scheduling_queue.go:43-51 — in the in-process runtime, backoff is a retry
-    counter consulted by the drain loop rather than wall-clock sleeps)."""
+    counter consulted by the drain loop rather than wall-clock sleeps).
+
+    Thread-safe: watch handlers enqueue from whatever thread mutated the
+    store while drain loops pop concurrently (the reference's workqueue is
+    the same cross-goroutine seam)."""
 
     def __init__(self, max_retries: int = 16):
         self._items: OrderedDict[str, None] = OrderedDict()
         self._retries: dict[str, int] = {}
+        self._lock = threading.Lock()
         self.max_retries = max_retries
 
     def add(self, key: str) -> None:
-        if key not in self._items:
-            self._items[key] = None
+        with self._lock:
+            if key not in self._items:
+                self._items[key] = None
 
     def pop(self) -> Optional[str]:
-        if not self._items:
-            return None
-        key, _ = self._items.popitem(last=False)
-        return key
+        with self._lock:
+            if not self._items:
+                return None
+            key, _ = self._items.popitem(last=False)
+            return key
 
     def retry(self, key: str) -> bool:
-        n = self._retries.get(key, 0) + 1
-        self._retries[key] = n
-        if n > self.max_retries:
-            return False
-        self.add(key)
-        return True
+        with self._lock:
+            n = self._retries.get(key, 0) + 1
+            self._retries[key] = n
+            if n > self.max_retries:
+                return False
+            if key not in self._items:
+                self._items[key] = None
+            return True
 
     def forget(self, key: str) -> None:
-        self._retries.pop(key, None)
+        with self._lock:
+            self._retries.pop(key, None)
 
     def __len__(self) -> int:
-        return len(self._items)
+        with self._lock:
+            return len(self._items)
 
 
 @dataclass

@@ -341,16 +341,16 @@ def _filter_kernel_compact(
     # batch core
     replicas, request, unknown_request, gvk,
     tol_key, tol_value, tol_effect, tol_op,
-    # factored reconstruction inputs (static weights skipped: spread-batched
-    # rows are never static-weighted, select_clusters.go:63-77)
+    # factored reconstruction inputs (static weights skipped: the division
+    # tail decompresses them itself for its row subset)
     aff_masks, aff_idx, prev_idx, prev_rep, evict_idx, seeds,
     extra_avail,
 ):
-    """Filter + estimate ONLY — the phase-1 program for batches where every
-    row rides the batched spread path (their assignment re-runs over the
-    selected set anyway, so the full kernel's division work would be thrown
-    away). Returns device-resident (feasible, score, avail, prev_replicas,
-    tie) for the spread kernels to consume without a host round-trip."""
+    """Filter + estimate ONLY — phase 1 of the partitioned schedule round.
+    The division tail runs separately on just the rows that need it
+    (_tail_kernel): duplicated/non-workload/spread rows never pay the [B,C]
+    dispenser sorts. Returns device-resident (feasible, score, avail,
+    prev_replicas, tie, feas_count)."""
     B = replicas.shape[0]
     C = alive.shape[0]
     rows = jnp.arange(B)[:, None]
@@ -371,7 +371,37 @@ def _filter_kernel_compact(
     )
     extra = jnp.broadcast_to(extra_avail, (B, C))
     avail = jnp.where(extra >= 0, jnp.minimum(avail, extra), avail)
-    return feasible, score, avail, prev_replicas, tie
+    return feasible, score, avail, prev_replicas, tie, feasible.sum(-1).astype(jnp.int32)
+
+
+@partial(jax.jit, static_argnames=("topk", "narrow", "has_agg"))
+def _tail_kernel(
+    feasible, avail, prev_replicas, tie,  # gathered rows of the filter phase
+    weight_tables, weight_idx, strategy, replicas, fresh,
+    topk: int, narrow: bool, has_agg: bool,
+):
+    """Division tail over a row SUBSET (phase 2): the [B,C] dispenser sorts
+    run only on rows whose strategy divides replicas; the agg-only
+    truncation sort compiles in solely for the Aggregated sub-batch
+    (has_agg) — at the flagship mix this halves the sort volume vs the
+    monolithic kernel."""
+    static_weight = weight_tables[weight_idx]
+    result, unschedulable, avail_sum = assignment_tail(
+        feasible, strategy, static_weight, avail, prev_replicas, tie,
+        replicas, fresh, narrow=narrow, has_agg=has_agg,
+    )
+    C = feasible.shape[1]
+    _, nnz, top_idx, top_val = compact_outputs(feasible, result, min(C, topk))
+    return result, unschedulable, avail_sum, nnz, top_idx, top_val
+
+
+@jax.jit
+def _pack_rows_kernel(feasible):
+    """Bit-packed feasible masks for duplicated / non-workload rows — their
+    target list IS the feasible set, complete in C/8 bytes per row."""
+    from . import spread_batch
+
+    return spread_batch._pack_bits(feasible)
 
 
 @jax.jit
@@ -702,6 +732,7 @@ class ArrayScheduler:
             if (
                 cfg is not None
                 and 0 < layout.n_regions <= spread_batch.MAX_REGIONS
+                and layout.grid_balanced  # skewed fleets: exact path
                 and (cfg.duplicated or rb.spec.replicas <= TOPK_TARGETS)
             ):
                 batched.append(b)
@@ -713,60 +744,206 @@ class ArrayScheduler:
     def _schedule_once(
         self, bindings: Sequence, extra_avail=None, term_indices=None
     ) -> list[ScheduleDecision]:
+        if self._mesh_kernel is None:
+            return self._schedule_once_partitioned(
+                bindings, extra_avail, term_indices
+            )
+        return self._schedule_once_monolithic(bindings, extra_avail, term_indices)
+
+    def _row_class(self, rb, spread_row: bool) -> int:
+        """0 = no division tail (dup / non-workload / spread rows),
+        1 = static-weight or dynamic-weight tail, 2 = aggregated tail."""
+        from ..models.batch import strategy_code
+
+        if spread_row:
+            return 0
+        strat = strategy_code(rb.spec.placement, rb.spec.replicas)
+        if strat == AGGREGATED:
+            return 2
+        if strat in (STATIC_WEIGHT, DYNAMIC_WEIGHT):
+            return 1
+        return 0
+
+    def _schedule_once_partitioned(
+        self, bindings: Sequence, extra_avail=None, term_indices=None
+    ) -> list[ScheduleDecision]:
+        """The single-chip schedule round, partitioned by row class:
+
+          phase 1  filter+estimate over ALL rows (one kernel, no sorts)
+          phase 2  division tail over ONLY the divided rows — static/dynW
+                   rows and Aggregated rows as separate sub-batches so the
+                   truncation sort compiles in only where needed
+          phase 2' spread selection (device group scoring + host
+                   combination search) for spread rows
+          packed   duplicated / non-workload targets are bit-packed
+                   feasible masks (complete, no top-K overflow)
+
+        Rows are permuted class-contiguous before encoding and decisions are
+        unpermuted at the end."""
         from . import spread as spread_mod
         from . import spread_batch
+
+        n_real = len(bindings)
+        if n_real == 0:
+            return []
+        names = self.fleet.names
+        C = len(names)
+
+        pre_batched, pre_cfg, pre_fallback = self._classify_spread(bindings)
+        spread_set = set(pre_batched) | set(pre_fallback)
+        cls = np.asarray(
+            [self._row_class(rb, b in spread_set) for b, rb in enumerate(bindings)],
+            np.int8,
+        )
+        order = np.argsort(cls, kind="stable")
+        bindings = [bindings[i] for i in order]
+        cls = cls[order]
+        if term_indices is not None:
+            term_indices = [term_indices[i] for i in order]
+        if extra_avail is not None:
+            extra_avail = extra_avail[order]
+
+        # re-derive spread classification in permuted space (placement-only,
+        # cheap — avoids index-translation bugs)
+        batched_rows, batched_cfg, fallback_rows = self._classify_spread(bindings)
 
         raw = self.batch_encoder.encode(bindings, term_indices=term_indices)
         batch = self._pad(raw)
         if extra_avail is not None and len(extra_avail) < len(batch.replicas):
             pad = len(batch.replicas) - len(extra_avail)
             extra_avail = np.pad(extra_avail, [(0, pad), (0, 0)], constant_values=-1)
-        n_real = len(raw.keys)
-        names = self.fleet.names
-        C = len(names)
 
-        batched_rows, batched_cfg, fallback_rows = self._classify_spread(bindings)
-        # every row rides the batched spread path ⇒ phase 1 skips the
-        # division tail entirely (it would be recomputed over the selection)
-        all_batched = (
-            len(batched_rows) == n_real
-            and n_real > 0
-            and not fallback_rows
-            and self._mesh_kernel is None
+        dev_feasible, dev_score, dev_avail, dev_prev, dev_tie, dev_fc = (
+            _filter_kernel_compact(
+                *self._fleet_dev,
+                batch.replicas, batch.request, batch.unknown_request,
+                batch.gvk, batch.tol_key, batch.tol_value, batch.tol_effect,
+                batch.tol_op, batch.aff_masks, batch.aff_idx,
+                batch.prev_idx, batch.prev_rep, batch.evict_idx, batch.seeds,
+                self._NO_EXTRA if extra_avail is None else extra_avail,
+            )
         )
+        feas_count = np.asarray(jax.device_get(dev_fc))[:n_real].astype(np.int64)
+        unsched = np.zeros(n_real, bool)
+        avail_sum = np.zeros(n_real, np.int64)
 
-        # sparse decode state, overlaid on the main kernel outputs
         row_err: dict[int, str] = {}
         row_target_src: dict[int, tuple] = {}
         row_feas_src: dict[int, tuple] = {}
 
-        if all_batched:
-            dev_feasible, dev_score, dev_avail, dev_prev, dev_tie = (
-                _filter_kernel_compact(
-                    *self._fleet_dev,
-                    batch.replicas, batch.request, batch.unknown_request,
-                    batch.gvk, batch.tol_key, batch.tol_value, batch.tol_effect,
-                    batch.tol_op, batch.aff_masks, batch.aff_idx,
-                    batch.prev_idx, batch.prev_rep, batch.evict_idx, batch.seeds,
-                    self._NO_EXTRA if extra_avail is None else extra_avail,
+        # ---- phase 2: division tails per sub-class ----
+        for want_cls, has_agg in ((1, False), (2, True)):
+            rows = [b for b in range(n_real) if cls[b] == want_cls]
+            if not rows:
+                continue
+            idx_pad, nr = _pad_rows_idx(rows, self._bucket)
+            rsel = idx_pad.astype(np.int64)
+            t_feas = _gather_rows_kernel(dev_feasible, idx_pad)
+            t_avail = _gather_rows_kernel(dev_avail, idx_pad)
+            t_prev = _gather_rows_kernel(dev_prev, idx_pad)
+            t_tie = _gather_rows_kernel(dev_tie, idx_pad)
+            max_repl = int(raw.replicas[rows].max(initial=0))
+            topk = 8
+            while topk < min(max_repl, TOPK_TARGETS):
+                topk *= 2
+            topk = min(topk, TOPK_TARGETS)
+            _, narrow, _ = self._batch_flags(batch)
+            t_out = _tail_kernel(
+                t_feas, t_avail, t_prev, t_tie,
+                batch.weight_tables, batch.weight_idx[rsel],
+                batch.strategy[rsel], batch.replicas[rsel], batch.fresh[rsel],
+                topk=topk, narrow=narrow, has_agg=has_agg,
+            )
+            t_unsched, t_avail_sum, t_nnz, t_ti, t_tv = jax.device_get(t_out[1:])
+            ordd = np.argsort(
+                np.where(t_tv > 0, t_ti, np.int32(1 << 30)), axis=1, kind="stable"
+            )
+            tis = np.take_along_axis(t_ti, ordd, 1)
+            tvs = np.take_along_axis(t_tv, ordd, 1)
+            overflow = []
+            for k, b in enumerate(rows):
+                unsched[b] = bool(t_unsched[k])
+                avail_sum[b] = int(t_avail_sum[k])
+                n = int(t_nnz[k])
+                if n > t_ti.shape[1]:
+                    overflow.append((k, b))
+                    continue
+                row_target_src[b] = ("pairs", names, tis[k, :n], tvs[k, :n])
+            if overflow:
+                o_res = fetch_rows(
+                    t_out[0], [k for k, _ in overflow], self._bucket
                 )
-            )
-            unsched = np.zeros(n_real, bool)
-            avail_sum = np.zeros(n_real, np.int64)
-            feas_count = np.zeros(n_real, np.int64)  # filled from group kernel
-            nnz = top_idx = top_val = None
-        else:
-            out = self.run_kernel(batch, extra_avail)
-            dev_feasible, dev_score, dev_result, dev_avail = (
-                out[0], out[1], out[2], out[5],
-            )
-            dev_prev = dev_tie = None
-            unsched, avail_sum, feas_count, nnz, top_idx, top_val = jax.device_get(
-                (out[3], out[4], out[6], out[7], out[8], out[9])
-            )
-            unsched = np.array(unsched)[:n_real]
-            avail_sum = np.array(avail_sum)[:n_real]
-            feas_count = np.array(feas_count)[:n_real]
+                for j, (_, b) in enumerate(overflow):
+                    pos = np.nonzero(o_res[j] > 0)[0]
+                    row_target_src[b] = (
+                        "pairs", names, pos, o_res[j, pos].astype(np.int64)
+                    )
+
+        # ---- duplicated / non-workload rows: packed feasible masks ----
+        fallback_set = set(fallback_rows)
+        mask_rows = [
+            b for b in range(n_real)
+            if cls[b] == 0 and b not in batched_cfg
+            and b not in fallback_set and feas_count[b] > 0
+        ]
+        if mask_rows:
+            idx_pad, nm = _pad_rows_idx(mask_rows, self._bucket)
+            packed = np.asarray(jax.device_get(
+                _pack_rows_kernel(_gather_rows_kernel(dev_feasible, idx_pad))
+            ))[:nm]
+            for k, b in enumerate(mask_rows):
+                strat = int(raw.strategy[b])
+                row_feas_src[b] = ("mask", names, packed[k], C)
+                reps = 0 if strat == NON_WORKLOAD else int(bindings[b].spec.replicas)
+                row_target_src[b] = ("mask", names, packed[k], C, reps)
+
+        self._spread_overlay(
+            bindings, raw, batch, extra_avail, batched_rows, batched_cfg,
+            fallback_rows, dev_feasible, dev_score, dev_avail, dev_prev,
+            dev_tie, feas_count, unsched, avail_sum,
+            row_err, row_target_src, row_feas_src,
+        )
+
+        # ---- build decisions, then unpermute ----
+        dec_p: list[ScheduleDecision] = []
+        for b, key in enumerate(raw.keys):
+            dec = ScheduleDecision(key=key)
+            if b in row_feas_src:
+                dec._feasible_src = row_feas_src[b]
+            if b in row_err:
+                dec.error = row_err[b]
+            elif feas_count[b] == 0:
+                # FitError diagnosis (generic_scheduler.go:83-88)
+                dec.error = f"0/{C} clusters are available"
+            elif unsched[b]:
+                dec.error = (
+                    f"Clusters available replicas {int(avail_sum[b])} are not "
+                    "enough to schedule."
+                )
+            elif b in row_target_src:
+                dec._targets_src = row_target_src[b]
+            else:  # defensively unreachable: every live row has a source
+                dec.targets = []
+            dec_p.append(dec)
+        out: list[Optional[ScheduleDecision]] = [None] * n_real
+        for j, dec in enumerate(dec_p):
+            out[int(order[j])] = dec
+        return out
+
+    def _spread_overlay(
+        self, bindings, raw, batch, extra_avail, batched_rows, batched_cfg,
+        fallback_rows, dev_feasible, dev_score, dev_avail, dev_prev, dev_tie,
+        feas_count, unsched, avail_sum, row_err, row_target_src, row_feas_src,
+    ) -> None:
+        """Spread-constrained rows: batched device path + per-row exact
+        fallback. Mutates the decode overlays in place. dev_prev/dev_tie may
+        be None (mesh path) — they're rebuilt for the row subset."""
+        from . import spread as spread_mod
+        from . import spread_batch
+
+        names = self.fleet.names
+        C = len(names)
+        n_real = len(raw.keys)
 
         # ---- batched spread path: device group scoring → vectorized host
         # combination search → packed selection masks + divided re-dispense
@@ -776,9 +953,7 @@ class ArrayScheduler:
             g_feas = _gather_rows_kernel(dev_feasible, idx_pad)
             g_score = _gather_rows_kernel(dev_score, idx_pad)
             g_avail = _gather_rows_kernel(dev_avail, idx_pad)
-            if dev_prev is not None and nb == len(batch.replicas):
-                g_prev, g_tie = dev_prev, dev_tie
-            elif dev_prev is not None:
+            if dev_prev is not None:
                 g_prev = _gather_rows_kernel(dev_prev, idx_pad)
                 g_tie = _gather_rows_kernel(dev_tie, idx_pad)
             else:
@@ -940,34 +1115,80 @@ class ArrayScheduler:
                     fidx = np.nonzero(s_feas[j])[0]
                     row_feas_src[b] = ("idx", names, fidx)
                     feas_count[b] = len(fidx)
-                    pos = np.nonzero(s_result[j] > 0)[0]
-                    row_target_src[b] = (
-                        "pairs", names, pos, s_result[j, pos].astype(np.int64)
-                    )
+                    if raw.strategy[b] == NON_WORKLOAD:
+                        # targets = the selected set, no replica counts
+                        row_target_src[b] = (
+                            "pairs", names, fidx, np.zeros(len(fidx), np.int64)
+                        )
+                    else:
+                        pos = np.nonzero(s_result[j] > 0)[0]
+                        row_target_src[b] = (
+                            "pairs", names, pos, s_result[j, pos].astype(np.int64)
+                        )
                     unsched[b] = bool(s_unsched[j])
                     avail_sum[b] = int(s_avail_sum[j])
 
-        # ---- main-path decode sources (vectorized; no per-row Python sort)
-        if top_idx is not None:
-            Kw = top_idx.shape[1]
-            order = np.argsort(
-                np.where(top_val > 0, top_idx, np.int32(1 << 30)), axis=1,
-                kind="stable",
-            )
-            ti_sorted = np.take_along_axis(top_idx, order, 1)
-            tv_sorted = np.take_along_axis(top_val, order, 1)
-            overflow = [
-                b for b in range(n_real)
-                if b not in row_target_src and nnz[b] > Kw
-                and raw.strategy[b] != NON_WORKLOAD
-            ]
-            if overflow:
-                o_res = fetch_rows(dev_result, overflow, self._bucket)
-                for k, b in enumerate(overflow):
-                    pos = np.nonzero(o_res[k] > 0)[0]
-                    row_target_src[b] = (
-                        "pairs", names, pos, o_res[k, pos].astype(np.int64)
-                    )
+    def _schedule_once_monolithic(
+        self, bindings: Sequence, extra_avail=None, term_indices=None
+    ) -> list[ScheduleDecision]:
+        """One full-kernel round (filter + tail over every row) — the mesh
+        path, where the sharded kernel computes everything in one program
+        (parallel/mesh.py). Decode mirrors the partitioned path."""
+        n_real = len(bindings)
+        if n_real == 0:
+            return []
+        names = self.fleet.names
+        C = len(names)
+        batched_rows, batched_cfg, fallback_rows = self._classify_spread(bindings)
+
+        raw = self.batch_encoder.encode(bindings, term_indices=term_indices)
+        batch = self._pad(raw)
+        if extra_avail is not None and len(extra_avail) < len(batch.replicas):
+            pad = len(batch.replicas) - len(extra_avail)
+            extra_avail = np.pad(extra_avail, [(0, pad), (0, 0)], constant_values=-1)
+
+        out = self.run_kernel(batch, extra_avail)
+        dev_feasible, dev_score, dev_result, dev_avail = (
+            out[0], out[1], out[2], out[5],
+        )
+        unsched, avail_sum, feas_count, nnz, top_idx, top_val = jax.device_get(
+            (out[3], out[4], out[6], out[7], out[8], out[9])
+        )
+        unsched = np.array(unsched)[:n_real]
+        avail_sum = np.array(avail_sum)[:n_real]
+        feas_count = np.array(feas_count)[:n_real].astype(np.int64)
+
+        row_err: dict[int, str] = {}
+        row_target_src: dict[int, tuple] = {}
+        row_feas_src: dict[int, tuple] = {}
+
+        self._spread_overlay(
+            bindings, raw, batch, extra_avail, batched_rows, batched_cfg,
+            fallback_rows, dev_feasible, dev_score, dev_avail, None, None,
+            feas_count, unsched, avail_sum,
+            row_err, row_target_src, row_feas_src,
+        )
+
+        # vectorized pair extraction for main rows
+        Kw = top_idx.shape[1]
+        ordd = np.argsort(
+            np.where(top_val > 0, top_idx, np.int32(1 << 30)), axis=1,
+            kind="stable",
+        )
+        ti_sorted = np.take_along_axis(top_idx, ordd, 1)
+        tv_sorted = np.take_along_axis(top_val, ordd, 1)
+        overflow = [
+            b for b in range(n_real)
+            if b not in row_target_src and nnz[b] > Kw
+            and raw.strategy[b] != NON_WORKLOAD
+        ]
+        if overflow:
+            o_res = fetch_rows(dev_result, overflow, self._bucket)
+            for k, b in enumerate(overflow):
+                pos = np.nonzero(o_res[k] > 0)[0]
+                row_target_src[b] = (
+                    "pairs", names, pos, o_res[k, pos].astype(np.int64)
+                )
         nonwork = [
             b for b in range(n_real)
             if raw.strategy[b] == NON_WORKLOAD and b not in row_feas_src
@@ -982,7 +1203,6 @@ class ArrayScheduler:
                     "pairs", names, fidx, np.zeros(len(fidx), np.int64)
                 )
 
-        # ---- build decisions ----
         out_decisions: list[ScheduleDecision] = []
         for b, key in enumerate(raw.keys):
             dec = ScheduleDecision(key=key)

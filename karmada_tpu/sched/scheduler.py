@@ -158,18 +158,27 @@ class SchedulerDaemon:
                 self.store.update(rb)
         if not bindings:
             return []
+        from ..tracing import Trace
+
+        trace = Trace("Scheduling", {"bindings": len(bindings)})
         with timed(e2e_scheduling_duration):
             array = self._ensure_fleet()
+            trace.step("Fleet snapshot ready")
             extra_avail = None
             if self.estimator_registry is not None:
                 extra_avail = self.estimator_registry.batch_estimates(
                     bindings, array.fleet.names
                 )
+            trace.step("Estimator fan-out done")
             with timed(scheduling_algorithm_duration):
                 decisions = array.schedule(bindings, extra_avail=extra_avail)
+            trace.step("Batched solve done")
             for rb, decision in zip(bindings, decisions):
                 schedule_attempts.inc(result="scheduled" if decision.ok else "error")
                 self._patch_result(rb, decision)
+            trace.step("Results patched")
+        # slow-round span (the scheduler-side analogue of estimate.go:37-38)
+        trace.log_if_long(1.0)
         return []
 
     def _patch_result(self, rb: ResourceBinding, decision: ScheduleDecision) -> None:

@@ -115,6 +115,29 @@ class RegionLayout:
             pos = np.nonzero(rid_p == r)[0]
             self.slices.append((int(pos[0]), int(pos[-1]) + 1) if len(pos) else (0, 0))
         self.name_rank_p = name_rank[order].astype(np.int32)
+        # padded [R, W] grid of original column ids (W = widest region):
+        # lets group scoring run as ONE [S, R, W] sort instead of R unrolled
+        # per-slice sorts — R distinct sort shapes made the jitted program
+        # pathologically slow to compile (612 s at R=16, C=5000)
+        self.grid_width = max(
+            (e - s for s, e in self.slices), default=0
+        )
+        # skew guard: the padded grid holds R x W elements vs the C the
+        # per-slice form touched — one giant region among many tiny ones
+        # would multiply group-scoring memory ~R-fold. Such fleets route to
+        # the per-row exact path instead (ArrayScheduler._classify_spread).
+        self.grid_balanced = (
+            self.n_regions * max(self.grid_width, 1) <= max(4 * C, 1024)
+        )
+        self.grid_idx = np.zeros((self.n_regions, max(self.grid_width, 1)), np.int32)
+        self.grid_valid = np.zeros_like(self.grid_idx, dtype=bool)
+        for r, (s, e) in enumerate(self.slices):
+            w = e - s
+            self.grid_idx[r, :w] = self.perm[s:e]
+            self.grid_valid[r, :w] = True
+        self.grid_name_rank = np.where(
+            self.grid_valid, name_rank[self.grid_idx], np.iinfo(np.int32).max
+        ).astype(np.int32)
         # original-column-order region ids, shifted by one (0 = regionless —
         # such clusters never join a region selection)
         self.rid_orig = np.where(region_id < 0, 0, region_id + 1).astype(np.int32)
@@ -138,82 +161,71 @@ def group_score_kernel(
 ):
     """Score every (row, region) group in one program.
 
-    Per region slice (static contiguous columns after layout.perm):
-    sort rows by (infeasible, score desc, available desc, name) — the
-    sortClusters order (util.go:43-57) with infeasible clusters pushed to
-    the tail — then prefix-walk via cumsum exactly like
-    calcGroupScore (group_clusters.go:143-330). Returns
-    (weight i64[S,R], value i32[S,R], avail_sum i64[S,R],
-    feas_count i32[S] — the unrestricted fit count for FitError checks)."""
+    The fleet's regions are laid out as a static padded grid [R, W]
+    (W = widest region), so scoring is ONE [S, R, W] sort along the member
+    axis — rows by (infeasible, score desc, available desc, name), the
+    sortClusters order (util.go:43-57) with infeasible/pad members pushed
+    to the tail — followed by prefix cumsums, exactly like calcGroupScore
+    (group_clusters.go:143-330). Returns (weight i64[S,R], value i32[S,R],
+    avail_sum i64[S,R], feas_count i32[S] — the unrestricted fit count for
+    FitError checks)."""
     S = feasible.shape[0]
-    perm = jnp.asarray(layout.perm)
-    feas = feasible[:, perm]
-    av = jnp.where(feas, avail[:, perm].astype(jnp.int64)
-                   + prev_replicas[:, perm].astype(jnp.int64), 0)
-    sc = jnp.where(feas, score[:, perm].astype(jnp.int64), 0)
-    nr = jnp.asarray(layout.name_rank_p)
+    grid = jnp.asarray(layout.grid_idx)  # [R, W] original column ids
+    valid = jnp.asarray(layout.grid_valid)  # [R, W]
+    R, W = grid.shape
 
-    weights, values, avsums = [], [], []
-    for r in range(layout.n_regions):
-        s, e = layout.slices[r]
-        w = e - s
-        if w == 0:
-            weights.append(jnp.zeros((S,), jnp.int64))
-            values.append(jnp.zeros((S,), jnp.int32))
-            avsums.append(jnp.zeros((S,), jnp.int64))
-            continue
-        f_r = feas[:, s:e]
-        av_r = av[:, s:e]
-        sc_r = sc[:, s:e]
-        infeas = (~f_r).astype(jnp.int32)
-        nscore = -sc_r.astype(jnp.int32)
-        nav = -av_r
-        nrank = jnp.broadcast_to(nr[s:e], (S, w))
-        _, _, _, _, av_s, sc_s = jax.lax.sort(
-            (infeas, nscore, nav, nrank, av_r, sc_r), dimension=-1, num_keys=4
-        )
-        cum_av = jnp.cumsum(av_s, axis=-1)
-        cum_sc = jnp.cumsum(sc_s, axis=-1)
-        value = f_r.sum(-1).astype(jnp.int32)  # feasible member count
-        av_sum = cum_av[:, -1]
-        sc_sum = cum_sc[:, -1]
-        idx = jax.lax.broadcasted_iota(jnp.int64, (S, w), 1)
-        # divided branch: first k with (count >= need) & (cum_av >= target),
-        # restricted to real members (group_clusters.go:217-330)
-        cond = (
-            (idx + 1 >= need[:, None])
-            & (cum_av >= target[:, None])
-            & (idx < value[:, None].astype(jnp.int64))
-        )
-        big = jnp.int64(1 << 40)
-        k = jnp.min(jnp.where(cond, idx, big), axis=-1)
-        met = k < big
-        k_eff = jnp.clip(jnp.where(met, k, value.astype(jnp.int64) - 1), 0, w - 1)
-        sc_at_k = jnp.take_along_axis(cum_sc, k_eff[:, None].astype(jnp.int32), axis=-1)[:, 0]
-        denom = jnp.maximum(jnp.where(met, k_eff + 1, value.astype(jnp.int64)), 1)
-        w_div = jnp.where(
-            av_sum < target,
-            av_sum * WEIGHT_UNIT + sc_sum // jnp.maximum(value.astype(jnp.int64), 1),
-            target * WEIGHT_UNIT + sc_at_k // denom,
-        )
-        # duplicated branch (group_clusters.go:143-215): order-free
-        valid = f_r & (av_r >= replicas[:, None])
-        cnt = valid.sum(-1).astype(jnp.int64)
-        sc_valid = jnp.where(valid, sc_r, 0).sum(-1)
-        w_dup = jnp.where(cnt > 0, cnt * WEIGHT_UNIT + sc_valid // jnp.maximum(cnt, 1), 0)
-
-        weight = jnp.where(duplicated, w_dup, w_div)
-        weight = jnp.where(value > 0, weight, 0)
-        weights.append(weight)
-        values.append(value)
-        avsums.append(av_sum)
-
-    return (
-        jnp.stack(weights, axis=1),
-        jnp.stack(values, axis=1),
-        jnp.stack(avsums, axis=1),
-        feasible.sum(-1).astype(jnp.int32),
+    f3 = feasible[:, grid] & valid  # [S, R, W]
+    av3 = jnp.where(
+        f3,
+        avail[:, grid].astype(jnp.int64) + prev_replicas[:, grid].astype(jnp.int64),
+        0,
     )
+    sc3 = jnp.where(f3, score[:, grid].astype(jnp.int64), 0)
+
+    infeas = (~f3).astype(jnp.int32)
+    nscore = -sc3.astype(jnp.int32)
+    nav = -av3
+    nrank = jnp.broadcast_to(layout.grid_name_rank, (S, R, W))
+    _, _, _, _, av_s, sc_s = jax.lax.sort(
+        (infeas, nscore, nav, nrank, av3, sc3), dimension=-1, num_keys=4
+    )
+    cum_av = jnp.cumsum(av_s, axis=-1)
+    cum_sc = jnp.cumsum(sc_s, axis=-1)
+    value = f3.sum(-1).astype(jnp.int32)  # [S, R] feasible member count
+    value64 = value.astype(jnp.int64)
+    av_sum = cum_av[..., -1]
+    sc_sum = cum_sc[..., -1]
+    idx = jax.lax.broadcasted_iota(jnp.int64, (S, R, W), 2)
+    # divided branch: first k with (count >= need) & (cum_av >= target),
+    # restricted to real members (group_clusters.go:217-330)
+    cond = (
+        (idx + 1 >= need[:, None, None])
+        & (cum_av >= target[:, None, None])
+        & (idx < value64[..., None])
+    )
+    big = jnp.int64(1 << 40)
+    k = jnp.min(jnp.where(cond, idx, big), axis=-1)  # [S, R]
+    met = k < big
+    k_eff = jnp.clip(jnp.where(met, k, value64 - 1), 0, W - 1)
+    sc_at_k = jnp.take_along_axis(
+        cum_sc, k_eff[..., None].astype(jnp.int32), axis=-1
+    )[..., 0]
+    denom = jnp.maximum(jnp.where(met, k_eff + 1, value64), 1)
+    tgt = target[:, None]
+    w_div = jnp.where(
+        av_sum < tgt,
+        av_sum * WEIGHT_UNIT + sc_sum // jnp.maximum(value64, 1),
+        tgt * WEIGHT_UNIT + sc_at_k // denom,
+    )
+    # duplicated branch (group_clusters.go:143-215): order-free
+    dup_ok = f3 & (av3 >= replicas[:, None, None])
+    cnt = dup_ok.sum(-1).astype(jnp.int64)
+    sc_dup = jnp.where(dup_ok, sc3, 0).sum(-1)
+    w_dup = jnp.where(cnt > 0, cnt * WEIGHT_UNIT + sc_dup // jnp.maximum(cnt, 1), 0)
+
+    weight = jnp.where(duplicated[:, None], w_dup, w_div)
+    weight = jnp.where(value > 0, weight, 0)
+    return weight, value, av_sum, feasible.sum(-1).astype(jnp.int32)
 
 
 def _apply_chosen(feasible, chosen, layout: RegionLayout):

@@ -1,0 +1,162 @@
+"""Trace spans with slow-path logging + the pprof-equivalent profile server.
+
+Parity with the reference's observability aids:
+- `Trace` mirrors k8s.io/utils/trace as the estimator/scheduler use it —
+  named spans with fields and nested steps, logged ONLY when total duration
+  crosses a threshold (ref pkg/estimator/server/estimate.go:37-38 logs
+  estimates slower than 100 ms with per-step timing).
+- `ProfileServer` mirrors pkg/sharedcli/profileflag (net/http/pprof): an
+  opt-in HTTP endpoint serving whole-process sampled CPU profiles (all
+  threads' stacks) and heap snapshots (tracemalloc) for a live process.
+  Disabled by default, like the reference's --enable-pprof.
+"""
+from __future__ import annotations
+
+import json
+import logging
+import threading
+import time
+import tracemalloc
+from dataclasses import dataclass, field
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Callable, Optional
+from urllib.parse import parse_qs, urlparse
+
+logger = logging.getLogger("karmada_tpu.trace")
+
+DEFAULT_SLOW_THRESHOLD_S = 0.100  # estimate.go:38
+
+
+@dataclass
+class _Step:
+    msg: str
+    at: float
+
+
+@dataclass
+class Trace:
+    """utiltrace.Trace: step() marks checkpoints; log_if_long() emits the
+    whole span breakdown when the total exceeds the threshold."""
+
+    name: str
+    fields: dict = field(default_factory=dict)
+    clock: Callable[[], float] = time.perf_counter
+    sink: Optional[Callable[[str], None]] = None  # default: logger.warning
+
+    def __post_init__(self):
+        self.start = self.clock()
+        self.steps: list[_Step] = []
+
+    def step(self, msg: str) -> None:
+        self.steps.append(_Step(msg, self.clock()))
+
+    def duration(self) -> float:
+        return self.clock() - self.start
+
+    def log_if_long(self, threshold_s: float = DEFAULT_SLOW_THRESHOLD_S) -> bool:
+        """Emit the span if it ran long; returns whether it was emitted."""
+        total = self.duration()
+        if total < threshold_s:
+            return False
+        parts = [f'"{self.name}"']
+        if self.fields:
+            parts.append(
+                " ".join(f"{k}={v}" for k, v in self.fields.items())
+            )
+        parts.append(f"total={total * 1e3:.1f}ms:")
+        prev = self.start
+        for s in self.steps:
+            parts.append(f"[{(s.at - prev) * 1e3:.1f}ms] {s.msg};")
+            prev = s.at
+        tail = total - (prev - self.start)
+        if self.steps and tail > 0:
+            parts.append(f"[{tail * 1e3:.1f}ms] (rest)")
+        line = "Trace " + " ".join(parts)
+        (self.sink or logger.warning)(line)
+        return True
+
+
+# -- pprof-equivalent profile endpoint --------------------------------------
+
+
+def _sample_all_threads(seconds: float, interval: float = 0.01) -> str:
+    """Statistical whole-process CPU profile: periodically snapshot every
+    thread's stack (sys._current_frames) and count frames. cProfile is
+    per-thread — enabling it in the HTTP handler would only ever profile the
+    handler's own sleep — so sampling is the honest pprof-style view of a
+    live multi-threaded process."""
+    import sys
+
+    me = threading.get_ident()
+    counts: dict[tuple[str, int, str], int] = {}
+    samples = 0
+    deadline = time.perf_counter() + seconds
+    while time.perf_counter() < deadline:
+        for tid, frame in sys._current_frames().items():
+            if tid == me:
+                continue
+            f = frame
+            while f is not None:
+                key = (f.f_code.co_filename, f.f_lineno, f.f_code.co_name)
+                counts[key] = counts.get(key, 0) + 1
+                f = f.f_back
+        samples += 1
+        time.sleep(interval)
+    top = sorted(counts.items(), key=lambda kv: -kv[1])[:60]
+    lines = [f"samples: {samples} (interval {interval * 1e3:.0f}ms, all threads)"]
+    for (fname, lineno, func), n in top:
+        lines.append(f"{n:6d}  {func}  {fname}:{lineno}")
+    return "\n".join(lines)
+
+
+class _ProfileHandler(BaseHTTPRequestHandler):
+    def log_message(self, *a):  # quiet
+        pass
+
+    def do_GET(self):  # noqa: N802 (http.server API)
+        url = urlparse(self.path)
+        if url.path == "/debug/pprof/profile":
+            seconds = float(parse_qs(url.query).get("seconds", ["2"])[0])
+            self._ok(_sample_all_threads(min(seconds, 30.0)))
+        elif url.path == "/debug/pprof/heap":
+            if not tracemalloc.is_tracing():
+                # tracking starts now; only allocations made from this point
+                # are attributable (same lazy-start shape as pprof heap)
+                tracemalloc.start()
+                self._ok("tracemalloc started; re-request for allocation data")
+                return
+            snap = tracemalloc.take_snapshot()
+            top = snap.statistics("lineno")[:50]
+            self._ok("\n".join(str(s) for s in top) or "no tracked allocations")
+        elif url.path == "/debug/pprof/":
+            self._ok(json.dumps({"endpoints": ["profile?seconds=N", "heap"]}))
+        else:
+            self.send_error(404)
+
+    def _ok(self, body: str) -> None:
+        data = body.encode()
+        self.send_response(200)
+        self.send_header("Content-Type", "text/plain")
+        self.send_header("Content-Length", str(len(data)))
+        self.end_headers()
+        self.wfile.write(data)
+
+
+class ProfileServer:
+    """pkg/sharedcli/profileflag equivalent: opt-in /debug/pprof endpoints."""
+
+    def __init__(self, enable_pprof: bool = False, bind_address: str = "127.0.0.1",
+                 port: int = 0):
+        self.enabled = enable_pprof
+        self._server: Optional[ThreadingHTTPServer] = None
+        self.port = 0
+        if enable_pprof:
+            self._server = ThreadingHTTPServer((bind_address, port), _ProfileHandler)
+            self.port = self._server.server_address[1]
+            t = threading.Thread(target=self._server.serve_forever, daemon=True)
+            t.start()
+
+    def stop(self) -> None:
+        if self._server is not None:
+            self._server.shutdown()
+            self._server = None

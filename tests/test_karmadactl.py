@@ -7,6 +7,7 @@ import pytest
 
 from karmada_tpu.cli.karmadactl import CLIError, run
 from karmada_tpu.controlplane import ControlPlane
+from karmada_tpu.runtime.controller import Clock
 from karmada_tpu.members.member import MemberConfig
 from karmada_tpu.testing.fixtures import (
     duplicated_placement,
@@ -39,8 +40,16 @@ class TestLifecycle:
         assert "m1" not in run(cp, ["get", "clusters"])
 
     def test_register_pull_mode(self, cp):
-        run(cp, ["register", "edge-1"])
+        # register requires the token/CSR bootstrap (register.go:304-308)
+        token = run(cp, ["token", "create"])
+        ca_hash = cp.pki.cert_hash()
+        run(cp, ["register", "edge-1", "--token", token,
+                 "--discovery-token-ca-cert-hash", ca_hash])
         assert "Pull" in run(cp, ["get", "clusters"])
+        # the agent got a CA-signed identity cert at join
+        agent = cp.agents["edge-1"]
+        assert agent.cert is not None
+        assert agent.cert.common_name == "system:node:edge-1"
         run(cp, ["unregister", "edge-1"])
 
     def test_join_duplicate_fails(self, cp):
@@ -167,3 +176,84 @@ class TestProxyCommands:
     def test_addons(self, cp):
         out = run(cp, ["addons"])
         assert "karmada-search" in out and "enabled" in out
+
+
+class TestInitDeinitTokenFlow:
+    """karmadactl init/deinit + token/CSR bootstrap + agent cert rotation
+    (ref pkg/karmadactl/cmdinit, register/register.go:70-308,
+    controllers/certificate/cert_rotation_controller.go)."""
+
+    def test_init_creates_plane_and_deinit_tears_down(self):
+        from karmada_tpu.cli.karmadactl import CLIError, Management, cmd_deinit, cmd_init
+
+        mgmt = Management(clock=Clock(fixed=100.0))
+        out = cmd_init(mgmt, "prod")
+        assert "control plane prod installed" in out
+        assert "--token" in out and "--discovery-token-ca-cert-hash sha256:" in out
+        plane = mgmt.plane("prod")
+        assert plane is not None
+        # the plane actually works: join + propagate
+        assert "joined" in run(plane, ["join", "m1"])
+
+        with pytest.raises(CLIError, match="already installed"):
+            cmd_init(mgmt, "prod")
+        assert "removed" in cmd_deinit(mgmt, "prod")
+        assert mgmt.plane("prod") is None
+        with pytest.raises(CLIError, match="not found"):
+            cmd_deinit(mgmt, "prod")
+
+    def test_register_token_validation(self, cp):
+        from karmada_tpu.cli.karmadactl import CLIError
+
+        with pytest.raises(CLIError, match="token is required"):
+            run(cp, ["register", "edge-2"])
+        with pytest.raises(CLIError, match="invalid bootstrap token"):
+            run(cp, ["register", "edge-2", "--token", "bad.token",
+                     "--discovery-token-unsafe-skip-ca-verification"])
+        token = run(cp, ["token", "create"])
+        with pytest.raises(CLIError, match="need to verify CACertHashes"):
+            run(cp, ["register", "edge-2", "--token", token])
+        with pytest.raises(CLIError, match="does not match"):
+            run(cp, ["register", "edge-2", "--token", token,
+                     "--discovery-token-ca-cert-hash", "sha256:deadbeef"])
+        # unsafe skip works like the reference flag
+        out = run(cp, ["register", "edge-2", "--token", token,
+                       "--discovery-token-unsafe-skip-ca-verification"])
+        assert "registered" in out
+
+    def test_token_expiry_and_lifecycle(self, cp):
+        token = run(cp, ["token", "create"])
+        assert token.partition(".")[0] in run(cp, ["token", "list"])
+        cp.runtime.clock.advance(25 * 3600)  # past the 24h TTL
+        from karmada_tpu.cli.karmadactl import CLIError
+
+        with pytest.raises(CLIError, match="expired"):
+            run(cp, ["register", "edge-3", "--token", token,
+                     "--discovery-token-unsafe-skip-ca-verification"])
+        token2 = run(cp, ["token", "create"])
+        assert "deleted" in run(cp, ["token", "delete", token2])
+        with pytest.raises(CLIError, match="not found"):
+            run(cp, ["token", "delete", token2])
+
+    def test_print_register_command(self, cp):
+        out = run(cp, ["token", "create", "--print-register-command"])
+        assert out.startswith("karmadactl register")
+        assert "--discovery-token-ca-cert-hash sha256:" in out
+
+    def test_agent_cert_rotation(self, cp):
+        token = cp.bootstrap_tokens.create().token
+        run(cp, ["register", "edge-r", "--token", token,
+                 "--discovery-token-ca-cert-hash", cp.pki.cert_hash()])
+        agent = cp.agents["edge-r"]
+        first = agent.cert
+        assert first.remaining_ratio(cp.runtime.clock.now()) > 0.9
+
+        # inside the threshold: no rotation
+        cp.tick(seconds=0.5 * (first.not_after - first.not_before))
+        assert agent.cert is first
+        # past 90% of the lifetime: the rotation controller re-issues
+        cp.tick(seconds=0.45 * (first.not_after - first.not_before))
+        assert agent.cert is not first
+        assert agent.cert.not_after > first.not_after
+        assert agent.cert.common_name == "system:node:edge-r"
+        assert cp.cert_rotation_controller.rotations == 1

@@ -1,0 +1,99 @@
+"""Trace spans + slow-path logging + the pprof-equivalent endpoint
+(ref estimate.go:37-38, pkg/sharedcli/profileflag)."""
+import urllib.request
+
+from karmada_tpu.tracing import ProfileServer, Trace
+
+
+class TestTrace:
+    def test_fast_span_not_logged(self):
+        lines = []
+        t = Trace("Estimating", {"cluster": "m1"}, sink=lines.append)
+        t.step("snapshot done")
+        assert t.log_if_long(threshold_s=10.0) is False
+        assert lines == []
+
+    def test_slow_span_logged_with_steps(self):
+        lines = []
+        now = [0.0]
+        t = Trace("Estimating", {"cluster": "m1"},
+                  clock=lambda: now[0], sink=lines.append)
+        now[0] = 0.06
+        t.step("snapshot done")
+        now[0] = 0.15
+        t.step("estimate done")
+        assert t.log_if_long(threshold_s=0.1) is True
+        (line,) = lines
+        assert '"Estimating"' in line and "cluster=m1" in line
+        assert "total=150.0ms" in line
+        assert "[60.0ms] snapshot done" in line
+        assert "[90.0ms] estimate done" in line
+
+    def test_estimator_server_emits_slow_trace(self, monkeypatch):
+        import karmada_tpu.tracing as tracing_mod
+        from karmada_tpu.api.meta import CPU
+        from karmada_tpu.api.work import ReplicaRequirements
+        from karmada_tpu.estimator.accurate import AccurateEstimator
+        from karmada_tpu.estimator.service import EstimatorServer, GrpcSchedulerEstimator
+        from karmada_tpu.models.nodes import NodeSpec
+
+        lines = []
+        monkeypatch.setattr(tracing_mod.logger, "warning", lines.append)
+        est = AccurateEstimator([NodeSpec(name="n", allocatable={CPU: 4.0})])
+        slow_orig = est.max_available_replicas
+
+        def slow(req):
+            import time
+
+            time.sleep(0.12)
+            return slow_orig(req)
+
+        est.max_available_replicas = slow
+        srv = EstimatorServer({"m1": est})
+        port = srv.start(warm=False)
+        try:
+            client = GrpcSchedulerEstimator(address_for=lambda c: f"127.0.0.1:{port}")
+            client.max_available_replicas(["m1"], ReplicaRequirements(resource_request={CPU: 1.0}), 1)
+        finally:
+            srv.stop()
+        assert any("Estimating" in ln and "cluster=m1" in ln for ln in lines)
+
+
+class TestProfileServer:
+    def test_disabled_by_default(self):
+        ps = ProfileServer()
+        assert not ps.enabled and ps.port == 0
+
+    def test_profile_and_heap_endpoints(self):
+        import threading
+        import time
+
+        ps = ProfileServer(enable_pprof=True)
+        # a busy worker thread the sampler must observe (cProfile would only
+        # ever see the handler's own sleep)
+        stop = threading.Event()
+
+        def busy_loop_marker():
+            while not stop.is_set():
+                sum(i * i for i in range(500))
+                time.sleep(0.001)
+
+        t = threading.Thread(target=busy_loop_marker, daemon=True)
+        t.start()
+        try:
+            body = urllib.request.urlopen(
+                f"http://127.0.0.1:{ps.port}/debug/pprof/profile?seconds=0.3",
+                timeout=10,
+            ).read().decode()
+            assert body.startswith("samples:")
+            assert "busy_loop_marker" in body  # whole-process view
+            url = f"http://127.0.0.1:{ps.port}/debug/pprof/heap"
+            first = urllib.request.urlopen(url, timeout=10).read().decode()
+            assert "tracemalloc started" in first
+            blob = list(range(20000))  # attributable allocation
+            heap = urllib.request.urlopen(url, timeout=10).read().decode()
+            assert heap and "tracemalloc started" not in heap
+            del blob
+        finally:
+            stop.set()
+            ps.stop()
