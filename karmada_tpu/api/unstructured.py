@@ -19,7 +19,11 @@ class Unstructured:
     def __init__(self, manifest: dict):
         manifest.setdefault("metadata", {})
         self._m = manifest
-        md = manifest["metadata"]
+        self._load_meta()
+
+    def _load_meta(self) -> None:
+        """(Re)build the typed metadata view from the backing dict."""
+        md = self._m["metadata"]
         self.metadata = ObjectMeta(
             name=md.get("name", ""),
             namespace=md.get("namespace", ""),
@@ -67,6 +71,25 @@ class Unstructured:
     def to_dict(self) -> dict:
         self.sync_meta()
         return copy.deepcopy(self._m)
+
+    def merge_patch(self, patch: dict) -> None:
+        """RFC 7386 merge-patch applied in place: null deletes, dicts
+        recurse (kubectl patch --type=merge semantics)."""
+
+        def merge(dst: dict, src: dict) -> None:
+            for k, v in src.items():
+                if v is None:
+                    dst.pop(k, None)
+                elif isinstance(v, dict) and isinstance(dst.get(k), dict):
+                    merge(dst[k], v)
+                else:
+                    dst[k] = v
+
+        merge(self._m, patch)
+        self._m.setdefault("metadata", {})
+        # re-derive the typed view: without this, sync_meta would write the
+        # PRE-patch metadata back over any metadata fields the patch touched
+        self._load_meta()
 
     def get(self, *path: str, default: Any = None) -> Any:
         cur: Any = self._m

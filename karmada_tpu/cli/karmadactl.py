@@ -488,6 +488,187 @@ def cmd_apply(cp: ControlPlane, manifest: dict, all_clusters: bool = False) -> s
     return msg
 
 
+def cmd_create(cp: ControlPlane, manifest: dict) -> str:
+    """kubectl-style create (pkg/karmadactl/create)."""
+    obj = Unstructured(manifest)
+    cp.store.create(obj)
+    cp.settle()
+    return f"{obj.kind}/{obj.name} created"
+
+
+def cmd_delete(cp: ControlPlane, kind: str, name: str, namespace: str = "") -> str:
+    """kubectl-style delete (pkg/karmadactl/delete)."""
+    kind = _resolve_kind(kind)
+    if cp.store.try_get(kind, name, namespace) is None:
+        raise CLIError(f"{kind} {name!r} not found")
+    cp.store.delete(kind, name, namespace)
+    cp.settle()
+    return f"{kind}/{name} deleted"
+
+
+def _mutate_meta_map(cp: ControlPlane, kind: str, name: str, namespace: str,
+                     pairs: list[str], which: str) -> str:
+    """Shared annotate/label implementation (pkg/karmadactl/{annotate,label}):
+    k=v sets, k- removes."""
+    kind = _resolve_kind(kind)
+    obj = cp.store.try_get(kind, name, namespace)
+    if obj is None:
+        raise CLIError(f"{kind} {name!r} not found")
+    target = getattr(obj.metadata, which)
+    for pair in pairs:
+        if pair.endswith("-"):
+            target.pop(pair[:-1], None)
+        elif "=" in pair:
+            k, _, v = pair.partition("=")
+            target[k] = v
+        else:
+            raise CLIError(f"bad {which} spec {pair!r} (want k=v or k-)")
+    cp.store.update(obj)
+    cp.settle()
+    return f"{kind}/{name} {which[:-1]}{'s' if len(pairs) != 1 else ''} updated"
+
+
+def cmd_annotate(cp: ControlPlane, kind: str, name: str, pairs: list[str],
+                 namespace: str = "") -> str:
+    return _mutate_meta_map(cp, kind, name, namespace, pairs, "annotations")
+
+
+def cmd_label(cp: ControlPlane, kind: str, name: str, pairs: list[str],
+              namespace: str = "") -> str:
+    return _mutate_meta_map(cp, kind, name, namespace, pairs, "labels")
+
+
+def cmd_patch(cp: ControlPlane, kind: str, name: str, patch: dict,
+              namespace: str = "") -> str:
+    """Merge-patch a resource template (pkg/karmadactl/patch). Dict-backed
+    (Unstructured) objects only — typed control-plane objects are patched
+    through their dedicated commands."""
+    kind = _resolve_kind(kind)
+    obj = cp.store.try_get(kind, name, namespace)
+    if obj is None:
+        raise CLIError(f"{kind} {name!r} not found")
+    if not isinstance(obj, Unstructured):
+        raise CLIError(f"{kind} is a typed object; patch supports templates")
+    obj.merge_patch(patch)
+    cp.store.update(obj)
+    cp.settle()
+    return f"{kind}/{name} patched"
+
+
+def cmd_edit(cp: ControlPlane, kind: str, name: str, manifest: dict,
+             namespace: str = "") -> str:
+    """Non-interactive edit: replace the object with the edited manifest
+    (pkg/karmadactl/edit opens $EDITOR; the CLI seam here takes the edited
+    file via -f)."""
+    kind = _resolve_kind(kind)
+    old = cp.store.try_get(kind, name, namespace)
+    if old is None:
+        raise CLIError(f"{kind} {name!r} not found")
+    if not isinstance(old, Unstructured):
+        raise CLIError(f"{kind} is a typed object; edit supports templates")
+    obj = Unstructured(manifest)
+    # kubectl edit rejects identity changes: the edited manifest must still
+    # be the named object, else we'd silently overwrite a different one
+    if (f"{obj.api_version}/{obj.kind}" != kind or obj.name != name
+            or obj.namespace != namespace):
+        raise CLIError(
+            f"edited manifest is {obj.api_version}/{obj.kind} "
+            f"{obj.namespace}/{obj.name}, not {kind} {namespace}/{name}; "
+            "identity changes are not allowed"
+        )
+    obj.metadata.resource_version = old.metadata.resource_version
+    obj.metadata.uid = old.metadata.uid
+    obj.sync_meta()
+    cp.store.update(obj)
+    cp.settle()
+    return f"{kind}/{name} edited"
+
+
+def cmd_apiresources(cp: ControlPlane) -> str:
+    """pkg/karmadactl/apiresources: the kinds this plane serves."""
+    return "\n".join(sorted(cp.store.kinds()))
+
+
+_EXPLAIN = {
+    "propagationpolicy": (
+        "PropagationPolicy: resourceSelectors (apiVersion/kind/namespace/"
+        "name/labelSelector), placement (clusterAffinity, clusterTolerations,"
+        " spreadConstraints, replicaScheduling), preemption, priority,"
+        " failover, dependencies"
+    ),
+    "resourcebinding": (
+        "ResourceBinding: resource reference, replicas +"
+        " replicaRequirements, placement annotation, clusters (targets),"
+        " gracefulEvictionTasks, conditions"
+    ),
+    "cluster": (
+        "Cluster: syncMode Push|Pull, provider/region/zone, taints,"
+        " apiEnablements, resourceSummary, conditions, remedyActions"
+    ),
+    "overridepolicy": (
+        "OverridePolicy: resourceSelectors, overrideRules (targetCluster +"
+        " imageOverrider/argsOverrider/commandOverrider/plaintext/"
+        "labelsAnnotations)"
+    ),
+    "work": (
+        "Work: workload manifests destined for one member cluster;"
+        " status.manifestStatuses feeds aggregation"
+    ),
+}
+
+
+def cmd_explain(cp: ControlPlane, kind: str) -> str:
+    """pkg/karmadactl/explain: field documentation per kind."""
+    k = kind.lower()
+    if k.endswith("ies"):
+        k = k[:-3] + "y"
+    elif k.endswith("s"):
+        k = k[:-1]
+    doc = _EXPLAIN.get(k)
+    if doc is None:
+        raise CLIError(f"no documentation for {kind!r}")
+    return doc
+
+
+def cmd_options() -> str:
+    return (
+        "The following options can be passed to any command:\n"
+        "  -n, --namespace   object namespace\n"
+        "  --cluster         route the verb to one member cluster\n"
+        "  -f, --filename    manifest file (JSON)"
+    )
+
+
+def cmd_completion(shell: str = "bash") -> str:
+    if shell != "bash":
+        raise CLIError(f"unsupported shell {shell!r}")
+    return (
+        "_karmadactl_complete() {\n"
+        "  COMPREPLY=($(compgen -W \"" + " ".join(sorted(ALL_COMMANDS)) + "\" "
+        "-- \"${COMP_WORDS[1]}\"))\n"
+        "}\n"
+        "complete -F _karmadactl_complete karmadactl"
+    )
+
+
+def cmd_attach(cp: ControlPlane, cluster: str, workload: str,
+               namespace: str = "default") -> str:
+    """pkg/karmadactl/attach: attach to the workload's main process — the
+    in-process member returns its log stream handle."""
+    return cmd_logs(cp, cluster, workload, namespace)
+
+
+# exactly the subcommands run()'s argparse accepts (init/deinit target a
+# Management context via cmd_init/cmd_deinit, not the per-plane dispatcher)
+ALL_COMMANDS = [
+    "addons", "annotate", "api-resources", "apply", "attach", "completion",
+    "cordon", "create", "delete", "deschedule", "describe", "edit",
+    "exec", "explain", "get", "interpret", "join", "label", "logs",
+    "options", "patch", "promote", "rebalance", "register", "taint", "token",
+    "top", "uncordon", "unjoin", "unregister",
+]
+
+
 # -- rescheduling ----------------------------------------------------------
 
 
@@ -624,6 +805,38 @@ def run(cp: ControlPlane, argv: list[str]) -> str:
     p.add_argument("cmd", nargs="*", default=["sh"])
     p = sub.add_parser("addons")
     p.add_argument("action", nargs="?", default="list")
+    p = sub.add_parser("create")
+    p.add_argument("-f", "--filename", required=True)
+    p = sub.add_parser("delete")
+    p.add_argument("kind")
+    p.add_argument("name")
+    p.add_argument("-n", "--namespace", default="")
+    for cmd in ("annotate", "label"):
+        p = sub.add_parser(cmd)
+        p.add_argument("kind")
+        p.add_argument("name")
+        p.add_argument("pairs", nargs="+")
+        p.add_argument("-n", "--namespace", default="")
+    p = sub.add_parser("patch")
+    p.add_argument("kind")
+    p.add_argument("name")
+    p.add_argument("-p", "--patch", required=True)
+    p.add_argument("-n", "--namespace", default="")
+    p = sub.add_parser("edit")
+    p.add_argument("kind")
+    p.add_argument("name")
+    p.add_argument("-f", "--filename", required=True)
+    p.add_argument("-n", "--namespace", default="")
+    sub.add_parser("api-resources")
+    p = sub.add_parser("explain")
+    p.add_argument("kind")
+    sub.add_parser("options")
+    p = sub.add_parser("completion")
+    p.add_argument("shell", nargs="?", default="bash")
+    p = sub.add_parser("attach")
+    p.add_argument("workload")
+    p.add_argument("-C", "--cluster", required=True)
+    p.add_argument("-n", "--namespace", default="default")
 
     args = parser.parse_args(argv)
 
@@ -675,6 +888,30 @@ def run(cp: ControlPlane, argv: list[str]) -> str:
         return cmd_exec(cp, args.cluster, args.workload, args.cmd, args.namespace)
     if args.command == "addons":
         return cmd_addons(cp)
+    if args.command == "create":
+        return cmd_create(cp, json.load(open(args.filename)))
+    if args.command == "delete":
+        return cmd_delete(cp, args.kind, args.name, args.namespace)
+    if args.command == "annotate":
+        return cmd_annotate(cp, args.kind, args.name, args.pairs, args.namespace)
+    if args.command == "label":
+        return cmd_label(cp, args.kind, args.name, args.pairs, args.namespace)
+    if args.command == "patch":
+        return cmd_patch(cp, args.kind, args.name, json.loads(args.patch),
+                         args.namespace)
+    if args.command == "edit":
+        return cmd_edit(cp, args.kind, args.name, json.load(open(args.filename)),
+                        args.namespace)
+    if args.command == "api-resources":
+        return cmd_apiresources(cp)
+    if args.command == "explain":
+        return cmd_explain(cp, args.kind)
+    if args.command == "options":
+        return cmd_options()
+    if args.command == "completion":
+        return cmd_completion(args.shell)
+    if args.command == "attach":
+        return cmd_attach(cp, args.cluster, args.workload, args.namespace)
     if args.command == "deschedule":
         return cmd_deschedule(cp)
     if args.command == "rebalance":

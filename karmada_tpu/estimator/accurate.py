@@ -27,6 +27,7 @@ from ..models.nodes import (
 from ..native import first_fit_place
 
 _I32_MAX = np.int64(2**31 - 1)
+_estimator_uid = iter(range(1, 2**62))
 
 
 def _np_cluster_estimate(alloc, requested, pod_count, allowed_pods, request, node_ok):
@@ -62,8 +63,11 @@ class AccurateEstimator:
         self._node_ok_cache: dict[str, np.ndarray] = {}
         self._pending: dict[str, tuple[int, float]] = {}  # key -> (count, since)
         # bumped on every node-state mutation (pod placement); lets fleet-
-        # level caches (client.MemberEstimators) know when to re-snapshot
+        # level caches (client.MemberEstimators) know when to re-snapshot.
+        # uid is a process-monotonic identity: id() recycles after GC, which
+        # would let a rejoined cluster alias a stale fleet snapshot.
         self.version = 0
+        self.uid = next(_estimator_uid)
 
     # -- estimation (the gRPC answer) -------------------------------------
 

@@ -189,7 +189,6 @@ class MemberEstimators:
         self._pool = ThreadPoolExecutor(max_workers=16)
         self._fleet_key = None
         self._fleet_dev = None  # (alloc, requested, pod_count, allowed, cid, claimless_ok)
-        self._fleet_plugins = False
         self._no_node_cols = None  # bool[C] clusters without node state
 
     def _estimator_for(self, cluster: str):
@@ -216,7 +215,7 @@ class MemberEstimators:
         if any(e is not None and e.framework is not None for e in ests):
             return None
         key = tuple(
-            (c, id(e), e.version if e is not None else -1)
+            (c, e.uid, e.version) if e is not None else (c, -1, -1)
             for c, e in zip(clusters, ests)
         )
         if key == self._fleet_key:

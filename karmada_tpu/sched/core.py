@@ -409,6 +409,19 @@ def _gather_rows_kernel(a, idx):
     return a[idx]
 
 
+def _sorted_pairs(top_idx, top_val):
+    """Order each row's compact (cluster idx, replicas) window by cluster
+    index, parking the zero-replica padding at the end — shared by every
+    decode site so the sentinel logic can never drift."""
+    order = np.argsort(
+        np.where(top_val > 0, top_idx, np.int32(1 << 30)), axis=1, kind="stable"
+    )
+    return (
+        np.take_along_axis(top_idx, order, 1),
+        np.take_along_axis(top_val, order, 1),
+    )
+
+
 def _pad_rows_idx(rows: Sequence[int], bucket_fn) -> tuple[np.ndarray, int]:
     """Pad a row-index list to a jit-cache-friendly bucket (pads repeat the
     first row; callers slice the result back to len(rows))."""
@@ -780,9 +793,6 @@ class ArrayScheduler:
 
         Rows are permuted class-contiguous before encoding and decisions are
         unpermuted at the end."""
-        from . import spread as spread_mod
-        from . import spread_batch
-
         n_real = len(bindings)
         if n_real == 0:
             return []
@@ -826,6 +836,7 @@ class ArrayScheduler:
         feas_count = np.asarray(jax.device_get(dev_fc))[:n_real].astype(np.int64)
         unsched = np.zeros(n_real, bool)
         avail_sum = np.zeros(n_real, np.int64)
+        _, narrow, _ = self._batch_flags(batch)  # once per round
 
         row_err: dict[int, str] = {}
         row_target_src: dict[int, tuple] = {}
@@ -847,7 +858,6 @@ class ArrayScheduler:
             while topk < min(max_repl, TOPK_TARGETS):
                 topk *= 2
             topk = min(topk, TOPK_TARGETS)
-            _, narrow, _ = self._batch_flags(batch)
             t_out = _tail_kernel(
                 t_feas, t_avail, t_prev, t_tie,
                 batch.weight_tables, batch.weight_idx[rsel],
@@ -855,11 +865,7 @@ class ArrayScheduler:
                 topk=topk, narrow=narrow, has_agg=has_agg,
             )
             t_unsched, t_avail_sum, t_nnz, t_ti, t_tv = jax.device_get(t_out[1:])
-            ordd = np.argsort(
-                np.where(t_tv > 0, t_ti, np.int32(1 << 30)), axis=1, kind="stable"
-            )
-            tis = np.take_along_axis(t_ti, ordd, 1)
-            tvs = np.take_along_axis(t_tv, ordd, 1)
+            tis, tvs = _sorted_pairs(t_ti, t_tv)
             overflow = []
             for k, b in enumerate(rows):
                 unsched[b] = bool(t_unsched[k])
@@ -901,7 +907,7 @@ class ArrayScheduler:
             bindings, raw, batch, extra_avail, batched_rows, batched_cfg,
             fallback_rows, dev_feasible, dev_score, dev_avail, dev_prev,
             dev_tie, feas_count, unsched, avail_sum,
-            row_err, row_target_src, row_feas_src,
+            row_err, row_target_src, row_feas_src, narrow=narrow,
         )
 
         # ---- build decisions, then unpermute ----
@@ -934,6 +940,7 @@ class ArrayScheduler:
         self, bindings, raw, batch, extra_avail, batched_rows, batched_cfg,
         fallback_rows, dev_feasible, dev_score, dev_avail, dev_prev, dev_tie,
         feas_count, unsched, avail_sum, row_err, row_target_src, row_feas_src,
+        narrow=None,
     ) -> None:
         """Spread-constrained rows: batched device path + per-row exact
         fallback. Mutates the decode overlays in place. dev_prev/dev_tie may
@@ -1039,22 +1046,19 @@ class ArrayScheduler:
                     d_strategy = raw.strategy[d_brows]
                     d_replicas = raw.replicas[d_brows]
                     d_fresh = raw.fresh[d_brows]
-                    topk_d, narrow_d, _ = self._batch_flags(batch)
+                    if narrow is None:
+                        _, narrow, _ = self._batch_flags(batch)
+                    topk_d = TOPK_TARGETS
                     has_agg_d = bool((d_strategy == AGGREGATED).any())
                     un2, as2, fc2, nnz2, ti2, tv2 = jax.device_get(
                         spread_batch.spread_tail_kernel(
                             d_feas, d_avail, d_prev, d_tie, d_chosen,
                             d_strategy, d_replicas, d_fresh,
                             layout=layout, topk=topk_d,
-                            narrow=narrow_d, has_agg=has_agg_d,
+                            narrow=narrow, has_agg=has_agg_d,
                         )
                     )
-                    ordd = np.argsort(
-                        np.where(tv2 > 0, ti2, np.int32(1 << 30)), axis=1,
-                        kind="stable",
-                    )
-                    ti2s = np.take_along_axis(ti2, ordd, 1)
-                    tv2s = np.take_along_axis(tv2, ordd, 1)
+                    ti2s, tv2s = _sorted_pairs(ti2, tv2)
                     for k, b in enumerate(d_rows):
                         unsched[b] = bool(un2[k])
                         avail_sum[b] = int(as2[k])
@@ -1171,12 +1175,7 @@ class ArrayScheduler:
 
         # vectorized pair extraction for main rows
         Kw = top_idx.shape[1]
-        ordd = np.argsort(
-            np.where(top_val > 0, top_idx, np.int32(1 << 30)), axis=1,
-            kind="stable",
-        )
-        ti_sorted = np.take_along_axis(top_idx, ordd, 1)
-        tv_sorted = np.take_along_axis(top_val, ordd, 1)
+        ti_sorted, tv_sorted = _sorted_pairs(top_idx, top_val)
         overflow = [
             b for b in range(n_real)
             if b not in row_target_src and nnz[b] > Kw

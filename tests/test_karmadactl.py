@@ -257,3 +257,80 @@ class TestInitDeinitTokenFlow:
         assert agent.cert.not_after > first.not_after
         assert agent.cert.common_name == "system:node:edge-r"
         assert cp.cert_rotation_controller.rotations == 1
+
+
+class TestGenericVerbs:
+    """kubectl-style verbs (pkg/karmadactl/{create,delete,annotate,label,
+    patch,edit,apiresources,explain,options,completion,attach})."""
+
+    def test_create_and_delete(self, cp, tmp_path):
+        run(cp, ["join", "m1"])
+        f = tmp_path / "cm.json"
+        f.write_text(json.dumps({
+            "apiVersion": "v1", "kind": "ConfigMap",
+            "metadata": {"name": "settings", "namespace": "default"},
+            "data": {"a": "1"},
+        }))
+        assert "created" in run(cp, ["create", "-f", str(f)])
+        assert cp.store.try_get("v1/ConfigMap", "settings", "default") is not None
+        assert "deleted" in run(cp, ["delete", "v1/ConfigMap", "settings", "-n", "default"])
+        assert cp.store.try_get("v1/ConfigMap", "settings", "default") is None
+        with pytest.raises(CLIError, match="not found"):
+            run(cp, ["delete", "v1/ConfigMap", "settings", "-n", "default"])
+
+    def test_annotate_and_label(self, cp):
+        run(cp, ["join", "m1"])
+        run(cp, ["annotate", "cluster", "m1", "team=infra"])
+        assert cp.store.get("Cluster", "m1").metadata.annotations["team"] == "infra"
+        run(cp, ["annotate", "cluster", "m1", "team-"])
+        assert "team" not in cp.store.get("Cluster", "m1").metadata.annotations
+        run(cp, ["label", "cluster", "m1", "tier=gold", "env=prod"])
+        labels = cp.store.get("Cluster", "m1").metadata.labels
+        assert labels["tier"] == "gold" and labels["env"] == "prod"
+
+    def test_patch_merge_semantics(self, cp):
+        run(cp, ["join", "m1"])
+        propagate_web(cp, replicas=2)
+        run(cp, ["patch", "apps/v1/Deployment", "web", "-n", "default",
+                 "-p", json.dumps({"spec": {"replicas": 6}})])
+        cp.settle()
+        obj = cp.store.get("apps/v1/Deployment", "web", "default")
+        assert int(obj.get("spec", "replicas")) == 6
+        # and the change actually reschedules
+        rb = cp.store.get("ResourceBinding", "web-deployment", "default")
+        assert sum(t.replicas for t in rb.spec.clusters) == 6
+        # metadata patches must survive sync_meta (null deletes a label)
+        run(cp, ["label", "apps/v1/Deployment", "web", "-n", "default", "team=a"])
+        run(cp, ["patch", "apps/v1/Deployment", "web", "-n", "default",
+                 "-p", json.dumps({"metadata": {"labels": {"team": None}}})])
+        obj = cp.store.get("apps/v1/Deployment", "web", "default")
+        assert "team" not in obj.metadata.labels
+
+    def test_edit_replaces_template(self, cp, tmp_path):
+        run(cp, ["join", "m1"])
+        dep = propagate_web(cp, replicas=2)
+        edited = dep.to_dict()
+        edited["spec"]["replicas"] = 4
+        f = tmp_path / "web.json"
+        f.write_text(json.dumps(edited))
+        assert "edited" in run(cp, ["edit", "apps/v1/Deployment", "web",
+                                    "-n", "default", "-f", str(f)])
+        cp.settle()
+        rb = cp.store.get("ResourceBinding", "web-deployment", "default")
+        assert sum(t.replicas for t in rb.spec.clusters) == 4
+
+    def test_apiresources_explain_options_completion(self, cp):
+        run(cp, ["join", "m1"])
+        propagate_web(cp)
+        kinds = run(cp, ["api-resources"])
+        assert "Cluster" in kinds and "ResourceBinding" in kinds
+        assert "resourceSelectors" in run(cp, ["explain", "propagationpolicies"])
+        with pytest.raises(CLIError):
+            run(cp, ["explain", "nonsense"])
+        assert "--namespace" in run(cp, ["options"])
+        assert "complete -F" in run(cp, ["completion"])
+
+    def test_attach(self, cp):
+        run(cp, ["join", "m1"])
+        propagate_web(cp)
+        assert "ready=2" in run(cp, ["attach", "web", "-C", "m1"])
