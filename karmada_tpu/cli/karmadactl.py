@@ -889,7 +889,8 @@ def run(cp: ControlPlane, argv: list[str]) -> str:
     if args.command == "addons":
         return cmd_addons(cp)
     if args.command == "create":
-        return cmd_create(cp, json.load(open(args.filename)))
+        with open(args.filename) as f:
+            return cmd_create(cp, json.load(f))
     if args.command == "delete":
         return cmd_delete(cp, args.kind, args.name, args.namespace)
     if args.command == "annotate":
@@ -900,8 +901,9 @@ def run(cp: ControlPlane, argv: list[str]) -> str:
         return cmd_patch(cp, args.kind, args.name, json.loads(args.patch),
                          args.namespace)
     if args.command == "edit":
-        return cmd_edit(cp, args.kind, args.name, json.load(open(args.filename)),
-                        args.namespace)
+        with open(args.filename) as f:
+            manifest = json.load(f)
+        return cmd_edit(cp, args.kind, args.name, manifest, args.namespace)
     if args.command == "api-resources":
         return cmd_apiresources(cp)
     if args.command == "explain":

@@ -255,6 +255,15 @@ class BatchEncoder:
         evict_lists: list[list[int]] = []
         seeds = np.zeros(B, np.uint64)
 
+        # Per-PLACEMENT cache: policies are few, bindings are many, and the
+        # toleration/affinity/static-weight encodings depend only on the
+        # (shared) placement object — not the row. Keyed by id() and scoped
+        # to THIS call (bindings hold the references, so ids can't recycle
+        # mid-encode).
+        known = set(self.encoder.resources)
+        _default_placement = Placement()
+        pl_cache: dict[tuple[int, int], tuple] = {}
+
         for b, rb in enumerate(bindings):
             keys.append(rb.metadata.key())
             uids.append(rb.metadata.uid or rb.metadata.key())
@@ -265,7 +274,6 @@ class BatchEncoder:
             fresh[b] = _reschedule_required(spec, rb.status)
             seeds[b] = uid_seed(uids[-1])
             if spec.replica_requirements is not None:
-                known = set(self.encoder.resources)
                 for rname, val in spec.replica_requirements.resource_request.items():
                     if rname not in known and to_int_units(rname, val) > 0:
                         unknown_request[b] = True
@@ -274,30 +282,42 @@ class BatchEncoder:
                         rname, spec.replica_requirements.resource_request.get(rname, 0.0)
                     )
 
-            placement = spec.placement or Placement()
-            for k, tol in enumerate(placement.cluster_tolerations):
-                tol_key[b, k] = self.encoder.strings.id(tol.key)
-                tol_value[b, k] = self.encoder.strings.id(tol.value)
-                tol_effect[b, k] = EFFECT_CODES.get(tol.effect, 0)
-                tol_op[b, k] = TOL_OP_EXISTS if tol.operator == "Exists" else TOL_OP_EQUAL
-
+            placement = spec.placement or _default_placement
             term = -1 if term_indices is None else term_indices[b]
-            mask = self.affinity_cache.mask(self.active_affinity(rb, term))
-            row = aff_by_id.get(id(mask))
-            if row is None:
-                row = len(aff_rows)
-                aff_rows.append(mask)
-                aff_by_id[id(mask)] = row
+            pc = pl_cache.get((id(placement), term))
+            if pc is None:
+                trow = np.zeros((4, K), np.int32)
+                for k, tol in enumerate(placement.cluster_tolerations):
+                    trow[0, k] = self.encoder.strings.id(tol.key)
+                    trow[1, k] = self.encoder.strings.id(tol.value)
+                    trow[2, k] = EFFECT_CODES.get(tol.effect, 0)
+                    trow[3, k] = (
+                        TOL_OP_EXISTS if tol.operator == "Exists" else TOL_OP_EQUAL
+                    )
+                mask = self.affinity_cache.mask(self.active_affinity(rb, term))
+                row = aff_by_id.get(id(mask))
+                if row is None:
+                    row = len(aff_rows)
+                    aff_rows.append(mask)
+                    aff_by_id[id(mask)] = row
+                w = self._static_weights(placement)
+                wrow = 0
+                if w.any():
+                    wrow = weight_by_id.get(id(w))
+                    if wrow is None:
+                        wrow = len(weight_rows)
+                        weight_rows.append(w)
+                        weight_by_id[id(w)] = wrow
+                pc = (trow, row, wrow, bool(placement.cluster_tolerations))
+                pl_cache[(id(placement), term)] = pc
+            trow, row, wrow, has_tols = pc
+            if has_tols:
+                tol_key[b] = trow[0]
+                tol_value[b] = trow[1]
+                tol_effect[b] = trow[2]
+                tol_op[b] = trow[3]
             aff_idx[b] = row
-
-            w = self._static_weights(placement)
-            if w.any():
-                wrow = weight_by_id.get(id(w))
-                if wrow is None:
-                    wrow = len(weight_rows)
-                    weight_rows.append(w)
-                    weight_by_id[id(w)] = wrow
-                weight_idx[b] = wrow
+            weight_idx[b] = wrow
 
             prev_lists.append(
                 [

@@ -940,7 +940,7 @@ class ArrayScheduler:
         self, bindings, raw, batch, extra_avail, batched_rows, batched_cfg,
         fallback_rows, dev_feasible, dev_score, dev_avail, dev_prev, dev_tie,
         feas_count, unsched, avail_sum, row_err, row_target_src, row_feas_src,
-        narrow=None,
+        narrow: bool,
     ) -> None:
         """Spread-constrained rows: batched device path + per-row exact
         fallback. Mutates the decode overlays in place. dev_prev/dev_tie may
@@ -1046,9 +1046,11 @@ class ArrayScheduler:
                     d_strategy = raw.strategy[d_brows]
                     d_replicas = raw.replicas[d_brows]
                     d_fresh = raw.fresh[d_brows]
-                    if narrow is None:
-                        _, narrow, _ = self._batch_flags(batch)
-                    topk_d = TOPK_TARGETS
+                    max_repl = int(raw.replicas[d_rows].max(initial=0))
+                    topk_d = 8
+                    while topk_d < min(max_repl, TOPK_TARGETS):
+                        topk_d *= 2
+                    topk_d = min(topk_d, TOPK_TARGETS)
                     has_agg_d = bool((d_strategy == AGGREGATED).any())
                     un2, as2, fc2, nnz2, ti2, tv2 = jax.device_get(
                         spread_batch.spread_tail_kernel(
@@ -1166,11 +1168,12 @@ class ArrayScheduler:
         row_target_src: dict[int, tuple] = {}
         row_feas_src: dict[int, tuple] = {}
 
+        _, narrow, _ = self._batch_flags(batch)
         self._spread_overlay(
             bindings, raw, batch, extra_avail, batched_rows, batched_cfg,
             fallback_rows, dev_feasible, dev_score, dev_avail, None, None,
             feas_count, unsched, avail_sum,
-            row_err, row_target_src, row_feas_src,
+            row_err, row_target_src, row_feas_src, narrow=narrow,
         )
 
         # vectorized pair extraction for main rows
