@@ -310,6 +310,11 @@ class _ComboTable:
         for i, m in enumerate(self.members):
             self.onehot[i, list(m)] = 1
         self.sizes = self.onehot.sum(1)
+        self.max_len = max((len(m) for m in self.members), default=1)
+        self.members_pad = np.full((max(len(self.members), 1), self.max_len),
+                                   -1, np.int64)
+        for i, m in enumerate(self.members):
+            self.members_pad[i, : len(m)] = m
 
 
 _combo_cache: dict[tuple[int, int, int], _ComboTable] = {}
@@ -387,9 +392,26 @@ def select_regions_batch(
     overflow = (~too_few) & (kmax_row > kmax_enum) & (n_present > kmax_enum)
 
     v64 = value.astype(np.int64)
-    sum_w = weight @ table.onehot.T  # [S,K]
-    sum_v = v64 @ table.onehot.T
-    members_present = (present @ table.onehot.T) == table.sizes[None, :]
+    # int64 matmul has no BLAS path in numpy (it cost ~0.5 s at 5k rows x
+    # 680 combos); float64 is exact while |weight| * path-length < 2^53,
+    # which holds for every sane score (weight <= target*1000 + avg score).
+    # The [S,K] aggregates STAY f64/i32 — halving the bandwidth of the
+    # dozen masked passes below.
+    onehot_f = table.onehot.astype(np.float64).T
+    if int(np.abs(weight).max(initial=0)) >= (1 << 48):
+        # pathological magnitudes would lose exactness in f64 rank compares:
+        # such fleets go to the per-row exact DFS
+        live = np.nonzero(~too_few)[0]
+        fallback.extend(int(s) for s in live)
+        return ComboResult(chosen, errors, fallback)
+    sum_w = weight.astype(np.float64) @ onehot_f  # exact below 2^48
+    # values are i32 per region; a path of several huge regions can pass
+    # 2^31, so the summed form stays i64 (f64 is exact: counts << 2^53)
+    sum_v = (v64.astype(np.float64) @ onehot_f).astype(np.int64)
+    members_present = (
+        (present.astype(np.float64) @ onehot_f).astype(np.int32)
+        == table.sizes[None, :]
+    )
     feasible_combo = (
         members_present
         & (sum_v >= cfg.cmin)
@@ -399,72 +421,105 @@ def select_regions_batch(
     # RECORDED-path pruning: the reference DFS returns at the FIRST
     # satisfied prefix (select_groups.go dfs), so a subset is enumerated
     # iff removing its LAST member in the group order (value asc, weight
-    # desc, name asc) leaves an UNsatisfied prefix. Compute each combo's
-    # last-member value per row by a vectorized tournament.
-    v_last = np.zeros((S, len(table.members)), np.int64)
+    # desc, name asc) leaves an UNsatisfied prefix. Each row ranks its
+    # regions in that order ONCE (pos, int8 — R <= 64), then every combo's
+    # last member falls out of one [S, K, Lmax] positional gather.
     rr = layout.rname_rank
-    for ci, members in enumerate(table.members):
-        if len(members) == 1:
-            continue  # k-1 = 0 < kmin: always recorded when feasible
-        bv = v64[:, members[0]].copy()
-        bw = weight[:, members[0]].copy()
-        bn = np.full(S, rr[members[0]])
-        for m in members[1:]:
-            vm, wm, nm = v64[:, m], weight[:, m], rr[m]
-            after = (vm > bv) | (
-                (vm == bv) & ((wm < bw) | ((wm == bw) & (nm > bn)))
-            )
-            bv = np.where(after, vm, bv)
-            bw = np.where(after, wm, bw)
-            bn = np.where(after, nm, bn)
-        v_last[:, ci] = bv
+    order_g = np.lexsort(
+        (np.broadcast_to(rr, (S, R)), -weight, v64), axis=-1
+    )  # ascending group order; last position = the DFS path's last member
+    pos = np.empty((S, R), np.int8)
+    np.put_along_axis(pos, order_g, np.arange(R, dtype=np.int8)[None, :], -1)
+    mp = table.members_pad  # [K, Lmax], -1 = pad
+    mpc = np.where(mp >= 0, mp, 0)
+    pos_g = pos[:, mpc]  # [S, K, Lmax] int8
+    pos_g = np.where(mp[None, :, :] >= 0, pos_g, np.int8(-1))
+    am = pos_g.argmax(axis=2)  # [S, K]
+    last_region = mpc[np.arange(mpc.shape[0])[None, :], am]  # [S, K]
+    v_last = np.take_along_axis(value, last_region, axis=1)  # i32
     recorded = (table.sizes[None, :] - 1 < kmin) | (sum_v - v_last < cfg.cmin)
     feasible_combo &= recorded
 
-    NEG = np.int64(-(1 << 62))
-    w_masked = np.where(feasible_combo, sum_w, NEG)
+    w_masked = np.where(feasible_combo, sum_w, -np.inf)
     best_w = w_masked.max(1)
-    none_feasible = best_w == NEG
+    none_feasible = np.isneginf(best_w)
     cand = w_masked == best_w[:, None]
-    v_masked = np.where(cand, sum_v, NEG)
+    v_masked = np.where(cand, sum_v, np.int64(-(1 << 62)))
     best_v = v_masked.max(1)
     cand2 = cand & (sum_v == best_v[:, None]) & feasible_combo
     n_ties = cand2.sum(1)
 
     first_idx = np.argmax(cand2, axis=1)
 
-    for s in range(S):
-        if s in errors:
-            continue
-        if none_feasible[s]:
-            errors[s] = (
+    # rows that need a decision here (everything else errors or falls back)
+    live = np.ones(S, bool)
+    for s in np.nonzero(none_feasible)[0]:
+        if int(s) not in errors:
+            errors[int(s)] = (
                 "the number of clusters is less than the cluster "
                 "spreadConstraint.MinGroups"
             )
-            continue
-        if overflow[s] or n_ties[s] > 1:
-            fallback.append(s)
-            continue
-        combo = table.members[int(first_idx[s])]
-        # subpath preference (select_groups.go:210-230): order the winner's
-        # members by (weight desc, name asc) and take the SHORTEST prefix
-        # that is itself a RECORDED feasible path
-        members = sorted(
-            combo, key=lambda r: (-int(weight[s, r]), layout.region_names[r])
-        )
-        cut = len(members)
-        for L in range(max(kmin, 1), len(members)):
-            pref = members[:L]
-            sv = sum(int(v64[s, r]) for r in pref)
-            if sv < cfg.cmin:
-                continue
-            # recorded-ness of the prefix: drop ITS value-order last member
-            last = max(
-                pref,
-                key=lambda r: (int(v64[s, r]), -int(weight[s, r]), rr[r]),
-            )
-            if L - 1 < kmin or sv - int(v64[s, last]) < cfg.cmin:
-                cut = L
-                break
-        chosen[s, members[:cut]] = True
+    live &= ~none_feasible
+    for s in errors:
+        live[s] = False
+    fb_mask = live & (overflow | (n_ties > 1))
+    fallback.extend(int(s) for s in np.nonzero(fb_mask)[0])
+    live &= ~fb_mask
+    rows = np.nonzero(live)[0]
+    if not len(rows):
+        return ComboResult(chosen, errors, fallback)
+
+    # ---- vectorized subpath preference (select_groups.go:210-230): order
+    # each winner's members by (weight desc, name asc), then take the
+    # SHORTEST prefix that is itself a RECORDED feasible path ----
+    Lmax = table.max_len
+    mem = table.members_pad[first_idx[rows]]  # [N, Lmax] region ids, -1 = pad
+    valid_m = mem >= 0
+    midx = np.where(valid_m, mem, 0)
+    mw = np.where(valid_m, weight[rows[:, None], midx], np.int64(-1) << 62)
+    mv = np.where(valid_m, v64[rows[:, None], midx], 0)
+    mn = np.where(valid_m, rr[midx], np.int64(1) << 40)
+    # row-wise sort by (weight desc, name asc): stable argsort name, then -w
+    o1 = np.argsort(mn, axis=1, kind="stable")
+    mw1 = np.take_along_axis(mw, o1, 1)
+    o2 = np.argsort(-mw1, axis=1, kind="stable")
+    order = np.take_along_axis(o1, o2, 1)
+    ms = np.take_along_axis(mem, order, 1)  # sorted member ids
+    vs = np.take_along_axis(mv, order, 1)
+    ws = np.take_along_axis(mw, order, 1)
+    ns = np.take_along_axis(mn, order, 1)
+    sizes_r = valid_m.sum(1)
+    cum_v = np.cumsum(vs, axis=1)
+
+    cut = sizes_r.copy()
+    decided = np.zeros(len(rows), bool)
+    for L in range(max(kmin, 1), Lmax):
+        cand_rows = (~decided) & (sizes_r > L)
+        if not cand_rows.any():
+            break
+        ok = cum_v[:, L - 1] >= cfg.cmin
+        if L - 1 >= kmin:
+            # recorded-ness: drop the prefix's value-order last member
+            # ((value asc, weight desc, name asc) max) — tournament over L
+            bv = vs[:, 0].copy()
+            bw = ws[:, 0].copy()
+            bn = ns[:, 0].copy()
+            for j in range(1, L):
+                after = (vs[:, j] > bv) | (
+                    (vs[:, j] == bv)
+                    & ((ws[:, j] < bw) | ((ws[:, j] == bw) & (ns[:, j] > bn)))
+                )
+                bv = np.where(after, vs[:, j], bv)
+                bw = np.where(after, ws[:, j], bw)
+                bn = np.where(after, ns[:, j], bn)
+            ok = ok & (cum_v[:, L - 1] - bv < cfg.cmin)
+        hit = cand_rows & ok
+        cut[hit] = L
+        decided |= hit
+
+    # scatter the chosen prefixes: position < cut (over the sorted order)
+    keep = np.arange(Lmax)[None, :] < cut[:, None]
+    sel_rows = np.repeat(rows, Lmax)[keep.ravel()]
+    sel_regions = ms.ravel()[keep.ravel()]
+    chosen[sel_rows, sel_regions] = True
     return ComboResult(chosen, errors, fallback)
