@@ -119,6 +119,11 @@ class BindingBatch:
     # tie-break randomness: per-binding seed, expanded on device
     seeds: np.ndarray  # u64[B]
     n_clusters: int = 0
+    # deduped request vectors: the [.,C,R] estimator divisions run once per
+    # DISTINCT request (policies are few); rows gather via req_idx. None on
+    # hand-built batches — consumers fall back to the dense `request`.
+    req_unique: "np.ndarray | None" = None  # i64[U,R]
+    req_idx: "np.ndarray | None" = None  # i32[B]
 
     @property
     def size(self) -> int:
@@ -334,6 +339,16 @@ class BatchEncoder:
                 ]
             )
 
+        # deduped request vectors, U padded to a pow2 bucket (jit cache)
+        req_unique, req_inverse = np.unique(request, axis=0, return_inverse=True)
+        U = len(req_unique)
+        Up = 1
+        while Up < U:
+            Up *= 2
+        if Up > U:
+            req_unique = np.pad(req_unique, [(0, Up - U), (0, 0)])
+        req_idx_arr = req_inverse.astype(np.int32)
+
         # sparse axes bucketed to powers of two (jit cache bound)
         def bucket(n: int, lo: int = 2) -> int:
             k = lo
@@ -375,6 +390,8 @@ class BatchEncoder:
             evict_idx=evict_idx,
             seeds=seeds,
             n_clusters=C,
+            req_unique=req_unique,
+            req_idx=req_idx_arr,
         )
 
 

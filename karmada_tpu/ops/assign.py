@@ -277,6 +277,33 @@ def combined_assign(
     return DynamicResult(result, unsched, avail_sum.astype(jnp.int32))
 
 
+def general_estimate_unique(capacity, has_summary, request_u):
+    """The [U,C] core of general_estimate over UNIQUE request vectors —
+    requests come from policies (few), not rows (many), so the expensive
+    [.,C,R] integer divisions run once per distinct vector and rows gather
+    their answer (general_estimate_apply)."""
+    has_req = request_u > 0  # [U,R]
+    cap = capacity[None, :, :].astype(jnp.int64)
+    req = jnp.maximum(request_u, 1)[:, None, :].astype(jnp.int64)
+    big = jnp.int64(2**62)
+    per_res = jnp.where(has_req[:, None, :], cap // req, big)
+    per_res = jnp.where(has_req[:, None, :] & (cap <= 0), 0, per_res)
+    est_u = jnp.min(per_res, axis=-1)  # i64[U,C]
+    return est_u, has_req.any(-1)
+
+
+def general_estimate_apply(est_u, any_req_u, req_idx, has_summary, replicas):
+    """Row gather + the per-row clamps of general_estimate (same order of
+    operations — bit-exact with the dense form)."""
+    est = est_u[req_idx]  # i64[B,C]
+    any_req = any_req_u[req_idx]
+    replicas64 = replicas.astype(jnp.int64)
+    est = jnp.where(any_req[:, None], est, replicas64[:, None])
+    est = jnp.where(has_summary[None, :], est, 0)
+    est = jnp.where(est >= I32_MAX.astype(jnp.int64), replicas64[:, None], est)
+    return est.astype(jnp.int32)
+
+
 def general_estimate(
     capacity,  # i64[C,R] available = allocatable − allocated − allocating
     has_summary,  # bool[C]

@@ -59,6 +59,57 @@ def make_mesh(devices: Optional[Sequence] = None) -> Mesh:
     return Mesh(np.array(devices).reshape(b, c), (AXIS_BINDINGS, AXIS_CLUSTERS))
 
 
+def initialize_multihost(coordinator: Optional[str] = None,
+                         num_processes: Optional[int] = None,
+                         process_id: Optional[int] = None) -> None:
+    """Join this process to a multi-host JAX cluster (the distributed
+    communication backend — the reference scales its control plane by adding
+    scheduler replicas behind leader election; the TPU-native equivalent is
+    one SPMD program spanning hosts, with XLA emitting the cross-host
+    collectives over DCN). Safe to call on single-host: it no-ops when no
+    coordinator is configured."""
+    if coordinator is None:
+        return
+    jax.distributed.initialize(
+        coordinator_address=coordinator,
+        num_processes=num_processes,
+        process_id=process_id,
+    )
+
+
+def make_hierarchical_mesh(devices: Optional[Sequence] = None) -> Mesh:
+    """Multi-host mesh with DCN/ICI-aware axis assignment (the scaling-book
+    recipe: put the axis with the cheapest communication across the slowest
+    link). The BINDINGS axis carries no collective at all in this solve —
+    rows are independent end-to-end — so it spans HOSTS (DCN); the CLUSTERS
+    axis carries the per-round all_gather, so it stays within each host's
+    local devices (ICI). On a single host this degenerates to
+    (1 x local-device factorization) of make_mesh."""
+    devices = list(devices if devices is not None else jax.devices())
+    by_process: dict[int, list] = {}
+    for d in devices:
+        by_process.setdefault(getattr(d, "process_index", 0), []).append(d)
+    n_hosts = len(by_process)
+    per_host = min(len(v) for v in by_process.values())
+    dropped = sum(len(v) - per_host for v in by_process.values())
+    if dropped:
+        import warnings
+
+        warnings.warn(
+            f"make_hierarchical_mesh: hosts have unequal device counts; "
+            f"dropping {dropped} device(s) to keep the mesh rectangular",
+            stacklevel=2,
+        )
+    grid = np.array(
+        [v[:per_host] for _, v in sorted(by_process.items())]
+    )  # [hosts, local]
+    # widen bindings within the host too when local devices outnumber the
+    # useful cluster shards (keeps shard shapes square-ish)
+    lb, lc = factor_mesh(per_host)
+    grid = grid.reshape(n_hosts * lb, lc)
+    return Mesh(grid, (AXIS_BINDINGS, AXIS_CLUSTERS))
+
+
 # in_specs in the exact positional order of sched.core._schedule_kernel_compact
 _FLEET_SPECS = (
     P(AXIS_CLUSTERS),        # alive
@@ -88,6 +139,8 @@ _BATCH_SPECS = (
     P(AXIS_BINDINGS, None),  # prev_rep
     P(AXIS_BINDINGS, None),  # evict_idx
     P(AXIS_BINDINGS),        # seeds
+    P(None, None),           # req_unique (replicated policy table)
+    P(AXIS_BINDINGS),        # req_idx
 )
 _OUT_SPECS = (
     P(AXIS_BINDINGS, None),  # feasible (full rows, replicated over clusters axis)
@@ -110,6 +163,7 @@ def _sharded_body(topk: int):
         tol_key, tol_value, tol_effect, tol_op,
         aff_masks, aff_idx, weight_tables, weight_idx,
         prev_idx, prev_rep, evict_idx, seeds,
+        req_unique, req_idx,
         extra_avail,
     ):
         # shares the single-chip kernel's phases (sched/core.py): decompress →
@@ -136,6 +190,7 @@ def _sharded_body(topk: int):
             replicas, request, unknown_request, gvk,
             tol_key, tol_value, tol_effect, tol_op,
             affinity_ok, eviction_ok, prev_member,
+            req_unique=req_unique, req_idx=req_idx,
         )
 
         # ---- gather the cluster shards: the division solve is a per-row
@@ -280,5 +335,7 @@ class MeshScheduleKernel:
             bb(batch.prev_rep),
             _pad_axis(batch.evict_idx, 0, Bp, fill=Cp),
             bb(batch.seeds),
+            batch.req_unique,
+            bb(batch.req_idx),
             extra,
         )

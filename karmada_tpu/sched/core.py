@@ -120,6 +120,7 @@ def filter_estimate_phase(
     replicas, request, unknown_request, gvk,
     tol_key, tol_value, tol_effect, tol_op,
     affinity_ok, eviction_ok, prev_member,
+    req_unique=None, req_idx=None,
 ):
     """Filters + score + GeneralEstimator — elementwise over (B, C), so the
     mesh path runs it on local (B_l, C_l) tiles before any collective.
@@ -134,7 +135,17 @@ def filter_estimate_phase(
         alive, api_mask, taint_mask, jnp.ones_like(affinity_ok), affinity_ok, eviction_ok
     )
     score = filter_ops.locality_score(prev_member)
-    avail = assign_ops.general_estimate(capacity, has_summary, request, replicas)
+    if req_unique is not None:
+        # requests dedup to the policy set: the [.,C,R] divisions run per
+        # DISTINCT vector; rows gather (bit-exact with the dense form)
+        est_u, any_u = assign_ops.general_estimate_unique(
+            capacity, has_summary, req_unique
+        )
+        avail = assign_ops.general_estimate_apply(
+            est_u, any_u, req_idx, has_summary, replicas
+        )
+    else:
+        avail = assign_ops.general_estimate(capacity, has_summary, request, replicas)
     avail = jnp.where(unknown_request[:, None], 0, avail)
     return feasible, score, avail
 
@@ -203,12 +214,15 @@ def _schedule_body(
     extra_avail,  # i32[B,C] min-merged registered-estimator answers; -1 = none
     narrow: bool = False,
     has_agg: bool = True,
+    req_unique=None,
+    req_idx=None,
 ):
     feasible, score, avail = filter_estimate_phase(
         alive, capacity, has_summary, taint_key, taint_value, taint_effect, api_ok,
         replicas, request, unknown_request, gvk,
         tol_key, tol_value, tol_effect, tol_op,
         affinity_ok, eviction_ok, prev_member,
+        req_unique=req_unique, req_idx=req_idx,
     )
     # min-merge with registered estimators (-1 sentinel discarded,
     # core/util.go:72-92); gRPC/node-level answers tighten the general bound
@@ -297,6 +311,7 @@ def _schedule_kernel_compact(
     # factored [B,C] reconstruction inputs (models/batch.py BindingBatch)
     aff_masks, aff_idx, weight_tables, weight_idx,
     prev_idx, prev_rep, evict_idx, seeds,
+    req_unique, req_idx,  # deduped request vectors (policy-level)
     extra_avail,  # i32[B,C] or broadcastable [1,1] sentinel
     topk: int = TOPK_TARGETS,
     narrow: bool = False,
@@ -324,6 +339,7 @@ def _schedule_kernel_compact(
         tol_key, tol_value, tol_effect, tol_op,
         affinity_ok, eviction_ok, static_weight, prev_member, prev_replicas, tie,
         extra, narrow=narrow, has_agg=has_agg,
+        req_unique=req_unique, req_idx=req_idx,
     )
     feas_count, nnz, top_idx, top_val = compact_outputs(
         feasible, result, min(C, topk)
@@ -344,6 +360,7 @@ def _filter_kernel_compact(
     # factored reconstruction inputs (static weights skipped: the division
     # tail decompresses them itself for its row subset)
     aff_masks, aff_idx, prev_idx, prev_rep, evict_idx, seeds,
+    req_unique, req_idx,
     extra_avail,
 ):
     """Filter + estimate ONLY — phase 1 of the partitioned schedule round.
@@ -368,6 +385,7 @@ def _filter_kernel_compact(
         api_ok, replicas, request, unknown_request, gvk,
         tol_key, tol_value, tol_effect, tol_op,
         affinity_ok, eviction_ok, prev_member,
+        req_unique=req_unique, req_idx=req_idx,
     )
     extra = jnp.broadcast_to(extra_avail, (B, C))
     avail = jnp.where(extra >= 0, jnp.minimum(avail, extra), avail)
@@ -486,6 +504,8 @@ def _restrict_rows(batch: BindingBatch, rows: list[int], affinity_override: np.n
         evict_idx=take(batch.evict_idx),
         seeds=take(batch.seeds),
         n_clusters=batch.n_clusters,
+        req_unique=batch.req_unique,
+        req_idx=None if batch.req_idx is None else take(batch.req_idx),
     )
 
 
@@ -601,6 +621,8 @@ class ArrayScheduler:
             evict_idx=pz(batch.evict_idx, fill=batch.n_clusters),
             seeds=pz(batch.seeds),
             n_clusters=batch.n_clusters,
+            req_unique=batch.req_unique,
+            req_idx=None if batch.req_idx is None else pz(batch.req_idx),
         )
 
     _NO_EXTRA = np.full((1, 1), -1, np.int32)  # broadcast sentinel
@@ -672,6 +694,8 @@ class ArrayScheduler:
             batch.prev_rep,
             batch.evict_idx,
             batch.seeds,
+            batch.req_unique,
+            batch.req_idx,
             extra_avail,
             topk=topk,
             narrow=narrow,
@@ -830,6 +854,7 @@ class ArrayScheduler:
                 batch.gvk, batch.tol_key, batch.tol_value, batch.tol_effect,
                 batch.tol_op, batch.aff_masks, batch.aff_idx,
                 batch.prev_idx, batch.prev_rep, batch.evict_idx, batch.seeds,
+                batch.req_unique, batch.req_idx,
                 self._NO_EXTRA if extra_avail is None else extra_avail,
             )
         )

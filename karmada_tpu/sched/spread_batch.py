@@ -311,6 +311,7 @@ class _ComboTable:
             self.onehot[i, list(m)] = 1
         self.sizes = self.onehot.sum(1)
         self.max_len = max((len(m) for m in self.members), default=1)
+        self.onehot_f_t = self.onehot.astype(np.float64).T  # cached for BLAS
         self.members_pad = np.full((max(len(self.members), 1), self.max_len),
                                    -1, np.int64)
         for i, m in enumerate(self.members):
@@ -397,7 +398,7 @@ def select_regions_batch(
     # which holds for every sane score (weight <= target*1000 + avg score).
     # The [S,K] aggregates STAY f64/i32 — halving the bandwidth of the
     # dozen masked passes below.
-    onehot_f = table.onehot.astype(np.float64).T
+    onehot_f = table.onehot_f_t
     if int(np.abs(weight).max(initial=0)) >= (1 << 48):
         # pathological magnitudes would lose exactness in f64 rank compares:
         # such fleets go to the per-row exact DFS

@@ -234,3 +234,38 @@ def test_sharded_at_scale_sampled_parity():
         np.asarray(ref_out[3])[: len(bindings)],
         np.asarray(got_out[3])[: len(bindings)],
     )
+
+
+def test_hierarchical_mesh_axis_assignment():
+    """DCN/ICI-aware mesh: the collective-free bindings axis spans process
+    groups; the all_gather-carrying clusters axis stays within a host's
+    local devices (parallel/mesh.py make_hierarchical_mesh)."""
+    from karmada_tpu.parallel.mesh import (
+        AXIS_BINDINGS, AXIS_CLUSTERS, make_hierarchical_mesh,
+    )
+
+    mesh = make_hierarchical_mesh(jax.devices())
+    assert set(mesh.axis_names) == {AXIS_BINDINGS, AXIS_CLUSTERS}
+    # single host, 8 virtual devices: degenerates to the square-ish split
+    assert mesh.shape[AXIS_BINDINGS] * mesh.shape[AXIS_CLUSTERS] == 8
+    # every clusters-axis group lives in one process (ICI-only collectives)
+    devs = mesh.devices
+    for row in range(devs.shape[0]):
+        procs = {getattr(d, "process_index", 0) for d in devs[row]}
+        assert len(procs) == 1
+
+    # the scheduler runs on it with identical decisions
+    from karmada_tpu.testing.fixtures import synthetic_fleet
+    from tests.test_scheduler_core import dyn_placement, make_binding
+
+    clusters = synthetic_fleet(24, seed=11)
+    sched = ArrayScheduler(clusters)
+    hier = ArrayScheduler(clusters, mesh=mesh)
+    bindings = [make_binding(f"b{i}", 6 + i, dyn_placement(), cpu=0.5)
+                for i in range(10)]
+    want = sched.schedule(bindings)
+    got = hier.schedule(bindings)
+    for w, g in zip(want, got):
+        assert w.ok and g.ok
+        assert {t.name: t.replicas for t in w.targets} == {
+            t.name: t.replicas for t in g.targets}
