@@ -316,6 +316,13 @@ class MeshScheduleKernel:
         def tbl(a):  # policy tables: pad the cluster axis
             return _pad_axis(a, 1, Cp)
 
+        # hand-built batches may lack the deduped request form (the
+        # documented fallback): synthesize the trivial factoring
+        if batch.req_unique is None or batch.req_idx is None:
+            req_unique = batch.request
+            req_idx = np.arange(B, dtype=np.int32)
+        else:
+            req_unique, req_idx = batch.req_unique, batch.req_idx
         if extra_avail is None or extra_avail.shape == (1, 1):
             extra, dense_extra = self._NO_EXTRA, False
         else:
@@ -335,7 +342,7 @@ class MeshScheduleKernel:
             bb(batch.prev_rep),
             _pad_axis(batch.evict_idx, 0, Bp, fill=Cp),
             bb(batch.seeds),
-            batch.req_unique,
-            bb(batch.req_idx),
+            req_unique,
+            bb(req_idx),
             extra,
         )

@@ -341,11 +341,71 @@ class ComboResult:
     fallback: list[int]  # rows needing the exact per-row path (ties etc.)
 
 
+# device winner-selection guard: the [S,K,L] gathers must fit comfortably
+SPREAD_COMBO_DEVICE_BYTES = 1 << 30
+
+
+@partial(jax.jit, static_argnames=("table", "cmin", "kmin"))
+def _combo_select_kernel(weight, value, kmax_row, rname, table, cmin: int,
+                         kmin: int):
+    """Device twin of the winner-selection block of select_regions_batch:
+    per-combination sums via [S,K,L] gathers (int-exact, no f64 dance),
+    DFS recorded-path pruning via the group-order positional gather, and
+    the (Σweight, Σvalue) lexicographic winner + tie count. Returns
+    (first_idx i32[S], n_ties i32[S], none_feasible bool[S])."""
+    S, R = weight.shape
+    v64 = value.astype(jnp.int64)
+    mp = jnp.asarray(table.members_pad)  # [K, L]
+    valid = mp >= 0
+    mpc = jnp.where(valid, mp, 0)
+    w_g = jnp.where(valid[None, :, :], weight[:, mpc], 0)  # [S,K,L]
+    v_g = jnp.where(valid[None, :, :], v64[:, mpc], 0)
+    present_g = jnp.where(valid[None, :, :], value[:, mpc] > 0, True)
+    sum_w = w_g.sum(-1)  # [S,K] i64
+    sum_v = v_g.sum(-1)
+    sizes = jnp.asarray(table.sizes)
+    feasible = (
+        present_g.all(-1)
+        & (sum_v >= cmin)
+        & (sizes[None, :] <= kmax_row[:, None].astype(jnp.int64))
+    )
+    # recorded-path pruning: group-order (value asc, weight desc, name asc)
+    order_g = jnp.lexsort(
+        (jnp.broadcast_to(rname, (S, R)), -weight, v64), axis=-1
+    )
+    pos = jnp.zeros((S, R), jnp.int32).at[
+        jnp.arange(S)[:, None], order_g
+    ].set(jnp.broadcast_to(jnp.arange(R, dtype=jnp.int32), (S, R)))
+    pos_g = jnp.where(valid[None, :, :], pos[:, mpc], -1)
+    am = pos_g.argmax(-1)  # [S,K]
+    last_region = jnp.take_along_axis(
+        jnp.broadcast_to(mpc[None, :, :], pos_g.shape), am[:, :, None], axis=2
+    )[:, :, 0]
+    v_last = jnp.take_along_axis(v64, last_region, axis=1)
+    recorded = (sizes[None, :] - 1 < kmin) | (sum_v - v_last < cmin)
+    feasible = feasible & recorded
+
+    NEG = jnp.int64(-(1 << 62))
+    w_m = jnp.where(feasible, sum_w, NEG)
+    best_w = w_m.max(1)
+    none_feasible = best_w == NEG
+    cand = feasible & (w_m == best_w[:, None])
+    v_m = jnp.where(cand, sum_v, NEG)
+    best_v = v_m.max(1)
+    cand2 = cand & (sum_v == best_v[:, None])
+    return (
+        jnp.argmax(cand2, axis=1).astype(jnp.int32),
+        cand2.sum(1).astype(jnp.int32),
+        none_feasible,
+    )
+
+
 def select_regions_batch(
     weight: np.ndarray,  # i64[S,R]
     value: np.ndarray,  # i32[S,R]
     cfg: SpreadConfig,
     layout: RegionLayout,
+    device: "bool | None" = None,  # None = auto (accelerator + size gate)
 ) -> ComboResult:
     """Vectorized selectGroups (select_groups.go:100-230) for rows sharing
     one constraint config. Winner per row = feasible combination maximizing
@@ -393,18 +453,43 @@ def select_regions_batch(
     overflow = (~too_few) & (kmax_row > kmax_enum) & (n_present > kmax_enum)
 
     v64 = value.astype(np.int64)
+    if int(np.abs(weight).max(initial=0)) >= (1 << 48):
+        # pathological magnitudes would lose exactness in the f64 host rank
+        # compares; keep behavior identical across backends by routing such
+        # fleets to the per-row exact DFS everywhere
+        live = np.nonzero(~too_few)[0]
+        fallback.extend(int(s) for s in live)
+        return ComboResult(chosen, errors, fallback)
+
+    if device is None:
+        device = (
+            jax.default_backend() != "cpu"
+            and S * len(table.members) * table.max_len * 8
+            <= SPREAD_COMBO_DEVICE_BYTES
+        )
+    if device:
+        # the winner-selection block as ONE jitted program (int-exact)
+        fi, nt, nf = jax.device_get(_combo_select_kernel(
+            jnp.asarray(weight), jnp.asarray(value),
+            jnp.asarray(kmax_row.astype(np.int32)),
+            jnp.asarray(layout.rname_rank.astype(np.int32)),
+            table=table, cmin=int(cfg.cmin), kmin=int(kmin),
+        ))
+        first_idx = np.asarray(fi)
+        n_ties = np.asarray(nt)
+        none_feasible = np.asarray(nf)
+        return _finish_selection(
+            weight, v64, cfg, layout, table, kmin, chosen, errors,
+            fallback, overflow, first_idx, n_ties, none_feasible,
+        )
+
+    # host path (also the spec the device kernel is tested against)
     # int64 matmul has no BLAS path in numpy (it cost ~0.5 s at 5k rows x
     # 680 combos); float64 is exact while |weight| * path-length < 2^53,
     # which holds for every sane score (weight <= target*1000 + avg score).
     # The [S,K] aggregates STAY f64/i32 — halving the bandwidth of the
     # dozen masked passes below.
     onehot_f = table.onehot_f_t
-    if int(np.abs(weight).max(initial=0)) >= (1 << 48):
-        # pathological magnitudes would lose exactness in f64 rank compares:
-        # such fleets go to the per-row exact DFS
-        live = np.nonzero(~too_few)[0]
-        fallback.extend(int(s) for s in live)
-        return ComboResult(chosen, errors, fallback)
     sum_w = weight.astype(np.float64) @ onehot_f  # exact below 2^48
     # values are i32 per region; a path of several huge regions can pass
     # 2^31, so the summed form stays i64 (f64 is exact: counts << 2^53)
@@ -451,6 +536,21 @@ def select_regions_batch(
     n_ties = cand2.sum(1)
 
     first_idx = np.argmax(cand2, axis=1)
+    return _finish_selection(
+        weight, v64, cfg, layout, table, kmin, chosen, errors,
+        fallback, overflow, first_idx, n_ties, none_feasible,
+    )
+
+
+def _finish_selection(
+    weight, v64, cfg, layout, table, kmin, chosen, errors, fallback,
+    overflow, first_idx, n_ties, none_feasible,
+) -> ComboResult:
+    """Shared tail of select_regions_batch: error/fallback routing + the
+    vectorized subpath preference, fed by either the host or the device
+    winner selection."""
+    S = weight.shape[0]
+    rr = layout.rname_rank
 
     # rows that need a decision here (everything else errors or falls back)
     live = np.ones(S, bool)

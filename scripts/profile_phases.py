@@ -65,6 +65,7 @@ def main():
         out = core_mod._schedule_kernel_compact(
             *fleet_dev, replicas, request, unknown_request, gvk, strategy,
             fresh, tol_key, tol_value, tol_effect, tol_op, *dec_dev,
+            batch.req_unique, batch.req_idx,
             jnp.full((1, 1), -1, jnp.int32))
         return sum(o.sum().astype(jnp.int64) for o in out[3:5]) + out[8].sum()
 
@@ -109,6 +110,7 @@ def main():
     out = core_mod._schedule_kernel_compact(
         *fleet_dev, replicas, request, unknown_request, gvk, strategy,
         fresh, tol_key, tol_value, tol_effect, tol_op, *dec_dev,
+        batch.req_unique, batch.req_idx,
         jnp.full((1, 1), -1, jnp.int32))
     _ = jax.device_get((out[3], out[4], out[6], out[7], out[8], out[9]))
 

@@ -153,27 +153,6 @@ def main():
     tie32 = jnp.asarray(rng.integers(0, 1 << 31 - 1, (B, C)), jnp.int32)
 
     @jax.jit
-    def rank_current(w, last, tie):
-        return assign_ops._rank_by(w, last, tie)
-
-    t = timeit(lambda: rank_current(w64, last, tie32), iters=args.iters)
-    print(f"  _rank_by (lexsort+argsort, i64) {t:8.3f}s", flush=True)
-
-    @jax.jit
-    def rank_scatter(w, last, tie):
-        last_tie = (
-            ((jnp.int64(2**31 - 1) - last.astype(jnp.int64)) << jnp.int64(32))
-            | tie.astype(jnp.int64))
-        order = jnp.lexsort((last_tie, -w), axis=-1)
-        iota = jnp.broadcast_to(jnp.arange(C, dtype=jnp.int32), (B, C))
-        rank = jnp.zeros((B, C), jnp.int32).at[
-            jnp.arange(B)[:, None], order].set(iota)
-        return rank
-
-    t = timeit(lambda: rank_scatter(w64, last, tie32), iters=args.iters)
-    print(f"  rank scatter-iota (1 sort, i64) {t:8.3f}s", flush=True)
-
-    @jax.jit
     def one_sort_i64(w):
         return jnp.sort(w, axis=-1)
 

@@ -417,3 +417,34 @@ def test_region_max_below_min_clamped_like_dfs():
     if got.ok:
         assert {t.name: t.replicas for t in got.targets} == {
             t.name: t.replicas for t in want.targets}
+
+
+def test_device_combo_select_matches_host():
+    """The jitted winner-selection kernel must agree with the numpy host
+    path (which the randomized tests pin to the exact DFS)."""
+    import numpy as np
+
+    from karmada_tpu.sched.spread_batch import (
+        RegionLayout, SpreadConfig, select_regions_batch,
+    )
+
+    rng = np.random.default_rng(7)
+    R = 12
+    layout = RegionLayout(
+        rng.integers(0, R, 300).astype(np.int32),
+        [f"region-{i:02d}" for i in range(R)],
+        np.arange(300, dtype=np.int32),
+    )
+    for trial in range(4):
+        S = 64
+        W = rng.integers(0, 50, (S, R)).astype(np.int64) * 1000  # heavy ties
+        V = rng.integers(0, 40, (S, R)).astype(np.int32)
+        cfg = SpreadConfig(rmin=int(rng.integers(1, 3)),
+                           rmax=int(rng.integers(0, 4)),
+                           cmin=int(rng.integers(0, 20)), cmax=0,
+                           duplicated=bool(trial % 2))
+        host = select_regions_batch(W, V, cfg, layout, device=False)
+        dev = select_regions_batch(W, V, cfg, layout, device=True)
+        np.testing.assert_array_equal(host.chosen, dev.chosen)
+        assert host.errors == dev.errors
+        assert sorted(host.fallback) == sorted(dev.fallback)
