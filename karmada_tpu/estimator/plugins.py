@@ -174,13 +174,39 @@ def _matches_scope(sel: ScopedSelectorRequirement, priority_class: str) -> bool:
     return False
 
 
+def _is_extended_resource(name: str) -> bool:
+    """corev1helper.IsExtendedResourceName: a domain-qualified name outside
+    the kubernetes.io native namespace (nvidia.com/gpu etc.). Prefixed-native
+    domains like node.kubernetes.io/* CONTAIN kubernetes.io and are not
+    extended (IsPrefixedNativeResource uses a contains check)."""
+    return (
+        "/" in name
+        and "kubernetes.io/" not in name
+        and not name.startswith(_REQUESTS_PREFIX)
+        and not name.startswith(_LIMITS_PREFIX)
+    )
+
+
+def _matches_compute(rname: str) -> bool:
+    """matchingResources (resourcequota.go:306-335): the fixed compute set
+    plus extended resources, bare or requests./limits.-prefixed."""
+    if rname in _COMPUTE_RESOURCES:
+        return True
+    base = rname
+    for pref in (_REQUESTS_PREFIX, _LIMITS_PREFIX):
+        if rname.startswith(pref):
+            base = rname[len(pref):]
+            break
+    return _is_extended_resource(base)
+
+
 def _free_resources(rq: ResourceQuota) -> dict[str, float]:
     """calculateFreeResources (resourcequota.go:185-215): hard − used over
-    matching compute rows; limits.* skipped; requests.* merged with the
-    bare name (requests.cpu == cpu)."""
+    matching compute/extended rows; limits.* skipped; requests.* merged with
+    the bare name (requests.cpu == cpu)."""
     free: dict[str, float] = {}
     for rname in rq.hard:
-        if rname not in _COMPUTE_RESOURCES:
+        if not _matches_compute(rname):
             continue
         if rname.startswith(_LIMITS_PREFIX):
             continue

@@ -352,3 +352,131 @@ class TestEstimatorPluginFramework:
         # exhausted quota: Unschedulable short-circuits to 0
         rq.used = {"requests.cpu": 3.0}
         assert est.max_available_replicas(req) == 0
+
+
+class TestResourceQuotaReferenceFixtures:
+    """Exact expectations ported from the reference's plugin test
+    (resourcequota_test.go:40-420): foo quota (bare compute + gpu rows,
+    In-selector on foo-priority) and bar quota (requests./limits. rows)."""
+
+    MiB = 1024.0 * 1024.0
+
+    def _gates(self):
+        from karmada_tpu.features import RESOURCE_QUOTA_ESTIMATE, FeatureGates
+
+        g = FeatureGates()
+        g.set(RESOURCE_QUOTA_ESTIMATE, True)
+        return g
+
+    def _foo_quota(self):
+        from karmada_tpu.estimator import plugins as P
+
+        return P.ResourceQuota(
+            name="foo", namespace="foo",
+            scope_selector=[P.ScopedSelectorRequirement(
+                scope_name=P.SCOPE_PRIORITY_CLASS, operator=P.SCOPE_OP_IN,
+                values=["foo-priority"],
+            )],
+            hard={"cpu": 1.0, "memory": 4 * self.MiB, "nvidia.com/gpu": 5.0},
+            used={"cpu": 0.2, "memory": 1 * self.MiB, "nvidia.com/gpu": 2.0},
+        )
+
+    def _bar_quota(self):
+        from karmada_tpu.estimator import plugins as P
+
+        return P.ResourceQuota(
+            name="bar", namespace="bar",
+            scope_selector=[P.ScopedSelectorRequirement(
+                scope_name=P.SCOPE_PRIORITY_CLASS, operator=P.SCOPE_OP_IN,
+                values=["bar-priority"],
+            )],
+            hard={
+                "limits.cpu": 1.0, "limits.memory": 4 * self.MiB,
+                "limits.nvidia.com/gpu": 5.0,
+                "requests.cpu": 1.0, "requests.memory": 4 * self.MiB,
+                "requests.nvidia.com/gpu": 5.0,
+            },
+            used={
+                "limits.cpu": 0.5, "limits.memory": 3 * self.MiB,
+                "limits.nvidia.com/gpu": 4.0,
+                "requests.cpu": 0.2, "requests.memory": 1 * self.MiB,
+                "requests.nvidia.com/gpu": 2.0,
+            },
+        )
+
+    def _estimate(self, quota, request, namespace, priority):
+        from karmada_tpu.api.work import ReplicaRequirements
+        from karmada_tpu.estimator import plugins as P
+
+        pl = P.ResourceQuotaEstimatorPlugin(
+            lambda ns: [quota] if ns == quota.namespace else [],
+            gates=self._gates(),
+        )
+        return pl.estimate(ReplicaRequirements(
+            resource_request=request, namespace=namespace,
+            priority_class_name=priority,
+        ))
+
+    def test_cpu_only(self):  # free 800m / 200m -> 4
+        r, ret = self._estimate(self._foo_quota(), {"cpu": 0.2}, "foo", "foo-priority")
+        assert ret.is_success and r == 4
+
+    def test_memory_only(self):  # free 3Mi / 2Mi -> 1
+        r, ret = self._estimate(
+            self._foo_quota(), {"memory": 2 * self.MiB}, "foo", "foo-priority")
+        assert ret.is_success and r == 1
+
+    def test_extended_resource_only(self):  # gpu free 3 / 1 -> 3
+        r, ret = self._estimate(
+            self._foo_quota(), {"nvidia.com/gpu": 1.0}, "foo", "foo-priority")
+        assert ret.is_success and r == 3
+
+    def test_unsupported_ephemeral_storage_is_noop(self):
+        from karmada_tpu.estimator import plugins as P
+
+        r, ret = self._estimate(
+            self._foo_quota(), {"ephemeral-storage": self.MiB}, "foo", "foo-priority")
+        assert ret.is_noop and r == P.MAX_INT32
+
+    def test_all_resources_unschedulable(self):  # cpu 1 core > free 800m -> 0
+        r, ret = self._estimate(
+            self._foo_quota(),
+            {"cpu": 1.0, "memory": 2 * self.MiB, "nvidia.com/gpu": 1.0,
+             "ephemeral-storage": self.MiB},
+            "foo", "foo-priority")
+        assert ret.is_unschedulable and r == 0
+
+    def test_all_resources_min(self):  # min(4, 1, 3) -> 1
+        r, ret = self._estimate(
+            self._foo_quota(),
+            {"cpu": 0.2, "memory": 2 * self.MiB, "nvidia.com/gpu": 1.0,
+             "ephemeral-storage": self.MiB},
+            "foo", "foo-priority")
+        assert ret.is_success and r == 1
+
+    def test_requests_rows_bind_limits_skipped(self):
+        # bar: requests.cpu free 800m -> 4; requests.memory free 3Mi/2Mi -> 1;
+        # requests.gpu free 3 -> 3; limits rows (free cpu 500m -> 2) SKIPPED
+        r, ret = self._estimate(
+            self._bar_quota(),
+            {"cpu": 0.2, "memory": 2 * self.MiB, "nvidia.com/gpu": 1.0,
+             "ephemeral-storage": self.MiB},
+            "bar", "bar-priority")
+        assert ret.is_success and r == 1
+
+    def test_wrong_priority_class_noop(self):
+        from karmada_tpu.estimator import plugins as P
+
+        r, ret = self._estimate(self._foo_quota(), {"cpu": 0.2}, "foo", "other")
+        assert ret.is_noop and r == P.MAX_INT32
+
+    def test_non_priority_scopes_never_match(self):
+        from karmada_tpu.estimator import plugins as P
+
+        q = self._foo_quota()
+        q.scope_selector = []
+        q.scopes = [P.SCOPE_TERMINATING, P.SCOPE_NOT_TERMINATING,
+                    P.SCOPE_BEST_EFFORT, P.SCOPE_NOT_BEST_EFFORT,
+                    P.SCOPE_CROSS_NS_AFFINITY]
+        r, ret = self._estimate(q, {"cpu": 0.2}, "foo", "foo-priority")
+        assert ret.is_noop and r == P.MAX_INT32
