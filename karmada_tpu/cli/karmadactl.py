@@ -212,11 +212,7 @@ def cmd_deinit(mgmt: Management, name: str = "karmada") -> str:
 def _remove_cluster(cp: ControlPlane, name: str) -> None:
     if cp.store.try_get("Cluster", name) is None:
         raise CLIError(f"cluster {name} not found")
-    cp.store.delete("Cluster", name)
-    cp.members.pop(name, None)
-    # drop the flap-suppression entry with the membership
-    # (cluster_condition_cache.go delete-on-removal)
-    cp.condition_cache.delete(name)
+    cp.unjoin_member(name)
     cp.settle()
 
 

@@ -1,0 +1,125 @@
+"""Cluster-API auto-discovery + the CoreDNS service-name-resolution detector.
+
+Parity surface:
+- `ClusterAPIDetector` (ref pkg/clusterdiscovery/clusterapi/clusterapi.go):
+  watches Cluster-API `Cluster` objects; a cluster whose status.phase hits
+  Provisioned is auto-JOINED as a member, and deletion auto-unjoins it. The
+  reference resolves the kubeconfig from the cluster-api secret; our member
+  bootstrap config rides the object's spec (in-memory members).
+- `CorednsDetector` (ref pkg/servicenameresolutiondetector/coredns/
+  detector.go:49-170): a member-side probe resolving a service domain name,
+  reporting the ServiceDomainNameResolutionReady condition on the member's
+  CLUSTER object through the same threshold-adjusted condition cache the
+  Ready flap suppression uses.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+from .api.meta import Condition, set_condition
+from .api.unstructured import Unstructured
+from .controllers.condition_cache import ClusterConditionCache
+from .members.member import MemberConfig
+from .runtime.controller import Controller, DONE, Runtime
+
+CLUSTER_API_GROUP_VERSION = "cluster.x-k8s.io/v1beta1"
+CLUSTER_API_KIND = "Cluster"
+PHASE_PROVISIONED = "Provisioned"
+
+SERVICE_DNS_CONDITION = "ServiceDomainNameResolutionReady"
+REASON_DNS_READY = "ServiceDomainNameResolutionReady"
+REASON_DNS_FAILED = "ServiceDomainNameResolutionFailed"
+
+
+class ClusterAPIDetector:
+    """Auto-join/unjoin members from Cluster-API Cluster objects."""
+
+    KIND = f"{CLUSTER_API_GROUP_VERSION}/{CLUSTER_API_KIND}"
+
+    def __init__(self, control_plane, runtime: Optional[Runtime] = None):
+        self.cp = control_plane
+        self.runtime = runtime or control_plane.runtime
+        self.joined: set[str] = set()
+        self.controller = self.runtime.register(
+            Controller(name="cluster-api-detector", reconcile=self._reconcile)
+        )
+        self.cp.store.watch(self.KIND, self._on_object)
+
+    def _on_object(self, event: str, obj: Unstructured) -> None:
+        self.controller.enqueue(obj.metadata.key())
+
+    def _reconcile(self, key: str) -> str:
+        ns, _, name = key.partition("/")
+        if not name:
+            ns, name = "", ns
+        obj = self.cp.store.try_get(self.KIND, name, ns)
+        if obj is None or obj.metadata.deletion_timestamp is not None:
+            # unJoinClusterAPICluster (clusterapi.go:120-133)
+            if name in self.joined:
+                self.cp.unjoin_member(name)
+            self.joined.discard(name)
+            return DONE
+        phase = obj.get("status", "phase", default="")
+        if phase != PHASE_PROVISIONED:
+            return DONE  # join only once provisioned (clusterapi.go:106-111)
+        if name in self.joined or self.cp.store.try_get("Cluster", name):
+            return DONE
+        spec = obj.get("spec", default={}) or {}
+        self.cp.join_member(MemberConfig(
+            name=name,
+            provider=spec.get("provider", "cluster-api"),
+            region=spec.get("region", ""),
+            zone=spec.get("zone", ""),
+            allocatable=dict(spec.get("allocatable", {"cpu": 100.0})),
+            sync_mode=spec.get("syncMode", "Push"),
+        ))
+        self.joined.add(name)
+        return DONE
+
+
+class CorednsDetector:
+    """Member-side DNS health probe → threshold-adjusted cluster condition.
+
+    The reference resolves a domain against coredns every period and writes
+    the node/cluster condition through SuccessThreshold/FailureThreshold
+    debouncing (detector.go:119-170); our members expose `dns_healthy` as the
+    probe outcome seam."""
+
+    def __init__(self, control_plane, success_threshold: float = 30.0,
+                 failure_threshold: float = 30.0):
+        self.cp = control_plane
+        self.cache = ClusterConditionCache(
+            control_plane.runtime.clock,
+            failure_threshold=failure_threshold,
+            success_threshold=success_threshold,
+        )
+
+    def probe(self, member) -> bool:
+        return bool(getattr(member, "dns_healthy", True))
+
+    def tick(self) -> None:
+        for name, member in self.cp.members.items():
+            cluster = self.cp.store.try_get("Cluster", name)
+            if cluster is None:
+                continue
+            observed = "True" if self.probe(member) else "False"
+            current = None
+            for c in cluster.status.conditions:
+                if c.type == SERVICE_DNS_CONDITION:
+                    current = c.status
+                    break
+            effective = self.cache.threshold_adjusted_ready(
+                name, current, observed
+            )
+            if current == effective:
+                continue
+            set_condition(
+                cluster.status.conditions,
+                Condition(
+                    type=SERVICE_DNS_CONDITION,
+                    status=effective,
+                    reason=REASON_DNS_READY if effective == "True"
+                    else REASON_DNS_FAILED,
+                ),
+            )
+            self.cp.store.update(cluster)

@@ -73,6 +73,7 @@ from .auth import (
     CertificateAuthority,
     IssuedCertificate,
 )
+from .clusterdiscovery import ClusterAPIDetector, CorednsDetector
 from .controllers.certificate import CertRotationController
 from .controllers.condition_cache import ClusterConditionCache
 from .metricsadapter import MetricsAdapter
@@ -173,6 +174,9 @@ class ControlPlane:
             failure_threshold=cluster_failure_threshold,
             success_threshold=cluster_success_threshold,
         )
+        # auto-discovery of cluster-api members + member DNS health probe
+        self.cluster_api_detector = ClusterAPIDetector(self)
+        self.coredns_detector = CorednsDetector(self)
         self.lease_detector = LeaseFailureDetector(
             self.store,
             self.runtime,
@@ -325,6 +329,22 @@ class ControlPlane:
             agent.heartbeat()
         return member
 
+    def unjoin_member(self, name: str) -> None:
+        """Tear a member down completely: the agent (pull mode) stops
+        heartbeating, its Lease leaves the store (else the lease detector
+        would keep flagging a cluster that no longer exists), and the
+        flap-suppression entry is dropped with the membership."""
+        from .agent.agent import work_namespace_for_cluster
+
+        self.agents.pop(name, None)
+        lease_ns = work_namespace_for_cluster(name)
+        if self.store.try_get("Lease", name, lease_ns) is not None:
+            self.store.delete("Lease", name, lease_ns)
+        if self.store.try_get("Cluster", name) is not None:
+            self.store.delete("Cluster", name)
+        self.members.pop(name, None)
+        self.condition_cache.delete(name)
+
     def sign_agent_cert(self, cluster: str, ttl_seconds: float = 365 * 86400.0) -> IssuedCertificate:
         """Sign the karmada-agent client identity for a pull cluster
         (register.go's CSR: CN system:node:<name>, O system:nodes)."""
@@ -371,6 +391,7 @@ class ControlPlane:
             self.runtime.clock.advance(seconds)
         self.cluster_taint_controller.tick()
         self.cert_rotation_controller.tick()
+        self.coredns_detector.tick()
         if self.taint_manager is not None:
             self.taint_manager.tick()
         self.application_failover_controller.tick()

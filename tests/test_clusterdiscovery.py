@@ -1,0 +1,101 @@
+"""Cluster-API auto-discovery + CoreDNS resolution detector
+(ref pkg/clusterdiscovery/clusterapi, pkg/servicenameresolutiondetector)."""
+from karmada_tpu.api.unstructured import Unstructured
+from karmada_tpu.clusterdiscovery import SERVICE_DNS_CONDITION
+from karmada_tpu.controlplane import ControlPlane
+from karmada_tpu.members.member import MemberConfig
+from karmada_tpu.runtime.controller import Clock
+from karmada_tpu.testing.fixtures import (
+    duplicated_placement, new_deployment, new_policy, selector_for,
+)
+
+
+def capi_cluster(name, phase="Provisioned"):
+    return Unstructured({
+        "apiVersion": "cluster.x-k8s.io/v1beta1",
+        "kind": "Cluster",
+        "metadata": {"name": name, "namespace": ""},
+        "spec": {"allocatable": {"cpu": 50.0, "memory": 200.0, "pods": 500.0}},
+        "status": {"phase": phase},
+    })
+
+
+class TestClusterAPIDiscovery:
+    def test_provisioned_cluster_auto_joins(self):
+        cp = ControlPlane(clock=Clock(fixed=0.0))
+        cp.store.create(capi_cluster("capi-1"))
+        cp.settle()
+        assert cp.store.try_get("Cluster", "capi-1") is not None
+        assert "capi-1" in cp.members
+        # it schedules like any member
+        dep = new_deployment("default", "web", replicas=2, cpu=0.1)
+        cp.store.create(dep)
+        cp.store.create(new_policy("default", "pp", [selector_for(dep)],
+                                   duplicated_placement([])))
+        cp.settle()
+        rb = cp.store.get("ResourceBinding", "web-deployment", "default")
+        assert [t.name for t in rb.spec.clusters] == ["capi-1"]
+
+    def test_pending_cluster_waits_for_provisioned(self):
+        cp = ControlPlane(clock=Clock(fixed=0.0))
+        obj = capi_cluster("capi-2", phase="Pending")
+        cp.store.create(obj)
+        cp.settle()
+        assert cp.store.try_get("Cluster", "capi-2") is None
+        fresh = cp.store.get("cluster.x-k8s.io/v1beta1/Cluster", "capi-2")
+        fresh.set("status", "phase", "Provisioned")
+        cp.store.update(fresh)
+        cp.settle()
+        assert cp.store.try_get("Cluster", "capi-2") is not None
+
+    def test_deletion_unjoins(self):
+        cp = ControlPlane(clock=Clock(fixed=0.0))
+        cp.store.create(capi_cluster("capi-3"))
+        cp.settle()
+        assert "capi-3" in cp.members
+        cp.store.delete("cluster.x-k8s.io/v1beta1/Cluster", "capi-3")
+        cp.settle()
+        assert cp.store.try_get("Cluster", "capi-3") is None
+        assert "capi-3" not in cp.members
+
+
+class TestCorednsDetector:
+    def test_dns_condition_with_flap_suppression(self):
+        cp = ControlPlane(clock=Clock(fixed=0.0))
+        cp.join_member(MemberConfig(name="m1", allocatable={"cpu": 10.0}))
+        cp.tick()
+        cond = {c.type: c.status for c in
+                cp.store.get("Cluster", "m1").status.conditions}
+        assert cond[SERVICE_DNS_CONDITION] == "True"
+
+        # flap inside the threshold: condition retained
+        cp.members["m1"].dns_healthy = False
+        cp.tick(seconds=5)
+        cond = {c.type: c.status for c in
+                cp.store.get("Cluster", "m1").status.conditions}
+        assert cond[SERVICE_DNS_CONDITION] == "True"
+
+        # sustained failure past the threshold flips it
+        cp.tick(seconds=31)
+        cond = {c.type: c.status for c in
+                cp.store.get("Cluster", "m1").status.conditions}
+        assert cond[SERVICE_DNS_CONDITION] == "False"
+
+    def test_pull_mode_deletion_cleans_agent_and_lease(self):
+        """Orphaned agents/leases after auto-unjoin crashed the next tick
+        (lease detector firing for a Cluster that no longer exists)."""
+        cp = ControlPlane(clock=Clock(fixed=0.0))
+        obj = capi_cluster("capi-pull")
+        obj.set("spec", "syncMode", "Pull")
+        cp.store.create(obj)
+        cp.settle()
+        assert "capi-pull" in cp.agents
+        lease_ns = "karmada-es-capi-pull"
+        assert cp.store.try_get("Lease", "capi-pull", lease_ns) is not None
+
+        cp.members["capi-pull"].set_healthy(False)  # outage precedes removal
+        cp.store.delete("cluster.x-k8s.io/v1beta1/Cluster", "capi-pull")
+        cp.settle()
+        assert "capi-pull" not in cp.agents
+        assert cp.store.try_get("Lease", "capi-pull", lease_ns) is None
+        cp.tick(seconds=100)  # must not raise on the vanished cluster
