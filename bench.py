@@ -377,7 +377,9 @@ def main() -> None:
           + (["--platform", platform] if platform else [])
         budget = deadline - time.perf_counter()
         if platform is None:
-            budget = min(budget, 0.7 * args.run_timeout)
+            # TPU attempts (all of them together) stay under tpu_deadline so
+            # a hung tunnel always leaves the CPU fallback room
+            budget = min(budget, tpu_deadline - time.perf_counter())
         if budget <= 1.0:
             return None
         try:

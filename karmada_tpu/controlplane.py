@@ -344,6 +344,7 @@ class ControlPlane:
             self.store.delete("Cluster", name)
         self.members.pop(name, None)
         self.condition_cache.delete(name)
+        self.coredns_detector.cache.delete(name)
 
     def sign_agent_cert(self, cluster: str, ttl_seconds: float = 365 * 86400.0) -> IssuedCertificate:
         """Sign the karmada-agent client identity for a pull cluster

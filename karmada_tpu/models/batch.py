@@ -95,18 +95,18 @@ class BindingBatch:
     uids: list[str]
     # core tensors
     replicas: np.ndarray  # i32[B]
-    request: np.ndarray  # i64[B,R] integer units (cpu milli)
     unknown_request: np.ndarray  # bool[B] request names outside the resource
     #   vocabulary ⇒ estimators must report 0 (missing allocatable key → 0,
     #   general.go:166-169)
     gvk: np.ndarray  # i32[B]
     strategy: np.ndarray  # i32[B]
     fresh: np.ndarray  # bool[B]
-    # tolerations
-    tol_key: np.ndarray  # i32[B,K]
-    tol_value: np.ndarray
-    tol_effect: np.ndarray
-    tol_op: np.ndarray
+    # tolerations, factored like the policy tables: distinct toleration ROWS
+    # (key/value/effect/op stacked) in one [T,4,K] table + a per-row index —
+    # the dense [B,K]x4 form was >1 MB of host→device upload per flagship
+    # round on a ~40 MB/s tunnel link
+    tol_tables: np.ndarray  # i32[T,4,K] (row 0 = no tolerations)
+    tol_idx: np.ndarray  # i32[B]
     # factored policy tables (deduped across the batch)
     aff_masks: np.ndarray  # bool[P,C] unique affinity masks
     aff_idx: np.ndarray  # i32[B] row → mask row
@@ -120,8 +120,8 @@ class BindingBatch:
     seeds: np.ndarray  # u64[B]
     n_clusters: int = 0
     # deduped request vectors: the [.,C,R] estimator divisions run once per
-    # DISTINCT request (policies are few); rows gather via req_idx. None on
-    # hand-built batches — consumers fall back to the dense `request`.
+    # DISTINCT request (policies are few); rows gather via req_idx. The
+    # dense [B,R] form is the `request` property.
     req_unique: "np.ndarray | None" = None  # i64[U,R]
     req_idx: "np.ndarray | None" = None  # i32[B]
 
@@ -130,6 +130,26 @@ class BindingBatch:
         return len(self.keys)
 
     # -- dense views (mesh path, oracle parity tests) ---------------------
+
+    @property
+    def request(self) -> np.ndarray:  # i64[B,R]
+        return self.req_unique[self.req_idx]
+
+    @property
+    def tol_key(self) -> np.ndarray:  # i32[B,K]
+        return self.tol_tables[self.tol_idx, 0]
+
+    @property
+    def tol_value(self) -> np.ndarray:  # i32[B,K]
+        return self.tol_tables[self.tol_idx, 1]
+
+    @property
+    def tol_effect(self) -> np.ndarray:  # i32[B,K]
+        return self.tol_tables[self.tol_idx, 2]
+
+    @property
+    def tol_op(self) -> np.ndarray:  # i32[B,K]
+        return self.tol_tables[self.tol_idx, 3]
 
     @property
     def affinity_ok(self) -> np.ndarray:  # bool[B,C]
@@ -178,6 +198,26 @@ class BatchEncoder:
         self.affinity_cache = AffinityMaskCache(self.clusters)
         self._weight_cache: dict[str, np.ndarray] = {}
         self._cluster_index = {c.name: i for i, c in enumerate(self.clusters)}
+        self._res_index = {r: i for i, r in enumerate(encoder.resources)}
+        # Persistent interners + per-binding row cache. The reference never
+        # re-parses an object per schedule attempt — the informer cache hands
+        # the scheduler pre-decoded structs; this cache is that decode step.
+        # A row is reused only while (generation, term, replicas) match AND
+        # the placement/requirements/resource objects are the SAME objects
+        # (`is` — the cache holds strong refs, so ids cannot recycle);
+        # store-managed updates replace objects and bump generation, which
+        # invalidates naturally. prev/eviction entries and `fresh` are
+        # re-read every round (status-driven, cheap).
+        self._row_cache: dict[str, tuple] = {}
+        self._tol_width = max_tolerations
+        self._tol_rows: list[np.ndarray] = [
+            np.zeros((4, self._tol_width), np.int32)
+        ]
+        self._tol_by_key: dict[bytes, int] = {}
+        self._tol_stack: Optional[np.ndarray] = None
+        self._req_rows: list[np.ndarray] = []
+        self._req_by_key: dict[bytes, int] = {}
+        self._req_stack: Optional[np.ndarray] = None
 
     def _static_weights(self, placement: Optional[Placement]) -> np.ndarray:
         """weight[c] = max over matching rules (division_algorithm.go:40-55);
@@ -213,6 +253,133 @@ class BatchEncoder:
             return p.cluster_affinities[i].affinity
         return p.cluster_affinity
 
+    # growth caps: the interners/row cache trade memory for encode speed;
+    # past these bounds (a pathological churn of distinct policy values)
+    # everything is dropped and rebuilt from the live rows of the next
+    # encode — a one-round re-encode, not a leak
+    MAX_REQ_ROWS = 1024
+    MAX_TOL_ROWS = 512
+
+    def _reset_interners(self) -> None:
+        self._row_cache.clear()  # cached rows hold req/tol ids → must drop
+        self._req_rows = []
+        self._req_by_key = {}
+        self._req_stack = None
+        self._tol_width = self.max_tolerations
+        self._tol_rows = [np.zeros((4, self._tol_width), np.int32)]
+        self._tol_by_key = {}
+        self._tol_stack = None
+
+    def _intern_req(self, req: np.ndarray) -> int:
+        key = req.tobytes()
+        rid = self._req_by_key.get(key)
+        if rid is None:
+            rid = len(self._req_rows)
+            self._req_rows.append(req)
+            self._req_by_key[key] = rid
+            self._req_stack = None
+        return rid
+
+    def _req_table(self) -> np.ndarray:
+        """Request table padded to a pow2 bucket (jit cache bound)."""
+        if self._req_stack is None:
+            U = max(len(self._req_rows), 1)
+            Up = 1
+            while Up < U:
+                Up *= 2
+            tab = np.zeros((Up, len(self.encoder.resources)), np.int64)
+            if self._req_rows:
+                tab[: len(self._req_rows)] = np.stack(self._req_rows)
+            self._req_stack = tab
+        return self._req_stack
+
+    def _intern_tol(self, tols) -> int:
+        if not tols:
+            return 0
+        if len(tols) > self._tol_width:
+            # widen the whole table (capping would wrongly reject bindings
+            # whose matching toleration is dropped); ids stay stable
+            w = self._tol_width
+            while w < len(tols):
+                w *= 2
+            self._tol_rows = [
+                np.pad(r, [(0, 0), (0, w - self._tol_width)])
+                for r in self._tol_rows
+            ]
+            self._tol_width = w
+            self._tol_by_key = {
+                r.tobytes(): i for i, r in enumerate(self._tol_rows)
+            }
+            self._tol_stack = None
+        trow = np.zeros((4, self._tol_width), np.int32)
+        for k, tol in enumerate(tols):
+            trow[0, k] = self.encoder.strings.id(tol.key)
+            trow[1, k] = self.encoder.strings.id(tol.value)
+            trow[2, k] = EFFECT_CODES.get(tol.effect, 0)
+            trow[3, k] = (
+                TOL_OP_EXISTS if tol.operator == "Exists" else TOL_OP_EQUAL
+            )
+        key = trow.tobytes()
+        tid = self._tol_by_key.get(key)
+        if tid is None:
+            tid = len(self._tol_rows)
+            self._tol_rows.append(trow)
+            self._tol_by_key[key] = tid
+            self._tol_stack = None
+        return tid
+
+    def _tol_table(self) -> np.ndarray:
+        """Toleration table with T padded to a pow2 bucket — tol_tables is a
+        traced kernel arg, so an unpadded T would recompile the schedule
+        kernel every time one new distinct toleration set appears."""
+        if self._tol_stack is None:
+            T = len(self._tol_rows)
+            Tp = 1
+            while Tp < T:
+                Tp *= 2
+            tab = np.zeros((Tp, 4, self._tol_width), np.int32)
+            tab[:T] = np.stack(self._tol_rows)
+            self._tol_stack = tab
+        return self._tol_stack
+
+    _DEFAULT_PLACEMENT = Placement()
+
+    def _encode_row(self, rb: ResourceBinding, term: int) -> tuple:
+        """Everything about a row that does not change while its
+        (generation, placement, requirements, resource) stay the same."""
+        meta = rb.metadata
+        spec = rb.spec
+        uid = meta.uid or meta.key()
+        req = np.zeros(len(self.encoder.resources), np.int64)
+        unknown = False
+        if spec.replica_requirements is not None:
+            for rname, val in spec.replica_requirements.resource_request.items():
+                r = self._res_index.get(rname)
+                if r is None:
+                    # outside the vocabulary ⇒ estimators must report 0
+                    # (missing allocatable key → 0, general.go:166-169)
+                    if to_int_units(rname, val) > 0:
+                        unknown = True
+                else:
+                    req[r] = to_int_units(rname, val)
+        placement = spec.placement or self._DEFAULT_PLACEMENT
+        mask = self.affinity_cache.mask(self.active_affinity(rb, term))
+        w = self._static_weights(placement)
+        if not w.any():
+            w = None  # row 0 of the weight table
+        return (
+            meta.key(),
+            uid,
+            uid_seed(uid),
+            self.encoder.gvk_id(spec.resource.api_version, spec.resource.kind),
+            strategy_code(spec.placement, spec.replicas),
+            unknown,
+            self._intern_req(req),
+            self._intern_tol(placement.cluster_tolerations),
+            mask,
+            w,
+        )
+
     def encode(
         self,
         bindings: Sequence[ResourceBinding],
@@ -220,32 +387,16 @@ class BatchEncoder:
     ) -> BindingBatch:
         B = len(bindings)
         C = len(self.clusters)
-        R = len(self.encoder.resources)
-        # Toleration axis sized to the batch maximum (bucketed) — capping
-        # would wrongly reject bindings whose matching toleration is dropped.
-        widest = max(
-            (
-                len(b.spec.placement.cluster_tolerations)
-                for b in bindings
-                if b.spec.placement is not None
-            ),
-            default=0,
-        )
-        K = self.max_tolerations
-        while K < widest:
-            K *= 2
 
         keys, uids = [], []
         replicas = np.zeros(B, np.int32)
-        request = np.zeros((B, R), np.int64)
         unknown_request = np.zeros(B, bool)
         gvk = np.zeros(B, np.int32)
         strategy = np.zeros(B, np.int32)
         fresh = np.zeros(B, bool)
-        tol_key = np.zeros((B, K), np.int32)
-        tol_value = np.zeros((B, K), np.int32)
-        tol_effect = np.zeros((B, K), np.int32)
-        tol_op = np.zeros((B, K), np.int32)
+        tol_idx = np.zeros(B, np.int32)
+        req_idx_arr = np.zeros(B, np.int32)
+        seeds = np.zeros(B, np.uint64)
 
         # factored tables: dedup masks/weights per policy signature (few
         # distinct policies, many bindings); indices per row
@@ -256,80 +407,84 @@ class BatchEncoder:
         weight_by_id: dict[int, int] = {}
         weight_idx = np.zeros(B, np.int32)
 
-        prev_lists: list[list[tuple[int, int]]] = []
-        evict_lists: list[list[int]] = []
-        seeds = np.zeros(B, np.uint64)
+        prev_lists: list = []
+        evict_lists: list = []
 
-        # Per-PLACEMENT cache: policies are few, bindings are many, and the
-        # toleration/affinity/static-weight encodings depend only on the
-        # (shared) placement object — not the row. Keyed by id() and scoped
-        # to THIS call (bindings hold the references, so ids can't recycle
-        # mid-encode).
-        known = set(self.encoder.resources)
-        _default_placement = Placement()
-        pl_cache: dict[tuple[int, int], tuple] = {}
+        # bound the caches: entries for deleted bindings (and pathological
+        # churn of distinct request/toleration values) must not accumulate
+        # forever — reset costs one round of re-encode
+        if (
+            len(self._req_rows) > self.MAX_REQ_ROWS
+            or len(self._tol_rows) > self.MAX_TOL_ROWS
+        ):
+            self._reset_interners()
+        elif len(self._row_cache) > max(4 * B, 16384):
+            self._row_cache.clear()
 
+        row_cache = self._row_cache
         for b, rb in enumerate(bindings):
-            keys.append(rb.metadata.key())
-            uids.append(rb.metadata.uid or rb.metadata.key())
+            meta = rb.metadata
             spec = rb.spec
-            replicas[b] = spec.replicas
-            gvk[b] = self.encoder.gvk_id(spec.resource.api_version, spec.resource.kind)
-            strategy[b] = strategy_code(spec.placement, spec.replicas)
-            fresh[b] = _reschedule_required(spec, rb.status)
-            seeds[b] = uid_seed(uids[-1])
-            if spec.replica_requirements is not None:
-                for rname, val in spec.replica_requirements.resource_request.items():
-                    if rname not in known and to_int_units(rname, val) > 0:
-                        unknown_request[b] = True
-                for r, rname in enumerate(self.encoder.resources):
-                    request[b, r] = to_int_units(
-                        rname, spec.replica_requirements.resource_request.get(rname, 0.0)
-                    )
-
-            placement = spec.placement or _default_placement
             term = -1 if term_indices is None else term_indices[b]
-            pc = pl_cache.get((id(placement), term))
-            if pc is None:
-                trow = np.zeros((4, K), np.int32)
-                for k, tol in enumerate(placement.cluster_tolerations):
-                    trow[0, k] = self.encoder.strings.id(tol.key)
-                    trow[1, k] = self.encoder.strings.id(tol.value)
-                    trow[2, k] = EFFECT_CODES.get(tol.effect, 0)
-                    trow[3, k] = (
-                        TOL_OP_EXISTS if tol.operator == "Exists" else TOL_OP_EQUAL
+            ent = row_cache.get(meta.uid) if meta.uid else None
+            if (
+                ent is not None
+                and ent[0] == meta.generation
+                and ent[1] == term
+                and ent[2] == spec.replicas
+                # strong refs held below ⇒ `is` cannot false-positive on a
+                # recycled id; store updates swap objects + bump generation
+                and ent[3] is spec.placement
+                and ent[4] is spec.replica_requirements
+                and ent[5] is spec.resource
+            ):
+                data = ent[6]
+            else:
+                data = self._encode_row(rb, term)
+                if meta.uid:
+                    row_cache[meta.uid] = (
+                        meta.generation, term, spec.replicas,
+                        spec.placement, spec.replica_requirements,
+                        spec.resource, data,
                     )
-                mask = self.affinity_cache.mask(self.active_affinity(rb, term))
-                row = aff_by_id.get(id(mask))
-                if row is None:
-                    row = len(aff_rows)
-                    aff_rows.append(mask)
-                    aff_by_id[id(mask)] = row
-                w = self._static_weights(placement)
-                wrow = 0
-                if w.any():
-                    wrow = weight_by_id.get(id(w))
-                    if wrow is None:
-                        wrow = len(weight_rows)
-                        weight_rows.append(w)
-                        weight_by_id[id(w)] = wrow
-                pc = (trow, row, wrow, bool(placement.cluster_tolerations))
-                pl_cache[(id(placement), term)] = pc
-            trow, row, wrow, has_tols = pc
-            if has_tols:
-                tol_key[b] = trow[0]
-                tol_value[b] = trow[1]
-                tol_effect[b] = trow[2]
-                tol_op[b] = trow[3]
+            key, uid, seed, g, strat, unknown, rid, tid, mask, w = data
+            keys.append(key)
+            uids.append(uid)
+            seeds[b] = seed
+            gvk[b] = g
+            strategy[b] = strat
+            unknown_request[b] = unknown
+            req_idx_arr[b] = rid
+            tol_idx[b] = tid
+            replicas[b] = spec.replicas
+            fresh[b] = _reschedule_required(spec, rb.status)
+
+            row = aff_by_id.get(id(mask))
+            if row is None:
+                row = len(aff_rows)
+                aff_rows.append(mask)
+                aff_by_id[id(mask)] = row
             aff_idx[b] = row
+            if w is None:
+                wrow = 0
+            else:
+                wrow = weight_by_id.get(id(w))
+                if wrow is None:
+                    wrow = len(weight_rows)
+                    weight_rows.append(w)
+                    weight_by_id[id(w)] = wrow
             weight_idx[b] = wrow
 
+            # previous placement / eviction entries are status-driven per
+            # round — never cached
             prev_lists.append(
                 [
                     (i, tc.replicas)
                     for tc in spec.clusters
                     if (i := self._cluster_index.get(tc.name)) is not None
                 ]
+                if spec.clusters
+                else ()
             )
             evict_lists.append(
                 [
@@ -337,17 +492,9 @@ class BatchEncoder:
                     for task in spec.graceful_eviction_tasks
                     if (i := self._cluster_index.get(task.from_cluster)) is not None
                 ]
+                if spec.graceful_eviction_tasks
+                else ()
             )
-
-        # deduped request vectors, U padded to a pow2 bucket (jit cache)
-        req_unique, req_inverse = np.unique(request, axis=0, return_inverse=True)
-        U = len(req_unique)
-        Up = 1
-        while Up < U:
-            Up *= 2
-        if Up > U:
-            req_unique = np.pad(req_unique, [(0, Up - U), (0, 0)])
-        req_idx_arr = req_inverse.astype(np.int32)
 
         # sparse axes bucketed to powers of two (jit cache bound)
         def bucket(n: int, lo: int = 2) -> int:
@@ -356,8 +503,8 @@ class BatchEncoder:
                 k *= 2
             return k
 
-        Kp = bucket(max((len(p) for p in prev_lists), default=0))
-        Ke = bucket(max((len(e) for e in evict_lists), default=0), lo=1)
+        Kp = bucket(max(map(len, prev_lists), default=0))
+        Ke = bucket(max(map(len, evict_lists), default=0), lo=1)
         prev_idx = np.full((B, Kp), C, np.int32)  # C = drop sentinel
         prev_rep = np.zeros((B, Kp), np.int32)
         evict_idx = np.full((B, Ke), C, np.int32)
@@ -372,15 +519,12 @@ class BatchEncoder:
             keys=keys,
             uids=uids,
             replicas=replicas,
-            request=request,
             unknown_request=unknown_request,
             gvk=gvk,
             strategy=strategy,
             fresh=fresh,
-            tol_key=tol_key,
-            tol_value=tol_value,
-            tol_effect=tol_effect,
-            tol_op=tol_op,
+            tol_tables=self._tol_table(),
+            tol_idx=tol_idx,
             aff_masks=np.stack(aff_rows) if aff_rows else np.ones((1, C), bool),
             aff_idx=aff_idx,
             weight_tables=np.stack(weight_rows),
@@ -390,7 +534,7 @@ class BatchEncoder:
             evict_idx=evict_idx,
             seeds=seeds,
             n_clusters=C,
-            req_unique=req_unique,
+            req_unique=self._req_table(),
             req_idx=req_idx_arr,
         )
 

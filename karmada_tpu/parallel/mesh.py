@@ -122,15 +122,12 @@ _FLEET_SPECS = (
 )
 _BATCH_SPECS = (
     P(AXIS_BINDINGS),        # replicas
-    P(AXIS_BINDINGS, None),  # request
     P(AXIS_BINDINGS),        # unknown_request
     P(AXIS_BINDINGS),        # gvk
     P(AXIS_BINDINGS),        # strategy
     P(AXIS_BINDINGS),        # fresh
-    P(AXIS_BINDINGS, None),  # tol_key
-    P(AXIS_BINDINGS, None),  # tol_value
-    P(AXIS_BINDINGS, None),  # tol_effect
-    P(AXIS_BINDINGS, None),  # tol_op
+    P(None, None, None),     # tol_tables [T,4,K] (replicated policy table)
+    P(AXIS_BINDINGS),        # tol_idx
     P(None, AXIS_CLUSTERS),  # aff_masks   [P,C] policy table, column-sharded
     P(AXIS_BINDINGS),        # aff_idx
     P(None, AXIS_CLUSTERS),  # weight_tables [W,C]
@@ -159,8 +156,8 @@ _OUT_SPECS = (
 def _sharded_body(topk: int):
     def body(
         alive, capacity, has_summary, taint_key, taint_value, taint_effect, api_ok,
-        replicas, request, unknown_request, gvk, strategy, fresh,
-        tol_key, tol_value, tol_effect, tol_op,
+        replicas, unknown_request, gvk, strategy, fresh,
+        tol_tables, tol_idx,
         aff_masks, aff_idx, weight_tables, weight_idx,
         prev_idx, prev_rep, evict_idx, seeds,
         req_unique, req_idx,
@@ -184,11 +181,12 @@ def _sharded_body(topk: int):
                 prev_idx, prev_rep, evict_idx, seeds, C_l, col_offset=c0,
             )
         )
+        tol = tol_tables[tol_idx]  # [B_l,4,K] on-device gather
         feasible_l, score_l, avail_l = filter_estimate_phase(
             alive, capacity, has_summary, taint_key, taint_value, taint_effect,
             api_ok,
-            replicas, request, unknown_request, gvk,
-            tol_key, tol_value, tol_effect, tol_op,
+            replicas, None, unknown_request, gvk,
+            tol[:, 0], tol[:, 1], tol[:, 2], tol[:, 3],
             affinity_ok, eviction_ok, prev_member,
             req_unique=req_unique, req_idx=req_idx,
         )
@@ -316,13 +314,14 @@ class MeshScheduleKernel:
         def tbl(a):  # policy tables: pad the cluster axis
             return _pad_axis(a, 1, Cp)
 
-        # hand-built batches may lack the deduped request form (the
-        # documented fallback): synthesize the trivial factoring
+        # the encoder always factors requests (BindingBatch.request is a
+        # view over req_unique/req_idx now, so there is no dense fallback)
         if batch.req_unique is None or batch.req_idx is None:
-            req_unique = batch.request
-            req_idx = np.arange(B, dtype=np.int32)
-        else:
-            req_unique, req_idx = batch.req_unique, batch.req_idx
+            raise ValueError(
+                "BindingBatch lacks req_unique/req_idx — encode batches via "
+                "BatchEncoder.encode()"
+            )
+        req_unique, req_idx = batch.req_unique, batch.req_idx
         if extra_avail is None or extra_avail.shape == (1, 1):
             extra, dense_extra = self._NO_EXTRA, False
         else:
@@ -331,10 +330,9 @@ class MeshScheduleKernel:
             dense_extra = True
         return self._kernel(min(Cp, self._topk), dense_extra)(
             *self._fleet_dev,
-            bb(batch.replicas), bb(batch.request), bb(batch.unknown_request),
+            bb(batch.replicas), bb(batch.unknown_request),
             bb(batch.gvk), bb(batch.strategy), bb(batch.fresh),
-            bb(batch.tol_key), bb(batch.tol_value), bb(batch.tol_effect),
-            bb(batch.tol_op),
+            batch.tol_tables, bb(batch.tol_idx),
             tbl(batch.aff_masks), bb(batch.aff_idx),
             tbl(batch.weight_tables), bb(batch.weight_idx),
             # padded rows carry the global drop sentinel, not column 0

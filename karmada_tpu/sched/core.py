@@ -305,9 +305,11 @@ def decompress_batch(
 def _schedule_kernel_compact(
     # fleet (device-resident)
     alive, capacity, has_summary, taint_key, taint_value, taint_effect, api_ok,
-    # batch core
-    replicas, request, unknown_request, gvk, strategy, fresh,
-    tol_key, tol_value, tol_effect, tol_op,
+    # batch core (tolerations ride the factored [T,4,K] table + per-row idx;
+    # dense per-resource requests ride req_unique/req_idx — upload stays
+    # O(tables + B), never O(B·K))
+    replicas, unknown_request, gvk, strategy, fresh,
+    tol_tables, tol_idx,
     # factored [B,C] reconstruction inputs (models/batch.py BindingBatch)
     aff_masks, aff_idx, weight_tables, weight_idx,
     prev_idx, prev_rep, evict_idx, seeds,
@@ -332,11 +334,12 @@ def _schedule_kernel_compact(
             prev_idx, prev_rep, evict_idx, seeds, C,
         )
     )
+    tol = tol_tables[tol_idx]  # [B,4,K] on-device gather
     extra = jnp.broadcast_to(extra_avail, (B, C))
     feasible, score, result, unschedulable, avail_sum, avail = _schedule_body(
         alive, capacity, has_summary, taint_key, taint_value, taint_effect, api_ok,
-        replicas, request, unknown_request, gvk, strategy, fresh,
-        tol_key, tol_value, tol_effect, tol_op,
+        replicas, None, unknown_request, gvk, strategy, fresh,
+        tol[:, 0], tol[:, 1], tol[:, 2], tol[:, 3],
         affinity_ok, eviction_ok, static_weight, prev_member, prev_replicas, tie,
         extra, narrow=narrow, has_agg=has_agg,
         req_unique=req_unique, req_idx=req_idx,
@@ -355,8 +358,8 @@ def _filter_kernel_compact(
     # fleet (device-resident)
     alive, capacity, has_summary, taint_key, taint_value, taint_effect, api_ok,
     # batch core
-    replicas, request, unknown_request, gvk,
-    tol_key, tol_value, tol_effect, tol_op,
+    replicas, unknown_request, gvk,
+    tol_tables, tol_idx,
     # factored reconstruction inputs (static weights skipped: the division
     # tail decompresses them itself for its row subset)
     aff_masks, aff_idx, prev_idx, prev_rep, evict_idx, seeds,
@@ -371,6 +374,10 @@ def _filter_kernel_compact(
     B = replicas.shape[0]
     C = alive.shape[0]
     rows = jnp.arange(B)[:, None]
+    tol = tol_tables[tol_idx]  # [B,4,K]
+    tol_key, tol_value, tol_effect, tol_op = (
+        tol[:, 0], tol[:, 1], tol[:, 2], tol[:, 3],
+    )
     affinity_ok = aff_masks[aff_idx]
     p = jnp.where((prev_idx >= 0) & (prev_idx < C), prev_idx, C)
     prev_member = jnp.zeros((B, C), bool).at[rows, p].set(True, mode="drop")
@@ -382,7 +389,7 @@ def _filter_kernel_compact(
     tie = _device_tie(seeds, C)
     feasible, score, avail = filter_estimate_phase(
         alive, capacity, has_summary, taint_key, taint_value, taint_effect,
-        api_ok, replicas, request, unknown_request, gvk,
+        api_ok, replicas, None, unknown_request, gvk,
         tol_key, tol_value, tol_effect, tol_op,
         affinity_ok, eviction_ok, prev_member,
         req_unique=req_unique, req_idx=req_idx,
@@ -392,17 +399,21 @@ def _filter_kernel_compact(
     return feasible, score, avail, prev_replicas, tie, feasible.sum(-1).astype(jnp.int32)
 
 
-@partial(jax.jit, static_argnames=("topk", "narrow", "has_agg"))
+@partial(jax.jit, static_argnames=("topk", "narrow", "has_agg", "narrow16"))
 def _tail_kernel(
     feasible, avail, prev_replicas, tie,  # gathered rows of the filter phase
     weight_tables, weight_idx, strategy, replicas, fresh,
-    topk: int, narrow: bool, has_agg: bool,
+    topk: int, narrow: bool, has_agg: bool, narrow16: bool = False,
 ):
     """Division tail over a row SUBSET (phase 2): the [B,C] dispenser sorts
     run only on rows whose strategy divides replicas; the agg-only
     truncation sort compiles in solely for the Aggregated sub-batch
     (has_agg) — at the flagship mix this halves the sort volume vs the
-    monolithic kernel."""
+    monolithic kernel.
+
+    narrow16: emit the compact (idx, val) window as i16 — sound when the
+    host proves C < 2**15 and max replicas < 2**15; the tunnel link runs at
+    ~40 MB/s, so halving the dominant transfer is wall-clock, not polish."""
     static_weight = weight_tables[weight_idx]
     result, unschedulable, avail_sum = assignment_tail(
         feasible, strategy, static_weight, avail, prev_replicas, tie,
@@ -410,6 +421,9 @@ def _tail_kernel(
     )
     C = feasible.shape[1]
     _, nnz, top_idx, top_val = compact_outputs(feasible, result, min(C, topk))
+    if narrow16:
+        top_idx = top_idx.astype(jnp.int16)
+        top_val = top_val.astype(jnp.int16)
     return result, unschedulable, avail_sum, nnz, top_idx, top_val
 
 
@@ -420,6 +434,23 @@ def _pack_rows_kernel(feasible):
     from . import spread_batch
 
     return spread_batch._pack_bits(feasible)
+
+
+@partial(jax.jit, static_argnames=("k", "narrow16"))
+def _feas_idx_kernel(feasible, k: int, narrow16: bool = False):
+    """Ascending indices of the (at most k) feasible columns per row — the
+    complete target/feasible set for duplicated rows whose affinity popcount
+    proves ≤ k candidates (host bound; feasible ⊆ affinity mask). 2k bytes
+    per row instead of the packed mask's C/8 — at 10k×5k that is the
+    difference between ~80 KB and ~1.6 MB on a ~40 MB/s link."""
+    B, C = feasible.shape
+    iota = jax.lax.broadcasted_iota(jnp.int32, (B, C), 1)
+    key = jnp.where(feasible, iota, jnp.int32(2**30))
+    neg, _ = jax.lax.top_k(-key, k)
+    idx = -neg
+    if narrow16:
+        idx = idx.astype(jnp.int16)  # rows slice [:feas_count] before use
+    return idx
 
 
 @jax.jit
@@ -486,15 +517,12 @@ def _restrict_rows(batch: BindingBatch, rows: list[int], affinity_override: np.n
         keys=[batch.keys[b] for b in rows],
         uids=[batch.uids[b] for b in rows],
         replicas=take(batch.replicas),
-        request=take(batch.request),
         unknown_request=take(batch.unknown_request),
         gvk=take(batch.gvk),
         strategy=take(batch.strategy),
         fresh=take(batch.fresh),
-        tol_key=take(batch.tol_key),
-        tol_value=take(batch.tol_value),
-        tol_effect=take(batch.tol_effect),
-        tol_op=take(batch.tol_op),
+        tol_tables=batch.tol_tables,
+        tol_idx=take(batch.tol_idx),
         aff_masks=affinity_override[idx],
         aff_idx=np.arange(len(rows), dtype=np.int32),
         weight_tables=batch.weight_tables,
@@ -602,15 +630,12 @@ class ArrayScheduler:
             keys=batch.keys,
             uids=batch.uids,
             replicas=pz(batch.replicas),
-            request=pz(batch.request),
             unknown_request=pz(batch.unknown_request),
             gvk=pz(batch.gvk),
             strategy=pz(batch.strategy),
             fresh=pz(batch.fresh),
-            tol_key=pz(batch.tol_key),
-            tol_value=pz(batch.tol_value),
-            tol_effect=pz(batch.tol_effect),
-            tol_op=pz(batch.tol_op),
+            tol_tables=batch.tol_tables,
+            tol_idx=pz(batch.tol_idx),
             aff_masks=batch.aff_masks,
             aff_idx=pz(batch.aff_idx),  # padded rows → mask row 0 (harmless:
             #   strategy 0/replicas 0 rows are never decoded)
@@ -677,15 +702,12 @@ class ArrayScheduler:
         return _schedule_kernel_compact(
             *self._fleet_dev,
             batch.replicas,
-            batch.request,
             batch.unknown_request,
             batch.gvk,
             batch.strategy,
             batch.fresh,
-            batch.tol_key,
-            batch.tol_value,
-            batch.tol_effect,
-            batch.tol_op,
+            batch.tol_tables,
+            batch.tol_idx,
             batch.aff_masks,
             batch.aff_idx,
             batch.weight_tables,
@@ -850,24 +872,31 @@ class ArrayScheduler:
         dev_feasible, dev_score, dev_avail, dev_prev, dev_tie, dev_fc = (
             _filter_kernel_compact(
                 *self._fleet_dev,
-                batch.replicas, batch.request, batch.unknown_request,
-                batch.gvk, batch.tol_key, batch.tol_value, batch.tol_effect,
-                batch.tol_op, batch.aff_masks, batch.aff_idx,
+                batch.replicas, batch.unknown_request,
+                batch.gvk, batch.tol_tables, batch.tol_idx,
+                batch.aff_masks, batch.aff_idx,
                 batch.prev_idx, batch.prev_rep, batch.evict_idx, batch.seeds,
                 batch.req_unique, batch.req_idx,
                 self._NO_EXTRA if extra_avail is None else extra_avail,
             )
         )
-        feas_count = np.asarray(jax.device_get(dev_fc))[:n_real].astype(np.int64)
         unsched = np.zeros(n_real, bool)
         avail_sum = np.zeros(n_real, np.int64)
         _, narrow, _ = self._batch_flags(batch)  # once per round
+        narrow16 = C < 2**15 and int(raw.replicas.max(initial=0)) < 2**15
 
         row_err: dict[int, str] = {}
         row_target_src: dict[int, tuple] = {}
         row_feas_src: dict[int, tuple] = {}
 
-        # ---- phase 2: division tails per sub-class ----
+        # Every phase-2 kernel below depends only on phase-1 DEVICE outputs,
+        # never on host values — so all of them are LAUNCHED back to back and
+        # the round pays ONE device→host sync (the tunnel adds ~70 ms RTT per
+        # sync; the round-2 shape of this loop synced after every sub-phase
+        # and serialized RTT + exec four times over).
+
+        # ---- phase 2 launch: division tails per sub-class ----
+        tails = []  # (rows, t_out)
         for want_cls, has_agg in ((1, False), (2, True)):
             rows = [b for b in range(n_real) if cls[b] == want_cls]
             if not rows:
@@ -887,9 +916,52 @@ class ArrayScheduler:
                 t_feas, t_avail, t_prev, t_tie,
                 batch.weight_tables, batch.weight_idx[rsel],
                 batch.strategy[rsel], batch.replicas[rsel], batch.fresh[rsel],
-                topk=topk, narrow=narrow, has_agg=has_agg,
+                topk=topk, narrow=narrow, has_agg=has_agg, narrow16=narrow16,
             )
-            t_unsched, t_avail_sum, t_nnz, t_ti, t_tv = jax.device_get(t_out[1:])
+            tails.append((rows, t_out))
+
+        # ---- phase 2 launch: duplicated / non-workload target sets ----
+        fallback_set = set(fallback_rows)
+        mask_rows = [
+            b for b in range(n_real)
+            if cls[b] == 0 and b not in batched_cfg and b not in fallback_set
+        ]
+        packed_dev = midx_dev = None
+        if mask_rows:
+            mask_idx, nm = _pad_rows_idx(mask_rows, self._bucket)
+            m_feas = _gather_rows_kernel(dev_feasible, mask_idx)
+            pc = raw.aff_masks.sum(axis=1)
+            mk = int(pc[raw.aff_idx[np.asarray(mask_rows)]].max(initial=0))
+            if 0 < mk <= TOPK_TARGETS:
+                mkb = 8
+                while mkb < mk:
+                    mkb *= 2
+                midx_dev = _feas_idx_kernel(
+                    m_feas, min(mkb, C), narrow16=narrow16
+                )
+            else:  # wide rows (full-fleet affinities): complete packed mask
+                packed_dev = _pack_rows_kernel(m_feas)
+
+        # ---- phase 2 launch: spread group scoring ----
+        spread_pre = self._spread_prelaunch(
+            bindings, batch, batched_rows, batched_cfg,
+            dev_feasible, dev_score, dev_avail, dev_prev, dev_tie,
+        )
+
+        # ---- THE sync ----
+        host = jax.device_get((
+            dev_fc,
+            [t_out[1:] for _, t_out in tails],
+            (packed_dev, midx_dev),
+            None if spread_pre is None else spread_pre["wvf"],
+        ))
+        feas_count = np.asarray(host[0])[:n_real].astype(np.int64)
+        if spread_pre is not None:
+            spread_pre["wvf_host"] = host[3]
+
+        # ---- decode: division tails ----
+        for (rows, t_out), vals in zip(tails, host[1]):
+            t_unsched, t_avail_sum, t_nnz, t_ti, t_tv = vals
             tis, tvs = _sorted_pairs(t_ti, t_tv)
             overflow = []
             for k, b in enumerate(rows):
@@ -910,29 +982,31 @@ class ArrayScheduler:
                         "pairs", names, pos, o_res[j, pos].astype(np.int64)
                     )
 
-        # ---- duplicated / non-workload rows: packed feasible masks ----
-        fallback_set = set(fallback_rows)
-        mask_rows = [
-            b for b in range(n_real)
-            if cls[b] == 0 and b not in batched_cfg
-            and b not in fallback_set and feas_count[b] > 0
-        ]
+        # ---- decode: duplicated / non-workload target sets ----
         if mask_rows:
-            idx_pad, nm = _pad_rows_idx(mask_rows, self._bucket)
-            packed = np.asarray(jax.device_get(
-                _pack_rows_kernel(_gather_rows_kernel(dev_feasible, idx_pad))
-            ))[:nm]
+            packed_h, midx_h = host[2]
             for k, b in enumerate(mask_rows):
+                n = int(feas_count[b])
+                if n <= 0:
+                    continue
                 strat = int(raw.strategy[b])
-                row_feas_src[b] = ("mask", names, packed[k], C)
                 reps = 0 if strat == NON_WORKLOAD else int(bindings[b].spec.replicas)
-                row_target_src[b] = ("mask", names, packed[k], C, reps)
+                if midx_h is not None:
+                    fidx = np.asarray(midx_h[k][:n], np.int64)
+                    row_feas_src[b] = ("idx", names, fidx)
+                    row_target_src[b] = (
+                        "pairs", names, fidx, np.full(n, reps, np.int64)
+                    )
+                else:
+                    row_feas_src[b] = ("mask", names, packed_h[k], C)
+                    row_target_src[b] = ("mask", names, packed_h[k], C, reps)
 
         self._spread_overlay(
             bindings, raw, batch, extra_avail, batched_rows, batched_cfg,
             fallback_rows, dev_feasible, dev_score, dev_avail, dev_prev,
             dev_tie, feas_count, unsched, avail_sum,
             row_err, row_target_src, row_feas_src, narrow=narrow,
+            pre=spread_pre,
         )
 
         # ---- build decisions, then unpermute ----
@@ -961,15 +1035,68 @@ class ArrayScheduler:
             out[int(order[j])] = dec
         return out
 
+    def _spread_prelaunch(
+        self, bindings, batch, batched_rows, batched_cfg,
+        dev_feasible, dev_score, dev_avail, dev_prev, dev_tie,
+    ):
+        """LAUNCH the batched-spread group scoring (gathers + one kernel) and
+        return the device handles — no sync. The partitioned round folds the
+        (W, V, fc) fetch into its single round-trip; callers without that
+        discipline fetch from the returned handles themselves."""
+        if not batched_rows:
+            return None
+        from . import spread_batch
+
+        C = len(self.fleet.names)
+        layout = self._spread_layout
+        idx_pad, nb = _pad_rows_idx(batched_rows, self._bucket)
+        g_feas = _gather_rows_kernel(dev_feasible, idx_pad)
+        g_score = _gather_rows_kernel(dev_score, idx_pad)
+        g_avail = _gather_rows_kernel(dev_avail, idx_pad)
+        if dev_prev is not None:
+            g_prev = _gather_rows_kernel(dev_prev, idx_pad)
+            g_tie = _gather_rows_kernel(dev_tie, idx_pad)
+        else:
+            g_prev, g_tie = _row_context_kernel(
+                batch.prev_idx[idx_pad], batch.prev_rep[idx_pad],
+                batch.seeds[idx_pad], n_cols=C,
+            )
+
+        S = len(idx_pad)
+        need = np.ones(S, np.int64)
+        target = np.ones(S, np.int64)
+        reps = np.zeros(S, np.int64)
+        dupf = np.zeros(S, bool)
+        for j, b in enumerate(batched_rows):
+            cfg = batched_cfg[b]
+            mg = max(cfg.rmin, 1)
+            need[j] = cfg.need
+            target[j] = -(-bindings[b].spec.replicas // mg)
+            reps[j] = bindings[b].spec.replicas
+            dupf[j] = cfg.duplicated
+        W, V, A, fc_dev = spread_batch.group_score_kernel(
+            g_feas, g_score, g_avail, g_prev,
+            reps, need, target, dupf, layout=layout,
+        )
+        return {
+            "idx_pad": idx_pad, "nb": nb,
+            "g_feas": g_feas, "g_avail": g_avail,
+            "g_prev": g_prev, "g_tie": g_tie,
+            "wvf": (W, V, fc_dev),
+        }
+
     def _spread_overlay(
         self, bindings, raw, batch, extra_avail, batched_rows, batched_cfg,
         fallback_rows, dev_feasible, dev_score, dev_avail, dev_prev, dev_tie,
         feas_count, unsched, avail_sum, row_err, row_target_src, row_feas_src,
-        narrow: bool,
+        narrow: bool, pre=None,
     ) -> None:
         """Spread-constrained rows: batched device path + per-row exact
         fallback. Mutates the decode overlays in place. dev_prev/dev_tie may
-        be None (mesh path) — they're rebuilt for the row subset."""
+        be None (mesh path) — they're rebuilt for the row subset. `pre` is a
+        _spread_prelaunch result whose (W, V, fc) the caller already fetched
+        (stored under pre["wvf_host"]); without it the overlay launches and
+        fetches itself."""
         from . import spread as spread_mod
         from . import spread_batch
 
@@ -981,36 +1108,19 @@ class ArrayScheduler:
         # combination search → packed selection masks + divided re-dispense
         if batched_rows:
             layout = self._spread_layout
-            idx_pad, nb = _pad_rows_idx(batched_rows, self._bucket)
-            g_feas = _gather_rows_kernel(dev_feasible, idx_pad)
-            g_score = _gather_rows_kernel(dev_score, idx_pad)
-            g_avail = _gather_rows_kernel(dev_avail, idx_pad)
-            if dev_prev is not None:
-                g_prev = _gather_rows_kernel(dev_prev, idx_pad)
-                g_tie = _gather_rows_kernel(dev_tie, idx_pad)
-            else:
-                g_prev, g_tie = _row_context_kernel(
-                    batch.prev_idx[idx_pad], batch.prev_rep[idx_pad],
-                    batch.seeds[idx_pad], n_cols=C,
+            if pre is None:
+                pre = self._spread_prelaunch(
+                    bindings, batch, batched_rows, batched_cfg,
+                    dev_feasible, dev_score, dev_avail, dev_prev, dev_tie,
                 )
-
+            wvf_host = pre.get("wvf_host")
+            if wvf_host is None:
+                wvf_host = jax.device_get(pre["wvf"])
+            idx_pad, nb = pre["idx_pad"], pre["nb"]
+            g_feas, g_avail = pre["g_feas"], pre["g_avail"]
+            g_prev, g_tie = pre["g_prev"], pre["g_tie"]
             S = len(idx_pad)
-            need = np.ones(S, np.int64)
-            target = np.ones(S, np.int64)
-            reps = np.zeros(S, np.int64)
-            dupf = np.zeros(S, bool)
-            for j, b in enumerate(batched_rows):
-                cfg = batched_cfg[b]
-                mg = max(cfg.rmin, 1)
-                need[j] = cfg.need
-                target[j] = -(-bindings[b].spec.replicas // mg)
-                reps[j] = bindings[b].spec.replicas
-                dupf[j] = cfg.duplicated
-            W, V, A, fc_dev = spread_batch.group_score_kernel(
-                g_feas, g_score, g_avail, g_prev,
-                reps, need, target, dupf, layout=layout,
-            )
-            W, V, fc = jax.device_get((W, V, fc_dev))
+            W, V, fc = wvf_host
             W = np.asarray(W)[:nb]
             V = np.asarray(V)[:nb]
             fc = np.asarray(fc)[:nb]

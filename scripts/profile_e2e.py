@@ -1,0 +1,137 @@
+"""End-to-end breakdown of ArrayScheduler.schedule() at bench shapes.
+
+Wraps the internal kernels + sync points of the partitioned round with
+wall-clock accumulators (kernel launches are async — time shows up at the
+device_get sync points). Honest on the tunnel backend: syncs are real
+fetches, not block_until_ready.
+
+Run:  python scripts/profile_e2e.py [flagship|churn|spread|dynamic] [iters]
+"""
+from __future__ import annotations
+
+import sys
+import time
+from collections import defaultdict
+
+sys.path.insert(0, ".")
+import karmada_tpu  # noqa: F401
+
+import jax
+import numpy as np
+
+import bench as bench_mod
+from karmada_tpu.models import batch as batch_mod
+from karmada_tpu.sched import core as core_mod
+from karmada_tpu.sched import spread_batch
+
+ACC: dict[str, float] = defaultdict(float)
+CNT: dict[str, int] = defaultdict(int)
+
+
+def wrap_attr(mod, name, label=None):
+    fn = getattr(mod, name)
+    key = label or name
+
+    def wrapped(*a, **k):
+        t0 = time.perf_counter()
+        r = fn(*a, **k)
+        ACC[key] += time.perf_counter() - t0
+        CNT[key] += 1
+        return r
+
+    setattr(mod, name, wrapped)
+    return fn
+
+
+def wrap_method(cls, name, label):
+    fn = getattr(cls, name)
+
+    def wrapped(self, *a, **k):
+        t0 = time.perf_counter()
+        r = fn(self, *a, **k)
+        ACC[label] += time.perf_counter() - t0
+        CNT[label] += 1
+        return r
+
+    setattr(cls, name, wrapped)
+
+
+def main():
+    cfg = sys.argv[1] if len(sys.argv) > 1 else "flagship"
+    iters = int(sys.argv[2]) if len(sys.argv) > 2 else 4
+    dev = jax.devices()[0]
+    print(f"# backend={dev.platform} config={cfg}", flush=True)
+
+    build, _ = bench_mod.CONFIGS[cfg]
+    if cfg == "flagship":
+        sched, bindings, extra_fn = build(n_clusters=5000, n_bindings=10000)
+    else:
+        sched, bindings, extra_fn = build()
+
+    # --- instrument ---
+    wrap_method(batch_mod.BatchEncoder, "encode", "host: batch encode")
+    # sync points: device_get (blocks until producing kernels finish)
+    real_get = jax.device_get
+
+    def timed_get(x):
+        t0 = time.perf_counter()
+        r = real_get(x)
+        ACC["sync: device_get"] += time.perf_counter() - t0
+        CNT["sync: device_get"] += 1
+        return r
+
+    jax.device_get = timed_get
+    # kernel dispatch cost (async – small unless host-bound)
+    for name in (
+        "_filter_kernel_compact", "_tail_kernel", "_gather_rows_kernel",
+        "_pack_rows_kernel", "_schedule_kernel_compact", "_row_context_kernel",
+    ):
+        wrap_attr(core_mod, name, f"dispatch: {name}")
+    wrap_attr(core_mod, "_sorted_pairs", "host: _sorted_pairs")
+    for name in (
+        "group_score_kernel", "select_regions_batch",
+        "packed_selection_kernel", "spread_tail_kernel",
+    ):
+        if hasattr(spread_batch, name):
+            wrap_attr(spread_batch, name, f"spread: {name}")
+    wrap_method(core_mod.ArrayScheduler, "_batch_flags", "host: _batch_flags")
+    wrap_method(core_mod.ArrayScheduler, "_classify_spread", "host: _classify_spread")
+    wrap_method(core_mod.ArrayScheduler, "_pad", "host: _pad")
+    wrap_method(
+        core_mod.ArrayScheduler, "_spread_overlay", "phase: _spread_overlay(total)"
+    )
+
+    # warm round (compile), unmeasured
+    extra = extra_fn() if extra_fn else None
+    decisions = sched.schedule(bindings, extra_avail=extra)
+    n_ok = sum(d.ok for d in decisions)
+    ACC.clear()
+    CNT.clear()
+
+    lat = []
+    for _ in range(iters):
+        t0 = time.perf_counter()
+        extra = extra_fn() if extra_fn else None
+        decisions = sched.schedule(bindings, extra_avail=extra)
+        lat.append(time.perf_counter() - t0)
+    total = sum(lat)
+    print(f"# e2e: {[f'{t:.3f}' for t in lat]}  ok={n_ok}/{len(bindings)}")
+    if extra_fn:
+        t0 = time.perf_counter()
+        extra_fn()
+        print(f"# extra_fn alone: {time.perf_counter() - t0:.3f}s")
+    print(f"{'section':38s} {'total ms':>9s} {'/iter ms':>9s} {'calls':>6s}")
+    for key in sorted(ACC, key=lambda k: -ACC[k]):
+        print(
+            f"{key:38s} {ACC[key]*1e3:9.1f} {ACC[key]/iters*1e3:9.1f} "
+            f"{CNT[key]:6d}"
+        )
+    acc_total = (
+        ACC.get("host: batch encode", 0) + ACC.get("sync: device_get", 0)
+    )
+    print(f"# sum(encode+syncs) {acc_total/iters*1e3:.1f} ms/iter of "
+          f"{total/iters*1e3:.1f} ms/iter e2e")
+
+
+if __name__ == "__main__":
+    main()

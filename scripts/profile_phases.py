@@ -50,12 +50,16 @@ def main():
 
     # put the batch core on device once so phase timings exclude upload
     core_args = jax.device_put((
-        batch.replicas, batch.request, batch.unknown_request, batch.gvk,
-        batch.strategy, batch.fresh, batch.tol_key, batch.tol_value,
-        batch.tol_effect, batch.tol_op))
+        batch.replicas, batch.unknown_request, batch.gvk,
+        batch.strategy, batch.fresh, batch.tol_tables, batch.tol_idx))
     dec_dev = jax.device_put(dec_args)
-    (replicas, request, unknown_request, gvk, strategy, fresh,
-     tol_key, tol_value, tol_effect, tol_op) = core_args
+    (replicas, unknown_request, gvk, strategy, fresh,
+     tol_tables, tol_idx) = core_args
+    request = None
+    tol = batch.tol_tables[batch.tol_idx]
+    tol_key, tol_value, tol_effect, tol_op = (
+        jax.device_put(tol[:, 0]), jax.device_put(tol[:, 1]),
+        jax.device_put(tol[:, 2]), jax.device_put(tol[:, 3]))
     _ = np.asarray(jax.jit(lambda r: r.sum())(replicas))
 
     timeit(lambda: jax.jit(lambda: jnp.int32(1))(), "noop RTT")
@@ -63,8 +67,8 @@ def main():
     @jax.jit
     def full_kernel():
         out = core_mod._schedule_kernel_compact(
-            *fleet_dev, replicas, request, unknown_request, gvk, strategy,
-            fresh, tol_key, tol_value, tol_effect, tol_op, *dec_dev,
+            *fleet_dev, replicas, unknown_request, gvk, strategy,
+            fresh, tol_tables, tol_idx, *dec_dev,
             batch.req_unique, batch.req_idx,
             jnp.full((1, 1), -1, jnp.int32))
         return sum(o.sum().astype(jnp.int64) for o in out[3:5]) + out[8].sum()
@@ -85,7 +89,8 @@ def main():
         feasible, score, avail = core_mod.filter_estimate_phase(
             *fleet_dev, replicas, request, unknown_request, gvk,
             tol_key, tol_value, tol_effect, tol_op,
-            affinity_ok, eviction_ok, prev_member)
+            affinity_ok, eviction_ok, prev_member,
+            req_unique=batch.req_unique, req_idx=batch.req_idx)
         return (feasible.sum().astype(jnp.int64) + score.sum()
                 + avail.sum().astype(jnp.int64))
 
@@ -98,7 +103,8 @@ def main():
         feasible, score, avail = core_mod.filter_estimate_phase(
             *fleet_dev, replicas, request, unknown_request, gvk,
             tol_key, tol_value, tol_effect, tol_op,
-            affinity_ok, eviction_ok, prev_member)
+            affinity_ok, eviction_ok, prev_member,
+            req_unique=batch.req_unique, req_idx=batch.req_idx)
         result, unsched, avail_sum = core_mod.assignment_tail(
             feasible, strategy, static_weight, avail, prev_replicas, tie,
             replicas, fresh)
@@ -108,8 +114,8 @@ def main():
 
     # transfer cost of the compact outputs alone
     out = core_mod._schedule_kernel_compact(
-        *fleet_dev, replicas, request, unknown_request, gvk, strategy,
-        fresh, tol_key, tol_value, tol_effect, tol_op, *dec_dev,
+        *fleet_dev, replicas, unknown_request, gvk, strategy,
+        fresh, tol_tables, tol_idx, *dec_dev,
         batch.req_unique, batch.req_idx,
         jnp.full((1, 1), -1, jnp.int32))
     _ = jax.device_get((out[3], out[4], out[6], out[7], out[8], out[9]))
