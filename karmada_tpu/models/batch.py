@@ -58,6 +58,15 @@ def strategy_code(placement: Optional[Placement], replicas: int) -> int:
     return DUPLICATED
 
 
+def pow2_bucket(n: int, lo: int = 2) -> int:
+    """Smallest power of two >= n, starting at lo — THE jit-cache bucketing
+    rule (shared so the policy can't drift between call sites)."""
+    b = lo
+    while b < n:
+        b *= 2
+    return b
+
+
 def uid_seed(uid: str) -> np.uint64:
     return np.frombuffer(hashlib.blake2b(uid.encode(), digest_size=8).digest(), np.uint64)[0]
 
@@ -283,10 +292,7 @@ class BatchEncoder:
     def _req_table(self) -> np.ndarray:
         """Request table padded to a pow2 bucket (jit cache bound)."""
         if self._req_stack is None:
-            U = max(len(self._req_rows), 1)
-            Up = 1
-            while Up < U:
-                Up *= 2
+            Up = pow2_bucket(max(len(self._req_rows), 1), lo=1)
             tab = np.zeros((Up, len(self.encoder.resources)), np.int64)
             if self._req_rows:
                 tab[: len(self._req_rows)] = np.stack(self._req_rows)
@@ -299,9 +305,7 @@ class BatchEncoder:
         if len(tols) > self._tol_width:
             # widen the whole table (capping would wrongly reject bindings
             # whose matching toleration is dropped); ids stay stable
-            w = self._tol_width
-            while w < len(tols):
-                w *= 2
+            w = pow2_bucket(len(tols), lo=self._tol_width)
             self._tol_rows = [
                 np.pad(r, [(0, 0), (0, w - self._tol_width)])
                 for r in self._tol_rows
@@ -334,9 +338,7 @@ class BatchEncoder:
         kernel every time one new distinct toleration set appears."""
         if self._tol_stack is None:
             T = len(self._tol_rows)
-            Tp = 1
-            while Tp < T:
-                Tp *= 2
+            Tp = pow2_bucket(T, lo=1)
             tab = np.zeros((Tp, 4, self._tol_width), np.int32)
             tab[:T] = np.stack(self._tol_rows)
             self._tol_stack = tab
@@ -497,14 +499,8 @@ class BatchEncoder:
             )
 
         # sparse axes bucketed to powers of two (jit cache bound)
-        def bucket(n: int, lo: int = 2) -> int:
-            k = lo
-            while k < n:
-                k *= 2
-            return k
-
-        Kp = bucket(max(map(len, prev_lists), default=0))
-        Ke = bucket(max(map(len, evict_lists), default=0), lo=1)
+        Kp = pow2_bucket(max(map(len, prev_lists), default=0))
+        Ke = pow2_bucket(max(map(len, evict_lists), default=0), lo=1)
         prev_idx = np.full((B, Kp), C, np.int32)  # C = drop sentinel
         prev_rep = np.zeros((B, Kp), np.int32)
         evict_idx = np.full((B, Ke), C, np.int32)

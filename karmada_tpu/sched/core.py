@@ -24,6 +24,7 @@ import numpy as np
 from ..api.work import TargetCluster
 from ..models.batch import (
     AGGREGATED,
+    pow2_bucket,
     BatchEncoder,
     BindingBatch,
     DUPLICATED,
@@ -688,9 +689,7 @@ class ArrayScheduler:
         if dup.any():
             pc = batch.aff_masks.sum(axis=1)
             cand = max(cand, int(pc[batch.aff_idx[dup]].max(initial=0)))
-        topk = 8
-        while topk < min(cand, TOPK_TARGETS):
-            topk *= 2
+        topk = pow2_bucket(min(cand, TOPK_TARGETS), lo=8)
         return min(topk, TOPK_TARGETS), narrow, has_agg
 
     def run_kernel(self, batch: BindingBatch, extra_avail=None):
@@ -781,13 +780,24 @@ class ArrayScheduler:
 
         batched, cfg_of, fallback = [], {}, []
         layout = self._spread_layout
+        # placements are shared across many rows: classify each DISTINCT
+        # placement once (ids are stable for the duration of the call —
+        # bindings hold the references)
+        pl_seen: dict[int, object] = {}
+        _MISS = object()
         for b, rb in enumerate(bindings):
             placement = rb.spec.placement
             if placement is None or not placement.spread_constraints:
                 continue
-            if spread_mod.should_ignore_spread_constraint(placement):
+            cfg = pl_seen.get(id(placement), _MISS)
+            if cfg is _MISS:
+                if spread_mod.should_ignore_spread_constraint(placement):
+                    cfg = "ignore"
+                else:
+                    cfg = spread_batch.config_of(placement)
+                pl_seen[id(placement)] = cfg
+            if cfg == "ignore":
                 continue
-            cfg = spread_batch.config_of(placement)
             if (
                 cfg is not None
                 and 0 < layout.n_regions <= spread_batch.MAX_REGIONS
@@ -908,10 +918,7 @@ class ArrayScheduler:
             t_prev = _gather_rows_kernel(dev_prev, idx_pad)
             t_tie = _gather_rows_kernel(dev_tie, idx_pad)
             max_repl = int(raw.replicas[rows].max(initial=0))
-            topk = 8
-            while topk < min(max_repl, TOPK_TARGETS):
-                topk *= 2
-            topk = min(topk, TOPK_TARGETS)
+            topk = min(pow2_bucket(min(max_repl, TOPK_TARGETS), lo=8), TOPK_TARGETS)
             t_out = _tail_kernel(
                 t_feas, t_avail, t_prev, t_tie,
                 batch.weight_tables, batch.weight_idx[rsel],
@@ -933,9 +940,7 @@ class ArrayScheduler:
             pc = raw.aff_masks.sum(axis=1)
             mk = int(pc[raw.aff_idx[np.asarray(mask_rows)]].max(initial=0))
             if 0 < mk <= TOPK_TARGETS:
-                mkb = 8
-                while mkb < mk:
-                    mkb *= 2
+                mkb = pow2_bucket(mk, lo=8)
                 midx_dev = _feas_idx_kernel(
                     m_feas, min(mkb, C), narrow16=narrow16
                 )
@@ -1148,25 +1153,36 @@ class ArrayScheduler:
                 if fc[j] > 0 and b not in row_err and b not in fallback_set
             ]
             if ok_js:
-                packed = np.asarray(jax.device_get(
-                    spread_batch.packed_selection_kernel(
-                        g_feas, chosen, layout=layout
-                    )
-                ))
+                # packed selection masks compute for every row on device, but
+                # rows sharing (filters, eviction set, chosen regions) have
+                # IDENTICAL masks — only representative rows ride the link
+                # (5k spread rows over 10 policies ⇒ a few dozen rows)
+                packed_all = spread_batch.packed_selection_kernel(
+                    g_feas, chosen, layout=layout
+                )
+                rep_of: dict[tuple, int] = {}
+                rep_js: list[int] = []
+                rep_idx_of_j: dict[int, int] = {}
                 div_js = []
                 for j in ok_js:
                     b = batched_rows[j]
-                    row_feas_src[b] = ("mask", names, packed[j], C)
-                    strat = int(raw.strategy[b])
-                    if strat == NON_WORKLOAD:
-                        row_target_src[b] = ("mask", names, packed[j], C, 0)
-                    elif strat == DUPLICATED:
-                        row_target_src[b] = (
-                            "mask", names, packed[j], C,
-                            int(bindings[b].spec.replicas),
-                        )
-                    else:
+                    k = (
+                        int(raw.aff_idx[b]), int(raw.tol_idx[b]),
+                        int(raw.gvk[b]), raw.evict_idx[b].tobytes(),
+                        chosen[j].tobytes(),
+                    )
+                    r = rep_of.get(k)
+                    if r is None:
+                        r = len(rep_js)
+                        rep_of[k] = r
+                        rep_js.append(j)
+                    rep_idx_of_j[j] = r
+                    if int(raw.strategy[b]) not in (NON_WORKLOAD, DUPLICATED):
                         div_js.append(j)
+                rep_pad, nrep = _pad_rows_idx(rep_js, self._bucket)
+                packed_reps_dev = _gather_rows_kernel(packed_all, rep_pad)
+
+                tail_dev = None
                 if div_js:
                     d_idx, nd = _pad_rows_idx(div_js, self._bucket)
                     d_rows = [batched_rows[j] for j in div_js]
@@ -1182,26 +1198,60 @@ class ArrayScheduler:
                     d_replicas = raw.replicas[d_brows]
                     d_fresh = raw.fresh[d_brows]
                     max_repl = int(raw.replicas[d_rows].max(initial=0))
-                    topk_d = 8
-                    while topk_d < min(max_repl, TOPK_TARGETS):
-                        topk_d *= 2
-                    topk_d = min(topk_d, TOPK_TARGETS)
-                    has_agg_d = bool((d_strategy == AGGREGATED).any())
-                    un2, as2, fc2, nnz2, ti2, tv2 = jax.device_get(
-                        spread_batch.spread_tail_kernel(
-                            d_feas, d_avail, d_prev, d_tie, d_chosen,
-                            d_strategy, d_replicas, d_fresh,
-                            layout=layout, topk=topk_d,
-                            narrow=narrow, has_agg=has_agg_d,
-                        )
+                    topk_d = min(
+                        pow2_bucket(min(max_repl, TOPK_TARGETS), lo=8),
+                        TOPK_TARGETS,
                     )
+                    has_agg_d = bool((d_strategy == AGGREGATED).any())
+                    tail_dev = spread_batch.spread_tail_kernel(
+                        d_feas, d_avail, d_prev, d_tie, d_chosen,
+                        d_strategy, d_replicas, d_fresh,
+                        layout=layout, topk=topk_d,
+                        narrow=narrow, has_agg=has_agg_d,
+                    )
+
+                # one sync for the packed representatives AND the tail (the
+                # dense result tensor tail_dev[0] stays on device — only
+                # overflow rows fetch their dense row)
+                packed_reps, tail_host = jax.device_get(
+                    (packed_reps_dev, None if tail_dev is None else tail_dev[1:])
+                )
+                packed_reps = np.asarray(packed_reps)[:nrep]
+                for j in ok_js:
+                    b = batched_rows[j]
+                    prow = packed_reps[rep_idx_of_j[j]]
+                    row_feas_src[b] = ("mask", names, prow, C)
+                    strat = int(raw.strategy[b])
+                    if strat == NON_WORKLOAD:
+                        row_target_src[b] = ("mask", names, prow, C, 0)
+                    elif strat == DUPLICATED:
+                        row_target_src[b] = (
+                            "mask", names, prow, C,
+                            int(bindings[b].spec.replicas),
+                        )
+                if div_js:
+                    un2, as2, fc2, nnz2, ti2, tv2 = tail_host
                     ti2s, tv2s = _sorted_pairs(ti2, tv2)
+                    overflow2 = []
                     for k, b in enumerate(d_rows):
                         unsched[b] = bool(un2[k])
                         avail_sum[b] = int(as2[k])
                         feas_count[b] = int(fc2[k])
                         n = int(nnz2[k])
+                        if n > ti2.shape[1]:
+                            overflow2.append((k, b))
+                            continue
                         row_target_src[b] = ("pairs", names, ti2s[k, :n], tv2s[k, :n])
+                    if overflow2:
+                        o_res = fetch_rows(
+                            tail_dev[0], [k for k, _ in overflow2], self._bucket
+                        )
+                        for m, (_, b) in enumerate(overflow2):
+                            pos = np.nonzero(o_res[m] > 0)[0]
+                            row_target_src[b] = (
+                                "pairs", names, pos,
+                                o_res[m, pos].astype(np.int64),
+                            )
 
         # ---- fallback spread path: the per-row exact selection + restricted
         # re-run (sched/spread.py stays the semantic spec)

@@ -301,3 +301,82 @@ class TestKernelSpecializations:
         d1 = sched.schedule(mixed)
         got = [targets_dict(d) for d in d1 if d.ok]
         assert got  # sanity: some rows scheduled
+
+
+class TestEncoderRowCache:
+    """The generation-keyed per-binding row cache (models/batch.py) — the
+    informer-decode analogue — must invalidate on every mutation channel a
+    store-managed flow exercises."""
+
+    def _sched(self):
+        return ArrayScheduler(synthetic_fleet(8, seed=3))
+
+    def test_repeat_encode_reuses_rows_and_matches(self):
+        sched = self._sched()
+        names = [c.name for c in sched.clusters]
+        bindings = [
+            make_binding(f"a{i}", 4 + i % 3, static_weight_placement({names[0]: 2, names[1]: 1}), cpu=0.1)
+            for i in range(24)
+        ]
+        first = [targets_dict(d) for d in sched.schedule(bindings)]
+        # warm cache: second round must hit (same objects, same generation)
+        enc = sched.batch_encoder
+        assert len(enc._row_cache) == len(bindings)
+        second = [targets_dict(d) for d in sched.schedule(bindings)]
+        assert first == second
+
+    def test_replicas_change_invalidates(self):
+        sched = self._sched()
+        names = [c.name for c in sched.clusters]
+        rb = make_binding("app", 4, static_weight_placement({names[0]: 1, names[1]: 1}))
+        t1 = targets_dict(sched.schedule([rb])[0])
+        assert sum(t1.values()) == 4
+        rb.spec.replicas = 10  # same generation, replicas differ → miss
+        t2 = targets_dict(sched.schedule([rb])[0])
+        assert sum(t2.values()) == 10
+
+    def test_placement_object_swap_invalidates(self):
+        sched = self._sched()
+        names = [c.name for c in sched.clusters]
+        rb = make_binding("app", 6, static_weight_placement({names[0]: 1}))
+        t1 = targets_dict(sched.schedule([rb])[0])
+        assert set(t1) == {names[0]}
+        rb.spec.placement = static_weight_placement({names[1]: 1})
+        t2 = targets_dict(sched.schedule([rb])[0])
+        assert set(t2) == {names[1]}
+
+    def test_generation_bump_invalidates(self):
+        sched = self._sched()
+        names = [c.name for c in sched.clusters]
+        pl = static_weight_placement({names[0]: 1, names[1]: 1})
+        rb = make_binding("app", 4, pl)
+        t1 = targets_dict(sched.schedule([rb])[0])
+        assert t1 == {names[0]: 2, names[1]: 2}
+        # a store update that mutates the SAME placement object in place but
+        # bumps generation — the cache must re-encode and see the new weight
+        rules = pl.replica_scheduling.weight_preference.static_weight_list
+        rules[0].weight = 3
+        rb.metadata.generation += 1
+        t2 = targets_dict(sched.schedule([rb])[0])
+        assert t2 == {names[0]: 3, names[1]: 1}
+
+    def test_interner_reset_on_overflow(self):
+        from karmada_tpu.models.batch import BatchEncoder
+
+        sched = self._sched()
+        enc = sched.batch_encoder
+        enc.MAX_REQ_ROWS  # class attr exists
+        names = [c.name for c in sched.clusters]
+        # force a reset by shrinking the cap, then encode again
+        old = BatchEncoder.MAX_REQ_ROWS
+        try:
+            BatchEncoder.MAX_REQ_ROWS = 1
+            bindings = [
+                make_binding(f"a{i}", 2, static_weight_placement({names[0]: 1}), cpu=0.1 * (1 + i))
+                for i in range(8)
+            ]
+            sched.schedule(bindings)  # fills > 1 req rows
+            out = [targets_dict(d) for d in sched.schedule(bindings)]  # reset path
+            assert all(sum(t.values()) == 2 for t in out)
+        finally:
+            BatchEncoder.MAX_REQ_ROWS = old
