@@ -480,3 +480,32 @@ class TestResourceQuotaReferenceFixtures:
                     P.SCOPE_CROSS_NS_AFFINITY]
         r, ret = self._estimate(q, {"cpu": 0.2}, "foo", "foo-priority")
         assert ret.is_noop and r == P.MAX_INT32
+
+
+
+def test_reference_fixture_500x10k():
+    import importlib.util
+    import pathlib
+
+    spec = importlib.util.spec_from_file_location(
+        "bench_estimator",
+        pathlib.Path(__file__).resolve().parent.parent / "scripts" / "bench_estimator.py",
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+
+    est = mod.build(500, 10_000, seed=1)
+    GiB = 1024.0**3
+    for cpu, mem in ((0.5, 1.0), (0.1, 0.5), (2.0, 4.0)):
+        req = ReplicaRequirements(resource_request={CPU: cpu, MEMORY: mem * GiB})
+        got = est.max_available_replicas(req)
+        # brute-force per-node recomputation (estimate.go:104-112 math)
+        a = est.arrays
+        rv = est.encoder.request_vector({CPU: cpu, MEMORY: mem * GiB}).astype(np.int64)
+        total = 0
+        for i in range(a.n_nodes):
+            rest = a.alloc[i].astype(np.int64) - a.requested[i].astype(np.int64)
+            per = min(int(rest[r] // rv[r]) for r in range(len(rv)) if rv[r] > 0)
+            per = min(per, int(a.allowed_pods[i]) - int(a.pod_count[i]))
+            total += max(per, 0)
+        assert got == total
