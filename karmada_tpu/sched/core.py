@@ -801,7 +801,6 @@ class ArrayScheduler:
             if (
                 cfg is not None
                 and 0 < layout.n_regions <= spread_batch.MAX_REGIONS
-                and layout.grid_balanced  # skewed fleets: exact path
                 and (cfg.duplicated or rb.spec.replicas <= TOPK_TARGETS)
             ):
                 batched.append(b)
@@ -1079,7 +1078,12 @@ class ArrayScheduler:
             target[j] = -(-bindings[b].spec.replicas // mg)
             reps[j] = bindings[b].spec.replicas
             dupf[j] = cfg.duplicated
-        W, V, A, fc_dev = spread_batch.group_score_kernel(
+        score_kernel = (
+            spread_batch.group_score_kernel
+            if layout.grid_balanced
+            else spread_batch.group_score_kernel_segmented  # skewed fleets
+        )
+        W, V, A, fc_dev = score_kernel(
             g_feas, g_score, g_avail, g_prev,
             reps, need, target, dupf, layout=layout,
         )

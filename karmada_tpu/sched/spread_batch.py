@@ -138,6 +138,18 @@ class RegionLayout:
         self.grid_name_rank = np.where(
             self.grid_valid, name_rank[self.grid_idx], np.iinfo(np.int32).max
         ).astype(np.int32)
+        # segmented layout (skew-proof twin of the grid): the permuted
+        # columns whose region is real are contiguous per region, so group
+        # reductions are prefix-sum differences at STATIC offsets — memory
+        # O(C) regardless of how unbalanced the region sizes are
+        self.seg_cp = int((region_id >= 0).sum())
+        self.seg_id_p = rid_p[: self.seg_cp].astype(np.int32)
+        self.seg_start = np.array(
+            [s for s, _ in self.slices], np.int32
+        ) if self.slices else np.zeros(0, np.int32)
+        self.seg_end = np.array(
+            [e for _, e in self.slices], np.int32
+        ) if self.slices else np.zeros(0, np.int32)
         # original-column-order region ids, shifted by one (0 = regionless —
         # such clusters never join a region selection)
         self.rid_orig = np.where(region_id < 0, 0, region_id + 1).astype(np.int32)
@@ -221,6 +233,107 @@ def group_score_kernel(
     dup_ok = f3 & (av3 >= replicas[:, None, None])
     cnt = dup_ok.sum(-1).astype(jnp.int64)
     sc_dup = jnp.where(dup_ok, sc3, 0).sum(-1)
+    w_dup = jnp.where(cnt > 0, cnt * WEIGHT_UNIT + sc_dup // jnp.maximum(cnt, 1), 0)
+
+    weight = jnp.where(duplicated[:, None], w_dup, w_div)
+    weight = jnp.where(value > 0, weight, 0)
+    return weight, value, av_sum, feasible.sum(-1).astype(jnp.int32)
+
+
+@partial(jax.jit, static_argnames=("layout",))
+def group_score_kernel_segmented(
+    feasible, score, avail, prev_replicas,
+    replicas, need, target, duplicated,
+    layout: RegionLayout,
+):
+    """Skew-proof twin of group_score_kernel: identical outputs, O(S·C)
+    memory for ANY region-size distribution.
+
+    The grid form pads every region to the widest one ([S,R,W] — one giant
+    region among many tiny ones multiplies memory ~R-fold, the
+    `grid_balanced` guard). Here the member sort runs over the PERMUTED
+    columns with the region id as the leading sort key, so each region's
+    members land in their static contiguous slice already ordered by the
+    sortClusters order (util.go:43-57); every per-region aggregate is then
+    an exclusive-prefix-sum difference at static offsets, and the
+    calcGroupScore first-k (group_clusters.go:217-330) falls out of a
+    monotone fail-count per segment — no scatters, no padding."""
+    S = feasible.shape[0]
+    Cp = layout.seg_cp
+    perm = jnp.asarray(layout.perm[:Cp])
+    seg = jnp.asarray(layout.seg_id_p)  # i32[Cp]
+    seg_start = jnp.asarray(layout.seg_start)  # i32[R]
+    seg_end = jnp.asarray(layout.seg_end)  # i32[R]
+
+    f = feasible[:, perm]
+    av = jnp.where(
+        f,
+        avail[:, perm].astype(jnp.int64) + prev_replicas[:, perm].astype(jnp.int64),
+        0,
+    )
+    sc = jnp.where(f, score[:, perm].astype(jnp.int64), 0)
+    infeas = (~f).astype(jnp.int32)
+    nscore = (-sc).astype(jnp.int32)
+    nav = -av
+    nrank = jnp.broadcast_to(jnp.asarray(layout.name_rank_p[:Cp]), (S, Cp))
+    segb = jnp.broadcast_to(seg, (S, Cp))
+    _, _, _, _, _, f_s, av_s, sc_s = jax.lax.sort(
+        (segb, infeas, nscore, nav, nrank,
+         f.astype(jnp.int32), av, sc),
+        dimension=-1, num_keys=5,
+    )
+
+    def excl(x):  # P[j] = sum of first j entries, [S, Cp+1]
+        return jnp.concatenate(
+            [jnp.zeros((S, 1), x.dtype), jnp.cumsum(x, axis=-1)], axis=-1
+        )
+
+    Pf = excl(f_s.astype(jnp.int64))
+    Pav = excl(av_s)
+    Psc = excl(sc_s)
+
+    def segsum(P):  # [S, R]
+        return P[:, seg_end] - P[:, seg_start]
+
+    value64 = segsum(Pf)  # feasible member count per region
+    value = value64.astype(jnp.int32)
+    av_sum = segsum(Pav)
+    sc_sum = segsum(Psc)
+
+    iota = jax.lax.broadcasted_iota(jnp.int32, (S, Cp), 1)
+    idx_rel = (iota - seg_start[seg][None, :]).astype(jnp.int64)
+    cum_av_rel = Pav[:, 1:] - jnp.take(Pav, seg_start[seg], axis=1)
+    value_at = jnp.take_along_axis(
+        value64, jnp.broadcast_to(seg, (S, Cp)).astype(jnp.int32), axis=1
+    )
+    condA = idx_rel + 1 >= need[:, None]
+    condB = cum_av_rel >= target[:, None]
+    condC = idx_rel < value_at
+    # within the feasible prefix, A∧B flips once and stays true (cum_av is
+    # nondecreasing), so the failing positions are a prefix and the first
+    # satisfying index equals their count
+    fail = (condC & ~(condA & condB)).astype(jnp.int64)
+    k_count = segsum(excl(fail))  # [S, R]
+    met = k_count < value64
+    k_eff = jnp.clip(jnp.where(met, k_count, value64 - 1), 0, max(Cp - 1, 0))
+    at = seg_start[None, :] + k_eff.astype(jnp.int32) + 1
+    sc_at_k = jnp.take_along_axis(Psc, at, axis=1) - jnp.take(
+        Psc, seg_start, axis=1
+    )
+    denom = jnp.maximum(jnp.where(met, k_eff + 1, value64), 1)
+    tgt = target[:, None]
+    w_div = jnp.where(
+        av_sum < tgt,
+        av_sum * WEIGHT_UNIT + sc_sum // jnp.maximum(value64, 1),
+        tgt * WEIGHT_UNIT + sc_at_k // denom,
+    )
+    dup_ok = f & (av >= replicas[:, None])
+    Pdup = excl(dup_ok.astype(jnp.int64))
+    # dup aggregates are order-free — sum over the UNSORTED segmented
+    # columns works because segments are contiguous pre-sort too
+    cnt = segsum(Pdup)
+    Pscd = excl(jnp.where(dup_ok, sc, 0))
+    sc_dup = segsum(Pscd)
     w_dup = jnp.where(cnt > 0, cnt * WEIGHT_UNIT + sc_dup // jnp.maximum(cnt, 1), 0)
 
     weight = jnp.where(duplicated[:, None], w_dup, w_div)

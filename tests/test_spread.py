@@ -448,3 +448,125 @@ def test_device_combo_select_matches_host():
         np.testing.assert_array_equal(host.chosen, dev.chosen)
         assert host.errors == dev.errors
         assert sorted(host.fallback) == sorted(dev.fallback)
+
+
+class TestSegmentedGroupScore:
+    """group_score_kernel_segmented is the skew-proof twin of the padded-grid
+    kernel — bit-identical outputs on any fleet, and the batched path must
+    keep using it end-to-end when the grid would blow the balance guard."""
+
+    @pytest.mark.parametrize("seed", [0, 1, 2])
+    @pytest.mark.parametrize("skewed", [False, True])
+    def test_kernel_parity_with_grid(self, seed, skewed):
+        import numpy as np
+
+        from karmada_tpu.sched import spread_batch
+
+        nrng = np.random.default_rng(seed)
+        if skewed:  # raw-output parity on the very layout segmented exists for
+            clusters = self._skewed_fleet(n=120, seed=seed)
+        else:
+            clusters = synthetic_fleet(37, seed=seed, ready_fraction=0.9)
+        sched = ArrayScheduler(clusters)
+        layout = sched._spread_layout
+        C = len(clusters)
+        S = 12
+        feasible = nrng.random((S, C)) < 0.8
+        score = nrng.integers(0, 101, (S, C)).astype(np.int32)
+        avail = nrng.integers(0, 50, (S, C)).astype(np.int32)
+        prev = nrng.integers(0, 4, (S, C)).astype(np.int32)
+        reps = nrng.integers(1, 40, S).astype(np.int64)
+        need = nrng.integers(1, 4, S).astype(np.int64)
+        target = nrng.integers(1, 30, S).astype(np.int64)
+        dupf = nrng.random(S) < 0.5
+
+        a = spread_batch.group_score_kernel(
+            feasible, score, avail, prev, reps, need, target, dupf,
+            layout=layout,
+        )
+        b = spread_batch.group_score_kernel_segmented(
+            feasible, score, avail, prev, reps, need, target, dupf,
+            layout=layout,
+        )
+        for name, x, y in zip(("weight", "value", "av_sum", "fc"), a, b):
+            np.testing.assert_array_equal(
+                np.asarray(x), np.asarray(y), err_msg=name
+            )
+
+    def _skewed_fleet(self, n=300, seed=5):
+        """One giant region among many tiny ones — R*W blows the grid
+        balance guard, so the batched path must take the segmented kernel."""
+        clusters = synthetic_fleet(n, seed=seed, ready_fraction=0.95)
+        for i, c in enumerate(clusters):
+            if i < n * 2 // 3:
+                c.spec.region = "mega-region"
+            else:
+                c.spec.region = f"tiny-{i % 45}"
+            c.spec.zone = f"{c.spec.region}-z0"
+        return clusters
+
+    def test_skewed_fleet_uses_batched_path(self):
+        clusters = self._skewed_fleet()
+        sched = ArrayScheduler(clusters)
+        assert not sched._spread_layout.grid_balanced  # the guard trips
+        p = Placement(
+            cluster_affinity=ClusterAffinity(),
+            spread_constraints=[SpreadConstraint(
+                spread_by_field=SPREAD_BY_FIELD_REGION, min_groups=2, max_groups=3,
+            )],
+        )
+        rb = make_binding("skew", 4, p, cpu=0.5)
+        batched, _, fallback = sched._classify_spread([rb])
+        assert batched == [0] and fallback == []
+
+    @pytest.mark.parametrize("seed", [0, 1])
+    def test_skewed_fleet_end_to_end_parity(self, seed, monkeypatch):
+        clusters = self._skewed_fleet(seed=seed + 11)
+        rng = random.Random(seed)
+        names = [c.name for c in clusters]
+        bindings = []
+        for i in range(16):
+            rmin = rng.randrange(1, 4)
+            cons = [SpreadConstraint(
+                spread_by_field=SPREAD_BY_FIELD_REGION,
+                min_groups=rmin, max_groups=rng.choice([0, rmin, rmin + 2]),
+            )]
+            dup = rng.random() < 0.5
+            if dup:
+                p = Placement(cluster_affinity=ClusterAffinity(),
+                              spread_constraints=cons)
+            else:
+                p = Placement(
+                    cluster_affinity=ClusterAffinity(),
+                    spread_constraints=cons,
+                    replica_scheduling=ReplicaSchedulingStrategy(
+                        replica_scheduling_type=REPLICA_SCHEDULING_DIVIDED,
+                        replica_division_preference="Aggregated",
+                    ),
+                )
+            prev = {}
+            if rng.random() < 0.3:
+                for nme in rng.sample(names, 2):
+                    prev[nme] = rng.randrange(1, 4)
+            bindings.append(
+                make_binding(f"sk-{i}", rng.randrange(1, 60), p,
+                             cpu=rng.choice([0.5, 1.0]), prev=prev)
+            )
+
+        sched = ArrayScheduler(clusters)
+        got = sched.schedule(bindings)
+
+        from karmada_tpu.sched import spread_batch
+
+        monkeypatch.setattr(spread_batch, "config_of", lambda p: None)
+        sched2 = ArrayScheduler(clusters)
+        want = sched2.schedule(bindings)
+
+        for rb, g, w in zip(bindings, got, want):
+            assert g.ok == w.ok, f"{rb.name}: {g.error!r} vs {w.error!r}"
+            if not g.ok:
+                assert g.error == w.error, rb.name
+                continue
+            gt = {t.name: t.replicas for t in g.targets}
+            wt = {t.name: t.replicas for t in w.targets}
+            assert gt == wt, f"{rb.name}: batched {gt} != exact {wt}"
