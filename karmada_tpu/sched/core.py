@@ -35,6 +35,7 @@ from ..models.batch import (
 from ..models.fleet import FleetArrays, FleetEncoder
 from ..ops import assign as assign_ops
 from ..ops import filters as filter_ops
+from . import plugins as plugin_mod
 
 # compact-output width: covers every row whose target count is <= this
 # (divided rows are bounded by spec.replicas; wider duplicated rows fetch
@@ -122,20 +123,46 @@ def filter_estimate_phase(
     tol_key, tol_value, tol_effect, tol_op,
     affinity_ok, eviction_ok, prev_member,
     req_unique=None, req_idx=None,
+    plugin_bits: int = plugin_mod.ALL_PLUGIN_BITS,
+    extra_mask=None, extra_score=None,
 ):
     """Filters + score + GeneralEstimator — elementwise over (B, C), so the
     mesh path runs it on local (B_l, C_l) tiles before any collective.
 
+    plugin_bits statically selects which fused in-tree plugin terms compile
+    in (`--plugins` disable, sched/plugins.py); extra_mask/extra_score are
+    the out-of-tree plugins' host-computed contributions.
+
     Requests naming resources outside the encoded vocabulary behave like a
     missing allocatable key: 0 available everywhere (general.go:166-169)."""
-    taint_mask = filter_ops.taint_toleration_mask(
-        taint_key, taint_value, taint_effect, tol_key, tol_value, tol_effect, tol_op
+    ones = jnp.ones_like(affinity_ok)
+    taint_mask = (
+        filter_ops.taint_toleration_mask(
+            taint_key, taint_value, taint_effect,
+            tol_key, tol_value, tol_effect, tol_op,
+        )
+        if plugin_bits & plugin_mod.BIT_TAINT
+        else ones
     )
-    api_mask = filter_ops.api_enablement_mask(api_ok, gvk)
+    api_mask = (
+        filter_ops.api_enablement_mask(api_ok, gvk)
+        if plugin_bits & plugin_mod.BIT_API
+        else ones
+    )
     feasible = filter_ops.feasible_mask(
-        alive, api_mask, taint_mask, jnp.ones_like(affinity_ok), affinity_ok, eviction_ok
+        alive, api_mask, taint_mask, ones,
+        affinity_ok if plugin_bits & plugin_mod.BIT_AFFINITY else ones,
+        eviction_ok if plugin_bits & plugin_mod.BIT_EVICTION else ones,
     )
-    score = filter_ops.locality_score(prev_member)
+    if extra_mask is not None:
+        feasible = feasible & jnp.broadcast_to(extra_mask, feasible.shape)
+    score = (
+        filter_ops.locality_score(prev_member)
+        if plugin_bits & plugin_mod.BIT_LOCALITY
+        else jnp.zeros(feasible.shape, jnp.int32)
+    )
+    if extra_score is not None:
+        score = score + jnp.broadcast_to(extra_score, score.shape)
     if req_unique is not None:
         # requests dedup to the policy set: the [.,C,R] divisions run per
         # DISTINCT vector; rows gather (bit-exact with the dense form)
@@ -217,6 +244,9 @@ def _schedule_body(
     has_agg: bool = True,
     req_unique=None,
     req_idx=None,
+    plugin_bits: int = plugin_mod.ALL_PLUGIN_BITS,
+    extra_mask=None,
+    extra_score=None,
 ):
     feasible, score, avail = filter_estimate_phase(
         alive, capacity, has_summary, taint_key, taint_value, taint_effect, api_ok,
@@ -224,6 +254,8 @@ def _schedule_body(
         tol_key, tol_value, tol_effect, tol_op,
         affinity_ok, eviction_ok, prev_member,
         req_unique=req_unique, req_idx=req_idx,
+        plugin_bits=plugin_bits,
+        extra_mask=extra_mask, extra_score=extra_score,
     )
     # min-merge with registered estimators (-1 sentinel discarded,
     # core/util.go:72-92); gRPC/node-level answers tighten the general bound
@@ -302,7 +334,7 @@ def decompress_batch(
     return affinity_ok, static_weight, prev_member, prev_replicas, eviction_ok, tie
 
 
-@partial(jax.jit, static_argnames=("topk", "narrow", "has_agg"))
+@partial(jax.jit, static_argnames=("topk", "narrow", "has_agg", "plugin_bits"))
 def _schedule_kernel_compact(
     # fleet (device-resident)
     alive, capacity, has_summary, taint_key, taint_value, taint_effect, api_ok,
@@ -316,9 +348,11 @@ def _schedule_kernel_compact(
     prev_idx, prev_rep, evict_idx, seeds,
     req_unique, req_idx,  # deduped request vectors (policy-level)
     extra_avail,  # i32[B,C] or broadcastable [1,1] sentinel
+    extra_mask=None, extra_score=None,  # out-of-tree plugin terms
     topk: int = TOPK_TARGETS,
     narrow: bool = False,
     has_agg: bool = True,
+    plugin_bits: int = plugin_mod.ALL_PLUGIN_BITS,
 ):
     """Decompress the factored batch on device, then run the solve.
 
@@ -344,6 +378,8 @@ def _schedule_kernel_compact(
         affinity_ok, eviction_ok, static_weight, prev_member, prev_replicas, tie,
         extra, narrow=narrow, has_agg=has_agg,
         req_unique=req_unique, req_idx=req_idx,
+        plugin_bits=plugin_bits,
+        extra_mask=extra_mask, extra_score=extra_score,
     )
     feas_count, nnz, top_idx, top_val = compact_outputs(
         feasible, result, min(C, topk)
@@ -354,7 +390,7 @@ def _schedule_kernel_compact(
     )
 
 
-@jax.jit
+@partial(jax.jit, static_argnames=("plugin_bits",))
 def _filter_kernel_compact(
     # fleet (device-resident)
     alive, capacity, has_summary, taint_key, taint_value, taint_effect, api_ok,
@@ -366,6 +402,8 @@ def _filter_kernel_compact(
     aff_masks, aff_idx, prev_idx, prev_rep, evict_idx, seeds,
     req_unique, req_idx,
     extra_avail,
+    extra_mask, extra_score,  # out-of-tree plugin terms ([1,1] sentinels)
+    plugin_bits: int = plugin_mod.ALL_PLUGIN_BITS,
 ):
     """Filter + estimate ONLY — phase 1 of the partitioned schedule round.
     The division tail runs separately on just the rows that need it
@@ -394,6 +432,8 @@ def _filter_kernel_compact(
         tol_key, tol_value, tol_effect, tol_op,
         affinity_ok, eviction_ok, prev_member,
         req_unique=req_unique, req_idx=req_idx,
+        plugin_bits=plugin_bits,
+        extra_mask=extra_mask, extra_score=extra_score,
     )
     extra = jnp.broadcast_to(extra_avail, (B, C))
     avail = jnp.where(extra >= 0, jnp.minimum(avail, extra), avail)
@@ -548,12 +588,27 @@ class ArrayScheduler:
         clusters: Sequence,
         encoder: Optional[FleetEncoder] = None,
         mesh=None,
+        plugins: Optional[Sequence[str]] = None,
+        plugin_registry=None,
     ):
         """`mesh`: optional jax.sharding.Mesh — the solve runs column/row-
-        sharded over it (parallel/mesh.py) with identical outputs."""
+        sharded over it (parallel/mesh.py) with identical outputs.
+        `plugins`: the `--plugins` enable/disable list (default ["*"]);
+        `plugin_registry`: out-of-tree plugins (sched/plugins.py)."""
         self.encoder = encoder or FleetEncoder()
         self.mesh = mesh
         self._mesh_kernel = None
+        self.plugin_registry = plugin_registry or plugin_mod.PluginRegistry()
+        self.enabled_plugins = self.plugin_registry.filter(plugins)
+        self._plugin_bits = plugin_mod.plugin_bits(self.enabled_plugins)
+        self._oot_plugins = self.plugin_registry.out_of_tree(self.enabled_plugins)
+        if mesh is not None and (
+            self._plugin_bits != plugin_mod.ALL_PLUGIN_BITS or self._oot_plugins
+        ):
+            raise ValueError(
+                "plugin disable / out-of-tree plugins are not supported on "
+                "the mesh path yet"
+            )
         self.set_clusters(clusters)
 
     def set_clusters(self, clusters: Sequence) -> None:
@@ -652,6 +707,27 @@ class ArrayScheduler:
         )
 
     _NO_EXTRA = np.full((1, 1), -1, np.int32)  # broadcast sentinel
+    _NO_MASK = np.ones((1, 1), bool)
+    _NO_SCORE = np.zeros((1, 1), np.int32)
+
+    def _plugin_terms(self, bindings, padded_B: int):
+        """Out-of-tree plugins' host-computed [B,C] mask/score terms
+        (scheduler.go:241-244 out-of-tree registry merge); broadcastable
+        sentinels when none are registered. Padding rows stay all-feasible /
+        zero-score — they are never decoded."""
+        if not self._oot_plugins:
+            return self._NO_MASK, self._NO_SCORE
+        names = self.fleet.names
+        C = len(names)
+        n = len(bindings)
+        mask = np.ones((padded_B, C), bool)
+        score = np.zeros((padded_B, C), np.int32)
+        for p in self._oot_plugins:
+            if hasattr(p, "mask"):
+                mask[:n] &= np.asarray(p.mask(bindings, names), bool)
+            if hasattr(p, "score"):
+                score[:n] += np.asarray(p.score(bindings, names), np.int32)
+        return mask, score
 
     def _batch_flags(self, batch: BindingBatch) -> tuple[int, bool, bool]:
         """Host-derived static kernel specializations (cheap numpy passes
@@ -692,11 +768,18 @@ class ArrayScheduler:
         topk = pow2_bucket(min(cand, TOPK_TARGETS), lo=8)
         return min(topk, TOPK_TARGETS), narrow, has_agg
 
-    def run_kernel(self, batch: BindingBatch, extra_avail=None):
+    def run_kernel(
+        self, batch: BindingBatch, extra_avail=None,
+        extra_mask=None, extra_score=None,
+    ):
         if self._mesh_kernel is not None:
             return self._mesh_kernel(batch, extra_avail)
         if extra_avail is None:
             extra_avail = self._NO_EXTRA
+        if extra_mask is None:
+            extra_mask = self._NO_MASK
+        if extra_score is None:
+            extra_score = self._NO_SCORE
         topk, narrow, has_agg = self._batch_flags(batch)
         return _schedule_kernel_compact(
             *self._fleet_dev,
@@ -718,9 +801,12 @@ class ArrayScheduler:
             batch.req_unique,
             batch.req_idx,
             extra_avail,
+            extra_mask,
+            extra_score,
             topk=topk,
             narrow=narrow,
             has_agg=has_agg,
+            plugin_bits=self._plugin_bits,
         )
 
     def schedule(self, bindings: Sequence, extra_avail=None) -> list[ScheduleDecision]:
@@ -878,6 +964,9 @@ class ArrayScheduler:
             pad = len(batch.replicas) - len(extra_avail)
             extra_avail = np.pad(extra_avail, [(0, pad), (0, 0)], constant_values=-1)
 
+        extra_mask, extra_score = self._plugin_terms(
+            bindings, len(batch.replicas)
+        )
         dev_feasible, dev_score, dev_avail, dev_prev, dev_tie, dev_fc = (
             _filter_kernel_compact(
                 *self._fleet_dev,
@@ -887,6 +976,8 @@ class ArrayScheduler:
                 batch.prev_idx, batch.prev_rep, batch.evict_idx, batch.seeds,
                 batch.req_unique, batch.req_idx,
                 self._NO_EXTRA if extra_avail is None else extra_avail,
+                extra_mask, extra_score,
+                plugin_bits=self._plugin_bits,
             )
         )
         unsched = np.zeros(n_real, bool)
@@ -1265,6 +1356,12 @@ class ArrayScheduler:
             f_score = fetch_rows(dev_score, fallback_rows, self._bucket)
             f_avail = fetch_rows(dev_avail, fallback_rows, self._bucket)
             sub_affinity = raw.affinity_ok.copy()
+            # with ClusterAffinity disabled the kernel substitutes ones for
+            # the affinity table, so the spread selection must ride the
+            # extra_mask channel instead (it is a SelectClusters restriction,
+            # not an affinity-plugin term)
+            affinity_on = bool(self._plugin_bits & plugin_mod.BIT_AFFINITY)
+            sel_of: dict[int, np.ndarray] = {}
             live_rows = []
             for k, b in enumerate(fallback_rows):
                 if not f_feas[k].any():
@@ -1289,7 +1386,10 @@ class ArrayScheduler:
                     continue
                 mask = np.zeros(C, bool)
                 mask[selected_idx] = True
-                sub_affinity[b] &= mask
+                if affinity_on:
+                    sub_affinity[b] &= mask
+                else:
+                    sel_of[b] = mask
                 live_rows.append(b)
             if live_rows:
                 sub = _restrict_rows(raw, live_rows, sub_affinity)
@@ -1302,7 +1402,20 @@ class ArrayScheduler:
                         sub_extra = np.pad(
                             sub_extra, [(0, pad), (0, 0)], constant_values=-1
                         )
-                s_out = self.run_kernel(sub_batch, sub_extra)
+                s_mask, s_score = self._plugin_terms(
+                    [bindings[b] for b in live_rows], len(sub_batch.replicas)
+                )
+                if sel_of:
+                    if s_mask.shape == (1, 1):
+                        s_mask = np.ones(
+                            (len(sub_batch.replicas), C), bool
+                        )
+                    for j, b in enumerate(live_rows):
+                        if b in sel_of:
+                            s_mask[j] &= sel_of[b]
+                s_out = self.run_kernel(
+                    sub_batch, sub_extra, extra_mask=s_mask, extra_score=s_score
+                )
                 s_feas, s_result, s_unsched, s_avail_sum = jax.device_get(
                     (s_out[0], s_out[2], s_out[3], s_out[4])
                 )
@@ -1342,7 +1455,12 @@ class ArrayScheduler:
             pad = len(batch.replicas) - len(extra_avail)
             extra_avail = np.pad(extra_avail, [(0, pad), (0, 0)], constant_values=-1)
 
-        out = self.run_kernel(batch, extra_avail)
+        extra_mask, extra_score = self._plugin_terms(
+            bindings, len(batch.replicas)
+        )
+        out = self.run_kernel(
+            batch, extra_avail, extra_mask=extra_mask, extra_score=extra_score
+        )
         dev_feasible, dev_score, dev_result, dev_avail = (
             out[0], out[1], out[2], out[5],
         )

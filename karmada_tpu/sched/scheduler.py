@@ -58,12 +58,16 @@ class SchedulerDaemon:
         estimator_registry=None,
         gates: Optional[FeatureGates] = None,
         event_recorder=None,
+        plugins=None,  # the --plugins list: "*" / "foo" / "-foo"
+        plugin_registry=None,  # out-of-tree plugins (WithOutOfTreeRegistry)
     ) -> None:
         self.store = store
         self.clock = runtime.clock
         self.scheduler_name = scheduler_name
         self.estimator_registry = estimator_registry
         self.event_recorder = event_recorder
+        self.plugins = plugins
+        self.plugin_registry = plugin_registry
         self._array: Optional[ArrayScheduler] = None
         self._fleet_dirty = True
         self.controller = runtime.register(
@@ -133,7 +137,11 @@ class SchedulerDaemon:
             clusters = self.store.list("Cluster")
             clusters.sort(key=lambda c: c.name)
             if self._array is None:
-                self._array = ArrayScheduler(clusters)
+                self._array = ArrayScheduler(
+                    clusters,
+                    plugins=self.plugins,
+                    plugin_registry=self.plugin_registry,
+                )
             else:
                 self._array.set_clusters(clusters)
             self._fleet_dirty = False

@@ -124,20 +124,14 @@ class RegionLayout:
         )
         # skew guard: the padded grid holds R x W elements vs the C the
         # per-slice form touched — one giant region among many tiny ones
-        # would multiply group-scoring memory ~R-fold. Such fleets route to
-        # the per-row exact path instead (ArrayScheduler._classify_spread).
+        # would multiply group-scoring memory ~R-fold. Such fleets score via
+        # group_score_kernel_segmented instead, so the grid arrays build
+        # LAZILY (an unbalanced fleet never pays the R x W allocation).
         self.grid_balanced = (
             self.n_regions * max(self.grid_width, 1) <= max(4 * C, 1024)
         )
-        self.grid_idx = np.zeros((self.n_regions, max(self.grid_width, 1)), np.int32)
-        self.grid_valid = np.zeros_like(self.grid_idx, dtype=bool)
-        for r, (s, e) in enumerate(self.slices):
-            w = e - s
-            self.grid_idx[r, :w] = self.perm[s:e]
-            self.grid_valid[r, :w] = True
-        self.grid_name_rank = np.where(
-            self.grid_valid, name_rank[self.grid_idx], np.iinfo(np.int32).max
-        ).astype(np.int32)
+        self._name_rank = name_rank
+        self._grid = None
         # segmented layout (skew-proof twin of the grid): the permuted
         # columns whose region is real are contiguous per region, so group
         # reductions are prefix-sum differences at STATIC offsets — memory
@@ -158,6 +152,33 @@ class RegionLayout:
         self.rname_rank = np.empty(self.n_regions, np.int64)
         self.rname_rank[names_idx] = np.arange(self.n_regions)
 
+    def _build_grid(self):
+        if self._grid is None:
+            grid_idx = np.zeros(
+                (self.n_regions, max(self.grid_width, 1)), np.int32
+            )
+            grid_valid = np.zeros_like(grid_idx, dtype=bool)
+            for r, (s, e) in enumerate(self.slices):
+                w = e - s
+                grid_idx[r, :w] = self.perm[s:e]
+                grid_valid[r, :w] = True
+            grid_name_rank = np.where(
+                grid_valid, self._name_rank[grid_idx], np.iinfo(np.int32).max
+            ).astype(np.int32)
+            self._grid = (grid_idx, grid_valid, grid_name_rank)
+        return self._grid
+
+    @property
+    def grid_idx(self) -> np.ndarray:
+        return self._build_grid()[0]
+
+    @property
+    def grid_valid(self) -> np.ndarray:
+        return self._build_grid()[1]
+
+    @property
+    def grid_name_rank(self) -> np.ndarray:
+        return self._build_grid()[2]
 
 @partial(jax.jit, static_argnames=("layout",))
 def group_score_kernel(

@@ -1,0 +1,168 @@
+"""Scheduler plugin registry: --plugins filter semantics
+(runtime/registry.go:73-103, options.go:163) + in-tree disablement as kernel
+specializations + the out-of-tree mask/score seam."""
+import numpy as np
+import pytest
+
+from karmada_tpu.api.cluster import Taint, EFFECT_NO_SCHEDULE
+from karmada_tpu.api.meta import CPU
+from karmada_tpu.api.policy import ClusterAffinity, Placement
+from karmada_tpu.sched import plugins as P
+from karmada_tpu.sched.core import ArrayScheduler
+from karmada_tpu.testing.fixtures import new_cluster, synthetic_fleet
+
+from test_scheduler_core import make_binding, targets_dict  # shared helpers
+
+
+class TestRegistryFilter:
+    def test_star_enables_all(self):
+        r = P.PluginRegistry()
+        assert r.filter(["*"]) == set(P.IN_TREE)
+        assert r.filter(None) == set(P.IN_TREE)
+
+    def test_explicit_names_only(self):
+        r = P.PluginRegistry()
+        assert r.filter(["TaintToleration"]) == {"TaintToleration"}
+
+    def test_star_minus_disables(self):
+        r = P.PluginRegistry()
+        got = r.filter(["*", "-TaintToleration"])
+        assert got == set(P.IN_TREE) - {"TaintToleration"}
+        # '-foo,*' order also works (registry.go:94-99)
+        assert r.filter(["-TaintToleration", "*"]) == got
+
+    def test_out_of_tree_register_merge(self):
+        r = P.PluginRegistry()
+
+        class Foo(P.FilterPlugin):
+            name = "Foo"
+
+        r.register(Foo())
+        assert "Foo" in r.factory_names()
+        assert "Foo" in r.filter(["*"])
+        with pytest.raises(ValueError):
+            r.register(Foo())  # duplicate (registry.go:40-44)
+        r.unregister("Foo")
+        with pytest.raises(ValueError):
+            r.unregister("Foo")
+
+
+class TestInTreeDisable:
+    def _fleet(self):
+        clusters = synthetic_fleet(6, seed=2)
+        # taint cluster 0 with no toleration anywhere
+        clusters[0].spec.taints = [
+            Taint(key="maintenance", value="true", effect=EFFECT_NO_SCHEDULE)
+        ]
+        return clusters
+
+    def test_disable_taint_toleration(self):
+        clusters = self._fleet()
+        names = [c.name for c in clusters]
+        p = Placement(cluster_affinity=ClusterAffinity(cluster_names=[]))
+        rb = make_binding("app", 2, p)
+
+        on = ArrayScheduler(clusters)
+        t_on = targets_dict(on.schedule([rb])[0])
+        assert names[0] not in t_on  # tainted cluster filtered
+
+        off = ArrayScheduler(clusters, plugins=["*", "-TaintToleration"])
+        t_off = targets_dict(off.schedule([rb])[0])
+        assert names[0] in t_off  # filter term compiled out
+
+    def test_disable_cluster_affinity(self):
+        clusters = self._fleet()
+        names = [c.name for c in clusters]
+        p = Placement(cluster_affinity=ClusterAffinity(cluster_names=[names[1]]))
+        rb = make_binding("app", 2, p)
+        off = ArrayScheduler(clusters, plugins=["*", "-ClusterAffinity"])
+        t = targets_dict(off.schedule([rb])[0])
+        assert len(t) > 1  # affinity restriction ignored
+
+    def test_mesh_rejects_plugin_config(self):
+        clusters = self._fleet()
+        with pytest.raises(ValueError):
+            ArrayScheduler(
+                clusters, mesh=object(), plugins=["*", "-TaintToleration"]
+            )
+
+
+class TestOutOfTreeSeam:
+    def test_filter_and_score_plugins_apply(self):
+        clusters = synthetic_fleet(5, seed=4)
+        names = [c.name for c in clusters]
+
+        class BanFirst(P.FilterPlugin):
+            name = "BanFirst"
+
+            def mask(self, bindings, cluster_names):
+                m = np.ones((len(bindings), len(cluster_names)), bool)
+                m[:, 0] = False
+                return m
+
+        reg = P.PluginRegistry()
+        reg.register(BanFirst())
+        sched = ArrayScheduler(clusters, plugin_registry=reg)
+        p = Placement(cluster_affinity=ClusterAffinity(cluster_names=[]))
+        rb = make_binding("app", 2, p)
+        d = sched.schedule([rb])[0]
+        t = targets_dict(d)
+        assert names[0] not in t
+        assert names[0] not in d.feasible
+
+    def test_disabled_out_of_tree_plugin_is_inert(self):
+        clusters = synthetic_fleet(5, seed=4)
+        names = [c.name for c in clusters]
+
+        class BanFirst(P.FilterPlugin):
+            name = "BanFirst"
+
+            def mask(self, bindings, cluster_names):
+                m = np.ones((len(bindings), len(cluster_names)), bool)
+                m[:, 0] = False
+                return m
+
+        reg = P.PluginRegistry()
+        reg.register(BanFirst())
+        sched = ArrayScheduler(
+            clusters, plugins=["*", "-BanFirst"], plugin_registry=reg
+        )
+        p = Placement(cluster_affinity=ClusterAffinity(cluster_names=[]))
+        rb = make_binding("app", 2, p)
+        assert names[0] in targets_dict(sched.schedule([rb])[0])
+
+
+class TestSpreadInteraction:
+    def test_spread_fallback_honors_selection_with_affinity_disabled(self):
+        """The per-row exact spread selection is a SelectClusters restriction,
+        not an affinity-plugin term — it must survive '-ClusterAffinity'
+        (it rides the extra_mask channel in that configuration)."""
+        from karmada_tpu.api.policy import (
+            SPREAD_BY_FIELD_CLUSTER,
+            SPREAD_BY_FIELD_REGION,
+            SpreadConstraint,
+        )
+
+        clusters = synthetic_fleet(20, seed=9)
+        p = Placement(
+            cluster_affinity=ClusterAffinity(),
+            spread_constraints=[
+                SpreadConstraint(spread_by_field=SPREAD_BY_FIELD_REGION,
+                                 min_groups=2, max_groups=0),
+                SpreadConstraint(spread_by_field=SPREAD_BY_FIELD_CLUSTER,
+                                 min_groups=2, max_groups=3),
+            ],
+        )
+        rb = make_binding("capped", 4, p, cpu=0.5)
+
+        base = ArrayScheduler(clusters)
+        want = targets_dict(base.schedule([rb])[0])
+
+        off = ArrayScheduler(clusters, plugins=["*", "-ClusterAffinity"])
+        batched, _, fallback = off._classify_spread([rb])
+        assert fallback == [0]  # the cluster cap routes to the exact path
+        got = targets_dict(off.schedule([rb])[0])
+        # the placement has an empty affinity, so disabling the plugin must
+        # not change the outcome — and must NOT leak beyond the selection
+        assert got == want
+        assert len(got) <= 3
