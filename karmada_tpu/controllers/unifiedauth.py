@@ -15,15 +15,23 @@ UNIFIED_AUTH_WORK_LABEL = "unifiedauth.karmada.io/managed"
 
 
 class UnifiedAuthController:
-    def __init__(self, store: Store, runtime: Runtime):
+    def __init__(self, store: Store, runtime: Runtime, sync_enabled: bool = True):
+        """sync_enabled=False (the --controllers '-unifiedAuth' case): the
+        grant list still exists and the proxy still ENFORCES it — what stops
+        is the RBAC propagation to members. Disabling a sync controller must
+        never fail authorization open."""
         self.store = store
         # subjects granted cluster-proxy access (the reference derives these
         # from ClusterRoles referencing clusters/proxy; settable via CLI/API)
         self.subjects: list[dict] = []
-        self.controller = runtime.register(
-            Controller(name="unifiedauth", reconcile=self._reconcile)
-        )
-        store.watch("Cluster", self._on_cluster)
+        self.sync_enabled = sync_enabled
+        if sync_enabled:
+            self.controller = runtime.register(
+                Controller(name="unifiedauth", reconcile=self._reconcile)
+            )
+            store.watch("Cluster", self._on_cluster)
+        else:
+            self.controller = None
 
     def _on_cluster(self, event: str, cluster) -> None:
         if event == DELETED:
@@ -36,6 +44,8 @@ class UnifiedAuthController:
         subject = {"kind": kind, "name": name}
         if subject not in self.subjects:
             self.subjects.append(subject)
+        if self.controller is None:
+            return
         for cluster in self.store.list("Cluster"):
             self.controller.enqueue(cluster.metadata.name)
 

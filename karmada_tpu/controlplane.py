@@ -91,6 +91,41 @@ DEFAULT_API_ENABLEMENTS = [
     APIEnablement(group_version="batch/v1", resources=["Job"]),
 ]
 
+# the --controllers surface (cmd/controller-manager): names mirror the
+# reference's registration map (controllermanager.go:222-248); two are off
+# unless explicitly named (controllermanager.go:220)
+CONTROLLERS_DISABLED_BY_DEFAULT = frozenset(
+    {"hpaScaleTargetMarker", "deploymentReplicasSyncer"}
+)
+CONTROLLER_NAMES = (
+    "binding", "bindingStatus", "execution", "workStatus", "namespace",
+    "serviceExport", "unifiedAuth", "federatedResourceQuotaSync",
+    "federatedResourceQuotaStatus", "gracefulEviction", "applicationFailover",
+    "federatedHorizontalPodAutoscaler", "cronFederatedHorizontalPodAutoscaler",
+    "hpaScaleTargetMarker", "deploymentReplicasSyncer", "multiclusterservice",
+    "remedy", "workloadRebalancer",
+)
+
+
+def is_controller_enabled(
+    name: str,
+    controllers: list,
+    disabled_by_default: frozenset = CONTROLLERS_DISABLED_BY_DEFAULT,
+) -> bool:
+    """context.go IsControllerEnabled (:116-137): explicit name wins, then
+    explicit '-name', then '*' (minus the disabled-by-default set)."""
+    has_star = False
+    for ctrl in controllers:
+        if ctrl == name:
+            return True
+        if ctrl == "-" + name:
+            return False
+        if ctrl == "*":
+            has_star = True
+    if not has_star:
+        return False
+    return name not in disabled_by_default
+
 
 class ControlPlane:
     def __init__(
@@ -99,7 +134,28 @@ class ControlPlane:
         gates: Optional[FeatureGates] = None,
         cluster_failure_threshold: float = 30.0,
         cluster_success_threshold: float = 30.0,
+        controllers: Optional[list] = None,
     ):
+        """`controllers`: the --controllers enable/disable list with the
+        reference's semantics (context.go:116-137): '*' enables everything
+        not disabled by default, 'foo' force-enables, '-foo' disables.
+        Default ["*"] — hpaScaleTargetMarker and deploymentReplicasSyncer
+        stay off unless named (controllermanager.go:220)."""
+        self.controllers = list(controllers) if controllers is not None else ["*"]
+        known = set(CONTROLLER_NAMES)
+        unknown = [
+            c for c in self.controllers
+            if c != "*" and c.removeprefix("-") not in known
+        ]
+        if unknown:
+            raise ValueError(
+                f"unknown controller name(s) {unknown}; known: "
+                + ",".join(CONTROLLER_NAMES)
+            )
+
+        def ctl(name: str) -> bool:
+            return is_controller_enabled(name, self.controllers)
+
         self.store = Store()
         self.runtime = Runtime(clock=clock)
         self.gates = gates or FeatureGates()
@@ -147,11 +203,14 @@ class ControlPlane:
             self.runtime,
             override_manager=self.override_manager,
             gates=self.gates,
-        )
+        ) if ctl("binding") else None
         self.dependencies_distributor = DependenciesDistributor(
             self.store, self.interpreter, self.runtime, gates=self.gates
         )
-        self.namespace_controller = NamespaceSyncController(self.store, self.runtime)
+        self.namespace_controller = (
+            NamespaceSyncController(self.store, self.runtime)
+            if ctl("namespace") else None
+        )
         self.agents: dict[str, KarmadaAgent] = {}
         self.execution_controller = ExecutionController(
             self.store,
@@ -159,7 +218,7 @@ class ControlPlane:
             self.interpreter,
             self.runtime,
             pull_clusters=self.agents.keys(),  # live view: agents join later
-        )
+        ) if ctl("execution") else None
         # cluster CA + bootstrap tokens (cmdinit generates these; the
         # register token/CSR handshake and agent cert rotation consume them)
         self.pki = CertificateAuthority(clock=lambda: self.runtime.clock.now())
@@ -192,10 +251,14 @@ class ControlPlane:
             self.members,
             self.interpreter,
             self.runtime,
-            execution_controller=self.execution_controller.controller,
-        )
-        self.binding_status_controller = BindingStatusController(
-            self.store, self.interpreter, self.runtime
+            execution_controller=(
+                self.execution_controller.controller
+                if self.execution_controller is not None else None
+            ),
+        ) if ctl("workStatus") else None
+        self.binding_status_controller = (
+            BindingStatusController(self.store, self.interpreter, self.runtime)
+            if ctl("bindingStatus") else None
         )
         self.descheduler = Descheduler(
             self.store, self.estimator_registry, clock=self.runtime.clock
@@ -212,27 +275,42 @@ class ControlPlane:
             if self.gates.enabled(FAILOVER)
             else None
         )
-        self.application_failover_controller = ApplicationFailoverController(
-            self.store, self.runtime, gates=self.gates
+        self.application_failover_controller = (
+            ApplicationFailoverController(self.store, self.runtime, gates=self.gates)
+            if ctl("applicationFailover") else None
         )
         self.graceful_eviction_controller = (
             GracefulEvictionController(self.store, self.runtime)
-            if self.gates.enabled(GRACEFUL_EVICTION)
+            if self.gates.enabled(GRACEFUL_EVICTION) and ctl("gracefulEviction")
             else None
         )
-        self.rebalancer_controller = WorkloadRebalancerController(self.store, self.runtime)
-        self.remedy_controller = RemedyController(self.store, self.runtime)
+        self.rebalancer_controller = (
+            WorkloadRebalancerController(self.store, self.runtime)
+            if ctl("workloadRebalancer") else None
+        )
+        self.remedy_controller = (
+            RemedyController(self.store, self.runtime)
+            if ctl("remedy") else None
+        )
 
         # Query plane (Q1-Q3)
         self.resource_cache = ResourceCache(self.store, self.members)
         self.search_proxy = SearchProxy(self.resource_cache)
-        self.frq_sync_controller = FederatedResourceQuotaSyncController(
-            self.store, self.runtime
+        self.frq_sync_controller = (
+            FederatedResourceQuotaSyncController(self.store, self.runtime)
+            if ctl("federatedResourceQuotaSync") else None
         )
-        self.frq_status_controller = FederatedResourceQuotaStatusController(
-            self.store, self.members, self.runtime
+        self.frq_status_controller = (
+            FederatedResourceQuotaStatusController(
+                self.store, self.members, self.runtime
+            )
+            if ctl("federatedResourceQuotaStatus") else None
         )
-        self.unified_auth_controller = UnifiedAuthController(self.store, self.runtime)
+        # always constructed: it is the proxy's authorization source;
+        # disabling the controller only stops the RBAC sync to members
+        self.unified_auth_controller = UnifiedAuthController(
+            self.store, self.runtime, sync_enabled=ctl("unifiedAuth")
+        )
         self.cluster_proxy = ClusterProxy(
             self.store, self.members, unified_auth=self.unified_auth_controller
         )
@@ -242,23 +320,34 @@ class ControlPlane:
         self.mcs_controller = (
             MultiClusterServiceController(self.store, self.members, self.runtime)
             if self.gates.enabled(MULTI_CLUSTER_SERVICE)
+            and ctl("multiclusterservice")
             else None
         )
-        self.service_export_controller = ServiceExportController(
-            self.store, self.members, self.runtime
+        self.service_export_controller = (
+            ServiceExportController(self.store, self.members, self.runtime)
+            if ctl("serviceExport") else None
         )
 
         # Autoscaling family (A1-A4)
         self.metrics_adapter = MetricsAdapter(self.members)
-        self.federated_hpa_controller = FederatedHPAController(
-            self.store, self.metrics_adapter, self.runtime, interpreter=self.interpreter
+        self.federated_hpa_controller = (
+            FederatedHPAController(
+                self.store, self.metrics_adapter, self.runtime,
+                interpreter=self.interpreter,
+            )
+            if ctl("federatedHorizontalPodAutoscaler") else None
         )
-        self.cron_federated_hpa_controller = CronFederatedHPAController(
-            self.store, self.runtime
+        self.cron_federated_hpa_controller = (
+            CronFederatedHPAController(self.store, self.runtime)
+            if ctl("cronFederatedHorizontalPodAutoscaler") else None
         )
-        self.hpa_scale_target_marker = HPAScaleTargetMarker(self.store, self.runtime)
-        self.deployment_replicas_syncer = DeploymentReplicasSyncer(
-            self.store, self.members, self.runtime
+        self.hpa_scale_target_marker = (
+            HPAScaleTargetMarker(self.store, self.runtime)
+            if ctl("hpaScaleTargetMarker") else None
+        )
+        self.deployment_replicas_syncer = (
+            DeploymentReplicasSyncer(self.store, self.members, self.runtime)
+            if ctl("deploymentReplicasSyncer") else None
         )
 
     # -- cluster lifecycle (karmadactl join equivalent) -------------------
@@ -319,7 +408,8 @@ class ControlPlane:
         # until it holds for the failure threshold
         self.condition_cache.threshold_adjusted_ready(config.name, None, "True")
         self.store.create(cluster)
-        self.work_status_controller.watch_member(member)
+        if self.work_status_controller is not None:
+            self.work_status_controller.watch_member(member)
         if config.sync_mode == "Pull":
             # the member runs its own agent (L7): execution + lease heartbeat
             agent = KarmadaAgent(self.store, member, self.interpreter, self.runtime)
@@ -395,22 +485,29 @@ class ControlPlane:
         self.coredns_detector.tick()
         if self.taint_manager is not None:
             self.taint_manager.tick()
-        self.application_failover_controller.tick()
+        if self.application_failover_controller is not None:
+            self.application_failover_controller.tick()
         if self.graceful_eviction_controller is not None:
             self.graceful_eviction_controller.tick()
-        self.rebalancer_controller.tick()
+        if self.rebalancer_controller is not None:
+            self.rebalancer_controller.tick()
         self.descheduler.tick()
-        self.federated_hpa_controller.tick()
-        self.cron_federated_hpa_controller.tick()
-        self.deployment_replicas_syncer.sync_once()
+        if self.federated_hpa_controller is not None:
+            self.federated_hpa_controller.tick()
+        if self.cron_federated_hpa_controller is not None:
+            self.cron_federated_hpa_controller.tick()
+        if self.deployment_replicas_syncer is not None:
+            self.deployment_replicas_syncer.sync_once()
         if self.mcs_controller is not None:
             self.mcs_controller.collect_once()
-        self.service_export_controller.collect_once()
+        if self.service_export_controller is not None:
+            self.service_export_controller.collect_once()
         for agent in self.agents.values():
             agent.heartbeat()
         self.lease_detector.check()
         self.resource_cache.sweep()
-        self.frq_status_controller.collect_once()
+        if self.frq_status_controller is not None:
+            self.frq_status_controller.collect_once()
         return self.settle(max_steps)
 
     def run_descheduler(self) -> int:

@@ -29,8 +29,13 @@ from karmada_tpu.webhook import AdmissionDenied
 
 @pytest.fixture
 def cp():
-    # fixed clock at a known UTC minute boundary for cron math
-    plane = ControlPlane(clock=Clock(fixed=1_700_000_000.0))
+    # fixed clock at a known UTC minute boundary for cron math; the marker
+    # and replicas-syncer are disabled-by-default (controllermanager.go:220),
+    # so the autoscaling suite opts in by name
+    plane = ControlPlane(
+        clock=Clock(fixed=1_700_000_000.0),
+        controllers=["*", "hpaScaleTargetMarker", "deploymentReplicasSyncer"],
+    )
     plane.join_member(MemberConfig(name="m1", allocatable={"cpu": 100.0}))
     plane.join_member(MemberConfig(name="m2", allocatable={"cpu": 100.0}))
     return plane

@@ -1,0 +1,106 @@
+"""--controllers enable/disable surface (context.go:116-137,
+controllermanager.go:217-248)."""
+from karmada_tpu.api.meta import CPU, MEMORY
+from karmada_tpu.controlplane import (
+    CONTROLLER_NAMES,
+    CONTROLLERS_DISABLED_BY_DEFAULT,
+    ControlPlane,
+    is_controller_enabled,
+)
+from karmada_tpu.members.member import MemberConfig
+from karmada_tpu.testing.fixtures import (
+    duplicated_placement,
+    new_deployment,
+    new_policy,
+    selector_for,
+)
+
+GiB = 1024.0**3
+
+
+class TestIsControllerEnabled:
+    def test_star_enables_non_default_disabled(self):
+        assert is_controller_enabled("binding", ["*"])
+        assert not is_controller_enabled("hpaScaleTargetMarker", ["*"])
+
+    def test_explicit_name_wins_over_default_disable(self):
+        assert is_controller_enabled(
+            "hpaScaleTargetMarker", ["*", "hpaScaleTargetMarker"]
+        )
+
+    def test_minus_disables(self):
+        assert not is_controller_enabled("binding", ["*", "-binding"])
+
+    def test_no_star_means_nothing_on(self):
+        assert not is_controller_enabled("binding", ["execution"])
+        assert is_controller_enabled("execution", ["execution"])
+
+    def test_all_names_known(self):
+        assert CONTROLLERS_DISABLED_BY_DEFAULT <= set(CONTROLLER_NAMES)
+
+
+class TestDisabledControllerBehavior:
+    def _plane(self, controllers):
+        cp = ControlPlane(controllers=controllers)
+        cp.join_member(MemberConfig(
+            name="m1", allocatable={CPU: 16.0, MEMORY: 64 * GiB, "pods": 100.0}
+        ))
+        return cp
+
+    def test_binding_disabled_means_no_works(self):
+        cp = self._plane(["*", "-binding"])
+        d = new_deployment("default", "web", replicas=1, cpu=0.1)
+        cp.store.create(d)
+        cp.store.create(new_policy(
+            "default", "pp", [selector_for(d)], duplicated_placement([])
+        ))
+        cp.settle()
+        # detector + scheduler still run: the RB exists and is scheduled
+        rb = cp.store.get("ResourceBinding", "web-deployment", "default")
+        assert rb.spec.clusters
+        # ...but no binding controller ⇒ no Work objects materialize
+        assert not cp.store.list("Work")
+
+    def test_default_plane_unaffected(self):
+        cp = self._plane(None)
+        d = new_deployment("default", "web", replicas=1, cpu=0.1)
+        cp.store.create(d)
+        cp.store.create(new_policy(
+            "default", "pp", [selector_for(d)], duplicated_placement([])
+        ))
+        cp.settle()
+        assert cp.store.list("Work")
+        assert cp.hpa_scale_target_marker is None  # default-disabled
+        assert cp.deployment_replicas_syncer is None
+
+
+def test_unknown_controller_name_rejected():
+    import pytest
+
+    with pytest.raises(ValueError, match="unknown controller"):
+        ControlPlane(controllers=["*", "-bindng"])  # typo
+
+
+def test_unified_auth_disable_fails_closed():
+    """Disabling the unifiedAuth SYNC controller must not bypass proxy
+    authorization — only the RBAC propagation to members stops."""
+    import pytest
+
+    from karmada_tpu.proxy import ForbiddenError
+
+    cp = ControlPlane(controllers=["*", "-unifiedAuth"])
+    cp.join_member(MemberConfig(
+        name="m1", allocatable={CPU: 16.0, MEMORY: 64 * GiB, "pods": 100.0}
+    ))
+    with pytest.raises(ForbiddenError):
+        cp.cluster_proxy.request(
+            "m1", "GET", "apps/v1", "Deployment", name="x",
+            subject={"kind": "User", "name": "mallory"},
+        )
+    # grants still enforce (the data plane is alive, the sync loop is not)
+    cp.unified_auth_controller.grant("User", "alice")
+    with pytest.raises(Exception):  # object doesn't exist, but authz passed
+        cp.cluster_proxy.request(
+            "m1", "GET", "apps/v1", "Deployment", name="x",
+            subject={"kind": "User", "name": "alice"},
+        )
