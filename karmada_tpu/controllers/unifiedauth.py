@@ -24,7 +24,7 @@ class UnifiedAuthController:
         # subjects granted cluster-proxy access (the reference derives these
         # from ClusterRoles referencing clusters/proxy; settable via CLI/API)
         self.subjects: list[dict] = []
-        self.sync_enabled = sync_enabled
+        # the single gate is `self.controller is None` below
         if sync_enabled:
             self.controller = runtime.register(
                 Controller(name="unifiedauth", reconcile=self._reconcile)

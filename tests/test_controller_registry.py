@@ -97,10 +97,18 @@ def test_unified_auth_disable_fails_closed():
             "m1", "GET", "apps/v1", "Deployment", name="x",
             subject={"kind": "User", "name": "mallory"},
         )
-    # grants still enforce (the data plane is alive, the sync loop is not)
+    # grants still enforce (the data plane is alive, the sync loop is not):
+    # alice passes authorization and fails only on the missing object
+    from karmada_tpu.proxy import ProxyError
+
     cp.unified_auth_controller.grant("User", "alice")
-    with pytest.raises(Exception):  # object doesn't exist, but authz passed
+    with pytest.raises(ProxyError, match="not found"):
         cp.cluster_proxy.request(
             "m1", "GET", "apps/v1", "Deployment", name="x",
             subject={"kind": "User", "name": "alice"},
         )
+    # ...and the sync side is genuinely off: no impersonation Work was synced
+    assert not [
+        w for w in cp.store.list("Work")
+        if "impersonator" in w.metadata.name
+    ]

@@ -1,0 +1,176 @@
+"""A full control-plane lifecycle scenario in one continuous story:
+
+join (2 regions) → deploy three strategy families → steady state →
+member failure (NoExecute taint → graceful eviction → re-place) →
+recovery → template scale-up → WorkloadRebalancer fresh pass →
+unjoin → global invariants.
+
+The per-feature suites pin each subsystem in isolation; this one pins the
+CROSS-controller contracts (the reference covers the same ground with its
+kind-backed e2e suites, test/e2e/suites/base — SURVEY §4)."""
+import pytest
+
+from karmada_tpu.api.apps import (
+    RebalancerObjectReference,
+    WorkloadRebalancer,
+    WorkloadRebalancerSpec,
+)
+from karmada_tpu.api.cluster import Taint, EFFECT_NO_EXECUTE
+from karmada_tpu.api.meta import CPU, MEMORY, ObjectMeta, get_condition
+from karmada_tpu.api.work import CONDITION_FULLY_APPLIED, CONDITION_SCHEDULED
+from karmada_tpu.controlplane import ControlPlane
+from karmada_tpu.features import FAILOVER, FeatureGates
+from karmada_tpu.members.member import MemberConfig
+from karmada_tpu.runtime.controller import Clock
+from karmada_tpu.testing.fixtures import (
+    duplicated_placement,
+    new_deployment,
+    new_policy,
+    selector_for,
+    static_weight_placement,
+)
+
+from test_scheduler_core import dyn_placement
+
+GiB = 1024.0**3
+
+
+def check_works_consistent(cp: ControlPlane) -> None:
+    """Global invariant: every scheduled ResourceBinding's targets are
+    materialized on exactly those members with the revised replica counts;
+    no member runs a workload its binding no longer targets."""
+    for rb in cp.store.list("ResourceBinding"):
+        if not rb.spec.clusters:
+            continue
+        ref = rb.spec.resource
+        targets = {tc.name: tc.replicas for tc in rb.spec.clusters}
+        evicting = {t.from_cluster for t in rb.spec.graceful_eviction_tasks}
+        for name, member in cp.members.items():
+            obj = member.get(ref.api_version, ref.kind, ref.name, ref.namespace)
+            if name in targets:
+                assert obj is not None, f"{ref.name} missing on {name}"
+                if rb.spec.replicas > 0 and targets[name] > 0:
+                    assert obj.get("spec", "replicas") == targets[name], (
+                        f"{ref.name}@{name}: {obj.get('spec', 'replicas')} "
+                        f"!= {targets[name]}"
+                    )
+            elif name not in evicting:
+                assert obj is None, f"orphan {ref.name} on {name}"
+
+
+def scheduled_ok(cp, key) -> dict:
+    rb = cp.store.get("ResourceBinding", key, "default")
+    cond = get_condition(rb.status.conditions, CONDITION_SCHEDULED)
+    assert cond is not None and cond.status == "True", key
+    return {tc.name: tc.replicas for tc in rb.spec.clusters}
+
+
+def test_full_lifecycle():
+    gates = FeatureGates({FAILOVER: True})
+    cp = ControlPlane(clock=Clock(fixed=1000.0), gates=gates)
+    for i in range(6):
+        cp.join_member(MemberConfig(
+            name=f"m{i}",
+            region=f"r{i % 2}",
+            allocatable={CPU: 100.0, MEMORY: 400 * GiB, "pods": 1000.0},
+        ))
+
+    # --- deploy three strategy families ---
+    web = new_deployment("default", "web", replicas=3, cpu=0.2)
+    cp.store.create(web)
+    cp.store.create(new_policy(
+        "default", "web-pp", [selector_for(web)], duplicated_placement([])
+    ))
+    api = new_deployment("default", "api", replicas=12, cpu=0.5)
+    cp.store.create(api)
+    cp.store.create(new_policy(
+        "default", "api-pp", [selector_for(api)],
+        static_weight_placement({"m0": 2, "m1": 1, "m2": 1}),
+    ))
+    worker = new_deployment("default", "worker", replicas=8, cpu=0.25)
+    cp.store.create(worker)
+    cp.store.create(new_policy(
+        "default", "worker-pp", [selector_for(worker)], dyn_placement()
+    ))
+    cp.settle()
+
+    web_t = scheduled_ok(cp, "web-deployment")
+    assert len(web_t) == 6 and all(r == 3 for r in web_t.values())
+    api_t = scheduled_ok(cp, "api-deployment")
+    assert api_t == {"m0": 6, "m1": 3, "m2": 3}
+    worker_t = scheduled_ok(cp, "worker-deployment")
+    assert sum(worker_t.values()) == 8
+    check_works_consistent(cp)
+
+    # status aggregation closed the loop
+    rb = cp.store.get("ResourceBinding", "web-deployment", "default")
+    assert get_condition(rb.status.conditions, CONDITION_FULLY_APPLIED).status == "True"
+    tmpl = cp.store.get("apps/v1/Deployment", "web", "default")
+    assert tmpl.get("status", "readyReplicas") == 18  # 3 x 6 members
+
+    # --- member failure: NoExecute taint on m0 evicts its bindings ---
+    cp.members["m1"].set_healthy(False)  # hold assessment so we can observe
+    cp.settle()
+    cluster = cp.store.get("Cluster", "m0")
+    cluster.spec.taints.append(Taint(
+        key="node.kubernetes.io/unreachable",
+        effect=EFFECT_NO_EXECUTE,
+        time_added=cp.runtime.clock.now(),
+    ))
+    cp.store.update(cluster)
+    cp.settle()
+
+    api_t = scheduled_ok(cp, "api-deployment")
+    assert "m0" not in api_t and sum(api_t.values()) == 12
+    rb = cp.store.get("ResourceBinding", "api-deployment", "default")
+    assert [t.from_cluster for t in rb.spec.graceful_eviction_tasks] == ["m0"]
+    # the old copy keeps serving until the replacement is healthy
+    assert cp.members["m0"].get("apps/v1", "Deployment", "api", "default") is not None
+
+    # --- recovery: replacement healthy → eviction assessed away ---
+    cp.members["m1"].set_healthy(True)
+    cp.settle()
+    rb = cp.store.get("ResourceBinding", "api-deployment", "default")
+    assert not rb.spec.graceful_eviction_tasks
+    assert cp.members["m0"].get("apps/v1", "Deployment", "api", "default") is None
+    check_works_consistent(cp)
+
+    # --- template scale-up flows template → detector → scheduler → works ---
+    du = cp.store.get("apps/v1/Deployment", "worker", "default")
+    du.set("spec", "replicas", 20)
+    cp.store.update(du)
+    cp.settle()
+    worker_t = scheduled_ok(cp, "worker-deployment")
+    assert sum(worker_t.values()) == 20
+    check_works_consistent(cp)
+
+    # --- untaint + rebalancer: a Fresh pass may use m0 again ---
+    cluster = cp.store.get("Cluster", "m0")
+    cluster.spec.taints = []
+    cp.store.update(cluster)
+    cp.settle()
+    # the trigger is `rescheduleTriggeredAt > lastScheduledTime` (strict,
+    # assignment.go:110-115) — real time must pass since the last schedule
+    cp.runtime.clock.advance(1.0)
+    cp.store.create(WorkloadRebalancer(
+        metadata=ObjectMeta(name="rb-1"),
+        spec=WorkloadRebalancerSpec(workloads=[
+            RebalancerObjectReference(
+                api_version="apps/v1", kind="Deployment",
+                namespace="default", name="api",
+            ),
+        ]),
+    ))
+    cp.settle()
+    api_t = scheduled_ok(cp, "api-deployment")
+    # Fresh reassignment with the static 2:1:1 weights re-includes m0
+    assert api_t == {"m0": 6, "m1": 3, "m2": 3}
+    check_works_consistent(cp)
+
+    # --- unjoin: bindings lose the member, works are purged ---
+    cp.unjoin_member("m5")
+    cp.settle()
+    web_t = scheduled_ok(cp, "web-deployment")
+    assert "m5" not in web_t and len(web_t) == 5
+    assert "m5" not in cp.members
+    check_works_consistent(cp)
