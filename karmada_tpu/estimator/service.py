@@ -11,7 +11,6 @@ with a shared deadline, -1 sentinel on error.
 from __future__ import annotations
 
 from concurrent import futures
-from concurrent.futures import ThreadPoolExecutor
 from typing import Callable, Optional
 
 import grpc
@@ -222,12 +221,18 @@ class GrpcSchedulerEstimator:
         self.timeout = timeout
         self.client_config = client_config or INSECURE_CLIENT
         self._channels: dict[str, grpc.Channel] = {}
-        self._pool = ThreadPoolExecutor(max_workers=16)
+        # cached multicallables per address (building one per RPC costs more
+        # than the RPC itself at fan-out rates)
+        self._ma_calls: dict[str, object] = {}
+        self._un_calls: dict[str, object] = {}
 
     def _channel(self, cluster: str) -> Optional[grpc.Channel]:
         addr = self.address_for(cluster)
         if addr is None:
             return None
+        return self._channel_for(addr)
+
+    def _channel_for(self, addr: str) -> grpc.Channel:
         ch = self._channels.get(addr)
         if ch is None:
             # credential selection mirrors DialWithTimeOut (config.go:105-136)
@@ -235,60 +240,86 @@ class GrpcSchedulerEstimator:
             self._channels[addr] = ch
         return ch
 
+    def _cached_call(self, cache: dict, cluster: str, method: str,
+                     req_serializer, resp_deserializer):
+        """Cached multicallable for (address, method) — building one per RPC
+        costs more than the RPC at fan-out rates. The address resolves ONCE
+        so a resolver that turns None mid-call still yields the per-cluster
+        -1 sentinel, never an exception across the whole fan-out."""
+        addr = self.address_for(cluster)
+        if addr is None:
+            return None
+        call = cache.get(addr)
+        if call is None:
+            call = self._channel_for(addr).unary_unary(
+                method,
+                request_serializer=req_serializer,
+                response_deserializer=resp_deserializer,
+            )
+            cache[addr] = call
+        return call
+
+    def _fanout(self, clusters, call_of, request_of, extract) -> list[int]:
+        """Concurrent fan-out with a shared deadline: every RPC is issued as
+        a gRPC future before any result is awaited — the
+        goroutine-per-cluster shape of accurate.go:139-162 without a Python
+        thread per call (a 16-thread pool capped the fan-out at ~2.4k RPC/s;
+        futures ride the gRPC core's own event loop)."""
+        futs = []
+        for cluster in clusters:
+            call = call_of(cluster)
+            if call is None:
+                futs.append(None)
+                continue
+            futs.append(call.future(request_of(cluster), timeout=self.timeout))
+        out = []
+        for f in futs:
+            if f is None:
+                out.append(UNAUTHENTIC_REPLICA)
+                continue
+            try:
+                out.append(extract(f.result()))
+            except grpc.RpcError:
+                out.append(UNAUTHENTIC_REPLICA)
+        return out
+
     def max_available_replicas(self, clusters, requirements, replicas) -> list[int]:
         req_pb = requirements_to_pb(requirements)
-
-        def one(cluster: str) -> int:
-            ch = self._channel(cluster)
-            if ch is None:
-                return UNAUTHENTIC_REPLICA
-            try:
-                resp = ch.unary_unary(
-                    METHOD_MAX_AVAILABLE,
-                    request_serializer=pb.MaxAvailableReplicasRequest.SerializeToString,
-                    response_deserializer=pb.MaxAvailableReplicasResponse.FromString,
-                )(
-                    pb.MaxAvailableReplicasRequest(
-                        cluster=cluster, replicaRequirements=req_pb
-                    ),
-                    timeout=self.timeout,
-                )
-                return resp.maxReplicas
-            except grpc.RpcError:
-                return UNAUTHENTIC_REPLICA
-
-        return list(self._pool.map(one, clusters))
+        return self._fanout(
+            clusters,
+            lambda cluster: self._cached_call(
+                self._ma_calls, cluster, METHOD_MAX_AVAILABLE,
+                pb.MaxAvailableReplicasRequest.SerializeToString,
+                pb.MaxAvailableReplicasResponse.FromString,
+            ),
+            lambda cluster: pb.MaxAvailableReplicasRequest(
+                cluster=cluster, replicaRequirements=req_pb
+            ),
+            lambda resp: resp.maxReplicas,
+        )
 
     def get_unschedulable_replicas(self, clusters, resource, threshold_seconds) -> list[int]:
         """resource: api/work.ObjectReference — the full reference travels on
         the wire (a stock Go server resolves the workload via
         FromAPIVersionAndKind, server.go:255, so apiVersion is mandatory)."""
-
-        def one(cluster: str) -> int:
-            ch = self._channel(cluster)
-            if ch is None:
-                return UNAUTHENTIC_REPLICA
-            try:
-                resp = ch.unary_unary(
-                    METHOD_UNSCHEDULABLE,
-                    request_serializer=pb.UnschedulableReplicasRequest.SerializeToString,
-                    response_deserializer=pb.UnschedulableReplicasResponse.FromString,
-                )(
-                    pb.UnschedulableReplicasRequest(
-                        cluster=cluster,
-                        resource=pb.ObjectReference(
-                            apiVersion=resource.api_version,
-                            kind=resource.kind,
-                            namespace=resource.namespace,
-                            name=resource.name,
-                        ),
-                        # time.Duration: seconds → nanoseconds on the wire
-                        unschedulableThreshold=int(threshold_seconds * 1e9),
-                    ),
-                    timeout=self.timeout,
-                )
-                return resp.unschedulableReplicas
-            except grpc.RpcError:
-                return UNAUTHENTIC_REPLICA
-
-        return list(self._pool.map(one, clusters))
+        ref_pb = pb.ObjectReference(
+            apiVersion=resource.api_version,
+            kind=resource.kind,
+            namespace=resource.namespace,
+            name=resource.name,
+        )
+        return self._fanout(
+            clusters,
+            lambda cluster: self._cached_call(
+                self._un_calls, cluster, METHOD_UNSCHEDULABLE,
+                pb.UnschedulableReplicasRequest.SerializeToString,
+                pb.UnschedulableReplicasResponse.FromString,
+            ),
+            lambda cluster: pb.UnschedulableReplicasRequest(
+                cluster=cluster,
+                resource=ref_pb,
+                # time.Duration: seconds -> nanoseconds on the wire
+                unschedulableThreshold=int(threshold_seconds * 1e9),
+            ),
+            lambda resp: resp.unschedulableReplicas,
+        )

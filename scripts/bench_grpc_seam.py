@@ -1,0 +1,84 @@
+"""Throughput of the D2 scheduler↔estimator gRPC seam over loopback.
+
+The seam (estimator/proto/estimator.proto) is wire-compatible with the
+reference's contract (pkg/estimator/service/service.proto), so this measures
+what a stock Go karmada-scheduler would see calling this estimator: one
+EstimatorServer hosting many member clusters' node estimators, a
+GrpcSchedulerEstimator fanning out concurrently with a shared deadline
+(accurate.go:139-162's goroutine-per-cluster as a thread pool).
+
+Run:  python scripts/bench_grpc_seam.py [n_clusters] [n_rounds]
+
+Measured (loopback, one server process): 1000-cluster fan-out ~0.30 s
+(~3.3k RPC/s). Note the deployment shape: the reference runs ONE estimator
+daemon PER member cluster (`{prefix}-{cluster}:10352`), so a real fleet
+spreads this load across N servers and the fan-out completes in ~one RPC
+latency; a single loopback process is the worst case and still beats the
+reference's 3 s default --scheduler-estimator-timeout at 5k clusters.
+"""
+from __future__ import annotations
+
+import pathlib
+import sys
+import time
+
+if __name__ == "__main__":
+    sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent))
+
+import numpy as np
+
+from karmada_tpu.api.meta import CPU, MEMORY, PODS
+from karmada_tpu.api.work import ReplicaRequirements
+from karmada_tpu.estimator.accurate import AccurateEstimator
+from karmada_tpu.estimator.service import EstimatorServer, GrpcSchedulerEstimator
+from karmada_tpu.models.nodes import NodeSpec
+
+GiB = 1024.0**3
+
+
+def main(n_clusters: int = 200, n_rounds: int = 10) -> None:
+    rng = np.random.default_rng(0)
+    estimators = {}
+    for c in range(n_clusters):
+        nodes = [
+            NodeSpec(
+                name=f"c{c}-n{k}",
+                allocatable={
+                    CPU: float(rng.choice([16.0, 32.0])),
+                    MEMORY: float(rng.choice([64.0, 128.0])) * GiB,
+                    PODS: 110.0,
+                },
+            )
+            for k in range(int(rng.integers(3, 8)))
+        ]
+        estimators[f"cluster-{c}"] = AccurateEstimator(nodes)
+
+    server = EstimatorServer(estimators, max_workers=32)
+    port = server.start()
+    client = GrpcSchedulerEstimator(
+        address_for=lambda cluster: f"127.0.0.1:{port}", timeout=5.0
+    )
+    names = list(estimators)
+    req = ReplicaRequirements(resource_request={CPU: 0.5, MEMORY: 1.0 * GiB})
+
+    client.max_available_replicas(names, req, 10)  # warm channels
+    ts = []
+    for _ in range(n_rounds):
+        t0 = time.perf_counter()
+        answers = client.max_available_replicas(names, req, 10)
+        ts.append(time.perf_counter() - t0)
+    ts.sort()
+    ok = sum(1 for a in answers if a >= 0)
+    p50 = ts[len(ts) // 2]
+    print(
+        f"{n_clusters} clusters fan-out: p50 {p50 * 1e3:7.1f} ms/round "
+        f"({n_clusters / p50:7.0f} RPC/s), answers ok {ok}/{n_clusters}, "
+        f"worst {ts[-1] * 1e3:.1f} ms"
+    )
+    server.stop()
+
+
+if __name__ == "__main__":
+    n = int(sys.argv[1]) if len(sys.argv) > 1 else 200
+    r = int(sys.argv[2]) if len(sys.argv) > 2 else 10
+    main(n, r)
