@@ -14,6 +14,7 @@ from __future__ import annotations
 
 from typing import Optional
 
+from ..features import POLICY_PREEMPTION
 from ..api.policy import (
     ClusterPropagationPolicy,
     PropagationPolicy,
@@ -127,7 +128,7 @@ class ResourceDetector:
 
     def _resolve_claim(self, obj: Unstructured, best):
         """Claim stability + preemption (pkg/detector/preemption.go under the
-        PolicyPreemption α gate): a template already claimed by a still-
+        PropagationPolicyPreemption α gate): a template already claimed by a still-
         matching policy keeps it; a different policy takes over only when the
         gate is on, it declares `preemption: Always`, AND its explicit
         priority is strictly higher (preemption.go preemption conditions).
@@ -137,7 +138,7 @@ class ResourceDetector:
             return best
         if current.metadata.uid == best.metadata.uid:
             return best
-        preemption_on = self.gates is not None and self.gates.enabled("PolicyPreemption")
+        preemption_on = self.gates is not None and self.gates.enabled(POLICY_PREEMPTION)
         if (
             preemption_on
             and best.spec.preemption == "Always"

@@ -2,7 +2,7 @@
 
 Parity with pkg/features/features.go:33-101: same gate names, same defaults
 (Failover β off, GracefulEviction β on, PropagateDeps β on,
-CustomizedClusterResourceModeling β on, PolicyPreemption α off,
+CustomizedClusterResourceModeling β on, PropagationPolicyPreemption α off,
 MultiClusterService α off, ResourceQuotaEstimate α off,
 StatefulFailoverInjection α off, PriorityBasedScheduling α off).
 
@@ -16,7 +16,9 @@ FAILOVER = "Failover"
 GRACEFUL_EVICTION = "GracefulEviction"
 PROPAGATE_DEPS = "PropagateDeps"
 CUSTOMIZED_CLUSTER_RESOURCE_MODELING = "CustomizedClusterResourceModeling"
-POLICY_PREEMPTION = "PolicyPreemption"
+# the operator-facing gate string matches the reference exactly
+# (features.go:50: "PropagationPolicyPreemption")
+POLICY_PREEMPTION = "PropagationPolicyPreemption"
 MULTI_CLUSTER_SERVICE = "MultiClusterService"
 RESOURCE_QUOTA_ESTIMATE = "ResourceQuotaEstimate"
 STATEFUL_FAILOVER_INJECTION = "StatefulFailoverInjection"
