@@ -1,0 +1,11 @@
+"""kubectl-karmada: the kubectl plugin entry point.
+
+The reference ships the same cobra command under two binaries
+(cmd/karmadactl + cmd/kubectl-karmada — kubectl discovers plugins named
+kubectl-*); this module is that second entry: `python -m
+karmada_tpu.cli.kubectl_karmada <subcommand>` behaves exactly like
+karmadactl."""
+from .karmadactl import main
+
+if __name__ == "__main__":
+    raise SystemExit(main())
