@@ -10,6 +10,7 @@ with a shared deadline, -1 sentinel on error.
 """
 from __future__ import annotations
 
+import time
 from concurrent import futures
 from typing import Callable, Optional
 
@@ -264,14 +265,19 @@ class GrpcSchedulerEstimator:
         a gRPC future before any result is awaited — the
         goroutine-per-cluster shape of accurate.go:139-162 without a Python
         thread per call (a 16-thread pool capped the fan-out at ~2.4k RPC/s;
-        futures ride the gRPC core's own event loop)."""
+        futures ride the gRPC core's own event loop). ONE deadline covers the
+        whole fan-out — each RPC gets the time remaining from the round's
+        start, like the reference's shared context deadline, so the overall
+        wall-clock is bounded by self.timeout regardless of fleet width."""
+        deadline = time.monotonic() + self.timeout
         futs = []
         for cluster in clusters:
             call = call_of(cluster)
             if call is None:
                 futs.append(None)
                 continue
-            futs.append(call.future(request_of(cluster), timeout=self.timeout))
+            remaining = max(deadline - time.monotonic(), 0.001)
+            futs.append(call.future(request_of(cluster), timeout=remaining))
         out = []
         for f in futs:
             if f is None:

@@ -142,6 +142,12 @@ class BindingBatch:
 
     @property
     def request(self) -> np.ndarray:  # i64[B,R]
+        if self.req_unique is None or self.req_idx is None:
+            raise ValueError(
+                "BindingBatch.request needs req_unique/req_idx — hand-built "
+                "batches must carry the deduped request tables; use "
+                "BatchEncoder.encode() to construct batches"
+            )
         return self.req_unique[self.req_idx]
 
     @property

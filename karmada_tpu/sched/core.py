@@ -1029,7 +1029,14 @@ class ArrayScheduler:
             m_feas = _gather_rows_kernel(dev_feasible, mask_idx)
             pc = raw.aff_masks.sum(axis=1)
             mk = int(pc[raw.aff_idx[np.asarray(mask_rows)]].max(initial=0))
-            if 0 < mk <= TOPK_TARGETS:
+            # the popcount bound is only a bound while feasible ⊆ affinity
+            # mask; with ClusterAffinity disabled the kernel substitutes
+            # all-ones for affinity, so the index window could truncate —
+            # those batches ship complete packed masks instead
+            if (
+                self._plugin_bits & plugin_mod.BIT_AFFINITY
+                and 0 < mk <= TOPK_TARGETS
+            ):
                 mkb = pow2_bucket(mk, lo=8)
                 midx_dev = _feas_idx_kernel(
                     m_feas, min(mkb, C), narrow16=narrow16
@@ -1080,6 +1087,7 @@ class ArrayScheduler:
         # ---- decode: duplicated / non-workload target sets ----
         if mask_rows:
             packed_h, midx_h = host[2]
+            mask_overflow: list[int] = []
             for k, b in enumerate(mask_rows):
                 n = int(feas_count[b])
                 if n <= 0:
@@ -1087,6 +1095,13 @@ class ArrayScheduler:
                 strat = int(raw.strategy[b])
                 reps = 0 if strat == NON_WORKLOAD else int(bindings[b].spec.replicas)
                 if midx_h is not None:
+                    if n > midx_h.shape[1]:
+                        # feasible outran the popcount-derived window (the
+                        # invariant feasible ⊆ affinity mask failed some other
+                        # way) — mirror the tail-overflow contract and fetch
+                        # the dense row instead of silently truncating
+                        mask_overflow.append(b)
+                        continue
                     fidx = np.asarray(midx_h[k][:n], np.int64)
                     row_feas_src[b] = ("idx", names, fidx)
                     row_target_src[b] = (
@@ -1095,13 +1110,27 @@ class ArrayScheduler:
                 else:
                     row_feas_src[b] = ("mask", names, packed_h[k], C)
                     row_target_src[b] = ("mask", names, packed_h[k], C, reps)
+            if mask_overflow:
+                o_feas = fetch_rows(dev_feasible, mask_overflow, self._bucket)
+                for j, b in enumerate(mask_overflow):
+                    fidx = np.nonzero(o_feas[j])[0]
+                    strat = int(raw.strategy[b])
+                    reps = (
+                        0 if strat == NON_WORKLOAD
+                        else int(bindings[b].spec.replicas)
+                    )
+                    row_feas_src[b] = ("idx", names, fidx)
+                    row_target_src[b] = (
+                        "pairs", names, fidx,
+                        np.full(len(fidx), reps, np.int64),
+                    )
 
         self._spread_overlay(
             bindings, raw, batch, extra_avail, batched_rows, batched_cfg,
             fallback_rows, dev_feasible, dev_score, dev_avail, dev_prev,
             dev_tie, feas_count, unsched, avail_sum,
             row_err, row_target_src, row_feas_src, narrow=narrow,
-            pre=spread_pre,
+            pre=spread_pre, extra_mask=extra_mask,
         )
 
         # ---- build decisions, then unpermute ----
@@ -1122,8 +1151,16 @@ class ArrayScheduler:
                 )
             elif b in row_target_src:
                 dec._targets_src = row_target_src[b]
-            else:  # defensively unreachable: every live row has a source
-                dec.targets = []
+            else:
+                # hard invariant: every live (feasible, schedulable) row must
+                # have been given a decode source by exactly one of the
+                # phase-2 paths above — a misrouted row silently decoding to
+                # empty targets would look like a successful no-op placement
+                raise AssertionError(
+                    "schedule round produced no decode source for live row "
+                    f"{key!r} (class {int(cls[b])}, strategy "
+                    f"{int(raw.strategy[b])})"
+                )
             dec_p.append(dec)
         out: list[Optional[ScheduleDecision]] = [None] * n_real
         for j, dec in enumerate(dec_p):
@@ -1189,7 +1226,7 @@ class ArrayScheduler:
         self, bindings, raw, batch, extra_avail, batched_rows, batched_cfg,
         fallback_rows, dev_feasible, dev_score, dev_avail, dev_prev, dev_tie,
         feas_count, unsched, avail_sum, row_err, row_target_src, row_feas_src,
-        narrow: bool, pre=None,
+        narrow: bool, pre=None, extra_mask=None,
     ) -> None:
         """Spread-constrained rows: batched device path + per-row exact
         fallback. Mutates the decode overlays in place. dev_prev/dev_tie may
@@ -1259,12 +1296,25 @@ class ArrayScheduler:
                 rep_js: list[int] = []
                 rep_idx_of_j: dict[int, int] = {}
                 div_js = []
+                # the feasible row (hence the packed mask) also folds in the
+                # out-of-tree FilterPlugin masks, which are PER-ROW — fold
+                # each row's mask digest into the dedup key so rows that only
+                # differ in their out-of-tree mask never share a
+                # representative
+                oot = (
+                    extra_mask
+                    if self._oot_plugins
+                    and extra_mask is not None
+                    and extra_mask.shape != (1, 1)
+                    else None
+                )
                 for j in ok_js:
                     b = batched_rows[j]
                     k = (
                         int(raw.aff_idx[b]), int(raw.tol_idx[b]),
                         int(raw.gvk[b]), raw.evict_idx[b].tobytes(),
                         chosen[j].tobytes(),
+                        None if oot is None else np.asarray(oot[b]).tobytes(),
                     )
                     r = rep_of.get(k)
                     if r is None:
@@ -1481,6 +1531,7 @@ class ArrayScheduler:
             fallback_rows, dev_feasible, dev_score, dev_avail, None, None,
             feas_count, unsched, avail_sum,
             row_err, row_target_src, row_feas_src, narrow=narrow,
+            extra_mask=extra_mask,
         )
 
         # vectorized pair extraction for main rows

@@ -79,6 +79,23 @@ class TestInTreeDisable:
         t = targets_dict(off.schedule([rb])[0])
         assert len(t) > 1  # affinity restriction ignored
 
+    def test_disable_cluster_affinity_wide_fleet_complete_targets(self):
+        """Regression: with ClusterAffinity disabled the feasible set is NOT
+        bounded by the affinity-mask popcount, so the duplicated-row compact
+        index window (sized from that popcount) must not silently truncate —
+        a 2-name affinity over 20 clusters must still yield all 20 targets."""
+        clusters = synthetic_fleet(20, seed=7)
+        names = [c.name for c in clusters]
+        p = Placement(cluster_affinity=ClusterAffinity(cluster_names=names[:2]))
+        rb = make_binding("app", 3, p)
+        off = ArrayScheduler(clusters, plugins=["*", "-ClusterAffinity"])
+        d = off.schedule([rb])[0]
+        t = targets_dict(d)
+        assert len(t) == 20
+        assert set(t) == set(names)
+        assert all(r == 3 for r in t.values())
+        assert sorted(d.feasible) == sorted(names)
+
     def test_mesh_rejects_plugin_config(self):
         clusters = self._fleet()
         with pytest.raises(ValueError):
@@ -133,6 +150,50 @@ class TestOutOfTreeSeam:
 
 
 class TestSpreadInteraction:
+    def test_spread_dedup_respects_out_of_tree_masks(self):
+        """Regression: two batched-spread rows with identical in-tree keys
+        but different OUT-OF-TREE filter masks must not share a packed-mask
+        representative — the out-of-tree mask folds into the feasible row,
+        hence into the selection mask."""
+        from karmada_tpu.api.policy import (
+            SPREAD_BY_FIELD_REGION,
+            SpreadConstraint,
+        )
+
+        clusters = synthetic_fleet(24, seed=11)
+        names = [c.name for c in clusters]
+        n_regions = len({c.spec.region for c in clusters})
+
+        class BanPerRow(P.FilterPlugin):
+            name = "BanPerRow"
+
+            def mask(self, bindings, cluster_names):
+                m = np.ones((len(bindings), len(cluster_names)), bool)
+                for i, rb in enumerate(bindings):
+                    if rb.metadata.name == "row-b":
+                        m[i, 1] = False
+                return m
+
+        reg = P.PluginRegistry()
+        reg.register(BanPerRow())
+        # every region must be chosen for both rows so the packed masks can
+        # only differ through the out-of-tree mask itself
+        p = Placement(
+            cluster_affinity=ClusterAffinity(),
+            spread_constraints=[
+                SpreadConstraint(spread_by_field=SPREAD_BY_FIELD_REGION,
+                                 min_groups=n_regions, max_groups=0),
+            ],
+        )
+        rb_a = make_binding("row-a", 2, p)
+        rb_b = make_binding("row-b", 2, p)
+        sched = ArrayScheduler(clusters, plugin_registry=reg)
+        d_a, d_b = sched.schedule([rb_a, rb_b])
+        t_a, t_b = targets_dict(d_a), targets_dict(d_b)
+        assert names[1] in t_a
+        assert names[1] not in t_b
+        assert set(t_a) - set(t_b) == {names[1]}
+
     def test_spread_fallback_honors_selection_with_affinity_disabled(self):
         """The per-row exact spread selection is a SelectClusters restriction,
         not an affinity-plugin term — it must survive '-ClusterAffinity'

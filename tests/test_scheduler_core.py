@@ -380,3 +380,43 @@ class TestEncoderRowCache:
             assert all(sum(t.values()) == 2 for t in out)
         finally:
             BatchEncoder.MAX_REQ_ROWS = old
+
+
+class TestDecodeSourceInvariant:
+    def test_every_live_row_has_decode_source(self):
+        """core.py decode invariant: every live (feasible, schedulable) row
+        must get a decode source from exactly one phase-2 path — a misrouted
+        row now raises instead of silently decoding to empty targets."""
+        from karmada_tpu.api.policy import (
+            SPREAD_BY_FIELD_REGION,
+            SpreadConstraint,
+        )
+        from karmada_tpu.testing.fixtures import duplicated_placement
+
+        clusters = synthetic_fleet(16, seed=5)
+        names = [c.name for c in clusters]
+        spread_p = Placement(
+            cluster_affinity=ClusterAffinity(),
+            spread_constraints=[
+                SpreadConstraint(spread_by_field=SPREAD_BY_FIELD_REGION,
+                                 min_groups=1, max_groups=2)
+            ],
+        )
+        bindings = [
+            make_binding("dup", 3, duplicated_placement(names[:4])),
+            make_binding(
+                "static", 5,
+                static_weight_placement({names[0]: 1, names[1]: 2}),
+            ),
+            make_binding("dynw", 7, dyn_placement(), cpu=0.5),
+            make_binding("agg", 6, dyn_placement(aggregated=True), cpu=0.5),
+            make_binding(
+                "nonwork", 0, Placement(cluster_affinity=ClusterAffinity())
+            ),
+            make_binding("spread", 4, spread_p),
+        ]
+        sched = ArrayScheduler(clusters)
+        decisions = sched.schedule(bindings)  # raises on a source-less row
+        for d in decisions:
+            assert d.ok, d.error
+            assert d._targets_src is not None or d._targets is not None
