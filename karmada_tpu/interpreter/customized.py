@@ -8,8 +8,9 @@
   routes operations to named in-process endpoints (the stand-in for the HTTPS
   hook servers).
 - THIRDPARTY_CUSTOMIZATIONS (I3, reference
-  default/thirdparty/resourcecustomizations/): shipped script configs for
-  common CRDs, loaded below the customized tiers.
+  default/thirdparty/resourcecustomizations/): the shipped per-CRD
+  customization library (native hooks in interpreter/thirdparty.py),
+  loaded below the customized tiers.
 """
 from __future__ import annotations
 
@@ -219,69 +220,13 @@ class WebhookInterpreterManager:
 
 
 # -- I3: shipped thirdparty customizations ---------------------------------
-# (reference: default/thirdparty/resourcecustomizations/ — Lua for common
-# CRDs; the same operations expressed in the script dialect.)
+# The shipped library lives in interpreter/thirdparty.py as native hooks:
+# THIRDPARTY_CUSTOMIZATIONS maps gvk -> zero-arg KindInterpreter builder
+# (16 GVKs matching the reference's customization sets kind-for-kind), and
+# load_thirdparty_tier() instantiates the tier. Aliased here because this
+# module historically hosted the registry.
 
-THIRDPARTY_CUSTOMIZATIONS: dict[str, dict[str, str]] = {
-    # Argo Rollouts: replicas like a Deployment, health from status phases
-    "argoproj.io/v1alpha1/Rollout": {
-        "replica_resource": (
-            "def GetReplicas(obj):\n"
-            "    spec = obj.get('spec', {})\n"
-            "    replicas = spec.get('replicas', 1)\n"
-            "    req = {}\n"
-            "    tpl = spec.get('template', {}).get('spec', {})\n"
-            "    for c in tpl.get('containers', []):\n"
-            "        for k, v in c.get('resources', {}).get('requests', {}).items():\n"
-            "            req[k] = req.get(k, 0) + float(v)\n"
-            "    return replicas, req\n"
-        ),
-        "replica_revision": (
-            "def ReviseReplica(obj, replica):\n"
-            "    obj.setdefault('spec', {})['replicas'] = replica\n"
-            "    return obj\n"
-        ),
-        "health_interpretation": (
-            "def InterpretHealth(obj):\n"
-            "    st = obj.get('status', {})\n"
-            "    return st.get('phase') == 'Healthy' or (\n"
-            "        st.get('readyReplicas', 0) >= obj.get('spec', {}).get('replicas', 1))\n"
-        ),
-    },
-    # OpenKruise CloneSet: Deployment-shaped workload CRD
-    "apps.kruise.io/v1alpha1/CloneSet": {
-        "replica_resource": (
-            "def GetReplicas(obj):\n"
-            "    spec = obj.get('spec', {})\n"
-            "    replicas = spec.get('replicas', 1)\n"
-            "    req = {}\n"
-            "    tpl = spec.get('template', {}).get('spec', {})\n"
-            "    for c in tpl.get('containers', []):\n"
-            "        for k, v in c.get('resources', {}).get('requests', {}).items():\n"
-            "            req[k] = req.get(k, 0) + float(v)\n"
-            "    return replicas, req\n"
-        ),
-        "replica_revision": (
-            "def ReviseReplica(obj, replica):\n"
-            "    obj.setdefault('spec', {})['replicas'] = replica\n"
-            "    return obj\n"
-        ),
-        "health_interpretation": (
-            "def InterpretHealth(obj):\n"
-            "    st = obj.get('status', {})\n"
-            "    return st.get('readyReplicas', 0) >= obj.get('spec', {}).get('replicas', 1)\n"
-        ),
-        "status_reflection": (
-            "def ReflectStatus(obj):\n"
-            "    return obj.get('status')\n"
-        ),
-    },
-}
-
-
-def load_thirdparty_tier() -> dict[str, KindInterpreter]:
-    out: dict[str, KindInterpreter] = {}
-    for gvk, scripts in THIRDPARTY_CUSTOMIZATIONS.items():
-        fns = {op: compile_script(src, op) for op, src in scripts.items()}
-        out[gvk] = _wrap_scripts(fns)
-    return out
+from .thirdparty import (  # noqa: E402
+    THIRDPARTY_CUSTOMIZATIONS,
+    load_thirdparty_tier,
+)

@@ -223,7 +223,7 @@ class ResourceInterpreter:
 
     def load_thirdparty(self) -> None:
         """Load the shipped thirdparty configs (default/thirdparty/)."""
-        from .customized import load_thirdparty_tier
+        from .thirdparty import load_thirdparty_tier
 
         self._thirdparty = load_thirdparty_tier()
 

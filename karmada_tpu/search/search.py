@@ -10,11 +10,15 @@ cache + aggregated search API + proxy.
 - proxy (pkg/search/proxy/controller.go:94,277 Connect): route GET/LIST to
   the cached member objects — the "single pane of glass".
 - backend stores (pkg/search/backendstore): pluggable sinks; the default
-  keeps objects in memory, the OpenSearch one ships documents to a cluster
-  (stubbed offline: it records what it would index).
+  keeps objects in memory, the OpenSearch one builds wire-correct REST
+  requests (index create / bulk upsert / delete) against an injectable
+  transport (the default transport buffers — no egress in this sandbox).
 """
 from __future__ import annotations
 
+import json
+import time as _time
+from dataclasses import dataclass, field
 from typing import Optional, Protocol
 
 from ..api.unstructured import Unstructured
@@ -41,29 +45,231 @@ class InMemoryBackend:
         self.docs.pop((cluster, gvk, namespace, name), None)
 
 
-class OpenSearchBackend:
-    """OpenSearch sink (backendstore/opensearch.go). Network egress is not
-    available in this environment, so documents are queued with the bulk
-    requests that would be sent; `flushed` exposes them for inspection."""
+OPENSEARCH_INDEX_PREFIX = "kubernetes"
 
-    def __init__(self, addresses: list[str]):
+# index bootstrap body (opensearch.go:41-116 `mapping`): single shard, no
+# replicas; metadata name/namespace/resourceVersion as keyword-subfielded
+# text; labels/annotations/spec/status stored but not indexed
+OPENSEARCH_INDEX_BODY: dict = {
+    "settings": {"index": {"number_of_shards": 1, "number_of_replicas": 0}},
+    "mappings": {
+        "properties": {
+            "apiVersion": {"type": "text"},
+            "kind": {"type": "text"},
+            "metadata": {
+                "properties": {
+                    "annotations": {"type": "object", "enabled": False},
+                    "creationTimestamp": {"type": "text"},
+                    "deletionTimestamp": {"type": "text"},
+                    "labels": {"type": "object", "enabled": False},
+                    "name": {
+                        "type": "text",
+                        "fields": {
+                            "keyword": {"type": "keyword", "ignore_above": 256}
+                        },
+                    },
+                    "namespace": {
+                        "type": "text",
+                        "fields": {
+                            "keyword": {"type": "keyword", "ignore_above": 256}
+                        },
+                    },
+                    "ownerReferences": {"type": "text"},
+                    "resourceVersion": {
+                        "type": "text",
+                        "fields": {
+                            "keyword": {"type": "keyword", "ignore_above": 256}
+                        },
+                    },
+                }
+            },
+            "spec": {"type": "object", "enabled": False},
+            "status": {"type": "object", "enabled": False},
+        }
+    },
+}
+
+
+@dataclass
+class HttpRequest:
+    """One OpenSearch REST call, fully serialized (what would go on the
+    wire; the host/port comes from the configured addresses)."""
+
+    method: str
+    path: str
+    headers: dict = field(default_factory=dict)
+    body: bytes = b""
+
+
+class OpenSearchTransport(Protocol):
+    def perform(self, request: HttpRequest) -> tuple[int, bytes]: ...
+
+
+class BufferingTransport:
+    """Default transport: egress is unavailable in this sandbox, so fully
+    serialized requests buffer here instead of being sent (bounded — the
+    buffer exists for inspection, not durability). A real deployment
+    injects an HTTP transport with the same `perform`."""
+
+    MAX_REQUESTS = 256
+
+    def __init__(self) -> None:
+        self.requests: list[HttpRequest] = []
+
+    def perform(self, request: HttpRequest) -> tuple[int, bytes]:
+        self.requests.append(request)
+        if len(self.requests) > self.MAX_REQUESTS:
+            del self.requests[: -self.MAX_REQUESTS]
+        return 200, b"{}"
+
+
+def _rfc3339(ts: float) -> str:
+    return _time.strftime("%Y-%m-%dT%H:%M:%SZ", _time.gmtime(ts))
+
+
+def _jline(obj: dict) -> bytes:
+    return json.dumps(obj, separators=(",", ":"), sort_keys=False).encode()
+
+
+class OpenSearchBackend:
+    """OpenSearch sink speaking the real REST wire format
+    (backendstore/opensearch.go:127-260 behavior):
+
+    - index name `kubernetes-{kind lowercase}` (`indexName`, :249-253);
+      first use issues `PUT /{index}` with the settings+mappings body.
+    - upsert documents carry apiVersion/kind, a pruned metadata block
+      (name/namespace/creationTimestamp RFC3339/labels/annotations/
+      deletionTimestamp), the cache-source annotation, and spec/status as
+      JSON-ENCODED STRINGS (:202-218) — not nested objects.
+    - documents are addressed by uid (`DocumentID: us.GetUID()`, :173-175).
+    - where the reference issues one IndexRequest/DeleteRequest per event
+      (its own `// TODO: bulk` markers at :158,185), operations here queue
+      and `flush()` ships ONE `POST /_bulk` NDJSON body per sweep.
+
+    Requests go through an injectable transport; the default buffers them
+    (no egress in this sandbox) — the wire bytes are real either way."""
+
+    def __init__(
+        self,
+        addresses: list[str],
+        transport: Optional[OpenSearchTransport] = None,
+        prefix: str = OPENSEARCH_INDEX_PREFIX,
+    ):
         self.addresses = addresses
-        self.pending: list[dict] = []
+        self.transport = transport or BufferingTransport()
+        self.prefix = prefix
+        self._indices: set[str] = set()
+        # queued ops, each an atomic NDJSON line group: (action,) for
+        # deletes, (action, source) for upserts — bounded so a persistent
+        # transport outage cannot grow the retry queue without limit (every
+        # sweep re-appends a full re-index; upserts are idempotent, so
+        # dropping the OLDEST ops on overflow converges once the transport
+        # recovers)
+        self._bulk: list[tuple[bytes, ...]] = []
+        # (cluster, gvk, ns, name) -> uid: deletes address by uid like the
+        # reference, but the remove() contract doesn't carry one
+        self._doc_ids: dict[tuple, str] = {}
+        self.pending: list[dict] = []  # op-level view for inspection
+
+    def _index_name(self, kind: str) -> str:
+        return f"{self.prefix}-{kind.lower()}"
+
+    def _ensure_index(self, name: str) -> None:
+        if name in self._indices:
+            return
+        status, body = self.transport.perform(
+            HttpRequest(
+                method="PUT",
+                path=f"/{name}",
+                headers={"Content-Type": "application/json"},
+                body=_jline(OPENSEARCH_INDEX_BODY),
+            )
+        )
+        # resource_already_exists_exception counts as success (:257-260);
+        # any other error leaves the index unmarked so the next touch retries
+        if status < 300 or b"resource_already_exists_exception" in body:
+            self._indices.add(name)
+
+    def document_of(self, cluster: str, obj: Unstructured) -> dict:
+        """The exact document body the reference upserts (:203-218)."""
+        annotations = dict(obj.metadata.annotations)
+        annotations[CLUSTER_ANNOTATION] = cluster
+        d = obj.to_dict()
+        dts = obj.metadata.deletion_timestamp
+        return {
+            "apiVersion": obj.api_version,
+            "kind": obj.kind,
+            "metadata": {
+                "name": obj.name,
+                "namespace": obj.namespace,
+                "creationTimestamp": _rfc3339(obj.metadata.creation_timestamp),
+                "labels": dict(obj.metadata.labels),
+                "annotations": annotations,
+                "deletionTimestamp": None if dts is None else _rfc3339(dts),
+            },
+            "spec": json.dumps(d.get("spec"), separators=(",", ":")),
+            "status": json.dumps(d.get("status"), separators=(",", ":")),
+        }
 
     def index(self, cluster: str, obj: Unstructured) -> None:
+        name = self._index_name(obj.kind)
+        self._ensure_index(name)
+        doc_id = obj.metadata.uid or f"{cluster}/{obj.namespace}/{obj.name}"
+        gvk = f"{obj.api_version}/{obj.kind}"
+        self._doc_ids[(cluster, gvk, obj.namespace, obj.name)] = doc_id
+        doc = self.document_of(cluster, obj)
+        self._bulk.append(
+            (_jline({"index": {"_index": name, "_id": doc_id}}), _jline(doc))
+        )
+        self._trim_bulk()
         self.pending.append(
-            {
-                "_op": "index",
-                "_index": f"{obj.kind.lower()}s",
-                "_id": f"{cluster}/{obj.namespace}/{obj.name}",
-                "doc": obj.to_dict(),
-            }
+            {"_op": "index", "_index": name, "_id": doc_id, "doc": doc}
         )
 
     def remove(self, cluster: str, gvk: str, namespace: str, name: str) -> None:
-        self.pending.append(
-            {"_op": "delete", "_id": f"{cluster}/{namespace}/{name}", "_index": gvk}
+        kind = gvk.rsplit("/", 1)[-1]
+        index = self._index_name(kind)
+        doc_id = self._doc_ids.pop(
+            (cluster, gvk, namespace, name), f"{cluster}/{namespace}/{name}"
         )
+        self._bulk.append(
+            (_jline({"delete": {"_index": index, "_id": doc_id}}),)
+        )
+        self._trim_bulk()
+        self.pending.append(
+            {"_op": "delete", "_index": index, "_id": doc_id}
+        )
+
+    MAX_PENDING = 1024  # `pending` is an inspection view, not durability
+    MAX_BULK_OPS = 65536  # retry-queue bound (see _bulk comment)
+
+    def _trim_bulk(self) -> None:
+        if len(self._bulk) > self.MAX_BULK_OPS:
+            del self._bulk[: -self.MAX_BULK_OPS]
+
+    def flush(self) -> Optional[tuple[int, bytes]]:
+        """Ship everything queued since the last flush as one `POST /_bulk`
+        (NDJSON: action line [+ source line], newline-terminated). The queue
+        drains only on a successful send — a transport exception or error
+        status leaves it intact for the next flush."""
+        if not self._bulk:
+            return None
+        body = b"\n".join(
+            line for op in self._bulk for line in op
+        ) + b"\n"
+        status, resp = self.transport.perform(
+            HttpRequest(
+                method="POST",
+                path="/_bulk",
+                headers={"Content-Type": "application/x-ndjson"},
+                body=body,
+            )
+        )
+        if status < 300:
+            self._bulk = []
+            if len(self.pending) > self.MAX_PENDING:
+                del self.pending[: -self.MAX_PENDING]
+        return status, resp
 
 
 class ResourceCache:
@@ -75,6 +281,9 @@ class ResourceCache:
         # (cluster, gvk, ns, name) -> Unstructured
         self._cache: dict[tuple, Unstructured] = {}
         self._backends: dict[str, BackendStore] = {}
+        # registry name -> keys its backend indexed last sweep (removals
+        # route only to the backends that actually hold the document)
+        self._indexed: dict[str, set] = {}
 
     def backend_for(self, registry) -> BackendStore:
         name = registry.metadata.name
@@ -101,8 +310,10 @@ class ResourceCache:
         """Refresh the cache from every registry's selected members (informer
         resync). Returns the number of cached objects."""
         fresh: dict[tuple, Unstructured] = {}
+        indexed_now: dict[str, set] = {}
         for registry in self.store.list("ResourceRegistry"):
             backend = self.backend_for(registry)
+            keys = indexed_now.setdefault(registry.metadata.name, set())
             wanted = {(s.api_version, s.kind) for s in registry.spec.resource_selectors}
             for cname in self._selected_clusters(registry):
                 member = self.members.get(cname)
@@ -117,12 +328,29 @@ class ResourceCache:
                     copy.sync_meta()
                     fresh[key] = copy
                     backend.index(cname, copy)
-        removed = set(self._cache) - set(fresh)
-        for key in removed:
-            cluster, gvk, ns, name = key
-            for be in self._backends.values():
-                be.remove(cluster, gvk, ns, name)
+                    keys.add(key)
+        # removals route only to the backend that actually indexed the key;
+        # a deleted registry's backend gets its removals + final flush BEFORE
+        # being dropped (its documents must leave the external store too)
+        for name, be in list(self._backends.items()):
+            gone = self._indexed.get(name, set()) - indexed_now.get(name, set())
+            for key in gone:
+                cluster, gvk, ns, oname = key
+                be.remove(cluster, gvk, ns, oname)
+        self._indexed = indexed_now
         self._cache = fresh
+        # backends that batch (OpenSearch bulk) ship one request per sweep;
+        # one backend's transport outage must not abort the others
+        for name, be in list(self._backends.items()):
+            flush = getattr(be, "flush", None)
+            if flush is not None:
+                try:
+                    flush()
+                except Exception:  # noqa: BLE001 — per-backend isolation
+                    pass
+            if name not in indexed_now:  # registry deleted: drop after flush
+                self._backends.pop(name)
+                self._indexed.pop(name, None)
         return len(self._cache)
 
     # -- aggregated search API -------------------------------------------

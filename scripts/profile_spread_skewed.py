@@ -1,0 +1,215 @@
+"""Profile the skewed-fleet spread round (VERDICT r3 weak #1).
+
+Builds a 5k-cluster fleet with one mega region (~60% of clusters) among many
+tiny ones — the layout that defeats the balanced [S,R,W] grid kernel and
+rides group_score_kernel_segmented — then times the end-to-end round and its
+phases. Scalar-checksum fetches force real device sync (block_until_ready
+does not block on this image's tunnel backend; see docs/ROUND3.md).
+
+Usage: python scripts/profile_spread_skewed.py [--clusters N] [--bindings B]
+       [--platform cpu] [--iters K] [--phases]
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+
+def skewed_fleet(n_clusters: int, seed: int = 0, mega_frac: float = 0.6,
+                 n_small: int = 30):
+    """One mega region + n_small tiny regions (skew the grid kernel hates)."""
+    from karmada_tpu.testing.fixtures import synthetic_fleet
+
+    clusters = synthetic_fleet(n_clusters, seed=seed)
+    rng = np.random.default_rng(seed)
+    n_mega = int(n_clusters * mega_frac)
+    for i, c in enumerate(clusters):
+        if i < n_mega:
+            c.spec.region = "mega-region"
+            c.spec.provider = "mega"
+        else:
+            r = int(rng.integers(0, n_small))
+            c.spec.region = f"small-{r}"
+            c.spec.provider = f"p{r % 4}"
+    return clusters
+
+
+def spread_bindings(n_bindings: int, seed: int = 0, n_placements: int = 200):
+    """Diverse constraint tuples (VERDICT r3: 10 cycled placements let the
+    row-content dedup collapse the search; a real fleet is messier)."""
+    from karmada_tpu.api import policy as pol
+    import bench
+
+    rng = np.random.default_rng(seed)
+    placements = []
+    for k in range(n_placements):
+        rmin = int(rng.integers(2, 5))
+        rmax = rmin + int(rng.integers(0, 3))
+        cmin = int(rng.integers(rmin, rmin + 3))
+        divided = k % 10 >= 7  # 30% divided, like the bench config
+        cons = [
+            pol.SpreadConstraint(
+                spread_by_field=pol.SPREAD_BY_FIELD_REGION,
+                min_groups=rmin, max_groups=rmax,
+            ),
+            pol.SpreadConstraint(
+                spread_by_field=pol.SPREAD_BY_FIELD_CLUSTER, min_groups=cmin,
+            ),
+        ]
+        if divided:
+            p = bench._dyn_placement(aggregated=True)
+            p.spread_constraints = cons
+        else:
+            p = pol.Placement(
+                cluster_affinity=pol.ClusterAffinity(cluster_names=[]),
+                spread_constraints=cons,
+            )
+        placements.append(p)
+    return [
+        bench._binding(i, int(rng.integers(1, 32)),
+                       placements[i % n_placements],
+                       float(rng.choice([0.1, 0.25, 0.5])))
+        for i in range(n_bindings)
+    ]
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--clusters", type=int, default=5000)
+    ap.add_argument("--bindings", type=int, default=5000)
+    ap.add_argument("--iters", type=int, default=5)
+    ap.add_argument("--placements", type=int, default=200)
+    ap.add_argument("--platform", default=None)
+    ap.add_argument("--phases", action="store_true",
+                    help="also time group-scoring / search / tail separately")
+    args = ap.parse_args()
+
+    import jax
+
+    if args.platform:
+        jax.config.update("jax_platforms", args.platform)
+    print(f"# backend: {jax.devices()[0].platform}")
+
+    from karmada_tpu.sched.core import ArrayScheduler
+
+    t0 = time.perf_counter()
+    clusters = skewed_fleet(args.clusters)
+    bindings = spread_bindings(args.bindings, n_placements=args.placements)
+    sched = ArrayScheduler(clusters)
+    print(f"# build: {time.perf_counter()-t0:.2f}s  "
+          f"regions={sched._spread_layout.n_regions} "
+          f"grid_balanced={sched._spread_layout.grid_balanced}")
+
+    t0 = time.perf_counter()
+    decisions = sched.schedule(bindings)
+    warm = time.perf_counter() - t0
+    n_ok = sum(d.ok for d in decisions)
+    print(f"# warm (compile): {warm:.2f}s ok={n_ok}/{len(bindings)}")
+
+    lat = []
+    for _ in range(args.iters):
+        t0 = time.perf_counter()
+        decisions = sched.schedule(bindings)
+        lat.append(time.perf_counter() - t0)
+    lat.sort()
+    print(f"# e2e p50={lat[len(lat)//2]*1e3:.0f}ms "
+          f"min={lat[0]*1e3:.0f}ms max={lat[-1]*1e3:.0f}ms")
+
+    if args.phases:
+        profile_phases(sched, bindings)
+
+
+def profile_phases(sched, bindings):
+    """Time the round's phases with explicit scalar-checksum syncs."""
+    import jax
+    import jax.numpy as jnp
+    from karmada_tpu.sched import core as C
+    from karmada_tpu.sched import spread_batch
+
+    def sync(*arrs):
+        tot = 0.0
+        for a in arrs:
+            tot += float(jnp.asarray(a).sum())
+        return tot
+
+    # mirror _schedule_once_partitioned's setup
+    n_real = len(bindings)
+    t0 = time.perf_counter()
+    pre_b, pre_cfg, pre_fb = sched._classify_spread(bindings)
+    spread_set = set(pre_b) | set(pre_fb)
+    cls = np.asarray(
+        [sched._row_class(rb, b in spread_set) for b, rb in enumerate(bindings)],
+        np.int8,
+    )
+    order = np.argsort(cls, kind="stable")
+    bindings_p = [bindings[i] for i in order]
+    batched_rows, batched_cfg, fallback_rows = sched._classify_spread(bindings_p)
+    t_classify = time.perf_counter() - t0
+
+    t0 = time.perf_counter()
+    raw = sched.batch_encoder.encode(bindings_p)
+    batch = sched._pad(raw)
+    t_encode = time.perf_counter() - t0
+
+    t0 = time.perf_counter()
+    out = C._filter_kernel_compact(
+        *sched._fleet_dev,
+        batch.replicas, batch.unknown_request,
+        batch.gvk, batch.tol_tables, batch.tol_idx,
+        batch.aff_masks, batch.aff_idx,
+        batch.prev_idx, batch.prev_rep, batch.evict_idx, batch.seeds,
+        batch.req_unique, batch.req_idx,
+        sched._NO_EXTRA, sched._NO_MASK, sched._NO_SCORE,
+        plugin_bits=sched._plugin_bits,
+    )
+    dev_feasible, dev_score, dev_avail, dev_prev, dev_tie, dev_fc = out
+    sync(dev_fc)
+    t_filter = time.perf_counter() - t0
+
+    t0 = time.perf_counter()
+    pre = sched._spread_prelaunch(
+        bindings_p, batch, batched_rows, batched_cfg,
+        dev_feasible, dev_score, dev_avail, dev_prev, dev_tie,
+    )
+    sync(pre["wvf"][0])
+    t_score = time.perf_counter() - t0
+
+    t0 = time.perf_counter()
+    W, V, fc = jax.device_get(pre["wvf"])
+    t_fetch = time.perf_counter() - t0
+
+    nb = pre["nb"]
+    W = np.asarray(W)[:nb]
+    V = np.asarray(V)[:nb]
+    layout = sched._spread_layout
+    from collections import defaultdict
+
+    j_by_cfg = defaultdict(list)
+    fch = np.asarray(fc)[:nb]
+    for j, b in enumerate(batched_rows):
+        if fch[j] > 0:
+            j_by_cfg[batched_cfg[b]].append(j)
+    t0 = time.perf_counter()
+    n_fb = 0
+    for cfg, js in j_by_cfg.items():
+        res = spread_batch.select_regions_batch(W[js], V[js], cfg, layout)
+        n_fb += len(res.fallback)
+    t_search = time.perf_counter() - t0
+
+    print(
+        f"# phases: classify={t_classify*1e3:.0f}ms encode={t_encode*1e3:.0f}ms "
+        f"filter={t_filter*1e3:.0f}ms group-score+gathers={t_score*1e3:.0f}ms "
+        f"wvf-fetch={t_fetch*1e3:.0f}ms combo-search={t_search*1e3:.0f}ms "
+        f"(distinct cfgs={len(j_by_cfg)}, search fallback rows={n_fb}, "
+        f"batched={len(batched_rows)}, classify-fallback={len(fallback_rows)})"
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -176,3 +176,219 @@ class TestUnifiedAuth:
                                        "karmada-impersonator", "")
         assert binding is not None
         assert {"kind": "User", "name": "alice"} in binding.get("subjects")
+
+
+class TestOpenSearchWire:
+    """Wire-shape tests for the OpenSearch backend: byte-correct REST
+    requests against an injectable transport (opensearch.go:127-260)."""
+
+    def _obj(self, uid="uid-123", ns="default", name="web"):
+        from karmada_tpu.api.unstructured import Unstructured
+
+        return Unstructured({
+            "apiVersion": "apps/v1",
+            "kind": "Deployment",
+            "metadata": {
+                "name": name, "namespace": ns, "uid": uid,
+                "labels": {"app": name},
+                "creationTimestamp": 1700000000.0,
+            },
+            "spec": {"replicas": 2},
+            "status": {"readyReplicas": 2},
+        })
+
+    def _backend(self):
+        from karmada_tpu.search.search import BufferingTransport
+
+        t = BufferingTransport()
+        return OpenSearchBackend(["http://os:9200"], transport=t), t
+
+    def test_index_creates_index_then_bulk_upserts(self):
+        import json
+
+        be, t = self._backend()
+        be.index("m1", self._obj())
+        # first touch of the kind creates the index with the mapping body
+        assert [r.method for r in t.requests] == ["PUT"]
+        create = t.requests[0]
+        assert create.path == "/kubernetes-deployment"
+        assert create.headers["Content-Type"] == "application/json"
+        body = json.loads(create.body)
+        assert body["settings"]["index"]["number_of_shards"] == 1
+        assert body["mappings"]["properties"]["spec"] == {
+            "type": "object", "enabled": False,
+        }
+        # second index of the same kind does NOT recreate
+        be.index("m1", self._obj(name="web2", uid="uid-124"))
+        assert len(t.requests) == 1
+
+        status, _ = be.flush()
+        assert status == 200
+        bulk = t.requests[-1]
+        assert (bulk.method, bulk.path) == ("POST", "/_bulk")
+        assert bulk.headers["Content-Type"] == "application/x-ndjson"
+        lines = bulk.body.decode().splitlines()
+        assert len(lines) == 4  # two (action, source) pairs
+        assert bulk.body.endswith(b"\n")
+        action = json.loads(lines[0])
+        assert action == {
+            "index": {"_index": "kubernetes-deployment", "_id": "uid-123"}
+        }
+
+    def test_document_shape_matches_reference(self):
+        import json
+
+        be, _ = self._backend()
+        doc = be.document_of("m1", self._obj())
+        # spec/status are JSON-encoded STRINGS (opensearch.go:216-218)
+        assert doc["spec"] == '{"replicas":2}'
+        assert doc["status"] == '{"readyReplicas":2}'
+        assert doc["apiVersion"] == "apps/v1" and doc["kind"] == "Deployment"
+        md = doc["metadata"]
+        assert md["name"] == "web" and md["namespace"] == "default"
+        assert md["creationTimestamp"] == "2023-11-14T22:13:20Z"  # RFC3339
+        assert md["labels"] == {"app": "web"}
+        assert md["annotations"][CLUSTER_ANNOTATION] == "m1"
+        assert md["deletionTimestamp"] is None
+        # the metadata block is PRUNED: no uid/resourceVersion/finalizers
+        assert set(md) == {
+            "name", "namespace", "creationTimestamp", "labels",
+            "annotations", "deletionTimestamp",
+        }
+        # the full doc round-trips through compact JSON deterministically
+        assert json.loads(json.dumps(doc)) == doc
+
+    def test_delete_addresses_by_uid(self):
+        import json
+
+        be, t = self._backend()
+        be.index("m1", self._obj(uid="uid-xyz"))
+        be.flush()
+        be.remove("m1", "apps/v1/Deployment", "default", "web")
+        be.flush()
+        bulk = t.requests[-1]
+        lines = bulk.body.decode().splitlines()
+        assert len(lines) == 1  # delete has no source line
+        assert json.loads(lines[0]) == {
+            "delete": {"_index": "kubernetes-deployment", "_id": "uid-xyz"}
+        }
+
+    def test_flush_empty_is_noop(self):
+        be, t = self._backend()
+        assert be.flush() is None
+        assert t.requests == []
+
+    def test_sweep_flushes_one_bulk(self, cp):
+        propagate(cp)
+        cp.store.create(
+            registry(backend=BackendStoreConfig(
+                type="opensearch", addresses=["http://os:9200"]))
+        )
+        cp.resource_cache.sweep()
+        be = cp.resource_cache.backend_for(
+            cp.store.get("ResourceRegistry", "reg")
+        )
+        from karmada_tpu.search.search import BufferingTransport
+
+        assert isinstance(be.transport, BufferingTransport)
+        bulks = [r for r in be.transport.requests if r.path == "/_bulk"]
+        assert len(bulks) == 1  # the whole sweep ships as ONE bulk
+        assert be._bulk == []  # queue drained into the transport
+
+    def test_flush_keeps_queue_on_transport_error(self):
+        import json
+
+        class FlakyTransport:
+            def __init__(self):
+                self.requests = []
+                self.fail = True
+
+            def perform(self, request):
+                self.requests.append(request)
+                if self.fail and request.path == "/_bulk":
+                    return 503, b"unavailable"
+                return 200, b"{}"
+
+        t = FlakyTransport()
+        be = OpenSearchBackend(["http://os:9200"], transport=t)
+        be.index("m1", self._obj())
+        status, _ = be.flush()
+        assert status == 503
+        assert be._bulk  # queue intact
+        t.fail = False
+        status, _ = be.flush()
+        assert status == 200 and be._bulk == []
+        lines = t.requests[-1].body.decode().splitlines()
+        assert json.loads(lines[0])["index"]["_id"] == "uid-123"
+
+    def test_index_create_retries_after_error(self):
+        class RejectOnce:
+            def __init__(self):
+                self.requests = []
+                self.fail = True
+
+            def perform(self, request):
+                self.requests.append(request)
+                if self.fail and request.method == "PUT":
+                    return 503, b"not ready"
+                return 200, b"{}"
+
+        t = RejectOnce()
+        be = OpenSearchBackend(["http://os:9200"], transport=t)
+        be.index("m1", self._obj())
+        assert "kubernetes-deployment" not in be._indices
+        t.fail = False
+        be.index("m1", self._obj(name="web2", uid="u2"))
+        assert "kubernetes-deployment" in be._indices
+        # already-exists answers also count as created
+        class Exists:
+            requests: list = []
+
+            def perform(self, request):
+                if request.method == "PUT":
+                    return 400, b'{"error":{"type":"resource_already_exists_exception"}}'
+                return 200, b"{}"
+
+        be2 = OpenSearchBackend(["http://os:9200"], transport=Exists())
+        be2.index("m1", self._obj())
+        assert "kubernetes-deployment" in be2._indices
+
+    def test_removals_route_only_to_indexing_backend(self, cp):
+        propagate(cp)
+        cp.store.create(registry(
+            name="reg-a", clusters=["m1"],
+            backend=BackendStoreConfig(type="opensearch",
+                                       addresses=["http://a:9200"])))
+        cp.store.create(registry(
+            name="reg-b", clusters=["m2"],
+            backend=BackendStoreConfig(type="opensearch",
+                                       addresses=["http://b:9200"])))
+        cp.resource_cache.sweep()
+        be_a = cp.resource_cache._backends["reg-a"]
+        be_b = cp.resource_cache._backends["reg-b"]
+        # make m1's object disappear: restrict reg-a to a cluster with nothing
+        reg_a = cp.store.get("ResourceRegistry", "reg-a")
+        reg_a.spec.target_cluster.cluster_names = ["nonexistent"]
+        cp.store.update(reg_a)
+        be_a.pending.clear()
+        be_b.pending.clear()
+        cp.resource_cache.sweep()
+        assert any(p["_op"] == "delete" for p in be_a.pending)
+        assert not any(p["_op"] == "delete" for p in be_b.pending)
+
+    def test_deleted_registry_backend_flushes_deletes_then_prunes(self, cp):
+        propagate(cp)
+        cp.store.create(registry(
+            backend=BackendStoreConfig(type="opensearch",
+                                       addresses=["http://os:9200"])))
+        cp.resource_cache.sweep()
+        be = cp.resource_cache._backends["reg"]
+        n_before = len(be.transport.requests)
+        cp.store.delete("ResourceRegistry", "reg")
+        cp.resource_cache.sweep()
+        # documents were deleted from the external store BEFORE the prune
+        assert any(p["_op"] == "delete" for p in be.pending)
+        bulks = [r for r in be.transport.requests[n_before:]
+                 if r.path == "/_bulk"]
+        assert len(bulks) == 1 and b'"delete"' in bulks[0].body
+        assert "reg" not in cp.resource_cache._backends

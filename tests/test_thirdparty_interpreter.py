@@ -1,0 +1,620 @@
+"""Thirdparty interpreter customization library (I3): per-operation behavior
+mirroring the reference's shipped customization sets
+(default/thirdparty/resourcecustomizations/*/*/customizations.yaml)."""
+from __future__ import annotations
+
+import pytest
+
+from karmada_tpu.api.unstructured import Unstructured
+from karmada_tpu.api.work import AggregatedStatusItem
+from karmada_tpu.interpreter.interpreter import (
+    HEALTHY,
+    ResourceInterpreter,
+    UNHEALTHY,
+)
+from karmada_tpu.interpreter.thirdparty import (
+    THIRDPARTY_CUSTOMIZATIONS,
+    load_thirdparty_tier,
+)
+
+REFERENCE_SET = [
+    "apps.kruise.io/v1alpha1/AdvancedCronJob",
+    "apps.kruise.io/v1alpha1/BroadcastJob",
+    "apps.kruise.io/v1alpha1/CloneSet",
+    "apps.kruise.io/v1alpha1/DaemonSet",
+    "apps.kruise.io/v1beta1/StatefulSet",
+    "argoproj.io/v1alpha1/Workflow",
+    "flink.apache.org/v1beta1/FlinkDeployment",
+    "helm.toolkit.fluxcd.io/v2beta1/HelmRelease",
+    "kustomize.toolkit.fluxcd.io/v1/Kustomization",
+    "kyverno.io/v1/ClusterPolicy",
+    "kyverno.io/v1/Policy",
+    "source.toolkit.fluxcd.io/v1/GitRepository",
+    "source.toolkit.fluxcd.io/v1beta2/Bucket",
+    "source.toolkit.fluxcd.io/v1beta2/HelmChart",
+    "source.toolkit.fluxcd.io/v1beta2/HelmRepository",
+    "source.toolkit.fluxcd.io/v1beta2/OCIRepository",
+]
+
+
+def interp() -> ResourceInterpreter:
+    ri = ResourceInterpreter()
+    ri.load_thirdparty()
+    return ri
+
+
+def obj(gvk: str, *, spec=None, status=None, generation=1, ns="default",
+        annotations=None, name="x"):
+    api_version, kind = gvk.rsplit("/", 1)
+    return Unstructured({
+        "apiVersion": api_version,
+        "kind": kind,
+        "metadata": {
+            "name": name, "namespace": ns, "generation": generation,
+            "annotations": dict(annotations or {}),
+        },
+        **({"spec": spec} if spec is not None else {}),
+        **({"status": status} if status is not None else {}),
+    })
+
+
+def item(cluster: str, status) -> AggregatedStatusItem:
+    return AggregatedStatusItem(cluster_name=cluster, status=status)
+
+
+POD_TEMPLATE = {
+    "spec": {
+        "containers": [
+            {"name": "c", "resources": {"requests": {"cpu": "500m",
+                                                     "memory": "1Gi"}}},
+        ],
+        "volumes": [
+            {"name": "cfg", "configMap": {"name": "app-config"}},
+            {"name": "creds", "secret": {"secretName": "app-secret"}},
+        ],
+    },
+}
+
+
+class TestLibraryCompleteness:
+    def test_all_reference_gvks_present(self):
+        for gvk in REFERENCE_SET:
+            assert gvk in THIRDPARTY_CUSTOMIZATIONS, gvk
+
+    def test_tier_builds(self):
+        tier = load_thirdparty_tier()
+        assert len(tier) >= 16
+        for gvk in REFERENCE_SET:
+            assert tier[gvk] is not None
+
+
+class TestCloneSet:
+    GVK = "apps.kruise.io/v1alpha1/CloneSet"
+
+    def test_get_replicas_and_requirements(self):
+        o = obj(self.GVK, spec={"replicas": 3, "template": POD_TEMPLATE})
+        n, req = interp().get_replicas(o)
+        assert n == 3
+        assert req.resource_request["cpu"] == 0.5
+        assert req.resource_request["memory"] == 1024.0**3
+
+    def test_revise_replica(self):
+        o = obj(self.GVK, spec={"replicas": 3})
+        out = interp().revise_replica(o, 7)
+        assert out.get("spec", "replicas") == 7
+
+    def test_aggregate_sums_and_revisions(self):
+        tmpl = obj(self.GVK, spec={"replicas": 4}, generation=2,
+                   status={"observedGeneration": 1})
+        items = [
+            item("m1", {"replicas": 2, "readyReplicas": 2,
+                        "updatedReplicas": 2, "availableReplicas": 2,
+                        "updateRevision": "rev-a",
+                        "resourceTemplateGeneration": 2,
+                        "generation": 5, "observedGeneration": 5}),
+            item("m2", {"replicas": 2, "readyReplicas": 1,
+                        "updatedReplicas": 1, "availableReplicas": 1,
+                        "updateRevision": "rev-b",
+                        "resourceTemplateGeneration": 2,
+                        "generation": 3, "observedGeneration": 3}),
+        ]
+        out = interp().aggregate_status(tmpl, items)
+        st = out.get("status")
+        assert st["replicas"] == 4 and st["readyReplicas"] == 3
+        assert st["updateRevision"] == "rev-b"  # last non-empty wins
+        # every member caught up → observedGeneration advances
+        assert st["observedGeneration"] == 2
+
+    def test_aggregate_holds_generation_when_member_behind(self):
+        tmpl = obj(self.GVK, spec={"replicas": 4}, generation=2,
+                   status={"observedGeneration": 1})
+        items = [
+            item("m1", {"resourceTemplateGeneration": 1,  # stale template
+                        "generation": 5, "observedGeneration": 5}),
+        ]
+        st = interp().aggregate_status(tmpl, items).get("status")
+        assert st["observedGeneration"] == 1
+
+    def test_aggregate_empty_items_resets(self):
+        tmpl = obj(self.GVK, spec={"replicas": 4}, generation=3,
+                   status={"replicas": 9})
+        st = interp().aggregate_status(tmpl, []).get("status")
+        assert st["observedGeneration"] == 3
+        assert st["replicas"] == 0 and st["readyReplicas"] == 0
+
+    def test_reflect_lifts_template_generation_annotation(self):
+        o = obj(self.GVK, generation=4,
+                annotations={"resourcetemplate.karmada.io/generation": "2"},
+                status={"replicas": 2, "readyReplicas": 2})
+        st = interp().reflect_status(o)
+        assert st["replicas"] == 2
+        assert st["generation"] == 4
+        assert st["resourceTemplateGeneration"] == 2
+
+    def test_health(self):
+        ri = interp()
+        healthy = obj(self.GVK, generation=2, spec={"replicas": 2},
+                      status={"observedGeneration": 2, "updatedReplicas": 2,
+                              "availableReplicas": 2})
+        assert ri.interpret_health(healthy) == HEALTHY
+        behind = obj(self.GVK, generation=3, spec={"replicas": 2},
+                     status={"observedGeneration": 2, "updatedReplicas": 2,
+                             "availableReplicas": 2})
+        assert ri.interpret_health(behind) == UNHEALTHY
+        not_updated = obj(self.GVK, generation=2, spec={"replicas": 2},
+                          status={"observedGeneration": 2,
+                                  "updatedReplicas": 1,
+                                  "availableReplicas": 1})
+        assert ri.interpret_health(not_updated) == UNHEALTHY
+
+    def test_dependencies(self):
+        o = obj(self.GVK, spec={"replicas": 1, "template": POD_TEMPLATE})
+        deps = interp().get_dependencies(o)
+        kinds = {(d["kind"], d["name"]) for d in deps}
+        assert ("ConfigMap", "app-config") in kinds
+        assert ("Secret", "app-secret") in kinds
+
+
+class TestKruiseStatefulSet:
+    GVK = "apps.kruise.io/v1beta1/StatefulSet"
+
+    def test_aggregate_current_replicas(self):
+        tmpl = obj(self.GVK, spec={"replicas": 2}, generation=1, status={})
+        items = [
+            item("m1", {"replicas": 1, "currentReplicas": 1,
+                        "currentRevision": "c1",
+                        "resourceTemplateGeneration": 1,
+                        "generation": 2, "observedGeneration": 2}),
+            item("m2", {"replicas": 1, "currentReplicas": 1,
+                        "resourceTemplateGeneration": 1,
+                        "generation": 2, "observedGeneration": 2}),
+        ]
+        st = interp().aggregate_status(tmpl, items).get("status")
+        assert st["currentReplicas"] == 2
+        assert st["currentRevision"] == "c1"
+        assert st["observedGeneration"] == 1
+
+    def test_empty_init_has_revision_strings(self):
+        tmpl = obj(self.GVK, spec={"replicas": 2}, generation=1, status={})
+        st = interp().aggregate_status(tmpl, []).get("status")
+        assert st["updateRevision"] == "" and st["currentRevision"] == ""
+
+
+class TestKruiseDaemonSet:
+    GVK = "apps.kruise.io/v1alpha1/DaemonSet"
+
+    def test_no_replica_hooks(self):
+        o = obj(self.GVK, spec={"template": POD_TEMPLATE})
+        n, req = interp().get_replicas(o)
+        assert n == 0 and req is None  # non-workload for scheduling purposes
+
+    def test_aggregate_and_health(self):
+        ri = interp()
+        tmpl = obj(self.GVK, generation=1, status={})
+        items = [
+            item("m1", {"desiredNumberScheduled": 2, "numberReady": 2,
+                        "updatedNumberScheduled": 2, "numberAvailable": 2,
+                        "daemonSetHash": "h1",
+                        "resourceTemplateGeneration": 1,
+                        "generation": 1, "observedGeneration": 1}),
+        ]
+        st = ri.aggregate_status(tmpl, items).get("status")
+        assert st["desiredNumberScheduled"] == 2
+        assert st["daemonSetHash"] == "h1"
+        healthy = obj(self.GVK, generation=1,
+                      status={"observedGeneration": 1,
+                              "desiredNumberScheduled": 2,
+                              "updatedNumberScheduled": 2,
+                              "numberAvailable": 2})
+        assert ri.interpret_health(healthy) == HEALTHY
+        lagging = obj(self.GVK, generation=1,
+                      status={"observedGeneration": 1,
+                              "desiredNumberScheduled": 3,
+                              "updatedNumberScheduled": 2,
+                              "numberAvailable": 2})
+        assert ri.interpret_health(lagging) == UNHEALTHY
+
+
+class TestAdvancedCronJob:
+    GVK = "apps.kruise.io/v1alpha1/AdvancedCronJob"
+
+    def test_aggregate_concatenates_active(self):
+        tmpl = obj(self.GVK, status={})
+        items = [
+            item("m1", {"active": [{"name": "j1"}], "type": "Job",
+                        "lastScheduleTime": "t1"}),
+            item("m2", {"active": [{"name": "j2"}, {"name": "j3"}],
+                        "lastScheduleTime": "t2"}),
+        ]
+        st = interp().aggregate_status(tmpl, items).get("status")
+        assert [a["name"] for a in st["active"]] == ["j1", "j2", "j3"]
+        assert st["type"] == "Job"
+        assert st["lastScheduleTime"] == "t2"
+
+    def test_dependencies_from_either_template(self):
+        ri = interp()
+        o = obj(self.GVK, spec={"template": {"jobTemplate": {
+            "spec": {"template": POD_TEMPLATE}}}})
+        kinds = {d["kind"] for d in ri.get_dependencies(o)}
+        assert kinds == {"ConfigMap", "Secret"}
+        o2 = obj(self.GVK, spec={"template": {"broadcastJobTemplate": {
+            "spec": {"template": POD_TEMPLATE}}}})
+        assert {d["kind"] for d in ri.get_dependencies(o2)} == {
+            "ConfigMap", "Secret"
+        }
+
+
+class TestBroadcastJob:
+    GVK = "apps.kruise.io/v1alpha1/BroadcastJob"
+
+    def test_replicas_from_parallelism(self):
+        ri = interp()
+        o = obj(self.GVK, spec={"parallelism": 5, "template": POD_TEMPLATE})
+        n, req = ri.get_replicas(o)
+        assert n == 5 and req.resource_request["cpu"] == 0.5
+        out = ri.revise_replica(o, 9)
+        assert out.get("spec", "parallelism") == 9
+
+    def test_health(self):
+        ri = interp()
+        ok = obj(self.GVK, status={"desired": 3, "failed": 0, "active": 1,
+                                   "succeeded": 0})
+        assert ri.interpret_health(ok) == HEALTHY
+        failed = obj(self.GVK, status={"desired": 3, "failed": 1, "active": 1,
+                                       "succeeded": 0})
+        assert ri.interpret_health(failed) == UNHEALTHY
+        idle = obj(self.GVK, status={"desired": 3, "failed": 0, "active": 0,
+                                     "succeeded": 0})
+        assert ri.interpret_health(idle) == UNHEALTHY
+
+    def test_aggregate_builds_conditions(self):
+        tmpl = obj(self.GVK, status={})
+        items = [
+            item("m1", {"desired": 1, "succeeded": 1, "conditions": [
+                {"type": "Complete", "status": "True"}]}),
+            item("m2", {"desired": 1, "failed": 1, "conditions": [
+                {"type": "Failed", "status": "True"}]}),
+        ]
+        st = interp().aggregate_status(tmpl, items).get("status")
+        assert st["desired"] == 2 and st["succeeded"] == 1 and st["failed"] == 1
+        types = {c["type"] for c in st["conditions"]}
+        assert "Failed" in types and "Completed" not in types
+        failed_cond = next(c for c in st["conditions"] if c["type"] == "Failed")
+        assert "m2" in failed_cond["message"]
+
+    def test_aggregate_all_complete(self):
+        tmpl = obj(self.GVK, status={})
+        items = [
+            item("m1", {"desired": 1, "succeeded": 1, "conditions": [
+                {"type": "Complete", "status": "True"}]}),
+            item("m2", {"desired": 1, "succeeded": 1, "conditions": [
+                {"type": "Complete", "status": "True"}]}),
+        ]
+        st = interp().aggregate_status(tmpl, items).get("status")
+        assert [c["type"] for c in st["conditions"]] == ["Completed"]
+
+    def test_retain_pod_template_labels(self):
+        desired = obj(self.GVK, spec={"template": {"metadata": {"labels": {}}}})
+        observed = obj(self.GVK, spec={"template": {"metadata": {
+            "labels": {"injected": "yes"}}}})
+        out = interp().retain(desired, observed)
+        assert out.get("spec", "template", "metadata", "labels") == {
+            "injected": "yes"
+        }
+
+
+class TestArgoWorkflow:
+    GVK = "argoproj.io/v1alpha1/Workflow"
+
+    def test_replicas_from_parallelism_with_node_claim(self):
+        o = obj(self.GVK, spec={
+            "parallelism": 4,
+            "nodeSelector": {"zone": "a"},
+            "tolerations": [{"key": "gpu", "operator": "Exists"}],
+        })
+        n, req = interp().get_replicas(o)
+        assert n == 4
+        assert req.node_claim.node_selector == {"zone": "a"}
+        assert req.node_claim.tolerations[0]["key"] == "gpu"
+
+    def test_health_phases(self):
+        ri = interp()
+        assert ri.interpret_health(
+            obj(self.GVK, status={"phase": "Running"})) == HEALTHY
+        assert ri.interpret_health(
+            obj(self.GVK, status={"phase": "Failed"})) == UNHEALTHY
+        assert ri.interpret_health(
+            obj(self.GVK, status={"phase": "Error"})) == UNHEALTHY
+        assert ri.interpret_health(
+            obj(self.GVK, status={"phase": ""})) == UNHEALTHY
+        assert ri.interpret_health(obj(self.GVK, spec={})) == UNHEALTHY
+        assert ri.interpret_health(
+            obj(self.GVK, status={"phase": "Running", "failed": "Error"})
+        ) == UNHEALTHY
+
+    def test_retain_suspend_and_status(self):
+        desired = obj(self.GVK, spec={})
+        observed = obj(self.GVK, spec={"suspend": True},
+                       status={"phase": "Running"})
+        out = interp().retain(desired, observed)
+        assert out.get("spec", "suspend") is True
+        assert out.get("status", "phase") == "Running"
+
+    def test_dependencies(self):
+        o = obj(self.GVK, spec={
+            "executor": {"serviceAccountName": "exec-sa"},
+            "serviceAccountName": "wf-sa",
+            "volumeClaimTemplates": [{"metadata": {"name": "work"}}],
+            "volumes": [
+                {"name": "v1", "configMap": {"name": "wf-config"}},
+                {"name": "v2", "secret": {"name": "wf-secret"}},
+                {"name": "v3", "persistentVolumeClaim": {"claimName": "data"}},
+            ],
+            "imagePullSecrets": [{"name": "pull"}],
+        })
+        deps = interp().get_dependencies(o)
+        got = {(d["kind"], d["name"]) for d in deps}
+        assert got == {
+            ("ConfigMap", "wf-config"),
+            ("Secret", "wf-secret"), ("Secret", "pull"),
+            ("ServiceAccount", "exec-sa"), ("ServiceAccount", "wf-sa"),
+            ("PersistentVolumeClaim", "work"),
+            ("PersistentVolumeClaim", "data"),
+        }
+
+    def test_default_service_account_skipped(self):
+        o = obj(self.GVK, spec={"serviceAccountName": "default"})
+        assert interp().get_dependencies(o) == []
+
+
+class TestFlinkDeployment:
+    GVK = "flink.apache.org/v1beta1/FlinkDeployment"
+
+    def test_health_states(self):
+        ri = interp()
+        running = obj(self.GVK, status={"jobStatus": {"state": "RUNNING"}})
+        assert ri.interpret_health(running) == HEALTHY
+        terminal = obj(self.GVK, status={"jobStatus": {"state": "FAILED"}})
+        assert ri.interpret_health(terminal) == HEALTHY  # terminal = settled
+        ephemeral = obj(self.GVK, status={"jobStatus": {"state": "CREATED"}})
+        assert ri.interpret_health(ephemeral) == UNHEALTHY
+        ephemeral_err = obj(self.GVK, status={
+            "jobStatus": {"state": "CREATED"}, "error": "bad image"})
+        assert ri.interpret_health(ephemeral_err) == HEALTHY
+        no_job = obj(self.GVK, status={})
+        assert ri.interpret_health(no_job) == UNHEALTHY
+
+    def test_replicas_from_parallelism_and_slots(self):
+        o = obj(self.GVK, spec={
+            "jobManager": {"resource": {"cpu": 1.0, "memory": "2Gi"}},
+            "taskManager": {"resource": {"cpu": 2.0, "memory": "4Gi"}},
+            "job": {"parallelism": 8},
+            "flinkConfiguration": {"taskmanager.numberOfTaskSlots": "2"},
+        })
+        n, req = interp().get_replicas(o)
+        assert n == 1 + 4  # 1 jobManager + ceil(8/2) taskManagers
+        assert req.resource_request["cpu"] == 2.0
+        assert req.resource_request["memory"] == 4 * 1024.0**3
+
+    def test_replicas_explicit_tm_replicas_take_precedence(self):
+        o = obj(self.GVK, spec={
+            "jobManager": {"replicas": 2, "resource": {"cpu": 1.0,
+                                                       "memory": "1Gi"}},
+            "taskManager": {"replicas": 3, "resource": {"cpu": 0.5,
+                                                        "memory": "1Gi"}},
+            "job": {"parallelism": 100},
+            "flinkConfiguration": {"taskmanager.numberOfTaskSlots": "1"},
+        })
+        n, _ = interp().get_replicas(o)
+        assert n == 5
+
+    def test_aggregate_last_wins(self):
+        tmpl = obj(self.GVK, status={})
+        items = [
+            item("m1", {"lifecycleState": "DEPLOYED",
+                        "jobStatus": {"state": "RUNNING"}}),
+        ]
+        st = interp().aggregate_status(tmpl, items).get("status")
+        assert st["lifecycleState"] == "DEPLOYED"
+        assert st["jobStatus"]["state"] == "RUNNING"
+
+
+class TestKyverno:
+    @pytest.mark.parametrize("gvk", ["kyverno.io/v1/ClusterPolicy",
+                                     "kyverno.io/v1/Policy"])
+    def test_health_ready_field_then_conditions(self, gvk):
+        ri = interp()
+        assert ri.interpret_health(obj(gvk, status={"ready": True})) == HEALTHY
+        assert ri.interpret_health(obj(gvk, status={"ready": False})) == UNHEALTHY
+        cond_ok = obj(gvk, status={"conditions": [
+            {"type": "Ready", "status": "True", "reason": "Succeeded"}]})
+        assert ri.interpret_health(cond_ok) == HEALTHY
+        assert ri.interpret_health(obj(gvk, spec={})) == UNHEALTHY
+
+    def test_aggregate_rulecount_and_conditions(self):
+        gvk = "kyverno.io/v1/ClusterPolicy"
+        tmpl = obj(gvk, status={"stale": True})
+        items = [
+            item("m1", {"ready": True,
+                        "rulecount": {"validate": 1, "generate": 0,
+                                      "mutate": 2, "verifyimages": 0},
+                        "conditions": [{"type": "Ready", "status": "True",
+                                        "reason": "Succeeded",
+                                        "message": "ok"}]}),
+            item("m2", {"rulecount": {"validate": 1, "generate": 1,
+                                      "mutate": 0, "verifyimages": 0},
+                        "conditions": [{"type": "Ready", "status": "True",
+                                        "reason": "Succeeded",
+                                        "message": "ok"}]}),
+        ]
+        st = interp().aggregate_status(tmpl, items).get("status")
+        assert "stale" not in st  # status is REPLACED, not merged
+        assert st["rulecount"] == {"validate": 2, "generate": 1, "mutate": 2,
+                                   "verifyimages": 0}
+        # same (type,status,reason) → one condition, cluster-prefixed merge
+        assert len(st["conditions"]) == 1
+        assert st["conditions"][0]["message"] == "m1=ok, m2=ok"
+
+
+class TestFluxHelmRelease:
+    GVK = "helm.toolkit.fluxcd.io/v2beta1/HelmRelease"
+
+    def test_health_requires_reconciliation_succeeded(self):
+        ri = interp()
+        ok = obj(self.GVK, status={"conditions": [
+            {"type": "Ready", "status": "True",
+             "reason": "ReconciliationSucceeded"}]})
+        assert ri.interpret_health(ok) == HEALTHY
+        wrong_reason = obj(self.GVK, status={"conditions": [
+            {"type": "Ready", "status": "True", "reason": "Succeeded"}]})
+        assert ri.interpret_health(wrong_reason) == UNHEALTHY
+
+    def test_aggregate_revisions_and_guarded_failures(self):
+        tmpl = obj(self.GVK, generation=1,
+                   status={"failures": 1, "lastAppliedRevision": "v0"})
+        items = [
+            item("m1", {"lastAppliedRevision": "v1", "failures": 2,
+                        "resourceTemplateGeneration": 1,
+                        "generation": 1, "observedGeneration": 1}),
+        ]
+        st = interp().aggregate_status(tmpl, items).get("status")
+        assert st["lastAppliedRevision"] == "v1"
+        assert st["failures"] == 3  # template 1 + member 2
+        assert st["observedGeneration"] == 1
+
+    def test_retain_suspend(self):
+        desired = obj(self.GVK, spec={})
+        observed = obj(self.GVK, spec={"suspend": True})
+        assert interp().retain(desired, observed).get("spec", "suspend") is True
+
+    def test_dependencies(self):
+        o = obj(self.GVK, spec={
+            "valuesFrom": [
+                {"kind": "Secret", "name": "vals-secret"},
+                {"kind": "ConfigMap", "name": "vals-cm"},
+            ],
+            "chart": {"spec": {"verify": {"secretRef": {"name": "cosign"}}}},
+            "kubeConfig": {"secretRef": {"name": "kc"}},
+            "serviceAccountName": "helm-sa",
+        })
+        got = {(d["kind"], d["name"]) for d in interp().get_dependencies(o)}
+        assert got == {
+            ("Secret", "vals-secret"), ("Secret", "cosign"), ("Secret", "kc"),
+            ("ConfigMap", "vals-cm"), ("ServiceAccount", "helm-sa"),
+        }
+
+
+class TestFluxKustomization:
+    GVK = "kustomize.toolkit.fluxcd.io/v1/Kustomization"
+
+    def test_aggregate_and_deps(self):
+        ri = interp()
+        tmpl = obj(self.GVK, generation=2, status={"observedGeneration": 1})
+        items = [
+            item("m1", {"lastAppliedRevision": "main@sha1:abc",
+                        "resourceTemplateGeneration": 2,
+                        "generation": 4, "observedGeneration": 4}),
+        ]
+        st = ri.aggregate_status(tmpl, items).get("status")
+        assert st["lastAppliedRevision"] == "main@sha1:abc"
+        assert st["observedGeneration"] == 2
+        o = obj(self.GVK, spec={
+            "decryption": {"secretRef": {"name": "sops"}},
+            "serviceAccountName": "kust-sa",
+        })
+        got = {(d["kind"], d["name"]) for d in ri.get_dependencies(o)}
+        assert got == {("Secret", "sops"), ("ServiceAccount", "kust-sa")}
+
+
+class TestFluxSources:
+    def test_gitrepository(self):
+        ri = interp()
+        gvk = "source.toolkit.fluxcd.io/v1/GitRepository"
+        ok = obj(gvk, status={"conditions": [
+            {"type": "Ready", "status": "True", "reason": "Succeeded"}]})
+        assert ri.interpret_health(ok) == HEALTHY
+        tmpl = obj(gvk, generation=1, status={})
+        items = [item("m1", {"artifact": {"revision": "r1"},
+                             "resourceTemplateGeneration": 1,
+                             "generation": 1, "observedGeneration": 1})]
+        st = ri.aggregate_status(tmpl, items).get("status")
+        assert st["artifact"] == {"revision": "r1"}
+        o = obj(gvk, spec={"secretRef": {"name": "git-creds"},
+                           "verify": {"secretRef": {"name": "gpg"}}})
+        got = {d["name"] for d in ri.get_dependencies(o)}
+        assert got == {"git-creds", "gpg"}
+
+    def test_bucket_url(self):
+        ri = interp()
+        gvk = "source.toolkit.fluxcd.io/v1beta2/Bucket"
+        tmpl = obj(gvk, generation=1, status={})
+        items = [item("m1", {"url": "http://u", "artifact": {"path": "p"},
+                             "resourceTemplateGeneration": 1,
+                             "generation": 1, "observedGeneration": 1})]
+        st = ri.aggregate_status(tmpl, items).get("status")
+        assert st["url"] == "http://u"
+        o = obj(gvk, spec={"secretRef": {"name": "s3-creds"}})
+        assert {d["name"] for d in ri.get_dependencies(o)} == {"s3-creds"}
+
+    def test_helmchart_health_accepts_chart_pull(self):
+        ri = interp()
+        gvk = "source.toolkit.fluxcd.io/v1beta2/HelmChart"
+        ok = obj(gvk, status={"conditions": [
+            {"type": "Ready", "status": "True",
+             "reason": "ChartPullSucceeded"}]})
+        assert ri.interpret_health(ok) == HEALTHY
+        tmpl = obj(gvk, generation=1, status={})
+        items = [item("m1", {"observedChartName": "nginx",
+                             "resourceTemplateGeneration": 1,
+                             "generation": 1, "observedGeneration": 1})]
+        st = ri.aggregate_status(tmpl, items).get("status")
+        assert st["observedChartName"] == "nginx"
+        o = obj(gvk, spec={"verify": {"secretRef": {"name": "cosign"}}})
+        assert {d["name"] for d in ri.get_dependencies(o)} == {"cosign"}
+
+    def test_helmrepository(self):
+        ri = interp()
+        gvk = "source.toolkit.fluxcd.io/v1beta2/HelmRepository"
+        o = obj(gvk, spec={"secretRef": {"name": "repo-creds"}})
+        assert {d["name"] for d in ri.get_dependencies(o)} == {"repo-creds"}
+
+    def test_ocirepository_cert_secret(self):
+        ri = interp()
+        gvk = "source.toolkit.fluxcd.io/v1beta2/OCIRepository"
+        o = obj(gvk, spec={
+            "secretRef": {"name": "oci-creds"},
+            "verify": {"secretRef": {"name": "cosign"}},
+            "certSecretRef": {"name": "tls"},
+        })
+        assert {d["name"] for d in ri.get_dependencies(o)} == {
+            "oci-creds", "cosign", "tls"
+        }
+
+    def test_suspend_retention_all_sources(self):
+        ri = interp()
+        for gvk in [g for g in REFERENCE_SET if "source.toolkit" in g
+                    or "fluxcd" in g]:
+            desired = obj(gvk, spec={})
+            observed = obj(gvk, spec={"suspend": True})
+            out = ri.retain(desired, observed)
+            assert out.get("spec", "suspend") is True, gvk
