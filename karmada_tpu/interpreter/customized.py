@@ -17,11 +17,18 @@ from __future__ import annotations
 from typing import Any, Callable, Optional
 
 from ..api.unstructured import Unstructured
-from ..api.work import AggregatedStatusItem, ReplicaRequirements
+from ..api.work import AggregatedStatusItem, NodeClaim, ReplicaRequirements
 from ..runtime.controller import DONE, Controller, Runtime
 from ..store.store import Store
 from .declarative import OPERATION_FUNCTIONS, ScriptError, compile_script
-from .interpreter import HEALTHY, KindInterpreter, UNHEALTHY, UNKNOWN, ResourceInterpreter
+from .interpreter import (
+    HEALTHY,
+    KindInterpreter,
+    ResourceInterpreter,
+    UNHEALTHY,
+    UNKNOWN,
+    _parse_quantity,
+)
 
 
 def _wrap_scripts(fns: dict[str, Callable]) -> KindInterpreter:
@@ -33,7 +40,24 @@ def _wrap_scripts(fns: dict[str, Callable]) -> KindInterpreter:
         def get_replicas(obj: Unstructured):
             replicas, req = get_rep(obj.to_dict())
             requirements = None
-            if req:
+            if req and "resourceRequest" in req:
+                # structured shape (the Lua contract returns the full
+                # ReplicaRequirements table, kube.accuratePodRequirements)
+                claim = req.get("nodeClaim") or None
+                requirements = ReplicaRequirements(
+                    node_claim=None if claim is None else NodeClaim(
+                        node_selector=dict(claim.get("nodeSelector") or {}),
+                        tolerations=list(claim.get("tolerations") or []),
+                        hard_node_affinity=claim.get("hardNodeAffinity"),
+                    ),
+                    resource_request={
+                        k: float(_parse_quantity(v))
+                        for k, v in (req.get("resourceRequest") or {}).items()
+                    },
+                    namespace=req.get("namespace") or obj.namespace,
+                    priority_class_name=req.get("priorityClassName") or "",
+                )
+            elif req:
                 requirements = ReplicaRequirements(
                     resource_request={k: float(v) for k, v in req.items()},
                     namespace=obj.namespace,
@@ -79,12 +103,24 @@ def _wrap_scripts(fns: dict[str, Callable]) -> KindInterpreter:
 
 
 def compile_customization(spec) -> KindInterpreter:
-    """Compile every script in a ResourceInterpreterCustomizationSpec."""
+    """Compile every script in a ResourceInterpreterCustomizationSpec.
+
+    Scripts are language-sniffed per rule: the reference CRD carries Lua
+    (executed by interpreter/luavm.py, so existing Karmada customizations
+    carry over unmodified); the TPU-native dialect stays available."""
+    from . import luavm
+
     fns: dict[str, Callable] = {}
     for op in OPERATION_FUNCTIONS:
         rule = getattr(spec.customizations, op, None)
         if rule is not None and rule.script:
-            fns[op] = compile_script(rule.script, op)
+            if luavm.looks_like_lua(rule.script):
+                try:
+                    fns[op] = luavm.compile_lua_script(rule.script, op)
+                except luavm.LuaError as e:
+                    raise ScriptError(str(e))
+            else:
+                fns[op] = compile_script(rule.script, op)
     if not fns:
         raise ScriptError("customization defines no scripts")
     return _wrap_scripts(fns)

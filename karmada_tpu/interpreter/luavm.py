@@ -1,0 +1,1214 @@
+"""Sandboxed Lua-subset interpreter for ResourceInterpreterCustomization.
+
+The reference executes customization scripts as Lua in a gopher-lua sandbox
+(pkg/resourceinterpreter/customized/declarative/luavm/lua.go:59-129) with a
+`kube` helper library (kube.go: accuratePodRequirements, getPodDependencies,
+getResourceQuantity). This module implements the Lua subset those scripts
+use — enough that an existing Karmada user's Lua customizations (and the
+reference's own shipped library) run unmodified:
+
+  - functions, locals, assignment, multiple return values
+  - if/elseif/else, while, numeric `for i = a, b [, step]`,
+    generic `for k, v in pairs(t)`, break
+  - tables (array + map duality, 1-based, `#` length, nil-assignment
+    deletes), constructors `{}` / `{a = 1}` / `{x, y}`
+  - operators: and/or/not, .. concat, == ~= < <= > >=, + - * / % ^,
+    unary -, #
+  - stdlib surface used by the scripts: tonumber, tostring, type, pairs,
+    ipairs, string.format/len/sub/lower/upper, math.ceil/floor/max/min/abs/
+    huge, table.insert/remove, and `require("kube")`
+
+No io/os/debug/load/metatables — the sandbox exposes ONLY the above, and
+execution is step-bounded so a runaway script cannot hang the interpreter
+(the reference relies on gopher-lua's context cancellation for the same).
+
+Data mapping (lua.go ConvertLuaResultInto equivalents): Python dicts become
+map-tables, lists become 1-based array-tables; on the way back a table whose
+keys are exactly 1..n returns a list, an empty table returns {} (callers
+normalize where the distinction matters, as the reference does by decoding
+into typed structs).
+"""
+from __future__ import annotations
+
+import math
+import re
+from typing import Any, Callable, Optional
+
+from .interpreter import _parse_quantity
+
+
+class LuaError(Exception):
+    """Compile or runtime error in a Lua customization script."""
+
+
+# ---------------------------------------------------------------------------
+# lexer
+# ---------------------------------------------------------------------------
+
+_KEYWORDS = {
+    "and", "break", "do", "else", "elseif", "end", "false", "for",
+    "function", "if", "in", "local", "nil", "not", "or", "repeat",
+    "return", "then", "true", "until", "while",
+}
+
+_TOKEN_RE = re.compile(
+    r"""
+    (?P<ws>\s+)
+  | (?P<comment>--\[\[.*?\]\]|--[^\n]*)
+  | (?P<number>0[xX][0-9a-fA-F]+|\d+\.?\d*(?:[eE][+-]?\d+)?|\.\d+)
+  | (?P<name>[A-Za-z_][A-Za-z0-9_]*)
+  | (?P<string>"(?:\\.|[^"\\])*"|'(?:\\.|[^'\\])*')
+  | (?P<op>\.\.\.|\.\.|==|~=|<=|>=|[-+*/%^#<>=(){}\[\];:,.])
+    """,
+    re.VERBOSE | re.DOTALL,
+)
+
+_ESCAPES = {"n": "\n", "t": "\t", "r": "\r", "a": "\a", "b": "\b",
+            "f": "\f", "v": "\v", "\\": "\\", '"': '"', "'": "'", "\n": "\n"}
+
+
+def _unescape(s: str) -> str:
+    out, i = [], 0
+    while i < len(s):
+        c = s[i]
+        if c == "\\" and i + 1 < len(s):
+            nxt = s[i + 1]
+            if nxt.isdigit():
+                j = i + 1
+                while j < len(s) and j < i + 4 and s[j].isdigit():
+                    j += 1
+                out.append(chr(int(s[i + 1:j])))
+                i = j
+                continue
+            out.append(_ESCAPES.get(nxt, nxt))
+            i += 2
+            continue
+        out.append(c)
+        i += 1
+    return "".join(out)
+
+
+def tokenize(src: str) -> list[tuple[str, Any, int]]:
+    """→ [(kind, value, line)]; kinds: name/keyword/number/string/op/eof."""
+    toks: list[tuple[str, Any, int]] = []
+    pos, line = 0, 1
+    while pos < len(src):
+        m = _TOKEN_RE.match(src, pos)
+        if m is None:
+            raise LuaError(f"unexpected character {src[pos]!r} at line {line}")
+        text = m.group(0)
+        if m.lastgroup == "ws" or m.lastgroup == "comment":
+            pass
+        elif m.lastgroup == "number":
+            if text.lower().startswith("0x"):
+                val: Any = int(text, 16)
+            else:
+                f = float(text)
+                val = int(f) if f.is_integer() and "." not in text and "e" not in text.lower() else f
+            toks.append(("number", val, line))
+        elif m.lastgroup == "name":
+            kind = "keyword" if text in _KEYWORDS else "name"
+            toks.append((kind, text, line))
+        elif m.lastgroup == "string":
+            toks.append(("string", _unescape(text[1:-1]), line))
+        else:
+            toks.append(("op", text, line))
+        line += text.count("\n")
+        pos = m.end()
+    toks.append(("eof", None, line))
+    return toks
+
+
+# ---------------------------------------------------------------------------
+# parser → AST (tuples: (node_kind, ...))
+# ---------------------------------------------------------------------------
+
+
+class _Parser:
+    def __init__(self, toks: list[tuple[str, Any, int]]):
+        self.toks = toks
+        self.i = 0
+
+    # -- token helpers --
+    def peek(self):
+        return self.toks[self.i]
+
+    def next(self):
+        t = self.toks[self.i]
+        self.i += 1
+        return t
+
+    def check(self, kind: str, value=None) -> bool:
+        k, v, _ = self.peek()
+        return k == kind and (value is None or v == value)
+
+    def accept(self, kind: str, value=None) -> bool:
+        if self.check(kind, value):
+            self.i += 1
+            return True
+        return False
+
+    def expect(self, kind: str, value=None):
+        k, v, ln = self.peek()
+        if k != kind or (value is not None and v != value):
+            want = value if value is not None else kind
+            raise LuaError(f"line {ln}: expected {want!r}, got {v!r}")
+        return self.next()
+
+    # -- grammar --
+    def parse_chunk(self):
+        body = self.parse_block(("eof",))
+        self.expect("eof")
+        return ("block", body)
+
+    def parse_block(self, stops: tuple[str, ...]):
+        stmts = []
+        while True:
+            k, v, _ = self.peek()
+            if k == "eof" or (k == "keyword" and v in stops):
+                break
+            if k == "keyword" and v in ("end", "else", "elseif", "until"):
+                break
+            stmts.append(self.parse_statement())
+        return stmts
+
+    def parse_statement(self):
+        k, v, ln = self.peek()
+        if k == "op" and v == ";":
+            self.next()
+            return ("nop",)
+        if k == "keyword":
+            if v == "local":
+                return self.parse_local()
+            if v == "if":
+                return self.parse_if()
+            if v == "while":
+                return self.parse_while()
+            if v == "for":
+                return self.parse_for()
+            if v == "function":
+                return self.parse_function_stmt()
+            if v == "return":
+                self.next()
+                exprs = []
+                nk, nv, _ = self.peek()
+                if not (nk == "eof" or (nk == "keyword" and nv in (
+                        "end", "else", "elseif", "until"))):
+                    exprs.append(self.parse_expr())
+                    while self.accept("op", ","):
+                        exprs.append(self.parse_expr())
+                return ("return", exprs)
+            if v == "break":
+                self.next()
+                return ("break",)
+            if v == "do":
+                self.next()
+                body = self.parse_block(())
+                self.expect("keyword", "end")
+                return ("block_stmt", body)
+            if v == "repeat":
+                self.next()
+                body = self.parse_block(("until",))
+                self.expect("keyword", "until")
+                cond = self.parse_expr()
+                return ("repeat", body, cond)
+        # expression statement: assignment or call
+        expr = self.parse_prefix_expr()
+        if self.check("op", "=") or self.check("op", ","):
+            targets = [expr]
+            while self.accept("op", ","):
+                targets.append(self.parse_prefix_expr())
+            self.expect("op", "=")
+            values = [self.parse_expr()]
+            while self.accept("op", ","):
+                values.append(self.parse_expr())
+            for t in targets:
+                if t[0] not in ("name", "index"):
+                    raise LuaError(f"line {ln}: cannot assign to {t[0]}")
+            return ("assign", targets, values)
+        if expr[0] != "call":
+            raise LuaError(f"line {ln}: syntax error (unexpected expression)")
+        return ("call_stmt", expr)
+
+    def parse_local(self):
+        self.expect("keyword", "local")
+        if self.accept("keyword", "function"):
+            _, name, _ = self.expect("name")
+            func = self.parse_function_body()
+            return ("local_function", name, func)
+        names = [self.expect("name")[1]]
+        while self.accept("op", ","):
+            names.append(self.expect("name")[1])
+        values = []
+        if self.accept("op", "="):
+            values.append(self.parse_expr())
+            while self.accept("op", ","):
+                values.append(self.parse_expr())
+        return ("local", names, values)
+
+    def parse_if(self):
+        self.expect("keyword", "if")
+        clauses = []
+        cond = self.parse_expr()
+        self.expect("keyword", "then")
+        body = self.parse_block(())
+        clauses.append((cond, body))
+        else_body = []
+        while True:
+            if self.accept("keyword", "elseif"):
+                c = self.parse_expr()
+                self.expect("keyword", "then")
+                b = self.parse_block(())
+                clauses.append((c, b))
+                continue
+            if self.accept("keyword", "else"):
+                else_body = self.parse_block(())
+            self.expect("keyword", "end")
+            break
+        return ("if", clauses, else_body)
+
+    def parse_while(self):
+        self.expect("keyword", "while")
+        cond = self.parse_expr()
+        self.expect("keyword", "do")
+        body = self.parse_block(())
+        self.expect("keyword", "end")
+        return ("while", cond, body)
+
+    def parse_for(self):
+        self.expect("keyword", "for")
+        _, first, _ = self.expect("name")
+        if self.accept("op", "="):  # numeric for
+            start = self.parse_expr()
+            self.expect("op", ",")
+            stop = self.parse_expr()
+            step = None
+            if self.accept("op", ","):
+                step = self.parse_expr()
+            self.expect("keyword", "do")
+            body = self.parse_block(())
+            self.expect("keyword", "end")
+            return ("for_num", first, start, stop, step, body)
+        names = [first]
+        while self.accept("op", ","):
+            names.append(self.expect("name")[1])
+        self.expect("keyword", "in")
+        iters = [self.parse_expr()]
+        while self.accept("op", ","):
+            iters.append(self.parse_expr())
+        self.expect("keyword", "do")
+        body = self.parse_block(())
+        self.expect("keyword", "end")
+        return ("for_in", names, iters, body)
+
+    def parse_function_stmt(self):
+        self.expect("keyword", "function")
+        _, name, _ = self.expect("name")
+        target = ("name", name)
+        while self.accept("op", "."):
+            _, attr, _ = self.expect("name")
+            target = ("index", target, ("const", attr))
+        func = self.parse_function_body()
+        return ("assign", [target], [func])
+
+    def parse_function_body(self):
+        self.expect("op", "(")
+        params = []
+        if not self.check("op", ")"):
+            params.append(self.expect("name")[1])
+            while self.accept("op", ","):
+                params.append(self.expect("name")[1])
+        self.expect("op", ")")
+        body = self.parse_block(())
+        self.expect("keyword", "end")
+        return ("function", params, body)
+
+    # -- expressions (precedence climbing) --
+
+    _BINPREC = {
+        "or": 1, "and": 2,
+        "<": 3, ">": 3, "<=": 3, ">=": 3, "~=": 3, "==": 3,
+        "..": 4,
+        "+": 5, "-": 5,
+        "*": 6, "/": 6, "%": 6,
+        "^": 8,
+    }
+
+    def parse_expr(self, min_prec: int = 0):
+        left = self.parse_unary()
+        while True:
+            k, v, _ = self.peek()
+            op = v if (k == "op" or (k == "keyword" and v in ("and", "or"))) else None
+            prec = self._BINPREC.get(op or "", 0)
+            if prec == 0 or prec < min_prec:
+                return left
+            self.next()
+            # right-assoc for .. and ^
+            nxt = prec if op in ("..", "^") else prec + 1
+            right = self.parse_expr(nxt)
+            left = ("binop", op, left, right)
+
+    def parse_unary(self):
+        k, v, _ = self.peek()
+        if (k == "keyword" and v == "not") or (k == "op" and v in ("-", "#")):
+            self.next()
+            operand = self.parse_unary()
+            return ("unop", v, operand)
+        return self.parse_power()
+
+    def parse_power(self):
+        base = self.parse_prefix_expr()
+        if self.check("op", "^"):
+            self.next()
+            exp = self.parse_unary()
+            return ("binop", "^", base, exp)
+        return base
+
+    def parse_prefix_expr(self):
+        k, v, ln = self.peek()
+        if k == "number" or k == "string":
+            self.next()
+            expr = ("const", v)
+        elif k == "keyword" and v in ("nil", "true", "false"):
+            self.next()
+            expr = ("const", {"nil": None, "true": True, "false": False}[v])
+        elif k == "keyword" and v == "function":
+            self.next()
+            expr = self.parse_function_body()
+        elif k == "op" and v == "(":
+            self.next()
+            expr = ("paren", self.parse_expr())
+            self.expect("op", ")")
+        elif k == "op" and v == "{":
+            expr = self.parse_table()
+        elif k == "name":
+            self.next()
+            expr = ("name", v)
+        else:
+            raise LuaError(f"line {ln}: unexpected token {v!r}")
+        # suffixes: .name  [expr]  (args)  'str'  {table}  :method(args)
+        while True:
+            if self.accept("op", "."):
+                _, attr, _ = self.expect("name")
+                expr = ("index", expr, ("const", attr))
+            elif self.accept("op", "["):
+                idx = self.parse_expr()
+                self.expect("op", "]")
+                expr = ("index", expr, idx)
+            elif self.check("op", "("):
+                self.next()
+                args = []
+                if not self.check("op", ")"):
+                    args.append(self.parse_expr())
+                    while self.accept("op", ","):
+                        args.append(self.parse_expr())
+                self.expect("op", ")")
+                expr = ("call", expr, args)
+            elif self.check("string"):
+                _, s, _ = self.next()
+                expr = ("call", expr, [("const", s)])
+            elif self.check("op", ":"):
+                self.next()
+                _, meth, _ = self.expect("name")
+                self.expect("op", "(")
+                args = []
+                if not self.check("op", ")"):
+                    args.append(self.parse_expr())
+                    while self.accept("op", ","):
+                        args.append(self.parse_expr())
+                self.expect("op", ")")
+                expr = ("method_call", expr, meth, args)
+            else:
+                return expr
+
+    def parse_table(self):
+        self.expect("op", "{")
+        array_items, hash_items = [], []
+        while not self.check("op", "}"):
+            k, v, _ = self.peek()
+            if k == "name" and self.toks[self.i + 1][:2] == ("op", "="):
+                self.next()
+                self.next()
+                hash_items.append((("const", v), self.parse_expr()))
+            elif k == "op" and v == "[":
+                self.next()
+                key = self.parse_expr()
+                self.expect("op", "]")
+                self.expect("op", "=")
+                hash_items.append((key, self.parse_expr()))
+            else:
+                array_items.append(self.parse_expr())
+            if not (self.accept("op", ",") or self.accept("op", ";")):
+                break
+        self.expect("op", "}")
+        return ("table", array_items, hash_items)
+
+
+# ---------------------------------------------------------------------------
+# runtime values
+# ---------------------------------------------------------------------------
+
+
+class LuaTable:
+    """Array+map duality over one dict; integer keys stay integers."""
+
+    __slots__ = ("data",)
+
+    def __init__(self, data: Optional[dict] = None):
+        self.data = data if data is not None else {}
+
+    def get(self, key):
+        return self.data.get(_normkey(key))
+
+    def set(self, key, value):
+        key = _normkey(key)
+        if key is None:
+            raise LuaError("table index is nil")
+        if value is None:
+            self.data.pop(key, None)
+        else:
+            self.data[key] = value
+
+    def length(self) -> int:
+        n = 0
+        while (n + 1) in self.data:
+            n += 1
+        return n
+
+    def __repr__(self):
+        return f"LuaTable({self.data!r})"
+
+
+def _normkey(key):
+    if isinstance(key, float) and key.is_integer():
+        return int(key)
+    return key
+
+
+def to_lua(value: Any) -> Any:
+    """Python JSON-ish value → Lua value (lists become 1-based tables)."""
+    if isinstance(value, dict):
+        return LuaTable({k: to_lua(v) for k, v in value.items()})
+    if isinstance(value, (list, tuple)):
+        return LuaTable({i + 1: to_lua(v) for i, v in enumerate(value)})
+    return value
+
+
+def from_lua(value: Any) -> Any:
+    """Lua value → Python. A table keyed exactly 1..n → list; else dict
+    (empty table → {})."""
+    if not isinstance(value, LuaTable):
+        return value
+    data = value.data
+    n = len(data)
+    if n and all(isinstance(k, int) for k in data):
+        if set(data) == set(range(1, n + 1)):
+            return [from_lua(data[i]) for i in range(1, n + 1)]
+    return {str(k): from_lua(v) for k, v in data.items()}
+
+
+class _LuaFunction:
+    __slots__ = ("params", "body", "env", "vm")
+
+    def __init__(self, params, body, env, vm):
+        self.params = params
+        self.body = body
+        self.env = env
+        self.vm = vm
+
+    def __call__(self, *args):
+        return self.vm.call(self, list(args))
+
+
+class _Env:
+    __slots__ = ("vars", "parent")
+
+    def __init__(self, parent=None):
+        self.vars: dict[str, Any] = {}
+        self.parent = parent
+
+    def lookup(self, name):
+        env = self
+        while env is not None:
+            if name in env.vars:
+                return env.vars[name]
+            env = env.parent
+        return None
+
+    def assign(self, name, value):
+        env = self
+        while env is not None:
+            if name in env.vars:
+                env.vars[name] = value
+                return
+            env = env.parent
+        # undeclared → global (outermost)
+        env = self
+        while env.parent is not None:
+            env = env.parent
+        env.vars[name] = value
+
+    def declare(self, name, value):
+        self.vars[name] = value
+
+
+class _Break(Exception):
+    pass
+
+
+class _Return(Exception):
+    def __init__(self, values):
+        self.values = values
+
+
+# ---------------------------------------------------------------------------
+# evaluator
+# ---------------------------------------------------------------------------
+
+MAX_STEPS = 2_000_000  # statement+expression budget per top-level call
+
+
+class LuaVM:
+    """One sandboxed script: parse once, call its functions many times."""
+
+    def __init__(self, source: str):
+        try:
+            self.ast = _Parser(tokenize(source)).parse_chunk()
+        except LuaError:
+            raise
+        except RecursionError:
+            raise LuaError("script nesting too deep")
+        self.globals = _Env()
+        for name, value in _stdlib().items():
+            self.globals.declare(name, value)
+        self._steps = 0
+        # run the chunk body (defines functions, requires libraries)
+        self._steps_reset()
+        try:
+            self.exec_block(self.ast[1], self.globals)
+        except RecursionError:
+            raise LuaError("script recursion too deep")
+
+    # -- public --
+
+    def function(self, name: str) -> Callable:
+        fn = self.globals.lookup(name)
+        if not isinstance(fn, _LuaFunction):
+            raise LuaError(f"script does not define function {name!r}")
+
+        def invoke(*py_args):
+            self._steps_reset()
+            try:
+                out = self.call(fn, [to_lua(a) for a in py_args])
+            except RecursionError:
+                # Python's stack limit trips before MAX_STEPS on deep
+                # recursion — keep it a script error, not a host crash
+                raise LuaError("script recursion too deep")
+            return [from_lua(v) for v in out]
+
+        return invoke
+
+    # -- internals --
+
+    def _steps_reset(self):
+        self._steps = 0
+
+    def _tick(self):
+        self._steps += 1
+        if self._steps > MAX_STEPS:
+            raise LuaError("script exceeded execution budget")
+
+    def call(self, fn: _LuaFunction, args: list):
+        env = _Env(parent=fn.env)
+        for i, p in enumerate(fn.params):
+            env.declare(p, args[i] if i < len(args) else None)
+        try:
+            self.exec_block(fn.body, env)
+        except _Return as r:
+            return r.values
+        return []
+
+    def exec_block(self, stmts, env):
+        for st in stmts:
+            self._tick()
+            self.exec_stmt(st, env)
+
+    def exec_stmt(self, st, env):
+        kind = st[0]
+        if kind == "nop":
+            return
+        if kind == "local":
+            _, names, value_exprs = st
+            values = self._eval_list(value_exprs, env, want=len(names))
+            for n, v in zip(names, values):
+                env.declare(n, v)
+            return
+        if kind == "local_function":
+            _, name, func_ast = st
+            env.declare(name, None)
+            env.vars[name] = _LuaFunction(func_ast[1], func_ast[2], env, self)
+            return
+        if kind == "assign":
+            _, targets, value_exprs = st
+            values = self._eval_list(value_exprs, env, want=len(targets))
+            for t, v in zip(targets, values):
+                if t[0] == "name":
+                    env.assign(t[1], v)
+                else:  # index
+                    obj = self.eval(t[1], env)
+                    if not isinstance(obj, LuaTable):
+                        raise LuaError(
+                            f"attempt to index a {_typename(obj)} value"
+                        )
+                    obj.set(self.eval(t[2], env), v)
+            return
+        if kind == "call_stmt":
+            self.eval(st[1], env)
+            return
+        if kind == "if":
+            _, clauses, else_body = st
+            for cond, body in clauses:
+                if _truthy(self.eval(cond, env)):
+                    self.exec_block(body, _Env(env))
+                    return
+            self.exec_block(else_body, _Env(env))
+            return
+        if kind == "while":
+            _, cond, body = st
+            while _truthy(self.eval(cond, env)):
+                self._tick()
+                try:
+                    self.exec_block(body, _Env(env))
+                except _Break:
+                    break
+            return
+        if kind == "repeat":
+            _, body, cond = st
+            while True:
+                self._tick()
+                scope = _Env(env)
+                try:
+                    self.exec_block(body, scope)
+                except _Break:
+                    break
+                if _truthy(self.eval(cond, scope)):
+                    break
+            return
+        if kind == "for_num":
+            _, var, start_e, stop_e, step_e, body = st
+            start = _tonum(self.eval(start_e, env), "for start")
+            stop = _tonum(self.eval(stop_e, env), "for stop")
+            step = _tonum(self.eval(step_e, env), "for step") if step_e else 1
+            if step == 0:
+                raise LuaError("for step is zero")
+            i = start
+            while (step > 0 and i <= stop) or (step < 0 and i >= stop):
+                self._tick()
+                scope = _Env(env)
+                scope.declare(var, i)
+                try:
+                    self.exec_block(body, scope)
+                except _Break:
+                    break
+                i += step
+            return
+        if kind == "for_in":
+            _, names, iter_exprs, body = st
+            iterator = self.eval(iter_exprs[0], env)
+            if not hasattr(iterator, "__iter__"):
+                raise LuaError("for-in expects an iterator (use pairs/ipairs)")
+            for pair in iterator:
+                self._tick()
+                scope = _Env(env)
+                vals = list(pair) if isinstance(pair, tuple) else [pair]
+                for j, n in enumerate(names):
+                    scope.declare(n, vals[j] if j < len(vals) else None)
+                try:
+                    self.exec_block(body, scope)
+                except _Break:
+                    break
+            return
+        if kind == "return":
+            values = self._eval_list(st[1], env, want=None)
+            raise _Return(values)
+        if kind == "break":
+            raise _Break()
+        if kind == "block_stmt":
+            self.exec_block(st[1], _Env(env))
+            return
+        raise LuaError(f"unknown statement {kind}")
+
+    def _eval_list(self, exprs, env, want: Optional[int]):
+        """Evaluate an expression list with Lua multi-value semantics: the
+        LAST expression expands its multiple returns, earlier ones truncate
+        to one value."""
+        values: list = []
+        for i, e in enumerate(exprs):
+            v = self.eval(e, env, multi=(i == len(exprs) - 1))
+            if isinstance(v, _Multi):
+                values.extend(v.values if i == len(exprs) - 1 else v.values[:1])
+            else:
+                values.append(v)
+        if want is not None:
+            while len(values) < want:
+                values.append(None)
+        return values
+
+    def eval(self, expr, env, multi: bool = False):
+        self._tick()
+        kind = expr[0]
+        if kind == "const":
+            return expr[1]
+        if kind == "name":
+            return env.lookup(expr[1])
+        if kind == "paren":
+            v = self.eval(expr[1], env)
+            return v.values[0] if isinstance(v, _Multi) and v.values else (
+                None if isinstance(v, _Multi) else v
+            )
+        if kind == "index":
+            obj = self.eval(expr[1], env)
+            key = self.eval(expr[2], env)
+            if isinstance(obj, LuaTable):
+                return obj.get(key)
+            if isinstance(obj, dict):  # host library (kube/math/…)
+                return obj.get(key)
+            if obj is None:
+                raise LuaError(
+                    f"attempt to index a nil value ({_describe(expr[1])})"
+                )
+            if isinstance(obj, str):
+                raise LuaError("attempt to index a string value")
+            raise LuaError(f"attempt to index a {_typename(obj)} value")
+        if kind == "call":
+            fn = self.eval(expr[1], env)
+            args = self._eval_list(expr[2], env, want=None)
+            return self._invoke(fn, args, expr[1], multi)
+        if kind == "method_call":
+            obj = self.eval(expr[1], env)
+            if isinstance(obj, str):
+                lib = _STRING_METHODS.get(expr[2])
+                if lib is None:
+                    raise LuaError(f"unknown string method {expr[2]!r}")
+                args = [obj] + self._eval_list(expr[3], env, want=None)
+                return lib(*args)
+            raise LuaError("method calls are only supported on strings")
+        if kind == "function":
+            return _LuaFunction(expr[1], expr[2], env, self)
+        if kind == "table":
+            _, array_items, hash_items = expr
+            t = LuaTable()
+            idx = 1
+            for i, e in enumerate(array_items):
+                v = self.eval(e, env, multi=(i == len(array_items) - 1))
+                if isinstance(v, _Multi):
+                    for mv in v.values:
+                        t.set(idx, mv)
+                        idx += 1
+                else:
+                    t.set(idx, v)
+                    idx += 1
+            for key_e, val_e in hash_items:
+                t.set(self.eval(key_e, env), self.eval(val_e, env))
+            return t
+        if kind == "binop":
+            return self._binop(expr, env)
+        if kind == "unop":
+            op = expr[1]
+            v = self.eval(expr[2], env)
+            if op == "not":
+                return not _truthy(v)
+            if op == "-":
+                return -_tonum(v, "unary minus")
+            if op == "#":
+                if isinstance(v, LuaTable):
+                    return v.length()
+                if isinstance(v, str):
+                    return len(v)
+                raise LuaError(f"attempt to get length of a {_typename(v)} value")
+        raise LuaError(f"unknown expression {kind}")
+
+    def _invoke(self, fn, args, fn_expr, multi: bool):
+        if isinstance(fn, _LuaFunction):
+            out = self.call(fn, args)
+            if multi:
+                return _Multi(out)
+            return out[0] if out else None
+        if callable(fn):
+            out = fn(*args)
+            if isinstance(out, tuple):
+                return _Multi(list(out)) if multi else (
+                    out[0] if out else None
+                )
+            return out
+        raise LuaError(f"attempt to call a {_typename(fn)} value "
+                       f"({_describe(fn_expr)})")
+
+    def _binop(self, expr, env):
+        op = expr[1]
+        if op == "and":
+            left = self.eval(expr[2], env)
+            return self.eval(expr[3], env) if _truthy(left) else left
+        if op == "or":
+            left = self.eval(expr[2], env)
+            return left if _truthy(left) else self.eval(expr[3], env)
+        a = self.eval(expr[2], env)
+        b = self.eval(expr[3], env)
+        if op == "==":
+            return _lua_eq(a, b)
+        if op == "~=":
+            return not _lua_eq(a, b)
+        if op == "..":
+            return _tostr_concat(a) + _tostr_concat(b)
+        if op in ("<", "<=", ">", ">="):
+            if isinstance(a, str) and isinstance(b, str):
+                pass
+            elif isinstance(a, (int, float)) and isinstance(b, (int, float)) \
+                    and not isinstance(a, bool) and not isinstance(b, bool):
+                pass
+            else:
+                raise LuaError(
+                    f"attempt to compare {_typename(a)} with {_typename(b)}"
+                )
+            return {"<": a < b, "<=": a <= b, ">": a > b, ">=": a >= b}[op]
+        x = _tonum(a, f"arithmetic on {_typename(a)}")
+        y = _tonum(b, f"arithmetic on {_typename(b)}")
+        if op == "+":
+            return x + y
+        if op == "-":
+            return x - y
+        if op == "*":
+            return x * y
+        if op == "/":
+            if y == 0:  # Lua float division: 1/0 == inf, 0/0 == nan
+                return math.nan if x == 0 else math.copysign(math.inf, x)
+            return x / y
+        if op == "%":
+            if y == 0:
+                return math.nan
+            return x - math.floor(x / y) * y
+        if op == "^":
+            return float(x) ** float(y)
+        raise LuaError(f"unknown operator {op}")
+
+
+class _Multi:
+    __slots__ = ("values",)
+
+    def __init__(self, values):
+        self.values = values
+
+
+def _truthy(v) -> bool:
+    return v is not None and v is not False
+
+
+def _typename(v) -> str:
+    if v is None:
+        return "nil"
+    if isinstance(v, bool):
+        return "boolean"
+    if isinstance(v, (int, float)):
+        return "number"
+    if isinstance(v, str):
+        return "string"
+    if isinstance(v, LuaTable):
+        return "table"
+    return "function" if callable(v) else type(v).__name__
+
+
+def _describe(expr) -> str:
+    if expr[0] == "name":
+        return expr[1]
+    if expr[0] == "index" and expr[2][0] == "const":
+        return f"field {expr[2][1]!r}"
+    return expr[0]
+
+
+def _lua_eq(a, b) -> bool:
+    if isinstance(a, bool) or isinstance(b, bool):
+        return a is b
+    if isinstance(a, (int, float)) and isinstance(b, (int, float)):
+        return a == b
+    if type(a) is not type(b):
+        return False
+    if isinstance(a, LuaTable):
+        return a is b
+    return a == b
+
+
+def _tonum(v, what: str):
+    if isinstance(v, (int, float)) and not isinstance(v, bool):
+        return v
+    if isinstance(v, str):
+        n = _lua_tonumber(v)
+        if n is not None:
+            return n
+    raise LuaError(f"attempt to perform {what}")
+
+
+def _tostr_concat(v) -> str:
+    if isinstance(v, str):
+        return v
+    if isinstance(v, (int, float)) and not isinstance(v, bool):
+        return _numstr(v)
+    raise LuaError(f"attempt to concatenate a {_typename(v)} value")
+
+
+def _numstr(v) -> str:
+    if isinstance(v, int):
+        return str(v)
+    if float(v).is_integer():
+        return str(v)  # Lua prints 2.0 as "2.0"
+    return repr(v)
+
+
+def _lua_tonumber(v, base=None):
+    if base is not None:
+        try:
+            return int(str(v), int(base))
+        except (TypeError, ValueError):
+            return None
+    if isinstance(v, (int, float)) and not isinstance(v, bool):
+        return v
+    if isinstance(v, str):
+        s = v.strip()
+        try:
+            if s.lower().startswith("0x"):
+                return int(s, 16)
+            f = float(s)
+            return int(f) if f.is_integer() and "." not in s and "e" not in s.lower() else f
+        except ValueError:
+            return None
+    return None
+
+
+# ---------------------------------------------------------------------------
+# stdlib + kube library (kube.go)
+# ---------------------------------------------------------------------------
+
+
+def _lua_tostring(v) -> str:
+    if v is None:
+        return "nil"
+    if isinstance(v, bool):
+        return "true" if v else "false"
+    if isinstance(v, (int, float)):
+        return _numstr(v)
+    if isinstance(v, str):
+        return v
+    return _typename(v)
+
+
+def _pairs(t):
+    if not isinstance(t, LuaTable):
+        raise LuaError(f"bad argument to 'pairs' ({_typename(t)})")
+    return iter([(k, v) for k, v in t.data.items()])
+
+
+def _ipairs(t):
+    if not isinstance(t, LuaTable):
+        raise LuaError(f"bad argument to 'ipairs' ({_typename(t)})")
+    out = []
+    i = 1
+    while i in t.data:
+        out.append((i, t.data[i]))
+        i += 1
+    return iter(out)
+
+
+def _table_insert(t, *args):
+    if not isinstance(t, LuaTable):
+        raise LuaError("bad argument to 'table.insert'")
+    if len(args) == 1:
+        t.set(t.length() + 1, args[0])
+    else:
+        pos, v = int(args[0]), args[1]
+        n = t.length()
+        for i in range(n, pos - 1, -1):
+            t.set(i + 1, t.get(i))
+        t.set(pos, v)
+
+
+def _table_remove(t, pos=None):
+    if not isinstance(t, LuaTable):
+        raise LuaError("bad argument to 'table.remove'")
+    n = t.length()
+    if n == 0:
+        return None
+    p = int(pos) if pos is not None else n
+    v = t.get(p)
+    for i in range(p, n):
+        t.set(i, t.get(i + 1))
+    t.set(n, None)
+    return v
+
+
+def _string_format(fmt, *args):
+    # Lua %s/%d/%f/%g/%x + %% are what scripts use; map to printf-style
+    try:
+        return fmt % args
+    except (TypeError, ValueError) as e:
+        raise LuaError(f"string.format: {e}")
+
+
+def _string_sub(s, i, j=-1):
+    n = len(s)
+    i, j = int(i), int(j)
+    if i < 0:
+        i = max(n + i + 1, 1)
+    elif i == 0:
+        i = 1
+    if j < 0:
+        j = n + j + 1
+    elif j > n:
+        j = n
+    if i > j:
+        return ""
+    return s[i - 1:j]
+
+
+_STRING_METHODS = {
+    "format": _string_format,
+    "sub": _string_sub,
+    "len": lambda s: len(s),
+    "lower": lambda s: s.lower(),
+    "upper": lambda s: s.upper(),
+}
+
+
+def _kube_accurate_pod_requirements(pod_template):
+    """kube.accuratePodRequirements(podTemplateSpec) → the full
+    ReplicaRequirements table (kube.go:78-102): resourceRequest summed over
+    containers, nodeClaim from nodeSelector/tolerations(/affinity), plus
+    namespace/priorityClassName when present."""
+    tpl = from_lua(pod_template) or {}
+    spec = tpl.get("spec") or {}
+    request: dict = {}
+    for c in spec.get("containers") or []:
+        for k, v in (c.get("resources", {}).get("requests") or {}).items():
+            request[k] = request.get(k, 0.0) + _parse_quantity(v)
+    out: dict = {"resourceRequest": request}
+    node_claim: dict = {}
+    if spec.get("nodeSelector"):
+        node_claim["nodeSelector"] = spec["nodeSelector"]
+    if spec.get("tolerations"):
+        node_claim["tolerations"] = spec["tolerations"]
+    if spec.get("affinity"):
+        node_claim["hardNodeAffinity"] = spec["affinity"]
+    if node_claim:
+        out["nodeClaim"] = node_claim
+    if spec.get("priorityClassName"):
+        out["priorityClassName"] = spec["priorityClassName"]
+    return to_lua(out)
+
+
+def _kube_get_pod_dependencies(pod_template, namespace):
+    from .thirdparty import _pod_spec_dependencies
+
+    tpl = from_lua(pod_template) or {}
+    ns = namespace if isinstance(namespace, str) and namespace else "default"
+    deps = _pod_spec_dependencies(tpl.get("spec") or {}, ns)
+    return to_lua(deps)
+
+
+def _kube_get_resource_quantity(q):
+    """kube.getResourceQuantity (kube.go:134-155)."""
+    if q is None:
+        return 0.0
+    try:
+        return float(_parse_quantity(q))
+    except (ValueError, TypeError) as e:
+        raise LuaError(f"getResourceQuantity: {e}")
+
+
+_KUBE_LIB = {
+    "accuratePodRequirements": _kube_accurate_pod_requirements,
+    "getPodDependencies": _kube_get_pod_dependencies,
+    "getResourceQuantity": _kube_get_resource_quantity,
+}
+
+
+def _require(name):
+    if name == "kube":
+        return dict(_KUBE_LIB)
+    raise LuaError(f"module {name!r} is not available in the sandbox")
+
+
+def _stdlib() -> dict:
+    return {
+        "tonumber": _lua_tonumber,
+        "tostring": _lua_tostring,
+        "type": _typename,
+        "pairs": _pairs,
+        "ipairs": _ipairs,
+        "require": _require,
+        "math": {
+            "ceil": lambda x: int(math.ceil(_tonum(x, "math.ceil"))),
+            "floor": lambda x: int(math.floor(_tonum(x, "math.floor"))),
+            "max": lambda *a: max(_tonum(x, "math.max") for x in a),
+            "min": lambda *a: min(_tonum(x, "math.min") for x in a),
+            "abs": lambda x: abs(_tonum(x, "math.abs")),
+            "huge": math.inf,
+        },
+        "string": dict(_STRING_METHODS),
+        "table": {"insert": _table_insert, "remove": _table_remove},
+    }
+
+
+# ---------------------------------------------------------------------------
+# operation adapters (lua.go:59-129 — one function per operation)
+# ---------------------------------------------------------------------------
+
+LUA_OPERATION_FUNCTIONS = {
+    "replica_resource": "GetReplicas",
+    "replica_revision": "ReviseReplica",
+    "retention": "Retain",
+    "status_aggregation": "AggregateStatus",
+    "status_reflection": "ReflectStatus",
+    "health_interpretation": "InterpretHealth",
+    "dependency_interpretation": "GetDependencies",
+}
+
+
+def looks_like_lua(source: str) -> bool:
+    """Heuristic language sniff for CustomizationRule scripts: the reference
+    CRD carries Lua; our dialect carries Python `def`s."""
+    if re.search(r"^\s*def\s+\w+\s*\(", source, re.MULTILINE):
+        return False
+    return bool(
+        re.search(r"\bfunction\s+\w+\s*\(", source)
+        or re.search(r"\blocal\s+\w+", source)
+    )
+
+
+def compile_lua_script(source: str, operation: str) -> Callable:
+    """Compile one Lua customization script → a dict-level callable with the
+    same contract as declarative.compile_script (the `_wrap_scripts`
+    adapter consumes either)."""
+    fn_name = LUA_OPERATION_FUNCTIONS.get(operation)
+    if fn_name is None:
+        raise LuaError(f"unknown operation {operation!r}")
+    vm = LuaVM(source)
+    fn = vm.function(fn_name)
+
+    if operation == "replica_resource":
+        def replica_resource(obj: dict):
+            out = fn(obj)
+            replicas = out[0] if out else 0
+            requirement = out[1] if len(out) > 1 else None
+            return replicas, requirement
+        return replica_resource
+
+    if operation == "status_aggregation":
+        def status_aggregation(obj: dict, items: list):
+            # lua.go passes nil when there are no status items
+            out = fn(obj, items if items else None)
+            return out[0] if out else obj
+        return status_aggregation
+
+    def single(*args):
+        out = fn(*args)
+        return out[0] if out else None
+
+    return single

