@@ -5,14 +5,21 @@ north-star metric (10k ResourceBindings x 5k clusters, < 1 s p99 on TPU
 v5e-1). Every number times `ArrayScheduler.schedule()` END TO END — host
 encode, device solve, decision decode — not just the kernel.
 
-| config   | BASELINE.md row                                             |
-|----------|-------------------------------------------------------------|
-| dup3     | 1: samples/nginx x 3 members, Duplicated strategy           |
-| static   | 2: Divided/Weighted static split, 100 clusters x 1k rb      |
-| dynamic  | 3: Divided/Aggregated via estimator fan-out, 1k clusters    |
-| spread   | 4: SpreadConstraint multi-dim HA, 5k clusters x 5k rb       |
-| churn    | 5: steady-state reschedule replay, 5k x 10k with prev state |
-| flagship | north-star: mixed 10k x 5k                                  |
+| config        | BASELINE.md row                                             |
+|---------------|-------------------------------------------------------------|
+| dup3          | 1: samples/nginx x 3 members, Duplicated strategy           |
+| static        | 2: Divided/Weighted static split, 100 clusters x 1k rb      |
+| dynamic       | 3: Divided/Aggregated via estimator fan-out, 1k clusters —  |
+|               |    the answers cross the wire-compatible gRPC seam INSIDE   |
+|               |    the measured round (sharded estimator daemons)           |
+| spread        | 4: SpreadConstraint multi-dim HA, 5k clusters x 5k rb, 200  |
+|               |    distinct constraint tuples (dedup-adversarial)           |
+| spread_skewed | 4b: same round on a skewed fleet (one mega region + 30 tiny |
+|               |    ones) — the r3 verdict's missing hard case               |
+| churn         | 5: steady-state reschedule replay, 5k x 10k with prev state |
+| flagship_cold | north-star with the per-placement encode cache defeated     |
+|               |    (every iteration re-encodes genuinely-dirty bindings)    |
+| flagship      | north-star: mixed 10k x 5k                                  |
 
 The reference has no batched path at all (SURVEY §6): its per-binding loop
 pays an O(C) snapshot deep-copy + sequential filter/score per binding
@@ -156,66 +163,116 @@ def build_static(seed=0):
     return ArrayScheduler(clusters), bindings, None
 
 
-def build_dynamic(seed=0):
-    """Config 3: Divided/Aggregated dynamic division with the node-level
-    estimator fan-out (accurate.go's goroutine-per-cluster as a thread pool
-    over per-member AccurateEstimators on heterogeneous synthetic nodes)."""
-    from types import SimpleNamespace
+def _shard_nodes(seed: int, cluster_name: str):
+    """Deterministic heterogeneous node pool for one member cluster (both
+    the parent and the estimator-server shards rebuild it from the seed)."""
+    import zlib
 
     from karmada_tpu.api.meta import CPU, MEMORY, PODS
-    from karmada_tpu.estimator.accurate import AccurateEstimator
-    from karmada_tpu.estimator.client import EstimatorRegistry, MemberEstimators
     from karmada_tpu.models.nodes import NodeSpec
+
+    GiB = 1024.0**3
+    # crc32, not hash(): str hashing is randomized per process, and the
+    # spawned daemon must rebuild the same pools as any parent-side caller
+    rng = np.random.default_rng((seed, zlib.crc32(cluster_name.encode())))
+    return [
+        NodeSpec(
+            name=f"{cluster_name}-n{k}",
+            allocatable={
+                CPU: float(rng.choice([8.0, 16.0, 32.0])),
+                MEMORY: float(rng.choice([32.0, 64.0])) * GiB,
+                PODS: 110.0,
+            },
+        )
+        for k in range(int(rng.integers(2, 6)))
+    ]
+
+
+def _estimator_shard_main(seed, cluster_names, port_queue):
+    """One karmada-scheduler-estimator 'daemon' process serving a shard of
+    member clusters over the wire-compatible gRPC contract."""
+    from karmada_tpu.estimator.accurate import AccurateEstimator
+    from karmada_tpu.estimator.service import EstimatorServer
+
+    estimators = {
+        n: AccurateEstimator(_shard_nodes(seed, n)) for n in cluster_names
+    }
+    server = EstimatorServer(estimators, max_workers=16)
+    port_queue.put(server.start())
+    import time as _t
+
+    while True:
+        _t.sleep(3600)
+
+
+def build_dynamic(seed=0):
+    """Config 3: Divided/Aggregated dynamic division with the estimator
+    answers arriving OVER THE WIRE inside the measured round: a spawned
+    estimator-daemon process answers over the gRPC seam every iteration.
+
+    The wire shape is the batched method (one RPC per server covering its
+    shard × all distinct requirements — estimator.proto's additive
+    BatchMaxAvailableReplicas; the reference's per-(binding, cluster) RPC
+    costs ~0.35 ms of CPU in grpc-python and this sandbox has ONE core
+    shared by client and server, so the singular fan-out measures mostly
+    RPC framing: 3000 calls ≈ 1.05 s regardless of sharding. The singular
+    contract stays measured by scripts/bench_grpc_seam.py and the mTLS
+    tests)."""
+    import multiprocessing as mp
+
+    from karmada_tpu.api.meta import CPU
+    from karmada_tpu.api.work import ReplicaRequirements
+    from karmada_tpu.estimator.service import GrpcSchedulerEstimator
     from karmada_tpu.sched.core import ArrayScheduler
     from karmada_tpu.testing.fixtures import synthetic_fleet
 
-    GiB = 1024.0**3
     rng = np.random.default_rng(seed)
     clusters = synthetic_fleet(1000, seed=seed)
     names = [c.name for c in clusters]
-    members = {}
-    for ci, c in enumerate(clusters):
-        n_nodes = int(rng.integers(2, 6))  # heterogeneous node pools
-        nodes = [
-            NodeSpec(
-                name=f"{c.name}-n{k}",
-                allocatable={
-                    CPU: float(rng.choice([8.0, 16.0, 32.0])),
-                    MEMORY: float(rng.choice([32.0, 64.0])) * GiB,
-                    PODS: 110.0,
-                },
-            )
-            for k in range(n_nodes)
-        ]
-        members[c.name] = SimpleNamespace(node_estimator=AccurateEstimator(nodes))
-    registry = EstimatorRegistry()
-    registry.register_replica_estimator("member-nodes", MemberEstimators(members))
 
+    ctx = mp.get_context("spawn")  # no forked JAX/TPU state in the daemon
+    q = ctx.Queue()
+    ctx.Process(
+        target=_estimator_shard_main, args=(seed, names, q), daemon=True
+    ).start()
+    port = q.get(timeout=180)
+    client = GrpcSchedulerEstimator(lambda c: f"127.0.0.1:{port}", timeout=5.0)
+
+    cpus = [0.25, 0.5, 1.0]
     bindings = [
         _binding(i, int(rng.integers(1, 64)),
                  _dyn_placement(aggregated=(i % 2 == 0)),
-                 float(rng.choice([0.25, 0.5, 1.0])))
+                 float(rng.choice(cpus)))
         for i in range(1000)
     ]
     sched = ArrayScheduler(clusters)
 
+    reqs = [ReplicaRequirements(resource_request={CPU: c}) for c in cpus]
+    row_req = np.asarray(
+        [cpus.index(rb.spec.replica_requirements.resource_request[CPU])
+         for rb in bindings]
+    )
+
     def extra_fn():
-        return registry.batch_estimates(bindings, names)
+        # the measured window: the answer matrix crosses the wire, rows
+        # gather to their binding's requirement class
+        answers = client.batch_max_available_replicas(names, reqs)
+        return answers[row_req]
 
     return sched, bindings, extra_fn
 
 
-def build_spread(seed=0, n_clusters=5000, n_bindings=5000):
-    """Config 4: multi-dim HA — region spread (+ cluster MinGroups) over the
-    full fleet; 70% Duplicated HA apps, 30% dynamic-divided."""
+def _spread_placements(rng, n_placements: int):
+    """n_placements DISTINCT (rmin, rmax, cmin, divided) constraint tuples —
+    a real fleet's policy diversity; 10 cycled templates let the row-content
+    dedup collapse the combination search (VERDICT r3 weak #1)."""
     _, _, _, pol, *_ = _api()
-    from karmada_tpu.sched.core import ArrayScheduler
-    from karmada_tpu.testing.fixtures import synthetic_fleet
-
-    rng = np.random.default_rng(seed)
-    clusters = synthetic_fleet(n_clusters, seed=seed)
-
-    def spread_placement(rmin, rmax, cmin, divided):
+    out = []
+    for k in range(n_placements):
+        rmin = int(rng.integers(2, 5))
+        rmax = rmin + int(rng.integers(0, 3))
+        cmin = int(rng.integers(rmin, rmin + 3))
+        divided = k % 10 >= 7  # 30% divided
         cons = [
             pol.SpreadConstraint(
                 spread_by_field=pol.SPREAD_BY_FIELD_REGION,
@@ -225,27 +282,58 @@ def build_spread(seed=0, n_clusters=5000, n_bindings=5000):
                 spread_by_field=pol.SPREAD_BY_FIELD_CLUSTER, min_groups=cmin,
             ),
         ]
-        if not divided:
-            return pol.Placement(
+        if divided:
+            p = _dyn_placement(aggregated=True)
+            p.spread_constraints = cons
+        else:
+            p = pol.Placement(
                 cluster_affinity=pol.ClusterAffinity(cluster_names=[]),
                 spread_constraints=cons,
             )
-        p = _dyn_placement(aggregated=True)
-        p.spread_constraints = cons
-        return p
+        out.append(p)
+    return out
 
-    placements = [
-        spread_placement(2, 3, 2, False),
-        spread_placement(2, 4, 3, False),
-        spread_placement(3, 3, 3, False),
-        spread_placement(2, 2, 2, False),
-        spread_placement(2, 3, 2, False),
-        spread_placement(3, 4, 4, False),
-        spread_placement(2, 3, 2, False),
-        spread_placement(2, 3, 3, True),
-        spread_placement(2, 2, 2, True),
-        spread_placement(3, 3, 3, True),
+
+def build_spread(seed=0, n_clusters=5000, n_bindings=5000):
+    """Config 4: multi-dim HA — region spread (+ cluster MinGroups) over the
+    full fleet; 200 distinct constraint tuples (adversarial to the
+    row-content dedup), ~70% Duplicated HA apps, 30% dynamic-divided."""
+    from karmada_tpu.sched.core import ArrayScheduler
+    from karmada_tpu.testing.fixtures import synthetic_fleet
+
+    rng = np.random.default_rng(seed)
+    clusters = synthetic_fleet(n_clusters, seed=seed)
+    placements = _spread_placements(rng, 200)
+    bindings = [
+        _binding(i, int(rng.integers(1, 32)), placements[i % len(placements)],
+                 float(rng.choice([0.1, 0.25, 0.5])))
+        for i in range(n_bindings)
     ]
+    return ArrayScheduler(clusters), bindings, None
+
+
+def build_spread_skewed(seed=0, n_clusters=5000, n_bindings=5000):
+    """Config 4b: the spread round on a SKEWED fleet — one mega region
+    (60% of clusters) among 30 tiny ones. Defeats the balanced grid kernel
+    (the segmented kernel scores it), produces mass exact group-score ties
+    (resolved in-batch by DFS discovery order), and pushes the larger
+    min-group shapes past the combination-table bound (class-collapsed
+    exact DFS). The r3 verdict's missing hard case."""
+    from karmada_tpu.sched.core import ArrayScheduler
+    from karmada_tpu.testing.fixtures import synthetic_fleet
+
+    rng = np.random.default_rng(seed)
+    clusters = synthetic_fleet(n_clusters, seed=seed)
+    n_mega = int(n_clusters * 0.6)
+    for i, c in enumerate(clusters):
+        if i < n_mega:
+            c.spec.region = "mega-region"
+            c.spec.provider = "mega"
+        else:
+            r = int(rng.integers(0, 30))
+            c.spec.region = f"small-{r}"
+            c.spec.provider = f"p{r % 4}"
+    placements = _spread_placements(rng, 200)
     bindings = [
         _binding(i, int(rng.integers(1, 32)), placements[i % len(placements)],
                  float(rng.choice([0.1, 0.25, 0.5])))
@@ -320,15 +408,37 @@ def build_flagship(seed=0, n_clusters=5000, n_bindings=10000):
     return ArrayScheduler(clusters), bindings, None
 
 
+def build_flagship_cold(seed=0, n_clusters=5000, n_bindings=10000):
+    """North-star variant, adversarial to the per-placement encode cache:
+    every measured iteration bumps each binding's generation first
+    (simulating genuinely-dirty bindings — dirty bindings CHANGED, so the
+    informer-decode analogue re-encodes their rows). The bump itself is the
+    store's work and happens outside the timer."""
+    sched, bindings, extra_fn = build_flagship(
+        seed=seed, n_clusters=n_clusters, n_bindings=n_bindings
+    )
+
+    def pre_iter():
+        for rb in bindings:
+            rb.metadata.generation += 1
+
+    return sched, bindings, extra_fn, pre_iter
+
+
 CONFIGS = {
     "dup3": (build_dup3, "duplicated_100rb_x_3c"),
     "static": (build_static, "static_1000rb_x_100c"),
-    "dynamic": (build_dynamic, "dynamic_estimator_1000rb_x_1000c"),
+    "dynamic": (build_dynamic, "dynamic_grpc_estimator_1000rb_x_1000c"),
     "spread": (build_spread, "spread_5000rb_x_5000c"),
+    "spread_skewed": (build_spread_skewed, "spread_skewed_5000rb_x_5000c"),
     "churn": (build_churn, "churn_10000rb_x_5000c"),
+    "flagship_cold": (build_flagship_cold, None),  # named after the shape
     "flagship": (build_flagship, None),  # metric name carries the shape
 }
-DEFAULT_ORDER = ["dup3", "static", "dynamic", "spread", "churn", "flagship"]
+DEFAULT_ORDER = [
+    "dup3", "static", "dynamic", "spread", "spread_skewed", "churn",
+    "flagship_cold", "flagship",
+]
 
 
 # --------------------------------------------------------------------------
@@ -452,22 +562,22 @@ def run_bench(args) -> None:
     lines = []
     for name in wanted:
         build, metric_suffix = CONFIGS[name]
-        if name == "flagship":
+        t0 = time.perf_counter()
+        if name in ("flagship", "flagship_cold"):
             metric = (
                 f"schedule_round_p99_{args.bindings}rb_x_{args.clusters}clusters"
             )
+            if name == "flagship_cold":
+                metric += "_coldencode"
             iters = args.iters
-            t0 = time.perf_counter()
-            sched, bindings, extra_fn = build(
-                n_clusters=args.clusters, n_bindings=args.bindings
-            )
-            t_build = time.perf_counter() - t0
+            built = build(n_clusters=args.clusters, n_bindings=args.bindings)
         else:
             metric = f"schedule_round_p99_{metric_suffix}"
             iters = min(args.iters, 5)
-            t0 = time.perf_counter()
-            sched, bindings, extra_fn = build()
-            t_build = time.perf_counter() - t0
+            built = build()
+        sched, bindings, extra_fn, *rest = built
+        pre_iter = rest[0] if rest else None
+        t_build = time.perf_counter() - t0
         if not on_tpu:
             metric += f"_{backend}"  # label non-TPU fallbacks
 
@@ -480,6 +590,8 @@ def run_bench(args) -> None:
 
         lat = []
         for _ in range(iters):
+            if pre_iter is not None:
+                pre_iter()  # store-side dirtying, outside the timer
             t0 = time.perf_counter()
             extra = extra_fn() if extra_fn else None
             decisions = sched.schedule(bindings, extra_avail=extra)

@@ -295,9 +295,17 @@ def _fmt_table(rows: list[list[str]], headers: list[str]) -> str:
 
 
 def cmd_get(cp: ControlPlane, kind: str, name: str = "", namespace: str = "",
-            cluster: str = "") -> str:
+            cluster: str = "", output: str = "") -> str:
     """Multi-cluster aware get: with --cluster, reads the member's object via
-    the proxy view (get.go's operation-scope Members)."""
+    the proxy view (get.go's operation-scope Members). `output` selects the
+    printer: table (default) / wide / json / yaml / name
+    (pkg/printers/tablegenerator.go seam)."""
+    from . import printers
+
+    try:
+        printers.check_output(output)
+    except printers.UnknownOutputFormat as e:
+        raise CLIError(str(e))
     resolved = _resolve_kind(kind)
     if cluster:
         member = cp.members.get(cluster)
@@ -313,6 +321,8 @@ def cmd_get(cp: ControlPlane, kind: str, name: str = "", namespace: str = "",
             objs = [o for o in objs if o.name == name]
         if namespace:
             objs = [o for o in objs if o.namespace == namespace]
+        if output in ("json", "yaml", "name"):
+            return printers.print_objs(objs, output, kind=resolved)
         rows = [[o.namespace or "-", o.name, cluster] for o in objs]
         return _fmt_table(rows, ["NAMESPACE", "NAME", "CLUSTER"])
 
@@ -321,6 +331,13 @@ def cmd_get(cp: ControlPlane, kind: str, name: str = "", namespace: str = "",
         objs = [o for o in objs if o.metadata.name == name]
         if not objs:
             raise CLIError(f"{resolved} {name!r} not found")
+    if output in ("json", "yaml", "name"):
+        return printers.print_objs(
+            sorted(objs, key=lambda o: (getattr(o.metadata, "namespace", ""),
+                                        o.metadata.name)),
+            output, kind=resolved,
+        )
+    wide = output == "wide"
     if resolved == "Cluster":
         rows = [
             [
@@ -329,9 +346,14 @@ def cmd_get(cp: ControlPlane, kind: str, name: str = "", namespace: str = "",
                 "True" if cluster_ready(c) else "False",
                 c.status.kubernetes_version,
             ]
+            + ([c.spec.provider or "-", c.spec.region or "-",
+                c.spec.zone or "-"] if wide else [])
             for c in sorted(objs, key=lambda c: c.metadata.name)
         ]
-        return _fmt_table(rows, ["NAME", "MODE", "READY", "VERSION"])
+        headers = ["NAME", "MODE", "READY", "VERSION"]
+        if wide:
+            headers += ["PROVIDER", "REGION", "ZONE"]
+        return _fmt_table(rows, headers)
     if resolved == "ResourceBinding":
         rows = [
             [
@@ -339,9 +361,14 @@ def cmd_get(cp: ControlPlane, kind: str, name: str = "", namespace: str = "",
                 b.metadata.name,
                 ",".join(f"{t.name}:{t.replicas}" for t in b.spec.clusters) or "<pending>",
             ]
+            + ([f"{b.spec.resource.api_version}/{b.spec.resource.kind}",
+                str(b.spec.replicas)] if wide else [])
             for b in sorted(objs, key=lambda b: (b.metadata.namespace, b.metadata.name))
         ]
-        return _fmt_table(rows, ["NAMESPACE", "NAME", "SCHEDULED"])
+        headers = ["NAMESPACE", "NAME", "SCHEDULED"]
+        if wide:
+            headers += ["RESOURCE", "REPLICAS"]
+        return _fmt_table(rows, headers)
     if resolved == "Event":
         rows = [
             [e.involved_kind, f"{e.involved_namespace}/{e.involved_name}".lstrip("/"),
@@ -768,6 +795,7 @@ def run(cp: ControlPlane, argv: list[str]) -> str:
     p.add_argument("name", nargs="?", default="")
     p.add_argument("-n", "--namespace", default="")
     p.add_argument("--cluster", default="")
+    p.add_argument("-o", "--output", default="")
     p = sub.add_parser("describe")
     p.add_argument("kind")
     p.add_argument("name")
@@ -859,7 +887,8 @@ def run(cp: ControlPlane, argv: list[str]) -> str:
     if args.command == "taint":
         return cmd_taint(cp, args.name, args.spec)
     if args.command == "get":
-        return cmd_get(cp, args.kind, args.name, args.namespace, args.cluster)
+        return cmd_get(cp, args.kind, args.name, args.namespace, args.cluster,
+                       output=args.output)
     if args.command == "describe":
         return cmd_describe(cp, args.kind, args.name, args.namespace)
     if args.command == "top":

@@ -1,0 +1,72 @@
+"""Output-format printers for karmadactl get (-o json|yaml|name|wide).
+
+The reference routes get/describe output through a printers layer with
+table generation and format switches (pkg/printers/tablegenerator.go,
+kubectl's -o flags); this is that seam: typed/unstructured objects
+serialize to manifests, multiple objects wrap in a v1 List, and `wide`
+extends the per-kind tables with extra columns.
+"""
+from __future__ import annotations
+
+import dataclasses
+import json
+from typing import Any, Sequence
+
+from ..api.unstructured import Unstructured
+
+OUTPUT_FORMATS = ("", "wide", "json", "yaml", "name")
+
+
+class UnknownOutputFormat(Exception):
+    pass
+
+
+def check_output(output: str) -> None:
+    if output not in OUTPUT_FORMATS:
+        raise UnknownOutputFormat(
+            f"unable to match a printer suitable for the output format "
+            f"{output!r} (allowed: {', '.join(f or '<table>' for f in OUTPUT_FORMATS)})"
+        )
+
+
+def to_manifest(obj: Any) -> dict:
+    """Object → JSON-able manifest dict (Unstructured passes through; typed
+    API dataclasses serialize with their kind when they carry one)."""
+    if isinstance(obj, Unstructured):
+        return obj.to_dict()
+    if dataclasses.is_dataclass(obj):
+        out = dataclasses.asdict(obj)
+        kind = getattr(obj, "kind", None)
+        if kind and "kind" not in out:
+            out["kind"] = kind
+        return out
+    return dict(obj)
+
+
+def _default(o: Any) -> Any:
+    return str(o)
+
+
+def print_objs(objs: Sequence[Any], output: str, kind: str = "") -> str:
+    """json/yaml/name rendering. A single object prints bare; several wrap
+    in a v1 List (kubectl semantics)."""
+    manifests = [to_manifest(o) for o in objs]
+    if output == "name":
+        lines = []
+        for o, m in zip(objs, manifests):
+            k = (m.get("kind") or kind or "object").lower()
+            name = m.get("metadata", {}).get("name", "")
+            lines.append(f"{k}/{name}")
+        return "\n".join(lines)
+    payload: Any = (
+        manifests[0]
+        if len(manifests) == 1
+        else {"apiVersion": "v1", "kind": "List", "items": manifests}
+    )
+    if output == "json":
+        return json.dumps(payload, indent=2, sort_keys=True, default=_default)
+    if output == "yaml":
+        import yaml
+
+        return yaml.safe_dump(payload, sort_keys=True, default_flow_style=False)
+    raise UnknownOutputFormat(output)

@@ -25,6 +25,7 @@ from .proto import estimator_pb2 as pb
 _SERVICE = "github.com.karmada_io.karmada.pkg.estimator.service.Estimator"
 METHOD_MAX_AVAILABLE = f"/{_SERVICE}/MaxAvailableReplicas"
 METHOD_UNSCHEDULABLE = f"/{_SERVICE}/GetUnschedulableReplicas"
+METHOD_BATCH_MAX_AVAILABLE = f"/{_SERVICE}/BatchMaxAvailableReplicas"
 
 
 def requirements_from_pb(req: pb.ReplicaRequirements) -> ReplicaRequirements:
@@ -143,6 +144,13 @@ class EstimatorServer:
                 request_deserializer=pb.UnschedulableReplicasRequest.FromString,
                 response_serializer=pb.UnschedulableReplicasResponse.SerializeToString,
             ),
+            # additive batched method (see estimator.proto) — not part of
+            # the reference contract; stock schedulers never call it
+            "BatchMaxAvailableReplicas": grpc.unary_unary_rpc_method_handler(
+                self._batch_max_available,
+                request_deserializer=pb.BatchMaxAvailableReplicasRequest.FromString,
+                response_serializer=pb.BatchMaxAvailableReplicasResponse.SerializeToString,
+            ),
         }
         self._server.add_generic_rpc_handlers(
             (grpc.method_handlers_generic_handler(_SERVICE, handlers),)
@@ -190,6 +198,24 @@ class EstimatorServer:
             # slow-estimate span logging (ref estimate.go:37-38: > 100 ms)
             trace.log_if_long()
 
+    def _batch_max_available(
+        self, request: pb.BatchMaxAvailableReplicasRequest, context
+    ):
+        """One answer matrix per request: rows = requirements, columns = the
+        request's cluster order; unknown clusters answer the -1 sentinel
+        (interface.go:27-30 UnauthenticReplica semantics per cluster)."""
+        resp = pb.BatchMaxAvailableReplicasResponse()
+        ests = [self.estimators.get(c) for c in request.clusters]
+        for req_pb in request.replicaRequirements:
+            requirements = requirements_from_pb(req_pb)
+            row = resp.rows.add()
+            row.maxReplicas.extend(
+                UNAUTHENTIC_REPLICA if est is None
+                else est.max_available_replicas(requirements)
+                for est in ests
+            )
+        return resp
+
     def _unschedulable(self, request: pb.UnschedulableReplicasRequest, context):
         est = self.estimators.get(request.cluster)
         if est is None:
@@ -226,6 +252,7 @@ class GrpcSchedulerEstimator:
         # than the RPC itself at fan-out rates)
         self._ma_calls: dict[str, object] = {}
         self._un_calls: dict[str, object] = {}
+        self._batch_calls: dict[str, object] = {}
 
     def _channel(self, cluster: str) -> Optional[grpc.Channel]:
         addr = self.address_for(cluster)
@@ -250,6 +277,11 @@ class GrpcSchedulerEstimator:
         addr = self.address_for(cluster)
         if addr is None:
             return None
+        return self._addr_call(cache, addr, method, req_serializer,
+                               resp_deserializer)
+
+    def _addr_call(self, cache: dict, addr: str, method: str,
+                   req_serializer, resp_deserializer):
         call = cache.get(addr)
         if call is None:
             call = self._channel_for(addr).unary_unary(
@@ -303,6 +335,49 @@ class GrpcSchedulerEstimator:
             ),
             lambda resp: resp.maxReplicas,
         )
+
+    def batch_max_available_replicas(self, clusters, requirements_list):
+        """Batched fan-out over the additive BatchMaxAvailableReplicas
+        method: ONE RPC per estimator-server address covering that shard's
+        clusters × all distinct requirements. Returns i32[R, C] aligned to
+        (requirements_list, clusters); unreachable shards / unknown clusters
+        answer -1. The per-(binding, cluster) wire shape of accurate.go is
+        the reference's bottleneck; this amortizes it the way the solve
+        amortizes per-binding math."""
+        import numpy as np
+
+        R, C = len(requirements_list), len(clusters)
+        out = np.full((R, C), UNAUTHENTIC_REPLICA, np.int32)
+        req_pbs = [requirements_to_pb(r) for r in requirements_list]
+        by_addr: dict[str, list[int]] = {}
+        for j, cluster in enumerate(clusters):
+            addr = self.address_for(cluster)
+            if addr is not None:
+                by_addr.setdefault(addr, []).append(j)
+        deadline = time.monotonic() + self.timeout
+        futs = []
+        for addr, cols in by_addr.items():
+            call = self._addr_call(
+                self._batch_calls, addr, METHOD_BATCH_MAX_AVAILABLE,
+                pb.BatchMaxAvailableReplicasRequest.SerializeToString,
+                pb.BatchMaxAvailableReplicasResponse.FromString,
+            )
+            request = pb.BatchMaxAvailableReplicasRequest(
+                clusters=[clusters[j] for j in cols],
+                replicaRequirements=req_pbs,
+            )
+            remaining = max(deadline - time.monotonic(), 0.001)
+            futs.append((cols, call.future(request, timeout=remaining)))
+        for cols, f in futs:
+            try:
+                resp = f.result()
+            except grpc.RpcError:
+                continue  # shard stays at the -1 sentinel
+            for r, row in enumerate(resp.rows[:R]):
+                vals = np.fromiter(row.maxReplicas, np.int32,
+                                   count=len(row.maxReplicas))
+                out[r, cols[: len(vals)]] = vals[: len(cols)]
+        return out
 
     def get_unschedulable_replicas(self, clusters, resource, threshold_seconds) -> list[int]:
         """resource: api/work.ObjectReference — the full reference travels on

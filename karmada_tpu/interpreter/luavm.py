@@ -1178,6 +1178,7 @@ def looks_like_lua(source: str) -> bool:
         return False
     return bool(
         re.search(r"\bfunction\s+\w+\s*\(", source)
+        or re.search(r"\b\w+\s*=\s*function\s*\(", source)  # assignment style
         or re.search(r"\blocal\s+\w+", source)
     )
 

@@ -153,7 +153,7 @@ _OUT_SPECS = (
 )
 
 
-def _sharded_body(topk: int):
+def _sharded_body(topk: int, plugin_bits: int, has_terms: bool):
     def body(
         alive, capacity, has_summary, taint_key, taint_value, taint_effect, api_ok,
         replicas, unknown_request, gvk, strategy, fresh,
@@ -161,7 +161,7 @@ def _sharded_body(topk: int):
         aff_masks, aff_idx, weight_tables, weight_idx,
         prev_idx, prev_rep, evict_idx, seeds,
         req_unique, req_idx,
-        extra_avail,
+        extra_avail, extra_mask, extra_score,
     ):
         # shares the single-chip kernel's phases (sched/core.py): decompress →
         # filter/estimate on the local tile → all_gather → assignment tail
@@ -189,6 +189,7 @@ def _sharded_body(topk: int):
             tol[:, 0], tol[:, 1], tol[:, 2], tol[:, 3],
             affinity_ok, eviction_ok, prev_member,
             req_unique=req_unique, req_idx=req_idx,
+            plugin_bits=plugin_bits,
         )
 
         # ---- gather the cluster shards: the division solve is a per-row
@@ -203,6 +204,14 @@ def _sharded_body(topk: int):
         static_w = gcols(static_weight)
         prev_r = gcols(prev_replicas)
         tie_g = gcols(tie)
+
+        if has_terms:
+            # out-of-tree plugin terms are host-computed full rows
+            # (row-sharded): masks only shrink feasibility and scores only
+            # add, so applying them post-gather is equivalent to the
+            # single-chip in-phase application
+            feasible = feasible & jnp.broadcast_to(extra_mask, feasible.shape)
+            score = score + jnp.broadcast_to(extra_score, score.shape)
 
         # registered-estimator min-merge (row-sharded dense [B_l, C] or the
         # replicated [1,1] no-estimator sentinel)
@@ -259,16 +268,19 @@ class MeshScheduleKernel:
         if fleet is not None:
             self.set_fleet(fleet)
 
-    def _kernel(self, topk: int, dense_extra: bool):
-        key = (topk, dense_extra)
+    def _kernel(self, topk: int, dense_extra: bool, plugin_bits: int,
+                has_terms: bool):
+        key = (topk, dense_extra, plugin_bits, has_terms)
         fn = self._kernels.get(key)
         if fn is None:
             extra_spec = P(AXIS_BINDINGS, None) if dense_extra else P(None, None)
+            term_spec = P(AXIS_BINDINGS, None) if has_terms else P(None, None)
             fn = jax.jit(
                 jax.shard_map(
-                    _sharded_body(topk),
+                    _sharded_body(topk, plugin_bits, has_terms),
                     mesh=self.mesh,
-                    in_specs=_FLEET_SPECS + _BATCH_SPECS + (extra_spec,),
+                    in_specs=_FLEET_SPECS + _BATCH_SPECS
+                    + (extra_spec, term_spec, term_spec),
                     out_specs=_OUT_SPECS,
                     check_vma=False,
                 )
@@ -300,8 +312,16 @@ class MeshScheduleKernel:
         )
 
     _NO_EXTRA = np.full((1, 1), -1, np.int32)
+    _NO_MASK = np.ones((1, 1), bool)
+    _NO_SCORE = np.zeros((1, 1), np.int32)
 
-    def __call__(self, batch: BindingBatch, extra_avail=None):
+    def __call__(self, batch: BindingBatch, extra_avail=None,
+                 extra_mask=None, extra_score=None,
+                 plugin_bits: Optional[int] = None):
+        from ..sched import plugins as plugin_mod
+
+        if plugin_bits is None:
+            plugin_bits = plugin_mod.ALL_PLUGIN_BITS
         if self._fleet_dev is None:
             raise RuntimeError("set_fleet() before scheduling")
         B = len(batch.replicas)
@@ -328,7 +348,26 @@ class MeshScheduleKernel:
             # registered-estimator answers are per-row: ship them row-sharded
             extra = _pad_axis(_pad_axis(extra_avail, 0, Bp, fill=-1), 1, Cp, fill=-1)
             dense_extra = True
-        return self._kernel(min(Cp, self._topk), dense_extra)(
+        has_terms = (
+            extra_mask is not None and extra_mask.shape != (1, 1)
+        ) or (extra_score is not None and extra_score.shape != (1, 1))
+        if has_terms:
+            mask = (
+                np.ones((B, self.n_clusters), bool)
+                if extra_mask is None or extra_mask.shape == (1, 1)
+                else np.asarray(extra_mask, bool)
+            )
+            score = (
+                np.zeros((B, self.n_clusters), np.int32)
+                if extra_score is None or extra_score.shape == (1, 1)
+                else np.asarray(extra_score, np.int32)
+            )
+            mask = _pad_axis(_pad_axis(mask, 0, Bp, fill=True), 1, Cp, fill=True)
+            score = _pad_axis(_pad_axis(score, 0, Bp), 1, Cp)
+        else:
+            mask, score = self._NO_MASK, self._NO_SCORE
+        return self._kernel(min(Cp, self._topk), dense_extra, plugin_bits,
+                            has_terms)(
             *self._fleet_dev,
             bb(batch.replicas), bb(batch.unknown_request),
             bb(batch.gvk), bb(batch.strategy), bb(batch.fresh),
@@ -343,4 +382,6 @@ class MeshScheduleKernel:
             req_unique,
             bb(req_idx),
             extra,
+            mask,
+            score,
         )

@@ -523,6 +523,23 @@ def _pad_rows_idx(rows: Sequence[int], bucket_fn) -> tuple[np.ndarray, int]:
     return idx, n
 
 
+def _pad_extra_avail(extra_avail, n_cols: int, n_rows: int):
+    """Pad caller-provided estimator answers to the kernel shape: columns to
+    the (possibly mesh-padded) fleet width, rows to the padded batch — both
+    with the -1 no-answer sentinel."""
+    if extra_avail.shape[1] < n_cols:
+        extra_avail = np.pad(
+            extra_avail, [(0, 0), (0, n_cols - extra_avail.shape[1])],
+            constant_values=-1,
+        )
+    if len(extra_avail) < n_rows:
+        extra_avail = np.pad(
+            extra_avail, [(0, n_rows - len(extra_avail)), (0, 0)],
+            constant_values=-1,
+        )
+    return extra_avail
+
+
 def fetch_rows(dev_array, rows: Sequence[int], bucket_fn) -> np.ndarray:
     """Fetch a row subset of a device tensor: device-side gather + compact
     transfer, never the full [B,C] fetch (200 MB at the flagship shape)."""
@@ -602,17 +619,34 @@ class ArrayScheduler:
         self.enabled_plugins = self.plugin_registry.filter(plugins)
         self._plugin_bits = plugin_mod.plugin_bits(self.enabled_plugins)
         self._oot_plugins = self.plugin_registry.out_of_tree(self.enabled_plugins)
-        if mesh is not None and (
-            self._plugin_bits != plugin_mod.ALL_PLUGIN_BITS or self._oot_plugins
-        ):
-            raise ValueError(
-                "plugin disable / out-of-tree plugins are not supported on "
-                "the mesh path yet"
-            )
+        # mesh rounds default to the partitioned single-sync shape: the
+        # SAME kernels run with the fleet tensors mesh-sharded and XLA's
+        # GSPMD partitioner inserts the collectives (the scaling-book
+        # recipe: annotate shardings, let XLA partition). The explicit
+        # shard_map kernel remains as the monolithic mode.
+        self.mesh_partitioned = True
         self.set_clusters(clusters)
 
     def set_clusters(self, clusters: Sequence) -> None:
-        self.clusters = list(clusters)
+        clusters = list(clusters)
+        self.n_real_clusters = len(clusters)
+        if self.mesh is not None:
+            # pad the fleet to a mesh-divisible width with DEAD clusters
+            # (never Ready ⇒ never feasible ⇒ never decoded): every derived
+            # table — batch policy tables, region layout, device tensors —
+            # then sizes consistently, and sharded device_put is legal
+            from ..api.cluster import Cluster, ClusterSpec
+            from ..api.meta import ObjectMeta
+            from ..parallel.mesh import AXIS_CLUSTERS
+
+            mesh_c = self.mesh.shape[AXIS_CLUSTERS]
+            pad = (-len(clusters)) % mesh_c
+            clusters += [
+                Cluster(metadata=ObjectMeta(name=f"__mesh-pad-{i}"),
+                        spec=ClusterSpec())
+                for i in range(pad)
+            ]
+        self.clusters = clusters
         self.fleet: FleetArrays = self.encoder.encode(self.clusters)
         self.batch_encoder = BatchEncoder(self.encoder, self.fleet, self.clusters)
         # spread-selection fast-path encodings (sched/spread.py array API):
@@ -642,15 +676,33 @@ class ArrayScheduler:
         # fleet tensors live on device across rounds (the persistent snapshot
         # that replaces the reference's per-attempt deep copy, cache.go:62-77);
         # re-transferred only on cluster-set change
+        f = self.fleet
         if self.mesh is not None:
-            from ..parallel.mesh import MeshScheduleKernel
+            from ..parallel.mesh import (
+                AXIS_CLUSTERS,
+                MeshScheduleKernel,
+            )
+            from jax.sharding import NamedSharding, PartitionSpec as P
 
             if self._mesh_kernel is None:
                 self._mesh_kernel = MeshScheduleKernel(self.mesh)
             self._mesh_kernel.set_fleet(self.fleet)
-            self._fleet_dev = None
+            # the partitioned round runs the single-chip kernels with the
+            # fleet COLUMN-SHARDED over the mesh; GSPMD partitions every
+            # kernel (no manual padding: XLA handles uneven shards)
+            def put(x, spec):
+                return jax.device_put(x, NamedSharding(self.mesh, spec))
+
+            self._fleet_dev = (
+                put(f.alive, P(AXIS_CLUSTERS)),
+                put(f.capacity, P(AXIS_CLUSTERS, None)),
+                put(f.has_summary, P(AXIS_CLUSTERS)),
+                put(f.taint_key, P(AXIS_CLUSTERS, None)),
+                put(f.taint_value, P(AXIS_CLUSTERS, None)),
+                put(f.taint_effect, P(AXIS_CLUSTERS, None)),
+                put(f.api_ok, P(AXIS_CLUSTERS, None)),
+            )
             return
-        f = self.fleet
         self._fleet_dev = tuple(
             jax.device_put(x)
             for x in (
@@ -772,8 +824,12 @@ class ArrayScheduler:
         self, batch: BindingBatch, extra_avail=None,
         extra_mask=None, extra_score=None,
     ):
-        if self._mesh_kernel is not None:
-            return self._mesh_kernel(batch, extra_avail)
+        if self._mesh_kernel is not None and not self.mesh_partitioned:
+            return self._mesh_kernel(
+                batch, extra_avail,
+                extra_mask=extra_mask, extra_score=extra_score,
+                plugin_bits=self._plugin_bits,
+            )
         if extra_avail is None:
             extra_avail = self._NO_EXTRA
         if extra_mask is None:
@@ -898,7 +954,7 @@ class ArrayScheduler:
     def _schedule_once(
         self, bindings: Sequence, extra_avail=None, term_indices=None
     ) -> list[ScheduleDecision]:
-        if self._mesh_kernel is None:
+        if self._mesh_kernel is None or self.mesh_partitioned:
             return self._schedule_once_partitioned(
                 bindings, extra_avail, term_indices
             )
@@ -960,9 +1016,8 @@ class ArrayScheduler:
 
         raw = self.batch_encoder.encode(bindings, term_indices=term_indices)
         batch = self._pad(raw)
-        if extra_avail is not None and len(extra_avail) < len(batch.replicas):
-            pad = len(batch.replicas) - len(extra_avail)
-            extra_avail = np.pad(extra_avail, [(0, pad), (0, 0)], constant_values=-1)
+        if extra_avail is not None:
+            extra_avail = _pad_extra_avail(extra_avail, C, len(batch.replicas))
 
         extra_mask, extra_score = self._plugin_terms(
             bindings, len(batch.replicas)
@@ -1143,7 +1198,7 @@ class ArrayScheduler:
                 dec.error = row_err[b]
             elif feas_count[b] == 0:
                 # FitError diagnosis (generic_scheduler.go:83-88)
-                dec.error = f"0/{C} clusters are available"
+                dec.error = f"0/{self.n_real_clusters} clusters are available"
             elif unsched[b]:
                 dec.error = (
                     f"Clusters available replicas {int(avail_sum[b])} are not "
@@ -1501,9 +1556,8 @@ class ArrayScheduler:
 
         raw = self.batch_encoder.encode(bindings, term_indices=term_indices)
         batch = self._pad(raw)
-        if extra_avail is not None and len(extra_avail) < len(batch.replicas):
-            pad = len(batch.replicas) - len(extra_avail)
-            extra_avail = np.pad(extra_avail, [(0, pad), (0, 0)], constant_values=-1)
+        if extra_avail is not None:
+            extra_avail = _pad_extra_avail(extra_avail, C, len(batch.replicas))
 
         extra_mask, extra_score = self._plugin_terms(
             bindings, len(batch.replicas)
@@ -1572,7 +1626,7 @@ class ArrayScheduler:
                 dec.error = row_err[b]
             elif feas_count[b] == 0:
                 # FitError diagnosis (generic_scheduler.go:83-88)
-                dec.error = f"0/{C} clusters are available"
+                dec.error = f"0/{self.n_real_clusters} clusters are available"
             elif unsched[b]:
                 dec.error = (
                     f"Clusters available replicas {int(avail_sum[b])} are not "

@@ -478,6 +478,170 @@ class ComboResult:
     fallback: list[int]  # rows needing the exact per-row path (ties etc.)
 
 
+_CLASS_DFS_BUDGET = 200_000  # recursion-step bound per row
+
+
+def _select_row_class_dfs(weight: np.ndarray, value: np.ndarray,
+                          cfg: SpreadConfig, layout: RegionLayout,
+                          kmax: int):
+    """Exact region selection for ONE row by collapsing identical regions.
+
+    When the combination table would be too large to enumerate
+    (C(R, kmin..kmax) > MAX_COMBOS), the skewed-fleet structure that causes
+    it — many interchangeable tiny regions — also defeats it: regions with
+    identical (weight, value) are indistinguishable to the DFS except for
+    name order, so recorded paths collapse to CLASS MULTISETS. Subsets
+    realizing one multiset share (Σw, Σv) and recorded-ness, and the
+    discovery-order representative is the canonical first-members-per-class
+    subset (lex-min position sequence); the reference's winner rule
+    (weight desc, value desc, id asc; select_groups.go:200-213) therefore
+    reduces to a DFS over class counts — tiny wherever the subset
+    enumeration explodes.
+
+    Returns (region_index_array) on success, an error string for the
+    too-few-groups cases, or None when the class DFS itself exceeds its
+    budget (caller falls back to the per-row subset path)."""
+    kmin = max(cfg.rmin, 1)
+    cmin = cfg.cmin
+    present = np.nonzero(value > 0)[0]
+    if len(present) < kmin:
+        return (
+            "the number of feasible region is less than "
+            "spreadConstraint.MinGroups"
+        )
+    # group order (value asc, weight desc, name asc)
+    rr = layout.rname_rank
+    order = sorted(
+        present, key=lambda r: (value[r], -weight[r], rr[r])
+    )
+    # contiguous classes over (value, weight)
+    cls_v: list[int] = []
+    cls_w: list[int] = []
+    cls_members: list[list[int]] = []
+    cls_start: list[int] = []
+    for pos, r in enumerate(order):
+        if cls_v and value[r] == cls_v[-1] and weight[r] == cls_w[-1]:
+            cls_members[-1].append(r)
+        else:
+            cls_v.append(int(value[r]))
+            cls_w.append(int(weight[r]))
+            cls_members.append([r])
+            cls_start.append(pos)
+    K = len(cls_v)
+    n_present = len(present)
+    kmax = min(kmax, n_present)
+    if kmax < kmin:
+        return (
+            "the number of clusters is less than the cluster "
+            "spreadConstraint.MinGroups"
+        )
+
+    # `if len(groups) == minConstraint: break` (select_groups.go:181-183):
+    # the DFS takes exactly the full set
+    if n_present == kmin:
+        sv = int(value[present].sum())
+        if sv < cmin:
+            return (
+                "the number of clusters is less than the cluster "
+                "spreadConstraint.MinGroups"
+            )
+        counts = [len(m) for m in cls_members]
+        return _class_counts_to_regions(
+            counts, cls_members, cls_v, cls_w, cls_start, rr, kmin, cmin
+        )
+
+    recorded: list[tuple[int, int, tuple[int, ...]]] = []  # (Σw, Σv, counts)
+    counts = [0] * K
+    budget = [_CLASS_DFS_BUDGET]
+
+    def rec(k: int, size: int, sv: int, sw: int) -> None:
+        budget[0] -= 1
+        if budget[0] <= 0:
+            raise _Budget()
+        if k == K:
+            return
+        # j = 0 (skip this class)
+        rec(k + 1, size, sv, sw)
+        m = len(cls_members[k])
+        vk, wk = cls_v[k], cls_w[k]
+        for j in range(1, min(m, kmax - size) + 1):
+            size_j = size + j
+            sv_j = sv + j * vk
+            sw_j = sw + j * wk
+            if sv_j >= cmin and size_j >= kmin:
+                # the subset DFS records here and RETURNS — deeper members
+                # of this class or later classes would have a satisfied
+                # prefix and never be enumerated
+                counts[k] = j
+                recorded.append((sw_j, sv_j, tuple(counts)))
+                counts[k] = 0
+                break
+            counts[k] = j
+            rec(k + 1, size_j, sv_j, sw_j)
+            counts[k] = 0
+
+    class _Budget(Exception):
+        pass
+
+    try:
+        rec(0, 0, 0, 0)
+    except _Budget:
+        return None
+    if not recorded:
+        return (
+            "the number of clusters is less than the cluster "
+            "spreadConstraint.MinGroups"
+        )
+
+    def canonical_key(cv: tuple[int, ...]) -> tuple[int, ...]:
+        key: list[int] = []
+        for k, j in enumerate(cv):
+            key.extend(range(cls_start[k], cls_start[k] + j))
+        return tuple(key)
+
+    best = min(recorded, key=lambda t: (-t[0], -t[1], canonical_key(t[2])))
+    return _class_counts_to_regions(
+        list(best[2]), cls_members, cls_v, cls_w, cls_start, rr, kmin, cmin
+    )
+
+
+def _class_counts_to_regions(counts, cls_members, cls_v, cls_w, cls_start,
+                             rr, kmin: int, cmin: int) -> np.ndarray:
+    """Counts → concrete regions (first members per class, name-ascending —
+    the canonical representative) + the subpath preference
+    (select_groups.go:210-230): the SHORTEST (weight desc, name asc)-ordered
+    prefix that is itself a recorded feasible path."""
+    members: list[int] = []  # winner's concrete regions
+    mem_v: list[int] = []
+    mem_w: list[int] = []
+    mem_pos: list[int] = []
+    for k, j in enumerate(counts):
+        ordered = sorted(cls_members[k], key=lambda r: rr[r])
+        for i in range(j):
+            members.append(ordered[i])
+            mem_v.append(cls_v[k])
+            mem_w.append(cls_w[k])
+            mem_pos.append(cls_start[k] + i)
+    # weight-order: (weight desc, name asc)
+    worder = sorted(range(len(members)),
+                    key=lambda i: (-mem_w[i], rr[members[i]]))
+    n = len(members)
+    cut = n
+    for L in range(max(kmin, 1), n):
+        prefix = worder[:L]
+        sv = sum(mem_v[i] for i in prefix)
+        if sv < cmin:
+            continue
+        if L > kmin:
+            # recorded-ness: drop the prefix's group-order-last member
+            last = max(prefix, key=lambda i: mem_pos[i])
+            if sv - mem_v[last] >= cmin:
+                continue
+        cut = L
+        break
+    return np.asarray(sorted(members[i] for i in worder[:cut]), np.int64)
+
+
 # device winner-selection guard: the [S,K,L] gathers must fit comfortably
 SPREAD_COMBO_DEVICE_BYTES = 1 << 30
 
@@ -487,9 +651,11 @@ def _combo_select_kernel(weight, value, kmax_row, rname, table, cmin: int,
                          kmin: int):
     """Device twin of the winner-selection block of select_regions_batch:
     per-combination sums via [S,K,L] gathers (int-exact, no f64 dance),
-    DFS recorded-path pruning via the group-order positional gather, and
-    the (Σweight, Σvalue) lexicographic winner + tie count. Returns
-    (first_idx i32[S], n_ties i32[S], none_feasible bool[S])."""
+    DFS recorded-path pruning via the group-order positional gather, the
+    (Σweight, Σvalue) lexicographic winner, and the discovery-order tie
+    resolution (see _discovery_keys). Returns (first_idx i32[S],
+    n_ties i32[S], none_feasible bool[S]); n_ties stays >1 only when the
+    path length defeats the packed discovery key."""
     S, R = weight.shape
     v64 = value.astype(jnp.int64)
     mp = jnp.asarray(table.members_pad)  # [K, L]
@@ -530,11 +696,20 @@ def _combo_select_kernel(weight, value, kmax_row, rname, table, cmin: int,
     v_m = jnp.where(cand, sum_v, NEG)
     best_v = v_m.max(1)
     cand2 = cand & (sum_v == best_v[:, None])
-    return (
-        jnp.argmax(cand2, axis=1).astype(jnp.int32),
-        cand2.sum(1).astype(jnp.int32),
-        none_feasible,
-    )
+    L = mp.shape[1]
+    if 6 * L <= 62:
+        seq = jnp.sort(
+            jnp.where(pos_g < 0, 63, pos_g).astype(jnp.int64), axis=2
+        )
+        shifts = 6 * jnp.arange(L - 1, -1, -1, dtype=jnp.int64)
+        disc = (seq << shifts).sum(axis=2)
+        disc_m = jnp.where(cand2, disc, jnp.int64(1) << 62)
+        first_idx = jnp.argmin(disc_m, axis=1).astype(jnp.int32)
+        n_ties = jnp.minimum(cand2.sum(1), 1).astype(jnp.int32)
+    else:
+        first_idx = jnp.argmax(cand2, axis=1).astype(jnp.int32)
+        n_ties = cand2.sum(1).astype(jnp.int32)
+    return first_idx, n_ties, none_feasible
 
 
 def select_regions_batch(
@@ -601,9 +776,24 @@ def select_regions_batch(
     if kmax_enum < kmin:
         kmax_enum = kmin
     table = _combos(R, kmin, min(kmax_enum, R))
-    if table is None or R > MAX_REGIONS:
+    if R > MAX_REGIONS:
         live = np.nonzero(~too_few)[0]
         fallback.extend(int(s) for s in live)
+        return ComboResult(chosen, errors, fallback)
+    if table is None:
+        # enumeration too large — the per-row class-collapsed exact DFS
+        # (skewed fleets: many interchangeable regions ⇒ few classes)
+        for s in np.nonzero(~too_few)[0]:
+            s = int(s)
+            out = _select_row_class_dfs(
+                weight[s], value[s], cfg, layout, int(kmax_row[s])
+            )
+            if out is None:
+                fallback.append(s)
+            elif isinstance(out, str):
+                errors[s] = out
+            else:
+                chosen[s, out] = True
         return ComboResult(chosen, errors, fallback)
     if not table.members:  # kmin > R: no combination can exist
         for s in np.nonzero(~too_few)[0]:
@@ -704,6 +894,26 @@ def select_regions_batch(
     n_ties = cand2.sum(1)
 
     first_idx = np.argmax(cand2, axis=1)
+    if n_ties.max(initial=0) > 1 and 6 * table.max_len <= 62:
+        # (Σw, Σv) ties resolve by DFS DISCOVERY ORDER (prioritizePaths
+        # sorts (weight desc, value desc, id asc), select_groups.go:207-213;
+        # id = append order of the DFS, which emits recorded paths in
+        # lexicographic order of their group-order position sequences, and
+        # no recorded path is a prefix of another — the DFS returns at the
+        # first satisfied prefix). Pack each combo's sorted positions into
+        # one integer (6 bits/slot, pad 63) and take the min — skewed
+        # fleets produce MANY exact ties (identical tiny regions), and this
+        # keeps them off the per-row fallback entirely.
+        tied = np.nonzero(n_ties > 1)[0]
+        seq = np.where(pos_g[tied] < 0, np.int8(63), pos_g[tied]).astype(
+            np.int64
+        )
+        seq.sort(axis=2)
+        shifts = 6 * np.arange(table.max_len - 1, -1, -1, dtype=np.int64)
+        disc = (seq << shifts).sum(axis=2)
+        disc = np.where(cand2[tied], disc, np.int64(1) << 62)
+        first_idx[tied] = disc.argmin(axis=1)
+        n_ties[tied] = 1
     return _finish_selection(
         weight, v64, cfg, layout, table, kmin, chosen, errors,
         fallback, overflow, first_idx, n_ties, none_feasible,

@@ -94,6 +94,41 @@ class TestGrpcContract:
             server.stop()
 
 
+class TestBatchedGrpcContract:
+    def test_batch_matrix_over_wire(self):
+        pytest.importorskip("grpc")
+        from karmada_tpu.estimator.service import (
+            EstimatorServer,
+            GrpcSchedulerEstimator,
+        )
+
+        server = EstimatorServer({"m1": AccurateEstimator(nodes_small()),
+                                  "m2": AccurateEstimator(nodes_small())})
+        port = server.start()
+        try:
+            client = GrpcSchedulerEstimator(
+                lambda c: None if c == "gone" else f"127.0.0.1:{port}"
+            )
+            reqs = [
+                ReplicaRequirements(resource_request={CPU: 1.0, MEMORY: 1 * GiB}),
+                ReplicaRequirements(resource_request={CPU: 2.0}),
+            ]
+            out = client.batch_max_available_replicas(
+                ["m1", "unknown", "gone", "m2"], reqs
+            )
+            assert out.shape == (2, 4)
+            # row 0 matches the singular RPC's answers per cluster
+            singular = client.max_available_replicas(["m1", "m2"], reqs[0], 100)
+            assert out[0, 0] == singular[0] and out[0, 3] == singular[1]
+            # unknown cluster and unresolvable address -> -1 sentinel
+            assert out[0, 1] == UNAUTHENTIC_REPLICA
+            assert out[0, 2] == UNAUTHENTIC_REPLICA
+            # second requirement is tighter -> fewer replicas
+            assert 0 < out[1, 0] < out[0, 0]
+        finally:
+            server.stop()
+
+
 class TestSchedulerIntegration:
     def make_plane(self):
         from karmada_tpu.controlplane import ControlPlane

@@ -334,3 +334,58 @@ class TestGenericVerbs:
         run(cp, ["join", "m1"])
         propagate_web(cp)
         assert "ready=2" in run(cp, ["attach", "web", "-C", "m1"])
+
+
+class TestOutputFormats:
+    """`karmadactl get -o json|yaml|name|wide` printers
+    (pkg/printers/tablegenerator.go seam)."""
+
+    @pytest.fixture()
+    def plane(self):
+        from karmada_tpu.controlplane import ControlPlane
+        from karmada_tpu.members.member import MemberConfig
+
+        cp = ControlPlane()
+        cp.join_member(MemberConfig(name="m1", provider="aws",
+                                    region="us-1", zone="us-1a",
+                                    allocatable={"cpu": 10.0}))
+        cp.join_member(MemberConfig(name="m2", allocatable={"cpu": 10.0}))
+        return cp
+
+    def test_json_single_object(self, plane):
+        import json as _json
+
+        out = run(plane, ["get", "clusters", "m1", "-o", "json"])
+        doc = _json.loads(out)
+        assert doc["metadata"]["name"] == "m1"
+        assert doc["kind"] == "Cluster"
+
+    def test_json_list_wrapping(self, plane):
+        import json as _json
+
+        doc = _json.loads(run(plane, ["get", "clusters", "-o", "json"]))
+        assert doc["kind"] == "List"
+        assert [i["metadata"]["name"] for i in doc["items"]] == ["m1", "m2"]
+
+    def test_yaml(self, plane):
+        import yaml as _yaml
+
+        doc = _yaml.safe_load(run(plane, ["get", "clusters", "m2", "-o", "yaml"]))
+        assert doc["metadata"]["name"] == "m2"
+
+    def test_name_format(self, plane):
+        out = run(plane, ["get", "clusters", "-o", "name"])
+        assert out.splitlines() == ["cluster/m1", "cluster/m2"]
+
+    def test_wide_adds_columns(self, plane):
+        out = run(plane, ["get", "clusters", "-o", "wide"])
+        header = out.splitlines()[0]
+        for col in ("PROVIDER", "REGION", "ZONE"):
+            assert col in header
+        assert "aws" in out and "us-1a" in out
+        narrow = run(plane, ["get", "clusters"])
+        assert "PROVIDER" not in narrow
+
+    def test_unknown_format_rejected(self, plane):
+        with pytest.raises(CLIError, match="output format"):
+            run(plane, ["get", "clusters", "-o", "toml"])

@@ -198,6 +198,14 @@ class TestLanguageSniff:
     def test_python_dialect_not_lua(self):
         assert not looks_like_lua("def GetReplicas(obj):\n    return 1, {}")
 
+    def test_assignment_style_function(self):
+        src = "GetReplicas = function(obj)\n  return obj.spec.replicas, nil\nend"
+        assert looks_like_lua(src)
+        out = compile_lua_script(src, "replica_resource")(
+            {"spec": {"replicas": 4}}
+        )
+        assert out == (4, None)
+
 
 # ---------------------------------------------------------------------------
 # the reference's own shipped Lua, executed unmodified

@@ -96,12 +96,46 @@ class TestInTreeDisable:
         assert all(r == 3 for r in t.values())
         assert sorted(d.feasible) == sorted(names)
 
-    def test_mesh_rejects_plugin_config(self):
+    @pytest.mark.parametrize("partitioned", [True, False])
+    def test_mesh_supports_plugin_config(self, partitioned):
+        """Single-chip and mesh deployments expose the SAME plugin surface:
+        a disabled in-tree plugin plus an out-of-tree filter/score pair must
+        produce identical decisions on both the partitioned (GSPMD) and
+        monolithic (shard_map) mesh paths."""
+        import jax
+
+        from karmada_tpu.parallel.mesh import make_mesh
+
         clusters = self._fleet()
-        with pytest.raises(ValueError):
-            ArrayScheduler(
-                clusters, mesh=object(), plugins=["*", "-TaintToleration"]
+        names = [c.name for c in clusters]
+
+        class BanLast(P.FilterPlugin):
+            name = "BanLast"
+
+            def mask(self, bindings, cluster_names):
+                m = np.ones((len(bindings), len(cluster_names)), bool)
+                m[:, -1] = False
+                return m
+
+        def build(mesh=None):
+            reg = P.PluginRegistry()
+            reg.register(BanLast())
+            s = ArrayScheduler(
+                clusters, mesh=mesh,
+                plugins=["*", "-TaintToleration"], plugin_registry=reg,
             )
+            return s
+
+        p = Placement(cluster_affinity=ClusterAffinity(cluster_names=[]))
+        rb = make_binding("app", 2, p)
+        want = targets_dict(build().schedule([rb])[0])
+        assert names[0] in want      # taint filter compiled out
+        assert names[-1] not in want  # out-of-tree ban applied
+
+        mesh_sched = build(mesh=make_mesh(jax.devices()))
+        mesh_sched.mesh_partitioned = partitioned
+        got = targets_dict(mesh_sched.schedule([rb])[0])
+        assert got == want
 
 
 class TestOutOfTreeSeam:

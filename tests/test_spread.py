@@ -570,3 +570,113 @@ class TestSegmentedGroupScore:
             gt = {t.name: t.replicas for t in g.targets}
             wt = {t.name: t.replicas for t in w.targets}
             assert gt == wt, f"{rb.name}: batched {gt} != exact {wt}"
+
+
+class TestSkewedFleetParity:
+    """Skewed fleets (one mega region + many interchangeable tiny ones)
+    exercise the two paths VERDICT r3 flagged: exact (Σw, Σv) ties resolved
+    by DFS discovery order in-batch, and constraint shapes whose combination
+    enumeration overflows MAX_COMBOS routed through the class-collapsed
+    exact DFS. Both must match the per-row exact path bit-for-bit."""
+
+    def _skewed_problem(self, seed, n_clusters=60, n_bindings=40,
+                        big_groups=False):
+        rng = random.Random(seed)
+        clusters = synthetic_fleet(n_clusters, seed=seed, ready_fraction=0.95)
+        n_mega = int(n_clusters * 0.5)
+        for i, c in enumerate(clusters):
+            if i < n_mega:
+                c.spec.region = "mega"
+            else:
+                c.spec.region = f"tiny-{(i - n_mega) % 20}"
+        bindings = []
+        for i in range(n_bindings):
+            if big_groups:
+                # C(21, 4..6)-scale enumeration → table=None → class DFS
+                rmin = rng.randrange(4, 6)
+                rmax = rmin + rng.randrange(0, 2)
+            else:
+                rmin = rng.randrange(1, 4)
+                rmax = rng.choice([0, rmin, rmin + 1])
+            cons = [SpreadConstraint(
+                spread_by_field=SPREAD_BY_FIELD_REGION,
+                min_groups=rmin, max_groups=rmax,
+            ), SpreadConstraint(
+                spread_by_field=SPREAD_BY_FIELD_CLUSTER,
+                min_groups=rng.randrange(0, 8), max_groups=0,
+            )]
+            kind = rng.choice(["dup", "dup", "dyn"])  # ties bite duplicated
+            if kind == "dup":
+                p = Placement(cluster_affinity=ClusterAffinity(),
+                              spread_constraints=cons)
+            else:
+                p = Placement(
+                    cluster_affinity=ClusterAffinity(),
+                    spread_constraints=cons,
+                    replica_scheduling=ReplicaSchedulingStrategy(
+                        replica_scheduling_type=REPLICA_SCHEDULING_DIVIDED,
+                        replica_division_preference="Weighted",
+                        weight_preference=ClusterPreferences(
+                            dynamic_weight=DYNAMIC_WEIGHT_AVAILABLE_REPLICAS
+                        ),
+                    ),
+                )
+            bindings.append(
+                make_binding(f"skew-{i}", rng.randrange(1, 40), p,
+                             cpu=rng.choice([0.5, 1.0]))
+            )
+        return clusters, bindings
+
+    @pytest.mark.parametrize("seed", [0, 1, 2])
+    def test_tie_resolution_parity(self, seed, monkeypatch):
+        clusters, bindings = self._skewed_problem(seed)
+        sched = ArrayScheduler(clusters)
+        got = sched.schedule(bindings)
+
+        from karmada_tpu.sched import spread_batch
+
+        monkeypatch.setattr(spread_batch, "config_of", lambda p: None)
+        want = ArrayScheduler(clusters).schedule(bindings)
+        for rb, g, w in zip(bindings, got, want):
+            assert g.ok == w.ok, f"{rb.name}: {g.error!r} vs {w.error!r}"
+            if not g.ok:
+                continue
+            gt = {t.name: t.replicas for t in g.targets}
+            wt = {t.name: t.replicas for t in w.targets}
+            assert gt == wt, f"{rb.name}: batched {gt} != exact {wt}"
+
+    @pytest.mark.parametrize("seed", [10, 11, 12])
+    def test_class_dfs_parity(self, seed, monkeypatch):
+        clusters, bindings = self._skewed_problem(seed, big_groups=True)
+        sched = ArrayScheduler(clusters)
+        got = sched.schedule(bindings)
+
+        from karmada_tpu.sched import spread_batch
+
+        monkeypatch.setattr(spread_batch, "config_of", lambda p: None)
+        want = ArrayScheduler(clusters).schedule(bindings)
+        for rb, g, w in zip(bindings, got, want):
+            assert g.ok == w.ok, f"{rb.name}: {g.error!r} vs {w.error!r}"
+            if not g.ok:
+                continue
+            gt = {t.name: t.replicas for t in g.targets}
+            wt = {t.name: t.replicas for t in w.targets}
+            assert gt == wt, f"{rb.name}: class-DFS {gt} != exact {wt}"
+
+    def test_ties_and_big_groups_stay_off_the_fallback(self):
+        clusters, bindings = self._skewed_problem(3, big_groups=True)
+        sched = ArrayScheduler(clusters)
+        from karmada_tpu.sched import spread_batch
+
+        calls = []
+        orig = spread_batch.select_regions_batch
+
+        def spy(weight, value, cfg, layout, device=None):
+            res = orig(weight, value, cfg, layout, device)
+            calls.append(len(res.fallback))
+            return res
+
+        with pytest.MonkeyPatch.context() as mp:
+            mp.setattr(spread_batch, "select_regions_batch", spy)
+            sched.schedule(bindings)
+        assert calls and sum(calls) == 0
