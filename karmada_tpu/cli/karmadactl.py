@@ -323,8 +323,16 @@ def cmd_get(cp: ControlPlane, kind: str, name: str = "", namespace: str = "",
             objs = [o for o in objs if o.namespace == namespace]
         if output in ("json", "yaml", "name"):
             return printers.print_objs(objs, output, kind=resolved)
-        rows = [[o.namespace or "-", o.name, cluster] for o in objs]
-        return _fmt_table(rows, ["NAMESPACE", "NAME", "CLUSTER"])
+        wide = output == "wide"
+        rows = [
+            [o.namespace or "-", o.name, cluster]
+            + ([f"{o.api_version}/{o.kind}"] if wide else [])
+            for o in objs
+        ]
+        headers = ["NAMESPACE", "NAME", "CLUSTER"] + (
+            ["RESOURCE"] if wide else []
+        )
+        return _fmt_table(rows, headers)
 
     objs = cp.store.list(resolved, namespace)
     if name:
@@ -421,26 +429,140 @@ def cmd_top(cp: ControlPlane) -> str:
 # -- interpret / promote / apply ------------------------------------------
 
 
+_RIC_FIELD_OPS = {
+    "replicaResource": "replica_resource",
+    "replicaRevision": "replica_revision",
+    "retention": "retention",
+    "statusAggregation": "status_aggregation",
+    "statusReflection": "status_reflection",
+    "healthInterpretation": "health_interpretation",
+    "dependencyInterpretation": "dependency_interpretation",
+}
+
+# the reference's --operation spellings (interpret.go examples) next to ours
+_OPERATION_ALIASES = {
+    "interpretReplica": "replica",
+    "interpretStatus": "status",
+    "interpretHealth": "health",
+    "interpretDependency": "dependencies",
+}
+
+
+def _ric_spec_from_doc(doc: dict):
+    """Build a ResourceInterpreterCustomizationSpec from a manifest dict
+    (accepts the reference's `luaScript` field and our `script`)."""
+    from ..api.interpreter import (
+        Customizations,
+        CustomizationTarget,
+        ResourceInterpreterCustomizationSpec,
+        ScriptRule,
+    )
+
+    spec = doc.get("spec", {})
+    target = spec.get("target", {})
+    rules = {}
+    for field_name, op in _RIC_FIELD_OPS.items():
+        rule = spec.get("customizations", {}).get(field_name)
+        if rule:
+            rules[op] = ScriptRule(
+                script=rule.get("luaScript") or rule.get("script") or ""
+            )
+    return ResourceInterpreterCustomizationSpec(
+        target=CustomizationTarget(
+            api_version=target.get("apiVersion", ""),
+            kind=target.get("kind", ""),
+        ),
+        customizations=Customizations(**rules),
+    )
+
+
+def cmd_interpret_check(manifest: dict) -> str:
+    """`karmadactl interpret -f customization.yml --check`: load every
+    script (Lua or the native dialect) for a syntax check
+    (interpret/check.go)."""
+    from ..interpreter import luavm
+    from ..interpreter.declarative import (
+        OPERATION_FUNCTIONS,
+        ScriptError,
+        compile_script,
+    )
+
+    spec = _ric_spec_from_doc(manifest)
+    name = manifest.get("metadata", {}).get("name", "<unnamed>")
+    lines = [f"customization: {name}",
+             f"target: {spec.target.api_version}/{spec.target.kind}"]
+    failed = False
+    for op in OPERATION_FUNCTIONS:
+        rule = getattr(spec.customizations, op, None)
+        if rule is None or not rule.script:
+            continue
+        try:
+            if luavm.looks_like_lua(rule.script):
+                luavm.compile_lua_script(rule.script, op)
+                lines.append(f"  {op}: ok (lua)")
+            else:
+                compile_script(rule.script, op)
+                lines.append(f"  {op}: ok")
+        except (ScriptError, luavm.LuaError) as e:
+            failed = True
+            lines.append(f"  {op}: INVALID: {e}")
+    if failed:
+        raise CLIError("\n".join(lines))
+    return "\n".join(lines)
+
+
+def _interpreter_for(cp: ControlPlane, customization: Optional[dict]):
+    """The interpreter the dry-run executes against: the control plane's
+    facade, or a throwaway one carrying ONLY the given customization."""
+    if customization is None:
+        return cp.interpreter
+    from ..interpreter.customized import compile_customization
+    from ..interpreter.interpreter import ResourceInterpreter
+
+    spec = _ric_spec_from_doc(customization)
+    ri = ResourceInterpreter()
+    ri.register(f"{spec.target.api_version}/{spec.target.kind}",
+                compile_customization(spec))
+    return ri
+
+
 def cmd_interpret(cp: ControlPlane, manifest: dict, operation: str,
-                  desired: Optional[dict] = None, replicas: int = 0) -> str:
+                  desired: Optional[dict] = None, replicas: int = 0,
+                  customization: Optional[dict] = None,
+                  status_items: Optional[list] = None) -> str:
     """Dry-run an interpreter operation against a manifest
-    (pkg/karmadactl/interpret — test customizations without propagating)."""
+    (pkg/karmadactl/interpret — test customizations without propagating).
+    With `customization`, the operation runs through THAT customization's
+    scripts (the reference's `interpret -f customization.yml --operation
+    ... --observed-file ...` flow) instead of the control plane's tiers."""
+    operation = _OPERATION_ALIASES.get(operation, operation)
+    interp = _interpreter_for(cp, customization)
     obj = Unstructured(manifest)
     if operation == "replica":
-        n, req = cp.interpreter.get_replicas(obj)
+        n, req = interp.get_replicas(obj)
         return json.dumps({"replicas": n, "requirements": None if req is None else req.resource_request})
     if operation == "reviseReplica":
-        out = cp.interpreter.revise_replica(obj, replicas)
+        out = interp.revise_replica(obj, replicas)
         return json.dumps(out.to_dict(), sort_keys=True)
     if operation == "retain":
-        out = cp.interpreter.retain(Unstructured(desired or manifest), obj)
+        out = interp.retain(Unstructured(desired or manifest), obj)
         return json.dumps(out.to_dict(), sort_keys=True)
     if operation == "health":
-        return json.dumps({"healthy": cp.interpreter.interpret_health(obj)})
+        return json.dumps({"healthy": interp.interpret_health(obj)})
     if operation == "status":
-        return json.dumps({"status": cp.interpreter.reflect_status(obj)})
+        return json.dumps({"status": interp.reflect_status(obj)})
     if operation == "dependencies":
-        return json.dumps({"dependencies": cp.interpreter.get_dependencies(obj)})
+        return json.dumps({"dependencies": interp.get_dependencies(obj)})
+    if operation == "aggregateStatus":
+        from ..api.work import AggregatedStatusItem
+
+        items = [
+            AggregatedStatusItem(cluster_name=i.get("clusterName", ""),
+                                 status=i.get("status"))
+            for i in (status_items or [])
+        ]
+        out = interp.aggregate_status(obj, items)
+        return json.dumps(out.to_dict(), sort_keys=True, default=str)
     raise CLIError(f"unknown interpret operation {operation!r}")
 
 
@@ -803,10 +925,14 @@ def run(cp: ControlPlane, argv: list[str]) -> str:
     p = sub.add_parser("top")
     p.add_argument("resource", nargs="?", default="clusters")
     p = sub.add_parser("interpret")
-    p.add_argument("--operation", required=True)
+    p.add_argument("--operation", default="")
     p.add_argument("-f", "--filename", required=True)
+    p.add_argument("--check", action="store_true")
+    p.add_argument("--observed-file", default="")
     p.add_argument("--desired-file", default="")
-    p.add_argument("--replicas", type=int, default=0)
+    p.add_argument("--status-file", default="")
+    p.add_argument("--desired-replica", "--replicas", type=int, default=0,
+                   dest="replicas")
     p = sub.add_parser("apply")
     p.add_argument("-f", "--filename", required=True)
     p.add_argument("--all-clusters", action="store_true")
@@ -894,13 +1020,41 @@ def run(cp: ControlPlane, argv: list[str]) -> str:
     if args.command == "top":
         return cmd_top(cp)
     if args.command == "interpret":
-        with open(args.filename) as f:
-            manifest = json.load(f)
-        desired = None
-        if args.desired_file:
-            with open(args.desired_file) as f:
-                desired = json.load(f)
-        return cmd_interpret(cp, manifest, args.operation, desired, args.replicas)
+        def load(path):
+            with open(path) as f:
+                text = f.read()
+            try:
+                return json.loads(text)
+            except json.JSONDecodeError:
+                import yaml
+
+                return yaml.safe_load(text)
+
+        doc = load(args.filename)
+        if not isinstance(doc, dict):
+            raise CLIError(
+                f"{args.filename}: expected a single manifest object, got "
+                f"{type(doc).__name__}"
+            )
+        is_ric = doc.get("kind") == "ResourceInterpreterCustomization"
+        if args.check:
+            if not is_ric:
+                raise CLIError("--check needs a ResourceInterpreterCustomization file")
+            return cmd_interpret_check(doc)
+        if not args.operation:
+            raise CLIError("either --operation or --check is required")
+        desired = load(args.desired_file) if args.desired_file else None
+        status_items = load(args.status_file) if args.status_file else None
+        observed = load(args.observed_file) if args.observed_file else None
+        if is_ric:
+            if observed is None and args.operation not in ("reviseReplica",):
+                raise CLIError("--observed-file is required with a customization file")
+            return cmd_interpret(
+                cp, observed or desired or {}, args.operation, desired,
+                args.replicas, customization=doc, status_items=status_items,
+            )
+        return cmd_interpret(cp, observed or doc, args.operation, desired,
+                             args.replicas, status_items=status_items)
     if args.command == "apply":
         with open(args.filename) as f:
             manifest = json.load(f)

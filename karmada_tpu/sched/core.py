@@ -678,15 +678,12 @@ class ArrayScheduler:
         # re-transferred only on cluster-set change
         f = self.fleet
         if self.mesh is not None:
-            from ..parallel.mesh import (
-                AXIS_CLUSTERS,
-                MeshScheduleKernel,
-            )
+            from ..parallel.mesh import AXIS_CLUSTERS
             from jax.sharding import NamedSharding, PartitionSpec as P
 
-            if self._mesh_kernel is None:
-                self._mesh_kernel = MeshScheduleKernel(self.mesh)
-            self._mesh_kernel.set_fleet(self.fleet)
+            if self._mesh_kernel is not None:
+                # monolithic mode is in use: refresh its fleet copy
+                self._mesh_kernel.set_fleet(self.fleet)
             # the partitioned round runs the single-chip kernels with the
             # fleet COLUMN-SHARDED over the mesh; GSPMD partitions every
             # kernel (no manual padding: XLA handles uneven shards)
@@ -765,20 +762,22 @@ class ArrayScheduler:
     def _plugin_terms(self, bindings, padded_B: int):
         """Out-of-tree plugins' host-computed [B,C] mask/score terms
         (scheduler.go:241-244 out-of-tree registry merge); broadcastable
-        sentinels when none are registered. Padding rows stay all-feasible /
-        zero-score — they are never decoded."""
+        sentinels when none are registered. Plugins see only the REAL
+        cluster names — mesh pad columns stay all-feasible / zero-score,
+        and padding rows are never decoded."""
         if not self._oot_plugins:
             return self._NO_MASK, self._NO_SCORE
-        names = self.fleet.names
-        C = len(names)
+        C = len(self.fleet.names)
+        Cr = self.n_real_clusters
+        names = self.fleet.names[:Cr]
         n = len(bindings)
         mask = np.ones((padded_B, C), bool)
         score = np.zeros((padded_B, C), np.int32)
         for p in self._oot_plugins:
             if hasattr(p, "mask"):
-                mask[:n] &= np.asarray(p.mask(bindings, names), bool)
+                mask[:n, :Cr] &= np.asarray(p.mask(bindings, names), bool)
             if hasattr(p, "score"):
-                score[:n] += np.asarray(p.score(bindings, names), np.int32)
+                score[:n, :Cr] += np.asarray(p.score(bindings, names), np.int32)
         return mask, score
 
     def _batch_flags(self, batch: BindingBatch) -> tuple[int, bool, bool]:
@@ -824,7 +823,13 @@ class ArrayScheduler:
         self, batch: BindingBatch, extra_avail=None,
         extra_mask=None, extra_score=None,
     ):
-        if self._mesh_kernel is not None and not self.mesh_partitioned:
+        if self.mesh is not None and not self.mesh_partitioned:
+            if self._mesh_kernel is None:  # built lazily: the default
+                # partitioned mode never needs the second fleet copy
+                from ..parallel.mesh import MeshScheduleKernel
+
+                self._mesh_kernel = MeshScheduleKernel(self.mesh)
+                self._mesh_kernel.set_fleet(self.fleet)
             return self._mesh_kernel(
                 batch, extra_avail,
                 extra_mask=extra_mask, extra_score=extra_score,
@@ -954,7 +959,7 @@ class ArrayScheduler:
     def _schedule_once(
         self, bindings: Sequence, extra_avail=None, term_indices=None
     ) -> list[ScheduleDecision]:
-        if self._mesh_kernel is None or self.mesh_partitioned:
+        if self.mesh is None or self.mesh_partitioned:
             return self._schedule_once_partitioned(
                 bindings, extra_avail, term_indices
             )

@@ -697,11 +697,12 @@ def _combo_select_kernel(weight, value, kmax_row, rname, table, cmin: int,
     best_v = v_m.max(1)
     cand2 = cand & (sum_v == best_v[:, None])
     L = mp.shape[1]
-    if 6 * L <= 62:
+    if 7 * L <= 62:  # 7 bits/slot: positions reach 63 at R == MAX_REGIONS,
+        # so the pad sentinel must be a distinct 127
         seq = jnp.sort(
-            jnp.where(pos_g < 0, 63, pos_g).astype(jnp.int64), axis=2
+            jnp.where(pos_g < 0, 127, pos_g).astype(jnp.int64), axis=2
         )
-        shifts = 6 * jnp.arange(L - 1, -1, -1, dtype=jnp.int64)
+        shifts = 7 * jnp.arange(L - 1, -1, -1, dtype=jnp.int64)
         disc = (seq << shifts).sum(axis=2)
         disc_m = jnp.where(cand2, disc, jnp.int64(1) << 62)
         first_idx = jnp.argmin(disc_m, axis=1).astype(jnp.int32)
@@ -894,22 +895,21 @@ def select_regions_batch(
     n_ties = cand2.sum(1)
 
     first_idx = np.argmax(cand2, axis=1)
-    if n_ties.max(initial=0) > 1 and 6 * table.max_len <= 62:
+    if n_ties.max(initial=0) > 1 and 7 * table.max_len <= 62:
         # (Σw, Σv) ties resolve by DFS DISCOVERY ORDER (prioritizePaths
         # sorts (weight desc, value desc, id asc), select_groups.go:207-213;
         # id = append order of the DFS, which emits recorded paths in
         # lexicographic order of their group-order position sequences, and
         # no recorded path is a prefix of another — the DFS returns at the
         # first satisfied prefix). Pack each combo's sorted positions into
-        # one integer (6 bits/slot, pad 63) and take the min — skewed
+        # one integer (7 bits/slot — positions reach 63 at R == MAX_REGIONS,
+        # so the pad sentinel is a distinct 127) and take the min — skewed
         # fleets produce MANY exact ties (identical tiny regions), and this
         # keeps them off the per-row fallback entirely.
         tied = np.nonzero(n_ties > 1)[0]
-        seq = np.where(pos_g[tied] < 0, np.int8(63), pos_g[tied]).astype(
-            np.int64
-        )
+        seq = np.where(pos_g[tied] < 0, 127, pos_g[tied]).astype(np.int64)
         seq.sort(axis=2)
-        shifts = 6 * np.arange(table.max_len - 1, -1, -1, dtype=np.int64)
+        shifts = 7 * np.arange(table.max_len - 1, -1, -1, dtype=np.int64)
         disc = (seq << shifts).sum(axis=2)
         disc = np.where(cand2[tied], disc, np.int64(1) << 62)
         first_idx[tied] = disc.argmin(axis=1)
