@@ -1046,6 +1046,12 @@ def run(cp: ControlPlane, argv: list[str]) -> str:
         desired = load(args.desired_file) if args.desired_file else None
         status_items = load(args.status_file) if args.status_file else None
         observed = load(args.observed_file) if args.observed_file else None
+        if args.operation == "retain" and desired is None:
+            if is_ric or observed is not None:
+                # without an explicit desired template, retain(observed,
+                # observed) would merge the observed object with itself
+                raise CLIError("--desired-file is required for retain")
+            desired = doc  # plain-manifest form: -f IS the desired template
         if is_ric:
             if observed is None and args.operation not in ("reviseReplica",):
                 raise CLIError("--observed-file is required with a customization file")

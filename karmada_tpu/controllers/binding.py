@@ -126,6 +126,18 @@ class BindingController:
             md = manifest.get("metadata", {})
             for field in ("resourceVersion", "generation", "uid", "creationTimestamp"):
                 md.pop(field, None)
+            # the federated-generation protocol: members report which
+            # template revision they run via this annotation; status
+            # reflection lifts it and the aggregation's caught-up count
+            # gates observedGeneration (the reference stamps it in
+            # ensureWork, binding/common.go)
+            from ..interpreter.interpreter import (
+                RESOURCE_TEMPLATE_GENERATION_ANNOTATION,
+            )
+
+            md.setdefault("annotations", {})[
+                RESOURCE_TEMPLATE_GENERATION_ANNOTATION
+            ] = str(template.metadata.generation)
 
             wname = work_name(
                 template.api_version,

@@ -20,6 +20,11 @@ HEALTHY = "Healthy"
 UNHEALTHY = "Unhealthy"
 UNKNOWN = "Unknown"
 
+# the federated-generation protocol annotation (workv1alpha2
+# ResourceTemplateGenerationAnnotationKey): members report which template
+# revision they run; aggregations gate observedGeneration on it
+RESOURCE_TEMPLATE_GENERATION_ANNOTATION = "resourcetemplate.karmada.io/generation"
+
 
 @dataclass
 class KindInterpreter:
@@ -81,94 +86,7 @@ def _parse_quantity(v: Any) -> float:
     raise ValueError(f"unparseable quantity {v!r}")
 
 
-# ---------------------------------------------------------------------------
-# Default native interpreters (default/native/default.go equivalents)
-# ---------------------------------------------------------------------------
-
-
-def _deployment_get_replicas(obj: Unstructured):
-    replicas = int(obj.get("spec", "replicas", default=1) or 0)
-    pod_spec = obj.get("spec", "template", "spec", default={}) or {}
-    return replicas, _pod_template_requirements(pod_spec, obj.namespace)
-
-
-def _deployment_health(obj: Unstructured) -> str:
-    spec_replicas = int(obj.get("spec", "replicas", default=1) or 0)
-    ready = int(obj.get("status", "readyReplicas", default=0) or 0)
-    observed = int(obj.get("status", "observedGeneration", default=0) or 0)
-    if observed >= obj.metadata.generation and ready == spec_replicas:
-        return HEALTHY
-    return UNHEALTHY
-
-
-def _deployment_aggregate(template: Unstructured, items: list[AggregatedStatusItem]):
-    ready = available = updated = total = 0
-    for it in items:
-        st = it.status or {}
-        ready += int(st.get("readyReplicas", 0) or 0)
-        available += int(st.get("availableReplicas", 0) or 0)
-        updated += int(st.get("updatedReplicas", 0) or 0)
-        total += int(st.get("replicas", 0) or 0)
-    template.status = {
-        "replicas": total,
-        "readyReplicas": ready,
-        "availableReplicas": available,
-        "updatedReplicas": updated,
-    }
-    return template
-
-
-def _workload_dependencies(obj: Unstructured) -> list[dict]:
-    """ConfigMaps/Secrets referenced by the pod template (GetDependencies,
-    default/native/dependencies.go behavior)."""
-    pod_spec = obj.get("spec", "template", "spec", default={}) or {}
-    ns = obj.namespace
-    deps: list[dict] = []
-
-    def add(kind: str, name: str) -> None:
-        if name:
-            deps.append({"apiVersion": "v1", "kind": kind, "namespace": ns, "name": name})
-
-    for vol in pod_spec.get("volumes", []):
-        if "configMap" in vol:
-            add("ConfigMap", vol["configMap"].get("name", ""))
-        if "secret" in vol:
-            add("Secret", vol["secret"].get("secretName", ""))
-        if "persistentVolumeClaim" in vol:
-            add("PersistentVolumeClaim", vol["persistentVolumeClaim"].get("claimName", ""))
-    for container in pod_spec.get("containers", []):
-        for env in container.get("env", []):
-            src = env.get("valueFrom", {})
-            if "configMapKeyRef" in src:
-                add("ConfigMap", src["configMapKeyRef"].get("name", ""))
-            if "secretKeyRef" in src:
-                add("Secret", src["secretKeyRef"].get("name", ""))
-        for envfrom in container.get("envFrom", []):
-            if "configMapRef" in envfrom:
-                add("ConfigMap", envfrom["configMapRef"].get("name", ""))
-            if "secretRef" in envfrom:
-                add("Secret", envfrom["secretRef"].get("name", ""))
-    # dedupe preserving order
-    seen, out = set(), []
-    for d in deps:
-        k = (d["kind"], d["namespace"], d["name"])
-        if k not in seen:
-            seen.add(k)
-            out.append(d)
-    return out
-
-
-def _job_get_replicas(obj: Unstructured):
-    parallelism = int(obj.get("spec", "parallelism", default=1) or 0)
-    pod_spec = obj.get("spec", "template", "spec", default={}) or {}
-    return parallelism, _pod_template_requirements(pod_spec, obj.namespace)
-
-
-def _job_health(obj: Unstructured) -> str:
-    for cond in obj.get("status", "conditions", default=[]) or []:
-        if cond.get("type") == "Failed" and cond.get("status") == "True":
-            return UNHEALTHY
-    return HEALTHY
+# Default native interpreters live in interpreter/native.py (default/native/*.go equivalents, the full kind matrix).
 
 
 class ResourceInterpreter:
@@ -185,24 +103,9 @@ class ResourceInterpreter:
         self._registered: dict[str, KindInterpreter] = {}
         self._declarative: dict[str, KindInterpreter] = {}
         self._thirdparty: dict[str, KindInterpreter] = {}
-        self._native: dict[str, KindInterpreter] = {
-            "apps/v1/Deployment": KindInterpreter(
-                get_replicas=_deployment_get_replicas,
-                aggregate_status=_deployment_aggregate,
-                interpret_health=_deployment_health,
-                get_dependencies=_workload_dependencies,
-            ),
-            "apps/v1/StatefulSet": KindInterpreter(
-                get_replicas=_deployment_get_replicas,
-                interpret_health=_deployment_health,
-                get_dependencies=_workload_dependencies,
-            ),
-            "batch/v1/Job": KindInterpreter(
-                get_replicas=_job_get_replicas,
-                interpret_health=_job_health,
-                get_dependencies=_workload_dependencies,
-            ),
-        }
+        from .native import default_native_tier
+
+        self._native: dict[str, KindInterpreter] = default_native_tier()
 
     @staticmethod
     def _gvk(obj: Unstructured) -> str:

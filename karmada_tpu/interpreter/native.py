@@ -1,0 +1,693 @@
+"""Default native interpreters (I2) — the reference's built-in per-kind
+hooks (pkg/resourceinterpreter/default/native/{replica,revisereplica,
+aggregatestatus,reflectstatus,healthy,retain,dependencies}.go), kind for
+kind:
+
+  replicas:   Deployment, StatefulSet, Job, Pod
+  revise:     Deployment, StatefulSet, Job
+  aggregate:  Deployment, Service, Ingress, Job, CronJob, DaemonSet,
+              StatefulSet, Pod, PersistentVolume, PersistentVolumeClaim,
+              PodDisruptionBudget, HorizontalPodAutoscaler
+  reflect:    Deployment, Service, Ingress, Job, DaemonSet, StatefulSet,
+              PodDisruptionBudget, HorizontalPodAutoscaler
+  health:     Deployment, StatefulSet, ReplicaSet, DaemonSet, Service,
+              Ingress, PersistentVolumeClaim, Pod, PodDisruptionBudget
+  retain:     Deployment, Pod, Service, ServiceAccount,
+              PersistentVolumeClaim, PersistentVolume, Job, Secret
+  deps:       Deployment, Job, CronJob, Pod, DaemonSet, StatefulSet,
+              Ingress, ServiceImport
+
+The workload aggregations carry the federated-generation protocol: members
+report their generation + the `resourcetemplate.karmada.io/generation`
+annotation, and the aggregated observedGeneration advances to the template
+generation only when EVERY member caught up (aggregatestatus.go:81-87).
+"""
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+from ..api.unstructured import Unstructured
+from ..api.work import AggregatedStatusItem
+from .interpreter import (
+    HEALTHY,
+    KindInterpreter,
+    RESOURCE_TEMPLATE_GENERATION_ANNOTATION,
+    UNHEALTHY,
+    _pod_template_requirements,
+)
+
+RETAIN_REPLICAS_LABEL = "resourcetemplate.karmada.io/retain-replicas"
+
+
+def _statuses(items):
+    return [(it.cluster_name, it.status) for it in items if it.status is not None]
+
+
+def _set_status(template: Unstructured, status: dict) -> Unstructured:
+    template.set("status", status)
+    return template
+
+
+def _int(v) -> int:
+    return int(v or 0)
+
+
+def _aggregated_observed_generation(template: Unstructured, items) -> int:
+    """aggregatestatus.go:81-87 — member caught up when its own status is
+    current (observedGeneration >= generation) AND it runs the latest
+    federated revision (resourceTemplateGeneration >= template generation).
+
+    NOTE: deliberately >= like the Go native tier; the thirdparty tier's
+    twin (thirdparty._aggregate_observed_generation) uses the == form its
+    Lua scripts carry — the reference's own two tiers diverge here."""
+    generation = template.metadata.generation or 0
+    prev = _int(template.get("status", "observedGeneration", default=0))
+    count = 0
+    for _, st in _statuses(items):
+        if (
+            _int(st.get("observedGeneration")) >= _int(st.get("generation"))
+            and _int(st.get("resourceTemplateGeneration")) >= generation
+        ):
+            count += 1
+    return generation if count == len(items) else prev
+
+
+def _sum_aggregate(fields: tuple, observed_generation: bool = True):
+    """The workload shape: member counters sum; observedGeneration advances
+    via the caught-up count (Deployment/DaemonSet/StatefulSet)."""
+
+    def aggregate(template: Unstructured, items) -> Unstructured:
+        status = {f: 0 for f in fields}
+        for _, st in _statuses(items):
+            for f in fields:
+                status[f] += _int(st.get(f))
+        if observed_generation:
+            status["observedGeneration"] = _aggregated_observed_generation(
+                template, items
+            )
+        return _set_status(template, status)
+
+    return aggregate
+
+
+def _reflect_fields(fields: tuple, with_generation: bool = True):
+    """reflectstatus.go shape: the field subset, plus the member generation
+    and the resource-template generation lifted from the annotation."""
+
+    def reflect(obj: Unstructured) -> Optional[dict]:
+        observed = obj.get("status") or {}
+        status = {f: observed[f] for f in fields if f in observed}
+        if with_generation:
+            status["generation"] = obj.metadata.generation
+            rtg = obj.metadata.annotations.get(
+                RESOURCE_TEMPLATE_GENERATION_ANNOTATION
+            )
+            if rtg is not None:
+                try:
+                    status["resourceTemplateGeneration"] = int(float(rtg))
+                except (TypeError, ValueError):
+                    pass
+        return status or None
+
+    return reflect
+
+
+# ---------------------------------------------------------------------------
+# replicas / revise
+# ---------------------------------------------------------------------------
+
+
+def _replicas_from(path: tuple, template_path=("spec", "template")):
+    def get_replicas(obj: Unstructured):
+        v = obj.get(*path)
+        replicas = _int(v) if v is not None else 1
+        tpl = obj.get(*template_path, default={}) or {}
+        pod_spec = tpl.get("spec", {}) or {}
+        return replicas, _pod_template_requirements(pod_spec, obj.namespace)
+
+    return get_replicas
+
+
+def _pod_get_replicas(obj: Unstructured):
+    """A bare Pod is one replica carrying its own spec (replica.go)."""
+    return 1, _pod_template_requirements(obj.get("spec") or {}, obj.namespace)
+
+
+def _revise(path: tuple):
+    def revise(obj: Unstructured, n: int) -> Unstructured:
+        obj.set(*path, n)
+        return obj
+
+    return revise
+
+
+# ---------------------------------------------------------------------------
+# health
+# ---------------------------------------------------------------------------
+
+
+def _workload_health(obj: Unstructured) -> str:
+    """Deployment/StatefulSet: caught up + fully updated + all updated
+    available (healthy.go:47-83)."""
+    st = obj.get("status") or {}
+    if _int(st.get("observedGeneration")) != obj.metadata.generation:
+        return UNHEALTHY
+    spec_replicas = obj.get("spec", "replicas")
+    if spec_replicas is not None and _int(st.get("updatedReplicas")) < spec_replicas:
+        return UNHEALTHY
+    if _int(st.get("availableReplicas")) < _int(st.get("updatedReplicas")):
+        return UNHEALTHY
+    return HEALTHY
+
+
+def _replicaset_health(obj: Unstructured) -> str:
+    st = obj.get("status") or {}
+    if _int(st.get("observedGeneration")) != obj.metadata.generation:
+        return UNHEALTHY
+    spec_replicas = obj.get("spec", "replicas")
+    if spec_replicas is not None and _int(st.get("availableReplicas")) < spec_replicas:
+        return UNHEALTHY
+    return HEALTHY
+
+
+def _daemonset_health(obj: Unstructured) -> str:
+    st = obj.get("status") or {}
+    if _int(st.get("observedGeneration")) != obj.metadata.generation:
+        return UNHEALTHY
+    if _int(st.get("updatedNumberScheduled")) < _int(st.get("desiredNumberScheduled")):
+        return UNHEALTHY
+    if _int(st.get("numberAvailable")) < _int(st.get("updatedNumberScheduled")):
+        return UNHEALTHY
+    return HEALTHY
+
+
+def _lb_ingress_present(obj: Unstructured) -> bool:
+    for ing in obj.get("status", "loadBalancer", "ingress", default=[]) or []:
+        if ing.get("hostname") or ing.get("ip"):
+            return True
+    return False
+
+
+def _service_health(obj: Unstructured) -> str:
+    if obj.get("spec", "type") != "LoadBalancer":
+        return HEALTHY
+    return HEALTHY if _lb_ingress_present(obj) else UNHEALTHY
+
+
+def _ingress_health(obj: Unstructured) -> str:
+    return HEALTHY if _lb_ingress_present(obj) else UNHEALTHY
+
+
+def _pvc_health(obj: Unstructured) -> str:
+    return HEALTHY if obj.get("status", "phase") == "Bound" else UNHEALTHY
+
+
+def _pod_health(obj: Unstructured) -> str:
+    st = obj.get("status") or {}
+    if st.get("phase") == "Succeeded":
+        return HEALTHY
+    if st.get("phase") == "Running":
+        for cond in st.get("conditions") or []:
+            if cond.get("type") == "Ready" and cond.get("status") == "True":
+                return HEALTHY
+    return UNHEALTHY
+
+
+def _pdb_health(obj: Unstructured) -> str:
+    st = obj.get("status") or {}
+    return (
+        HEALTHY
+        if _int(st.get("currentHealthy")) >= _int(st.get("desiredHealthy"))
+        else UNHEALTHY
+    )
+
+
+def _job_health(obj: Unstructured) -> str:
+    for cond in obj.get("status", "conditions", default=[]) or []:
+        if cond.get("type") == "Failed" and cond.get("status") == "True":
+            return UNHEALTHY
+    return HEALTHY
+
+
+# ---------------------------------------------------------------------------
+# aggregate
+# ---------------------------------------------------------------------------
+
+
+def _lb_aggregate(template: Unstructured, items) -> Unstructured:
+    """Service/Ingress: concatenate + dedupe + sort member load-balancer
+    ingress entries (aggregatestatus.go:123-192)."""
+    if template.kind == "Service" and template.get("spec", "type") != "LoadBalancer":
+        return template
+    entries = []
+    for _, st in _statuses(items):
+        entries.extend((st.get("loadBalancer") or {}).get("ingress") or [])
+    seen, deduped = set(), []
+    for e in entries:
+        key = (e.get("ip", ""), e.get("hostname", ""))
+        if key not in seen:
+            seen.add(key)
+            deduped.append(e)
+    deduped.sort(key=lambda e: (e.get("ip", ""), e.get("hostname", "")))
+    return _set_status(template, {"loadBalancer": {"ingress": deduped}})
+
+
+def _job_finished(status: dict) -> Optional[str]:
+    for cond in status.get("conditions") or []:
+        if cond.get("status") == "True" and cond.get("type") in ("Complete", "Failed"):
+            return cond["type"]
+    return None
+
+
+def _job_aggregate(template: Unstructured, items) -> Unstructured:
+    """helper.ParsingJobStatus (job.go:35-99): sums + earliest start /
+    latest completion + Failed/Complete conditions; a finished Job never
+    updates again."""
+    if _job_finished(template.get("status") or {}) is not None:
+        return template
+    status: dict = {"active": 0, "succeeded": 0, "failed": 0}
+    failed_clusters = []
+    successful = 0
+    start_time = completion_time = None
+    for cluster, st in _statuses(items):
+        status["active"] += _int(st.get("active"))
+        status["succeeded"] += _int(st.get("succeeded"))
+        status["failed"] += _int(st.get("failed"))
+        finished = _job_finished(st)
+        if finished == "Complete":
+            successful += 1
+        elif finished == "Failed":
+            failed_clusters.append(cluster)
+        ts = st.get("startTime")
+        if ts is not None and (start_time is None or ts < start_time):
+            start_time = ts
+        tc = st.get("completionTime")
+        if tc is not None and (completion_time is None or completion_time < tc):
+            completion_time = tc
+    conditions = []
+    if failed_clusters:
+        conditions.append({
+            "type": "Failed", "status": "True", "reason": "JobFailed",
+            "message": "Job executed failed in member clusters "
+                       + ",".join(failed_clusters),
+        })
+    if successful == len(items) and successful > 0:
+        conditions.append({
+            "type": "Complete", "status": "True", "reason": "Completed",
+            "message": "Job completed",
+        })
+        if start_time is not None:
+            status["startTime"] = start_time
+        if completion_time is not None:
+            status["completionTime"] = completion_time
+    if conditions:
+        status["conditions"] = conditions
+    return _set_status(template, status)
+
+
+def _cronjob_aggregate(template: Unstructured, items) -> Unstructured:
+    """Active refs concatenate; schedule/success times take the LATEST
+    (aggregatestatus.go:220-259)."""
+    active: list = []
+    last_schedule = last_successful = None
+    for _, st in _statuses(items):
+        active.extend(st.get("active") or [])
+        ts = st.get("lastScheduleTime")
+        if ts is not None and (last_schedule is None or last_schedule < ts):
+            last_schedule = ts
+        tc = st.get("lastSuccessfulTime")
+        if tc is not None and (last_successful is None or last_successful < tc):
+            last_successful = tc
+    status: dict = {"active": active}
+    if last_schedule is not None:
+        status["lastScheduleTime"] = last_schedule
+    if last_successful is not None:
+        status["lastSuccessfulTime"] = last_successful
+    return _set_status(template, status)
+
+
+def _pod_aggregate(template: Unstructured, items) -> Unstructured:
+    """Container statuses concatenate; the aggregated phase checks
+    Failed → Pending → Running → Succeeded (aggregatestatus.go:384-453; a
+    member without status counts as Pending)."""
+    if not items:
+        return template
+    phases = set()
+    containers: list = []
+    init_containers: list = []
+    for it in items:
+        st = it.status
+        if st is None:
+            phases.add("Pending")
+            continue
+        phases.add(st.get("phase"))
+        for cs in st.get("containerStatuses") or []:
+            containers.append({"ready": cs.get("ready", False),
+                               "state": cs.get("state", {})})
+        for cs in st.get("initContainerStatuses") or []:
+            init_containers.append({"ready": cs.get("ready", False),
+                                    "state": cs.get("state", {})})
+    phase = ""
+    for candidate in ("Failed", "Pending", "Running", "Succeeded"):
+        if candidate in phases:
+            phase = candidate
+            break
+    status: dict = {"phase": phase, "containerStatuses": containers}
+    if init_containers:
+        status["initContainerStatuses"] = init_containers
+    return _set_status(template, status)
+
+
+def _pv_aggregate(template: Unstructured, items) -> Unstructured:
+    """Phase precedence Failed → Pending → Available → Bound → Released
+    (aggregatestatus.go:456-507; missing member status counts Pending)."""
+    phases = set()
+    for it in items:
+        if it.status is None:
+            phases.add("Pending")
+        else:
+            phases.add(it.status.get("phase"))
+    phase = ""
+    for candidate in ("Failed", "Pending", "Available", "Bound", "Released"):
+        if candidate in phases:
+            phase = candidate
+            break
+    return _set_status(template, {"phase": phase})
+
+
+def _pvc_aggregate(template: Unstructured, items) -> Unstructured:
+    """Bound unless any member disagrees; Lost short-circuits
+    (aggregatestatus.go:509-545)."""
+    phase = "Bound"
+    for _, st in _statuses(items):
+        p = st.get("phase")
+        if p == "Lost":
+            phase = "Lost"
+            break
+        if p != "Bound":
+            phase = p
+    return _set_status(template, {"phase": phase})
+
+
+def _pdb_aggregate(template: Unstructured, items) -> Unstructured:
+    """Counters sum; disruptedPods key by '{cluster}/{pod}'
+    (aggregatestatus.go:547-588)."""
+    status = {"currentHealthy": 0, "desiredHealthy": 0, "expectedPods": 0,
+              "disruptionsAllowed": 0, "disruptedPods": {}}
+    for cluster, st in _statuses(items):
+        status["currentHealthy"] += _int(st.get("currentHealthy"))
+        status["desiredHealthy"] += _int(st.get("desiredHealthy"))
+        status["expectedPods"] += _int(st.get("expectedPods"))
+        status["disruptionsAllowed"] += _int(st.get("disruptionsAllowed"))
+        for pod, t in (st.get("disruptedPods") or {}).items():
+            status["disruptedPods"][f"{cluster}/{pod}"] = t
+    return _set_status(template, status)
+
+
+def _hpa_aggregate(template: Unstructured, items) -> Unstructured:
+    status = {"currentReplicas": 0, "desiredReplicas": 0}
+    for _, st in _statuses(items):
+        status["currentReplicas"] += _int(st.get("currentReplicas"))
+        status["desiredReplicas"] += _int(st.get("desiredReplicas"))
+    return _set_status(template, status)
+
+
+# ---------------------------------------------------------------------------
+# retain
+# ---------------------------------------------------------------------------
+
+
+def _retain_workload_replicas(desired: Unstructured, observed: Unstructured):
+    """With the retain-replicas label, member-side replica counts (e.g. an
+    HPA's) win over the template's (retain.go:145-163)."""
+    if desired.metadata.labels.get(RETAIN_REPLICAS_LABEL) == "true":
+        replicas = observed.get("spec", "replicas")
+        if replicas is not None:
+            desired.set("spec", "replicas", replicas)
+    return desired
+
+
+def _retain_pod_fields(desired: Unstructured, observed: Unstructured):
+    """nodeName / serviceAccountName / volumes / per-container volumeMounts
+    are member-cluster-managed (retain.go:64-106)."""
+    for field in ("nodeName", "serviceAccountName", "volumes"):
+        v = observed.get("spec", field)
+        if v is not None:
+            desired.set("spec", field, v)
+    for key in ("containers", "initContainers"):
+        observed_cs = {c.get("name"): c for c in observed.get("spec", key, default=[]) or []}
+        for c in desired.get("spec", key, default=[]) or []:
+            oc = observed_cs.get(c.get("name"))
+            if oc is not None and "volumeMounts" in oc:
+                c["volumeMounts"] = oc["volumeMounts"]
+    return desired
+
+
+def _retain_service_fields(desired: Unstructured, observed: Unstructured):
+    """clusterIP + healthCheckNodePort are member-allocated
+    (lifted RetainServiceFields)."""
+    hc = observed.get("spec", "healthCheckNodePort")
+    if hc:
+        desired.set("spec", "healthCheckNodePort", hc)
+    cluster_ip = observed.get("spec", "clusterIP")
+    if cluster_ip:
+        desired.set("spec", "clusterIP", cluster_ip)
+    return desired
+
+
+def _retain_serviceaccount_fields(desired: Unstructured, observed: Unstructured):
+    """Merge member-generated token secrets into the desired list
+    (lifted RetainServiceAccountFields)."""
+    merged = []
+    seen = set()
+    for s in (desired.get("secrets") or []) + (observed.get("secrets") or []):
+        name = s.get("name")
+        if name in seen:
+            continue
+        seen.add(name)
+        merged.append(s)
+    if merged:
+        desired.set("secrets", merged)
+    return desired
+
+
+def _retain_pvc_fields(desired: Unstructured, observed: Unstructured):
+    volume_name = observed.get("spec", "volumeName")
+    if volume_name:
+        desired.set("spec", "volumeName", volume_name)
+    return desired
+
+
+def _retain_pv_fields(desired: Unstructured, observed: Unstructured):
+    claim_ref = observed.get("spec", "claimRef")
+    if claim_ref is not None:
+        desired.set("spec", "claimRef", claim_ref)
+    return desired
+
+
+def _retain_job_selector(desired: Unstructured, observed: Unstructured):
+    """Job selector + template labels carry member-generated uids
+    (retain.go:120-144)."""
+    match = observed.get("spec", "selector", "matchLabels")
+    if match is not None:
+        desired.set("spec", "selector", "matchLabels", match)
+    tpl_labels = observed.get("spec", "template", "metadata", "labels")
+    if tpl_labels is not None:
+        desired.set("spec", "template", "metadata", "labels", tpl_labels)
+    return desired
+
+
+def _retain_secret_sa_token(desired: Unstructured, observed: Unstructured):
+    if desired.get("type") == "kubernetes.io/service-account-token":
+        data = observed.get("data")
+        if data is not None:
+            desired.set("data", data)
+    return desired
+
+
+# ---------------------------------------------------------------------------
+# dependencies
+# ---------------------------------------------------------------------------
+
+
+def _pod_template_deps(template_path=("spec", "template")):
+    from .thirdparty import _pod_spec_dependencies
+
+    def deps(obj: Unstructured) -> list[dict]:
+        tpl = obj.get(*template_path, default={}) or {}
+        return _pod_spec_dependencies(tpl.get("spec", {}) or {}, obj.namespace)
+
+    return deps
+
+
+def _pod_deps(obj: Unstructured) -> list[dict]:
+    from .thirdparty import _pod_spec_dependencies
+
+    return _pod_spec_dependencies(obj.get("spec") or {}, obj.namespace)
+
+
+def _statefulset_deps(obj: Unstructured) -> list[dict]:
+    """Pod-template deps minus PVCs that the StatefulSet's own
+    volumeClaimTemplates will create (dependencies.go:126-166)."""
+    deps = _pod_template_deps()(obj)
+    claim_names = {
+        (t.get("metadata") or {}).get("name")
+        for t in obj.get("spec", "volumeClaimTemplates", default=[]) or []
+    }
+    return [
+        d for d in deps
+        if d["kind"] != "PersistentVolumeClaim" or d["name"] not in claim_names
+    ]
+
+
+def _ingress_deps(obj: Unstructured) -> list[dict]:
+    return [
+        {"apiVersion": "v1", "kind": "Secret", "namespace": obj.namespace,
+         "name": tls.get("secretName", "")}
+        for tls in obj.get("spec", "tls", default=[]) or []
+    ]
+
+
+def _serviceimport_deps(obj: Unstructured) -> list[dict]:
+    """The derived service + its EndpointSlices
+    (dependencies.go:190-211; names.GenerateDerivedServiceName)."""
+    derived = f"derived-{obj.name}"
+    return [
+        {"apiVersion": "v1", "kind": "Service", "namespace": obj.namespace,
+         "name": derived},
+        {"apiVersion": "discovery.k8s.io/v1", "kind": "EndpointSlice",
+         "namespace": obj.namespace,
+         "labelSelector": {"matchLabels": {
+             "kubernetes.io/service-name": derived}}},
+    ]
+
+
+# ---------------------------------------------------------------------------
+# registry
+# ---------------------------------------------------------------------------
+
+
+def default_native_tier() -> dict[str, KindInterpreter]:
+    deployment_reflect = _reflect_fields((
+        "replicas", "updatedReplicas", "readyReplicas", "availableReplicas",
+        "unavailableReplicas", "observedGeneration",
+    ))
+    statefulset_reflect = _reflect_fields((
+        "replicas", "readyReplicas", "currentReplicas", "updatedReplicas",
+        "availableReplicas", "observedGeneration",
+    ))
+    return {
+        "apps/v1/Deployment": KindInterpreter(
+            get_replicas=_replicas_from(("spec", "replicas")),
+            revise_replica=_revise(("spec", "replicas")),
+            aggregate_status=_sum_aggregate((
+                "replicas", "readyReplicas", "updatedReplicas",
+                "availableReplicas", "unavailableReplicas",
+            )),
+            reflect_status=deployment_reflect,
+            interpret_health=_workload_health,
+            retain=_retain_workload_replicas,
+            get_dependencies=_pod_template_deps(),
+        ),
+        "apps/v1/StatefulSet": KindInterpreter(
+            get_replicas=_replicas_from(("spec", "replicas")),
+            revise_replica=_revise(("spec", "replicas")),
+            aggregate_status=_sum_aggregate((
+                "availableReplicas", "currentReplicas", "readyReplicas",
+                "replicas", "updatedReplicas",
+            )),
+            reflect_status=statefulset_reflect,
+            interpret_health=_workload_health,
+            get_dependencies=_statefulset_deps,
+        ),
+        "apps/v1/ReplicaSet": KindInterpreter(
+            interpret_health=_replicaset_health,
+        ),
+        "apps/v1/DaemonSet": KindInterpreter(
+            aggregate_status=_sum_aggregate((
+                "currentNumberScheduled", "desiredNumberScheduled",
+                "numberAvailable", "numberMisscheduled", "numberReady",
+                "updatedNumberScheduled", "numberUnavailable",
+            )),
+            reflect_status=_reflect_fields((
+                "currentNumberScheduled", "desiredNumberScheduled",
+                "numberAvailable", "numberMisscheduled", "numberReady",
+                "updatedNumberScheduled", "numberUnavailable",
+                "observedGeneration",
+            )),
+            interpret_health=_daemonset_health,
+            get_dependencies=_pod_template_deps(),
+        ),
+        "batch/v1/Job": KindInterpreter(
+            get_replicas=_replicas_from(("spec", "parallelism")),
+            revise_replica=_revise(("spec", "parallelism")),
+            aggregate_status=_job_aggregate,
+            reflect_status=_reflect_fields((
+                "active", "succeeded", "failed", "conditions", "startTime",
+                "completionTime",
+            ), with_generation=False),
+            interpret_health=_job_health,
+            retain=_retain_job_selector,
+            get_dependencies=_pod_template_deps(),
+        ),
+        "batch/v1/CronJob": KindInterpreter(
+            aggregate_status=_cronjob_aggregate,
+            get_dependencies=_pod_template_deps(
+                ("spec", "jobTemplate", "spec", "template")
+            ),
+        ),
+        "v1/Pod": KindInterpreter(
+            get_replicas=_pod_get_replicas,
+            aggregate_status=_pod_aggregate,
+            interpret_health=_pod_health,
+            retain=_retain_pod_fields,
+            get_dependencies=_pod_deps,
+        ),
+        "v1/Service": KindInterpreter(
+            aggregate_status=_lb_aggregate,
+            reflect_status=lambda obj: (
+                {"loadBalancer": obj.get("status", "loadBalancer") or {}}
+                if obj.get("spec", "type") == "LoadBalancer"
+                else None
+            ),
+            interpret_health=_service_health,
+            retain=_retain_service_fields,
+        ),
+        "networking.k8s.io/v1/Ingress": KindInterpreter(
+            aggregate_status=_lb_aggregate,
+            interpret_health=_ingress_health,
+            get_dependencies=_ingress_deps,
+        ),
+        "v1/PersistentVolume": KindInterpreter(
+            aggregate_status=_pv_aggregate,
+            retain=_retain_pv_fields,
+        ),
+        "v1/PersistentVolumeClaim": KindInterpreter(
+            aggregate_status=_pvc_aggregate,
+            interpret_health=_pvc_health,
+            retain=_retain_pvc_fields,
+        ),
+        "v1/ServiceAccount": KindInterpreter(
+            retain=_retain_serviceaccount_fields,
+        ),
+        "v1/Secret": KindInterpreter(
+            retain=_retain_secret_sa_token,
+        ),
+        "policy/v1/PodDisruptionBudget": KindInterpreter(
+            aggregate_status=_pdb_aggregate,
+            reflect_status=_reflect_fields((
+                "currentHealthy", "desiredHealthy", "expectedPods",
+                "disruptionsAllowed", "disruptedPods",
+            ), with_generation=False),
+            interpret_health=_pdb_health,
+        ),
+        "autoscaling/v2/HorizontalPodAutoscaler": KindInterpreter(
+            aggregate_status=_hpa_aggregate,
+            reflect_status=_reflect_fields((
+                "currentReplicas", "desiredReplicas", "currentMetrics",
+            ), with_generation=False),
+        ),
+        "multicluster.x-k8s.io/v1alpha1/ServiceImport": KindInterpreter(
+            get_dependencies=_serviceimport_deps,
+        ),
+    }

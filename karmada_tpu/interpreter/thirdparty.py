@@ -34,12 +34,11 @@ from ..api.work import AggregatedStatusItem, NodeClaim, ReplicaRequirements
 from .interpreter import (
     HEALTHY,
     KindInterpreter,
+    RESOURCE_TEMPLATE_GENERATION_ANNOTATION,
     UNHEALTHY,
     _parse_quantity,
     _pod_template_requirements,
 )
-
-RESOURCE_TEMPLATE_GENERATION_ANNOTATION = "resourcetemplate.karmada.io/generation"
 
 
 # ---------------------------------------------------------------------------

@@ -487,6 +487,16 @@ class TestInterpretCustomizations:
         ]))
         assert out["status"]["ready"] == 3
 
+    def test_retain_requires_desired_file(self, tmp_path):
+        cp = ControlPlane()
+        f = self._write(tmp_path, "ric.json", self.RIC)
+        observed = self._write(tmp_path, "obs.json", {
+            "apiVersion": "example.io/v1", "kind": "App",
+            "metadata": {"name": "a"}, "spec": {}})
+        with pytest.raises(CLIError, match="--desired-file"):
+            run(cp, ["interpret", "-f", f, "--operation", "retain",
+                     "--observed-file", observed])
+
     def test_reference_shipped_yaml_checks(self):
         """The reference's own shipped CloneSet customizations.yaml passes
         --check unmodified (Lua compatibility, end to end through the CLI)."""

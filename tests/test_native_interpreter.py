@@ -1,0 +1,407 @@
+"""Default native interpreter matrix (I2): per-kind per-operation behavior
+mirroring pkg/resourceinterpreter/default/native/*.go, plus the
+federated-generation protocol end to end."""
+from __future__ import annotations
+
+import pytest
+
+from karmada_tpu.api.unstructured import Unstructured
+from karmada_tpu.api.work import AggregatedStatusItem
+from karmada_tpu.interpreter.interpreter import (
+    HEALTHY,
+    ResourceInterpreter,
+    UNHEALTHY,
+)
+
+
+def interp() -> ResourceInterpreter:
+    return ResourceInterpreter()
+
+
+def obj(api_version, kind, *, spec=None, status=None, generation=1,
+        ns="default", name="x", labels=None, annotations=None, data=None,
+        typ=None, secrets=None):
+    m = {
+        "apiVersion": api_version, "kind": kind,
+        "metadata": {"name": name, "namespace": ns, "generation": generation,
+                     "labels": dict(labels or {}),
+                     "annotations": dict(annotations or {})},
+    }
+    if spec is not None:
+        m["spec"] = spec
+    if status is not None:
+        m["status"] = status
+    if data is not None:
+        m["data"] = data
+    if typ is not None:
+        m["type"] = typ
+    if secrets is not None:
+        m["secrets"] = secrets
+    return Unstructured(m)
+
+
+def item(cluster, status):
+    return AggregatedStatusItem(cluster_name=cluster, status=status)
+
+
+class TestDeployment:
+    def test_aggregate_observed_generation_protocol(self):
+        tmpl = obj("apps/v1", "Deployment", generation=3,
+                   spec={"replicas": 4}, status={"observedGeneration": 2})
+        caught_up = [
+            item("m1", {"replicas": 2, "readyReplicas": 2,
+                        "generation": 7, "observedGeneration": 7,
+                        "resourceTemplateGeneration": 3}),
+            item("m2", {"replicas": 2, "readyReplicas": 2,
+                        "generation": 5, "observedGeneration": 5,
+                        "resourceTemplateGeneration": 3}),
+        ]
+        st = interp().aggregate_status(tmpl, caught_up).get("status")
+        assert st["replicas"] == 4 and st["readyReplicas"] == 4
+        assert st["observedGeneration"] == 3  # every member caught up
+        # one member on a stale template revision → holds the previous value
+        stale = [caught_up[0],
+                 item("m2", {"replicas": 2, "generation": 5,
+                             "observedGeneration": 5,
+                             "resourceTemplateGeneration": 2})]
+        tmpl2 = obj("apps/v1", "Deployment", generation=3,
+                    spec={"replicas": 4}, status={"observedGeneration": 2})
+        st2 = interp().aggregate_status(tmpl2, stale).get("status")
+        assert st2["observedGeneration"] == 2
+
+    def test_reflect_lifts_generation_annotation(self):
+        o = obj("apps/v1", "Deployment", generation=6,
+                annotations={"resourcetemplate.karmada.io/generation": "4"},
+                status={"replicas": 2, "readyReplicas": 2,
+                        "observedGeneration": 6})
+        st = interp().reflect_status(o)
+        assert st["generation"] == 6
+        assert st["resourceTemplateGeneration"] == 4
+        assert st["readyReplicas"] == 2
+
+    def test_retain_replicas_label(self):
+        ri = interp()
+        desired = obj("apps/v1", "Deployment", spec={"replicas": 3},
+                      labels={"resourcetemplate.karmada.io/retain-replicas":
+                              "true"})
+        observed = obj("apps/v1", "Deployment", spec={"replicas": 9})
+        assert ri.retain(desired, observed).get("spec", "replicas") == 9
+        plain = obj("apps/v1", "Deployment", spec={"replicas": 3})
+        assert ri.retain(plain, observed).get("spec", "replicas") == 3
+
+
+class TestReplicaSetAndDaemonSet:
+    def test_replicaset_health(self):
+        ri = interp()
+        ok = obj("apps/v1", "ReplicaSet", generation=1,
+                 spec={"replicas": 2},
+                 status={"observedGeneration": 1, "availableReplicas": 2})
+        assert ri.interpret_health(ok) == HEALTHY
+        low = obj("apps/v1", "ReplicaSet", generation=1,
+                  spec={"replicas": 3},
+                  status={"observedGeneration": 1, "availableReplicas": 2})
+        assert ri.interpret_health(low) == UNHEALTHY
+
+    def test_daemonset_aggregate_and_health(self):
+        ri = interp()
+        tmpl = obj("apps/v1", "DaemonSet", generation=1, status={})
+        items = [
+            item("m1", {"desiredNumberScheduled": 3, "numberReady": 3,
+                        "updatedNumberScheduled": 3, "numberAvailable": 3,
+                        "generation": 1, "observedGeneration": 1,
+                        "resourceTemplateGeneration": 1}),
+        ]
+        st = ri.aggregate_status(tmpl, items).get("status")
+        assert st["desiredNumberScheduled"] == 3
+        assert st["observedGeneration"] == 1
+        healthy = obj("apps/v1", "DaemonSet", generation=1,
+                      status={"observedGeneration": 1,
+                              "desiredNumberScheduled": 2,
+                              "updatedNumberScheduled": 2,
+                              "numberAvailable": 2})
+        assert ri.interpret_health(healthy) == HEALTHY
+
+
+class TestJob:
+    def test_aggregate_conditions_and_times(self):
+        tmpl = obj("batch/v1", "Job", spec={"parallelism": 2}, status={})
+        items = [
+            item("m1", {"succeeded": 1, "startTime": "2024-01-01T00:00:00Z",
+                        "completionTime": "2024-01-01T01:00:00Z",
+                        "conditions": [{"type": "Complete",
+                                        "status": "True"}]}),
+            item("m2", {"succeeded": 1, "startTime": "2024-01-01T00:30:00Z",
+                        "completionTime": "2024-01-01T02:00:00Z",
+                        "conditions": [{"type": "Complete",
+                                        "status": "True"}]}),
+        ]
+        st = interp().aggregate_status(tmpl, items).get("status")
+        assert st["succeeded"] == 2
+        assert [c["type"] for c in st["conditions"]] == ["Complete"]
+        assert st["startTime"] == "2024-01-01T00:00:00Z"  # earliest
+        assert st["completionTime"] == "2024-01-01T02:00:00Z"  # latest
+
+    def test_aggregate_failed_lists_clusters(self):
+        tmpl = obj("batch/v1", "Job", status={})
+        items = [item("m1", {"failed": 1, "conditions": [
+            {"type": "Failed", "status": "True"}]})]
+        st = interp().aggregate_status(tmpl, items).get("status")
+        cond = st["conditions"][0]
+        assert cond["type"] == "Failed" and "m1" in cond["message"]
+
+    def test_finished_job_never_updates(self):
+        tmpl = obj("batch/v1", "Job", status={
+            "succeeded": 5,
+            "conditions": [{"type": "Complete", "status": "True"}]})
+        st = interp().aggregate_status(
+            tmpl, [item("m1", {"succeeded": 1})]
+        ).get("status")
+        assert st["succeeded"] == 5  # untouched
+
+    def test_retain_selector(self):
+        desired = obj("batch/v1", "Job", spec={"template": {"metadata": {}}})
+        observed = obj("batch/v1", "Job", spec={
+            "selector": {"matchLabels": {"controller-uid": "u1"}},
+            "template": {"metadata": {"labels": {"controller-uid": "u1"}}},
+        })
+        out = interp().retain(desired, observed)
+        assert out.get("spec", "selector", "matchLabels") == {
+            "controller-uid": "u1"}
+        assert out.get("spec", "template", "metadata", "labels") == {
+            "controller-uid": "u1"}
+
+
+class TestCronJob:
+    def test_aggregate_latest_times(self):
+        tmpl = obj("batch/v1", "CronJob", status={})
+        items = [
+            item("m1", {"active": [{"name": "j1"}],
+                        "lastScheduleTime": "2024-01-01T00:00:00Z"}),
+            item("m2", {"active": [{"name": "j2"}],
+                        "lastScheduleTime": "2024-01-02T00:00:00Z"}),
+        ]
+        st = interp().aggregate_status(tmpl, items).get("status")
+        assert len(st["active"]) == 2
+        assert st["lastScheduleTime"] == "2024-01-02T00:00:00Z"
+
+    def test_dependencies_from_job_template(self):
+        o = obj("batch/v1", "CronJob", spec={"jobTemplate": {"spec": {
+            "template": {"spec": {"volumes": [
+                {"name": "v", "configMap": {"name": "cm"}}]}}}}})
+        assert {d["name"] for d in interp().get_dependencies(o)} == {"cm"}
+
+
+class TestPod:
+    def test_replicas_is_one_with_own_spec(self):
+        o = obj("v1", "Pod", spec={"containers": [
+            {"resources": {"requests": {"cpu": "2"}}}]})
+        n, req = interp().get_replicas(o)
+        assert n == 1 and req.resource_request["cpu"] == 2.0
+
+    def test_aggregate_phase_precedence(self):
+        ri = interp()
+        tmpl = obj("v1", "Pod", status={})
+        st = ri.aggregate_status(tmpl, [
+            item("m1", {"phase": "Running"}),
+            item("m2", {"phase": "Failed"}),
+        ]).get("status")
+        assert st["phase"] == "Failed"
+        tmpl2 = obj("v1", "Pod", status={})
+        st2 = ri.aggregate_status(tmpl2, [
+            item("m1", {"phase": "Running"}),
+            AggregatedStatusItem(cluster_name="m2", status=None),  # pending
+        ]).get("status")
+        assert st2["phase"] == "Pending"
+
+    def test_health(self):
+        ri = interp()
+        ok = obj("v1", "Pod", status={"phase": "Running", "conditions": [
+            {"type": "Ready", "status": "True"}]})
+        assert ri.interpret_health(ok) == HEALTHY
+        assert ri.interpret_health(
+            obj("v1", "Pod", status={"phase": "Succeeded"})) == HEALTHY
+        assert ri.interpret_health(
+            obj("v1", "Pod", status={"phase": "Running"})) == UNHEALTHY
+
+    def test_retain_member_fields(self):
+        desired = obj("v1", "Pod", spec={"containers": [{"name": "c"}]})
+        observed = obj("v1", "Pod", spec={
+            "nodeName": "node-7", "serviceAccountName": "sa",
+            "volumes": [{"name": "tok"}],
+            "containers": [{"name": "c", "volumeMounts": [{"name": "tok"}]}],
+        })
+        out = interp().retain(desired, observed)
+        assert out.get("spec", "nodeName") == "node-7"
+        assert out.get("spec", "containers")[0]["volumeMounts"] == [
+            {"name": "tok"}]
+
+
+class TestServiceAndIngress:
+    def test_service_lb_aggregate_dedupes_and_sorts(self):
+        tmpl = obj("v1", "Service", spec={"type": "LoadBalancer"}, status={})
+        items = [
+            item("m1", {"loadBalancer": {"ingress": [{"ip": "10.0.0.2"}]}}),
+            item("m2", {"loadBalancer": {"ingress": [{"ip": "10.0.0.1"},
+                                                     {"ip": "10.0.0.2"}]}}),
+        ]
+        st = interp().aggregate_status(tmpl, items).get("status")
+        assert st["loadBalancer"]["ingress"] == [
+            {"ip": "10.0.0.1"}, {"ip": "10.0.0.2"}]
+
+    def test_clusterip_service_aggregate_noop(self):
+        tmpl = obj("v1", "Service", spec={"type": "ClusterIP"},
+                   status={"x": 1})
+        st = interp().aggregate_status(tmpl, [item("m1", {})]).get("status")
+        assert st == {"x": 1}
+
+    def test_service_retain(self):
+        desired = obj("v1", "Service", spec={"type": "LoadBalancer"})
+        observed = obj("v1", "Service", spec={
+            "clusterIP": "10.96.0.5", "healthCheckNodePort": 30101})
+        out = interp().retain(desired, observed)
+        assert out.get("spec", "clusterIP") == "10.96.0.5"
+        assert out.get("spec", "healthCheckNodePort") == 30101
+
+    def test_ingress_health_and_deps(self):
+        ri = interp()
+        ok = obj("networking.k8s.io/v1", "Ingress",
+                 status={"loadBalancer": {"ingress": [{"ip": "1.2.3.4"}]}})
+        assert ri.interpret_health(ok) == HEALTHY
+        o = obj("networking.k8s.io/v1", "Ingress",
+                spec={"tls": [{"secretName": "tls-cert"}]})
+        assert [d["name"] for d in ri.get_dependencies(o)] == ["tls-cert"]
+
+
+class TestVolumesAndPolicy:
+    def test_pv_phase_precedence(self):
+        tmpl = obj("v1", "PersistentVolume", status={})
+        st = interp().aggregate_status(tmpl, [
+            item("m1", {"phase": "Bound"}),
+            item("m2", {"phase": "Available"}),
+        ]).get("status")
+        assert st["phase"] == "Available"
+
+    def test_pvc_lost_short_circuits(self):
+        tmpl = obj("v1", "PersistentVolumeClaim", status={})
+        st = interp().aggregate_status(tmpl, [
+            item("m1", {"phase": "Lost"}),
+            item("m2", {"phase": "Bound"}),
+        ]).get("status")
+        assert st["phase"] == "Lost"
+
+    def test_pvc_retain_volume_name(self):
+        desired = obj("v1", "PersistentVolumeClaim", spec={})
+        observed = obj("v1", "PersistentVolumeClaim",
+                       spec={"volumeName": "pv-123"})
+        assert interp().retain(desired, observed).get(
+            "spec", "volumeName") == "pv-123"
+
+    def test_pv_retain_claim_ref(self):
+        desired = obj("v1", "PersistentVolume", spec={})
+        observed = obj("v1", "PersistentVolume",
+                       spec={"claimRef": {"name": "pvc-a"}})
+        assert interp().retain(desired, observed).get(
+            "spec", "claimRef") == {"name": "pvc-a"}
+
+    def test_pdb_aggregate_prefixes_disrupted_pods(self):
+        tmpl = obj("policy/v1", "PodDisruptionBudget", status={})
+        st = interp().aggregate_status(tmpl, [
+            item("m1", {"currentHealthy": 2, "desiredHealthy": 2,
+                        "disruptedPods": {"p1": "t1"}}),
+            item("m2", {"currentHealthy": 1, "desiredHealthy": 1}),
+        ]).get("status")
+        assert st["currentHealthy"] == 3
+        assert st["disruptedPods"] == {"m1/p1": "t1"}
+
+    def test_hpa_aggregate(self):
+        tmpl = obj("autoscaling/v2", "HorizontalPodAutoscaler", status={})
+        st = interp().aggregate_status(tmpl, [
+            item("m1", {"currentReplicas": 2, "desiredReplicas": 3}),
+            item("m2", {"currentReplicas": 1, "desiredReplicas": 1}),
+        ]).get("status")
+        assert st["currentReplicas"] == 3 and st["desiredReplicas"] == 4
+
+
+class TestSecretsAndServiceAccounts:
+    def test_sa_token_secret_retained(self):
+        ri = interp()
+        desired = obj("v1", "Secret", typ="kubernetes.io/service-account-token",
+                      data={})
+        observed = obj("v1", "Secret",
+                       typ="kubernetes.io/service-account-token",
+                       data={"token": "abc"})
+        assert ri.retain(desired, observed).get("data") == {"token": "abc"}
+        plain_desired = obj("v1", "Secret", typ="Opaque", data={"k": "v"})
+        plain_observed = obj("v1", "Secret", typ="Opaque", data={"k": "w"})
+        assert ri.retain(plain_desired, plain_observed).get("data") == {"k": "v"}
+
+    def test_service_account_secret_merge(self):
+        desired = obj("v1", "ServiceAccount", secrets=[{"name": "a"}])
+        observed = obj("v1", "ServiceAccount",
+                       secrets=[{"name": "a"}, {"name": "token-xyz"}])
+        out = interp().retain(desired, observed)
+        assert out.get("secrets") == [{"name": "a"}, {"name": "token-xyz"}]
+
+
+class TestStatefulSetDeps:
+    def test_volume_claim_template_pvcs_excluded(self):
+        o = obj("apps/v1", "StatefulSet", spec={
+            "volumeClaimTemplates": [{"metadata": {"name": "data"}}],
+            "template": {"spec": {"volumes": [
+                {"name": "d", "persistentVolumeClaim": {"claimName": "data"}},
+                {"name": "x", "persistentVolumeClaim": {"claimName": "extern"}},
+            ]}},
+        })
+        deps = interp().get_dependencies(o)
+        names = {d["name"] for d in deps if d["kind"] == "PersistentVolumeClaim"}
+        assert names == {"extern"}
+
+
+class TestServiceImport:
+    def test_derived_service_and_endpointslice(self):
+        o = obj("multicluster.x-k8s.io/v1alpha1", "ServiceImport", name="web")
+        deps = interp().get_dependencies(o)
+        assert deps[0] == {"apiVersion": "v1", "kind": "Service",
+                           "namespace": "default", "name": "derived-web"}
+        assert deps[1]["kind"] == "EndpointSlice"
+        assert deps[1]["labelSelector"]["matchLabels"][
+            "kubernetes.io/service-name"] == "derived-web"
+
+
+class TestGenerationProtocolEndToEnd:
+    def test_binding_stamps_annotation_and_aggregate_converges(self):
+        """The federated-generation protocol through the REAL pipeline:
+        ensureWork stamps resourcetemplate.karmada.io/generation on member
+        manifests; status reflection lifts it; the aggregation's caught-up
+        count advances the template's observedGeneration."""
+        from karmada_tpu.controlplane import ControlPlane
+        from karmada_tpu.members.member import MemberConfig
+        from karmada_tpu.testing.fixtures import (
+            duplicated_placement,
+            new_deployment,
+            new_policy,
+            selector_for,
+        )
+
+        cp = ControlPlane()
+        cp.join_member(MemberConfig(name="m1", allocatable={"cpu": 100.0}))
+        cp.join_member(MemberConfig(name="m2", allocatable={"cpu": 100.0}))
+        dep = new_deployment("default", "web", replicas=2)
+        cp.store.create(dep)
+        cp.store.create(new_policy("default", "pp", [selector_for(dep)],
+                                   duplicated_placement(["m1", "m2"])))
+        cp.settle()
+
+        for m in ("m1", "m2"):
+            got = cp.members[m].get("apps/v1", "Deployment", "web", "default")
+            assert got.metadata.annotations[
+                "resourcetemplate.karmada.io/generation"
+            ] == str(cp.store.get("apps/v1/Deployment", "web",
+                                  "default").metadata.generation)
+
+        tmpl = cp.store.get("apps/v1/Deployment", "web", "default")
+        st = tmpl.get("status") or {}
+        assert st.get("replicas") == 4  # 2 members x 2 duplicated replicas
+        # every member runs the latest template revision + its own status
+        # is current → aggregated observedGeneration == template generation
+        assert st.get("observedGeneration") == tmpl.metadata.generation
