@@ -452,8 +452,9 @@ def add_args(ap: argparse.ArgumentParser) -> None:
                     help="comma-separated subset of " + ",".join(DEFAULT_ORDER))
     ap.add_argument("--verbose", action="store_true")
     ap.add_argument("--probe-timeout", type=float, default=90.0)
-    ap.add_argument("--run-timeout", type=float, default=1500.0,
-                    help="total seconds for all measured child runs combined")
+    ap.add_argument("--run-timeout", type=float, default=2200.0,
+                    help="total seconds for all measured child runs combined"
+                         " (8 configs now: compiles dominate the budget)")
     ap.add_argument("--require-tpu", action="store_true")
     ap.add_argument("--inner", action="store_true", help=argparse.SUPPRESS)
     # platform must be pinned via jax.config inside the child, not the

@@ -91,15 +91,51 @@ class DependenciesDistributor:
             dep_kind = dep.get("kind", "")
             dep_ns = dep.get("namespace", rb.namespace)
             dep_name = dep.get("name", "")
-            if not dep_kind or not dep_name:
+            if not dep_kind:
                 continue
-            if self.store.try_get(f"{dep_api}/{dep_kind}", dep_name, dep_ns) is None:
-                continue  # dependency template not present in the control plane
-            attached_name = binding_name(dep_kind, dep_name)
-            wanted.add(f"{dep_ns}/{attached_name}")
-            self._ensure_attached(
-                rb, label_key, permanent_id, dep_api, dep_kind, dep_ns, dep_name
-            )
+            if dep_name:
+                names = (
+                    [dep_name]
+                    if self.store.try_get(f"{dep_api}/{dep_kind}", dep_name, dep_ns)
+                    is not None
+                    else []  # dependency template not in the control plane
+                )
+            else:
+                # labelSelector-shaped dependent references (config
+                # DependentObjectReference.LabelSelector — e.g. a
+                # ServiceImport's EndpointSlices): every matching object in
+                # the namespace attaches. Full metav1.LabelSelector
+                # semantics via api/meta.LabelSelector; a selector-less,
+                # nameless dep stays skipped, and so does an empty
+                # namespace (the list would span every namespace).
+                from ..api.meta import LabelSelector, LabelSelectorRequirement
+
+                sel_dict = dep.get("labelSelector") or {}
+                selector = LabelSelector(
+                    match_labels=dict(sel_dict.get("matchLabels") or {}),
+                    match_expressions=[
+                        LabelSelectorRequirement(
+                            key=e.get("key", ""),
+                            operator=e.get("operator", "In"),
+                            values=list(e.get("values") or []),
+                        )
+                        for e in sel_dict.get("matchExpressions") or []
+                    ],
+                )
+                if selector.is_empty() or not dep_ns:
+                    continue
+                names = [
+                    o.metadata.name
+                    for o in self.store.list(f"{dep_api}/{dep_kind}", dep_ns)
+                    if selector.matches(o.metadata.labels)
+                ]
+            for name_i in names:
+                attached_name = binding_name(dep_kind, name_i)
+                wanted.add(f"{dep_ns}/{attached_name}")
+                self._ensure_attached(
+                    rb, label_key, permanent_id, dep_api, dep_kind, dep_ns,
+                    name_i,
+                )
         # drop our snapshot from attached bindings we no longer depend on
         for attached in self.store.list("ResourceBinding"):
             if label_key not in attached.metadata.labels:

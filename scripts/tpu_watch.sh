@@ -1,0 +1,22 @@
+#!/bin/bash
+# Wait for the TPU tunnel to come back, then run the round's TPU
+# measurements: the skewed-spread profile and the full bench.
+cd /root/repo
+LOG=/tmp/tpu_watch.log
+echo "[watch] started $(date)" >> "$LOG"
+for i in $(seq 1 200); do
+  if timeout 90 python -c "import jax; jax.devices()" >/dev/null 2>&1; then
+    echo "[watch] tunnel UP at $(date) (attempt $i)" >> "$LOG"
+    echo "[watch] running skewed profile..." >> "$LOG"
+    timeout 1500 python scripts/profile_spread_skewed.py --iters 6 \
+      >> "$LOG" 2>&1
+    echo "[watch] running full bench..." >> "$LOG"
+    timeout 2400 python bench.py --verbose --run-timeout 2300 \
+      > /tmp/bench_tpu.out 2> /tmp/bench_tpu.err
+    echo "[watch] bench rc=$? done $(date)" >> "$LOG"
+    exit 0
+  fi
+  echo "[watch] attempt $i: tunnel down $(date)" >> "$LOG"
+  sleep 120
+done
+echo "[watch] gave up $(date)" >> "$LOG"

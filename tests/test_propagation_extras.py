@@ -324,3 +324,49 @@ def test_namespace_auto_propagation():
     )
     cp.settle()
     assert cp.members["member4"].get("v1", "Namespace", "team-a") is not None
+
+
+def test_label_selector_dependencies_attach():
+    """labelSelector-shaped dependent references (DependentObjectReference.
+    LabelSelector, e.g. a ServiceImport's EndpointSlices) attach every
+    matching object in the namespace."""
+    from karmada_tpu.api.unstructured import Unstructured
+    from karmada_tpu.controlplane import ControlPlane
+    from karmada_tpu.members.member import MemberConfig
+
+    cp = ControlPlane()
+    cp.join_member(MemberConfig(name="m1", allocatable={"cpu": 10.0}))
+
+    # two EndpointSlices for the derived service, one unrelated
+    for name, svc in (("eps-1", "derived-web"), ("eps-2", "derived-web"),
+                      ("eps-other", "derived-api")):
+        cp.store.create(Unstructured({
+            "apiVersion": "discovery.k8s.io/v1", "kind": "EndpointSlice",
+            "metadata": {"name": name, "namespace": "default",
+                         "labels": {"kubernetes.io/service-name": svc}},
+        }))
+    cp.store.create(Unstructured({
+        "apiVersion": "v1", "kind": "Service",
+        "metadata": {"name": "derived-web", "namespace": "default"},
+        "spec": {"ports": [{"port": 80}]},
+    }))
+    si = Unstructured({
+        "apiVersion": "multicluster.x-k8s.io/v1alpha1", "kind": "ServiceImport",
+        "metadata": {"name": "web", "namespace": "default"},
+        "spec": {"type": "ClusterSetIP"},
+    })
+    cp.store.create(si)
+    policy = new_policy("default", "pp-si", [selector_for(si)],
+                        duplicated_placement(["m1"]))
+    policy.spec.propagate_deps = True
+    cp.store.create(policy)
+    cp.settle()
+
+    attached = {
+        b.spec.resource.name
+        for b in cp.store.list("ResourceBinding")
+        if b.spec.required_by
+    }
+    assert "derived-web" in attached  # named dep
+    assert {"eps-1", "eps-2"} <= attached  # selector-matched deps
+    assert "eps-other" not in attached
