@@ -224,6 +224,10 @@ class BatchEncoder:
         # invalidates naturally. prev/eviction entries and `fresh` are
         # re-read every round (status-driven, cheap).
         self._row_cache: dict[str, tuple] = {}
+        # per-call identity memos over policy objects (reassigned fresh at
+        # every encode() and cleared at its end — stale ids are never read)
+        self._call_aff_memo: dict[int, np.ndarray] = {}
+        self._call_weight_memo: dict[int, tuple] = {}
         self._tol_width = max_tolerations
         self._tol_rows: list[np.ndarray] = [
             np.zeros((4, self._tol_width), np.int32)
@@ -371,10 +375,25 @@ class BatchEncoder:
                 else:
                     req[r] = to_int_units(rname, val)
         placement = spec.placement or self._DEFAULT_PLACEMENT
-        mask = self.affinity_cache.mask(self.active_affinity(rb, term))
-        w = self._static_weights(placement)
-        if not w.any():
-            w = None  # row 0 of the weight table
+        # per-CALL identity memos (reset at every encode()): thousands of
+        # rows share a handful of policy objects, and within one call the
+        # objects cannot change — so the canonical-key string builds run
+        # once per distinct object, not once per row. Safe against in-place
+        # mutation between rounds (the generation-bump contract): the memo
+        # never outlives the call.
+        aff = self.active_affinity(rb, term)
+        mask = self._call_aff_memo.get(id(aff))
+        if mask is None:
+            mask = self.affinity_cache.mask(aff)
+            self._call_aff_memo[id(aff)] = mask
+        went = self._call_weight_memo.get(id(placement))
+        if went is None:
+            w = self._static_weights(placement)
+            if not w.any():
+                w = None  # row 0 of the weight table
+            self._call_weight_memo[id(placement)] = (w,)
+        else:
+            (w,) = went
         return (
             meta.key(),
             uid,
@@ -430,6 +449,11 @@ class BatchEncoder:
             self._row_cache.clear()
 
         row_cache = self._row_cache
+        # fresh per-call memos; id(None) maps the no-affinity case safely
+        # (None is immortal and its mask is constant). Cleared again at the
+        # end of the call so entries never outlive it.
+        self._call_aff_memo = {}
+        self._call_weight_memo = {}
         for b, rb in enumerate(bindings):
             meta = rb.metadata
             spec = rb.spec
@@ -517,6 +541,8 @@ class BatchEncoder:
             for k, i in enumerate(evict_lists[b]):
                 evict_idx[b, k] = i
 
+        self._call_aff_memo = {}
+        self._call_weight_memo = {}
         return BindingBatch(
             keys=keys,
             uids=uids,
