@@ -267,6 +267,28 @@ class PlaintextOverrider:
 
 
 @dataclass
+class FieldPatchOperation:
+    """JSONPatchOperation / YAMLPatchOperation (override_types.go:288-325):
+    one add/remove/replace at an RFC 6901 subPath inside the embedded
+    document."""
+
+    sub_path: str = ""
+    operator: str = "add"  # add | remove | replace
+    value: Any = None
+
+
+@dataclass
+class FieldOverrider:
+    """Modify a STRING field holding an embedded JSON or YAML document
+    (e.g. a ConfigMap data value) with patch operations
+    (override_types.go:266-286). Either `json` or `yaml` per instance."""
+
+    field_path: str = ""  # RFC 6901 pointer to the string field
+    json: list[FieldPatchOperation] = field(default_factory=list)
+    yaml: list[FieldPatchOperation] = field(default_factory=list)
+
+
+@dataclass
 class Overriders:
     plaintext: list[PlaintextOverrider] = field(default_factory=list)
     image_overrider: list[ImageOverrider] = field(default_factory=list)
@@ -274,6 +296,7 @@ class Overriders:
     args_overrider: list[CommandArgsOverrider] = field(default_factory=list)
     labels_overrider: list[LabelAnnotationOverrider] = field(default_factory=list)
     annotations_overrider: list[LabelAnnotationOverrider] = field(default_factory=list)
+    field_overrider: list[FieldOverrider] = field(default_factory=list)
 
 
 @dataclass

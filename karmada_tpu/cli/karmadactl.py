@@ -403,6 +403,34 @@ def cmd_describe(cp: ControlPlane, kind: str, name: str, namespace: str = "") ->
     return json.dumps(dataclasses.asdict(obj), indent=2, sort_keys=True, default=str)
 
 
+def cmd_top_pods(cp: ControlPlane, namespace: str = "") -> str:
+    """`karmadactl top pods`: per-workload pod counts and usage across the
+    member fleet (the multi-cluster pod metrics view of karmadactl top,
+    pkg/karmadactl/top — one row per (cluster, workload))."""
+    rows = []
+    for cname in sorted(cp.members):
+        member = cp.members[cname]
+        for obj in member.objects():
+            if obj.kind not in ("Deployment", "StatefulSet", "Job", "Pod",
+                                "DaemonSet"):
+                continue
+            if namespace and obj.namespace != namespace:
+                continue
+            pods, usage = member.pod_metrics(obj.kind, obj.namespace, obj.name)
+            cpu = (usage or {}).get("cpu", 0.0)
+            mem = (usage or {}).get("memory", 0.0)
+            rows.append([
+                cname, obj.namespace or "-", f"{obj.kind}/{obj.name}",
+                str(pods),
+                f"{cpu * pods:g}" if usage else "-",
+                f"{mem * pods / (1024.0 ** 2):.0f}Mi" if usage else "-",
+            ])
+    return _fmt_table(
+        rows, ["CLUSTER", "NAMESPACE", "WORKLOAD", "PODS", "CPU(cores)",
+               "MEMORY"],
+    )
+
+
 def cmd_top(cp: ControlPlane) -> str:
     """`karmadactl top clusters`: per-cluster allocatable vs allocated."""
     rows = []
@@ -752,8 +780,8 @@ _EXPLAIN = {
     ),
     "overridepolicy": (
         "OverridePolicy: resourceSelectors, overrideRules (targetCluster +"
-        " imageOverrider/argsOverrider/commandOverrider/plaintext/"
-        "labelsAnnotations)"
+        " imageOverrider/argsOverrider/commandOverrider/labelsOverrider/"
+        "annotationsOverrider/fieldOverrider/plaintext)"
     ),
     "work": (
         "Work: workload manifests destined for one member cluster;"
@@ -924,6 +952,7 @@ def run(cp: ControlPlane, argv: list[str]) -> str:
     p.add_argument("-n", "--namespace", default="")
     p = sub.add_parser("top")
     p.add_argument("resource", nargs="?", default="clusters")
+    p.add_argument("-n", "--namespace", default="")
     p = sub.add_parser("interpret")
     p.add_argument("--operation", default="")
     p.add_argument("-f", "--filename", required=True)
@@ -1018,6 +1047,8 @@ def run(cp: ControlPlane, argv: list[str]) -> str:
     if args.command == "describe":
         return cmd_describe(cp, args.kind, args.name, args.namespace)
     if args.command == "top":
+        if args.resource in ("pods", "pod", "po"):
+            return cmd_top_pods(cp, getattr(args, "namespace", ""))
         return cmd_top(cp)
     if args.command == "interpret":
         def load(path):

@@ -122,6 +122,18 @@ def _jp_tokens(path: str) -> list[str]:
     return [t.replace("~1", "/").replace("~0", "~") for t in path[1:].split("/")]
 
 
+def _jp_get(doc: Any, path: str) -> Any:
+    cur = doc
+    for tok in _jp_tokens(path):
+        if isinstance(cur, list):
+            cur = cur[int(tok)]
+        elif isinstance(cur, dict):
+            cur = cur[tok]
+        else:
+            raise KeyError(path)
+    return cur
+
+
 def apply_json_patch(doc: dict, op: str, path: str, value: Any = None) -> None:
     """add/remove/replace on a nested dict/list document (RFC 6902 subset, as
     the plaintext overrider consumes it). add on a map creates intermediate
@@ -180,22 +192,11 @@ def _pod_spec(manifest: dict, kind: str) -> Optional[dict]:
 def _apply_image_overriders(manifest: dict, kind: str, overriders: list[ImageOverrider]) -> None:
     for o in overriders:
         if o.predicate_path:
-            tokens = _jp_tokens(o.predicate_path)
-            cur: Any = manifest
-            ok = True
-            for tok in tokens:
-                if isinstance(cur, list):
-                    idx = int(tok)
-                    if idx >= len(cur):
-                        ok = False
-                        break
-                    cur = cur[idx]
-                elif isinstance(cur, dict) and tok in cur:
-                    cur = cur[tok]
-                else:
-                    ok = False
-                    break
-            if not ok or not isinstance(cur, str):
+            try:
+                cur = _jp_get(manifest, o.predicate_path)
+            except (KeyError, IndexError, ValueError):
+                continue  # unresolvable predicate path: soft-skip
+            if not isinstance(cur, str):
                 continue
             apply_json_patch(manifest, "replace", o.predicate_path, override_image(cur, o))
             continue
@@ -239,6 +240,36 @@ def _apply_label_annotation(manifest: dict, field: str, overriders: list[LabelAn
         md[field] = current
 
 
+def _apply_field_overriders(manifest: dict, overriders) -> None:
+    """FieldOverrider (overridemanager.go:410-452): the fieldPath must
+    resolve to a STRING holding an embedded JSON or YAML document; the
+    add/remove/replace operations apply at each subPath inside it, and the
+    document re-serializes in its original format."""
+    import json as _json
+
+    for o in overriders:
+        raw = _jp_get(manifest, o.field_path)
+        if not isinstance(raw, str):
+            raise ValueError(
+                f"value at fieldPath {o.field_path!r} is not a string"
+            )
+        if o.yaml:
+            import yaml as _yaml
+
+            doc = _yaml.safe_load(raw)
+            for op in o.yaml:
+                apply_json_patch(doc, op.operator, op.sub_path, op.value)
+            out = _yaml.safe_dump(doc, default_flow_style=False)
+        elif o.json:
+            doc = _json.loads(raw)
+            for op in o.json:
+                apply_json_patch(doc, op.operator, op.sub_path, op.value)
+            out = _json.dumps(doc)
+        else:
+            continue
+        apply_json_patch(manifest, "replace", o.field_path, out)
+
+
 def _apply_plaintext(manifest: dict, overriders: list[PlaintextOverrider]) -> None:
     for o in overriders:
         apply_json_patch(manifest, o.operator, o.path, o.value)
@@ -247,12 +278,13 @@ def _apply_plaintext(manifest: dict, overriders: list[PlaintextOverrider]) -> No
 def apply_overriders(manifest: dict, kind: str, overriders: Overriders) -> None:
     """In-place, in the reference's fixed order (overridemanager.go
     applyPolicyOverriders): image, command, args, labels, annotations,
-    plaintext last."""
+    field, plaintext last."""
     _apply_image_overriders(manifest, kind, overriders.image_overrider)
     _apply_command_args(manifest, kind, "command", overriders.command_overrider)
     _apply_command_args(manifest, kind, "args", overriders.args_overrider)
     _apply_label_annotation(manifest, "labels", overriders.labels_overrider)
     _apply_label_annotation(manifest, "annotations", overriders.annotations_overrider)
+    _apply_field_overriders(manifest, overriders.field_overrider)
     _apply_plaintext(manifest, overriders.plaintext)
 
 

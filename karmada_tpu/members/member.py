@@ -87,7 +87,20 @@ class InMemoryMember:
                     break
         if obj is None:
             return 0, None
-        ready = int(obj.get("status", "readyReplicas", default=0) or 0)
+        st = obj.get("status") or {}
+        # per-kind pod count: workloads report readyReplicas; Jobs report
+        # active/succeeded; DaemonSets numberReady; a bare Pod is one pod
+        # while running
+        if "readyReplicas" in st:
+            ready = int(st.get("readyReplicas") or 0)
+        elif kind == "Job":
+            ready = int(st.get("active") or 0) + int(st.get("succeeded") or 0)
+        elif kind == "DaemonSet":
+            ready = int(st.get("numberReady") or 0)
+        elif kind == "Pod":
+            ready = 1 if st.get("phase") in ("Running", "Succeeded") else 0
+        else:
+            ready = 0
         return ready, self.workload_usage.get(f"{kind}/{namespace}/{name}")
 
     def objects(self) -> list[Unstructured]:

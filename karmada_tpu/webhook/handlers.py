@@ -126,6 +126,24 @@ def _validate_override_policy(req: AdmissionRequest) -> None:
         for lao in list(ov.labels_overrider) + list(ov.annotations_overrider):
             if lao.operator not in ("add", "remove", "replace"):
                 raise AdmissionDenied(req.kind, f"{name}: invalid label/annotation operator {lao.operator!r}")
+        for fo in ov.field_overrider:
+            if not fo.field_path.startswith("/"):
+                raise AdmissionDenied(
+                    req.kind, f"{name}: fieldPath {fo.field_path!r} must be a JSON pointer"
+                )
+            if fo.json and fo.yaml:
+                # "processes either JSON or YAML fields, but not both
+                # simultaneously" (override_types.go:270)
+                raise AdmissionDenied(
+                    req.kind, f"{name}: fieldOverrider must not carry both json and yaml operations"
+                )
+            for opn in list(fo.json) + list(fo.yaml):
+                if opn.operator not in ("add", "remove", "replace"):
+                    raise AdmissionDenied(req.kind, f"{name}: invalid field operator {opn.operator!r}")
+                if not opn.sub_path.startswith("/"):
+                    raise AdmissionDenied(
+                        req.kind, f"{name}: subPath {opn.sub_path!r} must be a JSON pointer"
+                    )
 
 
 def _validate_work(req: AdmissionRequest) -> None:

@@ -510,3 +510,28 @@ class TestInterpretCustomizations:
         cp = ControlPlane()
         out = run(cp, ["interpret", "-f", path, "--check"])
         assert out.count("ok (lua)") >= 5
+
+
+def test_top_pods_lists_member_workloads():
+    from karmada_tpu.testing.fixtures import (
+        duplicated_placement,
+        new_deployment,
+        new_policy,
+        selector_for,
+    )
+
+    cp = ControlPlane()
+    cp.join_member(MemberConfig(name="m1", allocatable={"cpu": 50.0}))
+    cp.join_member(MemberConfig(name="m2", allocatable={"cpu": 50.0}))
+    dep = new_deployment("default", "web", replicas=2)
+    cp.store.create(dep)
+    cp.store.create(new_policy("default", "pp", [selector_for(dep)],
+                               duplicated_placement(["m1", "m2"])))
+    cp.settle()
+    out = run(cp, ["top", "pods"])
+    lines = out.splitlines()
+    assert lines[0].split()[:3] == ["CLUSTER", "NAMESPACE", "WORKLOAD"]
+    body = "\n".join(lines[1:])
+    assert "m1" in body and "m2" in body and "Deployment/web" in body
+    # namespace filter
+    assert "web" not in run(cp, ["top", "pods", "-n", "other"])

@@ -370,3 +370,56 @@ def test_label_selector_dependencies_attach():
     assert "derived-web" in attached  # named dep
     assert {"eps-1", "eps-2"} <= attached  # selector-matched deps
     assert "eps-other" not in attached
+
+
+def test_field_overrider_patches_embedded_documents():
+    """FieldOverrider (override_types.go:266-325): patch an embedded JSON or
+    YAML document inside a string field (the ConfigMap data case)."""
+    import json as _json
+
+    import yaml as _yaml
+
+    from karmada_tpu.api.policy import FieldOverrider, FieldPatchOperation, Overriders
+    from karmada_tpu.controllers.overrides import apply_overriders
+
+    manifest = {
+        "apiVersion": "v1", "kind": "ConfigMap",
+        "metadata": {"name": "cfg", "namespace": "default"},
+        "data": {
+            "db-config.yaml": "db:\n  host: old-host\n  port: 5432\n",
+            "app.json": _json.dumps({"log": {"level": "info"}, "replicas": 1}),
+        },
+    }
+    overriders = Overriders(field_overrider=[
+        FieldOverrider(
+            field_path="/data/db-config.yaml",
+            yaml=[FieldPatchOperation(sub_path="/db/host", operator="replace",
+                                      value="member-db"),
+                  FieldPatchOperation(sub_path="/db/ssl", operator="add",
+                                      value=True)],
+        ),
+        FieldOverrider(
+            field_path="/data/app.json",
+            json=[FieldPatchOperation(sub_path="/log/level",
+                                      operator="replace", value="debug"),
+                  FieldPatchOperation(sub_path="/replicas",
+                                      operator="remove")],
+        ),
+    ])
+    apply_overriders(manifest, "ConfigMap", overriders)
+
+    y = _yaml.safe_load(manifest["data"]["db-config.yaml"])
+    assert y == {"db": {"host": "member-db", "port": 5432, "ssl": True}}
+    j = _json.loads(manifest["data"]["app.json"])
+    assert j == {"log": {"level": "debug"}}
+
+    # non-string target fails loudly, like the reference
+    import pytest as _pytest
+
+    bad = Overriders(field_overrider=[
+        FieldOverrider(field_path="/metadata",
+                       json=[FieldPatchOperation(sub_path="/x", operator="add",
+                                                 value=1)]),
+    ])
+    with _pytest.raises(ValueError, match="not a string"):
+        apply_overriders(dict(manifest), "ConfigMap", bad)

@@ -183,3 +183,36 @@ class TestEndToEndWithAdmission:
         assert [t.name for t in rb.spec.clusters] == ["m1"]
         works = cp.store.list("Work")
         assert works
+
+
+def test_field_overrider_validation():
+    from karmada_tpu.api.policy import FieldOverrider, FieldPatchOperation
+    from karmada_tpu.controlplane import ControlPlane
+
+    cp = ControlPlane()
+
+    def policy_with(name, fo):
+        return OverridePolicy(
+            metadata=ObjectMeta(name=name, namespace="default"),
+            spec=OverrideSpec(override_rules=[
+                RuleWithCluster(overriders=Overriders(field_overrider=[fo]))
+            ]),
+        )
+
+    ok = policy_with("op-ok", FieldOverrider(
+        field_path="/data/cfg.json",
+        json=[FieldPatchOperation(sub_path="/a", operator="add", value=1)]))
+    assert cp.store.create(ok) is not None
+
+    both = policy_with("op-both", FieldOverrider(
+        field_path="/data/cfg.json",
+        json=[FieldPatchOperation(sub_path="/a", operator="add", value=1)],
+        yaml=[FieldPatchOperation(sub_path="/a", operator="add", value=1)]))
+    with pytest.raises(AdmissionDenied, match="both json and yaml"):
+        cp.store.create(both)
+
+    bad_path = policy_with("op-bad", FieldOverrider(
+        field_path="data/cfg.json",
+        json=[FieldPatchOperation(sub_path="/a", operator="add", value=1)]))
+    with pytest.raises(AdmissionDenied, match="fieldPath"):
+        cp.store.create(bad_path)
