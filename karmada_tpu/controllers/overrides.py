@@ -248,7 +248,13 @@ def _apply_field_overriders(manifest: dict, overriders) -> None:
     import json as _json
 
     for o in overriders:
-        raw = _jp_get(manifest, o.field_path)
+        try:
+            raw = _jp_get(manifest, o.field_path)
+        except (KeyError, IndexError) as e:
+            raise ValueError(
+                f"fieldOverrider path {o.field_path!r} does not resolve in "
+                f"the manifest"
+            ) from e
         if not isinstance(raw, str):
             raise ValueError(
                 f"value at fieldPath {o.field_path!r} is not a string"

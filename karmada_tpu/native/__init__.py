@@ -50,6 +50,9 @@ def _build() -> Optional[ctypes.CDLL]:
     lib.first_fit_place.argtypes = [LLP, LLP, LLP, LLP, U8P, LLP, LLP, LL, LL, LL]
     lib.max_available_replicas.restype = None
     lib.max_available_replicas.argtypes = [LLP, LLP, LLP, LLP, U8P, LLP, LLP, LL, LL, LL]
+    lib.class_dfs_batch.restype = LL
+    lib.class_dfs_batch.argtypes = [LLP, LLP, LLP, LLP, LLP, LL, LL, LL, LL,
+                                    LLP, LLP]
     return lib
 
 
@@ -144,3 +147,36 @@ def max_available_replicas_native(
         _u8(ok), _ll(req), _ll(answers), N, R, B,
     )
     return answers
+
+
+def class_dfs_batch(
+    cls_v: np.ndarray,      # i64[total] class values, rows concatenated
+    cls_w: np.ndarray,      # i64[total] class weights
+    cls_m: np.ndarray,      # i64[total] class multiplicities
+    row_off: np.ndarray,    # i64[n_rows+1] row offsets into cls_*
+    kmax_row: np.ndarray,   # i64[n_rows]
+    kmin: int,
+    cmin: int,
+    budget: int,
+) -> "Optional[tuple[np.ndarray, np.ndarray]]":
+    """Batched class-collapsed spread-selection DFS
+    (sched/spread_batch._select_row_class_dfs semantics). Returns
+    (counts i64[total], status i64[n_rows]: 1 winner / 0 none-feasible /
+    -1 budget) or None when the native library is unavailable (callers run
+    the Python per-row path instead)."""
+    lib = get_lib()
+    if lib is None:
+        return None
+    n_rows = len(row_off) - 1
+    cls_v = np.ascontiguousarray(cls_v, dtype=np.int64)
+    cls_w = np.ascontiguousarray(cls_w, dtype=np.int64)
+    cls_m = np.ascontiguousarray(cls_m, dtype=np.int64)
+    row_off = np.ascontiguousarray(row_off, dtype=np.int64)
+    kmax_row = np.ascontiguousarray(kmax_row, dtype=np.int64)
+    counts = np.zeros(len(cls_v), np.int64)
+    status = np.zeros(n_rows, np.int64)
+    lib.class_dfs_batch(
+        _ll(cls_v), _ll(cls_w), _ll(cls_m), _ll(row_off), _ll(kmax_row),
+        n_rows, int(kmin), int(cmin), int(budget), _ll(counts), _ll(status),
+    )
+    return counts, status

@@ -83,3 +83,139 @@ extern "C" void max_available_replicas(
     answers[b] = total;
   }
 }
+
+// Class-collapsed spread-selection DFS (sched/spread_batch.py
+// _select_row_class_dfs), batched: one call processes every row of a
+// constraint config. Semantics mirror the Python implementation exactly —
+// the reference DFS's record-and-return enumeration over class count
+// vectors, the (sum_w, sum_v) maximum, and the discovery-order tie-break
+// (lexicographically smallest canonical position sequence; since class
+// start positions ascend, comparing sequences degenerates to a walk over
+// per-class counts).
+//
+// Contract (row r owns classes row_off[r] .. row_off[r+1]):
+//   cls_v, cls_w [total]   class value / weight
+//   cls_m        [total]   class multiplicity
+//   row_off      [n_rows+1]
+//   kmax_row     [n_rows]  per-row path-length cap (>= kmin)
+//   out_counts   [total]   OUT winner counts (zeroed by caller)
+//   out_status   [n_rows]  OUT 1 = winner, 0 = none feasible, -1 = budget
+// returns 0
+namespace {
+
+struct DfsCtx {
+  const long long* v;
+  const long long* w;
+  const long long* m;
+  long long K;
+  long long kmin, kmax, cmin;
+  long long budget;
+  long long* counts;      // scratch, length K
+  long long* best_counts; // OUT winner, length K
+  long long best_w, best_v;
+  bool found;
+  bool budget_hit;
+};
+
+// canonical order: first differing class; the one still holding members
+// there comes lexicographically FIRST (its next position is earlier)
+static bool canonical_less(const long long* a, const long long* b, long long K) {
+  for (long long k = 0; k < K; ++k) {
+    if (a[k] != b[k]) return a[k] > b[k];
+  }
+  return false;
+}
+
+static void dfs(DfsCtx& ctx, long long k, long long size, long long sv,
+                long long sw) {
+  if (--ctx.budget <= 0) {
+    ctx.budget_hit = true;
+    return;
+  }
+  if (k == ctx.K || ctx.budget_hit) return;
+  // j = 0 (skip this class)
+  dfs(ctx, k + 1, size, sv, sw);
+  if (ctx.budget_hit) return;
+  long long jmax = ctx.m[k];
+  if (jmax > ctx.kmax - size) jmax = ctx.kmax - size;
+  for (long long j = 1; j <= jmax; ++j) {
+    long long size_j = size + j;
+    long long sv_j = sv + j * ctx.v[k];
+    long long sw_j = sw + j * ctx.w[k];
+    if (sv_j >= ctx.cmin && size_j >= ctx.kmin) {
+      // recorded: the subset DFS returns at the first satisfied prefix
+      ctx.counts[k] = j;
+      if (!ctx.found || sw_j > ctx.best_w ||
+          (sw_j == ctx.best_w && sv_j > ctx.best_v) ||
+          (sw_j == ctx.best_w && sv_j == ctx.best_v &&
+           canonical_less(ctx.counts, ctx.best_counts, ctx.K))) {
+        ctx.best_w = sw_j;
+        ctx.best_v = sv_j;
+        for (long long i = 0; i < ctx.K; ++i) ctx.best_counts[i] = ctx.counts[i];
+        ctx.found = true;
+      }
+      ctx.counts[k] = 0;
+      break;
+    }
+    ctx.counts[k] = j;
+    dfs(ctx, k + 1, size_j, sv_j, sw_j);
+    ctx.counts[k] = 0;
+    if (ctx.budget_hit) return;
+  }
+}
+
+}  // namespace
+
+extern "C" long long class_dfs_batch(
+    const long long* cls_v,
+    const long long* cls_w,
+    const long long* cls_m,
+    const long long* row_off,
+    const long long* kmax_row,
+    long long n_rows,
+    long long kmin,
+    long long cmin,
+    long long budget,
+    long long* out_counts,
+    long long* out_status) {
+  // scratch sized to the widest row
+  long long max_k = 0;
+  for (long long r = 0; r < n_rows; ++r) {
+    long long K = row_off[r + 1] - row_off[r];
+    if (K > max_k) max_k = K;
+  }
+  long long* counts = new long long[max_k > 0 ? max_k : 1];
+  long long* best = new long long[max_k > 0 ? max_k : 1];
+  for (long long r = 0; r < n_rows; ++r) {
+    long long off = row_off[r];
+    long long K = row_off[r + 1] - off;
+    for (long long i = 0; i < K; ++i) counts[i] = 0;
+    DfsCtx ctx;
+    ctx.v = cls_v + off;
+    ctx.w = cls_w + off;
+    ctx.m = cls_m + off;
+    ctx.K = K;
+    ctx.kmin = kmin;
+    ctx.kmax = kmax_row[r];
+    ctx.cmin = cmin;
+    ctx.budget = budget;
+    ctx.counts = counts;
+    ctx.best_counts = best;
+    ctx.best_w = 0;
+    ctx.best_v = 0;
+    ctx.found = false;
+    ctx.budget_hit = false;
+    dfs(ctx, 0, 0, 0, 0);
+    if (ctx.budget_hit) {
+      out_status[r] = -1;
+    } else if (!ctx.found) {
+      out_status[r] = 0;
+    } else {
+      out_status[r] = 1;
+      for (long long i = 0; i < K; ++i) out_counts[off + i] = best[i];
+    }
+  }
+  delete[] counts;
+  delete[] best;
+  return 0;
+}

@@ -481,6 +481,137 @@ class ComboResult:
 _CLASS_DFS_BUDGET = 200_000  # recursion-step bound per row
 
 
+def _class_dfs_rows_native(weight, value, cfg, layout, kmax_row, rows,
+                           chosen, errors) -> set:
+    """Run the class-collapsed DFS for many rows through the native batch
+    kernel. Classification (group order → contiguous (value, weight)
+    classes) is vectorized across rows; winners decode through the shared
+    subpath walk. Returns the set of rows fully handled here (winner or
+    error); rows needing the Python twin (no native library, budget hit,
+    or the full-set special case mismatch) are left out."""
+    from .. import native
+
+    if not rows or not native.native_available():
+        return set()
+    kmin = max(cfg.rmin, 1)
+    cmin = cfg.cmin
+    rr = layout.rname_rank
+    rows_a = np.asarray(rows)
+    Wl = weight[rows_a]
+    Vl = value[rows_a]
+    S, R = Wl.shape
+    present = Vl > 0
+    n_present = present.sum(1)
+    # group order (value asc, weight desc, name asc), absent regions last
+    order = np.lexsort(
+        (np.broadcast_to(rr, Wl.shape), -Wl, Vl, ~present), axis=-1
+    )
+    Vs = np.take_along_axis(Vl, order, 1)
+    Ws = np.take_along_axis(Wl, order, 1)
+    Ps = np.take_along_axis(present, order, 1)  # prefix mask per row
+    new_cls = np.ones_like(Ps)
+    new_cls[:, 1:] = (Vs[:, 1:] != Vs[:, :-1]) | (Ws[:, 1:] != Ws[:, :-1])
+    new_cls &= Ps
+    r_idx, c_idx = np.nonzero(new_cls)
+    per_row = np.bincount(r_idx, minlength=S)
+    row_off = np.concatenate([[0], np.cumsum(per_row)]).astype(np.int64)
+    cls_v = Vs[r_idx, c_idx].astype(np.int64)
+    cls_w = Ws[r_idx, c_idx].astype(np.int64)
+    # class end = next class start within the row, else the row's n_present
+    ends = np.empty(len(c_idx), np.int64)
+    if len(c_idx):
+        ends[:-1] = c_idx[1:]
+        ends[-1] = 0
+        last_of_row = row_off[1:][per_row > 0] - 1
+        ends[last_of_row] = n_present[per_row > 0]
+    cls_m = ends - c_idx
+
+    kmax_l = np.minimum(np.asarray(kmax_row)[rows_a], n_present).astype(np.int64)
+    handled: set = set()
+
+    # special cases the Python twin handles before its DFS; their kernel
+    # slots run with kmax 0 so the DFS short-circuits (no wasted work)
+    full_set = n_present == kmin
+    too_few_regions = n_present < kmin
+    bad_kmax = (kmax_l < kmin) & ~too_few_regions
+    skip = full_set | too_few_regions | bad_kmax
+
+    out = native.class_dfs_batch(
+        cls_v, cls_w, cls_m, row_off, np.where(skip, 0, kmax_l),
+        kmin, cmin, _CLASS_DFS_BUDGET,
+    )
+    if out is None:
+        return set()
+    counts, status = out
+    err_msg = (
+        "the number of clusters is less than the cluster "
+        "spreadConstraint.MinGroups"
+    )
+    for i, s in enumerate(rows):
+        if too_few_regions[i]:
+            # same text as the Python twin's n_present < kmin branch
+            errors[s] = (
+                "the number of feasible region is less than "
+                "spreadConstraint.MinGroups"
+            )
+            handled.add(s)
+            continue
+        if bad_kmax[i]:
+            errors[s] = err_msg
+            handled.add(s)
+            continue
+        lo, hi = int(row_off[i]), int(row_off[i + 1])
+        if full_set[i]:
+            # `len(groups) == minConstraint` (select_groups.go:181-183):
+            # the DFS takes exactly the full set
+            if int(Vs[i, : n_present[i]].sum()) < cmin:
+                errors[s] = err_msg
+            else:
+                cnts = cls_m[lo:hi]
+                regs = _decode_class_winner(
+                    order[i], c_idx[lo:hi], cnts, cls_v[lo:hi], cls_w[lo:hi],
+                    rr, kmin, cmin,
+                )
+                chosen[s, regs] = True
+            handled.add(s)
+            continue
+        st = int(status[i])
+        if st == -1:
+            continue  # budget: Python twin decides (it will fall back too)
+        if st == 0:
+            errors[s] = err_msg
+            handled.add(s)
+            continue
+        regs = _decode_class_winner(
+            order[i], c_idx[lo:hi], counts[lo:hi], cls_v[lo:hi], cls_w[lo:hi],
+            rr, kmin, cmin,
+        )
+        chosen[s, regs] = True
+        handled.add(s)
+    return handled
+
+
+def _decode_class_winner(order_row, starts, counts, cls_v, cls_w, rr,
+                         kmin: int, cmin: int) -> np.ndarray:
+    """Winner counts → concrete regions: class members are contiguous in
+    the row's group order and name-ascending within a class, so the
+    canonical representative is the first `count` entries of each run; the
+    shared subpath walk finishes the selection."""
+    members: list[int] = []
+    mem_v: list[int] = []
+    mem_w: list[int] = []
+    mem_pos: list[int] = []
+    for k in range(len(starts)):
+        j = int(counts[k])
+        for i in range(j):
+            pos = int(starts[k]) + i
+            members.append(int(order_row[pos]))
+            mem_v.append(int(cls_v[k]))
+            mem_w.append(int(cls_w[k]))
+            mem_pos.append(pos)
+    return _finish_row_members(members, mem_v, mem_w, mem_pos, rr, kmin, cmin)
+
+
 def _select_row_class_dfs(weight: np.ndarray, value: np.ndarray,
                           cfg: SpreadConfig, layout: RegionLayout,
                           kmax: int):
@@ -599,7 +730,17 @@ def _select_row_class_dfs(weight: np.ndarray, value: np.ndarray,
             key.extend(range(cls_start[k], cls_start[k] + j))
         return tuple(key)
 
-    best = min(recorded, key=lambda t: (-t[0], -t[1], canonical_key(t[2])))
+    # two-stage winner: (Σw, Σv) max with cheap tuple compares first; the
+    # discovery-order canonical key is built ONLY for the tied maxima (the
+    # single-pass min() built it for every recorded multiset — the dominant
+    # cost of the whole combination search at 5k rows)
+    best_w, best_v = max((t[0], t[1]) for t in recorded)
+    tied = [t for t in recorded if t[0] == best_w and t[1] == best_v]
+    best = (
+        tied[0]
+        if len(tied) == 1
+        else min(tied, key=lambda t: canonical_key(t[2]))
+    )
     return _class_counts_to_regions(
         list(best[2]), cls_members, cls_v, cls_w, cls_start, rr, kmin, cmin
     )
@@ -608,9 +749,7 @@ def _select_row_class_dfs(weight: np.ndarray, value: np.ndarray,
 def _class_counts_to_regions(counts, cls_members, cls_v, cls_w, cls_start,
                              rr, kmin: int, cmin: int) -> np.ndarray:
     """Counts → concrete regions (first members per class, name-ascending —
-    the canonical representative) + the subpath preference
-    (select_groups.go:210-230): the SHORTEST (weight desc, name asc)-ordered
-    prefix that is itself a recorded feasible path."""
+    the canonical representative) + the subpath preference."""
     members: list[int] = []  # winner's concrete regions
     mem_v: list[int] = []
     mem_w: list[int] = []
@@ -622,7 +761,14 @@ def _class_counts_to_regions(counts, cls_members, cls_v, cls_w, cls_start,
             mem_v.append(cls_v[k])
             mem_w.append(cls_w[k])
             mem_pos.append(cls_start[k] + i)
-    # weight-order: (weight desc, name asc)
+    return _finish_row_members(members, mem_v, mem_w, mem_pos, rr, kmin, cmin)
+
+
+def _finish_row_members(members, mem_v, mem_w, mem_pos, rr,
+                        kmin: int, cmin: int) -> np.ndarray:
+    """The subpath preference (select_groups.go:210-230): the SHORTEST
+    (weight desc, name asc)-ordered prefix of the winner that is itself a
+    recorded feasible path."""
     worder = sorted(range(len(members)),
                     key=lambda i: (-mem_w[i], rr[members[i]]))
     n = len(members)
@@ -782,10 +928,18 @@ def select_regions_batch(
         fallback.extend(int(s) for s in live)
         return ComboResult(chosen, errors, fallback)
     if table is None:
-        # enumeration too large — the per-row class-collapsed exact DFS
-        # (skewed fleets: many interchangeable regions ⇒ few classes)
-        for s in np.nonzero(~too_few)[0]:
-            s = int(s)
+        # enumeration too large — the class-collapsed exact DFS (skewed
+        # fleets: many interchangeable regions ⇒ few classes). The batch
+        # runs through the native kernel when available (the per-row Python
+        # recursion cost ~0.5 ms × thousands of rows); rows the native path
+        # cannot take (or budget blowouts) use the Python twin.
+        live = [int(s) for s in np.nonzero(~too_few)[0]]
+        handled = _class_dfs_rows_native(
+            weight, value, cfg, layout, kmax_row, live, chosen, errors
+        )
+        for s in live:
+            if s in handled:
+                continue
             out = _select_row_class_dfs(
                 weight[s], value[s], cfg, layout, int(kmax_row[s])
             )
