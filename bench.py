@@ -128,7 +128,7 @@ def _dyn_placement(aggregated=False):
     )
 
 
-def build_dup3(seed=0):
+def build_dup3(seed=0, n_bindings=100):
     """Config 1: the local-up slice — 3 members, Duplicated nginx-alikes."""
     from karmada_tpu.sched.core import ArrayScheduler
     from karmada_tpu.testing.fixtures import duplicated_placement, synthetic_fleet
@@ -136,29 +136,29 @@ def build_dup3(seed=0):
     clusters = synthetic_fleet(3, seed=seed)
     names = [c.name for c in clusters]
     p = duplicated_placement(names)
-    bindings = [_binding(i, 2, p, 0.1) for i in range(100)]
+    bindings = [_binding(i, 2, p, 0.1) for i in range(n_bindings)]
     return ArrayScheduler(clusters), bindings, None
 
 
-def build_static(seed=0):
+def build_static(seed=0, n_clusters=100, n_bindings=1000):
     """Config 2: static-weight Divided split, 100 clusters x 1k bindings."""
     from karmada_tpu.sched.core import ArrayScheduler
     from karmada_tpu.testing.fixtures import static_weight_placement, synthetic_fleet
 
     rng = np.random.default_rng(seed)
-    clusters = synthetic_fleet(100, seed=seed)
+    clusters = synthetic_fleet(n_clusters, seed=seed)
     names = [c.name for c in clusters]
     placements = [
         static_weight_placement(
             {names[j]: int(rng.integers(1, 10))
-             for j in rng.choice(100, size=8, replace=False)}
+             for j in rng.choice(n_clusters, size=min(8, n_clusters), replace=False)}
         )
         for _ in range(16)
     ]
     bindings = [
         _binding(i, int(rng.integers(1, 64)), placements[i % 16],
                  float(rng.choice([0.1, 0.25, 0.5])))
-        for i in range(1000)
+        for i in range(n_bindings)
     ]
     return ArrayScheduler(clusters), bindings, None
 
@@ -205,7 +205,7 @@ def _estimator_shard_main(seed, cluster_names, port_queue):
         _t.sleep(3600)
 
 
-def build_dynamic(seed=0):
+def build_dynamic(seed=0, n_clusters=1000, n_bindings=1000):
     """Config 3: Divided/Aggregated dynamic division with the estimator
     answers arriving OVER THE WIRE inside the measured round: a spawned
     estimator-daemon process answers over the gRPC seam every iteration.
@@ -227,7 +227,7 @@ def build_dynamic(seed=0):
     from karmada_tpu.testing.fixtures import synthetic_fleet
 
     rng = np.random.default_rng(seed)
-    clusters = synthetic_fleet(1000, seed=seed)
+    clusters = synthetic_fleet(n_clusters, seed=seed)
     names = [c.name for c in clusters]
 
     ctx = mp.get_context("spawn")  # no forked JAX/TPU state in the daemon
@@ -243,7 +243,7 @@ def build_dynamic(seed=0):
         _binding(i, int(rng.integers(1, 64)),
                  _dyn_placement(aggregated=(i % 2 == 0)),
                  float(rng.choice(cpus)))
-        for i in range(1000)
+        for i in range(n_bindings)
     ]
     sched = ArrayScheduler(clusters)
 

@@ -250,7 +250,7 @@ def _apply_field_overriders(manifest: dict, overriders) -> None:
     for o in overriders:
         try:
             raw = _jp_get(manifest, o.field_path)
-        except (KeyError, IndexError) as e:
+        except (KeyError, IndexError, ValueError) as e:
             raise ValueError(
                 f"fieldOverrider path {o.field_path!r} does not resolve in "
                 f"the manifest"

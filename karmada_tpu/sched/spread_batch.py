@@ -922,6 +922,14 @@ def select_regions_batch(
     kmax_enum = int(min(R, kmax_row.max(initial=0), MAX_PATH_LEN if cfg.rmax <= 0 else cfg.rmax))
     if kmax_enum < kmin:
         kmax_enum = kmin
+    if int(np.abs(weight).max(initial=0)) >= (1 << 48):
+        # pathological magnitudes would lose exactness in the f64 host rank
+        # compares AND overflow the native DFS's int64 weight sums — route
+        # such fleets to the per-row exact DFS everywhere (checked BEFORE
+        # the class-DFS branch so it covers that path too)
+        live = np.nonzero(~too_few)[0]
+        fallback.extend(int(s) for s in live)
+        return ComboResult(chosen, errors, fallback)
     table = _combos(R, kmin, min(kmax_enum, R))
     if R > MAX_REGIONS:
         live = np.nonzero(~too_few)[0]
@@ -962,13 +970,6 @@ def select_regions_batch(
     overflow = (~too_few) & (kmax_row > kmax_enum) & (n_present > kmax_enum)
 
     v64 = value.astype(np.int64)
-    if int(np.abs(weight).max(initial=0)) >= (1 << 48):
-        # pathological magnitudes would lose exactness in the f64 host rank
-        # compares; keep behavior identical across backends by routing such
-        # fleets to the per-row exact DFS everywhere
-        live = np.nonzero(~too_few)[0]
-        fallback.extend(int(s) for s in live)
-        return ComboResult(chosen, errors, fallback)
 
     if device is None:
         # the device win only materializes once the (deduped) row count is

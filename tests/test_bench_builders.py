@@ -1,0 +1,46 @@
+"""Driver insurance: every bench config BUILDS and schedules at a tiny
+shape — a builder crash at round end would lose the round's numbers."""
+from __future__ import annotations
+
+import sys
+
+import pytest
+
+sys.path.insert(0, ".")
+import bench  # noqa: E402
+
+SMALL = dict(
+    dup3=lambda: bench.build_dup3(n_bindings=8),
+    static=lambda: bench.build_static(n_clusters=20, n_bindings=16),
+    spread=lambda: bench.build_spread(n_clusters=60, n_bindings=16),
+    spread_skewed=lambda: bench.build_spread_skewed(n_clusters=60, n_bindings=16),
+    churn=lambda: bench.build_churn(n_clusters=30, n_bindings=16),
+    flagship=lambda: bench.build_flagship(n_clusters=30, n_bindings=16),
+    flagship_cold=lambda: bench.build_flagship_cold(n_clusters=30, n_bindings=16),
+)
+
+
+@pytest.mark.parametrize("name", sorted(SMALL))
+def test_config_builds_and_schedules(name):
+    built = SMALL[name]()
+    sched, bindings, extra_fn, *rest = built
+    pre_iter = rest[0] if rest else None
+    for _ in range(2):
+        if pre_iter is not None:
+            pre_iter()
+        extra = extra_fn() if extra_fn else None
+        decisions = sched.schedule(bindings, extra_avail=extra)
+        assert sum(d.ok for d in decisions) == len(bindings)
+
+
+@pytest.mark.slow
+def test_dynamic_config_builds_with_daemon():
+    """The gRPC config spawns a real estimator daemon; keep it under the
+    slow marker (spawn + channel warmup)."""
+    sched, bindings, extra_fn = bench.build_dynamic(
+        n_clusters=12, n_bindings=8)[:3]
+    extra = extra_fn()
+    assert extra.shape == (8, 12)
+    assert (extra >= 0).all()  # every answer crossed the wire
+    decisions = sched.schedule(bindings, extra_avail=extra)
+    assert sum(d.ok for d in decisions) == 8

@@ -738,7 +738,11 @@ class TestNativeClassDfsParity:
             if isinstance(out, str):
                 assert s in errors_n, f"row {s}: python error, native winner"
             elif out is None:
-                pass  # python budget; native handled — spot-check feasibility
+                # python budget-out while native completed: the native
+                # winner must at least be a feasible selection
+                got = np.nonzero(chosen_n[s])[0]
+                assert len(got) >= kmin
+                assert value[s][got].sum() >= cfg.cmin
             else:
                 got = np.nonzero(chosen_n[s])[0]
                 assert np.array_equal(got, out), (
