@@ -15,8 +15,11 @@ reference's own shipped library) run unmodified:
   - operators: and/or/not, .. concat, == ~= < <= > >=, + - * / % ^,
     unary -, #
   - stdlib surface used by the scripts: tonumber, tostring, type, pairs,
-    ipairs, string.format/len/sub/lower/upper, math.ceil/floor/max/min/abs/
-    huge, table.insert/remove, and `require("kube")`
+    ipairs, string.format/len/sub/lower/upper/rep/byte/char/reverse plus
+    find/match/gmatch/gsub with the Lua pattern language (classes, sets,
+    quantifiers incl. lazy '-', anchors, captures, backrefs — %b/%f
+    unsupported), math.ceil/floor/max/min/abs/huge, table.insert/remove,
+    and `require("kube")`
 
 No io/os/debug/load/metatables — the sandbox exposes ONLY the above, and
 execution is step-bounded so a runaway script cannot hang the interpreter
@@ -791,7 +794,12 @@ class LuaVM:
                 if lib is None:
                     raise LuaError(f"unknown string method {expr[2]!r}")
                 args = [obj] + self._eval_list(expr[3], env, want=None)
-                return lib(*args)
+                out = lib(*args)
+                if isinstance(out, tuple):
+                    return _Multi(list(out)) if multi else (
+                        out[0] if out else None
+                    )
+                return out
             raise LuaError("method calls are only supported on strings")
         if kind == "function":
             return _LuaFunction(expr[1], expr[2], env, self)
@@ -1068,12 +1076,363 @@ def _string_sub(s, i, j=-1):
     return s[i - 1:j]
 
 
+# -- Lua patterns (string.find/match/gmatch/gsub) ---------------------------
+# A backtracking matcher for the Lua 5.x pattern language subset user
+# customizations use: literals, ., %a %d %l %s %u %w %x %p %c (and
+# complements), %<punct> escapes, [set] with ranges and ^ negation,
+# quantifiers * + - ?, anchors ^ $, captures () and %1-%9 backrefs.
+# %b/%f are not supported (LuaError). Indices are 1-based like Lua.
+
+
+def _cls_match(ch: str, cl: str) -> bool:
+    if cl.isalpha():
+        base = {
+            "a": ch.isalpha(), "c": ord(ch) < 32, "d": ch.isdigit(),
+            "l": ch.islower(), "p": (not ch.isalnum()) and ch.isprintable()
+            and not ch.isspace(),
+            "s": ch.isspace(), "u": ch.isupper(), "w": ch.isalnum(),
+            "x": ch in "0123456789abcdefABCDEF",
+        }.get(cl.lower())
+        if base is None:
+            return ch == cl
+        return base if cl.islower() else not base
+    return ch == cl
+
+
+class _LuaPattern:
+    def __init__(self, pat: str):
+        self.pat = pat
+        if "%b" in pat or "%f" in pat:
+            raise LuaError("unsupported pattern item (%b/%f)")
+        self.anchored = pat.startswith("^")
+        self.items, self.caps = self._parse(pat[1:] if self.anchored else pat)
+
+    def _parse(self, p: str):
+        items = []  # (kind, data, quant) kind: lit/any/cls/set/cap_open/cap_close/backref/end
+        caps = 0
+        i = 0
+        while i < len(p):
+            c = p[i]
+            if c == "(":
+                items.append(("cap_open", None, ""))
+                caps += 1
+                i += 1
+                continue
+            if c == ")":
+                items.append(("cap_close", None, ""))
+                i += 1
+                continue
+            if c == "$" and i == len(p) - 1:
+                items.append(("end", None, ""))
+                i += 1
+                continue
+            if c == "%":
+                if i + 1 >= len(p):
+                    raise LuaError("malformed pattern (ends with %)")
+                nxt = p[i + 1]
+                if nxt.isdigit():
+                    if nxt == "0":
+                        raise LuaError("invalid capture index %0 in pattern")
+                    items.append(("backref", int(nxt), ""))
+                    i += 2
+                    continue
+                unit = ("cls", nxt)
+                i += 2
+            elif c == "[":
+                j = i + 1
+                neg = j < len(p) and p[j] == "^"
+                if neg:
+                    j += 1
+                entries = []
+                first = True
+                while j < len(p) and (p[j] != "]" or first):
+                    first = False
+                    if p[j] == "%" and j + 1 < len(p):
+                        entries.append(("cls", p[j + 1]))
+                        j += 2
+                    elif j + 2 < len(p) and p[j + 1] == "-" and p[j + 2] != "]":
+                        entries.append(("range", (p[j], p[j + 2])))
+                        j += 3
+                    else:
+                        entries.append(("lit", p[j]))
+                        j += 1
+                if j >= len(p):
+                    raise LuaError("malformed pattern (missing ']')")
+                unit = ("set", (neg, entries))
+                i = j + 1
+            elif c == ".":
+                unit = ("any", None)
+                i += 1
+            else:
+                unit = ("lit", c)
+                i += 1
+            quant = ""
+            if i < len(p) and p[i] in "*+-?":
+                quant = p[i]
+                i += 1
+            items.append((unit[0], unit[1], quant))
+        return items, caps
+
+    def _single(self, s: str, pos: int, kind, data) -> bool:
+        if pos >= len(s):
+            return False
+        ch = s[pos]
+        if kind == "any":
+            return True
+        if kind == "lit":
+            return ch == data
+        if kind == "cls":
+            return _cls_match(ch, data)
+        if kind == "set":
+            neg, entries = data
+            hit = False
+            for ek, ev in entries:
+                if ek == "lit" and ch == ev:
+                    hit = True
+                elif ek == "cls" and _cls_match(ch, ev):
+                    hit = True
+                elif ek == "range" and ev[0] <= ch <= ev[1]:
+                    hit = True
+            return hit != neg
+        return False
+
+    MAX_STEPS = 200_000  # backtracking bound: patterns are user input
+
+    def match_at(self, s: str, start: int, budget: Optional[list] = None):
+        """Try to match at `start`; returns (end, captures) or None.
+        captures: list of (cap_start, cap_end) 0-based half-open."""
+        caps: list = []
+        if budget is None:
+            budget = [self.MAX_STEPS]
+
+        def bt(ii: int, pos: int):
+            budget[0] -= 1
+            if budget[0] <= 0:
+                raise LuaError("pattern too complex (backtracking budget)")
+            while ii < len(self.items):
+                kind, data, quant = self.items[ii]
+                if kind == "cap_open":
+                    caps.append([pos, None])
+                    out = bt(ii + 1, pos)
+                    if out is None:
+                        caps.pop()  # clean the branch's capture on backtrack
+                    return out
+                if kind == "cap_close":
+                    for c in reversed(caps):
+                        if c[1] is None:
+                            c[1] = pos
+                            out = bt(ii + 1, pos)
+                            if out is None:
+                                c[1] = None
+                            return out
+                    raise LuaError("invalid pattern capture")
+                if kind == "end":
+                    return pos if pos == len(s) else None
+                if kind == "backref":
+                    idx = data - 1
+                    if idx >= len(caps) or caps[idx][1] is None:
+                        raise LuaError(f"invalid capture index %{data}")
+                    text = s[caps[idx][0]:caps[idx][1]]
+                    if s.startswith(text, pos):
+                        pos += len(text)
+                        ii += 1
+                        continue
+                    return None
+                if quant == "":
+                    if self._single(s, pos, kind, data):
+                        pos += 1
+                        ii += 1
+                        continue
+                    return None
+                if quant == "?":
+                    if self._single(s, pos, kind, data):
+                        out = bt(ii + 1, pos + 1)
+                        if out is not None:
+                            return out
+                    ii += 1
+                    continue
+                if quant in "*+":
+                    count = 0
+                    while self._single(s, pos + count, kind, data):
+                        count += 1
+                    lo = 1 if quant == "+" else 0
+                    for take in range(count, lo - 1, -1):
+                        out = bt(ii + 1, pos + take)
+                        if out is not None:
+                            return out
+                    return None
+                if quant == "-":
+                    take = 0
+                    while True:
+                        out = bt(ii + 1, pos + take)
+                        if out is not None:
+                            return out
+                        if not self._single(s, pos + take, kind, data):
+                            return None
+                        take += 1
+                raise LuaError(f"unknown quantifier {quant!r}")
+            return pos
+
+        end = bt(0, start)
+        if end is None:
+            return None
+        if any(c[1] is None for c in caps):
+            raise LuaError("unfinished capture")
+        return end, [(c[0], c[1]) for c in caps]
+
+    def search(self, s: str, init: int = 0):
+        """First match at or after init: (start, end, captures) or None."""
+        stops = [init] if self.anchored else range(init, len(s) + 1)
+        budget = [self.MAX_STEPS]
+        for start in stops:
+            out = self.match_at(s, start, budget)
+            if out is not None:
+                return start, out[0], out[1]
+        return None
+
+
+def _capture_values(s: str, start: int, end: int, caps):
+    if not caps:
+        return [s[start:end]]
+    return [s[a:b] for a, b in caps]
+
+
+def _string_find(s, pat, init=1, plain=None):
+    init = int(init)
+    if init < 0:
+        init = max(len(s) + init, 0)
+    elif init > 0:
+        init -= 1
+    if _truthy(plain):
+        idx = s.find(pat, init)
+        if idx < 0:
+            return None
+        return (idx + 1, idx + len(pat))
+    m = _LuaPattern(pat).search(s, init)
+    if m is None:
+        return None
+    start, end, caps = m
+    if caps:
+        return tuple([start + 1, end] + _capture_values(s, start, end, caps))
+    return (start + 1, end)
+
+
+def _string_match(s, pat, init=1):
+    init = int(init)
+    init = max(len(s) + init, 0) if init < 0 else max(init - 1, 0)
+    m = _LuaPattern(pat).search(s, init)
+    if m is None:
+        return None
+    start, end, caps = m
+    vals = _capture_values(s, start, end, caps)
+    return tuple(vals) if len(vals) > 1 else vals[0]
+
+
+def _string_gmatch(s, pat):
+    compiled = _LuaPattern(pat)
+
+    def gen():
+        pos = 0
+        while pos <= len(s):
+            m = compiled.search(s, pos)
+            if m is None:
+                return
+            start, end, caps = m
+            vals = _capture_values(s, start, end, caps)
+            yield tuple(vals) if len(vals) > 1 else vals[0]
+            pos = end + 1 if end == start else end
+
+    return iter(gen())
+
+
+def _string_gsub(s, pat, repl, n=None):
+    compiled = _LuaPattern(pat)
+    limit = int(n) if n is not None else -1
+    out = []
+    pos = 0
+    count = 0
+    while pos <= len(s) and (limit < 0 or count < limit):
+        if compiled.anchored and pos > 0:
+            break  # a ^-anchored pattern only ever applies at the start
+        m = compiled.search(s, pos)
+        if m is None:
+            break
+        start, end, caps = m
+        out.append(s[pos:start])
+        vals = _capture_values(s, start, end, caps)
+        whole = s[start:end]
+        if isinstance(repl, str):
+            rep = []
+            i = 0
+            while i < len(repl):
+                if repl[i] == "%" and i + 1 < len(repl):
+                    d = repl[i + 1]
+                    if d == "0":
+                        rep.append(whole)
+                    elif d.isdigit():
+                        k = int(d) - 1
+                        if k >= len(vals):
+                            raise LuaError(f"invalid capture index %{d}")
+                        rep.append(vals[k])
+                    else:
+                        rep.append(d)
+                    i += 2
+                else:
+                    rep.append(repl[i])
+                    i += 1
+            out.append("".join(rep))
+        elif isinstance(repl, LuaTable):
+            v = repl.get(vals[0])
+            out.append(_tostr_concat(v) if v is not None and v is not False else whole)
+        elif callable(repl):
+            v = repl(*vals)
+            if isinstance(v, (tuple, list)):  # _LuaFunction returns a list
+                v = v[0] if v else None
+            if isinstance(v, _Multi):
+                v = v.values[0] if v.values else None
+            out.append(_tostr_concat(v) if v is not None and v is not False else whole)
+        else:
+            raise LuaError("bad gsub replacement type")
+        count += 1
+        if end == start:
+            if start < len(s):
+                out.append(s[start])
+            pos = start + 1
+        else:
+            pos = end
+    out.append(s[pos:])
+    return ("".join(out), count)
+
+
+def _string_byte(s, i=1):
+    i = int(i)
+    idx = i - 1 if i > 0 else len(s) + i  # Lua: negative counts from the end
+    if 0 <= idx < len(s):
+        return ord(s[idx])
+    return None
+
+
+def _string_rep(s, n, sep=None):
+    n = int(n)
+    if n <= 0:
+        return ""
+    return (str(sep) if sep is not None else "").join([s] * n) if sep else s * n
+
+
 _STRING_METHODS = {
     "format": _string_format,
     "sub": _string_sub,
     "len": lambda s: len(s),
     "lower": lambda s: s.lower(),
     "upper": lambda s: s.upper(),
+    "find": _string_find,
+    "match": _string_match,
+    "gmatch": _string_gmatch,
+    "gsub": _string_gsub,
+    "rep": _string_rep,
+    "byte": _string_byte,
+    "char": lambda *a: "".join(chr(int(x)) for x in a),
+    "reverse": lambda s: s[::-1],
 }
 
 

@@ -162,6 +162,99 @@ class TestLanguage:
         assert run(src, "F") == [1]
 
 
+class TestStringPatterns:
+    """string.find/match/gmatch/gsub with Lua patterns (1-based indices,
+    %-classes, captures, lazy '-', anchors)."""
+
+    def test_find_plain_and_pattern(self):
+        src = """
+        function F(s)
+          local a, b = string.find(s, 'world')
+          local c, d = string.find(s, '%d+')
+          return a, b, c, d
+        end"""
+        assert run(src, "F", "hello world 42") == [7, 11, 13, 14]
+
+    def test_find_plain_flag(self):
+        src = "function F(s) return string.find(s, '%d', 1, true) end"
+        assert run(src, "F", "a%db") == [2, 3]
+        assert run(src, "F", "a1b") == [None]
+
+    def test_match_captures(self):
+        src = """
+        function F(s)
+          local k, v = string.match(s, '(%w+)=(%w+)')
+          return k, v
+        end"""
+        assert run(src, "F", "  cpu=500m ") == ["cpu", "500m"]
+
+    def test_match_anchors(self):
+        # a bare return of a multi-capture match expands all captures
+        src = "function F(s) return s:match('^v(%d+)%.(%d+)') end"
+        assert run(src, "F", "v1.29-gke") == ["1", "29"]
+        assert run(src, "F", "1.29") == [None]  # anchor fails
+        out = run("function F(s) local a, b = s:match('^v(%d+)%.(%d+)') return a, b end",
+                  "F", "v1.29-gke")
+        assert out == ["1", "29"]
+
+    def test_gmatch_iteration(self):
+        src = """
+        function F(s)
+          local parts = {}
+          for w in string.gmatch(s, '[^,]+') do
+            parts[#parts + 1] = w
+          end
+          return parts
+        end"""
+        assert run(src, "F", "a,b,cd") == [["a", "b", "cd"]]
+
+    def test_gmatch_pairs(self):
+        src = """
+        function F(s)
+          local t = {}
+          for k, v in string.gmatch(s, '(%w+)=(%w+)') do
+            t[k] = v
+          end
+          return t
+        end"""
+        assert run(src, "F", "a=1,b=2") == [{"a": "1", "b": "2"}]
+
+    def test_gsub_string_repl(self):
+        src = "function F(s) local r, n = s:gsub('%s+', '-') return r, n end"
+        assert run(src, "F", "a  b c") == ["a-b-c", 2]
+
+    def test_gsub_capture_refs(self):
+        src = "function F(s) return (s:gsub('(%w+)@(%w+)', '%2.%1')) end"
+        assert run(src, "F", "user@host") == ["host.user"]
+
+    def test_gsub_function_repl(self):
+        src = """
+        function F(s)
+          return (s:gsub('%d+', function(d) return tostring(tonumber(d) * 2) end))
+        end"""
+        assert run(src, "F", "x2 y10") == ["x4 y20"]
+
+    def test_gsub_limit(self):
+        src = "function F(s) local r, n = s:gsub('a', 'b', 1) return r, n end"
+        assert run(src, "F", "aaa") == ["baa", 1]
+
+    def test_lazy_quantifier(self):
+        src = "function F(s) return s:match('<(.-)>') end"
+        assert run(src, "F", "<a><b>") == ["a"]
+
+    def test_charset_and_rep(self):
+        src = """
+        function F()
+          return ('ab'):rep(3), string.match('k8s-node-07', '[%w%-]+'),
+                 ('abc'):byte(2), string.char(104, 105), ('abc'):reverse()
+        end"""
+        assert run(src, "F") == ["ababab", "k8s-node-07", 98, "hi", "cba"]
+
+    def test_unsupported_balanced_raises(self):
+        with pytest.raises(LuaError, match="%b"):
+            run("function F(s) return s:match('%b()') end", "F", "(x)")
+
+
 class TestSandbox:
     def test_no_io_os_load(self):
         for name in ("io", "os", "load", "loadstring", "dofile", "debug"):
