@@ -794,12 +794,7 @@ class LuaVM:
                 if lib is None:
                     raise LuaError(f"unknown string method {expr[2]!r}")
                 args = [obj] + self._eval_list(expr[3], env, want=None)
-                out = lib(*args)
-                if isinstance(out, tuple):
-                    return _Multi(list(out)) if multi else (
-                        out[0] if out else None
-                    )
-                return out
+                return self._invoke(lib, args, expr, multi)
             raise LuaError("method calls are only supported on strings")
         if kind == "function":
             return _LuaFunction(expr[1], expr[2], env, self)
@@ -843,7 +838,7 @@ class LuaVM:
                 return _Multi(out)
             return out[0] if out else None
         if callable(fn):
-            out = fn(*args)
+            out = _host_call(fn, *args)
             if isinstance(out, tuple):
                 return _Multi(list(out)) if multi else (
                     out[0] if out else None
@@ -909,6 +904,19 @@ class _Multi:
 
 def _truthy(v) -> bool:
     return v is not None and v is not False
+
+
+def _host_call(fn, *args):
+    """Invoke a host (stdlib/kube) function keeping the sandbox's error
+    contract: any Python-level failure surfaces as a catchable LuaError,
+    never a raw host exception."""
+    try:
+        return fn(*args)
+    except LuaError:
+        raise
+    except (ValueError, TypeError, OverflowError, OSError, IndexError,
+            KeyError, ZeroDivisionError, ArithmeticError) as e:
+        raise LuaError(f"{type(e).__name__}: {e}")
 
 
 def _typename(v) -> str:
@@ -1102,8 +1110,14 @@ def _cls_match(ch: str, cl: str) -> bool:
 class _LuaPattern:
     def __init__(self, pat: str):
         self.pat = pat
-        if "%b" in pat or "%f" in pat:
-            raise LuaError("unsupported pattern item (%b/%f)")
+        i = 0
+        while i < len(pat):  # escape-aware: '%%b' is a literal, '%b' is not
+            if pat[i] == "%":
+                if i + 1 < len(pat) and pat[i + 1] in "bf":
+                    raise LuaError("unsupported pattern item (%b/%f)")
+                i += 2
+            else:
+                i += 1
         self.anchored = pat.startswith("^")
         self.items, self.caps = self._parse(pat[1:] if self.anchored else pat)
 
@@ -1329,6 +1343,10 @@ def _string_match(s, pat, init=1):
 
 
 def _string_gmatch(s, pat):
+    # PUC Lua: gmatch does not honor '^' as an anchor (it would defeat the
+    # iteration); the caret is matched as a literal character instead
+    if pat.startswith("^"):
+        pat = "%^" + pat[1:]
     compiled = _LuaPattern(pat)
 
     def gen():
@@ -1412,10 +1430,16 @@ def _string_byte(s, i=1):
     return None
 
 
+_MAX_STRING_LEN = 10_000_000  # rep amplification cap (sandbox memory bound)
+
+
 def _string_rep(s, n, sep=None):
     n = int(n)
     if n <= 0:
         return ""
+    total = len(s) * n + (len(str(sep)) * (n - 1) if sep else 0)
+    if total > _MAX_STRING_LEN:
+        raise LuaError("resulting string too large")
     return (str(sep) if sep is not None else "").join([s] * n) if sep else s * n
 
 
@@ -1494,6 +1518,118 @@ def _require(name):
     raise LuaError(f"module {name!r} is not available in the sandbox")
 
 
+def _lua_error(msg=None, level=None):
+    raise LuaError(_lua_tostring(msg) if msg is not None else "error")
+
+
+def _lua_assert(v, msg=None):
+    if not _truthy(v):
+        raise LuaError(_lua_tostring(msg) if msg is not None else
+                       "assertion failed!")
+    return v
+
+
+def _lua_pcall(fn, *args):
+    try:
+        if isinstance(fn, _LuaFunction):
+            out = fn(*args)  # list of return values
+            return tuple([True] + list(out))
+        if callable(fn):
+            out = _host_call(fn, *args)
+            if isinstance(out, tuple):
+                return tuple([True] + list(out))
+            return (True,) if out is None else (True, out)
+        raise LuaError(f"attempt to call a {_typename(fn)} value")
+    except LuaError as e:
+        return (False, str(e))
+
+
+def _table_concat(t, sep="", i=1, j=None):
+    if not isinstance(t, LuaTable):
+        raise LuaError("bad argument to 'table.concat'")
+    j = t.length() if j is None else int(j)
+    parts = []
+    for k in range(int(i), j + 1):
+        v = t.get(k)
+        if v is None:
+            raise LuaError(f"invalid value (at index {k}) in table for 'concat'")
+        parts.append(_tostr_concat(v))
+    return (sep or "").join(parts)
+
+
+def _lua_lt(a, b) -> bool:
+    if isinstance(a, str) and isinstance(b, str):
+        return a < b
+    if (isinstance(a, (int, float)) and isinstance(b, (int, float))
+            and not isinstance(a, bool) and not isinstance(b, bool)):
+        return a < b
+    raise LuaError(f"attempt to compare {_typename(a)} with {_typename(b)}")
+
+
+def _table_sort(t, comp=None):
+    import functools
+
+    if not isinstance(t, LuaTable):
+        raise LuaError("bad argument to 'table.sort'")
+    n = t.length()
+    vals = [t.get(k) for k in range(1, n + 1)]
+    if comp is None:
+        less = _lua_lt
+    else:
+        def less(a, b) -> bool:
+            out = comp(a, b)
+            if isinstance(out, (list, tuple)):
+                out = out[0] if out else None
+            return _truthy(out)
+
+    vals.sort(key=functools.cmp_to_key(
+        lambda a, b: -1 if less(a, b) else (1 if less(b, a) else 0)
+    ))
+    for k, v in enumerate(vals, start=1):
+        t.set(k, v)
+
+
+def _os_time(spec=None):
+    # safe os.time (lifted/lua/oslib_safe.go): epoch seconds, or the epoch
+    # of a {year, month, day[, hour, min, sec]} table (noon default hour)
+    import time as _t
+
+    if spec is None:
+        return int(_t.time())
+    if not isinstance(spec, LuaTable):
+        raise LuaError("bad argument to 'os.time'")
+
+    def g(key, default):
+        v = spec.get(key)
+        return int(v) if v is not None else default
+
+    # mktime (LOCAL time) like Lua / the lifted oslib; isdst -1 = unknown
+    return int(_t.mktime((
+        g("year", 1970), g("month", 1), g("day", 1),
+        g("hour", 12), g("min", 0), g("sec", 0), 0, 0, -1,
+    )))
+
+
+def _os_date(fmt="%c", t=None):
+    # safe os.date: strftime formats plus the '*t'/'!*t' table form
+    import time as _t
+
+    when = int(t) if t is not None else int(_t.time())
+    utc = fmt.startswith("!")
+    if utc:
+        fmt = fmt[1:]
+    st = _t.gmtime(when) if utc else _t.localtime(when)
+    if fmt == "*t":
+        return to_lua({
+            "year": st.tm_year, "month": st.tm_mon, "day": st.tm_mday,
+            "hour": st.tm_hour, "min": st.tm_min, "sec": st.tm_sec,
+            # Lua wday: 1 = Sunday; tm_wday: 0 = Monday
+            "wday": (st.tm_wday + 1) % 7 + 1, "yday": st.tm_yday,
+            "isdst": bool(st.tm_isdst),
+        })
+    return _t.strftime(fmt, st)
+
+
 def _stdlib() -> dict:
     return {
         "tonumber": _lua_tonumber,
@@ -1501,6 +1637,9 @@ def _stdlib() -> dict:
         "type": _typename,
         "pairs": _pairs,
         "ipairs": _ipairs,
+        "error": _lua_error,
+        "assert": _lua_assert,
+        "pcall": _lua_pcall,
         "require": _require,
         "math": {
             "ceil": lambda x: int(math.ceil(_tonum(x, "math.ceil"))),
@@ -1511,7 +1650,11 @@ def _stdlib() -> dict:
             "huge": math.inf,
         },
         "string": dict(_STRING_METHODS),
-        "table": {"insert": _table_insert, "remove": _table_remove},
+        "table": {"insert": _table_insert, "remove": _table_remove,
+                  "concat": _table_concat, "sort": _table_sort},
+        # the reference sandbox opens a SAFE os with only time/date
+        # (lifted/lua/oslib_safe.go via luavm/lua.go:188)
+        "os": {"time": _os_time, "date": _os_date},
     }
 
 

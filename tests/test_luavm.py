@@ -256,10 +256,36 @@ class TestStringPatterns:
 
 
 class TestSandbox:
-    def test_no_io_os_load(self):
-        for name in ("io", "os", "load", "loadstring", "dofile", "debug"):
+    def test_no_io_load_debug(self):
+        for name in ("io", "load", "loadstring", "dofile", "debug",
+                     "rawget", "rawset", "getmetatable", "setmetatable"):
             out = run(f"function F() return {name} end", "F")
             assert out == [None], name
+
+    def test_safe_os_only_time_and_date(self):
+        # the reference opens a SAFE os with only time/date
+        # (lifted/lua/oslib_safe.go); execute/exit/getenv must not exist
+        out = run("function F() return os.execute, os.exit, os.getenv, "
+                  "os.remove end", "F")
+        assert out == [None, None, None, None]
+        t = run("function F() return os.time() end", "F")[0]
+        assert isinstance(t, int) and t > 1_600_000_000
+        assert run("function F() return os.date('!%Y-%m-%d', 86400) end",
+                   "F") == ["1970-01-02"]
+        d = run("function F() return os.date('!*t', 0) end", "F")[0]
+        assert d["year"] == 1970 and d["month"] == 1 and d["wday"] == 5
+
+    def test_table_sort_concat_pcall(self):
+        src = """
+        function F()
+          local t = {'b', 'c', 'a'}
+          table.sort(t)
+          local joined = table.concat(t, ',')
+          table.sort(t, function(x, y) return x > y end)
+          local ok, err = pcall(function() error('nope') end)
+          return joined, t[1], ok, err, assert(5)
+        end"""
+        assert run(src, "F") == ["a,b,c", "c", False, "nope", 5]
 
     def test_require_only_kube(self):
         with pytest.raises(LuaError, match="not available"):
