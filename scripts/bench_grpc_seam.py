@@ -75,6 +75,23 @@ def main(n_clusters: int = 200, n_rounds: int = 10) -> None:
         f"({n_clusters / p50:7.0f} RPC/s), answers ok {ok}/{n_clusters}, "
         f"worst {ts[-1] * 1e3:.1f} ms"
     )
+
+    # the batched method (estimator.proto BatchMaxAvailableReplicas): one
+    # RPC per server covering its whole shard x all distinct requirements
+    reqs = [req, ReplicaRequirements(resource_request={CPU: 1.0})]
+    client.batch_max_available_replicas(names, reqs)  # warm
+    tb = []
+    for _ in range(n_rounds):
+        t0 = time.perf_counter()
+        mat = client.batch_max_available_replicas(names, reqs)
+        tb.append(time.perf_counter() - t0)
+    tb.sort()
+    okb = int((mat >= 0).sum())
+    print(
+        f"{n_clusters} clusters x {len(reqs)} reqs BATCHED: p50 "
+        f"{tb[len(tb) // 2] * 1e3:7.1f} ms/round, answers ok "
+        f"{okb}/{mat.size}, worst {tb[-1] * 1e3:.1f} ms"
+    )
     server.stop()
 
 
