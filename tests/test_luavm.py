@@ -593,3 +593,169 @@ class TestCustomizationLanguageRouting:
         from karmada_tpu.interpreter.interpreter import HEALTHY
 
         assert ki.interpret_health(o) == HEALTHY
+
+
+@pytestmark_ref
+class TestReferenceLuaNativeParityBroad:
+    """Output parity between the reference's shipped Lua (executed by the
+    VM) and the native thirdparty implementations, beyond CloneSet.
+    Known deliberate divergences are skipped per-kind (e.g. HelmRelease's
+    aggregation reads an undeclared global for observedGeneration — a
+    reference-script bug the native tier does not reproduce)."""
+
+    def _lua(self, kind_path, field):
+        yaml = pytest.importorskip("yaml")
+        path = [p for p in REF_CUSTOMIZATIONS if kind_path in p][0]
+        cust = yaml.safe_load(open(path))["spec"]["customizations"]
+        return compile_lua_script(cust[field]["luaScript"], OP_OF_FIELD[field])
+
+    def _native(self, gvk):
+        from karmada_tpu.interpreter.thirdparty import load_thirdparty_tier
+
+        return load_thirdparty_tier()[gvk]
+
+    def _items(self, raw):
+        from karmada_tpu.api.work import AggregatedStatusItem
+
+        return [AggregatedStatusItem(cluster_name=c, status=dict(s))
+                for c, s in raw]
+
+    def _obj(self, gvk, spec=None, status=None, generation=1):
+        from karmada_tpu.api.unstructured import Unstructured
+
+        api_version, kind = gvk.rsplit("/", 1)
+        return Unstructured({
+            "apiVersion": api_version, "kind": kind,
+            "metadata": {"name": "o", "namespace": "default",
+                         "generation": generation, "annotations": {}},
+            **({"spec": spec} if spec is not None else {}),
+            **({"status": status} if status is not None else {}),
+        })
+
+    def _assert_status_parity(self, kind_path, gvk, field_status, items_raw,
+                              fields, generation=2):
+        lua_fn = self._lua(kind_path, "statusAggregation")
+        native = self._native(gvk)
+        obj = self._obj(gvk, spec={"replicas": 2}, status=dict(field_status),
+                        generation=generation)
+        # the VM deep-converts its args (to_lua), so the dict is safe to share
+        lua_out = lua_fn(obj.to_dict(),
+                         [{"clusterName": c, "status": dict(s)}
+                          for c, s in items_raw])
+        nat_out = native.aggregate_status(obj, self._items(items_raw)).to_dict()
+        for f in fields:
+            assert _norm(lua_out["status"].get(f)) == \
+                _norm(nat_out["status"].get(f)), (gvk, f)
+
+    def test_kruise_statefulset_aggregate(self):
+        items = [
+            ("m1", {"replicas": 2, "readyReplicas": 2, "currentReplicas": 2,
+                    "updatedReplicas": 2, "availableReplicas": 2,
+                    "updateRevision": "u1", "currentRevision": "c1",
+                    "resourceTemplateGeneration": 2, "generation": 3,
+                    "observedGeneration": 3}),
+            ("m2", {"replicas": 1, "readyReplicas": 1, "currentReplicas": 1,
+                    "updatedReplicas": 1, "availableReplicas": 1,
+                    "resourceTemplateGeneration": 2, "generation": 4,
+                    "observedGeneration": 4}),
+        ]
+        self._assert_status_parity(
+            "v1beta1/StatefulSet", "apps.kruise.io/v1beta1/StatefulSet",
+            {"observedGeneration": 1}, items,
+            ("replicas", "readyReplicas", "currentReplicas",
+             "updatedReplicas", "availableReplicas", "updateRevision",
+             "currentRevision", "observedGeneration"),
+        )
+
+    def test_kruise_daemonset_aggregate(self):
+        items = [
+            ("m1", {"currentNumberScheduled": 2, "desiredNumberScheduled": 2,
+                    "numberReady": 2, "updatedNumberScheduled": 2,
+                    "numberAvailable": 2, "numberMisscheduled": 0,
+                    "numberUnavailable": 0, "daemonSetHash": "h",
+                    "resourceTemplateGeneration": 2, "generation": 1,
+                    "observedGeneration": 1}),
+        ]
+        self._assert_status_parity(
+            "v1alpha1/DaemonSet", "apps.kruise.io/v1alpha1/DaemonSet",
+            {"observedGeneration": 1}, items,
+            ("currentNumberScheduled", "desiredNumberScheduled",
+             "numberReady", "updatedNumberScheduled", "numberAvailable",
+             "numberMisscheduled", "numberUnavailable", "daemonSetHash",
+             "observedGeneration"),
+        )
+
+    def test_kyverno_policy_aggregate(self):
+        items = [
+            ("m1", {"ready": True,
+                    "rulecount": {"validate": 1, "generate": 0, "mutate": 1,
+                                  "verifyimages": 0},
+                    "conditions": [{"type": "Ready", "status": "True",
+                                    "reason": "Succeeded", "message": "ok"}]}),
+            ("m2", {"rulecount": {"validate": 2, "generate": 1, "mutate": 0,
+                                  "verifyimages": 1},
+                    "conditions": [{"type": "Ready", "status": "True",
+                                    "reason": "Succeeded", "message": "ok"}]}),
+        ]
+        self._assert_status_parity(
+            "kyverno.io/v1/Policy", "kyverno.io/v1/Policy",
+            {}, items, ("ready", "rulecount", "conditions"),
+        )
+
+    @pytest.mark.parametrize("kind_path,gvk", [
+        ("v1/GitRepository", "source.toolkit.fluxcd.io/v1/GitRepository"),
+        ("v1beta2/Bucket", "source.toolkit.fluxcd.io/v1beta2/Bucket"),
+        ("v1beta2/HelmRepository",
+         "source.toolkit.fluxcd.io/v1beta2/HelmRepository"),
+        ("v1beta2/OCIRepository",
+         "source.toolkit.fluxcd.io/v1beta2/OCIRepository"),
+    ])
+    def test_flux_source_aggregate(self, kind_path, gvk):
+        items = [
+            ("m1", {"artifact": {"revision": "r1"}, "url": "http://u1",
+                    "conditions": [{"type": "Ready", "status": "True",
+                                    "reason": "Succeeded", "message": "ok"}],
+                    "resourceTemplateGeneration": 2, "generation": 1,
+                    "observedGeneration": 1}),
+            ("m2", {"artifact": {"revision": "r2"}, "url": "http://u2",
+                    "conditions": [{"type": "Ready", "status": "True",
+                                    "reason": "Succeeded", "message": "ok"}],
+                    "resourceTemplateGeneration": 2, "generation": 1,
+                    "observedGeneration": 1}),
+        ]
+        fields = ("artifact", "conditions", "observedGeneration")
+        if "GitRepository" not in gvk:
+            fields += ("url",)
+        self._assert_status_parity(kind_path, gvk, {"observedGeneration": 1},
+                                   items, fields)
+
+    @pytest.mark.parametrize("kind_path,gvk,healthy,unhealthy", [
+        ("v1beta1/StatefulSet", "apps.kruise.io/v1beta1/StatefulSet",
+         {"observedGeneration": 1, "updatedReplicas": 2,
+          "availableReplicas": 2},
+         {"observedGeneration": 0, "updatedReplicas": 2,
+          "availableReplicas": 2}),
+        ("kyverno.io/v1/ClusterPolicy", "kyverno.io/v1/ClusterPolicy",
+         {"ready": True}, {"ready": False}),
+        ("v1/GitRepository", "source.toolkit.fluxcd.io/v1/GitRepository",
+         {"conditions": [{"type": "Ready", "status": "True",
+                          "reason": "Succeeded"}]},
+         {"conditions": [{"type": "Ready", "status": "False",
+                          "reason": "Failed"}]}),
+        ("v1beta2/HelmChart", "source.toolkit.fluxcd.io/v1beta2/HelmChart",
+         {"conditions": [{"type": "Ready", "status": "True",
+                          "reason": "ChartPullSucceeded"}]},
+         {"conditions": [{"type": "Ready", "status": "True",
+                          "reason": "Other"}]}),
+    ])
+    def test_health_parity(self, kind_path, gvk, healthy, unhealthy):
+        from karmada_tpu.interpreter.interpreter import HEALTHY
+
+        lua_fn = self._lua(kind_path, "healthInterpretation")
+        native = self._native(gvk)
+        for st, want in ((healthy, True), (unhealthy, False)):
+            obj = self._obj(gvk, spec={"replicas": 2}, status=dict(st),
+                            generation=1)
+            lua_h = lua_fn(obj.to_dict())
+            nat_h = native.interpret_health(obj) == HEALTHY
+            assert lua_h == nat_h == want, (gvk, st)
