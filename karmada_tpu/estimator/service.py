@@ -206,13 +206,34 @@ class EstimatorServer:
         (interface.go:27-30 UnauthenticReplica semantics per cluster)."""
         resp = pb.BatchMaxAvailableReplicasResponse()
         ests = [self.estimators.get(c) for c in request.clusters]
+
+        logged: set[str] = set()
+
+        def one(cluster: str, est, requirements) -> int:
+            # per-cluster error isolation: one failing estimator answers
+            # the -1 sentinel for ITS column only, like the singular path
+            # degrading per cluster (client min-merge discards -1)
+            if est is None:
+                return UNAUTHENTIC_REPLICA
+            try:
+                return est.max_available_replicas(requirements)
+            except Exception as e:  # noqa: BLE001 - degrade, don't fail batch
+                if cluster not in logged:
+                    logged.add(cluster)
+                    import logging
+
+                    logging.getLogger(__name__).warning(
+                        "estimator for %s failed in batch RPC, answering -1: %s",
+                        cluster, e,
+                    )
+                return UNAUTHENTIC_REPLICA
+
         for req_pb in request.replicaRequirements:
             requirements = requirements_from_pb(req_pb)
             row = resp.rows.add()
             row.maxReplicas.extend(
-                UNAUTHENTIC_REPLICA if est is None
-                else est.max_available_replicas(requirements)
-                for est in ests
+                one(c, est, requirements)
+                for c, est in zip(request.clusters, ests)
             )
         return resp
 

@@ -20,7 +20,9 @@ from ..api.unstructured import Unstructured
 from ..api.work import AggregatedStatusItem, NodeClaim, ReplicaRequirements
 from ..runtime.controller import DONE, Controller, Runtime
 from ..store.store import Store
-from .declarative import OPERATION_FUNCTIONS, ScriptError, compile_script
+from .declarative import (
+    OPERATION_FUNCTIONS, ScriptError, compile_rule_script,
+)
 from .interpreter import (
     HEALTHY,
     KindInterpreter,
@@ -114,13 +116,10 @@ def compile_customization(spec) -> KindInterpreter:
     for op in OPERATION_FUNCTIONS:
         rule = getattr(spec.customizations, op, None)
         if rule is not None and rule.script:
-            if luavm.looks_like_lua(rule.script):
-                try:
-                    fns[op] = luavm.compile_lua_script(rule.script, op)
-                except luavm.LuaError as e:
-                    raise ScriptError(str(e))
-            else:
-                fns[op] = compile_script(rule.script, op)
+            try:
+                fns[op], _ = compile_rule_script(rule.script, op)
+            except luavm.LuaError as e:
+                raise ScriptError(str(e))
     if not fns:
         raise ScriptError("customization defines no scripts")
     return _wrap_scripts(fns)

@@ -132,6 +132,32 @@ def compile_script(script: str, operation: str) -> Callable[..., Any]:
     return _with_execution_limit(fn, operation)
 
 
+def compile_rule_script(script: str, operation: str):
+    """Compile one CustomizationRule script in whichever language it is
+    written in, returning (callable, language).
+
+    The sniff (luavm.looks_like_lua) only picks which compiler runs FIRST;
+    a script the sniff misroutes still compiles via the other language
+    before any error surfaces, so classification can never turn a valid
+    script into a denial — only genuinely-invalid scripts fail, and they
+    fail with the sniffed language's error (the one the author meant)."""
+    from . import luavm
+
+    sniffed_lua = luavm.looks_like_lua(script)
+    first, second = (
+        ((luavm.compile_lua_script, "lua"), (compile_script, "native"))
+        if sniffed_lua
+        else ((compile_script, "native"), (luavm.compile_lua_script, "lua"))
+    )
+    try:
+        return first[0](script, operation), first[1]
+    except (ScriptError, luavm.LuaError) as primary_err:
+        try:
+            return second[0](script, operation), second[1]
+        except (ScriptError, luavm.LuaError):
+            raise primary_err
+
+
 def _run_limited(thunk: Callable[[], Any], operation: str) -> Any:
     """Run `thunk` under a trace-event budget: an infinite loop becomes a
     ScriptError instead of a stuck controller."""

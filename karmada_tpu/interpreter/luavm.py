@@ -57,9 +57,10 @@ _KEYWORDS = {
 _TOKEN_RE = re.compile(
     r"""
     (?P<ws>\s+)
-  | (?P<comment>--\[\[.*?\]\]|--[^\n]*)
+  | (?P<comment>--\[(?P<_cl>=*)\[.*?\](?P=_cl)\]|--[^\n]*)
   | (?P<number>0[xX][0-9a-fA-F]+|\d+\.?\d*(?:[eE][+-]?\d+)?|\.\d+)
   | (?P<name>[A-Za-z_][A-Za-z0-9_]*)
+  | (?P<longstring>\[(?P<_ll>=*)\[(?P<_lsbody>.*?)\](?P=_ll)\])
   | (?P<string>"(?:\\.|[^"\\])*"|'(?:\\.|[^'\\])*')
   | (?P<op>\.\.\.|\.\.|==|~=|<=|>=|[-+*/%^#<>=(){}\[\];:,.])
     """,
@@ -100,8 +101,20 @@ def tokenize(src: str) -> list[tuple[str, Any, int]]:
         if m is None:
             raise LuaError(f"unexpected character {src[pos]!r} at line {line}")
         text = m.group(0)
-        if m.lastgroup == "ws" or m.lastgroup == "comment":
+        # the level-capture backrefs (_cl/_ll/_lsbody) shadow m.lastgroup,
+        # so test the bracketed alternatives by group before dispatching
+        if m.group("ws") is not None or m.group("comment") is not None:
             pass
+        elif m.group("longstring") is not None:
+            # Lua 5.1 long strings: no escapes; a leading end-of-line
+            # sequence right after the opening bracket is dropped (the
+            # lexer skips \r\n / \n\r / \r / \n alike)
+            body = m.group("_lsbody")
+            for eol in ("\r\n", "\n\r", "\r", "\n"):
+                if body.startswith(eol):
+                    body = body[len(eol):]
+                    break
+            toks.append(("string", body, line))
         elif m.lastgroup == "number":
             if text.lower().startswith("0x"):
                 val: Any = int(text, 16)
@@ -974,9 +987,9 @@ def _tostr_concat(v) -> str:
 def _numstr(v) -> str:
     if isinstance(v, int):
         return str(v)
-    if float(v).is_integer():
-        return str(v)  # Lua prints 2.0 as "2.0"
-    return repr(v)
+    # gopher-lua (Lua 5.1) formats numbers with %.14g: tostring(4/2) is
+    # "2", not Python's "2.0" (LUAI_NUMFFORMAT semantics)
+    return "%.14g" % float(v)
 
 
 def _lua_tonumber(v, base=None):

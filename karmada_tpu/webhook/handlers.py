@@ -255,7 +255,9 @@ def _validate_interpreter_customization(req: AdmissionRequest) -> None:
     if not ric.spec.target.api_version or not ric.spec.target.kind:
         raise AdmissionDenied(req.kind, f"{ric.metadata.name}: target apiVersion/kind must be set")
     from ..interpreter import luavm
-    from ..interpreter.declarative import OPERATION_FUNCTIONS, ScriptError, compile_script
+    from ..interpreter.declarative import (
+        OPERATION_FUNCTIONS, ScriptError, compile_rule_script,
+    )
 
     any_script = False
     for op in OPERATION_FUNCTIONS:
@@ -265,12 +267,9 @@ def _validate_interpreter_customization(req: AdmissionRequest) -> None:
         any_script = True
         try:
             # scripts must compile in the sandbox (the reference's webhook
-            # runs the Lua compile check at admission time); Lua and the
-            # native dialect are sniffed per rule like the declarative tier
-            if luavm.looks_like_lua(rule.script):
-                luavm.compile_lua_script(rule.script, op)
-            else:
-                compile_script(rule.script, op)
+            # runs the Lua compile check at admission time); the sniff only
+            # orders the compilers — either language is accepted
+            compile_rule_script(rule.script, op)
         except (ScriptError, luavm.LuaError) as e:
             raise AdmissionDenied(req.kind, f"{ric.metadata.name}: {op}: {e}") from e
     if not any_script:

@@ -1,0 +1,103 @@
+"""Capture a full TPU benchmark artifact and persist it into the repo.
+
+Run by scripts/tpu_watch.sh the moment the TPU tunnel probe succeeds.
+Produces BENCH_tpu_latest.json at the repo root — the durable, committed
+record the round docs cite (VERDICT r4 weak #3: the watcher must leave
+something in-tree, not /tmp droppings).
+
+Contents: one entry per bench config (all 8), plus the 2x/4x flagship
+headroom points, each entry the parsed JSON line bench.py printed.
+The commit is attempted with retries so it can interleave with the
+builder's own commits; if the commit loses every race the file still
+lands in the working tree and the round-end driver sweep commits it.
+"""
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+OUT = os.path.join(REPO, "BENCH_tpu_latest.json")
+
+
+def run_bench(extra_args: list[str], timeout_s: float) -> dict:
+    """Run bench.py --require-tpu with the given args; parse its JSON lines."""
+    argv = [sys.executable, os.path.join(REPO, "bench.py"),
+            "--require-tpu", "--verbose"] + extra_args
+    t0 = time.time()
+    try:
+        r = subprocess.run(argv, timeout=timeout_s, capture_output=True,
+                           text=True, cwd=REPO)
+    except subprocess.TimeoutExpired:
+        return {"cmd": " ".join(extra_args), "error": f"timeout {timeout_s}s"}
+    lines = []
+    for line in (r.stdout or "").splitlines():
+        line = line.strip()
+        if line.startswith("{"):
+            try:
+                lines.append(json.loads(line))
+            except json.JSONDecodeError:
+                pass
+    comments = [l for l in (r.stdout or "").splitlines()
+                if l.startswith("#")]
+    return {
+        "cmd": " ".join(extra_args), "rc": r.returncode,
+        "wall_s": round(time.time() - t0, 1),
+        "results": lines, "detail": comments,
+        **({} if r.returncode == 0 else
+           {"stderr_tail": (r.stderr or "").strip().splitlines()[-3:]}),
+    }
+
+
+def main() -> None:
+    captured_at = time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime())
+    artifact = {
+        "captured_at": captured_at,
+        "note": "driver-independent TPU capture by scripts/tpu_watch.sh; "
+                "every p99 is end-to-end ArrayScheduler.schedule() "
+                "(host encode + device solve + decode)",
+        "runs": [],
+    }
+    # full default suite: all 8 configs at BASELINE shapes
+    artifact["runs"].append(run_bench(["--run-timeout", "2300"], 2400))
+    # headroom ladder: 2x and 4x the flagship shape (VERDICT r4 next #1)
+    artifact["runs"].append(run_bench(
+        ["--configs", "flagship", "--bindings", "20000",
+         "--clusters", "10000", "--iters", "5", "--run-timeout", "1200"],
+        1300))
+    artifact["runs"].append(run_bench(
+        ["--configs", "flagship", "--bindings", "40000",
+         "--clusters", "20000", "--iters", "3", "--run-timeout", "1500"],
+        1600))
+
+    ok = any(r.get("rc") == 0 for r in artifact["runs"])
+    if not ok:
+        # leave no artifact and exit nonzero: the watcher keeps polling
+        # without a junk commit per failed attempt
+        print("no run succeeded; not writing/committing an artifact")
+        sys.exit(1)
+
+    with open(OUT, "w") as f:
+        json.dump(artifact, f, indent=2)
+        f.write("\n")
+    print(f"wrote {OUT}")
+
+    msg = "Capture TPU bench artifact (all configs + headroom ladder)"
+    for _ in range(20):  # ride out index.lock races with the builder
+        subprocess.run(["git", "add", "BENCH_tpu_latest.json"],
+                       cwd=REPO, capture_output=True)
+        c = subprocess.run(["git", "commit", "-m", msg, "--only",
+                            "BENCH_tpu_latest.json"],
+                           cwd=REPO, capture_output=True, text=True)
+        if c.returncode == 0 or "nothing to commit" in (c.stdout + c.stderr):
+            print("committed")
+            return
+        time.sleep(15)
+    print("commit never landed; file left in working tree for the sweep")
+
+
+if __name__ == "__main__":
+    main()

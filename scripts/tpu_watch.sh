@@ -1,22 +1,24 @@
 #!/bin/bash
-# Wait for the TPU tunnel to come back, then run the round's TPU
-# measurements: the skewed-spread profile and the full bench.
+# Wait for the TPU tunnel to come back, then capture the round's TPU
+# measurements DURABLY: scripts/tpu_capture.py writes BENCH_tpu_latest.json
+# at the repo root and commits it (VERDICT r4 weak #3 — the watcher must
+# persist its capture in-tree, not in /tmp).
 cd /root/repo
 LOG=/tmp/tpu_watch.log
 echo "[watch] started $(date)" >> "$LOG"
-for i in $(seq 1 200); do
+for i in $(seq 1 330); do
   if timeout 90 python -c "import jax; jax.devices()" >/dev/null 2>&1; then
     echo "[watch] tunnel UP at $(date) (attempt $i)" >> "$LOG"
-    echo "[watch] running skewed profile..." >> "$LOG"
-    timeout 1500 python scripts/profile_spread_skewed.py --iters 6 \
-      >> "$LOG" 2>&1
-    echo "[watch] running full bench..." >> "$LOG"
-    timeout 2400 python bench.py --verbose --run-timeout 2300 \
-      > /tmp/bench_tpu.out 2> /tmp/bench_tpu.err
-    echo "[watch] bench rc=$? done $(date)" >> "$LOG"
-    exit 0
+    timeout 6000 python scripts/tpu_capture.py >> "$LOG" 2>&1
+    rc=$?
+    echo "[watch] capture rc=$rc done $(date)" >> "$LOG"
+    if [ $rc -eq 0 ]; then
+      exit 0
+    fi
+    echo "[watch] capture incomplete; continuing to poll" >> "$LOG"
+  else
+    echo "[watch] attempt $i: tunnel down $(date)" >> "$LOG"
   fi
-  echo "[watch] attempt $i: tunnel down $(date)" >> "$LOG"
   sleep 120
 done
 echo "[watch] gave up $(date)" >> "$LOG"

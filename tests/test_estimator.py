@@ -128,6 +128,41 @@ class TestBatchedGrpcContract:
         finally:
             server.stop()
 
+    def test_batch_isolates_a_raising_estimator(self):
+        pytest.importorskip("grpc")
+        from karmada_tpu.estimator.service import (
+            EstimatorServer,
+            GrpcSchedulerEstimator,
+        )
+
+        class Broken:
+            # healthy through the server's start-time warmup call, then the
+            # informer cache 'poisons' and every estimate raises
+            warmed = False
+
+            def max_available_replicas(self, requirements):
+                if not self.warmed:
+                    self.warmed = True
+                    return 1
+                raise RuntimeError("informer cache poisoned")
+
+        server = EstimatorServer({"ok": AccurateEstimator(nodes_small()),
+                                  "broken": Broken()})
+        port = server.start()
+        try:
+            client = GrpcSchedulerEstimator(lambda c: f"127.0.0.1:{port}")
+            out = client.batch_max_available_replicas(
+                ["ok", "broken"],
+                [ReplicaRequirements(resource_request={CPU: 1.0})],
+            )
+            # one estimator raising mid-batch degrades ITS column to the -1
+            # sentinel; the healthy cluster's answer still lands (the
+            # singular path's per-cluster degradation, kept on the batch RPC)
+            assert out[0, 0] > 0
+            assert out[0, 1] == UNAUTHENTIC_REPLICA
+        finally:
+            server.stop()
+
 
 class TestSchedulerIntegration:
     def make_plane(self):

@@ -595,6 +595,58 @@ class TestCustomizationLanguageRouting:
         assert ki.interpret_health(o) == HEALTHY
 
 
+class TestMisroutedScriptFallback:
+    """compile_rule_script: the sniff orders the compilers; it cannot deny a
+    valid script of either language (ADVICE r4 luavm.py:1679)."""
+
+    def test_lua_script_with_def_in_string_still_compiles_as_lua(self):
+        from karmada_tpu.interpreter.declarative import compile_rule_script
+
+        # line-anchored "def foo(" inside a Lua string used to route this
+        # to the native compiler, which then denied the valid Lua
+        src = ("function InterpretHealth(obj)\n"
+               "  local doc = [[\n"
+               "def foo(:\n"
+               "]]\n"
+               "  return obj.status.ready == true\n"
+               "end")
+        fn, lang = compile_rule_script(src, "health_interpretation")
+        assert lang == "lua"
+        assert fn({"status": {"ready": True}}) is True
+
+    def test_native_script_sniffed_as_lua_falls_back(self):
+        from karmada_tpu.interpreter.declarative import compile_rule_script
+
+        # "local " in a Python comment trips the Lua sniff; the native
+        # compiler must still get its shot
+        src = ("# keep local state out of this\n"
+               "def InterpretHealth(obj):\n"
+               "    return obj['status']['ready'] is True")
+        fn, lang = compile_rule_script(src, "health_interpretation")
+        assert lang == "native"
+
+    def test_invalid_script_fails_with_sniffed_language_error(self):
+        import pytest
+
+        from karmada_tpu.interpreter.declarative import (
+            ScriptError, compile_rule_script,
+        )
+        from karmada_tpu.interpreter.luavm import LuaError
+
+        with pytest.raises(LuaError):
+            compile_rule_script("function F( syntax oops", "health_interpretation")
+        with pytest.raises(ScriptError):
+            compile_rule_script("def InterpretHealth(:", "health_interpretation")
+
+    def test_integral_float_tostring_matches_gopher_lua(self):
+        from karmada_tpu.interpreter.luavm import LuaVM
+
+        # Lua 5.1 %.14g: division always yields float, but tostring(4/2)
+        # prints "2" (gopher-lua), not Python's "2.0"
+        vm = LuaVM("function F() return tostring(4/2) .. '|' .. (7/2) end")
+        assert vm.function("F")() == ["2|3.5"]
+
+
 @pytestmark_ref
 class TestReferenceLuaNativeParityBroad:
     """Output parity between the reference's shipped Lua (executed by the
