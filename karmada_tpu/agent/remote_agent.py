@@ -1,0 +1,99 @@
+"""Pull-mode agent connected over the network (agent.go:73,135).
+
+The reference agent is a separate binary given a karmada-apiserver
+kubeconfig: it registers its Cluster, watches its execution namespace for
+Work, applies manifests to the member it sits in, reflects status, and
+heartbeats a Lease. `RemoteAgentSession` is that binary's body for the TPU
+build: everything crosses the serving seam via `RemoteStore` — the control
+plane never holds an in-process handle to this member.
+
+    session = RemoteAgentSession("http://127.0.0.1:7443", MemberConfig(
+        name="edge-1", sync_mode="Pull", allocatable={...}))
+    session.register()          # Cluster object + first heartbeat
+    ...
+    session.step()              # drain delivered Works (or .run() to loop)
+"""
+from __future__ import annotations
+
+import threading
+from typing import Optional
+
+from ..interpreter.interpreter import ResourceInterpreter
+from ..members.member import InMemoryMember, MemberConfig, cluster_object_for
+from ..runtime.controller import Runtime
+from ..server.remote import RemoteStore
+from .agent import KarmadaAgent
+
+
+class RemoteAgentSession:
+    def __init__(self, url: str, config: MemberConfig,
+                 member: Optional[InMemoryMember] = None):
+        if config.sync_mode != "Pull":
+            raise ValueError("remote agents serve Pull clusters")
+        self.config = config
+        self.store = RemoteStore(url)
+        self.member = member or InMemoryMember(config)
+        self.runtime = Runtime()
+        interpreter = ResourceInterpreter()
+        interpreter.load_thirdparty()
+        self.agent = KarmadaAgent(self.store, self.member, interpreter, self.runtime)
+        # the agent's own workStatus controller (agent.go:248-433 runs
+        # execution + workStatus + clusterStatus member-side): reflect this
+        # member's object status into work.status over the wire
+        from ..controllers.status import WorkStatusController
+
+        self.work_status = WorkStatusController(
+            self.store, {config.name: self.member}, interpreter, self.runtime
+        )
+        self.work_status.watch_member(self.member)
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def register(self) -> None:
+        """generateClusterInControllerPlane (agent.go:437): create-or-refresh
+        the Cluster object and heartbeat once so the lease is live before
+        the scheduler can consider the cluster."""
+        fresh = cluster_object_for(self.config)
+        existing = self.store.try_get("Cluster", self.config.name)
+        if existing is None:
+            self.store.create(fresh)
+        else:
+            # restart with changed config: refresh what this agent owns
+            # (spec identity + reported capacity) without clobbering
+            # control-plane-written state (taints, conditions, remedies)
+            existing.spec.sync_mode = fresh.spec.sync_mode
+            existing.spec.provider = fresh.spec.provider
+            existing.spec.region = fresh.spec.region
+            existing.spec.zone = fresh.spec.zone
+            existing.metadata.labels.update(fresh.metadata.labels)
+            existing.status.resource_summary = fresh.status.resource_summary
+            self.store.update(existing)
+        self.agent.heartbeat()
+
+    def step(self) -> int:
+        """Drain Works the watch stream delivered; heartbeat the lease."""
+        steps = self.runtime.settle()
+        self.agent.heartbeat()
+        return steps
+
+    def run(self, interval: float = 1.0) -> None:
+        """Background loop: step() every `interval` seconds."""
+        def loop() -> None:
+            while not self._stop.wait(interval):
+                try:
+                    self.step()
+                except Exception:  # noqa: BLE001 - agent must keep serving
+                    import logging
+
+                    logging.getLogger(__name__).exception("agent step")
+
+        self._thread = threading.Thread(
+            target=loop, name=f"agent-{self.config.name}", daemon=True
+        )
+        self._thread.start()
+
+    def close(self) -> None:
+        self._stop.set()
+        self.store.close()
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)

@@ -93,6 +93,15 @@ class APIEnablement:
     resources: list[str] = field(default_factory=list)  # Kind names
 
 
+# the API surface every simulated member advertises (status collector's
+# APIEnablements probe; consumed by the APIEnablement filter plugin)
+DEFAULT_API_ENABLEMENTS = [
+    APIEnablement(group_version="apps/v1", resources=["Deployment", "StatefulSet"]),
+    APIEnablement(group_version="v1", resources=["ConfigMap", "Secret", "Service"]),
+    APIEnablement(group_version="batch/v1", resources=["Job"]),
+]
+
+
 @dataclass
 class ClusterSpec:
     sync_mode: str = SYNC_MODE_PUSH

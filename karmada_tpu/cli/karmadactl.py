@@ -46,8 +46,13 @@ from ..api.policy import (
     ResourceSelector,
 )
 from ..api.unstructured import Unstructured
-from ..controlplane import ControlPlane
 from ..members.member import MemberConfig
+from typing import TYPE_CHECKING
+
+if TYPE_CHECKING:  # the remote CLI path must stay JAX-free: a karmadactl
+    # --server process imports no device code (ControlPlane pulls in the
+    # scheduler's jax modules, whose backend init needs the TPU tunnel)
+    from ..controlplane import ControlPlane
 
 CORDON_TAINT_KEY = "cluster.karmada.io/cordoned"  # pkg/karmadactl/cordon
 
@@ -162,11 +167,60 @@ class Management:
         return self.operator.plane(name)
 
 
+DAEMON_UNIT_TEMPLATE = """\
+[Unit]
+Description=karmada-tpu control plane ({name})
+After=network.target
+
+[Service]
+ExecStart={python} -m karmada_tpu.server --host {host} --port {port} --tick-interval 2
+Restart=on-failure
+WorkingDirectory={workdir}
+
+[Install]
+WantedBy=multi-user.target
+"""
+
+DAEMON_SCRIPT_TEMPLATE = """\
+#!/bin/sh
+# Launch the {name} control-plane daemon (emitted by `karmadactl init`).
+# karmadactl talks to it with:  karmadactl --server http://{host}:{port} ...
+exec {python} -m karmada_tpu.server --host {host} --port {port} --tick-interval 2 "$@"
+"""
+
+
+def emit_daemon_artifacts(out_dir: str, name: str = "karmada",
+                          host: str = "127.0.0.1", port: int = 7443) -> list[str]:
+    """Write the runnable launch artifacts for a control-plane daemon: a
+    shell launcher and a systemd unit (the role of the manifests cmdinit
+    renders into the host cluster). Returns the written paths."""
+    import os
+    import stat
+    import sys
+
+    os.makedirs(out_dir, exist_ok=True)
+    subs = {
+        "name": name, "host": host, "port": port,
+        "python": sys.executable, "workdir": os.getcwd(),
+    }
+    script = os.path.join(out_dir, f"{name}-daemon.sh")
+    with open(script, "w") as f:
+        f.write(DAEMON_SCRIPT_TEMPLATE.format(**subs))
+    os.chmod(script, os.stat(script).st_mode | stat.S_IXUSR | stat.S_IXGRP)
+    unit = os.path.join(out_dir, f"{name}-daemon.service")
+    with open(unit, "w") as f:
+        f.write(DAEMON_UNIT_TEMPLATE.format(**subs))
+    return [script, unit]
+
+
 def cmd_init(mgmt: Management, name: str = "karmada",
              components: Optional[list[str]] = None,
-             feature_gates: Optional[dict[str, bool]] = None) -> str:
+             feature_gates: Optional[dict[str, bool]] = None,
+             emit_dir: Optional[str] = None) -> str:
     """karmadactl init: run the install workflow and leave a live plane
-    behind (cmdinit's phases: validate → control plane → components)."""
+    behind (cmdinit's phases: validate → control plane → components).
+    With emit_dir, also write launchable daemon artifacts so the installed
+    plane can be served out-of-process (python -m karmada_tpu.server)."""
     from ..api.meta import ObjectMeta
     from ..operator.operator import (
         DEFAULT_COMPONENTS,
@@ -190,12 +244,16 @@ def cmd_init(mgmt: Management, name: str = "karmada",
         inst = mgmt.store.get("KarmadaInstance", name)
         raise CLIError(f"init failed (phase {inst.status.phase})")
     token = plane.bootstrap_tokens.create(description="init bootstrap")
-    return (
+    msg = (
         f"control plane {name} installed\n"
         f"register command:\n"
         f"  karmadactl register <endpoint> --token {token.token} "
         f"--discovery-token-ca-cert-hash {plane.pki.cert_hash()}"
     )
+    if emit_dir:
+        paths = emit_daemon_artifacts(emit_dir, name)
+        msg += "\ndaemon artifacts:\n" + "\n".join(f"  {p}" for p in paths)
+    return msg
 
 
 def cmd_deinit(mgmt: Management, name: str = "karmada") -> str:
@@ -1140,15 +1198,44 @@ def run(cp: ControlPlane, argv: list[str]) -> str:
 
 
 def main(argv: Optional[list[str]] = None) -> int:
+    import os
     import sys
 
     from ..store.store import ConflictError, NotFoundError
     from ..webhook import AdmissionDenied
 
-    cp = ControlPlane()
+    argv = list(argv if argv is not None else sys.argv[1:])
+
+    # --server URL (or KARMADA_SERVER): run out-of-process against a live
+    # daemon (python -m karmada_tpu.server), like the reference CLI speaking
+    # REST to the karmada-apiserver. Peeled before subcommand parsing so it
+    # works in any position.
+    server_url = os.environ.get("KARMADA_SERVER", "")
+    for i, a in enumerate(argv):
+        if a == "--server" and i + 1 < len(argv):
+            server_url = argv[i + 1]
+            del argv[i:i + 2]
+            break
+        if a.startswith("--server="):
+            server_url = a.partition("=")[2]
+            del argv[i]
+            break
+
+    if server_url:
+        from ..server.remote import RemoteControlPlane, RemoteError
+
+        cp = RemoteControlPlane(server_url)
+        errors = (CLIError, AdmissionDenied, ConflictError, NotFoundError,
+                  RemoteError, AttributeError)  # AttributeError = verb needs
+        # daemon-side state the remote facade doesn't expose
+    else:
+        from ..controlplane import ControlPlane
+
+        cp = ControlPlane()
+        errors = (CLIError, AdmissionDenied, ConflictError, NotFoundError)
     try:
-        print(run(cp, argv if argv is not None else sys.argv[1:]))
-    except (CLIError, AdmissionDenied, ConflictError, NotFoundError) as e:
+        print(run(cp, argv))
+    except errors as e:
         print(f"error: {e}", file=sys.stderr)
         return 1
     return 0

@@ -11,16 +11,8 @@ from __future__ import annotations
 
 from typing import Optional
 
-from .api.cluster import (
-    APIEnablement,
-    Cluster,
-    ClusterSpec,
-    ClusterStatus,
-    NodeSummary,
-    ResourceSummary,
-    CLUSTER_CONDITION_READY,
-)
-from .api.meta import Condition, ObjectMeta, set_condition
+from .api.cluster import CLUSTER_CONDITION_READY
+from .api.meta import Condition, set_condition
 from .controllers.autoscaling import (
     CronFederatedHPAController,
     DeploymentReplicasSyncer,
@@ -66,7 +58,7 @@ from .interpreter.customized import (
 from .interpreter.interpreter import ResourceInterpreter
 from .agent import KarmadaAgent
 from .agent.agent import LeaseFailureDetector, REASON_LEASE_EXPIRED
-from .members.member import InMemoryMember, MemberConfig
+from .members.member import InMemoryMember, MemberConfig, cluster_object_for
 from .auth import (
     AGENT_ORGANIZATION,
     BootstrapTokens,
@@ -78,18 +70,16 @@ from .controllers.certificate import CertRotationController
 from .controllers.condition_cache import ClusterConditionCache
 from .metricsadapter import MetricsAdapter
 from .proxy import ClusterProxy
-from .modeling import GradeHistogram, ModelBasedEstimator, default_resource_models
+from .modeling import ModelBasedEstimator
 from .runtime.controller import Clock, Runtime
 from .sched.scheduler import SchedulerDaemon
 from .search import ResourceCache, SearchProxy
 from .store.store import Store
 from .webhook import default_admission_chain
 
-DEFAULT_API_ENABLEMENTS = [
-    APIEnablement(group_version="apps/v1", resources=["Deployment", "StatefulSet"]),
-    APIEnablement(group_version="v1", resources=["ConfigMap", "Secret", "Service"]),
-    APIEnablement(group_version="batch/v1", resources=["Job"]),
-]
+# re-exported from the cluster API (shared with the remote agent's
+# self-registration path)
+from .api.cluster import DEFAULT_API_ENABLEMENTS  # noqa: E402,F401
 
 # the --controllers surface (cmd/controller-manager): names mirror the
 # reference's registration map (controllermanager.go:222-248); two are off
@@ -361,47 +351,11 @@ class ControlPlane:
         self.members[config.name] = member
         if member.node_estimator is not None:
             member.node_estimator.clock = self.runtime.clock
-        if config.nodes and not config.allocatable:
-            # derive the ResourceSummary from node capacity (status collector
-            # NodeSummary/ResourceSummary path, cluster_status_controller.go:544-679)
-            alloc: dict[str, float] = {}
-            for n in config.nodes:
-                for k, v in n.allocatable.items():
-                    alloc[k] = alloc.get(k, 0.0) + v
-            alloc.setdefault("pods", float(sum(n.allowed_pods for n in config.nodes)))
-            config.allocatable = alloc
-        # node-histogram resource modeling (EST6): default grades + counts
-        # from node capacity (cluster_status_controller.go:282,671)
-        resource_models = []
-        modelings = []
-        if config.nodes and self.gates.enabled(CUSTOMIZED_CLUSTER_RESOURCE_MODELING):
-            resource_models = default_resource_models()
-            hist = GradeHistogram(resource_models)
-            hist.add_nodes([dict(n.allocatable) for n in config.nodes])
-            modelings = hist.to_allocatable_modelings()
-        cluster = Cluster(
-            metadata=ObjectMeta(name=config.name, labels=dict(config.labels)),
-            spec=ClusterSpec(
-                sync_mode=config.sync_mode,
-                provider=config.provider,
-                region=config.region,
-                zone=config.zone,
-                resource_models=resource_models,
-            ),
-            status=ClusterStatus(
-                kubernetes_version="v1.30.0",
-                api_enablements=list(DEFAULT_API_ENABLEMENTS),
-                node_summary=NodeSummary(total_num=10, ready_num=10),
-                resource_summary=ResourceSummary(
-                    allocatable=dict(config.allocatable),
-                    allocated=dict(config.allocated),
-                    allocatable_modelings=modelings,
-                ),
-            ),
-        )
-        set_condition(
-            cluster.status.conditions,
-            Condition(type=CLUSTER_CONDITION_READY, status="True", reason="ClusterReady"),
+        # node-histogram resource modeling (EST6) gated by
+        # CustomizedClusterResourceModeling (cluster_status_controller.go:282,671)
+        cluster = cluster_object_for(
+            config,
+            modeling=self.gates.enabled(CUSTOMIZED_CLUSTER_RESOURCE_MODELING),
         )
         # registration IS the first Ready observation: seed the flap-
         # suppression cache so a later one-shot NotReady probe is retained

@@ -196,3 +196,68 @@ class InMemoryMember:
             for obj in self.store.list(kind):
                 if isinstance(obj, Unstructured):
                     self._run_controllers(obj)
+
+
+def cluster_object_for(config: MemberConfig, *, modeling: bool = False):
+    """Build the Cluster API object a joining member reports: health, API
+    enablements, node/resource summaries, optional grade-histogram resource
+    models (syncClusterStatus in one step, cluster_status_controller.go:
+    181,544-679). Shared by ControlPlane.join_member (push/local pull) and
+    the remote pull agent's self-registration (agent.go:437
+    generateClusterInControllerPlane)."""
+    from ..api.cluster import (
+        CLUSTER_CONDITION_READY,
+        Cluster,
+        ClusterSpec,
+        ClusterStatus,
+        DEFAULT_API_ENABLEMENTS,
+        NodeSummary,
+        ResourceSummary,
+    )
+    from ..api.meta import Condition, ObjectMeta, set_condition
+
+    if config.nodes and not config.allocatable:
+        # derive the ResourceSummary from node capacity (the status
+        # collector's NodeSummary/ResourceSummary path)
+        alloc: dict[str, float] = {}
+        for n in config.nodes:
+            for k, v in n.allocatable.items():
+                alloc[k] = alloc.get(k, 0.0) + v
+        alloc.setdefault("pods", float(sum(n.allowed_pods for n in config.nodes)))
+        config.allocatable = alloc
+
+    resource_models = []
+    modelings = []
+    if config.nodes and modeling:
+        from ..modeling.modeling import GradeHistogram, default_resource_models
+
+        resource_models = default_resource_models()
+        hist = GradeHistogram(resource_models)
+        hist.add_nodes([dict(n.allocatable) for n in config.nodes])
+        modelings = hist.to_allocatable_modelings()
+
+    cluster = Cluster(
+        metadata=ObjectMeta(name=config.name, labels=dict(config.labels)),
+        spec=ClusterSpec(
+            sync_mode=config.sync_mode,
+            provider=config.provider,
+            region=config.region,
+            zone=config.zone,
+            resource_models=resource_models,
+        ),
+        status=ClusterStatus(
+            kubernetes_version="v1.30.0",
+            api_enablements=list(DEFAULT_API_ENABLEMENTS),
+            node_summary=NodeSummary(total_num=10, ready_num=10),
+            resource_summary=ResourceSummary(
+                allocatable=dict(config.allocatable),
+                allocated=dict(config.allocated),
+                allocatable_modelings=modelings,
+            ),
+        ),
+    )
+    set_condition(
+        cluster.status.conditions,
+        Condition(type=CLUSTER_CONDITION_READY, status="True", reason="ClusterReady"),
+    )
+    return cluster

@@ -1,0 +1,14 @@
+"""Out-of-process control-plane serving (SURVEY L1's network boundary).
+
+The reference's L1 is a stock kube-apiserver: karmadactl speaks REST to it
+(client-go throughout pkg/karmadactl/) and pull agents connect over the
+network (cmd/agent/app/agent.go:73,135). This package provides the same
+boundary for the TPU build: `apiserver.ControlPlaneServer` serves a
+ControlPlane's store over HTTP REST + streaming watch, `remote.RemoteStore`
+/ `remote.RemoteControlPlane` are the client transports, and
+`python -m karmada_tpu.server` is the daemon entry point.
+"""
+from .apiserver import ControlPlaneServer
+from .remote import RemoteControlPlane, RemoteStore
+
+__all__ = ["ControlPlaneServer", "RemoteControlPlane", "RemoteStore"]

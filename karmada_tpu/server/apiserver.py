@@ -1,0 +1,340 @@
+"""HTTP REST + watch serving over a ControlPlane (the L1 network boundary).
+
+Routes (all JSON; objects wire-encoded by server/codec.py):
+
+| method+path          | store call                | notes                      |
+|----------------------|---------------------------|----------------------------|
+| GET  /healthz        | —                         | liveness                   |
+| GET  /kinds          | store.kinds()             |                            |
+| GET  /objects        | get / list                | ?kind=&namespace=[&name=]  |
+| POST /objects        | create                    | body {"obj": enc}          |
+| PUT  /objects        | update                    | body {"obj": enc, "check_rv"} |
+| POST /apply          | apply                     | body {"obj": enc}          |
+| DELETE /objects      | delete                    | ?kind=&name=[&namespace=]  |
+| GET  /watch          | watch / watch_all         | ?kind= (or *) [&replay=]   |
+|                      |                           | streams JSON lines         |
+| POST /settle         | cp.settle()               | drain controllers, blocking|
+| POST /tick           | cp.tick(seconds)          | fire timer loops           |
+| GET  /members        | cp.members keys           |                            |
+| GET  /member/objects | member.objects()          | ?cluster= — the aggregated |
+|                      |                           | cluster-proxy view (U9)    |
+| POST /join           | cp.join_member            | body {"config": enc}       |
+| POST /unjoin         | cp.unjoin_member          | body {"name": ...}         |
+| POST /agent/cert     | cp.sign_agent_cert        | register CSR flow          |
+
+Error mapping: NotFound→404, Conflict→409, admission denial→422, anything
+else→500; bodies are {"error": "..."}. The reference secures this boundary
+with TLS + RBAC on the kube-apiserver; here the daemon binds loopback by
+default and multi-host deployments are expected to front it with the same
+mTLS material `auth/pki.py` already issues for the estimator seam.
+
+Concurrency model: store CRUD is thread-safe (store.py's RLock), so request
+handlers hit it directly. Controller queues drain on a single reconcile
+thread (`_reconcile_loop`) woken by a store-wide watch — `Runtime.settle`
+is never run from two threads.
+"""
+from __future__ import annotations
+
+import json
+import queue
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any, Optional
+from urllib.parse import parse_qs, urlparse
+
+from ..store.store import ConflictError, NotFoundError
+from ..webhook.handlers import AdmissionDenied
+from . import codec
+
+_WATCH_END = object()
+
+
+class ControlPlaneServer:
+    def __init__(self, cp, host: str = "127.0.0.1", port: int = 0):
+        self.cp = cp
+        self._host = host
+        self._port = port
+        self._httpd: Optional[ThreadingHTTPServer] = None
+        self._threads: list[threading.Thread] = []
+        self._dirty = threading.Event()
+        self._quiesced = threading.Condition()
+        self._settle_lock = threading.Lock()  # one settle/tick at a time
+        self._stopping = False
+
+    # -- lifecycle --------------------------------------------------------
+
+    def start(self) -> int:
+        """Bind, start the serving + reconcile threads, return the port."""
+        server = self
+
+        class Handler(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, fmt, *args):  # quiet by default
+                pass
+
+            def do_GET(self):
+                server._route(self, "GET")
+
+            def do_POST(self):
+                server._route(self, "POST")
+
+            def do_PUT(self):
+                server._route(self, "PUT")
+
+            def do_DELETE(self):
+                server._route(self, "DELETE")
+
+        self._httpd = ThreadingHTTPServer((self._host, self._port), Handler)
+        self._httpd.daemon_threads = True
+        self._port = self._httpd.server_address[1]
+        self.cp.store.watch_all(self._mark_dirty, replay=False)
+        for target, name in ((self._httpd.serve_forever, "serve"),
+                             (self._reconcile_loop, "reconcile")):
+            t = threading.Thread(
+                target=target, name=f"cp-server-{name}", daemon=True
+            )
+            t.start()
+            self._threads.append(t)
+        return self._port
+
+    def stop(self) -> None:
+        self._stopping = True
+        self.cp.store.unwatch_all(self._mark_dirty)
+        self._dirty.set()
+        if self._httpd is not None:
+            self._httpd.shutdown()
+            self._httpd.server_close()
+
+    @property
+    def url(self) -> str:
+        return f"http://{self._host}:{self._port}"
+
+    # -- reconcile thread -------------------------------------------------
+
+    def _mark_dirty(self, kind: str, event: str, obj: Any) -> None:
+        self._dirty.set()
+
+    def _reconcile_loop(self) -> None:
+        while not self._stopping:
+            if not self._dirty.wait(timeout=0.2):
+                continue  # idle: no settle churn, no lock contention
+            if self._stopping:
+                return
+            self._dirty.clear()
+            try:
+                with self._settle_lock:
+                    self.cp.settle()
+            except Exception:  # noqa: BLE001 - keep the loop alive
+                import logging
+
+                logging.getLogger(__name__).exception("reconcile loop")
+            with self._quiesced:
+                self._quiesced.notify_all()
+
+    def _settle_blocking(self, timeout: float = 30.0) -> None:
+        """Wake the reconcile thread and wait until a settle pass ran with
+        no further dirtying (the CLI's post-mutation convergence point)."""
+        import time
+
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            self._dirty.set()
+            with self._quiesced:
+                self._quiesced.wait(timeout=0.5)
+            if not self._dirty.is_set():
+                return
+
+    # -- routing ----------------------------------------------------------
+
+    def _route(self, h: BaseHTTPRequestHandler, method: str) -> None:
+        parsed = urlparse(h.path)
+        q = {k: v[0] for k, v in parse_qs(parsed.query).items()}
+        try:
+            fn = getattr(self, f"_h_{method}_{parsed.path.strip('/').replace('/', '_')}", None)
+            if fn is None:
+                self._send(h, 404, {"error": f"no route {method} {parsed.path}"})
+                return
+            fn(h, q)
+        except NotFoundError as e:
+            self._send(h, 404, {"error": str(e)})
+        except ConflictError as e:
+            self._send(h, 409, {"error": str(e)})
+        except AdmissionDenied as e:
+            self._send(h, 422, {"error": str(e)})
+        except BrokenPipeError:
+            pass
+        except Exception as e:  # noqa: BLE001 - wire boundary
+            self._send(h, 500, {"error": f"{type(e).__name__}: {e}"})
+
+    @staticmethod
+    def _send(h, status: int, body: dict) -> None:
+        try:
+            data = json.dumps(body).encode()
+            h.send_response(status)
+            h.send_header("Content-Type", "application/json")
+            h.send_header("Content-Length", str(len(data)))
+            h.end_headers()
+            h.wfile.write(data)
+        except (BrokenPipeError, ConnectionResetError):
+            pass
+
+    @staticmethod
+    def _body(h) -> dict:
+        n = int(h.headers.get("Content-Length") or 0)
+        if n == 0:
+            return {}
+        return json.loads(h.rfile.read(n).decode())
+
+    # -- handlers ---------------------------------------------------------
+
+    def _h_GET_healthz(self, h, q):
+        self._send(h, 200, {"ok": True})
+
+    def _h_GET_kinds(self, h, q):
+        self._send(h, 200, {"kinds": self.cp.store.kinds()})
+
+    def _h_GET_objects(self, h, q):
+        kind = q.get("kind", "")
+        if not kind:
+            self._send(h, 400, {"error": "kind required"})
+            return
+        if "name" in q:
+            obj = self.cp.store.get(kind, q["name"], q.get("namespace", ""))
+            self._send(h, 200, {"obj": codec.encode(obj)})
+        else:
+            objs = self.cp.store.list(kind, q.get("namespace", ""))
+            self._send(h, 200, {"items": [codec.encode(o) for o in objs]})
+
+    def _h_POST_objects(self, h, q):
+        obj = codec.decode(self._body(h)["obj"])
+        out = self.cp.store.create(obj)
+        self._send(h, 200, {"obj": codec.encode(out)})
+
+    def _h_PUT_objects(self, h, q):
+        body = self._body(h)
+        obj = codec.decode(body["obj"])
+        out = self.cp.store.update(obj, check_rv=bool(body.get("check_rv")))
+        self._send(h, 200, {"obj": codec.encode(out)})
+
+    def _h_POST_apply(self, h, q):
+        obj = codec.decode(self._body(h)["obj"])
+        out = self.cp.store.apply(obj)
+        self._send(h, 200, {"obj": codec.encode(out)})
+
+    def _h_DELETE_objects(self, h, q):
+        self.cp.store.delete(q["kind"], q["name"], q.get("namespace", ""))
+        self._send(h, 200, {"ok": True})
+
+    def _h_POST_settle(self, h, q):
+        self._settle_blocking()
+        self._send(h, 200, {"ok": True})
+
+    def _h_POST_tick(self, h, q):
+        body = self._body(h)
+        # timer loops share the reconcile thread's exclusivity requirement
+        # (tick itself settles at the end). NOTE: advancing a nonzero
+        # `seconds` freezes the daemon's Clock at the advanced instant —
+        # meant for test drivers, not live deployments.
+        with self._settle_lock:
+            steps = self.cp.tick(float(body.get("seconds") or 0.0))
+        self._send(h, 200, {"steps": steps})
+
+    def _h_GET_members(self, h, q):
+        self._send(h, 200, {"members": sorted(self.cp.members.keys())})
+
+    def _h_GET_member_objects(self, h, q):
+        member = self.cp.members.get(q.get("cluster", ""))
+        if member is None:
+            self._send(h, 404, {"error": f"cluster {q.get('cluster')!r} not found"})
+            return
+        self._send(h, 200, {
+            "items": [o.to_dict() for o in member.objects()],
+        })
+
+    def _h_POST_join(self, h, q):
+        from ..members.member import MemberConfig
+
+        cfg = codec.decode(self._body(h)["config"])
+        if not isinstance(cfg, MemberConfig):
+            self._send(h, 400, {"error": "config must be a MemberConfig"})
+            return
+        self.cp.join_member(cfg)
+        self._settle_blocking()
+        self._send(h, 200, {"ok": True})
+
+    def _h_POST_unjoin(self, h, q):
+        self.cp.unjoin_member(self._body(h)["name"])
+        self._settle_blocking()
+        self._send(h, 200, {"ok": True})
+
+    def _h_POST_agent_cert(self, h, q):
+        cert = self.cp.sign_agent_cert(self._body(h)["cluster"])
+        self._send(h, 200, {
+            "cert_pem": cert.cert_pem.decode(),
+            "key_pem": cert.key_pem.decode(),
+            "ca_pem": self.cp.pki.ca_pem.decode(),
+        })
+
+    # -- watch streaming --------------------------------------------------
+
+    def _h_GET_watch(self, h, q):
+        kind = q.get("kind", "")
+        replay = q.get("replay", "1") not in ("0", "false")
+        if not kind:
+            self._send(h, 400, {"error": "kind required"})
+            return
+        events: queue.Queue = queue.Queue(maxsize=10_000)
+        # a client too slow for the event rate gets its stream CLOSED (not
+        # silently thinned): RemoteStore reconnects with replay=1, which is
+        # the informer relist/resync — level-triggered consumers converge
+        overflowed = threading.Event()
+
+        if kind == "*":
+            def handler(k: str, event: str, obj: Any) -> None:
+                try:
+                    events.put_nowait((k, event, obj))
+                except queue.Full:
+                    overflowed.set()
+            self.cp.store.watch_all(handler, replay=replay)
+            unsub = lambda: self.cp.store.unwatch_all(handler)  # noqa: E731
+        else:
+            def handler(event: str, obj: Any) -> None:  # type: ignore[misc]
+                try:
+                    events.put_nowait((kind, event, obj))
+                except queue.Full:
+                    overflowed.set()
+            self.cp.store.watch(kind, handler, replay=replay)
+            unsub = lambda: self.cp.store.unwatch(kind, handler)  # noqa: E731
+
+        try:
+            h.send_response(200)
+            h.send_header("Content-Type", "application/json-lines")
+            # no Content-Length: the stream ends when either side closes
+            h.send_header("Connection", "close")
+            h.end_headers()
+            while not self._stopping:
+                if overflowed.is_set() and events.empty():
+                    import logging
+
+                    logging.getLogger(__name__).warning(
+                        "watch stream for %s overflowed; closing for resync",
+                        kind,
+                    )
+                    break
+                try:
+                    k, event, obj = events.get(timeout=0.5)
+                except queue.Empty:
+                    # heartbeat line keeps half-open connections detectable
+                    h.wfile.write(b"\n")
+                    h.wfile.flush()
+                    continue
+                line = json.dumps(
+                    {"kind": k, "event": event, "obj": codec.encode(obj)}
+                )
+                h.wfile.write(line.encode() + b"\n")
+                h.wfile.flush()
+        except (BrokenPipeError, ConnectionResetError, OSError):
+            pass
+        finally:
+            unsub()

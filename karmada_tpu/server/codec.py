@@ -1,0 +1,108 @@
+"""Self-describing JSON codec for store objects crossing the serving seam.
+
+The store holds two object shapes: typed dataclasses (`karmada_tpu.api.*`,
+the agent's Lease, recorded Events) and `Unstructured` manifests. On the
+wire each dataclass is tagged with `__t: "<module_tail>.<ClassName>"` so the
+receiving side reconstructs the exact type without a schema exchange —
+the analogue of the reference's apiVersion/kind round-trip through the
+kube-apiserver, for our own object model.
+
+Decode is forward-compatible: unknown fields are dropped, missing fields
+take dataclass defaults (a newer server can talk to an older client and
+vice versa).
+"""
+from __future__ import annotations
+
+import dataclasses
+import importlib
+import pkgutil
+from typing import Any
+
+from ..api.unstructured import Unstructured
+
+_TAG = "__t"
+_UNSTRUCTURED_TAG = "unstructured.Unstructured"
+
+_registry: dict[str, type] = {}
+_by_class: dict[type, str] = {}
+
+
+def _tag_for(cls: type) -> str:
+    return f"{cls.__module__.rsplit('.', 1)[-1]}.{cls.__qualname__}"
+
+
+def register_type(cls: type) -> type:
+    """Add a dataclass to the wire registry (idempotent)."""
+    tag = _tag_for(cls)
+    existing = _registry.get(tag)
+    if existing is not None and existing is not cls:
+        raise TypeError(f"codec tag collision: {tag} -> {existing} and {cls}")
+    _registry[tag] = cls
+    _by_class[cls] = tag
+    return cls
+
+
+def _scan() -> None:
+    """Register every dataclass in karmada_tpu.api plus the non-api kinds
+    that live in the store (Lease heartbeats, recorded Events)."""
+    import karmada_tpu.api as api_pkg
+
+    for info in pkgutil.iter_modules(api_pkg.__path__):
+        mod = importlib.import_module(f"karmada_tpu.api.{info.name}")
+        for v in vars(mod).values():
+            if isinstance(v, type) and dataclasses.is_dataclass(v) \
+                    and v.__module__ == mod.__name__:
+                register_type(v)
+    from ..agent.agent import Lease
+    from ..events import Event
+    from ..members.member import MemberConfig
+    from ..models.nodes import NodeSpec
+
+    register_type(Lease)
+    register_type(Event)
+    # join/register payloads (not store objects, but they cross the seam)
+    register_type(MemberConfig)
+    register_type(NodeSpec)
+
+
+_scan()
+
+
+def encode(value: Any) -> Any:
+    """→ JSON-safe structure; inverse of decode()."""
+    if isinstance(value, Unstructured):
+        return {_TAG: _UNSTRUCTURED_TAG, "manifest": value.to_dict()}
+    if dataclasses.is_dataclass(value) and not isinstance(value, type):
+        tag = _by_class.get(type(value))
+        if tag is None:
+            tag = _by_class[register_type(type(value))]
+        out: dict[str, Any] = {_TAG: tag}
+        for f in dataclasses.fields(value):
+            out[f.name] = encode(getattr(value, f.name))
+        return out
+    if isinstance(value, dict):
+        return {k: encode(v) for k, v in value.items()}
+    if isinstance(value, (list, tuple)):
+        return [encode(v) for v in value]
+    return value
+
+
+def decode(value: Any) -> Any:
+    if isinstance(value, dict):
+        tag = value.get(_TAG)
+        if tag == _UNSTRUCTURED_TAG:
+            return Unstructured(value.get("manifest") or {})
+        if tag is not None:
+            cls = _registry.get(tag)
+            if cls is None:
+                raise TypeError(f"unknown wire type {tag!r}")
+            names = {f.name for f in dataclasses.fields(cls) if f.init}
+            kwargs = {
+                k: decode(v) for k, v in value.items()
+                if k != _TAG and k in names
+            }
+            return cls(**kwargs)
+        return {k: decode(v) for k, v in value.items()}
+    if isinstance(value, list):
+        return [decode(v) for v in value]
+    return value

@@ -1,0 +1,272 @@
+"""Client transports for the control-plane serving seam.
+
+`RemoteStore` implements the Store surface (create/get/try_get/list/update/
+apply/delete/watch/watch_all/kinds) over the HTTP API, so anything built
+against the in-process store — the pull agent, controllers, the CLI — runs
+out-of-process unchanged. `RemoteControlPlane` is the karmadactl-facing
+facade: store + settle + the member-object view the promote verb reads
+(the reference CLI's cluster-proxy path, pkg/karmadactl/promote).
+
+Watch streams run on daemon threads reading JSON lines; each handler is
+delivered events in arrival order. `close()` tears the streams down.
+"""
+from __future__ import annotations
+
+import json
+import threading
+from typing import Any, Callable, Optional
+from urllib.error import HTTPError
+from urllib.parse import quote, urlparse
+from urllib.request import Request, urlopen
+
+from ..api.unstructured import Unstructured
+from ..store.store import ConflictError, NotFoundError
+from . import codec
+
+
+class RemoteError(RuntimeError):
+    """Non-CRUD failure on the serving seam (transport or server error)."""
+
+
+class AdmissionDeniedRemote(RemoteError):
+    """Server-side admission chain rejected the operation (HTTP 422)."""
+
+
+class RemoteStore:
+    def __init__(self, base_url: str, timeout: float = 30.0):
+        self.base_url = base_url.rstrip("/")
+        self.timeout = timeout
+        self._watch_threads: list[threading.Thread] = []
+        self._closed = False
+
+    # -- transport --------------------------------------------------------
+
+    def _call(self, method: str, path: str, body: Optional[dict] = None) -> dict:
+        data = json.dumps(body).encode() if body is not None else None
+        req = Request(
+            self.base_url + path, data=data, method=method,
+            headers={"Content-Type": "application/json"} if data else {},
+        )
+        try:
+            with urlopen(req, timeout=self.timeout) as resp:
+                return json.loads(resp.read().decode() or "{}")
+        except HTTPError as e:
+            try:
+                msg = json.loads(e.read().decode()).get("error", str(e))
+            except Exception:  # noqa: BLE001
+                msg = str(e)
+            if e.code == 404:
+                raise NotFoundError(msg) from None
+            if e.code == 409:
+                raise ConflictError(msg) from None
+            if e.code == 422:
+                raise AdmissionDeniedRemote(msg) from None
+            raise RemoteError(f"HTTP {e.code}: {msg}") from None
+        except OSError as e:
+            raise RemoteError(f"control plane unreachable: {e}") from None
+
+    @staticmethod
+    def _okey(kind: str, name: str = "", namespace: str = "") -> str:
+        parts = [f"kind={quote(kind, safe='')}"]
+        if name:
+            parts.append(f"name={quote(name, safe='')}")
+        if namespace:
+            parts.append(f"namespace={quote(namespace, safe='')}")
+        return "/objects?" + "&".join(parts)
+
+    # -- Store surface ----------------------------------------------------
+
+    def create(self, obj: Any) -> Any:
+        return codec.decode(self._call("POST", "/objects", {"obj": codec.encode(obj)})["obj"])
+
+    def update(self, obj: Any, *, check_rv: bool = False) -> Any:
+        return codec.decode(self._call(
+            "PUT", "/objects", {"obj": codec.encode(obj), "check_rv": check_rv}
+        )["obj"])
+
+    def apply(self, obj: Any) -> Any:
+        return codec.decode(self._call("POST", "/apply", {"obj": codec.encode(obj)})["obj"])
+
+    def get(self, kind: str, name: str, namespace: str = "") -> Any:
+        return codec.decode(self._call("GET", self._okey(kind, name, namespace))["obj"])
+
+    def try_get(self, kind: str, name: str, namespace: str = "") -> Optional[Any]:
+        try:
+            return self.get(kind, name, namespace)
+        except NotFoundError:
+            return None
+
+    def list(self, kind: str, namespace: str = "") -> list[Any]:
+        out = self._call("GET", self._okey(kind, namespace=namespace))
+        return [codec.decode(o) for o in out["items"]]
+
+    def delete(self, kind: str, name: str, namespace: str = "") -> None:
+        self._call("DELETE", self._okey(kind, name, namespace))
+
+    def kinds(self) -> list[str]:
+        return self._call("GET", "/kinds")["kinds"]
+
+    # -- watch ------------------------------------------------------------
+
+    def watch(self, kind: str, handler: Callable[[str, Any], None], *,
+              replay: bool = True) -> None:
+        self._start_stream(kind, replay, lambda k, ev, obj: handler(ev, obj))
+
+    def watch_all(self, handler: Callable[[str, str, Any], None], *,
+                  replay: bool = True) -> None:
+        self._start_stream("*", replay, handler)
+
+    def _start_stream(self, kind: str, replay: bool,
+                      deliver: Callable[[str, str, Any], None]) -> None:
+        import http.client
+
+        url = urlparse(self.base_url)
+
+        def attach(with_replay: bool) -> None:
+            path = (f"/watch?kind={quote(kind, safe='')}"
+                    f"&replay={'1' if with_replay else '0'}")
+            conn = http.client.HTTPConnection(
+                url.hostname, url.port, timeout=None
+            )
+            try:
+                conn.request("GET", path)
+                resp = conn.getresponse()
+                if resp.status != 200:
+                    return
+                buf = b""
+                while not self._closed:
+                    chunk = resp.read1(65536)
+                    if not chunk:
+                        return  # server closed (shutdown or overflow)
+                    buf += chunk
+                    while b"\n" in buf:
+                        line, _, buf = buf.partition(b"\n")
+                        if not line.strip():
+                            continue  # heartbeat
+                        msg = json.loads(line.decode())
+                        deliver(
+                            msg["kind"], msg["event"], codec.decode(msg["obj"])
+                        )
+            finally:
+                conn.close()
+
+        def run() -> None:
+            # informer semantics: a dropped stream (server restart, overflow
+            # close) re-attaches WITH replay — the relist/resync that makes
+            # level-triggered consumers converge despite missed deltas
+            first = True
+            while not self._closed:
+                try:
+                    attach(replay if first else True)
+                except (OSError, json.JSONDecodeError):
+                    pass
+                first = False
+                if not self._closed:
+                    import time as _time
+
+                    _time.sleep(0.5)
+
+        t = threading.Thread(target=run, name=f"watch-{kind}", daemon=True)
+        t.start()
+        self._watch_threads.append(t)
+
+    def close(self) -> None:
+        self._closed = True
+
+
+class _RemoteMember:
+    """Read-only member view for verbs that inspect member objects
+    (promote): backed by GET /member/objects — the cluster-proxy
+    subresource of the aggregated apiserver (SURVEY U9)."""
+
+    def __init__(self, store: RemoteStore, name: str):
+        self._store = store
+        self.name = name
+
+    def objects(self) -> list[Unstructured]:
+        out = self._store._call(
+            "GET", f"/member/objects?cluster={quote(self.name, safe='')}"
+        )
+        return [Unstructured(d) for d in out["items"]]
+
+    def get(self, api_version: str, kind: str, name: str,
+            namespace: str = "") -> Optional[Unstructured]:
+        for o in self.objects():
+            if (o.api_version == api_version and o.kind == kind
+                    and o.name == name
+                    and (not namespace or o.namespace == namespace)):
+                return o
+        return None
+
+
+class _RemoteMembers(dict):
+    """Live mapping facade over GET /members."""
+
+    def __init__(self, store: RemoteStore):
+        super().__init__()
+        self._store = store
+
+    def _refresh(self) -> None:
+        names = self._store._call("GET", "/members")["members"]
+        super().clear()
+        for n in names:
+            super().__setitem__(n, _RemoteMember(self._store, n))
+
+    def get(self, key, default=None):
+        self._refresh()
+        return super().get(key, default)
+
+    def __getitem__(self, key):
+        self._refresh()
+        return super().__getitem__(key)
+
+    def __contains__(self, key) -> bool:
+        self._refresh()
+        return super().__contains__(key)
+
+    def keys(self):
+        self._refresh()
+        return super().keys()
+
+    def __iter__(self):
+        self._refresh()
+        return super().__iter__()
+
+
+class RemoteControlPlane:
+    """What `karmadactl --server URL` hands to the command functions: the
+    same attribute surface the in-process ControlPlane exposes for the
+    verbs that are meaningful over the wire (store CRUD, settle, member
+    views, join/unjoin). Anything deeper (in-process scheduler state,
+    interpreter internals) raises AttributeError — those verbs require the
+    daemon side, as in the reference where karmadactl is a pure API client."""
+
+    def __init__(self, url: str, timeout: float = 30.0):
+        self.url = url.rstrip("/")
+        self.store = RemoteStore(self.url, timeout=timeout)
+        self.members = _RemoteMembers(self.store)
+
+    def settle(self, max_steps: int = 0) -> int:
+        self.store._call("POST", "/settle")
+        return 0
+
+    def tick(self, seconds: float = 0.0) -> int:
+        return int(self.store._call("POST", "/tick", {"seconds": seconds}).get("steps", 0))
+
+    def join_member(self, config) -> None:
+        self.store._call("POST", "/join", {"config": codec.encode(config)})
+
+    def unjoin_member(self, name: str) -> None:
+        self.store._call("POST", "/unjoin", {"name": name})
+
+    def sign_agent_cert(self, cluster: str) -> dict:
+        return self.store._call("POST", "/agent/cert", {"cluster": cluster})
+
+    def healthz(self) -> bool:
+        try:
+            return bool(self.store._call("GET", "/healthz").get("ok"))
+        except RemoteError:
+            return False
+
+    def close(self) -> None:
+        self.store.close()

@@ -260,6 +260,19 @@ class Store:
             for o in snapshot:
                 handler(ADDED, o)
 
+    def unwatch(self, kind: str, handler: WatchHandler) -> None:
+        """Drop a kind subscription (a disconnected watch stream must not
+        keep filling a dead queue)."""
+        with self._lock:
+            b = self._buckets.get(kind)
+            if b is not None and handler in b.watchers:
+                b.watchers.remove(handler)
+
+    def unwatch_all(self, handler: Callable[[str, str, Any], None]) -> None:
+        with self._lock:
+            if handler in self._all_watchers:
+                self._all_watchers.remove(handler)
+
     def watch_all(self, handler: Callable[[str, str, Any], None], *, replay: bool = True) -> None:
         """Subscribe to every kind: handler(kind, event, obj). Used by the
         detector's dynamic-informer sweep (detector.go:112)."""

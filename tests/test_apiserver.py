@@ -1,0 +1,337 @@
+"""The out-of-process control-plane boundary (VERDICT r4 missing #2).
+
+Starts a real ControlPlaneServer on a loopback socket and drives it the way
+the reference's network clients drive the karmada-apiserver:
+- RemoteStore CRUD + streaming watch (client-go list/watch equivalent),
+- karmadactl verbs (apply/get/promote/join/delete) through `--server`,
+- a pull agent (RemoteAgentSession) registering, receiving Works, applying
+  them to its member, reflecting status, and heartbeating its lease —
+  entirely over HTTP (cmd/agent/app/agent.go:73,135).
+"""
+from __future__ import annotations
+
+import json
+import threading
+import time
+
+import pytest
+
+from karmada_tpu.api.meta import CPU, MEMORY, get_condition
+from karmada_tpu.api.unstructured import Unstructured
+from karmada_tpu.api.work import CONDITION_SCHEDULED
+from karmada_tpu.controlplane import ControlPlane
+from karmada_tpu.members.member import MemberConfig
+from karmada_tpu.server.apiserver import ControlPlaneServer
+from karmada_tpu.server.remote import (
+    AdmissionDeniedRemote,
+    RemoteControlPlane,
+    RemoteStore,
+)
+from karmada_tpu.store.store import ConflictError, NotFoundError
+from karmada_tpu.testing.fixtures import (
+    duplicated_placement,
+    new_deployment,
+    new_policy,
+    selector_for,
+)
+
+GiB = 1024.0**3
+
+
+@pytest.fixture()
+def served_plane():
+    cp = ControlPlane()
+    for i in range(1, 3):
+        cp.join_member(MemberConfig(
+            name=f"member{i}", region=f"region-{i}",
+            allocatable={CPU: 100.0, MEMORY: 400 * GiB, "pods": 1000.0},
+        ))
+    cp.settle()
+    srv = ControlPlaneServer(cp)
+    srv.start()
+    yield cp, srv
+    srv.stop()
+
+
+def wait_until(pred, timeout=10.0, interval=0.05):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred():
+            return True
+        time.sleep(interval)
+    return False
+
+
+class TestRemoteStoreCrud:
+    def test_crud_roundtrip_and_errors(self, served_plane):
+        cp, srv = served_plane
+        rs = RemoteStore(srv.url)
+        try:
+            dep = new_deployment("default", "web", replicas=3, cpu=0.25)
+            created = rs.create(dep)
+            assert created.metadata.resource_version > 0
+            got = rs.get("apps/v1/Deployment", "web", "default")
+            assert got.get("spec", "replicas") == 3
+            with pytest.raises(ConflictError):
+                rs.create(dep)
+            got.set("spec", "replicas", 5)
+            rs.update(got)
+            assert rs.get("apps/v1/Deployment", "web", "default").get("spec", "replicas") == 5
+            assert len(rs.list("apps/v1/Deployment", "default")) == 1
+            rs.delete("apps/v1/Deployment", "web", "default")
+            assert rs.try_get("apps/v1/Deployment", "web", "default") is None
+            with pytest.raises(NotFoundError):
+                rs.get("apps/v1/Deployment", "nope", "default")
+            assert "Cluster" in rs.kinds()
+        finally:
+            rs.close()
+
+    def test_admission_denial_crosses_the_wire(self, served_plane):
+        cp, srv = served_plane
+        rs = RemoteStore(srv.url)
+        try:
+            # a PropagationPolicy without resourceSelectors is denied by the
+            # webhook chain server-side; the client sees the denial typed
+            bad = new_policy("default", "bad", [], duplicated_placement([]))
+            with pytest.raises(AdmissionDeniedRemote):
+                rs.create(bad)
+        finally:
+            rs.close()
+
+    def test_watch_streams_events(self, served_plane):
+        cp, srv = served_plane
+        rs = RemoteStore(srv.url)
+        seen: list[tuple[str, str]] = []
+        done = threading.Event()
+
+        def handler(event, obj):
+            seen.append((event, obj.metadata.name))
+            if event == "DELETED":
+                done.set()
+
+        try:
+            rs.watch("apps/v1/Deployment", handler, replay=False)
+            time.sleep(0.3)  # let the stream attach
+            dep = new_deployment("default", "watched", replicas=1, cpu=0.1)
+            rs.create(dep)
+            got = rs.get("apps/v1/Deployment", "watched", "default")
+            got.set("spec", "replicas", 2)
+            rs.update(got)
+            rs.delete("apps/v1/Deployment", "watched", "default")
+            assert done.wait(10.0), f"events so far: {seen}"
+            events = [e for e, _ in seen]
+            assert events[0] == "ADDED"
+            assert "MODIFIED" in events
+            assert events[-1] == "DELETED"
+        finally:
+            rs.close()
+
+
+class TestKarmadactlOverSocket:
+    def test_apply_get_promote_join_through_the_wire(self, served_plane, tmp_path):
+        from karmada_tpu.cli.karmadactl import run
+
+        cp, srv = served_plane
+        rcp = RemoteControlPlane(srv.url)
+
+        # apply -f --all-clusters
+        manifest = new_deployment("default", "nginx", replicas=2, cpu=0.1).to_dict()
+        f = tmp_path / "dep.json"
+        f.write_text(json.dumps(manifest, default=str))
+        out = run(rcp, ["apply", "-f", str(f), "--all-clusters"])
+        assert "applied" in out
+
+        # the daemon's reconcile loop scheduled + propagated it
+        assert wait_until(lambda: all(
+            m.get("apps/v1", "Deployment", "nginx", "default") is not None
+            for m in cp.members.values()
+        )), "propagation did not converge through the socket"
+
+        # get across the wire
+        out = run(rcp, ["get", "deployment", "nginx", "-n", "default"])
+        assert "nginx" in out
+
+        # promote: member object -> control-plane template + pinned policy
+        cp.members["member1"].apply_manifest({
+            "apiVersion": "apps/v1", "kind": "Deployment",
+            "metadata": {"name": "legacy", "namespace": "default"},
+            "spec": {"replicas": 1},
+        })
+        out = run(rcp, ["promote", "deployment", "legacy", "-C", "member1",
+                        "-n", "default"])
+        assert "promoted" in out
+        assert rcp.store.try_get("apps/v1/Deployment", "legacy", "default") is not None
+        assert rcp.store.try_get("PropagationPolicy", "promote-legacy", "default") is not None
+
+        # join a third (push) member over the wire, then unjoin it
+        out = run(rcp, ["join", "member3", "--region", "region-3"])
+        assert "member3" in out
+        assert wait_until(lambda: "member3" in cp.members)
+        assert rcp.store.try_get("Cluster", "member3") is not None
+        run(rcp, ["unjoin", "member3"])
+        assert wait_until(lambda: "member3" not in cp.members)
+
+        # delete through the wire
+        out = run(rcp, ["delete", "deployment", "nginx", "-n", "default"])
+        assert "deleted" in out
+
+    def test_main_peels_server_flag(self, served_plane, capsys):
+        from karmada_tpu.cli.karmadactl import main
+
+        cp, srv = served_plane
+        rc = main(["--server", srv.url, "get", "clusters"])
+        out = capsys.readouterr().out
+        assert rc == 0
+        assert "member1" in out and "member2" in out
+
+
+class TestRemotePullAgent:
+    def test_agent_over_the_socket(self, served_plane):
+        from karmada_tpu.agent.remote_agent import RemoteAgentSession
+        from karmada_tpu.api.work import work_namespace_for_cluster as execution_namespace
+
+        cp, srv = served_plane
+        session = RemoteAgentSession(srv.url, MemberConfig(
+            name="edge-1", sync_mode="Pull", region="edge",
+            allocatable={CPU: 50.0, MEMORY: 200 * GiB, "pods": 500.0},
+        ))
+        try:
+            session.register()
+            # central plane sees the cluster, Pull mode, lease live
+            assert wait_until(
+                lambda: cp.store.try_get("Cluster", "edge-1") is not None
+            )
+            assert cp.store.get("Cluster", "edge-1").spec.sync_mode == "Pull"
+            assert cp.store.try_get(
+                "Lease", "edge-1", execution_namespace("edge-1")
+            ) is not None
+
+            # target the pull cluster explicitly; the daemon schedules and
+            # emits a Work into karmada-es-edge-1
+            dep = new_deployment("default", "edge-app", replicas=2, cpu=0.1)
+            rs = session.store
+            rs.create(dep)
+            rs.create(new_policy(
+                "default", "edge-pp", [selector_for(dep)],
+                duplicated_placement(["edge-1"]),
+            ))
+
+            assert wait_until(lambda: len(
+                cp.store.list("Work", execution_namespace("edge-1"))
+            ) > 0), "work never reached the agent namespace"
+
+            # the agent (watch-driven, over the socket) applies it to its
+            # member and reflects status back into the Work
+            assert wait_until(
+                lambda: (session.step() or True) and session.member.get(
+                    "apps/v1", "Deployment", "edge-app", "default"
+                ) is not None
+            ), "agent never applied the Work"
+            obj = session.member.get("apps/v1", "Deployment", "edge-app", "default")
+            assert obj.get("spec", "replicas") == 2
+
+            def applied_and_reflected():
+                session.step()
+                works = cp.store.list("Work", execution_namespace("edge-1"))
+                if not works:
+                    return False
+                w = works[0]
+                cond = get_condition(w.status.conditions, "Applied")
+                return (cond is not None and cond.status == "True"
+                        and len(w.status.manifest_statuses) > 0)
+
+            assert wait_until(applied_and_reflected), \
+                "work status never reflected over the wire"
+
+            # binding status aggregates centrally from the agent-reported
+            # manifest status
+            def rb_scheduled():
+                rb = cp.store.try_get("ResourceBinding", "edge-app-deployment", "default")
+                if rb is None:
+                    return False
+                cond = get_condition(rb.status.conditions, CONDITION_SCHEDULED)
+                return cond is not None and cond.status == "True"
+
+            assert wait_until(rb_scheduled)
+        finally:
+            session.close()
+
+
+class TestDaemonArtifacts:
+    def test_init_emits_runnable_launcher(self, tmp_path):
+        from karmada_tpu.cli.karmadactl import Management, cmd_init
+
+        mgmt = Management()
+        out = cmd_init(mgmt, "prod", emit_dir=str(tmp_path))
+        assert "daemon artifacts" in out
+        script = tmp_path / "prod-daemon.sh"
+        unit = tmp_path / "prod-daemon.service"
+        assert script.exists() and unit.exists()
+        assert "karmada_tpu.server" in script.read_text()
+        assert script.stat().st_mode & 0o100  # executable
+        assert "ExecStart=" in unit.read_text()
+
+
+class TestDaemonProcess:
+    def test_daemon_subprocess_serves_cli(self, tmp_path):
+        """The real boundary: a separate OS process runs the daemon; the
+        CLI main() talks to it over the socket."""
+        import re
+        import subprocess
+        import sys
+
+        proc = subprocess.Popen(
+            [sys.executable, "-m", "karmada_tpu.server",
+             "--members", "2", "--tick-interval", "0.5", "--platform", "cpu"],
+            stdout=subprocess.PIPE, stderr=subprocess.DEVNULL, text=True,
+        )
+        try:
+            line = proc.stdout.readline()
+            m = re.search(r"http://[\d.]+:(\d+)", line)
+            assert m, f"no URL line: {line!r}"
+            url = m.group(0)
+
+            from karmada_tpu.cli.karmadactl import run
+
+            rcp = RemoteControlPlane(url)
+            out = run(rcp, ["get", "clusters"])
+            assert "member1" in out and "member2" in out
+            out = run(rcp, ["api-resources"])
+            assert out
+
+            # join crosses the REAL process boundary: the daemon's codec
+            # registry must decode a MemberConfig it never encoded
+            out = run(rcp, ["join", "edge-join", "--region", "r9"])
+            assert "edge-join" in out
+            assert rcp.store.try_get("Cluster", "edge-join") is not None
+
+            # the register CSR flow: signed agent identity over the wire
+            certs = rcp.sign_agent_cert("edge-join")
+            assert "BEGIN CERTIFICATE" in certs["cert_pem"]
+            assert "BEGIN" in certs["key_pem"]
+            assert "BEGIN CERTIFICATE" in certs["ca_pem"]
+        finally:
+            proc.terminate()
+            proc.wait(timeout=10)
+
+    def test_watch_overflow_resyncs(self, served_plane):
+        """A slow watch client gets its stream closed and re-attached with
+        replay (informer relist) instead of silently missing objects."""
+        cp, srv = served_plane
+        rs = RemoteStore(srv.url)
+        names: set[str] = set()
+        try:
+            rs.watch("v1/ConfigMap", lambda ev, o: names.add(o.metadata.name),
+                     replay=True)
+            time.sleep(0.3)
+            # 60 objects through the in-process store; even if the stream
+            # drops mid-burst the resync replay must converge to all of them
+            for i in range(60):
+                cp.store.create(Unstructured({
+                    "apiVersion": "v1", "kind": "ConfigMap",
+                    "metadata": {"name": f"cm-{i}", "namespace": "default"},
+                    "data": {"k": str(i)},
+                }))
+            assert wait_until(lambda: len(names) == 60), sorted(names)[:5]
+        finally:
+            rs.close()
