@@ -53,21 +53,30 @@ class RemoteAgentSession:
         """generateClusterInControllerPlane (agent.go:437): create-or-refresh
         the Cluster object and heartbeat once so the lease is live before
         the scheduler can consider the cluster."""
+        from ..store.store import ConflictError
+
         fresh = cluster_object_for(self.config)
-        existing = self.store.try_get("Cluster", self.config.name)
-        if existing is None:
-            self.store.create(fresh)
-        else:
+        for _ in range(8):
+            existing = self.store.try_get("Cluster", self.config.name)
+            if existing is None:
+                self.store.create(fresh)
+                break
             # restart with changed config: refresh what this agent owns
             # (spec identity + reported capacity) without clobbering
-            # control-plane-written state (taints, conditions, remedies)
+            # control-plane-written state (taints, conditions, remedies);
+            # check_rv + retry so a concurrent control-plane write between
+            # our read and write is never silently reverted
             existing.spec.sync_mode = fresh.spec.sync_mode
             existing.spec.provider = fresh.spec.provider
             existing.spec.region = fresh.spec.region
             existing.spec.zone = fresh.spec.zone
             existing.metadata.labels.update(fresh.metadata.labels)
             existing.status.resource_summary = fresh.status.resource_summary
-            self.store.update(existing)
+            try:
+                self.store.update(existing, check_rv=True)
+                break
+            except ConflictError:
+                continue
         self.agent.heartbeat()
 
     def step(self) -> int:

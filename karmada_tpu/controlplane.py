@@ -364,6 +364,8 @@ class ControlPlane:
         self.store.create(cluster)
         if self.work_status_controller is not None:
             self.work_status_controller.watch_member(member)
+        # the search cache's per-cluster dynamic informer (proxy WATCH bus)
+        self.resource_cache.attach_member(member)
         if config.sync_mode == "Pull":
             # the member runs its own agent (L7): execution + lease heartbeat
             agent = KarmadaAgent(self.store, member, self.interpreter, self.runtime)
@@ -389,6 +391,7 @@ class ControlPlane:
         self.members.pop(name, None)
         self.condition_cache.delete(name)
         self.coredns_detector.cache.delete(name)
+        self.resource_cache.detach_member(name)
 
     def sign_agent_cert(self, cluster: str, ttl_seconds: float = 365 * 86400.0) -> IssuedCertificate:
         """Sign the karmada-agent client identity for a pull cluster

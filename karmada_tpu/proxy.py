@@ -56,12 +56,29 @@ class ClusterProxy:
         namespace: str = "",
         body: Optional[dict] = None,
         subject: Optional[dict] = None,
+        handler: Optional[Any] = None,
     ) -> Any:
         """The Connect handler (registry/cluster/storage/proxy.go):
-        GET/LIST/POST/PUT/DELETE against one member through the control plane."""
+        GET/LIST/WATCH/POST/PUT/DELETE against one member through the
+        control plane. WATCH takes `handler(event, obj)` and returns an
+        unsubscribe callable; current objects replay as ADDED first."""
         self._authorize(subject)
         member = self._member(cluster)
         method = method.upper()
+        if method == "WATCH":
+            if handler is None:
+                raise ProxyError("WATCH requires a handler")
+            gvk = f"{api_version}/{kind}"
+
+            def filt(event: str, obj: Any) -> None:
+                if namespace and obj.metadata.namespace != namespace:
+                    return
+                if name and obj.metadata.name != name:
+                    return
+                handler(event, obj)
+
+            member.store.watch(gvk, filt, replay=True)
+            return lambda: member.store.unwatch(gvk, filt)
         if method == "GET":
             if not name:
                 return member.store.list(f"{api_version}/{kind}", namespace)

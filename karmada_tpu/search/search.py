@@ -7,8 +7,11 @@ cache + aggregated search API + proxy.
   sweep is our resync).
 - search API (pkg/search/apiserver.go): federation-wide list with cluster
   annotations.
-- proxy (pkg/search/proxy/controller.go:94,277 Connect): route GET/LIST to
-  the cached member objects — the "single pane of glass".
+- proxy (pkg/search/proxy/controller.go:94,277 Connect): route GET/LIST/WATCH
+  to the cached member objects — the "single pane of glass". WATCH is served
+  from the cache's live event bus: member-store events (the per-cluster
+  dynamic informers of the reference) flow through registry selection into
+  the cache and out to watch subscribers.
 - backend stores (pkg/search/backendstore): pluggable sinks; the default
   keeps objects in memory, the OpenSearch one builds wire-correct REST
   requests (index create / bulk upsert / delete) against an injectable
@@ -300,6 +303,86 @@ class ResourceCache:
         # registry name -> keys its backend indexed last sweep (removals
         # route only to the backends that actually hold the document)
         self._indexed: dict[str, set] = {}
+        # live event bus: member-store events that pass registry selection
+        # update the cache incrementally and fan out here — this is what
+        # proxy WATCH serves (controller.go:277 routes watch to the cache)
+        self._watchers: list = []  # handler(cluster, event, Unstructured)
+        self._attached: set[str] = set()
+        # (api_version, kind) -> selected clusters, rebuilt lazily when a
+        # ResourceRegistry or Cluster changes: the live handler runs on
+        # every member write, so it must not deepcopy-list the store
+        self._selection: Optional[dict[tuple, set]] = None
+        store.watch("ResourceRegistry", self._invalidate_selection, replay=False)
+        store.watch("Cluster", self._invalidate_selection, replay=False)
+
+    def _invalidate_selection(self, event: str, obj) -> None:
+        self._selection = None
+
+    def _selection_map(self) -> dict[tuple, set]:
+        sel = self._selection
+        if sel is None:
+            sel = {}
+            for registry in self.store.list("ResourceRegistry"):
+                clusters = set(self._selected_clusters(registry))
+                for s in registry.spec.resource_selectors:
+                    sel.setdefault((s.api_version, s.kind), set()).update(clusters)
+            self._selection = sel
+        return sel
+
+    # -- live member informers -------------------------------------------
+
+    def attach_member(self, member) -> None:
+        """Subscribe to one member's object events (the reference's
+        per-cluster dynamic informer). Idempotent per cluster name."""
+        if member.name in self._attached:
+            return
+        self._attached.add(member.name)
+        cname = member.name
+
+        def handler(kind: str, event: str, obj) -> None:
+            if not isinstance(obj, Unstructured):
+                return
+            if not self._selected_by_any_registry(cname, obj):
+                return
+            key = (cname, f"{obj.api_version}/{obj.kind}", obj.namespace, obj.name)
+            annotated = Unstructured(obj.to_dict())
+            annotated.metadata.annotations[CLUSTER_ANNOTATION] = cname
+            annotated.sync_meta()
+            if event == "DELETED":
+                self._cache.pop(key, None)
+            else:
+                self._cache[key] = annotated
+            for w in list(self._watchers):
+                w(cname, event, annotated)
+
+        member.store.watch_all(handler, replay=False)
+
+    def detach_member(self, name: str) -> None:
+        """Forget an unjoined cluster's cached objects (its store — and the
+        subscription into it — is garbage with the membership)."""
+        self._attached.discard(name)
+        for key in [k for k in self._cache if k[0] == name]:
+            del self._cache[key]
+
+    def _selected_by_any_registry(self, cluster: str, obj) -> bool:
+        return cluster in self._selection_map().get(
+            (obj.api_version, obj.kind), ()
+        )
+
+    def watch(self, handler, *, replay: bool = True):
+        """Subscribe to cache events; handler(cluster, event, obj). With
+        replay, current cache content is delivered as ADDED first (informer
+        list+watch). Returns an unsubscribe callable."""
+        if replay:
+            for (cname, _, _, _), obj in sorted(self._cache.items()):
+                handler(cname, "ADDED", obj)
+        self._watchers.append(handler)
+
+        def unsubscribe() -> None:
+            if handler in self._watchers:
+                self._watchers.remove(handler)
+
+        return unsubscribe
 
     def backend_for(self, registry) -> BackendStore:
         name = registry.metadata.name
@@ -400,11 +483,33 @@ class ResourceCache:
 
 
 class SearchProxy:
-    """Single-pane proxy (proxy/controller.go Connect): GET/LIST routed to the
-    cache, falling through to the live member for objects not yet cached."""
+    """Single-pane proxy (proxy/controller.go:277 Connect): GET/LIST/WATCH
+    routed to the cache, GET/LIST falling through to the live member for
+    objects not yet cached."""
 
     def __init__(self, cache: ResourceCache):
         self.cache = cache
+
+    def watch(self, handler, *, cluster: str = "", api_version: str = "",
+              kind: str = "", namespace: str = "", name: str = "",
+              replay: bool = True):
+        """Watch member objects through the proxy: handler(cluster, event,
+        obj), filtered like the Connect request path. Returns unsubscribe."""
+
+        def filt(cname: str, event: str, obj) -> None:
+            if cluster and cname != cluster:
+                return
+            if api_version and obj.api_version != api_version:
+                return
+            if kind and obj.kind != kind:
+                return
+            if namespace and obj.namespace != namespace:
+                return
+            if name and obj.name != name:
+                return
+            handler(cname, event, obj)
+
+        return self.cache.watch(filt, replay=replay)
 
     def get(self, cluster: str, api_version: str, kind: str,
             name: str, namespace: str = "") -> Optional[Unstructured]:

@@ -259,12 +259,17 @@ class ControlPlaneServer:
         if not isinstance(cfg, MemberConfig):
             self._send(h, 400, {"error": "config must be a MemberConfig"})
             return
-        self.cp.join_member(cfg)
+        # membership mutates cp.members, which controllers iterate during
+        # settle — serialize with the reconcile/tick threads
+        with self._settle_lock:
+            self.cp.join_member(cfg)
         self._settle_blocking()
         self._send(h, 200, {"ok": True})
 
     def _h_POST_unjoin(self, h, q):
-        self.cp.unjoin_member(self._body(h)["name"])
+        name = self._body(h)["name"]
+        with self._settle_lock:
+            self.cp.unjoin_member(name)
         self._settle_blocking()
         self._send(h, 200, {"ok": True})
 

@@ -125,8 +125,11 @@ class RemoteStore:
         def attach(with_replay: bool) -> None:
             path = (f"/watch?kind={quote(kind, safe='')}"
                     f"&replay={'1' if with_replay else '0'}")
+            # the server heartbeats every 0.5s; a read stalling 10x that is
+            # a half-open connection (host died without RST) — time out and
+            # let the outer loop re-attach with replay
             conn = http.client.HTTPConnection(
-                url.hostname, url.port, timeout=None
+                url.hostname, url.port, timeout=5.0
             )
             try:
                 conn.request("GET", path)
@@ -212,15 +215,23 @@ class _RemoteMembers(dict):
         for n in names:
             super().__setitem__(n, _RemoteMember(self._store, n))
 
+    # iteration always refreshes; keyed access only refreshes on a miss —
+    # `for name in cp.members: cp.members[name]` costs ONE round-trip, not
+    # N+1, while a just-joined member is still found
+
     def get(self, key, default=None):
-        self._refresh()
+        if not super().__contains__(key):
+            self._refresh()
         return super().get(key, default)
 
     def __getitem__(self, key):
-        self._refresh()
+        if not super().__contains__(key):
+            self._refresh()
         return super().__getitem__(key)
 
     def __contains__(self, key) -> bool:
+        # membership checks always re-ask (an unjoined member must read as
+        # gone); only get/getitem use the stale-snapshot fast path
         self._refresh()
         return super().__contains__(key)
 
@@ -228,9 +239,17 @@ class _RemoteMembers(dict):
         self._refresh()
         return super().keys()
 
+    def values(self):
+        self._refresh()
+        return super().values()
+
+    def items(self):
+        self._refresh()
+        return super().items()
+
     def __iter__(self):
         self._refresh()
-        return super().__iter__()
+        return iter(list(super().keys()))
 
 
 class RemoteControlPlane:
