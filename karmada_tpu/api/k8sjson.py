@@ -1,0 +1,278 @@
+"""Parsers for the reference's own JSON wire shapes → our typed API.
+
+A stock Go karmada component marshals its CRD structs with k8s JSON tags
+(camelCase, quantity strings, RFC3339 times). The scheduler sidecar shim
+accepts exactly those bytes, so the Go side needs no translation layer:
+`json.Marshal(spec)` of a `workv1alpha2.ResourceBindingSpec` (
+binding_types.go) or a `clusterv1alpha1.Cluster` (types.go) is a valid
+request body. Unknown fields are ignored (k8s clients are forward-
+compatible the same way).
+"""
+from __future__ import annotations
+
+from datetime import datetime
+from typing import Any, Optional
+
+from ..interpreter.interpreter import _parse_quantity
+from . import policy as pol
+from .cluster import (
+    APIEnablement,
+    Cluster,
+    ClusterSpec,
+    ClusterStatus,
+    NodeSummary,
+    ResourceSummary,
+    Taint,
+)
+from .meta import (
+    Condition,
+    LabelSelector,
+    LabelSelectorRequirement,
+    ObjectMeta,
+)
+from .work import (
+    BindingSpec,
+    NodeClaim,
+    ObjectReference,
+    ReplicaRequirements,
+    TargetCluster,
+)
+
+
+def rfc3339_to_epoch(v: Any) -> Optional[float]:
+    if v in (None, ""):
+        return None
+    if isinstance(v, (int, float)):
+        return float(v)
+    # metav1.Time marshals as RFC3339 (Z or numeric offset, optional
+    # fractional seconds) — exactly what fromisoformat accepts
+    try:
+        return datetime.fromisoformat(str(v).replace("Z", "+00:00")).timestamp()
+    except ValueError:
+        return None
+
+
+def resources_from_json(d: Optional[dict]) -> dict[str, float]:
+    return {k: _parse_quantity(v) for k, v in (d or {}).items()}
+
+
+def _label_selector(d: Optional[dict]) -> Optional[LabelSelector]:
+    if not d:
+        return None
+    return LabelSelector(
+        match_labels=dict(d.get("matchLabels") or {}),
+        match_expressions=[
+            LabelSelectorRequirement(
+                key=e.get("key", ""),
+                operator=e.get("operator", "In"),
+                values=list(e.get("values") or []),
+            )
+            for e in (d.get("matchExpressions") or [])
+        ],
+    )
+
+
+def _field_selector(d: Optional[dict]) -> Optional[pol.FieldSelector]:
+    if not d:
+        return None
+    return pol.FieldSelector(
+        match_expressions=[
+            pol.FieldSelectorRequirement(
+                key=e.get("key", ""),
+                operator=e.get("operator", "In"),
+                values=list(e.get("values") or []),
+            )
+            for e in (d.get("matchExpressions") or [])
+        ]
+    )
+
+
+def cluster_affinity_from_json(d: Optional[dict]) -> Optional[pol.ClusterAffinity]:
+    if d is None:
+        return None
+    return pol.ClusterAffinity(
+        label_selector=_label_selector(d.get("labelSelector")),
+        field_selector=_field_selector(d.get("fieldSelector")),
+        cluster_names=list(d.get("clusterNames") or []),
+        exclude=list(d.get("exclude") or []),
+    )
+
+
+def _toleration(d: dict) -> pol.Toleration:
+    return pol.Toleration(
+        key=d.get("key", ""),
+        operator=d.get("operator", "Equal"),
+        value=d.get("value", ""),
+        effect=d.get("effect", ""),
+        toleration_seconds=d.get("tolerationSeconds"),
+    )
+
+
+def placement_from_json(d: Optional[dict]) -> Optional[pol.Placement]:
+    """propagation_types.go Placement (JSON tags) → Placement."""
+    if d is None:
+        return None
+    rs = d.get("replicaScheduling")
+    strategy = None
+    if rs is not None:
+        wp = rs.get("weightPreference")
+        prefs = None
+        if wp is not None:
+            prefs = pol.ClusterPreferences(
+                static_weight_list=[
+                    pol.StaticClusterWeight(
+                        target_cluster=cluster_affinity_from_json(
+                            w.get("targetCluster")
+                        ) or pol.ClusterAffinity(),
+                        weight=int(w.get("weight", 1)),
+                    )
+                    for w in (wp.get("staticWeightList") or [])
+                ],
+                dynamic_weight=wp.get("dynamicWeight", ""),
+            )
+        strategy = pol.ReplicaSchedulingStrategy(
+            replica_scheduling_type=rs.get(
+                "replicaSchedulingType", pol.REPLICA_SCHEDULING_DUPLICATED
+            ),
+            replica_division_preference=rs.get("replicaDivisionPreference", ""),
+            weight_preference=prefs,
+        )
+    return pol.Placement(
+        cluster_affinity=cluster_affinity_from_json(d.get("clusterAffinity")),
+        cluster_affinities=[
+            pol.ClusterAffinityTerm(
+                affinity_name=t.get("affinityName", ""),
+                affinity=cluster_affinity_from_json(t) or pol.ClusterAffinity(),
+            )
+            for t in (d.get("clusterAffinities") or [])
+        ],
+        cluster_tolerations=[
+            _toleration(t) for t in (d.get("clusterTolerations") or [])
+        ],
+        spread_constraints=[
+            pol.SpreadConstraint(
+                spread_by_field=s.get("spreadByField", ""),
+                spread_by_label=s.get("spreadByLabel", ""),
+                min_groups=int(s.get("minGroups") or 1),
+                max_groups=int(s.get("maxGroups") or 0),
+            )
+            for s in (d.get("spreadConstraints") or [])
+        ],
+        replica_scheduling=strategy,
+    )
+
+
+def replica_requirements_from_json(d: Optional[dict]) -> Optional[ReplicaRequirements]:
+    if d is None:
+        return None
+    nc = d.get("nodeClaim")
+    claim = None
+    if nc is not None:
+        claim = NodeClaim(
+            node_selector=dict(nc.get("nodeSelector") or {}),
+            tolerations=list(nc.get("tolerations") or []),
+            hard_node_affinity=nc.get("hardNodeAffinity"),
+        )
+    return ReplicaRequirements(
+        node_claim=claim,
+        resource_request=resources_from_json(d.get("resourceRequest")),
+        namespace=d.get("namespace", ""),
+        priority_class_name=d.get("priorityClassName", ""),
+    )
+
+
+def binding_spec_from_json(d: dict) -> BindingSpec:
+    """workv1alpha2.ResourceBindingSpec JSON → BindingSpec (the scheduler's
+    slice of it: resource identity, replicas+requirements, placement,
+    previous clusters, reschedule trigger)."""
+    res = d.get("resource") or {}
+    return BindingSpec(
+        resource=ObjectReference(
+            api_version=res.get("apiVersion", ""),
+            kind=res.get("kind", ""),
+            namespace=res.get("namespace", ""),
+            name=res.get("name", ""),
+            uid=res.get("uid", ""),
+        ),
+        replicas=int(d.get("replicas") or 0),
+        replica_requirements=replica_requirements_from_json(
+            d.get("replicaRequirements")
+        ),
+        placement=placement_from_json(d.get("placement")),
+        clusters=[
+            TargetCluster(name=c.get("name", ""), replicas=int(c.get("replicas") or 0))
+            for c in (d.get("clusters") or [])
+        ],
+        scheduler_name=d.get("schedulerName", ""),
+        reschedule_triggered_at=rfc3339_to_epoch(d.get("rescheduleTriggeredAt")),
+    )
+
+
+def cluster_from_json(d: dict) -> Cluster:
+    """clusterv1alpha1.Cluster JSON → Cluster (the scheduler's slice:
+    identity/topology, taints, Ready condition, resource summary, API
+    enablements)."""
+    meta = d.get("metadata") or {}
+    spec = d.get("spec") or {}
+    status = d.get("status") or {}
+    summary = status.get("resourceSummary") or {}
+    nodes = status.get("nodeSummary") or {}
+    return Cluster(
+        metadata=ObjectMeta(
+            name=meta.get("name", ""),
+            labels=dict(meta.get("labels") or {}),
+        ),
+        spec=ClusterSpec(
+            sync_mode=spec.get("syncMode", "Push"),
+            provider=spec.get("provider", ""),
+            region=spec.get("region", ""),
+            zone=spec.get("zone", ""),
+            taints=[
+                Taint(
+                    key=t.get("key", ""),
+                    value=t.get("value", ""),
+                    effect=t.get("effect", ""),
+                    time_added=rfc3339_to_epoch(t.get("timeAdded")),
+                )
+                for t in (spec.get("taints") or [])
+            ],
+        ),
+        status=ClusterStatus(
+            kubernetes_version=status.get("kubernetesVersion", ""),
+            api_enablements=[
+                APIEnablement(
+                    group_version=e.get("groupVersion", ""),
+                    resources=[
+                        r.get("kind", "") for r in (e.get("resources") or [])
+                    ],
+                )
+                for e in (status.get("apiEnablements") or [])
+            ],
+            conditions=[
+                Condition(
+                    type=c.get("type", ""),
+                    status=c.get("status", ""),
+                    reason=c.get("reason", ""),
+                    message=c.get("message", ""),
+                )
+                for c in (status.get("conditions") or [])
+            ],
+            node_summary=NodeSummary(
+                total_num=int(nodes.get("totalNum") or 0),
+                ready_num=int(nodes.get("readyNum") or 0),
+            ),
+            resource_summary=ResourceSummary(
+                allocatable=resources_from_json(summary.get("allocatable")),
+                allocating=resources_from_json(summary.get("allocating")),
+                allocated=resources_from_json(summary.get("allocated")),
+            ),
+        ),
+    )
+
+
+def target_clusters_to_json(clusters: list[TargetCluster]) -> list[dict]:
+    """→ workv1alpha2.TargetCluster JSON (the ScheduleResult payload)."""
+    return [
+        {"name": tc.name, **({"replicas": tc.replicas} if tc.replicas else {})}
+        for tc in clusters
+    ]

@@ -1,0 +1,197 @@
+"""Scheduler sidecar shim: the north-star Go-interop seam (SURVEY §7 step 7).
+
+A stock karmada-scheduler's ScheduleAlgorithm contract
+(pkg/scheduler/core/generic_scheduler.go:36-38,70-115) is
+`Schedule(spec, status, option) -> []TargetCluster`. This service exposes
+that contract over HTTP with the reference's OWN JSON wire shapes
+(api/k8sjson.py): a Go plugin delegates by POSTing `json.Marshal(spec)`
+verbatim and patching the returned TargetCluster list — filter, score,
+SelectClusters and AssignReplicas all run in the batched JAX core.
+
+| method+path         | body                                   | returns |
+|---------------------|----------------------------------------|---------|
+| GET  /healthz       | —                                      | {ok}    |
+| POST /v1/clusters   | {"items": [clusterv1alpha1 JSON, ...]} | {count} — replaces the fleet snapshot |
+| POST /v1/schedule   | {"spec": RBSpec JSON, "status": {...}} | {"suggestedClusters": [TargetCluster...]} or {"error", "unschedulable"} |
+| POST /v1/scheduleBatch | {"items": [{"spec":...}, ...]}      | {"results": [...]} — ONE batched [B,C] solve |
+
+The batch endpoint is the TPU payoff: N dirty bindings arrive together and
+cost one device round instead of N sequential per-binding loops
+(the reference's Schedule is per-binding; SURVEY §3.1 HOT LOOPs 1-2).
+
+Unschedulable (capacity short / no feasible cluster) maps to HTTP 200 with
+`unschedulable: true` — it is a scheduling outcome, not a transport error,
+mirroring framework.FitError vs plain error (interface.go:71-93).
+"""
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+
+from ..api import k8sjson
+from ..api.meta import ObjectMeta, new_uid
+from ..api.work import BindingStatus, ResourceBinding
+
+
+class SchedulerShim:
+    """The service core, callable in-process or via serve()."""
+
+    def __init__(self, clusters: Optional[list] = None, estimator_registry=None):
+        self._lock = threading.Lock()
+        self._sched = None
+        self._estimators = estimator_registry
+        if clusters:
+            self.sync_clusters_typed(clusters)
+
+    # -- fleet snapshot ---------------------------------------------------
+
+    def sync_clusters(self, cluster_jsons: list[dict]) -> int:
+        return self.sync_clusters_typed(
+            [k8sjson.cluster_from_json(d) for d in cluster_jsons]
+        )
+
+    def sync_clusters_typed(self, clusters: list) -> int:
+        from ..sched.core import ArrayScheduler
+
+        sched = ArrayScheduler(clusters)
+        with self._lock:
+            self._sched = sched
+        return len(clusters)
+
+    # -- the ScheduleAlgorithm contract ----------------------------------
+
+    def schedule(self, spec_json: dict, status_json: Optional[dict] = None) -> dict:
+        return self.schedule_batch([{"spec": spec_json, "status": status_json}])[0]
+
+    def schedule_batch(self, items: list[dict]) -> list[dict]:
+        """One batched solve for N bindings; per-item result dicts in order."""
+        with self._lock:
+            sched = self._sched
+        if sched is None:
+            return [
+                {"error": "no cluster snapshot: POST /v1/clusters first",
+                 "unschedulable": False}
+                for _ in items
+            ]
+        bindings = []
+        for i, item in enumerate(items):
+            spec = k8sjson.binding_spec_from_json(item.get("spec") or {})
+            status = BindingStatus(
+                scheduler_observed_affinity_name=(
+                    (item.get("status") or {}).get("schedulerObservedAffinityName", "")
+                ),
+            )
+            name = spec.resource.name or f"item-{i}"
+            bindings.append(ResourceBinding(
+                metadata=ObjectMeta(
+                    namespace=spec.resource.namespace, name=f"{name}-{i}",
+                    uid=new_uid("shim"),
+                ),
+                spec=spec,
+                status=status,
+            ))
+        extra = None
+        if self._estimators is not None:
+            # optional accurate-estimator fan-out (EstimatorRegistry), e.g.
+            # the wire-compatible gRPC clients; min-merged i32[B,C] answers
+            extra = self._estimators.batch_estimates(
+                bindings, sched.fleet.names
+            )
+        decisions = sched.schedule(bindings, extra_avail=extra)
+        out = []
+        for d in decisions:
+            if d.error:
+                out.append({
+                    "error": d.error,
+                    # FitError-style outcomes are unschedulable, not failures
+                    "unschedulable": True,
+                })
+            else:
+                rec = {
+                    "suggestedClusters": k8sjson.target_clusters_to_json(d.targets),
+                }
+                if d.affinity_name:
+                    rec["appliedAffinityName"] = d.affinity_name
+                out.append(rec)
+        return out
+
+
+class SchedulerShimServer:
+    """HTTP front-end over SchedulerShim (loopback by default; front with
+    the estimator seam's mTLS material for cross-host deployments)."""
+
+    def __init__(self, shim: Optional[SchedulerShim] = None,
+                 host: str = "127.0.0.1", port: int = 0):
+        self.shim = shim or SchedulerShim()
+        self._host = host
+        self._port = port
+        self._httpd: Optional[ThreadingHTTPServer] = None
+
+    def start(self) -> int:
+        server = self
+
+        class Handler(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, fmt, *args):
+                pass
+
+            def _reply(self, status: int, body: dict) -> None:
+                data = json.dumps(body).encode()
+                self.send_response(status)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(data)))
+                self.end_headers()
+                self.wfile.write(data)
+
+            def _read(self) -> dict:
+                n = int(self.headers.get("Content-Length") or 0)
+                return json.loads(self.rfile.read(n).decode()) if n else {}
+
+            def do_GET(self):
+                if self.path == "/healthz":
+                    self._reply(200, {"ok": True})
+                else:
+                    self._reply(404, {"error": f"no route {self.path}"})
+
+            def do_POST(self):
+                try:
+                    body = self._read()
+                    if self.path == "/v1/clusters":
+                        n = server.shim.sync_clusters(body.get("items") or [])
+                        self._reply(200, {"count": n})
+                    elif self.path == "/v1/schedule":
+                        self._reply(200, server.shim.schedule(
+                            body.get("spec") or {}, body.get("status")
+                        ))
+                    elif self.path == "/v1/scheduleBatch":
+                        self._reply(200, {
+                            "results": server.shim.schedule_batch(
+                                body.get("items") or []
+                            ),
+                        })
+                    else:
+                        self._reply(404, {"error": f"no route {self.path}"})
+                except BrokenPipeError:
+                    pass
+                except Exception as e:  # noqa: BLE001 - wire boundary
+                    self._reply(500, {"error": f"{type(e).__name__}: {e}"})
+
+        self._httpd = ThreadingHTTPServer((self._host, self._port), Handler)
+        self._httpd.daemon_threads = True
+        self._port = self._httpd.server_address[1]
+        threading.Thread(
+            target=self._httpd.serve_forever, name="sched-shim", daemon=True
+        ).start()
+        return self._port
+
+    @property
+    def url(self) -> str:
+        return f"http://{self._host}:{self._port}"
+
+    def stop(self) -> None:
+        if self._httpd is not None:
+            self._httpd.shutdown()
+            self._httpd.server_close()

@@ -1,0 +1,262 @@
+"""Contract tests for the scheduler sidecar shim (VERDICT r4 missing #4).
+
+Inputs are REFERENCE-SHAPED JSON: what `json.Marshal` of Go
+workv1alpha2.ResourceBindingSpec / clusterv1alpha1.Cluster produces
+(binding_types.go / cluster types.go JSON tags). Expected placements are
+the Go path's answers per pkg/scheduler/core/{assignment,
+division_algorithm}.go and util/helper/binding.go's Dispenser — the shim
+must be a drop-in ScheduleAlgorithm (generic_scheduler.go:36-38).
+"""
+from __future__ import annotations
+
+import json
+import urllib.request
+
+import pytest
+
+from karmada_tpu.server.scheduler_shim import SchedulerShim, SchedulerShimServer
+
+
+def cluster_json(name, cpu="100", region="r1", taints=None, allocated="0"):
+    return {
+        "apiVersion": "cluster.karmada.io/v1alpha1",
+        "kind": "Cluster",
+        "metadata": {"name": name, "labels": {"fleet": "test"}},
+        "spec": {
+            "syncMode": "Push",
+            "region": region,
+            **({"taints": taints} if taints else {}),
+        },
+        "status": {
+            "kubernetesVersion": "v1.30.0",
+            "apiEnablements": [
+                {"groupVersion": "apps/v1",
+                 "resources": [{"name": "deployments", "kind": "Deployment"}]},
+            ],
+            "conditions": [
+                {"type": "Ready", "status": "True", "reason": "ClusterReady"},
+            ],
+            "resourceSummary": {
+                "allocatable": {"cpu": cpu, "memory": "400Gi", "pods": "1000"},
+                "allocated": {"cpu": allocated},
+            },
+        },
+    }
+
+
+def spec_json(name="app", replicas=0, placement=None, cpu_request="100m",
+              clusters=None, reschedule=None):
+    d = {
+        "resource": {"apiVersion": "apps/v1", "kind": "Deployment",
+                     "namespace": "default", "name": name},
+        "replicas": replicas,
+        "replicaRequirements": {
+            "resourceRequest": {"cpu": cpu_request},
+        },
+        "placement": placement or {},
+    }
+    if clusters:
+        d["clusters"] = clusters
+    if reschedule:
+        d["rescheduleTriggeredAt"] = reschedule
+    return d
+
+
+@pytest.fixture(scope="module")
+def shim():
+    s = SchedulerShim()
+    s.sync_clusters([
+        cluster_json("m1", cpu="10"),
+        cluster_json("m2", cpu="30", region="r2"),
+        cluster_json("m3", cpu="20", region="r2"),
+    ])
+    return s
+
+
+def targets_of(result):
+    assert "error" not in result, result
+    return {tc["name"]: tc.get("replicas", 0)
+            for tc in result["suggestedClusters"]}
+
+
+class TestScheduleContract:
+    def test_duplicated_full_replicas_everywhere(self, shim):
+        # assignByDuplicatedStrategy (assignment.go:176-182)
+        result = shim.schedule(spec_json(replicas=4, placement={
+            "clusterAffinity": {"clusterNames": ["m1", "m2", "m3"]},
+            "replicaScheduling": {"replicaSchedulingType": "Duplicated"},
+        }))
+        assert targets_of(result) == {"m1": 4, "m2": 4, "m3": 4}
+
+    def test_static_weight_largest_remainder(self, shim):
+        # TakeByWeight (util/helper/binding.go:112-144): 9 by 1:2 -> 3/6
+        result = shim.schedule(spec_json(replicas=9, placement={
+            "clusterAffinity": {"clusterNames": ["m1", "m2"]},
+            "replicaScheduling": {
+                "replicaSchedulingType": "Divided",
+                "replicaDivisionPreference": "Weighted",
+                "weightPreference": {"staticWeightList": [
+                    {"targetCluster": {"clusterNames": ["m1"]}, "weight": 1},
+                    {"targetCluster": {"clusterNames": ["m2"]}, "weight": 2},
+                ]},
+            },
+        }))
+        assert targets_of(result) == {"m1": 3, "m2": 6}
+
+    def test_dynamic_weight_by_available_replicas(self, shim):
+        # dynamicDivideReplicas (division_algorithm.go:75-99): free cpu
+        # m1=10 m2=30 m3=20 at 1 cpu/replica -> weights 10:30:20; 6 replicas
+        # -> 1/3/2
+        result = shim.schedule(spec_json(replicas=6, cpu_request="1", placement={
+            "clusterAffinity": {"clusterNames": ["m1", "m2", "m3"]},
+            "replicaScheduling": {
+                "replicaSchedulingType": "Divided",
+                "replicaDivisionPreference": "Weighted",
+                "weightPreference": {"dynamicWeight": "AvailableReplicas"},
+            },
+        }))
+        assert targets_of(result) == {"m1": 1, "m2": 3, "m3": 2}
+
+    def test_aggregated_packs_fewest_clusters(self, shim):
+        # division_algorithm.go:80-90: sort by available desc, truncate to
+        # covering prefix: m2(30) alone covers 8
+        result = shim.schedule(spec_json(replicas=8, cpu_request="1", placement={
+            "clusterAffinity": {"clusterNames": ["m1", "m2", "m3"]},
+            "replicaScheduling": {
+                "replicaSchedulingType": "Divided",
+                "replicaDivisionPreference": "Aggregated",
+            },
+        }))
+        assert targets_of(result) == {"m2": 8}
+
+    def test_taint_filters_untolerated_cluster(self):
+        shim = SchedulerShim()
+        shim.sync_clusters([
+            cluster_json("ok", cpu="10"),
+            cluster_json("tainted", cpu="10", taints=[
+                {"key": "maintenance", "value": "true", "effect": "NoSchedule"},
+            ]),
+        ])
+        result = shim.schedule(spec_json(replicas=2, placement={
+            "clusterAffinity": {"clusterNames": ["ok", "tainted"]},
+            "replicaScheduling": {"replicaSchedulingType": "Duplicated"},
+        }))
+        assert set(targets_of(result)) == {"ok"}
+
+        # with a matching toleration the taint no longer filters
+        result = shim.schedule(spec_json(replicas=2, placement={
+            "clusterAffinity": {"clusterNames": ["ok", "tainted"]},
+            "clusterTolerations": [
+                {"key": "maintenance", "operator": "Equal", "value": "true",
+                 "effect": "NoSchedule"},
+            ],
+            "replicaScheduling": {"replicaSchedulingType": "Duplicated"},
+        }))
+        assert set(targets_of(result)) == {"ok", "tainted"}
+
+    def test_unschedulable_is_an_outcome_not_an_error(self, shim):
+        # capacity 60 total at 1cpu; 1000 replicas cannot fit ->
+        # framework.FitError equivalent
+        result = shim.schedule(spec_json(replicas=1000, cpu_request="1", placement={
+            "clusterAffinity": {"clusterNames": ["m1", "m2", "m3"]},
+            "replicaScheduling": {
+                "replicaSchedulingType": "Divided",
+                "replicaDivisionPreference": "Weighted",
+                "weightPreference": {"dynamicWeight": "AvailableReplicas"},
+            },
+        }))
+        assert result.get("unschedulable") is True
+        assert result.get("error")
+
+    def test_steady_scale_up_keeps_prior_clusters_first(self, shim):
+        # assignment.go:120-173 resortAvailableClusters: previous clusters
+        # retain their replicas; only the delta disperses
+        result = shim.schedule(spec_json(
+            replicas=12, cpu_request="1",
+            clusters=[{"name": "m3", "replicas": 10}],
+            placement={
+                "clusterAffinity": {"clusterNames": ["m1", "m2", "m3"]},
+                "replicaScheduling": {
+                    "replicaSchedulingType": "Divided",
+                    "replicaDivisionPreference": "Aggregated",
+                },
+            }))
+        got = targets_of(result)
+        assert got.get("m3", 0) >= 10  # stickiness held
+        assert sum(got.values()) == 12
+
+    def test_batch_matches_singular(self, shim):
+        specs = [
+            spec_json("a", replicas=4, placement={
+                "clusterAffinity": {"clusterNames": ["m1", "m2", "m3"]},
+                "replicaScheduling": {"replicaSchedulingType": "Duplicated"},
+            }),
+            spec_json("b", replicas=9, placement={
+                "clusterAffinity": {"clusterNames": ["m1", "m2"]},
+                "replicaScheduling": {
+                    "replicaSchedulingType": "Divided",
+                    "replicaDivisionPreference": "Weighted",
+                    "weightPreference": {"staticWeightList": [
+                        {"targetCluster": {"clusterNames": ["m1"]}, "weight": 1},
+                        {"targetCluster": {"clusterNames": ["m2"]}, "weight": 2},
+                    ]},
+                },
+            }),
+        ]
+        batch = shim.schedule_batch([{"spec": s} for s in specs])
+        singular = [shim.schedule(s) for s in specs]
+        assert [targets_of(r) for r in batch] == [targets_of(r) for r in singular]
+
+
+class TestShimOverHttp:
+    def test_wire_roundtrip(self):
+        srv = SchedulerShimServer()
+        port = srv.start()
+        try:
+            def post(path, body):
+                req = urllib.request.Request(
+                    f"http://127.0.0.1:{port}{path}",
+                    data=json.dumps(body).encode(),
+                    headers={"Content-Type": "application/json"},
+                )
+                with urllib.request.urlopen(req, timeout=30) as r:
+                    return json.loads(r.read().decode())
+
+            out = post("/v1/clusters", {"items": [
+                cluster_json("m1", cpu="10"), cluster_json("m2", cpu="30"),
+            ]})
+            assert out == {"count": 2}
+
+            out = post("/v1/schedule", {"spec": spec_json(replicas=3, placement={
+                "clusterAffinity": {"clusterNames": ["m1", "m2"]},
+                "replicaScheduling": {"replicaSchedulingType": "Duplicated"},
+            })})
+            assert {tc["name"]: tc["replicas"]
+                    for tc in out["suggestedClusters"]} == {"m1": 3, "m2": 3}
+
+            out = post("/v1/scheduleBatch", {"items": [
+                {"spec": spec_json("x", replicas=2, placement={
+                    "clusterAffinity": {"clusterNames": ["m1"]},
+                    "replicaScheduling": {"replicaSchedulingType": "Duplicated"},
+                })},
+            ]})
+            assert out["results"][0]["suggestedClusters"] == [
+                {"name": "m1", "replicas": 2},
+            ]
+
+            # schedule before any snapshot: typed error, not a 500
+            srv2 = SchedulerShimServer()
+            p2 = srv2.start()
+            try:
+                req = urllib.request.Request(
+                    f"http://127.0.0.1:{p2}/v1/schedule",
+                    data=json.dumps({"spec": spec_json()}).encode(),
+                    headers={"Content-Type": "application/json"},
+                )
+                with urllib.request.urlopen(req, timeout=30) as r:
+                    out = json.loads(r.read().decode())
+                assert "no cluster snapshot" in out["error"]
+            finally:
+                srv2.stop()
+        finally:
+            srv.stop()
