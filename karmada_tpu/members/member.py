@@ -46,6 +46,10 @@ class InMemoryMember:
         # simulated per-pod resource usage by "kind/ns/name" → {resource: qty}
         # (what metrics-server would report; feeds the metrics adapter)
         self.workload_usage: dict[str, dict[str, float]] = {}
+        # custom.metrics.k8s.io samples: (groupResource, metric, ns, name)
+        # -> (value, labels); node usage for metrics.k8s.io node rows
+        self.custom_metrics: dict[tuple, tuple] = {}
+        self.node_usage: dict[str, dict[str, float]] = {}
         self.node_estimator = None
         if config.nodes:
             from ..estimator.accurate import AccurateEstimator
@@ -102,6 +106,96 @@ class InMemoryMember:
         else:
             ready = 0
         return ready, self.workload_usage.get(f"{kind}/{namespace}/{name}")
+
+    # -- metrics feeds (what a real member's metrics-server and
+    # custom-metrics pipeline would serve; queried by the metrics adapter) --
+
+    _POD_KINDS = ("Deployment", "StatefulSet", "Job", "DaemonSet", "Pod")
+
+    def list_pod_metrics(self, namespace: str = ""):
+        """metrics.k8s.io pod rows, synthesized per ready pod of each
+        workload. Rows carry the workload's labels plus the implicit
+        workload label so selector queries (and HPA) can address them."""
+        from ..metricsadapter.adapter import (
+            WORKLOAD_LABEL,
+            PodMetrics,
+            workload_label_value,
+        )
+
+        out = []
+        for gvk in list(self.store.kinds()):
+            kind = gvk.rsplit("/", 1)[-1]
+            if kind not in self._POD_KINDS:
+                continue
+            for obj in self.store.list(gvk, namespace):
+                ready, usage = self.pod_metrics(kind, obj.namespace, obj.name)
+                if ready <= 0:
+                    continue
+                labels = dict(obj.metadata.labels)
+                labels[WORKLOAD_LABEL] = workload_label_value(
+                    kind, obj.namespace, obj.name
+                )
+                for i in range(ready):
+                    out.append(PodMetrics(
+                        namespace=obj.namespace,
+                        name=f"{obj.name}-{i}",
+                        labels=dict(labels),
+                        usage=dict(usage or {}),
+                    ))
+        return out
+
+    def list_node_metrics(self):
+        """metrics.k8s.io node rows from the simulated node pool."""
+        from ..metricsadapter.adapter import NodeMetrics
+
+        out = []
+        for n in self.config.nodes or []:
+            out.append(NodeMetrics(
+                name=n.name,
+                labels=dict(n.labels),
+                usage=dict(self.node_usage.get(n.name, {})),
+                allocatable=dict(n.allocatable),
+            ))
+        return out
+
+    def set_node_usage(self, node: str, usage: dict[str, float]) -> None:
+        self.node_usage[node] = dict(usage)
+
+    def set_custom_metric(self, group_resource: str, metric: str, value: float,
+                          *, namespace: str = "", name: str = "",
+                          labels: Optional[dict] = None) -> None:
+        """Seed one custom.metrics.k8s.io sample on this member."""
+        self.custom_metrics[(group_resource, metric, namespace, name)] = (
+            float(value), dict(labels or {})
+        )
+
+    def query_custom_metrics(self, group_resource: str, metric: str, *,
+                             namespace: str = "", name: str = "",
+                             selector: Optional[dict] = None,
+                             metric_selector: Optional[dict] = None):
+        """The member-side custom-metrics query (by name or selector)."""
+        from ..metricsadapter.adapter import MetricValue, _selector_matches
+
+        out = []
+        for (gr, m, ns, n), (value, labels) in sorted(self.custom_metrics.items()):
+            if gr != group_resource or m != metric:
+                continue
+            if namespace and ns != namespace:
+                continue
+            if name and n != name:
+                continue
+            if not _selector_matches(selector, labels):
+                continue
+            if not _selector_matches(metric_selector, labels):
+                continue
+            out.append(MetricValue(
+                kind=group_resource, namespace=ns, name=n,
+                metric=metric, value=value,
+            ))
+        return out
+
+    def list_custom_metric_names(self):
+        return sorted({(gr, m) for (gr, m, _, _) in self.custom_metrics})
 
     def objects(self) -> list[Unstructured]:
         """Every object on the member, across kinds (proxy/CLI listing)."""

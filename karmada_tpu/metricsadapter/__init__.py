@@ -1,3 +1,28 @@
-from .adapter import MetricsAdapter, WorkloadMetrics
+from .adapter import (
+    WORKLOAD_LABEL,
+    CustomMetricInfo,
+    CustomMetricsProvider,
+    ExternalMetricsProvider,
+    ExternalMetricsUnsupportedError,
+    MetricNotFoundError,
+    MetricValue,
+    MetricsAdapter,
+    NodeMetrics,
+    PodMetrics,
+    ResourceMetricsProvider,
+    WorkloadMetrics,
+)
 
-__all__ = ["MetricsAdapter", "WorkloadMetrics"]
+__all__ = [
+    "CustomMetricInfo",
+    "CustomMetricsProvider",
+    "ExternalMetricsProvider",
+    "ExternalMetricsUnsupportedError",
+    "MetricNotFoundError",
+    "MetricValue",
+    "MetricsAdapter",
+    "NodeMetrics",
+    "PodMetrics",
+    "ResourceMetricsProvider",
+    "WorkloadMetrics",
+]

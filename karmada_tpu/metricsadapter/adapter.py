@@ -1,17 +1,209 @@
-"""karmada-metrics-adapter (A4, reference: pkg/metricsadapter/ — the
-custom-metrics aggregated API that fans a metric query out to every member
-cluster and merges the answers; consumed by the FederatedHPA controller).
+"""karmada-metrics-adapter (A4, reference: pkg/metricsadapter/ 1546 LoC).
 
-Here the fan-out is over the in-memory members' simulated metrics-server
-feeds; the merged answer is the federation-wide pod metric set."""
+The reference runs three aggregated-API providers, each fanning a query out
+to every member cluster and merging the answers:
+
+- **ResourceMetricsProvider** (provider/resourcemetrics.go): metrics.k8s.io
+  pod/node metrics by name or label selector, merged across clusters.
+- **CustomMetricsProvider** (provider/custommetrics.go): custom.metrics.k8s.io
+  object metrics; same-named objects in multiple clusters have their values
+  SUMMED (custommetrics.go:100-110,139-156).
+- **ExternalMetricsProvider** (provider/externalmetrics.go): declared but
+  unsupported — queries error, the metric list is empty.
+
+`MetricsAdapter` bundles the three; the FederatedHPA controller consumes
+pod metrics through the same by-selector query path an API user would
+(`adapter.resource.pod_metrics_by_selector`), not a bespoke feed.
+
+Member side, the in-memory clusters expose the two feeds a real member's
+metrics-server / custom-metrics pipeline would: per-pod resource usage
+synthesized from workload status, and seeded custom metrics
+(`member.set_custom_metric`).
+"""
 from __future__ import annotations
 
 from dataclasses import dataclass, field
+from typing import Optional
+
+
+class MetricNotFoundError(KeyError):
+    """provider.NewMetricNotFoundError equivalent."""
+
+
+class ExternalMetricsUnsupportedError(RuntimeError):
+    """externalmetrics.go:38: external metrics queries are not supported."""
+
+
+@dataclass(frozen=True)
+class CustomMetricInfo:
+    """provider.CustomMetricInfo: which resource the metric describes."""
+
+    group_resource: str = "pods"  # e.g. "pods", "deployments.apps"
+    metric: str = ""
+    namespaced: bool = True
+
+
+@dataclass
+class MetricValue:
+    """custom_metrics.MetricValue: one described object's metric answer."""
+
+    kind: str = ""
+    namespace: str = ""
+    name: str = ""
+    metric: str = ""
+    value: float = 0.0
+    # which member clusters contributed (values summed across them)
+    clusters: list[str] = field(default_factory=list)
+
+
+@dataclass
+class PodMetrics:
+    """metrics.k8s.io PodMetrics row, cluster-qualified after the merge."""
+
+    cluster: str = ""
+    namespace: str = ""
+    name: str = ""
+    labels: dict[str, str] = field(default_factory=dict)
+    usage: dict[str, float] = field(default_factory=dict)
+
+
+@dataclass
+class NodeMetrics:
+    cluster: str = ""
+    name: str = ""
+    labels: dict[str, str] = field(default_factory=dict)
+    usage: dict[str, float] = field(default_factory=dict)
+    allocatable: dict[str, float] = field(default_factory=dict)
+
+
+def _selector_matches(selector: Optional[dict], labels: dict) -> bool:
+    if not selector:
+        return True
+    return all(labels.get(k) == v for k, v in selector.items())
+
+
+class ResourceMetricsProvider:
+    """metrics.k8s.io across the fleet (resourcemetrics.go)."""
+
+    def __init__(self, members: dict):
+        self.members = members
+
+    def pod_metrics_by_selector(self, namespace: str = "",
+                                selector: Optional[dict] = None) -> list[PodMetrics]:
+        """Fan out to every member's pod-metrics feed and merge; rows carry
+        their cluster so same-named pods never collide."""
+        out: list[PodMetrics] = []
+        for cname, member in sorted(self.members.items()):
+            for pm in member.list_pod_metrics(namespace=namespace):
+                if not _selector_matches(selector, pm.labels):
+                    continue
+                pm.cluster = cname
+                out.append(pm)
+        return out
+
+    def pod_metrics_by_name(self, namespace: str, name: str) -> list[PodMetrics]:
+        """One row per cluster holding a pod of that name (the reference
+        returns every member's same-named pod)."""
+        return [
+            pm for pm in self.pod_metrics_by_selector(namespace=namespace)
+            if pm.name == name
+        ]
+
+    def node_metrics_by_selector(self, selector: Optional[dict] = None) -> list[NodeMetrics]:
+        out: list[NodeMetrics] = []
+        for cname, member in sorted(self.members.items()):
+            for nm in member.list_node_metrics():
+                if not _selector_matches(selector, nm.labels):
+                    continue
+                nm.cluster = cname
+                out.append(nm)
+        return out
+
+    def node_metrics_by_name(self, name: str) -> list[NodeMetrics]:
+        return [n for n in self.node_metrics_by_selector() if n.name == name]
+
+
+class CustomMetricsProvider:
+    """custom.metrics.k8s.io across the fleet (custommetrics.go)."""
+
+    def __init__(self, members: dict):
+        self.members = members
+
+    def get_metric_by_name(self, namespace: str, name: str,
+                           info: CustomMetricInfo,
+                           metric_selector: Optional[dict] = None) -> MetricValue:
+        """Query every member for one object's metric; an object present in
+        multiple clusters answers the SUM (custommetrics.go:100-110)."""
+        merged: Optional[MetricValue] = None
+        for cname, member in sorted(self.members.items()):
+            for mv in member.query_custom_metrics(
+                info.group_resource, info.metric,
+                namespace=namespace if info.namespaced else "",
+                name=name, metric_selector=metric_selector,
+            ):
+                if merged is None:
+                    merged = mv
+                    merged.clusters = [cname]
+                else:
+                    merged.value += mv.value
+                    merged.clusters.append(cname)
+        if merged is None:
+            raise MetricNotFoundError(
+                f"{info.group_resource}/{name}: metric {info.metric} not found"
+            )
+        return merged
+
+    def get_metric_by_selector(self, namespace: str, selector: Optional[dict],
+                               info: CustomMetricInfo,
+                               metric_selector: Optional[dict] = None) -> list[MetricValue]:
+        """Selector query; same-named described objects across clusters are
+        merged by summing (custommetrics.go:139-156)."""
+        merged: dict[str, MetricValue] = {}
+        for cname, member in sorted(self.members.items()):
+            for mv in member.query_custom_metrics(
+                info.group_resource, info.metric,
+                namespace=namespace if info.namespaced else "",
+                selector=selector, metric_selector=metric_selector,
+            ):
+                prev = merged.get(mv.name)
+                if prev is None:
+                    mv.clusters = [cname]
+                    merged[mv.name] = mv
+                else:
+                    prev.value += mv.value
+                    prev.clusters.append(cname)
+        if not merged:
+            raise MetricNotFoundError(
+                f"{info.group_resource}: metric {info.metric} not found"
+            )
+        return [merged[k] for k in sorted(merged)]
+
+    def list_all_metrics(self) -> list[CustomMetricInfo]:
+        """Every (resource, metric) any member currently serves."""
+        seen: set[CustomMetricInfo] = set()
+        for member in self.members.values():
+            for gr, metric in member.list_custom_metric_names():
+                seen.add(CustomMetricInfo(group_resource=gr, metric=metric))
+        return sorted(seen, key=lambda i: (i.group_resource, i.metric))
+
+
+class ExternalMetricsProvider:
+    """Declared but unsupported, like the reference
+    (externalmetrics.go:38-45)."""
+
+    def get_external_metric(self, namespace: str, selector, info) -> None:
+        raise ExternalMetricsUnsupportedError(
+            "karmada-metrics-adapter does not support external metrics"
+        )
+
+    def list_all_external_metrics(self) -> list:
+        return []
 
 
 @dataclass
 class WorkloadMetrics:
-    """Merged pod metrics for one workload across the federation."""
+    """Merged pod metrics for one workload across the federation (the
+    FHPA controller's consumption shape, computed FROM the query API)."""
 
     ready_pods: int = 0
     # per-cluster: cluster name -> (pods, per-pod usage dict)
@@ -25,20 +217,41 @@ class WorkloadMetrics:
         return self.total_usage.get(resource, 0.0) / self.ready_pods
 
 
+# the implicit workload label every synthesized pod row carries, so HPA-style
+# consumers select a workload's pods the way a label selector would
+WORKLOAD_LABEL = "resourcebinding.karmada.io/workload"
+
+
+def workload_label_value(kind: str, namespace: str, name: str) -> str:
+    return f"{kind}.{namespace}.{name}".lower()
+
+
 class MetricsAdapter:
+    """The adapter bundle: three providers behind one object (adapter.go)."""
+
     def __init__(self, members: dict):
         self.members = members
+        self.resource = ResourceMetricsProvider(members)
+        self.custom = CustomMetricsProvider(members)
+        self.external = ExternalMetricsProvider()
 
     def collect(self, kind: str, namespace: str, name: str) -> WorkloadMetrics:
-        """Fan out to every member (the adapter's multi-cluster query path)
-        and merge: total usage = Σ pods × per-pod usage."""
+        """Workload view used by FederatedHPA — answered THROUGH the pod
+        query API (by the workload's implicit selector), merged per cluster."""
+        rows = self.resource.pod_metrics_by_selector(
+            namespace=namespace,
+            selector={WORKLOAD_LABEL: workload_label_value(kind, namespace, name)},
+        )
         out = WorkloadMetrics()
-        for cname, member in self.members.items():
-            pods, usage = member.pod_metrics(kind, namespace, name)
-            if pods <= 0 or usage is None:
+        for pm in rows:
+            if not pm.usage:
+                # a member without a usage feed must not dilute the average
+                # toward zero (it would bias FHPA to under-scale); the old
+                # bespoke feed skipped non-reporting members the same way
                 continue
-            out.ready_pods += pods
-            out.by_cluster[cname] = (pods, dict(usage))
-            for res, v in usage.items():
-                out.total_usage[res] = out.total_usage.get(res, 0.0) + pods * v
+            out.ready_pods += 1
+            pods, usage = out.by_cluster.get(pm.cluster, (0, dict(pm.usage)))
+            out.by_cluster[pm.cluster] = (pods + 1, usage)
+            for res, v in pm.usage.items():
+                out.total_usage[res] = out.total_usage.get(res, 0.0) + v
         return out
