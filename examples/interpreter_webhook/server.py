@@ -1,0 +1,116 @@
+"""Runnable interpreter-webhook example (the reference ships the same demo
+as examples/customresourceinterpreter: a `Workload` CRD whose replicas,
+revision, retention, status and health are interpreted by an external HTTPS
+hook server instead of in-tree code).
+
+Run it:
+
+    python examples/interpreter_webhook/server.py [--port N]
+
+It prints its URL and the CA bundle to trust, then serves the
+ResourceInterpreterContext wire protocol. Point a
+ResourceInterpreterWebhookConfiguration at it:
+
+    ResourceInterpreterWebhookConfiguration(
+        metadata=ObjectMeta(name="workload-hooks"),
+        webhooks=[InterpreterWebhook(
+            name="workload.example.com",
+            url="<printed url>", ca_bundle="<printed ca>",
+            rules=[InterpreterRule(api_versions=["workload.example.io/v1alpha1"],
+                                   kinds=["Workload"], operations=["*"])],
+        )],
+    )
+"""
+from __future__ import annotations
+
+import argparse
+import sys
+import time
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parents[2]))
+
+
+class WorkloadHooks:
+    """Dict-level interpreter for the example Workload CRD (the same five
+    operations the reference demo implements in Go)."""
+
+    def get_replicas(self, obj: dict):
+        spec = obj.get("spec") or {}
+        requirements = None
+        res = ((spec.get("template") or {}).get("spec") or {}).get("resources")
+        if res:
+            requirements = {"resourceRequest": res.get("requests") or {}}
+        return int(spec.get("replicas") or 0), requirements
+
+    def revise_replica(self, obj: dict, replicas: int) -> dict:
+        out = dict(obj)
+        out["spec"] = dict(obj.get("spec") or {})
+        out["spec"]["replicas"] = int(replicas)
+        return out
+
+    def retain(self, desired: dict, observed: dict) -> dict:
+        # keep the member-set paused field, like the reference demo retains
+        # .spec.paused
+        out = dict(desired)
+        spec_obs = observed.get("spec") or {}
+        if "paused" in spec_obs:
+            out["spec"] = dict(out.get("spec") or {})
+            out["spec"]["paused"] = spec_obs["paused"]
+        return out
+
+    def aggregate_status(self, obj: dict, items: list) -> dict:
+        ready = sum(
+            int((i.get("status") or {}).get("readyReplicas") or 0)
+            for i in items
+        )
+        out = dict(obj)
+        out["status"] = dict(obj.get("status") or {})
+        out["status"]["readyReplicas"] = ready
+        return out
+
+    def reflect_status(self, obj: dict):
+        return obj.get("status") or {}
+
+    def interpret_health(self, obj: dict) -> bool:
+        spec = obj.get("spec") or {}
+        status = obj.get("status") or {}
+        return int(status.get("readyReplicas") or 0) >= int(spec.get("replicas") or 0)
+
+    def get_dependencies(self, obj: dict) -> list:
+        ref = ((obj.get("spec") or {}).get("configRef")) or None
+        if not ref:
+            return []
+        return [{
+            "apiVersion": "v1", "kind": "ConfigMap",
+            "namespace": (obj.get("metadata") or {}).get("namespace", ""),
+            "name": ref,
+        }]
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--port", type=int, default=0)
+    ap.add_argument("--plain-http", action="store_true",
+                    help="serve without TLS (testing only)")
+    args = ap.parse_args()
+
+    from karmada_tpu.auth.pki import CertificateAuthority
+    from karmada_tpu.interpreter.webhook_http import InterpreterHookServer
+
+    pki = None if args.plain_http else CertificateAuthority("interpreter-example-ca")
+    server = InterpreterHookServer(WorkloadHooks(), port=args.port, pki=pki)
+    server.start()
+    print(f"serving {server.url}", flush=True)
+    if pki is not None:
+        print("--- trust this CA bundle ---")
+        print(pki.ca_pem.decode(), flush=True)
+    try:
+        while True:
+            time.sleep(3600)
+    except KeyboardInterrupt:
+        server.stop()
+
+
+if __name__ == "__main__":
+    main()

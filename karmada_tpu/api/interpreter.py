@@ -75,7 +75,11 @@ class InterpreterRule:
 @dataclass
 class InterpreterWebhook:
     name: str = ""
-    url: str = ""  # in-process endpoint name in the HookRegistry
+    # in-process endpoint name in the HookRegistry, or a real http(s):// URL
+    # of an interpreter hook server (examples/customresourceinterpreter)
+    url: str = ""
+    # PEM CA bundle for https:// hooks (clientConfig.caBundle)
+    ca_bundle: str = ""
     rules: list[InterpreterRule] = field(default_factory=list)
     timeout_seconds: int = 10
 

@@ -20,6 +20,7 @@ from __future__ import annotations
 
 import datetime
 import hashlib
+import time
 import secrets
 import string
 from dataclasses import dataclass
@@ -61,7 +62,10 @@ class CertificateAuthority:
         from cryptography.hazmat.primitives.asymmetric import ec
         from cryptography.x509.oid import NameOID
 
-        self._clock = clock or (lambda: 0.0)
+        # default to the wall clock: certificates must satisfy REAL TLS
+        # validity checks (the HTTPS hook servers verify against this CA);
+        # tests inject a fixed clock for determinism
+        self._clock = clock or time.time
         self._key = ec.generate_private_key(ec.SECP256R1())
         now = _now_dt(self._clock())
         name = x509.Name([x509.NameAttribute(NameOID.COMMON_NAME, common_name)])
@@ -94,6 +98,7 @@ class CertificateAuthority:
         common_name: str,
         organizations: tuple[str, ...] = (),
         ttl_seconds: float = 365 * 86400.0,
+        dns_names: tuple[str, ...] = (),
     ) -> IssuedCertificate:
         from cryptography import x509
         from cryptography.hazmat.primitives import hashes, serialization
@@ -107,7 +112,7 @@ class CertificateAuthority:
         attrs.extend(
             x509.NameAttribute(NameOID.ORGANIZATION_NAME, o) for o in organizations
         )
-        cert = (
+        builder = (
             x509.CertificateBuilder()
             .subject_name(x509.Name(attrs))
             .issuer_name(self._cert.subject)
@@ -115,8 +120,23 @@ class CertificateAuthority:
             .serial_number(x509.random_serial_number())
             .not_valid_before(now)
             .not_valid_after(now + datetime.timedelta(seconds=ttl_seconds))
-            .sign(self._key, hashes.SHA256())
         )
+        if dns_names:
+            # server certs: modern TLS hostname verification requires SANs;
+            # IP-literal names must be iPAddress entries (OpenSSL refuses to
+            # match an IP peer against a DNSName SAN)
+            import ipaddress
+
+            sans = []
+            for n in dns_names:
+                try:
+                    sans.append(x509.IPAddress(ipaddress.ip_address(n)))
+                except ValueError:
+                    sans.append(x509.DNSName(n))
+            builder = builder.add_extension(
+                x509.SubjectAlternativeName(sans), critical=False,
+            )
+        cert = builder.sign(self._key, hashes.SHA256())
         return IssuedCertificate(
             cert_pem=cert.public_bytes(serialization.Encoding.PEM),
             key_pem=key.private_bytes(
@@ -159,7 +179,7 @@ class BootstrapTokens:
     DEFAULT_TTL_S = 24 * 3600.0  # cmdinit default: 24h
 
     def __init__(self, clock: Optional[Callable[[], float]] = None):
-        self._clock = clock or (lambda: 0.0)
+        self._clock = clock or time.time
         self._tokens: dict[str, BootstrapToken] = {}
 
     def create(self, ttl_seconds: float = DEFAULT_TTL_S,

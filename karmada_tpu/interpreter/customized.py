@@ -168,18 +168,37 @@ class DeclarativeInterpreterManager:
 
 
 class HookRegistry:
-    """Named in-process interpreter endpoints (the webhook servers)."""
+    """Interpreter hook endpoints: named in-process handlers, plus real
+    http(s):// hook servers reached through HttpHookClient (the reference's
+    webhook mode, customized/webhook/) — resolved lazily per URL+CA."""
 
     def __init__(self) -> None:
         self._endpoints: dict[str, Any] = {}
+        self._http_clients: dict[tuple, Any] = {}
 
     def register(self, url: str, handler: Any) -> None:
         """handler: object with optional methods named like the operations
         (get_replicas(obj dict) -> (n, req), interpret_health(obj) -> bool...)."""
         self._endpoints[url] = handler
 
-    def get(self, url: str) -> Optional[Any]:
-        return self._endpoints.get(url)
+    def get(self, url: str, ca_bundle: str = "",
+            timeout_seconds: float = 10.0) -> Optional[Any]:
+        handler = self._endpoints.get(url)
+        if handler is not None:
+            return handler
+        if url.startswith(("http://", "https://")):
+            key = (url, ca_bundle, timeout_seconds)
+            client = self._http_clients.get(key)
+            if client is None:
+                from .webhook_http import HttpHookClient
+
+                client = HttpHookClient(
+                    url, ca_pem=ca_bundle.encode() if ca_bundle else None,
+                    timeout=float(timeout_seconds),
+                )
+                self._http_clients[key] = client
+            return client
+        return None
 
 
 class WebhookInterpreterManager:
@@ -205,7 +224,10 @@ class WebhookInterpreterManager:
             key=lambda c: c.metadata.name,
         ):
             for wh in cfg.webhooks:
-                handler = self.hooks.get(wh.url)
+                handler = self.hooks.get(
+                    wh.url, getattr(wh, "ca_bundle", ""),
+                    timeout_seconds=getattr(wh, "timeout_seconds", 10) or 10,
+                )
                 if handler is None:
                     continue
                 for rule in wh.rules:
@@ -228,7 +250,13 @@ class WebhookInterpreterManager:
             def get_replicas(obj: Unstructured):
                 n, req = handler.get_replicas(obj.to_dict())
                 requirements = (
-                    ReplicaRequirements(resource_request=dict(req), namespace=obj.namespace)
+                    ReplicaRequirements(
+                        resource_request={
+                            k: float(_parse_quantity(v))
+                            for k, v in dict(req).items()
+                        },
+                        namespace=obj.namespace,
+                    )
                     if req else None
                 )
                 return int(n), requirements

@@ -45,6 +45,7 @@ from urllib.parse import parse_qs, urlparse
 from ..store.store import ConflictError, NotFoundError
 from ..webhook.handlers import AdmissionDenied
 from . import codec
+from .httpbase import read_json, send_json
 
 _WATCH_END = object()
 
@@ -169,22 +170,11 @@ class ControlPlaneServer:
 
     @staticmethod
     def _send(h, status: int, body: dict) -> None:
-        try:
-            data = json.dumps(body).encode()
-            h.send_response(status)
-            h.send_header("Content-Type", "application/json")
-            h.send_header("Content-Length", str(len(data)))
-            h.end_headers()
-            h.wfile.write(data)
-        except (BrokenPipeError, ConnectionResetError):
-            pass
+        send_json(h, status, body)
 
     @staticmethod
     def _body(h) -> dict:
-        n = int(h.headers.get("Content-Length") or 0)
-        if n == 0:
-            return {}
-        return json.loads(h.rfile.read(n).decode())
+        return read_json(h)
 
     # -- handlers ---------------------------------------------------------
 

@@ -1,0 +1,78 @@
+"""Shared plumbing for the JSON-over-HTTP services (control-plane apiserver,
+scheduler shim, interpreter hook server): one place for reply/read framing
+and the background ThreadingHTTPServer lifecycle."""
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+
+
+class QuietHandler(BaseHTTPRequestHandler):
+    """HTTP/1.1 handler with request logging off."""
+
+    protocol_version = "HTTP/1.1"
+
+    def log_message(self, fmt, *args):  # noqa: D102 - intentionally quiet
+        pass
+
+
+def send_json(handler: BaseHTTPRequestHandler, status: int, body: dict) -> None:
+    try:
+        data = json.dumps(body).encode()
+        handler.send_response(status)
+        handler.send_header("Content-Type", "application/json")
+        handler.send_header("Content-Length", str(len(data)))
+        handler.end_headers()
+        handler.wfile.write(data)
+    except (BrokenPipeError, ConnectionResetError):
+        pass
+
+
+def read_json(handler: BaseHTTPRequestHandler) -> dict:
+    n = int(handler.headers.get("Content-Length") or 0)
+    if n == 0:
+        return {}
+    return json.loads(handler.rfile.read(n).decode())
+
+
+class BackgroundHTTPServer:
+    """A ThreadingHTTPServer served from a daemon thread; `start()` returns
+    the bound port (0 = ephemeral)."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0):
+        self._host = host
+        self._port = port
+        self._httpd: Optional[ThreadingHTTPServer] = None
+
+    def bind(self, handler_cls, name: str) -> int:
+        self.bind_only(handler_cls)
+        return self.serve(name)
+
+    def bind_only(self, handler_cls) -> ThreadingHTTPServer:
+        """Bind without serving (callers that wrap the socket — TLS — do it
+        between bind and serve)."""
+        self._httpd = ThreadingHTTPServer((self._host, self._port), handler_cls)
+        self._httpd.daemon_threads = True
+        return self._httpd
+
+    def serve(self, name: str) -> int:
+        self._port = self._httpd.server_address[1]
+        threading.Thread(
+            target=self._httpd.serve_forever, name=name, daemon=True
+        ).start()
+        return self._port
+
+    @property
+    def port(self) -> int:
+        return self._port
+
+    @property
+    def host(self) -> str:
+        return self._host
+
+    def stop(self) -> None:
+        if self._httpd is not None:
+            self._httpd.shutdown()
+            self._httpd.server_close()

@@ -25,14 +25,13 @@ mirroring framework.FitError vs plain error (interface.go:71-93).
 """
 from __future__ import annotations
 
-import json
 import threading
-from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional
 
 from ..api import k8sjson
 from ..api.meta import ObjectMeta, new_uid
 from ..api.work import BindingStatus, ResourceBinding
+from .httpbase import BackgroundHTTPServer, QuietHandler, read_json, send_json
 
 
 class SchedulerShim:
@@ -125,73 +124,46 @@ class SchedulerShimServer:
     def __init__(self, shim: Optional[SchedulerShim] = None,
                  host: str = "127.0.0.1", port: int = 0):
         self.shim = shim or SchedulerShim()
-        self._host = host
-        self._port = port
-        self._httpd: Optional[ThreadingHTTPServer] = None
+        self._server = BackgroundHTTPServer(host, port)
 
     def start(self) -> int:
         server = self
 
-        class Handler(BaseHTTPRequestHandler):
-            protocol_version = "HTTP/1.1"
-
-            def log_message(self, fmt, *args):
-                pass
-
-            def _reply(self, status: int, body: dict) -> None:
-                data = json.dumps(body).encode()
-                self.send_response(status)
-                self.send_header("Content-Type", "application/json")
-                self.send_header("Content-Length", str(len(data)))
-                self.end_headers()
-                self.wfile.write(data)
-
-            def _read(self) -> dict:
-                n = int(self.headers.get("Content-Length") or 0)
-                return json.loads(self.rfile.read(n).decode()) if n else {}
-
+        class Handler(QuietHandler):
             def do_GET(self):
                 if self.path == "/healthz":
-                    self._reply(200, {"ok": True})
+                    send_json(self, 200, {"ok": True})
                 else:
-                    self._reply(404, {"error": f"no route {self.path}"})
+                    send_json(self, 404, {"error": f"no route {self.path}"})
 
             def do_POST(self):
                 try:
-                    body = self._read()
+                    body = read_json(self)
                     if self.path == "/v1/clusters":
                         n = server.shim.sync_clusters(body.get("items") or [])
-                        self._reply(200, {"count": n})
+                        send_json(self, 200, {"count": n})
                     elif self.path == "/v1/schedule":
-                        self._reply(200, server.shim.schedule(
+                        send_json(self, 200, server.shim.schedule(
                             body.get("spec") or {}, body.get("status")
                         ))
                     elif self.path == "/v1/scheduleBatch":
-                        self._reply(200, {
+                        send_json(self, 200, {
                             "results": server.shim.schedule_batch(
                                 body.get("items") or []
                             ),
                         })
                     else:
-                        self._reply(404, {"error": f"no route {self.path}"})
+                        send_json(self, 404, {"error": f"no route {self.path}"})
                 except BrokenPipeError:
                     pass
                 except Exception as e:  # noqa: BLE001 - wire boundary
-                    self._reply(500, {"error": f"{type(e).__name__}: {e}"})
+                    send_json(self, 500, {"error": f"{type(e).__name__}: {e}"})
 
-        self._httpd = ThreadingHTTPServer((self._host, self._port), Handler)
-        self._httpd.daemon_threads = True
-        self._port = self._httpd.server_address[1]
-        threading.Thread(
-            target=self._httpd.serve_forever, name="sched-shim", daemon=True
-        ).start()
-        return self._port
+        return self._server.bind(Handler, "sched-shim")
 
     @property
     def url(self) -> str:
-        return f"http://{self._host}:{self._port}"
+        return f"http://{self._server.host}:{self._server.port}"
 
     def stop(self) -> None:
-        if self._httpd is not None:
-            self._httpd.shutdown()
-            self._httpd.server_close()
+        self._server.stop()

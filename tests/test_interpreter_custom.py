@@ -297,3 +297,115 @@ class TestThirdparty:
         })
         out = cp.interpreter.revise_replica(cs, 7)
         assert out.get("spec", "replicas") == 7
+
+
+class TestHttpsInterpreterWebhook:
+    """I5 over a real socket (VERDICT r4 missing #5): the hook crosses
+    HTTPS with the reference's ResourceInterpreterContext wire shapes,
+    TLS-verified against the control plane CA."""
+
+    @pytest.fixture()
+    def hook_server(self):
+        import importlib.util
+        from pathlib import Path
+
+        from karmada_tpu.auth.pki import CertificateAuthority
+        from karmada_tpu.interpreter.webhook_http import InterpreterHookServer
+
+        # load the example by file path under a unique module name — no
+        # sys.path/sys.modules pollution for the rest of the session
+        example = (Path(__file__).resolve().parents[1]
+                   / "examples" / "interpreter_webhook" / "server.py")
+        spec = importlib.util.spec_from_file_location(
+            "_example_interpreter_hook_server", example)
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+
+        pki = CertificateAuthority("hook-ca")
+        srv = InterpreterHookServer(mod.WorkloadHooks(), pki=pki)
+        srv.start()
+        yield srv, pki
+        srv.stop()
+
+    def _config(self, url, ca_pem):
+        from karmada_tpu.api.interpreter import (
+            InterpreterRule,
+            InterpreterWebhook,
+            ResourceInterpreterWebhookConfiguration,
+        )
+        from karmada_tpu.api.meta import ObjectMeta
+
+        return ResourceInterpreterWebhookConfiguration(
+            metadata=ObjectMeta(name="workload-hooks"),
+            webhooks=[InterpreterWebhook(
+                name="workload.example.com", url=url, ca_bundle=ca_pem,
+                rules=[InterpreterRule(
+                    api_versions=["workload.example.io/v1alpha1"],
+                    kinds=["Workload"], operations=["*"],
+                )],
+            )],
+        )
+
+    def test_all_operations_cross_the_socket(self, hook_server):
+        from karmada_tpu.api.unstructured import Unstructured
+        from karmada_tpu.controlplane import ControlPlane
+        from karmada_tpu.interpreter.interpreter import HEALTHY, UNHEALTHY
+
+        srv, pki = hook_server
+        cp = ControlPlane()
+        cp.store.create(self._config(srv.url, pki.ca_pem.decode()))
+        cp.settle()
+
+        w = Unstructured({
+            "apiVersion": "workload.example.io/v1alpha1", "kind": "Workload",
+            "metadata": {"name": "w", "namespace": "default"},
+            "spec": {"replicas": 5, "configRef": "w-config",
+                     "template": {"spec": {"resources": {
+                         "requests": {"cpu": "250m"}}}}},
+            "status": {"readyReplicas": 5},
+        })
+        n, req = cp.interpreter.get_replicas(w)
+        assert n == 5
+        assert req is not None and req.resource_request["cpu"] == 0.25
+
+        revised = cp.interpreter.revise_replica(w, 9)
+        assert revised.get("spec", "replicas") == 9
+
+        observed = Unstructured(dict(w.to_dict()))
+        observed.set("spec", "paused", True)
+        retained = cp.interpreter.retain(w, observed)
+        assert retained.get("spec", "paused") is True
+
+        assert cp.interpreter.interpret_health(w) == HEALTHY
+        sick = Unstructured(dict(w.to_dict()))
+        sick.set("status", "readyReplicas", 1)
+        assert cp.interpreter.interpret_health(sick) == UNHEALTHY
+
+        deps = cp.interpreter.get_dependencies(w)
+        assert deps and deps[0]["name"] == "w-config"
+
+    def test_wrong_ca_is_rejected(self, hook_server):
+        from karmada_tpu.auth.pki import CertificateAuthority
+        from karmada_tpu.interpreter.webhook_http import HttpHookClient
+
+        srv, _ = hook_server
+        other = CertificateAuthority("not-the-hook-ca")
+        client = HttpHookClient(srv.url, ca_pem=other.ca_pem)
+        with pytest.raises(Exception) as ei:
+            client.interpret_health({"spec": {}, "status": {}})
+        assert "CERTIFICATE_VERIFY_FAILED" in str(ei.value) or "certificate" in str(ei.value).lower()
+
+    def test_json_patch_roundtrip(self):
+        from karmada_tpu.interpreter.webhook_http import (
+            json_patch_apply,
+            json_patch_diff,
+        )
+
+        old = {"spec": {"replicas": 2, "keep": [1, 2], "drop": "x"},
+               "meta": {"a": 1}}
+        new = {"spec": {"replicas": 5, "keep": [1, 2], "added": {"k": "v"}},
+               "meta": {"a": 1}}
+        patch = json_patch_diff(old, new)
+        assert json_patch_apply(old, patch) == new
+        ops = {op["op"] for op in patch}
+        assert ops == {"replace", "remove", "add"}
