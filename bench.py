@@ -539,7 +539,7 @@ def main() -> None:
     if args.verbose:
         print(f"# cpu fallback: {'; '.join(attempts)}")
     cpu_ok = [c for c in args.configs.split(",")
-              if c in ("dup3", "static", "dynamic", "flagship")]
+              if c in ("dup3", "static", "dynamic", "churn", "flagship")]
     if cpu_ok:
         args.configs = ",".join(cpu_ok)  # run_child reads args.configs
     r = run_child("cpu", min(args.iters, 2))

@@ -25,8 +25,74 @@ from typing import NamedTuple
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 I32_MAX = jnp.int32(2**31 - 1)
+
+
+# --------------------------------------------------------------------------
+# host-tail specialization (CPU backend)
+#
+# XLA:CPU lowers lax.sort to a scalar comparator loop: the two [B,C]
+# multi-key sorts of the division tail cost ~40 s of the 44 s CPU flagship
+# round (scripts/profile_phases.py). On the cpu backend the ArrayScheduler
+# therefore runs the WHOLE division tail as numpy (`host_tail`, the 1:1
+# mirror of combined_assign below): the dispenser bonus cutoff becomes an
+# O(B·C) selection (np.partition + a stable rank of the tied group) and the
+# Aggregated truncation a packed single-key np.sort. NOT a pure_callback —
+# in-jit host callbacks deadlock this jax build's single CPU stream (the
+# callback's device_put of its args queues behind the running program).
+# TPU/mesh paths are untouched (under a mesh the rows/columns are sharded
+# and a host cutoff over partial rows would be wrong anyway).
+# --------------------------------------------------------------------------
+
+
+def _agg_keep_cb(prior, weight, tgt, active):
+    """Aggregated truncation membership on host: rows ordered by
+    (prior desc, weight desc, col asc) keep the shortest prefix whose
+    cumulative weight covers tgt. Packed single-key np.sort when
+    1 + weight-bits + col-bits fit an int64 (always at realistic shapes),
+    else a stable lexsort fallback."""
+    B, C = weight.shape
+    keep = np.ones((B, C), bool)
+    act = np.flatnonzero(active)
+    if act.size == 0:
+        return keep
+    w = weight[act]
+    pr = prior[act].astype(np.int64)
+    t = tgt[act].astype(np.int64)
+    ib = max((C - 1).bit_length(), 1)
+    wmax = int(w.max(initial=0))
+    wb = max(wmax.bit_length(), 1)
+    iota = np.arange(C, dtype=np.int64)
+    if 1 + wb + ib <= 63:
+        packed = (
+            ((1 - pr) << (wb + ib)) | ((wmax - w) << ib) | iota[None, :]
+        )
+        ps = np.sort(packed, axis=-1)
+        ws = wmax - ((ps >> ib) & ((1 << wb) - 1))
+        cum = np.cumsum(ws, axis=-1)
+        k = ((cum - ws) < t[:, None]).sum(-1)
+        cutoff = np.take_along_axis(
+            ps, np.clip(k - 1, 0, C - 1)[:, None], axis=-1
+        )
+        keep[act] = (packed <= cutoff) & (k > 0)[:, None]
+    else:
+        key1 = -pr
+        key2 = -w
+        order = np.lexsort((key2, key1), axis=-1)
+        ws = np.take_along_axis(w, order, axis=-1)
+        cum = np.cumsum(ws, axis=-1)
+        k = ((cum - ws) < t[:, None]).sum(-1)
+        idx = np.clip(k - 1, 0, C - 1)[:, None]
+        co = np.take_along_axis(order, idx, axis=-1)
+        c1 = np.take_along_axis(key1, co, axis=-1)
+        c2 = np.take_along_axis(key2, co, axis=-1)
+        le = (key1 < c1) | (
+            (key1 == c1) & ((key2 < c2) | ((key2 == c2) & (iota[None, :] <= co)))
+        )
+        keep[act] = le & (k > 0)[:, None]
+    return keep
 
 
 def _pack_last_tie(last, tie):
@@ -343,3 +409,146 @@ def min_merge(estimates, replicas):
     masked = jnp.where(estimates < 0, I32_MAX, estimates)
     merged = masked.min(axis=0)
     return jnp.where(merged == I32_MAX, replicas[:, None], merged)
+
+
+def _host_dispense(weight, last, seeds, tgt, init):
+    """take_by_weight as numpy over a row subset (same order semantics).
+
+    The bonus set — the first `rem` columns by (weight desc, last desc, tie
+    asc) — is built by per-row SELECTION: columns strictly heavier than the
+    cutoff weight are all in; the cutoff-weight tie group is ranked stably
+    by (packed last/tie, col) and its first m members join. Tie values are
+    computed only for tied columns (splitmix64 from the row seed — the same
+    per-(binding, cluster) stream as models.batch.tie_matrix), so no [B,C]
+    tie matrix or packed key is ever materialized."""
+    from ..models.batch import _mix64
+
+    B, C = weight.shape
+    sum_w = weight.sum(-1)
+    safe_sum = np.maximum(sum_w, 1)
+    quota = weight * tgt[:, None] // safe_sum[:, None]
+    rem = tgt - quota.sum(-1)
+    bonus = np.zeros((B, C), bool)
+    for b in np.flatnonzero((sum_w > 0) & (rem > 0)):
+        kb = min(int(rem[b]), C)
+        row1 = -weight[b]
+        v1 = np.partition(row1, kb - 1)[kb - 1]
+        less = row1 < v1
+        bonus[b, less] = True
+        m = kb - int(less.sum())
+        t = np.flatnonzero(row1 == v1)
+        tie_vals = (
+            _mix64(np.uint64(seeds[b]) ^ (t.astype(np.uint64) + np.uint64(1)))
+            >> np.uint64(33)
+        ).astype(np.int64)
+        k2 = (
+            (np.int64(2**31 - 1) - last[b, t].astype(np.int64)) << 32
+        ) | tie_vals
+        # first m of the tie group by (k2, col): everything strictly below
+        # the m-th k2 value, then fill from the pivot-valued cols in col
+        # order (t is ascending, so the boolean gather is already col-sorted)
+        pv = np.partition(k2, m - 1)[m - 1]
+        lt = k2 < pv
+        bonus[b, t[lt]] = True
+        need = m - int(lt.sum())
+        if need > 0:
+            bonus[b, t[k2 == pv][:need]] = True
+    bonus &= weight > 0
+    ok = sum_w > 0
+    return init + np.where(ok[:, None], quota + bonus, 0).astype(np.int32)
+
+
+def host_tail(
+    feasible,  # bool[B,C]
+    avail,  # i32[B,C]
+    prev,  # i32[B,C]
+    seeds,  # u64[B] tie seeds (models.batch BindingBatch.seeds)
+    static_weight,  # i64[B,C]
+    strategy,  # i32[B] (models.batch strategy codes)
+    replicas,  # i32[B]
+    fresh,  # bool[B]
+    strategy_codes,  # (STATIC_WEIGHT, DYNAMIC_WEIGHT, AGGREGATED)
+    topk: int,
+):
+    """The division tail as pure numpy — the CPU-backend twin of
+    assignment_tail→combined_assign→take_by_weight (placement-identical;
+    guarded by TestHostSortParity's randomized A/B). Returns the
+    _tail_kernel output shape: (result, unschedulable, avail_sum, nnz,
+    top_idx, top_val), all numpy.
+
+    Same formulas as the jit path, restructured for a single-core host:
+    static and dynamic rows are processed as SUBSETS (the jit path computes
+    both variants full-width and row-selects — free on TPU, 2x wasted
+    passes on CPU), and the two order computations run as selection /
+    packed sort instead of comparator-loop lax.sort (module header)."""
+    STATIC, DYNW, AGG = strategy_codes
+    feasible = np.asarray(feasible)
+    avail = np.asarray(avail)
+    prev = np.asarray(prev)
+    seeds = np.asarray(seeds)
+    B, C = feasible.shape
+
+    result = np.zeros((B, C), np.int32)
+    unschedulable = np.zeros(B, bool)
+    avail_sum = np.zeros(B, np.int64)
+
+    # --- static rows (assignment.go:194-206) ---
+    rs = np.flatnonzero(strategy == STATIC)
+    if rs.size:
+        feas = feasible[rs]
+        w = np.where(feas, static_weight[rs], 0).astype(np.int64)
+        all_zero = w.sum(-1) == 0
+        w = np.where(all_zero[:, None] & feas, 1, w)
+        last = np.where(feas, prev[rs], 0).astype(np.int32)
+        tgt = replicas[rs].astype(np.int64)
+        result[rs] = _host_dispense(
+            w, last, seeds[rs], tgt, np.zeros_like(last)
+        )
+
+    # --- dynamic rows (assignment.go:208-239) ---
+    rd = np.flatnonzero((strategy == DYNW) | (strategy == AGG))
+    if rd.size:
+        feas = feasible[rd]
+        avail_m = np.where(feas, avail[rd], 0).astype(np.int64)
+        prev_m = np.where(feas, prev[rd], 0).astype(np.int64)
+        assigned = prev_m.sum(-1)
+        target_spec = replicas[rd].astype(np.int64)
+        fr = fresh[rd]
+        down = ~fr & (assigned > target_spec)
+        up = ~fr & (assigned < target_spec)
+        eq = ~fr & (assigned == target_spec)
+        w = np.where(
+            fr[:, None], avail_m + prev_m,
+            np.where(down[:, None], prev_m, avail_m),
+        )
+        init = np.where(up[:, None], prev_m, 0).astype(np.int32)
+        tgt = np.where(up, target_spec - assigned, target_spec)
+        a_sum = w.sum(-1)
+        unsched = ~eq & (a_sum < tgt)
+
+        # Aggregated truncation (division_algorithm.go:80-90)
+        act = (strategy[rd] == AGG) & ~eq
+        if act.any():
+            prior = up[:, None] & (prev_m > 0)
+            keep = _agg_keep_cb(prior, w, tgt, act)
+            w = np.where(act[:, None] & ~keep, 0, w)
+        last = np.where(up[:, None], prev_m, 0).astype(np.int32)
+
+        dispensed = _host_dispense(w, last, seeds[rd], tgt, init)
+        res = np.where(eq[:, None], prev_m.astype(np.int32), dispensed)
+        res = np.where(unsched[:, None], 0, res)
+        result[rd] = res
+        unschedulable[rd] = unsched
+        avail_sum[rd] = a_sum
+
+    # compact window (compact_outputs): any window holding every positive
+    # entry is decode-equivalent — _sorted_pairs reorders by cluster index
+    # and rows with nnz > topk take the dense fallback fetch
+    k = min(topk, C)
+    nnz = (result > 0).sum(-1).astype(np.int32)
+    top_idx = np.argpartition(-result, k - 1, axis=-1)[:, :k].astype(np.int32)
+    top_val = np.take_along_axis(result, top_idx, axis=-1)
+    return (
+        result, unschedulable, avail_sum.astype(np.int32), nnz,
+        top_idx, top_val,
+    )

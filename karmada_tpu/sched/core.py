@@ -37,6 +37,10 @@ from ..ops import assign as assign_ops
 from ..ops import filters as filter_ops
 from . import plugins as plugin_mod
 
+# below this [tail rows x C] volume the numpy host tail loses to the jit
+# kernel (per-row Python overhead); tests pin it to 0 to force the host path
+HOST_TAIL_MIN_ELEMS = 2_000_000
+
 # compact-output width: covers every row whose target count is <= this
 # (divided rows are bounded by spec.replicas; wider duplicated rows fetch
 # their dense result row as a fallback)
@@ -625,6 +629,22 @@ class ArrayScheduler:
         # recipe: annotate shardings, let XLA partition). The explicit
         # shard_map kernel remains as the monolithic mode.
         self.mesh_partitioned = True
+        # CPU backend, unsharded: route the division-tail sorts through the
+        # host selection path (XLA:CPU's comparator-loop sort costs ~40 s at
+        # the flagship shape; see ops/assign.py module header). Never under
+        # a mesh — shards see partial rows. KARMADA_TPU_HOST_SORTS=0/1
+        # overrides.
+        import os
+
+        env = os.environ.get("KARMADA_TPU_HOST_SORTS", "")
+        if env in ("0", "off", "false"):
+            self._host_sorts = False
+        elif env in ("1", "on", "true"):
+            self._host_sorts = mesh is None
+        else:
+            self._host_sorts = (
+                mesh is None and jax.default_backend() == "cpu"
+            )
         self.set_clusters(clusters)
 
     def set_clusters(self, clusters: Sequence) -> None:
@@ -1065,16 +1085,45 @@ class ArrayScheduler:
             rsel = idx_pad.astype(np.int64)
             t_feas = _gather_rows_kernel(dev_feasible, idx_pad)
             t_avail = _gather_rows_kernel(dev_avail, idx_pad)
-            t_prev = _gather_rows_kernel(dev_prev, idx_pad)
-            t_tie = _gather_rows_kernel(dev_tie, idx_pad)
             max_repl = int(raw.replicas[rows].max(initial=0))
             topk = min(pow2_bucket(min(max_repl, TOPK_TARGETS), lo=8), TOPK_TARGETS)
-            t_out = _tail_kernel(
-                t_feas, t_avail, t_prev, t_tie,
-                batch.weight_tables, batch.weight_idx[rsel],
-                batch.strategy[rsel], batch.replicas[rsel], batch.fresh[rsel],
-                topk=topk, narrow=narrow, has_agg=has_agg, narrow16=narrow16,
-            )
+            if self._host_sorts and len(rows) * C >= HOST_TAIL_MIN_ELEMS:
+                # the numpy tail wins only once the [rows, C] sort volume
+                # dwarfs its per-row Python overhead; small tails stay on
+                # the (already fast) jit kernel
+                # cpu backend: the division tail runs as numpy — XLA:CPU's
+                # comparator-loop sorts cost ~40 s at the flagship shape
+                # while the host selection/packed-sort twin lands the same
+                # placements in seconds (ops/assign.py host_tail). Only the
+                # filter-phase outputs cross from the device; prev/tie
+                # reconstruct from the factored batch, and the jit-bucket
+                # padding is sliced off (host work needs no shape buckets).
+                rsub = np.asarray(rows, np.int64)
+                h_feas, h_avail = jax.device_get((t_feas, t_avail))
+                h_feas = np.asarray(h_feas)[:nr]
+                h_avail = np.asarray(h_avail)[:nr]
+                pidx = np.asarray(batch.prev_idx)[rsub]
+                prep = np.asarray(batch.prev_rep)[rsub]
+                h_prev = np.zeros((nr, C), np.int32)
+                rr, cc = np.nonzero((pidx >= 0) & (pidx < C))
+                h_prev[rr, pidx[rr, cc]] = prep[rr, cc]
+                t_out = assign_ops.host_tail(
+                    h_feas, h_avail, h_prev, np.asarray(batch.seeds)[rsub],
+                    np.asarray(batch.weight_tables)[batch.weight_idx[rsub]],
+                    batch.strategy[rsub], batch.replicas[rsub],
+                    batch.fresh[rsub],
+                    (STATIC_WEIGHT, DYNAMIC_WEIGHT, AGGREGATED),
+                    topk=topk,
+                )
+            else:
+                t_prev = _gather_rows_kernel(dev_prev, idx_pad)
+                t_tie = _gather_rows_kernel(dev_tie, idx_pad)
+                t_out = _tail_kernel(
+                    t_feas, t_avail, t_prev, t_tie,
+                    batch.weight_tables, batch.weight_idx[rsel],
+                    batch.strategy[rsel], batch.replicas[rsel], batch.fresh[rsel],
+                    topk=topk, narrow=narrow, has_agg=has_agg, narrow16=narrow16,
+                )
             tails.append((rows, t_out))
 
         # ---- phase 2 launch: duplicated / non-workload target sets ----
@@ -1135,9 +1184,12 @@ class ArrayScheduler:
                     continue
                 row_target_src[b] = ("pairs", names, tis[k, :n], tvs[k, :n])
             if overflow:
-                o_res = fetch_rows(
-                    t_out[0], [k for k, _ in overflow], self._bucket
-                )
+                if isinstance(t_out[0], np.ndarray):  # host tail: no fetch
+                    o_res = t_out[0][[k for k, _ in overflow]]
+                else:
+                    o_res = fetch_rows(
+                        t_out[0], [k for k, _ in overflow], self._bucket
+                    )
                 for j, (_, b) in enumerate(overflow):
                     pos = np.nonzero(o_res[j] > 0)[0]
                     row_target_src[b] = (

@@ -420,3 +420,58 @@ class TestDecodeSourceInvariant:
         for d in decisions:
             assert d.ok, d.error
             assert d._targets_src is not None or d._targets is not None
+
+
+class TestHostSortParity:
+    """The CPU host-sort specialization must be placement-identical to the
+    XLA sort path (ops/assign.py module header): randomized A/B at a
+    non-trivial shape across all strategies."""
+
+    def test_host_vs_xla_sorts_identical(self, monkeypatch):
+        import numpy as np
+
+        from karmada_tpu.sched.core import ArrayScheduler
+        from karmada_tpu.testing.fixtures import (
+            duplicated_placement,
+            static_weight_placement,
+            synthetic_fleet,
+        )
+        import bench
+
+        rng = np.random.default_rng(7)
+        clusters = synthetic_fleet(64, seed=7)
+        names = [c.name for c in clusters]
+        placements = [
+            duplicated_placement(names[:8]),
+            static_weight_placement({names[j]: j + 1 for j in range(6)}),
+            bench._dyn_placement(aggregated=False),
+            bench._dyn_placement(aggregated=True),
+        ]
+        bindings = []
+        for i in range(160):
+            prev = (
+                {names[int(rng.integers(64))]: int(rng.integers(1, 6))}
+                if i % 3 == 0 else None
+            )
+            bindings.append(bench._binding(
+                i, int(rng.integers(1, 40)), placements[i % 4],
+                float(rng.choice([0.1, 0.25, 0.5])), prev=prev,
+            ))
+
+        from karmada_tpu.sched import core as core_mod
+
+        monkeypatch.setenv("KARMADA_TPU_HOST_SORTS", "1")
+        monkeypatch.setattr(core_mod, "HOST_TAIL_MIN_ELEMS", 0)
+        host = ArrayScheduler(clusters)
+        assert host._host_sorts
+        d_host = host.schedule(bindings)
+
+        monkeypatch.setenv("KARMADA_TPU_HOST_SORTS", "0")
+        xla = ArrayScheduler(clusters)
+        assert not xla._host_sorts
+        d_xla = xla.schedule(bindings)
+
+        for a, b in zip(d_host, d_xla):
+            assert a.error == b.error, a.key
+            assert [(t.name, t.replicas) for t in a.targets] == \
+                [(t.name, t.replicas) for t in b.targets], a.key
