@@ -74,7 +74,7 @@ from .modeling import ModelBasedEstimator
 from .runtime.controller import Clock, Runtime
 from .sched.scheduler import SchedulerDaemon
 from .search import ResourceCache, SearchProxy
-from .store.store import Store
+from .store.store import ConflictError, Store
 from .webhook import default_admission_chain
 
 # re-exported from the cluster API (shared with the remote agent's
@@ -347,6 +347,10 @@ class ControlPlane:
         collected from the member (the cluster status controller's
         syncClusterStatus in one step: health, API enablements, resource
         summary — cluster_status_controller.go:181,544-679)."""
+        if config.name in self.members:
+            # double-join stays a loud failure (the restart re-attach path
+            # below only applies when no member sim is attached yet)
+            raise ConflictError(f"member {config.name} already joined")
         member = InMemoryMember(config)
         self.members[config.name] = member
         if member.node_estimator is not None:
@@ -361,7 +365,23 @@ class ControlPlane:
         # suppression cache so a later one-shot NotReady probe is retained
         # until it holds for the failure threshold
         self.condition_cache.threshold_adjusted_ready(config.name, None, "True")
-        self.store.create(cluster)
+        existing = self.store.try_get("Cluster", config.name)
+        if existing is None:
+            self.store.create(cluster)
+        else:
+            # restart flow: the Cluster object was restored from the
+            # persisted store and this call re-attaches the member behind
+            # it — refresh what the member owns (identity + capacity; the
+            # config may have changed across the restart) while keeping
+            # control-plane-written state (taints, conditions, remedies)
+            existing.spec.sync_mode = cluster.spec.sync_mode
+            existing.spec.provider = cluster.spec.provider
+            existing.spec.region = cluster.spec.region
+            existing.spec.zone = cluster.spec.zone
+            existing.spec.resource_models = cluster.spec.resource_models
+            existing.metadata.labels.update(cluster.metadata.labels)
+            existing.status.resource_summary = cluster.status.resource_summary
+            self.store.update(existing)
         if self.work_status_controller is not None:
             self.work_status_controller.watch_member(member)
         # the search cache's per-cluster dynamic informer (proxy WATCH bus)

@@ -30,6 +30,9 @@ def main() -> None:
     ap.add_argument("--platform", default="",
                     help="pin the jax platform (e.g. cpu); default = the "
                          "ambient backend (TPU where available)")
+    ap.add_argument("--data-dir", default="",
+                    help="persist store state (snapshot + WAL) here and "
+                         "restore it on start; empty = in-memory only")
     args = ap.parse_args()
 
     if args.platform == "cpu":
@@ -49,6 +52,14 @@ def main() -> None:
     from .apiserver import ControlPlaneServer
 
     cp = ControlPlane(controllers=args.controllers.split(","))
+    persistence = None
+    if args.data_dir:
+        from ..store.persistence import StorePersistence
+
+        persistence = StorePersistence(cp.store, args.data_dir)
+        n = persistence.load()  # controllers are subscribed: state replays
+        persistence.attach()
+        print(f"restored {n} objects from {args.data_dir}", flush=True)
     GiB = 1024.0**3
     for i in range(1, args.members + 1):
         cp.join_member(MemberConfig(
@@ -84,6 +95,9 @@ def main() -> None:
             time.sleep(3600)
     except KeyboardInterrupt:
         srv.stop()
+        if persistence is not None:
+            persistence.snapshot()
+            persistence.close()
 
 
 if __name__ == "__main__":

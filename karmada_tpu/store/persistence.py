@@ -1,0 +1,187 @@
+"""Durable control-plane state: snapshot + write-ahead log over the store.
+
+The reference's L1 persists in etcd; every controller is level-triggered
+and resumes from informer cache (SURVEY §5 checkpoint note). This module
+is that durability for the TPU build's store: every watch event appends a
+codec-encoded JSON line to `wal.jsonl`; a periodic (or explicit) snapshot
+rotates the WAL aside, rewrites `snapshot.jsonl` atomically, then drops
+the rotated WAL; `load()` replays whatever files survive into the store
+via `Store.restore`, which notifies subscribers as ADDED — so a daemon
+started with `--data-dir` converges to its pre-restart state the way
+controllers converge after an informer relist.
+
+Crash-safety without ordering games: replay applies a record only when its
+resourceVersion is >= the highest seen for that object key (store RVs are
+monotonic), so snapshot + rotated WAL + live WAL merge correctly no matter
+which rename a crash interrupted, and a torn tail line just ends that
+file's replay.
+
+Device state needs no persistence at all: the fleet arrays are a pure
+cache rebuilt from the Cluster objects this file restores. Member-cluster
+SIMULATIONS are not persisted — they stand in for real clusters, which
+survive a control-plane restart on their own (push members re-join via
+flags/CLI; pull agents re-register and their works re-apply).
+"""
+from __future__ import annotations
+
+import json
+import os
+import threading
+from typing import Any, Optional
+
+from ..server import codec
+from .store import DELETED, Store
+
+SNAPSHOT_FILE = "snapshot.jsonl"
+WAL_FILE = "wal.jsonl"
+WAL_ROTATED = "wal.1.jsonl"
+
+
+class StorePersistence:
+    def __init__(self, store: Store, data_dir: str, *,
+                 snapshot_every: int = 5000):
+        self.store = store
+        self.data_dir = data_dir
+        self.snapshot_every = snapshot_every
+        os.makedirs(data_dir, exist_ok=True)
+        # guards ONLY the WAL file handle — never call into the store while
+        # holding it (watch handlers can run with the store lock held)
+        self._lock = threading.Lock()
+        self._wal: Optional[Any] = None
+        self._wal_len = 0
+        self._attached = False
+
+    # -- restore ----------------------------------------------------------
+
+    def load(self) -> int:
+        """Replay snapshot + rotated WAL + WAL into the store. Call after
+        the consuming controllers subscribed (they receive the state as
+        ADDED events, like an informer's initial list) and before
+        attach()."""
+        latest: dict[tuple, tuple[int, Any]] = {}  # key -> (rv, obj|None)
+        for name in (SNAPSHOT_FILE, WAL_ROTATED, WAL_FILE):
+            path = self._path(name)
+            if not os.path.exists(path):
+                continue
+            with open(path) as f:
+                for line in f:
+                    line = line.strip()
+                    if not line:
+                        continue
+                    try:
+                        rec = json.loads(line)
+                    except json.JSONDecodeError:
+                        break  # torn tail write (crash mid-append)
+                    try:
+                        obj = codec.decode(rec["obj"])
+                    except Exception as e:  # noqa: BLE001 - one bad record
+                        # must not drop the rest of the file (a decode
+                        # failure is schema drift/corruption, not a tail)
+                        import logging
+
+                        logging.getLogger(__name__).warning(
+                            "skipping undecodable %s record in %s: %s",
+                            rec.get("kind"), path, e,
+                        )
+                        continue
+                    key = (rec["kind"], obj.metadata.namespace,
+                           obj.metadata.name)
+                    rv = obj.metadata.resource_version
+                    if key in latest and rv < latest[key][0]:
+                        continue  # older than what another file delivered
+                    latest[key] = (rv, None if rec["event"] == DELETED else obj)
+        return self.store.restore(
+            obj for _, obj in latest.values() if obj is not None
+        )
+
+    # -- capture ----------------------------------------------------------
+
+    def attach(self) -> None:
+        """Subscribe to the store and append every event to the WAL."""
+        if self._attached:
+            return
+        self._attached = True
+        with self._lock:
+            self._open_wal()
+        self.store.watch_all(self._on_event, replay=False)
+
+    def _on_event(self, kind: str, event: str, obj: Any) -> None:
+        line = json.dumps({
+            "kind": kind, "event": event, "obj": codec.encode(obj),
+        })
+        with self._lock:
+            if self._wal is None:
+                return
+            self._wal.write(line + "\n")
+            self._wal.flush()
+            self._wal_len += 1
+            need_snapshot = self._wal_len >= self.snapshot_every
+        if need_snapshot:
+            self.snapshot()
+
+    def snapshot(self) -> int:
+        """Rotate the WAL aside, write the full store state atomically,
+        then drop the rotated WAL. Any crash point leaves a recoverable
+        combination (load() is rv-ordered, not file-ordered).
+
+        Correctness of the rotation point: a WAL line is written only
+        AFTER its mutation committed to the store, so every line in the
+        rotated WAL is reflected in the state listed below; lines arriving
+        after the rotation land in the fresh WAL."""
+        wal1 = self._path(WAL_ROTATED)
+        with self._lock:
+            if self._wal is not None:
+                self._wal.close()
+            wal = self._path(WAL_FILE)
+            if os.path.exists(wal):
+                if os.path.exists(wal1):
+                    # previous snapshot crashed mid-flight: merge, keeping
+                    # chronological order within the rotated file
+                    with open(wal1, "a") as dst, open(wal) as src:
+                        dst.write(src.read())
+                    os.remove(wal)
+                else:
+                    os.replace(wal, wal1)
+            self._open_wal(truncate=True)
+
+        records = []
+        for kind in self.store.kinds():
+            for obj in self.store.list(kind):
+                records.append(json.dumps({
+                    "kind": kind, "event": "ADDED", "obj": codec.encode(obj),
+                }))
+        tmp = self._path(SNAPSHOT_FILE + ".tmp")
+        with open(tmp, "w") as f:
+            f.write("\n".join(records) + ("\n" if records else ""))
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, self._path(SNAPSHOT_FILE))
+        if os.path.exists(wal1):
+            os.remove(wal1)
+        return len(records)
+
+    def close(self) -> None:
+        self.store.unwatch_all(self._on_event)
+        with self._lock:
+            if self._wal is not None:
+                self._wal.close()
+                self._wal = None
+        self._attached = False
+
+    # -- helpers ----------------------------------------------------------
+
+    def _path(self, name: str) -> str:
+        return os.path.join(self.data_dir, name)
+
+    def _open_wal(self, truncate: bool = False) -> None:
+        mode = "w" if truncate else "a"
+        self._wal = open(self._path(WAL_FILE), mode)
+        self._wal_len = 0 if truncate else self._count_lines(self._path(WAL_FILE))
+
+    @staticmethod
+    def _count_lines(path: str) -> int:
+        try:
+            with open(path) as f:
+                return sum(1 for _ in f)
+        except OSError:
+            return 0

@@ -194,6 +194,9 @@ class Store:
                 m.generation += 1
             if m.deletion_timestamp is not None and not m.finalizers:
                 del b.objects[key]
+                # removal gets a FRESH rv: a DELETED event must order after
+                # every prior write of the object (WAL replay is rv-ordered)
+                m.resource_version = self._next_rv()
                 out = copy.deepcopy(stored)
                 deleted = True
             else:
@@ -235,6 +238,7 @@ class Store:
                 deleted = False
             else:
                 del b.objects[key]
+                obj.metadata.resource_version = self._next_rv()  # see update()
                 out = copy.deepcopy(obj)
                 deleted = True
         self._notify(kind, DELETED if deleted else MODIFIED, out)
@@ -247,6 +251,27 @@ class Store:
             return a != b
         except Exception:
             return True
+
+    # -- restore (persistence) --------------------------------------------
+
+    def restore(self, objects: Iterable[Any]) -> int:
+        """Load persisted objects verbatim — uid/resourceVersion/generation
+        kept, admission NOT re-run (the reference's apiserver does not
+        re-admit etcd content on restart). Watchers are notified ADDED so
+        already-subscribed level-triggered controllers converge, exactly as
+        an informer relist would deliver the initial state."""
+        loaded = []
+        with self._lock:
+            for obj in objects:
+                kind = gvk_of(obj)
+                b = self._bucket(kind)
+                stored = copy.deepcopy(obj)
+                b.objects[self._key(stored.metadata)] = stored
+                self._rv = max(self._rv, stored.metadata.resource_version)
+                loaded.append((kind, copy.deepcopy(stored)))
+        for kind, obj in loaded:
+            self._notify(kind, ADDED, obj)
+        return len(loaded)
 
     # -- watch ------------------------------------------------------------
 
