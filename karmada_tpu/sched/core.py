@@ -1157,6 +1157,8 @@ class ArrayScheduler:
         spread_pre = self._spread_prelaunch(
             bindings, batch, batched_rows, batched_cfg,
             dev_feasible, dev_score, dev_avail, dev_prev, dev_tie,
+            extra_avail=extra_avail, extra_mask=extra_mask,
+            extra_score=extra_score,
         )
 
         # ---- THE sync ----
@@ -1282,6 +1284,7 @@ class ArrayScheduler:
     def _spread_prelaunch(
         self, bindings, batch, batched_rows, batched_cfg,
         dev_feasible, dev_score, dev_avail, dev_prev, dev_tie,
+        extra_avail=None, extra_mask=None, extra_score=None,
     ):
         """LAUNCH the batched-spread group scoring (gathers + one kernel) and
         return the device handles — no sync. The partitioned round folds the
@@ -1295,7 +1298,6 @@ class ArrayScheduler:
         layout = self._spread_layout
         idx_pad, nb = _pad_rows_idx(batched_rows, self._bucket)
         g_feas = _gather_rows_kernel(dev_feasible, idx_pad)
-        g_score = _gather_rows_kernel(dev_score, idx_pad)
         g_avail = _gather_rows_kernel(dev_avail, idx_pad)
         if dev_prev is not None:
             g_prev = _gather_rows_kernel(dev_prev, idx_pad)
@@ -1318,20 +1320,90 @@ class ArrayScheduler:
             target[j] = -(-bindings[b].spec.replicas // mg)
             reps[j] = bindings[b].spec.replicas
             dupf[j] = cfg.duplicated
-        score_kernel = (
-            spread_batch.group_score_kernel
-            if layout.grid_balanced
-            else spread_batch.group_score_kernel_segmented  # skewed fleets
-        )
-        W, V, A, fc_dev = score_kernel(
-            g_feas, g_score, g_avail, g_prev,
-            reps, need, target, dupf, layout=layout,
-        )
+
+        # dedup rows whose SCORING inputs are identical — policy-heavy
+        # batches collapse ~25x (5k rows over 200 placements), so only
+        # representative rows pay the [S, C] member sort (device or host);
+        # the overlay expands (W, V, fc) back through `score_inv`
+        rep_of: dict[tuple, int] = {}
+        rep_js: list[int] = []
+        inv = np.empty(len(batched_rows), np.int64)
+
+        def row_bytes(x, b):
+            # per-row term that feeds dev_feasible/score/avail (estimator
+            # answers, out-of-tree plugin masks/scores): rows differing in
+            # them must never share a scoring representative
+            if x is None:
+                return None
+            arr = np.asarray(x)
+            if arr.shape[:1] == (1,) and arr.ndim == 2 and arr.shape[0] == 1:
+                return b"same"  # broadcast sentinel: identical for all rows
+            return arr[b].tobytes()
+
+        for j, b in enumerate(batched_rows):
+            key = (
+                int(batch.aff_idx[b]), int(batch.tol_idx[b]),
+                int(batch.gvk[b]), int(batch.req_idx[b]),
+                bool(batch.unknown_request[b]), int(batch.replicas[b]),
+                batch.evict_idx[b].tobytes(),
+                batch.prev_idx[b].tobytes(), batch.prev_rep[b].tobytes(),
+                int(need[j]), int(target[j]), bool(dupf[j]),
+                row_bytes(extra_avail, b), row_bytes(extra_mask, b),
+                row_bytes(extra_score, b),
+            )
+            r = rep_of.get(key)
+            if r is None:
+                r = len(rep_js)
+                rep_of[key] = r
+                rep_js.append(j)
+            inv[j] = r
+        rep_b = [batched_rows[j] for j in rep_js]
+        rep_pad, nrep = _pad_rows_idx(rep_b, self._bucket)
+        r_feas = _gather_rows_kernel(dev_feasible, rep_pad)
+        r_score = _gather_rows_kernel(dev_score, rep_pad)
+        r_avail = _gather_rows_kernel(dev_avail, rep_pad)
+        if dev_prev is not None:
+            r_prev = _gather_rows_kernel(dev_prev, rep_pad)
+        else:
+            r_prev, _ = _row_context_kernel(
+                batch.prev_idx[rep_pad], batch.prev_rep[rep_pad],
+                batch.seeds[rep_pad], n_cols=C,
+            )
+        Sr = len(rep_pad)
+        # per-row scalars padded like rep_pad (pads repeat the first row)
+        jsel = np.asarray(
+            rep_js + [rep_js[0]] * (Sr - nrep), np.int64
+        ) if rep_js else np.zeros(Sr, np.int64)
+        need_r = need[jsel]
+        target_r = target[jsel]
+        reps_r = reps[jsel]
+        dupf_r = dupf[jsel]
+
+        if self._host_sorts and Sr * C >= HOST_TAIL_MIN_ELEMS:
+            # cpu backend: the group-scoring member sort runs as numpy
+            # (host_group_score — same outputs, packed np.argsort instead
+            # of XLA:CPU's comparator-loop sort)
+            h = jax.device_get((r_feas, r_score, r_avail, r_prev))
+            W, V, A, fc_dev = spread_batch.host_group_score(
+                h[0], h[1], h[2], h[3],
+                reps_r, need_r, target_r, dupf_r, layout=layout,
+            )
+        else:
+            score_kernel = (
+                spread_batch.group_score_kernel
+                if layout.grid_balanced
+                else spread_batch.group_score_kernel_segmented  # skewed
+            )
+            W, V, A, fc_dev = score_kernel(
+                r_feas, r_score, r_avail, r_prev,
+                reps_r, need_r, target_r, dupf_r, layout=layout,
+            )
         return {
             "idx_pad": idx_pad, "nb": nb,
             "g_feas": g_feas, "g_avail": g_avail,
             "g_prev": g_prev, "g_tie": g_tie,
             "wvf": (W, V, fc_dev),
+            "score_inv": inv, "score_nrep": nrep,
         }
 
     def _spread_overlay(
@@ -1361,6 +1433,7 @@ class ArrayScheduler:
                 pre = self._spread_prelaunch(
                     bindings, batch, batched_rows, batched_cfg,
                     dev_feasible, dev_score, dev_avail, dev_prev, dev_tie,
+                    extra_avail=extra_avail, extra_mask=extra_mask,
                 )
             wvf_host = pre.get("wvf_host")
             if wvf_host is None:
@@ -1370,9 +1443,16 @@ class ArrayScheduler:
             g_prev, g_tie = pre["g_prev"], pre["g_tie"]
             S = len(idx_pad)
             W, V, fc = wvf_host
-            W = np.asarray(W)[:nb]
-            V = np.asarray(V)[:nb]
-            fc = np.asarray(fc)[:nb]
+            inv = pre.get("score_inv")
+            if inv is None:
+                W = np.asarray(W)[:nb]
+                V = np.asarray(V)[:nb]
+                fc = np.asarray(fc)[:nb]
+            else:  # expand representative scores back to all rows
+                nrep = pre["score_nrep"]
+                W = np.asarray(W)[:nrep][inv]
+                V = np.asarray(V)[:nrep][inv]
+                fc = np.asarray(fc)[:nrep][inv]
             for j, b in enumerate(batched_rows):
                 feas_count[b] = fc[j]
 
@@ -1446,7 +1526,6 @@ class ArrayScheduler:
                     d_feas = _gather_rows_kernel(g_feas, d_idx)
                     d_avail = _gather_rows_kernel(g_avail, d_idx)
                     d_prev = _gather_rows_kernel(g_prev, d_idx)
-                    d_tie = _gather_rows_kernel(g_tie, d_idx)
                     d_chosen = chosen[d_idx]
                     d_brows = np.asarray(
                         [batched_rows[j] for j in d_idx], np.int64
@@ -1460,12 +1539,41 @@ class ArrayScheduler:
                         TOPK_TARGETS,
                     )
                     has_agg_d = bool((d_strategy == AGGREGATED).any())
-                    tail_dev = spread_batch.spread_tail_kernel(
-                        d_feas, d_avail, d_prev, d_tie, d_chosen,
-                        d_strategy, d_replicas, d_fresh,
-                        layout=layout, topk=topk_d,
-                        narrow=narrow, has_agg=has_agg_d,
-                    )
+                    if self._host_sorts and nd * C >= HOST_TAIL_MIN_ELEMS:
+                        # the spread re-run's division is the same tail —
+                        # run the numpy twin (see the phase-2 host branch)
+                        h_feas, h_avail, h_prev = jax.device_get(
+                            (d_feas, d_avail, d_prev)
+                        )
+                        rid = np.asarray(layout.rid_orig)
+                        chosen_pad = np.concatenate(
+                            [np.zeros((nd, 1), bool), np.asarray(d_chosen)[:nd]],
+                            axis=1,
+                        )
+                        sel = np.asarray(h_feas)[:nd] & chosen_pad[:, rid]
+                        ht = assign_ops.host_tail(
+                            sel, np.asarray(h_avail)[:nd],
+                            np.asarray(h_prev)[:nd],
+                            np.asarray(batch.seeds)[d_brows[:nd]],
+                            np.zeros((nd, C), np.int64),
+                            d_strategy[:nd], d_replicas[:nd], d_fresh[:nd],
+                            (STATIC_WEIGHT, DYNAMIC_WEIGHT, AGGREGATED),
+                            topk=topk_d,
+                        )
+                        # spread_tail_kernel's output order, feas_count from
+                        # the restricted selection
+                        tail_dev = (
+                            ht[0], ht[1], ht[2],
+                            sel.sum(-1).astype(np.int32), ht[3], ht[4], ht[5],
+                        )
+                    else:
+                        d_tie = _gather_rows_kernel(g_tie, d_idx)
+                        tail_dev = spread_batch.spread_tail_kernel(
+                            d_feas, d_avail, d_prev, d_tie, d_chosen,
+                            d_strategy, d_replicas, d_fresh,
+                            layout=layout, topk=topk_d,
+                            narrow=narrow, has_agg=has_agg_d,
+                        )
 
                 # one sync for the packed representatives AND the tail (the
                 # dense result tensor tail_dev[0] stays on device — only
@@ -1500,9 +1608,13 @@ class ArrayScheduler:
                             continue
                         row_target_src[b] = ("pairs", names, ti2s[k, :n], tv2s[k, :n])
                     if overflow2:
-                        o_res = fetch_rows(
-                            tail_dev[0], [k for k, _ in overflow2], self._bucket
-                        )
+                        if isinstance(tail_dev[0], np.ndarray):
+                            o_res = tail_dev[0][[k for k, _ in overflow2]]
+                        else:
+                            o_res = fetch_rows(
+                                tail_dev[0], [k for k, _ in overflow2],
+                                self._bucket,
+                            )
                         for m, (_, b) in enumerate(overflow2):
                             pos = np.nonzero(o_res[m] > 0)[0]
                             row_target_src[b] = (

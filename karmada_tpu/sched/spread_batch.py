@@ -1157,3 +1157,111 @@ def _finish_selection(
     sel_regions = ms.ravel()[keep.ravel()]
     chosen[sel_rows, sel_regions] = True
     return ComboResult(chosen, errors, fallback)
+
+
+def host_group_score(feasible, score, avail, prev_replicas,
+                     replicas, need, target, duplicated,
+                     layout: RegionLayout):
+    """group_score_kernel's numpy twin for the cpu backend (identical
+    outputs; same segmented math as group_score_kernel_segmented, with the
+    5-key lax.sort replaced by a packed single-key np.argsort when the
+    per-batch value ranges fit an int64, else a stable np.lexsort).
+    XLA:CPU's comparator-loop sort costs ~20 s at 6k rows x 5k clusters;
+    this lands the same (weight, value, avail_sum, feas_count) in a couple
+    of seconds. Parity is guarded by TestHostSpreadScoreParity."""
+    feasible = np.asarray(feasible)
+    score = np.asarray(score)
+    avail = np.asarray(avail)
+    prev_replicas = np.asarray(prev_replicas)
+    S = feasible.shape[0]
+    Cp = layout.seg_cp
+    perm = layout.perm[:Cp]
+    seg = layout.seg_id_p.astype(np.int64)
+    seg_start = layout.seg_start
+    seg_end = layout.seg_end
+
+    f = feasible[:, perm]
+    av = np.where(
+        f,
+        avail[:, perm].astype(np.int64) + prev_replicas[:, perm].astype(np.int64),
+        0,
+    )
+    sc = np.where(f, score[:, perm].astype(np.int64), 0)
+    rank = layout.name_rank_p[:Cp].astype(np.int64)
+    infeas = ~f
+
+    # member order per region: (infeasible, score desc, avail desc, name) —
+    # the seg id leads so each region's members land contiguous
+    sb = max(int(sc.max(initial=0)).bit_length(), 1)
+    ab = max(int(av.max(initial=0)).bit_length(), 1)
+    # ranks range over the FULL fleet (regionless clusters hold ranks too)
+    rb = max(int(rank.max(initial=0)).bit_length(), 1)
+    gb = max(int(max(layout.n_regions - 1, 1)).bit_length(), 1)
+    # negative values (out-of-tree plugin scores) break the offset-binary
+    # packing; signed inputs take the lexsort path
+    signed = int(sc.min(initial=0)) < 0 or int(av.min(initial=0)) < 0
+    if not signed and gb + 1 + sb + ab + rb <= 63:
+        packed = (
+            (seg[None, :] << (1 + sb + ab + rb))
+            | (infeas.astype(np.int64) << (sb + ab + rb))
+            | ((int(sc.max(initial=0)) - sc) << (ab + rb))
+            | ((int(av.max(initial=0)) - av) << rb)
+            | rank[None, :]
+        )
+        order = np.argsort(packed, axis=-1, kind="stable")
+    else:  # values too wide to pack: stable lexsort, last key primary
+        order = np.lexsort((
+            np.broadcast_to(rank, (S, Cp)), -av, -sc,
+            infeas.astype(np.int64), np.broadcast_to(seg, (S, Cp)),
+        ), axis=-1)
+    f_s = np.take_along_axis(f, order, axis=-1)
+    av_s = np.take_along_axis(av, order, axis=-1)
+    sc_s = np.take_along_axis(sc, order, axis=-1)
+
+    def excl(x):  # P[j] = sum of first j entries, [S, Cp+1]
+        return np.concatenate(
+            [np.zeros((S, 1), x.dtype), np.cumsum(x, axis=-1)], axis=1
+        )
+
+    def segsum(P):  # [S, R]
+        return P[:, seg_end] - P[:, seg_start]
+
+    Pf = excl(f_s.astype(np.int64))
+    Pav = excl(av_s)
+    Psc = excl(sc_s)
+    value64 = segsum(Pf)
+    value = value64.astype(np.int32)
+    av_sum = segsum(Pav)
+    sc_sum = segsum(Psc)
+
+    iota = np.arange(Cp, dtype=np.int64)[None, :]
+    seg32 = seg.astype(np.int64)
+    idx_rel = iota - seg_start[seg32][None, :]
+    cum_av_rel = Pav[:, 1:] - Pav[:, seg_start[seg32]]
+    value_at = value64[:, seg32]
+    condA = idx_rel + 1 >= need[:, None]
+    condB = cum_av_rel >= target[:, None]
+    condC = idx_rel < value_at
+    fail = (condC & ~(condA & condB)).astype(np.int64)
+    k_count = segsum(excl(fail))
+    met = k_count < value64
+    k_eff = np.clip(np.where(met, k_count, value64 - 1), 0, max(Cp - 1, 0))
+    at = seg_start[None, :] + k_eff.astype(np.int32) + 1
+    sc_at_k = np.take_along_axis(Psc, at, axis=1) - Psc[:, seg_start]
+    denom = np.maximum(np.where(met, k_eff + 1, value64), 1)
+    tgt = target[:, None]
+    w_div = np.where(
+        av_sum < tgt,
+        av_sum * WEIGHT_UNIT + sc_sum // np.maximum(value64, 1),
+        tgt * WEIGHT_UNIT + sc_at_k // denom,
+    )
+    dup_ok = f & (av >= replicas[:, None])
+    cnt = segsum(excl(dup_ok.astype(np.int64)))
+    sc_dup = segsum(excl(np.where(dup_ok, sc, 0)))
+    w_dup = np.where(
+        cnt > 0, cnt * WEIGHT_UNIT + sc_dup // np.maximum(cnt, 1), 0
+    )
+
+    weight = np.where(duplicated[:, None], w_dup, w_div)
+    weight = np.where(value > 0, weight, 0)
+    return weight, value, av_sum, feasible.sum(-1).astype(np.int32)

@@ -748,3 +748,116 @@ class TestNativeClassDfsParity:
                 assert np.array_equal(got, out), (
                     f"seed {seed} row {s}: native {got} != python {out}"
                 )
+
+
+class TestHostSpreadScoreParity:
+    """host_group_score (the cpu-backend numpy twin) must produce outputs
+    identical to the device scoring kernels, balanced and skewed."""
+
+    def _inputs(self, n_clusters, skewed, seed=3):
+        import numpy as np
+
+        from karmada_tpu.sched.spread_batch import RegionLayout
+        from karmada_tpu.testing.fixtures import synthetic_fleet
+
+        rng = np.random.default_rng(seed)
+        clusters = synthetic_fleet(n_clusters, seed=seed)
+        if skewed:
+            for i, c in enumerate(clusters):
+                c.spec.region = (
+                    "mega" if i < n_clusters * 0.7 else f"tiny-{i % 9}"
+                )
+        regions = sorted({c.spec.region for c in clusters if c.spec.region})
+        rid = np.asarray([
+            regions.index(c.spec.region) if c.spec.region else -1
+            for c in clusters
+        ])
+        names = [c.metadata.name for c in clusters]
+        name_rank = np.empty(len(names), np.int64)
+        name_rank[np.argsort(np.asarray(names))] = np.arange(len(names))
+        layout = RegionLayout(rid, regions, name_rank)
+
+        S = 40
+        feasible = rng.random((S, n_clusters)) > 0.3
+        score = rng.integers(0, 200, (S, n_clusters)).astype(np.int32)
+        avail = rng.integers(0, 50, (S, n_clusters)).astype(np.int32)
+        prev = rng.integers(0, 5, (S, n_clusters)).astype(np.int32)
+        reps = rng.integers(1, 30, S).astype(np.int64)
+        need = rng.integers(1, 4, S).astype(np.int64)
+        target = rng.integers(1, 20, S).astype(np.int64)
+        dup = rng.random(S) > 0.5
+        return layout, (feasible, score, avail, prev, reps, need, target, dup)
+
+    def _assert_same(self, layout, args):
+        import numpy as np
+
+        from karmada_tpu.sched import spread_batch
+
+        host = spread_batch.host_group_score(*args, layout=layout)
+        kernel = (
+            spread_batch.group_score_kernel if layout.grid_balanced
+            else spread_batch.group_score_kernel_segmented
+        )
+        dev = kernel(*args, layout=layout)
+        for h, d, what in zip(host, dev, ("weight", "value", "avail", "fc")):
+            assert np.array_equal(np.asarray(h), np.asarray(d)), what
+
+    def test_balanced_fleet(self):
+        layout, args = self._inputs(96, skewed=False)
+        assert layout.grid_balanced
+        self._assert_same(layout, args)
+
+    def test_skewed_fleet(self):
+        layout, args = self._inputs(96, skewed=True)
+        self._assert_same(layout, args)
+
+    def test_regionless_clusters_keep_rank_bits(self):
+        # ranks span the FULL fleet while the packed key only covers the
+        # region-ful prefix: a late-sorting name in a region must not bleed
+        # into the avail bits (review finding r5)
+        import numpy as np
+
+        from karmada_tpu.sched.spread_batch import RegionLayout
+
+        rng = np.random.default_rng(5)
+        C = 96
+        regions = [f"r{i}" for i in range(6)]
+        rid = np.asarray([
+            -1 if i % 7 == 0 else i % 6 for i in range(C)
+        ])
+        name_rank = rng.permutation(C).astype(np.int64)
+        layout = RegionLayout(rid, regions, name_rank)
+        assert layout.seg_cp < C
+        S = 24
+        args = (
+            rng.random((S, C)) > 0.3,
+            rng.integers(0, 64, (S, C)).astype(np.int32),
+            rng.integers(0, 40, (S, C)).astype(np.int32),
+            rng.integers(0, 4, (S, C)).astype(np.int32),
+            rng.integers(1, 30, S).astype(np.int64),
+            rng.integers(1, 4, S).astype(np.int64),
+            rng.integers(1, 20, S).astype(np.int64),
+            rng.random(S) > 0.5,
+        )
+        self._assert_same(layout, args)
+
+    def test_negative_scores_take_lexsort(self):
+        import numpy as np
+
+        layout, args = self._inputs(64, skewed=False)
+        feasible, score, avail, prev, reps, need, target, dup = args
+        score = score.astype(np.int32) - 150  # OOT plugins can go negative
+        self._assert_same(
+            layout, (feasible, score, avail, prev, reps, need, target, dup))
+
+    def test_wide_values_fall_back_to_lexsort(self):
+        import numpy as np
+
+        layout, args = self._inputs(64, skewed=False)
+        feasible, score, avail, prev, reps, need, target, dup = args
+        # scores near 2^40 blow the packed bit budget -> lexsort path
+        score = score.astype(np.int64) + (1 << 40)
+        self._assert_same(
+            layout, (feasible, score.astype(np.int64), avail, prev,
+                     reps, need, target, dup),
+        )
