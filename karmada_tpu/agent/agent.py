@@ -65,11 +65,14 @@ class KarmadaAgent:
         self.controller = runtime.register(
             Controller(name=f"agent-{member.name}", reconcile=self._reconcile)
         )
-        store.watch("Work", self._on_work)
+        # scoped to this member's execution namespace: a remote agent's
+        # watch stream carries only its own Works across the wire
+        store.watch("Work", self._on_work, namespace=self.namespace)
 
     def _on_work(self, event: str, work: Work) -> None:
-        if work.metadata.namespace == self.namespace:
-            self.controller.enqueue(work.metadata.key())
+        # delivery is already scoped by the namespace-filtered watch above;
+        # no per-event re-check needed
+        self.controller.enqueue(work.metadata.key())
 
     def _reconcile(self, key: str) -> str:
         ns, _, name = key.partition("/")

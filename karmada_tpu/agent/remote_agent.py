@@ -43,7 +43,8 @@ class RemoteAgentSession:
         from ..controllers.status import WorkStatusController
 
         self.work_status = WorkStatusController(
-            self.store, {config.name: self.member}, interpreter, self.runtime
+            self.store, {config.name: self.member}, interpreter, self.runtime,
+            namespace=self.agent.namespace,  # only this member's Works
         )
         self.work_status.watch_member(self.member)
         self._stop = threading.Event()

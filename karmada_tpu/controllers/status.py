@@ -40,6 +40,7 @@ class WorkStatusController:
         interpreter: ResourceInterpreter,
         runtime: Runtime,
         execution_controller=None,
+        namespace: str = "",  # agent mode: scope to one execution namespace
     ) -> None:
         self.store = store
         self.members = members
@@ -48,7 +49,10 @@ class WorkStatusController:
         self.controller = runtime.register(
             Controller(name="work-status", reconcile=self._reconcile)
         )
-        store.watch("Work", lambda ev, w: self.controller.enqueue(w.metadata.key()))
+        store.watch(
+            "Work", lambda ev, w: self.controller.enqueue(w.metadata.key()),
+            namespace=namespace,
+        )
 
     def watch_member(self, member) -> None:
         """Subscribe to one member's object events (fedinformer equivalent)."""

@@ -276,6 +276,10 @@ class ControlPlaneServer:
     def _h_GET_watch(self, h, q):
         kind = q.get("kind", "")
         replay = q.get("replay", "1") not in ("0", "false")
+        # server-side namespace scoping: a pull agent watching its own
+        # execution namespace must not receive (or pay for) the rest of the
+        # federation's events
+        namespace = q.get("namespace", "")
         if not kind:
             self._send(h, 400, {"error": "kind required"})
             return
@@ -287,6 +291,8 @@ class ControlPlaneServer:
 
         if kind == "*":
             def handler(k: str, event: str, obj: Any) -> None:
+                if namespace and obj.metadata.namespace != namespace:
+                    return
                 try:
                     events.put_nowait((k, event, obj))
                 except queue.Full:
@@ -299,7 +305,9 @@ class ControlPlaneServer:
                     events.put_nowait((kind, event, obj))
                 except queue.Full:
                     overflowed.set()
-            self.cp.store.watch(kind, handler, replay=replay)
+            self.cp.store.watch(
+                kind, handler, replay=replay, namespace=namespace
+            )
             unsub = lambda: self.cp.store.unwatch(kind, handler)  # noqa: E731
 
         try:

@@ -109,15 +109,19 @@ class RemoteStore:
     # -- watch ------------------------------------------------------------
 
     def watch(self, kind: str, handler: Callable[[str, Any], None], *,
-              replay: bool = True) -> None:
-        self._start_stream(kind, replay, lambda k, ev, obj: handler(ev, obj))
+              replay: bool = True, namespace: str = "") -> None:
+        self._start_stream(
+            kind, replay, lambda k, ev, obj: handler(ev, obj),
+            namespace=namespace,
+        )
 
     def watch_all(self, handler: Callable[[str, str, Any], None], *,
                   replay: bool = True) -> None:
         self._start_stream("*", replay, handler)
 
     def _start_stream(self, kind: str, replay: bool,
-                      deliver: Callable[[str, str, Any], None]) -> None:
+                      deliver: Callable[[str, str, Any], None],
+                      namespace: str = "") -> None:
         import http.client
 
         url = urlparse(self.base_url)
@@ -125,6 +129,8 @@ class RemoteStore:
         def attach(with_replay: bool) -> None:
             path = (f"/watch?kind={quote(kind, safe='')}"
                     f"&replay={'1' if with_replay else '0'}")
+            if namespace:
+                path += f"&namespace={quote(namespace, safe='')}"
             # the server heartbeats every 0.5s; a read stalling 10x that is
             # a half-open connection (host died without RST) — time out and
             # let the outer loop re-attach with replay

@@ -46,7 +46,7 @@ def gvk_of(obj: Any) -> str:
 @dataclass
 class _Bucket:
     objects: dict[str, Any]
-    watchers: list[WatchHandler]
+    watchers: list[tuple[WatchHandler, str]]  # (handler, namespace filter)
 
 
 class Store:
@@ -275,12 +275,19 @@ class Store:
 
     # -- watch ------------------------------------------------------------
 
-    def watch(self, kind: str, handler: WatchHandler, *, replay: bool = True) -> None:
+    def watch(self, kind: str, handler: WatchHandler, *, replay: bool = True,
+              namespace: str = "") -> None:
         """Subscribe; with replay=True existing objects are delivered as ADDED
-        first (informer 'list+watch' semantics)."""
+        first (informer 'list+watch' semantics). A non-empty `namespace`
+        scopes delivery — the reference agent's informers are scoped to its
+        execution namespace the same way (agent.go:248-433)."""
         with self._lock:
-            self._bucket(kind).watchers.append(handler)
-            snapshot = [copy.deepcopy(o) for o in self._buckets[kind].objects.values()]
+            self._bucket(kind).watchers.append((handler, namespace))
+            snapshot = [
+                copy.deepcopy(o)
+                for o in self._buckets[kind].objects.values()
+                if not namespace or o.metadata.namespace == namespace
+            ]
         if replay:
             for o in snapshot:
                 handler(ADDED, o)
@@ -290,8 +297,12 @@ class Store:
         keep filling a dead queue)."""
         with self._lock:
             b = self._buckets.get(kind)
-            if b is not None and handler in b.watchers:
-                b.watchers.remove(handler)
+            if b is not None:
+                # equality, not identity: bound-method handlers compare ==
+                # across separate attribute accesses but are never `is`
+                b.watchers = [
+                    (h, ns) for h, ns in b.watchers if h != handler
+                ]
 
     def unwatch_all(self, handler: Callable[[str, str, Any], None]) -> None:
         with self._lock:
@@ -316,7 +327,9 @@ class Store:
         with self._lock:
             watchers = list(self._buckets[kind].watchers)
             all_watchers = list(self._all_watchers)
-        for w in watchers:
-            w(event, obj)
+        ns = obj.metadata.namespace
+        for w, want_ns in watchers:
+            if not want_ns or ns == want_ns:
+                w(event, obj)
         for w in all_watchers:
             w(kind, event, obj)

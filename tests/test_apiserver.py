@@ -335,3 +335,48 @@ class TestDaemonProcess:
             assert wait_until(lambda: len(names) == 60), sorted(names)[:5]
         finally:
             rs.close()
+
+
+class TestNamespaceScopedWatch:
+    def test_store_watch_namespace_filter(self):
+        from karmada_tpu.store.store import Store
+
+        store = Store()
+        seen = []
+        store.watch("v1/ConfigMap", lambda ev, o: seen.append(o.metadata.name),
+                    namespace="ns-a")
+        for ns in ("ns-a", "ns-b"):
+            store.create(Unstructured({
+                "apiVersion": "v1", "kind": "ConfigMap",
+                "metadata": {"name": f"cm-{ns}", "namespace": ns},
+                "data": {},
+            }))
+        assert seen == ["cm-ns-a"]
+        # replay also filters
+        replayed = []
+        store.watch("v1/ConfigMap",
+                    lambda ev, o: replayed.append(o.metadata.name),
+                    namespace="ns-b")
+        assert replayed == ["cm-ns-b"]
+
+    def test_remote_watch_namespace_scoped(self, served_plane):
+        """A pull agent's stream only carries its own namespace — filtered
+        server-side, so the rest of the federation never crosses the wire."""
+        cp, srv = served_plane
+        rs = RemoteStore(srv.url)
+        seen = []
+        try:
+            rs.watch("v1/Secret", lambda ev, o: seen.append(o.metadata.name),
+                     replay=False, namespace="karmada-es-edge")
+            time.sleep(0.3)
+            for ns in ("karmada-es-edge", "karmada-es-other", "default"):
+                cp.store.create(Unstructured({
+                    "apiVersion": "v1", "kind": "Secret",
+                    "metadata": {"name": f"s-{ns}", "namespace": ns},
+                    "data": {},
+                }))
+            assert wait_until(lambda: "s-karmada-es-edge" in seen)
+            time.sleep(0.5)
+            assert seen == ["s-karmada-es-edge"], seen
+        finally:
+            rs.close()
