@@ -24,7 +24,7 @@ from ..api.work import (
 from ..controllers.binding import WORK_BINDING_NAME_LABEL, WORK_BINDING_NAMESPACE_LABEL
 from ..interpreter.interpreter import ResourceInterpreter
 from ..runtime.controller import Controller, DONE, Runtime
-from ..store.store import Store
+from ..store.store import ConflictError, Store
 from ..utils.names import execution_namespace, work_name
 
 
@@ -186,15 +186,26 @@ class BindingStatusController:
         if changed or cond_changed:
             self.store.update(rb)
 
-        # write aggregated status back onto the template (AggregateStatus op)
-        template = self.store.try_get(
-            f"{rb.spec.resource.api_version}/{rb.spec.resource.kind}",
-            rb.spec.resource.name,
-            rb.spec.resource.namespace,
-        )
-        if template is not None and items:
+        # write aggregated status back onto the template (AggregateStatus op).
+        # check_rv + retry: the interpreter call sits between read and write,
+        # and a whole-object update with a stale snapshot would silently
+        # revert a concurrent spec change (e.g. a remote writer scaling the
+        # template while we aggregate) — last-write-wins must never eat spec
+        for _ in range(8):
+            template = self.store.try_get(
+                f"{rb.spec.resource.api_version}/{rb.spec.resource.kind}",
+                rb.spec.resource.name,
+                rb.spec.resource.namespace,
+            )
+            if template is None or not items:
+                break
             old_status = template.get("status")
             updated = self.interpreter.aggregate_status(template, items)
-            if updated.get("status") != old_status:
-                self.store.update(updated)
+            if updated.get("status") == old_status:
+                break
+            try:
+                self.store.update(updated, check_rv=True)
+                break
+            except ConflictError:
+                continue  # re-read and re-aggregate against the fresh object
         return DONE

@@ -116,8 +116,8 @@ class RemoteStore:
         )
 
     def watch_all(self, handler: Callable[[str, str, Any], None], *,
-                  replay: bool = True) -> None:
-        self._start_stream("*", replay, handler)
+                  replay: bool = True, namespace: str = "") -> None:
+        self._start_stream("*", replay, handler, namespace=namespace)
 
     def _start_stream(self, kind: str, replay: bool,
                       deliver: Callable[[str, str, Any], None],
