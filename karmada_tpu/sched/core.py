@@ -645,6 +645,26 @@ class ArrayScheduler:
             self._host_sorts = (
                 mesh is None and jax.default_backend() == "cpu"
             )
+        # HBM budget for one round's [B,C] working set: phase 1 keeps ~6 live
+        # i32/bool [B,C] buffers, so cap B·C per launched round and split
+        # oversized batches into equal row chunks (rows are independent —
+        # placement-identical by construction). 2^28 elements ≈ 1 GiB per
+        # i32 buffer ≈ 6 GiB live on a 16 GiB v5e-1; a sharded mesh divides
+        # the per-device footprint, so the cap scales with mesh size.
+        env_cap = os.environ.get("KARMADA_TPU_MAX_BC_ELEMS", "")
+        if env_cap:
+            try:
+                self.max_bc_elems = int(env_cap)
+            except ValueError:
+                raise ValueError(
+                    f"KARMADA_TPU_MAX_BC_ELEMS={env_cap!r}: must be an integer"
+                ) from None
+            if self.max_bc_elems <= 0:
+                raise ValueError(
+                    f"KARMADA_TPU_MAX_BC_ELEMS={env_cap!r}: must be positive"
+                )
+        else:
+            self.max_bc_elems = 2 << 27
         self.set_clusters(clusters)
 
     def set_clusters(self, clusters: Sequence) -> None:
@@ -727,6 +747,27 @@ class ArrayScheduler:
                 f.taint_key, f.taint_value, f.taint_effect, f.api_ok,
             )
         )
+
+    def _max_rows_per_round(self, n_cols: int) -> int:
+        """Row cap per launched round under the [B,C] HBM budget, floored to
+        a _bucket boundary so every full chunk hits one compiled shape. On a
+        mesh the budget scales by the BINDINGS-axis size only: division-tail
+        buffers are all-gathered to full rows, so a clusters-axis split does
+        not shrink their per-device footprint."""
+        if self.mesh is not None:
+            from ..parallel.mesh import AXIS_BINDINGS
+
+            scale = dict(self.mesh.shape).get(AXIS_BINDINGS, 1)
+        else:
+            scale = 1
+        budget = self.max_bc_elems * scale
+        cap = max(8, budget // max(n_cols, 1))
+        if cap >= 2048:
+            return (cap // 2048) * 2048
+        b = 8
+        while b * 2 <= cap:
+            b *= 2
+        return b
 
     @staticmethod
     def _bucket(n: int) -> int:
@@ -898,6 +939,13 @@ class ArrayScheduler:
         applied term's name is recorded on the decision."""
         if not bindings:
             return []
+        max_rows = self._max_rows_per_round(len(self.fleet.names))
+        if len(bindings) > max_rows:
+            out = []
+            for s in range(0, len(bindings), max_rows):
+                sub = None if extra_avail is None else extra_avail[s:s + max_rows]
+                out.extend(self.schedule(list(bindings[s:s + max_rows]), sub))
+            return out
 
         def terms_of(rb):
             p = rb.spec.placement

@@ -475,3 +475,64 @@ class TestHostSortParity:
             assert a.error == b.error, a.key
             assert [(t.name, t.replicas) for t in a.targets] == \
                 [(t.name, t.replicas) for t in b.targets], a.key
+
+
+class TestHBMChunking:
+    """Oversized batches split into row chunks under the [B,C] HBM budget
+    (sched/core.py _max_rows_per_round); rows are independent, so chunked
+    and single-round schedules must be placement-identical — including the
+    ordered-affinity retry loop and spread rows inside each chunk."""
+
+    def test_chunked_equals_unchunked(self):
+        import bench
+        from karmada_tpu.testing.fixtures import (
+            duplicated_placement,
+            static_weight_placement,
+            synthetic_fleet,
+        )
+
+        rng = np.random.default_rng(11)
+        clusters = synthetic_fleet(48, seed=11)
+        names = [c.name for c in clusters]
+        placements = [
+            duplicated_placement(names[:6]),
+            static_weight_placement({names[j]: j + 1 for j in range(5)}),
+            bench._dyn_placement(aggregated=False),
+            bench._dyn_placement(aggregated=True),
+        ]
+        bindings = []
+        for i in range(120):
+            prev = (
+                {names[int(rng.integers(48))]: int(rng.integers(1, 5))}
+                if i % 4 == 0 else None
+            )
+            bindings.append(bench._binding(
+                i, int(rng.integers(1, 30)), placements[i % 4],
+                float(rng.choice([0.1, 0.25])), prev=prev,
+            ))
+
+        whole = ArrayScheduler(clusters)
+        assert whole._max_rows_per_round(len(names)) >= len(bindings)
+        d_whole = whole.schedule(bindings)
+
+        chunked = ArrayScheduler(clusters)
+        chunked.max_bc_elems = 16 * len(names)  # 16-row chunks -> 8 chunks
+        assert chunked._max_rows_per_round(len(names)) == 16
+        d_chunked = chunked.schedule(bindings)
+
+        for a, b in zip(d_whole, d_chunked):
+            assert a.error == b.error, a.key
+            assert a.ok == b.ok
+            if a.ok:
+                assert [(t.name, t.replicas) for t in a.targets] == \
+                    [(t.name, t.replicas) for t in b.targets], a.key
+
+    def test_cap_floors_to_buckets(self):
+        clusters = synthetic_fleet(8, seed=3)
+        s = ArrayScheduler(clusters)
+        s.max_bc_elems = 2048 * 3 * 8  # cap 6144 rows at C=8
+        assert s._max_rows_per_round(8) == 6144
+        s.max_bc_elems = 100 * 8  # cap 100 -> pow2 floor 64
+        assert s._max_rows_per_round(8) == 64
+        s.max_bc_elems = 1  # degenerate: never below 8
+        assert s._max_rows_per_round(8) == 8
