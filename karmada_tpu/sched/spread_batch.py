@@ -56,6 +56,12 @@ from .spread import (
 # better, but the batched matmul would burn memory — fall back per row.
 MAX_REGIONS = 64
 MAX_PATH_LEN = 6
+# Combination-count : row-count ratio above which a (deduped) batch takes
+# the class-collapsed DFS instead of the [S, n_combo] table passes — the
+# table's per-call fixed cost scales with the enumeration while the DFS
+# scales with rows (measured ~5x on the skewed bench: 51 config groups ×
+# ~27 representative rows × C(31, 4..6) combos).
+CLASS_DFS_COMBO_RATIO = 64
 MAX_COMBOS = 40000
 
 
@@ -930,17 +936,13 @@ def select_regions_batch(
         live = np.nonzero(~too_few)[0]
         fallback.extend(int(s) for s in live)
         return ComboResult(chosen, errors, fallback)
-    table = _combos(R, kmin, min(kmax_enum, R))
-    if R > MAX_REGIONS:
-        live = np.nonzero(~too_few)[0]
-        fallback.extend(int(s) for s in live)
-        return ComboResult(chosen, errors, fallback)
-    if table is None:
-        # enumeration too large — the class-collapsed exact DFS (skewed
-        # fleets: many interchangeable regions ⇒ few classes). The batch
-        # runs through the native kernel when available (the per-row Python
-        # recursion cost ~0.5 ms × thousands of rows); rows the native path
-        # cannot take (or budget blowouts) use the Python twin.
+
+    def run_class_dfs() -> ComboResult:
+        # the class-collapsed exact DFS (skewed fleets: many
+        # interchangeable regions ⇒ few classes). The batch runs through
+        # the native kernel when available (the per-row Python recursion
+        # cost ~0.5 ms × thousands of rows); rows the native path cannot
+        # take (or budget blowouts) use the Python twin.
         live = [int(s) for s in np.nonzero(~too_few)[0]]
         handled = _class_dfs_rows_native(
             weight, value, cfg, layout, kmax_row, live, chosen, errors
@@ -958,6 +960,22 @@ def select_regions_batch(
             else:
                 chosen[s, out] = True
         return ComboResult(chosen, errors, fallback)
+
+    if device is None and R <= MAX_REGIONS:
+        # auto mode only: an explicit device= pin (tests A/B the table
+        # paths) must still reach the enumeration below
+        n_enum = sum(math.comb(R, k) for k in range(kmin, min(kmax_enum, R) + 1))
+        if n_enum > S * CLASS_DFS_COMBO_RATIO:
+            # small batch over a rich enumeration: per-row DFS beats the
+            # table passes (and skips building the table entirely)
+            return run_class_dfs()
+    table = _combos(R, kmin, min(kmax_enum, R))
+    if R > MAX_REGIONS:
+        live = np.nonzero(~too_few)[0]
+        fallback.extend(int(s) for s in live)
+        return ComboResult(chosen, errors, fallback)
+    if table is None:
+        return run_class_dfs()  # enumeration too large even to build
     if not table.members:  # kmin > R: no combination can exist
         for s in np.nonzero(~too_few)[0]:
             errors[int(s)] = (

@@ -184,17 +184,25 @@ def profile_phases(sched, bindings):
     W, V, fc = jax.device_get(pre["wvf"])
     t_fetch = time.perf_counter() - t0
 
-    nb = pre["nb"]
-    W = np.asarray(W)[:nb]
-    V = np.asarray(V)[:nb]
+    # (W, V, fc) are per scoring REPRESENTATIVE since the r5 dedup;
+    # score_inv maps batched-row position -> representative row
+    inv = pre["score_inv"]
+    nrep = pre["score_nrep"]
+    W = np.asarray(W)[:nrep]
+    V = np.asarray(V)[:nrep]
     layout = sched._spread_layout
     from collections import defaultdict
 
-    j_by_cfg = defaultdict(list)
-    fch = np.asarray(fc)[:nb]
+    # mirror the production overlay: every cfg group searches ALL of its
+    # rows' representatives (a rep shared across cfgs — placements equal in
+    # scoring key but differing in rmax/cmin — is searched once per cfg)
+    by_cfg_sets = defaultdict(set)
+    fch = np.asarray(fc)[:nrep]
     for j, b in enumerate(batched_rows):
-        if fch[j] > 0:
-            j_by_cfg[batched_cfg[b]].append(j)
+        r = int(inv[j])
+        if fch[r] > 0:
+            by_cfg_sets[batched_cfg[b]].add(r)
+    j_by_cfg = {cfg: sorted(rs) for cfg, rs in by_cfg_sets.items()}
     t0 = time.perf_counter()
     n_fb = 0
     for cfg, js in j_by_cfg.items():

@@ -861,3 +861,36 @@ class TestHostSpreadScoreParity:
             layout, (feasible, score.astype(np.int64), avail, prev,
                      reps, need, target, dup),
         )
+
+
+def test_class_dfs_gate_matches_table_paths():
+    """The auto-mode gate that routes small batches over rich enumerations
+    to the class-collapsed DFS (spread_batch.CLASS_DFS_COMBO_RATIO) must be
+    placement-identical to the table passes it bypasses."""
+    import numpy as np
+
+    from karmada_tpu.sched.spread_batch import (
+        RegionLayout, SpreadConfig, select_regions_batch,
+    )
+
+    rng = np.random.default_rng(23)
+    R = 20  # rich enumeration: C(20, 2..6) >> S
+    layout = RegionLayout(
+        rng.integers(0, R, 400).astype(np.int32),
+        [f"region-{i:02d}" for i in range(R)],
+        np.arange(400, dtype=np.int32),
+    )
+    for trial in range(6):
+        S = int(rng.integers(4, 24))
+        W = rng.integers(0, 40, (S, R)).astype(np.int64) * 100
+        V = rng.integers(0, 30, (S, R)).astype(np.int32)
+        V[rng.random((S, R)) < 0.2] = 0  # absent regions
+        cfg = SpreadConfig(rmin=int(rng.integers(2, 5)),
+                           rmax=int(rng.integers(3, 7)),
+                           cmin=int(rng.integers(0, 10)), cmax=0,
+                           duplicated=bool(trial % 2))
+        auto = select_regions_batch(W, V, cfg, layout)          # gate: DFS
+        table = select_regions_batch(W, V, cfg, layout, device=False)
+        np.testing.assert_array_equal(auto.chosen, table.chosen)
+        assert auto.errors == table.errors
+        assert sorted(auto.fallback) == sorted(table.fallback)
