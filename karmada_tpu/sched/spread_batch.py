@@ -222,12 +222,39 @@ def group_score_kernel(
     sc3 = jnp.where(f3, score[:, grid].astype(jnp.int64), 0)
 
     infeas = (~f3).astype(jnp.int32)
-    nscore = -sc3.astype(jnp.int32)
-    nav = -av3
     nrank = jnp.broadcast_to(layout.grid_name_rank, (S, R, W))
-    _, _, _, _, av_s, sc_s = jax.lax.sort(
-        (infeas, nscore, nav, nrank, av3, sc3), dimension=-1, num_keys=4
+    # pad slots carry an INT32_MAX rank sentinel; they are forced
+    # infeasible (zero payloads), so their rank never affects results —
+    # mask them out of both the guard and the packed key
+    rank_masked = np.where(
+        np.asarray(layout.grid_valid), np.asarray(layout.grid_name_rank), 0
     )
+    max_rank = int(rank_masked.max(initial=0))
+    if (
+        avail.dtype == jnp.int32
+        and prev_replicas.dtype == jnp.int32
+        and score.dtype == jnp.int32
+        and max_rank < (1 << 24)
+    ):
+        # the same order-preserving bit-pack as the segmented kernel
+        # (see there for the exactness argument): 2 sort operands
+        # instead of 6, payloads reconstructed from the sorted keys
+        one = jnp.int64(1)
+        key1 = (infeas.astype(jnp.int64) << 33) | ((one << 32) - sc3)
+        key2 = (
+            ((one << 34) - av3) << 24
+        ) | jnp.broadcast_to(jnp.asarray(rank_masked), (S, R, W)).astype(jnp.int64)
+        key1_s, key2_s = jax.lax.sort((key1, key2), dimension=-1, num_keys=2)
+        sc_s = (one << 32) - (key1_s & ((one << 33) - 1))
+        av_s = (one << 34) - (key2_s >> 24)
+    else:
+        # fallback keeps the score key i64: negating an i32 key wraps at
+        # INT32_MIN (scores span the full int32 domain via plugin terms)
+        nscore = -sc3
+        nav = -av3
+        _, _, _, _, av_s, sc_s = jax.lax.sort(
+            (infeas, nscore, nav, nrank, av3, sc3), dimension=-1, num_keys=4
+        )
     cum_av = jnp.cumsum(av_s, axis=-1)
     cum_sc = jnp.cumsum(sc_s, axis=-1)
     value = f3.sum(-1).astype(jnp.int32)  # [S, R] feasible member count
@@ -300,15 +327,42 @@ def group_score_kernel_segmented(
     )
     sc = jnp.where(f, score[:, perm].astype(jnp.int64), 0)
     infeas = (~f).astype(jnp.int32)
-    nscore = (-sc).astype(jnp.int32)
     nav = -av
     nrank = jnp.broadcast_to(jnp.asarray(layout.name_rank_p[:Cp]), (S, Cp))
     segb = jnp.broadcast_to(seg, (S, Cp))
-    _, _, _, _, _, f_s, av_s, sc_s = jax.lax.sort(
-        (segb, infeas, nscore, nav, nrank,
-         f.astype(jnp.int32), av, sc),
-        dimension=-1, num_keys=5,
-    )
+    max_rank = int(layout.name_rank_p[:Cp].max(initial=0)) if Cp else 0
+    if (
+        avail.dtype == jnp.int32
+        and prev_replicas.dtype == jnp.int32
+        and score.dtype == jnp.int32
+        and max_rank < (1 << 24)
+    ):
+        # Order-preserving bit-pack: lex(seg, infeas, -score, -av, rank)
+        # == lex(key1, key2), exact by dtype alone — |score| < 2^31
+        # (bias 2^32, 33 bits), av = i32 + i32 ∈ (-2^33, 2^33) (bias
+        # 2^34, 35 bits), rank < 2^24 (ranks span the FULL fleet, so the
+        # guard checks the max rank actually packed, not Cp). One
+        # 2-operand 2-key sort replaces the 8-operand 5-key form and
+        # every payload reconstructs from the sorted keys.
+        one = jnp.int64(1)
+        key1 = (
+            (segb.astype(jnp.int64) << 34)
+            | (infeas.astype(jnp.int64) << 33)
+            | ((one << 32) - sc)
+        )
+        key2 = (((one << 34) - av) << 24) | nrank.astype(jnp.int64)
+        key1_s, key2_s = jax.lax.sort((key1, key2), dimension=-1, num_keys=2)
+        f_s = (1 - ((key1_s >> 33) & 1)).astype(jnp.int32)
+        sc_s = (one << 32) - (key1_s & ((one << 33) - 1))
+        av_s = (one << 34) - (key2_s >> 24)
+    else:
+        # fallback keeps the score key i64: negating an i32 key wraps at
+        # INT32_MIN (scores span the full int32 domain via plugin terms)
+        _, _, _, _, _, f_s, av_s, sc_s = jax.lax.sort(
+            (segb, infeas, -sc, nav, nrank,
+             f.astype(jnp.int32), av, sc),
+            dimension=-1, num_keys=5,
+        )
 
     def excl(x):  # P[j] = sum of first j entries, [S, Cp+1]
         return jnp.concatenate(

@@ -894,3 +894,71 @@ def test_class_dfs_gate_matches_table_paths():
         np.testing.assert_array_equal(auto.chosen, table.chosen)
         assert auto.errors == table.errors
         assert sorted(auto.fallback) == sorted(table.fallback)
+
+
+def test_segmented_packed_sort_extremes_and_fallback():
+    """The bit-packed 2-key sort in group_score_kernel_segmented must be
+    exact over the full int32 domain (negative and extreme scores/avail),
+    and the int64-dtype fallback branch must produce identical outputs —
+    pinning the bias constants and field widths against regression."""
+    import numpy as np
+
+    from karmada_tpu.sched import spread_batch as sb
+
+    rng = np.random.default_rng(31)
+    S, C, R = 6, 40, 4
+    region_id = rng.integers(0, R, C).astype(np.int32)
+    layout = sb.RegionLayout(
+        region_id, [f"r{i}" for i in range(R)],
+        np.arange(C, dtype=np.int32),
+    )
+    i32 = np.iinfo(np.int32)
+    extremes = np.array([i32.min, -1, 0, 1, i32.max], np.int64)
+
+    def build(seed):
+        r = np.random.default_rng(seed)
+        feas = r.random((S, C)) < 0.7
+        score = extremes[r.integers(0, 5, (S, C))]
+        avail = extremes[r.integers(0, 5, (S, C))]
+        prev = extremes[r.integers(0, 5, (S, C))]
+        return (
+            feas, score, avail, prev,
+            r.integers(1, 20, S).astype(np.int64),
+            r.integers(1, 4, S).astype(np.int64),
+            r.integers(1, 10, S).astype(np.int64),
+            r.random(S) < 0.5,
+        )
+
+    import re
+
+    for kernel in (sb.group_score_kernel_segmented, sb.group_score_kernel):
+        # the int32 route must actually ENGAGE the packed 2-operand sort
+        # (a bad guard silently falls back and turns this test vacuous)
+        args0 = build(0)
+        hlo = kernel.lower(
+            args0[0], args0[1].astype(np.int32), args0[2].astype(np.int32),
+            args0[3].astype(np.int32), *args0[4:], layout=layout,
+        ).as_text()
+        operand_counts = [
+            m.group(1).count("%")
+            for m in re.finditer(r'"stablehlo\.sort"\(([^)]*)\)', hlo)
+        ]
+        assert 2 in operand_counts, (
+            f"{kernel.__name__}: packed sort did not engage "
+            f"(sort operand counts: {operand_counts})"
+        )
+        for seed in (0, 1, 2):
+            args = build(seed)
+            packed = kernel(
+                args[0], args[1].astype(np.int32), args[2].astype(np.int32),
+                args[3].astype(np.int32), *args[4:], layout=layout,
+            )
+            fallback = kernel(
+                args[0], args[1], args[2], args[3], *args[4:], layout=layout,
+            )
+            for name, x, y in zip(("weight", "value", "av_sum", "fc"),
+                                  packed, fallback):
+                np.testing.assert_array_equal(
+                    np.asarray(x), np.asarray(y),
+                    err_msg=f"{kernel.__name__} seed={seed} {name}",
+                )
