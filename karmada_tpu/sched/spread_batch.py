@@ -1017,9 +1017,13 @@ def select_regions_batch(
 
     if device is None and R <= MAX_REGIONS:
         # auto mode only: an explicit device= pin (tests A/B the table
-        # paths) must still reach the enumeration below
+        # paths) must still reach the enumeration below. Without the
+        # native kernel every row pays the ~0.5 ms Python DFS twin, which
+        # loses to the table pass — only divert when native is loaded.
+        from .. import native
+
         n_enum = sum(math.comb(R, k) for k in range(kmin, min(kmax_enum, R) + 1))
-        if n_enum > S * CLASS_DFS_COMBO_RATIO:
+        if n_enum > S * CLASS_DFS_COMBO_RATIO and native.native_available():
             # small batch over a rich enumeration: per-row DFS beats the
             # table passes (and skips building the table entirely)
             return run_class_dfs()
