@@ -1,0 +1,196 @@
+"""Latency of the scheduler sidecar shim — the Go-interop seam, measured.
+
+The contract tests (tests/test_scheduler_shim.py) prove wire-shape parity;
+this script measures what a delegating Go scheduler would actually pay:
+POST /v1/scheduleBatch with B reference-shaped RBSpec JSONs against a
+C-cluster fleet synced through /v1/clusters (one batched [B,C] device
+round), and the per-binding /v1/schedule loop for contrast (the
+reference's own Schedule() shape — SURVEY §3.1 HOT LOOP 1).
+
+Run:  python scripts/bench_shim.py [--clusters C] [--batch B] [--iters K]
+      [--singular N] [--platform cpu]
+Backend: bounded TPU probe (bench.probe_tpu) with cpu fallback, so the
+script never hangs on a dead tunnel.
+"""
+from __future__ import annotations
+
+import argparse
+import http.client
+import json
+import pathlib
+import sys
+import time
+
+if __name__ == "__main__":
+    sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent))
+
+import numpy as np
+
+
+def cluster_json(name: str, cpu: str, region: str, allocated: str) -> dict:
+    """Reference-shaped clusterv1alpha1 JSON (what a Go plugin would sync)."""
+    return {
+        "apiVersion": "cluster.karmada.io/v1alpha1",
+        "kind": "Cluster",
+        "metadata": {"name": name, "labels": {"fleet": "bench"}},
+        "spec": {"syncMode": "Push", "region": region},
+        "status": {
+            "kubernetesVersion": "v1.30.0",
+            "apiEnablements": [
+                {"groupVersion": "apps/v1",
+                 "resources": [{"name": "deployments", "kind": "Deployment"}]},
+            ],
+            "conditions": [
+                {"type": "Ready", "status": "True", "reason": "ClusterReady"},
+            ],
+            "resourceSummary": {
+                "allocatable": {"cpu": cpu, "memory": "400Gi", "pods": "1000"},
+                "allocated": {"cpu": allocated},
+            },
+        },
+    }
+
+
+def spec_json(i: int, rng) -> dict:
+    """Mixed-strategy RBSpec JSON in the reference wire shape."""
+    kind = i % 4
+    if kind == 0:
+        placement = {"replicaScheduling": {"replicaSchedulingType": "Duplicated"}}
+    elif kind == 1:
+        placement = {"replicaScheduling": {
+            "replicaSchedulingType": "Divided",
+            "replicaDivisionPreference": "Weighted",
+            "weightPreference": {"staticWeightList": [
+                {"targetCluster": {"labelSelector": {
+                    "matchLabels": {"fleet": "bench"}}}, "weight": 1},
+            ]},
+        }}
+    elif kind == 2:
+        placement = {"replicaScheduling": {
+            "replicaSchedulingType": "Divided",
+            "replicaDivisionPreference": "Weighted",
+            "weightPreference": {
+                "dynamicWeight": "AvailableReplicas"},
+        }}
+    else:
+        placement = {"replicaScheduling": {
+            "replicaSchedulingType": "Divided",
+            "replicaDivisionPreference": "Aggregated",
+        }}
+    return {
+        "resource": {"apiVersion": "apps/v1", "kind": "Deployment",
+                     "namespace": "bench", "name": f"app-{i}"},
+        "replicas": int(rng.integers(1, 32)),
+        "replicaRequirements": {"resourceRequest": {
+            "cpu": str(rng.choice(["100m", "250m", "500m"]))}},
+        "placement": placement,
+    }
+
+
+def post(conn: http.client.HTTPConnection, path: str, body: dict) -> dict:
+    payload = json.dumps(body)
+    conn.request("POST", path, body=payload,
+                 headers={"Content-Type": "application/json"})
+    r = conn.getresponse()
+    data = r.read()
+    if r.status != 200:
+        raise RuntimeError(f"{path}: HTTP {r.status}: {data[:200]!r}")
+    return json.loads(data)
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--clusters", type=int, default=2000)
+    ap.add_argument("--batch", type=int, default=1000)
+    ap.add_argument("--iters", type=int, default=5)
+    ap.add_argument("--singular", type=int, default=50,
+                    help="sequential /v1/schedule calls to time for contrast")
+    ap.add_argument("--platform", choices=("cpu", "tpu"), default=None,
+                    help="cpu pins offline; tpu requires the tunnel (exits "
+                         "if the probe fails); default probes with fallback")
+    ap.add_argument("--probe-timeout", type=float, default=90.0)
+    args = ap.parse_args()
+
+    if args.iters < 1:
+        ap.error("--iters must be >= 1")
+    if args.platform == "cpu":
+        from karmada_tpu.testing.cpumesh import force_cpu_mesh
+        force_cpu_mesh(1)
+    else:
+        import bench as bench_mod
+        ok, msg = bench_mod.probe_tpu(args.probe_timeout)
+        if not ok and args.platform == "tpu":
+            print(f"# tpu probe failed ({msg}); --platform tpu set, exiting")
+            sys.exit(1)
+        if not ok:
+            print(f"# tpu probe failed ({msg}); pinning cpu")
+            from karmada_tpu.testing.cpumesh import force_cpu_mesh
+            force_cpu_mesh(1)
+    import jax
+
+    backend = jax.devices()[0].platform
+    print(f"# backend: {backend}")
+
+    from karmada_tpu.server.scheduler_shim import SchedulerShimServer
+
+    rng = np.random.default_rng(7)
+    srv = SchedulerShimServer()
+    port = srv.start()
+    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=600)
+
+    t0 = time.perf_counter()
+    fleet = [
+        cluster_json(
+            f"m{k:05d}",
+            cpu=str(int(rng.choice([100, 200, 400]))),
+            region=f"r{k % 16}",
+            allocated=str(int(rng.integers(0, 50))),
+        )
+        for k in range(args.clusters)
+    ]
+    out = post(conn, "/v1/clusters", {"items": fleet})
+    t_sync = time.perf_counter() - t0
+    assert out["count"] == args.clusters
+    print(f"# /v1/clusters: {args.clusters} synced in {t_sync:.2f}s")
+
+    items = [{"spec": spec_json(i, rng)} for i in range(args.batch)]
+
+    t0 = time.perf_counter()
+    res = post(conn, "/v1/scheduleBatch", {"items": items})
+    warm = time.perf_counter() - t0
+    n_ok = sum(1 for r in res["results"]
+               if r.get("suggestedClusters") and not r.get("error"))
+    print(f"# warm (compile): {warm:.2f}s ok={n_ok}/{args.batch}")
+
+    lat = []
+    for _ in range(args.iters):
+        t0 = time.perf_counter()
+        res = post(conn, "/v1/scheduleBatch", {"items": items})
+        lat.append(time.perf_counter() - t0)
+    lat.sort()
+    p50 = lat[len(lat) // 2]
+    p99 = lat[min(len(lat) - 1, max(0, int(len(lat) * 0.99)))]
+    # no vs_baseline field: the repo baseline is defined for the 10k x 5k
+    # schedule round, not this workload — a fake ratio would mislead
+    # anyone aggregating BENCH_*.json lines
+    print(json.dumps({
+        "metric": f"shim_batch_p99_{args.batch}rb_x_{args.clusters}c",
+        "value": round(p99, 6), "unit": "s",
+        "backend": backend, "iters": args.iters, "scheduled_ok": n_ok,
+    }))
+
+    if args.singular > 0:
+        t0 = time.perf_counter()
+        for i in range(args.singular):
+            post(conn, "/v1/schedule", {"spec": spec_json(i, rng)})
+        per = (time.perf_counter() - t0) / args.singular
+        print(f"# /v1/schedule singular: {per * 1e3:.1f} ms/call "
+              f"(x{args.batch} sequential would be "
+              f"{per * args.batch:.1f}s vs batch {p50:.2f}s)")
+
+    conn.close()
+    srv.stop()
+
+
+if __name__ == "__main__":
+    main()
