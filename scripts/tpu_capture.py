@@ -23,6 +23,36 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 OUT = os.path.join(REPO, "BENCH_tpu_latest.json")
 
 
+def run_script(script: str, extra_args: list[str], timeout_s: float) -> dict:
+    """Run a repo script; parse the JSON lines it prints (same contract as
+    bench.py: one {"metric": ...} object per measured config)."""
+    argv = [sys.executable, os.path.join(REPO, script)] + extra_args
+    t0 = time.time()
+    try:
+        r = subprocess.run(argv, timeout=timeout_s, capture_output=True,
+                           text=True, cwd=REPO)
+    except subprocess.TimeoutExpired:
+        return {"cmd": f"{script} " + " ".join(extra_args),
+                "error": f"timeout {timeout_s}s"}
+    lines = []
+    for line in (r.stdout or "").splitlines():
+        line = line.strip()
+        if line.startswith("{"):
+            try:
+                lines.append(json.loads(line))
+            except json.JSONDecodeError:
+                pass
+    return {
+        "cmd": f"{script} " + " ".join(extra_args), "rc": r.returncode,
+        "wall_s": round(time.time() - t0, 1),
+        "results": lines,
+        "detail": [l for l in (r.stdout or "").splitlines()
+                   if l.startswith("#")],
+        **({} if r.returncode == 0 else
+           {"stderr_tail": (r.stderr or "").strip().splitlines()[-3:]}),
+    }
+
+
 def run_bench(extra_args: list[str], timeout_s: float) -> dict:
     """Run bench.py --require-tpu with the given args; parse its JSON lines."""
     argv = [sys.executable, os.path.join(REPO, "bench.py"),
@@ -72,6 +102,12 @@ def main() -> None:
         ["--configs", "flagship", "--bindings", "40000",
          "--clusters", "20000", "--iters", "3", "--run-timeout", "1500"],
         1600))
+    # the Go-interop seam: /v1/scheduleBatch latency at flagship scale
+    artifact["runs"].append(run_script(
+        "scripts/bench_shim.py",
+        ["--platform", "tpu", "--clusters", "5000", "--batch", "10000",
+         "--iters", "3", "--singular", "20"],
+        1200))
 
     ok = any(r.get("rc") == 0 for r in artifact["runs"])
     if not ok:
