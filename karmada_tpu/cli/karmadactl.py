@@ -25,7 +25,7 @@ from __future__ import annotations
 
 import argparse
 import json
-from typing import Optional
+from typing import Any, Optional
 
 from ..api.cluster import (
     EFFECT_NO_SCHEDULE,
@@ -55,6 +55,34 @@ if TYPE_CHECKING:  # the remote CLI path must stay JAX-free: a karmadactl
     from ..controlplane import ControlPlane
 
 CORDON_TAINT_KEY = "cluster.karmada.io/cordoned"  # pkg/karmadactl/cordon
+
+
+def _load_manifest_file(path: str, multi: bool = False,
+                        any_shape: bool = False) -> Any:
+    """Load a manifest file as JSON or YAML (kubectl -f accepts both).
+
+    multi=True returns a list of documents (`---`-separated YAML streams);
+    any_shape=True permits non-mapping documents (e.g. a status-item list);
+    otherwise exactly one manifest object is required."""
+    with open(path) as f:
+        text = f.read()
+    try:
+        docs = [json.loads(text)]
+    except json.JSONDecodeError:
+        import yaml
+
+        try:
+            docs = [d for d in yaml.safe_load_all(text) if d is not None]
+        except yaml.YAMLError as e:
+            raise CLIError(f"{path}: not valid JSON or YAML: {e}") from e
+    if not any_shape and (not docs or not all(isinstance(d, dict) for d in docs)):
+        raise CLIError(f"{path}: expected manifest object(s), got "
+                       + ", ".join(type(d).__name__ for d in docs or [None]))
+    if multi:
+        return docs
+    if len(docs) != 1:
+        raise CLIError(f"{path}: expected a single manifest, got {len(docs)}")
+    return docs[0]
 
 
 class CLIError(Exception):
@@ -173,7 +201,7 @@ Description=karmada-tpu control plane ({name})
 After=network.target
 
 [Service]
-ExecStart={python} -m karmada_tpu.server --host {host} --port {port} --tick-interval 2
+ExecStart={python} -m karmada_tpu.server --host {host} --port {port} --tick-interval 2{data_flag}
 Restart=on-failure
 WorkingDirectory={workdir}
 
@@ -185,23 +213,29 @@ DAEMON_SCRIPT_TEMPLATE = """\
 #!/bin/sh
 # Launch the {name} control-plane daemon (emitted by `karmadactl init`).
 # karmadactl talks to it with:  karmadactl --server http://{host}:{port} ...
-exec {python} -m karmada_tpu.server --host {host} --port {port} --tick-interval 2 "$@"
+exec {python} -m karmada_tpu.server --host {host} --port {port} --tick-interval 2{data_flag} "$@"
 """
 
 
 def emit_daemon_artifacts(out_dir: str, name: str = "karmada",
-                          host: str = "127.0.0.1", port: int = 7443) -> list[str]:
+                          host: str = "127.0.0.1", port: int = 7443,
+                          data_dir: Optional[str] = None) -> list[str]:
     """Write the runnable launch artifacts for a control-plane daemon: a
     shell launcher and a systemd unit (the role of the manifests cmdinit
-    renders into the host cluster). Returns the written paths."""
+    renders into the host cluster). The daemon is launched with --data-dir
+    (snapshot+WAL restore across restarts) unless data_dir=\"\" opts out.
+    Returns the written paths."""
     import os
     import stat
     import sys
 
     os.makedirs(out_dir, exist_ok=True)
+    if data_dir is None:
+        data_dir = os.path.join(os.path.abspath(out_dir), f"{name}-state")
     subs = {
         "name": name, "host": host, "port": port,
         "python": sys.executable, "workdir": os.getcwd(),
+        "data_flag": f' --data-dir "{data_dir}"' if data_dir else "",
     }
     script = os.path.join(out_dir, f"{name}-daemon.sh")
     with open(script, "w") as f:
@@ -235,6 +269,7 @@ def cmd_init(mgmt: Management, name: str = "karmada",
         spec=KarmadaInstanceSpec(
             components=list(components or DEFAULT_COMPONENTS),
             feature_gates=dict(feature_gates or {}),
+            artifacts_dir=emit_dir,
         ),
     )
     mgmt.store.create(inst)
@@ -242,7 +277,13 @@ def cmd_init(mgmt: Management, name: str = "karmada",
     plane = mgmt.plane(name)
     if plane is None:
         inst = mgmt.store.get("KarmadaInstance", name)
-        raise CLIError(f"init failed (phase {inst.status.phase})")
+        detail = ""
+        for c in inst.status.conditions:
+            if c.type == "Ready":
+                detail = f": {c.message}"
+        # remove the failed instance so a corrected re-run can create it anew
+        mgmt.store.delete("KarmadaInstance", name)
+        raise CLIError(f"init failed (phase {inst.status.phase}){detail}")
     token = plane.bootstrap_tokens.create(description="init bootstrap")
     msg = (
         f"control plane {name} installed\n"
@@ -250,8 +291,8 @@ def cmd_init(mgmt: Management, name: str = "karmada",
         f"  karmadactl register <endpoint> --token {token.token} "
         f"--discovery-token-ca-cert-hash {plane.pki.cert_hash()}"
     )
-    if emit_dir:
-        paths = emit_daemon_artifacts(emit_dir, name)
+    paths = mgmt.store.get("KarmadaInstance", name).status.artifacts
+    if paths:
         msg += "\ndaemon artifacts:\n" + "\n".join(f"  {p}" for p in paths)
     return msg
 
@@ -1105,22 +1146,7 @@ def run(cp: ControlPlane, argv: list[str]) -> str:
             return cmd_top_pods(cp, getattr(args, "namespace", ""))
         return cmd_top(cp)
     if args.command == "interpret":
-        def load(path):
-            with open(path) as f:
-                text = f.read()
-            try:
-                return json.loads(text)
-            except json.JSONDecodeError:
-                import yaml
-
-                return yaml.safe_load(text)
-
-        doc = load(args.filename)
-        if not isinstance(doc, dict):
-            raise CLIError(
-                f"{args.filename}: expected a single manifest object, got "
-                f"{type(doc).__name__}"
-            )
+        doc = _load_manifest_file(args.filename)
         is_ric = doc.get("kind") == "ResourceInterpreterCustomization"
         if args.check:
             if not is_ric:
@@ -1128,9 +1154,12 @@ def run(cp: ControlPlane, argv: list[str]) -> str:
             return cmd_interpret_check(doc)
         if not args.operation:
             raise CLIError("either --operation or --check is required")
-        desired = load(args.desired_file) if args.desired_file else None
-        status_items = load(args.status_file) if args.status_file else None
-        observed = load(args.observed_file) if args.observed_file else None
+        desired = (_load_manifest_file(args.desired_file)
+                   if args.desired_file else None)
+        status_items = (_load_manifest_file(args.status_file, any_shape=True)
+                        if args.status_file else None)
+        observed = (_load_manifest_file(args.observed_file)
+                    if args.observed_file else None)
         if args.operation == "retain" and desired is None:
             if is_ric or observed is not None:
                 # without an explicit desired template, retain(observed,
@@ -1147,9 +1176,10 @@ def run(cp: ControlPlane, argv: list[str]) -> str:
         return cmd_interpret(cp, observed or doc, args.operation, desired,
                              args.replicas, status_items=status_items)
     if args.command == "apply":
-        with open(args.filename) as f:
-            manifest = json.load(f)
-        return cmd_apply(cp, manifest, all_clusters=args.all_clusters)
+        return "\n".join(
+            cmd_apply(cp, doc, all_clusters=args.all_clusters)
+            for doc in _load_manifest_file(args.filename, multi=True)
+        )
     if args.command == "promote":
         return cmd_promote(cp, args.cluster, args.kind, args.name, args.namespace)
     if args.command == "logs":
@@ -1159,8 +1189,7 @@ def run(cp: ControlPlane, argv: list[str]) -> str:
     if args.command == "addons":
         return cmd_addons(cp)
     if args.command == "create":
-        with open(args.filename) as f:
-            return cmd_create(cp, json.load(f))
+        return cmd_create(cp, _load_manifest_file(args.filename))
     if args.command == "delete":
         return cmd_delete(cp, args.kind, args.name, args.namespace)
     if args.command == "annotate":

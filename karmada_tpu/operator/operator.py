@@ -47,6 +47,13 @@ DEFAULT_COMPONENTS = [
 class KarmadaInstanceSpec:
     components: list[str] = field(default_factory=lambda: list(DEFAULT_COMPONENTS))
     feature_gates: dict[str, bool] = field(default_factory=dict)
+    # when set, the install workflow also writes runnable daemon artifacts
+    # (launcher + systemd unit for `python -m karmada_tpu.server`) there —
+    # the role of the component manifests the reference operator renders
+    # into the host cluster (operator/pkg/controlplane)
+    artifacts_dir: Optional[str] = None
+    daemon_host: str = "127.0.0.1"
+    daemon_port: int = 7443
 
 
 @dataclass
@@ -54,6 +61,7 @@ class KarmadaInstanceStatus:
     phase: str = PHASE_PENDING
     conditions: list[Condition] = field(default_factory=list)
     installed_components: list[str] = field(default_factory=list)
+    artifacts: list[str] = field(default_factory=list)
     observed_generation: int = 0
 
 
@@ -149,6 +157,18 @@ def _task_components(ctx: dict) -> None:
     ctx["installed"] = list(instance.spec.components)
 
 
+def _task_artifacts(ctx: dict) -> None:
+    instance: KarmadaInstance = ctx["instance"]
+    # lazy import: cli imports operator, so the reverse edge must not exist
+    # at module load
+    from ..cli.karmadactl import emit_daemon_artifacts
+
+    ctx["artifacts"] = emit_daemon_artifacts(
+        instance.spec.artifacts_dir, name=instance.name or "karmada",
+        host=instance.spec.daemon_host, port=instance.spec.daemon_port,
+    )
+
+
 def init_workflow() -> Workflow:
     return Workflow(
         [
@@ -157,6 +177,8 @@ def init_workflow() -> Workflow:
             ]),
             Task(name="control-plane", run=_task_control_plane, tasks=[
                 Task(name="components", run=_task_components),
+                Task(name="artifacts", run=_task_artifacts,
+                     skip=lambda ctx: not ctx["instance"].spec.artifacts_dir),
             ]),
         ]
     )
@@ -213,6 +235,7 @@ class KarmadaOperator:
         instance.status.observed_generation = instance.metadata.generation
         instance.status.phase = PHASE_RUNNING
         instance.status.installed_components = ctx.get("installed", [])
+        instance.status.artifacts = ctx.get("artifacts", [])
         set_condition(
             instance.status.conditions,
             Condition(type=CONDITION_READY, status="True",

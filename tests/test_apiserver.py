@@ -270,6 +270,9 @@ class TestDaemonArtifacts:
         assert "karmada_tpu.server" in script.read_text()
         assert script.stat().st_mode & 0o100  # executable
         assert "ExecStart=" in unit.read_text()
+        # restart durability: the emitted daemon restores from its WAL
+        assert "--data-dir" in script.read_text()
+        assert "--data-dir" in unit.read_text()
 
 
 class TestDaemonProcess:

@@ -134,6 +134,36 @@ class TestInterpretApplyPromote:
         rb = next(iter(cp.store.list("ResourceBinding")))
         assert sorted(t.name for t in rb.spec.clusters) == ["m1", "m2"]
 
+    def test_apply_yaml_manifest(self, cp, tmp_path):
+        run(cp, ["join", "m1"])
+        f = tmp_path / "dep.yaml"
+        f.write_text(
+            "apiVersion: apps/v1\nkind: Deployment\nmetadata:\n"
+            "  name: web\n  namespace: default\nspec:\n  replicas: 1\n"
+        )
+        out = run(cp, ["apply", "-f", str(f), "--all-clusters"])
+        assert "applied" in out
+        assert cp.store.try_get("apps/v1/Deployment", "web", "default") is not None
+
+    def test_apply_multidoc_yaml(self, cp, tmp_path):
+        run(cp, ["join", "m1"])
+        f = tmp_path / "bundle.yaml"
+        f.write_text(
+            "apiVersion: apps/v1\nkind: Deployment\nmetadata:\n"
+            "  name: a\n  namespace: default\nspec:\n  replicas: 1\n"
+            "---\n"
+            "apiVersion: apps/v1\nkind: Deployment\nmetadata:\n"
+            "  name: b\n  namespace: default\nspec:\n  replicas: 1\n"
+        )
+        out = run(cp, ["apply", "-f", str(f)])
+        assert "Deployment/a applied" in out and "Deployment/b applied" in out
+
+    def test_apply_non_manifest_file_is_a_cli_error(self, cp, tmp_path):
+        f = tmp_path / "notes.txt"
+        f.write_text("just some plain text\n")
+        with pytest.raises(CLIError, match="expected manifest"):
+            run(cp, ["apply", "-f", str(f)])
+
     def test_promote(self, cp):
         run(cp, ["join", "m1"])
         run(cp, ["join", "m2"])
@@ -201,6 +231,23 @@ class TestInitDeinitTokenFlow:
         assert mgmt.plane("prod") is None
         with pytest.raises(CLIError, match="not found"):
             cmd_deinit(mgmt, "prod")
+
+    def test_failed_init_is_retryable(self, tmp_path):
+        """A bad --emit-dir fails the install workflow with the task path in
+        the error, and a corrected re-run under the same name succeeds."""
+        from karmada_tpu.cli.karmadactl import CLIError, Management, cmd_init
+
+        # a file where a directory is needed blocks even a root test run
+        blocker = tmp_path / "blocked"
+        blocker.write_text("")
+        target = str(blocker / "sub")
+        mgmt = Management()
+        with pytest.raises(CLIError, match="artifacts"):
+            cmd_init(mgmt, "prod", emit_dir=target)
+        assert mgmt.plane("prod") is None
+        out = cmd_init(mgmt, "prod", emit_dir=str(tmp_path / "good"))
+        assert "control plane prod installed" in out
+        assert (tmp_path / "good" / "prod-daemon.sh").exists()
 
     def test_register_token_validation(self, cp):
         from karmada_tpu.cli.karmadactl import CLIError

@@ -107,6 +107,39 @@ class TestOperator:
         self.runtime.settle()
         assert self.store.get("KarmadaInstance", "badgate").status.phase == PHASE_FAILED
 
+    def test_artifacts_task_emits_runnable_daemon(self, tmp_path):
+        """The install workflow materializes something a user can start
+        (the reference operator renders component manifests into the host
+        cluster; here: launcher + unit for `python -m karmada_tpu.server`)."""
+        self.store.create(KarmadaInstance(
+            metadata=ObjectMeta(name="prod"),
+            spec=KarmadaInstanceSpec(artifacts_dir=str(tmp_path)),
+        ))
+        self.runtime.settle()
+        instance = self.store.get("KarmadaInstance", "prod")
+        assert instance.status.phase == PHASE_RUNNING
+        assert len(instance.status.artifacts) == 2
+        for path in instance.status.artifacts:
+            assert (tmp_path / path.split("/")[-1]).exists()
+        launcher = tmp_path / "prod-daemon.sh"
+        assert "karmada_tpu.server" in launcher.read_text()
+
+    def test_artifacts_distinct_ports(self, tmp_path):
+        for name, port in (("a", 7501), ("b", 7502)):
+            self.store.create(KarmadaInstance(
+                metadata=ObjectMeta(name=name),
+                spec=KarmadaInstanceSpec(artifacts_dir=str(tmp_path),
+                                         daemon_port=port),
+            ))
+        self.runtime.settle()
+        assert "--port 7501" in (tmp_path / "a-daemon.sh").read_text()
+        assert "--port 7502" in (tmp_path / "b-daemon.sh").read_text()
+
+    def test_no_artifacts_without_dir(self):
+        self.store.create(KarmadaInstance(metadata=ObjectMeta(name="plain")))
+        self.runtime.settle()
+        assert self.store.get("KarmadaInstance", "plain").status.artifacts == []
+
     def test_deinit_on_delete(self):
         self.store.create(KarmadaInstance(metadata=ObjectMeta(name="tmp")))
         self.runtime.settle()
