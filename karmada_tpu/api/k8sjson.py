@@ -276,3 +276,260 @@ def target_clusters_to_json(clusters: list[TargetCluster]) -> list[dict]:
         {"name": tc.name, **({"replicas": tc.replicas} if tc.replicas else {})}
         for tc in clusters
     ]
+
+
+# -- typed → reference JSON (the marshal direction a Go component's own
+# json.Marshal produces; mirrors of the parsers above, omitempty-style) ------
+
+
+def epoch_to_rfc3339(v: Optional[float]) -> Optional[str]:
+    if v is None:
+        return None
+    from datetime import timezone
+
+    return (
+        datetime.fromtimestamp(float(v), tz=timezone.utc)
+        .isoformat()
+        .replace("+00:00", "Z")
+    )
+
+
+def resources_to_json(d: Optional[dict]) -> dict:
+    """→ corev1.ResourceList quantity strings ('2', '0.25')."""
+    out = {}
+    for k, v in (d or {}).items():
+        out[k] = str(int(v)) if float(v) == int(v) else repr(float(v))
+    return out
+
+
+def _label_selector_to_json(s: Optional[LabelSelector]) -> Optional[dict]:
+    if s is None:
+        return None
+    out: dict = {}
+    if s.match_labels:
+        out["matchLabels"] = dict(s.match_labels)
+    if s.match_expressions:
+        out["matchExpressions"] = [
+            {"key": e.key, "operator": e.operator,
+             **({"values": list(e.values)} if e.values else {})}
+            for e in s.match_expressions
+        ]
+    # an empty selector parses back as None; omit it so marshal∘parse∘marshal
+    # is a fixpoint (it selects everything either way)
+    return out or None
+
+
+def _field_selector_to_json(s) -> Optional[dict]:
+    if s is None:
+        return None
+    return {
+        "matchExpressions": [
+            {"key": e.key, "operator": e.operator,
+             **({"values": list(e.values)} if e.values else {})}
+            for e in s.match_expressions
+        ]
+    }
+
+
+def cluster_affinity_to_json(a: Optional[pol.ClusterAffinity]) -> Optional[dict]:
+    if a is None:
+        return None
+    out: dict = {}
+    sel = _label_selector_to_json(a.label_selector)
+    if sel is not None:
+        out["labelSelector"] = sel
+    fsel = _field_selector_to_json(a.field_selector)
+    if fsel is not None:
+        out["fieldSelector"] = fsel
+    if a.cluster_names:
+        out["clusterNames"] = list(a.cluster_names)
+    if a.exclude:
+        out["exclude"] = list(a.exclude)
+    return out
+
+
+def _toleration_to_json(t: pol.Toleration) -> dict:
+    out: dict = {}
+    if t.key:
+        out["key"] = t.key
+    # the parser defaults a missing operator to Equal; normalize here so
+    # the marshal is a fixpoint under parse∘marshal
+    out["operator"] = t.operator or "Equal"
+    if t.value:
+        out["value"] = t.value
+    if t.effect:
+        out["effect"] = t.effect
+    if t.toleration_seconds is not None:
+        out["tolerationSeconds"] = t.toleration_seconds
+    return out
+
+
+def placement_to_json(p: Optional[pol.Placement]) -> Optional[dict]:
+    if p is None:
+        return None
+    out: dict = {}
+    aff = cluster_affinity_to_json(p.cluster_affinity)
+    if aff is not None:
+        out["clusterAffinity"] = aff
+    if p.cluster_affinities:
+        out["clusterAffinities"] = [
+            {"affinityName": t.affinity_name,
+             **(cluster_affinity_to_json(t.affinity) or {})}
+            for t in p.cluster_affinities
+        ]
+    if p.cluster_tolerations:
+        out["clusterTolerations"] = [
+            _toleration_to_json(t) for t in p.cluster_tolerations
+        ]
+    if p.spread_constraints:
+        out["spreadConstraints"] = [
+            {
+                **({"spreadByField": s.spread_by_field}
+                   if s.spread_by_field else {}),
+                **({"spreadByLabel": s.spread_by_label}
+                   if s.spread_by_label else {}),
+                "minGroups": s.min_groups or 1,
+                **({"maxGroups": s.max_groups} if s.max_groups else {}),
+            }
+            for s in p.spread_constraints
+        ]
+    rs = p.replica_scheduling
+    if rs is not None:
+        rsj: dict = {"replicaSchedulingType": rs.replica_scheduling_type}
+        if rs.replica_division_preference:
+            rsj["replicaDivisionPreference"] = rs.replica_division_preference
+        wp = rs.weight_preference
+        if wp is not None:
+            wpj: dict = {}
+            if wp.static_weight_list:
+                wpj["staticWeightList"] = [
+                    {
+                        "targetCluster": cluster_affinity_to_json(
+                            w.target_cluster
+                        ) or {},
+                        "weight": w.weight,
+                    }
+                    for w in wp.static_weight_list
+                ]
+            if wp.dynamic_weight:
+                wpj["dynamicWeight"] = wp.dynamic_weight
+            rsj["weightPreference"] = wpj
+        out["replicaScheduling"] = rsj
+    return out
+
+
+def replica_requirements_to_json(r: Optional[ReplicaRequirements]) -> Optional[dict]:
+    if r is None:
+        return None
+    out: dict = {}
+    if r.node_claim is not None:
+        nc: dict = {}
+        if r.node_claim.node_selector:
+            nc["nodeSelector"] = dict(r.node_claim.node_selector)
+        if r.node_claim.tolerations:
+            nc["tolerations"] = list(r.node_claim.tolerations)
+        if r.node_claim.hard_node_affinity is not None:
+            nc["hardNodeAffinity"] = r.node_claim.hard_node_affinity
+        out["nodeClaim"] = nc
+    if r.resource_request:
+        out["resourceRequest"] = resources_to_json(r.resource_request)
+    if r.namespace:
+        out["namespace"] = r.namespace
+    if r.priority_class_name:
+        out["priorityClassName"] = r.priority_class_name
+    return out
+
+
+def binding_spec_to_json(s: BindingSpec) -> dict:
+    """BindingSpec → workv1alpha2.ResourceBindingSpec JSON (the scheduler's
+    slice; inverse of binding_spec_from_json)."""
+    out: dict = {
+        "resource": {
+            **({"apiVersion": s.resource.api_version}
+               if s.resource.api_version else {}),
+            **({"kind": s.resource.kind} if s.resource.kind else {}),
+            **({"namespace": s.resource.namespace}
+               if s.resource.namespace else {}),
+            **({"name": s.resource.name} if s.resource.name else {}),
+            **({"uid": s.resource.uid} if s.resource.uid else {}),
+        },
+    }
+    if s.replicas:
+        out["replicas"] = s.replicas
+    rr = replica_requirements_to_json(s.replica_requirements)
+    if rr is not None:
+        out["replicaRequirements"] = rr
+    pj = placement_to_json(s.placement)
+    if pj is not None:
+        out["placement"] = pj
+    if s.clusters:
+        out["clusters"] = target_clusters_to_json(s.clusters)
+    if s.scheduler_name:
+        out["schedulerName"] = s.scheduler_name
+    if s.reschedule_triggered_at is not None:
+        out["rescheduleTriggeredAt"] = epoch_to_rfc3339(s.reschedule_triggered_at)
+    return out
+
+
+def cluster_to_json(c: Cluster) -> dict:
+    """Cluster → clusterv1alpha1.Cluster JSON (the scheduler's slice;
+    inverse of cluster_from_json)."""
+    out: dict = {
+        "metadata": {
+            "name": c.metadata.name,
+            **({"labels": dict(c.metadata.labels)}
+               if c.metadata.labels else {}),
+        },
+        "spec": {
+            "syncMode": c.spec.sync_mode,
+            **({"provider": c.spec.provider} if c.spec.provider else {}),
+            **({"region": c.spec.region} if c.spec.region else {}),
+            **({"zone": c.spec.zone} if c.spec.zone else {}),
+        },
+    }
+    if c.spec.taints:
+        out["spec"]["taints"] = [
+            {
+                **({"key": t.key} if t.key else {}),
+                **({"value": t.value} if t.value else {}),
+                **({"effect": t.effect} if t.effect else {}),
+                **({"timeAdded": epoch_to_rfc3339(t.time_added)}
+                   if t.time_added is not None else {}),
+            }
+            for t in c.spec.taints
+        ]
+    status: dict = {}
+    if c.status.kubernetes_version:
+        status["kubernetesVersion"] = c.status.kubernetes_version
+    if c.status.api_enablements:
+        status["apiEnablements"] = [
+            {"groupVersion": e.group_version,
+             "resources": [{"kind": k} for k in e.resources]}
+            for e in c.status.api_enablements
+        ]
+    if c.status.conditions:
+        status["conditions"] = [
+            {
+                "type": cond.type, "status": cond.status,
+                **({"reason": cond.reason} if cond.reason else {}),
+                **({"message": cond.message} if cond.message else {}),
+            }
+            for cond in c.status.conditions
+        ]
+    ns = c.status.node_summary
+    if ns is not None and (ns.total_num or ns.ready_num):
+        status["nodeSummary"] = {"totalNum": ns.total_num,
+                                 "readyNum": ns.ready_num}
+    rs = c.status.resource_summary
+    if rs is not None and (rs.allocatable or rs.allocating or rs.allocated):
+        status["resourceSummary"] = {
+            **({"allocatable": resources_to_json(rs.allocatable)}
+               if rs.allocatable else {}),
+            **({"allocating": resources_to_json(rs.allocating)}
+               if rs.allocating else {}),
+            **({"allocated": resources_to_json(rs.allocated)}
+               if rs.allocated else {}),
+        }
+    if status:
+        out["status"] = status
+    return out

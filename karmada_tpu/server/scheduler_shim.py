@@ -86,7 +86,12 @@ class SchedulerShim:
             bindings.append(ResourceBinding(
                 metadata=ObjectMeta(
                     namespace=spec.resource.namespace, name=f"{name}-{i}",
-                    uid=new_uid("shim"),
+                    # seed the deterministic tie-break (models/batch.py
+                    # tie_matrix) from the template's own uid when the wire
+                    # carries one: repeated calls for the same object then
+                    # return identical placements (the reference's
+                    # crypto-rand tie-break is per-call instead)
+                    uid=spec.resource.uid or new_uid("shim"),
                 ),
                 spec=spec,
                 status=status,
