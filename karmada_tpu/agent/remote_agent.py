@@ -27,11 +27,12 @@ from .agent import KarmadaAgent
 
 class RemoteAgentSession:
     def __init__(self, url: str, config: MemberConfig,
-                 member: Optional[InMemoryMember] = None):
+                 member: Optional[InMemoryMember] = None,
+                 token: Optional[str] = None, cafile: Optional[str] = None):
         if config.sync_mode != "Pull":
             raise ValueError("remote agents serve Pull clusters")
         self.config = config
-        self.store = RemoteStore(url)
+        self.store = RemoteStore(url, token=token, cafile=cafile)
         self.member = member or InMemoryMember(config)
         self.runtime = Runtime()
         interpreter = ResourceInterpreter()

@@ -1237,23 +1237,34 @@ def main(argv: Optional[list[str]] = None) -> int:
 
     # --server URL (or KARMADA_SERVER): run out-of-process against a live
     # daemon (python -m karmada_tpu.server), like the reference CLI speaking
-    # REST to the karmada-apiserver. Peeled before subcommand parsing so it
-    # works in any position.
-    server_url = os.environ.get("KARMADA_SERVER", "")
-    for i, a in enumerate(argv):
-        if a == "--server" and i + 1 < len(argv):
-            server_url = argv[i + 1]
-            del argv[i:i + 2]
-            break
-        if a.startswith("--server="):
-            server_url = a.partition("=")[2]
-            del argv[i]
-            break
+    # REST to the karmada-apiserver. --bearer-token/KARMADA_TOKEN and
+    # --cacert/KARMADA_CACERT are the kubeconfig bearer-token and
+    # certificate-authority roles for daemons started with --token-file /
+    # --tls-dir. (--bearer-token, not --token: the register verb's
+    # bootstrap --token must reach its own subparser.) Peeled before
+    # subcommand parsing so they work anywhere.
+    def peel(flag: str, env: str) -> str:
+        val = os.environ.get(env, "")
+        for i, a in enumerate(argv):
+            if a == flag and i + 1 < len(argv):
+                val = argv[i + 1]
+                del argv[i:i + 2]
+                break
+            if a.startswith(flag + "="):
+                val = a.partition("=")[2]
+                del argv[i]
+                break
+        return val
+
+    server_url = peel("--server", "KARMADA_SERVER")
+    token = peel("--bearer-token", "KARMADA_TOKEN")
+    cacert = peel("--cacert", "KARMADA_CACERT")
 
     if server_url:
         from ..server.remote import RemoteControlPlane, RemoteError
 
-        cp = RemoteControlPlane(server_url)
+        cp = RemoteControlPlane(server_url, token=token or None,
+                                cafile=cacert or None)
         errors = (CLIError, AdmissionDenied, ConflictError, NotFoundError,
                   RemoteError, AttributeError)  # AttributeError = verb needs
         # daemon-side state the remote facade doesn't expose

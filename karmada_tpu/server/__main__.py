@@ -33,6 +33,15 @@ def main() -> None:
     ap.add_argument("--data-dir", default="",
                     help="persist store state (snapshot + WAL) here and "
                          "restore it on start; empty = in-memory only")
+    ap.add_argument("--tls-dir", default="",
+                    help="serve HTTPS with material from this directory "
+                         "(ca.pem/server.pem/server.key; generated via the "
+                         "cluster CA on first start — clients verify with "
+                         "ca.pem); empty = plaintext HTTP")
+    ap.add_argument("--token-file", default="",
+                    help="require 'Authorization: Bearer <token>' matching "
+                         "this file's contents (generated on first start "
+                         "if absent); empty = unauthenticated")
     args = ap.parse_args()
 
     if args.platform == "cpu":
@@ -71,10 +80,25 @@ def main() -> None:
         ))
     cp.settle()
 
-    srv = ControlPlaneServer(cp, host=args.host, port=args.port)
-    port = srv.start()
-    print(f"karmada-tpu control plane serving on http://{args.host}:{port}",
-          flush=True)
+    ssl_context = None
+    if args.tls_dir:
+        from .tlsmaterial import ensure_server_tls
+
+        ssl_context = ensure_server_tls(args.tls_dir, args.host)
+        print(f"tls: serving with material from {args.tls_dir} "
+              f"(clients: --cacert {args.tls_dir}/ca.pem)", flush=True)
+    token = None
+    if args.token_file:
+        from .tlsmaterial import ensure_token
+
+        token = ensure_token(args.token_file)
+        print(f"auth: bearer token required (--token-file {args.token_file})",
+              flush=True)
+
+    srv = ControlPlaneServer(cp, host=args.host, port=args.port,
+                             ssl_context=ssl_context, token=token)
+    srv.start()
+    print(f"karmada-tpu control plane serving on {srv.url}", flush=True)
 
     def ticker() -> None:
         while True:

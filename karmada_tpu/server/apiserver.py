@@ -22,11 +22,16 @@ Routes (all JSON; objects wire-encoded by server/codec.py):
 | POST /unjoin         | cp.unjoin_member          | body {"name": ...}         |
 | POST /agent/cert     | cp.sign_agent_cert        | register CSR flow          |
 
-Error mapping: NotFound→404, Conflict→409, admission denial→422, anything
-else→500; bodies are {"error": "..."}. The reference secures this boundary
-with TLS + RBAC on the kube-apiserver; here the daemon binds loopback by
-default and multi-host deployments are expected to front it with the same
-mTLS material `auth/pki.py` already issues for the estimator seam.
+Error mapping: NotFound→404, Conflict→409, admission denial→422, missing or
+wrong bearer token→401, anything else→500; bodies are {"error": "..."}.
+
+Transport security mirrors the reference's kube-apiserver boundary (TLS +
+authn): pass `ssl_context` (server cert signed by the cluster CA,
+`auth/pki.py`) to serve HTTPS, and `token` to require
+`Authorization: Bearer <token>` on every route except GET /healthz
+(liveness probes are conventionally unauthenticated). The daemon
+(`python -m karmada_tpu.server --tls-dir --token-file`) materializes both;
+loopback plaintext remains the zero-config default for tests and demos.
 
 Concurrency model: store CRUD is thread-safe (store.py's RLock), so request
 handlers hit it directly. Controller queues drain on a single reconcile
@@ -51,10 +56,13 @@ _WATCH_END = object()
 
 
 class ControlPlaneServer:
-    def __init__(self, cp, host: str = "127.0.0.1", port: int = 0):
+    def __init__(self, cp, host: str = "127.0.0.1", port: int = 0,
+                 ssl_context=None, token: Optional[str] = None):
         self.cp = cp
         self._host = host
         self._port = port
+        self._ssl_context = ssl_context
+        self._token = token
         self._httpd: Optional[ThreadingHTTPServer] = None
         self._threads: list[threading.Thread] = []
         self._dirty = threading.Event()
@@ -86,7 +94,31 @@ class ControlPlaneServer:
             def do_DELETE(self):
                 server._route(self, "DELETE")
 
-        self._httpd = ThreadingHTTPServer((self._host, self._port), Handler)
+        if self._ssl_context is not None:
+            ctx = self._ssl_context
+
+            class TLSServer(ThreadingHTTPServer):
+                # handshake in the per-connection thread (finish_request
+                # runs there under ThreadingMixIn), NOT on the accept loop:
+                # wrapping the listening socket would let one client that
+                # connects and never sends ClientHello stall accept() and
+                # with it every other request
+                def finish_request(self, request, client_address):
+                    import ssl
+
+                    request.settimeout(15.0)
+                    try:
+                        tls = ctx.wrap_socket(request, server_side=True)
+                        tls.settimeout(None)
+                    except (ssl.SSLError, OSError):
+                        request.close()
+                        return
+                    self.RequestHandlerClass(tls, client_address, self)
+
+            server_cls = TLSServer
+        else:
+            server_cls = ThreadingHTTPServer
+        self._httpd = server_cls((self._host, self._port), Handler)
         self._httpd.daemon_threads = True
         self._port = self._httpd.server_address[1]
         self.cp.store.watch_all(self._mark_dirty, replay=False)
@@ -109,7 +141,8 @@ class ControlPlaneServer:
 
     @property
     def url(self) -> str:
-        return f"http://{self._host}:{self._port}"
+        scheme = "https" if self._ssl_context is not None else "http"
+        return f"{scheme}://{self._host}:{self._port}"
 
     # -- reconcile thread -------------------------------------------------
 
@@ -151,6 +184,19 @@ class ControlPlaneServer:
     def _route(self, h: BaseHTTPRequestHandler, method: str) -> None:
         parsed = urlparse(h.path)
         q = {k: v[0] for k, v in parse_qs(parsed.query).items()}
+        if (self._token is not None
+                and not (method == "GET" and parsed.path == "/healthz")):
+            import hmac
+
+            # compare as bytes: compare_digest refuses non-ASCII str, and a
+            # hostile header must yield a 401, not an unhandled TypeError
+            supplied = h.headers.get("Authorization", "")
+            want = f"Bearer {self._token}".encode()
+            if not hmac.compare_digest(
+                supplied.encode("utf-8", "surrogateescape"), want
+            ):
+                self._send(h, 401, {"error": "unauthorized"})
+                return
         try:
             fn = getattr(self, f"_h_{method}_{parsed.path.strip('/').replace('/', '_')}", None)
             if fn is None:

@@ -33,22 +33,41 @@ class AdmissionDeniedRemote(RemoteError):
 
 
 class RemoteStore:
-    def __init__(self, base_url: str, timeout: float = 30.0):
+    def __init__(self, base_url: str, timeout: float = 30.0,
+                 token: Optional[str] = None, cafile: Optional[str] = None):
         self.base_url = base_url.rstrip("/")
         self.timeout = timeout
+        self.token = token
+        self.cafile = cafile
+        self._ssl_ctx = None
+        if self.base_url.startswith("https"):
+            import ssl
+
+            # verify against the cluster CA the daemon's --tls-dir emitted
+            # (the kubeconfig certificate-authority role); without a cafile
+            # the default trust store applies and a self-signed CA fails —
+            # honest, not bypassed
+            self._ssl_ctx = ssl.create_default_context(cafile=cafile)
         self._watch_threads: list[threading.Thread] = []
         self._closed = False
 
     # -- transport --------------------------------------------------------
 
+    def _headers(self, with_content: bool) -> dict:
+        headers = {"Content-Type": "application/json"} if with_content else {}
+        if self.token:
+            headers["Authorization"] = f"Bearer {self.token}"
+        return headers
+
     def _call(self, method: str, path: str, body: Optional[dict] = None) -> dict:
         data = json.dumps(body).encode() if body is not None else None
         req = Request(
             self.base_url + path, data=data, method=method,
-            headers={"Content-Type": "application/json"} if data else {},
+            headers=self._headers(data is not None),
         )
         try:
-            with urlopen(req, timeout=self.timeout) as resp:
+            with urlopen(req, timeout=self.timeout,
+                         context=self._ssl_ctx) as resp:
                 return json.loads(resp.read().decode() or "{}")
         except HTTPError as e:
             try:
@@ -134,11 +153,17 @@ class RemoteStore:
             # the server heartbeats every 0.5s; a read stalling 10x that is
             # a half-open connection (host died without RST) — time out and
             # let the outer loop re-attach with replay
-            conn = http.client.HTTPConnection(
-                url.hostname, url.port, timeout=5.0
-            )
+            if self._ssl_ctx is not None:
+                conn = http.client.HTTPSConnection(
+                    url.hostname, url.port, timeout=5.0,
+                    context=self._ssl_ctx,
+                )
+            else:
+                conn = http.client.HTTPConnection(
+                    url.hostname, url.port, timeout=5.0
+                )
             try:
-                conn.request("GET", path)
+                conn.request("GET", path, headers=self._headers(False))
                 resp = conn.getresponse()
                 if resp.status != 200:
                     return
@@ -266,9 +291,11 @@ class RemoteControlPlane:
     interpreter internals) raises AttributeError — those verbs require the
     daemon side, as in the reference where karmadactl is a pure API client."""
 
-    def __init__(self, url: str, timeout: float = 30.0):
+    def __init__(self, url: str, timeout: float = 30.0,
+                 token: Optional[str] = None, cafile: Optional[str] = None):
         self.url = url.rstrip("/")
-        self.store = RemoteStore(self.url, timeout=timeout)
+        self.store = RemoteStore(self.url, timeout=timeout, token=token,
+                                 cafile=cafile)
         self.members = _RemoteMembers(self.store)
 
     def settle(self, max_steps: int = 0) -> int:

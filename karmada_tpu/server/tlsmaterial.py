@@ -1,0 +1,77 @@
+"""On-disk TLS material + bearer token for the serving boundary.
+
+The reference's L1 is a kube-apiserver: TLS with a cluster CA, clients
+verifying via the kubeconfig's certificate-authority and authenticating
+with bearer tokens/certs. `ensure_server_tls` materializes that shape from
+our own cluster CA (`auth/pki.py`): on first start it writes
+ca.pem / server.pem / server.key into the directory; later starts reuse
+them (so client-held ca.pem copies stay valid across daemon restarts).
+"""
+from __future__ import annotations
+
+import os
+import secrets
+
+
+def _cert_covers_host(cert_path: str, host: str) -> bool:
+    from cryptography import x509
+
+    with open(cert_path, "rb") as f:
+        cert = x509.load_pem_x509_certificate(f.read())
+    try:
+        sans = cert.extensions.get_extension_for_class(
+            x509.SubjectAlternativeName
+        ).value
+    except x509.ExtensionNotFound:
+        return False
+    names = {str(v) for v in sans.get_values_for_type(x509.DNSName)}
+    names |= {str(v) for v in sans.get_values_for_type(x509.IPAddress)}
+    return host in names
+
+
+def ensure_server_tls(tls_dir: str, host: str):
+    """Return an ssl.SSLContext serving cert material from tls_dir.
+
+    Reuses existing ca.pem/server.pem/server.key (so client-held ca.pem
+    copies stay valid across restarts); generates all three when any is
+    missing OR the existing cert's SANs don't cover `host` (a daemon moved
+    from loopback to a routable --host needs a new cert, and the CA key is
+    not persisted, so regeneration is a full re-issue — clients must
+    re-pin the new ca.pem)."""
+    import ssl
+
+    os.makedirs(tls_dir, exist_ok=True)
+    ca_path = os.path.join(tls_dir, "ca.pem")
+    cert_path = os.path.join(tls_dir, "server.pem")
+    key_path = os.path.join(tls_dir, "server.key")
+    complete = all(
+        os.path.exists(p) for p in (ca_path, cert_path, key_path)
+    )
+    if not complete or not _cert_covers_host(cert_path, host):
+        from ..auth.pki import CertificateAuthority
+
+        ca = CertificateAuthority(common_name="karmada-tpu-ca")
+        sans = tuple(dict.fromkeys((host, "localhost", "127.0.0.1")))
+        issued = ca.sign("karmada-tpu-apiserver", dns_names=sans)
+        with open(ca_path, "wb") as f:
+            f.write(ca.ca_pem)
+        with open(cert_path, "wb") as f:
+            f.write(issued.cert_pem)
+        with open(key_path, "wb") as f:
+            f.write(issued.key_pem)
+        os.chmod(key_path, 0o600)
+    ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
+    ctx.load_cert_chain(cert_path, key_path)
+    return ctx
+
+
+def ensure_token(token_file: str) -> str:
+    """Read the bearer token from token_file, generating one on first use."""
+    if not os.path.exists(token_file):
+        parent = os.path.dirname(os.path.abspath(token_file))
+        os.makedirs(parent, exist_ok=True)
+        fd = os.open(token_file, os.O_WRONLY | os.O_CREAT | os.O_EXCL, 0o600)
+        with os.fdopen(fd, "w") as f:
+            f.write(secrets.token_urlsafe(24))
+    with open(token_file) as f:
+        return f.read().strip()

@@ -340,6 +340,195 @@ class TestDaemonProcess:
             rs.close()
 
 
+class TestTLSAndAuth:
+    """The secured serving boundary: HTTPS from the cluster CA's material
+    plus bearer-token authn — the kube-apiserver transport shape of L1."""
+
+    @pytest.fixture()
+    def secured_plane(self, tmp_path):
+        from karmada_tpu.server.tlsmaterial import ensure_server_tls, ensure_token
+
+        cp = ControlPlane()
+        cp.join_member(MemberConfig(
+            name="member1", region="region-1",
+            allocatable={CPU: 100.0, MEMORY: 400 * GiB, "pods": 1000.0},
+        ))
+        cp.settle()
+        ctx = ensure_server_tls(str(tmp_path / "tls"), "127.0.0.1")
+        token = ensure_token(str(tmp_path / "token"))
+        srv = ControlPlaneServer(cp, ssl_context=ctx, token=token)
+        srv.start()
+        yield cp, srv, token, str(tmp_path / "tls" / "ca.pem")
+        srv.stop()
+
+    def test_crud_and_watch_over_tls(self, secured_plane):
+        cp, srv, token, cafile = secured_plane
+        assert srv.url.startswith("https://")
+        rs = RemoteStore(srv.url, token=token, cafile=cafile)
+        try:
+            assert "Cluster" in rs.kinds()
+            names: set[str] = set()
+            rs.watch("v1/ConfigMap", lambda ev, o: names.add(o.metadata.name),
+                     replay=True)
+            time.sleep(0.3)
+            rs.create(Unstructured({
+                "apiVersion": "v1", "kind": "ConfigMap",
+                "metadata": {"name": "sec", "namespace": "default"},
+                "data": {"k": "v"},
+            }))
+            assert wait_until(lambda: "sec" in names)
+        finally:
+            rs.close()
+
+    def test_wrong_or_missing_token_is_401(self, secured_plane):
+        from karmada_tpu.server.remote import RemoteError
+
+        cp, srv, token, cafile = secured_plane
+        for bad in (None, "not-the-token"):
+            rs = RemoteStore(srv.url, token=bad, cafile=cafile)
+            with pytest.raises(RemoteError, match="401"):
+                rs.kinds()
+        # healthz stays probe-able without credentials
+        rcp = RemoteControlPlane(srv.url, cafile=cafile)
+        assert rcp.healthz()
+
+    def test_untrusted_ca_is_rejected(self, secured_plane, tmp_path):
+        from karmada_tpu.server.remote import RemoteError
+        from karmada_tpu.server.tlsmaterial import ensure_server_tls
+
+        cp, srv, token, cafile = secured_plane
+        ensure_server_tls(str(tmp_path / "other"), "127.0.0.1")
+        rs = RemoteStore(srv.url, token=token,
+                         cafile=str(tmp_path / "other" / "ca.pem"))
+        with pytest.raises(RemoteError, match="unreachable"):
+            rs.kinds()
+
+    def test_pull_agent_over_tls(self, secured_plane):
+        from karmada_tpu.agent.remote_agent import RemoteAgentSession
+        from karmada_tpu.api.work import (
+            work_namespace_for_cluster as execution_namespace,
+        )
+
+        cp, srv, token, cafile = secured_plane
+        session = RemoteAgentSession(
+            srv.url,
+            MemberConfig(name="edge-tls", sync_mode="Pull", region="edge",
+                         allocatable={CPU: 50.0, MEMORY: 200 * GiB,
+                                      "pods": 500.0}),
+            token=token, cafile=cafile,
+        )
+        try:
+            session.register()
+            assert wait_until(
+                lambda: cp.store.try_get("Cluster", "edge-tls") is not None
+            )
+            dep = new_deployment("default", "edge-app", replicas=2, cpu=0.1)
+            session.store.create(dep)
+            session.store.create(new_policy(
+                "default", "edge-pp", [selector_for(dep)],
+                duplicated_placement(["edge-tls"]),
+            ))
+            assert wait_until(lambda: len(
+                cp.store.list("Work", execution_namespace("edge-tls"))
+            ) > 0)
+            assert wait_until(
+                lambda: (session.step() or True) and session.member.get(
+                    "apps/v1", "Deployment", "edge-app", "default"
+                ) is not None
+            ), "agent never applied the Work over TLS"
+        finally:
+            session.close()
+
+    def test_tls_material_survives_restart(self, tmp_path):
+        """Second start reuses the directory's material, so a client's
+        ca.pem copy stays valid across daemon restarts — but a --host the
+        cert's SANs don't cover forces a re-issue."""
+        from karmada_tpu.server.tlsmaterial import ensure_server_tls
+
+        d = str(tmp_path / "tls")
+        ensure_server_tls(d, "127.0.0.1")
+        before = (tmp_path / "tls" / "server.pem").read_bytes()
+        ensure_server_tls(d, "127.0.0.1")
+        assert (tmp_path / "tls" / "server.pem").read_bytes() == before
+        ensure_server_tls(d, "10.9.8.7")
+        after = (tmp_path / "tls" / "server.pem").read_bytes()
+        assert after != before
+        from karmada_tpu.server.tlsmaterial import _cert_covers_host
+
+        cert = tmp_path / "tls" / "server.pem"
+        assert _cert_covers_host(str(cert), "10.9.8.7")
+        assert _cert_covers_host(str(cert), "127.0.0.1")
+
+    def test_stalled_client_hello_does_not_block_server(self, secured_plane):
+        """A TCP client that never sends ClientHello must not stall the
+        accept loop (handshake happens in the per-connection thread)."""
+        import socket
+
+        cp, srv, token, cafile = secured_plane
+        stalled = socket.create_connection(("127.0.0.1", srv._port))
+        try:
+            rs = RemoteStore(srv.url, token=token, cafile=cafile)
+            assert "Cluster" in rs.kinds()  # served despite the stalled peer
+            rs.close()
+        finally:
+            stalled.close()
+
+    def test_non_ascii_auth_header_is_401(self, secured_plane):
+        import http.client
+        import ssl as ssl_mod
+
+        cp, srv, token, cafile = secured_plane
+        ctx = ssl_mod.create_default_context(cafile=cafile)
+        conn = http.client.HTTPSConnection("127.0.0.1", srv._port,
+                                           timeout=10, context=ctx)
+        try:
+            conn.request("GET", "/kinds",
+                         headers={"Authorization": "Bearer caf\xe9"})
+            assert conn.getresponse().status == 401
+        finally:
+            conn.close()
+
+    def test_daemon_subprocess_tls_token_cli(self, tmp_path):
+        """Process-boundary e2e: daemon with --tls-dir/--token-file, CLI
+        with --server https + --token + --cacert."""
+        import re
+        import subprocess
+        import sys
+
+        tls_dir = str(tmp_path / "tls")
+        token_file = str(tmp_path / "token")
+        proc = subprocess.Popen(
+            [sys.executable, "-m", "karmada_tpu.server",
+             "--members", "1", "--tick-interval", "0.5", "--platform", "cpu",
+             "--tls-dir", tls_dir, "--token-file", token_file],
+            stdout=subprocess.PIPE, stderr=subprocess.DEVNULL, text=True,
+        )
+        try:
+            url = None
+            for _ in range(10):
+                line = proc.stdout.readline()
+                m = re.search(r"https://[\d.]+:\d+", line)
+                if m:
+                    url = m.group(0)
+                    break
+            assert url, "no https URL line"
+            token = (tmp_path / "token").read_text().strip()
+
+            from karmada_tpu.cli.karmadactl import main as cli_main
+
+            rc = cli_main(["get", "clusters", "--server", url,
+                           "--bearer-token", token,
+                           "--cacert", f"{tls_dir}/ca.pem"])
+            assert rc == 0
+            rc = cli_main(["get", "clusters", "--server", url,
+                           "--bearer-token", "wrong",
+                           "--cacert", f"{tls_dir}/ca.pem"])
+            assert rc == 1
+        finally:
+            proc.terminate()
+            proc.wait(timeout=10)
+
+
 class TestNamespaceScopedWatch:
     def test_store_watch_namespace_filter(self):
         from karmada_tpu.store.store import Store
