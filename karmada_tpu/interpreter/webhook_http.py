@@ -117,9 +117,20 @@ class InterpreterHookServer:
     def __init__(self, handler: Any, host: str = "127.0.0.1", port: int = 0,
                  pki=None, hostname: str = "localhost"):
         self.handler = handler
-        self._server = BackgroundHTTPServer(host, port)
         self._pki = pki
         self._hostname = hostname
+        ssl_ctx = None
+        if pki is not None:
+            cert = pki.sign(hostname, dns_names=(hostname, host))
+            ssl_ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
+            with tempfile.NamedTemporaryFile(suffix=".pem") as cf, \
+                    tempfile.NamedTemporaryFile(suffix=".pem") as kf:
+                cf.write(cert.cert_pem)
+                cf.flush()
+                kf.write(cert.key_pem)
+                kf.flush()
+                ssl_ctx.load_cert_chain(cf.name, kf.name)
+        self._server = BackgroundHTTPServer(host, port, ssl_context=ssl_ctx)
 
     def start(self) -> int:
         hook = self
@@ -140,22 +151,7 @@ class InterpreterHookServer:
                     "response": response,
                 })
 
-        httpd = self._server.bind_only(Handler)
-        if self._pki is not None:
-            cert = self._pki.sign(
-                self._hostname,
-                dns_names=(self._hostname, self._server.host),
-            )
-            ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
-            with tempfile.NamedTemporaryFile(suffix=".pem") as cf, \
-                    tempfile.NamedTemporaryFile(suffix=".pem") as kf:
-                cf.write(cert.cert_pem)
-                cf.flush()
-                kf.write(cert.key_pem)
-                kf.flush()
-                ctx.load_cert_chain(cf.name, kf.name)
-            httpd.socket = ctx.wrap_socket(httpd.socket, server_side=True)
-        return self._server.serve("interp-hook")
+        return self._server.bind(Handler, "interp-hook")
 
     @property
     def url(self) -> str:

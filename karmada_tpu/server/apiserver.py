@@ -50,7 +50,13 @@ from urllib.parse import parse_qs, urlparse
 from ..store.store import ConflictError, NotFoundError
 from ..webhook.handlers import AdmissionDenied
 from . import codec
-from .httpbase import read_json, send_json
+from .httpbase import (
+    bearer_auth_ok,
+    drain_body,
+    make_http_server,
+    read_json,
+    send_json,
+)
 
 _WATCH_END = object()
 
@@ -94,32 +100,9 @@ class ControlPlaneServer:
             def do_DELETE(self):
                 server._route(self, "DELETE")
 
-        if self._ssl_context is not None:
-            ctx = self._ssl_context
-
-            class TLSServer(ThreadingHTTPServer):
-                # handshake in the per-connection thread (finish_request
-                # runs there under ThreadingMixIn), NOT on the accept loop:
-                # wrapping the listening socket would let one client that
-                # connects and never sends ClientHello stall accept() and
-                # with it every other request
-                def finish_request(self, request, client_address):
-                    import ssl
-
-                    request.settimeout(15.0)
-                    try:
-                        tls = ctx.wrap_socket(request, server_side=True)
-                        tls.settimeout(None)
-                    except (ssl.SSLError, OSError):
-                        request.close()
-                        return
-                    self.RequestHandlerClass(tls, client_address, self)
-
-            server_cls = TLSServer
-        else:
-            server_cls = ThreadingHTTPServer
-        self._httpd = server_cls((self._host, self._port), Handler)
-        self._httpd.daemon_threads = True
+        self._httpd = make_http_server(
+            self._host, self._port, Handler, self._ssl_context
+        )
         self._port = self._httpd.server_address[1]
         self.cp.store.watch_all(self._mark_dirty, replay=False)
         for target, name in ((self._httpd.serve_forever, "serve"),
@@ -184,22 +167,15 @@ class ControlPlaneServer:
     def _route(self, h: BaseHTTPRequestHandler, method: str) -> None:
         parsed = urlparse(h.path)
         q = {k: v[0] for k, v in parse_qs(parsed.query).items()}
-        if (self._token is not None
-                and not (method == "GET" and parsed.path == "/healthz")):
-            import hmac
-
-            # compare as bytes: compare_digest refuses non-ASCII str, and a
-            # hostile header must yield a 401, not an unhandled TypeError
-            supplied = h.headers.get("Authorization", "")
-            want = f"Bearer {self._token}".encode()
-            if not hmac.compare_digest(
-                supplied.encode("utf-8", "surrogateescape"), want
-            ):
-                self._send(h, 401, {"error": "unauthorized"})
-                return
+        if (not (method == "GET" and parsed.path == "/healthz")
+                and not bearer_auth_ok(h, self._token)):
+            drain_body(h)
+            self._send(h, 401, {"error": "unauthorized"})
+            return
         try:
             fn = getattr(self, f"_h_{method}_{parsed.path.strip('/').replace('/', '_')}", None)
             if fn is None:
+                drain_body(h)
                 self._send(h, 404, {"error": f"no route {method} {parsed.path}"})
                 return
             fn(h, q)

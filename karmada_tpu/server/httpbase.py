@@ -37,13 +37,68 @@ def read_json(handler: BaseHTTPRequestHandler) -> dict:
     return json.loads(handler.rfile.read(n).decode())
 
 
+def drain_body(handler: BaseHTTPRequestHandler) -> None:
+    """Consume an unread request body before an early-reply (401/404): on an
+    HTTP/1.1 keep-alive connection, leftover body bytes would be parsed as
+    the next request line, desyncing every later request on the socket."""
+    try:
+        n = int(handler.headers.get("Content-Length") or 0)
+        if n:
+            handler.rfile.read(n)
+    except (OSError, ValueError):
+        pass
+
+
+def make_http_server(host: str, port: int, handler_cls,
+                     ssl_context=None) -> ThreadingHTTPServer:
+    """A ThreadingHTTPServer, TLS-wrapped per connection when ssl_context
+    is given: the handshake runs in the handler thread (finish_request under
+    ThreadingMixIn), NOT on the accept loop, so a client that connects and
+    never sends ClientHello cannot stall every other request."""
+    if ssl_context is None:
+        httpd = ThreadingHTTPServer((host, port), handler_cls)
+    else:
+        class TLSServer(ThreadingHTTPServer):
+            def finish_request(self, request, client_address):
+                import ssl
+
+                request.settimeout(15.0)
+                try:
+                    tls = ssl_context.wrap_socket(request, server_side=True)
+                    tls.settimeout(None)
+                except (ssl.SSLError, OSError):
+                    request.close()
+                    return
+                self.RequestHandlerClass(tls, client_address, self)
+
+        httpd = TLSServer((host, port), handler_cls)
+    httpd.daemon_threads = True
+    return httpd
+
+
+def bearer_auth_ok(handler: BaseHTTPRequestHandler,
+                   token: Optional[str]) -> bool:
+    """Constant-time bearer check; tolerant of hostile header bytes."""
+    if token is None:
+        return True
+    import hmac
+
+    supplied = handler.headers.get("Authorization", "")
+    return hmac.compare_digest(
+        supplied.encode("utf-8", "surrogateescape"),
+        f"Bearer {token}".encode(),
+    )
+
+
 class BackgroundHTTPServer:
     """A ThreadingHTTPServer served from a daemon thread; `start()` returns
     the bound port (0 = ephemeral)."""
 
-    def __init__(self, host: str = "127.0.0.1", port: int = 0):
+    def __init__(self, host: str = "127.0.0.1", port: int = 0,
+                 ssl_context=None):
         self._host = host
         self._port = port
+        self._ssl_context = ssl_context
         self._httpd: Optional[ThreadingHTTPServer] = None
 
     def bind(self, handler_cls, name: str) -> int:
@@ -51,10 +106,9 @@ class BackgroundHTTPServer:
         return self.serve(name)
 
     def bind_only(self, handler_cls) -> ThreadingHTTPServer:
-        """Bind without serving (callers that wrap the socket — TLS — do it
-        between bind and serve)."""
-        self._httpd = ThreadingHTTPServer((self._host, self._port), handler_cls)
-        self._httpd.daemon_threads = True
+        self._httpd = make_http_server(
+            self._host, self._port, handler_cls, self._ssl_context
+        )
         return self._httpd
 
     def serve(self, name: str) -> int:
@@ -71,6 +125,10 @@ class BackgroundHTTPServer:
     @property
     def host(self) -> str:
         return self._host
+
+    @property
+    def scheme(self) -> str:
+        return "https" if self._ssl_context is not None else "http"
 
     def stop(self) -> None:
         if self._httpd is not None:

@@ -31,7 +31,14 @@ from typing import Optional
 from ..api import k8sjson
 from ..api.meta import ObjectMeta, new_uid
 from ..api.work import BindingStatus, ResourceBinding
-from .httpbase import BackgroundHTTPServer, QuietHandler, read_json, send_json
+from .httpbase import (
+    BackgroundHTTPServer,
+    QuietHandler,
+    bearer_auth_ok,
+    drain_body,
+    read_json,
+    send_json,
+)
 
 
 class SchedulerShim:
@@ -123,13 +130,18 @@ class SchedulerShim:
 
 
 class SchedulerShimServer:
-    """HTTP front-end over SchedulerShim (loopback by default; front with
-    the estimator seam's mTLS material for cross-host deployments)."""
+    """HTTP front-end over SchedulerShim. Loopback plaintext by default;
+    pass `ssl_context` (server/tlsmaterial.ensure_server_tls) and `token`
+    for cross-host deployments — same transport contract as the
+    control-plane apiserver (GET /healthz stays unauthenticated)."""
 
     def __init__(self, shim: Optional[SchedulerShim] = None,
-                 host: str = "127.0.0.1", port: int = 0):
+                 host: str = "127.0.0.1", port: int = 0,
+                 ssl_context=None, token: Optional[str] = None):
         self.shim = shim or SchedulerShim()
-        self._server = BackgroundHTTPServer(host, port)
+        self._token = token
+        self._server = BackgroundHTTPServer(host, port,
+                                            ssl_context=ssl_context)
 
     def start(self) -> int:
         server = self
@@ -138,11 +150,17 @@ class SchedulerShimServer:
             def do_GET(self):
                 if self.path == "/healthz":
                     send_json(self, 200, {"ok": True})
+                elif not bearer_auth_ok(self, server._token):
+                    send_json(self, 401, {"error": "unauthorized"})
                 else:
                     send_json(self, 404, {"error": f"no route {self.path}"})
 
             def do_POST(self):
                 try:
+                    if not bearer_auth_ok(self, server._token):
+                        drain_body(self)
+                        send_json(self, 401, {"error": "unauthorized"})
+                        return
                     body = read_json(self)
                     if self.path == "/v1/clusters":
                         n = server.shim.sync_clusters(body.get("items") or [])
@@ -168,7 +186,7 @@ class SchedulerShimServer:
 
     @property
     def url(self) -> str:
-        return f"http://{self._server.host}:{self._server.port}"
+        return f"{self._server.scheme}://{self._server.host}:{self._server.port}"
 
     def stop(self) -> None:
         self._server.stop()

@@ -302,6 +302,73 @@ class TestWireParityFuzz:
 
 
 class TestShimOverHttp:
+    def test_tls_and_token(self, tmp_path):
+        """Cross-host deployment shape: HTTPS from cluster-CA material and
+        bearer auth, same contract as the control-plane apiserver."""
+        import ssl
+        import urllib.error
+
+        from karmada_tpu.server.tlsmaterial import ensure_server_tls, ensure_token
+
+        ctx = ensure_server_tls(str(tmp_path / "tls"), "127.0.0.1")
+        token = ensure_token(str(tmp_path / "token"))
+        srv = SchedulerShimServer(ssl_context=ctx, token=token)
+        port = srv.start()
+        assert srv.url.startswith("https://")
+        client_ctx = ssl.create_default_context(
+            cafile=str(tmp_path / "tls" / "ca.pem")
+        )
+
+        def post(path, body, tok):
+            req = urllib.request.Request(
+                f"{srv.url}{path}", data=json.dumps(body).encode(),
+                headers={"Content-Type": "application/json",
+                         **({"Authorization": f"Bearer {tok}"} if tok else {})},
+            )
+            with urllib.request.urlopen(req, timeout=30,
+                                        context=client_ctx) as r:
+                return json.loads(r.read().decode())
+
+        try:
+            out = post("/v1/clusters", {"items": [cluster_json("m1")]}, token)
+            assert out == {"count": 1}
+            with pytest.raises(urllib.error.HTTPError) as e:
+                post("/v1/clusters", {"items": []}, "wrong")
+            assert e.value.code == 401
+            # healthz probe-able without credentials
+            req = urllib.request.Request(f"{srv.url}/healthz")
+            with urllib.request.urlopen(req, timeout=30,
+                                        context=client_ctx) as r:
+                assert json.loads(r.read().decode()) == {"ok": True}
+
+            # keep-alive discipline: a 401 with an unread body must not
+            # desync the connection for the next (authenticated) request
+            import http.client
+
+            conn = http.client.HTTPSConnection(
+                "127.0.0.1", port, timeout=30, context=client_ctx
+            )
+            try:
+                body = json.dumps({"items": [cluster_json("m2")]})
+                conn.request("POST", "/v1/clusters", body=body, headers={
+                    "Content-Type": "application/json",
+                    "Authorization": "Bearer wrong",
+                })
+                resp = conn.getresponse()
+                assert resp.status == 401
+                resp.read()
+                conn.request("POST", "/v1/clusters", body=body, headers={
+                    "Content-Type": "application/json",
+                    "Authorization": f"Bearer {token}",
+                })
+                resp = conn.getresponse()
+                assert resp.status == 200
+                assert json.loads(resp.read().decode()) == {"count": 1}
+            finally:
+                conn.close()
+        finally:
+            srv.stop()
+
     def test_wire_roundtrip(self):
         srv = SchedulerShimServer()
         port = srv.start()
