@@ -26,6 +26,29 @@ from karmada_tpu.testing.fixtures import (
 GiB = 1024.0**3
 
 
+def start_daemon(data_dir: str):
+    """Launch the daemon subprocess and scrape its serving URL; raises with
+    a diagnostic if the process dies before printing one."""
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "karmada_tpu.server",
+         "--members", "1", "--tick-interval", "0.5",
+         "--platform", "cpu", "--data-dir", data_dir],
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+    )
+    lines = []
+    while True:
+        line = proc.stdout.readline()
+        if not line and proc.poll() is not None:
+            raise AssertionError(
+                f"daemon exited rc={proc.returncode} before serving:\n"
+                + "".join(lines[-10:])
+            )
+        lines.append(line)
+        m = re.search(r"http://[\d.]+:\d+", line)
+        if m:
+            return proc, m.group(0)
+
+
 def plane_with_members(n=2):
     cp = ControlPlane()
     for i in range(1, n + 1):
@@ -129,21 +152,7 @@ class TestDaemonPersistence:
 
         data = str(tmp_path / "state")
 
-        def start():
-            proc = subprocess.Popen(
-                [sys.executable, "-m", "karmada_tpu.server",
-                 "--members", "1", "--tick-interval", "0.5",
-                 "--platform", "cpu", "--data-dir", data],
-                stdout=subprocess.PIPE, stderr=subprocess.DEVNULL, text=True,
-            )
-            line = proc.stdout.readline()
-            m = re.search(r"http://[\d.]+:\d+", line)
-            while m is None:  # restore line precedes the URL line
-                line = proc.stdout.readline()
-                m = re.search(r"http://[\d.]+:\d+", line)
-            return proc, m.group(0)
-
-        proc, url = start()
+        proc, url = start_daemon(data)
         try:
             rcp = RemoteControlPlane(url)
             rcp.store.create(new_deployment("default", "durable", replicas=2))
@@ -152,11 +161,39 @@ class TestDaemonPersistence:
             proc.send_signal(signal.SIGINT)
             proc.wait(timeout=30)
 
-        proc, url = start()
+        proc, url = start_daemon(data)
         try:
             rcp = RemoteControlPlane(url)
             got = rcp.store.get("apps/v1/Deployment", "durable", "default")
             assert got.get("spec", "replicas") == 2
+        finally:
+            proc.send_signal(signal.SIGINT)
+            proc.wait(timeout=30)
+
+    def test_daemon_sigkill_recovers_from_wal(self, tmp_path):
+        """SIGKILL the daemon (no shutdown snapshot runs) and restart: the
+        per-event WAL flush alone must bring every committed write back."""
+        from karmada_tpu.server.remote import RemoteControlPlane
+
+        data = str(tmp_path / "state")
+
+        proc, url = start_daemon(data)
+        try:
+            rcp = RemoteControlPlane(url)
+            for i in range(5):
+                rcp.store.create(
+                    new_deployment("default", f"crash-{i}", replicas=i + 1)
+                )
+        finally:
+            proc.kill()  # SIGKILL: no snapshot, no WAL close
+            proc.wait(timeout=30)
+
+        proc, url = start_daemon(data)
+        try:
+            rcp = RemoteControlPlane(url)
+            for i in range(5):
+                got = rcp.store.get("apps/v1/Deployment", f"crash-{i}", "default")
+                assert got.get("spec", "replicas") == i + 1
         finally:
             proc.send_signal(signal.SIGINT)
             proc.wait(timeout=30)
