@@ -1,0 +1,43 @@
+"""Spawn the serving daemon as a subprocess and scrape its URL — shared by
+the process-boundary tests (persistence restarts, TLS e2e, CLI drives)."""
+from __future__ import annotations
+
+import re
+import subprocess
+import sys
+import time
+
+
+def spawn_daemon(*extra_args: str, scheme: str = "http",
+                 timeout: float = 60.0):
+    """Start `python -m karmada_tpu.server --platform cpu <extra_args>` and
+    return (proc, url) once the serving line appears. Raises with the
+    captured output if the process dies (or goes silent) without serving."""
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "karmada_tpu.server", "--platform", "cpu",
+         *extra_args],
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+    )
+    pattern = re.compile(rf"{scheme}://[\d.]+:\d+")
+    lines: list[str] = []
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        line = proc.stdout.readline()
+        if not line:
+            if proc.poll() is not None:
+                raise AssertionError(
+                    f"daemon exited rc={proc.returncode} before serving:\n"
+                    + "".join(lines[-10:])
+                )
+            # stdout EOF while alive (stream redirected/closed): don't
+            # busy-spin; poll until exit or deadline
+            time.sleep(0.1)
+            continue
+        lines.append(line)
+        m = pattern.search(line)
+        if m:
+            return proc, m.group(0)
+    proc.kill()
+    raise AssertionError(
+        "daemon never printed its serving URL:\n" + "".join(lines[-10:])
+    )

@@ -279,21 +279,10 @@ class TestDaemonProcess:
     def test_daemon_subprocess_serves_cli(self, tmp_path):
         """The real boundary: a separate OS process runs the daemon; the
         CLI main() talks to it over the socket."""
-        import re
-        import subprocess
-        import sys
+        from karmada_tpu.testing.daemon import spawn_daemon
 
-        proc = subprocess.Popen(
-            [sys.executable, "-m", "karmada_tpu.server",
-             "--members", "2", "--tick-interval", "0.5", "--platform", "cpu"],
-            stdout=subprocess.PIPE, stderr=subprocess.DEVNULL, text=True,
-        )
+        proc, url = spawn_daemon("--members", "2", "--tick-interval", "0.5")
         try:
-            line = proc.stdout.readline()
-            m = re.search(r"http://[\d.]+:(\d+)", line)
-            assert m, f"no URL line: {line!r}"
-            url = m.group(0)
-
             from karmada_tpu.cli.karmadactl import run
 
             rcp = RemoteControlPlane(url)
@@ -491,27 +480,16 @@ class TestTLSAndAuth:
     def test_daemon_subprocess_tls_token_cli(self, tmp_path):
         """Process-boundary e2e: daemon with --tls-dir/--token-file, CLI
         with --server https + --token + --cacert."""
-        import re
-        import subprocess
-        import sys
+        from karmada_tpu.testing.daemon import spawn_daemon
 
         tls_dir = str(tmp_path / "tls")
         token_file = str(tmp_path / "token")
-        proc = subprocess.Popen(
-            [sys.executable, "-m", "karmada_tpu.server",
-             "--members", "1", "--tick-interval", "0.5", "--platform", "cpu",
-             "--tls-dir", tls_dir, "--token-file", token_file],
-            stdout=subprocess.PIPE, stderr=subprocess.DEVNULL, text=True,
+        proc, url = spawn_daemon(
+            "--members", "1", "--tick-interval", "0.5",
+            "--tls-dir", tls_dir, "--token-file", token_file,
+            scheme="https",
         )
         try:
-            url = None
-            for _ in range(10):
-                line = proc.stdout.readline()
-                m = re.search(r"https://[\d.]+:\d+", line)
-                if m:
-                    url = m.group(0)
-                    break
-            assert url, "no https URL line"
             token = (tmp_path / "token").read_text().strip()
 
             from karmada_tpu.cli.karmadactl import main as cli_main

@@ -1,0 +1,21 @@
+"""The shipped examples must stay runnable — they are the acceptance
+scripts a migrating user tries first."""
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_full_walkthrough_runs_clean():
+    r = subprocess.run(
+        [sys.executable, os.path.join(REPO, "examples", "full_walkthrough.py")],
+        capture_output=True, text=True, timeout=300, cwd=REPO,
+    )
+    assert r.returncode == 0, r.stdout[-2000:] + r.stderr[-2000:]
+    assert "WALKTHROUGH COMPLETE" in r.stdout
+    # every stage banner printed
+    for n in range(1, 9):
+        assert f"=== stage {n}:" in r.stdout, f"stage {n} missing"

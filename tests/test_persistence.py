@@ -4,10 +4,7 @@ from __future__ import annotations
 
 import json
 import os
-import re
 import signal
-import subprocess
-import sys
 import time
 
 import pytest
@@ -27,26 +24,10 @@ GiB = 1024.0**3
 
 
 def start_daemon(data_dir: str):
-    """Launch the daemon subprocess and scrape its serving URL; raises with
-    a diagnostic if the process dies before printing one."""
-    proc = subprocess.Popen(
-        [sys.executable, "-m", "karmada_tpu.server",
-         "--members", "1", "--tick-interval", "0.5",
-         "--platform", "cpu", "--data-dir", data_dir],
-        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
-    )
-    lines = []
-    while True:
-        line = proc.stdout.readline()
-        if not line and proc.poll() is not None:
-            raise AssertionError(
-                f"daemon exited rc={proc.returncode} before serving:\n"
-                + "".join(lines[-10:])
-            )
-        lines.append(line)
-        m = re.search(r"http://[\d.]+:\d+", line)
-        if m:
-            return proc, m.group(0)
+    from karmada_tpu.testing.daemon import spawn_daemon
+
+    return spawn_daemon("--members", "1", "--tick-interval", "0.5",
+                        "--data-dir", data_dir)
 
 
 def plane_with_members(n=2):
