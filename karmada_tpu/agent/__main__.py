@@ -1,0 +1,75 @@
+"""Pull-agent daemon: `python -m karmada_tpu.agent --server URL --cluster N`.
+
+The reference's cmd/agent binary (agent.go:73,135): a process running in
+the member's trust domain that registers its Cluster with the control
+plane, receives Works over the watch stream, applies them to its member,
+reflects status, and heartbeats its lease. Here the member is the
+in-memory simulator (the framework's member-cluster substrate); everything
+crosses the real network boundary via RemoteStore.
+"""
+from __future__ import annotations
+
+import argparse
+import signal
+import sys
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser(prog="python -m karmada_tpu.agent")
+    ap.add_argument("--server", required=True,
+                    help="control-plane URL (http:// or https://)")
+    ap.add_argument("--cluster", required=True, help="member cluster name")
+    ap.add_argument("--region", default="")
+    ap.add_argument("--zone", default="")
+    ap.add_argument("--provider", default="")
+    ap.add_argument("--cpu", type=float, default=100.0,
+                    help="allocatable CPU cores")
+    ap.add_argument("--memory-gib", type=float, default=400.0)
+    ap.add_argument("--pods", type=float, default=1000.0)
+    ap.add_argument("--interval", type=float, default=1.0,
+                    help="seconds between settle+heartbeat steps")
+    ap.add_argument("--bearer-token", default="",
+                    help="daemon --token-file credential (KARMADA_TOKEN)")
+    ap.add_argument("--cacert", default="",
+                    help="daemon --tls-dir ca.pem (KARMADA_CACERT)")
+    args = ap.parse_args()
+
+    # host-plane process: never let an ambient TPU backend init block startup
+    from ..testing.cpumesh import force_cpu_mesh
+
+    force_cpu_mesh(1)
+
+    import os
+
+    from ..api.meta import CPU, MEMORY
+    from ..members.member import MemberConfig
+    from .remote_agent import RemoteAgentSession
+
+    GiB = 1024.0**3
+    session = RemoteAgentSession(
+        args.server,
+        MemberConfig(
+            name=args.cluster, sync_mode="Pull", region=args.region,
+            zone=args.zone, provider=args.provider,
+            allocatable={CPU: args.cpu, MEMORY: args.memory_gib * GiB,
+                         "pods": args.pods},
+        ),
+        token=args.bearer_token or os.environ.get("KARMADA_TOKEN") or None,
+        cafile=args.cacert or os.environ.get("KARMADA_CACERT") or None,
+    )
+    session.register()
+    session.run(interval=args.interval)
+    print(f"agent {args.cluster} registered with {args.server}", flush=True)
+
+    stop = []
+    signal.signal(signal.SIGTERM, lambda *a: stop.append(1))
+    try:
+        while not stop:
+            signal.pause()
+    except KeyboardInterrupt:
+        pass
+    session.close()
+
+
+if __name__ == "__main__":
+    sys.exit(main())

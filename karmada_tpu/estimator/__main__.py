@@ -1,0 +1,119 @@
+"""Scheduler-estimator daemon: `python -m karmada_tpu.estimator ...`.
+
+The reference's cmd/scheduler-estimator binary: a gRPC server a stock
+karmada-scheduler's --enable-scheduler-estimator fan-out calls on the
+reference's own method paths (estimator/service.py). One process serves
+one or more member clusters' estimators.
+
+Node inventory per cluster comes from either a JSON file (an out-of-band
+exporter's dump: [{"name", "labels", "allocatable": {"cpu": ..}, ...}])
+or a synthetic fleet (--nodes) for benches/demos. mTLS flags mirror the
+reference's grpcconnection ServerConfig.
+
+Example:
+    python -m karmada_tpu.estimator --cluster m1 --nodes 500 --port 10352
+    python -m karmada_tpu.estimator --cluster m1=nodes-m1.json --cluster m2=nodes-m2.json
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+
+def _nodes_from_file(path: str):
+    from ..models.nodes import NodeSpec
+
+    with open(path) as f:
+        docs = json.load(f)
+    if not isinstance(docs, list):
+        raise SystemExit(f"{path}: expected a JSON list of node objects")
+    return [
+        NodeSpec(
+            name=d.get("name", f"node-{i}"),
+            labels=dict(d.get("labels") or {}),
+            allocatable={k: float(v) for k, v in
+                         (d.get("allocatable") or {}).items()},
+            allowed_pods=int(d.get("allowedPods", 110)),
+        )
+        for i, d in enumerate(docs)
+    ]
+
+
+def _synthetic_nodes(n: int):
+    from ..models.nodes import NodeSpec
+
+    GiB = 1024.0**3
+    return [
+        NodeSpec(
+            name=f"node-{i}",
+            allocatable={"cpu": 16.0, "memory": 64 * GiB,
+                         "ephemeral-storage": 500 * GiB},
+            allowed_pods=110,
+        )
+        for i in range(n)
+    ]
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser(prog="python -m karmada_tpu.estimator")
+    ap.add_argument("--cluster", action="append", required=True,
+                    metavar="NAME[=NODES.json]",
+                    help="serve this member cluster; repeatable. With "
+                         "=FILE, nodes load from the JSON dump; otherwise "
+                         "--nodes synthetic nodes are used")
+    ap.add_argument("--nodes", type=int, default=100,
+                    help="synthetic node count for clusters without a file")
+    ap.add_argument("--port", type=int, default=0,
+                    help="gRPC port (0 = ephemeral, printed on stdout)")
+    ap.add_argument("--cert-file", default="")
+    ap.add_argument("--key-file", default="")
+    ap.add_argument("--client-ca-file", default="",
+                    help="require client certs signed by this CA (mTLS)")
+    ap.add_argument("--platform", default="cpu",
+                    help="jax platform for the estimate kernels; 'cpu' "
+                         "(default) never touches an ambient TPU tunnel")
+    args = ap.parse_args()
+
+    if args.platform == "cpu":
+        from ..testing.cpumesh import force_cpu_mesh
+
+        force_cpu_mesh(1)
+    elif args.platform:
+        import jax
+
+        jax.config.update("jax_platforms", args.platform)
+
+    from .accurate import AccurateEstimator
+    from .grpcconnection import ServerConfig
+    from .service import EstimatorServer
+
+    estimators = {}
+    for spec in args.cluster:
+        name, sep, path = spec.partition("=")
+        nodes = _nodes_from_file(path) if sep else _synthetic_nodes(args.nodes)
+        estimators[name] = AccurateEstimator(nodes)
+        print(f"cluster {name}: {len(nodes)} nodes", flush=True)
+
+    config = None
+    if args.cert_file or args.key_file:
+        config = ServerConfig(
+            cert_file=args.cert_file, key_file=args.key_file,
+            client_auth_ca_file=args.client_ca_file,
+        )
+    srv = EstimatorServer(estimators, port=args.port, server_config=config)
+    port = srv.start()
+    print(f"karmada-tpu scheduler-estimator serving on :{port} "
+          f"({'mTLS' if args.client_ca_file else 'TLS' if config else 'insecure'})",
+          flush=True)
+    try:
+        import time
+
+        while True:
+            time.sleep(3600)
+    except KeyboardInterrupt:
+        srv.stop()
+
+
+if __name__ == "__main__":
+    sys.exit(main())

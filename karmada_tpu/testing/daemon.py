@@ -1,43 +1,76 @@
-"""Spawn the serving daemon as a subprocess and scrape its URL — shared by
-the process-boundary tests (persistence restarts, TLS e2e, CLI drives)."""
+"""Spawn framework daemons as subprocesses and scrape their startup lines —
+shared by the process-boundary tests (persistence restarts, TLS e2e, CLI
+drives, agent/estimator daemons)."""
 from __future__ import annotations
 
+import queue
 import re
 import subprocess
 import sys
+import threading
 import time
+
+
+def spawn_process(argv: list[str], pattern: str, timeout: float = 60.0,
+                  label: str = "daemon"):
+    """Start argv and read its merged stdout/stderr until `pattern` matches
+    a line; returns (proc, match). The deadline is enforced even while no
+    output arrives (reader thread + polling get), and process death or
+    stdout EOF raises with the captured tail instead of hanging."""
+    proc = subprocess.Popen(
+        argv, stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+    )
+    rx = re.compile(pattern)
+    q: queue.Queue = queue.Queue()
+
+    def reader() -> None:
+        for line in proc.stdout:
+            q.put(line)
+        q.put(None)
+
+    threading.Thread(target=reader, daemon=True,
+                     name=f"spawn-reader-{label}").start()
+    lines: list[str] = []
+
+    def fail(reason: str) -> AssertionError:
+        proc.kill()
+        return AssertionError(
+            f"{label} {reason} (waiting for {pattern!r}):\n"
+            + "".join(lines[-10:])
+        )
+
+    deadline = time.monotonic() + timeout
+    eof = False
+    while True:
+        remaining = deadline - time.monotonic()
+        if remaining <= 0:
+            raise fail(f"never matched within {timeout}s")
+        try:
+            line = q.get(timeout=min(remaining, 0.5))
+        except queue.Empty:
+            if proc.poll() is not None:
+                raise fail(f"exited rc={proc.returncode}")
+            continue
+        if line is None:
+            eof = True
+            if proc.poll() is not None:
+                raise fail(f"exited rc={proc.returncode}")
+            continue  # EOF while alive: poll until exit or deadline
+        if eof:
+            continue
+        lines.append(line)
+        m = rx.search(line)
+        if m:
+            return proc, m
 
 
 def spawn_daemon(*extra_args: str, scheme: str = "http",
                  timeout: float = 60.0):
     """Start `python -m karmada_tpu.server --platform cpu <extra_args>` and
-    return (proc, url) once the serving line appears. Raises with the
-    captured output if the process dies (or goes silent) without serving."""
-    proc = subprocess.Popen(
+    return (proc, url) once the serving line appears."""
+    proc, m = spawn_process(
         [sys.executable, "-m", "karmada_tpu.server", "--platform", "cpu",
          *extra_args],
-        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+        rf"{scheme}://[\d.]+:\d+", timeout=timeout, label="control-plane",
     )
-    pattern = re.compile(rf"{scheme}://[\d.]+:\d+")
-    lines: list[str] = []
-    deadline = time.monotonic() + timeout
-    while time.monotonic() < deadline:
-        line = proc.stdout.readline()
-        if not line:
-            if proc.poll() is not None:
-                raise AssertionError(
-                    f"daemon exited rc={proc.returncode} before serving:\n"
-                    + "".join(lines[-10:])
-                )
-            # stdout EOF while alive (stream redirected/closed): don't
-            # busy-spin; poll until exit or deadline
-            time.sleep(0.1)
-            continue
-        lines.append(line)
-        m = pattern.search(line)
-        if m:
-            return proc, m.group(0)
-    proc.kill()
-    raise AssertionError(
-        "daemon never printed its serving URL:\n" + "".join(lines[-10:])
-    )
+    return proc, m.group(0)
