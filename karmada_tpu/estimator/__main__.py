@@ -96,15 +96,22 @@ def main() -> None:
         print(f"cluster {name}: {len(nodes)} nodes", flush=True)
 
     config = None
-    if args.cert_file or args.key_file:
+    if args.cert_file or args.key_file or args.client_ca_file:
+        if not (args.cert_file and args.key_file):
+            raise SystemExit(
+                "TLS needs BOTH --cert-file and --key-file "
+                "(--client-ca-file additionally enables mTLS)"
+            )
         config = ServerConfig(
             cert_file=args.cert_file, key_file=args.key_file,
             client_auth_ca_file=args.client_ca_file,
         )
     srv = EstimatorServer(estimators, port=args.port, server_config=config)
     port = srv.start()
-    print(f"karmada-tpu scheduler-estimator serving on :{port} "
-          f"({'mTLS' if args.client_ca_file else 'TLS' if config else 'insecure'})",
+    mode = "insecure"
+    if config is not None and config.secure:
+        mode = "mTLS" if config.client_auth_ca_file else "TLS"
+    print(f"karmada-tpu scheduler-estimator serving on :{port} ({mode})",
           flush=True)
     try:
         import time

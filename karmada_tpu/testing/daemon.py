@@ -39,24 +39,44 @@ def spawn_process(argv: list[str], pattern: str, timeout: float = 60.0,
             + "".join(lines[-10:])
         )
 
+    def drain() -> "re.Match | None":
+        """Move everything already buffered into `lines`, scanning for the
+        pattern — the child may have printed the match (or its dying
+        traceback) moments before exit was observed."""
+        while True:
+            try:
+                line = q.get(timeout=0.5)
+            except queue.Empty:
+                return None
+            if line is None:
+                return None
+            lines.append(line)
+            m = rx.search(line)
+            if m:
+                return m
+
     deadline = time.monotonic() + timeout
-    eof = False
     while True:
         remaining = deadline - time.monotonic()
         if remaining <= 0:
+            m = drain()
+            if m:
+                return proc, m
             raise fail(f"never matched within {timeout}s")
         try:
             line = q.get(timeout=min(remaining, 0.5))
         except queue.Empty:
             if proc.poll() is not None:
+                m = drain()
+                if m:
+                    return proc, m
                 raise fail(f"exited rc={proc.returncode}")
             continue
         if line is None:
-            eof = True
+            # stdout EOF: collect the exit code (or keep waiting if the
+            # child closed its stream while alive)
             if proc.poll() is not None:
                 raise fail(f"exited rc={proc.returncode}")
-            continue  # EOF while alive: poll until exit or deadline
-        if eof:
             continue
         lines.append(line)
         m = rx.search(line)

@@ -63,11 +63,13 @@ class TestAgentDaemon:
             assert wait_until(applied, timeout=45.0), \
                 "agent never reflected status into the Work"
         finally:
-            if agent_proc is not None:
-                agent_proc.terminate()
-                agent_proc.wait(timeout=15)
-            cp_proc.terminate()
-            cp_proc.wait(timeout=15)
+            try:
+                if agent_proc is not None:
+                    agent_proc.terminate()
+                    agent_proc.wait(timeout=15)
+            finally:
+                cp_proc.terminate()
+                cp_proc.wait(timeout=15)
 
 
 class TestEstimatorDaemon:
