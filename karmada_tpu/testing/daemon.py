@@ -3,12 +3,40 @@ shared by the process-boundary tests (persistence restarts, TLS e2e, CLI
 drives, agent/estimator daemons)."""
 from __future__ import annotations
 
+import contextlib
 import queue
 import re
 import subprocess
 import sys
 import threading
 import time
+
+
+@contextlib.contextmanager
+def reaping(*procs):
+    """Terminate-and-wait registered processes on exit (last spawned first),
+    escalating to kill on a stuck wait; every process is reaped even if an
+    earlier teardown raises. Yields a register function for processes
+    spawned inside the block."""
+    bag = list(procs)
+    try:
+        yield bag.append
+    finally:
+        errors = []
+        for proc in reversed(bag):
+            if proc is None:
+                continue
+            try:
+                proc.terminate()
+                try:
+                    proc.wait(timeout=15)
+                except subprocess.TimeoutExpired:
+                    proc.kill()
+                    proc.wait(timeout=15)
+            except Exception as e:  # noqa: BLE001 - reap the rest first
+                errors.append(e)
+        if errors:
+            raise errors[0]
 
 
 def spawn_process(argv: list[str], pattern: str, timeout: float = 60.0,

@@ -11,7 +11,7 @@ import pytest
 from karmada_tpu.api.meta import CPU
 from karmada_tpu.api.work import ReplicaRequirements
 from karmada_tpu.server.remote import RemoteControlPlane
-from karmada_tpu.testing.daemon import spawn_daemon, spawn_process
+from karmada_tpu.testing.daemon import reaping, spawn_daemon, spawn_process
 from karmada_tpu.testing.fixtures import (
     duplicated_placement,
     new_deployment,
@@ -36,14 +36,14 @@ class TestAgentDaemon:
         applies it to its member, and reflects status back — observable
         centrally through work.status (agent.go:248-433)."""
         cp_proc, url = spawn_daemon("--members", "0", "--tick-interval", "0.5")
-        agent_proc = None
-        try:
+        with reaping(cp_proc) as reap:
             agent_proc, _ = spawn_process(
                 [sys.executable, "-m", "karmada_tpu.agent",
                  "--server", url, "--cluster", "edge-d",
                  "--region", "edge", "--interval", "0.2"],
                 r"registered", label="agent",
             )
+            reap(agent_proc)
 
             rcp = RemoteControlPlane(url)
             assert wait_until(
@@ -62,14 +62,35 @@ class TestAgentDaemon:
 
             assert wait_until(applied, timeout=45.0), \
                 "agent never reflected status into the Work"
-        finally:
-            try:
-                if agent_proc is not None:
-                    agent_proc.terminate()
-                    agent_proc.wait(timeout=15)
-            finally:
-                cp_proc.terminate()
-                cp_proc.wait(timeout=15)
+
+
+class TestSecuredAgentDaemon:
+    def test_two_process_topology_tls(self, tmp_path):
+        """The same topology with the transport secured end to end:
+        HTTPS + bearer token on both the CLI-shaped flags the agent
+        daemon exposes."""
+        tls_dir = str(tmp_path / "tls")
+        cp_proc, url = spawn_daemon(
+            "--members", "0", "--tick-interval", "0.5",
+            "--tls-dir", tls_dir, "--token-file", str(tmp_path / "token"),
+            scheme="https",
+        )
+        with reaping(cp_proc) as reap:
+            token = (tmp_path / "token").read_text().strip()
+            agent_proc, _ = spawn_process(
+                [sys.executable, "-m", "karmada_tpu.agent",
+                 "--server", url, "--cluster", "edge-s",
+                 "--interval", "0.2", "--bearer-token", token,
+                 "--cacert", f"{tls_dir}/ca.pem"],
+                r"registered", label="agent-tls",
+            )
+            reap(agent_proc)
+            rcp = RemoteControlPlane(url, token=token,
+                                     cafile=f"{tls_dir}/ca.pem")
+            assert wait_until(
+                lambda: rcp.store.try_get("Cluster", "edge-s") is not None
+            )
+            assert rcp.store.get("Cluster", "edge-s").spec.sync_mode == "Pull"
 
 
 class TestEstimatorDaemon:
