@@ -393,6 +393,53 @@ def _fmt_table(rows: list[list[str]], headers: list[str]) -> str:
     )
 
 
+def cmd_watch(cp: ControlPlane, kind: str, name: str = "", namespace: str = "",
+              seconds: float = 0.0, sink=None) -> str:
+    """`karmadactl get -w`: list+watch the kind, streaming one line per
+    event (the reference's get inherits kubectl's watch machinery). Works
+    identically in-process and against a daemon (`--server`): both store
+    surfaces expose the same watch bus. Stops after `seconds` (0 = until
+    interrupted); `sink` overrides the print target for tests."""
+    import queue as queue_mod
+    import time
+
+    resolved = _resolve_kind(kind)
+    emit = sink or (lambda line: print(line, flush=True))
+    q: queue_mod.Queue = queue_mod.Queue()
+
+    def handler(event: str, obj) -> None:
+        q.put((event, obj))
+
+    cp.store.watch(resolved, handler, replay=True, namespace=namespace)
+    deadline = time.monotonic() + seconds if seconds > 0 else None
+    count = 0
+    try:
+        while True:
+            remaining = (None if deadline is None
+                         else deadline - time.monotonic())
+            if remaining is not None and remaining <= 0:
+                break
+            try:
+                event, obj = q.get(
+                    timeout=0.25 if remaining is None else min(remaining, 0.25)
+                )
+            except queue_mod.Empty:
+                continue
+            meta = obj.metadata
+            if name and meta.name != name:
+                continue
+            ns = meta.namespace or ""
+            emit(f"{event}\t{ns}\t{meta.name}")
+            count += 1
+    except KeyboardInterrupt:
+        pass
+    finally:
+        unwatch = getattr(cp.store, "unwatch", None)
+        if unwatch is not None:
+            unwatch(resolved, handler)
+    return f"watched {count} event(s)"
+
+
 def cmd_get(cp: ControlPlane, kind: str, name: str = "", namespace: str = "",
             cluster: str = "", output: str = "") -> str:
     """Multi-cluster aware get: with --cluster, reads the member's object via
@@ -1041,6 +1088,10 @@ def run(cp: ControlPlane, argv: list[str]) -> str:
     p.add_argument("-n", "--namespace", default="")
     p.add_argument("--cluster", default="")
     p.add_argument("-o", "--output", default="")
+    p.add_argument("-w", "--watch", action="store_true",
+                   help="after the initial list, stream events")
+    p.add_argument("--watch-seconds", type=float, default=0.0,
+                   help="stop watching after N seconds (0 = until ^C)")
     p = sub.add_parser("describe")
     p.add_argument("kind")
     p.add_argument("name")
@@ -1137,6 +1188,17 @@ def run(cp: ControlPlane, argv: list[str]) -> str:
     if args.command == "taint":
         return cmd_taint(cp, args.name, args.spec)
     if args.command == "get":
+        if args.watch:
+            if args.cluster:
+                raise CLIError("--watch streams control-plane objects; "
+                               "member views go through the search proxy")
+            if args.output:
+                raise CLIError("--watch emits event lines; -o is not "
+                               "supported with it")
+            return cmd_watch(cp, args.kind, args.name, args.namespace,
+                             seconds=args.watch_seconds)
+        if args.watch_seconds:
+            raise CLIError("--watch-seconds requires --watch")
         return cmd_get(cp, args.kind, args.name, args.namespace, args.cluster,
                        output=args.output)
     if args.command == "describe":

@@ -49,6 +49,7 @@ class RemoteStore:
             # honest, not bypassed
             self._ssl_ctx = ssl.create_default_context(cafile=cafile)
         self._watch_threads: list[threading.Thread] = []
+        self._streams: list[tuple[str, Any, threading.Event]] = []
         self._closed = False
 
     # -- transport --------------------------------------------------------
@@ -131,19 +132,33 @@ class RemoteStore:
               replay: bool = True, namespace: str = "") -> None:
         self._start_stream(
             kind, replay, lambda k, ev, obj: handler(ev, obj),
-            namespace=namespace,
+            namespace=namespace, handler_key=handler,
         )
 
     def watch_all(self, handler: Callable[[str, str, Any], None], *,
                   replay: bool = True, namespace: str = "") -> None:
-        self._start_stream("*", replay, handler, namespace=namespace)
+        self._start_stream("*", replay, handler, namespace=namespace,
+                           handler_key=handler)
+
+    def unwatch(self, kind: str, handler: Callable) -> None:
+        """Stop the stream(s) registered for (kind, handler) — the Store
+        surface's unwatch, so bounded consumers (get -w) don't leak
+        reconnect threads against the daemon."""
+        for k, h, stop in self._streams:
+            if k == kind and h == handler:
+                stop.set()
 
     def _start_stream(self, kind: str, replay: bool,
                       deliver: Callable[[str, str, Any], None],
-                      namespace: str = "") -> None:
+                      namespace: str = "", handler_key: Any = None) -> None:
         import http.client
 
         url = urlparse(self.base_url)
+        stop = threading.Event()
+        self._streams.append((kind, handler_key, stop))
+
+        def done() -> bool:
+            return self._closed or stop.is_set()
 
         def attach(with_replay: bool) -> None:
             path = (f"/watch?kind={quote(kind, safe='')}"
@@ -168,7 +183,7 @@ class RemoteStore:
                 if resp.status != 200:
                     return
                 buf = b""
-                while not self._closed:
+                while not done():
                     chunk = resp.read1(65536)
                     if not chunk:
                         return  # server closed (shutdown or overflow)
@@ -189,16 +204,14 @@ class RemoteStore:
             # close) re-attaches WITH replay — the relist/resync that makes
             # level-triggered consumers converge despite missed deltas
             first = True
-            while not self._closed:
+            while not done():
                 try:
                     attach(replay if first else True)
                 except (OSError, json.JSONDecodeError):
                     pass
                 first = False
-                if not self._closed:
-                    import time as _time
-
-                    _time.sleep(0.5)
+                if not done():
+                    stop.wait(0.5)
 
         t = threading.Thread(target=run, name=f"watch-{kind}", daemon=True)
         t.start()

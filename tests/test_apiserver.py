@@ -329,6 +329,45 @@ class TestDaemonProcess:
             rs.close()
 
 
+class TestRemoteGetWatch:
+    def test_get_watch_over_the_socket(self, served_plane):
+        """`karmadactl get -w` against a daemon: the replayed list and the
+        live churn both arrive through the HTTP watch stream."""
+        import threading
+
+        from karmada_tpu.cli.karmadactl import cmd_watch
+
+        cp, srv = served_plane
+        rcp = RemoteControlPlane(srv.url)
+        cp.store.create(Unstructured({
+            "apiVersion": "v1", "kind": "ConfigMap",
+            "metadata": {"name": "pre", "namespace": "default"},
+            "data": {},
+        }))
+        lines: list[str] = []
+
+        def churn():
+            time.sleep(0.3)
+            cp.store.create(Unstructured({
+                "apiVersion": "v1", "kind": "ConfigMap",
+                "metadata": {"name": "live", "namespace": "default"},
+                "data": {},
+            }))
+
+        t = threading.Thread(target=churn)
+        t.start()
+        try:
+            cmd_watch(rcp, "v1/ConfigMap", seconds=1.5, sink=lines.append)
+            # bounded watch must stop its reconnect stream (no leaked
+            # re-attach loop hammering the daemon after return)
+            assert all(stop.is_set() for _, _, stop in rcp.store._streams)
+        finally:
+            t.join()
+            rcp.close()
+        assert any(ln.endswith("pre") for ln in lines), lines
+        assert any(ln.endswith("live") for ln in lines), lines
+
+
 class TestTLSAndAuth:
     """The secured serving boundary: HTTPS from the cluster CA's material
     plus bearer-token authn — the kube-apiserver transport shape of L1."""

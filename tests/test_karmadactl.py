@@ -145,6 +145,40 @@ class TestInterpretApplyPromote:
         assert "applied" in out
         assert cp.store.try_get("apps/v1/Deployment", "web", "default") is not None
 
+    def test_get_watch_streams_events(self, cp):
+        """get -w: replayed ADDED lines for existing objects, live events
+        for churn during the window."""
+        import threading
+
+        from karmada_tpu.cli.karmadactl import cmd_watch
+
+        run(cp, ["join", "m1"])
+        dep = new_deployment("default", "pre", replicas=1)
+        cp.store.create(dep)
+        lines: list[str] = []
+        watching = threading.Event()
+
+        def sink(line: str) -> None:
+            lines.append(line)
+            watching.set()  # first replayed line = subscription is live
+
+        def churn():
+            assert watching.wait(5.0)
+            cp.store.create(new_deployment("default", "live", replicas=1))
+            cp.store.delete("apps/v1/Deployment", "pre", "default")
+
+        t = threading.Thread(target=churn)
+        t.start()
+        out = cmd_watch(cp, "deployments", seconds=1.0, sink=sink)
+        t.join()
+        assert any(ln.startswith("ADDED") and ln.endswith("pre")
+                   for ln in lines), lines
+        assert any(ln.startswith("ADDED") and ln.endswith("live")
+                   for ln in lines), lines
+        assert any(ln.startswith("DELETED") and ln.endswith("pre")
+                   for ln in lines), lines
+        assert "event(s)" in out
+
     def test_apply_multidoc_yaml(self, cp, tmp_path):
         run(cp, ["join", "m1"])
         f = tmp_path / "bundle.yaml"
