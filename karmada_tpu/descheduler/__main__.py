@@ -1,0 +1,96 @@
+"""Descheduler daemon: `python -m karmada_tpu.descheduler --server URL ...`.
+
+The reference's cmd/descheduler binary (descheduler.go:141): a standalone
+process that, every --descheduling-interval, lists Divided+Dynamic
+bindings over the control-plane API, asks the per-cluster scheduler
+estimators for unschedulable counts over gRPC, and shrinks assignments so
+the scheduler re-places the freed replicas. Here the control-plane side
+rides RemoteStore and the estimator side the wire-compatible gRPC client.
+
+Example:
+    python -m karmada_tpu.descheduler --server http://127.0.0.1:7443 \\
+        --estimator m1=127.0.0.1:10352 --estimator m2=127.0.0.1:10353
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import time
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser(prog="python -m karmada_tpu.descheduler")
+    ap.add_argument("--server", required=True,
+                    help="control-plane URL (http:// or https://)")
+    ap.add_argument("--estimator", action="append", default=[],
+                    metavar="CLUSTER=HOST:PORT",
+                    help="scheduler-estimator address per member cluster; "
+                         "repeatable. Clusters without one fall back to the "
+                         "binding's aggregated ready counts alone")
+    ap.add_argument("--interval", type=float, default=120.0,
+                    help="seconds between sweeps (--descheduling-interval)")
+    ap.add_argument("--threshold", type=float, default=300.0,
+                    help="unschedulable-threshold seconds")
+    ap.add_argument("--once", action="store_true",
+                    help="run one sweep and exit (prints the update count)")
+    ap.add_argument("--bearer-token", default="")
+    ap.add_argument("--cacert", default="")
+    args = ap.parse_args()
+
+    # host-plane process: never let an ambient TPU backend init block startup
+    from ..testing.cpumesh import force_cpu_mesh
+
+    force_cpu_mesh(1)
+
+    from ..estimator.client import EstimatorRegistry
+    from ..server.remote import RemoteStore
+    from .descheduler import Descheduler
+
+    addresses = {}
+    for spec in args.estimator:
+        cluster, sep, addr = spec.partition("=")
+        if not sep:
+            raise SystemExit(f"--estimator {spec!r}: want CLUSTER=HOST:PORT")
+        addresses[cluster] = addr
+    registry = EstimatorRegistry()
+    if addresses:
+        from ..estimator.service import GrpcSchedulerEstimator
+
+        # ONE registry entry: the client fans out per cluster itself via
+        # address_for; registering it per cluster would multiply every
+        # sweep's RPC load K-fold (controlplane.py registers the same way)
+        registry.register_unschedulable_estimator(
+            "scheduler-estimator", GrpcSchedulerEstimator(addresses.get)
+        )
+
+    store = RemoteStore(
+        args.server,
+        token=args.bearer_token or os.environ.get("KARMADA_TOKEN") or None,
+        cafile=args.cacert or os.environ.get("KARMADA_CACERT") or None,
+    )
+    d = Descheduler(store, registry, interval=args.interval,
+                    unschedulable_threshold=args.threshold)
+    if args.once:
+        n = d.deschedule_once()
+        print(f"descheduled {n} binding(s)", flush=True)
+        return
+    print(f"karmada-tpu descheduler sweeping {args.server} "
+          f"every {args.interval:.0f}s", flush=True)
+    try:
+        while True:
+            try:
+                n = d.deschedule_once()
+                if n:
+                    print(f"descheduled {n} binding(s)", flush=True)
+            except Exception:  # noqa: BLE001 - survive transient plane errors
+                import logging
+
+                logging.getLogger(__name__).exception("descheduling sweep")
+            time.sleep(args.interval)
+    except KeyboardInterrupt:
+        pass
+
+
+if __name__ == "__main__":
+    sys.exit(main())

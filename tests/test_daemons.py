@@ -93,6 +93,51 @@ class TestSecuredAgentDaemon:
             assert rcp.store.get("Cluster", "edge-s").spec.sync_mode == "Pull"
 
 
+class TestDeschedulerDaemon:
+    def test_once_sweep_over_the_wire(self):
+        """cmd/descheduler shape: a standalone process lists bindings over
+        the control-plane API and fans out to the estimator daemon over
+        gRPC. With every member healthy the sweep updates nothing — the
+        assertion is the full wiring crossing both process boundaries."""
+        pytest.importorskip("grpc")
+        import subprocess
+
+        from tests.test_scheduler_core import dyn_placement
+
+        cp_proc, url = spawn_daemon("--members", "2", "--tick-interval", "0.5")
+        with reaping(cp_proc) as reap:
+            est_proc, m = spawn_process(
+                [sys.executable, "-m", "karmada_tpu.estimator",
+                 "--cluster", "member1", "--cluster", "member2",
+                 "--nodes", "5", "--port", "0"],
+                r"serving on :(\d+)", label="estimator",
+            )
+            reap(est_proc)
+            est_port = int(m.group(1))
+
+            rcp = RemoteControlPlane(url)
+            dep = new_deployment("default", "web", replicas=4, cpu=0.5)
+            rcp.store.create(dep)
+            rcp.store.create(new_policy(
+                "default", "pp", [selector_for(dep)], dyn_placement()
+            ))
+            rcp.settle()
+            assert wait_until(lambda: any(
+                rb.spec.clusters
+                for rb in rcp.store.list("ResourceBinding", "default")
+            ))
+
+            r = subprocess.run(
+                [sys.executable, "-m", "karmada_tpu.descheduler",
+                 "--server", url, "--once",
+                 "--estimator", f"member1=127.0.0.1:{est_port}",
+                 "--estimator", f"member2=127.0.0.1:{est_port}"],
+                capture_output=True, text=True, timeout=120,
+            )
+            assert r.returncode == 0, r.stdout + r.stderr
+            assert "descheduled 0 binding(s)" in r.stdout, r.stdout
+
+
 class TestEstimatorDaemon:
     def test_grpc_daemon_answers_stock_contract(self):
         pytest.importorskip("grpc")
