@@ -94,6 +94,10 @@ CONTROLLER_NAMES = (
     "federatedHorizontalPodAutoscaler", "cronFederatedHorizontalPodAutoscaler",
     "hpaScaleTargetMarker", "deploymentReplicasSyncer", "multiclusterservice",
     "remedy", "workloadRebalancer",
+    # not a controller-manager controller in the reference (its own binary),
+    # but gateable here so a plane can run scheduler-less with
+    # `python -m karmada_tpu.sched` attached out-of-process
+    "scheduler",
 )
 
 
@@ -179,13 +183,18 @@ class ControlPlane:
         self.detector = ResourceDetector(
             self.store, self.interpreter, self.runtime, gates=self.gates
         )
+        # the scheduler is the reference's own binary, NOT a
+        # controller-manager controller — an explicit --controllers list
+        # without it must still schedule. Only the explicit opt-out
+        # ("-scheduler") disables it, for planes that attach
+        # `python -m karmada_tpu.sched` out-of-process instead.
         self.scheduler = SchedulerDaemon(
             self.store,
             self.runtime,
             estimator_registry=self.estimator_registry,
             gates=self.gates,
             event_recorder=self.event_recorder,
-        )
+        ) if "-scheduler" not in self.controllers else None
         self.override_manager = OverrideManager(self.store)
         self.binding_controller = BindingController(
             self.store,

@@ -43,23 +43,15 @@ def main() -> None:
 
     force_cpu_mesh(1)
 
-    from ..estimator.client import EstimatorRegistry
+    from ..estimator.client import EstimatorRegistry, parse_estimator_flags
     from ..server.remote import RemoteStore
     from .descheduler import Descheduler
 
-    addresses = {}
-    for spec in args.estimator:
-        cluster, sep, addr = spec.partition("=")
-        if not sep:
-            raise SystemExit(f"--estimator {spec!r}: want CLUSTER=HOST:PORT")
-        addresses[cluster] = addr
+    addresses = parse_estimator_flags(args.estimator)
     registry = EstimatorRegistry()
     if addresses:
         from ..estimator.service import GrpcSchedulerEstimator
 
-        # ONE registry entry: the client fans out per cluster itself via
-        # address_for; registering it per cluster would multiply every
-        # sweep's RPC load K-fold (controlplane.py registers the same way)
         registry.register_unschedulable_estimator(
             "scheduler-estimator", GrpcSchedulerEstimator(addresses.get)
         )

@@ -1,0 +1,98 @@
+"""Scheduler daemon: `python -m karmada_tpu.sched --server URL`.
+
+The reference's cmd/scheduler binary as its own OS process — and the
+north-star deployment shape: the process that owns the accelerator runs
+the batched [B,C] solve, attached to a scheduler-less control plane
+(`python -m karmada_tpu.server --controllers "*,-scheduler"`) over the
+serving API. ResourceBinding/Cluster watches stream in over HTTP
+(RemoteStore), scheduling results patch back the same way; optional
+per-cluster scheduler-estimators are reached over the wire-compatible
+gRPC client.
+
+Example:
+    python -m karmada_tpu.server --controllers "*,-scheduler" &
+    python -m karmada_tpu.sched --server http://127.0.0.1:<port> \\
+        --estimator m1=127.0.0.1:10352
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import time
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser(prog="python -m karmada_tpu.sched")
+    ap.add_argument("--server", required=True,
+                    help="control-plane URL (http:// or https://)")
+    ap.add_argument("--estimator", action="append", default=[],
+                    metavar="CLUSTER=HOST:PORT",
+                    help="scheduler-estimator gRPC address per member "
+                         "cluster; repeatable")
+    ap.add_argument("--plugins", default="*",
+                    help="reference --plugins semantics (enable/disable "
+                         "filter and score plugins)")
+    ap.add_argument("--scheduler-name", default="default-scheduler")
+    ap.add_argument("--interval", type=float, default=0.2,
+                    help="seconds between queue drains")
+    ap.add_argument("--platform", default="",
+                    help="pin the jax platform (e.g. cpu); default = the "
+                         "ambient backend (TPU where available)")
+    ap.add_argument("--bearer-token", default="")
+    ap.add_argument("--cacert", default="")
+    args = ap.parse_args()
+
+    if args.platform == "cpu":
+        from ..testing.cpumesh import force_cpu_mesh
+
+        force_cpu_mesh(1)
+    elif args.platform:
+        import jax
+
+        jax.config.update("jax_platforms", args.platform)
+
+    from ..estimator.client import EstimatorRegistry, parse_estimator_flags
+    from ..runtime.controller import Runtime
+    from ..server.remote import RemoteStore
+    from .scheduler import SchedulerDaemon
+
+    addresses = parse_estimator_flags(args.estimator)
+    registry = None
+    if addresses:
+        from ..estimator.service import GrpcSchedulerEstimator
+
+        registry = EstimatorRegistry()
+        registry.register_replica_estimator(
+            "scheduler-estimator", GrpcSchedulerEstimator(addresses.get)
+        )
+
+    store = RemoteStore(
+        args.server,
+        token=args.bearer_token or os.environ.get("KARMADA_TOKEN") or None,
+        cafile=args.cacert or os.environ.get("KARMADA_CACERT") or None,
+    )
+    runtime = Runtime()
+    plugins = [p.strip() for p in args.plugins.split(",") if p.strip()]
+    SchedulerDaemon(
+        store, runtime, scheduler_name=args.scheduler_name,
+        estimator_registry=registry, plugins=plugins,
+    )
+    print(f"karmada-tpu scheduler attached to {args.server}", flush=True)
+    try:
+        while True:
+            try:
+                runtime.settle()
+            except Exception:  # noqa: BLE001 - survive transient plane errors
+                import logging
+
+                logging.getLogger(__name__).exception("scheduling drain")
+            time.sleep(args.interval)
+    except KeyboardInterrupt:
+        pass
+    finally:
+        store.close()
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -61,6 +61,33 @@ class TestDisabledControllerBehavior:
         # ...but no binding controller ⇒ no Work objects materialize
         assert not cp.store.list("Work")
 
+    def test_explicit_list_still_schedules(self):
+        """The scheduler is its own binary in the reference — an explicit
+        --controllers list (no '*', no mention of it) must not turn it off."""
+        cp = self._plane(["binding", "execution", "workStatus"])
+        assert cp.scheduler is not None
+        d = new_deployment("default", "web", replicas=1, cpu=0.1)
+        cp.store.create(d)
+        cp.store.create(new_policy(
+            "default", "pp", [selector_for(d)], duplicated_placement([])
+        ))
+        cp.settle()
+        assert cp.store.get(
+            "ResourceBinding", "web-deployment", "default"
+        ).spec.clusters
+
+    def test_scheduler_opt_out(self):
+        cp = self._plane(["*", "-scheduler"])
+        assert cp.scheduler is None
+        d = new_deployment("default", "web", replicas=1, cpu=0.1)
+        cp.store.create(d)
+        cp.store.create(new_policy(
+            "default", "pp", [selector_for(d)], duplicated_placement([])
+        ))
+        cp.settle()
+        rb = cp.store.get("ResourceBinding", "web-deployment", "default")
+        assert not rb.spec.clusters  # pending until a scheduler attaches
+
     def test_default_plane_unaffected(self):
         cp = self._plane(None)
         d = new_deployment("default", "web", replicas=1, cpu=0.1)

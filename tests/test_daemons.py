@@ -138,6 +138,48 @@ class TestDeschedulerDaemon:
             assert "descheduled 0 binding(s)" in r.stdout, r.stdout
 
 
+class TestSchedulerDaemon:
+    def test_scheduler_attaches_to_schedulerless_plane(self):
+        """The north-star deployment: a scheduler-less serving daemon
+        (--controllers '*,-scheduler') plus `python -m karmada_tpu.sched`
+        as its own process. Bindings stay unscheduled until the remote
+        scheduler attaches, then placements and Works appear."""
+        cp_proc, url = spawn_daemon(
+            "--members", "2", "--tick-interval", "0.5",
+            "--controllers", "*,-scheduler",
+        )
+        with reaping(cp_proc) as reap:
+            rcp = RemoteControlPlane(url)
+            dep = new_deployment("default", "web", replicas=4, cpu=0.5)
+            rcp.store.create(dep)
+            rcp.store.create(new_policy(
+                "default", "pp", [selector_for(dep)],
+                duplicated_placement([]),
+            ))
+            rcp.settle()
+
+            def rb():
+                rbs = rcp.store.list("ResourceBinding", "default")
+                return rbs[0] if rbs else None
+
+            assert wait_until(lambda: rb() is not None)
+            assert not rb().spec.clusters, "scheduled without a scheduler?"
+
+            sched_proc, _ = spawn_process(
+                [sys.executable, "-m", "karmada_tpu.sched",
+                 "--server", url, "--platform", "cpu"],
+                r"attached", label="scheduler",
+            )
+            reap(sched_proc)
+            assert wait_until(
+                lambda: rb() is not None and len(rb().spec.clusters) == 2,
+                timeout=60.0,
+            ), "remote scheduler never placed the binding"
+            assert wait_until(lambda: len(
+                rcp.store.list("Work", "karmada-es-member1")
+            ) > 0), "placement never materialized as Works"
+
+
 class TestEstimatorDaemon:
     def test_grpc_daemon_answers_stock_contract(self):
         pytest.importorskip("grpc")
