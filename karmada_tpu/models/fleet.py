@@ -130,29 +130,103 @@ class FleetEncoder:
         topo = np.zeros((C, 4), np.int32)
 
         for i, c in enumerate(clusters):
-            rs = c.status.resource_summary
-            if rs is not None:
-                has_summary[i] = True
-                for r, rname in enumerate(self.resources):
-                    alloc = to_int_units(rname, rs.allocatable.get(rname, 0.0))
-                    used = to_int_units(rname, rs.allocated.get(rname, 0.0))
-                    pending = to_int_units(rname, rs.allocating.get(rname, 0.0))
-                    allocatable[i, r] = alloc
-                    capacity[i, r] = max(alloc - used - pending, 0)
-            for t, taint in enumerate(c.spec.taints):
-                taint_key[i, t] = self.strings.id(taint.key)
-                taint_value[i, t] = self.strings.id(taint.value)
-                taint_effect[i, t] = EFFECT_CODES.get(taint.effect, 1)
-            for en in c.status.api_enablements:
-                for kind in en.resources:
-                    api_ok[i, self.gvk_id(en.group_version, kind)] = True
-            topo[i, TOPO_PROVIDER] = self.strings.id(c.spec.provider)
-            topo[i, TOPO_REGION] = self.strings.id(c.spec.region)
-            topo[i, TOPO_ZONE] = self.strings.id(c.spec.zone)
-            topo[i, TOPO_CLUSTER] = name_id[i]
+            self._fill_cluster_row(
+                i, c, capacity, allocatable, has_summary,
+                taint_key, taint_value, taint_effect, api_ok, topo, name_id,
+            )
 
         return FleetArrays(
             names=names,
+            name_id=name_id,
+            alive=alive,
+            capacity=capacity,
+            allocatable=allocatable,
+            has_summary=has_summary,
+            taint_key=taint_key,
+            taint_value=taint_value,
+            taint_effect=taint_effect,
+            api_ok=api_ok,
+            topo=topo,
+        )
+
+    def _fill_cluster_row(
+        self, i: int, c: Cluster, capacity, allocatable, has_summary,
+        taint_key, taint_value, taint_effect, api_ok, topo, name_id,
+    ) -> None:
+        """Write one cluster's encoding into row i of the fleet arrays —
+        the single source of truth shared by the full encode() and the
+        dirty-column encode_cols() refresh."""
+        rs = c.status.resource_summary
+        if rs is not None:
+            has_summary[i] = True
+            for r, rname in enumerate(self.resources):
+                alloc = to_int_units(rname, rs.allocatable.get(rname, 0.0))
+                used = to_int_units(rname, rs.allocated.get(rname, 0.0))
+                pending = to_int_units(rname, rs.allocating.get(rname, 0.0))
+                allocatable[i, r] = alloc
+                capacity[i, r] = max(alloc - used - pending, 0)
+        for t, taint in enumerate(c.spec.taints):
+            taint_key[i, t] = self.strings.id(taint.key)
+            taint_value[i, t] = self.strings.id(taint.value)
+            taint_effect[i, t] = EFFECT_CODES.get(taint.effect, 1)
+        for en in c.status.api_enablements:
+            for kind in en.resources:
+                api_ok[i, self.gvk_id(en.group_version, kind)] = True
+        topo[i, TOPO_PROVIDER] = self.strings.id(c.spec.provider)
+        topo[i, TOPO_REGION] = self.strings.id(c.spec.region)
+        topo[i, TOPO_ZONE] = self.strings.id(c.spec.zone)
+        topo[i, TOPO_CLUSTER] = name_id[i]
+
+    def encode_cols(
+        self, prev: FleetArrays, clusters: Sequence[Cluster], idx: Sequence[int]
+    ) -> Optional[FleetArrays]:
+        """Dirty-column re-encode: new FleetArrays sharing `prev`'s layout
+        with only the rows in `idx` re-encoded from `clusters`. Returns None
+        when the delta does not fit the previous layout — the membership
+        changed, a dirty cluster's taints outgrow the taint axis, or it
+        enables a GVK outside the encoded vocabulary (api_ok would need a
+        new column) — and the caller must run the full encode()."""
+        if len(clusters) != prev.n_clusters:
+            return None
+        T = prev.taint_key.shape[1]
+        G = prev.api_ok.shape[1]
+        for i in idx:
+            c = clusters[i]
+            if c.name != prev.names[i]:
+                return None
+            if len(c.spec.taints) > T:
+                return None
+            for en in c.status.api_enablements:
+                for kind in en.resources:
+                    gid = self.gvks.peek(f"{en.group_version}/{kind}")
+                    if gid is None or gid >= G:
+                        return None
+        name_id = prev.name_id
+        alive = prev.alive.copy()
+        capacity = prev.capacity.copy()
+        allocatable = prev.allocatable.copy()
+        has_summary = prev.has_summary.copy()
+        taint_key = prev.taint_key.copy()
+        taint_value = prev.taint_value.copy()
+        taint_effect = prev.taint_effect.copy()
+        api_ok = prev.api_ok.copy()
+        topo = prev.topo.copy()
+        for i in idx:
+            c = clusters[i]
+            alive[i] = cluster_ready(c)
+            has_summary[i] = False
+            capacity[i] = 0
+            allocatable[i] = 0
+            taint_key[i] = 0
+            taint_value[i] = 0
+            taint_effect[i] = 0
+            api_ok[i] = False
+            self._fill_cluster_row(
+                i, c, capacity, allocatable, has_summary,
+                taint_key, taint_value, taint_effect, api_ok, topo, name_id,
+            )
+        return FleetArrays(
+            names=prev.names,
             name_id=name_id,
             alive=alive,
             capacity=capacity,

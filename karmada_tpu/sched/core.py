@@ -503,6 +503,16 @@ def _gather_rows_kernel(a, idx):
     return a[idx]
 
 
+@partial(jax.jit, donate_argnums=(0,))
+def _scatter_rows_kernel(dst, idx, src):
+    """In-place (donated) row update of a resident device tensor — the
+    dirty-column fleet refresh writes only the changed clusters' rows
+    instead of re-uploading the whole fleet encoding. Duplicate indices in
+    `idx` write identical rows (callers pad with repeats), so the scatter
+    is idempotent."""
+    return dst.at[idx].set(src)
+
+
 def _sorted_pairs(top_idx, top_val):
     """Order each row's compact (cluster idx, replicas) window by cluster
     index, parking the zero-replica padding at the end — shared by every
@@ -611,11 +621,16 @@ class ArrayScheduler:
         mesh=None,
         plugins: Optional[Sequence[str]] = None,
         plugin_registry=None,
+        autoshard: Optional[bool] = None,
     ):
         """`mesh`: optional jax.sharding.Mesh — the solve runs column/row-
         sharded over it (parallel/mesh.py) with identical outputs.
         `plugins`: the `--plugins` enable/disable list (default ["*"]);
-        `plugin_registry`: out-of-tree plugins (sched/plugins.py)."""
+        `plugin_registry`: out-of-tree plugins (sched/plugins.py).
+        `autoshard`: when no mesh was given and a round's [B,C] footprint
+        exceeds the single-chip HBM budget, transparently re-place the fleet
+        over a device mesh and run sharded (decision-identical); default on,
+        KARMADA_TPU_AUTOSHARD=0 disables."""
         self.encoder = encoder or FleetEncoder()
         self.mesh = mesh
         self._mesh_kernel = None
@@ -665,10 +680,33 @@ class ArrayScheduler:
                 )
         else:
             self.max_bc_elems = 2 << 27
+        env_as = os.environ.get("KARMADA_TPU_AUTOSHARD", "")
+        if autoshard is not None:
+            self.autoshard = bool(autoshard)
+        else:
+            self.autoshard = env_as not in ("0", "off", "false")
+        # cross-round incremental state: any fleet change bumps the epoch
+        # (cached decisions are only replayed at the epoch they were solved
+        # in); the cache maps binding uid → DecisionEntry
+        self.fleet_epoch = 0
+        self._decision_cache: dict[str, object] = {}
+        self.last_round_stats = {"replayed": 0, "solved": 0}
         self.set_clusters(clusters)
 
-    def set_clusters(self, clusters: Sequence) -> None:
+    def set_clusters(self, clusters: Sequence,
+                     dirty_names: Optional[set] = None) -> None:
+        """Re-encode the fleet. With `dirty_names` (the clusters the caller
+        knows changed since the last call), the dirty-column fast path
+        re-encodes ONLY those clusters and scatters their rows into the
+        resident device tensors (buffer donation) — keeping the batch
+        encoder's affinity masks and per-binding row cache alive — whenever
+        the change is expressible that way; otherwise this falls back to the
+        full rebuild. Either way the fleet epoch advances, so incremental
+        rounds re-solve every binding against the new snapshot."""
         clusters = list(clusters)
+        self.fleet_epoch += 1
+        if dirty_names and self._update_dirty_columns(clusters, dirty_names):
+            return
         self.n_real_clusters = len(clusters)
         if self.mesh is not None:
             # pad the fleet to a mesh-divisible width with DEAD clusters
@@ -718,27 +756,7 @@ class ArrayScheduler:
         # re-transferred only on cluster-set change
         f = self.fleet
         if self.mesh is not None:
-            from ..parallel.mesh import AXIS_CLUSTERS
-            from jax.sharding import NamedSharding, PartitionSpec as P
-
-            if self._mesh_kernel is not None:
-                # monolithic mode is in use: refresh its fleet copy
-                self._mesh_kernel.set_fleet(self.fleet)
-            # the partitioned round runs the single-chip kernels with the
-            # fleet COLUMN-SHARDED over the mesh; GSPMD partitions every
-            # kernel (no manual padding: XLA handles uneven shards)
-            def put(x, spec):
-                return jax.device_put(x, NamedSharding(self.mesh, spec))
-
-            self._fleet_dev = (
-                put(f.alive, P(AXIS_CLUSTERS)),
-                put(f.capacity, P(AXIS_CLUSTERS, None)),
-                put(f.has_summary, P(AXIS_CLUSTERS)),
-                put(f.taint_key, P(AXIS_CLUSTERS, None)),
-                put(f.taint_value, P(AXIS_CLUSTERS, None)),
-                put(f.taint_effect, P(AXIS_CLUSTERS, None)),
-                put(f.api_ok, P(AXIS_CLUSTERS, None)),
-            )
+            self._place_fleet_sharded()
             return
         self._fleet_dev = tuple(
             jax.device_put(x)
@@ -747,6 +765,102 @@ class ArrayScheduler:
                 f.taint_key, f.taint_value, f.taint_effect, f.api_ok,
             )
         )
+
+    def _place_fleet_sharded(self) -> None:
+        """Place the (cluster-padded) fleet COLUMN-SHARDED over the mesh;
+        the partitioned round runs the single-chip kernels on it and GSPMD
+        partitions every kernel (no manual padding: XLA handles uneven
+        shards). Also refreshes the monolithic kernel's copy when that mode
+        is in use."""
+        from ..parallel.mesh import AXIS_CLUSTERS
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        if self._mesh_kernel is not None:
+            self._mesh_kernel.set_fleet(self.fleet)
+
+        def put(x, spec):
+            return jax.device_put(x, NamedSharding(self.mesh, spec))
+
+        f = self.fleet
+        self._fleet_dev = (
+            put(f.alive, P(AXIS_CLUSTERS)),
+            put(f.capacity, P(AXIS_CLUSTERS, None)),
+            put(f.has_summary, P(AXIS_CLUSTERS)),
+            put(f.taint_key, P(AXIS_CLUSTERS, None)),
+            put(f.taint_value, P(AXIS_CLUSTERS, None)),
+            put(f.taint_effect, P(AXIS_CLUSTERS, None)),
+            put(f.api_ok, P(AXIS_CLUSTERS, None)),
+        )
+
+    def _update_dirty_columns(self, clusters: list, dirty_names) -> bool:
+        """Dirty-column fleet refresh. Applies only when the membership is
+        unchanged and no dirty cluster changed a mask-relevant field
+        (labels / provider / region / zone): affinity masks, the spread
+        layout and the weight tables are then provably still valid, so the
+        batch encoder — and its per-binding row cache — survives the fleet
+        update. Status-driven changes (capacity, readiness, taints, api
+        enablements over known GVKs) all take this path. Returns False when
+        the delta cannot be expressed in the resident layout.
+
+        Under a mesh (user-provided or autoshard-engaged) the host side of
+        the fast path is identical — encode_cols over the pad-preserving
+        cluster list, batch encoder kept alive — and only the device
+        placement differs: the refreshed tensors re-place sharded instead of
+        row-scattering into donated buffers."""
+        # under a mesh self.clusters carries dead pad clusters at the tail;
+        # the caller's list never does, so compare against the real prefix
+        old = self.clusters[: self.n_real_clusters]
+        if len(clusters) != len(old):
+            return False
+        idx: list[int] = []
+        for i, (cn, co) in enumerate(zip(clusters, old)):
+            if cn.name != co.name:
+                return False  # membership / order changed
+            if cn.name in dirty_names:
+                if (
+                    cn.metadata.labels != co.metadata.labels
+                    or cn.spec.provider != co.spec.provider
+                    or cn.spec.region != co.spec.region
+                    or cn.spec.zone != co.spec.zone
+                ):
+                    return False  # affinity/spread inputs changed
+                idx.append(i)
+        if not idx:
+            return True  # spurious dirt: nothing to re-encode
+        # keep the mesh pad clusters (never dirty: they are synthetic)
+        clusters = clusters + self.clusters[len(clusters):]
+        fleet = self.encoder.encode_cols(self.fleet, clusters, idx)
+        if fleet is None:
+            return False  # taint axis would widen / unknown GVK appeared
+        self.clusters = clusters
+        self.fleet = fleet
+        self.batch_encoder.fleet = fleet
+        self.batch_encoder.clusters = clusters
+        self.batch_encoder.affinity_cache.clusters = clusters
+        cap = np.asarray(fleet.capacity, np.int64)
+        self._max_cap_per_res = (
+            cap.max(axis=0) if cap.size else np.zeros(cap.shape[1], np.int64)
+        )
+        if self.mesh is not None:
+            # sharded tensors re-place whole (still no host re-encode, no
+            # encoder rebuild — the expensive parts this path avoids)
+            self._place_fleet_sharded()
+            return True
+        # scatter the dirty rows into the resident device tensors in place
+        # (donated buffers — no second fleet copy, no full re-upload); the
+        # index list pads to a pow2 bucket with repeats of the first entry
+        # so the jit cache stays bounded
+        idx_pad, _ = _pad_rows_idx(idx, partial(pow2_bucket, lo=1))
+        f = fleet
+        srcs = (
+            f.alive, f.capacity, f.has_summary,
+            f.taint_key, f.taint_value, f.taint_effect, f.api_ok,
+        )
+        self._fleet_dev = tuple(
+            _scatter_rows_kernel(dst, idx_pad, src[idx_pad])
+            for dst, src in zip(self._fleet_dev, srcs)
+        )
+        return True
 
     def _max_rows_per_round(self, n_cols: int) -> int:
         """Row cap per launched round under the [B,C] HBM budget, floored to
@@ -931,6 +1045,115 @@ class ArrayScheduler:
             plugin_bits=self._plugin_bits,
         )
 
+    # -- automatic backend selection (oversized → mesh) -------------------
+
+    def _maybe_autoshard(self, n_rows: int) -> bool:
+        """Route oversized rounds through the mesh-sharded solve. The
+        single-chip HBM heuristic: phase 1 keeps ~6 live i32/bool [B,C]
+        buffers, so a round whose B·C exceeds `max_bc_elems` (the same
+        budget that drives row chunking) no longer fits one launch — it
+        would serialize into B·C/budget sequential chunks. When more than
+        one device is visible, re-placing the fleet over a (bindings,
+        clusters) mesh multiplies the budget by the bindings-axis size and
+        splits the column work, so the round runs in fewer (ideally one)
+        launches — with bit-identical placements (tests/test_parallel.py,
+        dryrun_multichip). KARMADA_TPU_AUTOSHARD=0 or autoshard=False
+        disables the selector; passing an explicit mesh bypasses it.
+
+        Engagement is deliberately one-way (hysteresis, not an oversight):
+        problems that crossed the envelope once tend to recur (cluster
+        events re-enqueue the whole binding set), and de-escalating per
+        round would re-place the fleet and bump the epoch on every flip —
+        each epoch bump forces a full re-solve of the working set, which is
+        itself an oversized round that would immediately re-engage the
+        mesh. Steady state stays cheap under the mesh: decision replay and
+        the dirty-column fleet refresh both work there."""
+        if not self.autoshard or self.mesh is not None:
+            return False
+        if n_rows * len(self.fleet.names) <= self.max_bc_elems:
+            return False
+        devices = jax.devices()
+        if len(devices) < 2:
+            return False
+        from ..parallel.mesh import make_mesh
+
+        self.mesh = make_mesh(devices)
+        self._mesh_kernel = None
+        self._host_sorts = False  # never under a mesh: shards see partial rows
+        # re-place the fleet sharded (pads clusters to a mesh-divisible
+        # width); bumps the fleet epoch, so cached decisions re-solve once
+        # on the (decision-identical) sharded path
+        self.set_clusters(self.clusters[: self.n_real_clusters])
+        return True
+
+    # -- incremental rounds -----------------------------------------------
+
+    def schedule_incremental(
+        self, bindings: Sequence, extra_avail=None
+    ) -> list[ScheduleDecision]:
+        """Incremental schedule round: bindings whose solve inputs are
+        unchanged since the round that last solved them — same fleet epoch,
+        same spec/status inputs, same estimator answers (sched/incremental.py
+        DecisionEntry) — replay their cached decision without touching the
+        device; only genuinely dirty rows enter `schedule()`. Decisions are
+        bit-identical to a cold full solve (the tie-break is UID-seeded),
+        which the incremental-vs-cold parity suite pins.
+
+        Out-of-tree plugins compute opaque per-round [B,C] terms on host, so
+        their presence disables replay entirely (a plugin's changed answer
+        must never be masked by a stale cache)."""
+        if not bindings:
+            self.last_round_stats = {"replayed": 0, "solved": 0}
+            return []
+        if self._oot_plugins:
+            decisions = self.schedule(bindings, extra_avail=extra_avail)
+            self.last_round_stats = {"replayed": 0, "solved": len(bindings)}
+            return decisions
+        from .incremental import DecisionEntry, extra_digest
+
+        cache = self._decision_cache
+        epoch = self.fleet_epoch
+        out: list[Optional[ScheduleDecision]] = [None] * len(bindings)
+        dirty_pos: list[int] = []
+        # digests computed ONCE per row here and reused by the cache writes
+        # below (each is a blake2b over a [C] estimator row — ~20 KB at the
+        # flagship shape, not worth hashing twice in the hot path)
+        digests: list[Optional[bytes]] = [None] * len(bindings)
+        for i, rb in enumerate(bindings):
+            uid = rb.metadata.uid
+            if extra_avail is not None:
+                digests[i] = extra_digest(extra_avail[i])
+            ent = cache.get(uid) if uid else None
+            if ent is not None and ent.matches(rb, epoch, digests[i]):
+                out[i] = ent.decision
+            else:
+                dirty_pos.append(i)
+        if dirty_pos:
+            dirty = [bindings[i] for i in dirty_pos]
+            sub_extra = None if extra_avail is None else extra_avail[dirty_pos]
+            decisions = self.schedule(dirty, extra_avail=sub_extra)
+            solve_epoch = self.fleet_epoch  # autoshard may have bumped it
+            for i, rb, dec in zip(dirty_pos, dirty, decisions):
+                out[i] = dec
+                if rb.metadata.uid:
+                    cache[rb.metadata.uid] = DecisionEntry(
+                        rb, solve_epoch, digests[i], dec
+                    )
+            # bound the cache: entries for deleted bindings must not
+            # accumulate forever (same policy as the encoder's row cache)
+            if len(cache) > max(4 * len(bindings), 16384):
+                cache.clear()
+                for i, rb in enumerate(bindings):
+                    if rb.metadata.uid and out[i] is not None:
+                        cache[rb.metadata.uid] = DecisionEntry(
+                            rb, solve_epoch, digests[i], out[i]
+                        )
+        self.last_round_stats = {
+            "replayed": len(bindings) - len(dirty_pos),
+            "solved": len(dirty_pos),
+        }
+        return out
+
     def schedule(self, bindings: Sequence, extra_avail=None) -> list[ScheduleDecision]:
         """Schedule with the ordered-affinity-terms retry loop
         (scheduleResourceBindingWithClusterAffinities, scheduler.go:562-625):
@@ -939,6 +1162,7 @@ class ArrayScheduler:
         applied term's name is recorded on the decision."""
         if not bindings:
             return []
+        self._maybe_autoshard(len(bindings))
         max_rows = self._max_rows_per_round(len(self.fleet.names))
         if len(bindings) > max_rows:
             out = []

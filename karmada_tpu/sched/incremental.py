@@ -1,0 +1,98 @@
+"""Cross-round incremental scheduling state: per-binding decision replay.
+
+The solve is row-independent — every binding's placement is a pure function
+of (its own spec/status inputs, the fleet snapshot, its estimator answers).
+So a binding whose inputs did not change since the round that last solved it
+can skip the device solve entirely and replay the cached ScheduleDecision.
+This is the per-row memo that turns a steady-state churn round (≤5% of
+bindings dirty) into a solve over only the dirty rows.
+
+`DecisionEntry` captures EVERYTHING `ArrayScheduler._schedule_once` reads
+from a binding:
+
+  - metadata.generation + the identities of placement / replica_requirements
+    / resource (the store contract: managed updates replace these objects
+    and bump generation — the entry holds strong refs, so `is` can never
+    false-positive on a recycled id; same contract as BatchEncoder's row
+    cache),
+  - spec.replicas,
+  - previous placements and graceful-eviction entries by VALUE (they are
+    status-driven and mutate between rounds),
+  - the Fresh-reschedule bit (rescheduleTriggeredAt vs lastScheduledTime),
+  - status.scheduler_observed_affinity_name (the ordered-affinity retry
+    loop's starting term),
+  - a digest of the binding's registered-estimator answer row, and
+  - the scheduler's fleet epoch (any cluster change bumps it, so a fleet
+    delta re-solves every row — cheap insurance that replay can never serve
+    a decision computed against a stale fleet).
+
+The tie-break is seeded from the binding UID (models/batch.py tie_matrix),
+so a replayed decision is bit-identical to what a cold re-solve would have
+produced — the incremental-vs-cold parity suite pins this.
+"""
+from __future__ import annotations
+
+import hashlib
+from typing import Optional
+
+import numpy as np
+
+from ..models.batch import _reschedule_required
+
+
+def extra_digest(row: Optional[np.ndarray]) -> Optional[bytes]:
+    """Fixed-size digest of one binding's estimator-answer row (storing the
+    raw row would pin O(B·C) host memory in the cache)."""
+    if row is None:
+        return None
+    return hashlib.blake2b(np.ascontiguousarray(row).tobytes(),
+                           digest_size=8).digest()
+
+
+class DecisionEntry:
+    __slots__ = (
+        "epoch", "key", "generation", "replicas",
+        "placement", "requirements", "resource",
+        "prev", "evict", "fresh", "observed_affinity", "extra",
+        "decision",
+    )
+
+    def __init__(self, rb, epoch: int, extra: Optional[bytes], decision):
+        spec = rb.spec
+        self.epoch = epoch
+        self.key = rb.metadata.key()
+        self.generation = rb.metadata.generation
+        self.replicas = spec.replicas
+        self.placement = spec.placement
+        self.requirements = spec.replica_requirements
+        self.resource = spec.resource
+        self.prev = tuple(
+            (tc.name, tc.replicas) for tc in (spec.clusters or ())
+        )
+        self.evict = tuple(
+            t.from_cluster for t in (spec.graceful_eviction_tasks or ())
+        )
+        self.fresh = _reschedule_required(spec, rb.status)
+        self.observed_affinity = rb.status.scheduler_observed_affinity_name
+        self.extra = extra
+        self.decision = decision
+
+    def matches(self, rb, epoch: int, extra: Optional[bytes]) -> bool:
+        spec = rb.spec
+        return (
+            self.epoch == epoch
+            and self.generation == rb.metadata.generation
+            and self.replicas == spec.replicas
+            and self.placement is spec.placement
+            and self.requirements is spec.replica_requirements
+            and self.resource is spec.resource
+            and self.extra == extra
+            and self.key == rb.metadata.key()
+            and self.fresh == _reschedule_required(spec, rb.status)
+            and self.observed_affinity
+            == rb.status.scheduler_observed_affinity_name
+            and self.prev
+            == tuple((tc.name, tc.replicas) for tc in (spec.clusters or ()))
+            and self.evict
+            == tuple(t.from_cluster for t in (spec.graceful_eviction_tasks or ()))
+        )

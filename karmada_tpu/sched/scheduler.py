@@ -38,7 +38,7 @@ from ..metrics import (
     timed,
 )
 from ..runtime.controller import BatchingController, Runtime
-from ..store.store import DELETED, Store
+from ..store.store import DELETED, MODIFIED, Store
 from .core import ArrayScheduler, ScheduleDecision
 from .queue import PrioritySchedulingQueue
 
@@ -70,6 +70,10 @@ class SchedulerDaemon:
         self.plugin_registry = plugin_registry
         self._array: Optional[ArrayScheduler] = None
         self._fleet_dirty = True
+        # names of clusters MODIFIED since the last fleet encode; None means
+        # the membership changed (add/delete) and the next encode must be a
+        # full rebuild instead of the dirty-column scatter
+        self._dirty_clusters: Optional[set[str]] = None
         self.controller = runtime.register(
             BatchingController(
                 name="scheduler", reconcile=None, reconcile_batch=self._schedule_batch
@@ -104,6 +108,19 @@ class SchedulerDaemon:
         return rb.spec.schedule_priority
 
     def _on_cluster(self, event: str, cluster) -> None:
+        # record the delta FIRST, then mark dirty unconditionally — there is
+        # no check-then-act window in which a concurrent _ensure_fleet swap
+        # could absorb the flag without the event. Racing with the swap can
+        # at worst add the name to the retired set (the fresh set is then
+        # empty ⇒ the re-marked round does a full rebuild): a lost NAME
+        # degrades to a full re-encode, a lost FLAG would mean scheduling
+        # against a stale fleet.
+        if event == MODIFIED:
+            d = self._dirty_clusters
+            if d is not None:
+                d.add(cluster.name)
+        else:
+            self._dirty_clusters = None  # membership changed: full rebuild
         self._fleet_dirty = True
         for rb in self.store.list("ResourceBinding"):
             self._on_binding("MODIFIED", rb)
@@ -134,6 +151,12 @@ class SchedulerDaemon:
 
     def _ensure_fleet(self) -> ArrayScheduler:
         if self._array is None or self._fleet_dirty:
+            # swap the dirty state out FIRST: a cluster event landing while
+            # we encode re-marks the fleet dirty for the next round instead
+            # of being silently absorbed into this one
+            self._fleet_dirty = False
+            dirty = self._dirty_clusters
+            self._dirty_clusters = set()
             clusters = self.store.list("Cluster")
             clusters.sort(key=lambda c: c.name)
             if self._array is None:
@@ -143,8 +166,10 @@ class SchedulerDaemon:
                     plugin_registry=self.plugin_registry,
                 )
             else:
-                self._array.set_clusters(clusters)
-            self._fleet_dirty = False
+                # MODIFIED-only churn rides the dirty-column scatter (the
+                # batch encoder and its row cache survive); membership
+                # changes rebuild everything as before
+                self._array.set_clusters(clusters, dirty_names=dirty)
         return self._array
 
     def _schedule_batch(self, keys: list[str]) -> list[str]:
@@ -179,7 +204,9 @@ class SchedulerDaemon:
                 )
             trace.step("Estimator fan-out done")
             with timed(scheduling_algorithm_duration):
-                decisions = array.schedule(bindings, extra_avail=extra_avail)
+                decisions = array.schedule_incremental(
+                    bindings, extra_avail=extra_avail
+                )
             trace.step("Batched solve done")
             for rb, decision in zip(bindings, decisions):
                 schedule_attempts.inc(result="scheduled" if decision.ok else "error")

@@ -42,6 +42,11 @@ def main() -> None:
                     help="require 'Authorization: Bearer <token>' matching "
                          "this file's contents (generated on first start "
                          "if absent); empty = unauthenticated")
+    ap.add_argument("--enable-test-clock", action="store_true",
+                    help="allow POST /tick (advancing/freezing the plane's "
+                         "Clock — test drivers only); disabled by default "
+                         "so a production daemon's clock cannot be frozen "
+                         "via the normal bearer token (403)")
     args = ap.parse_args()
 
     if args.platform == "cpu":
@@ -96,7 +101,8 @@ def main() -> None:
               flush=True)
 
     srv = ControlPlaneServer(cp, host=args.host, port=args.port,
-                             ssl_context=ssl_context, token=token)
+                             ssl_context=ssl_context, token=token,
+                             enable_test_clock=args.enable_test_clock)
     srv.start()
     print(f"karmada-tpu control plane serving on {srv.url}", flush=True)
 

@@ -63,12 +63,20 @@ _WATCH_END = object()
 
 class ControlPlaneServer:
     def __init__(self, cp, host: str = "127.0.0.1", port: int = 0,
-                 ssl_context=None, token: Optional[str] = None):
+                 ssl_context=None, token: Optional[str] = None,
+                 enable_test_clock: bool = True):
+        """`enable_test_clock=False` disables POST /tick with 403: advancing
+        a nonzero `seconds` freezes the plane's Clock at the advanced
+        instant, which is a test-driver affordance — a production daemon
+        must not expose it to anyone holding the normal bearer token. The
+        in-process default stays True (tests and demo drivers); the daemon
+        (`python -m karmada_tpu.server`) requires --enable-test-clock."""
         self.cp = cp
         self._host = host
         self._port = port
         self._ssl_context = ssl_context
         self._token = token
+        self._enable_test_clock = enable_test_clock
         self._httpd: Optional[ThreadingHTTPServer] = None
         self._threads: list[threading.Thread] = []
         self._dirty = threading.Event()
@@ -243,6 +251,13 @@ class ControlPlaneServer:
         self._send(h, 200, {"ok": True})
 
     def _h_POST_tick(self, h, q):
+        if not self._enable_test_clock:
+            drain_body(h)
+            self._send(h, 403, {
+                "error": "test clock disabled: start the daemon with "
+                         "--enable-test-clock to allow POST /tick",
+            })
+            return
         body = self._body(h)
         # timer loops share the reconcile thread's exclusivity requirement
         # (tick itself settles at the end). NOTE: advancing a nonzero

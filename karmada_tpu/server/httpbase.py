@@ -24,6 +24,10 @@ def send_json(handler: BaseHTTPRequestHandler, status: int, body: dict) -> None:
         handler.send_response(status)
         handler.send_header("Content-Type", "application/json")
         handler.send_header("Content-Length", str(len(data)))
+        if handler.close_connection:
+            # drain_body declined an oversized body: tell the peer the
+            # socket will not be reused (the unread bytes make it unusable)
+            handler.send_header("Connection", "close")
         handler.end_headers()
         handler.wfile.write(data)
     except (BrokenPipeError, ConnectionResetError):
@@ -37,16 +41,42 @@ def read_json(handler: BaseHTTPRequestHandler) -> dict:
     return json.loads(handler.rfile.read(n).decode())
 
 
-def drain_body(handler: BaseHTTPRequestHandler) -> None:
+# an unauthenticated peer may drain at most this much; anything larger gets
+# the connection torn down instead of read (the bytes were never paid for)
+DRAIN_BODY_MAX = 1 << 20
+_DRAIN_CHUNK = 64 * 1024
+
+
+def drain_body(handler: BaseHTTPRequestHandler,
+               max_bytes: int = DRAIN_BODY_MAX) -> None:
     """Consume an unread request body before an early-reply (401/404): on an
     HTTP/1.1 keep-alive connection, leftover body bytes would be parsed as
-    the next request line, desyncing every later request on the socket."""
+    the next request line, desyncing every later request on the socket.
+
+    The body is discarded in fixed 64 KiB chunks — never allocated as one
+    attacker-controlled Content-Length buffer — and a body above `max_bytes`
+    is not read at all: the handler instead closes the connection after the
+    reply (send_json adds `Connection: close`), so an unauthenticated peer
+    cannot make the server read (or buffer) an arbitrarily large body."""
     try:
         n = int(handler.headers.get("Content-Length") or 0)
-        if n:
-            handler.rfile.read(n)
-    except (OSError, ValueError):
-        pass
+    except ValueError:
+        handler.close_connection = True
+        return
+    if n <= 0:
+        return
+    if n > max_bytes:
+        handler.close_connection = True
+        return
+    try:
+        remaining = n
+        while remaining > 0:
+            chunk = handler.rfile.read(min(_DRAIN_CHUNK, remaining))
+            if not chunk:
+                break  # peer closed early; nothing left to desync
+            remaining -= len(chunk)
+    except OSError:
+        handler.close_connection = True
 
 
 def make_http_server(host: str, port: int, handler_cls,

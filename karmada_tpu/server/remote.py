@@ -160,7 +160,9 @@ class RemoteStore:
         def done() -> bool:
             return self._closed or stop.is_set()
 
-        def attach(with_replay: bool) -> None:
+        def attach(with_replay: bool) -> Optional[int]:
+            """One stream attachment; returns the HTTP status (None when the
+            request itself failed before a response arrived)."""
             path = (f"/watch?kind={quote(kind, safe='')}"
                     f"&replay={'1' if with_replay else '0'}")
             if namespace:
@@ -181,12 +183,12 @@ class RemoteStore:
                 conn.request("GET", path, headers=self._headers(False))
                 resp = conn.getresponse()
                 if resp.status != 200:
-                    return
+                    return resp.status
                 buf = b""
                 while not done():
                     chunk = resp.read1(65536)
                     if not chunk:
-                        return  # server closed (shutdown or overflow)
+                        return 200  # server closed (shutdown or overflow)
                     buf += chunk
                     while b"\n" in buf:
                         line, _, buf = buf.partition(b"\n")
@@ -196,22 +198,66 @@ class RemoteStore:
                         deliver(
                             msg["kind"], msg["event"], codec.decode(msg["obj"])
                         )
+                return 200
             finally:
                 conn.close()
 
         def run() -> None:
             # informer semantics: a dropped stream (server restart, overflow
             # close) re-attaches WITH replay — the relist/resync that makes
-            # level-triggered consumers converge despite missed deltas
+            # level-triggered consumers converge despite missed deltas.
+            # Non-200 responses are LOGGED (at least once per distinct
+            # status) and retried with exponential backoff instead of a
+            # silent fixed 0.5 s spin; 401/403 are authorization failures
+            # that no amount of retrying fixes, so the stream surfaces them
+            # as a hard error and terminates.
+            import logging
+
+            log = logging.getLogger(__name__)
             first = True
+            backoff = 0.5
+            logged: set[object] = set()
             while not done():
+                status: Optional[int] = None
+                err: Optional[Exception] = None
                 try:
-                    attach(replay if first else True)
-                except (OSError, json.JSONDecodeError):
-                    pass
+                    status = attach(replay if first else True)
+                except (OSError, json.JSONDecodeError) as e:
+                    err = e
                 first = False
+                if status in (401, 403):
+                    log.error(
+                        "watch %s: HTTP %d from %s — authorization failure, "
+                        "stream terminated (check the bearer token)",
+                        kind, status, self.base_url,
+                    )
+                    stop.set()
+                    return
+                if status == 200:
+                    backoff = 0.5  # healthy stream ended: quick resync
+                elif status is None:
+                    # transport failure (connection refused, half-open
+                    # timeout): log the first occurrence per stream, and
+                    # back off mildly — the cap stays low so a restarting
+                    # server is re-joined within a couple of seconds
+                    if "transport" not in logged:
+                        logged.add("transport")
+                        log.warning(
+                            "watch %s: %s unreachable (%s); retrying",
+                            kind, self.base_url, err,
+                        )
+                elif status not in logged:
+                    logged.add(status)
+                    log.warning(
+                        "watch %s: HTTP %d from %s; retrying with backoff",
+                        kind, status, self.base_url,
+                    )
                 if not done():
-                    stop.wait(0.5)
+                    stop.wait(backoff)
+                    if status is None:
+                        backoff = min(backoff * 2, 2.0)
+                    elif status != 200:
+                        backoff = min(backoff * 2, 30.0)
 
         t = threading.Thread(target=run, name=f"watch-{kind}", daemon=True)
         t.start()

@@ -30,6 +30,15 @@ class Interner:
     def lookup(self, i: int) -> str:
         return self._strs[i]
 
+    def peek(self, s: str):
+        """Id of `s` if already interned, else None — never inserts (the
+        dirty-column fleet refresh must detect out-of-vocabulary strings
+        instead of growing the vocabulary mid-update)."""
+        if not s:
+            return self.NONE
+        with self._lock:
+            return self._ids.get(s)
+
     def ids(self, strs) -> list[int]:
         return [self.id(s) for s in strs]
 

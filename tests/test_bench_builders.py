@@ -15,6 +15,9 @@ SMALL = dict(
     spread=lambda: bench.build_spread(n_clusters=60, n_bindings=16),
     spread_skewed=lambda: bench.build_spread_skewed(n_clusters=60, n_bindings=16),
     churn=lambda: bench.build_churn(n_clusters=30, n_bindings=16),
+    churn_incremental=lambda: bench.build_churn_incremental(
+        n_clusters=30, n_bindings=16),
+    autoshard=lambda: bench.build_autoshard(n_clusters=30, n_bindings=16),
     flagship=lambda: bench.build_flagship(n_clusters=30, n_bindings=16),
     flagship_cold=lambda: bench.build_flagship_cold(n_clusters=30, n_bindings=16),
 )
@@ -31,6 +34,42 @@ def test_config_builds_and_schedules(name):
         extra = extra_fn() if extra_fn else None
         decisions = sched.schedule(bindings, extra_avail=extra)
         assert sum(d.ok for d in decisions) == len(bindings)
+
+
+def test_churn_incremental_replays_most_rows():
+    """The 3x-speedup claim rests on replay: after the warm round, a
+    measured round with ≤5% dirty bindings must solve only the dirty rows."""
+    sched, bindings, _, pre_iter = bench.build_churn_incremental(
+        n_clusters=30, n_bindings=16)
+    sched.schedule(bindings)  # warm: cold full solve populates the cache
+    pre_iter()
+    sched.schedule(bindings)
+    stats = sched.last_round_stats
+    assert stats["solved"] <= max(1, int(0.05 * len(bindings)))
+    assert stats["replayed"] == len(bindings) - stats["solved"]
+
+
+def test_autoshard_config_records_route():
+    import jax
+
+    sched, bindings, _ = bench.build_autoshard(n_clusters=30, n_bindings=16)
+    sched.schedule(bindings)
+    # with the conftest 8-device virtual mesh the oversized round must have
+    # taken the sharded route
+    assert (sched.mesh is not None) == (len(jax.devices()) > 1)
+
+
+def test_tpu_capture_lines_merge():
+    """CPU-only fallback artifacts embed the committed TPU capture lines."""
+    lines = bench.tpu_capture_lines()
+    assert lines, "BENCH_tpu_latest.json should yield capture lines"
+    for rec in lines:
+        assert rec["source"] == "BENCH_tpu_latest.json"
+        assert rec["metric"].startswith("schedule_round_p99")
+        assert rec["backend"] == "tpu"
+        assert "captured_at" in rec
+    # a missing/corrupt capture degrades to an empty merge, never a crash
+    assert bench.tpu_capture_lines("/nonexistent.json") == []
 
 
 @pytest.mark.slow

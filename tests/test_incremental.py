@@ -1,0 +1,346 @@
+"""Incremental schedule rounds: decision replay + dirty-row/column encoding
+must be indistinguishable from a cold full solve (the tie-break is
+UID-seeded, so "indistinguishable" means BIT-IDENTICAL decisions), across
+arbitrary interleaved churn — binding add/remove/mutate, strategy changes,
+cluster status/label changes — on both the single-chip and mesh-sharded
+paths. Also pins the automatic backend selector: oversized rounds route to
+the mesh transparently and stay decision-identical."""
+from __future__ import annotations
+
+import copy
+
+import numpy as np
+import pytest
+
+import jax
+
+from karmada_tpu.api.policy import (
+    ClusterAffinity,
+    ClusterAffinityTerm,
+    LabelSelector,
+    Placement,
+)
+from karmada_tpu.models.fleet import FleetEncoder
+from karmada_tpu.parallel import make_mesh
+from karmada_tpu.sched.core import ArrayScheduler
+from karmada_tpu.testing.fixtures import (
+    duplicated_placement,
+    static_weight_placement,
+    synthetic_fleet,
+)
+from tests.test_parallel import dyn_placement, make_binding
+
+
+def mixed_bindings(names, n=14):
+    bindings = []
+    for i in range(n):
+        kind = i % 5
+        if kind == 0:
+            p = duplicated_placement(names[: 3 + i % 4])
+        elif kind == 1:
+            p = static_weight_placement({names[j]: j + 1 for j in range(1 + i % 5)})
+        elif kind == 4:
+            # ordered affinity terms: the retry loop must replay identically
+            p = Placement(cluster_affinities=[
+                ClusterAffinityTerm(
+                    affinity_name="first",
+                    affinity=ClusterAffinity(cluster_names=[names[0]]),
+                ),
+                ClusterAffinityTerm(
+                    affinity_name="rest",
+                    affinity=ClusterAffinity(cluster_names=list(names[1:6])),
+                ),
+            ])
+        else:
+            p = dyn_placement(aggregated=(kind == 3))
+        prev = {names[i % len(names)]: 2} if i % 3 == 0 else None
+        bindings.append(
+            make_binding(f"app-{i}", 4 + i, p, cpu=0.5, prev=prev)
+        )
+    return bindings
+
+
+def assert_same_decisions(got, want):
+    assert len(got) == len(want)
+    for g, w in zip(got, want):
+        assert g.key == w.key
+        assert g.ok == w.ok, f"{g.key}: {g.error!r} vs {w.error!r}"
+        assert g.error == w.error, g.key
+        assert g.affinity_name == w.affinity_name, g.key
+        if g.ok:
+            assert {t.name: t.replicas for t in (g.targets or [])} == {
+                t.name: t.replicas for t in (w.targets or [])
+            }, g.key
+
+
+@pytest.fixture()
+def fleet():
+    clusters = synthetic_fleet(19, seed=5)
+    return clusters, [c.name for c in clusters]
+
+
+def bump(rb):
+    """The store-update contract: managed updates bump generation."""
+    rb.metadata.generation += 1
+
+
+def test_replay_skips_unchanged_rows(fleet):
+    clusters, names = fleet
+    bindings = mixed_bindings(names)
+    inc = ArrayScheduler(clusters)
+    inc.schedule_incremental(bindings)
+    assert inc.last_round_stats == {"replayed": 0, "solved": len(bindings)}
+    got = inc.schedule_incremental(bindings)
+    assert inc.last_round_stats == {"replayed": len(bindings), "solved": 0}
+    assert_same_decisions(got, ArrayScheduler(clusters).schedule(bindings))
+
+
+def test_incremental_parity_across_churn_sequence(fleet):
+    """Interleaved churn: every round's incremental decisions must equal a
+    cold scheduler's full solve of the same inputs."""
+    clusters, names = fleet
+    bindings = mixed_bindings(names)
+    inc = ArrayScheduler(clusters)
+
+    def check(expect_solved=None):
+        got = inc.schedule_incremental(bindings)
+        want = ArrayScheduler(clusters).schedule(bindings)
+        assert_same_decisions(got, want)
+        if expect_solved is not None:
+            assert inc.last_round_stats["solved"] == expect_solved
+
+    check(expect_solved=len(bindings))  # cold round
+
+    # mutate: replicas change (scale), strategy change (Divided→Duplicated),
+    # prev-placement drift, Fresh reschedule trigger
+    bindings[2].spec.replicas += 3
+    bump(bindings[2])
+    bindings[3].spec.placement = duplicated_placement(names[:5])
+    bump(bindings[3])
+    bindings[6].spec.clusters = [
+        type(bindings[6].spec.clusters[0])(name=names[1], replicas=4)
+    ] if bindings[6].spec.clusters else []
+    bindings[7].spec.reschedule_triggered_at = 5.0
+    bindings[7].status.last_scheduled_time = 1.0
+    check(expect_solved=4)
+
+    # add + remove bindings
+    bindings.append(make_binding("late-1", 6, dyn_placement(), cpu=0.25))
+    bindings.append(make_binding("late-2", 2, duplicated_placement(names[:3])))
+    del bindings[0]
+    check(expect_solved=2)
+
+    # steady state again: everything replays
+    check(expect_solved=0)
+
+
+def test_cluster_status_change_takes_dirty_column_path(fleet):
+    clusters, names = fleet
+    bindings = mixed_bindings(names)
+    inc = ArrayScheduler(clusters)
+    inc.schedule_incremental(bindings)
+    encoder_before = inc.batch_encoder
+    epoch_before = inc.fleet_epoch
+
+    new_clusters = list(clusters)
+    c = copy.deepcopy(clusters[4])
+    c.status.resource_summary.allocated["cpu"] = 77.0
+    new_clusters[4] = c
+    inc.set_clusters(new_clusters, dirty_names={c.name})
+    # the batch encoder (and its row cache) survive a status-only delta
+    assert inc.batch_encoder is encoder_before
+    assert inc.fleet_epoch == epoch_before + 1
+
+    got = inc.schedule_incremental(bindings)
+    # epoch bump ⇒ every row re-solves against the new fleet
+    assert inc.last_round_stats["solved"] == len(bindings)
+    assert_same_decisions(got, ArrayScheduler(new_clusters).schedule(bindings))
+
+
+def test_cluster_label_change_falls_back_to_full_rebuild(fleet):
+    """A label change invalidates affinity masks: the dirty-column path must
+    refuse it, and decisions must track the new labels."""
+    clusters, names = fleet
+    label_placement = Placement(
+        cluster_affinity=ClusterAffinity(
+            label_selector=LabelSelector(match_labels={"tier": "gold"})
+        )
+    )
+    bindings = [make_binding("lbl", 4, label_placement, cpu=0.25)]
+    base = list(clusters)
+    gold = copy.deepcopy(clusters[0])
+    gold.metadata.labels["tier"] = "gold"
+    base[0] = gold
+
+    inc = ArrayScheduler(base)
+    d0 = inc.schedule_incremental(bindings)
+    assert d0[0].ok and {t.name for t in d0[0].targets} == {gold.name}
+
+    encoder_before = inc.batch_encoder
+    switched = list(base)
+    plain = copy.deepcopy(gold)
+    del plain.metadata.labels["tier"]
+    other = copy.deepcopy(base[1])
+    other.metadata.labels["tier"] = "gold"
+    switched[0] = plain
+    switched[1] = other
+    inc.set_clusters(switched, dirty_names={plain.name, other.name})
+    assert inc.batch_encoder is not encoder_before  # full rebuild happened
+
+    d1 = inc.schedule_incremental(bindings)
+    assert d1[0].ok and {t.name for t in d1[0].targets} == {other.name}
+    assert_same_decisions(d1, ArrayScheduler(switched).schedule(bindings))
+
+
+def test_cluster_membership_change_rebuilds(fleet):
+    clusters, names = fleet
+    bindings = mixed_bindings(names)
+    inc = ArrayScheduler(clusters)
+    inc.schedule_incremental(bindings)
+
+    grown = list(clusters) + synthetic_fleet(2, seed=99)
+    # dirty-names hint is stale/wrong on purpose: membership changed, the
+    # fast path must refuse and the full rebuild must land
+    inc.set_clusters(grown, dirty_names={grown[-1].name})
+    got = inc.schedule_incremental(bindings)
+    assert_same_decisions(got, ArrayScheduler(grown).schedule(bindings))
+
+
+def test_encode_cols_matches_full_encode(fleet):
+    clusters, _ = fleet
+    enc = FleetEncoder()
+    prev = enc.encode(clusters)
+
+    changed = list(clusters)
+    c = copy.deepcopy(clusters[3])
+    c.status.resource_summary.allocated["cpu"] = 50.0
+    c.status.conditions[0].status = "False"  # goes NotReady
+    changed[3] = c
+    got = enc.encode_cols(prev, changed, [3])
+    want = enc.encode(changed)  # same encoder ⇒ same interned ids
+    np.testing.assert_array_equal(got.capacity, want.capacity)
+    np.testing.assert_array_equal(got.alive, want.alive)
+    np.testing.assert_array_equal(got.has_summary, want.has_summary)
+    np.testing.assert_array_equal(got.taint_key, want.taint_key)
+    np.testing.assert_array_equal(got.api_ok, want.api_ok)
+    np.testing.assert_array_equal(got.topo, want.topo)
+
+    # un-expressible deltas signal fallback instead of silently truncating
+    assert enc.encode_cols(prev, changed[:-1], [3]) is None  # size change
+    renamed = list(changed)
+    rn = copy.deepcopy(changed[0])
+    rn.metadata.name = "imposter"
+    renamed[0] = rn
+    assert enc.encode_cols(prev, renamed, [0]) is None
+
+
+def test_incremental_parity_on_mesh(fleet):
+    """The acceptance bar: the incremental-vs-cold parity holds on the
+    mesh-sharded path too."""
+    clusters, names = fleet
+    bindings = mixed_bindings(names)
+    inc = ArrayScheduler(clusters, mesh=make_mesh(jax.devices()))
+    inc.schedule_incremental(bindings)
+    bindings[1].spec.replicas += 2
+    bump(bindings[1])
+    bindings.append(make_binding("late", 5, dyn_placement(aggregated=True), cpu=0.5))
+    got = inc.schedule_incremental(bindings)
+    assert inc.last_round_stats["solved"] == 2
+    assert_same_decisions(got, ArrayScheduler(clusters).schedule(bindings))
+
+
+def test_dirty_column_refresh_under_mesh(fleet):
+    """The dirty-column fast path must survive mesh engagement (autoshard or
+    user mesh): the batch encoder stays alive and decisions track the new
+    capacities — an oversized round must not permanently re-impose full
+    fleet rebuilds on every cluster heartbeat."""
+    clusters, names = fleet
+    bindings = mixed_bindings(names)
+    inc = ArrayScheduler(clusters, mesh=make_mesh(jax.devices()))
+    inc.schedule_incremental(bindings)
+    encoder_before = inc.batch_encoder
+
+    new_clusters = list(clusters)
+    c = copy.deepcopy(clusters[2])
+    c.status.resource_summary.allocated["cpu"] = 88.0
+    new_clusters[2] = c
+    inc.set_clusters(new_clusters, dirty_names={c.name})
+    assert inc.batch_encoder is encoder_before  # no rebuild under the mesh
+
+    got = inc.schedule_incremental(bindings)
+    assert inc.last_round_stats["solved"] == len(bindings)
+    assert_same_decisions(got, ArrayScheduler(new_clusters).schedule(bindings))
+
+
+def test_estimator_answer_change_invalidates_replay(fleet):
+    clusters, names = fleet
+    bindings = [
+        make_binding(f"d{i}", 6 + i, dyn_placement(), cpu=0.5) for i in range(4)
+    ]
+    B, C = len(bindings), len(clusters)
+    extra = np.full((B, C), 40, np.int32)
+    inc = ArrayScheduler(clusters)
+    inc.schedule_incremental(bindings, extra_avail=extra)
+    inc.schedule_incremental(bindings, extra_avail=extra)
+    assert inc.last_round_stats == {"replayed": B, "solved": 0}
+    extra2 = extra.copy()
+    extra2[1, :] = 2  # one binding's estimator answers tightened
+    got = inc.schedule_incremental(bindings, extra_avail=extra2)
+    assert inc.last_round_stats == {"replayed": B - 1, "solved": 1}
+    assert_same_decisions(
+        got, ArrayScheduler(clusters).schedule(bindings, extra_avail=extra2)
+    )
+
+
+# -- automatic backend selection (oversized → mesh) ------------------------
+
+
+def test_autoshard_routes_oversized_round_to_mesh(fleet):
+    clusters, names = fleet
+    bindings = mixed_bindings(names)
+    want = ArrayScheduler(clusters).schedule(bindings)
+
+    sched = ArrayScheduler(clusters)
+    sched.max_bc_elems = 16  # force the oversized classification
+    got = sched.schedule(bindings)
+    assert sched.mesh is not None, "oversized round did not engage the mesh"
+    assert_same_decisions(got, want)
+
+    # once engaged, later (small) rounds stay on the mesh and stay identical
+    got2 = sched.schedule(bindings[:3])
+    assert_same_decisions(got2, want[:3])
+
+
+def test_autoshard_override_flag_disables(fleet, monkeypatch):
+    clusters, names = fleet
+    bindings = mixed_bindings(names)
+    monkeypatch.setenv("KARMADA_TPU_AUTOSHARD", "0")
+    sched = ArrayScheduler(clusters)
+    sched.max_bc_elems = 16
+    got = sched.schedule(bindings)  # row-chunked single-chip fallback
+    assert sched.mesh is None
+    assert_same_decisions(got, ArrayScheduler(clusters).schedule(bindings))
+
+
+def test_autoshard_constructor_param_beats_env(fleet, monkeypatch):
+    clusters, _ = fleet
+    monkeypatch.setenv("KARMADA_TPU_AUTOSHARD", "0")
+    sched = ArrayScheduler(clusters, autoshard=True)
+    assert sched.autoshard is True
+    monkeypatch.delenv("KARMADA_TPU_AUTOSHARD")
+    sched = ArrayScheduler(clusters, autoshard=False)
+    assert sched.autoshard is False
+
+
+def test_autoshard_with_incremental_rounds(fleet):
+    """schedule_incremental over an autosharding scheduler: the reshard
+    bumps the epoch (one full re-solve), then replay resumes on the mesh."""
+    clusters, names = fleet
+    bindings = mixed_bindings(names)
+    sched = ArrayScheduler(clusters)
+    sched.max_bc_elems = 16
+    sched.schedule_incremental(bindings)
+    assert sched.mesh is not None
+    got = sched.schedule_incremental(bindings)
+    assert sched.last_round_stats["solved"] == 0
+    assert_same_decisions(got, ArrayScheduler(clusters).schedule(bindings))

@@ -1,0 +1,153 @@
+"""Serving-seam hardening (ADVICE/VERDICT round 5 satellites): bounded
+unauthenticated body drain, the test-clock gate on POST /tick, and watch
+streams that surface auth failures instead of silently spinning."""
+from __future__ import annotations
+
+import io
+import time
+
+import pytest
+
+from karmada_tpu.server.apiserver import ControlPlaneServer
+from karmada_tpu.server.httpbase import DRAIN_BODY_MAX, drain_body
+from karmada_tpu.server.remote import RemoteControlPlane, RemoteError, RemoteStore
+from karmada_tpu.store.store import Store
+
+
+class MiniPlane:
+    """The slice of the ControlPlane surface the apiserver routes under test
+    actually touch — keeps these tests independent of the full plane's
+    optional dependencies (auth/pki needs `cryptography`)."""
+
+    def __init__(self):
+        self.store = Store()
+        self.ticks: list[float] = []
+
+    def settle(self, max_steps: int = 0) -> int:
+        return 0
+
+    def tick(self, seconds: float = 0.0) -> int:
+        self.ticks.append(seconds)
+        return 0
+
+
+class FakeHandler:
+    """Just enough of BaseHTTPRequestHandler for drain_body."""
+
+    def __init__(self, content_length, body=b""):
+        self.headers = {"Content-Length": str(content_length)}
+        self.rfile = io.BytesIO(body)
+        self.close_connection = False
+
+
+class TestDrainBody:
+    def test_small_body_fully_drained(self):
+        h = FakeHandler(100, b"x" * 100 + b"NEXT")
+        drain_body(h)
+        assert h.rfile.tell() == 100  # next request line left intact
+        assert h.close_connection is False
+
+    def test_large_body_drained_in_chunks_not_one_allocation(self):
+        n = 300 * 1024  # crosses several 64 KiB chunks
+        h = FakeHandler(n, b"x" * n)
+        drain_body(h)
+        assert h.rfile.tell() == n
+        assert h.close_connection is False
+
+    def test_oversized_body_not_read_connection_closed(self):
+        h = FakeHandler(DRAIN_BODY_MAX + 1, b"x" * 1024)
+        drain_body(h)
+        assert h.rfile.tell() == 0  # attacker bytes never read or buffered
+        assert h.close_connection is True
+
+    def test_hostile_content_length_closes(self):
+        h = FakeHandler("not-a-number")
+        drain_body(h)
+        assert h.close_connection is True
+
+    def test_truncated_body_stops_cleanly(self):
+        h = FakeHandler(1000, b"x" * 10)  # peer lied, then closed
+        drain_body(h)
+        assert h.close_connection is False  # nothing left to desync
+
+
+@pytest.fixture()
+def plane():
+    return MiniPlane()
+
+
+class TestTestClockGate:
+    def test_tick_disabled_returns_403(self, plane):
+        srv = ControlPlaneServer(plane, enable_test_clock=False)
+        port = srv.start()
+        try:
+            rcp = RemoteControlPlane(f"http://127.0.0.1:{port}")
+            with pytest.raises(RemoteError, match="HTTP 403"):
+                rcp.tick(5.0)
+            # the rest of the surface is untouched
+            assert rcp.healthz()
+            rcp.settle()
+        finally:
+            srv.stop()
+
+    def test_tick_enabled_by_default_in_process(self, plane):
+        srv = ControlPlaneServer(plane)
+        port = srv.start()
+        try:
+            RemoteControlPlane(f"http://127.0.0.1:{port}").tick(1.5)
+            assert plane.ticks == [1.5]
+        finally:
+            srv.stop()
+
+    def test_daemon_flag_exists(self):
+        # the daemon must expose the opt-in; its default is OFF (production)
+        import argparse
+
+        from karmada_tpu.server import __main__ as daemon_main
+
+        src = open(daemon_main.__file__).read()
+        assert "--enable-test-clock" in src
+        assert "enable_test_clock=args.enable_test_clock" in src
+        assert argparse  # imported for clarity of intent
+
+
+class TestWatchAuthFailure:
+    def test_unauthorized_watch_surfaces_hard_error_and_stops(self, plane, caplog):
+        srv = ControlPlaneServer(plane, token="sekrit")
+        port = srv.start()
+        try:
+            rs = RemoteStore(f"http://127.0.0.1:{port}")  # no token
+            events = []
+            with caplog.at_level("ERROR", logger="karmada_tpu.server.remote"):
+                rs.watch("Cluster", lambda ev, obj: events.append(ev))
+                # the 401 must terminate the stream (no silent retry loop)
+                deadline = time.monotonic() + 5.0
+                _, _, stop = rs._streams[0]
+                while time.monotonic() < deadline and not stop.is_set():
+                    time.sleep(0.05)
+            assert stop.is_set(), "401 stream kept silently retrying"
+            assert any(
+                "authorization failure" in r.message for r in caplog.records
+            )
+            assert not events
+            rs.close()
+        finally:
+            srv.stop()
+
+    def test_authorized_watch_still_streams(self, plane):
+        srv = ControlPlaneServer(plane, token="sekrit")
+        port = srv.start()
+        try:
+            rs = RemoteStore(f"http://127.0.0.1:{port}", token="sekrit")
+            got = []
+            rs.watch("Cluster", lambda ev, obj: got.append((ev, obj.name)))
+            from karmada_tpu.testing.fixtures import new_cluster
+
+            rs.create(new_cluster("watched-1"))
+            deadline = time.monotonic() + 5.0
+            while time.monotonic() < deadline and not got:
+                time.sleep(0.05)
+            assert ("ADDED", "watched-1") in got
+            rs.close()
+        finally:
+            srv.stop()
