@@ -6,12 +6,22 @@ plane, receives Works over the watch stream, applies them to its member,
 reflects status, and heartbeats its lease. Here the member is the
 in-memory simulator (the framework's member-cluster substrate); everything
 crosses the real network boundary via RemoteStore.
+
+Leader election (agent.go runs behind the same leaderelection package):
+two agents started for one --cluster compete for the
+`karmada-agent-<cluster>` LeaderLease — only the holder registers,
+heartbeats, and applies Works; the standby idles until promoted, so a
+member's heartbeat never comes from two processes at once and the active
+agent's status writes are fenced.
 """
 from __future__ import annotations
 
 import argparse
+import os
 import signal
 import sys
+import threading
+import time
 
 
 def main() -> None:
@@ -32,6 +42,15 @@ def main() -> None:
                     help="daemon --token-file credential (KARMADA_TOKEN)")
     ap.add_argument("--cacert", default="",
                     help="daemon --tls-dir ca.pem (KARMADA_CACERT)")
+    ap.add_argument("--no-leader-elect", action="store_true",
+                    help="skip the per-cluster agent election (UNSAFE with "
+                         "two agents for one cluster)")
+    ap.add_argument("--lease-duration", type=float, default=10.0)
+    ap.add_argument("--identity", default="",
+                    help="election identity (default hostname_pid)")
+    ap.add_argument("--metrics-port", type=int, default=0,
+                    help="serve GET /metrics on this port (0 = ephemeral, "
+                         "printed on stdout; -1 disables)")
     args = ap.parse_args()
 
     # host-plane process: never let an ambient TPU backend init block startup
@@ -39,12 +58,14 @@ def main() -> None:
 
     force_cpu_mesh(1)
 
-    import os
-
+    from ..api.coordination import agent_lease_name
     from ..api.meta import CPU, MEMORY
+    from ..coordination.elector import Elector, default_identity
     from ..members.member import MemberConfig
+    from ..server.metricsserver import start_metrics_server
     from .remote_agent import RemoteAgentSession
 
+    token = args.bearer_token or os.environ.get("KARMADA_TOKEN") or None
     GiB = 1024.0**3
     session = RemoteAgentSession(
         args.server,
@@ -54,21 +75,74 @@ def main() -> None:
             allocatable={CPU: args.cpu, MEMORY: args.memory_gib * GiB,
                          "pods": args.pods},
         ),
-        token=args.bearer_token or os.environ.get("KARMADA_TOKEN") or None,
+        token=token,
         cafile=args.cacert or os.environ.get("KARMADA_CACERT") or None,
     )
-    session.register()
-    session.run(interval=args.interval)
-    print(f"agent {args.cluster} registered with {args.server}", flush=True)
+    metrics_srv = start_metrics_server(args.metrics_port, token=token)
+
+    lease = agent_lease_name(args.cluster)
+    identity = args.identity or default_identity()
+    leading = threading.Event()
+    registered = threading.Event()
+    elector = None
+
+    def announce_active() -> None:
+        session.register()
+        registered.set()
+        print(f"agent {args.cluster} registered with {args.server}",
+              flush=True)
+
+    if args.no_leader_elect:
+        leading.set()
+    else:
+        def started(token_: int) -> None:
+            session.store.set_fence(lease, token_)
+            leading.set()
+            print(f"leader: {identity} acquired lease {lease} "
+                  f"(fencing token {token_})", flush=True)
+
+        def stopped(reason: str) -> None:
+            leading.clear()
+            session.store.clear_fence()
+            print(f"leader: {identity} lost lease {lease} ({reason})",
+                  flush=True)
+
+        elector = Elector(
+            session.store, lease, identity,
+            lease_duration=args.lease_duration,
+            on_started_leading=started, on_stopped_leading=stopped,
+        )
+        elector.step()  # lone agent becomes active before the first print
+        elector.run()
+        if not leading.is_set():
+            print(f"agent {args.cluster} standing by for lease {lease}",
+                  flush=True)
 
     stop = []
     signal.signal(signal.SIGTERM, lambda *a: stop.append(1))
     try:
         while not stop:
-            signal.pause()
+            if leading.is_set():
+                try:
+                    if not registered.is_set():
+                        announce_active()
+                    session.step()
+                except Exception:  # noqa: BLE001 - agent must keep serving
+                    import logging
+
+                    logging.getLogger(__name__).exception("agent step")
+                time.sleep(args.interval)
+            else:
+                # standby: wake promptly on promotion
+                leading.wait(args.interval)
     except KeyboardInterrupt:
         pass
-    session.close()
+    finally:
+        if elector is not None:
+            elector.stop(release=True)
+        if metrics_srv is not None:
+            metrics_srv.stop()
+        session.close()
 
 
 if __name__ == "__main__":

@@ -377,6 +377,7 @@ _KIND_ALIASES = {
     "op": "OverridePolicy", "overridepolicy": "OverridePolicy",
     "overridepolicies": "OverridePolicy",
     "event": "Event", "events": "Event",
+    "leaderlease": "LeaderLease", "leaderleases": "LeaderLease",
     "deployment": "apps/v1/Deployment", "deployments": "apps/v1/Deployment",
 }
 
@@ -530,6 +531,8 @@ def cmd_get(cp: ControlPlane, kind: str, name: str = "", namespace: str = "",
             for e in objs
         ]
         return _fmt_table(rows, ["KIND", "OBJECT", "TYPE", "REASON", "COUNT"])
+    if resolved == "LeaderLease":
+        return _elections_table(objs, wide=wide)
     rows = [
         [getattr(o.metadata, "namespace", "") or "-", o.metadata.name]
         for o in sorted(objs, key=lambda o: (o.metadata.namespace, o.metadata.name))
@@ -1026,6 +1029,45 @@ def cmd_addons(cp: ControlPlane) -> str:
     return _fmt_table(rows, ["ADDON", "STATUS"])
 
 
+def _elections_table(leases, wide: bool = False) -> str:
+    """Shared LeaderLease table (the `elections` verb and `get
+    leaderleases` print the same columns)."""
+    import time as _time
+
+    rows = []
+    now = _time.time()
+    for l in sorted(leases, key=lambda l: (l.metadata.namespace,
+                                           l.metadata.name)):
+        s = l.spec
+        if not s.holder_identity:
+            state = "Released"
+        elif now - s.renew_time > s.lease_duration_seconds:
+            state = "Expired"
+        else:
+            state = "Active"
+        age = max(0.0, now - s.renew_time) if s.renew_time else 0.0
+        rows.append(
+            [l.metadata.name, s.holder_identity or "<none>", state,
+             str(s.fencing_token), str(s.lease_transitions), f"{age:.0f}s"]
+            + ([l.metadata.namespace,
+                f"{s.lease_duration_seconds:.0f}s"] if wide else [])
+        )
+    headers = ["NAME", "HOLDER", "STATE", "FENCING", "TRANSITIONS", "RENEWED"]
+    if wide:
+        headers += ["NAMESPACE", "TTL"]
+    return _fmt_table(rows, headers)
+
+
+def cmd_elections(cp: ControlPlane, wide: bool = False) -> str:
+    """`karmadactl elections` — who leads each daemon role (the
+    LeaderLease view of the coordination plane; docs/HA.md)."""
+    leases = cp.store.list("LeaderLease")
+    if not leases:
+        return ("No elections found: no daemon has acquired a LeaderLease "
+                "on this plane.")
+    return _elections_table(leases, wide=wide)
+
+
 def cmd_deschedule(cp: ControlPlane) -> str:
     n = cp.run_descheduler()
     return f"descheduled {n} binding(s)"
@@ -1117,6 +1159,9 @@ def run(cp: ControlPlane, argv: list[str]) -> str:
     p.add_argument("-C", "--cluster", required=True)
     p.add_argument("-n", "--namespace", default="")
     sub.add_parser("deschedule")
+    p = sub.add_parser("elections")
+    p.add_argument("-o", "--output", default="",
+                   help="'' (table) or wide")
     p = sub.add_parser("rebalance")
     p.add_argument("workloads", nargs="+", help="apiVersion:Kind:namespace:name")
     p = sub.add_parser("logs")
@@ -1277,6 +1322,8 @@ def run(cp: ControlPlane, argv: list[str]) -> str:
         return cmd_attach(cp, args.cluster, args.workload, args.namespace)
     if args.command == "deschedule":
         return cmd_deschedule(cp)
+    if args.command == "elections":
+        return cmd_elections(cp, wide=args.output == "wide")
     if args.command == "rebalance":
         workloads = []
         for w in args.workloads:

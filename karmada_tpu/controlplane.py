@@ -152,6 +152,11 @@ class ControlPlane:
 
         self.store = Store()
         self.runtime = Runtime(clock=clock)
+        # leader-election lease CAS + write fencing for the daemon topology
+        # (coordination/lease.py; served over /leases/* and X-Karmada-Fencing)
+        from .coordination.lease import LeaseCoordinator
+
+        self.coordinator = LeaseCoordinator(self.store, self.runtime.clock)
         self.gates = gates or FeatureGates()
         self.admission = default_admission_chain(self.gates)
         self.store.set_admission(self.admission.admit)

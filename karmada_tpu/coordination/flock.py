@@ -1,0 +1,51 @@
+"""Exclusive data-dir lock: a second server on one --data-dir fails fast.
+
+Two server processes appending the same WAL interleave records and corrupt
+each other's snapshots (etcd refuses a locked member directory the same
+way). `lock_data_dir` takes a non-blocking `flock(LOCK_EX)` on a lockfile
+inside the directory and holds it for the process lifetime — flock locks
+die with the holder, so a SIGKILL'd server never leaves a stale lock the
+way a pidfile would.
+"""
+from __future__ import annotations
+
+import os
+from typing import IO, Optional
+
+LOCK_FILE = ".lock"
+
+
+class DataDirLockedError(RuntimeError):
+    """Another live process holds the data directory."""
+
+
+def lock_data_dir(data_dir: str) -> Optional[IO[str]]:
+    """Acquire the exclusive lock on `data_dir`, creating it if needed.
+
+    Returns the open lockfile handle — the caller must keep it referenced
+    for the life of the process (closing it drops the lock). Raises
+    DataDirLockedError when another process holds it. On platforms without
+    flock (non-POSIX) returns None and the caller proceeds unlocked."""
+    try:
+        import fcntl
+    except ImportError:  # non-POSIX: no advisory locking available
+        return None
+    os.makedirs(data_dir, exist_ok=True)
+    path = os.path.join(data_dir, LOCK_FILE)
+    f = open(path, "a+")
+    try:
+        fcntl.flock(f.fileno(), fcntl.LOCK_EX | fcntl.LOCK_NB)
+    except OSError:
+        f.seek(0)
+        holder = f.read().strip() or "unknown pid"
+        f.close()
+        raise DataDirLockedError(
+            f"data dir {data_dir!r} is locked by another running server "
+            f"({holder}): two servers on one --data-dir would corrupt the "
+            f"WAL. Stop the other process or use a different --data-dir."
+        ) from None
+    f.seek(0)
+    f.truncate()
+    f.write(f"pid {os.getpid()}\n")
+    f.flush()
+    return f

@@ -33,9 +33,19 @@ def main() -> None:
     ap.add_argument("--threshold", type=float, default=300.0,
                     help="unschedulable-threshold seconds")
     ap.add_argument("--once", action="store_true",
-                    help="run one sweep and exit (prints the update count)")
+                    help="run one sweep and exit (prints the update count); "
+                         "operator-invoked, so it skips leader election")
     ap.add_argument("--bearer-token", default="")
     ap.add_argument("--cacert", default="")
+    ap.add_argument("--no-leader-elect", action="store_true",
+                    help="sweep without holding the karmada-descheduler "
+                         "lease (UNSAFE with more than one instance)")
+    ap.add_argument("--lease-duration", type=float, default=15.0)
+    ap.add_argument("--identity", default="",
+                    help="election identity (default hostname_pid)")
+    ap.add_argument("--metrics-port", type=int, default=0,
+                    help="serve GET /metrics on this port (0 = ephemeral, "
+                         "printed on stdout; -1 disables)")
     args = ap.parse_args()
 
     # host-plane process: never let an ambient TPU backend init block startup
@@ -56,9 +66,10 @@ def main() -> None:
             "scheduler-estimator", GrpcSchedulerEstimator(addresses.get)
         )
 
+    token = args.bearer_token or os.environ.get("KARMADA_TOKEN") or None
     store = RemoteStore(
         args.server,
-        token=args.bearer_token or os.environ.get("KARMADA_TOKEN") or None,
+        token=token,
         cafile=args.cacert or os.environ.get("KARMADA_CACERT") or None,
     )
     d = Descheduler(store, registry, interval=args.interval,
@@ -67,21 +78,53 @@ def main() -> None:
         n = d.deschedule_once()
         print(f"descheduled {n} binding(s)", flush=True)
         return
+
+    from ..api.coordination import LEASE_DESCHEDULER
+    from ..coordination.elector import Elector, default_identity
+    from ..server.metricsserver import start_metrics_server
+
+    metrics_srv = start_metrics_server(args.metrics_port, token=token)
+    identity = args.identity or default_identity()
+    elector = None
+    if not args.no_leader_elect:
+        def started(token_: int) -> None:
+            store.set_fence(LEASE_DESCHEDULER, token_)
+            print(f"leader: {identity} acquired lease {LEASE_DESCHEDULER} "
+                  f"(fencing token {token_})", flush=True)
+
+        def stopped(reason: str) -> None:
+            store.clear_fence()
+            print(f"leader: {identity} lost lease {LEASE_DESCHEDULER} "
+                  f"({reason})", flush=True)
+
+        elector = Elector(
+            store, LEASE_DESCHEDULER, identity,
+            lease_duration=args.lease_duration,
+            on_started_leading=started, on_stopped_leading=stopped,
+        )
+        elector.step()
+        elector.run()
     print(f"karmada-tpu descheduler sweeping {args.server} "
           f"every {args.interval:.0f}s", flush=True)
     try:
         while True:
-            try:
-                n = d.deschedule_once()
-                if n:
-                    print(f"descheduled {n} binding(s)", flush=True)
-            except Exception:  # noqa: BLE001 - survive transient plane errors
-                import logging
+            if elector is None or elector.is_leader:
+                try:
+                    n = d.deschedule_once()
+                    if n:
+                        print(f"descheduled {n} binding(s)", flush=True)
+                except Exception:  # noqa: BLE001 - survive transient errors
+                    import logging
 
-                logging.getLogger(__name__).exception("descheduling sweep")
+                    logging.getLogger(__name__).exception("descheduling sweep")
             time.sleep(args.interval)
     except KeyboardInterrupt:
         pass
+    finally:
+        if elector is not None:
+            elector.stop(release=True)
+        if metrics_srv is not None:
+            metrics_srv.stop()
 
 
 if __name__ == "__main__":

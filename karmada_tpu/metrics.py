@@ -196,6 +196,21 @@ descheduler_sweeps = registry.counter(
     "Number of descheduling sweeps",
 )
 
+# leader election (coordination/elector.py); mirrors client-go's
+# leader_election_master_status + rest of the election metric family
+leader_election_is_leader = registry.gauge(
+    "karmada_leader_election_is_leader",
+    "1 while this process holds the named lease, else 0",
+)
+leader_election_transitions = registry.counter(
+    "karmada_leader_election_transitions_total",
+    "Times this process acquired leadership of the named lease",
+)
+leader_election_renew_duration = registry.histogram(
+    "karmada_leader_election_renew_duration_seconds",
+    "Lease renew round-trip latency in seconds",
+)
+
 
 class timed:
     """Context manager observing wall time into a histogram."""

@@ -9,16 +9,25 @@ serving API. ResourceBinding/Cluster watches stream in over HTTP
 per-cluster scheduler-estimators are reached over the wire-compatible
 gRPC client.
 
-Example:
+Leader election (reference: scheduler.go:33-34,188 — the binary refuses to
+schedule until it holds the lock): every instance competes for the
+`karmada-scheduler` LeaderLease; only the leader drains the queue and
+patches placements, and its writes carry the lease's fencing token so a
+deposed leader's in-flight patches bounce with 409. Non-leaders run HOT:
+watches attached, fleet encoders built, jit cache primed by a dry solve —
+takeover happens within one lease TTL with no cold-start.
+
+Example (HA pair):
     python -m karmada_tpu.server --controllers "*,-scheduler" &
-    python -m karmada_tpu.sched --server http://127.0.0.1:<port> \\
-        --estimator m1=127.0.0.1:10352
+    python -m karmada_tpu.sched --server http://127.0.0.1:<port> &
+    python -m karmada_tpu.sched --server http://127.0.0.1:<port> &
 """
 from __future__ import annotations
 
 import argparse
 import os
 import sys
+import threading
 import time
 
 
@@ -41,6 +50,22 @@ def main() -> None:
                          "ambient backend (TPU where available)")
     ap.add_argument("--bearer-token", default="")
     ap.add_argument("--cacert", default="")
+    ap.add_argument("--no-leader-elect", action="store_true",
+                    help="skip leader election and always schedule "
+                         "(single-instance legacy topology; UNSAFE with "
+                         "more than one scheduler daemon)")
+    ap.add_argument("--lease-name", default="",
+                    help="election lease name (default karmada-scheduler; "
+                         "one lease per --scheduler-name partition)")
+    ap.add_argument("--lease-duration", type=float, default=10.0,
+                    help="lease TTL seconds; takeover happens within this")
+    ap.add_argument("--renew-interval", type=float, default=0.0,
+                    help="seconds between renews (default TTL/3)")
+    ap.add_argument("--identity", default="",
+                    help="election identity (default hostname_pid)")
+    ap.add_argument("--metrics-port", type=int, default=0,
+                    help="serve GET /metrics on this port (0 = ephemeral, "
+                         "printed on stdout; -1 disables)")
     args = ap.parse_args()
 
     if args.platform == "cpu":
@@ -52,8 +77,11 @@ def main() -> None:
 
         jax.config.update("jax_platforms", args.platform)
 
+    from ..api.coordination import LEASE_SCHEDULER
+    from ..coordination.elector import Elector, default_identity
     from ..estimator.client import EstimatorRegistry, parse_estimator_flags
     from ..runtime.controller import Runtime
+    from ..server.metricsserver import start_metrics_server
     from ..server.remote import RemoteStore
     from .scheduler import SchedulerDaemon
 
@@ -67,30 +95,73 @@ def main() -> None:
             "scheduler-estimator", GrpcSchedulerEstimator(addresses.get)
         )
 
+    token = args.bearer_token or os.environ.get("KARMADA_TOKEN") or None
     store = RemoteStore(
         args.server,
-        token=args.bearer_token or os.environ.get("KARMADA_TOKEN") or None,
+        token=token,
         cafile=args.cacert or os.environ.get("KARMADA_CACERT") or None,
     )
     runtime = Runtime()
     plugins = [p.strip() for p in args.plugins.split(",") if p.strip()]
-    SchedulerDaemon(
+    daemon = SchedulerDaemon(
         store, runtime, scheduler_name=args.scheduler_name,
         estimator_registry=registry, plugins=plugins,
     )
+    metrics_srv = start_metrics_server(args.metrics_port, token=token)
+
+    lease_name = args.lease_name or (
+        LEASE_SCHEDULER if args.scheduler_name == "default-scheduler"
+        else f"karmada-scheduler-{args.scheduler_name}"
+    )
+    identity = args.identity or default_identity()
+    leading = threading.Event()
+    elector = None
+    if args.no_leader_elect:
+        leading.set()
+    else:
+        def started(token_: int) -> None:
+            store.set_fence(lease_name, token_)
+            leading.set()
+            print(f"leader: {identity} acquired lease {lease_name} "
+                  f"(fencing token {token_})", flush=True)
+
+        def stopped(reason: str) -> None:
+            leading.clear()
+            store.clear_fence()
+            print(f"leader: {identity} lost lease {lease_name} ({reason})",
+                  flush=True)
+
+        elector = Elector(
+            store, lease_name, identity,
+            lease_duration=args.lease_duration,
+            renew_interval=args.renew_interval or None,
+            on_started_leading=started, on_stopped_leading=stopped,
+        )
+        elector.step()  # synchronous first try: a lone daemon leads at once
+        elector.run()
+
     print(f"karmada-tpu scheduler attached to {args.server}", flush=True)
+    # hot standby: encoders + jit cache warm before (and while) not leading
+    daemon.prewarm()
     try:
         while True:
-            try:
-                runtime.settle()
-            except Exception:  # noqa: BLE001 - survive transient plane errors
-                import logging
+            if leading.is_set():
+                try:
+                    runtime.settle()
+                except Exception:  # noqa: BLE001 - survive transient errors
+                    import logging
 
-                logging.getLogger(__name__).exception("scheduling drain")
+                    logging.getLogger(__name__).exception("scheduling drain")
+            else:
+                daemon.prewarm()  # re-warm on cluster churn while standing by
             time.sleep(args.interval)
     except KeyboardInterrupt:
         pass
     finally:
+        if elector is not None:
+            elector.stop(release=True)
+        if metrics_srv is not None:
+            metrics_srv.stop()
         store.close()
 
 

@@ -70,6 +70,7 @@ class SchedulerDaemon:
         self.plugin_registry = plugin_registry
         self._array: Optional[ArrayScheduler] = None
         self._fleet_dirty = True
+        self._prewarmed_epoch = -1
         # names of clusters MODIFIED since the last fleet encode; None means
         # the membership changed (add/delete) and the next encode must be a
         # full rebuild instead of the dirty-column scatter
@@ -171,6 +172,48 @@ class SchedulerDaemon:
                 # changes rebuild everything as before
                 self._array.set_clusters(clusters, dirty_names=dirty)
         return self._array
+
+    def prewarm(self) -> None:
+        """Hot-standby warmth (coordination plane): build the fleet encoders
+        and prime the solve's jit cache with a throwaway dry round, so a
+        standby promoted on leader death takes over within the lease TTL
+        instead of paying encoder + compile cold-start. Idempotent per fleet
+        epoch — cheap to call from the standby's idle loop; cluster churn
+        (which bumps the epoch via the watch handlers) re-warms."""
+        try:
+            array = self._ensure_fleet()
+            if not array.fleet.names:
+                return  # nothing to encode against yet
+            if self._prewarmed_epoch == array.fleet_epoch:
+                return
+            self._prewarmed_epoch = array.fleet_epoch
+            from ..api.meta import ObjectMeta
+            from ..api.policy import (
+                ClusterAffinity,
+                Placement,
+                ReplicaSchedulingStrategy,
+            )
+            from ..api.work import BindingSpec, ResourceBinding
+
+            dry = ResourceBinding(
+                metadata=ObjectMeta(name="__prewarm__"),
+                spec=BindingSpec(
+                    replicas=0,
+                    placement=Placement(
+                        cluster_affinity=ClusterAffinity(cluster_names=[]),
+                        replica_scheduling=ReplicaSchedulingStrategy(
+                            replica_scheduling_type=REPLICA_SCHEDULING_DUPLICATED
+                        ),
+                    ),
+                ),
+            )
+            # plain schedule(), NOT schedule_incremental: the dry decision
+            # must never enter the replay cache
+            array.schedule([dry])
+        except Exception:  # noqa: BLE001 - warmth is best-effort
+            import logging
+
+            logging.getLogger(__name__).exception("standby prewarm")
 
     def _schedule_batch(self, keys: list[str]) -> list[str]:
         bindings = []

@@ -38,16 +38,43 @@ def main() -> None:
                          "(ca.pem/server.pem/server.key; generated via the "
                          "cluster CA on first start — clients verify with "
                          "ca.pem); empty = plaintext HTTP")
+    ap.add_argument("--tls-san", action="append", default=[],
+                    metavar="NAME_OR_IP",
+                    help="extra subjectAltName for the serving cert; "
+                         "repeatable. Required for --host 0.0.0.0 "
+                         "deployments where clients dial a routable "
+                         "address the bind address doesn't name")
     ap.add_argument("--token-file", default="",
                     help="require 'Authorization: Bearer <token>' matching "
                          "this file's contents (generated on first start "
                          "if absent); empty = unauthenticated")
+    ap.add_argument("--insecure-token-ok", action="store_true",
+                    help="allow --token-file over plaintext HTTP on a "
+                         "non-loopback --host (the token crosses the "
+                         "network in the clear; refused otherwise)")
     ap.add_argument("--enable-test-clock", action="store_true",
                     help="allow POST /tick (advancing/freezing the plane's "
                          "Clock — test drivers only); disabled by default "
                          "so a production daemon's clock cannot be frozen "
                          "via the normal bearer token (403)")
     args = ap.parse_args()
+
+    # bearer tokens over plaintext HTTP on a routable interface leak the
+    # credential to the network (the reference never serves token authn
+    # without TLS) — refuse unless explicitly overridden (ADVICE r5 item 4)
+    loopback = args.host in ("127.0.0.1", "localhost", "::1")
+    if (args.token_file and not args.tls_dir and not loopback
+            and not args.insecure_token_ok):
+        import sys
+
+        print(
+            f"fatal: --token-file with plaintext HTTP on non-loopback host "
+            f"{args.host!r} would transmit the bearer token in the clear. "
+            f"Add --tls-dir, bind a loopback --host, or pass "
+            f"--insecure-token-ok to accept the risk.",
+            file=sys.stderr, flush=True,
+        )
+        raise SystemExit(2)
 
     if args.platform == "cpu":
         # offline/e2e mode: never touch the (possibly hung) TPU tunnel;
@@ -67,9 +94,18 @@ def main() -> None:
 
     cp = ControlPlane(controllers=args.controllers.split(","))
     persistence = None
+    _data_dir_lock = None  # held for the process lifetime
     if args.data_dir:
+        from ..coordination.flock import DataDirLockedError, lock_data_dir
         from ..store.persistence import StorePersistence
 
+        try:
+            _data_dir_lock = lock_data_dir(args.data_dir)
+        except DataDirLockedError as e:
+            import sys
+
+            print(f"fatal: {e}", file=sys.stderr, flush=True)
+            raise SystemExit(2)
         persistence = StorePersistence(cp.store, args.data_dir)
         n = persistence.load()  # controllers are subscribed: state replays
         persistence.attach()
@@ -89,7 +125,8 @@ def main() -> None:
     if args.tls_dir:
         from .tlsmaterial import ensure_server_tls
 
-        ssl_context = ensure_server_tls(args.tls_dir, args.host)
+        ssl_context = ensure_server_tls(args.tls_dir, args.host,
+                                        extra_sans=args.tls_san)
         print(f"tls: serving with material from {args.tls_dir} "
               f"(clients: --cacert {args.tls_dir}/ca.pem)", flush=True)
     token = None
@@ -106,9 +143,31 @@ def main() -> None:
     srv.start()
     print(f"karmada-tpu control plane serving on {srv.url}", flush=True)
 
+    # The controller-manager role elects even single-instance (reference:
+    # controllermanager.go:154-155 — LeaderElect defaults on). Against this
+    # server's own store it wins immediately; the lease makes the role
+    # visible in `karmadactl elections` and gates the timer loops the same
+    # way a multi-instance deployment would.
+    from ..api.coordination import LEASE_CONTROLLER_MANAGER
+    from ..coordination.elector import (
+        Elector,
+        LocalLeaseClient,
+        default_identity,
+    )
+
+    elector = Elector(
+        LocalLeaseClient(cp.coordinator),
+        LEASE_CONTROLLER_MANAGER,
+        default_identity(),
+    )
+    elector.step()
+    elector.run()
+
     def ticker() -> None:
         while True:
             time.sleep(args.tick_interval)
+            if not elector.is_leader:
+                continue  # standby: watch streams still serve, timers idle
             with srv._settle_lock:
                 try:
                     cp.tick(0.0)
@@ -124,6 +183,7 @@ def main() -> None:
         while True:
             time.sleep(3600)
     except KeyboardInterrupt:
+        elector.stop(release=True)
         srv.stop()
         if persistence is not None:
             persistence.snapshot()

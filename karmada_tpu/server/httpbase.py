@@ -41,6 +41,22 @@ def read_json(handler: BaseHTTPRequestHandler) -> dict:
     return json.loads(handler.rfile.read(n).decode())
 
 
+def send_prometheus(handler: BaseHTTPRequestHandler, text: str) -> None:
+    """Prometheus text-exposition reply — the one place the content-type
+    version and framing live (used by the apiserver /metrics route and the
+    per-daemon MetricsServer)."""
+    try:
+        data = text.encode()
+        handler.send_response(200)
+        handler.send_header("Content-Type",
+                            "text/plain; version=0.0.4; charset=utf-8")
+        handler.send_header("Content-Length", str(len(data)))
+        handler.end_headers()
+        handler.wfile.write(data)
+    except (BrokenPipeError, ConnectionResetError):
+        pass
+
+
 # an unauthenticated peer may drain at most this much; anything larger gets
 # the connection torn down instead of read (the bytes were never paid for)
 DRAIN_BODY_MAX = 1 << 20

@@ -51,13 +51,37 @@ class RemoteStore:
         self._watch_threads: list[threading.Thread] = []
         self._streams: list[tuple[str, Any, threading.Event]] = []
         self._closed = False
+        # leader-election fence: while set, every request carries
+        # X-Karmada-Fencing so a deposed holder's writes bounce with 409
+        self._fence: Optional[str] = None
 
     # -- transport --------------------------------------------------------
+
+    def set_fence(self, lease_name: str, token: int,
+                  namespace: str = "") -> None:
+        """Stamp subsequent requests with this lease's fencing token (the
+        elector's on_started_leading hook). token 0 clears (legacy planes
+        without a lease API mint no tokens)."""
+        from ..coordination.lease import format_fence_header
+
+        if not token:
+            self._fence = None
+            return
+        from ..api.coordination import LEADER_LEASE_NAMESPACE
+
+        self._fence = format_fence_header(
+            lease_name, token, namespace or LEADER_LEASE_NAMESPACE
+        )
+
+    def clear_fence(self) -> None:
+        self._fence = None
 
     def _headers(self, with_content: bool) -> dict:
         headers = {"Content-Type": "application/json"} if with_content else {}
         if self.token:
             headers["Authorization"] = f"Bearer {self.token}"
+        if self._fence:
+            headers["X-Karmada-Fencing"] = self._fence
         return headers
 
     def _call(self, method: str, path: str, body: Optional[dict] = None) -> dict:
@@ -125,6 +149,36 @@ class RemoteStore:
 
     def kinds(self) -> list[str]:
         return self._call("GET", "/kinds")["kinds"]
+
+    # -- leader election (the Elector's lease-client protocol) ------------
+
+    def acquire_lease(self, name: str, identity: str,
+                      duration: float = 0.0, namespace: str = ""):
+        body = {"name": name, "identity": identity}
+        if duration:
+            body["duration"] = duration
+        if namespace:
+            body["namespace"] = namespace
+        out = self._call("POST", "/leases/acquire", body)
+        return codec.decode(out["lease"]), bool(out["acquired"])
+
+    def renew_lease(self, name: str, identity: str, token: int,
+                    namespace: str = ""):
+        body = {"name": name, "identity": identity, "token": token}
+        if namespace:
+            body["namespace"] = namespace
+        return codec.decode(self._call("POST", "/leases/renew", body)["lease"])
+
+    def release_lease(self, name: str, identity: str, token: int,
+                      namespace: str = "") -> None:
+        body = {"name": name, "identity": identity, "token": token}
+        if namespace:
+            body["namespace"] = namespace
+        self._call("POST", "/leases/release", body)
+
+    def elections(self) -> list[Any]:
+        return [codec.decode(x)
+                for x in self._call("GET", "/elections")["items"]]
 
     # -- watch ------------------------------------------------------------
 

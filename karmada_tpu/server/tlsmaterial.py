@@ -11,13 +11,22 @@ from __future__ import annotations
 
 import os
 import secrets
+import sys
+from typing import Iterable
 
 
 def _cert_covers_host(cert_path: str, host: str) -> bool:
+    """True when the cert's SANs include `host`. Corrupt or truncated PEM
+    (a half-written tls dir) reads as not-covering, so the caller's
+    regeneration path replaces it instead of the daemon crashing on boot
+    (ADVICE r5 item 5)."""
     from cryptography import x509
 
-    with open(cert_path, "rb") as f:
-        cert = x509.load_pem_x509_certificate(f.read())
+    try:
+        with open(cert_path, "rb") as f:
+            cert = x509.load_pem_x509_certificate(f.read())
+    except (ValueError, OSError):
+        return False
     try:
         sans = cert.extensions.get_extension_for_class(
             x509.SubjectAlternativeName
@@ -29,29 +38,50 @@ def _cert_covers_host(cert_path: str, host: str) -> bool:
     return host in names
 
 
-def ensure_server_tls(tls_dir: str, host: str):
+def ensure_server_tls(tls_dir: str, host: str,
+                      extra_sans: Iterable[str] = ()):
     """Return an ssl.SSLContext serving cert material from tls_dir.
 
     Reuses existing ca.pem/server.pem/server.key (so client-held ca.pem
     copies stay valid across restarts); generates all three when any is
-    missing OR the existing cert's SANs don't cover `host` (a daemon moved
-    from loopback to a routable --host needs a new cert, and the CA key is
-    not persisted, so regeneration is a full re-issue — clients must
-    re-pin the new ca.pem)."""
+    missing OR the existing cert's SANs don't cover `host` or any of
+    `extra_sans` (the daemon's --tls-san list — with `--host 0.0.0.0` the
+    bind address says nothing about the names clients dial, so routable
+    addresses must be named explicitly).
+
+    Regeneration over EXISTING material is a re-issue from a brand-new CA
+    (the CA key is never persisted): every client's pinned ca.pem copy
+    becomes invalid, so it happens with a prominent warning (ADVICE r5
+    item 3) instead of silently."""
     import ssl
 
     os.makedirs(tls_dir, exist_ok=True)
     ca_path = os.path.join(tls_dir, "ca.pem")
     cert_path = os.path.join(tls_dir, "server.pem")
     key_path = os.path.join(tls_dir, "server.key")
+    wanted = [host, *[s for s in extra_sans if s]]
     complete = all(
         os.path.exists(p) for p in (ca_path, cert_path, key_path)
     )
-    if not complete or not _cert_covers_host(cert_path, host):
+    covered = complete and all(
+        _cert_covers_host(cert_path, h) for h in wanted
+    )
+    if not covered:
+        if complete:
+            missing = [h for h in wanted
+                       if not _cert_covers_host(cert_path, h)]
+            print(
+                f"tls: WARNING regenerating ALL material in {tls_dir} — the "
+                f"existing server.pem does not cover {missing} (corrupt, or "
+                f"the daemon moved hosts). The CA key is not persisted, so "
+                f"this mints a NEW cluster CA: every client pinning the old "
+                f"{ca_path} must re-fetch it or verification will fail.",
+                file=sys.stderr, flush=True,
+            )
         from ..auth.pki import CertificateAuthority
 
         ca = CertificateAuthority(common_name="karmada-tpu-ca")
-        sans = tuple(dict.fromkeys((host, "localhost", "127.0.0.1")))
+        sans = tuple(dict.fromkeys((*wanted, "localhost", "127.0.0.1")))
         issued = ca.sign("karmada-tpu-apiserver", dns_names=sans)
         with open(ca_path, "wb") as f:
             f.write(ca.ca_pem)

@@ -1,0 +1,83 @@
+#!/usr/bin/env bash
+# HA smoke: boot a scheduler-less control plane + TWO scheduler daemons,
+# kill the leader with SIGKILL, and assert the standby takes over within a
+# few lease TTLs — observed via each daemon's /metrics surface
+# (karmada_leader_election_is_leader). Exit 0 prints "TAKEOVER OK".
+#
+# Wired into the soak path as tests/test_coordination.py::TestHASmokeScript
+# (pytest -m slow). Runs on CPU; needs no accelerator.
+set -euo pipefail
+
+cd "$(dirname "$0")/.."
+PY=${PYTHON:-python}
+WORK=$(mktemp -d /tmp/ha_smoke.XXXXXX)
+M1=$((21000 + RANDOM % 20000))
+M2=$((M1 + 1))
+PIDS=()
+
+cleanup() {
+    for pid in "${PIDS[@]:-}"; do kill -9 "$pid" 2>/dev/null || true; done
+    rm -rf "$WORK"
+}
+trap cleanup EXIT
+
+log() { echo "ha_smoke: $*"; }
+
+# --- control plane (scheduler-less: the daemons own scheduling) -----------
+$PY -m karmada_tpu.server --platform cpu --members 2 \
+    --controllers '*,-scheduler' --tick-interval 0.5 \
+    > "$WORK/server.log" 2>&1 &
+PIDS+=($!)
+for _ in $(seq 1 120); do
+    URL=$(grep -oE 'http://[0-9.]+:[0-9]+' "$WORK/server.log" | head -1 || true)
+    [ -n "${URL:-}" ] && break
+    sleep 0.5
+done
+[ -n "${URL:-}" ] || { log "server never came up"; cat "$WORK/server.log"; exit 1; }
+log "control plane at $URL"
+
+# --- two scheduler daemons, short lease so takeover is quick --------------
+start_sched() { # $1 identity, $2 metrics port
+    $PY -m karmada_tpu.sched --server "$URL" --platform cpu \
+        --identity "$1" --lease-duration 3 --metrics-port "$2" \
+        > "$WORK/$1.log" 2>&1 &
+    PIDS+=($!)
+    eval "PID_$1=$!"
+}
+start_sched schedA "$M1"
+start_sched schedB "$M2"
+
+is_leader() { # $1 metrics port -> 0 when this daemon reports leadership
+    curl -sf "http://127.0.0.1:$1/metrics" 2>/dev/null \
+        | grep -E '^karmada_leader_election_is_leader\{[^}]*\} 1(\.0)?$' \
+        > /dev/null
+}
+
+leader_port=""
+for _ in $(seq 1 120); do
+    if is_leader "$M1"; then leader_port=$M1; break; fi
+    if is_leader "$M2"; then leader_port=$M2; break; fi
+    sleep 0.5
+done
+[ -n "$leader_port" ] || {
+    log "no scheduler took the lease"; tail -5 "$WORK"/sched*.log; exit 1; }
+
+if [ "$leader_port" = "$M1" ]; then
+    victim=$PID_schedA; survivor_port=$M2; survivor=schedB
+else
+    victim=$PID_schedB; survivor_port=$M1; survivor=schedA
+fi
+log "leader on metrics port $leader_port (pid $victim); killing -9"
+kill -9 "$victim"
+
+# takeover must land within a few TTLs (lease-duration 3s)
+for _ in $(seq 1 60); do
+    if is_leader "$survivor_port"; then
+        log "standby $survivor promoted"
+        echo "TAKEOVER OK"
+        exit 0
+    fi
+    sleep 0.5
+done
+log "standby never promoted"; tail -5 "$WORK/$survivor.log"
+exit 1
