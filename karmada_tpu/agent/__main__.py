@@ -51,6 +51,10 @@ def main() -> None:
     ap.add_argument("--metrics-port", type=int, default=0,
                     help="serve GET /metrics on this port (0 = ephemeral, "
                          "printed on stdout; -1 disables)")
+    ap.add_argument("--scrape-token-file", default="",
+                    help="dedicated READ-ONLY token accepted on GET "
+                         "/metrics only (the Prometheus credential no "
+                         "longer needs to be the full wire token)")
     args = ap.parse_args()
 
     # host-plane process: never let an ambient TPU backend init block startup
@@ -78,7 +82,10 @@ def main() -> None:
         token=token,
         cafile=args.cacert or os.environ.get("KARMADA_CACERT") or None,
     )
-    metrics_srv = start_metrics_server(args.metrics_port, token=token)
+    metrics_srv = start_metrics_server(
+        args.metrics_port, token=token,
+        scrape_token_file=args.scrape_token_file,
+    )
 
     lease = agent_lease_name(args.cluster)
     identity = args.identity or default_identity()

@@ -378,6 +378,9 @@ _KIND_ALIASES = {
     "overridepolicies": "OverridePolicy",
     "event": "Event", "events": "Event",
     "leaderlease": "LeaderLease", "leaderleases": "LeaderLease",
+    "simulationreport": "SimulationReport",
+    "simulationreports": "SimulationReport",
+    "simreport": "SimulationReport", "simreports": "SimulationReport",
     "deployment": "apps/v1/Deployment", "deployments": "apps/v1/Deployment",
 }
 
@@ -533,6 +536,8 @@ def cmd_get(cp: ControlPlane, kind: str, name: str = "", namespace: str = "",
         return _fmt_table(rows, ["KIND", "OBJECT", "TYPE", "REASON", "COUNT"])
     if resolved == "LeaderLease":
         return _elections_table(objs, wide=wide)
+    if resolved == "SimulationReport":
+        return _simulation_reports_table(objs, wide=wide)
     rows = [
         [getattr(o.metadata, "namespace", "") or "-", o.metadata.name]
         for o in sorted(objs, key=lambda o: (o.metadata.namespace, o.metadata.name))
@@ -1068,7 +1073,198 @@ def cmd_elections(cp: ControlPlane, wide: bool = False) -> str:
     return _elections_table(leases, wide=wide)
 
 
-def cmd_deschedule(cp: ControlPlane) -> str:
+def _simulation_reports_table(reports, wide: bool = False) -> str:
+    """Shared SimulationReport table (`get simulationreports`)."""
+    rows = []
+    for r in sorted(reports, key=lambda r: r.metadata.resource_version):
+        displaced = sum(s.displaced for s in r.scenarios)
+        unplaceable = sum(s.unplaceable for s in r.scenarios)
+        row = [
+            r.metadata.name,
+            str(len(r.scenarios)),
+            str(displaced),
+            str(unplaceable),
+        ]
+        if wide:
+            row += [
+                str(r.bindings),
+                str(r.clusters),
+                f"{r.batched_solves}/{r.fallback_solves}",
+            ]
+        rows.append(row)
+    headers = ["NAME", "SCENARIOS", "DISPLACED", "UNPLACEABLE"]
+    if wide:
+        headers += ["BINDINGS", "CLUSTERS", "SOLVES(B/F)"]
+    return _fmt_table(rows, headers)
+
+
+def _format_targets(targets) -> str:
+    if not targets:
+        return "<none>"
+    return ",".join(f"{t.name}:{t.replicas}" for t in targets)
+
+
+def format_simulation_report(report, details: int = 3) -> str:
+    """Diff-style printer for a SimulationReport: one summary row per
+    scenario plus up to `details` displaced-binding diff lines each
+    (`~` = moved, `!` = went unplaceable)."""
+    rows = [
+        [
+            s.scenario.label(),
+            str(s.displaced),
+            str(s.unplaceable),
+            ",".join(s.overcommitted) or "-",
+        ]
+        for s in report.scenarios
+    ]
+    out = [_fmt_table(rows, ["SCENARIO", "DISPLACED", "UNPLACEABLE",
+                             "OVERCOMMITTED"])]
+    for s in report.scenarios:
+        shown = s.diffs[:details] if details >= 0 else s.diffs
+        lines = []
+        for d in shown:
+            if d.error:
+                lines.append(f"  ! {d.binding}  {d.error}")
+            else:
+                lines.append(
+                    f"  ~ {d.binding}  {_format_targets(d.before)} -> "
+                    f"{_format_targets(d.after)}"
+                )
+        if lines:
+            out.append(f"{s.scenario.label()}:")
+            out.extend(lines)
+            hidden = s.displaced - len(shown)
+            if hidden > 0:
+                out.append(f"  ... and {hidden} more")
+    return "\n".join(out)
+
+
+def _parse_scenarios(drains, losses, taints, capacities, surges) -> list:
+    """Flag syntax → Scenario objects:
+      --drain CLUSTER
+      --loss CLUSTER
+      --taint CLUSTER:key[=value][:Effect]
+      --capacity CLUSTER:res=+delta[,res=delta...]
+      --surge N[:replicas=R][:cpu=X][:memory=Y]
+    """
+    from ..api.simulation import (
+        SCENARIO_CAPACITY,
+        SCENARIO_DRAIN,
+        SCENARIO_LOSS,
+        SCENARIO_SURGE,
+        SCENARIO_TAINT,
+        Scenario,
+    )
+
+    scenarios = []
+    for c in drains:
+        scenarios.append(Scenario(kind=SCENARIO_DRAIN, cluster=c))
+    for c in losses:
+        scenarios.append(Scenario(kind=SCENARIO_LOSS, cluster=c))
+    for spec in taints:
+        parts = spec.split(":")
+        if len(parts) < 2:
+            raise CLIError(f"--taint {spec!r}: want CLUSTER:key[=value][:Effect]")
+        cluster, kv = parts[0], parts[1]
+        effect = parts[2] if len(parts) > 2 else "NoSchedule"
+        key, _, value = kv.partition("=")
+        scenarios.append(Scenario(
+            kind=SCENARIO_TAINT, cluster=cluster, taint_key=key,
+            taint_value=value, taint_effect=effect,
+        ))
+    for spec in capacities:
+        cluster, sep, deltas = spec.partition(":")
+        if not sep or not deltas:
+            raise CLIError(
+                f"--capacity {spec!r}: want CLUSTER:res=+delta[,res=delta]"
+            )
+        resources = {}
+        for item in deltas.split(","):
+            rname, s2, val = item.partition("=")
+            if not s2:
+                raise CLIError(f"--capacity {spec!r}: bad delta {item!r}")
+            try:
+                resources[rname] = float(val)
+            except ValueError:
+                raise CLIError(f"--capacity {spec!r}: bad number {val!r}")
+        scenarios.append(Scenario(
+            kind=SCENARIO_CAPACITY, cluster=cluster, resources=resources,
+        ))
+    for spec in surges:
+        parts = spec.split(":")
+        try:
+            count = int(parts[0])
+        except ValueError:
+            raise CLIError(f"--surge {spec!r}: want N[:replicas=R][:cpu=X]")
+        replicas, request = 1, {}
+        for item in parts[1:]:
+            k, s2, v = item.partition("=")
+            if not s2:
+                raise CLIError(f"--surge {spec!r}: bad option {item!r}")
+            try:
+                if k == "replicas":
+                    replicas = int(v)
+                else:
+                    request[k] = float(v)
+            except ValueError:
+                raise CLIError(f"--surge {spec!r}: bad number {v!r}")
+        scenarios.append(Scenario(
+            kind=SCENARIO_SURGE, surge_count=count, surge_replicas=replicas,
+            surge_request=request,
+        ))
+    return scenarios
+
+
+def cmd_simulate(cp: ControlPlane, drains, losses, taints, capacities,
+                 surges, namespace: str = "", output: str = "",
+                 details: int = 3) -> str:
+    """`karmadactl simulate` — the what-if plane: evaluate drain/loss/taint/
+    capacity/surge counterfactuals against the live fleet in one batched
+    solve and print the displacement diff. Works identically in-process and
+    against a daemon (`--server` routes through POST /simulate)."""
+    from . import printers
+    from ..api.simulation import SimulationRequest, SimulationRequestSpec
+
+    try:
+        printers.check_output(output)
+    except printers.UnknownOutputFormat as e:
+        raise CLIError(str(e))
+    scenarios = _parse_scenarios(drains, losses, taints, capacities, surges)
+    if not scenarios:
+        raise CLIError(
+            "nothing to simulate: give at least one of --drain/--loss/"
+            "--taint/--capacity/--surge"
+        )
+    # --details N = diff lines per scenario; -1 = all (the report must then
+    # carry every diff, not the default window)
+    request = SimulationRequest(
+        spec=SimulationRequestSpec(
+            scenarios=scenarios, namespace=namespace,
+            diff_limit=(1 << 20) if details < 0 else details,
+        )
+    )
+    try:
+        report = cp.simulate(request)
+    except ValueError as e:  # SimulationError: unknown cluster etc.
+        raise CLIError(str(e))
+    if output in ("json", "yaml", "name"):
+        return printers.print_objs([report], output, kind="SimulationReport")
+    return format_simulation_report(report, details=details)
+
+
+def cmd_deschedule(cp: ControlPlane, dry_run: bool = False,
+                   details: int = 3) -> str:
+    if dry_run:
+        report = cp.run_descheduler_dryrun(
+            diff_limit=(1 << 20) if details < 0 else details
+        )
+        if not report.scenarios:
+            return "dry-run: nothing to deschedule"
+        header = (
+            f"dry-run: {report.bindings} binding(s) would be descheduled; "
+            "simulated re-placement:"
+        )
+        return header + "\n" + format_simulation_report(report, details=details)
     n = cp.run_descheduler()
     return f"descheduled {n} binding(s)"
 
@@ -1158,7 +1354,25 @@ def run(cp: ControlPlane, argv: list[str]) -> str:
     p.add_argument("name")
     p.add_argument("-C", "--cluster", required=True)
     p.add_argument("-n", "--namespace", default="")
-    sub.add_parser("deschedule")
+    p = sub.add_parser("deschedule")
+    p.add_argument("--dry-run", action="store_true",
+                   help="run the eviction set through the what-if simulator "
+                        "instead of patching bindings; prints the "
+                        "displacement report, mutates nothing")
+    p.add_argument("--details", type=int, default=3)
+    p = sub.add_parser("simulate")
+    p.add_argument("--drain", action="append", default=[], metavar="CLUSTER")
+    p.add_argument("--loss", action="append", default=[], metavar="CLUSTER")
+    p.add_argument("--taint", action="append", default=[],
+                   metavar="CLUSTER:key[=value][:Effect]")
+    p.add_argument("--capacity", action="append", default=[],
+                   metavar="CLUSTER:res=+delta[,res=delta]")
+    p.add_argument("--surge", action="append", default=[],
+                   metavar="N[:replicas=R][:cpu=X]")
+    p.add_argument("-n", "--namespace", default="")
+    p.add_argument("-o", "--output", default="")
+    p.add_argument("--details", type=int, default=3,
+                   help="diff lines shown per scenario")
     p = sub.add_parser("elections")
     p.add_argument("-o", "--output", default="",
                    help="'' (table) or wide")
@@ -1321,7 +1535,13 @@ def run(cp: ControlPlane, argv: list[str]) -> str:
     if args.command == "attach":
         return cmd_attach(cp, args.cluster, args.workload, args.namespace)
     if args.command == "deschedule":
-        return cmd_deschedule(cp)
+        return cmd_deschedule(cp, dry_run=args.dry_run, details=args.details)
+    if args.command == "simulate":
+        return cmd_simulate(
+            cp, args.drain, args.loss, args.taint, args.capacity, args.surge,
+            namespace=args.namespace, output=args.output,
+            details=args.details,
+        )
     if args.command == "elections":
         return cmd_elections(cp, wide=args.output == "wide")
     if args.command == "rebalance":

@@ -159,7 +159,23 @@ class ControlPlane:
         self.coordinator = LeaseCoordinator(self.store, self.runtime.clock)
         self.gates = gates or FeatureGates()
         self.admission = default_admission_chain(self.gates)
+        # FederatedResourceQuota preflight: quota changes whose simulated
+        # re-solve would strand placed replicas are denied at admission
+        # (simulation/preflight.py — consumes the what-if engine, no
+        # duplicated solve logic). Registered here, not in the default
+        # chain, because it needs the live store.
+        from .simulation.preflight import PREFLIGHT_WEBHOOK, QuotaPreflight
+        from .webhook.admission import Webhook as _Webhook
+
+        self.quota_preflight = QuotaPreflight(self.store)
+        self.admission.register(_Webhook(
+            name=PREFLIGHT_WEBHOOK,
+            kinds=("FederatedResourceQuota",),
+            validate=self.quota_preflight.validate,
+        ))
         self.store.set_admission(self.admission.admit)
+        # POST /simulate report retention (karmadactl get simulationreports)
+        self.simulation_report_history = 10
         self.interpreter = ResourceInterpreter()
         self.interpreter.load_thirdparty()  # I3 shipped customizations
         self.members: dict[str, InMemoryMember] = {}
@@ -506,3 +522,51 @@ class ControlPlane:
         n = self.descheduler.deschedule_once()
         self.settle()
         return n
+
+    def run_descheduler_dryrun(self, diff_limit: int = 16):
+        """Descheduler preflight: the eviction set goes through the what-if
+        simulator instead of the store — returns the displacement report,
+        mutates nothing (the report is NOT persisted either)."""
+        return self.descheduler.deschedule_dryrun(diff_limit=diff_limit)
+
+    # -- what-if simulation plane (simulation/engine.py) -------------------
+
+    def simulate(self, request):
+        """Evaluate a SimulationRequest against the live fleet + bindings.
+        Read-only with respect to both; the resulting SimulationReport is
+        persisted (last `simulation_report_history` kept) so operators can
+        review a preflight decision after the fact."""
+        from .api.meta import new_uid
+        from .api.simulation import KIND_SIMULATION_REPORT
+        from .simulation import Simulator, build_report
+
+        clusters = sorted(
+            self.store.list("Cluster"), key=lambda c: c.metadata.name
+        )
+        bindings = [
+            rb for rb in self.store.list("ResourceBinding",
+                                         request.spec.namespace)
+            if rb.metadata.deletion_timestamp is None
+        ]
+        sim = Simulator(clusters)
+        baseline, outcomes = sim.simulate(bindings, request.spec.scenarios)
+        report = build_report(
+            request, baseline, outcomes, stats=sim.last_stats,
+            clusters=len(clusters), bindings=len(bindings),
+        )
+        if not report.metadata.name:
+            report.metadata.name = new_uid("sim")
+        if self.store.try_get(KIND_SIMULATION_REPORT,
+                              report.metadata.name) is not None:
+            report.metadata.name = new_uid("sim")
+        self.store.create(report)
+        # retention: keep the last N reports (oldest out by storage order)
+        reports = sorted(
+            self.store.list(KIND_SIMULATION_REPORT),
+            key=lambda r: r.metadata.resource_version,
+        )
+        while len(reports) > max(self.simulation_report_history, 1):
+            victim = reports.pop(0)
+            self.store.delete(KIND_SIMULATION_REPORT, victim.metadata.name,
+                              victim.metadata.namespace)
+        return report

@@ -35,6 +35,18 @@ def main() -> None:
     ap.add_argument("--once", action="store_true",
                     help="run one sweep and exit (prints the update count); "
                          "operator-invoked, so it skips leader election")
+    ap.add_argument("--details", type=int, default=16,
+                    help="max per-binding diffs carried in the --dry-run "
+                         "report (-1 = all)")
+    ap.add_argument("--dry-run", action="store_true",
+                    help="compute the eviction set, run it through the "
+                         "what-if simulator instead of patching bindings, "
+                         "and print the displacement report (JSON). Mutates "
+                         "nothing; implies --once")
+    ap.add_argument("--scrape-token-file", default="",
+                    help="dedicated READ-ONLY token accepted on GET "
+                         "/metrics only (the Prometheus credential no "
+                         "longer needs to be the full wire token)")
     ap.add_argument("--bearer-token", default="")
     ap.add_argument("--cacert", default="")
     ap.add_argument("--no-leader-elect", action="store_true",
@@ -74,6 +86,23 @@ def main() -> None:
     )
     d = Descheduler(store, registry, interval=args.interval,
                     unschedulable_threshold=args.threshold)
+    if args.dry_run:
+        import dataclasses
+        import json
+
+        report = d.deschedule_dryrun(
+            diff_limit=(1 << 20) if args.details < 0 else args.details
+        )
+        row = report.scenarios[0] if report.scenarios else None
+        print(json.dumps({
+            "dry_run": True,
+            "evicted_bindings": report.bindings,
+            "displaced": row.displaced if row else 0,
+            "unplaceable": row.unplaceable if row else 0,
+            "overcommitted": row.overcommitted if row else [],
+            "diffs": [dataclasses.asdict(di) for di in (row.diffs if row else [])],
+        }), flush=True)
+        return
     if args.once:
         n = d.deschedule_once()
         print(f"descheduled {n} binding(s)", flush=True)
@@ -83,7 +112,10 @@ def main() -> None:
     from ..coordination.elector import Elector, default_identity
     from ..server.metricsserver import start_metrics_server
 
-    metrics_srv = start_metrics_server(args.metrics_port, token=token)
+    metrics_srv = start_metrics_server(
+        args.metrics_port, token=token,
+        scrape_token_file=args.scrape_token_file,
+    )
     identity = args.identity or default_identity()
     elector = None
     if not args.no_leader_elect:

@@ -88,13 +88,17 @@ class Descheduler:
                 updated += 1
         return updated
 
-    def _deschedule_binding(self, rb: ResourceBinding) -> bool:
+    def _proposed_targets(self, rb: ResourceBinding):
+        """The eviction set for one binding: the shrunk spec.clusters this
+        sweep would write, or None when nothing shrinks. Pure — shared by
+        the live sweep and the dry-run preflight so the two can never use
+        different shrink logic."""
         ready = ready_replicas_by_cluster(rb)
         undesired = [
             tc.name for tc in rb.spec.clusters if ready.get(tc.name, 0) < tc.replicas
         ]
         if not undesired:
-            return False
+            return None
         unschedulable = dict(
             zip(
                 undesired,
@@ -113,7 +117,11 @@ class Descheduler:
                 new_clusters.append(TargetCluster(name=tc.name, replicas=target))
             else:
                 new_clusters.append(tc)
-        if not changed:
+        return new_clusters if changed else None
+
+    def _deschedule_binding(self, rb: ResourceBinding) -> bool:
+        new_clusters = self._proposed_targets(rb)
+        if new_clusters is None:
             return False
         fresh = self.store.try_get("ResourceBinding", rb.name, rb.namespace)
         if fresh is None:
@@ -121,3 +129,74 @@ class Descheduler:
         fresh.spec.clusters = new_clusters
         self.store.update(fresh)
         return True
+
+    def deschedule_dryrun(self, diff_limit: int = 16):
+        """--dry-run mode: compute the eviction set, then — instead of
+        patching bindings — run the shrunk copies through the simulation
+        engine (the scheduler's own solve, simulation/engine.py) and report
+        what the re-placement WOULD do, diffed against the bindings'
+        current assignments. Touches neither the store nor the estimators'
+        state; returns a SimulationReport that is NOT persisted.
+
+        The simulated before-image is the live spec.clusters; the after
+        image is the baseline solve of the shrunk copies (the scheduler
+        sees replicas-changed and scale-up re-places the freed replicas —
+        exactly what deschedule_once would trigger)."""
+        import copy as copy_mod
+
+        from ..api.simulation import (
+            SCENARIO_COMPOSITE,
+            Scenario,
+            ScenarioReport,
+            SimulationReport,
+        )
+        from ..simulation import Simulator, diff_placements
+
+        proposals = []
+        for rb in self.store.list("ResourceBinding"):
+            if not eligible(rb):
+                continue
+            new_clusters = self._proposed_targets(rb)
+            if new_clusters is not None:
+                proposals.append((rb, new_clusters))
+        report = SimulationReport()
+        report.metadata.name = "descheduler-dry-run"
+        if not proposals:
+            return report
+        clusters = sorted(
+            self.store.list("Cluster"), key=lambda c: c.metadata.name
+        )
+        shrunk = []
+        current_placements: dict[str, list] = {}
+        for rb, new_clusters in proposals:
+            m = copy_mod.deepcopy(rb)
+            m.spec.clusters = new_clusters
+            shrunk.append(m)
+            current_placements[rb.metadata.key()] = list(rb.spec.clusters)
+        sim = Simulator(clusters)
+        # the live re-solve min-merges registered-estimator answers
+        # (sched/scheduler.py batch_estimates) — the preflight must see the
+        # same tightened availability, or it reports freed replicas landing
+        # on clusters the real solve will reject (None when this registry
+        # carries only unschedulable estimators, e.g. the daemon path)
+        extra = None
+        batch_estimates = getattr(self.registry, "batch_estimates", None)
+        if batch_estimates is not None:
+            extra = batch_estimates(shrunk, [c.metadata.name for c in clusters])
+        baseline, _ = sim.simulate(shrunk, [], extra_avail=extra)
+        baseline.scenario = Scenario(
+            kind=SCENARIO_COMPOSITE, name="descheduler-evictions",
+        )
+        row = diff_placements(current_placements, {}, baseline,
+                              limit=diff_limit)
+        row = ScenarioReport(
+            scenario=row.scenario, displaced=row.displaced,
+            unplaceable=row.unplaceable, injected=len(shrunk),
+            overcommitted=row.overcommitted, diffs=row.diffs,
+        )
+        report.scenarios = [row]
+        report.bindings = len(shrunk)
+        report.clusters = len(clusters)
+        report.batched_solves = sim.last_stats.get("batched_solves", 0)
+        report.fallback_solves = sim.last_stats.get("fallback_solves", 0)
+        return report

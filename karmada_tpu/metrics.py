@@ -196,6 +196,23 @@ descheduler_sweeps = registry.counter(
     "Number of descheduling sweeps",
 )
 
+# what-if simulation plane (simulation/engine.py): `mode=batched` counts
+# vmapped [S,B,C] device launches (the acceptance metric: S scenarios must
+# cost ONE launch when they fit the memory envelope); `mode=fallback` counts
+# per-scenario exact re-solves for rows outside the batched path
+simulation_solves = registry.counter(
+    "karmada_simulation_solves_total",
+    "What-if solve launches by mode (batched = one vmapped launch)",
+)
+simulation_scenarios = registry.counter(
+    "karmada_simulation_scenarios_total",
+    "Scenarios evaluated by the simulation plane",
+)
+simulation_duration = registry.histogram(
+    "karmada_simulation_duration_seconds",
+    "End-to-end what-if simulation latency in seconds",
+)
+
 # leader election (coordination/elector.py); mirrors client-go's
 # leader_election_master_status + rest of the election metric family
 leader_election_is_leader = registry.gauge(

@@ -66,6 +66,10 @@ def main() -> None:
     ap.add_argument("--metrics-port", type=int, default=0,
                     help="serve GET /metrics on this port (0 = ephemeral, "
                          "printed on stdout; -1 disables)")
+    ap.add_argument("--scrape-token-file", default="",
+                    help="dedicated READ-ONLY token accepted on GET "
+                         "/metrics only (the Prometheus credential no "
+                         "longer needs to be the full wire token)")
     args = ap.parse_args()
 
     if args.platform == "cpu":
@@ -107,7 +111,10 @@ def main() -> None:
         store, runtime, scheduler_name=args.scheduler_name,
         estimator_registry=registry, plugins=plugins,
     )
-    metrics_srv = start_metrics_server(args.metrics_port, token=token)
+    metrics_srv = start_metrics_server(
+        args.metrics_port, token=token,
+        scrape_token_file=args.scrape_token_file,
+    )
 
     lease_name = args.lease_name or (
         LEASE_SCHEDULER if args.scheduler_name == "default-scheduler"

@@ -289,6 +289,19 @@ def _schedule_kernel(
     )
 
 
+def tie_from_index(seeds, idx):
+    """splitmix64 tie values from explicit per-column 1-based GLOBAL cluster
+    indices (u64[C]) — the generalization of _device_tie that lets a caller
+    with a REMAPPED column space (the simulation plane's drain scenarios,
+    where a drained cluster vanishes from the index range) reproduce exactly
+    the tie matrix a fleet without that cluster would have."""
+    x = seeds[:, None] ^ idx[None, :]
+    x = (x ^ (x >> jnp.uint64(30))) * jnp.uint64(0xBF58476D1CE4E5B9)
+    x = (x ^ (x >> jnp.uint64(27))) * jnp.uint64(0x94D049BB133111EB)
+    x = x ^ (x >> jnp.uint64(31))
+    return (x >> jnp.uint64(33)).astype(jnp.int32)
+
+
 def _device_tie(seeds, n_clusters, offset=0):
     """splitmix64 tie-break expanded on device — bit-identical to
     models.batch.tie_matrix (the deterministic stand-in for the reference's
@@ -298,12 +311,8 @@ def _device_tie(seeds, n_clusters, offset=0):
     idx = (
         jnp.asarray(offset).astype(jnp.uint64)
         + jnp.arange(1, n_clusters + 1, dtype=jnp.uint64)
-    )[None, :]
-    x = seeds[:, None] ^ idx
-    x = (x ^ (x >> jnp.uint64(30))) * jnp.uint64(0xBF58476D1CE4E5B9)
-    x = (x ^ (x >> jnp.uint64(27))) * jnp.uint64(0x94D049BB133111EB)
-    x = x ^ (x >> jnp.uint64(31))
-    return (x >> jnp.uint64(33)).astype(jnp.int32)
+    )
+    return tie_from_index(seeds, idx)
 
 
 def decompress_batch(
@@ -609,6 +618,81 @@ def _restrict_rows(batch: BindingBatch, rows: list[int], affinity_override: np.n
     )
 
 
+def resolve_max_bc_elems(override: Optional[int] = None) -> int:
+    """THE [B,C]-elements-per-launch budget (HBM envelope): explicit
+    override, else KARMADA_TPU_MAX_BC_ELEMS, else 2<<27. Shared by
+    ArrayScheduler and the simulation plane so a malformed env var fails
+    loudly and identically everywhere."""
+    import os
+
+    if override is not None:
+        val, src = int(override), "max_bc_elems override"
+    else:
+        env = os.environ.get("KARMADA_TPU_MAX_BC_ELEMS", "")
+        if not env:
+            return 2 << 27
+        try:
+            val = int(env)
+        except ValueError:
+            raise ValueError(
+                f"KARMADA_TPU_MAX_BC_ELEMS={env!r}: must be an integer"
+            ) from None
+        src = f"KARMADA_TPU_MAX_BC_ELEMS={env!r}"
+    if val <= 0:
+        raise ValueError(f"{src}: must be positive")
+    return val
+
+
+def resolve_autoshard(override: Optional[bool] = None) -> bool:
+    import os
+
+    if override is not None:
+        return bool(override)
+    return os.environ.get("KARMADA_TPU_AUTOSHARD", "") not in (
+        "0", "off", "false",
+    )
+
+
+def pad_batch(batch: BindingBatch, bucket_fn) -> BindingBatch:
+    """Pad a batch's row axis to bucket_fn(B) (jit-cache bucketing). Module
+    level so non-ArrayScheduler launchers (simulation/engine.py) share the
+    exact padding contract — padded rows are strategy 0 / replicas 0 and are
+    never decoded."""
+    B = batch.size
+    Bp = bucket_fn(B)
+    if Bp == B:
+        return batch
+    pad = Bp - B
+
+    def pz(a, fill=0):
+        width = [(0, pad)] + [(0, 0)] * (a.ndim - 1)
+        return np.pad(a, width, constant_values=fill)
+
+    return BindingBatch(
+        keys=batch.keys,
+        uids=batch.uids,
+        replicas=pz(batch.replicas),
+        unknown_request=pz(batch.unknown_request),
+        gvk=pz(batch.gvk),
+        strategy=pz(batch.strategy),
+        fresh=pz(batch.fresh),
+        tol_tables=batch.tol_tables,
+        tol_idx=pz(batch.tol_idx),
+        aff_masks=batch.aff_masks,
+        aff_idx=pz(batch.aff_idx),  # padded rows → mask row 0 (harmless:
+        #   strategy 0/replicas 0 rows are never decoded)
+        weight_tables=batch.weight_tables,
+        weight_idx=pz(batch.weight_idx),
+        prev_idx=pz(batch.prev_idx, fill=batch.n_clusters),
+        prev_rep=pz(batch.prev_rep),
+        evict_idx=pz(batch.evict_idx, fill=batch.n_clusters),
+        seeds=pz(batch.seeds),
+        n_clusters=batch.n_clusters,
+        req_unique=batch.req_unique,
+        req_idx=None if batch.req_idx is None else pz(batch.req_idx),
+    )
+
+
 class ArrayScheduler:
     """Host wrapper: encodes fleet + batches, runs the kernel, decodes
     TargetClusters. Batch sizes are padded to power-of-two buckets to bound
@@ -666,25 +750,8 @@ class ArrayScheduler:
         # placement-identical by construction). 2^28 elements ≈ 1 GiB per
         # i32 buffer ≈ 6 GiB live on a 16 GiB v5e-1; a sharded mesh divides
         # the per-device footprint, so the cap scales with mesh size.
-        env_cap = os.environ.get("KARMADA_TPU_MAX_BC_ELEMS", "")
-        if env_cap:
-            try:
-                self.max_bc_elems = int(env_cap)
-            except ValueError:
-                raise ValueError(
-                    f"KARMADA_TPU_MAX_BC_ELEMS={env_cap!r}: must be an integer"
-                ) from None
-            if self.max_bc_elems <= 0:
-                raise ValueError(
-                    f"KARMADA_TPU_MAX_BC_ELEMS={env_cap!r}: must be positive"
-                )
-        else:
-            self.max_bc_elems = 2 << 27
-        env_as = os.environ.get("KARMADA_TPU_AUTOSHARD", "")
-        if autoshard is not None:
-            self.autoshard = bool(autoshard)
-        else:
-            self.autoshard = env_as not in ("0", "off", "false")
+        self.max_bc_elems = resolve_max_bc_elems()
+        self.autoshard = resolve_autoshard(autoshard)
         # cross-round incremental state: any fleet change bumps the epoch
         # (cached decisions are only replayed at the epoch they were solved
         # in); the cache maps binding uid → DecisionEntry
@@ -896,39 +963,7 @@ class ArrayScheduler:
         return ((n + 2047) // 2048) * 2048
 
     def _pad(self, batch: BindingBatch) -> BindingBatch:
-        B = batch.size
-        Bp = self._bucket(B)
-        if Bp == B:
-            return batch
-        pad = Bp - B
-
-        def pz(a, fill=0):
-            width = [(0, pad)] + [(0, 0)] * (a.ndim - 1)
-            return np.pad(a, width, constant_values=fill)
-
-        return BindingBatch(
-            keys=batch.keys,
-            uids=batch.uids,
-            replicas=pz(batch.replicas),
-            unknown_request=pz(batch.unknown_request),
-            gvk=pz(batch.gvk),
-            strategy=pz(batch.strategy),
-            fresh=pz(batch.fresh),
-            tol_tables=batch.tol_tables,
-            tol_idx=pz(batch.tol_idx),
-            aff_masks=batch.aff_masks,
-            aff_idx=pz(batch.aff_idx),  # padded rows → mask row 0 (harmless:
-            #   strategy 0/replicas 0 rows are never decoded)
-            weight_tables=batch.weight_tables,
-            weight_idx=pz(batch.weight_idx),
-            prev_idx=pz(batch.prev_idx, fill=batch.n_clusters),
-            prev_rep=pz(batch.prev_rep),
-            evict_idx=pz(batch.evict_idx, fill=batch.n_clusters),
-            seeds=pz(batch.seeds),
-            n_clusters=batch.n_clusters,
-            req_unique=batch.req_unique,
-            req_idx=None if batch.req_idx is None else pz(batch.req_idx),
-        )
+        return pad_batch(batch, self._bucket)
 
     _NO_EXTRA = np.full((1, 1), -1, np.int32)  # broadcast sentinel
     _NO_MASK = np.ones((1, 1), bool)
@@ -1115,16 +1150,29 @@ class ArrayScheduler:
         epoch = self.fleet_epoch
         out: list[Optional[ScheduleDecision]] = [None] * len(bindings)
         dirty_pos: list[int] = []
-        # digests computed ONCE per row here and reused by the cache writes
-        # below (each is a blake2b over a [C] estimator row — ~20 KB at the
-        # flagship shape, not worth hashing twice in the hot path)
+        # estimator-row digests are computed LAZILY — only after the cheap
+        # epoch check says a cached entry could match, and once more at cache
+        # write time for dirty rows. An epoch-invalidated round (any cluster
+        # change) therefore never pays B blake2b passes over [C] rows just to
+        # discover every entry is stale. Each digest is memoized so the cache
+        # writes below reuse it.
         digests: list[Optional[bytes]] = [None] * len(bindings)
+        digest_done = [extra_avail is None] * len(bindings)
+
+        def digest_of(i: int) -> Optional[bytes]:
+            if not digest_done[i]:
+                digests[i] = extra_digest(extra_avail[i])
+                digest_done[i] = True
+            return digests[i]
+
         for i, rb in enumerate(bindings):
             uid = rb.metadata.uid
-            if extra_avail is not None:
-                digests[i] = extra_digest(extra_avail[i])
             ent = cache.get(uid) if uid else None
-            if ent is not None and ent.matches(rb, epoch, digests[i]):
+            if (
+                ent is not None
+                and ent.epoch == epoch  # cheap gate before any hashing
+                and ent.matches(rb, epoch, digest_of(i))
+            ):
                 out[i] = ent.decision
             else:
                 dirty_pos.append(i)
@@ -1137,7 +1185,7 @@ class ArrayScheduler:
                 out[i] = dec
                 if rb.metadata.uid:
                     cache[rb.metadata.uid] = DecisionEntry(
-                        rb, solve_epoch, digests[i], dec
+                        rb, solve_epoch, digest_of(i), dec
                     )
             # bound the cache: entries for deleted bindings must not
             # accumulate forever (same policy as the encoder's row cache)
@@ -1146,7 +1194,7 @@ class ArrayScheduler:
                 for i, rb in enumerate(bindings):
                     if rb.metadata.uid and out[i] is not None:
                         cache[rb.metadata.uid] = DecisionEntry(
-                            rb, solve_epoch, digests[i], out[i]
+                            rb, solve_epoch, digest_of(i), out[i]
                         )
         self.last_round_stats = {
             "replayed": len(bindings) - len(dirty_pos),

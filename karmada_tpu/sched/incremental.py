@@ -10,11 +10,13 @@ bindings dirty) into a solve over only the dirty rows.
 `DecisionEntry` captures EVERYTHING `ArrayScheduler._schedule_once` reads
 from a binding:
 
-  - metadata.generation + the identities of placement / replica_requirements
-    / resource (the store contract: managed updates replace these objects
-    and bump generation — the entry holds strong refs, so `is` can never
-    false-positive on a recycled id; same contract as BatchEncoder's row
-    cache),
+  - metadata.generation + placement / replica_requirements / resource
+    compared by VALUE with an object-identity fast path (the in-process
+    store contract — managed updates replace these objects and bump
+    generation — makes `is` a sufficient check there, but the daemon path
+    re-fetches bindings through the store's deepcopy / the wire codec, so
+    out-of-process every fetch hands back NEW objects and an identity-only
+    compare would defeat replay entirely; dataclass `==` restores it),
   - spec.replicas,
   - previous placements and graceful-eviction entries by VALUE (they are
     status-driven and mutate between rounds),
@@ -77,15 +79,20 @@ class DecisionEntry:
         self.extra = extra
         self.decision = decision
 
+    @staticmethod
+    def _same(a, b) -> bool:
+        """Identity fast path (in-process callers hand back the very same
+        policy objects), value compare otherwise (the daemon path re-fetches
+        through the store's deepcopy / wire codec, where identity never
+        holds but dataclass equality does)."""
+        return a is b or a == b
+
     def matches(self, rb, epoch: int, extra: Optional[bytes]) -> bool:
         spec = rb.spec
         return (
             self.epoch == epoch
             and self.generation == rb.metadata.generation
             and self.replicas == spec.replicas
-            and self.placement is spec.placement
-            and self.requirements is spec.replica_requirements
-            and self.resource is spec.resource
             and self.extra == extra
             and self.key == rb.metadata.key()
             and self.fresh == _reschedule_required(spec, rb.status)
@@ -95,4 +102,7 @@ class DecisionEntry:
             == tuple((tc.name, tc.replicas) for tc in (spec.clusters or ()))
             and self.evict
             == tuple(t.from_cluster for t in (spec.graceful_eviction_tasks or ()))
+            and self._same(self.placement, spec.placement)
+            and self._same(self.requirements, spec.replica_requirements)
+            and self._same(self.resource, spec.resource)
         )

@@ -48,6 +48,12 @@ def main() -> None:
                     help="require 'Authorization: Bearer <token>' matching "
                          "this file's contents (generated on first start "
                          "if absent); empty = unauthenticated")
+    ap.add_argument("--scrape-token-file", default="",
+                    help="dedicated READ-ONLY token accepted on GET "
+                         "/metrics only (generated on first start if "
+                         "absent) — hand THIS to Prometheus instead of the "
+                         "wire token; it cannot read objects or mutate the "
+                         "plane")
     ap.add_argument("--insecure-token-ok", action="store_true",
                     help="allow --token-file over plaintext HTTP on a "
                          "non-loopback --host (the token crosses the "
@@ -136,10 +142,18 @@ def main() -> None:
         token = ensure_token(args.token_file)
         print(f"auth: bearer token required (--token-file {args.token_file})",
               flush=True)
+    scrape_token = None
+    if args.scrape_token_file:
+        from .tlsmaterial import ensure_token
+
+        scrape_token = ensure_token(args.scrape_token_file)
+        print(f"auth: read-only scrape token accepted on /metrics "
+              f"(--scrape-token-file {args.scrape_token_file})", flush=True)
 
     srv = ControlPlaneServer(cp, host=args.host, port=args.port,
                              ssl_context=ssl_context, token=token,
-                             enable_test_clock=args.enable_test_clock)
+                             enable_test_clock=args.enable_test_clock,
+                             scrape_token=scrape_token)
     srv.start()
     print(f"karmada-tpu control plane serving on {srv.url}", flush=True)
 

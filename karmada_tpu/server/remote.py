@@ -427,6 +427,15 @@ class RemoteControlPlane:
     def sign_agent_cert(self, cluster: str) -> dict:
         return self.store._call("POST", "/agent/cert", {"cluster": cluster})
 
+    def simulate(self, request):
+        """POST /simulate: the what-if plane over the wire — same signature
+        as ControlPlane.simulate, so karmadactl simulate works identically
+        in-process and against a daemon."""
+        out = self.store._call(
+            "POST", "/simulate", {"request": codec.encode(request)}
+        )
+        return codec.decode(out.get("report"))
+
     def healthz(self) -> bool:
         try:
             return bool(self.store._call("GET", "/healthz").get("ok"))

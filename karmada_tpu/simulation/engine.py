@@ -1,0 +1,691 @@
+"""Scenario-batched counterfactual solves: S what-ifs as ONE [S,B,C] launch.
+
+The device plane already solves the full [B,C] cost matrix in one program
+(sched/core.py). Stacking a scenario axis on top turns the same kernels into
+a counterfactual engine: every scenario is a perturbation of the fleet
+encoding (models/fleet.py FleetArrays — drain, readiness loss, taint,
+capacity delta) or of the binding set (surge), and `jax.vmap` over the
+scenario axis of the perturbed fleet tensors evaluates all S counterfactuals
+against the SAME binding batch in one device launch. `_schedule_body` — the
+exact program every live schedule round runs — is reused unchanged; only the
+tie stream is generalized (core.tie_from_index) so a Drain scenario
+reproduces bit-identically what a cold solve WITHOUT that cluster would
+place (the tie matrix is indexed by a cluster's position in the fleet list,
+which shifts when a cluster is removed).
+
+Memory envelope: one launch keeps ~6 live i32/bool [S,B,C] buffers, so
+S·B·C is capped by the same `max_bc_elems` budget the live scheduler uses.
+Oversized simulations route automatically:
+  - multiple visible devices → the scenario axis shards over a 1-d device
+    mesh (scenarios are embarrassingly parallel; GSPMD partitions the
+    vmapped program with no collectives),
+  - otherwise → scenario/row chunking into sequential launches.
+
+Rows the dense kernel does not cover end to end (spread constraints,
+ordered multi-term affinities — both host-driven search loops) take a
+per-scenario exact fallback through ArrayScheduler; everything else (the
+overwhelmingly common Duplicated / static / dynamic strategies) rides the
+batched path. `last_stats` and the karmada_simulation_solves_total metric
+expose the split.
+"""
+from __future__ import annotations
+
+import copy
+import time
+from functools import partial
+from typing import Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..api.cluster import CLUSTER_CONDITION_READY, Taint
+from ..api.meta import Condition, ObjectMeta, set_condition
+from ..api.policy import (
+    ClusterAffinity,
+    DIVISION_PREFERENCE_AGGREGATED,
+    Placement,
+    REPLICA_SCHEDULING_DIVIDED,
+    ReplicaSchedulingStrategy,
+)
+from ..api.simulation import (
+    SCENARIO_BASELINE,
+    SCENARIO_CAPACITY,
+    SCENARIO_COMPOSITE,
+    SCENARIO_DRAIN,
+    SCENARIO_KINDS,
+    SCENARIO_LOSS,
+    SCENARIO_SURGE,
+    SCENARIO_TAINT,
+    Scenario,
+)
+from ..api.work import (
+    BindingSpec,
+    ObjectReference,
+    ReplicaRequirements,
+    ResourceBinding,
+    TargetCluster,
+)
+from ..metrics import simulation_duration, simulation_scenarios, simulation_solves
+from ..models.batch import (
+    AGGREGATED,
+    BatchEncoder,
+    DUPLICATED,
+    NON_WORKLOAD,
+    pow2_bucket,
+)
+from ..models.fleet import FleetEncoder, to_int_units
+from ..sched.core import (
+    ArrayScheduler,
+    TOPK_TARGETS,
+    _schedule_body,
+    _sorted_pairs,
+    compact_outputs,
+    pad_batch,
+    resolve_autoshard,
+    resolve_max_bc_elems,
+    tie_from_index,
+)
+
+SURGE_NAMESPACE = "karmada-simulation"
+
+
+class SimulationError(ValueError):
+    """A scenario references state the fleet does not have (unknown cluster,
+    unknown scenario kind) — surfaced as a client error, not a solve bug."""
+
+
+# --------------------------------------------------------------------------
+# scenario application (object level — the single source of perturbation
+# semantics, shared by the batched encode, the exact fallback, and tests)
+# --------------------------------------------------------------------------
+
+
+def scenario_steps(scenario: Scenario) -> list[Scenario]:
+    if scenario.kind == SCENARIO_COMPOSITE:
+        return list(scenario.steps)
+    return [scenario]
+
+
+def _validate_steps(steps: Sequence[Scenario], cluster_names: set) -> None:
+    for st in steps:
+        if st.kind not in SCENARIO_KINDS:
+            raise SimulationError(f"unknown scenario kind {st.kind!r}")
+        if st.kind == SCENARIO_COMPOSITE:
+            raise SimulationError("Composite scenarios cannot nest")
+        if st.kind in (SCENARIO_DRAIN, SCENARIO_LOSS, SCENARIO_TAINT,
+                       SCENARIO_CAPACITY):
+            if not st.cluster:
+                raise SimulationError(f"{st.kind} scenario needs a cluster")
+            if st.cluster not in cluster_names:
+                raise SimulationError(
+                    f"{st.kind} scenario targets unknown cluster {st.cluster!r}"
+                )
+        if st.kind == SCENARIO_TAINT and not st.taint_key:
+            raise SimulationError("Taint scenario needs taint_key")
+        if st.kind == SCENARIO_SURGE and st.surge_count <= 0:
+            raise SimulationError("BindingSurge scenario needs surge_count > 0")
+
+
+def _set_ready(cluster, ready: bool) -> None:
+    set_condition(
+        cluster.status.conditions,
+        Condition(
+            type=CLUSTER_CONDITION_READY,
+            status="True" if ready else "False",
+            reason="Simulated",
+        ),
+    )
+
+
+def _apply_step(cluster, step: Scenario):
+    """One perturbed deepcopy of `cluster` under `step` (never Drain)."""
+    cc = copy.deepcopy(cluster)
+    if step.kind == SCENARIO_LOSS:
+        _set_ready(cc, False)
+    elif step.kind == SCENARIO_TAINT:
+        cc.spec.taints.append(
+            Taint(key=step.taint_key, value=step.taint_value,
+                  effect=step.taint_effect or "NoSchedule")
+        )
+    elif step.kind == SCENARIO_CAPACITY:
+        rs = cc.status.resource_summary
+        if rs is not None:
+            for rname, delta in step.resources.items():
+                rs.allocatable[rname] = max(
+                    0.0, rs.allocatable.get(rname, 0.0) + delta
+                )
+    return cc
+
+
+def apply_scenario_objects(clusters: Sequence, scenario: Scenario) -> list:
+    """REFERENCE semantics: the cluster list a real cold re-solve under this
+    scenario would see — drained clusters REMOVED, others perturbed. The
+    engine's batched path must be bit-identical to
+    `ArrayScheduler(apply_scenario_objects(...)).schedule(...)` per scenario
+    (tests/test_simulation.py pins this)."""
+    steps = scenario_steps(scenario)
+    drained = {s.cluster for s in steps if s.kind == SCENARIO_DRAIN}
+    mods: dict[str, list[Scenario]] = {}
+    for s in steps:
+        if s.kind in (SCENARIO_LOSS, SCENARIO_TAINT, SCENARIO_CAPACITY):
+            mods.setdefault(s.cluster, []).append(s)
+    out = []
+    for c in clusters:
+        if c.name in drained:
+            continue
+        for s in mods.get(c.name, ()):
+            c = _apply_step(c, s)
+        out.append(c)
+    return out
+
+
+def _perturb_columns(clusters: Sequence, scenario: Scenario):
+    """ENGINE column view: same-length cluster list (the stacked [S,C,...]
+    encode needs rectangular fleets) + the present mask. A drained cluster
+    stays as a column but becomes a NotReady husk with no capacity — never
+    feasible, so only its tie index matters, and tie indices come from the
+    present mask (cumulative rank = the cluster's position in the REMOVED
+    list), which is what makes drain bit-identical to removal."""
+    steps = scenario_steps(scenario)
+    drained = {s.cluster for s in steps if s.kind == SCENARIO_DRAIN}
+    mods: dict[str, list[Scenario]] = {}
+    for s in steps:
+        if s.kind in (SCENARIO_LOSS, SCENARIO_TAINT, SCENARIO_CAPACITY):
+            mods.setdefault(s.cluster, []).append(s)
+    out, present = [], np.ones(len(clusters), bool)
+    for i, c in enumerate(clusters):
+        if c.name in drained:
+            husk = copy.deepcopy(c)
+            _set_ready(husk, False)
+            husk.status.resource_summary = None
+            husk.spec.taints = []
+            out.append(husk)
+            present[i] = False
+            continue
+        for s in mods.get(c.name, ()):
+            c = _apply_step(c, s)
+        out.append(c)
+    return out, present
+
+
+def surge_bindings(step: Scenario, scenario_index: int) -> list[ResourceBinding]:
+    """Deterministic synthetic bindings for a BindingSurge step: dynamic
+    Divided/Aggregated over the whole fleet (the capacity-pressure shape).
+    Names/uids are derived from the scenario index so the batched solve and
+    any per-scenario reference solve see identical rows (the tie stream is
+    uid-seeded)."""
+    req = dict(step.surge_request) or {"cpu": 0.1}
+    out = []
+    for i in range(step.surge_count):
+        name = f"surge-{scenario_index}-{i}"
+        out.append(ResourceBinding(
+            metadata=ObjectMeta(
+                namespace=SURGE_NAMESPACE, name=name,
+                uid=f"sim-surge-{scenario_index}-{i}",
+            ),
+            spec=BindingSpec(
+                resource=ObjectReference(
+                    api_version="apps/v1", kind="Deployment",
+                    namespace=SURGE_NAMESPACE, name=name,
+                ),
+                replicas=max(1, step.surge_replicas),
+                replica_requirements=ReplicaRequirements(resource_request=req),
+                placement=Placement(
+                    cluster_affinity=ClusterAffinity(cluster_names=[]),
+                    replica_scheduling=ReplicaSchedulingStrategy(
+                        replica_scheduling_type=REPLICA_SCHEDULING_DIVIDED,
+                        replica_division_preference=DIVISION_PREFERENCE_AGGREGATED,
+                    ),
+                ),
+            ),
+        ))
+    return out
+
+
+# --------------------------------------------------------------------------
+# the vmapped kernel
+# --------------------------------------------------------------------------
+
+
+@partial(jax.jit, static_argnames=("topk", "has_agg"))
+def _sim_kernel(
+    # scenario-stacked fleet [S,...]
+    alive, capacity, has_summary, taint_key, taint_value, taint_effect, api_ok,
+    tie_idx,  # u64[S,C] 1-based present-rank per column
+    active,  # bool[S,B] rows that exist in each scenario (surge ownership)
+    # batch (scenario-invariant — encoded once, shared by every scenario)
+    replicas, unknown_request, gvk, strategy, fresh,
+    tol_tables, tol_idx, aff_masks, aff_idx, weight_tables, weight_idx,
+    prev_idx, prev_rep, evict_idx, seeds, req_unique, req_idx,
+    extra_avail,  # i32[B,C] or [1,1] -1 sentinel (scenario-independent)
+    request_dense,  # i64[B,R] for the overcommit usage accumulation
+    topk: int = TOPK_TARGETS,
+    has_agg: bool = True,
+):
+    """Decompress the factored batch ONCE, then vmap the standard schedule
+    body over the scenario axis of the fleet tensors. Output is compact per
+    scenario (top-K pairs + per-cluster load); the dense [S,B,C] result
+    stays on device for overflow-row fetches."""
+    B = replicas.shape[0]
+    C = alive.shape[1]
+    rows = jnp.arange(B)[:, None]
+    tol = tol_tables[tol_idx]  # [B,4,K]
+    affinity_ok = aff_masks[aff_idx]
+    static_weight = weight_tables[weight_idx]
+    p = jnp.where((prev_idx >= 0) & (prev_idx < C), prev_idx, C)
+    prev_member = jnp.zeros((B, C), bool).at[rows, p].set(True, mode="drop")
+    prev_replicas = (
+        jnp.zeros((B, C), jnp.int32).at[rows, p].set(prev_rep, mode="drop")
+    )
+    e = jnp.where((evict_idx >= 0) & (evict_idx < C), evict_idx, C)
+    eviction_ok = jnp.ones((B, C), bool).at[rows, e].set(False, mode="drop")
+    extra = jnp.broadcast_to(extra_avail, (B, C))
+
+    def one(alive_s, cap_s, hs_s, tk_s, tv_s, te_s, api_s, tidx_s, active_s):
+        tie = tie_from_index(seeds, tidx_s)
+        feasible, _score, result, unschedulable, avail_sum, _avail = (
+            _schedule_body(
+                alive_s, cap_s, hs_s, tk_s, tv_s, te_s, api_s,
+                replicas, None, unknown_request, gvk, strategy, fresh,
+                tol[:, 0], tol[:, 1], tol[:, 2], tol[:, 3],
+                affinity_ok, eviction_ok, static_weight, prev_member,
+                prev_replicas, tie, extra,
+                narrow=False, has_agg=has_agg,
+                req_unique=req_unique, req_idx=req_idx,
+            )
+        )
+        feas_count, nnz, top_idx, top_val = compact_outputs(
+            feasible, result, topk
+        )
+        r64 = jnp.where(active_s[:, None], result, 0).astype(jnp.int64)
+        assigned = r64.sum(0)  # i64[C] replicas landed per cluster
+        usage = r64.T @ request_dense  # i64[C,R] resource load per cluster
+        return (
+            unschedulable, avail_sum, feas_count, nnz, top_idx, top_val,
+            assigned, usage, result,
+        )
+
+    return jax.vmap(one)(
+        alive, capacity, has_summary, taint_key, taint_value, taint_effect,
+        api_ok, tie_idx, active,
+    )
+
+
+# --------------------------------------------------------------------------
+# host wrapper
+# --------------------------------------------------------------------------
+
+
+class ScenarioOutcome:
+    """One scenario's counterfactual solve, decoded."""
+
+    __slots__ = (
+        "scenario", "placements", "errors", "assigned", "usage",
+        "overcommitted", "present", "injected",
+    )
+
+    def __init__(self, scenario: Scenario, n_clusters: int, n_resources: int,
+                 present: np.ndarray):
+        self.scenario = scenario
+        self.placements: dict[str, list[TargetCluster]] = {}
+        self.errors: dict[str, str] = {}
+        self.assigned = np.zeros(n_clusters, np.int64)
+        self.usage = np.zeros((n_clusters, n_resources), np.int64)
+        self.overcommitted: list[str] = []
+        self.present = present
+        self.injected = 0
+
+    @property
+    def unplaceable(self) -> int:
+        return len(self.errors)
+
+
+class Simulator:
+    """Evaluates S counterfactual scenarios against one fleet + binding set.
+
+    Reuses the live plane's encoders unchanged: one FleetEncoder (interned
+    ids stay stable across the scenario encodes) and one BatchEncoder (the
+    batch is scenario-invariant). The solve is the vmapped `_sim_kernel`
+    above; see the module docstring for routing."""
+
+    def __init__(self, clusters: Sequence, encoder: Optional[FleetEncoder] = None,
+                 max_bc_elems: Optional[int] = None,
+                 autoshard: Optional[bool] = None):
+        self.clusters = list(clusters)
+        self.encoder = encoder or FleetEncoder()
+        self.fleet = self.encoder.encode(self.clusters)
+        self.batch_encoder = BatchEncoder(self.encoder, self.fleet, self.clusters)
+        self.max_bc_elems = resolve_max_bc_elems(max_bc_elems)
+        self.autoshard = resolve_autoshard(autoshard)
+        self.last_stats: dict = {}
+
+    # -- scenario fleet stacking ------------------------------------------
+
+    def _encode_scenario_fleets(self, all_scen: list[Scenario]):
+        """Per-scenario FleetArrays via the SHARED encoder (ids stable),
+        stacked [S,...] with the taint/api axes padded to a common width.
+        Late-minted GVK columns (registered by the batch encode after a
+        fleet encode) are enabled by no cluster, so False-padding api_ok is
+        exact, and zero-padding taints means 'no taint in slot'."""
+        fleets, present = [], []
+        for sc in all_scen:
+            cols, pres = _perturb_columns(self.clusters, sc)
+            fleets.append(self.encoder.encode(cols))
+            present.append(pres)
+        T = max(f.taint_key.shape[1] for f in fleets)
+        G = max((f.api_ok.shape[1] for f in fleets), default=0)
+
+        def padt(a):
+            return np.pad(a, [(0, 0), (0, T - a.shape[1])])
+
+        def padg(a):
+            return np.pad(a, [(0, 0), (0, G - a.shape[1])])
+
+        stacks = (
+            np.stack([f.alive for f in fleets]),
+            np.stack([f.capacity for f in fleets]),
+            np.stack([f.has_summary for f in fleets]),
+            np.stack([padt(f.taint_key) for f in fleets]),
+            np.stack([padt(f.taint_value) for f in fleets]),
+            np.stack([padt(f.taint_effect) for f in fleets]),
+            np.stack([padg(f.api_ok) for f in fleets]),
+        )
+        present = np.stack(present)
+        tie_idx = np.cumsum(present, axis=1).astype(np.uint64)
+        return stacks, present, tie_idx
+
+    # -- the batched launch (scenario/row chunking + mesh routing) --------
+
+    def _launch_chunks(self, stacks, tie_idx, active, batch, extra_np,
+                       request_dense, topk, has_agg):
+        """Yield (scenario_slice, host_outputs, result_dev) per launch,
+        honoring the S·B·C memory budget. With >1 device and an oversized
+        scenario volume, the scenario axis shards over a 1-d mesh (GSPMD:
+        embarrassingly parallel, no collectives)."""
+        S = tie_idx.shape[0]
+        Bp = len(batch.replicas)
+        C = tie_idx.shape[1]
+        budget = self.max_bc_elems
+        devices = jax.devices()
+        mesh = None
+        if self.autoshard and len(devices) > 1 and S * Bp * C > budget:
+            from jax.sharding import Mesh
+
+            mesh = Mesh(np.array(devices), ("scenarios",))
+            budget = budget * len(devices)
+        per = max(1, budget // max(Bp * C, 1))
+        if mesh is not None:
+            nd = len(devices)
+            per = max((per // nd) * nd, nd)
+        self.last_stats["mesh"] = mesh is not None
+
+        batch_args = (
+            batch.replicas, batch.unknown_request, batch.gvk, batch.strategy,
+            batch.fresh, batch.tol_tables, batch.tol_idx, batch.aff_masks,
+            batch.aff_idx, batch.weight_tables, batch.weight_idx,
+            batch.prev_idx, batch.prev_rep, batch.evict_idx, batch.seeds,
+            batch.req_unique, batch.req_idx, extra_np, request_dense,
+        )
+        for s0 in range(0, S, per):
+            s1 = min(s0 + per, S)
+            fa = [a[s0:s1] for a in stacks] + [tie_idx[s0:s1], active[s0:s1]]
+            n_live = s1 - s0
+            if mesh is not None:
+                from jax.sharding import NamedSharding, PartitionSpec as P
+
+                nd = len(devices)
+                pad = (-n_live) % nd
+                if pad:
+                    fa = [
+                        np.concatenate([a, np.repeat(a[:1], pad, axis=0)])
+                        for a in fa
+                    ]
+                fa = [
+                    jax.device_put(
+                        a,
+                        NamedSharding(
+                            mesh, P("scenarios", *([None] * (a.ndim - 1)))
+                        ),
+                    )
+                    for a in fa
+                ]
+            out = _sim_kernel(*fa, *batch_args, topk=topk, has_agg=has_agg)
+            simulation_solves.inc(mode="batched")
+            self.last_stats["batched_solves"] += 1
+            host = jax.device_get(out[:8])
+            host = tuple(np.asarray(h)[:n_live] for h in host)
+            yield slice(s0, s1), host, out[8]
+
+    # -- public API -------------------------------------------------------
+
+    def simulate(self, bindings: Sequence, scenarios: Sequence[Scenario],
+                 extra_avail=None):
+        """Evaluate `scenarios` (plus an implicit baseline) against
+        `bindings` on this fleet. Returns (baseline_outcome, outcomes) where
+        outcomes[i] corresponds to scenarios[i]. Mutates nothing — neither
+        the fleet, nor the bindings, nor any store."""
+        t0 = time.perf_counter()
+        names = self.fleet.names
+        C = len(names)
+        R = len(self.encoder.resources)
+        cluster_names = set(names)
+        all_scen = [Scenario(kind=SCENARIO_BASELINE, name="baseline")]
+        all_scen += list(scenarios)
+        for sc in all_scen[1:]:
+            _validate_steps(scenario_steps(sc), cluster_names)
+        simulation_scenarios.inc(len(all_scen) - 1)
+        S = len(all_scen)
+
+        # union batch: base rows live in every scenario; surge rows only in
+        # their own (rows are independent, so solving a surge row under a
+        # foreign scenario is wasted-but-harmless work that the active mask
+        # excludes from decode and load accounting)
+        union = list(bindings)
+        owner = [-1] * len(bindings)
+        for si, sc in enumerate(all_scen):
+            for st in scenario_steps(sc):
+                if st.kind == SCENARIO_SURGE:
+                    rows = surge_bindings(st, si)
+                    union += rows
+                    owner += [si] * len(rows)
+
+        if extra_avail is not None:
+            extra_u = np.full((len(union), C), -1, np.int32)
+            extra_u[: len(bindings)] = np.asarray(extra_avail, np.int32)
+        else:
+            extra_u = None
+
+        # partition: spread constraints and ordered affinity terms are
+        # host-driven searches — per-scenario exact fallback
+        bat_rows, fb_rows = [], []
+        for i, rb in enumerate(union):
+            p = rb.spec.placement
+            if p is not None and (p.spread_constraints or p.cluster_affinities):
+                fb_rows.append(i)
+            else:
+                bat_rows.append(i)
+
+        self.last_stats = {
+            "scenarios": S - 1,
+            "bindings": len(bindings),
+            "batched_rows": len(bat_rows),
+            "fallback_rows": len(fb_rows),
+            "batched_solves": 0,
+            "fallback_solves": 0,
+            "mesh": False,
+        }
+
+        stacks, present, tie_idx = self._encode_scenario_fleets(all_scen)
+        present_counts = present.sum(axis=1)
+        outcomes = [
+            ScenarioOutcome(sc, C, R, present[si])
+            for si, sc in enumerate(all_scen)
+        ]
+        for ui, si in enumerate(owner):
+            if si >= 0:
+                outcomes[si].injected += 1
+
+        if bat_rows:
+            self._solve_batched(
+                union, owner, bat_rows, all_scen, stacks, present_counts,
+                tie_idx, extra_u, outcomes,
+            )
+        if fb_rows:
+            self._solve_fallback(
+                union, owner, fb_rows, all_scen, present, extra_u, outcomes,
+            )
+
+        # overcommit: scheduled load vs available capacity per cluster
+        cap = stacks[1]  # [S,C,R]
+        hs = stacks[2]  # [S,C]
+        for si, o in enumerate(outcomes):
+            over = (
+                (o.usage > cap[si]).any(-1) & hs[si] & present[si]
+            )
+            o.overcommitted = [names[c] for c in np.nonzero(over)[0]]
+
+        simulation_duration.observe(time.perf_counter() - t0)
+        return outcomes[0], outcomes[1:]
+
+    # -- batched path -----------------------------------------------------
+
+    def _solve_batched(self, union, owner, bat_rows, all_scen, stacks,
+                       present_counts, tie_idx, extra_u, outcomes):
+        names = self.fleet.names
+        C = len(names)
+        S = len(all_scen)
+        max_rows = max(8, self.max_bc_elems // max(C, 1))
+        for g0 in range(0, len(bat_rows), max_rows):
+            group = bat_rows[g0:g0 + max_rows]
+            raw = self.batch_encoder.encode([union[i] for i in group])
+            batch = pad_batch(raw, ArrayScheduler._bucket)
+            Bp = len(batch.replicas)
+            n = len(group)
+
+            # static specializations (mirrors ArrayScheduler._batch_flags'
+            # topk/has_agg derivation; narrow stays off — i64 keys are
+            # always sound and parity does not depend on the narrowing)
+            max_repl = int(raw.replicas.max(initial=0))
+            cand = max_repl
+            dup = raw.strategy == DUPLICATED
+            if dup.any():
+                pc = raw.aff_masks.sum(axis=1)
+                cand = max(cand, int(pc[raw.aff_idx[dup]].max(initial=0)))
+            topk = min(pow2_bucket(max(min(cand, TOPK_TARGETS), 1), lo=8),
+                       min(C, TOPK_TARGETS)) if C else 8
+            topk = max(topk, 1)
+            has_agg = bool((raw.strategy == AGGREGATED).any())
+
+            active = np.zeros((S, Bp), bool)
+            for j, ui in enumerate(group):
+                si = owner[ui]
+                if si < 0:
+                    active[:, j] = True
+                else:
+                    active[si, j] = True
+
+            if extra_u is not None:
+                extra_np = np.full((Bp, C), -1, np.int32)
+                extra_np[:n] = extra_u[group]
+            else:
+                extra_np = np.full((1, 1), -1, np.int32)
+            request_dense = np.asarray(batch.request, np.int64)
+
+            for s_slice, host, result_dev in self._launch_chunks(
+                stacks, tie_idx, active, batch, extra_np, request_dense,
+                topk, has_agg,
+            ):
+                (unsched, avail_sum, feas_count, nnz, top_idx, top_val,
+                 assigned, usage) = host
+                for local, si in enumerate(range(s_slice.start, s_slice.stop)):
+                    o = outcomes[si]
+                    o.assigned += np.asarray(assigned[local], np.int64)
+                    o.usage += np.asarray(usage[local], np.int64)
+                    tis, tvs = _sorted_pairs(top_idx[local], top_val[local])
+                    window = top_idx.shape[2]
+                    overflow: list[tuple[int, int, str, int]] = []
+                    for j, ui in enumerate(group):
+                        if not active[si, j]:
+                            continue
+                        rb = union[ui]
+                        key = raw.keys[j]
+                        strat = int(raw.strategy[j])
+                        if feas_count[local, j] == 0:
+                            o.errors[key] = (
+                                f"0/{int(present_counts[si])} clusters are "
+                                "available"
+                            )
+                        elif unsched[local, j]:
+                            o.errors[key] = (
+                                "Clusters available replicas "
+                                f"{int(avail_sum[local, j])} are not enough "
+                                "to schedule."
+                            )
+                        elif strat == NON_WORKLOAD:
+                            o.placements[key] = []
+                        elif int(nnz[local, j]) > window:
+                            overflow.append((local, j, key, si))
+                        else:
+                            k = int(nnz[local, j])
+                            o.placements[key] = [
+                                TargetCluster(
+                                    name=names[int(tis[j, t])],
+                                    replicas=int(tvs[j, t]),
+                                )
+                                for t in range(k)
+                            ]
+                    if overflow:
+                        rows_j = np.asarray([j for _, j, _, _ in overflow])
+                        dense = np.asarray(
+                            jax.device_get(result_dev[local][rows_j])
+                        )
+                        for m, (_, _, key, si2) in enumerate(overflow):
+                            pos = np.nonzero(dense[m] > 0)[0]
+                            outcomes[si2].placements[key] = [
+                                TargetCluster(
+                                    name=names[int(i)],
+                                    replicas=int(dense[m, i]),
+                                )
+                                for i in pos
+                            ]
+
+    # -- exact fallback (spread / multi-term affinity rows) ---------------
+
+    def _solve_fallback(self, union, owner, fb_rows, all_scen, present,
+                        extra_u, outcomes):
+        req_cols = self.encoder.resources
+        for si, sc in enumerate(all_scen):
+            rows = [i for i in fb_rows if owner[i] in (-1, si)]
+            if not rows:
+                continue
+            ref_clusters = apply_scenario_objects(self.clusters, sc)
+            sub = [union[i] for i in rows]
+            sub_extra = None
+            if extra_u is not None:
+                sub_extra = extra_u[rows][:, present[si]]
+            sched = ArrayScheduler(ref_clusters)
+            decisions = sched.schedule(sub, extra_avail=sub_extra)
+            simulation_solves.inc(mode="fallback")
+            self.last_stats["fallback_solves"] += 1
+            o = outcomes[si]
+            name_to_col = {n: c for c, n in enumerate(self.fleet.names)}
+            for rb, dec in zip(sub, decisions):
+                key = rb.metadata.key()
+                if not dec.ok:
+                    o.errors[key] = dec.error
+                    continue
+                targets = list(dec.targets or [])
+                o.placements[key] = targets
+                # fold fallback load into the per-cluster accounting
+                req = np.zeros(len(req_cols), np.int64)
+                rr = rb.spec.replica_requirements
+                if rr is not None:
+                    for rname, val in rr.resource_request.items():
+                        if rname in req_cols:
+                            req[req_cols.index(rname)] = to_int_units(rname, val)
+                for tc in targets:
+                    c = name_to_col.get(tc.name)
+                    if c is not None:
+                        o.assigned[c] += tc.replicas
+                        o.usage[c] += tc.replicas * req

@@ -292,6 +292,105 @@ def test_estimator_answer_change_invalidates_replay(fleet):
     )
 
 
+def test_replay_survives_object_identity_change(fleet):
+    """The daemon path re-fetches bindings through the store's deepcopy (or
+    the wire codec), so the cached entry never sees the SAME placement/
+    requirements objects again — replay must engage on VALUE equality
+    (ROADMAP open item: identity-only compare defeated out-of-process
+    replay entirely)."""
+    clusters, names = fleet
+    bindings = mixed_bindings(names)
+    inc = ArrayScheduler(clusters)
+    inc.schedule_incremental(bindings)
+    clones = [copy.deepcopy(rb) for rb in bindings]
+    got = inc.schedule_incremental(clones)
+    assert inc.last_round_stats == {"replayed": len(bindings), "solved": 0}
+    assert_same_decisions(got, ArrayScheduler(clusters).schedule(bindings))
+    # a genuine spec change in a clone still re-solves
+    clones2 = [copy.deepcopy(rb) for rb in bindings]
+    clones2[1].spec.replicas += 3
+    bump(clones2[1])
+    inc.schedule_incremental(clones2)
+    assert inc.last_round_stats["solved"] == 1
+
+
+def test_replay_engages_through_daemon_store_path():
+    """Acceptance: replay > 0 across the daemon path — the SchedulerDaemon
+    fetches every binding through Store.get (a deepcopy per fetch), so this
+    exercises exactly the out-of-process object-identity break."""
+    pytest.importorskip("cryptography")  # ControlPlane builds a cluster CA
+    from karmada_tpu.api.meta import CPU, MEMORY
+    from karmada_tpu.controlplane import ControlPlane
+    from karmada_tpu.members.member import MemberConfig
+    from karmada_tpu.testing.fixtures import (
+        new_deployment,
+        new_policy,
+        selector_for,
+    )
+
+    GiB = 1024.0**3
+    cp = ControlPlane()
+    for name in ("a", "b"):
+        cp.join_member(MemberConfig(
+            name=name,
+            allocatable={CPU: 50.0, MEMORY: 200 * GiB, "pods": 500.0},
+        ))
+    dep = new_deployment("default", "web", replicas=2, cpu=0.1)
+    cp.store.create(dep)
+    cp.store.create(new_policy(
+        "default", "pp", [selector_for(dep)], duplicated_placement([])
+    ))
+    cp.settle()
+    rb = cp.store.get("ResourceBinding", "web-deployment", "default")
+    assert rb.spec.clusters, "binding never scheduled"
+    # metadata-only touch: MODIFIED event, generation unchanged — the
+    # Duplicated trigger re-schedules it with identical solve inputs
+    # fetched through the store deepcopy, which must REPLAY
+    rb.metadata.labels["touch"] = "1"
+    cp.store.update(rb)
+    cp.settle()
+    assert cp.scheduler._array is not None
+    assert cp.scheduler._array.last_round_stats["replayed"] > 0
+
+
+def test_estimator_digests_lazy_after_epoch_bump(fleet, monkeypatch):
+    """An epoch-invalidated round must not hash estimator rows before the
+    cheap epoch check (ROADMAP open item) — every entry is stale, so no
+    digest should be computed during the match scan (only at cache-write
+    time for the rows that re-solve)."""
+    from karmada_tpu.sched import incremental as inc_mod
+
+    clusters, names = fleet
+    bindings = [
+        make_binding(f"d{i}", 6 + i, dyn_placement(), cpu=0.5)
+        for i in range(4)
+    ]
+    B, C = len(bindings), len(clusters)
+    extra = np.full((B, C), 40, np.int32)
+    inc = ArrayScheduler(clusters)
+    inc.schedule_incremental(bindings, extra_avail=extra)
+
+    calls = {"n": 0}
+    real = inc_mod.extra_digest
+
+    def counting(row):
+        calls["n"] += 1
+        return real(row)
+
+    monkeypatch.setattr(inc_mod, "extra_digest", counting)
+    # warm replay round: one digest per row (needed to validate the match)
+    inc.schedule_incremental(bindings, extra_avail=extra)
+    assert inc.last_round_stats["replayed"] == B
+    assert calls["n"] == B
+
+    calls["n"] = 0
+    inc.fleet_epoch += 1  # cluster change: every entry stale by epoch alone
+    inc.schedule_incremental(bindings, extra_avail=extra)
+    assert inc.last_round_stats["solved"] == B
+    # digests only at cache-write time — never during the (failed) matching
+    assert calls["n"] == B
+
+
 # -- automatic backend selection (oversized → mesh) ------------------------
 
 

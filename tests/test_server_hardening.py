@@ -259,3 +259,70 @@ class TestTokenOverPlaintextGuard:
         )
         with reaping(proc):
             pass
+
+
+class TestScrapeToken:
+    """Dedicated read-only scrape token (ROADMAP open item): GET /metrics
+    accepts it, NOTHING else does — a leaked Prometheus credential can
+    neither read objects nor mutate the plane."""
+
+    @staticmethod
+    def _get(url, token=None):
+        import urllib.error
+        import urllib.request
+
+        req = urllib.request.Request(url)
+        if token is not None:
+            req.add_header("Authorization", f"Bearer {token}")
+        try:
+            with urllib.request.urlopen(req, timeout=10) as r:
+                return r.status, r.read().decode()
+        except urllib.error.HTTPError as e:
+            return e.code, ""
+
+    def test_apiserver_scrape_token_metrics_only(self, plane):
+        srv = ControlPlaneServer(plane, token="wire-secret",
+                                 scrape_token="scrape-secret")
+        port = srv.start()
+        base = f"http://127.0.0.1:{port}"
+        try:
+            # scrape token: /metrics yes, everything else 401
+            code, body = self._get(f"{base}/metrics", "scrape-secret")
+            assert code == 200 and "karmada_" in body
+            code, _ = self._get(f"{base}/objects?kind=Cluster",
+                                "scrape-secret")
+            assert code == 401
+            code, _ = self._get(f"{base}/kinds", "scrape-secret")
+            assert code == 401
+            # the wire token still reads /metrics (back-compat)
+            code, _ = self._get(f"{base}/metrics", "wire-secret")
+            assert code == 200
+            # no token at all stays rejected
+            code, _ = self._get(f"{base}/metrics")
+            assert code == 401
+        finally:
+            srv.stop()
+
+    def test_metricsserver_scrape_token(self):
+        from karmada_tpu.server.metricsserver import MetricsServer
+
+        srv = MetricsServer(token="wire-secret", scrape_token="scrape-secret")
+        port = srv.start()
+        base = f"http://127.0.0.1:{port}"
+        try:
+            assert self._get(f"{base}/metrics", "scrape-secret")[0] == 200
+            assert self._get(f"{base}/metrics", "wire-secret")[0] == 200
+            assert self._get(f"{base}/metrics", "wrong")[0] == 401
+            assert self._get(f"{base}/metrics")[0] == 401
+            assert self._get(f"{base}/healthz")[0] == 200
+        finally:
+            srv.stop()
+
+    def test_daemon_flags_exist(self):
+        # every daemon with a metrics surface takes --scrape-token-file
+        import karmada_tpu.descheduler.__main__ as dmain
+        import karmada_tpu.sched.__main__ as smain
+        import karmada_tpu.server.__main__ as srvmain
+
+        for mod in (dmain, smain, srvmain):
+            assert "--scrape-token-file" in open(mod.__file__).read()

@@ -1,0 +1,710 @@
+"""What-if simulation plane: the vmapped [S,B,C] scenario batch must be
+indistinguishable from S independent cold solves (Drain bit-identical to
+actually removing the cluster), the whole batch must cost ONE device launch
+(solve-count metric), and every consumer — POST /simulate, karmadactl
+simulate, descheduler --dry-run, FederatedResourceQuota preflight — must
+mutate nothing it does not own."""
+from __future__ import annotations
+
+import copy
+import json
+
+import numpy as np
+import pytest
+
+from karmada_tpu.api.meta import CPU, MEMORY, ObjectMeta, new_uid
+from karmada_tpu.api.simulation import (
+    SCENARIO_CAPACITY,
+    SCENARIO_DRAIN,
+    SCENARIO_LOSS,
+    SCENARIO_SURGE,
+    SCENARIO_TAINT,
+    Scenario,
+    SimulationRequest,
+    SimulationRequestSpec,
+)
+from karmada_tpu.api.work import (
+    BindingSpec,
+    ObjectReference,
+    ReplicaRequirements,
+    ResourceBinding,
+    TargetCluster,
+)
+from karmada_tpu.metrics import simulation_solves
+from karmada_tpu.sched.core import ArrayScheduler
+from karmada_tpu.simulation import Simulator, apply_scenario_objects
+from karmada_tpu.simulation.engine import (
+    SimulationError,
+    scenario_steps,
+    surge_bindings,
+)
+from karmada_tpu.testing.fixtures import (
+    duplicated_placement,
+    static_weight_placement,
+    synthetic_fleet,
+)
+from tests.test_parallel import dyn_placement, make_binding
+
+GiB = 1024.0**3
+
+
+def fp(targets):
+    return tuple(sorted((t.name, t.replicas) for t in (targets or [])))
+
+
+def mixed_bindings(names, n=16):
+    bindings = []
+    for i in range(n):
+        kind = i % 4
+        if kind == 0:
+            p = duplicated_placement(names[: 3 + i % 4])
+        elif kind == 1:
+            p = static_weight_placement({names[j]: j + 1 for j in range(3)})
+        else:
+            p = dyn_placement(aggregated=(kind == 3))
+        prev = {names[i % len(names)]: 2} if i % 3 == 0 else None
+        bindings.append(make_binding(f"app-{i}", 4 + i, p, cpu=0.5, prev=prev))
+    return bindings
+
+
+@pytest.fixture()
+def fleet():
+    clusters = synthetic_fleet(12, seed=7)
+    return clusters, [c.name for c in clusters]
+
+
+def scenario_set(names):
+    return [
+        Scenario(kind=SCENARIO_DRAIN, cluster=names[4]),
+        Scenario(kind=SCENARIO_LOSS, cluster=names[2]),
+        Scenario(kind=SCENARIO_TAINT, cluster=names[0], taint_key="sim",
+                 taint_value="x"),
+        Scenario(kind=SCENARIO_CAPACITY, cluster=names[1],
+                 resources={"cpu": -500.0}),
+        Scenario(kind=SCENARIO_SURGE, surge_count=4, surge_replicas=3,
+                 surge_request={"cpu": 1.0}),
+    ]
+
+
+def assert_outcome_matches_reference(clusters, bindings, scenario, outcome,
+                                     scenario_index):
+    """The acceptance bar: each scenario outcome equals the cold solve of
+    the scenario applied at OBJECT level (drain = the cluster REMOVED from
+    the fleet — bit-identical placements, same error strings)."""
+    ref_clusters = apply_scenario_objects(clusters, scenario)
+    extra_rows = []
+    for st in scenario_steps(scenario):
+        if st.kind == SCENARIO_SURGE:
+            extra_rows += surge_bindings(st, scenario_index)
+    rows = list(bindings) + extra_rows
+    want = ArrayScheduler(ref_clusters).schedule(rows)
+    for rb, w in zip(rows, want):
+        key = rb.metadata.key()
+        if w.ok:
+            assert key in outcome.placements, (scenario.kind, key,
+                                               outcome.errors.get(key))
+            assert fp(outcome.placements[key]) == fp(w.targets), (
+                scenario.kind, key,
+            )
+        else:
+            assert outcome.errors.get(key) == w.error, (scenario.kind, key)
+
+
+class TestEngineParity:
+    def test_drain_bit_identical_to_cluster_removal(self, fleet):
+        clusters, names = fleet
+        bindings = mixed_bindings(names)
+        sim = Simulator(clusters)
+        drain = Scenario(kind=SCENARIO_DRAIN, cluster=names[4])
+        _, (out,) = sim.simulate(bindings, [drain])
+        removed = [c for c in clusters if c.name != names[4]]
+        want = ArrayScheduler(removed).schedule(bindings)
+        for rb, w in zip(bindings, want):
+            key = rb.metadata.key()
+            if w.ok:
+                assert fp(out.placements[key]) == fp(w.targets), key
+                assert all(
+                    t.name != names[4] for t in out.placements[key]
+                ), key
+            else:
+                assert out.errors[key] == w.error, key
+
+    def test_scenario_batch_equals_independent_solves(self, fleet):
+        """One vmapped S-scenario batch == S independent single-scenario
+        cold solves, across every scenario kind."""
+        clusters, names = fleet
+        bindings = mixed_bindings(names)
+        scenarios = scenario_set(names)
+        sim = Simulator(clusters)
+        baseline, outs = sim.simulate(bindings, scenarios)
+        assert sim.last_stats["batched_solves"] == 1
+        assert sim.last_stats["fallback_solves"] == 0
+        # baseline = plain cold solve of the unperturbed fleet
+        want = ArrayScheduler(clusters).schedule(bindings)
+        for rb, w in zip(bindings, want):
+            key = rb.metadata.key()
+            if w.ok:
+                assert fp(baseline.placements[key]) == fp(w.targets), key
+            else:
+                assert baseline.errors[key] == w.error, key
+        for si, (sc, out) in enumerate(zip(scenarios, outs), start=1):
+            assert_outcome_matches_reference(clusters, bindings, sc, out, si)
+
+    def test_sixteen_scenarios_one_batched_solve(self, fleet):
+        """Acceptance: S=16 scenarios over a churn-style binding set return
+        per-scenario reports from ONE batched vmapped solve, asserted via
+        the solve-count metric."""
+        clusters, names = fleet
+        bindings = mixed_bindings(names, n=24)
+        scenarios = [
+            Scenario(kind=SCENARIO_DRAIN, cluster=names[k % len(names)])
+            if k % 2 == 0
+            else Scenario(kind=SCENARIO_LOSS, cluster=names[k % len(names)])
+            for k in range(16)
+        ]
+        before = simulation_solves.value(mode="batched")
+        sim = Simulator(clusters)
+        baseline, outs = sim.simulate(bindings, scenarios)
+        assert simulation_solves.value(mode="batched") == before + 1
+        assert sim.last_stats["batched_solves"] == 1
+        assert len(outs) == 16
+        for out in outs:
+            assert out.placements or out.errors
+
+    def test_spread_rows_take_exact_fallback(self, fleet):
+        """Spread-constrained rows cannot ride the dense kernel — they must
+        still produce correct per-scenario outcomes via the fallback."""
+        from karmada_tpu.api import policy as pol
+
+        clusters, names = fleet
+        spread = pol.Placement(
+            cluster_affinity=pol.ClusterAffinity(cluster_names=[]),
+            spread_constraints=[pol.SpreadConstraint(
+                spread_by_field=pol.SPREAD_BY_FIELD_REGION, min_groups=2,
+            )],
+        )
+        bindings = mixed_bindings(names, n=6)
+        bindings.append(make_binding("ha-app", 4, spread, cpu=0.25))
+        sim = Simulator(clusters)
+        drain = Scenario(kind=SCENARIO_DRAIN, cluster=names[3])
+        _, (out,) = sim.simulate(bindings, [drain])
+        assert sim.last_stats["fallback_rows"] == 1
+        assert sim.last_stats["fallback_solves"] >= 1
+        assert_outcome_matches_reference(clusters, bindings, drain, out, 1)
+
+    def test_oversized_batch_routes_to_scenario_mesh(self, fleet):
+        """S·B·C past the memory envelope with >1 device: the scenario axis
+        shards over the device mesh, outputs unchanged."""
+        clusters, names = fleet
+        bindings = mixed_bindings(names)
+        scenarios = scenario_set(names)[:4]
+        small = Simulator(clusters, max_bc_elems=64)
+        baseline_s, outs_s = small.simulate(bindings, scenarios)
+        assert small.last_stats["mesh"] is True
+        big = Simulator(clusters)
+        baseline_b, outs_b = big.simulate(bindings, scenarios)
+        assert big.last_stats["mesh"] is False
+        for a, b in zip([baseline_s] + outs_s, [baseline_b] + outs_b):
+            assert a.errors == b.errors
+            assert set(a.placements) == set(b.placements)
+            for key in a.placements:
+                assert fp(a.placements[key]) == fp(b.placements[key]), key
+
+    def test_unknown_cluster_is_client_error(self, fleet):
+        clusters, names = fleet
+        sim = Simulator(clusters)
+        with pytest.raises(SimulationError, match="unknown cluster"):
+            sim.simulate(mixed_bindings(names, n=2),
+                         [Scenario(kind=SCENARIO_DRAIN, cluster="nope")])
+
+    def test_surge_overcommit_reported(self, fleet):
+        """A surge big enough to outrun fleet capacity shows up as
+        unplaceable rows (dynamic rows respect the estimator) and the
+        scenario carries its injected-row count."""
+        clusters, names = fleet
+        sim = Simulator(clusters)
+        surge = Scenario(kind=SCENARIO_SURGE, surge_count=3,
+                         surge_replicas=10 ** 6,
+                         surge_request={"cpu": 8.0})
+        _, (out,) = sim.simulate(mixed_bindings(names, n=4), [surge])
+        assert out.injected == 3
+        surge_keys = [k for k in list(out.errors) + list(out.placements)
+                      if k.startswith("karmada-simulation/")]
+        assert len(surge_keys) == 3
+        assert any(k in out.errors for k in surge_keys)
+
+
+def _store_image(store):
+    """Byte-level store snapshot: every kind, every object, wire-encoded
+    (includes resourceVersion, so ANY write shows up)."""
+    from karmada_tpu.server import codec
+
+    out = {}
+    for kind in sorted(store.kinds()):
+        out[kind] = sorted(
+            json.dumps(codec.encode(o), sort_keys=True, default=str)
+            for o in store.list(kind)
+        )
+    return json.dumps(out, sort_keys=True)
+
+
+def _plane_with_stuck_binding():
+    """A placed workload whose member shrank under it — the descheduler has
+    a genuine eviction set (mirrors test_estimator.TestDescheduler)."""
+    pytest.importorskip("cryptography")  # ControlPlane builds a cluster CA
+    from karmada_tpu.controlplane import ControlPlane
+    from karmada_tpu.members.member import MemberConfig
+    from karmada_tpu.models.nodes import NodeSpec
+    from karmada_tpu.testing.fixtures import (
+        new_deployment, new_policy, selector_for,
+    )
+    from tests.test_scheduler_core import dyn_placement as dyn
+
+    cp = ControlPlane()
+    for name in ("a", "b"):
+        cp.join_member(MemberConfig(
+            name=name,
+            nodes=[NodeSpec(name="n1",
+                            allocatable={CPU: 10.0, MEMORY: 40 * GiB})],
+        ))
+    deploy = new_deployment("default", "web", replicas=10, cpu=1.0)
+    cp.store.create(deploy)
+    cp.store.create(new_policy("default", "pp", [selector_for(deploy)], dyn()))
+    cp.settle()
+    est_a = cp.members["a"].node_estimator
+    est_a.arrays.alloc[0, 0] = 2000  # 2 cpu left in millicores
+    obj = cp.members["a"].get("apps/v1", "Deployment", "web", "default")
+    if obj is not None:
+        cp.members["a"].apply_manifest(obj.to_dict())
+    cp.settle()
+    cp.runtime.clock.advance(600)  # past the unschedulable threshold
+    return cp
+
+
+class TestDeschedulerDryRun:
+    def test_dry_run_reports_and_store_stays_byte_identical(self):
+        cp = _plane_with_stuck_binding()
+        before = _store_image(cp.store)
+        report = cp.run_descheduler_dryrun()
+        assert _store_image(cp.store) == before, "dry-run wrote to the store"
+        assert report.bindings == 1
+        (row,) = report.scenarios
+        assert row.scenario.name == "descheduler-evictions"
+        # the simulated re-placement moves replicas off the shrunk member
+        assert row.displaced >= 1
+        assert row.diffs and row.diffs[0].binding == "default/web-deployment"
+        # dry-run report is NOT persisted
+        assert cp.store.list("SimulationReport") == []
+        # and the live sweep (the thing dry-run previews) still works after
+        assert cp.run_descheduler() == 1
+
+    def test_dry_run_empty_when_nothing_to_deschedule(self):
+        pytest.importorskip("cryptography")
+        from karmada_tpu.controlplane import ControlPlane
+
+        cp = ControlPlane()
+        report = cp.run_descheduler_dryrun()
+        assert report.scenarios == []
+        assert report.bindings == 0
+
+
+class TestQuotaPreflight:
+    def _plane(self):
+        pytest.importorskip("cryptography")
+        from karmada_tpu.controlplane import ControlPlane
+        from karmada_tpu.members.member import MemberConfig
+        from karmada_tpu.testing.fixtures import (
+            new_deployment, new_policy, selector_for,
+        )
+        from tests.test_scheduler_core import dyn_placement as dyn
+
+        cp = ControlPlane()
+        for name in ("a", "b"):
+            cp.join_member(MemberConfig(
+                name=name, allocatable={CPU: 10.0, MEMORY: 40 * GiB,
+                                        "pods": 100.0},
+            ))
+        deploy = new_deployment("default", "web", replicas=8, cpu=1.0)
+        cp.store.create(deploy)
+        cp.store.create(
+            new_policy("default", "pp", [selector_for(deploy)], dyn())
+        )
+        cp.settle()
+        rb = cp.store.get("ResourceBinding", "web-deployment", "default")
+        assert sum(tc.replicas for tc in rb.spec.clusters) == 8
+        return cp
+
+    def _frq(self, caps):
+        from karmada_tpu.api.search import (
+            FederatedResourceQuota,
+            FederatedResourceQuotaSpec,
+            StaticClusterAssignment,
+        )
+
+        return FederatedResourceQuota(
+            metadata=ObjectMeta(name="quota", namespace="default"),
+            spec=FederatedResourceQuotaSpec(
+                overall={"cpu": 100.0},
+                static_assignments=[
+                    StaticClusterAssignment(cluster_name=c, hard={"cpu": h})
+                    for c, h in caps.items()
+                ],
+            ),
+        )
+
+    def test_stranding_quota_rejected(self):
+        from karmada_tpu.webhook import AdmissionDenied
+
+        cp = self._plane()
+        with pytest.raises(AdmissionDenied, match="strands replicas"):
+            cp.store.create(self._frq({"a": 0.5, "b": 0.5}))
+        assert cp.store.list("FederatedResourceQuota") == []
+
+    def test_generous_quota_admitted_and_status_updates_skip_solve(self):
+        cp = self._plane()
+        cp.store.create(self._frq({"a": 100.0, "b": 100.0}))
+        frq = cp.store.get("FederatedResourceQuota", "quota", "default")
+        before = simulation_solves.value(mode="batched")
+        # status-only write: the preflight must not re-run the solve
+        frq.status.overall_used = {"cpu": 1.0}
+        cp.store.update(frq)
+        assert simulation_solves.value(mode="batched") == before
+
+    def test_tightening_update_rejected(self):
+        from karmada_tpu.webhook import AdmissionDenied
+
+        cp = self._plane()
+        cp.store.create(self._frq({"a": 100.0, "b": 100.0}))
+        frq = cp.store.get("FederatedResourceQuota", "quota", "default")
+        frq.spec.static_assignments[0].hard["cpu"] = 0.5
+        frq.spec.static_assignments[1].hard["cpu"] = 0.5
+        with pytest.raises(AdmissionDenied, match="strands replicas"):
+            cp.store.update(frq)
+
+
+def _served_plane():
+    pytest.importorskip("cryptography")  # ControlPlane builds a cluster CA
+    from karmada_tpu.controlplane import ControlPlane
+    from karmada_tpu.members.member import MemberConfig
+    from karmada_tpu.server.apiserver import ControlPlaneServer
+    from karmada_tpu.testing.fixtures import (
+        new_deployment, new_policy, selector_for,
+    )
+
+    cp = ControlPlane()
+    for i in range(1, 4):
+        cp.join_member(MemberConfig(
+            name=f"member{i}", region=f"region-{i}",
+            allocatable={CPU: 50.0, MEMORY: 200 * GiB, "pods": 500.0},
+        ))
+    for i in range(3):
+        dep = new_deployment("default", f"web-{i}", replicas=4, cpu=0.5)
+        cp.store.create(dep)
+        cp.store.create(new_policy(
+            "default", f"pp-{i}", [selector_for(dep)],
+            duplicated_placement([]),
+        ))
+    cp.settle()
+    srv = ControlPlaneServer(cp)
+    srv.start()
+    return cp, srv
+
+
+class TestSimulateAPI:
+    def test_post_simulate_end_to_end(self):
+        """POST /simulate over the wire: scenarios in, per-scenario
+        displacement report out of ONE batched vmapped solve; the report
+        persists for `karmadactl get simulationreports`."""
+        from karmada_tpu.api.simulation import SimulationReport
+        from karmada_tpu.cli.karmadactl import run
+        from karmada_tpu.server.remote import RemoteControlPlane
+
+        cp, srv = _served_plane()
+        try:
+            rcp = RemoteControlPlane(srv.url)
+            scenarios = [
+                Scenario(kind=SCENARIO_DRAIN, cluster="member1"),
+                Scenario(kind=SCENARIO_SURGE, surge_count=2,
+                         surge_replicas=2, surge_request={"cpu": 0.5}),
+            ]
+            before = simulation_solves.value(mode="batched")
+            report = rcp.simulate(SimulationRequest(
+                spec=SimulationRequestSpec(scenarios=scenarios)
+            ))
+            assert isinstance(report, SimulationReport)
+            assert simulation_solves.value(mode="batched") == before + 1
+            assert report.batched_solves == 1
+            assert len(report.scenarios) == 2
+            drain_row = report.scenarios[0]
+            assert drain_row.scenario.kind == SCENARIO_DRAIN
+            # duplicated rows lose their member1 copy → displaced
+            assert drain_row.displaced >= 1
+            # persisted for after-the-fact review
+            stored = cp.store.list("SimulationReport")
+            assert [r.metadata.name for r in stored] == [report.metadata.name]
+            table = run(cp, ["get", "simulationreports"])
+            assert report.metadata.name in table
+            assert "DISPLACED" in table
+        finally:
+            srv.stop()
+
+    def test_post_simulate_unknown_cluster_400(self):
+        from karmada_tpu.server.remote import RemoteControlPlane, RemoteError
+
+        cp, srv = _served_plane()
+        try:
+            rcp = RemoteControlPlane(srv.url)
+            with pytest.raises(RemoteError, match="HTTP 400"):
+                rcp.simulate(SimulationRequest(spec=SimulationRequestSpec(
+                    scenarios=[Scenario(kind=SCENARIO_DRAIN, cluster="nope")]
+                )))
+        finally:
+            srv.stop()
+
+    def test_report_retention_prunes_to_last_n(self):
+        cp, srv = _served_plane()
+        try:
+            cp.simulation_report_history = 2
+            for k in range(3):
+                cp.simulate(SimulationRequest(spec=SimulationRequestSpec(
+                    scenarios=[Scenario(kind=SCENARIO_LOSS,
+                                        cluster="member2")],
+                )))
+            stored = cp.store.list("SimulationReport")
+            assert len(stored) == 2
+        finally:
+            srv.stop()
+
+
+class TestKarmadactlSimulate:
+    def test_simulate_table_output(self):
+        from karmada_tpu.cli.karmadactl import run
+
+        cp, srv = _served_plane()
+        try:
+            out = run(cp, [
+                "simulate", "--drain", "member1",
+                "--capacity", "member2:cpu=-40",
+                "--surge", "3:replicas=2:cpu=0.5",
+            ])
+            assert "SCENARIO" in out and "DISPLACED" in out
+            assert "drain(member1)" in out
+            assert "capacity(member2:cpu-40)" in out
+            assert "surge(3x2)" in out
+        finally:
+            srv.stop()
+
+    def test_simulate_requires_scenarios(self):
+        from karmada_tpu.cli.karmadactl import CLIError, run
+
+        cp, srv = _served_plane()
+        try:
+            with pytest.raises(CLIError, match="nothing to simulate"):
+                run(cp, ["simulate"])
+        finally:
+            srv.stop()
+
+    def test_deschedule_dry_run_via_cli(self):
+        from karmada_tpu.cli.karmadactl import run
+
+        cp = _plane_with_stuck_binding()
+        before = _store_image(cp.store)
+        out = run(cp, ["deschedule", "--dry-run"])
+        assert "dry-run" in out
+        assert _store_image(cp.store) == before
+
+
+class _StubRegistry:
+    """min_unschedulable stub: every undesired cluster has N replicas that
+    can never start."""
+
+    def __init__(self, n=2):
+        self.n = n
+
+    def min_unschedulable(self, clusters, resource, threshold):
+        return [self.n] * len(clusters)
+
+
+class TestDryRunStoreLevel:
+    """Descheduler dry-run against a bare Store (no ControlPlane, so it
+    runs even without the optional cryptography dependency): the eviction
+    set goes through the simulator and the store stays byte-identical."""
+
+    def _store(self, fleet):
+        from karmada_tpu.api.work import AggregatedStatusItem
+        from karmada_tpu.store.store import Store
+
+        clusters, names = fleet
+        store = Store()
+        for i, c in enumerate(clusters):
+            c = copy.deepcopy(c)
+            if i == 0:
+                # the shrunk member has NO headroom left: the simulated
+                # re-solve must place the freed replicas elsewhere
+                rs = c.status.resource_summary
+                rs.allocated = dict(rs.allocatable)
+            store.create(c)
+        rb = make_binding(
+            "stuck", 10, dyn_placement(aggregated=True), cpu=0.5,
+            prev={names[0]: 6, names[1]: 4},
+        )
+        rb.status.aggregated_status = [
+            AggregatedStatusItem(cluster_name=names[0],
+                                 status={"readyReplicas": 2}),
+            AggregatedStatusItem(cluster_name=names[1],
+                                 status={"readyReplicas": 4}),
+        ]
+        store.create(rb)
+        return store
+
+    def test_dry_run_mutates_nothing_and_reports(self, fleet):
+        from karmada_tpu.descheduler.descheduler import Descheduler
+
+        store = self._store(fleet)
+        d = Descheduler(store, _StubRegistry(n=3))
+        before = _store_image(store)
+        report = d.deschedule_dryrun()
+        assert _store_image(store) == before, "dry-run wrote to the store"
+        assert report.bindings == 1
+        (row,) = report.scenarios
+        assert row.scenario.name == "descheduler-evictions"
+        assert row.injected == 1
+        assert row.diffs and row.diffs[0].binding == "default/stuck"
+        # the live sweep it previews DOES mutate — shared shrink logic
+        assert d.deschedule_once() == 1
+        assert _store_image(store) != before
+
+    def test_dry_run_and_live_share_shrink_logic(self, fleet):
+        from karmada_tpu.descheduler.descheduler import Descheduler
+
+        store = self._store(fleet)
+        d = Descheduler(store, _StubRegistry(n=3))
+        rb = store.list("ResourceBinding")[0]
+        proposed = d._proposed_targets(rb)
+        d.deschedule_once()
+        after = store.list("ResourceBinding")[0]
+        assert fp(after.spec.clusters) == fp(proposed)
+
+
+class TestQuotaPreflightStoreLevel:
+    """The preflight validator against a bare Store + a hand-built
+    AdmissionRequest — exercises the deny/allow logic without the full
+    plane's optional dependencies."""
+
+    def _setup(self, fleet):
+        from karmada_tpu.store.store import Store
+
+        clusters, names = fleet
+        store = Store()
+        for c in clusters:
+            store.create(copy.deepcopy(c))
+        store.create(make_binding("app", 8, dyn_placement(), cpu=1.0))
+        return store, names
+
+    def _frq(self, caps):
+        from karmada_tpu.api.search import (
+            FederatedResourceQuota,
+            FederatedResourceQuotaSpec,
+            StaticClusterAssignment,
+        )
+
+        return FederatedResourceQuota(
+            metadata=ObjectMeta(name="quota", namespace="default"),
+            spec=FederatedResourceQuotaSpec(
+                overall={"cpu": 1000.0},
+                static_assignments=[
+                    StaticClusterAssignment(cluster_name=c, hard={"cpu": h})
+                    for c, h in caps.items()
+                ],
+            ),
+        )
+
+    def test_denies_stranding_caps(self, fleet):
+        from karmada_tpu.simulation.preflight import QuotaPreflight
+        from karmada_tpu.webhook.admission import AdmissionDenied, AdmissionRequest
+
+        store, names = self._setup(fleet)
+        pf = QuotaPreflight(store)
+        # cap EVERY cluster to a sliver of cpu: 8x1cpu cannot fit anywhere
+        frq = self._frq({n: 0.25 for n in names})
+        req = AdmissionRequest(operation="CREATE", kind="FederatedResourceQuota",
+                               obj=frq)
+        with pytest.raises(AdmissionDenied, match="strands replicas"):
+            pf.validate(req)
+
+    def test_allows_generous_caps_and_skips_status_writes(self, fleet):
+        from karmada_tpu.simulation.preflight import QuotaPreflight
+        from karmada_tpu.webhook.admission import AdmissionRequest
+
+        store, names = self._setup(fleet)
+        pf = QuotaPreflight(store)
+        frq = self._frq({n: 10_000.0 for n in names})
+        pf.validate(AdmissionRequest(
+            operation="CREATE", kind="FederatedResourceQuota", obj=frq,
+        ))  # no deltas at all -> allowed without a solve
+        # spec-unchanged update (status aggregation) skips the solve
+        before = simulation_solves.value(mode="batched")
+        old = copy.deepcopy(frq)
+        pf.validate(AdmissionRequest(
+            operation="UPDATE", kind="FederatedResourceQuota", obj=frq,
+            old_thunk=lambda: old,
+        ))
+        assert simulation_solves.value(mode="batched") == before
+
+    def test_preflight_registered_on_control_plane(self):
+        pytest.importorskip("cryptography")
+        from karmada_tpu.controlplane import ControlPlane
+        from karmada_tpu.simulation.preflight import PREFLIGHT_WEBHOOK
+
+        cp = ControlPlane()
+        assert any(w.name == PREFLIGHT_WEBHOOK
+                   for w in cp.admission.webhooks)
+
+
+class TestScenarioFlagParsing:
+    def test_parse_scenarios_flags(self):
+        from karmada_tpu.cli.karmadactl import _parse_scenarios
+
+        scenarios = _parse_scenarios(
+            ["m1"], ["m2"], ["m3:gpu=broken:NoExecute"],
+            ["m4:cpu=-10,memory=5"], ["7:replicas=3:cpu=0.25"],
+        )
+        kinds = [s.kind for s in scenarios]
+        assert kinds == [SCENARIO_DRAIN, SCENARIO_LOSS, SCENARIO_TAINT,
+                         SCENARIO_CAPACITY, SCENARIO_SURGE]
+        taint = scenarios[2]
+        assert (taint.cluster, taint.taint_key, taint.taint_value,
+                taint.taint_effect) == ("m3", "gpu", "broken", "NoExecute")
+        cap = scenarios[3]
+        assert cap.resources == {"cpu": -10.0, "memory": 5.0}
+        surge = scenarios[4]
+        assert (surge.surge_count, surge.surge_replicas,
+                surge.surge_request) == (7, 3, {"cpu": 0.25})
+
+    def test_parse_scenarios_bad_specs(self):
+        from karmada_tpu.cli.karmadactl import CLIError, _parse_scenarios
+
+        with pytest.raises(CLIError, match="--taint"):
+            _parse_scenarios([], [], ["justacluster"], [], [])
+        with pytest.raises(CLIError, match="--capacity"):
+            _parse_scenarios([], [], [], ["m1"], [])
+        with pytest.raises(CLIError, match="--surge"):
+            _parse_scenarios([], [], [], [], ["many"])
+
+    def test_report_formatting(self, fleet):
+        from karmada_tpu.cli.karmadactl import format_simulation_report
+        from karmada_tpu.simulation import build_report
+
+        clusters, names = fleet
+        bindings = mixed_bindings(names, n=8)
+        sim = Simulator(clusters)
+        request = SimulationRequest(spec=SimulationRequestSpec(
+            scenarios=[Scenario(kind=SCENARIO_DRAIN, cluster=names[0])],
+        ))
+        baseline, outs = sim.simulate(bindings, request.spec.scenarios)
+        report = build_report(request, baseline, outs, stats=sim.last_stats,
+                              clusters=len(clusters), bindings=len(bindings))
+        text = format_simulation_report(report)
+        assert f"drain({names[0]})" in text
+        assert "DISPLACED" in text
+        assert report.batched_solves == 1
