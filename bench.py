@@ -540,6 +540,131 @@ def build_whatif(seed=0, n_clusters=500, n_bindings=1000, n_scenarios=16):
     return _WhatIfSched(Simulator(clusters), scenarios), bindings, None
 
 
+class _DegradedSched:
+    """Bench facade for degraded-mode scheduling (docs/ROBUSTNESS.md):
+    alternating healthy and breaker-open rounds over one fleet + binding
+    set, with the estimator sweep feeding the scheduler through
+    EstimatorRegistry's staleness overlay. Counts the device kernel
+    launches of every round per leg — the acceptance claim is that a
+    breaker-open round adds NO extra launches vs a healthy round (stale
+    rows stay in the [B,C] matrix; only the extra_avail DATA changes)."""
+
+    def __init__(self, inner, registry, breakers, dark_cluster):
+        self.inner = inner
+        self.registry = registry
+        self.breakers = breakers
+        self.dark = dark_cluster
+        self.round_no = 0
+        self.launches = {"healthy": 0, "degraded": 0}
+        self.rounds = {"healthy": 0, "degraded": 0}
+
+    def _count_launches(self, fn):
+        import karmada_tpu.sched.core as core
+
+        n = {"v": 0}
+        orig_filter = core._filter_kernel_compact
+        orig_tail = core._tail_kernel
+
+        def cf(*a, **k):
+            n["v"] += 1
+            return orig_filter(*a, **k)
+
+        def ct(*a, **k):
+            n["v"] += 1
+            return orig_tail(*a, **k)
+
+        core._filter_kernel_compact = cf
+        core._tail_kernel = ct
+        try:
+            out = fn()
+        finally:
+            core._filter_kernel_compact = orig_filter
+            core._tail_kernel = orig_tail
+        return out, n["v"]
+
+    def schedule(self, bindings, extra_avail=None):
+        self.round_no += 1
+        degraded = self.round_no % 2 == 0  # warm round (1) is healthy
+        br = self.breakers.for_member(self.dark)
+        if degraded:
+            for _ in range(self.breakers.failure_threshold):
+                br.record_failure()
+        else:
+            br.record_success()
+        extra = self.registry.batch_estimates(
+            bindings, self.inner.fleet.names
+        )
+        decisions, launches = self._count_launches(
+            lambda: self.inner.schedule(bindings, extra_avail=extra)
+        )
+        leg = "degraded" if degraded else "healthy"
+        self.launches[leg] += launches
+        self.rounds[leg] += 1
+        if degraded and self.registry.last_sweep_open:
+            from karmada_tpu.metrics import degraded_rounds
+
+            degraded_rounds.inc()
+        return decisions
+
+    def report(self) -> dict:
+        per = {
+            leg: (self.launches[leg] / self.rounds[leg]
+                  if self.rounds[leg] else 0.0)
+            for leg in ("healthy", "degraded")
+        }
+        return {
+            "rounds": dict(self.rounds),
+            "launches_per_round": per,
+            "launch_parity": per["healthy"] == per["degraded"],
+        }
+
+
+def build_degraded(seed=0, n_clusters=500, n_bindings=1000):
+    """Config: degraded-mode batched scheduling — one member's breaker is
+    OPEN every other round; its estimator column is served from the
+    staleness cache (last fresh answers, decayed) and the round must still
+    complete in the SAME number of device launches as a healthy round."""
+    from karmada_tpu.estimator.client import EstimatorRegistry
+    from karmada_tpu.faults.policy import BreakerRegistry
+    from karmada_tpu.sched.core import ArrayScheduler
+    from karmada_tpu.testing.fixtures import synthetic_fleet
+
+    rng = np.random.default_rng(seed)
+    clusters = synthetic_fleet(n_clusters, seed=seed)
+    names = [c.name for c in clusters]
+    bindings = [
+        _binding(i, int(rng.integers(1, 32)), _dyn_placement(aggregated=False),
+                 float(rng.choice([0.1, 0.25, 0.5])))
+        for i in range(n_bindings)
+    ]
+
+    class _RowsEstimator:
+        """Deterministic per-(binding, cluster) answers standing in for the
+        member estimator daemons."""
+
+        def __init__(self):
+            self._rng = np.random.default_rng(seed + 1)
+            self._cache = {}
+
+        def max_available_replicas_rows(self, cl, reqs):
+            key = (len(cl), len(reqs))
+            if key not in self._cache:
+                self._cache[key] = self._rng.integers(
+                    1, 1000, size=(len(reqs), len(cl))
+                ).astype(np.int32)
+            return self._cache[key]
+
+    breakers = BreakerRegistry(failure_threshold=1, open_seconds=3600.0)
+    registry = EstimatorRegistry(breakers=breakers)
+    registry.register_replica_estimator("bench-estimator", _RowsEstimator())
+    return (
+        _DegradedSched(ArrayScheduler(clusters), registry, breakers,
+                       names[0]),
+        bindings,
+        None,
+    )
+
+
 def build_autoshard(seed=0, n_clusters=2048, n_bindings=4096):
     """Config: the automatic backend selector exercised end to end. The
     scheduler's single-chip HBM budget is shrunk so this round's [B,C]
@@ -585,12 +710,14 @@ CONFIGS = {
     ),
     "autoshard": (build_autoshard, "autoshard_4096rb_x_2048c"),
     "whatif": (build_whatif, "whatif_16s_1000rb_x_500c"),
+    "degraded": (build_degraded, "degraded_breaker_1000rb_x_500c"),
     "flagship_cold": (build_flagship_cold, None),  # named after the shape
     "flagship": (build_flagship, None),  # metric name carries the shape
 }
 DEFAULT_ORDER = [
     "dup3", "static", "dynamic", "spread", "spread_skewed", "churn",
-    "churn_incremental", "autoshard", "whatif", "flagship_cold", "flagship",
+    "churn_incremental", "autoshard", "whatif", "degraded",
+    "flagship_cold", "flagship",
 ]
 
 
@@ -814,6 +941,10 @@ def run_bench(args) -> None:
             rec["last_round"] = dict(sched.last_round_stats)
         if name == "autoshard":
             rec["autoshard_engaged"] = sched.mesh is not None
+        if name == "degraded":
+            # breaker-open rounds must add NO device launches vs healthy
+            # rounds — stale estimator rows ride the same [B,C] matrix
+            rec["degraded"] = sched.report()
         if name == "whatif":
             # the amortization claim: S scenarios through ONE vmapped solve
             # vs the same S as sequential single-scenario simulations

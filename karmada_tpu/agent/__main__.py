@@ -62,12 +62,19 @@ def main() -> None:
 
     force_cpu_mesh(1)
 
+    from .. import faults
     from ..api.coordination import agent_lease_name
     from ..api.meta import CPU, MEMORY
     from ..coordination.elector import Elector, default_identity
     from ..members.member import MemberConfig
     from ..server.metricsserver import start_metrics_server
     from .remote_agent import RemoteAgentSession
+
+    # env-gated chaos plan (KARMADA_TPU_FAULT_PLAN): the agent's apply and
+    # HTTP boundaries inject from the same replayable schedule
+    if faults.install_from_env() is not None:
+        print(f"faults: chaos plan installed from {faults.ENV_FAULT_PLAN}",
+              flush=True)
 
     token = args.bearer_token or os.environ.get("KARMADA_TOKEN") or None
     GiB = 1024.0**3

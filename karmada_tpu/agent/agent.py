@@ -26,7 +26,7 @@ from ..controllers.execution import (
 )
 from ..api.meta import Condition, set_condition
 from ..api.work import WORK_CONDITION_APPLIED
-from ..runtime.controller import Controller, DONE, Runtime
+from ..runtime.controller import Controller, DONE, REQUEUE, Runtime
 from ..store.store import Store
 
 LEASE_DURATION_SECONDS = 40.0  # cluster lease default (cluster API)
@@ -93,7 +93,8 @@ class KarmadaAgent:
             work = self.store.update(work)
         if work.spec.suspend_dispatching:
             return DONE
-        errors = apply_work_manifests(work, self.member, self.interpreter)
+        results = apply_work_manifests(work, self.member, self.interpreter)
+        errors = [r.message for r in results if not r.ok]
         if set_condition(
             work.status.conditions,
             Condition(
@@ -104,6 +105,11 @@ class KarmadaAgent:
             ),
         ):
             self.store.update(work)
+        if any(not r.ok and r.retryable for r in results):
+            # same policy as the push-mode controller: only retryable
+            # failures re-dispatch (faults/policy — the agent shares the
+            # queue's bounded retry budget)
+            return REQUEUE
         return DONE
 
     # -- heartbeat (cluster lease + status refresh) -----------------------

@@ -10,6 +10,8 @@ for per-cluster dynamic clients.
 """
 from __future__ import annotations
 
+from dataclasses import dataclass
+
 from ..api.meta import Condition, set_condition
 from ..api.unstructured import Unstructured
 from ..api.work import (
@@ -19,19 +21,62 @@ from ..api.work import (
     cluster_of_work_namespace,
 )
 from ..interpreter.interpreter import ResourceInterpreter
-from ..runtime.controller import Controller, DONE, Runtime
-from ..store.store import Store
+from ..runtime.controller import Controller, DONE, REQUEUE, Runtime
+from ..store.store import ConflictError, Store
 
 EXECUTION_FINALIZER = "karmada.io/execution-controller"
 
 
-def apply_work_manifests(work: Work, member, interpreter: ResourceInterpreter) -> list[str]:
+@dataclass(frozen=True)
+class ManifestResult:
+    """Typed outcome of applying ONE manifest to a member: the retryable
+    classification is what lets the retry policy re-dispatch only what can
+    succeed (conflicts and transient member errors) while terminal failures
+    (validation) park on the Work condition without burning retry budget."""
+
+    kind: str
+    name: str
+    error: str = ""
+    retryable: bool = False
+
+    @property
+    def ok(self) -> bool:
+        return not self.error
+
+    @property
+    def message(self) -> str:
+        # the exact per-manifest string the Work condition always carried
+        return f"{self.kind}/{self.name}: {self.error}"
+
+
+def classify_apply_error(e: Exception) -> bool:
+    """retryable (conflict, transient member/transport error) vs terminal
+    (validation and everything else that retrying cannot fix)."""
+    from ..faults.plan import InjectedFault
+
+    return isinstance(
+        e, (ConflictError, InjectedFault, ConnectionError, TimeoutError,
+            OSError)
+    )
+
+
+def apply_work_manifests(
+    work: Work, member, interpreter: ResourceInterpreter
+) -> list[ManifestResult]:
     """Apply every manifest of a Work to the member with interpreter retain
-    (objectwatcher.Create/Update path); returns per-manifest error strings.
-    Shared by the push-mode execution controller and the pull-mode agent."""
-    errors: list[str] = []
+    (objectwatcher.Create/Update path); returns one typed `ManifestResult`
+    per manifest. Shared by the push-mode execution controller and the
+    pull-mode agent. The member-apply chaos boundary (faults/plan.py,
+    BOUNDARY_APPLY) fires per manifest, so injected faults classify and
+    retry exactly like real transient member errors."""
+    from .. import faults
+
+    results: list[ManifestResult] = []
     for manifest in work.spec.workload_manifests:
+        kind = manifest.get("kind")
+        name = manifest.get("metadata", {}).get("name")
         try:
+            faults.check(faults.BOUNDARY_APPLY, member.name)
             desired = Unstructured(dict(manifest))
             observed = member.get(
                 desired.api_version, desired.kind, desired.name, desired.namespace
@@ -40,10 +85,13 @@ def apply_work_manifests(work: Work, member, interpreter: ResourceInterpreter) -
                 desired = interpreter.retain(desired, observed)
             member.apply_manifest(desired.to_dict())
         except Exception as e:  # noqa: BLE001 — reported on the Work
-            errors.append(
-                f"{manifest.get('kind')}/{manifest.get('metadata', {}).get('name')}: {e}"
-            )
-    return errors
+            results.append(ManifestResult(
+                kind=kind, name=name, error=str(e),
+                retryable=classify_apply_error(e),
+            ))
+            continue
+        results.append(ManifestResult(kind=kind, name=name))
+    return results
 
 
 def remove_work_manifests(work: Work, member) -> None:
@@ -131,7 +179,8 @@ class ExecutionController:
         ):
             work = self.store.update(work)
 
-        errors = apply_work_manifests(work, member, self.interpreter)
+        results = apply_work_manifests(work, member, self.interpreter)
+        errors = [r.message for r in results if not r.ok]
 
         changed = set_condition(
             work.status.conditions,
@@ -144,4 +193,16 @@ class ExecutionController:
         )
         if changed:
             self.store.update(work)
+        if any(not r.ok and r.retryable for r in results):
+            # re-dispatch under the queue's retry budget: only retryable
+            # failures (conflict / transient member error) earn another
+            # attempt; terminal validation failures stay parked on the
+            # condition until the Work changes. Retry PACING follows the
+            # runtime's deliberate design (runtime/controller.py: backoff
+            # is a retry counter, not wall-clock sleeps — what keeps
+            # settle() deterministic for tests): attempts within one drain
+            # are back-to-back and bounded by max_retries; once the budget
+            # is spent, the next Work event re-triggers. Daemon loops pace
+            # drains by their --interval.
+            return REQUEUE
         return DONE

@@ -180,8 +180,17 @@ class ControlPlane:
         self.interpreter.load_thirdparty()  # I3 shipped customizations
         self.members: dict[str, InMemoryMember] = {}
 
-        self.estimator_registry = EstimatorRegistry()
-        member_estimators = MemberEstimators(self.members)
+        # per-member circuit breakers for every member-facing I/O path
+        # (faults/policy.py): estimator sweeps fast-fail dark members and
+        # degraded rounds reuse decayed stale rows instead of stalling
+        from .faults.policy import BreakerRegistry
+
+        self.breakers = BreakerRegistry(
+            clock=lambda: self.runtime.clock.now()
+        )
+        self.estimator_registry = EstimatorRegistry(breakers=self.breakers)
+        member_estimators = MemberEstimators(self.members,
+                                             breakers=self.breakers)
         self.estimator_registry.register_replica_estimator(
             "scheduler-estimator", member_estimators
         )

@@ -92,9 +92,25 @@ class EstimatorRegistry:
     (interface.go:38-55). The GeneralEstimator equivalent is fused into the
     device kernel; registered estimators contribute the extra min-merge term."""
 
-    def __init__(self) -> None:
+    def __init__(self, breakers=None, staleness=None) -> None:
+        """`breakers`: faults.BreakerRegistry shared with the estimator
+        clients — when a member's breaker is open, its column of the [B,C]
+        answer matrix is served from the staleness cache (last fresh answers
+        decayed by the pure-array penalty, faults/staleness.py) instead of
+        the discard sentinel, so degraded rounds keep steering away from the
+        dark member without stalling the batched solve."""
         self.replica_estimators: dict[str, ReplicaEstimator] = {}
         self.unschedulable_estimators: dict[str, UnschedulableReplicaEstimator] = {}
+        self.breakers = breakers
+        if staleness is None and breakers is not None:
+            from ..faults.staleness import StalenessTracker
+
+            staleness = StalenessTracker()
+        self.staleness = staleness
+        # per-sweep degraded bookkeeping (consumed by the scheduler daemon's
+        # karmada_degraded_rounds_total accounting)
+        self.last_sweep_open: list[str] = []
+        self.last_sweep_stale: list[str] = []
 
     def register_replica_estimator(self, name: str, est: ReplicaEstimator) -> None:
         self.replica_estimators[name] = est
@@ -112,6 +128,8 @@ class EstimatorRegistry:
         """extra_avail i32[B,C]: min across registered estimators, -1 where
         every estimator discarded (the device kernel min-merges this with the
         GeneralEstimator column)."""
+        self.last_sweep_open = []
+        self.last_sweep_stale = []
         if not self.replica_estimators:
             return None
         from ..models.batch import AGGREGATED, DYNAMIC_WEIGHT, strategy_code
@@ -163,7 +181,43 @@ class EstimatorRegistry:
                             bindings[b].spec.replicas,
                         ),
                     )
-        return np.where(authentic, merged, UNAUTHENTIC_REPLICA).astype(np.int32)
+        out = np.where(authentic, merged, UNAUTHENTIC_REPLICA).astype(np.int32)
+        if self.breakers is not None:
+            self._overlay_stale_columns(bindings, clusters, out)
+        return out
+
+    def _overlay_stale_columns(self, bindings, clusters, out: np.ndarray) -> None:
+        """Degraded-mode column repair: a member whose breaker is OPEN after
+        this sweep answered the discard sentinel on its member legs — fold
+        the staleness cache's decayed last-fresh answers into its column
+        (rows stay in the [B,C] matrix; the round completes in one launch).
+        Healthy columns refresh the cache and reset their staleness epoch.
+
+        The fold is a MIN-merge, not an overwrite: other registered
+        estimators (e.g. the model-based one) may still be answering live
+        for this cluster, and stale member data may only TIGHTEN or fill a
+        live bound — a decayed snapshot must never loosen one."""
+        # ONE shared tuple per sweep: the staleness snapshots alias it, so
+        # the unchanged-binding-set fast path is an identity check
+        uids = tuple(rb.metadata.uid for rb in bindings)
+        for j, c in enumerate(clusters):
+            br = self.breakers.get(c)
+            if br is not None and br.is_open:
+                self.last_sweep_open.append(c)
+                col = self.staleness.fill_stale(c, uids)
+                if col is not None:
+                    cur = out[:, j]
+                    out[:, j] = np.where(
+                        cur >= 0,
+                        np.where(col >= 0, np.minimum(cur, col), cur),
+                        col,
+                    )
+                    self.last_sweep_stale.append(c)
+            elif (out[:, j] != UNAUTHENTIC_REPLICA).any():
+                # an all-sentinel column under a CLOSED breaker is a blip
+                # (or a row set with nothing to estimate) — never wipe the
+                # last-fresh cache for it
+                self.staleness.record_fresh(c, uids, out[:, j])
 
     def min_unschedulable(
         self,
@@ -198,8 +252,9 @@ class MemberEstimators:
     resident and version-checked against each member's estimator, so steady
     rounds ship only the [B,R] request matrix."""
 
-    def __init__(self, members: dict):
+    def __init__(self, members: dict, breakers=None):
         self.members = members
+        self.breakers = breakers  # faults.BreakerRegistry, shared
         self._pool = ThreadPoolExecutor(max_workers=16)
         self._fleet_key = None
         self._fleet_dev = None  # (alloc, requested, pod_count, allowed, cid, claimless_ok)
@@ -209,12 +264,69 @@ class MemberEstimators:
         member = self.members.get(cluster)
         return getattr(member, "node_estimator", None) if member else None
 
+    def _guarded(self, cluster: str, fn, sentinel):
+        """One member-estimator leg under the unified fault policy: the
+        in-process stand-in for the gRPC boundary — breaker admission,
+        chaos injection (BOUNDARY_GRPC), typed failure metric, breaker
+        feedback. Failures answer `sentinel`, never raise — per-cluster
+        error isolation, like the wire client."""
+        from .. import faults
+        from ..metrics import estimator_rpc_errors
+
+        br = (
+            self.breakers.for_member(cluster)
+            if self.breakers is not None else None
+        )
+        if br is not None and not br.allow():
+            return sentinel
+        try:
+            faults.check(faults.BOUNDARY_GRPC, cluster)
+            out = fn()
+        except faults.InjectedFault as e:
+            estimator_rpc_errors.inc(cluster=cluster, code=e.code)
+            if br is not None:
+                br.record_failure()
+            return sentinel
+        except Exception:  # noqa: BLE001 - degrade per cluster, don't fail sweep
+            estimator_rpc_errors.inc(cluster=cluster, code="MEMBER_ERROR")
+            if br is not None:
+                br.record_failure()
+            return sentinel
+        if br is not None:
+            br.record_success()
+        return out
+
+    def _guards_engaged(self, clusters) -> bool:
+        """True when the per-cluster boundary must be exercised (a fault
+        plan with grpc-boundary rules is installed, or any breaker is not
+        at rest) — the batched fleet kernel bypasses member boundaries, so
+        those sweeps route per-cluster instead. A plan that only targets
+        other boundaries (http/apply) leaves the fused one-launch path
+        alone: chaos must not change the shape of what it isn't injecting
+        into."""
+        from .. import faults
+        from ..faults.policy import CLOSED
+
+        inj = faults.active()
+        if inj is not None and inj.plan.has_boundary(faults.BOUNDARY_GRPC):
+            return True
+        if self.breakers is None:
+            return False
+        return any(
+            br is not None and br.state != CLOSED
+            for br in (self.breakers.get(c) for c in clusters)
+        )
+
     def max_available_replicas(self, clusters, requirements, replicas) -> list[int]:
         def one(cluster: str) -> int:
             est = self._estimator_for(cluster)
             if est is None:
                 return UNAUTHENTIC_REPLICA
-            return est.max_available_replicas(requirements)
+            return self._guarded(
+                cluster,
+                lambda: est.max_available_replicas(requirements),
+                UNAUTHENTIC_REPLICA,
+            )
 
         return list(self._pool.map(one, clusters))
 
@@ -267,7 +379,13 @@ class MemberEstimators:
         claimless = all(
             r is None or r.node_claim is None for r in requirements_list
         )
-        fleet = self._fleet_snapshot(clusters) if claimless else None
+        # the fleet kernel fuses every member into one launch, which skips
+        # the per-member boundary — with a chaos plan installed or a breaker
+        # not at rest, route per-cluster so faults/breakers apply per member
+        fleet = (
+            self._fleet_snapshot(clusters)
+            if claimless and not self._guards_engaged(clusters) else None
+        )
         if fleet is not None:
             import jax
 
@@ -293,10 +411,15 @@ class MemberEstimators:
             return rows
 
         def one(cluster: str) -> list[int]:
+            sentinel = [UNAUTHENTIC_REPLICA] * len(requirements_list)
             est = self._estimator_for(cluster)
             if est is None:
-                return [UNAUTHENTIC_REPLICA] * len(requirements_list)
-            return est.max_available_replicas_batch(requirements_list)
+                return sentinel
+            return self._guarded(
+                cluster,
+                lambda: est.max_available_replicas_batch(requirements_list),
+                sentinel,
+            )
 
         columns = np.asarray(list(self._pool.map(one, clusters)))  # [C,B]
         return columns.T
@@ -308,6 +431,10 @@ class MemberEstimators:
             est = self._estimator_for(cluster)
             if est is None:
                 return UNAUTHENTIC_REPLICA
-            return est.get_unschedulable_replicas(key, threshold_seconds)
+            return self._guarded(
+                cluster,
+                lambda: est.get_unschedulable_replicas(key, threshold_seconds),
+                UNAUTHENTIC_REPLICA,
+            )
 
         return list(self._pool.map(one, clusters))

@@ -262,12 +262,17 @@ class GrpcSchedulerEstimator:
         address_for: Callable[[str], Optional[str]],
         timeout: float = 5.0,
         client_config=None,  # grpcconnection.ClientConfig; None = insecure
+        breakers=None,  # faults.BreakerRegistry — per-member circuit breaker
     ):
         from .grpcconnection import INSECURE_CLIENT
 
         self.address_for = address_for
         self.timeout = timeout
         self.client_config = client_config or INSECURE_CLIENT
+        # per-member breaker: a member whose estimator keeps failing is
+        # fast-failed (sentinel, no RPC) instead of burning the shared
+        # fan-out deadline every round (docs/ROBUSTNESS.md)
+        self.breakers = breakers
         self._channels: dict[str, grpc.Channel] = {}
         # cached multicallables per address (building one per RPC costs more
         # than the RPC itself at fan-out rates)
@@ -313,6 +318,54 @@ class GrpcSchedulerEstimator:
             cache[addr] = call
         return call
 
+    # -- failure accounting (per-member breaker + typed error metric) -----
+
+    def _breaker(self, cluster: str):
+        return (
+            self.breakers.for_member(cluster)
+            if self.breakers is not None else None
+        )
+
+    def _record_error(self, cluster: str, code: str) -> None:
+        """One estimator failure: typed metric (UNAVAILABLE is a dead
+        member, DEADLINE_EXCEEDED a slow one — they tune differently) + the
+        member's breaker, instead of silently flattening to the sentinel."""
+        from ..metrics import estimator_rpc_errors
+
+        estimator_rpc_errors.inc(cluster=cluster, code=code)
+        br = self._breaker(cluster)
+        if br is not None:
+            br.record_failure()
+
+    def _record_ok(self, cluster: str) -> None:
+        br = self._breaker(cluster)
+        if br is not None:
+            br.record_success()
+
+    @staticmethod
+    def _rpc_code(e: grpc.RpcError) -> str:
+        try:
+            code = e.code()
+            return code.name if code is not None else "UNKNOWN"
+        except Exception:  # noqa: BLE001 - raw channel errors carry no code
+            return "UNKNOWN"
+
+    def _admit(self, cluster: str) -> bool:
+        """Breaker admission + chaos hook for one fan-out leg. False ⇒ the
+        leg answers the sentinel without issuing an RPC (fast-fail: an open
+        breaker must never make the batched round wait out the deadline)."""
+        from .. import faults
+
+        br = self._breaker(cluster)
+        if br is not None and not br.allow():
+            return False
+        try:
+            faults.check(faults.BOUNDARY_GRPC, cluster)
+        except faults.InjectedFault as e:
+            self._record_error(cluster, e.code)
+            return False
+        return True
+
     def _fanout(self, clusters, call_of, request_of, extract) -> list[int]:
         """Concurrent fan-out with a shared deadline: every RPC is issued as
         a gRPC future before any result is awaited — the
@@ -321,24 +374,40 @@ class GrpcSchedulerEstimator:
         futures ride the gRPC core's own event loop). ONE deadline covers the
         whole fan-out — each RPC gets the time remaining from the round's
         start, like the reference's shared context deadline, so the overall
-        wall-clock is bounded by self.timeout regardless of fleet width."""
+        wall-clock is bounded by self.timeout regardless of fleet width.
+
+        Members whose breaker is open (or whose fault-plan leg fires) answer
+        the sentinel without an RPC; real failures are recorded per cluster
+        with their gRPC status code and fed to the breaker."""
         deadline = time.monotonic() + self.timeout
-        futs = []
+        futs: list = []
         for cluster in clusters:
+            # resolve the call BEFORE breaker admission: _admit consumes a
+            # half-open probe slot, and a probe that never issues an RPC
+            # would never settle — leaving the breaker stuck HALF_OPEN and
+            # the member fast-failed forever
             call = call_of(cluster)
             if call is None:
+                futs.append(None)  # no address: not a member failure
+                continue
+            if not self._admit(cluster):
                 futs.append(None)
                 continue
             remaining = max(deadline - time.monotonic(), 0.001)
-            futs.append(call.future(request_of(cluster), timeout=remaining))
+            futs.append(
+                (cluster, call.future(request_of(cluster), timeout=remaining))
+            )
         out = []
         for f in futs:
             if f is None:
                 out.append(UNAUTHENTIC_REPLICA)
                 continue
+            cluster, fut = f
             try:
-                out.append(extract(f.result()))
-            except grpc.RpcError:
+                out.append(extract(fut.result()))
+                self._record_ok(cluster)
+            except grpc.RpcError as e:
+                self._record_error(cluster, self._rpc_code(e))
                 out.append(UNAUTHENTIC_REPLICA)
         return out
 
@@ -372,9 +441,18 @@ class GrpcSchedulerEstimator:
         req_pbs = [requirements_to_pb(r) for r in requirements_list]
         by_addr: dict[str, list[int]] = {}
         for j, cluster in enumerate(clusters):
+            # address first, THEN breaker admission (see _fanout: an
+            # admitted half-open probe must always reach an RPC so its
+            # outcome settles the probe slot). Breaker-open / fault-
+            # injected columns stay at the sentinel and are EXCLUDED from
+            # the shard request — a dark member must not stall or poison
+            # its shard-mates' batched RPC.
             addr = self.address_for(cluster)
-            if addr is not None:
-                by_addr.setdefault(addr, []).append(j)
+            if addr is None:
+                continue
+            if not self._admit(cluster):
+                continue
+            by_addr.setdefault(addr, []).append(j)
         deadline = time.monotonic() + self.timeout
         futs = []
         for addr, cols in by_addr.items():
@@ -392,8 +470,13 @@ class GrpcSchedulerEstimator:
         for cols, f in futs:
             try:
                 resp = f.result()
-            except grpc.RpcError:
+            except grpc.RpcError as e:
+                code = self._rpc_code(e)
+                for j in cols:
+                    self._record_error(clusters[j], code)
                 continue  # shard stays at the -1 sentinel
+            for j in cols:
+                self._record_ok(clusters[j])
             for r, row in enumerate(resp.rows[:R]):
                 vals = np.fromiter(row.maxReplicas, np.int32,
                                    count=len(row.maxReplicas))

@@ -1,0 +1,42 @@
+"""Fault-tolerance plane: deterministic chaos injection, unified
+retry/breaker policy, and degraded-mode estimator staleness
+(docs/ROBUSTNESS.md)."""
+from .plan import (
+    BOUNDARY_APPLY,
+    BOUNDARY_GRPC,
+    BOUNDARY_HTTP,
+    ENV_FAULT_PLAN,
+    FaultAction,
+    FaultInjector,
+    FaultPlan,
+    FaultRule,
+    InjectedFault,
+    active,
+    check,
+    install,
+    install_from_env,
+    reset,
+)
+from .policy import (
+    CLOSED,
+    HALF_OPEN,
+    OPEN,
+    Backoff,
+    BreakerRegistry,
+    CircuitBreaker,
+    RetryPolicy,
+)
+from .staleness import (
+    MAX_STALENESS_AGE,
+    StalenessTracker,
+    apply_staleness_penalty,
+)
+
+__all__ = [
+    "BOUNDARY_APPLY", "BOUNDARY_GRPC", "BOUNDARY_HTTP", "ENV_FAULT_PLAN",
+    "FaultAction", "FaultInjector", "FaultPlan", "FaultRule", "InjectedFault",
+    "active", "check", "install", "install_from_env", "reset",
+    "CLOSED", "HALF_OPEN", "OPEN",
+    "Backoff", "BreakerRegistry", "CircuitBreaker", "RetryPolicy",
+    "MAX_STALENESS_AGE", "StalenessTracker", "apply_staleness_penalty",
+]

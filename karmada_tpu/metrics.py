@@ -229,6 +229,32 @@ leader_election_renew_duration = registry.histogram(
 )
 
 
+# fault-tolerance plane (faults/ — docs/ROBUSTNESS.md): degraded rounds are
+# schedule rounds that completed as ONE batched launch while at least one
+# member's breaker was open (stale estimator rows stayed in the matrix with
+# the staleness penalty applied)
+degraded_rounds = registry.counter(
+    "karmada_degraded_rounds_total",
+    "Schedule rounds completed while at least one member breaker was open",
+)
+estimator_rpc_errors = registry.counter(
+    "karmada_estimator_rpc_errors_total",
+    "Estimator fan-out failures by cluster and status code",
+)
+breaker_transitions = registry.counter(
+    "karmada_breaker_transitions_total",
+    "Circuit-breaker state transitions by member and destination state",
+)
+breaker_state = registry.gauge(
+    "karmada_breaker_state",
+    "Per-member breaker state: 0 closed, 1 half-open, 2 open",
+)
+faults_injected = registry.counter(
+    "karmada_faults_injected_total",
+    "Fault-plan decisions that fired, by boundary and kind",
+)
+
+
 class timed:
     """Context manager observing wall time into a histogram."""
 

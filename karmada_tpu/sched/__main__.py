@@ -70,6 +70,12 @@ def main() -> None:
                     help="dedicated READ-ONLY token accepted on GET "
                          "/metrics only (the Prometheus credential no "
                          "longer needs to be the full wire token)")
+    ap.add_argument("--breaker-failures", type=int, default=3,
+                    help="consecutive estimator failures before a member's "
+                         "circuit breaker opens (docs/ROBUSTNESS.md)")
+    ap.add_argument("--breaker-open-seconds", type=float, default=5.0,
+                    help="seconds an open breaker fast-fails before the "
+                         "half-open probe")
     args = ap.parse_args()
 
     if args.platform == "cpu":
@@ -81,6 +87,7 @@ def main() -> None:
 
         jax.config.update("jax_platforms", args.platform)
 
+    from .. import faults
     from ..api.coordination import LEASE_SCHEDULER
     from ..coordination.elector import Elector, default_identity
     from ..estimator.client import EstimatorRegistry, parse_estimator_flags
@@ -89,14 +96,25 @@ def main() -> None:
     from ..server.remote import RemoteStore
     from .scheduler import SchedulerDaemon
 
+    # chaos plans are env-gated (KARMADA_TPU_FAULT_PLAN); install at boot so
+    # a malformed plan aborts the daemon instead of silently running clean
+    if faults.install_from_env() is not None:
+        print("faults: chaos plan installed from "
+              f"{faults.ENV_FAULT_PLAN}", flush=True)
+
+    breakers = faults.BreakerRegistry(
+        failure_threshold=args.breaker_failures,
+        open_seconds=args.breaker_open_seconds,
+    )
     addresses = parse_estimator_flags(args.estimator)
     registry = None
     if addresses:
         from ..estimator.service import GrpcSchedulerEstimator
 
-        registry = EstimatorRegistry()
+        registry = EstimatorRegistry(breakers=breakers)
         registry.register_replica_estimator(
-            "scheduler-estimator", GrpcSchedulerEstimator(addresses.get)
+            "scheduler-estimator",
+            GrpcSchedulerEstimator(addresses.get, breakers=breakers),
         )
 
     token = args.bearer_token or os.environ.get("KARMADA_TOKEN") or None

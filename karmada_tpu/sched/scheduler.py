@@ -31,6 +31,7 @@ from ..api.work import (
 )
 from ..features import FeatureGates, PRIORITY_BASED_SCHEDULING
 from ..metrics import (
+    degraded_rounds,
     e2e_scheduling_duration,
     queue_incoming_bindings,
     schedule_attempts,
@@ -245,6 +246,11 @@ class SchedulerDaemon:
                 extra_avail = self.estimator_registry.batch_estimates(
                     bindings, array.fleet.names
                 )
+                if getattr(self.estimator_registry, "last_sweep_open", None):
+                    # degraded mode: at least one member's breaker is open —
+                    # its stale (penalized) rows stay in the matrix and the
+                    # round still completes as one batched solve below
+                    degraded_rounds.inc()
             trace.step("Estimator fan-out done")
             with timed(scheduling_algorithm_duration):
                 decisions = array.schedule_incremental(

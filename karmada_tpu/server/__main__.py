@@ -58,6 +58,11 @@ def main() -> None:
                     help="allow --token-file over plaintext HTTP on a "
                          "non-loopback --host (the token crosses the "
                          "network in the clear; refused otherwise)")
+    ap.add_argument("--socket-timeout", type=float, default=15.0,
+                    help="per-connection idle timeout in seconds — a peer "
+                         "that trickles bytes (slow loris) is reaped after "
+                         "this long instead of pinning a handler thread; "
+                         "0 disables (not recommended)")
     ap.add_argument("--enable-test-clock", action="store_true",
                     help="allow POST /tick (advancing/freezing the plane's "
                          "Clock — test drivers only); disabled by default "
@@ -93,10 +98,17 @@ def main() -> None:
 
         jax.config.update("jax_platforms", args.platform)
 
+    from .. import faults
     from ..api.meta import CPU, MEMORY
     from ..controlplane import ControlPlane
     from ..members.member import MemberConfig
     from .apiserver import ControlPlaneServer
+
+    # env-gated chaos plan (KARMADA_TPU_FAULT_PLAN, docs/ROBUSTNESS.md):
+    # install at boot so a malformed plan aborts instead of running clean
+    if faults.install_from_env() is not None:
+        print(f"faults: chaos plan installed from {faults.ENV_FAULT_PLAN}",
+              flush=True)
 
     cp = ControlPlane(controllers=args.controllers.split(","))
     persistence = None
@@ -153,7 +165,8 @@ def main() -> None:
     srv = ControlPlaneServer(cp, host=args.host, port=args.port,
                              ssl_context=ssl_context, token=token,
                              enable_test_clock=args.enable_test_clock,
-                             scrape_token=scrape_token)
+                             scrape_token=scrape_token,
+                             socket_timeout=args.socket_timeout)
     srv.start()
     print(f"karmada-tpu control plane serving on {srv.url}", flush=True)
 

@@ -81,7 +81,8 @@ class ControlPlaneServer:
     def __init__(self, cp, host: str = "127.0.0.1", port: int = 0,
                  ssl_context=None, token: Optional[str] = None,
                  enable_test_clock: bool = True,
-                 scrape_token: Optional[str] = None):
+                 scrape_token: Optional[str] = None,
+                 socket_timeout: Optional[float] = None):
         """`enable_test_clock=False` disables POST /tick with 403: advancing
         a nonzero `seconds` freezes the plane's Clock at the advanced
         instant, which is a test-driver affordance — a production daemon
@@ -91,10 +92,20 @@ class ControlPlaneServer:
 
         `scrape_token`: a dedicated READ-ONLY credential accepted on GET
         /metrics ONLY — a Prometheus scraper no longer needs the full wire
-        token (docs/HA.md). Every other route still requires `token`."""
+        token (docs/HA.md). Every other route still requires `token`.
+
+        `socket_timeout`: per-connection idle bound in seconds (slow-loris
+        reaping, httpbase.make_http_server); None = the shared default,
+        0 disables (tests only). Daemon flag: --socket-timeout."""
+        from .httpbase import DEFAULT_SOCKET_TIMEOUT
+
         self.cp = cp
         self._host = host
         self._port = port
+        self._socket_timeout = (
+            DEFAULT_SOCKET_TIMEOUT if socket_timeout is None
+            else socket_timeout
+        )
         self._ssl_context = ssl_context
         self._token = token
         self._scrape_token = scrape_token
@@ -131,7 +142,8 @@ class ControlPlaneServer:
                 server._route(self, "DELETE")
 
         self._httpd = make_http_server(
-            self._host, self._port, Handler, self._ssl_context
+            self._host, self._port, Handler, self._ssl_context,
+            socket_timeout=self._socket_timeout,
         )
         self._port = self._httpd.server_address[1]
         self.cp.store.watch_all(self._mark_dirty, replay=False)

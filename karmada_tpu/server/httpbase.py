@@ -95,12 +95,34 @@ def drain_body(handler: BaseHTTPRequestHandler,
         handler.close_connection = True
 
 
+# default server-side socket timeout: bounds how long ONE connection may sit
+# between bytes (request line, headers, body, TLS handshake) before it is
+# reaped — the slow-loris bound. Override per server via `socket_timeout`.
+DEFAULT_SOCKET_TIMEOUT = 15.0
+
+
 def make_http_server(host: str, port: int, handler_cls,
-                     ssl_context=None) -> ThreadingHTTPServer:
+                     ssl_context=None,
+                     socket_timeout: float = DEFAULT_SOCKET_TIMEOUT,
+                     ) -> ThreadingHTTPServer:
     """A ThreadingHTTPServer, TLS-wrapped per connection when ssl_context
     is given: the handshake runs in the handler thread (finish_request under
     ThreadingMixIn), NOT on the accept loop, so a client that connects and
-    never sends ClientHello cannot stall every other request."""
+    never sends ClientHello cannot stall every other request.
+
+    `socket_timeout` applies to EVERY connection (plain or TLS): a peer that
+    connects and trickles bytes — the slow-loris shape — is reaped after
+    this many idle seconds instead of pinning a handler thread and socket
+    forever (BaseHTTPRequestHandler treats the read timeout as end of
+    requests and closes). 0/None disables (tests only)."""
+    if socket_timeout:
+        # per-connection timeout via the handler's `timeout` attribute
+        # (socketserver applies it in setup(); handle_one_request maps the
+        # resulting socket.timeout to close_connection)
+        handler_cls = type(
+            handler_cls.__name__, (handler_cls,),
+            {"timeout": socket_timeout},
+        )
     if ssl_context is None:
         httpd = ThreadingHTTPServer((host, port), handler_cls)
     else:
@@ -108,7 +130,7 @@ def make_http_server(host: str, port: int, handler_cls,
             def finish_request(self, request, client_address):
                 import ssl
 
-                request.settimeout(15.0)
+                request.settimeout(socket_timeout or None)
                 try:
                     tls = ssl_context.wrap_socket(request, server_side=True)
                     tls.settimeout(None)
@@ -141,10 +163,12 @@ class BackgroundHTTPServer:
     the bound port (0 = ephemeral)."""
 
     def __init__(self, host: str = "127.0.0.1", port: int = 0,
-                 ssl_context=None):
+                 ssl_context=None,
+                 socket_timeout: float = DEFAULT_SOCKET_TIMEOUT):
         self._host = host
         self._port = port
         self._ssl_context = ssl_context
+        self._socket_timeout = socket_timeout
         self._httpd: Optional[ThreadingHTTPServer] = None
 
     def bind(self, handler_cls, name: str) -> int:
@@ -153,7 +177,8 @@ class BackgroundHTTPServer:
 
     def bind_only(self, handler_cls) -> ThreadingHTTPServer:
         self._httpd = make_http_server(
-            self._host, self._port, handler_cls, self._ssl_context
+            self._host, self._port, handler_cls, self._ssl_context,
+            socket_timeout=self._socket_timeout,
         )
         return self._httpd
 

@@ -48,6 +48,8 @@ class RemoteStore:
             # the default trust store applies and a self-signed CA fails —
             # honest, not bypassed
             self._ssl_ctx = ssl.create_default_context(cafile=cafile)
+        # fault-plan site name for this client's HTTP boundary
+        self._fault_target = urlparse(self.base_url).netloc or "control-plane"
         self._watch_threads: list[threading.Thread] = []
         self._streams: list[tuple[str, Any, threading.Event]] = []
         self._closed = False
@@ -85,6 +87,15 @@ class RemoteStore:
         return headers
 
     def _call(self, method: str, path: str, body: Optional[dict] = None) -> dict:
+        # chaos hook: the HTTP process boundary (faults/plan.py). A decision
+        # surfaces as the same RemoteError a real transport failure raises,
+        # so every consumer's error handling is exercised, not special-cased.
+        from .. import faults
+
+        try:
+            faults.check(faults.BOUNDARY_HTTP, self._fault_target)
+        except faults.InjectedFault as e:
+            raise RemoteError(f"control plane unreachable: {e}") from None
         data = json.dumps(body).encode() if body is not None else None
         req = Request(
             self.base_url + path, data=data, method=method,
@@ -217,6 +228,15 @@ class RemoteStore:
         def attach(with_replay: bool) -> Optional[int]:
             """One stream attachment; returns the HTTP status (None when the
             request itself failed before a response arrived)."""
+            from .. import faults
+
+            try:
+                # watch re-attach rides the same HTTP fault site as _call;
+                # an injected fault presents as the transport failure the
+                # retry loop already classifies
+                faults.check(faults.BOUNDARY_HTTP, self._fault_target)
+            except faults.InjectedFault as e:
+                raise OSError(str(e)) from None
             path = (f"/watch?kind={quote(kind, safe='')}"
                     f"&replay={'1' if with_replay else '0'}")
             if namespace:
@@ -249,9 +269,29 @@ class RemoteStore:
                         if not line.strip():
                             continue  # heartbeat
                         msg = json.loads(line.decode())
-                        deliver(
-                            msg["kind"], msg["event"], codec.decode(msg["obj"])
-                        )
+                        try:
+                            deliver(
+                                msg["kind"], msg["event"],
+                                codec.decode(msg["obj"]),
+                            )
+                        except Exception:  # noqa: BLE001 - handler fault
+                            # a handler doing its own I/O can fail
+                            # transiently (chaos plans inject exactly
+                            # this). Dropping the event would silently
+                            # lose it forever if nothing changes
+                            # server-side again, and letting it propagate
+                            # used to KILL the thread — instead, end this
+                            # attachment cleanly: the outer loop
+                            # re-attaches WITH replay, re-delivering the
+                            # full state so the level-triggered handler
+                            # gets another shot at the missed key.
+                            import logging
+
+                            logging.getLogger(__name__).exception(
+                                "watch %s: handler failed for one event; "
+                                "re-attaching with replay", kind,
+                            )
+                            return 200
                 return 200
             finally:
                 conn.close()
@@ -267,9 +307,18 @@ class RemoteStore:
             # as a hard error and terminates.
             import logging
 
+            from ..faults.policy import Backoff
+
             log = logging.getLogger(__name__)
             first = True
-            backoff = 0.5
+            # the unified backoff policy (faults/policy.py) replaces the
+            # hand-rolled doubling counter: full jitter de-synchronizes a
+            # fleet of daemons re-attaching to one restarted server. Two
+            # envelopes, as before — transport failures cap low so a
+            # restarting server is re-joined within a couple of seconds;
+            # HTTP-level errors (5xx) back off for real.
+            transport_bo = Backoff(base=0.5, cap=2.0)
+            http_bo = Backoff(base=0.5, cap=30.0)
             logged: set[object] = set()
             while not done():
                 status: Optional[int] = None
@@ -288,30 +337,29 @@ class RemoteStore:
                     stop.set()
                     return
                 if status == 200:
-                    backoff = 0.5  # healthy stream ended: quick resync
+                    transport_bo.reset()
+                    http_bo.reset()
+                    wait = 0.5  # healthy stream ended: quick resync
                 elif status is None:
                     # transport failure (connection refused, half-open
-                    # timeout): log the first occurrence per stream, and
-                    # back off mildly — the cap stays low so a restarting
-                    # server is re-joined within a couple of seconds
+                    # timeout): log the first occurrence per stream
                     if "transport" not in logged:
                         logged.add("transport")
                         log.warning(
                             "watch %s: %s unreachable (%s); retrying",
                             kind, self.base_url, err,
                         )
-                elif status not in logged:
-                    logged.add(status)
-                    log.warning(
-                        "watch %s: HTTP %d from %s; retrying with backoff",
-                        kind, status, self.base_url,
-                    )
+                    wait = transport_bo.next()
+                else:
+                    if status not in logged:
+                        logged.add(status)
+                        log.warning(
+                            "watch %s: HTTP %d from %s; retrying with backoff",
+                            kind, status, self.base_url,
+                        )
+                    wait = http_bo.next()
                 if not done():
-                    stop.wait(backoff)
-                    if status is None:
-                        backoff = min(backoff * 2, 2.0)
-                    elif status != 200:
-                        backoff = min(backoff * 2, 30.0)
+                    stop.wait(wait)
 
         t = threading.Thread(target=run, name=f"watch-{kind}", daemon=True)
         t.start()

@@ -1,0 +1,103 @@
+#!/usr/bin/env bash
+# Chaos smoke: boot a scheduler-less control plane + ONE scheduler daemon
+# whose env carries a seeded KARMADA_TPU_FAULT_PLAN (HTTP-boundary errors +
+# latency on every call to the control plane), then assert that
+#   1. the daemon still takes the lease and PLACES a workload (the retry /
+#      backoff plane rides out the injected faults), and
+#   2. the daemon's /metrics surface proves faults actually fired
+#      (karmada_faults_injected_total > 0).
+# Exit 0 prints "CHAOS OK".
+#
+# Wired into the chaos path as tests/test_chaos.py::TestChaosSmokeScript
+# (pytest -m 'slow and chaos'). Runs on CPU; needs no accelerator.
+set -euo pipefail
+
+cd "$(dirname "$0")/.."
+PY=${PYTHON:-python}
+WORK=$(mktemp -d /tmp/chaos_smoke.XXXXXX)
+MPORT=$((23000 + RANDOM % 20000))
+PIDS=()
+
+cleanup() {
+    for pid in "${PIDS[@]:-}"; do kill -9 "$pid" 2>/dev/null || true; done
+    rm -rf "$WORK"
+}
+trap cleanup EXIT
+
+log() { echo "chaos_smoke: $*"; }
+
+# --- control plane (fault-free: the chaos targets the scheduler's client
+# seam; the plan env is NOT exported to this process) ----------------------
+$PY -m karmada_tpu.server --platform cpu --members 3 \
+    --controllers '*,-scheduler' --tick-interval 0.5 \
+    > "$WORK/server.log" 2>&1 &
+PIDS+=($!)
+for _ in $(seq 1 120); do
+    URL=$(grep -oE 'http://[0-9.]+:[0-9]+' "$WORK/server.log" | head -1 || true)
+    [ -n "${URL:-}" ] && break
+    sleep 0.5
+done
+[ -n "${URL:-}" ] || { log "server never came up"; cat "$WORK/server.log"; exit 1; }
+log "control plane at $URL"
+
+# --- scheduler daemon under a seeded fault plan ---------------------------
+PLAN='{"seed": 7, "rules": [
+  {"boundary": "http", "target": "*", "kind": "error", "rate": 0.2},
+  {"boundary": "http", "target": "*", "kind": "latency", "latency": 0.02, "rate": 0.3}
+]}'
+KARMADA_TPU_FAULT_PLAN="$PLAN" $PY -m karmada_tpu.sched \
+    --server "$URL" --platform cpu --identity chaos-sched \
+    --lease-duration 3 --metrics-port "$MPORT" \
+    > "$WORK/sched.log" 2>&1 &
+PIDS+=($!)
+
+INSTALLED=""
+for _ in $(seq 1 120); do
+    if grep -q "chaos plan installed" "$WORK/sched.log" 2>/dev/null; then
+        INSTALLED=1; break
+    fi
+    sleep 0.5
+done
+[ -n "$INSTALLED" ] || {
+    log "scheduler never installed the fault plan"; cat "$WORK/sched.log"; exit 1; }
+log "scheduler running with injected faults"
+
+# --- a workload must still get placed -------------------------------------
+$PY - "$URL" <<'PYEOF'
+import sys, time
+from karmada_tpu.server.remote import RemoteControlPlane
+from karmada_tpu.testing.fixtures import (
+    duplicated_placement, new_deployment, new_policy, selector_for,
+)
+
+url = sys.argv[1]
+rcp = RemoteControlPlane(url)
+dep = new_deployment("default", "web", replicas=2, cpu=0.1)
+rcp.store.create(dep)
+rcp.store.create(new_policy("default", "pp", [selector_for(dep)],
+                            duplicated_placement([])))
+rcp.settle()
+deadline = time.monotonic() + 120
+while time.monotonic() < deadline:
+    rbs = rcp.store.list("ResourceBinding", "default")
+    if rbs and all(rb.spec.clusters for rb in rbs):
+        print("placed:", [(t.name, t.replicas)
+                          for rb in rbs for t in rb.spec.clusters])
+        sys.exit(0)
+    time.sleep(1.0)
+print("binding never placed under chaos", file=sys.stderr)
+sys.exit(1)
+PYEOF
+log "workload placed despite injected faults"
+
+# --- the faults must actually have fired ----------------------------------
+for _ in $(seq 1 30); do
+    INJ=$(curl -sf "http://127.0.0.1:$MPORT/metrics" 2>/dev/null \
+        | grep -E '^karmada_faults_injected_total' | head -3 || true)
+    [ -n "$INJ" ] && break
+    sleep 1.0
+done
+[ -n "${INJ:-}" ] || {
+    log "no karmada_faults_injected_total on /metrics"; exit 1; }
+log "injected: $INJ"
+echo "CHAOS OK"

@@ -1,0 +1,467 @@
+"""Seeded chaos sweep over the daemon topology (the tentpole's harness).
+
+One in-process topology — scheduler daemon + binding controller + execution
+controller + member fleet + guarded estimator fan-out — driven through a
+deterministic round schedule under a seeded `FaultPlan`:
+
+  - the estimator of one member (m2) is PARTITIONED for a window of sweeps:
+    its breaker opens, its column degrades to penalized stale answers, and
+    every degraded round still completes as ONE batched solve
+    (karmada_degraded_rounds_total + the solve counter assert it);
+  - the member-apply path of another member (m3) is partitioned for a
+    window of apply ops: the execution controller's typed retry policy
+    re-dispatches only the retryable failures until the window heals;
+  - once faults heal, a fleet-wide reschedule converges placements
+    BIT-IDENTICAL to the fault-free run of the same round schedule;
+  - member state reaches a fixpoint: an extra settle performs ZERO
+    additional applies (no duplicate member applies, no hot loops);
+  - the whole sweep runs TWICE with the same seed + plan and the recorded
+    fault schedules compare byte-identical (replayable chaos).
+
+Everything in the sweep is deterministic: fixed runtime clock, driver-owned
+breaker clock, synchronous watch delivery, uid-seeded tie-breaks, and fault
+decisions that are a pure function of (seed, site, op index).
+"""
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from karmada_tpu import faults
+from karmada_tpu.api.meta import CPU, MEMORY, ObjectMeta
+from karmada_tpu.api import policy as pol
+from karmada_tpu.api.work import (
+    BindingSpec,
+    ObjectReference,
+    ReplicaRequirements,
+    ResourceBinding,
+)
+from karmada_tpu.controllers.binding import BindingController
+from karmada_tpu.controllers.execution import ExecutionController
+from karmada_tpu.estimator.client import (
+    EstimatorRegistry,
+    UNAUTHENTIC_REPLICA,
+)
+from karmada_tpu.faults import BreakerRegistry, FaultPlan, FaultRule
+from karmada_tpu.interpreter.interpreter import ResourceInterpreter
+from karmada_tpu.members.member import InMemoryMember, MemberConfig
+from karmada_tpu.metrics import (
+    degraded_rounds,
+    scheduling_algorithm_duration,
+)
+from karmada_tpu.runtime.controller import Clock, Runtime
+from karmada_tpu.sched.scheduler import SchedulerDaemon
+from karmada_tpu.store.store import Store
+from karmada_tpu.testing.fixtures import new_cluster_with_resource
+
+GiB = 1024.0 ** 3
+
+# deterministic per-cluster estimator answers (replicas available); chosen
+# so the 60-replica aggregated binding fits exactly one healthy member (m1)
+# — a discarded (-1) m2 column makes m2 look infinitely roomy and steals
+# the spill, while a stale penalized m2 column keeps it on m1
+ANSWERS = {"m1": 64, "m2": 32, "m3": 16}
+
+
+class GuardedRows:
+    """Deterministic row estimator guarded like the wire client: breaker
+    admission, grpc-boundary fault injection, typed error metric, breaker
+    feedback — ONE op per cluster per sweep (the rows_fn shape), so fault
+    windows count sweeps. Shared with the coordination chaos-overlap test."""
+
+    def __init__(self, breakers: BreakerRegistry,
+                 answers: dict[str, int] = ANSWERS):
+        self.breakers = breakers
+        self.answers = answers
+
+    def _leg(self, cluster: str) -> int:
+        from karmada_tpu.metrics import estimator_rpc_errors
+
+        br = self.breakers.for_member(cluster)
+        if not br.allow():
+            return UNAUTHENTIC_REPLICA
+        try:
+            faults.check(faults.BOUNDARY_GRPC, cluster)
+        except faults.InjectedFault as e:
+            estimator_rpc_errors.inc(cluster=cluster, code=e.code)
+            br.record_failure()
+            return UNAUTHENTIC_REPLICA
+        br.record_success()
+        return self.answers.get(cluster, UNAUTHENTIC_REPLICA)
+
+    def max_available_replicas_rows(self, clusters, requirements_list):
+        col = np.array([self._leg(c) for c in clusters], np.int64)
+        return np.broadcast_to(
+            col, (len(requirements_list), len(clusters))
+        ).copy()
+
+
+def dyn_placement() -> pol.Placement:
+    return pol.Placement(
+        cluster_affinity=pol.ClusterAffinity(cluster_names=[]),
+        replica_scheduling=pol.ReplicaSchedulingStrategy(
+            replica_scheduling_type=pol.REPLICA_SCHEDULING_DIVIDED,
+            replica_division_preference=pol.DIVISION_PREFERENCE_AGGREGATED,
+        ),
+    )
+
+
+def dup_placement() -> pol.Placement:
+    return pol.Placement(
+        cluster_affinity=pol.ClusterAffinity(cluster_names=[]),
+        replica_scheduling=pol.ReplicaSchedulingStrategy(
+            replica_scheduling_type=pol.REPLICA_SCHEDULING_DUPLICATED,
+        ),
+    )
+
+
+def make_binding(name: str, uid: str, replicas: int,
+                 placement: pol.Placement) -> ResourceBinding:
+    return ResourceBinding(
+        metadata=ObjectMeta(namespace="default", name=name, uid=uid),
+        spec=BindingSpec(
+            resource=ObjectReference(
+                api_version="apps/v1", kind="Deployment",
+                namespace="default", name=name,
+            ),
+            replicas=replicas,
+            replica_requirements=ReplicaRequirements(
+                resource_request={CPU: 0.1}),
+            placement=placement,
+        ),
+    )
+
+
+def make_template(name: str, replicas: int):
+    from karmada_tpu.api.unstructured import Unstructured
+
+    return Unstructured({
+        "apiVersion": "apps/v1", "kind": "Deployment",
+        "metadata": {"namespace": "default", "name": name},
+        "spec": {"replicas": replicas,
+                 "template": {"spec": {"containers": [
+                     {"name": "app", "resources": {
+                         "requests": {"cpu": "100m"}}}]}}},
+    })
+
+
+class ChaosTopology:
+    """The daemon topology, in-process and fully deterministic."""
+
+    MEMBERS = ("m1", "m2", "m3")
+
+    def __init__(self):
+        self.store = Store()
+        self.runtime = Runtime(clock=Clock(fixed=1000.0))
+        self.mono = [0.0]  # driver-owned breaker clock
+        self.breakers = BreakerRegistry(
+            failure_threshold=2, open_seconds=60.0,
+            clock=lambda: self.mono[0],
+        )
+        self.registry = EstimatorRegistry(breakers=self.breakers)
+        self.registry.register_replica_estimator(
+            "member-estimators", GuardedRows(self.breakers)
+        )
+        self.interpreter = ResourceInterpreter()
+        self.members = {
+            n: InMemoryMember(MemberConfig(name=n)) for n in self.MEMBERS
+        }
+        self.applies: dict[str, int] = {n: 0 for n in self.MEMBERS}
+        for name, member in self.members.items():
+            member.apply_manifest = self._counting_apply(name, member)
+        for n in self.MEMBERS:
+            self.store.create(new_cluster_with_resource(
+                n, {CPU: 100.0, MEMORY: 400 * GiB, "pods": 1000.0}
+            ))
+        self.sched = SchedulerDaemon(
+            self.store, self.runtime, estimator_registry=self.registry
+        )
+        BindingController(self.store, self.interpreter, self.runtime)
+        ExecutionController(
+            self.store, self.members, self.interpreter, self.runtime
+        )
+
+    def _counting_apply(self, name: str, member: InMemoryMember):
+        orig = member.apply_manifest
+
+        def apply(manifest):
+            self.applies[name] += 1
+            return orig(manifest)
+
+        return apply
+
+    # -- driver ------------------------------------------------------------
+
+    def seed_workloads(self) -> None:
+        for name, uid, replicas, kind in WORKLOADS:
+            self.store.create(make_template(name, replicas))
+            self.store.create(make_binding(
+                name, uid, replicas,
+                dyn_placement() if kind == "dyn" else dup_placement(),
+            ))
+        self.runtime.settle()
+
+    def reschedule_round(self) -> None:
+        """One driven round: advance the plane clock, trigger a fleet-wide
+        reschedule, settle. Fresh-mode dispensing weighs avail + previous
+        assignment, so these rounds carry history."""
+        self.runtime.clock.advance(1.0)
+        now = self.runtime.clock.now()
+        for rb in self.store.list("ResourceBinding", "default"):
+            rb.spec.reschedule_triggered_at = now
+            self.store.update(rb)
+        self.runtime.settle()
+
+    def cold_redeploy_round(self) -> None:
+        """Clear every binding's placements and reschedule: the next solve
+        is COLD (no previous assignment in the dispense weights) — a pure
+        function of (spec, estimator answers, uid-seeded ties), directly
+        comparable against an independent ArrayScheduler cold solve."""
+        self.runtime.clock.advance(1.0)
+        now = self.runtime.clock.now()
+        for rb in self.store.list("ResourceBinding", "default"):
+            rb.spec.clusters = []
+            rb.spec.reschedule_triggered_at = now
+            self.store.update(rb)
+        self.runtime.settle()
+
+    def placements(self) -> dict[str, tuple]:
+        out = {}
+        for rb in self.store.list("ResourceBinding", "default"):
+            out[rb.metadata.name] = tuple(
+                sorted((t.name, t.replicas) for t in (rb.spec.clusters or []))
+            )
+        return out
+
+    def member_deployments(self) -> dict[str, set]:
+        out = {}
+        for n, m in self.members.items():
+            out[n] = {
+                o.name for o in m.store.list("apps/v1/Deployment", "default")
+            }
+        return out
+
+
+WORKLOADS = (
+    ("web", "rb-web", 60, "dyn"),
+    ("api", "rb-api", 6, "dyn"),
+    ("cfg", "rb-cfg", 2, "dup"),
+    ("dns", "rb-dns", 1, "dup"),
+)
+
+
+def independent_cold_solve() -> dict[str, tuple]:
+    """What a fault-free cold ArrayScheduler solve of the same specs with
+    the same fresh estimator answers places — the acceptance anchor the
+    healed daemon topology must reproduce bit-identically."""
+    from karmada_tpu.sched.core import ArrayScheduler
+
+    clusters = [
+        new_cluster_with_resource(
+            n, {CPU: 100.0, MEMORY: 400 * GiB, "pods": 1000.0}
+        )
+        for n in ChaosTopology.MEMBERS
+    ]
+    bindings = [
+        make_binding(name, uid, replicas,
+                     dyn_placement() if kind == "dyn" else dup_placement())
+        for name, uid, replicas, kind in WORKLOADS
+    ]
+    extra = np.full((len(bindings), len(clusters)), -1, np.int32)
+    col = np.array([ANSWERS[c.name] for c in clusters], np.int32)
+    for i, (_, _, _, kind) in enumerate(WORKLOADS):
+        if kind == "dyn":
+            extra[i] = col
+    decisions = ArrayScheduler(clusters).schedule(bindings, extra_avail=extra)
+    return {
+        rb.metadata.name: tuple(
+            sorted((t.name, t.replicas) for t in (d.targets or []))
+        )
+        for rb, d in zip(bindings, decisions)
+    }
+
+
+CHAOS_PLAN = FaultPlan(seed=2024, rules=[
+    # estimator of m2 goes dark for sweeps 1 and 2 (one op per sweep)
+    FaultRule(boundary="grpc", target="m2", kind="partition",
+              after=1, heal_after=3),
+    # member-apply on m3 fails for apply ops 2..6, then heals — exercised
+    # by the execution controller's retryable re-dispatch
+    FaultRule(boundary="apply", target="m3", kind="partition",
+              after=2, heal_after=7),
+])
+
+
+def run_sweep(plan: FaultPlan | None):
+    """The deterministic round schedule; returns the observables the
+    invariants compare."""
+    if plan is not None:
+        injector = faults.install(plan)
+    else:
+        faults.reset()
+        injector = None
+    topo = ChaosTopology()
+    phases: dict[str, dict] = {}
+    counters: dict[str, float] = {}
+
+    topo.seed_workloads()  # sweep op 0: fresh answers, cache primed
+    phases["fresh"] = topo.placements()
+
+    # sweep 1: m2's first failure — the breaker (threshold 2) is still
+    # CLOSED, so the column degrades to the -1 discard sentinel and the
+    # GeneralEstimator bound alone steers: the blip round misplaces the
+    # spilling binding ONTO the dark member (the failure mode the stale
+    # penalty exists to fix)
+    topo.cold_redeploy_round()
+    phases["blip"] = topo.placements()
+
+    d0 = degraded_rounds.total()
+    s0 = scheduling_algorithm_duration.count()
+    topo.cold_redeploy_round()  # sweep 2: m2 fails again -> breaker OPEN,
+    #                               stale penalized column, degraded round
+    counters["degraded_delta"] = degraded_rounds.total() - d0
+    counters["solves_delta"] = scheduling_algorithm_duration.count() - s0
+    counters["open_members"] = tuple(sorted(topo.breakers.open_members()))
+    # the tracker's epoch proves the stale column was served this round
+    # (the registry's last_sweep_* lists reset on the settle's later
+    # duplicated-only drain, which never sweeps estimators)
+    counters["stale_age_m2"] = topo.registry.staleness.age("m2")
+    phases["degraded"] = topo.placements()
+
+    topo.cold_redeploy_round()  # still open: fast-fail, deeper staleness
+    phases["degraded2"] = topo.placements()
+
+    # heal: the open window elapses; the next sweep's half-open probe hits
+    # the healed plan window, closes the breaker, and fresh answers return.
+    # The round is a cold redeploy, so converged placements are directly
+    # comparable to a fault-free cold solve.
+    topo.mono[0] = 60.0
+    topo.cold_redeploy_round()
+    counters["post_heal_open"] = tuple(sorted(topo.breakers.open_members()))
+    phases["healed"] = topo.placements()
+
+    # fixpoint: one more settle must apply NOTHING new anywhere
+    applies_before = dict(topo.applies)
+    topo.runtime.settle()
+    counters["fixpoint_applies"] = (topo.applies == applies_before)
+
+    return {
+        "phases": phases,
+        "counters": counters,
+        "applies": dict(topo.applies),
+        "member_deployments": topo.member_deployments(),
+        "trace": b"" if injector is None else injector.trace_bytes(),
+        "breaker_state_m2": topo.breakers.for_member("m2").state,
+    }
+
+
+@pytest.fixture(autouse=True)
+def _clean_plan():
+    faults.reset()
+    yield
+    faults.reset()
+
+
+class TestChaosSweep:
+    def test_seeded_sweep_invariants_and_replay(self):
+        chaos_a = run_sweep(CHAOS_PLAN)
+        chaos_b = run_sweep(CHAOS_PLAN)  # the replay
+        clean = run_sweep(None)
+
+        # --- replayable chaos: same seed + same plan ⇒ byte-identical
+        # fault schedule, and the whole sweep's observables match
+        assert chaos_a["trace"], "the plan must have fired"
+        assert chaos_a["trace"] == chaos_b["trace"]
+        assert chaos_a == chaos_b
+
+        c = chaos_a["counters"]
+        # --- the breaker actually opened on the partitioned member, the
+        # stale column was served (epoch 1), and the degraded round counted
+        assert c["open_members"] == ("m2",)
+        assert c["stale_age_m2"] == 1
+        assert c["degraded_delta"] == 1
+        # --- a breaker-open round adds NO extra batched solves vs the
+        # fault-free run of the identical round (stale rows stay in the
+        # [B,C] matrix — only the extra_avail DATA changed)
+        assert c["solves_delta"] == clean["counters"]["solves_delta"]
+        assert c["post_heal_open"] == ()
+        assert chaos_a["breaker_state_m2"] == faults.CLOSED
+
+        # --- why the stale penalty exists: the BLIP round (one failure,
+        # breaker still closed) discards m2's column to -1, so only the
+        # GeneralEstimator bound steers and the spilling aggregated binding
+        # lands ON the dark member; once the breaker opens, the penalized
+        # stale answers pull it off m2
+        blip = dict(chaos_a["phases"]["blip"]["web"])
+        degraded = dict(chaos_a["phases"]["degraded"]["web"])
+        assert blip.get("m2", 0) > 0, "blip round should over-trust m2"
+        assert degraded.get("m2", 0) == 0, (
+            "the stale penalty must steer the spill off the dark member"
+        )
+
+        # --- post-heal convergence: bit-identical to the fault-free run
+        # of the same schedule AND to an independent fault-free cold solve
+        assert chaos_a["phases"]["healed"] == clean["phases"]["healed"]
+        assert chaos_a["phases"]["healed"] == independent_cold_solve()
+
+        # --- no duplicate member applies: member state reaches a fixpoint
+        # (an extra settle applies nothing) and the final member contents
+        # mirror the final placements exactly
+        assert c["fixpoint_applies"]
+        assert clean["counters"]["fixpoint_applies"]
+        final = chaos_a["phases"]["healed"]
+        expected = {m: set() for m in ChaosTopology.MEMBERS}
+        for workload, targets in final.items():
+            for cluster, _ in targets:
+                expected[cluster].add(workload)
+        assert chaos_a["member_deployments"] == expected
+
+    def test_fault_free_sweep_is_fault_free(self):
+        clean = run_sweep(None)
+        c = clean["counters"]
+        assert c["open_members"] == ()
+        assert c["degraded_delta"] == 0
+        assert c["stale_age_m2"] == 0
+        assert c["solves_delta"] >= 1
+        assert clean["trace"] == b""
+
+    def test_apply_outage_retries_only_retryable_and_heals(self):
+        """The m3 apply partition: during the outage the Work condition
+        carries the unchanged AppliedFailed message; the retry policy
+        re-dispatches until the window heals; afterwards everything lands."""
+        faults.install(FaultPlan(seed=7, rules=[
+            FaultRule(boundary="apply", target="m3", kind="partition",
+                      after=0, heal_after=4),
+        ]))
+        topo = ChaosTopology()
+        topo.seed_workloads()
+        # duplicated workloads land on every member, m3 included, despite
+        # the first 4 apply ops failing — the bounded re-dispatch healed it
+        assert "cfg" in topo.member_deployments()["m3"]
+        assert "dns" in topo.member_deployments()["m3"]
+        from karmada_tpu.api.meta import get_condition
+        from karmada_tpu.api.work import WORK_CONDITION_APPLIED
+
+        for w in topo.store.list("Work"):
+            cond = get_condition(w.status.conditions, WORK_CONDITION_APPLIED)
+            assert cond is not None and cond.status == "True", (
+                f"{w.namespace}/{w.name} never converged: {cond}"
+            )
+
+
+@pytest.mark.slow
+@pytest.mark.chaos
+class TestChaosSmokeScript:
+    def test_chaos_smoke(self):
+        """scripts/chaos_smoke.sh: real daemon topology (server + scheduler
+        processes) under an env-gated fault plan — placements land despite
+        injected faults and /metrics shows the injections."""
+        import subprocess
+
+        pytest.importorskip("cryptography")
+        r = subprocess.run(
+            ["bash", "scripts/chaos_smoke.sh"],
+            capture_output=True, text=True, timeout=300, cwd="/root/repo",
+        )
+        assert r.returncode == 0, r.stdout + r.stderr
+        assert "CHAOS OK" in r.stdout

@@ -408,13 +408,15 @@ class SchedHarness:
     SchedulerDaemon, elector with fencing callbacks), in-process so the
     clock is injectable and 'kill -9' is 'stop stepping'."""
 
-    def __init__(self, url: str, identity: str, coordinator=None):
+    def __init__(self, url: str, identity: str, coordinator=None,
+                 registry=None):
         self.identity = identity
         self.store = RemoteStore(url, token="tok")
         self.runtime = Runtime()
         from karmada_tpu.sched.scheduler import SchedulerDaemon
 
-        self.daemon = SchedulerDaemon(self.store, self.runtime)
+        self.daemon = SchedulerDaemon(self.store, self.runtime,
+                                      estimator_registry=registry)
         self.elector = Elector(
             self.store, "karmada-scheduler", identity, lease_duration=10.0,
             on_started_leading=lambda t: self.store.set_fence(
@@ -579,6 +581,142 @@ class TestSchedulerFailoverParity:
             b.close()
             user.close()
             srv.stop()
+
+
+# ---------------------------------------------------------------------------
+# Chaos overlap: SIGKILL failover WHILE the fault injector flaps one
+# member's estimator (faults/ plane × coordination plane)
+# ---------------------------------------------------------------------------
+
+
+class TestChaosOverlapFailover:
+    """The two robustness planes interfering: the estimator of one member
+    flaps (fault injector + per-member breaker) while the scheduler leader
+    dies mid-run. Fencing must still 409 the deposed leader's late write,
+    and the final placements must be bit-identical to the fault-free
+    single-daemon baseline — estimator-side chaos must never leak into
+    placement results when its answers don't bind (answers above the
+    GeneralEstimator bound) nor corrupt the election."""
+
+    # answers far above the GeneralEstimator capacity bound: the min-merge
+    # always resolves to the general bound, so flap (-1), stale (decayed)
+    # and fresh answers all land identical placements — chaos is pure
+    # interference here, which is exactly what the parity assertion needs
+    ANSWERS = {"m1": 10 ** 6, "m2": 10 ** 6, "m3": 10 ** 6}
+
+    def _registry(self):
+        from karmada_tpu.estimator.client import EstimatorRegistry
+        from karmada_tpu.faults import BreakerRegistry
+        from tests.test_chaos import GuardedRows
+
+        breakers = BreakerRegistry(failure_threshold=2, open_seconds=0.2)
+        registry = EstimatorRegistry(breakers=breakers)
+        registry.register_replica_estimator(
+            "members", GuardedRows(breakers, answers=self.ANSWERS)
+        )
+        return registry, breakers
+
+    def _churn(self, user, round_no: int) -> None:
+        from tests.test_chaos import dyn_placement
+
+        _churn(user, round_no)  # the duplicated set
+        # dynamic rows so the estimator fan-out (the flapping boundary)
+        # actually runs every round
+        user.create(make_rb(f"dyn-r{round_no}", replicas=2 + round_no,
+                            placement=dyn_placement()))
+
+    def _run_epoch(self, harnesses, user, rounds):
+        for r in rounds:
+            self._churn(user, r)
+
+            def all_placed() -> bool:
+                for h in harnesses:
+                    h.drive()
+                return all(
+                    rb.spec.clusters
+                    for rb in user.list("ResourceBinding", "default")
+                )
+
+            assert wait_until(all_placed, timeout=60.0), (
+                f"round {r} never fully placed"
+            )
+
+    def test_failover_during_estimator_flap(self):
+        from karmada_tpu import faults
+        from karmada_tpu.metrics import estimator_rpc_errors
+
+        # --- fault-free single-daemon baseline ----------------------------
+        faults.reset()
+        cp1 = MiniPlane()
+        srv1 = ControlPlaneServer(cp1, token="tok")
+        srv1.start()
+        user1 = RemoteStore(srv1.url, token="tok")
+        solo = SchedHarness(srv1.url, "solo_1",
+                            registry=self._registry()[0])
+        try:
+            for name in ("m1", "m2", "m3"):
+                user1.create(_mk_cluster(name))
+            self._run_epoch([solo], user1, rounds=(1, 2, 3))
+            baseline = _placements(user1)
+        finally:
+            solo.close()
+            user1.close()
+            srv1.stop()
+        assert baseline
+
+        # --- HA pair, estimator of m2 flapping, SIGKILL mid-run -----------
+        faults.install(faults.FaultPlan(seed=99, rules=[
+            faults.FaultRule(boundary="grpc", target="m2", kind="flap",
+                             period=2),
+        ]))
+        errs0 = estimator_rpc_errors.value(cluster="m2", code="UNAVAILABLE")
+        cp2 = MiniPlane()
+        srv2 = ControlPlaneServer(cp2, token="tok")
+        srv2.start()
+        user2 = RemoteStore(srv2.url, token="tok")
+        a = SchedHarness(srv2.url, "a_1", registry=self._registry()[0])
+        b = SchedHarness(srv2.url, "b_2", registry=self._registry()[0])
+        try:
+            for name in ("m1", "m2", "m3"):
+                user2.create(_mk_cluster(name))
+            self._run_epoch([a, b], user2, rounds=(1,))
+            leaders = [h for h in (a, b) if h.elector.is_leader]
+            assert len(leaders) == 1
+            leader = leaders[0]
+            standby = b if leader is a else a
+
+            # SIGKILL the leader (stop stepping); TTL elapses; the standby
+            # wins — all while the injector keeps flapping m2's estimator
+            cp2.clock.advance(10.5)
+            assert standby.elector.step() is True, (
+                "standby not promoted within one lease TTL during the flap"
+            )
+            self._run_epoch([standby], user2, rounds=(2, 3))
+
+            # the flap genuinely fired against m2's estimator
+            assert estimator_rpc_errors.value(
+                cluster="m2", code="UNAVAILABLE") > errs0
+            inj = faults.active()
+            assert inj is not None and inj.trace, "no faults recorded"
+
+            # fencing holds across the breaker flaps: the dead leader's
+            # late write bounces with 409
+            rb = leader.store.try_get("ResourceBinding", "app-r1-0",
+                                      "default")
+            rb.spec.replicas = 77
+            with pytest.raises(ConflictError):
+                leader.store.update(rb)
+
+            assert _placements(user2) == baseline, (
+                "chaos-overlap placements diverged from the fault-free "
+                "single-daemon run"
+            )
+        finally:
+            faults.reset()
+            a.close()
+            b.close()
+            user2.close()
+            srv2.stop()
 
 
 # ---------------------------------------------------------------------------

@@ -1,0 +1,730 @@
+"""Fault-tolerance plane unit tests (karmada_tpu/faults/, docs/ROBUSTNESS.md):
+
+- FaultPlan determinism: same seed + same plan ⇒ byte-identical schedule;
+- CircuitBreaker state machine under a fake clock (open / half-open probe
+  timing, probe admission limits);
+- RetryPolicy full-jitter envelope + deadline budget; Backoff streaks;
+- staleness penalty + tracker semantics;
+- typed per-manifest apply results (retryable vs terminal) and the
+  execution controller's bounded re-dispatch;
+- degraded estimator sweeps: open breaker ⇒ stale penalized column, fresh
+  sweep ⇒ cache refresh, and the estimator error metric by status code.
+"""
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from karmada_tpu import faults
+from karmada_tpu.faults import (
+    CLOSED,
+    HALF_OPEN,
+    OPEN,
+    Backoff,
+    BreakerRegistry,
+    CircuitBreaker,
+    FaultPlan,
+    FaultRule,
+    InjectedFault,
+    RetryPolicy,
+    StalenessTracker,
+    apply_staleness_penalty,
+)
+
+
+@pytest.fixture(autouse=True)
+def _no_ambient_plan():
+    faults.reset()
+    yield
+    faults.reset()
+
+
+# ---------------------------------------------------------------------------
+# deterministic fault plans
+# ---------------------------------------------------------------------------
+
+
+class TestFaultPlan:
+    def plan(self) -> FaultPlan:
+        return FaultPlan(seed=42, rules=[
+            FaultRule(boundary="grpc", target="m1", kind="flap", period=3),
+            FaultRule(boundary="grpc", target="m2", kind="partition",
+                      after=2, heal_after=6),
+            FaultRule(boundary="http", kind="error", rate=0.5,
+                      heal_after=50),
+            FaultRule(boundary="apply", target="m3", kind="latency",
+                      latency=0.001, rate=0.25),
+        ])
+
+    def test_same_seed_same_plan_byte_identical_schedule(self):
+        p1 = self.plan()
+        p2 = FaultPlan.from_json(p1.to_json())  # round-trips the plan
+        for boundary, target in (("grpc", "m1"), ("grpc", "m2"),
+                                 ("http", "x:1"), ("apply", "m3")):
+            assert (p1.schedule(boundary, target, 64)
+                    == p2.schedule(boundary, target, 64))
+
+    def test_different_seed_changes_probabilistic_schedule(self):
+        p1, p2 = self.plan(), self.plan()
+        p2.seed = 43
+        assert (p1.schedule("http", "x:1", 256)
+                != p2.schedule("http", "x:1", 256))
+
+    def test_flap_alternates_in_period_windows(self):
+        p = self.plan()
+        states = [p.decide("grpc", "m1", n).error for n in range(9)]
+        assert states == [None] * 3 + ["UNAVAILABLE"] * 3 + [None] * 3
+
+    def test_partition_window_and_heal(self):
+        p = self.plan()
+        states = [p.decide("grpc", "m2", n).error for n in range(8)]
+        assert states == [None, None] + ["UNAVAILABLE"] * 4 + [None, None]
+
+    def test_unmatched_site_is_clean(self):
+        p = self.plan()
+        for n in range(16):
+            a = p.decide("apply", "m-not-listed", n)
+            assert a.error is None and a.latency == 0.0
+
+    def test_injector_counts_per_site_and_traces(self):
+        inj = faults.install(self.plan())
+        hits = 0
+        for _ in range(6):
+            try:
+                inj.check("grpc", "m1")
+            except InjectedFault as e:
+                assert e.code == "UNAVAILABLE"
+                hits += 1
+        assert hits == 3  # ops 3,4,5 of the flap
+        t1 = inj.trace_bytes()
+        # replaying the same driver against a fresh injector reproduces the
+        # trace byte-for-byte
+        inj2 = faults.FaultInjector(self.plan())
+        for _ in range(6):
+            try:
+                inj2.check("grpc", "m1")
+            except InjectedFault:
+                pass
+        assert t1 == inj2.trace_bytes()
+
+    def test_env_gate_installs_and_malformed_plan_raises(self, monkeypatch,
+                                                         tmp_path):
+        monkeypatch.setenv(faults.ENV_FAULT_PLAN, self.plan().to_json())
+        faults.reset()
+        assert faults.active() is not None
+        faults.reset()
+        f = tmp_path / "plan.json"
+        f.write_text(self.plan().to_json())
+        monkeypatch.setenv(faults.ENV_FAULT_PLAN, str(f))
+        assert faults.active() is not None
+        faults.reset()
+        monkeypatch.setenv(faults.ENV_FAULT_PLAN, '{"rules": [{"boundary": "grpc", "kind": "nope"}]}')
+        with pytest.raises(ValueError, match="unknown fault kind"):
+            faults.install_from_env()
+
+    def test_check_is_noop_without_plan(self, monkeypatch):
+        monkeypatch.delenv(faults.ENV_FAULT_PLAN, raising=False)
+        faults.reset()
+        faults.check("grpc", "m1")  # must not raise
+
+    def test_typoed_boundary_rejected_at_install(self):
+        with pytest.raises(ValueError, match="unknown fault boundary"):
+            faults.install(FaultPlan(seed=1, rules=[
+                FaultRule(boundary="gprc", target="m1", kind="partition"),
+            ]))
+
+    def test_malformed_env_plan_raises_persistently(self, monkeypatch):
+        """A broken chaos plan must never quietly become a clean run: the
+        lazy env install fails on EVERY boundary hit, not just the first
+        (which a broad except at some call site could swallow)."""
+        monkeypatch.setenv(faults.ENV_FAULT_PLAN, '{"rules": "nope"}')
+        faults.reset()
+        with pytest.raises(Exception):
+            faults.active()
+        with pytest.raises(Exception):
+            faults.active()  # still raising — not latched into silence
+        with pytest.raises(Exception):
+            faults.check("grpc", "m1")
+
+    def test_env_gate_mints_exactly_one_injector(self, monkeypatch):
+        """Repeated active() calls must return the SAME injector — a second
+        install would reset per-site op counters and break replay."""
+        monkeypatch.setenv(faults.ENV_FAULT_PLAN, self.plan().to_json())
+        faults.reset()
+        a = faults.active()
+        b = faults.active()
+        assert a is not None and a is b
+        assert faults.active() is a
+
+
+# ---------------------------------------------------------------------------
+# circuit breaker (fake clock)
+# ---------------------------------------------------------------------------
+
+
+class TestCircuitBreaker:
+    def setup_method(self):
+        self.t = [0.0]
+        self.br = CircuitBreaker(
+            "m1", failure_threshold=3, open_seconds=5.0,
+            clock=lambda: self.t[0],
+        )
+
+    def test_closed_until_consecutive_threshold(self):
+        for _ in range(2):
+            self.br.record_failure()
+        assert self.br.state == CLOSED and self.br.allow()
+        self.br.record_success()  # success resets the streak
+        for _ in range(2):
+            self.br.record_failure()
+        assert self.br.state == CLOSED
+        self.br.record_failure()
+        assert self.br.state == OPEN
+        assert not self.br.allow()
+
+    def _trip(self):
+        for _ in range(3):
+            self.br.record_failure()
+
+    def test_half_open_probe_timing(self):
+        self._trip()
+        self.t[0] = 4.9
+        assert not self.br.allow(), "open window not elapsed"
+        self.t[0] = 5.0
+        assert self.br.state == HALF_OPEN
+        assert self.br.allow()  # the single probe
+        assert not self.br.allow(), "only one probe admitted"
+
+    def test_probe_failure_reopens_and_restarts_window(self):
+        self._trip()
+        self.t[0] = 5.0
+        assert self.br.allow()
+        self.br.record_failure()
+        assert self.br.state == OPEN
+        self.t[0] = 9.9  # window restarted at t=5.0
+        assert not self.br.allow()
+        self.t[0] = 10.0
+        assert self.br.allow()
+
+    def test_probe_success_closes(self):
+        self._trip()
+        self.t[0] = 5.0
+        assert self.br.allow()
+        self.br.record_success()
+        assert self.br.state == CLOSED
+        assert self.br.allow()
+
+    def test_transition_metrics(self):
+        from karmada_tpu.metrics import breaker_state, breaker_transitions
+
+        before = breaker_transitions.value(member="m1", to=OPEN)
+        self._trip()
+        assert breaker_transitions.value(member="m1", to=OPEN) == before + 1
+        assert breaker_state.value(member="m1") == 2.0
+        self.t[0] = 5.0
+        self.br.allow()
+        self.br.record_success()
+        assert breaker_state.value(member="m1") == 0.0
+
+    def test_registry_open_members(self):
+        t = [0.0]
+        reg = BreakerRegistry(failure_threshold=1, open_seconds=5.0,
+                              clock=lambda: t[0])
+        reg.for_member("a").record_failure()
+        reg.for_member("b").record_success()
+        assert reg.open_members() == {"a"}
+        assert reg.any_open()
+        t[0] = 5.0  # half-open probes: no longer dark
+        assert reg.open_members() == set()
+
+
+# ---------------------------------------------------------------------------
+# retry policy + backoff
+# ---------------------------------------------------------------------------
+
+
+class TestRetryPolicy:
+    def test_full_jitter_delay_envelope(self):
+        p = RetryPolicy(base_delay=1.0, max_delay=8.0, multiplier=2.0)
+        assert p.delay(0, u=1.0) == 1.0
+        assert p.delay(2, u=1.0) == 4.0
+        assert p.delay(5, u=1.0) == 8.0  # capped
+        assert p.delay(5, u=0.0) == 0.0  # full jitter reaches zero
+
+    def test_run_retries_then_succeeds(self):
+        calls = {"n": 0}
+        sleeps: list[float] = []
+
+        def fn():
+            calls["n"] += 1
+            if calls["n"] < 3:
+                raise ConnectionError("transient")
+            return "ok"
+
+        out = RetryPolicy(max_attempts=5).run(
+            fn, retryable=lambda e: isinstance(e, ConnectionError),
+            sleep=sleeps.append, rng=lambda: 1.0,
+        )
+        assert out == "ok" and calls["n"] == 3 and len(sleeps) == 2
+
+    def test_run_gives_up_on_terminal_and_attempt_budget(self):
+        with pytest.raises(ValueError):
+            RetryPolicy().run(
+                lambda: (_ for _ in ()).throw(ValueError("terminal")),
+                retryable=lambda e: False, sleep=lambda s: None,
+            )
+        calls = {"n": 0}
+
+        def always_fail():
+            calls["n"] += 1
+            raise ConnectionError("down")
+
+        with pytest.raises(ConnectionError):
+            RetryPolicy(max_attempts=3).run(
+                always_fail, retryable=lambda e: True,
+                sleep=lambda s: None, rng=lambda: 0.5,
+            )
+        assert calls["n"] == 3
+
+    def test_run_respects_deadline_budget(self):
+        t = [0.0]
+        calls = {"n": 0}
+
+        def fail():
+            calls["n"] += 1
+            t[0] += 10.0  # each attempt burns 10s of the 15s budget
+            raise ConnectionError("slow failure")
+
+        with pytest.raises(ConnectionError):
+            RetryPolicy(max_attempts=10, deadline=15.0,
+                        base_delay=6.0, multiplier=1.0).run(
+                fail, retryable=lambda e: True,
+                sleep=lambda s: None, clock=lambda: t[0], rng=lambda: 1.0,
+            )
+        assert calls["n"] == 2  # attempt 3 would overrun the deadline
+
+    def test_backoff_streak_and_reset(self):
+        bo = Backoff(base=0.5, cap=2.0, rng=lambda: 1.0)
+        assert [bo.next(), bo.next(), bo.next(), bo.next()] == \
+            [0.5, 1.0, 2.0, 2.0]
+        bo.reset()
+        assert bo.next() == 0.5
+
+
+# ---------------------------------------------------------------------------
+# staleness penalty
+# ---------------------------------------------------------------------------
+
+
+class TestStaleness:
+    def test_penalty_halves_per_epoch_and_keeps_sentinel(self):
+        v = np.array([64, 1, 0, -1], np.int32)
+        assert list(apply_staleness_penalty(v, 0)) == [64, 1, 0, -1]
+        assert list(apply_staleness_penalty(v, 1)) == [32, 0, 0, -1]
+        assert list(apply_staleness_penalty(v, 3)) == [8, 0, 0, -1]
+        # age caps: stable past MAX_STALENESS_AGE (replay can re-engage)
+        a = apply_staleness_penalty(v, faults.MAX_STALENESS_AGE)
+        b = apply_staleness_penalty(v, faults.MAX_STALENESS_AGE + 5)
+        assert list(a) == list(b)
+
+    def test_tracker_round_trip(self):
+        st = StalenessTracker()
+        st.record_fresh("m1", ["a", "b", None], np.array([8, -1, 5]))
+        col = st.fill_stale("m1", ["a", "b", "new"])
+        assert list(col) == [4, -1, -1]  # age 1: halved; unknown → sentinel
+        col = st.fill_stale("m1", ["a"])
+        assert list(col) == [2]  # age 2
+        st.record_fresh("m1", ["a"], np.array([100]))
+        assert st.age("m1") == 0
+        assert st.fill_stale("never-seen", ["a"]) is None
+
+
+# ---------------------------------------------------------------------------
+# typed per-manifest apply results + bounded re-dispatch
+# ---------------------------------------------------------------------------
+
+
+def _work_for(cluster: str, name: str, manifests: list[dict]):
+    from karmada_tpu.api.meta import ObjectMeta, new_uid
+    from karmada_tpu.api.work import (
+        Work,
+        WorkSpec,
+        work_namespace_for_cluster,
+    )
+
+    return Work(
+        metadata=ObjectMeta(
+            namespace=work_namespace_for_cluster(cluster), name=name,
+            uid=new_uid("work"),
+        ),
+        spec=WorkSpec(workload_manifests=manifests),
+    )
+
+
+def _manifest(name: str, replicas: int = 1) -> dict:
+    return {
+        "apiVersion": "apps/v1", "kind": "Deployment",
+        "metadata": {"namespace": "default", "name": name},
+        "spec": {"replicas": replicas},
+    }
+
+
+class TestManifestResults:
+    def test_apply_returns_typed_results_with_same_message_strings(self):
+        from karmada_tpu.controllers.execution import apply_work_manifests
+        from karmada_tpu.interpreter.interpreter import ResourceInterpreter
+        from karmada_tpu.members.member import InMemoryMember, MemberConfig
+
+        member = InMemoryMember(MemberConfig(name="m1"))
+        work = _work_for("m1", "w", [_manifest("app")])
+        results = apply_work_manifests(work, member,
+                                       ResourceInterpreter())
+        assert len(results) == 1 and results[0].ok
+        assert member.get("apps/v1", "Deployment", "app", "default") is not None
+
+    def test_injected_apply_fault_is_retryable_and_message_format_stable(self):
+        from karmada_tpu.controllers.execution import apply_work_manifests
+        from karmada_tpu.interpreter.interpreter import ResourceInterpreter
+        from karmada_tpu.members.member import InMemoryMember, MemberConfig
+
+        faults.install(FaultPlan(seed=1, rules=[
+            FaultRule(boundary="apply", target="m1", kind="partition"),
+        ]))
+        member = InMemoryMember(MemberConfig(name="m1"))
+        work = _work_for("m1", "w", [_manifest("app")])
+        results = apply_work_manifests(work, member, ResourceInterpreter())
+        assert not results[0].ok and results[0].retryable
+        # the Work-condition string format the controllers always wrote
+        assert results[0].message.startswith("Deployment/app: ")
+
+    def test_classification(self):
+        from karmada_tpu.controllers.execution import classify_apply_error
+        from karmada_tpu.store.store import ConflictError
+
+        assert classify_apply_error(ConflictError("rv"))
+        assert classify_apply_error(InjectedFault("apply", "m1"))
+        assert classify_apply_error(ConnectionError("reset"))
+        assert classify_apply_error(TimeoutError("deadline"))
+        assert not classify_apply_error(ValueError("bad manifest"))
+        assert not classify_apply_error(KeyError("missing"))
+
+    def test_execution_controller_requeues_only_retryable(self):
+        """A transient apply fault heals after 2 ops: the controller's
+        bounded re-dispatch lands the manifest without operator action,
+        and the Work condition carries the unchanged message strings
+        while failing."""
+        from karmada_tpu.api.meta import get_condition
+        from karmada_tpu.api.work import WORK_CONDITION_APPLIED
+        from karmada_tpu.controllers.execution import ExecutionController
+        from karmada_tpu.interpreter.interpreter import ResourceInterpreter
+        from karmada_tpu.members.member import InMemoryMember, MemberConfig
+        from karmada_tpu.runtime.controller import Runtime
+        from karmada_tpu.store.store import Store
+
+        faults.install(FaultPlan(seed=1, rules=[
+            FaultRule(boundary="apply", target="m1", kind="partition",
+                      heal_after=2),
+        ]))
+        store = Store()
+        runtime = Runtime()
+        members = {"m1": InMemoryMember(MemberConfig(name="m1"))}
+        ExecutionController(store, members, ResourceInterpreter(), runtime)
+        store.create(_work_for("m1", "w", [_manifest("app")]))
+        runtime.settle()
+        work = store.get("Work", "w", "karmada-es-m1")
+        cond = get_condition(work.status.conditions, WORK_CONDITION_APPLIED)
+        assert cond is not None and cond.status == "True"
+        assert members["m1"].get("apps/v1", "Deployment", "app",
+                                 "default") is not None
+
+    def test_terminal_failure_does_not_requeue(self):
+        """A manifest the member rejects terminally parks on the condition:
+        the queue must not spin on it (retry budget untouched)."""
+        from karmada_tpu.api.meta import get_condition
+        from karmada_tpu.api.work import WORK_CONDITION_APPLIED
+        from karmada_tpu.controllers.execution import ExecutionController
+        from karmada_tpu.interpreter.interpreter import ResourceInterpreter
+        from karmada_tpu.members.member import InMemoryMember, MemberConfig
+        from karmada_tpu.runtime.controller import Runtime
+        from karmada_tpu.store.store import Store
+
+        store = Store()
+        runtime = Runtime()
+        member = InMemoryMember(MemberConfig(name="m1"))
+        applies = {"n": 0}
+        orig = member.apply_manifest
+
+        def failing_apply(manifest):
+            applies["n"] += 1
+            raise ValueError("field is immutable")
+
+        member.apply_manifest = failing_apply
+        ExecutionController(store, {"m1": member}, ResourceInterpreter(),
+                            runtime)
+        store.create(_work_for("m1", "w", [_manifest("app")]))
+        runtime.settle()
+        # event-driven reconciles (finalizer + condition updates) may apply
+        # a couple of times, but the RETRY path must not engage: a
+        # requeueing terminal failure would burn the whole 16-deep budget
+        n0 = applies["n"]
+        assert n0 <= 3, f"terminal failure re-dispatched {n0} times"
+        runtime.settle()
+        assert applies["n"] == n0, "terminal failure must reach a fixpoint"
+        work = store.get("Work", "w", "karmada-es-m1")
+        cond = get_condition(work.status.conditions, WORK_CONDITION_APPLIED)
+        assert cond.status == "False"
+        assert cond.message == "Deployment/app: field is immutable"
+        member.apply_manifest = orig
+
+
+# ---------------------------------------------------------------------------
+# degraded estimator sweeps (breaker-open column → stale penalized rows)
+# ---------------------------------------------------------------------------
+
+
+class _FlakyRows:
+    """Deterministic row estimator whose per-cluster legs raise while that
+    cluster is 'dark' — the in-process stand-in for a member estimator
+    daemon (answers per (binding, cluster) = 100 + 10·b + c)."""
+
+    def __init__(self, breakers):
+        self.breakers = breakers
+        self.dark: set[str] = set()
+
+    def max_available_replicas(self, clusters, requirements, replicas):
+        out = []
+        for c, cluster in enumerate(clusters):
+            br = self.breakers.for_member(cluster)
+            if not br.allow():
+                out.append(-1)
+                continue
+            if cluster in self.dark:
+                br.record_failure()
+                from karmada_tpu.metrics import estimator_rpc_errors
+
+                estimator_rpc_errors.inc(cluster=cluster, code="UNAVAILABLE")
+                out.append(-1)
+                continue
+            br.record_success()
+            out.append(100 + c)
+        return out
+
+
+def _dyn_binding(i: int, replicas: int = 4):
+    from karmada_tpu.api.meta import CPU, ObjectMeta, new_uid
+    from karmada_tpu.api import policy as pol
+    from karmada_tpu.api.work import (
+        BindingSpec,
+        ObjectReference,
+        ReplicaRequirements,
+        ResourceBinding,
+    )
+
+    return ResourceBinding(
+        metadata=ObjectMeta(namespace="default", name=f"app-{i}",
+                            uid=f"rb-{i}"),
+        spec=BindingSpec(
+            resource=ObjectReference(api_version="apps/v1",
+                                     kind="Deployment",
+                                     namespace="default", name=f"app-{i}"),
+            replicas=replicas,
+            replica_requirements=ReplicaRequirements(
+                resource_request={CPU: 0.1}),
+            placement=pol.Placement(
+                cluster_affinity=pol.ClusterAffinity(cluster_names=[]),
+                replica_scheduling=pol.ReplicaSchedulingStrategy(
+                    replica_scheduling_type=pol.REPLICA_SCHEDULING_DIVIDED,
+                    replica_division_preference=(
+                        pol.DIVISION_PREFERENCE_AGGREGATED),
+                ),
+            ),
+        ),
+    )
+
+
+class TestDegradedSweep:
+    def test_open_breaker_serves_penalized_stale_column(self):
+        from karmada_tpu.estimator.client import EstimatorRegistry
+
+        t = [0.0]
+        breakers = BreakerRegistry(failure_threshold=2, open_seconds=60.0,
+                                   clock=lambda: t[0])
+        registry = EstimatorRegistry(breakers=breakers)
+        est = _FlakyRows(breakers)
+        registry.register_replica_estimator("flaky", est)
+        bindings = [_dyn_binding(i) for i in range(3)]
+        clusters = ["m1", "m2", "m3"]
+
+        fresh = registry.batch_estimates(bindings, clusters)
+        assert registry.last_sweep_open == []
+        assert (fresh[:, 1] == 101).all()
+
+        # the sweep runs one estimator leg per binding, so the 3 failed
+        # legs of this sweep cross failure_threshold=2 and OPEN the breaker
+        # mid-sweep — the overlay then serves the stale column immediately
+        est.dark = {"m2"}
+        out = registry.batch_estimates(bindings, clusters)
+        assert registry.last_sweep_open == ["m2"]
+        assert registry.last_sweep_stale == ["m2"]
+        # the stale column is the last FRESH answer decayed by age 1
+        assert (out[:, 1] == 101 >> 1).all()
+        # healthy columns unaffected
+        assert (out[:, 0] == 100).all() and (out[:, 2] == 102).all()
+
+        # next degraded sweep decays further (age 2) — no estimator call
+        # reaches the dark member (breaker fast-fails)
+        out = registry.batch_estimates(bindings, clusters)
+        assert (out[:, 1] == 101 >> 2).all()
+
+        # heal: probe window elapses, the probe succeeds, fresh answers
+        # return and the staleness epoch resets
+        est.dark = set()
+        t[0] = 60.0
+        out = registry.batch_estimates(bindings, clusters)
+        assert registry.last_sweep_open == []
+        assert (out[:, 1] == 101).all()
+        assert registry.staleness.age("m2") == 0
+
+    def test_stale_column_min_merges_with_live_estimators(self):
+        """Another registered estimator may still answer live for a
+        breaker-open member (e.g. the model-based one): the stale decayed
+        snapshot may only TIGHTEN or fill its column, never loosen it."""
+        from karmada_tpu.estimator.client import EstimatorRegistry
+
+        t = [0.0]
+        breakers = BreakerRegistry(failure_threshold=1, open_seconds=60.0,
+                                   clock=lambda: t[0])
+        registry = EstimatorRegistry(breakers=breakers)
+        flaky = _FlakyRows(breakers)
+        registry.register_replica_estimator("flaky", flaky)
+
+        class Model:
+            """Live for every cluster regardless of member health."""
+
+            answer = 200
+
+            def max_available_replicas(self, clusters, requirements,
+                                       replicas):
+                return [self.answer] * len(clusters)
+
+        model = Model()
+        registry.register_replica_estimator("model", model)
+        bindings = [_dyn_binding(i) for i in range(2)]
+
+        fresh = registry.batch_estimates(bindings, ["m9"])
+        assert (fresh[:, 0] == 100).all()  # min(member 100, model 200)
+
+        flaky.dark = {"m9"}
+        model.answer = 8  # the live model bound DROPS while m9 is dark
+        out = registry.batch_estimates(bindings, ["m9"])
+        assert registry.last_sweep_open == ["m9"]
+        # stale decayed member answer is 100>>1 = 50, but the live model
+        # says 8 — the merged column must keep the tighter live bound
+        assert (out[:, 0] == 8).all()
+
+    def test_http_only_plan_keeps_fused_fleet_kernel(self):
+        from karmada_tpu.estimator.client import MemberEstimators
+
+        faults.install(FaultPlan(seed=5, rules=[
+            FaultRule(boundary="http", kind="error", rate=0.5),
+        ]))
+        me = MemberEstimators({}, breakers=BreakerRegistry())
+        assert not me._guards_engaged(["m1"]), (
+            "an http-only plan must not reroute the estimator sweep"
+        )
+        faults.install(FaultPlan(seed=5, rules=[
+            FaultRule(boundary="grpc", target="m1", kind="flap"),
+        ]))
+        assert me._guards_engaged(["m1"])
+
+    def test_error_metric_by_code(self):
+        from karmada_tpu.metrics import estimator_rpc_errors
+
+        t = [0.0]
+        breakers = BreakerRegistry(failure_threshold=2, open_seconds=60.0,
+                                   clock=lambda: t[0])
+        registry = EstimatorRegistry = None  # noqa: F841 - clarity below
+        from karmada_tpu.estimator.client import EstimatorRegistry
+
+        registry = EstimatorRegistry(breakers=breakers)
+        est = _FlakyRows(breakers)
+        est.dark = {"m9"}
+        registry.register_replica_estimator("flaky", est)
+        before = estimator_rpc_errors.value(cluster="m9", code="UNAVAILABLE")
+        registry.batch_estimates([_dyn_binding(0)], ["m9"])
+        assert estimator_rpc_errors.value(
+            cluster="m9", code="UNAVAILABLE") == before + 1
+
+
+class TestGrpcClientBreakerOrdering:
+    def test_addressless_leg_does_not_leak_half_open_probe(self):
+        """_fanout resolves the call BEFORE breaker admission: a half-open
+        probe slot consumed by a leg that never issues an RPC would never
+        settle, sticking the breaker in HALF_OPEN and fast-failing the
+        member forever."""
+        from karmada_tpu.estimator.service import GrpcSchedulerEstimator
+
+        t = [0.0]
+        breakers = BreakerRegistry(failure_threshold=1, open_seconds=5.0,
+                                   clock=lambda: t[0])
+        client = GrpcSchedulerEstimator(lambda c: None, breakers=breakers)
+        br = breakers.for_member("m1")
+        br.record_failure()
+        assert br.state == OPEN
+        t[0] = 5.0
+        assert br.state == HALF_OPEN
+        out = client.max_available_replicas(["m1"], None, 1)
+        assert out == [-1]
+        assert br.state == HALF_OPEN
+        assert br.allow(), (
+            "the addressless leg must not have consumed the probe slot"
+        )
+
+    def test_addressless_batch_shard_does_not_leak_probe(self):
+        from karmada_tpu.estimator.service import GrpcSchedulerEstimator
+
+        t = [0.0]
+        breakers = BreakerRegistry(failure_threshold=1, open_seconds=5.0,
+                                   clock=lambda: t[0])
+        client = GrpcSchedulerEstimator(lambda c: None, breakers=breakers)
+        br = breakers.for_member("m1")
+        br.record_failure()
+        t[0] = 5.0
+        out = client.batch_max_available_replicas(["m1"], [None])
+        assert out.tolist() == [[-1]]
+        assert br.allow(), "batch shard leaked the half-open probe slot"
+
+
+class TestMemberEstimatorsGuard:
+    def test_injected_grpc_fault_feeds_breaker_and_sentinel(self):
+        from karmada_tpu.api.meta import CPU, MEMORY
+        from karmada_tpu.estimator.client import (
+            MemberEstimators,
+            UNAUTHENTIC_REPLICA,
+        )
+        from karmada_tpu.members.member import InMemoryMember, MemberConfig
+        from karmada_tpu.models.nodes import NodeSpec
+
+        GiB = 1024.0 ** 3
+        faults.install(FaultPlan(seed=3, rules=[
+            FaultRule(boundary="grpc", target="m1", kind="partition"),
+        ]))
+        breakers = BreakerRegistry(failure_threshold=2, open_seconds=60.0)
+        members = {
+            name: InMemoryMember(MemberConfig(
+                name=name,
+                nodes=[NodeSpec(name="n1",
+                                allocatable={CPU: 10.0, MEMORY: 40 * GiB})],
+            ))
+            for name in ("m1", "m2")
+        }
+        me = MemberEstimators(members, breakers=breakers)
+        from karmada_tpu.api.work import ReplicaRequirements
+
+        req = ReplicaRequirements(resource_request={CPU: 1.0})
+        out = me.max_available_replicas(["m1", "m2"], req, 4)
+        assert out[0] == UNAUTHENTIC_REPLICA  # injected
+        assert out[1] > 0  # healthy member answers
+        me.max_available_replicas(["m1", "m2"], req, 4)
+        assert breakers.for_member("m1").state == OPEN
+        assert breakers.for_member("m2").state == CLOSED

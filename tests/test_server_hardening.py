@@ -326,3 +326,63 @@ class TestScrapeToken:
 
         for mod in (dmain, smain, srvmain):
             assert "--scrape-token-file" in open(mod.__file__).read()
+
+
+class TestSlowLoris:
+    """The server-side socket timeout (httpbase.make_http_server
+    socket_timeout — a constructor arg and the daemon's --socket-timeout
+    flag, no longer a hard-coded 15.0): a peer that connects and trickles
+    bytes is reaped instead of pinning a handler thread forever."""
+
+    def _connect(self, port: int):
+        import socket
+
+        s = socket.create_connection(("127.0.0.1", port), timeout=10.0)
+        return s
+
+    def test_trickling_peer_is_reaped_and_server_keeps_serving(self):
+        import socket
+
+        cp = MiniPlane()
+        srv = ControlPlaneServer(cp, socket_timeout=0.5)
+        srv.start()
+        try:
+            loris = self._connect(srv._port)
+            loris.sendall(b"GET /healthz HT")  # partial request line, stall
+            t0 = time.monotonic()
+            loris.settimeout(10.0)
+            # the server must close the connection once socket_timeout
+            # elapses (recv returns b"" / reset) — not hold it open
+            try:
+                data = loris.recv(1024)
+            except (ConnectionResetError, socket.timeout) as e:
+                assert not isinstance(e, socket.timeout), (
+                    "server never reaped the slow-loris connection"
+                )
+                data = b""
+            assert data == b"", "expected connection close, got a reply"
+            elapsed = time.monotonic() - t0
+            assert elapsed < 8.0, f"reap took {elapsed:.1f}s"
+            loris.close()
+            # and an honest client is still served
+            store = RemoteStore(srv.url)
+            assert store._call("GET", "/healthz").get("ok") is True
+            store.close()
+        finally:
+            srv.stop()
+
+    def test_zero_disables_timeout(self):
+        cp = MiniPlane()
+        srv = ControlPlaneServer(cp, socket_timeout=0)
+        srv.start()
+        try:
+            loris = self._connect(srv._port)
+            loris.sendall(b"GET /healthz HT")
+            loris.settimeout(1.0)
+            import socket
+
+            with pytest.raises(socket.timeout):
+                loris.recv(1024)  # connection stays open: no reap
+            loris.close()
+        finally:
+            srv.stop()
