@@ -129,6 +129,7 @@ class ControlPlane:
         cluster_failure_threshold: float = 30.0,
         cluster_success_threshold: float = 30.0,
         controllers: Optional[list] = None,
+        estimator_workers: Optional[int] = None,
     ):
         """`controllers`: the --controllers enable/disable list with the
         reference's semantics (context.go:116-137): '*' enables everything
@@ -189,8 +190,12 @@ class ControlPlane:
             clock=lambda: self.runtime.clock.now()
         )
         self.estimator_registry = EstimatorRegistry(breakers=self.breakers)
+        # --estimator-workers sizes the per-cluster fan-out pool so the
+        # pipelined round's estimate-prefetch stage can't starve on large
+        # fleets (default scales with member count, see MemberEstimators)
         member_estimators = MemberEstimators(self.members,
-                                             breakers=self.breakers)
+                                             breakers=self.breakers,
+                                             max_workers=estimator_workers)
         self.estimator_registry.register_replica_estimator(
             "scheduler-estimator", member_estimators
         )

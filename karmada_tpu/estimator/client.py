@@ -53,6 +53,33 @@ def _fleet_rows_kernel(alloc, requested, pod_count, allowed_pods, cluster_id,
 _fleet_rows_jit = None
 
 
+class SchedulerEstimatorRegistry(Protocol):
+    """What the scheduler daemon requires of an estimator registry — typed,
+    so degraded-mode detection reads a declared attribute instead of
+    duck-probing with getattr (the probe silently went dark whenever a
+    registry forgot the attribute).
+
+    `last_sweep_open` lists the member clusters whose circuit breaker was
+    OPEN during the most recent `batch_estimates` sweep (empty while the
+    fleet is healthy — the default for a registry that never degrades).
+    Under the pipelined round executor each chunk-shard sweep resets it, so
+    callers snapshot it immediately after the sweep that produced it."""
+
+    last_sweep_open: list[str]
+
+    def batch_estimates(
+        self,
+        bindings: Sequence["ResourceBinding"],
+        clusters: Sequence[str],
+    ) -> Optional[np.ndarray]:
+        ...
+
+    def sweep_round(self):
+        """Context manager scoping N chunk-shard sweeps as ONE round (the
+        pipelined executor's prefetch stage) — see EstimatorRegistry."""
+        ...
+
+
 class ReplicaEstimator(Protocol):
     def max_available_replicas(
         self,
@@ -111,6 +138,27 @@ class EstimatorRegistry:
         # karmada_degraded_rounds_total accounting)
         self.last_sweep_open: list[str] = []
         self.last_sweep_stale: list[str] = []
+
+    def sweep_round(self):
+        """Scope a pipelined round's N chunk-shard sweeps as ONE logical
+        sweep for the staleness cache: fresh snapshots merge across the
+        round's chunks and each open member's staleness epoch advances once
+        per round — a chunked degraded round then serves exactly the
+        penalized columns a whole-round sweep would (docs/ROBUSTNESS.md)."""
+        from contextlib import contextmanager
+
+        @contextmanager
+        def scope():
+            if self.staleness is None:
+                yield
+                return
+            self.staleness.begin_round()
+            try:
+                yield
+            finally:
+                self.staleness.end_round()
+
+        return scope()
 
     def register_replica_estimator(self, name: str, est: ReplicaEstimator) -> None:
         self.replica_estimators[name] = est
@@ -250,15 +298,47 @@ class MemberEstimators:
     whenever no row carries a node claim: 1000 per-cluster Python calls
     became the 8.4 s wall of BASELINE config 3. The snapshot is device-
     resident and version-checked against each member's estimator, so steady
-    rounds ship only the [B,R] request matrix."""
+    rounds ship only the [B,R] request matrix.
 
-    def __init__(self, members: dict, breakers=None):
+    `max_workers` pins the per-cluster fan-out pool size; the default
+    (None) scales with each sweep's actual fan-out width — floor 16, cap
+    64, growing as members join (the members dict is usually EMPTY at
+    construction time, so boot-time sizing would freeze the pool at the
+    floor forever; the old hardcoded 16 starved the pipelined round's
+    estimate-prefetch stage on large fleets, serializing hundreds of
+    per-cluster legs 16 at a time while the device sat idle). Plumbed
+    through the server daemon as `--estimator-workers`."""
+
+    DEFAULT_MIN_WORKERS = 16
+    DEFAULT_MAX_WORKERS = 64
+
+    def __init__(self, members: dict, breakers=None,
+                 max_workers: Optional[int] = None):
         self.members = members
         self.breakers = breakers  # faults.BreakerRegistry, shared
-        self._pool = ThreadPoolExecutor(max_workers=16)
+        if max_workers is not None and max_workers <= 0:
+            raise ValueError(f"max_workers={max_workers}: must be positive")
+        self.max_workers = max_workers
+        self._pool: Optional[ThreadPoolExecutor] = None
+        self._pool_width = 0
         self._fleet_key = None
         self._fleet_dev = None  # (alloc, requested, pod_count, allowed, cid, claimless_ok)
         self._no_node_cols = None  # bool[C] clusters without node state
+
+    def _pool_for(self, width: int) -> ThreadPoolExecutor:
+        """The fan-out pool, (re)sized for a sweep over `width` clusters:
+        explicit max_workers pins it; the default grows with the widest
+        sweep seen (floor/cap above), replacing the executor only when it
+        must widen — only the sweep thread uses it, so the swap is safe."""
+        want = self.max_workers or min(
+            self.DEFAULT_MAX_WORKERS, max(self.DEFAULT_MIN_WORKERS, width)
+        )
+        if self._pool is None or want > self._pool_width:
+            if self._pool is not None:
+                self._pool.shutdown(wait=False)
+            self._pool = ThreadPoolExecutor(max_workers=want)
+            self._pool_width = want
+        return self._pool
 
     def _estimator_for(self, cluster: str):
         member = self.members.get(cluster)
@@ -328,7 +408,7 @@ class MemberEstimators:
                 UNAUTHENTIC_REPLICA,
             )
 
-        return list(self._pool.map(one, clusters))
+        return list(self._pool_for(len(clusters)).map(one, clusters))
 
     def _fleet_snapshot(self, clusters):
         """Concatenated node arrays for the fleet kernel, rebuilt only when
@@ -421,7 +501,7 @@ class MemberEstimators:
                 sentinel,
             )
 
-        columns = np.asarray(list(self._pool.map(one, clusters)))  # [C,B]
+        columns = np.asarray(list(self._pool_for(len(clusters)).map(one, clusters)))  # [C,B]
         return columns.T
 
     def get_unschedulable_replicas(self, clusters, resource, threshold_seconds) -> list[int]:
@@ -437,4 +517,4 @@ class MemberEstimators:
                 UNAUTHENTIC_REPLICA,
             )
 
-        return list(self._pool.map(one, clusters))
+        return list(self._pool_for(len(clusters)).map(one, clusters))

@@ -65,6 +65,26 @@ class StalenessTracker:
         self._rows: dict[str, tuple] = {}
         self._age: dict[str, int] = {}
         self._index_cache: Optional[tuple] = None  # (uids, {uid: i})
+        # chunked-round scope (pipelined scheduler, sched/pipeline.py): a
+        # round of N chunk-shard sweeps must look like ONE sweep here —
+        # fresh snapshots MERGE across the round's chunks (a replace would
+        # keep only the last chunk's bindings) and each cluster's staleness
+        # epoch advances once per ROUND, not once per chunk (else trust
+        # decays chunk-count times faster and later chunks see a different
+        # penalty than earlier ones, breaking serial/pipelined parity)
+        self._round_active = False
+        self._round_fresh: set = set()
+        self._round_aged: set = set()
+
+    def begin_round(self) -> None:
+        self._round_active = True
+        self._round_fresh.clear()
+        self._round_aged.clear()
+
+    def end_round(self) -> None:
+        self._round_active = False
+        self._round_fresh.clear()
+        self._round_aged.clear()
 
     def age(self, cluster: str) -> int:
         return self._age.get(cluster, 0)
@@ -72,10 +92,22 @@ class StalenessTracker:
     def record_fresh(self, cluster: str, uids, column) -> None:
         """A successful sweep for `cluster`: snapshot its column (replacing
         the previous snapshot — deleted bindings fall out with their sweep)
-        and reset the staleness epoch."""
-        self._rows[cluster] = (
-            uids, np.array(column, np.int32, copy=True)
-        )
+        and reset the staleness epoch. Inside a chunked round, later chunks
+        EXTEND the round's snapshot instead of replacing it."""
+        if self._round_active and cluster in self._round_fresh:
+            old_uids, old_col = self._rows[cluster]
+            self._rows[cluster] = (
+                tuple(old_uids) + tuple(uids),
+                np.concatenate(
+                    [old_col, np.array(column, np.int32, copy=True)]
+                ),
+            )
+        else:
+            self._rows[cluster] = (
+                uids, np.array(column, np.int32, copy=True)
+            )
+            if self._round_active:
+                self._round_fresh.add(cluster)
         self._age[cluster] = 0
 
     def _index_of(self, uids) -> dict:
@@ -90,8 +122,13 @@ class StalenessTracker:
         """One degraded sweep for `cluster`: bump its staleness epoch and
         return the penalized column for the CURRENT binding order (i32[B];
         bindings the cache never saw answer the -1 sentinel). Returns None
-        when nothing was ever cached (the column stays all-sentinel)."""
-        self._age[cluster] = self._age.get(cluster, 0) + 1
+        when nothing was ever cached (the column stays all-sentinel).
+        Inside a chunked round the epoch bumps once per ROUND — every chunk
+        of the round sees the same decay."""
+        if not (self._round_active and cluster in self._round_aged):
+            self._age[cluster] = self._age.get(cluster, 0) + 1
+            if self._round_active:
+                self._round_aged.add(cluster)
         cached = self._rows.get(cluster)
         if cached is None:
             return None

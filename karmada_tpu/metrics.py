@@ -23,6 +23,13 @@ def _label_key(labels: dict[str, str]) -> tuple:
     return tuple(sorted(labels.items()))
 
 
+# one lock for every metric mutation: observations are read-modify-write and
+# arrive from many threads (estimator fan-out pools, watch streams, and the
+# pipelined round's writer/prefetch threads hitting the SAME label key as
+# the main thread) — un-locked interleavings silently drop updates
+_mutate_lock = threading.Lock()
+
+
 @dataclass
 class Counter:
     name: str
@@ -31,7 +38,8 @@ class Counter:
 
     def inc(self, amount: float = 1.0, **labels: str) -> None:
         k = _label_key(labels)
-        self._values[k] = self._values.get(k, 0.0) + amount
+        with _mutate_lock:
+            self._values[k] = self._values.get(k, 0.0) + amount
 
     def value(self, **labels: str) -> float:
         return self._values.get(_label_key(labels), 0.0)
@@ -64,12 +72,13 @@ class Histogram:
 
     def observe(self, v: float, **labels: str) -> None:
         k = _label_key(labels)
-        counts = self._counts.setdefault(k, [0] * len(self.buckets))
-        i = bisect.bisect_left(self.buckets, v)
-        if i < len(counts):
-            counts[i] += 1
-        self._sums[k] = self._sums.get(k, 0.0) + v
-        self._totals[k] = self._totals.get(k, 0) + 1
+        with _mutate_lock:
+            counts = self._counts.setdefault(k, [0] * len(self.buckets))
+            i = bisect.bisect_left(self.buckets, v)
+            if i < len(counts):
+                counts[i] += 1
+            self._sums[k] = self._sums.get(k, 0.0) + v
+            self._totals[k] = self._totals.get(k, 0) + 1
 
     def count(self, **labels: str) -> int:
         return self._totals.get(_label_key(labels), 0)
@@ -190,6 +199,14 @@ estimating_request_total = registry.counter(
 estimating_algorithm_duration = registry.histogram(
     "karmada_estimator_estimating_algorithm_duration_seconds",
     "Estimating algorithm latency in seconds",
+)
+# pipelined round executor (sched/pipeline.py): wall seconds per stage —
+# estimate / encode / solve / materialize / patch. Under the pipeline the
+# per-round stage totals exceed the round's wall time (overlap); the
+# per-round overlap ratio rides ArrayScheduler.last_round_stats
+schedule_stage_seconds = registry.histogram(
+    "karmada_schedule_stage_seconds",
+    "Wall seconds per schedule-round pipeline stage",
 )
 descheduler_sweeps = registry.counter(
     "karmada_descheduler_sweeps_total",

@@ -70,6 +70,12 @@ def main() -> None:
                     help="dedicated READ-ONLY token accepted on GET "
                          "/metrics only (the Prometheus credential no "
                          "longer needs to be the full wire token)")
+    ap.add_argument("--no-pipeline", action="store_true",
+                    help="disable the pipelined round executor (serial "
+                         "estimate→encode→solve→materialize→patch chain; "
+                         "see docs/PERF.md — decisions are identical either "
+                         "way). KARMADA_TPU_PIPELINE=0 is the env "
+                         "equivalent; this flag wins")
     ap.add_argument("--breaker-failures", type=int, default=3,
                     help="consecutive estimator failures before a member's "
                          "circuit breaker opens (docs/ROBUSTNESS.md)")
@@ -128,6 +134,7 @@ def main() -> None:
     daemon = SchedulerDaemon(
         store, runtime, scheduler_name=args.scheduler_name,
         estimator_registry=registry, plugins=plugins,
+        pipeline=False if args.no_pipeline else None,
     )
     metrics_srv = start_metrics_server(
         args.metrics_port, token=token,

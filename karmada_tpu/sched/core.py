@@ -13,6 +13,7 @@ with empty constraints).
 """
 from __future__ import annotations
 
+from contextlib import contextmanager
 from dataclasses import dataclass, field
 from functools import partial
 from typing import Optional, Sequence
@@ -36,6 +37,13 @@ from ..models.fleet import FleetArrays, FleetEncoder
 from ..ops import assign as assign_ops
 from ..ops import filters as filter_ops
 from . import plugins as plugin_mod
+from .pipeline import (
+    ChunkPipeline,
+    StageTimer,
+    chunk_spans,
+    resolve_pipeline,
+    stage_span,
+)
 
 # below this [tail rows x C] volume the numpy host tail loses to the jit
 # kernel (per-row Python overhead); tests pin it to 0 to force the host path
@@ -45,6 +53,13 @@ HOST_TAIL_MIN_ELEMS = 2_000_000
 # (divided rows are bounded by spec.replicas; wider duplicated rows fetch
 # their dense result row as a fallback)
 TOPK_TARGETS = 128
+
+# pipelined-round chunking policy (sched/pipeline.py): a daemon round is cut
+# into ~PIPELINE_CHUNKS chunks so the estimate/encode/solve/materialize/patch
+# stages overlap across them, but never below PIPELINE_MIN_ROWS rows per
+# chunk — tiny launches pay more in dispatch than overlap buys back
+PIPELINE_MIN_ROWS = 256
+PIPELINE_CHUNKS = 8
 
 
 class ScheduleDecision:
@@ -706,6 +721,7 @@ class ArrayScheduler:
         plugins: Optional[Sequence[str]] = None,
         plugin_registry=None,
         autoshard: Optional[bool] = None,
+        pipeline: Optional[bool] = None,
     ):
         """`mesh`: optional jax.sharding.Mesh — the solve runs column/row-
         sharded over it (parallel/mesh.py) with identical outputs.
@@ -714,7 +730,11 @@ class ArrayScheduler:
         `autoshard`: when no mesh was given and a round's [B,C] footprint
         exceeds the single-chip HBM budget, transparently re-place the fleet
         over a device mesh and run sharded (decision-identical); default on,
-        KARMADA_TPU_AUTOSHARD=0 disables."""
+        KARMADA_TPU_AUTOSHARD=0 disables.
+        `pipeline`: chunked rounds run as the software pipeline
+        (sched/pipeline.py — encode/solve/materialize overlapped across
+        chunks, bit-identical decisions); default on,
+        KARMADA_TPU_PIPELINE=0 disables (the serial row-chunk executor)."""
         self.encoder = encoder or FleetEncoder()
         self.mesh = mesh
         self._mesh_kernel = None
@@ -752,6 +772,27 @@ class ArrayScheduler:
         # the per-device footprint, so the cap scales with mesh size.
         self.max_bc_elems = resolve_max_bc_elems()
         self.autoshard = resolve_autoshard(autoshard)
+        # pipelined round executor (sched/pipeline.py): chunked rounds
+        # overlap encode/solve/materialize across chunks; the stage timer is
+        # installed by the driving pipeline for the duration of a round and
+        # last_pipeline_stats carries the stage/overlap numbers of the last
+        # chunked round (None when the round ran un-chunked)
+        self.pipeline_enabled = resolve_pipeline(pipeline)
+        self.stage_timer: Optional[StageTimer] = None
+        self.last_pipeline_stats: Optional[dict] = None
+        # True while a pipelined (overlapping) round drives launch/
+        # materialize on separate threads — the cpu-backend tail routing
+        # reads it (host twins run on the writer thread, overlapped, so
+        # they win at ANY volume there; XLA:CPU division sorts would
+        # serialize the whole pipe)
+        self._overlap_active = False
+        # the batch encoder interns tables and keeps row caches — under the
+        # pipeline the writer thread's affinity-retry sub-rounds encode
+        # concurrently with the main thread's next-chunk encode, so every
+        # encode takes this lock (retries are rare; contention is noise)
+        import threading
+
+        self._encode_lock = threading.Lock()
         # cross-round incremental state: any fleet change bumps the epoch
         # (cached decisions are only replayed at the epoch they were solved
         # in); the cache maps binding uid → DecisionEntry
@@ -759,6 +800,20 @@ class ArrayScheduler:
         self._decision_cache: dict[str, object] = {}
         self.last_round_stats = {"replayed": 0, "solved": 0}
         self.set_clusters(clusters)
+
+    @contextmanager
+    def pipeline_context(self, timer: StageTimer, overlap: bool):
+        """Install the driving pipeline's stage timer (and the overlap flag
+        the tail routing reads) for the duration of one round; restores the
+        previous state on exit. The daemon and `_schedule_chunked` both run
+        their ChunkPipeline inside this."""
+        prev_t, prev_o = self.stage_timer, self._overlap_active
+        self.stage_timer = timer
+        self._overlap_active = overlap
+        try:
+            yield
+        finally:
+            self.stage_timer, self._overlap_active = prev_t, prev_o
 
     def set_clusters(self, clusters: Sequence,
                      dirty_names: Optional[set] = None) -> None:
@@ -942,13 +997,47 @@ class ArrayScheduler:
         else:
             scale = 1
         budget = self.max_bc_elems * scale
-        cap = max(8, budget // max(n_cols, 1))
+        return self._floor_rows(max(8, budget // max(n_cols, 1)))
+
+    @staticmethod
+    def _floor_rows(cap: int) -> int:
+        """Floor a row cap to a _bucket boundary so every full chunk hits
+        one compiled shape."""
         if cap >= 2048:
             return (cap // 2048) * 2048
         b = 8
         while b * 2 <= cap:
             b *= 2
         return b
+
+    def pipeline_chunk_rows(self, n_cols: int) -> int:
+        """Per-chunk row cap when the pipeline drives a chunked round: HALF
+        the serial per-launch cap, so depth-2 double buffering (one chunk
+        solving while the next uploads) keeps the device working set inside
+        the serial executor's HBM envelope."""
+        return self._floor_rows(max(8, self._max_rows_per_round(n_cols) // 2))
+
+    def round_chunk_rows(self, n_rows: int) -> int:
+        """Chunking policy for a daemon-driven pipelined round (the whole
+        dirty set, replay included): aim for ~PIPELINE_CHUNKS chunks so the
+        estimate/encode/solve/materialize/patch stages have work to overlap,
+        floor at PIPELINE_MIN_ROWS, and never exceed the double-buffered HBM
+        chunk cap. Returns one chunk (⇒ the pipeline runs serial) for
+        rounds too small to fill the pipe — and for out-of-tree-plugin
+        rounds (stateful host hooks must not run on two threads). ALWAYS
+        bounded by the serial per-launch HBM row cap: a daemon round must
+        never dispatch a launch the chunked schedule() path would have
+        split."""
+        max_rows = self._max_rows_per_round(len(self.fleet.names))
+        if not self.pipeline_enabled or self._oot_plugins:
+            return min(max(1, n_rows), max_rows)
+        if n_rows <= 2 * PIPELINE_MIN_ROWS and n_rows <= max_rows:
+            return max(1, n_rows)
+        cap = self.pipeline_chunk_rows(len(self.fleet.names))
+        target = self._floor_rows(
+            max(PIPELINE_MIN_ROWS, n_rows // PIPELINE_CHUNKS)
+        )
+        return max(8, min(cap, target))
 
     @staticmethod
     def _bucket(n: int) -> int:
@@ -1123,41 +1212,27 @@ class ArrayScheduler:
 
     # -- incremental rounds -----------------------------------------------
 
-    def schedule_incremental(
-        self, bindings: Sequence, extra_avail=None
-    ) -> list[ScheduleDecision]:
-        """Incremental schedule round: bindings whose solve inputs are
-        unchanged since the round that last solved them — same fleet epoch,
-        same spec/status inputs, same estimator answers (sched/incremental.py
-        DecisionEntry) — replay their cached decision without touching the
-        device; only genuinely dirty rows enter `schedule()`. Decisions are
-        bit-identical to a cold full solve (the tie-break is UID-seeded),
-        which the incremental-vs-cold parity suite pins.
+    def _split_replay(self, bindings: Sequence, extra_avail):
+        """Replay-cache consult for one binding list: returns
+        (out, dirty_pos, digest_of) where out[i] is the replayed decision or
+        None, dirty_pos lists the rows that must solve, and digest_of
+        memoizes the per-row estimator-answer digests for the cache writes.
+
+        Estimator-row digests are computed LAZILY — only after the cheap
+        epoch check says a cached entry could match, and once more at cache
+        write time for dirty rows. An epoch-invalidated round (any cluster
+        change) therefore never pays B blake2b passes over [C] rows just to
+        discover every entry is stale.
 
         Out-of-tree plugins compute opaque per-round [B,C] terms on host, so
         their presence disables replay entirely (a plugin's changed answer
         must never be masked by a stale cache)."""
-        if not bindings:
-            self.last_round_stats = {"replayed": 0, "solved": 0}
-            return []
-        if self._oot_plugins:
-            decisions = self.schedule(bindings, extra_avail=extra_avail)
-            self.last_round_stats = {"replayed": 0, "solved": len(bindings)}
-            return decisions
-        from .incremental import DecisionEntry, extra_digest
+        from .incremental import extra_digest
 
-        cache = self._decision_cache
-        epoch = self.fleet_epoch
-        out: list[Optional[ScheduleDecision]] = [None] * len(bindings)
-        dirty_pos: list[int] = []
-        # estimator-row digests are computed LAZILY — only after the cheap
-        # epoch check says a cached entry could match, and once more at cache
-        # write time for dirty rows. An epoch-invalidated round (any cluster
-        # change) therefore never pays B blake2b passes over [C] rows just to
-        # discover every entry is stale. Each digest is memoized so the cache
-        # writes below reuse it.
-        digests: list[Optional[bytes]] = [None] * len(bindings)
-        digest_done = [extra_avail is None] * len(bindings)
+        n = len(bindings)
+        out: list[Optional[ScheduleDecision]] = [None] * n
+        digests: list[Optional[bytes]] = [None] * n
+        digest_done = [extra_avail is None] * n
 
         def digest_of(i: int) -> Optional[bytes]:
             if not digest_done[i]:
@@ -1165,6 +1240,11 @@ class ArrayScheduler:
                 digest_done[i] = True
             return digests[i]
 
+        if self._oot_plugins:
+            return out, list(range(n)), digest_of
+        cache = self._decision_cache
+        epoch = self.fleet_epoch
+        dirty_pos: list[int] = []
         for i, rb in enumerate(bindings):
             uid = rb.metadata.uid
             ent = cache.get(uid) if uid else None
@@ -1176,30 +1256,119 @@ class ArrayScheduler:
                 out[i] = ent.decision
             else:
                 dirty_pos.append(i)
+        return out, dirty_pos, digest_of
+
+    def _cache_decisions(
+        self, bindings: Sequence, out, dirty_pos, digest_of, solve_epoch: int,
+        round_rows: Optional[int] = None,
+    ) -> None:
+        """Write the round's dirty decisions back to the replay cache and
+        enforce the size bound (entries for deleted bindings must not
+        accumulate forever — same policy as the encoder's row cache).
+        `round_rows`: the WHOLE round's binding count when the caller is one
+        chunk of a larger round — the bound must scale with the round, or a
+        >16384-binding round would wipe the live working set on every chunk
+        write and defeat replay at exactly the fleet scale it exists for."""
+        if self._oot_plugins:
+            return  # replay disabled: never cache under opaque plugin terms
+        from .incremental import DecisionEntry
+
+        cache = self._decision_cache
+        for i in dirty_pos:
+            rb = bindings[i]
+            if rb.metadata.uid:
+                cache[rb.metadata.uid] = DecisionEntry(
+                    rb, solve_epoch, digest_of(i), out[i]
+                )
+        if len(cache) > max(4 * (round_rows or len(bindings)), 16384):
+            cache.clear()
+            for i, rb in enumerate(bindings):
+                if rb.metadata.uid and out[i] is not None:
+                    cache[rb.metadata.uid] = DecisionEntry(
+                        rb, solve_epoch, digest_of(i), out[i]
+                    )
+
+    def schedule_incremental(
+        self, bindings: Sequence, extra_avail=None
+    ) -> list[ScheduleDecision]:
+        """Incremental schedule round: bindings whose solve inputs are
+        unchanged since the round that last solved them — same fleet epoch,
+        same spec/status inputs, same estimator answers (sched/incremental.py
+        DecisionEntry) — replay their cached decision without touching the
+        device; only genuinely dirty rows enter `schedule()`. Decisions are
+        bit-identical to a cold full solve (the tie-break is UID-seeded),
+        which the incremental-vs-cold parity suite pins."""
+        if not bindings:
+            self.last_round_stats = {"replayed": 0, "solved": 0}
+            return []
+        bindings = list(bindings)
+        out, dirty_pos, digest_of = self._split_replay(bindings, extra_avail)
         if dirty_pos:
             dirty = [bindings[i] for i in dirty_pos]
             sub_extra = None if extra_avail is None else extra_avail[dirty_pos]
             decisions = self.schedule(dirty, extra_avail=sub_extra)
             solve_epoch = self.fleet_epoch  # autoshard may have bumped it
-            for i, rb, dec in zip(dirty_pos, dirty, decisions):
+            for i, dec in zip(dirty_pos, decisions):
                 out[i] = dec
-                if rb.metadata.uid:
-                    cache[rb.metadata.uid] = DecisionEntry(
-                        rb, solve_epoch, digest_of(i), dec
-                    )
-            # bound the cache: entries for deleted bindings must not
-            # accumulate forever (same policy as the encoder's row cache)
-            if len(cache) > max(4 * len(bindings), 16384):
-                cache.clear()
-                for i, rb in enumerate(bindings):
-                    if rb.metadata.uid and out[i] is not None:
-                        cache[rb.metadata.uid] = DecisionEntry(
-                            rb, solve_epoch, digest_of(i), out[i]
-                        )
+            self._cache_decisions(bindings, out, dirty_pos, digest_of,
+                                  solve_epoch)
         self.last_round_stats = {
             "replayed": len(bindings) - len(dirty_pos),
             "solved": len(dirty_pos),
         }
+        if self.last_pipeline_stats:
+            # the dirty-row solve ran chunked: surface its stage/overlap
+            # numbers next to the replay split
+            self.last_round_stats.update(self.last_pipeline_stats)
+        return out
+
+    # -- pipelined chunk API (sched/pipeline.py drives these) --------------
+
+    def launch_chunk(
+        self, bindings: Sequence, extra_avail=None,
+        round_rows: Optional[int] = None,
+    ) -> dict:
+        """Launch one pipeline chunk, replay-aware: cached decisions resolve
+        immediately (they skip straight to the patch stage); dirty rows
+        encode on host and dispatch to the device asynchronously — NO device
+        sync happens here. The caller must have routed autoshard for the
+        whole round already (`_maybe_autoshard(total_rows)`) and must keep
+        chunks within `round_chunk_rows`. `round_rows`: the whole round's
+        binding count (scales the replay-cache bound)."""
+        bindings = list(bindings)
+        out, dirty_pos, digest_of = self._split_replay(bindings, extra_avail)
+        state = None
+        if dirty_pos:
+            dirty = [bindings[i] for i in dirty_pos]
+            sub_extra = None if extra_avail is None else extra_avail[dirty_pos]
+            state = self._launch_solve(dirty, sub_extra)
+        return {
+            "bindings": bindings,
+            "out": out,
+            "dirty_pos": dirty_pos,
+            "digest_of": digest_of,
+            "state": state,
+            "epoch": self.fleet_epoch,
+            "round_rows": round_rows,
+            "replayed": len(bindings) - len(dirty_pos),
+            "solved": len(dirty_pos),
+        }
+
+    def materialize_chunk(self, pending: dict) -> list[ScheduleDecision]:
+        """Second half of `launch_chunk`: sync + decode the chunk's dirty
+        rows, run the ordered-affinity retry loop, write the replay cache,
+        and merge with the replayed decisions — decisions return in the
+        chunk's binding order."""
+        out = pending["out"]
+        if pending["state"] is not None:
+            decisions = self._materialize_solve(pending["state"])
+            for i, dec in zip(pending["dirty_pos"], decisions):
+                out[i] = dec
+            self._cache_decisions(
+                pending["bindings"], out, pending["dirty_pos"],
+                pending["digest_of"], pending["epoch"],
+                round_rows=pending["round_rows"],
+            )
         return out
 
     def schedule(self, bindings: Sequence, extra_avail=None) -> list[ScheduleDecision]:
@@ -1207,39 +1376,57 @@ class ArrayScheduler:
         (scheduleResourceBindingWithClusterAffinities, scheduler.go:562-625):
         bindings whose placement lists `cluster_affinities` start from the
         last observed term and fall through to later terms on failure; the
-        applied term's name is recorded on the decision."""
+        applied term's name is recorded on the decision.
+
+        Oversized rounds (B over the per-launch HBM row cap) run as the
+        chunked software pipeline (sched/pipeline.py): encode/solve/
+        materialize overlap across chunks with double-buffered uploads,
+        decisions bit-identical to the serial row-chunk executor."""
         if not bindings:
             return []
+        bindings = list(bindings)
+        self.last_pipeline_stats = None
         self._maybe_autoshard(len(bindings))
         max_rows = self._max_rows_per_round(len(self.fleet.names))
         if len(bindings) > max_rows:
-            out = []
-            for s in range(0, len(bindings), max_rows):
-                sub = None if extra_avail is None else extra_avail[s:s + max_rows]
-                out.extend(self.schedule(list(bindings[s:s + max_rows]), sub))
-            return out
+            return self._schedule_chunked(bindings, extra_avail, max_rows)
+        return self._materialize_solve(self._launch_solve(bindings, extra_avail))
 
-        def terms_of(rb):
-            p = rb.spec.placement
-            return p.cluster_affinities if p is not None else []
+    @staticmethod
+    def _affinity_terms_of(rb):
+        p = rb.spec.placement
+        return p.cluster_affinities if p is not None else []
 
-        def initial_term(rb) -> int:
-            terms = terms_of(rb)
-            if not terms:
-                return 0
-            observed = rb.status.scheduler_observed_affinity_name
-            for i, t in enumerate(terms):
-                if t.affinity_name == observed:
-                    return i
+    def _initial_term(self, rb) -> int:
+        terms = self._affinity_terms_of(rb)
+        if not terms:
             return 0
+        observed = rb.status.scheduler_observed_affinity_name
+        for i, t in enumerate(terms):
+            if t.affinity_name == observed:
+                return i
+        return 0
 
-        term_idx = [initial_term(rb) for rb in bindings]
-        decisions = self._schedule_once(bindings, extra_avail, term_idx)
+    def _launch_solve(self, bindings: list, extra_avail=None):
+        """First half of one (≤ max_rows) solve round: resolve the starting
+        ordered-affinity terms, encode, and dispatch the device kernels —
+        asynchronously, no device sync."""
+        term_idx = [self._initial_term(rb) for rb in bindings]
+        pending = self._launch_once(bindings, extra_avail, term_idx)
+        return (bindings, extra_avail, term_idx, pending)
+
+    def _materialize_solve(self, state) -> list[ScheduleDecision]:
+        """Second half: sync + decode, then the ordered-affinity retry loop
+        (retried sub-batches solve serially — failures past the first term
+        are rare) and the applied term names."""
+        bindings, extra_avail, term_idx, pending = state
+        decisions = self._materialize_once(pending)
         while True:
             retry = [
                 b
                 for b, d in enumerate(decisions)
-                if not d.ok and term_idx[b] + 1 < len(terms_of(bindings[b]))
+                if not d.ok
+                and term_idx[b] + 1 < len(self._affinity_terms_of(bindings[b]))
             ]
             if not retry:
                 break
@@ -1252,10 +1439,56 @@ class ArrayScheduler:
             for j, b in enumerate(retry):
                 decisions[b] = sub_dec[j]
         for b, d in enumerate(decisions):
-            terms = terms_of(bindings[b])
+            terms = self._affinity_terms_of(bindings[b])
             if terms and d.ok:
                 d.affinity_name = terms[term_idx[b]].affinity_name
         return decisions
+
+    def _schedule_chunked(
+        self, bindings: list, extra_avail, max_rows: int
+    ) -> list[ScheduleDecision]:
+        """The oversized-round executor: row chunks under the HBM budget,
+        run as the software pipeline when enabled (chunk k+1 encodes and
+        dispatches while chunk k's kernels run and chunk k−1 materializes on
+        the writer; double-buffered, so chunks are HALF the serial row cap),
+        or strictly serially when not. Decisions are bit-identical either
+        way — rows are independent and the tie-break is UID-seeded.
+
+        Out-of-tree plugins compute opaque host-side terms whose hooks may
+        be stateful — their rounds run the chunks serially (same chunking,
+        no thread overlap), exactly as they disable decision replay."""
+        pipelined = self.pipeline_enabled and not self._oot_plugins
+        rows = (
+            min(max_rows, self.pipeline_chunk_rows(len(self.fleet.names)))
+            if pipelined
+            else max_rows
+        )
+        spans = chunk_spans(len(bindings), rows)
+        chunks = [
+            (
+                bindings[s:e],
+                None if extra_avail is None else extra_avail[s:e],
+            )
+            for s, e in spans
+        ]
+        timer = StageTimer()
+        with self.pipeline_context(timer, overlap=pipelined):
+            pipe = ChunkPipeline(
+                launch=lambda i, c, est: self._launch_solve(c[0], c[1]),
+                materialize=self._materialize_solve,
+                pipelined=pipelined,
+                timer=timer,
+                # _materialize_once times its own span (the retry loop's
+                # nested sub-rounds then record their stages, not a second
+                # blanket materialize span)
+                time_materialize=False,
+            )
+            results = pipe.run(chunks)
+        stats = pipe.stats()
+        stats["chunks"] = len(spans)
+        stats["chunk_rows"] = rows
+        self.last_pipeline_stats = stats
+        return [d for chunk_dec in results for d in chunk_dec]
 
     def _classify_spread(self, bindings) -> tuple[list[int], dict, list[int]]:
         """Split spread-constrained rows into the batched device path and the
@@ -1299,11 +1532,32 @@ class ArrayScheduler:
     def _schedule_once(
         self, bindings: Sequence, extra_avail=None, term_indices=None
     ) -> list[ScheduleDecision]:
+        return self._materialize_once(
+            self._launch_once(bindings, extra_avail, term_indices)
+        )
+
+    def _launch_once(
+        self, bindings: Sequence, extra_avail=None, term_indices=None
+    ) -> dict:
+        """Encode + async kernel dispatch for one round; the returned
+        pending dict feeds `_materialize_once`. The monolithic (explicit
+        shard_map) mesh mode computes its round eagerly — its pending just
+        carries the finished decisions, so pipelined callers degrade to
+        serial there without a special case."""
         if self.mesh is None or self.mesh_partitioned:
-            return self._schedule_once_partitioned(
+            return self._launch_once_partitioned(
                 bindings, extra_avail, term_indices
             )
-        return self._schedule_once_monolithic(bindings, extra_avail, term_indices)
+        return {
+            "decisions": self._schedule_once_monolithic(
+                bindings, extra_avail, term_indices
+            )
+        }
+
+    def _materialize_once(self, pending: dict) -> list[ScheduleDecision]:
+        if "decisions" in pending:
+            return pending["decisions"]
+        return self._materialize_once_partitioned(pending)
 
     def _row_class(self, rb, spread_row: bool) -> int:
         """0 = no division tail (dup / non-workload / spread rows),
@@ -1322,7 +1576,15 @@ class ArrayScheduler:
     def _schedule_once_partitioned(
         self, bindings: Sequence, extra_avail=None, term_indices=None
     ) -> list[ScheduleDecision]:
-        """The single-chip schedule round, partitioned by row class:
+        return self._materialize_once_partitioned(
+            self._launch_once_partitioned(bindings, extra_avail, term_indices)
+        )
+
+    def _launch_once_partitioned(
+        self, bindings: Sequence, extra_avail=None, term_indices=None
+    ) -> dict:
+        """LAUNCH half of the single-chip schedule round, partitioned by
+        row class:
 
           phase 1  filter+estimate over ALL rows (one kernel, no sorts)
           phase 2  division tail over ONLY the divided rows — static/dynW
@@ -1333,167 +1595,274 @@ class ArrayScheduler:
           packed   duplicated / non-workload targets are bit-packed
                    feasible masks (complete, no top-K overflow)
 
-        Rows are permuted class-contiguous before encoding and decisions are
-        unpermuted at the end."""
+        Rows are permuted class-contiguous before encoding; decisions are
+        unpermuted by the materialize half. Everything here is host encode
+        (stage `encode`) plus ASYNC kernel dispatch (stage `solve`) — the
+        device sync, host-sort tails, and all decode live in
+        `_materialize_once_partitioned`, so a pipelined caller can encode
+        and dispatch chunk k+1 while chunk k still computes."""
         n_real = len(bindings)
         if n_real == 0:
+            return {"n_real": 0}
+        names = self.fleet.names
+        C = len(names)
+        timer = self.stage_timer
+
+        with stage_span("encode", timer):
+            pre_batched, pre_cfg, pre_fallback = self._classify_spread(bindings)
+            spread_set = set(pre_batched) | set(pre_fallback)
+            cls = np.asarray(
+                [
+                    self._row_class(rb, b in spread_set)
+                    for b, rb in enumerate(bindings)
+                ],
+                np.int8,
+            )
+            order = np.argsort(cls, kind="stable")
+            bindings = [bindings[i] for i in order]
+            cls = cls[order]
+            if term_indices is not None:
+                term_indices = [term_indices[i] for i in order]
+            if extra_avail is not None:
+                extra_avail = extra_avail[order]
+
+            # re-derive spread classification in permuted space
+            # (placement-only, cheap — avoids index-translation bugs)
+            batched_rows, batched_cfg, fallback_rows = self._classify_spread(
+                bindings
+            )
+
+            with self._encode_lock:
+                raw = self.batch_encoder.encode(
+                    bindings, term_indices=term_indices
+                )
+            batch = self._pad(raw)
+            if extra_avail is not None:
+                extra_avail = _pad_extra_avail(extra_avail, C, len(batch.replicas))
+
+            extra_mask, extra_score = self._plugin_terms(
+                bindings, len(batch.replicas)
+            )
+            _, narrow, _ = self._batch_flags(batch)  # once per round
+            narrow16 = C < 2**15 and int(raw.replicas.max(initial=0)) < 2**15
+
+        with stage_span("solve", timer):
+            dev_feasible, dev_score, dev_avail, dev_prev, dev_tie, dev_fc = (
+                _filter_kernel_compact(
+                    *self._fleet_dev,
+                    batch.replicas, batch.unknown_request,
+                    batch.gvk, batch.tol_tables, batch.tol_idx,
+                    batch.aff_masks, batch.aff_idx,
+                    batch.prev_idx, batch.prev_rep, batch.evict_idx, batch.seeds,
+                    batch.req_unique, batch.req_idx,
+                    self._NO_EXTRA if extra_avail is None else extra_avail,
+                    extra_mask, extra_score,
+                    plugin_bits=self._plugin_bits,
+                )
+            )
+
+            # Every phase-2 kernel below depends only on phase-1 DEVICE
+            # outputs, never on host values — so all of them are LAUNCHED
+            # back to back and the round pays ONE device→host sync (the
+            # tunnel adds ~70 ms RTT per sync; the round-2 shape of this
+            # loop synced after every sub-phase and serialized RTT + exec
+            # four times over). Host-sort tails (cpu backend) defer to the
+            # materialize half: their inputs ride THE sync and the numpy
+            # twin runs on the writer thread, overlapped with the next
+            # chunk's encode + filter kernel.
+
+            # ---- phase 2 launch: division tails per sub-class ----
+            tails = []
+            for want_cls, has_agg in ((1, False), (2, True)):
+                rows = [b for b in range(n_real) if cls[b] == want_cls]
+                if not rows:
+                    continue
+                idx_pad, nr = _pad_rows_idx(rows, self._bucket)
+                rsel = idx_pad.astype(np.int64)
+                t_feas = _gather_rows_kernel(dev_feasible, idx_pad)
+                t_avail = _gather_rows_kernel(dev_avail, idx_pad)
+                max_repl = int(raw.replicas[rows].max(initial=0))
+                topk = min(
+                    pow2_bucket(min(max_repl, TOPK_TARGETS), lo=8), TOPK_TARGETS
+                )
+                if self._host_sorts and (
+                    len(rows) * C >= HOST_TAIL_MIN_ELEMS
+                    or self._overlap_active
+                ):
+                    # the numpy tail wins only once the [rows, C] sort volume
+                    # dwarfs its per-row Python overhead; small tails stay on
+                    # the (already fast) jit kernel. Deferred: only the
+                    # gathered filter outputs cross the device boundary (in
+                    # THE sync), the twin itself runs at materialize time.
+                    # Under an OVERLAPPING pipeline the twin wins at any
+                    # volume: it runs on the writer thread behind the next
+                    # chunk's filter kernel, while an XLA:CPU division sort
+                    # would serialize the whole pipe (measured 2x per-row
+                    # regression when the halved chunks fell under the
+                    # threshold).
+                    tails.append({
+                        "kind": "host", "rows": rows, "nr": nr,
+                        "t_feas": t_feas, "t_avail": t_avail, "topk": topk,
+                    })
+                else:
+                    t_prev = _gather_rows_kernel(dev_prev, idx_pad)
+                    t_tie = _gather_rows_kernel(dev_tie, idx_pad)
+                    t_out = _tail_kernel(
+                        t_feas, t_avail, t_prev, t_tie,
+                        batch.weight_tables, batch.weight_idx[rsel],
+                        batch.strategy[rsel], batch.replicas[rsel],
+                        batch.fresh[rsel],
+                        topk=topk, narrow=narrow, has_agg=has_agg,
+                        narrow16=narrow16,
+                    )
+                    tails.append({"kind": "dev", "rows": rows, "t_out": t_out})
+
+            # ---- phase 2 launch: duplicated / non-workload target sets ----
+            fallback_set = set(fallback_rows)
+            mask_rows = [
+                b for b in range(n_real)
+                if cls[b] == 0 and b not in batched_cfg and b not in fallback_set
+            ]
+            packed_dev = midx_dev = None
+            if mask_rows:
+                mask_idx, nm = _pad_rows_idx(mask_rows, self._bucket)
+                m_feas = _gather_rows_kernel(dev_feasible, mask_idx)
+                pc = raw.aff_masks.sum(axis=1)
+                mk = int(pc[raw.aff_idx[np.asarray(mask_rows)]].max(initial=0))
+                # the popcount bound is only a bound while feasible ⊆ affinity
+                # mask; with ClusterAffinity disabled the kernel substitutes
+                # all-ones for affinity, so the index window could truncate —
+                # those batches ship complete packed masks instead
+                if (
+                    self._plugin_bits & plugin_mod.BIT_AFFINITY
+                    and 0 < mk <= TOPK_TARGETS
+                ):
+                    mkb = pow2_bucket(mk, lo=8)
+                    midx_dev = _feas_idx_kernel(
+                        m_feas, min(mkb, C), narrow16=narrow16
+                    )
+                else:  # wide rows (full-fleet affinities): complete packed mask
+                    packed_dev = _pack_rows_kernel(m_feas)
+
+            # ---- phase 2 launch: spread group scoring ----
+            spread_pre = self._spread_prelaunch(
+                bindings, batch, batched_rows, batched_cfg,
+                dev_feasible, dev_score, dev_avail, dev_prev, dev_tie,
+                extra_avail=extra_avail, extra_mask=extra_mask,
+                extra_score=extra_score, defer_host=True,
+            )
+
+        return {
+            "bindings": bindings, "raw": raw, "batch": batch, "cls": cls,
+            "order": order, "n_real": n_real,
+            "extra_avail": extra_avail, "extra_mask": extra_mask,
+            "narrow": narrow,
+            "dev": (dev_feasible, dev_score, dev_avail, dev_prev, dev_tie,
+                    dev_fc),
+            "tails": tails, "packed_dev": packed_dev, "midx_dev": midx_dev,
+            "mask_rows": mask_rows,
+            "batched_rows": batched_rows, "batched_cfg": batched_cfg,
+            "fallback_rows": fallback_rows, "spread_pre": spread_pre,
+        }
+
+    def _materialize_once_partitioned(self, p: dict) -> list[ScheduleDecision]:
+        """MATERIALIZE half: ONE device→host sync for everything the launch
+        half dispatched, then the deferred host-sort twins, decode overlays,
+        spread selection, and decision construction (stage `materialize`)."""
+        if p["n_real"] == 0:
             return []
+        with stage_span("materialize", self.stage_timer):
+            return self._materialize_partitioned_inner(p)
+
+    def _materialize_partitioned_inner(self, p: dict) -> list[ScheduleDecision]:
+        bindings = p["bindings"]
+        raw, batch, cls, order = p["raw"], p["batch"], p["cls"], p["order"]
+        n_real = p["n_real"]
+        extra_avail, extra_mask = p["extra_avail"], p["extra_mask"]
+        narrow = p["narrow"]
+        dev_feasible, dev_score, dev_avail, dev_prev, dev_tie, dev_fc = p["dev"]
+        tails = p["tails"]
+        packed_dev, midx_dev = p["packed_dev"], p["midx_dev"]
+        mask_rows = p["mask_rows"]
+        batched_rows, batched_cfg = p["batched_rows"], p["batched_cfg"]
+        fallback_rows, spread_pre = p["fallback_rows"], p["spread_pre"]
         names = self.fleet.names
         C = len(names)
 
-        pre_batched, pre_cfg, pre_fallback = self._classify_spread(bindings)
-        spread_set = set(pre_batched) | set(pre_fallback)
-        cls = np.asarray(
-            [self._row_class(rb, b in spread_set) for b, rb in enumerate(bindings)],
-            np.int8,
-        )
-        order = np.argsort(cls, kind="stable")
-        bindings = [bindings[i] for i in order]
-        cls = cls[order]
-        if term_indices is not None:
-            term_indices = [term_indices[i] for i in order]
-        if extra_avail is not None:
-            extra_avail = extra_avail[order]
-
-        # re-derive spread classification in permuted space (placement-only,
-        # cheap — avoids index-translation bugs)
-        batched_rows, batched_cfg, fallback_rows = self._classify_spread(bindings)
-
-        raw = self.batch_encoder.encode(bindings, term_indices=term_indices)
-        batch = self._pad(raw)
-        if extra_avail is not None:
-            extra_avail = _pad_extra_avail(extra_avail, C, len(batch.replicas))
-
-        extra_mask, extra_score = self._plugin_terms(
-            bindings, len(batch.replicas)
-        )
-        dev_feasible, dev_score, dev_avail, dev_prev, dev_tie, dev_fc = (
-            _filter_kernel_compact(
-                *self._fleet_dev,
-                batch.replicas, batch.unknown_request,
-                batch.gvk, batch.tol_tables, batch.tol_idx,
-                batch.aff_masks, batch.aff_idx,
-                batch.prev_idx, batch.prev_rep, batch.evict_idx, batch.seeds,
-                batch.req_unique, batch.req_idx,
-                self._NO_EXTRA if extra_avail is None else extra_avail,
-                extra_mask, extra_score,
-                plugin_bits=self._plugin_bits,
-            )
-        )
         unsched = np.zeros(n_real, bool)
         avail_sum = np.zeros(n_real, np.int64)
-        _, narrow, _ = self._batch_flags(batch)  # once per round
-        narrow16 = C < 2**15 and int(raw.replicas.max(initial=0)) < 2**15
-
         row_err: dict[int, str] = {}
         row_target_src: dict[int, tuple] = {}
         row_feas_src: dict[int, tuple] = {}
 
-        # Every phase-2 kernel below depends only on phase-1 DEVICE outputs,
-        # never on host values — so all of them are LAUNCHED back to back and
-        # the round pays ONE device→host sync (the tunnel adds ~70 ms RTT per
-        # sync; the round-2 shape of this loop synced after every sub-phase
-        # and serialized RTT + exec four times over).
-
-        # ---- phase 2 launch: division tails per sub-class ----
-        tails = []  # (rows, t_out)
-        for want_cls, has_agg in ((1, False), (2, True)):
-            rows = [b for b in range(n_real) if cls[b] == want_cls]
-            if not rows:
-                continue
-            idx_pad, nr = _pad_rows_idx(rows, self._bucket)
-            rsel = idx_pad.astype(np.int64)
-            t_feas = _gather_rows_kernel(dev_feasible, idx_pad)
-            t_avail = _gather_rows_kernel(dev_avail, idx_pad)
-            max_repl = int(raw.replicas[rows].max(initial=0))
-            topk = min(pow2_bucket(min(max_repl, TOPK_TARGETS), lo=8), TOPK_TARGETS)
-            if self._host_sorts and len(rows) * C >= HOST_TAIL_MIN_ELEMS:
-                # the numpy tail wins only once the [rows, C] sort volume
-                # dwarfs its per-row Python overhead; small tails stay on
-                # the (already fast) jit kernel
-                # cpu backend: the division tail runs as numpy — XLA:CPU's
-                # comparator-loop sorts cost ~40 s at the flagship shape
-                # while the host selection/packed-sort twin lands the same
-                # placements in seconds (ops/assign.py host_tail). Only the
-                # filter-phase outputs cross from the device; prev/tie
-                # reconstruct from the factored batch, and the jit-bucket
-                # padding is sliced off (host work needs no shape buckets).
-                rsub = np.asarray(rows, np.int64)
-                h_feas, h_avail = jax.device_get((t_feas, t_avail))
-                h_feas = np.asarray(h_feas)[:nr]
-                h_avail = np.asarray(h_avail)[:nr]
-                pidx = np.asarray(batch.prev_idx)[rsub]
-                prep = np.asarray(batch.prev_rep)[rsub]
-                h_prev = np.zeros((nr, C), np.int32)
-                rr, cc = np.nonzero((pidx >= 0) & (pidx < C))
-                h_prev[rr, pidx[rr, cc]] = prep[rr, cc]
-                t_out = assign_ops.host_tail(
-                    h_feas, h_avail, h_prev, np.asarray(batch.seeds)[rsub],
-                    np.asarray(batch.weight_tables)[batch.weight_idx[rsub]],
-                    batch.strategy[rsub], batch.replicas[rsub],
-                    batch.fresh[rsub],
-                    (STATIC_WEIGHT, DYNAMIC_WEIGHT, AGGREGATED),
-                    topk=topk,
-                )
-            else:
-                t_prev = _gather_rows_kernel(dev_prev, idx_pad)
-                t_tie = _gather_rows_kernel(dev_tie, idx_pad)
-                t_out = _tail_kernel(
-                    t_feas, t_avail, t_prev, t_tie,
-                    batch.weight_tables, batch.weight_idx[rsel],
-                    batch.strategy[rsel], batch.replicas[rsel], batch.fresh[rsel],
-                    topk=topk, narrow=narrow, has_agg=has_agg, narrow16=narrow16,
-                )
-            tails.append((rows, t_out))
-
-        # ---- phase 2 launch: duplicated / non-workload target sets ----
-        fallback_set = set(fallback_rows)
-        mask_rows = [
-            b for b in range(n_real)
-            if cls[b] == 0 and b not in batched_cfg and b not in fallback_set
-        ]
-        packed_dev = midx_dev = None
-        if mask_rows:
-            mask_idx, nm = _pad_rows_idx(mask_rows, self._bucket)
-            m_feas = _gather_rows_kernel(dev_feasible, mask_idx)
-            pc = raw.aff_masks.sum(axis=1)
-            mk = int(pc[raw.aff_idx[np.asarray(mask_rows)]].max(initial=0))
-            # the popcount bound is only a bound while feasible ⊆ affinity
-            # mask; with ClusterAffinity disabled the kernel substitutes
-            # all-ones for affinity, so the index window could truncate —
-            # those batches ship complete packed masks instead
-            if (
-                self._plugin_bits & plugin_mod.BIT_AFFINITY
-                and 0 < mk <= TOPK_TARGETS
-            ):
-                mkb = pow2_bucket(mk, lo=8)
-                midx_dev = _feas_idx_kernel(
-                    m_feas, min(mkb, C), narrow16=narrow16
-                )
-            else:  # wide rows (full-fleet affinities): complete packed mask
-                packed_dev = _pack_rows_kernel(m_feas)
-
-        # ---- phase 2 launch: spread group scoring ----
-        spread_pre = self._spread_prelaunch(
-            bindings, batch, batched_rows, batched_cfg,
-            dev_feasible, dev_score, dev_avail, dev_prev, dev_tie,
-            extra_avail=extra_avail, extra_mask=extra_mask,
-            extra_score=extra_score,
-        )
+        spread_fetch = None
+        if spread_pre is not None:
+            # device group scores, or the deferred host-score INPUTS
+            spread_fetch = spread_pre.get("wvf", spread_pre.get("host_inputs"))
 
         # ---- THE sync ----
         host = jax.device_get((
             dev_fc,
-            [t_out[1:] for _, t_out in tails],
+            [t["t_out"][1:] for t in tails if t["kind"] == "dev"],
             (packed_dev, midx_dev),
-            None if spread_pre is None else spread_pre["wvf"],
+            spread_fetch,
+            [(t["t_feas"], t["t_avail"]) for t in tails if t["kind"] == "host"],
         ))
         feas_count = np.asarray(host[0])[:n_real].astype(np.int64)
         if spread_pre is not None:
-            spread_pre["wvf_host"] = host[3]
+            if "wvf" in spread_pre:
+                spread_pre["wvf_host"] = host[3]
+            else:
+                # deferred host group scoring (cpu backend): the numpy twin
+                # runs here, on the materialize thread
+                from . import spread_batch
+
+                hi = host[3]
+                reps_r, need_r, target_r, dupf_r = spread_pre["host_params"]
+                W, V, A, fc_h = spread_batch.host_group_score(
+                    np.asarray(hi[0]), np.asarray(hi[1]),
+                    np.asarray(hi[2]), np.asarray(hi[3]),
+                    reps_r, need_r, target_r, dupf_r,
+                    layout=self._spread_layout,
+                )
+                spread_pre["wvf_host"] = (W, V, fc_h)
+
+        # ---- deferred host-sort division tails ----
+        dev_vals = iter(host[1])
+        host_inputs = iter(host[4])
+        decoded_tails = []  # (rows, result_src, vals)
+        for t in tails:
+            if t["kind"] == "dev":
+                decoded_tails.append((t["rows"], t["t_out"][0], next(dev_vals)))
+                continue
+            rows, nr, topk = t["rows"], t["nr"], t["topk"]
+            h_feas, h_avail = next(host_inputs)
+            # cpu backend: the division tail runs as numpy — XLA:CPU's
+            # comparator-loop sorts cost ~40 s at the flagship shape while
+            # the host selection/packed-sort twin lands the same placements
+            # in seconds (ops/assign.py host_tail). Only the filter-phase
+            # outputs cross from the device; prev/tie reconstruct from the
+            # factored batch, and the jit-bucket padding is sliced off.
+            rsub = np.asarray(rows, np.int64)
+            h_feas = np.asarray(h_feas)[:nr]
+            h_avail = np.asarray(h_avail)[:nr]
+            pidx = np.asarray(batch.prev_idx)[rsub]
+            prep = np.asarray(batch.prev_rep)[rsub]
+            h_prev = np.zeros((nr, C), np.int32)
+            rr, cc = np.nonzero((pidx >= 0) & (pidx < C))
+            h_prev[rr, pidx[rr, cc]] = prep[rr, cc]
+            t_out = assign_ops.host_tail(
+                h_feas, h_avail, h_prev, np.asarray(batch.seeds)[rsub],
+                np.asarray(batch.weight_tables)[batch.weight_idx[rsub]],
+                batch.strategy[rsub], batch.replicas[rsub],
+                batch.fresh[rsub],
+                (STATIC_WEIGHT, DYNAMIC_WEIGHT, AGGREGATED),
+                topk=topk,
+            )
+            decoded_tails.append((rows, t_out[0], t_out[1:]))
 
         # ---- decode: division tails ----
-        for (rows, t_out), vals in zip(tails, host[1]):
+        for rows, t_res, vals in decoded_tails:
             t_unsched, t_avail_sum, t_nnz, t_ti, t_tv = vals
             tis, tvs = _sorted_pairs(t_ti, t_tv)
             overflow = []
@@ -1506,11 +1875,11 @@ class ArrayScheduler:
                     continue
                 row_target_src[b] = ("pairs", names, tis[k, :n], tvs[k, :n])
             if overflow:
-                if isinstance(t_out[0], np.ndarray):  # host tail: no fetch
-                    o_res = t_out[0][[k for k, _ in overflow]]
+                if isinstance(t_res, np.ndarray):  # host tail: no fetch
+                    o_res = t_res[[k for k, _ in overflow]]
                 else:
                     o_res = fetch_rows(
-                        t_out[0], [k for k, _ in overflow], self._bucket
+                        t_res, [k for k, _ in overflow], self._bucket
                     )
                 for j, (_, b) in enumerate(overflow):
                     pos = np.nonzero(o_res[j] > 0)[0]
@@ -1605,11 +1974,18 @@ class ArrayScheduler:
         self, bindings, batch, batched_rows, batched_cfg,
         dev_feasible, dev_score, dev_avail, dev_prev, dev_tie,
         extra_avail=None, extra_mask=None, extra_score=None,
+        defer_host: bool = False,
     ):
         """LAUNCH the batched-spread group scoring (gathers + one kernel) and
         return the device handles — no sync. The partitioned round folds the
         (W, V, fc) fetch into its single round-trip; callers without that
-        discipline fetch from the returned handles themselves."""
+        discipline fetch from the returned handles themselves.
+
+        `defer_host`: when the cpu-backend host-scoring twin would engage,
+        do NOT sync here — return the gathered device handles under
+        `host_inputs`/`host_params` and let the materialize half fetch them
+        in THE sync and run the numpy twin on its own thread (the pipelined
+        launch path must never block on the device)."""
         if not batched_rows:
             return None
         from . import spread_batch
@@ -1699,10 +2075,25 @@ class ArrayScheduler:
         reps_r = reps[jsel]
         dupf_r = dupf[jsel]
 
-        if self._host_sorts and Sr * C >= HOST_TAIL_MIN_ELEMS:
+        base = {
+            "idx_pad": idx_pad, "nb": nb,
+            "g_feas": g_feas, "g_avail": g_avail,
+            "g_prev": g_prev, "g_tie": g_tie,
+            "score_inv": inv, "score_nrep": nrep,
+        }
+        if self._host_sorts and (
+            Sr * C >= HOST_TAIL_MIN_ELEMS
+            or (defer_host and self._overlap_active)
+        ):
             # cpu backend: the group-scoring member sort runs as numpy
             # (host_group_score — same outputs, packed np.argsort instead
-            # of XLA:CPU's comparator-loop sort)
+            # of XLA:CPU's comparator-loop sort); under an overlapping
+            # pipeline the twin runs deferred on the writer thread, so it
+            # wins at any volume (see the division-tail gate)
+            if defer_host:
+                base["host_inputs"] = (r_feas, r_score, r_avail, r_prev)
+                base["host_params"] = (reps_r, need_r, target_r, dupf_r)
+                return base
             h = jax.device_get((r_feas, r_score, r_avail, r_prev))
             W, V, A, fc_dev = spread_batch.host_group_score(
                 h[0], h[1], h[2], h[3],
@@ -1718,13 +2109,8 @@ class ArrayScheduler:
                 r_feas, r_score, r_avail, r_prev,
                 reps_r, need_r, target_r, dupf_r, layout=layout,
             )
-        return {
-            "idx_pad": idx_pad, "nb": nb,
-            "g_feas": g_feas, "g_avail": g_avail,
-            "g_prev": g_prev, "g_tie": g_tie,
-            "wvf": (W, V, fc_dev),
-            "score_inv": inv, "score_nrep": nrep,
-        }
+        base["wvf"] = (W, V, fc_dev)
+        return base
 
     def _spread_overlay(
         self, bindings, raw, batch, extra_avail, batched_rows, batched_cfg,
@@ -1859,9 +2245,13 @@ class ArrayScheduler:
                         TOPK_TARGETS,
                     )
                     has_agg_d = bool((d_strategy == AGGREGATED).any())
-                    if self._host_sorts and nd * C >= HOST_TAIL_MIN_ELEMS:
+                    if self._host_sorts and (
+                        nd * C >= HOST_TAIL_MIN_ELEMS or self._overlap_active
+                    ):
                         # the spread re-run's division is the same tail —
-                        # run the numpy twin (see the phase-2 host branch)
+                        # run the numpy twin (see the phase-2 host branch);
+                        # already on the materialize thread, so under the
+                        # pipeline it too wins at any volume
                         h_feas, h_avail, h_prev = jax.device_get(
                             (d_feas, d_avail, d_prev)
                         )
@@ -2043,7 +2433,8 @@ class ArrayScheduler:
         C = len(names)
         batched_rows, batched_cfg, fallback_rows = self._classify_spread(bindings)
 
-        raw = self.batch_encoder.encode(bindings, term_indices=term_indices)
+        with self._encode_lock:
+            raw = self.batch_encoder.encode(bindings, term_indices=term_indices)
         batch = self._pad(raw)
         if extra_avail is not None:
             extra_avail = _pad_extra_avail(extra_avail, C, len(batch.replicas))

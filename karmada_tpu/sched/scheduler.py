@@ -56,19 +56,39 @@ class SchedulerDaemon:
         store: Store,
         runtime: Runtime,
         scheduler_name: str = DEFAULT_SCHEDULER_NAME,
+        # estimator.client.SchedulerEstimatorRegistry: the typed contract —
+        # batch_estimates + the last_sweep_open degraded-sweep attribute
         estimator_registry=None,
         gates: Optional[FeatureGates] = None,
         event_recorder=None,
         plugins=None,  # the --plugins list: "*" / "foo" / "-foo"
         plugin_registry=None,  # out-of-tree plugins (WithOutOfTreeRegistry)
+        pipeline=None,  # pipelined round executor (None = KARMADA_TPU_PIPELINE)
     ) -> None:
         self.store = store
         self.clock = runtime.clock
         self.scheduler_name = scheduler_name
+        if estimator_registry is not None:
+            # contract check ONCE, loudly, at boot: the pipelined round
+            # reads these every chunk, and a registry from the old
+            # batch_estimates-only duck contract would otherwise fail every
+            # round with a bare AttributeError deep in the pipeline
+            missing = [
+                a for a in ("batch_estimates", "last_sweep_open",
+                            "sweep_round")
+                if not hasattr(estimator_registry, a)
+            ]
+            if missing:
+                raise TypeError(
+                    "estimator_registry must satisfy "
+                    "estimator.client.SchedulerEstimatorRegistry; missing: "
+                    + ", ".join(missing)
+                )
         self.estimator_registry = estimator_registry
         self.event_recorder = event_recorder
         self.plugins = plugins
         self.plugin_registry = plugin_registry
+        self.pipeline = pipeline
         self._array: Optional[ArrayScheduler] = None
         self._fleet_dirty = True
         self._prewarmed_epoch = -1
@@ -166,6 +186,7 @@ class SchedulerDaemon:
                     clusters,
                     plugins=self.plugins,
                     plugin_registry=self.plugin_registry,
+                    pipeline=self.pipeline,
                 )
             else:
                 # MODIFIED-only churn rides the dirty-column scatter (the
@@ -236,31 +257,108 @@ class SchedulerDaemon:
         if not bindings:
             return []
         from ..tracing import Trace
+        from .pipeline import ChunkPipeline, StageTimer, chunk_spans
 
         trace = Trace("Scheduling", {"bindings": len(bindings)})
         with timed(e2e_scheduling_duration):
             array = self._ensure_fleet()
             trace.step("Fleet snapshot ready")
-            extra_avail = None
-            if self.estimator_registry is not None:
-                extra_avail = self.estimator_registry.batch_estimates(
-                    bindings, array.fleet.names
+            names = array.fleet.names
+            reg = self.estimator_registry
+            # Pipelined round (sched/pipeline.py): the round is cut into row
+            # chunks and the five stages overlap across them — chunk k+1's
+            # estimator sweep prefetches on a worker thread and its rows
+            # encode/dispatch on this thread while chunk k solves on device
+            # and chunk k−1 materializes + patches on the bounded writer.
+            # Decisions are bit-identical to the serial executor (rows are
+            # independent; tie-breaks UID-seeded) and the writer patches
+            # chunks strictly in order, so per-binding store-write ordering
+            # is exactly the serial sequence. Autoshard routes on the WHOLE
+            # round first — chunked launches must see the same backend the
+            # serial executor would.
+            array._maybe_autoshard(len(bindings))
+            rows = array.round_chunk_rows(len(bindings))
+            chunks = [
+                bindings[s:e] for s, e in chunk_spans(len(bindings), rows)
+            ]
+            # same guard as ArrayScheduler._schedule_chunked: out-of-tree
+            # plugins' stateful host hooks must never run on two threads,
+            # so their (HBM-chunked) rounds execute serially
+            pipelined = (
+                array.pipeline_enabled
+                and not array._oot_plugins
+                and len(chunks) > 1
+            )
+            timer = StageTimer()
+            open_members: set[str] = set()
+            totals = {"replayed": 0, "solved": 0}
+
+            def estimate(chunk):
+                # chunk-shard estimator fan-out: each sweep covers only this
+                # chunk's bindings, so the next chunk's answers prefetch
+                # while the current one solves. Snapshot the degraded set
+                # per sweep — breaker-open members' stale columns merged
+                # into THIS chunk's matrix exactly as a serial sweep would.
+                extra = reg.batch_estimates(chunk, names)
+                return extra, tuple(reg.last_sweep_open)
+
+            def launch(i, chunk, est):
+                extra = None
+                if est is not None:
+                    extra, swept_open = est
+                    open_members.update(swept_open)
+                pending = array.launch_chunk(chunk, extra,
+                                             round_rows=len(bindings))
+                totals["replayed"] += pending["replayed"]
+                totals["solved"] += pending["solved"]
+                return pending
+
+            def patch(i, chunk, decisions):
+                for rb, decision in zip(chunk, decisions):
+                    schedule_attempts.inc(
+                        result="scheduled" if decision.ok else "error"
+                    )
+                    self._patch_result(rb, decision)
+
+            from contextlib import nullcontext
+
+            # the round's chunk-shard sweeps count as ONE sweep for the
+            # staleness cache (snapshots merge, epochs advance once/round)
+            sweep_scope = (
+                reg.sweep_round() if reg is not None else nullcontext()
+            )
+            with array.pipeline_context(timer, overlap=pipelined), sweep_scope:
+                pipe = ChunkPipeline(
+                    launch=launch,
+                    materialize=array.materialize_chunk,
+                    estimate=estimate if reg is not None else None,
+                    patch=patch,
+                    pipelined=pipelined,
+                    timer=timer,
+                    # materialize_chunk times its own finer span
+                    time_materialize=False,
                 )
-                if getattr(self.estimator_registry, "last_sweep_open", None):
-                    # degraded mode: at least one member's breaker is open —
-                    # its stale (penalized) rows stay in the matrix and the
-                    # round still completes as one batched solve below
-                    degraded_rounds.inc()
-            trace.step("Estimator fan-out done")
-            with timed(scheduling_algorithm_duration):
-                decisions = array.schedule_incremental(
-                    bindings, extra_avail=extra_avail
-                )
-            trace.step("Batched solve done")
-            for rb, decision in zip(bindings, decisions):
-                schedule_attempts.inc(result="scheduled" if decision.ok else "error")
-                self._patch_result(rb, decision)
-            trace.step("Results patched")
+                pipe.run(chunks)
+            # the algorithm metric keeps its solve-only reference semantics
+            # (estimate RPC time and store patching stay OUTSIDE it, as they
+            # were before the pipeline): observe the round's algorithm-stage
+            # busy time — stages overlap, so wall-clock would under-count
+            scheduling_algorithm_duration.observe(sum(
+                timer.totals.get(s, 0.0)
+                for s in ("encode", "solve", "materialize")
+            ))
+            if open_members:
+                # degraded mode: at least one member's breaker was open
+                # during this round's sweeps — its stale (penalized) rows
+                # stayed in the matrix and every chunk still completed as a
+                # batched launch
+                degraded_rounds.inc()
+            stats = pipe.stats()
+            stats["chunks"] = len(chunks)
+            stats["chunk_rows"] = rows
+            array.last_round_stats = {**totals, **stats}
+            trace.step("Pipelined round done (estimate/encode/solve/"
+                       "materialize/patch)")
         # slow-round span (the scheduler-side analogue of estimate.go:37-38)
         trace.log_if_long(1.0)
         return []

@@ -63,6 +63,12 @@ def main() -> None:
                          "that trickles bytes (slow loris) is reaped after "
                          "this long instead of pinning a handler thread; "
                          "0 disables (not recommended)")
+    ap.add_argument("--estimator-workers", type=int, default=0,
+                    help="threads for the member-estimator fan-out pool "
+                         "(0 = scale with member count, capped; see "
+                         "MemberEstimators) — sized so the pipelined "
+                         "scheduler round's estimate-prefetch stage can't "
+                         "starve on large fleets")
     ap.add_argument("--enable-test-clock", action="store_true",
                     help="allow POST /tick (advancing/freezing the plane's "
                          "Clock — test drivers only); disabled by default "
@@ -110,7 +116,10 @@ def main() -> None:
         print(f"faults: chaos plan installed from {faults.ENV_FAULT_PLAN}",
               flush=True)
 
-    cp = ControlPlane(controllers=args.controllers.split(","))
+    cp = ControlPlane(
+        controllers=args.controllers.split(","),
+        estimator_workers=args.estimator_workers or None,
+    )
     persistence = None
     _data_dir_lock = None  # held for the process lifetime
     if args.data_dir:

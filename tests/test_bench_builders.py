@@ -18,6 +18,7 @@ SMALL = dict(
     churn_incremental=lambda: bench.build_churn_incremental(
         n_clusters=30, n_bindings=16),
     autoshard=lambda: bench.build_autoshard(n_clusters=30, n_bindings=16),
+    pipeline=lambda: bench.build_pipeline(n_clusters=30, n_bindings=16),
     flagship=lambda: bench.build_flagship(n_clusters=30, n_bindings=16),
     flagship_cold=lambda: bench.build_flagship_cold(n_clusters=30, n_bindings=16),
 )
@@ -47,6 +48,29 @@ def test_churn_incremental_replays_most_rows():
     stats = sched.last_round_stats
     assert stats["solved"] <= max(1, int(0.05 * len(bindings)))
     assert stats["replayed"] == len(bindings) - stats["solved"]
+
+
+def test_pipeline_config_serial_leg_bit_identical():
+    """The pipeline config's acceptance gate in miniature: pipelined and
+    serial legs over the same (shrunk-budget, chunked) round must land
+    bit-identical decisions and report the overlap stats."""
+    sched, bindings, _ = bench.build_pipeline(n_clusters=30, n_bindings=16)
+    sched.schedule(bindings)  # warm
+    sched.schedule(bindings)
+    stats = sched.last_round_stats
+    assert stats.get("pipelined") is True
+    assert stats.get("chunks", 0) > 1
+    lat, identical = sched.serial_compare(bindings, iters=1)
+    assert identical, "pipelined vs serial decisions diverged"
+    assert len(lat) == 1
+
+
+def test_latest_capture_name_resolves_newest():
+    """The CPU-fallback note must point at the newest committed capture,
+    never a pinned round (the r03 hardcode this replaced)."""
+    name = bench.latest_capture_name()
+    assert name == "BENCH_tpu_latest.json"  # committed in this repo
+    assert "r03" not in name
 
 
 def test_autoshard_config_records_route():

@@ -1,0 +1,537 @@
+"""Pipelined round executor (sched/pipeline.py): the chunked software
+pipeline must be INDISTINGUISHABLE from the serial executor in its outputs —
+bit-identical decisions (UID-seeded ties make that testable) and per-binding
+store-write order — while actually overlapping its stages (pinned by a
+fake-clock stage trace, not by wall-clock luck). Covers the single-chip
+chunked path, mesh/autoshard, incremental replay riding through, and a
+breaker-open member under a seeded FaultPlan."""
+from __future__ import annotations
+
+import threading
+
+import numpy as np
+import pytest
+
+from karmada_tpu.metrics import degraded_rounds, schedule_stage_seconds
+from karmada_tpu.sched import core as core_mod
+from karmada_tpu.sched.core import ArrayScheduler
+from karmada_tpu.sched.pipeline import (
+    STAGES,
+    ChunkPipeline,
+    StageTimer,
+    chunk_spans,
+    resolve_pipeline,
+)
+from karmada_tpu.testing.fixtures import synthetic_fleet
+from tests.test_incremental import assert_same_decisions, mixed_bindings
+from tests.test_parallel import dyn_placement, make_binding
+
+
+@pytest.fixture()
+def fleet():
+    clusters = synthetic_fleet(19, seed=5)
+    return clusters, [c.name for c in clusters]
+
+
+def chunked_pair(clusters, rows_per_chunk=16):
+    """(pipelined, serial) ArrayScheduler twins over the same fleet with the
+    HBM budget shrunk so a mixed round chunks."""
+    pipe = ArrayScheduler(clusters, pipeline=True, autoshard=False)
+    serial = ArrayScheduler(clusters, pipeline=False, autoshard=False)
+    for s in (pipe, serial):
+        s.max_bc_elems = len(clusters) * rows_per_chunk
+    return pipe, serial
+
+
+class TestChunkedParity:
+    def test_bit_identical_single_chip(self, fleet):
+        clusters, names = fleet
+        bindings = mixed_bindings(names, n=120)
+        pipe, serial = chunked_pair(clusters)
+        got = pipe.schedule(bindings)
+        assert pipe.last_pipeline_stats["pipelined"] is True
+        assert pipe.last_pipeline_stats["chunks"] > 1
+        assert_same_decisions(got, serial.schedule(bindings))
+        # and against an un-chunked cold solve (chunk boundaries must not
+        # leak into placements)
+        assert_same_decisions(got, ArrayScheduler(clusters).schedule(bindings))
+
+    def test_bit_identical_with_estimator_answers(self, fleet):
+        clusters, names = fleet
+        bindings = mixed_bindings(names, n=60)
+        rng = np.random.default_rng(3)
+        extra = rng.integers(-1, 50, size=(len(bindings), len(names)))
+        extra = extra.astype(np.int32)
+        pipe, serial = chunked_pair(clusters)
+        assert_same_decisions(
+            pipe.schedule(bindings, extra_avail=extra),
+            serial.schedule(bindings, extra_avail=extra),
+        )
+
+    def test_bit_identical_host_tail_and_spread(self, fleet, monkeypatch):
+        """Force the cpu host-sort twins (division tail + spread group
+        scoring) so the DEFERRED host paths — they now run at materialize
+        time on the writer thread — are exercised and stay bit-identical."""
+        from karmada_tpu.api import policy as pol
+
+        monkeypatch.setattr(core_mod, "HOST_TAIL_MIN_ELEMS", 0)
+        monkeypatch.setattr(core_mod, "PIPELINE_MIN_ROWS", 4)
+        clusters, names = fleet
+        bindings = mixed_bindings(names, n=40)
+        spread = pol.Placement(
+            cluster_affinity=pol.ClusterAffinity(cluster_names=[]),
+            spread_constraints=[pol.SpreadConstraint(
+                spread_by_field=pol.SPREAD_BY_FIELD_REGION, min_groups=2,
+            )],
+        )
+        bindings += [
+            make_binding(f"spread-{i}", 3 + i, spread, cpu=0.25)
+            for i in range(12)
+        ]
+        pipe, serial = chunked_pair(clusters)
+        assert_same_decisions(
+            pipe.schedule(bindings), serial.schedule(bindings)
+        )
+
+    def test_incremental_replay_rides_through(self, fleet):
+        clusters, names = fleet
+        bindings = mixed_bindings(names, n=80)
+        pipe, serial = chunked_pair(clusters)
+        assert_same_decisions(
+            pipe.schedule_incremental(bindings),
+            serial.schedule_incremental(bindings),
+        )
+        # chunked round: the replay split plus the pipeline stats surface
+        assert pipe.last_round_stats["solved"] == len(bindings)
+        assert "overlap_ratio" in pipe.last_round_stats
+        # dirty a handful; replay must engage for the rest and decisions
+        # must still match a fresh cold solve
+        for rb in bindings[:5]:
+            rb.metadata.generation += 1
+            rb.spec.replicas += 1
+        got = pipe.schedule_incremental(bindings)
+        assert pipe.last_round_stats["replayed"] == len(bindings) - 5
+        assert_same_decisions(
+            got, ArrayScheduler(clusters).schedule(bindings)
+        )
+
+    def test_autoshard_engages_under_pipeline(self, fleet):
+        clusters, names = fleet
+        bindings = mixed_bindings(names)
+        want = ArrayScheduler(clusters).schedule(bindings)
+        sched = ArrayScheduler(clusters, pipeline=True)
+        sched.max_bc_elems = 16  # force the oversized classification
+        got = sched.schedule(bindings)
+        assert sched.mesh is not None, "oversized round did not engage mesh"
+        assert_same_decisions(got, want)
+
+    def test_breaker_open_member_under_fault_plan(self, fleet):
+        """Degraded round through the pipeline: a seeded FaultPlan darkens
+        one member's estimator legs until its breaker opens; the stale
+        (penalized) column rides every chunk's matrix and the pipelined
+        decisions stay bit-identical to the serial executor's."""
+        from karmada_tpu import faults
+        from karmada_tpu.estimator.client import (
+            EstimatorRegistry, MemberEstimators,
+        )
+        from karmada_tpu.faults import FaultPlan, FaultRule
+        from karmada_tpu.faults.policy import BreakerRegistry
+
+        clusters, names = fleet
+        dark = names[2]
+        bindings = [
+            make_binding(f"dyn-{i}", 4 + i % 7, dyn_placement(), cpu=0.5)
+            for i in range(40)
+        ]
+
+        class _Rows:
+            """Per-cluster member-estimator stand-in (batched leg)."""
+
+            def max_available_replicas_batch(self, requirements_list):
+                return [37] * len(requirements_list)
+
+        class _Member:
+            node_estimator = _Rows()
+
+        faults.reset()
+        faults.install(FaultPlan(seed=11, rules=[
+            FaultRule(boundary="grpc", target=dark, kind="error"),
+        ]))
+        try:
+            breakers = BreakerRegistry(failure_threshold=1,
+                                       open_seconds=3600.0)
+            registry = EstimatorRegistry(breakers=breakers)
+            registry.register_replica_estimator(
+                "members",
+                MemberEstimators({n: _Member() for n in names},
+                                 breakers=breakers),
+            )
+            warm = registry.batch_estimates(bindings, names)  # opens breaker
+            assert warm is not None
+            extra = registry.batch_estimates(bindings, names)
+            assert registry.last_sweep_open == [dark]
+            pipe, serial = chunked_pair(clusters)
+            assert_same_decisions(
+                pipe.schedule(bindings, extra_avail=extra),
+                serial.schedule(bindings, extra_avail=extra),
+            )
+        finally:
+            faults.reset()
+
+
+class TestStageTrace:
+    """Fake-clock stage-trace tests: the pipeline's overlap is pinned by
+    event ordering, never by wall-clock timing."""
+
+    @staticmethod
+    def _fake_clock():
+        lock = threading.Lock()
+        t = [0.0]
+
+        def clock():
+            with lock:
+                t[0] += 1.0
+                return t[0]
+
+        return clock
+
+    def test_chunks_overlap(self):
+        """encode of chunk k+1 must START before materialize of chunk k
+        ENDS. Deterministic: materialize(0) BLOCKS until launch(1) has
+        begun — a serial executor would deadlock here (guarded by a
+        timeout), a pipelined one sails through."""
+        trace: list[tuple] = []
+        tlock = threading.Lock()
+
+        def on_trace(stage, tag, event, t):
+            with tlock:
+                trace.append((stage, tag, event, t))
+
+        timer = StageTimer(clock=self._fake_clock(), trace=on_trace)
+        launched_1 = threading.Event()
+        patched: list[int] = []
+
+        def launch(i, chunk, est):
+            with timer.stage("encode", tag=i):
+                if i == 1:
+                    launched_1.set()
+            with timer.stage("solve", tag=i):
+                pass
+            return i
+
+        def materialize(pending):
+            if pending == 0:
+                assert launched_1.wait(timeout=30.0), (
+                    "pipeline serialized: chunk 1 never encoded while "
+                    "chunk 0 materialized"
+                )
+            return pending * 10
+
+        def patch(i, chunk, result):
+            patched.append(i)
+
+        pipe = ChunkPipeline(launch=launch, materialize=materialize,
+                             patch=patch, timer=timer)
+        results = pipe.run([["a"], ["b"], ["c"]])
+        assert results == [0, 10, 20]
+        assert patched == [0, 1, 2]  # write order strictly chunk order
+
+        def at(stage, tag, event):
+            return next(t for s, g, e, t in trace
+                        if s == stage and g == tag and e == event)
+
+        assert at("encode", 1, "begin") < at("materialize", 0, "end")
+        stats = pipe.stats()
+        assert stats["pipelined"] is True
+        assert set(stats["stage_seconds"]) == {"encode", "solve",
+                                               "materialize", "patch"}
+
+    def test_serial_leg_does_not_overlap(self):
+        trace: list[tuple] = []
+        timer = StageTimer(
+            clock=self._fake_clock(),
+            trace=lambda *ev: trace.append(ev),
+        )
+
+        def launch(i, chunk, est):
+            with timer.stage("encode", tag=i):
+                pass
+            return i
+
+        pipe = ChunkPipeline(launch=launch, materialize=lambda p: p,
+                             timer=timer, pipelined=False)
+        assert pipe.run([["a"], ["b"]]) == [0, 1]
+
+        def at(stage, tag, event):
+            return next(t for s, g, e, t in trace
+                        if s == stage and g == tag and e == event)
+
+        assert at("encode", 1, "begin") > at("materialize", 0, "end")
+
+    def test_estimate_prefetch_overlaps_launch(self):
+        """The estimate of chunk k+1 runs while chunk k encodes: launch(0)
+        blocks until estimate(1) has begun."""
+        est_started: dict[int, threading.Event] = {
+            i: threading.Event() for i in range(3)
+        }
+        seen_est: list[object] = []
+
+        def estimate(chunk):
+            i = chunk[0]
+            est_started[i].set()
+            return i * 100
+
+        def launch(i, chunk, est):
+            seen_est.append(est)
+            if i == 0:
+                assert est_started[1].wait(timeout=30.0), (
+                    "estimate prefetch serialized behind launch"
+                )
+            return i
+
+        pipe = ChunkPipeline(launch=launch, materialize=lambda p: p,
+                             estimate=estimate)
+        assert pipe.run([[0], [1], [2]]) == [0, 1, 2]
+        assert seen_est == [0, 100, 200]  # each chunk got ITS estimate
+
+    def test_materialize_failure_propagates(self):
+        def materialize(pending):
+            if pending == 1:
+                raise RuntimeError("boom")
+            return pending
+
+        pipe = ChunkPipeline(launch=lambda i, c, e: i,
+                             materialize=materialize)
+        with pytest.raises(RuntimeError, match="boom"):
+            pipe.run([[0], [1], [2], [3]])
+
+
+class TestDaemonPipeline:
+    """Tier-1-safe fast variant: the daemon's five-stage round over a small
+    store, chunked via a lowered PIPELINE_MIN_ROWS, compared against a
+    serial daemon over an identical store (same binding objects deep-copied
+    — the UID-seeded tie-break demands identical uids on both sides)."""
+
+    @staticmethod
+    def _bindings(names, n=24):
+        from karmada_tpu.testing.fixtures import duplicated_placement
+
+        out = []
+        for i in range(n):
+            if i % 2 == 0:
+                p = dyn_placement(aggregated=i % 4 == 0)
+            else:
+                p = duplicated_placement(names[:4])
+            out.append(make_binding(f"app-{i}", 3 + i % 9, p, cpu=0.25))
+        return out
+
+    def _topology(self, pipeline_enabled: bool, bindings, n_clusters=7):
+        import copy
+
+        from karmada_tpu.estimator.client import EstimatorRegistry
+        from karmada_tpu.runtime.controller import Runtime
+        from karmada_tpu.sched.scheduler import SchedulerDaemon
+        from karmada_tpu.store.store import Store
+
+        store = Store()
+        runtime = Runtime()
+        for c in synthetic_fleet(n_clusters, seed=9):
+            store.create(c)
+
+        class _Rows:
+            # a pure function of the cluster column — chunk-shard sweeps
+            # must see exactly the whole-round sweep's answers
+            def max_available_replicas_rows(self, cl, reqs):
+                col = 7 + 5 * np.arange(len(cl), dtype=np.int64)
+                return np.broadcast_to(col, (len(reqs), len(cl))).copy()
+
+        registry = EstimatorRegistry()
+        registry.register_replica_estimator("rows", _Rows())
+        daemon = SchedulerDaemon(store, runtime,
+                                 estimator_registry=registry)
+        # pin the executor mode regardless of the ambient env default
+        daemon._ensure_fleet().pipeline_enabled = pipeline_enabled
+        for rb in bindings:
+            store.create(copy.deepcopy(rb))
+        return store, runtime, daemon
+
+    @staticmethod
+    def _placements(store):
+        return {
+            rb.metadata.name: tuple(
+                sorted((t.name, t.replicas) for t in (rb.spec.clusters or []))
+            )
+            for rb in store.list("ResourceBinding")
+        }
+
+    def test_daemon_round_pipelined_matches_serial(self, monkeypatch):
+        monkeypatch.setattr(core_mod, "PIPELINE_MIN_ROWS", 4)
+        names = [c.name for c in synthetic_fleet(7, seed=9)]
+        bindings = self._bindings(names)
+        store_p, rt_p, daemon_p = self._topology(True, bindings)
+        store_s, rt_s, daemon_s = self._topology(False, bindings)
+        before = {
+            s: schedule_stage_seconds.count(stage=s) for s in STAGES
+        }
+        rt_p.settle()
+        rt_s.settle()
+        assert self._placements(store_p) == self._placements(store_s)
+        array = daemon_p._array
+        # settle() runs rounds to the event fixpoint; the LAST one is the
+        # Duplicated-refresh round, still chunked and pipelined
+        assert array.last_round_stats["chunks"] > 1
+        assert array.last_round_stats["overlap_ratio"] > 0
+        # every stage of the pipelined rounds observed its histogram
+        for s in STAGES:
+            assert schedule_stage_seconds.count(stage=s) > before[s], s
+        # metadata-only touch of the Duplicated bindings: the refresh
+        # trigger re-enters them with identical solve inputs — they must
+        # REPLAY through launch_chunk and skip straight to patch
+        for rb in store_p.list("ResourceBinding"):
+            if rb.metadata.name.endswith(("1", "3", "5", "7", "9")):
+                rb.metadata.labels["touch"] = "1"
+                store_p.update(rb)
+        rt_p.settle()
+        assert array.last_round_stats["replayed"] > 0
+        assert array.last_round_stats["solved"] == 0
+        assert self._placements(store_p) == self._placements(store_s)
+
+    def test_daemon_degraded_detection_typed(self, monkeypatch):
+        """The typed last_sweep_open attribute drives degraded-round
+        accounting through the chunked sweeps: any chunk whose sweep saw an
+        open member counts the round ONCE."""
+        from karmada_tpu.estimator.client import EstimatorRegistry
+        from karmada_tpu.faults.policy import BreakerRegistry
+        from karmada_tpu.runtime.controller import Runtime
+        from karmada_tpu.sched.scheduler import SchedulerDaemon
+        from karmada_tpu.store.store import Store
+
+        monkeypatch.setattr(core_mod, "PIPELINE_MIN_ROWS", 4)
+        store = Store()
+        runtime = Runtime()
+        clusters = synthetic_fleet(5, seed=3)
+        names = [c.name for c in clusters]
+        for c in clusters:
+            store.create(c)
+        breakers = BreakerRegistry(failure_threshold=1, open_seconds=3600.0)
+        registry = EstimatorRegistry(breakers=breakers)
+
+        class _Rows:
+            def max_available_replicas_rows(self, cl, reqs):
+                return np.full((len(reqs), len(cl)), 50, np.int32)
+
+        registry.register_replica_estimator("rows", _Rows())
+        daemon = SchedulerDaemon(store, runtime,
+                                 estimator_registry=registry)
+        daemon._ensure_fleet().pipeline_enabled = True
+        for i in range(12):
+            store.create(make_binding(f"d-{i}", 4, dyn_placement(), cpu=0.5))
+        t0 = degraded_rounds.total()
+        runtime.settle()  # healthy round: must not count
+        assert degraded_rounds.total() == t0
+        # open one member's breaker, then dirty every binding so a full
+        # (chunked) round runs with the stale column merged per chunk
+        breakers.for_member(names[0]).record_failure()
+        for rb in store.list("ResourceBinding"):
+            rb.spec.replicas += 1
+            store.update(rb)
+        runtime.settle()
+        assert degraded_rounds.total() == t0 + 1
+
+
+class TestChunkShardSweeps:
+    """A pipelined round's N chunk-shard estimator sweeps must be
+    indistinguishable from ONE whole-round sweep — including the degraded
+    path: staleness snapshots merge across chunks and the decay epoch
+    advances once per round, so every chunk sees the same penalized
+    column a serial sweep would have produced."""
+
+    @staticmethod
+    def _registry():
+        from karmada_tpu.estimator.client import EstimatorRegistry
+        from karmada_tpu.faults.policy import BreakerRegistry
+
+        breakers = BreakerRegistry(failure_threshold=1, open_seconds=3600.0)
+        reg = EstimatorRegistry(breakers=breakers)
+
+        class Flaky:
+            dark: set[str] = set()
+
+            def max_available_replicas(self, clusters, requirements,
+                                       replicas):
+                out = []
+                for c, cluster in enumerate(clusters):
+                    br = breakers.for_member(cluster)
+                    if not br.allow():
+                        out.append(-1)
+                        continue
+                    if cluster in self.dark:
+                        br.record_failure()
+                        out.append(-1)
+                        continue
+                    br.record_success()
+                    out.append(100 + c)
+                return out
+
+        est = Flaky()
+        reg.register_replica_estimator("flaky", est)
+        return reg, est
+
+    def test_chunked_degraded_sweeps_match_whole_round(self):
+        bindings = [
+            make_binding(f"x-{i}", 4, dyn_placement(), cpu=0.5)
+            for i in range(12)
+        ]
+        clusters = ["m1", "m2", "m3"]
+
+        def sweep(reg, chunked):
+            if not chunked:
+                return reg.batch_estimates(bindings, clusters)
+            outs = []
+            with reg.sweep_round():
+                for s in range(0, len(bindings), 4):
+                    outs.append(
+                        reg.batch_estimates(bindings[s:s + 4], clusters)
+                    )
+            return np.vstack(outs)
+
+        reg_a, est_a = self._registry()
+        reg_b, est_b = self._registry()
+        assert (sweep(reg_a, False) == sweep(reg_b, True)).all()
+        est_a.dark = {"m2"}
+        est_b.dark = {"m2"}
+        for expect_age in (1, 2):  # decay must advance once per ROUND
+            a = sweep(reg_a, False)
+            b = sweep(reg_b, True)
+            assert (a == b).all(), (a, b)
+            assert reg_a.staleness.age("m2") == expect_age
+            assert reg_b.staleness.age("m2") == expect_age
+            # the stale column is served (decayed), not the -1 discard
+            assert (b[:, 1] == 101 >> expect_age).all()
+
+
+class TestResolvePipeline:
+    def test_env_and_override(self, monkeypatch):
+        monkeypatch.delenv("KARMADA_TPU_PIPELINE", raising=False)
+        assert resolve_pipeline() is True
+        monkeypatch.setenv("KARMADA_TPU_PIPELINE", "0")
+        assert resolve_pipeline() is False
+        assert resolve_pipeline(True) is True  # constructor beats env
+        clusters = synthetic_fleet(3, seed=1)
+        assert ArrayScheduler(clusters).pipeline_enabled is False
+        assert ArrayScheduler(clusters, pipeline=True).pipeline_enabled
+
+    def test_chunk_spans(self):
+        assert chunk_spans(10, 4) == [(0, 4), (4, 8), (8, 10)]
+        assert chunk_spans(4, 4) == [(0, 4)]
+
+    def test_round_chunk_rows_policy(self, monkeypatch):
+        clusters = synthetic_fleet(3, seed=1)
+        sched = ArrayScheduler(clusters, pipeline=True)
+        # tiny rounds stay single-chunk (serial — nothing to overlap)
+        assert sched.round_chunk_rows(10) == 10
+        monkeypatch.setattr(core_mod, "PIPELINE_MIN_ROWS", 4)
+        rows = sched.round_chunk_rows(64)
+        assert 4 <= rows < 64
+        disabled = ArrayScheduler(clusters, pipeline=False)
+        assert disabled.round_chunk_rows(64) == 64
