@@ -742,7 +742,12 @@ def build_pipeline(seed=0, n_clusters=5000, n_bindings=10000):
     serial.pipeline_enabled = False
     serial.autoshard = False
     serial.max_bc_elems = budget
-    pipe = ArrayScheduler(serial.clusters, pipeline=True, autoshard=False)
+    # the REAL cluster prefix only — serial.clusters carries dead shape-pad
+    # tail entries that the new scheduler would re-pad on top of
+    pipe = ArrayScheduler(
+        serial.clusters[: serial.n_real_clusters],
+        pipeline=True, autoshard=False,
+    )
     pipe.max_bc_elems = budget
     return _PipelineSched(pipe, serial), bindings, None
 
@@ -761,6 +766,119 @@ def build_autoshard(seed=0, n_clusters=2048, n_bindings=4096):
     # ~4 sequential row chunks on a single chip; a mesh route collapses them
     sched.max_bc_elems = max(1, (n_bindings * n_clusters) // 4)
     return sched, bindings, None
+
+
+def run_coldstart_child(args) -> None:
+    """Grandchild of the coldstart config: ONE cold process measured from
+    entry to its first placement batch. Prints a single JSON line:
+    cold_to_first_s (process entry → first schedule() returned — imports,
+    backend init, fleet/bindings build, optional AOT prewarm, first round),
+    plus the split and the compile counters, so the parent can attribute
+    where a cold boot spends its time with and without the persistent
+    compilation cache."""
+    t_proc = time.perf_counter()
+    import jax
+
+    if args.platform:
+        jax.config.update("jax_platforms", args.platform)
+    from karmada_tpu.sched.compilecache import (
+        compile_counts,
+        enable_persistent_cache,
+    )
+
+    cache_entries = -1
+    if args.coldstart_cache_dir:
+        cache_entries = enable_persistent_cache(args.coldstart_cache_dir)
+    backend = jax.devices()[0].platform
+
+    t0 = time.perf_counter()
+    sched, bindings, _extra = build_flagship(
+        n_clusters=args.clusters, n_bindings=args.bindings
+    )
+    build_s = time.perf_counter() - t0
+
+    aot_s = 0.0
+    if args.coldstart_aot:
+        from karmada_tpu.sched.aot import prewarm_schedule
+
+        t0 = time.perf_counter()
+        prewarm_schedule(sched, bindings)
+        aot_s = time.perf_counter() - t0
+
+    t0 = time.perf_counter()
+    decisions = sched.schedule(bindings)
+    first_s = time.perf_counter() - t0
+    print(json.dumps({
+        "cold_to_first_s": round(time.perf_counter() - t_proc, 3),
+        "build_s": round(build_s, 3),
+        "aot_s": round(aot_s, 3),
+        "first_round_s": round(first_s, 3),
+        "cache_entries_at_boot": cache_entries,
+        "backend": backend,
+        "scheduled_ok": sum(d.ok for d in decisions),
+        **compile_counts(),
+    }))
+
+
+def run_coldstart(args, platform, backend_label: str) -> dict:
+    """The `coldstart` config: cold-process-to-first-placement, measured in
+    fresh grandchild processes — (a) no persistent cache, (b) cold cache
+    (the populating boot), (c) warm cache + AOT prewarm (the claim: a cold
+    PROCESS with a warm cache places within one lease TTL, docs/HA.md).
+    Emits both the no-cache and warm-cache numbers in one JSON line."""
+    import shutil
+    import tempfile
+
+    tmp = tempfile.mkdtemp(prefix="karmada-coldstart-cache-")
+
+    def child(cache_dir: str, aot: bool):
+        argv = [
+            sys.executable, os.path.abspath(__file__), "--coldstart-child",
+            "--clusters", str(args.clusters), "--bindings", str(args.bindings),
+            "--coldstart-cache-dir", cache_dir,
+        ]
+        if aot:
+            argv.append("--coldstart-aot")
+        if platform:
+            argv += ["--platform", platform]
+        try:
+            r = subprocess.run(argv, timeout=900, capture_output=True,
+                               text=True, env=_child_env())
+        except subprocess.TimeoutExpired:
+            return {"error": "coldstart child timed out"}
+        for line in reversed((r.stdout or "").strip().splitlines()):
+            if line.startswith("{"):
+                return json.loads(line)
+        return {"error": f"coldstart child rc={r.returncode}: {_tail(r)}"}
+
+    try:
+        no_cache = child("", False)
+        populate = child(tmp, True)  # cold cache: this boot compiles + writes
+        warm = child(tmp, True)  # warm cache: compiles hit disk
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+
+    lease_ttl_s = 10.0  # sched daemon --lease-duration default
+    value = warm.get("cold_to_first_s")
+    rec = {
+        "metric": f"coldstart_first_placement_{args.bindings}rb_x_{args.clusters}c",
+        "value": value,
+        "unit": "s",
+        "backend": backend_label,
+        "no_cache_s": no_cache.get("cold_to_first_s"),
+        "populate_s": populate.get("cold_to_first_s"),
+        "warm_cache_s": value,
+        "warm_first_round_s": warm.get("first_round_s"),
+        "warm_aot_s": warm.get("aot_s"),
+        "warm_jit_compile_seconds": warm.get("jit_compile_seconds"),
+        "warm_persistent_cache_hits": warm.get("jit_persistent_cache_hits"),
+        "lease_ttl_s": lease_ttl_s,
+        "under_lease_ttl": bool(value is not None and value < lease_ttl_s),
+    }
+    errs = [d["error"] for d in (no_cache, populate, warm) if "error" in d]
+    if errs:
+        rec["error"] = "; ".join(errs)[:300]
+    return rec
 
 
 def build_flagship_cold(seed=0, n_clusters=5000, n_bindings=10000):
@@ -794,14 +912,21 @@ CONFIGS = {
     "pipeline": (build_pipeline, "pipeline_churn_10000rb_x_5000c"),
     "whatif": (build_whatif, "whatif_16s_1000rb_x_500c"),
     "degraded": (build_degraded, "degraded_breaker_1000rb_x_500c"),
+    "coldstart": (None, None),  # subprocess-measured; see run_coldstart
     "flagship_cold": (build_flagship_cold, None),  # named after the shape
     "flagship": (build_flagship, None),  # metric name carries the shape
 }
 DEFAULT_ORDER = [
     "dup3", "static", "dynamic", "spread", "spread_skewed", "churn",
     "churn_incremental", "autoshard", "pipeline", "whatif", "degraded",
-    "flagship_cold", "flagship",
+    "coldstart", "flagship_cold", "flagship",
 ]
+
+# coldstart measures PROCESS boot, not round latency — a fixed modest shape
+# keeps the three child boots affordable on the CPU fallback while the
+# compile cost being amortized is shape-independent
+COLDSTART_BINDINGS = 2000
+COLDSTART_CLUSTERS = 1000
 
 
 # --------------------------------------------------------------------------
@@ -817,9 +942,18 @@ def add_args(ap: argparse.ArgumentParser) -> None:
     ap.add_argument("--probe-timeout", type=float, default=90.0)
     ap.add_argument("--run-timeout", type=float, default=2600.0,
                     help="total seconds for all measured child runs combined"
-                         " (11 configs now: compiles dominate the budget)")
+                         " (14 configs now: compiles dominate the budget — "
+                         "set KARMADA_TPU_COMPILE_CACHE to amortize them "
+                         "across runs)")
     ap.add_argument("--require-tpu", action="store_true")
     ap.add_argument("--inner", action="store_true", help=argparse.SUPPRESS)
+    # coldstart grandchild mode (run_coldstart_child)
+    ap.add_argument("--coldstart-child", action="store_true",
+                    help=argparse.SUPPRESS)
+    ap.add_argument("--coldstart-cache-dir", default="",
+                    help=argparse.SUPPRESS)
+    ap.add_argument("--coldstart-aot", action="store_true",
+                    help=argparse.SUPPRESS)
     # platform must be pinned via jax.config inside the child, not the
     # JAX_PLATFORMS env var (the TPU sitecustomize hangs on the env var)
     ap.add_argument("--platform", default=None, help=argparse.SUPPRESS)
@@ -880,6 +1014,9 @@ def main() -> None:
     ap = argparse.ArgumentParser()
     add_args(ap)
     args = ap.parse_args()
+    if args.coldstart_child:
+        run_coldstart_child(args)
+        return
     if args.inner:
         run_bench(args)
         return
@@ -980,6 +1117,26 @@ def run_bench(args) -> None:
     wanted = [c for c in args.configs.split(",") if c]
     lines = []
     for name in wanted:
+        if name == "coldstart":
+            import types
+
+            cs_args = types.SimpleNamespace(
+                clusters=COLDSTART_CLUSTERS, bindings=COLDSTART_BINDINGS,
+            )
+            rec = run_coldstart(cs_args, args.platform, backend)
+            if not on_tpu:
+                rec["metric"] += f"_{backend}"
+                rec["note"] = (
+                    "cpu fallback; compile amortization targets TPU — last "
+                    f"TPU capture: {latest_capture_name()}"
+                )
+            if args.verbose:
+                print(f"# coldstart: no_cache={rec.get('no_cache_s')}s "
+                      f"populate={rec.get('populate_s')}s "
+                      f"warm={rec.get('warm_cache_s')}s "
+                      f"under_ttl={rec.get('under_lease_ttl')}")
+            lines.append(json.dumps(rec))
+            continue
         build, metric_suffix = CONFIGS[name]
         t0 = time.perf_counter()
         if name in ("flagship", "flagship_cold"):

@@ -213,6 +213,25 @@ descheduler_sweeps = registry.counter(
     "Number of descheduling sweeps",
 )
 
+# compile economics (sched/compilecache.py — docs/PERF.md): every XLA
+# backend compile is a jit-cache miss (the in-memory executable caches had
+# no program for that shape); with the persistent compilation cache enabled
+# a miss may still be served from disk, which the hits counter records.
+# Buckets reach 240 s: a cold flagship-shape compile measures 157 s on TPU.
+jit_compile_seconds = registry.histogram(
+    "karmada_jit_compile_seconds",
+    "XLA backend compile wall seconds per compiled program",
+    buckets=(0.01, 0.05, 0.1, 0.5, 1.0, 5.0, 15.0, 30.0, 60.0, 120.0, 240.0),
+)
+jit_cache_misses = registry.counter(
+    "karmada_jit_cache_misses_total",
+    "XLA backend compiles (jit executable-cache misses)",
+)
+jit_persistent_cache_hits = registry.counter(
+    "karmada_jit_persistent_cache_hits_total",
+    "Compiles served from the persistent compilation cache on disk",
+)
+
 # what-if simulation plane (simulation/engine.py): `mode=batched` counts
 # vmapped [S,B,C] device launches (the acceptance metric: S scenarios must
 # cost ONE launch when they fit the memory envelope); `mode=fallback` counts

@@ -67,6 +67,40 @@ def pow2_bucket(n: int, lo: int = 2) -> int:
     return b
 
 
+def shape_bucket(n: int, lo: int = 8) -> int:
+    """Smallest pow2/1.5×pow2 lattice point >= n, switching to 1024-multiples
+    past 4096 — THE shape-bucketing rule for the batch row axis B and the
+    fleet column axis C (sched/core.py pads both to it). The 1.5× midpoints
+    cap pad waste at 25% (pure pow2 wastes up to 50%) while the lattice stays
+    small enough to bound the jit cache AND to be enumerable by the AOT
+    prewarm pass (sched/aot.py); above 4096 the 1024-step keeps waste under
+    ~2.5% where the solve volume — O(B·C) — makes pad rows wall-clock."""
+    b = lo
+    while b < n and b < 4096:
+        h = b + b // 2
+        if n <= h:
+            return h
+        b *= 2
+    if n <= b:
+        return b
+    return ((n + 1023) // 1024) * 1024
+
+
+def shape_floor(cap: int, lo: int = 8) -> int:
+    """Largest shape_bucket lattice point <= cap (never below lo) — row caps
+    floor to it so every full chunk of a chunked round hits one compiled
+    shape."""
+    if cap >= 4096:
+        return (cap // 1024) * 1024
+    b, best = lo, lo
+    while b <= cap:
+        best = b
+        if b + b // 2 <= cap:
+            best = b + b // 2
+        b *= 2
+    return best
+
+
 def uid_seed(uid: str) -> np.uint64:
     return np.frombuffer(hashlib.blake2b(uid.encode(), digest_size=8).digest(), np.uint64)[0]
 
@@ -543,6 +577,20 @@ class BatchEncoder:
 
         self._call_aff_memo = {}
         self._call_weight_memo = {}
+        # policy-table row axes pad to pow2 buckets (lo=2 so the ubiquitous
+        # one-policy and two-policy rounds share a shape): aff_masks and
+        # weight_tables are traced kernel args, and an unpadded P/W would
+        # recompile the round whenever the BATCH COMPOSITION changes — the
+        # exact churn the shape-bucket lattice exists to absorb. Pad rows
+        # are never indexed (aff_idx/weight_idx point at real rows only).
+        aff = np.stack(aff_rows) if aff_rows else np.ones((1, C), bool)
+        Pp = pow2_bucket(len(aff), lo=2)
+        if Pp > len(aff):
+            aff = np.pad(aff, [(0, Pp - len(aff)), (0, 0)])
+        wt = np.stack(weight_rows)
+        Wp = pow2_bucket(len(wt), lo=2)
+        if Wp > len(wt):
+            wt = np.pad(wt, [(0, Wp - len(wt)), (0, 0)])
         return BindingBatch(
             keys=keys,
             uids=uids,
@@ -553,9 +601,9 @@ class BatchEncoder:
             fresh=fresh,
             tol_tables=self._tol_table(),
             tol_idx=tol_idx,
-            aff_masks=np.stack(aff_rows) if aff_rows else np.ones((1, C), bool),
+            aff_masks=aff,
             aff_idx=aff_idx,
-            weight_tables=np.stack(weight_rows),
+            weight_tables=wt,
             weight_idx=weight_idx,
             prev_idx=prev_idx,
             prev_rep=prev_rep,

@@ -70,6 +70,19 @@ def main() -> None:
                     help="dedicated READ-ONLY token accepted on GET "
                          "/metrics only (the Prometheus credential no "
                          "longer needs to be the full wire token)")
+    ap.add_argument("--compile-cache-dir", default="",
+                    help="persistent XLA compilation-cache directory "
+                         "(docs/PERF.md compile economics): compiled round "
+                         "programs persist across processes, so a cold boot "
+                         "or failover re-uses every shape any previous "
+                         "process compiled. Default: KARMADA_TPU_COMPILE_"
+                         "CACHE env; 'off' disables")
+    ap.add_argument("--no-aot-prewarm", action="store_true",
+                    help="skip the standby's background AOT pass that "
+                         "compiles the round kernels over the reachable "
+                         "shape-bucket lattice (sched/aot.py); the dry-"
+                         "solve prewarm still runs. KARMADA_TPU_AOT_"
+                         "PREWARM=0 is the env equivalent; this flag wins")
     ap.add_argument("--no-pipeline", action="store_true",
                     help="disable the pipelined round executor (serial "
                          "estimate→encode→solve→materialize→patch chain; "
@@ -94,6 +107,24 @@ def main() -> None:
         jax.config.update("jax_platforms", args.platform)
 
     from .. import faults
+    from .compilecache import (
+        describe_cache,
+        enable_persistent_cache,
+        resolve_cache_dir,
+    )
+
+    # the persistent compilation cache wires BEFORE any kernel compiles so
+    # the boot's own compiles land on disk; the hit/miss state of the boot
+    # is logged loudly (enable_persistent_cache) and counted on /metrics
+    cache_dir = resolve_cache_dir(args.compile_cache_dir)
+    if cache_dir:
+        n = enable_persistent_cache(cache_dir)
+        print(describe_cache(cache_dir, n), flush=True)
+    else:
+        print("compile cache: disabled (set --compile-cache-dir or "
+              "KARMADA_TPU_COMPILE_CACHE; every process recompiles)",
+              flush=True)
+
     from ..api.coordination import LEASE_SCHEDULER
     from ..coordination.elector import Elector, default_identity
     from ..estimator.client import EstimatorRegistry, parse_estimator_flags
@@ -135,6 +166,7 @@ def main() -> None:
         store, runtime, scheduler_name=args.scheduler_name,
         estimator_registry=registry, plugins=plugins,
         pipeline=False if args.no_pipeline else None,
+        aot_prewarm=False if args.no_aot_prewarm else None,
     )
     metrics_srv = start_metrics_server(
         args.metrics_port, token=token,
@@ -153,6 +185,8 @@ def main() -> None:
     else:
         def started(token_: int) -> None:
             store.set_fence(lease_name, token_)
+            daemon.abandon_prewarm()  # the leader's first round must not
+            #   share the backend with a background compile walk
             leading.set()
             print(f"leader: {identity} acquired lease {lease_name} "
                   f"(fencing token {token_})", flush=True)

@@ -1,0 +1,266 @@
+"""AOT prewarm lattice: compile the round kernels before the first round.
+
+The standby scheduler (docs/HA.md) used to prime the jit cache with one
+tiny dry solve — which warms exactly one program shape, while a real
+takeover round dispatches chunked kernels at the shape_bucket lattice
+points the chunk planner produces (sched/pipeline.py `plan_chunk_rows`).
+On a cold fleet epoch every one of those shapes paid a fresh XLA compile
+(67–157 s per shape on TPU, BENCH_tpu_latest.json) in the middle of the
+first round after takeover.
+
+This module walks the bucket lattice REACHABLE from the current fleet
+width and AOT-compiles the partitioned round kernels for each point with
+`jit(...).lower(...).compile()` — tracing plus XLA compilation, no device
+execution, no decisions. With the persistent compilation cache enabled
+(sched/compilecache.py) the compiled programs land on disk, so:
+
+- the standby's background prewarm thread absorbs the compile cost while
+  it is NOT leading, and takeover-to-first-placement stays inside the
+  lease TTL from a genuinely cold process;
+- any later process (restart, failover, bench rerun) re-uses them — the
+  lower().compile() path and the live jit dispatch path share the same
+  cache key, so a prewarmed shape costs a disk read, not an XLA run.
+
+Shape fidelity: the kernels' table axes (affinity masks [P,C], toleration
+tables, deduped requests) depend on the batch CONTENT, so prewarming with
+a made-up batch would compile programs no real round dispatches. The
+entry point therefore takes the daemon's real binding snapshot when one
+exists (the standby has live watches — the takeover round's rows are
+already known) and encodes the round's first chunk through the live
+`BatchEncoder` (which also warms its row cache); a synthetic mixed-
+strategy template stands in only before any bindings exist. Arg shapes
+come from `ArrayScheduler.filter_kernel_args` — the same builder live
+rounds use — so prewarmed shapes cannot drift from dispatched ones.
+"""
+from __future__ import annotations
+
+import logging
+import time
+from typing import Optional, Sequence
+
+import numpy as np
+
+from ..models.batch import pow2_bucket, shape_bucket
+from .compilecache import compile_counts, compile_delta
+
+log = logging.getLogger(__name__)
+
+# compile-budget guard: prewarm is a background nicety, never a boot hog —
+# at most this many row buckets compile per pass (the persistent cache
+# makes later passes incremental anyway)
+MAX_PREWARM_SHAPES = 8
+
+
+def row_buckets_for(sched, n_hint: Optional[int] = None,
+                    max_shapes: int = MAX_PREWARM_SHAPES) -> list[int]:
+    """Padded row buckets a round on this scheduler can reach, most
+    valuable first: the equalized chunk schedule of the current working set
+    (`n_hint` bindings — the shape the takeover round will actually
+    dispatch), then a small-round ladder every boot passes through, capped
+    at the per-launch HBM row cap."""
+    C = len(sched.fleet.names)
+    if C == 0:
+        return []
+    serial_cap = sched._max_rows_per_round(C)
+    chunk_cap = min(serial_cap, sched.pipeline_chunk_rows(C))
+    from .pipeline import chunk_spans, plan_chunk_rows
+
+    pts: list[int] = []
+    if n_hint:
+        rows = plan_chunk_rows(n_hint, sched.round_chunk_rows(n_hint))
+        for s, e in chunk_spans(n_hint, rows):
+            pts.append(shape_bucket(e - s))
+    pts += [8, 256, 1024]
+    if n_hint and n_hint > chunk_cap:
+        # the chunk cap is only a REACHABLE shape when the working set
+        # actually chunks — at a small fleet the cap is millions of rows
+        # (budget // C) and compiling it would be pure waste (and, on a
+        # real chip, minutes of XLA for a program no round dispatches)
+        pts.append(chunk_cap)
+    out: list[int] = []
+    for p in pts:
+        p = min(p, serial_cap)
+        if p not in out:
+            out.append(p)
+        if len(out) >= max_shapes:
+            break
+    return out
+
+
+def _synthetic_bindings(sched) -> list:
+    """One binding per strategy class (duplicated / static-weight / dynamic
+    / aggregated) — the template when the store holds no bindings yet. The
+    encoded tables then carry one row per class, which is also what the
+    daemon's dry prewarm round encodes."""
+    from ..api.meta import ObjectMeta
+    from ..api.policy import (
+        ClusterAffinity,
+        ClusterPreferences,
+        DIVISION_PREFERENCE_AGGREGATED,
+        DIVISION_PREFERENCE_WEIGHTED,
+        DYNAMIC_WEIGHT_AVAILABLE_REPLICAS,
+        Placement,
+        REPLICA_SCHEDULING_DIVIDED,
+        REPLICA_SCHEDULING_DUPLICATED,
+        ReplicaSchedulingStrategy,
+        StaticClusterWeight,
+    )
+    from ..api.work import BindingSpec, ObjectReference, ResourceBinding
+
+    affinity = ClusterAffinity(cluster_names=[])
+    placements = [
+        Placement(
+            cluster_affinity=affinity,
+            replica_scheduling=ReplicaSchedulingStrategy(
+                replica_scheduling_type=REPLICA_SCHEDULING_DUPLICATED
+            ),
+        ),
+        Placement(
+            cluster_affinity=affinity,
+            replica_scheduling=ReplicaSchedulingStrategy(
+                replica_scheduling_type=REPLICA_SCHEDULING_DIVIDED,
+                replica_division_preference=DIVISION_PREFERENCE_WEIGHTED,
+                weight_preference=ClusterPreferences(static_weight_list=[
+                    StaticClusterWeight(
+                        target_cluster=ClusterAffinity(
+                            cluster_names=[sched.fleet.names[0]]
+                        ),
+                        weight=1,
+                    ),
+                ]),
+            ),
+        ),
+        Placement(
+            cluster_affinity=affinity,
+            replica_scheduling=ReplicaSchedulingStrategy(
+                replica_scheduling_type=REPLICA_SCHEDULING_DIVIDED,
+                replica_division_preference=DIVISION_PREFERENCE_WEIGHTED,
+                weight_preference=ClusterPreferences(
+                    dynamic_weight=DYNAMIC_WEIGHT_AVAILABLE_REPLICAS
+                ),
+            ),
+        ),
+        Placement(
+            cluster_affinity=affinity,
+            replica_scheduling=ReplicaSchedulingStrategy(
+                replica_scheduling_type=REPLICA_SCHEDULING_DIVIDED,
+                replica_division_preference=DIVISION_PREFERENCE_AGGREGATED,
+            ),
+        ),
+    ]
+    return [
+        ResourceBinding(
+            metadata=ObjectMeta(name=f"__aot-prewarm-{i}",
+                                uid=f"aot-prewarm-{i}"),
+            spec=BindingSpec(
+                resource=ObjectReference(
+                    api_version="apps/v1", kind="Deployment",
+                    namespace="default", name=f"__aot-prewarm-{i}",
+                ),
+                replicas=2,
+                placement=p,
+            ),
+        )
+        for i, p in enumerate(placements)
+    ]
+
+
+def prewarm_schedule(
+    sched,
+    bindings: Optional[Sequence] = None,
+    with_extra: bool = False,
+    max_shapes: int = MAX_PREWARM_SHAPES,
+    stop=None,
+) -> dict:
+    """AOT-lower+compile the partitioned round kernels over the reachable
+    row-bucket lattice at the current fleet width. `bindings`: the live
+    working set (shape hint AND encode template); `with_extra`: also
+    compile the dense estimator-answer variant (registered estimators make
+    rounds carry an i32[B,C] extra matrix, a different program shape);
+    `stop`: optional threading.Event checked between shapes so a standby
+    promoted mid-prewarm abandons the pass immediately. Returns a stats
+    dict (shapes compiled, compile seconds, persistent-cache hits)."""
+    import jax
+
+    from .core import _filter_kernel_compact, _tail_kernel, pad_batch
+
+    t0 = time.perf_counter()
+    bindings = list(bindings or [])
+    buckets = row_buckets_for(sched, len(bindings) or None, max_shapes)
+    snap = compile_counts()
+    stats = {"row_buckets": [], "aot_seconds": 0.0, **compile_delta(snap)}
+    if not buckets:
+        return stats
+    if not bindings:
+        bindings = _synthetic_bindings(sched)
+    C = len(sched.fleet.names)
+    for b in buckets:
+        if stop is not None and stop.is_set():
+            break
+        rows = list(bindings[:b])  # the table shapes a real b-row chunk of
+        #   this working set would encode (matching the live encode exactly)
+        with sched._encode_lock:
+            raw = sched.batch_encoder.encode(rows)
+        batch = pad_batch(raw, lambda n, _b=b: _b)
+        # per-SLICE, exactly as _launch_once_partitioned derives it for the
+        # chunk it dispatches — a whole-set bound could compile tail
+        # programs no live chunk uses. (This and the class-split/topk
+        # derivation below intentionally mirror the launch half; keep them
+        # in sync with core._launch_once_partitioned.)
+        narrow16 = C < 2**15 and int(raw.replicas.max(initial=0)) < 2**15
+        extra = np.full((b, C), -1, np.int32) if with_extra else None
+        args = sched.filter_kernel_args(batch, extra)
+        _filter_kernel_compact.lower(
+            *args, plugin_bits=sched._plugin_bits
+        ).compile()
+        stats["row_buckets"].append(b)
+        if sched._host_sorts:
+            # cpu backend: the division tails run as the numpy host twins —
+            # there is no tail program to compile
+            continue
+        # division-tail shapes: gathered row subsets bucket by class count;
+        # compute the template's class split exactly as the launch half does
+        pre_b, _pre_cfg, pre_fb = sched._classify_spread(rows)
+        spread_set = set(pre_b) | set(pre_fb)
+        cls = [
+            sched._row_class(rb, i in spread_set) for i, rb in enumerate(rows)
+        ]
+        shapes = jax.eval_shape(
+            lambda *a: _filter_kernel_compact(
+                *a, plugin_bits=sched._plugin_bits
+            ),
+            *args,
+        )
+        sd_feas, _sd_score, sd_avail, sd_prev, sd_tie, _sd_fc = shapes
+        for want_cls, has_agg in ((1, False), (2, True)):
+            n_cls = sum(1 for c in cls if c == want_cls)
+            if not n_cls:
+                continue
+            sp = sched._bucket(n_cls)
+            max_repl = max(
+                (rb.spec.replicas for i, rb in enumerate(rows)
+                 if cls[i] == want_cls),
+                default=1,
+            )
+            from .core import TOPK_TARGETS
+
+            topk = min(
+                pow2_bucket(min(max(max_repl, 1), TOPK_TARGETS), lo=8),
+                TOPK_TARGETS,
+            )
+            row2d = lambda sd, n: jax.ShapeDtypeStruct((n, C), sd.dtype)
+            _, narrow, _ = sched._batch_flags(batch)
+            _tail_kernel.lower(
+                row2d(sd_feas, sp), row2d(sd_avail, sp),
+                row2d(sd_prev, sp), row2d(sd_tie, sp),
+                batch.weight_tables,
+                jax.ShapeDtypeStruct((sp,), batch.weight_idx.dtype),
+                jax.ShapeDtypeStruct((sp,), batch.strategy.dtype),
+                jax.ShapeDtypeStruct((sp,), batch.replicas.dtype),
+                jax.ShapeDtypeStruct((sp,), batch.fresh.dtype),
+                topk=topk, narrow=narrow, has_agg=has_agg,
+                narrow16=narrow16,
+            ).compile()
+    stats.update(compile_delta(snap))
+    stats["aot_seconds"] = round(time.perf_counter() - t0, 3)
+    return stats

@@ -1,0 +1,181 @@
+"""Persistent XLA compilation cache + compile observability.
+
+BENCH_tpu_latest.json shows compile time dominating real deployments: every
+new (B, C) shape pays 67–157 s of warm time before its first round, so the
+"takeover within one lease TTL" claim (docs/HA.md) only held once XLA was
+warm. Three mechanisms make compilation a boot-time, cached, shape-stable
+cost instead of a per-fleet-epoch one:
+
+1. **Shape bucketing** (models/batch.py `shape_bucket`) bounds the set of
+   program shapes a deployment can reach — see sched/core.py.
+2. **The persistent compilation cache** (this module): JAX's disk cache,
+   keyed under the daemon's data dir, so a cold PROCESS re-uses every
+   program any previous process compiled. `enable_persistent_cache` wires
+   `jax_compilation_cache_dir` with thresholds dropped to zero (the round
+   kernels are exactly the programs worth persisting) and reports the
+   entry count loudly at boot.
+3. **AOT prewarm** (sched/aot.py) walks the reachable bucket lattice at
+   boot/standby time and `lower(...).compile()`s the round kernels, so the
+   disk cache is populated BEFORE the first real round.
+
+Observability: `install_compile_listeners()` hooks `jax.monitoring` —
+every XLA backend compile observes `karmada_jit_compile_seconds` and
+increments `karmada_jit_cache_misses_total`; compiles served from the disk
+cache increment `karmada_jit_persistent_cache_hits_total`. All three ride
+`/metrics`, and the scheduler daemon folds the per-round deltas into
+`ArrayScheduler.last_round_stats`.
+"""
+from __future__ import annotations
+
+import logging
+import os
+import threading
+from typing import Optional
+
+from ..metrics import (
+    jit_cache_misses,
+    jit_compile_seconds,
+    jit_persistent_cache_hits,
+)
+
+log = logging.getLogger(__name__)
+
+ENV_COMPILE_CACHE = "KARMADA_TPU_COMPILE_CACHE"
+
+# jax.monitoring event names (stable across the 0.4.x line this image
+# bakes): the duration event fires once per actual XLA backend compile —
+# not on executable-cache or persistent-cache hits — and the hit event
+# fires when the persistent cache served a program from disk.
+_COMPILE_EVENT = "/jax/core/compile/backend_compile_duration"
+_COMPILE_EVENT_LEGACY = "/jax/core/compile/backend_compile_time"
+_CACHE_HIT_EVENT = "/jax/compilation_cache/cache_hits"
+
+_install_lock = threading.Lock()
+_installed = False
+
+
+def install_compile_listeners() -> None:
+    """Register the jax.monitoring listeners feeding the compile metrics.
+    Idempotent and cheap — ArrayScheduler installs it at construction so
+    every entry point (daemons, tests, bench) gets compile observability
+    without its own wiring."""
+    global _installed
+    with _install_lock:
+        if _installed:
+            return
+        _installed = True
+    from jax._src import monitoring
+
+    def _on_duration(event: str, duration: float, **_kw) -> None:
+        if event == _COMPILE_EVENT or event == _COMPILE_EVENT_LEGACY:
+            jit_compile_seconds.observe(duration)
+            jit_cache_misses.inc()
+
+    def _on_event(event: str, **_kw) -> None:
+        if event == _CACHE_HIT_EVENT:
+            jit_persistent_cache_hits.inc()
+
+    monitoring.register_event_duration_secs_listener(_on_duration)
+    monitoring.register_event_listener(_on_event)
+
+
+def compile_counts() -> dict:
+    """Snapshot of the compile counters — callers diff two snapshots to
+    attribute compiles/seconds/disk-hits to one round or prewarm pass."""
+    return {
+        "jit_compiles": int(jit_cache_misses.total()),
+        "jit_compile_seconds": round(jit_compile_seconds.sum(), 6),
+        "jit_persistent_cache_hits": int(jit_persistent_cache_hits.total()),
+    }
+
+
+def compile_delta(before: dict, after: Optional[dict] = None) -> dict:
+    if after is None:
+        after = compile_counts()
+    return {
+        k: round(after[k] - before[k], 6) if isinstance(after[k], float)
+        else after[k] - before[k]
+        for k in before
+    }
+
+
+def resolve_cache_dir(
+    flag: str = "", data_dir: str = "", env: Optional[dict] = None
+) -> str:
+    """Cache-location precedence shared by every daemon: explicit
+    --compile-cache-dir flag > KARMADA_TPU_COMPILE_CACHE env > a
+    `compile-cache/` subdir of --data-dir when one is configured > disabled
+    (empty string). `off`/`none`/`0`/`false` as the flag or env disables
+    even when a data dir exists (`false` included so the token every
+    sibling KARMADA_TPU_* switch accepts cannot create a cache directory
+    literally named ./false)."""
+    env = os.environ if env is None else env
+    for val in (flag, env.get(ENV_COMPILE_CACHE, "")):
+        if val in ("off", "none", "0", "false"):
+            return ""
+        if val:
+            return val
+    if data_dir:
+        return os.path.join(data_dir, "compile-cache")
+    return ""
+
+
+def enable_persistent_cache(path: str) -> int:
+    """Point JAX's persistent compilation cache at `path` (created if
+    missing) and return the number of cached programs already there. The
+    size/time thresholds drop to zero: the schedule-round kernels are
+    exactly the programs worth persisting, and the sub-millisecond helper
+    jits around them are noise either way. Also installs the compile
+    listeners so the boot log's hit/miss claim is backed by counters."""
+    import jax
+
+    install_compile_listeners()
+    os.makedirs(path, exist_ok=True)
+    jax.config.update("jax_compilation_cache_dir", path)
+    jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+    # the cache object initializes lazily on first compile and then pins its
+    # decision — a process that already compiled something (tests, a late
+    # enable) must drop that state or the new dir is silently ignored
+    from jax._src import compilation_cache
+
+    compilation_cache.reset_cache()
+    n = cache_entries(path)
+    # loud by design: whether a boot is riding warm programs is the first
+    # thing to check when takeover latency looks wrong. describe_cache is
+    # the SINGLE wording source — the daemons print the same line to stdout.
+    log.warning("%s", describe_cache(path, n))
+    return n
+
+
+def describe_cache(path: str, n: int) -> str:
+    """The canonical one-line boot report for a cache dir with n cached
+    programs — shared by the library log and every daemon's stdout print so
+    the wording cannot drift."""
+    state = (
+        "warm boot, compiles hit disk" if n
+        else "cold boot, this process compiles"
+    )
+    return f"compile cache: {path} ({n} cached programs — {state})"
+
+
+def disable_persistent_cache() -> None:
+    """Detach the persistent cache (tests restore global state with this)."""
+    import jax
+
+    jax.config.update("jax_compilation_cache_dir", None)
+    from jax._src import compilation_cache
+
+    compilation_cache.reset_cache()
+
+
+def cache_entries(path: str) -> int:
+    """Number of cached programs under a cache dir (best-effort)."""
+    try:
+        names = os.listdir(path)
+    except OSError:
+        return 0
+    cached = [f for f in names if f.endswith("-cache")]
+    if cached:  # this jax line writes <key>-cache + <key>-atime pairs
+        return len(cached)
+    return sum(1 for f in names if not f.endswith("-atime"))

@@ -26,6 +26,8 @@ from ..api.work import TargetCluster
 from ..models.batch import (
     AGGREGATED,
     pow2_bucket,
+    shape_bucket,
+    shape_floor,
     BatchEncoder,
     BindingBatch,
     DUPLICATED,
@@ -41,6 +43,7 @@ from .pipeline import (
     ChunkPipeline,
     StageTimer,
     chunk_spans,
+    plan_chunk_rows,
     resolve_pipeline,
     stage_span,
 )
@@ -722,6 +725,7 @@ class ArrayScheduler:
         plugin_registry=None,
         autoshard: Optional[bool] = None,
         pipeline: Optional[bool] = None,
+        bucket_cols: bool = True,
     ):
         """`mesh`: optional jax.sharding.Mesh — the solve runs column/row-
         sharded over it (parallel/mesh.py) with identical outputs.
@@ -734,8 +738,18 @@ class ArrayScheduler:
         `pipeline`: chunked rounds run as the software pipeline
         (sched/pipeline.py — encode/solve/materialize overlapped across
         chunks, bit-identical decisions); default on,
-        KARMADA_TPU_PIPELINE=0 disables (the serial row-chunk executor)."""
+        KARMADA_TPU_PIPELINE=0 disables (the serial row-chunk executor).
+        `bucket_cols`: pad the fleet axis C to the shape_bucket lattice
+        with dead pad clusters (never Ready ⇒ never feasible ⇒ never
+        decoded) so fleet growth inside a bucket re-uses compiled programs
+        instead of triggering fresh XLA compiles; decisions are
+        bit-identical to the exact-width solve (tests/test_bucketing.py).
+        False restores exact fleet width (the parity-suite reference)."""
+        from .compilecache import install_compile_listeners
+
+        install_compile_listeners()
         self.encoder = encoder or FleetEncoder()
+        self.bucket_cols = bucket_cols
         self.mesh = mesh
         self._mesh_kernel = None
         self.plugin_registry = plugin_registry or plugin_mod.PluginRegistry()
@@ -799,6 +813,9 @@ class ArrayScheduler:
         self.fleet_epoch = 0
         self._decision_cache: dict[str, object] = {}
         self.last_round_stats = {"replayed": 0, "solved": 0}
+        # compile delta of the last schedule() round (compile economics):
+        # jit_compiles / jit_compile_seconds / jit_persistent_cache_hits
+        self.last_compile_stats: dict = {}
         self.set_clusters(clusters)
 
     @contextmanager
@@ -830,19 +847,20 @@ class ArrayScheduler:
         if dirty_names and self._update_dirty_columns(clusters, dirty_names):
             return
         self.n_real_clusters = len(clusters)
-        if self.mesh is not None:
-            # pad the fleet to a mesh-divisible width with DEAD clusters
-            # (never Ready ⇒ never feasible ⇒ never decoded): every derived
-            # table — batch policy tables, region layout, device tensors —
-            # then sizes consistently, and sharded device_put is legal
+        pad = self._fleet_width(len(clusters)) - len(clusters)
+        if pad > 0:
+            # pad the fleet to the bucketed (and, under a mesh, mesh-
+            # divisible) width with DEAD clusters (never Ready ⇒ never
+            # feasible ⇒ never decoded): every derived table — batch policy
+            # tables, region layout, device tensors — sizes consistently,
+            # sharded device_put stays legal, and fleet growth INSIDE a
+            # bucket re-uses every compiled program (the compile-economics
+            # tentpole, docs/PERF.md; parity pinned by tests/test_bucketing)
             from ..api.cluster import Cluster, ClusterSpec
             from ..api.meta import ObjectMeta
-            from ..parallel.mesh import AXIS_CLUSTERS
 
-            mesh_c = self.mesh.shape[AXIS_CLUSTERS]
-            pad = (-len(clusters)) % mesh_c
             clusters += [
-                Cluster(metadata=ObjectMeta(name=f"__mesh-pad-{i}"),
+                Cluster(metadata=ObjectMeta(name=f"__shape-pad-{i}"),
                         spec=ClusterSpec())
                 for i in range(pad)
             ]
@@ -888,6 +906,22 @@ class ArrayScheduler:
             )
         )
 
+    def _fleet_width(self, n_real: int) -> int:
+        """Padded fleet width for n_real clusters: the shape_bucket lattice
+        point (so cluster add/remove inside a bucket keeps every program
+        shape), rounded up to mesh divisibility when a mesh is placed. An
+        empty fleet stays empty — there is nothing to schedule against and
+        padding it would only fake a nonzero C."""
+        if n_real == 0:
+            return 0
+        width = shape_bucket(n_real) if self.bucket_cols else n_real
+        if self.mesh is not None:
+            from ..parallel.mesh import AXIS_CLUSTERS
+
+            mesh_c = self.mesh.shape[AXIS_CLUSTERS]
+            width += (-width) % mesh_c
+        return width
+
     def _place_fleet_sharded(self) -> None:
         """Place the (cluster-padded) fleet COLUMN-SHARDED over the mesh;
         the partitioned round runs the single-chip kernels on it and GSPMD
@@ -929,8 +963,9 @@ class ArrayScheduler:
         cluster list, batch encoder kept alive — and only the device
         placement differs: the refreshed tensors re-place sharded instead of
         row-scattering into donated buffers."""
-        # under a mesh self.clusters carries dead pad clusters at the tail;
-        # the caller's list never does, so compare against the real prefix
+        # self.clusters carries dead shape-pad clusters at the tail (bucketed
+        # fleet width); the caller's list never does, so compare against the
+        # real prefix
         old = self.clusters[: self.n_real_clusters]
         if len(clusters) != len(old):
             return False
@@ -949,7 +984,7 @@ class ArrayScheduler:
                 idx.append(i)
         if not idx:
             return True  # spurious dirt: nothing to re-encode
-        # keep the mesh pad clusters (never dirty: they are synthetic)
+        # keep the shape/mesh pad clusters (never dirty: they are synthetic)
         clusters = clusters + self.clusters[len(clusters):]
         fleet = self.encoder.encode_cols(self.fleet, clusters, idx)
         if fleet is None:
@@ -1001,14 +1036,9 @@ class ArrayScheduler:
 
     @staticmethod
     def _floor_rows(cap: int) -> int:
-        """Floor a row cap to a _bucket boundary so every full chunk hits
-        one compiled shape."""
-        if cap >= 2048:
-            return (cap // 2048) * 2048
-        b = 8
-        while b * 2 <= cap:
-            b *= 2
-        return b
+        """Floor a row cap to a _bucket lattice boundary so every full
+        chunk hits one compiled shape."""
+        return shape_floor(max(cap, 8))
 
     def pipeline_chunk_rows(self, n_cols: int) -> int:
         """Per-chunk row cap when the pipeline drives a chunked round: HALF
@@ -1039,17 +1069,12 @@ class ArrayScheduler:
         )
         return max(8, min(cap, target))
 
-    @staticmethod
-    def _bucket(n: int) -> int:
-        """Power-of-two buckets up to 2048, then 2048-multiples: bounds the
-        jit cache while capping pad waste at large B (10k pads to 10240, not
-        16384 — the solve is O(B·C), so pad waste is wall-clock waste)."""
-        b = 8
-        while b < n and b < 2048:
-            b *= 2
-        if n <= b:
-            return b
-        return ((n + 2047) // 2048) * 2048
+    # THE row-axis bucketing rule: the pow2/1.5× lattice (then 1024-steps
+    # past 4096) bounds the jit cache while capping pad waste — the solve is
+    # O(B·C), so pad rows are wall-clock waste — and keeps the reachable
+    # shape set small enough for the AOT prewarm pass to enumerate
+    # (sched/aot.py). Shared with the column axis via _fleet_width.
+    _bucket = staticmethod(shape_bucket)
 
     def _pad(self, batch: BindingBatch) -> BindingBatch:
         return pad_batch(batch, self._bucket)
@@ -1117,6 +1142,26 @@ class ArrayScheduler:
             cand = max(cand, int(pc[batch.aff_idx[dup]].max(initial=0)))
         topk = pow2_bucket(min(cand, TOPK_TARGETS), lo=8)
         return min(topk, TOPK_TARGETS), narrow, has_agg
+
+    def filter_kernel_args(
+        self, batch: BindingBatch, extra_avail=None,
+        extra_mask=None, extra_score=None,
+    ) -> tuple:
+        """Positional args of `_filter_kernel_compact` for one padded batch
+        — the SINGLE builder shared by the round launch and the AOT prewarm
+        pass (sched/aot.py), so prewarmed program shapes can never drift
+        from what live rounds dispatch."""
+        return (
+            *self._fleet_dev,
+            batch.replicas, batch.unknown_request, batch.gvk,
+            batch.tol_tables, batch.tol_idx,
+            batch.aff_masks, batch.aff_idx,
+            batch.prev_idx, batch.prev_rep, batch.evict_idx, batch.seeds,
+            batch.req_unique, batch.req_idx,
+            self._NO_EXTRA if extra_avail is None else extra_avail,
+            self._NO_MASK if extra_mask is None else extra_mask,
+            self._NO_SCORE if extra_score is None else extra_score,
+        )
 
     def run_kernel(
         self, batch: BindingBatch, extra_avail=None,
@@ -1315,6 +1360,12 @@ class ArrayScheduler:
         self.last_round_stats = {
             "replayed": len(bindings) - len(dirty_pos),
             "solved": len(dirty_pos),
+            # compile attribution of the dirty-row solve (all-replay rounds
+            # by definition compiled nothing)
+            **(self.last_compile_stats if dirty_pos else {
+                "jit_compiles": 0, "jit_compile_seconds": 0.0,
+                "jit_persistent_cache_hits": 0,
+            }),
         }
         if self.last_pipeline_stats:
             # the dirty-row solve ran chunked: surface its stage/overlap
@@ -1384,13 +1435,26 @@ class ArrayScheduler:
         decisions bit-identical to the serial row-chunk executor."""
         if not bindings:
             return []
+        from .compilecache import compile_counts, compile_delta
+
         bindings = list(bindings)
         self.last_pipeline_stats = None
-        self._maybe_autoshard(len(bindings))
-        max_rows = self._max_rows_per_round(len(self.fleet.names))
-        if len(bindings) > max_rows:
-            return self._schedule_chunked(bindings, extra_avail, max_rows)
-        return self._materialize_solve(self._launch_solve(bindings, extra_avail))
+        snap = compile_counts()
+        try:
+            self._maybe_autoshard(len(bindings))
+            max_rows = self._max_rows_per_round(len(self.fleet.names))
+            if len(bindings) > max_rows:
+                return self._schedule_chunked(bindings, extra_avail, max_rows)
+            return self._materialize_solve(
+                self._launch_solve(bindings, extra_avail)
+            )
+        finally:
+            # compile attribution per round: a steady-state round on the
+            # bucket lattice must show jit_compiles == 0 here (pinned by
+            # tests/test_bucketing.py)
+            self.last_compile_stats = compile_delta(snap)
+            if self.last_pipeline_stats is not None:
+                self.last_pipeline_stats.update(self.last_compile_stats)
 
     @staticmethod
     def _affinity_terms_of(rb):
@@ -1458,11 +1522,15 @@ class ArrayScheduler:
         be stateful — their rounds run the chunks serially (same chunking,
         no thread overlap), exactly as they disable decision replay."""
         pipelined = self.pipeline_enabled and not self._oot_plugins
-        rows = (
+        cap = (
             min(max_rows, self.pipeline_chunk_rows(len(self.fleet.names)))
             if pipelined
             else max_rows
         )
+        # equalized chunk-size schedule: same chunk count as the greedy
+        # cap-sized split, but equal lattice-snapped chunks — never more
+        # program shapes than greedy, usually one (docs/PERF.md)
+        rows = plan_chunk_rows(len(bindings), cap)
         spans = chunk_spans(len(bindings), rows)
         chunks = [
             (
@@ -1649,14 +1717,9 @@ class ArrayScheduler:
         with stage_span("solve", timer):
             dev_feasible, dev_score, dev_avail, dev_prev, dev_tie, dev_fc = (
                 _filter_kernel_compact(
-                    *self._fleet_dev,
-                    batch.replicas, batch.unknown_request,
-                    batch.gvk, batch.tol_tables, batch.tol_idx,
-                    batch.aff_masks, batch.aff_idx,
-                    batch.prev_idx, batch.prev_rep, batch.evict_idx, batch.seeds,
-                    batch.req_unique, batch.req_idx,
-                    self._NO_EXTRA if extra_avail is None else extra_avail,
-                    extra_mask, extra_score,
+                    *self.filter_kernel_args(
+                        batch, extra_avail, extra_mask, extra_score
+                    ),
                     plugin_bits=self._plugin_bits,
                 )
             )

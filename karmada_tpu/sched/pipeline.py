@@ -333,3 +333,30 @@ def chunk_spans(total: int, rows: int) -> list[tuple[int, int]]:
     """[start, end) spans chunking `total` rows at `rows` per chunk."""
     rows = max(1, rows)
     return [(s, min(s + rows, total)) for s in range(0, total, rows)]
+
+
+def plan_chunk_rows(total: int, cap: int) -> int:
+    """Equalized chunk-size schedule: the rows-per-chunk that splits `total`
+    into the same number of chunks a greedy cap-sized split would, but with
+    EQUAL chunks snapped to the shape_bucket lattice. The greedy schedule
+    (cap, cap, ..., remainder) wastes twice — the ragged tail pads to its
+    own (different) bucket, compiling a second program per kernel, and the
+    full chunks may sit just above a lattice point, padding maximally. At
+    the 40k×20k flagship the greedy split is 12288×3 + 3136 (two compiled
+    shapes, 3.1k pad rows); the equalized split is 10240×4 — one shape,
+    960 pad rows (the profiled chunk-size half of the HBM-chunking fix,
+    docs/PERF.md compile economics).
+
+    The guarantee is "never more program shapes than the greedy split,
+    usually one" — NOT always one: when the tail chunk falls below the
+    rows bucket's predecessor lattice point (e.g. total=2100, cap=2048 →
+    1536 + 564, buckets {1536, 768}), the round still pads two shapes;
+    both are on the lattice, so they amortize across rounds either way."""
+    from ..models.batch import shape_bucket
+
+    cap = max(1, cap)
+    if total <= cap:
+        return cap
+    n_chunks = -(-total // cap)
+    rows = shape_bucket(-(-total // n_chunks))
+    return min(rows, cap)

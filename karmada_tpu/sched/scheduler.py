@@ -64,6 +64,8 @@ class SchedulerDaemon:
         plugins=None,  # the --plugins list: "*" / "foo" / "-foo"
         plugin_registry=None,  # out-of-tree plugins (WithOutOfTreeRegistry)
         pipeline=None,  # pipelined round executor (None = KARMADA_TPU_PIPELINE)
+        aot_prewarm=None,  # AOT bucket-lattice prewarm on the standby
+        #   (sched/aot.py); None = KARMADA_TPU_AOT_PREWARM env (default on)
     ) -> None:
         self.store = store
         self.clock = runtime.clock
@@ -92,6 +94,25 @@ class SchedulerDaemon:
         self._array: Optional[ArrayScheduler] = None
         self._fleet_dirty = True
         self._prewarmed_epoch = -1
+        if aot_prewarm is None:
+            import os
+
+            aot_prewarm = os.environ.get("KARMADA_TPU_AOT_PREWARM", "") not in (
+                "0", "off", "false",
+            )
+        self.aot_prewarm = bool(aot_prewarm)
+        import threading as _threading
+
+        self._aot_epoch = -1  # fleet epoch the last AOT pass covered
+        self._aot_thread = None
+        self._aot_stop = None  # threading.Event: promotion abandons the pass
+        # start/abandon run on different threads (standby idle loop vs the
+        # elector callback); the lock makes stop-event assignment and the
+        # suspension flag atomic with thread start, so a promotion landing
+        # mid-_start_aot can never race a fresh pass into a leading daemon
+        self._aot_lock = _threading.Lock()
+        self._prewarm_suspended = False
+        self.last_prewarm_stats: dict = {}
         # names of clusters MODIFIED since the last fleet encode; None means
         # the membership changed (add/delete) and the next encode must be a
         # full rebuild instead of the dirty-column scatter
@@ -195,18 +216,36 @@ class SchedulerDaemon:
                 self._array.set_clusters(clusters, dirty_names=dirty)
         return self._array
 
-    def prewarm(self) -> None:
+    def prewarm(self, wait_aot: bool = False) -> None:
         """Hot-standby warmth (coordination plane): build the fleet encoders
         and prime the solve's jit cache with a throwaway dry round, so a
         standby promoted on leader death takes over within the lease TTL
         instead of paying encoder + compile cold-start. Idempotent per fleet
         epoch — cheap to call from the standby's idle loop; cluster churn
-        (which bumps the epoch via the watch handlers) re-warms."""
+        (which bumps the epoch via the watch handlers) re-warms.
+
+        Beyond the dry solve, a background thread AOT-compiles the round
+        kernels over the bucket lattice reachable from the current fleet
+        width (sched/aot.py), using the store's LIVE binding snapshot as
+        the shape template — the takeover round's chunks then hit compiled
+        (and, with the persistent cache, disk-resident) programs instead of
+        paying 67–157 s of XLA mid-round. `wait_aot` blocks until the pass
+        finishes (tests and explicit boot warming); the idle loop never
+        waits. `abandon_prewarm()` stops a pass on promotion; calling
+        prewarm again (the standby loop after losing leadership) lifts the
+        suspension."""
+        with self._aot_lock:
+            self._prewarm_suspended = False
         try:
             array = self._ensure_fleet()
             if not array.fleet.names:
                 return  # nothing to encode against yet
             if self._prewarmed_epoch == array.fleet_epoch:
+                # dry solve already warm for this epoch — but the AOT pass
+                # has its own lifecycle (it may have been abandoned on
+                # promotion, or still cover a stale epoch) and must get its
+                # chance every standby tick
+                self._start_aot(array, wait=wait_aot)
                 return
             self._prewarmed_epoch = array.fleet_epoch
             from ..api.meta import ObjectMeta
@@ -232,10 +271,101 @@ class SchedulerDaemon:
             # plain schedule(), NOT schedule_incremental: the dry decision
             # must never enter the replay cache
             array.schedule([dry])
+            self._start_aot(array, wait=wait_aot)
         except Exception:  # noqa: BLE001 - warmth is best-effort
             import logging
 
             logging.getLogger(__name__).exception("standby prewarm")
+
+    def _start_aot(self, array: ArrayScheduler, wait: bool = False) -> None:
+        """Kick (or join) the AOT bucket-lattice pass for the current fleet
+        epoch. One pass per epoch; runs on a daemon thread so the standby's
+        idle loop keeps renewing its election heartbeat while XLA works."""
+        if not self.aot_prewarm:
+            return
+        import threading
+
+        with self._aot_lock:
+            t = self._aot_thread
+            if t is not None and t.is_alive():
+                if (self._aot_epoch != array.fleet_epoch
+                        and self._aot_stop is not None):
+                    # the running pass covers a stale fleet epoch: wind it
+                    # down; the NEXT prewarm tick starts the fresh-epoch pass
+                    # (cheap — the persistent cache makes re-walked shapes
+                    # disk hits)
+                    self._aot_stop.set()
+            else:
+                t = None
+        if t is not None:
+            if not wait:
+                return
+            t.join()
+        # snapshot the live working set NOW (watches keep it current): the
+        # takeover round's rows — and therefore its encoded table shapes —
+        # are exactly these
+        bindings = [
+            rb for rb in self.store.list("ResourceBinding")
+            if rb.metadata.deletion_timestamp is None
+            and not rb.spec.scheduling_suspended()
+        ]
+        with self._aot_lock:
+            if self._prewarm_suspended:
+                return  # promoted while we were snapshotting: do not start
+            if self._aot_thread is not None and self._aot_thread.is_alive():
+                return
+            if self._aot_epoch == array.fleet_epoch:
+                return
+            self._aot_epoch = array.fleet_epoch
+            epoch = array.fleet_epoch
+            stop = threading.Event()
+            self._aot_stop = stop
+
+        def run() -> None:
+            import logging
+
+            from .aot import prewarm_schedule
+
+            try:
+                stats = prewarm_schedule(
+                    array, bindings,
+                    with_extra=self.estimator_registry is not None,
+                    stop=stop,
+                )
+                self.last_prewarm_stats = {"epoch": epoch, **stats}
+                # loud by design (docs/HA.md): whether takeover rides warm
+                # programs is the first thing to check when it looks slow
+                logging.getLogger(__name__).warning(
+                    "aot prewarm: epoch %d row buckets %s — %d XLA compiles "
+                    "(%.1fs), %d persistent-cache hits",
+                    epoch, stats.get("row_buckets"),
+                    stats.get("jit_compiles", 0),
+                    stats.get("jit_compile_seconds", 0.0),
+                    stats.get("jit_persistent_cache_hits", 0),
+                )
+            except Exception:  # noqa: BLE001 - warmth is best-effort
+                logging.getLogger(__name__).exception("aot prewarm")
+
+        t = threading.Thread(target=run, name="sched-aot-prewarm", daemon=True)
+        self._aot_thread = t
+        t.start()
+        if wait:
+            t.join()
+
+    def abandon_prewarm(self) -> None:
+        """Promotion hook: stop an in-flight AOT pass — the new leader's
+        first round must not share the backend with a background compile
+        walk (the pass resumes, persistent-cache-incremental, next time the
+        process stands by). The stop is polled between shapes: a single
+        in-flight XLA compile cannot be aborted mid-program, so at most one
+        shape's compile drains after promotion (and its compile-counter
+        delta can then leak into the first leader round's process-global
+        attribution — see _schedule_batch)."""
+        with self._aot_lock:
+            self._prewarm_suspended = True
+            if self._aot_stop is not None:
+                self._aot_stop.set()
+            self._aot_epoch = -1  # re-arm for the next standby period
 
     def _schedule_batch(self, keys: list[str]) -> list[str]:
         bindings = []
@@ -257,9 +387,13 @@ class SchedulerDaemon:
         if not bindings:
             return []
         from ..tracing import Trace
-        from .pipeline import ChunkPipeline, StageTimer, chunk_spans
+        from .compilecache import compile_counts, compile_delta
+        from .pipeline import (
+            ChunkPipeline, StageTimer, chunk_spans, plan_chunk_rows,
+        )
 
         trace = Trace("Scheduling", {"bindings": len(bindings)})
+        compile_snap = compile_counts()
         with timed(e2e_scheduling_duration):
             array = self._ensure_fleet()
             trace.step("Fleet snapshot ready")
@@ -277,7 +411,11 @@ class SchedulerDaemon:
             # round first — chunked launches must see the same backend the
             # serial executor would.
             array._maybe_autoshard(len(bindings))
-            rows = array.round_chunk_rows(len(bindings))
+            # equalized chunk-size schedule: lattice-snapped equal chunks —
+            # never more program shapes than the greedy split, usually one
+            rows = plan_chunk_rows(
+                len(bindings), array.round_chunk_rows(len(bindings))
+            )
             chunks = [
                 bindings[s:e] for s, e in chunk_spans(len(bindings), rows)
             ]
@@ -356,6 +494,16 @@ class SchedulerDaemon:
             stats = pipe.stats()
             stats["chunks"] = len(chunks)
             stats["chunk_rows"] = rows
+            # compile economics: a steady-state round on the bucket lattice
+            # shows jit_compiles == 0; anything else here is a shape the
+            # prewarm lattice (or the persistent cache) should have covered.
+            # Attribution is PROCESS-global (the jax.monitoring hook cannot
+            # see threads): a concurrent compile — e.g. an abandoned AOT
+            # pass draining its last uninterruptible shape right after
+            # takeover, or a second in-process scheduler — can leak into
+            # one round's delta; treat a lone nonzero round next to a
+            # takeover as that, a RECURRING nonzero as a real bucket miss
+            stats.update(compile_delta(compile_snap))
             array.last_round_stats = {**totals, **stats}
             trace.step("Pipelined round done (estimate/encode/solve/"
                        "materialize/patch)")

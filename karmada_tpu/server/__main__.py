@@ -33,6 +33,12 @@ def main() -> None:
     ap.add_argument("--data-dir", default="",
                     help="persist store state (snapshot + WAL) here and "
                          "restore it on start; empty = in-memory only")
+    ap.add_argument("--compile-cache-dir", default="",
+                    help="persistent XLA compilation-cache directory "
+                         "(docs/PERF.md compile economics). Default: "
+                         "KARMADA_TPU_COMPILE_CACHE env, else "
+                         "<data-dir>/compile-cache when --data-dir is set; "
+                         "'off' disables")
     ap.add_argument("--tls-dir", default="",
                     help="serve HTTPS with material from this directory "
                          "(ca.pem/server.pem/server.key; generated via the "
@@ -108,7 +114,20 @@ def main() -> None:
     from ..api.meta import CPU, MEMORY
     from ..controlplane import ControlPlane
     from ..members.member import MemberConfig
+    from ..sched.compilecache import (
+        describe_cache,
+        enable_persistent_cache,
+        resolve_cache_dir,
+    )
     from .apiserver import ControlPlaneServer
+
+    # compile cache keyed under the data dir: an in-process scheduler
+    # controller (--controllers "*") compiles the same round kernels the
+    # standalone daemon does, and a restarted server must re-use them
+    cache_dir = resolve_cache_dir(args.compile_cache_dir, args.data_dir)
+    if cache_dir:
+        n = enable_persistent_cache(cache_dir)
+        print(describe_cache(cache_dir, n), flush=True)
 
     # env-gated chaos plan (KARMADA_TPU_FAULT_PLAN, docs/ROBUSTNESS.md):
     # install at boot so a malformed plan aborts instead of running clean

@@ -98,26 +98,31 @@ def post(conn: http.client.HTTPConnection, path: str, body: dict) -> dict:
     return json.loads(data)
 
 
-def _die_cleanly(conn, srv, metric: str, err: str) -> None:
-    """A timed-out (or transport-failed) measurement must still produce one
-    JSON line and must NOT take the process down with SIGABRT: a handler
-    thread may be wedged mid-device-call, and normal interpreter exit then
-    trips the TPU runtime's thread teardown ('FATAL: exception not
-    rethrown', rc=-6 in BENCH_tpu_latest.json). Tear the HTTP plumbing down
-    first, then leave via os._exit so the wedged daemon thread is never
-    cancelled under the runtime's feet."""
+def _exit_hard(code: int) -> None:
+    """Leave via os._exit with streams flushed, NEVER via interpreter
+    teardown: a shim handler thread may be wedged mid-device-call, and both
+    normal exit and any poke at the HTTP plumbing (connection close, server
+    shutdown) then trip the TPU runtime's thread teardown ('terminate
+    called…', 'FATAL: exception not rethrown', rc=-6 — the standing
+    BENCH_tpu_latest.json capture failure). The round-1 fix took the
+    os._exit path only AFTER conn.close()+srv.stop(); the committed rc=-6
+    capture shows the abort fires inside that teardown itself, so neither
+    exit path may touch the plumbing at all. The OS reclaims sockets and
+    threads; the JSON contract only needs stdout flushed."""
     import os
 
-    print(json.dumps({"metric": metric, "value": None, "unit": "s",
-                      "error": err[:300]}))
-    try:
-        conn.close()
-        srv.stop()
-    except Exception:  # noqa: BLE001 - already on the failure path
-        pass
     sys.stdout.flush()
     sys.stderr.flush()
-    os._exit(1)
+    os._exit(code)
+
+
+def _die_cleanly(conn, srv, metric: str, err: str) -> None:
+    """A timed-out (or transport-failed) measurement must still produce one
+    JSON line and must NOT take the process down with SIGABRT — print the
+    line and leave hard (see _exit_hard)."""
+    print(json.dumps({"metric": metric, "value": None, "unit": "s",
+                      "error": err[:300]}))
+    _exit_hard(1)
 
 
 def main() -> None:
@@ -235,12 +240,13 @@ def main() -> None:
                   f"(x{args.batch} sequential would be "
                   f"{per * args.batch:.1f}s vs batch {p50:.2f}s)")
     except Exception as e:  # noqa: BLE001 - ANY measurement failure
-        # (timeout, BadStatusLine, assertion...) must take the clean-
-        # teardown path, or the wedged handler thread aborts the exit
+        # (timeout, BadStatusLine, assertion...) must take the hard-exit
+        # path, or the wedged handler thread aborts the exit
         _die_cleanly(conn, srv, metric, f"{type(e).__name__}: {e}")
 
-    conn.close()
-    srv.stop()
+    # success leaves hard too: rc=0 must not depend on the TPU runtime
+    # surviving interpreter teardown with shim handler threads still live
+    _exit_hard(0)
 
 
 if __name__ == "__main__":

@@ -102,6 +102,10 @@ def main() -> None:
         ["--configs", "flagship", "--bindings", "40000",
          "--clusters", "20000", "--iters", "3", "--run-timeout", "1500"],
         1600))
+    # compile economics: cold-process-to-first-placement with/without the
+    # persistent cache + AOT prewarm (three cold child boots per run)
+    artifact["runs"].append(run_bench(
+        ["--configs", "coldstart", "--run-timeout", "2000"], 2100))
     # the Go-interop seam: /v1/scheduleBatch latency at flagship scale
     artifact["runs"].append(run_script(
         "scripts/bench_shim.py",

@@ -1,0 +1,417 @@
+"""Compile economics: shape-bucketed padding parity + compile counting.
+
+The fleet axis C pads to the shape_bucket lattice with dead pad clusters
+and the batch axis B pads to the same lattice (sched/core.py), so fleet
+growth and binding churn INSIDE a bucket re-use every compiled program.
+This suite pins the two claims that make that sound:
+
+1. **Bit-identical decisions**: bucket-padded solves equal exact-shape
+   solves (`ArrayScheduler(bucket_cols=False)` is the exact-width
+   reference) across mixed strategies, spread constraints, churn, the
+   mesh/autoshard path, incremental replay, and degraded (stale-column)
+   estimator rounds.
+2. **Zero new compiles inside a bucket**: a second round at a different
+   (B, C) inside the same buckets triggers no XLA compile, asserted via
+   the `karmada_jit_cache_misses_total` counter the jax.monitoring hook
+   feeds (sched/compilecache.py).
+
+Plus the persistent-cache and AOT-prewarm plumbing: compiles served from
+disk count as `karmada_jit_persistent_cache_hits_total`, and an AOT pass
+(sched/aot.py) populates the cache so a cleared process re-uses it.
+"""
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+import jax
+
+from karmada_tpu.api.policy import SpreadConstraint
+from karmada_tpu.models.batch import shape_bucket, shape_floor
+from karmada_tpu.parallel import make_mesh
+from karmada_tpu.sched import compilecache
+from karmada_tpu.sched.core import ArrayScheduler
+from karmada_tpu.sched.pipeline import chunk_spans, plan_chunk_rows
+from karmada_tpu.testing.fixtures import synthetic_fleet
+from tests.test_incremental import assert_same_decisions, mixed_bindings
+from tests.test_parallel import dyn_placement, make_binding
+
+
+# ---------------------------------------------------------------------------
+# lattice unit tests
+# ---------------------------------------------------------------------------
+
+
+def test_shape_bucket_lattice():
+    assert shape_bucket(1) == 8
+    assert shape_bucket(8) == 8
+    assert shape_bucket(9) == 12
+    assert shape_bucket(13) == 16
+    assert shape_bucket(100) == 128
+    assert shape_bucket(1500) == 1536
+    assert shape_bucket(3000) == 3072
+    assert shape_bucket(4096) == 4096
+    # past 4096: 1024-steps (pad waste stays ~2.5% where O(B·C) hurts)
+    assert shape_bucket(5000) == 5120
+    assert shape_bucket(10000) == 10240
+    assert shape_bucket(20000) == 20480
+    assert shape_bucket(40000) == 40960
+    for n in range(1, 6000, 7):
+        b = shape_bucket(n)
+        assert b >= n
+        assert shape_bucket(b) == b  # lattice points are fixpoints
+        assert b <= 2 * n or n < 8  # bounded pad waste
+
+
+def test_shape_floor():
+    assert shape_floor(8) == 8
+    assert shape_floor(100) == 96
+    assert shape_floor(2048) == 2048
+    assert shape_floor(12288) == 12288
+    assert shape_floor(13421) == 13312
+    for cap in range(8, 6000, 11):
+        f = shape_floor(cap)
+        assert f <= cap
+        assert shape_bucket(f) == f  # floors land on the lattice
+
+
+def test_plan_chunk_rows_equalizes():
+    # the 40k×20k flagship schedule: greedy was 12288×3 + 3136 (two
+    # compiled shapes); equalized is 10240×4 — one shape, fewer pad rows
+    rows = plan_chunk_rows(40000, 12288)
+    assert rows == 10240
+    spans = chunk_spans(40000, rows)
+    assert len(spans) == 4
+    assert {shape_bucket(e - s) for s, e in spans} == {10240}
+    # under-cap rounds stay one chunk
+    assert plan_chunk_rows(100, 12288) == 12288
+    # never exceeds the cap
+    assert plan_chunk_rows(10**6, 6144) <= 6144
+
+
+# ---------------------------------------------------------------------------
+# padding parity: bucket-padded == exact-shape, bit for bit
+# ---------------------------------------------------------------------------
+
+
+def spread_placement(min_groups=2):
+    p = dyn_placement()
+    p.spread_constraints = [
+        SpreadConstraint(spread_by_field="region", min_groups=min_groups)
+    ]
+    return p
+
+
+def parity_bindings(names):
+    bindings = mixed_bindings(names)
+    bindings += [
+        make_binding(f"spread-{i}", 6 + i, spread_placement(2 + i % 2),
+                     cpu=0.25)
+        for i in range(4)
+    ]
+    return bindings
+
+
+@pytest.fixture()
+def fleet():
+    clusters = synthetic_fleet(19, seed=5)  # pads to width 24
+    return clusters, [c.name for c in clusters]
+
+
+def test_fleet_pads_to_lattice(fleet):
+    clusters, _ = fleet
+    padded = ArrayScheduler(clusters)
+    exact = ArrayScheduler(clusters, bucket_cols=False)
+    assert padded.n_real_clusters == 19
+    assert len(padded.fleet.names) == 24
+    assert len(exact.fleet.names) == 19
+    # pad clusters are dead: never Ready, never feasible
+    assert not padded.fleet.alive[19:].any()
+
+
+def test_parity_single_chip(fleet):
+    clusters, names = fleet
+    bindings = parity_bindings(names)
+    padded = ArrayScheduler(clusters)
+    exact = ArrayScheduler(clusters, bucket_cols=False)
+    got = padded.schedule(bindings)
+    want = exact.schedule(bindings)
+    assert_same_decisions(got, want)
+    # feasible sets never leak pad cluster names
+    for d in got:
+        assert all(not n.startswith("__shape-pad") for n in d.feasible)
+
+
+def test_parity_across_churn(fleet):
+    """Cluster status churn (the dirty-column path) and membership growth
+    WITHIN the bucket: the padded scheduler keeps its program shapes, the
+    exact one re-encodes — decisions stay bit-identical throughout."""
+    import copy
+
+    clusters, names = fleet
+    bindings = parity_bindings(names)
+    padded = ArrayScheduler(clusters)
+    exact = ArrayScheduler(clusters, bucket_cols=False)
+    assert_same_decisions(padded.schedule(bindings), exact.schedule(bindings))
+
+    # status churn on two clusters (dirty-column fast path)
+    churned = [copy.deepcopy(c) for c in clusters]
+    for c in churned[:2]:
+        rs = c.status.resource_summary
+        if rs is not None:
+            rs.allocated["cpu"] = rs.allocated.get("cpu", 0.0) + 8.0
+    padded.set_clusters(churned, dirty_names={churned[0].name, churned[1].name})
+    exact.set_clusters(churned, dirty_names={churned[0].name, churned[1].name})
+    assert_same_decisions(padded.schedule(bindings), exact.schedule(bindings))
+
+    # membership growth inside the bucket (19 -> 21, width stays 24)
+    grown = churned + synthetic_fleet(23, seed=11)[19:21]
+    padded.set_clusters(grown)
+    exact.set_clusters(grown)
+    assert len(padded.fleet.names) == 24
+    assert_same_decisions(padded.schedule(bindings), exact.schedule(bindings))
+
+
+def test_parity_mesh(fleet):
+    clusters, names = fleet
+    bindings = parity_bindings(names)
+    mesh = make_mesh(jax.devices())
+    padded = ArrayScheduler(clusters, mesh=mesh)
+    exact = ArrayScheduler(clusters, mesh=mesh, bucket_cols=False)
+    # bucketed width is also mesh-divisible
+    from karmada_tpu.parallel.mesh import AXIS_CLUSTERS
+
+    assert len(padded.fleet.names) % mesh.shape[AXIS_CLUSTERS] == 0
+    assert_same_decisions(padded.schedule(bindings), exact.schedule(bindings))
+
+
+def test_parity_autoshard(fleet):
+    """Oversized rounds re-place the fleet on a mesh (autoshard): the
+    bucketed width must survive the re-placement with identical decisions."""
+    clusters, names = fleet
+    bindings = parity_bindings(names)
+    padded = ArrayScheduler(clusters)
+    exact = ArrayScheduler(clusters, bucket_cols=False)
+    padded.max_bc_elems = 16  # force the oversized classification
+    exact.max_bc_elems = 16
+    got = padded.schedule(bindings)
+    want = exact.schedule(bindings)
+    assert padded.mesh is not None  # engaged (conftest provides 8 devices)
+    assert_same_decisions(got, want)
+
+
+def test_parity_incremental_replay(fleet):
+    """Replay must engage identically on the padded scheduler (estimator
+    digests hash the caller's [B, C_real] matrix before padding) and the
+    replayed decisions must equal an exact-shape cold solve."""
+    clusters, names = fleet
+    bindings = parity_bindings(names)
+    B = len(bindings)
+    extra = np.full((B, 19), 40, np.int32)
+    padded = ArrayScheduler(clusters)
+    exact = ArrayScheduler(clusters, bucket_cols=False)
+    padded.schedule_incremental(bindings, extra_avail=extra)
+    got = padded.schedule_incremental(bindings, extra_avail=extra)
+    assert padded.last_round_stats["replayed"] == B
+    assert padded.last_round_stats["jit_compiles"] == 0
+    want = exact.schedule(bindings, extra_avail=extra)
+    assert_same_decisions(got, want)
+
+
+def test_parity_degraded_columns(fleet):
+    """Degraded rounds serve breaker-open members' columns as age-penalized
+    stale answers inside extra_avail (faults/staleness.py) — pure array
+    over the same channel, so parity must hold with a mix of live, stale
+    (penalized), and discarded (-1) columns."""
+    clusters, names = fleet
+    bindings = parity_bindings(names)
+    B = len(bindings)
+    rng = np.random.default_rng(3)
+    extra = rng.integers(0, 50, size=(B, 19)).astype(np.int32)
+    extra[:, 4] = np.maximum(extra[:, 4] >> 3, 0)  # stale: age-penalized
+    extra[:, 7] = -1  # discarded column
+    padded = ArrayScheduler(clusters)
+    exact = ArrayScheduler(clusters, bucket_cols=False)
+    assert_same_decisions(
+        padded.schedule(bindings, extra_avail=extra),
+        exact.schedule(bindings, extra_avail=extra),
+    )
+
+
+def test_parity_chunked_pipeline(fleet):
+    """The pipelined chunked executor over a bucket-padded fleet: chunk
+    planning + padding must compose with bit-identical decisions."""
+    clusters, names = fleet
+    bindings = parity_bindings(names) * 3  # 54 rows
+    padded = ArrayScheduler(clusters, autoshard=False)
+    exact = ArrayScheduler(clusters, bucket_cols=False, autoshard=False)
+    padded.max_bc_elems = 16 * len(padded.fleet.names)  # force chunking
+    exact.max_bc_elems = 16 * len(exact.fleet.names)
+    got = padded.schedule(bindings)
+    want = exact.schedule(bindings)
+    assert padded.last_pipeline_stats["chunks"] > 1
+    assert_same_decisions(got, want)
+
+
+# ---------------------------------------------------------------------------
+# compile counting: zero new compiles inside a bucket
+# ---------------------------------------------------------------------------
+
+
+def test_same_bucket_shape_change_zero_compiles():
+    clusters = synthetic_fleet(13, seed=2)
+    sched = ArrayScheduler(clusters)
+    bindings = [
+        make_binding(f"a{i}", 3, dyn_placement(), cpu=0.5) for i in range(13)
+    ]
+    sched.schedule(bindings)  # warm round: compiles the bucket's programs
+
+    # fleet grows 13 -> 15 (width bucket 16 unchanged) AND the round grows
+    # B 13 -> 15 (row bucket 16 unchanged): zero new XLA compiles
+    grown = clusters + synthetic_fleet(16, seed=9)[13:15]
+    sched.set_clusters(grown)
+    bindings2 = bindings + [
+        make_binding(f"b{i}", 3, dyn_placement(), cpu=0.5) for i in range(2)
+    ]
+    snap = compilecache.compile_counts()
+    decisions = sched.schedule(bindings2)
+    delta = compilecache.compile_delta(snap)
+    assert delta["jit_compiles"] == 0, delta
+    assert sched.last_compile_stats["jit_compiles"] == 0
+    assert sum(d.ok for d in decisions) == len(bindings2)
+    # and the zero-compile round still solved against the GROWN fleet
+    # (bit-identical to an exact-width cold solve over it)
+    exact = ArrayScheduler(grown, bucket_cols=False)
+    assert_same_decisions(decisions, exact.schedule(bindings2))
+
+
+def test_round_stats_carry_compile_keys():
+    clusters = synthetic_fleet(9, seed=4)
+    sched = ArrayScheduler(clusters)
+    bindings = [
+        make_binding(f"c{i}", 2, dyn_placement(), cpu=0.25) for i in range(4)
+    ]
+    sched.schedule_incremental(bindings)
+    stats = sched.last_round_stats
+    for key in ("jit_compiles", "jit_compile_seconds",
+                "jit_persistent_cache_hits"):
+        assert key in stats
+    # a first-ever shape must have compiled something and metered it
+    assert compilecache.compile_counts()["jit_compiles"] > 0
+
+
+def test_compile_metrics_on_metrics_endpoint():
+    from karmada_tpu.metrics import registry
+
+    text = registry.render()
+    assert "karmada_jit_compile_seconds" in text
+    assert "karmada_jit_cache_misses_total" in text
+
+
+# ---------------------------------------------------------------------------
+# persistent cache + AOT prewarm
+# ---------------------------------------------------------------------------
+
+
+def test_resolve_cache_dir_precedence():
+    env: dict = {}
+    # flag > env > data-dir default > disabled
+    assert compilecache.resolve_cache_dir("/x", "/d", env) == "/x"
+    assert compilecache.resolve_cache_dir(
+        "", "/d", {"KARMADA_TPU_COMPILE_CACHE": "/e"}
+    ) == "/e"
+    assert compilecache.resolve_cache_dir("", "/d", env).endswith(
+        "compile-cache"
+    )
+    assert compilecache.resolve_cache_dir("", "", env) == ""
+    # explicit off beats the data-dir default
+    assert compilecache.resolve_cache_dir("off", "/d", env) == ""
+    assert compilecache.resolve_cache_dir(
+        "", "/d", {"KARMADA_TPU_COMPILE_CACHE": "off"}
+    ) == ""
+
+
+@pytest.fixture()
+def cache_dir(tmp_path):
+    path = str(tmp_path / "compile-cache")
+    compilecache.enable_persistent_cache(path)
+    try:
+        yield path
+    finally:
+        compilecache.disable_persistent_cache()
+
+
+def test_persistent_cache_serves_cleared_process(cache_dir):
+    """In-process stand-in for a process restart: compile, drop every
+    in-memory executable cache (jax.clear_caches), re-dispatch — the
+    programs must come back from disk (persistent hits), not XLA."""
+    clusters = synthetic_fleet(11, seed=7)
+    sched = ArrayScheduler(clusters)
+    bindings = [
+        make_binding(f"p{i}", 3, dyn_placement(), cpu=0.5) for i in range(6)
+    ]
+    # earlier tests may have compiled these shapes already (in-memory);
+    # drop them so this round compiles and WRITES the fresh cache dir
+    jax.clear_caches()
+    want = sched.schedule(bindings)
+    assert compilecache.cache_entries(cache_dir) > 0
+    jax.clear_caches()
+    snap = compilecache.compile_counts()
+    got = sched.schedule(bindings)
+    delta = compilecache.compile_delta(snap)
+    assert delta["jit_persistent_cache_hits"] > 0, delta
+    assert_same_decisions(got, want)
+
+
+def test_aot_prewarm_populates_cache_for_real_round(cache_dir):
+    """The standby's AOT pass must compile the shapes the real round will
+    dispatch: prewarm with the live binding snapshot, clear the in-memory
+    caches (the takeover process analogue), then run the round — its
+    filter-kernel program must be a disk hit."""
+    from karmada_tpu.sched.aot import prewarm_schedule
+
+    clusters = synthetic_fleet(11, seed=8)
+    sched = ArrayScheduler(clusters)
+    bindings = [
+        make_binding(f"q{i}", 3, dyn_placement(), cpu=0.5) for i in range(9)
+    ]
+    stats = prewarm_schedule(sched, bindings)
+    assert stats["row_buckets"], stats
+    assert stats["jit_compiles"] > 0
+    jax.clear_caches()
+    snap = compilecache.compile_counts()
+    decisions = sched.schedule(bindings)
+    delta = compilecache.compile_delta(snap)
+    assert delta["jit_persistent_cache_hits"] > 0, delta
+    assert sum(d.ok for d in decisions) == len(bindings)
+
+
+def test_daemon_prewarm_runs_aot(cache_dir):
+    """SchedulerDaemon.prewarm(wait_aot=True) runs the lattice pass for the
+    current fleet epoch exactly once, records stats, and abandon_prewarm
+    re-arms it for the next standby period."""
+    from karmada_tpu.runtime.controller import Runtime
+    from karmada_tpu.sched.scheduler import SchedulerDaemon
+    from karmada_tpu.store.store import Store
+
+    store = Store()
+    for c in synthetic_fleet(7, seed=6):
+        store.create(c)
+    for i in range(5):
+        store.create(make_binding(f"d{i}", 2, dyn_placement(), cpu=0.25))
+    daemon = SchedulerDaemon(store, Runtime(), aot_prewarm=True)
+    daemon.prewarm(wait_aot=True)
+    assert daemon.last_prewarm_stats.get("row_buckets"), (
+        daemon.last_prewarm_stats
+    )
+    epoch = daemon.last_prewarm_stats["epoch"]
+    # idempotent per epoch: a second call must not start a new pass
+    daemon.prewarm(wait_aot=True)
+    assert daemon.last_prewarm_stats["epoch"] == epoch
+    daemon.abandon_prewarm()
+    assert daemon._aot_epoch == -1  # re-armed
+    # back on standby at the SAME fleet epoch: the pass must re-run (the
+    # dry-solve epoch gate must not swallow it) — persistent-cache hits
+    # make the re-walk cheap
+    daemon.prewarm(wait_aot=True)
+    assert daemon._aot_epoch == epoch

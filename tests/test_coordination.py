@@ -571,7 +571,7 @@ class TestSchedulerFailoverParity:
             def standby_warm() -> bool:
                 b.drive()
                 arr = b.daemon._array
-                return arr is not None and len(arr.fleet.names) == 3
+                return arr is not None and arr.n_real_clusters == 3
             assert wait_until(standby_warm, timeout=30.0), (
                 "standby never built its fleet encoders"
             )

@@ -532,7 +532,7 @@ class TestHBMChunking:
         s = ArrayScheduler(clusters)
         s.max_bc_elems = 2048 * 3 * 8  # cap 6144 rows at C=8
         assert s._max_rows_per_round(8) == 6144
-        s.max_bc_elems = 100 * 8  # cap 100 -> pow2 floor 64
-        assert s._max_rows_per_round(8) == 64
+        s.max_bc_elems = 100 * 8  # cap 100 -> lattice floor 96 (1.5 x 64)
+        assert s._max_rows_per_round(8) == 96
         s.max_bc_elems = 1  # degenerate: never below 8
         assert s._max_rows_per_round(8) == 8
