@@ -17,6 +17,10 @@ encode, device solve, decision decode — not just the kernel.
 | spread_skewed | 4b: same round on a skewed fleet (one mega region + 30 tiny |
 |               |    ones) — the r3 verdict's missing hard case               |
 | churn         | 5: steady-state reschedule replay, 5k x 10k with prev state |
+| stream        | streaming scheduler: the churn volume as a sustained RATE   |
+|               |    (800 bindings/s) against a live daemon topology; per-    |
+|               |    binding arrival→patch latency percentiles, streaming vs  |
+|               |    the fixed-interval batch-round loop, max sustained rate  |
 | whatif        | simulation plane: S=16 drain/loss/capacity scenarios over a |
 |               |    churn fleet as ONE vmapped [S,B,C] solve; reports         |
 |               |    per-scenario amortized time vs S sequential solves        |
@@ -36,6 +40,7 @@ import os
 import subprocess
 import sys
 import time
+from contextlib import contextmanager
 
 import numpy as np
 
@@ -354,7 +359,14 @@ def build_churn(seed=0, n_clusters=5000, n_bindings=10000):
 
     rng = np.random.default_rng(seed)
     clusters = synthetic_fleet(n_clusters, seed=seed)
-    names = [c.name for c in clusters]
+    bindings = _churn_bindings(rng, [c.name for c in clusters], n_bindings)
+    return ArrayScheduler(clusters), bindings, None
+
+
+def _churn_bindings(rng, names, n_bindings):
+    """The churn working set (shared with the `stream` config): bindings
+    with previous placements across Steady/Fresh division modes."""
+    n_clusters = len(names)
     bindings = []
     for i in range(n_bindings):
         prev_n = int(rng.integers(1, 5))
@@ -380,7 +392,7 @@ def build_churn(seed=0, n_clusters=5000, n_bindings=10000):
             rb.spec.reschedule_triggered_at = 2.0
             rb.status.last_scheduled_time = 1.0
         bindings.append(rb)
-    return ArrayScheduler(clusters), bindings, None
+    return bindings
 
 
 def build_flagship(seed=0, n_clusters=5000, n_bindings=10000):
@@ -881,6 +893,464 @@ def run_coldstart(args, platform, backend_label: str) -> dict:
     return rec
 
 
+# --------------------------------------------------------------------------
+# `stream` config: the streaming admission service under a sustained churn
+# RATE (docs/PERF.md "Streaming scheduler"). Unlike every other config this
+# does not time rounds — it drives bindings/sec against a live daemon
+# topology (store + watches + scheduler) and reports per-binding
+# arrival→patch placement-latency percentiles, for BOTH execution models:
+# the streaming admission loop and the pre-streaming fixed-interval
+# batch-round drain loop, over the IDENTICAL seeded update schedule.
+# --------------------------------------------------------------------------
+
+STREAM_CLUSTERS = 5000
+STREAM_BINDINGS = 10000  # the BENCH_r05 churn volume
+STREAM_WINDOW_S = 12.5
+STREAM_RATE_HZ = 800.0  # x window = the churn volume as a sustained rate
+STREAM_BATCH_INTERVAL_S = 0.2  # the old daemon's fixed drain tick
+
+
+class _ArrivalWatch:
+    """Arrival→patch latency per binding, measured at the store boundary
+    (identically for both legs): the driver `mark()`s a key the moment it
+    writes the dirtying update; the watch sees the scheduler's patch land
+    (observed generation caught up) and records the delta."""
+
+    def __init__(self, store):
+        import threading
+
+        self._lock = threading.Lock()
+        self._arrivals: dict[str, float] = {}
+        self._placed: set[str] = set()
+        self.latencies: list[float] = []
+        store.watch("ResourceBinding", self._on_event, replay=False)
+
+    def mark(self, key: str) -> None:
+        with self._lock:
+            self._arrivals[key] = time.perf_counter()
+
+    def pending(self) -> int:
+        with self._lock:
+            return len(self._arrivals)
+
+    def placed_count(self) -> int:
+        """Distinct bindings the scheduler has patched at least once — the
+        initial-placement warm barrier (queue length is NOT one: a batch
+        round drains the queue the moment it STARTS solving)."""
+        with self._lock:
+            return len(self._placed)
+
+    def _on_event(self, event, rb) -> None:
+        if event == "DELETED":
+            return
+        if rb.status.scheduler_observed_generation != rb.metadata.generation:
+            return  # not the scheduler's patch (e.g. the dirtying write)
+        if not rb.spec.clusters:
+            return
+        key = rb.metadata.key()
+        with self._lock:
+            self._placed.add(key)
+            t0 = self._arrivals.pop(key, None)
+            if t0 is not None:
+                self.latencies.append(time.perf_counter() - t0)
+
+
+def _stream_topology(seed, n_clusters, n_bindings):
+    from karmada_tpu.runtime.controller import Runtime
+    from karmada_tpu.sched.scheduler import SchedulerDaemon
+    from karmada_tpu.store.store import Store
+    from karmada_tpu.testing.fixtures import synthetic_fleet
+
+    clusters = synthetic_fleet(n_clusters, seed=seed)
+    rng = np.random.default_rng(seed)
+    bindings = _churn_bindings(rng, [c.name for c in clusters], n_bindings)
+    for i, rb in enumerate(bindings):
+        # deterministic uids: the tie-break is UID-seeded, and _binding's
+        # new_uid() is a process-global counter — the two legs' pools must
+        # carry IDENTICAL uids or the bit-parity check compares different
+        # tie-break seeds, not different executors
+        rb.metadata.uid = f"bench-stream-{i}"
+    store = Store()
+    for c in clusters:
+        store.create(c)
+    for rb in bindings:
+        store.create(rb)
+    runtime = Runtime()
+    daemon = SchedulerDaemon(store, runtime)
+    return store, runtime, daemon
+
+
+def _stream_schedule(seed, n_bindings, n_events):
+    """The seeded update schedule both legs replay verbatim: (binding
+    index, replica delta) pairs, round-robin so a binding's consecutive
+    updates are a full pool apart (its placement chain is identical in
+    both legs as long as each update solves before the next — which the
+    drain between phases guarantees)."""
+    rng = np.random.default_rng(seed + 77)
+    deltas = rng.integers(-2, 4, size=n_events)
+    return [(j % n_bindings, int(deltas[j]) or 1) for j in range(n_events)]
+
+
+def _stream_drive(store, watch, schedule, rate_hz, ns="bench"):
+    """Apply the update schedule at the target rate (absolute-time paced;
+    falls behind honestly on a slow host). Returns the ACHIEVED rate."""
+    t0 = time.perf_counter()
+    for j, (idx, delta) in enumerate(schedule):
+        target = t0 + j / rate_hz
+        now = time.perf_counter()
+        if target > now:
+            time.sleep(target - now)
+        rb = store.get("ResourceBinding", f"app-{idx}", ns)
+        rb.spec.replicas = max(1, rb.spec.replicas + delta)
+        watch.mark(rb.metadata.key())
+        store.update(rb)
+    wall = time.perf_counter() - t0
+    return len(schedule) / wall if wall > 0 else 0.0
+
+
+def _stream_wait_drain(watch, grace_s=30.0) -> bool:
+    deadline = time.monotonic() + grace_s
+    while time.monotonic() < deadline:
+        if watch.pending() == 0:
+            return True
+        time.sleep(0.02)
+    return False
+
+
+def _quiesce_stream(svc, grace_s=60.0) -> bool:
+    """Wait until the streaming service has genuinely settled: queue empty
+    AND every admitted binding accounted for at the patch stage. The watch
+    drain alone is not enough under overload — a mid-flight staleness
+    discard re-admits its binding, so placements keep converging after the
+    last MARKED arrival was patched; snapshotting parity early would
+    compare a still-moving store."""
+    deadline = time.monotonic() + grace_s
+    while time.monotonic() < deadline:
+        s = svc.stats_snapshot()
+        if svc._ready() == 0 and s["formed"] == s["batches"]:
+            return True
+        time.sleep(0.02)
+    return False
+
+
+def _quiesce_batch(daemon, interval_s, grace_s=60.0) -> bool:
+    """Batch-leg analogue: the queue must read empty across a full drain
+    tick (settle() drains the queue the moment a round STARTS solving, so
+    one empty reading can be mid-round)."""
+    deadline = time.monotonic() + grace_s
+    while time.monotonic() < deadline:
+        if len(daemon.controller.queue) == 0:
+            time.sleep(interval_s + 0.05)
+            if len(daemon.controller.queue) == 0:
+                return True
+        time.sleep(0.02)
+    return False
+
+
+def _percentiles(lat):
+    if not lat:
+        return {"p50_s": None, "p95_s": None, "p99_s": None, "n": 0}
+    s = sorted(lat)
+
+    def q(p):
+        return round(s[min(len(s) - 1, int(np.ceil(p * len(s))) - 1)], 6)
+
+    return {"p50_s": q(0.50), "p95_s": q(0.95), "p99_s": q(0.99),
+            "n": len(s)}
+
+
+def _prime_hwm(store, daemon):
+    """One whole-pool encode pass plus a synthetic WIDE-placement row:
+    sets the batch encoder's content-axis high-water marks (prev/evict
+    widths, policy-table rows) so later micro-batches — arbitrary queue
+    slices — cannot flip those table shapes mid-window (models/batch.py).
+
+    The pool maximum alone is NOT enough: replica growth across a long
+    measured window widens placements (prev width ≈ replicas for divided
+    bindings), and the first binding to cross the warm-time pow2 bucket
+    flips Kp — which recompiles EVERY warmed row bucket at 2-3 s/shape on
+    XLA:CPU, a mid-window stall that snowballs the backlog into yet more
+    unwarmed shapes. The synthetic row pins Kp at the ceiling replica
+    growth can actually reach, making the flip impossible by
+    construction."""
+    import copy as _copy
+
+    snap = store.list("ResourceBinding")
+    array = daemon._ensure_fleet()
+    _, ObjectMeta, _, _, _, _, _, _, TargetCluster = _api()
+    names = [c.metadata.name for c in store.list("Cluster")]
+    kmax = min(
+        len(names),
+        max(64, 2 * max((rb.spec.replicas or 1) for rb in snap)),
+    )
+    wide = _copy.deepcopy(snap[0])
+    wide.metadata = ObjectMeta(
+        namespace=wide.metadata.namespace, name="__hwm-probe",
+        uid="bench-hwm-probe",
+    )
+    wide.spec.clusters = [
+        TargetCluster(name=n, replicas=1) for n in names[:kmax]
+    ]
+    with array._encode_lock:
+        array.batch_encoder.encode(snap + [wide])
+    return snap
+
+
+def _warm_lattice(snap, daemon, cap):
+    """Compile-warm every row-bucket lattice point a leg's rounds can
+    reach (≤ `cap`), with the primed table shapes: throwaway schedule()
+    calls over pool slices — no store writes, no replay-cache entries.
+    The measured window is then steady state by construction instead of
+    paying XLA mid-window for whatever round size the backlog happened
+    to produce (2-3 s per shape on XLA:CPU, minutes on TPU)."""
+    from karmada_tpu.sched.aot import MICROBATCH_LADDER
+
+    array = daemon._ensure_fleet()
+    sizes = [b for b in (*MICROBATCH_LADDER, 384, 512, 768, 1024, 1536)
+             if b <= min(cap, len(snap))]
+    for b in sizes:
+        array.schedule(snap[:b])
+
+
+def _final_placements(store):
+    return {
+        rb.metadata.key(): tuple(
+            sorted((t.name, t.replicas) for t in (rb.spec.clusters or []))
+        )
+        for rb in store.list("ResourceBinding")
+    }
+
+
+@contextmanager
+def _gc_quiesced():
+    """Latency-measurement hygiene, applied identically to BOTH legs'
+    measured windows: collect once, then freeze the long-lived heap
+    (store + fleet + jit caches) and disable the cyclic collector — a
+    gen2 sweep over the warm heap is a ~200 ms stop-the-world pause that
+    would land squarely in the percentile tail and measure the Python GC,
+    not the admission model. Refcounting still reclaims the drive loop's
+    (acyclic) per-event garbage; the collector re-enables after the
+    window."""
+    import gc
+
+    gc.collect()
+    gc.freeze()
+    gc.disable()
+    try:
+        yield
+    finally:
+        gc.enable()
+        gc.unfreeze()
+
+
+def run_stream(args, backend_label: str, verbose=False) -> dict:
+    """The `stream` config. Phases, per leg:
+
+    streaming leg — initial placement through the admission service (warm:
+    compiles the reachable buckets), the measured window (the churn volume
+    as a sustained rate; steady-state compile accounting over its second
+    half), then a rate RAMP (2x, 4x) probing the max sustainable rate;
+    batch leg — same topology and the same seeded schedule against the
+    pre-streaming `settle(); sleep(interval)` loop.
+
+    The JSON line reports both legs' arrival→patch percentiles, the
+    streaming:batch p99 ratio, the bit-parity of the two legs' final
+    placements, and the steady-state jit-compile count (the zero
+    assertion)."""
+    from karmada_tpu.sched import core as core_mod
+
+    seed = 0
+    n_clusters, n_bindings = args.clusters, args.bindings
+    rate_hz, window_s = args.rate_hz, args.window_s
+
+    # cpu fallback: route every division tail through the numpy host twins
+    # in BOTH legs. The device tail kernel's shape is the CLASS-count
+    # bucket — with admission-sized rounds that axis wobbles per round and
+    # each flip is an XLA:CPU compile, which would measure compile churn,
+    # not admission models (no-op on TPU: _host_sorts is already off)
+    prev_tail_thresh = core_mod.HOST_TAIL_MIN_ELEMS
+    core_mod.HOST_TAIL_MIN_ELEMS = 0
+    try:
+        return _run_stream_inner(args, backend_label, verbose, seed,
+                                 n_clusters, n_bindings, rate_hz, window_s)
+    finally:
+        core_mod.HOST_TAIL_MIN_ELEMS = prev_tail_thresh
+
+
+def _run_stream_inner(args, backend_label, verbose, seed, n_clusters,
+                      n_bindings, rate_hz, window_s):
+    import threading
+
+    n_events = int(rate_hz * window_s)
+    # ramp-in: a throwaway half-window at the target rate, driven before
+    # the measured window in BOTH legs — it walks the reachable micro-batch
+    # / round buckets so the measured window is genuinely steady-state
+    # (zero compiles), exactly like every other config's unmeasured warm
+    # round. Measured window and ramp-in replay the SAME schedules in both
+    # legs, so the final snapshots stay comparable bit-for-bit.
+    rampin = _stream_schedule(seed + 1, n_bindings, n_events // 2)
+    schedule = _stream_schedule(seed, n_bindings, n_events)
+
+    # ---- streaming leg ---------------------------------------------------
+    store_s, _rt_s, daemon_s = _stream_topology(seed, n_clusters, n_bindings)
+    # max_batch pinned to the TOP of the AOT micro-batch ladder: every
+    # reachable rows bucket is a prewarmed shape
+    svc = daemon_s.streaming(batch_delay=0.002, interval=0.05, max_batch=256)
+    stop = threading.Event()
+    server = threading.Thread(
+        target=lambda: svc.serve(should_stop=stop.is_set), daemon=True,
+        name="bench-stream-serve",
+    )
+    watch_s = _ArrivalWatch(store_s)
+    t_warm = time.perf_counter()
+    server.start()
+    # initial placement of the whole pool, then prime + lattice warm +
+    # the ramp-in window
+    deadline = time.monotonic() + 600.0
+    while time.monotonic() < deadline:
+        if svc._ready() == 0 and watch_s.placed_count() >= n_bindings:
+            break
+        time.sleep(0.1)
+    _warm_lattice(_prime_hwm(store_s, daemon_s), daemon_s, cap=256)
+    _stream_drive(store_s, watch_s, rampin, rate_hz)
+    _stream_wait_drain(watch_s)
+    warm_s = time.perf_counter() - t_warm
+    if verbose:
+        print(f"# stream: warm+rampin {warm_s:.1f}s "
+              f"({svc.stats_snapshot()['batches']} micro-batches)")
+
+    skip = len(watch_s.latencies)
+    compiles_before = svc.stats_snapshot()["jit_compiles"]
+    with _gc_quiesced():
+        stream_rate = _stream_drive(store_s, watch_s, schedule, rate_hz)
+        stream_drained = _stream_wait_drain(watch_s)
+    # parity snapshots only once the service settles: staleness discards
+    # keep the store converging after the last marked arrival patched
+    stream_quiesced = _quiesce_stream(svc)
+    steady_compiles = svc.stats_snapshot()["jit_compiles"] - compiles_before
+    stream_lat = list(watch_s.latencies)[skip:]
+    stream_final = _final_placements(store_s)
+    sstats = svc.stats_snapshot()
+
+    # rate ramp: probe the max sustainable rate (drain within grace)
+    max_rate = stream_rate if stream_drained else 0.0
+    ramp = []
+    for mult in (2, 4):
+        probe_rate = rate_hz * mult
+        n_probe = min(int(probe_rate * 2.5), 4000)
+        probe_sched = _stream_schedule(seed + mult, n_bindings, n_probe)
+        achieved = _stream_drive(store_s, watch_s, probe_sched, probe_rate)
+        drained = _stream_wait_drain(watch_s, grace_s=5.0)
+        ramp.append({"target_hz": probe_rate,
+                     "achieved_hz": round(achieved, 1),
+                     "sustained": drained})
+        if not drained:
+            _stream_wait_drain(watch_s, grace_s=60.0)  # let it settle
+            break
+        max_rate = max(max_rate, achieved)
+    stop.set()
+    svc.stop()
+    server.join(timeout=60.0)
+
+    # ---- batch-round leg (the pre-streaming daemon loop) -----------------
+    store_b, runtime_b, daemon_b = _stream_topology(
+        seed, n_clusters, n_bindings
+    )
+    watch_b = _ArrivalWatch(store_b)
+    stop_b = threading.Event()
+
+    def batch_loop():
+        # the daemon main loop this PR replaced: drain everything dirty
+        # into one round, then sleep the fixed tick
+        while not stop_b.is_set():
+            try:
+                runtime_b.settle()
+            except Exception:  # noqa: BLE001 - keep draining
+                pass
+            time.sleep(STREAM_BATCH_INTERVAL_S)
+
+    batcher = threading.Thread(target=batch_loop, daemon=True,
+                               name="bench-batch-loop")
+    t_warm_b = time.perf_counter()
+    batcher.start()
+    deadline = time.monotonic() + 600.0
+    while time.monotonic() < deadline:  # warm: the initial full placement
+        if (watch_b.placed_count() >= n_bindings
+                and len(daemon_b.controller.queue) == 0):
+            break
+        time.sleep(0.1)
+    _warm_lattice(_prime_hwm(store_b, daemon_b), daemon_b, cap=1536)
+    _stream_drive(store_b, watch_b, rampin, rate_hz)
+    _stream_wait_drain(watch_b)
+    warm_b = time.perf_counter() - t_warm_b
+    if verbose:
+        print(f"# stream: batch-leg warm+rampin {warm_b:.1f}s")
+    skip_b = len(watch_b.latencies)
+    with _gc_quiesced():
+        batch_achieved = _stream_drive(store_b, watch_b, schedule, rate_hz)
+        batch_drained = _stream_wait_drain(watch_b)
+    batch_quiesced = _quiesce_batch(daemon_b, STREAM_BATCH_INTERVAL_S)
+    stop_b.set()
+    batcher.join(timeout=60.0)
+    batch_lat = list(watch_b.latencies)[skip_b:]
+    batch_final = _final_placements(store_b)
+
+    # ---- the JSON line ---------------------------------------------------
+    sp = _percentiles(stream_lat)
+    bp = _percentiles(batch_lat)
+    identical = stream_final == batch_final
+    ratio = (
+        round(bp["p99_s"] / sp["p99_s"], 3)
+        if sp["p99_s"] and bp["p99_s"] else None
+    )
+    rec = {
+        "metric": (
+            f"stream_placement_latency_p99_{n_bindings}rb_x_{n_clusters}c"
+            f"_at_{rate_hz:g}hz"
+        ),
+        "value": sp["p99_s"],
+        "unit": "s",
+        "backend": backend_label,
+        "stream": {
+            **sp,
+            "achieved_rate_hz": round(stream_rate, 1),
+            "target_rate_hz": rate_hz,
+            "drained": stream_drained,
+            # False = the 60 s settle grace expired: the parity snapshot
+            # below compared a possibly still-converging store — treat a
+            # decisions_identical=false line with quiesced=false as an
+            # overload artifact, not a parity break
+            "quiesced": stream_quiesced,
+            "micro_batches": sstats["batches"],
+            "mean_batch_rows": (
+                round(sstats["admitted"] / sstats["batches"], 1)
+                if sstats["batches"] else 0
+            ),
+            "stale_discarded": sstats["stale_discarded"],
+            "warm_s": round(warm_s, 1),
+        },
+        "batch_round": {
+            **bp,
+            "achieved_rate_hz": round(batch_achieved, 1),
+            "drained": batch_drained,
+            "quiesced": batch_quiesced,
+            "interval_s": STREAM_BATCH_INTERVAL_S,
+            "warm_s": round(warm_b, 1),
+        },
+        "stream_vs_batch_p99": ratio,
+        "beats_batch_2x": bool(ratio is not None and ratio >= 2.0),
+        "decisions_identical": identical,
+        "steady_state_jit_compiles": int(steady_compiles),
+        "max_sustained_rate_hz": round(max_rate, 1),
+        "rate_ramp": ramp,
+    }
+    if verbose:
+        print(f"# stream: p99 {sp['p99_s']}s vs batch {bp['p99_s']}s "
+              f"(x{ratio}) identical={identical} "
+              f"steady_compiles={steady_compiles} max_rate={max_rate:.0f}/s")
+    return rec
+
+
 def build_flagship_cold(seed=0, n_clusters=5000, n_bindings=10000):
     """North-star variant, adversarial to the per-placement encode cache:
     every measured iteration bumps each binding's generation first
@@ -913,13 +1383,14 @@ CONFIGS = {
     "whatif": (build_whatif, "whatif_16s_1000rb_x_500c"),
     "degraded": (build_degraded, "degraded_breaker_1000rb_x_500c"),
     "coldstart": (None, None),  # subprocess-measured; see run_coldstart
+    "stream": (None, None),  # daemon-topology rate drive; see run_stream
     "flagship_cold": (build_flagship_cold, None),  # named after the shape
     "flagship": (build_flagship, None),  # metric name carries the shape
 }
 DEFAULT_ORDER = [
     "dup3", "static", "dynamic", "spread", "spread_skewed", "churn",
     "churn_incremental", "autoshard", "pipeline", "whatif", "degraded",
-    "coldstart", "flagship_cold", "flagship",
+    "coldstart", "stream", "flagship_cold", "flagship",
 ]
 
 # coldstart measures PROCESS boot, not round latency — a fixed modest shape
@@ -953,6 +1424,11 @@ def add_args(ap: argparse.ArgumentParser) -> None:
     ap.add_argument("--coldstart-cache-dir", default="",
                     help=argparse.SUPPRESS)
     ap.add_argument("--coldstart-aot", action="store_true",
+                    help=argparse.SUPPRESS)
+    # stream config overrides (defaults: the churn volume as a rate)
+    ap.add_argument("--stream-rate-hz", type=float, default=STREAM_RATE_HZ,
+                    help=argparse.SUPPRESS)
+    ap.add_argument("--stream-window-s", type=float, default=STREAM_WINDOW_S,
                     help=argparse.SUPPRESS)
     # platform must be pinned via jax.config inside the child, not the
     # JAX_PLATFORMS env var (the TPU sitecustomize hangs on the env var)
@@ -1033,6 +1509,8 @@ def main() -> None:
             sys.executable, os.path.abspath(__file__), "--inner",
             "--clusters", str(args.clusters), "--bindings", str(args.bindings),
             "--iters", str(iters), "--configs", args.configs,
+            "--stream-rate-hz", str(args.stream_rate_hz),
+            "--stream-window-s", str(args.stream_window_s),
         ] + (["--verbose"] if args.verbose else []) \
           + (["--platform", platform] if platform else [])
         budget = deadline - time.perf_counter()
@@ -1135,6 +1613,33 @@ def run_bench(args) -> None:
                       f"populate={rec.get('populate_s')}s "
                       f"warm={rec.get('warm_cache_s')}s "
                       f"under_ttl={rec.get('under_lease_ttl')}")
+            lines.append(json.dumps(rec))
+            continue
+        if name == "stream":
+            import types
+
+            # --clusters/--bindings default to the BENCH_r05 churn volume
+            # (STREAM_CLUSTERS x STREAM_BINDINGS); smaller values scale the
+            # topology down for smoke runs
+            st_args = types.SimpleNamespace(
+                clusters=min(args.clusters, STREAM_CLUSTERS),
+                bindings=min(args.bindings, STREAM_BINDINGS),
+                rate_hz=args.stream_rate_hz, window_s=args.stream_window_s,
+            )
+            try:
+                rec = run_stream(st_args, backend, verbose=args.verbose)
+            except Exception as e:  # noqa: BLE001 - one labeled error line
+                rec = {
+                    "metric": "stream_placement_latency_p99",
+                    "value": None, "unit": "s", "backend": backend,
+                    "error": f"{type(e).__name__}: {e}"[:300],
+                }
+            if not on_tpu:
+                rec["metric"] += f"_{backend}"
+                rec["note"] = (
+                    "cpu fallback; latency SLO targets TPU — last TPU "
+                    f"capture: {latest_capture_name()}"
+                )
             lines.append(json.dumps(rec))
             continue
         build, metric_suffix = CONFIGS[name]

@@ -213,6 +213,29 @@ descheduler_sweeps = registry.counter(
     "Number of descheduling sweeps",
 )
 
+# streaming scheduler (sched/streaming.py — docs/PERF.md "Streaming
+# scheduler"): the per-BINDING latency SLO the admission service replaces
+# the batch round's p99 with — watch-event admission (the event that made
+# the binding dirty) to the store patch that placed it. Buckets extend past
+# the request-latency defaults: an overloaded admission queue backs up into
+# seconds, and that tail is exactly what the histogram must resolve.
+placement_latency = registry.histogram(
+    "karmada_placement_latency_seconds",
+    "Per-binding latency from watch-event admission to store patch",
+    buckets=(0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5,
+             1.0, 2.5, 5.0, 10.0, 30.0, 60.0),
+)
+sched_queue_depth = registry.gauge(
+    "karmada_sched_queue_depth",
+    "Dirty-binding keys waiting in the scheduling queue",
+)
+microbatch_size = registry.histogram(
+    "karmada_microbatch_size",
+    "Bindings per admitted streaming micro-batch",
+    buckets=(1, 2, 4, 8, 12, 16, 24, 32, 48, 64, 96, 128, 256, 512,
+             1024, 2048, 4096),
+)
+
 # compile economics (sched/compilecache.py — docs/PERF.md): every XLA
 # backend compile is a jit-cache miss (the in-memory executable caches had
 # no program for that shape); with the persistent compilation cache enabled

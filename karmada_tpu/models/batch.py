@@ -266,6 +266,21 @@ class BatchEncoder:
         self._tol_rows: list[np.ndarray] = [
             np.zeros((4, self._tol_width), np.int32)
         ]
+        # high-water marks for the content-dependent table axes (sparse
+        # prev/evict widths, policy-table row counts): each batch pads to
+        # the pow2 bucket of the LARGEST value this encoder has seen, not
+        # just this batch's. A per-batch bucket makes the program shape a
+        # function of batch COMPOSITION — under the streaming scheduler,
+        # where micro-batches are arbitrary queue slices, that axis would
+        # wobble (e.g. a batch with vs without a 33-target binding flips
+        # Kp 32↔64) and each flip is a fresh XLA compile mid-stream. The
+        # marks only grow (bounded by pow2(C) / pow2(P)), convergence is
+        # one warm pass, pad entries are never indexed ⇒ decisions are
+        # bit-identical either way.
+        self._kp_hwm = 0
+        self._ke_hwm = 1
+        self._pp_hwm = 2
+        self._wp_hwm = 2
         self._tol_by_key: dict[bytes, int] = {}
         self._tol_stack: Optional[np.ndarray] = None
         self._req_rows: list[np.ndarray] = []
@@ -562,9 +577,16 @@ class BatchEncoder:
                 else ()
             )
 
-        # sparse axes bucketed to powers of two (jit cache bound)
-        Kp = pow2_bucket(max(map(len, prev_lists), default=0))
-        Ke = pow2_bucket(max(map(len, evict_lists), default=0), lo=1)
+        # sparse axes bucketed to powers of two (jit cache bound), floored
+        # at the encoder's high-water mark so batch composition cannot
+        # shrink (and later re-grow ⇒ recompile) the shape
+        self._kp_hwm = Kp = max(
+            pow2_bucket(max(map(len, prev_lists), default=0)), self._kp_hwm
+        )
+        self._ke_hwm = Ke = max(
+            pow2_bucket(max(map(len, evict_lists), default=0), lo=1),
+            self._ke_hwm,
+        )
         prev_idx = np.full((B, Kp), C, np.int32)  # C = drop sentinel
         prev_rep = np.zeros((B, Kp), np.int32)
         evict_idx = np.full((B, Ke), C, np.int32)
@@ -584,11 +606,11 @@ class BatchEncoder:
         # exact churn the shape-bucket lattice exists to absorb. Pad rows
         # are never indexed (aff_idx/weight_idx point at real rows only).
         aff = np.stack(aff_rows) if aff_rows else np.ones((1, C), bool)
-        Pp = pow2_bucket(len(aff), lo=2)
+        self._pp_hwm = Pp = max(pow2_bucket(len(aff), lo=2), self._pp_hwm)
         if Pp > len(aff):
             aff = np.pad(aff, [(0, Pp - len(aff)), (0, 0)])
         wt = np.stack(weight_rows)
-        Wp = pow2_bucket(len(wt), lo=2)
+        self._wp_hwm = Wp = max(pow2_bucket(len(wt), lo=2), self._wp_hwm)
         if Wp > len(wt):
             wt = np.pad(wt, [(0, Wp - len(wt)), (0, 0)])
         return BindingBatch(

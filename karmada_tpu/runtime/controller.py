@@ -36,11 +36,19 @@ class WorkQueue:
         self._retries: dict[str, int] = {}
         self._lock = threading.Lock()
         self.max_retries = max_retries
+        # enqueue wakeup hook (the streaming scheduler's condition-variable
+        # seam, sched/streaming.py): called — OUTSIDE the queue lock — when
+        # a key lands in an empty-or-not queue, so an event-driven drain
+        # loop can sleep until work exists instead of polling on a tick
+        self.on_add: Optional[Callable[[], None]] = None
 
     def add(self, key: str) -> None:
         with self._lock:
-            if key not in self._items:
+            fresh = key not in self._items
+            if fresh:
                 self._items[key] = None
+        if fresh and self.on_add is not None:
+            self.on_add()
 
     def pop(self) -> Optional[str]:
         with self._lock:
@@ -49,15 +57,33 @@ class WorkQueue:
             key, _ = self._items.popitem(last=False)
             return key
 
+    def readd(self, key: str) -> None:
+        """Interface parity with PrioritySchedulingQueue.readd (store-free
+        re-admit of a drained key); add() is already store-free here."""
+        self.add(key)
+
+    def drain(self, limit: Optional[int] = None) -> list[str]:
+        """Pop up to `limit` keys (all, when None) in FIFO order — the
+        micro-batch former's one-lock-hold alternative to a pop loop."""
+        out: list[str] = []
+        with self._lock:
+            while self._items and (limit is None or len(out) < limit):
+                key, _ = self._items.popitem(last=False)
+                out.append(key)
+        return out
+
     def retry(self, key: str) -> bool:
         with self._lock:
             n = self._retries.get(key, 0) + 1
             self._retries[key] = n
             if n > self.max_retries:
                 return False
-            if key not in self._items:
+            readded = key not in self._items
+            if readded:
                 self._items[key] = None
-            return True
+        if readded and self.on_add is not None:
+            self.on_add()
+        return True
 
     def forget(self, key: str) -> None:
         with self._lock:
@@ -111,12 +137,7 @@ class BatchingController(Controller):
     reconcile_batch: Optional[Callable[[list[str]], list[str]]] = None
 
     def step(self) -> bool:
-        keys = []
-        while True:
-            k = self.queue.pop()
-            if k is None:
-                break
-            keys.append(k)
+        keys = self.queue.drain()
         if not keys:
             return False
         try:

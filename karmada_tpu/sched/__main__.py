@@ -44,7 +44,27 @@ def main() -> None:
                          "filter and score plugins)")
     ap.add_argument("--scheduler-name", default="default-scheduler")
     ap.add_argument("--interval", type=float, default=0.2,
-                    help="seconds between queue drains")
+                    help="max-sleep fallback between wakeups: the daemon "
+                         "wakes on every enqueue (condition variable), so "
+                         "this only bounds how long an IDLE leader sleeps "
+                         "before its renew/prewarm housekeeping tick")
+    ap.add_argument("--batch-delay-ms", type=float, default=5.0,
+                    help="streaming admission batching delay: how long a "
+                         "trickle of watch events may coalesce into one "
+                         "micro-batch before it launches (latency floor vs "
+                         "batch efficiency; a backlog always admits "
+                         "immediately). See docs/PERF.md 'Streaming "
+                         "scheduler'")
+    ap.add_argument("--max-batch-rows", type=int, default=0,
+                    help="drain quota per streaming micro-batch (0 = auto: "
+                         "shape-bucket-floored, capped by the pipeline's "
+                         "per-chunk HBM row budget)")
+    ap.add_argument("--no-streaming", action="store_true",
+                    help="disable the streaming admission service and "
+                         "restore the discrete batch-round drain loop "
+                         "(decisions are identical either way; streaming "
+                         "only changes WHEN work is admitted). KARMADA_TPU_"
+                         "STREAMING=0 is the env equivalent; this flag wins")
     ap.add_argument("--platform", default="",
                     help="pin the jax platform (e.g. cpu); default = the "
                          "ambient backend (TPU where available)")
@@ -206,24 +226,65 @@ def main() -> None:
         elector.step()  # synchronous first try: a lone daemon leads at once
         elector.run()
 
+    streaming = not args.no_streaming and os.environ.get(
+        "KARMADA_TPU_STREAMING", ""
+    ) not in ("0", "off", "false")
+    service = None
+    if streaming:
+        service = daemon.streaming(
+            batch_delay=max(0.0, args.batch_delay_ms) / 1000.0,
+            interval=args.interval,
+            max_batch=args.max_batch_rows,
+        )
+        print(f"streaming admission: on (batch delay "
+              f"{args.batch_delay_ms:g} ms; leader-only — docs/PERF.md)",
+              flush=True)
+    wake = threading.Event()
+    if service is None:
+        # batch mode still gets the condition-variable wakeup: an enqueue
+        # interrupts the sleep, --interval is only the max-sleep fallback
+        daemon.controller.queue.on_add = wake.set
+
     print(f"karmada-tpu scheduler attached to {args.server}", flush=True)
     # hot standby: encoders + jit cache warm before (and while) not leading
     daemon.prewarm()
     try:
         while True:
             if leading.is_set():
-                try:
-                    runtime.settle()
-                except Exception:  # noqa: BLE001 - survive transient errors
-                    import logging
+                if service is not None:
+                    # blocks while leading: event-driven micro-batch
+                    # admission (returns on leadership loss). serve()'s
+                    # entry (_ensure_fleet) reads the store BEFORE its
+                    # in-loop survival wraps — a transient store error
+                    # there must back off and retry, not kill the daemon
+                    try:
+                        service.serve(
+                            should_stop=lambda: not leading.is_set()
+                        )
+                    except Exception:  # noqa: BLE001 - survive transients
+                        import logging
 
-                    logging.getLogger(__name__).exception("scheduling drain")
+                        logging.getLogger(__name__).exception(
+                            "streaming admission service")
+                        time.sleep(args.interval)
+                else:
+                    try:
+                        runtime.settle()
+                    except Exception:  # noqa: BLE001 - survive transients
+                        import logging
+
+                        logging.getLogger(__name__).exception(
+                            "scheduling drain")
+                    wake.wait(args.interval)
+                    wake.clear()
             else:
-                daemon.prewarm()  # re-warm on cluster churn while standing by
-            time.sleep(args.interval)
+                daemon.prewarm()  # re-warm on cluster churn while standby
+                time.sleep(args.interval)
     except KeyboardInterrupt:
         pass
     finally:
+        if service is not None:
+            service.stop()
         if elector is not None:
             elector.stop(release=True)
         if metrics_srv is not None:

@@ -50,14 +50,23 @@ log = logging.getLogger(__name__)
 # makes later passes incremental anyway)
 MAX_PREWARM_SHAPES = 8
 
+# the bottom of the shape_bucket lattice, where streaming micro-batches
+# live: the admission loop drains ~arrival_rate x solve_time rows per
+# micro-batch, so steady state walks these buckets as load breathes —
+# prewarming them is what makes "zero XLA compiles at steady state" hold
+# from the FIRST admitted micro-batch (docs/PERF.md streaming scheduler)
+MICROBATCH_LADDER = (8, 12, 16, 24, 32, 48, 64, 96, 128, 192, 256)
+
 
 def row_buckets_for(sched, n_hint: Optional[int] = None,
-                    max_shapes: int = MAX_PREWARM_SHAPES) -> list[int]:
+                    max_shapes: int = MAX_PREWARM_SHAPES,
+                    stream: bool = False) -> list[int]:
     """Padded row buckets a round on this scheduler can reach, most
     valuable first: the equalized chunk schedule of the current working set
     (`n_hint` bindings — the shape the takeover round will actually
-    dispatch), then a small-round ladder every boot passes through, capped
-    at the per-launch HBM row cap."""
+    dispatch), then — with `stream` — the micro-batch ladder a streaming
+    admission loop breathes through, then a small-round ladder every boot
+    passes through, capped at the per-launch HBM row cap."""
     C = len(sched.fleet.names)
     if C == 0:
         return []
@@ -70,6 +79,8 @@ def row_buckets_for(sched, n_hint: Optional[int] = None,
         rows = plan_chunk_rows(n_hint, sched.round_chunk_rows(n_hint))
         for s, e in chunk_spans(n_hint, rows):
             pts.append(shape_bucket(e - s))
+    if stream:
+        pts += list(MICROBATCH_LADDER)
     pts += [8, 256, 1024]
     if n_hint and n_hint > chunk_cap:
         # the chunk cap is only a REACHABLE shape when the working set
@@ -170,6 +181,7 @@ def prewarm_schedule(
     bindings: Optional[Sequence] = None,
     with_extra: bool = False,
     max_shapes: int = MAX_PREWARM_SHAPES,
+    stream: bool = False,
     stop=None,
 ) -> dict:
     """AOT-lower+compile the partitioned round kernels over the reachable
@@ -177,6 +189,9 @@ def prewarm_schedule(
     working set (shape hint AND encode template); `with_extra`: also
     compile the dense estimator-answer variant (registered estimators make
     rounds carry an i32[B,C] extra matrix, a different program shape);
+    `stream`: the daemon runs the streaming admission loop — include the
+    micro-batch ladder (and widen the shape budget to fit it; the ladder's
+    shapes are the lattice bottom, seconds not minutes of XLA each);
     `stop`: optional threading.Event checked between shapes so a standby
     promoted mid-prewarm abandons the pass immediately. Returns a stats
     dict (shapes compiled, compile seconds, persistent-cache hits)."""
@@ -186,7 +201,10 @@ def prewarm_schedule(
 
     t0 = time.perf_counter()
     bindings = list(bindings or [])
-    buckets = row_buckets_for(sched, len(bindings) or None, max_shapes)
+    if stream and max_shapes == MAX_PREWARM_SHAPES:
+        max_shapes = MAX_PREWARM_SHAPES + len(MICROBATCH_LADDER)
+    buckets = row_buckets_for(sched, len(bindings) or None, max_shapes,
+                              stream=stream)
     snap = compile_counts()
     stats = {"row_buckets": [], "aot_seconds": 0.0, **compile_delta(snap)}
     if not buckets:

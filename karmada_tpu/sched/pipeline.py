@@ -130,6 +130,193 @@ class _Done:
 _DONE = _Done()
 
 
+class StreamPipeline:
+    """Open-ended chunk stream: the launch→materialize→patch tail of the
+    pipeline without a fixed chunk list.
+
+    `ChunkPipeline` runs a round whose chunks are all known up front; the
+    streaming scheduler (sched/streaming.py) has no round — micro-batches
+    form one at a time as watch events accumulate, and each is submitted
+    the moment it exists. This class owns the shared machinery: `submit()`
+    launches a chunk on the caller's thread (host encode + async device
+    dispatch, no sync) and hands it to a writer thread that materializes
+    and patches chunks strictly in submission order, while a semaphore
+    bounds launched-but-unretired chunks at `depth` (the same double
+    buffering bound — in-flight device work never exceeds depth × chunk).
+    The caller's thread is free the moment `submit()` returns: the
+    admission loop goes back to accumulating the NEXT micro-batch while
+    this one solves on device, which is exactly how new work is admitted
+    into the gaps of an already-running pipeline.
+
+    Failure semantics match ChunkPipeline: the first exception from any
+    stage aborts the stream — later submitted chunks drain un-executed,
+    `submit()` returns None once aborted, and `close()` re-raises (or
+    returns quietly with `.failure` set when `raise_failure=False`, for
+    callers that must sequence their own cleanup first). `chunk_of()`
+    exposes the un-retired chunks so an aborting caller can re-enqueue
+    their work instead of losing it."""
+
+    def __init__(
+        self,
+        launch: Callable,
+        *,
+        materialize: Optional[Callable] = None,
+        patch: Optional[Callable] = None,
+        depth: int = DEFAULT_DEPTH,
+        timer: Optional[StageTimer] = None,
+        time_materialize: bool = True,
+        keep_results: bool = True,
+        name: str = "sched-stream-writer",
+    ) -> None:
+        self.launch = launch
+        self.materialize = materialize
+        self.patch = patch
+        self.depth = max(1, depth)
+        self.timer = timer or StageTimer()
+        self.time_materialize = time_materialize
+        # a long-lived stream (the streaming daemon runs ONE for its whole
+        # leadership) must not accumulate per-chunk state: with
+        # keep_results=False the writer drops a chunk's result and its
+        # chunk ref the moment it retires cleanly
+        self.keep_results = keep_results
+        self.failure: Optional[BaseException] = None
+        self._abort = threading.Event()
+        self._slots = threading.Semaphore(self.depth)
+        self._q: queue.Queue = queue.Queue()
+        self._lock = threading.Lock()
+        self._retired_cv = threading.Condition(self._lock)
+        self._results: dict[int, object] = {}
+        self._pending_chunks: dict[int, object] = {}
+        self._submitted = 0
+        self._retired = 0
+        self._closed = False
+        self._writer = threading.Thread(
+            target=self._writer_main, name=name, daemon=True
+        )
+        self._writer.start()
+
+    # -- caller side -------------------------------------------------------
+
+    def submit(self, chunk, est=None,
+               timeout: Optional[float] = None) -> Optional[int]:
+        """Launch `chunk` on this thread and queue it for the writer.
+        Blocks while `depth` chunks are already in flight — bounded by
+        `timeout` when given (a writer wedged in a hung patch holds every
+        slot; an unbounded acquire would pin the caller forever). Returns
+        the chunk's stream index, or None when the stream aborted or the
+        slot wait timed out (distinguish via `.aborted`; on timeout no
+        state was touched — the caller may retry). A `launch` exception
+        propagates here, after its slot is returned."""
+        if self._closed:
+            raise RuntimeError("stream already closed")
+        if timeout is None:
+            self._slots.acquire()
+        elif not self._slots.acquire(timeout=timeout):
+            return None
+        if self._abort.is_set():
+            self._slots.release()
+            return None
+        i = self._submitted
+        try:
+            pending = self.launch(i, chunk, est)
+        except BaseException:
+            self._slots.release()
+            raise
+        self._submitted = i + 1
+        with self._lock:
+            self._pending_chunks[i] = chunk
+        self._q.put((i, chunk, pending))
+        return i
+
+    def abort(self) -> None:
+        """Stop executing: chunks not yet materialized drain un-patched
+        (their work is recoverable via `unretired_chunks`)."""
+        self._abort.set()
+
+    @property
+    def aborted(self) -> bool:
+        return self._abort.is_set()
+
+    def drain(self, timeout: Optional[float] = None) -> bool:
+        """Block until every submitted chunk has retired (materialized and
+        patched, or abort-drained). True unless the timeout hit."""
+        with self._retired_cv:
+            return self._retired_cv.wait_for(
+                lambda: self._retired >= self._submitted, timeout
+            )
+
+    def unretired_chunks(self) -> list:
+        """Chunks submitted but not fully patched (abort/failure leftovers;
+        empty after a clean drain) — the caller re-admits their work."""
+        with self._lock:
+            return [
+                self._pending_chunks[i] for i in sorted(self._pending_chunks)
+            ]
+
+    def close(self, raise_failure: bool = True,
+              timeout: Optional[float] = None) -> dict[int, object]:
+        """Shut the writer down once the queued chunks drain; returns the
+        per-index results. Re-raises the first stage failure unless
+        `raise_failure=False` (then read `.failure`). Idempotent.
+        `timeout` bounds the writer join: a writer WEDGED in a stage (a
+        hung store patch, a stuck device sync) would otherwise block the
+        caller forever — on expiry the stream aborts, records a failure,
+        and the (daemon) writer thread is abandoned; its chunks stay
+        recoverable via `unretired_chunks()`."""
+        if not self._closed:
+            self._closed = True
+            self._q.put(_DONE)
+        self._writer.join(timeout)
+        if self._writer.is_alive():
+            self._abort.set()
+            if self.failure is None:
+                self.failure = RuntimeError(
+                    f"stream writer did not retire within {timeout}s"
+                )
+        if raise_failure and self.failure is not None:
+            raise self.failure
+        with self._lock:
+            return dict(self._results)
+
+    # -- writer side -------------------------------------------------------
+
+    def _materialize_one(self, i: int, pending):
+        if self.materialize is None:
+            return pending
+        if self.time_materialize:
+            with self.timer.stage("materialize", tag=i):
+                return self.materialize(pending)
+        return self.materialize(pending)
+
+    def _writer_main(self) -> None:
+        while True:
+            item = self._q.get()
+            if item is _DONE:
+                return
+            i, chunk, pending = item
+            try:
+                if self._abort.is_set():
+                    continue  # drain without executing past a failure
+                try:
+                    result = self._materialize_one(i, pending)
+                    if self.patch is not None:
+                        with self.timer.stage("patch", tag=i):
+                            self.patch(i, chunk, result)
+                    with self._lock:
+                        self._pending_chunks.pop(i, None)
+                        if self.keep_results:
+                            self._results[i] = result
+                except BaseException as e:  # noqa: BLE001 - close() re-raises
+                    if self.failure is None:
+                        self.failure = e
+                    self._abort.set()
+            finally:
+                self._slots.release()  # chunk fully retired: slot frees
+                with self._retired_cv:
+                    self._retired += 1
+                    self._retired_cv.notify_all()
+
+
 class ChunkPipeline:
     """The chunked software pipeline.
 
@@ -207,44 +394,21 @@ class ChunkPipeline:
 
     # -- pipelined leg -----------------------------------------------------
 
-    def _writer_main(self, q: queue.Queue, results: list, failure: list,
-                     abort: threading.Event,
-                     slots: threading.Semaphore) -> None:
-        while True:
-            item = q.get()
-            if item is _DONE:
-                return
-            i, chunk, pending = item
-            try:
-                if abort.is_set():
-                    continue  # drain without executing past a failure
-                try:
-                    result = self._materialize_one(i, pending)
-                    if self.patch is not None:
-                        with self.timer.stage("patch", tag=i):
-                            self.patch(i, chunk, result)
-                    results[i] = result
-                except BaseException as e:  # noqa: BLE001 - re-raised by run()
-                    failure.append(e)
-                    abort.set()
-            finally:
-                slots.release()  # chunk fully retired: its launch slot frees
-
     def _run_pipelined(self, chunks: Sequence) -> list:
+        """A fixed chunk list is just a stream that closes after its last
+        submit: the launch/materialize/patch tail (writer thread, in-order
+        patching, depth-bounded double buffering) is StreamPipeline's; this
+        leg only adds the estimate PREFETCH — chunk i+1's estimator fan-out
+        runs on a worker thread while chunk i encodes and solves, which
+        needs the full chunk list and so cannot live in the open-ended
+        stream."""
         n = len(chunks)
-        results: list = [None] * n
-        failure: list[BaseException] = []
-        abort = threading.Event()
-        # the double-buffering bound: a launch slot is held from dispatch
-        # until the writer retires the chunk, so at most `depth` chunks are
-        # launched-but-unmaterialized (device working set = depth x chunk)
-        slots = threading.Semaphore(self.depth)
-        q: queue.Queue = queue.Queue()
-        writer = threading.Thread(
-            target=self._writer_main, args=(q, results, failure, abort, slots),
-            name="sched-pipeline-writer", daemon=True,
+        stream = StreamPipeline(
+            launch=self.launch, materialize=self.materialize,
+            patch=self.patch, depth=self.depth, timer=self.timer,
+            time_materialize=self.time_materialize,
+            name="sched-pipeline-writer",
         )
-        writer.start()
 
         est_box: dict[int, object] = {}
         est_lock = threading.Lock()
@@ -259,7 +423,7 @@ class ChunkPipeline:
                     est_box[i] = est
             except BaseException as e:  # noqa: BLE001
                 est_err.append(e)
-                abort.set()
+                stream.abort()
             finally:
                 est_ready[i].set()
 
@@ -288,22 +452,19 @@ class ChunkPipeline:
                         est = est_box.pop(i)
                     # chunk i+1's fan-out runs while chunk i encodes/solves
                     prefetcher = start_prefetch(i + 1)
-                slots.acquire()  # wait for a double-buffer slot
-                if abort.is_set():
-                    slots.release()
-                    break
-                pending = self.launch(i, chunk, est)
-                q.put((i, chunk, pending))
+                if stream.submit(chunk, est) is None:
+                    break  # a stage failed: stop launching, drain below
         finally:
-            q.put(_DONE)
-            writer.join()
+            # close() drains the queued chunks and joins the writer; a
+            # launch exception propagates from the try body AFTER cleanup
+            results = stream.close(raise_failure=False)
             if prefetcher is not None:
                 prefetcher.join()
         if est_err:
             raise est_err[0]
-        if failure:
-            raise failure[0]
-        return results
+        if stream.failure is not None:
+            raise stream.failure
+        return [results.get(i) for i in range(n)]
 
     def run(self, chunks: Sequence) -> list:
         t0 = time.perf_counter()

@@ -50,6 +50,84 @@ def placement_json(placement) -> str:
     return json.dumps(asdict(placement), sort_keys=True, default=str)
 
 
+class AdmissionLog:
+    """Per-binding admission bookkeeping for the streaming scheduler
+    (sched/streaming.py). Two facts per key, both bumped by the watch
+    handlers the moment an event enqueues the binding:
+
+    - a monotonically increasing dirty EPOCH — the staleness fence: a
+      micro-batch snapshots each binding's epoch BEFORE reading its spec,
+      and the patch stage discards any in-flight decision whose binding
+      moved past the snapshot (it dirtied mid-flight; the bumping event
+      already re-enqueued the key, so the binding re-admits with the
+      fresh spec);
+    - the ADMITTED-AT timestamp the placement-latency histogram measures
+      from: the FIRST event of the current pending stretch (coalesced
+      re-events while the key waits do not reset the clock — the binding
+      has been dirty since the first one).
+
+    Disabled (`enabled=False`) outside streaming mode so the batch daemon
+    pays no bookkeeping and the maps cannot grow in a mode that never
+    clears them."""
+
+    def __init__(self) -> None:
+        import itertools
+        import threading
+
+        self.enabled = False
+        self._lock = threading.Lock()
+        # epochs come from ONE process-global counter, not per-key counts:
+        # forget() may drop a key's entry while a snapshot of it is still
+        # in flight (delete→recreate of the same ns/name), and a per-key
+        # count restarting at 1 could collide with that old snapshot and
+        # let a stale decision patch the recreated binding
+        self._gen = itertools.count(1)
+        self._epoch: dict[str, int] = {}
+        self._admitted: dict[str, float] = {}
+
+    def note(self, key: str, now: float) -> None:
+        with self._lock:
+            self._epoch[key] = next(self._gen)
+            self._admitted.setdefault(key, now)
+
+    def invalidate(self, key: str) -> None:
+        """Fence off any in-flight decision for `key` WITHOUT starting a
+        new pending stretch: events that stop scheduling rather than
+        request it (suspension, scheduler_name re-target, deletion) must
+        still move the epoch — the in-flight decision was computed on the
+        pre-event spec — but there is nothing to measure a placement
+        latency against."""
+        with self._lock:
+            self._epoch[key] = next(self._gen)
+            self._admitted.pop(key, None)
+
+    def epoch(self, key: str) -> int:
+        with self._lock:
+            return self._epoch.get(key, 0)
+
+    def observe_patch(self, key: str, now: float) -> Optional[float]:
+        """Latency of the patch that just landed (admission → patch);
+        clears the pending stretch. None when nothing was pending. The
+        daemon's own patch re-notes the key (its store write is a watch
+        event) BEFORE this pop runs on the same thread, and setdefault
+        keeps the original timestamp — so the pop both measures from the
+        true first admission and retires the self-inflicted note."""
+        with self._lock:
+            t0 = self._admitted.pop(key, None)
+        return None if t0 is None else max(0.0, now - t0)
+
+    def settle(self, key: str) -> None:
+        """A drained key needed no scheduling: the pending stretch (if
+        any — e.g. the daemon's own patch event) resolves un-measured."""
+        with self._lock:
+            self._admitted.pop(key, None)
+
+    def forget(self, key: str) -> None:
+        with self._lock:
+            self._epoch.pop(key, None)
+            self._admitted.pop(key, None)
+
+
 class SchedulerDaemon:
     def __init__(
         self,
@@ -113,6 +191,11 @@ class SchedulerDaemon:
         self._aot_lock = _threading.Lock()
         self._prewarm_suspended = False
         self.last_prewarm_stats: dict = {}
+        # streaming admission state (sched/streaming.py): epoch + latency
+        # bookkeeping (inert until a StreamingScheduler attaches) and the
+        # AOT hint that micro-batch row buckets belong in the prewarm walk
+        self.admission = AdmissionLog()
+        self.stream_prewarm = False
         # names of clusters MODIFIED since the last fleet encode; None means
         # the membership changed (add/delete) and the next encode must be a
         # full rebuild instead of the dirty-column scatter
@@ -135,13 +218,37 @@ class SchedulerDaemon:
 
     def _on_binding(self, event: str, rb: ResourceBinding) -> None:
         if event == DELETED:
+            if self.admission.enabled:
+                # fence + drain: the bump discards any in-flight decision,
+                # and enqueueing lets _form_keys see the tombstone and
+                # forget the key, keeping the admission maps bounded
+                self.admission.invalidate(rb.metadata.key())
+                self.controller.enqueue(rb.metadata.key())
             return
         if rb.spec.scheduler_name and rb.spec.scheduler_name != self.scheduler_name:
+            # re-targeted to another scheduler: any in-flight decision of
+            # ours was computed on the pre-retarget spec — fence it off
+            # (no enqueue: the binding is not ours to schedule)
+            if self.admission.enabled:
+                self.admission.invalidate(rb.metadata.key())
             return
         if rb.spec.scheduling_suspended():
+            # suspension must also move the epoch: an in-flight decision
+            # passing the writer's fence would place a binding the user
+            # explicitly told the scheduler to leave alone. Enqueue so the
+            # drain settles the pending stretch (un-measured).
+            if self.admission.enabled:
+                self.admission.invalidate(rb.metadata.key())
+                self.controller.enqueue(rb.metadata.key())
             return
         queue_incoming_bindings.inc(event=event)
-        self.controller.enqueue(rb.metadata.key())
+        key = rb.metadata.key()
+        if self.admission.enabled:
+            # note BEFORE enqueue: the enqueue hook wakes the streaming
+            # admission loop, whose epoch snapshot must already see this
+            # event's bump
+            self.admission.note(key, self.clock.now())
+        self.controller.enqueue(key)
 
     def _priority_of(self, key: str) -> int:
         ns, _, name = key.partition("/")
@@ -189,6 +296,34 @@ class SchedulerDaemon:
             if rb.spec.assigned_replicas() != rb.spec.replicas:
                 return True  # replicas changed → scale schedule (:408)
         return False
+
+    def _admission_gate(self, rb: Optional[ResourceBinding]) -> str:
+        """Per-key admission decision, shared by BOTH drain paths (the
+        batch round's _schedule_batch and streaming's _form_keys) so the
+        skip conditions cannot drift apart and silently break the
+        streaming-vs-batch decision-parity contract. 'drop': tombstone or
+        re-targeted to another scheduler (not ours — the key's bookkeeping
+        should be forgotten); 'suspended': the user told us to leave it
+        alone; 'schedule': solve it; 'clean': current, just record the
+        observed generation."""
+        if rb is None or rb.metadata.deletion_timestamp is not None:
+            return "drop"
+        if (rb.spec.scheduler_name
+                and rb.spec.scheduler_name != self.scheduler_name):
+            # re-targeted while queued: the event handler declines
+            # re-target events, but this key was enqueued BEFORE
+            return "drop"
+        if rb.spec.scheduling_suspended():
+            return "suspended"
+        return "schedule" if self._needs_schedule(rb) else "clean"
+
+    def _record_observed(self, rb: ResourceBinding) -> None:
+        """No scheduling required: still record that the current spec was
+        observed (scheduler.go:437-441) — graceful eviction assessment
+        gates on this."""
+        if rb.status.scheduler_observed_generation != rb.metadata.generation:
+            rb.status.scheduler_observed_generation = rb.metadata.generation
+            self.store.update(rb)
 
     # -- the batch solve --------------------------------------------------
 
@@ -330,6 +465,7 @@ class SchedulerDaemon:
                 stats = prewarm_schedule(
                     array, bindings,
                     with_extra=self.estimator_registry is not None,
+                    stream=self.stream_prewarm,
                     stop=stop,
                 )
                 self.last_prewarm_stats = {"epoch": epoch, **stats}
@@ -367,23 +503,28 @@ class SchedulerDaemon:
                 self._aot_stop.set()
             self._aot_epoch = -1  # re-arm for the next standby period
 
+    def streaming(self, **kwargs):
+        """Attach the streaming admission service (sched/streaming.py):
+        kills the round boundary — watch events wake an always-on admission
+        loop that accumulates micro-batches while the previous one solves
+        on device. Enables admission/epoch bookkeeping and adds the
+        micro-batch row buckets to the AOT prewarm walk. kwargs pass
+        through to StreamingScheduler (batch_delay, interval, max_batch,
+        depth)."""
+        from .streaming import StreamingScheduler
+
+        return StreamingScheduler(self, **kwargs)
+
     def _schedule_batch(self, keys: list[str]) -> list[str]:
         bindings = []
         for key in keys:
             ns, _, name = key.partition("/")
             rb = self.store.try_get("ResourceBinding", name, ns)
-            if rb is None or rb.metadata.deletion_timestamp is not None:
-                continue
-            if rb.spec.scheduling_suspended():
-                continue
-            if self._needs_schedule(rb):
+            gate = self._admission_gate(rb)
+            if gate == "schedule":
                 bindings.append(rb)
-            elif rb.status.scheduler_observed_generation != rb.metadata.generation:
-                # no scheduling required: still record that the current spec
-                # was observed (scheduler.go:437-441) — graceful eviction
-                # assessment gates on this
-                rb.status.scheduler_observed_generation = rb.metadata.generation
-                self.store.update(rb)
+            elif gate == "clean":
+                self._record_observed(rb)
         if not bindings:
             return []
         from ..tracing import Trace
@@ -511,10 +652,17 @@ class SchedulerDaemon:
         trace.log_if_long(1.0)
         return []
 
-    def _patch_result(self, rb: ResourceBinding, decision: ScheduleDecision) -> None:
+    def _patch_result(self, rb: ResourceBinding, decision: ScheduleDecision) -> bool:
+        """Write a decision back to the store. Returns False when the write
+        is VETOED by a last-moment spec change: the streaming writer's epoch
+        fence is check-then-act, so a deletion/suspension/re-target event
+        landing between the epoch comparison and this write must still stop
+        the patch — re-checked here against the freshest spec, under the
+        store's serialization (which orders this read after that event's
+        write)."""
         fresh = self.store.try_get("ResourceBinding", rb.name, rb.namespace)
-        if fresh is None:
-            return
+        if self._admission_gate(fresh) in ("drop", "suspended"):
+            return False
         if decision.ok:
             placement = placement_json(fresh.spec.placement)
             trigger_active = fresh.spec.reschedule_triggered_at is not None and (
@@ -542,7 +690,7 @@ class SchedulerDaemon:
                 if fresh.status.scheduler_observed_generation != fresh.metadata.generation:
                     fresh.status.scheduler_observed_generation = fresh.metadata.generation
                     self.store.update(fresh)
-                return  # idempotent no-op: the event fixpoint terminates here
+                return True  # idempotent no-op: the event fixpoint terminates here
             fresh.status.scheduler_observed_generation = fresh.metadata.generation
             fresh.status.scheduler_observed_affinity_name = decision.affinity_name
             fresh.status.last_scheduled_time = self.clock.now()
@@ -564,7 +712,7 @@ class SchedulerDaemon:
                     message=decision.error,
                 ),
             ):
-                return
+                return True
         self.store.update(fresh)
         if self.event_recorder is not None:
             # recorded on the binding (scheduler.go:964-1010); the binding
@@ -585,6 +733,7 @@ class SchedulerDaemon:
                 self.event_recorder.event(
                     fresh, TYPE_WARNING, REASON_SCHEDULE_BINDING_FAILED, decision.error
                 )
+        return True
 
 
 def _targets_fingerprint(targets) -> tuple:

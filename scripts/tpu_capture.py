@@ -106,6 +106,11 @@ def main() -> None:
     # persistent cache + AOT prewarm (three cold child boots per run)
     artifact["runs"].append(run_bench(
         ["--configs", "coldstart", "--run-timeout", "2000"], 2100))
+    # streaming scheduler: sustained churn RATE against the admission
+    # service vs the batch-round drain loop — placement-latency
+    # percentiles + max sustainable rate (docs/PERF.md)
+    artifact["runs"].append(run_bench(
+        ["--configs", "stream", "--run-timeout", "1500"], 1600))
     # the Go-interop seam: /v1/scheduleBatch latency at flagship scale
     artifact["runs"].append(run_script(
         "scripts/bench_shim.py",

@@ -94,6 +94,108 @@ class TestPriorityQueue:
         q.add("b/stuck")  # new cluster event re-activates immediately
         assert q.pop() == "b/stuck"
 
+    def test_aging_prevents_starvation_under_priority_flood(self):
+        """A sustained flood of high-priority bindings must not starve a
+        priority-0 key forever: its effective priority grows by one per
+        aging_step seconds of activeQ age, so it eventually out-ranks
+        fresh arrivals (fake clock — deterministic)."""
+        priorities = {"b/low": 0}
+        clock = Clock(fixed=1000.0)
+        q = PrioritySchedulingQueue(
+            clock,
+            priority_fn=lambda k: priorities.get(k, 10),
+            aging_step=30.0,
+        )
+        q.add("b/low")
+        popped: list[str] = []
+        for tick in range(20):
+            # the flood: drains never outpace arrivals of priority-10 keys
+            q.add(f"b/hi-{2 * tick}")
+            q.add(f"b/hi-{2 * tick + 1}")
+            clock.advance(30.0)
+            popped.append(q.pop())
+            popped.append(q.pop())
+        assert "b/low" in popped, "priority-0 key starved despite aging"
+        # and it surfaced once its age crossed the flood's priority
+        # (0 + 10 aging steps), not at the very end
+        assert popped.index("b/low") <= 2 * 12
+
+    def test_aging_disabled_starves(self):
+        """aging_step=0 restores the reference's strict-priority pop: the
+        same flood starves the priority-0 key indefinitely — the behavior
+        the aging default exists to prevent."""
+        priorities = {"b/low": 0}
+        clock = Clock(fixed=1000.0)
+        q = PrioritySchedulingQueue(
+            clock, priority_fn=lambda k: priorities.get(k, 10),
+            aging_step=0.0,
+        )
+        q.add("b/low")
+        for tick in range(20):
+            q.add(f"b/hi-{2 * tick}")
+            q.add(f"b/hi-{2 * tick + 1}")
+            clock.advance(30.0)
+            assert q.pop() != "b/low"
+            assert q.pop() != "b/low"
+
+    def test_drain_pops_in_priority_order(self):
+        _, q = make_queue(priorities={"b/high": 5})
+        q.add("b/a")
+        q.add("b/high")
+        q.add("b/b")
+        assert q.drain(2) == ["b/high", "b/a"]
+        assert q.drain() == ["b/b"]
+        assert q.drain() == []
+
+    def test_on_add_hook_fires(self):
+        _, q = make_queue()
+        fired = []
+        q.on_add = lambda: fired.append(1)
+        q.add("b/x")
+        q.add("b/x")  # already active: no second wakeup
+        assert len(fired) == 1
+
+    def test_forget_keeps_parked_priority(self):
+        """The patch path forgets a key right after _patch_result may have
+        parked it unschedulable; its later re-activation must re-enqueue
+        at the REAL priority (cached at add), not 0."""
+        priorities = {"b/vip": 10}
+        clock = Clock(fixed=0.0)
+        q = PrioritySchedulingQueue(
+            clock, priority_fn=lambda k: priorities.get(k, 0)
+        )
+        q.add("b/vip")
+        assert q.pop() == "b/vip"
+        q.push_unschedulable("b/vip")
+        q.forget("b/vip")
+        clock.advance(301.0)  # past unschedulable_max_stay
+        q.add("b/low")
+        assert q.pop() == "b/vip", "parked VIP re-activated at priority 0"
+
+    def test_readd_skips_priority_fn_and_keeps_cached_priority(self):
+        """readd is the streaming error paths' store-free re-admit:
+        priority_fn typically reads the store, and those paths run exactly
+        when the store is erroring — readd must never call it, and the
+        cached base priority (left in place by the drain) must order the
+        re-admitted keys correctly."""
+        calls: list[str] = []
+        prios = {"b/vip": 9}
+        clock = Clock(fixed=0.0)
+        q = PrioritySchedulingQueue(
+            clock, priority_fn=lambda k: calls.append(k) or prios.get(k, 0)
+        )
+        q.add("b/low")
+        q.add("b/vip")
+        drained = q.drain()
+        assert drained == ["b/vip", "b/low"]
+        n_reads = len(calls)
+        for k in drained:
+            q.readd(k)
+        assert len(calls) == n_reads, "readd consulted priority_fn"
+        assert q.drain() == ["b/vip", "b/low"], (
+            "cached priority lost on readd"
+        )
+
     def test_forget_resets_attempts(self):
         clock, q = make_queue()
         q.add("b/x")
