@@ -1351,6 +1351,416 @@ def _run_stream_inner(args, backend_label, verbose, seed, n_clusters,
     return rec
 
 
+# --------------------------------------------------------------------------
+# fanout: the control-plane read path (store/watchcache.py + apiserver)
+# --------------------------------------------------------------------------
+
+FANOUT_WATCHERS = 1000   # acceptance floor; the 10k point is slow-marked
+FANOUT_WINDOW_S = 3.0
+FANOUT_WRITERS = 4       # concurrent mutators (exercises WAL group commit)
+FANOUT_OBJECTS = 200
+FANOUT_KIND = "v1/ConfigMap"
+
+
+def _fanout_obj(i, t=""):
+    from karmada_tpu.api.unstructured import Unstructured
+
+    return Unstructured({
+        "apiVersion": "v1", "kind": "ConfigMap",
+        "metadata": {"name": f"obj-{i:05d}", "namespace": "bench"},
+        "data": {"t": t},
+    })
+
+
+class _FanoutCP:
+    """The minimal cp surface ControlPlaneServer needs for the byte-count
+    leg (no controllers, no PKI — the bench must run on boxes without the
+    optional cryptography stack)."""
+
+    def __init__(self, store):
+        self.store = store
+        self.members = {}
+
+    def settle(self, max_steps=0):
+        return 0
+
+    def tick(self, seconds=0.0):
+        return 0
+
+
+def _fanout_store(n_objs, data_dir):
+    """Store + attached persistence (group commit ON: the write-p99 number
+    includes durability, in both legs) pre-seeded with the object pool."""
+    from karmada_tpu.store.persistence import StorePersistence
+    from karmada_tpu.store.store import Store
+
+    store = Store()
+    pers = StorePersistence(store, data_dir)
+    pers.attach()
+    for i in range(n_objs):
+        store.create(_fanout_obj(i, t=str(time.perf_counter())))
+    return store, pers
+
+
+def _fanout_writers_run(store, n_writers, n_objs, window_s):
+    """Concurrent mutators at max rate for the window; returns per-write
+    latencies (seconds) and the write count."""
+    import threading
+
+    lats = [[] for _ in range(n_writers)]
+    counts = [0] * n_writers
+    t_end = time.perf_counter() + window_s
+
+    def writer(w):
+        j = w
+        while time.perf_counter() < t_end:
+            obj = _fanout_obj(j % n_objs, t=str(time.perf_counter()))
+            t0 = time.perf_counter()
+            store.update(obj)
+            lats[w].append(time.perf_counter() - t0)
+            counts[w] += 1
+            j += n_writers
+
+    threads = [threading.Thread(target=writer, args=(w,), daemon=True)
+               for w in range(n_writers)]
+    t_start = time.perf_counter()
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    all_lats = [x for per in lats for x in per]
+    return all_lats, sum(counts), t_start
+
+
+# serving-thread pool per leg: W watcher *streams* multiplexed over a
+# fixed pool, like any real event-loop/thread-pool server — W OS threads
+# of Python would measure the GIL scheduler, not the serving paths. The
+# PER-EVENT work is the model: the baseline pays a queue put inside the
+# store's notify fan-out plus a PER-CLIENT encode; the mux path pays one
+# under-lock encode total and a shared-bytes concatenation per client.
+FANOUT_SERVERS = 8
+
+
+def _fanout_baseline_leg(watchers, n_writers, window_s, n_objs, data_dir,
+                         drain_grace_s=25.0):
+    """OLD serving path: every watcher is a store subscription whose
+    handler runs inside the store's notify fan-out (serializing every
+    write), feeding a bounded per-client queue; the serving pool drains
+    each queue and encodes the event once PER CLIENT — the per-stream work
+    apiserver.py's per-subscription path did."""
+    import queue as queue_mod
+    import threading
+
+    from karmada_tpu.server import codec
+
+    store, pers = _fanout_store(n_objs, data_dir)
+    qs = [queue_mod.Queue(maxsize=10_000) for _ in range(watchers)]
+    drops = [0] * watchers
+    delivered = [0] * watchers
+    lat_samples = [[] for _ in range(FANOUT_SERVERS)]
+    stop = threading.Event()
+
+    for i in range(watchers):
+        def handler(event, obj, q=qs[i], i=i):
+            try:
+                q.put_nowait((event, obj))
+            except queue_mod.Full:
+                drops[i] += 1
+        store.watch(FANOUT_KIND, handler, replay=False)
+
+    def server(s):
+        idxs = range(s, watchers, FANOUT_SERVERS)
+        ticks = 0
+        while not stop.is_set():
+            moved = False
+            for i in idxs:
+                q = qs[i]
+                for _ in range(64):
+                    try:
+                        event, obj = q.get_nowait()
+                    except queue_mod.Empty:
+                        break
+                    # the legacy stream's per-client work: THIS client's
+                    # own wire encode of the event
+                    json.dumps({"kind": FANOUT_KIND, "event": event,
+                                "obj": codec.encode(obj)})
+                    delivered[i] += 1
+                    moved = True
+                    ticks += 1
+                    if ticks % 997 == 0:
+                        try:
+                            lat_samples[s].append(
+                                time.perf_counter()
+                                - float(obj.get("data", "t")))
+                        except (TypeError, ValueError):
+                            pass
+            if not moved:
+                time.sleep(0.002)
+
+    servers = [threading.Thread(target=server, args=(s,), daemon=True)
+               for s in range(FANOUT_SERVERS)]
+    for t in servers:
+        t.start()
+    write_lats, n_writes, t_start = _fanout_writers_run(
+        store, n_writers, n_objs, window_s)
+    deadline = time.monotonic() + drain_grace_s
+    while time.monotonic() < deadline:
+        if all(q.empty() for q in qs):
+            break
+        time.sleep(0.05)
+    elapsed = time.perf_counter() - t_start
+    stop.set()
+    for t in servers:
+        t.join(timeout=10.0)
+    pers.close()
+    return {
+        "events_per_s": round(sum(delivered) / elapsed, 1),
+        "delivered": sum(delivered),
+        "dropped": sum(drops),
+        "writes": n_writes,
+        "writes_per_s": round(n_writes / elapsed, 1),
+        "elapsed_s": round(elapsed, 2),
+        "write_lat": write_lats,
+        "event_lat": [x for per in lat_samples for x in per],
+    }
+
+
+def _fanout_mux_leg(watchers, n_writers, window_s, n_objs, data_dir,
+                    drain_grace_s=25.0):
+    """NEW serving path: ONE under-lock event sink feeds the revisioned
+    ring; every watcher is a cursor over shared pre-encoded lines
+    (apiserver's cached serving loop), with snapshot-resync fallback when
+    it lags past ring compaction."""
+    import threading
+
+    from karmada_tpu.metrics import wal_fsync_batch_size
+    from karmada_tpu.store.watchcache import WatchCache
+
+    batches0 = wal_fsync_batch_size.count()
+    records0 = wal_fsync_batch_size.sum()
+    store, pers = _fanout_store(n_objs, data_dir)
+    cache = WatchCache(store, capacity=65_536)
+    cache.attach()
+    start_rv = cache.current_rv
+    delivered = [0] * watchers
+    resyncs = [0] * watchers
+    cursors = [start_rv] * watchers
+    lat_samples = [[] for _ in range(FANOUT_SERVERS)]
+    stop = threading.Event()
+
+    def server(s):
+        idxs = range(s, watchers, FANOUT_SERVERS)
+        ticks = 0
+        while not stop.is_set():
+            moved = False
+            for i in idxs:
+                events, cursor, ok = cache.events_since(
+                    cursors[i], FANOUT_KIND, limit=256)
+                if not ok:
+                    resyncs[i] += 1
+                    cursors[i], _items = cache.snapshot(FANOUT_KIND)
+                    continue
+                cursors[i] = cursor
+                if not events:
+                    continue
+                # the cached stream's per-client work: concatenate the
+                # SHARED pre-encoded lines (what the HTTP loop writes)
+                b"".join(ev.line() for ev in events)
+                delivered[i] += len(events)
+                moved = True
+                ticks += 1
+                if ticks % 97 == 0:
+                    try:
+                        lat_samples[s].append(time.perf_counter() - float(
+                            events[-1].enc["manifest"]["data"]["t"]))
+                    except (KeyError, TypeError, ValueError):
+                        pass
+            if not moved:
+                time.sleep(0.002)
+
+    servers = [threading.Thread(target=server, args=(s,), daemon=True)
+               for s in range(FANOUT_SERVERS)]
+    for t in servers:
+        t.start()
+    write_lats, n_writes, t_start = _fanout_writers_run(
+        store, n_writers, n_objs, window_s)
+    deadline = time.monotonic() + drain_grace_s
+    tip = cache.current_rv
+    while time.monotonic() < deadline:
+        if min(cursors) >= tip:
+            break
+        time.sleep(0.05)
+    elapsed = time.perf_counter() - t_start
+    stop.set()
+    for t in servers:
+        t.join(timeout=10.0)
+    pers.close()
+    cache.detach()
+    return {
+        "events_per_s": round(sum(delivered) / elapsed, 1),
+        "delivered": sum(delivered),
+        "resyncs": sum(resyncs),
+        "writes": n_writes,
+        "writes_per_s": round(n_writes / elapsed, 1),
+        "elapsed_s": round(elapsed, 2),
+        "write_lat": write_lats,
+        "event_lat": [x for per in lat_samples for x in per],
+        "wal_fsync_batches": wal_fsync_batch_size.count() - batches0,
+        "wal_records": int(wal_fsync_batch_size.sum() - records0),
+    }
+
+
+def _fanout_read_watch(port, kind, since=None, expect=0, timeout_s=30.0):
+    """Raw HTTP watch reader: counts the wire bytes of event lines until
+    `expect` objects arrived; returns (bytes, highest rv seen)."""
+    import http.client
+    from urllib.parse import quote
+
+    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=5.0)
+    path = f"/watch?kind={quote(kind, safe='')}&replay=1"
+    if since is not None:
+        path += f"&since={since}"
+    conn.request("GET", path)
+    resp = conn.getresponse()
+    assert resp.status == 200, resp.status
+    total = 0
+    seen = 0
+    last_rv = 0
+    buf = b""
+    deadline = time.monotonic() + timeout_s
+    try:
+        while seen < expect and time.monotonic() < deadline:
+            chunk = resp.read1(65536)
+            if not chunk:
+                break
+            buf += chunk
+            while b"\n" in buf:
+                line, _, buf = buf.partition(b"\n")
+                if not line.strip():
+                    continue  # heartbeat
+                total += len(line) + 1
+                seen += 1
+                msg = json.loads(line.decode())
+                rv = msg.get("rv") or 0
+                last_rv = max(last_rv, rv)
+    finally:
+        conn.close()
+    return total, last_rv, seen
+
+
+def _fanout_resume_bytes(n_objs=2000, n_delta=40):
+    """Over REAL sockets: a full replay attach vs a since= resume after
+    `n_delta` missed events — the reconnect cost the satellite bounds at
+    <5% of a full replay."""
+    from karmada_tpu.server.apiserver import ControlPlaneServer
+    from karmada_tpu.store.store import Store
+
+    store = Store()
+    cp = _FanoutCP(store)
+    srv = ControlPlaneServer(cp)
+    srv.start()
+    try:
+        for i in range(n_objs):
+            store.create(_fanout_obj(i))
+        replay_bytes, last_rv, seen = _fanout_read_watch(
+            srv._port, FANOUT_KIND, expect=n_objs)
+        assert seen == n_objs, (seen, n_objs)
+        for i in range(n_delta):
+            store.update(_fanout_obj(i % n_objs, t=f"delta-{i}"))
+        resume_bytes, _, dseen = _fanout_read_watch(
+            srv._port, FANOUT_KIND, since=last_rv, expect=n_delta)
+        assert dseen == n_delta, (dseen, n_delta)
+    finally:
+        srv.stop()
+    return replay_bytes, resume_bytes
+
+
+def run_fanout(args, backend_label: str, verbose=False) -> dict:
+    """The `fanout` config: W concurrent watchers + a sustained multi-writer
+    mutation load against the OLD (per-subscription, per-client encode) and
+    NEW (revisioned ring, shared encode) serving paths — events/sec
+    delivered, end-to-end event latency, write p99 — plus the since= resume
+    byte ratio over real sockets. Pure host path (no device kernels); the
+    acceptance criteria ride the JSON line as pass_* booleans."""
+    import shutil
+    import tempfile
+
+    watchers = int(args.watchers)
+    window_s = float(args.window_s)
+    work = tempfile.mkdtemp(prefix="fanout-bench-")
+    # tighter GIL handoff for the measured windows: with 12 runnable
+    # threads the default 5 ms switch interval charges every GIL-release
+    # point in a write (locks, fsync) a full scheduling quantum, measuring
+    # the interpreter's scheduler instead of the serving paths. Applied to
+    # BOTH legs identically.
+    prev_switch = sys.getswitchinterval()
+    sys.setswitchinterval(0.0005)
+    try:
+        base = _fanout_baseline_leg(
+            watchers, FANOUT_WRITERS, window_s, FANOUT_OBJECTS,
+            os.path.join(work, "base"))
+        if verbose:
+            print(f"# fanout baseline: {base['events_per_s']:.0f} ev/s "
+                  f"({base['writes']} writes, {base['dropped']} dropped)")
+        mux = _fanout_mux_leg(
+            watchers, FANOUT_WRITERS, window_s, FANOUT_OBJECTS,
+            os.path.join(work, "mux"))
+        if verbose:
+            print(f"# fanout mux: {mux['events_per_s']:.0f} ev/s "
+                  f"({mux['writes']} writes, {mux['resyncs']} resyncs)")
+        replay_bytes, resume_bytes = _fanout_resume_bytes()
+    finally:
+        sys.setswitchinterval(prev_switch)
+        shutil.rmtree(work, ignore_errors=True)
+
+    def pct(lat):
+        p = _percentiles(lat)
+        return {k: p[k] for k in ("p50_s", "p95_s", "p99_s", "n")}
+
+    base_w = pct(base.pop("write_lat"))
+    mux_w = pct(mux.pop("write_lat"))
+    base_e = pct(base.pop("event_lat"))
+    mux_e = pct(mux.pop("event_lat"))
+    ratio = (round(mux["events_per_s"] / base["events_per_s"], 2)
+             if base["events_per_s"] else None)
+    # "no worse": within measurement noise of the baseline's write p99 —
+    # the expected result is MUCH better (no fan-out inside the write path)
+    write_ok = bool(base_w["p99_s"] and mux_w["p99_s"]
+                    and mux_w["p99_s"] <= base_w["p99_s"] * 1.05)
+    resume_frac = (round(resume_bytes / replay_bytes, 4)
+                   if replay_bytes else None)
+    rec = {
+        "metric": f"watch_fanout_{watchers}w",
+        "value": mux["events_per_s"],
+        "unit": "events/s",
+        "backend": backend_label,
+        "watchers": watchers,
+        "writers": FANOUT_WRITERS,
+        "window_s": window_s,
+        "baseline": {**base, "write": base_w, "event_latency": base_e},
+        "mux": {**mux, "write": mux_w, "event_latency": mux_e},
+        "fanout_vs_baseline": ratio,
+        "write_p99_vs_baseline": (
+            round(mux_w["p99_s"] / base_w["p99_s"], 3)
+            if base_w["p99_s"] and mux_w["p99_s"] else None
+        ),
+        "replay_bytes": replay_bytes,
+        "resume_bytes": resume_bytes,
+        "resume_frac": resume_frac,
+        "pass_fanout_5x": bool(ratio is not None and ratio >= 5.0),
+        "pass_write_p99": write_ok,
+        "pass_resume_frac": bool(resume_frac is not None
+                                 and resume_frac < 0.05),
+    }
+    rec["pass"] = (rec["pass_fanout_5x"] and rec["pass_write_p99"]
+                   and rec["pass_resume_frac"])
+    if verbose:
+        print(f"# fanout: {ratio}x events/s, write p99 "
+              f"{mux_w['p99_s']}s vs {base_w['p99_s']}s, "
+              f"resume {resume_frac} of replay -> pass={rec['pass']}")
+    return rec
+
+
 def build_flagship_cold(seed=0, n_clusters=5000, n_bindings=10000):
     """North-star variant, adversarial to the per-placement encode cache:
     every measured iteration bumps each binding's generation first
@@ -1384,13 +1794,14 @@ CONFIGS = {
     "degraded": (build_degraded, "degraded_breaker_1000rb_x_500c"),
     "coldstart": (None, None),  # subprocess-measured; see run_coldstart
     "stream": (None, None),  # daemon-topology rate drive; see run_stream
+    "fanout": (None, None),  # serving-path read scaling; see run_fanout
     "flagship_cold": (build_flagship_cold, None),  # named after the shape
     "flagship": (build_flagship, None),  # metric name carries the shape
 }
 DEFAULT_ORDER = [
     "dup3", "static", "dynamic", "spread", "spread_skewed", "churn",
     "churn_incremental", "autoshard", "pipeline", "whatif", "degraded",
-    "coldstart", "stream", "flagship_cold", "flagship",
+    "coldstart", "stream", "fanout", "flagship_cold", "flagship",
 ]
 
 # coldstart measures PROCESS boot, not round latency — a fixed modest shape
@@ -1429,6 +1840,11 @@ def add_args(ap: argparse.ArgumentParser) -> None:
     ap.add_argument("--stream-rate-hz", type=float, default=STREAM_RATE_HZ,
                     help=argparse.SUPPRESS)
     ap.add_argument("--stream-window-s", type=float, default=STREAM_WINDOW_S,
+                    help=argparse.SUPPRESS)
+    # fanout config overrides (watchers: 1000 default, 10000 slow-marked)
+    ap.add_argument("--fanout-watchers", type=int, default=FANOUT_WATCHERS,
+                    help=argparse.SUPPRESS)
+    ap.add_argument("--fanout-window-s", type=float, default=FANOUT_WINDOW_S,
                     help=argparse.SUPPRESS)
     # platform must be pinned via jax.config inside the child, not the
     # JAX_PLATFORMS env var (the TPU sitecustomize hangs on the env var)
@@ -1511,6 +1927,8 @@ def main() -> None:
             "--iters", str(iters), "--configs", args.configs,
             "--stream-rate-hz", str(args.stream_rate_hz),
             "--stream-window-s", str(args.stream_window_s),
+            "--fanout-watchers", str(args.fanout_watchers),
+            "--fanout-window-s", str(args.fanout_window_s),
         ] + (["--verbose"] if args.verbose else []) \
           + (["--platform", platform] if platform else [])
         budget = deadline - time.perf_counter()
@@ -1613,6 +2031,25 @@ def run_bench(args) -> None:
                       f"populate={rec.get('populate_s')}s "
                       f"warm={rec.get('warm_cache_s')}s "
                       f"under_ttl={rec.get('under_lease_ttl')}")
+            lines.append(json.dumps(rec))
+            continue
+        if name == "fanout":
+            import types
+
+            fo_args = types.SimpleNamespace(
+                watchers=args.fanout_watchers,
+                window_s=args.fanout_window_s,
+            )
+            try:
+                rec = run_fanout(fo_args, backend, verbose=args.verbose)
+            except Exception as e:  # noqa: BLE001 - one labeled error line
+                rec = {
+                    "metric": f"watch_fanout_{args.fanout_watchers}w",
+                    "value": None, "unit": "events/s", "backend": backend,
+                    "error": f"{type(e).__name__}: {e}"[:300],
+                }
+            # host-side serving-path bench: no device kernels involved, so
+            # the number is meaningful on any backend — no cpu-fallback note
             lines.append(json.dumps(rec))
             continue
         if name == "stream":

@@ -1588,12 +1588,26 @@ def main(argv: Optional[list[str]] = None) -> int:
     server_url = peel("--server", "KARMADA_SERVER")
     token = peel("--bearer-token", "KARMADA_TOKEN")
     cacert = peel("--cacert", "KARMADA_CACERT")
+    # --chunk-size/KARMADA_CHUNK_SIZE: list page size for every remote verb
+    # (kubectl's flag of the same name) — lists ride limit=/continue= pages
+    # pinned to one snapshot revision; 0 = single unpaginated request
+    chunk_size = peel("--chunk-size", "KARMADA_CHUNK_SIZE")
 
     if server_url:
-        from ..server.remote import RemoteControlPlane, RemoteError
+        from ..server.remote import (
+            DEFAULT_PAGE_SIZE,
+            RemoteControlPlane,
+            RemoteError,
+        )
 
+        try:
+            page_size = int(chunk_size) if chunk_size else DEFAULT_PAGE_SIZE
+        except ValueError:
+            print(f"error: --chunk-size must be an integer, got {chunk_size!r}",
+                  file=sys.stderr)
+            return 1
         cp = RemoteControlPlane(server_url, token=token or None,
-                                cafile=cacert or None)
+                                cafile=cacert or None, page_size=page_size)
         errors = (CLIError, AdmissionDenied, ConflictError, NotFoundError,
                   RemoteError, AttributeError)  # AttributeError = verb needs
         # daemon-side state the remote facade doesn't expose

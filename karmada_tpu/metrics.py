@@ -55,7 +55,21 @@ class Gauge:
     _values: dict[tuple, float] = field(default_factory=dict)
 
     def set(self, v: float, **labels: str) -> None:
-        self._values[_label_key(labels)] = v
+        # under the shared lock: render() snapshots label sets while
+        # per-client series (watch_client_lag) appear/vanish concurrently
+        with _mutate_lock:
+            self._values[_label_key(labels)] = v
+
+    def inc(self, amount: float = 1.0, **labels: str) -> None:
+        k = _label_key(labels)
+        with _mutate_lock:
+            self._values[k] = self._values.get(k, 0.0) + amount
+
+    def remove(self, **labels: str) -> None:
+        """Drop a label series (per-client gauges must not accumulate one
+        stale row per disconnected watcher forever)."""
+        with _mutate_lock:
+            self._values.pop(_label_key(labels), None)
 
     def value(self, **labels: str) -> float:
         return self._values.get(_label_key(labels), 0.0)
@@ -132,7 +146,10 @@ class MetricsRegistry:
             return m  # type: ignore[return-value]
 
     def render(self) -> str:
-        """Prometheus text exposition."""
+        """Prometheus text exposition. Label sets are snapshotted under the
+        mutation lock: per-client series (watch_client_lag) appear and
+        vanish with live connections, and iterating a dict another thread
+        is resizing raises mid-scrape."""
         out: list[str] = []
         with self._lock:
             metrics = list(self._metrics.values())
@@ -141,24 +158,32 @@ class MetricsRegistry:
                 out.append(f"# HELP {m.name} {m.help}")
             if isinstance(m, Counter):
                 out.append(f"# TYPE {m.name} counter")
-                for k, v in sorted(m._values.items()):
+                with _mutate_lock:
+                    items = sorted(m._values.items())
+                for k, v in items:
                     out.append(f"{m.name}{_fmt_labels(k)} {v}")
             elif isinstance(m, Gauge):
                 out.append(f"# TYPE {m.name} gauge")
-                for k, v in sorted(m._values.items()):
+                with _mutate_lock:
+                    items = sorted(m._values.items())
+                for k, v in items:
                     out.append(f"{m.name}{_fmt_labels(k)} {v}")
             elif isinstance(m, Histogram):
                 out.append(f"# TYPE {m.name} histogram")
-                for k in sorted(m._totals):
+                with _mutate_lock:
+                    counts = {k: list(v) for k, v in m._counts.items()}
+                    sums = dict(m._sums)
+                    totals = dict(m._totals)
+                for k in sorted(totals):
                     acc = 0
-                    for i, c in enumerate(m._counts[k]):
+                    for i, c in enumerate(counts[k]):
                         acc += c
                         le = ("le", repr(m.buckets[i]))
                         out.append(f"{m.name}_bucket{_fmt_labels(k + (le,))} {acc}")
                     inf = ("le", "+Inf")
-                    out.append(f"{m.name}_bucket{_fmt_labels(k + (inf,))} {m._totals[k]}")
-                    out.append(f"{m.name}_sum{_fmt_labels(k)} {m._sums[k]}")
-                    out.append(f"{m.name}_count{_fmt_labels(k)} {m._totals[k]}")
+                    out.append(f"{m.name}_bucket{_fmt_labels(k + (inf,))} {totals[k]}")
+                    out.append(f"{m.name}_sum{_fmt_labels(k)} {sums[k]}")
+                    out.append(f"{m.name}_count{_fmt_labels(k)} {totals[k]}")
         return "\n".join(out) + "\n"
 
 
@@ -270,6 +295,37 @@ simulation_scenarios = registry.counter(
 simulation_duration = registry.histogram(
     "karmada_simulation_duration_seconds",
     "End-to-end what-if simulation latency in seconds",
+)
+
+# control-plane read path (store/watchcache.py + the apiserver fan-out —
+# docs/PERF.md "Control-plane read path"): every watch stream is a cursor
+# into ONE shared revisioned ring, so these are the fleet-scale serving
+# signals — how many streams, how fast events leave, who is lagging, and
+# whether slow consumers are falling back to snapshot replays
+watch_clients = registry.gauge(
+    "karmada_watch_clients",
+    "Watch streams currently attached to the apiserver",
+)
+watch_events_sent = registry.counter(
+    "karmada_watch_events_sent_total",
+    "Events written to watch streams, by serving path",
+)
+watch_client_lag = registry.gauge(
+    "karmada_watch_client_lag",
+    "Per-client watch backlog (ring events not yet delivered)",
+)
+watch_resyncs = registry.counter(
+    "karmada_watch_resyncs_total",
+    "Snapshot+replay fallbacks served, by reason (compacted/lagged)",
+)
+list_pages = registry.counter(
+    "karmada_list_pages_total",
+    "Paginated list pages served from the watch cache",
+)
+wal_fsync_batch_size = registry.histogram(
+    "karmada_wal_fsync_batch_size",
+    "WAL records committed per group-commit fsync batch",
+    buckets=(1, 2, 4, 8, 16, 32, 64, 128, 256, 512, 1024),
 )
 
 # leader election (coordination/elector.py); mirrors client-go's

@@ -75,6 +75,16 @@ def main() -> None:
                          "MemberEstimators) — sized so the pipelined "
                          "scheduler round's estimate-prefetch stage can't "
                          "starve on large fleets")
+    ap.add_argument("--no-watch-cache", action="store_true",
+                    help="serve every GET /watch from its own store "
+                         "subscription instead of the shared revisioned "
+                         "ring (the pre-fan-out baseline; also disables "
+                         "paginated lists and since= watch resume)")
+    ap.add_argument("--watch-cache-events", type=int, default=0,
+                    help="watch-cache ring capacity in events (0 = default "
+                         "8192) — a reconnecting client whose since= token "
+                         "is older than the ring falls back to a full "
+                         "snapshot replay")
     ap.add_argument("--enable-test-clock", action="store_true",
                     help="allow POST /tick (advancing/freezing the plane's "
                          "Clock — test drivers only); disabled by default "
@@ -194,7 +204,9 @@ def main() -> None:
                              ssl_context=ssl_context, token=token,
                              enable_test_clock=args.enable_test_clock,
                              scrape_token=scrape_token,
-                             socket_timeout=args.socket_timeout)
+                             socket_timeout=args.socket_timeout,
+                             watch_cache=not args.no_watch_cache,
+                             watch_cache_capacity=args.watch_cache_events)
     srv.start()
     print(f"karmada-tpu control plane serving on {srv.url}", flush=True)
 

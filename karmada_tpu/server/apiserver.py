@@ -7,12 +7,16 @@ Routes (all JSON; objects wire-encoded by server/codec.py):
 | GET  /healthz        | —                         | liveness                   |
 | GET  /kinds          | store.kinds()             |                            |
 | GET  /objects        | get / list                | ?kind=&namespace=[&name=]  |
+|                      |                           | [&limit=&continue=] pages  |
+|                      |                           | pinned to a snapshot rv    |
 | POST /objects        | create                    | body {"obj": enc}          |
 | PUT  /objects        | update                    | body {"obj": enc, "check_rv"} |
 | POST /apply          | apply                     | body {"obj": enc}          |
 | DELETE /objects      | delete                    | ?kind=&name=[&namespace=]  |
-| GET  /watch          | watch / watch_all         | ?kind= (or *) [&replay=]   |
-|                      |                           | streams JSON lines         |
+| GET  /watch          | watch cache fan-out       | ?kind= (or *) [&replay=]   |
+|                      |   (store subscription     | [&since=<rv>] resumes from |
+|                      |    when cache disabled)   | the ring; streams JSON     |
+|                      |                           | lines tagged with "rv"     |
 | POST /settle         | cp.settle()               | drain controllers, blocking|
 | POST /tick           | cp.tick(seconds)          | fire timer loops           |
 | GET  /members        | cp.members keys           |                            |
@@ -53,17 +57,29 @@ Concurrency model: store CRUD is thread-safe (store.py's RLock), so request
 handlers hit it directly. Controller queues drain on a single reconcile
 thread (`_reconcile_loop`) woken by a store-wide watch — `Runtime.settle`
 is never run from two threads.
+
+Read scaling (docs/PERF.md "Control-plane read path"): by default the
+server attaches ONE revisioned WatchCache to the store and every watch
+stream is a cursor into its shared ring — the per-client store
+subscription (N watchers serializing every write inside the notify path)
+only remains as the `watch_cache=False` baseline. A slow client's cursor
+falls behind instead of overflowing a queue: it misses nothing until the
+ring compacts past it, and even then the SAME stream falls back to a
+snapshot replay instead of being closed for a full reconnect resync.
 """
 from __future__ import annotations
 
+import itertools
 import json
 import queue
 import threading
+import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Any, Optional
 from urllib.parse import parse_qs, urlparse
 
 from ..store.store import ConflictError, NotFoundError
+from ..store.watchcache import ContinueExpired
 from ..webhook.handlers import AdmissionDenied
 from . import codec
 from .httpbase import (
@@ -82,7 +98,9 @@ class ControlPlaneServer:
                  ssl_context=None, token: Optional[str] = None,
                  enable_test_clock: bool = True,
                  scrape_token: Optional[str] = None,
-                 socket_timeout: Optional[float] = None):
+                 socket_timeout: Optional[float] = None,
+                 watch_cache: bool = True,
+                 watch_cache_capacity: int = 0):
         """`enable_test_clock=False` disables POST /tick with 403: advancing
         a nonzero `seconds` freezes the plane's Clock at the advanced
         instant, which is a test-driver affordance — a production daemon
@@ -96,7 +114,14 @@ class ControlPlaneServer:
 
         `socket_timeout`: per-connection idle bound in seconds (slow-loris
         reaping, httpbase.make_http_server); None = the shared default,
-        0 disables (tests only). Daemon flag: --socket-timeout."""
+        0 disables (tests only). Daemon flag: --socket-timeout.
+
+        `watch_cache`: serve GET /watch and paginated GET /objects from a
+        shared revisioned ring (store/watchcache.py) instead of a store
+        subscription per stream. False restores the per-subscription
+        baseline (the fanout bench's comparison leg; daemon flag
+        --no-watch-cache). `watch_cache_capacity`: ring size in events
+        (0 = the module default)."""
         from .httpbase import DEFAULT_SOCKET_TIMEOUT
 
         self.cp = cp
@@ -110,6 +135,10 @@ class ControlPlaneServer:
         self._token = token
         self._scrape_token = scrape_token
         self._enable_test_clock = enable_test_clock
+        self._use_watch_cache = watch_cache
+        self._watch_cache_capacity = watch_cache_capacity
+        self._watch_cache = None
+        self._watch_ids = itertools.count(1)
         self._httpd: Optional[ThreadingHTTPServer] = None
         self._threads: list[threading.Thread] = []
         self._dirty = threading.Event()
@@ -146,6 +175,14 @@ class ControlPlaneServer:
             socket_timeout=self._socket_timeout,
         )
         self._port = self._httpd.server_address[1]
+        if self._use_watch_cache and self._watch_cache is None:
+            from ..store.watchcache import WatchCache
+
+            kwargs = {}
+            if self._watch_cache_capacity:
+                kwargs["capacity"] = self._watch_cache_capacity
+            self._watch_cache = WatchCache(self.cp.store, **kwargs)
+            self._watch_cache.attach()
         self.cp.store.watch_all(self._mark_dirty, replay=False)
         for target, name in ((self._httpd.serve_forever, "serve"),
                              (self._reconcile_loop, "reconcile")):
@@ -159,6 +196,8 @@ class ControlPlaneServer:
     def stop(self) -> None:
         self._stopping = True
         self.cp.store.unwatch_all(self._mark_dirty)
+        if self._watch_cache is not None:
+            self._watch_cache.detach()
         self._dirty.set()
         if self._httpd is not None:
             self._httpd.shutdown()
@@ -240,6 +279,10 @@ class ControlPlaneServer:
             self._send(h, 404, {"error": str(e)})
         except ConflictError as e:
             self._send(h, 409, {"error": str(e)})
+        except ContinueExpired as e:
+            # the reference's "410 Gone / expired resourceVersion": the
+            # client restarts its paginated list from the beginning
+            self._send(h, 410, {"error": str(e)})
         except AdmissionDenied as e:
             self._send(h, 422, {"error": str(e)})
         except BrokenPipeError:
@@ -299,6 +342,25 @@ class ControlPlaneServer:
         if "name" in q:
             obj = self.cp.store.get(kind, q["name"], q.get("namespace", ""))
             self._send(h, 200, {"obj": codec.encode(obj)})
+            return
+        try:
+            limit = int(q.get("limit") or 0)
+        except ValueError:
+            limit = 0
+        if limit > 0 and self._watch_cache is not None:
+            # revision-consistent pagination: every page of one crawl is
+            # served from the snapshot pinned by the first page, so writes
+            # landing mid-crawl cannot duplicate or skip items
+            from ..metrics import list_pages
+
+            rv, items, token = self._watch_cache.list_page(
+                kind, q.get("namespace", ""), limit, q.get("continue") or None
+            )
+            list_pages.inc()
+            body: dict = {"items": items, "resourceVersion": rv}
+            if token:
+                body["continue"] = token
+            self._send(h, 200, body)
         else:
             objs = self.cp.store.list(kind, q.get("namespace", ""))
             self._send(h, 200, {"items": [codec.encode(o) for o in objs]})
@@ -455,6 +517,10 @@ class ControlPlaneServer:
 
     # -- watch streaming --------------------------------------------------
 
+    # events written per batch on the cached path: bounds one client's
+    # single write() while amortizing the per-batch ring scan + flush
+    WATCH_BATCH = 256
+
     def _h_GET_watch(self, h, q):
         kind = q.get("kind", "")
         replay = q.get("replay", "1") not in ("0", "false")
@@ -465,6 +531,119 @@ class ControlPlaneServer:
         if not kind:
             self._send(h, 400, {"error": "kind required"})
             return
+        if self._watch_cache is not None:
+            self._serve_watch_cached(h, q, kind, replay, namespace)
+            return
+        self._serve_watch_subscribed(h, kind, replay, namespace)
+
+    def _serve_watch_cached(self, h, q, kind: str, replay: bool,
+                            namespace: str) -> None:
+        """Fan-out serving: this stream is a cursor into the shared
+        revisioned ring — no store subscription, no per-client queue. The
+        filter and the JSON bytes are evaluated/read here, in this
+        connection's own thread, never inside the store's notify path.
+
+        `since=<rv>`: resume — deliver only events past rv when the ring
+        still holds them, else fall back to snapshot+replay (the client
+        sent since because it HAS state; the replay reconverges it). A
+        cursor that lags past ring compaction mid-stream resyncs the same
+        way instead of being closed."""
+        from ..metrics import (
+            watch_client_lag,
+            watch_clients,
+            watch_events_sent,
+            watch_resyncs,
+        )
+
+        cache = self._watch_cache
+        client = f"c{next(self._watch_ids)}"
+        watch_clients.inc(1)
+        try:
+            h.send_response(200)
+            h.send_header("Content-Type", "application/json-lines")
+            # no Content-Length: the stream ends when either side closes
+            h.send_header("Connection", "close")
+            h.end_headers()
+            w = h.wfile
+            cursor = None
+            since = q.get("since")
+            if since is not None:
+                try:
+                    since_rv = int(since)
+                except ValueError:
+                    since_rv = -1
+                if since_rv >= 0:
+                    _, _, ok = cache.events_since(since_rv, kind, namespace,
+                                                  limit=1)
+                    # a token from a different store incarnation (rv ahead
+                    # of everything we have) is as unusable as a compacted
+                    # one — fall through to snapshot replay
+                    if ok and since_rv <= cache.current_rv:
+                        cursor = since_rv
+                    else:
+                        watch_resyncs.inc(reason="compacted")
+            if cursor is None:
+                if replay or since is not None:
+                    cursor = self._replay_snapshot(w, kind, namespace)
+                else:
+                    cursor = cache.current_rv
+            last_write = time.monotonic()
+            while not self._stopping:
+                events, cursor, ok = cache.events_since(
+                    cursor, kind, namespace, limit=self.WATCH_BATCH
+                )
+                if not ok:
+                    # lagged past ring compaction: resync IN-STREAM (the
+                    # per-subscription path closed for a full reconnect)
+                    watch_resyncs.inc(reason="lagged")
+                    cursor = self._replay_snapshot(w, kind, namespace)
+                    last_write = time.monotonic()
+                    continue
+                if not events:
+                    cache.wait(cursor, timeout=0.5)
+                    # heartbeat on WALL time since this stream's last
+                    # bytes — not on wait()'s wakeup: unrelated-kind churn
+                    # wakes the wait constantly while matching nothing, and
+                    # a byte-silent stream trips the client's read timeout
+                    if time.monotonic() - last_write >= 0.5:
+                        w.write(b"\n")
+                        w.flush()
+                        last_write = time.monotonic()
+                    continue
+                w.write(b"".join(ev.line() for ev in events))
+                w.flush()
+                last_write = time.monotonic()
+                watch_events_sent.inc(len(events), path="cache")
+                watch_client_lag.set(cache.lag(cursor), client=client)
+        except (BrokenPipeError, ConnectionResetError, OSError):
+            pass
+        finally:
+            watch_client_lag.remove(client=client)
+            watch_clients.inc(-1)
+
+    def _replay_snapshot(self, w, kind: str, namespace: str) -> int:
+        """Write the cache's revision-consistent current state as ADDED
+        lines (informer initial-list semantics); returns the snapshot rv —
+        the cursor from which live streaming continues gap-free."""
+        from ..metrics import watch_events_sent
+
+        rv, items = self._watch_cache.snapshot(kind, namespace)
+        buf = b"".join(it.added_line() for it in items)
+        if buf:
+            w.write(buf)
+            w.flush()
+            watch_events_sent.inc(len(items), path="cache")
+        return rv
+
+    def _serve_watch_subscribed(self, h, kind: str, replay: bool,
+                                namespace: str) -> None:
+        """Per-subscription baseline (watch_cache=False): every stream owns
+        a Store.watch subscription and a bounded queue filled inside the
+        store's notify path; overflow closes the stream for a full-resync
+        reconnect. Kept as the fanout bench's comparison leg."""
+        from ..metrics import watch_clients, watch_events_sent
+
+        watch_clients.inc(1)
         events: queue.Queue = queue.Queue(maxsize=10_000)
         # a client too slow for the event rate gets its stream CLOSED (not
         # silently thinned): RemoteStore reconnects with replay=1, which is
@@ -519,7 +698,9 @@ class ControlPlaneServer:
                 )
                 h.wfile.write(line.encode() + b"\n")
                 h.wfile.flush()
+                watch_events_sent.inc(path="subscription")
         except (BrokenPipeError, ConnectionResetError, OSError):
             pass
         finally:
             unsub()
+            watch_clients.inc(-1)

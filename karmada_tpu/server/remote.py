@@ -32,13 +32,28 @@ class AdmissionDeniedRemote(RemoteError):
     """Server-side admission chain rejected the operation (HTTP 422)."""
 
 
+class ContinueExpiredRemote(RemoteError):
+    """The server expired this list's continue token (HTTP 410); the
+    paginated crawl restarts from the beginning."""
+
+
+# default list page size: large enough that small fleets still list in one
+# round-trip, small enough that a 40k-binding store never materializes as
+# one response body on either side of the wire
+DEFAULT_PAGE_SIZE = 500
+
+
 class RemoteStore:
     def __init__(self, base_url: str, timeout: float = 30.0,
-                 token: Optional[str] = None, cafile: Optional[str] = None):
+                 token: Optional[str] = None, cafile: Optional[str] = None,
+                 page_size: int = DEFAULT_PAGE_SIZE):
         self.base_url = base_url.rstrip("/")
         self.timeout = timeout
         self.token = token
         self.cafile = cafile
+        # list() auto-paginates in chunks of this many objects (0 = one
+        # unpaginated request — also what pre-pagination servers serve)
+        self.page_size = page_size
         self._ssl_ctx = None
         if self.base_url.startswith("https"):
             import ssl
@@ -114,6 +129,8 @@ class RemoteStore:
                 raise NotFoundError(msg) from None
             if e.code == 409:
                 raise ConflictError(msg) from None
+            if e.code == 410:
+                raise ContinueExpiredRemote(msg) from None
             if e.code == 422:
                 raise AdmissionDeniedRemote(msg) from None
             raise RemoteError(f"HTTP {e.code}: {msg}") from None
@@ -151,9 +168,38 @@ class RemoteStore:
         except NotFoundError:
             return None
 
-    def list(self, kind: str, namespace: str = "") -> list[Any]:
-        out = self._call("GET", self._okey(kind, namespace=namespace))
-        return [codec.decode(o) for o in out["items"]]
+    def list(self, kind: str, namespace: str = "", *,
+             page_size: Optional[int] = None) -> list[Any]:
+        """Auto-paginating list: pages of `page_size` ride limit=/continue=
+        tokens pinned server-side to ONE snapshot revision, so the result
+        is revision-consistent however many round-trips it took. A server
+        without pagination support ignores the limit and answers in full
+        (no continue token ends the loop); an expired token (410) restarts
+        the crawl from scratch."""
+        size = self.page_size if page_size is None else page_size
+        base = self._okey(kind, namespace=namespace)
+        if size <= 0:
+            out = self._call("GET", base)
+            return [codec.decode(o) for o in out["items"]]
+        for _ in range(3):  # expired-token restarts
+            items: list[Any] = []
+            token = ""
+            try:
+                while True:
+                    path = base + f"&limit={size}"
+                    if token:
+                        path += f"&continue={quote(token, safe='')}"
+                    out = self._call("GET", path)
+                    items.extend(codec.decode(o) for o in out["items"])
+                    token = out.get("continue") or ""
+                    if not token:
+                        return items
+            except ContinueExpiredRemote:
+                continue
+        raise RemoteError(
+            f"list {kind}: continue token kept expiring mid-crawl "
+            f"(snapshot TTL shorter than the crawl?)"
+        )
 
     def delete(self, kind: str, name: str, namespace: str = "") -> None:
         self._call("DELETE", self._okey(kind, name, namespace))
@@ -225,7 +271,15 @@ class RemoteStore:
         def done() -> bool:
             return self._closed or stop.is_set()
 
-        def attach(with_replay: bool) -> Optional[int]:
+        # highest resourceVersion this stream has fully DELIVERED: on
+        # re-attach it rides the wire as `since=<rv>` so the server's watch
+        # cache resumes with only the missed delta instead of a full replay
+        # (an event whose handler failed does not advance it — the
+        # re-attach re-delivers exactly that event). Pre-cache servers
+        # ignore `since`; `replay=1` keeps them converging the old way.
+        last_rv = [0]
+
+        def attach(with_replay: bool, since: int) -> Optional[int]:
             """One stream attachment; returns the HTTP status (None when the
             request itself failed before a response arrived)."""
             from .. import faults
@@ -239,6 +293,8 @@ class RemoteStore:
                 raise OSError(str(e)) from None
             path = (f"/watch?kind={quote(kind, safe='')}"
                     f"&replay={'1' if with_replay else '0'}")
+            if since > 0:
+                path += f"&since={since}"
             if namespace:
                 path += f"&namespace={quote(namespace, safe='')}"
             # the server heartbeats every 0.5s; a read stalling 10x that is
@@ -270,10 +326,11 @@ class RemoteStore:
                             continue  # heartbeat
                         msg = json.loads(line.decode())
                         try:
-                            deliver(
-                                msg["kind"], msg["event"],
-                                codec.decode(msg["obj"]),
-                            )
+                            # decode stays INSIDE the try: an undecodable
+                            # event (codec skew) must end the attachment
+                            # for a resync, not kill this thread
+                            obj = codec.decode(msg["obj"])
+                            deliver(msg["kind"], msg["event"], obj)
                         except Exception:  # noqa: BLE001 - handler fault
                             # a handler doing its own I/O can fail
                             # transiently (chaos plans inject exactly
@@ -289,16 +346,21 @@ class RemoteStore:
 
                             logging.getLogger(__name__).exception(
                                 "watch %s: handler failed for one event; "
-                                "re-attaching with replay", kind,
+                                "re-attaching to resume it", kind,
                             )
                             return 200
+                        rv = msg.get("rv") or obj.metadata.resource_version
+                        if rv and rv > last_rv[0]:
+                            last_rv[0] = rv
                 return 200
             finally:
                 conn.close()
 
         def run() -> None:
-            # informer semantics: a dropped stream (server restart, overflow
-            # close) re-attaches WITH replay — the relist/resync that makes
+            # informer semantics: a dropped stream re-attaches with
+            # `since=<last delivered rv>` — the server's ring resumes with
+            # only the missed delta; when it can't (compaction, old server)
+            # the replay=1 fallback is the full relist/resync that makes
             # level-triggered consumers converge despite missed deltas.
             # Non-200 responses are LOGGED (at least once per distinct
             # status) and retried with exponential backoff instead of a
@@ -324,7 +386,8 @@ class RemoteStore:
                 status: Optional[int] = None
                 err: Optional[Exception] = None
                 try:
-                    status = attach(replay if first else True)
+                    status = attach(replay if first else True,
+                                    0 if first else last_rv[0])
                 except (OSError, json.JSONDecodeError) as e:
                     err = e
                 first = False
@@ -453,10 +516,11 @@ class RemoteControlPlane:
     daemon side, as in the reference where karmadactl is a pure API client."""
 
     def __init__(self, url: str, timeout: float = 30.0,
-                 token: Optional[str] = None, cafile: Optional[str] = None):
+                 token: Optional[str] = None, cafile: Optional[str] = None,
+                 page_size: int = DEFAULT_PAGE_SIZE):
         self.url = url.rstrip("/")
         self.store = RemoteStore(self.url, timeout=timeout, token=token,
-                                 cafile=cafile)
+                                 cafile=cafile, page_size=page_size)
         self.members = _RemoteMembers(self.store)
 
     def settle(self, max_steps: int = 0) -> int:

@@ -16,6 +16,17 @@ monotonic), so snapshot + rotated WAL + live WAL merge correctly no matter
 which rename a crash interrupted, and a torn tail line just ends that
 file's replay.
 
+Group commit (docs/PERF.md "Control-plane read path"): concurrent events
+coalesce into ONE buffered write + fsync. The first appender to find no
+commit in flight becomes the batch leader; appenders arriving while it is
+on the disk ride its batch (or the next one) and merely wait for their
+record's sequence to commit. Durability contract: when `_on_event`
+returns, the record IS on disk (fsync'd) — under W concurrent writers the
+write path pays ~1 fsync per batch instead of per record, which is what
+keeps write p99 flat while thousands of watch clients hammer the same
+plane. `fsync=False` keeps the pre-group-commit flush-only behavior
+(process-crash-safe, not power-loss-safe) for tests and benchmarks.
+
 Device state needs no persistence at all: the fleet arrays are a pure
 cache rebuilt from the Cluster objects this file restores. Member-cluster
 SIMULATIONS are not persisted — they stand in for real clusters, which
@@ -39,14 +50,26 @@ WAL_ROTATED = "wal.1.jsonl"
 
 class StorePersistence:
     def __init__(self, store: Store, data_dir: str, *,
-                 snapshot_every: int = 5000):
+                 snapshot_every: int = 5000, fsync: bool = True):
         self.store = store
         self.data_dir = data_dir
         self.snapshot_every = snapshot_every
+        self.fsync = fsync
         os.makedirs(data_dir, exist_ok=True)
-        # guards ONLY the WAL file handle — never call into the store while
-        # holding it (watch handlers can run with the store lock held)
+        # guards pending-batch state + the WAL handle pointer — never call
+        # into the store while holding it (watch handlers can run with the
+        # store lock held). Disk I/O happens OUTSIDE it, under _io_lock,
+        # so appenders can queue behind an in-flight fsync.
         self._lock = threading.Lock()
+        self._commit_cv = threading.Condition(self._lock)
+        self._io_lock = threading.Lock()  # serializes write/fsync + rotation
+        self._pending: list[str] = []
+        self._seq = 0            # sequence of the newest enqueued record
+        self._committed_seq = 0  # sequence through which disk is current
+        self._committing = False
+        # last failed batch: (through-seq, exception) — riders whose record
+        # was in it must raise too, not report durability that didn't happen
+        self._commit_error: Optional[tuple[int, BaseException]] = None
         self._wal: Optional[Any] = None
         self._wal_len = 0
         self._attached = False
@@ -106,18 +129,83 @@ class StorePersistence:
         self.store.watch_all(self._on_event, replay=False)
 
     def _on_event(self, kind: str, event: str, obj: Any) -> None:
+        """Group commit: enqueue the record, then either lead a batch to
+        disk or wait for the leader whose batch includes it. Returns only
+        once the record is durably written (fsync'd when self.fsync)."""
         line = json.dumps({
             "kind": kind, "event": event, "obj": codec.encode(obj),
         })
-        with self._lock:
+        lead = False
+        need_snapshot = False
+        with self._commit_cv:
             if self._wal is None:
                 return
-            self._wal.write(line + "\n")
-            self._wal.flush()
-            self._wal_len += 1
-            need_snapshot = self._wal_len >= self.snapshot_every
+            self._pending.append(line)
+            self._seq += 1
+            my_seq = self._seq
+            while self._committed_seq < my_seq:
+                if not self._committing:
+                    self._committing = True
+                    lead = True
+                    break  # this thread leads the next batch
+                self._commit_cv.wait()
+                if self._wal is None:
+                    return  # closed mid-wait
+            if lead:
+                batch = self._pending
+                self._pending = []
+                batch_hi = self._seq
+            else:
+                # a rider of a FAILED batch must raise like its leader did
+                # (the durability contract is per record, not per leader) —
+                # any rider with my_seq <= the failed batch's high seq had
+                # its record captured in that batch
+                err = self._commit_error
+                if err is not None and my_seq <= err[0]:
+                    raise OSError(
+                        f"WAL group commit failed: {err[1]}") from err[1]
+            # followers return without re-checking the snapshot threshold:
+            # the batch leader triggers it, so a batch crossing the line
+            # causes ONE snapshot, not one per rider
+        if lead:
+            committed = False
+            failure: Optional[BaseException] = None
+            try:
+                committed = self._commit_batch(batch)
+            except BaseException as e:
+                failure = e
+                raise
+            finally:
+                # on a failed commit (disk full, EIO) the leadership and
+                # the sequence MUST still advance — otherwise every later
+                # write parks forever on _commit_cv. The error surfaces to
+                # the leader's mutator AND to every rider of this batch.
+                with self._commit_cv:
+                    self._committed_seq = batch_hi
+                    self._committing = False
+                    if failure is not None:
+                        self._commit_error = (batch_hi, failure)
+                    if committed:
+                        self._wal_len += len(batch)
+                    need_snapshot = self._wal_len >= self.snapshot_every
+                    self._commit_cv.notify_all()
         if need_snapshot:
             self.snapshot()
+
+    def _commit_batch(self, batch: list[str]) -> bool:
+        """One buffered write + flush (+ fsync) for the whole batch."""
+        from ..metrics import wal_fsync_batch_size
+
+        with self._io_lock:
+            wal = self._wal
+            if wal is None or not batch:
+                return False
+            wal.write("".join(l + "\n" for l in batch))
+            wal.flush()
+            if self.fsync:
+                os.fsync(wal.fileno())
+        wal_fsync_batch_size.observe(len(batch))
+        return True
 
     def snapshot(self) -> int:
         """Rotate the WAL aside, write the full store state atomically,
@@ -129,7 +217,9 @@ class StorePersistence:
         rotated WAL is reflected in the state listed below; lines arriving
         after the rotation land in the fresh WAL."""
         wal1 = self._path(WAL_ROTATED)
-        with self._lock:
+        # _io_lock first: an in-flight group-commit batch must finish its
+        # write+fsync before the handle under it is rotated away
+        with self._io_lock, self._lock:
             if self._wal is not None:
                 self._wal.close()
             wal = self._path(WAL_FILE)
@@ -162,10 +252,31 @@ class StorePersistence:
 
     def close(self) -> None:
         self.store.unwatch_all(self._on_event)
-        with self._lock:
+        with self._commit_cv:
+            # wait out an in-flight batch leader: its captured batch is no
+            # longer in _pending, so closing under it would silently drop
+            # records whose mutators were promised durability (the leader
+            # would find the handle gone and write nothing). Leadership is
+            # only ever taken under this condition, so once _committing
+            # reads False HERE no new batch can start before we finish.
+            while self._committing:
+                self._commit_cv.wait(0.1)
             if self._wal is not None:
+                if self._pending:
+                    # records enqueued but never led ride out in one final
+                    # batch
+                    self._wal.write(
+                        "".join(l + "\n" for l in self._pending))
+                    self._pending = []
+                self._wal.flush()
+                if self.fsync:
+                    # the durability contract holds through shutdown: the
+                    # final batch is on disk before close() returns
+                    os.fsync(self._wal.fileno())
                 self._wal.close()
                 self._wal = None
+            self._committed_seq = self._seq
+            self._commit_cv.notify_all()
         self._attached = False
 
     # -- helpers ----------------------------------------------------------

@@ -55,12 +55,49 @@ class Store:
         self._buckets: dict[str, _Bucket] = {}
         self._rv = 0
         self._all_watchers: list[Callable[[str, str, Any], None]] = []
+        # event sinks run UNDER the mutation lock, at the point the rv is
+        # assigned — unlike watchers (notified after the lock drops, so two
+        # racing mutators may interleave), a sink observes the event log in
+        # strict resourceVersion order. This is the feed for the revisioned
+        # watch cache (store/watchcache.py); sinks must be fast and must
+        # never call back into the store.
+        self._event_sinks: list[Callable[[str, str, Any], None]] = []
         # admission chain (op, kind, obj, old) -> obj; raises to deny —
         # the apiserver admission path (reference: pkg/webhook/* handlers)
         self._admission: Optional[Callable[[str, str, Any, Any], Any]] = None
 
     def set_admission(self, admit: Callable[[str, str, Any, Any], Any]) -> None:
         self._admission = admit
+
+    def add_event_sink(self, sink: Callable[[str, str, Any], None], *,
+                       prime: Optional[Callable[[str, Any], None]] = None) -> int:
+        """Register an under-lock, rv-ordered event sink. The object passed
+        is the same post-mutation copy watchers receive; sinks needing to
+        retain it beyond the call must take their own copy (the watch cache
+        retains only the wire encoding).
+
+        `prime(kind, obj)` — when given — is called under the same lock hold
+        for every object already stored, so a cache attaches with a snapshot
+        index that is revision-consistent with the event feed (no mutation
+        can land between the prime sweep and the first sinked event).
+        Returns the store's current resourceVersion at attach time."""
+        with self._lock:
+            if prime is not None:
+                for kind, b in self._buckets.items():
+                    for o in b.objects.values():
+                        prime(kind, copy.deepcopy(o))
+            self._event_sinks.append(sink)
+            return self._rv
+
+    def remove_event_sink(self, sink: Callable[[str, str, Any], None]) -> None:
+        with self._lock:
+            if sink in self._event_sinks:
+                self._event_sinks.remove(sink)
+
+    def _sink(self, kind: str, event: str, obj: Any) -> None:
+        """Feed event sinks; caller MUST hold self._lock."""
+        for s in self._event_sinks:
+            s(kind, event, obj)
 
     # -- helpers ----------------------------------------------------------
 
@@ -124,6 +161,7 @@ class Store:
             m.generation = 1
             b.objects[key] = stored
             out = copy.deepcopy(stored)
+            self._sink(kind, ADDED, out)
         self._notify(kind, ADDED, out)
         return out
 
@@ -204,6 +242,7 @@ class Store:
                 b.objects[key] = stored
                 out = copy.deepcopy(stored)
                 deleted = False
+            self._sink(kind, DELETED if deleted else MODIFIED, out)
         self._notify(kind, DELETED if deleted else MODIFIED, out)
         return out
 
@@ -241,6 +280,7 @@ class Store:
                 obj.metadata.resource_version = self._next_rv()  # see update()
                 out = copy.deepcopy(obj)
                 deleted = True
+            self._sink(kind, DELETED if deleted else MODIFIED, out)
         self._notify(kind, DELETED if deleted else MODIFIED, out)
 
     @staticmethod
@@ -268,7 +308,13 @@ class Store:
                 stored = copy.deepcopy(obj)
                 b.objects[self._key(stored.metadata)] = stored
                 self._rv = max(self._rv, stored.metadata.resource_version)
-                loaded.append((kind, copy.deepcopy(stored)))
+                out = copy.deepcopy(stored)
+                # restored rvs arrive in file order, not rv order — the
+                # watch cache treats a non-monotonic rv as a compaction
+                # point (no since-resume across a restore), so feeding them
+                # here keeps its snapshot index complete without games
+                self._sink(kind, ADDED, out)
+                loaded.append((kind, out))
         for kind, obj in loaded:
             self._notify(kind, ADDED, obj)
         return len(loaded)

@@ -1,0 +1,316 @@
+"""Revisioned watch cache: the server-side read-scaling layer over the store.
+
+The reference control plane gets its read throughput from etcd revisions
+plus the kube-apiserver watch cache (PAPER.md L1/L6): every mutation is
+stamped with a monotonic revision, the apiserver keeps a bounded in-memory
+log of recent events plus a revision-consistent object index, and serves
+
+- resumable watches — a client reconnecting with `since=<rv>` receives
+  only the delta, not a full relist, as long as the ring still holds it;
+- consistent paginated lists — `limit=`/`continue=` pages pinned to one
+  snapshot revision, so a list crawled across many requests never shows
+  dupes or skips from writes that landed mid-crawl.
+
+This module is that analogue for the TPU build's store. `WatchCache`
+attaches to a `Store` through the under-lock event-sink seam
+(`Store.add_event_sink`), which delivers mutations in strict
+resourceVersion order — unlike the watcher bus, whose callbacks run after
+the lock drops and may interleave under concurrent writers. Each event is
+wire-encoded ONCE at append time (`server/codec.py`); every watch client
+then writes the same cached bytes, so fan-out cost per client is a filter
+check plus a socket write, not an encode.
+
+Consistency model:
+- the ring holds the last `capacity` events in rv order; `events_since(rv)`
+  is exact while `rv >= compacted_rv`, else the caller must fall back to
+  snapshot + replay (exactly the reference's "too old resource version");
+- the object index is updated in the same critical section as the ring
+  append, so `snapshot()` at rv R reflects precisely the first R events;
+- a non-monotonic rv (a persistence `restore()` replaying files in
+  file order) resets the ring and moves the compaction point forward —
+  no since-resume across a restore, snapshots stay correct.
+
+Thread-safety: one condition variable guards ring + index + pinned pages;
+`wait()` lets serving threads block for the next event without polling.
+"""
+from __future__ import annotations
+
+import bisect
+import itertools
+import json
+import threading
+import time
+from typing import Any, Optional
+
+from ..server import codec
+from .store import ADDED, DELETED, Store
+
+DEFAULT_CAPACITY = 8192
+# pinned list snapshots: a crawler must finish its pages inside the TTL
+# (refreshed per page fetch); beyond MAX_PINNED the oldest pin is dropped
+DEFAULT_PAGE_TTL = 60.0
+MAX_PINNED_PAGES = 64
+
+
+class ContinueExpired(Exception):
+    """The continue token's pinned snapshot is gone (TTL or pressure);
+    the client must restart the list from the beginning (HTTP 410)."""
+
+
+class CacheEvent:
+    """One revisioned event, wire-encoded once, shared by ring and index."""
+
+    __slots__ = ("rv", "kind", "event", "namespace", "name", "enc",
+                 "_line", "_added_line")
+
+    def __init__(self, rv: int, kind: str, event: str, namespace: str,
+                 name: str, enc: Any):
+        self.rv = rv
+        self.kind = kind
+        self.event = event
+        self.namespace = namespace
+        self.name = name
+        self.enc = enc
+        self._line: Optional[bytes] = None
+        self._added_line: Optional[bytes] = None
+
+    def matches(self, kind: str, namespace: str) -> bool:
+        if kind != "*" and self.kind != kind:
+            return False
+        return not namespace or self.namespace == namespace
+
+    def line(self) -> bytes:
+        """The JSON wire line for this event (built once, served to every
+        client). Two racing builders produce identical bytes — benign."""
+        line = self._line
+        if line is None:
+            line = (json.dumps({
+                "kind": self.kind, "event": self.event, "rv": self.rv,
+                "obj": self.enc,
+            }) + "\n").encode()
+            self._line = line
+        return line
+
+    def added_line(self) -> bytes:
+        """The same object as an ADDED line — what a snapshot replay sends
+        (informer initial-list semantics), whatever the live event was."""
+        if self.event == ADDED:
+            return self.line()
+        line = self._added_line
+        if line is None:
+            line = (json.dumps({
+                "kind": self.kind, "event": ADDED, "rv": self.rv,
+                "obj": self.enc,
+            }) + "\n").encode()
+            self._added_line = line
+        return line
+
+
+class WatchCache:
+    def __init__(self, store: Store, capacity: int = DEFAULT_CAPACITY,
+                 page_ttl: float = DEFAULT_PAGE_TTL):
+        self._store = store
+        self.capacity = max(int(capacity), 1)
+        self.page_ttl = page_ttl
+        self._cond = threading.Condition()
+        self._events: list[CacheEvent] = []
+        # kind -> (namespace, name) -> latest CacheEvent (current state)
+        self._index: dict[str, dict[tuple[str, str], CacheEvent]] = {}
+        self._rv = 0
+        self._compacted_rv = 0  # since-resume exact iff since >= this
+        # pinned list snapshots: id -> [expires, rv, items]
+        self._pages: dict[int, list] = {}
+        self._page_ids = itertools.count(1)
+        self._attached = False
+
+    # -- lifecycle --------------------------------------------------------
+
+    def attach(self) -> None:
+        """Prime the index from current store state and subscribe to the
+        event-sink seam, atomically with respect to mutations."""
+        if self._attached:
+            return
+        self._attached = True
+        rv = self._store.add_event_sink(self._on_event, prime=self._prime)
+        with self._cond:
+            self._rv = max(self._rv, rv)
+            self._compacted_rv = self._rv
+
+    def detach(self) -> None:
+        if not self._attached:
+            return
+        self._attached = False
+        self._store.remove_event_sink(self._on_event)
+
+    # -- feed (runs under the store lock) ---------------------------------
+
+    def _prime(self, kind: str, obj: Any) -> None:
+        ev = self._make_event(kind, ADDED, obj)
+        with self._cond:
+            self._apply_index(ev)
+            self._rv = max(self._rv, ev.rv)
+
+    @staticmethod
+    def _make_event(kind: str, event: str, obj: Any) -> CacheEvent:
+        m = obj.metadata
+        return CacheEvent(m.resource_version, kind, event, m.namespace,
+                          m.name, codec.encode(obj))
+
+    def _on_event(self, kind: str, event: str, obj: Any) -> None:
+        ev = self._make_event(kind, event, obj)
+        with self._cond:
+            if ev.rv <= self._rv:
+                # non-monotonic: a restore() replaying persisted files in
+                # file order. Keep the index correct, forbid since-resume
+                # across the discontinuity — and mint a FRESH store
+                # revision for it (we run under the store lock, so
+                # _next_rv is safe): a pre-restore cursor numerically
+                # equal to the post-restore tip must not alias a client
+                # that already resynced and holds the restored state.
+                self._apply_index(ev)
+                self._events.clear()
+                self._compacted_rv = self._rv = self._store._next_rv()
+            else:
+                self._rv = ev.rv
+                self._apply_index(ev)
+                self._events.append(ev)
+                if len(self._events) > self.capacity:
+                    drop = len(self._events) - self.capacity
+                    self._compacted_rv = self._events[drop - 1].rv
+                    del self._events[:drop]
+            self._cond.notify_all()
+
+    def _apply_index(self, ev: CacheEvent) -> None:
+        by_key = self._index.setdefault(ev.kind, {})
+        key = (ev.namespace, ev.name)
+        if ev.event == DELETED:
+            by_key.pop(key, None)
+        else:
+            by_key[key] = ev
+
+    # -- read side --------------------------------------------------------
+
+    @property
+    def current_rv(self) -> int:
+        with self._cond:
+            return self._rv
+
+    def events_since(self, rv: int, kind: str = "*", namespace: str = "",
+                     limit: int = 0) -> tuple[list[CacheEvent], int, bool]:
+        """Events with resourceVersion > rv matching the filter, in order.
+
+        Returns (events, cursor, ok): `cursor` is the rv the caller should
+        resume from next (past filtered-out events too, so an idle filter
+        never rescans the ring); ok=False means the ring has compacted past
+        `rv` — the caller must snapshot+replay instead."""
+        with self._cond:
+            if rv < self._compacted_rv:
+                return [], rv, False
+            events = self._events
+            lo = self._idx_after(rv)
+            out: list[CacheEvent] = []
+            cursor = rv
+            for ev in events[lo:]:
+                cursor = ev.rv
+                if ev.matches(kind, namespace):
+                    out.append(ev)
+                    if limit and len(out) >= limit:
+                        break
+            return out, cursor, True
+
+    def wait(self, rv: int, timeout: float) -> bool:
+        """Block until an event past `rv` exists (True) or timeout."""
+        with self._cond:
+            if self._rv > rv:
+                return True
+            self._cond.wait(timeout)
+            return self._rv > rv
+
+    def lag(self, rv: int) -> int:
+        """How many ring events a cursor at `rv` still has to consume —
+        the per-client backlog the lag gauge exports."""
+        with self._cond:
+            return len(self._events) - self._idx_after(rv)
+
+    def _idx_after(self, rv: int) -> int:
+        """Index of the first ring event with .rv > rv (rv-sorted ring);
+        caller must hold self._cond."""
+        return bisect.bisect_right(self._events, rv, key=lambda e: e.rv)
+
+    def snapshot(self, kind: str = "*", namespace: str = ""
+                 ) -> tuple[int, list[CacheEvent]]:
+        """Revision-consistent current state matching the filter, sorted by
+        (kind, namespace, name) — the replay source for watch fallback."""
+        with self._cond:
+            rv = self._rv
+            items = self._collect(kind, namespace)
+        return rv, items
+
+    def _collect(self, kind: str, namespace: str) -> list[CacheEvent]:
+        """Caller must hold self._cond."""
+        kinds = self._index.keys() if kind == "*" else (kind,)
+        out: list[CacheEvent] = []
+        for k in kinds:
+            by_key = self._index.get(k)
+            if not by_key:
+                continue
+            for ev in by_key.values():
+                if not namespace or ev.namespace == namespace:
+                    out.append(ev)
+        out.sort(key=lambda e: (e.kind, e.namespace, e.name))
+        return out
+
+    # -- paginated, revision-consistent lists -----------------------------
+
+    def list_page(self, kind: str, namespace: str, limit: int,
+                  token: Optional[str] = None
+                  ) -> tuple[int, list[Any], str]:
+        """One page of encoded objects. First call (token=None) pins a
+        snapshot at the current rv; the returned continue token fetches
+        later pages FROM THAT SNAPSHOT, so concurrent writes can neither
+        duplicate nor skip items across pages. Returns (rv, items, token);
+        an empty token means the list is complete."""
+        limit = max(int(limit), 1)
+        now = time.monotonic()
+        with self._cond:
+            self._prune_pages(now)
+            if token:
+                try:
+                    pid_s, off_s = token.split(":", 1)
+                    pid, off = int(pid_s), int(off_s)
+                except ValueError:
+                    raise ContinueExpired(
+                        f"malformed continue token {token!r}") from None
+                if pid <= 0 or off < 0:
+                    # a negative offset would slice from the END of the pin
+                    # and silently duplicate items across pages
+                    raise ContinueExpired(
+                        f"malformed continue token {token!r}")
+                page = self._pages.get(pid)
+                if page is None:
+                    raise ContinueExpired(
+                        "continue token expired; restart the list")
+                page[0] = now + self.page_ttl  # crawl in progress: refresh
+                _, rv, items = page
+            else:
+                rv = self._rv
+                items = self._collect(kind, namespace)
+                off = 0
+                pid = 0
+                if len(items) > limit:
+                    pid = next(self._page_ids)
+                    self._pages[pid] = [now + self.page_ttl, rv, items]
+            chunk = items[off:off + limit]
+            end = off + limit
+            next_token = f"{pid}:{end}" if end < len(items) else ""
+            if not next_token and token:
+                self._pages.pop(pid, None)  # crawl done: unpin eagerly
+            return rv, [it.enc for it in chunk], next_token
+
+    def _prune_pages(self, now: float) -> None:
+        expired = [pid for pid, p in self._pages.items() if p[0] <= now]
+        for pid in expired:
+            del self._pages[pid]
+        while len(self._pages) > MAX_PINNED_PAGES:
+            oldest = min(self._pages, key=lambda pid: self._pages[pid][0])
+            del self._pages[oldest]

@@ -1,0 +1,52 @@
+#!/usr/bin/env bash
+# Fan-out smoke: the control-plane read path at the 10k-watcher point
+# (ROADMAP item 3's bench). Single-shot: runs the `fanout` bench config —
+# 10 000 concurrent watch streams + 4 concurrent writers against BOTH
+# serving paths (per-subscription baseline vs revisioned watch cache),
+# plus the since=-resume byte measurement over real sockets — and asserts
+# the acceptance booleans the JSON line carries:
+#   pass_fanout_5x     new path delivers >= 5x the events/sec
+#   pass_write_p99     write p99 no worse than the baseline's
+#   pass_resume_frac   a since= reconnect transfers < 5% of a full replay
+# Exit 0 prints "FANOUT OK".
+#
+# Wired into the slow path as
+# tests/test_watchcache.py::TestFanoutSmokeScript (pytest -m slow).
+# Runs on CPU; needs no accelerator (the read path is pure host code).
+set -euo pipefail
+
+cd "$(dirname "$0")/.."
+PY=${PYTHON:-python}
+WORK=$(mktemp -d /tmp/fanout_smoke.XXXXXX)
+trap 'rm -rf "$WORK"' EXIT
+
+log() { echo "fanout_smoke: $*"; }
+
+JAX_PLATFORMS=cpu $PY bench.py --inner --platform cpu --configs fanout \
+    --fanout-watchers 10000 --verbose > "$WORK/out.txt" 2> "$WORK/err.txt" \
+    || { log "bench failed"; cat "$WORK/err.txt"; exit 1; }
+
+LINE=$(grep -E '^\{' "$WORK/out.txt" | tail -1)
+[ -n "$LINE" ] || { log "no JSON line emitted"; cat "$WORK/out.txt"; exit 1; }
+log "result: $LINE"
+
+FANOUT_LINE="$LINE" $PY - <<'PYEOF'
+import json
+import os
+import sys
+
+rec = json.loads(os.environ["FANOUT_LINE"])
+for key in ("pass_fanout_5x", "pass_write_p99", "pass_resume_frac", "pass"):
+    if not rec.get(key):
+        print(f"fanout_smoke: criterion {key} FAILED "
+              f"(ratio={rec.get('fanout_vs_baseline')}, "
+              f"write_p99_vs_baseline={rec.get('write_p99_vs_baseline')}, "
+              f"resume_frac={rec.get('resume_frac')})", file=sys.stderr)
+        sys.exit(1)
+print(f"fanout_smoke: {rec['watchers']} watchers, "
+      f"{rec['fanout_vs_baseline']}x events/sec, "
+      f"write p99 ratio {rec['write_p99_vs_baseline']}, "
+      f"resume frac {rec['resume_frac']}")
+PYEOF
+
+echo "FANOUT OK"

@@ -111,6 +111,13 @@ def main() -> None:
     # percentiles + max sustainable rate (docs/PERF.md)
     artifact["runs"].append(run_bench(
         ["--configs", "stream", "--run-timeout", "1500"], 1600))
+    # control-plane read path: watch fan-out throughput + write p99 at the
+    # 10k-watcher point, plus the since=-resume byte ratio (host-side
+    # serving bench — captured here so the committed artifact carries the
+    # acceptance booleans alongside the device numbers)
+    artifact["runs"].append(run_bench(
+        ["--configs", "fanout", "--fanout-watchers", "10000",
+         "--run-timeout", "600"], 700))
     # the Go-interop seam: /v1/scheduleBatch latency at flagship scale
     artifact["runs"].append(run_script(
         "scripts/bench_shim.py",

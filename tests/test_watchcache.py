@@ -1,0 +1,715 @@
+"""Control-plane read path at fleet scale (docs/PERF.md).
+
+The revisioned watch cache + fan-out serving layer between Store and the
+HTTP boundary: ring resume (`since=`), in-stream lag resync instead of
+overflow closes, revision-consistent paginated lists, and WAL group
+commit. Uses a stub control plane (bare Store) so the suite runs without
+the optional cryptography/ControlPlane stack.
+"""
+from __future__ import annotations
+
+import json
+import threading
+import time
+
+import pytest
+
+from karmada_tpu.api.unstructured import Unstructured
+from karmada_tpu.server.apiserver import ControlPlaneServer
+from karmada_tpu.server.remote import (
+    ContinueExpiredRemote,
+    RemoteStore,
+)
+from karmada_tpu.store.store import ADDED, DELETED, MODIFIED, Store
+from karmada_tpu.store.watchcache import WatchCache
+
+KIND = "v1/ConfigMap"
+
+
+def cm(name: str, ns: str = "default", val: str = "0") -> Unstructured:
+    return Unstructured({
+        "apiVersion": "v1", "kind": "ConfigMap",
+        "metadata": {"name": name, "namespace": ns},
+        "data": {"v": val},
+    })
+
+
+class _StubCP:
+    """The minimal surface ControlPlaneServer needs: a store + no-op
+    settle. Lets the read-path suite run without the full ControlPlane
+    (whose PKI needs the optional cryptography dependency)."""
+
+    def __init__(self):
+        self.store = Store()
+        self.members = {}
+
+    def settle(self, max_steps: int = 0) -> int:
+        return 0
+
+    def tick(self, seconds: float = 0.0) -> int:
+        return 0
+
+
+@pytest.fixture()
+def served_store():
+    cp = _StubCP()
+    srv = ControlPlaneServer(cp)
+    srv.start()
+    yield cp.store, srv
+    srv.stop()
+
+
+def wait_until(pred, timeout=10.0, interval=0.02):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred():
+            return True
+        time.sleep(interval)
+    return False
+
+
+# -- WatchCache unit semantics ---------------------------------------------
+
+
+class TestWatchCacheRing:
+    def test_events_since_returns_only_the_delta(self):
+        store = Store()
+        cache = WatchCache(store)
+        cache.attach()
+        for i in range(5):
+            store.create(cm(f"a-{i}"))
+        rv3 = store.get(KIND, "a-2", "default").metadata.resource_version
+        events, cursor, ok = cache.events_since(rv3, KIND)
+        assert ok
+        assert [e.name for e in events] == ["a-3", "a-4"]
+        assert cursor == cache.current_rv
+        # and nothing past the tip
+        events, _, ok = cache.events_since(cache.current_rv, KIND)
+        assert ok and events == []
+
+    def test_compaction_refuses_resume(self):
+        store = Store()
+        cache = WatchCache(store, capacity=4)
+        cache.attach()
+        objs = [store.create(cm(f"b-{i}")) for i in range(10)]
+        old_rv = objs[0].metadata.resource_version
+        _, _, ok = cache.events_since(old_rv, KIND)
+        assert not ok  # compacted past it: caller must snapshot+replay
+        # the last 4 are still resumable
+        recent = objs[5].metadata.resource_version
+        events, _, ok = cache.events_since(recent, KIND)
+        assert ok
+        assert [e.name for e in events] == ["b-6", "b-7", "b-8", "b-9"]
+
+    def test_snapshot_is_current_state_sorted(self):
+        store = Store()
+        cache = WatchCache(store)
+        cache.attach()
+        store.create(cm("z"))
+        store.create(cm("a"))
+        store.create(cm("m"))
+        store.delete(KIND, "m", "default")
+        rv, items = cache.snapshot(KIND)
+        assert [i.name for i in items] == ["a", "z"]
+        assert rv == cache.current_rv
+
+    def test_attach_primes_existing_state(self):
+        store = Store()
+        store.create(cm("pre-1"))
+        store.create(cm("pre-2"))
+        cache = WatchCache(store)
+        cache.attach()
+        _, items = cache.snapshot(KIND)
+        assert [i.name for i in items] == ["pre-1", "pre-2"]
+        # nothing before attach is resumable (ring starts at attach rv)
+        _, _, ok = cache.events_since(0, KIND)
+        assert not ok
+
+    def test_restore_resets_resume_but_keeps_index(self):
+        store = Store()
+        cache = WatchCache(store)
+        cache.attach()
+        store.create(cm("live"))
+        live_rv = cache.current_rv
+        # a persistence restore replays objects with their OLD (lower) rvs
+        old = cm("restored")
+        old.metadata.resource_version = 1
+        old.metadata.uid = "uid-r"
+        store.restore([old])
+        _, items = cache.snapshot(KIND)
+        assert {i.name for i in items} == {"live", "restored"}
+        _, _, ok = cache.events_since(live_rv, KIND)
+        assert not ok  # no since-resume across the discontinuity
+
+    def test_per_key_events_strictly_rv_ordered_under_concurrency(self):
+        """Writers racing on the same keys: the ring must hold a strictly
+        rv-increasing sequence (the under-lock sink guarantees it; the
+        plain watcher bus explicitly does NOT)."""
+        store = Store()
+        cache = WatchCache(store, capacity=100_000)
+        cache.attach()
+        n_threads, n_objs, n_iters = 4, 8, 50
+        for i in range(n_objs):
+            store.create(cm(f"k-{i}"))
+        start_rv = cache.current_rv
+
+        def writer(t):
+            for j in range(n_iters):
+                store.apply(cm(f"k-{(t + j) % n_objs}", val=f"{t}:{j}"))
+
+        threads = [threading.Thread(target=writer, args=(t,))
+                   for t in range(n_threads)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        events, _, ok = cache.events_since(start_rv, KIND)
+        assert ok and len(events) == n_threads * n_iters
+        rvs = [e.rv for e in events]
+        assert rvs == sorted(rvs) and len(set(rvs)) == len(rvs)
+        per_key: dict[str, list[int]] = {}
+        for e in events:
+            per_key.setdefault(e.name, []).append(e.rv)
+        for name, krvs in per_key.items():
+            assert krvs == sorted(krvs), name
+
+
+class TestPaginationConsistency:
+    def test_paginated_list_is_a_frozen_snapshot(self):
+        """Writes landing between pages must neither duplicate nor skip
+        items: every page comes from the snapshot pinned by page one."""
+        store = Store()
+        cache = WatchCache(store)
+        cache.attach()
+        for i in range(25):
+            store.create(cm(f"p-{i:02d}"))
+        rv0, page, token = cache.list_page(KIND, "", 10)
+        got = [o["manifest"]["metadata"]["name"] for o in page]
+        # mutate between pages: delete a not-yet-listed item, add new ones,
+        # modify a listed one
+        store.delete(KIND, "p-20", "default")
+        store.create(cm("p-99"))
+        store.apply(cm("p-00", val="changed"))
+        while token:
+            rv, page, token = cache.list_page(KIND, "", 10, token)
+            assert rv == rv0
+            got += [o["manifest"]["metadata"]["name"] for o in page]
+        assert got == [f"p-{i:02d}" for i in range(25)]  # frozen, ordered
+        assert len(got) == len(set(got))
+        # a FRESH list sees the new state
+        _, page, token = cache.list_page(KIND, "", 100)
+        names = {o["manifest"]["metadata"]["name"] for o in page}
+        assert not token
+        assert "p-99" in names and "p-20" not in names
+
+    def test_expired_token_raises(self):
+        from karmada_tpu.store.watchcache import ContinueExpired
+
+        store = Store()
+        cache = WatchCache(store, page_ttl=0.05)
+        cache.attach()
+        for i in range(6):
+            store.create(cm(f"q-{i}"))
+        _, _, token = cache.list_page(KIND, "", 2)
+        assert token
+        time.sleep(0.1)
+        with pytest.raises(ContinueExpired):
+            cache.list_page(KIND, "", 2, token)
+        with pytest.raises(ContinueExpired):
+            cache.list_page(KIND, "", 2, "not-a-token")
+        # a negative offset must 410, not slice from the end of the pin
+        _, _, tok2 = cache.list_page(KIND, "", 2)
+        pid = tok2.split(":", 1)[0]
+        with pytest.raises(ContinueExpired):
+            cache.list_page(KIND, "", 2, f"{pid}:-4")
+
+
+# -- the HTTP serving layer ------------------------------------------------
+
+
+class TestServedReadPath:
+    def test_remote_list_auto_paginates(self, served_store):
+        store, srv = served_store
+        for i in range(23):
+            store.create(cm(f"r-{i:02d}"))
+        rs = RemoteStore(srv.url, page_size=5)
+        try:
+            from karmada_tpu.metrics import list_pages
+
+            before = list_pages.total()
+            objs = rs.list(KIND)
+            assert len(objs) == 23
+            assert sorted(o.metadata.name for o in objs) == \
+                [f"r-{i:02d}" for i in range(23)]
+            assert list_pages.total() - before == 5  # ceil(23/5) pages
+            # page_size=0 keeps the unpaginated single round-trip shape
+            assert len(rs.list(KIND, page_size=0)) == 23
+        finally:
+            rs.close()
+
+    def test_expired_continue_maps_to_410_and_list_restarts(self, served_store):
+        store, srv = served_store
+        for i in range(9):
+            store.create(cm(f"s-{i}"))
+        rs = RemoteStore(srv.url, page_size=4)
+        try:
+            out = rs._call("GET", f"/objects?kind={KIND.replace('/', '%2F')}"
+                                  f"&limit=4")
+            token = out["continue"]
+            srv._watch_cache._pages.clear()  # simulate TTL/pressure expiry
+            with pytest.raises(ContinueExpiredRemote):
+                rs._call("GET", f"/objects?kind={KIND.replace('/', '%2F')}"
+                                f"&limit=4&continue={token}")
+            # the auto-paginating client restarts the crawl and completes
+            assert len(rs.list(KIND)) == 9
+        finally:
+            rs.close()
+
+    def test_watch_streams_through_the_cache(self, served_store):
+        store, srv = served_store
+        assert srv._watch_cache is not None
+        rs = RemoteStore(srv.url)
+        seen: list[tuple[str, str]] = []
+        done = threading.Event()
+
+        def handler(event, obj):
+            seen.append((event, obj.metadata.name))
+            if event == DELETED:
+                done.set()
+
+        try:
+            rs.watch(KIND, handler, replay=False)
+            time.sleep(0.3)
+            store.create(cm("w"))
+            obj = store.get(KIND, "w", "default")
+            obj.set("data", "v", "2")
+            store.update(obj)
+            store.delete(KIND, "w", "default")
+            assert done.wait(10.0), seen
+            assert [e for e, _ in seen] == [ADDED, MODIFIED, DELETED]
+        finally:
+            rs.close()
+
+    def test_watch_replay_then_live_has_no_gap_or_dupe(self, served_store):
+        store, srv = served_store
+        for i in range(10):
+            store.create(cm(f"g-{i}"))
+        rs = RemoteStore(srv.url)
+        seen: list[str] = []
+        try:
+            rs.watch(KIND, lambda ev, o: seen.append(o.metadata.name),
+                     replay=True)
+            # churn while the replay may still be in flight
+            for i in range(10, 30):
+                store.create(cm(f"g-{i}"))
+            assert wait_until(lambda: len(seen) >= 30), len(seen)
+            time.sleep(0.3)
+            assert sorted(seen) == sorted(f"g-{i}" for i in range(30))
+            assert len(seen) == 30  # exactly once each: no dupes
+        finally:
+            rs.close()
+
+    def test_watch_all_and_namespace_scope_on_cache_path(self, served_store):
+        store, srv = served_store
+        rs = RemoteStore(srv.url)
+        all_seen: list[tuple[str, str]] = []
+        ns_seen: list[str] = []
+        try:
+            rs.watch_all(lambda k, ev, o: all_seen.append((k, o.metadata.name)),
+                         replay=False)
+            rs.watch(KIND, lambda ev, o: ns_seen.append(o.metadata.name),
+                     replay=False, namespace="ns-a")
+            time.sleep(0.3)
+            store.create(cm("n-1", ns="ns-a"))
+            store.create(cm("n-2", ns="ns-b"))
+            store.create(Unstructured({
+                "apiVersion": "v1", "kind": "Secret",
+                "metadata": {"name": "n-3", "namespace": "ns-a"},
+            }))
+            assert wait_until(lambda: len(all_seen) >= 3)
+            assert wait_until(lambda: ns_seen == ["n-1"])
+            time.sleep(0.2)
+            assert ns_seen == ["n-1"]
+            assert ("v1/Secret", "n-3") in all_seen
+        finally:
+            rs.close()
+
+
+class TestOverflowAndResume:
+    def test_slow_watcher_misses_zero_events_across_overflow(self):
+        """Satellite regression: the per-subscription path CLOSED a lagging
+        stream for a full resync; the ring path must deliver every event,
+        in order, to a consumer slower than the write burst."""
+        cp = _StubCP()
+        # ring far larger than the burst: lag without compaction
+        srv = ControlPlaneServer(cp, watch_cache_capacity=4096)
+        srv.start()
+        rs = RemoteStore(srv.url)
+        seen: list[str] = []
+
+        def slow_handler(event, obj):
+            time.sleep(0.002)  # ~5x slower than the write burst
+            seen.append(obj.get("data", "v"))
+
+        try:
+            rs.watch(KIND, slow_handler, replay=False)
+            time.sleep(0.3)
+            n = 300
+            for i in range(n):
+                cp.store.apply(cm("hot", val=str(i)))
+            assert wait_until(lambda: len(seen) == n, timeout=30.0), len(seen)
+            assert seen == [str(i) for i in range(n)]  # zero missed, ordered
+        finally:
+            rs.close()
+            srv.stop()
+
+    def test_lag_past_compaction_resyncs_in_stream(self):
+        """A cursor that falls behind a TINY ring converges via an
+        in-stream snapshot replay on the SAME connection (no close)."""
+        import http.client
+        from urllib.parse import quote
+
+        cp = _StubCP()
+        srv = ControlPlaneServer(cp, watch_cache_capacity=8)
+        srv.start()
+        try:
+            from karmada_tpu.metrics import watch_resyncs
+
+            resyncs0 = watch_resyncs.total()
+            conn = http.client.HTTPConnection("127.0.0.1", srv._port,
+                                              timeout=10.0)
+            conn.request("GET", f"/watch?kind={quote(KIND, safe='')}&replay=0")
+            resp = conn.getresponse()
+            assert resp.status == 200
+            time.sleep(0.2)
+            # burst far past the ring while the client is NOT reading
+            for i in range(200):
+                cp.store.apply(cm(f"c-{i % 20}", val=str(i)))
+            # now drain: the stream must still be open and converge to the
+            # full current state without EOF
+            deadline = time.monotonic() + 15.0
+            names: set[str] = set()
+            buf = b""
+            while time.monotonic() < deadline and len(names) < 20:
+                chunk = resp.read1(65536)
+                assert chunk, "server closed the lagging stream"
+                buf += chunk
+                while b"\n" in buf:
+                    line, _, buf = buf.partition(b"\n")
+                    if not line.strip():
+                        continue
+                    msg = json.loads(line.decode())
+                    names.add(msg["obj"]["manifest"]["metadata"]["name"])
+            assert names == {f"c-{i}" for i in range(20)}
+            assert watch_resyncs.total() > resyncs0
+            conn.close()
+        finally:
+            srv.stop()
+
+    def test_reconnect_with_since_delivers_only_the_delta(self, served_store):
+        """Satellite regression: a watch re-attach used to replay the ENTIRE
+        store through the handler; with since= it must deliver only what the
+        stream missed (here: the one event whose handler failed)."""
+        store, srv = served_store
+        for i in range(20):
+            store.create(cm(f"pre-{i}"))
+        seen: list[str] = []
+        fail_once = threading.Event()
+
+        def handler(event, obj):
+            name = obj.metadata.name
+            if name == "trigger" and not fail_once.is_set():
+                fail_once.set()
+                raise RuntimeError("injected handler fault")
+            seen.append(name)
+
+        rs = RemoteStore(srv.url)
+        try:
+            rs.watch(KIND, handler, replay=True)
+            assert wait_until(lambda: len(seen) == 20), len(seen)
+            # this event's handler fails -> the stream re-attaches; with
+            # since= the 20 pre objects must NOT be replayed again
+            store.create(cm("trigger"))
+            assert wait_until(lambda: "trigger" in seen, timeout=15.0), seen
+            store.create(cm("post"))
+            assert wait_until(lambda: "post" in seen, timeout=10.0), seen
+            assert fail_once.is_set()
+            assert len([n for n in seen if n.startswith("pre-")]) == 20, \
+                "reconnect replayed the full store instead of resuming"
+        finally:
+            rs.close()
+
+    def test_watch_hard_stops_on_401(self):
+        cp = _StubCP()
+        srv = ControlPlaneServer(cp, token="sekrit")
+        srv.start()
+        rs = RemoteStore(srv.url, token="wrong")
+        try:
+            rs.watch(KIND, lambda ev, o: None, replay=False)
+            assert wait_until(
+                lambda: all(stop.is_set() for _, _, stop in rs._streams),
+                timeout=10.0,
+            ), "401 watch stream kept retrying instead of terminating"
+        finally:
+            rs.close()
+            srv.stop()
+
+
+class TestBaselineParity:
+    def test_legacy_and_cached_paths_deliver_identical_sequences(self):
+        """Bit-for-bit serving semantics: the same store churn through the
+        per-subscription baseline and the cache fan-out produces the same
+        (event, name, data) sequence."""
+        cp = _StubCP()
+        srv_new = ControlPlaneServer(cp)
+        srv_old = ControlPlaneServer(cp, watch_cache=False)
+        srv_new.start()
+        srv_old.start()
+        seqs: dict[str, list] = {"new": [], "old": []}
+        rs_new = RemoteStore(srv_new.url)
+        rs_old = RemoteStore(srv_old.url)
+        try:
+            rs_new.watch(KIND, lambda ev, o: seqs["new"].append(
+                (ev, o.metadata.name, o.get("data", "v"))), replay=False)
+            rs_old.watch(KIND, lambda ev, o: seqs["old"].append(
+                (ev, o.metadata.name, o.get("data", "v"))), replay=False)
+            time.sleep(0.3)
+            for i in range(30):
+                cp.store.apply(cm(f"x-{i % 7}", val=str(i)))
+            cp.store.delete(KIND, "x-0", "default")
+            assert wait_until(lambda: len(seqs["new"]) == 31
+                              and len(seqs["old"]) == 31), \
+                (len(seqs["new"]), len(seqs["old"]))
+            assert seqs["new"] == seqs["old"]
+        finally:
+            rs_new.close()
+            rs_old.close()
+            srv_new.stop()
+            srv_old.stop()
+
+
+@pytest.mark.slow
+class TestFanoutSmokeScript:
+    def test_fanout_smoke(self):
+        """scripts/fanout_smoke.sh: the 10k-watcher point of the fanout
+        bench — both serving paths under sustained writes, the acceptance
+        booleans asserted from the emitted JSON line."""
+        import os
+        import subprocess
+
+        repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        r = subprocess.run(
+            ["bash", "scripts/fanout_smoke.sh"],
+            capture_output=True, text=True, timeout=600, cwd=repo,
+        )
+        assert r.returncode == 0, r.stdout + r.stderr
+        assert "FANOUT OK" in r.stdout
+
+
+# -- WAL group commit ------------------------------------------------------
+
+
+class TestGroupCommit:
+    def test_concurrent_writers_coalesce_into_batches(self, tmp_path,
+                                                      monkeypatch):
+        import os as os_mod
+
+        from karmada_tpu.store.persistence import StorePersistence
+
+        store = Store()
+        p = StorePersistence(store, str(tmp_path))
+        p.attach()
+        real_fsync = os_mod.fsync
+        fsyncs = [0]
+
+        def slow_fsync(fd):
+            fsyncs[0] += 1
+            time.sleep(0.002)  # force concurrent appenders to pile up
+            real_fsync(fd)
+
+        monkeypatch.setattr(
+            "karmada_tpu.store.persistence.os.fsync", slow_fsync)
+        n_threads, n_each = 8, 20
+
+        def writer(t):
+            # create(), not apply(): apply holds the store lock through its
+            # notify, serializing writers before they ever reach the WAL —
+            # group commit only engages for genuinely concurrent appenders
+            for j in range(n_each):
+                store.create(cm(f"gc-{t}-{j}", val=str(j)))
+
+        threads = [threading.Thread(target=writer, args=(t,))
+                   for t in range(n_threads)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        p.close()
+        # durability: every record landed, exactly once
+        lines = (tmp_path / "wal.jsonl").read_text().splitlines()
+        assert len(lines) == n_threads * n_each
+        # group commit engaged: strictly fewer fsyncs than records
+        assert 0 < fsyncs[0] < n_threads * n_each
+        from karmada_tpu.metrics import wal_fsync_batch_size
+
+        assert wal_fsync_batch_size.count() > 0
+        # and a fresh store replays the full state
+        store2 = Store()
+        p2 = StorePersistence(store2, str(tmp_path))
+        assert p2.load() == n_threads * n_each
+        assert len(store2.list(KIND)) == n_threads * n_each
+
+    def test_failed_commit_surfaces_but_does_not_wedge_writes(
+            self, tmp_path, monkeypatch):
+        """A batch leader hitting EIO/disk-full must surface the error to
+        its mutator AND release leadership — later writes proceed instead
+        of parking forever on the commit condition."""
+        import os as os_mod
+
+        from karmada_tpu.store.persistence import StorePersistence
+
+        store = Store()
+        p = StorePersistence(store, str(tmp_path))
+        p.attach()
+        real_fsync = os_mod.fsync
+        fail_next = [True]
+
+        def flaky_fsync(fd):
+            if fail_next[0]:
+                fail_next[0] = False
+                raise OSError(5, "injected EIO")
+            real_fsync(fd)
+
+        monkeypatch.setattr(
+            "karmada_tpu.store.persistence.os.fsync", flaky_fsync)
+        with pytest.raises(OSError):
+            store.create(cm("doomed"))
+        # the write path recovered: this one commits and is durable
+        store.create(cm("survivor"))
+        p.close()
+        text = (tmp_path / "wal.jsonl").read_text()
+        assert "survivor" in text
+
+    def test_riders_of_a_failed_batch_see_the_error(self, tmp_path,
+                                                    monkeypatch):
+        """Durability is promised per RECORD: when a leader's batch fails,
+        every writer whose record rode that batch must raise, not return
+        as if its mutation were on disk."""
+        import os as os_mod
+
+        from karmada_tpu.store.persistence import StorePersistence
+
+        store = Store()
+        p = StorePersistence(store, str(tmp_path))
+        p.attach()
+        real_fsync = os_mod.fsync
+        calls = [0]
+
+        def fsync(fd):
+            calls[0] += 1
+            if calls[0] == 1:
+                # batch 1 (the first writer alone): slow success, so the
+                # other three writers pile into ONE pending batch
+                time.sleep(0.3)
+                real_fsync(fd)
+            elif calls[0] == 2:
+                raise OSError(28, "injected ENOSPC")  # the pile's batch
+            else:
+                real_fsync(fd)
+
+        monkeypatch.setattr("karmada_tpu.store.persistence.os.fsync", fsync)
+        errors = []
+
+        def writer(i):
+            try:
+                store.create(cm(f"ride-{i}"))
+            except OSError as e:
+                errors.append((i, str(e)))
+
+        threads = [threading.Thread(target=writer, args=(i,))
+                   for i in range(4)]
+        threads[0].start()
+        time.sleep(0.1)  # writer 0 leads batch 1, mid-slow-fsync
+        for t in threads[1:]:
+            t.start()
+        for t in threads:
+            t.join(timeout=10.0)
+        # writers 1-3 formed the doomed batch: its leader AND both riders
+        # raised; writer 0's batch succeeded
+        assert len(errors) == 3, errors
+        assert all(i != 0 for i, _ in errors), errors
+        store.create(cm("after"))
+        p.close()
+        assert "after" in (tmp_path / "wal.jsonl").read_text()
+
+    def test_close_waits_for_inflight_leader_batch(self, tmp_path,
+                                                   monkeypatch):
+        """close() racing a batch leader that captured its batch but has
+        not reached the disk yet must wait it out — closing the handle
+        under it would silently drop records whose mutators were promised
+        durability."""
+        from karmada_tpu.store.persistence import StorePersistence
+
+        store = Store()
+        p = StorePersistence(store, str(tmp_path))
+        p.attach()
+        real_commit = StorePersistence._commit_batch
+
+        def slow_commit(self, batch):
+            time.sleep(0.25)  # widen the capture->io window
+            return real_commit(self, batch)
+
+        monkeypatch.setattr(StorePersistence, "_commit_batch", slow_commit)
+        t = threading.Thread(target=lambda: store.create(cm("racer")))
+        t.start()
+        time.sleep(0.08)  # leader has captured its batch, not yet on disk
+        p.close()
+        t.join(timeout=10.0)
+        assert "racer" in (tmp_path / "wal.jsonl").read_text()
+
+    def test_single_writer_still_durable_per_event(self, tmp_path):
+        from karmada_tpu.store.persistence import StorePersistence
+
+        store = Store()
+        p = StorePersistence(store, str(tmp_path))
+        p.attach()
+        store.create(cm("one"))
+        # no close(), no flush help: the record must already be on disk
+        lines = (tmp_path / "wal.jsonl").read_text().splitlines()
+        assert len(lines) == 1
+        p.close()
+
+    def test_snapshot_during_concurrent_commits_loses_nothing(self, tmp_path):
+        from karmada_tpu.store.persistence import StorePersistence
+
+        store = Store()
+        p = StorePersistence(store, str(tmp_path), fsync=False)
+        p.attach()
+        stop = threading.Event()
+
+        def writer(t):
+            j = 0
+            while not stop.is_set():
+                store.apply(cm(f"sn-{t}", val=str(j)))
+                j += 1
+
+        threads = [threading.Thread(target=writer, args=(t,))
+                   for t in range(4)]
+        for t in threads:
+            t.start()
+        for _ in range(5):
+            time.sleep(0.02)
+            p.snapshot()
+        stop.set()
+        for t in threads:
+            t.join()
+        p.close()
+        store2 = Store()
+        p2 = StorePersistence(store2, str(tmp_path))
+        p2.load()
+        # every writer's FINAL value survived the rotations
+        for t in range(4):
+            obj = store2.try_get(KIND, f"sn-{t}", "default")
+            assert obj is not None
+            assert obj.get("data", "v") == store.get(
+                KIND, f"sn-{t}", "default").get("data", "v")
