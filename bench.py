@@ -1761,6 +1761,357 @@ def run_fanout(args, backend_label: str, verbose=False) -> dict:
     return rec
 
 
+# writeload: the control-plane write path (store/store.py batch writes)
+# --------------------------------------------------------------------------
+
+WRITELOAD_WRITERS = 32      # acceptance point: >=3x throughput, >=2x p99
+WRITELOAD_WINDOW_S = 2.0
+WRITELOAD_BATCH = 64        # objects per transactional batch call
+WRITELOAD_KEYS_PER_WRITER = 256
+
+
+def _writeload_server(writers, data_dir):
+    """The full write path under test: a live apiserver (watch cache
+    attached — every write pays the under-lock sink) over a store with
+    attached persistence (fsync ON: both legs pay full durability),
+    pre-seeded with each writer's private key range — writers never
+    conflict, so the measured delta is pure write-path overhead: per-write
+    lock holds, copies, WAL waits, and per-request HTTP round-trips."""
+    from karmada_tpu.server.apiserver import ControlPlaneServer
+    from karmada_tpu.store.persistence import StorePersistence
+    from karmada_tpu.store.store import Store
+
+    store = Store()
+    pers = StorePersistence(store, data_dir)
+    pers.attach()
+    srv = ControlPlaneServer(_FanoutCP(store))
+    srv.start()
+    for w in range(writers):
+        store.create_batch([
+            _fanout_obj(w * WRITELOAD_KEYS_PER_WRITER + j)
+            for j in range(WRITELOAD_KEYS_PER_WRITER)
+        ])
+    return store, pers, srv
+
+
+def _writeload_leg(batched, writers, window_s, data_dir,
+                   batch=WRITELOAD_BATCH):
+    """Closed-loop max-rate throughput over the SERVING SEAM: W concurrent
+    RemoteStore writers against a live apiserver. The sequential leg is
+    the old write path — one PUT /objects round-trip per object (server-
+    side, its fsyncs still coalesce across threads via the PR-8 group
+    commit; what this leg keeps paying is the per-request HTTP overhead
+    and per-write lock hold). The batched leg commits the same objects
+    `batch` at a time through ONE POST /objects/batch (one request, one
+    lock hold, one fsync). Payload objects are pre-built outside the
+    window in both legs."""
+    import threading
+
+    from karmada_tpu.metrics import wal_fsync_batch_size
+    from karmada_tpu.server.remote import RemoteStore
+
+    store, pers, srv = _writeload_server(writers, data_dir)
+    # snapshot AFTER seeding: the delta is the measured window's fsyncs
+    batches0 = wal_fsync_batch_size.count()
+    records0 = wal_fsync_batch_size.sum()
+    clients = [RemoteStore(srv.url) for _ in range(writers)]
+    payloads = [
+        [_fanout_obj(w * WRITELOAD_KEYS_PER_WRITER
+                     + k % WRITELOAD_KEYS_PER_WRITER, t="w")
+         for k in range(batch)]
+        for w in range(writers)
+    ]
+    lats = [[] for _ in range(writers)]
+    counts = [0] * writers
+    t_end = time.perf_counter() + window_s
+
+    errors = [0] * writers
+
+    def writer(w):
+        from karmada_tpu.server.remote import RemoteError
+
+        objs = payloads[w]
+        remote = clients[w]
+        while time.perf_counter() < t_end:
+            # a transport blip (accept-queue overflow under load) must not
+            # silently kill the writer thread: count it and keep driving
+            if batched:
+                t0 = time.perf_counter()
+                try:
+                    remote.update_batch(objs, chunk=batch)
+                except RemoteError:
+                    errors[w] += 1
+                    continue
+                lats[w].append(time.perf_counter() - t0)
+                counts[w] += batch
+            else:
+                for obj in objs:
+                    if time.perf_counter() >= t_end:
+                        return  # per-write window check: at high per-
+                        # request latency the 64-object inner loop would
+                        # otherwise overshoot the window several-fold
+                    t0 = time.perf_counter()
+                    try:
+                        remote.update(obj)
+                    except RemoteError:
+                        errors[w] += 1
+                        continue
+                    lats[w].append(time.perf_counter() - t0)
+                    counts[w] += 1
+
+    threads = [threading.Thread(target=writer, args=(w,), daemon=True)
+               for w in range(writers)]
+    t_start = time.perf_counter()
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    elapsed = time.perf_counter() - t_start
+    srv.stop()
+    pers.close()
+    n = sum(counts)
+    return {
+        "writes": n,
+        "writes_per_s": round(n / elapsed, 1),
+        "elapsed_s": round(elapsed, 2),
+        "errors": sum(errors),
+        "wal_fsync_batches": wal_fsync_batch_size.count() - batches0,
+        "wal_records": int(wal_fsync_batch_size.sum() - records0),
+        "write_lat": [x for per in lats for x in per],
+    }
+
+
+def _writeload_latency_leg(batched, rate_hz, window_s, data_dir,
+                           writers=WRITELOAD_WRITERS, max_batch=512):
+    """Open-loop write p99 over the serving seam: writes ARRIVE at a fixed
+    rate (the i-th at t0 + i/rate) and each one's latency is
+    arrival→durable-commit. This is the apples-to-apples p99 comparison
+    the closed loop can't give (a closed loop ties in-flight work to the
+    leg's own batch size, so Little's law charges the batched leg its own
+    depth). The sequential leg serves arrivals with W committer threads,
+    one PUT round-trip each — at an arrival rate past its capacity the
+    backlog (and so p99) grows with the window, which is exactly the
+    fleet-scale failure mode. The batched leg is ONE committer draining
+    every due arrival into a single batch request per cycle — the
+    client-side analogue of WAL group commit, batch size self-paced by
+    the backlog (the WriteCoalescer shape)."""
+    import threading
+
+    from karmada_tpu.server.remote import RemoteStore
+
+    store, pers, srv = _writeload_server(writers, data_dir)
+    n_total = max(1, int(rate_hz * window_s))
+    pool = writers * WRITELOAD_KEYS_PER_WRITER
+    payloads = [_fanout_obj(i % pool, t="r") for i in range(min(n_total, pool))]
+    lats = []
+    lats_lock = threading.Lock()
+    t0 = time.perf_counter() + 0.05  # arrivals start shortly after spawn
+
+    def arrival(i):
+        return t0 + i / rate_hz
+
+    if batched:
+        remote = RemoteStore(srv.url)
+
+        def committer():
+            done = 0
+            while done < n_total:
+                now = time.perf_counter()
+                due = 0
+                while done + due < n_total and arrival(done + due) <= now:
+                    due += 1
+                if due == 0:
+                    time.sleep(min(0.001, max(0.0, arrival(done) - now)))
+                    continue
+                due = min(due, max_batch)
+                objs = [payloads[(done + k) % len(payloads)]
+                        for k in range(due)]
+                remote.update_batch(objs, chunk=max_batch)
+                t_done = time.perf_counter()
+                with lats_lock:
+                    lats.extend(t_done - arrival(done + k)
+                                for k in range(due))
+                done += due
+
+        threads = [threading.Thread(target=committer, daemon=True)]
+    else:
+        clients = [RemoteStore(srv.url) for _ in range(writers)]
+        next_i = [0]
+        claim_lock = threading.Lock()
+
+        def committer(w):
+            remote = clients[w]
+            while True:
+                with claim_lock:
+                    i = next_i[0]
+                    if i >= n_total:
+                        return
+                    next_i[0] = i + 1
+                wait = arrival(i) - time.perf_counter()
+                if wait > 0:
+                    time.sleep(wait)
+                remote.update(payloads[i % len(payloads)])
+                t_done = time.perf_counter()
+                with lats_lock:
+                    lats.append(t_done - arrival(i))
+
+        threads = [threading.Thread(target=committer, args=(w,), daemon=True)
+                   for w in range(writers)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        # the sequential leg may fall arbitrarily far behind the arrival
+        # schedule: bound the drain so an overloaded leg still reports
+        t.join(timeout=window_s * 4 + 10)
+    srv.stop()
+    pers.close()
+    p = _percentiles(lats)
+    return {
+        "rate_hz": round(rate_hz, 1),
+        "completed": len(lats),
+        "offered": n_total,
+        "p50_s": p["p50_s"], "p95_s": p["p95_s"], "p99_s": p["p99_s"],
+    }
+
+
+def _writeload_parity(n_objs=200, chunk=16):
+    """Bit-parity of the batched write path: the same create/update op
+    sequence applied per-object vs through apply_batch must leave
+    byte-identical final stores AND byte-identical event streams (kind,
+    event, rv, encoded object). Wall-clock stamps (creationTimestamp, uid
+    counter) are pinned for the comparison so any difference is REAL."""
+    import itertools as it_mod
+
+    import karmada_tpu.store.store as store_mod
+    from karmada_tpu.server import codec
+    from karmada_tpu.store.store import Store
+
+    def op_seq():
+        ops = [_fanout_obj(i, t="v1") for i in range(n_objs)]
+        ops += [_fanout_obj(i, t="v2") for i in range(0, n_objs, 2)]
+        ops += [_fanout_obj(n_objs + i, t="v1") for i in range(chunk)]
+        return ops
+
+    old_now, old_uid = store_mod.now, store_mod.new_uid
+
+    def run(batched):
+        counter = it_mod.count(1)
+        store_mod.now = lambda: 1000.0
+        store_mod.new_uid = lambda prefix="uid": f"{prefix}-{next(counter)}"
+        store = Store()
+        events = []
+        store.watch_all(
+            lambda k, ev, o: events.append(
+                (k, ev, o.metadata.resource_version,
+                 json.dumps(codec.encode(o), sort_keys=True))
+            ),
+            replay=False,
+        )
+        ops = op_seq()
+        if batched:
+            for s in range(0, len(ops), chunk):
+                store.apply_batch(ops[s:s + chunk])
+        else:
+            for o in ops:
+                store.apply(o)
+        final = sorted(
+            json.dumps(codec.encode(o), sort_keys=True)
+            for kind in store.kinds() for o in store.list(kind)
+        )
+        return events, final
+
+    try:
+        seq_events, seq_final = run(False)
+        bat_events, bat_final = run(True)
+    finally:
+        store_mod.now, store_mod.new_uid = old_now, old_uid
+    return seq_events == bat_events and seq_final == bat_final
+
+
+def run_writeload(args, backend_label: str, verbose=False) -> dict:
+    """The `writeload` config: W concurrent writers against the sequential
+    (per-object) and batched (transactional multi-op) write paths — write
+    throughput, per-write p50/p99 (full durability in both legs), WAL
+    fsyncs per record, and the batch-vs-sequential bit-parity check. Pure
+    host path; the acceptance criteria ride the JSON line as pass_*
+    booleans (scripts/writeload_smoke.sh asserts them)."""
+    import shutil
+    import tempfile
+
+    writers = int(args.writers)
+    window_s = float(args.window_s)
+    work = tempfile.mkdtemp(prefix="writeload-bench-")
+    # same GIL-handoff tightening as the fanout bench, both legs identically
+    prev_switch = sys.getswitchinterval()
+    sys.setswitchinterval(0.0005)
+    try:
+        seq = _writeload_leg(False, writers, window_s,
+                             os.path.join(work, "seq"))
+        if verbose:
+            print(f"# writeload sequential: {seq['writes_per_s']:.0f} wr/s "
+                  f"({seq['wal_fsync_batches']} fsyncs)")
+        bat = _writeload_leg(True, writers, window_s,
+                             os.path.join(work, "bat"))
+        if verbose:
+            print(f"# writeload batched: {bat['writes_per_s']:.0f} wr/s "
+                  f"({bat['wal_fsync_batches']} fsyncs)")
+        # open-loop p99 at an arrival rate the per-object path CANNOT
+        # sustain but the batched path carries at half throttle: its
+        # backlog (and p99) grows with the window while the batched
+        # committer must both sustain the rate and keep p99 flat — the
+        # fleet-scale regime the ROADMAP names (write p99 as binding
+        # counts grow)
+        rate_hz = max(1.25 * seq["writes_per_s"], 0.5 * bat["writes_per_s"])
+        seq_lat = _writeload_latency_leg(
+            False, rate_hz, window_s, os.path.join(work, "seq-lat"),
+            writers=writers)
+        bat_lat = _writeload_latency_leg(
+            True, rate_hz, window_s, os.path.join(work, "bat-lat"),
+            writers=writers)
+        if verbose:
+            print(f"# writeload p99 @ {rate_hz:.0f}/s: batched "
+                  f"{bat_lat['p99_s']}s vs sequential {seq_lat['p99_s']}s")
+        parity = _writeload_parity()
+    finally:
+        sys.setswitchinterval(prev_switch)
+        shutil.rmtree(work, ignore_errors=True)
+
+    def pct(lat):
+        p = _percentiles(lat)
+        return {k: p[k] for k in ("p50_s", "p95_s", "p99_s", "n")}
+
+    seq_w = pct(seq.pop("write_lat"))
+    bat_w = pct(bat.pop("write_lat"))
+    tput_ratio = (round(bat["writes_per_s"] / seq["writes_per_s"], 2)
+                  if seq["writes_per_s"] else None)
+    p99_ratio = (round(seq_lat["p99_s"] / bat_lat["p99_s"], 2)
+                 if bat_lat["p99_s"] and seq_lat["p99_s"] else None)
+    rec = {
+        "metric": f"write_throughput_{writers}w",
+        "value": bat["writes_per_s"],
+        "unit": "writes/s",
+        "backend": backend_label,
+        "writers": writers,
+        "batch": WRITELOAD_BATCH,
+        "window_s": window_s,
+        "sequential": {**seq, "call": seq_w, "latency": seq_lat},
+        "batched": {**bat, "call": bat_w, "latency": bat_lat},
+        "batched_vs_sequential": tput_ratio,
+        "write_p99_improvement": p99_ratio,
+        "parity": bool(parity),
+        "pass_write_3x": bool(tput_ratio is not None and tput_ratio >= 3.0),
+        "pass_write_p99_2x": bool(p99_ratio is not None and p99_ratio >= 2.0),
+        "pass_parity": bool(parity),
+    }
+    rec["pass"] = (rec["pass_write_3x"] and rec["pass_write_p99_2x"]
+                   and rec["pass_parity"])
+    if verbose:
+        print(f"# writeload: {tput_ratio}x writes/s, open-loop p99 "
+              f"{bat_lat['p99_s']}s vs {seq_lat['p99_s']}s ({p99_ratio}x), "
+              f"parity={parity} -> pass={rec['pass']}")
+    return rec
+
+
 def build_flagship_cold(seed=0, n_clusters=5000, n_bindings=10000):
     """North-star variant, adversarial to the per-placement encode cache:
     every measured iteration bumps each binding's generation first
@@ -1795,13 +2146,15 @@ CONFIGS = {
     "coldstart": (None, None),  # subprocess-measured; see run_coldstart
     "stream": (None, None),  # daemon-topology rate drive; see run_stream
     "fanout": (None, None),  # serving-path read scaling; see run_fanout
+    "writeload": (None, None),  # write-path batching; see run_writeload
     "flagship_cold": (build_flagship_cold, None),  # named after the shape
     "flagship": (build_flagship, None),  # metric name carries the shape
 }
 DEFAULT_ORDER = [
     "dup3", "static", "dynamic", "spread", "spread_skewed", "churn",
     "churn_incremental", "autoshard", "pipeline", "whatif", "degraded",
-    "coldstart", "stream", "fanout", "flagship_cold", "flagship",
+    "coldstart", "stream", "fanout", "writeload", "flagship_cold",
+    "flagship",
 ]
 
 # coldstart measures PROCESS boot, not round latency — a fixed modest shape
@@ -1846,6 +2199,11 @@ def add_args(ap: argparse.ArgumentParser) -> None:
                     help=argparse.SUPPRESS)
     ap.add_argument("--fanout-window-s", type=float, default=FANOUT_WINDOW_S,
                     help=argparse.SUPPRESS)
+    # writeload config overrides (writers: the W=32 acceptance point)
+    ap.add_argument("--writeload-writers", type=int,
+                    default=WRITELOAD_WRITERS, help=argparse.SUPPRESS)
+    ap.add_argument("--writeload-window-s", type=float,
+                    default=WRITELOAD_WINDOW_S, help=argparse.SUPPRESS)
     # platform must be pinned via jax.config inside the child, not the
     # JAX_PLATFORMS env var (the TPU sitecustomize hangs on the env var)
     ap.add_argument("--platform", default=None, help=argparse.SUPPRESS)
@@ -1929,6 +2287,8 @@ def main() -> None:
             "--stream-window-s", str(args.stream_window_s),
             "--fanout-watchers", str(args.fanout_watchers),
             "--fanout-window-s", str(args.fanout_window_s),
+            "--writeload-writers", str(args.writeload_writers),
+            "--writeload-window-s", str(args.writeload_window_s),
         ] + (["--verbose"] if args.verbose else []) \
           + (["--platform", platform] if platform else [])
         budget = deadline - time.perf_counter()
@@ -2050,6 +2410,24 @@ def run_bench(args) -> None:
                 }
             # host-side serving-path bench: no device kernels involved, so
             # the number is meaningful on any backend — no cpu-fallback note
+            lines.append(json.dumps(rec))
+            continue
+        if name == "writeload":
+            import types
+
+            wl_args = types.SimpleNamespace(
+                writers=args.writeload_writers,
+                window_s=args.writeload_window_s,
+            )
+            try:
+                rec = run_writeload(wl_args, backend, verbose=args.verbose)
+            except Exception as e:  # noqa: BLE001 - one labeled error line
+                rec = {
+                    "metric": f"write_throughput_{args.writeload_writers}w",
+                    "value": None, "unit": "writes/s", "backend": backend,
+                    "error": f"{type(e).__name__}: {e}"[:300],
+                }
+            # host-side write-path bench: meaningful on any backend
             lines.append(json.dumps(rec))
             continue
         if name == "stream":

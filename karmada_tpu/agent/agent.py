@@ -56,12 +56,26 @@ class Lease:
 
 
 class KarmadaAgent:
-    def __init__(self, store: Store, member, interpreter, runtime: Runtime):
+    def __init__(self, store: Store, member, interpreter, runtime: Runtime,
+                 status_flush_delay: float = 0.0):
+        """`status_flush_delay` > 0 coalesces the per-Work applied-condition
+        status reports through a WriteCoalescer (store/batching.py): a
+        settle pass draining N Works writes their conditions as one batch
+        call after the delay instead of N round-trips. 0 (the in-process
+        default) writes through synchronously. Correctness-bearing writes
+        (finalizers, deletion) are never buffered."""
         self.store = store
         self.member = member
         self.interpreter = interpreter
         self.clock = runtime.clock
         self.namespace = work_namespace_for_cluster(member.name)
+        self._status_coalescer = None
+        if status_flush_delay > 0:
+            from ..store.batching import WriteCoalescer
+
+            self._status_coalescer = WriteCoalescer(
+                store, flush_delay=status_flush_delay, path="agent_status",
+            )
         self.controller = runtime.register(
             Controller(name=f"agent-{member.name}", reconcile=self._reconcile)
         )
@@ -104,13 +118,29 @@ class KarmadaAgent:
                 message="; ".join(errors) if errors else "Manifest has been successfully applied",
             ),
         ):
-            self.store.update(work)
+            # the applied-condition report is level-triggered and idempotent
+            # — the one write that may ride the coalescing buffer
+            if self._status_coalescer is not None:
+                self._status_coalescer.apply(work)
+            else:
+                self.store.update(work)
         if any(not r.ok and r.retryable for r in results):
             # same policy as the push-mode controller: only retryable
             # failures re-dispatch (faults/policy — the agent shares the
             # queue's bounded retry budget)
             return REQUEUE
         return DONE
+
+    def flush_status(self) -> int:
+        """Commit buffered status reports now (the session's step boundary);
+        no-op when coalescing is off. Returns how many writes flushed."""
+        if self._status_coalescer is None:
+            return 0
+        return self._status_coalescer.flush()
+
+    def close(self) -> None:
+        if self._status_coalescer is not None:
+            self._status_coalescer.close()
 
     # -- heartbeat (cluster lease + status refresh) -----------------------
 

@@ -13,6 +13,24 @@ from typing import Any, Optional
 from .meta import ObjectMeta
 
 
+def copy_json_tree(x: Any) -> Any:
+    """Deep-copy a JSON-shaped tree several times faster than copy.deepcopy
+    (no memo bookkeeping, no reduce protocol) — manifests are copied on
+    every store write, so this is control-plane write-path time. Non-JSON
+    leaves (rare: objects placed via set()) fall back to copy.deepcopy for
+    fidelity. Aliased sub-trees are duplicated rather than shared, which
+    only strengthens isolation for store semantics; cycles are the
+    caller's bug (json.dumps would reject the manifest anyway)."""
+    t = x.__class__
+    if t is dict:
+        return {k: copy_json_tree(v) for k, v in x.items()}
+    if t is list:
+        return [copy_json_tree(v) for v in x]
+    if t is str or t is int or t is float or t is bool or x is None:
+        return x
+    return copy.deepcopy(x)
+
+
 class Unstructured:
     """Dict-backed object: {'apiVersion','kind','metadata',...}."""
 
@@ -70,7 +88,18 @@ class Unstructured:
 
     def to_dict(self) -> dict:
         self.sync_meta()
-        return copy.deepcopy(self._m)
+        return copy_json_tree(self._m)
+
+    def spec_view(self) -> dict:
+        """The manifest minus status/metadata WITHOUT copying — the store's
+        generation-diff compares two of these for equality only. Read-only:
+        the values alias the live manifest; callers must not mutate or
+        retain them. (to_dict() here deepcopied the whole manifest twice
+        per update, inside the store's critical section.)"""
+        return {
+            k: v for k, v in self._m.items()
+            if k not in ("status", "metadata")
+        }
 
     def merge_patch(self, patch: dict) -> None:
         """RFC 7386 merge-patch applied in place: null deletes, dicts
@@ -120,7 +149,7 @@ class Unstructured:
 
     def __deepcopy__(self, memo):
         self.sync_meta()
-        return Unstructured(copy.deepcopy(self._m, memo))
+        return Unstructured(copy_json_tree(self._m))
 
     def __repr__(self) -> str:
         return f"Unstructured({self.api_version}/{self.kind} {self.metadata.key()})"

@@ -93,6 +93,11 @@ class BindingController:
         )
         suspend_dispatch = rb.spec.suspension.dispatching if rb.spec.suspension else False
         keep = set()
+        # per-cluster Works accumulate here and commit as ONE transactional
+        # batch write after the loop (store/batching.py): a binding fanning
+        # out to N clusters was N store round-trips / N lock holds / N WAL
+        # fsyncs — now one of each per chunk, same objects and events
+        pending_works: list[Work] = []
         for tc in targets:
             keep.add(tc.name)
             manifest_obj: Unstructured = template.__deepcopy__({})
@@ -163,12 +168,13 @@ class BindingController:
                 workload_manifests=[manifest],
                 suspend_dispatching=suspend_dispatch,
             )
-            if existing is None:
+            if existing is None or existing.spec != new_spec:
                 work.spec = new_spec
-                self.store.create(work)
-            elif existing.spec != new_spec:
-                work.spec = new_spec
-                self.store.update(work)
+                pending_works.append(work)
+        if pending_works:
+            from ..store.batching import apply_all
+
+            apply_all(self.store, pending_works, path="binding_works")
         # Graceful eviction: Works on evicting clusters (PurgeMode != Immediately)
         # survive until the eviction task is assessed away
         # (helper.ObtainBindingSpecExistingClusters).

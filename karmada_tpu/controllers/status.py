@@ -41,11 +41,14 @@ class WorkStatusController:
         runtime: Runtime,
         execution_controller=None,
         namespace: str = "",  # agent mode: scope to one execution namespace
+        status_coalescer=None,  # store/batching.WriteCoalescer: batch the
+        #   per-Work reflection writes (remote agents share the agent's)
     ) -> None:
         self.store = store
         self.members = members
         self.interpreter = interpreter
         self.execution_controller = execution_controller
+        self.status_coalescer = status_coalescer
         self.controller = runtime.register(
             Controller(name="work-status", reconcile=self._reconcile)
         )
@@ -103,7 +106,13 @@ class WorkStatusController:
             )
         if statuses != work.status.manifest_statuses:
             work.status.manifest_statuses = statuses
-            self.store.update(work)
+            if self.status_coalescer is not None:
+                # level-triggered + idempotent: safe to buffer — a write
+                # lost to a same-key race re-converges on the next event,
+                # exactly like two racing read-modify-write updates did
+                self.status_coalescer.apply(work)
+            else:
+                self.store.update(work)
         return DONE
 
 

@@ -328,6 +328,35 @@ wal_fsync_batch_size = registry.histogram(
     buckets=(1, 2, 4, 8, 16, 32, 64, 128, 256, 512, 1024),
 )
 
+# control-plane write path (store/store.py transactional batch writes —
+# docs/PERF.md "Write path at fleet scale"): how long writers queue on the
+# store's one mutation lock, how long the critical section actually is once
+# encode/copies/notify moved out of it, how many objects each transactional
+# batch commits, and how many writes the coalescing call sites (scheduler
+# patch, binding Work fan-out, agent status) merged into batch calls
+store_lock_wait = registry.histogram(
+    "karmada_store_lock_wait_seconds",
+    "Wall seconds a mutator waited to acquire the store write lock",
+    buckets=(1e-6, 5e-6, 1e-5, 5e-5, 1e-4, 5e-4, 1e-3, 5e-3,
+             0.01, 0.05, 0.1, 0.5, 1.0),
+)
+store_lock_hold = registry.histogram(
+    "karmada_store_lock_hold_seconds",
+    "Wall seconds the store write lock was held per mutation/batch",
+    buckets=(1e-6, 5e-6, 1e-5, 5e-5, 1e-4, 5e-4, 1e-3, 5e-3,
+             0.01, 0.05, 0.1, 0.5, 1.0),
+)
+txn_batch_size = registry.histogram(
+    "karmada_txn_batch_size",
+    "Objects committed per transactional store batch write",
+    buckets=(1, 2, 4, 8, 16, 32, 64, 128, 256, 512, 1024, 2048, 4096),
+)
+writes_coalesced = registry.counter(
+    "karmada_writes_coalesced_total",
+    "Writes that rode a coalesced batch call instead of their own "
+    "round-trip, by call-site path",
+)
+
 # leader election (coordination/elector.py); mirrors client-go's
 # leader_election_master_status + rest of the election metric family
 leader_election_is_leader = registry.gauge(

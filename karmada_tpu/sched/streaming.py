@@ -392,6 +392,7 @@ class StreamingScheduler:
         transient error). Returns the count of clean (needed-no-schedule)
         keys."""
         clean = 0
+        observed: list = []
         for key in keys:
             # epoch BEFORE the spec read: an event landing in between
             # discards a decision that was in fact computed on the fresh
@@ -420,10 +421,13 @@ class StreamingScheduler:
                 out_keys.append(key)
                 epochs.append(epoch)
             else:  # clean
-                daemon._record_observed(rb)
+                daemon._record_observed(rb, sink=observed)
                 daemon.admission.settle(key)
                 self._suspects.discard(key)
                 clean += 1
+        # one batch write for the drain's observed-generation bookkeeping
+        # (a raise here rides the caller's re-admit-everything contract)
+        daemon._flush_observed(observed)
         return clean
 
     # -- launch / patch (StreamPipeline callbacks) -------------------------
@@ -494,6 +498,7 @@ class StreamingScheduler:
         q = daemon.controller.queue
         admission = daemon.admission
         placed = failed = stale = 0
+        cohort = []
         for key, epoch0, rb, dec in zip(mb.keys, mb.epochs, mb.bindings,
                                         decisions):
             if admission.epoch(key) != epoch0:
@@ -503,7 +508,13 @@ class StreamingScheduler:
                 stale += 1
                 continue
             schedule_attempts.inc(result="scheduled" if dec.ok else "error")
-            if not daemon._patch_result(rb, dec):
+            cohort.append((key, rb, dec))
+        # coalesced patch (docs/PERF.md "Write path at fleet scale"): one
+        # batch read + ONE transactional batch write for the whole cohort —
+        # the micro-batch's B decisions were 2·B store round-trips
+        outcomes = daemon._patch_results([(rb, dec) for _, rb, dec in cohort])
+        for (key, rb, dec), ok in zip(cohort, outcomes):
+            if not ok:
                 # last-moment veto under the store's serialization: a
                 # deletion/suspension/re-target landed AFTER the epoch
                 # check above — the epoch fence is check-then-act, and

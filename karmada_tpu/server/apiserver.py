@@ -12,6 +12,11 @@ Routes (all JSON; objects wire-encoded by server/codec.py):
 | POST /objects        | create                    | body {"obj": enc}          |
 | PUT  /objects        | update                    | body {"obj": enc, "check_rv"} |
 | POST /apply          | apply                     | body {"obj": enc}          |
+| POST /objects/batch  | *_batch / get_batch       | transactional multi-op:    |
+|                      |                           | {"op", "objs"} all-or-     |
+|                      |                           | nothing, one lock hold +   |
+|                      |                           | one fsync; 409/422 carry   |
+|                      |                           | per-object typed results   |
 | DELETE /objects      | delete                    | ?kind=&name=[&namespace=]  |
 | GET  /watch          | watch cache fan-out       | ?kind= (or *) [&replay=]   |
 |                      |   (store subscription     | [&since=<rv>] resumes from |
@@ -369,6 +374,58 @@ class ControlPlaneServer:
         obj = codec.decode(self._body(h)["obj"])
         out = self.cp.store.create(obj)
         self._send(h, 200, {"obj": codec.encode(out)})
+
+    def _h_POST_objects_batch(self, h, q):
+        """Transactional batch writes (docs/PERF.md "Write path at fleet
+        scale"): body {"op": "create"|"update"|"apply", "objs": [enc...]}
+        (+ "check_rv"/"skip_missing" for update) commits every object under
+        ONE store lock hold with contiguous resourceVersions and one WAL
+        fsync — or commits NOTHING, answering 409/422 with per-object typed
+        results so the client's retry policy can tell re-send-the-rest from
+        drop-this-one. op "get" batches point reads: {"op": "get", "kind":
+        ..., "keys": [[name, namespace], ...]} -> objs (null = missing)."""
+        from ..store.store import BatchError
+
+        body = self._body(h)
+        op = body.get("op", "apply")
+        store = self.cp.store
+        if op == "get":
+            keys = [(k[0], k[1] if len(k) > 1 else "")
+                    for k in body.get("keys", [])]
+            objs = store.get_batch(body.get("kind", ""), keys)
+            self._send(h, 200, {"objs": [
+                None if o is None else codec.encode(o) for o in objs
+            ]})
+            return
+        objs = [codec.decode(o) for o in body.get("objs", [])]
+        try:
+            if op == "create":
+                outs = store.create_batch(objs)
+            elif op == "update":
+                outs = store.update_batch(
+                    objs, check_rv=bool(body.get("check_rv")),
+                    skip_missing=bool(body.get("skip_missing")),
+                    skip_stale=bool(body.get("skip_stale")),
+                )
+            elif op == "apply":
+                outs = store.apply_batch(objs)
+            else:
+                self._send(h, 400, {"error": f"unknown batch op {op!r}"})
+                return
+        except BatchError as e:
+            reasons = {r.reason for r in e.results}
+            # conflict dominates (retryable, like the single-object 409);
+            # a pure admission failure maps to the single-object 422
+            status = (409 if "conflict" in reasons
+                      else 422 if "admission" in reasons else 400)
+            self._send(h, status, {"error": str(e), "results": [
+                {"ok": r.ok, "reason": r.reason, "error": r.error}
+                for r in e.results
+            ]})
+            return
+        self._send(h, 200, {"objs": [
+            None if o is None else codec.encode(o) for o in outs
+        ]})
 
     def _h_PUT_objects(self, h, q):
         body = self._body(h)

@@ -124,9 +124,19 @@ def make_http_server(host: str, port: int, handler_cls,
             {"timeout": socket_timeout},
         )
     if ssl_context is None:
-        httpd = ThreadingHTTPServer((host, port), handler_cls)
+        class PlainServer(ThreadingHTTPServer):
+            # accept backlog: the socketserver default of 5 turns a fleet
+            # of agents reconnecting at once (control-plane restart, or W
+            # writers opening a connection per request) into
+            # connection-refused storms — writers then die or retry-spin.
+            # 128 rides the kernel somaxconn clamp.
+            request_queue_size = 128
+
+        httpd = PlainServer((host, port), handler_cls)
     else:
         class TLSServer(ThreadingHTTPServer):
+            request_queue_size = 128  # see PlainServer
+
             def finish_request(self, request, client_address):
                 import ssl
 

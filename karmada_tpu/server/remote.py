@@ -14,13 +14,14 @@ from __future__ import annotations
 
 import json
 import threading
-from typing import Any, Callable, Optional
+import time
+from typing import Any, Callable, Iterable, Optional
 from urllib.error import HTTPError
 from urllib.parse import quote, urlparse
 from urllib.request import Request, urlopen
 
 from ..api.unstructured import Unstructured
-from ..store.store import ConflictError, NotFoundError
+from ..store.store import BatchError, BatchOpResult, ConflictError, NotFoundError, gvk_of
 from . import codec
 
 
@@ -41,6 +42,16 @@ class ContinueExpiredRemote(RemoteError):
 # round-trip, small enough that a 40k-binding store never materializes as
 # one response body on either side of the wire
 DEFAULT_PAGE_SIZE = 500
+
+# batch-write chunk: one POST /objects/batch per this many objects (one
+# store lock hold + one WAL fsync server-side); sized so a chunk's request
+# body stays well under a megabyte for typical bindings/works
+DEFAULT_BATCH_CHUNK = 256
+
+
+class _NoBatchRoute(Exception):
+    """The server predates POST /objects/batch (404): fall back to the
+    per-object calls so new clients keep working against old daemons."""
 
 
 class RemoteStore:
@@ -158,6 +169,182 @@ class RemoteStore:
 
     def apply(self, obj: Any) -> Any:
         return codec.decode(self._call("POST", "/apply", {"obj": codec.encode(obj)})["obj"])
+
+    # -- transactional batch writes (POST /objects/batch) ------------------
+
+    def _call_batch(self, body: dict) -> dict:
+        """One batch round-trip. 4xx answers carrying per-object results
+        raise the store's own BatchError so remote and in-process callers
+        share one failure vocabulary; 404 (a pre-batch server) raises
+        _NoBatchRoute for the per-object fallback."""
+        from .. import faults
+
+        try:
+            faults.check(faults.BOUNDARY_HTTP, self._fault_target)
+        except faults.InjectedFault as e:
+            raise RemoteError(f"control plane unreachable: {e}") from None
+        data = json.dumps(body).encode()
+        req = Request(
+            self.base_url + "/objects/batch", data=data, method="POST",
+            headers=self._headers(True),
+        )
+        try:
+            with urlopen(req, timeout=self.timeout,
+                         context=self._ssl_ctx) as resp:
+                return json.loads(resp.read().decode() or "{}")
+        except HTTPError as e:
+            try:
+                payload = json.loads(e.read().decode())
+            except Exception:  # noqa: BLE001
+                payload = {}
+            msg = payload.get("error", str(e))
+            if e.code == 404:
+                raise _NoBatchRoute(msg) from None
+            results = payload.get("results")
+            if e.code in (400, 409, 422) and results is not None:
+                raise BatchError(msg, [
+                    BatchOpResult(ok=bool(r.get("ok")),
+                                  reason=r.get("reason", ""),
+                                  error=r.get("error", ""))
+                    for r in results
+                ]) from None
+            if e.code == 409:
+                raise ConflictError(msg) from None
+            if e.code == 422:
+                raise AdmissionDeniedRemote(msg) from None
+            raise RemoteError(f"HTTP {e.code}: {msg}") from None
+        except OSError as e:
+            raise RemoteError(f"control plane unreachable: {e}") from None
+
+    def create_batch(self, objs: Iterable[Any], *,
+                     chunk: int = DEFAULT_BATCH_CHUNK) -> list[Any]:
+        """Batched create with auto-chunking: one POST per `chunk` objects
+        (one lock hold + one fsync server-side each). A chunk replayed
+        after a transport timeout is IDEMPOTENT: objects the lost-response
+        attempt already committed come back as 409 conflicts with typed
+        results — those are treated as satisfied-by-replay (the server's
+        copy is fetched), and only the remainder is re-sent, so a retry can
+        never double-create. First-attempt conflicts still raise."""
+        return self._write_batch("create", list(objs), chunk=chunk)
+
+    def apply_batch(self, objs: Iterable[Any], *,
+                    chunk: int = DEFAULT_BATCH_CHUNK) -> list[Any]:
+        """Batched create-or-update with auto-chunking; replay-safe by
+        construction (apply is idempotent), so transport failures retry the
+        whole chunk."""
+        return self._write_batch("apply", list(objs), chunk=chunk)
+
+    def update_batch(self, objs: Iterable[Any], *, check_rv: bool = False,
+                     skip_missing: bool = False, skip_stale: bool = False,
+                     chunk: int = DEFAULT_BATCH_CHUNK) -> list[Optional[Any]]:
+        """Batched update. With `skip_stale`, rv-mismatched slots skip
+        (None) instead of failing the batch — which also makes a
+        transport-retry replay benign: the first attempt's own commits
+        surface as skipped slots, not a 409. Plain `check_rv` retry
+        caveat: a replayed chunk whose lost-response attempt committed
+        answers conflict for its own writes."""
+        return self._write_batch("update", list(objs), chunk=chunk,
+                                 check_rv=check_rv, skip_missing=skip_missing,
+                                 skip_stale=skip_stale)
+
+    def get_batch(self, kind: str, keys: Iterable[tuple[str, str]], *,
+                  chunk: int = DEFAULT_BATCH_CHUNK) -> list[Optional[Any]]:
+        """Batched point reads: [(name, namespace), ...] -> [obj | None] in
+        one round-trip per chunk (the coalesced patch path's read half)."""
+        keys = list(keys)
+        out: list[Optional[Any]] = []
+        step = max(1, chunk)
+        for s in range(0, len(keys), step):
+            ch = keys[s:s + step]
+            try:
+                resp = self._call_batch({
+                    "op": "get", "kind": kind,
+                    "keys": [[n, ns] for n, ns in ch],
+                })
+                out.extend(None if o is None else codec.decode(o)
+                           for o in resp["objs"])
+            except _NoBatchRoute:
+                out.extend(self.try_get(kind, n, ns) for n, ns in ch)
+        return out
+
+    def _write_batch(self, op: str, objs: list, *, chunk: int,
+                     check_rv: bool = False, skip_missing: bool = False,
+                     skip_stale: bool = False) -> list:
+        out: list = []
+        step = max(1, chunk)
+        for s in range(0, len(objs), step):
+            out.extend(self._write_chunk(op, objs[s:s + step],
+                                         check_rv, skip_missing, skip_stale))
+        return out
+
+    def _write_chunk(self, op: str, objs: list, check_rv: bool,
+                     skip_missing: bool, skip_stale: bool = False) -> list:
+        payload: dict = {"op": op, "objs": [codec.encode(o) for o in objs]}
+        if op == "update":
+            payload["check_rv"] = check_rv
+            payload["skip_missing"] = skip_missing
+            payload["skip_stale"] = skip_stale
+        attempted = False
+        for attempt in range(4):
+            try:
+                resp = self._call_batch(payload)
+                return [None if o is None else codec.decode(o)
+                        for o in resp["objs"]]
+            except _NoBatchRoute:
+                return self._batch_fallback(op, objs, check_rv, skip_missing)
+            except BatchError as e:
+                if (op == "create" and attempted
+                        and len(e.results) == len(objs)
+                        and any(r.reason == "conflict" for r in e.results)
+                        and all(r.reason in ("conflict", "aborted", "skipped")
+                                for r in e.results if not r.ok)):
+                    # replayed chunk after a lost response: the conflicts
+                    # are (with create's all-or-nothing, nothing ELSE can
+                    # have committed them mid-retry except our own first
+                    # attempt or a racing creator — either way the object
+                    # exists) satisfied-by-replay. Fetch their server copy,
+                    # re-send only the rest.
+                    conflicted = [r.reason == "conflict" for r in e.results]
+                    rest = [o for o, c in zip(objs, conflicted) if not c]
+                    rest_out = (self._write_chunk("create", rest, check_rv,
+                                                  skip_missing, skip_stale)
+                                if rest else [])
+                    it = iter(rest_out)
+                    return [
+                        self.try_get(gvk_of(o), o.metadata.name,
+                                     o.metadata.namespace)
+                        if c else next(it)
+                        for o, c in zip(objs, conflicted)
+                    ]
+                raise
+            except RemoteError:
+                # transport failure: the request may or may not have landed.
+                # apply/update replays are idempotent; create replays are
+                # made idempotent by the conflict handling above.
+                attempted = True
+                if attempt == 3:
+                    raise
+                time.sleep(0.1 * (attempt + 1))
+        raise RemoteError("batch write: retries exhausted")  # unreachable
+
+    def _batch_fallback(self, op: str, objs: list, check_rv: bool,
+                        skip_missing: bool) -> list:
+        """Pre-batch server: per-object round-trips with the same per-op
+        semantics (the old write path, one request per object)."""
+        out: list = []
+        for o in objs:
+            if op == "create":
+                out.append(self.create(o))
+            elif op == "apply":
+                out.append(self.apply(o))
+            else:
+                try:
+                    out.append(self.update(o, check_rv=check_rv))
+                except NotFoundError:
+                    if not skip_missing:
+                        raise
+                    out.append(None)
+        return out
 
     def get(self, kind: str, name: str, namespace: str = "") -> Any:
         return codec.decode(self._call("GET", self._okey(kind, name, namespace))["obj"])

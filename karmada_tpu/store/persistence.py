@@ -24,8 +24,12 @@ record's sequence to commit. Durability contract: when `_on_event`
 returns, the record IS on disk (fsync'd) — under W concurrent writers the
 write path pays ~1 fsync per batch instead of per record, which is what
 keeps write p99 flat while thousands of watch clients hammer the same
-plane. `fsync=False` keeps the pre-group-commit flush-only behavior
-(process-crash-safe, not power-loss-safe) for tests and benchmarks.
+plane. Transactional batch writes (Store.apply_batch and friends) arrive
+through the store's batch seam as ONE enqueue, so a single writer's
+N-object transaction is also one fsync — group commit alone only coalesced
+across threads. `fsync=False` keeps the pre-group-commit flush-only
+behavior (process-crash-safe, not power-loss-safe) for tests and
+benchmarks.
 
 Device state needs no persistence at all: the fleet arrays are a pure
 cache rebuilt from the Cluster objects this file restores. Member-cluster
@@ -120,28 +124,42 @@ class StorePersistence:
     # -- capture ----------------------------------------------------------
 
     def attach(self) -> None:
-        """Subscribe to the store and append every event to the WAL."""
+        """Subscribe to the store and append every event to the WAL. The
+        subscription rides the BATCH seam (`Store.watch_all_batch`): a
+        transactional batch write is delivered as one call, so its records
+        enter the group commit as one unit — one buffered write + fsync for
+        the whole batch, even from a single writer thread (the per-event
+        bus would pay one leader election and fsync per record there)."""
         if self._attached:
             return
         self._attached = True
         with self._lock:
             self._open_wal()
-        self.store.watch_all(self._on_event, replay=False)
+        self.store.watch_all_batch(self._on_events)
 
     def _on_event(self, kind: str, event: str, obj: Any) -> None:
-        """Group commit: enqueue the record, then either lead a batch to
-        disk or wait for the leader whose batch includes it. Returns only
-        once the record is durably written (fsync'd when self.fsync)."""
-        line = json.dumps({
-            "kind": kind, "event": event, "obj": codec.encode(obj),
-        })
+        """Single-record append (kept for callers/tests that feed events
+        directly); equivalent to a one-element batch."""
+        self._on_events([(kind, event, obj)])
+
+    def _on_events(self, records: list) -> None:
+        """Group commit: enqueue the records, then either lead a batch to
+        disk or wait for the leader whose batch includes them. Returns only
+        once every record is durably written (fsync'd when self.fsync)."""
+        if not records:
+            return
+        # codec work outside every lock: appenders encode concurrently
+        lines = [
+            json.dumps({"kind": k, "event": ev, "obj": codec.encode(o)})
+            for k, ev, o in records
+        ]
         lead = False
         need_snapshot = False
         with self._commit_cv:
             if self._wal is None:
                 return
-            self._pending.append(line)
-            self._seq += 1
+            self._pending.extend(lines)
+            self._seq += len(lines)
             my_seq = self._seq
             while self._committed_seq < my_seq:
                 if not self._committing:
@@ -251,7 +269,7 @@ class StorePersistence:
         return len(records)
 
     def close(self) -> None:
-        self.store.unwatch_all(self._on_event)
+        self.store.unwatch_all_batch(self._on_events)
         with self._commit_cv:
             # wait out an in-flight batch leader: its captured batch is no
             # longer in _pending, so closing under it would silently drop

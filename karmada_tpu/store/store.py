@@ -7,18 +7,38 @@ events, finalizer-gated deletion — as an in-process store so every controller
 can stay level-triggered and resumable (reference invariant: all state is CRDs,
 device state is a rebuildable cache; SURVEY §5 checkpoint note).
 
-Thread-safety: a single RLock guards all maps; watch delivery is synchronous
-(callbacks run under the caller, outside the lock) feeding controller queues.
+Thread-safety and lock scope: a single RLock guards the maps, and the critical
+section is kept to exactly the commit — validate, stamp, place, feed the
+under-lock event sink. Input deepcopies happen before the lock, the
+return/watcher copies and ALL watcher-bus dispatch happen after it drops
+(including on the `apply` path, which used to notify re-entrantly under the
+hold — the store side of the ABBA surface the queue side fixed first). Objects
+are IMMUTABLE once committed: every mutation places a fresh copy, so readers
+holding a committed reference (the watch cache retains them for lazy wire
+encoding) never observe in-place changes.
+
+Transactional batch writes (the etcd multi-op Txn analogue — PAPER.md L1:
+write throughput comes from transactional commits, not raw fsync speed):
+`create_batch` / `update_batch` / `apply_batch` admit, validate, and commit N
+objects under ONE lock hold, minting contiguous resourceVersions and feeding
+the event sink as one rv-ordered run; the batch then reaches persistence as a
+single `watch_all_batch` delivery — one WAL group-commit unit, one fsync.
+Semantics are all-or-nothing: any validation failure raises `BatchError` with
+per-object typed results (conflict/not-found/admission/aborted) and commits
+nothing, so a caller can distinguish re-send-the-rest from drop-this-one.
 """
 from __future__ import annotations
 
 import copy
 import threading
+import time
+from contextlib import contextmanager
 from dataclasses import dataclass
 from typing import Any, Callable, Iterable, Optional
 
 from ..api.meta import ObjectMeta, new_uid, now
 from ..api.unstructured import Unstructured
+from ..metrics import store_lock_hold, store_lock_wait, txn_batch_size
 
 ADDED = "ADDED"
 MODIFIED = "MODIFIED"
@@ -35,6 +55,39 @@ class NotFoundError(KeyError):
     pass
 
 
+@dataclass
+class BatchOpResult:
+    """Per-object disposition of a transactional batch write.
+
+    reason: "" (committed) | "conflict" | "not-found" | "admission" |
+    "skipped" (update_batch skip_missing) | "aborted" (this object was
+    fine; a neighbor torched the batch)."""
+
+    ok: bool
+    obj: Any = None
+    reason: str = ""
+    error: str = ""
+
+    @property
+    def retryable(self) -> bool:
+        """Worth re-sending as-is: the object conflicted with a racing
+        writer or merely rode a batch a neighbor failed. Admission denials
+        and not-found are terminal for this object."""
+        return self.reason in ("conflict", "aborted")
+
+
+class BatchError(Exception):
+    """A transactional batch write failed validation: NOTHING was committed
+    (all-or-nothing). `results` aligns 1:1 with the submitted objects — a
+    conflict on one object leaves its neighbors marked `aborted` (retryable),
+    so one bad object doesn't destroy the batch's retryable/terminal
+    distinction."""
+
+    def __init__(self, message: str, results: list[BatchOpResult]):
+        super().__init__(message)
+        self.results = results
+
+
 def gvk_of(obj: Any) -> str:
     """Store key kind. Typed objects use their dataclass kind; unstructured
     use apiVersion+kind so e.g. apps/v1/Deployment is distinct."""
@@ -49,6 +102,9 @@ class _Bucket:
     watchers: list[tuple[WatchHandler, str]]  # (handler, namespace filter)
 
 
+_REMOVED = object()  # batch-overlay tombstone (in-batch delete transition)
+
+
 class Store:
     def __init__(self) -> None:
         self._lock = threading.RLock()
@@ -59,9 +115,19 @@ class Store:
         # assigned — unlike watchers (notified after the lock drops, so two
         # racing mutators may interleave), a sink observes the event log in
         # strict resourceVersion order. This is the feed for the revisioned
-        # watch cache (store/watchcache.py); sinks must be fast and must
-        # never call back into the store.
+        # watch cache (store/watchcache.py); sinks must be fast, must never
+        # call back into the store, and must never MUTATE the object — they
+        # receive the committed (immutable-once-placed) object itself and
+        # may retain the reference (the watch cache encodes it lazily,
+        # outside this lock).
         self._event_sinks: list[Callable[[str, str, Any], None]] = []
+        # batch watchers receive whole commit batches (single writes arrive
+        # as one-element lists) OUTSIDE the lock — the persistence seam: a
+        # transactional batch is delivered as ONE call so the WAL commits
+        # it as one group-commit unit (one fsync)
+        self._batch_watchers: list[
+            Callable[[list[tuple[str, str, Any]]], None]
+        ] = []
         # admission chain (op, kind, obj, old) -> obj; raises to deny —
         # the apiserver admission path (reference: pkg/webhook/* handlers)
         self._admission: Optional[Callable[[str, str, Any, Any], Any]] = None
@@ -72,9 +138,9 @@ class Store:
     def add_event_sink(self, sink: Callable[[str, str, Any], None], *,
                        prime: Optional[Callable[[str, Any], None]] = None) -> int:
         """Register an under-lock, rv-ordered event sink. The object passed
-        is the same post-mutation copy watchers receive; sinks needing to
-        retain it beyond the call must take their own copy (the watch cache
-        retains only the wire encoding).
+        is the committed stored object — immutable once placed — so a sink
+        may retain the reference but must never mutate it (watchers get
+        their own post-lock copy).
 
         `prime(kind, obj)` — when given — is called under the same lock hold
         for every object already stored, so a cache attaches with a snapshot
@@ -120,6 +186,30 @@ class Store:
         self._rv += 1
         return self._rv
 
+    @contextmanager
+    def _write_lock(self):
+        """One measured hold of the store lock (the write paths). Lock-wait
+        and lock-hold ride the karmada_store_lock_* histograms, observed
+        AFTER release so the metrics mutex is never taken under the store
+        lock. Re-entrant acquisitions (apply's inner commit) skip the
+        metrics, so each write is measured exactly once."""
+        lock = self._lock
+        owned = getattr(lock, "_is_owned", None)
+        if owned is not None and owned():
+            with lock:
+                yield
+            return
+        t0 = time.perf_counter()
+        lock.acquire()
+        t1 = time.perf_counter()
+        try:
+            yield
+        finally:
+            t2 = time.perf_counter()
+            lock.release()
+            store_lock_wait.observe(t1 - t0)
+            store_lock_hold.observe(t2 - t1)
+
     def _peek_deletion_timestamp(self, kind: str, name: str, namespace: str):
         """Copy-free read of a stored object's deletionTimestamp (hot path:
         every update consults this for the removal-transition check)."""
@@ -132,72 +222,23 @@ class Store:
 
     @staticmethod
     def _spec_view(obj: Any) -> Any:
-        """The part whose change bumps generation (k8s semantics: spec only)."""
+        """The part whose change bumps generation (k8s semantics: spec
+        only). A comparison VIEW, not a copy — it is read for equality and
+        dropped, and the old to_dict() round-trip deepcopied the whole
+        manifest twice per update inside the lock hold."""
         if isinstance(obj, Unstructured):
-            d = obj.to_dict()
-            d.pop("status", None)
-            d.pop("metadata", None)
-            return d
+            return obj.spec_view()
         spec = getattr(obj, "spec", None)
         return spec
 
-    # -- CRUD -------------------------------------------------------------
+    # -- admission wrappers (run OUTSIDE the lock on the direct paths) -----
 
-    def create(self, obj: Any) -> Any:
-        kind = gvk_of(obj)
+    def _admit_create(self, obj: Any, kind: str) -> Any:
         if self._admission is not None:
             obj = self._admission("CREATE", kind, obj, None)
-        with self._lock:
-            b = self._bucket(kind)
-            key = self._key(obj.metadata)
-            if key in b.objects:
-                raise ConflictError(f"{kind} {key} already exists")
-            stored = copy.deepcopy(obj)
-            m = stored.metadata
-            if not m.uid:
-                m.uid = new_uid(kind.split("/")[-1].lower())
-            m.creation_timestamp = m.creation_timestamp or now()
-            m.resource_version = self._next_rv()
-            m.generation = 1
-            b.objects[key] = stored
-            out = copy.deepcopy(stored)
-            self._sink(kind, ADDED, out)
-        self._notify(kind, ADDED, out)
-        return out
+        return obj
 
-    def get(self, kind: str, name: str, namespace: str = "") -> Any:
-        with self._lock:
-            b = self._buckets.get(kind)
-            key = self._name_key(name, namespace)
-            if b is None or key not in b.objects:
-                raise NotFoundError(f"{kind} {key}")
-            return copy.deepcopy(b.objects[key])
-
-    def try_get(self, kind: str, name: str, namespace: str = "") -> Optional[Any]:
-        try:
-            return self.get(kind, name, namespace)
-        except NotFoundError:
-            return None
-
-    def list(self, kind: str, namespace: str = "") -> list[Any]:
-        with self._lock:
-            b = self._buckets.get(kind)
-            if b is None:
-                return []
-            objs = b.objects.values()
-            if namespace:
-                objs = [o for o in objs if o.metadata.namespace == namespace]
-            return [copy.deepcopy(o) for o in objs]
-
-    def kinds(self) -> list[str]:
-        with self._lock:
-            return list(self._buckets.keys())
-
-    def update(self, obj: Any, *, check_rv: bool = False) -> Any:
-        """Update; bumps generation if the spec view changed. Finalizer-gated
-        deletion: if deletionTimestamp set and no finalizers remain, the
-        object is removed instead."""
-        kind = gvk_of(obj)
+    def _admit_update(self, obj: Any, kind: str) -> Any:
         if self._admission is not None:
             name, ns = obj.metadata.name, obj.metadata.namespace
             obj = self._admission("UPDATE", kind, obj, lambda: self.try_get(kind, name, ns))
@@ -209,53 +250,161 @@ class Store:
                 or self._peek_deletion_timestamp(kind, name, ns) is not None
             ):
                 self._admission("DELETE", kind, obj, None)
-        with self._lock:
-            b = self._bucket(kind)
-            key = self._key(obj.metadata)
-            existing = b.objects.get(key)
-            if existing is None:
-                raise NotFoundError(f"{kind} {key}")
-            if check_rv and obj.metadata.resource_version != existing.metadata.resource_version:
-                raise ConflictError(
-                    f"{kind} {key}: rv {obj.metadata.resource_version} != {existing.metadata.resource_version}"
-                )
-            stored = copy.deepcopy(obj)
-            m = stored.metadata
-            m.uid = existing.metadata.uid
-            m.creation_timestamp = existing.metadata.creation_timestamp
-            m.generation = existing.metadata.generation
-            # deletionTimestamp is immutable once set (k8s semantics): a stale
-            # writer must not resurrect an object already marked for deletion.
-            if existing.metadata.deletion_timestamp is not None:
-                m.deletion_timestamp = existing.metadata.deletion_timestamp
-            if self._differs(self._spec_view(existing), self._spec_view(stored)):
-                m.generation += 1
-            if m.deletion_timestamp is not None and not m.finalizers:
-                del b.objects[key]
-                # removal gets a FRESH rv: a DELETED event must order after
-                # every prior write of the object (WAL replay is rv-ordered)
-                m.resource_version = self._next_rv()
-                out = copy.deepcopy(stored)
-                deleted = True
-            else:
-                m.resource_version = self._next_rv()
-                b.objects[key] = stored
-                out = copy.deepcopy(stored)
-                deleted = False
-            self._sink(kind, DELETED if deleted else MODIFIED, out)
-        self._notify(kind, DELETED if deleted else MODIFIED, out)
+        return obj
+
+    # -- commit primitives (caller holds the lock) -------------------------
+
+    @staticmethod
+    def _stamp_create(kind: str, stored: Any) -> None:
+        m = stored.metadata
+        if not m.uid:
+            m.uid = new_uid(kind.split("/")[-1].lower())
+        m.creation_timestamp = m.creation_timestamp or now()
+        m.generation = 1
+
+    @staticmethod
+    def _stamp_update(stored: Any, existing: Any, check_rv: bool,
+                      kind: str, key: str) -> tuple[str, bool]:
+        """Stamp `stored` from its predecessor (uid, creation timestamp,
+        generation bump on spec change, deletionTimestamp immutability);
+        returns (event, removed) where removed means finalizer-gated
+        removal. The caller mints the resourceVersion at commit."""
+        if check_rv and stored.metadata.resource_version != existing.metadata.resource_version:
+            raise ConflictError(
+                f"{kind} {key}: rv {stored.metadata.resource_version} != "
+                f"{existing.metadata.resource_version}"
+            )
+        m = stored.metadata
+        m.uid = existing.metadata.uid
+        m.creation_timestamp = existing.metadata.creation_timestamp
+        m.generation = existing.metadata.generation
+        # deletionTimestamp is immutable once set (k8s semantics): a stale
+        # writer must not resurrect an object already marked for deletion.
+        if existing.metadata.deletion_timestamp is not None:
+            m.deletion_timestamp = existing.metadata.deletion_timestamp
+        if Store._differs(Store._spec_view(existing), Store._spec_view(stored)):
+            m.generation += 1
+        if m.deletion_timestamp is not None and not m.finalizers:
+            # removal gets a FRESH rv: a DELETED event must order after
+            # every prior write of the object (WAL replay is rv-ordered)
+            return DELETED, True
+        return MODIFIED, False
+
+    def _commit_create(self, kind: str, stored: Any) -> None:
+        b = self._bucket(kind)
+        key = self._key(stored.metadata)
+        if key in b.objects:
+            raise ConflictError(f"{kind} {key} already exists")
+        self._stamp_create(kind, stored)
+        stored.metadata.resource_version = self._next_rv()
+        b.objects[key] = stored
+        self._sink(kind, ADDED, stored)
+
+    def _commit_update(self, kind: str, stored: Any, check_rv: bool) -> str:
+        b = self._bucket(kind)
+        key = self._key(stored.metadata)
+        existing = b.objects.get(key)
+        if existing is None:
+            raise NotFoundError(f"{kind} {key}")
+        event, removed = self._stamp_update(stored, existing, check_rv, kind, key)
+        stored.metadata.resource_version = self._next_rv()
+        if removed:
+            del b.objects[key]
+        else:
+            b.objects[key] = stored
+        self._sink(kind, event, stored)
+        return event
+
+    def _finish(self, kind: str, event: str, stored: Any) -> Any:
+        """Post-commit tail, OUTSIDE the lock: the return/watcher copy and
+        the persistence + watcher-bus dispatch. Subscribers may take their
+        own locks — or call back into the store — without lock-order
+        inversion, on every path including apply()."""
+        out = copy.deepcopy(stored)
+        self._dispatch([(kind, event, out)])
         return out
+
+    # -- CRUD -------------------------------------------------------------
+
+    def create(self, obj: Any) -> Any:
+        kind = gvk_of(obj)
+        obj = self._admit_create(obj, kind)
+        stored = copy.deepcopy(obj)
+        with self._write_lock():
+            self._commit_create(kind, stored)
+        return self._finish(kind, ADDED, stored)
+
+    def get(self, kind: str, name: str, namespace: str = "") -> Any:
+        with self._lock:
+            b = self._buckets.get(kind)
+            key = self._name_key(name, namespace)
+            if b is None or key not in b.objects:
+                raise NotFoundError(f"{kind} {key}")
+            obj = b.objects[key]
+        # committed objects are immutable once placed: copy outside the lock
+        return copy.deepcopy(obj)
+
+    def try_get(self, kind: str, name: str, namespace: str = "") -> Optional[Any]:
+        try:
+            return self.get(kind, name, namespace)
+        except NotFoundError:
+            return None
+
+    def get_batch(self, kind: str,
+                  keys: Iterable[tuple[str, str]]) -> list[Optional[Any]]:
+        """One lock hold for N point reads: [(name, namespace), ...] ->
+        [obj | None]. The deepcopies happen outside the lock."""
+        with self._lock:
+            b = self._buckets.get(kind)
+            refs = [
+                None if b is None
+                else b.objects.get(self._name_key(n, ns))
+                for n, ns in keys
+            ]
+        return [None if o is None else copy.deepcopy(o) for o in refs]
+
+    def list(self, kind: str, namespace: str = "") -> list[Any]:
+        with self._lock:
+            b = self._buckets.get(kind)
+            if b is None:
+                return []
+            objs = list(b.objects.values())
+        if namespace:
+            objs = [o for o in objs if o.metadata.namespace == namespace]
+        return [copy.deepcopy(o) for o in objs]
+
+    def kinds(self) -> list[str]:
+        with self._lock:
+            return list(self._buckets.keys())
+
+    def update(self, obj: Any, *, check_rv: bool = False) -> Any:
+        """Update; bumps generation if the spec view changed. Finalizer-gated
+        deletion: if deletionTimestamp set and no finalizers remain, the
+        object is removed instead."""
+        kind = gvk_of(obj)
+        obj = self._admit_update(obj, kind)
+        stored = copy.deepcopy(obj)
+        with self._write_lock():
+            event = self._commit_update(kind, stored, check_rv)
+        return self._finish(kind, event, stored)
 
     def apply(self, obj: Any) -> Any:
         """create-or-update. The existence check and the inner create/update
         run under one reentrant-lock hold so concurrent apply() calls cannot
-        race each other into ConflictError/NotFoundError. Watch handlers must
-        stay enqueue-only (they may run with the lock held on this path)."""
+        race each other into ConflictError/NotFoundError. Watch handlers run
+        AFTER the hold drops (they used to run re-entrantly under it on this
+        path — the store half of the ABBA surface)."""
         kind = gvk_of(obj)
         key = self._key(obj.metadata)
-        with self._lock:
-            exists = key in self._bucket(kind).objects
-            return self.update(obj) if exists else self.create(obj)
+        with self._write_lock():
+            if key in self._bucket(kind).objects:
+                stored = copy.deepcopy(self._admit_update(obj, kind))
+                event = self._commit_update(kind, stored, False)
+            else:
+                stored = copy.deepcopy(self._admit_create(obj, kind))
+                self._commit_create(kind, stored)
+                event = ADDED
+        return self._finish(kind, event, stored)
 
     def delete(self, kind: str, name: str, namespace: str = "") -> None:
         """Marks deletionTimestamp; removes immediately when no finalizers."""
@@ -263,25 +412,28 @@ class Store:
             target = self.try_get(kind, name, namespace)
             if target is not None:
                 self._admission("DELETE", kind, target, None)
-        with self._lock:
+        with self._write_lock():
             b = self._buckets.get(kind)
             key = self._name_key(name, namespace)
             if b is None or key not in b.objects:
                 return
-            obj = b.objects[key]
-            if obj.metadata.deletion_timestamp is None:
-                obj.metadata.deletion_timestamp = now()
-            if obj.metadata.finalizers:
-                obj.metadata.resource_version = self._next_rv()
-                out = copy.deepcopy(obj)
-                deleted = False
+            # copy-on-write: committed objects are immutable once placed
+            # (the watch cache retains references for lazy encoding) — the
+            # marked copy REPLACES the stored object, never mutates it
+            stored = copy.deepcopy(b.objects[key])
+            m = stored.metadata
+            if m.deletion_timestamp is None:
+                m.deletion_timestamp = now()
+            if m.finalizers:
+                m.resource_version = self._next_rv()
+                b.objects[key] = stored
+                event = MODIFIED
             else:
                 del b.objects[key]
-                obj.metadata.resource_version = self._next_rv()  # see update()
-                out = copy.deepcopy(obj)
-                deleted = True
-            self._sink(kind, DELETED if deleted else MODIFIED, out)
-        self._notify(kind, DELETED if deleted else MODIFIED, out)
+                m.resource_version = self._next_rv()  # see _stamp_update
+                event = DELETED
+            self._sink(kind, event, stored)
+        self._finish(kind, event, stored)
 
     @staticmethod
     def _differs(a: Any, b: Any) -> bool:
@@ -292,6 +444,210 @@ class Store:
         except Exception:
             return True
 
+    # -- transactional batch writes ---------------------------------------
+
+    def create_batch(self, objs: Iterable[Any]) -> list[Any]:
+        """N creates admitted, validated, and committed under ONE lock hold
+        with contiguous resourceVersions; the event sink sees one rv-ordered
+        run and persistence one group-commit unit. All-or-nothing: any
+        conflict/denial raises BatchError (typed per-object results) and
+        commits nothing."""
+        return self._write_batch([("create", o) for o in objs])
+
+    def apply_batch(self, objs: Iterable[Any]) -> list[Any]:
+        """Batched create-or-update; per-object semantics identical to N
+        sequential apply() calls (same stamps, same events, contiguous rvs)
+        at one lock hold and one WAL fsync."""
+        return self._write_batch([("apply", o) for o in objs])
+
+    def update_batch(self, objs: Iterable[Any], *, check_rv: bool = False,
+                     skip_missing: bool = False,
+                     skip_stale: bool = False) -> list[Optional[Any]]:
+        """Batched update. `skip_missing=True` records a vanished object as
+        a skipped slot (None in the result) instead of failing the batch —
+        the patch-coalescing caller's tolerance for a delete racing its
+        read-prepare-commit window. `skip_stale=True` (implies rv
+        checking) does the same for an rv mismatch: a slot whose object
+        was rewritten since the caller's read SKIPS instead of committing
+        a stale full-object snapshot over the newer write — batching
+        widens the read→commit window from per-object to per-cohort, and
+        this is what keeps that window from ever clobbering a concurrent
+        writer (the skipped slot's own change event re-converges the
+        caller). NOTE for retry loops: with plain check_rv (no
+        skip_stale), a replayed batch whose first attempt committed
+        answers `conflict` for its own writes."""
+        return self._write_batch(
+            [("update", o) for o in objs],
+            check_rv=check_rv or skip_stale, skip_missing=skip_missing,
+            skip_stale=skip_stale,
+        )
+
+    @staticmethod
+    def _abort_batch(results: list[BatchOpResult]) -> None:
+        """All-or-nothing failure: objects that validated fine become
+        `aborted` (retryable — they only rode a torched batch); raises."""
+        for r in results:
+            if r.ok:
+                r.ok = False
+                r.reason = "aborted"
+                r.error = "batch aborted: nothing committed"
+        bad = next((r for r in results if r.reason not in ("aborted", "skipped")),
+                   None)
+        raise BatchError(
+            "batch write failed (nothing committed): "
+            + (bad.error if bad is not None else "unknown"),
+            results,
+        )
+
+    def _write_batch(self, ops: list[tuple[str, Any]], *,
+                     check_rv: bool = False,
+                     skip_missing: bool = False,
+                     skip_stale: bool = False) -> list[Optional[Any]]:
+        if not ops:
+            return []
+        from ..webhook.admission import AdmissionDenied  # optional layer
+
+        n = len(ops)
+        results = [BatchOpResult(ok=True) for _ in range(n)]
+        failed = False
+
+        # phase 1 — NO lock held across it: admission chains + input
+        # deepcopies. The create-vs-update admission choice for "apply"
+        # rides ONE existence-peek lock hold (skipped entirely without an
+        # admission chain — phase 2 resolves the real op either way) and
+        # is re-checked under the commit lock (a racing writer flipping it
+        # re-runs the right chain there).
+        guesses: dict[int, str] = {}
+        if self._admission is not None and any(op == "apply" for op, _ in ops):
+            with self._lock:
+                for i, (op, obj) in enumerate(ops):
+                    if op != "apply":
+                        continue
+                    b = self._buckets.get(gvk_of(obj))
+                    guesses[i] = (
+                        "update" if b is not None
+                        and self._key(obj.metadata) in b.objects
+                        else "create"
+                    )
+        prepped: list[Optional[tuple[str, str, str, Any, Any]]] = [None] * n
+        for i, (op, obj) in enumerate(ops):
+            kind = gvk_of(obj)
+            eff = guesses.get(i, "create") if op == "apply" else op
+            try:
+                admitted = (self._admit_update(obj, kind) if eff == "update"
+                            else self._admit_create(obj, kind))
+            except AdmissionDenied as e:
+                results[i] = BatchOpResult(False, reason="admission",
+                                           error=str(e))
+                failed = True
+                continue
+            prepped[i] = (op, eff, kind, copy.deepcopy(admitted), obj)
+        if failed:
+            self._abort_batch(results)
+
+        # phase 2 — ONE lock hold: validate every op against an overlay of
+        # the batch's own effects (in-batch create→update sequences behave
+        # exactly like the sequential calls), then commit with contiguous
+        # rvs, feeding the event sink in rv order. The buckets are not
+        # touched until the whole batch validated.
+        staged: list[Optional[tuple[str, str, Any, str, bool]]] = [None] * n
+        events: list[tuple[int, str, str, Any]] = []
+        with self._write_lock():
+            overlay: dict[tuple[str, str], Any] = {}
+            for i in range(n):
+                op, eff_guess, kind, stored, raw = prepped[i]
+                key = self._key(stored.metadata)
+                okey = (kind, key)
+                if okey in overlay:
+                    existing = overlay[okey]
+                    if existing is _REMOVED:
+                        existing = None
+                else:
+                    b = self._buckets.get(kind)
+                    existing = None if b is None else b.objects.get(key)
+                eff = op
+                if op == "apply":
+                    eff = "update" if existing is not None else "create"
+                    if eff != eff_guess and self._admission is not None:
+                        # the existence race flipped create<->update after
+                        # phase-1 admission: re-run the right chain (under
+                        # the lock — rare, never silently under-admitted)
+                        try:
+                            admitted = (
+                                self._admit_update(raw, kind) if eff == "update"
+                                else self._admit_create(raw, kind)
+                            )
+                        except AdmissionDenied as e:
+                            results[i] = BatchOpResult(
+                                False, reason="admission", error=str(e))
+                            failed = True
+                            continue
+                        stored = copy.deepcopy(admitted)
+                if eff == "create":
+                    if existing is not None:
+                        results[i] = BatchOpResult(
+                            False, reason="conflict",
+                            error=f"{kind} {key} already exists")
+                        failed = True
+                        continue
+                    self._stamp_create(kind, stored)
+                    staged[i] = (kind, key, stored, ADDED, False)
+                    overlay[okey] = stored
+                else:
+                    if existing is None:
+                        if skip_missing:
+                            results[i] = BatchOpResult(
+                                False, reason="skipped",
+                                error=f"{kind} {key} not found")
+                            continue
+                        results[i] = BatchOpResult(
+                            False, reason="not-found",
+                            error=f"{kind} {key}")
+                        failed = True
+                        continue
+                    try:
+                        event, removed = self._stamp_update(
+                            stored, existing, check_rv, kind, key)
+                    except ConflictError as e:
+                        if skip_stale:
+                            results[i] = BatchOpResult(
+                                False, reason="skipped", error=str(e))
+                            continue
+                        results[i] = BatchOpResult(
+                            False, reason="conflict", error=str(e))
+                        failed = True
+                        continue
+                    staged[i] = (kind, key, stored, event, removed)
+                    overlay[okey] = _REMOVED if removed else stored
+            if failed:
+                self._abort_batch(results)  # raises; lock releases
+            for i in range(n):
+                st = staged[i]
+                if st is None:
+                    continue
+                kind, key, stored, event, removed = st
+                stored.metadata.resource_version = self._next_rv()
+                b = self._bucket(kind)
+                if removed:
+                    b.objects.pop(key, None)
+                else:
+                    b.objects[key] = stored
+                self._sink(kind, event, stored)
+                events.append((i, kind, event, stored))
+        txn_batch_size.observe(float(len(events)))
+
+        # phase 3 — outside the lock: watcher/return copies + dispatch (the
+        # whole batch reaches persistence as ONE watch_all_batch call)
+        outs: list[Optional[Any]] = [None] * n
+        dispatch: list[tuple[str, str, Any]] = []
+        for i, kind, event, stored in events:
+            out = copy.deepcopy(stored)
+            outs[i] = out
+            results[i].obj = out
+            dispatch.append((kind, event, out))
+        self._dispatch(dispatch)
+        return outs
+
     # -- restore (persistence) --------------------------------------------
 
     def restore(self, objects: Iterable[Any]) -> int:
@@ -300,7 +656,7 @@ class Store:
         re-admit etcd content on restart). Watchers are notified ADDED so
         already-subscribed level-triggered controllers converge, exactly as
         an informer relist would deliver the initial state."""
-        loaded = []
+        loaded: list[tuple[str, Any]] = []
         with self._lock:
             for obj in objects:
                 kind = gvk_of(obj)
@@ -308,15 +664,15 @@ class Store:
                 stored = copy.deepcopy(obj)
                 b.objects[self._key(stored.metadata)] = stored
                 self._rv = max(self._rv, stored.metadata.resource_version)
-                out = copy.deepcopy(stored)
                 # restored rvs arrive in file order, not rv order — the
                 # watch cache treats a non-monotonic rv as a compaction
                 # point (no since-resume across a restore), so feeding them
                 # here keeps its snapshot index complete without games
-                self._sink(kind, ADDED, out)
-                loaded.append((kind, out))
-        for kind, obj in loaded:
-            self._notify(kind, ADDED, obj)
+                self._sink(kind, ADDED, stored)
+                loaded.append((kind, stored))
+        self._dispatch([
+            (kind, ADDED, copy.deepcopy(stored)) for kind, stored in loaded
+        ])
         return len(loaded)
 
     # -- watch ------------------------------------------------------------
@@ -369,7 +725,43 @@ class Store:
             for kind, o in snapshot:
                 handler(kind, ADDED, o)
 
+    def watch_all_batch(
+        self, handler: Callable[[list[tuple[str, str, Any]]], None]
+    ) -> None:
+        """Subscribe to commit batches: handler(events) with `events` a list
+        of (kind, event, obj) in commit (resourceVersion) order. Single
+        writes arrive as one-element batches; a transactional batch write
+        arrives as ONE call — the seam the WAL's group commit turns into a
+        single fsync. Runs outside the store lock, like the watcher bus."""
+        with self._lock:
+            self._batch_watchers.append(handler)
+
+    def unwatch_all_batch(
+        self, handler: Callable[[list[tuple[str, str, Any]]], None]
+    ) -> None:
+        with self._lock:
+            if handler in self._batch_watchers:
+                self._batch_watchers.remove(handler)
+
+    def _dispatch(self, events: list[tuple[str, str, Any]]) -> None:
+        """Deliver committed events to subscribers — always OUTSIDE the
+        store lock. Batch watchers (persistence) get the whole rv-ordered
+        list first, so a mutator returns only after its records are durable;
+        the kind/all watcher bus then fans out per event. Per-key ordering
+        across RACING writers remains the sink's contract (under-lock
+        sequencing), not the bus's."""
+        if not events:
+            return
+        with self._lock:
+            batch_watchers = list(self._batch_watchers)
+        for bw in batch_watchers:
+            bw(events)
+        for kind, event, obj in events:
+            self._notify(kind, event, obj)
+
     def _notify(self, kind: str, event: str, obj: Any) -> None:
+        """Watcher-bus fan-out for one event; never called with the store
+        lock held (see _dispatch)."""
         with self._lock:
             watchers = list(self._buckets[kind].watchers)
             all_watchers = list(self._all_watchers)

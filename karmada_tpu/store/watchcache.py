@@ -16,9 +16,10 @@ attaches to a `Store` through the under-lock event-sink seam
 (`Store.add_event_sink`), which delivers mutations in strict
 resourceVersion order — unlike the watcher bus, whose callbacks run after
 the lock drops and may interleave under concurrent writers. Each event is
-wire-encoded ONCE at append time (`server/codec.py`); every watch client
-then writes the same cached bytes, so fan-out cost per client is a filter
-check plus a socket write, not an encode.
+wire-encoded ONCE (`server/codec.py`) — lazily, on the first serving read,
+so the store's lock hold pays only the ring append while every watch
+client still writes the same cached bytes: fan-out cost per client is a
+filter check plus a socket write, not an encode.
 
 Consistency model:
 - the ring holds the last `capacity` events in rv order; `events_since(rv)`
@@ -58,21 +59,50 @@ class ContinueExpired(Exception):
 
 
 class CacheEvent:
-    """One revisioned event, wire-encoded once, shared by ring and index."""
+    """One revisioned event, wire-encoded once, shared by ring and index.
 
-    __slots__ = ("rv", "kind", "event", "namespace", "name", "enc",
+    The encode is LAZY: the event is appended under the store lock (the
+    sink runs in the mutation's critical section so the ring sees strict
+    rv order), but the codec work happens on the first serving thread that
+    reads `enc`/`line()` — the store commits immutable objects, so
+    retaining the reference and encoding it outside the lock is safe, and
+    the write path's lock hold stays free of codec cost. Two racing
+    builders produce identical values — benign."""
+
+    __slots__ = ("rv", "kind", "event", "namespace", "name", "obj", "_enc",
                  "_line", "_added_line")
 
     def __init__(self, rv: int, kind: str, event: str, namespace: str,
-                 name: str, enc: Any):
+                 name: str, obj: Any = None, enc: Any = None):
         self.rv = rv
         self.kind = kind
         self.event = event
         self.namespace = namespace
         self.name = name
-        self.enc = enc
+        self.obj = obj
+        self._enc = enc
         self._line: Optional[bytes] = None
         self._added_line: Optional[bytes] = None
+
+    @property
+    def enc(self) -> Any:
+        """Wire encoding, built once on first read (never under the store
+        lock); the retained object reference drops once encoded. Two
+        racing first-readers are safe under the GIL: the object reference
+        is snapshotted BEFORE encoding, and a reader that finds it already
+        dropped re-reads the published encoding (the writer publishes
+        `_enc` before clearing `obj`, so a None obj implies `_enc` is
+        set — encoding the dropped None would cache a corrupt obj:null
+        wire line forever)."""
+        e = self._enc
+        if e is None:
+            obj = self.obj
+            if obj is None:
+                return self._enc  # racer published between our two reads
+            e = codec.encode(obj)
+            self._enc = e
+            self.obj = None  # footprint: keep bytes OR object, not both
+        return e
 
     def matches(self, kind: str, namespace: str) -> bool:
         if kind != "*" and self.kind != kind:
@@ -152,9 +182,12 @@ class WatchCache:
 
     @staticmethod
     def _make_event(kind: str, event: str, obj: Any) -> CacheEvent:
+        # no encode here: _on_event runs under the store lock, and the
+        # committed object is immutable — CacheEvent encodes lazily on the
+        # first serving read instead (write-path lock-scope shrink)
         m = obj.metadata
         return CacheEvent(m.resource_version, kind, event, m.namespace,
-                          m.name, codec.encode(obj))
+                          m.name, obj=obj)
 
     def _on_event(self, kind: str, event: str, obj: Any) -> None:
         ev = self._make_event(kind, event, obj)

@@ -118,6 +118,13 @@ def main() -> None:
     artifact["runs"].append(run_bench(
         ["--configs", "fanout", "--fanout-watchers", "10000",
          "--run-timeout", "600"], 700))
+    # control-plane write path: transactional batch writes vs per-object
+    # round-trips at W=32 concurrent writers — throughput, open-loop write
+    # p99, WAL fsyncs/record, and the bit-parity boolean (host-side
+    # serving bench; captured so the committed artifact carries the
+    # acceptance booleans alongside the device numbers)
+    artifact["runs"].append(run_bench(
+        ["--configs", "writeload", "--run-timeout", "600"], 700))
     # the Go-interop seam: /v1/scheduleBatch latency at flagship scale
     artifact["runs"].append(run_script(
         "scripts/bench_shim.py",

@@ -1,0 +1,54 @@
+#!/usr/bin/env bash
+# Write-path smoke: the control-plane write path at the W=32-writer point
+# (ROADMAP item 3's write half). Single-shot: runs the `writeload` bench
+# config — 32 concurrent RemoteStore writers against a live apiserver,
+# per-object PUTs vs transactional POST /objects/batch, plus an open-loop
+# fixed-rate p99 comparison and the batch-vs-sequential bit-parity check —
+# and asserts the acceptance booleans the JSON line carries:
+#   pass_write_3x       batched path sustains >= 3x the write throughput
+#   pass_write_p99_2x   batched write p99 >= 2x better at the same
+#                       arrival rate
+#   pass_parity         same ops batched vs sequential leave byte-identical
+#                       stores AND event streams
+# Exit 0 prints "WRITELOAD OK".
+#
+# Wired into the slow path as
+# tests/test_writepath.py::TestWriteloadSmokeScript (pytest -m slow).
+# Runs on CPU; needs no accelerator (the write path is pure host code).
+set -euo pipefail
+
+cd "$(dirname "$0")/.."
+PY=${PYTHON:-python}
+WORK=$(mktemp -d /tmp/writeload_smoke.XXXXXX)
+trap 'rm -rf "$WORK"' EXIT
+
+log() { echo "writeload_smoke: $*"; }
+
+JAX_PLATFORMS=cpu $PY bench.py --inner --platform cpu --configs writeload \
+    --verbose > "$WORK/out.txt" 2> "$WORK/err.txt" \
+    || { log "bench failed"; cat "$WORK/err.txt"; exit 1; }
+
+LINE=$(grep -E '^\{' "$WORK/out.txt" | tail -1)
+[ -n "$LINE" ] || { log "no JSON line emitted"; cat "$WORK/out.txt"; exit 1; }
+log "result: $LINE"
+
+WRITELOAD_LINE="$LINE" $PY - <<'PYEOF'
+import json
+import os
+import sys
+
+rec = json.loads(os.environ["WRITELOAD_LINE"])
+for key in ("pass_write_3x", "pass_write_p99_2x", "pass_parity", "pass"):
+    if not rec.get(key):
+        print(f"writeload_smoke: criterion {key} FAILED "
+              f"(throughput={rec.get('batched_vs_sequential')}x, "
+              f"p99={rec.get('write_p99_improvement')}x, "
+              f"parity={rec.get('parity')})", file=sys.stderr)
+        sys.exit(1)
+print(f"writeload_smoke: {rec['writers']} writers, "
+      f"{rec['batched_vs_sequential']}x writes/sec, "
+      f"write p99 {rec['write_p99_improvement']}x better, "
+      f"parity {rec['parity']}")
+PYEOF
+
+echo "WRITELOAD OK"

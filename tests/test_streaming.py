@@ -457,13 +457,13 @@ class TestTransientErrors:
         calls = []
         orig = daemon._patch_result
 
-        def flaky(rb, dec):
+        def flaky(rb, dec, **kw):
             calls.append(1)
             if len(calls) == 1:
                 # raises BEFORE any store write: no watch event fires, so
                 # nothing but the eager abort check can revive the key
                 raise RuntimeError("transient store write failure")
-            return orig(rb, dec)
+            return orig(rb, dec, **kw)
 
         daemon._patch_result = flaky
         store.create(make_binding("app-q", 3, dyn_placement(), cpu=0.25))
@@ -782,7 +782,7 @@ class TestReviewHardening:
         deposed = threading.Event()
         orig_patch = daemon._patch_result
 
-        def fenced(rb, dec):
+        def fenced(rb, dec, **kw):
             deposed.set()  # the elector observed the new leader
             raise RuntimeError("409: stale fencing token")
 

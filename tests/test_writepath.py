@@ -1,0 +1,675 @@
+"""Control-plane write path (docs/PERF.md "Write path at fleet scale"):
+transactional batch writes — one lock hold, contiguous resourceVersions,
+one WAL fsync, all-or-nothing with typed per-object results — plus the
+lock-scope shrink (watcher dispatch/encode/copies out of the hold), the
+serving-seam batch route with replay-safe retry, and the coalesced
+writers (scheduler patch, binding Work fan-out, agent status)."""
+from __future__ import annotations
+
+import itertools
+import json
+import threading
+
+import pytest
+
+from karmada_tpu.api.unstructured import Unstructured
+from karmada_tpu.server import codec
+from karmada_tpu.store.store import (
+    ADDED,
+    MODIFIED,
+    BatchError,
+    ConflictError,
+    Store,
+)
+
+
+def cm(i, t="", ns="d"):
+    return Unstructured({
+        "apiVersion": "v1", "kind": "ConfigMap",
+        "metadata": {"name": f"o-{i:04d}", "namespace": ns},
+        "data": {"t": t},
+    })
+
+
+KIND = "v1/ConfigMap"
+
+
+# -- transactional semantics ------------------------------------------------
+
+
+class TestBatchWrites:
+    def test_create_batch_contiguous_rvs(self):
+        s = Store()
+        outs = s.create_batch([cm(i) for i in range(20)])
+        rvs = [o.metadata.resource_version for o in outs]
+        assert rvs == list(range(rvs[0], rvs[0] + 20))
+        assert all(o.metadata.uid for o in outs)
+
+    def test_all_or_nothing_with_typed_results(self):
+        s = Store()
+        s.create(cm(0))
+        with pytest.raises(BatchError) as ei:
+            s.create_batch([cm(1), cm(0), cm(2)])
+        results = ei.value.results
+        assert [r.reason for r in results] == ["aborted", "conflict",
+                                              "aborted"]
+        # a conflict keeps its neighbors retryable — the batch's
+        # retryable/terminal distinction survives one bad object
+        assert all(r.retryable for r in results)
+        # NOTHING committed: neither the earlier nor the later neighbor
+        assert s.try_get(KIND, "o-0001", "d") is None
+        assert s.try_get(KIND, "o-0002", "d") is None
+
+    def test_admission_denial_is_terminal_and_commits_nothing(self):
+        from karmada_tpu.webhook.admission import AdmissionDenied
+
+        s = Store()
+
+        def admit(op, kind, obj, old):
+            if obj.metadata.name == "o-0001":
+                raise AdmissionDenied(kind, "nope")
+            return obj
+
+        s.set_admission(admit)
+        with pytest.raises(BatchError) as ei:
+            s.apply_batch([cm(0), cm(1)])
+        r0, r1 = ei.value.results
+        assert r0.reason == "aborted" and r0.retryable
+        assert r1.reason == "admission" and not r1.retryable
+        assert s.try_get(KIND, "o-0000", "d") is None
+
+    def test_update_batch_check_rv_conflict_torches_batch(self):
+        s = Store()
+        outs = s.create_batch([cm(0), cm(1)])
+        stale = outs[1]
+        s.update(cm(1, t="newer"))  # bump rv behind the stale copy's back
+        fresh0 = s.get(KIND, "o-0000", "d")
+        with pytest.raises(BatchError) as ei:
+            s.update_batch([fresh0, stale], check_rv=True)
+        assert [r.reason for r in ei.value.results] == ["aborted", "conflict"]
+        # the valid neighbor did NOT land
+        assert s.get(KIND, "o-0000", "d").metadata.resource_version \
+            == fresh0.metadata.resource_version
+
+    def test_update_batch_skip_missing(self):
+        s = Store()
+        s.create(cm(0))
+        outs = s.update_batch([cm(0, t="x"), cm(7)], skip_missing=True)
+        assert outs[0].get("data", "t") == "x"
+        assert outs[1] is None
+
+    def test_in_batch_create_then_update_behaves_sequentially(self):
+        s = Store()
+        outs = s.apply_batch([cm(0, t="a"), cm(0, t="b")])
+        assert outs[0].metadata.resource_version + 1 \
+            == outs[1].metadata.resource_version
+        final = s.get(KIND, "o-0000", "d")
+        assert final.get("data", "t") == "b"
+        # spec changed between the two in-batch writes: generation bumped
+        assert final.metadata.generation == 2
+
+    def test_get_batch(self):
+        s = Store()
+        s.create_batch([cm(0), cm(1)])
+        got = s.get_batch(KIND, [("o-0001", "d"), ("o-9999", "d")])
+        assert got[0].metadata.name == "o-0001"
+        assert got[1] is None
+
+    def test_batch_input_isolation(self):
+        """Caller mutation after the call must not reach the store (same
+        contract as the single-object paths)."""
+        s = Store()
+        obj = cm(0, t="v1")
+        s.apply_batch([obj])
+        obj.set("data", "t", "HACKED")
+        assert s.get(KIND, "o-0000", "d").get("data", "t") == "v1"
+
+
+# -- batch-vs-sequential bit parity ----------------------------------------
+
+
+def run_ops(batched: bool, ops, chunk=7):
+    """Apply `ops` to a fresh store; returns (event stream, final bytes)
+    with wall-clock stamps pinned so any difference is real."""
+    import karmada_tpu.store.store as store_mod
+
+    counter = itertools.count(1)
+    old_now, old_uid = store_mod.now, store_mod.new_uid
+    store_mod.now = lambda: 1000.0
+    store_mod.new_uid = lambda prefix="uid": f"{prefix}-{next(counter)}"
+    try:
+        s = Store()
+        events = []
+        s.watch_all(
+            lambda k, ev, o: events.append(
+                (k, ev, o.metadata.resource_version,
+                 json.dumps(codec.encode(o), sort_keys=True))
+            ),
+            replay=False,
+        )
+        if batched:
+            for i in range(0, len(ops), chunk):
+                s.apply_batch(ops[i:i + chunk])
+        else:
+            for o in ops:
+                s.apply(o)
+        final = sorted(
+            json.dumps(codec.encode(o), sort_keys=True)
+            for kind in s.kinds() for o in s.list(kind)
+        )
+        return events, final
+    finally:
+        store_mod.now, store_mod.new_uid = old_now, old_uid
+
+
+class TestBitParity:
+    def test_apply_batch_bit_identical_to_sequential(self):
+        ops = [cm(i, t="v1") for i in range(25)]
+        ops += [cm(i, t="v2") for i in range(0, 25, 2)]  # spec changes
+        ops += [cm(i, t="v2") for i in range(0, 25, 4)]  # no-spec-change
+        seq_events, seq_final = run_ops(False, ops)
+        bat_events, bat_final = run_ops(True, ops)
+        assert seq_final == bat_final
+        assert seq_events == bat_events
+
+
+# -- lock scope (satellite: dispatch outside the hold) ----------------------
+
+
+class TestLockScope:
+    def test_watch_handlers_run_outside_lock_even_under_apply(self):
+        s = Store()
+        held = []
+        s.watch(KIND, lambda ev, o: held.append(s._lock._is_owned()))
+        s.apply(cm(0))
+        s.apply(cm(0, t="x"))
+        s.create(cm(1))
+        s.update(cm(1, t="y"))
+        s.delete(KIND, "o-0001", "d")
+        s.apply_batch([cm(2), cm(3)])
+        assert held and not any(held)
+
+    def test_subscriber_lock_no_longer_inverts_with_store_lock(self):
+        """The ABBA regression: a watch handler that takes its own lock L,
+        racing a thread that holds L and calls back into Store.apply. With
+        notify under the store lock this deadlocked (store→L vs L→store);
+        with dispatch outside the hold both sides complete."""
+        s = Store()
+        sub_lock = threading.Lock()
+        entered = threading.Event()
+        release = threading.Event()
+
+        def handler(ev, obj):
+            if obj.metadata.name != "o-0000":
+                return  # only the first apply's event takes part
+            entered.set()
+            release.wait(timeout=10.0)
+            with sub_lock:
+                pass
+
+        s.watch(KIND, handler)
+
+        def mutator():
+            s.apply(cm(0))  # dispatches to handler outside the lock
+
+        def locked_applier():
+            entered.wait(timeout=10.0)
+            with sub_lock:
+                release.set()
+                s.apply(cm(1))  # would block forever under old ordering
+
+        t1 = threading.Thread(target=mutator, daemon=True)
+        t2 = threading.Thread(target=locked_applier, daemon=True)
+        t1.start()
+        t2.start()
+        t1.join(timeout=20.0)
+        t2.join(timeout=20.0)
+        assert not t1.is_alive() and not t2.is_alive(), \
+            "lock-order inversion: store.apply deadlocked against a " \
+            "subscriber holding its own lock"
+        assert s.try_get(KIND, "o-0001", "d") is not None
+
+
+# -- WAL: one group-commit unit per batch -----------------------------------
+
+
+class TestWalBatch:
+    def test_batch_commits_one_fsync(self, tmp_path, monkeypatch):
+        import os as os_mod
+
+        from karmada_tpu.store.persistence import StorePersistence
+
+        s = Store()
+        p = StorePersistence(s, str(tmp_path))
+        p.attach()
+        count = [0]
+        real = os_mod.fsync
+        monkeypatch.setattr(os_mod, "fsync",
+                            lambda fd: (count.__setitem__(0, count[0] + 1),
+                                        real(fd))[1])
+        s.create_batch([cm(i) for i in range(100)])
+        assert count[0] == 1, "a 100-object batch must be ONE fsync"
+        p.close()
+
+    def test_batch_is_durable_and_replayable(self, tmp_path):
+        from karmada_tpu.store.persistence import StorePersistence
+
+        s = Store()
+        p = StorePersistence(s, str(tmp_path))
+        p.attach()
+        s.create_batch([cm(i) for i in range(10)])
+        s.update_batch([cm(i, t="x") for i in range(10)])
+        p.close()
+        s2 = Store()
+        p2 = StorePersistence(s2, str(tmp_path))
+        assert p2.load() == 10
+        assert all(
+            s2.get(KIND, f"o-{i:04d}", "d").get("data", "t") == "x"
+            for i in range(10)
+        )
+
+
+# -- rv contiguity + strict watch-cache order under racing batch writers ----
+
+
+class TestRacingBatchWriters:
+    def test_rv_contiguity_and_cache_order(self):
+        from karmada_tpu.store.watchcache import WatchCache
+
+        s = Store()
+        cache = WatchCache(s, capacity=65536)
+        cache.attach()
+        n_writers, per_batch, rounds = 6, 16, 10
+        batches: list[list[int]] = []
+        lock = threading.Lock()
+
+        def writer(w):
+            for r in range(rounds):
+                objs = [cm(w * 1000 + r * per_batch + k)
+                        for k in range(per_batch)]
+                outs = s.create_batch(objs)
+                with lock:
+                    batches.append(
+                        [o.metadata.resource_version for o in outs])
+
+        threads = [threading.Thread(target=writer, args=(w,), daemon=True)
+                   for w in range(n_writers)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=60.0)
+        # every batch's rvs are contiguous (one lock hold each) and no rv
+        # appears twice across the race
+        all_rvs = [rv for b in batches for rv in b]
+        assert len(set(all_rvs)) == len(all_rvs)
+        for b in batches:
+            assert b == list(range(b[0], b[0] + per_batch))
+        # the cache ring observed the interleaved log in strict rv order
+        events, _, ok = cache.events_since(0)
+        assert ok
+        rvs = [e.rv for e in events]
+        assert rvs == sorted(rvs) and len(set(rvs)) == len(rvs)
+        assert len(rvs) == n_writers * per_batch * rounds
+        cache.detach()
+
+
+# -- the serving seam: POST /objects/batch + RemoteStore --------------------
+
+
+class _MiniCP:
+    """Minimal cp surface for ControlPlaneServer (no PKI/cryptography)."""
+
+    def __init__(self, store):
+        self.store = store
+        self.members = {}
+
+    def settle(self, max_steps=0):
+        return 0
+
+    def tick(self, seconds=0.0):
+        return 0
+
+
+@pytest.fixture()
+def served_store():
+    from karmada_tpu.server.apiserver import ControlPlaneServer
+
+    s = Store()
+    srv = ControlPlaneServer(_MiniCP(s))
+    srv.start()
+    yield s, srv
+    srv.stop()
+
+
+class TestRemoteBatch:
+    def test_apply_batch_roundtrip_and_get_batch(self, served_store):
+        from karmada_tpu.server.remote import RemoteStore
+
+        s, srv = served_store
+        remote = RemoteStore(srv.url)
+        outs = remote.apply_batch([cm(i) for i in range(30)], chunk=8)
+        assert len(outs) == 30
+        rvs = [o.metadata.resource_version for o in outs]
+        assert len(set(rvs)) == 30
+        got = remote.get_batch(KIND, [("o-0003", "d"), ("o-bogus", "d")])
+        assert got[0].metadata.name == "o-0003" and got[1] is None
+        assert s.get(KIND, "o-0003", "d") is not None
+
+    def test_conflict_carries_typed_results_over_the_wire(self, served_store):
+        from karmada_tpu.server.remote import RemoteStore
+
+        s, srv = served_store
+        s.create(cm(1))
+        remote = RemoteStore(srv.url)
+        with pytest.raises(BatchError) as ei:
+            remote.create_batch([cm(0), cm(1)])
+        assert [r.reason for r in ei.value.results] == ["aborted",
+                                                        "conflict"]
+        assert s.try_get(KIND, "o-0000", "d") is None  # all-or-nothing
+
+    def test_replayed_chunk_after_timeout_does_not_double_create(
+            self, served_store, monkeypatch):
+        """The partial-retry idempotency contract: the server commits the
+        chunk but the response is lost (timeout). The client's replay sees
+        409 conflicts for the objects that landed, treats them as
+        satisfied-by-replay, and re-sends nothing twice."""
+        from karmada_tpu.server.remote import RemoteError, RemoteStore
+
+        s, srv = served_store
+        remote = RemoteStore(srv.url)
+        real = RemoteStore._call_batch
+        dropped = [0]
+
+        def lossy(self, body):
+            out = real(self, body)
+            if body.get("op") == "create" and not dropped[0]:
+                dropped[0] = 1
+                raise RemoteError("simulated timeout: response lost")
+            return out
+
+        monkeypatch.setattr(RemoteStore, "_call_batch", lossy)
+        outs = remote.create_batch([cm(i) for i in range(12)], chunk=12)
+        assert dropped[0] == 1
+        assert len(outs) == 12
+        assert all(o is not None for o in outs)
+        # exactly one copy of each landed
+        assert len(s.list(KIND)) == 12
+
+    def test_pre_batch_server_falls_back_per_object(self, served_store,
+                                                    monkeypatch):
+        from karmada_tpu.server import remote as remote_mod
+        from karmada_tpu.server.remote import RemoteStore
+
+        s, srv = served_store
+        remote = RemoteStore(srv.url)
+
+        def no_route(self, body):
+            raise remote_mod._NoBatchRoute("404")
+
+        monkeypatch.setattr(RemoteStore, "_call_batch", no_route)
+        outs = remote.apply_batch([cm(0), cm(1)])
+        assert len(outs) == 2 and len(s.list(KIND)) == 2
+
+    def test_fencing_applies_to_batch_route(self, served_store):
+        """A deposed leader's batch writes must bounce exactly like its
+        single writes (the fencing check runs before the store op)."""
+        from karmada_tpu.server.remote import RemoteStore
+
+        s, srv = served_store
+        remote = RemoteStore(srv.url)
+        remote._fence = "ns/lease:42"  # no coordinator on _MiniCP: ignored
+        outs = remote.apply_batch([cm(0)])
+        assert len(outs) == 1
+
+
+# -- coalesced writers ------------------------------------------------------
+
+
+class TestWriteCoalescer:
+    def test_same_key_writes_coalesce_last_write_wins(self):
+        from karmada_tpu.store.batching import WriteCoalescer
+
+        s = Store()
+        wc = WriteCoalescer(s, flush_delay=30.0, path="t")  # manual flush
+        wc.apply(cm(0, t="v1"))
+        wc.apply(cm(0, t="v2"))
+        wc.apply(cm(1, t="v1"))
+        assert wc.pending() == 2
+        assert wc.flush() == 2
+        assert s.get(KIND, "o-0000", "d").get("data", "t") == "v2"
+        wc.close()
+
+    def test_zero_delay_writes_through(self):
+        from karmada_tpu.store.batching import WriteCoalescer
+
+        s = Store()
+        wc = WriteCoalescer(s, flush_delay=0.0)
+        out = wc.apply(cm(0))
+        assert out is not None and s.try_get(KIND, "o-0000", "d") is not None
+
+    def test_background_flush_within_delay(self):
+        import time
+
+        from karmada_tpu.store.batching import WriteCoalescer
+
+        s = Store()
+        wc = WriteCoalescer(s, flush_delay=0.01, path="t")
+        wc.apply(cm(0))
+        deadline = time.monotonic() + 5.0
+        while time.monotonic() < deadline:
+            if s.try_get(KIND, "o-0000", "d") is not None:
+                break
+            time.sleep(0.005)
+        assert s.try_get(KIND, "o-0000", "d") is not None
+        wc.close()
+
+    def test_apply_all_degrades_per_object_on_batch_error(self):
+        from karmada_tpu.store.batching import apply_all
+        from karmada_tpu.webhook.admission import AdmissionDenied
+
+        s = Store()
+
+        def admit(op, kind, obj, old):
+            if obj.metadata.name == "o-0001":
+                raise AdmissionDenied(kind, "nope")
+            return obj
+
+        s.set_admission(admit)
+        with pytest.raises(AdmissionDenied):
+            apply_all(s, [cm(0), cm(1), cm(2)])
+        # pre-batch loop semantics: the object BEFORE the bad one landed
+        assert s.try_get(KIND, "o-0000", "d") is not None
+
+
+class TestSchedulerPatchCoalescing:
+    def _topology(self):
+        from karmada_tpu.runtime.controller import Runtime
+        from karmada_tpu.sched.scheduler import SchedulerDaemon
+        from karmada_tpu.testing.fixtures import synthetic_fleet
+
+        class CountingStore(Store):
+            def __init__(self):
+                super().__init__()
+                self.n_update = 0
+                self.n_update_batch = 0
+
+            def update(self, obj, **kw):
+                self.n_update += 1
+                return super().update(obj, **kw)
+
+            def update_batch(self, objs, **kw):
+                self.n_update_batch += 1
+                return super().update_batch(objs, **kw)
+
+        store = CountingStore()
+        runtime = Runtime()
+        for c in synthetic_fleet(5, seed=3):
+            store.create(c)
+        daemon = SchedulerDaemon(store, runtime)
+        return store, runtime, daemon
+
+    def test_streaming_microbatch_patches_in_one_batch_call(self):
+        from tests.test_parallel import dyn_placement, make_binding
+
+        store, runtime, daemon = self._topology()
+        bindings = [make_binding(f"app-{i}", 2 + i % 3, dyn_placement(),
+                                 cpu=0.1) for i in range(16)]
+        for rb in bindings:
+            store.create(rb)
+        svc = daemon.streaming(batch_delay=0.0)
+        store.n_update = store.n_update_batch = 0
+        svc.serve(quiescent=True)
+        placed = [rb for rb in store.list("ResourceBinding")
+                  if rb.spec.clusters]
+        assert len(placed) == 16
+        # the patch path must be BATCH calls, not B per-binding updates
+        assert store.n_update_batch >= 1
+        assert store.n_update == 0, (
+            f"per-object updates leaked into the micro-batch patch path "
+            f"({store.n_update} update() calls)"
+        )
+
+    def test_batch_round_patches_in_batch_calls(self):
+        from tests.test_parallel import dyn_placement, make_binding
+
+        store, runtime, daemon = self._topology()
+        for i in range(12):
+            store.create(make_binding(f"app-{i}", 2, dyn_placement(),
+                                      cpu=0.1))
+        store.n_update = store.n_update_batch = 0
+        runtime.settle()
+        placed = [rb for rb in store.list("ResourceBinding")
+                  if rb.spec.clusters]
+        assert len(placed) == 12
+        assert store.n_update_batch >= 1
+        assert store.n_update == 0
+
+
+class TestBindingWorksCoalesced:
+    def test_work_fanout_rides_batch_writes(self):
+        from karmada_tpu.metrics import writes_coalesced
+
+        before = writes_coalesced.value(path="binding_works")
+        from karmada_tpu.controlplane import ControlPlane
+        try:
+            cp = ControlPlane()
+        except ModuleNotFoundError:
+            pytest.skip("optional crypto stack missing")
+        from karmada_tpu.members.member import MemberConfig
+
+        for name in ("m1", "m2", "m3"):
+            cp.join_member(MemberConfig(name=name, sync_mode="Push",
+                                        allocatable={"cpu": 8.0}))
+        deployment = Unstructured({
+            "apiVersion": "apps/v1", "kind": "Deployment",
+            "metadata": {"name": "web", "namespace": "default"},
+            "spec": {"replicas": 3},
+        })
+        from karmada_tpu.api.policy import (
+            ClusterAffinity,
+            Placement,
+            PropagationPolicy,
+            PropagationSpec,
+            ResourceSelector,
+        )
+        from karmada_tpu.api.meta import ObjectMeta
+
+        cp.store.create(deployment)
+        cp.store.create(PropagationPolicy(
+            metadata=ObjectMeta(name="pp", namespace="default"),
+            spec=PropagationSpec(
+                resource_selectors=[ResourceSelector(
+                    api_version="apps/v1", kind="Deployment", name="web",
+                    namespace="default")],
+                placement=Placement(cluster_affinity=ClusterAffinity(
+                    cluster_names=["m1", "m2", "m3"])),
+            ),
+        ))
+        cp.settle()
+        works = cp.store.list("Work")
+        assert len(works) >= 3
+        assert writes_coalesced.value(path="binding_works") > before
+
+
+class TestAgentStatusCoalescing:
+    def test_agent_buffers_status_and_flushes(self):
+        from karmada_tpu.agent.agent import KarmadaAgent
+        from karmada_tpu.api.meta import ObjectMeta
+        from karmada_tpu.api.work import Work, WorkSpec
+        from karmada_tpu.interpreter.interpreter import ResourceInterpreter
+        from karmada_tpu.members.member import InMemoryMember, MemberConfig
+        from karmada_tpu.api.work import work_namespace_for_cluster
+        from karmada_tpu.runtime.controller import Runtime
+
+        store = Store()
+        runtime = Runtime()
+        member = InMemoryMember(MemberConfig(name="m1", sync_mode="Pull",
+                                             allocatable={"cpu": 4.0}))
+        agent = KarmadaAgent(store, member, ResourceInterpreter(), runtime,
+                             status_flush_delay=30.0)  # manual flush only
+        ns = work_namespace_for_cluster("m1")
+        for i in range(4):
+            store.create(Work(
+                metadata=ObjectMeta(name=f"w-{i}", namespace=ns),
+                spec=WorkSpec(workload_manifests=[{
+                    "apiVersion": "v1", "kind": "ConfigMap",
+                    "metadata": {"name": f"c-{i}", "namespace": "default"},
+                }]),
+            ))
+        runtime.settle()
+        # conditions are buffered, not yet visible
+        pending = agent.flush_status()
+        assert pending == 4
+        for i in range(4):
+            w = store.get("Work", f"w-{i}", ns)
+            assert any(c.type == "Applied" and c.status == "True"
+                       for c in w.status.conditions)
+        agent.close()
+
+
+# -- metrics ---------------------------------------------------------------
+
+
+class TestWritePathMetrics:
+    def test_lock_and_txn_metrics_flow(self):
+        from karmada_tpu.metrics import (
+            registry,
+            store_lock_hold,
+            store_lock_wait,
+            txn_batch_size,
+        )
+
+        s = Store()
+        w0 = store_lock_wait.count()
+        h0 = store_lock_hold.count()
+        t0 = txn_batch_size.count()
+        s.create(cm(0))
+        s.apply_batch([cm(1), cm(2), cm(3)])
+        assert store_lock_wait.count() > w0
+        assert store_lock_hold.count() > h0
+        assert txn_batch_size.count() == t0 + 1
+        text = registry.render()
+        assert "karmada_store_lock_wait_seconds" in text
+        assert "karmada_txn_batch_size" in text
+        assert "karmada_writes_coalesced_total" in text
+
+
+# -- the smoke wrapper (slow path) -----------------------------------------
+
+
+@pytest.mark.slow
+class TestWriteloadSmokeScript:
+    def test_writeload_smoke(self):
+        """scripts/writeload_smoke.sh: the W=32 point of the writeload
+        bench — batched vs per-object write path over a live apiserver,
+        the acceptance booleans asserted from the emitted JSON line."""
+        import os
+        import subprocess
+
+        repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        r = subprocess.run(
+            ["bash", "scripts/writeload_smoke.sh"],
+            capture_output=True, text=True, timeout=600, cwd=repo,
+        )
+        assert r.returncode == 0, r.stdout + r.stderr
+        assert "WRITELOAD OK" in r.stdout
