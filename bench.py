@@ -2112,6 +2112,486 @@ def run_writeload(args, backend_label: str, verbose=False) -> dict:
     return rec
 
 
+# replica: the replicated control-plane store (store/replication.py)
+# --------------------------------------------------------------------------
+
+REPLICA_WATCHERS = 10000   # acceptance point: >=1.7x read scaling 1f->2f
+REPLICA_WINDOW_S = 3.0
+REPLICA_WRITERS = 4
+REPLICA_OBJECTS = 200
+REPLICA_SERVERS = 8        # serving-pool threads per plane (fanout model)
+REPLICA_QUORUM_BATCH = 64
+
+
+def run_replica_child(args) -> None:
+    """Follower-plane child process: a real OS process with its own GIL —
+    the honest unit of read capacity a replica adds. Runs a store +
+    persistence (fsync ON: its append acks are durability acks) + a live
+    apiserver whose /replication routes the parent's leader ships to, and
+    answers a tiny stdin/stdout JSON protocol: measure (cursor fan-out
+    over its own watch cache for a window), wait_rv, digest, exit."""
+    import threading  # noqa: F401 - measure spawns its pool
+
+    from karmada_tpu.server.apiserver import ControlPlaneServer
+    from karmada_tpu.store.persistence import StorePersistence
+    from karmada_tpu.store.replication import ReplicaControlPlane
+
+    # same GIL-handoff tightening as the fanout/writeload in-process legs:
+    # the serving pool + the append-apply thread are all runnable at once,
+    # and the default 5 ms switch interval charges every lock release a
+    # scheduling quantum — measuring the interpreter, not the plane
+    sys.setswitchinterval(0.0005)
+    cp = ReplicaControlPlane()
+    pers = StorePersistence(cp.store, args.replica_data_dir)
+    pers.attach()
+    # ring sized past the measured window's event count (the fanout bench
+    # leg does the same): a saturated cursor lagging past ring compaction
+    # resyncs by SKIPPING to the tip, which under-counts delivery and
+    # makes the scaling measurement nonlinear in load
+    srv = ControlPlaneServer(cp, watch_cache_capacity=65_536)
+    srv.start()
+
+    def out(d):
+        sys.stdout.write(json.dumps(d) + "\n")
+        sys.stdout.flush()
+
+    out({"ready": True, "url": srv.url})
+    for line in sys.stdin:
+        line = line.strip()
+        if not line:
+            continue
+        cmd = json.loads(line)
+        op = cmd.get("cmd")
+        if op == "exit":
+            break
+        if op == "wait_rv":
+            deadline = time.monotonic() + float(cmd.get("timeout", 30.0))
+            while (cp.store.current_rv < cmd["rv"]
+                   and time.monotonic() < deadline):
+                time.sleep(0.01)
+            out({"rv": cp.store.current_rv})
+        elif op == "digest":
+            out({"rv": cp.store.current_rv,
+                 "sha": _replica_digest(cp.store)})
+        elif op == "measure":
+            res = _replica_measure(
+                srv._watch_cache, int(cmd["watchers"]),
+                float(cmd["window_s"]), cmd.get("kind", "*"))
+            res["applied_rv"] = cp.store.current_rv
+            out(res)
+    srv.stop()
+    pers.close()
+
+
+def _replica_digest(store) -> str:
+    import hashlib
+
+    from karmada_tpu.server import codec
+
+    h = hashlib.sha256()
+    for line in sorted(
+        json.dumps(codec.encode(o), sort_keys=True)
+        for kind in store.kinds() for o in store.list(kind)
+    ):
+        h.update(line.encode())
+        h.update(b"\n")
+    h.update(str(store.current_rv).encode())
+    return h.hexdigest()
+
+
+def _replica_measure(cache, watchers, window_s, kind) -> dict:
+    """W watch cursors over this plane's shared revisioned ring, served
+    by a fixed thread pool — the fanout bench's mux-leg model, run inside
+    a FOLLOWER while replicated events stream in.
+
+    The serving interval is FIXED (write window + 2x drain) and identical
+    across the 1-vs-2-follower legs: at the 10k-watcher acceptance point
+    the backlog (watchers x window events) far exceeds one process's
+    serving capacity over the interval, so delivered/interval measures
+    saturated per-replica capacity and the aggregate scales with
+    follower count, not with how long a drain happened to take."""
+    import threading
+
+    serve_s = window_s * 3.0
+    start_rv = cache.current_rv
+    cursors = [start_rv] * watchers
+    delivered = [0] * watchers
+    stop = threading.Event()
+
+    def server(s):
+        idxs = range(s, watchers, REPLICA_SERVERS)
+        while not stop.is_set():
+            moved = False
+            for i in idxs:
+                events, cursor, ok = cache.events_since(
+                    cursors[i], kind, limit=256)
+                if not ok:
+                    cursors[i], _items = cache.snapshot(kind)
+                    continue
+                cursors[i] = cursor
+                if not events:
+                    continue
+                b"".join(ev.line() for ev in events)
+                delivered[i] += len(events)
+                moved = True
+            if not moved:
+                time.sleep(0.002)
+
+    threads = [threading.Thread(target=server, args=(s,), daemon=True)
+               for s in range(REPLICA_SERVERS)]
+    t_start = time.perf_counter()
+    for t in threads:
+        t.start()
+    time.sleep(serve_s)
+    elapsed = time.perf_counter() - t_start
+    stop.set()
+    for t in threads:
+        t.join(timeout=10.0)
+    return {
+        "watchers": watchers,
+        "delivered": sum(delivered),
+        "events_per_s": round(sum(delivered) / elapsed, 1),
+        "elapsed_s": round(elapsed, 2),
+    }
+
+
+def _replica_spawn(n, work, tag):
+    """n follower child processes; returns [(proc, url)]."""
+    procs = []
+    for i in range(n):
+        d = os.path.join(work, f"{tag}-f{i}")
+        os.makedirs(d, exist_ok=True)
+        p = subprocess.Popen(
+            [sys.executable, os.path.abspath(__file__), "--replica-child",
+             "--replica-data-dir", d],
+            stdin=subprocess.PIPE, stdout=subprocess.PIPE, text=True,
+            env=_child_env(),
+        )
+        ready = json.loads(p.stdout.readline())
+        procs.append((p, ready["url"]))
+    return procs
+
+
+def _replica_ask(proc, cmd) -> dict:
+    proc.stdin.write(json.dumps(cmd) + "\n")
+    proc.stdin.flush()
+    return json.loads(proc.stdout.readline())
+
+
+def _replica_stop(children):
+    for p, _ in children:
+        try:
+            p.stdin.write('{"cmd": "exit"}\n')
+            p.stdin.flush()
+        except (BrokenPipeError, OSError):
+            pass
+    for p, _ in children:
+        try:
+            p.wait(timeout=10)
+        except subprocess.TimeoutExpired:
+            p.kill()
+
+
+def _replica_read_leg(n_followers, watchers, writers, window_s, work,
+                      tag=""):
+    """Leader (this process) drives a sustained write load whose commit
+    stream ships async to `n_followers` child processes, each serving its
+    share of the `watchers` cursor fan-out from its OWN watch cache on
+    its OWN cores. Aggregate events/s is the group's read capacity —
+    the claim is that it scales with follower count because every
+    follower serves the same rv-exact stream."""
+    from karmada_tpu.store.replication import ReplicationManager
+    from karmada_tpu.store.store import Store
+
+    store = Store()
+    children = _replica_spawn(n_followers, work, f"read{n_followers}{tag}")
+    # log ring sized past the window's write volume: a follower briefly
+    # out-paced by the writers must catch up through the APPEND stream —
+    # falling off the ring mid-window would degrade it into snapshot
+    # resyncs, whose state jumps skip the ring events being measured
+    mgr = ReplicationManager(
+        store, [url for _, url in children], mode="async", quorum=1,
+        token=1, identity="bench-leader", max_entries=65_536,
+    )
+    mgr.attach()
+    try:
+        for i in range(REPLICA_OBJECTS):
+            store.create(_fanout_obj(i, t=str(time.perf_counter())))
+        for p, _ in children:  # bootstrap sync before the measured window
+            _replica_ask(p, {"cmd": "wait_rv", "rv": store.current_rv})
+        per = max(watchers // n_followers, 1)
+        for p, _ in children:
+            p.stdin.write(json.dumps({
+                "cmd": "measure", "watchers": per, "window_s": window_s,
+                "kind": FANOUT_KIND}) + "\n")
+            p.stdin.flush()
+        write_lats, n_writes, _t = _fanout_writers_run(
+            store, writers, REPLICA_OBJECTS, window_s)
+        replies = [json.loads(p.stdout.readline()) for p, _ in children]
+        tip = store.current_rv
+        digests = []
+        for p, _ in children:
+            _replica_ask(p, {"cmd": "wait_rv", "rv": tip})
+            digests.append(_replica_ask(p, {"cmd": "digest"}))
+        leader_sha = _replica_digest(store)
+        p = _percentiles(write_lats)
+        return {
+            "followers": n_followers,
+            "watchers": per * n_followers,
+            "writes": n_writes,
+            "events_per_s": round(sum(r["events_per_s"] for r in replies), 1),
+            "delivered": sum(r["delivered"] for r in replies),
+            "write_p99_s": p["p99_s"],
+            "per_follower": replies,
+            "rv_consistent": all(
+                d["sha"] == leader_sha and d["rv"] == tip for d in digests),
+        }
+    finally:
+        mgr.close()
+        _replica_stop(children)
+
+
+def _replica_quorum_leg(follower_urls, window_s, data_dir,
+                        batch=REPLICA_QUORUM_BATCH, writers=16):
+    """Batched write throughput with full durability under W concurrent
+    writers (the PR-9 writeload shape) — and, when follower_urls is
+    non-empty, QUORUM=all acks piggybacked on each batch: one append
+    round-trip + one follower fsync per update_batch. W writers matter
+    for the same reason group commit does: while one writer waits out its
+    batch's quorum ack, the others commit and their entries ride the SAME
+    shipping request, so the round-trip amortizes across in-flight
+    batches instead of serializing behind each one."""
+    import threading
+
+    from karmada_tpu.store.persistence import StorePersistence
+    from karmada_tpu.store.replication import ReplicationManager
+    from karmada_tpu.store.store import Store
+
+    store = Store()
+    pers = StorePersistence(store, data_dir)
+    pers.attach()
+    mgr = None
+    if follower_urls:
+        mgr = ReplicationManager(
+            store, follower_urls, mode="quorum", quorum=len(follower_urls),
+            token=1, identity="bench-leader",
+        )
+        mgr.attach()
+    try:
+        for w in range(writers):
+            store.create_batch(
+                [_fanout_obj(w * batch + j) for j in range(batch)])
+        payloads = [
+            [_fanout_obj(w * batch + j, t="q") for j in range(batch)]
+            for w in range(writers)
+        ]
+        counts = [0] * writers
+        t0 = time.perf_counter()
+        t_end = t0 + window_s
+
+        def writer(w):
+            objs = payloads[w]
+            while time.perf_counter() < t_end:
+                store.update_batch(objs)
+                counts[w] += batch
+
+        threads = [threading.Thread(target=writer, args=(w,), daemon=True)
+                   for w in range(writers)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        elapsed = time.perf_counter() - t0
+        n = sum(counts)
+        return {
+            "writes": n,
+            "writes_per_s": round(n / elapsed, 1),
+            "writers": writers,
+            "elapsed_s": round(elapsed, 2),
+            "final_rv": store.current_rv,
+            "sha": _replica_digest(store),
+        }
+    finally:
+        if mgr is not None:
+            mgr.close()
+        pers.close()
+
+
+def _replica_failover_leg(n_acked=50):
+    """Seal-and-promote timing, in-process (promotion is control logic,
+    not CPU): quorum-acked writes, leader vanishes without cleanup, the
+    acked follower promotes after the lease TTL and serves — zero
+    quorum-acked writes may be missing on the new leader."""
+    from karmada_tpu.server.apiserver import ControlPlaneServer
+    from karmada_tpu.store.replication import (
+        REPLICATION_LEASE,
+        ReplicaControlPlane,
+        ReplicationError,
+        ReplicationManager,
+        seal_and_promote,
+    )
+
+    a = ControlPlaneServer(ReplicaControlPlane())
+    a.start()
+    b = ControlPlaneServer(ReplicaControlPlane())
+    b.start()
+    leader_cp = ReplicaControlPlane()
+    lease, _ = leader_cp.coordinator.acquire(
+        REPLICATION_LEASE, "bench-leader", 0.25)
+    mgr = ReplicationManager(
+        leader_cp.store, [a.url], mode="quorum", quorum=1,
+        token=lease.spec.fencing_token, identity="bench-leader",
+    )
+    mgr.attach()
+    new_mgr = None
+    try:
+        for i in range(n_acked):
+            leader_cp.store.create(_fanout_obj(i, t="acked"))
+        t0 = time.perf_counter()
+        mgr.close()  # the leader is gone; nothing released or sealed
+        while True:  # promotion wins once the 0.25 s lease TTL lapses
+            try:
+                new_mgr = seal_and_promote(
+                    a, [b.url], identity="bench-follower-a", mode="async")
+                break
+            except ReplicationError:
+                time.sleep(0.02)
+        out = a.cp.store.create(_fanout_obj(n_acked, t="post-failover"))
+        failover_s = time.perf_counter() - t0
+        lost = sum(
+            1 for i in range(n_acked)
+            if a.cp.store.try_get(FANOUT_KIND, f"obj-{i:05d}", "bench")
+            is None
+        )
+        deadline = time.monotonic() + 10.0
+        while (b.cp.store.current_rv < out.metadata.resource_version
+               and time.monotonic() < deadline):
+            time.sleep(0.02)
+        return {
+            "failover_s": round(failover_s, 3),
+            "acked_writes": n_acked,
+            "lost_acked_writes": lost,
+            "new_token": new_mgr.token,
+            "old_token": mgr.token,
+            "peer_caught_up": b.cp.store.current_rv
+            >= out.metadata.resource_version,
+        }
+    finally:
+        if new_mgr is not None:
+            new_mgr.close()
+        a.stop()
+        b.stop()
+
+
+def run_replica(args, backend_label: str, verbose=False) -> dict:
+    """The `replica` config: leader + follower child processes.
+
+    Legs: (1) read fan-out — the same total watcher count served by 1 vs
+    2 followers (each its own process/GIL), aggregate events/s must scale
+    >= 1.7x; (2) quorum writes — in-process batched write rate alone vs
+    with quorum=2 replication riding each batch, must retain >= 0.5x;
+    (3) rv-exactness — follower digests equal the leader's at the final
+    acked rv in both legs; (4) failover — seal-and-promote after leader
+    death, zero quorum-acked writes lost. Host-side; no device kernels."""
+    import shutil
+    import tempfile
+
+    watchers = int(args.watchers)
+    window_s = float(args.window_s)
+    work = tempfile.mkdtemp(prefix="replica-bench-")
+    prev_switch = sys.getswitchinterval()
+    sys.setswitchinterval(0.0005)
+    try:
+        # two trials per leg, best taken: serving capacity is a
+        # supremum — scheduler noise and shipping hiccups only ever
+        # SUBTRACT from a trial, so min-of-noise comparisons would
+        # measure the hiccups, not the replicas
+        def read_leg(n):
+            trials = [
+                _replica_read_leg(n, watchers, REPLICA_WRITERS, window_s,
+                                  work, tag=f"t{t}")
+                for t in range(2)
+            ]
+            best = max(trials, key=lambda t: t["events_per_s"])
+            best["trials_events_per_s"] = [t["events_per_s"]
+                                           for t in trials]
+            best["rv_consistent"] = all(t["rv_consistent"] for t in trials)
+            return best
+
+        read_1f = read_leg(1)
+        if verbose:
+            print(f"# replica read 1f: {read_1f['events_per_s']:.0f} ev/s "
+                  f"(trials {read_1f['trials_events_per_s']})")
+        read_2f = read_leg(2)
+        if verbose:
+            print(f"# replica read 2f: {read_2f['events_per_s']:.0f} ev/s "
+                  f"(trials {read_2f['trials_events_per_s']})")
+
+        single = _replica_quorum_leg([], window_s,
+                                     os.path.join(work, "single"))
+        children = _replica_spawn(2, work, "quorum")
+        try:
+            quorum = _replica_quorum_leg(
+                [url for _, url in children], window_s,
+                os.path.join(work, "quorum-leader"))
+            q_digests = []
+            for p, _ in children:
+                _replica_ask(p, {"cmd": "wait_rv", "rv": quorum["final_rv"]})
+                q_digests.append(_replica_ask(p, {"cmd": "digest"}))
+            quorum_consistent = all(
+                d["sha"] == quorum["sha"] and d["rv"] == quorum["final_rv"]
+                for d in q_digests)
+        finally:
+            _replica_stop(children)
+        if verbose:
+            print(f"# replica writes: single {single['writes_per_s']:.0f}/s "
+                  f"quorum2 {quorum['writes_per_s']:.0f}/s")
+
+        failover = _replica_failover_leg()
+        if verbose:
+            print(f"# replica failover: {failover['failover_s']}s, "
+                  f"lost {failover['lost_acked_writes']}")
+    finally:
+        sys.setswitchinterval(prev_switch)
+        shutil.rmtree(work, ignore_errors=True)
+
+    scaling = (round(read_2f["events_per_s"] / read_1f["events_per_s"], 2)
+               if read_1f["events_per_s"] else None)
+    retained = (round(quorum["writes_per_s"] / single["writes_per_s"], 2)
+                if single["writes_per_s"] else None)
+    rv_consistent = bool(read_1f["rv_consistent"]
+                         and read_2f["rv_consistent"] and quorum_consistent)
+    rec = {
+        "metric": f"replica_read_scaling_{watchers}w",
+        "value": scaling,
+        "unit": "x",
+        "backend": backend_label,
+        "watchers": watchers,
+        "writers": REPLICA_WRITERS,
+        "window_s": window_s,
+        "read_1f": read_1f,
+        "read_2f": read_2f,
+        "read_scaling_1f_to_2f": scaling,
+        "write_single_node": single,
+        "write_quorum2": {k: v for k, v in quorum.items() if k != "sha"},
+        "quorum_write_retained": retained,
+        "rv_consistent": rv_consistent,
+        "failover": failover,
+        "pass_read_scaling": bool(scaling is not None and scaling >= 1.7),
+        "pass_write_retained": bool(retained is not None and retained >= 0.5),
+        "pass_rv_consistent": rv_consistent,
+        "pass_failover_zero_loss": failover["lost_acked_writes"] == 0,
+    }
+    rec["pass"] = (rec["pass_read_scaling"] and rec["pass_write_retained"]
+                   and rec["pass_rv_consistent"]
+                   and rec["pass_failover_zero_loss"])
+    if verbose:
+        print(f"# replica: {scaling}x read scaling 1f->2f, quorum retains "
+              f"{retained}x writes, rv_consistent={rv_consistent}, "
+              f"failover {failover['failover_s']}s -> pass={rec['pass']}")
+    return rec
+
+
 def build_flagship_cold(seed=0, n_clusters=5000, n_bindings=10000):
     """North-star variant, adversarial to the per-placement encode cache:
     every measured iteration bumps each binding's generation first
@@ -2147,14 +2627,15 @@ CONFIGS = {
     "stream": (None, None),  # daemon-topology rate drive; see run_stream
     "fanout": (None, None),  # serving-path read scaling; see run_fanout
     "writeload": (None, None),  # write-path batching; see run_writeload
+    "replica": (None, None),  # replicated store group; see run_replica
     "flagship_cold": (build_flagship_cold, None),  # named after the shape
     "flagship": (build_flagship, None),  # metric name carries the shape
 }
 DEFAULT_ORDER = [
     "dup3", "static", "dynamic", "spread", "spread_skewed", "churn",
     "churn_incremental", "autoshard", "pipeline", "whatif", "degraded",
-    "coldstart", "stream", "fanout", "writeload", "flagship_cold",
-    "flagship",
+    "coldstart", "stream", "fanout", "writeload", "replica",
+    "flagship_cold", "flagship",
 ]
 
 # coldstart measures PROCESS boot, not round latency — a fixed modest shape
@@ -2204,6 +2685,16 @@ def add_args(ap: argparse.ArgumentParser) -> None:
                     default=WRITELOAD_WRITERS, help=argparse.SUPPRESS)
     ap.add_argument("--writeload-window-s", type=float,
                     default=WRITELOAD_WINDOW_S, help=argparse.SUPPRESS)
+    # replica config overrides (watchers: the 10k acceptance point) +
+    # follower-child mode (run_replica_child)
+    ap.add_argument("--replica-watchers", type=int,
+                    default=REPLICA_WATCHERS, help=argparse.SUPPRESS)
+    ap.add_argument("--replica-window-s", type=float,
+                    default=REPLICA_WINDOW_S, help=argparse.SUPPRESS)
+    ap.add_argument("--replica-child", action="store_true",
+                    help=argparse.SUPPRESS)
+    ap.add_argument("--replica-data-dir", default="",
+                    help=argparse.SUPPRESS)
     # platform must be pinned via jax.config inside the child, not the
     # JAX_PLATFORMS env var (the TPU sitecustomize hangs on the env var)
     ap.add_argument("--platform", default=None, help=argparse.SUPPRESS)
@@ -2267,6 +2758,9 @@ def main() -> None:
     if args.coldstart_child:
         run_coldstart_child(args)
         return
+    if args.replica_child:
+        run_replica_child(args)
+        return
     if args.inner:
         run_bench(args)
         return
@@ -2289,6 +2783,8 @@ def main() -> None:
             "--fanout-window-s", str(args.fanout_window_s),
             "--writeload-writers", str(args.writeload_writers),
             "--writeload-window-s", str(args.writeload_window_s),
+            "--replica-watchers", str(args.replica_watchers),
+            "--replica-window-s", str(args.replica_window_s),
         ] + (["--verbose"] if args.verbose else []) \
           + (["--platform", platform] if platform else [])
         budget = deadline - time.perf_counter()
@@ -2428,6 +2924,24 @@ def run_bench(args) -> None:
                     "error": f"{type(e).__name__}: {e}"[:300],
                 }
             # host-side write-path bench: meaningful on any backend
+            lines.append(json.dumps(rec))
+            continue
+        if name == "replica":
+            import types
+
+            rp_args = types.SimpleNamespace(
+                watchers=args.replica_watchers,
+                window_s=args.replica_window_s,
+            )
+            try:
+                rec = run_replica(rp_args, backend, verbose=args.verbose)
+            except Exception as e:  # noqa: BLE001 - one labeled error line
+                rec = {
+                    "metric": f"replica_read_scaling_{args.replica_watchers}w",
+                    "value": None, "unit": "x", "backend": backend,
+                    "error": f"{type(e).__name__}: {e}"[:300],
+                }
+            # host-side replication bench: meaningful on any backend
             lines.append(json.dumps(rec))
             continue
         if name == "stream":

@@ -535,7 +535,8 @@ def cmd_get(cp: ControlPlane, kind: str, name: str = "", namespace: str = "",
         ]
         return _fmt_table(rows, ["KIND", "OBJECT", "TYPE", "REASON", "COUNT"])
     if resolved == "LeaderLease":
-        return _elections_table(objs, wide=wide)
+        return _elections_table(objs, wide=wide,
+                                repl=_replication_status(cp))
     if resolved == "SimulationReport":
         return _simulation_reports_table(objs, wide=wide)
     rows = [
@@ -1034,13 +1035,44 @@ def cmd_addons(cp: ControlPlane) -> str:
     return _fmt_table(rows, ["ADDON", "STATUS"])
 
 
-def _elections_table(leases, wide: bool = False) -> str:
+def _replication_status(cp) -> Optional[dict]:
+    """Best-effort replication role of the plane the CLI is talking to
+    (GET /replication/status over the wire; a single in-process plane
+    reads as role=single at its own store rv). None when the plane
+    predates the replication routes."""
+    fetch = getattr(cp, "replication_status", None)
+    if fetch is not None:
+        try:
+            return fetch()
+        except Exception:  # noqa: BLE001 - pre-replication daemon
+            return None
+    rv = getattr(cp.store, "current_rv", None)
+    if rv is None:
+        return None
+    return {"role": "single", "applied_rv": rv}
+
+
+def _role_cell(repl: Optional[dict]) -> str:
+    """leader/follower/candidate + last-acked rv, e.g. follower@rv123."""
+    if not repl:
+        return "-"
+    role = repl.get("role", "single")
+    rv = repl.get("applied_rv")
+    return f"{role}@rv{rv}" if rv is not None else role
+
+
+def _elections_table(leases, wide: bool = False,
+                     repl: Optional[dict] = None) -> str:
     """Shared LeaderLease table (the `elections` verb and `get
-    leaderleases` print the same columns)."""
+    leaderleases` print the same columns). The ROLE column is the
+    REPLICATION role of the plane answering (leader/follower/single +
+    its last-applied rv) — on a follower it shows how far behind the
+    served view is."""
     import time as _time
 
     rows = []
     now = _time.time()
+    role = _role_cell(repl)
     for l in sorted(leases, key=lambda l: (l.metadata.namespace,
                                            l.metadata.name)):
         s = l.spec
@@ -1053,11 +1085,13 @@ def _elections_table(leases, wide: bool = False) -> str:
         age = max(0.0, now - s.renew_time) if s.renew_time else 0.0
         rows.append(
             [l.metadata.name, s.holder_identity or "<none>", state,
-             str(s.fencing_token), str(s.lease_transitions), f"{age:.0f}s"]
+             str(s.fencing_token), str(s.lease_transitions), f"{age:.0f}s",
+             role]
             + ([l.metadata.namespace,
                 f"{s.lease_duration_seconds:.0f}s"] if wide else [])
         )
-    headers = ["NAME", "HOLDER", "STATE", "FENCING", "TRANSITIONS", "RENEWED"]
+    headers = ["NAME", "HOLDER", "STATE", "FENCING", "TRANSITIONS",
+               "RENEWED", "ROLE"]
     if wide:
         headers += ["NAMESPACE", "TTL"]
     return _fmt_table(rows, headers)
@@ -1070,7 +1104,39 @@ def cmd_elections(cp: ControlPlane, wide: bool = False) -> str:
     if not leases:
         return ("No elections found: no daemon has acquired a LeaderLease "
                 "on this plane.")
-    return _elections_table(leases, wide=wide)
+    return _elections_table(leases, wide=wide, repl=_replication_status(cp))
+
+
+def cmd_replication_status(cp: ControlPlane) -> str:
+    """`karmadactl replication status` — this plane's replication role;
+    on a leader, one row per follower with its rv lag (docs/HA.md).
+    Backed by GET /replication/status."""
+    st = _replication_status(cp)
+    if st is None:
+        return "replication: status unavailable (pre-replication daemon?)"
+    role = st.get("role", "single")
+    head = [f"role: {role}", f"applied rv: {st.get('applied_rv')}"]
+    if st.get("token"):
+        head.append(f"fencing token: {st['token']}")
+    if role == "leader":
+        head.append(f"mode: {st.get('mode')} (quorum {st.get('quorum')})")
+        head.append(f"quorum-acked rv: {st.get('quorum_acked_rv')}")
+        rows = [
+            [p.get("url", ""), str(p.get("acked_rv", 0)),
+             str(p.get("lag_rvs", 0)), str(p.get("snapshots", 0)),
+             str(p.get("appends", 0)), p.get("last_error") or "-"]
+            for p in st.get("peers", [])
+        ]
+        table = _fmt_table(
+            rows, ["FOLLOWER", "ACKED-RV", "LAG", "SNAPSHOTS", "APPENDS",
+                   "LAST-ERROR"])
+        return "\n".join(head) + ("\n" + table if rows else "")
+    if role in ("follower", "promoted", "candidate"):
+        head.append(f"leader: {st.get('leader') or '<none>'} "
+                    f"({st.get('leader_url') or '?'})")
+        if st.get("sealed_rv") is not None:
+            head.append(f"sealed at rv: {st['sealed_rv']}")
+    return "\n".join(head)
 
 
 def _simulation_reports_table(reports, wide: bool = False) -> str:
@@ -1376,6 +1442,10 @@ def run(cp: ControlPlane, argv: list[str]) -> str:
     p = sub.add_parser("elections")
     p.add_argument("-o", "--output", default="",
                    help="'' (table) or wide")
+    p = sub.add_parser("replication")
+    p.add_argument("action", nargs="?", default="status",
+                   help="status (per-follower lag on a leader; role + "
+                        "applied rv elsewhere)")
     p = sub.add_parser("rebalance")
     p.add_argument("workloads", nargs="+", help="apiVersion:Kind:namespace:name")
     p = sub.add_parser("logs")
@@ -1544,6 +1614,11 @@ def run(cp: ControlPlane, argv: list[str]) -> str:
         )
     if args.command == "elections":
         return cmd_elections(cp, wide=args.output == "wide")
+    if args.command == "replication":
+        if args.action != "status":
+            raise CLIError(f"unknown replication action {args.action!r} "
+                           f"(only 'status')")
+        return cmd_replication_status(cp)
     if args.command == "rebalance":
         workloads = []
         for w in args.workloads:

@@ -357,6 +357,30 @@ writes_coalesced = registry.counter(
     "round-trip, by call-site path",
 )
 
+# replicated store (store/replication.py — docs/HA.md): per-follower rv
+# lag (Gauge with remove() on peer departure — a torn-down peer must not
+# leave a frozen series, same lesson as the per-client watch lag), quorum
+# ack latency per batch, append outcomes at the shipping boundary, and
+# which role served each read (the follower-read capacity signal)
+replica_lag = registry.gauge(
+    "karmada_replica_lag_rvs",
+    "Per-follower replication lag in resourceVersions behind the leader",
+)
+replication_quorum_latency = registry.histogram(
+    "karmada_replication_quorum_latency_seconds",
+    "Commit-to-quorum-ack latency per replicated batch",
+)
+replication_appends = registry.counter(
+    "karmada_replication_appends_total",
+    "Replication ship attempts by outcome "
+    "(ok/snapshot/gap/stale_token/transport)",
+)
+reads_served = registry.counter(
+    "karmada_reads_served_total",
+    "Object/watch reads served, by replication role "
+    "(leader/follower/single)",
+)
+
 # leader election (coordination/elector.py); mirrors client-go's
 # leader_election_master_status + rest of the election metric family
 leader_election_is_leader = registry.gauge(

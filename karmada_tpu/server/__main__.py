@@ -90,7 +90,40 @@ def main() -> None:
                          "Clock — test drivers only); disabled by default "
                          "so a production daemon's clock cannot be frozen "
                          "via the normal bearer token (403)")
+    ap.add_argument("--replica", action="append", default=[], metavar="URL",
+                    help="replication FOLLOWER endpoint (repeatable): this "
+                         "server leads a replicated store group, shipping "
+                         "its commit stream to each URL and fencing the "
+                         "appends with the karmada-store lease token "
+                         "(docs/HA.md)")
+    ap.add_argument("--replication", default="async",
+                    choices=("async", "quorum"),
+                    help="with --replica: 'quorum' holds every write until "
+                         "--replication-quorum followers fsync'd its log "
+                         "entry (one ack round-trip per BATCH); 'async' "
+                         "ships in the background with bounded lag")
+    ap.add_argument("--replication-quorum", type=int, default=1,
+                    help="follower acks a quorum-mode write waits for")
+    ap.add_argument("--advertise-url", default="",
+                    help="URL followers and redirected clients should dial "
+                         "this server at (default: the bound host:port)")
+    ap.add_argument("--follower", action="store_true",
+                    help="serve as a replication follower: reads + the "
+                         "replication apply path only. Disables controllers, "
+                         "the tick loop, and the self-election — a follower "
+                         "minting local resourceVersions would fork the "
+                         "leader's contiguous log. Promotion "
+                         "(store/replication.seal_and_promote) turns it "
+                         "into a leader on failover")
     args = ap.parse_args()
+
+    if args.follower and args.replica:
+        import sys
+
+        print("fatal: --follower and --replica are mutually exclusive "
+              "(a follower becomes a leader via promotion, not flags)",
+              file=sys.stderr, flush=True)
+        raise SystemExit(2)
 
     # bearer tokens over plaintext HTTP on a routable interface leak the
     # credential to the network (the reference never serves token authn
@@ -145,8 +178,12 @@ def main() -> None:
         print(f"faults: chaos plan installed from {faults.ENV_FAULT_PLAN}",
               flush=True)
 
+    # a follower must not run controllers: every controller write would
+    # mint a local rv and fork the replicated log. An empty list (not
+    # [""], which the name validation rejects) disables them all.
+    controllers = [] if args.follower else args.controllers.split(",")
     cp = ControlPlane(
-        controllers=args.controllers.split(","),
+        controllers=controllers,
         estimator_workers=args.estimator_workers or None,
     )
     persistence = None
@@ -200,15 +237,54 @@ def main() -> None:
         print(f"auth: read-only scrape token accepted on /metrics "
               f"(--scrape-token-file {args.scrape_token_file})", flush=True)
 
+    replication = None
+    repl_identity = None
+    if args.replica:
+        from ..coordination.elector import default_identity
+        from ..store.replication import REPLICATION_LEASE, ReplicationManager
+
+        repl_identity = default_identity()
+        # the acquisition mints the fencing token every append carries; the
+        # lease is a store object, so it REPLICATES and the counter's
+        # monotonicity survives failover (a promoted follower's local
+        # acquire mints token+1 against its replicated copy). The WAIT on
+        # `acquired` matters: a restarted daemon (fresh hostname_pid
+        # identity) inside the previous holder's TTL would otherwise ship
+        # with a token it does NOT hold — two leaders on one token is the
+        # split-brain the fence exists to prevent.
+        while True:
+            lease, acquired = cp.coordinator.acquire(
+                REPLICATION_LEASE, repl_identity)
+            if acquired:
+                break
+            print(
+                f"replication: {REPLICATION_LEASE} lease held by "
+                f"{lease.spec.holder_identity!r}; waiting for the TTL",
+                flush=True,
+            )
+            time.sleep(max(1.0, lease.spec.lease_duration_seconds / 3.0))
+        replication = ReplicationManager(
+            cp.store, args.replica,
+            mode=args.replication, quorum=args.replication_quorum,
+            token=lease.spec.fencing_token, identity=repl_identity,
+            advertise_url=args.advertise_url, auth_token=token,
+        )
+
     srv = ControlPlaneServer(cp, host=args.host, port=args.port,
                              ssl_context=ssl_context, token=token,
                              enable_test_clock=args.enable_test_clock,
                              scrape_token=scrape_token,
                              socket_timeout=args.socket_timeout,
                              watch_cache=not args.no_watch_cache,
-                             watch_cache_capacity=args.watch_cache_events)
+                             watch_cache_capacity=args.watch_cache_events,
+                             replication=replication,
+                             follower=args.follower)
     srv.start()
-    print(f"karmada-tpu control plane serving on {srv.url}", flush=True)
+    role = ("follower" if args.follower
+            else f"leader of {len(args.replica)} replicas"
+            if args.replica else "single")
+    print(f"karmada-tpu control plane serving on {srv.url} "
+          f"(replication: {role})", flush=True)
 
     # The controller-manager role elects even single-instance (reference:
     # controllermanager.go:154-155 — LeaderElect defaults on). Against this
@@ -222,13 +298,34 @@ def main() -> None:
         default_identity,
     )
 
-    elector = Elector(
-        LocalLeaseClient(cp.coordinator),
-        LEASE_CONTROLLER_MANAGER,
-        default_identity(),
-    )
-    elector.step()
-    elector.run()
+    elector = None
+    repl_elector = None
+    if not args.follower:
+        elector = Elector(
+            LocalLeaseClient(cp.coordinator),
+            LEASE_CONTROLLER_MANAGER,
+            default_identity(),
+        )
+        elector.step()
+        elector.run()
+
+    if replication is not None:
+        # keep the karmada-store lease renewed; losing it deposes the
+        # shipping plane (a successor's higher token fences our appends)
+        from ..store.replication import REPLICATION_LEASE
+
+        repl_elector = Elector(
+            LocalLeaseClient(cp.coordinator),
+            REPLICATION_LEASE,
+            repl_identity,
+            # revive, not just set-token: a deposed manager's shippers
+            # exited, and a leader that merely missed one renewal (GC
+            # pause, no successor) must resume shipping on re-election
+            on_started_leading=replication.revive,
+            on_stopped_leading=replication.depose,
+        )
+        repl_elector.step()
+        repl_elector.run()
 
     def ticker() -> None:
         while True:
@@ -243,14 +340,17 @@ def main() -> None:
 
                     logging.getLogger(__name__).exception("tick loop")
 
-    if args.tick_interval > 0:
+    if args.tick_interval > 0 and not args.follower:
         threading.Thread(target=ticker, name="cp-ticker", daemon=True).start()
 
     try:
         while True:
             time.sleep(3600)
     except KeyboardInterrupt:
-        elector.stop(release=True)
+        if elector is not None:
+            elector.stop(release=True)
+        if repl_elector is not None:
+            repl_elector.stop(release=True)
         srv.stop()
         if persistence is not None:
             persistence.snapshot()

@@ -40,6 +40,14 @@ Routes (all JSON; objects wire-encoded by server/codec.py):
 | POST /simulate       | cp.simulate               | what-if plane: body        |
 |                      |                           | {"request": enc(SimulationRequest)} |
 |                      |                           | → {"report": enc(SimulationReport)} |
+| POST /replication/append   | store.apply_replicated | leader log shipping:  |
+|                      |                           | rv-contiguous entries,     |
+|                      |                           | token-fenced; 409 carries  |
+|                      |                           | expected_rv / stale_token  |
+| POST /replication/snapshot | store.load_snapshot  | catch-up state swap at a   |
+|                      |                           | pinned rv                  |
+| GET  /replication/status   | role + lag view      | leader: per-peer lag;      |
+|                      |                           | follower: applied rv/leader|
 
 Write fencing: a mutating request may carry `X-Karmada-Fencing:
 <namespace>/<lease>:<token>`; the token is checked against the named
@@ -105,7 +113,9 @@ class ControlPlaneServer:
                  scrape_token: Optional[str] = None,
                  socket_timeout: Optional[float] = None,
                  watch_cache: bool = True,
-                 watch_cache_capacity: int = 0):
+                 watch_cache_capacity: int = 0,
+                 replication=None,
+                 follower: bool = False):
         """`enable_test_clock=False` disables POST /tick with 403: advancing
         a nonzero `seconds` freezes the plane's Clock at the advanced
         instant, which is a test-driver affordance — a production daemon
@@ -126,7 +136,18 @@ class ControlPlaneServer:
         subscription per stream. False restores the per-subscription
         baseline (the fanout bench's comparison leg; daemon flag
         --no-watch-cache). `watch_cache_capacity`: ring size in events
-        (0 = the module default)."""
+        (0 = the module default).
+
+        `replication`: a `store.replication.ReplicationManager` to attach
+        on start — this server is the replication LEADER, shipping its
+        commit stream to followers (docs/HA.md). Any server also serves
+        the FOLLOWER side lazily: the first authenticated
+        POST /replication/append flips it into follower mode (ordinary
+        store writes then 409 with a leader_url redirect until
+        promote()). `follower=True` (daemon --follower) enters follower
+        mode from BOOT: client writes are rejected even before the
+        leader's first append — a write accepted in that window would
+        mint a local rv and fork the replicated log."""
         from .httpbase import DEFAULT_SOCKET_TIMEOUT
 
         self.cp = cp
@@ -143,6 +164,9 @@ class ControlPlaneServer:
         self._use_watch_cache = watch_cache
         self._watch_cache_capacity = watch_cache_capacity
         self._watch_cache = None
+        self._repl = replication          # leader role (ships the log)
+        self._follower = None             # follower role (lazily created)
+        self._follower_mode = follower    # reject writes from boot
         self._watch_ids = itertools.count(1)
         self._httpd: Optional[ThreadingHTTPServer] = None
         self._threads: list[threading.Thread] = []
@@ -188,6 +212,17 @@ class ControlPlaneServer:
                 kwargs["capacity"] = self._watch_cache_capacity
             self._watch_cache = WatchCache(self.cp.store, **kwargs)
             self._watch_cache.attach()
+        if self._repl is not None:
+            # followers learn the redirect target from the append stream:
+            # default the advertised URL to the bound address BEFORE the
+            # shippers start, or the first appends would carry an empty
+            # leader_url and early follower 409s couldn't re-point clients
+            if not self._repl.advertise_url:
+                self._repl.advertise_url = self.url
+            # after the cache (and after any persistence the daemon
+            # attached): batch watchers run in subscription order, so a
+            # quorum wait begins only once the local fsync completed
+            self._repl.attach()
         self.cp.store.watch_all(self._mark_dirty, replay=False)
         for target, name in ((self._httpd.serve_forever, "serve"),
                              (self._reconcile_loop, "reconcile")):
@@ -201,6 +236,8 @@ class ControlPlaneServer:
     def stop(self) -> None:
         self._stopping = True
         self.cp.store.unwatch_all(self._mark_dirty)
+        if self._repl is not None:
+            self._repl.close()
         if self._watch_cache is not None:
             self._watch_cache.detach()
         self._dirty.set()
@@ -269,9 +306,15 @@ class ControlPlaneServer:
             return
         # lease-management routes are exempt from fencing: acquire IS how a
         # deposed leader (whose client still carries its old token) re-enters
-        # the election, and renew/release validate their own token server-side
-        if (method != "GET" and not parsed.path.startswith("/leases")
+        # the election, and renew/release validate their own token server-side.
+        # Replication routes carry their own (monotonic) token fence in the
+        # body — a follower plane has no coordinator to resolve the header
+        # against, and the append fence must hold there regardless.
+        if (method != "GET"
+                and not parsed.path.startswith(("/leases", "/replication"))
                 and not self._fence_ok(h)):
+            return
+        if method != "GET" and not self._follower_write_ok(h, parsed.path):
             return
         try:
             fn = getattr(self, f"_h_{method}_{parsed.path.strip('/').replace('/', '_')}", None)
@@ -323,6 +366,90 @@ class ControlPlaneServer:
             return False
         return True
 
+    # -- replicated-store roles (store/replication.py, docs/HA.md) --------
+
+    # store-mutating routes a FOLLOWER must refuse: a follower minting a
+    # local rv would fork the leader's contiguous log. This includes
+    # /settle and /tick (controller/timer passes write), /simulate (the
+    # plane persists SimulationReports + retention deletes), and the
+    # LEASE routes — an election CAS is a store write like any other
+    # (promotion uses the local in-process coordinator, never these
+    # routes; electors dialing a follower follow the redirect). The
+    # replication routes are the apply path itself.
+    _FOLLOWER_BLOCKED = ("/objects", "/objects/batch", "/apply",
+                         "/join", "/unjoin", "/settle", "/tick",
+                         "/simulate", "/leases/acquire", "/leases/renew",
+                         "/leases/release")
+
+    def _is_follower(self) -> bool:
+        """Follower for write-rejection purposes: flagged at boot
+        (--follower, before the leader's first append arrives) or flipped
+        by an accepted append — and not yet promoted."""
+        fol = self._follower
+        if fol is not None:
+            if fol.sealed:
+                return False  # promoted
+            return fol.active or self._follower_mode
+        return self._follower_mode
+
+    def _follower_write_ok(self, h, path: str) -> bool:
+        """True = proceed; False = a rejection was sent. Only
+        store-mutating routes bounce — a 409 whose leader_url lets
+        RemoteStore re-point its writes automatically. A boot follower
+        that has not heard from ANY leader yet answers 503 instead: a
+        bare 409 would read as an object conflict to callers using the
+        `except ConflictError: pass # already exists` idiom, silently
+        dropping the write."""
+        if path not in self._FOLLOWER_BLOCKED or not self._is_follower():
+            return True
+        fol = self._follower
+        leader_url = fol.leader_url if fol is not None else ""
+        drain_body(h)
+        if not leader_url:
+            self._send(h, 503, {
+                "error": "this plane is a replication follower with no "
+                         "leader contact yet; retry against the leader",
+            })
+            return False
+        self._send(h, 409, {
+            "error": "this plane is a replication follower"
+                     + (f" of {fol.leader_id!r}" if fol.leader_id else "")
+                     + "; writes go to the leader",
+            "leader_url": leader_url,
+        })
+        return False
+
+    def _replication_role(self) -> str:
+        if self._is_follower():
+            return "follower"
+        if self._repl is not None and not self._repl.deposed:
+            return "leader"
+        return "single"
+
+    def _ensure_follower(self):
+        if self._follower is None:
+            from ..store.replication import FollowerState
+
+            self._follower = FollowerState(self.cp.store)
+        return self._follower
+
+    def seal_follower(self) -> int:
+        """Promotion step 1 (store/replication.seal_and_promote): stop
+        accepting appends; returns the sealed rv."""
+        fol = self._ensure_follower()
+        return fol.seal()
+
+    def unseal_follower(self) -> None:
+        """Roll back a failed promotion: return to follower service."""
+        if self._follower is not None:
+            self._follower.unseal()
+
+    def promote(self, manager) -> None:
+        """Promotion step 3: install the leader role. The manager ships
+        this store's commit stream to the surviving peers from here on."""
+        self._repl = manager
+        manager.attach()
+
     @staticmethod
     def _send(h, status: int, body: dict) -> None:
         send_json(h, status, body)
@@ -339,11 +466,51 @@ class ControlPlaneServer:
     def _h_GET_kinds(self, h, q):
         self._send(h, 200, {"kinds": self.cp.store.kinds()})
 
+    # how long a min_rv= read barrier waits for replication to catch up
+    # before answering 504 (read-your-writes callers retry or re-route)
+    MIN_RV_WAIT_S = 5.0
+
+    def _min_rv_ok(self, h, q) -> bool:
+        """The min_rv= read barrier: block until this plane's store has
+        applied at least that resourceVersion (a follower waiting out
+        replication lag), else 504. True = proceed."""
+        try:
+            min_rv = int(q.get("min_rv") or 0)
+        except ValueError:
+            min_rv = 0
+        if min_rv <= 0:
+            return True
+        deadline = time.monotonic() + self.MIN_RV_WAIT_S
+        cache = self._watch_cache
+        while not self._stopping:
+            have = (cache.current_rv if cache is not None
+                    else self.cp.store.current_rv)
+            if have >= min_rv:
+                return True
+            if time.monotonic() >= deadline:
+                drain_body(h)
+                self._send(h, 504, {
+                    "error": f"min_rv {min_rv} not reached "
+                             f"(applied rv {have}) within "
+                             f"{self.MIN_RV_WAIT_S}s",
+                })
+                return False
+            if cache is not None:
+                cache.wait(have, timeout=0.25)
+            else:
+                time.sleep(0.02)
+        return False
+
     def _h_GET_objects(self, h, q):
+        from ..metrics import reads_served
+
         kind = q.get("kind", "")
         if not kind:
             self._send(h, 400, {"error": "kind required"})
             return
+        if not self._min_rv_ok(h, q):
+            return
+        reads_served.inc(role=self._replication_role())
         if "name" in q:
             obj = self.cp.store.get(kind, q["name"], q.get("namespace", ""))
             self._send(h, 200, {"obj": codec.encode(obj)})
@@ -556,6 +723,128 @@ class ControlPlaneServer:
             return
         self._send(h, 200, {"report": codec.encode(report)})
 
+    # -- replicated store (store/replication.py; docs/HA.md) --------------
+
+    def _h_POST_replication_append(self, h, q):
+        """Follower apply path: rv-contiguous log entries from the
+        leader's commit stream, fenced by the monotonic lease token (a
+        deposed leader's stale appends 409 exactly like stale client
+        writes). Applying an entry commits it under one store lock hold,
+        feeds the follower's watch cache the leader's exact events, and
+        reaches the follower's WAL as one group-commit fsync — the 200
+        response IS the durability ack the leader's quorum counts."""
+        from ..store.replication import StaleAppendError
+        from ..store.store import ReplicationGapError
+
+        body = self._body(h)
+        token = int(body.get("token") or 0)
+        if not self._yield_leadership(h, token, body.get("leader", "")):
+            return
+        fol = self._ensure_follower()
+        try:
+            applied = fol.apply_entries(
+                token, body.get("leader", ""), body.get("leader_url", ""),
+                body.get("entries", []),
+            )
+        except StaleAppendError as e:
+            self._send(h, 409, {"error": str(e), "stale_token": True})
+            return
+        except ReplicationGapError as e:
+            self._send(h, 409, {"error": str(e),
+                                "expected_rv": e.expected_rv})
+            return
+        self._send(h, 200, {"applied_rv": applied})
+
+    def _h_POST_replication_snapshot(self, h, q):
+        """Catch-up fallback: replace the whole store state with the
+        leader's rv-pinned snapshot. The watch cache is detached for the
+        swap and re-attached after — its re-primed index is revision-
+        consistent at the snapshot rv, and pre-swap watch cursors fall
+        back to snapshot replay instead of aliasing."""
+        from ..store.replication import StaleAppendError
+
+        body = self._body(h)
+        token = int(body.get("token") or 0)
+        if not self._yield_leadership(h, token, body.get("leader", "")):
+            return
+        fol = self._ensure_follower()
+
+        def swap(rv, objects):
+            cache = self._watch_cache
+            if cache is not None:
+                cache.detach()
+            try:
+                self.cp.store.load_snapshot(rv, objects)
+            finally:
+                if cache is not None:
+                    cache.attach()
+
+        try:
+            applied = fol.apply_snapshot(
+                token, body.get("leader", ""), body.get("leader_url", ""),
+                int(body.get("rv") or 0), body.get("objs", []), swap=swap,
+            )
+        except StaleAppendError as e:
+            self._send(h, 409, {"error": str(e), "stale_token": True})
+            return
+        except ConflictError as e:
+            # the snapshot is BEHIND this store (load_snapshot is
+            # forward-only): this follower ran ahead of the sender's log.
+            # Answer in the gap vocabulary — expected_rv past the
+            # sender's tip is how the shipper recognizes a forked peer
+            # and quarantines it instead of retrying forever.
+            self._send(h, 409, {
+                "error": str(e),
+                "expected_rv": self.cp.store.current_rv + 1,
+            })
+            return
+        self._send(h, 200, {"applied_rv": applied})
+
+    def _yield_leadership(self, h, token: int, leader: str) -> bool:
+        """Two leaders met (this plane leads AND received an append): the
+        strictly higher CLAIM — (token, identity), a total order so two
+        concurrent promotions minting EQUAL tokens against their own
+        replicated lease copies still resolve to exactly one winner —
+        takes over. True = proceed as follower; False = a 409 was sent.
+
+        Yielding CLOSES the local manager (not just depose: a deposed
+        manager still subscribed to watch_all_batch would raise out of
+        every replicated apply, 500ing the new leader's appends) and
+        unseals with resync: a promoted-then-outranked plane minted a
+        local lease rv the winner's log does not contain, so it must
+        re-sync from a snapshot rather than glue entries onto the fork."""
+        if self._repl is None:
+            return True
+        claim = (self._repl.token, self._repl.identity)
+        if (token, leader) <= claim:
+            self._send(h, 409, {
+                "error": f"this plane holds claim {claim}; append claim "
+                         f"({token}, {leader!r}) does not outrank it",
+                "stale_token": True})
+            return False
+        mgr = self._repl
+        self._repl = None
+        mgr.depose(f"append from {leader!r} with higher claim "
+                   f"({token} > {claim})")
+        mgr.close()
+        self._ensure_follower().unseal(resync=True)
+        return True
+
+    def _h_GET_replication_status(self, h, q):
+        """One status view for both roles — what `karmadactl replication
+        status` and the role column of `get leaderleases` read."""
+        role = self._replication_role()
+        if role == "leader":
+            self._send(h, 200, self._repl.status())
+            return
+        if self._follower is not None or self._follower_mode:
+            self._send(h, 200, self._ensure_follower().status())
+            return
+        self._send(h, 200, {
+            "role": "single",
+            "applied_rv": self.cp.store.current_rv,
+        })
+
     def _h_GET_metrics(self, h, q):
         """Prometheus text exposition (VERDICT r5 missing #5). Behind the
         same bearer auth as every other route — _route already checked."""
@@ -579,6 +868,9 @@ class ControlPlaneServer:
     WATCH_BATCH = 256
 
     def _h_GET_watch(self, h, q):
+        from ..metrics import reads_served
+
+        reads_served.inc(role=self._replication_role())
         kind = q.get("kind", "")
         replay = q.get("replay", "1") not in ("0", "false")
         # server-side namespace scoping: a pull agent watching its own

@@ -12,6 +12,7 @@ delivered events in arrival order. `close()` tears the streams down.
 """
 from __future__ import annotations
 
+import itertools
 import json
 import threading
 import time
@@ -38,6 +39,16 @@ class ContinueExpiredRemote(RemoteError):
     paginated crawl restarts from the beginning."""
 
 
+class LeaderRedirect(ConflictError):
+    """A write hit a replication FOLLOWER (or a just-deposed leader): the
+    409 body named the current leader. The client re-points its write
+    base and retries — docs/HA.md replicated topology."""
+
+    def __init__(self, message: str, leader_url: str):
+        super().__init__(message)
+        self.leader_url = leader_url
+
+
 # default list page size: large enough that small fleets still list in one
 # round-trip, small enough that a 40k-binding store never materializes as
 # one response body on either side of the wire
@@ -55,9 +66,14 @@ class _NoBatchRoute(Exception):
 
 
 class RemoteStore:
+    # how long an unreachable replica sits out of the read rotation
+    REPLICA_COOLDOWN_S = 15.0
+
     def __init__(self, base_url: str, timeout: float = 30.0,
                  token: Optional[str] = None, cafile: Optional[str] = None,
-                 page_size: int = DEFAULT_PAGE_SIZE):
+                 page_size: int = DEFAULT_PAGE_SIZE,
+                 replicas: Optional[Iterable[str]] = None,
+                 read_preference: str = "leader"):
         self.base_url = base_url.rstrip("/")
         self.timeout = timeout
         self.token = token
@@ -65,6 +81,20 @@ class RemoteStore:
         # list() auto-paginates in chunks of this many objects (0 = one
         # unpaginated request — also what pre-pagination servers serve)
         self.page_size = page_size
+        # replicated topology (docs/HA.md): follower endpoints for read
+        # routing. read_preference "leader" (default) keeps every call on
+        # base_url; "follower" round-robins GET /objects, list crawls,
+        # and watch streams across the replicas (identical rvs — the
+        # follower consistency contract), falling back to the leader when
+        # a replica is unreachable. Writes ALWAYS go to the leader, and a
+        # 409 naming a new leader re-points them automatically.
+        self._replicas = [u.rstrip("/") for u in (replicas or [])]
+        self.read_preference = read_preference
+        self._rr = itertools.count()
+        # replica -> monotonic deadline while it sits out of the read
+        # rotation (an unreachable replica must not cost every Nth read
+        # a connect timeout forever)
+        self._replica_cooldown: dict[str, float] = {}
         self._ssl_ctx = None
         if self.base_url.startswith("https"):
             import ssl
@@ -112,19 +142,21 @@ class RemoteStore:
             headers["X-Karmada-Fencing"] = self._fence
         return headers
 
-    def _call(self, method: str, path: str, body: Optional[dict] = None) -> dict:
+    def _call(self, method: str, path: str, body: Optional[dict] = None,
+              *, base: Optional[str] = None) -> dict:
         # chaos hook: the HTTP process boundary (faults/plan.py). A decision
         # surfaces as the same RemoteError a real transport failure raises,
         # so every consumer's error handling is exercised, not special-cased.
         from .. import faults
 
+        target = (urlparse(base).netloc if base else self._fault_target)
         try:
-            faults.check(faults.BOUNDARY_HTTP, self._fault_target)
+            faults.check(faults.BOUNDARY_HTTP, target or "control-plane")
         except faults.InjectedFault as e:
             raise RemoteError(f"control plane unreachable: {e}") from None
         data = json.dumps(body).encode() if body is not None else None
         req = Request(
-            self.base_url + path, data=data, method=method,
+            (base or self.base_url) + path, data=data, method=method,
             headers=self._headers(data is not None),
         )
         try:
@@ -133,12 +165,17 @@ class RemoteStore:
                 return json.loads(resp.read().decode() or "{}")
         except HTTPError as e:
             try:
-                msg = json.loads(e.read().decode()).get("error", str(e))
+                payload = json.loads(e.read().decode())
             except Exception:  # noqa: BLE001
-                msg = str(e)
+                payload = {}
+            if not isinstance(payload, dict):
+                payload = {}
+            msg = payload.get("error", str(e))
             if e.code == 404:
                 raise NotFoundError(msg) from None
             if e.code == 409:
+                if payload.get("leader_url"):
+                    raise LeaderRedirect(msg, payload["leader_url"]) from None
                 raise ConflictError(msg) from None
             if e.code == 410:
                 raise ContinueExpiredRemote(msg) from None
@@ -147,6 +184,92 @@ class RemoteStore:
             raise RemoteError(f"HTTP {e.code}: {msg}") from None
         except OSError as e:
             raise RemoteError(f"control plane unreachable: {e}") from None
+
+    # -- replicated-topology routing (docs/HA.md) --------------------------
+
+    def _read_base(self) -> str:
+        """Base URL for the next read: round-robin across replicas when
+        follower reads are preferred (skipping any sitting out a failure
+        cooldown), else the leader."""
+        if not self._replicas or self.read_preference == "leader":
+            return self.base_url
+        now = time.monotonic()
+        for _ in range(len(self._replicas)):
+            base = self._replicas[next(self._rr) % len(self._replicas)]
+            if self._replica_cooldown.get(base, 0.0) <= now:
+                return base
+        return self.base_url  # every replica is cooling down
+
+    def _read_call(self, path: str) -> dict:
+        base = self._read_base()
+        if base != self.base_url:
+            try:
+                return self._call("GET", path, base=base)
+            except RemoteError:
+                # replica unreachable: bench it briefly and fall back to
+                # the leader (without the cooldown a hung replica costs
+                # every rotation hit a full connect timeout, forever)
+                self._replica_cooldown[base] = (
+                    time.monotonic() + self.REPLICA_COOLDOWN_S)
+        return self._call("GET", path)
+
+    def _set_base(self, url: str) -> None:
+        self.base_url = url.rstrip("/")
+        self._fault_target = urlparse(self.base_url).netloc or "control-plane"
+
+    def _repoint(self, leader_url: str) -> None:
+        url = leader_url.rstrip("/")
+        if url and url != self.base_url:
+            old = self.base_url
+            self._set_base(url)
+            if self.read_preference != "leader" and old not in self._replicas:
+                # the deposed leader usually re-joins as a follower: keep
+                # it in the read rotation rather than forgetting it
+                self._replicas.append(old)
+
+    def _write_call(self, method: str, path: str,
+                    body: Optional[dict] = None) -> dict:
+        """A write against the leader, following leader redirects (we
+        dialed a follower, or leadership moved since our last write).
+
+        A redirect can be STALE during a failover window: the follower
+        still advertises the dead leader until the promoted one's first
+        append reaches it. An unreachable redirect target therefore falls
+        back to the origin and re-asks after a short wait — the follower
+        learns the new leader from the promotion's append stream and the
+        next redirect lands.
+
+        Honesty on replays: once a post-redirect attempt failed with a
+        transport error, the request MAY have landed. A later attempt
+        answering 409 could then be our own replay's conflict — that
+        surfaces as a RemoteError (outcome unknown, the pre-redirect
+        contract), never as a definite-looking ConflictError."""
+        origin = self.base_url
+        ambiguous: Optional[RemoteError] = None
+        for attempt in range(5):
+            try:
+                return self._call(method, path, body)
+            except LeaderRedirect as e:
+                self._repoint(e.leader_url)
+            except ConflictError:
+                if ambiguous is not None:
+                    raise RemoteError(
+                        f"write outcome unknown: a retry after "
+                        f"'{ambiguous}' answered 409, which may be our "
+                        f"own landed request's replay") from ambiguous
+                raise
+            except RemoteError as e:
+                if self.base_url == origin:
+                    raise  # not a redirect problem: surface as before
+                ambiguous = e
+                self._set_base(origin)
+                time.sleep(0.2 * (attempt + 1))
+        raise ambiguous or RemoteError("write: leader redirects exhausted")
+
+    def replication_status(self) -> dict:
+        """GET /replication/status on the write base — role, applied rv,
+        and (on a leader) per-follower lag."""
+        return self._call("GET", "/replication/status")
 
     @staticmethod
     def _okey(kind: str, name: str = "", namespace: str = "") -> str:
@@ -160,15 +283,15 @@ class RemoteStore:
     # -- Store surface ----------------------------------------------------
 
     def create(self, obj: Any) -> Any:
-        return codec.decode(self._call("POST", "/objects", {"obj": codec.encode(obj)})["obj"])
+        return codec.decode(self._write_call("POST", "/objects", {"obj": codec.encode(obj)})["obj"])
 
     def update(self, obj: Any, *, check_rv: bool = False) -> Any:
-        return codec.decode(self._call(
+        return codec.decode(self._write_call(
             "PUT", "/objects", {"obj": codec.encode(obj), "check_rv": check_rv}
         )["obj"])
 
     def apply(self, obj: Any) -> Any:
-        return codec.decode(self._call("POST", "/apply", {"obj": codec.encode(obj)})["obj"])
+        return codec.decode(self._write_call("POST", "/apply", {"obj": codec.encode(obj)})["obj"])
 
     # -- transactional batch writes (POST /objects/batch) ------------------
 
@@ -209,6 +332,8 @@ class RemoteStore:
                     for r in results
                 ]) from None
             if e.code == 409:
+                if payload.get("leader_url"):
+                    raise LeaderRedirect(msg, payload["leader_url"]) from None
                 raise ConflictError(msg) from None
             if e.code == 422:
                 raise AdmissionDeniedRemote(msg) from None
@@ -285,11 +410,17 @@ class RemoteStore:
             payload["skip_missing"] = skip_missing
             payload["skip_stale"] = skip_stale
         attempted = False
+        origin = self.base_url
         for attempt in range(4):
             try:
                 resp = self._call_batch(payload)
                 return [None if o is None else codec.decode(o)
                         for o in resp["objs"]]
+            except LeaderRedirect as e:
+                # we dialed a follower (or the leader moved): re-point and
+                # burn this attempt on the redirect, not on a backoff
+                self._repoint(e.leader_url)
+                continue
             except _NoBatchRoute:
                 return self._batch_fallback(op, objs, check_rv, skip_missing)
             except BatchError as e:
@@ -320,8 +451,13 @@ class RemoteStore:
             except RemoteError:
                 # transport failure: the request may or may not have landed.
                 # apply/update replays are idempotent; create replays are
-                # made idempotent by the conflict handling above.
+                # made idempotent by the conflict handling above. If a
+                # REDIRECT pointed us at a dead ex-leader (failover
+                # window), return to the origin — it learns the new
+                # leader from the promotion's append stream.
                 attempted = True
+                if self.base_url != origin:
+                    self._set_base(origin)
                 if attempt == 3:
                     raise
                 time.sleep(0.1 * (attempt + 1))
@@ -346,37 +482,62 @@ class RemoteStore:
                     out.append(None)
         return out
 
-    def get(self, kind: str, name: str, namespace: str = "") -> Any:
-        return codec.decode(self._call("GET", self._okey(kind, name, namespace))["obj"])
+    def get(self, kind: str, name: str, namespace: str = "", *,
+            min_rv: int = 0) -> Any:
+        """Point read, routed by read preference. `min_rv` is the
+        read-your-writes barrier: the serving plane (typically a
+        follower) blocks until it has applied at least that
+        resourceVersion before answering — pass the rv a prior write
+        returned to read your own write through a lagging replica."""
+        path = self._okey(kind, name, namespace)
+        if min_rv > 0:
+            path += f"&min_rv={min_rv}"
+        return codec.decode(self._read_call(path)["obj"])
 
-    def try_get(self, kind: str, name: str, namespace: str = "") -> Optional[Any]:
+    def try_get(self, kind: str, name: str, namespace: str = "", *,
+                min_rv: int = 0) -> Optional[Any]:
         try:
-            return self.get(kind, name, namespace)
+            return self.get(kind, name, namespace, min_rv=min_rv)
         except NotFoundError:
             return None
 
     def list(self, kind: str, namespace: str = "", *,
-             page_size: Optional[int] = None) -> list[Any]:
+             page_size: Optional[int] = None, min_rv: int = 0) -> list[Any]:
         """Auto-paginating list: pages of `page_size` ride limit=/continue=
         tokens pinned server-side to ONE snapshot revision, so the result
         is revision-consistent however many round-trips it took. A server
         without pagination support ignores the limit and answers in full
         (no continue token ends the loop); an expired token (410) restarts
-        the crawl from scratch."""
+        the crawl from scratch.
+
+        Routed by read preference (each crawl is sticky to one plane —
+        continue tokens pin a snapshot there); `min_rv` waits out
+        replication lag before the first page."""
         size = self.page_size if page_size is None else page_size
         base = self._okey(kind, namespace=namespace)
+        if min_rv > 0:
+            base += f"&min_rv={min_rv}"
         if size <= 0:
-            out = self._call("GET", base)
+            out = self._read_call(base)
             return [codec.decode(o) for o in out["items"]]
         for _ in range(3):  # expired-token restarts
             items: list[Any] = []
             token = ""
+            crawl_base = self._read_base()
             try:
                 while True:
                     path = base + f"&limit={size}"
                     if token:
                         path += f"&continue={quote(token, safe='')}"
-                    out = self._call("GET", path)
+                    try:
+                        out = self._call("GET", path, base=crawl_base)
+                    except RemoteError:
+                        if crawl_base == self.base_url:
+                            raise
+                        # replica died mid-crawl: restart on the leader
+                        crawl_base = self.base_url
+                        items, token = [], ""
+                        continue
                     items.extend(codec.decode(o) for o in out["items"])
                     token = out.get("continue") or ""
                     if not token:
@@ -389,7 +550,7 @@ class RemoteStore:
         )
 
     def delete(self, kind: str, name: str, namespace: str = "") -> None:
-        self._call("DELETE", self._okey(kind, name, namespace))
+        self._write_call("DELETE", self._okey(kind, name, namespace))
 
     def kinds(self) -> list[str]:
         return self._call("GET", "/kinds")["kinds"]
@@ -403,7 +564,10 @@ class RemoteStore:
             body["duration"] = duration
         if namespace:
             body["namespace"] = namespace
-        out = self._call("POST", "/leases/acquire", body)
+        # lease CAS is a store write: a replication follower 409-redirects
+        # it to the leader (an election must never mint follower-local
+        # rvs), and _write_call follows — electors work against any plane
+        out = self._write_call("POST", "/leases/acquire", body)
         return codec.decode(out["lease"]), bool(out["acquired"])
 
     def renew_lease(self, name: str, identity: str, token: int,
@@ -411,14 +575,15 @@ class RemoteStore:
         body = {"name": name, "identity": identity, "token": token}
         if namespace:
             body["namespace"] = namespace
-        return codec.decode(self._call("POST", "/leases/renew", body)["lease"])
+        return codec.decode(
+            self._write_call("POST", "/leases/renew", body)["lease"])
 
     def release_lease(self, name: str, identity: str, token: int,
                       namespace: str = "") -> None:
         body = {"name": name, "identity": identity, "token": token}
         if namespace:
             body["namespace"] = namespace
-        self._call("POST", "/leases/release", body)
+        self._write_call("POST", "/leases/release", body)
 
     def elections(self) -> list[Any]:
         return [codec.decode(x)
@@ -451,7 +616,6 @@ class RemoteStore:
                       namespace: str = "", handler_key: Any = None) -> None:
         import http.client
 
-        url = urlparse(self.base_url)
         stop = threading.Event()
         self._streams.append((kind, handler_key, stop))
 
@@ -471,11 +635,17 @@ class RemoteStore:
             request itself failed before a response arrived)."""
             from .. import faults
 
+            # replicated topology: each attach re-picks a read base, so a
+            # stream re-attaching after a replica died rotates to the next
+            # one (rvs are identical across replicas — the since= cursor
+            # stays valid wherever the stream lands)
+            url = urlparse(self._read_base())
             try:
                 # watch re-attach rides the same HTTP fault site as _call;
                 # an injected fault presents as the transport failure the
                 # retry loop already classifies
-                faults.check(faults.BOUNDARY_HTTP, self._fault_target)
+                faults.check(faults.BOUNDARY_HTTP,
+                             url.netloc or self._fault_target)
             except faults.InjectedFault as e:
                 raise OSError(str(e)) from None
             path = (f"/watch?kind={quote(kind, safe='')}"
@@ -704,11 +874,20 @@ class RemoteControlPlane:
 
     def __init__(self, url: str, timeout: float = 30.0,
                  token: Optional[str] = None, cafile: Optional[str] = None,
-                 page_size: int = DEFAULT_PAGE_SIZE):
+                 page_size: int = DEFAULT_PAGE_SIZE,
+                 replicas: Optional[Iterable[str]] = None,
+                 read_preference: str = "leader"):
         self.url = url.rstrip("/")
         self.store = RemoteStore(self.url, timeout=timeout, token=token,
-                                 cafile=cafile, page_size=page_size)
+                                 cafile=cafile, page_size=page_size,
+                                 replicas=replicas,
+                                 read_preference=read_preference)
         self.members = _RemoteMembers(self.store)
+
+    def replication_status(self) -> dict:
+        """GET /replication/status — the `karmadactl replication status`
+        backing call (role, applied rv, per-follower lag on a leader)."""
+        return self.store.replication_status()
 
     def settle(self, max_steps: int = 0) -> int:
         self.store._call("POST", "/settle")

@@ -84,42 +84,120 @@ class StorePersistence:
         """Replay snapshot + rotated WAL + WAL into the store. Call after
         the consuming controllers subscribed (they receive the state as
         ADDED events, like an informer's initial list) and before
-        attach()."""
+        attach().
+
+        Torn-tail hardening: a truncated/corrupt FINAL record (crash or
+        SIGKILL mid-append — routine once replication replays partial
+        logs) is logged loudly and the live WAL is TRUNCATED back to the
+        last whole record, so the next attach() appends at a clean record
+        boundary instead of gluing new records onto a torn line. A
+        corrupt record in the MIDDLE of a file (bit rot, interrupted
+        rotation merge) is logged and skipped — it must not silently drop
+        every record after it, as the old break-on-first-error did."""
         latest: dict[tuple, tuple[int, Any]] = {}  # key -> (rv, obj|None)
         for name in (SNAPSHOT_FILE, WAL_ROTATED, WAL_FILE):
             path = self._path(name)
             if not os.path.exists(path):
                 continue
-            with open(path) as f:
-                for line in f:
-                    line = line.strip()
-                    if not line:
-                        continue
-                    try:
-                        rec = json.loads(line)
-                    except json.JSONDecodeError:
-                        break  # torn tail write (crash mid-append)
-                    try:
-                        obj = codec.decode(rec["obj"])
-                    except Exception as e:  # noqa: BLE001 - one bad record
-                        # must not drop the rest of the file (a decode
-                        # failure is schema drift/corruption, not a tail)
-                        import logging
-
-                        logging.getLogger(__name__).warning(
-                            "skipping undecodable %s record in %s: %s",
-                            rec.get("kind"), path, e,
-                        )
-                        continue
-                    key = (rec["kind"], obj.metadata.namespace,
-                           obj.metadata.name)
-                    rv = obj.metadata.resource_version
-                    if key in latest and rv < latest[key][0]:
-                        continue  # older than what another file delivered
-                    latest[key] = (rv, None if rec["event"] == DELETED else obj)
+            # only the live WAL is repaired in place: it is the one file
+            # attach() will append to (the snapshot/rotated files are
+            # read-only history, rewritten wholesale by snapshot())
+            self._replay_file(path, latest, repair=(name == WAL_FILE))
         return self.store.restore(
             obj for _, obj in latest.values() if obj is not None
         )
+
+    def _replay_file(self, path: str, latest: dict, repair: bool) -> None:
+        """Streamed replay with byte-offset bookkeeping: one line in
+        memory at a time (a WAL can be hundreds of MB between snapshots),
+        a one-line lookahead distinguishing the FINAL record (torn-tail
+        candidate) from a corrupt mid-file one."""
+        import logging
+
+        log = logging.getLogger(__name__)
+        pos = 0
+        good_end = 0  # byte offset just past the last whole record
+        # a final record that parses but lost its trailing newline (the
+        # crash tore exactly the separator): keep it, but repair must
+        # restore the newline or the next append glues onto it
+        needs_newline = False
+        f = open(path, "rb")
+        try:
+            raw = f.readline()
+            while raw:
+                nxt = f.readline()
+                is_last = not nxt
+                next_pos = pos + len(raw)
+                line = raw.strip()
+                if not line:
+                    pos = good_end = next_pos
+                    raw = nxt
+                    continue
+                try:
+                    rec = json.loads(line.decode())
+                    if not isinstance(rec, dict):
+                        # `123` or `"x"` is valid JSON but not a record —
+                        # treat exactly like an unparseable line
+                        raise ValueError("non-object WAL record")
+                except (UnicodeDecodeError, json.JSONDecodeError,
+                        ValueError):
+                    if is_last:
+                        # torn tail: the crash interrupted the final
+                        # append. The record was never group-commit-acked
+                        # as a whole line, so dropping it loses nothing
+                        # durably promised.
+                        log.warning(
+                            "WAL %s: torn final record (%d trailing "
+                            "bytes); truncating to the last whole record "
+                            "at offset %d",
+                            path, next_pos - good_end, good_end,
+                        )
+                        if repair:
+                            f.close()
+                            with open(path, "rb+") as rf:
+                                rf.truncate(good_end)
+                        return
+                    log.warning(
+                        "WAL %s: corrupt mid-file record at offset %d "
+                        "(%d bytes); skipping it and continuing the "
+                        "replay", path, pos, len(line),
+                    )
+                    pos = next_pos
+                    raw = nxt
+                    continue
+                pos = good_end = next_pos
+                needs_newline = not raw.endswith(b"\n")
+                raw = nxt
+                self._apply_record(rec, latest, path, log)
+        finally:
+            if not f.closed:
+                f.close()
+        if repair and needs_newline:
+            log.warning(
+                "WAL %s: final record lost its newline separator; "
+                "restoring it so the next append starts a fresh line",
+                path,
+            )
+            with open(path, "ab") as af:
+                af.write(b"\n")
+
+    @staticmethod
+    def _apply_record(rec: dict, latest: dict, path: str, log) -> None:
+        try:
+            obj = codec.decode(rec["obj"])
+        except Exception as e:  # noqa: BLE001 - one bad record
+            # must not drop the rest of the file (a decode failure is
+            # schema drift/corruption, not a tail)
+            log.warning(
+                "skipping undecodable %s record in %s: %s",
+                rec.get("kind"), path, e,
+            )
+            return
+        key = (rec["kind"], obj.metadata.namespace, obj.metadata.name)
+        rv = obj.metadata.resource_version
+        if key in latest and rv < latest[key][0]:
+            return  # older than what another file delivered
+        latest[key] = (rv, None if rec["event"] == DELETED else obj)
 
     # -- capture ----------------------------------------------------------
 

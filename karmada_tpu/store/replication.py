@@ -1,0 +1,828 @@
+"""Replicated control-plane store: fenced log shipping, quorum writes,
+and follower reads.
+
+The reference control plane gets durability AND read capacity from etcd's
+replicated Raft log (PAPER.md L1); until now our whole store lived in one
+flock'd `--data-dir` WAL and docs/HA.md hot-standby was availability, not
+capacity. This module turns the single-node store into a leader/follower
+group built entirely on primitives earlier rounds shipped:
+
+- **The log entry already exists.** Transactional batches (PR-9) commit
+  with contiguous resourceVersions through ONE `Store.watch_all_batch`
+  delivery — the same unit the WAL group-commits with one fsync. The
+  leader's `ReplicationManager` subscribes to that seam and every
+  delivery becomes one rv-contiguous log entry, shipped over the existing
+  HTTP plane (`POST /replication/append`).
+
+- **Followers are rv-exact.** `Store.apply_replicated` commits an entry
+  under one lock hold, preserving the leader's rvs and ORIGINAL event
+  types through the under-lock event sink — so a follower's revisioned
+  watch cache (PR-8) and snapshot-pinned paginated lists are byte-exact
+  with the leader's at every applied rv. Follower reads (`GET /objects`,
+  `GET /watch?since=`) carry the same consistency contract the leader
+  serves, and a `min_rv=` read barrier waits out replication lag for
+  read-your-writes callers.
+
+- **Fencing, not consensus.** Appends are fenced by the `coordination/`
+  lease token, exactly like stale client writes: every acquisition mints
+  a strictly larger token, followers track the highest token they have
+  accepted, and a deposed leader's stale appends bounce with 409. The
+  lease itself is a store object and REPLICATES, so the token counter's
+  monotonicity survives failover: a promoted follower's local acquire
+  mints old_token+1 against its replicated copy.
+
+- **Quorum rides the batch.** In `--replication=quorum` mode a write (or
+  whole transactional batch) returns once `quorum` followers have
+  applied AND fsync'd its entry — the ack piggybacks on the group-commit
+  unit, so quorum costs one round-trip per BATCH, not per object. The
+  async mode ships the same entries in the background with a bounded-lag
+  backpressure gate.
+
+- **Failover is seal-and-promote.** Because every follower's state is a
+  contiguous PREFIX of the leader's log, the follower with the highest
+  applied rv contains every entry ANY follower acked — promoting it
+  (`seal_and_promote`) loses zero quorum-acked writes for any quorum
+  >= 1. The promoted follower seals its log at its applied rv, acquires
+  the lease locally (fresh fencing token), and ships to the remaining
+  peers; lagging peers catch up through the same append stream, falling
+  back to `POST /replication/snapshot` + rv offset when their next entry
+  has been compacted out of the in-memory log ring.
+"""
+from __future__ import annotations
+
+import bisect
+import json
+import logging
+import threading
+import time
+from typing import Any, Iterable, Optional
+from urllib.error import HTTPError
+from urllib.parse import urlparse
+from urllib.request import Request, urlopen
+
+from ..metrics import (
+    replica_lag,
+    replication_appends,
+    replication_quorum_latency,
+)
+from ..server import codec
+from .store import ConflictError, ReplicationGapError, Store
+
+log = logging.getLogger(__name__)
+
+# the store-replication election: one lease fences the whole append stream
+REPLICATION_LEASE = "karmada-store"
+
+# in-memory log ring (catch-up window): entries older than this fall back
+# to the snapshot path, like a watch client lagging past ring compaction
+DEFAULT_LOG_ENTRIES = 4096
+# quorum mode: how long a write waits for its acks before failing loudly
+DEFAULT_ACK_TIMEOUT = 15.0
+# async mode backpressure: writers stall briefly once the BEST follower is
+# this many rvs behind (bounded lag, not unbounded divergence)
+DEFAULT_MAX_ASYNC_LAG = 16384
+# entries shipped per append round-trip (group shipping: a backlog drains
+# in few requests, mirroring WAL group commit)
+APPEND_MAX_ENTRIES = 64
+
+
+class ReplicationError(RuntimeError):
+    """Replication-plane failure (transport, protocol, or deposition)."""
+
+
+class QuorumTimeoutError(ReplicationError):
+    """The write committed (and fsync'd) locally but its quorum of
+    follower acks did not arrive in time — durable here, NOT
+    quorum-acked; the caller must treat it as failed."""
+
+
+class StaleAppendError(ConflictError):
+    """An append/snapshot carried a fencing token older than one this
+    follower has already accepted — the sender was deposed (HTTP 409)."""
+
+
+class LogEntry:
+    """One rv-contiguous run of committed events — exactly one
+    `watch_all_batch` delivery, wire-encoded once at append."""
+
+    __slots__ = ("start_rv", "end_rv", "records")
+
+    def __init__(self, records: list[dict]):
+        self.records = records
+        self.start_rv = records[0]["rv"]
+        self.end_rv = records[-1]["rv"]
+
+    def to_wire(self) -> dict:
+        return {"start_rv": self.start_rv, "end_rv": self.end_rv,
+                "records": self.records}
+
+
+def encode_events(events: list[tuple[str, str, Any]]) -> list[dict]:
+    return [
+        {"kind": kind, "event": event,
+         "rv": obj.metadata.resource_version, "obj": codec.encode(obj)}
+        for kind, event, obj in events
+    ]
+
+
+def decode_records(records: list[dict]) -> list[tuple[str, str, Any]]:
+    out = []
+    for rec in records:
+        obj = codec.decode(rec["obj"])
+        out.append((rec["kind"], rec["event"], obj))
+    return out
+
+
+class ReplicaClient:
+    """Leader-side HTTP transport to one follower's replication routes.
+    Rides the same fault-plan boundary as every other HTTP client
+    (faults.BOUNDARY_HTTP), so seeded chaos plans exercise the shipping
+    retry/backoff path like any transport blip."""
+
+    def __init__(self, url: str, timeout: float = 30.0,
+                 token: Optional[str] = None, cafile: Optional[str] = None):
+        self.url = url.rstrip("/")
+        self.timeout = timeout
+        self.token = token
+        self._ssl_ctx = None
+        if self.url.startswith("https"):
+            import ssl
+
+            self._ssl_ctx = ssl.create_default_context(cafile=cafile)
+        self._fault_target = urlparse(self.url).netloc or "replica"
+
+    def _call(self, path: str, body: dict) -> dict:
+        from .. import faults
+
+        try:
+            faults.check(faults.BOUNDARY_HTTP, self._fault_target)
+        except faults.InjectedFault as e:
+            raise ReplicationError(f"replica unreachable: {e}") from None
+        headers = {"Content-Type": "application/json"}
+        if self.token:
+            headers["Authorization"] = f"Bearer {self.token}"
+        req = Request(self.url + path, data=json.dumps(body).encode(),
+                      method="POST", headers=headers)
+        try:
+            with urlopen(req, timeout=self.timeout,
+                         context=self._ssl_ctx) as resp:
+                return json.loads(resp.read().decode() or "{}")
+        except HTTPError as e:
+            try:
+                payload = json.loads(e.read().decode())
+            except Exception:  # noqa: BLE001
+                payload = {}
+            msg = payload.get("error", str(e))
+            if e.code == 409:
+                if payload.get("stale_token"):
+                    raise StaleAppendError(msg) from None
+                if "expected_rv" in payload:
+                    raise ReplicationGapError(
+                        msg, int(payload["expected_rv"])) from None
+                raise ConflictError(msg) from None
+            raise ReplicationError(f"HTTP {e.code}: {msg}") from None
+        except OSError as e:
+            raise ReplicationError(f"replica unreachable: {e}") from None
+
+    def append(self, body: dict) -> dict:
+        return self._call("/replication/append", body)
+
+    def snapshot(self, body: dict) -> dict:
+        return self._call("/replication/snapshot", body)
+
+
+class _Peer:
+    __slots__ = ("url", "client", "acked_rv", "thread", "last_error",
+                 "snapshots", "appends", "diverged")
+
+    def __init__(self, url: str, client: ReplicaClient):
+        self.url = url
+        self.client = client
+        self.acked_rv = -1  # unknown: first contact probes with an append
+        self.thread: Optional[threading.Thread] = None
+        self.last_error = ""
+        self.snapshots = 0
+        self.appends = 0
+        # the follower's store moved AHEAD of this leader's log (it
+        # minted local rvs — a fork). Appending on top would silently
+        # corrupt it, so the peer is quarantined until an operator
+        # resets it (restart as --follower / wipe its data dir).
+        self.diverged = False
+
+
+class ReplicationManager:
+    """Leader role: tail the store's commit stream, ship rv-contiguous
+    log entries to followers, and (in quorum mode) hold each write until
+    enough followers fsync'd its entry.
+
+    Attach AFTER persistence: `Store._dispatch` calls batch watchers in
+    subscription order, so the local WAL fsync completes before the
+    quorum wait begins — a quorum-acked write is durable on leader AND
+    `quorum` followers."""
+
+    def __init__(self, store: Store, peer_urls: Iterable[str], *,
+                 mode: str = "async", quorum: int = 1, token: int = 0,
+                 identity: str = "leader", advertise_url: str = "",
+                 lease_name: str = REPLICATION_LEASE,
+                 ack_timeout: float = DEFAULT_ACK_TIMEOUT,
+                 max_entries: int = DEFAULT_LOG_ENTRIES,
+                 max_async_lag: int = DEFAULT_MAX_ASYNC_LAG,
+                 auth_token: Optional[str] = None,
+                 cafile: Optional[str] = None,
+                 client_timeout: float = 30.0):
+        if mode not in ("async", "quorum"):
+            raise ValueError(f"replication mode {mode!r}: async|quorum")
+        self.store = store
+        self.mode = mode
+        self.quorum = max(int(quorum), 1)
+        self.token = token
+        self.identity = identity
+        self.advertise_url = advertise_url
+        self.lease_name = lease_name
+        self.ack_timeout = ack_timeout
+        self.max_entries = max(int(max_entries), 8)
+        self.max_async_lag = max_async_lag
+        self._cond = threading.Condition()
+        self._entries: list[LogEntry] = []  # sorted by start_rv
+        self._floor = 0   # entries <= floor are not in the ring
+        self._tip = 0     # highest committed rv seen
+        self._stop = threading.Event()
+        self._attached = False
+        self.deposed = False
+        self.deposed_reason = ""
+        self.peers = [
+            _Peer(u, ReplicaClient(u, timeout=client_timeout,
+                                   token=auth_token, cafile=cafile))
+            for u in peer_urls
+        ]
+        if self.mode == "quorum" and self.quorum > len(self.peers):
+            raise ValueError(
+                f"quorum {self.quorum} > {len(self.peers)} followers")
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def attach(self) -> None:
+        if self._attached:
+            return
+        self._attached = True
+        with self._cond:
+            self._floor = self._tip = self.store.current_rv
+        self.store.watch_all_batch(self._on_batch)
+        for p in self.peers:
+            p.thread = threading.Thread(
+                target=self._peer_loop, args=(p,),
+                name=f"repl-{urlparse(p.url).netloc}", daemon=True,
+            )
+            p.thread.start()
+
+    def close(self) -> None:
+        if not self._attached:
+            return
+        self._attached = False
+        self.store.unwatch_all_batch(self._on_batch)
+        self._stop.set()
+        with self._cond:
+            self._cond.notify_all()
+        for p in self.peers:
+            if p.thread is not None:
+                p.thread.join(timeout=5.0)
+            replica_lag.remove(peer=p.url)
+
+    def depose(self, reason: str) -> None:
+        """A newer fencing token exists somewhere: stop shipping, fail
+        any quorum waiters. The daemon's elector observes its own renew
+        Conflict independently; this keeps the two signals consistent."""
+        if self.deposed:
+            return
+        self.deposed = True
+        self.deposed_reason = reason
+        log.warning("replication leader %s deposed: %s",
+                    self.identity, reason)
+        with self._cond:
+            self._cond.notify_all()
+
+    def revive(self, token: int) -> None:
+        """The elector re-won the lease (e.g. a GC pause cost one renewal
+        with no successor taking over): resume shipping with the fresh
+        token. Without this a deposed-then-re-elected leader would fail
+        every write forever — depose() lets the peer threads exit, so
+        revival must restart them. Entries committed while deposed are
+        still in the log (insertion precedes the deposed check), so the
+        resumed shippers drain the backlog; if a real successor DOES
+        exist out there, its higher token re-deposes us on first contact."""
+        with self._cond:
+            self.token = max(self.token, token)
+            was_deposed = self.deposed
+            self.deposed = False
+            self.deposed_reason = ""
+            self._cond.notify_all()
+        if not was_deposed:
+            return
+        if not self._attached:
+            # the manager was CLOSED when a higher claim took over; a
+            # legitimate re-election (that leader died, the lease came
+            # back to us with a fresh token) re-attaches from scratch
+            self._stop = threading.Event()
+            self.attach()
+            return
+        log.warning("replication leader %s revived (token %d)",
+                    self.identity, token)
+        # peer loops PARK while deposed (they never exit on deposition,
+        # so there is no alive-but-exiting race to lose a shipper to);
+        # restarting here is defensive, for a loop killed by something
+        # unexpected
+        for p in self.peers:
+            if (p.thread is None or not p.thread.is_alive()) \
+                    and not p.diverged:
+                p.thread = threading.Thread(
+                    target=self._peer_loop, args=(p,),
+                    name=f"repl-{urlparse(p.url).netloc}", daemon=True,
+                )
+                p.thread.start()
+
+    # -- the commit-stream tail (runs in mutator threads) ------------------
+
+    def _on_batch(self, events: list[tuple[str, str, Any]]) -> None:
+        if not events or self._stop.is_set():
+            return
+        end_rv = events[-1][2].metadata.resource_version
+        if end_rv <= self._floor:
+            return  # pre-attach commit whose dispatch raced attach()
+        entry = LogEntry(encode_events(events))
+        with self._cond:
+            # racing mutators dispatch out of commit order — insert
+            # sorted; peers only ship contiguous prefixes, so a hole
+            # (a batch still in flight between commit and dispatch)
+            # parks the shippers until its entry arrives
+            bisect.insort(self._entries, entry, key=lambda e: e.start_rv)
+            self._tip = max(self._tip, entry.end_rv)
+            if len(self._entries) > self.max_entries:
+                drop = len(self._entries) - self.max_entries
+                self._floor = self._entries[drop - 1].end_rv
+                del self._entries[:drop]
+            self._cond.notify_all()
+        if self.deposed:
+            raise ReplicationError(
+                f"replication leader deposed ({self.deposed_reason}); "
+                f"write at rv {end_rv} is fenced out")
+        if self.mode == "quorum":
+            self._await_quorum(entry.end_rv)
+        elif self.max_async_lag:
+            self._bound_async_lag(entry.end_rv)
+
+    def _acks_through(self, rv: int) -> int:
+        return sum(1 for p in self.peers if p.acked_rv >= rv)
+
+    def _await_quorum(self, rv: int) -> None:
+        t0 = time.perf_counter()
+        deadline = t0 + self.ack_timeout
+        with self._cond:
+            while self._acks_through(rv) < self.quorum:
+                if self.deposed:
+                    raise ReplicationError(
+                        f"replication leader deposed "
+                        f"({self.deposed_reason}) awaiting quorum for rv "
+                        f"{rv}")
+                remaining = deadline - time.perf_counter()
+                if remaining <= 0:
+                    raise QuorumTimeoutError(
+                        f"rv {rv}: {self._acks_through(rv)}/{self.quorum} "
+                        f"follower acks after {self.ack_timeout}s (write "
+                        f"is durable locally but NOT quorum-acked)")
+                self._cond.wait(min(remaining, 0.25))
+        replication_quorum_latency.observe(time.perf_counter() - t0)
+
+    def _bound_async_lag(self, rv: int) -> None:
+        """Backpressure, not durability: stall the writer briefly while
+        even the most caught-up HEALTHY follower is > max_async_lag rvs
+        behind. Peers in a failure state (unreachable, never probed,
+        diverged) are exempt — a single dead follower must not tax every
+        async write with the full wait (availability is the async mode's
+        whole point); it catches up through the snapshot path when it
+        returns."""
+        deadline = time.perf_counter() + 1.0
+        with self._cond:
+            while True:
+                healthy = [
+                    p.acked_rv for p in self.peers
+                    if p.acked_rv >= 0 and not p.last_error
+                    and not p.diverged
+                ]
+                if not healthy:
+                    return  # nobody shippable to wait for
+                if rv - max(healthy) <= self.max_async_lag:
+                    return
+                remaining = deadline - time.perf_counter()
+                if remaining <= 0 or self.deposed:
+                    return
+                self._cond.wait(min(remaining, 0.1))
+
+    # -- per-peer shipping loops -------------------------------------------
+
+    def _next_for(self, peer: _Peer) -> Optional[list[LogEntry]]:
+        """Caller holds self._cond. Returns the next contiguous batch of
+        entries for this peer, [] when it is caught up / waiting on a
+        dispatch hole, or None when the peer needs a snapshot (its next
+        entry fell off the ring, or it has never synced)."""
+        want = peer.acked_rv + 1
+        if peer.acked_rv >= self._tip:
+            return []  # caught up (acked_rv < 0 never reaches here: the
+            # peer loop PROBES an unknown peer before calling this)
+        if want <= self._floor:
+            return None  # lagged past ring compaction: snapshot
+        idx = bisect.bisect_left(self._entries, want,
+                                 key=lambda e: e.start_rv)
+        batch: list[LogEntry] = []
+        expect = want
+        for e in self._entries[idx:]:
+            if e.start_rv != expect:
+                break  # hole: a racing dispatch hasn't landed yet
+            batch.append(e)
+            expect = e.end_rv + 1
+            if len(batch) >= APPEND_MAX_ENTRIES:
+                break
+        return batch
+
+    # a peer waiting on a log HOLE (an entry committed but whose dispatch
+    # never reached the log — e.g. the persistence batch-watcher raised
+    # before replication's ran) must not park forever: after this long
+    # with work visibly pending, fall back to a snapshot, which carries
+    # the committed state whether or not its entry ever landed
+    HOLE_TIMEOUT_S = 2.0
+
+    def _peer_loop(self, peer: _Peer) -> None:
+        from ..faults.policy import Backoff
+
+        bo = Backoff(base=0.2, cap=5.0)
+        stalled_since: Optional[float] = None
+        while not self._stop.is_set():
+            if peer.diverged:
+                return  # quarantined: nothing safe to ship
+            if self.deposed:
+                # PARK, don't exit: revive() clearing the flag resumes
+                # shipping with no thread restart — an exiting thread
+                # could otherwise read as alive during revive's check and
+                # leave its peer without a shipping loop forever
+                with self._cond:
+                    self._cond.wait(0.5)
+                continue
+            if peer.acked_rv < 0:
+                # first contact: PROBE with an empty (still token-fenced)
+                # append instead of assuming a snapshot — an already-
+                # in-sync follower (leader restart, promotion) answers
+                # with its applied rv and costs nothing; snapshots are
+                # reserved for peers genuinely past the ring
+                try:
+                    self._probe(peer)
+                    bo.reset()
+                except StaleAppendError as e:
+                    replication_appends.inc(outcome="stale_token")
+                    self.depose(str(e))
+                except ReplicationGapError as e:
+                    # a follower demanding a re-sync (forked-then-demoted
+                    # promotion) answers even the empty probe with a gap
+                    replication_appends.inc(outcome="gap")
+                    with self._cond:
+                        peer.acked_rv = max(e.expected_rv - 1, 0)
+                except Exception as e:  # noqa: BLE001 - transport/5xx
+                    replication_appends.inc(outcome="transport")
+                    peer.last_error = f"{type(e).__name__}: {e}"
+                    self._stop.wait(bo.next())
+                continue
+            with self._cond:
+                batch = self._next_for(peer)
+                if batch == []:
+                    lag = max(self._tip - max(peer.acked_rv, 0), 0)
+                    replica_lag.set(lag, peer=peer.url)
+                    if lag > 0:
+                        # entries exist past acked but the CONTIGUOUS next
+                        # one is missing — normally a sub-millisecond
+                        # commit->dispatch race, but a dropped dispatch
+                        # would park us forever; bound the wait
+                        now = time.monotonic()
+                        if stalled_since is None:
+                            stalled_since = now
+                        elif now - stalled_since > self.HOLE_TIMEOUT_S:
+                            stalled_since = None
+                            batch = None  # snapshot past the hole
+                    else:
+                        stalled_since = None
+                    if batch == []:
+                        self._cond.wait(0.5)
+                        continue
+                else:
+                    stalled_since = None
+            try:
+                if batch is None:
+                    self._send_snapshot(peer)
+                else:
+                    self._send_entries(peer, batch)
+                bo.reset()
+            except StaleAppendError as e:
+                replication_appends.inc(outcome="stale_token")
+                self.depose(str(e))
+                continue  # park (above) until revived or closed
+            except ReplicationGapError as e:
+                replication_appends.inc(outcome="gap")
+                with self._cond:
+                    if e.expected_rv > self._tip + 1:
+                        # the follower is AHEAD of everything we ever
+                        # committed: it minted local rvs (forked store).
+                        # Shipping entries on top would silently corrupt
+                        # it — quarantine loudly instead.
+                        peer.diverged = True
+                        peer.last_error = (
+                            f"diverged: follower expects rv "
+                            f"{e.expected_rv}, leader tip {self._tip} — "
+                            f"quarantined (reset the follower)")
+                        log.error("replication peer %s %s",
+                                  peer.url, peer.last_error)
+                        replication_appends.inc(outcome="diverged")
+                        return
+                    # rewind to what the follower actually has; if that
+                    # fell off the ring the next iteration snapshots
+                    peer.acked_rv = e.expected_rv - 1
+            except Exception as e:  # noqa: BLE001 - transport/5xx
+                replication_appends.inc(outcome="transport")
+                peer.last_error = f"{type(e).__name__}: {e}"
+                self._stop.wait(bo.next())
+
+    def _base_body(self) -> dict:
+        return {"token": self.token, "leader": self.identity,
+                "leader_url": self.advertise_url,
+                "lease": self.lease_name}
+
+    def _probe(self, peer: _Peer) -> None:
+        """Empty append: learns the follower's applied rv (and asserts
+        the token fence) without shipping state. A follower AHEAD of
+        everything this leader ever committed forked (it minted local
+        rvs) — quarantine it exactly like the gap path would."""
+        body = self._base_body()
+        body["entries"] = []
+        applied = int(peer.client.append(body).get("applied_rv", 0))
+        with self._cond:
+            if applied > self._tip:
+                peer.diverged = True
+                peer.last_error = (
+                    f"diverged: follower at rv {applied}, leader tip "
+                    f"{self._tip} — quarantined (reset the follower)")
+                log.error("replication peer %s %s",
+                          peer.url, peer.last_error)
+                replication_appends.inc(outcome="diverged")
+                return
+            peer.acked_rv = max(peer.acked_rv, applied)
+            replica_lag.set(max(self._tip - peer.acked_rv, 0), peer=peer.url)
+            self._cond.notify_all()
+
+    def _send_entries(self, peer: _Peer, batch: list[LogEntry]) -> None:
+        body = self._base_body()
+        body["entries"] = [e.to_wire() for e in batch]
+        resp = peer.client.append(body)
+        applied = int(resp.get("applied_rv", batch[-1].end_rv))
+        peer.appends += 1
+        peer.last_error = ""
+        replication_appends.inc(outcome="ok")
+        with self._cond:
+            peer.acked_rv = max(peer.acked_rv, applied)
+            replica_lag.set(max(self._tip - peer.acked_rv, 0), peer=peer.url)
+            self._cond.notify_all()
+
+    def _send_snapshot(self, peer: _Peer) -> None:
+        rv, items = self.store.snapshot_state()
+        body = self._base_body()
+        body["rv"] = rv
+        body["objs"] = [codec.encode(o) for _, o in items]
+        peer.client.snapshot(body)
+        peer.snapshots += 1
+        peer.last_error = ""
+        replication_appends.inc(outcome="snapshot")
+        with self._cond:
+            peer.acked_rv = max(peer.acked_rv, rv)
+            replica_lag.set(max(self._tip - peer.acked_rv, 0), peer=peer.url)
+            self._cond.notify_all()
+
+    # -- status ------------------------------------------------------------
+
+    def acked_quorum_rv(self) -> int:
+        """Highest rv with >= quorum follower acks (the seal point a
+        promoted follower is guaranteed to reach or exceed). The cond's
+        default lock is an RLock, so status() may call this under it."""
+        with self._cond:
+            acked = sorted((p.acked_rv for p in self.peers), reverse=True)
+            if len(acked) < self.quorum:
+                return 0
+            return max(acked[self.quorum - 1], 0)
+
+    def status(self) -> dict:
+        with self._cond:
+            return {
+                "role": "leader" if not self.deposed else "deposed",
+                "mode": self.mode,
+                "quorum": self.quorum,
+                "token": self.token,
+                "identity": self.identity,
+                "applied_rv": self._tip,
+                "quorum_acked_rv": self.acked_quorum_rv(),
+                "peers": [
+                    {"url": p.url, "acked_rv": max(p.acked_rv, 0),
+                     "lag_rvs": max(self._tip - max(p.acked_rv, 0), 0),
+                     "snapshots": p.snapshots, "appends": p.appends,
+                     "diverged": p.diverged,
+                     "last_error": p.last_error}
+                    for p in self.peers
+                ],
+            }
+
+
+class FollowerState:
+    """Follower role bookkeeping on a serving plane: the highest fencing
+    token accepted (monotonic — the append fence), who the leader is (the
+    redirect target for rejected writes), and the seal switch promotion
+    flips."""
+
+    def __init__(self, store: Store):
+        self.store = store
+        self.max_token = 0
+        self.leader_id = ""
+        self.leader_url = ""
+        self.sealed = False
+        self.sealed_rv = 0
+        # a demoted promotion minted a local lease rv the new leader's
+        # log does not contain: entries must not glue onto the fork —
+        # answer gaps until a snapshot re-syncs the whole state
+        self.force_snapshot = False
+        self.last_append_at = 0.0
+        self._lock = threading.Lock()
+
+    @property
+    def active(self) -> bool:
+        return not self.sealed and self.max_token > 0
+
+    def _fence(self, token: int, leader: str, leader_url: str) -> None:
+        """Caller holds self._lock. Claim fencing — the (token, leader)
+        pair totally ordered, the same rule that 409s a deposed client's
+        stale store writes; the identity tiebreak resolves two
+        equal-token leaders (concurrent promotions against independent
+        lease copies) to exactly one accepted stream."""
+        if self.sealed:
+            raise StaleAppendError(
+                f"follower sealed at rv {self.sealed_rv} (promoted); "
+                f"append from {leader!r} rejected")
+        if (token, leader) < (self.max_token, self.leader_id):
+            raise StaleAppendError(
+                f"stale replication claim ({token}, {leader!r}) "
+                f"(current ({self.max_token}, {self.leader_id!r}))")
+        self.max_token = token
+        self.leader_id = leader
+        self.leader_url = leader_url or self.leader_url
+        self.last_append_at = time.monotonic()
+
+    def apply_entries(self, token: int, leader: str, leader_url: str,
+                      entries: list[dict]) -> int:
+        """Apply one append request. The entries it carries are
+        rv-contiguous end to end, so the whole request commits as ONE
+        `apply_replicated` call — one store lock hold and one WAL
+        group-commit fsync per round-trip, however many leader-side
+        batches the shipper coalesced into it (the follower-side mirror
+        of the leader's group commit; a per-entry fsync would gate the
+        follower's apply rate at the disk instead of the wire)."""
+        with self._lock:
+            self._fence(token, leader, leader_url)
+            if self.force_snapshot:
+                raise ReplicationGapError(
+                    "re-sync required (this plane's demoted promotion "
+                    "forked the log); send a snapshot", 1)
+            records: list = []
+            for wire in entries:
+                records.extend(decode_records(wire.get("records", [])))
+            return self.store.apply_replicated(records)
+
+    def apply_snapshot(self, token: int, leader: str, leader_url: str,
+                       rv: int, objs: list, *, swap=None) -> int:
+        """`swap` wraps the store.load_snapshot call so the server can
+        detach/re-attach its watch cache around the state swap."""
+        with self._lock:
+            self._fence(token, leader, leader_url)
+            objects = [codec.decode(o) for o in objs]
+            if swap is not None:
+                swap(rv, objects)
+            else:
+                self.store.load_snapshot(rv, objects)
+            self.force_snapshot = False  # re-synced: entries resume
+            return self.store.current_rv
+
+    def seal(self) -> int:
+        """Promotion step 1: stop accepting appends (any late append from
+        the dead leader 409s) and pin the rv the new leader serves from.
+        Every applied entry is a contiguous prefix of the old leader's
+        log, so sealing at the applied rv keeps every quorum-acked write
+        this follower ever acknowledged."""
+        with self._lock:
+            self.sealed = True
+            self.sealed_rv = self.store.current_rv
+            return self.sealed_rv
+
+    def unseal(self, resync: bool = False) -> None:
+        """Roll a seal back: promotion failed (lost the election), or a
+        higher-claim leader's appends re-fenced this plane — it returns
+        to ordinary follower service. Without this a sealed-but-not-
+        promoted plane would accept client writes (it no longer reads as
+        a follower) while 409ing the legitimate leader's appends.
+
+        `resync=True` when the plane actually PROMOTED before being
+        outranked: its local lease acquire minted an rv the winner's log
+        does not contain, so subsequent entries must not apply until a
+        snapshot replaces the forked state."""
+        with self._lock:
+            was_sealed = self.sealed
+            self.sealed = False
+            self.sealed_rv = 0
+            if resync and was_sealed:
+                self.force_snapshot = True
+
+    def status(self) -> dict:
+        with self._lock:
+            return {
+                "role": "follower" if self.active else (
+                    "promoted" if self.sealed else "candidate"),
+                "applied_rv": self.store.current_rv,
+                "token": self.max_token,
+                "leader": self.leader_id,
+                "leader_url": self.leader_url,
+                "sealed_rv": self.sealed_rv if self.sealed else None,
+            }
+
+
+def seal_and_promote(server, peer_urls: Iterable[str], *, identity: str,
+                     coordinator=None, lease_name: str = REPLICATION_LEASE,
+                     lease_duration: float = 10.0, mode: str = "async",
+                     quorum: int = 1, auth_token: Optional[str] = None,
+                     cafile: Optional[str] = None,
+                     **manager_kwargs) -> ReplicationManager:
+    """Failover: promote a follower `ControlPlaneServer` to leader.
+
+    1. Seal its follower log at the applied rv (late appends 409).
+    2. Acquire the replication lease against its OWN store — the lease is
+       a replicated object, so the counter continues and the acquisition
+       mints a fencing token strictly above the dead leader's (this local
+       write is also the new leader's first minted rv).
+    3. Start a ReplicationManager shipping to the surviving peers; they
+       re-fence on the higher token and catch up from the append stream
+       (or a snapshot when they lag past the ring).
+
+    Promotion should target the follower with the HIGHEST applied rv
+    (`karmadactl replication status` / GET /replication/status): follower
+    state is a contiguous log prefix, so the max-rv follower contains
+    every entry any quorum ever acked — zero quorum-acked writes lost.
+
+    A FAILED promotion (lost the election — e.g. two operators promoting
+    concurrently) rolls the seal back: the loser returns to follower
+    service and accepts the winner's appends instead of 409ing them
+    while taking client writes.
+    """
+    server.seal_follower()
+    try:
+        token = 0
+        if coordinator is None:
+            coordinator = getattr(server.cp, "coordinator", None)
+        if coordinator is not None:
+            lease, acquired = coordinator.acquire(
+                lease_name, identity, lease_duration)
+            if not acquired:
+                raise ReplicationError(
+                    f"promotion lost the {lease_name} election to "
+                    f"{lease.spec.holder_identity!r}")
+            token = lease.spec.fencing_token
+        mgr = ReplicationManager(
+            server.cp.store, peer_urls, mode=mode, quorum=quorum,
+            token=token, identity=identity, advertise_url=server.url,
+            lease_name=lease_name, auth_token=auth_token, cafile=cafile,
+            **manager_kwargs,
+        )
+        server.promote(mgr)
+        return mgr
+    except BaseException:
+        server.unseal_follower()
+        raise
+
+
+class ReplicaControlPlane:
+    """The minimal cp surface a FOLLOWER plane serves with: store only, no
+    controllers, no members — a follower must mint no local rvs (any local
+    write would fork the leader's contiguous sequence), so it runs
+    read-only until promoted. Promotion hands the store to a real leader
+    role; the coordinator exists so the promotion path can acquire the
+    replicated lease locally."""
+
+    def __init__(self, store: Optional[Store] = None, clock=None):
+        from ..coordination.lease import LeaseCoordinator
+
+        self.store = store if store is not None else Store()
+        self.members: dict = {}
+        self.coordinator = LeaseCoordinator(self.store, clock)
+
+    def settle(self, max_steps: int = 0) -> int:
+        return 0
+
+    def tick(self, seconds: float = 0.0) -> int:
+        return 0

@@ -55,6 +55,17 @@ class NotFoundError(KeyError):
     pass
 
 
+class ReplicationGapError(ConflictError):
+    """A replicated log entry does not start at the follower's next
+    resourceVersion — the shipper must rewind to `expected_rv` (or fall
+    back to a snapshot when the entry is already compacted out of its
+    log)."""
+
+    def __init__(self, message: str, expected_rv: int):
+        super().__init__(message)
+        self.expected_rv = expected_rv
+
+
 @dataclass
 class BatchOpResult:
     """Per-object disposition of a transactional batch write.
@@ -648,6 +659,114 @@ class Store:
         self._dispatch(dispatch)
         return outs
 
+    # -- replication (store/replication.py) --------------------------------
+
+    @property
+    def current_rv(self) -> int:
+        with self._lock:
+            return self._rv
+
+    def apply_replicated(self, records: list[tuple[str, str, Any]]) -> int:
+        """Follower-side commit of one leader log entry: `records` is a
+        list of (kind, event, obj) whose resourceVersions were minted BY
+        THE LEADER and must continue this store's sequence exactly
+        (leader commits are globally contiguous, so a follower applying
+        every entry in order holds the leader's byte-exact state at every
+        applied rv — same watch-cache event stream, same snapshot pages).
+
+        One lock hold for the whole entry, rv continuity validated BEFORE
+        anything is applied (no partial entries), events fed to the
+        under-lock sink with their ORIGINAL type and rv so the follower's
+        revisioned watch cache is indistinguishable from the leader's.
+        The post-lock dispatch reaches persistence as one batch — one WAL
+        group-commit fsync per entry, mirroring the leader — so when this
+        returns the entry is durable and the follower may ack it."""
+        if not records:
+            return self.current_rv
+        with self._write_lock():
+            base = self._rv
+            for i, (kind, event, obj) in enumerate(records):
+                rv = obj.metadata.resource_version
+                if rv != base + 1 + i:
+                    raise ReplicationGapError(
+                        f"replication gap: record {i} of entry carries rv "
+                        f"{rv}, follower expects {base + 1 + i}",
+                        base + 1,
+                    )
+            for kind, event, obj in records:
+                self._rv = obj.metadata.resource_version
+                b = self._bucket(kind)
+                key = self._key(obj.metadata)
+                if event == DELETED:
+                    b.objects.pop(key, None)
+                else:
+                    # decoded fresh off the wire: committed as-is, and the
+                    # immutable-once-placed contract holds (a later entry
+                    # REPLACES it, never mutates)
+                    b.objects[key] = obj
+                self._sink(kind, event, obj)
+            tip = self._rv
+        self._dispatch([
+            (kind, event, copy.deepcopy(obj)) for kind, event, obj in records
+        ])
+        return tip
+
+    def snapshot_state(self) -> tuple[int, list[tuple[str, Any]]]:
+        """Revision-consistent full dump for replication catch-up: one
+        lock hold pins (rv, [(kind, obj), ...]); the deepcopies happen
+        outside it. The counterpart of load_snapshot() on the follower."""
+        with self._lock:
+            rv = self._rv
+            refs = [
+                (kind, o)
+                for kind, b in self._buckets.items()
+                for o in b.objects.values()
+            ]
+        return rv, [(kind, copy.deepcopy(o)) for kind, o in refs]
+
+    def load_snapshot(self, rv: int, objects: Iterable[Any]) -> int:
+        """Replication catch-up: replace the whole state with a leader
+        snapshot pinned at `rv` and adopt that rv exactly, so subsequent
+        log entries (rv+1, ...) continue the sequence. Only moves FORWARD
+        (a follower needs a snapshot because it is behind). Event sinks
+        are expected to be detached for the swap (the server detaches and
+        re-attaches its watch cache around this call — re-attach primes a
+        revision-consistent index); the watcher bus and persistence still
+        receive the transition as DELETED-for-vanished + ADDED-for-all
+        dispatches, so a follower's WAL replays to the snapshot state."""
+        objs = sorted(objects, key=lambda o: o.metadata.resource_version)
+        dispatch: list[tuple[str, str, Any]] = []
+        with self._write_lock():
+            if rv < self._rv:
+                raise ConflictError(
+                    f"snapshot at rv {rv} is behind this store's rv "
+                    f"{self._rv}"
+                )
+            old = {
+                (kind, key): o
+                for kind, b in self._buckets.items()
+                for key, o in b.objects.items()
+            }
+            for b in self._buckets.values():
+                # keep the buckets themselves: their watcher lists are
+                # live subscriptions that must survive the state swap
+                b.objects = {}
+            seen: set[tuple[str, str]] = set()
+            for obj in objs:
+                kind = gvk_of(obj)
+                key = self._key(obj.metadata)
+                self._bucket(kind).objects[key] = obj
+                seen.add((kind, key))
+            self._rv = rv
+            for (kind, key), o in old.items():
+                if (kind, key) not in seen:
+                    dispatch.append((kind, DELETED, o))
+        dispatch += [(gvk_of(o), ADDED, o) for o in objs]
+        self._dispatch([
+            (kind, event, copy.deepcopy(o)) for kind, event, o in dispatch
+        ])
+        return len(objs)
+
     # -- restore (persistence) --------------------------------------------
 
     def restore(self, objects: Iterable[Any]) -> int:
@@ -745,19 +864,28 @@ class Store:
 
     def _dispatch(self, events: list[tuple[str, str, Any]]) -> None:
         """Deliver committed events to subscribers — always OUTSIDE the
-        store lock. Batch watchers (persistence) get the whole rv-ordered
-        list first, so a mutator returns only after its records are durable;
-        the kind/all watcher bus then fans out per event. Per-key ordering
-        across RACING writers remains the sink's contract (under-lock
-        sequencing), not the bus's."""
+        store lock. Batch watchers (persistence, replication) get the
+        whole rv-ordered list first, so a mutator returns only after its
+        records are durable; the kind/all watcher bus then fans out per
+        event. Per-key ordering across RACING writers remains the sink's
+        contract (under-lock sequencing), not the bus's.
+
+        A batch watcher that RAISES (WAL write failure, replication
+        quorum timeout) surfaces its error to the mutator — but the
+        events are already committed to the store, so the per-event bus
+        fan-out still runs first (finally): level-triggered subscribers
+        must converge on committed state even when its durability or
+        replication promise failed."""
         if not events:
             return
         with self._lock:
             batch_watchers = list(self._batch_watchers)
-        for bw in batch_watchers:
-            bw(events)
-        for kind, event, obj in events:
-            self._notify(kind, event, obj)
+        try:
+            for bw in batch_watchers:
+                bw(events)
+        finally:
+            for kind, event, obj in events:
+                self._notify(kind, event, obj)
 
     def _notify(self, kind: str, event: str, obj: Any) -> None:
         """Watcher-bus fan-out for one event; never called with the store

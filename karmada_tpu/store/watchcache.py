@@ -157,10 +157,21 @@ class WatchCache:
 
     def attach(self) -> None:
         """Prime the index from current store state and subscribe to the
-        event-sink seam, atomically with respect to mutations."""
+        event-sink seam, atomically with respect to mutations.
+
+        Safe to call again after detach() — the replication snapshot path
+        swaps the store's whole state with the cache detached, then
+        re-attaches: the stale ring and index are dropped FIRST (a
+        since-resume across the swap must fall back to snapshot replay,
+        and index entries for objects that vanished during the swap must
+        not survive), and prime rebuilds a revision-consistent index
+        under the same lock hold that gates new events."""
         if self._attached:
             return
         self._attached = True
+        with self._cond:
+            self._events.clear()
+            self._index.clear()
         rv = self._store.add_event_sink(self._on_event, prime=self._prime)
         with self._cond:
             self._rv = max(self._rv, rv)

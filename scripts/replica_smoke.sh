@@ -1,0 +1,61 @@
+#!/usr/bin/env bash
+# Replicated-store smoke: the leader + 2-follower group at the 10k-watcher
+# acceptance point (ROADMAP item 1). Single-shot: runs the `replica` bench
+# config — follower child processes applying the leader's fenced log
+# shipping while serving a split cursor fan-out, quorum-batched writes vs
+# the single-node rate, rv-exactness digests, and a seal-and-promote
+# failover leg — and asserts the acceptance booleans the JSON line carries:
+#   pass_read_scaling        aggregate read events/s scales >= 1.7x
+#                            going 1 -> 2 followers at 10k watchers
+#   pass_write_retained      quorum-mode batched writes retain >= 0.5x of
+#                            the single-node batch rate
+#   pass_rv_consistent       follower state digests == the leader's at
+#                            every acked rv (read legs AND quorum leg)
+#   pass_failover_zero_loss  promoting the acked follower after leader
+#                            death loses zero quorum-acked writes
+# Exit 0 prints "REPLICA OK".
+#
+# Wired into the slow path as
+# tests/test_replication.py::TestReplicaSmokeScript (pytest -m slow).
+# Runs on CPU; needs no accelerator (the replication plane is pure host
+# code).
+set -euo pipefail
+
+cd "$(dirname "$0")/.."
+PY=${PYTHON:-python}
+WORK=$(mktemp -d /tmp/replica_smoke.XXXXXX)
+trap 'rm -rf "$WORK"' EXIT
+
+log() { echo "replica_smoke: $*"; }
+
+JAX_PLATFORMS=cpu $PY bench.py --inner --platform cpu --configs replica \
+    --verbose > "$WORK/out.txt" 2> "$WORK/err.txt" \
+    || { log "bench failed"; cat "$WORK/err.txt"; exit 1; }
+
+LINE=$(grep -E '^\{' "$WORK/out.txt" | tail -1)
+[ -n "$LINE" ] || { log "no JSON line emitted"; cat "$WORK/out.txt"; exit 1; }
+log "result: $LINE"
+
+REPLICA_LINE="$LINE" $PY - <<'PYEOF'
+import json
+import os
+import sys
+
+rec = json.loads(os.environ["REPLICA_LINE"])
+for key in ("pass_read_scaling", "pass_write_retained",
+            "pass_rv_consistent", "pass_failover_zero_loss", "pass"):
+    if not rec.get(key):
+        print(f"replica_smoke: criterion {key} FAILED "
+              f"(scaling={rec.get('read_scaling_1f_to_2f')}x, "
+              f"retained={rec.get('quorum_write_retained')}x, "
+              f"rv_consistent={rec.get('rv_consistent')}, "
+              f"failover={rec.get('failover')})", file=sys.stderr)
+        sys.exit(1)
+print(f"replica_smoke: {rec['watchers']} watchers, "
+      f"{rec['read_scaling_1f_to_2f']}x read scaling 1f->2f, "
+      f"quorum retains {rec['quorum_write_retained']}x writes, "
+      f"failover {rec['failover']['failover_s']}s with "
+      f"{rec['failover']['lost_acked_writes']} acked writes lost")
+PYEOF
+
+echo "REPLICA OK"

@@ -125,6 +125,12 @@ def main() -> None:
     # acceptance booleans alongside the device numbers)
     artifact["runs"].append(run_bench(
         ["--configs", "writeload", "--run-timeout", "600"], 700))
+    # replicated store: read fan-out scaling across follower processes,
+    # quorum-write retention vs the single-node batch rate, rv-exactness
+    # digests, and the seal-and-promote failover leg (host-side; captured
+    # so the committed artifact carries the acceptance booleans)
+    artifact["runs"].append(run_bench(
+        ["--configs", "replica", "--run-timeout", "600"], 700))
     # the Go-interop seam: /v1/scheduleBatch latency at flagship scale
     artifact["runs"].append(run_script(
         "scripts/bench_shim.py",

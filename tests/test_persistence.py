@@ -125,6 +125,101 @@ class TestPersistenceRoundTrip:
         assert cp2.store.try_get("apps/v1/Deployment", "ok", "default") is not None
 
 
+class TestTornTailHardening:
+    """Crash-mid-append WALs at EVERY truncation point (docs/HA.md:
+    replication replay makes partial tails routine): load() must keep
+    every intact record, truncate the live WAL back to the last whole
+    record, and never fail the boot."""
+
+    def _seed_wal(self, tmp_path, n=4):
+        from karmada_tpu.store.store import Store
+
+        store = Store()
+        p = StorePersistence(store, str(tmp_path))
+        p.attach()
+        from karmada_tpu.api.unstructured import Unstructured
+
+        for i in range(n):
+            store.create(Unstructured({
+                "apiVersion": "v1", "kind": "ConfigMap",
+                "metadata": {"name": f"t-{i}", "namespace": "d"},
+                "data": {"k": "v" * 20},
+            }))
+        p.close()
+        return (tmp_path / "wal.jsonl").read_bytes()
+
+    def test_truncate_at_every_byte_offset(self, tmp_path):
+        from karmada_tpu.store.store import Store
+
+        wal = self._seed_wal(tmp_path)
+        lines = wal.splitlines(keepends=True)
+        assert len(lines) == 4
+        # offsets of record boundaries (end of each whole line)
+        bounds = []
+        acc = 0
+        for ln in lines:
+            acc += len(ln)
+            bounds.append(acc)
+        wal_path = tmp_path / "wal.jsonl"
+        for cut in range(bounds[0], len(wal) + 1):
+            wal_path.write_bytes(wal[:cut])
+            store = Store()
+            p = StorePersistence(store, str(tmp_path))
+            n = p.load()
+            # every record wholly before the cut survives; records the
+            # cut tore are dropped. A cut exactly at a boundary keeps
+            # that record (incl. the no-trailing-newline case cut-1 of
+            # a boundary, where the line is complete JSON)
+            whole = sum(1 for b in bounds if cut >= b)
+            if cut + 1 in bounds:  # complete JSON, newline itself torn off
+                whole += 1
+            assert n == whole, (cut, n, whole)
+            # the live WAL was truncated to a record boundary: appending
+            # afterwards must produce a clean, fully-replayable log
+            p.attach()
+            from karmada_tpu.api.unstructured import Unstructured
+
+            store.create(Unstructured({
+                "apiVersion": "v1", "kind": "ConfigMap",
+                "metadata": {"name": "after-tear", "namespace": "d"},
+                "data": {},
+            }))
+            p.close()
+            store2 = Store()
+            n2 = StorePersistence(store2, str(tmp_path)).load()
+            assert n2 == whole + 1, (cut, n2, whole)
+            assert store2.try_get("v1/ConfigMap", "after-tear", "d") \
+                is not None
+            wal_path.unlink()  # reseed cleanly for the next offset
+
+    def test_corrupt_mid_file_record_is_skipped_not_fatal(self, tmp_path):
+        from karmada_tpu.store.store import Store
+
+        wal = self._seed_wal(tmp_path)
+        lines = wal.splitlines(keepends=True)
+        lines[1] = b'{"torn": \n'  # corrupt a MIDDLE record
+        (tmp_path / "wal.jsonl").write_bytes(b"".join(lines))
+        store = Store()
+        n = StorePersistence(store, str(tmp_path)).load()
+        # the records AFTER the corrupt one still replay (the old loader
+        # broke out of the file at the first bad line)
+        assert n == 3
+        assert store.try_get("v1/ConfigMap", "t-3", "d") is not None
+
+    def test_non_object_json_record_is_corrupt_not_fatal(self, tmp_path):
+        """`123` parses as valid JSON but is not a record — it must take
+        the corrupt-line path, not crash the replay with AttributeError."""
+        from karmada_tpu.store.store import Store
+
+        wal = self._seed_wal(tmp_path)
+        (tmp_path / "wal.jsonl").write_bytes(b"123\n" + wal + b'"x"')
+        store = Store()
+        n = StorePersistence(store, str(tmp_path)).load()
+        assert n == 4  # all real records; int skipped, str tail truncated
+        data = (tmp_path / "wal.jsonl").read_bytes()
+        assert not data.endswith(b'"x"')  # tail repaired
+
+
 class TestDaemonPersistence:
     def test_daemon_restart_preserves_objects(self, tmp_path):
         """Kill -INT a real daemon and restart it on the same --data-dir:

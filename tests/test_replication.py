@@ -1,0 +1,863 @@
+"""Replicated control-plane store (store/replication.py, docs/HA.md):
+fenced log shipping, quorum writes, follower reads, seal-and-promote.
+
+The acceptance property this suite pins is rv-EXACTNESS: a follower at any
+acked rv holds the leader's byte-identical state — same store bytes, same
+watch-cache event stream, same paginated snapshot pages — because every
+log entry replays the leader's commits with their original rvs and event
+types through the same under-lock sink the leader's own watch cache rides.
+"""
+from __future__ import annotations
+
+import json
+import threading
+import time
+
+import pytest
+
+from karmada_tpu import faults
+from karmada_tpu.api.unstructured import Unstructured
+from karmada_tpu.faults.plan import FaultPlan, FaultRule
+from karmada_tpu.server import codec
+from karmada_tpu.server.apiserver import ControlPlaneServer
+from karmada_tpu.server.remote import LeaderRedirect, RemoteControlPlane, RemoteStore
+from karmada_tpu.store.replication import (
+    REPLICATION_LEASE,
+    QuorumTimeoutError,
+    ReplicaClient,
+    ReplicaControlPlane,
+    ReplicationError,
+    ReplicationManager,
+    StaleAppendError,
+    seal_and_promote,
+)
+from karmada_tpu.store.store import ReplicationGapError, Store
+
+KIND = "v1/ConfigMap"
+
+
+def cm(i, t=""):
+    return Unstructured({
+        "apiVersion": "v1", "kind": "ConfigMap",
+        "metadata": {"name": f"obj-{i:04d}", "namespace": "repl"},
+        "data": {"t": t},
+    })
+
+
+def state_dump(store) -> list[str]:
+    return sorted(
+        json.dumps(codec.encode(o), sort_keys=True)
+        for kind in store.kinds() for o in store.list(kind)
+    )
+
+
+def follower_server():
+    cp = ReplicaControlPlane()
+    srv = ControlPlaneServer(cp)
+    srv.start()
+    return srv
+
+
+def wait_until(pred, timeout=10.0, interval=0.02):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred():
+            return True
+        time.sleep(interval)
+    return pred()
+
+
+class _Group:
+    """leader server (+ manager) and N follower servers, all in-process."""
+
+    def __init__(self, n_followers=2, mode="quorum", quorum=None, **kw):
+        self.followers = [follower_server() for _ in range(n_followers)]
+        self.leader_cp = ReplicaControlPlane()
+        # the replication lease fences the append stream; acquiring it
+        # BEFORE attach means the lease object itself replicates (token
+        # monotonicity survives failover)
+        lease, ok = self.leader_cp.coordinator.acquire(
+            REPLICATION_LEASE, "leader-0", kw.pop("lease_duration", 10.0))
+        assert ok
+        self.manager = ReplicationManager(
+            self.leader_cp.store, [f.url for f in self.followers],
+            mode=mode,
+            quorum=(n_followers if quorum is None else quorum),
+            token=lease.spec.fencing_token, identity="leader-0", **kw,
+        )
+        self.leader = ControlPlaneServer(self.leader_cp,
+                                         replication=self.manager)
+        self.leader.start()
+        self.manager.advertise_url = self.leader.url
+        # deterministic base: every follower finished its bootstrap sync
+        # (the initial snapshot at the attach floor) before the test
+        # writes, so everything after base_rv replays as pure log entries
+        assert wait_until(lambda: all(
+            p.acked_rv >= self.store.current_rv
+            for p in self.manager.peers))
+        self.base_rv = self.store.current_rv
+
+    @property
+    def store(self):
+        return self.leader_cp.store
+
+    def close(self):
+        self.leader.stop()
+        for f in self.followers:
+            f.stop()
+
+
+@pytest.fixture
+def group():
+    g = _Group()
+    yield g
+    g.close()
+
+
+class TestRvExactness:
+    def test_follower_state_and_event_stream_byte_identical(self, group):
+        store = group.store
+        for i in range(30):
+            store.create(cm(i, "v1"))
+        store.create_batch([cm(100 + i) for i in range(8)])
+        store.update_batch([cm(i, "v2") for i in range(0, 30, 3)])
+        store.delete(KIND, "obj-0001", "repl")
+        store.apply(cm(7, "v3"))
+
+        tip = store.current_rv
+        leader_cache = group.leader._watch_cache
+        l_events, _, ok = leader_cache.events_since(group.base_rv, limit=0)
+        assert ok and l_events
+
+        for f in group.followers:
+            fstore = f.cp.store
+            # quorum=all: every write above returned only after both
+            # followers applied+fsync'd it — no wait needed here
+            assert fstore.current_rv == tip
+            assert state_dump(fstore) == state_dump(store)
+            f_events, _, ok = f._watch_cache.events_since(group.base_rv,
+                                                          limit=0)
+            assert ok
+            # the watch-cache ring: same rvs, same event types, same wire
+            # bytes at every acked rv
+            assert [e.line() for e in f_events] == \
+                [e.line() for e in l_events]
+
+    def test_paginated_snapshots_identical(self, group):
+        store = group.store
+        for i in range(25):
+            store.create(cm(i))
+        l_rv, l_items, l_tok = group.leader._watch_cache.list_page(
+            KIND, "repl", 10)
+        for f in group.followers:
+            rv, items, tok = f._watch_cache.list_page(KIND, "repl", 10)
+            assert rv == l_rv
+            assert items == l_items
+        # crawl a full follower list over the wire and diff it against the
+        # leader's — revision-consistent page pinning on the replica
+        remote = RemoteStore(group.followers[0].url, page_size=7)
+        got = sorted(json.dumps(codec.encode(o), sort_keys=True)
+                     for o in remote.list(KIND, "repl"))
+        want = sorted(json.dumps(codec.encode(o), sort_keys=True)
+                      for o in store.list(KIND, "repl"))
+        assert got == want
+
+    def test_late_follower_catches_up_via_snapshot(self, group):
+        store = group.store
+        for i in range(12):
+            store.create(cm(i))
+        late = follower_server()
+        try:
+            group.manager.peers.append(
+                type(group.manager.peers[0])(
+                    late.url, ReplicaClient(late.url)))
+            p = group.manager.peers[-1]
+            t = threading.Thread(target=group.manager._peer_loop, args=(p,),
+                                 daemon=True)
+            p.thread = t
+            t.start()
+            assert wait_until(
+                lambda: late.cp.store.current_rv == store.current_rv)
+            assert state_dump(late.cp.store) == state_dump(store)
+            assert p.snapshots >= 1  # joined past the floor: snapshot first
+            # and the stream continues with ordinary entries
+            store.create(cm(500))
+            assert wait_until(
+                lambda: late.cp.store.current_rv == store.current_rv)
+        finally:
+            late.stop()
+
+
+class TestQuorumWrites:
+    def test_write_returns_after_quorum_fsync(self, group):
+        out = group.store.create(cm(0))
+        rv = out.metadata.resource_version
+        # no wait: the create() above could not have returned otherwise
+        for f in group.followers:
+            assert f.cp.store.current_rv >= rv
+
+    def test_quorum_timeout_fails_loudly(self):
+        g = _Group(n_followers=1, mode="quorum", quorum=1, ack_timeout=0.5)
+        try:
+            g.store.create(cm(0))  # healthy
+            g.followers[0].stop()
+            with pytest.raises((QuorumTimeoutError, ReplicationError)):
+                g.store.create(cm(1))
+        finally:
+            g.leader.stop()
+
+    def test_async_mode_does_not_block_on_dead_follower(self):
+        g = _Group(n_followers=1, mode="async")
+        try:
+            g.followers[0].stop()
+            t0 = time.perf_counter()
+            g.store.create(cm(0))
+            assert time.perf_counter() - t0 < 5.0  # bounded-lag gate only
+        finally:
+            g.leader.stop()
+
+
+class TestFencing:
+    def test_stale_append_409s_like_a_stale_write(self, group):
+        fol = group.followers[0]
+        client = ReplicaClient(fol.url)
+        stale_token = group.manager.token - 1
+        with pytest.raises(StaleAppendError):
+            client.append({
+                "token": stale_token, "leader": "ghost",
+                "leader_url": "http://ghost",
+                "entries": [{"start_rv": 1, "end_rv": 1, "records": [
+                    {"kind": KIND, "event": "ADDED", "rv": 1,
+                     "obj": codec.encode(cm(0))},
+                ]}],
+            })
+
+    def test_gap_409_carries_expected_rv(self, group):
+        group.store.create(cm(0))
+        fol = group.followers[0]
+        expect = fol.cp.store.current_rv + 1
+        client = ReplicaClient(fol.url)
+        with pytest.raises(ReplicationGapError) as ei:
+            client.append({
+                "token": group.manager.token + 1, "leader": "x",
+                "leader_url": "",
+                "entries": [{"start_rv": expect + 5, "end_rv": expect + 5,
+                             "records": [
+                                 {"kind": KIND, "event": "ADDED",
+                                  "rv": expect + 5,
+                                  "obj": codec.encode(cm(9))}]}],
+            })
+        assert ei.value.expected_rv == expect
+
+    def test_follower_writes_redirect_to_leader(self, group):
+        group.store.create(cm(0))
+        # dialing a follower with a write: the 409 carries leader_url and
+        # RemoteStore re-points automatically
+        remote = RemoteStore(group.followers[0].url)
+        out = remote.create(cm(77))
+        assert out.metadata.resource_version == group.store.current_rv
+        assert remote.base_url == group.leader.url
+        # batch writes take the same redirect
+        remote2 = RemoteStore(group.followers[1].url)
+        outs = remote2.create_batch([cm(88), cm(89)])
+        assert len(outs) == 2
+        assert remote2.base_url == group.leader.url
+
+
+class TestFollowerReads:
+    def test_min_rv_read_barrier_blocks_then_serves(self, group):
+        store = group.store
+        store.create(cm(0))
+        target_rv = store.current_rv + 3
+        remote = RemoteStore(group.followers[0].url)
+        results = {}
+
+        def reader():
+            t0 = time.perf_counter()
+            objs = remote.list(KIND, "repl", min_rv=target_rv)
+            results["elapsed"] = time.perf_counter() - t0
+            results["n"] = len(objs)
+
+        t = threading.Thread(target=reader, daemon=True)
+        t.start()
+        time.sleep(0.4)  # the barrier must be HOLDING the read open
+        assert "n" not in results
+        for i in range(1, 4):
+            store.create(cm(i))
+        t.join(timeout=10)
+        assert results["n"] == 4
+        assert results["elapsed"] >= 0.3
+
+    def test_read_preference_follower_round_robins(self, group):
+        from karmada_tpu.metrics import reads_served
+
+        for i in range(4):
+            group.store.create(cm(i))
+        before = reads_served.value(role="follower")
+        remote = RemoteStore(group.leader.url,
+                             replicas=[f.url for f in group.followers],
+                             read_preference="follower")
+        for i in range(4):
+            assert remote.get(KIND, f"obj-{i:04d}", "repl") is not None
+        assert reads_served.value(role="follower") >= before + 4
+
+    def test_watch_from_replica_delivers_leader_writes(self, group):
+        got = []
+        evt = threading.Event()
+
+        def handler(event, obj):
+            got.append((event, obj.metadata.name))
+            if len(got) >= 3:
+                evt.set()
+
+        remote = RemoteStore(group.leader.url,
+                             replicas=[group.followers[0].url],
+                             read_preference="follower")
+        try:
+            remote.watch(KIND, handler, replay=False)
+            time.sleep(0.3)  # stream attached to the follower
+            for i in range(3):
+                group.store.create(cm(i))
+            assert evt.wait(10.0)
+            assert {n for _, n in got} == {f"obj-{i:04d}" for i in range(3)}
+        finally:
+            remote.close()
+
+    def test_replica_read_falls_back_to_leader_when_replica_dies(self, group):
+        group.store.create(cm(0))
+        remote = RemoteStore(group.leader.url,
+                             replicas=[group.followers[0].url],
+                             read_preference="follower")
+        group.followers[0].stop()
+        assert remote.get(KIND, "obj-0000", "repl") is not None
+
+
+class TestFailover:
+    def test_sigkilled_leader_promotion_loses_zero_quorum_acked_writes(self):
+        # follower A is in the quorum path; follower B is added as a peer
+        # only AFTER failover (the lagging-peer catch-up leg)
+        a = follower_server()
+        b = follower_server()
+        leader_cp = ReplicaControlPlane()
+        lease, ok = leader_cp.coordinator.acquire(
+            REPLICATION_LEASE, "leader-0", 0.3)
+        assert ok
+        mgr = ReplicationManager(
+            leader_cp.store, [a.url], mode="quorum", quorum=1,
+            token=lease.spec.fencing_token, identity="leader-0",
+        )
+        leader = ControlPlaneServer(leader_cp, replication=mgr)
+        leader.start()
+        mgr.advertise_url = leader.url
+        try:
+            acked = []
+            for i in range(20):
+                out = leader_cp.store.create(cm(i))
+                acked.append(out.metadata.resource_version)
+            # "SIGKILL": the leader vanishes without sealing or releasing
+            # anything — no clean shutdown path runs
+            leader.stop()
+            time.sleep(0.4)  # the 0.3s lease TTL lapses
+
+            # promotion targets the max-rv follower (here: A, the only
+            # acked peer — and follower state is a contiguous log prefix,
+            # so max-rv contains every quorum-acked entry)
+            new_mgr = seal_and_promote(
+                a, [b.url], identity="follower-a", mode="quorum", quorum=1)
+            try:
+                # the replicated lease counter continued: strictly higher
+                # fencing token than the dead leader's
+                assert new_mgr.token > mgr.token
+                # zero quorum-acked writes lost
+                store_a = a.cp.store
+                assert store_a.current_rv >= max(acked)
+                for i in range(20):
+                    assert store_a.try_get(KIND, f"obj-{i:04d}", "repl") \
+                        is not None
+                # the new leader serves writes; B catches up from the same
+                # append stream (snapshot + rv offset)
+                out = store_a.create(cm(900, "post-failover"))
+                assert wait_until(
+                    lambda: b.cp.store.current_rv
+                    >= out.metadata.resource_version)
+                assert state_dump(b.cp.store) == state_dump(store_a)
+            finally:
+                new_mgr.close()
+        finally:
+            for s in (a, b):
+                s.stop()
+
+    def test_deposed_leaders_stale_appends_are_fenced(self):
+        a = follower_server()
+        leader_cp = ReplicaControlPlane()
+        lease, _ = leader_cp.coordinator.acquire(
+            REPLICATION_LEASE, "leader-0", 0.2)
+        mgr = ReplicationManager(
+            leader_cp.store, [a.url], mode="async", quorum=1,
+            token=lease.spec.fencing_token, identity="leader-0",
+        )
+        mgr.attach()
+        try:
+            leader_cp.store.create(cm(0))
+            assert wait_until(
+                lambda: a.cp.store.current_rv
+                == leader_cp.store.current_rv)
+            time.sleep(0.3)  # TTL lapses; the old leader does NOT notice
+            new_mgr = seal_and_promote(
+                a, [], identity="follower-a", mode="async")
+            try:
+                # the paused ex-leader resumes and ships another entry:
+                # the sealed, re-fenced follower must 409 it and the old
+                # manager must depose itself
+                leader_cp.store.create(cm(1, "stale"))
+                assert wait_until(lambda: mgr.deposed, timeout=5.0)
+                assert a.cp.store.try_get(KIND, "obj-0001", "repl") is None
+            finally:
+                new_mgr.close()
+        finally:
+            mgr.close()
+            a.stop()
+
+
+class TestChaosShipping:
+    def test_seeded_faults_on_the_replication_boundary_heal(self):
+        """A seeded FaultPlan partitions the leader->follower HTTP site
+        for a window of ship attempts: shipping retries with backoff and
+        the follower converges to the leader's exact bytes after heal."""
+        a = follower_server()
+        from urllib.parse import urlparse
+
+        target = urlparse(a.url).netloc
+        faults.install(FaultPlan(seed=7, rules=[
+            FaultRule(boundary="http", target=target, kind="partition",
+                      after=1, heal_after=5),
+        ]))
+        leader_cp = ReplicaControlPlane()
+        lease, _ = leader_cp.coordinator.acquire(
+            REPLICATION_LEASE, "leader-0")
+        mgr = ReplicationManager(
+            leader_cp.store, [a.url], mode="async", quorum=1,
+            token=lease.spec.fencing_token, identity="leader-0",
+        )
+        mgr.attach()
+        try:
+            for i in range(10):
+                leader_cp.store.create(cm(i))
+            assert wait_until(
+                lambda: a.cp.store.current_rv == leader_cp.store.current_rv,
+                timeout=20.0)
+            assert state_dump(a.cp.store) == state_dump(leader_cp.store)
+        finally:
+            mgr.close()
+            a.stop()
+            faults.reset()
+
+
+class TestStatusSurfaces:
+    def test_replication_status_route_and_cli(self, group):
+        group.store.create(cm(0))
+        assert wait_until(lambda: all(
+            f.cp.store.current_rv == group.store.current_rv
+            for f in group.followers))
+        rcp = RemoteControlPlane(group.leader.url)
+        st = rcp.replication_status()
+        assert st["role"] == "leader"
+        assert st["mode"] == "quorum"
+        assert len(st["peers"]) == 2
+        assert all(p["lag_rvs"] == 0 for p in st["peers"])
+        fst = RemoteControlPlane(group.followers[0].url).replication_status()
+        assert fst["role"] == "follower"
+        assert fst["applied_rv"] == group.store.current_rv
+
+        from karmada_tpu.cli.karmadactl import run
+
+        out = run(rcp, ["replication", "status"])
+        assert "role: leader" in out
+        assert "FOLLOWER" in out and "LAG" in out
+        out = run(RemoteControlPlane(group.followers[0].url),
+                  ["replication", "status"])
+        assert "role: follower" in out
+
+    def test_elections_printer_grows_role_column(self, group):
+        from karmada_tpu.cli.karmadactl import run
+
+        rcp = RemoteControlPlane(group.leader.url)
+        out = run(rcp, ["elections"])
+        assert "ROLE" in out
+        assert "leader@rv" in out
+        out = run(rcp, ["get", "leaderleases"])
+        assert "ROLE" in out and REPLICATION_LEASE in out
+
+
+class TestReviewHardening:
+    def test_watcher_bus_still_notified_when_quorum_times_out(self):
+        """A quorum-timeout write surfaces its error to the mutator, but
+        the object IS committed (and locally durable) — kind/all
+        watchers must still receive the event, or every level-triggered
+        subscriber silently desyncs from served state."""
+        g = _Group(n_followers=1, mode="quorum", quorum=1, ack_timeout=0.4)
+        try:
+            got = []
+            g.store.watch(KIND, lambda ev, o: got.append(o.metadata.name),
+                          replay=False)
+            g.followers[0].stop()
+            with pytest.raises((QuorumTimeoutError, ReplicationError)):
+                g.store.create(cm(1))
+            assert "obj-0001" in got
+            assert g.store.try_get(KIND, "obj-0001", "repl") is not None
+        finally:
+            g.leader.stop()
+
+    def test_revive_after_depose_resumes_shipping(self):
+        """A leader that lost its lease without a successor (GC pause)
+        re-elects and must SHIP again — depose() let the peer threads
+        exit, so revive() restarts them and drains the backlog."""
+        a = follower_server()
+        leader_cp = ReplicaControlPlane()
+        lease, _ = leader_cp.coordinator.acquire(
+            REPLICATION_LEASE, "leader-0")
+        mgr = ReplicationManager(
+            leader_cp.store, [a.url], mode="async",
+            token=lease.spec.fencing_token, identity="leader-0",
+        )
+        mgr.attach()
+        try:
+            leader_cp.store.create(cm(0))
+            assert wait_until(
+                lambda: a.cp.store.current_rv == leader_cp.store.current_rv)
+            mgr.depose("renewal missed")
+            with pytest.raises(ReplicationError):
+                leader_cp.store.create(cm(1))  # deposed: writes fail loudly
+            mgr.revive(lease.spec.fencing_token + 1)
+            leader_cp.store.create(cm(2))
+            assert wait_until(
+                lambda: a.cp.store.current_rv == leader_cp.store.current_rv)
+            assert state_dump(a.cp.store) == state_dump(leader_cp.store)
+        finally:
+            mgr.close()
+            a.stop()
+
+    def test_follower_mode_rejects_writes_before_first_append(self):
+        """--follower boots write-rejecting: a client write accepted in
+        the window before the leader's first append would mint a local
+        rv and fork the replicated log. With no leader to redirect to
+        yet the rejection is a 503, NOT a bare 409 — a 409 would read as
+        an object conflict to `except ConflictError: pass` callers."""
+        from karmada_tpu.server.remote import RemoteError
+
+        cp = ReplicaControlPlane()
+        srv = ControlPlaneServer(cp, follower=True)
+        srv.start()
+        try:
+            remote = RemoteStore(srv.url)
+            with pytest.raises(RemoteError, match="503"):
+                remote.create(cm(0))
+            assert cp.store.current_rv == 0
+            # reads still serve
+            assert remote.list(KIND, "repl") == []
+            st = RemoteControlPlane(srv.url).replication_status()
+            assert st["role"] in ("follower", "candidate")
+        finally:
+            srv.stop()
+
+    def test_lease_writes_redirect_off_followers(self, group):
+        """An election CAS is a store write: a follower must not mint a
+        local rv for it (the rv fork the lease exemption comment used to
+        allow). The elector's RemoteStore lease calls follow the
+        redirect to the leader instead."""
+        remote = RemoteStore(group.followers[0].url)
+        lease, acquired = remote.acquire_lease("test-elect", "me", 5.0)
+        assert acquired
+        # the write landed on the LEADER and replicated back — follower
+        # rv continuity intact, no local fork
+        assert group.store.try_get(
+            "LeaderLease", "test-elect", "karmada-system") is not None
+        assert wait_until(lambda: all(
+            f.cp.store.current_rv == group.store.current_rv
+            for f in group.followers))
+        assert state_dump(group.followers[0].cp.store) == \
+            state_dump(group.store)
+
+    def test_leader_restart_probes_instead_of_snapshotting(self):
+        """An in-sync follower re-contacted by a restarted leader must
+        cost a PROBE (empty append), not a full state snapshot + WAL
+        rewrite per follower per restart."""
+        a = follower_server()
+        leader_cp = ReplicaControlPlane()
+        mgr = ReplicationManager(
+            leader_cp.store, [a.url], mode="async", token=1,
+            identity="leader-0",
+        )
+        mgr.attach()
+        try:
+            leader_cp.store.create(cm(0))
+            assert wait_until(
+                lambda: a.cp.store.current_rv == leader_cp.store.current_rv)
+        finally:
+            mgr.close()
+        mgr2 = ReplicationManager(
+            leader_cp.store, [a.url], mode="async", token=2,
+            identity="leader-0b",
+        )
+        mgr2.attach()
+        try:
+            leader_cp.store.create(cm(1))
+            assert wait_until(
+                lambda: a.cp.store.current_rv == leader_cp.store.current_rv)
+            assert mgr2.peers[0].snapshots == 0
+            assert state_dump(a.cp.store) == state_dump(leader_cp.store)
+        finally:
+            mgr2.close()
+            a.stop()
+
+    def test_forked_follower_is_quarantined_not_silently_acked(self):
+        """A follower whose store ran AHEAD of the leader's log (it
+        minted local rvs) must be quarantined with a loud error — the
+        old rewind path marked it caught-up with lag 0 while the two
+        stores disagreed at the same rv."""
+        a = follower_server()
+        leader_cp = ReplicaControlPlane()
+        mgr = ReplicationManager(
+            leader_cp.store, [a.url], mode="async", token=5,
+            identity="leader-0",
+        )
+        # fork: the "follower" writes locally before any shipping
+        for i in range(10):
+            a.cp.store.create(cm(i, "forked"))
+        # make it look like a follower that accepted a leader before
+        fol = a._ensure_follower()
+        fol.max_token = 4
+        mgr.attach()
+        try:
+            leader_cp.store.create(cm(99))
+            assert wait_until(
+                lambda: mgr.peers[0].diverged, timeout=10.0)
+            st = mgr.status()
+            assert st["peers"][0]["diverged"]
+            assert "diverged" in st["peers"][0]["last_error"]
+        finally:
+            mgr.close()
+            a.stop()
+
+
+class TestReviewHardeningSecondPass:
+    def test_lost_promotion_rolls_the_seal_back(self):
+        """Two operators promoting concurrently: the loser's
+        seal_and_promote raises AND unseals — it must go back to
+        accepting the winner's appends and rejecting client writes, not
+        sit sealed (write-accepting, append-409ing)."""
+        a = follower_server()
+        leader_cp = ReplicaControlPlane()
+        lease, _ = leader_cp.coordinator.acquire(
+            REPLICATION_LEASE, "leader-0")  # long TTL: election un-winnable
+        mgr = ReplicationManager(
+            leader_cp.store, [a.url], mode="async",
+            token=lease.spec.fencing_token, identity="leader-0",
+        )
+        mgr.attach()
+        try:
+            leader_cp.store.create(cm(0))
+            assert wait_until(
+                lambda: a.cp.store.current_rv == leader_cp.store.current_rv)
+            with pytest.raises(ReplicationError):
+                seal_and_promote(a, [], identity="loser")  # lease held
+            assert not a._follower.sealed
+            # the real leader's stream keeps applying
+            leader_cp.store.create(cm(1))
+            assert wait_until(
+                lambda: a.cp.store.current_rv == leader_cp.store.current_rv)
+            # and client writes still bounce to the leader
+            with pytest.raises(Exception):
+                RemoteStore(a.url).create(cm(2))
+        finally:
+            mgr.close()
+            a.stop()
+
+    def test_simulate_is_blocked_on_followers(self, group):
+        from karmada_tpu.server.apiserver import ControlPlaneServer
+
+        assert "/simulate" in ControlPlaneServer._FOLLOWER_BLOCKED
+        # over the wire: a follower answers 409 before touching cp.simulate
+        from karmada_tpu.store.store import ConflictError
+
+        rs = RemoteStore(group.followers[0].url)
+        with pytest.raises(ConflictError):
+            rs._call("POST", "/simulate", {"request": None})
+
+    def test_revive_races_no_lost_shipper(self):
+        """Depose/revive churn must never strand a peer without a
+        shipping loop (the loops PARK while deposed instead of exiting)."""
+        a = follower_server()
+        leader_cp = ReplicaControlPlane()
+        mgr = ReplicationManager(
+            leader_cp.store, [a.url], mode="async", token=1,
+            identity="leader-0",
+        )
+        mgr.attach()
+        try:
+            for i in range(5):
+                mgr.depose("churn")
+                mgr.revive(2 + i)
+            leader_cp.store.create(cm(0))
+            assert wait_until(
+                lambda: a.cp.store.current_rv == leader_cp.store.current_rv)
+            assert mgr.peers[0].thread.is_alive()
+        finally:
+            mgr.close()
+            a.stop()
+
+
+class TestReviewHardeningThirdPass:
+    def test_concurrent_promotions_resolve_to_one_leader(self):
+        """Two operators promote A and B concurrently: both local
+        acquires mint EQUAL tokens (independent replicated lease
+        copies), so the claim's identity tiebreak must resolve to
+        exactly one leader — the loser closes its manager, re-syncs from
+        a snapshot (its local lease rv forked the log), and follows."""
+        a = follower_server()
+        b = follower_server()
+        leader_cp = ReplicaControlPlane()
+        lease, _ = leader_cp.coordinator.acquire(
+            REPLICATION_LEASE, "leader-0", 0.3)
+        mgr = ReplicationManager(
+            leader_cp.store, [a.url, b.url], mode="quorum", quorum=2,
+            token=lease.spec.fencing_token, identity="leader-0",
+        )
+        mgr.attach()
+        try:
+            for i in range(10):
+                leader_cp.store.create(cm(i))
+            mgr.close()  # leader dies
+            time.sleep(0.4)  # TTL lapses
+            mgr_a = seal_and_promote(a, [b.url], identity="promo-a",
+                                     mode="async")
+            mgr_b = seal_and_promote(b, [a.url], identity="promo-b",
+                                     mode="async")
+            assert mgr_a.token == mgr_b.token  # the equal-token tie
+            try:
+                # "promo-b" outranks "promo-a" at equal tokens: A yields
+                assert wait_until(lambda: mgr_a.deposed, timeout=10.0)
+                assert a._repl is None  # closed, not just deposed
+                # B's stream re-syncs A (snapshot past the forked lease
+                # rv) and keeps shipping
+                out = b.cp.store.create(cm(77, "winner"))
+                assert wait_until(
+                    lambda: a.cp.store.current_rv
+                    >= out.metadata.resource_version, timeout=10.0)
+                assert state_dump(a.cp.store) == state_dump(b.cp.store)
+                assert a._replication_role() == "follower"
+                assert b._replication_role() == "leader"
+            finally:
+                mgr_b.close()
+                mgr_a.close()
+        finally:
+            mgr.close()
+            a.stop()
+            b.stop()
+
+    def test_outranked_leader_server_applies_higher_claim_appends(self):
+        """An ex-leader SERVER whose manager is still attached must not
+        500 the new leader's appends (a deposed-but-subscribed manager
+        raised out of every replicated apply): yielding closes the
+        manager and the appends commit cleanly."""
+        old_cp = ReplicaControlPlane()
+        old_mgr = ReplicationManager(
+            old_cp.store, [], mode="async", token=1, identity="old-leader")
+        old_srv = ControlPlaneServer(old_cp, replication=old_mgr)
+        old_srv.start()
+        new_store_cp = ReplicaControlPlane()
+        new_mgr = ReplicationManager(
+            new_store_cp.store, [old_srv.url], mode="async", token=2,
+            identity="new-leader",
+        )
+        new_mgr.attach()
+        try:
+            for i in range(5):
+                new_store_cp.store.create(cm(i))
+            assert wait_until(
+                lambda: old_cp.store.current_rv
+                == new_store_cp.store.current_rv, timeout=10.0)
+            assert old_srv._repl is None
+            assert old_srv._replication_role() == "follower"
+            assert state_dump(old_cp.store) == state_dump(new_store_cp.store)
+            # the new leader never saw a 500-retry storm: appends landed
+            assert new_mgr.peers[0].appends >= 1
+            assert not new_mgr.peers[0].last_error
+        finally:
+            new_mgr.close()
+            old_srv.stop()
+
+    def test_async_writes_do_not_stall_on_a_dead_follower(self):
+        """A single unreachable follower must not tax every async write
+        with the bounded-lag wait — the gate only waits on peers that
+        are actually shippable."""
+        leader_cp = ReplicaControlPlane()
+        mgr = ReplicationManager(
+            leader_cp.store, ["http://127.0.0.1:9"],  # nothing listens
+            mode="async", token=1, identity="leader-0", max_async_lag=4,
+        )
+        mgr.attach()
+        try:
+            t0 = time.perf_counter()
+            for i in range(50):
+                leader_cp.store.create(cm(i))
+            assert time.perf_counter() - t0 < 5.0  # no per-write 1s stall
+        finally:
+            mgr.close()
+
+
+# -- the smoke wrapper (slow path) -----------------------------------------
+
+
+@pytest.mark.slow
+class TestReplicaSmokeScript:
+    def test_replica_smoke(self):
+        """scripts/replica_smoke.sh: the leader + 2-follower group at the
+        10k-watcher point — read scaling, quorum-write retention,
+        rv-exactness digests, and the seal-and-promote failover leg,
+        asserted from the emitted JSON line."""
+        import os
+        import subprocess
+
+        repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        r = subprocess.run(
+            ["bash", "scripts/replica_smoke.sh"],
+            capture_output=True, text=True, timeout=900, cwd=repo,
+        )
+        assert r.returncode == 0, r.stdout + r.stderr
+        assert "REPLICA OK" in r.stdout
+
+
+class TestStorePrimitives:
+    def test_apply_replicated_rejects_partial_entries(self):
+        s = Store()
+        s.create(cm(0))
+        recs = []
+        for rv, name in ((2, "a"), (4, "b")):  # rv 3 missing
+            o = cm(1)
+            o.metadata.name = name
+            o.metadata.resource_version = rv
+            recs.append((KIND, "ADDED", o))
+        with pytest.raises(ReplicationGapError):
+            s.apply_replicated(recs)
+        # nothing applied: continuity validated before any commit
+        assert s.current_rv == 1
+        assert s.try_get(KIND, "a", "repl") is None
+
+    def test_load_snapshot_moves_forward_only_and_deletes_vanished(self):
+        s = Store()
+        s.create(cm(0))
+        s.create(cm(1))
+        deleted = []
+        s.watch(KIND, lambda ev, o: deleted.append((ev, o.metadata.name)),
+                replay=False)
+        snap_obj = cm(2, "snap")
+        snap_obj.metadata.resource_version = 9
+        snap_obj.metadata.uid = "u-snap"
+        s.load_snapshot(10, [snap_obj])
+        assert s.current_rv == 10
+        assert s.try_get(KIND, "obj-0000", "repl") is None
+        assert s.try_get(KIND, "obj-0002", "repl") is not None
+        assert ("DELETED", "obj-0000") in deleted
+        with pytest.raises(Exception):
+            s.load_snapshot(5, [])  # backwards: refused
