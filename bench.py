@@ -2592,6 +2592,381 @@ def run_replica(args, backend_label: str, verbose=False) -> dict:
     return rec
 
 
+# --------------------------------------------------------------------------
+# elastic: the closed-loop elasticity plane (karmada_tpu/elastic)
+# --------------------------------------------------------------------------
+
+ELASTIC_WORKLOADS = 80
+ELASTIC_CLUSTERS = 12
+ELASTIC_TICK_S = 0.12      # elasticity-daemon tick (the driver's cadence)
+ELASTIC_SLO_S = 2.0        # metric-spike -> replicas-placed p99 SLO
+ELASTIC_REQUEST_CPU = 0.5  # per-pod request of every bench workload
+ELASTIC_TARGET_PCT = 60    # target utilization -> 0.3 cpu of demand/replica
+
+
+class _ElasticTopology:
+    """One leg's live daemon topology, crypto-free: bare store + member
+    sims, the streaming scheduler, a detector-lite (template spec.replicas
+    -> binding spec.replicas), a member reconciler (binding placements ->
+    member workloads, so ready pods track what the scheduler actually
+    placed), and the elasticity daemon under test. The closed loop:
+
+        demand -> reports -> elastic step -> template -> binding ->
+        streaming admission -> placement -> member ready pods -> reports
+
+    Per-pod usage is demand / ready (load conservation), so scaling
+    genuinely relieves utilization and the loop converges."""
+
+    NS = "bench"
+
+    def __init__(self, seed, n_workloads, n_clusters, hysteresis):
+        from karmada_tpu.api.autoscaling import (
+            FederatedHPA,
+            FederatedHPASpec,
+            HPABehavior,
+            ResourceMetricSource,
+            ScaleTargetRef,
+        )
+        from karmada_tpu.api.meta import ObjectMeta
+        from karmada_tpu.elastic import ElasticityDaemon
+        from karmada_tpu.interpreter.interpreter import ResourceInterpreter
+        from karmada_tpu.members.member import (
+            InMemoryMember,
+            MemberConfig,
+            cluster_object_for,
+        )
+        from karmada_tpu.runtime.controller import Runtime
+        from karmada_tpu.sched.scheduler import SchedulerDaemon
+        from karmada_tpu.store.store import Store
+        from karmada_tpu.testing.fixtures import new_deployment
+
+        self.w, self.c = n_workloads, n_clusters
+        self.store = Store()
+        self.members = {}
+        for i in range(n_clusters):
+            cfg = MemberConfig(
+                name=f"member{i}",
+                allocatable={"cpu": 10_000.0, "pods": 100_000.0},
+            )
+            m = InMemoryMember(cfg)
+            self.members[cfg.name] = m
+            self.store.create(cluster_object_for(cfg))
+        self.manifests = {}
+        rng = np.random.default_rng(seed)
+        self.base_demand = 0.6 + 1.8 * rng.random(n_workloads)
+        self.demand = dict(
+            (f"app-{i}", float(self.base_demand[i]))
+            for i in range(n_workloads)
+        )
+        for i in range(n_workloads):
+            dep = new_deployment(self.NS, f"app-{i}", replicas=2,
+                                 cpu=ELASTIC_REQUEST_CPU)
+            self.store.create(dep)
+            man = dep.to_dict()
+            man.pop("status", None)
+            man.get("metadata", {}).pop("resourceVersion", None)
+            self.manifests[f"app-{i}"] = man
+        # the daemon BEFORE the bindings: its replayed watch enqueues them
+        self.daemon = SchedulerDaemon(self.store, Runtime())
+        for i in range(n_workloads):
+            rb = _binding(i, 2, _dyn_placement(), ELASTIC_REQUEST_CPU,
+                          ns=self.NS)
+            rb.metadata.uid = f"bench-elastic-{i}"
+            self.store.create(rb)
+        zero_cut = n_workloads // 4
+        self.zero_set = {f"app-{i}" for i in range(zero_cut)}
+        for i in range(n_workloads):
+            name = f"app-{i}"
+            self.store.create(FederatedHPA(
+                metadata=ObjectMeta(name=f"hpa-{i}", namespace=self.NS),
+                spec=FederatedHPASpec(
+                    scale_target_ref=ScaleTargetRef(kind="Deployment",
+                                                    name=name),
+                    min_replicas=0 if name in self.zero_set else 1,
+                    max_replicas=64,
+                    metrics=[ResourceMetricSource(
+                        name="cpu",
+                        target_average_utilization=ELASTIC_TARGET_PCT)],
+                    behavior=HPABehavior(
+                        scale_up_stabilization_seconds=0.0,
+                        scale_down_stabilization_seconds=1.0,
+                    ),
+                    scale_to_zero=name in self.zero_set,
+                ),
+            ))
+        self.elastic = ElasticityDaemon(
+            self.store, interpreter=ResourceInterpreter(),
+            hysteresis=hysteresis, preflight=False,
+        )
+        # spike->placed latency bookkeeping (marked by the driver)
+        import threading
+
+        self._lat_lock = threading.Lock()
+        self._expect = {}       # workload name -> (t0, want_placed)
+        self.latencies = []
+        self._applied = {}      # workload name -> last-applied fingerprint
+        self.store.watch("apps/v1/Deployment", self._on_template,
+                         replay=False)
+        self.store.watch("ResourceBinding", self._on_binding, replay=False)
+
+    # -- the glue the full ControlPlane would provide ----------------------
+
+    def _on_template(self, event, dep):
+        """Detector-lite: template spec.replicas -> binding spec.replicas
+        (the ResourceDetector's revise-replica path)."""
+        if event == "DELETED":
+            return
+        rb = self.store.try_get("ResourceBinding", dep.name, self.NS)
+        if rb is None:
+            return
+        want = int(dep.get("spec", "replicas", default=0) or 0)
+        if rb.spec.replicas != want:
+            rb.spec.replicas = want
+            self.store.update(rb)
+
+    def _on_binding(self, event, rb):
+        """Member reconciler + latency watch: a scheduler patch (observed
+        generation caught up) applies the placement to the member sims and
+        completes any pending spike measurement."""
+        if event == "DELETED":
+            return
+        if rb.status.scheduler_observed_generation != rb.metadata.generation:
+            return
+        name = rb.metadata.name
+        targets = {t.name: t.replicas for t in (rb.spec.clusters or [])}
+        if rb.spec.replicas <= 0:
+            targets = {}
+        fp = tuple(sorted(targets.items()))
+        if self._applied.get(name) != fp:
+            self._applied[name] = fp
+            man = self.manifests.get(name)
+            if man is not None:
+                for cname, member in self.members.items():
+                    m = json.loads(json.dumps(man))
+                    m["spec"]["replicas"] = int(targets.get(cname, 0))
+                    member.apply_manifest(m)
+        placed = sum(targets.values())
+        with self._lat_lock:
+            pending = self._expect.get(name)
+            if pending is not None and placed >= pending[1]:
+                self._expect.pop(name)
+                self.latencies.append(time.perf_counter() - pending[0])
+
+    def mark_spike(self, name, want_placed):
+        with self._lat_lock:
+            self._expect[name] = (time.perf_counter(), want_placed)
+
+    def pending_spikes(self):
+        with self._lat_lock:
+            return len(self._expect)
+
+    def drive_tick(self):
+        """One driver tick: demand model -> member usage -> reports ->
+        ONE elasticity step."""
+        from karmada_tpu.elastic import build_metrics_report, publish_report
+
+        ready = {name: 0 for name in self.demand}
+        for member in self.members.values():
+            for name in self.demand:
+                r, _ = member.pod_metrics("Deployment", self.NS, name)
+                ready[name] += r
+        for name, demand in self.demand.items():
+            per_pod = demand / max(ready[name], 1)
+            for member in self.members.values():
+                member.set_workload_usage("Deployment", self.NS, name,
+                                          {"cpu": per_pod})
+        for member in self.members.values():
+            publish_report(self.store, build_metrics_report(member, 0.0))
+        self.elastic.step()
+
+
+def steady_replicas(demand):
+    """The loop's fixed point for one workload's demand:
+    ceil(demand / (request * target))."""
+    return int(np.ceil(demand / (ELASTIC_REQUEST_CPU
+                                 * ELASTIC_TARGET_PCT / 100.0)))
+
+
+def _elastic_leg(seed, hysteresis, n_workloads, n_clusters, tick_s,
+                 verbose=False):
+    """Replay the seeded diurnal trace — spike, plateau, trough (with
+    scale-to-zero), resurrection, flap — against one live topology.
+    Returns the leg's scale-event counts, spike->placed latencies, and
+    the one-launch accounting."""
+    import threading as _threading
+
+    topo = _ElasticTopology(seed, n_workloads, n_clusters, hysteresis)
+    daemon, store = topo.daemon, topo.store
+    svc = daemon.streaming(batch_delay=0.002, interval=0.05, max_batch=96)
+    stop = _threading.Event()
+    server = _threading.Thread(
+        target=lambda: svc.serve(should_stop=stop.is_set), daemon=True,
+        name=f"elastic-stream-{'h' if hysteresis else 'n'}",
+    )
+    t_warm = time.perf_counter()
+    server.start()
+    try:
+        # initial placement of the whole pool, then compile-warm the
+        # reachable micro-batch buckets (same discipline as `stream`)
+        deadline = time.monotonic() + 600.0
+        while time.monotonic() < deadline:
+            if svc._ready() == 0 and len(topo._applied) >= n_workloads:
+                break
+            time.sleep(0.05)
+        _warm_lattice(_prime_hwm(store, daemon), daemon, cap=96)
+
+        def run_phase(n_ticks):
+            for _ in range(n_ticks):
+                t0 = time.perf_counter()
+                topo.drive_tick()
+                sleep = tick_s - (time.perf_counter() - t0)
+                if sleep > 0:
+                    time.sleep(sleep)
+
+        # settle: seed the recommendation ring with steady history
+        run_phase(20)
+        warm_s = time.perf_counter() - t_warm
+        settle_events = (topo.elastic.stats["scale_ups"]
+                         + topo.elastic.stats["scale_downs"])
+        ticks0 = topo.elastic.stats["ticks"]
+
+        # ---- spike: 3x demand, measured spike -> replicas placed --------
+        for i in range(n_workloads):
+            name = f"app-{i}"
+            spiked = float(topo.base_demand[i] * 3.0)
+            topo.demand[name] = spiked
+            topo.mark_spike(name, steady_replicas(spiked))
+        spike_deadline = time.monotonic() + 60.0
+        while (topo.pending_spikes() > 0
+               and time.monotonic() < spike_deadline):
+            run_phase(1)
+        spikes_unplaced = topo.pending_spikes()
+        # ---- plateau ----------------------------------------------------
+        run_phase(15)
+        # ---- trough: quarter of the fleet to zero, the rest scale down --
+        for i in range(n_workloads):
+            name = f"app-{i}"
+            topo.demand[name] = (0.0 if name in topo.zero_set
+                                 else float(topo.base_demand[i] * 0.4))
+        run_phase(25)
+        zero_scaled = sum(
+            1 for name in topo.zero_set
+            if int(store.get("apps/v1/Deployment", name,
+                             topo.NS).get("spec", "replicas")) == 0
+        )
+        # ---- resurrection: demand returns to the scaled-to-zero subset --
+        for i in range(n_workloads):
+            name = f"app-{i}"
+            if name in topo.zero_set:
+                topo.demand[name] = float(topo.base_demand[i])
+        run_phase(15)
+        resurrected = topo.elastic.stats["resurrected"]
+        # ---- flap: hi/lo around every tick, inside the down window ------
+        for j in range(40):
+            hi = j % 2 == 0
+            for i in range(n_workloads):
+                name = f"app-{i}"
+                topo.demand[name] = float(
+                    topo.base_demand[i] * (3.0 if hi else 0.3))
+            run_phase(1)
+        run_phase(10)  # let the tail settle
+    finally:
+        stop.set()
+        svc.stop()
+        server.join(timeout=60.0)
+
+    st = topo.elastic.stats
+    events = st["scale_ups"] + st["scale_downs"] - settle_events
+    lat = _percentiles(topo.latencies)
+    leg = {
+        "hysteresis": hysteresis,
+        "scale_events": int(events),
+        "scale_ups": int(st["scale_ups"]),
+        "scale_downs": int(st["scale_downs"]),
+        "spike_to_placed": lat,
+        "spikes_unplaced": int(spikes_unplaced),
+        "zero_scaled": int(zero_scaled),
+        "zero_subset": len(topo.zero_set),
+        "resurrected": int(resurrected),
+        "ticks": int(st["ticks"]),
+        "solves": int(st["solves"]),
+        "workloads_per_solve": int(
+            topo.elastic.last_step_stats.get("workloads", 0)),
+        "warm_s": round(warm_s, 1),
+    }
+    if verbose:
+        print(f"# elastic leg hysteresis={hysteresis}: {events} scale "
+              f"events, spike p99 {lat['p99_s']}s, "
+              f"{zero_scaled}/{len(topo.zero_set)} scaled to zero, "
+              f"{resurrected} resurrected, solves={st['solves']}/"
+              f"{st['ticks']} ticks")
+    return leg
+
+
+def run_elastic(args, backend_label: str, verbose=False) -> dict:
+    """The `elastic` config: a seeded diurnal-traffic replay (spike,
+    plateau, trough with scale-to-zero, resurrection, flap) against the
+    LIVE daemon topology — streaming scheduler + elasticity daemon — run
+    twice on the same trace: hysteresis on (the production config, the
+    measured SLO leg) and off (the oscillation counterfactual). The JSON
+    line asserts: spike->placed p99 under the SLO, the hysteresis leg
+    >= 5x fewer scale events, and one vectorized launch per tick for all
+    W workloads."""
+    from karmada_tpu.sched import core as core_mod
+
+    seed = 0
+    n_workloads = int(args.workloads)
+    n_clusters = int(args.clusters)
+    # cpu fallback hygiene, same as `stream`: host-twin the division tails
+    # so wobbling class-count buckets don't turn the trace into XLA
+    # compile churn (no-op on TPU)
+    prev_tail = core_mod.HOST_TAIL_MIN_ELEMS
+    core_mod.HOST_TAIL_MIN_ELEMS = 0
+    try:
+        hyst = _elastic_leg(seed, True, n_workloads, n_clusters,
+                            ELASTIC_TICK_S, verbose=verbose)
+        nohyst = _elastic_leg(seed, False, n_workloads, n_clusters,
+                              ELASTIC_TICK_S, verbose=verbose)
+    finally:
+        core_mod.HOST_TAIL_MIN_ELEMS = prev_tail
+
+    p99 = hyst["spike_to_placed"]["p99_s"]
+    ratio = (round(nohyst["scale_events"] / hyst["scale_events"], 2)
+             if hyst["scale_events"] else None)
+    one_launch = bool(
+        hyst["solves"] == hyst["ticks"]
+        and nohyst["solves"] == nohyst["ticks"]
+        and hyst["workloads_per_solve"] == n_workloads
+    )
+    rec = {
+        "metric": (f"elastic_spike_to_placed_p99_{n_workloads}w"
+                   f"_x_{n_clusters}c"),
+        "value": p99,
+        "unit": "s",
+        "backend": backend_label,
+        "slo_s": ELASTIC_SLO_S,
+        "tick_s": ELASTIC_TICK_S,
+        "hysteresis_leg": hyst,
+        "no_hysteresis_leg": nohyst,
+        "oscillation_ratio": ratio,
+        "pass_slo": bool(p99 is not None and p99 <= ELASTIC_SLO_S
+                         and hyst["spikes_unplaced"] == 0),
+        "pass_oscillation": bool(ratio is not None and ratio >= 5.0),
+        "pass_one_launch": one_launch,
+        "pass_scale_to_zero": bool(
+            hyst["zero_scaled"] == hyst["zero_subset"]
+            and hyst["resurrected"] >= hyst["zero_subset"]),
+    }
+    rec["pass"] = (rec["pass_slo"] and rec["pass_oscillation"]
+                   and rec["pass_one_launch"] and rec["pass_scale_to_zero"])
+    if verbose:
+        print(f"# elastic: spike->placed p99 {p99}s (SLO {ELASTIC_SLO_S}s), "
+              f"{nohyst['scale_events']} vs {hyst['scale_events']} scale "
+              f"events ({ratio}x), one_launch={one_launch} -> "
+              f"pass={rec['pass']}")
+    return rec
+
+
 def build_flagship_cold(seed=0, n_clusters=5000, n_bindings=10000):
     """North-star variant, adversarial to the per-placement encode cache:
     every measured iteration bumps each binding's generation first
@@ -2628,13 +3003,14 @@ CONFIGS = {
     "fanout": (None, None),  # serving-path read scaling; see run_fanout
     "writeload": (None, None),  # write-path batching; see run_writeload
     "replica": (None, None),  # replicated store group; see run_replica
+    "elastic": (None, None),  # closed-loop autoscaling replay; run_elastic
     "flagship_cold": (build_flagship_cold, None),  # named after the shape
     "flagship": (build_flagship, None),  # metric name carries the shape
 }
 DEFAULT_ORDER = [
     "dup3", "static", "dynamic", "spread", "spread_skewed", "churn",
     "churn_incremental", "autoshard", "pipeline", "whatif", "degraded",
-    "coldstart", "stream", "fanout", "writeload", "replica",
+    "coldstart", "stream", "fanout", "writeload", "replica", "elastic",
     "flagship_cold", "flagship",
 ]
 
@@ -2695,6 +3071,11 @@ def add_args(ap: argparse.ArgumentParser) -> None:
                     help=argparse.SUPPRESS)
     ap.add_argument("--replica-data-dir", default="",
                     help=argparse.SUPPRESS)
+    # elastic config overrides (the diurnal-replay topology size)
+    ap.add_argument("--elastic-workloads", type=int,
+                    default=ELASTIC_WORKLOADS, help=argparse.SUPPRESS)
+    ap.add_argument("--elastic-clusters", type=int,
+                    default=ELASTIC_CLUSTERS, help=argparse.SUPPRESS)
     # platform must be pinned via jax.config inside the child, not the
     # JAX_PLATFORMS env var (the TPU sitecustomize hangs on the env var)
     ap.add_argument("--platform", default=None, help=argparse.SUPPRESS)
@@ -2785,6 +3166,8 @@ def main() -> None:
             "--writeload-window-s", str(args.writeload_window_s),
             "--replica-watchers", str(args.replica_watchers),
             "--replica-window-s", str(args.replica_window_s),
+            "--elastic-workloads", str(args.elastic_workloads),
+            "--elastic-clusters", str(args.elastic_clusters),
         ] + (["--verbose"] if args.verbose else []) \
           + (["--platform", platform] if platform else [])
         budget = deadline - time.perf_counter()
@@ -2942,6 +3325,31 @@ def run_bench(args) -> None:
                     "error": f"{type(e).__name__}: {e}"[:300],
                 }
             # host-side replication bench: meaningful on any backend
+            lines.append(json.dumps(rec))
+            continue
+        if name == "elastic":
+            import types
+
+            el_args = types.SimpleNamespace(
+                workloads=args.elastic_workloads,
+                clusters=args.elastic_clusters,
+            )
+            try:
+                rec = run_elastic(el_args, backend, verbose=args.verbose)
+            except Exception as e:  # noqa: BLE001 - one labeled error line
+                rec = {
+                    "metric": (f"elastic_spike_to_placed_p99_"
+                               f"{args.elastic_workloads}w"
+                               f"_x_{args.elastic_clusters}c"),
+                    "value": None, "unit": "s", "backend": backend,
+                    "error": f"{type(e).__name__}: {e}"[:300],
+                }
+            if not on_tpu:
+                rec["metric"] += f"_{backend}"
+                rec["note"] = (
+                    "cpu fallback; the placement half of the loop targets "
+                    f"TPU — last TPU capture: {latest_capture_name()}"
+                )
             lines.append(json.dumps(rec))
             continue
         if name == "stream":

@@ -57,16 +57,25 @@ class Lease:
 
 class KarmadaAgent:
     def __init__(self, store: Store, member, interpreter, runtime: Runtime,
-                 status_flush_delay: float = 0.0):
+                 status_flush_delay: float = 0.0,
+                 metrics_reports: bool = False):
         """`status_flush_delay` > 0 coalesces the per-Work applied-condition
         status reports through a WriteCoalescer (store/batching.py): a
         settle pass draining N Works writes their conditions as one batch
         call after the delay instead of N round-trips. 0 (the in-process
         default) writes through synchronously. Correctness-bearing writes
-        (finalizers, deletion) are never buffered."""
+        (finalizers, deletion) are never buffered.
+
+        `metrics_reports=True` (the elasticity plane's feed, docs/
+        ELASTICITY.md) publishes a WorkloadMetricsReport for this member on
+        every heartbeat — riding the SAME coalesced status path when one is
+        configured, so utilization reporting costs the fleet no extra
+        round-trips beyond the Work conditions it already batches."""
         self.store = store
         self.member = member
         self.interpreter = interpreter
+        self.metrics_reports = metrics_reports
+        self._report_cache: dict = {}  # change-suppression, no read RTT
         self.clock = runtime.clock
         self.namespace = work_namespace_for_cluster(member.name)
         self._status_coalescer = None
@@ -167,6 +176,17 @@ class KarmadaAgent:
             if cluster.status.resource_summary.allocatable != alloc:
                 cluster.status.resource_summary.allocatable = alloc
                 self.store.update(cluster)
+        if self.metrics_reports:
+            # the elasticity feed: per-workload utilization for this member,
+            # change-suppressed and coalesced with the Work status batch
+            from ..elastic.aggregator import build_metrics_report, publish_report
+
+            publish_report(
+                self.store,
+                build_metrics_report(self.member, self.clock.now()),
+                coalescer=self._status_coalescer,
+                cache=self._report_cache,
+            )
 
 
 class LeaseFailureDetector:

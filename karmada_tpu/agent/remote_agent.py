@@ -29,12 +29,18 @@ class RemoteAgentSession:
     def __init__(self, url: str, config: MemberConfig,
                  member: Optional[InMemoryMember] = None,
                  token: Optional[str] = None, cafile: Optional[str] = None,
-                 status_flush_delay: float = 0.005):
+                 status_flush_delay: float = 0.005,
+                 metrics_reports: bool = False):
         """`status_flush_delay`: the agent-side write-coalescing knob —
         per-Work status reports buffer this many seconds and commit as one
         POST /objects/batch instead of one round-trip each (a thousand
         agents reporting after a surge stop serializing on per-request
-        overhead). 0 restores per-object writes."""
+        overhead). 0 restores per-object writes.
+
+        `metrics_reports=True`: publish this member's WorkloadMetricsReport
+        on every heartbeat (the elasticity plane's feed, docs/ELASTICITY.md)
+        — riding the same coalescing buffer, so utilization reporting adds
+        zero extra round-trips to the status batch."""
         if config.sync_mode != "Pull":
             raise ValueError("remote agents serve Pull clusters")
         self.config = config
@@ -45,7 +51,8 @@ class RemoteAgentSession:
         interpreter.load_thirdparty()
         self.agent = KarmadaAgent(self.store, self.member, interpreter,
                                   self.runtime,
-                                  status_flush_delay=status_flush_delay)
+                                  status_flush_delay=status_flush_delay,
+                                  metrics_reports=metrics_reports)
         # the agent's own workStatus controller (agent.go:248-433 runs
         # execution + workStatus + clusterStatus member-side): reflect this
         # member's object status into work.status over the wire

@@ -14,6 +14,7 @@ from .meta import ObjectMeta
 
 KIND_FEDERATED_HPA = "FederatedHPA"
 KIND_CRON_FEDERATED_HPA = "CronFederatedHPA"
+KIND_WORKLOAD_METRICS_REPORT = "WorkloadMetricsReport"
 
 
 @dataclass
@@ -33,11 +34,30 @@ class ResourceMetricSource:
 
 
 @dataclass
+class HPABehavior:
+    """Per-direction stabilization windows (autoscaling/v2
+    HPAScalingRules.stabilizationWindowSeconds, kube defaults: scale-up 0,
+    scale-down 300). The elasticity daemon applies them as the hysteresis
+    half of its vectorized step: scale-up is damped to the MIN
+    recommendation over the up window, scale-down to the MAX over the down
+    window — a metric flapping inside the window produces zero scale
+    events."""
+
+    scale_up_stabilization_seconds: float = 0.0
+    scale_down_stabilization_seconds: float = 300.0
+
+
+@dataclass
 class FederatedHPASpec:
     scale_target_ref: ScaleTargetRef = field(default_factory=ScaleTargetRef)
     min_replicas: Optional[int] = 1
     max_replicas: int = 1
     metrics: list[ResourceMetricSource] = field(default_factory=list)
+    behavior: HPABehavior = field(default_factory=HPABehavior)
+    # HPAScaleToZero analogue: allows minReplicas 0 — the workload scales
+    # to zero when its utilization drops to zero and resurrects (through
+    # ordinary scheduler admission) when the demand signal returns
+    scale_to_zero: bool = False
 
 
 @dataclass
@@ -45,6 +65,10 @@ class FederatedHPAStatus:
     current_replicas: int = 0
     desired_replicas: int = 0
     current_average_utilization: Optional[int] = None
+    # which metric the observed percent belongs to (the last RESOLVED
+    # metric — without this, a multi-metric printer would attribute the
+    # one stored number to the wrong metric)
+    current_metric: str = ""
     last_scale_time: Optional[float] = None
 
 
@@ -100,6 +124,46 @@ class CronFederatedHPA:
     spec: CronFederatedHPASpec = field(default_factory=CronFederatedHPASpec)
     status: CronFederatedHPAStatus = field(default_factory=CronFederatedHPAStatus)
     kind: str = KIND_CRON_FEDERATED_HPA
+
+    @property
+    def name(self) -> str:
+        return self.metadata.name
+
+    @property
+    def namespace(self) -> str:
+        return self.metadata.namespace
+
+
+@dataclass
+class WorkloadMetricsRow:
+    """One workload's metrics in one member cluster, as reported by that
+    cluster's status stream: ready pod count + average PER-POD usage. A
+    workload at zero ready pods carries its raw demand signal instead
+    (queue depth / external traffic — the scale-from-zero trigger; with no
+    pods there are no pod metrics to report)."""
+
+    kind: str = ""
+    namespace: str = ""
+    name: str = ""
+    ready_pods: int = 0
+    usage: dict[str, float] = field(default_factory=dict)  # per ready pod
+    demand: dict[str, float] = field(default_factory=dict)  # at 0 ready
+
+
+@dataclass
+class WorkloadMetricsReport:
+    """Per-cluster workload utilization report (cluster-scoped, named after
+    the member): the feed the elasticity daemon's aggregator folds into its
+    [W, C] usage/capacity matrix. Pull agents publish it on their heartbeat
+    through the coalesced agent-status write path; the control plane
+    collects it for push members. Level-triggered and last-write-wins: a
+    report wholly REPLACES the cluster's previous rows."""
+
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    cluster: str = ""
+    rows: list[WorkloadMetricsRow] = field(default_factory=list)
+    reported_at: float = 0.0
+    kind: str = KIND_WORKLOAD_METRICS_REPORT
 
     @property
     def name(self) -> str:

@@ -378,6 +378,10 @@ _KIND_ALIASES = {
     "overridepolicies": "OverridePolicy",
     "event": "Event", "events": "Event",
     "leaderlease": "LeaderLease", "leaderleases": "LeaderLease",
+    "fhpa": "FederatedHPA", "federatedhpa": "FederatedHPA",
+    "federatedhpas": "FederatedHPA",
+    "cronfhpa": "CronFederatedHPA", "cronfederatedhpa": "CronFederatedHPA",
+    "cronfederatedhpas": "CronFederatedHPA",
     "simulationreport": "SimulationReport",
     "simulationreports": "SimulationReport",
     "simreport": "SimulationReport", "simreports": "SimulationReport",
@@ -539,6 +543,8 @@ def cmd_get(cp: ControlPlane, kind: str, name: str = "", namespace: str = "",
                                 repl=_replication_status(cp))
     if resolved == "SimulationReport":
         return _simulation_reports_table(objs, wide=wide)
+    if resolved == "FederatedHPA":
+        return _federated_hpas_table(objs, wide=wide)
     rows = [
         [getattr(o.metadata, "namespace", "") or "-", o.metadata.name]
         for o in sorted(objs, key=lambda o: (o.metadata.namespace, o.metadata.name))
@@ -1137,6 +1143,57 @@ def cmd_replication_status(cp: ControlPlane) -> str:
         if st.get("sealed_rv") is not None:
             head.append(f"sealed at rv: {st['sealed_rv']}")
     return "\n".join(head)
+
+
+def _federated_hpas_table(hpas, wide: bool = False) -> str:
+    """`karmadactl get federatedhpas` (kubectl get hpa columns): TARGETS is
+    observed/target utilization per metric, LASTSCALE the age of the last
+    scale event the elasticity daemon (or the per-object controller)
+    emitted."""
+    import time as _time
+
+    now = _time.time()
+    rows = []
+    for h in sorted(hpas, key=lambda h: (h.metadata.namespace,
+                                         h.metadata.name)):
+        # the status holds ONE observed percent, attributed to
+        # status.current_metric (the last RESOLVED metric) — it renders
+        # against that metric only; the rest show <unknown> rather than a
+        # fabricated reading. Objects written before the attribution field
+        # existed fall back to the last list position.
+        util = h.status.current_average_utilization
+        cm = getattr(h.status, "current_metric", "") or ""
+        n_metrics = len(h.spec.metrics)
+
+        def util_cell(i: int, m) -> str:
+            if util is None:
+                return "<unknown>"
+            mine = (m.name == cm) if cm else (i == n_metrics - 1)
+            return f"{util}%" if mine else "<unknown>"
+
+        targets = ",".join(
+            f"{m.name}: {util_cell(i, m)}/{m.target_average_utilization}%"
+            for i, m in enumerate(h.spec.metrics)
+        ) or "<none>"
+        last = h.status.last_scale_time
+        lastscale = "<never>" if not last else f"{max(0.0, now - last):.0f}s"
+        row = [
+            h.metadata.namespace, h.metadata.name, targets,
+            str(h.spec.min_replicas if h.spec.min_replicas is not None else 1),
+            str(h.spec.max_replicas),
+            str(h.status.current_replicas),
+            lastscale,
+        ]
+        if wide:
+            t = h.spec.scale_target_ref
+            row += [f"{t.kind}/{t.name}", str(h.status.desired_replicas),
+                    "true" if h.spec.scale_to_zero else "false"]
+        rows.append(row)
+    headers = ["NAMESPACE", "NAME", "TARGETS", "MINPODS", "MAXPODS",
+               "REPLICAS", "LASTSCALE"]
+    if wide:
+        headers += ["REFERENCE", "DESIRED", "SCALE-TO-ZERO"]
+    return _fmt_table(rows, headers)
 
 
 def _simulation_reports_table(reports, wide: bool = False) -> str:

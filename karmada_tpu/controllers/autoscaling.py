@@ -31,8 +31,40 @@ HPA_TOLERANCE = 0.1  # kube HPA default --horizontal-pod-autoscaler-tolerance
 SCALE_TARGET_MARKER_LABEL = "autoscaling.karmada.io/federated-hpa-enabled"
 
 
+class _TemplateKindIndex:
+    """kind-suffix -> [gvk] index over a store's registered kinds. The old
+    lookup rescanned store.kinds() on EVERY reconcile — O(kinds) per HPA
+    sync. Kind registration is rare (a bucket is created once per gvk), so
+    the index is built once per suffix and invalidated wholesale when the
+    store's kinds_token moves."""
+
+    def __init__(self) -> None:
+        import weakref
+
+        # per-store cache: (kinds_token, {kind_suffix: [gvk, ...]})
+        self._by_store: "weakref.WeakKeyDictionary" = weakref.WeakKeyDictionary()
+
+    def kinds(self, store: Store, kind: str) -> list[str]:
+        token = getattr(store, "kinds_token", None)
+        if token is None:  # store without the token (remote surface): scan
+            return [g for g in store.kinds() if g.endswith(f"/{kind}")]
+        cached = self._by_store.get(store)
+        if cached is None or cached[0] != token:
+            cached = (token, {})
+            self._by_store[store] = cached
+        suffixes = cached[1]
+        got = suffixes.get(kind)
+        if got is None:
+            got = [g for g in store.kinds() if g.endswith(f"/{kind}")]
+            suffixes[kind] = got
+        return got
+
+
+_template_index = _TemplateKindIndex()
+
+
 def _template_kinds(store: Store, kind: str) -> list[str]:
-    return [g for g in store.kinds() if g.endswith(f"/{kind}")]
+    return _template_index.kinds(store, kind)
 
 
 def _find_template(store: Store, kind: str, name: str, namespace: str):
@@ -41,6 +73,41 @@ def _find_template(store: Store, kind: str, name: str, namespace: str):
         if obj is not None:
             return obj
     return None
+
+
+def hpa_desired_replicas(
+    current: int,
+    ready_pods: int,
+    metric_rows: list[tuple[float, float, float]],
+    tolerance: float = HPA_TOLERANCE,
+) -> tuple[int, Optional[int]]:
+    """The kube HPA target-tracking step as a pure function — THE algorithm
+    both the per-object FederatedHPAController and the elasticity plane's
+    vectorized step implement (tests/test_elastic.py pins their bit
+    parity). `metric_rows` is [(avg_usage, resource_request, target_pct)]
+    for every metric whose request resolved (> 0). Returns (desired,
+    utilization_seen) BEFORE the min/max clamp; desired <= 0 collapses to
+    `current` (the per-direction scale-to-zero path lives in the
+    vectorized solver, gated by spec.scale_to_zero).
+
+    Every metric produces a proposal — the current replica count when
+    within tolerance (a tolerant metric still vetoes scaling below what it
+    needs), else ceil(ready * usage/target) — and the final answer is the
+    max across all metric proposals."""
+    proposals: list[int] = []
+    utilization_seen: Optional[int] = None
+    for avg_usage, res_request, target in metric_rows:
+        if res_request <= 0:
+            continue
+        utilization = avg_usage / res_request * 100.0
+        utilization_seen = int(utilization)
+        ratio = utilization / float(target)
+        if abs(ratio - 1.0) <= tolerance:
+            proposals.append(current)
+        else:
+            proposals.append(math.ceil(ready_pods * ratio))
+    desired = max(proposals, default=current)
+    return (desired if desired > 0 else current), utilization_seen
 
 
 class FederatedHPAController:
@@ -110,27 +177,21 @@ class FederatedHPAController:
                     request = req.resource_request
             except KeyError:
                 pass
-        # kube HPA algorithm: every metric produces a proposal — the current
-        # replica count when within tolerance (a tolerant metric still vetoes
-        # scaling below what it needs), else ceil(ready * usage/target) — and
-        # the final answer is the max across all metric proposals.
-        proposals: list[int] = []
-        utilization_seen: Optional[int] = None
-        for metric in hpa.spec.metrics:
-            res_request = request.get(metric.name, 0.0)
-            if res_request <= 0:
-                continue
-            avg_usage = metrics.average_usage(metric.name)
-            utilization = avg_usage / res_request * 100.0
-            utilization_seen = int(utilization)
-            ratio = utilization / float(metric.target_average_utilization)
-            if abs(ratio - 1.0) <= HPA_TOLERANCE:
-                proposals.append(current)
-            else:
-                proposals.append(math.ceil(metrics.ready_pods * ratio))
+        rows = [
+            (metrics.average_usage(m.name), request.get(m.name, 0.0),
+             float(m.target_average_utilization))
+            for m in hpa.spec.metrics
+        ]  # unresolved requests (<= 0) are skipped inside the algorithm
+        desired, utilization_seen = hpa_desired_replicas(
+            current, metrics.ready_pods, rows
+        )
         hpa.status.current_average_utilization = utilization_seen
-        desired = max(proposals, default=current)
-        return desired if desired > 0 else current
+        # the observed percent belongs to the LAST resolved metric
+        hpa.status.current_metric = next(
+            (m.name for m in reversed(hpa.spec.metrics)
+             if request.get(m.name, 0.0) > 0), "",
+        ) if utilization_seen is not None else ""
+        return desired
 
 
 class CronFederatedHPAController:

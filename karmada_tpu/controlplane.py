@@ -85,7 +85,7 @@ from .api.cluster import DEFAULT_API_ENABLEMENTS  # noqa: E402,F401
 # reference's registration map (controllermanager.go:222-248); two are off
 # unless explicitly named (controllermanager.go:220)
 CONTROLLERS_DISABLED_BY_DEFAULT = frozenset(
-    {"hpaScaleTargetMarker", "deploymentReplicasSyncer"}
+    {"hpaScaleTargetMarker", "deploymentReplicasSyncer", "elasticity"}
 )
 CONTROLLER_NAMES = (
     "binding", "bindingStatus", "execution", "workStatus", "namespace",
@@ -98,6 +98,12 @@ CONTROLLER_NAMES = (
     # but gateable here so a plane can run scheduler-less with
     # `python -m karmada_tpu.sched` attached out-of-process
     "scheduler",
+    # the closed-loop elasticity plane (elastic/ — docs/ELASTICITY.md):
+    # opt-in by name (or the server daemon's --elastic flag). When enabled,
+    # member utilization reports flow (agents + plane-side collector) and
+    # the elected elasticity daemon runs one vectorized autoscaling step
+    # per tick, replacing the per-object FHPA/Cron reconcile loops
+    "elasticity",
 )
 
 
@@ -362,18 +368,35 @@ class ControlPlane:
             if ctl("serviceExport") else None
         )
 
-        # Autoscaling family (A1-A4)
+        # Autoscaling family (A1-A4). The elasticity plane, when enabled,
+        # REPLACES the per-object FHPA/Cron reconcile loops: one elected
+        # daemon solves every scaled workload as a single vectorized step
+        # per tick (cron rules fold in as bound rows on the same matrix),
+        # so the per-HPA controllers must not race it to the templates.
         self.metrics_adapter = MetricsAdapter(self.members)
+        self.elasticity = None
+        self._metrics_report_cache: dict = {}
+        if ctl("elasticity"):
+            from .elastic import ElasticityDaemon
+
+            self.elasticity = ElasticityDaemon(
+                self.store, self.runtime.clock,
+                interpreter=self.interpreter,
+                coordinator=self.coordinator,
+                event_recorder=self.event_recorder,
+            )
         self.federated_hpa_controller = (
             FederatedHPAController(
                 self.store, self.metrics_adapter, self.runtime,
                 interpreter=self.interpreter,
             )
-            if ctl("federatedHorizontalPodAutoscaler") else None
+            if ctl("federatedHorizontalPodAutoscaler")
+            and self.elasticity is None else None
         )
         self.cron_federated_hpa_controller = (
             CronFederatedHPAController(self.store, self.runtime)
-            if ctl("cronFederatedHorizontalPodAutoscaler") else None
+            if ctl("cronFederatedHorizontalPodAutoscaler")
+            and self.elasticity is None else None
         )
         self.hpa_scale_target_marker = (
             HPAScaleTargetMarker(self.store, self.runtime)
@@ -432,7 +455,9 @@ class ControlPlane:
         self.resource_cache.attach_member(member)
         if config.sync_mode == "Pull":
             # the member runs its own agent (L7): execution + lease heartbeat
-            agent = KarmadaAgent(self.store, member, self.interpreter, self.runtime)
+            agent = KarmadaAgent(self.store, member, self.interpreter,
+                                 self.runtime,
+                                 metrics_reports=self.elasticity is not None)
             # the agent identity cert the register CSR flow would have issued
             agent.cert = self.sign_agent_cert(config.name)
             self.agents[config.name] = agent
@@ -450,6 +475,14 @@ class ControlPlane:
         lease_ns = work_namespace_for_cluster(name)
         if self.store.try_get("Lease", name, lease_ns) is not None:
             self.store.delete("Lease", name, lease_ns)
+        # the member's utilization report leaves with it — the elasticity
+        # aggregator drops its rows on the DELETED event, so a departed
+        # cluster's pods stop counting toward workload ready totals
+        from .api.autoscaling import KIND_WORKLOAD_METRICS_REPORT
+
+        if self.store.try_get(KIND_WORKLOAD_METRICS_REPORT, name) is not None:
+            self.store.delete(KIND_WORKLOAD_METRICS_REPORT, name)
+        self._metrics_report_cache.pop(name, None)
         if self.store.try_get("Cluster", name) is not None:
             self.store.delete("Cluster", name)
         self.members.pop(name, None)
@@ -525,11 +558,38 @@ class ControlPlane:
             self.service_export_controller.collect_once()
         for agent in self.agents.values():
             agent.heartbeat()
+        if self.elasticity is not None:
+            # push members have no agent to report for them: the plane
+            # collects their utilization (the reference's cluster-status
+            # controller role), then the elected daemon runs ONE vectorized
+            # autoscaling step over the whole report matrix. The settle()
+            # below propagates any emitted replica deltas template ->
+            # binding -> scheduler admission.
+            self.collect_metrics_reports()
+            self.elasticity.step()
         self.lease_detector.check()
         self.resource_cache.sweep()
         if self.frq_status_controller is not None:
             self.frq_status_controller.collect_once()
         return self.settle(max_steps)
+
+    def collect_metrics_reports(self) -> int:
+        """Plane-side WorkloadMetricsReport sweep for PUSH members (pull
+        members' agents publish their own on heartbeat, through the
+        coalesced agent-status path). Change-suppressed: an unchanged
+        member costs zero writes. Returns how many reports were written."""
+        from .elastic.aggregator import build_metrics_report, publish_report
+
+        written = 0
+        now = self.runtime.clock.now()
+        cache = self._metrics_report_cache
+        for name, member in sorted(self.members.items()):
+            if name in self.agents:
+                continue
+            if publish_report(self.store, build_metrics_report(member, now),
+                              cache=cache):
+                written += 1
+        return written
 
     def run_descheduler(self) -> int:
         """One descheduling sweep + convergence (the 2m timer tick)."""

@@ -1,0 +1,33 @@
+"""Closed-loop elasticity plane (docs/ELASTICITY.md).
+
+The reference ships a full autoscaling plane (pkg/apis/autoscaling +
+FederatedHPA/CronFederatedHPA controllers); this package closes the loop
+batched: member utilization reaches the plane through the coalesced agent
+status stream, an elected-leader daemon folds it into a per-workload
+[W, C] matrix, solves target tracking for ALL FederatedHPAs as ONE
+vectorized step per tick (tolerance band, per-direction hysteresis
+windows, scale-to-zero, CronFederatedHPA as bound rows), and emits the
+replica deltas through one rv-checked transactional batch the streaming
+scheduler absorbs as ordinary admissions.
+"""
+from .aggregator import (
+    UtilizationAggregator,
+    build_metrics_report,
+    publish_report,
+    workload_key,
+)
+from .daemon import LEASE_ELASTIC, ElasticityDaemon
+from .solver import RecommendationRing, SolveInputs, empty_inputs, solve_step
+
+__all__ = [
+    "ElasticityDaemon",
+    "LEASE_ELASTIC",
+    "RecommendationRing",
+    "SolveInputs",
+    "UtilizationAggregator",
+    "build_metrics_report",
+    "empty_inputs",
+    "publish_report",
+    "solve_step",
+    "workload_key",
+]

@@ -1,0 +1,227 @@
+"""Server-side utilization aggregation: the [W, C] matrix feed.
+
+Member utilization reaches the control plane as `WorkloadMetricsReport`
+objects — pull agents publish them on their heartbeat THROUGH the coalesced
+agent-status write path (PR-9 `WriteCoalescer`), the plane collects them
+for push members — and this module folds that stream into the per-workload
+usage/capacity matrix the elasticity daemon solves over.
+
+The fold is incremental and level-triggered: the watch handler keeps only
+the LATEST report per cluster (a report wholly replaces its predecessor),
+and `snapshot()` lays the retained rows out as numpy blocks aligned to the
+daemon's workload order. Report WRITERS are change-suppressed — a sweep
+whose rows match the stored report skips the write entirely, so an idle
+fleet costs zero store churn.
+"""
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass
+from typing import Optional
+
+import numpy as np
+
+from ..api.autoscaling import (
+    KIND_WORKLOAD_METRICS_REPORT,
+    WorkloadMetricsReport,
+    WorkloadMetricsRow,
+)
+from ..api.meta import ObjectMeta
+from ..store.store import DELETED
+
+
+def workload_key(kind: str, namespace: str, name: str) -> str:
+    return f"{kind}/{namespace}/{name}"
+
+
+@dataclass
+class AggregateView:
+    """One tick's matrix view for the daemon's workload order: per-cluster
+    ready pods and per-resource per-pod usage, plus the zero-ready demand
+    signal. Reductions over the C axis happen in the solver."""
+
+    clusters: list[str]
+    ready: np.ndarray                 # [W, C] int
+    usage: dict[str, np.ndarray]      # resource -> [W, C] per-pod usage
+    demand: dict[str, np.ndarray]     # resource -> [W, C] raw demand
+
+    def ready_total(self) -> np.ndarray:
+        return self.ready.sum(axis=1)
+
+    def avg_usage(self, resource: str) -> np.ndarray:
+        """Federation-wide average per-pod usage, weighted by ready pods —
+        exactly the MetricsAdapter.collect() average the per-object
+        controller consumes (total usage / total ready)."""
+        u = self.usage.get(resource)
+        total_ready = self.ready_total().astype(np.float64)
+        if u is None:
+            return np.zeros(self.ready.shape[0], dtype=np.float64)
+        total = (u * self.ready).sum(axis=1)
+        with np.errstate(divide="ignore", invalid="ignore"):
+            avg = total / total_ready
+        return np.where(total_ready > 0, avg, 0.0)
+
+    def demand_total(self) -> np.ndarray:
+        out = np.zeros(self.ready.shape[0], dtype=np.float64)
+        for d in self.demand.values():
+            out += d.sum(axis=1)
+        return out
+
+
+class UtilizationAggregator:
+    """Folds the WorkloadMetricsReport stream into per-cluster row maps and
+    serves matrix snapshots. Attach once per plane; the watch replays
+    existing reports so a restarted daemon starts warm."""
+
+    def __init__(self, store):
+        self.store = store
+        self._lock = threading.Lock()
+        # cluster -> {workload_key: row}
+        self._rows: dict[str, dict[str, WorkloadMetricsRow]] = {}
+        store.watch(KIND_WORKLOAD_METRICS_REPORT, self._on_report,
+                    replay=True)
+
+    def _on_report(self, event: str, report: WorkloadMetricsReport) -> None:
+        cluster = report.cluster or report.metadata.name
+        with self._lock:
+            if event == DELETED:
+                self._rows.pop(cluster, None)
+                return
+            self._rows[cluster] = {
+                workload_key(r.kind, r.namespace, r.name): r
+                for r in report.rows
+            }
+
+    def clusters(self) -> list[str]:
+        with self._lock:
+            return sorted(self._rows)
+
+    def snapshot(self, keys: list[str], resources: list[str], *,
+                 clusters: Optional[set] = None) -> AggregateView:
+        """Matrix view for the daemon's workload order. O(W*C) dict
+        lookups at assembly (host work, like the fleet encoders); the
+        arrays it returns feed the ONE vectorized solve.
+
+        `clusters` — when given — restricts the fold to that member set:
+        the daemon passes the READY clusters, so a crashed or partitioned
+        member's last retained report stops feeding phantom pods into the
+        matrix the moment the failure detector flips its condition."""
+        with self._lock:
+            per_cluster = {
+                c: dict(rows) for c, rows in self._rows.items()
+                if clusters is None or c in clusters
+            }
+        clusters = sorted(per_cluster)
+        w, c = len(keys), len(clusters)
+        ready = np.zeros((w, c), dtype=np.int64)
+        usage = {r: np.zeros((w, c), dtype=np.float64) for r in resources}
+        demand = {r: np.zeros((w, c), dtype=np.float64) for r in resources}
+        for ci, cname in enumerate(clusters):
+            rows = per_cluster[cname]
+            for wi, key in enumerate(keys):
+                row = rows.get(key)
+                if row is None:
+                    continue
+                ready[wi, ci] = row.ready_pods
+                for r in resources:
+                    if row.ready_pods > 0:
+                        usage[r][wi, ci] = row.usage.get(r, 0.0)
+                    else:
+                        demand[r][wi, ci] = row.demand.get(r, 0.0)
+        return AggregateView(clusters=clusters, ready=ready, usage=usage,
+                             demand=demand)
+
+
+# -- report builders (the writer side of the stream) -----------------------
+
+
+def build_metrics_report(member, now: float) -> WorkloadMetricsReport:
+    """Snapshot one member's workload metrics into a report: ready pods +
+    per-pod usage per workload, demand rows for workloads at zero ready
+    pods that still show a usage signal (the scale-from-zero trigger).
+    Shared by the pull agent's heartbeat and the plane-side collector for
+    push members — one report format, two writers, matching the reference's
+    Push/Pull status split."""
+    rows: list[WorkloadMetricsRow] = []
+    seen: set[str] = set()
+    for gvk in list(member.store.kinds()):
+        kind = gvk.rsplit("/", 1)[-1]
+        if kind not in member._POD_KINDS:
+            continue
+        for obj in member.store.list(gvk):
+            # ready derives from the object already in hand — pod_metrics
+            # would rescan kinds() and deepcopy the same object again, on
+            # the fleet's hottest periodic path
+            key = workload_key(kind, obj.namespace, obj.name)
+            ready = member.ready_pods_of(obj)
+            usage = member.workload_usage.get(key)
+            seen.add(key)
+            if ready > 0 and usage:
+                rows.append(WorkloadMetricsRow(
+                    kind=kind, namespace=obj.namespace, name=obj.name,
+                    ready_pods=ready, usage=dict(usage),
+                ))
+            elif usage:
+                # zero ready pods but a live usage entry: report it as the
+                # demand signal (external traffic with nothing serving it)
+                rows.append(WorkloadMetricsRow(
+                    kind=kind, namespace=obj.namespace, name=obj.name,
+                    ready_pods=0, demand=dict(usage),
+                ))
+    # workloads scaled fully OFF the member (no object at all) can still
+    # have a demand feed registered — surface those too
+    for key, usage in member.workload_usage.items():
+        if key in seen or not usage:
+            continue
+        kind, ns, name = key.split("/", 2)
+        if kind not in member._POD_KINDS:
+            continue
+        rows.append(WorkloadMetricsRow(
+            kind=kind, namespace=ns, name=name, ready_pods=0,
+            demand=dict(usage),
+        ))
+    rows.sort(key=lambda r: (r.kind, r.namespace, r.name))
+    return WorkloadMetricsReport(
+        metadata=ObjectMeta(name=member.name),
+        cluster=member.name, rows=rows, reported_at=now,
+    )
+
+
+def publish_report(store, report: WorkloadMetricsReport, *,
+                   coalescer=None, cache: Optional[dict] = None) -> bool:
+    """Write a report unless it matches the last published one (change
+    suppression: reported_at alone never forces a write — freshness is the
+    resourceVersion's job). Returns True when a write was issued. With a
+    coalescer the write rides the agent-status batch buffer.
+
+    `cache` (cluster -> last published rows), when given, is the
+    comparison source: a long-lived writer (agent heartbeat, plane
+    collector) then suppresses without a store READ per sweep — over the
+    wire that read is a full round-trip per heartbeat, and it races the
+    coalescer's unflushed buffer (two sweeps inside one flush window both
+    see the stale stored report). Without a cache the stored report is
+    consulted (one-shot callers)."""
+    if cache is not None:
+        if cache.get(report.metadata.name) == report.rows:
+            return False
+    else:
+        existing = store.try_get(KIND_WORKLOAD_METRICS_REPORT,
+                                 report.metadata.name)
+        if existing is not None and existing.rows == report.rows:
+            return False
+    if coalescer is not None:
+        coalescer.apply(report)
+    else:
+        store.apply(report)
+    if cache is not None:
+        cache[report.metadata.name] = report.rows
+    return True
+
+
+__all__ = [
+    "AggregateView",
+    "UtilizationAggregator",
+    "build_metrics_report",
+    "publish_report",
+    "workload_key",
+]

@@ -1,0 +1,591 @@
+"""The elected-leader elasticity daemon: one vectorized step per tick.
+
+Closes the autoscaling feedback loop the per-object controllers never had:
+
+    agent status stream -> [W, C] utilization matrix -> ONE batched
+    target-tracking solve over ALL FederatedHPAs -> replica deltas through
+    one rv-checked update_batch cohort -> the streaming scheduler absorbs
+    the binding updates as ordinary admissions.
+
+Never a per-HPA loop: assembly is O(W) host work (resolving templates and
+requests, laying rows into the matrix), the SOLVE is one array evaluation
+(`solver.solve_step`), and emission is one transactional batch write. The
+hysteresis half (per-direction stabilization windows over a ring-buffered
+recommendation history) and CronFederatedHPA (folded in as min/max bound
+rows on the same matrix) ride the same step.
+
+Leadership: the daemon elects on the `karmada-elastic` LeaderLease through
+the plane's coordination layer — visible in `karmadactl elections`, fenced
+like every other daemon role. A non-leader tick is a no-op.
+
+Quota interplay: a scale-up whose namespace carries a FederatedResourceQuota
+with static assignments is previewed through the simulation plane (the same
+counterfactual solve `POST /simulate` serves) under the quota's capacity
+caps; a scale-up that would strand replicas is VETOED for the tick (counted
+under karmada_hpa_scale_events_total{direction="vetoed"}) instead of
+emitted — the elasticity plane never writes a replica count the placement
+plane cannot honor.
+"""
+from __future__ import annotations
+
+import time
+from typing import Optional
+
+import numpy as np
+
+from ..api.autoscaling import KIND_FEDERATED_HPA
+from ..controllers.autoscaling import (
+    HPA_TOLERANCE,
+    _find_template,
+)
+from ..coordination.elector import Elector, LocalLeaseClient, default_identity
+from ..metrics import (
+    elastic_loop_seconds,
+    elastic_solves,
+    hpa_desired_replicas,
+    hpa_scale_events,
+)
+from ..store.store import BatchError
+from ..utils.cron import CronParseError, CronSchedule
+from .aggregator import UtilizationAggregator, workload_key
+from .solver import RecommendationRing, empty_inputs, solve_step
+
+LEASE_ELASTIC = "karmada-elastic"
+
+
+class ElasticityDaemon:
+    def __init__(
+        self,
+        store,
+        clock=None,
+        *,
+        interpreter=None,
+        coordinator=None,
+        event_recorder=None,
+        hysteresis: bool = True,
+        preflight: bool = True,
+        tolerance: float = HPA_TOLERANCE,
+        history_depth: int = 128,
+        identity: Optional[str] = None,
+    ):
+        """`coordinator` (a LeaseCoordinator) turns on real leader election
+        on the karmada-elastic lease; None = lead unconditionally (bare
+        test topologies). `hysteresis=False` zeroes the stabilization
+        windows — the bench's oscillation-control counterfactual leg."""
+        from ..runtime.controller import Clock
+
+        self.store = store
+        self.clock = clock or Clock()
+        self.interpreter = interpreter
+        self.event_recorder = event_recorder
+        self.hysteresis = hysteresis
+        self.preflight = preflight
+        self.tolerance = tolerance
+        self.aggregator = UtilizationAggregator(store)
+        self.ring = RecommendationRing(history_depth) if hysteresis else None
+        self.elector = (
+            Elector(LocalLeaseClient(coordinator), LEASE_ELASTIC,
+                    identity or default_identity())
+            if coordinator is not None else None
+        )
+        self._last_cron: float = self.clock.now()
+        self._gauge_keys: set[str] = set()
+        self.stats: dict[str, int] = {
+            "ticks": 0, "solves": 0, "scale_ups": 0, "scale_downs": 0,
+            "vetoed": 0, "resurrected": 0, "writes": 0, "skipped_stale": 0,
+            "cron_fired": 0,
+        }
+        self.last_step_stats: dict = {}
+
+    @property
+    def is_leader(self) -> bool:
+        return self.elector is None or self.elector.is_leader
+
+    # -- cron fold ---------------------------------------------------------
+
+    def _fold_crons(self, now: float, hpas_by_key: dict):
+        """Evaluate every CronFederatedHPA rule that fired since the last
+        tick. FederatedHPA-targeted rules mutate that HPA's min/max (the
+        bound rows the matrix clamp applies this tick AND a durable spec
+        change riding the emission batch); workload-targeted rules become
+        one-tick pin rows (min = max = targetReplicas) so the same clamp —
+        not a separate reconcile path — realizes the cron scale."""
+        pins: dict[tuple[str, str, str], int] = {}
+        dirty_crons: list = []
+        dirty_hpas: list = []
+        fired = 0
+        for cron in self.store.list("CronFederatedHPA"):
+            changed = False
+            target = cron.spec.scale_target_ref
+            ns = cron.metadata.namespace
+            for rule in cron.spec.rules:
+                if rule.suspend:
+                    continue
+                try:
+                    sched = CronSchedule.parse(rule.schedule)
+                except CronParseError as e:
+                    changed |= self._record_cron(cron, rule.name, "Failed",
+                                                 str(e), None)
+                    continue
+                if not sched.fired_between(self._last_cron, now):
+                    continue
+                fired += 1
+                if target.kind == KIND_FEDERATED_HPA:
+                    hpa = hpas_by_key.get((ns, target.name))
+                    if hpa is None:
+                        changed |= self._record_cron(
+                            cron, rule.name, "Failed",
+                            f"FederatedHPA {target.name} not found", now)
+                        continue
+                    if rule.target_min_replicas is not None:
+                        hpa.spec.min_replicas = rule.target_min_replicas
+                    if rule.target_max_replicas is not None:
+                        hpa.spec.max_replicas = rule.target_max_replicas
+                    if not any(h is hpa for h in dirty_hpas):
+                        dirty_hpas.append(hpa)
+                    changed |= self._record_cron(
+                        cron, rule.name, "Succeed",
+                        "scaled FederatedHPA bounds", now)
+                elif rule.target_replicas is not None:
+                    pins[(target.kind, ns, target.name)] = rule.target_replicas
+                    changed |= self._record_cron(
+                        cron, rule.name, "Succeed",
+                        f"pinned to {rule.target_replicas}", now)
+                else:
+                    changed |= self._record_cron(
+                        cron, rule.name, "Failed",
+                        "rule has no workload target", now)
+            if changed:
+                dirty_crons.append(cron)
+        # NOTE: the caller advances self._last_cron only after the tick's
+        # batch lands — cron firings are edge-triggered, and an effect
+        # dropped by a stale-skip or batch abort must re-fire next tick
+        # (rules set absolute values, so a re-fire is idempotent)
+        return pins, dirty_crons, dirty_hpas, fired
+
+    @staticmethod
+    def _record_cron(cron, rule_name: str, result: str, message: str,
+                     ts) -> bool:
+        """Record a rule outcome in the execution history; returns whether
+        anything actually CHANGED — a persistently-unparseable schedule
+        must not rewrite an identical history to the store every tick."""
+        from ..api.autoscaling import ExecutionHistory
+
+        for h in cron.status.execution_histories:
+            if h.rule_name == rule_name:
+                changed = (h.last_result != result or h.message != message
+                           or (ts is not None
+                               and h.last_execution_time != ts))
+                h.last_result = result
+                h.message = message
+                if ts is not None:
+                    h.last_execution_time = ts
+                return changed
+        cron.status.execution_histories.append(ExecutionHistory(
+            rule_name=rule_name, last_result=result, message=message,
+            last_execution_time=ts,
+        ))
+        return True
+
+    def _event(self, row: dict, etype: str, reason: str,
+               message: str) -> None:
+        """Scale-event audit trail on the FederatedHPA (the reference
+        emits SuccessfulRescale the same way); no-op without a recorder."""
+        if self.event_recorder is None:
+            return
+        obj = row["hpa"] if row["hpa"] is not None else row["template"]
+        try:
+            self.event_recorder.event(obj, etype, reason, message)
+        except Exception:  # noqa: BLE001 - audit must never break the tick
+            pass
+
+    # -- quota/simulate preflight -----------------------------------------
+
+    def _preflight_vetoes(self, scale_ups: list[dict]) -> set[int]:
+        """Counterfactual solve of the POST-scale binding set under the
+        namespace FederatedResourceQuotas' capacity caps (the same engine
+        `POST /simulate` serves — no duplicated solve logic). Returns the
+        indices whose scale-up would strand replicas.
+
+        Scoped PER NAMESPACE, like the admission preflight: each quota'd
+        namespace is simulated separately against ITS caps — a quota-less
+        namespace is never vetoed (there is nothing to preflight against),
+        and one namespace's caps never compete with another's bindings.
+        Multiple quotas capping the same cluster combine as the MIN hard
+        value per (cluster, resource), never as summed deltas (the engine
+        applies capacity deltas cumulatively — summing would cap below
+        what every individual quota allows)."""
+        frqs_by_ns: dict[str, list] = {}
+        for frq in self.store.list("FederatedResourceQuota"):
+            if frq.spec.static_assignments:
+                frqs_by_ns.setdefault(frq.metadata.namespace, []).append(frq)
+        namespaces = sorted(
+            {su["namespace"] for su in scale_ups} & frqs_by_ns.keys()
+        )
+        if not namespaces:
+            return set()
+        from ..api.simulation import (
+            SCENARIO_CAPACITY,
+            SCENARIO_COMPOSITE,
+            Scenario,
+        )
+        from ..simulation.engine import Simulator
+        from ..simulation.report import fingerprint
+
+        clusters = sorted(self.store.list("Cluster"),
+                          key=lambda c: c.metadata.name)
+        if not clusters:
+            return set()
+        by_name = {c.metadata.name: c for c in clusters}
+        vetoed: set[int] = set()
+        for ns in namespaces:
+            # combined caps for this namespace: MIN hard per cluster/resource
+            hard: dict[tuple[str, str], float] = {}
+            for frq in frqs_by_ns[ns]:
+                for sa in frq.spec.static_assignments:
+                    for rname, h in sa.hard.items():
+                        k = (sa.cluster_name, rname)
+                        hard[k] = min(hard[k], h) if k in hard else h
+            steps = []
+            by_cluster: dict[str, dict[str, float]] = {}
+            for (cname, rname), h in hard.items():
+                c = by_name.get(cname)
+                if c is None or c.status.resource_summary is None:
+                    continue
+                rs = c.status.resource_summary
+                available = (rs.allocatable.get(rname, 0.0)
+                             - rs.allocated.get(rname, 0.0)
+                             - rs.allocating.get(rname, 0.0))
+                if h < available:
+                    by_cluster.setdefault(cname, {})[rname] = h - available
+            for cname in sorted(by_cluster):
+                steps.append(Scenario(kind=SCENARIO_CAPACITY, cluster=cname,
+                                      resources=by_cluster[cname]))
+            bindings = []
+            scaled: dict[str, tuple[int, int]] = {}  # rb key -> (idx, want)
+            for rb in self.store.list("ResourceBinding", ns):
+                if rb.metadata.deletion_timestamp is not None:
+                    continue
+                res = rb.spec.resource
+                for i, su in enumerate(scale_ups):
+                    if (su["namespace"] == ns and res.kind == su["kind"]
+                            and res.name == su["name"]
+                            and res.namespace == ns):
+                        rb.spec.replicas = su["desired"]
+                        scaled[rb.metadata.key()] = (i, su["desired"])
+                        break
+                bindings.append(rb)
+            if not scaled:
+                continue
+            scenarios = [Scenario(
+                kind=SCENARIO_COMPOSITE, steps=steps,
+                name=f"elastic-preflight({ns})",
+            )] if steps else []
+            sim = Simulator(clusters)
+            baseline, outcomes = sim.simulate(bindings, scenarios)
+            outcome = outcomes[0] if outcomes else baseline
+            for key, (idx, want) in scaled.items():
+                if key in outcome.errors:
+                    vetoed.add(idx)
+                    continue
+                placed = sum(
+                    r for _, r in fingerprint(outcome.placements.get(key))
+                )
+                if placed < want:
+                    vetoed.add(idx)
+        return vetoed
+
+    # -- the tick ----------------------------------------------------------
+
+    def step(self, now: Optional[float] = None) -> dict:
+        """One closed-loop tick: elect, aggregate, solve (one launch for
+        all W workloads), emit one batch. Returns the step stats."""
+        if self.elector is not None:
+            self.elector.step()
+        if not self.is_leader:
+            self.last_step_stats = {"leader": False}
+            return self.last_step_stats
+        t0 = time.perf_counter()
+        if now is None:
+            now = self.clock.now()
+
+        hpas = sorted(
+            self.store.list(KIND_FEDERATED_HPA),
+            key=lambda h: (h.metadata.namespace, h.metadata.name),
+        )
+        hpas_by_key = {(h.metadata.namespace, h.metadata.name): h
+                       for h in hpas}
+        pins, dirty_crons, dirty_hpas, cron_fired = self._fold_crons(
+            now, hpas_by_key)
+
+        # -- assembly: one row per scaled workload (O(W) host work) --------
+        rows: list[dict] = []
+        for hpa in hpas:
+            ns = hpa.metadata.namespace
+            target = hpa.spec.scale_target_ref
+            template = _find_template(self.store, target.kind, target.name, ns)
+            if template is None:
+                continue
+            request: dict[str, float] = {}
+            if self.interpreter is not None:
+                try:
+                    _, req = self.interpreter.get_replicas(template)
+                    if req is not None:
+                        request = req.resource_request
+                except KeyError:
+                    pass
+            rows.append({
+                "hpa": hpa, "template": template,
+                "kind": target.kind, "namespace": ns, "name": target.name,
+                "key": workload_key(target.kind, ns, target.name),
+                "current": int(template.get("spec", "replicas", default=1) or 0),
+                "request": request,
+                "metrics": list(hpa.spec.metrics),
+            })
+        # cron pin rows for workloads with no FederatedHPA: same matrix,
+        # min = max = pinned replicas, no metrics
+        covered = {(r["kind"], r["namespace"], r["name"]) for r in rows}
+        for (kind, ns, name), pinned in sorted(pins.items()):
+            if (kind, ns, name) in covered:
+                continue
+            template = _find_template(self.store, kind, name, ns)
+            if template is None:
+                continue
+            rows.append({
+                "hpa": None, "template": template,
+                "kind": kind, "namespace": ns, "name": name,
+                "key": workload_key(kind, ns, name),
+                "current": int(template.get("spec", "replicas", default=1) or 0),
+                "request": {}, "metrics": [],
+            })
+
+        w = len(rows)
+        m = max((len(r["metrics"]) for r in rows), default=0)
+        resources = sorted({
+            met.name for r in rows for met in r["metrics"]
+        })
+        # only READY members feed the matrix: a crashed/partitioned
+        # cluster's last retained report must stop counting the moment the
+        # failure detector flips its condition — phantom ready pods would
+        # hold the workload down while real traffic fails over
+        from ..api.cluster import cluster_ready
+
+        live = {
+            c.metadata.name for c in self.store.list("Cluster")
+            if cluster_ready(c)
+        }
+        view = self.aggregator.snapshot([r["key"] for r in rows], resources,
+                                        clusters=live)
+        avg_by_res = {res: view.avg_usage(res) for res in resources}
+        ready_total = view.ready_total()
+        demand_total = view.demand_total()
+
+        inp = empty_inputs(w, m)
+        for wi, r in enumerate(rows):
+            hpa = r["hpa"]
+            inp.current[wi] = r["current"]
+            inp.ready[wi] = ready_total[wi]
+            inp.demand[wi] = demand_total[wi]
+            pin = pins.get((r["kind"], r["namespace"], r["name"]))
+            if hpa is not None:
+                # None defaults to 1 — the SAME floor the admission webhook
+                # stamps, so behavior cannot diverge by creation path;
+                # scale-to-zero requires an EXPLICIT minReplicas 0
+                lo = hpa.spec.min_replicas
+                lo = 1 if lo is None else lo
+                inp.min_r[wi] = lo
+                inp.max_r[wi] = hpa.spec.max_replicas
+                inp.scale_to_zero[wi] = hpa.spec.scale_to_zero
+                b = hpa.spec.behavior
+                if self.hysteresis:
+                    inp.up_window[wi] = b.scale_up_stabilization_seconds
+                    inp.down_window[wi] = b.scale_down_stabilization_seconds
+            if pin is not None:
+                inp.min_r[wi] = pin
+                inp.max_r[wi] = pin
+            for mi, met in enumerate(r["metrics"]):
+                req = r["request"].get(met.name, 0.0)
+                if req <= 0:
+                    continue
+                inp.avg_usage[wi, mi] = avg_by_res[met.name][wi]
+                inp.request[wi, mi] = req
+                inp.target[wi, mi] = float(met.target_average_utilization)
+                inp.valid[wi, mi] = True
+
+        # -- the ONE vectorized solve --------------------------------------
+        result = solve_step(inp, self.ring, [r["key"] for r in rows], now,
+                            tolerance=self.tolerance)
+        elastic_solves.inc()
+
+        # -- emission: one rv-checked batch cohort -------------------------
+        desired = result.desired
+        changed = [
+            (wi, r) for wi, r in enumerate(rows)
+            if int(desired[wi]) != r["current"]
+        ]
+        scale_ups = [
+            {"kind": r["kind"], "namespace": r["namespace"],
+             "name": r["name"], "desired": int(desired[wi]), "wi": wi}
+            for wi, r in changed if int(desired[wi]) > r["current"]
+        ]
+        vetoed_idx: set[int] = set()
+        if self.preflight and scale_ups:
+            vetoed_wi = {
+                scale_ups[i]["wi"]
+                for i in self._preflight_vetoes(scale_ups)
+            }
+            vetoed_idx = vetoed_wi
+        batch: list = []
+        batch_ids: set[int] = set()
+
+        def _enlist(obj) -> None:
+            if id(obj) not in batch_ids:
+                batch_ids.add(id(obj))
+                batch.append(obj)
+
+        # objects carrying an edge-triggered cron effect: if any of their
+        # slots fails to commit, the cron window must NOT advance
+        cron_sensitive: set[int] = {id(o) for o in dirty_hpas}
+        cron_sensitive |= {id(o) for o in dirty_crons}
+
+        ups = downs = resurrected = 0
+        cron_effect_dropped = False
+        for wi, r in changed:
+            want = int(desired[wi])
+            pinned = pins.get((r["kind"], r["namespace"], r["name"]))
+            if pinned is not None:
+                cron_sensitive.add(id(r["template"]))
+            if wi in vetoed_idx:
+                if pinned is not None:
+                    # a vetoed cron pin never reaches the batch: hold the
+                    # evaluation window open so the fired rule re-applies
+                    # next tick instead of being lost until its next fire
+                    cron_effect_dropped = True
+                hpa_scale_events.inc(direction="vetoed")
+                self.stats["vetoed"] += 1
+                self._event(r, "Warning", "ScaleUpVetoed",
+                            f"scale-up to {want} would strand replicas "
+                            f"under the namespace quota; holding at "
+                            f"{r['current']}")
+                continue
+            r["template"].set("spec", "replicas", want)
+            _enlist(r["template"])
+            if want > r["current"]:
+                ups += 1
+                if r["current"] == 0:
+                    resurrected += 1
+                hpa_scale_events.inc(direction="up")
+            else:
+                downs += 1
+                hpa_scale_events.inc(direction="down")
+            self._event(r, "Normal", "SuccessfulRescale",
+                        f"scaled {r['key']} {r['current']} -> {want}")
+            if r["hpa"] is not None:
+                # enlist HERE: the status-refresh pass below only enlists
+                # on current/desired/util motion, and a scale whose status
+                # fields happen to already match (e.g. the tick after a
+                # lifted veto) would silently drop the timestamp
+                r["hpa"].status.last_scale_time = now
+                _enlist(r["hpa"])
+        # HPA status refresh (only objects whose status actually moved)
+        for wi, r in enumerate(rows):
+            hpa = r["hpa"]
+            if hpa is None:
+                continue
+            util = result.utilization[wi]
+            util_i = None if not np.isfinite(util) else int(util)
+            mi = int(result.utilization_metric[wi])
+            metric_name = (r["metrics"][mi].name
+                           if 0 <= mi < len(r["metrics"]) else "")
+            st = hpa.status
+            moved = (st.current_replicas != r["current"]
+                     or st.desired_replicas != int(desired[wi])
+                     or st.current_average_utilization != util_i
+                     or st.current_metric != metric_name)
+            st.current_replicas = r["current"]
+            st.desired_replicas = int(desired[wi])
+            st.current_average_utilization = util_i
+            st.current_metric = metric_name
+            if moved:
+                _enlist(hpa)
+            hpa_desired_replicas.set(float(desired[wi]), workload=r["key"])
+        for hpa in dirty_hpas:  # cron bound changes with no status motion
+            _enlist(hpa)
+        for cron in dirty_crons:
+            _enlist(cron)
+
+        skipped = 0
+        committed = 0
+        cron_landed = True
+        if batch:
+            try:
+                outs = self.store.update_batch(batch, skip_stale=True,
+                                               skip_missing=True)
+                skipped = sum(1 for o in outs if o is None)
+                committed = len(batch) - skipped
+                cron_landed = not any(
+                    outs[i] is None and id(batch[i]) in cron_sensitive
+                    for i in range(len(batch))
+                )
+            except BatchError:
+                # all-or-nothing abort (terminal neighbor): NOTHING was
+                # committed — level-triggered, the next tick re-derives it
+                skipped = len(batch)
+                cron_landed = not cron_sensitive
+        # template scales are level-triggered (re-derived every tick), but
+        # cron firings are EDGE-triggered: only advance the evaluation
+        # window once every fired rule's effect actually committed
+        if cron_landed and not cron_effect_dropped:
+            self._last_cron = now
+
+        # gauge hygiene: drop rows for workloads no longer scaled
+        keys_now = {r["key"] for r in rows}
+        for stale in self._gauge_keys - keys_now:
+            hpa_desired_replicas.remove(workload=stale)
+        self._gauge_keys = keys_now
+
+        wall = time.perf_counter() - t0
+        elastic_loop_seconds.observe(wall)
+        self.stats["ticks"] += 1
+        self.stats["solves"] += 1
+        self.stats["scale_ups"] += ups
+        self.stats["scale_downs"] += downs
+        self.stats["resurrected"] += resurrected
+        self.stats["writes"] += committed
+        self.stats["skipped_stale"] += skipped
+        self.stats["cron_fired"] += cron_fired
+        self.last_step_stats = {
+            "leader": True, "workloads": w, "solves": 1,
+            "scale_ups": ups, "scale_downs": downs,
+            "vetoed": len(vetoed_idx), "resurrected": resurrected,
+            "writes": committed, "skipped_stale": skipped,
+            "cron_fired": cron_fired, "wall_s": wall,
+        }
+        return self.last_step_stats
+
+    # -- daemon loop -------------------------------------------------------
+
+    def serve(self, interval: float = 1.0, should_stop=None) -> None:
+        """Run the tick loop until `should_stop()` — the standalone daemon
+        shape (the server daemon drives step() from its own ticker
+        instead)."""
+        while should_stop is None or not should_stop():
+            try:
+                self.step()
+            except Exception:  # noqa: BLE001 - keep the daemon alive
+                import logging
+
+                logging.getLogger(__name__).exception("elastic tick")
+            time.sleep(interval)
+
+    def status(self) -> dict:
+        """Observability snapshot (GET /elastic/status)."""
+        return {
+            "leader": self.is_leader,
+            "hysteresis": self.hysteresis,
+            "preflight": self.preflight,
+            **{k: int(v) for k, v in self.stats.items()},
+        }
+
+
+__all__ = ["ElasticityDaemon", "LEASE_ELASTIC"]

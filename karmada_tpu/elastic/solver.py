@@ -1,0 +1,219 @@
+"""The vectorized target-tracking step: ALL FederatedHPAs as one solve.
+
+The per-object controller (controllers/autoscaling.py A1) answers one HPA
+per reconcile; this module answers every scaled workload of the plane in
+ONE array evaluation per tick — the elasticity analogue of the scheduler's
+one-batched-launch invariant. The math is the kube HPA algorithm
+(`hpa_desired_replicas`) lifted to a [W, M] metric matrix, followed by the
+hysteresis half (per-direction stabilization windows as masked min/max
+over a ring-buffered recommendation history) and the min/max bound clamp
+(which is where CronFederatedHPA folds in: a fired cron rule IS a bound
+row on this matrix, never its own reconcile path).
+
+Bit parity with the scalar algorithm is pinned in tests/test_elastic.py:
+for every workload the vectorized raw recommendation equals
+`hpa_desired_replicas(...)` exactly, including tolerance-band and ceil
+edge cases — the float expressions are evaluated in the same order
+(usage/request*100, /target) so the roundings cannot diverge.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import numpy as np
+
+from ..controllers.autoscaling import HPA_TOLERANCE
+
+
+@dataclass
+class SolveInputs:
+    """One tick's assembled state for W workloads and up to M metrics each.
+    Everything the step needs, already matrix-shaped — assembly is O(W)
+    host work (like the scheduler's encoders); the SOLVE over it is one
+    vectorized evaluation regardless of W."""
+
+    current: np.ndarray        # [W] int   — template spec.replicas
+    ready: np.ndarray          # [W] int   — federation-wide ready pods
+    avg_usage: np.ndarray      # [W, M]    — per-pod usage per metric
+    request: np.ndarray        # [W, M]    — per-pod resource request
+    target: np.ndarray         # [W, M]    — target utilization percent
+    valid: np.ndarray          # [W, M] bool — metric resolved (request > 0)
+    demand: np.ndarray         # [W]       — zero-ready demand signal total
+    min_r: np.ndarray          # [W] int   — effective lower bound
+    max_r: np.ndarray          # [W] int   — effective upper bound
+    scale_to_zero: np.ndarray  # [W] bool
+    up_window: np.ndarray      # [W] float seconds (0 = immediate)
+    down_window: np.ndarray    # [W] float seconds
+
+
+@dataclass
+class SolveResult:
+    desired: np.ndarray        # [W] int — post-hysteresis, post-clamp
+    raw: np.ndarray            # [W] int — pre-hysteresis recommendation
+    utilization: np.ndarray    # [W] float — last valid metric's util % (nan)
+    utilization_metric: np.ndarray  # [W] int — its metric column (-1 none)
+
+
+class RecommendationRing:
+    """Ring-buffered recommendation history for the stabilization windows:
+    values [W_cap, H] + timestamps [W_cap, H], rows assigned per workload
+    key so the matrix survives HPAs coming and going. Freed rows are
+    recycled (reset to -inf timestamps, so stale history can never leak
+    into a new workload's window)."""
+
+    def __init__(self, depth: int = 128):
+        self.depth = max(2, int(depth))
+        self._vals = np.zeros((0, self.depth), dtype=np.float64)
+        self._ts = np.full((0, self.depth), -np.inf, dtype=np.float64)
+        self._row_of: dict[str, int] = {}
+        self._free: list[int] = []
+        self._ptr = 0
+
+    def _grow(self, n: int) -> None:
+        extra_v = np.zeros((n, self.depth), dtype=np.float64)
+        extra_t = np.full((n, self.depth), -np.inf, dtype=np.float64)
+        base = self._vals.shape[0]
+        self._vals = np.concatenate([self._vals, extra_v], axis=0)
+        self._ts = np.concatenate([self._ts, extra_t], axis=0)
+        self._free.extend(range(base, base + n))
+
+    def rows_for(self, keys: list[str]) -> np.ndarray:
+        """Row indices for `keys`, assigning fresh rows to new workloads
+        and recycling rows whose workloads vanished."""
+        want = set(keys)
+        for k in [k for k in self._row_of if k not in want]:
+            row = self._row_of.pop(k)
+            self._ts[row, :] = -np.inf
+            self._free.append(row)
+        missing = [k for k in keys if k not in self._row_of]
+        if len(missing) > len(self._free):
+            self._grow(max(len(missing) - len(self._free), 16))
+        for k in missing:
+            self._row_of[k] = self._free.pop()
+        return np.array([self._row_of[k] for k in keys], dtype=np.int64)
+
+    def record(self, rows: np.ndarray, rec: np.ndarray, now: float) -> None:
+        self._vals[rows, self._ptr] = rec
+        self._ts[rows, self._ptr] = now
+        self._ptr = (self._ptr + 1) % self.depth
+
+    def window_bounds(self, rows: np.ndarray, rec_now: np.ndarray,
+                      now: float, up_window: np.ndarray,
+                      down_window: np.ndarray) -> tuple[np.ndarray, np.ndarray]:
+        """(up_rec, down_rec) per workload: the min recommendation inside
+        the up window and the max inside the down window, each seeded with
+        the CURRENT recommendation (kube's stabilizeRecommendation...).
+        One masked reduction over the whole [W, H] ring — no per-HPA
+        loop."""
+        ts = self._ts[rows]            # [W, H]
+        vals = self._vals[rows]        # [W, H]
+        up_mask = ts >= (now - up_window)[:, None]
+        down_mask = ts >= (now - down_window)[:, None]
+        up_rec = np.minimum(
+            rec_now, np.min(np.where(up_mask, vals, np.inf), axis=1)
+        )
+        down_rec = np.maximum(
+            rec_now, np.max(np.where(down_mask, vals, -np.inf), axis=1)
+        )
+        return up_rec, down_rec
+
+
+def solve_step(inp: SolveInputs, ring: RecommendationRing | None,
+               keys: list[str], now: float,
+               tolerance: float = HPA_TOLERANCE) -> SolveResult:
+    """One tick, all workloads: raw target-tracking recommendation ->
+    (optional) hysteresis stabilization -> bound clamp. `ring is None`
+    disables the hysteresis half (the bench's no-hysteresis leg)."""
+    current = inp.current.astype(np.float64)
+    ready = inp.ready.astype(np.float64)
+
+    # -- per-metric proposals, same expression order as the scalar path --
+    with np.errstate(divide="ignore", invalid="ignore"):
+        utilization = inp.avg_usage / inp.request * 100.0      # [W, M]
+        ratio = utilization / inp.target
+    within_tol = np.abs(ratio - 1.0) <= tolerance
+    proposal = np.where(
+        within_tol, current[:, None], np.ceil(ready[:, None] * ratio)
+    )
+    valid = inp.valid & np.isfinite(proposal)
+    # max across valid metric proposals; no valid metric -> hold current
+    raw = np.max(np.where(valid, proposal, -np.inf), axis=1)
+    has_metric = valid.any(axis=1)
+    raw = np.where(has_metric, raw, current)
+    # desired <= 0 collapses to current — EXCEPT for scale-to-zero
+    # workloads, whose zero-utilization recommendation really is 0
+    raw = np.where(raw > 0, raw, np.where(inp.scale_to_zero, 0.0, current))
+    # scalar parity: current <= 0 holds (an already-scaled-to-zero
+    # workload has no pod metrics to track), and so does ready == 0 with
+    # replicas in flight (the members haven't started the pods yet —
+    # recommending from an empty matrix would scale on noise)
+    raw = np.where((current <= 0) | (inp.ready <= 0), current, raw)
+    # cold resurrection is the only way out of zero: the demand signal
+    # (queue depth / external traffic at zero ready pods) wakes the
+    # workload at one-or-min replicas; the next ticks right-size it and
+    # the streaming scheduler re-admits the binding like any other write
+    resurrect = (current <= 0) & (inp.ready <= 0) & (inp.demand > 0.0)
+    raw = np.where(resurrect, np.maximum(1.0, inp.min_r), raw)
+
+    # utilization seen: the LAST valid metric's percent (scalar parity)
+    m = inp.avg_usage.shape[1]
+    any_valid = inp.valid.any(axis=1)
+    last_valid = np.where(
+        any_valid, m - 1 - np.argmax(inp.valid[:, ::-1], axis=1), 0
+    )
+    util_seen = np.where(
+        any_valid, utilization[np.arange(len(keys)), last_valid], np.nan,
+    )
+    util_metric = np.where(any_valid, last_valid, -1).astype(np.int64)
+
+    # bound clamp BEFORE the ring: recommendations entering the history are
+    # already feasible, so a bound change acts on the whole window at once
+    raw = np.clip(raw, inp.min_r, inp.max_r)
+
+    if ring is None:
+        return SolveResult(desired=raw.astype(np.int64),
+                           raw=raw.astype(np.int64), utilization=util_seen,
+                           utilization_metric=util_metric)
+
+    rows = ring.rows_for(keys)
+    up_rec, down_rec = ring.window_bounds(
+        rows, raw, now, inp.up_window, inp.down_window
+    )
+    ring.record(rows, raw, now)
+    # kube stabilization: start from current, raise to at least the up
+    # window's min, lower to at most the down window's max
+    stabilized = np.minimum(np.maximum(current, up_rec), down_rec)
+    desired = np.clip(stabilized, inp.min_r, inp.max_r)
+    return SolveResult(desired=desired.astype(np.int64),
+                       raw=raw.astype(np.int64), utilization=util_seen,
+                       utilization_metric=util_metric)
+
+
+def empty_inputs(w: int, m: int) -> SolveInputs:
+    """Allocate a zeroed [W, M] input block (assembly fills it in place).
+    M is floored to 1 so the metric-axis reductions stay well-defined for
+    HPAs that currently declare no metrics."""
+    m = max(1, m)
+    return SolveInputs(
+        current=np.zeros(w, dtype=np.int64),
+        ready=np.zeros(w, dtype=np.int64),
+        avg_usage=np.zeros((w, m), dtype=np.float64),
+        request=np.zeros((w, m), dtype=np.float64),
+        target=np.full((w, m), 100.0, dtype=np.float64),
+        valid=np.zeros((w, m), dtype=bool),
+        demand=np.zeros(w, dtype=np.float64),
+        min_r=np.ones(w, dtype=np.int64),
+        max_r=np.ones(w, dtype=np.int64),
+        scale_to_zero=np.zeros(w, dtype=bool),
+        up_window=np.zeros(w, dtype=np.float64),
+        down_window=np.zeros(w, dtype=np.float64),
+    )
+
+
+__all__ = [
+    "RecommendationRing",
+    "SolveInputs",
+    "SolveResult",
+    "empty_inputs",
+    "solve_step",
+]

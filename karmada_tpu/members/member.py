@@ -81,6 +81,25 @@ class InMemoryMember:
         """Set simulated per-pod usage for a workload (metrics-server feed)."""
         self.workload_usage[f"{kind}/{namespace}/{name}"] = dict(usage)
 
+    @staticmethod
+    def ready_pods_of(obj: Unstructured) -> int:
+        """Ready-pod count from a workload object already in hand (callers
+        holding the object skip the kind-rescan + deepcopy of
+        pod_metrics). Per-kind pod count: workloads report readyReplicas;
+        Jobs report active/succeeded; DaemonSets numberReady; a bare Pod
+        is one pod while running."""
+        st = obj.get("status") or {}
+        kind = obj.kind
+        if "readyReplicas" in st:
+            return int(st.get("readyReplicas") or 0)
+        if kind == "Job":
+            return int(st.get("active") or 0) + int(st.get("succeeded") or 0)
+        if kind == "DaemonSet":
+            return int(st.get("numberReady") or 0)
+        if kind == "Pod":
+            return 1 if st.get("phase") in ("Running", "Succeeded") else 0
+        return 0
+
     def pod_metrics(self, kind: str, namespace: str, name: str):
         """(ready_pods, per-pod usage dict or None) for a workload."""
         obj = None
@@ -91,21 +110,8 @@ class InMemoryMember:
                     break
         if obj is None:
             return 0, None
-        st = obj.get("status") or {}
-        # per-kind pod count: workloads report readyReplicas; Jobs report
-        # active/succeeded; DaemonSets numberReady; a bare Pod is one pod
-        # while running
-        if "readyReplicas" in st:
-            ready = int(st.get("readyReplicas") or 0)
-        elif kind == "Job":
-            ready = int(st.get("active") or 0) + int(st.get("succeeded") or 0)
-        elif kind == "DaemonSet":
-            ready = int(st.get("numberReady") or 0)
-        elif kind == "Pod":
-            ready = 1 if st.get("phase") in ("Running", "Succeeded") else 0
-        else:
-            ready = 0
-        return ready, self.workload_usage.get(f"{kind}/{namespace}/{name}")
+        return (self.ready_pods_of(obj),
+                self.workload_usage.get(f"{kind}/{namespace}/{name}"))
 
     # -- metrics feeds (what a real member's metrics-server and
     # custom-metrics pipeline would serve; queried by the metrics adapter) --

@@ -397,6 +397,32 @@ leader_election_renew_duration = registry.histogram(
 )
 
 
+# elasticity plane (elastic/ — docs/ELASTICITY.md): the closed autoscaling
+# loop. Desired replicas per scaled workload (Gauge rows removed when the
+# FederatedHPA goes away), scale events by direction (up/down — a vetoed
+# scale-up counts under `vetoed` instead of mutating anything), and the
+# wall seconds of one vectorized step — aggregate + solve + emit for ALL
+# W workloads (the one-launch invariant: karmada_elastic_solves_total
+# advances by exactly 1 per tick regardless of W)
+hpa_desired_replicas = registry.gauge(
+    "karmada_hpa_desired_replicas",
+    "Desired replicas per FederatedHPA-scaled workload",
+)
+hpa_scale_events = registry.counter(
+    "karmada_hpa_scale_events_total",
+    "Replica scale events emitted by the elasticity daemon, by direction "
+    "(up/down/vetoed)",
+)
+elastic_loop_seconds = registry.histogram(
+    "karmada_elastic_loop_seconds",
+    "Wall seconds per elasticity tick (aggregate + vectorized solve + "
+    "batched emission for all workloads)",
+)
+elastic_solves = registry.counter(
+    "karmada_elastic_solves_total",
+    "Vectorized elasticity solves (one per tick covers ALL workloads)",
+)
+
 # fault-tolerance plane (faults/ — docs/ROBUSTNESS.md): degraded rounds are
 # schedule rounds that completed as ONE batched launch while at least one
 # member's breaker was open (stale estimator rows stayed in the matrix with

@@ -27,6 +27,13 @@ def main() -> None:
                     help="seconds between timer-loop fires; 0 disables")
     ap.add_argument("--controllers", default="*",
                     help="comma list, reference --controllers semantics")
+    ap.add_argument("--elastic", action="store_true",
+                    help="run the closed-loop elasticity plane (docs/"
+                         "ELASTICITY.md): member utilization reports + an "
+                         "elected daemon solving ALL FederatedHPAs as one "
+                         "vectorized step per tick (replaces the per-object "
+                         "FHPA/Cron reconcile loops). Equivalent to adding "
+                         "'elasticity' to --controllers")
     ap.add_argument("--platform", default="",
                     help="pin the jax platform (e.g. cpu); default = the "
                          "ambient backend (TPU where available)")
@@ -182,6 +189,8 @@ def main() -> None:
     # mint a local rv and fork the replicated log. An empty list (not
     # [""], which the name validation rejects) disables them all.
     controllers = [] if args.follower else args.controllers.split(",")
+    if args.elastic and not args.follower and "elasticity" not in controllers:
+        controllers.append("elasticity")
     cp = ControlPlane(
         controllers=controllers,
         estimator_workers=args.estimator_workers or None,

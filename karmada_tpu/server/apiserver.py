@@ -463,6 +463,17 @@ class ControlPlaneServer:
     def _h_GET_healthz(self, h, q):
         self._send(h, 200, {"ok": True})
 
+    def _h_GET_elastic_status(self, h, q):
+        """Elasticity-daemon observability (docs/ELASTICITY.md): leadership,
+        hysteresis/preflight config, and the cumulative tick counters
+        (solves advance 1 per tick regardless of workload count)."""
+        el = getattr(self.cp, "elasticity", None)
+        if el is None:
+            self._send(h, 404, {"error": "elasticity plane not enabled "
+                                         "(start with --elastic)"})
+            return
+        self._send(h, 200, el.status())
+
     def _h_GET_kinds(self, h, q):
         self._send(h, 200, {"kinds": self.cp.store.kinds()})
 

@@ -120,6 +120,7 @@ class Store:
     def __init__(self) -> None:
         self._lock = threading.RLock()
         self._buckets: dict[str, _Bucket] = {}
+        self._kinds_token = 0
         self._rv = 0
         self._all_watchers: list[Callable[[str, str, Any], None]] = []
         # event sinks run UNDER the mutation lock, at the point the rv is
@@ -183,6 +184,9 @@ class Store:
         if b is None:
             b = _Bucket(objects={}, watchers=[])
             self._buckets[kind] = b
+            # kind registration bumps the token so kind-set caches (the
+            # autoscaling template index) invalidate without re-listing
+            self._kinds_token += 1
         return b
 
     @staticmethod
@@ -387,6 +391,14 @@ class Store:
     def kinds(self) -> list[str]:
         with self._lock:
             return list(self._buckets.keys())
+
+    @property
+    def kinds_token(self) -> int:
+        """Monotonic counter bumped on every kind (bucket) registration.
+        A cache keyed on kinds() content revalidates with one int compare
+        instead of re-listing every kind per lookup."""
+        with self._lock:
+            return self._kinds_token
 
     def update(self, obj: Any, *, check_rv: bool = False) -> Any:
         """Update; bumps generation if the spec view changed. Finalizer-gated

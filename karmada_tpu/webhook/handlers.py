@@ -203,8 +203,11 @@ def _validate_federated_resource_quota(req: AdmissionRequest) -> None:
 
 def _mutate_federated_hpa(req: AdmissionRequest):
     hpa = req.obj
-    if hpa.spec.min_replicas is None or hpa.spec.min_replicas < 1:
-        hpa.spec.min_replicas = 1
+    # HPAScaleToZero analogue: an explicit minReplicas 0 is legal only when
+    # the spec opted into scale-to-zero; everything else defaults up to 1
+    floor = 0 if getattr(hpa.spec, "scale_to_zero", False) else 1
+    if hpa.spec.min_replicas is None or hpa.spec.min_replicas < floor:
+        hpa.spec.min_replicas = max(floor, 1) if hpa.spec.min_replicas is None else floor
     return hpa
 
 

@@ -1,0 +1,66 @@
+#!/usr/bin/env bash
+# Elasticity-plane smoke: the closed autoscaling loop against the live
+# daemon topology (ROADMAP item 4 / docs/ELASTICITY.md). Single-shot: runs
+# the `elastic` bench config — a seeded diurnal-traffic replay (spike,
+# plateau, trough with scale-to-zero, resurrection, flap) driven through
+# member reports -> the elasticity daemon's ONE vectorized step per tick ->
+# template replica deltas -> streaming-scheduler admission — twice on the
+# same trace (hysteresis on / off) and asserts the acceptance booleans the
+# JSON line carries:
+#   pass_slo            metric-spike -> replicas-placed p99 under the SLO,
+#                       every spiked workload fully placed
+#   pass_oscillation    the hysteresis leg emits >= 5x fewer scale events
+#                       than the no-hysteresis leg on the same trace
+#   pass_one_launch     the vectorized step runs as ONE launch for all W
+#                       workloads every tick (no per-HPA solve loop)
+#   pass_scale_to_zero  the scale-to-zero subset reaches 0 replicas and
+#                       resurrects through ordinary scheduler admission
+# Exit 0 prints "ELASTIC OK".
+#
+# Wired into the slow path as
+# tests/test_elastic.py::TestElasticSmokeScript (pytest -m slow).
+# Runs on CPU; the placement half rides the scheduler's CPU fallback.
+set -euo pipefail
+
+cd "$(dirname "$0")/.."
+PY=${PYTHON:-python}
+WORK=$(mktemp -d /tmp/elastic_smoke.XXXXXX)
+trap 'rm -rf "$WORK"' EXIT
+
+log() { echo "elastic_smoke: $*"; }
+
+JAX_PLATFORMS=cpu $PY bench.py --inner --platform cpu --configs elastic \
+    --verbose > "$WORK/out.txt" 2> "$WORK/err.txt" \
+    || { log "bench failed"; cat "$WORK/err.txt"; exit 1; }
+
+LINE=$(grep -E '^\{' "$WORK/out.txt" | tail -1)
+[ -n "$LINE" ] || { log "no JSON line emitted"; cat "$WORK/out.txt"; exit 1; }
+log "result: $LINE"
+
+ELASTIC_LINE="$LINE" $PY - <<'PYEOF'
+import json
+import os
+import sys
+
+rec = json.loads(os.environ["ELASTIC_LINE"])
+for key in ("pass_slo", "pass_oscillation", "pass_one_launch",
+            "pass_scale_to_zero", "pass"):
+    if not rec.get(key):
+        print(f"elastic_smoke: criterion {key} FAILED "
+              f"(p99={rec.get('value')}s slo={rec.get('slo_s')}s, "
+              f"oscillation_ratio={rec.get('oscillation_ratio')}x, "
+              f"hyst={rec.get('hysteresis_leg')}, "
+              f"nohyst={rec.get('no_hysteresis_leg')})", file=sys.stderr)
+        sys.exit(1)
+h = rec["hysteresis_leg"]
+print(f"elastic_smoke: spike->placed p99 {rec['value']}s "
+      f"(SLO {rec['slo_s']}s), "
+      f"{rec['no_hysteresis_leg']['scale_events']} vs "
+      f"{h['scale_events']} scale events "
+      f"({rec['oscillation_ratio']}x fewer with hysteresis), "
+      f"{h['zero_scaled']}/{h['zero_subset']} scaled to zero and "
+      f"{h['resurrected']} resurrected, "
+      f"{h['solves']} solves over {h['ticks']} ticks")
+PYEOF
+
+echo "ELASTIC OK"

@@ -131,6 +131,13 @@ def main() -> None:
     # so the committed artifact carries the acceptance booleans)
     artifact["runs"].append(run_bench(
         ["--configs", "replica", "--run-timeout", "600"], 700))
+    # closed-loop elasticity: the seeded diurnal replay against the live
+    # streaming-scheduler + elasticity-daemon topology — spike->placed p99
+    # vs the SLO, hysteresis-vs-not oscillation counts, one-vectorized-
+    # launch-per-tick accounting (captured so the committed artifact
+    # carries the acceptance booleans alongside the device numbers)
+    artifact["runs"].append(run_bench(
+        ["--configs", "elastic", "--run-timeout", "600"], 700))
     # the Go-interop seam: /v1/scheduleBatch latency at flagship scale
     artifact["runs"].append(run_script(
         "scripts/bench_shim.py",

@@ -1,0 +1,1034 @@
+"""Closed-loop elasticity plane (karmada_tpu/elastic — docs/ELASTICITY.md).
+
+Runs without the cryptography stack: the topologies here are bare Store +
+InMemoryMember fleets (like tests/test_watchcache.py's stub plane), with
+Duplicated member semantics simulated by the `_Plane` helper and the real
+streaming scheduler attached where re-admission is the claim under test.
+"""
+from __future__ import annotations
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from karmada_tpu.api.autoscaling import (
+    CronFederatedHPA,
+    CronFederatedHPARule,
+    CronFederatedHPASpec,
+    FederatedHPA,
+    FederatedHPASpec,
+    HPABehavior,
+    KIND_WORKLOAD_METRICS_REPORT,
+    ResourceMetricSource,
+    ScaleTargetRef,
+)
+from karmada_tpu.api.meta import CPU, ObjectMeta, new_uid
+from karmada_tpu.controllers.autoscaling import (
+    HPA_TOLERANCE,
+    _template_kinds,
+    hpa_desired_replicas,
+)
+from karmada_tpu.elastic import (
+    ElasticityDaemon,
+    build_metrics_report,
+    publish_report,
+    solve_step,
+    workload_key,
+)
+from karmada_tpu.elastic.solver import empty_inputs
+from karmada_tpu.interpreter.interpreter import ResourceInterpreter
+from karmada_tpu.members.member import (
+    InMemoryMember,
+    MemberConfig,
+    cluster_object_for,
+)
+from karmada_tpu.runtime.controller import Clock
+from karmada_tpu.store.store import Store
+from karmada_tpu.testing.fixtures import new_deployment
+
+
+def fhpa(name="hpa", target="web", ns="default", min_r=1, max_r=10,
+         target_util=50, scale_to_zero=False, up_s=0.0, down_s=0.0):
+    return FederatedHPA(
+        metadata=ObjectMeta(name=name, namespace=ns),
+        spec=FederatedHPASpec(
+            scale_target_ref=ScaleTargetRef(kind="Deployment", name=target),
+            min_replicas=min_r, max_replicas=max_r,
+            metrics=[ResourceMetricSource(
+                name="cpu", target_average_utilization=target_util)],
+            behavior=HPABehavior(
+                scale_up_stabilization_seconds=up_s,
+                scale_down_stabilization_seconds=down_s,
+            ),
+            scale_to_zero=scale_to_zero,
+        ),
+    )
+
+
+def _divided_placement():
+    from karmada_tpu.api.policy import (
+        DIVISION_PREFERENCE_WEIGHTED,
+        DYNAMIC_WEIGHT_AVAILABLE_REPLICAS,
+        ClusterAffinity,
+        ClusterPreferences,
+        Placement,
+        REPLICA_SCHEDULING_DIVIDED,
+        ReplicaSchedulingStrategy,
+    )
+
+    return Placement(
+        cluster_affinity=ClusterAffinity(cluster_names=[]),
+        replica_scheduling=ReplicaSchedulingStrategy(
+            replica_scheduling_type=REPLICA_SCHEDULING_DIVIDED,
+            replica_division_preference=DIVISION_PREFERENCE_WEIGHTED,
+            weight_preference=ClusterPreferences(
+                dynamic_weight=DYNAMIC_WEIGHT_AVAILABLE_REPLICAS),
+        ),
+    )
+
+
+class _Plane:
+    """Crypto-free mini control plane: bare store, in-memory members with
+    Duplicated semantics (every member runs each template's replica count),
+    a closed-loop demand model (per-pod usage = total demand / total ready,
+    so scaling actually RELIEVES utilization), and the elasticity daemon."""
+
+    def __init__(self, n_members=2, hysteresis=True, preflight=False,
+                 ns="default", **daemon_kw):
+        self.ns = ns
+        self.clock = Clock(fixed=1_700_000_000.0)
+        self.store = Store()
+        self.members: dict[str, InMemoryMember] = {}
+        for i in range(n_members):
+            cfg = MemberConfig(name=f"m{i + 1}",
+                               allocatable={"cpu": 100.0, "pods": 500.0})
+            m = InMemoryMember(cfg)
+            self.members[cfg.name] = m
+            self.store.create(cluster_object_for(cfg))
+        self.daemon = ElasticityDaemon(
+            self.store, self.clock, interpreter=ResourceInterpreter(),
+            hysteresis=hysteresis, preflight=preflight, **daemon_kw,
+        )
+        self.demand: dict[str, float] = {}  # template name -> total demand
+
+    def add_workload(self, name="web", replicas=2, cpu=1.0, ns=None):
+        dep = new_deployment(ns or self.ns, name, replicas=replicas, cpu=cpu)
+        self.store.create(dep)
+        return dep
+
+    def set_usage(self, name, cpu, ns=None):
+        """Open-loop per-pod usage (mirrors member.set_workload_usage)."""
+        for m in self.members.values():
+            m.set_workload_usage("Deployment", ns or self.ns, name,
+                                 {"cpu": cpu})
+
+    def ready_total(self, name, ns=None) -> int:
+        total = 0
+        for m in self.members.values():
+            ready, _ = m.pod_metrics("Deployment", ns or self.ns, name)
+            total += ready
+        return total
+
+    def _sync_members(self):
+        for dep in self.store.list("apps/v1/Deployment"):
+            man = dep.to_dict()
+            man.pop("status", None)
+            for m in self.members.values():
+                m.apply_manifest(man)
+
+    def collect(self):
+        for m in self.members.values():
+            publish_report(self.store,
+                           build_metrics_report(m, self.clock.now()))
+
+    def tick(self, seconds=1.0):
+        """Advance time, converge members, apply the demand model, publish
+        reports, run ONE daemon step."""
+        if seconds:
+            self.clock.advance(seconds)
+        self._sync_members()
+        for name, demand in self.demand.items():
+            ready = self.ready_total(name)
+            self.set_usage(name, demand / max(ready, 1))
+        self.collect()
+        return self.daemon.step()
+
+    def replicas(self, name="web", ns=None) -> int:
+        dep = self.store.get("apps/v1/Deployment", name, ns or self.ns)
+        return int(dep.get("spec", "replicas"))
+
+
+# -- satellite: template-kind index ----------------------------------------
+
+
+class TestTemplateKindIndex:
+    def test_lookup_cached_until_kind_registration(self):
+        store = Store()
+        store.create(new_deployment("default", "a"))
+        calls = {"n": 0}
+        orig = store.kinds
+
+        def counting_kinds():
+            calls["n"] += 1
+            return orig()
+
+        store.kinds = counting_kinds
+        assert _template_kinds(store, "Deployment") == ["apps/v1/Deployment"]
+        warm = calls["n"]
+        for _ in range(50):
+            assert _template_kinds(store, "Deployment") == [
+                "apps/v1/Deployment"
+            ]
+        # HPA reconciles stop being O(kinds) store scans: repeated lookups
+        # answer from the index, not a rescan
+        assert calls["n"] == warm
+
+        # kind registration invalidates: a new gvk bucket must surface
+        from karmada_tpu.api.unstructured import Unstructured
+
+        store.create(Unstructured({
+            "apiVersion": "batch/v1", "kind": "Deployment",
+            "metadata": {"namespace": "default", "name": "other"},
+            "spec": {},
+        }))
+        got = _template_kinds(store, "Deployment")
+        assert sorted(got) == ["apps/v1/Deployment", "batch/v1/Deployment"]
+        assert calls["n"] > warm
+
+    def test_index_is_per_store(self):
+        s1, s2 = Store(), Store()
+        s1.create(new_deployment("d", "a"))
+        assert _template_kinds(s1, "Deployment") == ["apps/v1/Deployment"]
+        assert _template_kinds(s2, "Deployment") == []
+
+
+# -- satellite: vectorized/scalar bit parity -------------------------------
+
+
+def _scalar_reference(current, ready, rows, lo, hi):
+    """The per-object FederatedHPAController answer: the factored scalar
+    algorithm + the reconcile clamp (ready==0 / current<=0 hold first)."""
+    if current <= 0 or ready <= 0:
+        desired = current
+    else:
+        desired, _ = hpa_desired_replicas(current, ready, rows)
+    return max(lo, min(desired, hi))
+
+
+class TestVectorizedParity:
+    def test_randomized_sweep_matches_per_hpa_algorithm(self):
+        """W x C randomized sweep: the vectorized step's desired replicas
+        are IDENTICAL to the existing per-HPA algorithm for every workload
+        — tolerance band, min/max clamp, and ceil edge cases included."""
+        rng = np.random.default_rng(7)
+        for trial in range(4):
+            w = 257
+            m = 3
+            current = rng.integers(0, 40, size=w)
+            ready = rng.integers(0, 120, size=w)
+            lo = rng.integers(1, 5, size=w)
+            hi = lo + rng.integers(0, 60, size=w)
+            inp = empty_inputs(w, m)
+            inp.current[:] = current
+            inp.ready[:] = ready
+            inp.min_r[:] = lo
+            inp.max_r[:] = hi
+            scalar_rows: list[list[tuple]] = [[] for _ in range(w)]
+            for wi in range(w):
+                n_metrics = int(rng.integers(0, m + 1))
+                for mi in range(n_metrics):
+                    req = float(rng.choice([0.25, 0.5, 1.0, 2.0]))
+                    target = float(rng.choice([50, 60, 80, 100]))
+                    kind = rng.integers(0, 5)
+                    if kind == 0:   # exactly on-target (inside tolerance)
+                        avg = req * target / 100.0
+                    elif kind == 1:  # exactly AT the tolerance edge
+                        avg = req * target / 100.0 * (1.0 + HPA_TOLERANCE)
+                    elif kind == 2:  # ceil edge: ready*ratio lands integer
+                        avg = req * target / 100.0 * 2.0
+                    elif kind == 3:  # zero usage
+                        avg = 0.0
+                    else:
+                        avg = float(rng.uniform(0.0, 3.0)) * req
+                    inp.avg_usage[wi, mi] = avg
+                    inp.request[wi, mi] = req
+                    inp.target[wi, mi] = target
+                    inp.valid[wi, mi] = True
+                    scalar_rows[wi].append((avg, req, target))
+            got = solve_step(inp, None, [f"w{i}" for i in range(w)],
+                             now=0.0).desired
+            want = np.array([
+                _scalar_reference(int(current[wi]), int(ready[wi]),
+                                  scalar_rows[wi], int(lo[wi]), int(hi[wi]))
+                for wi in range(w)
+            ])
+            assert (got == want).all(), (
+                f"trial {trial}: mismatch rows "
+                f"{np.nonzero(got != want)[0][:5]}"
+            )
+
+    def test_closed_loop_matches_controller_numbers(self):
+        """End to end through reports + matrix: the exact numbers the
+        per-object controller suite pins (4 ready at 90% vs target 50 ->
+        8; within-tolerance holds; min clamp)."""
+        p = _Plane()
+        p.add_workload("web", replicas=2, cpu=1.0)
+        p.store.create(fhpa(target_util=50))
+        p._sync_members()
+        p.set_usage("web", 0.9)
+        p.collect()
+        p.daemon.step()
+        assert p.replicas("web") == 8  # ready 4, ratio 1.8 -> ceil(4*1.8)
+        hpa = p.store.get("FederatedHPA", "hpa", "default")
+        assert hpa.status.desired_replicas == 8
+        assert hpa.status.current_average_utilization == 90
+
+    def test_within_tolerance_holds(self):
+        p = _Plane()
+        p.add_workload("web", replicas=2, cpu=1.0)
+        p.store.create(fhpa(target_util=50))
+        p._sync_members()
+        p.set_usage("web", 0.52)  # 4% over target < 10% tolerance
+        p.collect()
+        p.daemon.step()
+        assert p.replicas("web") == 2
+        assert p.daemon.stats["scale_ups"] == 0
+
+    def test_min_clamp(self):
+        p = _Plane()
+        p.add_workload("web", replicas=4, cpu=1.0)
+        p.store.create(fhpa(min_r=2, target_util=80))
+        p._sync_members()
+        p.set_usage("web", 0.05)
+        p.collect()
+        p.daemon.step()
+        assert p.replicas("web") == 2
+
+    def test_one_vectorized_launch_for_all_workloads(self):
+        """W workloads cost ONE solve launch per tick — never a per-HPA
+        loop."""
+        import karmada_tpu.elastic.daemon as daemon_mod
+
+        p = _Plane()
+        w = 17
+        for i in range(w):
+            p.add_workload(f"app-{i}", replicas=2, cpu=1.0)
+            p.store.create(fhpa(name=f"hpa-{i}", target=f"app-{i}"))
+            p.demand[f"app-{i}"] = 3.0
+        calls = {"n": 0}
+        orig = daemon_mod.solve_step
+
+        def counting(*a, **kw):
+            calls["n"] += 1
+            return orig(*a, **kw)
+
+        daemon_mod.solve_step = counting
+        try:
+            for _ in range(3):
+                p.tick()
+        finally:
+            daemon_mod.solve_step = orig
+        assert calls["n"] == 3  # one launch per tick, 17 workloads each
+        assert p.daemon.stats["solves"] == p.daemon.stats["ticks"] == 3
+        assert p.daemon.last_step_stats["workloads"] == w
+
+
+# -- satellite: fake-clock hysteresis --------------------------------------
+
+
+class TestHysteresis:
+    def test_flap_inside_window_zero_scale_events(self):
+        """A metric flapping inside BOTH stabilization windows produces
+        ZERO scale events."""
+        p = _Plane()
+        p.add_workload("web", replicas=4, cpu=1.0)
+        p.store.create(fhpa(min_r=1, max_r=20, target_util=50,
+                            up_s=30.0, down_s=300.0))
+        # seed the ring with steady history at the current level
+        p.demand["web"] = 4.0  # per-pod 0.5 -> exactly on target
+        for _ in range(3):
+            p.tick()
+        assert p.replicas("web") == 4
+        # flap demand hi/lo every tick, well inside the 30 s up window
+        for i in range(10):
+            p.demand["web"] = 14.0 if i % 2 == 0 else 0.5
+            p.tick()
+        assert p.replicas("web") == 4
+        assert p.daemon.stats["scale_ups"] == 0
+        assert p.daemon.stats["scale_downs"] == 0
+
+    def test_sustained_spike_scales_exactly_once(self):
+        p = _Plane()
+        p.add_workload("web", replicas=4, cpu=1.0)
+        p.store.create(fhpa(min_r=1, max_r=20, target_util=50,
+                            up_s=3.0, down_s=300.0))
+        p.demand["web"] = 4.0
+        for _ in range(3):
+            p.tick()
+        assert p.daemon.stats["scale_ups"] == 0
+        # sustained spike: desired ceil(14/(1.0*0.5)) = 28 -> clamp 20;
+        # held while pre-spike recommendations sit in the up window, then
+        # ONE scale event, then steady (closed loop: utilization relieved)
+        p.demand["web"] = 14.0
+        for _ in range(8):
+            p.tick()
+        assert p.replicas("web") == 20
+        assert p.daemon.stats["scale_ups"] == 1
+        assert p.daemon.stats["scale_downs"] == 0
+
+    def test_no_hysteresis_leg_flaps(self):
+        """The same flapping trace WITHOUT hysteresis scales on every
+        transition — the counterfactual the bench quantifies at >=5x."""
+        p = _Plane(hysteresis=False)
+        p.add_workload("web", replicas=4, cpu=1.0)
+        p.store.create(fhpa(min_r=1, max_r=20, target_util=50))
+        p.demand["web"] = 4.0
+        for _ in range(3):
+            p.tick()
+        for i in range(10):
+            p.demand["web"] = 14.0 if i % 2 == 0 else 0.5
+            p.tick()
+        events = p.daemon.stats["scale_ups"] + p.daemon.stats["scale_downs"]
+        assert events >= 5
+
+    def test_scale_to_zero_and_resurrection(self):
+        p = _Plane()
+        p.add_workload("web", replicas=2, cpu=1.0)
+        p.store.create(fhpa(min_r=0, max_r=10, target_util=50,
+                            scale_to_zero=True, up_s=0.0, down_s=2.0))
+        p.demand["web"] = 2.0
+        for _ in range(3):
+            p.tick()
+        assert p.replicas("web") == 2  # per-pod 0.5 = exactly on target
+        # demand vanishes: utilization 0 -> recommendation 0, applied once
+        # the down window drains
+        p.demand["web"] = 0.0
+        for _ in range(5):
+            p.tick()
+        assert p.replicas("web") == 0
+        hpa = p.store.get("FederatedHPA", "hpa", "default")
+        assert hpa.status.desired_replicas == 0
+        # cold resurrection: demand returns while ZERO pods are ready —
+        # the zero-ready demand rows wake the workload at one replica,
+        # then the loop right-sizes it
+        p.demand["web"] = 3.0
+        p.tick()
+        assert p.replicas("web") == 1
+        assert p.daemon.stats["resurrected"] == 1
+        for _ in range(3):
+            p.tick()
+        assert p.replicas("web") == 6  # ceil(3/0.5)
+
+
+# -- cron fold -------------------------------------------------------------
+
+
+class TestCronFold:
+    def test_cron_updates_hpa_bounds_as_matrix_rows(self):
+        p = _Plane()
+        p.add_workload("web", replicas=2, cpu=1.0)
+        p.store.create(fhpa(min_r=1, max_r=10))
+        p.store.create(CronFederatedHPA(
+            metadata=ObjectMeta(name="peak", namespace="default"),
+            spec=CronFederatedHPASpec(
+                scale_target_ref=ScaleTargetRef(kind="FederatedHPA",
+                                                name="hpa"),
+                rules=[CronFederatedHPARule(
+                    name="peak", schedule="* * * * *",
+                    target_min_replicas=4, target_max_replicas=20)],
+            ),
+        ))
+        p.tick(seconds=90)  # rule fires; the new MIN bound row forces 2->4
+        hpa = p.store.get("FederatedHPA", "hpa", "default")
+        assert hpa.spec.min_replicas == 4
+        assert hpa.spec.max_replicas == 20
+        assert p.replicas("web") == 4
+        assert p.daemon.stats["cron_fired"] == 1
+
+    def test_cron_pins_workload_without_hpa(self):
+        p = _Plane()
+        p.add_workload("web", replicas=2, cpu=1.0)
+        p.store.create(CronFederatedHPA(
+            metadata=ObjectMeta(name="night", namespace="default"),
+            spec=CronFederatedHPASpec(
+                scale_target_ref=ScaleTargetRef(kind="Deployment",
+                                                name="web"),
+                rules=[CronFederatedHPARule(name="night",
+                                            schedule="* * * * *",
+                                            target_replicas=6)],
+            ),
+        ))
+        p.tick(seconds=120)
+        assert p.replicas("web") == 6
+        cron = p.store.get("CronFederatedHPA", "night", "default")
+        assert cron.status.execution_histories[0].last_result == "Succeed"
+
+    def test_bad_schedule_records_failure(self):
+        p = _Plane()
+        p.add_workload("web", replicas=2, cpu=1.0)
+        cron = CronFederatedHPA(
+            metadata=ObjectMeta(name="bad", namespace="default"),
+            spec=CronFederatedHPASpec(
+                scale_target_ref=ScaleTargetRef(kind="Deployment",
+                                                name="web"),
+                rules=[CronFederatedHPARule(name="bad", schedule="nope",
+                                            target_replicas=1)],
+            ),
+        )
+        # bypass admission (bare store has no webhook chain): the daemon
+        # must still record the parse failure instead of crashing the tick
+        p.store.create(cron)
+        p.tick(seconds=60)
+        cron = p.store.get("CronFederatedHPA", "bad", "default")
+        assert cron.status.execution_histories[0].last_result == "Failed"
+        assert p.replicas("web") == 2
+
+
+# -- aggregation / reports -------------------------------------------------
+
+
+class TestReports:
+    def test_report_rows_and_demand_signal(self):
+        cfg = MemberConfig(name="m1", allocatable={"cpu": 100.0})
+        m = InMemoryMember(cfg)
+        dep = new_deployment("default", "web", replicas=3, cpu=1.0)
+        man = dep.to_dict()
+        m.apply_manifest(man)
+        m.set_workload_usage("Deployment", "default", "web", {"cpu": 0.7})
+        report = build_metrics_report(m, now=123.0)
+        assert report.cluster == "m1" and report.reported_at == 123.0
+        (row,) = report.rows
+        assert (row.ready_pods, row.usage) == (3, {"cpu": 0.7})
+        assert row.demand == {}
+        # scale the workload to zero: the usage entry becomes the DEMAND
+        # row (no ready pods -> no pod metrics, but traffic still knocks)
+        man["spec"]["replicas"] = 0
+        m.apply_manifest(man)
+        report = build_metrics_report(m, now=124.0)
+        (row,) = report.rows
+        assert row.ready_pods == 0
+        assert row.usage == {} and row.demand == {"cpu": 0.7}
+
+    def test_publish_is_change_suppressed(self):
+        store = Store()
+        cfg = MemberConfig(name="m1", allocatable={"cpu": 100.0})
+        m = InMemoryMember(cfg)
+        m.apply_manifest(new_deployment("d", "w", replicas=1,
+                                        cpu=0.5).to_dict())
+        m.set_workload_usage("Deployment", "d", "w", {"cpu": 0.1})
+        assert publish_report(store, build_metrics_report(m, 1.0))
+        rv = store.current_rv
+        # identical rows, fresher timestamp: NO write (freshness is the
+        # resourceVersion's job, not reported_at's)
+        assert not publish_report(store, build_metrics_report(m, 2.0))
+        assert store.current_rv == rv
+        m.set_workload_usage("Deployment", "d", "w", {"cpu": 0.2})
+        assert publish_report(store, build_metrics_report(m, 3.0))
+        assert store.current_rv > rv
+
+    def test_not_ready_cluster_stops_feeding_the_matrix(self):
+        """A crashed/partitioned member's last retained report must not
+        keep phantom ready pods in the solve: flipping its Cluster Ready
+        condition excludes it from the fold."""
+        from karmada_tpu.api.cluster import CLUSTER_CONDITION_READY
+        from karmada_tpu.api.meta import Condition, set_condition
+
+        p = _Plane(n_members=2)
+        p.add_workload("web", replicas=2, cpu=1.0)
+        p.store.create(fhpa(target_util=50))
+        p._sync_members()
+        p.set_usage("web", 0.9)
+        p.collect()
+        p.daemon.step()
+        assert p.replicas("web") == 8  # both members' pods count (4 ready)
+        # m2 "crashes": its report is retained but its cluster goes NotReady
+        c = p.store.get("Cluster", "m2")
+        set_condition(c.status.conditions, Condition(
+            type=CLUSTER_CONDITION_READY, status="False",
+            reason="ClusterLeaseExpired"))
+        p.store.update(c)
+        p.daemon.step()
+        hpa = p.store.get("FederatedHPA", "hpa", "default")
+        # only m1's 8 pods remain in the matrix now (the solve re-derives
+        # from half the ready pool instead of the dead member's ghost rows)
+        assert p.daemon.last_step_stats["workloads"] == 1
+        assert hpa.status.current_replicas == 8
+
+    def test_deleted_report_drops_cluster_rows(self):
+        from karmada_tpu.api.autoscaling import KIND_WORKLOAD_METRICS_REPORT
+        from karmada_tpu.elastic import UtilizationAggregator
+
+        store = Store()
+        cfg = MemberConfig(name="m1", allocatable={"cpu": 100.0})
+        m = InMemoryMember(cfg)
+        m.apply_manifest(new_deployment("d", "w", replicas=2,
+                                        cpu=0.5).to_dict())
+        m.set_workload_usage("Deployment", "d", "w", {"cpu": 0.4})
+        agg = UtilizationAggregator(store)
+        publish_report(store, build_metrics_report(m, 1.0))
+        key = workload_key("Deployment", "d", "w")
+        assert agg.snapshot([key], ["cpu"]).ready_total()[0] == 2
+        store.delete(KIND_WORKLOAD_METRICS_REPORT, "m1")
+        assert agg.snapshot([key], ["cpu"]).ready_total()[0] == 0
+
+    def test_agent_heartbeat_publishes_report(self):
+        """The pull path: KarmadaAgent.heartbeat() publishes the member's
+        report when metrics_reports is on (the coalesced status seam)."""
+        from karmada_tpu.agent import KarmadaAgent
+        from karmada_tpu.runtime.controller import Runtime
+
+        store = Store()
+        cfg = MemberConfig(name="m1", allocatable={"cpu": 100.0},
+                           sync_mode="Pull")
+        m = InMemoryMember(cfg)
+        store.create(cluster_object_for(cfg))
+        runtime = Runtime(clock=Clock(fixed=1_700_000_000.0))
+        agent = KarmadaAgent(store, m, ResourceInterpreter(), runtime,
+                             metrics_reports=True)
+        m.apply_manifest(new_deployment("d", "w", replicas=2,
+                                        cpu=0.5).to_dict())
+        m.set_workload_usage("Deployment", "d", "w", {"cpu": 0.4})
+        agent.heartbeat()
+        report = store.get(KIND_WORKLOAD_METRICS_REPORT, "m1")
+        assert report.rows[0].ready_pods == 2
+
+
+# -- quota preflight veto --------------------------------------------------
+
+
+class TestPreflightVeto:
+    def test_scale_up_stranding_replicas_is_vetoed(self):
+        from karmada_tpu.api.search import (
+            FederatedResourceQuota,
+            FederatedResourceQuotaSpec,
+            StaticClusterAssignment,
+        )
+        from karmada_tpu.api.work import (
+            BindingSpec,
+            ObjectReference,
+            ReplicaRequirements,
+            ResourceBinding,
+            TargetCluster,
+        )
+
+        p = _Plane(n_members=2, preflight=True)
+        p.add_workload("web", replicas=2, cpu=30.0)
+        p.store.create(fhpa(min_r=1, max_r=10, target_util=50))
+        # the binding the preflight re-solves (30 cpu/replica)
+        p.store.create(ResourceBinding(
+            metadata=ObjectMeta(namespace="default", name="web-deployment",
+                                uid=new_uid("rb")),
+            spec=BindingSpec(
+                resource=ObjectReference(api_version="apps/v1",
+                                         kind="Deployment",
+                                         namespace="default", name="web"),
+                replicas=2, placement=_divided_placement(),
+                replica_requirements=ReplicaRequirements(
+                    resource_request={CPU: 30.0}),
+                clusters=[TargetCluster(name="m1", replicas=1),
+                          TargetCluster(name="m2", replicas=1)],
+            ),
+        ))
+        p.store.create(FederatedResourceQuota(
+            metadata=ObjectMeta(namespace="default", name="caps"),
+            spec=FederatedResourceQuotaSpec(
+                overall={CPU: 120.0},
+                static_assignments=[
+                    StaticClusterAssignment(cluster_name="m1",
+                                            hard={CPU: 60.0}),
+                    StaticClusterAssignment(cluster_name="m2",
+                                            hard={CPU: 60.0}),
+                ],
+            ),
+        ))
+        p._sync_members()
+        p.set_usage("web", 0.9 * 30.0)  # 90% of request -> desired 8
+        p.collect()
+        p.daemon.step()
+        # 8 replicas x 30 cpu = 240 > the 120 the caps leave: VETOED —
+        # the template stays put and the veto is counted
+        assert p.replicas("web") == 2
+        assert p.daemon.stats["vetoed"] == 1
+        assert p.daemon.stats["scale_ups"] == 0
+
+    def test_quota_less_namespace_is_never_vetoed(self):
+        """The preflight is scoped per namespace: a scale-up in a
+        namespace with NO FederatedResourceQuota must not compete with
+        (or be vetoed by) another namespace's caps."""
+        from karmada_tpu.api.search import (
+            FederatedResourceQuota,
+            FederatedResourceQuotaSpec,
+            StaticClusterAssignment,
+        )
+        from karmada_tpu.api.work import (
+            BindingSpec,
+            ObjectReference,
+            ReplicaRequirements,
+            ResourceBinding,
+            TargetCluster,
+        )
+
+        p = _Plane(n_members=2, preflight=True)
+        # ns "default": the scaled workload, NO quota
+        p.add_workload("web", replicas=2, cpu=30.0)
+        p.store.create(fhpa(min_r=1, max_r=10, target_util=50))
+        p.store.create(ResourceBinding(
+            metadata=ObjectMeta(namespace="default", name="web-deployment",
+                                uid=new_uid("rb")),
+            spec=BindingSpec(
+                resource=ObjectReference(api_version="apps/v1",
+                                         kind="Deployment",
+                                         namespace="default", name="web"),
+                replicas=2, placement=_divided_placement(),
+                replica_requirements=ReplicaRequirements(
+                    resource_request={CPU: 30.0}),
+                clusters=[TargetCluster(name="m1", replicas=1),
+                          TargetCluster(name="m2", replicas=1)],
+            ),
+        ))
+        # a DIFFERENT namespace carries a tight quota
+        p.store.create(FederatedResourceQuota(
+            metadata=ObjectMeta(namespace="other", name="caps"),
+            spec=FederatedResourceQuotaSpec(
+                overall={CPU: 2.0},
+                static_assignments=[
+                    StaticClusterAssignment(cluster_name="m1",
+                                            hard={CPU: 1.0}),
+                    StaticClusterAssignment(cluster_name="m2",
+                                            hard={CPU: 1.0}),
+                ],
+            ),
+        ))
+        p._sync_members()
+        p.set_usage("web", 0.9 * 30.0)
+        p.collect()
+        p.daemon.step()
+        assert p.replicas("web") == 8  # other/caps is not ours: emitted
+        assert p.daemon.stats["vetoed"] == 0
+
+    def test_scale_up_within_quota_passes(self):
+        from karmada_tpu.api.search import (
+            FederatedResourceQuota,
+            FederatedResourceQuotaSpec,
+            StaticClusterAssignment,
+        )
+        from karmada_tpu.api.work import (
+            BindingSpec,
+            ObjectReference,
+            ReplicaRequirements,
+            ResourceBinding,
+            TargetCluster,
+        )
+
+        p = _Plane(n_members=2, preflight=True)
+        p.add_workload("web", replicas=2, cpu=1.0)
+        p.store.create(fhpa(min_r=1, max_r=10, target_util=50))
+        p.store.create(ResourceBinding(
+            metadata=ObjectMeta(namespace="default", name="web-deployment",
+                                uid=new_uid("rb")),
+            spec=BindingSpec(
+                resource=ObjectReference(api_version="apps/v1",
+                                         kind="Deployment",
+                                         namespace="default", name="web"),
+                replicas=2, placement=_divided_placement(),
+                replica_requirements=ReplicaRequirements(
+                    resource_request={CPU: 1.0}),
+                clusters=[TargetCluster(name="m1", replicas=1),
+                          TargetCluster(name="m2", replicas=1)],
+            ),
+        ))
+        p.store.create(FederatedResourceQuota(
+            metadata=ObjectMeta(namespace="default", name="caps"),
+            spec=FederatedResourceQuotaSpec(
+                overall={CPU: 120.0},
+                static_assignments=[
+                    StaticClusterAssignment(cluster_name="m1",
+                                            hard={CPU: 60.0}),
+                    StaticClusterAssignment(cluster_name="m2",
+                                            hard={CPU: 60.0}),
+                ],
+            ),
+        ))
+        p._sync_members()
+        p.set_usage("web", 0.9)
+        p.collect()
+        p.daemon.step()
+        assert p.replicas("web") == 8  # fits under the caps: emitted
+        assert p.daemon.stats["vetoed"] == 0
+
+
+# -- streaming re-admission ------------------------------------------------
+
+
+class TestStreamingReadmission:
+    @staticmethod
+    def _placed(store, name, ns="bench"):
+        rb = store.try_get("ResourceBinding", name, ns)
+        if rb is None or rb.status.scheduler_observed_generation != rb.metadata.generation:
+            return None
+        return sum(t.replicas for t in (rb.spec.clusters or []))
+
+    def _wait(self, fn, want, deadline_s=30.0):
+        deadline = time.monotonic() + deadline_s
+        while time.monotonic() < deadline:
+            if fn() == want:
+                return True
+            time.sleep(0.02)
+        return False
+
+    def test_resurrection_readmits_through_streaming_scheduler(self):
+        """Scale-to-zero then cold resurrection: the replica-delta emission
+        is an ordinary store write the STREAMING scheduler absorbs as an
+        admission — zero special-casing in the placement plane."""
+        from karmada_tpu.api.work import (
+            BindingSpec,
+            ObjectReference,
+            ReplicaRequirements,
+            ResourceBinding,
+        )
+        from karmada_tpu.runtime.controller import Runtime
+        from karmada_tpu.sched.scheduler import SchedulerDaemon
+
+        ns = "bench"
+        p = _Plane(n_members=2, ns=ns)
+        p.add_workload("web", replicas=2, cpu=1.0)
+        p.store.create(fhpa(ns=ns, min_r=0, max_r=10, target_util=50,
+                            scale_to_zero=True))
+        placement = _divided_placement()
+        rb = ResourceBinding(
+            metadata=ObjectMeta(namespace=ns, name="web", uid="rb-elastic-0"),
+            spec=BindingSpec(
+                resource=ObjectReference(api_version="apps/v1",
+                                         kind="Deployment",
+                                         namespace=ns, name="web"),
+                replicas=2, placement=placement,
+                replica_requirements=ReplicaRequirements(
+                    resource_request={CPU: 1.0}),
+            ),
+        )
+        p.store.create(rb)
+
+        # detector-lite: template spec.replicas -> binding spec.replicas
+        def on_template(event, dep):
+            if event == "DELETED" or dep.name != "web":
+                return
+            fresh = p.store.try_get("ResourceBinding", "web", ns)
+            want = int(dep.get("spec", "replicas", default=0) or 0)
+            if fresh is not None and fresh.spec.replicas != want:
+                fresh.spec.replicas = want
+                p.store.update(fresh)
+
+        p.store.watch("apps/v1/Deployment", on_template, replay=False)
+
+        daemon = SchedulerDaemon(p.store, Runtime())
+        svc = daemon.streaming(batch_delay=0.001, interval=0.02,
+                               max_batch=64)
+        stop = threading.Event()
+        t = threading.Thread(
+            target=lambda: svc.serve(should_stop=stop.is_set), daemon=True)
+        t.start()
+        try:
+            assert self._wait(lambda: self._placed(p.store, "web"), 2)
+            # scale to zero
+            p.demand["web"] = 2.0
+            p.tick()
+            p.demand["web"] = 0.0
+            for _ in range(3):
+                p.tick()
+            dep = p.store.get("apps/v1/Deployment", "web", ns)
+            assert int(dep.get("spec", "replicas")) == 0
+            rb2 = p.store.get("ResourceBinding", "web", ns)
+            assert rb2.spec.replicas == 0
+            # resurrection: demand returns at zero ready -> one replica,
+            # re-placed by the streaming scheduler like any admission
+            p.demand["web"] = 3.0
+            p.tick()
+            dep = p.store.get("apps/v1/Deployment", "web", ns)
+            assert int(dep.get("spec", "replicas")) == 1
+            assert self._wait(lambda: self._placed(p.store, "web"), 1)
+        finally:
+            stop.set()
+            svc.stop()
+            t.join(timeout=30.0)
+
+
+# -- printers + metrics ----------------------------------------------------
+
+
+class _StubCP:
+    def __init__(self, store):
+        self.store = store
+        self.members = {}
+
+
+class TestPrinterAndMetrics:
+    def test_get_federatedhpas_table(self):
+        from karmada_tpu.cli.karmadactl import cmd_get
+
+        store = Store()
+        h = fhpa(min_r=2, max_r=12, target_util=50)
+        h.status.current_replicas = 4
+        h.status.current_average_utilization = 90
+        h.status.last_scale_time = time.time() - 30.0
+        store.create(h)
+        out = cmd_get(_StubCP(store), "federatedhpas")
+        for col in ("TARGETS", "MINPODS", "MAXPODS", "REPLICAS",
+                    "LASTSCALE"):
+            assert col in out
+        assert "cpu: 90%/50%" in out
+        assert " 2 " in out and " 12 " in out and " 4 " in out
+        wide = cmd_get(_StubCP(store), "fhpa", output="wide")
+        assert "Deployment/web" in wide and "DESIRED" in wide
+
+    def test_targets_attributes_utilization_to_resolved_metric(self):
+        """Multi-metric HPA: the one stored percent renders against the
+        metric it belongs to (status.current_metric), never fabricated
+        onto the others."""
+        from karmada_tpu.cli.karmadactl import cmd_get
+
+        store = Store()
+        h = fhpa(target_util=80)
+        h.spec.metrics.insert(0, ResourceMetricSource(
+            name="memory", target_average_utilization=60))
+        h.status.current_average_utilization = 57
+        h.status.current_metric = "cpu"
+        store.create(h)
+        out = cmd_get(_StubCP(store), "federatedhpas")
+        assert "memory: <unknown>/60%" in out
+        assert "cpu: 57%/80%" in out
+
+    def test_metrics_exported(self):
+        from karmada_tpu.metrics import (
+            elastic_loop_seconds,
+            elastic_solves,
+            hpa_desired_replicas,
+            hpa_scale_events,
+            registry,
+        )
+
+        loops0 = elastic_loop_seconds.count()
+        solves0 = elastic_solves.total()
+        ups0 = hpa_scale_events.value(direction="up")
+        p = _Plane()
+        p.add_workload("web", replicas=2, cpu=1.0)
+        p.store.create(fhpa(target_util=50))
+        p.demand["web"] = 4.0  # desired ceil(4/0.5) = 8
+        p.tick()
+        key = workload_key("Deployment", "default", "web")
+        assert hpa_desired_replicas.value(workload=key) == 8.0
+        assert hpa_scale_events.value(direction="up") == ups0 + 1
+        assert elastic_loop_seconds.count() == loops0 + 1
+        assert elastic_solves.total() == solves0 + 1
+        text = registry.render()
+        for name in ("karmada_hpa_desired_replicas",
+                     "karmada_hpa_scale_events_total",
+                     "karmada_elastic_loop_seconds"):
+            assert name in text
+
+    def test_scale_events_recorded(self):
+        from karmada_tpu.events import EventRecorder
+
+        p = _Plane()
+        p.daemon.event_recorder = EventRecorder(p.store, clock=p.clock)
+        p.add_workload("web", replicas=2, cpu=1.0)
+        p.store.create(fhpa(target_util=50))
+        p.demand["web"] = 4.0
+        p.tick()
+        hpa = p.store.get("FederatedHPA", "hpa", "default")
+        events = p.daemon.event_recorder.events_for(hpa)
+        assert any(e.reason == "SuccessfulRescale" for e in events)
+
+    def test_gauge_rows_removed_with_hpa(self):
+        from karmada_tpu.metrics import hpa_desired_replicas
+
+        p = _Plane()
+        p.add_workload("web", replicas=2, cpu=1.0)
+        p.store.create(fhpa(target_util=50))
+        p.demand["web"] = 1.0
+        p.tick()
+        key = workload_key("Deployment", "default", "web")
+        assert hpa_desired_replicas.value(workload=key) > 0
+        p.store.delete("FederatedHPA", "hpa", "default")
+        p.tick()
+        assert hpa_desired_replicas.value(workload=key) == 0.0
+
+
+class TestElasticStatusRoute:
+    def test_get_elastic_status(self):
+        """GET /elastic/status: 404 on a plane without the elasticity
+        plane, daemon counters when enabled."""
+        import json as json_mod
+        import urllib.error
+        import urllib.request
+
+        from karmada_tpu.server.apiserver import ControlPlaneServer
+
+        cp = _StubCP(Store())
+        srv = ControlPlaneServer(cp)
+        srv.start()
+        try:
+            with pytest.raises(urllib.error.HTTPError) as exc:
+                urllib.request.urlopen(f"{srv.url}/elastic/status")
+            assert exc.value.code == 404
+            cp.elasticity = ElasticityDaemon(cp.store)
+            cp.elasticity.step()
+            with urllib.request.urlopen(f"{srv.url}/elastic/status") as r:
+                body = json_mod.loads(r.read())
+            assert body["leader"] is True
+            assert body["ticks"] == 1 and body["solves"] == 1
+        finally:
+            srv.stop()
+
+
+# -- leadership ------------------------------------------------------------
+
+
+class TestLeadership:
+    def test_non_leader_tick_is_noop(self):
+        """With a coordinator, the daemon elects on karmada-elastic; a
+        second daemon against the same coordinator stays standby and its
+        ticks are no-ops."""
+        from karmada_tpu.coordination.lease import LeaseCoordinator
+        from karmada_tpu.elastic.daemon import LEASE_ELASTIC
+
+        clock = Clock(fixed=1_700_000_000.0)
+        store = Store()
+        coordinator = LeaseCoordinator(store, clock)
+        a = ElasticityDaemon(store, clock, coordinator=coordinator,
+                             identity="a")
+        b = ElasticityDaemon(store, clock, coordinator=coordinator,
+                             identity="b")
+        sa = a.step()
+        sb = b.step()
+        assert sa["leader"] is True
+        assert sb == {"leader": False}
+        lease = store.get("LeaderLease", LEASE_ELASTIC, "karmada-system")
+        assert lease.spec.holder_identity == "a"
+        # the leader's lease expires -> the standby takes over
+        clock.advance(60.0)
+        assert b.step()["leader"] is True
+
+
+# -- the smoke wrapper (slow path) -----------------------------------------
+
+
+@pytest.mark.slow
+class TestElasticSmokeScript:
+    def test_elastic_smoke(self):
+        """scripts/elastic_smoke.sh: the diurnal-replay bench against the
+        live daemon topology — spike->placed p99 under the SLO, the
+        hysteresis leg >=5x fewer scale events than the no-hysteresis leg
+        on the same seeded trace, one vectorized launch per tick —
+        asserted from the emitted JSON line."""
+        import os
+        import subprocess
+
+        repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        r = subprocess.run(
+            ["bash", "scripts/elastic_smoke.sh"],
+            capture_output=True, text=True, timeout=900, cwd=repo,
+        )
+        assert r.returncode == 0, r.stdout + r.stderr
+        assert "ELASTIC OK" in r.stdout
