@@ -30,10 +30,21 @@ class RebalancerObjectReference:
         return f"{self.api_version}/{self.kind}/{self.namespace}/{self.name}"
 
 
+REASON_NO_IMPROVING_MOVE = "RepackNoImprovingMove"
+REASON_REPACK_TRIGGERED = "RepackTriggered"
+
+
 @dataclass
 class WorkloadRebalancerSpec:
     workloads: list[RebalancerObjectReference] = field(default_factory=list)
     ttl_seconds_after_finished: Optional[int] = None
+    # periodic re-pack mode (sched/preemption.py's background consumer):
+    # when set, the rebalancer never one-shots — every interval it re-runs
+    # placement for its workloads through the counterfactual solve and
+    # triggers a reschedule ONLY for improving moves (a placement that
+    # lands strictly more replicas than the current one). finish_time and
+    # the TTL never fire in this mode.
+    repack_every_seconds: Optional[int] = None
 
 
 @dataclass
@@ -48,6 +59,7 @@ class WorkloadRebalancerStatus:
     observed_workloads: list[ObservedWorkload] = field(default_factory=list)
     observed_generation: int = 0
     finish_time: Optional[float] = None
+    last_repack_time: Optional[float] = None  # repack mode bookkeeping
 
 
 @dataclass

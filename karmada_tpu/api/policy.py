@@ -48,6 +48,20 @@ CONFLICT_ABORT = "Abort"
 
 DEFAULT_SCHEDULER_NAME = "default-scheduler"
 
+# Scheduling preemption policy (kube PreemptionPolicy vocabulary, distinct
+# from spec.preemption which governs POLICY-claim preemption in the
+# detector): "" defaults to Never; PreemptLowerPriority lets an
+# unschedulable binding evict placed replicas of strictly-lower-priority
+# bindings (sched/preemption.py).
+PREEMPT_NEVER = "Never"
+PREEMPT_LOWER_PRIORITY = "PreemptLowerPriority"
+VALID_SCHEDULER_PREEMPTION = ("", PREEMPT_NEVER, PREEMPT_LOWER_PRIORITY)
+
+# schedule_priority bounds enforced at admission (webhook/handlers.py) —
+# mirrors kube's PriorityClass value range so priorities stay well inside
+# i32 for the tiered device solve
+SCHEDULE_PRIORITY_BOUND = 1_000_000_000
+
 
 @dataclass
 class ResourceSelector:
@@ -203,7 +217,15 @@ class PropagationSpec:
     propagate_deps: bool = False
     priority: int = 0
     scheduler_priority: Optional[int] = None
-    preemption: str = "Never"  # Never | Always
+    preemption: str = "Never"  # Never | Always (policy-claim preemption)
+    # scheduling preemption: may this policy's bindings evict placed
+    # replicas of strictly-lower-priority bindings when they place short?
+    scheduler_preemption: str = ""  # "" | Never | PreemptLowerPriority
+    # gang scheduling: bindings sharing gang_name co-admit as an
+    # all-or-nothing cohort of gang_size members (sched/preemption.py);
+    # template labels gang.karmada.io/{name,size} override per workload
+    gang_name: str = ""
+    gang_size: int = 0
     scheduler_name: str = DEFAULT_SCHEDULER_NAME
     failover: Optional[FailoverBehavior] = None
     suspension: Optional[Suspension] = None

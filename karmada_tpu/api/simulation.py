@@ -37,10 +37,15 @@ SCENARIO_TAINT = "Taint"
 SCENARIO_CAPACITY = "CapacityDelta"
 SCENARIO_SURGE = "BindingSurge"
 SCENARIO_COMPOSITE = "Composite"
+# preemption preview (sched/preemption.py): what would placing `binding`
+# evict? Answered by the SAME planner the live scheduler runs, against the
+# same snapshot — the previewed victim set is identical to what a real
+# admission would cut, and nothing mutates
+SCENARIO_PREEMPT = "Preemption"
 
 SCENARIO_KINDS = (
     SCENARIO_BASELINE, SCENARIO_DRAIN, SCENARIO_LOSS, SCENARIO_TAINT,
-    SCENARIO_CAPACITY, SCENARIO_SURGE, SCENARIO_COMPOSITE,
+    SCENARIO_CAPACITY, SCENARIO_SURGE, SCENARIO_COMPOSITE, SCENARIO_PREEMPT,
 )
 
 
@@ -62,12 +67,17 @@ class Scenario:
     surge_count: int = 0
     surge_replicas: int = 1
     surge_request: dict[str, float] = field(default_factory=dict)
+    # Preemption: namespace/name of the (typically pending) preemptor
+    # binding whose victim set the preview computes
+    binding: str = ""
     # Composite
     steps: list["Scenario"] = field(default_factory=list)
 
     def label(self) -> str:
         if self.name:
             return self.name
+        if self.kind == SCENARIO_PREEMPT:
+            return f"preempt({self.binding})"
         if self.kind == SCENARIO_COMPOSITE:
             inner = ",".join(s.label() for s in self.steps[:3])
             more = "" if len(self.steps) <= 3 else f"+{len(self.steps) - 3}"
@@ -111,6 +121,16 @@ class BindingDiff:
 
 
 @dataclass
+class PreemptionVictim:
+    """One previewed victim replica reduction (Preemption scenarios)."""
+
+    binding: str = ""  # namespace/name
+    cluster: str = ""
+    replicas: int = 0
+    priority: int = 0
+
+
+@dataclass
 class ScenarioReport:
     scenario: Scenario = field(default_factory=Scenario)
     displaced: int = 0  # bindings whose placement changed vs baseline
@@ -118,6 +138,9 @@ class ScenarioReport:
     injected: int = 0  # surge rows evaluated under this scenario
     overcommitted: list[str] = field(default_factory=list)  # cluster names
     diffs: list[BindingDiff] = field(default_factory=list)  # first diff_limit
+    # Preemption scenarios: who pays for placing the previewed binding —
+    # identical to the live planner's victim set (shared plan code)
+    victims: list[PreemptionVictim] = field(default_factory=list)
 
 
 @dataclass

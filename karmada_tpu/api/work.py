@@ -30,6 +30,20 @@ CONDITION_FULLY_APPLIED = "FullyApplied"
 REASON_BINDING_SCHEDULED = "BindingScheduled"
 REASON_SCHEDULE_FAILED = "BindingFailedScheduling"
 REASON_UNSCHEDULABLE = "Unschedulable"
+# workload-class scheduling (sched/preemption.py)
+REASON_GANG_TIMEOUT = "GangTimeout"
+REASON_GANG_UNSCHEDULABLE = "GangUnschedulable"
+
+# graceful-eviction task reason/producer stamped by the preemption plane
+EVICTION_REASON_PREEMPTED = "Preempted"
+EVICTION_PRODUCER_PREEMPTION = "karmada-scheduler-preemption"
+
+# template labels the detector lifts into the binding's gang/priority
+# fields (they override the claiming policy's spec so several templates
+# under one policy can form one gang)
+GANG_NAME_LABEL = "gang.karmada.io/name"
+GANG_SIZE_LABEL = "gang.karmada.io/size"
+SCHEDULE_PRIORITY_LABEL = "scheduling.karmada.io/priority"
 
 # Work condition types
 WORK_CONDITION_APPLIED = "Applied"
@@ -127,6 +141,12 @@ class BindingSpec:
     placement: Optional[Placement] = None
     scheduler_name: str = ""
     schedule_priority: Optional[int] = None
+    # scheduling preemption + gang membership (workload-class scheduling,
+    # sched/preemption.py): plumbed from the claiming policy / template
+    # labels by the detector, validated by the admission webhook
+    preemption_policy: str = ""  # "" | Never | PreemptLowerPriority
+    gang_name: str = ""
+    gang_size: int = 0
     reschedule_triggered_at: Optional[float] = None
     graceful_eviction_tasks: list[GracefulEvictionTask] = field(default_factory=list)
     required_by: list[BindingSnapshot] = field(default_factory=list)

@@ -385,6 +385,10 @@ _KIND_ALIASES = {
     "simulationreport": "SimulationReport",
     "simulationreports": "SimulationReport",
     "simreport": "SimulationReport", "simreports": "SimulationReport",
+    "wr": "WorkloadRebalancer", "rebalancer": "WorkloadRebalancer",
+    "rebalancers": "WorkloadRebalancer",
+    "workloadrebalancer": "WorkloadRebalancer",
+    "workloadrebalancers": "WorkloadRebalancer",
     "deployment": "apps/v1/Deployment", "deployments": "apps/v1/Deployment",
 }
 
@@ -543,6 +547,8 @@ def cmd_get(cp: ControlPlane, kind: str, name: str = "", namespace: str = "",
                                 repl=_replication_status(cp))
     if resolved == "SimulationReport":
         return _simulation_reports_table(objs, wide=wide)
+    if resolved == "WorkloadRebalancer":
+        return _workload_rebalancers_table(objs, wide=wide)
     if resolved == "FederatedHPA":
         return _federated_hpas_table(objs, wide=wide)
     rows = [
@@ -1196,6 +1202,40 @@ def _federated_hpas_table(hpas, wide: bool = False) -> str:
     return _fmt_table(rows, headers)
 
 
+def _workload_rebalancers_table(rebalancers, wide: bool = False) -> str:
+    """`karmadactl get workloadrebalancers`: per-workload result counts
+    (the controller's status sync) + whether the rebalancer finished; wide
+    adds the TTL and the periodic re-pack interval."""
+    rows = []
+    for r in sorted(rebalancers, key=lambda r: r.metadata.name):
+        ok = sum(1 for w in r.status.observed_workloads
+                 if w.result == "Successful")
+        failed = sum(1 for w in r.status.observed_workloads
+                     if w.result == "Failed")
+        repack = r.spec.repack_every_seconds
+        finished = ("<periodic>" if repack is not None
+                    else "true" if r.status.finish_time is not None
+                    else "false")
+        row = [
+            r.metadata.name,
+            str(len(r.spec.workloads)),
+            str(ok),
+            str(failed),
+            finished,
+        ]
+        if wide:
+            ttl = r.spec.ttl_seconds_after_finished
+            row += [
+                "<none>" if ttl is None else f"{ttl}s",
+                "<one-shot>" if repack is None else f"{repack}s",
+            ]
+        rows.append(row)
+    headers = ["NAME", "WORKLOADS", "SUCCESSFUL", "FAILED", "FINISHED"]
+    if wide:
+        headers += ["TTL", "REPACK"]
+    return _fmt_table(rows, headers)
+
+
 def _simulation_reports_table(reports, wide: bool = False) -> str:
     """Shared SimulationReport table (`get simulationreports`)."""
     rows = []
@@ -1253,6 +1293,11 @@ def format_simulation_report(report, details: int = 3) -> str:
                     f"  ~ {d.binding}  {_format_targets(d.before)} -> "
                     f"{_format_targets(d.after)}"
                 )
+        for v in getattr(s, "victims", ()) or ():
+            lines.append(
+                f"  - victim {v.binding}  {v.cluster}:-{v.replicas} "
+                f"(priority {v.priority})"
+            )
         if lines:
             out.append(f"{s.scenario.label()}:")
             out.extend(lines)
@@ -1262,18 +1307,22 @@ def format_simulation_report(report, details: int = 3) -> str:
     return "\n".join(out)
 
 
-def _parse_scenarios(drains, losses, taints, capacities, surges) -> list:
+def _parse_scenarios(drains, losses, taints, capacities, surges,
+                     preempts=()) -> list:
     """Flag syntax → Scenario objects:
       --drain CLUSTER
       --loss CLUSTER
       --taint CLUSTER:key[=value][:Effect]
       --capacity CLUSTER:res=+delta[,res=delta...]
       --surge N[:replicas=R][:cpu=X][:memory=Y]
+      --preempt NAMESPACE/BINDING    (preemption preview: who would the
+                                      pending binding evict?)
     """
     from ..api.simulation import (
         SCENARIO_CAPACITY,
         SCENARIO_DRAIN,
         SCENARIO_LOSS,
+        SCENARIO_PREEMPT,
         SCENARIO_SURGE,
         SCENARIO_TAINT,
         Scenario,
@@ -1335,16 +1384,21 @@ def _parse_scenarios(drains, losses, taints, capacities, surges) -> list:
             kind=SCENARIO_SURGE, surge_count=count, surge_replicas=replicas,
             surge_request=request,
         ))
+    for spec in preempts:
+        if "/" not in spec:
+            raise CLIError(f"--preempt {spec!r}: want NAMESPACE/BINDING")
+        scenarios.append(Scenario(kind=SCENARIO_PREEMPT, binding=spec))
     return scenarios
 
 
 def cmd_simulate(cp: ControlPlane, drains, losses, taints, capacities,
-                 surges, namespace: str = "", output: str = "",
+                 surges, preempts=(), namespace: str = "", output: str = "",
                  details: int = 3) -> str:
     """`karmadactl simulate` — the what-if plane: evaluate drain/loss/taint/
     capacity/surge counterfactuals against the live fleet in one batched
-    solve and print the displacement diff. Works identically in-process and
-    against a daemon (`--server` routes through POST /simulate)."""
+    solve (and preemption previews through the live planner) and print the
+    displacement diff. Works identically in-process and against a daemon
+    (`--server` routes through POST /simulate)."""
     from . import printers
     from ..api.simulation import SimulationRequest, SimulationRequestSpec
 
@@ -1352,11 +1406,12 @@ def cmd_simulate(cp: ControlPlane, drains, losses, taints, capacities,
         printers.check_output(output)
     except printers.UnknownOutputFormat as e:
         raise CLIError(str(e))
-    scenarios = _parse_scenarios(drains, losses, taints, capacities, surges)
+    scenarios = _parse_scenarios(drains, losses, taints, capacities, surges,
+                                 preempts)
     if not scenarios:
         raise CLIError(
             "nothing to simulate: give at least one of --drain/--loss/"
-            "--taint/--capacity/--surge"
+            "--taint/--capacity/--surge/--preempt"
         )
     # --details N = diff lines per scenario; -1 = all (the report must then
     # carry every diff, not the default window)
@@ -1492,6 +1547,11 @@ def run(cp: ControlPlane, argv: list[str]) -> str:
                    metavar="CLUSTER:res=+delta[,res=delta]")
     p.add_argument("--surge", action="append", default=[],
                    metavar="N[:replicas=R][:cpu=X]")
+    p.add_argument("--preempt", action="append", default=[],
+                   metavar="NAMESPACE/BINDING",
+                   help="preemption preview: which lower-priority replicas "
+                        "would placing this pending binding evict (the live "
+                        "planner's exact victim set; mutates nothing)")
     p.add_argument("-n", "--namespace", default="")
     p.add_argument("-o", "--output", default="")
     p.add_argument("--details", type=int, default=3,
@@ -1666,8 +1726,8 @@ def run(cp: ControlPlane, argv: list[str]) -> str:
     if args.command == "simulate":
         return cmd_simulate(
             cp, args.drain, args.loss, args.taint, args.capacity, args.surge,
-            namespace=args.namespace, output=args.output,
-            details=args.details,
+            preempts=args.preempt, namespace=args.namespace,
+            output=args.output, details=args.details,
         )
     if args.command == "elections":
         return cmd_elections(cp, wide=args.output == "wide")

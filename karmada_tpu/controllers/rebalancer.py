@@ -13,7 +13,9 @@ import copy
 
 from ..api.apps import (
     ObservedWorkload,
+    REASON_NO_IMPROVING_MOVE,
     REASON_REFERENCED_BINDING_NOT_FOUND,
+    REASON_REPACK_TRIGGERED,
     REBALANCE_FAILED,
     REBALANCE_SUCCESSFUL,
     WorkloadRebalancer,
@@ -41,6 +43,17 @@ class WorkloadRebalancerController:
     def _reconcile(self, key: str) -> str:
         rebalancer = self.store.try_get("WorkloadRebalancer", key)
         if rebalancer is None:
+            return DONE
+        if rebalancer.spec.repack_every_seconds is not None:
+            # periodic re-pack mode: reconcile only syncs the spec→status
+            # scaffolding; the tick-driven counterfactual pass owns the
+            # triggers (and there is no finish — TTL never fires)
+            new_status = self._sync_spec_to_status(rebalancer)
+            new_status.finish_time = None
+            new_status.last_repack_time = rebalancer.status.last_repack_time
+            if new_status != rebalancer.status:
+                rebalancer.status = new_status
+                self.store.update(rebalancer)
             return DONE
         # snapshot before mutation: _trigger_reschedules mutates ObservedWorkload
         # objects shared with rebalancer.status, so compare against a copy
@@ -117,10 +130,17 @@ class WorkloadRebalancerController:
         return self.store.try_get("ResourceBinding", rb_name, namespace)
 
     def tick(self) -> int:
-        """Fire TTL cleanups whose deadline elapsed."""
+        """Fire TTL cleanups whose deadline elapsed, and run due periodic
+        re-pack passes."""
         fired = 0
         now = self.clock.now()
         for rebalancer in self.store.list("WorkloadRebalancer"):
+            every = rebalancer.spec.repack_every_seconds
+            if every is not None:
+                last = rebalancer.status.last_repack_time
+                if last is None or now - last >= every:
+                    fired += self._repack(rebalancer, now)
+                continue
             ttl = rebalancer.spec.ttl_seconds_after_finished
             if (
                 ttl is not None
@@ -130,3 +150,54 @@ class WorkloadRebalancerController:
                 self.controller.enqueue(rebalancer.name)
                 fired += 1
         return fired
+
+    # -- periodic re-pack mode (docs/SCHEDULING.md) ------------------------
+
+    def _repack(self, rebalancer: WorkloadRebalancer, now: float) -> int:
+        """One re-pack pass: re-run placement for the listed workloads
+        against current availability through the counterfactual engine
+        (the same batched solve everything else consumes — ONE launch for
+        all listed bindings, store untouched by the solve), then trigger a
+        reschedule ONLY for improving moves: a counterfactual placement
+        that lands strictly more replicas than the binding currently has.
+        A placement that is merely DIFFERENT but no fuller is left alone —
+        re-pack must never churn a healthy workload. Returns the number of
+        reschedules triggered."""
+        from ..simulation.engine import Simulator
+
+        status = self._sync_spec_to_status(rebalancer)
+        status.finish_time = None
+        status.last_repack_time = now
+        items = list(status.observed_workloads)
+        found: list[tuple[ObservedWorkload, object]] = []
+        for item in items:
+            w = item.workload
+            rb = self._find_binding(w.namespace, w.name, w.kind)
+            if rb is None:
+                item.result = REBALANCE_FAILED
+                item.reason = REASON_REFERENCED_BINDING_NOT_FOUND
+                continue
+            found.append((item, rb))
+        triggered = 0
+        if found:
+            clusters = sorted(
+                self.store.list("Cluster"), key=lambda c: c.metadata.name
+            )
+            sim = Simulator(clusters)
+            baseline, _ = sim.simulate([rb for _i, rb in found], [])
+            for item, rb in found:
+                key = rb.metadata.key()
+                fresh = baseline.placements.get(key)
+                fresh_total = sum(t.replicas for t in (fresh or []))
+                cur_total = rb.spec.assigned_replicas()
+                item.result = REBALANCE_SUCCESSFUL
+                if key not in baseline.errors and fresh_total > cur_total:
+                    rb.spec.reschedule_triggered_at = now
+                    self.store.update(rb)
+                    item.reason = REASON_REPACK_TRIGGERED
+                    triggered += 1
+                else:
+                    item.reason = REASON_NO_IMPROVING_MOVE
+        rebalancer.status = status
+        self.store.update(rebalancer)
+        return triggered

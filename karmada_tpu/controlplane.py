@@ -545,6 +545,11 @@ class ControlPlane:
             self.graceful_eviction_controller.tick()
         if self.rebalancer_controller is not None:
             self.rebalancer_controller.tick()
+        if self.scheduler is not None:
+            # partial gangs whose hold window elapsed reject on the clock
+            # (sched/queue.py GangCoordinator; the streaming loop checks
+            # per admission — the batch daemon needs the timer)
+            self.scheduler.gang_tick()
         self.descheduler.tick()
         if self.federated_hpa_controller is not None:
             self.federated_hpa_controller.tick()
@@ -614,6 +619,8 @@ class ControlPlane:
         from .api.simulation import KIND_SIMULATION_REPORT
         from .simulation import Simulator, build_report
 
+        from .api.simulation import SCENARIO_PREEMPT
+
         clusters = sorted(
             self.store.list("Cluster"), key=lambda c: c.metadata.name
         )
@@ -622,12 +629,32 @@ class ControlPlane:
                                          request.spec.namespace)
             if rb.metadata.deletion_timestamp is None
         ]
+        # Preemption previews route to the preemption planner — the SAME
+        # plan code the live scheduler runs, so the previewed victim set is
+        # identical to what a real admission would cut; the batched engine
+        # answers everything else
+        engine_scen, preempt_scen = [], []
+        for i, sc in enumerate(request.spec.scenarios):
+            (preempt_scen if sc.kind == SCENARIO_PREEMPT
+             else engine_scen).append((i, sc))
         sim = Simulator(clusters)
-        baseline, outcomes = sim.simulate(bindings, request.spec.scenarios)
+        baseline, outcomes = sim.simulate(bindings,
+                                          [sc for _i, sc in engine_scen])
         report = build_report(
             request, baseline, outcomes, stats=sim.last_stats,
             clusters=len(clusters), bindings=len(bindings),
         )
+        if preempt_scen:
+            previews = [
+                (i, self._preview_preemption(clusters, bindings, sc))
+                for i, sc in preempt_scen
+            ]
+            merged = [None] * len(request.spec.scenarios)
+            for (i, _sc), rep in zip(engine_scen, report.scenarios):
+                merged[i] = rep
+            for i, rep in previews:
+                merged[i] = rep
+            report.scenarios = merged
         if not report.metadata.name:
             report.metadata.name = new_uid("sim")
         if self.store.try_get(KIND_SIMULATION_REPORT,
@@ -644,3 +671,63 @@ class ControlPlane:
             self.store.delete(KIND_SIMULATION_REPORT, victim.metadata.name,
                               victim.metadata.namespace)
         return report
+
+    def _preview_preemption(self, clusters, bindings, scenario):
+        """One Preemption scenario's report row: the live planner's exact
+        plan (sched/preemption.py plan_preemption via preview_preemption)
+        rendered as victims + a preemptor diff. Store-read-only."""
+        from .api.simulation import (
+            BindingDiff, PreemptionVictim, ScenarioReport,
+        )
+        from .api.work import TargetCluster
+        from .sched.preemption import preview_preemption
+        from .simulation.engine import SimulationError
+
+        if not scenario.binding:
+            raise SimulationError("Preemption scenario needs binding")
+        preemptor = next(
+            (rb for rb in bindings
+             if rb.metadata.key() == scenario.binding), None,
+        )
+        if preemptor is None:
+            raise SimulationError(
+                f"Preemption scenario targets unknown binding "
+                f"{scenario.binding!r}"
+            )
+        plan = preview_preemption(clusters, bindings, preemptor)
+        cut_of: dict[tuple[str, str], int] = {}
+        for v in plan.victims:
+            cut_of[(v.key, v.cluster)] = (
+                cut_of.get((v.key, v.cluster), 0) + v.replicas
+            )
+        diffs = [BindingDiff(
+            binding=plan.key,
+            before=list(preemptor.spec.clusters),
+            after=list(plan.targets),
+            error=plan.error,
+        )]
+        for vkey in plan.victim_keys():
+            victim = next(
+                (rb for rb in bindings if rb.metadata.key() == vkey), None,
+            )
+            before = list(victim.spec.clusters) if victim is not None else []
+            after = [
+                TargetCluster(
+                    name=tc.name,
+                    replicas=tc.replicas - cut_of.get((vkey, tc.name), 0),
+                )
+                for tc in before
+                if tc.replicas - cut_of.get((vkey, tc.name), 0) > 0
+            ]
+            diffs.append(BindingDiff(binding=vkey, before=before,
+                                     after=after))
+        return ScenarioReport(
+            scenario=scenario,
+            displaced=len(plan.victim_keys()),
+            unplaceable=0 if plan.feasible else 1,
+            diffs=diffs,
+            victims=[PreemptionVictim(
+                binding=v.key, cluster=v.cluster, replicas=v.replicas,
+                priority=v.priority,
+            ) for v in plan.victims],
+        )

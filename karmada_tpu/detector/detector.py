@@ -23,9 +23,12 @@ from ..api.policy import (
 from ..api.unstructured import Unstructured
 from ..api.work import (
     BindingSpec,
+    GANG_NAME_LABEL,
+    GANG_SIZE_LABEL,
     ObjectReference,
     ResourceBinding,
     RESOURCE_BINDING_PERMANENT_ID_LABEL,
+    SCHEDULE_PRIORITY_LABEL,
 )
 from ..interpreter.interpreter import ResourceInterpreter
 from ..runtime.controller import Controller, DONE, Runtime
@@ -241,6 +244,25 @@ class ResourceDetector:
             rb.metadata.labels[RESOURCE_BINDING_PERMANENT_ID_LABEL] = (
                 rb.metadata.uid or f"{obj.namespace}.{rb_name}"
             )
+        # workload-class plumbing (sched/preemption.py): gang membership and
+        # priority flow from the claiming policy, with template labels
+        # overriding per workload — several templates under one policy can
+        # then form one gang, and a single workload can out-rank its
+        # policy's default priority
+        labels = obj.metadata.labels
+        gang_name = labels.get(GANG_NAME_LABEL, policy.spec.gang_name)
+        gang_size = policy.spec.gang_size
+        if GANG_SIZE_LABEL in labels:
+            try:
+                gang_size = int(labels[GANG_SIZE_LABEL])
+            except ValueError:
+                pass  # malformed label: keep the policy's declaration
+        schedule_priority = policy.spec.scheduler_priority
+        if SCHEDULE_PRIORITY_LABEL in labels:
+            try:
+                schedule_priority = int(labels[SCHEDULE_PRIORITY_LABEL])
+            except ValueError:
+                pass
         new_spec = BindingSpec(
             resource=ObjectReference(
                 api_version=obj.api_version,
@@ -258,7 +280,10 @@ class ResourceDetector:
             replicas=replicas,
             replica_requirements=requirements,
             placement=policy.spec.placement,
-            schedule_priority=policy.spec.scheduler_priority,
+            schedule_priority=schedule_priority,
+            preemption_policy=policy.spec.scheduler_preemption,
+            gang_name=gang_name,
+            gang_size=gang_size,
             scheduler_name=policy.spec.scheduler_name,
             propagate_deps=policy.spec.propagate_deps,
             conflict_resolution=policy.spec.conflict_resolution,

@@ -261,6 +261,28 @@ microbatch_size = registry.histogram(
              1024, 2048, 4096),
 )
 
+# workload-class scheduling (sched/preemption.py — docs/SCHEDULING.md):
+# preemption plans by outcome (committed = victims cut + preemptor placed in
+# ONE atomic batch cohort; aborted = the rv-checked commit lost a race;
+# infeasible = even reclaiming every lower-priority replica places short),
+# gang admissions by outcome (placed = all K committed in one cohort;
+# timeout = the gang never completed inside the wait window; rejected =
+# joint feasibility or the atomic commit failed — the gang re-admits whole),
+# and how many victim bindings each committed plan cut
+preemptions_total = registry.counter(
+    "karmada_preemptions_total",
+    "Preemption plans by outcome (committed/aborted/infeasible)",
+)
+gang_admissions = registry.counter(
+    "karmada_gang_admissions_total",
+    "Gang admission outcomes (placed/timeout/rejected)",
+)
+preemption_victims = registry.histogram(
+    "karmada_preemption_victims",
+    "Victim bindings cut per committed preemption plan",
+    buckets=(1, 2, 4, 8, 16, 32, 64, 128),
+)
+
 # compile economics (sched/compilecache.py — docs/PERF.md): every XLA
 # backend compile is a jit-cache miss (the in-memory executable caches had
 # no program for that shape); with the persistent compilation cache enabled

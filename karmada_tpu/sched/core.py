@@ -74,7 +74,7 @@ class ScheduleDecision:
     host time before anything consumes them. Consumers see plain lists via
     the `targets`/`feasible` properties; assigning a list works too."""
 
-    __slots__ = ("key", "error", "affinity_name", "score",
+    __slots__ = ("key", "error", "affinity_name", "score", "speculative",
                  "_targets", "_targets_src", "_feasible", "_feasible_src")
 
     def __init__(self, key: str, targets=None, error: str = "",
@@ -83,6 +83,11 @@ class ScheduleDecision:
         self.error = error  # non-empty ⇒ unschedulable / fit error
         self.affinity_name = affinity_name  # applied ordered-affinity term
         self.score = score
+        # speculative victim-augmented decision (sched/preemption.py): the
+        # same launch solved this row a second time over reclaimable
+        # capacity; a short placement's preemption plan reads it instead
+        # of paying a second launch
+        self.speculative: "Optional[ScheduleDecision]" = None
         self._targets = targets
         self._targets_src = None
         self._feasible = feasible
@@ -1409,7 +1414,19 @@ class ArrayScheduler:
         """Second half of `launch_chunk`: sync + decode the chunk's dirty
         rows, run the ordered-affinity retry loop, write the replay cache,
         and merge with the replayed decisions — decisions return in the
-        chunk's binding order."""
+        chunk's binding order.
+
+        Mixed-priority chunks launched through the segmented tiered solve
+        (sched/preemption.py launch_tiered) ride the same seam: their
+        pending carries the "tiered" marker and materializes here, so the
+        StreamPipeline writer needs no routing of its own. Tiered
+        decisions never enter the replay cache — they depend on batch
+        composition, which the cache cannot key."""
+        if pending.get("tiered"):
+            from .preemption import materialize_tiered
+
+            with stage_span("materialize", self.stage_timer):
+                return materialize_tiered(self, pending)
         out = pending["out"]
         if pending["state"] is not None:
             decisions = self._materialize_solve(pending["state"])

@@ -1,0 +1,916 @@
+"""Workload-class scheduling: priority tiers, preemption, gang placement —
+all as batched array solves (ROADMAP item 3, docs/SCHEDULING.md).
+
+Three capabilities, one math:
+
+- **Priority tiers as a segmented solve.** A micro-batch whose rows carry
+  more than one `schedule_priority` solves as ONE device launch with
+  tier-ordered capacity consumption: the kernel loops over the (statically
+  padded) tier count, runs the standard `_schedule_body` program for every
+  row, commits only the active tier's rows, subtracts their resource
+  consumption from the capacity matrix, and hands the residual to the next
+  tier. Bit-identical to solving the tiers as separate sequential rounds
+  against capacity-decremented fleets (`solve_tiers_sequential` below is
+  the executable contract; tests/test_preemption.py pins it on the
+  single-chip and mesh legs) — but it stays one launch, so solves-per-tick
+  is O(1) in the tier count.
+
+- **Preemption as a second solve pass.** When a binding whose
+  `preemption_policy` is PreemptLowerPriority places short, the planner
+  builds a victim-augmented capacity matrix — placed replicas of
+  strictly-lower-priority bindings become reclaimable capacity — and
+  re-solves the whole preemptor batch once over [B, C] (one launch per
+  distinct preemptor priority; usually one). Victim selection then
+  minimizes disruption per cluster: fewest victims first (largest
+  reclaimable cut within the lowest priority level), lowest priority
+  first, youngest placement as the tie-break. The plan commits atomically:
+  victim replica reductions (flowing through the existing
+  graceful-eviction tasks) and the preemptor's placement in ONE rv-checked
+  `update_batch` cohort — all or nothing.
+
+- **Gang groups.** Bindings sharing `gang_name` co-admit as a cohort of
+  `gang_size` members: the queue-side GangCoordinator (sched/queue.py)
+  holds partial gangs until complete or a timeout rejects them, the solved
+  cohort passes a joint all-K-fully-placed feasibility check, and the K
+  placements commit in one all-or-nothing `update_batch` (scheduler.py
+  `_patch_gang`) — a mid-cohort stale-epoch veto re-admits the whole gang.
+
+Scope notes (documented limitations, all enforced in `wants_tiers`):
+rows carrying spread constraints or ordered multi-term affinities are
+host-driven searches and solve through the standard (unsegmented) path
+inside a tiered batch — the tier residual models the array-path rows;
+out-of-tree-plugin rounds never tier (stateful host hooks). Registered
+estimator answers (`extra_avail`) are snapshot-constant across tiers: the
+residual applies to the GeneralEstimator capacity bound, exactly as a
+sequential replay between which no member state changed. Tiered and
+preemption solves never enter the decision replay cache — their outputs
+depend on batch composition, which the cache cannot key.
+"""
+from __future__ import annotations
+
+import logging
+from dataclasses import dataclass, field
+from functools import partial
+from typing import Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..api.policy import PREEMPT_LOWER_PRIORITY
+from ..api.work import ResourceBinding, TargetCluster
+from ..models.batch import AGGREGATED, DUPLICATED, NON_WORKLOAD, pow2_bucket
+from ..models.fleet import to_int_units
+from .core import (
+    ArrayScheduler,
+    ScheduleDecision,
+    TOPK_TARGETS,
+    _device_tie,
+    _pad_extra_avail,
+    _schedule_body,
+    _sorted_pairs,
+    compact_outputs,
+    pad_batch,
+)
+from . import plugins as plugin_mod
+
+log = logging.getLogger(__name__)
+
+
+class _LaunchCounter:
+    """Process-global tiered/preemption solve-launch counter — the
+    acceptance seam for the one-launch invariants (a tiered micro-batch is
+    ONE kernel dispatch regardless of tier count; a preemption pass is one
+    per distinct preemptor priority)."""
+
+    def __init__(self) -> None:
+        self.tiered = 0
+        self.preempt = 0
+
+
+LAUNCHES = _LaunchCounter()
+
+
+def priority_of(rb) -> int:
+    return rb.spec.schedule_priority or 0
+
+
+def gang_of(rb) -> str:
+    """The binding's gang identity, or "" when it schedules solo (a gang
+    of one is just a binding)."""
+    if rb.spec.gang_name and (rb.spec.gang_size or 0) > 1:
+        return rb.spec.gang_name
+    return ""
+
+
+def wants_tiers(array: ArrayScheduler, bindings: Sequence) -> bool:
+    """Route a batch through the segmented tiered solve? Only when rows
+    actually span more than one priority — a uniform batch is exactly the
+    existing solve — and never under out-of-tree plugins (stateful host
+    hooks; they also disable replay for the same reason)."""
+    if len(bindings) < 2 or array._oot_plugins:
+        return False
+    it = iter(bindings)
+    first = priority_of(next(it))
+    return any(priority_of(rb) != first for rb in it)
+
+
+# --------------------------------------------------------------------------
+# the tiered kernel
+# --------------------------------------------------------------------------
+
+
+@partial(jax.jit, static_argnames=("n_tiers", "topk", "has_agg",
+                                   "plugin_bits", "speculate"))
+def _tiered_kernel(
+    # fleet (device-resident; capacity may be a victim-augmented override)
+    alive, capacity, has_summary, taint_key, taint_value, taint_effect, api_ok,
+    tier_of,  # i32[B] tier index per row (0 = highest priority)
+    # factored batch (models/batch.py BindingBatch, padded)
+    replicas, unknown_request, gvk, strategy, fresh,
+    tol_tables, tol_idx, aff_masks, aff_idx, weight_tables, weight_idx,
+    prev_idx, prev_rep, evict_idx, seeds, req_unique, req_idx,
+    extra_avail,  # i32[B,C] or [1,1] -1 sentinel
+    request_dense,  # i64[B,R] per-replica requests (consumption accounting)
+    reclaim,  # i64[n_tiers,C,R] reclaimable capacity per tier ([1,1,1]
+    #   zeros sentinel when speculate is off)
+    n_tiers: int = 1,
+    topk: int = TOPK_TARGETS,
+    has_agg: bool = True,
+    plugin_bits: int = plugin_mod.ALL_PLUGIN_BITS,
+    speculate: bool = False,
+):
+    """Decompress the factored batch ONCE, then run the schedule body once
+    per tier with tier-ordered capacity consumption: tier t's committed
+    rows subtract `placed_replicas x request` from the capacity matrix
+    before tier t+1 solves. The Python loop unrolls inside the jit (n_tiers
+    is static, padded to a pow2 bucket), so the whole segmented solve is
+    ONE device launch. Feasibility is capacity-independent (alive / taints
+    / api / affinity / eviction only), so it is computed once.
+
+    `speculate` adds the preemption SECOND PASS to the same launch: every
+    tier also solves over `cap + reclaim[t]` — the capacity that would
+    exist if every strictly-lower-priority placed replica were evicted —
+    WITHOUT registered-estimator answers (they cannot model victim-freed
+    capacity, exactly like the standalone planner). A short placement's
+    preemption plan then reads its augmented decision from this launch
+    instead of paying a second one."""
+    B = replicas.shape[0]
+    C = alive.shape[0]
+    rows = jnp.arange(B)[:, None]
+    tol = tol_tables[tol_idx]  # [B,4,K]
+    affinity_ok = aff_masks[aff_idx]
+    static_weight = weight_tables[weight_idx]
+    p = jnp.where((prev_idx >= 0) & (prev_idx < C), prev_idx, C)
+    prev_member = jnp.zeros((B, C), bool).at[rows, p].set(True, mode="drop")
+    prev_replicas = (
+        jnp.zeros((B, C), jnp.int32).at[rows, p].set(prev_rep, mode="drop")
+    )
+    e = jnp.where((evict_idx >= 0) & (evict_idx < C), evict_idx, C)
+    eviction_ok = jnp.ones((B, C), bool).at[rows, e].set(False, mode="drop")
+    tie = _device_tie(seeds, C)
+    extra = jnp.broadcast_to(extra_avail, (B, C))
+    no_extra = jnp.broadcast_to(jnp.int32(-1), (B, C))
+
+    def body(cap_t, extra_t):
+        return _schedule_body(
+            alive, cap_t, has_summary, taint_key, taint_value, taint_effect,
+            api_ok,
+            replicas, None, unknown_request, gvk, strategy, fresh,
+            tol[:, 0], tol[:, 1], tol[:, 2], tol[:, 3],
+            affinity_ok, eviction_ok, static_weight, prev_member,
+            prev_replicas, tie, extra_t,
+            narrow=False, has_agg=has_agg,
+            req_unique=req_unique, req_idx=req_idx,
+            plugin_bits=plugin_bits,
+        )
+
+    cap = capacity
+    out_result = out_unsched = out_asum = feasible = None
+    aug_result = aug_unsched = aug_asum = None
+    for t in range(n_tiers):
+        feas_t, _score, res_t, unsch_t, asum_t, _avail = body(cap, extra)
+        m = tier_of == t
+        # consumption counts only rows that PLACE: an unschedulable row's
+        # partial dispenser output never commits (the decode answers an
+        # error), so the sequential reference subtracts nothing for it —
+        # the residual must match
+        placed = jnp.where((m & ~unsch_t)[:, None], res_t, 0)
+        if feasible is None:
+            feasible = feas_t
+            out_result = placed
+            out_unsched = m & unsch_t
+            out_asum = jnp.where(m, asum_t, 0)
+        else:
+            out_result = jnp.where(m[:, None], res_t, out_result)
+            out_unsched = jnp.where(m, unsch_t, out_unsched)
+            out_asum = jnp.where(m, asum_t, out_asum)
+        if speculate:
+            _f, _s, ares_t, aunsch_t, aasum_t, _a = body(
+                cap + reclaim[t], no_extra,
+            )
+            if aug_result is None:
+                aug_result = jnp.where(m[:, None], ares_t, 0)
+                aug_unsched = m & aunsch_t
+                aug_asum = jnp.where(m, aasum_t, 0)
+            else:
+                aug_result = jnp.where(m[:, None], ares_t, aug_result)
+                aug_unsched = jnp.where(m, aunsch_t, aug_unsched)
+                aug_asum = jnp.where(m, aasum_t, aug_asum)
+        if t + 1 < n_tiers:
+            cons = placed.astype(jnp.int64).T @ request_dense  # [C,R]
+            cap = jnp.maximum(cap - cons, 0)
+    feas_count, nnz, top_idx, top_val = compact_outputs(
+        feasible, out_result, topk
+    )
+    out = (out_unsched, out_asum, feas_count, nnz, top_idx, top_val,
+           out_result)
+    if speculate:
+        _fc, aug_nnz, aug_idx, aug_val = compact_outputs(
+            feasible, aug_result, topk
+        )
+        out += (aug_unsched, aug_asum, aug_nnz, aug_idx, aug_val, aug_result)
+    return out
+
+
+def _batch_static_flags(raw, n_cols: int) -> tuple[int, bool]:
+    """(topk, has_agg) for a raw (unpadded) batch. Unlike the main solve's
+    content-derived window (ArrayScheduler._batch_flags), topk here is
+    pinned to the FLEET width bucket: tiered batches mix re-admitted
+    victims with fresh preemptors, so a content-derived bound flips its
+    bucket as victim replica counts drift — every flip a fresh XLA
+    compile in the middle of a preemption wave (bench-surfaced). The
+    fixed window costs a slightly larger device→host transfer on small
+    batches and keeps the steady state at zero compiles; rows wider than
+    the window still fall back to a dense row fetch."""
+    topk = min(pow2_bucket(max(n_cols, 1), lo=8), TOPK_TARGETS)
+    return max(topk, 1), bool((raw.strategy == AGGREGATED).any())
+
+
+def _tier_assignment(bindings: Sequence) -> tuple[np.ndarray, int]:
+    """tier_of[i] per row (0 = highest priority) + the tier count padded to
+    a pow2 bucket so the jit cache stays bounded; pad tiers have no rows
+    and are no-ops (empty commit mask, zero consumption)."""
+    prios = np.asarray([priority_of(rb) for rb in bindings], np.int64)
+    uniq = np.unique(prios)[::-1]  # descending: tier 0 = highest
+    tier_of = np.searchsorted(-uniq, -prios).astype(np.int32)
+    return tier_of, int(pow2_bucket(len(uniq), lo=1))
+
+
+def _eligible_rows(bindings: Sequence) -> tuple[list[int], list[int]]:
+    """Split a batch into tiered-kernel rows and standard-path rows (spread
+    constraints / ordered multi-term affinities are host-driven searches
+    the dense kernel does not cover — same partition the simulation engine
+    applies)."""
+    kernel_rows, std_rows = [], []
+    for i, rb in enumerate(bindings):
+        p = rb.spec.placement
+        if p is not None and (p.spread_constraints or p.cluster_affinities):
+            std_rows.append(i)
+        else:
+            kernel_rows.append(i)
+    return kernel_rows, std_rows
+
+
+_NO_RECLAIM = np.zeros((1, 1, 1), np.int64)
+
+
+def _launch_kernel_rows(array: ArrayScheduler, bindings: list,
+                        extra_avail, capacity_override=None,
+                        reclaim_tiers=None,
+                        count: str = "tiered") -> dict:
+    """Encode + dispatch the tiered kernel for kernel-eligible rows; the
+    returned state feeds `_materialize_kernel_rows`. No device sync here —
+    the pipelined caller materializes on the writer thread. With
+    `reclaim_tiers` (i64[n_tiers,C,R]) the launch also solves the
+    speculative victim-augmented pass in the same dispatch."""
+    with array._encode_lock:
+        raw = array.batch_encoder.encode(bindings)
+    batch = pad_batch(raw, array._bucket)
+    C = len(array.fleet.names)
+    tier_of, n_tiers = _tier_assignment(bindings)
+    tier_pad = np.zeros(len(batch.replicas), np.int32)
+    tier_pad[: len(bindings)] = tier_of
+    if extra_avail is not None:
+        extra_np = _pad_extra_avail(
+            np.asarray(extra_avail, np.int32), C, len(batch.replicas)
+        )
+    else:
+        extra_np = ArrayScheduler._NO_EXTRA
+    topk, has_agg = _batch_static_flags(raw, C)
+    topk = min(topk, max(C, 1))
+    fleet_dev = array._fleet_dev
+    if capacity_override is not None:
+        fleet_dev = (
+            fleet_dev[0], jnp.asarray(capacity_override, jnp.int64),
+            *fleet_dev[2:],
+        )
+    speculate = reclaim_tiers is not None
+    out = _tiered_kernel(
+        *fleet_dev, tier_pad,
+        batch.replicas, batch.unknown_request, batch.gvk, batch.strategy,
+        batch.fresh, batch.tol_tables, batch.tol_idx, batch.aff_masks,
+        batch.aff_idx, batch.weight_tables, batch.weight_idx,
+        batch.prev_idx, batch.prev_rep, batch.evict_idx, batch.seeds,
+        batch.req_unique, batch.req_idx,
+        extra_np, np.asarray(batch.request, np.int64),
+        reclaim_tiers if speculate else _NO_RECLAIM,
+        n_tiers=n_tiers, topk=topk, has_agg=has_agg,
+        plugin_bits=array._plugin_bits,
+        speculate=speculate,
+    )
+    if count == "tiered":
+        LAUNCHES.tiered += 1
+    else:
+        LAUNCHES.preempt += 1
+    return {"raw": raw, "out": out, "n": len(bindings),
+            "names": array.fleet.names, "n_tiers": n_tiers,
+            "speculate": speculate}
+
+
+def _decode_rows(raw, names, real, rows_j, unsched, asum, feas_count, nnz,
+                 tis, tvs, window, result_dev) -> dict:
+    """Decode a set of kernel rows into ScheduleDecisions (the simulation
+    engine's decode, single-scenario): compact top-K pairs, unschedulable/
+    empty-feasible errors in the live solver's vocabulary, dense-row fetch
+    for rows whose target set overflows the window."""
+    decisions: dict[int, ScheduleDecision] = {}
+    overflow: list[tuple[int, ScheduleDecision]] = []
+    for j in rows_j:
+        key = raw.keys[j]
+        strat = int(raw.strategy[j])
+        if feas_count[j] == 0:
+            decisions[j] = ScheduleDecision(
+                key, error=f"0/{real} clusters are available",
+            )
+        elif unsched[j]:
+            decisions[j] = ScheduleDecision(
+                key,
+                error=(f"Clusters available replicas {int(asum[j])} are "
+                       "not enough to schedule."),
+            )
+        elif strat == NON_WORKLOAD:
+            decisions[j] = ScheduleDecision(key, targets=[])
+        elif int(nnz[j]) > window:
+            dec = ScheduleDecision(key)
+            decisions[j] = dec
+            overflow.append((j, dec))
+        else:
+            k = int(nnz[j])
+            decisions[j] = ScheduleDecision(key, targets=[
+                TargetCluster(name=names[int(tis[j, t])],
+                              replicas=int(tvs[j, t]))
+                for t in range(k)
+            ])
+    if overflow:
+        rows = np.asarray([j for j, _ in overflow])
+        dense = np.asarray(jax.device_get(result_dev[rows]))
+        for m, (_, dec) in enumerate(overflow):
+            pos = np.nonzero(dense[m] > 0)[0]
+            dec.targets = [
+                TargetCluster(name=names[int(i)], replicas=int(dense[m, i]))
+                for i in pos
+            ]
+    return decisions
+
+
+def _materialize_kernel_rows(state: dict,
+                             armed: Sequence[int] = ()
+                             ) -> list[ScheduleDecision]:
+    """Sync + decode the tiered kernel outputs. With a speculative launch,
+    `armed` rows also decode their victim-augmented decision onto
+    `decision.speculative` — the preemption pass reads it from there
+    instead of launching a second solve."""
+    raw, n, names = state["raw"], state["n"], state["names"]
+    speculate = state.get("speculate", False)
+    host = [np.asarray(a)
+            for a in jax.device_get(state["out"][:6] + (
+                state["out"][7:12] if speculate else ()))]
+    (unsched, asum, feas_count, nnz, top_idx, top_val) = host[:6]
+    result_dev = state["out"][6]
+    tis, tvs = _sorted_pairs(top_idx[:n], top_val[:n])
+    window = top_idx.shape[1]
+    real = sum(1 for nm in names if not nm.startswith("__shape-pad-"))
+    decoded = _decode_rows(
+        raw, names, real, range(n), unsched, asum, feas_count, nnz,
+        tis, tvs, window, result_dev,
+    )
+    decisions = [decoded[j] for j in range(n)]
+    if speculate and armed:
+        (a_unsched, a_asum, a_nnz, a_idx, a_val) = host[6:11]
+        a_tis, a_tvs = _sorted_pairs(a_idx[:n], a_val[:n])
+        aug = _decode_rows(
+            raw, names, real, list(armed), a_unsched, a_asum, feas_count,
+            a_nnz, a_tis, a_tvs, a_idx.shape[1], state["out"][12],
+        )
+        for j, dec in aug.items():
+            decisions[j].speculative = dec
+    return decisions
+
+
+def armed_for_preemption(rb) -> bool:
+    """Does this row want the speculative victim-augmented second pass?
+    PreemptLowerPriority, non-gang (cutting into a gang's cohort would
+    break its all-or-nothing contract, and a gang preemptor commits whole
+    or not at all — out of scope, documented)."""
+    return (rb.spec.preemption_policy == PREEMPT_LOWER_PRIORITY
+            and not gang_of(rb))
+
+
+# armed-row speculation cap: a handful of preemption-armed rows must not
+# drag a HUGE uniform-priority chunk off the partitioned solve path (which
+# has the host-sort twins and the replay cache) — past this row count the
+# chunk solves normally and a short-placed preemptor falls back to the
+# standalone planner's launch (one extra solve per preemption, correct
+# either way). Mixed-priority chunks always tier: the residual semantics
+# require the segmented launch regardless of size.
+SPECULATE_MAX_ROWS = 512
+
+
+def wants_workload_solve(array: ArrayScheduler, bindings: Sequence,
+                         preemption: bool = True) -> bool:
+    """Route a batch through the workload-class launch? Mixed priorities
+    (the segmented tiered solve) or any preemption-armed row (the
+    speculative second pass rides the same launch, bounded by
+    SPECULATE_MAX_ROWS). Never under out-of-tree plugins (stateful host
+    hooks)."""
+    if not bindings or array._oot_plugins:
+        return False
+    if (preemption and len(bindings) <= SPECULATE_MAX_ROWS
+            and any(armed_for_preemption(rb) for rb in bindings)):
+        return True
+    return wants_tiers(array, bindings)
+
+
+def _tier_reclaim(array: ArrayScheduler, bindings: list, placed) -> tuple:
+    """(reclaim i64[n_tiers,C,R], armed row indices) for a speculative
+    launch: per tier carrying an armed row, every strictly-lower-priority
+    placed replica's request folds into that tier's reclaimable matrix.
+    Tiers without armed rows stay zero (nothing reads their pass)."""
+    armed = [i for i, rb in enumerate(bindings)
+             if armed_for_preemption(rb)]
+    if not armed or placed is None:
+        return None, armed
+    resources = array.encoder.resources
+    names = array.fleet.names
+    col_of = {nm: c for c, nm in enumerate(names)}
+    tier_of, n_tiers = _tier_assignment(bindings)
+    C, R = len(names), len(resources)
+    reclaim = np.zeros((n_tiers, C, R), np.int64)
+    for t in sorted({int(tier_of[i]) for i in armed}):
+        row = next(i for i in armed if tier_of[i] == t)
+        for rb in victim_candidates(placed, bindings[row]):
+            units = _request_units(rb, resources)
+            for tc in rb.spec.clusters:
+                c = col_of.get(tc.name)
+                if c is not None and tc.replicas > 0:
+                    reclaim[t, c] += units * tc.replicas
+    return reclaim, armed
+
+
+def launch_tiered(array: ArrayScheduler, bindings: Sequence,
+                  extra_avail=None, placed=None) -> dict:
+    """Launch one workload-class batch — drop-in for
+    `ArrayScheduler.launch_chunk` (the pending dict rides the same
+    materialize seam; `materialize_chunk` dispatches on the "tiered"
+    marker). Mixed priorities solve as the segmented tiered pass;
+    preemption-armed rows additionally solve their victim-augmented
+    variant in the SAME launch (`placed` is the victim-candidate
+    snapshot). Spread/multi-term rows take the standard path inside the
+    same pending; tiered decisions never enter the replay cache (their
+    outputs depend on batch composition)."""
+    bindings = list(bindings)
+    kernel_rows, std_rows = _eligible_rows(bindings)
+    state = std_state = None
+    armed: list[int] = []
+    if kernel_rows:
+        krows = [bindings[i] for i in kernel_rows]
+        sub_extra = (None if extra_avail is None
+                     else np.asarray(extra_avail)[kernel_rows])
+        reclaim, armed = _tier_reclaim(array, krows, placed)
+        state = _launch_kernel_rows(
+            array, krows, sub_extra, reclaim_tiers=reclaim,
+        )
+    if std_rows:
+        sub_extra = (None if extra_avail is None
+                     else np.asarray(extra_avail)[std_rows])
+        std_state = array._launch_solve([bindings[i] for i in std_rows],
+                                        sub_extra)
+    return {
+        "tiered": True, "bindings": bindings,
+        "kernel_rows": kernel_rows, "std_rows": std_rows,
+        "state": state, "std_state": std_state, "armed": armed,
+        "replayed": 0, "solved": len(bindings),
+        "n_tiers": state["n_tiers"] if state else 1,
+    }
+
+
+def materialize_tiered(array: ArrayScheduler,
+                       pending: dict) -> list[ScheduleDecision]:
+    out: list[Optional[ScheduleDecision]] = [None] * len(pending["bindings"])
+    if pending["state"] is not None:
+        for i, dec in zip(
+            pending["kernel_rows"],
+            _materialize_kernel_rows(pending["state"],
+                                     armed=pending.get("armed", ())),
+        ):
+            out[i] = dec
+    if pending["std_state"] is not None:
+        for i, dec in zip(pending["std_rows"],
+                          array._materialize_solve(pending["std_state"])):
+            out[i] = dec
+    return out
+
+
+# --------------------------------------------------------------------------
+# the sequential reference (the executable parity contract)
+# --------------------------------------------------------------------------
+
+
+def solve_tiers_sequential(clusters: Sequence, bindings: Sequence,
+                           ) -> list[ScheduleDecision]:
+    """THE contract the tiered kernel is pinned against: solve each
+    priority tier (descending) as its own cold ArrayScheduler round on a
+    fleet whose allocated capacity has grown by every higher tier's placed
+    consumption — exactly what running the tiers as separate sequential
+    rounds against refreshed summaries would do. O(tiers) launches and a
+    fleet re-encode per tier; exists for tests and documentation, never on
+    a hot path."""
+    import copy
+
+    bindings = list(bindings)
+    decisions: list[Optional[ScheduleDecision]] = [None] * len(bindings)
+    cur = [copy.deepcopy(c) for c in clusters]
+    prios = sorted({priority_of(rb) for rb in bindings}, reverse=True)
+    for prio in prios:
+        rows = [i for i, rb in enumerate(bindings)
+                if priority_of(rb) == prio]
+        sched = ArrayScheduler(cur)
+        for i, dec in zip(rows, sched.schedule([bindings[i] for i in rows])):
+            decisions[i] = dec
+        # decrement: this tier's placements enter `allocated`, so the next
+        # tier's capacity = allocatable - allocated shrinks exactly as the
+        # kernel's consumption subtraction does
+        by_name = {c.name: c for c in cur}
+        for i in rows:
+            dec = decisions[i]
+            rb = bindings[i]
+            if not dec.ok or not dec.targets:
+                continue
+            rr = rb.spec.replica_requirements
+            req = rr.resource_request if rr is not None else {}
+            for tc in dec.targets:
+                c = by_name.get(tc.name)
+                if c is None or c.status.resource_summary is None:
+                    continue
+                rs = c.status.resource_summary
+                for rname, val in req.items():
+                    rs.allocated[rname] = (
+                        rs.allocated.get(rname, 0.0) + val * tc.replicas
+                    )
+    return decisions
+
+
+# --------------------------------------------------------------------------
+# preemption: plan / commit / preview
+# --------------------------------------------------------------------------
+
+
+@dataclass
+class VictimCut:
+    """One victim replica reduction: `replicas` reclaimed from `cluster`."""
+
+    key: str  # victim binding namespace/name
+    cluster: str
+    replicas: int
+    priority: int = 0
+
+
+@dataclass
+class PreemptionPlan:
+    key: str  # preemptor binding namespace/name
+    priority: int = 0
+    feasible: bool = False
+    error: str = ""
+    targets: list[TargetCluster] = field(default_factory=list)
+    victims: list[VictimCut] = field(default_factory=list)
+
+    def victim_keys(self) -> list[str]:
+        seen: list[str] = []
+        for v in self.victims:
+            if v.key not in seen:
+                seen.append(v.key)
+        return seen
+
+
+def victim_candidates(bindings: Sequence, preemptor) -> list:
+    """Placed bindings the preemptor may evict from: strictly lower
+    priority, same scheduler, not suspended/deleting, and not gang members
+    (cutting one member would break its gang's all-or-nothing contract)."""
+    prio = priority_of(preemptor)
+    sched_name = preemptor.spec.scheduler_name or ""
+    out = []
+    for rb in bindings:
+        if rb.metadata.key() == preemptor.metadata.key():
+            continue
+        if priority_of(rb) >= prio:
+            continue
+        if not rb.spec.clusters:
+            continue
+        if (rb.spec.scheduler_name or "") != sched_name:
+            continue
+        if rb.metadata.deletion_timestamp is not None:
+            continue
+        if rb.spec.scheduling_suspended() or gang_of(rb):
+            continue
+        out.append(rb)
+    return out
+
+
+# request-unit vectors memoized per (uid, generation, resource vocab):
+# candidate sets are stable across preemption waves, and rebuilding a few
+# hundred tiny arrays per plan was measurable host time on the decision
+# path. Bounded — cleared wholesale when it outgrows the working set.
+_UNITS_MEMO: dict = {}
+
+
+def _request_units(rb, resources: Sequence[str]) -> np.ndarray:
+    """Per-replica request in the fleet's integer units (cpu milli), zero
+    for resources outside the vocabulary — the same conversion the fleet
+    encoder applies to summaries."""
+    key = (rb.metadata.uid, rb.metadata.generation, len(resources))
+    hit = _UNITS_MEMO.get(key) if rb.metadata.uid else None
+    if hit is not None:
+        return hit
+    req = np.zeros(len(resources), np.int64)
+    rr = rb.spec.replica_requirements
+    if rr is not None:
+        for rname, val in rr.resource_request.items():
+            try:
+                r = resources.index(rname)
+            except ValueError:
+                continue
+            req[r] = to_int_units(rname, val)
+    if rb.metadata.uid:
+        if len(_UNITS_MEMO) > 16384:
+            _UNITS_MEMO.clear()
+        _UNITS_MEMO[key] = req
+    return req
+
+
+def plan_preemption(array: ArrayScheduler, placed: Sequence,
+                    preemptors: Sequence,
+                    ledger: Optional[PlanLedger] = None,
+                    ) -> list[PreemptionPlan]:
+    """Second solve pass for a batch of short-placed preemptors: ONE
+    victim-augmented [B, C] launch per distinct preemptor priority, then
+    host-side minimal-disruption victim selection. Pure — reads the fleet
+    encoding and the supplied binding snapshots, mutates nothing; the
+    caller owns the atomic commit (and POST /simulate's preview calls this
+    exact function, which is what makes the previewed victim set identical
+    to the live one)."""
+    resources = array.encoder.resources
+    names = array.fleet.names
+    col_of = {nm: c for c, nm in enumerate(names)}
+    if ledger is None:
+        ledger = PlanLedger(np.asarray(array.fleet.capacity, np.int64))
+    plans: list[PreemptionPlan] = []
+    by_prio: dict[int, list] = {}
+    for rb in preemptors:
+        by_prio.setdefault(priority_of(rb), []).append(rb)
+    for prio in sorted(by_prio, reverse=True):
+        group = by_prio[prio]
+        cands = victim_candidates(placed, group[0])
+        plans.extend(_plan_priority_group(
+            array, group, cands, prio, resources, names, col_of, ledger,
+        ))
+    return plans
+
+
+def _plan_priority_group(array, group, cands, prio, resources, names,
+                         col_of, ledger=None) -> list[PreemptionPlan]:
+    C = len(names)
+    R = len(resources)
+    if not cands:
+        return [PreemptionPlan(
+            key=rb.metadata.key(), priority=prio,
+            error="no lower-priority replicas to reclaim",
+        ) for rb in group]
+    # reclaimable capacity: every strictly-lower-priority placed replica's
+    # request, folded per cluster
+    reclaim = np.zeros((C, R), np.int64)
+    for rb in cands:
+        units = _request_units(rb, resources)
+        for tc in rb.spec.clusters:
+            c = col_of.get(tc.name)
+            if c is not None and tc.replicas > 0:
+                reclaim[c] += units * tc.replicas
+    capacity = np.asarray(array.fleet.capacity, np.int64) + reclaim
+    state = _launch_kernel_rows(array, list(group), None,
+                                capacity_override=capacity, count="preempt")
+    decisions = _materialize_kernel_rows(state)
+    return _plans_from_decisions(array, group, decisions, cands, prio,
+                                 resources, names, col_of, ledger=ledger)
+
+
+class PlanLedger:
+    """Cross-group planning accounting: one preemption pass may plan
+    several priority groups (and mix the speculative and standalone
+    paths), and each group's victim selection must see the free capacity
+    and victim replicas EARLIER groups already claimed — without this,
+    two preemptors in one batch each count the same free units / the same
+    reclaimable victim as covering their own deficit, and the joint
+    commit overcommits the cluster (review-surfaced)."""
+
+    def __init__(self, free: np.ndarray):
+        self.free_left = np.maximum(np.asarray(free, np.int64), 0).copy()
+        self.victim_cut: dict[tuple[str, int], int] = {}
+
+    def cut_so_far(self, key: str, c: int) -> int:
+        return self.victim_cut.get((key, int(c)), 0)
+
+    def note_cut(self, key: str, c: int, replicas: int) -> None:
+        k = (key, int(c))
+        self.victim_cut[k] = self.victim_cut.get(k, 0) + replicas
+
+
+def _plans_from_decisions(array, group, decisions, cands, prio, resources,
+                          names, col_of,
+                          ledger: Optional[PlanLedger] = None,
+                          ) -> list[PreemptionPlan]:
+    """The host half of a preemption plan: victim selection for a group of
+    SOLVED augmented decisions — shared verbatim by the standalone planner
+    (plan_preemption, which the preview uses) and the speculative in-launch
+    path (plan_from_speculative), so the two can never select different
+    victims for the same solve. Deficits accumulate per cluster so two
+    preemptors landing on one cluster select a joint victim set; `ledger`
+    carries the accounting ACROSS groups within one pass."""
+    C = len(names)
+    R = len(resources)
+    cand_units = {
+        rb.metadata.key(): _request_units(rb, resources) for rb in cands
+    }
+    if ledger is None:
+        ledger = PlanLedger(np.asarray(array.fleet.capacity, np.int64))
+    deficit = np.zeros((C, R), np.int64)
+    plans = []
+    for rb, dec in zip(group, decisions):
+        plan = PreemptionPlan(key=rb.metadata.key(), priority=prio)
+        if dec is None or not dec.ok:
+            plan.error = (dec.error if dec is not None else "") \
+                or "preemption solve placed short"
+            plans.append(plan)
+            continue
+        plan.feasible = True
+        plan.targets = list(dec.targets or [])
+        units = _request_units(rb, resources)
+        for tc in plan.targets:
+            c = col_of.get(tc.name)
+            if c is not None:
+                deficit[c] += units * tc.replicas
+        plans.append(plan)
+    need = np.maximum(deficit - ledger.free_left, 0)
+    # this group's placements consume the free units first; later groups
+    # see only the remainder
+    ledger.free_left = np.maximum(ledger.free_left - deficit, 0)
+    victims = _select_victims(need, cands, cand_units, col_of, names,
+                              ledger=ledger)
+    feasible_plans = [p for p in plans if p.feasible]
+    if victims is None:
+        # the greedy could not cover the deficit (a candidate vanished
+        # between snapshot and plan): the plans are not safely committable
+        for p in feasible_plans:
+            p.feasible = False
+            p.error = "victim selection could not cover the deficit"
+        return plans
+    for p in feasible_plans:
+        p.victims = victims
+    return plans
+
+
+def plan_from_speculative(array, placed, pairs,
+                          ledger: Optional[PlanLedger] = None,
+                          ) -> list[PreemptionPlan]:
+    """Preemption plans for rows whose victim-augmented decision already
+    rode the admission launch (decision.speculative): ZERO extra solves —
+    only the host victim-selection half runs. `pairs` is
+    [(binding, speculative_decision), ...]."""
+    resources = array.encoder.resources
+    names = array.fleet.names
+    col_of = {nm: c for c, nm in enumerate(names)}
+    if ledger is None:
+        ledger = PlanLedger(np.asarray(array.fleet.capacity, np.int64))
+    by_prio: dict[int, list] = {}
+    for rb, dec in pairs:
+        by_prio.setdefault(priority_of(rb), []).append((rb, dec))
+    plans: list[PreemptionPlan] = []
+    for prio in sorted(by_prio, reverse=True):
+        group = by_prio[prio]
+        cands = victim_candidates(placed, group[0][0])
+        if not cands:
+            plans.extend(PreemptionPlan(
+                key=rb.metadata.key(), priority=prio,
+                error="no lower-priority replicas to reclaim",
+            ) for rb, _d in group)
+            continue
+        plans.extend(_plans_from_decisions(
+            array, [rb for rb, _d in group], [d for _rb, d in group],
+            cands, prio, resources, names, col_of, ledger=ledger,
+        ))
+    return plans
+
+
+def _select_victims(need: np.ndarray, cands, cand_units, col_of,
+                    names, ledger: Optional[PlanLedger] = None,
+                    ) -> Optional[list[VictimCut]]:
+    """Minimal-disruption greedy per cluster: iterate candidate priorities
+    ascending (lowest first); within a priority take the candidate
+    covering the most deficit first (fewest victims), youngest placement
+    as the tie-break; cut only as many replicas as the deficit requires
+    (partial reductions, not whole evictions). Deterministic: final
+    tie-break is the binding key.
+
+    Candidate features are assembled as flat arrays once and ordered with
+    one lexsort per cluster — per-candidate numpy calls inside a sort key
+    were the planner's host hot spot (bench-surfaced)."""
+    cuts: list[VictimCut] = []
+    deficit_cols = np.nonzero(need.any(axis=1))[0]
+    if not len(deficit_cols):
+        return cuts
+    # candidate features, one pass: replicas-on-cluster per deficit col
+    n = len(cands)
+    prio = np.fromiter((priority_of(rb) for rb in cands), np.int64, n)
+    age = np.fromiter(
+        ((rb.status.last_scheduled_time or 0.0) for rb in cands),
+        np.float64, n,
+    )
+    units_mat = np.stack([cand_units[rb.metadata.key()] for rb in cands]) \
+        if n else np.zeros((0, need.shape[1]), np.int64)
+    keys = [rb.metadata.key() for rb in cands]
+    key_rank = np.argsort(np.argsort(keys))
+    on_cluster = np.zeros((n, len(deficit_cols)), np.int64)
+    col_pos = {int(c): i for i, c in enumerate(deficit_cols)}
+    for i, rb in enumerate(cands):
+        for tc in rb.spec.clusters:
+            p = col_pos.get(col_of.get(tc.name, -1))
+            if p is not None:
+                on_cluster[i, p] = tc.replicas
+    for p, c in enumerate(deficit_cols):
+        rem = need[c].copy()
+        on_c = on_cluster[:, p]
+        helps = (on_c > 0) & ((units_mat > 0) & (rem[None, :] > 0)).any(1)
+        idx = np.nonzero(helps)[0]
+        if len(idx):
+            cover = np.minimum(
+                units_mat[idx] * on_c[idx, None], rem[None, :]
+            ).sum(1)
+            # order: priority asc, coverage desc, youngest first, key asc
+            order = np.lexsort((key_rank[idx], -age[idx], -cover,
+                                prio[idx]))
+            for i in idx[order]:
+                if not (rem > 0).any():
+                    break
+                units = units_mat[i]
+                sel = (units > 0) & (rem > 0)
+                if not sel.any():
+                    continue
+                # minimal cut covering the remaining deficit this victim
+                # can address, capped by its replicas on the cluster MINUS
+                # whatever an earlier group in this pass already claimed
+                avail = int(on_c[i])
+                if ledger is not None:
+                    avail -= ledger.cut_so_far(keys[i], int(c))
+                cut = int(min(avail, int(
+                    -(-rem[sel] // units[sel]).max()
+                )))
+                if cut <= 0:
+                    continue
+                rem = np.maximum(rem - units * cut, 0)
+                if ledger is not None:
+                    ledger.note_cut(keys[i], int(c), cut)
+                cuts.append(VictimCut(
+                    key=keys[i], cluster=names[int(c)], replicas=cut,
+                    priority=int(prio[i]),
+                ))
+        if (rem > 0).any():
+            return None
+    return cuts
+
+
+def preview_preemption(clusters: Sequence, bindings: Sequence,
+                       preemptor) -> PreemptionPlan:
+    """POST /simulate's preemption preview: plan against a fresh fleet
+    encoding of the same snapshot the live planner would see — identical
+    victim set by construction (shared plan_preemption), zero store
+    mutation. `preemptor` is an existing (typically pending) binding; its
+    current placement, if any, is ignored (the plan answers 'where would
+    it land and who pays')."""
+    import copy
+
+    pre = copy.deepcopy(preemptor)
+    pre.spec.clusters = []
+    array = ArrayScheduler(sorted(clusters, key=lambda c: c.name))
+    placed = [rb for rb in bindings
+              if rb.metadata.key() != pre.metadata.key()]
+    plans = plan_preemption(array, placed, [pre])
+    return plans[0]

@@ -35,6 +35,86 @@ DEFAULT_BACKOFF_MAX = 10.0
 DEFAULT_UNSCHEDULABLE_MAX_STAY = 300.0  # 5 min
 DEFAULT_AGING_STEP = 60.0  # +1 effective priority per minute of queue age
 
+DEFAULT_GANG_WAIT = 30.0  # partial gangs reject after this hold window
+
+
+class GangCoordinator:
+    """The queue-side half of gang scheduling (sched/preemption.py): holds
+    partial gangs — bindings sharing `spec.gang_name`, expecting
+    `spec.gang_size` members — until the cohort completes (all K offered)
+    or a timeout rejects it. A drained gang member parks HERE instead of
+    entering a micro-batch; the offer that completes the gang releases
+    every held member into the CURRENT batch formation, so the cohort
+    always solves (and commits) together.
+
+    Held entries keep the binding snapshot + admission epoch captured at
+    offer time: a member whose spec changes while held re-offers through
+    its own watch event and REPLACES the stale entry (key-based), and the
+    epoch fence discards any decision computed on a replaced snapshot.
+    Thread-safe — the streaming admission loop and the batch daemon's
+    drain both offer."""
+
+    def __init__(self, clock, wait_seconds: float = DEFAULT_GANG_WAIT):
+        self.clock = clock
+        self.wait_seconds = wait_seconds
+        self._lock = threading.Lock()
+        # gang -> key -> (binding snapshot, epoch)
+        self._held: dict[str, dict[str, tuple]] = {}
+        self._deadline: dict[str, float] = {}
+        self._size: dict[str, int] = {}
+
+    def offer(self, key: str, rb, epoch: int = 0) -> list[tuple]:
+        """Offer one gang member. Returns the full cohort [(key, binding,
+        epoch), ...] when this offer completes the gang (the coordinator
+        forgets it — the cohort is the caller's now), else [] (held)."""
+        gname = rb.spec.gang_name
+        with self._lock:
+            g = self._held.setdefault(gname, {})
+            if not g:
+                self._deadline[gname] = self.clock.now() + self.wait_seconds
+            g[key] = (rb, epoch)
+            # misdeclared sizes: the largest declared K wins (a gang can
+            # only complete when every declared expectation is met)
+            self._size[gname] = max(
+                self._size.get(gname, 0), int(rb.spec.gang_size or 0)
+            )
+            if len(g) >= max(self._size[gname], 1):
+                self._forget_locked(gname)
+                return [(k, r, e) for k, (r, e) in g.items()]
+            return []
+
+    def discard(self, key: str, gang_name: str) -> None:
+        """Drop one held member (tombstone / re-target / suspension): the
+        remainder keeps waiting and times out if never completed."""
+        with self._lock:
+            g = self._held.get(gang_name)
+            if g is not None:
+                g.pop(key, None)
+                if not g:
+                    self._forget_locked(gang_name)
+
+    def expire(self, now: float) -> list[tuple[str, list[tuple]]]:
+        """Pop every gang whose hold window elapsed incomplete:
+        [(gang_name, [(key, binding, epoch), ...]), ...]."""
+        out = []
+        with self._lock:
+            for gname in [g for g, d in self._deadline.items() if now >= d]:
+                members = self._held.get(gname, {})
+                out.append(
+                    (gname, [(k, r, e) for k, (r, e) in members.items()])
+                )
+                self._forget_locked(gname)
+        return out
+
+    def held_count(self) -> int:
+        with self._lock:
+            return sum(len(g) for g in self._held.values())
+
+    def _forget_locked(self, gname: str) -> None:
+        self._held.pop(gname, None)
+        self._deadline.pop(gname, None)
+        self._size.pop(gname, None)
+
 
 class PrioritySchedulingQueue:
     """activeQ + backoffQ + unschedulable pool.

@@ -23,8 +23,13 @@ from ..api.meta import Condition, set_condition
 from ..api.policy import DEFAULT_SCHEDULER_NAME, REPLICA_SCHEDULING_DUPLICATED
 from ..api.work import (
     CONDITION_SCHEDULED,
+    EVICTION_PRODUCER_PREEMPTION,
+    EVICTION_REASON_PREEMPTED,
+    GracefulEvictionTask,
     POLICY_PLACEMENT_ANNOTATION,
     REASON_BINDING_SCHEDULED,
+    REASON_GANG_TIMEOUT,
+    REASON_GANG_UNSCHEDULABLE,
     REASON_SCHEDULE_FAILED,
     REASON_UNSCHEDULABLE,
     ResourceBinding,
@@ -33,6 +38,9 @@ from ..features import FeatureGates, PRIORITY_BASED_SCHEDULING
 from ..metrics import (
     degraded_rounds,
     e2e_scheduling_duration,
+    gang_admissions,
+    preemption_victims,
+    preemptions_total,
     queue_incoming_bindings,
     schedule_attempts,
     scheduling_algorithm_duration,
@@ -41,7 +49,7 @@ from ..metrics import (
 from ..runtime.controller import BatchingController, Runtime
 from ..store.store import DELETED, MODIFIED, Store
 from .core import ArrayScheduler, ScheduleDecision
-from .queue import PrioritySchedulingQueue
+from .queue import GangCoordinator, PrioritySchedulingQueue
 
 
 def placement_json(placement) -> str:
@@ -148,6 +156,10 @@ class SchedulerDaemon:
         pipeline=None,  # pipelined round executor (None = KARMADA_TPU_PIPELINE)
         aot_prewarm=None,  # AOT bucket-lattice prewarm on the standby
         #   (sched/aot.py); None = KARMADA_TPU_AOT_PREWARM env (default on)
+        gang_wait_seconds: Optional[float] = None,  # partial-gang hold
+        #   window before a timeout rejects the cohort (sched/queue.py)
+        preemption: bool = True,  # the PreemptLowerPriority second solve
+        #   pass (sched/preemption.py); bindings still opt in per policy
     ) -> None:
         self.store = store
         self.clock = runtime.clock
@@ -200,6 +212,25 @@ class SchedulerDaemon:
         # AOT hint that micro-batch row buckets belong in the prewarm walk
         self.admission = AdmissionLog()
         self.stream_prewarm = False
+        # workload-class scheduling (sched/preemption.py, docs/SCHEDULING.md):
+        # the gang coordinator holds partial all-or-nothing cohorts at the
+        # queue seam, and `preemption_enabled` arms the second solve pass
+        # for PreemptLowerPriority bindings that place short
+        from .queue import DEFAULT_GANG_WAIT
+
+        self.gangs = GangCoordinator(
+            self.clock,
+            DEFAULT_GANG_WAIT if gang_wait_seconds is None
+            else gang_wait_seconds,
+        )
+        self.preemption_enabled = bool(preemption)
+        # placed-bindings index for the preemption planner: maintained by
+        # the binding watch (replay seeds it at subscription), so a plan
+        # snapshot is a dict scan instead of a full store.list deep-copy
+        # per preemption. Eventually consistent only — the atomic commit
+        # re-reads every victim fresh and rv-checks, so a stale snapshot
+        # can only abort a plan, never mis-commit one.
+        self._placed: dict[str, ResourceBinding] = {}
         # names of clusters MODIFIED since the last fleet encode; None means
         # the membership changed (add/delete) and the next encode must be a
         # full rebuild instead of the dirty-column scatter
@@ -221,6 +252,13 @@ class SchedulerDaemon:
     # -- event handlers (event_handler.go:94-120) -------------------------
 
     def _on_binding(self, event: str, rb: ResourceBinding) -> None:
+        # placed index upkeep FIRST — before any gating below returns (the
+        # handler's rb is this subscriber's own copy, safe to retain)
+        if (event == DELETED or rb.metadata.deletion_timestamp is not None
+                or not rb.spec.clusters):
+            self._placed.pop(rb.metadata.key(), None)
+        else:
+            self._placed[rb.metadata.key()] = rb
         if event == DELETED:
             if self.admission.enabled:
                 # fence + drain: the bump discards any in-flight decision,
@@ -537,6 +575,86 @@ class SchedulerDaemon:
 
         return StreamingScheduler(self, **kwargs)
 
+    def _gang_of(self, rb: ResourceBinding) -> str:
+        from .preemption import gang_of
+
+        return gang_of(rb)
+
+    def gang_tick(self) -> int:
+        """Reject gangs whose hold window elapsed incomplete (ControlPlane
+        .tick drives this for the batch daemon; the streaming loop checks
+        on every admission). Returns the number of gangs rejected."""
+        expired = self.gangs.expire(self.clock.now())
+        for gname, members in expired:
+            self._reject_gang(
+                gname, members, REASON_GANG_TIMEOUT,
+                "gang %s timed out waiting for members" % gname,
+                outcome="timeout",
+            )
+        return len(expired)
+
+    def _reject_gang(self, gname: str, members, reason: str, message: str,
+                     outcome: str) -> None:
+        """Terminal gang disposition (timeout / joint infeasibility):
+        write the Scheduled=False condition on every member (idempotent —
+        a repeat writes nothing, so the event fixpoint terminates), park
+        priority-queue keys unschedulable, settle admission bookkeeping."""
+        gang_admissions.inc(outcome=outcome)
+        q = self.controller.queue
+        for key, rb, _epoch in members:
+            fresh = self.store.try_get("ResourceBinding", rb.name,
+                                       rb.namespace)
+            if self._admission_gate(fresh) in ("drop", "suspended"):
+                continue
+            if self.admission.enabled:
+                self.admission.settle(key)
+            if isinstance(q, PrioritySchedulingQueue):
+                q.push_unschedulable(key)
+            if set_condition(
+                fresh.status.conditions,
+                Condition(type=CONDITION_SCHEDULED, status="False",
+                          reason=reason, message=message),
+            ):
+                self.store.update(fresh)
+
+    def _admit_gangs(self, bindings: list) -> list:
+        """Gang admission at the drain seam: gang members park in the
+        coordinator until their cohort completes; the completing member
+        releases the whole gang into THIS batch (so the cohort always
+        solves together). Non-gang rows pass through untouched."""
+        ready: list = []
+        for rb in bindings:
+            if self._gang_of(rb):
+                released = self.gangs.offer(rb.metadata.key(), rb, 0)
+                ready.extend(rb2 for _k, rb2, _e in released)
+            else:
+                ready.append(rb)
+        return ready
+
+    def _launch_routed(self, array: ArrayScheduler, chunk: list,
+                       extra, round_rows: int) -> dict:
+        """Launch one chunk, routing workload-class batches — mixed
+        priorities (segmented tiered solve) or preemption-armed rows
+        (speculative victim-augmented pass) — through ONE
+        sched/preemption.py launch, and plain batches through the ordinary
+        replay-aware path."""
+        from .preemption import (
+            armed_for_preemption, launch_tiered, wants_workload_solve,
+        )
+
+        if wants_workload_solve(array, chunk,
+                                preemption=self.preemption_enabled):
+            # the O(placed) snapshot copy is only paid when a row will
+            # actually read it (speculative second pass) — a plain
+            # mixed-priority stream must not tax every micro-batch with it
+            placed = None
+            if self.preemption_enabled and any(
+                armed_for_preemption(rb) for rb in chunk
+            ):
+                placed = list(self._placed.values())
+            return launch_tiered(array, chunk, extra, placed=placed)
+        return array.launch_chunk(chunk, extra, round_rows=round_rows)
+
     def _schedule_batch(self, keys: list[str]) -> list[str]:
         bindings = []
         observed: list = []
@@ -548,7 +666,13 @@ class SchedulerDaemon:
                 bindings.append(rb)
             elif gate == "clean":
                 self._record_observed(rb, sink=observed)
+            if rb is not None and gate in ("drop", "suspended"):
+                g = self._gang_of(rb)
+                if g:
+                    self.gangs.discard(key, g)
         self._flush_observed(observed)
+        bindings = self._admit_gangs(bindings)
+        self.gang_tick()
         if not bindings:
             return []
         from ..tracing import Trace
@@ -610,11 +734,18 @@ class SchedulerDaemon:
                 if est is not None:
                     extra, swept_open = est
                     open_members.update(swept_open)
-                pending = array.launch_chunk(chunk, extra,
-                                             round_rows=len(bindings))
+                pending = self._launch_routed(array, chunk, extra,
+                                              round_rows=len(bindings))
                 totals["replayed"] += pending["replayed"]
                 totals["solved"] += pending["solved"]
                 return pending
+
+            # gang cohorts commit at ROUND scope, not chunk scope: the
+            # equalized chunk split can land a gang's members in different
+            # chunks, and the all-or-nothing commit must see the whole
+            # cohort (the streaming path never splits — the coordinator
+            # releases a gang into one micro-batch)
+            gang_buffer: list = []
 
             def patch(i, chunk, decisions):
                 for decision in decisions:
@@ -623,7 +754,8 @@ class SchedulerDaemon:
                     )
                 # coalesced: one batch read + one transactional batch write
                 # per chunk instead of 2 store round-trips per binding
-                self._patch_results(list(zip(chunk, decisions)))
+                self._patch_results(list(zip(chunk, decisions)),
+                                    gang_sink=gang_buffer)
 
             from contextlib import nullcontext
 
@@ -644,6 +776,8 @@ class SchedulerDaemon:
                     time_materialize=False,
                 )
                 pipe.run(chunks)
+                if gang_buffer:
+                    self._flush_gang_sink(gang_buffer)
             # the algorithm metric keeps its solve-only reference semantics
             # (estimate RPC time and store patching stay OUTSIDE it, as they
             # were before the pipeline): observe the round's algorithm-stage
@@ -678,17 +812,143 @@ class SchedulerDaemon:
         trace.log_if_long(1.0)
         return []
 
-    def _patch_results(self, items) -> list[bool]:
-        """Coalesced decision patching: per-binding prepare/veto against a
-        batch-read fresh snapshot, then ONE transactional batch write for
-        the whole cohort — a micro-batch of B decisions costs ≤1 batch read
-        + 1 batch write instead of 2·B store round-trips, with store bytes
-        and event stream bit-identical to the per-object path (same objects,
-        same order, contiguous rvs; under concurrent writers the cohort
-        write is rv-checked, so a mid-window rewrite skips its slot instead
-        of being clobbered). Event recording runs AFTER the commit and only
-        for slots that landed. Returns the per-item outcome (False =
-        vetoed/skipped, as _patch_result)."""
+    def _patch_results(self, items, gang_sink: Optional[list] = None
+                       ) -> list[bool]:
+        """Coalesced decision patching with workload-class routing: gang
+        cohorts split off to the all-or-nothing `_patch_gang` commit (or,
+        with `gang_sink`, defer to the caller's round-end `_flush_gang_sink`
+        — the batch round's chunk split can separate a gang's members, and
+        the atomic commit must see the whole cohort), everything else rides
+        the coalesced solo path, and failed PreemptLowerPriority rows take
+        the preemption second pass afterwards."""
+        if not items:
+            return []
+        gang_groups: dict[str, list[int]] = {}
+        for j, (rb, _dec) in enumerate(items):
+            g = self._gang_of(rb)
+            if g:
+                gang_groups.setdefault(g, []).append(j)
+        if not gang_groups:
+            return self._patch_solo(items)
+        outcomes: list[bool] = [False] * len(items)
+        in_gang = {j for js in gang_groups.values() for j in js}
+        solo_js = [j for j in range(len(items)) if j not in in_gang]
+        if solo_js:
+            for j, ok in zip(solo_js,
+                             self._patch_solo([items[j] for j in solo_js])):
+                outcomes[j] = ok
+        for gname, js in gang_groups.items():
+            group = [items[j] for j in js]
+            if gang_sink is not None:
+                # deferred: the round-end flush owns the cohort
+                gang_sink.append((gname, group))
+                continue
+            for j, ok in zip(js, self._patch_gang(gname, group)):
+                outcomes[j] = ok
+        return outcomes
+
+    def _flush_gang_sink(self, gang_buffer: list) -> None:
+        """Round-end gang commit for the batch daemon: chunks deferred
+        their gang items here, so a gang split across chunk boundaries
+        still commits as ONE cohort."""
+        merged: dict[str, list] = {}
+        for gname, group in gang_buffer:
+            merged.setdefault(gname, []).extend(group)
+        for gname, group in merged.items():
+            self._patch_gang(gname, group)
+
+    def _gang_full(self, rb: ResourceBinding, dec: ScheduleDecision) -> bool:
+        """Joint-feasibility term for one gang member: the solve succeeded
+        AND a divided workload placed its FULL replica count (a gang's
+        all-or-nothing contract covers partial placements too)."""
+        if not dec.ok:
+            return False
+        if rb.spec.replicas > 0 and rb.spec.placement is not None and (
+            rb.spec.placement.replica_scheduling_type()
+            != REPLICA_SCHEDULING_DUPLICATED
+        ):
+            return sum(t.replicas for t in (dec.targets or [])) \
+                >= rb.spec.replicas
+        return True
+
+    def _patch_gang(self, gname: str, items) -> list[bool]:
+        """All-or-nothing gang commit: the whole cohort passes the joint
+        feasibility check, prepares against fresh snapshots, and commits in
+        ONE rv-checked `update_batch` — a mid-cohort veto (stale rv,
+        vanished member, last-moment gate flip) re-admits the WHOLE gang
+        uncharged; nothing partial ever reaches the store (pinned by
+        tests/test_preemption.py)."""
+        from ..store.store import BatchError
+
+        size = max(max((rb.spec.gang_size or 0) for rb, _ in items), 1)
+        if len(items) < size or not all(
+            self._gang_full(rb, dec) for rb, dec in items
+        ):
+            self._reject_gang(
+                gname,
+                [(rb.metadata.key(), rb, 0) for rb, _ in items],
+                REASON_GANG_UNSCHEDULABLE,
+                f"gang {gname}: cohort did not place all "
+                f"{size} members fully",
+                outcome="rejected",
+            )
+            return [False] * len(items)
+        get_batch = getattr(self.store, "get_batch", None)
+        if get_batch is not None:
+            fresh_list = get_batch(
+                "ResourceBinding",
+                [(rb.name, rb.namespace) for rb, _ in items],
+            )
+        else:
+            fresh_list = [
+                self.store.try_get("ResourceBinding", rb.name, rb.namespace)
+                for rb, _ in items
+            ]
+        sink: list = []
+        for (rb, dec), fresh in zip(items, fresh_list):
+            if fresh is None:
+                return self._readmit_gang(items)
+            if not self._patch_result(rb, dec, fresh=fresh, sink=sink):
+                return self._readmit_gang(items)
+        objs = [obj for obj, _ in sink]
+        try:
+            if objs:
+                batch = getattr(self.store, "update_batch", None)
+                if batch is not None:
+                    batch(objs, check_rv=True)
+                else:
+                    for obj in objs:
+                        self.store.update(obj)
+        except BatchError:
+            return self._readmit_gang(items)
+        gang_admissions.inc(outcome="placed")
+        for obj, dec in sink:
+            if dec is not None:
+                self._record_event(obj, dec)
+        return [True] * len(items)
+
+    def _readmit_gang(self, items) -> list[bool]:
+        """Mid-cohort veto: something moved under one member and nothing
+        committed — the whole gang re-admits uncharged (readd keeps cached
+        priorities and burns no retry budget; the coordinator reassembles
+        the cohort on the next drain)."""
+        q = self.controller.queue
+        readd = getattr(q, "readd", None) or q.add
+        for rb, _dec in items:
+            readd(rb.metadata.key())
+        return [False] * len(items)
+
+    def _patch_solo(self, items) -> list[bool]:
+        """The coalesced non-gang patch path: per-binding prepare/veto
+        against a batch-read fresh snapshot, then ONE transactional batch
+        write for the whole cohort — a micro-batch of B decisions costs ≤1
+        batch read + 1 batch write instead of 2·B store round-trips, with
+        store bytes and event stream bit-identical to the per-object path
+        (same objects, same order, contiguous rvs; under concurrent writers
+        the cohort write is rv-checked, so a mid-window rewrite skips its
+        slot instead of being clobbered). Event recording runs AFTER the
+        commit and only for slots that landed. Returns the per-item outcome
+        (False = vetoed/skipped, as _patch_result)."""
         if not items:
             return []
         fresh_list = None
@@ -698,10 +958,25 @@ class SchedulerDaemon:
                 "ResourceBinding",
                 [(rb.name, rb.namespace) for rb, _ in items],
             )
+        from ..api.policy import PREEMPT_LOWER_PRIORITY
+
         sink: list = []
         outcomes = []
         spans = []
+        preempt_later: list[int] = []
         for j, (rb, decision) in enumerate(items):
+            if (self.preemption_enabled and not decision.ok
+                    and rb.spec.preemption_policy == PREEMPT_LOWER_PRIORITY
+                    and not self._gang_of(rb)):
+                # short-placed preemptor: defer — the preemption pass runs
+                # after this cohort commits, and only an infeasible or
+                # aborted plan writes the Unschedulable condition (a
+                # committed plan would immediately overwrite it, costing a
+                # wasted store round-trip per preemption on the hot path)
+                preempt_later.append(j)
+                outcomes.append(True)  # resolved by _preempt_pass below
+                spans.append((len(sink), len(sink)))
+                continue
             fresh = fresh_list[j] if fresh_list is not None else _UNREAD
             n0 = len(sink)
             outcomes.append(
@@ -727,7 +1002,201 @@ class SchedulerDaemon:
             for (obj, decision), done in zip(sink, committed):
                 if decision is not None and done is not None:
                     self._record_event(obj, decision)
+        if preempt_later:
+            self._preempt_pass(items, preempt_later, outcomes)
         return outcomes
+
+    # -- preemption second pass (sched/preemption.py) ----------------------
+
+    def _preempt_pass(self, items, idxs, outcomes) -> None:
+        """Short-placed PreemptLowerPriority bindings take the second solve
+        pass: plan over a victim-augmented capacity matrix (one launch per
+        distinct preemptor priority), then commit victim replica reductions
+        + preemptor placements as ONE rv-checked batch cohort. A committed
+        plan rewrites the in-flight decision to its placement so the
+        streaming writer observes the preemptor's placement latency on the
+        same SLO histogram as ordinary admissions; anything else falls back
+        to the ordinary unschedulable patch."""
+        cands = [(j, *items[j]) for j in idxs]
+        plans_by_key: dict = {}
+        if self._array is not None:
+            import numpy as np
+
+            from .preemption import (
+                PlanLedger, plan_from_speculative, plan_preemption,
+            )
+
+            placed = [
+                b for b in list(self._placed.values())
+                if b.spec.clusters and b.metadata.deletion_timestamp is None
+            ]
+            # rows whose victim-augmented decision already rode the
+            # admission launch (speculative second pass) plan with ZERO
+            # extra solves; the rest (batch-round fallbacks, std-path
+            # rows) pay the standalone planner's launch. ONE ledger spans
+            # both paths: every plan in this pass sees the free capacity
+            # and victim replicas earlier plans already claimed, so the
+            # joint commit cannot double-count either.
+            ledger = PlanLedger(
+                np.asarray(self._array.fleet.capacity, np.int64)
+            )
+            spec_pairs = [(rb, dec.speculative) for _j, rb, dec in cands
+                          if dec.speculative is not None]
+            solve_rbs = [rb for _j, rb, dec in cands
+                         if dec.speculative is None]
+            plans = []
+            if spec_pairs:
+                plans += plan_from_speculative(self._array, placed,
+                                               spec_pairs, ledger=ledger)
+            if solve_rbs:
+                plans += plan_preemption(self._array, placed, solve_rbs,
+                                         ledger=ledger)
+            plans_by_key = {p.key: p for p in plans}
+        feasible = []
+        feasible_js = []
+        fallback = []
+        for j, rb, dec in cands:
+            plan = plans_by_key.get(rb.metadata.key())
+            if plan is None or not plan.feasible:
+                preemptions_total.inc(outcome="infeasible")
+                fallback.append(j)
+                continue
+            feasible.append((rb, dec, plan))
+            feasible_js.append(j)
+        if feasible and not self._commit_preemption(feasible):
+            fallback.extend(feasible_js)
+        for j in fallback:
+            rb, dec = items[j]
+            outcomes[j] = self._patch_result(rb, dec)
+
+    def _commit_preemption(self, feasible) -> bool:
+        """The atomic half: victim cuts (merged per victim binding, flowing
+        through graceful-eviction tasks) and every preemptor's placement in
+        ONE `update_batch(check_rv=True)` — a concurrent write to any
+        member aborts the whole plan (outcome=aborted; the preemptor stays
+        unschedulable and retries on its next event)."""
+        from ..store.store import BatchError
+
+        # merge victim cuts per (binding, cluster): plans within one
+        # priority group SHARE one victims list (id-identical — the joint
+        # selection), counted once; DISTINCT groups' cuts SUM — the plan
+        # ledger already guaranteed they claim disjoint replicas, so the
+        # sum is exactly the combined eviction the pass decided on
+        cuts: dict[tuple[str, str], int] = {}
+        seen_lists: set[int] = set()
+        for _rb, _dec, plan in feasible:
+            if id(plan.victims) in seen_lists:
+                continue
+            seen_lists.add(id(plan.victims))
+            for v in plan.victims:
+                k = (v.key, v.cluster)
+                cuts[k] = cuts.get(k, 0) + v.replicas
+        victim_keys = sorted({k for k, _c in cuts})
+        now = self.clock.now()
+        objs: list = []
+        # fresh reads coalesced: one batch read for the victims + one for
+        # the preemptors instead of a try_get (lock hold + deep copy) each
+        get_batch = getattr(self.store, "get_batch", None)
+        if get_batch is not None:
+            pre_keys = [rb.metadata.key() for rb, _d, _p in feasible]
+            pairs = [(k.partition("/")[2], k.partition("/")[0])
+                     for k in victim_keys + pre_keys]
+            fresh_all = get_batch("ResourceBinding", pairs)
+            victims_fresh = dict(zip(victim_keys, fresh_all))
+            preemptors_fresh = dict(zip(pre_keys,
+                                        fresh_all[len(victim_keys):]))
+        else:
+            victims_fresh = preemptors_fresh = None
+        for vkey in victim_keys:
+            ns, _, name = vkey.partition("/")
+            if victims_fresh is not None:
+                victim = victims_fresh[vkey]
+            else:
+                victim = self.store.try_get("ResourceBinding", name, ns)
+            if victim is None or victim.metadata.deletion_timestamp is not None:
+                self._abort_preemption(feasible, "victim vanished mid-plan")
+                return False
+            for (k2, cluster), cut in sorted(cuts.items()):
+                if k2 != vkey:
+                    continue
+                entry = next(
+                    (tc for tc in victim.spec.clusters
+                     if tc.name == cluster), None,
+                )
+                if entry is None or entry.replicas < cut:
+                    self._abort_preemption(
+                        feasible, "victim placement changed mid-plan"
+                    )
+                    return False
+                entry.replicas -= cut
+                if entry.replicas == 0:
+                    victim.spec.clusters = [
+                        tc for tc in victim.spec.clusters
+                        if tc.name != cluster
+                    ]
+                victim.spec.graceful_eviction_tasks.append(
+                    GracefulEvictionTask(
+                        from_cluster=cluster,
+                        replicas=cut,
+                        reason=EVICTION_REASON_PREEMPTED,
+                        message=("preempted by higher-priority binding(s): "
+                                 + ", ".join(p.key for _r, _d, p in feasible
+                                             )[:200]),
+                        producer=EVICTION_PRODUCER_PREEMPTION,
+                        creation_timestamp=now,
+                    )
+                )
+            objs.append(victim)
+        # the preemptor's placement write goes through _patch_result — THE
+        # one placement-write implementation (annotation, condition,
+        # observed generation/affinity, reschedule handling) — so the
+        # preemption path cannot drift from the ordinary patch path, and
+        # committed placements record the same binding Event
+        sink: list = []
+        for rb, dec, plan in feasible:
+            if preemptors_fresh is not None:
+                fresh = preemptors_fresh[rb.metadata.key()]
+            else:
+                fresh = self.store.try_get("ResourceBinding", rb.name,
+                                           rb.namespace)
+            if fresh is None:
+                self._abort_preemption(feasible, "preemptor vanished")
+                return False
+            placed_dec = ScheduleDecision(dec.key,
+                                          targets=list(plan.targets))
+            if not self._patch_result(rb, placed_dec, fresh=fresh,
+                                      sink=sink):
+                self._abort_preemption(feasible, "preemptor gate flipped")
+                return False
+        objs.extend(obj for obj, _dec in sink)
+        try:
+            batch = getattr(self.store, "update_batch", None)
+            if batch is not None:
+                batch(objs, check_rv=True)
+            else:
+                for obj in objs:
+                    self.store.update(obj)
+        except BatchError:
+            self._abort_preemption(feasible, "atomic commit lost a race")
+            return False
+        for obj, dec in sink:
+            if dec is not None:
+                self._record_event(obj, dec)
+        for rb, dec, plan in feasible:
+            preemptions_total.inc(outcome="committed")
+            preemption_victims.observe(float(len(plan.victim_keys())))
+            # rewrite the in-flight decision: the preemptor IS placed now,
+            # so the streaming writer's SLO accounting sees a placement
+            dec.error = ""
+            dec.targets = list(plan.targets)
+        return True
+
+    def _abort_preemption(self, feasible, why: str) -> None:
+        import logging
+
+        logging.getLogger(__name__).warning("preemption aborted: %s", why)
+        for _rb, _dec, _plan in feasible:
+            preemptions_total.inc(outcome="aborted")
 
     def _patch_result(self, rb: ResourceBinding, decision: ScheduleDecision,
                       *, fresh=None, sink=None) -> bool:

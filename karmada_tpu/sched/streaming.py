@@ -356,6 +356,9 @@ class StreamingScheduler:
                 keys = [keep]
         from .compilecache import compile_counts
 
+        # gang hold windows expire on the admission clock: reject cohorts
+        # that never completed before forming the next batch
+        daemon.gang_tick()
         bindings, out_keys, epochs = [], [], []
         try:
             clean = self._form_keys(daemon, keys, bindings, out_keys, epochs)
@@ -414,9 +417,23 @@ class StreamingScheduler:
                 daemon.admission.forget(key)
                 daemon.controller.queue.forget(key)
                 self._suspects.discard(key)
+                if rb is not None and daemon._gang_of(rb):
+                    daemon.gangs.discard(key, rb.spec.gang_name)
             elif gate == "suspended":
                 daemon.admission.settle(key)
+                if daemon._gang_of(rb):
+                    daemon.gangs.discard(key, rb.spec.gang_name)
             elif gate == "schedule":
+                if daemon._gang_of(rb):
+                    # gang member: park in the coordinator until the whole
+                    # cohort is here; the completing offer releases every
+                    # held member into THIS micro-batch, so a gang always
+                    # solves (and commits) as one cohort
+                    for k2, rb2, e2 in daemon.gangs.offer(key, rb, epoch):
+                        bindings.append(rb2)
+                        out_keys.append(k2)
+                        epochs.append(e2)
+                    continue
                 bindings.append(rb)
                 out_keys.append(key)
                 epochs.append(epoch)
@@ -482,8 +499,11 @@ class StreamingScheduler:
                 return False
 
     def _launch(self, i: int, mb: _MicroBatch, extra):
-        pending = self._array.launch_chunk(
-            mb.bindings, extra, round_rows=len(mb.bindings)
+        # routed: a mixed-priority micro-batch solves as ONE segmented
+        # tiered launch (sched/preemption.py); uniform batches ride the
+        # ordinary replay-aware path — identical call shape either way
+        pending = self.daemon._launch_routed(
+            self._array, mb.bindings, extra, round_rows=len(mb.bindings)
         )
         mb.replayed = pending["replayed"]
         mb.solved = pending["solved"]
@@ -499,9 +519,36 @@ class StreamingScheduler:
         admission = daemon.admission
         placed = failed = stale = 0
         cohort = []
-        for key, epoch0, rb, dec in zip(mb.keys, mb.epochs, mb.bindings,
-                                        decisions):
-            if admission.epoch(key) != epoch0:
+        stale_keys = {
+            key for key, epoch0 in zip(mb.keys, mb.epochs)
+            if admission.epoch(key) != epoch0
+        }
+        # gang stale fencing: ONE stale member vetoes its WHOLE gang — the
+        # cohort must commit all K against current specs or not at all, so
+        # the healthy members re-admit uncharged and the coordinator
+        # reassembles the gang once the stale member's event re-offers it
+        vetoed_rows: set[int] = set()
+        if stale_keys:
+            gang_rows: dict[str, list[int]] = {}
+            for idx, rb in enumerate(mb.bindings):
+                g = daemon._gang_of(rb)
+                if g:
+                    gang_rows.setdefault(g, []).append(idx)
+            for g, idxs in gang_rows.items():
+                if any(mb.keys[i] in stale_keys for i in idxs):
+                    vetoed_rows.update(idxs)
+                    for i in idxs:
+                        # readd is a no-op for the stale member (its event
+                        # already re-enqueued it) and uncharged for the rest
+                        q.readd(mb.keys[i])
+        for idx, (key, epoch0, rb, dec) in enumerate(
+            zip(mb.keys, mb.epochs, mb.bindings, decisions)
+        ):
+            if idx in vetoed_rows:
+                if key in stale_keys:
+                    stale += 1
+                continue
+            if key in stale_keys:
                 # dirtied mid-flight: the decision is stale — discard it;
                 # the bumping event already re-enqueued the key, so the
                 # binding re-admits with its fresh spec

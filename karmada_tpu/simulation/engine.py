@@ -55,6 +55,7 @@ from ..api.simulation import (
     SCENARIO_DRAIN,
     SCENARIO_KINDS,
     SCENARIO_LOSS,
+    SCENARIO_PREEMPT,
     SCENARIO_SURGE,
     SCENARIO_TAINT,
     Scenario,
@@ -113,6 +114,14 @@ def _validate_steps(steps: Sequence[Scenario], cluster_names: set) -> None:
             raise SimulationError(f"unknown scenario kind {st.kind!r}")
         if st.kind == SCENARIO_COMPOSITE:
             raise SimulationError("Composite scenarios cannot nest")
+        if st.kind == SCENARIO_PREEMPT:
+            # answered by the preemption planner (ControlPlane.simulate
+            # routes them there) — the batched counterfactual engine has no
+            # victim-selection semantics and must not silently baseline it
+            raise SimulationError(
+                "Preemption scenarios are answered by the preemption "
+                "planner, not the batched engine"
+            )
         if st.kind in (SCENARIO_DRAIN, SCENARIO_LOSS, SCENARIO_TAINT,
                        SCENARIO_CAPACITY):
             if not st.cluster:

@@ -99,6 +99,48 @@ def _validate_propagation_policy(req: AdmissionRequest) -> None:
     for tol in pp.spec.placement.cluster_tolerations:
         if tol.effect and tol.effect not in VALID_TAINT_EFFECTS:
             raise AdmissionDenied(req.kind, f"{name}: invalid toleration effect {tol.effect!r}")
+    _validate_workload_class(
+        req.kind, name,
+        pp.spec.scheduler_priority, pp.spec.scheduler_preemption,
+        pp.spec.gang_name, pp.spec.gang_size,
+    )
+
+
+def _validate_workload_class(kind: str, name: str, priority, preemption: str,
+                             gang_name: str, gang_size: int) -> None:
+    """Workload-class scheduling fields (sched/preemption.py): bounded
+    priority range (it must survive the i32 tiered solve with aging
+    headroom), the kube preemption-policy enum, and a coherent gang
+    declaration — these used to round-trip unchecked from policy to
+    binding. Shared by the policy webhooks and the binding webhook, so the
+    detector's plumbing cannot smuggle an invalid value past either."""
+    from ..api.policy import SCHEDULE_PRIORITY_BOUND, VALID_SCHEDULER_PREEMPTION
+
+    if priority is not None and not (
+        -SCHEDULE_PRIORITY_BOUND <= priority <= SCHEDULE_PRIORITY_BOUND
+    ):
+        raise AdmissionDenied(
+            kind,
+            f"{name}: schedulerPriority {priority} outside "
+            f"[-{SCHEDULE_PRIORITY_BOUND}, {SCHEDULE_PRIORITY_BOUND}]",
+        )
+    if preemption not in VALID_SCHEDULER_PREEMPTION:
+        raise AdmissionDenied(
+            kind,
+            f"{name}: invalid schedulerPreemption {preemption!r} "
+            f"(allowed: {', '.join(v or '<unset>' for v in VALID_SCHEDULER_PREEMPTION)})",
+        )
+    if gang_name:
+        if gang_size < 1:
+            raise AdmissionDenied(
+                kind,
+                f"{name}: gang {gang_name!r} needs gangSize >= 1 "
+                f"(got {gang_size})",
+            )
+    elif gang_size not in (0, 1):
+        raise AdmissionDenied(
+            kind, f"{name}: gangSize {gang_size} without a gangName"
+        )
 
 
 def _validate_override_policy(req: AdmissionRequest) -> None:
@@ -162,6 +204,11 @@ def _validate_binding(req: AdmissionRequest) -> None:
         raise AdmissionDenied(req.kind, f"{rb.metadata.name}: spec.resource must reference an object")
     if rb.spec.replicas < 0:
         raise AdmissionDenied(req.kind, f"{rb.metadata.name}: replicas must be >= 0")
+    _validate_workload_class(
+        req.kind, rb.metadata.name,
+        rb.spec.schedule_priority, rb.spec.preemption_policy,
+        rb.spec.gang_name, rb.spec.gang_size,
+    )
 
 
 def _validate_deletion_protection(req: AdmissionRequest) -> None:

@@ -1,0 +1,62 @@
+#!/usr/bin/env bash
+# Workload-class scheduling smoke: priority tiers, preemption, and gang
+# placement against the live streaming topology (ROADMAP item 3 /
+# docs/SCHEDULING.md). Single-shot: runs the `preempt` bench config —
+# a full fleet of pre-placed low-priority replicas, a baseline leg of
+# fitting admissions, a wave of PreemptLowerPriority arrivals that must
+# each plan victims + commit atomically, and gangs of K in {2,4,8,16}
+# co-admitted through the coordinator — and asserts the acceptance
+# booleans the JSON line carries:
+#   pass_slo        preemption-decision p99 (admission -> placement patch,
+#                   on the SAME placement SLO histogram as ordinary
+#                   admissions) within 2x of the non-preempting baseline
+#   pass_preempted  every preemptor committed a plan and placed FULLY
+#                   (victims cut atomically with the placement)
+#   pass_gang_o1    micro-batches (= solve launches) per co-admitted gang
+#                   stay O(1) in the gang size K
+# Exit 0 prints "PREEMPT OK".
+#
+# Wired into the slow path as
+# tests/test_preemption.py::TestPreemptSmokeScript (pytest -m slow).
+# Runs on CPU; the solve rides the scheduler's CPU fallback.
+set -euo pipefail
+
+cd "$(dirname "$0")/.."
+PY=${PYTHON:-python}
+WORK=$(mktemp -d /tmp/preempt_smoke.XXXXXX)
+trap 'rm -rf "$WORK"' EXIT
+
+log() { echo "preempt_smoke: $*"; }
+
+JAX_PLATFORMS=cpu $PY bench.py --inner --platform cpu --configs preempt \
+    --verbose > "$WORK/out.txt" 2> "$WORK/err.txt" \
+    || { log "bench failed"; cat "$WORK/err.txt"; exit 1; }
+
+LINE=$(grep -E '^\{' "$WORK/out.txt" | tail -1)
+[ -n "$LINE" ] || { log "no JSON line emitted"; cat "$WORK/out.txt"; exit 1; }
+log "result: $LINE"
+
+PREEMPT_LINE="$LINE" $PY - <<'PYEOF'
+import json
+import os
+import sys
+
+rec = json.loads(os.environ["PREEMPT_LINE"])
+for key in ("pass_slo", "pass_preempted", "pass_gang_o1", "pass"):
+    if not rec.get(key):
+        print(f"preempt_smoke: criterion {key} FAILED "
+              f"(p99={rec.get('value')}s "
+              f"baseline={rec.get('baseline_p99_s')}s "
+              f"ratio={rec.get('latency_ratio')}x, "
+              f"committed={rec.get('preemptions_committed')}, "
+              f"gang_batches={rec.get('gang_batches')})", file=sys.stderr)
+        sys.exit(1)
+print(f"preempt_smoke: preemption-decision p99 {rec['value']}s vs "
+      f"baseline {rec['baseline_p99_s']}s "
+      f"({rec['latency_ratio']}x, criterion <=2x), "
+      f"{rec['preemptions_committed']:.0f} plans committed "
+      f"({rec['preemptors_placed_full']} placed full), "
+      f"gang micro-batches {rec['gang_batches']}")
+PYEOF
+
+echo "PREEMPT OK"

@@ -138,6 +138,13 @@ def main() -> None:
     # carries the acceptance booleans alongside the device numbers)
     artifact["runs"].append(run_bench(
         ["--configs", "elastic", "--run-timeout", "600"], 700))
+    # workload-class scheduling: preemption-decision p99 vs the
+    # non-preempting baseline on the same placement SLO histogram, every
+    # preemptor's atomic victim-cut + placement commit, and gang
+    # co-admission staying one micro-batch regardless of K (captured so
+    # the committed artifact carries the acceptance booleans)
+    artifact["runs"].append(run_bench(
+        ["--configs", "preempt", "--run-timeout", "600"], 700))
     # the Go-interop seam: /v1/scheduleBatch latency at flagship scale
     artifact["runs"].append(run_script(
         "scripts/bench_shim.py",
