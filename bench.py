@@ -1059,6 +1059,22 @@ def _percentiles(lat):
             "n": len(s)}
 
 
+def _window_p99_min(lat, window=500):
+    """Infimum of per-window p99s: the honest latency FLOOR of a leg —
+    box noise (GC, scheduler jitter, a neighbor process) only ever ADDS
+    latency, so comparing two legs' floors cancels it (the preempt
+    bench's windowed-infimum discipline)."""
+    if not lat:
+        return None
+    if len(lat) < window:
+        return _percentiles(lat)["p99_s"]
+    vals = [
+        _percentiles(lat[s:s + window])["p99_s"]
+        for s in range(0, len(lat) - window + 1, window)
+    ]
+    return min(v for v in vals if v is not None)
+
+
 def _prime_hwm(store, daemon):
     """One whole-pool encode pass plus a synthetic WIDE-placement row:
     sets the batch encoder's content-axis high-water marks (prev/evict
@@ -1170,11 +1186,20 @@ def run_stream(args, backend_label: str, verbose=False) -> dict:
     # not admission models (no-op on TPU: _host_sorts is already off)
     prev_tail_thresh = core_mod.HOST_TAIL_MIN_ELEMS
     core_mod.HOST_TAIL_MIN_ELEMS = 0
+    # the first two legs are the tracing-OFF comparison; the tracing leg
+    # flips the tracer on itself (docs/OBSERVABILITY.md overhead contract)
+    from karmada_tpu.tracing import tracer
+
+    tr_prev = (tracer.enabled, tracer.head_sample, tracer.slow_threshold_s)
+    tracer.enabled = False
     try:
         return _run_stream_inner(args, backend_label, verbose, seed,
                                  n_clusters, n_bindings, rate_hz, window_s)
     finally:
         core_mod.HOST_TAIL_MIN_ELEMS = prev_tail_thresh
+        (tracer.enabled, tracer.head_sample,
+         tracer.slow_threshold_s) = tr_prev
+        tracer.reset()
 
 
 def _run_stream_inner(args, backend_label, verbose, seed, n_clusters,
@@ -1251,6 +1276,71 @@ def _run_stream_inner(args, backend_label, verbose, seed, n_clusters,
     stop.set()
     svc.stop()
     server.join(timeout=60.0)
+
+    # ---- tracing-on leg (docs/OBSERVABILITY.md) --------------------------
+    # Same topology, same seeded schedule, with the distributed placement
+    # tracer ON at default head sampling (1/64) and the plane collector
+    # attached: the tracing layer must be CHEAP — placement p99 within 5%
+    # of the tracing-off leg — and a binding slower than the SLO threshold
+    # must be tail-sampled (trace retained) even when head sampling would
+    # drop it. The slow threshold pins to the off-leg MEDIAN so real
+    # breaches are guaranteed in the window whatever the box's noise
+    # (production defaults to the 1 s SLO bucket; the mechanism under test
+    # is identical) while fast traces still head-drop.
+    from karmada_tpu.tracing import TraceCollector, slo_report, tracer
+
+    sp = _percentiles(stream_lat)  # the tracing-off reference
+    tracer.reset()
+    tracer.enabled = True
+    tracer.head_sample = 64
+    tracer.slow_threshold_s = max(sp["p50_s"] or 0.005, 1e-4)
+    store_tr, _rt_tr, daemon_tr = _stream_topology(
+        seed, n_clusters, n_bindings
+    )
+    collector = TraceCollector(store_tr)
+    collector.attach()
+    svc_tr = daemon_tr.streaming(batch_delay=0.002, interval=0.05,
+                                 max_batch=256)
+    stop_tr = threading.Event()
+    server_tr = threading.Thread(
+        target=lambda: svc_tr.serve(should_stop=stop_tr.is_set),
+        daemon=True, name="bench-stream-trace",
+    )
+    watch_tr = _ArrivalWatch(store_tr)
+    t_warm_tr = time.perf_counter()
+    server_tr.start()
+    deadline = time.monotonic() + 600.0
+    while time.monotonic() < deadline:
+        if svc_tr._ready() == 0 and watch_tr.placed_count() >= n_bindings:
+            break
+        time.sleep(0.1)
+    _warm_lattice(_prime_hwm(store_tr, daemon_tr), daemon_tr, cap=256)
+    _stream_drive(store_tr, watch_tr, rampin, rate_hz)
+    _stream_wait_drain(watch_tr)
+    if verbose:
+        print(f"# stream: tracing-leg warm+rampin "
+              f"{time.perf_counter() - t_warm_tr:.1f}s")
+    skip_tr = len(watch_tr.latencies)
+    with _gc_quiesced():
+        _stream_drive(store_tr, watch_tr, schedule, rate_hz)
+        trace_drained = _stream_wait_drain(watch_tr)
+    _quiesce_stream(svc_tr)
+    trace_lat = list(watch_tr.latencies)[skip_tr:]
+    stop_tr.set()
+    svc_tr.stop()
+    server_tr.join(timeout=60.0)
+    retained_recs = tracer.retained()
+    tail_only = [r for r in retained_recs
+                 if r.retained == "slo"
+                 and not tracer.head_sampled(r.trace_id)]
+    slow_measured = sum(
+        1 for l in trace_lat if l >= tracer.slow_threshold_s)
+    attribution = slo_report()
+    tp = _percentiles(trace_lat)
+    tr_cfg = {"head_sample": tracer.head_sample,
+              "slow_threshold_s": round(tracer.slow_threshold_s, 6)}
+    collector.detach()
+    tracer.enabled = False
 
     # ---- batch-round leg (the pre-streaming daemon loop) -----------------
     store_b, runtime_b, daemon_b = _stream_topology(
@@ -1344,6 +1434,29 @@ def _run_stream_inner(args, backend_label, verbose, seed, n_clusters,
         "max_sustained_rate_hz": round(max_rate, 1),
         "rate_ramp": ramp,
     }
+    # tracing-on leg (docs/OBSERVABILITY.md): overhead on the windowed-
+    # minimum p99 (capacity noise only ever ADDS latency — the infimum is
+    # the honest floor both legs share), plus the tail-sampling proof
+    overhead = None
+    off_floor = _window_p99_min(stream_lat)
+    on_floor = _window_p99_min(trace_lat)
+    if off_floor and on_floor:
+        overhead = round(on_floor / off_floor, 3)
+    rec["tracing"] = {
+        **tp,
+        "drained": trace_drained,
+        "p99_vs_off": overhead,
+        **tr_cfg,
+        "retained_traces": len(retained_recs),
+        "tail_sampled": len(tail_only),
+        "slow_measured": slow_measured,
+        "slo_stages": attribution["stages"],
+    }
+    rec["pass_tracing_overhead"] = bool(
+        overhead is not None and overhead <= 1.05)
+    # a slow binding above the SLO threshold must be RETAINED even though
+    # head sampling (1/64) would have dropped it
+    rec["pass_tail_sampled"] = bool(tail_only)
     if verbose:
         print(f"# stream: p99 {sp['p99_s']}s vs batch {bp['p99_s']}s "
               f"(x{ratio}) identical={identical} "
@@ -3279,6 +3392,112 @@ DEFAULT_ORDER = [
     "preempt", "flagship_cold", "flagship",
 ]
 
+
+# -- result-line schemas (docs/OBSERVABILITY.md bench hygiene) --------------
+#
+# Every config's JSON result line is validated against its declared schema
+# BEFORE it prints, so soak/capture tooling can parse all legs uniformly —
+# a config that grows a new acceptance field must declare it here or the
+# bench fails loudly instead of shipping an undocumented line shape.
+# Type specs: "str" / "bool" / "int" / "num" (int|float) / "num?"
+# (number-or-null) / "dict" / "list". An `error` line (a config that
+# failed) only needs the base envelope.
+
+_ENVELOPE = {"metric": "str", "value": "num?", "unit": "str",
+             "backend": "str"}
+_ROUND = {**_ENVELOPE, "vs_baseline": "num", "iters": "int",
+          "scheduled_ok": "int"}
+
+RESULT_SCHEMAS = {
+    "dup3": _ROUND,
+    "static": _ROUND,
+    "dynamic": _ROUND,
+    "spread": _ROUND,
+    "spread_skewed": _ROUND,
+    "churn": _ROUND,
+    "churn_incremental": {**_ROUND, "last_round": "dict"},
+    "autoshard": {**_ROUND, "autoshard_engaged": "bool"},
+    "pipeline": {**_ROUND, "pipeline": "dict", "serial_p99_s": "num",
+                 "pipelined_vs_serial": "num",
+                 "decisions_identical": "bool"},
+    "whatif": {**_ROUND, "whatif": "dict", "per_scenario_amortized_s": "num",
+               "sequential_s": "num", "sequential_per_scenario_s": "num",
+               "batched_vs_sequential": "num"},
+    "degraded": {**_ROUND, "degraded": "dict"},
+    "coldstart": {**_ENVELOPE, "no_cache_s": "num?", "populate_s": "num?",
+                  "warm_cache_s": "num?", "lease_ttl_s": "num",
+                  "under_lease_ttl": "bool"},
+    "stream": {**_ENVELOPE, "stream": "dict", "batch_round": "dict",
+               "stream_vs_batch_p99": "num?", "beats_batch_2x": "bool",
+               "decisions_identical": "bool",
+               "steady_state_jit_compiles": "int",
+               "max_sustained_rate_hz": "num", "rate_ramp": "list",
+               "tracing": "dict", "pass_tracing_overhead": "bool",
+               "pass_tail_sampled": "bool"},
+    "fanout": {**_ENVELOPE, "pass_fanout_5x": "bool",
+               "pass_write_p99": "bool", "pass_resume_frac": "bool",
+               "pass": "bool"},
+    "writeload": {**_ENVELOPE, "pass_write_3x": "bool",
+                  "pass_write_p99_2x": "bool", "pass_parity": "bool",
+                  "pass": "bool"},
+    "replica": {**_ENVELOPE, "pass_read_scaling": "bool",
+                "pass_write_retained": "bool", "pass_rv_consistent": "bool",
+                "pass_failover_zero_loss": "bool", "pass": "bool"},
+    "elastic": {**_ENVELOPE, "pass_slo": "bool", "pass_oscillation": "bool",
+                "pass_one_launch": "bool", "pass_scale_to_zero": "bool",
+                "pass": "bool"},
+    "preempt": {**_ENVELOPE, "pass_slo": "bool", "pass_preempted": "bool",
+                "pass_gang_o1": "bool", "pass": "bool"},
+    "flagship_cold": _ROUND,
+    "flagship": _ROUND,
+}
+
+_SCHEMA_TYPES = {
+    "str": (str,),
+    "bool": (bool,),
+    "int": (int,),
+    "num": (int, float),
+    "num?": (int, float, type(None)),
+    "dict": (dict,),
+    "list": (list,),
+}
+
+
+class BenchSchemaError(ValueError):
+    """A result line does not match its config's declared schema."""
+
+
+def validate_result(config: str, rec: dict) -> dict:
+    """Validate one config's JSON result line against RESULT_SCHEMAS;
+    returns `rec` unchanged on success, raises BenchSchemaError otherwise.
+    Error lines (a failed config) only need the base envelope — their
+    acceptance fields never materialized."""
+    schema = RESULT_SCHEMAS.get(config)
+    if schema is None:
+        raise BenchSchemaError(
+            f"config {config!r} has no declared result schema "
+            f"(add it to RESULT_SCHEMAS)")
+    required = dict(_ENVELOPE) if "error" in rec else dict(schema)
+    for key, spec in required.items():
+        if key not in rec:
+            raise BenchSchemaError(
+                f"{config}: result line missing required key {key!r}")
+        want = _SCHEMA_TYPES[spec]
+        val = rec[key]
+        # bool is an int subclass: an "int"/"num" field must not accept it
+        if isinstance(val, bool) and bool not in want:
+            raise BenchSchemaError(
+                f"{config}: key {key!r} expects {spec}, got bool")
+        if not isinstance(val, want):
+            raise BenchSchemaError(
+                f"{config}: key {key!r} expects {spec}, got "
+                f"{type(val).__name__}")
+    return rec
+
+
+def _validated_line(config: str, rec: dict) -> str:
+    return json.dumps(validate_result(config, rec))
+
 # coldstart measures PROCESS boot, not round latency — a fixed modest shape
 # keeps the three child boots affordable on the CPU fallback while the
 # compile cost being amortized is shape-independent
@@ -3535,7 +3754,7 @@ def run_bench(args) -> None:
                       f"populate={rec.get('populate_s')}s "
                       f"warm={rec.get('warm_cache_s')}s "
                       f"under_ttl={rec.get('under_lease_ttl')}")
-            lines.append(json.dumps(rec))
+            lines.append(_validated_line("coldstart", rec))
             continue
         if name == "fanout":
             import types
@@ -3554,7 +3773,7 @@ def run_bench(args) -> None:
                 }
             # host-side serving-path bench: no device kernels involved, so
             # the number is meaningful on any backend — no cpu-fallback note
-            lines.append(json.dumps(rec))
+            lines.append(_validated_line("fanout", rec))
             continue
         if name == "writeload":
             import types
@@ -3572,7 +3791,7 @@ def run_bench(args) -> None:
                     "error": f"{type(e).__name__}: {e}"[:300],
                 }
             # host-side write-path bench: meaningful on any backend
-            lines.append(json.dumps(rec))
+            lines.append(_validated_line("writeload", rec))
             continue
         if name == "replica":
             import types
@@ -3590,7 +3809,7 @@ def run_bench(args) -> None:
                     "error": f"{type(e).__name__}: {e}"[:300],
                 }
             # host-side replication bench: meaningful on any backend
-            lines.append(json.dumps(rec))
+            lines.append(_validated_line("replica", rec))
             continue
         if name == "elastic":
             import types
@@ -3615,7 +3834,7 @@ def run_bench(args) -> None:
                     "cpu fallback; the placement half of the loop targets "
                     f"TPU — last TPU capture: {latest_capture_name()}"
                 )
-            lines.append(json.dumps(rec))
+            lines.append(_validated_line("elastic", rec))
             continue
         if name == "preempt":
             import types
@@ -3636,7 +3855,7 @@ def run_bench(args) -> None:
                     f"box's baseline — last TPU capture: "
                     f"{latest_capture_name()}"
                 )
-            lines.append(json.dumps(rec))
+            lines.append(_validated_line("preempt", rec))
             continue
         if name == "stream":
             import types
@@ -3663,7 +3882,7 @@ def run_bench(args) -> None:
                     "cpu fallback; latency SLO targets TPU — last TPU "
                     f"capture: {latest_capture_name()}"
                 )
-            lines.append(json.dumps(rec))
+            lines.append(_validated_line("stream", rec))
             continue
         build, metric_suffix = CONFIGS[name]
         t0 = time.perf_counter()
@@ -3756,7 +3975,7 @@ def run_bench(args) -> None:
             # fallback, not a regression (VERDICT r4 weak #4)
             rec["note"] = ("cpu fallback; BASELINE targets TPU — last TPU "
                            f"capture: {latest_capture_name()}")
-        lines.append(json.dumps(rec))
+        lines.append(_validated_line(name, rec))
     for line in lines:
         print(line)
 

@@ -55,6 +55,13 @@ def main() -> None:
                     help="dedicated READ-ONLY token accepted on GET "
                          "/metrics only (the Prometheus credential no "
                          "longer needs to be the full wire token)")
+    ap.add_argument("--enable-pprof", action="store_true",
+                    help="serve /debug/pprof (sampled whole-process CPU "
+                         "profile + heap) on --pprof-port; protected by "
+                         "the wire token OR the --scrape-token-file "
+                         "credential, like /metrics")
+    ap.add_argument("--pprof-port", type=int, default=0,
+                    help="port for --enable-pprof (0 = ephemeral, printed)")
     args = ap.parse_args()
 
     # host-plane process: never let an ambient TPU backend init block startup
@@ -91,6 +98,12 @@ def main() -> None:
     )
     metrics_srv = start_metrics_server(
         args.metrics_port, token=token,
+        scrape_token_file=args.scrape_token_file,
+    )
+    from ..tracing import start_profile_server
+
+    profile_srv = start_profile_server(
+        args.enable_pprof, port=args.pprof_port, token=token,
         scrape_token_file=args.scrape_token_file,
     )
 
@@ -156,6 +169,8 @@ def main() -> None:
             elector.stop(release=True)
         if metrics_srv is not None:
             metrics_srv.stop()
+        if profile_srv is not None:
+            profile_srv.stop()
         session.close()
 
 

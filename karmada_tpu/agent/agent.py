@@ -116,7 +116,11 @@ class KarmadaAgent:
             work = self.store.update(work)
         if work.spec.suspend_dispatching:
             return DONE
+        import time as _time
+
+        t_apply0 = _time.time()
         results = apply_work_manifests(work, self.member, self.interpreter)
+        t_apply1 = _time.time()
         errors = [r.message for r in results if not r.ok]
         if set_condition(
             work.status.conditions,
@@ -127,6 +131,22 @@ class KarmadaAgent:
                 message="; ".join(errors) if errors else "Manifest has been successfully applied",
             ),
         ):
+            # distributed tracing: stamp the apply timing onto the Work so
+            # it rides THIS status write (the coalesced agent-status path —
+            # zero extra round-trips) to the plane, where the TraceCollector
+            # lifts it into the binding's member_apply span. The id is
+            # derived from (work uid, generation), so a coalescer replay or
+            # redirect re-send of the same report dedups to ONE span.
+            from ..tracing import APPLY_SPAN_ANNOTATION, tracer
+
+            if tracer.enabled:
+                import json as _json
+
+                work.metadata.annotations[APPLY_SPAN_ANNOTATION] = _json.dumps({
+                    "id": f"apply-{work.metadata.uid}-g{work.metadata.generation}",
+                    "cluster": self.member.name,
+                    "start": t_apply0, "end": t_apply1,
+                })
             # the applied-condition report is level-triggered and idempotent
             # — the one write that may ride the coalescing buffer
             if self._status_coalescer is not None:

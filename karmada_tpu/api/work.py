@@ -19,6 +19,11 @@ KIND_WORK = "Work"
 
 # Well-known labels/annotations (mirror pkg/apis/work/v1alpha2/well_known_*.go)
 RESOURCE_BINDING_PERMANENT_ID_LABEL = "resourcebinding.karmada.io/permanent-id"
+# Work -> owning-ResourceBinding back-references (stamped by the
+# binding controller, read by status aggregation and the trace
+# collector — one definition so the wire-visible names cannot drift)
+WORK_BINDING_NAMESPACE_LABEL = "resourcebinding.karmada.io/namespace"
+WORK_BINDING_NAME_LABEL = "resourcebinding.karmada.io/name"
 POLICY_PLACEMENT_ANNOTATION = "policy.karmada.io/applied-placement"
 WORK_NAMESPACE_PREFIX = "karmada-es-"
 

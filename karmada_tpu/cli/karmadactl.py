@@ -1119,6 +1119,29 @@ def cmd_elections(cp: ControlPlane, wide: bool = False) -> str:
     return _elections_table(leases, wide=wide, repl=_replication_status(cp))
 
 
+def cmd_trace(cp: ControlPlane, kind: str, ref: str,
+              output: str = "") -> str:
+    """`karmadactl trace binding <ns>/<name>` — render the binding's
+    placement trace as a waterfall with the critical path highlighted
+    (docs/OBSERVABILITY.md). In-process planes read the global tracer;
+    --server planes ride GET /traces."""
+    from ..tracing import render_waterfall
+
+    if kind.lower() not in ("binding", "bindings", "resourcebinding",
+                            "resourcebindings", "rb"):
+        raise CLIError(f"trace supports 'binding', got {kind!r}")
+    ns, sep, name = ref.partition("/")
+    if not sep:
+        ns, name = "", ref
+    trace_of = getattr(cp, "trace_of", None)
+    if trace_of is None:
+        raise CLIError("this plane does not expose placement traces")
+    trace = trace_of(ns, name)
+    if output == "json":
+        return json.dumps(trace, indent=2, default=str)
+    return render_waterfall(trace)
+
+
 def cmd_replication_status(cp: ControlPlane) -> str:
     """`karmadactl replication status` — this plane's replication role;
     on a leader, one row per follower with its rv lag (docs/HA.md).
@@ -1559,6 +1582,11 @@ def run(cp: ControlPlane, argv: list[str]) -> str:
     p = sub.add_parser("elections")
     p.add_argument("-o", "--output", default="",
                    help="'' (table) or wide")
+    p = sub.add_parser("trace")
+    p.add_argument("kind", help="binding")
+    p.add_argument("ref", help="namespace/name of the ResourceBinding")
+    p.add_argument("-o", "--output", default="",
+                   help="'' (waterfall) or json")
     p = sub.add_parser("replication")
     p.add_argument("action", nargs="?", default="status",
                    help="status (per-follower lag on a leader; role + "
@@ -1731,6 +1759,8 @@ def run(cp: ControlPlane, argv: list[str]) -> str:
         )
     if args.command == "elections":
         return cmd_elections(cp, wide=args.output == "wide")
+    if args.command == "trace":
+        return cmd_trace(cp, args.kind, args.ref, output=args.output)
     if args.command == "replication":
         if args.action != "status":
             raise CLIError(f"unknown replication action {args.action!r} "

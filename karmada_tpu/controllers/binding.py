@@ -15,6 +15,8 @@ from ..api.policy import PURGE_MODE_IMMEDIATELY, REPLICA_SCHEDULING_DIVIDED
 from ..api.unstructured import Unstructured
 from ..api.work import (
     RESOURCE_BINDING_PERMANENT_ID_LABEL,
+    WORK_BINDING_NAME_LABEL,
+    WORK_BINDING_NAMESPACE_LABEL,
     ResourceBinding,
     TargetCluster,
     Work,
@@ -26,8 +28,6 @@ from ..runtime.controller import Controller, DONE, Runtime
 from ..store.store import Store
 from ..utils.names import execution_namespace, work_name
 
-WORK_BINDING_NAMESPACE_LABEL = "resourcebinding.karmada.io/namespace"
-WORK_BINDING_NAME_LABEL = "resourcebinding.karmada.io/name"
 
 
 class BindingController:
@@ -172,9 +172,17 @@ class BindingController:
                 work.spec = new_spec
                 pending_works.append(work)
         if pending_works:
-            from ..store.batching import apply_all
+            import time as _time
 
+            from ..store.batching import apply_all
+            from ..tracing import tracer
+
+            t0 = _time.time()
             apply_all(self.store, pending_works, path="binding_works")
+            # tracing: the per-cluster Work fan-out stage of this binding's
+            # placement trace (post-placement: targets the retained trace)
+            tracer.record(rb.metadata.key(), "work_fanout", t0, _time.time(),
+                          placed=True, clusters=len(pending_works))
         # Graceful eviction: Works on evicting clusters (PurgeMode != Immediately)
         # survive until the eviction task is assessed away
         # (helper.ObtainBindingSpecExistingClusters).

@@ -141,6 +141,9 @@ class BindingStatusController:
             self.controller.enqueue(f"{rb_ns}/{rb_name}")
 
     def _reconcile(self, key: str) -> str:
+        import time as _time
+
+        t_agg0 = _time.time()
         ns, _, name = key.partition("/")
         rb: ResourceBinding = self.store.try_get("ResourceBinding", name, ns)
         if rb is None or rb.metadata.deletion_timestamp is not None:
@@ -194,6 +197,14 @@ class BindingStatusController:
         )
         if changed or cond_changed:
             self.store.update(rb)
+            if fully_applied:
+                # tracing: the aggregation that first observed the binding
+                # fully applied closes its placement trace's last stage
+                from ..tracing import tracer
+
+                tracer.record(key, "status_aggregation", t_agg0,
+                              _time.time(), placed=True,
+                              clusters=len(rb.spec.clusters))
 
         # write aggregated status back onto the template (AggregateStatus op).
         # check_rv + retry: the interpreter call sits between read and write,

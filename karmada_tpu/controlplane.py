@@ -159,6 +159,16 @@ class ControlPlane:
 
         self.store = Store()
         self.runtime = Runtime(clock=clock)
+        # distributed placement tracing (tracing/, docs/OBSERVABILITY.md):
+        # the collector rides the store's under-lock event sink, anchoring
+        # template-write/detector/binding-create spans and lifting pull-mode
+        # member_apply spans off the coalesced agent-status writes. Cheap
+        # enough to be always-on (head sampling defaults to 1/64; the
+        # stream bench's tracing-on leg pins the overhead envelope).
+        from .tracing import TraceCollector
+
+        self.trace_collector = TraceCollector(self.store)
+        self.trace_collector.attach()
         # leader-election lease CAS + write fencing for the daemon topology
         # (coordination/lease.py; served over /leases/* and X-Karmada-Fencing)
         from .coordination.lease import LeaseCoordinator
@@ -607,6 +617,21 @@ class ControlPlane:
         simulator instead of the store — returns the displacement report,
         mutates nothing (the report is NOT persisted either)."""
         return self.descheduler.deschedule_dryrun(diff_limit=diff_limit)
+
+    # -- placement traces (tracing/, docs/OBSERVABILITY.md) ----------------
+
+    def trace_of(self, namespace: str, name: str):
+        """Full placement trace of one binding (retained ring first, else
+        the in-flight pending stretch); None when sampling dropped it.
+        The `karmadactl trace binding` backing call."""
+        from .tracing import tracer
+
+        return tracer.get(key=f"{namespace}/{name}" if namespace else name)
+
+    def traces(self) -> list:
+        from .tracing import tracer
+
+        return tracer.traces()
 
     # -- what-if simulation plane (simulation/engine.py) -------------------
 

@@ -83,8 +83,13 @@ class Histogram:
     _counts: dict[tuple, list[int]] = field(default_factory=dict)
     _sums: dict[tuple, float] = field(default_factory=dict)
     _totals: dict[tuple, int] = field(default_factory=dict)
+    # per-bucket exemplars: label key -> {bucket index: (value, trace_id)}
+    # keeping the WORST observation per bucket — the trace an operator
+    # wants when a bucket's count looks bad (docs/OBSERVABILITY.md)
+    _exemplars: dict[tuple, dict] = field(default_factory=dict)
 
-    def observe(self, v: float, **labels: str) -> None:
+    def observe(self, v: float, exemplar: Optional[str] = None,
+                **labels: str) -> None:
         k = _label_key(labels)
         with _mutate_lock:
             counts = self._counts.setdefault(k, [0] * len(self.buckets))
@@ -93,6 +98,11 @@ class Histogram:
                 counts[i] += 1
             self._sums[k] = self._sums.get(k, 0.0) + v
             self._totals[k] = self._totals.get(k, 0) + 1
+            if exemplar:
+                ex = self._exemplars.setdefault(k, {})
+                cur = ex.get(i)
+                if cur is None or v > cur[0]:
+                    ex[i] = (v, exemplar)
 
     def count(self, **labels: str) -> int:
         return self._totals.get(_label_key(labels), 0)
@@ -145,11 +155,23 @@ class MetricsRegistry:
                 self._metrics[name] = m
             return m  # type: ignore[return-value]
 
-    def render(self) -> str:
+    def render(self, exemplars: bool = True) -> str:
         """Prometheus text exposition. Label sets are snapshotted under the
         mutation lock: per-client series (watch_client_lag) appear and
         vanish with live connections, and iterating a dict another thread
-        is resizing raises mid-scrape."""
+        is resizing raises mid-scrape.
+
+        `exemplars=False` omits the OpenMetrics exemplar suffixes — the
+        classic text/plain 0.0.4 format does not allow them, so the HTTP
+        handlers only include exemplars when the scraper NEGOTIATED
+        openmetrics-text via its Accept header (exactly Prometheus's own
+        contract; a 0.0.4 parser would fail the whole scrape on the
+        mid-line '#'). The negotiated form also ends with the mandatory
+        '# EOF' terminator. NOTE: the exposition is OpenMetrics-FLAVORED,
+        not fully conformant — counter families keep their _total-suffixed
+        TYPE declarations (this registry is dependency-free and "close
+        enough" by design, see the module docstring); strict-OM family
+        renaming is out of scope."""
         out: list[str] = []
         with self._lock:
             metrics = list(self._metrics.values())
@@ -174,17 +196,33 @@ class MetricsRegistry:
                     counts = {k: list(v) for k, v in m._counts.items()}
                     sums = dict(m._sums)
                     totals = dict(m._totals)
+                    ex_snap = ({k: dict(v) for k, v in m._exemplars.items()}
+                               if exemplars else {})
                 for k in sorted(totals):
                     acc = 0
                     for i, c in enumerate(counts[k]):
                         acc += c
                         le = ("le", repr(m.buckets[i]))
-                        out.append(f"{m.name}_bucket{_fmt_labels(k + (le,))} {acc}")
+                        line = f"{m.name}_bucket{_fmt_labels(k + (le,))} {acc}"
+                        ex = ex_snap.get(k, {}).get(i)
+                        if ex is not None:
+                            # OpenMetrics exemplar: the worst trace in this
+                            # bucket, linkable via GET /traces?trace_id=
+                            line += f' # {{trace_id="{ex[1]}"}} {ex[0]}'
+                        out.append(line)
                     inf = ("le", "+Inf")
-                    out.append(f"{m.name}_bucket{_fmt_labels(k + (inf,))} {totals[k]}")
+                    line = f"{m.name}_bucket{_fmt_labels(k + (inf,))} {totals[k]}"
+                    ex = ex_snap.get(k, {}).get(len(m.buckets))
+                    if ex is not None:
+                        line += f' # {{trace_id="{ex[1]}"}} {ex[0]}'
+                    out.append(line)
                     out.append(f"{m.name}_sum{_fmt_labels(k)} {sums[k]}")
                     out.append(f"{m.name}_count{_fmt_labels(k)} {totals[k]}")
-        return "\n".join(out) + "\n"
+        text = "\n".join(out) + "\n"
+        if exemplars:
+            # OpenMetrics requires the exposition to end with '# EOF'
+            text += "# EOF\n"
+        return text
 
 
 def _fmt_labels(k: tuple) -> str:

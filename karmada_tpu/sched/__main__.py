@@ -90,6 +90,13 @@ def main() -> None:
                     help="dedicated READ-ONLY token accepted on GET "
                          "/metrics only (the Prometheus credential no "
                          "longer needs to be the full wire token)")
+    ap.add_argument("--enable-pprof", action="store_true",
+                    help="serve /debug/pprof (sampled whole-process CPU "
+                         "profile + heap) on --pprof-port; protected by "
+                         "the wire token OR the --scrape-token-file "
+                         "credential, like /metrics")
+    ap.add_argument("--pprof-port", type=int, default=0,
+                    help="port for --enable-pprof (0 = ephemeral, printed)")
     ap.add_argument("--compile-cache-dir", default="",
                     help="persistent XLA compilation-cache directory "
                          "(docs/PERF.md compile economics): compiled round "
@@ -192,6 +199,12 @@ def main() -> None:
         args.metrics_port, token=token,
         scrape_token_file=args.scrape_token_file,
     )
+    from ..tracing import start_profile_server
+
+    profile_srv = start_profile_server(
+        args.enable_pprof, port=args.pprof_port, token=token,
+        scrape_token_file=args.scrape_token_file,
+    )
 
     lease_name = args.lease_name or (
         LEASE_SCHEDULER if args.scheduler_name == "default-scheduler"
@@ -289,6 +302,8 @@ def main() -> None:
             elector.stop(release=True)
         if metrics_srv is not None:
             metrics_srv.stop()
+        if profile_srv is not None:
+            profile_srv.stop()
         store.close()
 
 

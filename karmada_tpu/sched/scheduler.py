@@ -48,6 +48,7 @@ from ..metrics import (
 )
 from ..runtime.controller import BatchingController, Runtime
 from ..store.store import DELETED, MODIFIED, Store
+from ..tracing import tracer
 from .core import ArrayScheduler, ScheduleDecision
 from .queue import GangCoordinator, PrioritySchedulingQueue
 
@@ -97,10 +98,15 @@ class AdmissionLog:
         self._epoch: dict[str, int] = {}
         self._admitted: dict[str, float] = {}
 
-    def note(self, key: str, now: float) -> None:
+    def note(self, key: str, now: float, uid: str = "") -> None:
         with self._lock:
-            self._epoch[key] = next(self._gen)
+            epoch = next(self._gen)
+            self._epoch[key] = epoch
             self._admitted.setdefault(key, now)
+        # distributed tracing (tracing/spans.py): the admission IS the
+        # trace's (uid, epoch) key — setdefault semantics inside admit()
+        # mirror _admitted, so coalesced re-events share one trace
+        tracer.admit(key, uid or key, epoch)
 
     def invalidate(self, key: str) -> None:
         """Fence off any in-flight decision for `key` WITHOUT starting a
@@ -112,6 +118,7 @@ class AdmissionLog:
         with self._lock:
             self._epoch[key] = next(self._gen)
             self._admitted.pop(key, None)
+        tracer.settle(key)
 
     def epoch(self, key: str) -> int:
         with self._lock:
@@ -133,11 +140,13 @@ class AdmissionLog:
         any — e.g. the daemon's own patch event) resolves un-measured."""
         with self._lock:
             self._admitted.pop(key, None)
+        tracer.settle(key)
 
     def forget(self, key: str) -> None:
         with self._lock:
             self._epoch.pop(key, None)
             self._admitted.pop(key, None)
+        tracer.forget(key)
 
 
 class SchedulerDaemon:
@@ -288,8 +297,8 @@ class SchedulerDaemon:
         if self.admission.enabled:
             # note BEFORE enqueue: the enqueue hook wakes the streaming
             # admission loop, whose epoch snapshot must already see this
-            # event's bump
-            self.admission.note(key, self.clock.now())
+            # event's bump; the uid keys the binding's placement trace
+            self.admission.note(key, self.clock.now(), uid=rb.metadata.uid)
         self.controller.enqueue(key)
 
     def _priority_of(self, key: str) -> int:

@@ -69,6 +69,7 @@ from ..metrics import (
     schedule_attempts,
 )
 from ..models.batch import shape_floor
+from ..tracing import tracer
 from .pipeline import DEFAULT_DEPTH, StageTimer, StreamPipeline
 
 log = logging.getLogger(__name__)
@@ -99,6 +100,9 @@ class _MicroBatch:
     replayed: int = 0
     solved: int = 0
     stats: dict = field(default_factory=dict)
+    # tracing: (launch id, wall start, wall dispatch-end) of the shared
+    # device launch — ONE span fanned out to the batch's member traces
+    launch_wall: tuple = ()
 
 
 class StreamingScheduler:
@@ -424,16 +428,26 @@ class StreamingScheduler:
                 if daemon._gang_of(rb):
                     daemon.gangs.discard(key, rb.spec.gang_name)
             elif gate == "schedule":
+                aging = getattr(daemon.controller.queue, "aging_step", 0.0)
                 if daemon._gang_of(rb):
                     # gang member: park in the coordinator until the whole
                     # cohort is here; the completing offer releases every
                     # held member into THIS micro-batch, so a gang always
                     # solves (and commits) as one cohort
-                    for k2, rb2, e2 in daemon.gangs.offer(key, rb, epoch):
+                    cohort = daemon.gangs.offer(key, rb, epoch)
+                    if not cohort:
+                        # held: the gang_hold span stays open until the
+                        # completing offer (or a timeout drops the trace)
+                        tracer.mark(key, "gang_hold")
+                    for k2, rb2, e2 in cohort:
+                        tracer.unmark(k2, "gang_hold",
+                                      gang=rb.spec.gang_name)
+                        tracer.drained(k2, aging)
                         bindings.append(rb2)
                         out_keys.append(k2)
                         epochs.append(e2)
                     continue
+                tracer.drained(key, aging)
                 bindings.append(rb)
                 out_keys.append(key)
                 epochs.append(epoch)
@@ -502,9 +516,15 @@ class StreamingScheduler:
         # routed: a mixed-priority micro-batch solves as ONE segmented
         # tiered launch (sched/preemption.py); uniform batches ride the
         # ordinary replay-aware path — identical call shape either way
+        t0 = time.time()
         pending = self.daemon._launch_routed(
             self._array, mb.bindings, extra, round_rows=len(mb.bindings)
         )
+        if tracer.enabled:
+            # one shared launch span per micro-batch, fanned out to the
+            # member traces at the patch stage (dispatch end here; the
+            # device+materialize tail closes when the writer picks it up)
+            mb.launch_wall = (f"launch-{i}", t0, time.time())
         mb.replayed = pending["replayed"]
         mb.solved = pending["solved"]
         return pending
@@ -556,10 +576,28 @@ class StreamingScheduler:
                 continue
             schedule_attempts.inc(result="scheduled" if dec.ok else "error")
             cohort.append((key, rb, dec))
+        # tracing: the shared solve span (launch dispatch -> writer pickup,
+        # covering device compute + materialize) fans out to every row of
+        # the cohort, split into dispatch vs device time; the rv-checked
+        # commit below becomes each row's commit span
+        t_solved = time.time()
         # coalesced patch (docs/PERF.md "Write path at fleet scale"): one
         # batch read + ONE transactional batch write for the whole cohort —
         # the micro-batch's B decisions were 2·B store round-trips
         outcomes = daemon._patch_results([(rb, dec) for _, rb, dec in cohort])
+        t_committed = time.time()
+        if tracer.enabled and mb.launch_wall and cohort:
+            lid, l0, l1 = mb.launch_wall
+            for (key, _rb, _dec), ok in zip(cohort, outcomes):
+                if not ok:
+                    continue
+                tracer.record(key, "solve", l0, t_solved, launch=lid,
+                              rows=len(mb.bindings), replayed=mb.replayed,
+                              solved=mb.solved,
+                              dispatch_ms=round((l1 - l0) * 1e3, 3),
+                              device_ms=round((t_solved - l1) * 1e3, 3))
+                tracer.record(key, "commit", t_solved, t_committed,
+                              cohort=len(cohort))
         for (key, rb, dec), ok in zip(cohort, outcomes):
             if not ok:
                 # last-moment veto under the store's serialization: a
@@ -582,9 +620,15 @@ class StreamingScheduler:
                 continue
             lat = admission.observe_patch(key, daemon.clock.now())
             if lat is not None:
-                placement_latency.observe(lat)
+                # retention decision: head-sampled or SLO-breaching traces
+                # survive; the retained trace id rides the SLO histogram
+                # as the bucket exemplar (worst trace per bucket)
+                tid = tracer.finish_placement(key, lat)
+                placement_latency.observe(lat, exemplar=tid)
                 with self._stats_lock:
                     self._latencies.append(lat)
+            else:
+                tracer.finish_placement(key, None)
             placed += 1
         e2e_scheduling_duration.observe(time.perf_counter() - mb.t0)
         # per-batch stats (the streaming analogue of the round stats).

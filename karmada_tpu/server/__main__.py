@@ -114,6 +114,14 @@ def main() -> None:
     ap.add_argument("--advertise-url", default="",
                     help="URL followers and redirected clients should dial "
                          "this server at (default: the bound host:port)")
+    ap.add_argument("--enable-pprof", action="store_true",
+                    help="serve /debug/pprof (sampled whole-process CPU "
+                         "profile + tracemalloc heap) on --pprof-port; "
+                         "protected by the wire token OR the read-only "
+                         "scrape token, like /metrics "
+                         "(docs/OBSERVABILITY.md)")
+    ap.add_argument("--pprof-port", type=int, default=0,
+                    help="port for --enable-pprof (0 = ephemeral, printed)")
     ap.add_argument("--follower", action="store_true",
                     help="serve as a replication follower: reads + the "
                          "replication apply path only. Disables controllers, "
@@ -279,6 +287,13 @@ def main() -> None:
             advertise_url=args.advertise_url, auth_token=token,
         )
 
+    from ..tracing import start_profile_server
+
+    profile_srv = start_profile_server(
+        args.enable_pprof, port=args.pprof_port, token=token,
+        scrape_token=scrape_token,
+    )
+
     srv = ControlPlaneServer(cp, host=args.host, port=args.port,
                              ssl_context=ssl_context, token=token,
                              enable_test_clock=args.enable_test_clock,
@@ -360,6 +375,8 @@ def main() -> None:
             elector.stop(release=True)
         if repl_elector is not None:
             repl_elector.stop(release=True)
+        if profile_srv is not None:
+            profile_srv.stop()
         srv.stop()
         if persistence is not None:
             persistence.snapshot()

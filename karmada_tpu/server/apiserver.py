@@ -316,6 +316,20 @@ class ControlPlaneServer:
             return
         if method != "GET" and not self._follower_write_ok(h, parsed.path):
             return
+        # distributed tracing: a mutating request may carry X-Karmada-Trace
+        # (trace id + LOGICAL span id); the server records its side of the
+        # write as a commit span under that context. The span id dedups, so
+        # a replay-idempotent retry or a 409->leader-redirect re-send of
+        # the same logical write yields exactly ONE commit span.
+        trace_ctx = None
+        if method != "GET":
+            from ..tracing import parse_trace_header
+
+            trace_ctx = parse_trace_header(
+                h.headers.get("X-Karmada-Trace", ""))
+            if trace_ctx is not None and not trace_ctx[2]:
+                trace_ctx = None  # s=0: head-dropped upstream
+        t_req = time.time() if trace_ctx is not None else 0.0
         try:
             fn = getattr(self, f"_h_{method}_{parsed.path.strip('/').replace('/', '_')}", None)
             if fn is None:
@@ -323,6 +337,21 @@ class ControlPlaneServer:
                 self._send(h, 404, {"error": f"no route {method} {parsed.path}"})
                 return
             fn(h, q)
+            # record ONLY on success: a handler that raised OR answered a
+            # 4xx/5xx via _send (POST /objects/batch reports BatchError as
+            # a 409 body and returns normally) committed nothing — its
+            # span would show a commit that never happened, and recording
+            # it would also burn the logical span id so the client's real
+            # replayed commit deduped away. A replay whose first attempt
+            # succeeded server-side still dedups here by span id.
+            if (trace_ctx is not None
+                    and getattr(h, "_trace_status", 200) < 400):
+                from ..tracing import tracer
+
+                tracer.record_trace(
+                    trace_ctx[0], "commit", t_req, time.time(),
+                    span_id=trace_ctx[1], route=parsed.path,
+                )
         except NotFoundError as e:
             self._send(h, 404, {"error": str(e)})
         except ConflictError as e:
@@ -452,6 +481,10 @@ class ControlPlaneServer:
 
     @staticmethod
     def _send(h, status: int, body: dict) -> None:
+        # remember the status for the commit-span gate in _route: some
+        # handlers (POST /objects/batch) report failure by SENDING 409/422
+        # and returning normally instead of raising
+        h._trace_status = status
         send_json(h, status, body)
 
     @staticmethod
@@ -856,13 +889,41 @@ class ControlPlaneServer:
             "applied_rv": self.cp.store.current_rv,
         })
 
+    def _h_GET_traces(self, h, q):
+        """Placement-trace store (docs/OBSERVABILITY.md): summaries of the
+        retained ring, one full trace by ?trace_id= or ?binding=<ns>/<name>,
+        or the per-stage SLO attribution table with ?report=1 (the soak's
+        report artifact). Served from the process-global tracer — the plane
+        that runs the streaming scheduler in-process holds the full causal
+        chain; split topologies contribute their commit/apply spans via the
+        X-Karmada-Trace header and the agent-status path."""
+        from ..tracing import slo_report, tracer
+
+        if q.get("report"):
+            self._send(h, 200, {"report": slo_report()})
+            return
+        tid, binding = q.get("trace_id"), q.get("binding")
+        if tid or binding:
+            trace = tracer.get(trace_id=tid, key=binding)
+            if trace is None:
+                self._send(h, 404, {"error": "no trace retained for "
+                                             f"{tid or binding!r}"})
+                return
+            self._send(h, 200, {"trace": trace})
+            return
+        self._send(h, 200, {"traces": tracer.traces(),
+                            "config": tracer.config()})
+
     def _h_GET_metrics(self, h, q):
         """Prometheus text exposition (VERDICT r5 missing #5). Behind the
-        same bearer auth as every other route — _route already checked."""
+        same bearer auth as every other route — _route already checked.
+        Exemplars (trace ids on the SLO histogram buckets) render only for
+        scrapers that negotiated openmetrics-text via Accept."""
         from ..metrics import registry
-        from .httpbase import send_prometheus
+        from .httpbase import send_prometheus, wants_openmetrics
 
-        send_prometheus(h, registry.render())
+        om = wants_openmetrics(h)
+        send_prometheus(h, registry.render(exemplars=om), openmetrics=om)
 
     def _h_POST_agent_cert(self, h, q):
         cert = self.cp.sign_agent_cert(self._body(h)["cluster"])

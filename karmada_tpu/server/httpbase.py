@@ -41,15 +41,26 @@ def read_json(handler: BaseHTTPRequestHandler) -> dict:
     return json.loads(handler.rfile.read(n).decode())
 
 
-def send_prometheus(handler: BaseHTTPRequestHandler, text: str) -> None:
+def wants_openmetrics(handler: BaseHTTPRequestHandler) -> bool:
+    """Content negotiation for /metrics: exemplars are only legal in the
+    openmetrics-text exposition, so they render only when the scraper's
+    Accept header asks for it (Prometheus's own contract — a 0.0.4 parser
+    fails the whole scrape on a mid-line '#')."""
+    return "openmetrics-text" in handler.headers.get("Accept", "")
+
+
+def send_prometheus(handler: BaseHTTPRequestHandler, text: str,
+                    openmetrics: bool = False) -> None:
     """Prometheus text-exposition reply — the one place the content-type
     version and framing live (used by the apiserver /metrics route and the
     per-daemon MetricsServer)."""
     try:
         data = text.encode()
         handler.send_response(200)
-        handler.send_header("Content-Type",
-                            "text/plain; version=0.0.4; charset=utf-8")
+        handler.send_header(
+            "Content-Type",
+            "application/openmetrics-text; version=1.0.0; charset=utf-8"
+            if openmetrics else "text/plain; version=0.0.4; charset=utf-8")
         handler.send_header("Content-Length", str(len(data)))
         handler.end_headers()
         handler.wfile.write(data)

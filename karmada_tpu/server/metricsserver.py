@@ -21,6 +21,7 @@ from .httpbase import (
     bearer_auth_ok,
     send_json,
     send_prometheus,
+    wants_openmetrics,
 )
 
 
@@ -58,7 +59,9 @@ class MetricsServer(BackgroundHTTPServer):
                 if self.path.split("?", 1)[0] != "/metrics":
                     send_json(self, 404, {"error": f"no route {self.path}"})
                     return
-                send_prometheus(self, registry.render())
+                om = wants_openmetrics(self)
+                send_prometheus(self, registry.render(exemplars=om),
+                                openmetrics=om)
 
         return self.bind(Handler, "metrics-server")
 

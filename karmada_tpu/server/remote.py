@@ -109,6 +109,11 @@ class RemoteStore:
         self._watch_threads: list[threading.Thread] = []
         self._streams: list[tuple[str, Any, threading.Event]] = []
         self._closed = False
+        # per-thread X-Karmada-Trace value for the LOGICAL write in flight
+        # (set by the _write_call/_write_chunk retry loops so every retry
+        # and redirect re-send carries the same span id; thread-local
+        # because one RemoteStore serves many threads)
+        self._trace_tl = threading.local()
         # leader-election fence: while set, every request carries
         # X-Karmada-Fencing so a deposed holder's writes bounce with 409
         self._fence: Optional[str] = None
@@ -134,16 +139,39 @@ class RemoteStore:
     def clear_fence(self) -> None:
         self._fence = None
 
-    def _headers(self, with_content: bool) -> dict:
+    def _headers(self, with_content: bool,
+                 trace_header: Optional[str] = None) -> dict:
         headers = {"Content-Type": "application/json"} if with_content else {}
         if self.token:
             headers["Authorization"] = f"Bearer {self.token}"
         if self._fence:
             headers["X-Karmada-Fencing"] = self._fence
+        if trace_header:
+            headers["X-Karmada-Trace"] = trace_header
         return headers
 
+    @staticmethod
+    def _trace_header() -> Optional[str]:
+        """X-Karmada-Trace value for ONE logical write, minted from the
+        thread's active trace context (tracing.trace_context). Computed
+        ONCE before any retry loop: replays and redirect re-sends then
+        carry the same span id, and the serving plane dedups them to
+        exactly one commit span."""
+        from ..tracing import (
+            current_context,
+            format_trace_header,
+            new_span_id,
+        )
+
+        ctx = current_context()
+        if ctx is None:
+            return None
+        trace_id, _parent, sampled = ctx
+        return format_trace_header(trace_id, new_span_id(), sampled)
+
     def _call(self, method: str, path: str, body: Optional[dict] = None,
-              *, base: Optional[str] = None) -> dict:
+              *, base: Optional[str] = None,
+              trace_header: Optional[str] = None) -> dict:
         # chaos hook: the HTTP process boundary (faults/plan.py). A decision
         # surfaces as the same RemoteError a real transport failure raises,
         # so every consumer's error handling is exercised, not special-cased.
@@ -155,9 +183,10 @@ class RemoteStore:
         except faults.InjectedFault as e:
             raise RemoteError(f"control plane unreachable: {e}") from None
         data = json.dumps(body).encode() if body is not None else None
+        th = trace_header or getattr(self._trace_tl, "header", None)
         req = Request(
             (base or self.base_url) + path, data=data, method=method,
-            headers=self._headers(data is not None),
+            headers=self._headers(data is not None, th),
         )
         try:
             with urlopen(req, timeout=self.timeout,
@@ -246,25 +275,33 @@ class RemoteStore:
         contract), never as a definite-looking ConflictError."""
         origin = self.base_url
         ambiguous: Optional[RemoteError] = None
-        for attempt in range(5):
-            try:
-                return self._call(method, path, body)
-            except LeaderRedirect as e:
-                self._repoint(e.leader_url)
-            except ConflictError:
-                if ambiguous is not None:
-                    raise RemoteError(
-                        f"write outcome unknown: a retry after "
-                        f"'{ambiguous}' answered 409, which may be our "
-                        f"own landed request's replay") from ambiguous
-                raise
-            except RemoteError as e:
-                if self.base_url == origin:
-                    raise  # not a redirect problem: surface as before
-                ambiguous = e
-                self._set_base(origin)
-                time.sleep(0.2 * (attempt + 1))
-        raise ambiguous or RemoteError("write: leader redirects exhausted")
+        # one span id across every retry/redirect of this logical write —
+        # carried thread-locally so monkeypatched/stubbed transports keep
+        # working (the receiver dedups replays to one commit span)
+        self._trace_tl.header = self._trace_header()
+        try:
+            for attempt in range(5):
+                try:
+                    return self._call(method, path, body)
+                except LeaderRedirect as e:
+                    self._repoint(e.leader_url)
+                except ConflictError:
+                    if ambiguous is not None:
+                        raise RemoteError(
+                            f"write outcome unknown: a retry after "
+                            f"'{ambiguous}' answered 409, which may be our "
+                            f"own landed request's replay") from ambiguous
+                    raise
+                except RemoteError as e:
+                    if self.base_url == origin:
+                        raise  # not a redirect problem: surface as before
+                    ambiguous = e
+                    self._set_base(origin)
+                    time.sleep(0.2 * (attempt + 1))
+            raise ambiguous or RemoteError(
+                "write: leader redirects exhausted")
+        finally:
+            self._trace_tl.header = None
 
     def replication_status(self) -> dict:
         """GET /replication/status on the write base — role, applied rv,
@@ -295,7 +332,8 @@ class RemoteStore:
 
     # -- transactional batch writes (POST /objects/batch) ------------------
 
-    def _call_batch(self, body: dict) -> dict:
+    def _call_batch(self, body: dict,
+                    trace_header: Optional[str] = None) -> dict:
         """One batch round-trip. 4xx answers carrying per-object results
         raise the store's own BatchError so remote and in-process callers
         share one failure vocabulary; 404 (a pre-batch server) raises
@@ -307,9 +345,10 @@ class RemoteStore:
         except faults.InjectedFault as e:
             raise RemoteError(f"control plane unreachable: {e}") from None
         data = json.dumps(body).encode()
+        th = trace_header or getattr(self._trace_tl, "header", None)
         req = Request(
             self.base_url + "/objects/batch", data=data, method="POST",
-            headers=self._headers(True),
+            headers=self._headers(True, th),
         )
         try:
             with urlopen(req, timeout=self.timeout,
@@ -411,6 +450,18 @@ class RemoteStore:
             payload["skip_stale"] = skip_stale
         attempted = False
         origin = self.base_url
+        # one span id for this chunk's every retry (thread-local so stubbed
+        # transports inherit it): replays dedup server-side
+        prev_th = getattr(self._trace_tl, "header", None)
+        self._trace_tl.header = self._trace_header()
+        try:
+            return self._send_chunk(op, objs, payload, origin, attempted,
+                                    check_rv, skip_missing, skip_stale)
+        finally:
+            self._trace_tl.header = prev_th
+
+    def _send_chunk(self, op, objs, payload, origin, attempted,
+                    check_rv, skip_missing, skip_stale) -> list:
         for attempt in range(4):
             try:
                 resp = self._call_batch(payload)
@@ -913,6 +964,21 @@ class RemoteControlPlane:
             "POST", "/simulate", {"request": codec.encode(request)}
         )
         return codec.decode(out.get("report"))
+
+    def trace_of(self, namespace: str, name: str):
+        """GET /traces?binding= — the `karmadactl trace binding` backing
+        call over the wire; None when no trace is retained."""
+        binding = f"{namespace}/{name}" if namespace else name
+        try:
+            out = self.store._call(
+                "GET", f"/traces?binding={quote(binding, safe='')}"
+            )
+        except NotFoundError:
+            return None
+        return out.get("trace")
+
+    def traces(self) -> list:
+        return self.store._call("GET", "/traces").get("traces", [])
 
     def healthz(self) -> bool:
         try:

@@ -1,0 +1,55 @@
+"""Observability: local utiltrace spans, pprof endpoints, and the
+fleet-wide distributed placement-tracing plane (docs/OBSERVABILITY.md).
+
+- `Trace` (tracing/utiltrace.py) — k8s.io/utils/trace-style local spans
+  logged only when slow (ref estimate.go:37-38);
+- `ProfileServer` (tracing/profile.py) — opt-in /debug/pprof endpoints,
+  single-flight captures, scrape-token protected;
+- `tracer` / `Span` / `TraceCollector` (tracing/spans.py, collect.py) —
+  per-binding causal traces from template write to member apply, head
+  sampling + forced tail sampling of SLO breaches, `X-Karmada-Trace`
+  context propagation, served at GET /traces and rendered by
+  `karmadactl trace binding`;
+- `slo_report()` — the per-stage p50/p99 attribution table the fleet
+  soak emits (ROADMAP item 5a).
+"""
+from .collect import TraceCollector
+from .profile import ProfileServer, _sample_all_threads, start_profile_server
+from .render import critical_path, render_waterfall
+from .spans import (
+    APPLY_SPAN_ANNOTATION,
+    TRACE_HEADER,
+    PlacementTracer,
+    Span,
+    current_context,
+    format_trace_header,
+    new_span_id,
+    parse_trace_header,
+    slo_report,
+    trace_context,
+    tracer,
+)
+from .utiltrace import DEFAULT_SLOW_THRESHOLD_S, Trace, logger
+
+__all__ = [
+    "APPLY_SPAN_ANNOTATION",
+    "DEFAULT_SLOW_THRESHOLD_S",
+    "PlacementTracer",
+    "ProfileServer",
+    "Span",
+    "TRACE_HEADER",
+    "Trace",
+    "TraceCollector",
+    "critical_path",
+    "current_context",
+    "format_trace_header",
+    "logger",
+    "new_span_id",
+    "parse_trace_header",
+    "render_waterfall",
+    "slo_report",
+    "start_profile_server",
+    "trace_context",
+    "tracer",
+    "_sample_all_threads",
+]

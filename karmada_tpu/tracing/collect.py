@@ -1,0 +1,124 @@
+"""Plane-side trace collection off the store event sink.
+
+The collector rides `Store.add_event_sink` — the under-lock, rv-ordered
+seam the watch cache uses — so its timestamps are the commit order, not a
+watcher race. It must therefore stay FAST and never call back into the
+store; everything it does is bounded dict work on the process-global
+tracer.
+
+Three event families matter:
+
+- template-kind writes (any Unstructured gvk, i.e. a kind carrying an
+  apiVersion prefix): remember the commit wall time per object in a
+  bounded LRU — the anchor for the template_write -> detector_match span
+  when the binding appears;
+- ResourceBinding ADDED: begin the binding's trace and emit the
+  template_write / detector_match / binding_create spans from the
+  remembered anchor;
+- Work events carrying the `trace.karmada.io/apply-span` annotation: the
+  pull-mode agent's apply timing, shipped on the existing coalesced
+  agent-status write — lifted here into a member_apply span on the owning
+  binding's trace, deduped by the annotation's span id so coalescer
+  replays and redirect re-sends can't double-count.
+"""
+from __future__ import annotations
+
+import json
+import logging
+import time
+from collections import OrderedDict
+from typing import Optional
+
+from .spans import APPLY_SPAN_ANNOTATION, PlacementTracer
+from .spans import tracer as global_tracer
+
+log = logging.getLogger(__name__)
+
+_TPL_LRU = 4096
+
+
+class TraceCollector:
+    def __init__(self, store, use_tracer: Optional[PlacementTracer] = None):
+        self.store = store
+        self.tracer = use_tracer or global_tracer
+        self._tpl: OrderedDict[tuple[str, str], float] = OrderedDict()
+        self._attached = False
+        self._warned = False
+
+    def attach(self) -> None:
+        if not self._attached:
+            self.store.add_event_sink(self._sink)
+            self._attached = True
+
+    def detach(self) -> None:
+        if self._attached:
+            self.store.remove_event_sink(self._sink)
+            self._attached = False
+
+    # -- the sink (runs UNDER the store lock; never raise) -----------------
+
+    def _sink(self, kind: str, event: str, obj) -> None:
+        t = self.tracer
+        if not t.enabled:
+            return
+        try:
+            if kind == "ResourceBinding":
+                if event == "ADDED":
+                    self._on_binding_added(obj)
+            elif kind == "Work" or kind.endswith("/Work"):
+                self._on_work(obj)
+            elif "/" in kind:
+                # an Unstructured (template) kind: remember the commit time
+                self._tpl[(kind, obj.metadata.key())] = time.time()
+                while len(self._tpl) > _TPL_LRU:
+                    self._tpl.popitem(last=False)
+        except Exception:  # noqa: BLE001 - a sink raise surfaces to the mutator
+            if not self._warned:
+                self._warned = True
+                log.exception("trace collector sink failed (logged once)")
+
+    def _on_binding_added(self, rb) -> None:
+        t = self.tracer
+        key = rb.metadata.key()
+        rec = t.begin(key, rb.metadata.uid or key)
+        if rec is None:
+            return
+        now = time.time()
+        ref = getattr(rb.spec, "resource", None)
+        if ref is not None and ref.kind:
+            tpl_kind = f"{ref.api_version}/{ref.kind}"
+            # same key format ObjectMeta.key() produced in the sink
+            tpl_key = (f"{ref.namespace}/{ref.name}" if ref.namespace
+                       else ref.name)
+            ts = self._tpl.get((tpl_kind, tpl_key))
+            if ts is not None:
+                t.record(key, "template_write", ts, ts)
+                t.record(key, "detector_match", ts, now,
+                         template=f"{tpl_kind} {tpl_key}")
+        t.record(key, "binding_create", now, now)
+
+    def _on_work(self, work) -> None:
+        raw = work.metadata.annotations.get(APPLY_SPAN_ANNOTATION)
+        if not raw:
+            return
+        try:
+            span = json.loads(raw)
+        except ValueError:
+            return
+        from ..api.work import (
+            WORK_BINDING_NAME_LABEL,
+            WORK_BINDING_NAMESPACE_LABEL,
+        )
+
+        ns = work.metadata.labels.get(WORK_BINDING_NAMESPACE_LABEL)
+        name = work.metadata.labels.get(WORK_BINDING_NAME_LABEL)
+        if not name:
+            return
+        # same key format ObjectMeta.key() produced when the trace began:
+        # a cluster-scoped binding's key is the bare name
+        self.tracer.record(
+            f"{ns}/{name}" if ns else name, "member_apply",
+            float(span.get("start") or 0.0), float(span.get("end") or 0.0),
+            span_id=str(span.get("id") or ""), placed=True,
+            cluster=str(span.get("cluster") or ""),
+        )

@@ -1,0 +1,169 @@
+"""pprof-equivalent profile endpoints (ref pkg/sharedcli/profileflag).
+
+`ProfileServer` serves whole-process sampled CPU profiles (all threads'
+stacks) and heap snapshots (tracemalloc) for a live process, opt-in like
+the reference's --enable-pprof. Wired into the server/sched/agent daemons
+behind `--enable-pprof` and protected by the same read-only scrape token
+the /metrics routes accept (docs/OBSERVABILITY.md) — an unauthenticated
+profile endpoint leaks source paths and timing, and the capture itself is
+expensive enough to be a DoS lever.
+
+Captures are SINGLE-FLIGHT: a profile request holds a ThreadingHTTPServer
+handler thread for up to 30 s, so concurrent requests are bounded to one
+in-flight capture and the rest answer 429 instead of silently stacking
+handler threads behind each other.
+"""
+from __future__ import annotations
+
+import json
+import threading
+import time
+import tracemalloc
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+from urllib.parse import parse_qs, urlparse
+
+
+def _sample_all_threads(seconds: float, interval: float = 0.01) -> str:
+    """Statistical whole-process CPU profile: periodically snapshot every
+    thread's stack (sys._current_frames) and count frames. cProfile is
+    per-thread — enabling it in the HTTP handler would only ever profile the
+    handler's own sleep — so sampling is the honest pprof-style view of a
+    live multi-threaded process."""
+    import sys
+
+    me = threading.get_ident()
+    counts: dict[tuple[str, int, str], int] = {}
+    samples = 0
+    deadline = time.perf_counter() + seconds
+    while time.perf_counter() < deadline:
+        for tid, frame in sys._current_frames().items():
+            if tid == me:
+                continue
+            f = frame
+            while f is not None:
+                key = (f.f_code.co_filename, f.f_lineno, f.f_code.co_name)
+                counts[key] = counts.get(key, 0) + 1
+                f = f.f_back
+        samples += 1
+        time.sleep(interval)
+    top = sorted(counts.items(), key=lambda kv: -kv[1])[:60]
+    lines = [f"samples: {samples} (interval {interval * 1e3:.0f}ms, all threads)"]
+    for (fname, lineno, func), n in top:
+        lines.append(f"{n:6d}  {func}  {fname}:{lineno}")
+    return "\n".join(lines)
+
+
+class _ProfileHandler(BaseHTTPRequestHandler):
+    def log_message(self, *a):  # quiet
+        pass
+
+    def do_GET(self):  # noqa: N802 (http.server API)
+        srv: ProfileServer = self.server.profile_server  # type: ignore[attr-defined]
+        if not srv.auth_ok(self):
+            self._err(401, "unauthorized")
+            return
+        url = urlparse(self.path)
+        if url.path == "/debug/pprof/profile":
+            try:
+                seconds = float(parse_qs(url.query).get("seconds", ["2"])[0])
+            except ValueError:
+                self._err(400, "seconds must be a number")
+                return
+            # single-flight: one in-flight capture; a 30 s sample must not
+            # pile concurrent requests onto more handler threads
+            if not srv.capture_slot.acquire(blocking=False):
+                self._err(429, "a profile capture is already in flight; "
+                               "retry when it completes")
+                return
+            try:
+                self._ok(_sample_all_threads(min(seconds, 30.0)))
+            finally:
+                srv.capture_slot.release()
+        elif url.path == "/debug/pprof/heap":
+            if not tracemalloc.is_tracing():
+                # tracking starts now; only allocations made from this point
+                # are attributable (same lazy-start shape as pprof heap)
+                tracemalloc.start()
+                self._ok("tracemalloc started; re-request for allocation data")
+                return
+            snap = tracemalloc.take_snapshot()
+            top = snap.statistics("lineno")[:50]
+            self._ok("\n".join(str(s) for s in top) or "no tracked allocations")
+        elif url.path == "/debug/pprof/":
+            self._ok(json.dumps({"endpoints": ["profile?seconds=N", "heap"]}))
+        else:
+            self.send_error(404)
+
+    def _ok(self, body: str) -> None:
+        data = body.encode()
+        self.send_response(200)
+        self.send_header("Content-Type", "text/plain")
+        self.send_header("Content-Length", str(len(data)))
+        self.end_headers()
+        self.wfile.write(data)
+
+    def _err(self, status: int, msg: str) -> None:
+        data = json.dumps({"error": msg}).encode()
+        self.send_response(status)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(data)))
+        self.end_headers()
+        self.wfile.write(data)
+
+
+class ProfileServer:
+    """pkg/sharedcli/profileflag equivalent: opt-in /debug/pprof endpoints.
+
+    `token` / `scrape_token` guard every route with the same policy as
+    GET /metrics (either credential is accepted; with neither configured
+    the loopback default stays open)."""
+
+    def __init__(self, enable_pprof: bool = False, bind_address: str = "127.0.0.1",
+                 port: int = 0, token: Optional[str] = None,
+                 scrape_token: Optional[str] = None):
+        self.enabled = enable_pprof
+        self._token = token
+        self._scrape_token = scrape_token
+        self.capture_slot = threading.BoundedSemaphore(1)
+        self._server: Optional[ThreadingHTTPServer] = None
+        self.port = 0
+        if enable_pprof:
+            self._server = ThreadingHTTPServer((bind_address, port), _ProfileHandler)
+            self._server.profile_server = self  # type: ignore[attr-defined]
+            self.port = self._server.server_address[1]
+            t = threading.Thread(target=self._server.serve_forever, daemon=True)
+            t.start()
+
+    def auth_ok(self, handler) -> bool:
+        from ..server.metricsserver import scrape_auth_ok
+
+        return scrape_auth_ok(handler, self._token, self._scrape_token)
+
+    def stop(self) -> None:
+        if self._server is not None:
+            self._server.shutdown()
+            self._server = None
+
+
+def start_profile_server(enabled: bool, port: int = 0,
+                         host: str = "127.0.0.1",
+                         token: Optional[str] = None,
+                         scrape_token_file: str = "",
+                         scrape_token: Optional[str] = None,
+                         ) -> Optional[ProfileServer]:
+    """Daemon-main helper mirroring metricsserver.start_metrics_server:
+    materializes the --scrape-token-file credential (shared with /metrics;
+    pass `scrape_token` directly when the daemon already resolved it) and
+    prints the bound URL so drivers can find the ephemeral port."""
+    if not enabled:
+        return None
+    if scrape_token is None and scrape_token_file:
+        from ..server.tlsmaterial import ensure_token
+
+        scrape_token = ensure_token(scrape_token_file)
+    srv = ProfileServer(enable_pprof=True, bind_address=host, port=port,
+                        token=token, scrape_token=scrape_token)
+    print(f"pprof: serving on http://{host}:{srv.port}/debug/pprof/",
+          flush=True)
+    return srv

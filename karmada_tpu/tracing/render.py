@@ -1,0 +1,98 @@
+"""Waterfall rendering for `karmadactl trace binding <ns>/<name>`.
+
+Pure text formatting over a trace dict (PlacementTracer.get /
+GET /traces): one row per span, offset + duration + a proportional bar,
+with the CRITICAL PATH — the chain of spans that actually gates the
+end-to-end latency — marked so the operator reads WHERE the time went
+without arithmetic.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+BAR_WIDTH = 36
+
+
+def critical_path(spans: list[dict]) -> set[int]:
+    """Indices of the spans on the critical path: walk forward from the
+    trace start, at each point taking the overlapping span that extends
+    the frontier furthest (gaps jump to the next span by start time).
+    Instant markers (zero duration) never gate anything."""
+    # the "placement" span is the admission->patch ENVELOPE (the SLO
+    # measurement), not a stage — it would shadow every stage inside it
+    timed = [(i, s) for i, s in enumerate(spans)
+             if s["end"] > s["start"] and s["name"] != "placement"]
+    if not timed:
+        return set()
+    timed.sort(key=lambda t: (t[1]["start"], -t[1]["end"]))
+    path: set[int] = set()
+    frontier = min(s["start"] for _, s in timed)
+    j = 0
+    while j < len(timed):
+        # candidates overlapping the frontier
+        best = None
+        for i, s in timed[j:]:
+            if s["start"] > frontier + 1e-9:
+                break
+            if s["end"] > frontier + 1e-9 and (
+                    best is None or s["end"] > best[1]["end"]):
+                best = (i, s)
+        if best is None:
+            # gap: jump to the next span that starts past the frontier
+            nxt = next(((i, s) for i, s in timed
+                        if s["start"] > frontier + 1e-9), None)
+            if nxt is None:
+                break
+            best = nxt
+        path.add(best[0])
+        frontier = best[1]["end"]
+        while j < len(timed) and timed[j][1]["end"] <= frontier + 1e-9:
+            j += 1
+    return path
+
+
+def render_waterfall(trace: Optional[dict]) -> str:
+    if not trace:
+        return ("no trace retained for this binding (head sampling may "
+                "have dropped it — see docs/OBSERVABILITY.md sampling "
+                "knobs; slow bindings above the SLO threshold are always "
+                "retained)")
+    spans = trace.get("spans") or []
+    head = (f"TRACE {trace.get('key') or trace.get('trace_id')}  "
+            f"trace_id={trace.get('trace_id')}  epoch={trace.get('epoch')}  "
+            f"retained={trace.get('retained') or 'pending'}")
+    if trace.get("placement_s") is not None:
+        head += f"  placement={trace['placement_s'] * 1e3:.1f}ms"
+    if not spans:
+        return head + "\n  (no spans recorded)"
+    t0 = min(s["start"] for s in spans)
+    t1 = max(s["end"] for s in spans)
+    total = max(t1 - t0, 1e-9)
+    crit = critical_path(spans)
+    lines = [head, f"  window {total * 1e3:.1f}ms  "
+                   f"({len(spans)} spans; * = critical path)"]
+    for i, s in enumerate(spans):
+        off = s["start"] - t0
+        dur = max(0.0, s["end"] - s["start"])
+        pre = int(round(off / total * BAR_WIDTH))
+        width = max(1, int(round(dur / total * BAR_WIDTH))) if dur else 0
+        pre = min(pre, BAR_WIDTH - max(width, 1))
+        bar = "·" * pre + ("█" * width if width else "▏") \
+            + "·" * max(0, BAR_WIDTH - pre - max(width, 1))
+        mark = "*" if i in crit else " "
+        name = s["name"]
+        attrs = s.get("attrs") or {}
+        suffix = ""
+        if attrs.get("cluster"):
+            suffix = f"  [{attrs['cluster']}]"
+        elif attrs.get("launch"):
+            suffix = f"  [{attrs['launch']}]"
+        lines.append(
+            f" {mark} {name:<20} {off * 1e3:>9.1f}ms "
+            f"{dur * 1e3:>9.1f}ms  |{bar}|{suffix}"
+        )
+    crit_names = [spans[i]["name"] for i in sorted(
+        crit, key=lambda i: spans[i]["start"])]
+    if crit_names:
+        lines.append("  critical path: " + " -> ".join(crit_names))
+    return "\n".join(lines)

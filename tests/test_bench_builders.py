@@ -107,3 +107,58 @@ def test_dynamic_config_builds_with_daemon():
     assert (extra >= 0).all()  # every answer crossed the wire
     decisions = sched.schedule(bindings, extra_avail=extra)
     assert sum(d.ok for d in decisions) == 8
+
+
+class TestResultSchemas:
+    """Bench hygiene (docs/OBSERVABILITY.md): every config's JSON result
+    line is validated against a declared schema before it prints, so the
+    soak/capture tooling can parse all legs uniformly."""
+
+    def test_every_config_declares_a_schema(self):
+        missing = [c for c in bench.CONFIGS if c not in bench.RESULT_SCHEMAS]
+        assert not missing, f"configs without a result schema: {missing}"
+        # and no schema for a config that no longer exists
+        stale = [c for c in bench.RESULT_SCHEMAS if c not in bench.CONFIGS]
+        assert not stale, f"schemas for unknown configs: {stale}"
+
+    def test_schemas_use_known_type_specs(self):
+        for config, schema in bench.RESULT_SCHEMAS.items():
+            for key, spec in schema.items():
+                assert spec in bench._SCHEMA_TYPES, (
+                    f"{config}.{key}: unknown type spec {spec!r}")
+
+    def test_validate_accepts_a_conforming_round_line(self):
+        rec = {"metric": "schedule_round_p99_x", "value": 0.5, "unit": "s",
+               "backend": "cpu", "vs_baseline": 1.2, "iters": 5,
+               "scheduled_ok": 100}
+        assert bench.validate_result("dup3", rec) is rec
+
+    def test_validate_rejects_missing_and_mistyped_keys(self):
+        import pytest
+
+        base = {"metric": "m", "value": 0.5, "unit": "s", "backend": "cpu",
+                "vs_baseline": 1.0, "iters": 5, "scheduled_ok": 1}
+        with pytest.raises(bench.BenchSchemaError, match="vs_baseline"):
+            bench.validate_result(
+                "dup3", {k: v for k, v in base.items()
+                         if k != "vs_baseline"})
+        with pytest.raises(bench.BenchSchemaError, match="iters"):
+            bench.validate_result("dup3", {**base, "iters": "five"})
+        # bool must not satisfy an int/num field (bool subclasses int)
+        with pytest.raises(bench.BenchSchemaError, match="bool"):
+            bench.validate_result("dup3", {**base, "scheduled_ok": True})
+        with pytest.raises(bench.BenchSchemaError, match="declared"):
+            bench.validate_result("no-such-config", base)
+
+    def test_error_lines_only_need_the_envelope(self):
+        rec = {"metric": "stream_placement_latency_p99", "value": None,
+               "unit": "s", "backend": "cpu", "error": "boom"}
+        assert bench.validate_result("stream", rec) is rec
+
+    def test_value_may_be_null_but_not_string(self):
+        import pytest
+
+        rec = {"metric": "m", "value": "fast", "unit": "s",
+               "backend": "cpu", "error": "x"}
+        with pytest.raises(bench.BenchSchemaError, match="value"):
+            bench.validate_result("stream", rec)

@@ -97,3 +97,708 @@ class TestProfileServer:
         finally:
             stop.set()
             ps.stop()
+
+
+# ===========================================================================
+# Distributed placement tracing (tracing/spans.py, collect.py, render.py —
+# docs/OBSERVABILITY.md)
+# ===========================================================================
+
+import json
+import threading
+import time
+
+import pytest
+
+from karmada_tpu.tracing import (
+    PlacementTracer,
+    TraceCollector,
+    render_waterfall,
+    slo_report,
+    trace_context,
+    tracer,
+)
+
+
+@pytest.fixture()
+def fresh_tracer():
+    """Pin the process-global tracer to a known state and restore after."""
+    prev = (tracer.enabled, tracer.head_sample, tracer.slow_threshold_s)
+    tracer.reset()
+    tracer.enabled = True
+    tracer.head_sample = 1  # sample everything unless a test overrides
+    tracer.slow_threshold_s = 1.0
+    yield tracer
+    (tracer.enabled, tracer.head_sample, tracer.slow_threshold_s) = prev
+    tracer.reset()
+
+
+class TestPlacementTracer:
+    def test_head_sampling_is_deterministic_across_processes(self):
+        a = PlacementTracer(head_sample=64)
+        b = PlacementTracer(head_sample=64)
+        ids = [f"uid-{i}:1" for i in range(2000)]
+        assert [a.head_sampled(t) for t in ids] == \
+            [b.head_sampled(t) for t in ids]
+        hits = sum(a.head_sampled(t) for t in ids)
+        # ~1/64 of 2000 = ~31; the hash must neither sample everything
+        # nor nothing
+        assert 5 <= hits <= 120
+
+    def test_tail_sampling_retains_slo_breach_head_would_drop(self):
+        t = PlacementTracer(head_sample=0, slow_threshold_s=0.5)
+        t.enabled = True
+        t.admit("ns/slow", "u-slow", 1)
+        t.admit("ns/fast", "u-fast", 2)
+        assert not t.head_sampled("u-slow:1")  # head sampling OFF entirely
+        assert t.finish_placement("ns/fast", 0.01) is None  # dropped
+        tid = t.finish_placement("ns/slow", 2.0)  # breached: retained
+        assert tid == "u-slow:1"
+        trace = t.get(key="ns/slow")
+        assert trace["retained"] == "slo"
+        assert trace["placement_s"] == 2.0
+
+    def test_settle_drops_the_pending_stretch(self):
+        t = PlacementTracer(head_sample=1)
+        t.admit("ns/a", "u1", 1)
+        t.settle("ns/a")
+        assert t.finish_placement("ns/a", 0.1) is None
+        assert t.get(key="ns/a") is None
+
+    def test_pending_is_bounded(self):
+        t = PlacementTracer(head_sample=1, pending_cap=10)
+        for i in range(50):
+            t.admit(f"ns/b{i}", f"u{i}", i + 1)
+        assert len(t._pending) <= 10
+        assert t.evicted >= 40
+
+    def test_span_id_dedup_is_exactly_once(self):
+        t = PlacementTracer(head_sample=1)
+        t.admit("ns/a", "u1", 1)
+        for _ in range(3):
+            t.record("ns/a", "commit", 1.0, 2.0, span_id="w-1")
+        t.record("ns/a", "commit", 1.0, 2.0, span_id="w-2")
+        tid = t.finish_placement("ns/a", 0.1)
+        spans = [s for s in t.get(trace_id=tid)["spans"]
+                 if s["name"] == "commit"]
+        assert len(spans) == 2  # w-1 once + w-2 once
+
+    def test_post_placement_spans_target_the_retained_trace(self):
+        """placed=True must append to the RETAINED trace even when the
+        patch's own watch event opened a fresh pending stretch."""
+        t = PlacementTracer(head_sample=1)
+        t.admit("ns/a", "u1", 1)
+        tid = t.finish_placement("ns/a", 0.1)
+        t.admit("ns/a", "u1", 2)  # the patch event's new stretch
+        now = time.time()
+        t.record("ns/a", "member_apply", now, now + 0.01, placed=True,
+                 cluster="m1")
+        retained = t.get(trace_id=tid)
+        assert [s["name"] for s in retained["spans"]
+                if s["name"] == "member_apply"] == ["member_apply"]
+        # and the new pending stretch did NOT absorb it
+        assert all(s["name"] != "member_apply"
+                   for s in t.get(key=None, trace_id="u1:2")["spans"] or [])
+
+    def test_stale_post_placement_span_is_dropped(self):
+        """A placed=True span that ENDED before the retained trace began
+        (the apply-span annotation preserved on a rewritten Work from a
+        PREVIOUS placement) must not attach to the new trace."""
+        t = PlacementTracer(head_sample=1)
+        t.admit("ns/a", "u1", 1)
+        tid = t.finish_placement("ns/a", 0.1)
+        stale_end = time.time() - 60.0
+        t.record("ns/a", "member_apply", stale_end - 1.0, stale_end,
+                 placed=True, span_id="apply-old-g1", cluster="m1")
+        assert all(s["name"] != "member_apply"
+                   for s in t.get(trace_id=tid)["spans"])
+
+    def test_ring_is_bounded(self):
+        t = PlacementTracer(head_sample=1, capacity=5)
+        for i in range(20):
+            t.admit(f"ns/c{i}", f"uc{i}", i + 1)
+            t.finish_placement(f"ns/c{i}", 0.1)
+        assert len(t.retained()) == 5
+
+    def test_gang_hold_mark_becomes_a_span(self):
+        t = PlacementTracer(head_sample=1)
+        t.admit("ns/g", "ug", 1)
+        t.mark("ns/g", "gang_hold")
+        t.unmark("ns/g", "gang_hold", gang="g1")
+        tid = t.finish_placement("ns/g", 0.1)
+        names = [s["name"] for s in t.get(trace_id=tid)["spans"]]
+        assert "gang_hold" in names
+
+    def test_disabled_tracer_is_inert(self):
+        t = PlacementTracer(head_sample=1)
+        t.enabled = False
+        t.admit("ns/a", "u1", 1)
+        t.record("ns/a", "solve", 1.0, 2.0)
+        assert t.finish_placement("ns/a", 5.0) is None
+        assert t.traces() == []
+
+
+class TestSloReport:
+    def test_per_stage_attribution_table(self):
+        t = PlacementTracer(head_sample=1)
+        for i in range(4):
+            key, uid = f"ns/r{i}", f"ur{i}"
+            t.admit(key, uid, i + 1)
+            t.record(key, "solve", 0.0, 0.010 * (i + 1))
+            t.record(key, "commit", 0.0, 0.002)
+            t.finish_placement(key, 0.02 * (i + 1))
+        rep = slo_report(t)
+        assert rep["n_traces"] == 4
+        assert rep["stages"]["solve"]["n"] == 4
+        assert rep["stages"]["commit"]["p50_ms"] == pytest.approx(2.0)
+        assert rep["placement"]["p99_ms"] == pytest.approx(80.0)
+        assert rep["stages"]["solve"]["p99_ms"] >= \
+            rep["stages"]["solve"]["p50_ms"]
+
+
+class TestWaterfallRender:
+    def test_render_marks_critical_path_and_stages(self):
+        t = PlacementTracer(head_sample=1)
+        t.admit("ns/w", "uw", 1)
+        t.record("ns/w", "queue_wait", 100.0, 100.010)
+        t.record("ns/w", "solve", 100.010, 100.050)
+        t.record("ns/w", "commit", 100.050, 100.055)
+        tid = t.finish_placement("ns/w", 0.055)
+        out = render_waterfall(t.get(trace_id=tid))
+        assert "TRACE ns/w" in out and tid in out
+        for stage in ("queue_wait", "solve", "commit"):
+            assert stage in out
+        assert "critical path:" in out
+        # solve dominates the window: it must be on the critical path
+        assert "* solve" in out
+
+    def test_render_no_trace_explains_sampling(self):
+        out = render_waterfall(None)
+        assert "head sampling" in out
+
+
+# ===========================================================================
+# End-to-end: the live streaming topology (acceptance criterion — detector
+# -> queue -> solve -> commit -> apply -> status in ONE waterfall, with the
+# agent-apply span stitched in over the coalesced status path)
+# ===========================================================================
+
+
+def _live_topology():
+    """Plane (detector, binding, agent, status controllers) + an external
+    streaming SchedulerDaemon on its own runtime — the daemon deployment
+    shape, built without the optional cryptography/ControlPlane stack."""
+    from karmada_tpu.agent.agent import KarmadaAgent
+    from karmada_tpu.api.meta import CPU, MEMORY
+    from karmada_tpu.controllers.binding import BindingController
+    from karmada_tpu.controllers.status import (
+        BindingStatusController,
+        WorkStatusController,
+    )
+    from karmada_tpu.detector.detector import ResourceDetector
+    from karmada_tpu.interpreter.interpreter import ResourceInterpreter
+    from karmada_tpu.members.member import (
+        InMemoryMember,
+        MemberConfig,
+        cluster_object_for,
+    )
+    from karmada_tpu.runtime.controller import Runtime
+    from karmada_tpu.sched.scheduler import SchedulerDaemon
+    from karmada_tpu.store.store import Store
+
+    GiB = 1024.0**3
+    store = Store()
+    collector = TraceCollector(store)
+    collector.attach()
+    rt = Runtime()
+    interp = ResourceInterpreter()
+    interp.load_thirdparty()
+    member = InMemoryMember(MemberConfig(
+        name="m1", sync_mode="Pull",
+        allocatable={CPU: 8.0, MEMORY: 32 * GiB, "pods": 100.0},
+    ))
+    store.create(cluster_object_for(member.config))
+    ResourceDetector(store, interp, rt)
+    BindingController(store, interp, rt)
+    agent = KarmadaAgent(store, member, interp, rt)
+    ws = WorkStatusController(store, {"m1": member}, interp, rt)
+    ws.watch_member(member)
+    BindingStatusController(store, interp, rt)
+    daemon = SchedulerDaemon(store, Runtime())
+    svc = daemon.streaming(batch_delay=0.0)
+    return store, rt, svc, agent, collector
+
+
+def _divided_policy_and_template():
+    from karmada_tpu.api.meta import ObjectMeta
+    from karmada_tpu.api.policy import (
+        DIVISION_PREFERENCE_AGGREGATED,
+        REPLICA_SCHEDULING_DIVIDED,
+        ClusterAffinity,
+        Placement,
+        PropagationPolicy,
+        PropagationSpec,
+        ReplicaSchedulingStrategy,
+        ResourceSelector,
+    )
+    from karmada_tpu.api.unstructured import Unstructured
+
+    pol = PropagationPolicy(
+        metadata=ObjectMeta(name="p1", namespace="default"),
+        spec=PropagationSpec(
+            resource_selectors=[ResourceSelector(
+                api_version="apps/v1", kind="Deployment")],
+            placement=Placement(
+                cluster_affinity=ClusterAffinity(cluster_names=["m1"]),
+                replica_scheduling=ReplicaSchedulingStrategy(
+                    replica_scheduling_type=REPLICA_SCHEDULING_DIVIDED,
+                    replica_division_preference=(
+                        DIVISION_PREFERENCE_AGGREGATED),
+                ),
+            ),
+        ),
+    )
+    dep = Unstructured({
+        "apiVersion": "apps/v1", "kind": "Deployment",
+        "metadata": {"name": "nginx", "namespace": "default"},
+        "spec": {"replicas": 2, "template": {"spec": {"containers": [
+            {"name": "c",
+             "resources": {"requests": {"cpu": "100m"}}}]}}},
+    })
+    return pol, dep
+
+
+class TestTraceWaterfall:
+    def test_full_pipeline_waterfall_on_live_streaming_topology(
+            self, fresh_tracer):
+        store, rt, svc, _agent, collector = _live_topology()
+        try:
+            pol, dep = _divided_policy_and_template()
+            store.create(pol)
+            store.create(dep)
+            rt.settle()                 # detector: template -> binding
+            svc.serve(quiescent=True)   # streaming placement
+            rt.settle()                 # works + agent apply + status
+            svc.serve(quiescent=True)   # absorb the status-driven events
+            rt.settle()
+            rb = store.list("ResourceBinding")[0]
+            assert rb.spec.clusters and rb.spec.clusters[0].name == "m1"
+            trace = tracer.get(key=rb.metadata.key())
+            assert trace is not None, "the placement trace must be retained"
+            names = [s["name"] for s in trace["spans"]]
+            # the complete causal chain, template write to status
+            # aggregation, in ONE trace keyed (uid, admission epoch)
+            for stage in ("template_write", "detector_match",
+                          "binding_create", "queue_wait", "solve",
+                          "commit", "placement", "work_fanout",
+                          "member_apply", "status_aggregation"):
+                assert stage in names, f"missing span {stage}: {names}"
+            assert trace["trace_id"].startswith(rb.metadata.uid + ":")
+            assert trace["epoch"] >= 1
+            # the agent-apply span crossed the process seam on the Work
+            # status write and stitched by trace id + cluster attr
+            apply_span = next(s for s in trace["spans"]
+                              if s["name"] == "member_apply")
+            assert apply_span["attrs"]["cluster"] == "m1"
+            assert apply_span["span_id"].startswith("apply-")
+            # spans order causally on the shared wall clock
+            solve = next(s for s in trace["spans"] if s["name"] == "solve")
+            commit = next(s for s in trace["spans"] if s["name"] == "commit")
+            assert solve["start"] <= commit["end"]
+            assert commit["end"] <= apply_span["end"]
+        finally:
+            collector.detach()
+
+    def test_karmadactl_trace_renders_the_waterfall(self, fresh_tracer):
+        import types
+
+        from karmada_tpu.cli.karmadactl import run as ctl_run
+
+        store, rt, svc, _agent, collector = _live_topology()
+        try:
+            pol, dep = _divided_policy_and_template()
+            store.create(pol)
+            store.create(dep)
+            rt.settle()
+            svc.serve(quiescent=True)
+            rt.settle()
+            svc.serve(quiescent=True)
+            rt.settle()
+            rb = store.list("ResourceBinding")[0]
+            cp = types.SimpleNamespace(
+                trace_of=lambda ns, n: tracer.get(
+                    key=f"{ns}/{n}" if ns else n))
+            out = ctl_run(cp, ["trace", "binding",
+                               f"default/{rb.metadata.name}"])
+            assert f"TRACE default/{rb.metadata.name}" in out
+            for stage in ("detector_match", "queue_wait", "solve",
+                          "commit", "member_apply", "status_aggregation"):
+                assert stage in out, out
+            assert "critical path:" in out
+            # -o json round-trips the raw trace
+            raw = ctl_run(cp, ["trace", "binding",
+                               f"default/{rb.metadata.name}", "-o", "json"])
+            assert json.loads(raw)["key"] == rb.metadata.key()
+        finally:
+            collector.detach()
+
+    def test_rescheduled_binding_gets_a_fresh_epoch_trace(self,
+                                                         fresh_tracer):
+        store, rt, svc, _agent, collector = _live_topology()
+        try:
+            pol, dep = _divided_policy_and_template()
+            store.create(pol)
+            store.create(dep)
+            rt.settle()
+            svc.serve(quiescent=True)
+            rb = store.list("ResourceBinding")[0]
+            first = tracer.get(key=rb.metadata.key())
+            assert first is not None
+            # dirty the binding: replica change re-admits (a new pending
+            # stretch = a new trace at a higher admission epoch)
+            rb = store.get("ResourceBinding", rb.metadata.name, "default")
+            rb.spec.replicas = 3
+            store.update(rb)
+            svc.serve(quiescent=True)
+            second = tracer.get(key=rb.metadata.key())
+            assert second["epoch"] > first["epoch"]
+            assert second["trace_id"] != first["trace_id"]
+            # both remain individually addressable in the ring
+            assert tracer.get(trace_id=first["trace_id"]) is not None
+        finally:
+            collector.detach()
+
+
+# ===========================================================================
+# Cross-process context propagation: X-Karmada-Trace over RemoteStore,
+# replay-idempotent retries and leader redirects dedup to ONE commit span
+# ===========================================================================
+
+
+class _StubCP:
+    """Minimal cp surface for ControlPlaneServer (no PKI/cryptography)."""
+
+    def __init__(self):
+        from karmada_tpu.store.store import Store
+
+        self.store = Store()
+        self.members = {}
+
+    def settle(self, max_steps: int = 0) -> int:
+        return 0
+
+    def tick(self, seconds: float = 0.0) -> int:
+        return 0
+
+
+def _cm(name: str, ns: str = "default"):
+    from karmada_tpu.api.unstructured import Unstructured
+
+    return Unstructured({
+        "apiVersion": "v1", "kind": "ConfigMap",
+        "metadata": {"name": name, "namespace": ns},
+        "data": {"v": "1"},
+    })
+
+
+def _commit_spans(trace_id: str) -> list:
+    t = tracer.get(trace_id=trace_id)
+    if t is None:
+        return []
+    return [s for s in t["spans"] if s["name"] == "commit"]
+
+
+class TestTraceContextPropagation:
+    def test_write_inside_context_records_one_commit_span(self,
+                                                          fresh_tracer):
+        from karmada_tpu.server.apiserver import ControlPlaneServer
+        from karmada_tpu.server.remote import RemoteStore
+
+        cp = _StubCP()
+        srv = ControlPlaneServer(cp)
+        srv.start()
+        try:
+            rs = RemoteStore(srv.url)
+            with trace_context("u-ctx:1"):
+                rs.create(_cm("a"))
+            spans = _commit_spans("u-ctx:1")
+            assert len(spans) == 1
+            assert spans[0]["attrs"]["route"] == "/objects"
+            # a write OUTSIDE any context carries no header: no new spans
+            rs.create(_cm("b"))
+            assert len(_commit_spans("u-ctx:1")) == 1
+        finally:
+            srv.stop()
+
+    def test_replayed_batch_chunk_yields_exactly_one_commit_span(
+            self, fresh_tracer):
+        """A create chunk whose response is lost is REPLAYED by
+        RemoteStore (replay-idempotent retry); the server saw the request
+        twice but both carried the same logical span id — exactly one
+        commit span survives."""
+        from karmada_tpu.server.apiserver import ControlPlaneServer
+        from karmada_tpu.server.remote import RemoteError, RemoteStore
+
+        cp = _StubCP()
+        srv = ControlPlaneServer(cp)
+        srv.start()
+        try:
+            rs = RemoteStore(srv.url)
+            real = rs._call_batch
+            state = {"lost": False}
+
+            def lossy(body, trace_header=None):
+                resp = real(body, trace_header=trace_header)
+                if not state["lost"]:
+                    # the server processed the request; the response is
+                    # "lost" on the way back — the retry replays the chunk
+                    state["lost"] = True
+                    raise RemoteError("injected: response lost")
+                return resp
+
+            rs._call_batch = lossy
+            with trace_context("u-replay:1"):
+                out = rs.create_batch([_cm("r1"), _cm("r2")])
+            assert len(out) == 2 and all(o is not None for o in out)
+            # both attempts reached the store; the replay resolved the
+            # conflicts as satisfied-by-replay — and the trace holds ONE
+            # commit span for the chunk, not two
+            assert len(_commit_spans("u-replay:1")) == 1
+        finally:
+            srv.stop()
+
+    def test_leader_redirect_yields_exactly_one_commit_span(self,
+                                                            fresh_tracer):
+        """A write dialing a follower is 409-redirected to the leader and
+        re-sent with the SAME span id: one commit span total (recorded by
+        the leader; the follower rejects before dispatch)."""
+        from karmada_tpu.server.apiserver import ControlPlaneServer
+        from karmada_tpu.server.remote import RemoteStore
+
+        leader_cp, follower_cp = _StubCP(), _StubCP()
+        leader = ControlPlaneServer(leader_cp)
+        leader.start()
+        follower = ControlPlaneServer(follower_cp, follower=True)
+        follower.start()
+        try:
+            fol = follower._ensure_follower()
+            fol.max_token = 1  # active follower that has heard a leader
+            fol.leader_url = leader.url
+            rs = RemoteStore(follower.url)
+            with trace_context("u-redir:1"):
+                rs.create(_cm("x"))
+            assert rs.base_url == leader.url  # re-pointed
+            assert leader_cp.store.try_get(
+                "v1/ConfigMap", "x", "default") is not None
+            assert len(_commit_spans("u-redir:1")) == 1
+        finally:
+            follower.stop()
+            leader.stop()
+
+    def test_head_dropped_context_records_nothing(self, fresh_tracer):
+        from karmada_tpu.server.apiserver import ControlPlaneServer
+        from karmada_tpu.server.remote import RemoteStore
+
+        cp = _StubCP()
+        srv = ControlPlaneServer(cp)
+        srv.start()
+        try:
+            rs = RemoteStore(srv.url)
+            with trace_context("u-drop:1", sampled=False):
+                rs.create(_cm("d"))
+            assert tracer.get(trace_id="u-drop:1") is None
+        finally:
+            srv.stop()
+
+
+class TestTracesRoute:
+    def test_get_traces_serves_ring_trace_and_report(self, fresh_tracer):
+        from karmada_tpu.server.apiserver import ControlPlaneServer
+        from karmada_tpu.server.remote import RemoteControlPlane
+
+        tracer.admit("ns/a", "u-served", 1)
+        tracer.record("ns/a", "solve", 1.0, 1.5)
+        tid = tracer.finish_placement("ns/a", 0.5)
+        cp = _StubCP()
+        srv = ControlPlaneServer(cp)
+        srv.start()
+        try:
+            rcp = RemoteControlPlane(srv.url)
+            summaries = rcp.traces()
+            assert any(s["trace_id"] == tid for s in summaries)
+            trace = rcp.trace_of("ns", "a")
+            assert trace["trace_id"] == tid
+            assert any(s["name"] == "solve" for s in trace["spans"])
+            # unknown binding -> None, not an exception
+            assert rcp.trace_of("ns", "nope") is None
+            # the report endpoint rolls up the attribution table
+            rep = rcp.store._call("GET", "/traces?report=1")["report"]
+            assert rep["n_traces"] == 1 and "solve" in rep["stages"]
+        finally:
+            srv.stop()
+
+
+# ===========================================================================
+# ProfileServer hardening: single-flight captures + scrape-token auth
+# ===========================================================================
+
+
+class TestProfileServerHardening:
+    def test_concurrent_profile_capture_answers_429(self):
+        import urllib.error
+
+        ps = ProfileServer(enable_pprof=True)
+        try:
+            url = (f"http://127.0.0.1:{ps.port}"
+                   f"/debug/pprof/profile?seconds=1.5")
+            results = {}
+
+            def first():
+                results["first"] = urllib.request.urlopen(
+                    url, timeout=30).status
+
+            t = threading.Thread(target=first, daemon=True)
+            t.start()
+            time.sleep(0.3)  # the first capture is in flight
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                urllib.request.urlopen(url, timeout=30)
+            assert ei.value.code == 429
+            t.join(timeout=30)
+            assert results.get("first") == 200
+            # the slot released: a fresh capture succeeds
+            ok = urllib.request.urlopen(
+                f"http://127.0.0.1:{ps.port}"
+                f"/debug/pprof/profile?seconds=0.1", timeout=30)
+            assert ok.status == 200
+        finally:
+            ps.stop()
+
+    def test_scrape_token_protects_every_route(self):
+        import urllib.error
+        import urllib.request as rq
+
+        ps = ProfileServer(enable_pprof=True, scrape_token="s3cret")
+        try:
+            base = f"http://127.0.0.1:{ps.port}/debug/pprof/"
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                rq.urlopen(base, timeout=10)
+            assert ei.value.code == 401
+            req = rq.Request(base,
+                             headers={"Authorization": "Bearer s3cret"})
+            assert rq.urlopen(req, timeout=10).status == 200
+            # the wire token is accepted too (same policy as /metrics)
+            ps2 = ProfileServer(enable_pprof=True, token="wire",
+                                scrape_token="scrape")
+            try:
+                for cred in ("wire", "scrape"):
+                    req = rq.Request(
+                        f"http://127.0.0.1:{ps2.port}/debug/pprof/",
+                        headers={"Authorization": f"Bearer {cred}"})
+                    assert rq.urlopen(req, timeout=10).status == 200
+            finally:
+                ps2.stop()
+        finally:
+            ps.stop()
+
+
+# ===========================================================================
+# Exemplars: the SLO histogram links its worst bucket entries to traces
+# ===========================================================================
+
+
+class TestHistogramExemplars:
+    def test_worst_observation_per_bucket_renders_openmetrics_exemplar(self):
+        from karmada_tpu.metrics import MetricsRegistry
+
+        reg = MetricsRegistry()
+        h = reg.histogram("karmada_test_exemplars", "t",
+                          buckets=(0.1, 1.0))
+        h.observe(0.05, exemplar="u-fast:1")
+        h.observe(0.07, exemplar="u-faster:1")  # not the bucket's worst
+        h.observe(0.06)
+        h.observe(5.0, exemplar="u-overflow:1")  # beyond the last bucket
+        out = reg.render()
+        # worst per bucket wins; the +Inf overflow carries its own
+        assert 'trace_id="u-fast:1"' not in out or True
+        assert out.count("trace_id=") == 2
+        assert 'trace_id="u-overflow:1"' in out
+        line = next(l for l in out.splitlines()
+                    if 'le="0.1"' in l and "trace_id" in l)
+        assert 'trace_id="u-faster:1"' in line and line.endswith("0.07")
+        # exemplars=False (the classic 0.0.4 exposition a non-negotiating
+        # scraper gets) omits them entirely — a 0.0.4 parser would fail
+        # the whole scrape on the mid-line '#'
+        assert "trace_id" not in reg.render(exemplars=False)
+
+    def test_metrics_route_negotiates_openmetrics(self, fresh_tracer):
+        import urllib.request as rq
+
+        from karmada_tpu.metrics import placement_latency
+        from karmada_tpu.server.apiserver import ControlPlaneServer
+
+        tracer.admit("ns/ex", "u-ex", 1)
+        tid = tracer.finish_placement("ns/ex", 0.123)
+        placement_latency.observe(0.123, exemplar=tid)
+        cp = _StubCP()
+        srv = ControlPlaneServer(cp)
+        srv.start()
+        try:
+            plain = rq.urlopen(srv.url + "/metrics", timeout=10)
+            assert "0.0.4" in plain.headers["Content-Type"]
+            assert "trace_id" not in plain.read().decode()
+            req = rq.Request(srv.url + "/metrics", headers={
+                "Accept": "application/openmetrics-text"})
+            om = rq.urlopen(req, timeout=10)
+            assert "openmetrics-text" in om.headers["Content-Type"]
+            assert f'trace_id="{tid}"' in om.read().decode()
+        finally:
+            srv.stop()
+
+
+# ===========================================================================
+# Metrics catalog static check: every registered metric is unique, follows
+# the karmada_* convention, and is documented in docs/OBSERVABILITY.md
+# ===========================================================================
+
+
+class TestMetricsCatalog:
+    @staticmethod
+    def _registered_names():
+        import ast
+        import pathlib
+
+        src = (pathlib.Path(__file__).resolve().parents[1]
+               / "karmada_tpu" / "metrics.py").read_text()
+        names = []
+        for node in ast.walk(ast.parse(src)):
+            if (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                    and isinstance(node.func.value, ast.Name)
+                    and node.func.value.id == "registry"
+                    and node.func.attr in ("counter", "gauge", "histogram")
+                    and node.args
+                    and isinstance(node.args[0], ast.Constant)):
+                names.append(node.args[0].value)
+        return names
+
+    def test_names_unique_and_conventional(self):
+        import re
+
+        names = self._registered_names()
+        assert len(names) >= 40  # the catalog exists and parsing worked
+        dupes = {n for n in names if names.count(n) > 1}
+        assert not dupes, f"duplicate metric names: {dupes}"
+        bad = [n for n in names
+               if not re.fullmatch(r"karmada_[a-z0-9_]+", n)]
+        assert not bad, f"metric names off the karmada_* convention: {bad}"
+
+    def test_every_metric_documented_in_observability_md(self):
+        import pathlib
+
+        doc = (pathlib.Path(__file__).resolve().parents[1]
+               / "docs" / "OBSERVABILITY.md").read_text()
+        missing = [n for n in self._registered_names()
+                   if f"`{n}`" not in doc]
+        assert not missing, (
+            "metrics registered in metrics.py but absent from the "
+            f"docs/OBSERVABILITY.md catalog: {missing}")
