@@ -3344,6 +3344,49 @@ def _preempt_committed() -> float:
     return preemptions_total.value(outcome="committed")
 
 
+def run_analysis(backend_label: str, verbose=False) -> dict:
+    """The `analysis` config: the invariant analysis plane's cost and
+    coverage (docs/ANALYSIS.md) — ONE full sweep of the four AST
+    analyzers over karmada_tpu/ plus the baseline ratchet diff, emitted
+    as a schema-validated JSON line so the capture trajectory records
+    what the static gate covers and what it costs. Host-side and
+    stdlib-only: the number is meaningful on any backend."""
+    import collections
+    import time as _time
+
+    from karmada_tpu.analysis import (
+        baseline_path, load_baseline, ratchet, repo_root, run_repo,
+    )
+
+    root = repo_root()
+    t0 = _time.perf_counter()
+    index, findings = run_repo(root)
+    wall = _time.perf_counter() - t0
+    baseline = load_baseline(baseline_path(root))
+    result = ratchet(findings, baseline)
+    rules = dict(sorted(collections.Counter(
+        f.rule for f in findings).items()))
+    if verbose:
+        print(f"# analysis: {len(index.modules)} files, rules={rules}, "
+              f"new={len(result.new)} stale={len(result.stale)} "
+              f"in {wall:.2f}s")
+    clean = result.ok
+    return {
+        "metric": "analysis_scan_wall",
+        "value": round(wall, 4),
+        "unit": "s",
+        "backend": backend_label,
+        "rules": rules,
+        "files_scanned": len(index.modules),
+        "findings_total": len(findings),
+        "baseline_entries": len(baseline),
+        "new_findings": len(result.new),
+        "stale_baseline": len(result.stale),
+        "pass_clean": bool(clean),
+        "pass": bool(clean),
+    }
+
+
 def build_flagship_cold(seed=0, n_clusters=5000, n_bindings=10000):
     """North-star variant, adversarial to the per-placement encode cache:
     every measured iteration bumps each binding's generation first
@@ -3382,6 +3425,7 @@ CONFIGS = {
     "replica": (None, None),  # replicated store group; see run_replica
     "elastic": (None, None),  # closed-loop autoscaling replay; run_elastic
     "preempt": (None, None),  # workload-class scheduling; run_preempt
+    "analysis": (None, None),  # invariant analysis sweep; run_analysis
     "flagship_cold": (build_flagship_cold, None),  # named after the shape
     "flagship": (build_flagship, None),  # metric name carries the shape
 }
@@ -3389,7 +3433,7 @@ DEFAULT_ORDER = [
     "dup3", "static", "dynamic", "spread", "spread_skewed", "churn",
     "churn_incremental", "autoshard", "pipeline", "whatif", "degraded",
     "coldstart", "stream", "fanout", "writeload", "replica", "elastic",
-    "preempt", "flagship_cold", "flagship",
+    "preempt", "analysis", "flagship_cold", "flagship",
 ]
 
 
@@ -3448,6 +3492,10 @@ RESULT_SCHEMAS = {
                 "pass": "bool"},
     "preempt": {**_ENVELOPE, "pass_slo": "bool", "pass_preempted": "bool",
                 "pass_gang_o1": "bool", "pass": "bool"},
+    "analysis": {**_ENVELOPE, "rules": "dict", "files_scanned": "int",
+                 "findings_total": "int", "baseline_entries": "int",
+                 "new_findings": "int", "stale_baseline": "int",
+                 "pass_clean": "bool", "pass": "bool"},
     "flagship_cold": _ROUND,
     "flagship": _ROUND,
 }
@@ -3856,6 +3904,18 @@ def run_bench(args) -> None:
                     f"{latest_capture_name()}"
                 )
             lines.append(_validated_line("preempt", rec))
+            continue
+        if name == "analysis":
+            try:
+                rec = run_analysis(backend, verbose=args.verbose)
+            except Exception as e:  # noqa: BLE001 - one labeled error line
+                rec = {
+                    "metric": "analysis_scan_wall",
+                    "value": None, "unit": "s", "backend": backend,
+                    "error": f"{type(e).__name__}: {e}"[:300],
+                }
+            # host-side stdlib sweep: meaningful on any backend
+            lines.append(_validated_line("analysis", rec))
             continue
         if name == "stream":
             import types

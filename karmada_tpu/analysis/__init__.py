@@ -1,0 +1,39 @@
+"""Invariant analysis plane: AST lint suite + runtime lock-order watchdog.
+
+Static half (stdlib-only, runs in tier-1):
+  * `framework`       — per-file parse-once `ModuleIndex`, typed `Finding`s,
+                        baseline + ratchet
+  * `lock_discipline` — the store's critical section stays
+                        validate+stamp+place+sink
+  * `jit_purity`      — no host syncs / RNG / content-derived shapes
+                        reachable from the jit entry points
+  * `thread_hygiene`  — daemon-or-joined threads, bounded queues/rings
+  * `constant_drift`  — wire-visible constants have one defining module
+                        (folds PR-14's metrics-catalog check in)
+
+Dynamic half:
+  * `lockorder`       — opt-in instrumented locks (KARMADA_TPU_LOCKCHECK=1)
+                        recording the acquisition-order graph, failing on
+                        cycles
+
+Run standalone via `scripts/lint.sh` (python -m karmada_tpu.analysis);
+docs/ANALYSIS.md has the rule catalog and the baseline workflow.
+
+This __init__ stays import-light on purpose: the store constructs its
+locks through `analysis.lockorder.make_lock`, so importing the package
+must cost nothing beyond the stdlib.
+"""
+from .framework import (  # noqa: F401
+    BaselineEntry,
+    Finding,
+    ModuleIndex,
+    RatchetResult,
+    baseline_path,
+    default_analyzers,
+    load_baseline,
+    ratchet,
+    repo_root,
+    run_analyzers,
+    run_repo,
+    save_baseline,
+)

@@ -1,0 +1,71 @@
+"""Standalone analyzer runner: `python -m karmada_tpu.analysis` (wrapped
+by scripts/lint.sh).
+
+Exit status is the ratchet: 0 when the findings match the baseline
+exactly, 1 on any NEW finding or any STALE baseline entry (a fixed
+violation must shrink the baseline — run with --update-baseline after
+reviewing). `--update-baseline` preserves the `reason` of entries that
+survive and stamps new ones UNREVIEWED so they cannot slip in silently.
+"""
+from __future__ import annotations
+
+import argparse
+import sys
+import time
+from collections import Counter
+
+from .framework import (
+    baseline_path,
+    load_baseline,
+    ratchet,
+    repo_root,
+    run_repo,
+    save_baseline,
+)
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="karmada_tpu.analysis",
+        description="invariant analysis suite (docs/ANALYSIS.md)")
+    ap.add_argument("--root", default=None,
+                    help="repo root (default: resolved from the package)")
+    ap.add_argument("--update-baseline", action="store_true",
+                    help="rewrite baseline.json from the current findings, "
+                         "preserving existing reasons")
+    ap.add_argument("--list", action="store_true",
+                    help="print every finding (matched ones too), not just "
+                         "the ratchet diff")
+    args = ap.parse_args(argv)
+
+    root = args.root or repo_root()
+    t0 = time.perf_counter()
+    index, findings = run_repo(root)
+    wall = time.perf_counter() - t0
+
+    bpath = baseline_path(root)
+    baseline = load_baseline(bpath)
+    result = ratchet(findings, baseline)
+
+    counts = Counter(f.rule for f in findings)
+    by_rule = ", ".join(f"{r}={n}" for r, n in sorted(counts.items()))
+    print(f"analysis: {len(index.modules)} files, "
+          f"{len(findings)} finding(s) ({by_rule or 'none'}) "
+          f"in {wall:.2f}s")
+
+    if args.list:
+        for f in findings:
+            print(f"  {f.render()}")
+
+    if args.update_baseline:
+        save_baseline(bpath, findings, old=baseline)
+        print(f"baseline rewritten: {bpath} "
+              f"({len({f.key for f in findings})} entr(ies))")
+        return 0
+
+    print(result.render())
+    return 0 if result.ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,132 @@
+"""Constant-drift analyzer: wire-visible strings have exactly ONE home.
+
+A label key, annotation key, route path, or metric name that is defined
+as a module-level string literal in two modules WILL drift — PR-14 hit
+exactly this with the Work-binding labels (the collector's copy of the
+literal diverging from the controller's is a silent cross-process
+protocol break) and moved them to one defining module with re-exports.
+This rule generalizes that: every wire-visible literal gets one defining
+module; everyone else imports it.
+
+"Wire-visible" means the literal looks like one of:
+  * a karmada.io label/annotation key    (contains "karmada.io/")
+  * an HTTP route path                   (^/[a-z][a-z0-9/_-]*$)
+  * a metric name                        (^karmada_[a-z0-9_]+$)
+  * a wire header                        (^X-[A-Za-z-]+$)
+
+The metrics-catalog check (PR-14's `TestMetricsCatalog`) folds onto the
+same module index here: every `registry.counter/gauge/histogram` name in
+metrics.py must be unique, match `karmada_[a-z0-9_]+`, and appear in the
+docs/OBSERVABILITY.md catalog — `tests/test_tracing.py` now delegates to
+`registered_metric_names()` / `metrics_catalog_findings()` instead of
+running its own ad-hoc `ast.parse` pass.
+"""
+from __future__ import annotations
+
+import ast
+import re
+from pathlib import Path
+
+from .framework import Finding, ModuleIndex
+
+RULE = "constant-drift"
+
+_ROUTE = re.compile(r"^/[a-z][a-z0-9/_-]*$")
+_METRIC = re.compile(r"^karmada_[a-z0-9_]+$")
+_HEADER = re.compile(r"^X-[A-Za-z][A-Za-z-]+$")
+
+
+def is_wire_visible(value: str) -> bool:
+    return ("karmada.io/" in value
+            or bool(_ROUTE.match(value))
+            or bool(_METRIC.match(value))
+            or bool(_HEADER.match(value)))
+
+
+def _module_constants(mod) -> list[tuple[str, str, int]]:
+    """Module-level NAME = "literal" assignments: (name, value, line)."""
+    out = []
+    for node in mod.tree.body:
+        if (isinstance(node, ast.Assign) and len(node.targets) == 1
+                and isinstance(node.targets[0], ast.Name)
+                and isinstance(node.value, ast.Constant)
+                and isinstance(node.value.value, str)):
+            name = node.targets[0].id
+            if name.isupper():
+                out.append((name, node.value.value, node.lineno))
+    return out
+
+
+# -- the metrics-catalog fold (PR-14's TestMetricsCatalog, on the shared
+#    framework) -------------------------------------------------------------
+
+_METRIC_CTORS = ("counter", "gauge", "histogram")
+
+
+def registered_metric_names(index: ModuleIndex) -> list[tuple[str, int]]:
+    """Every metric name registered in karmada_tpu/metrics.py, with its
+    line: first-arg literals of registry.counter/gauge/histogram calls."""
+    mod = index.modules.get("karmada_tpu/metrics.py")
+    if mod is None:
+        return []
+    names = []
+    for node in ast.walk(mod.tree):
+        if (isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and isinstance(node.func.value, ast.Name)
+                and node.func.value.id == "registry"
+                and node.func.attr in _METRIC_CTORS
+                and node.args
+                and isinstance(node.args[0], ast.Constant)):
+            names.append((node.args[0].value, node.lineno))
+    return names
+
+
+def metrics_catalog_findings(index: ModuleIndex) -> list[Finding]:
+    findings: list[Finding] = []
+    rel = "karmada_tpu/metrics.py"
+    names = registered_metric_names(index)
+    seen: dict[str, int] = {}
+    for name, line in names:
+        if name in seen:
+            findings.append(Finding(
+                RULE, rel, line,
+                f"metric {name!r} registered twice"))
+        seen.setdefault(name, line)
+        if not _METRIC.fullmatch(name):
+            findings.append(Finding(
+                RULE, rel, line,
+                f"metric {name!r} off the karmada_[a-z0-9_]+ convention"))
+    doc = index.root / "docs" / "OBSERVABILITY.md"
+    if doc.exists():
+        doc_text = doc.read_text()
+        for name, line in names:
+            if f"`{name}`" not in doc_text:
+                findings.append(Finding(
+                    RULE, rel, line,
+                    f"metric {name!r} not documented in the "
+                    f"docs/OBSERVABILITY.md catalog (new metrics cannot "
+                    f"ship undocumented)"))
+    return findings
+
+
+def analyze(index: ModuleIndex) -> list[Finding]:
+    # literal -> [(relpath, const name, line)]
+    homes: dict[str, list[tuple[str, str, int]]] = {}
+    for mod in index.modules.values():
+        for name, value, line in _module_constants(mod):
+            if is_wire_visible(value):
+                homes.setdefault(value, []).append(
+                    (mod.relpath, name, line))
+    findings: list[Finding] = []
+    for value, sites in sorted(homes.items()):
+        mods = sorted({rel for rel, _, _ in sites})
+        if len(mods) > 1:
+            first = min(sites, key=lambda s: (s[0], s[2]))
+            findings.append(Finding(
+                RULE, first[0], first[2],
+                f"wire constant {value!r} defined in {len(mods)} modules "
+                f"({', '.join(mods)}) — one defining module, re-export "
+                f"everywhere else"))
+    findings.extend(metrics_catalog_findings(index))
+    return findings

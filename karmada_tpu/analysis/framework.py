@@ -1,0 +1,382 @@
+"""Shared AST-walking framework for the invariant analysis plane.
+
+The codebase's hard-won invariants — the store's validate+stamp+place+sink
+critical section, jit shape purity, daemon-thread hygiene, single-definition
+wire constants — used to live only in reviewers' heads and scattered
+regression tests. This module gives every such rule one substrate: each
+source file is parsed ONCE into a `ModuleIndex` (functions with resolved
+decorators, a best-effort call graph, import aliases), analyzers visit the
+index and emit typed `Finding`s, and the findings diff against a checked-in
+baseline with a RATCHET — any new finding fails tier-1, and a baseline
+entry that stops reproducing fails too, so the baseline can only shrink.
+
+Everything here is stdlib-only (ast/json/pathlib): the analyzers reason
+ABOUT jax/threading code without importing it, so the suite runs in any
+container the tests run in.
+
+See docs/ANALYSIS.md for the rule catalog and the baseline workflow.
+"""
+from __future__ import annotations
+
+import ast
+import json
+from dataclasses import dataclass, field
+from pathlib import Path
+from typing import Callable, Iterable, Optional
+
+# -- findings ---------------------------------------------------------------
+
+
+@dataclass(frozen=True)
+class Finding:
+    """One rule violation: (rule, file, line, message). The baseline key
+    deliberately EXCLUDES the line number — messages are written line-free
+    and stable, so unrelated edits moving code around don't churn the
+    baseline, while a genuinely new violation (new function, new callee)
+    changes the message and trips the ratchet."""
+
+    rule: str
+    file: str      # repo-relative posix path
+    line: int
+    message: str
+
+    @property
+    def key(self) -> tuple[str, str, str]:
+        return (self.rule, self.file, self.message)
+
+    def render(self) -> str:
+        return f"{self.file}:{self.line}: [{self.rule}] {self.message}"
+
+
+# -- module index -----------------------------------------------------------
+
+
+@dataclass
+class FunctionInfo:
+    """One function/method: its AST, resolved decorator names, and the
+    callee identifiers it invokes (dotted best-effort)."""
+
+    name: str                 # bare name
+    qualname: str             # Class.method or plain name
+    module: "ModuleInfo" = field(repr=False)
+    node: ast.AST = field(repr=False)
+    decorators: list[str] = field(default_factory=list)
+    # decorator AST nodes, aligned with `decorators` (partial(jax.jit, ...)
+    # keeps its Call node so static_argnames stays extractable)
+    decorator_nodes: list[ast.AST] = field(default_factory=list, repr=False)
+    calls: list[tuple[str, int]] = field(default_factory=list)  # (callee, line)
+
+    @property
+    def fqid(self) -> str:
+        return f"{self.module.relpath}::{self.qualname}"
+
+    def jit_decorators(self) -> list[tuple[str, ast.AST]]:
+        return [(d, n) for d, n in zip(self.decorators, self.decorator_nodes)
+                if d in ("jax.jit", "jit", "pjit", "jax.pjit")]
+
+
+@dataclass
+class ModuleInfo:
+    path: Path
+    relpath: str              # repo-relative posix
+    tree: ast.Module = field(repr=False)
+    source: str = field(repr=False)
+    # alias -> dotted module/name it refers to ("np" -> "numpy",
+    # "deepcopy" -> "copy.deepcopy", "queue_mod" -> "queue")
+    imports: dict[str, str] = field(default_factory=dict)
+    functions: dict[str, FunctionInfo] = field(default_factory=dict)  # by qualname
+
+
+def dotted_name(node: ast.AST) -> Optional[str]:
+    """Render a Name/Attribute chain as a dotted string; None otherwise."""
+    parts: list[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    if isinstance(node, ast.Call):  # e.g. self._write_lock() in a with-item
+        inner = dotted_name(node.func)
+        return None if inner is None else inner + "()"
+    return None
+
+
+class ModuleIndex:
+    """Per-file parse-once index over a package tree. Analyzers share one
+    instance: the four rules (and the metrics-catalog check the tracing
+    suite delegates here) never re-parse a file."""
+
+    def __init__(self, root: Path, package: str = "karmada_tpu") -> None:
+        self.root = Path(root)
+        self.package = package
+        self.modules: dict[str, ModuleInfo] = {}
+        self.functions: dict[str, FunctionInfo] = {}      # by fqid
+        self.by_bare_name: dict[str, list[FunctionInfo]] = {}
+        self._build()
+
+    # -- construction ------------------------------------------------------
+
+    def _build(self) -> None:
+        pkg_root = self.root / self.package
+        for path in sorted(pkg_root.rglob("*.py")):
+            if "__pycache__" in path.parts:
+                continue
+            relpath = path.relative_to(self.root).as_posix()
+            source = path.read_text()
+            try:
+                tree = ast.parse(source)
+            except SyntaxError:
+                continue  # not our problem; the interpreter will complain
+            mod = ModuleInfo(path=path, relpath=relpath, tree=tree,
+                             source=source)
+            self._index_imports(mod)
+            self._index_functions(mod)
+            self.modules[relpath] = mod
+        for mod in self.modules.values():
+            for fn in mod.functions.values():
+                self.functions[fn.fqid] = fn
+                self.by_bare_name.setdefault(fn.name, []).append(fn)
+
+    @staticmethod
+    def _index_imports(mod: ModuleInfo) -> None:
+        for node in ast.walk(mod.tree):
+            if isinstance(node, ast.Import):
+                for a in node.names:
+                    mod.imports[a.asname or a.name.split(".")[0]] = a.name
+            elif isinstance(node, ast.ImportFrom) and node.module:
+                for a in node.names:
+                    mod.imports[a.asname or a.name] = (
+                        f"{node.module}.{a.name}")
+
+    def _index_functions(self, mod: ModuleInfo) -> None:
+        def visit(node: ast.AST, prefix: str) -> None:
+            for child in ast.iter_child_nodes(node):
+                if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    qual = f"{prefix}{child.name}"
+                    fn = FunctionInfo(
+                        name=child.name, qualname=qual, module=mod,
+                        node=child,
+                        decorators=[self.resolve_decorator(mod, d)
+                                    for d in child.decorator_list],
+                        decorator_nodes=list(child.decorator_list),
+                        calls=self._collect_calls(mod, child),
+                    )
+                    mod.functions[qual] = fn
+                    visit(child, f"{qual}.")
+                elif isinstance(child, ast.ClassDef):
+                    visit(child, f"{prefix}{child.name}.")
+                else:
+                    visit(child, prefix)
+
+        visit(mod.tree, "")
+
+    def resolve_decorator(self, mod: ModuleInfo, node: ast.AST) -> str:
+        """Resolve a decorator expression to a dotted name, looking through
+        functools.partial: @partial(jax.jit, static_argnames=...) -> jax.jit.
+        Import aliases resolve (`from jax import jit as J` -> jax.jit)."""
+        if isinstance(node, ast.Call):
+            head = self._resolve_alias(mod, dotted_name(node.func) or "")
+            if head in ("functools.partial", "partial") and node.args:
+                return self._resolve_alias(
+                    mod, dotted_name(node.args[0]) or "")
+            return head
+        return self._resolve_alias(mod, dotted_name(node) or "")
+
+    def _resolve_alias(self, mod: ModuleInfo, dotted: str) -> str:
+        if not dotted:
+            return ""
+        head, _, rest = dotted.partition(".")
+        target = mod.imports.get(head)
+        if target is None:
+            return dotted
+        return f"{target}.{rest}" if rest else target
+
+    def _collect_calls(self, mod: ModuleInfo,
+                       fn_node: ast.AST) -> list[tuple[str, int]]:
+        calls: list[tuple[str, int]] = []
+        for node in ast.walk(fn_node):
+            if isinstance(node, ast.Call):
+                name = dotted_name(node.func)
+                if name:
+                    calls.append((self._resolve_alias(mod, name),
+                                  node.lineno))
+        return calls
+
+    # -- queries -----------------------------------------------------------
+
+    def module(self, relpath_suffix: str) -> Optional[ModuleInfo]:
+        for rel, mod in self.modules.items():
+            if rel.endswith(relpath_suffix):
+                return mod
+        return None
+
+    def resolve_call(self, caller: FunctionInfo,
+                     callee: str) -> list[FunctionInfo]:
+        """Best-effort call resolution for reachability walks: same-class
+        methods via self/cls, same-module functions, then `from x import y`
+        aliases matched by bare name package-wide. Unresolvable callees
+        (stdlib, jax/jnp ops) resolve to []."""
+        mod = caller.module
+        if callee.startswith(("self.", "cls.")):
+            bare = callee.split(".", 1)[1]
+            if "." in bare:
+                return []
+            cls_prefix = caller.qualname.rsplit(".", 1)[0]
+            hit = mod.functions.get(f"{cls_prefix}.{bare}")
+            if hit is not None:
+                return [hit]
+            return [f for f in mod.functions.values() if f.name == bare]
+        if "." not in callee:
+            hit = mod.functions.get(callee)
+            if hit is not None:
+                return [hit]
+            # from-import of a function defined elsewhere in the package
+            target = mod.imports.get(callee)
+            if target:
+                bare = target.rsplit(".", 1)[-1]
+                return [f for f in self.by_bare_name.get(bare, [])
+                        if f.qualname == bare]
+            return []
+        # module-attribute call resolved through the import table
+        head, _, bare = callee.rpartition(".")
+        resolved_head = mod.imports.get(head.split(".")[0])
+        if resolved_head is None:
+            return []
+        return [f for f in self.by_bare_name.get(bare, [])
+                if f.qualname == bare
+                and f.module.relpath.replace("/", ".").endswith(
+                    resolved_head.lstrip(".") + ".py")]
+
+
+# -- analyzer protocol and runner -------------------------------------------
+
+
+Analyzer = Callable[[ModuleIndex], list[Finding]]
+
+
+def run_analyzers(index: ModuleIndex,
+                  analyzers: Iterable[Analyzer]) -> list[Finding]:
+    findings: list[Finding] = []
+    for a in analyzers:
+        findings.extend(a(index))
+    findings.sort(key=lambda f: (f.rule, f.file, f.line, f.message))
+    return findings
+
+
+def default_analyzers() -> list[Analyzer]:
+    from .constant_drift import analyze as constant_drift
+    from .jit_purity import analyze as jit_purity
+    from .lock_discipline import analyze as lock_discipline
+    from .thread_hygiene import analyze as thread_hygiene
+
+    return [lock_discipline, jit_purity, thread_hygiene, constant_drift]
+
+
+def run_repo(root: Path | str,
+             analyzers: Optional[Iterable[Analyzer]] = None,
+             ) -> tuple[ModuleIndex, list[Finding]]:
+    index = ModuleIndex(Path(root))
+    findings = run_analyzers(
+        index, analyzers if analyzers is not None else default_analyzers())
+    return index, findings
+
+
+# -- baseline + ratchet -----------------------------------------------------
+
+BASELINE_NAME = "baseline.json"
+
+
+def baseline_path(root: Path | str) -> Path:
+    return Path(root) / "karmada_tpu" / "analysis" / BASELINE_NAME
+
+
+@dataclass
+class BaselineEntry:
+    rule: str
+    file: str
+    message: str
+    reason: str  # REQUIRED: why this violation is deliberate
+
+    @property
+    def key(self) -> tuple[str, str, str]:
+        return (self.rule, self.file, self.message)
+
+
+def load_baseline(path: Path | str) -> list[BaselineEntry]:
+    p = Path(path)
+    if not p.exists():
+        return []
+    data = json.loads(p.read_text())
+    entries = []
+    for e in data.get("entries", []):
+        if not e.get("reason"):
+            raise ValueError(
+                f"baseline entry without a reason (baseline only what is "
+                f"deliberate): {e}")
+        entries.append(BaselineEntry(rule=e["rule"], file=e["file"],
+                                     message=e["message"],
+                                     reason=e["reason"]))
+    return entries
+
+
+def save_baseline(path: Path | str, findings: Iterable[Finding],
+                  old: Iterable[BaselineEntry] = (),
+                  default_reason: str = "UNREVIEWED — justify or fix",
+                  ) -> None:
+    """--update-baseline: rewrite the baseline from the current findings,
+    preserving the reason of entries that already existed."""
+    reasons = {e.key: e.reason for e in old}
+    entries = []
+    seen: set[tuple[str, str, str]] = set()
+    for f in sorted(findings, key=lambda f: f.key):
+        if f.key in seen:
+            continue
+        seen.add(f.key)
+        entries.append({"rule": f.rule, "file": f.file, "message": f.message,
+                        "reason": reasons.get(f.key, default_reason)})
+    p = Path(path)
+    p.parent.mkdir(parents=True, exist_ok=True)
+    p.write_text(json.dumps({"entries": entries}, indent=2) + "\n")
+
+
+@dataclass
+class RatchetResult:
+    new: list[Finding]             # findings absent from the baseline
+    stale: list[BaselineEntry]     # baseline entries that stopped reproducing
+    matched: list[Finding]         # findings covered by the baseline
+
+    @property
+    def ok(self) -> bool:
+        return not self.new and not self.stale
+
+    def render(self) -> str:
+        lines = []
+        if self.new:
+            lines.append(f"{len(self.new)} NEW finding(s) — fix them, or "
+                         f"baseline them with a reason if deliberate:")
+            lines += [f"  {f.render()}" for f in self.new]
+        if self.stale:
+            lines.append(f"{len(self.stale)} STALE baseline entr(ies) no "
+                         f"longer reproduce — shrink the baseline "
+                         f"(scripts/lint.sh --update-baseline):")
+            lines += [f"  [{e.rule}] {e.file}: {e.message}"
+                      for e in self.stale]
+        if not lines:
+            lines.append("analysis clean: no new findings, baseline exact")
+        return "\n".join(lines)
+
+
+def ratchet(findings: Iterable[Finding],
+            baseline: Iterable[BaselineEntry]) -> RatchetResult:
+    base_keys = {e.key for e in baseline}
+    found_keys = {f.key for f in findings}
+    new = [f for f in findings if f.key not in base_keys]
+    matched = [f for f in findings if f.key in base_keys]
+    stale = [e for e in baseline if e.key not in found_keys]
+    return RatchetResult(new=new, stale=stale, matched=matched)
+
+
+def repo_root() -> Path:
+    """The repository root, resolved from this file's location."""
+    return Path(__file__).resolve().parents[2]

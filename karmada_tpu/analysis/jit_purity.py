@@ -1,0 +1,248 @@
+"""Jit-purity analyzer: the compile-economics contract, enforced.
+
+Functions reachable from `jax.jit`/`pjit` entry points (seeded from the
+solve modules) must stay pure w.r.t. the trace:
+
+* no host syncs mid-launch — `float()`, `.item()`, `np.asarray()` on a
+  traced value forces a device round-trip inside the launch;
+* no Python RNG or wall-clock — `random.*`, `time.time()` etc. bake one
+  trace-time value into the compiled program (silent nondeterminism);
+* no content-derived ints in SHAPE positions — `jnp.zeros(n_victims)`
+  where `n_victims` came from data flips the program shape per batch and
+  pays a fresh XLA compile each time (the compact-window recompile bug
+  PR-13 hit). The shape-bucket lattice (`shape_bucket`/`shape_floor`,
+  models/batch.py) is the only legal dynamic shape source; `.shape`
+  reads, `len()`, and static_argnames parameters are static by
+  construction (PERF.md "Compile economics" is the companion doc).
+
+The shape check runs on the jit-decorated seeds themselves, where
+`static_argnames` tells us exactly which parameters are static; reachable
+helpers get the sync/RNG/clock checks plus a safe-expression walk of
+their local assignments (their parameters are assumed trace-static when
+only ever fed static values — the seed-level check already guards the
+boundary).
+"""
+from __future__ import annotations
+
+import ast
+from typing import Optional
+
+from .framework import Finding, FunctionInfo, ModuleIndex, dotted_name
+
+RULE = "jit-purity"
+
+# modules whose jit-decorated functions seed the reachability walk
+DEFAULT_SEED_MODULES = (
+    "karmada_tpu/sched/core.py",
+    "karmada_tpu/sched/preemption.py",
+    "karmada_tpu/elastic/solver.py",
+)
+
+_HOST_SYNC_CALLS = {"np.asarray", "numpy.asarray", "np.array",
+                    "numpy.array", "jax.device_get"}
+_RNG_CLOCK_PREFIX = ("random.", "np.random.", "numpy.random.")
+_RNG_CLOCK_EXACT = {"time.time", "time.perf_counter", "time.monotonic",
+                    "time.time_ns", "datetime.now",
+                    "datetime.datetime.now", "datetime.datetime.utcnow"}
+# jnp constructors with a shape (or size) position: ctor -> arg index
+_SHAPE_CTORS = {"zeros": 0, "ones": 0, "full": 0, "empty": 0, "arange": 0,
+                "eye": 0, "broadcast_to": 1}
+# calls whose result is trace-static when their inputs are
+_STATIC_SAFE_CALLS = {"len", "int", "max", "min", "shape_bucket",
+                      "shape_floor", "range", "tuple", "abs"}
+
+
+def _static_argnames(fn: FunctionInfo) -> Optional[set[str]]:
+    """The static parameter set of a jit seed, or None if not a seed."""
+    jits = fn.jit_decorators()
+    if not jits:
+        return None
+    names: set[str] = set()
+    for _, dec in jits:
+        if isinstance(dec, ast.Call):
+            for kw in dec.keywords:
+                if kw.arg in ("static_argnames", "static_argnums"):
+                    for c in ast.walk(kw.value):
+                        if isinstance(c, ast.Constant):
+                            if isinstance(c.value, str):
+                                names.add(c.value)
+                            elif isinstance(c.value, int):
+                                args = fn.node.args
+                                params = [a.arg for a in args.args]
+                                if 0 <= c.value < len(params):
+                                    names.add(params[c.value])
+    return names
+
+
+def _resolve(index: ModuleIndex, mod, node: ast.AST) -> str:
+    name = dotted_name(node)
+    return "" if name is None else index._resolve_alias(mod, name)
+
+
+class _ShapeSafety:
+    """Linear-pass safe-name dataflow over one function body: a name is
+    trace-STATIC if it only ever derives from constants, `.shape` reads,
+    `len()`, the bucket lattice, or other static names."""
+
+    def __init__(self, index: ModuleIndex, fn: FunctionInfo,
+                 static_params: set[str], assume_params_static: bool):
+        self.index = index
+        self.fn = fn
+        self.mod = fn.module
+        self.safe: set[str] = set(static_params)
+        args = fn.node.args
+        all_params = ([a.arg for a in args.posonlyargs]
+                      + [a.arg for a in args.args]
+                      + [a.arg for a in args.kwonlyargs])
+        self.params = set(all_params)
+        if assume_params_static:
+            self.safe |= self.params
+        self._sweep()
+
+    def _sweep(self) -> None:
+        # two passes so forward references in straight-line code settle
+        for _ in range(2):
+            for node in ast.walk(self.fn.node):
+                if isinstance(node, ast.Assign) and len(node.targets) == 1:
+                    t = node.targets[0]
+                    if isinstance(t, ast.Name) and self.is_static(node.value):
+                        self.safe.add(t.id)
+                    elif isinstance(t, ast.Tuple) and all(
+                            isinstance(e, ast.Name) for e in t.elts):
+                        # x, y = arr.shape — every element is static
+                        if self.is_static(node.value):
+                            self.safe.update(e.id for e in t.elts)
+
+    def is_static(self, node: ast.AST) -> bool:
+        """True iff every leaf of the expression is trace-static."""
+        if isinstance(node, ast.Constant):
+            return True
+        if isinstance(node, ast.Name):
+            return node.id in self.safe
+        if isinstance(node, ast.Attribute):
+            # x.shape / x.ndim / x.dtype are static regardless of x
+            if node.attr in ("shape", "ndim", "dtype", "size"):
+                return True
+            return self.is_static(node.value)
+        if isinstance(node, ast.Subscript):
+            # x.shape[0] — static iff the subscripted value is
+            return self.is_static(node.value)
+        if isinstance(node, (ast.BinOp,)):
+            return self.is_static(node.left) and self.is_static(node.right)
+        if isinstance(node, ast.UnaryOp):
+            return self.is_static(node.operand)
+        if isinstance(node, (ast.Tuple, ast.List)):
+            return all(self.is_static(e) for e in node.elts)
+        if isinstance(node, ast.IfExp):
+            return (self.is_static(node.test) and self.is_static(node.body)
+                    and self.is_static(node.orelse))
+        if isinstance(node, ast.Call):
+            callee = _resolve(self.index, self.mod, node.func)
+            bare = callee.rsplit(".", 1)[-1]
+            # bare builtins only: x.max() is a REDUCTION over traced data,
+            # not the static builtin max(); the bucket lattice stays safe
+            # under any import spelling
+            if bare in ("shape_bucket", "shape_floor"):
+                return True
+            if "." not in callee and callee in _STATIC_SAFE_CALLS:
+                return all(self.is_static(a) for a in node.args)
+            return False
+        if isinstance(node, ast.Compare):
+            return (self.is_static(node.left)
+                    and all(self.is_static(c) for c in node.comparators))
+        return False
+
+
+def _reachable(index: ModuleIndex,
+               seeds: list[FunctionInfo]) -> list[FunctionInfo]:
+    seen: dict[str, FunctionInfo] = {}
+    frontier = list(seeds)
+    while frontier:
+        fn = frontier.pop()
+        if fn.fqid in seen:
+            continue
+        seen[fn.fqid] = fn
+        for callee, _line in fn.calls:
+            for hit in index.resolve_call(fn, callee):
+                if hit.fqid not in seen:
+                    frontier.append(hit)
+    return list(seen.values())
+
+
+def _scan(index: ModuleIndex, fn: FunctionInfo,
+          is_seed: bool, static_params: set[str]) -> list[Finding]:
+    findings: list[Finding] = []
+    mod = fn.module
+    safety = _ShapeSafety(index, fn, static_params,
+                          assume_params_static=not is_seed)
+
+    for node in ast.walk(fn.node):
+        if not isinstance(node, ast.Call):
+            continue
+        callee = _resolve(index, mod, node.func)
+        bare = callee.rsplit(".", 1)[-1]
+        # host syncs
+        if callee in _HOST_SYNC_CALLS:
+            findings.append(Finding(
+                RULE, mod.relpath, node.lineno,
+                f"host sync {callee} in jit-reachable {fn.qualname} "
+                f"(forces a device round-trip mid-launch)"))
+            continue
+        if (isinstance(node.func, ast.Attribute)
+                and node.func.attr == "item" and not node.args):
+            findings.append(Finding(
+                RULE, mod.relpath, node.lineno,
+                f"host sync .item() in jit-reachable {fn.qualname} "
+                f"(forces a device round-trip mid-launch)"))
+            continue
+        if callee == "float" and node.args \
+                and not safety.is_static(node.args[0]):
+            findings.append(Finding(
+                RULE, mod.relpath, node.lineno,
+                f"float() on a traced value in jit-reachable "
+                f"{fn.qualname} (host sync mid-launch)"))
+            continue
+        # Python RNG / wall-clock
+        if callee in _RNG_CLOCK_EXACT \
+                or callee.startswith(_RNG_CLOCK_PREFIX):
+            findings.append(Finding(
+                RULE, mod.relpath, node.lineno,
+                f"Python RNG/wall-clock {callee} in jit-reachable "
+                f"{fn.qualname} (bakes a trace-time value into the "
+                f"compiled program)"))
+            continue
+        # content-derived shapes
+        head = callee.rsplit(".", 1)[0] if "." in callee else ""
+        if bare in _SHAPE_CTORS and head in ("jnp", "jax.numpy"):
+            pos = _SHAPE_CTORS[bare]
+            for arg in node.args[pos:pos + 1]:
+                if not safety.is_static(arg):
+                    findings.append(Finding(
+                        RULE, mod.relpath, node.lineno,
+                        f"content-derived shape in jnp.{bare}(...) in "
+                        f"{fn.qualname} (program shape must come from "
+                        f"the bucket lattice — shape_bucket/shape_floor "
+                        f"— or static_argnames, never from data)"))
+    return findings
+
+
+def analyze(index: ModuleIndex,
+            seed_modules: tuple[str, ...] = DEFAULT_SEED_MODULES
+            ) -> list[Finding]:
+    seeds: list[FunctionInfo] = []
+    for rel in seed_modules:
+        mod = index.modules.get(rel)
+        if mod is None:
+            mod = index.module(rel.split("/", 1)[-1])
+        if mod is None:
+            continue
+        for fn in mod.functions.values():
+            if fn.jit_decorators():
+                seeds.append(fn)
+    findings: list[Finding] = []
+    seed_ids = {s.fqid for s in seeds}
+    for fn in _reachable(index, seeds):
+        is_seed = fn.fqid in seed_ids
+        static = _static_argnames(fn) if is_seed else set()
+        findings.extend(_scan(index, fn, is_seed, static or set()))
+    return findings

@@ -1,0 +1,168 @@
+"""Lock-discipline analyzer: the store's critical section stays
+validate+stamp+place+sink.
+
+The contract (DESIGN.md 8c, PR-8/9): inside `with self._lock` /
+`with store._lock` / `with self._write_lock()` regions in `store/`,
+nothing may block, dispatch, or deep-copy request payloads —
+
+* BLOCKING calls (time.sleep, subprocess, socket/HTTP sends, fsync/IO):
+  a mutator holding the store lock stalls every reader and writer of the
+  plane. The one deliberate exception is the WAL group-commit seam: disk
+  I/O under persistence's dedicated `_io_lock` IS the design (appenders
+  queue behind an in-flight fsync there, never behind the store lock) —
+  whitelisted explicitly below.
+* WATCHER-BUS DISPATCH (`_dispatch`/`_notify`/`_bus`/handler invocation):
+  subscribers take their own locks and call back into the store — the
+  ABBA surface PR-7/9 closed. Event SINKS (`_sink`) are under-lock BY
+  CONTRACT (rv-ordered feed for the watch cache) and are not flagged.
+* DEEP COPIES of payloads (`copy.deepcopy`): input/return copies belong
+  outside the hold; committed objects are immutable-once-placed so refs
+  can be taken under the lock and copied after it drops.
+
+Condition variables guard the same discipline (`_cv`/`_cond`); waiting or
+notifying the condition ITSELF is of course allowed.
+"""
+from __future__ import annotations
+
+import ast
+import re
+from typing import Optional
+
+from .framework import Finding, FunctionInfo, ModuleIndex, dotted_name
+
+RULE = "lock-discipline"
+
+# with-item expressions that mean "a lock is held" (attribute tail)
+_LOCK_ATTR = re.compile(r"^_?(?:.*_)?(?:lock|cv|cond|commit_cv)$|^_write_lock\(\)$")
+
+# callees that block the thread (dotted, resolved through import aliases)
+_BLOCKING_EXACT = {
+    "time.sleep",
+    "urllib.request.urlopen", "urlopen",
+    "socket.create_connection",
+    "os.fsync", "os.fdatasync",
+}
+_BLOCKING_PREFIX = ("subprocess.", "requests.", "http.client.")
+# attribute tails that are socket/HTTP sends regardless of receiver
+_BLOCKING_ATTRS = {"sendall", "recv", "makefile", "getresponse", "urlopen"}
+
+# watcher-bus dispatch: method names + handler-variable call idioms
+_DISPATCH_ATTRS = {"_dispatch", "_notify", "dispatch"}
+_HANDLER_NAMES = {"handler", "handlers", "callback", "cb", "w", "bw",
+                  "watcher", "watchers"}
+
+_DEEPCOPY = {"copy.deepcopy", "deepcopy"}
+
+# The WAL group-commit fsync seam, whitelisted EXPLICITLY: persistence's
+# `_io_lock` exists to serialize buffered-write+fsync batches — I/O under
+# it is the design, not a violation (docs/ANALYSIS.md "whitelist").
+_IO_SEAM_LOCK = "_io_lock"
+
+
+def _lock_name(item: ast.withitem) -> Optional[str]:
+    """The held-lock name for a with-item, or None if not a lock."""
+    name = dotted_name(item.context_expr)
+    if name is None:
+        return None
+    tail = name.rsplit(".", 1)[-1]
+    return tail if _LOCK_ATTR.match(tail) else None
+
+
+def _callee_of(index: ModuleIndex, mod, node: ast.Call) -> str:
+    name = dotted_name(node.func)
+    if name is None:
+        return ""
+    return index._resolve_alias(mod, name)
+
+
+def _is_blocking(callee: str, held: list[str]) -> Optional[str]:
+    tail = callee.rsplit(".", 1)[-1]
+    hit = None
+    if callee in _BLOCKING_EXACT or tail in _BLOCKING_EXACT:
+        hit = callee
+    elif callee.startswith(_BLOCKING_PREFIX):
+        hit = callee
+    elif tail in _BLOCKING_ATTRS:
+        hit = callee
+    if hit in ("os.fsync", "os.fdatasync") and _IO_SEAM_LOCK in held:
+        return None  # the WAL group-commit seam (see module docstring)
+    return hit
+
+
+def _is_dispatch(callee: str) -> bool:
+    tail = callee.rsplit(".", 1)[-1]
+    if tail in _DISPATCH_ATTRS or "_bus" in callee:
+        return True
+    # direct handler invocation: a bare name that walks like a callback
+    return "." not in callee and callee in _HANDLER_NAMES
+
+
+def _is_lock_self_call(callee: str, held: list[str]) -> bool:
+    """cond.wait()/notify()/acquire() on the held lock object itself."""
+    parts = callee.rsplit(".", 2)
+    if len(parts) < 2:
+        return False
+    owner_tail, method = parts[-2], parts[-1]
+    return (method in ("wait", "wait_for", "notify", "notify_all",
+                       "acquire", "release")
+            and owner_tail in held)
+
+
+def _scan_function(index: ModuleIndex, fn: FunctionInfo) -> list[Finding]:
+    findings: list[Finding] = []
+    mod = fn.module
+
+    def check_call(node: ast.Call, held: list[str]) -> None:
+        callee = _callee_of(index, mod, node)
+        if not callee or _is_lock_self_call(callee, held):
+            return
+        lock = held[-1]
+        blocking = _is_blocking(callee, held)
+        if blocking:
+            findings.append(Finding(
+                RULE, mod.relpath, node.lineno,
+                f"blocking call {blocking} under {lock} in {fn.qualname}"))
+        elif _is_dispatch(callee):
+            findings.append(Finding(
+                RULE, mod.relpath, node.lineno,
+                f"watcher dispatch {callee} under {lock} in {fn.qualname} "
+                f"(the ABBA surface — dispatch after the hold drops)"))
+        elif callee in _DEEPCOPY:
+            findings.append(Finding(
+                RULE, mod.relpath, node.lineno,
+                f"deepcopy under {lock} in {fn.qualname} (payload copies "
+                f"belong pre-lock; committed objects are immutable — take "
+                f"refs, copy after)"))
+
+    def visit(node: ast.AST, held: list[str]) -> None:
+        if held and isinstance(node, ast.Call):
+            check_call(node, held)
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                  ast.Lambda)):
+                continue  # nested defs run later, not under this hold
+            if isinstance(child, ast.With):
+                names = [n for n in (_lock_name(i) for i in child.items)
+                         if n is not None]
+                inner = held + [n for n in names if n not in held]
+                # with-item expressions themselves evaluate pre-acquire
+                for item in child.items:
+                    visit(item, held)
+                for stmt in child.body:
+                    visit(stmt, inner)
+                continue
+            visit(child, held)
+
+    visit(fn.node, [])
+    return findings
+
+
+def analyze(index: ModuleIndex, scope: str = "karmada_tpu/store/"
+            ) -> list[Finding]:
+    findings: list[Finding] = []
+    for relpath, mod in index.modules.items():
+        if scope not in relpath:
+            continue
+        for fn in mod.functions.values():
+            findings.extend(_scan_function(index, fn))
+    return findings

@@ -1,0 +1,151 @@
+"""Thread-hygiene analyzer: daemons get flagged or joined; buffers stay
+bounded.
+
+* Every `threading.Thread(...)` must either set `daemon=True` (the
+  process can exit with it running) or have a matching `.join()` on a
+  shutdown path (`close`/`stop`/`shutdown`/`join`/`drain`/`wait*`) — a
+  non-daemon thread with neither hangs interpreter exit the first time a
+  test forgets to tear it down.
+* Every `queue.Queue`/`LifoQueue`/`PriorityQueue` must pass a positive
+  `maxsize`, every `collections.deque` a `maxlen`, and `SimpleQueue` is
+  unbounded by construction — an unbounded buffer between a producer and
+  a slow consumer is an OOM with a delay fuse (the soak plane's first
+  class of casualties).
+"""
+from __future__ import annotations
+
+import ast
+
+from .framework import Finding, FunctionInfo, ModuleIndex, dotted_name
+
+RULE = "thread-hygiene"
+
+_QUEUE_CTORS = {"Queue", "LifoQueue", "PriorityQueue"}
+_SHUTDOWN_HINT = ("close", "stop", "shutdown", "join", "drain", "wait",
+                  "__exit__", "finally")
+
+
+def _resolve(index: ModuleIndex, mod, node: ast.AST) -> str:
+    name = dotted_name(node)
+    return "" if name is None else index._resolve_alias(mod, name)
+
+
+def _assign_target(mod, call: ast.Call) -> str:
+    """The attribute/name a Thread construction is assigned to, best
+    effort: `self._writer = threading.Thread(...)` -> `_writer`."""
+    for node in ast.walk(mod.tree):
+        if isinstance(node, ast.Assign) and node.value is call:
+            t = node.targets[0]
+            if isinstance(t, ast.Attribute):
+                return t.attr
+            if isinstance(t, ast.Name):
+                return t.id
+    return ""
+
+
+def _module_joins(mod) -> set[str]:
+    """Names/attrs `.join()`ed anywhere in a shutdown-shaped function."""
+    joined: set[str] = set()
+    for fn in mod.functions.values():
+        if not any(h in fn.name.lower() for h in _SHUTDOWN_HINT):
+            continue
+        for node in ast.walk(fn.node):
+            if (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                    and node.func.attr == "join"):
+                owner = node.func.value
+                if isinstance(owner, ast.Attribute):
+                    joined.add(owner.attr)
+                elif isinstance(owner, ast.Name):
+                    joined.add(owner.id)
+    return joined
+
+
+def _daemon_kwarg(call: ast.Call):
+    for kw in call.keywords:
+        if kw.arg == "daemon":
+            return kw.value
+    return None
+
+
+def _has_bound(call: ast.Call, kwname: str) -> bool:
+    """A positive first positional arg or a non-None bounding kwarg."""
+    if call.args:
+        a = call.args[0]
+        if isinstance(a, ast.Constant):
+            return bool(a.value)
+        return True  # a computed bound: trust it (maxsize=self.depth + 1)
+    for kw in call.keywords:
+        if kw.arg == kwname:
+            if isinstance(kw.value, ast.Constant):
+                return bool(kw.value.value)
+            return True
+    return False
+
+
+def _scan_module(index: ModuleIndex, mod) -> list[Finding]:
+    findings: list[Finding] = []
+    joins = _module_joins(mod)
+    # map call node -> enclosing function qualname for messages
+    owner: dict[int, str] = {}
+    for fn in mod.functions.values():
+        for node in ast.walk(fn.node):
+            if isinstance(node, ast.Call):
+                owner.setdefault(id(node), fn.qualname)
+
+    for node in ast.walk(mod.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        callee = _resolve(index, mod, node.func)
+        bare = callee.rsplit(".", 1)[-1]
+        where = owner.get(id(node), "<module>")
+
+        if callee in ("threading.Thread", "Thread") \
+                and callee.split(".")[0] in ("threading", "Thread"):
+            dk = _daemon_kwarg(node)
+            if isinstance(dk, ast.Constant) and dk.value is True:
+                continue
+            target = _assign_target(mod, node)
+            if target and target in joins:
+                continue  # joined on a shutdown path
+            if dk is not None and not isinstance(dk, ast.Constant):
+                continue  # daemon=<expr>: configurable, assume handled
+            findings.append(Finding(
+                RULE, mod.relpath, node.lineno,
+                f"thread without daemon=True or a shutdown-path join "
+                f"in {where} (hangs interpreter exit)"))
+        elif bare in _QUEUE_CTORS and (
+                callee.startswith("queue.") or callee == bare):
+            # only the stdlib queue module (resolved through aliases);
+            # bare `Queue` counts only when imported from queue
+            head = callee.rsplit(".", 1)[0] if "." in callee else ""
+            if head and head not in ("queue",):
+                continue
+            if not _has_bound(node, "maxsize"):
+                findings.append(Finding(
+                    RULE, mod.relpath, node.lineno,
+                    f"unbounded queue.{bare}() in {where} (pass maxsize: "
+                    f"an unbounded producer/consumer buffer is a slow "
+                    f"OOM)"))
+        elif callee in ("queue.SimpleQueue",):
+            findings.append(Finding(
+                RULE, mod.relpath, node.lineno,
+                f"queue.SimpleQueue in {where} is unbounded by "
+                f"construction — use queue.Queue(maxsize=...)"))
+        elif bare == "deque" and callee in ("deque", "collections.deque"):
+            if not (len(node.args) >= 2 or any(
+                    kw.arg == "maxlen" and not (
+                        isinstance(kw.value, ast.Constant)
+                        and kw.value.value is None)
+                    for kw in node.keywords)):
+                findings.append(Finding(
+                    RULE, mod.relpath, node.lineno,
+                    f"unbounded deque() in {where} (pass maxlen)"))
+    return findings
+
+
+def analyze(index: ModuleIndex) -> list[Finding]:
+    findings: list[Finding] = []
+    for mod in index.modules.values():
+        findings.extend(_scan_module(index, mod))
+    return findings

@@ -417,7 +417,9 @@ def cmd_watch(cp: ControlPlane, kind: str, name: str = "", namespace: str = "",
 
     resolved = _resolve_kind(kind)
     emit = sink or (lambda line: print(line, flush=True))
-    q: queue_mod.Queue = queue_mod.Queue()
+    # bounded (thread-hygiene): a consumer stuck on a dead pipe must
+    # backpressure the watch bus, not buffer the fleet's event stream
+    q: queue_mod.Queue = queue_mod.Queue(maxsize=65536)
 
     def handler(event: str, obj) -> None:
         q.put((event, obj))

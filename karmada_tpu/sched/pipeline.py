@@ -182,7 +182,10 @@ class StreamPipeline:
         self.failure: Optional[BaseException] = None
         self._abort = threading.Event()
         self._slots = threading.Semaphore(self.depth)
-        self._q: queue.Queue = queue.Queue()
+        # the launch-slot semaphore already bounds in-flight chunks to
+        # `depth`; the queue bound (+1 for the close sentinel) makes the
+        # invariant structural (thread-hygiene rule: every ring bounded)
+        self._q: queue.Queue = queue.Queue(maxsize=self.depth + 1)
         self._lock = threading.Lock()
         self._retired_cv = threading.Condition(self._lock)
         self._results: dict[int, object] = {}

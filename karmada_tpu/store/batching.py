@@ -30,6 +30,7 @@ import logging
 import threading
 from typing import Any, Optional
 
+from ..analysis.lockorder import make_lock
 from ..metrics import writes_coalesced
 from .store import BatchError, gvk_of
 
@@ -124,7 +125,10 @@ class WriteCoalescer:
         self.flush_delay = flush_delay
         self.max_batch = max(1, max_batch)
         self.path = path
-        self._cv = threading.Condition()
+        # lock-order watchdog seam (KARMADA_TPU_LOCKCHECK=1): flush()
+        # commits to the store AFTER dropping this lock — the watchdog
+        # proves the coalescer/store order never inverts
+        self._cv = threading.Condition(make_lock("coalescer._cv"))
         self._buf: dict[tuple[str, str, str], Any] = {}
         self._closed = False
         self._closed_evt = threading.Event()

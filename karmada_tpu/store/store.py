@@ -36,6 +36,7 @@ from contextlib import contextmanager
 from dataclasses import dataclass
 from typing import Any, Callable, Iterable, Optional
 
+from ..analysis.lockorder import make_lock
 from ..api.meta import ObjectMeta, new_uid, now
 from ..api.unstructured import Unstructured
 from ..metrics import store_lock_hold, store_lock_wait, txn_batch_size
@@ -118,7 +119,11 @@ _REMOVED = object()  # batch-overlay tombstone (in-batch delete transition)
 
 class Store:
     def __init__(self) -> None:
-        self._lock = threading.RLock()
+        # constructed through the lock-order seam: a plain RLock normally,
+        # an instrumented one under KARMADA_TPU_LOCKCHECK=1 (the runtime
+        # watchdog records the global acquisition-order graph and the
+        # analysis tier-1 test fails on cycles — docs/ANALYSIS.md)
+        self._lock = make_lock("store._lock", rlock=True)
         self._buckets: dict[str, _Bucket] = {}
         self._kinds_token = 0
         self._rv = 0
@@ -412,19 +417,40 @@ class Store:
         return self._finish(kind, event, stored)
 
     def apply(self, obj: Any) -> Any:
-        """create-or-update. The existence check and the inner create/update
-        run under one reentrant-lock hold so concurrent apply() calls cannot
-        race each other into ConflictError/NotFoundError. Watch handlers run
-        AFTER the hold drops (they used to run re-entrantly under it on this
-        path — the store half of the ABBA surface)."""
+        """create-or-update. The create-vs-update decision is made under
+        the commit lock so concurrent apply() calls cannot race each other
+        into ConflictError/NotFoundError — but the admission chain (user
+        code: webhooks) and the input deepcopy run OUTSIDE the hold,
+        against a one-peek existence guess, exactly like `_write_batch`'s
+        apply path (lock-discipline rule: the critical section is
+        validate+stamp+place+sink, nothing else). A racing writer that
+        flips the guess re-runs the right chain under the lock — rare,
+        never silently under-admitted. Watch handlers run AFTER the hold
+        drops (they used to run re-entrantly under it on this path — the
+        store half of the ABBA surface)."""
         kind = gvk_of(obj)
         key = self._key(obj.metadata)
+        if self._admission is not None:
+            with self._lock:
+                guess_exists = key in self._bucket(kind).objects
+            admitted = (self._admit_update(obj, kind) if guess_exists
+                        else self._admit_create(obj, kind))
+        else:
+            guess_exists = None  # no chain: nothing depends on the guess
+            admitted = obj
+        stored = copy.deepcopy(admitted)
         with self._write_lock():
-            if key in self._bucket(kind).objects:
-                stored = copy.deepcopy(self._admit_update(obj, kind))
+            exists = key in self._bucket(kind).objects
+            if self._admission is not None and exists != guess_exists:
+                # the existence race flipped create<->update after the
+                # pre-lock admission: re-run the right chain here (under
+                # the lock — baselined, like _write_batch's twin)
+                admitted = (self._admit_update(obj, kind) if exists
+                            else self._admit_create(obj, kind))
+                stored = copy.deepcopy(admitted)
+            if exists:
                 event = self._commit_update(kind, stored, False)
             else:
-                stored = copy.deepcopy(self._admit_create(obj, kind))
                 self._commit_create(kind, stored)
                 event = ADDED
         return self._finish(kind, event, stored)
@@ -787,12 +813,14 @@ class Store:
         re-admit etcd content on restart). Watchers are notified ADDED so
         already-subscribed level-triggered controllers converge, exactly as
         an informer relist would deliver the initial state."""
+        # input deepcopies BEFORE the lock (lock-discipline): restore runs
+        # at boot, but a replication snapshot can land mid-flight and the
+        # hold must stay validate+stamp+place+sink there too
+        incoming = [(gvk_of(o), copy.deepcopy(o)) for o in objects]
         loaded: list[tuple[str, Any]] = []
         with self._lock:
-            for obj in objects:
-                kind = gvk_of(obj)
+            for kind, stored in incoming:
                 b = self._bucket(kind)
-                stored = copy.deepcopy(obj)
                 b.objects[self._key(stored.metadata)] = stored
                 self._rv = max(self._rv, stored.metadata.resource_version)
                 # restored rvs arrive in file order, not rv order — the
@@ -816,14 +844,15 @@ class Store:
         execution namespace the same way (agent.go:248-433)."""
         with self._lock:
             self._bucket(kind).watchers.append((handler, namespace))
-            snapshot = [
-                copy.deepcopy(o)
-                for o in self._buckets[kind].objects.values()
+            refs = [
+                o for o in self._buckets[kind].objects.values()
                 if not namespace or o.metadata.namespace == namespace
             ]
+        # committed objects are immutable once placed: refs under the
+        # lock, replay copies outside it (lock-discipline)
         if replay:
-            for o in snapshot:
-                handler(ADDED, o)
+            for o in refs:
+                handler(ADDED, copy.deepcopy(o))
 
     def unwatch(self, kind: str, handler: WatchHandler) -> None:
         """Drop a kind subscription (a disconnected watch stream must not
@@ -847,14 +876,15 @@ class Store:
         detector's dynamic-informer sweep (detector.go:112)."""
         with self._lock:
             self._all_watchers.append(handler)
-            snapshot = [
-                (kind, copy.deepcopy(o))
+            refs = [
+                (kind, o)
                 for kind, b in self._buckets.items()
                 for o in b.objects.values()
             ]
+        # immutable-once-placed: copy outside the hold (lock-discipline)
         if replay:
-            for kind, o in snapshot:
-                handler(kind, ADDED, o)
+            for kind, o in refs:
+                handler(kind, ADDED, copy.deepcopy(o))
 
     def watch_all_batch(
         self, handler: Callable[[list[tuple[str, str, Any]]], None]

@@ -43,6 +43,7 @@ import threading
 import time
 from typing import Any, Optional
 
+from ..analysis.lockorder import make_lock
 from ..server import codec
 from .store import ADDED, DELETED, Store
 
@@ -142,7 +143,10 @@ class WatchCache:
         self._store = store
         self.capacity = max(int(capacity), 1)
         self.page_ttl = page_ttl
-        self._cond = threading.Condition()
+        # lock-order watchdog seam (KARMADA_TPU_LOCKCHECK=1): the cache
+        # lock is acquired under the store hold via the event sink — the
+        # watchdog proves that edge never reverses (docs/ANALYSIS.md)
+        self._cond = threading.Condition(make_lock("watchcache._cond"))
         self._events: list[CacheEvent] = []
         # kind -> (namespace, name) -> latest CacheEvent (current state)
         self._index: dict[str, dict[tuple[str, str], CacheEvent]] = {}

@@ -49,7 +49,9 @@ def spawn_process(argv: list[str], pattern: str, timeout: float = 60.0,
         argv, stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
     )
     rx = re.compile(pattern)
-    q: queue.Queue = queue.Queue()
+    # bounded (thread-hygiene): a chatty child blocks its own stdout pipe
+    # behind the reader instead of ballooning the test process
+    q: queue.Queue = queue.Queue(maxsize=100_000)
 
     def reader() -> None:
         for line in proc.stdout:

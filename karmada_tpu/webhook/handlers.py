@@ -11,16 +11,19 @@ from __future__ import annotations
 
 from typing import Optional
 
+from ..api.cluster import TAINT_CLUSTER_NOT_READY, TAINT_CLUSTER_UNREACHABLE
 from ..api.meta import new_uid
 from ..api.policy import Toleration
 from .admission import AdmissionChain, AdmissionDenied, AdmissionRequest, DELETE, Webhook
 
 # pkg/webhook/propagationpolicy/mutating.go:47 — default NoExecute tolerations
 # for the condition taints the cluster controller applies (not-ready /
-# unreachable), 300s window.
+# unreachable), 300s window. The taint keys are wire constants with ONE
+# defining module (api/cluster.py, constant-drift rule) — re-exported here
+# under the names this module always used.
 DEFAULT_TOLERATION_SECONDS = 300
-NOT_READY_TAINT_KEY = "cluster.karmada.io/not-ready"
-UNREACHABLE_TAINT_KEY = "cluster.karmada.io/unreachable"
+NOT_READY_TAINT_KEY = TAINT_CLUSTER_NOT_READY
+UNREACHABLE_TAINT_KEY = TAINT_CLUSTER_UNREACHABLE
 
 DELETION_PROTECTION_LABEL = "resourcetemplate.karmada.io/deletion-protected"
 DELETION_PROTECTION_ALWAYS = "Always"

@@ -1,0 +1,25 @@
+#!/usr/bin/env bash
+# Invariant analysis suite, standalone (docs/ANALYSIS.md): runs the four
+# AST analyzers — lock-discipline, jit-purity, thread-hygiene,
+# constant-drift (incl. the metrics catalog) — over karmada_tpu/ and
+# diffs the findings against karmada_tpu/analysis/baseline.json with the
+# ratchet: exit nonzero on any NEW finding and on any baseline entry that
+# no longer reproduces (fixed violations must shrink the baseline).
+#
+#   scripts/lint.sh                     # the tier-1 gate, standalone
+#   scripts/lint.sh --list              # print every finding
+#   scripts/lint.sh --update-baseline   # rewrite the baseline, keeping
+#                                       # reviewed reasons; new entries
+#                                       # are stamped UNREVIEWED and the
+#                                       # tier-1 test refuses to ship them
+#
+# Wired into the slow path as
+# tests/test_analysis.py::TestLintSmokeScript (pytest -m slow).
+# Pure stdlib (ast/json): no jax, no device, no network.
+set -euo pipefail
+
+cd "$(dirname "$0")/.."
+PY=${PYTHON:-python}
+
+$PY -m karmada_tpu.analysis "$@"
+echo "ANALYSIS OK"

@@ -1,0 +1,674 @@
+"""Invariant analysis plane (karmada_tpu/analysis/, docs/ANALYSIS.md).
+
+Four layers of coverage:
+
+1. ANALYZER FIXTURES — positive + negative + whitelist snippets per rule,
+   including the content-derived-shape fixture jit-purity must catch and
+   the known-ABBA two-lock fixture the lock-order watchdog must catch.
+2. THE REPO ITSELF — all four analyzers run over karmada_tpu/ in tier-1
+   with zero non-baselined findings, and every baseline entry must still
+   reproduce (the ratchet: the baseline can only shrink).
+3. RATCHET MECHANICS — an injected violation trips `new`, a fixed one
+   trips `stale`, reasons are mandatory and survive --update-baseline.
+4. LOCK-ORDER WATCHDOG — instrumented locks under KARMADA_TPU_LOCKCHECK=1
+   record the acquisition graph while the real concurrent store paths run
+   (batch write + watch fan-out + coalescer flush) and the graph must be
+   acyclic.
+"""
+from __future__ import annotations
+
+import json
+import textwrap
+import threading
+
+import pytest
+
+import karmada_tpu.server  # noqa: F401  (import-order: server before watchcache)
+from karmada_tpu.analysis import (
+    Finding,
+    ModuleIndex,
+    baseline_path,
+    default_analyzers,
+    load_baseline,
+    ratchet,
+    repo_root,
+    run_analyzers,
+    run_repo,
+    save_baseline,
+)
+from karmada_tpu.analysis import lockorder
+from karmada_tpu.analysis.constant_drift import analyze as constant_drift
+from karmada_tpu.analysis.jit_purity import analyze as jit_purity
+from karmada_tpu.analysis.lock_discipline import analyze as lock_discipline
+from karmada_tpu.analysis.lockorder import (
+    CheckedLock,
+    LockOrderWatchdog,
+    make_lock,
+    watchdog,
+)
+from karmada_tpu.analysis.thread_hygiene import analyze as thread_hygiene
+
+
+def build_tree(tmp_path, files: dict[str, str]) -> ModuleIndex:
+    for rel, src in files.items():
+        p = tmp_path / rel
+        p.parent.mkdir(parents=True, exist_ok=True)
+        p.write_text(textwrap.dedent(src))
+    return ModuleIndex(tmp_path)
+
+
+def messages(findings: list[Finding]) -> str:
+    return "\n".join(f.render() for f in findings)
+
+
+# ===========================================================================
+# lock-discipline fixtures
+# ===========================================================================
+
+
+class TestLockDiscipline:
+    def test_blocking_dispatch_deepcopy_under_lock_flagged(self, tmp_path):
+        idx = build_tree(tmp_path, {"karmada_tpu/store/bad.py": """
+            import copy
+            import threading
+            import time
+
+            class S:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                def slow(self, obj):
+                    with self._lock:
+                        time.sleep(0.1)
+                        self._notify("k", "ADDED", obj)
+                        stored = copy.deepcopy(obj)
+                    return stored
+                def _notify(self, k, e, o):
+                    pass
+        """})
+        found = lock_discipline(idx)
+        kinds = [f.message.split(" ")[0] for f in found]
+        assert len(found) == 3, messages(found)
+        assert "blocking" in kinds[0] or any(
+            "time.sleep" in f.message for f in found)
+        assert any("watcher dispatch" in f.message for f in found)
+        assert any("deepcopy under" in f.message for f in found)
+        # every message carries the enclosing qualname, line-free (stable
+        # baseline keys)
+        assert all("S.slow" in f.message for f in found)
+
+    def test_outside_lock_not_flagged(self, tmp_path):
+        idx = build_tree(tmp_path, {"karmada_tpu/store/ok.py": """
+            import copy
+            import threading
+            import time
+
+            class S:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                def fine(self, obj):
+                    stored = copy.deepcopy(obj)   # pre-lock
+                    with self._lock:
+                        x = dict(a=1)
+                    time.sleep(0)                 # post-lock
+                    self._notify("k", "A", stored)
+                    return x
+                def _notify(self, k, e, o):
+                    pass
+        """})
+        assert lock_discipline(idx) == []
+
+    def test_wal_fsync_seam_whitelisted_under_io_lock_only(self, tmp_path):
+        idx = build_tree(tmp_path, {"karmada_tpu/store/persistence.py": """
+            import os
+            import threading
+
+            class P:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self._io_lock = threading.Lock()
+                def commit(self, wal, batch):
+                    with self._io_lock:
+                        wal.write(b"x")
+                        os.fsync(wal.fileno())    # THE whitelisted seam
+                def bad(self, wal):
+                    with self._lock:
+                        os.fsync(wal.fileno())    # NOT the seam: flagged
+        """})
+        found = lock_discipline(idx)
+        assert len(found) == 1, messages(found)
+        assert "os.fsync" in found[0].message and "P.bad" in found[0].message
+
+    def test_condition_self_wait_not_flagged(self, tmp_path):
+        idx = build_tree(tmp_path, {"karmada_tpu/store/cond.py": """
+            import threading
+
+            class C:
+                def __init__(self):
+                    self._cv = threading.Condition()
+                def waiter(self):
+                    with self._cv:
+                        while True:
+                            self._cv.wait(0.1)
+                            self._cv.notify_all()
+        """})
+        assert lock_discipline(idx) == []
+
+    def test_scope_is_store_only(self, tmp_path):
+        idx = build_tree(tmp_path, {"karmada_tpu/sched/elsewhere.py": """
+            import threading
+            import time
+
+            class X:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                def f(self):
+                    with self._lock:
+                        time.sleep(1)
+        """})
+        assert lock_discipline(idx) == []
+
+
+# ===========================================================================
+# jit-purity fixtures
+# ===========================================================================
+
+_JIT_HEADER = """
+            from functools import partial
+            import jax
+            import jax.numpy as jnp
+"""
+
+
+class TestJitPurity:
+    def test_content_derived_shape_flagged(self, tmp_path):
+        # THE fixture from the acceptance criteria: a victim count derived
+        # from data feeding a shape position
+        idx = build_tree(tmp_path, {"karmada_tpu/sched/core.py": _JIT_HEADER + """
+            @partial(jax.jit, static_argnames=())
+            def kernel(mask):
+                n_victims = int(mask.sum())
+                return jnp.zeros(n_victims, jnp.int32)
+        """})
+        found = jit_purity(idx)
+        assert len(found) == 1, messages(found)
+        assert "content-derived shape" in found[0].message
+        assert "kernel" in found[0].message
+
+    def test_bucket_lattice_and_static_argnames_are_legal(self, tmp_path):
+        idx = build_tree(tmp_path, {
+            "karmada_tpu/models/batch.py": """
+                def shape_bucket(n):
+                    return max(8, n)
+            """,
+            "karmada_tpu/sched/core.py": _JIT_HEADER + """
+                from ..models.batch import shape_bucket
+
+                @partial(jax.jit, static_argnames=("n_cols",))
+                def kernel(x, n_cols):
+                    B = x.shape[0]
+                    C = shape_bucket(n_cols)
+                    pad = jnp.zeros((B, C), jnp.int32)
+                    bcast = jnp.broadcast_to(x, (B, C))
+                    return pad + bcast
+            """})
+        assert jit_purity(idx) == [], messages(jit_purity(idx))
+
+    def test_host_sync_and_rng_clock_flagged(self, tmp_path):
+        idx = build_tree(tmp_path, {"karmada_tpu/sched/core.py": _JIT_HEADER + """
+            import random
+            import time
+            import numpy as np
+
+            @jax.jit
+            def kernel(x):
+                v = float(x.max())
+                w = x.sum().item()
+                h = np.asarray(x)
+                r = random.random()
+                t = time.time()
+                return v + w + r + t, h
+        """})
+        found = jit_purity(idx)
+        msgs = messages(found)
+        assert sum("host sync" in f.message for f in found) >= 3, msgs
+        assert any("random.random" in f.message for f in found), msgs
+        assert any("time.time" in f.message for f in found), msgs
+
+    def test_reachability_through_helpers(self, tmp_path):
+        # the violation sits in a helper the jitted seed calls — only
+        # reachable functions are scanned, unreachable ones are not
+        idx = build_tree(tmp_path, {"karmada_tpu/sched/core.py": _JIT_HEADER + """
+            import time
+
+            def helper(x):
+                return x * time.time()
+
+            def unreachable(x):
+                return x * time.time()
+
+            @jax.jit
+            def kernel(x):
+                return helper(x)
+        """})
+        found = jit_purity(idx)
+        assert len(found) == 1, messages(found)
+        assert "helper" in found[0].message
+        assert "unreachable" not in found[0].message
+
+    def test_float_of_constant_not_flagged(self, tmp_path):
+        idx = build_tree(tmp_path, {"karmada_tpu/sched/core.py": _JIT_HEADER + """
+            @jax.jit
+            def kernel(x):
+                return x * float(2)
+        """})
+        assert jit_purity(idx) == []
+
+
+# ===========================================================================
+# thread-hygiene fixtures
+# ===========================================================================
+
+
+class TestThreadHygiene:
+    def test_non_daemon_unjoined_thread_flagged(self, tmp_path):
+        idx = build_tree(tmp_path, {"karmada_tpu/runtime/bad.py": """
+            import threading
+
+            class D:
+                def start(self):
+                    self._t = threading.Thread(target=self._run)
+                    self._t.start()
+                def _run(self):
+                    pass
+        """})
+        found = thread_hygiene(idx)
+        assert len(found) == 1, messages(found)
+        assert "daemon=True" in found[0].message
+
+    def test_daemon_thread_ok(self, tmp_path):
+        idx = build_tree(tmp_path, {"karmada_tpu/runtime/ok.py": """
+            import threading
+
+            def go():
+                threading.Thread(target=print, daemon=True).start()
+        """})
+        assert thread_hygiene(idx) == []
+
+    def test_joined_on_close_path_ok(self, tmp_path):
+        idx = build_tree(tmp_path, {"karmada_tpu/runtime/joined.py": """
+            import threading
+
+            class D:
+                def start(self):
+                    self._t = threading.Thread(target=print)
+                    self._t.start()
+                def close(self):
+                    self._t.join(timeout=5.0)
+        """})
+        assert thread_hygiene(idx) == []
+
+    def test_unbounded_queue_and_deque_flagged(self, tmp_path):
+        idx = build_tree(tmp_path, {"karmada_tpu/runtime/q.py": """
+            import queue
+            from collections import deque
+
+            def make():
+                a = queue.Queue()                 # flagged
+                b = queue.Queue(maxsize=100)      # ok
+                c = deque()                       # flagged
+                d = deque(maxlen=512)             # ok
+                e = queue.SimpleQueue()           # flagged (by construction)
+                return a, b, c, d, e
+        """})
+        found = thread_hygiene(idx)
+        assert len(found) == 3, messages(found)
+        assert sum("unbounded queue.Queue" in f.message
+                   for f in found) == 1
+        assert sum("deque" in f.message for f in found) == 1
+        assert sum("SimpleQueue" in f.message for f in found) == 1
+
+    def test_aliased_import_resolved(self, tmp_path):
+        idx = build_tree(tmp_path, {"karmada_tpu/runtime/alias.py": """
+            import queue as queue_mod
+
+            def make():
+                return queue_mod.Queue()
+        """})
+        found = thread_hygiene(idx)
+        assert len(found) == 1, messages(found)
+
+
+# ===========================================================================
+# constant-drift fixtures
+# ===========================================================================
+
+
+class TestConstantDrift:
+    def test_duplicated_wire_constant_flagged(self, tmp_path):
+        idx = build_tree(tmp_path, {
+            "karmada_tpu/api/a.py": """
+                WORK_LABEL = "work.karmada.io/binding-name"
+            """,
+            "karmada_tpu/controllers/b.py": """
+                WORK_BINDING = "work.karmada.io/binding-name"
+            """,
+        })
+        found = constant_drift(idx)
+        assert len(found) == 1, messages(found)
+        assert "2 modules" in found[0].message
+        assert "work.karmada.io/binding-name" in found[0].message
+
+    def test_reexport_by_name_is_legal(self, tmp_path):
+        idx = build_tree(tmp_path, {
+            "karmada_tpu/api/a.py": """
+                WORK_LABEL = "work.karmada.io/binding-name"
+            """,
+            "karmada_tpu/controllers/b.py": """
+                from ..api.a import WORK_LABEL
+
+                WORK_BINDING = WORK_LABEL
+            """,
+        })
+        assert constant_drift(idx) == []
+
+    def test_non_wire_literals_ignored(self, tmp_path):
+        idx = build_tree(tmp_path, {
+            "karmada_tpu/a.py": 'ADDED = "ADDED"\n',
+            "karmada_tpu/b.py": 'ADDED = "ADDED"\n',
+        })
+        assert constant_drift(idx) == []
+
+    def test_route_metric_and_header_literals_are_wire(self, tmp_path):
+        idx = build_tree(tmp_path, {
+            "karmada_tpu/a.py": textwrap.dedent("""
+                ROUTE = "/objects/batch"
+                METRIC = "karmada_watch_clients"
+                HEADER = "X-Karmada-Trace"
+            """),
+            "karmada_tpu/b.py": textwrap.dedent("""
+                R2 = "/objects/batch"
+                M2 = "karmada_watch_clients"
+                H2 = "X-Karmada-Trace"
+            """),
+        })
+        found = constant_drift(idx)
+        assert len(found) == 3, messages(found)
+
+
+# ===========================================================================
+# the repo itself: zero non-baselined findings, baseline exact (the ratchet)
+# ===========================================================================
+
+
+class TestRepoClean:
+    def test_all_four_analyzers_clean_against_baseline(self):
+        root = repo_root()
+        _index, findings = run_repo(root)
+        baseline = load_baseline(baseline_path(root))
+        result = ratchet(findings, baseline)
+        assert result.ok, result.render()
+
+    def test_baseline_entries_all_carry_reasons(self):
+        baseline = load_baseline(baseline_path(repo_root()))
+        assert baseline, "baseline exists and parses"
+        for e in baseline:
+            assert e.reason and "UNREVIEWED" not in e.reason, (
+                f"baseline entry without a reviewed reason: {e}")
+
+
+# ===========================================================================
+# ratchet mechanics (injected violation pinned via fixture)
+# ===========================================================================
+
+
+class TestRatchet:
+    def _findings_with_injection(self, tmp_path):
+        idx = build_tree(tmp_path, {"karmada_tpu/store/injected.py": """
+            import threading
+            import time
+
+            class S:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                def f(self):
+                    with self._lock:
+                        time.sleep(1)   # the injected violation
+        """})
+        return run_analyzers(idx, default_analyzers())
+
+    def test_injected_violation_is_a_new_finding(self, tmp_path):
+        findings = self._findings_with_injection(tmp_path)
+        result = ratchet(findings, [])
+        assert not result.ok
+        assert len(result.new) == 1
+        assert "time.sleep" in result.new[0].message
+
+    def test_stale_baseline_entry_fails(self, tmp_path):
+        # baseline the injection, then "fix" it: the entry must go stale
+        findings = self._findings_with_injection(tmp_path)
+        bpath = tmp_path / "baseline.json"
+        save_baseline(bpath, findings, default_reason="fixture")
+        baseline = load_baseline(bpath)
+        assert ratchet(findings, baseline).ok
+        result = ratchet([], baseline)       # violation fixed
+        assert not result.ok and len(result.stale) == 1
+
+    def test_update_baseline_preserves_reasons(self, tmp_path):
+        findings = self._findings_with_injection(tmp_path)
+        bpath = tmp_path / "baseline.json"
+        save_baseline(bpath, findings, default_reason="reviewed: fixture")
+        # rewrite with the same findings: the reason must survive
+        save_baseline(bpath, findings, old=load_baseline(bpath))
+        data = json.loads(bpath.read_text())
+        assert data["entries"][0]["reason"] == "reviewed: fixture"
+
+    def test_reasonless_baseline_entry_rejected(self, tmp_path):
+        bpath = tmp_path / "baseline.json"
+        bpath.write_text(json.dumps({"entries": [
+            {"rule": "lock-discipline", "file": "x.py", "message": "m",
+             "reason": ""}]}))
+        with pytest.raises(ValueError, match="reason"):
+            load_baseline(bpath)
+
+
+# ===========================================================================
+# lock-order watchdog (KARMADA_TPU_LOCKCHECK=1)
+# ===========================================================================
+
+
+class TestLockOrderWatchdog:
+    def test_env_gate(self, monkeypatch):
+        monkeypatch.delenv(lockorder.ENV_GATE, raising=False)
+        lock = make_lock("gate-test")
+        assert not isinstance(lock, CheckedLock)
+        monkeypatch.setenv(lockorder.ENV_GATE, "1")
+        lock = make_lock("gate-test")
+        assert isinstance(lock, CheckedLock)
+
+    def test_known_abba_fixture_caught(self):
+        wd = LockOrderWatchdog()
+        a = CheckedLock("fixture.A", wd=wd)
+        b = CheckedLock("fixture.B", wd=wd)
+
+        def ab():
+            with a:
+                with b:
+                    pass
+
+        def ba():
+            with b:
+                with a:
+                    pass
+
+        t1 = threading.Thread(target=ab)
+        t1.start(); t1.join()
+        t2 = threading.Thread(target=ba)
+        t2.start(); t2.join()
+        with pytest.raises(AssertionError, match="fixture.A"):
+            wd.assert_acyclic()
+        assert wd.violations and "fixture.B" in wd.violations[0].cycle
+
+    def test_reentrant_hold_records_no_self_edge(self):
+        wd = LockOrderWatchdog()
+        a = CheckedLock("re.A", wd=wd, rlock=True)
+        with a:
+            with a:
+                pass
+        assert wd.edge_list() == []
+        wd.assert_acyclic()
+
+    def test_condition_wait_keeps_stack_consistent(self):
+        wd = LockOrderWatchdog()
+        cv = threading.Condition(CheckedLock("cv.lock", wd=wd))
+        other = CheckedLock("cv.other", wd=wd)
+
+        def waiter():
+            with cv:
+                cv.wait(timeout=0.5)
+                # post-wait: the lock is re-held; acquiring another lock
+                # must record cv.lock -> cv.other, nothing weirder
+                with other:
+                    pass
+
+        def notifier():
+            with cv:
+                cv.notify_all()
+
+        t = threading.Thread(target=waiter)
+        t.start()
+        threading.Thread(target=notifier).start()
+        t.join()
+        assert ("cv.lock", "cv.other") in wd.edge_list()
+        wd.assert_acyclic()
+
+    def test_concurrent_store_watch_coalescer_paths_acyclic(
+            self, monkeypatch):
+        """THE acceptance run: batch write + watch fan-out + coalescer
+        flush concurrently against instrumented store/watch-cache/
+        coalescer locks; the recorded acquisition graph must be acyclic
+        (and must actually contain the store->watch-cache edge, proving
+        the instrumentation saw the multi-lock path)."""
+        monkeypatch.setenv(lockorder.ENV_GATE, "1")
+        from karmada_tpu.api.cluster import Cluster
+        from karmada_tpu.api.meta import ObjectMeta
+        from karmada_tpu.store.batching import WriteCoalescer
+        from karmada_tpu.store.store import Store
+        from karmada_tpu.store.watchcache import WatchCache
+
+        watchdog.reset()
+        store = Store()
+        assert isinstance(store._lock, CheckedLock)
+        cache = WatchCache(store)
+        cache.attach()
+        co = WriteCoalescer(store, flush_delay=0.005)
+        stop = threading.Event()
+        errors: list[BaseException] = []
+
+        def guard(fn):
+            def run():
+                try:
+                    fn()
+                except BaseException as e:  # noqa: BLE001
+                    errors.append(e)
+            return run
+
+        def batch_writer():
+            for i in range(30):
+                store.apply(Cluster(metadata=ObjectMeta(name=f"c{i}")))
+            store.update_batch(
+                [Cluster(metadata=ObjectMeta(name=f"c{i}"))
+                 for i in range(30)],
+                skip_missing=True, skip_stale=True)
+
+        def watch_fanout():
+            seen = []
+            store.watch_all(lambda k, e, o: seen.append(e), replay=True)
+            while not stop.is_set():
+                cache.wait(cache.current_rv, timeout=0.01)
+
+        def coalescer_flush():
+            for i in range(30):
+                co.apply(Cluster(metadata=ObjectMeta(name=f"d{i}")))
+            co.flush()
+
+        threads = [threading.Thread(target=guard(f), daemon=True)
+                   for f in (batch_writer, watch_fanout, coalescer_flush)]
+        for t in threads:
+            t.start()
+        threads[0].join(30)
+        threads[2].join(30)
+        stop.set()
+        threads[1].join(30)
+        co.close()
+        assert not errors, errors
+        edges = watchdog.edge_list()
+        assert ("store._lock", "watchcache._cond") in edges, edges
+        watchdog.assert_acyclic()
+        watchdog.reset()
+
+
+# ===========================================================================
+# CLI / script surface
+# ===========================================================================
+
+
+class TestAnalysisCli:
+    def test_main_exits_zero_on_clean_repo(self, capsys):
+        from karmada_tpu.analysis.__main__ import main
+
+        assert main([]) == 0
+        out = capsys.readouterr().out
+        assert "analysis clean" in out
+
+    def test_main_exits_nonzero_on_new_finding(self, tmp_path, capsys):
+        build_tree(tmp_path, {"karmada_tpu/store/injected.py": """
+            import threading
+            import time
+
+            class S:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                def f(self):
+                    with self._lock:
+                        time.sleep(1)
+        """})
+        from karmada_tpu.analysis.__main__ import main
+
+        assert main(["--root", str(tmp_path)]) == 1
+        assert "NEW finding" in capsys.readouterr().out
+
+    def test_update_baseline_roundtrip(self, tmp_path, capsys):
+        build_tree(tmp_path, {"karmada_tpu/store/injected.py": """
+            import threading
+            import time
+
+            class S:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                def f(self):
+                    with self._lock:
+                        time.sleep(1)
+        """})
+        from karmada_tpu.analysis.__main__ import main
+
+        assert main(["--root", str(tmp_path), "--update-baseline"]) == 0
+        # the stamped entry is UNREVIEWED: load_baseline accepts it (a
+        # reason exists) but the repo test above forbids shipping it
+        assert main(["--root", str(tmp_path)]) == 0
+
+
+@pytest.mark.slow
+class TestLintSmokeScript:
+    def test_lint_smoke(self):
+        """scripts/lint.sh: the standalone analyzer suite over the repo —
+        exit 0 and the ANALYSIS OK trailer on a clean tree."""
+        import os
+        import subprocess
+
+        repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        r = subprocess.run(
+            ["bash", "scripts/lint.sh"],
+            capture_output=True, text=True, timeout=300, cwd=repo,
+        )
+        assert r.returncode == 0, r.stdout + r.stderr
+        assert "ANALYSIS OK" in r.stdout

@@ -757,48 +757,47 @@ class TestHistogramExemplars:
 
 # ===========================================================================
 # Metrics catalog static check: every registered metric is unique, follows
-# the karmada_* convention, and is documented in docs/OBSERVABILITY.md
+# the karmada_* convention, and is documented in docs/OBSERVABILITY.md.
+# Ported onto the shared analysis framework (karmada_tpu/analysis/) — the
+# metrics-catalog, constant-drift, and future rules share ONE module index
+# instead of three ad-hoc ast.parse passes; the deep coverage of the rule
+# itself lives in tests/test_analysis.py.
 # ===========================================================================
 
 
 class TestMetricsCatalog:
-    @staticmethod
-    def _registered_names():
-        import ast
+    _cached_index = None
+
+    @classmethod
+    def _index(cls):
         import pathlib
 
-        src = (pathlib.Path(__file__).resolve().parents[1]
-               / "karmada_tpu" / "metrics.py").read_text()
-        names = []
-        for node in ast.walk(ast.parse(src)):
-            if (isinstance(node, ast.Call)
-                    and isinstance(node.func, ast.Attribute)
-                    and isinstance(node.func.value, ast.Name)
-                    and node.func.value.id == "registry"
-                    and node.func.attr in ("counter", "gauge", "histogram")
-                    and node.args
-                    and isinstance(node.args[0], ast.Constant)):
-                names.append(node.args[0].value)
-        return names
+        from karmada_tpu.analysis import ModuleIndex
+
+        if cls._cached_index is None:
+            cls._cached_index = ModuleIndex(
+                pathlib.Path(__file__).resolve().parents[1])
+        return cls._cached_index
 
     def test_names_unique_and_conventional(self):
-        import re
+        from karmada_tpu.analysis.constant_drift import (
+            metrics_catalog_findings, registered_metric_names)
 
-        names = self._registered_names()
+        index = self._index()
+        names = [n for n, _line in registered_metric_names(index)]
         assert len(names) >= 40  # the catalog exists and parsing worked
-        dupes = {n for n in names if names.count(n) > 1}
-        assert not dupes, f"duplicate metric names: {dupes}"
-        bad = [n for n in names
-               if not re.fullmatch(r"karmada_[a-z0-9_]+", n)]
-        assert not bad, f"metric names off the karmada_* convention: {bad}"
+        bad = [f for f in metrics_catalog_findings(index)
+               if "registered twice" in f.message
+               or "convention" in f.message]
+        assert not bad, "\n".join(f.render() for f in bad)
 
     def test_every_metric_documented_in_observability_md(self):
-        import pathlib
+        from karmada_tpu.analysis.constant_drift import (
+            metrics_catalog_findings)
 
-        doc = (pathlib.Path(__file__).resolve().parents[1]
-               / "docs" / "OBSERVABILITY.md").read_text()
-        missing = [n for n in self._registered_names()
-                   if f"`{n}`" not in doc]
+        missing = [f for f in metrics_catalog_findings(self._index())
+                   if "not documented" in f.message]
         assert not missing, (
             "metrics registered in metrics.py but absent from the "
-            f"docs/OBSERVABILITY.md catalog: {missing}")
+            "docs/OBSERVABILITY.md catalog:\n"
+            + "\n".join(f.render() for f in missing))
