@@ -3344,6 +3344,181 @@ def _preempt_committed() -> float:
     return preemptions_total.value(outcome="committed")
 
 
+# -- candidates: top-K sparsified solve vs exact dense ----------------------
+#
+# The ISSUE grid is B in {1k,10k,100k} x C in {1k,5k}; the CPU fallback
+# trims to the smallest point so a tunnel-down run still yields per-leg
+# regression signal in seconds, not hours.
+CANDIDATES_SHAPES_TPU = [
+    (1_000, 1_000), (10_000, 1_000), (100_000, 1_000),
+    (1_000, 5_000), (10_000, 5_000), (100_000, 5_000),
+]
+CANDIDATES_SHAPES_CPU = [(1_000, 1_000)]
+CANDIDATES_EPS = 0.01        # placed-replica delta tolerance (quality leg)
+CANDIDATES_SPEEDUP_TPU = 3.0  # criterion at the largest (100k x 5k) point
+CANDIDATES_SPEEDUP_CPU = 1.1  # sanity floor on the cpu proxy shape
+
+
+def run_candidates(args, backend_label: str, on_tpu: bool,
+                   verbose=False) -> dict:
+    """The `candidates` config: exact-dense [B, C] vs top-K compact [B, K]
+    solve (sched/candidates.py, docs/PERF.md "Candidate sparsification").
+    Four legs:
+
+      timing    dense vs top-K round p99 per grid shape, fully-feasible
+                fleet (maximum truncation pressure — the honest worst
+                case); speedup is judged at the LARGEST shape run
+      quality   same rounds' total placed replicas; the compact solve may
+                redistribute but must not strand demand (delta <= eps)
+      parity    affinity-narrowed rounds whose feasible sets fit K must
+                decode BIT-IDENTICAL to dense
+      compiles  a second round whose real candidate count drifts inside
+                the same shape_bucket(K) bucket must trigger zero XLA
+                compiles, and the timed iterations themselves stay
+                compile-free
+
+    The JSON line asserts pass_speedup / pass_parity / pass_compiles."""
+    import random as _random
+
+    from karmada_tpu.models.batch import shape_bucket
+    from karmada_tpu.sched import compilecache
+    from karmada_tpu.sched.core import ArrayScheduler
+    from karmada_tpu.testing.fixtures import synthetic_fleet
+
+    shapes = CANDIDATES_SHAPES_TPU if on_tpu else CANDIDATES_SHAPES_CPU
+    iters = min(args.iters, 5) if on_tpu else 2
+
+    def det(rb):
+        rb.metadata.uid = f"bench-{rb.metadata.name}"
+        return rb
+
+    def p99_of(lat):
+        lat = sorted(lat)
+        return lat[min(len(lat) - 1, int(np.ceil(0.99 * len(lat))) - 1)]
+
+    def placed_of(decisions):
+        return sum(t.replicas for d in decisions if d.ok
+                   for t in (d.targets or []))
+
+    def timed(sched, bindings):
+        lat = []
+        for _ in range(iters):
+            t0 = time.perf_counter()
+            sched.schedule(bindings)
+            lat.append(time.perf_counter() - t0)
+        return p99_of(lat)
+
+    shape_rows = []
+    steady_compiles = 0
+    parity_ok = True
+    drift_compiles = 0
+    candidate_k = 0
+    for si, (n_bindings, n_clusters) in enumerate(shapes):
+        clusters = synthetic_fleet(n_clusters, seed=0)
+        bindings = [
+            det(_binding(i, 1 + i % 20, _dyn_placement(i % 4 == 0),
+                         cpu=0.01))
+            for i in range(n_bindings)
+        ]
+        dense = ArrayScheduler(clusters, candidate_k=0)
+        comp = ArrayScheduler(clusters)
+        d_dec = dense.schedule(bindings)   # warm (compile) rounds,
+        c_dec = comp.schedule(bindings)    # unmeasured
+        candidate_k = comp.last_candidate_stats.get("candidate_k", 0)
+        pd, pc = placed_of(d_dec), placed_of(c_dec)
+        delta = abs(pc - pd) / max(pd, 1)
+        snap = compilecache.compile_counts()
+        dense_p99 = timed(dense, bindings)
+        topk_p99 = timed(comp, bindings)
+        steady_compiles += int(
+            compilecache.compile_delta(snap)["jit_compiles"])
+        shape_rows.append({
+            "shape": f"{n_bindings}rb_x_{n_clusters}c",
+            "dense_p99_s": round(dense_p99, 4),
+            "topk_p99_s": round(topk_p99, 4),
+            "speedup": round(dense_p99 / max(topk_p99, 1e-9), 2),
+            "replica_delta_frac": round(delta, 6),
+        })
+        if verbose:
+            print(f"# candidates {shape_rows[-1]['shape']}: dense "
+                  f"{dense_p99:.3f}s topk {topk_p99:.3f}s "
+                  f"({shape_rows[-1]['speedup']}x) delta={delta:.4f} "
+                  f"k={candidate_k}")
+
+        if si == 0:
+            names = [c.name for c in clusters]
+            rng = _random.Random(0)
+            # parity leg: feasible sets fit the window -> bit-identical
+            narrow = [
+                det(_binding(10_000_000 + i, 1 + i % 9,
+                             _dyn_placement(i % 3 == 0), cpu=0.01))
+                for i in range(256)
+            ]
+            for rb in narrow:
+                rb.spec.placement.cluster_affinity.cluster_names = \
+                    rng.sample(names, 32)
+            for a, b in zip(dense.schedule(narrow), comp.schedule(narrow)):
+                ta = None if a.targets is None else \
+                    [(t.name, t.replicas) for t in a.targets]
+                tb = None if b.targets is None else \
+                    [(t.name, t.replicas) for t in b.targets]
+                if (a.error, ta, sorted(a.feasible)) != \
+                        (b.error, tb, sorted(b.feasible)):
+                    parity_ok = False
+            # K-drift leg: real candidate count 90 -> 95 shares the
+            # shape_bucket bucket (96) -> zero new compiles
+            assert shape_bucket(90) == shape_bucket(95)
+
+            def drift_batch(popcount, tag):
+                out = []
+                for i in range(8):
+                    rb = det(_binding(f"{tag}-{i}", 2 + i,
+                                      _dyn_placement(), cpu=0.01))
+                    rb.spec.placement.cluster_affinity.cluster_names = \
+                        rng.sample(names, popcount if i == 0 else 16)
+                    out.append(rb)
+                return out
+
+            comp.schedule(drift_batch(90, 9_000_000))  # warm the bucket
+            snap = compilecache.compile_counts()
+            comp.schedule(drift_batch(95, 9_500_000))
+            drift_compiles = int(
+                compilecache.compile_delta(snap)["jit_compiles"])
+
+    last = shape_rows[-1]
+    threshold = CANDIDATES_SPEEDUP_TPU if on_tpu else CANDIDATES_SPEEDUP_CPU
+    max_delta = max(r["replica_delta_frac"] for r in shape_rows)
+    metric = f"candidates_topk_speedup_{last['shape']}"
+    rec = {
+        "metric": metric if on_tpu else f"{metric}_{backend_label}",
+        "value": last["speedup"], "unit": "x", "backend": backend_label,
+        "shapes": shape_rows,
+        "dense_p99_s": last["dense_p99_s"],
+        "topk_p99_s": last["topk_p99_s"],
+        "speedup": last["speedup"],
+        "candidate_k": int(candidate_k),
+        "replica_delta_frac": max_delta,
+        "steady_jit_compiles": steady_compiles,
+        "drift_jit_compiles": drift_compiles,
+        "pass_speedup": last["speedup"] >= threshold,
+        "pass_parity": parity_ok and max_delta <= CANDIDATES_EPS,
+        "pass_compiles": steady_compiles == 0 and drift_compiles == 0,
+    }
+    if not on_tpu:
+        rec["note"] = (
+            "cpu proxy shape; the 3x criterion targets the TPU grid — "
+            f"last TPU capture: {latest_capture_name()}"
+        )
+    rec["pass"] = (rec["pass_speedup"] and rec["pass_parity"]
+                   and rec["pass_compiles"])
+    if verbose:
+        print(f"# candidates: speedup {last['speedup']}x "
+              f"(criterion >= {threshold}x), max replica delta "
+              f"{max_delta}, steady compiles {steady_compiles}, "
+              f"drift compiles {drift_compiles} -> pass={rec['pass']}")
+    return rec
+
+
 def run_analysis(backend_label: str, verbose=False) -> dict:
     """The `analysis` config: the invariant analysis plane's cost and
     coverage (docs/ANALYSIS.md) — ONE full sweep of the four AST
@@ -3425,6 +3600,7 @@ CONFIGS = {
     "replica": (None, None),  # replicated store group; see run_replica
     "elastic": (None, None),  # closed-loop autoscaling replay; run_elastic
     "preempt": (None, None),  # workload-class scheduling; run_preempt
+    "candidates": (None, None),  # top-K vs dense solve; run_candidates
     "analysis": (None, None),  # invariant analysis sweep; run_analysis
     "flagship_cold": (build_flagship_cold, None),  # named after the shape
     "flagship": (build_flagship, None),  # metric name carries the shape
@@ -3433,7 +3609,7 @@ DEFAULT_ORDER = [
     "dup3", "static", "dynamic", "spread", "spread_skewed", "churn",
     "churn_incremental", "autoshard", "pipeline", "whatif", "degraded",
     "coldstart", "stream", "fanout", "writeload", "replica", "elastic",
-    "preempt", "analysis", "flagship_cold", "flagship",
+    "preempt", "candidates", "analysis", "flagship_cold", "flagship",
 ]
 
 
@@ -3492,6 +3668,12 @@ RESULT_SCHEMAS = {
                 "pass": "bool"},
     "preempt": {**_ENVELOPE, "pass_slo": "bool", "pass_preempted": "bool",
                 "pass_gang_o1": "bool", "pass": "bool"},
+    "candidates": {**_ENVELOPE, "shapes": "list", "dense_p99_s": "num",
+                   "topk_p99_s": "num", "speedup": "num",
+                   "candidate_k": "int", "replica_delta_frac": "num",
+                   "steady_jit_compiles": "int", "drift_jit_compiles": "int",
+                   "pass_speedup": "bool", "pass_parity": "bool",
+                   "pass_compiles": "bool", "pass": "bool"},
     "analysis": {**_ENVELOPE, "rules": "dict", "files_scanned": "int",
                  "findings_total": "int", "baseline_entries": "int",
                  "new_findings": "int", "stale_baseline": "int",
@@ -3904,6 +4086,19 @@ def run_bench(args) -> None:
                     f"{latest_capture_name()}"
                 )
             lines.append(_validated_line("preempt", rec))
+            continue
+        if name == "candidates":
+            try:
+                rec = run_candidates(args, backend, on_tpu,
+                                     verbose=args.verbose)
+            except Exception as e:  # noqa: BLE001 - one labeled error line
+                rec = {
+                    "metric": "candidates_topk_speedup",
+                    "value": None, "unit": "x", "backend": backend,
+                    "error": f"{type(e).__name__}: {e}"[:300],
+                }
+            # run_candidates labels the cpu-proxy metric itself
+            lines.append(_validated_line("candidates", rec))
             continue
         if name == "analysis":
             try:

@@ -34,6 +34,7 @@ RULE = "jit-purity"
 # modules whose jit-decorated functions seed the reachability walk
 DEFAULT_SEED_MODULES = (
     "karmada_tpu/sched/core.py",
+    "karmada_tpu/sched/candidates.py",
     "karmada_tpu/sched/preemption.py",
     "karmada_tpu/elastic/solver.py",
 )

@@ -508,6 +508,29 @@ faults_injected = registry.counter(
     "Fault-plan decisions that fired, by boundary and kind",
 )
 
+# candidate sparsification (sched/candidates.py — docs/PERF.md "Candidate
+# sparsification"): the top-K prepass compacts [B, C] solves to [B, K].
+# fallback_total counts rounds (or row subsets) that solved exact-dense
+# instead and why; truncations_total counts feasible clusters dropped by
+# the window on divided rows — the decision-quality early-warning signal
+# (0 means every compact solve was provably bit-identical to dense)
+candidate_k = registry.gauge(
+    "karmada_candidate_k",
+    "Effective top-K candidate window of the last compact round, by "
+    "shape_bucket bucket",
+)
+candidate_fallback = registry.counter(
+    "karmada_candidate_fallback_total",
+    "Schedule rounds (or spread-row subsets) that fell back to the exact "
+    "dense solve, by reason (small_fleet/spread_constraint/policy/"
+    "duplicated)",
+)
+candidate_truncations = registry.counter(
+    "karmada_candidate_truncations_total",
+    "Feasible clusters dropped by the top-K candidate window on divided "
+    "rows (nonzero means compact decisions may diverge from exact dense)",
+)
+
 
 class timed:
     """Context manager observing wall time into a histogram."""

@@ -411,7 +411,7 @@ def min_merge(estimates, replicas):
     return jnp.where(merged == I32_MAX, replicas[:, None], merged)
 
 
-def _host_dispense(weight, last, seeds, tgt, init):
+def _host_dispense(weight, last, seeds, tgt, init, col_ids=None):
     """take_by_weight as numpy over a row subset (same order semantics).
 
     The bonus set — the first `rem` columns by (weight desc, last desc, tie
@@ -420,7 +420,13 @@ def _host_dispense(weight, last, seeds, tgt, init):
     by (packed last/tie, col) and its first m members join. Tie values are
     computed only for tied columns (splitmix64 from the row seed — the same
     per-(binding, cluster) stream as models.batch.tie_matrix), so no [B,C]
-    tie matrix or packed key is ever materialized."""
+    tie matrix or packed key is ever materialized.
+
+    `col_ids` (i64[B,C], 0-based GLOBAL cluster indices, ascending per row)
+    remaps the tie stream for callers whose column axis is a COMPACT
+    candidate window (sched/candidates.py): the splitmix64 value belongs to
+    the global cluster index, not the window position, or compact and dense
+    rounds would break ties differently."""
     from ..models.batch import _mix64
 
     B, C = weight.shape
@@ -437,8 +443,9 @@ def _host_dispense(weight, last, seeds, tgt, init):
         bonus[b, less] = True
         m = kb - int(less.sum())
         t = np.flatnonzero(row1 == v1)
+        g = t if col_ids is None else col_ids[b, t]
         tie_vals = (
-            _mix64(np.uint64(seeds[b]) ^ (t.astype(np.uint64) + np.uint64(1)))
+            _mix64(np.uint64(seeds[b]) ^ (g.astype(np.uint64) + np.uint64(1)))
             >> np.uint64(33)
         ).astype(np.int64)
         k2 = (
@@ -469,6 +476,7 @@ def host_tail(
     fresh,  # bool[B]
     strategy_codes,  # (STATIC_WEIGHT, DYNAMIC_WEIGHT, AGGREGATED)
     topk: int,
+    col_ids=None,  # i64[B,C] global cluster ids when C is a compact window
 ):
     """The division tail as pure numpy — the CPU-backend twin of
     assignment_tail→combined_assign→take_by_weight (placement-identical;
@@ -502,7 +510,8 @@ def host_tail(
         last = np.where(feas, prev[rs], 0).astype(np.int32)
         tgt = replicas[rs].astype(np.int64)
         result[rs] = _host_dispense(
-            w, last, seeds[rs], tgt, np.zeros_like(last)
+            w, last, seeds[rs], tgt, np.zeros_like(last),
+            col_ids=None if col_ids is None else col_ids[rs],
         )
 
     # --- dynamic rows (assignment.go:208-239) ---
@@ -534,7 +543,10 @@ def host_tail(
             w = np.where(act[:, None] & ~keep, 0, w)
         last = np.where(up[:, None], prev_m, 0).astype(np.int32)
 
-        dispensed = _host_dispense(w, last, seeds[rd], tgt, init)
+        dispensed = _host_dispense(
+            w, last, seeds[rd], tgt, init,
+            col_ids=None if col_ids is None else col_ids[rd],
+        )
         res = np.where(eq[:, None], prev_m.astype(np.int32), dispensed)
         res = np.where(unsched[:, None], 0, res)
         result[rd] = res

@@ -231,6 +231,20 @@ def prewarm_schedule(
         _filter_kernel_compact.lower(
             *args, plugin_bits=sched._plugin_bits
         ).compile()
+        # top-K candidate sparsification (sched/candidates.py): fleets wider
+        # than the bucketed window dispatch the candidate prepass instead of
+        # the dense filter on every non-policy round — prewarm it at the
+        # same lattice points (the dense lowering above stays: policy
+        # opt-out rounds and spread wide-row fallbacks still dispatch it)
+        from . import candidates as cand_mod
+
+        compact = cand_mod.compact_width_ok(sched)
+        if compact:
+            cand_k = cand_mod.effective_k(sched, raw, C)
+            cand_mod._candidate_select_kernel.lower(
+                *args, k=cand_k, plugin_bits=sched._plugin_bits
+            ).compile()
+            stats["candidate_k"] = cand_k
         stats["row_buckets"].append(b)
         if sched._host_sorts:
             # cpu backend: the division tails run as the numpy host twins —
@@ -279,6 +293,24 @@ def prewarm_schedule(
                 topk=topk, narrow=narrow, has_agg=has_agg,
                 narrow16=narrow16,
             ).compile()
+            if compact:
+                # the compact division tail live rounds dispatch at this
+                # class split: [rows, K] windows + the global candidate
+                # index ([rows, K] i32)
+                win = lambda dt, n: jax.ShapeDtypeStruct(
+                    (n, cand_k), np.dtype(dt)
+                )
+                cand_mod._candidate_tail_kernel.lower(
+                    win(np.bool_, sp), win(np.int32, sp), win(np.int32, sp),
+                    win(np.int32, sp), win(np.int32, sp),
+                    batch.weight_tables,
+                    jax.ShapeDtypeStruct((sp,), batch.weight_idx.dtype),
+                    jax.ShapeDtypeStruct((sp,), batch.strategy.dtype),
+                    jax.ShapeDtypeStruct((sp,), batch.replicas.dtype),
+                    jax.ShapeDtypeStruct((sp,), batch.fresh.dtype),
+                    topk=topk, narrow=narrow, has_agg=has_agg,
+                    narrow16=narrow16,
+                ).compile()
     stats.update(compile_delta(snap))
     stats["aot_seconds"] = round(time.perf_counter() - t0, 3)
     return stats

@@ -1,0 +1,893 @@
+"""Top-K candidate sparsification: compact [B, K] solves with pinned
+exact-dense parity (ROADMAP item 3(i), docs/PERF.md "Candidate
+sparsification").
+
+The dense round solves every binding against every cluster column — [B, C]
+— and no bucketing saves that product at 1M x 50k. This module inserts a
+cheap fully-vectorized PREPASS (one device launch, elementwise masks +
+static score only) that picks each row's top-K candidate clusters via
+`jax.lax.top_k`, then compacts everything the expensive phases consume —
+estimator answers, previous placements, tie values, static weights,
+override masks — into [B, K] via gathers along the candidate index.
+Decisions scatter back to fleet indices on decode. Solve cost becomes
+O(B·K) after one O(B·C) elementwise pass.
+
+Correctness contract (tests/test_candidates.py):
+
+- **Feasibility-aware selection.** The top-K key is
+  `(feasible << 33) + score`, so EVERY feasible cluster outranks every
+  infeasible one — a row whose only feasible cluster scores below the
+  K-th raw static score still places. Whenever a row's feasible count fits
+  in K, its candidate window is a superset of its feasible set and the
+  compact solve is bit-identical to dense (infeasible filler candidates
+  are inert: zero weight, zero quota, bonus gated on weight > 0).
+- **Ascending candidate order.** Candidate windows are sorted ascending by
+  global cluster index, so every local-order tie-break (column iota in the
+  dispenser, Aggregated truncation keep-order) sees the same relative
+  order as the dense solve; splitmix64 tie VALUES are computed from global
+  indices (`_tie_at`, ops/assign.py `col_ids`).
+- **Exact-dense fallback.** Fleets where C <= shape_bucket(K) solve dense
+  (compaction would be a reorder, not a reduction), as do rounds whose
+  bindings carry the `karmada-tpu.io/dense-solve` annotation and spread
+  rows whose feasible set outruns the window (full-fleet visibility) —
+  each fallback is counted (`karmada_candidate_fallback_total{reason}`).
+- **Truncation is observable.** Rows solved through the window with
+  feasible count > K lose candidates — the dropped count feeds
+  `karmada_candidate_truncations_total` (the decision-quality
+  early-warning signal). Duplicated / non-workload rows never truncate:
+  their target set is the feasible set, decoded from complete packed
+  masks exactly as in the dense round.
+
+K is resolved once per scheduler (`candidate_k` ctor arg, else
+KARMADA_TPU_CANDIDATE_K, else 128; 0 disables) and bucketed per round on
+the `shape_bucket` lattice (`effective_k`), so content-derived K drift —
+e.g. the affinity-popcount shrink — never triggers fresh XLA compiles
+(the PR-13 recompile class; pinned in tests/test_candidates.py).
+"""
+from __future__ import annotations
+
+import logging
+import os
+from functools import partial
+from typing import Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..models.batch import (
+    AGGREGATED,
+    DUPLICATED,
+    DYNAMIC_WEIGHT,
+    NON_WORKLOAD,
+    STATIC_WEIGHT,
+    pow2_bucket,
+    shape_bucket,
+)
+from ..ops import assign as assign_ops
+from . import plugins as plugin_mod
+from . import core as core_mod
+from .core import (
+    TOPK_TARGETS,
+    ScheduleDecision,
+    _gather_rows_kernel,
+    _pad_extra_avail,
+    _pad_rows_idx,
+    _sorted_pairs,
+    assignment_tail,
+    compact_outputs,
+    fetch_rows,
+    filter_phase,
+)
+from .pipeline import stage_span
+
+log = logging.getLogger(__name__)
+
+# default candidate window: covers every row whose feasible set fits 128
+# clusters exactly; wider feasible sets solve over their 128 best-scored
+# feasible candidates (truncation-counted)
+CANDIDATE_K_DEFAULT = 128
+
+# per-policy opt-out: bindings carrying this annotation (value 1/true/yes/on)
+# pin their whole round to the exact dense solve
+DENSE_SOLVE_ANNOTATION = "karmada-tpu.io/dense-solve"
+
+_TRUTHY = ("1", "true", "yes", "on")
+
+
+def resolve_candidate_k(override: Optional[int] = None) -> int:
+    """THE candidate-window size: explicit override, else
+    KARMADA_TPU_CANDIDATE_K, else CANDIDATE_K_DEFAULT; 0 disables the
+    compact path entirely. Malformed env fails loudly (same contract as
+    resolve_max_bc_elems)."""
+    if override is not None:
+        val, src = int(override), "candidate_k override"
+    else:
+        env = os.environ.get("KARMADA_TPU_CANDIDATE_K", "")
+        if not env:
+            return CANDIDATE_K_DEFAULT
+        try:
+            val = int(env)
+        except ValueError:
+            raise ValueError(
+                f"KARMADA_TPU_CANDIDATE_K={env!r}: must be an integer"
+            ) from None
+        src = f"KARMADA_TPU_CANDIDATE_K={env!r}"
+    if val < 0:
+        raise ValueError(f"{src}: must be >= 0 (0 disables)")
+    return val
+
+
+def compact_width_ok(array) -> bool:
+    """Binding-free half of the gate (the AOT prewarm pass uses it): the
+    compact path only pays off when the bucketed window is strictly
+    narrower than the fleet."""
+    k = getattr(array, "candidate_k", 0)
+    return k > 0 and len(array.fleet.names) > shape_bucket(max(k, 8))
+
+
+def dense_reason(array, bindings) -> Optional[str]:
+    """Why this round must solve dense — None when the compact path
+    engages. "disabled" is configuration, not a fallback (no counter)."""
+    if getattr(array, "candidate_k", 0) <= 0:
+        return "disabled"
+    if not compact_width_ok(array):
+        return "small_fleet"
+    for rb in bindings:
+        md = getattr(rb, "metadata", None)
+        ann = getattr(md, "annotations", None)
+        if ann and ann.get(DENSE_SOLVE_ANNOTATION, "").lower() in _TRUTHY:
+            return "policy"
+    return None
+
+
+def note_fallback(reason: str, n: int = 1) -> None:
+    if reason == "disabled":
+        return  # configuration, not a fallback
+    from ..metrics import candidate_fallback
+
+    candidate_fallback.inc(n, reason=reason)
+
+
+def effective_k(array, raw, n_cols: int) -> int:
+    """Per-round effective window, ON THE shape_bucket LATTICE (K drift
+    inside a bucket never compiles a fresh program — the PR-13 recompile
+    class). With the ClusterAffinity plugin enabled, feasible ⊆ affinity
+    mask, so the batch's max affinity popcount is a lossless shrink."""
+    k = array.candidate_k
+    if (array._plugin_bits & plugin_mod.BIT_AFFINITY) and raw.aff_masks.size:
+        pc = raw.aff_masks.sum(axis=1)
+        bound = int(pc[raw.aff_idx].max(initial=0))
+        if 0 < bound < k:
+            k = bound
+    return min(shape_bucket(max(k, 8)), n_cols)
+
+
+def _tie_at(seeds, cand_idx):
+    """splitmix64 tie values AT the candidate positions — the same
+    per-(binding, global cluster) stream as core.tie_from_index, evaluated
+    elementwise over [B, K] instead of gathered from a [B, C] matrix."""
+    x = seeds[:, None] ^ (cand_idx.astype(jnp.uint64) + jnp.uint64(1))
+    x = (x ^ (x >> jnp.uint64(30))) * jnp.uint64(0xBF58476D1CE4E5B9)
+    x = (x ^ (x >> jnp.uint64(27))) * jnp.uint64(0x94D049BB133111EB)
+    x = x ^ (x >> jnp.uint64(31))
+    return (x >> jnp.uint64(33)).astype(jnp.int32)
+
+
+def _compact_estimate(
+    capacity, has_summary, req_unique, req_idx, replicas, unknown_request,
+    cand_idx, c_extra,
+):
+    """GeneralEstimator answers AT the candidate positions: the [U, C]
+    unique-request solve stays dense (U is the distinct-policy count, tiny),
+    rows double-gather [B, K]; the per-row clamps replicate
+    general_estimate_apply in the same order — bit-exact with the dense
+    form at every surviving position. c_extra is the registered-estimator
+    override already gathered to [B, K] (None skips the min-merge — the
+    speculative preemption pass models victim-freed capacity the
+    registered estimators cannot see)."""
+    est_u, any_u = assign_ops.general_estimate_unique(
+        capacity, has_summary, req_unique
+    )
+    est = est_u[req_idx[:, None], cand_idx]  # i64[B,K]
+    any_req = any_u[req_idx]
+    replicas64 = replicas.astype(jnp.int64)[:, None]
+    est = jnp.where(any_req[:, None], est, replicas64)
+    est = jnp.where(has_summary[cand_idx], est, 0)
+    est = jnp.where(
+        est >= assign_ops.I32_MAX.astype(jnp.int64), replicas64, est
+    )
+    c_avail = est.astype(jnp.int32)
+    c_avail = jnp.where(unknown_request[:, None], 0, c_avail)
+    if c_extra is not None:
+        c_avail = jnp.where(
+            c_extra >= 0, jnp.minimum(c_avail, c_extra), c_avail
+        )
+    return c_avail
+
+
+@partial(jax.jit, static_argnames=("k", "plugin_bits"))
+def _candidate_select_kernel(
+    # fleet (device-resident) — the signature tracks _filter_kernel_compact
+    # so ArrayScheduler.filter_kernel_args builds the args for both
+    alive, capacity, has_summary, taint_key, taint_value, taint_effect, api_ok,
+    replicas, unknown_request, gvk,
+    tol_tables, tol_idx,
+    aff_masks, aff_idx, prev_idx, prev_rep, evict_idx, seeds,
+    req_unique, req_idx,
+    extra_avail,
+    extra_mask, extra_score,
+    k: int = CANDIDATE_K_DEFAULT,
+    plugin_bits: int = plugin_mod.ALL_PLUGIN_BITS,
+):
+    """Phase 1 of the compact round: ONE elementwise [B, C] pass (filters +
+    static score, no estimator, no sorts beyond top_k), then everything the
+    later phases consume gathers to [B, K].
+
+    Selection key `(feasible << 33) + score`: feasible columns ALWAYS
+    outrank infeasible ones (score is i32, so the feasibility bit clears
+    any score), making the window a superset of the feasible set whenever
+    that set fits in K. Candidate indices are sorted ASCENDING per row —
+    the local-order tie-breaks downstream then match the dense solve.
+
+    Returns (cand_idx i32[B,K], c_feas, c_score, c_avail, c_prev, c_tie,
+    feas_count i32[B], packed u8[B,ceil(C/8)]); feas_count is the EXACT
+    dense count (FitError diagnosis and truncation accounting), packed is
+    the complete feasible bitmask (duplicated / non-workload rows decode
+    from it, windowless)."""
+    from . import spread_batch
+
+    B = replicas.shape[0]
+    C = alive.shape[0]
+    rows = jnp.arange(B)[:, None]
+    tol = tol_tables[tol_idx]  # [B,4,K]
+    affinity_ok = aff_masks[aff_idx]
+    p = jnp.where((prev_idx >= 0) & (prev_idx < C), prev_idx, C)
+    prev_member = jnp.zeros((B, C), bool).at[rows, p].set(True, mode="drop")
+    prev_replicas = (
+        jnp.zeros((B, C), jnp.int32).at[rows, p].set(prev_rep, mode="drop")
+    )
+    e = jnp.where((evict_idx >= 0) & (evict_idx < C), evict_idx, C)
+    eviction_ok = jnp.ones((B, C), bool).at[rows, e].set(False, mode="drop")
+    feasible, score = filter_phase(
+        alive, taint_key, taint_value, taint_effect, api_ok, gvk,
+        tol[:, 0], tol[:, 1], tol[:, 2], tol[:, 3],
+        affinity_ok, eviction_ok, prev_member,
+        plugin_bits=plugin_bits,
+        extra_mask=extra_mask, extra_score=extra_score,
+    )
+    key = (feasible.astype(jnp.int64) << 33) + score.astype(jnp.int64)
+    _, ti = jax.lax.top_k(key, k)
+    cand_idx = jnp.sort(ti, axis=-1).astype(jnp.int32)
+
+    def take(a):
+        return jnp.take_along_axis(a, cand_idx, axis=-1)
+
+    extra = jnp.broadcast_to(extra_avail, (B, C))
+    c_avail = _compact_estimate(
+        capacity, has_summary, req_unique, req_idx, replicas,
+        unknown_request, cand_idx, take(extra),
+    )
+    return (
+        cand_idx, take(feasible), take(score), c_avail,
+        take(prev_replicas), _tie_at(seeds, cand_idx),
+        feasible.sum(-1).astype(jnp.int32),
+        spread_batch._pack_bits(feasible),
+    )
+
+
+@partial(jax.jit, static_argnames=("topk", "narrow", "has_agg", "narrow16"))
+def _candidate_tail_kernel(
+    c_feas, c_avail, c_prev, c_tie, cand_idx,  # gathered [rows, K] windows
+    weight_tables, weight_idx, strategy, replicas, fresh,
+    topk: int, narrow: bool, has_agg: bool, narrow16: bool = False,
+):
+    """Division tail over compact candidate windows — _tail_kernel with the
+    column axis narrowed from C to K. Static weights gather directly to
+    [rows, K] (never materializing [rows, C]); the compact output window's
+    indices map back to GLOBAL cluster ids through cand_idx, so decode is
+    identical to the dense tail's."""
+    static_weight = weight_tables[weight_idx[:, None], cand_idx]
+    result, unschedulable, avail_sum = assignment_tail(
+        c_feas, strategy, static_weight, c_avail, c_prev, c_tie,
+        replicas, fresh, narrow=narrow, has_agg=has_agg,
+    )
+    K = c_feas.shape[1]
+    _, nnz, l_idx, top_val = compact_outputs(c_feas, result, min(K, topk))
+    top_idx = jnp.take_along_axis(cand_idx, l_idx, axis=-1)
+    if narrow16:
+        top_idx = top_idx.astype(jnp.int16)
+        top_val = top_val.astype(jnp.int16)
+    return result, unschedulable, avail_sum, nnz, top_idx, top_val
+
+
+def _host_tail_compact(batch, rows_idx, nr, h_feas, h_avail, h_prev, h_cand,
+                       topk: int):
+    """The cpu-backend host-tail twin over compact windows: ops/assign.py
+    host_tail with `col_ids` carrying the global candidate indices (tie
+    parity), static weights fancy-gathered to [rows, K]. Returns the
+    device-tail tuple shape with top_idx already GLOBAL."""
+    rsub = np.asarray(rows_idx, np.int64)[:nr]
+    h_feas = np.asarray(h_feas)[:nr]
+    h_avail = np.asarray(h_avail)[:nr]
+    h_prev = np.asarray(h_prev)[:nr]
+    h_cand = np.asarray(h_cand)[:nr].astype(np.int64)
+    wt = np.asarray(batch.weight_tables)
+    widx = np.asarray(batch.weight_idx)[rsub]
+    w_compact = wt[widx[:, None], h_cand]
+    result, unsched, avail_sum, nnz, l_idx, top_val = assign_ops.host_tail(
+        h_feas, h_avail, h_prev, np.asarray(batch.seeds)[rsub], w_compact,
+        np.asarray(batch.strategy)[rsub], np.asarray(batch.replicas)[rsub],
+        np.asarray(batch.fresh)[rsub],
+        (STATIC_WEIGHT, DYNAMIC_WEIGHT, AGGREGATED),
+        topk=topk, col_ids=h_cand,
+    )
+    top_idx = np.take_along_axis(
+        h_cand, l_idx.astype(np.int64), axis=1
+    ).astype(np.int32)
+    return result, h_cand, (unsched, avail_sum, nnz, top_idx, top_val)
+
+
+# --------------------------------------------------------------------------
+# the compact round (launch / materialize pair — same seam as the dense
+# partitioned round, so the pipeline and daemon drive it unchanged)
+# --------------------------------------------------------------------------
+
+
+def launch_candidates(array, bindings: Sequence, extra_avail=None,
+                      term_indices=None) -> dict:
+    """LAUNCH half of the compact round — the gather/scatter analogue of
+    ArrayScheduler._launch_once_partitioned: classify + permute rows by
+    class, encode, run the candidate prepass (ONE [B,C] elementwise
+    launch), then dispatch every phase-2 consumer over [B, K] windows. No
+    device sync here."""
+    n_real = len(bindings)
+    if n_real == 0:
+        return {"candidates": True, "n_real": 0}
+    names = array.fleet.names
+    C = len(names)
+    timer = array.stage_timer
+
+    with stage_span("encode", timer):
+        pre_b, _pre_cfg, pre_f = array._classify_spread(bindings)
+        spread_set = set(pre_b) | set(pre_f)
+        cls = np.asarray(
+            [array._row_class(rb, b in spread_set)
+             for b, rb in enumerate(bindings)],
+            np.int8,
+        )
+        order = np.argsort(cls, kind="stable")
+        bindings = [bindings[i] for i in order]
+        cls = cls[order]
+        if term_indices is not None:
+            term_indices = [term_indices[i] for i in order]
+        if extra_avail is not None:
+            extra_avail = extra_avail[order]
+        # re-derive spread rows in permuted space (placement-only, cheap)
+        perm_b, _cfg, perm_f = array._classify_spread(bindings)
+        spread_rows = sorted(set(perm_b) | set(perm_f))
+
+        with array._encode_lock:
+            raw = array.batch_encoder.encode(bindings, term_indices=term_indices)
+        batch = array._pad(raw)
+        if extra_avail is not None:
+            extra_avail = _pad_extra_avail(extra_avail, C, len(batch.replicas))
+        extra_mask, extra_score = array._plugin_terms(
+            bindings, len(batch.replicas)
+        )
+        _, narrow, _ = array._batch_flags(batch)
+        narrow16 = C < 2**15 and int(raw.replicas.max(initial=0)) < 2**15
+        k = effective_k(array, raw, C)
+
+    with stage_span("solve", timer):
+        sel = _candidate_select_kernel(
+            *array.filter_kernel_args(batch, extra_avail, extra_mask,
+                                      extra_score),
+            k=k, plugin_bits=array._plugin_bits,
+        )
+        (cand_idx, c_feas, c_score, c_avail, c_prev, c_tie, dev_fc,
+         dev_packed) = sel
+
+        from ..metrics import candidate_k as candidate_k_gauge
+
+        candidate_k_gauge.set(float(k), bucket=str(k))
+
+        # ---- phase 2: division tails per sub-class over [rows, K] ----
+        tails = []
+        for want_cls, has_agg in ((1, False), (2, True)):
+            rows = [b for b in range(n_real) if cls[b] == want_cls]
+            if not rows:
+                continue
+            idx_pad, nr = _pad_rows_idx(rows, array._bucket)
+            rsel = idx_pad.astype(np.int64)
+            t_feas = _gather_rows_kernel(c_feas, idx_pad)
+            t_avail = _gather_rows_kernel(c_avail, idx_pad)
+            t_prev = _gather_rows_kernel(c_prev, idx_pad)
+            t_cand = _gather_rows_kernel(cand_idx, idx_pad)
+            max_repl = int(raw.replicas[rows].max(initial=0))
+            topk = min(
+                pow2_bucket(min(max_repl, TOPK_TARGETS), lo=8), TOPK_TARGETS
+            )
+            # the host-twin gate keys on the DENSE volume the dense round
+            # would have sorted — compact and dense rounds then route the
+            # same sub-batches to the same twin, keeping the parity
+            # surfaces aligned (the twin itself runs over [rows, K])
+            if array._host_sorts and (
+                len(rows) * C >= core_mod.HOST_TAIL_MIN_ELEMS
+                or array._overlap_active
+            ):
+                tails.append({
+                    "kind": "host", "rows": rows, "idx_pad": idx_pad,
+                    "nr": nr, "t_feas": t_feas, "t_avail": t_avail,
+                    "t_prev": t_prev, "t_cand": t_cand, "topk": topk,
+                })
+            else:
+                t_tie = _gather_rows_kernel(c_tie, idx_pad)
+                t_out = _candidate_tail_kernel(
+                    t_feas, t_avail, t_prev, t_tie, t_cand,
+                    batch.weight_tables, batch.weight_idx[rsel],
+                    batch.strategy[rsel], batch.replicas[rsel],
+                    batch.fresh[rsel],
+                    topk=topk, narrow=narrow, has_agg=has_agg,
+                    narrow16=narrow16,
+                )
+                tails.append({
+                    "kind": "dev", "rows": rows, "t_out": t_out,
+                    "t_cand": t_cand,
+                })
+
+        # ---- phase 2: duplicated / non-workload packed feasible masks ----
+        spread_perm = set(spread_rows)
+        mask_rows = [
+            b for b in range(n_real)
+            if cls[b] == 0 and b not in spread_perm
+        ]
+        mask_pack = None
+        nm = 0
+        if mask_rows:
+            mask_idx, nm = _pad_rows_idx(mask_rows, array._bucket)
+            mask_pack = _gather_rows_kernel(dev_packed, mask_idx)
+
+        # ---- phase 2: spread rows' candidate windows (selection runs on
+        # host at materialize over these compact gathers) ----
+        spread_fetch = None
+        ns = 0
+        if spread_rows:
+            s_idx, ns = _pad_rows_idx(spread_rows, array._bucket)
+            spread_fetch = tuple(
+                _gather_rows_kernel(a, s_idx)
+                for a in (cand_idx, c_feas, c_score, c_avail, c_prev, c_tie)
+            )
+
+    return {
+        "candidates": True, "bindings": bindings, "raw": raw, "batch": batch,
+        "cls": cls, "order": order, "n_real": n_real,
+        "extra_avail": extra_avail, "term_indices": term_indices,
+        "narrow": narrow, "narrow16": narrow16, "k": k,
+        "dev_fc": dev_fc, "tails": tails,
+        "mask_rows": mask_rows, "mask_pack": mask_pack, "nm": nm,
+        "spread_rows": spread_rows, "spread_fetch": spread_fetch, "ns": ns,
+    }
+
+
+def materialize_candidates(array, p: dict) -> list[ScheduleDecision]:
+    """MATERIALIZE half: ONE device→host sync, deferred host-sort twins,
+    candidate-set spread selection, decode, unpermute."""
+    if p["n_real"] == 0:
+        return []
+    with stage_span("materialize", array.stage_timer):
+        return _materialize_inner(array, p)
+
+
+def _materialize_inner(array, p: dict) -> list[ScheduleDecision]:
+    bindings, raw, batch = p["bindings"], p["raw"], p["batch"]
+    cls, order, n_real = p["cls"], p["order"], p["n_real"]
+    k, narrow = p["k"], p["narrow"]
+    tails, mask_rows, spread_rows = p["tails"], p["mask_rows"], p["spread_rows"]
+    names = array.fleet.names
+    C = len(names)
+
+    unsched = np.zeros(n_real, bool)
+    avail_sum = np.zeros(n_real, np.int64)
+    feas_count_ovr: dict[int, int] = {}
+    row_err: dict[int, str] = {}
+    row_target_src: dict[int, tuple] = {}
+    row_feas_src: dict[int, tuple] = {}
+    wide_dec: dict[int, ScheduleDecision] = {}
+
+    # ---- THE sync ----
+    host = jax.device_get((
+        p["dev_fc"],
+        [t["t_out"][1:] for t in tails if t["kind"] == "dev"],
+        p["mask_pack"],
+        p["spread_fetch"],
+        [(t["t_feas"], t["t_avail"], t["t_prev"], t["t_cand"])
+         for t in tails if t["kind"] == "host"],
+    ))
+    feas_count = np.asarray(host[0])[:n_real].astype(np.int64)
+
+    # truncation accounting: only rows solved THROUGH the window can drop
+    # feasible candidates (divided rows; spread rows wider than the window
+    # fall back dense instead and are fallback-counted)
+    div_rows = cls > 0
+    trunc = int(np.maximum(feas_count[div_rows] - k, 0).sum()) if (
+        div_rows.any()
+    ) else 0
+    if trunc:
+        from ..metrics import candidate_truncations
+
+        candidate_truncations.inc(trunc)
+    array.last_candidate_stats = {
+        "candidate_k": k, "candidate_truncations": trunc,
+    }
+
+    # ---- division tails (device outputs + deferred host twins) ----
+    dev_vals = iter(host[1])
+    host_inputs = iter(host[4])
+    decoded_tails = []  # (rows, result_src, cand_src, vals)
+    for t in tails:
+        if t["kind"] == "dev":
+            decoded_tails.append(
+                (t["rows"], t["t_out"][0], t["t_cand"], next(dev_vals))
+            )
+            continue
+        h_feas, h_avail, h_prev, h_cand = next(host_inputs)
+        result, h_cand64, vals = _host_tail_compact(
+            batch, t["idx_pad"], t["nr"], h_feas, h_avail, h_prev, h_cand,
+            t["topk"],
+        )
+        decoded_tails.append((t["rows"], result, h_cand64, vals))
+
+    for rows, res_src, cand_src, vals in decoded_tails:
+        t_unsched, t_asum, t_nnz, t_ti, t_tv = vals  # t_ti is GLOBAL
+        tis, tvs = _sorted_pairs(np.asarray(t_ti), np.asarray(t_tv))
+        overflow = []
+        for j, b in enumerate(rows):
+            unsched[b] = bool(t_unsched[j])
+            avail_sum[b] = int(t_asum[j])
+            n = int(t_nnz[j])
+            if n > t_ti.shape[1]:
+                overflow.append((j, b))
+                continue
+            row_target_src[b] = ("pairs", names, tis[j, :n], tvs[j, :n])
+        if overflow:
+            ks = [j for j, _ in overflow]
+            if isinstance(res_src, np.ndarray):  # host twin: no fetch
+                o_res, o_cand = res_src[ks], np.asarray(cand_src)[ks]
+            else:
+                o_res = fetch_rows(res_src, ks, array._bucket)
+                o_cand = fetch_rows(cand_src, ks, array._bucket)
+            for m, (_, b) in enumerate(overflow):
+                pos = np.nonzero(o_res[m] > 0)[0]
+                row_target_src[b] = (
+                    "pairs", names, o_cand[m, pos].astype(np.int64),
+                    o_res[m, pos].astype(np.int64),
+                )
+
+    # ---- duplicated / non-workload rows: complete packed masks ----
+    if mask_rows:
+        packed_h = np.asarray(host[2])[: p["nm"]]
+        for j, b in enumerate(mask_rows):
+            if feas_count[b] <= 0:
+                continue  # FitError branch
+            strat = int(raw.strategy[b])
+            reps = (
+                0 if strat == NON_WORKLOAD
+                else int(bindings[b].spec.replicas)
+            )
+            row_feas_src[b] = ("mask", names, packed_h[j], C)
+            row_target_src[b] = ("mask", names, packed_h[j], C, reps)
+
+    # ---- spread rows: exact per-row selection over the candidate set ----
+    if spread_rows:
+        self_dec = _spread_over_candidates(
+            array, p, bindings, raw, batch, spread_rows, host[3], feas_count,
+            unsched, avail_sum, feas_count_ovr, row_err, row_target_src,
+            row_feas_src,
+        )
+        wide_dec.update(self_dec)
+
+    # ---- build decisions, then unpermute ----
+    dec_p: list[ScheduleDecision] = []
+    for b, key in enumerate(raw.keys):
+        if b in wide_dec:
+            dec_p.append(wide_dec[b])
+            continue
+        dec = ScheduleDecision(key=key)
+        fc = feas_count_ovr.get(b, int(feas_count[b]))
+        if b in row_feas_src:
+            dec._feasible_src = row_feas_src[b]
+        if b in row_err:
+            dec.error = row_err[b]
+        elif fc == 0:
+            dec.error = f"0/{array.n_real_clusters} clusters are available"
+        elif unsched[b]:
+            dec.error = (
+                f"Clusters available replicas {int(avail_sum[b])} are not "
+                "enough to schedule."
+            )
+        elif b in row_target_src:
+            dec._targets_src = row_target_src[b]
+        else:
+            raise AssertionError(
+                "compact schedule round produced no decode source for live "
+                f"row {key!r} (class {int(cls[b])}, strategy "
+                f"{int(raw.strategy[b])})"
+            )
+        dec_p.append(dec)
+    out: list[Optional[ScheduleDecision]] = [None] * n_real
+    for j, dec in enumerate(dec_p):
+        out[int(order[j])] = dec
+    return out
+
+
+def _spread_over_candidates(
+    array, p, bindings, raw, batch, spread_rows, fetch, feas_count,
+    unsched, avail_sum, feas_count_ovr, row_err, row_target_src,
+    row_feas_src,
+) -> dict[int, ScheduleDecision]:
+    """Spread constraints evaluated over CANDIDATE sets: whenever a row's
+    feasible set fits the window, the window holds every feasible cluster
+    and the per-row exact selection (sched/spread.py, the semantic spec)
+    runs on the compact arrays — same inputs the dense fallback would pass,
+    gathered instead of fetched dense. Rows whose feasible set outruns the
+    window need full-fleet visibility: they re-solve through the dense
+    partitioned round (LOUD — log.warning + fallback counter) and their
+    finished decisions merge in by position."""
+    from . import spread as spread_mod
+
+    names = array.fleet.names
+    C = len(names)
+    k = p["k"]
+    ns = p["ns"]
+    s_cand, s_feas, s_score, s_avail, s_prev, s_tie = (
+        np.asarray(a)[:ns] for a in fetch
+    )
+    wide: list[int] = []
+    live_div: list[tuple[int, int, np.ndarray]] = []  # (fetch row, round row, sel)
+    for j, b in enumerate(spread_rows):
+        if feas_count[b] == 0:
+            continue  # FitError branch
+        if feas_count[b] > k:
+            wide.append(b)
+            continue
+        f = np.flatnonzero(s_feas[j])
+        gidx = s_cand[j, f].astype(np.int64)
+        rb = bindings[b]
+        try:
+            selected_idx = spread_mod.select_by_spread_arrays(
+                gidx,
+                s_score[j, f],
+                s_avail[j, f].astype(np.int64) + s_prev[j, f],
+                array._name_rank[gidx],
+                array._region_id[gidx],
+                array._region_names,
+                rb.spec.placement,
+                rb.spec.replicas,
+            )
+        except spread_mod.SpreadError as e:
+            row_err[b] = str(e)
+            continue
+        sel_sorted = np.sort(np.asarray(selected_idx, np.int64))
+        # the dense fallback re-runs the kernel with the selection folded
+        # into the feasibility mask, so its feasible set IS the selection —
+        # mirror that exactly
+        row_feas_src[b] = ("idx", names, sel_sorted)
+        feas_count_ovr[b] = len(sel_sorted)
+        strat = int(raw.strategy[b])
+        if strat == NON_WORKLOAD:
+            row_target_src[b] = (
+                "pairs", names, sel_sorted,
+                np.zeros(len(sel_sorted), np.int64),
+            )
+        elif strat == DUPLICATED:
+            row_target_src[b] = (
+                "pairs", names, sel_sorted,
+                np.full(len(sel_sorted), int(rb.spec.replicas), np.int64),
+            )
+        else:
+            live_div.append((j, b, np.isin(s_cand[j], sel_sorted)))
+
+    if live_div:
+        d_rows = [b for _, b, _ in live_div]
+        jks = [j for j, _, _ in live_div]
+        idx_pad, _nd = _pad_rows_idx(jks, array._bucket)
+        # pad by repeating the first live row (same contract as
+        # _pad_rows_idx): build the selection-restricted feasibility for
+        # the padded fetch-row subset
+        sel_rows = {j: sel for j, _, sel in live_div}
+        sel_stack = np.stack([sel_rows.get(int(j), live_div[0][2])
+                              for j in idx_pad])
+        d_feas = s_feas[idx_pad] & sel_stack
+        rows_pad, _ = _pad_rows_idx(d_rows, array._bucket)
+        rsel = rows_pad.astype(np.int64)
+        max_repl = int(raw.replicas[d_rows].max(initial=0))
+        topk = min(
+            pow2_bucket(min(max_repl, TOPK_TARGETS), lo=8), TOPK_TARGETS
+        )
+        has_agg = bool((raw.strategy[d_rows] == AGGREGATED).any())
+        t_out = _candidate_tail_kernel(
+            d_feas, s_avail[idx_pad], s_prev[idx_pad], s_tie[idx_pad],
+            s_cand[idx_pad],
+            batch.weight_tables, batch.weight_idx[rsel],
+            batch.strategy[rsel], batch.replicas[rsel], batch.fresh[rsel],
+            topk=topk, narrow=narrow_of(p), has_agg=has_agg, narrow16=False,
+        )
+        d_res, d_unsched, d_asum, d_nnz, d_ti, d_tv = (
+            np.asarray(a) for a in jax.device_get(t_out)
+        )
+        tis, tvs = _sorted_pairs(d_ti, d_tv)
+        d_cand = s_cand[idx_pad]
+        for m, (j, b, sel) in enumerate(live_div):
+            unsched[b] = bool(d_unsched[m])
+            avail_sum[b] = int(d_asum[m])
+            feas_count_ovr[b] = int(d_feas[m].sum())
+            n = int(d_nnz[m])
+            if n > d_ti.shape[1]:
+                pos = np.nonzero(d_res[m] > 0)[0]
+                row_target_src[b] = (
+                    "pairs", names, d_cand[m, pos].astype(np.int64),
+                    d_res[m, pos].astype(np.int64),
+                )
+            else:
+                row_target_src[b] = ("pairs", names, tis[m, :n], tvs[m, :n])
+
+    out: dict[int, ScheduleDecision] = {}
+    if wide:
+        log.warning(
+            "candidate window k=%d too narrow for %d spread row(s) "
+            "(feasible set needs full-fleet visibility) — re-solving them "
+            "through the exact dense round", k, len(wide),
+        )
+        note_fallback("spread_constraint", len(wide))
+        extra_avail = p["extra_avail"]
+        term_indices = p["term_indices"]
+        sub_extra = (
+            None if extra_avail is None else np.asarray(extra_avail)[wide]
+        )
+        sub_terms = (
+            None if term_indices is None else [term_indices[b] for b in wide]
+        )
+        sub_dec = array._schedule_once_partitioned(
+            [bindings[b] for b in wide], sub_extra, sub_terms
+        )
+        for b, dec in zip(wide, sub_dec):
+            out[b] = dec
+    return out
+
+
+def narrow_of(p: dict) -> bool:
+    return bool(p["narrow"])
+
+
+# --------------------------------------------------------------------------
+# the compact tiered kernel (sched/preemption.py routes here)
+# --------------------------------------------------------------------------
+
+
+@partial(jax.jit, static_argnames=("n_tiers", "k", "topk", "has_agg",
+                                   "plugin_bits", "speculate"))
+def _tiered_candidate_kernel(
+    # fleet (capacity may be a victim-augmented override)
+    alive, capacity, has_summary, taint_key, taint_value, taint_effect, api_ok,
+    tier_of,
+    replicas, unknown_request, gvk, strategy, fresh,
+    tol_tables, tol_idx, aff_masks, aff_idx, weight_tables, weight_idx,
+    prev_idx, prev_rep, evict_idx, seeds, req_unique, req_idx,
+    extra_avail, request_dense, reclaim,
+    n_tiers: int = 1,
+    k: int = CANDIDATE_K_DEFAULT,
+    topk: int = TOPK_TARGETS,
+    has_agg: bool = True,
+    plugin_bits: int = plugin_mod.ALL_PLUGIN_BITS,
+    speculate: bool = False,
+):
+    """preemption._tiered_kernel over compact candidate windows. Candidates
+    select ONCE (feasibility and static score are capacity-independent, so
+    they are tier-invariant); each tier re-runs only the estimator — the
+    [U, C] unique solve over the residual capacity, double-gathered through
+    the SAME candidate index (reclaimed capacity flows the same way on the
+    speculative pass) — and the [B, K] division tail. Tier consumption
+    scatter-adds compact placements back to the dense [C, R] capacity
+    matrix through cand_idx, so the residual each later tier sees is
+    bit-identical to the dense kernel's whenever every row's feasible set
+    fits the window. Duplicated rows are routed dense by the caller (their
+    target set must never truncate)."""
+    B = replicas.shape[0]
+    C = alive.shape[0]
+    rows = jnp.arange(B)[:, None]
+    tol = tol_tables[tol_idx]
+    affinity_ok = aff_masks[aff_idx]
+    p = jnp.where((prev_idx >= 0) & (prev_idx < C), prev_idx, C)
+    prev_member = jnp.zeros((B, C), bool).at[rows, p].set(True, mode="drop")
+    prev_replicas = (
+        jnp.zeros((B, C), jnp.int32).at[rows, p].set(prev_rep, mode="drop")
+    )
+    e = jnp.where((evict_idx >= 0) & (evict_idx < C), evict_idx, C)
+    eviction_ok = jnp.ones((B, C), bool).at[rows, e].set(False, mode="drop")
+    feasible, score = filter_phase(
+        alive, taint_key, taint_value, taint_effect, api_ok, gvk,
+        tol[:, 0], tol[:, 1], tol[:, 2], tol[:, 3],
+        affinity_ok, eviction_ok, prev_member,
+        plugin_bits=plugin_bits,
+    )
+    key = (feasible.astype(jnp.int64) << 33) + score.astype(jnp.int64)
+    _, ti = jax.lax.top_k(key, k)
+    cand_idx = jnp.sort(ti, axis=-1).astype(jnp.int32)
+
+    def take(a):
+        return jnp.take_along_axis(a, cand_idx, axis=-1)
+
+    c_feas = take(feasible)
+    c_prev = take(prev_replicas)
+    c_tie = _tie_at(seeds, cand_idx)
+    c_weight = weight_tables[weight_idx[:, None], cand_idx]
+    c_extra = take(jnp.broadcast_to(extra_avail, (B, C)))
+
+    def body(cap_t, use_extra: bool):
+        c_avail = _compact_estimate(
+            cap_t, has_summary, req_unique, req_idx, replicas,
+            unknown_request, cand_idx, c_extra if use_extra else None,
+        )
+        return assignment_tail(
+            c_feas, strategy, c_weight, c_avail, c_prev, c_tie,
+            replicas, fresh, narrow=False, has_agg=has_agg,
+        )
+
+    cap = capacity
+    out_result = out_unsched = out_asum = None
+    aug_result = aug_unsched = aug_asum = None
+    for t in range(n_tiers):
+        res_t, unsch_t, asum_t = body(cap, True)
+        m = tier_of == t
+        placed = jnp.where((m & ~unsch_t)[:, None], res_t, 0)
+        if out_result is None:
+            out_result = placed
+            out_unsched = m & unsch_t
+            out_asum = jnp.where(m, asum_t, 0)
+        else:
+            out_result = jnp.where(m[:, None], res_t, out_result)
+            out_unsched = jnp.where(m, unsch_t, out_unsched)
+            out_asum = jnp.where(m, asum_t, out_asum)
+        if speculate:
+            ares_t, aunsch_t, aasum_t = body(cap + reclaim[t], False)
+            if aug_result is None:
+                aug_result = jnp.where(m[:, None], ares_t, 0)
+                aug_unsched = m & aunsch_t
+                aug_asum = jnp.where(m, aasum_t, 0)
+            else:
+                aug_result = jnp.where(m[:, None], ares_t, aug_result)
+                aug_unsched = jnp.where(m, aunsch_t, aug_unsched)
+                aug_asum = jnp.where(m, aasum_t, aug_asum)
+        if t + 1 < n_tiers:
+            cons = jnp.zeros((C, request_dense.shape[1]), jnp.int64).at[
+                cand_idx
+            ].add(
+                placed.astype(jnp.int64)[:, :, None]
+                * request_dense[:, None, :]
+            )
+            cap = jnp.maximum(cap - cons, 0)
+    feas_count = feasible.sum(-1).astype(jnp.int32)
+    window = min(k, topk)
+    _, nnz, l_idx, top_val = compact_outputs(c_feas, out_result, window)
+    top_idx = jnp.take_along_axis(cand_idx, l_idx, axis=-1)
+    out = (out_unsched, out_asum, feas_count, nnz, top_idx, top_val,
+           out_result)
+    if speculate:
+        _, a_nnz, a_l, a_val = compact_outputs(c_feas, aug_result, window)
+        a_idx = jnp.take_along_axis(cand_idx, a_l, axis=-1)
+        out += (aug_unsched, aug_asum, a_nnz, a_idx, a_val, aug_result)
+    return out + (cand_idx,)
+
+
+def tiered_k(array, raw, n_cols: int) -> int:
+    """Effective window for a tiered/speculative batch, or 0 for dense:
+    the width gate plus a duplicated-row exclusion — a duplicated row's
+    target set IS its feasible set, which a window would truncate
+    silently (the main round decodes those rows from complete packed
+    masks; the tiered kernel has no such side channel)."""
+    if not compact_width_ok(array):
+        return 0
+    if bool((np.asarray(raw.strategy) == DUPLICATED).any()):
+        return 0
+    return effective_k(array, raw, n_cols)

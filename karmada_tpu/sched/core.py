@@ -144,24 +144,18 @@ class ScheduleDecision:
         self._feasible_src = None
 
 
-def filter_estimate_phase(
-    alive, capacity, has_summary, taint_key, taint_value, taint_effect, api_ok,
-    replicas, request, unknown_request, gvk,
+def filter_phase(
+    alive, taint_key, taint_value, taint_effect, api_ok, gvk,
     tol_key, tol_value, tol_effect, tol_op,
     affinity_ok, eviction_ok, prev_member,
-    req_unique=None, req_idx=None,
     plugin_bits: int = plugin_mod.ALL_PLUGIN_BITS,
     extra_mask=None, extra_score=None,
 ):
-    """Filters + score + GeneralEstimator — elementwise over (B, C), so the
-    mesh path runs it on local (B_l, C_l) tiles before any collective.
-
-    plugin_bits statically selects which fused in-tree plugin terms compile
-    in (`--plugins` disable, sched/plugins.py); extra_mask/extra_score are
-    the out-of-tree plugins' host-computed contributions.
-
-    Requests naming resources outside the encoded vocabulary behave like a
-    missing allocatable key: 0 available everywhere (general.go:166-169)."""
+    """Filter masks + static score WITHOUT the estimator — the
+    capacity-independent half of filter_estimate_phase. The candidate
+    prepass (sched/candidates.py) runs exactly this over [B, C] and then
+    computes the estimator answers compactly over [B, K], so the two
+    callers can never drift on feasibility/score semantics."""
     ones = jnp.ones_like(affinity_ok)
     taint_mask = (
         filter_ops.taint_toleration_mask(
@@ -190,6 +184,34 @@ def filter_estimate_phase(
     )
     if extra_score is not None:
         score = score + jnp.broadcast_to(extra_score, score.shape)
+    return feasible, score
+
+
+def filter_estimate_phase(
+    alive, capacity, has_summary, taint_key, taint_value, taint_effect, api_ok,
+    replicas, request, unknown_request, gvk,
+    tol_key, tol_value, tol_effect, tol_op,
+    affinity_ok, eviction_ok, prev_member,
+    req_unique=None, req_idx=None,
+    plugin_bits: int = plugin_mod.ALL_PLUGIN_BITS,
+    extra_mask=None, extra_score=None,
+):
+    """Filters + score + GeneralEstimator — elementwise over (B, C), so the
+    mesh path runs it on local (B_l, C_l) tiles before any collective.
+
+    plugin_bits statically selects which fused in-tree plugin terms compile
+    in (`--plugins` disable, sched/plugins.py); extra_mask/extra_score are
+    the out-of-tree plugins' host-computed contributions.
+
+    Requests naming resources outside the encoded vocabulary behave like a
+    missing allocatable key: 0 available everywhere (general.go:166-169)."""
+    feasible, score = filter_phase(
+        alive, taint_key, taint_value, taint_effect, api_ok, gvk,
+        tol_key, tol_value, tol_effect, tol_op,
+        affinity_ok, eviction_ok, prev_member,
+        plugin_bits=plugin_bits,
+        extra_mask=extra_mask, extra_score=extra_score,
+    )
     if req_unique is not None:
         # requests dedup to the policy set: the [.,C,R] divisions run per
         # DISTINCT vector; rows gather (bit-exact with the dense form)
@@ -731,6 +753,7 @@ class ArrayScheduler:
         autoshard: Optional[bool] = None,
         pipeline: Optional[bool] = None,
         bucket_cols: bool = True,
+        candidate_k: Optional[int] = None,
     ):
         """`mesh`: optional jax.sharding.Mesh — the solve runs column/row-
         sharded over it (parallel/mesh.py) with identical outputs.
@@ -749,7 +772,11 @@ class ArrayScheduler:
         decoded) so fleet growth inside a bucket re-uses compiled programs
         instead of triggering fresh XLA compiles; decisions are
         bit-identical to the exact-width solve (tests/test_bucketing.py).
-        False restores exact fleet width (the parity-suite reference)."""
+        False restores exact fleet width (the parity-suite reference).
+        `candidate_k`: top-K candidate sparsification window
+        (sched/candidates.py) — rounds on fleets wider than the bucketed
+        window solve compact [B, K]; None reads KARMADA_TPU_CANDIDATE_K
+        (default 128), 0 pins every round to the exact dense solve."""
         from .compilecache import install_compile_listeners
 
         install_compile_listeners()
@@ -821,6 +848,13 @@ class ArrayScheduler:
         # compile delta of the last schedule() round (compile economics):
         # jit_compiles / jit_compile_seconds / jit_persistent_cache_hits
         self.last_compile_stats: dict = {}
+        # top-K candidate sparsification (sched/candidates.py): window size
+        # resolved once; last_candidate_stats carries the last compact
+        # round's effective K and truncation count
+        from .candidates import resolve_candidate_k
+
+        self.candidate_k = resolve_candidate_k(candidate_k)
+        self.last_candidate_stats: dict = {}
         self.set_clusters(clusters)
 
     @contextmanager
@@ -1630,6 +1664,15 @@ class ArrayScheduler:
         carries the finished decisions, so pipelined callers degrade to
         serial there without a special case."""
         if self.mesh is None or self.mesh_partitioned:
+            from . import candidates as cand_mod
+
+            self.last_candidate_stats = {}
+            reason = cand_mod.dense_reason(self, bindings)
+            if reason is None:
+                return cand_mod.launch_candidates(
+                    self, bindings, extra_avail, term_indices
+                )
+            cand_mod.note_fallback(reason)
             return self._launch_once_partitioned(
                 bindings, extra_avail, term_indices
             )
@@ -1642,6 +1685,10 @@ class ArrayScheduler:
     def _materialize_once(self, pending: dict) -> list[ScheduleDecision]:
         if "decisions" in pending:
             return pending["decisions"]
+        if pending.get("candidates"):
+            from . import candidates as cand_mod
+
+            return cand_mod.materialize_candidates(self, pending)
         return self._materialize_once_partitioned(pending)
 
     def _row_class(self, rb, spread_row: bool) -> int:

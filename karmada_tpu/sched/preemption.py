@@ -306,7 +306,7 @@ def _launch_kernel_rows(array: ArrayScheduler, bindings: list,
             *fleet_dev[2:],
         )
     speculate = reclaim_tiers is not None
-    out = _tiered_kernel(
+    kernel_args = (
         *fleet_dev, tier_pad,
         batch.replicas, batch.unknown_request, batch.gvk, batch.strategy,
         batch.fresh, batch.tol_tables, batch.tol_idx, batch.aff_masks,
@@ -315,25 +315,52 @@ def _launch_kernel_rows(array: ArrayScheduler, bindings: list,
         batch.req_unique, batch.req_idx,
         extra_np, np.asarray(batch.request, np.int64),
         reclaim_tiers if speculate else _NO_RECLAIM,
-        n_tiers=n_tiers, topk=topk, has_agg=has_agg,
-        plugin_bits=array._plugin_bits,
-        speculate=speculate,
     )
+    # top-K candidate sparsification (sched/candidates.py): wide fleets run
+    # the compact tiered kernel — same tier/consumption/speculation
+    # semantics over [B, K] candidate windows; k=0 means dense (narrow
+    # fleet, disabled, or a duplicated row whose target set must never
+    # truncate)
+    from . import candidates as cand_mod
+
+    cand_k = cand_mod.tiered_k(array, raw, C)
+    cand_dev = None
+    if cand_k:
+        out = cand_mod._tiered_candidate_kernel(
+            *kernel_args,
+            n_tiers=n_tiers, k=cand_k, topk=topk, has_agg=has_agg,
+            plugin_bits=array._plugin_bits, speculate=speculate,
+        )
+        cand_dev = out[-1]
+    else:
+        if cand_mod.compact_width_ok(array):
+            cand_mod.note_fallback("duplicated")
+        elif getattr(array, "candidate_k", 0):
+            cand_mod.note_fallback("small_fleet")
+        out = _tiered_kernel(
+            *kernel_args,
+            n_tiers=n_tiers, topk=topk, has_agg=has_agg,
+            plugin_bits=array._plugin_bits,
+            speculate=speculate,
+        )
     if count == "tiered":
         LAUNCHES.tiered += 1
     else:
         LAUNCHES.preempt += 1
     return {"raw": raw, "out": out, "n": len(bindings),
             "names": array.fleet.names, "n_tiers": n_tiers,
-            "speculate": speculate}
+            "speculate": speculate, "cand_dev": cand_dev}
 
 
 def _decode_rows(raw, names, real, rows_j, unsched, asum, feas_count, nnz,
-                 tis, tvs, window, result_dev) -> dict:
+                 tis, tvs, window, result_dev, cand_dev=None) -> dict:
     """Decode a set of kernel rows into ScheduleDecisions (the simulation
     engine's decode, single-scenario): compact top-K pairs, unschedulable/
     empty-feasible errors in the live solver's vocabulary, dense-row fetch
-    for rows whose target set overflows the window."""
+    for rows whose target set overflows the window. With `cand_dev`
+    (compact tiered kernel), result columns are candidate-window LOCAL and
+    the overflow fetch maps them to global cluster ids through the
+    per-row candidate index."""
     decisions: dict[int, ScheduleDecision] = {}
     overflow: list[tuple[int, ScheduleDecision]] = []
     for j in rows_j:
@@ -364,12 +391,20 @@ def _decode_rows(raw, names, real, rows_j, unsched, asum, feas_count, nnz,
             ])
     if overflow:
         rows = np.asarray([j for j, _ in overflow])
-        dense = np.asarray(jax.device_get(result_dev[rows]))
+        if cand_dev is None:
+            dense = np.asarray(jax.device_get(result_dev[rows]))
+            cand = None
+        else:
+            dense, cand = (
+                np.asarray(a)
+                for a in jax.device_get((result_dev[rows], cand_dev[rows]))
+            )
         for m, (_, dec) in enumerate(overflow):
             pos = np.nonzero(dense[m] > 0)[0]
+            ids = pos if cand is None else cand[m, pos]
             dec.targets = [
-                TargetCluster(name=names[int(i)], replicas=int(dense[m, i]))
-                for i in pos
+                TargetCluster(name=names[int(i)], replicas=int(dense[m, p]))
+                for i, p in zip(ids, pos)
             ]
     return decisions
 
@@ -391,9 +426,10 @@ def _materialize_kernel_rows(state: dict,
     tis, tvs = _sorted_pairs(top_idx[:n], top_val[:n])
     window = top_idx.shape[1]
     real = sum(1 for nm in names if not nm.startswith("__shape-pad-"))
+    cand_dev = state.get("cand_dev")
     decoded = _decode_rows(
         raw, names, real, range(n), unsched, asum, feas_count, nnz,
-        tis, tvs, window, result_dev,
+        tis, tvs, window, result_dev, cand_dev=cand_dev,
     )
     decisions = [decoded[j] for j in range(n)]
     if speculate and armed:
@@ -402,6 +438,7 @@ def _materialize_kernel_rows(state: dict,
         aug = _decode_rows(
             raw, names, real, list(armed), a_unsched, a_asum, feas_count,
             a_nnz, a_tis, a_tvs, a_idx.shape[1], state["out"][12],
+            cand_dev=cand_dev,
         )
         for j, dec in aug.items():
             decisions[j].speculative = dec

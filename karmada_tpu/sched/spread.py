@@ -288,7 +288,14 @@ def select_by_spread_arrays(
 ) -> np.ndarray:
     """Array equivalent of select_clusters_by_spread: returns the SELECTED
     fleet indices. Semantics identical to the ClusterDetail path (parity
-    tested); no per-cluster objects are built."""
+    tested); no per-cluster objects are built.
+
+    Callers feed per-FEASIBLE-cluster arrays; the indices only name the
+    clusters, so the compact candidate round (sched/candidates.py) passes
+    its window-gathered slices directly — the selection is well-defined
+    over ANY subset that contains the row's whole feasible set, and rows
+    whose feasible set outruns the candidate window must re-solve dense
+    before calling here (the loud spread_constraint fallback)."""
     available = available.astype(np.int64)
     # sortClusters (util.go:43-57): score desc, avail desc, name asc
     order = np.lexsort((name_rank, -available, -score))

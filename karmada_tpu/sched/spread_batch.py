@@ -432,6 +432,9 @@ def _apply_chosen(feasible, chosen, layout: RegionLayout):
 
 
 def _pack_bits(sel):
+    # jit-safe bit-packing shared with the candidate prepass
+    # (sched/candidates.py ships complete feasible masks through it for
+    # duplicated / non-workload rows — their target sets never truncate)
     C = sel.shape[1]
     pad = (-C) % 8
     if pad:

@@ -642,6 +642,10 @@ class StreamingScheduler:
             "placed": placed, "failed": failed, "stale_discarded": stale,
             "queue_depth": int(self._ready()),
             **compile_delta(mb.compile_snap),
+            # candidate sparsification (sched/candidates.py): the last
+            # compact round's effective K and truncation count — empty on
+            # dense-solved micro-batches
+            **self._array.last_candidate_stats,
         }
         self._array.last_round_stats = mb.stats
         with self._stats_lock:

@@ -329,7 +329,12 @@ class ControlPlaneServer:
                 h.headers.get("X-Karmada-Trace", ""))
             if trace_ctx is not None and not trace_ctx[2]:
                 trace_ctx = None  # s=0: head-dropped upstream
-        t_req = time.time() if trace_ctx is not None else 0.0
+        # the span is recorded by _send BEFORE the response bytes reach the
+        # socket: a client that writes and immediately reads its trace back
+        # must observe the commit span (happens-before the response)
+        h._trace_ctx = trace_ctx
+        h._trace_t0 = time.time() if trace_ctx is not None else 0.0
+        h._trace_route = parsed.path
         try:
             fn = getattr(self, f"_h_{method}_{parsed.path.strip('/').replace('/', '_')}", None)
             if fn is None:
@@ -337,21 +342,6 @@ class ControlPlaneServer:
                 self._send(h, 404, {"error": f"no route {method} {parsed.path}"})
                 return
             fn(h, q)
-            # record ONLY on success: a handler that raised OR answered a
-            # 4xx/5xx via _send (POST /objects/batch reports BatchError as
-            # a 409 body and returns normally) committed nothing — its
-            # span would show a commit that never happened, and recording
-            # it would also burn the logical span id so the client's real
-            # replayed commit deduped away. A replay whose first attempt
-            # succeeded server-side still dedups here by span id.
-            if (trace_ctx is not None
-                    and getattr(h, "_trace_status", 200) < 400):
-                from ..tracing import tracer
-
-                tracer.record_trace(
-                    trace_ctx[0], "commit", t_req, time.time(),
-                    span_id=trace_ctx[1], route=parsed.path,
-                )
         except NotFoundError as e:
             self._send(h, 404, {"error": str(e)})
         except ConflictError as e:
@@ -481,10 +471,25 @@ class ControlPlaneServer:
 
     @staticmethod
     def _send(h, status: int, body: dict) -> None:
-        # remember the status for the commit-span gate in _route: some
-        # handlers (POST /objects/batch) report failure by SENDING 409/422
-        # and returning normally instead of raising
         h._trace_status = status
+        # commit span, recorded ONLY on success and BEFORE the response is
+        # written: a handler that raised OR answers a 4xx/5xx here (POST
+        # /objects/batch reports BatchError as a 409 body and returns
+        # normally) committed nothing — its span would show a commit that
+        # never happened, and recording it would also burn the logical span
+        # id so the client's real replayed commit deduped away. A replay
+        # whose first attempt succeeded server-side still dedups by span
+        # id. Ordering before send_json means a client that writes and
+        # immediately reads its trace always sees the span.
+        ctx = getattr(h, "_trace_ctx", None)
+        if ctx is not None and status < 400:
+            from ..tracing import tracer
+
+            h._trace_ctx = None
+            tracer.record_trace(
+                ctx[0], "commit", getattr(h, "_trace_t0", 0.0), time.time(),
+                span_id=ctx[1], route=getattr(h, "_trace_route", ""),
+            )
         send_json(h, status, body)
 
     @staticmethod

@@ -1,0 +1,62 @@
+#!/usr/bin/env bash
+# Top-K candidate sparsification smoke (docs/PERF.md "Candidate
+# sparsification"). Single-shot: runs the `candidates` bench config —
+# exact-dense [B, C] vs compact top-K [B, K] solve rounds over the grid
+# (the CPU fallback trims to the smallest point), an affinity-narrowed
+# parity leg, and a K-drift leg inside one shape_bucket bucket — and
+# asserts the acceptance booleans the JSON line carries:
+#   pass_speedup   top-K round p99 beats dense at the largest shape run
+#                  (>= 3x on the TPU grid; sanity floor on the cpu proxy)
+#   pass_parity    feasible-fits-K rounds decode bit-identical to dense
+#                  AND truncating rounds strand no demand (placed-replica
+#                  delta <= eps)
+#   pass_compiles  timed iterations and real-candidate-count drift inside
+#                  a shape_bucket(K) bucket trigger ZERO XLA compiles
+# Exit 0 prints "CANDIDATES OK".
+#
+# Wired into the slow path as
+# tests/test_candidates.py::TestCandidatesSmokeScript (pytest -m slow).
+# Runs on CPU; the solve rides the scheduler's CPU fallback.
+set -euo pipefail
+
+cd "$(dirname "$0")/.."
+PY=${PYTHON:-python}
+WORK=$(mktemp -d /tmp/candidates_smoke.XXXXXX)
+trap 'rm -rf "$WORK"' EXIT
+
+log() { echo "candidates_smoke: $*"; }
+
+JAX_PLATFORMS=cpu $PY bench.py --inner --platform cpu --configs candidates \
+    --verbose > "$WORK/out.txt" 2> "$WORK/err.txt" \
+    || { log "bench failed"; cat "$WORK/err.txt"; exit 1; }
+
+LINE=$(grep -E '^\{' "$WORK/out.txt" | tail -1)
+[ -n "$LINE" ] || { log "no JSON line emitted"; cat "$WORK/out.txt"; exit 1; }
+log "result: $LINE"
+
+CANDIDATES_LINE="$LINE" $PY - <<'PYEOF'
+import json
+import os
+import sys
+
+rec = json.loads(os.environ["CANDIDATES_LINE"])
+for key in ("pass_speedup", "pass_parity", "pass_compiles", "pass"):
+    if not rec.get(key):
+        print(f"candidates_smoke: criterion {key} FAILED "
+              f"(speedup={rec.get('speedup')}x "
+              f"dense_p99={rec.get('dense_p99_s')}s "
+              f"topk_p99={rec.get('topk_p99_s')}s "
+              f"k={rec.get('candidate_k')}, "
+              f"replica_delta={rec.get('replica_delta_frac')}, "
+              f"steady_compiles={rec.get('steady_jit_compiles')}, "
+              f"drift_compiles={rec.get('drift_jit_compiles')})",
+              file=sys.stderr)
+        sys.exit(1)
+print(f"candidates_smoke: top-K solve {rec['speedup']}x dense at "
+      f"{rec['shapes'][-1]['shape']} (k={rec['candidate_k']}), "
+      f"replica delta {rec['replica_delta_frac']}, "
+      f"steady/drift compiles {rec['steady_jit_compiles']}/"
+      f"{rec['drift_jit_compiles']}")
+PYEOF
+
+echo "CANDIDATES OK"

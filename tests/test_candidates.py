@@ -1,0 +1,426 @@
+"""Top-K candidate sparsification parity + compile economics.
+
+The compact [B, K] solve (sched/candidates.py) must be BIT-IDENTICAL to
+the exact-dense solve whenever every row's feasible set fits the window
+(docs/PERF.md "Candidate sparsification" is the contract). This suite
+pins the claims that make the window safe to ship:
+
+1. **Parity**: mixed-strategy rounds (dynamic/aggregated/static/
+   duplicated/non-workload/spread/affinity, plus top-K-overflow rows)
+   decode identically dense vs compact — single chip, the host-sorts
+   twin, and the mesh (GSPMD) leg.
+2. **Feasibility dominates score**: a binding whose only feasible
+   cluster ranks far below the K-th static score still places — the
+   selection key orders (feasible, score), never score alone.
+3. **Preemption**: tiered and speculative solves compacted produce the
+   same decisions and the same victim sets as dense.
+4. **Zero compiles on K drift inside a bucket**: the effective window
+   rides the shape_bucket lattice, so real candidate-count drift within
+   a bucket re-uses every compiled program (the PR-13 recompile class,
+   pinned here for the K axis).
+"""
+from __future__ import annotations
+
+import random
+
+import jax
+import numpy as np
+import pytest
+
+from karmada_tpu.api.meta import CPU, MEMORY, ObjectMeta, new_uid
+from karmada_tpu.api.policy import (
+    ClusterAffinity,
+    ClusterPreferences,
+    DIVISION_PREFERENCE_AGGREGATED,
+    DIVISION_PREFERENCE_WEIGHTED,
+    DYNAMIC_WEIGHT_AVAILABLE_REPLICAS,
+    Placement,
+    PREEMPT_LOWER_PRIORITY,
+    REPLICA_SCHEDULING_DIVIDED,
+    ReplicaSchedulingStrategy,
+    SPREAD_BY_FIELD_REGION,
+    SpreadConstraint,
+)
+from karmada_tpu.api.work import (
+    BindingSpec,
+    ObjectReference,
+    ReplicaRequirements,
+    ResourceBinding,
+    TargetCluster,
+)
+from karmada_tpu.models.batch import shape_bucket
+from karmada_tpu.parallel import make_mesh
+from karmada_tpu.sched import compilecache, preemption
+from karmada_tpu.sched import candidates as cand_mod
+from karmada_tpu.sched.core import ArrayScheduler
+from karmada_tpu.testing.fixtures import (
+    duplicated_placement,
+    new_cluster_with_resource,
+    static_weight_placement,
+    synthetic_fleet,
+)
+
+GiB = 1024.0**3
+
+
+def make_binding(name, replicas, placement, *, cpu=0.0, prev=None, prio=0):
+    rr = ReplicaRequirements(resource_request={CPU: cpu}) if cpu else None
+    rb = ResourceBinding(
+        metadata=ObjectMeta(namespace="default", name=name, uid=new_uid("rb")),
+        spec=BindingSpec(
+            resource=ObjectReference(
+                api_version="apps/v1", kind="Deployment",
+                namespace="default", name=name,
+            ),
+            replicas=replicas,
+            replica_requirements=rr,
+            placement=placement,
+            clusters=[TargetCluster(name=n, replicas=r)
+                      for n, r in (prev or {}).items()],
+        ),
+    )
+    rb.spec.schedule_priority = prio
+    return rb
+
+
+def dyn_placement(aggregated=False, names=None, spread=None):
+    return Placement(
+        cluster_affinity=ClusterAffinity(cluster_names=list(names or [])),
+        spread_constraints=spread,
+        replica_scheduling=ReplicaSchedulingStrategy(
+            replica_scheduling_type=REPLICA_SCHEDULING_DIVIDED,
+            replica_division_preference=(
+                DIVISION_PREFERENCE_AGGREGATED if aggregated
+                else DIVISION_PREFERENCE_WEIGHTED
+            ),
+            weight_preference=None if aggregated else ClusterPreferences(
+                dynamic_weight=DYNAMIC_WEIGHT_AVAILABLE_REPLICAS
+            ),
+        ),
+    )
+
+
+def mixed_bindings(names, *, seed=0, n=36):
+    """Every decode path in one round: divided (weighted + aggregated),
+    static-weight, duplicated, non-workload, spread, narrow affinity, and
+    rows whose replica count overflows the compact output window."""
+    rng = random.Random(seed)
+    out = []
+    for i in range(n):
+        kind = rng.choice([
+            "dyn", "agg", "static", "dup", "nonwork", "spread", "narrow",
+        ])
+        sub = rng.sample(names, rng.randrange(2, 12))
+        if kind == "dyn":
+            out.append(make_binding(
+                f"dyn{i}", rng.randrange(1, 40), dyn_placement(), cpu=0.5))
+        elif kind == "agg":
+            out.append(make_binding(
+                f"agg{i}", rng.randrange(1, 40),
+                dyn_placement(aggregated=True), cpu=0.5))
+        elif kind == "static":
+            out.append(make_binding(
+                f"st{i}", rng.randrange(1, 40),
+                static_weight_placement(
+                    {nm: rng.randrange(1, 5) for nm in sub})))
+        elif kind == "dup":
+            out.append(make_binding(
+                f"dup{i}", rng.randrange(1, 10), duplicated_placement(sub)))
+        elif kind == "nonwork":
+            out.append(make_binding(
+                f"nw{i}", 0, Placement(cluster_affinity=ClusterAffinity())))
+        elif kind == "narrow":
+            out.append(make_binding(
+                f"na{i}", rng.randrange(1, 20),
+                dyn_placement(names=sub), cpu=0.25))
+        else:
+            cons = [SpreadConstraint(
+                spread_by_field=SPREAD_BY_FIELD_REGION,
+                min_groups=1, max_groups=2,
+            )]
+            out.append(make_binding(
+                f"sp{i}", rng.randrange(1, 20),
+                dyn_placement(spread=cons), cpu=0.25))
+    # overflow rows: replicas > TOPK_TARGETS, so the compact output
+    # window overflows and the dense-row overflow fetch decode runs
+    out.append(make_binding("big0", 400, dyn_placement(), cpu=0.01))
+    out.append(make_binding("big1", 350, dyn_placement(), cpu=0.01))
+    return out
+
+
+def assert_same_rows(compact, dense):
+    assert len(compact) == len(dense)
+    for c, d in zip(compact, dense):
+        tc = None if c.targets is None else \
+            [(t.name, t.replicas) for t in c.targets]
+        td = None if d.targets is None else \
+            [(t.name, t.replicas) for t in d.targets]
+        assert (c.error, tc, sorted(c.feasible)) == \
+            (d.error, td, sorted(d.feasible)), c.key
+
+
+# ---------------------------------------------------------------------------
+# parity: compact == dense, bit-identical, when feasible fits the window
+# ---------------------------------------------------------------------------
+
+
+class TestParity:
+    def fleet(self, n=200, seed=7):
+        # ready_fraction 0.3 keeps every row's feasible count well under
+        # the default K=128 window — the bit-parity regime
+        return synthetic_fleet(n, seed=seed, ready_fraction=0.3)
+
+    def test_mixed_strategies_single_chip(self):
+        clusters = self.fleet()
+        names = [c.metadata.name for c in clusters]
+        bindings = mixed_bindings(names, seed=1)
+        dense = ArrayScheduler(clusters, candidate_k=0)
+        comp = ArrayScheduler(clusters)
+        dd = dense.schedule(bindings)
+        cd = comp.schedule(bindings)
+        # the compact path actually engaged, and nothing was truncated
+        assert comp.last_candidate_stats["candidate_k"] > 0
+        assert comp.last_candidate_stats["candidate_truncations"] == 0
+        assert dense.last_candidate_stats == {}
+        assert_same_rows(cd, dd)
+
+    def test_host_sorts_twin(self, monkeypatch):
+        from karmada_tpu.sched import core as core_mod
+
+        clusters = self.fleet(seed=11)
+        names = [c.metadata.name for c in clusters]
+        bindings = mixed_bindings(names, seed=2, n=20)
+        monkeypatch.setenv("KARMADA_TPU_HOST_SORTS", "1")
+        monkeypatch.setattr(core_mod, "HOST_TAIL_MIN_ELEMS", 0)
+        dense = ArrayScheduler(clusters, candidate_k=0)
+        comp = ArrayScheduler(clusters)
+        assert dense._host_sorts and comp._host_sorts
+        assert_same_rows(comp.schedule(bindings), dense.schedule(bindings))
+        assert comp.last_candidate_stats["candidate_truncations"] == 0
+
+    def test_parity_mesh(self):
+        """Same contract under a user-provided mesh: GSPMD partitions the
+        select/tail kernels like every other round kernel."""
+        clusters = self.fleet(n=150, seed=5)
+        names = [c.metadata.name for c in clusters]
+        bindings = mixed_bindings(names, seed=3, n=12)
+        mesh = make_mesh(jax.devices())
+        dense = ArrayScheduler(clusters, mesh=mesh, candidate_k=0)
+        comp = ArrayScheduler(clusters, mesh=mesh)
+        assert_same_rows(comp.schedule(bindings), dense.schedule(bindings))
+        assert comp.last_candidate_stats["candidate_k"] > 0
+
+    def test_feasibility_dominates_score(self):
+        """A binding whose ONLY feasible cluster ranks far below the K-th
+        static score still places: the selection key is (feasible, score),
+        so no amount of locality boost on infeasible clusters can push a
+        feasible one out of the window."""
+        from karmada_tpu.api.cluster import cluster_ready
+
+        clusters = self.fleet(n=200, seed=9)
+        ready = [c.metadata.name for c in clusters if cluster_ready(c)]
+        target = ready[0]
+        # locality-boost 30 OTHER clusters via prior placement; affinity
+        # restricts feasibility to `target`, which has score 0
+        boosted = {nm: 2 for nm in ready[1:31]}
+        rb = make_binding(
+            "only-one", 3, dyn_placement(names=[target]),
+            cpu=0.25, prev=boosted,
+        )
+        dense = ArrayScheduler(clusters, candidate_k=0)
+        comp = ArrayScheduler(clusters)
+        (dd,) = dense.schedule([rb])
+        (cd,) = comp.schedule([rb])
+        # the affinity popcount shrinks the window to the lattice floor —
+        # far narrower than the boosted set — and the row still places
+        assert comp.last_candidate_stats["candidate_k"] == 8
+        assert cd.ok and [t.name for t in cd.targets] == [target]
+        assert_same_rows([cd], [dd])
+
+    def test_small_fleet_falls_back_dense(self):
+        from karmada_tpu import metrics
+
+        clusters = synthetic_fleet(6, seed=1)
+        comp = ArrayScheduler(clusters)  # C=6 <= bucketed K: dense
+        before = metrics.candidate_fallback.value(reason="small_fleet")
+        decisions = comp.schedule(
+            [make_binding("a", 4, dyn_placement(), cpu=0.5)])
+        assert decisions[0].ok
+        assert comp.last_candidate_stats == {}
+        after = metrics.candidate_fallback.value(reason="small_fleet")
+        assert after == before + 1
+
+    def test_policy_annotation_falls_back_dense(self):
+        clusters = self.fleet(n=150, seed=4)
+        comp = ArrayScheduler(clusters)
+        rb = make_binding("pinned", 4, dyn_placement(), cpu=0.5)
+        rb.metadata.annotations[cand_mod.DENSE_SOLVE_ANNOTATION] = "true"
+        (d,) = comp.schedule([rb])
+        assert d.ok
+        assert comp.last_candidate_stats == {}  # round went dense
+
+
+# ---------------------------------------------------------------------------
+# preemption: tiered + speculative solves compacted
+# ---------------------------------------------------------------------------
+
+
+class TestPreemptionParity:
+    def test_tiered_decisions_identical(self):
+        clusters = synthetic_fleet(150, seed=3, ready_fraction=0.3)
+        rng = random.Random(1)
+        bindings = []
+        for i in range(18):
+            bindings.append(make_binding(
+                f"b{i}", rng.randrange(1, 30),
+                dyn_placement(rng.random() < 0.4),
+                cpu=rng.choice([0.25, 0.5, 1.0]), prio=(i % 3) * 5,
+            ))
+        dense = ArrayScheduler(clusters, candidate_k=0)
+        comp = ArrayScheduler(clusters)
+        dd = preemption.materialize_tiered(
+            dense, preemption.launch_tiered(dense, bindings))
+        cd = preemption.materialize_tiered(
+            comp, preemption.launch_tiered(comp, bindings))
+        for x, y in zip(dd, cd):
+            tx = None if x.targets is None else \
+                [(t.name, t.replicas) for t in x.targets]
+            ty = None if y.targets is None else \
+                [(t.name, t.replicas) for t in y.targets]
+            assert (x.error, tx) == (y.error, ty), x.key
+
+    def tight_wide_fleet(self, used=8.0):
+        """12 clusters, 6 ready (feasible = 6 fits a candidate_k=8
+        window; C=12 > bucket(8) engages compact). `used` cpu of 8 is
+        pre-allocated — 8.0 means zero free, so a preemptor can only
+        place by reclaiming victims."""
+        out = []
+        for i in range(12):
+            out.append(new_cluster_with_resource(
+                f"m{i}",
+                allocatable={CPU: 8.0, MEMORY: 64 * GiB, "pods": 200.0},
+                allocated={CPU: used},
+                ready=i < 6,
+            ))
+        return out
+
+    def placed_lo(self):
+        # the pre-allocated usage above IS these placements: lo{i} holds
+        # 2 one-cpu replicas on m{i}
+        lo = []
+        for i in range(6):
+            rb = make_binding(f"lo{i}", 2, dyn_placement(), cpu=1.0, prio=0)
+            rb.spec.clusters = [TargetCluster(name=f"m{i}", replicas=2)]
+            lo.append(rb)
+        return lo
+
+    def test_victim_sets_identical(self):
+        clusters = self.tight_wide_fleet()
+        dense = ArrayScheduler(clusters, candidate_k=0)
+        comp = ArrayScheduler(clusters, candidate_k=8)
+        lo = self.placed_lo()
+        hi = make_binding("hi", 4, dyn_placement(), cpu=1.0, prio=20)
+        hi.spec.preemption_policy = PREEMPT_LOWER_PRIORITY
+        pd = preemption.plan_preemption(dense, lo, [hi])
+        pc = preemption.plan_preemption(comp, lo, [hi])
+
+        def flat(plans):
+            return [
+                (p.key, p.feasible, p.error,
+                 sorted((t.name, t.replicas) for t in p.targets),
+                 sorted((v.key, v.cluster, v.replicas) for v in p.victims))
+                for p in plans
+            ]
+
+        assert flat(pc) == flat(pd)
+        assert any(p.victims for p in pd)  # the plan actually cut victims
+
+    def test_speculative_decisions_identical(self):
+        clusters = self.tight_wide_fleet()
+        dense = ArrayScheduler(clusters, candidate_k=0)
+        comp = ArrayScheduler(clusters, candidate_k=8)
+        lo = self.placed_lo()
+        hi = make_binding("hi", 4, dyn_placement(), cpu=1.0, prio=20)
+        hi.spec.preemption_policy = PREEMPT_LOWER_PRIORITY
+        batch = lo + [hi]
+        dd = preemption.materialize_tiered(
+            dense, preemption.launch_tiered(dense, batch, placed=lo))
+        cd = preemption.materialize_tiered(
+            comp, preemption.launch_tiered(comp, batch, placed=lo))
+
+        def spec_t(d):
+            s = d.speculative
+            if s is None:
+                return None
+            return (s.error, None if s.targets is None else
+                    [(t.name, t.replicas) for t in s.targets])
+
+        saw_spec = False
+        for x, y in zip(dd, cd):
+            tx = None if x.targets is None else \
+                [(t.name, t.replicas) for t in x.targets]
+            ty = None if y.targets is None else \
+                [(t.name, t.replicas) for t in y.targets]
+            assert (x.error, tx, spec_t(x)) == (y.error, ty, spec_t(y)), x.key
+            saw_spec = saw_spec or spec_t(x) is not None
+        assert saw_spec  # the speculative leg actually ran
+
+
+# ---------------------------------------------------------------------------
+# compile economics: K drift inside a shape_bucket bucket compiles nothing
+# ---------------------------------------------------------------------------
+
+
+class TestCompileEconomics:
+    def test_k_drift_in_bucket_zero_compiles(self):
+        """Two batches whose REAL candidate counts differ (max affinity
+        popcount 17 vs 19) but share a shape_bucket(K) bucket: the second
+        must trigger zero XLA compiles — the effective window lives on
+        the lattice, never on the raw count."""
+        assert shape_bucket(17) == shape_bucket(19) == 24
+        clusters = synthetic_fleet(60, seed=6, ready_fraction=0.3)
+        names = [c.metadata.name for c in clusters]
+        sched = ArrayScheduler(clusters, candidate_k=32)
+
+        def batch(popcount, n_rows, tag):
+            rng = random.Random(popcount)
+            out = []
+            for i in range(n_rows):
+                sub = rng.sample(names, popcount if i == 0
+                                 else rng.randrange(2, 9))
+                out.append(make_binding(
+                    f"{tag}{i}", 2 + i, dyn_placement(names=sub), cpu=0.25))
+            return out
+
+        sched.schedule(batch(17, 5, "warm"))  # warm round compiles
+        assert sched.last_candidate_stats["candidate_k"] == 24
+        snap = compilecache.compile_counts()
+        decisions = sched.schedule(batch(19, 6, "drift"))
+        delta = compilecache.compile_delta(snap)
+        assert delta["jit_compiles"] == 0, delta
+        assert sched.last_candidate_stats["candidate_k"] == 24
+        assert all(d.ok for d in decisions)
+
+
+# ---------------------------------------------------------------------------
+# slow path: the bench acceptance line, end to end
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.slow
+class TestCandidatesSmokeScript:
+    def test_candidates_smoke(self):
+        """scripts/candidates_smoke.sh: the `candidates` bench config —
+        dense vs top-K p99 speedup, placed-replica delta <= eps, zero
+        compiles on K drift inside a bucket — asserted from the emitted
+        JSON line."""
+        import os
+        import subprocess
+
+        repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        r = subprocess.run(
+            ["bash", "scripts/candidates_smoke.sh"],
+            capture_output=True, text=True, timeout=900, cwd=repo,
+        )
+        assert r.returncode == 0, r.stdout + r.stderr
+        assert "CANDIDATES OK" in r.stdout
