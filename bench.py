@@ -3562,6 +3562,201 @@ def run_analysis(backend_label: str, verbose=False) -> dict:
     }
 
 
+SEARCH_CLUSTERS = 1000
+SEARCH_OBJECTS_PER_CLUSTER = 20
+
+
+def run_search(backend_label: str, verbose=False) -> dict:
+    """The `search` config (docs/SEARCH.md): fleet-wide query serving.
+    Two legs:
+
+      speedup    the same selector queries executed (a) vectorized over
+                 the columnar index's published snapshot and (b) as the
+                 pre-columnar per-cluster fan-out — a Python walk over
+                 every member's shard matching each object. Result sets
+                 are cross-checked per query; speedup is judged at p99
+                 over the whole query mix at 1k clusters.
+      freshness  a real Store + SearchIngestor under ClusterObjectSummary
+                 churn: per-wave lag samples (store rv minus the published
+                 snapshot rv) must stay bounded by the outstanding
+                 backlog, and after the final flush the index must sit
+                 exactly at the store tip (lag 0).
+
+    The JSON line asserts pass_speedup (>= 5x) / pass_freshness."""
+    import random as _random
+    import time as _time
+
+    from karmada_tpu.api.meta import ObjectMeta
+    from karmada_tpu.api.search import (
+        ClusterObjectSummary,
+        ObjectSummaryRow,
+        summary_name,
+    )
+    from karmada_tpu.search import (
+        ColumnarIndex,
+        SearchIngestor,
+        Term,
+        compile_query,
+        execute,
+    )
+    from karmada_tpu.store.store import Store
+
+    rng = _random.Random(17)
+    n_clusters, per = SEARCH_CLUSTERS, SEARCH_OBJECTS_PER_CLUSTER
+    gvk = "apps/v1/Deployment"
+    apps = [f"app-{i}" for i in range(50)]
+    tiers = ["web", "db", "cache", "batch"]
+
+    index = ColumnarIndex()
+    shards: dict = {}  # the fan-out baseline's per-member caches
+    names = []
+    for c in range(n_clusters):
+        cname = f"member-{c:04d}"
+        shard = []
+        for i in range(per):
+            name = f"{rng.choice(apps)}-{c}-{i}"
+            labels = {"app": rng.choice(apps), "tier": rng.choice(tiers)}
+            fields = {"metadata.name": name,
+                      "metadata.namespace": "default",
+                      "spec.replicas": str(rng.randint(1, 64))}
+            doc = {"apiVersion": "apps/v1", "kind": "Deployment",
+                   "metadata": {"name": name, "namespace": "default",
+                                "labels": labels}}
+            index.upsert(cname, gvk, "default", name,
+                         labels=labels, fields=fields,
+                         rv=c * per + i + 1, doc=doc)
+            shard.append((name, labels, fields, doc))
+            names.append(name)
+        shards[cname] = shard
+    snap = index.publish()
+
+    params = []
+    params += [{"labelSelector": f"app={rng.choice(apps)}"}
+               for _ in range(20)]
+    params += [{"labelSelector":
+                f"app in ({', '.join(rng.sample(apps, 3))}),tier=web"}
+               for _ in range(10)]
+    params += [{"fieldSelector": f"metadata.name={rng.choice(names)}"}
+               for _ in range(10)]
+    params += [{"nameContains": f"app-{rng.randint(0, 49)}-"}
+               for _ in range(10)]
+    compiled = [compile_query(p) for p in params]
+
+    def term_match(t: Term, d: dict) -> bool:
+        have = t.key in d
+        if t.op == "exists":
+            return have
+        if t.op == "nexists":
+            return not have
+        if t.op == "eq":
+            return have and d[t.key] == t.values[0]
+        if t.op == "neq":
+            return not have or d[t.key] != t.values[0]
+        if t.op == "in":
+            return have and d[t.key] in t.values
+        return not have or d[t.key] not in t.values  # notin
+
+    def fanout_exec(q) -> list:
+        # the pre-columnar serving shape: one Python pass per member
+        out = []
+        for cname in sorted(shards):
+            for name, labels, fields, doc in shards[cname]:
+                if q.name_contains and q.name_contains not in name:
+                    continue
+                if not all(term_match(t, labels) for t in q.labels):
+                    continue
+                if not all(term_match(t, fields) for t in q.fields):
+                    continue
+                out.append(doc)
+        return out
+
+    col_lat, fan_lat = [], []
+    parity_ok = True
+    for q in compiled:  # warm pass + cross-check
+        if len(execute(snap, q)) != len(fanout_exec(q)):
+            parity_ok = False
+    for _ in range(3):
+        for q in compiled:
+            t0 = _time.perf_counter()
+            execute(snap, q)
+            col_lat.append(_time.perf_counter() - t0)
+            t0 = _time.perf_counter()
+            fanout_exec(q)
+            fan_lat.append(_time.perf_counter() - t0)
+
+    def pctl(lat, frac):
+        lat = sorted(lat)
+        return lat[min(len(lat) - 1, int(np.ceil(frac * len(lat))) - 1)]
+
+    col_p99, fan_p99 = pctl(col_lat, 0.99), pctl(fan_lat, 0.99)
+    speedup = fan_p99 / max(col_p99, 1e-9)
+
+    # -- freshness under churn -------------------------------------------
+    store = Store()
+    fidx = ColumnarIndex()
+    ing = SearchIngestor(store, fidx)
+    waves, churn_clusters, churn_rows = 10, 50, 5
+    lag_samples = []
+    writes = 0
+    try:
+        for w in range(waves):
+            for c in range(churn_clusters):
+                cname = f"churn-{c:03d}"
+                rows = [
+                    ObjectSummaryRow(
+                        namespace="default", name=f"obj-{i}",
+                        labels={"wave": str(w)},
+                        manifest={"metadata": {
+                            "name": f"obj-{i}", "namespace": "default",
+                            "labels": {"wave": str(w)}}})
+                    for i in range(churn_rows)
+                ]
+                store.apply(ClusterObjectSummary(
+                    metadata=ObjectMeta(
+                        name=summary_name(cname, "apps/v1", "Deployment")),
+                    cluster=cname, api_version="apps/v1",
+                    object_kind="Deployment", rows=rows))
+                writes += 1
+            lag_samples.append(
+                max(store.current_rv - fidx.snapshot().rv, 0))
+        flushed = ing.flush(timeout=60.0)
+        final_lag = max(store.current_rv - fidx.snapshot().rv, 0)
+    finally:
+        ing.close()
+    max_lag = max(lag_samples) if lag_samples else 0
+    # mid-churn lag can never exceed the writes still outstanding
+    pass_freshness = bool(flushed and final_lag == 0 and max_lag <= writes)
+
+    pass_speedup = bool(speedup >= 5.0 and parity_ok)
+    if verbose:
+        print(f"# search: columnar p99 {col_p99 * 1e3:.2f}ms vs fanout "
+              f"p99 {fan_p99 * 1e3:.2f}ms ({speedup:.1f}x, parity "
+              f"{parity_ok}); churn lag max {max_lag} final {final_lag}")
+    return {
+        "metric": "search_columnar_speedup",
+        "value": round(speedup, 2),
+        "unit": "x",
+        "backend": backend_label,
+        "clusters": n_clusters,
+        "objects": snap.count,
+        "queries": len(compiled),
+        "columnar_p50_s": round(pctl(col_lat, 0.50), 6),
+        "columnar_p99_s": round(col_p99, 6),
+        "fanout_p50_s": round(pctl(fan_lat, 0.50), 6),
+        "fanout_p99_s": round(fan_p99, 6),
+        "parity_ok": bool(parity_ok),
+        "freshness": {
+            "waves": waves, "writes": writes,
+            "max_lag_rvs": int(max_lag),
+            "final_lag_rvs": int(final_lag),
+            "flushed": bool(flushed),
+        },
+        "pass_speedup": pass_speedup,
+        "pass_freshness": pass_freshness,
+        "pass": bool(pass_speedup and pass_freshness),
+    }
+
+
 def build_flagship_cold(seed=0, n_clusters=5000, n_bindings=10000):
     """North-star variant, adversarial to the per-placement encode cache:
     every measured iteration bumps each binding's generation first
@@ -3602,6 +3797,7 @@ CONFIGS = {
     "preempt": (None, None),  # workload-class scheduling; run_preempt
     "candidates": (None, None),  # top-K vs dense solve; run_candidates
     "analysis": (None, None),  # invariant analysis sweep; run_analysis
+    "search": (None, None),  # columnar fleet search vs fan-out; run_search
     "flagship_cold": (build_flagship_cold, None),  # named after the shape
     "flagship": (build_flagship, None),  # metric name carries the shape
 }
@@ -3609,7 +3805,8 @@ DEFAULT_ORDER = [
     "dup3", "static", "dynamic", "spread", "spread_skewed", "churn",
     "churn_incremental", "autoshard", "pipeline", "whatif", "degraded",
     "coldstart", "stream", "fanout", "writeload", "replica", "elastic",
-    "preempt", "candidates", "analysis", "flagship_cold", "flagship",
+    "preempt", "candidates", "analysis", "search", "flagship_cold",
+    "flagship",
 ]
 
 
@@ -3678,6 +3875,12 @@ RESULT_SCHEMAS = {
                  "findings_total": "int", "baseline_entries": "int",
                  "new_findings": "int", "stale_baseline": "int",
                  "pass_clean": "bool", "pass": "bool"},
+    "search": {**_ENVELOPE, "clusters": "int", "objects": "int",
+               "queries": "int", "columnar_p50_s": "num",
+               "columnar_p99_s": "num", "fanout_p50_s": "num",
+               "fanout_p99_s": "num", "parity_ok": "bool",
+               "freshness": "dict", "pass_speedup": "bool",
+               "pass_freshness": "bool", "pass": "bool"},
     "flagship_cold": _ROUND,
     "flagship": _ROUND,
 }
@@ -4111,6 +4314,18 @@ def run_bench(args) -> None:
                 }
             # host-side stdlib sweep: meaningful on any backend
             lines.append(_validated_line("analysis", rec))
+            continue
+        if name == "search":
+            try:
+                rec = run_search(backend, verbose=args.verbose)
+            except Exception as e:  # noqa: BLE001 - one labeled error line
+                rec = {
+                    "metric": "search_columnar_speedup",
+                    "value": None, "unit": "x", "backend": backend,
+                    "error": f"{type(e).__name__}: {e}"[:300],
+                }
+            # numpy-on-host query plane: meaningful on any backend
+            lines.append(_validated_line("search", rec))
             continue
         if name == "stream":
             import types

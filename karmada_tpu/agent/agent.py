@@ -58,7 +58,8 @@ class Lease:
 class KarmadaAgent:
     def __init__(self, store: Store, member, interpreter, runtime: Runtime,
                  status_flush_delay: float = 0.0,
-                 metrics_reports: bool = False):
+                 metrics_reports: bool = False,
+                 search_reports: bool = False):
         """`status_flush_delay` > 0 coalesces the per-Work applied-condition
         status reports through a WriteCoalescer (store/batching.py): a
         settle pass draining N Works writes their conditions as one batch
@@ -70,12 +71,20 @@ class KarmadaAgent:
         ELASTICITY.md) publishes a WorkloadMetricsReport for this member on
         every heartbeat — riding the SAME coalesced status path when one is
         configured, so utilization reporting costs the fleet no extra
-        round-trips beyond the Work conditions it already batches."""
+        round-trips beyond the Work conditions it already batches.
+
+        `search_reports=True` (the search plane's remote ingest feed,
+        docs/SEARCH.md) publishes a ClusterObjectSummary per registry-
+        selected (apiVersion, kind) on every heartbeat — the same coalesced
+        status path and the same change-suppression discipline, so a quiet
+        cluster costs the plane zero search writes."""
         self.store = store
         self.member = member
         self.interpreter = interpreter
         self.metrics_reports = metrics_reports
+        self.search_reports = search_reports
         self._report_cache: dict = {}  # change-suppression, no read RTT
+        self._search_cache: dict = {}  # (apiVersion, kind) -> row signature
         self.clock = runtime.clock
         self.namespace = work_namespace_for_cluster(member.name)
         self._status_coalescer = None
@@ -207,6 +216,64 @@ class KarmadaAgent:
                 coalescer=self._status_coalescer,
                 cache=self._report_cache,
             )
+        if self.search_reports:
+            self._publish_search_summaries()
+
+    def _publish_search_summaries(self) -> None:
+        """Per-(apiVersion, kind) ClusterObjectSummary feed for the search
+        plane (docs/SEARCH.md). Level-triggered: each summary wholly
+        replaces its (cluster, gvk) index slice, a deselected gvk is
+        retracted with an empty-rows summary, and unchanged summaries are
+        suppressed agent-side so the plane sees no write at all."""
+        from ..api.search import (
+            ClusterObjectSummary,
+            ObjectSummaryRow,
+            summary_name,
+        )
+        from ..search.columnar import field_pairs_of
+        from ..search.search import selection_map
+
+        owed = {gvk for gvk, clusters in selection_map(self.store).items()
+                if self.member.name in clusters}
+        rows_by_gvk: dict[tuple, list] = {gvk: [] for gvk in owed}
+        for obj in self.member.objects():
+            bucket = rows_by_gvk.get((obj.api_version, obj.kind))
+            if bucket is None:
+                continue
+            manifest = obj.to_dict()
+            bucket.append(ObjectSummaryRow(
+                namespace=obj.namespace,
+                name=obj.name,
+                uid=obj.metadata.uid,
+                labels=dict(obj.metadata.labels),
+                fields=field_pairs_of(manifest),
+                manifest=manifest,
+            ))
+        # retract slices this cluster no longer owes (registry drift)
+        for gvk in set(self._search_cache) - owed:
+            rows_by_gvk.setdefault(gvk, [])
+        for (av, kind), rows in sorted(rows_by_gvk.items()):
+            rows.sort(key=lambda r: (r.namespace, r.name))
+            sig = [(r.namespace, r.name, r.uid, r.labels, r.fields,
+                    r.manifest) for r in rows]
+            if self._search_cache.get((av, kind)) == sig:
+                continue
+            summary = ClusterObjectSummary(
+                metadata=ObjectMeta(name=summary_name(self.member.name, av, kind)),
+                cluster=self.member.name,
+                api_version=av,
+                object_kind=kind,
+                rows=rows,
+                reported_at=self.clock.now(),
+            )
+            if self._status_coalescer is not None:
+                self._status_coalescer.apply(summary)
+            else:
+                self.store.apply(summary)
+            if sig:
+                self._search_cache[(av, kind)] = sig
+            else:
+                self._search_cache.pop((av, kind), None)
 
 
 class LeaseFailureDetector:

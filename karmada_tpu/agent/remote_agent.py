@@ -30,7 +30,8 @@ class RemoteAgentSession:
                  member: Optional[InMemoryMember] = None,
                  token: Optional[str] = None, cafile: Optional[str] = None,
                  status_flush_delay: float = 0.005,
-                 metrics_reports: bool = False):
+                 metrics_reports: bool = False,
+                 search_reports: bool = False):
         """`status_flush_delay`: the agent-side write-coalescing knob —
         per-Work status reports buffer this many seconds and commit as one
         POST /objects/batch instead of one round-trip each (a thousand
@@ -40,7 +41,11 @@ class RemoteAgentSession:
         `metrics_reports=True`: publish this member's WorkloadMetricsReport
         on every heartbeat (the elasticity plane's feed, docs/ELASTICITY.md)
         — riding the same coalescing buffer, so utilization reporting adds
-        zero extra round-trips to the status batch."""
+        zero extra round-trips to the status batch.
+
+        `search_reports=True`: publish registry-selected member objects as
+        ClusterObjectSummary on every heartbeat (the search plane's remote
+        ingest feed, docs/SEARCH.md), on the same buffer again."""
         if config.sync_mode != "Pull":
             raise ValueError("remote agents serve Pull clusters")
         self.config = config
@@ -52,7 +57,8 @@ class RemoteAgentSession:
         self.agent = KarmadaAgent(self.store, self.member, interpreter,
                                   self.runtime,
                                   status_flush_delay=status_flush_delay,
-                                  metrics_reports=metrics_reports)
+                                  metrics_reports=metrics_reports,
+                                  search_reports=search_reports)
         # the agent's own workStatus controller (agent.go:248-433 runs
         # execution + workStatus + clusterStatus member-side): reflect this
         # member's object status into work.status over the wire

@@ -157,11 +157,16 @@ def _scan_function(index: ModuleIndex, fn: FunctionInfo) -> list[Finding]:
     return findings
 
 
-def analyze(index: ModuleIndex, scope: str = "karmada_tpu/store/"
-            ) -> list[Finding]:
+# the under-lock planes this suite audits: the store (every serving path
+# holds its lock) and the search plane (ingest cv + index swap lock)
+DEFAULT_SCOPES = ("karmada_tpu/store/", "karmada_tpu/search/")
+
+
+def analyze(index: ModuleIndex, scope=DEFAULT_SCOPES) -> list[Finding]:
+    scopes = (scope,) if isinstance(scope, str) else tuple(scope)
     findings: list[Finding] = []
     for relpath, mod in index.modules.items():
-        if scope not in relpath:
+        if not any(s in relpath for s in scopes):
             continue
         for fn in mod.functions.values():
             findings.extend(_scan_function(index, fn))

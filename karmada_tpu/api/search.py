@@ -15,6 +15,54 @@ from .policy import ClusterAffinity
 
 KIND_RESOURCE_REGISTRY = "ResourceRegistry"
 KIND_FEDERATED_RESOURCE_QUOTA = "FederatedResourceQuota"
+KIND_CLUSTER_OBJECT_SUMMARY = "ClusterObjectSummary"
+
+
+@dataclass
+class ObjectSummaryRow:
+    """One member object as the search plane ingests it: the selector
+    surface (labels, flattened scalar fields) pre-extracted next to the
+    full manifest the query plane materializes."""
+
+    namespace: str = ""
+    name: str = ""
+    uid: str = ""
+    labels: dict[str, str] = field(default_factory=dict)
+    fields: dict[str, str] = field(default_factory=dict)
+    manifest: dict = field(default_factory=dict)
+
+
+@dataclass
+class ClusterObjectSummary:
+    """Per-(cluster, gvk) object summary published by a member's agent on
+    its heartbeat, riding the coalesced agent-status write path — the
+    search plane's remote ingest feed (docs/SEARCH.md). Level-triggered
+    and last-write-wins: a summary wholly REPLACES the (cluster, gvk)
+    slice of the columnar index, so the plane-side fold needs no diff
+    protocol and an empty `rows` retracts the slice. Named
+    `{cluster}.{kind}` (cluster-scoped, like WorkloadMetricsReport)."""
+
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    cluster: str = ""
+    api_version: str = ""
+    object_kind: str = ""  # the summarized Kind; `kind` is this object's
+    rows: list[ObjectSummaryRow] = field(default_factory=list)
+    reported_at: float = 0.0
+    kind: str = KIND_CLUSTER_OBJECT_SUMMARY
+
+    @property
+    def name(self) -> str:
+        return self.metadata.name
+
+    @property
+    def gvk(self) -> str:
+        return f"{self.api_version}/{self.object_kind}"
+
+
+def summary_name(cluster: str, api_version: str, kind: str) -> str:
+    """Deterministic ClusterObjectSummary object name: one per
+    (cluster, gvk), so heartbeats upsert in place."""
+    return f"{cluster}.{api_version.replace('/', '-')}.{kind.lower()}"
 
 
 @dataclass
@@ -29,6 +77,9 @@ class BackendStoreConfig:
 
     type: str = "memory"  # memory | opensearch
     addresses: list[str] = field(default_factory=list)
+    # auto-flush the bulk queue once it holds this many operations
+    # (0 = only the end-of-sweep flush)
+    flush_threshold: int = 0
 
 
 @dataclass

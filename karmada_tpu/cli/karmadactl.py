@@ -1144,6 +1144,66 @@ def cmd_trace(cp: ControlPlane, kind: str, ref: str,
     return render_waterfall(trace)
 
 
+def cmd_search(cp: ControlPlane, kind: str = "", selector: str = "",
+               field_selector: str = "", namespace: str = "",
+               clusters: str = "", name_contains: str = "",
+               at_rv: Optional[int] = None, limit: int = 0,
+               output: str = "") -> str:
+    """`karmadactl search [apiVersion/]Kind [-l ...]` — one vectorized
+    query over the fleet-wide columnar index (docs/SEARCH.md) instead of
+    a per-cluster fan-out. In-process planes execute against the plane's
+    own index; --server planes ride GET /search, preferring follower
+    replicas when configured. `--at-rv` pins the snapshot: the answer
+    never shows a row folded after that revision."""
+    search = getattr(cp, "search", None)
+    if search is None:
+        raise CLIError("this plane does not expose the search plane")
+    params: dict = {}
+    if kind:
+        av, sep, k = kind.rpartition("/")
+        if sep:
+            params["apiVersion"], params["kind"] = av, k
+        else:
+            params["kind"] = kind
+    if selector:
+        params["labelSelector"] = selector
+    if field_selector:
+        params["fieldSelector"] = field_selector
+    if namespace:
+        params["namespace"] = namespace
+    if clusters:
+        params["clusters"] = clusters
+    if name_contains:
+        params["nameContains"] = name_contains
+    if limit:
+        params["limit"] = str(limit)
+    try:
+        result = search(params, at_rv=at_rv)
+    except ValueError as e:  # QueryError: bad selector syntax
+        raise CLIError(str(e))
+    except LookupError as e:  # SnapshotExpired / search-less replica
+        raise CLIError(str(e))
+    if output == "json":
+        return json.dumps(
+            {"resourceVersion": result.rv,
+             "items": [o.to_dict() for o in result.items]},
+            indent=2, default=str)
+    from ..search.search import CLUSTER_ANNOTATION
+
+    rows = [
+        [o.metadata.annotations.get(CLUSTER_ANNOTATION, "-"),
+         o.namespace or "-", o.name, f"{o.api_version}/{o.kind}"]
+        for o in result.items
+    ]
+    head = f"rv: {result.rv} ({len(rows)} item{'s' if len(rows) != 1 else ''})"
+    if getattr(result, "replicated_rv", 0):
+        head += f"  replicated rv: {result.replicated_rv}"
+    if not rows:
+        return head
+    return head + "\n" + _fmt_table(
+        rows, ["CLUSTER", "NAMESPACE", "NAME", "KIND"])
+
+
 def cmd_replication_status(cp: ControlPlane) -> str:
     """`karmadactl replication status` — this plane's replication role;
     on a leader, one row per follower with its rv lag (docs/HA.md).
@@ -1589,6 +1649,23 @@ def run(cp: ControlPlane, argv: list[str]) -> str:
     p.add_argument("ref", help="namespace/name of the ResourceBinding")
     p.add_argument("-o", "--output", default="",
                    help="'' (waterfall) or json")
+    p = sub.add_parser("search")
+    p.add_argument("kind", nargs="?", default="",
+                   help="Kind or apiVersion/Kind (e.g. apps/v1/Deployment)")
+    p.add_argument("-l", "--selector", default="",
+                   help="label selector (=, !=, in (...), notin (...), key)")
+    p.add_argument("--field-selector", default="",
+                   help="field selector (metadata.name=..., spec.*=...)")
+    p.add_argument("-n", "--namespace", default="")
+    p.add_argument("--clusters", default="",
+                   help="comma-separated member cluster filter")
+    p.add_argument("--name-contains", default="",
+                   help="substring match on object name")
+    p.add_argument("--at-rv", type=int, default=None,
+                   help="pin the query to the snapshot at this rv")
+    p.add_argument("--limit", type=int, default=0)
+    p.add_argument("-o", "--output", default="",
+                   help="'' (table) or json")
     p = sub.add_parser("replication")
     p.add_argument("action", nargs="?", default="status",
                    help="status (per-follower lag on a leader; role + "
@@ -1763,6 +1840,13 @@ def run(cp: ControlPlane, argv: list[str]) -> str:
         return cmd_elections(cp, wide=args.output == "wide")
     if args.command == "trace":
         return cmd_trace(cp, args.kind, args.ref, output=args.output)
+    if args.command == "search":
+        return cmd_search(
+            cp, args.kind, selector=args.selector,
+            field_selector=args.field_selector, namespace=args.namespace,
+            clusters=args.clusters, name_contains=args.name_contains,
+            at_rv=args.at_rv, limit=args.limit, output=args.output,
+        )
     if args.command == "replication":
         if args.action != "status":
             raise CLIError(f"unknown replication action {args.action!r} "

@@ -73,7 +73,7 @@ from .proxy import ClusterProxy
 from .modeling import ModelBasedEstimator
 from .runtime.controller import Clock, Runtime
 from .sched.scheduler import SchedulerDaemon
-from .search import ResourceCache, SearchProxy
+from .search import ColumnarIndex, ResourceCache, SearchIngestor, SearchProxy
 from .store.store import ConflictError, Store
 from .webhook import default_admission_chain
 
@@ -343,9 +343,14 @@ class ControlPlane:
             if ctl("remedy") else None
         )
 
-        # Query plane (Q1-Q3)
-        self.resource_cache = ResourceCache(self.store, self.members)
+        # Query plane (Q1-Q3) + columnar search plane (docs/SEARCH.md):
+        # one index, two feeds — the cache's live member informers and the
+        # agents' ClusterObjectSummary heartbeats (idempotent by row key)
+        self.search_index = ColumnarIndex()
+        self.resource_cache = ResourceCache(self.store, self.members,
+                                            index=self.search_index)
         self.search_proxy = SearchProxy(self.resource_cache)
+        self.search_ingestor = SearchIngestor(self.store, self.search_index)
         self.frq_sync_controller = (
             FederatedResourceQuotaSyncController(self.store, self.runtime)
             if ctl("federatedResourceQuotaSync") else None
@@ -617,6 +622,19 @@ class ControlPlane:
         simulator instead of the store — returns the displacement report,
         mutates nothing (the report is NOT persisted either)."""
         return self.descheduler.deschedule_dryrun(diff_limit=diff_limit)
+
+    # -- fleet-wide search (search/columnar.py, docs/SEARCH.md) ------------
+
+    def search(self, params: dict, *, at_rv=None, trace_id: str = ""):
+        """Vectorized fleet query over the columnar member-object index.
+        `params` uses the GET /search wire names (kind, apiVersion,
+        namespace, name, nameContains, clusters, labelSelector,
+        fieldSelector, limit). Raises QueryError on bad selector syntax,
+        SnapshotExpired when `at_rv` predates the snapshot ring."""
+        from .search import compile_query, run_query
+
+        return run_query(self.search_index, compile_query(params),
+                         at_rv=at_rv, trace_id=trace_id)
 
     # -- placement traces (tracing/, docs/OBSERVABILITY.md) ----------------
 

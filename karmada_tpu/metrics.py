@@ -531,6 +531,47 @@ candidate_truncations = registry.counter(
     "rows (nonzero means compact decisions may diverge from exact dense)",
 )
 
+# -- search plane (docs/SEARCH.md) ------------------------------------------
+
+search_index_objects = registry.gauge(
+    "karmada_search_index_objects",
+    "Live rows in the columnar search index (published snapshot size)",
+)
+search_ingest_rows = registry.counter(
+    "karmada_search_ingest_rows_total",
+    "Rows folded into the columnar index, by feed (summary/live) and op "
+    "(upsert/remove)",
+)
+search_publishes = registry.counter(
+    "karmada_search_publishes_total",
+    "Snapshot publishes of the columnar index (each opens a new rv pin "
+    "point on the snapshot ring)",
+)
+search_queries = registry.counter(
+    "karmada_search_queries_total",
+    "Search queries executed, by pinned (at_rv present) or not",
+)
+search_query_seconds = registry.histogram(
+    "karmada_search_query_seconds",
+    "Vectorized mask-and-gather execution time per search query (p50/p99 "
+    "come from the bucket math; exemplars carry the caller's trace id)",
+)
+search_freshness_lag_rvs = registry.gauge(
+    "karmada_search_freshness_lag_rvs",
+    "Per-cluster ingest lag: plane store rv minus the cluster's last "
+    "folded summary rv (0 = the index has seen everything acked)",
+)
+search_ingest_queue_depth = registry.gauge(
+    "karmada_search_ingest_queue_depth",
+    "Summaries waiting in the ingest worker's bounded queue (sustained "
+    "growth means the fold is slower than the heartbeat feed)",
+)
+search_ingest_resyncs = registry.counter(
+    "karmada_search_ingest_resyncs_total",
+    "Full re-list resyncs of the ingest worker after a queue overflow "
+    "(the level-triggered recovery path; nonzero is safe but worth a look)",
+)
+
 
 class timed:
     """Context manager observing wall time into a histogram."""

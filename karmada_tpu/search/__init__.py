@@ -1,15 +1,51 @@
+from .columnar import (
+    ColumnarIndex,
+    Snapshot,
+    SnapshotExpired,
+    field_pairs_of,
+)
+from .ingest import SearchIngestor
+from .query import (
+    Query,
+    QueryError,
+    QueryResult,
+    Term,
+    compile_query,
+    execute,
+    parse_field_selector,
+    parse_label_selector,
+    run_query,
+)
 from .search import (
     BackendStore,
     InMemoryBackend,
     OpenSearchBackend,
     ResourceCache,
     SearchProxy,
+    selected_clusters,
+    selection_map,
 )
 
 __all__ = [
     "BackendStore",
+    "ColumnarIndex",
     "InMemoryBackend",
     "OpenSearchBackend",
+    "Query",
+    "QueryError",
+    "QueryResult",
     "ResourceCache",
+    "SearchIngestor",
     "SearchProxy",
+    "Snapshot",
+    "SnapshotExpired",
+    "Term",
+    "compile_query",
+    "execute",
+    "field_pairs_of",
+    "parse_field_selector",
+    "parse_label_selector",
+    "run_query",
+    "selected_clusters",
+    "selection_map",
 ]

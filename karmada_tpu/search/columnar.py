@@ -1,0 +1,426 @@
+"""Columnar member-object index: the fleet-wide search plane's storage.
+
+The dict cache in search.py answers "find every failing pod across 5k
+clusters" with a Python loop per object. This module holds the same
+objects arrow-style — parallel int columns keyed by interned
+cluster/gvk/namespace/name ids, plus padded [N, L] matrices of interned
+label/field (key, value) pairs — so a selector compiles to one
+vectorized mask-and-gather (query.py) instead of a per-object scan.
+
+Layout (docs/SEARCH.md):
+
+* dictionaries (`utils/interner.py`): one per column family. Id 0 is
+  "absent"; ids are first-seen ordered. Matching uses `peek` — a value
+  never interned matches nothing and NEVER grows the vocabulary.
+* builder: growable Python-list columns + a (cluster, gvk, ns, name) →
+  row dict; deletes tombstone the row onto a free list, upserts reuse it.
+* snapshots: `publish(rv)` compacts live rows SORTED by their
+  (cluster, gvk, ns, name) string key — byte-identical order to the dict
+  cache's `sorted(cache.items())` — into immutable numpy arrays stamped
+  with the plane rv. The last `ring` snapshots are retained so a query
+  pinned `at_rv=R` is served from the newest snapshot whose rv <= R:
+  ingest churn after the pin is invisible, the watch-cache rv discipline
+  applied to search (docs/SEARCH.md "rv semantics").
+
+The builder/swap lock is a `make_lock` seam: under KARMADA_TPU_LOCKCHECK
+the lock-order watchdog sees every hold. Queries never take it — they
+read a published snapshot reference, and snapshots are immutable.
+"""
+from __future__ import annotations
+
+from collections import deque
+from dataclasses import dataclass
+from typing import Any, Optional
+
+import numpy as np
+
+from ..analysis.lockorder import make_lock
+from ..utils.interner import Interner
+
+# Interned (key, value) pairs are joined on the unit separator — a byte
+# that cannot appear in a label key or value — so "a=b,c" style values
+# cannot alias a different (key, value) split.
+PAIR_SEP = "\x1f"
+
+# snapshots retained for at_rv pins; a pin older than the ring answers
+# "expired" (the k8s 410 Gone analogue), never a newer snapshot
+DEFAULT_RING = 32
+
+
+class SnapshotExpired(LookupError):
+    """The requested at_rv pin predates every retained snapshot."""
+
+
+def pair_id(interner: Interner, key: str, value: str) -> int:
+    return interner.id(f"{key}{PAIR_SEP}{value}")
+
+
+def peek_pair(interner: Interner, key: str, value: str) -> Optional[int]:
+    return interner.peek(f"{key}{PAIR_SEP}{value}")
+
+
+def field_pairs_of(doc: dict) -> dict[str, str]:
+    """The field-selector surface of an object: metadata.name/namespace
+    plus every SCALAR one level under spec/status (`status.phase` et al),
+    stringified the way `kubectl --field-selector` compares them."""
+    meta = doc.get("metadata") or {}
+    out = {
+        "metadata.name": str(meta.get("name", "")),
+        "metadata.namespace": str(meta.get("namespace", "")),
+    }
+    for top in ("spec", "status"):
+        block = doc.get(top)
+        if not isinstance(block, dict):
+            continue
+        for k, v in block.items():
+            if isinstance(v, bool):
+                out[f"{top}.{k}"] = "true" if v else "false"
+            elif isinstance(v, (str, int, float)):
+                out[f"{top}.{k}"] = str(v)
+    return out
+
+
+@dataclass(frozen=True)
+class Snapshot:
+    """One immutable published view: compacted parallel arrays over live
+    rows, pre-sorted by (cluster, gvk, ns, name) so any mask's gather
+    comes out in the dict cache's deterministic order."""
+
+    rv: int
+    cluster_ids: np.ndarray  # [N] int32
+    gvk_ids: np.ndarray      # [N] int32
+    ns_ids: np.ndarray       # [N] int32
+    name_ids: np.ndarray     # [N] int32
+    rvs: np.ndarray          # [N] int64: per-row ingest rv (<= self.rv)
+    label_pairs: np.ndarray  # [N, L] int32, 0-padded interned k=v pairs
+    label_keys: np.ndarray   # [N, L] int32, 0-padded interned bare keys
+    field_pairs: np.ndarray  # [N, F] int32, 0-padded interned field k=v
+    docs: tuple              # [N] annotated Unstructured refs
+    # shared dictionaries (append-only; every id this snapshot holds is
+    # already assigned, so concurrent growth cannot reorder a lookup)
+    clusters: Interner
+    gvks: Interner
+    namespaces: Interner
+    names: Interner
+    lpairs: Interner
+    lkeys: Interner
+    fpairs: Interner
+    name_dict: np.ndarray    # [V] unicode: the name dictionary at publish
+    gvk_dict: np.ndarray     # [G] unicode: the gvk dictionary at publish
+
+    @property
+    def count(self) -> int:
+        return int(self.cluster_ids.shape[0])
+
+
+_EMPTY_I32 = np.zeros(0, np.int32)
+
+
+def _doc_rv(doc: Any):
+    """Change-suppression signal: the object's own resourceVersion (the
+    MEMBER store's stamp, carried in the manifest), or None when absent —
+    None never equals None-with-a-doc swap because both sides compare."""
+    if doc is None:
+        return None
+    try:
+        return doc.metadata.resource_version
+    except AttributeError:
+        return None
+
+
+def _empty_snapshot(idx: "ColumnarIndex") -> Snapshot:
+    return Snapshot(
+        rv=0,
+        cluster_ids=_EMPTY_I32, gvk_ids=_EMPTY_I32, ns_ids=_EMPTY_I32,
+        name_ids=_EMPTY_I32, rvs=np.zeros(0, np.int64),
+        label_pairs=np.zeros((0, 0), np.int32),
+        label_keys=np.zeros((0, 0), np.int32),
+        field_pairs=np.zeros((0, 0), np.int32),
+        docs=(),
+        clusters=idx.clusters, gvks=idx.gvks, namespaces=idx.namespaces,
+        names=idx.names, lpairs=idx.lpairs, lkeys=idx.lkeys,
+        fpairs=idx.fpairs,
+        name_dict=np.array([""], dtype=object),
+        gvk_dict=np.array([""], dtype=object),
+    )
+
+
+class ColumnarIndex:
+    """Builder + snapshot ring. Writers (the ResourceCache live feed and
+    the SearchIngestor worker) call upsert/remove then publish; readers
+    take `snapshot()` and run query.execute against it lock-free."""
+
+    def __init__(self, ring: int = DEFAULT_RING):
+        self._lock = make_lock("search.index._lock")
+        self.clusters = Interner()
+        self.gvks = Interner()
+        self.namespaces = Interner()
+        self.names = Interner()
+        self.lpairs = Interner()   # label "key<US>value" pairs
+        self.lkeys = Interner()    # bare label keys (exists/!key)
+        self.fpairs = Interner()   # field "key<US>value" pairs
+        # builder columns (row-parallel); tombstoned rows keep their slot
+        self._keys: list[Optional[tuple]] = []   # (cluster, gvk, ns, name)
+        self._cluster: list[int] = []
+        self._gvk: list[int] = []
+        self._ns: list[int] = []
+        self._name: list[int] = []
+        self._rv: list[int] = []
+        self._lp: list[tuple[int, ...]] = []
+        self._lk: list[tuple[int, ...]] = []
+        self._fp: list[tuple[int, ...]] = []
+        self._docs: list[Any] = []
+        self._rows: dict[tuple, int] = {}
+        self._free: list[int] = []
+        self._dirty = False
+        self._max_rv = 0
+        self._cluster_rv: dict[str, int] = {}
+        self._snap = _empty_snapshot(self)
+        self._ring: deque = deque(maxlen=max(ring, 1))
+        self._ring.append(self._snap)
+        self.publishes = 0
+
+    # -- writes -----------------------------------------------------------
+
+    def upsert(self, cluster: str, gvk: str, namespace: str, name: str, *,
+               labels: Optional[dict] = None, fields: Optional[dict] = None,
+               rv: int = 0, doc: Any = None) -> bool:
+        """Insert or replace one row. `doc` is the fully annotated object
+        the query plane materializes (immutable by contract — the cache
+        annotates its own copy). `rv` is the plane rv this row state was
+        observed at; rows never move backwards in rv.
+
+        Change-suppressed: a re-report of an unchanged row (same selector
+        surface, same object resourceVersion) notes the freshness rv but
+        neither dirties the builder nor advances the row's rv — the
+        periodic sweep's full re-feed then republishes the snapshot tip
+        with a new stamp instead of rebuilding the arrays. Returns True
+        when the row actually changed."""
+        key = (cluster, gvk, namespace, name)
+        # intern OUTSIDE the row lock: interners have their own locks and
+        # the ids are stable whoever assigns them first
+        cid = self.clusters.id(cluster)
+        gid = self.gvks.id(gvk)
+        nid = self.namespaces.id(namespace)
+        mid = self.names.id(name)
+        lp = tuple(sorted(
+            pair_id(self.lpairs, k, v) for k, v in (labels or {}).items()))
+        lk = tuple(sorted(self.lkeys.id(k) for k in (labels or {})))
+        fp = tuple(sorted(
+            pair_id(self.fpairs, k, v) for k, v in (fields or {}).items()))
+        with self._lock:
+            row = self._rows.get(key)
+            if (row is not None
+                    and lp == self._lp[row] and lk == self._lk[row]
+                    and fp == self._fp[row]
+                    and _doc_rv(doc) == _doc_rv(self._docs[row])):
+                self._note_rv(cluster, rv)
+                return False
+            if row is None:
+                if self._free:
+                    row = self._free.pop()
+                    self._keys[row] = key
+                    self._cluster[row] = cid
+                    self._gvk[row] = gid
+                    self._ns[row] = nid
+                    self._name[row] = mid
+                    self._rv[row] = rv
+                    self._lp[row] = lp
+                    self._lk[row] = lk
+                    self._fp[row] = fp
+                    self._docs[row] = doc
+                else:
+                    row = len(self._keys)
+                    self._keys.append(key)
+                    self._cluster.append(cid)
+                    self._gvk.append(gid)
+                    self._ns.append(nid)
+                    self._name.append(mid)
+                    self._rv.append(rv)
+                    self._lp.append(lp)
+                    self._lk.append(lk)
+                    self._fp.append(fp)
+                    self._docs.append(doc)
+                self._rows[key] = row
+            else:
+                self._rv[row] = max(self._rv[row], rv)
+                self._lp[row] = lp
+                self._lk[row] = lk
+                self._fp[row] = fp
+                self._docs[row] = doc
+            self._note_rv(cluster, rv)
+            self._dirty = True
+            return True
+
+    def remove(self, cluster: str, gvk: str, namespace: str, name: str,
+               rv: int = 0) -> bool:
+        """Tombstone one row; no-op (False) when absent — removal is
+        level-triggered and both feeds may race to report the same gone
+        object."""
+        key = (cluster, gvk, namespace, name)
+        with self._lock:
+            row = self._rows.pop(key, None)
+            self._note_rv(cluster, rv)
+            if row is None:
+                return False
+            self._keys[row] = None
+            self._docs[row] = None
+            self._lp[row] = ()
+            self._lk[row] = ()
+            self._fp[row] = ()
+            self._free.append(row)
+            self._dirty = True
+            return True
+
+    def drop_cluster(self, cluster: str, rv: int = 0) -> int:
+        """Forget every row of an unjoined cluster (detach path)."""
+        with self._lock:
+            rows = [(k, r) for k, r in self._rows.items() if k[0] == cluster]
+            for key, row in rows:
+                del self._rows[key]
+                self._keys[row] = None
+                self._docs[row] = None
+                self._lp[row] = ()
+                self._lk[row] = ()
+                self._fp[row] = ()
+                self._free.append(row)
+            self._cluster_rv.pop(cluster, None)
+            if rows:
+                self._dirty = True
+            if rv:
+                self._max_rv = max(self._max_rv, rv)
+            return len(rows)
+
+    def _note_rv(self, cluster: str, rv: int) -> None:
+        """Caller holds self._lock."""
+        if rv:
+            self._max_rv = max(self._max_rv, rv)
+            prev = self._cluster_rv.get(cluster, 0)
+            self._cluster_rv[cluster] = max(prev, rv)
+
+    # -- publish / snapshots ---------------------------------------------
+
+    def publish(self, rv: Optional[int] = None) -> Snapshot:
+        """Compact live rows into an immutable Snapshot stamped `rv`
+        (default: the max rv folded so far) and push it onto the ring.
+        Ring rvs stay monotone — a publish stamped below the current tip
+        re-stamps AT the tip, so an at_rv pin can never resolve to two
+        different states for one rv. Clean republish (no writes since the
+        last publish) shares the tip's arrays and only re-stamps."""
+        with self._lock:
+            stamp = self._max_rv if rv is None else max(rv, self._max_rv)
+            stamp = max(stamp, self._snap.rv)
+            if not self._dirty:
+                if stamp == self._snap.rv:
+                    return self._snap
+                snap = Snapshot(
+                    rv=stamp,
+                    cluster_ids=self._snap.cluster_ids,
+                    gvk_ids=self._snap.gvk_ids, ns_ids=self._snap.ns_ids,
+                    name_ids=self._snap.name_ids, rvs=self._snap.rvs,
+                    label_pairs=self._snap.label_pairs,
+                    label_keys=self._snap.label_keys,
+                    field_pairs=self._snap.field_pairs,
+                    docs=self._snap.docs,
+                    clusters=self.clusters, gvks=self.gvks,
+                    namespaces=self.namespaces, names=self.names,
+                    lpairs=self.lpairs, lkeys=self.lkeys, fpairs=self.fpairs,
+                    name_dict=self._snap.name_dict,
+                    gvk_dict=self._snap.gvk_dict,
+                )
+            else:
+                live = sorted(self._rows.items())  # by string key tuple
+                n = len(live)
+                rows = [r for _, r in live]
+                lmax = max((len(self._lp[r]) for r in rows), default=0)
+                fmax = max((len(self._fp[r]) for r in rows), default=0)
+                lp = np.zeros((n, lmax), np.int32)
+                lk = np.zeros((n, lmax), np.int32)
+                fp = np.zeros((n, fmax), np.int32)
+                for i, r in enumerate(rows):
+                    pairs = self._lp[r]
+                    lp[i, :len(pairs)] = pairs
+                    keys = self._lk[r]
+                    lk[i, :len(keys)] = keys
+                    fpairs = self._fp[r]
+                    fp[i, :len(fpairs)] = fpairs
+                snap = Snapshot(
+                    rv=stamp,
+                    cluster_ids=np.fromiter(
+                        (self._cluster[r] for r in rows), np.int32, n),
+                    gvk_ids=np.fromiter(
+                        (self._gvk[r] for r in rows), np.int32, n),
+                    ns_ids=np.fromiter(
+                        (self._ns[r] for r in rows), np.int32, n),
+                    name_ids=np.fromiter(
+                        (self._name[r] for r in rows), np.int32, n),
+                    rvs=np.fromiter(
+                        (self._rv[r] for r in rows), np.int64, n),
+                    label_pairs=lp, label_keys=lk, field_pairs=fp,
+                    docs=tuple(self._docs[r] for r in rows),
+                    clusters=self.clusters, gvks=self.gvks,
+                    namespaces=self.namespaces, names=self.names,
+                    lpairs=self.lpairs, lkeys=self.lkeys, fpairs=self.fpairs,
+                    name_dict=np.array(self.names.strings(), dtype=object),
+                    gvk_dict=np.array(self.gvks.strings(), dtype=object),
+                )
+            self._snap = snap
+            self._dirty = False
+            self._ring.append(snap)
+            self.publishes += 1
+            return snap
+
+    def snapshot(self, at_rv: Optional[int] = None) -> Snapshot:
+        """Current snapshot, or — pinned — the newest retained snapshot
+        whose rv <= at_rv. Raises SnapshotExpired when the pin predates
+        the ring (serving a NEWER state would break the pin's guarantee;
+        the caller maps this to 410)."""
+        with self._lock:
+            if at_rv is None:
+                return self._snap
+            for snap in reversed(self._ring):
+                if snap.rv <= at_rv:
+                    return snap
+            raise SnapshotExpired(
+                f"at_rv {at_rv} predates the snapshot ring "
+                f"(oldest retained rv {self._ring[0].rv})")
+
+    # -- freshness / stats -----------------------------------------------
+
+    def cluster_rvs(self) -> dict[str, int]:
+        """Per-cluster highest folded rv — the freshness ledger the ingest
+        lag gauge compares against the store's acked rv."""
+        with self._lock:
+            return dict(self._cluster_rv)
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {
+                "rows": len(self._rows),
+                "tombstones": len(self._free),
+                "published_rv": self._snap.rv,
+                "published_rows": self._snap.count,
+                "max_rv": self._max_rv,
+                "ring": len(self._ring),
+                "publishes": self.publishes,
+                "dict_sizes": {
+                    "clusters": len(self.clusters),
+                    "gvks": len(self.gvks),
+                    "namespaces": len(self.namespaces),
+                    "names": len(self.names),
+                    "label_pairs": len(self.lpairs),
+                    "label_keys": len(self.lkeys),
+                    "field_pairs": len(self.fpairs),
+                },
+            }
+
+
+__all__ = [
+    "ColumnarIndex",
+    "Snapshot",
+    "SnapshotExpired",
+    "PAIR_SEP",
+    "field_pairs_of",
+    "pair_id",
+    "peek_pair",
+]

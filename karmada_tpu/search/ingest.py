@@ -1,0 +1,205 @@
+"""Plane-side search ingest: ClusterObjectSummary -> columnar index.
+
+Agents publish per-(cluster, gvk) ClusterObjectSummary objects on their
+heartbeat through the coalesced agent-status path (agent/agent.py). This
+worker watches the plane store for them and folds each one — wholly
+replacing that (cluster, gvk) slice of the ColumnarIndex — then
+publishes a snapshot stamped with the summary's store rv.
+
+The attach rides `Store.add_event_sink`: the sink runs UNDER the store
+lock in rv order (the same contract the watch cache rides), so the queue
+the worker drains is revision-consistent with the prime sweep — and on a
+replication FOLLOWER the identical sink sees the leader's original rvs
+and event types, which is what makes follower-served search answers
+byte-identical to the leader's at the same min_rv barrier (tested in
+tests/test_search_columnar.py).
+
+The under-lock sink does the minimum: bounded append + notify. Folding,
+publishing, metrics, and tracing happen on the worker thread. Overflow
+of the bounded queue sets a resync flag — the worker re-lists every
+summary from the store (level-triggered recovery) instead of losing the
+dropped events.
+"""
+from __future__ import annotations
+
+import copy
+import threading
+import time
+from typing import Optional
+
+from ..analysis.lockorder import make_lock
+from ..api.search import KIND_CLUSTER_OBJECT_SUMMARY
+from ..api.unstructured import Unstructured
+from .columnar import ColumnarIndex, field_pairs_of
+from .search import CLUSTER_ANNOTATION
+
+
+class SearchIngestor:
+    """One per serving plane (leader or follower). `close()` detaches the
+    sink and joins the worker."""
+
+    QUEUE_MAX = 4096
+
+    def __init__(self, store, index: ColumnarIndex, *, start: bool = True):
+        self.store = store
+        self.index = index
+        self._cv = threading.Condition(make_lock("search.ingest._cv"))
+        self._pending: list = []  # bounded by QUEUE_MAX; overflow -> resync
+        self._resync = False
+        self._busy = False
+        self._stop = False
+        # (cluster, gvk) -> the (ns, name) keys the last fold installed,
+        # so a replacement summary retracts exactly the vanished rows
+        self._slice_keys: dict[tuple, set] = {}
+        self.folded = 0
+        self._thread = threading.Thread(
+            target=self._run, name="search-ingest", daemon=True)
+        # prime runs under the store lock for every stored object: the
+        # queue starts revision-consistent with the event feed
+        self.attach_rv = store.add_event_sink(self._sink, prime=self._prime)
+        if start:
+            self._thread.start()
+
+    # -- under-lock feed (rv-ordered, minimum work) -----------------------
+
+    def _prime(self, kind: str, obj) -> None:
+        if kind == KIND_CLUSTER_OBJECT_SUMMARY:
+            with self._cv:
+                self._pending.append(("ADDED", obj))
+
+    def _sink(self, kind: str, event: str, obj) -> None:
+        if kind != KIND_CLUSTER_OBJECT_SUMMARY:
+            return
+        with self._cv:
+            if len(self._pending) >= self.QUEUE_MAX:
+                self._resync = True
+            else:
+                self._pending.append((event, obj))
+            self._cv.notify()
+
+    # -- worker -----------------------------------------------------------
+
+    def _run(self) -> None:
+        from ..metrics import search_ingest_queue_depth
+
+        while True:
+            with self._cv:
+                while not (self._pending or self._resync or self._stop):
+                    self._cv.wait(timeout=0.5)
+                if self._stop and not self._pending and not self._resync:
+                    return
+                batch = self._pending
+                self._pending = []
+                resync = self._resync
+                self._resync = False
+                self._busy = True
+                search_ingest_queue_depth.set(0)
+            try:
+                self._drain(batch, resync)
+            finally:
+                with self._cv:
+                    self._busy = False
+                    self._cv.notify_all()
+
+    def _drain(self, batch: list, resync: bool) -> None:
+        from ..metrics import (
+            search_freshness_lag_rvs,
+            search_index_objects,
+            search_ingest_resyncs,
+            search_publishes,
+        )
+
+        if resync:
+            search_ingest_resyncs.inc()
+            # level-triggered recovery: the re-list below runs AFTER the
+            # queue swap, so it supersedes every event that was pending —
+            # replaying those on top would resurrect stale slices
+            batch = [("MODIFIED", s) for s in
+                     self.store.list(KIND_CLUSTER_OBJECT_SUMMARY)]
+        if not batch:
+            return
+        t0 = time.time()
+        max_rv = 0
+        touched: set = set()
+        for event, summary in batch:
+            rv = self._fold(event, summary)
+            max_rv = max(max_rv, rv)
+            touched.add(summary.cluster)
+        snap = self.index.publish(rv=max_rv)
+        self.folded += len(batch)
+        search_publishes.inc()
+        search_index_objects.set(snap.count)
+        store_rv = self.store.current_rv
+        for cluster, folded_rv in self.index.cluster_rvs().items():
+            search_freshness_lag_rvs.set(
+                max(store_rv - folded_rv, 0), cluster=cluster)
+        from ..tracing import tracer
+
+        if tracer.enabled:
+            # the ingest leg of the ingest->index->query chain: one span
+            # per drain on a per-plane trace, attrs carrying the fold size
+            # and the rv the published snapshot pins
+            tracer.record_trace(
+                "search-ingest", "search_fold", t0, time.time(),
+                summaries=len(batch), rv=snap.rv,
+                clusters=len(touched))
+
+    def _fold(self, event: str, summary) -> int:
+        """Replace one (cluster, gvk) slice; returns the summary's store
+        rv (the freshness stamp for that cluster)."""
+        from ..metrics import search_ingest_rows
+
+        cluster = summary.cluster
+        gvk = summary.gvk
+        key = (cluster, gvk)
+        rv = int(getattr(summary.metadata, "resource_version", 0) or 0)
+        fresh: set = set()
+        if event != "DELETED":
+            for row in summary.rows:
+                # deep-copy before annotating: the sink hands us the
+                # store's committed object by reference, and mutating its
+                # manifest would race every concurrent store deepcopy
+                doc = Unstructured(copy.deepcopy(row.manifest))
+                doc.metadata.annotations[CLUSTER_ANNOTATION] = cluster
+                doc.sync_meta()
+                self.index.upsert(
+                    cluster, gvk, row.namespace, row.name,
+                    labels=row.labels, fields=row.fields, rv=rv, doc=doc)
+                fresh.add((row.namespace, row.name))
+            search_ingest_rows.inc(len(fresh) or 0, feed="summary",
+                                   op="upsert")
+        gone = self._slice_keys.get(key, set()) - fresh
+        for ns, name in gone:
+            self.index.remove(cluster, gvk, ns, name, rv=rv)
+        if gone:
+            search_ingest_rows.inc(len(gone), feed="summary", op="remove")
+        if fresh:
+            self._slice_keys[key] = fresh
+        else:
+            self._slice_keys.pop(key, None)
+        return rv
+
+    # -- control ----------------------------------------------------------
+
+    def flush(self, timeout: float = 10.0) -> bool:
+        """Wait until every event enqueued so far is folded AND published
+        (the test/step barrier). False on timeout."""
+        deadline = time.monotonic() + timeout
+        with self._cv:
+            while self._pending or self._resync or self._busy:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    return False
+                self._cv.wait(timeout=min(remaining, 0.25))
+        return True
+
+    def close(self) -> None:
+        self.store.remove_event_sink(self._sink)
+        with self._cv:
+            self._stop = True
+            self._cv.notify_all()
+        if self._thread.is_alive():
+            self._thread.join(timeout=5.0)
+
+
+__all__ = ["SearchIngestor"]

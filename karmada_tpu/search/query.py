@@ -1,0 +1,315 @@
+"""Search query IR + vectorized execution over a columnar Snapshot.
+
+`GET /search` and `karmadactl search` parse kubectl selector syntax into
+a small frozen IR (Query of Terms), and `execute` compiles each term to
+one vectorized mask over the snapshot's int columns:
+
+* `k=v` / `k==v`    -> (label_pairs == pair_id).any(axis=1)
+* `k!=v`            -> ~that (k8s semantics: a missing key MATCHES !=)
+* `k` / `!k`        -> (label_keys == key_id).any(axis=1) / ~that
+* `k in (a,b)`      -> np.isin(label_pairs, pair_ids).any(axis=1)
+* `k notin (a,b)`   -> ~that (missing key matches, like the reference)
+* field selectors   -> same shapes over field_pairs
+* name substring    -> evaluated over the NAME DICTIONARY (unique
+  strings, np.char.find), then np.isin(name_col, matching_ids) — the
+  classic dictionary-encoded trick: V substring tests instead of N.
+
+Matching never grows a dictionary: unknown strings `peek` to None and
+the term matches nothing (or everything, for the negated forms).
+
+Results come back in the snapshot's pre-sorted (cluster, gvk, ns, name)
+order — byte-identical to the dict cache's `sorted(cache.items())`.
+"""
+from __future__ import annotations
+
+import re
+import time
+from dataclasses import dataclass, field
+from typing import Optional
+
+import numpy as np
+
+from .columnar import PAIR_SEP, ColumnarIndex, Snapshot, SnapshotExpired
+
+
+class QueryError(ValueError):
+    """Unparseable selector syntax (maps to HTTP 400 / CLIError)."""
+
+
+# term ops over label columns; field terms reuse EQ/NEQ/IN/NOTIN
+EQ, NEQ, EXISTS, NEXISTS, IN, NOTIN = (
+    "eq", "neq", "exists", "nexists", "in", "notin")
+
+
+@dataclass(frozen=True)
+class Term:
+    op: str
+    key: str
+    values: tuple[str, ...] = ()
+
+
+@dataclass(frozen=True)
+class Query:
+    """One compiled search request. Empty fields mean "no constraint"."""
+
+    api_version: str = ""
+    kind: str = ""
+    namespace: str = ""
+    name: str = ""            # exact
+    name_contains: str = ""   # substring over the name dictionary
+    clusters: tuple[str, ...] = ()
+    labels: tuple[Term, ...] = ()
+    fields: tuple[Term, ...] = ()
+    limit: int = 0
+
+
+_SET_TERM = re.compile(
+    r"^(?P<key>[^!=,()\s]+)\s+(?P<op>in|notin)\s+\((?P<vals>[^()]*)\)$")
+_KEY = re.compile(r"^[^!=,()\s]+$")
+_VAL = re.compile(r"^[^!=,()\s]*$")  # empty is legal (`k=` matches "")
+
+
+def _split_terms(selector: str) -> list[str]:
+    """Split on top-level commas (commas inside `in (...)` sets bind to
+    the set, not the term list)."""
+    terms, depth, cur = [], 0, []
+    for ch in selector:
+        if ch == "(":
+            depth += 1
+        elif ch == ")":
+            depth = max(depth - 1, 0)
+        if ch == "," and depth == 0:
+            terms.append("".join(cur))
+            cur = []
+        else:
+            cur.append(ch)
+    terms.append("".join(cur))
+    return [t.strip() for t in terms if t.strip()]
+
+
+def _parse_term(raw: str, *, allow_sets: bool) -> Term:
+    m = _SET_TERM.match(raw)
+    if m:
+        if not allow_sets:
+            raise QueryError(
+                f"set operator in field selector: {raw!r} "
+                f"(field selectors support =, ==, != only)")
+        vals = tuple(v.strip() for v in m.group("vals").split(",")
+                     if v.strip())
+        if not vals:
+            raise QueryError(f"empty value set in {raw!r}")
+        return Term(IN if m.group("op") == "in" else NOTIN,
+                    m.group("key"), vals)
+    if "!=" in raw:
+        key, _, val = raw.partition("!=")
+        key, val = key.strip(), val.strip()
+        if not key or not _KEY.match(key) or not _VAL.match(val):
+            raise QueryError(f"bad selector term {raw!r}")
+        return Term(NEQ, key, (val,))
+    if "=" in raw:
+        key, _, val = raw.partition("==") if "==" in raw \
+            else raw.partition("=")
+        key, val = key.strip(), val.strip()
+        if not key or not _KEY.match(key) or not _VAL.match(val):
+            raise QueryError(f"bad selector term {raw!r}")
+        return Term(EQ, key, (val,))
+    if raw.startswith("!"):
+        key = raw[1:].strip()
+        if not key or not _KEY.match(key):
+            raise QueryError(f"bad selector term {raw!r}")
+        if not allow_sets:
+            raise QueryError(
+                f"existence operator in field selector: {raw!r}")
+        return Term(NEXISTS, key)
+    if not _KEY.match(raw):
+        raise QueryError(f"bad selector term {raw!r}")
+    if not allow_sets:
+        raise QueryError(f"existence operator in field selector: {raw!r}")
+    return Term(EXISTS, raw)
+
+
+def parse_label_selector(selector: str) -> tuple[Term, ...]:
+    """kubectl -l grammar: `k=v`, `k==v`, `k!=v`, `k`, `!k`,
+    `k in (a,b)`, `k notin (a,b)`, comma-joined (AND)."""
+    return tuple(_parse_term(t, allow_sets=True)
+                 for t in _split_terms(selector or ""))
+
+
+def parse_field_selector(selector: str) -> tuple[Term, ...]:
+    """kubectl --field-selector grammar: `k=v`, `k==v`, `k!=v` only."""
+    return tuple(_parse_term(t, allow_sets=False)
+                 for t in _split_terms(selector or ""))
+
+
+def compile_query(params: dict) -> Query:
+    """Build the IR from /search query parameters (also the CLI's path).
+    Recognized keys: kind, apiVersion, namespace, name, nameContains,
+    clusters (csv), labelSelector, fieldSelector, limit."""
+    try:
+        limit = int(params.get("limit") or 0)
+    except (TypeError, ValueError):
+        raise QueryError(f"bad limit {params.get('limit')!r}")
+    clusters = tuple(
+        c.strip() for c in (params.get("clusters") or "").split(",")
+        if c.strip())
+    return Query(
+        api_version=params.get("apiVersion", "") or "",
+        kind=params.get("kind", "") or "",
+        namespace=params.get("namespace", "") or "",
+        name=params.get("name", "") or "",
+        name_contains=params.get("nameContains", "") or "",
+        clusters=clusters,
+        labels=parse_label_selector(params.get("labelSelector", "") or ""),
+        fields=parse_field_selector(params.get("fieldSelector", "") or ""),
+        limit=max(limit, 0),
+    )
+
+
+def _pair_mask(matrix: np.ndarray, interner, key: str,
+               values: tuple[str, ...]) -> np.ndarray:
+    """Rows whose padded pair matrix holds ANY of key=value. Unknown
+    pairs peek to None (never id 0 — that's the pad) and drop out."""
+    n = matrix.shape[0]
+    ids = [interner.peek(f"{key}{PAIR_SEP}{v}") for v in values]
+    ids = [i for i in ids if i]  # None and the 0 pad both excluded
+    if not ids or matrix.shape[1] == 0:
+        return np.zeros(n, bool)
+    if len(ids) == 1:
+        return (matrix == ids[0]).any(axis=1)
+    return np.isin(matrix, np.asarray(ids, np.int32)).any(axis=1)
+
+
+def _key_mask(keys: np.ndarray, interner, key: str) -> np.ndarray:
+    kid = interner.peek(key)
+    if not kid or keys.shape[1] == 0:
+        return np.zeros(keys.shape[0], bool)
+    return (keys == kid).any(axis=1)
+
+
+def _term_mask(snap: Snapshot, term: Term, *, fields: bool) -> np.ndarray:
+    pairs = snap.field_pairs if fields else snap.label_pairs
+    interner = snap.fpairs if fields else snap.lpairs
+    if term.op == EQ:
+        return _pair_mask(pairs, interner, term.key, term.values)
+    if term.op == NEQ:
+        return ~_pair_mask(pairs, interner, term.key, term.values)
+    if term.op == IN:
+        return _pair_mask(pairs, interner, term.key, term.values)
+    if term.op == NOTIN:
+        return ~_pair_mask(pairs, interner, term.key, term.values)
+    if term.op == EXISTS:
+        return _key_mask(snap.label_keys, snap.lkeys, term.key)
+    if term.op == NEXISTS:
+        return ~_key_mask(snap.label_keys, snap.lkeys, term.key)
+    raise QueryError(f"unknown term op {term.op!r}")
+
+
+def execute(snap: Snapshot, query: Query) -> list:
+    """One mask-and-gather pass; returns the matching docs in the
+    snapshot's deterministic (cluster, gvk, ns, name) order."""
+    n = snap.count
+    if n == 0:
+        return []
+    mask = np.ones(n, bool)
+    if query.kind:
+        if query.api_version:
+            gid = snap.gvks.peek(f"{query.api_version}/{query.kind}")
+            if not gid:
+                return []
+            mask &= snap.gvk_ids == gid
+        else:
+            # kind-only match: scan the (tiny) gvk dictionary for any
+            # apiVersion carrying this Kind, then one isin over the column
+            suffix = f"/{query.kind}"
+            gids = np.nonzero(np.array(
+                [s.endswith(suffix) for s in snap.gvk_dict], bool))[0]
+            if gids.size == 0:
+                return []
+            mask &= np.isin(snap.gvk_ids, gids.astype(np.int32))
+    elif query.api_version:
+        prefix = f"{query.api_version}/"
+        gids = np.nonzero(np.array(
+            [s.startswith(prefix) for s in snap.gvk_dict], bool))[0]
+        if gids.size == 0:
+            return []
+        mask &= np.isin(snap.gvk_ids, gids.astype(np.int32))
+    if query.namespace:
+        nid = snap.namespaces.peek(query.namespace)
+        if not nid:
+            return []
+        mask &= snap.ns_ids == nid
+    if query.name:
+        mid = snap.names.peek(query.name)
+        if not mid:
+            return []
+        mask &= snap.name_ids == mid
+    if query.name_contains:
+        # dictionary-encoded substring: V vectorized tests over the name
+        # dictionary, then membership over the column. The dictionary was
+        # materialized at publish, so id -> position is exact.
+        hits = np.char.find(
+            snap.name_dict.astype(str), query.name_contains) >= 0
+        hits[0] = False  # id 0 is "absent", never a real name
+        ids = np.nonzero(hits)[0]
+        if ids.size == 0:
+            return []
+        mask &= np.isin(snap.name_ids, ids.astype(np.int32))
+    if query.clusters:
+        cids = [snap.clusters.peek(c) for c in query.clusters]
+        cids = [c for c in cids if c]
+        if not cids:
+            return []
+        mask &= np.isin(snap.cluster_ids, np.asarray(cids, np.int32))
+    for term in query.labels:
+        mask &= _term_mask(snap, term, fields=False)
+    for term in query.fields:
+        mask &= _term_mask(snap, term, fields=True)
+    idx = np.nonzero(mask)[0]
+    if query.limit:
+        idx = idx[:query.limit]
+    return [snap.docs[i] for i in idx]
+
+
+@dataclass
+class QueryResult:
+    rv: int
+    items: list = field(default_factory=list)
+    elapsed_s: float = 0.0
+    # leaders over the wire also report the fleet replication floor — the
+    # highest at_rv every replica can serve (0 when unknown/not replicated)
+    replicated_rv: int = 0
+
+
+def run_query(index: ColumnarIndex, query: Query, *,
+              at_rv: Optional[int] = None,
+              trace_id: str = "") -> QueryResult:
+    """The instrumented entry point every serving surface (apiserver,
+    karmadactl, bench) shares: snapshot selection (at_rv pin), timed
+    execute, `karmada_search_*` metrics, and — when tracing is on and the
+    caller carries a trace id — a `search_query` span, closing the
+    ingest->index->query causal chain (docs/SEARCH.md)."""
+    from ..metrics import search_queries, search_query_seconds
+
+    snap = index.snapshot(at_rv=at_rv)  # SnapshotExpired propagates
+    t0 = time.time()
+    items = execute(snap, query)
+    elapsed = time.time() - t0
+    search_queries.inc(pinned="true" if at_rv is not None else "false")
+    search_query_seconds.observe(elapsed, exemplar=trace_id or None)
+    if trace_id:
+        from ..tracing import tracer
+
+        if tracer.enabled:
+            tracer.record_trace(
+                trace_id, "search_query", t0, t0 + elapsed,
+                rows=snap.count, matched=len(items), rv=snap.rv)
+    return QueryResult(rv=snap.rv, items=items, elapsed_s=elapsed)
+
+
+__all__ = [
+    "EQ", "NEQ", "EXISTS", "NEXISTS", "IN", "NOTIN",
+    "Query", "QueryError", "QueryResult", "Term",
+    "SnapshotExpired",
+    "compile_query", "execute", "parse_field_selector",
+    "parse_label_selector", "run_query",
+]

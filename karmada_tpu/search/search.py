@@ -29,6 +29,35 @@ from ..api.unstructured import Unstructured
 CLUSTER_ANNOTATION = "resource.karmada.io/cached-from-cluster"
 
 
+# -- registry selection (shared: ResourceCache, agent summary publishing) --
+
+
+def selected_clusters(store, registry) -> list[str]:
+    """The clusters a ResourceRegistry's target affinity selects, from
+    the plane's Cluster objects (reference: registry targetCluster)."""
+    clusters = sorted(c.metadata.name for c in store.list("Cluster"))
+    affinity = registry.spec.target_cluster
+    if affinity.cluster_names:
+        clusters = [c for c in clusters if c in affinity.cluster_names]
+    if affinity.exclude:
+        clusters = [c for c in clusters if c not in affinity.exclude]
+    return clusters
+
+
+def selection_map(store) -> dict[tuple, set]:
+    """(api_version, kind) -> set of selected clusters, over every
+    ResourceRegistry. One walk; callers cache and invalidate on
+    ResourceRegistry/Cluster events. The agent's heartbeat uses the same
+    map to decide which summaries its cluster owes the search plane —
+    one selection semantic, two consumers."""
+    sel: dict[tuple, set] = {}
+    for registry in store.list("ResourceRegistry"):
+        clusters = set(selected_clusters(store, registry))
+        for s in registry.spec.resource_selectors:
+            sel.setdefault((s.api_version, s.kind), set()).update(clusters)
+    return sel
+
+
 class BackendStore(Protocol):
     def index(self, cluster: str, obj: Unstructured) -> None: ...
     def remove(self, cluster: str, gvk: str, namespace: str, name: str) -> None: ...
@@ -157,10 +186,16 @@ class OpenSearchBackend:
         addresses: list[str],
         transport: Optional[OpenSearchTransport] = None,
         prefix: str = OPENSEARCH_INDEX_PREFIX,
+        flush_threshold: int = 0,
     ):
         self.addresses = addresses
         self.transport = transport or BufferingTransport()
         self.prefix = prefix
+        # > 0: auto-flush when the queue reaches this many ops, so a
+        # heavy sweep ships several right-sized _bulk bodies instead of
+        # one giant request (OpenSearch's http.max_content_length would
+        # reject it); 0 keeps the one-bulk-per-sweep default
+        self.flush_threshold = flush_threshold
         self._indices: set[str] = set()
         # queued ops, each an atomic NDJSON line group: (action,) for
         # deletes, (action, source) for upserts — bounded so a persistent
@@ -228,6 +263,7 @@ class OpenSearchBackend:
         self._note_pending(
             {"_op": "index", "_index": name, "_id": doc_id, "doc": doc}
         )
+        self._maybe_flush()
 
     def remove(self, cluster: str, gvk: str, namespace: str, name: str) -> None:
         kind = gvk.rsplit("/", 1)[-1]
@@ -242,9 +278,20 @@ class OpenSearchBackend:
         self._note_pending(
             {"_op": "delete", "_index": index, "_id": doc_id}
         )
+        self._maybe_flush()
 
     MAX_PENDING = 1024  # `pending` is an inspection view, not durability
     MAX_BULK_OPS = 65536  # retry-queue bound (see _bulk comment)
+
+    def _maybe_flush(self) -> None:
+        """The flush threshold: queue reached N ops -> ship now. A failed
+        send leaves the queue intact (flush's contract), so the next op
+        past the threshold simply retries — no extra state."""
+        if self.flush_threshold and len(self._bulk) >= self.flush_threshold:
+            try:
+                self.flush()
+            except Exception:  # noqa: BLE001 — transport outage: retry later
+                pass
 
     def _trim_bulk(self) -> None:
         if len(self._bulk) <= self.MAX_BULK_OPS:
@@ -294,9 +341,14 @@ class OpenSearchBackend:
 class ResourceCache:
     """The registry-driven member-object cache + aggregated search API."""
 
-    def __init__(self, store, members: dict):
+    def __init__(self, store, members: dict, index=None):
         self.store = store
         self.members = members
+        # the shared columnar index (search/columnar.py) this cache feeds
+        # as its LIVE leg — the same rows the agents' ClusterObjectSummary
+        # feed converges to, keyed identically so the two legs are
+        # idempotent over each other; None = dict cache only
+        self.index = index
         # (cluster, gvk, ns, name) -> Unstructured
         self._cache: dict[tuple, Unstructured] = {}
         self._backends: dict[str, BackendStore] = {}
@@ -321,11 +373,7 @@ class ResourceCache:
     def _selection_map(self) -> dict[tuple, set]:
         sel = self._selection
         if sel is None:
-            sel = {}
-            for registry in self.store.list("ResourceRegistry"):
-                clusters = set(self._selected_clusters(registry))
-                for s in registry.spec.resource_selectors:
-                    sel.setdefault((s.api_version, s.kind), set()).update(clusters)
+            sel = selection_map(self.store)
             self._selection = sel
         return sel
 
@@ -352,6 +400,7 @@ class ResourceCache:
                 self._cache.pop(key, None)
             else:
                 self._cache[key] = annotated
+            self._feed_index(key, event, annotated)
             for w in list(self._watchers):
                 w(cname, event, annotated)
 
@@ -363,6 +412,30 @@ class ResourceCache:
         self._attached.discard(name)
         for key in [k for k in self._cache if k[0] == name]:
             del self._cache[key]
+        if self.index is not None:
+            self.index.drop_cluster(name, rv=self.store.current_rv)
+
+    def _feed_index(self, key: tuple, event: str, annotated) -> None:
+        """The live leg of the columnar index: rows stamped with the
+        PLANE store's rv at observation (summaries carry their own commit
+        rv) so at_rv pins mean the same thing on both legs."""
+        if self.index is None:
+            return
+        from ..metrics import search_ingest_rows
+        from .columnar import field_pairs_of
+
+        cluster, gvk, ns, name = key
+        rv = self.store.current_rv
+        if event == "DELETED":
+            if self.index.remove(cluster, gvk, ns, name, rv=rv):
+                search_ingest_rows.inc(feed="live", op="remove")
+        else:
+            self.index.upsert(
+                cluster, gvk, ns, name,
+                labels=dict(annotated.metadata.labels),
+                fields=field_pairs_of(annotated.to_dict()),
+                rv=rv, doc=annotated)
+            search_ingest_rows.inc(feed="live", op="upsert")
 
     def _selected_by_any_registry(self, cluster: str, obj) -> bool:
         return cluster in self._selection_map().get(
@@ -390,20 +463,16 @@ class ResourceCache:
         if be is None:
             cfg = registry.spec.backend_store
             if cfg is not None and cfg.type == "opensearch":
-                be = OpenSearchBackend(cfg.addresses)
+                be = OpenSearchBackend(
+                    cfg.addresses,
+                    flush_threshold=getattr(cfg, "flush_threshold", 0))
             else:
                 be = InMemoryBackend()
             self._backends[name] = be
         return be
 
     def _selected_clusters(self, registry) -> list[str]:
-        clusters = sorted(c.metadata.name for c in self.store.list("Cluster"))
-        affinity = registry.spec.target_cluster
-        if affinity.cluster_names:
-            clusters = [c for c in clusters if c in affinity.cluster_names]
-        if affinity.exclude:
-            clusters = [c for c in clusters if c not in affinity.exclude]
-        return clusters
+        return selected_clusters(self.store, registry)
 
     def sweep(self) -> int:
         """Refresh the cache from every registry's selected members (informer
@@ -436,8 +505,23 @@ class ResourceCache:
             for key in gone:
                 cluster, gvk, ns, oname = key
                 be.remove(cluster, gvk, ns, oname)
+        stale = set(self._cache) - set(fresh)
         self._indexed = indexed_now
         self._cache = fresh
+        if self.index is not None:
+            # reconcile the columnar live leg against the refreshed cache
+            # (upserts are change-suppressed in the index — a quiet sweep
+            # republishes the tip with a fresh rv stamp, no array rebuild)
+            rv = self.store.current_rv
+            for key in stale:
+                cluster, gvk, ns, oname = key
+                self.index.remove(cluster, gvk, ns, oname, rv=rv)
+            for key, obj in fresh.items():
+                self._feed_index(key, "MODIFIED", obj)
+            from ..metrics import search_index_objects
+
+            snap = self.index.publish(rv=rv)
+            search_index_objects.set(snap.count)
         # backends that batch (OpenSearch bulk) ship one request per sweep;
         # one backend's transport outage must not abort the others
         for name, be in list(self._backends.items()):

@@ -586,6 +586,54 @@ class ControlPlaneServer:
             objs = self.cp.store.list(kind, q.get("namespace", ""))
             self._send(h, 200, {"items": [codec.encode(o) for o in objs]})
 
+    def _h_GET_search(self, h, q):
+        """Fleet-wide columnar search (docs/SEARCH.md): selector params
+        compile to a vectorized query over this plane's member-object
+        index. Rides the min_rv= read barrier, so a follower answers only
+        once replication has caught up to the caller's pin — and `at_rv=`
+        additionally pins the SNAPSHOT, so the result set never shows a
+        row folded after that revision (410 when the pin left the ring).
+        Leaders also report `replicated_rv`: the floor every replica has
+        acked, i.e. the highest at_rv servable fleet-wide."""
+        from ..metrics import reads_served
+        from ..search.columnar import SnapshotExpired
+        from ..search.query import QueryError
+
+        search = getattr(self.cp, "search", None)
+        if search is None:
+            self._send(h, 404, {"error": "search plane not enabled"})
+            return
+        if not self._min_rv_ok(h, q):
+            return
+        reads_served.inc(role=self._replication_role())
+        at_rv = None
+        if q.get("at_rv"):
+            try:
+                at_rv = int(q["at_rv"])
+            except ValueError:
+                self._send(h, 400, {"error": "at_rv must be an integer"})
+                return
+        try:
+            result = search(dict(q), at_rv=at_rv,
+                            trace_id=q.get("trace") or "")
+        except QueryError as e:
+            self._send(h, 400, {"error": str(e)})
+            return
+        except SnapshotExpired as e:
+            self._send(h, 410, {"error": str(e)})
+            return
+        except LookupError as e:  # replica without a search plane
+            self._send(h, 404, {"error": str(e)})
+            return
+        body = {
+            "resourceVersion": result.rv,
+            "count": len(result.items),
+            "items": [codec.encode(o) for o in result.items],
+        }
+        if self._repl is not None:
+            body["replicated_rv"] = self._repl.fleet_acked_rv()
+        self._send(h, 200, body)
+
     def _h_POST_objects(self, h, q):
         obj = codec.decode(self._body(h)["obj"])
         out = self.cp.store.create(obj)

@@ -18,7 +18,7 @@ import threading
 import time
 from typing import Any, Callable, Iterable, Optional
 from urllib.error import HTTPError
-from urllib.parse import quote, urlparse
+from urllib.parse import quote, urlencode, urlparse
 from urllib.request import Request, urlopen
 
 from ..api.unstructured import Unstructured
@@ -210,7 +210,9 @@ class RemoteStore:
                 raise ContinueExpiredRemote(msg) from None
             if e.code == 422:
                 raise AdmissionDeniedRemote(msg) from None
-            raise RemoteError(f"HTTP {e.code}: {msg}") from None
+            err = RemoteError(f"HTTP {e.code}: {msg}")
+            err.code = e.code
+            raise err from None
         except OSError as e:
             raise RemoteError(f"control plane unreachable: {e}") from None
 
@@ -376,7 +378,9 @@ class RemoteStore:
                 raise ConflictError(msg) from None
             if e.code == 422:
                 raise AdmissionDeniedRemote(msg) from None
-            raise RemoteError(f"HTTP {e.code}: {msg}") from None
+            err = RemoteError(f"HTTP {e.code}: {msg}")
+            err.code = e.code
+            raise err from None
         except OSError as e:
             raise RemoteError(f"control plane unreachable: {e}") from None
 
@@ -964,6 +968,37 @@ class RemoteControlPlane:
             "POST", "/simulate", {"request": codec.encode(request)}
         )
         return codec.decode(out.get("report"))
+
+    def search(self, params: dict, *, at_rv=None, trace_id: str = ""):
+        """GET /search over the wire — same signature as
+        ControlPlane.search. Rides the replica read rotation
+        (read_preference="follower" serves fleet queries off the leader's
+        write path; pass `min_rv` in params for read-your-writes), and
+        returns the decoded QueryResult-shaped answer. Error codes map
+        back to the in-process exceptions (400 -> QueryError, 410 ->
+        SnapshotExpired) so callers like karmadactl handle both planes
+        with one except clause."""
+        from ..search.query import QueryError, QueryResult, SnapshotExpired
+
+        q = {k: str(v) for k, v in params.items() if v not in (None, "")}
+        if at_rv is not None:
+            q["at_rv"] = str(at_rv)
+        if trace_id:
+            q["trace"] = trace_id
+        try:
+            out = self.store._read_call(f"/search?{urlencode(q)}")
+        except ContinueExpiredRemote as e:
+            raise SnapshotExpired(str(e)) from None
+        except RemoteError as e:
+            if getattr(e, "code", 0) == 400:
+                raise QueryError(str(e)) from None
+            raise
+        return QueryResult(
+            rv=int(out.get("resourceVersion") or 0),
+            items=[codec.decode(o) for o in out.get("items", [])],
+            elapsed_s=0.0,
+            replicated_rv=int(out.get("replicated_rv") or 0),
+        )
 
     def trace_of(self, namespace: str, name: str):
         """GET /traces?binding= — the `karmadactl trace binding` backing

@@ -613,6 +613,16 @@ class ReplicationManager:
                 return 0
             return max(acked[self.quorum - 1], 0)
 
+    def fleet_acked_rv(self) -> int:
+        """Highest rv EVERY follower has acked (min over peers): a search
+        query pinned at or below this rv is servable by any replica with
+        the identical answer — the freshness floor GET /search reports as
+        `replicated_rv` (docs/SEARCH.md). 0 with no peers."""
+        with self._cond:
+            if not self.peers:
+                return 0
+            return max(min(p.acked_rv for p in self.peers), 0)
+
     def status(self) -> dict:
         with self._cond:
             return {
@@ -814,12 +824,40 @@ class ReplicaControlPlane:
     role; the coordinator exists so the promotion path can acquire the
     replicated lease locally."""
 
-    def __init__(self, store: Optional[Store] = None, clock=None):
+    def __init__(self, store: Optional[Store] = None, clock=None,
+                 search: bool = False):
         from ..coordination.lease import LeaseCoordinator
 
         self.store = store if store is not None else Store()
         self.members: dict = {}
         self.coordinator = LeaseCoordinator(self.store, clock)
+        self.search_index = None
+        self.search_ingestor = None
+        if search:
+            # follower-served search (docs/SEARCH.md): replicated
+            # ClusterObjectSummary objects arrive through apply_replicated
+            # with the leader's original rvs and event types, so the same
+            # event-sink ingest builds a byte-identical columnar index here
+            # and GET /search answers from this replica match the leader's
+            # at any rv both have reached
+            from ..search import ColumnarIndex, SearchIngestor
+
+            self.search_index = ColumnarIndex()
+            self.search_ingestor = SearchIngestor(self.store, self.search_index)
+
+    def search(self, params: dict, *, at_rv=None, trace_id: str = ""):
+        """Same surface as ControlPlane.search, served from this replica's
+        own index. Raises LookupError when search was not enabled."""
+        if self.search_index is None:
+            raise LookupError("search plane not enabled on this replica")
+        from ..search import compile_query, run_query
+
+        return run_query(self.search_index, compile_query(params),
+                         at_rv=at_rv, trace_id=trace_id)
+
+    def close(self) -> None:
+        if self.search_ingestor is not None:
+            self.search_ingestor.close()
 
     def settle(self, max_steps: int = 0) -> int:
         return 0

@@ -767,11 +767,14 @@ class Store:
         snapshot pinned at `rv` and adopt that rv exactly, so subsequent
         log entries (rv+1, ...) continue the sequence. Only moves FORWARD
         (a follower needs a snapshot because it is behind). Event sinks
-        are expected to be detached for the swap (the server detaches and
-        re-attaches its watch cache around this call — re-attach primes a
-        revision-consistent index); the watcher bus and persistence still
-        receive the transition as DELETED-for-vanished + ADDED-for-all
-        dispatches, so a follower's WAL replays to the snapshot state."""
+        may detach for the swap (the server detaches and re-attaches its
+        watch cache around this call — re-attach primes a revision-
+        consistent index); sinks that STAY attached receive the
+        transition under the lock as DELETED-for-vanished + ADDED-for-all
+        in rv order (how a follower's search ingest survives a catch-up
+        snapshot), and the watcher bus and persistence get the same
+        events post-lock, so a follower's WAL replays to the snapshot
+        state."""
         objs = sorted(objects, key=lambda o: o.metadata.resource_version)
         dispatch: list[tuple[str, str, Any]] = []
         with self._write_lock():
@@ -799,6 +802,9 @@ class Store:
             for (kind, key), o in old.items():
                 if (kind, key) not in seen:
                     dispatch.append((kind, DELETED, o))
+                    self._sink(kind, DELETED, o)
+            for obj in objs:
+                self._sink(gvk_of(obj), ADDED, obj)
         dispatch += [(gvk_of(o), ADDED, o) for o in objs]
         self._dispatch([
             (kind, event, copy.deepcopy(o)) for kind, event, o in dispatch

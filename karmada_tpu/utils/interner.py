@@ -42,5 +42,13 @@ class Interner:
     def ids(self, strs) -> list[int]:
         return [self.id(s) for s in strs]
 
+    def strings(self) -> list[str]:
+        """Copy of the dictionary, id-ordered (index == id). Taken under
+        the lock so a concurrent insert cannot tear the snapshot — the
+        search plane's publish path materializes this as the vectorized
+        substring-match dictionary."""
+        with self._lock:
+            return list(self._strs)
+
     def __len__(self) -> int:
         return len(self._strs)

@@ -1,0 +1,59 @@
+#!/usr/bin/env bash
+# Fleet-wide search plane smoke (docs/SEARCH.md). Single-shot: runs the
+# `search` bench config — the same selector queries executed vectorized
+# over the columnar index's published snapshot vs the pre-columnar
+# per-cluster fan-out walk at 1k clusters (result sets cross-checked per
+# query), plus a real Store + SearchIngestor freshness leg under
+# ClusterObjectSummary churn — and asserts the acceptance booleans the
+# JSON line carries:
+#   pass_speedup    columnar query p99 beats the fan-out baseline >= 5x
+#                   at 1k clusters AND every query's result set matches
+#   pass_freshness  mid-churn index lag stays bounded by the outstanding
+#                   backlog and the final flush lands the index exactly
+#                   at the store tip (lag 0)
+# Exit 0 prints "SEARCH OK".
+#
+# Wired into the slow path as
+# tests/test_search_columnar.py::TestSearchSmokeScript (pytest -m slow).
+# Pure numpy-on-host: runs on CPU.
+set -euo pipefail
+
+cd "$(dirname "$0")/.."
+PY=${PYTHON:-python}
+WORK=$(mktemp -d /tmp/search_smoke.XXXXXX)
+trap 'rm -rf "$WORK"' EXIT
+
+log() { echo "search_smoke: $*"; }
+
+JAX_PLATFORMS=cpu $PY bench.py --inner --platform cpu --configs search \
+    --verbose > "$WORK/out.txt" 2> "$WORK/err.txt" \
+    || { log "bench failed"; cat "$WORK/err.txt"; exit 1; }
+
+LINE=$(grep -E '^\{' "$WORK/out.txt" | tail -1)
+[ -n "$LINE" ] || { log "no JSON line emitted"; cat "$WORK/out.txt"; exit 1; }
+log "result: $LINE"
+
+SEARCH_LINE="$LINE" $PY - <<'PYEOF'
+import json
+import os
+import sys
+
+rec = json.loads(os.environ["SEARCH_LINE"])
+for key in ("pass_speedup", "pass_freshness", "pass"):
+    if not rec.get(key):
+        print(f"search_smoke: criterion {key} FAILED "
+              f"(speedup={rec.get('value')}x "
+              f"columnar_p99={rec.get('columnar_p99_s')}s "
+              f"fanout_p99={rec.get('fanout_p99_s')}s "
+              f"parity={rec.get('parity_ok')}, "
+              f"freshness={rec.get('freshness')})",
+              file=sys.stderr)
+        sys.exit(1)
+f = rec["freshness"]
+print(f"search_smoke: columnar {rec['value']}x fan-out over "
+      f"{rec['clusters']} clusters / {rec['objects']} objects "
+      f"({rec['queries']} queries, parity {rec['parity_ok']}); "
+      f"churn lag max {f['max_lag_rvs']} final {f['final_lag_rvs']}")
+PYEOF
+
+echo "SEARCH OK"

@@ -1,0 +1,912 @@
+"""Fleet-wide search plane (search/columnar.py, docs/SEARCH.md).
+
+The acceptance properties this suite pins:
+
+- PARITY: the vectorized columnar query plane returns BYTE-IDENTICAL
+  result sets (same objects, same cached-from-cluster annotations, same
+  deterministic order) as the dict-based ResourceCache for randomized
+  fleets and label/field/name queries.
+- RV CONSISTENCY: a query pinned at rv R never observes a row folded
+  after R, under concurrent ingest churn; pins that predate the
+  snapshot ring fail loudly (SnapshotExpired -> 410).
+- FOLLOWER PARITY: follower-served GET /search answers byte-match the
+  leader's at the same min_rv barrier — the replicated summary feed
+  builds the identical index off the leader's original rvs.
+"""
+from __future__ import annotations
+
+import json
+import random
+import threading
+import time
+from urllib.error import HTTPError
+from urllib.parse import urlencode
+from urllib.request import urlopen
+
+import pytest
+
+from karmada_tpu.api.meta import ObjectMeta
+from karmada_tpu.api.search import (
+    ClusterObjectSummary,
+    KIND_CLUSTER_OBJECT_SUMMARY,
+    ObjectSummaryRow,
+    ResourceRegistry,
+    ResourceRegistrySpec,
+    SearchResourceSelector,
+    summary_name,
+)
+from karmada_tpu.api.cluster import Cluster
+from karmada_tpu.api.policy import ClusterAffinity
+from karmada_tpu.api.unstructured import Unstructured
+from karmada_tpu.members.member import InMemoryMember, MemberConfig
+from karmada_tpu.search import (
+    ColumnarIndex,
+    QueryError,
+    SearchIngestor,
+    SnapshotExpired,
+    compile_query,
+    execute,
+    field_pairs_of,
+    parse_label_selector,
+    run_query,
+)
+from karmada_tpu.search.search import CLUSTER_ANNOTATION, ResourceCache
+from karmada_tpu.store.store import Store
+
+GVK = "apps/v1/Deployment"
+
+
+def upsert(ix, cluster, name, labels=None, ns="default", rv=1, gvk=GVK,
+           fields=None, doc=None):
+    av, _, kind = gvk.rpartition("/")
+    manifest = {
+        "apiVersion": av, "kind": kind,
+        "metadata": {"name": name, "namespace": ns,
+                     "labels": dict(labels or {})},
+    }
+    d = doc if doc is not None else Unstructured(manifest)
+    return ix.upsert(cluster, gvk, ns, name, labels=labels or {},
+                     fields=fields or field_pairs_of(manifest),
+                     rv=rv, doc=d)
+
+
+def names_of(items):
+    return [o.name for o in items]
+
+
+# ---------------------------------------------------------------------------
+# columnar index + selector execution
+# ---------------------------------------------------------------------------
+
+
+class TestColumnarIndex:
+    def test_label_eq_and_neq(self):
+        ix = ColumnarIndex()
+        upsert(ix, "c1", "web", {"app": "web"})
+        upsert(ix, "c1", "db", {"app": "db"})
+        upsert(ix, "c2", "bare", {})  # no labels at all
+        snap = ix.publish()
+        assert names_of(execute(snap, compile_query(
+            {"labelSelector": "app=web"}))) == ["web"]
+        # k8s semantics: != matches objects MISSING the key too
+        assert names_of(execute(snap, compile_query(
+            {"labelSelector": "app!=web"}))) == ["db", "bare"]
+
+    def test_set_ops_and_exists(self):
+        ix = ColumnarIndex()
+        upsert(ix, "c1", "a", {"tier": "web"})
+        upsert(ix, "c1", "b", {"tier": "db"})
+        upsert(ix, "c1", "c", {"other": "x"})
+        snap = ix.publish()
+        q = compile_query({"labelSelector": "tier in (web, cache)"})
+        assert names_of(execute(snap, q)) == ["a"]
+        q = compile_query({"labelSelector": "tier notin (web)"})
+        assert names_of(execute(snap, q)) == ["b", "c"]
+        assert names_of(execute(snap, compile_query(
+            {"labelSelector": "tier"}))) == ["a", "b"]
+        assert names_of(execute(snap, compile_query(
+            {"labelSelector": "!tier"}))) == ["c"]
+
+    def test_unknown_value_never_grows_vocabulary(self):
+        ix = ColumnarIndex()
+        upsert(ix, "c1", "web", {"app": "web"})
+        snap = ix.publish()
+        before = len(snap.lpairs)
+        assert execute(snap, compile_query(
+            {"labelSelector": "app=never-seen"})) == []
+        assert len(snap.lpairs) == before
+
+    def test_field_selector_and_name_contains(self):
+        ix = ColumnarIndex()
+        upsert(ix, "c1", "web-1", fields={"metadata.name": "web-1",
+                                          "spec.replicas": "3"})
+        upsert(ix, "c1", "api-1", fields={"metadata.name": "api-1",
+                                          "spec.replicas": "5"})
+        snap = ix.publish()
+        assert names_of(execute(snap, compile_query(
+            {"fieldSelector": "spec.replicas=3"}))) == ["web-1"]
+        assert names_of(execute(snap, compile_query(
+            {"fieldSelector": "spec.replicas!=3"}))) == ["api-1"]
+        assert names_of(execute(snap, compile_query(
+            {"nameContains": "web"}))) == ["web-1"]
+
+    def test_kind_only_query_scans_gvk_dictionary(self):
+        ix = ColumnarIndex()
+        upsert(ix, "c1", "web", gvk="apps/v1/Deployment")
+        upsert(ix, "c1", "svc", gvk="v1/Service")
+        snap = ix.publish()
+        assert names_of(execute(snap, compile_query(
+            {"kind": "Deployment"}))) == ["web"]
+        assert names_of(execute(snap, compile_query(
+            {"kind": "Deployment", "apiVersion": "apps/v1"}))) == ["web"]
+        assert execute(snap, compile_query(
+            {"kind": "Deployment", "apiVersion": "v1"})) == []
+
+    def test_cluster_filter_namespace_and_limit(self):
+        ix = ColumnarIndex()
+        for c in ("c1", "c2", "c3"):
+            upsert(ix, c, "web", ns="prod")
+            upsert(ix, c, "web", ns="dev")
+        snap = ix.publish()
+        q = compile_query({"clusters": "c1,c3", "namespace": "prod"})
+        hits = execute(snap, q)
+        assert [(h.namespace, h.name) for h in hits] == [
+            ("prod", "web"), ("prod", "web")]
+        assert len(execute(snap, compile_query({"limit": "4"}))) == 4
+
+    def test_remove_and_drop_cluster(self):
+        ix = ColumnarIndex()
+        upsert(ix, "c1", "web")
+        upsert(ix, "c2", "web")
+        upsert(ix, "c2", "db")
+        assert ix.remove("c2", GVK, "default", "web", rv=5)
+        assert not ix.remove("c2", GVK, "default", "missing", rv=5)
+        snap = ix.publish()
+        assert [(s.cluster_ids[i], s.name_ids[i]) for s, i in []] == []
+        assert len(execute(snap, compile_query({}))) == 2
+        assert ix.drop_cluster("c2", rv=6) == 1
+        assert names_of(execute(ix.publish(), compile_query({}))) == ["web"]
+
+    def test_change_suppression_skips_rebuild(self):
+        ix = ColumnarIndex()
+        doc = Unstructured({"apiVersion": "apps/v1", "kind": "Deployment",
+                            "metadata": {"name": "web", "namespace": "default",
+                                         "resourceVersion": 7}})
+        assert upsert(ix, "c1", "web", {"a": "b"}, rv=1, doc=doc)
+        s1 = ix.publish()
+        # identical re-report: not dirty, publish shares the tip arrays
+        assert not upsert(ix, "c1", "web", {"a": "b"}, rv=2, doc=doc)
+        s2 = ix.publish(rv=9)
+        assert s2.name_ids is s1.name_ids
+        assert s2.rv == 9  # but the freshness stamp still advances
+        # a changed selector surface is a real write again
+        assert upsert(ix, "c1", "web", {"a": "c"}, rv=3, doc=doc)
+        assert ix.publish().name_ids is not s1.name_ids
+
+    def test_bad_selector_syntax_raises_query_error(self):
+        with pytest.raises(QueryError):
+            parse_label_selector("a==b==c")
+        with pytest.raises(QueryError):
+            compile_query({"labelSelector": "tier in web"})  # missing parens
+        with pytest.raises(QueryError):
+            compile_query({"fieldSelector": "spec.x in (a)"})  # sets invalid
+        with pytest.raises(QueryError):
+            compile_query({"limit": "nope"})
+
+
+class TestSnapshotRing:
+    def test_at_rv_pin_resolves_older_snapshot(self):
+        ix = ColumnarIndex()
+        upsert(ix, "c1", "v1-only", rv=10)
+        s10 = ix.publish()
+        upsert(ix, "c1", "v2-extra", rv=20)
+        ix.publish()
+        pinned = ix.snapshot(at_rv=15)
+        assert pinned.rv == s10.rv
+        assert names_of(execute(pinned, compile_query({}))) == ["v1-only"]
+
+    def test_pin_before_ring_raises_snapshot_expired(self):
+        ix = ColumnarIndex(ring=4)
+        for i in range(8):
+            upsert(ix, "c1", f"o{i}", rv=(i + 1) * 10)
+            ix.publish()
+        with pytest.raises(SnapshotExpired):
+            ix.snapshot(at_rv=15)
+
+    def test_ring_rvs_monotone(self):
+        ix = ColumnarIndex()
+        upsert(ix, "c1", "a", rv=50)
+        ix.publish()
+        upsert(ix, "c1", "b", rv=20)  # stale stamp folds in...
+        s = ix.publish()
+        assert s.rv >= 50  # ...but the ring never goes backwards
+
+
+# ---------------------------------------------------------------------------
+# parity: columnar plane vs the dict-based ResourceCache
+# ---------------------------------------------------------------------------
+
+
+def _match_labels(terms, labels):
+    for t in terms:
+        have = t.key in labels
+        if t.op == "eq" and not (have and labels[t.key] == t.values[0]):
+            return False
+        if t.op == "neq" and (have and labels[t.key] == t.values[0]):
+            return False
+        if t.op == "exists" and not have:
+            return False
+        if t.op == "nexists" and have:
+            return False
+        if t.op == "in" and not (have and labels[t.key] in t.values):
+            return False
+        if t.op == "notin" and (have and labels[t.key] in t.values):
+            return False
+    return True
+
+
+class TestParityWithDictCache:
+    """Randomized fleets: the columnar plane must return byte-identical
+    result sets — same `to_dict()` bytes (including the
+    resource.karmada.io/cached-from-cluster annotation), same
+    deterministic order — as filtering the dict cache's sorted items."""
+
+    def _fleet(self, seed):
+        rng = random.Random(seed)
+        store = Store()
+        members = {}
+        apps = ["web", "api", "db", "cache"]
+        for c in range(3):
+            cfg = MemberConfig(name=f"m{c}", allocatable={"cpu": 10.0})
+            m = InMemoryMember(cfg)
+            members[m.name] = m
+            store.apply(Cluster(metadata=ObjectMeta(name=m.name)))
+            for i in range(rng.randint(3, 9)):
+                labels = {"app": rng.choice(apps)}
+                if rng.random() < 0.5:
+                    labels["tier"] = rng.choice(["fe", "be"])
+                m.apply_manifest({
+                    "apiVersion": "apps/v1", "kind": "Deployment",
+                    "metadata": {
+                        "name": f"{labels['app']}-{i}",
+                        "namespace": rng.choice(["default", "prod"]),
+                        "labels": labels,
+                    },
+                    "spec": {"replicas": rng.randint(1, 5)},
+                })
+        store.apply(ResourceRegistry(
+            metadata=ObjectMeta(name="reg"),
+            spec=ResourceRegistrySpec(
+                target_cluster=ClusterAffinity(),
+                resource_selectors=[SearchResourceSelector(
+                    api_version="apps/v1", kind="Deployment")])))
+        index = ColumnarIndex()
+        cache = ResourceCache(store, members, index=index)
+        cache.sweep()
+        return cache, index, rng
+
+    def _reference(self, cache, query):
+        out = []
+        for key, obj in sorted(cache._cache.items()):
+            if query.namespace and obj.namespace != query.namespace:
+                continue
+            if query.name_contains and query.name_contains not in obj.name:
+                continue
+            if query.clusters and key[0] not in query.clusters:
+                continue
+            if not _match_labels(query.labels, dict(obj.metadata.labels)):
+                continue
+            fields = field_pairs_of(obj.to_dict())
+            if not _match_labels(query.fields, fields):
+                continue
+            out.append(obj)
+        return out
+
+    @pytest.mark.parametrize("seed", [1, 2, 3])
+    def test_randomized_queries_byte_identical(self, seed):
+        cache, index, rng = self._fleet(seed)
+        snap = index.snapshot()
+        assert snap.count == len(cache._cache)
+        queries = (
+            [{"labelSelector": f"app={a}"} for a in
+             ("web", "api", "db", "cache", "ghost")] +
+            [{"labelSelector": "tier in (fe, be)"},
+             {"labelSelector": "tier notin (fe)"},
+             {"labelSelector": "!tier"},
+             {"labelSelector": "app=web,tier=fe"},
+             {"namespace": "prod"},
+             {"nameContains": "api"},
+             {"clusters": "m0,m2", "labelSelector": "app"},
+             {"fieldSelector": "spec.replicas=3"},
+             {"fieldSelector": "metadata.namespace=default",
+              "labelSelector": "app=db"}]
+        )
+        for params in queries:
+            q = compile_query(params)
+            got = execute(snap, q)
+            want = self._reference(cache, q)
+            got_b = [json.dumps(o.to_dict(), sort_keys=True) for o in got]
+            want_b = [json.dumps(o.to_dict(), sort_keys=True) for o in want]
+            assert got_b == want_b, params
+            for o in got:
+                assert o.metadata.annotations[CLUSTER_ANNOTATION] in (
+                    "m0", "m1", "m2")
+
+    def test_sweep_prunes_removed_objects_from_index(self):
+        cache, index, _ = self._fleet(seed=7)
+        victim = sorted(cache._cache)[0]
+        cname, _, ns, name = victim
+        cache.members[cname].delete_manifest("apps/v1", "Deployment", ns, name)
+        cache.sweep()
+        snap = index.snapshot()
+        assert snap.count == len(cache._cache)
+        assert victim not in cache._cache
+
+    def test_detach_member_drops_cluster_rows(self):
+        cache, index, _ = self._fleet(seed=8)
+        before = index.snapshot().count
+        dropped = sum(1 for k in cache._cache if k[0] == "m1")
+        cache.detach_member("m1")
+        assert index.publish().count == before - dropped
+
+
+# ---------------------------------------------------------------------------
+# ingest: summary feed, freshness, rv consistency under churn
+# ---------------------------------------------------------------------------
+
+
+def make_summary(cluster, rows_spec, av="apps/v1", kind="Deployment"):
+    rows = [
+        ObjectSummaryRow(
+            namespace=ns, name=name, labels=dict(labels),
+            manifest={"apiVersion": av, "kind": kind,
+                      "metadata": {"name": name, "namespace": ns,
+                                   "labels": dict(labels)}})
+        for ns, name, labels in rows_spec
+    ]
+    return ClusterObjectSummary(
+        metadata=ObjectMeta(name=summary_name(cluster, av, kind)),
+        cluster=cluster, api_version=av, object_kind=kind, rows=rows)
+
+
+class TestSearchIngestor:
+    def test_summary_folds_and_slice_replacement(self):
+        store = Store()
+        index = ColumnarIndex()
+        ing = SearchIngestor(store, index)
+        try:
+            store.apply(make_summary("c1", [
+                ("default", "web", {"app": "web"}),
+                ("default", "db", {"app": "db"}),
+            ]))
+            assert ing.flush()
+            snap = index.snapshot()
+            assert names_of(execute(snap, compile_query({}))) == ["db", "web"]
+            hit = execute(snap, compile_query({"labelSelector": "app=web"}))[0]
+            assert hit.metadata.annotations[CLUSTER_ANNOTATION] == "c1"
+            # a replacement summary retracts vanished rows (level-triggered)
+            store.apply(make_summary("c1", [
+                ("default", "web", {"app": "web"}),
+            ]))
+            assert ing.flush()
+            assert names_of(execute(index.snapshot(),
+                                    compile_query({}))) == ["web"]
+            # empty rows retracts the whole slice
+            store.apply(make_summary("c1", []))
+            assert ing.flush()
+            assert index.snapshot().count == 0
+        finally:
+            ing.close()
+
+    def test_prime_attaches_revision_consistent(self):
+        store = Store()
+        store.apply(make_summary("c1", [("default", "pre", {})]))
+        index = ColumnarIndex()
+        ing = SearchIngestor(store, index)  # attaches AFTER the write
+        try:
+            assert ing.flush()
+            assert names_of(execute(index.snapshot(),
+                                    compile_query({}))) == ["pre"]
+        finally:
+            ing.close()
+
+    def test_snapshot_rv_tracks_store_and_lag_drains(self):
+        store = Store()
+        index = ColumnarIndex()
+        ing = SearchIngestor(store, index)
+        try:
+            for w in range(20):
+                store.apply(make_summary(f"c{w % 4}", [
+                    ("default", f"o{w}", {"wave": str(w)})]))
+            assert ing.flush()
+            assert index.snapshot().rv == store.current_rv
+        finally:
+            ing.close()
+
+    def test_pinned_query_never_sees_future_rows_under_churn(self):
+        """RV CONSISTENCY: pin at rv R while a writer churns — every
+        snapshot served for the pin holds only rows folded at <= R."""
+        store = Store()
+        index = ColumnarIndex()
+        ing = SearchIngestor(store, index)
+        stop = threading.Event()
+
+        def churn():
+            i = 0
+            while not stop.is_set():
+                store.apply(make_summary("hot", [
+                    ("default", f"obj-{i % 5}", {"i": str(i)})]))
+                i += 1
+                time.sleep(0.001)
+        t = threading.Thread(target=churn, daemon=True)
+        try:
+            store.apply(make_summary("cold", [("default", "pinned", {})]))
+            assert ing.flush()
+            t.start()
+            checks = 0
+            deadline = time.monotonic() + 1.0
+            while time.monotonic() < deadline:
+                pin = index.snapshot().rv
+                try:
+                    snap = index.snapshot(at_rv=pin)
+                except SnapshotExpired:
+                    continue  # churn rolled the ring past the pin: re-pin
+                assert snap.rv <= pin
+                assert (snap.rvs <= pin).all()
+                checks += 1
+            assert checks > 0
+        finally:
+            stop.set()
+            t.join(timeout=5.0)
+            ing.close()
+
+    def test_overflow_sets_resync_and_recovers(self):
+        store = Store()
+        index = ColumnarIndex()
+        ing = SearchIngestor(store, index, start=False)  # worker held
+        try:
+            for i in range(SearchIngestor.QUEUE_MAX + 50):
+                ing._sink(KIND_CLUSTER_OBJECT_SUMMARY, "MODIFIED",
+                          make_summary("c1", [("default", f"o{i}", {})]))
+            assert ing._resync
+            # the real store state re-lists on recovery
+            store.apply(make_summary("c1", [("default", "real", {})]))
+            ing._thread.start()
+            assert ing.flush(timeout=30.0)
+            assert names_of(execute(index.snapshot(),
+                                    compile_query({}))) == ["real"]
+        finally:
+            ing.close()
+
+    def test_fold_does_not_mutate_committed_summary(self):
+        """The sink hands the ingestor the store's committed object by
+        reference; annotating the manifest in place would corrupt the
+        store (and race its deepcopies)."""
+        store = Store()
+        index = ColumnarIndex()
+        ing = SearchIngestor(store, index)
+        try:
+            store.apply(make_summary("c1", [("default", "web", {})]))
+            assert ing.flush()
+            stored = store.get(KIND_CLUSTER_OBJECT_SUMMARY,
+                               summary_name("c1", "apps/v1", "Deployment"))
+            assert CLUSTER_ANNOTATION not in json.dumps(
+                stored.rows[0].manifest)
+        finally:
+            ing.close()
+
+
+# ---------------------------------------------------------------------------
+# agent summary feed (the coalesced status path)
+# ---------------------------------------------------------------------------
+
+
+class TestAgentSearchReports:
+    def _plane(self, flush_delay=0.0):
+        from karmada_tpu.agent.agent import KarmadaAgent
+        from karmada_tpu.interpreter.interpreter import ResourceInterpreter
+        from karmada_tpu.runtime.controller import Runtime
+
+        store = Store()
+        cfg = MemberConfig(name="edge-1", sync_mode="Pull",
+                           allocatable={"cpu": 4.0})
+        member = InMemoryMember(cfg)
+        store.apply(Cluster(metadata=ObjectMeta(name="edge-1")))
+        store.apply(ResourceRegistry(
+            metadata=ObjectMeta(name="reg"),
+            spec=ResourceRegistrySpec(
+                target_cluster=ClusterAffinity(),
+                resource_selectors=[SearchResourceSelector(
+                    api_version="apps/v1", kind="Deployment")])))
+        agent = KarmadaAgent(store, member, ResourceInterpreter(), Runtime(),
+                             status_flush_delay=flush_delay,
+                             search_reports=True)
+        return store, member, agent
+
+    def test_heartbeat_publishes_selected_summaries(self):
+        store, member, agent = self._plane()
+        member.apply_manifest({
+            "apiVersion": "apps/v1", "kind": "Deployment",
+            "metadata": {"name": "web", "namespace": "default",
+                         "labels": {"app": "web"}},
+            "spec": {"replicas": 2}})
+        member.apply_manifest({  # NOT registry-selected
+            "apiVersion": "v1", "kind": "Service",
+            "metadata": {"name": "svc", "namespace": "default"}})
+        agent.heartbeat()
+        s = store.get(KIND_CLUSTER_OBJECT_SUMMARY,
+                      summary_name("edge-1", "apps/v1", "Deployment"))
+        assert [r.name for r in s.rows] == ["web"]
+        assert s.rows[0].labels == {"app": "web"}
+        assert s.rows[0].fields["spec.replicas"] == "2"
+        assert store.try_get(KIND_CLUSTER_OBJECT_SUMMARY,
+                             summary_name("edge-1", "v1", "Service")) is None
+
+    def test_quiet_heartbeat_is_change_suppressed(self):
+        store, member, agent = self._plane()
+        member.apply_manifest({
+            "apiVersion": "apps/v1", "kind": "Deployment",
+            "metadata": {"name": "web", "namespace": "default"}})
+        agent.heartbeat()
+        sname = summary_name("edge-1", "apps/v1", "Deployment")
+        rv = store.get(KIND_CLUSTER_OBJECT_SUMMARY,
+                       sname).metadata.resource_version
+        agent.heartbeat()  # nothing changed member-side: no summary write
+        assert store.get(KIND_CLUSTER_OBJECT_SUMMARY,
+                         sname).metadata.resource_version == rv
+        member.apply_manifest({
+            "apiVersion": "apps/v1", "kind": "Deployment",
+            "metadata": {"name": "web2", "namespace": "default"}})
+        agent.heartbeat()
+        assert store.get(KIND_CLUSTER_OBJECT_SUMMARY,
+                         sname).metadata.resource_version > rv
+
+    def test_summaries_ride_the_coalesced_status_path(self):
+        store, member, agent = self._plane(flush_delay=5.0)
+        member.apply_manifest({
+            "apiVersion": "apps/v1", "kind": "Deployment",
+            "metadata": {"name": "web", "namespace": "default"}})
+        rv = store.current_rv
+        agent.heartbeat()  # Lease writes through; the summary buffers
+        assert store.try_get(
+            KIND_CLUSTER_OBJECT_SUMMARY,
+            summary_name("edge-1", "apps/v1", "Deployment")) is None
+        assert agent.flush_status() >= 1
+        assert store.current_rv > rv
+        assert store.get(KIND_CLUSTER_OBJECT_SUMMARY,
+                         summary_name("edge-1", "apps/v1", "Deployment"))
+        agent.close()
+
+    def test_end_to_end_agent_to_query(self):
+        store, member, agent = self._plane()
+        member.apply_manifest({
+            "apiVersion": "apps/v1", "kind": "Deployment",
+            "metadata": {"name": "web", "namespace": "default",
+                         "labels": {"app": "web"}}})
+        index = ColumnarIndex()
+        ing = SearchIngestor(store, index)
+        try:
+            agent.heartbeat()
+            assert ing.flush()
+            res = run_query(index, compile_query(
+                {"labelSelector": "app=web"}))
+            assert names_of(res.items) == ["web"]
+            assert res.items[0].metadata.annotations[
+                CLUSTER_ANNOTATION] == "edge-1"
+            assert res.rv == store.current_rv
+        finally:
+            ing.close()
+
+
+# ---------------------------------------------------------------------------
+# GET /search endpoint + follower/leader parity
+# ---------------------------------------------------------------------------
+
+
+def _get(url, params=None):
+    q = f"?{urlencode(params)}" if params else ""
+    with urlopen(f"{url}/search{q}") as r:
+        return r.status, json.loads(r.read())
+
+
+class TestSearchEndpoint:
+    @pytest.fixture
+    def plane(self):
+        from karmada_tpu.server.apiserver import ControlPlaneServer
+        from karmada_tpu.store.replication import ReplicaControlPlane
+
+        cp = ReplicaControlPlane(search=True)
+        srv = ControlPlaneServer(cp)
+        srv.start()
+        yield cp, srv
+        srv.stop()
+        cp.close()
+
+    def test_search_serves_and_filters(self, plane):
+        cp, srv = plane
+        cp.store.apply(make_summary("c1", [
+            ("default", "web", {"app": "web"}),
+            ("default", "db", {"app": "db"})]))
+        assert cp.search_ingestor.flush()
+        status, body = _get(srv.url, {"labelSelector": "app=web"})
+        assert status == 200
+        assert body["count"] == 1
+        assert body["resourceVersion"] == cp.store.current_rv
+        names = [o["manifest"]["metadata"]["name"] for o in body["items"]]
+        assert names == ["web"]
+
+    def test_bad_selector_is_400_expired_pin_410(self, plane):
+        cp, srv = plane
+        cp.store.apply(make_summary("c1", [("default", "web", {})]))
+        assert cp.search_ingestor.flush()
+        with pytest.raises(HTTPError) as e:
+            _get(srv.url, {"labelSelector": "a==b==c"})
+        assert e.value.code == 400
+        # roll the ring past rv 1 (ring=32), then pin before it
+        for i in range(40):
+            cp.store.apply(make_summary("c1", [
+                ("default", "web", {"i": str(i)})]))
+            assert cp.search_ingestor.flush()
+            cp.search_index.publish()
+        with pytest.raises(HTTPError) as e:
+            _get(srv.url, {"at_rv": "1"})
+        assert e.value.code == 410
+
+    def test_at_rv_pin_serves_old_state(self, plane):
+        cp, srv = plane
+        cp.store.apply(make_summary("c1", [("default", "old", {})]))
+        assert cp.search_ingestor.flush()
+        pin = cp.store.current_rv
+        cp.store.apply(make_summary("c1", [
+            ("default", "old", {}), ("default", "new", {})]))
+        assert cp.search_ingestor.flush()
+        status, body = _get(srv.url, {"at_rv": str(pin)})
+        assert status == 200
+        assert [o["manifest"]["metadata"]["name"]
+                for o in body["items"]] == ["old"]
+        status, body = _get(srv.url)
+        assert body["count"] == 2
+
+    def test_plane_without_search_is_404(self):
+        from karmada_tpu.server.apiserver import ControlPlaneServer
+        from karmada_tpu.store.replication import ReplicaControlPlane
+
+        cp = ReplicaControlPlane()  # search not enabled
+        srv = ControlPlaneServer(cp)
+        srv.start()
+        try:
+            with pytest.raises(HTTPError) as e:
+                _get(srv.url)
+            assert e.value.code == 404
+        finally:
+            srv.stop()
+
+
+class TestFollowerLeaderParity:
+    def test_follower_answers_match_leader_at_min_rv(self):
+        """FOLLOWER PARITY: replicated summaries build a byte-identical
+        index on the follower; GET /search at the same min_rv barrier
+        returns the same items in the same order from either replica."""
+        from karmada_tpu.coordination.lease import LeaseCoordinator  # noqa: F401
+        from karmada_tpu.server.apiserver import ControlPlaneServer
+        from karmada_tpu.store.replication import (
+            REPLICATION_LEASE,
+            ReplicaControlPlane,
+            ReplicationManager,
+        )
+
+        follower_cp = ReplicaControlPlane(search=True)
+        follower = ControlPlaneServer(follower_cp)
+        follower.start()
+        leader_cp = ReplicaControlPlane(search=True)
+        lease, ok = leader_cp.coordinator.acquire(
+            REPLICATION_LEASE, "leader-0", 10.0)
+        assert ok
+        manager = ReplicationManager(
+            leader_cp.store, [follower.url], mode="quorum", quorum=1,
+            token=lease.spec.fencing_token, identity="leader-0")
+        leader = ControlPlaneServer(leader_cp, replication=manager)
+        leader.start()
+        try:
+            for c in ("c1", "c2"):
+                leader_cp.store.apply(make_summary(c, [
+                    ("default", "web", {"app": "web"}),
+                    ("prod", "db", {"app": "db"})]))
+            rv = leader_cp.store.current_rv
+            deadline = time.monotonic() + 10.0
+            while (min((p.acked_rv for p in manager.peers), default=0) < rv
+                   and time.monotonic() < deadline):
+                time.sleep(0.02)
+            assert manager.fleet_acked_rv() >= rv
+            assert leader_cp.search_ingestor.flush()
+            assert follower_cp.search_ingestor.flush()
+            for params in ({"labelSelector": "app=web"},
+                           {"namespace": "prod"},
+                           {"nameContains": "b"}):
+                q = dict(params, min_rv=str(rv), at_rv=str(rv))
+                _, lbody = _get(leader.url, q)
+                _, fbody = _get(follower.url, q)
+                assert lbody["items"] == fbody["items"], params
+                assert lbody["resourceVersion"] == fbody["resourceVersion"]
+            # the leader reports the replication floor
+            _, lbody = _get(leader.url, {"min_rv": str(rv)})
+            assert lbody["replicated_rv"] >= rv
+        finally:
+            leader.stop()
+            follower.stop()
+            leader_cp.close()
+            follower_cp.close()
+
+
+# ---------------------------------------------------------------------------
+# karmadactl search
+# ---------------------------------------------------------------------------
+
+
+class TestKarmadactlSearch:
+    def _cp(self):
+        class _CP:
+            def __init__(self):
+                self.search_index = ColumnarIndex()
+
+            def search(self, params, *, at_rv=None, trace_id=""):
+                return run_query(self.search_index, compile_query(params),
+                                 at_rv=at_rv, trace_id=trace_id)
+        cp = _CP()
+        doc = Unstructured({
+            "apiVersion": "apps/v1", "kind": "Deployment",
+            "metadata": {"name": "web", "namespace": "default",
+                         "labels": {"app": "web"},
+                         "annotations": {CLUSTER_ANNOTATION: "m1"}}})
+        upsert(cp.search_index, "m1", "web", {"app": "web"}, rv=3, doc=doc)
+        cp.search_index.publish()
+        return cp
+
+    def test_table_output(self):
+        from karmada_tpu.cli.karmadactl import run
+
+        out = run(self._cp(), ["search", "apps/v1/Deployment",
+                               "-l", "app=web"])
+        assert "rv: 3 (1 item)" in out
+        lines = out.splitlines()
+        assert lines[1].split() == ["CLUSTER", "NAMESPACE", "NAME", "KIND"]
+        assert lines[2].split() == ["m1", "default", "web",
+                                    "apps/v1/Deployment"]
+
+    def test_json_output_and_empty(self):
+        from karmada_tpu.cli.karmadactl import run
+
+        got = json.loads(run(self._cp(), ["search", "-o", "json"]))
+        assert got["resourceVersion"] == 3
+        assert got["items"][0]["metadata"]["name"] == "web"
+        assert run(self._cp(), ["search", "-l", "app=ghost"]) == "rv: 3 (0 items)"
+
+    def test_bad_selector_is_cli_error(self):
+        from karmada_tpu.cli.karmadactl import CLIError, run
+
+        with pytest.raises(CLIError):
+            run(self._cp(), ["search", "-l", "a==b==c"])
+
+    def test_plane_without_search_plane(self):
+        from karmada_tpu.cli.karmadactl import CLIError, run
+
+        with pytest.raises(CLIError):
+            run(object(), ["search"])
+
+    def test_remote_plane_maps_wire_errors(self):
+        """The wire surface keeps the in-process exception contract:
+        HTTP 400 -> QueryError, 410 -> SnapshotExpired, so karmadactl
+        handles both planes with one except clause."""
+        from karmada_tpu.cli.karmadactl import CLIError, run
+        from karmada_tpu.server.apiserver import ControlPlaneServer
+        from karmada_tpu.server.remote import RemoteControlPlane
+        from karmada_tpu.store.replication import ReplicaControlPlane
+
+        cp = ReplicaControlPlane(search=True)
+        srv = ControlPlaneServer(cp)
+        srv.start()
+        try:
+            cp.store.apply(make_summary("c1", [("default", "web", {})]))
+            assert cp.search_ingestor.flush()
+            rc = RemoteControlPlane(srv.url)
+            assert "web" in run(rc, ["search"])
+            with pytest.raises(CLIError):
+                run(rc, ["search", "-l", "a==b==c"])  # 400 over the wire
+            for i in range(40):  # roll the ring past rv 1
+                cp.store.apply(make_summary("c1", [
+                    ("default", "web", {"i": str(i)})]))
+                assert cp.search_ingestor.flush()
+                cp.search_index.publish()
+            with pytest.raises(CLIError):
+                run(rc, ["search", "--at-rv", "1"])  # 410 over the wire
+        finally:
+            srv.stop()
+            cp.close()
+
+
+# ---------------------------------------------------------------------------
+# OpenSearch backend flush threshold
+# ---------------------------------------------------------------------------
+
+
+class TestOpenSearchFlushThreshold:
+    def _obj(self, name):
+        return Unstructured({
+            "apiVersion": "apps/v1", "kind": "Deployment",
+            "metadata": {"name": name, "namespace": "default",
+                         "uid": f"uid-{name}"}})
+
+    def test_threshold_ships_bulk_mid_sweep(self):
+        from karmada_tpu.search.search import (
+            BufferingTransport,
+            OpenSearchBackend,
+        )
+
+        t = BufferingTransport()
+        be = OpenSearchBackend(["http://os:9200"], transport=t,
+                               flush_threshold=3)
+        for i in range(7):
+            be.index("m1", self._obj(f"web-{i}"))
+        bulks = [r for r in t.requests if r.path == "/_bulk"]
+        assert len(bulks) == 2  # at op 3 and op 6
+        assert len(be._bulk) == 1  # the remainder awaits the sweep flush
+        be.flush()
+        assert be._bulk == []
+        assert len([r for r in t.requests if r.path == "/_bulk"]) == 3
+
+    def test_zero_threshold_keeps_one_bulk_per_sweep(self):
+        from karmada_tpu.search.search import (
+            BufferingTransport,
+            OpenSearchBackend,
+        )
+
+        t = BufferingTransport()
+        be = OpenSearchBackend(["http://os:9200"], transport=t)
+        for i in range(10):
+            be.index("m1", self._obj(f"web-{i}"))
+        assert [r for r in t.requests if r.path == "/_bulk"] == []
+        be.flush()
+        assert len([r for r in t.requests if r.path == "/_bulk"]) == 1
+
+    def test_threshold_flush_failure_keeps_queue(self):
+        from karmada_tpu.search.search import (
+            BufferingTransport,
+            HttpRequest,
+            OpenSearchBackend,
+        )
+
+        class Flaky(BufferingTransport):
+            def perform(self, request: HttpRequest):
+                if request.path == "/_bulk":
+                    raise OSError("down")
+                return super().perform(request)
+
+        be = OpenSearchBackend(["http://os:9200"], transport=Flaky(),
+                               flush_threshold=2)
+        for i in range(5):
+            be.index("m1", self._obj(f"web-{i}"))
+        assert len(be._bulk) == 5  # nothing lost while the transport is down
+
+
+# ---------------------------------------------------------------------------
+# slow path: the bench acceptance line, end to end
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.slow
+class TestSearchSmokeScript:
+    def test_search_smoke(self):
+        """scripts/search_smoke.sh: the `search` bench config — columnar
+        query p99 >= 5x the per-cluster fan-out baseline at 1k clusters
+        with per-query result parity, churn freshness lag bounded and
+        draining to 0 — asserted from the emitted JSON line."""
+        import os
+        import subprocess
+
+        repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        r = subprocess.run(
+            ["bash", "scripts/search_smoke.sh"],
+            capture_output=True, text=True, timeout=900, cwd=repo,
+        )
+        assert r.returncode == 0, r.stdout + r.stderr
+        assert "SEARCH OK" in r.stdout
