@@ -3757,6 +3757,434 @@ def run_search(backend_label: str, verbose=False) -> dict:
     }
 
 
+# -- shards config: the sharded scheduler plane (sched/shards/) ------------
+#
+# N concurrent streaming leaders over ONE store: throughput must scale with
+# the shard count when the per-micro-batch estimator sweep is WAN-dominated
+# (the sweeps are genuine overlappable waits — N leaders fan out to their
+# member slices concurrently on one box), while the paced tail stays flat;
+# cross-shard gangs commit atomically through the coordinator protocol with
+# O(1) co-admission in the number of cohorts.
+
+# pool size picked so the rendezvous split is batch-aligned: at 416 uids
+# the 4-shard max owner holds 106 rows = 7 micro-batches against 26 for
+# one shard (ideal ratio 3.71) — headroom over the >=3x gate that the
+# 1-core GIL tax (~10-15%) cannot erase
+SHARDS_BINDINGS = 416
+SHARDS_CLUSTERS = 24
+# the WAN round-trip must DWARF the per-micro-batch host work (encode +
+# patch, ~100 ms of GIL-bound Python on a 1-core box) or the ladder
+# measures the GIL, not the overlapped sweeps
+SHARDS_RTT_MS = 600.0
+# micro-batch cap: quantizes each burst into per-shard sweep rounds, so the
+# 1->2->4 ladder has enough rounds per shard for clean scaling arithmetic
+SHARDS_MAX_BATCH = 16
+# coalescing delay: lets a burst's writes pool into FULL micro-batches —
+# without it the first batches form half-empty (driver race), the per-shard
+# round count wobbles, and unwarmed tail buckets compile mid-window
+SHARDS_BATCH_DELAY = 0.05
+SHARDS_RATE_HZ = 1.2  # paced-leg arrival rate, under 1-shard capacity
+SHARDS_P99_EVENTS = 36
+
+
+class _WanEstimator:
+    """Models the WAN member fan-out of a real estimator sweep: each
+    micro-batch round pays one member round-trip (`rtt_s`), split across
+    this shard's member legs and slept with the GIL released — exactly the
+    wait N shard leaders overlap on one box. Legs hold slots of the
+    plane's shared per-cluster fairness budget when installed (ShardPlane
+    wires `fairness`); sweeps rotate legs by shard index, so each leader
+    fans out to its own member slice like a real partitioned sweep."""
+
+    def __init__(self, shard_index, rtt_s, legs=4):
+        self.shard_index = shard_index
+        self.rtt_s = rtt_s
+        self.legs = legs
+        self.fairness = None  # installed by ShardPlane
+        self.sweeps = 0
+
+    def max_available_replicas_rows(self, clusters, requirements_list):
+        from contextlib import nullcontext
+
+        lo = (self.shard_index * self.legs) % max(1, len(clusters))
+        legs = [clusters[(lo + j) % len(clusters)] for j in range(self.legs)]
+        per_leg = self.rtt_s / max(1, len(legs))
+        for c in legs:
+            hold = (self.fairness.leg(c) if self.fairness is not None
+                    else nullcontext())
+            with hold:
+                time.sleep(per_leg)
+        self.sweeps += 1
+        # ample availability everywhere: the dynamic division itself is not
+        # under test here, the sweep's wall-clock shape is
+        return np.full((len(requirements_list), len(clusters)), 10_000,
+                       np.int64)
+
+
+def _shards_store(seed, n_clusters, n_bindings):
+    """The churn working set (same pool as `stream`) under a bare store —
+    the shard planes bring their own daemons. Deterministic uids pin the
+    rendezvous keyspace split across legs."""
+    from karmada_tpu.store.store import Store
+    from karmada_tpu.testing.fixtures import synthetic_fleet
+
+    clusters = synthetic_fleet(n_clusters, seed=seed)
+    rng = np.random.default_rng(seed)
+    bindings = _churn_bindings(rng, [c.name for c in clusters], n_bindings)
+    for i, rb in enumerate(bindings):
+        rb.metadata.uid = f"bench-shards-{i}"
+    store = Store()
+    for c in clusters:
+        store.create(c)
+    for rb in bindings:
+        store.create(rb)
+    return store
+
+
+def _shards_burst(store, watch, n_bindings):
+    """Dirty the whole pool at once (the throughput drive): one replica
+    bump per binding, marked for arrival->patch accounting."""
+    for i in range(n_bindings):
+        rb = store.get("ResourceBinding", f"app-{i}", "bench")
+        rb.spec.replicas = max(1, rb.spec.replicas + 1)
+        watch.mark(rb.metadata.key())
+        store.update(rb)
+
+
+def _shards_throughput_leg(total, n_clusters, n_bindings, rtt_s,
+                           paced=False, verbose=False):
+    """One ladder point: a ShardPlane of `total` leader stacks over a
+    fresh store. Unmeasured: initial placement + one warm burst (walks
+    every reachable micro-batch bucket including the tail sizes). Measured:
+    a dirty-all burst; throughput = pool / wall. `paced` additionally
+    drives a sub-capacity arrival rate and records the tail."""
+    from karmada_tpu.estimator.client import EstimatorRegistry
+    from karmada_tpu.sched.shards import ShardPlane
+
+    def registry(index):
+        reg = EstimatorRegistry()
+        reg.register_replica_estimator("wan", _WanEstimator(index, rtt_s))
+        return reg
+
+    store = _shards_store(0, n_clusters, n_bindings)
+    watch = _ArrivalWatch(store)
+    plane = ShardPlane(
+        store, total, elect=False, aot_prewarm=False,
+        registry_factory=registry,
+        batch_delay=SHARDS_BATCH_DELAY, interval=0.05,
+        max_batch=SHARDS_MAX_BATCH,
+    )
+    plane.start()
+    try:
+        deadline = time.monotonic() + 600.0
+        while time.monotonic() < deadline:
+            if watch.placed_count() >= n_bindings:
+                break
+            time.sleep(0.05)
+        else:
+            raise RuntimeError(f"{total}-shard initial placement stalled")
+        plane.quiesce(timeout=120.0)
+        # warm until a full burst completes with ZERO fresh compiles on
+        # every shard: batch formation races the driver, so one pass can
+        # miss a tail-bucket shape that would then compile mid-window
+        for _ in range(3):
+            pre = {s.index: s.service.stats_snapshot()["jit_compiles"]
+                   for s in plane.stacks}
+            _shards_burst(store, watch, n_bindings)
+            if not _stream_wait_drain(watch, grace_s=300.0):
+                raise RuntimeError(f"{total}-shard warm burst did not drain")
+            plane.quiesce(timeout=120.0)
+            if all(s.service.stats_snapshot()["jit_compiles"] == pre[s.index]
+                   for s in plane.stacks):
+                break
+        snap0 = {s.index: s.service.stats_snapshot() for s in plane.stacks}
+        with _gc_quiesced():
+            t0 = time.perf_counter()
+            _shards_burst(store, watch, n_bindings)
+            if not _stream_wait_drain(watch, grace_s=300.0):
+                raise RuntimeError(
+                    f"{total}-shard measured burst did not drain")
+            wall = time.perf_counter() - t0
+        plane.quiesce(timeout=120.0)
+        snap1 = {s.index: s.service.stats_snapshot() for s in plane.stacks}
+        leg = {
+            "shards": total,
+            "wall_s": round(wall, 3),
+            "throughput_hz": round(n_bindings / wall, 1),
+            "batches": sum(snap1[i]["batches"] - snap0[i]["batches"]
+                           for i in snap1),
+            "window_jit_compiles": sum(
+                snap1[i]["jit_compiles"] - snap0[i]["jit_compiles"]
+                for i in snap1),
+            "fairness_waits": int(plane.fairness.waits),
+        }
+        if paced:
+            # ramp-in walks the single-event buckets before measuring
+            ramp = _stream_schedule(7, n_bindings, 10)
+            sched = _stream_schedule(8, n_bindings, SHARDS_P99_EVENTS)
+            _stream_drive(store, watch, ramp, SHARDS_RATE_HZ)
+            _stream_wait_drain(watch, grace_s=60.0)
+            skip = len(watch.latencies)
+            with _gc_quiesced():
+                _stream_drive(store, watch, sched, SHARDS_RATE_HZ)
+                drained = _stream_wait_drain(watch, grace_s=60.0)
+            lat = list(watch.latencies)[skip:]
+            leg["paced"] = {**_percentiles(lat),
+                            "rate_hz": SHARDS_RATE_HZ,
+                            "drained": bool(drained)}
+        if verbose:
+            print(f"# shards: {total}-shard burst {leg['wall_s']}s "
+                  f"({leg['throughput_hz']}/s)"
+                  + (f", paced p99 {leg['paced']['p99_s']}s"
+                     if paced else ""))
+        return leg
+    finally:
+        plane.close()
+
+
+def _shards_gang_fleet():
+    from karmada_tpu.store.store import Store
+    from karmada_tpu.testing.fixtures import synthetic_fleet
+
+    store = Store()
+    for c in synthetic_fleet(6, seed=9):
+        store.create(c)
+    return store
+
+
+def _shards_gang_stacks(store, total):
+    from karmada_tpu.runtime.controller import Runtime
+    from karmada_tpu.sched.shards import ShardedDaemon
+
+    stacks = []
+    for i in range(total):
+        d = ShardedDaemon(store, Runtime(), i, total, aot_prewarm=False)
+        stacks.append((d, d.streaming(batch_delay=0.0)))
+    return stacks
+
+
+_SHARDS_GANG_SEQ = [0]
+
+
+def _shards_gang(gname, size):
+    rbs = []
+    for _ in range(size):
+        i = _SHARDS_GANG_SEQ[0]
+        _SHARDS_GANG_SEQ[0] += 1
+        rb = _binding(10_000 + i, 2, _dyn_placement(), 0.1)
+        rb.spec.gang_name = gname
+        rb.spec.gang_size = size
+        rbs.append(rb)
+    return rbs
+
+
+def _shards_gang_drain(stacks, rounds=32):
+    """Deterministic fixpoint drive (mirrors ControlPlane.settle):
+    quiescent-serve every shard, then run every cross-shard coordinator
+    tick, until a full round makes no progress. Returns the number of
+    PRODUCTIVE rounds — the co-admission cost a cohort count must not
+    inflate."""
+    productive = 0
+    for _ in range(rounds):
+        progress = 0
+        for _d, s in stacks:
+            progress += s.serve(quiescent=True)
+        for d, _s in stacks:
+            progress += d.xshards.tick()
+        if not progress:
+            return productive
+        productive += 1
+    raise RuntimeError("cross-shard gang drain did not reach a fixpoint")
+
+
+class _FirstPlacedLedger:
+    """Per binding, the rv of the FIRST write that placed it (spec.clusters
+    went non-empty). Final rvs are useless as an atomicity anchor: every
+    placement is followed by a per-SHARD observed-generation cleanup write
+    on the next serve round, so last-write rvs interleave across cohorts
+    even when each cohort committed as ONE rv-checked batch. Gang legs
+    drive serve/tick on one thread, so no lock."""
+
+    def __init__(self, store):
+        self.first_rv: dict[str, int] = {}
+        store.watch("ResourceBinding", self._on_event, replay=False)
+
+    def _on_event(self, event, rb) -> None:
+        if event == "DELETED" or not rb.spec.clusters:
+            return
+        self.first_rv.setdefault(
+            rb.metadata.name, rb.metadata.resource_version)
+
+
+def _shards_gang_atomic(store, ledger, gangs):
+    """True iff every cohort committed whole: all members placed and each
+    gang's first-placement rvs contiguous — the observable form of ONE
+    rv-checked batch per gang (a partial or split commit cannot produce
+    it)."""
+    for rbs in gangs:
+        rvs = [ledger.first_rv.get(rb.metadata.name) for rb in rbs]
+        if None in rvs:
+            return False
+        fresh = [store.get("ResourceBinding", rb.metadata.name, "bench")
+                 for rb in rbs]
+        if not all(rb.spec.clusters for rb in fresh):
+            return False
+        rvs = sorted(rvs)
+        if rvs[-1] - rvs[0] != len(rvs) - 1:
+            return False
+    return True
+
+
+def _shards_gang_co_admission(k, total=2, size=4):
+    """K gangs of `size` co-admitted on a `total`-shard plane: the drain
+    must resolve every cohort atomically in a round count that does NOT
+    grow with K (all ready cohorts commit in the same coordinator tick)."""
+    from karmada_tpu.api.sharding import (
+        KIND_SHARD_GANG_PROPOSAL,
+        SHARD_NAMESPACE,
+    )
+
+    store = _shards_gang_fleet()
+    stacks = _shards_gang_stacks(store, total)
+    ledger = _FirstPlacedLedger(store)
+    gangs = [_shards_gang(f"bench-xg-{k}-{j}", size) for j in range(k)]
+    for rbs in gangs:
+        for rb in rbs:
+            store.create(rb)
+    t0 = time.perf_counter()
+    rounds = _shards_gang_drain(stacks)
+    wall = time.perf_counter() - t0
+    atomic = _shards_gang_atomic(store, ledger, gangs)
+    leftovers = len(store.list(KIND_SHARD_GANG_PROPOSAL, SHARD_NAMESPACE))
+    for d, _s in stacks:
+        d.detach()
+    return {"gangs": k, "rounds": rounds, "wall_s": round(wall, 3),
+            "atomic": bool(atomic), "proposals_left": leftovers}
+
+
+def _shards_gang_race(total=2, size=4):
+    """The seeded stale-rv race: members solve and publish, then one
+    member's rv moves before the coordinator assembles — the commit must
+    abort EVERY row (no partial gang ever reaches the store) and the
+    cohort must re-admit uncharged and converge."""
+    from karmada_tpu.metrics import xshard_gang_commits
+    from karmada_tpu.sched.shards import shard_of_binding, shard_of_gang
+
+    store = _shards_gang_fleet()
+    stacks = _shards_gang_stacks(store, total)
+    ledger = _FirstPlacedLedger(store)
+    gname, rbs = "", []
+    for _ in range(64):  # re-roll uids until the cohort spans shards
+        gname = f"bench-race-{_SHARDS_GANG_SEQ[0]}"
+        rbs = _shards_gang(gname, size)
+        if len({shard_of_binding(rb, total) for rb in rbs}) > 1:
+            break
+    for rb in rbs:
+        store.create(rb)
+    for _d, s in stacks:
+        s.serve(quiescent=True)  # solve + publish; coordinator held
+    victim = store.get("ResourceBinding", rbs[0].metadata.name, "bench")
+    victim.metadata.labels = dict(victim.metadata.labels or {}, raced="y")
+    store.update(victim)
+    before = xshard_gang_commits.value(outcome="aborted")
+    coord = stacks[shard_of_gang("bench", gname, total)][0]
+    coord.xshards.tick()
+    aborted = xshard_gang_commits.value(outcome="aborted") - before
+    partial = any(
+        store.get("ResourceBinding", rb.metadata.name, "bench").spec.clusters
+        for rb in rbs
+    )
+    _shards_gang_drain(stacks)
+    recovered = _shards_gang_atomic(store, ledger, [rbs])
+    for d, _s in stacks:
+        d.detach()
+    return {"aborted": int(aborted), "partial_after_abort": bool(partial),
+            "recovered": bool(recovered)}
+
+
+def run_shards(args, backend_label: str, verbose=False) -> dict:
+    """The `shards` config. Legs:
+
+    throughput  ShardPlane at 1, 2, 4 shards over the churn pool; each
+                micro-batch's estimator sweep pays a WAN round-trip, so N
+                leaders overlap N sweeps — dirty-all burst throughput must
+                reach >=1.7x at 2 shards and >=3x at 4
+    paced tail  sub-capacity arrival rate at 1 and 4 shards; the 4-shard
+                p99 must stay within 1.25x of the 1-shard p99
+    gangs       K in {4, 12} cross-shard cohorts co-admitted on 2 shards:
+                every gang commits as ONE rv-checked batch (never partial),
+                resolution rounds O(1) in K; a seeded stale-rv race aborts
+                all rows and the cohort re-admits uncharged
+
+    The JSON line asserts pass_shard_scaling / pass_xshard_gang."""
+    from karmada_tpu.sched import core as core_mod
+    from karmada_tpu.tracing import tracer
+
+    n_bindings = args.bindings
+    rtt_s = args.rtt_ms / 1e3
+    # same CPU hygiene as `stream`: host division tails (the device tail's
+    # CLASS-count bucket wobbles per micro-batch — each flip is an XLA:CPU
+    # compile), tracer off for the measured legs
+    prev_tail = core_mod.HOST_TAIL_MIN_ELEMS
+    core_mod.HOST_TAIL_MIN_ELEMS = 0
+    tr_prev = (tracer.enabled, tracer.head_sample, tracer.slow_threshold_s)
+    tracer.enabled = False
+    try:
+        legs = {}
+        for total in (1, 2, 4):
+            legs[total] = _shards_throughput_leg(
+                total, SHARDS_CLUSTERS, n_bindings, rtt_s,
+                paced=total in (1, 4), verbose=verbose,
+            )
+        co4 = _shards_gang_co_admission(4)
+        co12 = _shards_gang_co_admission(12)
+        race = _shards_gang_race()
+    finally:
+        core_mod.HOST_TAIL_MIN_ELEMS = prev_tail
+        (tracer.enabled, tracer.head_sample,
+         tracer.slow_threshold_s) = tr_prev
+        tracer.reset()
+
+    speedup2 = legs[2]["throughput_hz"] / max(legs[1]["throughput_hz"], 1e-9)
+    speedup4 = legs[4]["throughput_hz"] / max(legs[1]["throughput_hz"], 1e-9)
+    p99_1 = legs[1]["paced"]["p99_s"]
+    p99_4 = legs[4]["paced"]["p99_s"]
+    p99_ratio = round(p99_4 / p99_1, 3) if p99_1 else None
+    pass_scaling = bool(
+        speedup2 >= 1.7 and speedup4 >= 3.0
+        and p99_ratio is not None and p99_ratio <= 1.25
+    )
+    pass_gang = bool(
+        co4["atomic"] and co12["atomic"]
+        and co4["proposals_left"] == 0 and co12["proposals_left"] == 0
+        and co12["rounds"] <= co4["rounds"] + 1
+        and race["aborted"] >= 1 and not race["partial_after_abort"]
+        and race["recovered"]
+    )
+    rec = {
+        "metric": f"shard_scaling_speedup_4x_{n_bindings}rb",
+        "value": round(speedup4, 2),
+        "unit": "x",
+        "backend": backend_label,
+        "rtt_ms": args.rtt_ms,
+        "bindings": n_bindings,
+        "legs": {str(t): legs[t] for t in legs},
+        "speedup_2shard": round(speedup2, 2),
+        "speedup_4shard": round(speedup4, 2),
+        "p99_ratio_4v1": p99_ratio,
+        "gangs": {"co4": co4, "co12": co12, "race": race},
+        "pass_shard_scaling": pass_scaling,
+        "pass_xshard_gang": pass_gang,
+        "pass": bool(pass_scaling and pass_gang),
+    }
+    if verbose:
+        print(f"# shards: speedup 2x={speedup2:.2f} 4x={speedup4:.2f}, "
+              f"p99 ratio {p99_ratio}, gangs rounds "
+              f"{co4['rounds']}->{co12['rounds']}, race abort "
+              f"{race['aborted']} -> pass={rec['pass']}")
+    return rec
+
+
 def build_flagship_cold(seed=0, n_clusters=5000, n_bindings=10000):
     """North-star variant, adversarial to the per-placement encode cache:
     every measured iteration bumps each binding's generation first
@@ -3798,6 +4226,7 @@ CONFIGS = {
     "candidates": (None, None),  # top-K vs dense solve; run_candidates
     "analysis": (None, None),  # invariant analysis sweep; run_analysis
     "search": (None, None),  # columnar fleet search vs fan-out; run_search
+    "shards": (None, None),  # sharded scheduler plane 1->2->4; run_shards
     "flagship_cold": (build_flagship_cold, None),  # named after the shape
     "flagship": (build_flagship, None),  # metric name carries the shape
 }
@@ -3805,8 +4234,8 @@ DEFAULT_ORDER = [
     "dup3", "static", "dynamic", "spread", "spread_skewed", "churn",
     "churn_incremental", "autoshard", "pipeline", "whatif", "degraded",
     "coldstart", "stream", "fanout", "writeload", "replica", "elastic",
-    "preempt", "candidates", "analysis", "search", "flagship_cold",
-    "flagship",
+    "preempt", "candidates", "analysis", "search", "shards",
+    "flagship_cold", "flagship",
 ]
 
 
@@ -3881,6 +4310,11 @@ RESULT_SCHEMAS = {
                "fanout_p99_s": "num", "parity_ok": "bool",
                "freshness": "dict", "pass_speedup": "bool",
                "pass_freshness": "bool", "pass": "bool"},
+    "shards": {**_ENVELOPE, "rtt_ms": "num", "bindings": "int",
+               "legs": "dict", "speedup_2shard": "num",
+               "speedup_4shard": "num", "p99_ratio_4v1": "num?",
+               "gangs": "dict", "pass_shard_scaling": "bool",
+               "pass_xshard_gang": "bool", "pass": "bool"},
     "flagship_cold": _ROUND,
     "flagship": _ROUND,
 }
@@ -3993,6 +4427,11 @@ def add_args(ap: argparse.ArgumentParser) -> None:
                     default=ELASTIC_WORKLOADS, help=argparse.SUPPRESS)
     ap.add_argument("--elastic-clusters", type=int,
                     default=ELASTIC_CLUSTERS, help=argparse.SUPPRESS)
+    # shards config overrides (the plane ladder is fixed at 1->2->4)
+    ap.add_argument("--shards-bindings", type=int, default=SHARDS_BINDINGS,
+                    help=argparse.SUPPRESS)
+    ap.add_argument("--shards-rtt-ms", type=float, default=SHARDS_RTT_MS,
+                    help=argparse.SUPPRESS)
     # platform must be pinned via jax.config inside the child, not the
     # JAX_PLATFORMS env var (the TPU sitecustomize hangs on the env var)
     ap.add_argument("--platform", default=None, help=argparse.SUPPRESS)
@@ -4085,6 +4524,8 @@ def main() -> None:
             "--replica-window-s", str(args.replica_window_s),
             "--elastic-workloads", str(args.elastic_workloads),
             "--elastic-clusters", str(args.elastic_clusters),
+            "--shards-bindings", str(args.shards_bindings),
+            "--shards-rtt-ms", str(args.shards_rtt_ms),
         ] + (["--verbose"] if args.verbose else []) \
           + (["--platform", platform] if platform else [])
         budget = deadline - time.perf_counter()
@@ -4326,6 +4767,25 @@ def run_bench(args) -> None:
                 }
             # numpy-on-host query plane: meaningful on any backend
             lines.append(_validated_line("search", rec))
+            continue
+        if name == "shards":
+            import types
+
+            sh_args = types.SimpleNamespace(
+                bindings=args.shards_bindings, rtt_ms=args.shards_rtt_ms,
+            )
+            try:
+                rec = run_shards(sh_args, backend, verbose=args.verbose)
+            except Exception as e:  # noqa: BLE001 - one labeled error line
+                rec = {
+                    "metric": (f"shard_scaling_speedup_4x_"
+                               f"{args.shards_bindings}rb"),
+                    "value": None, "unit": "x", "backend": backend,
+                    "error": f"{type(e).__name__}: {e}"[:300],
+                }
+            # the overlapped wait is a host-side WAN round-trip, so the
+            # scaling ratio is meaningful on any backend — no fallback note
+            lines.append(_validated_line("shards", rec))
             continue
         if name == "stream":
             import types

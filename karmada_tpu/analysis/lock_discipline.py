@@ -158,8 +158,10 @@ def _scan_function(index: ModuleIndex, fn: FunctionInfo) -> list[Finding]:
 
 
 # the under-lock planes this suite audits: the store (every serving path
-# holds its lock) and the search plane (ingest cv + index swap lock)
-DEFAULT_SCOPES = ("karmada_tpu/store/", "karmada_tpu/search/")
+# holds its lock), the search plane (ingest cv + index swap lock), and
+# the sharded scheduler plane (proposal CAS loops + fairness semaphores)
+DEFAULT_SCOPES = ("karmada_tpu/store/", "karmada_tpu/search/",
+                  "karmada_tpu/sched/shards/")
 
 
 def analyze(index: ModuleIndex, scope=DEFAULT_SCOPES) -> list[Finding]:

@@ -390,6 +390,8 @@ _KIND_ALIASES = {
     "workloadrebalancer": "WorkloadRebalancer",
     "workloadrebalancers": "WorkloadRebalancer",
     "deployment": "apps/v1/Deployment", "deployments": "apps/v1/Deployment",
+    "shard": "SchedulerShard", "shards": "SchedulerShard",
+    "schedulershard": "SchedulerShard", "schedulershards": "SchedulerShard",
 }
 
 
@@ -547,6 +549,8 @@ def cmd_get(cp: ControlPlane, kind: str, name: str = "", namespace: str = "",
     if resolved == "LeaderLease":
         return _elections_table(objs, wide=wide,
                                 repl=_replication_status(cp))
+    if resolved == "SchedulerShard":
+        return _shards_table(objs, wide=wide)
     if resolved == "SimulationReport":
         return _simulation_reports_table(objs, wide=wide)
     if resolved == "WorkloadRebalancer":
@@ -1075,6 +1079,42 @@ def _role_cell(repl: Optional[dict]) -> str:
     return f"{role}@rv{rv}" if rv is not None else role
 
 
+_SHARD_LEASE_PREFIX = "karmada-sched-shard-"
+
+
+def _shards_table(shards, wide: bool = False) -> str:
+    """`karmadactl get shards` — one row per scheduler shard slot
+    (docs/SCHEDULING.md 'Sharded plane'). QUEUE/BINDINGS/EPOCH come from
+    the leader's last status publish; LAST-SOLVE is the plane-clock stamp
+    of the slot's most recent decision batch."""
+
+    import time as _time
+
+    def slot(s) -> int:
+        try:
+            return int(s.metadata.name.rsplit("-", 1)[-1])
+        except ValueError:
+            return -1
+
+    rows = []
+    now = _time.time()
+    for s in sorted(shards, key=slot):
+        st = s.status
+        # last_solve_time is a wall-clock stamp: render the AGE (same
+        # convention as the elections RENEWED column)
+        solve = (f"{max(0.0, now - st.last_solve_time):.0f}s"
+                 if st.last_solve_time else "<never>")
+        rows.append(
+            [f"{slot(s)}/{st.shards_total}", st.leader or "<none>",
+             str(st.epoch), str(st.queue_depth), str(st.bindings), solve]
+            + ([str(st.fencing_token), st.handoff or "-"] if wide else [])
+        )
+    headers = ["SHARD", "LEADER", "EPOCH", "QUEUE", "BINDINGS", "LAST-SOLVE"]
+    if wide:
+        headers += ["TOKEN", "HANDOFF"]
+    return _fmt_table(rows, headers)
+
+
 def _elections_table(leases, wide: bool = False,
                      repl: Optional[dict] = None) -> str:
     """Shared LeaderLease table (the `elections` verb and `get
@@ -1097,10 +1137,15 @@ def _elections_table(leases, wide: bool = False,
         else:
             state = "Active"
         age = max(0.0, now - s.renew_time) if s.renew_time else 0.0
+        # a per-shard scheduler lease elects one SLOT of the sharded
+        # plane, not the whole plane: its ROLE names the slot
+        row_role = role
+        if l.metadata.name.startswith(_SHARD_LEASE_PREFIX):
+            row_role = f"shard-{l.metadata.name[len(_SHARD_LEASE_PREFIX):]}"
         rows.append(
             [l.metadata.name, s.holder_identity or "<none>", state,
              str(s.fencing_token), str(s.lease_transitions), f"{age:.0f}s",
-             role]
+             row_role]
             + ([l.metadata.namespace,
                 f"{s.lease_duration_seconds:.0f}s"] if wide else [])
         )

@@ -136,6 +136,7 @@ class ControlPlane:
         cluster_success_threshold: float = 30.0,
         controllers: Optional[list] = None,
         estimator_workers: Optional[int] = None,
+        scheduler_shards: int = 1,
     ):
         """`controllers`: the --controllers enable/disable list with the
         reference's semantics (context.go:116-137): '*' enables everything
@@ -239,13 +240,36 @@ class ControlPlane:
         # without it must still schedule. Only the explicit opt-out
         # ("-scheduler") disables it, for planes that attach
         # `python -m karmada_tpu.sched` out-of-process instead.
-        self.scheduler = SchedulerDaemon(
-            self.store,
-            self.runtime,
-            estimator_registry=self.estimator_registry,
-            gates=self.gates,
-            event_recorder=self.event_recorder,
-        ) if "-scheduler" not in self.controllers else None
+        if scheduler_shards < 1:
+            raise ValueError("scheduler_shards must be >= 1")
+        self.scheduler_shards = scheduler_shards
+        # the sharded plane (docs/SCHEDULING.md "Sharded plane"): N slot
+        # daemons over the one runtime, each admitting its rendezvous slice
+        # of the binding keyspace; settle() interleaves the cross-shard
+        # gang coordinator ticks so cohorts resolve deterministically
+        self.shard_daemons: list = []
+        self.scheduler = None
+        if "-scheduler" not in self.controllers:
+            if scheduler_shards > 1:
+                from .sched.shards import ShardedDaemon
+
+                self.shard_daemons = [
+                    ShardedDaemon(
+                        self.store, self.runtime, i, scheduler_shards,
+                        estimator_registry=self.estimator_registry,
+                        gates=self.gates,
+                        event_recorder=self.event_recorder,
+                    )
+                    for i in range(scheduler_shards)
+                ]
+            else:
+                self.scheduler = SchedulerDaemon(
+                    self.store,
+                    self.runtime,
+                    estimator_registry=self.estimator_registry,
+                    gates=self.gates,
+                    event_recorder=self.event_recorder,
+                )
         self.override_manager = OverrideManager(self.store)
         self.binding_controller = BindingController(
             self.store,
@@ -542,7 +566,16 @@ class ControlPlane:
             self.members[name].set_healthy(ready)
 
     def settle(self, max_steps: int = 100_000) -> int:
-        return self.runtime.settle(max_steps)
+        n = self.runtime.settle(max_steps)
+        # sharded plane: member shards publish gang proposals during the
+        # settle above; drive the coordinators to a fixpoint so committed
+        # cohorts' dispositions (and any re-admissions) settle too
+        while self.shard_daemons:
+            resolved = sum(d.xshards.tick() for d in self.shard_daemons)
+            n += self.runtime.settle(max_steps)
+            if not resolved:
+                break
+        return n
 
     def tick(self, seconds: float = 0.0, max_steps: int = 100_000) -> int:
         """Advance the injected clock and fire every time-gated loop (the
@@ -565,6 +598,11 @@ class ControlPlane:
             # (sched/queue.py GangCoordinator; the streaming loop checks
             # per admission — the batch daemon needs the timer)
             self.scheduler.gang_tick()
+        for d in self.shard_daemons:
+            # cross-shard cohorts never hold locally; the coordinator's
+            # tick owns assembly, commit, and the timeout clock
+            d.xshards.tick()
+            d.publish_status(leader="local")
         self.descheduler.tick()
         if self.federated_hpa_controller is not None:
             self.federated_hpa_controller.tick()

@@ -321,6 +321,11 @@ class MemberEstimators:
         self.max_workers = max_workers
         self._pool: Optional[ThreadPoolExecutor] = None
         self._pool_width = 0
+        # optional sched.shards.fairness.ClusterFairnessBudget: with N
+        # in-process shard leaders sweeping concurrently, this caps each
+        # member cluster's AGGREGATE estimator concurrency so one hot
+        # shard cannot starve its siblings' legs (installed by ShardPlane)
+        self.fairness = None
         self._fleet_key = None
         self._fleet_dev = None  # (alloc, requested, pod_count, allowed, cid, claimless_ok)
         self._no_node_cols = None  # bool[C] clusters without node state
@@ -359,9 +364,18 @@ class MemberEstimators:
         )
         if br is not None and not br.allow():
             return sentinel
+        from contextlib import nullcontext
+
+        # cross-shard fairness (sched/shards/fairness.py): hold one of the
+        # cluster's aggregate concurrency slots for the duration of the leg
+        leg = (
+            self.fairness.leg(cluster) if self.fairness is not None
+            else nullcontext()
+        )
         try:
-            faults.check(faults.BOUNDARY_GRPC, cluster)
-            out = fn()
+            with leg:
+                faults.check(faults.BOUNDARY_GRPC, cluster)
+                out = fn()
         except faults.InjectedFault as e:
             estimator_rpc_errors.inc(cluster=cluster, code=e.code)
             if br is not None:

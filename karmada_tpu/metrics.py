@@ -572,6 +572,30 @@ search_ingest_resyncs = registry.counter(
     "(the level-triggered recovery path; nonzero is safe but worth a look)",
 )
 
+# -- sharded scheduler plane (sched/shards/, docs/SCHEDULING.md) -----------
+shard_bindings = registry.gauge(
+    "karmada_shard_bindings",
+    "Bindings the rendezvous shard map currently assigns to each shard "
+    "slot (labeled by shard; rows retire with the shard)",
+)
+shard_queue_depth = registry.gauge(
+    "karmada_shard_queue_depth",
+    "Per-shard scheduling queue depth after each micro-batch drain "
+    "(labeled by shard; rows retire with the shard)",
+)
+shard_handoffs = registry.counter(
+    "karmada_shard_handoffs_total",
+    "Keyspace handoffs between shards, by reason: resize (the shard map "
+    "changed width) or takeover (a shard leader changed)",
+)
+xshard_gang_commits = registry.counter(
+    "karmada_xshard_gang_commits_total",
+    "Cross-shard gang commit outcomes at the coordinator: committed (one "
+    "rv-checked batch landed the whole cohort), aborted (a member's "
+    "stale-rv veto re-admitted the gang uncharged), rejected (jointly "
+    "infeasible), timeout (the cohort never assembled)",
+)
+
 
 class timed:
     """Context manager observing wall time into a histogram."""

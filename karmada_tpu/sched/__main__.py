@@ -43,6 +43,16 @@ def main() -> None:
                     help="reference --plugins semantics (enable/disable "
                          "filter and score plugins)")
     ap.add_argument("--scheduler-name", default="default-scheduler")
+    ap.add_argument("--scheduler-shards", type=int, default=1,
+                    help="total shard slots in the scheduler plane; this "
+                         "process serves the slot named by --shard-index "
+                         "and admits only the bindings whose ns/uid "
+                         "rendezvous-hashes to it (docs/SCHEDULING.md "
+                         "'Sharded plane')")
+    ap.add_argument("--shard-index", type=int, default=0,
+                    help="which shard slot this process serves (0-based; "
+                         "leader-elects on the karmada-sched-shard-<i> "
+                         "lease). Run one process per slot")
     ap.add_argument("--interval", type=float, default=0.2,
                     help="max-sleep fallback between wakeups: the daemon "
                          "wakes on every enqueue (condition variable), so "
@@ -124,6 +134,13 @@ def main() -> None:
                          "half-open probe")
     args = ap.parse_args()
 
+    sharded = args.scheduler_shards > 1
+    if args.scheduler_shards < 1:
+        ap.error("--scheduler-shards must be >= 1")
+    if not 0 <= args.shard_index < args.scheduler_shards:
+        ap.error(f"--shard-index {args.shard_index} out of range for "
+                 f"--scheduler-shards {args.scheduler_shards}")
+
     if args.platform == "cpu":
         from ..testing.cpumesh import force_cpu_mesh
 
@@ -189,12 +206,23 @@ def main() -> None:
     )
     runtime = Runtime()
     plugins = [p.strip() for p in args.plugins.split(",") if p.strip()]
-    daemon = SchedulerDaemon(
-        store, runtime, scheduler_name=args.scheduler_name,
+    daemon_kwargs = dict(
+        scheduler_name=args.scheduler_name,
         estimator_registry=registry, plugins=plugins,
         pipeline=False if args.no_pipeline else None,
         aot_prewarm=False if args.no_aot_prewarm else None,
     )
+    if sharded:
+        from .shards import ShardedDaemon
+
+        daemon = ShardedDaemon(
+            store, runtime, args.shard_index, args.scheduler_shards,
+            **daemon_kwargs,
+        )
+        print(f"sharded plane: serving shard {args.shard_index} of "
+              f"{args.scheduler_shards}", flush=True)
+    else:
+        daemon = SchedulerDaemon(store, runtime, **daemon_kwargs)
     metrics_srv = start_metrics_server(
         args.metrics_port, token=token,
         scrape_token_file=args.scrape_token_file,
@@ -206,26 +234,49 @@ def main() -> None:
         scrape_token_file=args.scrape_token_file,
     )
 
-    lease_name = args.lease_name or (
-        LEASE_SCHEDULER if args.scheduler_name == "default-scheduler"
-        else f"karmada-scheduler-{args.scheduler_name}"
-    )
+    if sharded:
+        from ..api.sharding import shard_lease_name
+
+        lease_name = args.lease_name or shard_lease_name(args.shard_index)
+    else:
+        lease_name = args.lease_name or (
+            LEASE_SCHEDULER if args.scheduler_name == "default-scheduler"
+            else f"karmada-scheduler-{args.scheduler_name}"
+        )
     identity = args.identity or default_identity()
     leading = threading.Event()
+    lease_token = [0]
     elector = None
     if args.no_leader_elect:
         leading.set()
+        if sharded:
+            daemon.xshards.start()
+            daemon.publish_status(leader=identity, force=True)
     else:
         def started(token_: int) -> None:
             store.set_fence(lease_name, token_)
             daemon.abandon_prewarm()  # the leader's first round must not
             #   share the backend with a background compile walk
+            lease_token[0] = token_
+            if sharded:
+                # takeover: the coordinator resumes pending cross-shard
+                # cohorts, and the re-list re-places whatever the deposed
+                # leader had in flight (its patches bounce on the fence)
+                daemon.xshards.start()
+                daemon.relist()
             leading.set()
+            if sharded:
+                daemon.publish_status(leader=identity, token=token_,
+                                      force=True)
             print(f"leader: {identity} acquired lease {lease_name} "
                   f"(fencing token {token_})", flush=True)
 
         def stopped(reason: str) -> None:
             leading.clear()
+            lease_token[0] = 0
+            if sharded:
+                daemon.xshards.stop()
+                daemon.publish_status(force=True)
             store.clear_fence()
             print(f"leader: {identity} lost lease {lease_name} ({reason})",
                   flush=True)
@@ -272,7 +323,10 @@ def main() -> None:
                     # there must back off and retry, not kill the daemon
                     try:
                         service.serve(
-                            should_stop=lambda: not leading.is_set()
+                            should_stop=lambda: not leading.is_set(),
+                            idle=(lambda: daemon.publish_status(
+                                leader=identity, token=lease_token[0]))
+                            if sharded else None,
                         )
                     except Exception:  # noqa: BLE001 - survive transients
                         import logging
@@ -288,6 +342,9 @@ def main() -> None:
 
                         logging.getLogger(__name__).exception(
                             "scheduling drain")
+                    if sharded:
+                        daemon.publish_status(leader=identity,
+                                              token=lease_token[0])
                     wake.wait(args.interval)
                     wake.clear()
             else:
@@ -300,6 +357,8 @@ def main() -> None:
             service.stop()
         if elector is not None:
             elector.stop(release=True)
+        if sharded:
+            daemon.xshards.stop()
         if metrics_srv is not None:
             metrics_srv.stop()
         if profile_srv is not None:

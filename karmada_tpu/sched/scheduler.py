@@ -124,6 +124,12 @@ class AdmissionLog:
         with self._lock:
             return self._epoch.get(key, 0)
 
+    def last_epoch(self) -> int:
+        """Highest admission epoch currently tracked — the shard status
+        view's EPOCH column (observational; forgotten keys drop out)."""
+        with self._lock:
+            return max(self._epoch.values(), default=0)
+
     def observe_patch(self, key: str, now: float) -> Optional[float]:
         """Latency of the patch that just landed (admission → patch);
         clears the pending stretch. None when nothing was pending. The
@@ -221,6 +227,12 @@ class SchedulerDaemon:
         # AOT hint that micro-batch row buckets belong in the prewarm walk
         self.admission = AdmissionLog()
         self.stream_prewarm = False
+        # sharded plane (sched/shards/): the shard this daemon serves, as a
+        # span/metric label ("" = the unsharded singleton). Ownership and
+        # gang-hold routing go through the _owns/_gang_holds seams below so
+        # the sharded subclass changes WHICH keys admit, never HOW they
+        # solve or patch.
+        self.shard_id = ""
         # workload-class scheduling (sched/preemption.py, docs/SCHEDULING.md):
         # the gang coordinator holds partial all-or-nothing cohorts at the
         # queue seam, and `preemption_enabled` arms the second solve pass
@@ -280,6 +292,14 @@ class SchedulerDaemon:
             # re-targeted to another scheduler: any in-flight decision of
             # ours was computed on the pre-retarget spec — fence it off
             # (no enqueue: the binding is not ours to schedule)
+            if self.admission.enabled:
+                self.admission.invalidate(rb.metadata.key())
+            return
+        if not self._owns(rb):
+            # sharded plane: another shard's key. Fence any in-flight
+            # decision of ours (a resize may have just moved the key off
+            # this shard mid-solve) but do not enqueue — the owning
+            # shard's own watch admits it.
             if self.admission.enabled:
                 self.admission.invalidate(rb.metadata.key())
             return
@@ -348,7 +368,8 @@ class SchedulerDaemon:
                 return True  # replicas changed → scale schedule (:408)
         return False
 
-    def _admission_gate(self, rb: Optional[ResourceBinding]) -> str:
+    def _admission_gate(self, rb: Optional[ResourceBinding],
+                        any_shard: bool = False) -> str:
         """Per-key admission decision, shared by BOTH drain paths (the
         batch round's _schedule_batch and streaming's _form_keys) so the
         skip conditions cannot drift apart and silently break the
@@ -364,9 +385,24 @@ class SchedulerDaemon:
             # re-targeted while queued: the event handler declines
             # re-target events, but this key was enqueued BEFORE
             return "drop"
+        if not any_shard and not self._owns(rb):
+            # sharded plane: the key moved (or never belonged) to another
+            # shard. Dropping here — and in the _patch_result re-check,
+            # which runs under the store's serialization — is the handoff
+            # fence: a losing shard's in-flight decision can never patch a
+            # binding the gaining shard now owns. `any_shard` is the one
+            # sanctioned bypass: the cross-shard gang COORDINATOR commits
+            # members it does not own (safety comes from the rv fence on
+            # its batch commit, not from ownership).
+            return "drop"
         if rb.spec.scheduling_suspended():
             return "suspended"
         return "schedule" if self._needs_schedule(rb) else "clean"
+
+    def _owns(self, rb: ResourceBinding) -> bool:
+        """Shard-ownership predicate (sched/shards/): the unsharded daemon
+        owns everything; ShardedDaemon overrides with the rendezvous map."""
+        return True
 
     def _record_observed(self, rb: ResourceBinding, sink=None) -> None:
         """No scheduling required: still record that the current spec was
@@ -589,6 +625,15 @@ class SchedulerDaemon:
 
         return gang_of(rb)
 
+    def _gang_holds(self, rb: ResourceBinding) -> str:
+        """The gang identity for QUEUE-HOLD purposes: non-empty parks the
+        member in the local GangCoordinator until its cohort assembles.
+        The sharded daemon (N>1) returns "" — members hash to different
+        shards, so no single queue can assemble the cohort; gang rows
+        admit like solo rows and the cross-shard proposal protocol
+        (sched/shards/gangs.py) supplies the all-or-nothing commit."""
+        return self._gang_of(rb)
+
     def gang_tick(self) -> int:
         """Reject gangs whose hold window elapsed incomplete (ControlPlane
         .tick drives this for the batch daemon; the streaming loop checks
@@ -633,7 +678,7 @@ class SchedulerDaemon:
         solves together). Non-gang rows pass through untouched."""
         ready: list = []
         for rb in bindings:
-            if self._gang_of(rb):
+            if self._gang_holds(rb):
                 released = self.gangs.offer(rb.metadata.key(), rb, 0)
                 ready.extend(rb2 for _k, rb2, _e in released)
             else:
@@ -1208,7 +1253,8 @@ class SchedulerDaemon:
             preemptions_total.inc(outcome="aborted")
 
     def _patch_result(self, rb: ResourceBinding, decision: ScheduleDecision,
-                      *, fresh=None, sink=None) -> bool:
+                      *, fresh=None, sink=None,
+                      any_shard: bool = False) -> bool:
         """Write a decision back to the store. Returns False when the write
         is VETOED by a last-moment spec change: the streaming writer's epoch
         fence is check-then-act, so a deletion/suspension/re-target event
@@ -1224,7 +1270,8 @@ class SchedulerDaemon:
         post-commit."""
         if fresh is _UNREAD or (fresh is None and sink is None):
             fresh = self.store.try_get("ResourceBinding", rb.name, rb.namespace)
-        if self._admission_gate(fresh) in ("drop", "suspended"):
+        if self._admission_gate(fresh, any_shard=any_shard) in (
+                "drop", "suspended"):
             return False
         if decision.ok:
             placement = placement_json(fresh.spec.placement)

@@ -344,6 +344,10 @@ class StreamingScheduler:
         q = daemon.controller.queue
         keys = q.drain(self._quota(array))
         sched_queue_depth.set(float(len(q)))
+        if daemon.shard_id:
+            from ..metrics import shard_queue_depth
+
+            shard_queue_depth.set(float(len(q)), shard=daemon.shard_id)
         if not keys:
             return None
         if self._suspects:
@@ -400,6 +404,9 @@ class StreamingScheduler:
         keys."""
         clean = 0
         observed: list = []
+        # per-shard span attribution: queue_wait records WHICH shard's
+        # queue held the key (empty for the unsharded singleton)
+        shard_attr = {"shard": daemon.shard_id} if daemon.shard_id else {}
         for key in keys:
             # epoch BEFORE the spec read: an event landing in between
             # discards a decision that was in fact computed on the fresh
@@ -429,11 +436,13 @@ class StreamingScheduler:
                     daemon.gangs.discard(key, rb.spec.gang_name)
             elif gate == "schedule":
                 aging = getattr(daemon.controller.queue, "aging_step", 0.0)
-                if daemon._gang_of(rb):
+                if daemon._gang_holds(rb):
                     # gang member: park in the coordinator until the whole
                     # cohort is here; the completing offer releases every
                     # held member into THIS micro-batch, so a gang always
-                    # solves (and commits) as one cohort
+                    # solves (and commits) as one cohort. (The sharded
+                    # daemon holds nothing here — _gang_holds returns ""
+                    # and members ride the cross-shard commit instead.)
                     cohort = daemon.gangs.offer(key, rb, epoch)
                     if not cohort:
                         # held: the gang_hold span stays open until the
@@ -442,12 +451,12 @@ class StreamingScheduler:
                     for k2, rb2, e2 in cohort:
                         tracer.unmark(k2, "gang_hold",
                                       gang=rb.spec.gang_name)
-                        tracer.drained(k2, aging)
+                        tracer.drained(k2, aging, **shard_attr)
                         bindings.append(rb2)
                         out_keys.append(k2)
                         epochs.append(e2)
                     continue
-                tracer.drained(key, aging)
+                tracer.drained(key, aging, **shard_attr)
                 bindings.append(rb)
                 out_keys.append(key)
                 epochs.append(epoch)
@@ -588,6 +597,9 @@ class StreamingScheduler:
         t_committed = time.time()
         if tracer.enabled and mb.launch_wall and cohort:
             lid, l0, l1 = mb.launch_wall
+            shard_attr = (
+                {"shard": daemon.shard_id} if daemon.shard_id else {}
+            )
             for (key, _rb, _dec), ok in zip(cohort, outcomes):
                 if not ok:
                     continue
@@ -595,9 +607,10 @@ class StreamingScheduler:
                               rows=len(mb.bindings), replayed=mb.replayed,
                               solved=mb.solved,
                               dispatch_ms=round((l1 - l0) * 1e3, 3),
-                              device_ms=round((t_solved - l1) * 1e3, 3))
+                              device_ms=round((t_solved - l1) * 1e3, 3),
+                              **shard_attr)
                 tracer.record(key, "commit", t_solved, t_committed,
-                              cohort=len(cohort))
+                              cohort=len(cohort), **shard_attr)
         for (key, rb, dec), ok in zip(cohort, outcomes):
             if not ok:
                 # last-moment veto under the store's serialization: a

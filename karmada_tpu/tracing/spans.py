@@ -222,10 +222,13 @@ class PlacementTracer:
                     rec.trace_id = f"{rec.uid}:{epoch}"
                     self._tid_pending[rec.trace_id] = rec
 
-    def drained(self, key: str, aging_step: float = 0.0) -> None:
+    def drained(self, key: str, aging_step: float = 0.0,
+                **attrs: Any) -> None:
         """The binding left the queue into a micro-batch: close the
         queue_wait span (admission -> drain), with the aged portion as its
-        own queue_aging span when the wait crossed the queue's aging step."""
+        own queue_aging span when the wait crossed the queue's aging step.
+        `attrs` ride the queue_wait span (the sharded plane stamps which
+        shard's queue held the key)."""
         if not self.enabled:
             return
         now = time.time()
@@ -233,7 +236,8 @@ class PlacementTracer:
             rec = self._pending.get(key)
             if rec is None or rec.admitted is None:
                 return
-            rec.spans.append(Span("queue_wait", rec.admitted, now))
+            rec.spans.append(Span("queue_wait", rec.admitted, now,
+                                  attrs=dict(attrs)))
             if aging_step > 0 and now - rec.admitted > aging_step:
                 rec.spans.append(Span(
                     "queue_aging", rec.admitted + aging_step, now,

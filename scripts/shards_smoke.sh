@@ -1,0 +1,63 @@
+#!/usr/bin/env bash
+# Sharded scheduler plane smoke (docs/SCHEDULING.md "Sharded plane").
+# Single-shot: runs the `shards` bench config — the 1->2->4 streaming-
+# leader ladder over one store (each leader sweeping WAN-latency
+# estimators for its owned rows only), plus the cross-shard gang commit
+# legs — and asserts the acceptance booleans the JSON line carries:
+#   pass_shard_scaling  dirty-all burst throughput >= 1.7x at 2 shards
+#                       and >= 3x at 4 shards vs the 1-shard leg, with
+#                       paced-arrival p99 at 4 shards within 1.25x of
+#                       the 1-shard tail
+#   pass_xshard_gang    every co-admitted cohort commits as ONE
+#                       rv-checked batch (first-placement rvs contiguous
+#                       per gang, K=4 and K=12 resolving in the same
+#                       round count), and the seeded stale-rv race
+#                       aborts ALL rows with the cohort re-admitting
+#                       uncharged
+# Exit 0 prints "SHARDS OK".
+#
+# Wired into the slow path as
+# tests/test_shards.py::TestShardsSmokeScript (pytest -m slow).
+# The overlapped wait is a host-side WAN round-trip: runs on CPU.
+set -euo pipefail
+
+cd "$(dirname "$0")/.."
+PY=${PYTHON:-python}
+WORK=$(mktemp -d /tmp/shards_smoke.XXXXXX)
+trap 'rm -rf "$WORK"' EXIT
+
+log() { echo "shards_smoke: $*"; }
+
+JAX_PLATFORMS=cpu $PY bench.py --inner --platform cpu --configs shards \
+    --verbose > "$WORK/out.txt" 2> "$WORK/err.txt" \
+    || { log "bench failed"; cat "$WORK/err.txt"; exit 1; }
+
+LINE=$(grep -E '^\{' "$WORK/out.txt" | tail -1)
+[ -n "$LINE" ] || { log "no JSON line emitted"; cat "$WORK/out.txt"; exit 1; }
+log "result: $LINE"
+
+SHARDS_LINE="$LINE" $PY - <<'PYEOF'
+import json
+import os
+import sys
+
+rec = json.loads(os.environ["SHARDS_LINE"])
+for key in ("pass_shard_scaling", "pass_xshard_gang", "pass"):
+    if not rec.get(key):
+        print(f"shards_smoke: criterion {key} FAILED "
+              f"(speedup_2shard={rec.get('speedup_2shard')}x "
+              f"speedup_4shard={rec.get('speedup_4shard')}x "
+              f"p99_ratio_4v1={rec.get('p99_ratio_4v1')}, "
+              f"gangs={rec.get('gangs')})",
+              file=sys.stderr)
+        sys.exit(1)
+g = rec["gangs"]
+print(f"shards_smoke: {rec['bindings']} bindings at "
+      f"{rec['rtt_ms']}ms RTT — 2-shard {rec['speedup_2shard']}x, "
+      f"4-shard {rec['speedup_4shard']}x, p99 ratio "
+      f"{rec['p99_ratio_4v1']}; gangs co4/co12 rounds "
+      f"{g['co4']['rounds']}/{g['co12']['rounds']}, race aborted "
+      f"{g['race']['aborted']} recovered {g['race']['recovered']}")
+PYEOF
+
+echo "SHARDS OK"

@@ -145,6 +145,13 @@ def main() -> None:
     # the committed artifact carries the acceptance booleans)
     artifact["runs"].append(run_bench(
         ["--configs", "preempt", "--run-timeout", "600"], 700))
+    # sharded scheduler plane: the 1->2->4 streaming-leader ladder over
+    # one store (dirty-all burst throughput scaling + paced-tail parity)
+    # and the cross-shard gang commit legs — atomic first-placement-rv
+    # batches, O(1)-in-K co-admission rounds, the seeded stale-rv abort
+    # (captured so the committed artifact carries the acceptance booleans)
+    artifact["runs"].append(run_bench(
+        ["--configs", "shards", "--run-timeout", "600"], 700))
     # the Go-interop seam: /v1/scheduleBatch latency at flagship scale
     artifact["runs"].append(run_script(
         "scripts/bench_shim.py",
