@@ -4185,6 +4185,53 @@ def run_shards(args, backend_label: str, verbose=False) -> dict:
     return rec
 
 
+def run_soak_bench(args, backend_label: str, verbose=False) -> dict:
+    """The `soak` config (docs/ROBUSTNESS.md "Fleet soak"): the full
+    daemon topology — leader + quorum followers, N scheduler shards with
+    real elections over the wire, pull agents + estimators per member,
+    elasticity daemon, descheduler, detector/binding/status controllers —
+    driven through seeded fault waves (boundary chaos on http/grpc/apply
+    PLUS leader kill, shard kill, follower partition past the log ring,
+    estimator blackout) while the invariant catalog is held continuously.
+    The run executes under KARMADA_TPU_LOCKCHECK=1; the JSON line embeds
+    the structured verdict (invariant pass_* gates + tracing.slo_report)
+    and refuses to print a malformed one. Short profile by default
+    (seeded, deterministic, < ~3 min CPU); --soak-minutes scales the wave
+    count for long runs. Host-side topology: meaningful on any backend."""
+    from karmada_tpu.soak import SoakProfile, run_soak, verdict_schema_ok
+
+    profile = SoakProfile(
+        members=2, followers=2, shards=2, apps=4, waves=4,
+        settle_window_s=45.0,
+        soak_minutes=float(getattr(args, "soak_minutes", 0.0) or 0.0),
+    )
+    verdict = run_soak(profile)
+    schema_ok = verdict_schema_ok(verdict)
+    rec = {
+        "metric": "soak_fleet_verdict",
+        "value": verdict["duration_s"],
+        "unit": "s",
+        "backend": backend_label,
+        "soak_schema_ok": bool(schema_ok),
+        "verdict": verdict,
+        "pass_lost_writes": verdict["pass_lost_writes"],
+        "pass_exactly_once": verdict["pass_exactly_once"],
+        "pass_gang_integrity": verdict["pass_gang_integrity"],
+        "pass_convergence": verdict["pass_convergence"],
+        "pass_resources": verdict["pass_resources"],
+        "pass_replication": verdict["pass_replication"],
+        "pass_lock_order": verdict["pass_lock_order"],
+        "pass": bool(verdict["pass"] and schema_ok),
+    }
+    if verbose:
+        ev = [e["kind"] for w in verdict["waves"]
+              for e in w["process_events"]]
+        print(f"# soak: {len(verdict['waves'])} waves in "
+              f"{verdict['duration_s']}s, process faults {ev}, "
+              f"pass={rec['pass']}")
+    return rec
+
+
 def build_flagship_cold(seed=0, n_clusters=5000, n_bindings=10000):
     """North-star variant, adversarial to the per-placement encode cache:
     every measured iteration bumps each binding's generation first
@@ -4227,6 +4274,7 @@ CONFIGS = {
     "analysis": (None, None),  # invariant analysis sweep; run_analysis
     "search": (None, None),  # columnar fleet search vs fan-out; run_search
     "shards": (None, None),  # sharded scheduler plane 1->2->4; run_shards
+    "soak": (None, None),  # fleet chaos soak verdict; run_soak_bench
     "flagship_cold": (build_flagship_cold, None),  # named after the shape
     "flagship": (build_flagship, None),  # metric name carries the shape
 }
@@ -4234,7 +4282,7 @@ DEFAULT_ORDER = [
     "dup3", "static", "dynamic", "spread", "spread_skewed", "churn",
     "churn_incremental", "autoshard", "pipeline", "whatif", "degraded",
     "coldstart", "stream", "fanout", "writeload", "replica", "elastic",
-    "preempt", "candidates", "analysis", "search", "shards",
+    "preempt", "candidates", "analysis", "search", "shards", "soak",
     "flagship_cold", "flagship",
 ]
 
@@ -4315,6 +4363,11 @@ RESULT_SCHEMAS = {
                "speedup_4shard": "num", "p99_ratio_4v1": "num?",
                "gangs": "dict", "pass_shard_scaling": "bool",
                "pass_xshard_gang": "bool", "pass": "bool"},
+    "soak": {**_ENVELOPE, "soak_schema_ok": "bool", "verdict": "dict",
+             "pass_lost_writes": "bool", "pass_exactly_once": "bool",
+             "pass_gang_integrity": "bool", "pass_convergence": "bool",
+             "pass_resources": "bool", "pass_replication": "bool",
+             "pass_lock_order": "bool", "pass": "bool"},
     "flagship_cold": _ROUND,
     "flagship": _ROUND,
 }
@@ -4432,6 +4485,9 @@ def add_args(ap: argparse.ArgumentParser) -> None:
                     help=argparse.SUPPRESS)
     ap.add_argument("--shards-rtt-ms", type=float, default=SHARDS_RTT_MS,
                     help=argparse.SUPPRESS)
+    # soak config: 0 = short deterministic profile; > 0 scales wave count
+    ap.add_argument("--soak-minutes", type=float, default=0.0,
+                    help=argparse.SUPPRESS)
     # platform must be pinned via jax.config inside the child, not the
     # JAX_PLATFORMS env var (the TPU sitecustomize hangs on the env var)
     ap.add_argument("--platform", default=None, help=argparse.SUPPRESS)
@@ -4526,6 +4582,7 @@ def main() -> None:
             "--elastic-clusters", str(args.elastic_clusters),
             "--shards-bindings", str(args.shards_bindings),
             "--shards-rtt-ms", str(args.shards_rtt_ms),
+            "--soak-minutes", str(args.soak_minutes),
         ] + (["--verbose"] if args.verbose else []) \
           + (["--platform", platform] if platform else [])
         budget = deadline - time.perf_counter()
@@ -4786,6 +4843,18 @@ def run_bench(args) -> None:
             # the overlapped wait is a host-side WAN round-trip, so the
             # scaling ratio is meaningful on any backend — no fallback note
             lines.append(_validated_line("shards", rec))
+            continue
+        if name == "soak":
+            try:
+                rec = run_soak_bench(args, backend, verbose=args.verbose)
+            except Exception as e:  # noqa: BLE001 - one labeled error line
+                rec = {
+                    "metric": "soak_fleet_verdict",
+                    "value": None, "unit": "s", "backend": backend,
+                    "error": f"{type(e).__name__}: {e}"[:300],
+                }
+            # host-side daemon topology under chaos: any backend
+            lines.append(_validated_line("soak", rec))
             continue
         if name == "stream":
             import types

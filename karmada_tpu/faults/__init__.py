@@ -11,6 +11,9 @@ from .plan import (
     FaultPlan,
     FaultRule,
     InjectedFault,
+    PROCESS_KINDS,
+    ProcessEvent,
+    ProcessFaultRule,
     active,
     check,
     install,
@@ -35,6 +38,7 @@ from .staleness import (
 __all__ = [
     "BOUNDARY_APPLY", "BOUNDARY_GRPC", "BOUNDARY_HTTP", "ENV_FAULT_PLAN",
     "FaultAction", "FaultInjector", "FaultPlan", "FaultRule", "InjectedFault",
+    "PROCESS_KINDS", "ProcessEvent", "ProcessFaultRule",
     "active", "check", "install", "install_from_env", "reset",
     "CLOSED", "HALF_OPEN", "OPEN",
     "Backoff", "BreakerRegistry", "CircuitBreaker", "RetryPolicy",

@@ -44,6 +44,18 @@ BOUNDARY_APPLY = "apply"    # execution controller / agent -> member apply
 BOUNDARIES = (BOUNDARY_HTTP, BOUNDARY_GRPC, BOUNDARY_APPLY)
 
 KINDS = ("error", "partition", "flap", "latency")
+
+# Process-level fault vocabulary: whole-process events the soak harness fires
+# BETWEEN traffic slices (boundary rules above fire per-op, these fire
+# per-wave). The plan only *decides* (pure, seeded); the harness *executes*
+# (kills the leader server, resizes the shard plane, valves a follower,
+# blacks out an estimator) because only it holds the process handles.
+PROCESS_KINDS = (
+    "leader_kill",          # stop leader server group; seal-and-promote
+    "shard_kill",           # kill one scheduler shard; map-resize handoff
+    "partition",            # isolate a follower past the log ring (snapshot)
+    "estimator_blackout",   # member estimators answer nothing for a window
+)
 ENV_FAULT_PLAN = "KARMADA_TPU_FAULT_PLAN"
 
 
@@ -98,6 +110,40 @@ class FaultAction:
     latency: float = 0.0
 
 
+@dataclass(frozen=True)
+class ProcessFaultRule:
+    """One whole-process fault candidate. `wave` pins the rule to exactly one
+    fault wave (the unit that replays deterministically — the soak has no
+    per-op counter for process lifecycles); wave=-1 makes the rule a
+    candidate on EVERY wave, gated by the seeded `rate` coin."""
+
+    kind: str
+    target: str = "*"      # follower name / shard index / member — "*" lets
+    #                        the harness pick (e.g. the max-applied follower)
+    wave: int = -1         # fire on exactly this wave; -1 = every wave
+    rate: float = 1.0      # per-wave firing probability (splitmix coin)
+
+    def validate(self) -> None:
+        if self.kind not in PROCESS_KINDS:
+            raise ValueError(
+                f"unknown process fault kind {self.kind!r} "
+                f"(want one of {sorted(PROCESS_KINDS)})"
+            )
+        if self.wave < -1:
+            raise ValueError(f"wave {self.wave} < -1")
+        if not 0.0 <= self.rate <= 1.0:
+            raise ValueError(f"rate {self.rate} outside [0, 1]")
+
+
+@dataclass(frozen=True)
+class ProcessEvent:
+    """One fired process fault: what the harness must do this wave."""
+
+    kind: str
+    target: str
+    wave: int
+
+
 def _splitmix_unit(seed: int, rule_idx: int, site: str, n: int) -> float:
     """Deterministic uniform [0,1) for one (rule, site, op) — splitmix64 over
     a stable mix of the identifying tuple (no Python hash randomization)."""
@@ -116,10 +162,13 @@ def _splitmix_unit(seed: int, rule_idx: int, site: str, n: int) -> float:
 class FaultPlan:
     seed: int = 0
     rules: list[FaultRule] = field(default_factory=list)
+    process_rules: list[ProcessFaultRule] = field(default_factory=list)
 
     def validate(self) -> None:
         for r in self.rules:
             r.validate()
+        for p in self.process_rules:
+            p.validate()
 
     # -- (de)serialization -------------------------------------------------
 
@@ -128,6 +177,9 @@ class FaultPlan:
         plan = FaultPlan(
             seed=int(d.get("seed", 0)),
             rules=[FaultRule(**r) for r in d.get("rules", [])],
+            process_rules=[
+                ProcessFaultRule(**r) for r in d.get("process_rules", [])
+            ],
         )
         plan.validate()
         return plan
@@ -139,10 +191,10 @@ class FaultPlan:
     def to_json(self) -> str:
         from dataclasses import asdict
 
-        return json.dumps(
-            {"seed": self.seed, "rules": [asdict(r) for r in self.rules]},
-            sort_keys=True,
-        )
+        doc = {"seed": self.seed, "rules": [asdict(r) for r in self.rules]}
+        if self.process_rules:
+            doc["process_rules"] = [asdict(r) for r in self.process_rules]
+        return json.dumps(doc, sort_keys=True)
 
     # -- the pure decision function ---------------------------------------
 
@@ -170,6 +222,31 @@ class FaultPlan:
                 if _splitmix_unit(self.seed, i, site, n) < r.rate:
                     action.latency += r.latency
         return action
+
+    def process_events(self, wave: int) -> list[ProcessEvent]:
+        """Process faults that fire on fault wave `wave` — pure, like
+        `decide()`, so a soak's whole process-fault schedule can be previewed
+        without a harness. The splitmix coin keys on a "process/" site string,
+        which no boundary rule can produce, so process and boundary streams
+        never correlate even under the same seed."""
+        fired = []
+        for i, r in enumerate(self.process_rules):
+            if r.wave != -1 and r.wave != wave:
+                continue
+            site = f"process/{r.kind}/{r.target}"
+            if r.rate >= 1.0 or _splitmix_unit(self.seed, i, site, wave) < r.rate:
+                fired.append(ProcessEvent(kind=r.kind, target=r.target, wave=wave))
+        return fired
+
+    def process_schedule(self, n_waves: int) -> bytes:
+        """All process-fault firings over the first `n_waves` waves,
+        serialized — the byte-identical-replay witness for the process
+        vocabulary (mirrors `schedule()` for boundary rules)."""
+        out = []
+        for w in range(n_waves):
+            for e in self.process_events(w):
+                out.append(f"{w}:{e.kind}:{e.target}")
+        return "\n".join(out).encode()
 
     def has_boundary(self, boundary: str) -> bool:
         """True when any rule can fire at `boundary` — call sites that

@@ -125,6 +125,27 @@ class ShardedDaemon(SchedulerDaemon):
 
     # -- handoff -----------------------------------------------------------
 
+    def _list_bindings_retried(self):
+        """The resize/relist sweep over the wire: a map-resize or leader
+        takeover that died on ONE transient store error would strand its
+        slice of the keyspace un-readmitted (the soak's shard-kill wave
+        hits exactly this against a faulted apiserver). Transport errors
+        retry under full jitter; anything else is terminal and escapes
+        immediately."""
+        from ...faults.policy import RetryPolicy
+
+        def transient(e: Exception) -> bool:
+            from ...server.remote import RemoteError
+            from ...store.store import ConflictError, NotFoundError
+
+            return isinstance(e, RemoteError) and not isinstance(
+                e, (ConflictError, NotFoundError))
+
+        policy = RetryPolicy(base_delay=0.1, max_delay=2.0,
+                             max_attempts=6, deadline=20.0)
+        return policy.run(
+            lambda: self.store.list("ResourceBinding"), transient)
+
     def set_total(self, new_total: int, reason: str = "resize") -> int:
         """Resize the shard map in place. The swap is atomic; the moved
         keyspace is fenced off the losing side (epoch bump + queue forget)
@@ -142,28 +163,32 @@ class ShardedDaemon(SchedulerDaemon):
             else "absorbing"
         self.shards = new  # the gate answers with the new map from here on
         moved = 0
-        for rb in self.store.list("ResourceBinding"):
-            was = shard_of_binding(rb, old.total) == old.index
-            now = new.mine(rb)
-            if was == now:
-                continue
-            moved += 1
-            key = rb.metadata.key()
-            if was:
-                # losing: fence any in-flight decision (epoch bump) and
-                # drop the queue's per-key bookkeeping; the gaining shard
-                # owns the key's future
-                self._owned.pop(key, None)
-                if self.admission.enabled:
-                    self.admission.invalidate(key)
-                self.controller.queue.forget(key)
-            else:
-                # gaining: level-triggered re-admission through the
-                # ordinary event path (notes the epoch, enqueues)
-                self._on_binding(MODIFIED, rb)
+        try:
+            for rb in self._list_bindings_retried():
+                was = shard_of_binding(rb, old.total) == old.index
+                now = new.mine(rb)
+                if was == now:
+                    continue
+                moved += 1
+                key = rb.metadata.key()
+                if was:
+                    # losing: fence any in-flight decision (epoch bump) and
+                    # drop the queue's per-key bookkeeping; the gaining
+                    # shard owns the key's future
+                    self._owned.pop(key, None)
+                    if self.admission.enabled:
+                        self.admission.invalidate(key)
+                    self.controller.queue.forget(key)
+                else:
+                    # gaining: level-triggered re-admission through the
+                    # ordinary event path (notes the epoch, enqueues)
+                    self._on_binding(MODIFIED, rb)
+        finally:
+            # even a retry-exhausted sweep must not leave the daemon
+            # claiming a handoff is still in flight
+            self._handoff_state = ""
         if moved:
             shard_handoffs.inc(float(moved), reason=reason)
-        self._handoff_state = ""
         return moved
 
     def relist(self) -> int:
@@ -172,7 +197,7 @@ class ShardedDaemon(SchedulerDaemon):
         patches the fence bounced) re-places under this leader. Counted
         as a takeover handoff."""
         n = 0
-        for rb in self.store.list("ResourceBinding"):
+        for rb in self._list_bindings_retried():
             if rb.metadata.deletion_timestamp is None and self._owns(rb):
                 self._on_binding(MODIFIED, rb)
                 n += 1
@@ -281,9 +306,17 @@ class _ShardStack:
         if plane.elect:
             from ...coordination.elector import LocalLeaseClient
 
-            coordinator = LeaseCoordinator(plane.store, clock=plane.clock)
+            if hasattr(plane.store, "acquire_lease"):
+                # the store already speaks the lease-client protocol
+                # (RemoteStore in the daemon deployment shape): elections go
+                # through the apiserver's lease routes, same as sched
+                # __main__ — NOT raw object CAS against a remote store
+                lease_client = plane.store
+            else:
+                coordinator = LeaseCoordinator(plane.store, clock=plane.clock)
+                lease_client = LocalLeaseClient(coordinator)
             self.elector = Elector(
-                LocalLeaseClient(coordinator),
+                lease_client,
                 shard_lease_name(index),
                 f"{plane.identity}-s{index}",
                 lease_duration=plane.lease_duration,

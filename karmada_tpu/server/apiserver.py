@@ -167,6 +167,12 @@ class ControlPlaneServer:
         self._repl = replication          # leader role (ships the log)
         self._follower = None             # follower role (lazily created)
         self._follower_mode = follower    # reject writes from boot
+        # chaos valve (soak harness): while True, EVERY request — including
+        # replication appends — answers 503, simulating a network partition
+        # of this process without tearing down its sockets. Healing is just
+        # flipping it back; a follower partitioned past the leader's log
+        # ring then exercises the snapshot catch-up path.
+        self.partitioned = False
         self._watch_ids = itertools.count(1)
         self._httpd: Optional[ThreadingHTTPServer] = None
         self._threads: list[threading.Thread] = []
@@ -288,6 +294,12 @@ class ControlPlaneServer:
     # -- routing ----------------------------------------------------------
 
     def _route(self, h: BaseHTTPRequestHandler, method: str) -> None:
+        if self.partitioned:
+            # the valve sits before auth on purpose: a partitioned host
+            # drops everything, not just what it would have authorized
+            drain_body(h)
+            self._send(h, 503, {"error": "partitioned (chaos valve)"})
+            return
         parsed = urlparse(h.path)
         q = {k: v[0] for k, v in parse_qs(parsed.query).items()}
         if method == "GET" and parsed.path == "/metrics":

@@ -22,8 +22,16 @@ from urllib.parse import quote, urlencode, urlparse
 from urllib.request import Request, urlopen
 
 from ..api.unstructured import Unstructured
+from ..faults.policy import RetryPolicy
 from ..store.store import BatchError, BatchOpResult, ConflictError, NotFoundError, gvk_of
 from . import codec
+
+# Write-retry backoff after a possible failover window: full-jitter with a
+# cap, so N clients retrying into a promotion don't form a synchronized
+# thundering herd (docs/ROBUSTNESS.md backoff audit). Attempts/deadline are
+# enforced by the call sites' own loops, not by `run()`.
+WRITE_RETRY = RetryPolicy(base_delay=0.2, max_delay=2.0, multiplier=2.0)
+BATCH_RETRY = RetryPolicy(base_delay=0.1, max_delay=1.0, multiplier=2.0)
 
 
 class RemoteError(RuntimeError):
@@ -299,7 +307,7 @@ class RemoteStore:
                         raise  # not a redirect problem: surface as before
                     ambiguous = e
                     self._set_base(origin)
-                    time.sleep(0.2 * (attempt + 1))
+                    time.sleep(WRITE_RETRY.delay(attempt))
             raise ambiguous or RemoteError(
                 "write: leader redirects exhausted")
         finally:
@@ -515,7 +523,7 @@ class RemoteStore:
                     self._set_base(origin)
                 if attempt == 3:
                     raise
-                time.sleep(0.1 * (attempt + 1))
+                time.sleep(BATCH_RETRY.delay(attempt))
         raise RemoteError("batch write: retries exhausted")  # unreachable
 
     def _batch_fallback(self, op: str, objs: list, check_rv: bool,

@@ -1,0 +1,35 @@
+"""Fleet-scale chaos soak (docs/ROBUSTNESS.md "Fleet soak").
+
+Composes the FULL daemon topology — replicated server group (leader +
+quorum followers), sharded streaming scheduler plane over HTTP, pull
+agents + estimators per member, elasticity daemon, descheduler, and the
+detector/binding/status controllers — then replays a seeded multi-tenant
+traffic program while a `FaultPlan` injects chaos on all three process
+boundaries plus whole-process faults (leader kill with seal-and-promote,
+shard kill with map-resize handoff, follower partition past the log ring,
+estimator blackouts). A continuous invariant checker holds the composed
+system to the contracts no unit test composes: zero lost quorum-acked
+writes, exactly-once admission per (uid, epoch), no partial gang at any
+sampled rv, bounded-window convergence after every wave, and bounded
+threads/queues across waves.
+"""
+from .harness import SoakHarness, SoakProfile, run_soak, verdict_schema_ok
+from .invariants import (
+    AdmissionLedger,
+    GangIntegrity,
+    ResourceBounds,
+    WriteLedger,
+)
+from .topology import SoakTopology
+
+__all__ = [
+    "AdmissionLedger",
+    "GangIntegrity",
+    "ResourceBounds",
+    "SoakHarness",
+    "SoakProfile",
+    "SoakTopology",
+    "WriteLedger",
+    "run_soak",
+    "verdict_schema_ok",
+]

@@ -1,0 +1,302 @@
+"""Phase-scripted soak runner: seeded traffic x seeded chaos x invariants.
+
+One run = bootstrap the full topology, converge a base tenant mix, then
+N fault waves. Each wave: install that wave's seeded boundary `FaultPlan`
+(http/grpc/apply chaos), drive half the traffic slice, fire the wave's
+PROCESS faults (from `FaultPlan.process_events` — leader kill, shard
+kill, follower partition, estimator blackout), drive the other half,
+heal everything, and hold the system to the invariant catalog inside a
+bounded settle window. The run executes under `KARMADA_TPU_LOCKCHECK=1`
+and ends with a structured verdict embedding `tracing.slo_report()` —
+the JSON line the `soak` bench config emits beside the other BENCH
+results (docs/ROBUSTNESS.md "Fleet soak").
+"""
+from __future__ import annotations
+
+import logging
+import os
+import time
+from dataclasses import dataclass
+
+from .. import faults
+from ..faults.plan import FaultPlan, FaultRule, ProcessFaultRule
+from .invariants import (
+    AdmissionLedger,
+    GangIntegrity,
+    ResourceBounds,
+    WriteLedger,
+    wait_converged,
+)
+from .topology import SoakTopology
+from .traffic import NAMESPACE, TrafficProgram
+
+log = logging.getLogger(__name__)
+
+VERDICT_SCHEMA = "karmada-tpu/soak-verdict/v1"
+
+# the four-wave fault rotation; longer profiles cycle it
+WAVE_PATTERN = ("estimator_blackout", "shard_kill", "leader_kill",
+                "partition")
+
+
+@dataclass
+class SoakProfile:
+    seed: int = 7
+    members: int = 4
+    followers: int = 2
+    shards: int = 2
+    apps: int = 10
+    waves: int = 4
+    settle_window_s: float = 60.0
+    soak_minutes: float = 0.0  # > 0: long profile, waves scaled to fill
+    estimator_capacity: int = 50
+
+    def effective_waves(self) -> int:
+        if self.soak_minutes > 0:
+            # ~30 s of traffic+converge per wave is the observed short-
+            # profile cadence; the long profile fills the requested time
+            return max(self.waves, int(self.soak_minutes * 60 / 30))
+        return self.waves
+
+
+def default_plan(profile: SoakProfile) -> FaultPlan:
+    """The soak's process-fault schedule: one pinned process fault per
+    wave, rotating leader kill / shard kill / partition / estimator
+    blackout — every class fires within any 4 consecutive waves."""
+    rules = [
+        ProcessFaultRule(kind=WAVE_PATTERN[w % len(WAVE_PATTERN)],
+                         target="*", wave=w)
+        for w in range(profile.effective_waves())
+    ]
+    return FaultPlan(seed=profile.seed, process_rules=rules)
+
+
+def wave_boundary_plan(profile: SoakProfile, wave: int) -> FaultPlan:
+    """Fresh per-wave boundary chaos (installed at wave start, reset at
+    heal): moderate error rates on all three boundaries plus a small
+    latency tax on http — enough to force every retry path without
+    starving the bounded-retry traffic funnel."""
+    return FaultPlan(
+        seed=profile.seed * 1009 + wave,
+        rules=[
+            FaultRule(boundary="http", kind="error", rate=0.08),
+            FaultRule(boundary="http", kind="latency", rate=0.2,
+                      latency=0.005),
+            FaultRule(boundary="grpc", kind="error", rate=0.10),
+            FaultRule(boundary="apply", kind="error", rate=0.08),
+        ],
+    )
+
+
+class SoakHarness:
+    def __init__(self, profile: SoakProfile | None = None):
+        self.profile = profile or SoakProfile()
+
+    # -- process-fault execution -------------------------------------------
+
+    def _fire(self, topo: SoakTopology, event, admission: AdmissionLedger,
+              gang: GangIntegrity) -> dict:
+        rec = {"kind": event.kind, "target": event.target,
+               "wave": event.wave}
+        if event.kind == "leader_kill":
+            rec["promoted"] = topo.kill_leader()
+            # the invariant watchers follow the promotion, like every
+            # other consumer of the (replicated) store
+            admission.attach(topo.store)
+            gang.attach(topo.store)
+        elif event.kind == "shard_kill":
+            rec["moved"] = topo.kill_shard()
+        elif event.kind == "partition":
+            rec["follower"] = topo.partition_follower(
+                event.wave % max(1, len(topo.followers))).url
+        elif event.kind == "estimator_blackout":
+            topo.set_estimator_blackout(True)
+        return rec
+
+    def _heal(self, topo: SoakTopology, traffic: TrafficProgram) -> None:
+        faults.reset()
+        topo.heal_partitions()
+        topo.set_estimator_blackout(False)
+        topo.restore_shards()
+        traffic.heal()
+
+    # -- traffic slices -----------------------------------------------------
+
+    def _slice_a(self, traffic: TrafficProgram, wave: int) -> None:
+        traffic.diurnal_demand(wave)
+        traffic.churn(n=4)
+        traffic.surge(wave, n=3)
+
+    def _slice_b(self, traffic: TrafficProgram, wave: int) -> None:
+        traffic.gang_cohort(wave, size=3)
+        traffic.churn(n=3)
+        if wave % 2 == 0:
+            traffic.preemptor_wave(wave, n=2)
+        else:
+            traffic.flap_cluster()
+        if wave > 0:
+            traffic.retire_wave_apps(wave - 1)
+
+    # -- the run ------------------------------------------------------------
+
+    def run(self) -> dict:
+        p = self.profile
+        os.environ["KARMADA_TPU_LOCKCHECK"] = "1"
+        faults.reset()
+        plan = default_plan(p)
+        t_start = time.monotonic()
+
+        topo = SoakTopology(
+            n_members=p.members, n_followers=p.followers,
+            n_shards=p.shards, estimator_capacity=p.estimator_capacity,
+        )
+        write_ledger = WriteLedger()
+        admission = AdmissionLedger()
+        gang = GangIntegrity()
+        admission.attach(topo.store)
+        gang.attach(topo.store)
+        bounds = ResourceBounds()
+
+        waves: list[dict] = []
+        convergence_failures: list[str] = []
+        resource_violations: list[str] = []
+        replication_failures: list[str] = []
+        try:
+            traffic = TrafficProgram(topo.client(), topo, write_ledger,
+                                     seed=p.seed, apps=p.apps)
+            traffic.bootstrap()
+            base = wait_converged(topo.store, namespaces={NAMESPACE},
+                                  timeout=p.settle_window_s)
+            if base:
+                convergence_failures.extend(
+                    f"bootstrap: {s}" for s in base)
+            bounds.rebase()
+
+            for w in range(p.effective_waves()):
+                t0 = time.monotonic()
+                faults.install(wave_boundary_plan(p, w))
+                fired = []
+                self._slice_a(traffic, w)
+                for ev in plan.process_events(w):
+                    fired.append(self._fire(topo, ev, admission, gang))
+                self._slice_b(traffic, w)
+                self._heal(topo, traffic)
+                # a promotion retires one plane stack and starts another:
+                # let the thread ceiling follow the NEW baseline, leaks
+                # still show as upward drift within later waves
+                if any(f["kind"] == "leader_kill" for f in fired):
+                    bounds.rebase()
+                stragglers = wait_converged(
+                    topo.store, namespaces={NAMESPACE},
+                    timeout=p.settle_window_s)
+                convergence_failures.extend(
+                    f"wave {w}: {s}" for s in stragglers)
+                replication_failures.extend(
+                    f"wave {w}: {s}"
+                    for s in topo.verify_partition_catchup())
+                topo.shards.quiesce(timeout=20.0)
+                topo.plane.quiesce(timeout=20.0)
+                resource_violations.extend(
+                    bounds.sample(w, topo.plane.queue_depth()))
+                waves.append({
+                    "wave": w,
+                    "process_events": fired,
+                    "write_failures": traffic.write_failures,
+                    "converged": not stragglers,
+                    "stragglers": stragglers[:8],
+                    "duration_s": round(time.monotonic() - t0, 3),
+                })
+        finally:
+            faults.reset()
+            try:
+                topo.close()
+            except Exception:  # noqa: BLE001 - verdict over teardown
+                log.exception("soak teardown")
+
+        lost = write_ledger.check(topo.store)
+        doubles = admission.doubles()
+        partial = gang.check()
+
+        from ..analysis import lockorder
+
+        lock_ok, lock_edges, lock_err = True, 0, ""
+        if lockorder.enabled():
+            lock_edges = len(lockorder.watchdog.edge_list())
+            try:
+                lockorder.watchdog.assert_acyclic()
+            except Exception as e:  # noqa: BLE001 - report, don't crash
+                lock_ok, lock_err = False, str(e)
+
+        from ..tracing import slo_report
+
+        verdict = {
+            "schema": VERDICT_SCHEMA,
+            "config": {
+                "seed": p.seed, "members": p.members,
+                "followers": p.followers, "shards": p.shards,
+                "apps": p.apps, "waves": p.effective_waves(),
+                "settle_window_s": p.settle_window_s,
+                "soak_minutes": p.soak_minutes,
+            },
+            "duration_s": round(time.monotonic() - t_start, 3),
+            "waves": waves,
+            "invariants": {
+                "lost_writes": lost,
+                "double_admissions": doubles,
+                "partial_gangs": partial,
+                "convergence_failures": convergence_failures,
+                "resource_violations": resource_violations,
+                "replication_failures": replication_failures,
+                "plane_errors": topo.plane.errors[:16],
+            },
+            "resource_samples": bounds.samples,
+            "lock_edges": lock_edges,
+            "lock_order_error": lock_err,
+            "pass_lost_writes": not lost,
+            "pass_exactly_once": not doubles,
+            "pass_gang_integrity": not partial,
+            "pass_convergence": not convergence_failures,
+            "pass_resources": not resource_violations,
+            "pass_replication": not replication_failures,
+            "pass_lock_order": lock_ok,
+            "slo": slo_report(),
+        }
+        verdict["pass"] = all(
+            verdict[k] for k in verdict if k.startswith("pass_"))
+        return verdict
+
+
+def run_soak(profile: SoakProfile | None = None) -> dict:
+    return SoakHarness(profile).run()
+
+
+def verdict_schema_ok(verdict: dict) -> bool:
+    """Structural validation of a soak verdict (the bench line embeds it;
+    emission refuses to publish a malformed one)."""
+    try:
+        if verdict["schema"] != VERDICT_SCHEMA:
+            return False
+        for k in ("pass", "pass_lost_writes", "pass_exactly_once",
+                  "pass_gang_integrity", "pass_convergence",
+                  "pass_resources", "pass_replication",
+                  "pass_lock_order"):
+            if not isinstance(verdict[k], bool):
+                return False
+        if not isinstance(verdict["waves"], list) or not verdict["waves"]:
+            return False
+        for w in verdict["waves"]:
+            if not {"wave", "process_events", "converged",
+                    "duration_s"} <= set(w):
+                return False
+        inv = verdict["invariants"]
+        for k in ("lost_writes", "double_admissions", "partial_gangs",
+                  "convergence_failures", "resource_violations",
+                  "replication_failures"):
+            if not isinstance(inv[k], list):
+                return False
+        slo = verdict["slo"]
+        if not isinstance(slo, dict) or "stages" not in slo:
+            return False
+        return isinstance(verdict["config"]["waves"], int)
+    except (KeyError, TypeError):
+        return False

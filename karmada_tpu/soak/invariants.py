@@ -1,0 +1,266 @@
+"""Continuous system-level invariants the soak holds the composed
+topology to. Each checker is a small standalone object so the violation
+fixtures in tests/test_soak.py can plant a lost write / a partial gang /
+a double admission against a bare store and prove the checker FIRES —
+an invariant checker that cannot fail is not checking anything.
+
+Catalog (docs/ROBUSTNESS.md "Fleet soak"):
+
+  WriteLedger      zero lost quorum-acked writes: every write the traffic
+                   driver saw acked at rv R is present (at >= R) on the
+                   current leader, across any number of failovers
+  AdmissionLedger  exactly-once admission per (uid, epoch): at most one
+                   empty->placed commit per (binding uid, observed
+                   scheduler generation) across shard handoffs/resizes
+  GangIntegrity    no partial gang at any sampled rv: placements of one
+                   gang land as ONE transactional batch, so at every
+                   batch boundary each gang's live bindings are all
+                   placed or all unplaced
+  ResourceBounds   no leak across waves: thread count and controller
+                   queue depths return below a fixed ceiling after every
+                   wave's heal
+"""
+from __future__ import annotations
+
+import threading
+from typing import Any, Optional
+
+
+class WriteLedger:
+    """Traffic-side record of quorum-acked writes, checked against the
+    (possibly promoted) leader store. Deletion is recorded too — a key
+    the driver deleted is allowed to be gone; any other recorded key
+    must exist at an rv >= its acked rv."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._acked: dict[tuple[str, str, str], int] = {}
+        self._deleted: set[tuple[str, str, str]] = set()
+
+    @staticmethod
+    def _key(obj) -> tuple[str, str, str]:
+        from ..store.store import gvk_of
+
+        return (gvk_of(obj), obj.metadata.name,
+                obj.metadata.namespace or "")
+
+    def record_ack(self, obj) -> None:
+        """Call with the object a (quorum-mode) write RETURNED — its
+        resource_version is the acked rv."""
+        key = self._key(obj)
+        rv = int(obj.metadata.resource_version)
+        with self._lock:
+            self._deleted.discard(key)
+            if rv >= self._acked.get(key, 0):
+                self._acked[key] = rv
+
+    def record_delete(self, kind: str, name: str, namespace: str = "") -> None:
+        with self._lock:
+            key = (kind, name, namespace or "")
+            self._acked.pop(key, None)
+            self._deleted.add(key)
+
+    def check(self, store) -> list[str]:
+        """Violations on `store` (the current leader): acked writes that
+        vanished or rolled back. Keys the plane itself legitimately
+        rewrites later (status flows, elasticity scaling) still satisfy
+        rv >= acked — rvs are monotonic and rewrites only advance them."""
+        with self._lock:
+            acked = dict(self._acked)
+        out = []
+        for (kind, name, ns), rv in acked.items():
+            cur = store.try_get(kind, name, ns)
+            if cur is None:
+                out.append(f"lost acked write: {kind} {ns}/{name} "
+                           f"(acked rv {rv}) is gone")
+            elif int(cur.metadata.resource_version) < rv:
+                out.append(
+                    f"rolled-back write: {kind} {ns}/{name} at rv "
+                    f"{cur.metadata.resource_version} < acked rv {rv}")
+        return out
+
+
+class AdmissionLedger:
+    """Watch-side exactly-once ledger, failover-aware.
+
+    Counts empty->placed commits per (binding uid, scheduler observed
+    generation) — the admission epoch the shard stamps at placement
+    commit. A re-schedule after eviction/preemption bumps the template
+    generation, so its commit lands under a NEW epoch; a second commit
+    under the SAME epoch is exactly the double-solve the shard handoff
+    fence must make impossible. Survives failovers: `attach()` to the
+    promoted store replays current state, and the retained `_placed` map
+    keeps replayed already-placed bindings from recounting."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._placed: dict[str, bool] = {}
+        self._commits: dict[tuple[str, int], int] = {}
+        self._store = None
+
+    def attach(self, store) -> None:
+        with self._lock:
+            if self._store is not None:
+                try:
+                    self._store.unwatch("ResourceBinding", self._on_event)
+                except Exception:  # noqa: BLE001 - old store may be dead
+                    pass
+            self._store = store
+        store.watch("ResourceBinding", self._on_event, replay=True)
+
+    def _on_event(self, event, rb) -> None:
+        uid = rb.metadata.uid
+        placed = bool(rb.spec.clusters)
+        epoch = int(rb.status.scheduler_observed_generation or 0)
+        with self._lock:
+            if event == "DELETED":
+                self._placed.pop(uid, None)
+                return
+            if placed and not self._placed.get(uid, False):
+                k = (uid, epoch)
+                self._commits[k] = self._commits.get(k, 0) + 1
+            self._placed[uid] = placed
+
+    def doubles(self) -> list[str]:
+        with self._lock:
+            return [
+                f"double admission: uid {uid} epoch {epoch} committed "
+                f"empty->placed {n} times"
+                for (uid, epoch), n in self._commits.items() if n > 1
+            ]
+
+
+class GangIntegrity:
+    """Batch-boundary partial-gang detector.
+
+    Subscribes to `Store.watch_all_batch` — one callback per rv-contiguous
+    commit batch, the transactional seam — and AFTER each batch asserts
+    every touched gang's live bindings are uniformly placed or uniformly
+    unplaced. A per-event watcher would false-positive mid-batch (it sees
+    1..K-1 placed inside the atomic gang commit); the batch boundary is
+    the rv at which outside observers can actually sample the store."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        # gang -> {uid: placed}
+        self._gangs: dict[str, dict[str, bool]] = {}
+        self._uid_gang: dict[str, str] = {}
+        self.violations: list[str] = []
+        self._store = None
+
+    def attach(self, store) -> None:
+        with self._lock:
+            if self._store is not None:
+                try:
+                    self._store.unwatch_all_batch(self._on_batch)
+                except Exception:  # noqa: BLE001 - old store may be dead
+                    pass
+            self._store = store
+            self._gangs.clear()
+            self._uid_gang.clear()
+        store.watch_all_batch(self._on_batch)
+        # seed from current state (subscription precedes the snapshot, so
+        # a concurrent batch lands in _on_batch either way; merging by uid
+        # makes the overlap idempotent)
+        seed = [("ResourceBinding", "ADDED", rb)
+                for rb in store.list("ResourceBinding")]
+        if seed:
+            self._on_batch(seed)
+
+    def _apply(self, kind: str, event: str, obj: Any) -> set[str]:
+        if kind != "ResourceBinding":
+            return set()
+        gname = getattr(obj.spec, "gang_name", "") or ""
+        uid = obj.metadata.uid
+        touched = set()
+        if event == "DELETED" or not gname:
+            old = self._uid_gang.pop(uid, None)
+            if old is not None:
+                self._gangs.get(old, {}).pop(uid, None)
+                touched.add(old)
+            return touched
+        self._uid_gang[uid] = gname
+        self._gangs.setdefault(gname, {})[uid] = bool(obj.spec.clusters)
+        touched.add(gname)
+        return touched
+
+    def _on_batch(self, events: list[tuple[str, str, Any]]) -> None:
+        with self._lock:
+            touched: set[str] = set()
+            for kind, event, obj in events:
+                touched |= self._apply(kind, event, obj)
+            for g in touched:
+                states = list(self._gangs.get(g, {}).values())
+                if states and any(states) and not all(states):
+                    self.violations.append(
+                        f"partial gang {g!r}: {sum(states)}/{len(states)} "
+                        f"members placed at a batch boundary")
+
+    def check(self) -> list[str]:
+        with self._lock:
+            return list(self.violations)
+
+
+class ResourceBounds:
+    """Leak detector across waves: threads and queue depths must return
+    under `baseline + headroom` after every heal. A promotion legitimately
+    retires one stack and starts another, so the ceiling is rebased (only
+    DOWNWARD drift is ever forgiven automatically)."""
+
+    def __init__(self, headroom_threads: int = 24,
+                 max_queue_depth: int = 512) -> None:
+        self.headroom = headroom_threads
+        self.max_queue = max_queue_depth
+        self.baseline: Optional[int] = None
+        self.samples: list[dict] = []
+
+    def rebase(self) -> None:
+        self.baseline = threading.active_count()
+
+    def sample(self, wave: int, queue_depth: int) -> list[str]:
+        threads = threading.active_count()
+        if self.baseline is None:
+            self.baseline = threads
+        self.samples.append(
+            {"wave": wave, "threads": threads, "queue_depth": queue_depth})
+        out = []
+        if threads > self.baseline + self.headroom:
+            out.append(
+                f"thread leak after wave {wave}: {threads} alive "
+                f"(baseline {self.baseline} + headroom {self.headroom})")
+        if queue_depth > self.max_queue:
+            out.append(
+                f"queue leak after wave {wave}: depth {queue_depth} "
+                f"> {self.max_queue}")
+        return out
+
+
+def wait_converged(store, *, namespaces: set[str],
+                   timeout: float, interval: float = 0.1) -> list[str]:
+    """Bounded-window convergence after a heal: every ResourceBinding in
+    the traffic namespaces is placed AND solved at its current template
+    generation. Returns [] on convergence, else one line per straggler
+    at the deadline."""
+    import time as _t
+
+    def stragglers() -> list[str]:
+        out = []
+        for rb in store.list("ResourceBinding"):
+            if (rb.metadata.namespace or "") not in namespaces:
+                continue
+            sog = int(rb.status.scheduler_observed_generation or 0)
+            gen = int(rb.metadata.generation or 0)
+            if not rb.spec.clusters:
+                out.append(f"unplaced: {rb.metadata.key()}")
+            elif sog < gen:
+                out.append(
+                    f"stale solve: {rb.metadata.key()} observed {sog} < "
+                    f"generation {gen}")
+        return out
+
+    deadline = _t.monotonic() + timeout
+    while _t.monotonic() < deadline:
+        if not stragglers():
+            return []
+        _t.sleep(interval)
+    return stragglers()

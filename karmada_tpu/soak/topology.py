@@ -1,0 +1,408 @@
+"""The soak's composed topology: every daemon the reference pipeline
+deploys, wired the way production wires them.
+
+Three tiers, real HTTP between them:
+
+  server group   leader `ReplicaControlPlane` + `ControlPlaneServer`
+                 shipping a quorum append stream to N follower servers
+                 (store/replication.py, docs/HA.md)
+  plane stack    the controllers that live in the leader process:
+                 detector, binding controller, pull agents, work/binding
+                 status controllers, elasticity daemon, descheduler, and
+                 the trace collector — driven by a settle thread against
+                 the CURRENT leader's in-process store (rebuilt wholesale
+                 on promotion, exactly like a standby operator taking over)
+  scheduler      a `ShardPlane` of N elected shard leaders over a
+                 `RemoteStore` pointed at the server group — the daemon
+                 deployment shape (sched/__main__.py), so scheduler
+                 traffic crosses the http boundary and failovers exercise
+                 the leader-redirect convergence path
+
+Process faults operate on this object: `kill_leader()` seal-and-promotes
+the max-applied follower and spawns a fresh (snapshot-bootstrapped)
+replacement, `kill_shard()`/`restore_shards()` drive map-resize handoff,
+`partition_follower()` flips the apiserver's chaos valve, and
+`set_estimator_blackout()` darkens every member estimator leg at once.
+"""
+from __future__ import annotations
+
+import logging
+import threading
+import time
+
+from ..agent.agent import KarmadaAgent
+from ..api.meta import CPU, MEMORY
+from ..controllers.binding import BindingController
+from ..controllers.status import BindingStatusController, WorkStatusController
+from ..detector.detector import ResourceDetector
+from ..elastic.aggregator import build_metrics_report, publish_report
+from ..elastic.daemon import ElasticityDaemon
+from ..descheduler.descheduler import Descheduler
+from ..estimator.client import EstimatorRegistry
+from ..faults.policy import BreakerRegistry
+from ..interpreter.interpreter import ResourceInterpreter
+from ..members.member import InMemoryMember, MemberConfig, cluster_object_for
+from ..runtime.controller import Clock, Runtime
+from ..sched.shards.daemon import ShardPlane
+from ..server.apiserver import ControlPlaneServer
+from ..server.remote import RemoteStore
+from ..store.replication import (
+    REPLICATION_LEASE,
+    ReplicaControlPlane,
+    ReplicationManager,
+    seal_and_promote,
+)
+from ..tracing import TraceCollector
+
+log = logging.getLogger(__name__)
+
+GiB = 1024.0**3
+
+# small ring on purpose: a follower partitioned for one traffic slice lags
+# past it and must catch up via the snapshot path, not the append stream
+SOAK_LOG_ENTRIES = 8
+
+
+def _state_dump(store) -> list[str]:
+    from ..server import codec
+    import json
+
+    return sorted(
+        json.dumps(codec.encode(o), sort_keys=True)
+        for kind in store.kinds() for o in store.list(kind)
+    )
+
+
+def wait_until(pred, timeout: float = 30.0, interval: float = 0.02) -> bool:
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred():
+            return True
+        time.sleep(interval)
+    return bool(pred())
+
+
+class SoakEstimator:
+    """One estimator leg per member cluster, with a blackout valve.
+
+    Answers a flat per-cluster availability (capacity generosity keeps the
+    soak's convergence contract about CORRECTNESS, not scarcity), runs the
+    gRPC-boundary chaos hook per leg, and feeds the shared breaker exactly
+    like the wire client — so an installed FaultPlan or a blackout opens
+    breakers and pushes the registry into degraded (staleness) mode."""
+
+    SENTINEL = -1
+
+    def __init__(self, blackout: threading.Event, breakers: BreakerRegistry,
+                 capacity: int = 50):
+        self.blackout = blackout
+        self.breakers = breakers
+        self.capacity = capacity
+
+    def max_available_replicas(self, clusters, requirements, replicas):
+        from .. import faults
+
+        out = []
+        for c in clusters:
+            br = self.breakers.for_member(c)
+            if not br.allow():
+                out.append(self.SENTINEL)
+                continue
+            try:
+                faults.check(faults.BOUNDARY_GRPC, c)
+                if self.blackout.is_set():
+                    raise RuntimeError("estimator blackout")
+            except Exception:  # noqa: BLE001 - every leg failure is a trip
+                br.record_failure()
+                out.append(self.SENTINEL)
+                continue
+            br.record_success()
+            out.append(self.capacity)
+        return out
+
+
+class _PlaneStack:
+    """The leader-process controller set over one in-process store, driven
+    to fixpoint by a settle thread. Discarded and rebuilt on promotion —
+    controller state is all derivable from the (replicated) store."""
+
+    def __init__(self, store, members: dict[str, InMemoryMember],
+                 clock: Clock, registry: EstimatorRegistry):
+        self.store = store
+        self.members = members
+        self.clock = clock
+        self.collector = TraceCollector(store)
+        self.collector.attach()
+        self.rt = Runtime(clock=clock)
+        self.interp = ResourceInterpreter()
+        self.interp.load_thirdparty()
+        ResourceDetector(store, self.interp, self.rt)
+        BindingController(store, self.interp, self.rt)
+        self.agents = [
+            KarmadaAgent(store, m, self.interp, self.rt)
+            for m in members.values()
+        ]
+        self.ws = WorkStatusController(store, members, self.interp, self.rt)
+        for m in members.values():
+            self.ws.watch_member(m)
+        BindingStatusController(store, self.interp, self.rt)
+        self.elastic = ElasticityDaemon(
+            store, clock, interpreter=self.interp,
+            hysteresis=False, preflight=False,
+        )
+        self.desched = Descheduler(store, registry, clock=clock,
+                                   interval=0.5)
+        self.errors: list[str] = []
+        self._stop = threading.Event()
+        self._last_collect = 0.0
+        self._thread = threading.Thread(
+            target=self._run, name="soak-plane-settle", daemon=True
+        )
+        self._thread.start()
+
+    def _run(self) -> None:
+        while not self._stop.is_set():
+            try:
+                self.rt.settle()
+                now = self.clock.now()
+                if now - self._last_collect >= 0.2:
+                    self._last_collect = now
+                    for m in self.members.values():
+                        publish_report(self.store,
+                                       build_metrics_report(m, now))
+                    self.elastic.step(now)
+                    self.desched.tick()
+            except Exception as e:  # noqa: BLE001 - soak counts, not dies
+                log.exception("plane settle error")
+                self.errors.append(f"{type(e).__name__}: {e}")
+            self._stop.wait(0.05)
+
+    def quiesce(self, timeout: float = 20.0) -> bool:
+        """Wait for the runtime queues to drain (fixpoint between waves)."""
+        return wait_until(
+            lambda: all(len(c.queue) == 0 for c in self.rt.controllers),
+            timeout,
+        )
+
+    def queue_depth(self) -> int:
+        return sum(len(c.queue) for c in self.rt.controllers)
+
+    def stop(self) -> None:
+        self._stop.set()
+        self._thread.join(timeout=10.0)
+
+
+class SoakTopology:
+    def __init__(self, *, n_members: int = 4, n_followers: int = 2,
+                 n_shards: int = 2, lease_duration: float = 2.0,
+                 estimator_capacity: int = 50):
+        self.clock = Clock()
+        self.lease_duration = lease_duration
+        self.estimator_blackout = threading.Event()
+        self.estimator_capacity = estimator_capacity
+        self._promotions = 0
+        self._clients: list[RemoteStore] = []
+
+        self.members: dict[str, InMemoryMember] = {}
+        for i in range(n_members):
+            cfg = MemberConfig(
+                name=f"member-{i}", sync_mode="Pull",
+                allocatable={CPU: 64.0, MEMORY: 256 * GiB, "pods": 2000.0},
+            )
+            self.members[cfg.name] = InMemoryMember(cfg)
+
+        # -- server group -------------------------------------------------
+        self.followers: list[ControlPlaneServer] = [
+            self._new_follower() for _ in range(n_followers)
+        ]
+        self.leader_cp = ReplicaControlPlane()
+        lease, ok = self.leader_cp.coordinator.acquire(
+            REPLICATION_LEASE, "soak-leader-0", lease_duration)
+        assert ok, "fresh plane must win its own replication lease"
+        self.manager = ReplicationManager(
+            self.leader_cp.store, [f.url for f in self.followers],
+            mode="quorum", quorum=1, token=lease.spec.fencing_token,
+            identity="soak-leader-0", max_entries=SOAK_LOG_ENTRIES,
+        )
+        self.leader = ControlPlaneServer(self.leader_cp,
+                                         replication=self.manager)
+        self.leader.start()
+        self.manager.advertise_url = self.leader.url
+        assert wait_until(lambda: all(
+            p.acked_rv >= self.leader_cp.store.current_rv
+            for p in self.manager.peers))
+
+        # cluster objects exist before any controller/scheduler attaches
+        for m in self.members.values():
+            self.leader_cp.store.create(cluster_object_for(m.config))
+
+        # -- plane stack (controllers in the leader process) --------------
+        self.plane = _PlaneStack(self.leader_cp.store, self.members,
+                                 self.clock, self._registry())
+
+        # -- scheduler plane over the wire --------------------------------
+        self.sched_store = self.client(read_preference="follower")
+        self.shards = ShardPlane(
+            self.sched_store, n_shards,
+            clock=self.clock,
+            registry_factory=lambda i: self._registry(),
+            gang_wait_seconds=30.0,
+            aot_prewarm=False,
+            elect=True,
+            lease_duration=lease_duration,
+            identity="soak-sched",
+            batch_delay=0.05,
+        )
+        self.n_shards = n_shards
+        self.shards.start()
+        assert self.shards.wait_leading(30.0), "shards must elect"
+
+    # -- construction helpers ---------------------------------------------
+
+    def _new_follower(self) -> ControlPlaneServer:
+        srv = ControlPlaneServer(ReplicaControlPlane())
+        srv.start()
+        return srv
+
+    def _registry(self) -> EstimatorRegistry:
+        """A per-consumer estimator registry: shared blackout valve, own
+        breakers (a shard tripping its breakers must not blind the
+        descheduler's registry, mirroring per-process breaker state)."""
+        breakers = BreakerRegistry(failure_threshold=3, open_seconds=1.0)
+        reg = EstimatorRegistry(breakers=breakers)
+        reg.register_replica_estimator(
+            "soak",
+            SoakEstimator(self.estimator_blackout, breakers,
+                          self.estimator_capacity),
+        )
+        return reg
+
+    def client(self, read_preference: str = "leader") -> RemoteStore:
+        """A new wire client of the server group, tracked so failovers can
+        re-point it (the production analog: service discovery moving the
+        leader VIP after a promotion)."""
+        rs = RemoteStore(
+            self.leader.url, timeout=10.0,
+            replicas=[f.url for f in self.followers],
+            read_preference=read_preference,
+        )
+        self._clients.append(rs)
+        return rs
+
+    @property
+    def store(self):
+        """The CURRENT leader's in-process store."""
+        return self.leader_cp.store
+
+    # -- process faults ----------------------------------------------------
+
+    def kill_leader(self) -> str:
+        """SIGKILL-style leader loss: no clean shutdown path runs. The
+        max-applied follower is sealed and promoted (zero quorum-acked
+        writes lost — follower state is a contiguous log prefix), a fresh
+        EMPTY follower replaces it in the group (bootstrapping via the
+        snapshot path), the plane stack is rebuilt on the promoted store,
+        and every wire client is re-pointed at the new leader."""
+        self._promotions += 1
+        gen = self._promotions
+        self._partition_record = None  # the old group's peers are history
+        self.plane.stop()
+        self.manager.close()
+        self.leader.stop()
+
+        chosen = max(self.followers, key=lambda f: f.cp.store.current_rv)
+        survivors = [f for f in self.followers if f is not chosen]
+        replacement = self._new_follower()
+        peers = [f.url for f in survivors] + [replacement.url]
+        new_mgr = seal_and_promote(
+            chosen, peers, identity=f"soak-leader-{gen}",
+            lease_duration=self.lease_duration,
+            mode="quorum", quorum=1, max_entries=SOAK_LOG_ENTRIES,
+        )
+        self.leader = chosen
+        self.leader_cp = chosen.cp
+        self.manager = new_mgr
+        self.followers = survivors + [replacement]
+        self.repoint()
+        self.plane = _PlaneStack(self.leader_cp.store, self.members,
+                                 self.clock, self._registry())
+        return self.leader.url
+
+    def repoint(self) -> None:
+        for rs in self._clients:
+            rs._set_base(self.leader.url)
+            rs._replicas[:] = [f.url for f in self.followers]
+            rs._replica_cooldown.clear()
+
+    def kill_shard(self) -> int:
+        """Kill the highest shard slot: the plane shrinks by one and the
+        survivors re-map the keyspace through the admission-epoch fence."""
+        new_total = max(1, self.shards.total - 1)
+        return self.shards.resize(new_total)
+
+    def restore_shards(self) -> int:
+        return self.shards.resize(self.n_shards)
+
+    def partition_follower(self, idx: int = 0) -> ControlPlaneServer:
+        srv = self.followers[idx % len(self.followers)]
+        peer = next((p for p in self.manager.peers if p.url == srv.url),
+                    None)
+        self._partition_record = {
+            "srv": srv, "peer": peer,
+            "snapshots": peer.snapshots if peer else 0,
+        }
+        srv.partitioned = True
+        return srv
+
+    def verify_partition_catchup(self, timeout: float = 30.0) -> list[str]:
+        """Post-heal witness that the partition wave was not vacuous: the
+        healed follower must re-converge BYTE-IDENTICALLY to the leader,
+        and — because the partition outlasted the (deliberately tiny) log
+        ring — through the SNAPSHOT path, not the append stream."""
+        rec = getattr(self, "_partition_record", None)
+        if rec is None:
+            return []
+        self._partition_record = None
+        srv, peer = rec["srv"], rec["peer"]
+        errs: list[str] = []
+        tip = self.store.current_rv
+        if not wait_until(lambda: srv.cp.store.current_rv >= tip, timeout):
+            errs.append(
+                f"partitioned follower stuck at rv "
+                f"{srv.cp.store.current_rv} < leader tip {tip}")
+        if peer is not None and peer.snapshots <= rec["snapshots"]:
+            errs.append(
+                "partitioned follower caught up without the snapshot "
+                "path — the partition never outran the log ring")
+        if not wait_until(
+            lambda: _state_dump(srv.cp.store) == _state_dump(self.store),
+            timeout,
+        ):
+            errs.append("follower state diverges from leader after heal")
+        return errs
+
+    def heal_partitions(self) -> None:
+        for f in self.followers:
+            f.partitioned = False
+
+    def set_estimator_blackout(self, on: bool) -> None:
+        if on:
+            self.estimator_blackout.set()
+        else:
+            self.estimator_blackout.clear()
+
+    # -- teardown -----------------------------------------------------------
+
+    def close(self) -> None:
+        try:
+            self.shards.close()
+        except Exception:  # noqa: BLE001 - teardown best-effort
+            log.exception("shard plane close")
+        self.plane.stop()
+        try:
+            self.manager.close()
+        except Exception:  # noqa: BLE001
+            pass
+        self.leader.stop()
+        for f in self.followers:
+            f.stop()

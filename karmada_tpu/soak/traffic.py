@@ -1,0 +1,280 @@
+"""Seeded multi-tenant traffic program for the soak.
+
+Each wave replays a deterministic slice of the tenant mix over the wire
+client (so every template/policy write crosses the faulted http
+boundary): binding surges, policy/replica churn, gang cohorts, preemptor
+waves, diurnal HPA demand, and cluster flaps. All writes go through a
+bounded retry (`_must`) because the point of the soak is what the PLANE
+does under faults, not whether the driver gives up — and every write
+that returns acked is recorded in the WriteLedger the lost-write
+invariant checks against the post-failover leader.
+"""
+from __future__ import annotations
+
+import random
+from typing import Optional
+
+from ..api.autoscaling import (
+    FederatedHPA,
+    FederatedHPASpec,
+    HPABehavior,
+    ResourceMetricSource,
+    ScaleTargetRef,
+)
+from ..api.cluster import CLUSTER_CONDITION_READY
+from ..api.meta import Condition, ObjectMeta, set_condition
+from ..api.policy import (
+    DIVISION_PREFERENCE_WEIGHTED,
+    DYNAMIC_WEIGHT_AVAILABLE_REPLICAS,
+    REPLICA_SCHEDULING_DIVIDED,
+    ClusterAffinity,
+    ClusterPreferences,
+    Placement,
+    ReplicaSchedulingStrategy,
+)
+from ..api.work import GANG_NAME_LABEL, GANG_SIZE_LABEL
+from ..server.remote import RemoteError
+from ..store.store import ConflictError
+from ..testing.fixtures import (
+    duplicated_placement,
+    new_deployment,
+    new_policy,
+    selector_for,
+)
+from .invariants import WriteLedger
+
+NAMESPACE = "soak"
+
+
+def dynamic_placement() -> Placement:
+    return Placement(
+        cluster_affinity=ClusterAffinity(cluster_names=[]),
+        replica_scheduling=ReplicaSchedulingStrategy(
+            replica_scheduling_type=REPLICA_SCHEDULING_DIVIDED,
+            replica_division_preference=DIVISION_PREFERENCE_WEIGHTED,
+            weight_preference=ClusterPreferences(
+                dynamic_weight=DYNAMIC_WEIGHT_AVAILABLE_REPLICAS),
+        ),
+    )
+
+
+class TrafficProgram:
+    def __init__(self, client, topology, ledger: WriteLedger, *,
+                 seed: int = 7, apps: int = 12):
+        self.client = client
+        self.topology = topology
+        self.ledger = ledger
+        self.rng = random.Random(seed)
+        self.n_base_apps = apps
+        self.apps: list[dict] = []       # {name, dyn, replicas, churn}
+        self.gangs: list[tuple[str, int]] = []
+        self._flapped: list[str] = []
+        self.write_failures = 0
+
+    # -- the write funnel ---------------------------------------------------
+
+    def _must(self, op: str, obj, attempts: int = 8):
+        """Write through the faulted boundary until it lands (bounded).
+        A create whose earlier ambiguous attempt actually landed answers
+        409 on the replay — resolved by reading the object back, which is
+        the ack. Exhaustion raises: the driver failing to place load is a
+        harness bug, not a chaos outcome."""
+        last: Optional[Exception] = None
+        for _ in range(attempts):
+            try:
+                if op == "create":
+                    out = self.client.create(obj)
+                elif op == "apply":
+                    out = self.client.apply(obj)
+                else:
+                    raise ValueError(op)
+                self.ledger.record_ack(out)
+                return out
+            except ConflictError:
+                from ..store.store import gvk_of
+
+                cur = self.client.try_get(
+                    gvk_of(obj), obj.metadata.name,
+                    obj.metadata.namespace or "")
+                if cur is not None:
+                    self.ledger.record_ack(cur)
+                    return cur
+                last = ConflictError(f"{op} conflicted and vanished")
+            except RemoteError as e:
+                self.write_failures += 1
+                last = e
+        raise RemoteError(f"traffic {op} exhausted retries: {last}")
+
+    def _delete(self, kind: str, name: str, ns: str = NAMESPACE,
+                attempts: int = 8) -> None:
+        from ..store.store import NotFoundError
+
+        last: Optional[Exception] = None
+        for _ in range(attempts):
+            try:
+                self.client.delete(kind, name, ns)
+                self.ledger.record_delete(kind, name, ns)
+                return
+            except NotFoundError:
+                # an ambiguous earlier attempt landed — done
+                self.ledger.record_delete(kind, name, ns)
+                return
+            except RemoteError as e:
+                self.write_failures += 1
+                try:
+                    if self.client.try_get(kind, name, ns) is None:
+                        self.ledger.record_delete(kind, name, ns)
+                        return
+                except RemoteError:
+                    pass
+                last = e
+        raise RemoteError(f"traffic delete exhausted retries: {last}")
+
+    # -- app lifecycle ------------------------------------------------------
+
+    def _make_app(self, name: str, *, dyn: bool, replicas: int,
+                  churn: bool = True, priority: Optional[int] = None,
+                  preempting: bool = False) -> dict:
+        dep = new_deployment(NAMESPACE, name, replicas=replicas, cpu=0.1)
+        spec_kw = {}
+        if priority is not None:
+            spec_kw["scheduler_priority"] = priority
+        if preempting:
+            spec_kw["scheduler_preemption"] = "PreemptLowerPriority"
+        pol = new_policy(
+            NAMESPACE, f"{name}-policy", [selector_for(dep)],
+            dynamic_placement() if dyn else duplicated_placement(
+                list(self.topology.members)),
+            **spec_kw,
+        )
+        self._must("create", dep)
+        self._must("create", pol)
+        app = {"name": name, "dyn": dyn, "replicas": replicas,
+               "churn": churn}
+        self.apps.append(app)
+        return app
+
+    def bootstrap(self) -> None:
+        """The steady-state tenant mix, plus one HPA-governed app whose
+        demand the diurnal phases steer (it is excluded from churn so the
+        elasticity daemon is its only replica writer)."""
+        for i in range(self.n_base_apps):
+            self._make_app(f"app-{i:03d}", dyn=(i % 3 == 0),
+                           replicas=1 + (i % 4))
+        self.hpa_target = self._make_app("hpa-web", dyn=False, replicas=2,
+                                         churn=False)
+        self._must("create", FederatedHPA(
+            metadata=ObjectMeta(name="hpa-web", namespace=NAMESPACE),
+            spec=FederatedHPASpec(
+                scale_target_ref=ScaleTargetRef(kind="Deployment",
+                                                name="hpa-web"),
+                min_replicas=1, max_replicas=8,
+                metrics=[ResourceMetricSource(
+                    name="cpu", target_average_utilization=50)],
+                behavior=HPABehavior(
+                    scale_up_stabilization_seconds=0.0,
+                    scale_down_stabilization_seconds=0.0),
+            ),
+        ))
+
+    # -- wave phases --------------------------------------------------------
+
+    def surge(self, wave: int, n: int = 4) -> None:
+        for i in range(n):
+            self._make_app(f"wave{wave}-app-{i}", dyn=(i % 2 == 0),
+                           replicas=1 + self.rng.randrange(3))
+
+    def churn(self, n: int = 6) -> None:
+        """Replica-scale churn on a random subset: apply rewrites the
+        template, the detector bumps the binding generation, the shards
+        re-solve — the bread-and-butter reconcile loop under chaos."""
+        pool = [a for a in self.apps if a["churn"]]
+        self.rng.shuffle(pool)
+        for app in pool[:n]:
+            app["replicas"] = 1 + self.rng.randrange(5)
+            self._must("apply", new_deployment(
+                NAMESPACE, app["name"], replicas=app["replicas"], cpu=0.1))
+
+    def gang_cohort(self, wave: int, size: int = 3) -> str:
+        """One gang of `size` templates (gang labels flow template ->
+        binding through the detector); the scheduler must admit the
+        cohort all-or-nothing in ONE cross-shard batch."""
+        gname = f"gang-w{wave}"
+        deps = [
+            new_deployment(
+                NAMESPACE, f"{gname}-m{j}", replicas=2, cpu=0.1,
+                labels={GANG_NAME_LABEL: gname,
+                        GANG_SIZE_LABEL: str(size)},
+            )
+            for j in range(size)
+        ]
+        pol = new_policy(
+            NAMESPACE, f"{gname}-policy",
+            [selector_for(d) for d in deps],
+            duplicated_placement(list(self.topology.members)),
+        )
+        self._must("create", pol)
+        for d in deps:
+            self._must("create", d)
+        self.gangs.append((gname, size))
+        return gname
+
+    def preemptor_wave(self, wave: int, n: int = 2) -> None:
+        for i in range(n):
+            self._make_app(f"wave{wave}-pre-{i}", dyn=True, replicas=2,
+                           churn=False, priority=10 + wave,
+                           preempting=True)
+
+    def diurnal_demand(self, wave: int) -> None:
+        """Even waves are daytime (high per-pod usage -> scale up), odd
+        waves are night (idle -> scale down). Usage lands on the members
+        directly; the plane's collect loop turns it into
+        WorkloadMetricsReports the elasticity daemon consumes."""
+        usage = 0.09 if wave % 2 == 0 else 0.01  # vs request 0.1, target 50%
+        for m in self.topology.members.values():
+            m.set_workload_usage("Deployment", NAMESPACE, "hpa-web",
+                                 {"cpu": usage})
+
+    def flap_cluster(self) -> str:
+        """Mark one member cluster NotReady through the wire client; the
+        heal phase restores it (the scheduler must steer around it in
+        between, and convergence is only checked after the heal)."""
+        name = self.rng.choice(sorted(self.topology.members))
+        self._set_ready(name, False)
+        self._flapped.append(name)
+        return name
+
+    def _set_ready(self, name: str, ready: bool, attempts: int = 8) -> None:
+        last: Optional[Exception] = None
+        for _ in range(attempts):
+            try:
+                cluster = self.client.get("Cluster", name)
+                set_condition(cluster.status.conditions, Condition(
+                    type=CLUSTER_CONDITION_READY,
+                    status="True" if ready else "False",
+                    reason="SoakFlap",
+                ))
+                out = self.client.update(cluster)
+                self.ledger.record_ack(out)
+                return
+            except (RemoteError, ConflictError) as e:
+                self.write_failures += 1
+                last = e
+        raise RemoteError(f"cluster flap exhausted retries: {last}")
+
+    def heal(self) -> None:
+        while self._flapped:
+            self._set_ready(self._flapped.pop(), True)
+
+    # -- accounting ---------------------------------------------------------
+
+    def retire_wave_apps(self, wave: int) -> None:
+        """Delete a slice of this wave's surge apps — delete/recreate
+        churn is part of the program, and recorded deletes tell the
+        lost-write invariant the absence is intentional."""
+        gone = [a for a in self.apps
+                if a["name"].startswith(f"wave{wave}-app-")][:2]
+        for app in gone:
+            self._delete("apps/v1/Deployment", app["name"])
+            self._delete("PropagationPolicy", f"{app['name']}-policy")
+            self.apps.remove(app)

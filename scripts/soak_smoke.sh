@@ -1,0 +1,65 @@
+#!/usr/bin/env bash
+# Fleet chaos soak smoke (docs/ROBUSTNESS.md "Fleet soak"). Single-shot:
+# runs the `soak` bench config — the FULL daemon topology (leader +
+# quorum followers, sharded scheduler plane with real elections over the
+# wire, pull agents + estimators per member, elasticity daemon,
+# descheduler, detector/binding/status controllers) driven through 4
+# seeded fault waves (boundary chaos on http/grpc/apply PLUS leader
+# kill + seal-and-promote, shard kill + map-resize handoff, follower
+# partition past the log ring, estimator blackout) under
+# KARMADA_TPU_LOCKCHECK=1 — and asserts the invariant gates the JSON
+# line carries:
+#   pass_lost_writes     zero lost quorum-acked writes across failovers
+#   pass_exactly_once    one empty->placed commit per (uid, epoch)
+#   pass_gang_integrity  no partial gang at any batch boundary
+#   pass_convergence     bounded-window convergence after every wave
+#   pass_resources       thread/queue ceilings hold after every heal
+#   pass_replication     partitioned follower catches up byte-identical
+#   pass_lock_order      the lock-order watchdog graph stays acyclic
+#   soak_schema_ok       the embedded verdict validates structurally
+# Exit 0 prints "SOAK OK".
+#
+# Wired into the slow path as tests/test_soak.py::TestSoakSmokeScript
+# (pytest -m slow). Runs on CPU; pass --soak-minutes N through
+# SOAK_MINUTES for the long profile.
+set -euo pipefail
+
+cd "$(dirname "$0")/.."
+PY=${PYTHON:-python}
+WORK=$(mktemp -d /tmp/soak_smoke.XXXXXX)
+trap 'rm -rf "$WORK"' EXIT
+
+log() { echo "soak_smoke: $*"; }
+
+JAX_PLATFORMS=cpu $PY bench.py --inner --platform cpu --configs soak \
+    --soak-minutes "${SOAK_MINUTES:-0}" \
+    --verbose > "$WORK/out.txt" 2> "$WORK/err.txt" \
+    || { log "bench failed"; cat "$WORK/err.txt"; exit 1; }
+
+LINE=$(grep -E '^\{' "$WORK/out.txt" | tail -1)
+[ -n "$LINE" ] || { log "no JSON line emitted"; cat "$WORK/out.txt"; exit 1; }
+
+SOAK_LINE="$LINE" $PY - <<'PYEOF'
+import json
+import os
+import sys
+
+rec = json.loads(os.environ["SOAK_LINE"])
+gates = ("pass_lost_writes", "pass_exactly_once", "pass_gang_integrity",
+         "pass_convergence", "pass_resources", "pass_replication",
+         "pass_lock_order", "soak_schema_ok", "pass")
+bad = [k for k in gates if not rec.get(k)]
+if bad:
+    inv = rec.get("verdict", {}).get("invariants", {})
+    print(f"soak_smoke: gates FAILED: {bad}", file=sys.stderr)
+    for k, v in inv.items():
+        if v:
+            print(f"soak_smoke:   {k}: {v[:4]}", file=sys.stderr)
+    sys.exit(1)
+waves = rec["verdict"]["waves"]
+kinds = [e["kind"] for w in waves for e in w["process_events"]]
+print(f"soak_smoke: {len(waves)} waves in {rec['value']}s, "
+      f"process faults {kinds}, all invariants green")
+PYEOF
+
+log "SOAK OK"

@@ -152,6 +152,14 @@ def main() -> None:
     # (captured so the committed artifact carries the acceptance booleans)
     artifact["runs"].append(run_bench(
         ["--configs", "shards", "--run-timeout", "600"], 700))
+    # fleet chaos soak: the full daemon topology through the seeded
+    # 4-wave fault rotation (leader kill, shard kill, follower partition,
+    # estimator blackout + boundary chaos) under KARMADA_TPU_LOCKCHECK=1
+    # — the line embeds the structured invariant verdict + SLO report
+    # (captured so the committed artifact carries the robustness gates;
+    # ROADMAP item 2(b) re-capture)
+    artifact["runs"].append(run_bench(
+        ["--configs", "soak", "--run-timeout", "600"], 700))
     # the Go-interop seam: /v1/scheduleBatch latency at flagship scale
     artifact["runs"].append(run_script(
         "scripts/bench_shim.py",

@@ -728,3 +728,210 @@ class TestMemberEstimatorsGuard:
         me.max_available_replicas(["m1", "m2"], req, 4)
         assert breakers.for_member("m1").state == OPEN
         assert breakers.for_member("m2").state == CLOSED
+
+
+# -- process-level fault vocabulary (soak harness; docs/ROBUSTNESS.md) ------
+
+
+class TestProcessFaultRules:
+    def test_schedule_is_deterministic_bytes(self):
+        from karmada_tpu.faults import ProcessFaultRule
+
+        rules = [
+            ProcessFaultRule(kind="leader_kill", wave=2),
+            ProcessFaultRule(kind="shard_kill", rate=0.5),
+            ProcessFaultRule(kind="partition", target="follower-1",
+                             rate=0.3),
+            ProcessFaultRule(kind="estimator_blackout", wave=0),
+        ]
+        a = FaultPlan(seed=11, process_rules=rules)
+        b = FaultPlan(seed=11, process_rules=list(rules))
+        assert a.process_schedule(16) == b.process_schedule(16)
+        # seed moves the probabilistic firings
+        c = FaultPlan(seed=12, process_rules=rules)
+        assert a.process_schedule(64) != c.process_schedule(64)
+
+    def test_pinned_wave_fires_exactly_once(self):
+        from karmada_tpu.faults import ProcessFaultRule
+
+        plan = FaultPlan(seed=7, process_rules=[
+            ProcessFaultRule(kind="leader_kill", wave=3)])
+        fired = [(w, e.kind) for w in range(8)
+                 for e in plan.process_events(w)]
+        assert fired == [(3, "leader_kill")]
+
+    def test_rate_one_fires_every_wave(self):
+        from karmada_tpu.faults import ProcessFaultRule
+
+        plan = FaultPlan(seed=7, process_rules=[
+            ProcessFaultRule(kind="shard_kill", rate=1.0)])
+        assert all(plan.process_events(w) for w in range(6))
+
+    def test_serialization_round_trip(self):
+        from karmada_tpu.faults import ProcessFaultRule
+
+        plan = FaultPlan(
+            seed=5,
+            rules=[FaultRule(boundary="http", kind="error", rate=0.1)],
+            process_rules=[
+                ProcessFaultRule(kind="partition", target="follower-0",
+                                 wave=1, rate=0.25),
+            ],
+        )
+        back = FaultPlan.from_dict(__import__("json").loads(plan.to_json()))
+        assert back.process_schedule(32) == plan.process_schedule(32)
+        assert back.process_rules == plan.process_rules
+
+    def test_empty_process_rules_not_serialized(self):
+        plan = FaultPlan(seed=5, rules=[
+            FaultRule(boundary="http", kind="error", rate=0.1)])
+        assert "process_rules" not in plan.to_json()
+
+    def test_validate_rejects_bad_rules(self):
+        from karmada_tpu.faults import ProcessFaultRule
+
+        with pytest.raises(ValueError):
+            FaultPlan(seed=1, process_rules=[
+                ProcessFaultRule(kind="meteor_strike")]).validate()
+        with pytest.raises(ValueError):
+            FaultPlan(seed=1, process_rules=[
+                ProcessFaultRule(kind="leader_kill", rate=1.5)]).validate()
+        with pytest.raises(ValueError):
+            FaultPlan(seed=1, process_rules=[
+                ProcessFaultRule(kind="leader_kill", wave=-2)]).validate()
+
+
+# -- retry/backoff audit pins (satellite: every boundary site jittered) -----
+
+
+class TestRemoteStoreRetryPolicies:
+    """remote.py used bare `0.2 * (attempt + 1)` sleeps on its write
+    paths — linear, uncapped, and synchronized across clients (thundering
+    herd on leader failover). Pinned here: both sites now ride RetryPolicy
+    full-jitter with a hard cap."""
+
+    def test_write_retry_full_jitter_envelope(self):
+        from karmada_tpu.server.remote import BATCH_RETRY, WRITE_RETRY
+
+        for policy, base in ((WRITE_RETRY, 0.2), (BATCH_RETRY, 0.1)):
+            assert policy.max_delay <= 2.0
+            for attempt in range(8):
+                ceiling = min(policy.max_delay,
+                              base * policy.multiplier ** attempt)
+                draws = {policy.delay(attempt) for _ in range(64)}
+                assert all(0.0 <= d <= ceiling for d in draws)
+                # full jitter, not a constant: draws actually spread
+                assert len(draws) > 1
+
+    def test_write_call_sleeps_through_policy(self, monkeypatch):
+        """The stale-redirect fallback in RemoteStore._write_call must
+        take its sleeps from WRITE_RETRY (jittered + capped), not the old
+        bare `0.2 * (attempt + 1)` formula."""
+        import threading
+
+        from karmada_tpu.server import remote as remote_mod
+        from karmada_tpu.server.remote import (
+            LeaderRedirect,
+            RemoteError,
+            RemoteStore,
+        )
+
+        rs = RemoteStore.__new__(RemoteStore)
+        rs.timeout = 0.01
+        rs.read_preference = "leader"
+        rs._replicas = []
+        rs._trace_tl = threading.local()
+        rs._set_base("http://origin:1")
+
+        # scripted transport: redirect, then the redirect target is dead
+        # (the stale-failover window) — twice — then the origin dies too
+        script = [
+            LeaderRedirect("moved", "http://stale:2"),
+            RemoteError("redirect target unreachable"),
+            LeaderRedirect("moved", "http://stale:2"),
+            RemoteError("redirect target unreachable"),
+            RemoteError("origin unreachable"),
+        ]
+        monkeypatch.setattr(
+            RemoteStore, "_call",
+            lambda self, m, p, b=None: (_ for _ in ()).throw(
+                script.pop(0)))
+
+        slept = []
+        monkeypatch.setattr(remote_mod.time, "sleep", slept.append)
+        sentinel = {1: 0.123, 3: 0.456}
+
+        class StubPolicy:
+            def delay(self, attempt, u=None):
+                return sentinel[attempt]
+
+        monkeypatch.setattr(remote_mod, "WRITE_RETRY", StubPolicy())
+        with pytest.raises(RemoteError):
+            rs._write_call("POST", "/create", {"x": 1})
+        # both post-redirect fallbacks slept through the policy
+        assert slept == [0.123, 0.456]
+
+
+class TestShardResizeListRetry:
+    """Regression pinned from the soak (wave `shard_kill` under http
+    chaos): ShardedDaemon.set_total / relist listed bindings over the
+    wire UNGUARDED — one injected 503 during the map-resize sweep killed
+    the resize and left the handoff fence stuck. Both now ride a bounded
+    transient-only RetryPolicy."""
+
+    def _daemon(self, store):
+        from karmada_tpu.sched.shards.daemon import ShardedDaemon
+
+        d = ShardedDaemon.__new__(ShardedDaemon)
+        d.store = store
+        return d
+
+    def test_transient_remote_errors_are_retried(self, monkeypatch):
+        from karmada_tpu.server.remote import RemoteError
+
+        calls = {"n": 0}
+
+        class FlakyStore:
+            def list(self, kind):
+                calls["n"] += 1
+                if calls["n"] < 3:
+                    raise RemoteError("injected fault [http] UNAVAILABLE")
+                return ["rb-sentinel"]
+
+        monkeypatch.setattr("time.sleep", lambda s: None)
+        out = self._daemon(FlakyStore())._list_bindings_retried()
+        assert out == ["rb-sentinel"]
+        assert calls["n"] == 3
+
+    def test_terminal_errors_escape_immediately(self):
+        from karmada_tpu.store.store import ConflictError
+
+        calls = {"n": 0}
+
+        class ConflictStore:
+            def list(self, kind):
+                calls["n"] += 1
+                raise ConflictError("not transient")
+
+        with pytest.raises(ConflictError):
+            self._daemon(ConflictStore())._list_bindings_retried()
+        assert calls["n"] == 1
+
+    def test_set_total_resets_handoff_state_on_failure(self, monkeypatch):
+        """Even when the retried list exhausts its budget, the resize
+        must drop the handoff fence — a permanently-stuck 'resizing'
+        state was the failure mode the soak exposed."""
+        from karmada_tpu.sched.shards import ShardMap
+        from karmada_tpu.sched.shards.daemon import ShardedDaemon
+        from karmada_tpu.server.remote import RemoteError
+
+        d = ShardedDaemon.__new__(ShardedDaemon)
+        d.shards = ShardMap(0, 2)
+        d._handoff_state = ""
+        monkeypatch.setattr(
+            ShardedDaemon, "_list_bindings_retried",
+            lambda self: (_ for _ in ()).throw(RemoteError("exhausted")))
+        with pytest.raises(RemoteError):
+            d.set_total(1)
+        assert d._handoff_state == ""
+        assert d.shards.total == 1  # the map swap itself is committed

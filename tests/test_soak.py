@@ -368,3 +368,318 @@ def test_churn_soak_descheduler_failover_rebalancer_flapping_fleet():
         for c in cp.runtime.controllers if c.errors
     }
     assert not leftovers, leftovers
+
+
+# ===========================================================================
+# Fleet chaos soak (karmada_tpu/soak/, docs/ROBUSTNESS.md "Fleet soak").
+#
+# Two layers:
+#
+# - FAST violation fixtures: each invariant checker is fed a PLANTED
+#   violation against a bare store — a lost acked write, a rolled-back
+#   rv, a double empty->placed admission under one epoch, a partial gang
+#   at a batch boundary, a queue/thread leak — and must FIRE. An
+#   invariant checker that cannot fail is not checking anything; these
+#   fixtures are the proof the soak's green verdict is falsifiable. Plus
+#   determinism pins on the harness's fault schedule and structural pins
+#   on the verdict validator.
+#
+# - SLOW end-to-end: the short seeded soak itself (full daemon topology,
+#   4 process-fault waves under boundary chaos + KARMADA_TPU_LOCKCHECK)
+#   and the scripts/soak_smoke.sh wiring.
+# ===========================================================================
+
+from karmada_tpu.api.meta import ObjectMeta, new_uid
+from karmada_tpu.api.work import (
+    BindingSpec,
+    ObjectReference,
+    ResourceBinding,
+    TargetCluster,
+)
+from karmada_tpu.soak import (
+    AdmissionLedger,
+    GangIntegrity,
+    ResourceBounds,
+    SoakProfile,
+    WriteLedger,
+    verdict_schema_ok,
+)
+from karmada_tpu.soak.harness import (
+    VERDICT_SCHEMA,
+    WAVE_PATTERN,
+    default_plan,
+    wave_boundary_plan,
+)
+from karmada_tpu.store.store import Store
+
+
+def make_rb(name: str, *, gang: str = "", placed: bool = False,
+            sog: int = 0) -> ResourceBinding:
+    rb = ResourceBinding(
+        metadata=ObjectMeta(namespace="soak", name=name,
+                            uid=new_uid("rb")),
+        spec=BindingSpec(
+            resource=ObjectReference(api_version="apps/v1",
+                                     kind="Deployment", namespace="soak",
+                                     name=name),
+            replicas=2,
+            gang_name=gang,
+        ),
+    )
+    if placed:
+        rb.spec.clusters = [TargetCluster(name="member-0", replicas=2)]
+    rb.status.scheduler_observed_generation = sog
+    return rb
+
+
+# -- violation fixtures: every checker must FIRE on a planted violation ----
+
+
+class TestWriteLedgerFires:
+    def test_planted_lost_write_fires(self):
+        store = Store()
+        ledger = WriteLedger()
+        kept = store.create(make_rb("kept"))
+        lost = store.create(make_rb("lost"))
+        ledger.record_ack(kept)
+        ledger.record_ack(lost)
+        store.delete("ResourceBinding", "lost", "soak")  # nobody recorded it
+        out = ledger.check(store)
+        assert len(out) == 1 and "lost" in out[0] and "gone" in out[0]
+
+    def test_planted_rollback_fires(self):
+        store = Store()
+        ledger = WriteLedger()
+        rb = store.create(make_rb("rb"))
+        rb = store.update(rb)
+        ledger.record_ack(rb)
+        # a promoted leader that lost the tail would serve an OLDER rv
+        stale = Store()
+        old = stale.create(make_rb("rb"))
+        assert int(old.metadata.resource_version) < int(
+            rb.metadata.resource_version)
+        out = ledger.check(stale)
+        assert len(out) == 1 and "rolled-back" in out[0]
+
+    def test_recorded_delete_and_later_rewrite_are_clean(self):
+        store = Store()
+        ledger = WriteLedger()
+        a = store.create(make_rb("a"))
+        ledger.record_ack(a)
+        store.delete("ResourceBinding", "a", "soak")
+        ledger.record_delete("ResourceBinding", "a", "soak")
+        b = store.create(make_rb("b"))
+        ledger.record_ack(b)
+        store.update(b)  # the plane legitimately rewrites at a higher rv
+        assert ledger.check(store) == []
+
+
+class TestAdmissionLedgerFires:
+    def test_planted_double_admission_fires(self):
+        store = Store()
+        ledger = AdmissionLedger()
+        ledger.attach(store)
+        rb = store.create(make_rb("rb", sog=1))
+        rb.spec.clusters = [TargetCluster(name="m0", replicas=2)]
+        rb = store.update(rb)  # empty -> placed, epoch 1: commit #1
+        rb.spec.clusters = []
+        rb = store.update(rb)  # evicted
+        rb.spec.clusters = [TargetCluster(name="m1", replicas=2)]
+        store.update(rb)  # empty -> placed AGAIN under epoch 1: the bug
+        out = ledger.doubles()
+        assert len(out) == 1 and "epoch 1" in out[0] and "2 times" in out[0]
+
+    def test_reschedule_under_new_epoch_is_clean(self):
+        store = Store()
+        ledger = AdmissionLedger()
+        ledger.attach(store)
+        rb = store.create(make_rb("rb", sog=1))
+        rb.spec.clusters = [TargetCluster(name="m0", replicas=2)]
+        rb = store.update(rb)
+        rb.spec.clusters = []
+        rb = store.update(rb)
+        rb.spec.clusters = [TargetCluster(name="m1", replicas=2)]
+        rb.status.scheduler_observed_generation = 2  # new admission epoch
+        store.update(rb)
+        assert ledger.doubles() == []
+
+    def test_failover_reattach_replay_does_not_recount(self):
+        """Promotion replays current state off the new leader; an
+        already-placed binding must not count as a fresh admission."""
+        old = Store()
+        ledger = AdmissionLedger()
+        ledger.attach(old)
+        rb = old.create(make_rb("rb", sog=1))
+        rb.spec.clusters = [TargetCluster(name="m0", replicas=2)]
+        old.update(rb)
+        promoted = Store()
+        placed = make_rb("rb", placed=True, sog=1)
+        placed.metadata.uid = rb.metadata.uid  # same object, new leader
+        promoted.create(placed)
+        ledger.attach(promoted)  # replays the placed binding
+        assert ledger.doubles() == []
+
+
+class TestGangIntegrityFires:
+    def test_planted_partial_gang_fires(self):
+        store = Store()
+        gang = GangIntegrity()
+        gang.attach(store)
+        store.create(make_rb("g-m0", gang="g", placed=True))
+        store.create(make_rb("g-m1", gang="g"))  # unplaced at the boundary
+        out = gang.check()
+        assert out and "partial gang 'g'" in out[0] and "1/2" in out[0]
+
+    def test_atomic_gang_batch_is_clean(self):
+        store = Store()
+        gang = GangIntegrity()
+        gang.attach(store)
+        store.create_batch([
+            make_rb("g-m0", gang="g", placed=True),
+            make_rb("g-m1", gang="g", placed=True),
+        ])
+        assert gang.check() == []
+
+    def test_unplaced_cohort_then_atomic_placement_is_clean(self):
+        store = Store()
+        gang = GangIntegrity()
+        gang.attach(store)
+        rbs = store.create_batch([
+            make_rb("g-m0", gang="g"), make_rb("g-m1", gang="g")])
+        for rb in rbs:
+            rb.spec.clusters = [TargetCluster(name="m0", replicas=2)]
+        store.update_batch(rbs)  # ONE rv-contiguous placement commit
+        assert gang.check() == []
+
+
+class TestResourceBoundsFires:
+    def test_planted_queue_leak_fires(self):
+        bounds = ResourceBounds(max_queue_depth=8)
+        bounds.rebase()
+        out = bounds.sample(0, queue_depth=9)
+        assert len(out) == 1 and "queue leak" in out[0]
+
+    def test_planted_thread_leak_fires(self):
+        bounds = ResourceBounds(headroom_threads=0)
+        bounds.rebase()
+        bounds.baseline -= 1  # plant: one thread more than the ceiling
+        out = bounds.sample(1, queue_depth=0)
+        assert len(out) == 1 and "thread leak" in out[0]
+
+    def test_within_bounds_is_clean(self):
+        bounds = ResourceBounds(headroom_threads=64, max_queue_depth=64)
+        bounds.rebase()
+        assert bounds.sample(0, queue_depth=3) == []
+        assert [s["wave"] for s in bounds.samples] == [0]
+
+
+# -- harness determinism + verdict validator pins ---------------------------
+
+
+class TestSoakPlanPins:
+    def test_default_plan_rotates_every_fault_class(self):
+        plan = default_plan(SoakProfile(waves=4))
+        kinds = [e.kind for w in range(4) for e in plan.process_events(w)]
+        assert kinds == list(WAVE_PATTERN)
+
+    def test_default_plan_is_deterministic(self):
+        p = SoakProfile(waves=8)
+        assert default_plan(p).process_schedule(8) == \
+            default_plan(p).process_schedule(8)
+
+    def test_wave_boundary_plans_differ_by_wave_but_are_stable(self):
+        p = SoakProfile()
+        a0, b0 = wave_boundary_plan(p, 0), wave_boundary_plan(p, 0)
+        a1 = wave_boundary_plan(p, 1)
+        assert a0.seed == b0.seed and a0.rules == b0.rules
+        assert a0.seed != a1.seed
+
+    def test_long_profile_scales_waves(self):
+        assert SoakProfile(waves=4).effective_waves() == 4
+        assert SoakProfile(waves=4, soak_minutes=5).effective_waves() == 10
+
+
+class TestVerdictSchema:
+    def _minimal(self) -> dict:
+        return {
+            "schema": VERDICT_SCHEMA,
+            "config": {"waves": 4},
+            "duration_s": 1.0,
+            "waves": [{"wave": 0, "process_events": [], "converged": True,
+                       "duration_s": 0.5}],
+            "invariants": {
+                "lost_writes": [], "double_admissions": [],
+                "partial_gangs": [], "convergence_failures": [],
+                "resource_violations": [], "replication_failures": [],
+            },
+            "slo": {"stages": {}},
+            "pass": True,
+            "pass_lost_writes": True, "pass_exactly_once": True,
+            "pass_gang_integrity": True, "pass_convergence": True,
+            "pass_resources": True, "pass_replication": True,
+            "pass_lock_order": True,
+        }
+
+    def test_minimal_valid_verdict_passes(self):
+        assert verdict_schema_ok(self._minimal())
+
+    def test_rejections(self):
+        import copy
+
+        good = self._minimal()
+        for mutate in (
+            lambda v: v.__setitem__("schema", "karmada-tpu/other/v9"),
+            lambda v: v.__setitem__("pass_replication", "yes"),
+            lambda v: v.__setitem__("waves", []),
+            lambda v: v["waves"][0].pop("converged"),
+            lambda v: v["invariants"].pop("replication_failures"),
+            lambda v: v.__setitem__("slo", {}),
+            lambda v: v["config"].__setitem__("waves", "4"),
+            lambda v: v.pop("invariants"),
+        ):
+            v = copy.deepcopy(good)
+            mutate(v)
+            assert not verdict_schema_ok(v), mutate
+        assert verdict_schema_ok(good)  # mutations never leaked back
+
+
+# -- slow path: the seeded soak end to end ----------------------------------
+
+
+@pytest.mark.slow
+@pytest.mark.chaos
+class TestShortSoak:
+    def test_short_profile_all_invariants_green(self):
+        """The bench-config profile: full daemon topology, 4 seeded fault
+        waves (estimator blackout, shard kill, leader kill + promote,
+        follower partition past the log ring) under boundary chaos and
+        the lock-order watchdog — every invariant gate must hold and the
+        verdict must validate."""
+        from karmada_tpu.soak import run_soak
+
+        v = run_soak(SoakProfile(members=2, followers=2, shards=2, apps=4,
+                                 waves=4, settle_window_s=45.0))
+        assert verdict_schema_ok(v), v
+        failed = {k: v["invariants"] for k in v if k.startswith("pass_")
+                  and not v[k]}
+        assert v["pass"], failed
+        kinds = [e["kind"] for w in v["waves"] for e in w["process_events"]]
+        assert sorted(kinds) == sorted(WAVE_PATTERN)
+        assert all(w["converged"] for w in v["waves"])
+
+
+@pytest.mark.slow
+class TestSoakSmokeScript:
+    def test_soak_smoke(self):
+        """scripts/soak_smoke.sh: the `soak` bench config end to end —
+        the JSON line's invariant gates asserted from a child process."""
+        import os
+        import subprocess
+
+        repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        r = subprocess.run(
+            ["bash", "scripts/soak_smoke.sh"],
+            capture_output=True, text=True, timeout=900, cwd=repo,
+        )
+        assert r.returncode == 0, r.stdout + r.stderr
+        assert "SOAK OK" in r.stdout
