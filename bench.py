@@ -1788,6 +1788,414 @@ def _fanout_resume_bytes(n_objs=2000, n_delta=40):
     return replay_bytes, resume_bytes
 
 
+# -- wire legs: event-loop serving density + negotiated delta codec --------
+#
+# Unlike the in-process baseline/mux legs above, these run over REAL
+# sockets against a live apiserver: the density leg compares serving CPU
+# per watcher between the threaded path and the event-loop path
+# (server/eventloop.py), the delta leg measures bytes/event of the
+# negotiated binary delta codec against the JSON parity baseline — with
+# the delta-applied state asserted bit-identical at every rv.
+
+FANOUT_WIRE_WATCHERS = 128   # density point (the 1000-watcher point rides
+FANOUT_WIRE_WINDOW_S = 2.0   # --fanout-wire-watchers in the capture run)
+# paced write rate for the density legs: watcher density is a FLEET
+# property (thousands of mostly-idle streams, a moderate shared event
+# rate) — an unthrottled writer saturates both paths with encode/send
+# volume and measures throughput, not the per-write thread-wakeup tax
+# the event loop removes
+FANOUT_WIRE_RATE_HZ = 200.0
+FANOUT_DELTA_OBJECTS = 64
+FANOUT_DELTA_UPDATES = 400
+
+
+def _wire_attach(port, kind, accept=None, replay=False, timeout_s=10.0,
+                 namespace=None):
+    """Raw-socket watch attachment: returns (socket, body bytes already
+    read past the headers, response Content-Type)."""
+    import socket as socket_mod
+    from urllib.parse import quote
+
+    s = socket_mod.create_connection(("127.0.0.1", port), timeout=timeout_s)
+    req = (f"GET /watch?kind={quote(kind, safe='')}"
+           f"&replay={'1' if replay else '0'}")
+    if namespace:
+        req += f"&namespace={quote(namespace, safe='')}"
+    req += " HTTP/1.1\r\nHost: bench\r\n"
+    if accept:
+        req += f"Accept: {accept}\r\n"
+    req += "Connection: close\r\n\r\n"
+    s.sendall(req.encode())
+    buf = b""
+    while b"\r\n\r\n" not in buf:
+        chunk = s.recv(65536)
+        if not chunk:
+            raise RuntimeError("watch attach: connection closed in headers")
+        buf += chunk
+    head, _, body = buf.partition(b"\r\n\r\n")
+    ctype = ""
+    for line in head.decode("latin-1").split("\r\n")[1:]:
+        if line.lower().startswith("content-type:"):
+            ctype = line.split(":", 1)[1].strip()
+    return s, body, ctype
+
+
+class _WireClientReader:
+    """One instrumented thread draining W watch sockets through a
+    selector: counts delivered JSON event lines and wire bytes, and
+    reports its own CPU time so the serving-side CPU can be isolated
+    (process CPU minus writers minus this reader)."""
+
+    def __init__(self, socks_with_tails):
+        import selectors
+        import threading
+
+        self._sel = selectors.DefaultSelector()
+        self.lines = 0
+        self.bytes = 0
+        self.cpu_s = 0.0
+        self.last_line_t = time.monotonic()
+        self._stop = threading.Event()
+        for sock, tail in socks_with_tails:
+            sock.setblocking(False)
+            self._sel.register(sock, selectors.EVENT_READ, {"buf": tail})
+            if tail:
+                self._consume(self._sel.get_key(sock).data, b"")
+        self._thread = threading.Thread(target=self._run, daemon=True,
+                                        name="wire-bench-reader")
+        self._thread.start()
+
+    def _consume(self, state, chunk):
+        data = state["buf"] + chunk
+        parts = data.split(b"\n")
+        state["buf"] = parts[-1]
+        for p in parts[:-1]:
+            if p.strip():
+                self.lines += 1
+                self.last_line_t = time.monotonic()
+
+    def _run(self):
+        cpu0 = time.thread_time()
+        try:
+            while not self._stop.is_set():
+                for key, _mask in self._sel.select(0.2):
+                    try:
+                        chunk = key.fileobj.recv(65536)
+                    except (BlockingIOError, InterruptedError):
+                        continue
+                    except OSError:
+                        self._sel.unregister(key.fileobj)
+                        continue
+                    if not chunk:
+                        self._sel.unregister(key.fileobj)
+                        continue
+                    self.bytes += len(chunk)
+                    self._consume(key.data, chunk)
+        finally:
+            self.cpu_s = time.thread_time() - cpu0
+
+    def stop(self):
+        self._stop.set()
+        self._thread.join(timeout=10.0)
+        self._sel.close()
+
+
+def _wire_paced_writes(store, rate_hz, window_s, obj_fn):
+    """One writer thread pacing `rate_hz` updates/s for `window_s`, with
+    per-write latency and writer-thread CPU accounting: returns
+    (latencies, write count, start time, writer CPU seconds). Paced, not
+    closed-loop: each write lands alone, so the threaded path pays its
+    per-write wake-every-watcher tax with no batching to hide behind —
+    the shape a fleet's shared event rate actually has."""
+    import threading
+
+    lats = []
+    tally = {"writes": 0, "cpu": 0.0}
+
+    def writer():
+        c0 = time.thread_time()
+        period = 1.0 / rate_hz
+        t0 = time.perf_counter()
+        i = 0
+        try:
+            while True:
+                due = t0 + i * period
+                now = time.perf_counter()
+                if now - t0 >= window_s:
+                    break
+                if due > now:
+                    time.sleep(due - now)
+                obj = obj_fn(i)
+                w0 = time.perf_counter()
+                store.update(obj)
+                lats.append(time.perf_counter() - w0)
+                i += 1
+        finally:
+            tally["writes"] = i
+            tally["cpu"] = time.thread_time() - c0
+
+    th = threading.Thread(target=writer, daemon=True,
+                          name="wire-bench-writer")
+    t_start = time.perf_counter()
+    th.start()
+    th.join()
+    return lats, tally["writes"], t_start, tally["cpu"]
+
+
+def _fanout_wire_leg(watchers, window_s, use_loop, drain_grace_s=20.0,
+                     rate_hz=FANOUT_WIRE_RATE_HZ):
+    """W real-socket JSON watch streams against a live apiserver, served
+    by the event loop (use_loop=True) or one thread per stream, under a
+    paced shared write rate. Fleet topology: every watcher is scoped to
+    its OWN namespace (a pull agent watching its execution namespace)
+    and each paced write lands in exactly one of them — so per write,
+    one stream has an event to send and the other W-1 are bystanders.
+    The threaded path wakes all W handler threads per write regardless;
+    the loop takes one wakeup and W cheap match checks. The figure of
+    merit is watcher density per serving CPU core:
+    watchers / (serving CPU fraction), where serving CPU is process CPU
+    minus the instrumented writer and client-reader threads — measured
+    identically for both paths."""
+    from karmada_tpu.api.unstructured import Unstructured
+    from karmada_tpu.server.apiserver import ControlPlaneServer
+    from karmada_tpu.store.store import Store
+
+    def ns_obj(i, t=""):
+        return Unstructured({
+            "apiVersion": "v1", "kind": "ConfigMap",
+            "metadata": {"name": "cm", "namespace": f"ns-{i % watchers}"},
+            "data": {"t": t},
+        })
+
+    store = Store()
+    for i in range(watchers):
+        store.create(ns_obj(i, t="seed"))
+    srv = ControlPlaneServer(_FanoutCP(store), watch_loop=use_loop)
+    port = srv.start()
+    socks = []
+    reader = None
+    try:
+        attached = [_wire_attach(port, FANOUT_KIND, namespace=f"ns-{i}")
+                    for i in range(watchers)]
+        socks = [s for s, _, _ in attached]
+        reader = _WireClientReader([(s, tail) for s, tail, _ in attached])
+        cpu0 = time.process_time()
+        write_lats, n_writes, t_start, writer_cpu = _wire_paced_writes(
+            store, rate_hz, window_s,
+            lambda i: ns_obj(i, t=str(time.perf_counter())))
+        expect = n_writes
+        deadline = time.monotonic() + drain_grace_s
+        while time.monotonic() < deadline and reader.lines < expect:
+            # quiet period: streams that resynced deliver a different
+            # count — stop once no event line arrived for a second
+            if time.monotonic() - reader.last_line_t > 1.0:
+                break
+            time.sleep(0.05)
+        elapsed = time.perf_counter() - t_start
+        cpu_total = time.process_time() - cpu0
+        loop_stats = srv.watch_loop_stats() if use_loop else None
+    finally:
+        if reader is not None:
+            reader.stop()
+        for s in socks:
+            try:
+                s.close()
+            except OSError:
+                pass
+        srv.stop()
+    serving_cpu = max(cpu_total - writer_cpu - reader.cpu_s, 1e-3)
+    density = watchers * elapsed / serving_cpu
+    out = {
+        "watchers": watchers,
+        "delivered": reader.lines,
+        "wire_bytes": reader.bytes,
+        "writes": n_writes,
+        "elapsed_s": round(elapsed, 2),
+        "serving_cpu_s": round(serving_cpu, 4),
+        "watchers_per_core": round(density, 1),
+        "write_lat": write_lats,
+    }
+    if loop_stats is not None:
+        out["loop"] = {k: loop_stats[k] for k in (
+            "connections", "queue_bytes_max", "resyncs", "evictions",
+            "stuck_closed", "heartbeats", "cpu_s")}
+    return out
+
+
+def _fanout_delta_obj(i, t=""):
+    from karmada_tpu.api.unstructured import Unstructured
+
+    return Unstructured({
+        "apiVersion": "v1", "kind": "ConfigMap",
+        "metadata": {"name": f"obj-{i:05d}", "namespace": "bench"},
+        # a realistic mostly-stable body: the delta codec ships only the
+        # changed field + metadata stamps, the JSON baseline re-ships pad
+        "data": {"t": t, "pad": "x" * 256},
+    })
+
+
+def _fanout_delta_leg(n_objs=FANOUT_DELTA_OBJECTS,
+                      n_updates=FANOUT_DELTA_UPDATES, timeout_s=30.0):
+    """One JSON stream and one negotiated binary stream over the same
+    update run: bytes/event of each codec over the MODIFIED window, with
+    the binary client's delta-applied state asserted BIT-IDENTICAL to
+    the JSON event at every rv (wirecodec.canonical)."""
+    import threading
+
+    from karmada_tpu.server import wirecodec
+    from karmada_tpu.server.apiserver import ControlPlaneServer
+    from karmada_tpu.store.store import Store
+
+    store = Store()
+    for i in range(n_objs):
+        store.create(_fanout_delta_obj(i, t="seed"))
+    srv = ControlPlaneServer(_FanoutCP(store))
+    port = srv.start()
+    json_events = {}   # rv -> canonical json enc (the parity reference)
+    json_bytes = [0, 0]   # MODIFIED bytes, MODIFIED count
+    bin_events = []    # (rv, canonical applied enc, was_delta, frame bytes)
+    errors = []
+    expect = n_objs + n_updates
+
+    # attach BOTH streams before any update, and hold the update burst
+    # until each client has READ its full seed replay (the `ready`
+    # events below): _wire_attach returns on response headers, but the
+    # handler thread takes the replay snapshot after that — an update
+    # racing the snapshot would be folded into the replay (one ADDED for
+    # the key's latest state) instead of arriving as a live MODIFIED,
+    # and the fixed `expect` count would never be reached. Once a client
+    # holds n_objs replay events written before any update, its snapshot
+    # provably covered only the seeds.
+    json_sock, json_tail, _jc = _wire_attach(port, FANOUT_KIND, replay=True)
+    bin_sock, bin_tail, bin_ctype = _wire_attach(
+        port, FANOUT_KIND, accept=wirecodec.CONTENT_TYPE_BIN, replay=True)
+    json_ready = threading.Event()
+    bin_ready = threading.Event()
+
+    def run_json():
+        sock, buf = json_sock, json_tail
+        seen = 0
+        deadline = time.monotonic() + timeout_s
+        try:
+            while seen < expect and time.monotonic() < deadline:
+                while b"\n" not in buf:
+                    chunk = sock.recv(65536)
+                    if not chunk:
+                        errors.append(
+                            f"json stream: EOF at {seen}/{expect}")
+                        return
+                    buf += chunk
+                line, _, buf = buf.partition(b"\n")
+                if not line.strip():
+                    continue
+                msg = json.loads(line.decode())
+                seen += 1
+                if seen >= n_objs:
+                    json_ready.set()
+                json_events[msg["rv"]] = wirecodec.canonical(msg["obj"])
+                if msg["event"] == "MODIFIED":
+                    json_bytes[0] += len(line) + 1
+                    json_bytes[1] += 1
+            if seen < expect:
+                errors.append(f"json stream: deadline at {seen}/{expect}")
+        except OSError as e:
+            errors.append(f"json stream: {e}")
+        finally:
+            sock.close()
+
+    def run_bin():
+        sock, tail = bin_sock, bin_tail
+        if wirecodec.CONTENT_TYPE_BIN not in bin_ctype:
+            errors.append(f"binary negotiation failed: got {bin_ctype!r}")
+            sock.close()
+            return
+        reader = wirecodec.FrameReader()
+        state = {}
+        seen = 0
+        deadline = time.monotonic() + timeout_s
+        try:
+            pending = [tail] if tail else []
+            while seen < expect and time.monotonic() < deadline:
+                if not pending:
+                    chunk = sock.recv(65536)
+                    if not chunk:
+                        errors.append(
+                            f"bin stream: EOF at {seen}/{expect}")
+                        return
+                    pending.append(chunk)
+                data = pending.pop()
+                for ftype, payload in reader.feed(data):
+                    if ftype == wirecodec.FRAME_HEARTBEAT:
+                        continue
+                    msg = json.loads(payload.decode())
+                    if ftype == wirecodec.FRAME_DELTA:
+                        key = (msg["ns"], msg["name"])
+                        base_rv, base_enc = state[key]
+                        if base_rv != msg["base"]:
+                            errors.append(
+                                f"delta base {msg['base']} != held "
+                                f"{base_rv} at rv {msg['rv']}")
+                            return
+                        enc = wirecodec.apply_patch(base_enc, msg["patch"])
+                        delta = True
+                    else:
+                        enc = msg["obj"]
+                        m = enc.get("manifest", enc).get("metadata", {})
+                        key = (m.get("namespace", ""), m.get("name", ""))
+                        delta = False
+                    seen += 1
+                    if seen >= n_objs:
+                        bin_ready.set()
+                    state[key] = (msg["rv"], enc)
+                    if msg["event"] == "MODIFIED":
+                        bin_events.append(
+                            (msg["rv"], wirecodec.canonical(enc), delta,
+                             wirecodec.HEADER_LEN + len(payload)))
+            if seen < expect:
+                errors.append(f"bin stream: deadline at {seen}/{expect}")
+        except (OSError, wirecodec.WireProtocolError, KeyError) as e:
+            errors.append(f"bin stream: {type(e).__name__}: {e}")
+        finally:
+            sock.close()
+
+    tj = threading.Thread(target=run_json, daemon=True)
+    tb = threading.Thread(target=run_bin, daemon=True)
+    tj.start()
+    tb.start()
+    try:
+        if not (json_ready.wait(timeout_s) and bin_ready.wait(timeout_s)):
+            errors.append("replay barrier: streams not live before burst")
+        for i in range(n_updates):
+            store.update(_fanout_delta_obj(i % n_objs, t=f"u{i}"))
+        tj.join(timeout=timeout_s)
+        tb.join(timeout=timeout_s)
+    finally:
+        loop_stats = srv.watch_loop_stats()
+        srv.stop()
+
+    delta_frames = sum(1 for _, _, d, _ in bin_events if d)
+    parity_ok = (not errors and len(bin_events) == n_updates
+                 and all(rv in json_events and json_events[rv] == canon
+                         for rv, canon, _, _ in bin_events))
+    bin_mod_bytes = sum(b for _, _, _, b in bin_events)
+    json_bpe = (json_bytes[0] / json_bytes[1]) if json_bytes[1] else None
+    bin_bpe = (bin_mod_bytes / len(bin_events)) if bin_events else None
+    return {
+        "objects": n_objs,
+        "updates": n_updates,
+        "json_events": json_bytes[1],
+        "bin_events": len(bin_events),
+        "delta_frames": delta_frames,
+        "bytes_per_event_json": round(json_bpe, 1) if json_bpe else None,
+        "bytes_per_event_bin": round(bin_bpe, 1) if bin_bpe else None,
+        "delta_reduction": (round(1 - bin_bpe / json_bpe, 4)
+                            if json_bpe and bin_bpe else None),
+        "parity_ok": parity_ok,
+        "errors": errors[:5],
+        "loop": loop_stats,
+    }
+
+
 def run_fanout(args, backend_label: str, verbose=False) -> dict:
     """The `fanout` config: W concurrent watchers + a sustained multi-writer
     mutation load against the OLD (per-subscription, per-client encode) and
@@ -1800,6 +2208,10 @@ def run_fanout(args, backend_label: str, verbose=False) -> dict:
 
     watchers = int(args.watchers)
     window_s = float(args.window_s)
+    wire_watchers = int(getattr(args, "wire_watchers",
+                                FANOUT_WIRE_WATCHERS))
+    wire_window_s = float(getattr(args, "wire_window_s",
+                                  FANOUT_WIRE_WINDOW_S))
     work = tempfile.mkdtemp(prefix="fanout-bench-")
     # tighter GIL handoff for the measured windows: with 12 runnable
     # threads the default 5 ms switch interval charges every GIL-release
@@ -1822,6 +2234,25 @@ def run_fanout(args, backend_label: str, verbose=False) -> dict:
             print(f"# fanout mux: {mux['events_per_s']:.0f} ev/s "
                   f"({mux['writes']} writes, {mux['resyncs']} resyncs)")
         replay_bytes, resume_bytes = _fanout_resume_bytes()
+        # wire legs: event-loop vs threaded serving density over real
+        # sockets, then the negotiated binary delta codec
+        wire_loop = _fanout_wire_leg(wire_watchers, wire_window_s,
+                                     use_loop=True)
+        if verbose:
+            print(f"# fanout wire loop: "
+                  f"{wire_loop['watchers_per_core']:.0f} watchers/core "
+                  f"({wire_loop['delivered']} delivered)")
+        wire_thr = _fanout_wire_leg(wire_watchers, wire_window_s,
+                                    use_loop=False)
+        if verbose:
+            print(f"# fanout wire threaded: "
+                  f"{wire_thr['watchers_per_core']:.0f} watchers/core "
+                  f"({wire_thr['delivered']} delivered)")
+        delta = _fanout_delta_leg()
+        if verbose:
+            print(f"# fanout delta: {delta['bytes_per_event_bin']} B/ev "
+                  f"binary vs {delta['bytes_per_event_json']} B/ev json, "
+                  f"parity={delta['parity_ok']}")
     finally:
         sys.setswitchinterval(prev_switch)
         shutil.rmtree(work, ignore_errors=True)
@@ -1842,6 +2273,16 @@ def run_fanout(args, backend_label: str, verbose=False) -> dict:
                     and mux_w["p99_s"] <= base_w["p99_s"] * 1.05)
     resume_frac = (round(resume_bytes / replay_bytes, 4)
                    if replay_bytes else None)
+    loop_w = pct(wire_loop.pop("write_lat"))
+    thr_w = pct(wire_thr.pop("write_lat"))
+    density_ratio = (
+        round(wire_loop["watchers_per_core"]
+              / wire_thr["watchers_per_core"], 2)
+        if wire_thr["watchers_per_core"] else None)
+    # the event loop removes per-write thread wakeups entirely, so its
+    # write p99 should be BETTER; 1.10 is the noise allowance
+    wire_write_ok = bool(thr_w["p99_s"] and loop_w["p99_s"]
+                         and loop_w["p99_s"] <= thr_w["p99_s"] * 1.10)
     rec = {
         "metric": f"watch_fanout_{watchers}w",
         "value": mux["events_per_s"],
@@ -1860,17 +2301,44 @@ def run_fanout(args, backend_label: str, verbose=False) -> dict:
         "replay_bytes": replay_bytes,
         "resume_bytes": resume_bytes,
         "resume_frac": resume_frac,
+        "wire": {
+            "watchers": wire_watchers,
+            "window_s": wire_window_s,
+            "rate_hz": FANOUT_WIRE_RATE_HZ,
+            "loop": {**wire_loop, "write": loop_w},
+            "threaded": {**wire_thr, "write": thr_w},
+            "density_ratio": density_ratio,
+        },
+        "watchers_per_core": wire_loop["watchers_per_core"],
+        "bytes_per_event": {
+            "json": delta["bytes_per_event_json"],
+            "bin": delta["bytes_per_event_bin"],
+            "reduction": delta["delta_reduction"],
+        },
+        "delta": delta,
         "pass_fanout_5x": bool(ratio is not None and ratio >= 5.0),
         "pass_write_p99": write_ok,
         "pass_resume_frac": bool(resume_frac is not None
                                  and resume_frac < 0.05),
+        "pass_density_5x": bool(density_ratio is not None
+                                and density_ratio >= 5.0),
+        "pass_wire_write_p99": wire_write_ok,
+        "pass_delta_bytes": bool(
+            delta["parity_ok"] and delta["delta_frames"] > 0
+            and delta["delta_reduction"] is not None
+            and delta["delta_reduction"] >= 0.2),
     }
     rec["pass"] = (rec["pass_fanout_5x"] and rec["pass_write_p99"]
-                   and rec["pass_resume_frac"])
+                   and rec["pass_resume_frac"] and rec["pass_density_5x"]
+                   and rec["pass_wire_write_p99"]
+                   and rec["pass_delta_bytes"])
     if verbose:
         print(f"# fanout: {ratio}x events/s, write p99 "
               f"{mux_w['p99_s']}s vs {base_w['p99_s']}s, "
-              f"resume {resume_frac} of replay -> pass={rec['pass']}")
+              f"resume {resume_frac} of replay, "
+              f"density {density_ratio}x, "
+              f"delta -{delta['delta_reduction']} bytes/ev "
+              f"-> pass={rec['pass']}")
     return rec
 
 
@@ -4330,7 +4798,10 @@ RESULT_SCHEMAS = {
                "pass_tail_sampled": "bool"},
     "fanout": {**_ENVELOPE, "pass_fanout_5x": "bool",
                "pass_write_p99": "bool", "pass_resume_frac": "bool",
-               "pass": "bool"},
+               "wire": "dict", "watchers_per_core": "num",
+               "bytes_per_event": "dict", "delta": "dict",
+               "pass_density_5x": "bool", "pass_wire_write_p99": "bool",
+               "pass_delta_bytes": "bool", "pass": "bool"},
     "writeload": {**_ENVELOPE, "pass_write_3x": "bool",
                   "pass_write_p99_2x": "bool", "pass_parity": "bool",
                   "pass": "bool"},
@@ -4460,6 +4931,11 @@ def add_args(ap: argparse.ArgumentParser) -> None:
                     help=argparse.SUPPRESS)
     ap.add_argument("--fanout-window-s", type=float, default=FANOUT_WINDOW_S,
                     help=argparse.SUPPRESS)
+    # wire legs (event-loop density + delta codec) ride the same config
+    ap.add_argument("--fanout-wire-watchers", type=int,
+                    default=FANOUT_WIRE_WATCHERS, help=argparse.SUPPRESS)
+    ap.add_argument("--fanout-wire-window-s", type=float,
+                    default=FANOUT_WIRE_WINDOW_S, help=argparse.SUPPRESS)
     # writeload config overrides (writers: the W=32 acceptance point)
     ap.add_argument("--writeload-writers", type=int,
                     default=WRITELOAD_WRITERS, help=argparse.SUPPRESS)
@@ -4574,6 +5050,8 @@ def main() -> None:
             "--stream-window-s", str(args.stream_window_s),
             "--fanout-watchers", str(args.fanout_watchers),
             "--fanout-window-s", str(args.fanout_window_s),
+            "--fanout-wire-watchers", str(args.fanout_wire_watchers),
+            "--fanout-wire-window-s", str(args.fanout_wire_window_s),
             "--writeload-writers", str(args.writeload_writers),
             "--writeload-window-s", str(args.writeload_window_s),
             "--replica-watchers", str(args.replica_watchers),
@@ -4693,6 +5171,8 @@ def run_bench(args) -> None:
             fo_args = types.SimpleNamespace(
                 watchers=args.fanout_watchers,
                 window_s=args.fanout_window_s,
+                wire_watchers=args.fanout_wire_watchers,
+                wire_window_s=args.fanout_wire_window_s,
             )
             try:
                 rec = run_fanout(fo_args, backend, verbose=args.verbose)

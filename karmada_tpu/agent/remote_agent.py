@@ -31,7 +31,8 @@ class RemoteAgentSession:
                  token: Optional[str] = None, cafile: Optional[str] = None,
                  status_flush_delay: float = 0.005,
                  metrics_reports: bool = False,
-                 search_reports: bool = False):
+                 search_reports: bool = False,
+                 wire: str = "auto"):
         """`status_flush_delay`: the agent-side write-coalescing knob —
         per-Work status reports buffer this many seconds and commit as one
         POST /objects/batch instead of one round-trip each (a thousand
@@ -49,7 +50,10 @@ class RemoteAgentSession:
         if config.sync_mode != "Pull":
             raise ValueError("remote agents serve Pull clusters")
         self.config = config
-        self.store = RemoteStore(url, token=token, cafile=cafile)
+        # `wire` rides through to the transport: "auto" (default) lets the
+        # coalesced status batches upgrade to the negotiated binary codec
+        # once the control plane advertises it; "json" pins the baseline
+        self.store = RemoteStore(url, token=token, cafile=cafile, wire=wire)
         self.member = member or InMemoryMember(config)
         self.runtime = Runtime()
         interpreter = ResourceInterpreter()

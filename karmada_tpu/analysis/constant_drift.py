@@ -13,6 +13,15 @@ module; everyone else imports it.
   * an HTTP route path                   (^/[a-z][a-z0-9/_-]*$)
   * a metric name                        (^karmada_[a-z0-9_]+$)
   * a wire header                        (^X-[A-Za-z-]+$)
+  * a negotiated content type            (^application/x-karmada-)
+  * a binary frame magic                 (a short bytes literal whose
+    constant name ends in _MAGIC)
+
+The content-type and magic shapes exist for the negotiated binary codec
+(server/wirecodec.py): a client and server disagreeing on the Accept
+string or the frame magic is a silent negotiation break — the client
+would fall back to JSON forever (or reject every frame), which no test
+asserting "it still works" catches.
 
 The metrics-catalog check (PR-14's `TestMetricsCatalog`) folds onto the
 same module index here: every `registry.counter/gauge/histogram` name in
@@ -34,26 +43,36 @@ RULE = "constant-drift"
 _ROUTE = re.compile(r"^/[a-z][a-z0-9/_-]*$")
 _METRIC = re.compile(r"^karmada_[a-z0-9_]+$")
 _HEADER = re.compile(r"^X-[A-Za-z][A-Za-z-]+$")
+_CONTENT_TYPE = re.compile(r"^application/x-karmada-")
 
 
 def is_wire_visible(value: str) -> bool:
     return ("karmada.io/" in value
+            or value.startswith("magic:")  # bytes magics, see below
             or bool(_ROUTE.match(value))
             or bool(_METRIC.match(value))
-            or bool(_HEADER.match(value)))
+            or bool(_HEADER.match(value))
+            or bool(_CONTENT_TYPE.match(value)))
 
 
 def _module_constants(mod) -> list[tuple[str, str, int]]:
-    """Module-level NAME = "literal" assignments: (name, value, line)."""
+    """Module-level NAME = "literal" assignments: (name, value, line).
+    Covers str literals and the bytes frame-magic shape (NAME_MAGIC =
+    b"..") — a magic redefined elsewhere drifts exactly like a string."""
     out = []
     for node in mod.tree.body:
         if (isinstance(node, ast.Assign) and len(node.targets) == 1
                 and isinstance(node.targets[0], ast.Name)
-                and isinstance(node.value, ast.Constant)
-                and isinstance(node.value.value, str)):
+                and isinstance(node.value, ast.Constant)):
             name = node.targets[0].id
-            if name.isupper():
-                out.append((name, node.value.value, node.lineno))
+            value = node.value.value
+            if not name.isupper():
+                continue
+            if isinstance(value, str):
+                out.append((name, value, node.lineno))
+            elif (isinstance(value, bytes) and name.endswith("_MAGIC")
+                    and 0 < len(value) <= 8):
+                out.append((name, f"magic:{value!r}", node.lineno))
     return out
 
 

@@ -11,6 +11,15 @@ bounded.
   unbounded by construction — an unbounded buffer between a producer and
   a slow consumer is an OOM with a delay fuse (the soak plane's first
   class of casualties).
+* The event-loop watch plane (`server/eventloop.py`) may own no
+  unbounded per-client buffers: the module must define a positive
+  `*_QUEUE_MAX_BYTES` constant, every append to a per-connection
+  `.chunks` queue must be confined to ONE function (so the bound is
+  checkable at all), the module must carry gating evidence (a
+  comparison of the queue's byte count against the bound), and
+  evictions must be counted (`wire_queue_evictions`) — a slow client
+  silently buffering unbounded bytes in the loop process is exactly
+  the OOM shape above, multiplied by fleet fan-out.
 """
 from __future__ import annotations
 
@@ -144,8 +153,106 @@ def _scan_module(index: ModuleIndex, mod) -> list[Finding]:
     return findings
 
 
+# -- the event-loop buffer rule (server/eventloop.py) -----------------------
+
+_EVENTLOOP = "karmada_tpu/server/eventloop.py"
+_QUEUE_BOUND_SUFFIX = "_QUEUE_MAX_BYTES"
+_EVICTION_COUNTER = "wire_queue_evictions"
+
+
+def _fold(node: ast.AST):
+    """Fold the arithmetic shapes size constants use (256 * 1024,
+    64 << 20); None when not a compile-time number."""
+    if isinstance(node, ast.Constant) and isinstance(node.value, (int, float)):
+        return node.value
+    if isinstance(node, ast.BinOp):
+        left, right = _fold(node.left), _fold(node.right)
+        if left is None or right is None:
+            return None
+        ops = {ast.Mult: lambda a, b: a * b, ast.Add: lambda a, b: a + b,
+               ast.Sub: lambda a, b: a - b, ast.LShift: lambda a, b: a << b}
+        fn = ops.get(type(node.op))
+        return fn(left, right) if fn else None
+    return None
+
+
+def _positive_const(node: ast.AST) -> bool:
+    value = _fold(node)
+    return value is not None and value > 0
+
+
+def _mentions(node: ast.AST, *needles: str) -> bool:
+    for sub in ast.walk(node):
+        name = None
+        if isinstance(sub, ast.Name):
+            name = sub.id
+        elif isinstance(sub, ast.Attribute):
+            name = sub.attr
+        if name and any(n in name.lower() for n in needles):
+            return True
+    return False
+
+
+def eventloop_findings(index: ModuleIndex) -> list[Finding]:
+    mod = index.modules.get(_EVENTLOOP)
+    if mod is None:
+        return []
+    findings: list[Finding] = []
+
+    bound_ok = any(
+        isinstance(node, ast.Assign) and len(node.targets) == 1
+        and isinstance(node.targets[0], ast.Name)
+        and node.targets[0].id.endswith(_QUEUE_BOUND_SUFFIX)
+        and _positive_const(node.value)
+        for node in mod.tree.body)
+    if not bound_ok:
+        findings.append(Finding(
+            RULE, mod.relpath, 1,
+            f"event loop defines no positive *{_QUEUE_BOUND_SUFFIX} "
+            f"constant — per-client queues must be byte-bounded"))
+
+    if _EVICTION_COUNTER not in mod.source:
+        findings.append(Finding(
+            RULE, mod.relpath, 1,
+            f"event loop never touches {_EVICTION_COUNTER} — a bounded "
+            f"queue that evicts invisibly is undebuggable at fleet scale"))
+
+    # every append to a per-connection chunks queue goes through ONE
+    # function (the bound is only auditable with a single enqueue seam)
+    append_fns: set[str] = set()
+    for fn in mod.functions.values():
+        for node in ast.walk(fn.node):
+            if (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                    and node.func.attr == "append"
+                    and isinstance(node.func.value, ast.Attribute)
+                    and node.func.value.attr == "chunks"):
+                append_fns.add(fn.qualname)
+    if len(append_fns) > 1:
+        findings.append(Finding(
+            RULE, mod.relpath, 1,
+            f"per-socket queue appended from {len(append_fns)} functions "
+            f"({', '.join(sorted(append_fns))}) — one enqueue seam only, "
+            f"or the byte bound cannot be audited"))
+
+    # gating evidence: somewhere, the queue byte count is compared
+    # against the bound before filling
+    gated = any(
+        isinstance(node, ast.Compare)
+        and _mentions(node, "qbytes")
+        and _mentions(node, "queue_max")
+        for node in ast.walk(mod.tree))
+    if append_fns and not gated:
+        findings.append(Finding(
+            RULE, mod.relpath, 1,
+            "no comparison of the per-socket byte count against the "
+            "queue bound found — queue fills must be gated"))
+    return findings
+
+
 def analyze(index: ModuleIndex) -> list[Finding]:
     findings: list[Finding] = []
     for mod in index.modules.values():
         findings.extend(_scan_module(index, mod))
+    findings.extend(eventloop_findings(index))
     return findings

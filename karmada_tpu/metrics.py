@@ -382,6 +382,26 @@ list_pages = registry.counter(
     "karmada_list_pages_total",
     "Paginated list pages served from the watch cache",
 )
+
+# async wire plane (server/eventloop.py + server/wirecodec.py —
+# docs/PERF.md "Async wire plane"): stream connections by negotiated codec
+# and serving path, bytes leaving by codec/encoding, and the slow-client
+# pressure valve (a full per-socket queue whose cursor lagged past ring
+# compaction evicts the backlog for an in-stream resync)
+wire_connections = registry.gauge(
+    "karmada_wire_connections",
+    "Active watch/stream connections, by codec (json/bin) and serving "
+    "path (loop/thread)",
+)
+wire_bytes_sent = registry.counter(
+    "karmada_wire_bytes_sent_total",
+    "Bytes written to watch streams, by codec and encoding (full/delta)",
+)
+wire_queue_evictions = registry.counter(
+    "karmada_wire_queue_evictions_total",
+    "Slow-client backlog evictions on the event loop (bounded per-socket "
+    "queue + compacted cursor -> in-stream resync)",
+)
 wal_fsync_batch_size = registry.histogram(
     "karmada_wal_fsync_batch_size",
     "WAL records committed per group-commit fsync batch",

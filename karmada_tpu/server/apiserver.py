@@ -94,7 +94,7 @@ from urllib.parse import parse_qs, urlparse
 from ..store.store import ConflictError, NotFoundError
 from ..store.watchcache import ContinueExpired
 from ..webhook.handlers import AdmissionDenied
-from . import codec
+from . import codec, wirecodec
 from .httpbase import (
     bearer_auth_ok,
     drain_body,
@@ -114,6 +114,7 @@ class ControlPlaneServer:
                  socket_timeout: Optional[float] = None,
                  watch_cache: bool = True,
                  watch_cache_capacity: int = 0,
+                 watch_loop: bool = True,
                  replication=None,
                  follower: bool = False):
         """`enable_test_clock=False` disables POST /tick with 403: advancing
@@ -137,6 +138,15 @@ class ControlPlaneServer:
         baseline (the fanout bench's comparison leg; daemon flag
         --no-watch-cache). `watch_cache_capacity`: ring size in events
         (0 = the module default).
+
+        `watch_loop`: serve plain-TCP watch streams from the single-thread
+        event loop (server/eventloop.py) instead of parking a handler
+        thread per stream, and negotiate the binary delta codec
+        (`Accept: application/x-karmada-bin`) on those streams. False
+        restores the thread-per-stream JSON baseline (the fanout bench's
+        wire comparison leg; daemon flag --no-watch-loop). TLS streams
+        always stay on the threaded path (an SSLSocket cannot be dup()'d
+        into byte-level non-blocking serving). Requires `watch_cache`.
 
         `replication`: a `store.replication.ReplicationManager` to attach
         on start — this server is the replication LEADER, shipping its
@@ -164,6 +174,8 @@ class ControlPlaneServer:
         self._use_watch_cache = watch_cache
         self._watch_cache_capacity = watch_cache_capacity
         self._watch_cache = None
+        self._use_watch_loop = watch_loop
+        self._watch_loop = None
         self._repl = replication          # leader role (ships the log)
         self._follower = None             # follower role (lazily created)
         self._follower_mode = follower    # reject writes from boot
@@ -218,6 +230,12 @@ class ControlPlaneServer:
                 kwargs["capacity"] = self._watch_cache_capacity
             self._watch_cache = WatchCache(self.cp.store, **kwargs)
             self._watch_cache.attach()
+        if (self._use_watch_loop and self._watch_cache is not None
+                and self._watch_loop is None):
+            from .eventloop import WatchLoop
+
+            self._watch_loop = WatchLoop(self._watch_cache)
+            self._watch_loop.start()
         if self._repl is not None:
             # followers learn the redirect target from the append stream:
             # default the advertised URL to the bound address BEFORE the
@@ -244,6 +262,8 @@ class ControlPlaneServer:
         self.cp.store.unwatch_all(self._mark_dirty)
         if self._repl is not None:
             self._repl.close()
+        if self._watch_loop is not None:
+            self._watch_loop.stop()
         if self._watch_cache is not None:
             self._watch_cache.detach()
         self._dirty.set()
@@ -255,6 +275,12 @@ class ControlPlaneServer:
     def url(self) -> str:
         scheme = "https" if self._ssl_context is not None else "http"
         return f"{scheme}://{self._host}:{self._port}"
+
+    def watch_loop_stats(self) -> dict:
+        """Event-loop serving counters (connections, queue high-water,
+        evictions, stuck closes) — the soak's WireHealth invariant and the
+        wire tests read these. Empty dict when the loop is disabled."""
+        return {} if self._watch_loop is None else self._watch_loop.stats()
 
     # -- reconcile thread -------------------------------------------------
 
@@ -366,6 +392,11 @@ class ControlPlaneServer:
             self._send(h, 422, {"error": str(e)})
         except BrokenPipeError:
             pass
+        except wirecodec.WireProtocolError as e:
+            # an undecodable negotiated-binary body is the client's error,
+            # not a server fault — and it must read as a hard 4xx so the
+            # client's sticky downgrade (not its 5xx retry loop) engages
+            self._send(h, 400, {"error": f"wire codec: {e}"})
         except Exception as e:  # noqa: BLE001 - wire boundary
             self._send(h, 500, {"error": f"{type(e).__name__}: {e}"})
 
@@ -502,7 +533,12 @@ class ControlPlaneServer:
                 ctx[0], "commit", getattr(h, "_trace_t0", 0.0), time.time(),
                 span_id=ctx[1], route=getattr(h, "_trace_route", ""),
             )
-        send_json(h, status, body)
+        # advertise binary-body support on every response: clients upgrade
+        # their subsequent POST bodies only after seeing this (a pre-binary
+        # server would reject a frame it cannot parse) — wirecodec.py
+        send_json(h, status, body,
+                  extra_headers={wirecodec.HEADER_WIRE:
+                                 str(wirecodec.WIRE_VERSION)})
 
     @staticmethod
     def _body(h) -> dict:
@@ -1033,25 +1069,45 @@ class ControlPlaneServer:
         still holds them, else fall back to snapshot+replay (the client
         sent since because it HAS state; the replay reconverges it). A
         cursor that lags past ring compaction mid-stream resyncs the same
-        way instead of being closed."""
+        way instead of being closed.
+
+        Serving path + codec negotiation (docs/PERF.md "Async wire
+        plane"): after the headers (and any replay snapshot) are written
+        here, a plain-TCP stream is handed off to the event loop — this
+        thread returns to the pool while the loop serves the live tail.
+        `Accept: application/x-karmada-bin` negotiates binary delta
+        frames on the loop path (the Content-Type answers the decision,
+        so a pre-binary client/server pair degrades observably to JSON
+        lines); TLS streams and watch_loop=False stay on this thread."""
         from ..metrics import (
             watch_client_lag,
             watch_clients,
             watch_events_sent,
             watch_resyncs,
+            wire_connections,
         )
 
         cache = self._watch_cache
+        loop = self._watch_loop
+        use_loop = loop is not None and self._ssl_context is None
+        wire = ("bin" if use_loop
+                and wirecodec.accepts_binary(h.headers.get("Accept"))
+                else "json")
         client = f"c{next(self._watch_ids)}"
         watch_clients.inc(1)
+        threaded = False
         try:
             h.send_response(200)
-            h.send_header("Content-Type", "application/json-lines")
+            h.send_header("Content-Type",
+                          wirecodec.CONTENT_TYPE_BIN if wire == "bin"
+                          else wirecodec.CONTENT_TYPE_JSON_LINES)
+            h.send_header(wirecodec.HEADER_WIRE, str(wirecodec.WIRE_VERSION))
             # no Content-Length: the stream ends when either side closes
             h.send_header("Connection", "close")
             h.end_headers()
             w = h.wfile
             cursor = None
+            replayed = False
             since = q.get("since")
             if since is not None:
                 try:
@@ -1070,9 +1126,26 @@ class ControlPlaneServer:
                         watch_resyncs.inc(reason="compacted")
             if cursor is None:
                 if replay or since is not None:
-                    cursor = self._replay_snapshot(w, kind, namespace)
+                    cursor = self._replay_snapshot(w, kind, namespace, wire)
+                    replayed = True
                 else:
                     cursor = cache.current_rv
+            if use_loop:
+                # hand-off: flush what this thread wrote, dup the
+                # connection for the loop, and keep socketserver's
+                # teardown from FIN-ing the shared socket (httpbase
+                # detach seam). Deltas are only sound against state this
+                # stream delivered: after a replay every base is held
+                # (floor 0); a bare since-resume holds nothing delivered
+                # by THIS attachment yet, so its floor is the cursor.
+                w.flush()
+                h.server.detach_request(h.connection)
+                loop.add(h.connection.dup(), kind=kind, namespace=namespace,
+                         wire=wire, cursor=cursor,
+                         delta_floor=0 if replayed else cursor)
+                return
+            threaded = True
+            wire_connections.inc(1, codec=wire, loop="thread")
             last_write = time.monotonic()
             while not self._stopping:
                 events, cursor, ok = cache.events_since(
@@ -1106,15 +1179,22 @@ class ControlPlaneServer:
         finally:
             watch_client_lag.remove(client=client)
             watch_clients.inc(-1)
+            if threaded:
+                wire_connections.inc(-1, codec=wire, loop="thread")
 
-    def _replay_snapshot(self, w, kind: str, namespace: str) -> int:
+    def _replay_snapshot(self, w, kind: str, namespace: str,
+                         wire: str = "json") -> int:
         """Write the cache's revision-consistent current state as ADDED
-        lines (informer initial-list semantics); returns the snapshot rv —
-        the cursor from which live streaming continues gap-free."""
+        lines (informer initial-list semantics) — or ADDED frames on a
+        binary-negotiated stream; returns the snapshot rv — the cursor
+        from which live streaming continues gap-free."""
         from ..metrics import watch_events_sent
 
         rv, items = self._watch_cache.snapshot(kind, namespace)
-        buf = b"".join(it.added_line() for it in items)
+        if wire == "bin":
+            buf = b"".join(it.added_frame() for it in items)
+        else:
+            buf = b"".join(it.added_line() for it in items)
         if buf:
             w.write(buf)
             w.flush()
@@ -1159,7 +1239,7 @@ class ControlPlaneServer:
 
         try:
             h.send_response(200)
-            h.send_header("Content-Type", "application/json-lines")
+            h.send_header("Content-Type", wirecodec.CONTENT_TYPE_JSON_LINES)
             # no Content-Length: the stream ends when either side closes
             h.send_header("Connection", "close")
             h.end_headers()

@@ -18,12 +18,15 @@ class QuietHandler(BaseHTTPRequestHandler):
         pass
 
 
-def send_json(handler: BaseHTTPRequestHandler, status: int, body: dict) -> None:
+def send_json(handler: BaseHTTPRequestHandler, status: int, body: dict,
+              extra_headers: Optional[dict] = None) -> None:
     try:
         data = json.dumps(body).encode()
         handler.send_response(status)
         handler.send_header("Content-Type", "application/json")
         handler.send_header("Content-Length", str(len(data)))
+        for k, v in (extra_headers or {}).items():
+            handler.send_header(k, v)
         if handler.close_connection:
             # drain_body declined an oversized body: tell the peer the
             # socket will not be reused (the unread bytes make it unusable)
@@ -35,10 +38,25 @@ def send_json(handler: BaseHTTPRequestHandler, status: int, body: dict) -> None:
 
 
 def read_json(handler: BaseHTTPRequestHandler) -> dict:
+    """Request body as a dict. Accepts both negotiated body codecs: plain
+    JSON (the default) and the binary framed message
+    (`Content-Type: application/x-karmada-bin`, server/wirecodec.py) that
+    clients upgrade to after seeing the advertise header — one sniff here
+    makes EVERY POST route codec-transparent (batch writes, replication
+    appends, the coalesced agent-status path)."""
     n = int(handler.headers.get("Content-Length") or 0)
     if n == 0:
         return {}
-    return json.loads(handler.rfile.read(n).decode())
+    raw = handler.rfile.read(n)
+    from . import wirecodec
+
+    if wirecodec.is_binary_content_type(
+            handler.headers.get("Content-Type")):
+        body = wirecodec.unpack_message(raw)
+        if not isinstance(body, dict):
+            raise wirecodec.WireProtocolError("message body must be a dict")
+        return body
+    return json.loads(raw.decode())
 
 
 def wants_openmetrics(handler: BaseHTTPRequestHandler) -> bool:
@@ -112,6 +130,29 @@ def drain_body(handler: BaseHTTPRequestHandler,
 DEFAULT_SOCKET_TIMEOUT = 15.0
 
 
+class _DetachMixin:
+    """Socket hand-off seam for the event-loop watch plane: a handler that
+    transplanted its connection (a dup()'d descriptor now owned by
+    server/eventloop.py) calls `detach_request(self.connection)`; the
+    per-request teardown then only closes THIS fd instead of issuing the
+    usual `shutdown(SHUT_WR)` — which would FIN the shared connection and
+    end the handed-off stream under the loop."""
+
+    def detach_request(self, request) -> None:
+        ids = getattr(self, "_detached_requests", None)
+        if ids is None:
+            ids = self._detached_requests = set()
+        ids.add(id(request))
+
+    def shutdown_request(self, request):  # noqa: D102 - socketserver hook
+        ids = getattr(self, "_detached_requests", None)
+        if ids is not None and id(request) in ids:
+            ids.discard(id(request))
+            self.close_request(request)
+            return
+        super().shutdown_request(request)
+
+
 def make_http_server(host: str, port: int, handler_cls,
                      ssl_context=None,
                      socket_timeout: float = DEFAULT_SOCKET_TIMEOUT,
@@ -135,7 +176,7 @@ def make_http_server(host: str, port: int, handler_cls,
             {"timeout": socket_timeout},
         )
     if ssl_context is None:
-        class PlainServer(ThreadingHTTPServer):
+        class PlainServer(_DetachMixin, ThreadingHTTPServer):
             # accept backlog: the socketserver default of 5 turns a fleet
             # of agents reconnecting at once (control-plane restart, or W
             # writers opening a connection per request) into
@@ -145,7 +186,7 @@ def make_http_server(host: str, port: int, handler_cls,
 
         httpd = PlainServer((host, port), handler_cls)
     else:
-        class TLSServer(ThreadingHTTPServer):
+        class TLSServer(_DetachMixin, ThreadingHTTPServer):
             request_queue_size = 128  # see PlainServer
 
             def finish_request(self, request, client_address):

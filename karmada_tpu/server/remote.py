@@ -24,7 +24,7 @@ from urllib.request import Request, urlopen
 from ..api.unstructured import Unstructured
 from ..faults.policy import RetryPolicy
 from ..store.store import BatchError, BatchOpResult, ConflictError, NotFoundError, gvk_of
-from . import codec
+from . import codec, wirecodec
 
 # Write-retry backoff after a possible failover window: full-jitter with a
 # cap, so N clients retrying into a promotion don't form a synchronized
@@ -81,11 +81,24 @@ class RemoteStore:
                  token: Optional[str] = None, cafile: Optional[str] = None,
                  page_size: int = DEFAULT_PAGE_SIZE,
                  replicas: Optional[Iterable[str]] = None,
-                 read_preference: str = "leader"):
+                 read_preference: str = "leader",
+                 wire: str = "auto"):
         self.base_url = base_url.rstrip("/")
         self.timeout = timeout
         self.token = token
         self.cafile = cafile
+        # negotiated wire codec (server/wirecodec.py). "auto": watch
+        # streams send Accept for the binary framing and follow whatever
+        # Content-Type the server answers with (a pre-binary server
+        # answers json-lines — observable, never assumed), and POST
+        # bodies upgrade to the framed binary codec only AFTER a response
+        # carried the X-Karmada-Wire advertise header. "json" pins the
+        # plain-JSON parity baseline everywhere. A 400/415 answer to a
+        # binary body downgrades stickily (a middlebox or downgraded
+        # server mid-rollout must not fail every later write).
+        self._wire = wire
+        self._wire_seen = False
+        self._wire_down = False
         # list() auto-paginates in chunks of this many objects (0 = one
         # unpaginated request — also what pre-pagination servers serve)
         self.page_size = page_size
@@ -158,6 +171,28 @@ class RemoteStore:
             headers["X-Karmada-Trace"] = trace_header
         return headers
 
+    # -- negotiated body codec (server/wirecodec.py) ----------------------
+
+    def _wire_upgrade_ok(self) -> bool:
+        """True when POST/PUT bodies should ship as binary frames: the
+        server advertised support and nothing has forced a downgrade."""
+        return (self._wire == "auto" and self._wire_seen
+                and not self._wire_down)
+
+    def _note_wire(self, value: Optional[str]) -> None:
+        """Learn binary-codec support from any response's advertise
+        header — one successful call (even a GET) upgrades every later
+        write body on this client."""
+        if value and not self._wire_seen:
+            self._wire_seen = True
+
+    def _encode_body(self, body: dict) -> tuple[bytes, Optional[str], bool]:
+        """(request bytes, content-type override, sent-binary flag)."""
+        if self._wire_upgrade_ok():
+            return (wirecodec.pack_message(body),
+                    wirecodec.CONTENT_TYPE_BIN, True)
+        return json.dumps(body).encode(), None, False
+
     @staticmethod
     def _trace_header() -> Optional[str]:
         """X-Karmada-Trace value for ONE logical write, minted from the
@@ -190,15 +225,21 @@ class RemoteStore:
             faults.check(faults.BOUNDARY_HTTP, target or "control-plane")
         except faults.InjectedFault as e:
             raise RemoteError(f"control plane unreachable: {e}") from None
-        data = json.dumps(body).encode() if body is not None else None
+        data, ctype, sent_bin = (None, None, False)
+        if body is not None:
+            data, ctype, sent_bin = self._encode_body(body)
         th = trace_header or getattr(self._trace_tl, "header", None)
+        headers = self._headers(data is not None, th)
+        if ctype:
+            headers["Content-Type"] = ctype
         req = Request(
             (base or self.base_url) + path, data=data, method=method,
-            headers=self._headers(data is not None, th),
+            headers=headers,
         )
         try:
             with urlopen(req, timeout=self.timeout,
                          context=self._ssl_ctx) as resp:
+                self._note_wire(resp.headers.get(wirecodec.HEADER_WIRE))
                 return json.loads(resp.read().decode() or "{}")
         except HTTPError as e:
             try:
@@ -208,6 +249,14 @@ class RemoteStore:
             if not isinstance(payload, dict):
                 payload = {}
             msg = payload.get("error", str(e))
+            if sent_bin and wirecodec.body_rejected(e.code, msg):
+                # the binary body bounced (pre-binary middlebox, or the
+                # server rolled back mid-session): downgrade stickily and
+                # replay this one request as plain JSON — a genuine bad
+                # request then fails the same way it always did
+                self._wire_down = True
+                return self._call(method, path, body, base=base,
+                                  trace_header=trace_header)
             if e.code == 404:
                 raise NotFoundError(msg) from None
             if e.code == 409:
@@ -354,22 +403,34 @@ class RemoteStore:
             faults.check(faults.BOUNDARY_HTTP, self._fault_target)
         except faults.InjectedFault as e:
             raise RemoteError(f"control plane unreachable: {e}") from None
-        data = json.dumps(body).encode()
+        data, ctype, sent_bin = self._encode_body(body)
         th = trace_header or getattr(self._trace_tl, "header", None)
+        headers = self._headers(True, th)
+        if ctype:
+            headers["Content-Type"] = ctype
         req = Request(
             self.base_url + "/objects/batch", data=data, method="POST",
-            headers=self._headers(True, th),
+            headers=headers,
         )
         try:
             with urlopen(req, timeout=self.timeout,
                          context=self._ssl_ctx) as resp:
+                self._note_wire(resp.headers.get(wirecodec.HEADER_WIRE))
                 return json.loads(resp.read().decode() or "{}")
         except HTTPError as e:
             try:
                 payload = json.loads(e.read().decode())
             except Exception:  # noqa: BLE001
                 payload = {}
+            if not isinstance(payload, dict):
+                payload = {}
             msg = payload.get("error", str(e))
+            if (sent_bin and "results" not in payload
+                    and wirecodec.body_rejected(e.code, msg)):
+                # codec-level rejection (no per-object results): sticky
+                # downgrade and replay as JSON — see _call
+                self._wire_down = True
+                return self._call_batch(body, trace_header=trace_header)
             if e.code == 404:
                 raise _NoBatchRoute(msg) from None
             results = payload.get("results")
@@ -730,10 +791,21 @@ class RemoteStore:
                     url.hostname, url.port, timeout=5.0
                 )
             try:
-                conn.request("GET", path, headers=self._headers(False))
+                headers = self._headers(False)
+                if self._wire != "json":
+                    # ask for the binary framing; the server's answering
+                    # Content-Type decides (pre-binary servers answer
+                    # json-lines and the JSON loop below runs unchanged)
+                    headers["Accept"] = wirecodec.CONTENT_TYPE_BIN
+                conn.request("GET", path, headers=headers)
                 resp = conn.getresponse()
                 if resp.status != 200:
                     return resp.status
+                self._note_wire(resp.getheader(wirecodec.HEADER_WIRE))
+                if wirecodec.is_binary_content_type(
+                        resp.getheader("Content-Type")):
+                    return self._attach_binary(resp, kind, deliver, done,
+                                               last_rv)
                 buf = b""
                 while not done():
                     chunk = resp.read1(65536)
@@ -848,6 +920,73 @@ class RemoteStore:
         t.start()
         self._watch_threads.append(t)
 
+    def _attach_binary(self, resp, kind: str, deliver, done,
+                       last_rv: list) -> int:
+        """One binary-framed watch attachment (negotiated by response
+        Content-Type). Tracks (rv, encoding) per key so FRAME_DELTA
+        patches apply against the exact base the server diffed from —
+        sound because the stream delivers each key's events in rv order,
+        so the state after a contiguous stream through `base` IS the
+        object at `base`. A base mismatch (compaction skew, codec bug)
+        ends the attachment: the outer loop re-attaches with replay and
+        the full snapshot heals the state. Returns the status-like code
+        the JSON loop returns (always 200 here: stream ended)."""
+        import logging
+
+        reader = wirecodec.FrameReader()
+        # (kind, namespace, name) -> (rv, wire encoding) for delta bases;
+        # DELETED drops the key so the dict tracks live objects only
+        state: dict[tuple, tuple[int, Any]] = {}
+        while not done():
+            chunk = resp.read1(65536)
+            if not chunk:
+                return 200  # server closed (shutdown or overflow)
+            try:
+                frames = list(reader.feed(chunk))
+            except wirecodec.WireProtocolError:
+                logging.getLogger(__name__).warning(
+                    "watch %s: broken binary framing; re-attaching", kind)
+                return 200
+            for ftype, payload in frames:
+                if ftype == wirecodec.FRAME_HEARTBEAT:
+                    continue
+                msg = json.loads(payload.decode())
+                if ftype == wirecodec.FRAME_DELTA:
+                    key = (msg["kind"], msg["ns"], msg["name"])
+                    held = state.get(key)
+                    if held is None or held[0] != msg["base"]:
+                        logging.getLogger(__name__).warning(
+                            "watch %s: delta base rv %s != held %s for "
+                            "%s/%s; re-attaching for a replay resync",
+                            kind, msg["base"],
+                            held[0] if held else None,
+                            msg["ns"], msg["name"])
+                        return 200
+                    enc = wirecodec.apply_patch(held[1], msg["patch"])
+                elif ftype == wirecodec.FRAME_EVENT:
+                    enc = msg["obj"]
+                else:
+                    continue  # unknown frame type: skip, stay attached
+                try:
+                    # decode inside the try — see the JSON loop
+                    obj = codec.decode(enc)
+                    key = (msg["kind"], obj.metadata.namespace or "",
+                           obj.metadata.name)
+                    deliver(msg["kind"], msg["event"], obj)
+                except Exception:  # noqa: BLE001 - handler fault
+                    logging.getLogger(__name__).exception(
+                        "watch %s: handler failed for one event; "
+                        "re-attaching to resume it", kind)
+                    return 200
+                if msg["event"] == "DELETED":
+                    state.pop(key, None)
+                else:
+                    state[key] = (msg["rv"], enc)
+                rv = msg.get("rv") or obj.metadata.resource_version
+                if rv and rv > last_rv[0]:
+                    last_rv[0] = rv
+        return 200
+
     def close(self) -> None:
         self._closed = True
 
@@ -939,12 +1078,14 @@ class RemoteControlPlane:
                  token: Optional[str] = None, cafile: Optional[str] = None,
                  page_size: int = DEFAULT_PAGE_SIZE,
                  replicas: Optional[Iterable[str]] = None,
-                 read_preference: str = "leader"):
+                 read_preference: str = "leader",
+                 wire: str = "auto"):
         self.url = url.rstrip("/")
         self.store = RemoteStore(self.url, timeout=timeout, token=token,
                                  cafile=cafile, page_size=page_size,
                                  replicas=replicas,
-                                 read_preference=read_preference)
+                                 read_preference=read_preference,
+                                 wire=wire)
         self.members = _RemoteMembers(self.store)
 
     def replication_status(self) -> dict:

@@ -10,14 +10,16 @@ shard kill with map-resize handoff, follower partition past the log ring,
 estimator blackouts). A continuous invariant checker holds the composed
 system to the contracts no unit test composes: zero lost quorum-acked
 writes, exactly-once admission per (uid, epoch), no partial gang at any
-sampled rv, bounded-window convergence after every wave, and bounded
-threads/queues across waves.
+sampled rv, bounded-window convergence after every wave, bounded
+threads/queues across waves, and a healthy event-loop wire plane (no
+stuck sockets, per-socket queues within their byte bound).
 """
 from .harness import SoakHarness, SoakProfile, run_soak, verdict_schema_ok
 from .invariants import (
     AdmissionLedger,
     GangIntegrity,
     ResourceBounds,
+    WireHealth,
     WriteLedger,
 )
 from .topology import SoakTopology
@@ -29,6 +31,7 @@ __all__ = [
     "SoakHarness",
     "SoakProfile",
     "SoakTopology",
+    "WireHealth",
     "WriteLedger",
     "run_soak",
     "verdict_schema_ok",

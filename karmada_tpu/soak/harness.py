@@ -24,6 +24,7 @@ from .invariants import (
     AdmissionLedger,
     GangIntegrity,
     ResourceBounds,
+    WireHealth,
     WriteLedger,
     wait_converged,
 )
@@ -156,6 +157,7 @@ class SoakHarness:
         admission.attach(topo.store)
         gang.attach(topo.store)
         bounds = ResourceBounds()
+        wire = WireHealth()
 
         waves: list[dict] = []
         convergence_failures: list[str] = []
@@ -198,6 +200,7 @@ class SoakHarness:
                 topo.plane.quiesce(timeout=20.0)
                 resource_violations.extend(
                     bounds.sample(w, topo.plane.queue_depth()))
+                wire.sample(w, [topo.leader, *topo.followers])
                 waves.append({
                     "wave": w,
                     "process_events": fired,
@@ -216,6 +219,7 @@ class SoakHarness:
         lost = write_ledger.check(topo.store)
         doubles = admission.doubles()
         partial = gang.check()
+        wire_violations = wire.check()
 
         from ..analysis import lockorder
 
@@ -247,9 +251,11 @@ class SoakHarness:
                 "convergence_failures": convergence_failures,
                 "resource_violations": resource_violations,
                 "replication_failures": replication_failures,
+                "wire_violations": wire_violations,
                 "plane_errors": topo.plane.errors[:16],
             },
             "resource_samples": bounds.samples,
+            "wire_samples": wire.samples,
             "lock_edges": lock_edges,
             "lock_order_error": lock_err,
             "pass_lost_writes": not lost,
@@ -258,6 +264,7 @@ class SoakHarness:
             "pass_convergence": not convergence_failures,
             "pass_resources": not resource_violations,
             "pass_replication": not replication_failures,
+            "pass_wire_health": not wire_violations,
             "pass_lock_order": lock_ok,
             "slo": slo_report(),
         }
@@ -279,7 +286,7 @@ def verdict_schema_ok(verdict: dict) -> bool:
         for k in ("pass", "pass_lost_writes", "pass_exactly_once",
                   "pass_gang_integrity", "pass_convergence",
                   "pass_resources", "pass_replication",
-                  "pass_lock_order"):
+                  "pass_wire_health", "pass_lock_order"):
             if not isinstance(verdict[k], bool):
                 return False
         if not isinstance(verdict["waves"], list) or not verdict["waves"]:
@@ -291,7 +298,7 @@ def verdict_schema_ok(verdict: dict) -> bool:
         inv = verdict["invariants"]
         for k in ("lost_writes", "double_admissions", "partial_gangs",
                   "convergence_failures", "resource_violations",
-                  "replication_failures"):
+                  "replication_failures", "wire_violations"):
             if not isinstance(inv[k], list):
                 return False
         slo = verdict["slo"]

@@ -19,6 +19,11 @@ Catalog (docs/ROBUSTNESS.md "Fleet soak"):
   ResourceBounds   no leak across waves: thread count and controller
                    queue depths return below a fixed ceiling after every
                    wave's heal
+  WireHealth       event-loop wire plane stays healthy under faults: no
+                   stream socket reaped as stuck, no per-socket queue
+                   over its byte bound at any wave boundary, and the
+                   loop actually served streams (a soak that never
+                   exercised the wire plane proves nothing about it)
 """
 from __future__ import annotations
 
@@ -232,6 +237,64 @@ class ResourceBounds:
             out.append(
                 f"queue leak after wave {wave}: depth {queue_depth} "
                 f"> {self.max_queue}")
+        return out
+
+
+class WireHealth:
+    """Wire-plane health across the server group, sampled at wave
+    boundaries (a loop that dies in a failover contributes its last
+    sample before the kill). Violations:
+
+    - a stream socket reaped as STUCK: soak clients are cooperative, so
+      a socket that stopped accepting bytes for the reap window means
+      the loop or a client thread wedged — never expected under chaos
+      that only kills/partitions whole processes;
+    - a per-socket queue above its byte bound: the loop's `_enqueue`
+      seam enforces the bound per frame, so a breach means unbounded
+      buffering snuck back in (the exact failure mode the event loop
+      exists to prevent).
+
+    `check()` additionally requires that at least one sample saw a live
+    or completed stream — a verdict from a topology whose wire plane was
+    never exercised would vacuously pass everything above."""
+
+    def __init__(self) -> None:
+        self.samples: list[dict] = []
+        self.violations: list[str] = []
+        self._served = False
+
+    def sample(self, wave: int, servers) -> list[str]:
+        """Fold in `watch_loop_stats()` from every live server (servers
+        without a loop — threaded mode, stopped — contribute nothing)."""
+        out = []
+        for srv in servers:
+            try:
+                st = srv.watch_loop_stats()
+            except Exception:  # noqa: BLE001 - a dying server is not a wire bug
+                continue
+            if not st:
+                continue
+            url = getattr(srv, "url", "?")
+            self.samples.append({"wave": wave, "url": url, **st})
+            if st.get("connections", 0) or st.get("closed_total", 0):
+                self._served = True
+            if st.get("stuck_closed", 0):
+                out.append(
+                    f"wave {wave}: {url} reaped {st['stuck_closed']} "
+                    f"stuck wire socket(s)")
+            bound = st.get("queue_bound", 0)
+            if bound and st.get("queue_bytes_max", 0) > bound:
+                out.append(
+                    f"wave {wave}: {url} wire queue at "
+                    f"{st['queue_bytes_max']}B exceeds bound {bound}B")
+        self.violations.extend(out)
+        return out
+
+    def check(self) -> list[str]:
+        out = list(self.violations)
+        if self.samples and not self._served:
+            out.append("wire plane never served a stream: every sampled "
+                       "loop saw 0 connections over the whole soak")
         return out
 
 

@@ -150,22 +150,39 @@ class ReplicaClient:
 
             self._ssl_ctx = ssl.create_default_context(cafile=cafile)
         self._fault_target = urlparse(self.url).netloc or "replica"
+        # negotiated body codec (server/wirecodec.py): append/snapshot
+        # bodies upgrade to the zlib-framed binary message once a follower
+        # response carries the advertise header — replication batches are
+        # many near-identical JSON records, the codec's best case. A
+        # body-rejection error on a binary append (wirecodec.body_rejected)
+        # downgrades stickily (mixed-version fleet mid-rollout).
+        self._wire_seen = False
+        self._wire_down = False
 
     def _call(self, path: str, body: dict) -> dict:
         from .. import faults
+        from ..server import wirecodec
 
         try:
             faults.check(faults.BOUNDARY_HTTP, self._fault_target)
         except faults.InjectedFault as e:
             raise ReplicationError(f"replica unreachable: {e}") from None
-        headers = {"Content-Type": "application/json"}
+        sent_bin = self._wire_seen and not self._wire_down
+        if sent_bin:
+            headers = {"Content-Type": wirecodec.CONTENT_TYPE_BIN}
+            data = wirecodec.pack_message(body)
+        else:
+            headers = {"Content-Type": "application/json"}
+            data = json.dumps(body).encode()
         if self.token:
             headers["Authorization"] = f"Bearer {self.token}"
-        req = Request(self.url + path, data=json.dumps(body).encode(),
+        req = Request(self.url + path, data=data,
                       method="POST", headers=headers)
         try:
             with urlopen(req, timeout=self.timeout,
                          context=self._ssl_ctx) as resp:
+                if resp.headers.get(wirecodec.HEADER_WIRE):
+                    self._wire_seen = True
                 return json.loads(resp.read().decode() or "{}")
         except HTTPError as e:
             try:
@@ -173,6 +190,9 @@ class ReplicaClient:
             except Exception:  # noqa: BLE001
                 payload = {}
             msg = payload.get("error", str(e))
+            if sent_bin and wirecodec.body_rejected(e.code, msg):
+                self._wire_down = True
+                return self._call(path, body)
             if e.code == 409:
                 if payload.get("stale_token"):
                     raise StaleAppendError(msg) from None

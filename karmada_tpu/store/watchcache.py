@@ -44,7 +44,7 @@ import time
 from typing import Any, Optional
 
 from ..analysis.lockorder import make_lock
-from ..server import codec
+from ..server import codec, wirecodec
 from .store import ADDED, DELETED, Store
 
 DEFAULT_CAPACITY = 8192
@@ -71,7 +71,8 @@ class CacheEvent:
     builders produce identical values — benign."""
 
     __slots__ = ("rv", "kind", "event", "namespace", "name", "obj", "_enc",
-                 "_line", "_added_line")
+                 "_line", "_added_line", "_frame", "_added_frame",
+                 "_base_rv", "_base_src", "_delta_frame")
 
     def __init__(self, rv: int, kind: str, event: str, namespace: str,
                  name: str, obj: Any = None, enc: Any = None):
@@ -84,6 +85,17 @@ class CacheEvent:
         self._enc = enc
         self._line: Optional[bytes] = None
         self._added_line: Optional[bytes] = None
+        # dual encoding (binary wire codec): the full binary frame, the
+        # ADDED-frame variant, and the delta frame against the PREVIOUS
+        # object for this key. `_base_src` holds a reference to the prior
+        # object (or its encoding) — never the prior CacheEvent, so no
+        # predecessor chain is retained: one extra object per ring slot
+        # at most, freed with the slot on compaction or delta build.
+        self._frame: Optional[bytes] = None
+        self._added_frame: Optional[bytes] = None
+        self._base_rv: int = 0
+        self._base_src: Any = None
+        self._delta_frame: Optional[bytes] = None
 
     @property
     def enc(self) -> Any:
@@ -136,6 +148,51 @@ class CacheEvent:
             self._added_line = line
         return line
 
+    # -- binary wire frames (server/wirecodec.py), built once like line()
+
+    def frame(self) -> bytes:
+        """Full binary event frame — same JSON object as line(), framed."""
+        f = self._frame
+        if f is None:
+            f = wirecodec.event_frame(self.kind, self.event, self.rv,
+                                      self.enc)
+            self._frame = f
+        return f
+
+    def added_frame(self) -> bytes:
+        """frame() with the event rewritten to ADDED — snapshot replay."""
+        if self.event == ADDED:
+            return self.frame()
+        f = self._added_frame
+        if f is None:
+            f = wirecodec.event_frame(self.kind, ADDED, self.rv, self.enc)
+            self._added_frame = f
+        return f
+
+    def delta_frame(self) -> Optional[bytes]:
+        """Delta frame against this key's previous object, or None when no
+        base exists (ADDED/DELETED, or a delta would not be smaller than
+        the full frame). Built once; the base reference drops after the
+        build either way. Racing builders produce identical bytes."""
+        f = self._delta_frame
+        if f is not None:
+            return f if f else None  # b"" caches "not worth it"
+        base_rv = self._base_rv
+        if not base_rv or self.event in (ADDED, DELETED):
+            return None
+        src = self._base_src
+        if src is None:
+            return None
+        base_enc = codec.encode(src)  # idempotent on already-encoded dicts
+        patch = wirecodec.diff(base_enc, self.enc)
+        f = wirecodec.delta_frame(self.kind, self.event, self.rv,
+                                  self.namespace, self.name, base_rv, patch)
+        if len(f) >= len(self.frame()):
+            f = b""
+        self._delta_frame = f
+        self._base_src = None  # the base served its purpose
+        return f if f else None
+
 
 class WatchCache:
     def __init__(self, store: Store, capacity: int = DEFAULT_CAPACITY,
@@ -156,6 +213,11 @@ class WatchCache:
         self._pages: dict[int, list] = {}
         self._page_ids = itertools.count(1)
         self._attached = False
+        # wakeup fan-out beyond the condition variable: the event loop
+        # (server/eventloop.py) blocks in selectors.select(), not in
+        # wait() — each hook runs inside _on_event (store lock held) and
+        # must be non-blocking (the loop's is one os.write to a self-pipe)
+        self._notify_hooks: list = []
 
     # -- lifecycle --------------------------------------------------------
 
@@ -227,6 +289,19 @@ class WatchCache:
                     self._compacted_rv = self._events[drop - 1].rv
                     del self._events[:drop]
             self._cond.notify_all()
+        for hook in self._notify_hooks:
+            hook()
+
+    def add_notify(self, hook) -> None:
+        """Register a non-blocking wakeup hook, called after every ring
+        append (outside the cache lock, still under the store lock)."""
+        self._notify_hooks.append(hook)
+
+    def remove_notify(self, hook) -> None:
+        try:
+            self._notify_hooks.remove(hook)
+        except ValueError:
+            pass
 
     def _apply_index(self, ev: CacheEvent) -> None:
         by_key = self._index.setdefault(ev.kind, {})
@@ -234,6 +309,16 @@ class WatchCache:
         if ev.event == DELETED:
             by_key.pop(key, None)
         else:
+            prev = by_key.get(key)
+            if prev is not None and ev.event != ADDED:
+                # delta base: the key's previous OBJECT (or its encoding
+                # if already built) — exactly the state an rv-contiguous
+                # client holds for this key when ev arrives. Never the
+                # CacheEvent itself: that would chain predecessors
+                # indefinitely when no binary client forces delta builds.
+                ev._base_rv = prev.rv
+                ev._base_src = (prev._enc if prev._enc is not None
+                                else prev.obj)
             by_key[key] = ev
 
     # -- read side --------------------------------------------------------
@@ -242,6 +327,13 @@ class WatchCache:
     def current_rv(self) -> int:
         with self._cond:
             return self._rv
+
+    @property
+    def compacted_rv(self) -> int:
+        """Cursors at or past this rv resume exactly; older ones must
+        snapshot+replay (the event loop checks before each pump)."""
+        with self._cond:
+            return self._compacted_rv
 
     def events_since(self, rv: int, kind: str = "*", namespace: str = "",
                      limit: int = 0) -> tuple[list[CacheEvent], int, bool]:

@@ -117,7 +117,13 @@ def main() -> None:
     # acceptance booleans alongside the device numbers)
     artifact["runs"].append(run_bench(
         ["--configs", "fanout", "--fanout-watchers", "10000",
-         "--run-timeout", "600"], 700))
+         # async wire plane legs ride the same config: event-loop vs
+         # threaded watcher density at the 1k-stream point (paced shared
+         # write rate), plus the negotiated binary delta codec's
+         # bytes/event + bit-parity booleans
+         "--fanout-wire-watchers", "1000",
+         "--fanout-wire-window-s", "3.0",
+         "--run-timeout", "900"], 1000))
     # control-plane write path: transactional batch writes vs per-object
     # round-trips at W=32 concurrent writers — throughput, open-loop write
     # p99, WAL fsyncs/record, and the bit-parity boolean (host-side

@@ -1,0 +1,66 @@
+#!/usr/bin/env bash
+# Wire-plane smoke: the async serving plane's acceptance gates (ROADMAP
+# item 4's bench legs). Single-shot: runs the `fanout` bench config at
+# the wire density point — W namespace-scoped watch streams under a
+# paced shared write rate served by BOTH paths (one thread per stream vs
+# the selectors event loop), plus the negotiated binary delta codec leg —
+# and asserts the wire acceptance booleans the JSON line carries:
+#   pass_density_5x       event loop serves >= 5x the watcher density
+#                         per serving CPU core
+#   pass_wire_write_p99   loop-path write p99 no worse than threaded
+#   pass_delta_bytes      delta codec cuts bytes/event >= 20% with the
+#                         delta-applied state bit-identical to the full
+#                         JSON event at every rv
+# Exit 0 prints "WIRE OK".
+#
+# Wired into the slow path as tests/test_wire.py::TestWireSmokeScript
+# (pytest -m slow). Runs on CPU; needs no accelerator (the wire plane is
+# pure host code).
+set -euo pipefail
+
+cd "$(dirname "$0")/.."
+PY=${PYTHON:-python}
+WORK=$(mktemp -d /tmp/wire_smoke.XXXXXX)
+trap 'rm -rf "$WORK"' EXIT
+
+log() { echo "wire_smoke: $*"; }
+
+# small fanout window (the threaded-vs-cache legs are not under test
+# here), full-size wire legs
+JAX_PLATFORMS=cpu $PY bench.py --inner --platform cpu --configs fanout \
+    --fanout-watchers 50 --fanout-window-s 0.8 \
+    --fanout-wire-watchers 128 --fanout-wire-window-s 2.0 --verbose \
+    > "$WORK/out.txt" 2> "$WORK/err.txt" \
+    || { log "bench failed"; cat "$WORK/err.txt"; exit 1; }
+
+LINE=$(grep -E '^\{' "$WORK/out.txt" | tail -1)
+[ -n "$LINE" ] || { log "no JSON line emitted"; cat "$WORK/out.txt"; exit 1; }
+log "result: $LINE"
+
+WIRE_LINE="$LINE" $PY - <<'PYEOF'
+import json
+import os
+import sys
+
+rec = json.loads(os.environ["WIRE_LINE"])
+for key in ("pass_density_5x", "pass_wire_write_p99", "pass_delta_bytes"):
+    if not rec.get(key):
+        print(f"wire_smoke: criterion {key} FAILED "
+              f"(density_ratio={rec['wire'].get('density_ratio')}, "
+              f"bytes_per_event={rec.get('bytes_per_event')}, "
+              f"delta_errors={rec['delta'].get('errors')}, "
+              f"delta_loop={rec['delta'].get('loop')})", file=sys.stderr)
+        sys.exit(1)
+loop = rec["wire"]["loop"]["loop"]
+if loop.get("queue_bytes_max", 0) > loop.get("queue_bound", 1 << 60):
+    print("wire_smoke: per-socket queue exceeded its byte bound",
+          file=sys.stderr)
+    sys.exit(1)
+print(f"wire_smoke: {rec['watchers_per_core']} watchers/core on the loop "
+      f"({rec['wire']['density_ratio']}x threaded), "
+      f"delta {rec['bytes_per_event']['bin']} B/ev vs "
+      f"{rec['bytes_per_event']['json']} B/ev json "
+      f"(-{rec['delta']['delta_reduction']}), parity ok")
+PYEOF
+
+echo "WIRE OK"

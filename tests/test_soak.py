@@ -401,6 +401,7 @@ from karmada_tpu.soak import (
     GangIntegrity,
     ResourceBounds,
     SoakProfile,
+    WireHealth,
     WriteLedger,
     verdict_schema_ok,
 )
@@ -573,6 +574,60 @@ class TestResourceBoundsFires:
         assert [s["wave"] for s in bounds.samples] == [0]
 
 
+class _LoopStatsServer:
+    """A server-group member reduced to what WireHealth reads."""
+
+    def __init__(self, stats, url="http://127.0.0.1:7001"):
+        self._stats = stats
+        self.url = url
+
+    def watch_loop_stats(self):
+        if isinstance(self._stats, Exception):
+            raise self._stats
+        return self._stats
+
+
+def _loop_stats(**over):
+    base = {"connections": 3, "queue_bytes_max": 1024,
+            "queue_bound": 262144, "resyncs": 0, "evictions": 0,
+            "stuck_closed": 0, "closed_total": 5, "heartbeats": 2,
+            "cpu_s": 0.01}
+    base.update(over)
+    return base
+
+
+class TestWireHealthFires:
+    def test_planted_stuck_socket_fires(self):
+        wire = WireHealth()
+        out = wire.sample(2, [_LoopStatsServer(_loop_stats(stuck_closed=1))])
+        assert len(out) == 1 and "stuck wire socket" in out[0]
+        assert wire.check() == out
+
+    def test_planted_queue_over_bound_fires(self):
+        wire = WireHealth()
+        out = wire.sample(0, [_LoopStatsServer(
+            _loop_stats(queue_bytes_max=262145))])
+        assert len(out) == 1 and "exceeds bound" in out[0]
+
+    def test_never_served_fires_at_verdict(self):
+        wire = WireHealth()
+        idle = _loop_stats(connections=0, closed_total=0)
+        assert wire.sample(0, [_LoopStatsServer(idle)]) == []
+        assert any("never served" in v for v in wire.check())
+
+    def test_healthy_group_is_clean(self):
+        wire = WireHealth()
+        servers = [
+            _LoopStatsServer(_loop_stats()),
+            _LoopStatsServer({}),                  # threaded-mode server
+            _LoopStatsServer(RuntimeError("dying")),  # mid-failover
+        ]
+        for w in range(3):
+            assert wire.sample(w, servers) == []
+        assert wire.check() == []
+        assert [s["wave"] for s in wire.samples] == [0, 1, 2]
+
+
 # -- harness determinism + verdict validator pins ---------------------------
 
 
@@ -611,13 +666,14 @@ class TestVerdictSchema:
                 "lost_writes": [], "double_admissions": [],
                 "partial_gangs": [], "convergence_failures": [],
                 "resource_violations": [], "replication_failures": [],
+                "wire_violations": [],
             },
             "slo": {"stages": {}},
             "pass": True,
             "pass_lost_writes": True, "pass_exactly_once": True,
             "pass_gang_integrity": True, "pass_convergence": True,
             "pass_resources": True, "pass_replication": True,
-            "pass_lock_order": True,
+            "pass_wire_health": True, "pass_lock_order": True,
         }
 
     def test_minimal_valid_verdict_passes(self):
@@ -633,6 +689,8 @@ class TestVerdictSchema:
             lambda v: v.__setitem__("waves", []),
             lambda v: v["waves"][0].pop("converged"),
             lambda v: v["invariants"].pop("replication_failures"),
+            lambda v: v["invariants"].pop("wire_violations"),
+            lambda v: v.__setitem__("pass_wire_health", 1),
             lambda v: v.__setitem__("slo", {}),
             lambda v: v["config"].__setitem__("waves", "4"),
             lambda v: v.pop("invariants"),

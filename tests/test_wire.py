@@ -1,0 +1,689 @@
+"""Async wire plane (ISSUE 20): the negotiated binary delta codec
+(server/wirecodec.py) and the single-thread event-loop watch serving
+(server/eventloop.py), end to end over real sockets.
+
+The properties pinned here:
+- frame/message codec round-trips, including incremental (byte-at-a-time)
+  framing and the oversize/bad-magic rejections;
+- diff/apply_patch exactness: `apply_patch(base, diff(base, new))` is
+  canonically identical to `new` for every JSON shape we ship;
+- the negotiation matrix: binary client/binary server, JSON-pinned
+  client, pre-binary server (watch answers json-lines and the client
+  observably falls back; POST bodies never upgrade without the advertise
+  header; a 400 on a binary body downgrades stickily and retries);
+- event-loop serving: idle streams heartbeat from the loop timer, a
+  heartbeat can never corrupt framing mid-delta, a slow client's bounded
+  queue evicts into an in-stream resync that converges to the store's
+  exact state, stuck sockets are reaped;
+- delta soundness: the delta-applied client state is BIT-identical to
+  the full encoding at every rv, including across a mid-stream
+  compaction resync;
+- replication appends round-trip over the binary body codec and heal the
+  follower to byte-identical state.
+"""
+from __future__ import annotations
+
+import json
+import socket
+import threading
+import time
+
+import pytest
+
+from karmada_tpu.api.unstructured import Unstructured
+from karmada_tpu.server import codec, wirecodec
+from karmada_tpu.server.apiserver import ControlPlaneServer
+from karmada_tpu.server.eventloop import WatchLoop
+from karmada_tpu.server.remote import RemoteStore
+from karmada_tpu.store.store import Store
+from karmada_tpu.store.watchcache import WatchCache
+
+KIND = "v1/ConfigMap"
+
+
+def cm(name, ns="default", **data):
+    return Unstructured({
+        "apiVersion": "v1", "kind": "ConfigMap",
+        "metadata": {"name": name, "namespace": ns},
+        "data": {k: str(v) for k, v in data.items()} or {"v": "1"},
+    })
+
+
+def wait_until(pred, timeout=10.0, interval=0.02):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred():
+            return True
+        time.sleep(interval)
+    return pred()
+
+
+class _StubCP:
+    """Minimal cp surface for ControlPlaneServer (no PKI/cryptography)."""
+
+    def __init__(self):
+        self.store = Store()
+        self.members = {}
+
+    def settle(self, max_steps: int = 0) -> int:
+        return 0
+
+    def tick(self, seconds: float = 0.0) -> int:
+        return 0
+
+
+def raw_attach(port, kind=KIND, accept=None, replay=False, namespace=None,
+               timeout_s=10.0):
+    """Raw-socket watch attach: (socket, body bytes past the headers,
+    response Content-Type)."""
+    from urllib.parse import quote
+
+    s = socket.create_connection(("127.0.0.1", port), timeout=timeout_s)
+    req = (f"GET /watch?kind={quote(kind, safe='')}"
+           f"&replay={'1' if replay else '0'}")
+    if namespace:
+        req += f"&namespace={quote(namespace, safe='')}"
+    req += " HTTP/1.1\r\nHost: t\r\n"
+    if accept:
+        req += f"Accept: {accept}\r\n"
+    req += "Connection: close\r\n\r\n"
+    s.sendall(req.encode())
+    buf = b""
+    while b"\r\n\r\n" not in buf:
+        chunk = s.recv(65536)
+        if not chunk:
+            raise RuntimeError("attach: closed during headers")
+        buf += chunk
+    head, _, body = buf.partition(b"\r\n\r\n")
+    ctype = ""
+    for line in head.decode("latin-1").split("\r\n")[1:]:
+        if line.lower().startswith("content-type:"):
+            ctype = line.split(":", 1)[1].strip()
+    return s, body, ctype
+
+
+def drain_frames(sock, tail=b"", quiet_s=0.3, timeout_s=10.0):
+    """Read until the stream goes quiet; returns the parsed frame list.
+    Raises WireProtocolError on any framing corruption."""
+    reader = wirecodec.FrameReader()
+    frames = list(reader.feed(tail)) if tail else []
+    sock.settimeout(quiet_s)
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        try:
+            chunk = sock.recv(65536)
+        except socket.timeout:
+            break
+        if not chunk:
+            break
+        frames.extend(reader.feed(chunk))
+    return frames
+
+
+# ===========================================================================
+# Frame + message codec units
+# ===========================================================================
+
+
+class TestFrameCodec:
+    def test_roundtrip_incremental_feed(self):
+        payloads = [
+            (wirecodec.FRAME_HEARTBEAT, b""),
+            (wirecodec.FRAME_EVENT, b'{"rv": 1}'),
+            (wirecodec.FRAME_DELTA, b'{"rv": 2, "patch": [0, null]}'),
+            (wirecodec.FRAME_MESSAGE, b"\x78\x9c"),
+        ]
+        stream = b"".join(wirecodec.pack_frame(t, p) for t, p in payloads)
+        # whole-buffer feed
+        reader = wirecodec.FrameReader()
+        assert list(reader.feed(stream)) == payloads
+        # byte-at-a-time feed must yield the identical frames
+        reader = wirecodec.FrameReader()
+        got = []
+        for i in range(len(stream)):
+            got.extend(reader.feed(stream[i:i + 1]))
+        assert got == payloads
+
+    def test_bad_magic_rejected(self):
+        reader = wirecodec.FrameReader()
+        with pytest.raises(wirecodec.WireProtocolError):
+            list(reader.feed(b"XX\x01\x00\x00\x00\x00\x00"))
+
+    def test_oversize_frame_rejected(self):
+        import struct
+
+        hdr = struct.pack("!2sBBI", wirecodec.WIRE_MAGIC,
+                          wirecodec.WIRE_VERSION, wirecodec.FRAME_EVENT,
+                          wirecodec.MAX_FRAME_BYTES + 1)
+        reader = wirecodec.FrameReader()
+        with pytest.raises(wirecodec.WireProtocolError):
+            list(reader.feed(hdr))
+
+    def test_message_roundtrip_and_garbage_rejected(self):
+        body = {"op": "append", "entries": [{"rv": 7, "x": "y" * 500}]}
+        packed = wirecodec.pack_message(body)
+        assert wirecodec.unpack_message(packed) == body
+        # compresses: a 500-char run must beat its JSON length
+        assert len(packed) < len(json.dumps(body))
+        with pytest.raises(wirecodec.WireProtocolError):
+            wirecodec.unpack_message(b"not a frame at all")
+
+
+class TestDiffPatch:
+    CASES = [
+        ({"a": 1, "b": {"x": "1", "y": "2"}}, {"a": 1, "b": {"x": "9", "y": "2"}}),
+        ({"a": 1, "b": 2}, {"a": 1}),                    # key deleted
+        ({"a": 1}, {"a": 1, "c": {"deep": [1, 2]}}),     # key added
+        ({"l": [1, 2, 3]}, {"l": [1, 2, 3, 4]}),         # lists replace
+        ({"s": "x"}, {"s": {"now": "a dict"}}),          # type change
+        ({"same": {"deeply": {"nested": 1}}}, {"same": {"deeply": {"nested": 1}}}),
+        ({}, {"fresh": True}),
+    ]
+
+    def test_apply_patch_restores_new_exactly(self):
+        for base, new in self.CASES:
+            patch = wirecodec.diff(base, new)
+            applied = wirecodec.apply_patch(base, patch)
+            assert wirecodec.canonical(applied) == wirecodec.canonical(new), \
+                (base, new, patch)
+
+    def test_small_change_patches_smaller_than_full(self):
+        base = {"metadata": {"name": "n", "labels": {"k": "v"}},
+                "data": {"pad": "x" * 400, "t": "0"}}
+        new = json.loads(json.dumps(base))
+        new["data"]["t"] = "1"
+        patch = wirecodec.diff(base, new)
+        assert len(json.dumps(patch)) < len(json.dumps(new)) / 4
+
+
+# ===========================================================================
+# Negotiation matrix over a live server
+# ===========================================================================
+
+
+class TestNegotiation:
+    def test_binary_client_binary_server_upgrades_posts_and_watch(self):
+        srv = ControlPlaneServer(_StubCP())
+        srv.start()
+        rs = RemoteStore(srv.url)  # wire="auto"
+        try:
+            rs.create(cm("a", v=1))
+            # the advertise header on the first response flips the
+            # upgrade gate; subsequent POST bodies go binary
+            assert rs._wire_seen and not rs._wire_down
+            rs.create(cm("b", v=1))
+            rs.create_batch([cm("c", v=1), cm("d", v=1)])
+            assert {o.metadata.name for o in rs.list(KIND)} == \
+                {"a", "b", "c", "d"}
+            # watch negotiates the binary stream (Content-Type answers)
+            s, tail, ctype = raw_attach(
+                srv._port, accept=wirecodec.CONTENT_TYPE_BIN, replay=True)
+            try:
+                assert wirecodec.CONTENT_TYPE_BIN in ctype
+                frames = drain_frames(s, tail)
+                evs = [f for f in frames
+                       if f[0] != wirecodec.FRAME_HEARTBEAT]
+                assert len(evs) == 4  # the replay snapshot, framed
+            finally:
+                s.close()
+        finally:
+            rs.close()
+            srv.stop()
+
+    def test_json_pinned_client_never_upgrades(self):
+        srv = ControlPlaneServer(_StubCP())
+        srv.start()
+        rs = RemoteStore(srv.url, wire="json")
+        got = []
+        try:
+            rs.create(cm("a", v=1))
+            rs.watch(KIND, lambda ev, obj: got.append((ev, obj.name)),
+                     replay=True)
+            assert wait_until(lambda: len(got) == 1)
+            rs.create(cm("b", v=1))
+            assert wait_until(lambda: len(got) == 2)
+            assert not rs._wire_upgrade_ok()
+        finally:
+            rs.close()
+            srv.stop()
+
+    def test_pre_binary_server_watch_falls_back_to_json_lines(
+            self, monkeypatch):
+        """A server that never negotiates binary answers json-lines; the
+        binary-capable RemoteStore observably degrades and still
+        delivers."""
+        from karmada_tpu.server import apiserver as apiserver_mod
+
+        monkeypatch.setattr(
+            apiserver_mod.wirecodec, "accepts_binary", lambda h: False)
+        srv = ControlPlaneServer(_StubCP())
+        srv.start()
+        rs = RemoteStore(srv.url)  # wire="auto": sends Accept, gets json
+        got = []
+        try:
+            rs.create(cm("a", v=1))
+            s, _, ctype = raw_attach(
+                srv._port, accept=wirecodec.CONTENT_TYPE_BIN, replay=True)
+            s.close()
+            assert wirecodec.CONTENT_TYPE_BIN not in ctype
+            rs.watch(KIND, lambda ev, obj: got.append((ev, obj.name)),
+                     replay=True)
+            assert wait_until(lambda: ("ADDED", "a") in got)
+            rs.update(cm("a", v=2))
+            assert wait_until(lambda: ("MODIFIED", "a") in got)
+        finally:
+            rs.close()
+            srv.stop()
+
+    def test_no_advertise_header_means_no_body_upgrade(self, monkeypatch):
+        """POST bodies upgrade only after the server advertises
+        X-Karmada-Wire; a server that never does keeps the client on
+        plain JSON forever (a pre-binary server never sees a frame)."""
+        from karmada_tpu.server import apiserver as apiserver_mod
+        from karmada_tpu.server.httpbase import send_json
+
+        monkeypatch.setattr(
+            apiserver_mod.ControlPlaneServer, "_send",
+            staticmethod(lambda h, status, body: send_json(h, status, body)))
+        srv = ControlPlaneServer(_StubCP())
+        srv.start()
+        rs = RemoteStore(srv.url)
+        try:
+            rs.create(cm("a", v=1))
+            rs.create(cm("b", v=1))
+            assert not rs._wire_seen
+            assert not rs._wire_upgrade_ok()
+            assert len(rs.list(KIND)) == 2
+        finally:
+            rs.close()
+            srv.stop()
+
+    def test_binary_body_400_downgrades_stickily_and_retries(
+            self, monkeypatch):
+        """An upgraded client hitting a server that cannot parse the
+        binary body (400) retries that call as JSON and pins JSON for the
+        connection's lifetime — no flapping, no lost write."""
+        monkeypatch.setattr(
+            ControlPlaneServer, "_body",
+            staticmethod(lambda h: json.loads(
+                h.rfile.read(int(h.headers.get("Content-Length") or 0)
+                             ).decode())))
+        srv = ControlPlaneServer(_StubCP())
+        srv.start()
+        rs = RemoteStore(srv.url)
+        try:
+            rs.create(cm("a", v=1))          # learns the advertise header
+            assert rs._wire_seen
+            rs.create(cm("b", v=1))          # binary -> 400 -> json retry
+            assert rs._wire_down
+            rs.create_batch([cm("c", v=1)])  # stays json
+            assert {o.metadata.name for o in rs.list(KIND)} == \
+                {"a", "b", "c"}
+        finally:
+            rs.close()
+            srv.stop()
+
+
+# ===========================================================================
+# Event-loop serving: heartbeats, framing, slow clients, stuck sockets
+# ===========================================================================
+
+
+def loop_fixture(capacity=4096, queue_max=256 * 1024, heartbeat_s=0.15):
+    store = Store()
+    cache = WatchCache(store, capacity=capacity)
+    cache.attach()
+    loop = WatchLoop(cache, heartbeat_s=heartbeat_s,
+                     queue_max_bytes=queue_max)
+    loop.start()
+    return store, cache, loop
+
+
+class TestEventLoop:
+    def test_idle_stream_heartbeats_from_loop_timer(self):
+        """Bugfix pin: a stream with NO events must still emit heartbeats
+        (the loop timer owns them — not the event path), on both codecs."""
+        store, cache, loop = loop_fixture()
+        a, a_client = socket.socketpair()
+        b, b_client = socket.socketpair()
+        try:
+            rv = cache.current_rv
+            loop.add(a, kind="*", namespace="", wire="json",
+                     cursor=rv, delta_floor=rv)
+            loop.add(b, kind="*", namespace="", wire="bin",
+                     cursor=rv, delta_floor=rv)
+            a_client.settimeout(5.0)
+            b_client.settimeout(5.0)
+            assert a_client.recv(64) == b"\n"
+            got = b_client.recv(64)
+            reader = wirecodec.FrameReader()
+            frames = list(reader.feed(got))
+            assert frames and all(
+                t == wirecodec.FRAME_HEARTBEAT for t, _ in frames)
+            assert loop.stats()["heartbeats"] >= 2
+        finally:
+            loop.stop()
+            for s in (a_client, b_client):
+                s.close()
+
+    def test_heartbeat_never_corrupts_framing_mid_delta(self):
+        """Bugfix pin: heartbeats append only at frame boundaries. With a
+        large frame partially flushed into a full socket buffer, sweeps
+        fire while the remainder is queued — the client must still parse
+        the whole stream cleanly, heartbeats strictly between frames."""
+        store, cache, loop = loop_fixture(heartbeat_s=0.05)
+        srv_sock, client = socket.socketpair()
+        srv_sock.setsockopt(socket.SOL_SOCKET, socket.SO_SNDBUF, 8192)
+        try:
+            store.create(cm("big", pad="x"))
+            rv = cache.current_rv
+            loop.add(srv_sock, kind="*", namespace="", wire="bin",
+                     cursor=rv, delta_floor=rv)
+            # one update whose full frame exceeds the socket buffer: the
+            # flush leaves a partial frame queued across several sweeps
+            big = cm("big", pad="y" * 200_000)
+            store.update(big)
+            time.sleep(0.3)  # several heartbeat sweeps with bytes queued
+            frames = drain_frames(client, timeout_s=10.0)
+            evs = [(t, json.loads(p)) for t, p in frames
+                   if t != wirecodec.FRAME_HEARTBEAT]
+            assert len(evs) == 1
+            ftype, msg = evs[0]
+            if ftype == wirecodec.FRAME_DELTA:
+                basev = codec.encode(store.get(KIND, "big", "default"))
+                assert msg["patch"]
+            else:
+                assert msg["obj"]
+        finally:
+            loop.stop()
+            client.close()
+
+    def test_slow_client_eviction_resyncs_in_stream_to_exact_state(self):
+        """The bounded per-socket queue: a non-reading client stalls its
+        cursor; when the ring compacts past it, the backlog is evicted
+        into an in-stream resync (ADDED snapshot, fed incrementally) —
+        and once the client reads again, its state converges EXACTLY to
+        the store's."""
+        store, cache, loop = loop_fixture(
+            capacity=24, queue_max=4096, heartbeat_s=5.0)
+        srv_sock, client = socket.socketpair()
+        srv_sock.setsockopt(socket.SOL_SOCKET, socket.SO_SNDBUF, 4096)
+        try:
+            rv = cache.current_rv
+            loop.add(srv_sock, kind="*", namespace="", wire="json",
+                     cursor=rv, delta_floor=rv)
+            # 120 distinct keys x ~350B while the client reads nothing:
+            # the 4 KiB queue + 4 KiB socket buffer hold ~20 events, the
+            # 24-slot ring compacts far past the stalled cursor
+            for i in range(120):
+                store.create(cm(f"k{i:03d}", pad="p" * 300))
+            assert wait_until(lambda: loop.stats()["evictions"] >= 1)
+            assert loop.stats()["resyncs"] >= 1
+            assert loop.stats()["queue_bytes_max"] <= 4096
+            # now drain: live lines, then the resync's ADDED snapshot —
+            # last event per key must equal the store's current state
+            state = {}
+            buf = b""
+            client.settimeout(0.5)
+            deadline = time.monotonic() + 15.0
+            while time.monotonic() < deadline:
+                try:
+                    chunk = client.recv(65536)
+                except socket.timeout:
+                    if len(state) == 120:
+                        break
+                    continue
+                if not chunk:
+                    break
+                buf += chunk
+                while b"\n" in buf:
+                    line, _, buf = buf.partition(b"\n")
+                    if not line.strip():
+                        continue
+                    msg = json.loads(line)
+                    enc = msg["obj"]
+                    m = enc.get("manifest", enc).get("metadata", {})
+                    state[(m.get("namespace", ""), m.get("name", ""))] = \
+                        wirecodec.canonical(enc)
+            assert len(state) == 120
+            for o in store.list(KIND):
+                key = (o.metadata.namespace, o.metadata.name)
+                assert state[key] == wirecodec.canonical(codec.encode(o))
+        finally:
+            loop.stop()
+            client.close()
+
+    def test_stuck_socket_reaped(self, monkeypatch):
+        from karmada_tpu.server import eventloop as eventloop_mod
+
+        monkeypatch.setattr(eventloop_mod, "STUCK_SOCKET_TIMEOUT_S", 0.3)
+        store, cache, loop = loop_fixture(queue_max=2048, heartbeat_s=0.1)
+        srv_sock, client = socket.socketpair()
+        srv_sock.setsockopt(socket.SOL_SOCKET, socket.SO_SNDBUF, 2048)
+        try:
+            rv = cache.current_rv
+            loop.add(srv_sock, kind="*", namespace="", wire="json",
+                     cursor=rv, delta_floor=rv)
+            for i in range(40):
+                store.create(cm(f"s{i}", pad="p" * 400))
+            # the client never reads: pending bytes make no progress and
+            # the loop must close the socket within the (patched) bound
+            assert wait_until(lambda: loop.stats()["stuck_closed"] >= 1,
+                              timeout=5.0)
+            assert loop.stats()["connections"] == 0
+        finally:
+            loop.stop()
+            client.close()
+
+
+# ===========================================================================
+# Delta soundness: bit-parity at every rv, across a mid-stream resync
+# ===========================================================================
+
+
+class TestDeltaParity:
+    def test_bit_parity_every_rv_with_midstream_compaction_resync(self):
+        """A binary stream whose client state is asserted canonically
+        identical to the served encoding at every rv — then the client
+        stalls, the ring compacts past it (eviction -> in-stream ADDED
+        resync), and parity must hold again for everything after."""
+        store, cache, loop = loop_fixture(
+            capacity=24, queue_max=8192, heartbeat_s=5.0)
+        srv_sock, client = socket.socketpair()
+        srv_sock.setsockopt(socket.SOL_SOCKET, socket.SO_SNDBUF, 4096)
+        refs = {}  # rv -> canonical full encoding, captured at write time
+
+        def put(obj):
+            store.update(obj) if store.try_get(
+                KIND, obj.metadata.name, obj.metadata.namespace) \
+                else store.create(obj)
+            cur = store.get(KIND, obj.metadata.name, obj.metadata.namespace)
+            refs[int(cur.metadata.resource_version)] = \
+                wirecodec.canonical(codec.encode(cur))
+
+        try:
+            for i in range(6):
+                put(cm(f"d{i}", pad="q" * 120, t=0))
+            rv = cache.current_rv
+            loop.add(srv_sock, kind="*", namespace="", wire="bin",
+                     cursor=rv, delta_floor=rv)
+            # phase 1: live updates, client reading — deltas must appear
+            # and apply to bit-parity
+            for t in range(1, 4):
+                for i in range(6):
+                    put(cm(f"d{i}", pad="q" * 120, t=t))
+            state = {}
+            deltas_seen = [0]
+
+            def apply_frames(frames):
+                for ftype, payload in frames:
+                    if ftype == wirecodec.FRAME_HEARTBEAT:
+                        continue
+                    msg = json.loads(payload)
+                    if ftype == wirecodec.FRAME_DELTA:
+                        key = (msg["ns"], msg["name"])
+                        held_rv, held = state[key]
+                        assert held_rv == msg["base"], \
+                            f"delta base {msg['base']} vs held {held_rv}"
+                        enc = wirecodec.apply_patch(held, msg["patch"])
+                        deltas_seen[0] += 1
+                    else:
+                        enc = msg["obj"]
+                        m = enc.get("manifest", enc).get("metadata", {})
+                        key = (m.get("namespace", ""), m.get("name", ""))
+                    state[key] = (msg["rv"], enc)
+                    if msg["rv"] in refs:
+                        assert wirecodec.canonical(enc) == refs[msg["rv"]], \
+                            f"parity broke at rv {msg['rv']}"
+
+            apply_frames(drain_frames(client, quiet_s=0.4))
+            assert deltas_seen[0] > 0, "no delta frames on the live phase"
+            phase1_deltas = deltas_seen[0]
+            # phase 2: client stops reading; enough writes to fill the
+            # queue and compact the 24-slot ring past the stalled cursor
+            for t in range(4, 40):
+                for i in range(6):
+                    put(cm(f"d{i}", pad="q" * 120, t=t))
+            assert wait_until(lambda: loop.stats()["resyncs"] >= 1)
+            # phase 3: drain — the resync ADDED frames rebase the client,
+            # then deltas resume (floor drops to 0 after the snapshot);
+            # final state must equal the store exactly
+            for t in range(40, 44):
+                for i in range(6):
+                    put(cm(f"d{i}", pad="q" * 120, t=t))
+            apply_frames(drain_frames(client, quiet_s=0.4))
+            assert len(state) == 6
+            for o in store.list(KIND):
+                key = (o.metadata.namespace, o.metadata.name)
+                assert wirecodec.canonical(state[key][1]) == \
+                    wirecodec.canonical(codec.encode(o))
+            assert deltas_seen[0] > phase1_deltas, \
+                "no delta frames after the resync"
+        finally:
+            loop.stop()
+            client.close()
+
+    def test_remote_store_binary_watch_matches_json_watch(self):
+        """End-to-end through RemoteStore: the binary-negotiated watch
+        (delta application inside _attach_binary) must deliver the same
+        (event, name, rv) sequence as a JSON-pinned watch."""
+        srv = ControlPlaneServer(_StubCP())
+        srv.start()
+        rs_bin = RemoteStore(srv.url)             # negotiates binary
+        rs_json = RemoteStore(srv.url, wire="json")
+        seen = {"bin": [], "json": []}
+        lock = threading.Lock()
+
+        def rec(tag):
+            def h(ev, obj):
+                with lock:
+                    seen[tag].append(
+                        (ev, obj.name, int(obj.metadata.resource_version)))
+            return h
+
+        try:
+            rs_bin.create(cm("w0", v=0))
+            rs_bin.watch(KIND, rec("bin"), replay=True)
+            rs_json.watch(KIND, rec("json"), replay=True)
+            assert wait_until(lambda: len(seen["bin"]) >= 1
+                              and len(seen["json"]) >= 1)
+            for v in range(1, 6):
+                rs_bin.update(cm("w0", v=v))
+            rs_bin.create(cm("w1", v=0))
+            rs_bin.delete(KIND, "w1", "default")
+            assert wait_until(lambda: len(seen["bin"]) >= 8
+                              and len(seen["json"]) >= 8)
+            time.sleep(0.2)
+            with lock:
+                assert seen["bin"] == seen["json"]
+                assert [e for e, _, _ in seen["bin"]].count("DELETED") == 1
+        finally:
+            rs_bin.close()
+            rs_json.close()
+            srv.stop()
+
+
+# ===========================================================================
+# Replication over the binary body codec
+# ===========================================================================
+
+
+class TestReplicationBinary:
+    def test_binary_appends_heal_follower_to_byte_identical_state(
+            self, monkeypatch):
+        from karmada_tpu.store.replication import (
+            REPLICATION_LEASE,
+            ReplicaControlPlane,
+            ReplicationManager,
+        )
+
+        packed = [0]
+        real_pack = wirecodec.pack_message
+
+        def counting_pack(body):
+            packed[0] += 1
+            return real_pack(body)
+
+        # ReplicaClient reaches wirecodec.pack_message through the shared
+        # module: counting it proves the appends shipped binary
+        monkeypatch.setattr(wirecodec, "pack_message", counting_pack)
+        fol_cp = ReplicaControlPlane()
+        fol = ControlPlaneServer(fol_cp)
+        fol.start()
+        leader_cp = ReplicaControlPlane()
+        lease, ok = leader_cp.coordinator.acquire(
+            REPLICATION_LEASE, "leader-0", 10.0)
+        assert ok
+        mgr = ReplicationManager(
+            leader_cp.store, [fol.url], mode="quorum", quorum=1,
+            token=lease.spec.fencing_token, identity="leader-0")
+        leader = ControlPlaneServer(leader_cp, replication=mgr)
+        leader.start()
+        try:
+            mgr.advertise_url = leader.url
+            assert wait_until(lambda: all(
+                p.acked_rv >= leader_cp.store.current_rv
+                for p in mgr.peers))
+            for i in range(30):
+                leader_cp.store.create(cm(f"r{i:03d}", v=i, pad="z" * 64))
+            for i in range(0, 30, 3):
+                leader_cp.store.delete(KIND, f"r{i:03d}", "default")
+            assert wait_until(lambda: all(
+                p.acked_rv >= leader_cp.store.current_rv
+                for p in mgr.peers))
+
+            def dump(store):
+                return sorted(
+                    json.dumps(codec.encode(o), sort_keys=True)
+                    for kind in store.kinds() for o in store.list(kind))
+
+            assert dump(fol_cp.store) == dump(leader_cp.store)
+            # and the shipping really upgraded: appends after the first
+            # advertised response went out as binary framed messages
+            assert packed[0] > 0
+        finally:
+            leader.stop()
+            fol.stop()
+
+
+# ===========================================================================
+# The smoke script (slow path)
+# ===========================================================================
+
+
+@pytest.mark.slow
+class TestWireSmokeScript:
+    def test_wire_smoke(self):
+        """scripts/wire_smoke.sh: the wire density + delta codec legs of
+        the fanout bench, acceptance booleans asserted from the emitted
+        JSON line."""
+        import os
+        import subprocess
+
+        repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        r = subprocess.run(
+            ["bash", "scripts/wire_smoke.sh"],
+            capture_output=True, text=True, timeout=600, cwd=repo,
+        )
+        assert r.returncode == 0, r.stdout + r.stderr
+        assert "WIRE OK" in r.stdout
